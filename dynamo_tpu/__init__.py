@@ -1,0 +1,21 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of NVIDIA Dynamo
+(reference: /root/reference): disaggregated prefill/decode serving, KV-cache
+aware routing over a radix prefix index, a multi-tier paged KV block manager
+(TPU HBM <-> host DRAM), an OpenAI-compatible HTTP frontend, and a
+distributed asyncio runtime (lease-based discovery + message bus + TCP
+response streaming).
+
+Layer map (mirrors reference SURVEY.md section 1, re-architected for TPU):
+
+  L0  transports      dynamo_tpu.runtime.{store,bus,tcp}   control/request/response planes
+  L1  runtime         dynamo_tpu.runtime                   Runtime, DistributedRuntime, components
+  L2  pipeline        dynamo_tpu.runtime.{engine,pipeline} AsyncEngine, typed operator graph
+  L3  llm library     dynamo_tpu.{protocols,llm,kv_router,kv,http}
+  L4  launch          dynamo_tpu.launch                    dynamo-run equivalent CLI
+  L6  sdk             dynamo_tpu.sdk                       service graphs + supervisor
+  --  tpu engine      dynamo_tpu.{models,ops,parallel,engine}  the native JAX worker
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,87 @@
+"""Distributed runtime layer (L0/L1/L2).
+
+Re-design of the reference's ``lib/runtime`` crate for asyncio + TPU hosts:
+control-plane store with leases/watch, message bus, TCP response plane,
+component model with lease-backed discovery, and the AsyncEngine/pipeline
+abstractions every serving stage implements.
+"""
+
+from .annotated import Annotated
+from .bus import LocalBus, Message, NoResponders
+from .codec import TwoPartMessage, decode_buffer, encode, read_frame, write_frame
+from .component import (
+    Client,
+    Component,
+    Endpoint,
+    EndpointInfo,
+    EngineClient,
+    Namespace,
+    RequestEnvelope,
+    slug,
+)
+from .engine import (
+    AsyncEngine,
+    AsyncEngineContext,
+    CancellationToken,
+    Context,
+    EngineFn,
+    ResponseStream,
+    collect,
+)
+from .pipeline import MapOperator, Operator, link
+from .runtime import DistributedRuntime, Runtime, Worker
+from .store import (
+    EventKind,
+    KeyExists,
+    KvEntry,
+    LeaseKeeper,
+    LocalStore,
+    StoreError,
+    ValidationFailed,
+    WatchEvent,
+)
+from .tcp import ConnectionInfo, TcpStreamServer, connect_response_stream
+
+__all__ = [
+    "Annotated",
+    "AsyncEngine",
+    "AsyncEngineContext",
+    "CancellationToken",
+    "Client",
+    "Component",
+    "ConnectionInfo",
+    "Context",
+    "DistributedRuntime",
+    "Endpoint",
+    "EndpointInfo",
+    "EngineClient",
+    "EngineFn",
+    "EventKind",
+    "KeyExists",
+    "KvEntry",
+    "LeaseKeeper",
+    "LocalBus",
+    "LocalStore",
+    "MapOperator",
+    "Message",
+    "Namespace",
+    "NoResponders",
+    "Operator",
+    "RequestEnvelope",
+    "ResponseStream",
+    "Runtime",
+    "StoreError",
+    "TcpStreamServer",
+    "TwoPartMessage",
+    "ValidationFailed",
+    "WatchEvent",
+    "Worker",
+    "collect",
+    "connect_response_stream",
+    "decode_buffer",
+    "encode",
+    "link",
+    "read_frame",
+    "slug",
+    "write_frame",
+]

@@ -1,0 +1,278 @@
+"""Request-plane message bus: subjects, queue groups, work queues, objects.
+
+TPU-native re-design of the reference's NATS layer
+(lib/runtime/src/transports/nats.rs + JetStream uses): the serving stack
+needs four messaging shapes, all provided here behind one interface:
+
+  * **publish/subscribe** on hierarchical subjects — KV events, hit-rate
+    events (ref kv_router.rs:41 ``kv_events`` subject),
+  * **request/reply to a queue group** — the addressed request plane: each
+    worker endpoint subscribes its unique subject; the router publishes a
+    request envelope and gets an ack (the real response rides the TCP
+    response plane, see tcp.py),
+  * **durable work queue** with pull + ack + redelivery — the prefill queue
+    (ref examples/llm/utils/nats_queue.py:27-142),
+  * **object store** buckets with TTL — model deployment cards
+    (ref model_card/model.rs:42-49).
+
+:class:`LocalBus` is the in-process implementation and the state machine
+behind the TCP hub server (hub.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Awaitable, Callable, Optional
+
+
+class BusError(Exception):
+    pass
+
+
+class NoResponders(BusError):
+    """No subscriber on the requested subject (ref NATS no-responders)."""
+
+
+@dataclass
+class Message:
+    subject: str
+    payload: bytes
+    headers: dict[str, str] = field(default_factory=dict)
+    reply: Optional[str] = None
+
+
+@dataclass
+class QueueItem:
+    id: int
+    payload: bytes
+    deliveries: int = 0
+
+
+class Subscription:
+    def __init__(self, bus: "LocalBus", subject: str, group: Optional[str]):
+        self.subject = subject
+        self.group = group
+        self._queue: asyncio.Queue[Optional[Message]] = asyncio.Queue()
+        self._bus = bus
+
+    def _push(self, msg: Message) -> None:
+        self._queue.put_nowait(msg)
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[Message]:
+        try:
+            msg = await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        return msg
+
+    def unsubscribe(self) -> None:
+        self._bus._unsubscribe(self)
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator[Message]:
+        return self
+
+    async def __anext__(self) -> Message:
+        msg = await self._queue.get()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style matching: '*' one token, '>' tail wildcard."""
+    if pattern == subject:
+        return True
+    pt, st = pattern.split("."), subject.split(".")
+    for i, p in enumerate(pt):
+        if p == ">":
+            return True
+        if i >= len(st):
+            return False
+        if p != "*" and p != st[i]:
+            return False
+    return len(pt) == len(st)
+
+
+class _WorkQueue:
+    """Durable-ish FIFO with ack + visibility-timeout redelivery
+    (JetStream work-queue semantics, ref nats_queue.py)."""
+
+    def __init__(self, name: str, redeliver_after: float = 30.0):
+        self.name = name
+        self.redeliver_after = redeliver_after
+        self._ids = itertools.count(1)
+        self._ready: asyncio.Queue[QueueItem] = asyncio.Queue()
+        self._inflight: dict[int, tuple[QueueItem, float]] = {}
+
+    def push(self, payload: bytes) -> int:
+        item = QueueItem(next(self._ids), payload)
+        self._ready.put_nowait(item)
+        return item.id
+
+    async def pop(self, timeout: Optional[float]) -> Optional[QueueItem]:
+        self._redeliver_expired()
+        try:
+            item = await asyncio.wait_for(self._ready.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        item.deliveries += 1
+        self._inflight[item.id] = (item, time.monotonic() + self.redeliver_after)
+        return item
+
+    def ack(self, item_id: int) -> bool:
+        return self._inflight.pop(item_id, None) is not None
+
+    def nack(self, item_id: int) -> bool:
+        entry = self._inflight.pop(item_id, None)
+        if entry is None:
+            return False
+        self._ready.put_nowait(entry[0])
+        return True
+
+    def _redeliver_expired(self) -> None:
+        now = time.monotonic()
+        for item_id, (item, deadline) in list(self._inflight.items()):
+            if deadline <= now:
+                del self._inflight[item_id]
+                self._ready.put_nowait(item)
+
+    @property
+    def depth(self) -> int:
+        self._redeliver_expired()
+        return self._ready.qsize() + len(self._inflight)
+
+
+@dataclass
+class _ObjectEntry:
+    data: bytes
+    expires_at: Optional[float]
+
+
+class LocalBus:
+    """In-process bus implementation."""
+
+    def __init__(self):
+        self._subs: list[Subscription] = []
+        self._rr: dict[tuple[str, str], int] = {}  # queue-group round robin
+        self._inboxes: dict[str, asyncio.Future] = {}
+        self._inbox_ids = itertools.count(1)
+        self._queues: dict[str, _WorkQueue] = {}
+        self._objects: dict[str, dict[str, _ObjectEntry]] = {}
+        # request handlers registered as service endpoints (fast path)
+        self._handlers: dict[str, Callable[[Message], Awaitable[bytes]]] = {}
+
+    # ---- pub/sub ----
+    def subscribe(self, subject: str, group: Optional[str] = None) -> Subscription:
+        sub = Subscription(self, subject, group)
+        self._subs.append(sub)
+        return sub
+
+    def _unsubscribe(self, sub: Subscription) -> None:
+        if sub in self._subs:
+            self._subs.remove(sub)
+
+    def publish(
+        self,
+        subject: str,
+        payload: bytes,
+        headers: Optional[dict[str, str]] = None,
+        reply: Optional[str] = None,
+    ) -> int:
+        """Deliver to all plain subscribers and one member per queue group.
+        Returns the number of deliveries."""
+        msg = Message(subject, payload, headers or {}, reply)
+        matched = [s for s in self._subs if _subject_matches(s.subject, subject)]
+        delivered = 0
+        groups: dict[str, list[Subscription]] = {}
+        for s in matched:
+            if s.group is None:
+                s._push(msg)
+                delivered += 1
+            else:
+                groups.setdefault(s.group, []).append(s)
+        for group, members in groups.items():
+            idx = self._rr.get((subject, group), 0) % len(members)
+            self._rr[(subject, group)] = idx + 1
+            members[idx]._push(msg)
+            delivered += 1
+        return delivered
+
+    # ---- request/reply ----
+    async def request(
+        self,
+        subject: str,
+        payload: bytes,
+        timeout: float = 30.0,
+        headers: Optional[dict[str, str]] = None,
+    ) -> bytes:
+        handler = self._handlers.get(subject)
+        if handler is not None:
+            return await asyncio.wait_for(
+                handler(Message(subject, payload, headers or {})), timeout
+            )
+        inbox = f"_inbox.{next(self._inbox_ids)}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._inboxes[inbox] = fut
+        try:
+            n = self.publish(subject, payload, headers, reply=inbox)
+            if n == 0:
+                raise NoResponders(subject)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._inboxes.pop(inbox, None)
+
+    def respond(self, msg: Message, payload: bytes) -> None:
+        if not msg.reply:
+            return
+        fut = self._inboxes.get(msg.reply)
+        if fut is not None and not fut.done():
+            fut.set_result(payload)
+
+    def register_handler(
+        self, subject: str, handler: Callable[[Message], Awaitable[bytes]]
+    ) -> None:
+        """Direct-call service endpoint (in-process fast path)."""
+        self._handlers[subject] = handler
+
+    def unregister_handler(self, subject: str) -> None:
+        self._handlers.pop(subject, None)
+
+    def handler_subjects(self) -> list[str]:
+        return list(self._handlers)
+
+    # ---- work queues ----
+    def work_queue(self, name: str, redeliver_after: float = 30.0) -> _WorkQueue:
+        q = self._queues.get(name)
+        if q is None:
+            q = self._queues[name] = _WorkQueue(name, redeliver_after)
+        return q
+
+    # ---- object store ----
+    def object_put(
+        self, bucket: str, name: str, data: bytes, ttl: Optional[float] = None
+    ) -> None:
+        expires = time.monotonic() + ttl if ttl else None
+        self._objects.setdefault(bucket, {})[name] = _ObjectEntry(data, expires)
+
+    def object_get(self, bucket: str, name: str) -> Optional[bytes]:
+        entry = self._objects.get(bucket, {}).get(name)
+        if entry is None:
+            return None
+        if entry.expires_at is not None and entry.expires_at <= time.monotonic():
+            del self._objects[bucket][name]
+            return None
+        return entry.data
+
+    def object_list(self, bucket: str) -> list[str]:
+        now = time.monotonic()
+        out = []
+        for name, entry in list(self._objects.get(bucket, {}).items()):
+            if entry.expires_at is not None and entry.expires_at <= now:
+                del self._objects[bucket][name]
+            else:
+                out.append(name)
+        return sorted(out)

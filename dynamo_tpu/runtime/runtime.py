@@ -1,0 +1,182 @@
+"""Runtime + Worker + DistributedRuntime.
+
+Re-design of the reference's runtime layer (lib/runtime/src/{runtime,worker,
+distributed}.rs): a process-wide asyncio runtime with a cancellation-token
+tree, a ``Worker`` main() wrapper with signal handling and a graceful
+shutdown timeout (exit code 911 on overrun, ref worker.rs:16-80), and the
+``DistributedRuntime`` which owns the control-plane store connection (with
+the process's *primary lease* — the liveness primitive), the message bus,
+and the lazily-started TCP response-plane server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Awaitable, Callable, Optional
+
+from .bus import LocalBus
+from .engine import CancellationToken
+from .store import LeaseKeeper, LocalStore
+from .tcp import TcpStreamServer
+
+logger = logging.getLogger(__name__)
+
+GRACEFUL_SHUTDOWN_TIMEOUT_ENV = "DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT"
+EXIT_CODE_SHUTDOWN_OVERRUN = 911
+
+
+class Runtime:
+    """Process-wide runtime: cancellation root + background task tracking
+    (ref runtime.rs:38-117)."""
+
+    def __init__(self):
+        self.cancellation = CancellationToken()
+        self._tasks: set[asyncio.Task] = set()
+
+    def child_token(self) -> CancellationToken:
+        return self.cancellation.child_token()
+
+    def spawn(self, coro: Awaitable, name: Optional[str] = None) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    def shutdown(self) -> None:
+        self.cancellation.cancel()
+
+    async def join(self, timeout: Optional[float] = None, cancel: bool = False) -> bool:
+        """Wait for background tasks; returns False on timeout. With
+        ``cancel=True``, cancel everything first (daemon-style tasks like
+        serve loops never end on their own)."""
+        pending = [t for t in self._tasks if not t.done()]
+        if cancel:
+            for t in pending:
+                t.cancel()
+        if not pending:
+            return True
+        done, still = await asyncio.wait(pending, timeout=timeout)
+        for t in still:
+            t.cancel()
+        return not still
+
+
+class DistributedRuntime:
+    """Runtime + control-plane store + bus + response-plane server
+    (ref distributed.rs:31-129).
+
+    ``store``/``bus`` may be local in-process instances or remote hub
+    clients (dynamo_tpu.runtime.hub) — everything above this class is
+    transport-agnostic.
+    """
+
+    PRIMARY_LEASE_TTL = 10.0
+
+    def __init__(self, store=None, bus=None, host: str = "127.0.0.1"):
+        self.runtime = Runtime()
+        self.store = store if store is not None else LocalStore()
+        self.bus = bus if bus is not None else LocalBus()
+        self._tcp_server: Optional[TcpStreamServer] = None
+        self._host = host
+        self.primary_lease_id: int = 0
+        self._lease_keeper: Optional[LeaseKeeper] = None
+        self._started = False
+
+    @classmethod
+    async def from_settings(cls, store=None, bus=None, host: str = "127.0.0.1"):
+        drt = cls(store=store, bus=bus, host=host)
+        await drt.start()
+        return drt
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        if isinstance(self.store, LocalStore):
+            self.store.start()
+        lease = self.store.grant_lease(self.PRIMARY_LEASE_TTL)
+        if asyncio.iscoroutine(lease):
+            lease = await lease
+        self.primary_lease_id = lease
+        self._lease_keeper = LeaseKeeper(
+            self.store,
+            lease,
+            self.PRIMARY_LEASE_TTL,
+            on_lost=self.runtime.shutdown,
+        )
+        self._lease_keeper.start()
+
+    @property
+    def worker_id(self) -> int:
+        """Stable identity of this process in the cluster = its lease id
+        (the reference uses the etcd lease id the same way)."""
+        return self.primary_lease_id
+
+    async def tcp_server(self) -> TcpStreamServer:
+        """Lazily-started response-plane server (ref distributed.rs lazy TCP)."""
+        if self._tcp_server is None:
+            self._tcp_server = TcpStreamServer(host=self._host)
+            await self._tcp_server.start()
+        return self._tcp_server
+
+    def namespace(self, name: str):
+        from .component import Namespace
+
+        return Namespace(self, name)
+
+    async def shutdown(self) -> None:
+        self.runtime.shutdown()
+        if self._lease_keeper:
+            await self._lease_keeper.stop(revoke=True)
+            self._lease_keeper = None
+        if self._tcp_server:
+            await self._tcp_server.close()
+            self._tcp_server = None
+        await self.runtime.join(timeout=5.0, cancel=True)
+
+
+class Worker:
+    """main() wrapper: run an async entrypoint under signal handling with a
+    graceful-shutdown deadline (ref worker.rs:16-80)."""
+
+    def __init__(self, drt: Optional[DistributedRuntime] = None):
+        self.drt = drt
+
+    def execute(self, fn: Callable[[DistributedRuntime], Awaitable[None]]) -> None:
+        try:
+            asyncio.run(self._run(fn))
+        except KeyboardInterrupt:
+            pass
+
+    async def _run(self, fn: Callable[[DistributedRuntime], Awaitable[None]]) -> None:
+        drt = self.drt or DistributedRuntime()
+        await drt.start()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, drt.runtime.shutdown)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        main_task = loop.create_task(fn(drt))
+        cancel_wait = loop.create_task(drt.runtime.cancellation.cancelled())
+        done, _ = await asyncio.wait(
+            [main_task, cancel_wait], return_when=asyncio.FIRST_COMPLETED
+        )
+        if main_task in done:
+            cancel_wait.cancel()
+            main_task.result()  # propagate errors
+            await drt.shutdown()
+            return
+        # external shutdown requested: give main a grace period
+        timeout = float(os.environ.get(GRACEFUL_SHUTDOWN_TIMEOUT_ENV, "30"))
+        main_task.cancel()
+        try:
+            await asyncio.wait_for(asyncio.gather(main_task, return_exceptions=True), timeout)
+        except asyncio.TimeoutError:
+            logger.error("graceful shutdown overran %ss; exiting 911", timeout)
+            sys.exit(EXIT_CODE_SHUTDOWN_OVERRUN)
+        await drt.shutdown()

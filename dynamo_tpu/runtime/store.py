@@ -1,0 +1,261 @@
+"""Control-plane KV store: discovery, leases/liveness, dynamic config.
+
+TPU-native re-design of the reference's etcd transport
+(lib/runtime/src/transports/etcd.rs:38-346 + etcd/lease.rs): a
+strongly-ordered key-value store with
+
+  * **leases** with TTL + keepalive — the liveness primitive: every endpoint
+    registration is bound to its worker's primary lease; lease loss deletes
+    the keys, which every watcher observes (elastic membership),
+  * **atomic create-if-absent** (``kv_create``) and create-or-validate,
+  * **prefix get + watch** streams of Put/Delete events.
+
+Deployments that fit on one host use :class:`LocalStore` in-process; the
+multi-host path serves the same interface over TCP via
+:mod:`dynamo_tpu.runtime.hub` (no external etcd dependency — TPU pods give
+us a reliable single coordinator host, so a replicated consensus store is
+deliberately out of scope; the interface would admit one).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import AsyncIterator, Optional
+
+
+class StoreError(Exception):
+    pass
+
+
+class KeyExists(StoreError):
+    pass
+
+
+class ValidationFailed(StoreError):
+    pass
+
+
+class EventKind(str, Enum):
+    PUT = "put"
+    DELETE = "delete"
+
+
+@dataclass
+class WatchEvent:
+    kind: EventKind
+    key: str
+    value: bytes = b""
+    lease_id: int = 0
+
+
+@dataclass
+class KvEntry:
+    key: str
+    value: bytes
+    lease_id: int = 0
+    revision: int = 0
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: set[str] = field(default_factory=set)
+
+
+class Watcher:
+    """A live prefix watch: async-iterate to receive WatchEvents.
+
+    Mirrors the reference's PrefixWatcher (etcd.rs:283-332): creating one
+    returns the current snapshot plus the event stream from that revision.
+    """
+
+    def __init__(self, prefix: str, snapshot: list[KvEntry], store: "LocalStore"):
+        self.prefix = prefix
+        self.snapshot = snapshot
+        self._queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
+        self._store = store
+
+    def _push(self, ev: WatchEvent) -> None:
+        self._queue.put_nowait(ev)
+
+    def cancel(self) -> None:
+        self._store._watchers.discard(self)
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self._queue.get()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+
+class LocalStore:
+    """In-process store implementation; also the state machine behind the
+    TCP hub server."""
+
+    def __init__(self, *, clock=time.monotonic):
+        self._data: dict[str, KvEntry] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._watchers: set[Watcher] = set()
+        self._revision = itertools.count(1)
+        self._lease_ids = itertools.count(1)
+        self._clock = clock
+        self._reaper_task: Optional[asyncio.Task] = None
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        if self._reaper_task is None:
+            self._reaper_task = asyncio.get_running_loop().create_task(self._reaper())
+
+    async def close(self) -> None:
+        if self._reaper_task:
+            self._reaper_task.cancel()
+            self._reaper_task = None
+        for w in list(self._watchers):
+            w.cancel()
+
+    async def _reaper(self) -> None:
+        while True:
+            await asyncio.sleep(0.25)
+            self.expire_leases()
+
+    def expire_leases(self) -> None:
+        now = self._clock()
+        for lease in [l for l in self._leases.values() if l.deadline <= now]:
+            self._revoke(lease.id)
+
+    # ---- leases ----
+    def grant_lease(self, ttl: float) -> int:
+        lease_id = next(self._lease_ids)
+        self._leases[lease_id] = _Lease(lease_id, ttl, self._clock() + ttl)
+        return lease_id
+
+    def keep_alive(self, lease_id: int) -> bool:
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.deadline = self._clock() + lease.ttl
+        return True
+
+    def revoke_lease(self, lease_id: int) -> None:
+        self._revoke(lease_id)
+
+    def _revoke(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            self._delete(key)
+
+    # ---- kv ----
+    def _notify(self, ev: WatchEvent) -> None:
+        for w in list(self._watchers):
+            if ev.key.startswith(w.prefix):
+                w._push(ev)
+
+    def _attach(self, key: str, lease_id: int) -> None:
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise StoreError(f"unknown lease {lease_id}")
+            lease.keys.add(key)
+
+    def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        old = self._data.get(key)
+        if old is not None and old.lease_id and old.lease_id != lease_id:
+            lease = self._leases.get(old.lease_id)
+            if lease:
+                lease.keys.discard(key)
+        self._attach(key, lease_id)
+        self._data[key] = KvEntry(key, value, lease_id, next(self._revision))
+        self._notify(WatchEvent(EventKind.PUT, key, value, lease_id))
+
+    def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        """Atomic create-if-absent (ref: etcd.rs kv_create txn)."""
+        if key in self._data:
+            raise KeyExists(key)
+        self.kv_put(key, value, lease_id)
+
+    def kv_create_or_validate(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        existing = self._data.get(key)
+        if existing is None:
+            self.kv_put(key, value, lease_id)
+        elif existing.value != value:
+            raise ValidationFailed(key)
+
+    def kv_get(self, key: str) -> Optional[KvEntry]:
+        return self._data.get(key)
+
+    def kv_get_prefix(self, prefix: str) -> list[KvEntry]:
+        return [e for k, e in sorted(self._data.items()) if k.startswith(prefix)]
+
+    def kv_delete(self, key: str) -> bool:
+        return self._delete(key)
+
+    def _delete(self, key: str) -> bool:
+        entry = self._data.pop(key, None)
+        if entry is None:
+            return False
+        if entry.lease_id:
+            lease = self._leases.get(entry.lease_id)
+            if lease:
+                lease.keys.discard(key)
+        self._notify(WatchEvent(EventKind.DELETE, key))
+        return True
+
+    def kv_delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self._data if k.startswith(prefix)]
+        for k in keys:
+            self._delete(k)
+        return len(keys)
+
+    # ---- watch ----
+    def watch_prefix(self, prefix: str) -> Watcher:
+        w = Watcher(prefix, self.kv_get_prefix(prefix), self)
+        self._watchers.add(w)
+        return w
+
+
+class LeaseKeeper:
+    """Background keepalive for a lease (ref: etcd/lease.rs:51). Cancels the
+    given CancellationToken if the lease is lost."""
+
+    def __init__(self, store, lease_id: int, ttl: float, on_lost=None):
+        self._store = store
+        self.lease_id = lease_id
+        self._ttl = ttl
+        self._on_lost = on_lost
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        interval = max(self._ttl / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            ok = self._store.keep_alive(self.lease_id)
+            if asyncio.iscoroutine(ok):
+                ok = await ok
+            if not ok:
+                if self._on_lost:
+                    self._on_lost()
+                return
+
+    async def stop(self, revoke: bool = True) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        if revoke:
+            r = self._store.revoke_lease(self.lease_id)
+            if asyncio.iscoroutine(r):
+                await r

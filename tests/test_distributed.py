@@ -1,0 +1,195 @@
+"""Distributed runtime integration tests: serve/discover/route/stream/cancel.
+
+Mirrors the reference's lib/runtime/tests/soak.rs ingress/egress round-trips,
+but all in-process: shared LocalStore/LocalBus plus the real TCP response
+plane on loopback.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Annotated,
+    AsyncEngine,
+    Context,
+    DistributedRuntime,
+    EngineClient,
+    LocalBus,
+    LocalStore,
+    collect,
+)
+
+
+class EchoEngine(AsyncEngine):
+    async def generate(self, request: Context):
+        for ch in request.data["text"]:
+            yield Annotated.from_data({"token": ch})
+
+
+class SlowEngine(AsyncEngine):
+    def __init__(self):
+        self.cancelled = asyncio.Event()
+
+    async def generate(self, request: Context):
+        for i in range(1000):
+            if request.context.is_stopped():
+                self.cancelled.set()
+                return
+            yield Annotated.from_data({"i": i})
+            await asyncio.sleep(0.01)
+
+
+async def make_pair(store, bus):
+    """One worker drt + one frontend drt sharing the control plane."""
+    worker = await DistributedRuntime.from_settings(store=store, bus=bus)
+    front = await DistributedRuntime.from_settings(store=store, bus=bus)
+    return worker, front
+
+
+def test_endpoint_roundtrip(run):
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        worker, front = await make_pair(store, bus)
+        ep = worker.namespace("ns").component("gen").endpoint("generate")
+        await ep.serve(EchoEngine(), stats_handler=lambda: {"load": 1})
+
+        client = await front.namespace("ns").component("gen").endpoint("generate").client().start()
+        ids = await client.wait_for_instances(timeout=5)
+        assert ids == [worker.primary_lease_id]
+
+        stream = await client.round_robin(Context({"text": "hi"}))
+        out = await collect(stream)
+        assert [a.data["token"] for a in out] == ["h", "i"]
+
+        stats = await worker.namespace("ns").component("gen").scrape_stats()
+        assert stats and stats[0]["data"] == {"load": 1}
+        await worker.shutdown()
+        await front.shutdown()
+
+    run(main())
+
+
+def test_multi_instance_round_robin_and_direct(run):
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        front = await DistributedRuntime.from_settings(store=store, bus=bus)
+        workers = []
+        for _ in range(3):
+            w = await DistributedRuntime.from_settings(store=store, bus=bus)
+            ep = w.namespace("ns").component("gen").endpoint("g")
+
+            class Tagged(AsyncEngine):
+                def __init__(self, wid):
+                    self.wid = wid
+
+                async def generate(self, request: Context):
+                    yield Annotated.from_data({"worker": self.wid})
+
+            await ep.serve(Tagged(w.primary_lease_id))
+            workers.append(w)
+
+        client = await front.namespace("ns").component("gen").endpoint("g").client().start()
+        await client.wait_for_instances(5)
+        assert len(client.instance_ids()) == 3
+
+        seen = set()
+        for _ in range(3):
+            out = await collect(await client.round_robin(Context({})))
+            seen.add(out[0].data["worker"])
+        assert seen == set(client.instance_ids())
+
+        target = client.instance_ids()[1]
+        out = await collect(await client.direct(Context({}), target))
+        assert out[0].data["worker"] == target
+
+        for w in workers:
+            await w.shutdown()
+        await front.shutdown()
+
+    run(main())
+
+
+def test_lease_loss_removes_instance(run):
+    async def main():
+        now = [0.0]
+        store = LocalStore(clock=lambda: now[0])
+        bus = LocalBus()
+        worker, front = await make_pair(store, bus)
+        ep = worker.namespace("ns").component("gen").endpoint("g")
+        await ep.serve(EchoEngine())
+        client = await front.namespace("ns").component("gen").endpoint("g").client().start()
+        await client.wait_for_instances(5)
+
+        # simulate worker death: stop keepalive, advance clock past TTL
+        await worker._lease_keeper.stop(revoke=False)
+        worker._lease_keeper = None
+        now[0] = DistributedRuntime.PRIMARY_LEASE_TTL + 1
+        store.expire_leases()
+        await asyncio.sleep(0.05)
+        assert client.instance_ids() == []
+        await front.shutdown()
+
+    run(main())
+
+
+def test_stop_propagates_to_worker(run):
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        worker, front = await make_pair(store, bus)
+        engine = SlowEngine()
+        await worker.namespace("ns").component("gen").endpoint("g").serve(engine)
+        client = await front.namespace("ns").component("gen").endpoint("g").client().start()
+        await client.wait_for_instances(5)
+
+        ctx_req = Context({})
+        stream = await client.round_robin(ctx_req)
+        count = 0
+        async for _item in stream:
+            count += 1
+            if count == 3:
+                ctx_req.context.stop_generating()
+                break
+        await asyncio.wait_for(engine.cancelled.wait(), timeout=5)
+        await worker.shutdown()
+        await front.shutdown()
+
+    run(main())
+
+
+def test_engine_error_surfaces_as_annotated_error(run):
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        worker, front = await make_pair(store, bus)
+
+        class Boom(AsyncEngine):
+            async def generate(self, request: Context):
+                yield Annotated.from_data({"ok": 1})
+                raise RuntimeError("engine exploded")
+
+        await worker.namespace("ns").component("gen").endpoint("g").serve(Boom())
+        client = await front.namespace("ns").component("gen").endpoint("g").client().start()
+        await client.wait_for_instances(5)
+        out = await collect(await client.round_robin(Context({})))
+        assert out[0].data == {"ok": 1}
+        assert out[-1].is_error() and "exploded" in out[-1].error
+        await worker.shutdown()
+        await front.shutdown()
+
+    run(main())
+
+
+def test_engine_client_adapter_links_into_pipeline(run):
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        worker, front = await make_pair(store, bus)
+        await worker.namespace("ns").component("gen").endpoint("g").serve(EchoEngine())
+        client = await front.namespace("ns").component("gen").endpoint("g").client().start()
+        await client.wait_for_instances(5)
+        remote = EngineClient(client)
+        out = await collect(remote.generate(Context({"text": "ab"})))
+        assert [a.data["token"] for a in out] == ["a", "b"]
+        await worker.shutdown()
+        await front.shutdown()
+
+    run(main())

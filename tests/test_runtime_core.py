@@ -1,0 +1,254 @@
+"""Runtime-core unit tests: codec, store, bus, pipeline, cancellation.
+
+Modeled on the reference's lib/runtime/tests/{pipeline,pool}.rs strategy:
+in-process graphs with fake engines, no external services.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Annotated,
+    AsyncEngine,
+    Context,
+    EngineFn,
+    KeyExists,
+    LocalBus,
+    LocalStore,
+    MapOperator,
+    NoResponders,
+    Operator,
+    TwoPartMessage,
+    ValidationFailed,
+    collect,
+    decode_buffer,
+    encode,
+    link,
+)
+from dynamo_tpu.runtime.bus import _subject_matches
+from dynamo_tpu.runtime.engine import CancellationToken
+
+
+# ---------------- codec ----------------
+
+
+def test_codec_roundtrip():
+    msg = TwoPartMessage.from_json({"a": 1}, data=b"\x00\x01payload")
+    decoded, rest = decode_buffer(encode(msg))
+    assert rest == b""
+    assert decoded.header_json() == {"a": 1}
+    assert decoded.data == b"\x00\x01payload"
+
+
+def test_codec_multiple_frames():
+    buf = encode(TwoPartMessage(b"h1", b"d1")) + encode(TwoPartMessage(b"h2", b""))
+    m1, rest = decode_buffer(buf)
+    m2, rest = decode_buffer(rest)
+    assert (m1.header, m1.data) == (b"h1", b"d1")
+    assert (m2.header, m2.data) == (b"h2", b"")
+    assert rest == b""
+
+
+# ---------------- store ----------------
+
+
+def test_store_create_and_validate(run):
+    async def main():
+        s = LocalStore()
+        s.kv_create("k", b"v")
+        with pytest.raises(KeyExists):
+            s.kv_create("k", b"v2")
+        s.kv_create_or_validate("k", b"v")
+        with pytest.raises(ValidationFailed):
+            s.kv_create_or_validate("k", b"other")
+        assert s.kv_get("k").value == b"v"
+
+    run(main())
+
+
+def test_store_lease_expiry_deletes_keys_and_notifies(run):
+    async def main():
+        now = [0.0]
+        s = LocalStore(clock=lambda: now[0])
+        lease = s.grant_lease(ttl=5.0)
+        s.kv_put("ns/components/w/ep:1", b"info", lease_id=lease)
+        w = s.watch_prefix("ns/components/")
+        assert len(w.snapshot) == 1
+        now[0] = 6.0
+        s.expire_leases()
+        ev = await asyncio.wait_for(w.__anext__(), 1)
+        assert ev.kind.value == "delete"
+        assert s.kv_get_prefix("ns/") == []
+
+    run(main())
+
+
+def test_store_keepalive_extends_lease(run):
+    async def main():
+        now = [0.0]
+        s = LocalStore(clock=lambda: now[0])
+        lease = s.grant_lease(ttl=5.0)
+        s.kv_put("k", b"v", lease_id=lease)
+        now[0] = 4.0
+        assert s.keep_alive(lease)
+        now[0] = 8.0
+        s.expire_leases()
+        assert s.kv_get("k") is not None
+        now[0] = 10.0
+        s.expire_leases()
+        assert s.kv_get("k") is None
+        assert not s.keep_alive(lease)
+
+    run(main())
+
+
+def test_store_watch_sees_puts(run):
+    async def main():
+        s = LocalStore()
+        w = s.watch_prefix("pre/")
+        s.kv_put("pre/a", b"1")
+        s.kv_put("other/b", b"2")
+        ev = await asyncio.wait_for(w.__anext__(), 1)
+        assert (ev.key, ev.value) == ("pre/a", b"1")
+
+    run(main())
+
+
+# ---------------- bus ----------------
+
+
+def test_subject_matching():
+    assert _subject_matches("a.b.c", "a.b.c")
+    assert _subject_matches("a.*.c", "a.b.c")
+    assert _subject_matches("a.>", "a.b.c.d")
+    assert not _subject_matches("a.b", "a.b.c")
+    assert not _subject_matches("a.*.c", "a.b.d")
+
+
+def test_bus_pubsub_and_queue_group(run):
+    async def main():
+        bus = LocalBus()
+        plain = bus.subscribe("ev.x")
+        g1 = bus.subscribe("ev.x", group="g")
+        g2 = bus.subscribe("ev.x", group="g")
+        bus.publish("ev.x", b"m1")
+        bus.publish("ev.x", b"m2")
+        assert (await plain.next(1)).payload == b"m1"
+        assert (await plain.next(1)).payload == b"m2"
+        # queue group: one member each, round-robin
+        got = [(await g1.next(0.2)), (await g2.next(0.2))]
+        payloads = sorted(m.payload for m in got if m)
+        assert payloads == [b"m1", b"m2"]
+
+    run(main())
+
+
+def test_bus_request_reply_and_no_responders(run):
+    async def main():
+        bus = LocalBus()
+        with pytest.raises(NoResponders):
+            await bus.request("svc.a", b"req", timeout=0.5)
+        sub = bus.subscribe("svc.a", group="workers")
+
+        async def server():
+            msg = await sub.next(1)
+            bus.respond(msg, b"reply:" + msg.payload)
+
+        t = asyncio.get_running_loop().create_task(server())
+        reply = await bus.request("svc.a", b"req", timeout=1)
+        assert reply == b"reply:req"
+        await t
+
+    run(main())
+
+
+def test_bus_work_queue_ack_redelivery(run):
+    async def main():
+        bus = LocalBus()
+        q = bus.work_queue("prefill", redeliver_after=0.0)
+        q.push(b"job1")
+        item = await q.pop(0.5)
+        assert item.payload == b"job1"
+        # not acked and visibility timeout 0 -> redelivered
+        item2 = await q.pop(0.5)
+        assert item2.payload == b"job1"
+        assert item2.deliveries == 2
+        q.ack(item2.id)
+        assert await q.pop(0.05) is None
+        assert q.depth == 0
+
+    run(main())
+
+
+def test_bus_object_store_ttl(run):
+    async def main():
+        bus = LocalBus()
+        bus.object_put("mdc", "model-a", b"card", ttl=None)
+        assert bus.object_get("mdc", "model-a") == b"card"
+        bus.object_put("mdc", "model-b", b"x", ttl=-1.0)  # already expired
+        assert bus.object_get("mdc", "model-b") is None
+        assert bus.object_list("mdc") == ["model-a"]
+
+    run(main())
+
+
+# ---------------- cancellation ----------------
+
+
+def test_cancellation_tree(run):
+    async def main():
+        root = CancellationToken()
+        child = root.child_token()
+        grand = child.child_token()
+        fired = []
+        grand.on_cancel(lambda: fired.append("g"))
+        child.cancel()
+        assert not root.is_cancelled()
+        assert child.is_cancelled() and grand.is_cancelled()
+        assert fired == ["g"]
+
+    run(main())
+
+
+# ---------------- pipeline ----------------
+
+
+class DoubleEcho(AsyncEngine):
+    """Fake backend: yields each input token id twice (echo-engine style,
+    ref launch/dynamo-run/src/output/echo_core.rs)."""
+
+    async def generate(self, request: Context):
+        for tok in request.data:
+            yield tok
+            yield tok
+
+
+class PrePost(Operator):
+    """Bidirectional stage: +1 on the way in, *10 on the way out."""
+
+    async def generate(self, request: Context, next_engine: AsyncEngine):
+        mapped = request.map(lambda toks: [t + 1 for t in toks])
+        async for resp in next_engine.generate(mapped):
+            yield resp * 10
+
+
+def test_pipeline_link_forward_and_backward(run):
+    async def main():
+        engine = link(PrePost(), DoubleEcho())
+        out = await collect(engine.generate(Context([1, 2])))
+        assert out == [20, 20, 30, 30]
+
+    run(main())
+
+
+def test_map_operator_and_engine_fn(run):
+    async def main():
+        async def gen(req: Context):
+            yield sum(req.data)
+
+        engine = link(MapOperator(fwd=lambda x: x + [10], bwd=lambda r: -r), EngineFn(gen))
+        out = await collect(engine.generate(Context([1, 2])))
+        assert out == [-13]
+
+    run(main())
