@@ -1,0 +1,6 @@
+"""OpenAI-compatible HTTP frontend (re-design of lib/llm/src/http)."""
+
+from .metrics import Metrics
+from .service import HttpService, ModelManager
+
+__all__ = ["HttpService", "Metrics", "ModelManager"]

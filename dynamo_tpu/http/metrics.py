@@ -1,0 +1,116 @@
+"""Prometheus-style service metrics.
+
+Re-design of the reference's HTTP metrics (lib/llm/src/http/service/
+metrics.rs:36-311): request counters by (model, endpoint, status), an
+inflight gauge with an RAII guard, and request-duration histograms, all
+rendered in the Prometheus text exposition format at /metrics — no
+prometheus client dependency needed.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+
+
+class Histogram:
+    def __init__(self):
+        self.counts = [0] * (len(_BUCKETS) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(_BUCKETS):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class Metrics:
+    def __init__(self, prefix: str = "dynamo_tpu"):
+        self.prefix = prefix
+        self.requests_total: dict[tuple, int] = defaultdict(int)
+        self.inflight: dict[tuple, int] = defaultdict(int)
+        self.duration: dict[tuple, Histogram] = defaultdict(Histogram)
+        self.tokens_total: dict[tuple, int] = defaultdict(int)
+
+    def inflight_guard(self, model: str, endpoint: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint)
+
+    def observe_tokens(self, model: str, kind: str, n: int) -> None:
+        self.tokens_total[(model, kind)] += n
+
+    def render(self) -> str:
+        p = self.prefix
+        lines = [
+            f"# TYPE {p}_http_service_requests_total counter",
+        ]
+        for (model, endpoint, status), v in sorted(self.requests_total.items()):
+            lines.append(
+                f'{p}_http_service_requests_total{{model="{model}",endpoint="{endpoint}",status="{status}"}} {v}'
+            )
+        lines.append(f"# TYPE {p}_http_service_inflight_requests gauge")
+        for (model, endpoint), v in sorted(self.inflight.items()):
+            lines.append(
+                f'{p}_http_service_inflight_requests{{model="{model}",endpoint="{endpoint}"}} {v}'
+            )
+        lines.append(f"# TYPE {p}_http_service_request_duration_seconds histogram")
+        for (model, endpoint), h in sorted(self.duration.items()):
+            cum = 0
+            for i, b in enumerate(_BUCKETS):
+                cum += h.counts[i]
+                lines.append(
+                    f'{p}_http_service_request_duration_seconds_bucket{{model="{model}",endpoint="{endpoint}",le="{b}"}} {cum}'
+                )
+            cum += h.counts[-1]
+            lines.append(
+                f'{p}_http_service_request_duration_seconds_bucket{{model="{model}",endpoint="{endpoint}",le="+Inf"}} {cum}'
+            )
+            lines.append(
+                f'{p}_http_service_request_duration_seconds_sum{{model="{model}",endpoint="{endpoint}"}} {h.total}'
+            )
+            lines.append(
+                f'{p}_http_service_request_duration_seconds_count{{model="{model}",endpoint="{endpoint}"}} {h.n}'
+            )
+        lines.append(f"# TYPE {p}_tokens_total counter")
+        for (model, kind), v in sorted(self.tokens_total.items()):
+            lines.append(f'{p}_tokens_total{{model="{model}",kind="{kind}"}} {v}')
+        return "\n".join(lines) + "\n"
+
+
+class InflightGuard:
+    """RAII inflight gauge + status-coded counter (ref metrics.rs:187-311
+    InflightGuard)."""
+
+    def __init__(self, metrics: Metrics, model: str, endpoint: str):
+        self._m = metrics
+        self._key = (model, endpoint)
+        self._status = "error"
+        self._start = time.monotonic()
+        metrics.inflight[self._key] += 1
+
+    def mark_ok(self) -> None:
+        self._status = "success"
+
+    def mark(self, status: str) -> None:
+        self._status = status
+
+    def done(self) -> None:
+        m, (model, endpoint) = self._m, self._key
+        m.inflight[self._key] -= 1
+        m.requests_total[(model, endpoint, self._status)] += 1
+        m.duration[self._key].observe(time.monotonic() - self._start)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self._status == "error":
+            self.mark_ok()
+        self.done()
+        return False

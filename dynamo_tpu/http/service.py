@@ -1,0 +1,358 @@
+"""OpenAI-compatible HTTP frontend.
+
+Re-design of the reference's axum HTTP service (lib/llm/src/http/service/
+{service_v2,openai}.rs): routes /v1/chat/completions, /v1/completions,
+/v1/models, /metrics, /health. The service always streams from the engine
+and folds for non-streaming clients (ref http/service.rs:24-26); client
+disconnects kill the request context so TPU work is cancelled end-to-end
+(ref openai.rs client-disconnect handling).
+
+The server is a dependency-free asyncio HTTP/1.1 implementation — the
+Python-idiomatic equivalent of the reference's axum layer, with SSE
+streaming via chunked transfer encoding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..protocols.aggregator import aggregate_chat_chunks, aggregate_completion_chunks
+from ..protocols.openai import ChatCompletionRequest, CompletionRequest, RequestError
+from ..protocols.sse import encode_comment, encode_data, encode_done, encode_event
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, Context
+from .metrics import Metrics
+
+logger = logging.getLogger(__name__)
+
+
+class ModelManager:
+    """Live model registry (ref http/service.rs:58 ModelManager): model name
+    -> engine, hot add/remove as workers come and go."""
+
+    def __init__(self):
+        self._chat: dict[str, AsyncEngine] = {}
+        self._completion: dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completion[name] = engine
+
+    def remove_chat_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+
+    def remove_completion_model(self, name: str) -> None:
+        self._completion.pop(name, None)
+
+    def chat_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._chat.get(name)
+
+    def completion_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._completion.get(name)
+
+    def model_names(self) -> list[str]:
+        return sorted(set(self._chat) | set(self._completion))
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str, code: str = "invalid_request_error"):
+        self.status = status
+        self.message = message
+        self.code = code
+
+
+_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    422: "Unprocessable Entity", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+class HttpService:
+    """ref service_v2.rs:24 HttpService + builder."""
+
+    def __init__(
+        self,
+        model_manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.models = model_manager or ModelManager()
+        self.metrics = metrics or Metrics()
+        self._host, self._port = host, port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.port: int = port
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self._host, self._port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("http service listening on %s:%d", self._host, self.port)
+
+    async def run(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ---------------- http plumbing ----------------
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                method, path, headers, body = req
+                keep_alive = headers.get("connection", "").lower() != "close"
+                try:
+                    await self._route(method, path, headers, body, writer)
+                except HttpError as e:
+                    await self._send_json(
+                        writer, e.status,
+                        {"error": {"message": e.message, "type": e.code}},
+                    )
+                except (ConnectionResetError, BrokenPipeError):
+                    break
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("handler error")
+                    try:
+                        await self._send_json(
+                            writer, 500,
+                            {"error": {"message": str(e), "type": "internal_error"}},
+                        )
+                    except (ConnectionResetError, BrokenPipeError):
+                        break
+                if not keep_alive:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _read_request(reader: asyncio.StreamReader):
+        try:
+            request_line = await reader.readline()
+        except (ConnectionResetError, asyncio.LimitOverrunError):
+            return None
+        if not request_line:
+            return None
+        try:
+            method, path, _version = request_line.decode().split(None, 2)
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            headers[name.strip().lower()] = value.strip()
+        body = b""
+        length = int(headers.get("content-length", 0) or 0)
+        if length:
+            body = await reader.readexactly(length)
+        elif headers.get("transfer-encoding", "").lower() == "chunked":
+            chunks = []
+            while True:
+                size_line = await reader.readline()
+                # RFC 7230: ignore chunk extensions after ';'
+                size = int(size_line.split(b";", 1)[0].strip() or b"0", 16)
+                if size == 0:
+                    await reader.readline()
+                    break
+                chunks.append(await reader.readexactly(size))
+                await reader.readline()
+            body = b"".join(chunks)
+        return method.upper(), path, headers, body
+
+    @staticmethod
+    async def _send_response(
+        writer: asyncio.StreamWriter,
+        status: int,
+        body: bytes,
+        content_type: str = "application/json",
+    ) -> None:
+        head = (
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+
+    async def _send_json(self, writer, status: int, obj) -> None:
+        await self._send_response(writer, status, json.dumps(obj).encode())
+
+    # ---------------- routing ----------------
+
+    async def _route(self, method, path, headers, body, writer) -> None:
+        path = path.split("?", 1)[0]
+        if method == "GET":
+            if path in ("/health", "/live", "/ready"):
+                await self._send_json(writer, 200, {"status": "ok"})
+            elif path == "/metrics":
+                await self._send_response(
+                    writer, 200, self.metrics.render().encode(),
+                    content_type="text/plain; version=0.0.4",
+                )
+            elif path == "/v1/models":
+                data = [
+                    {"id": name, "object": "model", "owned_by": "dynamo_tpu"}
+                    for name in self.models.model_names()
+                ]
+                await self._send_json(writer, 200, {"object": "list", "data": data})
+            else:
+                raise HttpError(404, f"no route for GET {path}", "not_found")
+        elif method == "POST":
+            if path == "/v1/chat/completions":
+                await self._openai_endpoint(writer, body, chat=True)
+            elif path == "/v1/completions":
+                await self._openai_endpoint(writer, body, chat=False)
+            else:
+                raise HttpError(404, f"no route for POST {path}", "not_found")
+        else:
+            raise HttpError(405, f"method {method} not allowed")
+
+    # ---------------- openai endpoints (ref openai.rs:132,214) ----------------
+
+    async def _openai_endpoint(self, writer, body: bytes, chat: bool) -> None:
+        endpoint = "chat_completions" if chat else "completions"
+        try:
+            payload = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise HttpError(400, f"invalid JSON body: {e}") from None
+        try:
+            req = (
+                ChatCompletionRequest.from_dict(payload)
+                if chat
+                else CompletionRequest.from_dict(payload)
+            )
+        except RequestError as e:
+            raise HttpError(400, str(e)) from None
+
+        engine = (
+            self.models.chat_engine(req.model)
+            if chat
+            else self.models.completion_engine(req.model)
+        )
+        if engine is None:
+            raise HttpError(
+                404, f"model {req.model!r} not found", "model_not_found"
+            )
+
+        guard = self.metrics.inflight_guard(req.model, endpoint)
+        context = Context(req)
+        try:
+            stream = engine.generate(context)
+            if req.stream:
+                await self._stream_sse(writer, stream, context, req, guard)
+            else:
+                chunks: list[dict] = []
+                error: Optional[str] = None
+                async for item in stream:
+                    ann = item if isinstance(item, Annotated) else Annotated.from_data(item)
+                    if ann.is_error():
+                        error = ann.error or "engine error"
+                        break
+                    if ann.data is not None:
+                        chunks.append(ann.data)
+                if error is not None:
+                    guard.mark("error")
+                    raise HttpError(500, error, "engine_error")
+                if not chunks:
+                    guard.mark("error")
+                    raise HttpError(500, "engine produced no output", "engine_error")
+                full = (
+                    aggregate_chat_chunks(chunks)
+                    if chat
+                    else aggregate_completion_chunks(chunks)
+                )
+                self._count_tokens(req.model, full)
+                guard.mark_ok()
+                await self._send_json(writer, 200, full)
+        finally:
+            guard.done()
+
+    def _count_tokens(self, model: str, full: dict) -> None:
+        usage = full.get("usage") or {}
+        if usage.get("prompt_tokens"):
+            self.metrics.observe_tokens(model, "prompt", usage["prompt_tokens"])
+        if usage.get("completion_tokens"):
+            self.metrics.observe_tokens(model, "completion", usage["completion_tokens"])
+
+    async def _stream_sse(self, writer, stream, context: Context, req, guard) -> None:
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-cache\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            "\r\n"
+        )
+        writer.write(head.encode())
+        await writer.drain()
+
+        async def send(chunk: bytes):
+            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            await writer.drain()
+
+        include_usage = bool(getattr(req, "stream_options", {}).get("include_usage"))
+        ok = True
+        try:
+            try:
+                async for item in stream:
+                    ann = item if isinstance(item, Annotated) else Annotated.from_data(item)
+                    if ann.is_error():
+                        await send(encode_event("error", {"message": ann.error}))
+                        ok = False
+                        break
+                    if ann.event and ann.event != "sentinel":
+                        await send(encode_event(ann.event,
+                            json.loads(ann.comment[0]) if ann.comment else None))
+                        continue
+                    if ann.data is not None:
+                        data = ann.data
+                        if isinstance(data, dict) and data.get("usage") is not None:
+                            self._count_tokens(req.model, data)
+                            if not include_usage:
+                                data = {k: v for k, v in data.items() if k != "usage"}
+                        await send(encode_data(data))
+            except (ConnectionResetError, BrokenPipeError):
+                raise
+            except Exception as e:  # noqa: BLE001
+                # engine failure mid-stream: the 200 + SSE head is already on
+                # the wire, so surface it as an SSE error event, never as a
+                # second HTTP response on the same socket
+                logger.exception("engine error mid-stream")
+                await send(encode_event("error", {"message": str(e)}))
+                ok = False
+            await send(encode_done())
+        except (ConnectionResetError, BrokenPipeError):
+            # client went away: kill generation end-to-end (ref openai.rs)
+            context.context.kill()
+            guard.mark("disconnect")
+            return
+        # end chunked body
+        try:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            context.context.kill()
+        if ok:
+            guard.mark_ok()

@@ -1,0 +1,14 @@
+"""LLM library layer: tokenization, preprocessing, detokenization, model
+cards (re-design of the reference's lib/llm crate, minus engines which live
+in dynamo_tpu.engine)."""
+
+from .tokenizer import ByteTokenizer, DecodeStream, HFTokenizer, Tokenizer
+from .model_card import ModelDeploymentCard
+
+__all__ = [
+    "ByteTokenizer",
+    "DecodeStream",
+    "HFTokenizer",
+    "ModelDeploymentCard",
+    "Tokenizer",
+]

@@ -1,0 +1,99 @@
+"""Model Deployment Card (MDC): the metadata record for a served model.
+
+Re-design of the reference's model card (lib/llm/src/model_card/model.rs:94
+ModelDeploymentCard + create.rs): display/service name, tokenizer location,
+prompt-template source, context length, KV block size — published to the
+bus object store bucket "mdc" with a TTL that the owning worker refreshes
+(ref model.rs:42-49, 5-minute TTL), so dead workers' cards age out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+MDC_BUCKET = "mdc"
+MDC_TTL_SECONDS = 300.0
+
+
+@dataclass
+class ModelDeploymentCard:
+    display_name: str
+    service_name: str
+    model_path: str = ""
+    tokenizer_kind: str = "hf"  # "hf" | "byte"
+    context_length: int = 8192
+    kv_block_size: int = 16
+    model_type: str = "chat"  # "chat" | "completion" | "both"
+    # architecture hints for the native engine
+    architecture: str = ""
+    dtype: str = "bfloat16"
+    extra: dict = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "ModelDeploymentCard":
+        d = json.loads(raw)
+        known = {k: d[k] for k in d if k in ModelDeploymentCard.__dataclass_fields__}
+        return ModelDeploymentCard(**known)
+
+    @staticmethod
+    def from_local_path(path: str, service_name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build from a HF-style checkout (ref model_card/create.rs:185)."""
+        name = service_name or os.path.basename(os.path.normpath(path))
+        card = ModelDeploymentCard(
+            display_name=name, service_name=name, model_path=path
+        )
+        cfg_path = os.path.join(path, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            card.architecture = (cfg.get("architectures") or [""])[0]
+            card.context_length = int(
+                cfg.get("max_position_embeddings", card.context_length)
+            )
+            card.dtype = cfg.get("torch_dtype", card.dtype)
+        return card
+
+    # ---- object-store publication ----
+    async def publish(self, bus, refresh: bool = False):
+        put = bus.object_put(
+            MDC_BUCKET, self.service_name, self.to_json(), ttl=MDC_TTL_SECONDS
+        )
+        if asyncio.iscoroutine(put):
+            await put
+
+    @staticmethod
+    async def load(bus, service_name: str) -> Optional["ModelDeploymentCard"]:
+        got = bus.object_get(MDC_BUCKET, service_name)
+        if asyncio.iscoroutine(got):
+            got = await got
+        return ModelDeploymentCard.from_json(got) if got else None
+
+
+class MdcRefresher:
+    """Keep a card alive in the object store while the worker lives."""
+
+    def __init__(self, bus, card: ModelDeploymentCard, interval: float = MDC_TTL_SECONDS / 3):
+        self._bus = bus
+        self._card = card
+        self._interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await self._card.publish(self._bus, refresh=True)
+            await asyncio.sleep(self._interval)
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None

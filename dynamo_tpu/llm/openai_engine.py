@@ -1,0 +1,45 @@
+"""Worker-side OpenAI engine: raw request dicts -> local pipeline -> chunks.
+
+The frontend's discovery layer routes raw OpenAI request dicts to worker
+endpoints (see dynamo_tpu.http.discovery). This adapter is the worker-side
+counterpart: parse the dict, run the local preprocessor -> detokenizer ->
+core-engine pipeline, and yield OpenAI chunk dicts. It is the TPU
+equivalent of the reference's StaticFull engine wiring
+(launch/dynamo-run/src/lib.rs EngineConfig::StaticFull).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+from ..protocols.openai import ChatCompletionRequest, CompletionRequest, RequestError
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import link
+from .backend import Backend
+from .preprocessor import OpenAIPreprocessor
+from .tokenizer import Tokenizer
+
+
+class OpenAIWorkerEngine(AsyncEngine):
+    def __init__(self, tokenizer: Tokenizer, core_engine: AsyncEngine):
+        self._pipeline = link(
+            OpenAIPreprocessor(tokenizer), Backend(tokenizer), core_engine
+        )
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        data = request.data
+        if isinstance(data, dict):
+            try:
+                typed = (
+                    ChatCompletionRequest.from_dict(data)
+                    if "messages" in data
+                    else CompletionRequest.from_dict(data)
+                )
+            except RequestError as e:
+                yield Annotated.from_error(str(e))
+                return
+        else:
+            typed = data
+        async for item in self._pipeline.generate(request.transfer(typed)):
+            yield item

@@ -1,0 +1,157 @@
+"""OpenAI preprocessor: template rendering + tokenization + delta generation.
+
+Re-design of the reference's OpenAIPreprocessor (lib/llm/src/
+preprocessor.rs:63-103 + protocols/openai/chat_completions/delta.rs): a
+bidirectional operator. Forward: render the chat template (the model's
+jinja2 template via the HF tokenizer, ref preprocessor/prompt/template/*),
+tokenize, and extract stop/sampling options into a PreprocessedRequest.
+Backward: turn detokenized LLMEngineOutputs into OpenAI
+chat.completion.chunk / text_completion deltas, including the requested
+``nvext.annotations`` (formatted_prompt, token_ids) as SSE events.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Union
+
+from ..protocols.common import LLMEngineOutput, PreprocessedRequest
+from ..protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    Usage,
+    chat_chunk,
+    completion_chunk,
+    new_chat_id,
+    new_cmpl_id,
+)
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.pipeline import Operator
+from .tokenizer import Tokenizer
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+
+
+class OpenAIPreprocessor(Operator):
+    def __init__(self, tokenizer: Tokenizer):
+        self._tokenizer = tokenizer
+
+    # ---- forward ----
+    def preprocess_chat(self, req: ChatCompletionRequest) -> tuple[PreprocessedRequest, str]:
+        if req.nvext.use_raw_prompt and len(req.messages) == 1:
+            prompt = req.messages[-1].content_text()
+        else:
+            prompt = self._tokenizer.apply_chat_template(
+                [m.to_dict() for m in req.messages], add_generation_prompt=True
+            )
+        token_ids = self._tokenizer.encode(prompt, add_special_tokens=False)
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=req.stops,
+            sampling_options=req.sampling,
+            model=req.model,
+            eos_token_ids=self._tokenizer.eos_token_ids,
+        )
+        return pre, prompt
+
+    def preprocess_completion(self, req: CompletionRequest) -> tuple[PreprocessedRequest, str]:
+        if isinstance(req.prompt, list) and req.prompt and isinstance(req.prompt[0], int):
+            token_ids = list(req.prompt)
+            prompt = self._tokenizer.decode(token_ids)
+        else:
+            prompt = req.prompt if isinstance(req.prompt, str) else "".join(req.prompt)
+            token_ids = self._tokenizer.encode(prompt, add_special_tokens=True)
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=req.stops,
+            sampling_options=req.sampling,
+            model=req.model,
+            eos_token_ids=self._tokenizer.eos_token_ids,
+        )
+        return pre, prompt
+
+    # ---- the operator ----
+    async def generate(
+        self, request: Context, next_engine: AsyncEngine
+    ) -> AsyncIterator[Annotated]:
+        req: Union[ChatCompletionRequest, CompletionRequest] = request.data
+        is_chat = isinstance(req, ChatCompletionRequest)
+        if is_chat:
+            pre, prompt = self.preprocess_chat(req)
+        else:
+            pre, prompt = self.preprocess_completion(req)
+
+        # requested annotations ride the stream as events (ref nvext.rs)
+        for ann in req.nvext.annotations:
+            if ann == ANNOTATION_FORMATTED_PROMPT:
+                yield Annotated.from_annotation(ANNOTATION_FORMATTED_PROMPT, prompt)
+            elif ann == ANNOTATION_TOKEN_IDS:
+                yield Annotated.from_annotation(ANNOTATION_TOKEN_IDS, pre.token_ids)
+
+        delta = DeltaGenerator(req, is_chat=is_chat, prompt_tokens=len(pre.token_ids))
+        first = True
+        async for item in next_engine.generate(request.transfer(pre)):
+            if not isinstance(item, Annotated):
+                item = Annotated.from_data(item)
+            if item.data is None:
+                yield item
+                continue
+            out: LLMEngineOutput = (
+                item.data
+                if isinstance(item.data, LLMEngineOutput)
+                else LLMEngineOutput.from_dict(item.data)
+            )
+            for chunk in delta.chunks(out, include_role=first):
+                yield Annotated(data=chunk, id=item.id)
+            first = False
+            if out.is_final():
+                break
+
+
+class DeltaGenerator:
+    """LLMEngineOutput -> OpenAI chunk dicts (ref chat_completions/delta.rs:215)."""
+
+    def __init__(self, req, is_chat: bool, prompt_tokens: int):
+        self.req = req
+        self.is_chat = is_chat
+        self.id = new_chat_id() if is_chat else new_cmpl_id()
+        self.prompt_tokens = prompt_tokens
+        self.completion_tokens = 0
+
+    def chunks(self, out: LLMEngineOutput, include_role: bool = False) -> list[dict]:
+        self.completion_tokens += len(out.token_ids)
+        result: list[dict] = []
+        text = out.text or ""
+        finish = out.finish_reason.to_openai() if out.finish_reason else None
+        # usage always rides the final chunk; the HTTP layer strips it for
+        # streaming clients that did not ask for include_usage, and the
+        # aggregator folds it into non-streaming responses (OpenAI-required)
+        usage = None
+        if finish is not None:
+            usage = Usage(
+                prompt_tokens=out.prompt_tokens or self.prompt_tokens,
+                completion_tokens=out.completion_tokens or self.completion_tokens,
+            )
+        if self.is_chat:
+            delta: dict = {}
+            if include_role:
+                delta["role"] = "assistant"
+            if text or include_role:
+                delta["content"] = text
+            if delta or finish is not None:
+                result.append(
+                    chat_chunk(
+                        self.id, self.req.model, delta,
+                        finish_reason=finish, usage=usage,
+                    )
+                )
+        else:
+            if text or finish is not None:
+                result.append(
+                    completion_chunk(
+                        self.id, self.req.model, text,
+                        finish_reason=finish, usage=usage,
+                    )
+                )
+        return result

@@ -1,0 +1,179 @@
+"""Tokenizer abstraction + incremental detokenization.
+
+Re-design of the reference's tokenizer layer (lib/llm/src/tokenizers.rs:
+Encoder/Decoder traits + DecodeStream:158). Two implementations:
+
+  * :class:`HFTokenizer` — wraps a HuggingFace tokenizer (the production
+    path; the HF `tokenizers` Rust core is already the fastest option),
+  * :class:`ByteTokenizer` — dependency-free byte-level tokenizer used by
+    tests and echo engines (the reference tests against checked-in
+    fixtures the same way).
+
+:class:`DecodeStream` implements UTF-8-safe incremental detokenization: a
+token boundary is not a character boundary, so we re-decode a sliding
+window and emit only the confirmed new suffix (holding back trailing
+replacement chars that indicate an incomplete multi-byte sequence).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+
+class Tokenizer(abc.ABC):
+    @abc.abstractmethod
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        ...
+
+    @abc.abstractmethod
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def eos_token_ids(self) -> list[int]:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int:
+        ...
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return None
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        raise NotImplementedError("this tokenizer has no chat template")
+
+
+class ByteTokenizer(Tokenizer):
+    """ids 0-255 = raw bytes; 256 = BOS, 257 = EOS."""
+
+    BOS = 256
+    EOS = 257
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if add_special_tokens:
+            ids = [self.BOS] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return [self.EOS]
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.BOS
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        parts = [f"<|{m['role']}|>{m.get('content') or ''}" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>")
+        return "".join(parts)
+
+
+class HFTokenizer(Tokenizer):
+    """HuggingFace tokenizer from a local checkout (tokenizer.json /
+    tokenizer_config.json), ref tokenizers/hf.rs:23."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        eos = self._tok.eos_token_id
+        self._eos_ids = [eos] if eos is not None else []
+        # llama-3 style: some models define extra end-of-turn tokens
+        for name in ("<|eot_id|>", "<|im_end|>", "<|end|>"):
+            tid = self._tok.convert_tokens_to_ids(name)
+            if tid is not None and tid >= 0 and tid not in self._eos_ids:
+                unk = getattr(self._tok, "unk_token_id", None)
+                if tid != unk:
+                    self._eos_ids.append(tid)
+
+    @property
+    def hf(self):
+        return self._tok
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens)
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return list(self._eos_ids)
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._tok.bos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+
+
+class DecodeStream:
+    """Incremental, UTF-8-safe detokenizer (ref tokenizers.rs:158
+    DecodeStream; the sliding-window scheme matches what the engines the
+    reference wraps do internally)."""
+
+    def __init__(self, tokenizer: Tokenizer, skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip_special = skip_special_tokens
+        self._ids: list[int] = []
+        self._prefix_offset = 0  # start of the re-decode window
+        self._read_offset = 0  # tokens already surfaced as text
+
+    def step(self, token_id: int) -> Optional[str]:
+        """Feed one token id; return newly-confirmed text (or None)."""
+        self._ids.append(token_id)
+        prefix_text = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset], self._skip_special
+        )
+        new_text = self._tok.decode(self._ids[self._prefix_offset :], self._skip_special)
+        if len(new_text) <= len(prefix_text) or new_text.endswith("�"):
+            # incomplete multi-byte sequence — hold until the next token
+            return None
+        delta = new_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return delta
+
+    def flush(self) -> Optional[str]:
+        """Emit whatever is still held back (end of stream)."""
+        prefix_text = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset], self._skip_special
+        )
+        full = self._tok.decode(self._ids[self._prefix_offset :], self._skip_special)
+        if len(full) > len(prefix_text):
+            self._read_offset = len(self._ids)
+            self._prefix_offset = self._read_offset
+            return full[len(prefix_text) :]
+        return None
+
+    @property
+    def token_count(self) -> int:
+        return len(self._ids)

@@ -121,7 +121,21 @@ class _WorkQueue:
             return None
         item.deliveries += 1
         self._inflight[item.id] = (item, time.monotonic() + self.redeliver_after)
+        # active redelivery: a consumer already blocked in pop() must still
+        # see this item again if the holder crashes without ack
+        asyncio.get_running_loop().call_later(
+            max(self.redeliver_after, 0.001), self._redeliver_one, item.id
+        )
         return item
+
+    def _redeliver_one(self, item_id: int) -> None:
+        entry = self._inflight.get(item_id)
+        if entry is None:
+            return
+        item, deadline = entry
+        if deadline <= time.monotonic():
+            del self._inflight[item_id]
+            self._ready.put_nowait(item)
 
     def ack(self, item_id: int) -> bool:
         return self._inflight.pop(item_id, None) is not None

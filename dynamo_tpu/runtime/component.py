@@ -108,7 +108,10 @@ class Component:
                     info.subject + ".stats", b"{}", timeout=timeout
                 )
                 stats = json.loads(raw) if raw else {}
-            except (NoResponders, asyncio.TimeoutError, Exception):
+            except (NoResponders, asyncio.TimeoutError):
+                continue  # instance mid-departure; expected churn
+            except Exception:  # noqa: BLE001
+                logger.exception("bad stats from %s", info.subject)
                 continue
             out.append(
                 {
@@ -197,6 +200,12 @@ class Endpoint:
         bus = self.drt.bus
         sub = bus.subscribe(self.subject, group="workers")
         stats_sub = bus.subscribe(self.subject + ".stats", group="workers")
+        # remote bus: wait until subscriptions are confirmed before
+        # advertising in discovery, or early requests would hit NoResponders
+        for s in (sub, stats_sub):
+            ready = getattr(s, "ready", None)
+            if ready is not None:
+                await ready
 
         info = EndpointInfo(
             namespace=self.namespace,
@@ -303,6 +312,7 @@ class Client:
         watcher = self.drt.store.watch_prefix(self.endpoint.discovery_prefix)
         if asyncio.iscoroutine(watcher):
             watcher = await watcher
+        self._watcher = watcher
         for entry in watcher.snapshot:
             info = EndpointInfo.from_json(entry.value)
             self._instances[info.instance_id] = info
@@ -324,6 +334,16 @@ class Client:
                 except (IndexError, ValueError):
                     pass
             self._instances_changed.set()
+
+    def stop(self) -> None:
+        """Tear down the discovery watch (watcher + task)."""
+        if getattr(self, "_watcher", None) is not None:
+            self._watcher.cancel()
+            self._watcher = None
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None
+        self._started = False
 
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)

@@ -1,0 +1,91 @@
+"""Minimal end-to-end deployment: hub + echo worker + OpenAI HTTP frontend.
+
+Run each role in its own process (mirrors the reference's multi-node
+layout: etcd/NATS host, worker node, frontend node):
+
+    python examples/serve_echo.py hub      --hub-port 18500
+    python examples/serve_echo.py worker   --hub 127.0.0.1:18500
+    python examples/serve_echo.py frontend --hub 127.0.0.1:18500 --port 18080
+
+Then:
+
+    curl -s localhost:18080/v1/chat/completions -d '{
+      "model": "echo", "messages": [{"role": "user", "content": "hello"}]}'
+"""
+
+import argparse
+import asyncio
+
+from dynamo_tpu.http.discovery import ModelEntry, ModelWatcher, register_model
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.llm.openai_engine import OpenAIWorkerEngine
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import AsyncEngine, Context, DistributedRuntime
+from dynamo_tpu.runtime.hub import HubServer, connect_hub
+
+
+class TokenEchoEngine(AsyncEngine):
+    """Echo the prompt tokens back, one per step."""
+
+    async def generate(self, request: Context):
+        req: PreprocessedRequest = request.data
+        n = len(req.token_ids)
+        maxt = req.stop_conditions.max_tokens or n
+        for i, tid in enumerate(req.token_ids[:maxt]):
+            final = i == min(n, maxt) - 1
+            yield LLMEngineOutput(
+                token_ids=[tid],
+                finish_reason=FinishReason.LENGTH if final else None,
+                prompt_tokens=n if final else None,
+                completion_tokens=i + 1 if final else None,
+            )
+            await asyncio.sleep(0)
+
+
+async def run_hub(args):
+    hub = HubServer(host="0.0.0.0", port=args.hub_port)
+    await hub.start()
+    print(f"hub listening on {hub.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_worker(args):
+    store, bus, _conn = await connect_hub(args.hub)
+    drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+    engine = OpenAIWorkerEngine(ByteTokenizer(), TokenEchoEngine())
+    await drt.namespace("dyn").component("worker").endpoint("generate").serve(
+        engine, stats_handler=lambda: {"requests_active": 0}
+    )
+    await register_model(
+        drt,
+        ModelEntry(name=args.model, namespace="dyn", component="worker",
+                   endpoint="generate", model_type="both"),
+    )
+    print(f"worker {drt.worker_id:x} serving model {args.model!r}", flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_frontend(args):
+    store, bus, _conn = await connect_hub(args.hub)
+    drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+    svc = HttpService(ModelManager(), host="0.0.0.0", port=args.port)
+    await ModelWatcher(drt, svc.models).start()
+    await svc.start()
+    print(f"frontend on :{svc.port}", flush=True)
+    await svc.run()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("role", choices=["hub", "worker", "frontend"])
+    p.add_argument("--hub", default="127.0.0.1:18500")
+    p.add_argument("--hub-port", type=int, default=18500)
+    p.add_argument("--port", type=int, default=18080)
+    p.add_argument("--model", default="echo")
+    args = p.parse_args()
+    asyncio.run({"hub": run_hub, "worker": run_worker, "frontend": run_frontend}[args.role](args))
+
+
+if __name__ == "__main__":
+    main()

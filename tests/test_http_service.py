@@ -1,0 +1,211 @@
+"""HTTP frontend tests (modeled on lib/llm/tests/http-service.rs): real
+asyncio HTTP server on loopback, raw-socket client, fake engines; asserts
+SSE behavior, aggregation, metrics counters, and the full discovery path."""
+
+import asyncio
+import json
+
+from dynamo_tpu.http.discovery import ModelEntry, ModelWatcher, register_model
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.llm.openai_engine import OpenAIWorkerEngine
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.protocols.sse import parse_sse_stream
+from dynamo_tpu.runtime import DistributedRuntime, LocalBus, LocalStore
+from tests.test_llm_protocols import TokenEchoEngine
+
+
+async def http_request(port: int, method: str, path: str, body: bytes = b"") -> tuple[int, dict, bytes]:
+    """Minimal HTTP/1.1 client over asyncio streams."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: localhost\r\n"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+    ).encode() + body
+    writer.write(req)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, rest = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    if headers.get("transfer-encoding") == "chunked":
+        body_out = b""
+        while rest:
+            size_line, _, rest = rest.partition(b"\r\n")
+            size = int(size_line or b"0", 16)
+            if size == 0:
+                break
+            body_out += rest[:size]
+            rest = rest[size + 2 :]
+        return status, headers, body_out
+    return status, headers, rest
+
+
+def make_local_service():
+    tok = ByteTokenizer()
+    engine = OpenAIWorkerEngine(tok, TokenEchoEngine())
+    manager = ModelManager()
+    manager.add_chat_model("echo", engine)
+    manager.add_completion_model("echo", engine)
+    return HttpService(manager, host="127.0.0.1", port=0)
+
+
+def test_models_and_health(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        status, _, body = await http_request(svc.port, "GET", "/v1/models")
+        assert status == 200
+        data = json.loads(body)
+        assert [m["id"] for m in data["data"]] == ["echo"]
+        status, _, _ = await http_request(svc.port, "GET", "/health")
+        assert status == 200
+        await svc.close()
+
+    run(main())
+
+
+def test_chat_non_streaming(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        req = {"model": "echo", "messages": [{"role": "user", "content": "hey"}],
+               "nvext": {"use_raw_prompt": True}}
+        status, _, body = await http_request(
+            svc.port, "POST", "/v1/chat/completions", json.dumps(req).encode()
+        )
+        assert status == 200
+        resp = json.loads(body)
+        assert resp["object"] == "chat.completion"
+        assert resp["choices"][0]["message"]["content"] == "hey"
+        await svc.close()
+
+    run(main())
+
+
+def test_chat_streaming_sse(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        req = {
+            "model": "echo", "stream": True,
+            "stream_options": {"include_usage": True},
+            "messages": [{"role": "user", "content": "ab"}],
+            "nvext": {"use_raw_prompt": True},
+        }
+        status, headers, body = await http_request(
+            svc.port, "POST", "/v1/chat/completions", json.dumps(req).encode()
+        )
+        assert status == 200
+        assert headers["content-type"].startswith("text/event-stream")
+        events = parse_sse_stream(body)
+        assert events[-1].is_done()
+        chunks = [e.json() for e in events[:-1] if e.data]
+        texts = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks if c.get("choices")
+        )
+        assert texts == "ab"
+        usages = [c["usage"] for c in chunks if c.get("usage")]
+        assert usages and usages[-1]["prompt_tokens"] == 2
+        await svc.close()
+
+    run(main())
+
+
+def test_completions_endpoint(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        req = {"model": "echo", "prompt": "xyz"}
+        status, _, body = await http_request(
+            svc.port, "POST", "/v1/completions", json.dumps(req).encode()
+        )
+        assert status == 200
+        resp = json.loads(body)
+        assert resp["object"] == "text_completion"
+        assert resp["choices"][0]["text"] == "xyz"
+        await svc.close()
+
+    run(main())
+
+
+def test_errors_and_metrics(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        # unknown model -> 404
+        status, _, body = await http_request(
+            svc.port, "POST", "/v1/chat/completions",
+            json.dumps({"model": "nope", "messages": [{"role": "user", "content": "x"}]}).encode(),
+        )
+        assert status == 404
+        # invalid json -> 400
+        status, _, _ = await http_request(svc.port, "POST", "/v1/chat/completions", b"{nope")
+        assert status == 400
+        # a good request, then check counters
+        ok = {"model": "echo", "messages": [{"role": "user", "content": "x"}],
+              "nvext": {"use_raw_prompt": True}}
+        await http_request(svc.port, "POST", "/v1/chat/completions", json.dumps(ok).encode())
+        status, _, body = await http_request(svc.port, "GET", "/metrics")
+        text = body.decode()
+        assert 'requests_total{model="echo",endpoint="chat_completions",status="success"} 1' in text
+        assert "request_duration_seconds_bucket" in text
+        await svc.close()
+
+    run(main())
+
+
+def test_discovery_end_to_end(run):
+    """worker endpoint + model registration + frontend watcher + HTTP."""
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        worker = await DistributedRuntime.from_settings(store=store, bus=bus)
+        front = await DistributedRuntime.from_settings(store=store, bus=bus)
+
+        tok = ByteTokenizer()
+        engine = OpenAIWorkerEngine(tok, TokenEchoEngine())
+        await worker.namespace("dyn").component("worker").endpoint("generate").serve(engine)
+        await register_model(
+            worker,
+            ModelEntry(name="echo-remote", namespace="dyn", component="worker",
+                       endpoint="generate", model_type="both"),
+        )
+
+        svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+        watcher = ModelWatcher(front, svc.models)
+        await watcher.start()
+        await svc.start()
+        # wait for discovery
+        for _ in range(100):
+            if "echo-remote" in svc.models.model_names():
+                break
+            await asyncio.sleep(0.01)
+        assert "echo-remote" in svc.models.model_names()
+
+        req = {"model": "echo-remote", "messages": [{"role": "user", "content": "nodehop"}],
+               "nvext": {"use_raw_prompt": True}}
+        status, _, body = await http_request(
+            svc.port, "POST", "/v1/chat/completions", json.dumps(req).encode()
+        )
+        assert status == 200
+        resp = json.loads(body)
+        assert resp["choices"][0]["message"]["content"] == "nodehop"
+
+        # worker death -> model removed
+        await worker.shutdown()
+        store.expire_leases()  # lease revoked on shutdown already; watcher fires
+        for _ in range(100):
+            if "echo-remote" not in svc.models.model_names():
+                break
+            await asyncio.sleep(0.01)
+        assert "echo-remote" not in svc.models.model_names()
+
+        await svc.close()
+        await front.shutdown()
+
+    run(main())

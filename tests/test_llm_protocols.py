@@ -1,0 +1,223 @@
+"""LLM protocol layer tests: SSE, aggregators, tokenizer streaming, stop
+jail, preprocessor/backend pipeline (modeled on the reference's
+lib/llm/tests/{aggregators,preprocessor,tokenizers}.rs)."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, StopJail
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.protocols.aggregator import (
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    RequestError,
+    chat_chunk,
+)
+from dynamo_tpu.protocols.sse import (
+    SseParser,
+    encode_data,
+    encode_done,
+    encode_event,
+    parse_sse_stream,
+)
+from dynamo_tpu.runtime import AsyncEngine, Context, collect, link
+
+
+# ---------------- SSE ----------------
+
+
+def test_sse_roundtrip():
+    raw = encode_data({"x": 1}) + encode_event("error", {"msg": "boom"}) + encode_done()
+    events = parse_sse_stream(raw)
+    assert events[0].json() == {"x": 1}
+    assert events[1].event == "error" and events[1].json() == {"msg": "boom"}
+    assert events[2].is_done()
+
+
+def test_sse_incremental_split_feed():
+    raw = encode_data({"long": "x" * 100})
+    p = SseParser()
+    events = []
+    for i in range(0, len(raw), 7):
+        events.extend(p.feed(raw[i : i + 7]))
+    assert len(events) == 1 and events[0].json()["long"] == "x" * 100
+
+
+# ---------------- aggregators ----------------
+
+
+def test_chat_aggregation():
+    chunks = [
+        chat_chunk("id1", "m", {"role": "assistant", "content": "Hel"}),
+        chat_chunk("id1", "m", {"content": "lo"}),
+        chat_chunk("id1", "m", {}, finish_reason="stop"),
+    ]
+    full = aggregate_chat_chunks(chunks)
+    assert full["object"] == "chat.completion"
+    assert full["choices"][0]["message"]["content"] == "Hello"
+    assert full["choices"][0]["finish_reason"] == "stop"
+
+
+def test_completion_aggregation():
+    from dynamo_tpu.protocols.openai import completion_chunk
+
+    chunks = [
+        completion_chunk("c1", "m", "a"),
+        completion_chunk("c1", "m", "b", finish_reason="length"),
+    ]
+    full = aggregate_completion_chunks(chunks)
+    assert full["choices"][0]["text"] == "ab"
+    assert full["choices"][0]["finish_reason"] == "length"
+
+
+def test_tool_call_merging():
+    chunks = [
+        chat_chunk("i", "m", {"tool_calls": [{"index": 0, "id": "call_1",
+                   "function": {"name": "get_w", "arguments": '{"a"'}}]}),
+        chat_chunk("i", "m", {"tool_calls": [{"index": 0,
+                   "function": {"arguments": ': 1}'}}]}),
+        chat_chunk("i", "m", {}, finish_reason="tool_calls"),
+    ]
+    full = aggregate_chat_chunks(chunks)
+    tc = full["choices"][0]["message"]["tool_calls"][0]
+    assert tc["id"] == "call_1"
+    assert tc["function"]["name"] == "get_w"
+    assert tc["function"]["arguments"] == '{"a": 1}'
+
+
+# ---------------- request parsing ----------------
+
+
+def test_chat_request_parsing_and_validation():
+    req = ChatCompletionRequest.from_dict(
+        {
+            "model": "llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 7,
+            "temperature": 0.5,
+            "stop": "END",
+            "nvext": {"ignore_eos": True, "annotations": ["token_ids"]},
+        }
+    )
+    assert req.stops.max_tokens == 7
+    assert req.stops.stop == ["END"]
+    assert req.stops.ignore_eos is True
+    assert req.sampling.temperature == 0.5
+    with pytest.raises(RequestError):
+        ChatCompletionRequest.from_dict({"model": "m", "messages": []})
+    with pytest.raises(RequestError):
+        ChatCompletionRequest.from_dict({"messages": [{"role": "user"}]})
+
+
+def test_preprocessed_request_roundtrip():
+    pre = PreprocessedRequest(token_ids=[1, 2, 3], model="m")
+    pre.stop_conditions.max_tokens = 5
+    again = PreprocessedRequest.from_dict(pre.to_dict())
+    assert again.token_ids == [1, 2, 3]
+    assert again.stop_conditions.max_tokens == 5
+
+
+# ---------------- incremental detokenization ----------------
+
+
+def test_decode_stream_multibyte_utf8():
+    tok = ByteTokenizer()
+    # snowman is 3 bytes: e2 98 83
+    ids = tok.encode("a☃b")
+    ds = DecodeStream(tok)
+    pieces = [ds.step(i) for i in ids]
+    text = "".join(p for p in pieces if p)
+    tail = ds.flush()
+    assert text + (tail or "") == "a☃b"
+    # intermediate steps never emitted replacement chars
+    assert all("�" not in p for p in pieces if p)
+
+
+def test_stop_jail_partial_and_full_match():
+    jail = StopJail(["STOP"])
+    emit, hit = jail.push("hello S")
+    assert emit == "hello " and not hit
+    emit, hit = jail.push("T")  # held "ST"
+    assert emit == "" and not hit
+    emit, hit = jail.push("OP and more")
+    assert hit and emit == ""
+    # diverging prefix gets released
+    jail2 = StopJail(["STOP"])
+    emit, hit = jail2.push("a ST")
+    assert emit == "a "
+    emit, hit = jail2.push("YLE")
+    assert emit == "STYLE" and not hit
+
+
+# ---------------- pipeline: preprocessor -> backend -> engine ----------------
+
+
+class TokenEchoEngine(AsyncEngine):
+    """Yields the prompt's token ids back one at a time, then EOS-finishes
+    (echo_core-style, ref launch/dynamo-run/src/output/echo_core.rs)."""
+
+    async def generate(self, request: Context):
+        req: PreprocessedRequest = request.data
+        n = 0
+        maxt = req.stop_conditions.max_tokens or len(req.token_ids)
+        for tid in req.token_ids:
+            if n >= maxt:
+                break
+            n += 1
+            final = n == maxt or n == len(req.token_ids)
+            yield LLMEngineOutput(
+                token_ids=[tid],
+                finish_reason=FinishReason.LENGTH if final else None,
+                prompt_tokens=len(req.token_ids) if final else None,
+                completion_tokens=n if final else None,
+            )
+
+
+def test_full_pipeline_chat(run):
+    async def main():
+        tok = ByteTokenizer()
+        engine = link(OpenAIPreprocessor(tok), Backend(tok), TokenEchoEngine())
+        req = ChatCompletionRequest.from_dict(
+            {
+                "model": "echo",
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": True,
+                "stream_options": {"include_usage": True},
+                "nvext": {"use_raw_prompt": True, "annotations": ["formatted_prompt"]},
+            }
+        )
+        out = await collect(engine.generate(Context(req)))
+        # first item is the formatted_prompt annotation
+        assert out[0].event == "formatted_prompt"
+        chunks = [a.data for a in out if a.data is not None]
+        full = aggregate_chat_chunks(chunks)
+        assert full["choices"][0]["message"]["content"] == "hi"
+        assert full["choices"][0]["finish_reason"] == "length"
+        assert full["usage"]["prompt_tokens"] == 2
+
+    run(main())
+
+
+def test_full_pipeline_stop_sequence(run):
+    async def main():
+        tok = ByteTokenizer()
+        engine = link(OpenAIPreprocessor(tok), Backend(tok), TokenEchoEngine())
+        req = CompletionRequest.from_dict(
+            {"model": "echo", "prompt": "abcSTOPxyz", "stop": ["STOP"]}
+        )
+        out = await collect(engine.generate(Context(req)))
+        chunks = [a.data for a in out if a.data is not None]
+        full = aggregate_completion_chunks(chunks)
+        assert full["choices"][0]["text"] == "abc"
+        assert full["choices"][0]["finish_reason"] == "stop"
+
+    run(main())
