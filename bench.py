@@ -1,0 +1,92 @@
+"""Benchmark: decode throughput (tokens/sec/chip) on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: continuous-batching decode on a 1B-class llama config (bf16) —
+the largest family member that fits a single v5e chip's HBM alongside its
+KV cache. ``vs_baseline`` is measured throughput / HBM-roofline throughput
+(decode is weight-bandwidth-bound: roofline = bw / param_bytes x batch),
+since the reference publishes no absolute numbers (BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        # smoke-test scale only — the real bench runs on TPU
+        cfg = ModelConfig.tiny(dtype="bfloat16")
+        B, BLOCK, CTX = 4, 16, 128
+    else:
+        # 1B-class llama (llama-3.2-1B-ish)
+        cfg = ModelConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+            max_position_embeddings=2048, dtype="bfloat16",
+        )
+        B, BLOCK, CTX = 16, 16, 1024
+    M = CTX // BLOCK
+    NUM_BLOCKS = B * M + 1
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+    tokens = jnp.zeros(B, jnp.int32)
+    seq_len0 = CTX // 2
+    positions = jnp.full((B,), seq_len0, jnp.int32)
+    tables = jnp.asarray(
+        np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M)
+    )
+    seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
+
+    def step(tokens, positions, seq_lens, k_cache, v_cache):
+        logits, k_cache, v_cache = llama.decode_step(
+            params, cfg, tokens, positions, tables, seq_lens, k_cache, v_cache
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, positions + 1, seq_lens + 1, k_cache, v_cache
+
+    # warmup / compile
+    tokens, positions, seq_lens, k_cache, v_cache = step(
+        tokens, positions, seq_lens, k_cache, v_cache
+    )
+    tokens.block_until_ready()
+
+    ITERS = 50
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        tokens, positions, seq_lens, k_cache, v_cache = step(
+            tokens, positions, seq_lens, k_cache, v_cache
+        )
+    tokens.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    toks_per_s = ITERS * B / dt / n_chips
+
+    # HBM roofline: each decode step streams all weights once
+    hbm_bw = 50e9 if on_cpu else 819e9  # v5e ~819 GB/s
+    roofline = hbm_bw / param_bytes * B
+    result = {
+        "metric": "decode_tokens_per_sec_per_chip_llama1b_bf16_b16",
+        "value": round(toks_per_s, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(toks_per_s / roofline, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

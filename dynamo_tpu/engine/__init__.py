@@ -1,0 +1,11 @@
+"""The native JAX/TPU inference engine: paged KV allocator, continuous
+batching scheduler, and the AsyncEngine facade the serving stack links to.
+
+This replaces the reference's wrapped engines (vLLM/SGLang/TRT-LLM,
+lib/llm/src/engines/*) with a first-party TPU engine.
+"""
+
+from .allocator import BlockAllocator
+from .engine import EngineConfig, JaxEngine
+
+__all__ = ["BlockAllocator", "EngineConfig", "JaxEngine"]

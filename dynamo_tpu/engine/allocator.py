@@ -1,0 +1,186 @@
+"""Paged KV block allocator with prefix reuse.
+
+Host-side bookkeeping for the device-resident paged KV cache (the
+reference's equivalent machinery is vLLM's block manager plus the Rust
+reuse pool, lib/llm/src/kv/{manager,reuse}.rs). Responsibilities:
+
+  * free-list allocation of fixed-size token blocks (block 0 is reserved
+    as the trash block — padded-position writes land there harmlessly),
+  * content addressing: full blocks carry a chained sequence hash
+    (ref lib/llm/src/tokens.rs SequenceHash) so identical prefixes map to
+    identical block chains,
+  * prefix-cache reuse: freed blocks go to an LRU reuse pool indexed by
+    sequence hash; new requests claim matching chains (radix-style match),
+  * refcounting: shared prefix blocks are copy-free (multiple sequences
+    reference the same immutable full block — ref kv/reserved.rs).
+
+Events (block stored/removed) feed the KV router's global index via
+dynamo_tpu.kv_router.publisher.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+
+def block_token_hash(tokens: Sequence[int]) -> int:
+    """Content hash of one block's tokens (local hash, ref
+    kv_router/indexer.rs:87 LocalBlockHash over token bytes)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"tok:" + b",".join(str(t).encode() for t in tokens))
+    return int.from_bytes(h.digest(), "big")
+
+
+def chain_hash(parent: Optional[int], local: int) -> int:
+    """Chained sequence hash (ref tokens.rs:166-202 SequenceHash)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"seq:" + (parent or 0).to_bytes(8, "big") + local.to_bytes(8, "big"))
+    return int.from_bytes(h.digest(), "big")
+
+
+def sequence_block_hashes(tokens: Sequence[int], block_size: int) -> list[tuple[int, int]]:
+    """[(local_hash, chained_hash)] for each *full* block of the sequence."""
+    out: list[tuple[int, int]] = []
+    parent: Optional[int] = None
+    for i in range(0, len(tokens) - len(tokens) % block_size, block_size):
+        local = block_token_hash(tokens[i : i + block_size])
+        parent = chain_hash(parent, local)
+        out.append((local, parent))
+    return out
+
+
+@dataclass
+class Block:
+    idx: int  # device block index
+    ref_count: int = 0
+    seq_hash: Optional[int] = None  # chained hash when full+immutable
+    local_hash: Optional[int] = None
+
+
+class BlockAllocator:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        on_stored: Optional[Callable[[Block, Optional[int]], None]] = None,
+        on_removed: Optional[Callable[[list[int]], None]] = None,
+    ):
+        """``num_blocks`` includes the reserved trash block 0."""
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._blocks = [Block(i) for i in range(num_blocks)]
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # stack; 0 reserved
+        # full immutable blocks by chained hash (active, refcounted)
+        self._by_hash: dict[int, int] = {}
+        # reuse pool: freed-but-still-resident blocks, LRU ordered
+        self._reuse: OrderedDict[int, int] = OrderedDict()  # seq_hash -> idx
+        self.on_stored = on_stored
+        self.on_removed = on_removed
+
+    # ---- stats ----
+    @property
+    def free_count(self) -> int:
+        return len(self._free) + len(self._reuse)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - 1 - self.free_count
+
+    def usage(self) -> float:
+        cap = self.num_blocks - 1
+        return self.used_count / cap if cap else 0.0
+
+    # ---- allocation ----
+    def _pop_free(self) -> Optional[Block]:
+        if self._free:
+            b = self._blocks[self._free.pop()]
+        elif self._reuse:
+            # evict LRU from the reuse pool
+            seq_hash, idx = self._reuse.popitem(last=False)
+            b = self._blocks[idx]
+            if self.on_removed:
+                self.on_removed([seq_hash])
+            b.seq_hash = None
+            b.local_hash = None
+        else:
+            return None
+        b.ref_count = 1
+        return b
+
+    def match_prefix(self, tokens: Sequence[int]) -> list[Block]:
+        """Longest chain of cached full blocks matching the token prefix.
+        Claims refs on the matched blocks (caller owns them)."""
+        matched: list[Block] = []
+        for _local, seq_hash in sequence_block_hashes(tokens, self.block_size):
+            idx = self._by_hash.get(seq_hash)
+            if idx is None and seq_hash in self._reuse:
+                idx = self._reuse.pop(seq_hash)
+                self._by_hash[seq_hash] = idx
+            if idx is None:
+                break
+            b = self._blocks[idx]
+            b.ref_count += 1
+            matched.append(b)
+        return matched
+
+    def allocate(self, n: int) -> Optional[list[Block]]:
+        """n fresh (mutable) blocks, or None if insufficient."""
+        if self.free_count < n:
+            return None
+        out = []
+        for _ in range(n):
+            b = self._pop_free()
+            assert b is not None
+            out.append(b)
+        return out
+
+    def commit_full_block(self, block: Block, tokens: Sequence[int], parent_hash: Optional[int]) -> int:
+        """Mark a now-full block immutable + content-addressed; returns its
+        chained hash. Fires the stored event (feeds the KV router)."""
+        local = block_token_hash(tokens)
+        seq_hash = chain_hash(parent_hash, local)
+        block.local_hash = local
+        existing = self._by_hash.get(seq_hash)
+        if existing is not None and existing != block.idx:
+            # another sequence committed identical content first; keep ours
+            # as a duplicate (device copy dedup is a later optimization)
+            pass
+        else:
+            self._by_hash[seq_hash] = block.idx
+        block.seq_hash = seq_hash
+        if self.on_stored:
+            self.on_stored(block, parent_hash)
+        return seq_hash
+
+    def free(self, blocks: list[Block]) -> None:
+        """Release refs; full content-addressed blocks go to the reuse pool,
+        partial blocks go straight to the free list."""
+        removed_hashes: list[int] = []
+        for b in blocks:
+            if b.idx == 0:
+                continue
+            b.ref_count -= 1
+            if b.ref_count > 0:
+                continue
+            if b.seq_hash is not None and self._by_hash.get(b.seq_hash) == b.idx:
+                del self._by_hash[b.seq_hash]
+                self._reuse[b.seq_hash] = b.idx
+                self._reuse.move_to_end(b.seq_hash)
+            else:
+                b.seq_hash = None
+                b.local_hash = None
+                self._free.append(b.idx)
+        if removed_hashes and self.on_removed:
+            self.on_removed(removed_hashes)
+
+    def reset(self) -> None:
+        for b in self._blocks:
+            b.ref_count = 0
+            b.seq_hash = None
+            b.local_hash = None
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._by_hash.clear()
+        self._reuse.clear()

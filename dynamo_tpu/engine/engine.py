@@ -1,0 +1,454 @@
+"""The native JAX engine: continuous batching over a paged KV cache.
+
+This is the TPU replacement for the reference's wrapped GPU engines (vLLM
+et al.): a single background scheduler task owns the device state (params,
+KV cache, block tables) and interleaves
+
+  * **admission**: claim prefix-cache hits, allocate blocks, run (chunked,
+    bucketed) prefill for new requests,
+  * **decode**: one batched ``decode_step`` per iteration for all active
+    sequences (continuous batching — sequences join/leave the batch at any
+    step),
+  * **emission**: stream sampled tokens into per-request asyncio queues
+    (the AsyncEngine facade yields from them).
+
+Static-shape discipline (XLA): prefill lengths are bucketed, the decode
+batch is padded to ``max_batch_size``, block tables are a fixed
+``[B, max_blocks_per_seq]`` — so there are O(#buckets + 1) compiled
+programs total, reused forever. The KV cache arrays are donated through
+every jit call and never leave the device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.config import ModelConfig
+from ..ops.sampling import make_keys, sample_tokens
+from ..parallel.mesh import MeshConfig, cache_sharding, make_mesh, shard_params
+from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from ..runtime.engine import AsyncEngine, Context
+from .allocator import Block, BlockAllocator, sequence_block_hashes
+
+logger = logging.getLogger(__name__)
+
+PREFILL_BUCKETS = [16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192]
+
+
+def _bucket(n: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + 8191) // 8192) * 8192
+
+
+@dataclass
+class EngineConfig:
+    model: ModelConfig
+    num_blocks: int = 256
+    block_size: int = 16
+    max_batch_size: int = 8
+    max_context: int = 0  # 0 -> model.max_position_embeddings
+    prefill_chunk: int = 2048
+    mesh: Optional[MeshConfig] = None
+    max_queue: int = 1024
+
+    def __post_init__(self):
+        if self.max_context == 0:
+            self.max_context = self.model.max_position_embeddings
+        self.max_blocks_per_seq = (
+            self.max_context + self.block_size - 1
+        ) // self.block_size
+
+
+@dataclass
+class _Sequence:
+    request: PreprocessedRequest
+    context: object  # AsyncEngineContext
+    out_queue: asyncio.Queue
+    tokens: list[int] = field(default_factory=list)  # prompt + generated
+    prompt_len: int = 0
+    blocks: list[Block] = field(default_factory=list)
+    committed: int = 0  # number of blocks committed (full+hashed)
+    parent_hash: Optional[int] = None
+    generated: int = 0
+    cached_prefix: int = 0  # tokens served from prefix cache
+    slot: int = -1  # decode batch slot
+    finished: bool = False
+    arrival_t: float = field(default_factory=time.monotonic)
+
+    @property
+    def seq_len(self) -> int:
+        return len(self.tokens)
+
+
+class JaxEngine(AsyncEngine):
+    """AsyncEngine over PreprocessedRequest -> LLMEngineOutput stream."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        params: Optional[dict] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.mesh = make_mesh(cfg.mesh) if cfg.mesh else None
+        mcfg = cfg.model
+        if params is None:
+            params = llama.init_params(mcfg, jax.random.key(seed))
+        if self.mesh is not None:
+            params = shard_params(params, self.mesh)
+        self.params = params
+        k, v = llama.init_kv_cache(mcfg, cfg.num_blocks, cfg.block_size)
+        if self.mesh is not None:
+            sh = cache_sharding(self.mesh, mcfg)
+            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        self.k_cache, self.v_cache = k, v
+        self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
+        self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
+        self._active: list[Optional[_Sequence]] = [None] * cfg.max_batch_size
+        self._n_active = 0
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        # host mirrors of device-side batch state
+        M = cfg.max_blocks_per_seq
+        self._block_tables = np.zeros((cfg.max_batch_size, M), np.int32)
+        self._seq_lens = np.zeros(cfg.max_batch_size, np.int32)
+        self._last_tokens = np.zeros(cfg.max_batch_size, np.int32)
+        self._seeds = np.zeros(cfg.max_batch_size, np.int64)
+        self._temps = np.zeros(cfg.max_batch_size, np.float32)
+        self._top_ks = np.zeros(cfg.max_batch_size, np.int32)
+        self._top_ps = np.ones(cfg.max_batch_size, np.float32)
+        # metrics
+        self.stats = {
+            "requests_total": 0,
+            "requests_active": 0,
+            "requests_waiting": 0,
+            "tokens_generated": 0,
+            "prefix_cache_hits_tokens": 0,
+            "decode_steps": 0,
+        }
+
+    # ---------------- public api ----------------
+
+    def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task:
+            self._loop_task.cancel()
+            self._loop_task = None
+
+    async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
+        self.start()
+        req: PreprocessedRequest = request.data
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_dict(req)
+        if not req.token_ids:
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR, text="empty prompt")
+            return
+        if len(req.token_ids) >= self.cfg.max_context:
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR)
+            return
+        seq = _Sequence(
+            request=req,
+            context=request.context,
+            out_queue=asyncio.Queue(),
+            tokens=list(req.token_ids),
+            prompt_len=len(req.token_ids),
+        )
+        self.stats["requests_total"] += 1
+        await self._waiting.put(seq)
+        self._wake.set()
+        while True:
+            out = await seq.out_queue.get()
+            if out is None:
+                return
+            yield out
+            if out.is_final():
+                return
+
+    def load_metrics(self) -> dict:
+        """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
+        return {
+            "kv_active_blocks": self.allocator.used_count,
+            "kv_total_blocks": self.allocator.num_blocks - 1,
+            "gpu_cache_usage_perc": self.allocator.usage(),
+            "request_active_slots": self._n_active,
+            "request_total_slots": self.cfg.max_batch_size,
+            "num_requests_waiting": self._waiting.qsize(),
+        }
+
+    # ---------------- scheduler loop ----------------
+
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                admitted = await self._admit()
+                if self._n_active == 0 and not admitted:
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                if self._n_active:
+                    self._decode_once()
+                # yield to the event loop so emissions flush
+                await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("engine loop crashed")
+            for seq in self._active:
+                if seq is not None:
+                    seq.out_queue.put_nowait(
+                        LLMEngineOutput(finish_reason=FinishReason.ERROR)
+                    )
+
+    # ---- admission ----
+
+    async def _admit(self) -> bool:
+        admitted = False
+        while self._n_active < self.cfg.max_batch_size and not self._waiting.empty():
+            seq = self._waiting.get_nowait()
+            if seq.context.is_stopped():
+                seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
+                continue
+            if not self._try_prefill(seq):
+                # out of KV blocks: put back and stop admitting (backpressure)
+                self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
+                break
+            admitted = True
+        self.stats["requests_active"] = self._n_active
+        self.stats["requests_waiting"] = self._waiting.qsize()
+        return admitted
+
+    def _try_prefill(self, seq: _Sequence) -> bool:
+        cfg = self.cfg
+        bs = cfg.block_size
+        prompt = seq.tokens
+        # prefix-cache match on full blocks, but always recompute the final
+        # token so prefill yields fresh last-position logits
+        matched = self.allocator.match_prefix(prompt[: len(prompt) - 1])
+        history = len(matched) * bs
+        seq.cached_prefix = history
+        self.stats["prefix_cache_hits_tokens"] += history
+        remaining = len(prompt) - history
+        # blocks needed to cover prompt + some decode headroom
+        total_needed = min(
+            (len(prompt) + bs) // bs + 1, cfg.max_blocks_per_seq
+        )
+        fresh_needed = max(0, total_needed - len(matched))
+        fresh = self.allocator.allocate(fresh_needed)
+        if fresh is None:
+            self.allocator.free(matched)
+            seq.cached_prefix = 0
+            return False
+        seq.blocks = matched + fresh
+        seq.committed = len(matched)
+        seq.parent_hash = matched[-1].seq_hash if matched else None
+
+        # run chunked prefill over the uncached suffix
+        table = self._table_for(seq)
+        logits = None
+        pos = history
+        while pos < len(prompt):
+            chunk = prompt[pos : pos + cfg.prefill_chunk]
+            T = _bucket(len(chunk))
+            toks = np.zeros(T, np.int32)
+            toks[: len(chunk)] = chunk
+            # table must cover padded chunk; _table_for pads with trash 0
+            logits, self.k_cache, self.v_cache = llama.prefill(
+                self.params,
+                self.cfg.model,
+                jnp.asarray(toks),
+                jnp.asarray(table),
+                jnp.int32(pos),
+                jnp.int32(len(chunk)),
+                self.k_cache,
+                self.v_cache,
+            )
+            pos += len(chunk)
+
+        # sample the first generated token on host from final logits
+        first_token = self._sample_prefill(seq, logits)
+        self._commit_full_blocks(seq)
+        self._emit_token(seq, first_token)
+        if not seq.finished:
+            self._place_in_batch(seq)
+        return True
+
+    def _table_for(self, seq: _Sequence) -> np.ndarray:
+        t = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
+        for i, b in enumerate(seq.blocks[: self.cfg.max_blocks_per_seq]):
+            t[i] = b.idx
+        return t
+
+    def _sample_prefill(self, seq: _Sequence, logits) -> int:
+        so = seq.request.sampling_options
+        temp = so.temperature if so.temperature is not None else 1.0
+        if getattr(seq.request, "greedy", False):
+            temp = 0.0
+        keys = make_keys(
+            jnp.asarray([so.seed if so.seed is not None else 0]),
+            jnp.asarray([seq.generated]),
+        )
+        tok = sample_tokens(
+            logits[None, :],
+            keys,
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([so.top_k or 0], jnp.int32),
+            jnp.asarray([so.top_p if so.top_p is not None else 1.0], jnp.float32),
+        )
+        return int(jax.device_get(tok)[0])
+
+    def _place_in_batch(self, seq: _Sequence) -> None:
+        slot = self._active.index(None)
+        seq.slot = slot
+        self._active[slot] = seq
+        self._n_active += 1
+        so = seq.request.sampling_options
+        self._block_tables[slot] = self._table_for(seq)
+        self._seq_lens[slot] = seq.seq_len
+        self._last_tokens[slot] = seq.tokens[-1]
+        self._seeds[slot] = so.seed if so.seed is not None else 0
+        self._temps[slot] = so.temperature if so.temperature is not None else 1.0
+        self._top_ks[slot] = so.top_k or 0
+        self._top_ps[slot] = so.top_p if so.top_p is not None else 1.0
+
+    # ---- decode ----
+
+    def _decode_once(self) -> None:
+        cfg = self.cfg
+        # ensure every active sequence has a block for the incoming token
+        for seq in self._active:
+            if seq is None:
+                continue
+            if seq.context.is_stopped():
+                self._finish(seq, FinishReason.CANCELLED)
+                continue
+            needed = seq.seq_len + 1
+            if needed > len(seq.blocks) * cfg.block_size:
+                extra = self.allocator.allocate(1)
+                if extra is None or len(seq.blocks) >= cfg.max_blocks_per_seq:
+                    self._finish(seq, FinishReason.LENGTH)
+                    continue
+                seq.blocks.extend(extra)
+                self._block_tables[seq.slot] = self._table_for(seq)
+        if self._n_active == 0:
+            return
+
+        active_slots = [i for i, s in enumerate(self._active) if s is not None]
+        steps = np.asarray(
+            [self._active[i].generated if self._active[i] else 0
+             for i in range(cfg.max_batch_size)],
+            np.int64,
+        )
+        positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
+        logits, self.k_cache, self.v_cache = llama.decode_step(
+            self.params,
+            cfg.model,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(positions),
+            jnp.asarray(self._block_tables),
+            jnp.asarray(self._seq_lens),
+            self.k_cache,
+            self.v_cache,
+        )
+        keys = make_keys(jnp.asarray(self._seeds), jnp.asarray(steps))
+        toks = sample_tokens(
+            logits,
+            keys,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps),
+        )
+        toks_host = np.asarray(jax.device_get(toks))
+        self.stats["decode_steps"] += 1
+        for i in active_slots:
+            seq = self._active[i]
+            if seq is None:
+                continue
+            self._emit_token(seq, int(toks_host[i]))
+            if not seq.finished:
+                self._seq_lens[i] = seq.seq_len
+                self._last_tokens[i] = seq.tokens[-1]
+                self._commit_full_blocks(seq)
+
+    # ---- token emission + finish logic ----
+
+    def _emit_token(self, seq: _Sequence, token: int) -> None:
+        req = seq.request
+        sc = req.stop_conditions
+        seq.tokens.append(token)
+        seq.generated += 1
+        self.stats["tokens_generated"] += 1
+
+        finish: Optional[FinishReason] = None
+        eos_ids = set(req.eos_token_ids or [])
+        min_ok = seq.generated >= (sc.min_tokens or 0)
+        if token in (sc.stop_token_ids or []):
+            finish = FinishReason.STOP
+        elif not sc.ignore_eos and token in eos_ids and min_ok:
+            finish = FinishReason.EOS
+        elif sc.max_tokens is not None and seq.generated >= sc.max_tokens:
+            finish = FinishReason.LENGTH
+        elif seq.seq_len >= self.cfg.max_context:
+            finish = FinishReason.LENGTH
+        elif seq.context.is_stopped():
+            finish = FinishReason.CANCELLED
+
+        out = LLMEngineOutput(token_ids=[token])
+        if finish is not None:
+            out.finish_reason = finish
+            out.prompt_tokens = seq.prompt_len
+            out.completion_tokens = seq.generated
+            out.kv_overlap_blocks = seq.cached_prefix // self.cfg.block_size
+        seq.out_queue.put_nowait(out)
+        if finish is not None:
+            self._finish(seq, finish, emit=False)
+
+    def _finish(self, seq: _Sequence, reason: FinishReason, emit: bool = True) -> None:
+        if seq.finished:
+            return
+        seq.finished = True
+        if emit:
+            seq.out_queue.put_nowait(
+                LLMEngineOutput(
+                    finish_reason=reason,
+                    prompt_tokens=seq.prompt_len,
+                    completion_tokens=seq.generated,
+                )
+            )
+        if seq.slot >= 0:
+            self._active[seq.slot] = None
+            self._seq_lens[seq.slot] = 0
+            self._block_tables[seq.slot] = 0
+            self._n_active -= 1
+            seq.slot = -1
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        self._wake.set()
+
+    def _commit_full_blocks(self, seq: _Sequence) -> None:
+        """Content-address blocks that just became full."""
+        bs = self.cfg.block_size
+        full = seq.seq_len // bs
+        while seq.committed < full and seq.committed < len(seq.blocks):
+            i = seq.committed
+            tokens = seq.tokens[i * bs : (i + 1) * bs]
+            seq.parent_hash = self.allocator.commit_full_block(
+                seq.blocks[i], tokens, seq.parent_hash
+            )
+            seq.committed += 1

@@ -42,9 +42,10 @@ class ModelEntry:
     component: str
     endpoint: str
     model_type: str = "chat"  # "chat" | "completion" | "both"
+    instance: int = 0  # registering worker's lease id — one entry per worker
 
     def key(self) -> str:
-        return f"{MODEL_ROOT}/{self.model_type}/{self.name}"
+        return f"{MODEL_ROOT}/{self.model_type}/{self.name}/{self.instance:x}"
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -58,6 +59,8 @@ async def register_model(drt, entry: ModelEntry, use_lease: bool = True) -> None
     """llmctl add: register under this process's lease so the entry dies
     with the worker."""
     lease = drt.primary_lease_id if use_lease else 0
+    if entry.instance == 0:
+        entry.instance = drt.primary_lease_id
     put = drt.store.kv_put(entry.key(), entry.to_json(), lease_id=lease)
     if asyncio.iscoroutine(put):
         await put
@@ -76,22 +79,19 @@ async def list_models(drt) -> list[ModelEntry]:
     return [ModelEntry.from_json(e.value) for e in entries]
 
 
-class RemoteOpenAIEngine(AsyncEngine):
-    """Presents a discovered worker endpoint as a local engine speaking raw
-    OpenAI dicts (the worker runs its own pre/post-processing)."""
+from ..runtime.component import EngineClient
 
-    def __init__(self, client: Client, policy: str = "round_robin"):
-        self._client = client
-        self._policy = policy
+
+class RemoteOpenAIEngine(EngineClient):
+    """EngineClient variant speaking raw OpenAI dicts: unwraps typed
+    requests to their original JSON before pushing (the worker runs its own
+    pre/post-processing)."""
 
     async def generate(self, request: Context) -> AsyncIterator[Annotated]:
         data = request.data
         if isinstance(data, (ChatCompletionRequest, CompletionRequest)):
-            data = data.raw
-        stream = await self._client.generate(
-            request.transfer(data), policy=self._policy
-        )
-        async for item in stream:
+            request = request.transfer(data.raw)
+        async for item in super().generate(request):
             yield item
 
 
@@ -103,6 +103,7 @@ class ModelWatcher:
         self.manager = manager
         self._task: Optional[asyncio.Task] = None
         self._clients: dict[str, Client] = {}
+        self._entries: dict[str, ModelEntry] = {}
 
     async def start(self) -> "ModelWatcher":
         watcher = self.drt.store.watch_prefix(MODEL_ROOT + "/")
@@ -124,6 +125,10 @@ class ModelWatcher:
                 logger.exception("model watcher error for %s", ev.key)
 
     async def _add(self, entry: ModelEntry) -> None:
+        key = entry.key()
+        old = self._clients.pop(key, None)
+        if old is not None:
+            old.stop()  # worker re-registered under the same key
         client = await (
             self.drt.namespace(entry.namespace)
             .component(entry.component)
@@ -131,7 +136,8 @@ class ModelWatcher:
             .client()
             .start()
         )
-        self._clients[entry.key()] = client
+        self._clients[key] = client
+        self._entries[key] = entry
         engine = RemoteOpenAIEngine(client)
         if entry.model_type in ("chat", "both"):
             self.manager.add_chat_model(entry.name, engine)
@@ -141,16 +147,21 @@ class ModelWatcher:
                     entry.name, entry.namespace, entry.component, entry.endpoint)
 
     def _remove_by_key(self, key: str) -> None:
-        # key = public/models/{type}/{name}
-        parts = key.split("/")
-        if len(parts) < 4:
-            return
-        model_type, name = parts[2], parts[3]
-        if model_type in ("chat", "both"):
-            self.manager.remove_chat_model(name)
-        if model_type in ("completion", "both"):
-            self.manager.remove_completion_model(name)
+        entry = self._entries.pop(key, None)
         client = self._clients.pop(key, None)
         if client is not None:
             client.stop()
-        logger.info("removed model %s", name)
+        if entry is None:
+            return
+        # only drop the model when no other live worker still serves it
+        still_served = any(
+            e.name == entry.name and e.model_type == entry.model_type
+            for e in self._entries.values()
+        )
+        if still_served:
+            return
+        if entry.model_type in ("chat", "both"):
+            self.manager.remove_chat_model(entry.name)
+        if entry.model_type in ("completion", "both"):
+            self.manager.remove_completion_model(entry.name)
+        logger.info("removed model %s", entry.name)

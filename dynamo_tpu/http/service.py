@@ -111,7 +111,16 @@ class HttpService:
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             while True:
-                req = await self._read_request(reader)
+                try:
+                    req = await self._read_request(reader)
+                except ValueError:
+                    # malformed framing (bad content-length / chunk size)
+                    await self._send_json(
+                        writer, 400,
+                        {"error": {"message": "malformed request framing",
+                                   "type": "invalid_request_error"}},
+                    )
+                    break
                 if req is None:
                     break
                 method, path, headers, body = req

@@ -1,0 +1,1 @@
+"""Launch layer: the dynamo-run equivalent CLI (ref launch/dynamo-run)."""

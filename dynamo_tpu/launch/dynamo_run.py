@@ -1,0 +1,339 @@
+"""dynamo-run equivalent CLI: ``in=<source> out=<engine>``.
+
+Re-design of the reference's launcher (launch/dynamo-run/src/{main,lib}.rs:
+``dynamo run in=http|text|stdin|batch:f|dyn://… out=echo|<engine>|dyn://…``)
+for the TPU stack:
+
+  in=http      OpenAI frontend in this process
+  in=text      interactive REPL
+  in=stdin     one prompt from stdin, stream to stdout
+  in=batch:F   JSONL throughput harness (reports tokens in/out per sec,
+               ref input/batch.rs:180-195)
+  in=dyn://ns.comp.ep   serve the engine as a distributed endpoint (worker)
+
+  out=echo     token-echo fake engine (testing, ref output/echo_core.rs)
+  out=jax      the native JAX/TPU engine
+  out=dyn://ns.comp.ep  route to discovered remote workers (frontend mode)
+
+Examples:
+
+  python -m dynamo_tpu.launch.dynamo_run in=http out=jax --model-path /models/llama-3-8b
+  python -m dynamo_tpu.launch.dynamo_run in=dyn://dyn.worker.generate out=jax \
+      --model-path /models/llama-3-8b --hub 10.0.0.1:18500     # worker node
+  python -m dynamo_tpu.launch.dynamo_run in=http out=dyn://dyn.worker.generate \
+      --hub 10.0.0.1:18500                                      # frontend node
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+from ..engine import EngineConfig, JaxEngine
+from ..http.discovery import ModelEntry, ModelWatcher, register_model
+from ..http.service import HttpService, ModelManager
+from ..llm.backend import Backend
+from ..llm.model_card import MdcRefresher, ModelDeploymentCard
+from ..llm.openai_engine import OpenAIWorkerEngine
+from ..llm.preprocessor import OpenAIPreprocessor
+from ..llm.tokenizer import ByteTokenizer, HFTokenizer
+from ..models.config import ModelConfig
+from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from ..protocols.openai import ChatCompletionRequest
+from ..runtime import AsyncEngine, Context, DistributedRuntime, link
+from ..runtime.hub import HubServer, connect_hub
+
+logger = logging.getLogger(__name__)
+
+
+class EchoEngine(AsyncEngine):
+    """Echo prompt tokens back (ref output/echo_core.rs)."""
+
+    async def generate(self, request: Context):
+        req: PreprocessedRequest = request.data
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_dict(req)
+        n = len(req.token_ids)
+        maxt = min(req.stop_conditions.max_tokens or n, n)
+        for i in range(maxt):
+            final = i == maxt - 1
+            yield LLMEngineOutput(
+                token_ids=[req.token_ids[i]],
+                finish_reason=FinishReason.LENGTH if final else None,
+                prompt_tokens=n if final else None,
+                completion_tokens=i + 1 if final else None,
+            )
+            await asyncio.sleep(0)
+
+
+def build_model(args) -> tuple[ModelConfig, Optional[dict], object, str]:
+    """(model config, params-or-None, tokenizer, model name)."""
+    if args.model_path in (None, "tiny"):
+        cfg = ModelConfig.tiny()
+        return cfg, None, ByteTokenizer(), args.model_name or "tiny"
+    cfg = ModelConfig.from_local_path(args.model_path)
+    tokenizer = HFTokenizer(args.model_path)
+    name = args.model_name or os.path.basename(os.path.normpath(args.model_path))
+    params = None
+    has_weights = any(
+        f.endswith(".safetensors") for f in os.listdir(args.model_path)
+    )
+    if has_weights:
+        from ..models.weights import load_llama_params
+
+        from ..parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(tp=args.tp)) if args.tp > 1 else None
+        params = load_llama_params(args.model_path, cfg, mesh=mesh)
+    return cfg, params, tokenizer, name
+
+
+def build_core_engine(args, cfg: ModelConfig, params) -> AsyncEngine:
+    if args.out == "echo":
+        return EchoEngine()
+    if args.out == "jax":
+        from ..parallel.mesh import MeshConfig
+
+        ecfg = EngineConfig(
+            model=cfg,
+            num_blocks=args.num_blocks,
+            block_size=args.block_size,
+            max_batch_size=args.max_batch,
+            max_context=args.max_context or 0,
+            mesh=MeshConfig(tp=args.tp) if args.tp > 1 else None,
+        )
+        return JaxEngine(ecfg, params=params)
+    raise SystemExit(f"unknown out= engine {args.out!r}")
+
+
+async def connect_runtime(args) -> DistributedRuntime:
+    if args.hub:
+        store, bus, _conn = await connect_hub(args.hub)
+        return await DistributedRuntime.from_settings(store=store, bus=bus)
+    return await DistributedRuntime.from_settings()
+
+
+# ---------------- in= modes ----------------
+
+
+async def run_http(args) -> None:
+    manager = ModelManager()
+    svc = HttpService(manager, host=args.host, port=args.http_port)
+    if args.out.startswith("dyn://"):
+        drt = await connect_runtime(args)
+        await ModelWatcher(drt, manager).start()
+    else:
+        cfg, params, tokenizer, name = build_model(args)
+        core = build_core_engine(args, cfg, params)
+        engine = OpenAIWorkerEngine(tokenizer, core)
+        manager.add_chat_model(name, engine)
+        manager.add_completion_model(name, engine)
+    await svc.start()
+    print(f"OpenAI server on http://{args.host}:{svc.port} "
+          f"(models: {manager.model_names() or 'discovered dynamically'})", flush=True)
+    await svc.run()
+
+
+async def run_endpoint(args) -> None:
+    """Worker mode: serve the engine at dyn://ns.comp.ep (ref input/endpoint.rs)."""
+    target = args.in_.removeprefix("dyn://")
+    ns, comp, ep = target.split(".")
+    drt = await connect_runtime(args)
+    cfg, params, tokenizer, name = build_model(args)
+    core = build_core_engine(args, cfg, params)
+    engine = OpenAIWorkerEngine(tokenizer, core)
+    stats = core.load_metrics if isinstance(core, JaxEngine) else (lambda: {})
+    await drt.namespace(ns).component(comp).endpoint(ep).serve(engine, stats_handler=stats)
+    await register_model(
+        drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
+                        model_type="both"),
+    )
+    card = ModelDeploymentCard(
+        display_name=name, service_name=name, model_path=args.model_path or "",
+        context_length=cfg.max_position_embeddings, kv_block_size=args.block_size,
+    )
+    await card.publish(drt.bus)
+    refresher = MdcRefresher(drt.bus, card)
+    refresher.start()
+    print(f"worker {drt.worker_id:x} serving {name!r} at dyn://{target}", flush=True)
+    await asyncio.Event().wait()
+
+
+async def _one_shot(engine: AsyncEngine, model: str, prompt: str, max_tokens: int, emit):
+    req = ChatCompletionRequest.from_dict(
+        {
+            "model": model,
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "stream": True,
+        }
+    )
+    n_out = 0
+    async for item in engine.generate(Context(req)):
+        data = getattr(item, "data", None)
+        if data and data.get("choices"):
+            delta = data["choices"][0].get("delta", {})
+            if delta.get("content"):
+                emit(delta["content"])
+                n_out += 1
+    return n_out
+
+
+async def run_text(args) -> None:
+    cfg, params, tokenizer, name = build_model(args)
+    core = build_core_engine(args, cfg, params)
+    engine = OpenAIWorkerEngine(tokenizer, core)
+    print(f"interactive mode — model {name!r}; ctrl-d to exit", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            prompt = await loop.run_in_executor(None, lambda: input("> "))
+        except EOFError:
+            return
+        await _one_shot(engine, name, prompt, args.max_tokens,
+                        lambda s: print(s, end="", flush=True))
+        print(flush=True)
+
+
+async def run_stdin(args) -> None:
+    cfg, params, tokenizer, name = build_model(args)
+    core = build_core_engine(args, cfg, params)
+    engine = OpenAIWorkerEngine(tokenizer, core)
+    prompt = sys.stdin.read().strip()
+    await _one_shot(engine, name, prompt, args.max_tokens,
+                    lambda s: print(s, end="", flush=True))
+    print(flush=True)
+
+
+async def run_batch(args, batch_file: str) -> None:
+    """Throughput harness (ref input/batch.rs): JSONL with {"text": ...}."""
+    cfg, params, tokenizer, name = build_model(args)
+    core = build_core_engine(args, cfg, params)
+    pipeline = link(Backend(tokenizer), core)
+
+    entries = []
+    with open(batch_file) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+
+    results = []
+    t0 = time.monotonic()
+
+    async def run_one(entry):
+        from ..protocols.common import SamplingOptions, StopConditions
+
+        token_ids = tokenizer.encode(entry["text"], add_special_tokens=True)
+        req = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=StopConditions(
+                max_tokens=entry.get("max_tokens", args.max_tokens), ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(temperature=0.0),
+            model=name,
+        )
+        t_start = time.monotonic()
+        tokens_out = 0
+        tokens_in = len(token_ids)
+        async for item in pipeline.generate(Context(req)):
+            out = getattr(item, "data", None)
+            if out is None:
+                continue
+            tokens_out += len(out.token_ids)
+        results.append(
+            {"tokens_in": tokens_in, "tokens_out": tokens_out,
+             "elapsed_ms": (time.monotonic() - t_start) * 1e3}
+        )
+
+    concurrency = args.concurrency
+    pending = set()
+    for entry in entries:
+        if len(pending) >= concurrency:
+            _done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+        pending.add(asyncio.get_running_loop().create_task(run_one(entry)))
+    if pending:
+        await asyncio.wait(pending)
+
+    elapsed = time.monotonic() - t0
+    tin = sum(r["tokens_in"] for r in results)
+    tout = sum(r["tokens_out"] for r in results)
+    print(json.dumps({
+        "requests": len(results),
+        "elapsed_s": round(elapsed, 3),
+        "tokens_in": tin,
+        "tokens_out": tout,
+        "tokens_in_per_s": round(tin / elapsed, 2),
+        "tokens_out_per_s": round(tout / elapsed, 2),
+    }), flush=True)
+
+
+async def run_hub(args) -> None:
+    hub = HubServer(host=args.host, port=args.hub_port)
+    await hub.start()
+    print(f"hub listening on {hub.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        "dynamo_run", description="TPU-native dynamo run: in=<source> out=<engine>"
+    )
+    p.add_argument("in_out", nargs="*", help="in=... out=... pairs")
+    p.add_argument("--model-path", default=None, help="HF model dir or 'tiny'")
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--hub", default=None, help="hub address host:port")
+    p.add_argument("--hub-port", type=int, default=18500)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--max-tokens", type=int, default=128)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-context", type=int, default=0)
+    args = p.parse_args(argv)
+
+    args.in_ = "http"
+    args.out = "jax"
+    for tok in args.in_out:
+        if tok.startswith("in="):
+            args.in_ = tok[3:]
+        elif tok.startswith("out="):
+            args.out = tok[4:]
+        elif tok == "hub":
+            args.in_ = "hub"
+
+    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+
+    if args.in_ == "hub":
+        coro = run_hub(args)
+    elif args.in_ == "http":
+        coro = run_http(args)
+    elif args.in_ == "text":
+        coro = run_text(args)
+    elif args.in_ == "stdin":
+        coro = run_stdin(args)
+    elif args.in_.startswith("batch:"):
+        coro = run_batch(args, args.in_[len("batch:"):])
+    elif args.in_.startswith("dyn://"):
+        coro = run_endpoint(args)
+    else:
+        raise SystemExit(f"unknown in= mode {args.in_!r}")
+    try:
+        asyncio.run(coro)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,7 @@ class OpenAIPreprocessor(Operator):
             token_ids = list(req.prompt)
             prompt = self._tokenizer.decode(token_ids)
         else:
-            prompt = req.prompt if isinstance(req.prompt, str) else "".join(req.prompt)
+            prompt = req.prompt
             token_ids = self._tokenizer.encode(prompt, add_special_tokens=True)
         pre = PreprocessedRequest(
             token_ids=token_ids,

@@ -1,0 +1,10 @@
+"""Model families for the native TPU engine (pure-JAX functional models).
+
+The reference delegates model code to wrapped engines (vLLM/SGLang/TRT-LLM);
+this framework owns its models natively: functional JAX forward passes over
+a paged KV cache, sharded via jax.sharding over a device mesh.
+"""
+
+from .config import ModelConfig
+
+__all__ = ["ModelConfig"]

@@ -1,0 +1,94 @@
+"""Model architecture config (llama-family superset + MoE fields).
+
+Parsed from HF ``config.json`` (the reference reads the same artifact via
+its ModelDeploymentCard, model_card/create.rs). Covers Llama 2/3,
+DeepSeek-R1-Distill-Llama, Qwen2 (bias variant), Mistral, and
+Mixtral/DeepSeek-style MoE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(eq=False)  # identity hash/eq: used as a jit static arg
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 0  # 0 -> hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0
+    # runtime
+    dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.head_dim == 0:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @staticmethod
+    def from_hf_config(cfg: dict) -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=cfg.get("vocab_size", 32000),
+            hidden_size=cfg.get("hidden_size", 4096),
+            intermediate_size=cfg.get("intermediate_size", 11008),
+            num_layers=cfg.get("num_hidden_layers", 32),
+            num_heads=cfg.get("num_attention_heads", 32),
+            num_kv_heads=cfg.get("num_key_value_heads", cfg.get("num_attention_heads", 32)),
+            head_dim=cfg.get("head_dim", 0) or 0,
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            attention_bias=cfg.get("attention_bias", False),
+            num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
+            dtype=cfg.get("torch_dtype", "bfloat16"),
+        )
+
+    @staticmethod
+    def from_local_path(path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return ModelConfig.from_hf_config(json.load(f))
+
+    @staticmethod
+    def tiny(**overrides) -> "ModelConfig":
+        """A small config for tests/benches."""
+        base = dict(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position_embeddings=512,
+        )
+        base.update(overrides)
+        return ModelConfig(**base)
+
+    # llama-3-8b-ish for benches
+    @staticmethod
+    def llama3_8b(**overrides) -> "ModelConfig":
+        base = dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+            rope_theta=500000.0, max_position_embeddings=8192,
+        )
+        base.update(overrides)
+        return ModelConfig(**base)

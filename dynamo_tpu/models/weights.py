@@ -1,0 +1,142 @@
+"""HF checkpoint loading: safetensors -> the engine's param pytree.
+
+Replaces the reference's delegation of weight loading to its engines (plus
+hub download, launch/dynamo-run/src/hub.rs — here models are local paths;
+fetching is an operator concern). Loads sharded ``*.safetensors`` files
+lazily, maps HF llama naming onto the stacked-layer pytree, and can place
+each tensor directly onto its mesh sharding to avoid a full host copy of
+the model per process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _np_dtype(dtype: str):
+    return {"bfloat16": jnp.bfloat16, "float32": np.float32, "float16": np.float16}[dtype]
+
+
+def load_llama_params(
+    path: str,
+    cfg: ModelConfig,
+    mesh=None,
+    dtype: Optional[str] = None,
+) -> dict:
+    """Load a HF llama-family checkpoint directory into the stacked pytree
+    used by dynamo_tpu.models.llama."""
+    from safetensors import safe_open
+
+    dt = _np_dtype(dtype or str(cfg.dtype))
+    files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {path}")
+
+    # build tensor name -> file map (honors index.json if present)
+    index_file = os.path.join(path, "model.safetensors.index.json")
+    name_to_file: dict[str, str] = {}
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            name_to_file = json.load(f)["weight_map"]
+    else:
+        for fname in files:
+            with safe_open(os.path.join(path, fname), framework="numpy") as f:
+                for name in f.keys():
+                    name_to_file[name] = fname
+
+    handles: dict[str, object] = {}
+
+    def get(name: str) -> np.ndarray:
+        fname = name_to_file[name]
+        if fname not in handles:
+            handles[fname] = safe_open(os.path.join(path, fname), framework="numpy")
+        t = handles[fname].get_tensor(name)
+        return t
+
+    L = cfg.num_layers
+
+    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
+        mats = []
+        for i in range(L):
+            t = get(fmt.format(i=i))
+            mats.append(t.T if transpose else t)
+        return np.stack(mats)
+
+    params: dict = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
+        "layers": {
+            "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+        },
+    }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
+        params["layers"]["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False)
+        params["layers"]["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+
+    # cast + (optionally) place on mesh shard-by-shard
+    if mesh is not None:
+        from ..parallel.mesh import shard_params
+
+        params = jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+        params = shard_params(params, mesh)
+    else:
+        params = jax.tree.map(lambda x: jnp.asarray(x, dt), params)
+    for h in handles.values():
+        del h
+    return params
+
+
+def save_llama_params(path: str, params: dict) -> None:
+    """Write params back out as a single safetensors file (testing and
+    fixture generation)."""
+    from safetensors.numpy import save_file
+
+    flat: dict[str, np.ndarray] = {}
+    L = params["layers"]["wq"].shape[0]
+    flat["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
+    flat["model.norm.weight"] = np.asarray(params["final_norm"], np.float32)
+    lay = params["layers"]
+    names = {
+        "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
+        "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+        "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+        "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+        "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+        "mlp_norm": ("model.layers.{i}.post_attention_layernorm.weight", False),
+        "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+        "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+        "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    }
+    for key, (fmt, transpose) in names.items():
+        if key not in lay:
+            continue
+        for i in range(L):
+            t = np.asarray(lay[key][i], np.float32)
+            flat[fmt.format(i=i)] = t.T.copy() if transpose else t
+    if "lm_head" in params:
+        flat["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
+    save_file(flat, os.path.join(path, "model.safetensors"))

@@ -1,0 +1,1 @@
+"""TPU compute ops: paged attention, sampling, KV block copies."""

@@ -1,0 +1,155 @@
+"""Paged attention over a block-table-indirect KV cache.
+
+The reference's equivalent lives inside the engines it wraps (vLLM's paged
+attention CUDA kernels); on TPU we own it. Two implementations with one
+interface:
+
+  * :func:`paged_attention_xla` — pure-XLA gather + dense attention.
+    Correct everywhere (CPU tests, any TPU), and XLA fuses it acceptably
+    for small batches.
+  * a Pallas ragged kernel in :mod:`dynamo_tpu.ops.paged_attention_pallas`
+    (used automatically on TPU for decode when shapes allow).
+
+Cache layout (one array per K/V for all layers — a single sharded
+residency):
+
+    k_cache, v_cache: [num_layers, num_blocks, block_size, num_kv_heads, head_dim]
+
+sharded over the "tp" mesh axis on num_kv_heads. Block tables are
+[batch, max_blocks_per_seq] int32 indices into num_blocks; sequence length
+masks out unused tail positions. Static shapes throughout — batch, table
+width, and block count are fixed per compiled program (XLA requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int, axis: int) -> jnp.ndarray:
+    """GQA: repeat kv heads to match query heads."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=axis)
+
+
+def decode_attention_xla(
+    q: jnp.ndarray,  # [B, H, D] one new token per sequence
+    k_cache_layer: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
+    v_cache_layer: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B] int32 (includes the new token)
+    scale: float,
+) -> jnp.ndarray:  # [B, H, D]
+    B, H, D = q.shape
+    M = block_tables.shape[1]
+    bs = k_cache_layer.shape[1]
+    Hkv = k_cache_layer.shape[2]
+    # gather blocks -> [B, M*bs, Hkv, D]
+    k = k_cache_layer[block_tables].reshape(B, M * bs, Hkv, D)
+    v = v_cache_layer[block_tables].reshape(B, M * bs, Hkv, D)
+    k = repeat_kv(k, H // Hkv, axis=2)
+    v = repeat_kv(v, H // Hkv, axis=2)
+    scores = jnp.einsum("bhd,bthd->bht", q * scale, k).astype(jnp.float32)
+    positions = jnp.arange(M * bs)[None, :]  # [1, T]
+    mask = positions < seq_lens[:, None]  # [B, T]
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bht,bthd->bhd", probs, v)
+
+
+def prefill_attention_xla(
+    q: jnp.ndarray,  # [T, H, D]
+    k: jnp.ndarray,  # [T, Hkv, D] (this chunk's keys)
+    v: jnp.ndarray,  # [T, Hkv, D]
+    q_positions: jnp.ndarray,  # [T] absolute positions of the queries
+    valid_len: jnp.ndarray,  # scalar: number of real (unpadded) tokens
+    scale: float,
+) -> jnp.ndarray:  # [T, H, D]
+    """Causal self-attention within one (padded) prompt chunk."""
+    T, H, D = q.shape
+    Hkv = k.shape[1]
+    k = repeat_kv(k, H // Hkv, axis=1)
+    v = repeat_kv(v, H // Hkv, axis=1)
+    scores = jnp.einsum("thd,shd->hts", q * scale, k).astype(jnp.float32)
+    causal = q_positions[:, None] >= q_positions[None, :]  # [T, T]
+    valid = jnp.arange(T)[None, :] < valid_len  # [1, T]
+    mask = causal & valid
+    scores = jnp.where(mask[None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("hts,shd->thd", probs, v)
+
+
+def chunk_attention_with_cache_xla(
+    q: jnp.ndarray,  # [T, H, D] chunk queries
+    k_chunk: jnp.ndarray,  # [T, Hkv, D]
+    v_chunk: jnp.ndarray,  # [T, Hkv, D]
+    k_cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    v_cache_layer: jnp.ndarray,
+    block_table: jnp.ndarray,  # [M] this sequence's blocks
+    history_len: jnp.ndarray,  # scalar: tokens already in cache
+    valid_len: jnp.ndarray,  # scalar: real tokens in this chunk
+    scale: float,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: queries attend to cached history plus the
+    causal prefix of the current chunk (enables chunked prefill and
+    prefix-cache reuse without recomputing cached blocks)."""
+    T, H, D = q.shape
+    M = block_table.shape[0]
+    bs = k_cache_layer.shape[1]
+    Hkv = k_chunk.shape[1]
+    k_hist = k_cache_layer[block_table].reshape(M * bs, Hkv, D)
+    v_hist = v_cache_layer[block_table].reshape(M * bs, Hkv, D)
+    k_all = jnp.concatenate([k_hist, k_chunk], axis=0)  # [M*bs+T, Hkv, D]
+    v_all = jnp.concatenate([v_hist, v_chunk], axis=0)
+    k_all = repeat_kv(k_all, H // Hkv, axis=1)
+    v_all = repeat_kv(v_all, H // Hkv, axis=1)
+    scores = jnp.einsum("thd,shd->hts", q * scale, k_all).astype(jnp.float32)
+    S = M * bs + T
+    q_pos = history_len + jnp.arange(T)  # absolute positions of queries
+    kv_pos = jnp.concatenate([jnp.arange(M * bs), history_len + jnp.arange(T)])
+    kv_is_hist = jnp.arange(S) < M * bs
+    kv_valid = jnp.where(
+        kv_is_hist,
+        jnp.arange(S) < history_len,  # history entries below history_len
+        (jnp.arange(S) - M * bs) < valid_len,  # chunk entries below valid_len
+    )
+    causal = q_pos[:, None] >= kv_pos[None, :]
+    mask = causal & kv_valid[None, :]
+    scores = jnp.where(mask[None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
+    return jnp.einsum("hts,shd->thd", probs, v_all)
+
+
+def write_chunk_to_cache(
+    cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    chunk: jnp.ndarray,  # [T, Hkv, D]
+    block_table: jnp.ndarray,  # [M]
+    start_pos: jnp.ndarray,  # scalar: first absolute position of the chunk
+) -> jnp.ndarray:
+    """Scatter a chunk's K or V into its paged blocks. Padded tail tokens
+    are routed to a sacrificial slot (last block's last position is
+    overwritten by real data later or never read thanks to masking)."""
+    T = chunk.shape[0]
+    bs = cache_layer.shape[1]
+    pos = start_pos + jnp.arange(T)
+    blk = block_table[pos // bs]
+    off = pos % bs
+    return cache_layer.at[blk, off].set(chunk)
+
+
+def write_decode_token_to_cache(
+    cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    token_kv: jnp.ndarray,  # [B, Hkv, D]
+    block_tables: jnp.ndarray,  # [B, M]
+    positions: jnp.ndarray,  # [B] absolute position of the new token
+) -> jnp.ndarray:
+    bs = cache_layer.shape[1]
+    blk = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1
+    )[:, 0]
+    off = positions % bs
+    return cache_layer.at[blk, off].set(token_kv)

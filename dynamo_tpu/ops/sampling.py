@@ -1,0 +1,63 @@
+"""On-device batched token sampling.
+
+Temperature / top-k / top-p / greedy for a whole decode batch in one fused
+XLA program (per-request parameters as vectors, so mixed sampling configs
+batch together — no per-request host round trips).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=("top_k_max",))
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    keys: jnp.ndarray,  # [B, 2] uint32 PRNG keys (jax.random.key data)
+    temperature: jnp.ndarray,  # [B] 0 => greedy
+    top_k: jnp.ndarray,  # [B] 0 => disabled
+    top_p: jnp.ndarray,  # [B] 1.0 => disabled
+    top_k_max: int = 0,  # static cap for the top-k sort width (0 = full V)
+) -> jnp.ndarray:  # [B] int32
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+
+    # top-k: mask everything below the k-th largest
+    kth = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [B]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
+    kth_val = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=1)  # [B,1]
+    scaled = jnp.where(scaled < kth_val, NEG_INF, scaled)
+
+    # top-p (nucleus): keep smallest set with cumulative prob >= top_p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # find threshold value: smallest logit still inside the nucleus
+    inside = cum - probs_sorted < top_p[:, None]  # keep while cumsum(before) < p
+    # threshold = min sorted value that is inside
+    thresh = jnp.min(jnp.where(inside, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < thresh, NEG_INF, scaled)
+
+    def sample_one(key_data, row):
+        key = jax.random.wrap_key_data(key_data)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(sample_one)(keys, scaled)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def make_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
+    """Derive per-(request, step) key data from int seeds — deterministic
+    replay per request without threading key state through the host."""
+    def one(seed, step):
+        return jax.random.key_data(jax.random.fold_in(jax.random.key(seed), step))
+
+    return jax.vmap(one)(seeds, steps)

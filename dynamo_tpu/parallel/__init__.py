@@ -1,0 +1,11 @@
+"""Parallelism: device meshes, sharding rules, multi-host init.
+
+TPU-native replacement for the reference's engine-delegated parallelism
+(Ray/MPI/torch.distributed bootstraps, SURVEY.md section 2.8): a
+jax.sharding.Mesh with named axes + NamedSharding placement rules; XLA SPMD
+inserts all collectives.
+"""
+
+from .mesh import MeshConfig, cache_sharding, make_mesh, param_sharding, shard_params
+
+__all__ = ["MeshConfig", "cache_sharding", "make_mesh", "param_sharding", "shard_params"]

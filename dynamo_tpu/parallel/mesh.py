@@ -1,0 +1,116 @@
+"""Device mesh + sharding placement rules.
+
+The mesh axes:
+
+  * ``dp``   — data parallel (independent request batches / replicas)
+  * ``tp``   — tensor parallel (heads / mlp-hidden / vocab, over ICI)
+
+Megatron-style placement (column-parallel qkv/gate/up, row-parallel
+out/down, vocab-parallel embedding + lm_head) expressed purely as
+NamedSharding annotations: jit propagates them and XLA SPMD inserts the
+reduce-scatter/all-gather/all-reduce the reference gets from NCCL inside
+vLLM. KV cache shards its kv-head axis over ``tp``; when tp exceeds the
+kv-head count the cache axis is replicated (XLA handles the q-head split).
+
+Multi-host: the same mesh built from jax.devices() spanning hosts (ICI
+within a slice, DCN across slices via jax.distributed.initialize) — see
+parallel.multihost.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+
+def make_mesh(mesh_cfg: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if mesh_cfg is None:
+        mesh_cfg = MeshConfig(dp=1, tp=len(devices))
+    n = mesh_cfg.num_devices
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(mesh_cfg.dp, mesh_cfg.tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+# partition specs per parameter path (leading L axis on stacked layers)
+_PARAM_SPECS = {
+    "embed": P("tp", None),  # vocab-parallel
+    "lm_head": P(None, "tp"),  # vocab-parallel output
+    "final_norm": P(None),
+    "layers.attn_norm": P(None, None),
+    "layers.mlp_norm": P(None, None),
+    "layers.wq": P(None, None, "tp"),  # column: heads
+    "layers.wk": P(None, None, "tp"),
+    "layers.wv": P(None, None, "tp"),
+    "layers.wo": P(None, "tp", None),  # row
+    "layers.bq": P(None, "tp"),
+    "layers.bk": P(None, "tp"),
+    "layers.bv": P(None, "tp"),
+    "layers.w_gate": P(None, None, "tp"),  # column: hidden
+    "layers.w_up": P(None, None, "tp"),
+    "layers.w_down": P(None, "tp", None),  # row
+    # MoE (experts stacked on axis 1: [L, X, ...])
+    "layers.moe_gate": P(None, None, None),
+    "layers.we_gate": P(None, None, None, "tp"),
+    "layers.we_up": P(None, None, None, "tp"),
+    "layers.we_down": P(None, None, "tp", None),
+    "layers.shared_gate": P(None, None, "tp"),
+    "layers.shared_up": P(None, None, "tp"),
+    "layers.shared_down": P(None, "tp", None),
+}
+
+
+def param_sharding(mesh: Mesh) -> dict:
+    """Pytree of NamedShardings matching the params structure."""
+
+    def build(prefix: str, tree):
+        if isinstance(tree, dict):
+            return {k: build(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
+        spec = _PARAM_SPECS.get(prefix, P())
+        return NamedSharding(mesh, spec)
+
+    return build
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place a params pytree onto the mesh per the placement rules."""
+    builder = param_sharding(mesh)
+
+    def walk(prefix: str, tree):
+        if isinstance(tree, dict):
+            return {k: walk(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
+        spec = _PARAM_SPECS.get(prefix, P())
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+
+    return walk("", params)
+
+
+def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
+    """[L, num_blocks, block_size, Hkv, D]: shard kv heads over tp when
+    divisible, else replicate that axis."""
+    tp = mesh.shape["tp"]
+    if cfg.num_kv_heads % tp == 0:
+        return NamedSharding(mesh, P(None, None, None, "tp", None))
+    return NamedSharding(mesh, P(None, None, None, None, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -167,6 +167,13 @@ class CompletionRequest:
             raise RequestError("missing required field: model")
         if "prompt" not in d:
             raise RequestError("missing required field: prompt")
+        prompt = d["prompt"]
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+            # OpenAI batched prompts need one choice per prompt; not
+            # supported yet — reject rather than silently concatenate
+            raise RequestError(
+                "list-of-strings prompt is not supported; send one request per prompt"
+            )
         nvext = NvExt.from_dict(d.get("nvext"))
         return CompletionRequest(
             model=d["model"],

@@ -76,9 +76,7 @@ class HubServer:
                     break
                 try:
                     head = frame.header_json() or {}
-                    asyncio.get_running_loop().create_task(
-                        session.dispatch(head, frame.data)
-                    )
+                    session.spawn(session.dispatch(head, frame.data))
                 except Exception as e:  # noqa: BLE001
                     logger.warning("hub dispatch error: %s", e)
         except (ConnectionResetError, asyncio.IncompleteReadError):

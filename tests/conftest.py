@@ -2,17 +2,24 @@
 
 All tests run on CPU with 8 virtual devices so multi-chip sharding
 (tp/dp/pp/sp/ep over jax.sharding.Mesh) is exercised without TPU hardware.
-Must run before jax is imported anywhere.
+
+Note: this environment's sitecustomize imports jax at interpreter startup
+(with JAX_PLATFORMS=axon baked into the config snapshot), so setting env
+vars here is too late — jax.config.update is the reliable override.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

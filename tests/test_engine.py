@@ -1,0 +1,186 @@
+"""JAX engine tests: continuous batching, prefix cache, cancellation,
+stop conditions — all on the CPU mesh with a tiny model."""
+
+import asyncio
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import BlockAllocator, EngineConfig, JaxEngine
+from dynamo_tpu.engine.allocator import sequence_block_hashes
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import Context, collect
+
+
+@pytest.fixture(scope="module")
+def engine_cfg():
+    return EngineConfig(
+        model=ModelConfig.tiny(),
+        num_blocks=64,
+        block_size=4,
+        max_batch_size=4,
+        max_context=128,
+        prefill_chunk=32,
+    )
+
+
+@pytest.fixture
+def shared_engine(engine_cfg):
+    # fresh engine per test (asyncio state binds to the test's loop);
+    # jit compile caches are module-level so this stays fast
+    return JaxEngine(engine_cfg, seed=0)
+
+
+def make_req(tokens, max_tokens=8, temperature=0.0, seed=0, **stops):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **stops),
+        sampling_options=SamplingOptions(temperature=temperature, seed=seed),
+        eos_token_ids=[511],
+    )
+
+
+# ---------------- allocator unit tests (ref lib/llm/tests/kv_manager.rs) --------
+
+
+def test_allocator_alloc_free_reuse():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    assert a.free_count == 8
+    blocks = a.allocate(3)
+    assert a.free_count == 5 and all(b.idx != 0 for b in blocks)
+    # commit first as full, then free all
+    h = a.commit_full_block(blocks[0], [1, 2, 3, 4], None)
+    a.free(blocks)
+    assert a.free_count == 8
+    # matching prefix claims the committed block back
+    matched = a.match_prefix([1, 2, 3, 4, 5, 6])
+    assert len(matched) == 1 and matched[0].seq_hash == h
+    a.free(matched)
+
+
+def test_allocator_chained_hashes_differ_by_prefix():
+    h1 = sequence_block_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    h2 = sequence_block_hashes([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert h1[0][0] != h2[0][0]
+    # same local hash for the second block, different chained hash
+    assert h1[1][0] == h2[1][0]
+    assert h1[1][1] != h2[1][1]
+
+
+def test_allocator_exhaustion_and_refcounts():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    blocks = a.allocate(4)
+    assert a.allocate(1) is None
+    h = a.commit_full_block(blocks[0], [7, 7, 7, 7], None)
+    m = a.match_prefix([7, 7, 7, 7])  # shared ref on same block
+    assert m[0].idx == blocks[0].idx and m[0].ref_count == 2
+    a.free([blocks[0]])
+    assert a.free_count == 0  # still referenced by m
+    a.free(m)
+    assert a.free_count == 1  # now in reuse pool
+
+    removed = []
+    a.on_removed = removed.append
+    got = a.allocate(1)  # must evict the reuse-pool block
+    assert got is not None
+    assert removed and removed[0] == [h]
+
+
+# ---------------- engine behavior ----------------
+
+
+def test_engine_greedy_deterministic(run, engine_cfg, shared_engine):
+    async def main():
+        engine = shared_engine
+        req = make_req(range(10, 20), max_tokens=6)
+        out1 = await collect(engine.generate(Context(req)))
+        out2 = await collect(engine.generate(Context(make_req(range(10, 20), max_tokens=6))))
+        toks1 = [t for o in out1 for t in o.token_ids]
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert len(toks1) == 6
+        assert toks1 == toks2
+        final = out1[-1]
+        assert final.finish_reason == FinishReason.LENGTH
+        assert final.prompt_tokens == 10 and final.completion_tokens == 6
+
+    run(main())
+
+
+def test_engine_prefix_cache_hit(run, engine_cfg, shared_engine):
+    async def main():
+        engine = shared_engine
+        base = engine.stats["prefix_cache_hits_tokens"]
+        prompt = list(range(30, 46))  # 16 tokens = 4 full blocks
+        await collect(engine.generate(Context(make_req(prompt, max_tokens=2))))
+        await collect(engine.generate(Context(make_req(prompt, max_tokens=2))))
+        # second run must reuse at least 3 full blocks (last block recomputed)
+        assert engine.stats["prefix_cache_hits_tokens"] - base >= 12
+
+    run(main())
+
+
+def test_engine_concurrent_requests_batch(run, engine_cfg, shared_engine):
+    async def main():
+        engine = shared_engine
+        reqs = [make_req(range(50 + i, 60 + i), max_tokens=5, seed=i) for i in range(3)]
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(r))) for r in reqs]
+        )
+        for out in outs:
+            toks = [t for o in out for t in o.token_ids]
+            assert len(toks) == 5
+            assert out[-1].finish_reason == FinishReason.LENGTH
+        # all sequences finished and freed their blocks
+        assert engine._n_active == 0
+
+    run(main())
+
+
+def test_engine_cancellation(run, engine_cfg, shared_engine):
+    async def main():
+        engine = shared_engine
+        ctx = Context(make_req(range(70, 80), max_tokens=100))
+        got = []
+        async for out in engine.generate(ctx):
+            got.append(out)
+            if len(got) == 2:
+                ctx.context.stop_generating()
+        assert got[-1].finish_reason == FinishReason.CANCELLED
+        assert engine._n_active == 0
+
+    run(main())
+
+
+def test_engine_stop_token(run, engine_cfg, shared_engine):
+    async def main():
+        engine = shared_engine
+        # run one greedy request, find its 3rd token, then use it as a stop id
+        probe = await collect(
+            engine.generate(Context(make_req(range(90, 100), max_tokens=5)))
+        )
+        toks = [t for o in probe for t in o.token_ids]
+        req = make_req(range(90, 100), max_tokens=5, stop_token_ids=[toks[2]])
+        out = await collect(engine.generate(Context(req)))
+        got = [t for o in out for t in o.token_ids]
+        assert got == toks[:3]
+        assert out[-1].finish_reason == FinishReason.STOP
+
+    run(main())
+
+
+def test_engine_metrics_shape(run, engine_cfg, shared_engine):
+    async def main():
+        m = shared_engine.load_metrics()
+        assert set(m) >= {
+            "kv_active_blocks", "kv_total_blocks", "gpu_cache_usage_perc",
+            "request_active_slots", "request_total_slots", "num_requests_waiting",
+        }
+        assert m["kv_total_blocks"] == 63
+
+    run(main())
