@@ -125,7 +125,7 @@ class JaxEngine(AsyncEngine):
         self._block_tables = np.zeros((cfg.max_batch_size, M), np.int32)
         self._seq_lens = np.zeros(cfg.max_batch_size, np.int32)
         self._last_tokens = np.zeros(cfg.max_batch_size, np.int32)
-        self._seeds = np.zeros(cfg.max_batch_size, np.int64)
+        self._seeds = np.zeros(cfg.max_batch_size, np.int32)
         self._temps = np.zeros(cfg.max_batch_size, np.float32)
         self._top_ks = np.zeros(cfg.max_batch_size, np.int32)
         self._top_ps = np.ones(cfg.max_batch_size, np.float32)
@@ -203,7 +203,7 @@ class JaxEngine(AsyncEngine):
                     await self._wake.wait()
                     continue
                 if self._n_active:
-                    self._decode_once()
+                    await self._decode_once()
                 # yield to the event loop so emissions flush
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
@@ -225,7 +225,7 @@ class JaxEngine(AsyncEngine):
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
-            if not self._try_prefill(seq):
+            if not await self._try_prefill(seq):
                 # out of KV blocks: put back and stop admitting (backpressure)
                 self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
                 break
@@ -234,7 +234,7 @@ class JaxEngine(AsyncEngine):
         self.stats["requests_waiting"] = self._waiting.qsize()
         return admitted
 
-    def _try_prefill(self, seq: _Sequence) -> bool:
+    async def _try_prefill(self, seq: _Sequence) -> bool:
         cfg = self.cfg
         bs = cfg.block_size
         prompt = seq.tokens
@@ -244,7 +244,6 @@ class JaxEngine(AsyncEngine):
         history = len(matched) * bs
         seq.cached_prefix = history
         self.stats["prefix_cache_hits_tokens"] += history
-        remaining = len(prompt) - history
         # blocks needed to cover prompt + some decode headroom
         total_needed = min(
             (len(prompt) + bs) // bs + 1, cfg.max_blocks_per_seq
@@ -259,7 +258,21 @@ class JaxEngine(AsyncEngine):
         seq.committed = len(matched)
         seq.parent_hash = matched[-1].seq_hash if matched else None
 
-        # run chunked prefill over the uncached suffix
+        # device work (jit dispatch + compile + host sync) runs in a worker
+        # thread so lease keepalives / bus traffic stay live on the loop
+        first_token = await asyncio.get_running_loop().run_in_executor(
+            None, self._prefill_device, seq, history
+        )
+        self._commit_full_blocks(seq)
+        self._emit_token(seq, first_token)
+        if not seq.finished:
+            self._place_in_batch(seq)
+        return True
+
+    def _prefill_device(self, seq: _Sequence, history: int) -> int:
+        """Runs in an executor thread: chunked prefill + first-token sample."""
+        cfg = self.cfg
+        prompt = seq.tokens
         table = self._table_for(seq)
         logits = None
         pos = history
@@ -280,14 +293,7 @@ class JaxEngine(AsyncEngine):
                 self.v_cache,
             )
             pos += len(chunk)
-
-        # sample the first generated token on host from final logits
-        first_token = self._sample_prefill(seq, logits)
-        self._commit_full_blocks(seq)
-        self._emit_token(seq, first_token)
-        if not seq.finished:
-            self._place_in_batch(seq)
-        return True
+        return self._sample_prefill(seq, logits)
 
     def _table_for(self, seq: _Sequence) -> np.ndarray:
         t = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
@@ -301,7 +307,7 @@ class JaxEngine(AsyncEngine):
         if getattr(seq.request, "greedy", False):
             temp = 0.0
         keys = make_keys(
-            jnp.asarray([so.seed if so.seed is not None else 0]),
+            jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
         )
         tok = sample_tokens(
@@ -322,14 +328,15 @@ class JaxEngine(AsyncEngine):
         self._block_tables[slot] = self._table_for(seq)
         self._seq_lens[slot] = seq.seq_len
         self._last_tokens[slot] = seq.tokens[-1]
-        self._seeds[slot] = so.seed if so.seed is not None else 0
+        # mask into int32 range: PRNG seeds only need entropy, not magnitude
+        self._seeds[slot] = (so.seed or 0) & 0x7FFFFFFF
         self._temps[slot] = so.temperature if so.temperature is not None else 1.0
         self._top_ks[slot] = so.top_k or 0
         self._top_ps[slot] = so.top_p if so.top_p is not None else 1.0
 
     # ---- decode ----
 
-    def _decode_once(self) -> None:
+    async def _decode_once(self) -> None:
         cfg = self.cfg
         # ensure every active sequence has a block for the incoming token
         for seq in self._active:
@@ -353,8 +360,25 @@ class JaxEngine(AsyncEngine):
         steps = np.asarray(
             [self._active[i].generated if self._active[i] else 0
              for i in range(cfg.max_batch_size)],
-            np.int64,
+            np.int32,
         )
+        toks_host = await asyncio.get_running_loop().run_in_executor(
+            None, self._decode_device, steps
+        )
+        self.stats["decode_steps"] += 1
+        for i in active_slots:
+            seq = self._active[i]
+            if seq is None:
+                continue
+            self._emit_token(seq, int(toks_host[i]))
+            if not seq.finished:
+                self._seq_lens[i] = seq.seq_len
+                self._last_tokens[i] = seq.tokens[-1]
+                self._commit_full_blocks(seq)
+
+    def _decode_device(self, steps: np.ndarray) -> np.ndarray:
+        """Runs in an executor thread: one decode step + sampling."""
+        cfg = self.cfg
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
         logits, self.k_cache, self.v_cache = llama.decode_step(
             self.params,
@@ -374,17 +398,7 @@ class JaxEngine(AsyncEngine):
             jnp.asarray(self._top_ks),
             jnp.asarray(self._top_ps),
         )
-        toks_host = np.asarray(jax.device_get(toks))
-        self.stats["decode_steps"] += 1
-        for i in active_slots:
-            seq = self._active[i]
-            if seq is None:
-                continue
-            self._emit_token(seq, int(toks_host[i]))
-            if not seq.finished:
-                self._seq_lens[i] = seq.seq_len
-                self._last_tokens[i] = seq.tokens[-1]
-                self._commit_full_blocks(seq)
+        return np.asarray(jax.device_get(toks))
 
     # ---- token emission + finish logic ----
 

@@ -1,0 +1,28 @@
+"""KV-cache-aware request routing (re-design of lib/llm/src/kv_router).
+
+Workers publish block stored/removed events; the router maintains a global
+prefix index (chained block hashes -> worker sets), scores each request's
+cache overlap per worker, combines it with scraped load metrics, and
+routes to the best worker. This is the capability behind the reference's
+"3x TTFT" headline (BASELINE.md).
+"""
+
+from .indexer import KvIndexer, OverlapScores, PrefixIndex
+from .protocols import KvCacheEvent, RouterEvent
+from .publisher import KvEventPublisher, KvMetricsAggregator
+from .router import KvRouter
+from .scheduler import KvScheduler, ProcessedEndpoints, WorkerLoad
+
+__all__ = [
+    "KvCacheEvent",
+    "KvEventPublisher",
+    "KvIndexer",
+    "KvMetricsAggregator",
+    "KvRouter",
+    "KvScheduler",
+    "OverlapScores",
+    "PrefixIndex",
+    "ProcessedEndpoints",
+    "RouterEvent",
+    "WorkerLoad",
+]

@@ -1,0 +1,215 @@
+"""Global prefix index: chained block hash -> worker residency.
+
+Re-design of the reference's RadixTree indexer (lib/llm/src/kv_router/
+indexer.rs:87-677). Because block hashes are *chained* (hash includes the
+whole prefix), the radix structure is implicit: looking up a sequence's
+k-th chained hash is an O(1) dict probe, and a match at depth k implies
+matches at all shallower depths. The index therefore stores a flat
+``hash -> node`` map with parent/child links kept only for subtree
+removal and per-worker cleanup — same behavior as the reference's tree,
+one less traversal.
+
+``KvIndexer`` wraps the structure in a single consumer task fed from the
+bus (ref indexer.rs:499 mpsc pattern) so appliers never contend with
+lookups; ``ShardedPrefixIndex`` hash-partitions across shards for
+parallelism (ref KvIndexerSharded, indexer.rs:677).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .protocols import KV_EVENT_SUBJECT, RouterEvent
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class OverlapScores:
+    """worker_id -> number of consecutive prefix blocks resident
+    (ref indexer.rs:239 OverlapScores)."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+    total_blocks: int = 0
+
+    def best(self) -> tuple[Optional[int], int]:
+        if not self.scores:
+            return None, 0
+        wid = max(self.scores, key=lambda w: self.scores[w])
+        return wid, self.scores[wid]
+
+
+@dataclass
+class _Node:
+    block_hash: int
+    parent_hash: Optional[int]
+    workers: set[int] = field(default_factory=set)
+    children: set[int] = field(default_factory=set)
+
+
+class PrefixIndex:
+    def __init__(self):
+        self._nodes: dict[int, _Node] = {}
+        self._by_worker: dict[int, set[int]] = defaultdict(set)
+
+    # ---- queries ----
+    def find_matches(self, block_hashes: Iterable[int]) -> OverlapScores:
+        """Walk the chained hashes in order; per worker, count how deep its
+        residency extends (consecutive from the start)."""
+        scores = OverlapScores()
+        active: Optional[set[int]] = None
+        n = 0
+        for h in block_hashes:
+            n += 1
+            node = self._nodes.get(h)
+            if node is None:
+                break
+            workers = node.workers if active is None else (node.workers & active)
+            if not workers:
+                break
+            for w in workers:
+                scores.scores[w] = scores.scores.get(w, 0) + 1
+            active = set(workers)
+        scores.total_blocks = n
+        return scores
+
+    @property
+    def size(self) -> int:
+        return len(self._nodes)
+
+    def workers(self) -> list[int]:
+        return sorted(self._by_worker)
+
+    # ---- mutation ----
+    def apply_event(self, ev: RouterEvent) -> None:
+        kv = ev.event
+        if kv.kind == "stored":
+            parent = kv.parent_hash
+            for blk in kv.blocks:
+                node = self._nodes.get(blk.block_hash)
+                if node is None:
+                    node = self._nodes[blk.block_hash] = _Node(
+                        blk.block_hash, parent
+                    )
+                    if parent is not None and parent in self._nodes:
+                        self._nodes[parent].children.add(blk.block_hash)
+                node.workers.add(ev.worker_id)
+                self._by_worker[ev.worker_id].add(blk.block_hash)
+                parent = blk.block_hash
+        elif kv.kind == "removed":
+            for h in kv.block_hashes:
+                self._remove_worker_block(ev.worker_id, h)
+
+    def _remove_worker_block(self, worker_id: int, block_hash: int) -> None:
+        node = self._nodes.get(block_hash)
+        if node is None:
+            return
+        node.workers.discard(worker_id)
+        self._by_worker[worker_id].discard(block_hash)
+        # a removed parent means the worker also dropped descendants it held
+        for child in list(node.children):
+            cnode = self._nodes.get(child)
+            if cnode and worker_id in cnode.workers:
+                self._remove_worker_block(worker_id, child)
+        if not node.workers:
+            self._drop_node(node)
+
+    def _drop_node(self, node: _Node) -> None:
+        for child in list(node.children):
+            cnode = self._nodes.get(child)
+            if cnode is not None:
+                self._drop_node(cnode)
+        if node.parent_hash is not None:
+            parent = self._nodes.get(node.parent_hash)
+            if parent:
+                parent.children.discard(node.block_hash)
+        self._nodes.pop(node.block_hash, None)
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Full cleanup when a worker dies (ref indexer.rs:380)."""
+        for h in list(self._by_worker.get(worker_id, ())):
+            node = self._nodes.get(h)
+            if node is None:
+                continue
+            node.workers.discard(worker_id)
+            if not node.workers:
+                # children sharing only this worker die via their own
+                # by_worker entries; just unlink this node
+                self._drop_node(node)
+        self._by_worker.pop(worker_id, None)
+
+
+class ShardedPrefixIndex:
+    """Hash-partitioned by worker id: each worker's residency lives in one
+    shard; queries fan out and merge (ref KvIndexerSharded)."""
+
+    def __init__(self, shards: int = 4):
+        self._shards = [PrefixIndex() for _ in range(shards)]
+
+    def _shard(self, worker_id: int) -> PrefixIndex:
+        return self._shards[worker_id % len(self._shards)]
+
+    def apply_event(self, ev: RouterEvent) -> None:
+        self._shard(ev.worker_id).apply_event(ev)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._shard(worker_id).remove_worker(worker_id)
+
+    def find_matches(self, block_hashes) -> OverlapScores:
+        hashes = list(block_hashes)
+        merged = OverlapScores(total_blocks=len(hashes))
+        for s in self._shards:
+            part = s.find_matches(hashes)
+            merged.scores.update(part.scores)
+        return merged
+
+
+class KvIndexer:
+    """Event-plane consumer: subscribes the component's kv_events subject
+    and owns a PrefixIndex behind a queue (ref KvIndexer, indexer.rs:499)."""
+
+    def __init__(self, drt, component, shards: int = 1):
+        self.drt = drt
+        self.component = component
+        self.index = PrefixIndex() if shards <= 1 else ShardedPrefixIndex(shards)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._tasks: list[asyncio.Task] = []
+        self.events_applied = 0
+
+    async def start(self) -> "KvIndexer":
+        sub = self.drt.bus.subscribe(self.component.event_subject(KV_EVENT_SUBJECT))
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._tasks.append(self.drt.runtime.spawn(self._consume(sub)))
+        self._tasks.append(self.drt.runtime.spawn(self._apply_loop()))
+        return self
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self._queue.put_nowait(RouterEvent.from_bytes(msg.payload))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad kv event")
+
+    async def _apply_loop(self) -> None:
+        while True:
+            ev = await self._queue.get()
+            self.index.apply_event(ev)
+            self.events_applied += 1
+
+    def find_matches(self, block_hashes) -> OverlapScores:
+        return self.index.find_matches(block_hashes)
+
+    def find_matches_for_tokens(self, tokens, block_size: int) -> OverlapScores:
+        from ..engine.allocator import sequence_block_hashes
+
+        hashes = [seq for _loc, seq in sequence_block_hashes(tokens, block_size)]
+        return self.find_matches(hashes)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.index.remove_worker(worker_id)

@@ -1,0 +1,89 @@
+"""KV event schema (re-design of lib/llm/src/kv_router/protocols.rs:19-98).
+
+Two hash kinds, as in the reference:
+  * ``tokens_hash`` — content hash of one block's tokens (LocalBlockHash),
+  * ``block_hash``  — chained sequence hash (ExternalSequenceBlockHash):
+    hash(parent_chain, tokens_hash). The chain hash is position-dependent,
+    so equal chains <=> equal full prefixes — the index key.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+KV_EVENT_SUBJECT = "kv_events"
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+
+@dataclass
+class StoredBlock:
+    block_hash: int  # chained
+    tokens_hash: int  # local
+
+
+@dataclass
+class KvCacheEvent:
+    """Stored (with parent linkage) or Removed."""
+
+    kind: str  # "stored" | "removed"
+    parent_hash: Optional[int] = None
+    blocks: list[StoredBlock] = field(default_factory=list)
+    block_hashes: list[int] = field(default_factory=list)
+
+    @staticmethod
+    def stored(parent_hash: Optional[int], blocks: list[StoredBlock]) -> "KvCacheEvent":
+        return KvCacheEvent(kind="stored", parent_hash=parent_hash, blocks=blocks)
+
+    @staticmethod
+    def removed(block_hashes: list[int]) -> "KvCacheEvent":
+        return KvCacheEvent(kind="removed", block_hashes=block_hashes)
+
+
+@dataclass
+class RouterEvent:
+    worker_id: int
+    event: KvCacheEvent
+    event_id: int = 0
+
+    def to_bytes(self) -> bytes:
+        d = {
+            "worker_id": self.worker_id,
+            "event_id": self.event_id,
+            "kind": self.event.kind,
+            "parent_hash": self.event.parent_hash,
+            "blocks": [[b.block_hash, b.tokens_hash] for b in self.event.blocks],
+            "block_hashes": self.event.block_hashes,
+        }
+        return json.dumps(d).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "RouterEvent":
+        d = json.loads(raw)
+        return RouterEvent(
+            worker_id=d["worker_id"],
+            event_id=d.get("event_id", 0),
+            event=KvCacheEvent(
+                kind=d["kind"],
+                parent_hash=d.get("parent_hash"),
+                blocks=[StoredBlock(b[0], b[1]) for b in d.get("blocks", [])],
+                block_hashes=d.get("block_hashes", []),
+            ),
+        )
+
+
+@dataclass
+class KVHitRateEvent:
+    """Emitted per routing decision (ref scheduler.rs:28-32)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "KVHitRateEvent":
+        return KVHitRateEvent(**json.loads(raw))

@@ -1,0 +1,94 @@
+"""Worker-side KV event publication + router-side metrics aggregation.
+
+Re-design of lib/llm/src/kv_router/{publisher,metrics_aggregator,scoring}.rs:
+
+  * :class:`KvEventPublisher` — hooks the engine's BlockAllocator
+    stored/removed callbacks and publishes RouterEvents on the component's
+    ``kv_events`` subject,
+  * :class:`KvMetricsAggregator` — periodically scrapes every worker
+    instance's stats endpoint (the engine's ``load_metrics``) into
+    :class:`ProcessedEndpoints` for the scheduler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Optional
+
+from .protocols import KV_EVENT_SUBJECT, KvCacheEvent, RouterEvent, StoredBlock
+from .scheduler import ProcessedEndpoints, WorkerLoad
+
+logger = logging.getLogger(__name__)
+
+
+class KvEventPublisher:
+    """ref publisher.rs:33-73."""
+
+    def __init__(self, drt, component, worker_id: int):
+        self.drt = drt
+        self.subject = component.event_subject(KV_EVENT_SUBJECT)
+        self.worker_id = worker_id
+        self._ids = itertools.count(1)
+
+    def publish(self, event: KvCacheEvent) -> None:
+        ev = RouterEvent(self.worker_id, event, next(self._ids))
+        self.drt.bus.publish(self.subject, ev.to_bytes())
+
+    # -- allocator callback adapters --
+    def on_stored(self, block, parent_hash: Optional[int]) -> None:
+        self.publish(
+            KvCacheEvent.stored(
+                parent_hash,
+                [StoredBlock(block_hash=block.seq_hash, tokens_hash=block.local_hash)],
+            )
+        )
+
+    def on_removed(self, block_hashes: list[int]) -> None:
+        self.publish(KvCacheEvent.removed(block_hashes))
+
+    def attach(self, allocator) -> None:
+        allocator.on_stored = self.on_stored
+        allocator.on_removed = self.on_removed
+
+
+class KvMetricsAggregator:
+    """ref metrics_aggregator.rs:27-109 collect_endpoints_task."""
+
+    def __init__(self, drt, component, interval: float = 1.0):
+        self.drt = drt
+        self.component = component
+        self.interval = interval
+        self.endpoints = ProcessedEndpoints([])
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "KvMetricsAggregator":
+        await self._collect_once()
+        self._task = self.drt.runtime.spawn(self._loop())
+        return self
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self._collect_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("metrics scrape failed")
+
+    async def _collect_once(self) -> None:
+        stats = await self.component.scrape_stats()
+        loads = []
+        for s in stats:
+            d = s.get("data") or {}
+            loads.append(
+                WorkerLoad(
+                    worker_id=s["instance_id"],
+                    kv_active_blocks=d.get("kv_active_blocks", 0),
+                    kv_total_blocks=max(d.get("kv_total_blocks", 1), 1),
+                    active_requests=d.get("request_active_slots", 0),
+                    total_slots=max(d.get("request_total_slots", 1), 1),
+                    waiting=d.get("num_requests_waiting", 0),
+                )
+            )
+        self.endpoints = ProcessedEndpoints(loads)

@@ -1,0 +1,126 @@
+"""KvRouter: indexer + metrics + scheduler behind one ``schedule`` call,
+plus the pipeline operator that routes preprocessed requests to workers.
+
+Re-design of lib/llm/src/kv_router.rs:45-143 (KvRouter.schedule) and the
+python router component (examples/llm/components/kv_router.py): the router
+sits between the preprocessor and the worker client, computes the
+request's chained block hashes, scores overlap against the global index,
+and pins the request to the chosen worker with ``client.direct``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, Optional
+
+from ..engine.allocator import sequence_block_hashes
+from ..protocols.common import PreprocessedRequest
+from ..runtime.annotated import Annotated
+from ..runtime.component import Client, Component
+from ..runtime.engine import AsyncEngine, Context
+from .indexer import KvIndexer
+from .publisher import KvMetricsAggregator
+from .scheduler import AllWorkersBusy, KvScheduler, SchedulerConfig
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    """ref kv_router.rs:45 KvRouter{indexer, scheduler}."""
+
+    def __init__(
+        self,
+        drt,
+        component: Component,
+        block_size: int = 16,
+        config: Optional[SchedulerConfig] = None,
+        indexer_shards: int = 1,
+    ):
+        self.drt = drt
+        self.component = component
+        self.block_size = block_size
+        self.indexer = KvIndexer(drt, component, shards=indexer_shards)
+        self.metrics = KvMetricsAggregator(drt, component)
+        self.scheduler = KvScheduler(drt, component, config)
+        self._watch_task = None
+
+    async def start(self) -> "KvRouter":
+        await self.indexer.start()
+        await self.metrics.start()
+        # prune dead workers from the index when their discovery keys vanish
+        # (lease loss), ref indexer.rs:380 remove_worker wiring
+        import asyncio
+
+        from ..runtime.store import EventKind
+
+        watcher = self.drt.store.watch_prefix(self.component.etcd_root + "/")
+        if asyncio.iscoroutine(watcher):
+            watcher = await watcher
+        self._watch_task = self.drt.runtime.spawn(self._watch_instances(watcher))
+        return self
+
+    async def _watch_instances(self, watcher) -> None:
+        from ..runtime.store import EventKind
+
+        async for ev in watcher:
+            if ev.kind != EventKind.DELETE:
+                continue
+            try:
+                lease_hex = ev.key.rsplit(":", 1)[1]
+                worker_id = int(lease_hex, 16)
+            except (IndexError, ValueError):
+                continue
+            logger.info("pruning dead worker %x from kv index", worker_id)
+            self.indexer.remove_worker(worker_id)
+
+    async def schedule(self, token_ids: list[int]) -> tuple[int, int]:
+        """-> (worker_id, overlap_blocks). Raises AllWorkersBusy."""
+        hashes = [s for _l, s in sequence_block_hashes(token_ids, self.block_size)]
+        overlaps = self.indexer.find_matches(hashes)
+        endpoints = self.metrics.endpoints
+        if not endpoints.loads:
+            await self.metrics._collect_once()
+            endpoints = self.metrics.endpoints
+        worker_id = self.scheduler.select_worker(endpoints, overlaps, len(hashes))
+        return worker_id, overlaps.scores.get(worker_id, 0)
+
+    def request_finished(self, worker_id: int) -> None:
+        self.scheduler.request_finished(worker_id)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.indexer.remove_worker(worker_id)
+
+
+class KvRoutedEngine(AsyncEngine):
+    """Routes PreprocessedRequests to the KV-best worker instance
+    (falls back to round robin when the router can't decide)."""
+
+    def __init__(self, router: KvRouter, client: Client):
+        self.router = router
+        self.client = client
+
+    async def generate(self, request: Context) -> AsyncIterator[Annotated]:
+        data = request.data
+        token_ids = (
+            data.token_ids
+            if isinstance(data, PreprocessedRequest)
+            else (data or {}).get("token_ids", [])
+        )
+        payload = data.to_dict() if isinstance(data, PreprocessedRequest) else data
+        worker_id: Optional[int] = None
+        try:
+            worker_id, _overlap = await self.router.schedule(token_ids)
+        except AllWorkersBusy:
+            logger.warning("all workers busy; falling back to round robin")
+        except Exception:  # noqa: BLE001
+            logger.exception("router failure; falling back to round robin")
+        try:
+            if worker_id is not None and worker_id in set(self.client.instance_ids()):
+                stream = await self.client.direct(request.transfer(payload), worker_id)
+            else:
+                stream = await self.client.round_robin(request.transfer(payload))
+            async for item in stream:
+                yield item
+        finally:
+            if worker_id is not None:
+                self.router.request_finished(worker_id)

@@ -1,0 +1,157 @@
+"""KV-aware worker selection (re-design of lib/llm/src/kv_router/
+scheduler.rs:84-316).
+
+Cost model per candidate worker, as in the reference (scheduler.rs:221-262):
+
+  normalized_new_tokens = tokens the worker would have to prefill / isl
+  load_deviation        = worker kv usage - mean kv usage
+  request_load_ratio    = active requests / slots
+
+  cost = alpha * load_deviation
+       + (1 - alpha) * normalized_new_tokens
+       + gamma * request_load_ratio
+
+with a "balance mode" switch: when the kv-load standard deviation across
+workers exceeds a threshold the weights flip to prioritize load (alpha
+0.7) over cache overlap (alpha 0.3 otherwise). Full workers are skipped;
+if every worker is saturated the scheduler reports AllWorkersBusy so the
+caller can queue (ref scheduler.rs:165-174). Selected workers get an
+optimistic local load bump so concurrent decisions spread out (ref
+scheduler.rs:281-282).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .indexer import OverlapScores
+from .protocols import KV_HIT_RATE_SUBJECT, KVHitRateEvent
+
+logger = logging.getLogger(__name__)
+
+
+class AllWorkersBusy(Exception):
+    pass
+
+
+@dataclass
+class WorkerLoad:
+    worker_id: int
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    active_requests: int = 0
+    total_slots: int = 1
+    waiting: int = 0
+
+    @property
+    def kv_usage(self) -> float:
+        return self.kv_active_blocks / max(self.kv_total_blocks, 1)
+
+    @property
+    def slot_usage(self) -> float:
+        return self.active_requests / max(self.total_slots, 1)
+
+    @property
+    def saturated(self) -> bool:
+        return self.active_requests >= self.total_slots and self.waiting > 0
+
+
+@dataclass
+class ProcessedEndpoints:
+    loads: list[WorkerLoad]
+
+    def __post_init__(self):
+        self.by_id = {l.worker_id: l for l in self.loads}
+
+    @property
+    def load_avg(self) -> float:
+        if not self.loads:
+            return 0.0
+        return sum(l.kv_usage for l in self.loads) / len(self.loads)
+
+    @property
+    def load_std(self) -> float:
+        if not self.loads:
+            return 0.0
+        avg = self.load_avg
+        return (sum((l.kv_usage - avg) ** 2 for l in self.loads) / len(self.loads)) ** 0.5
+
+    def worker_ids(self) -> list[int]:
+        return sorted(self.by_id)
+
+
+@dataclass
+class SchedulerConfig:
+    overlap_alpha: float = 0.3  # weight on load when caches matter more
+    balance_alpha: float = 0.7  # weight on load in balance mode
+    balance_threshold: float = 0.2  # load-std that flips to balance mode
+    gamma: float = 0.2  # request-load term
+
+
+class KvScheduler:
+    def __init__(self, drt=None, component=None, config: Optional[SchedulerConfig] = None):
+        self.cfg = config or SchedulerConfig()
+        self.drt = drt
+        self._hit_subject = (
+            component.event_subject(KV_HIT_RATE_SUBJECT) if component else None
+        )
+        # optimistic in-flight bumps: worker -> extra requests assumed
+        self._pending: dict[int, int] = {}
+
+    def select_worker(
+        self,
+        endpoints: ProcessedEndpoints,
+        overlaps: OverlapScores,
+        isl_blocks: int,
+    ) -> int:
+        loads = [l for l in endpoints.loads]
+        if not loads:
+            raise AllWorkersBusy("no workers")
+        candidates = [l for l in loads if not l.saturated]
+        if not candidates:
+            raise AllWorkersBusy("all workers saturated")
+
+        balance_mode = endpoints.load_std > self.cfg.balance_threshold
+        alpha = self.cfg.balance_alpha if balance_mode else self.cfg.overlap_alpha
+        avg = endpoints.load_avg
+
+        best_id, best_cost = None, None
+        for l in candidates:
+            overlap = overlaps.scores.get(l.worker_id, 0)
+            new_blocks = max(isl_blocks - overlap, 0)
+            norm_new = new_blocks / max(isl_blocks, 1)
+            pending = self._pending.get(l.worker_id, 0)
+            req_ratio = (l.active_requests + pending) / max(l.total_slots, 1)
+            cost = (
+                alpha * (l.kv_usage - avg)
+                + (1 - alpha) * norm_new
+                + self.cfg.gamma * req_ratio
+            )
+            if best_cost is None or cost < best_cost:
+                best_id, best_cost = l.worker_id, cost
+
+        assert best_id is not None
+        self._pending[best_id] = self._pending.get(best_id, 0) + 1
+        self._emit_hit_rate(best_id, isl_blocks, overlaps.scores.get(best_id, 0))
+        return best_id
+
+    def request_finished(self, worker_id: int) -> None:
+        """Release the optimistic bump once the request lands/completes."""
+        n = self._pending.get(worker_id, 0)
+        if n <= 1:
+            self._pending.pop(worker_id, None)
+        else:
+            self._pending[worker_id] = n - 1
+
+    def _emit_hit_rate(self, worker_id: int, isl_blocks: int, overlap: int) -> None:
+        if self.drt is None or self._hit_subject is None:
+            return
+        try:
+            self.drt.bus.publish(
+                self._hit_subject,
+                KVHitRateEvent(worker_id, isl_blocks, overlap).to_bytes(),
+            )
+        except Exception:  # noqa: BLE001
+            logger.debug("hit-rate publish failed", exc_info=True)

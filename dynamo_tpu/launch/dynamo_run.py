@@ -72,7 +72,7 @@ class EchoEngine(AsyncEngine):
             await asyncio.sleep(0)
 
 
-def build_model(args) -> tuple[ModelConfig, Optional[dict], object, str]:
+def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[dict], object, str]:
     """(model config, params-or-None, tokenizer, model name)."""
     if args.model_path in (None, "tiny"):
         cfg = ModelConfig.tiny()
@@ -81,7 +81,7 @@ def build_model(args) -> tuple[ModelConfig, Optional[dict], object, str]:
     tokenizer = HFTokenizer(args.model_path)
     name = args.model_name or os.path.basename(os.path.normpath(args.model_path))
     params = None
-    has_weights = any(
+    has_weights = load_weights and any(
         f.endswith(".safetensors") for f in os.listdir(args.model_path)
     )
     if has_weights:
@@ -125,7 +125,26 @@ async def connect_runtime(args) -> DistributedRuntime:
 async def run_http(args) -> None:
     manager = ModelManager()
     svc = HttpService(manager, host=args.host, port=args.http_port)
-    if args.out.startswith("dyn://"):
+    if args.out.startswith("dyn://") and args.router == "kv":
+        # KV-aware frontend: tokenize locally (for prefix hashing), route
+        # each request to the worker with the best cache overlap
+        from ..kv_router import KvRouter
+        from ..kv_router.router import KvRoutedEngine
+
+        ns, comp_name, ep = args.out.removeprefix("dyn://").split(".")
+        drt = await connect_runtime(args)
+        cfg, _params, tokenizer, name = build_model(args, load_weights=False)
+        comp = drt.namespace(ns).component(comp_name)
+        client = await comp.endpoint(ep).client().start()
+        router = await KvRouter(drt, comp, block_size=args.block_size).start()
+        engine = link(
+            OpenAIPreprocessor(tokenizer),
+            Backend(tokenizer),
+            KvRoutedEngine(router, client),
+        )
+        manager.add_chat_model(name, engine)
+        manager.add_completion_model(name, engine)
+    elif args.out.startswith("dyn://"):
         drt = await connect_runtime(args)
         await ModelWatcher(drt, manager).start()
     else:
@@ -144,12 +163,19 @@ async def run_endpoint(args) -> None:
     """Worker mode: serve the engine at dyn://ns.comp.ep (ref input/endpoint.rs)."""
     target = args.in_.removeprefix("dyn://")
     ns, comp, ep = target.split(".")
-    drt = await connect_runtime(args)
+    # build the engine (slow: weight loading, jit warmup) BEFORE taking a
+    # lease, so control-plane keepalives aren't starved during init
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
+    drt = await connect_runtime(args)
     engine = OpenAIWorkerEngine(tokenizer, core)
     stats = core.load_metrics if isinstance(core, JaxEngine) else (lambda: {})
-    await drt.namespace(ns).component(comp).endpoint(ep).serve(engine, stats_handler=stats)
+    component = drt.namespace(ns).component(comp)
+    if isinstance(core, JaxEngine):
+        from ..kv_router import KvEventPublisher
+
+        KvEventPublisher(drt, component, drt.primary_lease_id).attach(core.allocator)
+    await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
                         model_type="both"),
@@ -297,6 +323,8 @@ def main(argv=None) -> None:
     p.add_argument("--max-tokens", type=int, default=128)
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    p.add_argument("--router", default="round_robin",
+                   choices=["round_robin", "random", "kv"])
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch", type=int, default=8)

@@ -23,6 +23,7 @@ from .tokenizer import Tokenizer
 
 class OpenAIWorkerEngine(AsyncEngine):
     def __init__(self, tokenizer: Tokenizer, core_engine: AsyncEngine):
+        self._core = core_engine
         self._pipeline = link(
             OpenAIPreprocessor(tokenizer), Backend(tokenizer), core_engine
         )
@@ -30,6 +31,14 @@ class OpenAIWorkerEngine(AsyncEngine):
     async def generate(self, request: Context) -> AsyncIterator[Annotated]:
         data = request.data
         if isinstance(data, dict):
+            if "token_ids" in data:
+                # already preprocessed upstream (KV-routed frontend does
+                # tokenization for prefix hashing) -> run the core engine
+                async for item in self._core.generate(request):
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    yield item
+                return
             try:
                 typed = (
                     ChatCompletionRequest.from_dict(data)

@@ -197,11 +197,14 @@ class ResponseWriter:
             self.context.kill()
 
     async def send(self, item: Annotated) -> None:
+        payload = item.to_dict(
+            data_to_dict=lambda d: d.to_dict() if hasattr(d, "to_dict") else d
+        )
         await write_frame(
             self._writer,
             TwoPartMessage(
                 header=json.dumps({"type": T_DATA}).encode(),
-                data=json.dumps(item.to_dict()).encode(),
+                data=json.dumps(payload).encode(),
             ),
         )
 
