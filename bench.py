@@ -31,7 +31,7 @@ def main() -> None:
         # 1B-class llama (llama-3.2-1B-ish)
         cfg = ModelConfig(
             vocab_size=32768, hidden_size=2048, intermediate_size=8192,
-            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+            num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
             max_position_embeddings=2048, dtype="bfloat16",
         )
         B, BLOCK, CTX = 16, 16, 1024
@@ -51,9 +51,12 @@ def main() -> None:
     )
     seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
 
+    use_pallas = not on_cpu and cfg.head_dim % 128 == 0 and BLOCK % 8 == 0
+
     def step(tokens, positions, seq_lens, k_cache, v_cache):
         logits, k_cache, v_cache = llama.decode_step(
-            params, cfg, tokens, positions, tables, seq_lens, k_cache, v_cache
+            params, cfg, tokens, positions, tables, seq_lens, k_cache, v_cache,
+            use_pallas=use_pallas,
         )
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, positions + 1, seq_lens + 1, k_cache, v_cache

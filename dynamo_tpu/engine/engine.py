@@ -114,6 +114,15 @@ class JaxEngine(AsyncEngine):
             k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
+        # Pallas decode path: TPU backend, unsharded cache, aligned tiles
+        # (the sharded-mesh pallas path goes through shard_map — see
+        # parallel/; until then meshes use the XLA fallback).
+        self.use_pallas = (
+            jax.default_backend() == "tpu"
+            and self.mesh is None
+            and cfg.model.head_dim % 128 == 0
+            and cfg.block_size % 8 == 0
+        )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         self._active: list[Optional[_Sequence]] = [None] * cfg.max_batch_size
         self._n_active = 0
@@ -210,11 +219,18 @@ class JaxEngine(AsyncEngine):
             pass
         except Exception:  # noqa: BLE001
             logger.exception("engine loop crashed")
+            # fail every request we own — active, and still-waiting (their
+            # generate() coroutines block on out_queue otherwise)
             for seq in self._active:
                 if seq is not None:
                     seq.out_queue.put_nowait(
                         LLMEngineOutput(finish_reason=FinishReason.ERROR)
                     )
+            while not self._waiting.empty():
+                seq = self._waiting.get_nowait()
+                seq.out_queue.put_nowait(
+                    LLMEngineOutput(finish_reason=FinishReason.ERROR)
+                )
 
     # ---- admission ----
 
@@ -225,7 +241,19 @@ class JaxEngine(AsyncEngine):
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
-            if not await self._try_prefill(seq):
+            try:
+                ok = await self._try_prefill(seq)
+            except Exception:  # noqa: BLE001
+                # device failure on THIS request (oom, compile error): fail
+                # it alone — the loop and other requests keep going
+                logger.exception("prefill failed for request %s", seq.context.id())
+                self.allocator.free(seq.blocks)
+                seq.blocks = []
+                seq.out_queue.put_nowait(
+                    LLMEngineOutput(finish_reason=FinishReason.ERROR)
+                )
+                continue
+            if not ok:
                 # out of KV blocks: put back and stop admitting (backpressure)
                 self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
                 break
@@ -389,6 +417,7 @@ class JaxEngine(AsyncEngine):
             jnp.asarray(self._seq_lens),
             self.k_cache,
             self.v_cache,
+            use_pallas=self.use_pallas,
         )
         keys = make_keys(jnp.asarray(self._seeds), jnp.asarray(steps))
         toks = sample_tokens(

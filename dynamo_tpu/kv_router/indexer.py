@@ -105,29 +105,38 @@ class PrefixIndex:
                 self._remove_worker_block(ev.worker_id, h)
 
     def _remove_worker_block(self, worker_id: int, block_hash: int) -> None:
-        node = self._nodes.get(block_hash)
-        if node is None:
-            return
-        node.workers.discard(worker_id)
-        self._by_worker[worker_id].discard(block_hash)
-        # a removed parent means the worker also dropped descendants it held
-        for child in list(node.children):
-            cnode = self._nodes.get(child)
-            if cnode and worker_id in cnode.workers:
-                self._remove_worker_block(worker_id, child)
-        if not node.workers:
-            self._drop_node(node)
+        # iterative (explicit stack): chains reach thousands of blocks at
+        # long context, far past Python's recursion limit
+        stack = [block_hash]
+        while stack:
+            node = self._nodes.get(stack.pop())
+            if node is None:
+                continue
+            node.workers.discard(worker_id)
+            self._by_worker[worker_id].discard(node.block_hash)
+            # a removed parent means the worker also dropped descendants it held
+            for child in node.children:
+                cnode = self._nodes.get(child)
+                if cnode and worker_id in cnode.workers:
+                    stack.append(child)
+            if not node.workers:
+                self._drop_node(node)
 
     def _drop_node(self, node: _Node) -> None:
-        for child in list(node.children):
-            cnode = self._nodes.get(child)
-            if cnode is not None:
-                self._drop_node(cnode)
+        """Unlink a node and drop its whole subtree (descendants are
+        unreachable in a prefix walk once the chain is broken)."""
         if node.parent_hash is not None:
             parent = self._nodes.get(node.parent_hash)
             if parent:
                 parent.children.discard(node.block_hash)
-        self._nodes.pop(node.block_hash, None)
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            for child in n.children:
+                cnode = self._nodes.get(child)
+                if cnode is not None:
+                    stack.append(cnode)
+            self._nodes.pop(n.block_hash, None)
 
     def remove_worker(self, worker_id: int) -> None:
         """Full cleanup when a worker dies (ref indexer.rs:380)."""
@@ -176,8 +185,7 @@ class KvIndexer:
         self.drt = drt
         self.component = component
         self.index = PrefixIndex() if shards <= 1 else ShardedPrefixIndex(shards)
-        self._queue: asyncio.Queue = asyncio.Queue()
-        self._tasks: list[asyncio.Task] = []
+        self._task: Optional[asyncio.Task] = None
         self.events_applied = 0
 
     async def start(self) -> "KvIndexer":
@@ -185,22 +193,24 @@ class KvIndexer:
         ready = getattr(sub, "ready", None)
         if ready is not None:
             await ready
-        self._tasks.append(self.drt.runtime.spawn(self._consume(sub)))
-        self._tasks.append(self.drt.runtime.spawn(self._apply_loop()))
+        self._task = self.drt.runtime.spawn(self._consume(sub))
         return self
 
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
     async def _consume(self, sub) -> None:
+        # apply inline: mutation and lookups share the event loop, so a
+        # separate applier task (the reference's mpsc, indexer.rs:499) would
+        # only add an unbounded buffer here
         async for msg in sub:
             try:
-                self._queue.put_nowait(RouterEvent.from_bytes(msg.payload))
+                self.index.apply_event(RouterEvent.from_bytes(msg.payload))
+                self.events_applied += 1
             except Exception:  # noqa: BLE001
                 logger.exception("bad kv event")
-
-    async def _apply_loop(self) -> None:
-        while True:
-            ev = await self._queue.get()
-            self.index.apply_event(ev)
-            self.events_applied += 1
 
     def find_matches(self, block_hashes) -> OverlapScores:
         return self.index.find_matches(block_hashes)

@@ -51,8 +51,6 @@ class KvRouter:
         # (lease loss), ref indexer.rs:380 remove_worker wiring
         import asyncio
 
-        from ..runtime.store import EventKind
-
         watcher = self.drt.store.watch_prefix(self.component.etcd_root + "/")
         if asyncio.iscoroutine(watcher):
             watcher = await watcher
@@ -77,11 +75,12 @@ class KvRouter:
         """-> (worker_id, overlap_blocks). Raises AllWorkersBusy."""
         hashes = [s for _l, s in sequence_block_hashes(token_ids, self.block_size)]
         overlaps = self.indexer.find_matches(hashes)
-        endpoints = self.metrics.endpoints
-        if not endpoints.loads:
-            await self.metrics._collect_once()
-            endpoints = self.metrics.endpoints
-        worker_id = self.scheduler.select_worker(endpoints, overlaps, len(hashes))
+        # never scrape inline: the aggregator loop refreshes every interval;
+        # an empty load set (cold start / all workers gone) raises
+        # AllWorkersBusy and the caller falls back to round robin
+        worker_id = self.scheduler.select_worker(
+            self.metrics.endpoints, overlaps, len(hashes)
+        )
         return worker_id, overlaps.scores.get(worker_id, 0)
 
     def request_finished(self, worker_id: int) -> None:

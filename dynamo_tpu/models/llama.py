@@ -7,7 +7,8 @@ list). Design is TPU-first, not a port:
   * parameters are a pytree with layers **stacked on a leading axis** and
     the layer loop is ``lax.scan`` — one traced layer body, fast XLA
     compiles even at 80 layers;
-  * the KV cache is two arrays ``[L, num_blocks, block_size, Hkv, D]``
+  * the KV cache is two arrays ``[L, Hkv, num_blocks, block_size, D]``
+    (head-major so each (head, page) is one contiguous DMA tile)
     threaded through scan functionally and **donated** by the engine's jit,
     so XLA updates it in place in HBM;
   * attention reads the cache through block tables (paged), masks do the
@@ -82,7 +83,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 def init_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=None
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim)
     dt = dtype or _dtype(cfg)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
@@ -201,7 +202,11 @@ def prefill(
 # ---------------- batched decode step ----------------
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_pallas"),
+    donate_argnames=("k_cache", "v_cache"),
+)
 def decode_step(
     params: dict,
     cfg: ModelConfig,
@@ -211,6 +216,7 @@ def decode_step(
     seq_lens: jnp.ndarray,  # [B] length including the new token
     k_cache: jnp.ndarray,  # donated
     v_cache: jnp.ndarray,
+    use_pallas: bool = False,
 ):
     """One continuous-batching decode step for all active sequences."""
     inv_freq = _rope_freqs(cfg)
@@ -227,7 +233,9 @@ def decode_step(
         k = apply_rope(k, positions, inv_freq)
         kc = att.write_decode_token_to_cache(kc, k, block_tables, positions)
         vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
-        o = att.decode_attention_xla(q, kc, vc, block_tables, seq_lens, scale)
+        o = att.decode_attention(
+            q, kc, vc, block_tables, seq_lens, scale, use_pallas=use_pallas
+        )
         x = x + o.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])

@@ -4,21 +4,24 @@ The reference's equivalent lives inside the engines it wraps (vLLM's paged
 attention CUDA kernels); on TPU we own it. Two implementations with one
 interface:
 
-  * :func:`paged_attention_xla` — pure-XLA gather + dense attention.
+  * :func:`decode_attention_xla` et al — pure-XLA gather + dense attention.
     Correct everywhere (CPU tests, any TPU), and XLA fuses it acceptably
     for small batches.
   * a Pallas ragged kernel in :mod:`dynamo_tpu.ops.paged_attention_pallas`
-    (used automatically on TPU for decode when shapes allow).
+    (used on TPU for decode via the :func:`decode_attention` dispatcher).
 
 Cache layout (one array per K/V for all layers — a single sharded
 residency):
 
-    k_cache, v_cache: [num_layers, num_blocks, block_size, num_kv_heads, head_dim]
+    k_cache, v_cache: [num_layers, num_kv_heads, num_blocks, block_size, head_dim]
 
-sharded over the "tp" mesh axis on num_kv_heads. Block tables are
-[batch, max_blocks_per_seq] int32 indices into num_blocks; sequence length
-masks out unused tail positions. Static shapes throughout — batch, table
-width, and block count are fixed per compiled program (XLA requirement).
+The kv-head axis leads the page axes so one (head, page) is a contiguous
+``[block_size, head_dim]`` tile — the unit the Pallas kernel DMAs from HBM
+into VMEM — and the "tp" mesh axis shards on num_kv_heads. Block tables
+are [batch, max_blocks_per_seq] int32 indices into num_blocks; sequence
+length masks out unused tail positions. Static shapes throughout — batch,
+table width, and block count are fixed per compiled program (XLA
+requirement).
 """
 
 from __future__ import annotations
@@ -36,29 +39,56 @@ def repeat_kv(x: jnp.ndarray, n_rep: int, axis: int) -> jnp.ndarray:
     return jnp.repeat(x, n_rep, axis=axis)
 
 
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache_layer: jnp.ndarray,
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    scale: float,
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
+
+    ``use_pallas`` must be trace-static (the engine derives it from
+    backend + sharding: the Pallas path requires unsharded cache arrays —
+    sharded meshes go through shard_map in parallel/).
+    """
+    if use_pallas:
+        from .paged_attention_pallas import paged_decode_attention
+
+        return paged_decode_attention(
+            q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
+        )
+    return decode_attention_xla(
+        q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
+    )
+
+
 def decode_attention_xla(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence
-    k_cache_layer: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
-    v_cache_layer: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]
+    v_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]
     block_tables: jnp.ndarray,  # [B, M] int32
     seq_lens: jnp.ndarray,  # [B] int32 (includes the new token)
     scale: float,
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     M = block_tables.shape[1]
-    bs = k_cache_layer.shape[1]
-    Hkv = k_cache_layer.shape[2]
-    # gather blocks -> [B, M*bs, Hkv, D]
-    k = k_cache_layer[block_tables].reshape(B, M * bs, Hkv, D)
-    v = v_cache_layer[block_tables].reshape(B, M * bs, Hkv, D)
-    k = repeat_kv(k, H // Hkv, axis=2)
-    v = repeat_kv(v, H // Hkv, axis=2)
-    scores = jnp.einsum("bhd,bthd->bht", q * scale, k).astype(jnp.float32)
+    Hkv, _, bs, _ = k_cache_layer.shape
+    G = H // Hkv
+    # gather pages -> [Hkv, B, M*bs, D] (no repeat_kv materialization:
+    # grouped-query einsum keeps kv heads shared)
+    k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    qg = q.reshape(B, Hkv, G, D)
+    scores = jnp.einsum("bkgd,kbtd->bkgt", qg * scale, k).astype(jnp.float32)
     positions = jnp.arange(M * bs)[None, :]  # [1, T]
     mask = positions < seq_lens[:, None]  # [B, T]
-    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    return jnp.einsum("bht,bthd->bhd", probs, v)
+    out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
+    return out.reshape(B, H, D)
 
 
 def prefill_attention_xla(
@@ -87,7 +117,7 @@ def chunk_attention_with_cache_xla(
     q: jnp.ndarray,  # [T, H, D] chunk queries
     k_chunk: jnp.ndarray,  # [T, Hkv, D]
     v_chunk: jnp.ndarray,  # [T, Hkv, D]
-    k_cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D]
     v_cache_layer: jnp.ndarray,
     block_table: jnp.ndarray,  # [M] this sequence's blocks
     history_len: jnp.ndarray,  # scalar: tokens already in cache
@@ -99,15 +129,14 @@ def chunk_attention_with_cache_xla(
     prefix-cache reuse without recomputing cached blocks)."""
     T, H, D = q.shape
     M = block_table.shape[0]
-    bs = k_cache_layer.shape[1]
-    Hkv = k_chunk.shape[1]
-    k_hist = k_cache_layer[block_table].reshape(M * bs, Hkv, D)
-    v_hist = v_cache_layer[block_table].reshape(M * bs, Hkv, D)
-    k_all = jnp.concatenate([k_hist, k_chunk], axis=0)  # [M*bs+T, Hkv, D]
-    v_all = jnp.concatenate([v_hist, v_chunk], axis=0)
-    k_all = repeat_kv(k_all, H // Hkv, axis=1)
-    v_all = repeat_kv(v_all, H // Hkv, axis=1)
-    scores = jnp.einsum("thd,shd->hts", q * scale, k_all).astype(jnp.float32)
+    Hkv, _, bs, _ = k_cache_layer.shape
+    G = H // Hkv
+    k_hist = jnp.take(k_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
+    v_hist = jnp.take(v_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
+    k_all = jnp.concatenate([k_hist, k_chunk.swapaxes(0, 1)], axis=1)  # [Hkv, S, D]
+    v_all = jnp.concatenate([v_hist, v_chunk.swapaxes(0, 1)], axis=1)
+    qg = q.reshape(T, Hkv, G, D)
+    scores = jnp.einsum("tkgd,ksd->tkgs", qg * scale, k_all).astype(jnp.float32)
     S = M * bs + T
     q_pos = history_len + jnp.arange(T)  # absolute positions of queries
     kv_pos = jnp.concatenate([jnp.arange(M * bs), history_len + jnp.arange(T)])
@@ -119,13 +148,14 @@ def chunk_attention_with_cache_xla(
     )
     causal = q_pos[:, None] >= kv_pos[None, :]
     mask = causal & kv_valid[None, :]
-    scores = jnp.where(mask[None, :, :], scores, NEG_INF)
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
-    return jnp.einsum("hts,shd->thd", probs, v_all)
+    out = jnp.einsum("tkgs,ksd->tkgd", probs, v_all)
+    return out.reshape(T, H, D)
 
 
 def write_chunk_to_cache(
-    cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D]
     chunk: jnp.ndarray,  # [T, Hkv, D]
     block_table: jnp.ndarray,  # [M]
     start_pos: jnp.ndarray,  # scalar: first absolute position of the chunk
@@ -134,22 +164,22 @@ def write_chunk_to_cache(
     are routed to a sacrificial slot (last block's last position is
     overwritten by real data later or never read thanks to masking)."""
     T = chunk.shape[0]
-    bs = cache_layer.shape[1]
+    bs = cache_layer.shape[2]
     pos = start_pos + jnp.arange(T)
     blk = block_table[pos // bs]
     off = pos % bs
-    return cache_layer.at[blk, off].set(chunk)
+    return cache_layer.at[:, blk, off].set(chunk.swapaxes(0, 1))
 
 
 def write_decode_token_to_cache(
-    cache_layer: jnp.ndarray,  # [num_blocks, bs, Hkv, D]
+    cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D]
     token_kv: jnp.ndarray,  # [B, Hkv, D]
     block_tables: jnp.ndarray,  # [B, M]
     positions: jnp.ndarray,  # [B] absolute position of the new token
 ) -> jnp.ndarray:
-    bs = cache_layer.shape[1]
+    bs = cache_layer.shape[2]
     blk = jnp.take_along_axis(
         block_tables, (positions // bs)[:, None], axis=1
     )[:, 0]
     off = positions % bs
-    return cache_layer.at[blk, off].set(token_kv)
+    return cache_layer.at[:, blk, off].set(token_kv.swapaxes(0, 1))

@@ -104,11 +104,11 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 
 
 def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
-    """[L, num_blocks, block_size, Hkv, D]: shard kv heads over tp when
+    """[L, Hkv, num_blocks, block_size, D]: shard kv heads over tp when
     divisible, else replicate that axis."""
     tp = mesh.shape["tp"]
     if cfg.num_kv_heads % tp == 0:
-        return NamedSharding(mesh, P(None, None, None, "tp", None))
+        return NamedSharding(mesh, P(None, "tp", None, None, None))
     return NamedSharding(mesh, P(None, None, None, None, None))
 
 
