@@ -67,6 +67,7 @@ class BlockAllocator:
         block_size: int,
         on_stored: Optional[Callable[[Block, Optional[int]], None]] = None,
         on_removed: Optional[Callable[[list[int]], None]] = None,
+        on_evict: Optional[Callable[[int, Block], None]] = None,
     ):
         """``num_blocks`` includes the reserved trash block 0."""
         self.num_blocks = num_blocks
@@ -79,6 +80,9 @@ class BlockAllocator:
         self._reuse: OrderedDict[int, int] = OrderedDict()  # seq_hash -> idx
         self.on_stored = on_stored
         self.on_removed = on_removed
+        # fired when a reuse-pool block is about to be repurposed — the
+        # offload tier's chance to copy it down (engine/offload.py)
+        self.on_evict = on_evict
 
     # ---- stats ----
     @property
@@ -101,6 +105,8 @@ class BlockAllocator:
             # evict LRU from the reuse pool
             seq_hash, idx = self._reuse.popitem(last=False)
             b = self._blocks[idx]
+            if self.on_evict:
+                self.on_evict(seq_hash, b)
             if self.on_removed:
                 self.on_removed([seq_hash])
             b.seq_hash = None
@@ -110,11 +116,19 @@ class BlockAllocator:
         b.ref_count = 1
         return b
 
-    def match_prefix(self, tokens: Sequence[int]) -> list[Block]:
+    def match_prefix(
+        self,
+        tokens: Sequence[int],
+        hashes: Optional[list[tuple[int, int]]] = None,
+    ) -> list[Block]:
         """Longest chain of cached full blocks matching the token prefix.
-        Claims refs on the matched blocks (caller owns them)."""
+        Claims refs on the matched blocks (caller owns them). ``hashes``
+        may carry precomputed ``sequence_block_hashes(tokens, block_size)``
+        to avoid re-hashing."""
         matched: list[Block] = []
-        for _local, seq_hash in sequence_block_hashes(tokens, self.block_size):
+        if hashes is None:
+            hashes = sequence_block_hashes(tokens, self.block_size)
+        for _local, seq_hash in hashes:
             idx = self._by_hash.get(seq_hash)
             if idx is None and seq_hash in self._reuse:
                 idx = self._reuse.pop(seq_hash)

@@ -38,6 +38,7 @@ from ..parallel.mesh import MeshConfig, cache_sharding, make_mesh, shard_params
 from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from ..runtime.engine import AsyncEngine, Context
 from .allocator import Block, BlockAllocator, sequence_block_hashes
+from .offload import OffloadManager
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +62,9 @@ class EngineConfig:
     prefill_chunk: int = 2048
     mesh: Optional[MeshConfig] = None
     max_queue: int = 1024
+    # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
+    # device blocks park here and restore on prefix hits (engine/offload.py)
+    host_cache_blocks: int = 0
 
     def __post_init__(self):
         if self.max_context == 0:
@@ -114,6 +118,10 @@ class JaxEngine(AsyncEngine):
             k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
+        self.offload: Optional[OffloadManager] = None
+        if cfg.host_cache_blocks > 0:
+            self.offload = OffloadManager(cfg.host_cache_blocks)
+            self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
         # Pallas decode path: TPU backend, unsharded cache, aligned tiles
         # (the sharded-mesh pallas path goes through shard_map — see
         # parallel/; until then meshes use the XLA fallback).
@@ -192,7 +200,10 @@ class JaxEngine(AsyncEngine):
 
     def load_metrics(self) -> dict:
         """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
-        return {
+        out = {}
+        if self.offload is not None:
+            out.update(self.offload.stats())
+        return out | {
             "kv_active_blocks": self.allocator.used_count,
             "kv_total_blocks": self.allocator.num_blocks - 1,
             "gpu_cache_usage_perc": self.allocator.usage(),
@@ -268,8 +279,19 @@ class JaxEngine(AsyncEngine):
         prompt = seq.tokens
         # prefix-cache match on full blocks, but always recompute the final
         # token so prefill yields fresh last-position logits
-        matched = self.allocator.match_prefix(prompt[: len(prompt) - 1])
-        history = len(matched) * bs
+        all_hashes = sequence_block_hashes(prompt[: len(prompt) - 1], bs)
+        matched = self.allocator.match_prefix(
+            prompt[: len(prompt) - 1], hashes=all_hashes
+        )
+        # host-tier probe: continuation of the chain past the device match
+        # (ref docs/kv_cache_manager.md host offload); reserving takes the
+        # blocks out of the pool so they can't be LRU'd before restore
+        restore_hashes: list[int] = []
+        restore_data: list = []
+        if self.offload is not None:
+            tail = [s for _l, s in all_hashes[len(matched) :]]
+            restore_hashes, restore_data = self.offload.reserve_chain(tail)
+        history = (len(matched) + len(restore_hashes)) * bs
         seq.cached_prefix = history
         self.stats["prefix_cache_hits_tokens"] += history
         # blocks needed to cover prompt + some decode headroom
@@ -280,26 +302,50 @@ class JaxEngine(AsyncEngine):
         fresh = self.allocator.allocate(fresh_needed)
         if fresh is None:
             self.allocator.free(matched)
+            if self.offload is not None and restore_hashes:
+                self.offload.unreserve(restore_hashes, restore_data)
             seq.cached_prefix = 0
             return False
         seq.blocks = matched + fresh
         seq.committed = len(matched)
         seq.parent_hash = matched[-1].seq_hash if matched else None
+        restore_idxs = [b.idx for b in fresh[: len(restore_hashes)]]
 
         # device work (jit dispatch + compile + host sync) runs in a worker
         # thread so lease keepalives / bus traffic stay live on the loop
-        first_token = await asyncio.get_running_loop().run_in_executor(
-            None, self._prefill_device, seq, history
-        )
+        try:
+            first_token = await asyncio.get_running_loop().run_in_executor(
+                None, self._prefill_device, seq, history, restore_data, restore_idxs
+            )
+        except Exception:
+            # device failure: hand reserved host blocks back so the prefix
+            # isn't silently lost from the offload tier (host arrays are
+            # never mutated, so re-pooling is safe even mid-restore)
+            if self.offload is not None and restore_hashes:
+                self.offload.unreserve(restore_hashes, restore_data)
+            raise
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token)
         if not seq.finished:
             self._place_in_batch(seq)
         return True
 
-    def _prefill_device(self, seq: _Sequence, history: int) -> int:
+    def _prefill_device(
+        self,
+        seq: _Sequence,
+        history: int,
+        restore_data: Optional[list] = None,
+        restore_idxs: Optional[list[int]] = None,
+    ) -> int:
         """Runs in an executor thread: chunked prefill + first-token sample."""
         cfg = self.cfg
+        if self.offload is not None:
+            # d2h evicted blocks before their pages get overwritten below
+            self.offload.flush_evictions(self.k_cache, self.v_cache)
+            if restore_data:
+                self.k_cache, self.v_cache = self.offload.restore(
+                    self.k_cache, self.v_cache, restore_data, restore_idxs
+                )
         prompt = seq.tokens
         table = self._table_for(seq)
         logits = None
@@ -407,6 +453,8 @@ class JaxEngine(AsyncEngine):
     def _decode_device(self, steps: np.ndarray) -> np.ndarray:
         """Runs in an executor thread: one decode step + sampling."""
         cfg = self.cfg
+        if self.offload is not None:
+            self.offload.flush_evictions(self.k_cache, self.v_cache)
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
         logits, self.k_cache, self.v_cache = llama.decode_step(
             self.params,

@@ -136,6 +136,8 @@ class PrefixIndex:
                 cnode = self._nodes.get(child)
                 if cnode is not None:
                     stack.append(cnode)
+            for w in n.workers:  # keep per-worker bookkeeping in sync
+                self._by_worker[w].discard(n.block_hash)
             self._nodes.pop(n.block_hash, None)
 
     def remove_worker(self, worker_id: int) -> None:

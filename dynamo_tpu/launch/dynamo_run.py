@@ -107,6 +107,7 @@ def build_core_engine(args, cfg: ModelConfig, params) -> AsyncEngine:
             max_batch_size=args.max_batch,
             max_context=args.max_context or 0,
             mesh=MeshConfig(tp=args.tp) if args.tp > 1 else None,
+            host_cache_blocks=args.host_cache_blocks,
         )
         return JaxEngine(ecfg, params=params)
     raise SystemExit(f"unknown out= engine {args.out!r}")
@@ -326,6 +327,8 @@ def main(argv=None) -> None:
     p.add_argument("--router", default="round_robin",
                    choices=["round_robin", "random", "kv"])
     p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--host-cache-blocks", type=int, default=0,
+                   help="host-DRAM KV offload tier capacity (blocks; 0=off)")
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-context", type=int, default=0)
