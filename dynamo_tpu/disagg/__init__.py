@@ -1,0 +1,38 @@
+"""Disaggregated prefill/decode serving (ref docs/disagg_serving.md:5-101).
+
+The reference's flagship capability, rebuilt TPU-native:
+
+* ``protocols``  — RemotePrefillRequest and the disagg config schema
+  (ref vllm patch remote_prefill.py:3584-3645, disagg_router.rs:25).
+* ``router``     — conditional disaggregation: local vs remote prefill
+  decision from prompt length / prefix-hit / queue depth, with the
+  config hot-reloaded from a control-plane store watch
+  (ref lib/llm/src/disagg_router.rs:25-135, examples worker.py:151-171).
+* ``queue``      — prefill work queue with ack + redelivery
+  (ref examples/llm/utils/prefill_queue.py, JetStream work-queue).
+* ``transfer``   — the KV data plane. No RDMA one-sided writes on TPU:
+  prefill gathers the computed KV blocks on device, ships them
+  layer-chunked over a TCP stream (two-part codec frames) to the decode
+  host, which scatters them into its own paged cache (ref NIXL path,
+  patch:811-1216; kv_rearrange for layout mismatch).
+* ``worker``     — PrefillWorker (queue consumer) + DisaggEngine (the
+  decode-side AsyncEngine that orchestrates remote prefill).
+"""
+
+from .protocols import DisaggConfig, RemotePrefillRequest
+from .queue import PrefillQueue
+from .router import ConditionalDisaggRouter
+from .transfer import KvTransferServer, LocalKvPipe, send_kv_blocks
+from .worker import DisaggEngine, PrefillWorker
+
+__all__ = [
+    "ConditionalDisaggRouter",
+    "DisaggConfig",
+    "DisaggEngine",
+    "KvTransferServer",
+    "LocalKvPipe",
+    "PrefillQueue",
+    "PrefillWorker",
+    "RemotePrefillRequest",
+    "send_kv_blocks",
+]

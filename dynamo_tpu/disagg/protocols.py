@@ -1,0 +1,70 @@
+"""Disaggregation wire types.
+
+RemotePrefillRequest mirrors the reference's
+``vllm/remote_prefill.py`` (patch:3584-3645): everything a prefill
+worker needs to compute the prompt's KV and the first token, plus where
+to deliver the result. ``skip_blocks`` carries the decode side's local
+prefix-cache hit so only the uncached tail of the KV is shipped (the
+reference instead RDMA-reads prefix-hit blocks from the decode worker —
+same bytes saved, inverted direction).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+
+@dataclass
+class RemotePrefillRequest:
+    request_id: str
+    # the full PreprocessedRequest as a dict (token_ids, sampling, stops)
+    request: dict
+    # decode-side blocks already holding the first `skip_blocks` prompt
+    # blocks (prefix-cache hit) — transfer starts after them
+    skip_blocks: int
+    # where the prefill worker delivers KV + first token:
+    # ConnectionInfo dict of the decode host's KvTransferServer
+    connection: dict
+    # decode engine identity (diagnostics / metrics)
+    engine_id: int = 0
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disaggregation policy knobs
+    (ref DisaggRouterConf, disagg_router.rs:25; docs/disagg_serving.md:46-52).
+
+    A prompt goes to a remote prefill worker when its *uncached* prefill
+    length exceeds ``max_local_prefill_length`` — unless the prefill
+    queue is so deep that waiting would cost more than computing locally.
+    """
+
+    max_local_prefill_length: int = 512
+    # remote prefill disabled above this queue depth (0 = no limit)
+    max_prefill_queue_size: int = 0
+    enabled: bool = True
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, raw) -> "DisaggConfig":
+        if isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode()
+        d = json.loads(raw)
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+def disagg_config_key(namespace: str, model: str) -> str:
+    """Store key for the hot-reloadable policy
+    (ref etcd path ``public/components/disagg_router/models/chat/{model}``)."""
+    return f"{namespace}/components/disagg_router/models/{model}"

@@ -1,0 +1,245 @@
+"""KV block transfer plane — the TPU-native stand-in for NIXL RDMA
+(ref patch:811-1216 nixl.py, utils/nixl.py, docs/disagg_serving.md:58-91).
+
+XLA exposes no one-sided remote writes, so the protocol is inverted into
+a push stream: the prefill worker gathers the computed KV blocks on
+device ([L, Hkv, n, bs, D] stacks, one d2h fetch), then ships them over
+a TCP connection to the decode host **layer-chunked** — frame i carries
+layers [i*c, (i+1)*c) of both K and V — so the wire transfer of layer
+chunk i overlaps the serialization of chunk i+1, the same overlap the
+reference gets from per-layer CUDA-stream triggered copies
+(kv/layer.rs:619-1132). The decode side reassembles and scatters into
+its own paged cache with a donated jit scatter.
+
+Frames use the runtime's two-part codec (header JSON + raw bytes), the
+same framing as the response plane. In-process prefill→decode (both
+engines in one process, e.g. two meshes on one host) short-circuits
+through ``LocalKvPipe`` — no serialization at all.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..runtime.codec import TwoPartMessage, read_frame, write_frame
+from ..runtime.tcp import ConnectionInfo
+
+logger = logging.getLogger(__name__)
+
+_DTYPES = {}
+
+
+def _np_dtype(name: str):
+    """dtype registry incl. bfloat16 (ml_dtypes ships with jax)."""
+    if not _DTYPES:
+        import ml_dtypes
+
+        _DTYPES.update(
+            {
+                "bfloat16": np.dtype(ml_dtypes.bfloat16),
+                "float32": np.dtype(np.float32),
+                "float16": np.dtype(np.float16),
+                "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+                "int8": np.dtype(np.int8),
+            }
+        )
+    return _DTYPES[name]
+
+
+@dataclass
+class KvDelivery:
+    """What the decode side receives for one remote-prefilled request."""
+
+    request_id: str
+    first_token: int
+    n_blocks: int
+    # [L, Hkv, n_blocks, bs, D] host arrays (None when n_blocks == 0)
+    k_data: Optional[np.ndarray]
+    v_data: Optional[np.ndarray]
+    error: Optional[str] = None
+
+
+class KvTransferServer:
+    """Decode-side listener. ``expect(request_id)`` registers a pending
+    delivery and returns (ConnectionInfo, future); the prefill worker
+    connects back with the data (mirror of the response plane's
+    connect-back handshake, tcp/server.rs:74)."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        advertise_host: Optional[str] = None,
+    ):
+        self._host = host
+        self._port = port
+        self._advertise = advertise_host
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pending: dict[str, asyncio.Future] = {}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+
+    @property
+    def address(self) -> ConnectionInfo:
+        """The ADVERTISED address, shipped to prefill workers — must be
+        routable from their hosts, not the bind address (which may be
+        0.0.0.0)."""
+        host = self._advertise
+        if not host:
+            host = self._host
+            if host in ("0.0.0.0", "::"):
+                import socket
+
+                host = socket.gethostbyname(socket.gethostname())
+        return ConnectionInfo(f"{host}:{self._port}", "kv")
+
+    async def close(self) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        return fut
+
+    def abandon(self, request_id: str) -> None:
+        fut = self._pending.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        fut: Optional[asyncio.Future] = None
+        try:
+            frame = await read_frame(reader)
+            if frame is None:
+                return
+            head = json.loads(frame.header)
+            req_id = head["request_id"]
+            fut = self._pending.pop(req_id, None)
+            if head.get("error"):
+                if fut is not None and not fut.done():
+                    fut.set_result(
+                        KvDelivery(req_id, -1, 0, None, None, error=head["error"])
+                    )
+                return
+            n = head["n_blocks"]
+            shape = tuple(head["shape"])  # [L, Hkv, n, bs, D]
+            dt = _np_dtype(head["dtype"])
+            layer_chunk = head["layer_chunk"]
+            L = shape[0]
+            k = np.empty(shape, dt) if n else None
+            v = np.empty(shape, dt) if n else None
+            l0 = 0
+            while l0 < L and n:
+                part = await read_frame(reader)
+                if part is None:
+                    raise ConnectionError("kv stream truncated")
+                l1 = min(l0 + layer_chunk, L)
+                blob = part.data
+                half = len(blob) // 2
+                sub = (l1 - l0,) + shape[1:]
+                k[l0:l1] = np.frombuffer(blob[:half], dt).reshape(sub)
+                v[l0:l1] = np.frombuffer(blob[half:], dt).reshape(sub)
+                l0 = l1
+            writer.write(b"ok")
+            await writer.drain()
+            if fut is not None and not fut.done():
+                fut.set_result(
+                    KvDelivery(req_id, head["first_token"], n, k, v)
+                )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("kv transfer receive failed")
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+        finally:
+            writer.close()
+
+
+async def send_kv_blocks(
+    connection: ConnectionInfo | dict,
+    request_id: str,
+    first_token: int,
+    k_data: Optional[np.ndarray],
+    v_data: Optional[np.ndarray],
+    layer_chunk: int = 4,
+    error: Optional[str] = None,
+) -> None:
+    """Prefill-side push of one request's KV (or an error notification)."""
+    if isinstance(connection, dict):
+        connection = ConnectionInfo.from_dict(connection)
+    host, port = connection.address.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        n = 0 if k_data is None else int(k_data.shape[2])
+        head = {
+            "request_id": request_id,
+            "first_token": int(first_token),
+            "n_blocks": n,
+            "shape": [] if k_data is None else list(k_data.shape),
+            "dtype": "" if k_data is None else str(k_data.dtype),
+            "layer_chunk": layer_chunk,
+            "error": error,
+        }
+        await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
+        if n:
+            L = k_data.shape[0]
+            for l0 in range(0, L, layer_chunk):
+                l1 = min(l0 + layer_chunk, L)
+                blob = k_data[l0:l1].tobytes() + v_data[l0:l1].tobytes()
+                await write_frame(
+                    writer, TwoPartMessage(b"", blob)
+                )
+        await writer.drain()
+        # wait for the receiver's ack so redelivery can't double-complete
+        await asyncio.wait_for(reader.read(2), timeout=30.0)
+    finally:
+        writer.close()
+
+
+class LocalKvPipe:
+    """In-process transfer: prefill and decode engines share the process
+    (two meshes / two engines on one host) — hand the arrays over
+    directly, zero copies on the host side."""
+
+    def __init__(self):
+        self._pending: dict[str, asyncio.Future] = {}
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        return fut
+
+    def abandon(self, request_id: str) -> None:
+        fut = self._pending.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+    async def deliver(
+        self,
+        request_id: str,
+        first_token: int,
+        k_data: Optional[np.ndarray],
+        v_data: Optional[np.ndarray],
+        error: Optional[str] = None,
+    ) -> None:
+        fut = self._pending.pop(request_id, None)
+        if fut is None or fut.done():
+            return
+        n = 0 if k_data is None else int(k_data.shape[2])
+        fut.set_result(KvDelivery(request_id, first_token, n, k_data, v_data, error))

@@ -1,0 +1,202 @@
+"""Disaggregated serving workers.
+
+``DisaggEngine`` is the decode-side AsyncEngine: per request it consults
+the ConditionalDisaggRouter; local prompts flow straight into the wrapped
+JaxEngine, long prompts are pre-allocated (begin_remote), enqueued on the
+PrefillQueue, and completed when the prefill worker's KV lands on the
+transfer plane (ref examples/llm/components/worker.py:45-189).
+
+``PrefillWorker`` is the queue consumer: prefill + first-token sample on
+its own engine/mesh, then push the KV to the requesting decode host
+(ref examples/llm/components/prefill_worker.py:84-141). Failures nack the
+item so it redelivers to another worker — elastic xPyD
+(docs/disagg_serving.md:93-101)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional, Union
+
+from ..engine.engine import JaxEngine, OutOfBlocks
+from ..protocols.common import LLMEngineOutput, PreprocessedRequest
+from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
+from .protocols import RemotePrefillRequest
+from .queue import PrefillQueue
+from .router import ConditionalDisaggRouter
+from .transfer import KvTransferServer, LocalKvPipe, send_kv_blocks
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillWorker:
+    def __init__(
+        self,
+        engine: JaxEngine,
+        queue: PrefillQueue,
+        local_pipe: Optional[LocalKvPipe] = None,
+        layer_chunk: int = 4,
+    ):
+        self.engine = engine
+        self.queue = queue
+        self.local_pipe = local_pipe
+        self.layer_chunk = layer_chunk
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.stats = {"prefills_total": 0, "prefill_errors": 0, "nacks": 0}
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def close(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                got = await self.queue.dequeue(timeout=0.5)
+                if got is None:
+                    continue
+                item_id, rpr = got
+                try:
+                    await self._process(rpr)
+                except OutOfBlocks:
+                    # pool full: hand the item back for another worker (or
+                    # ourselves, once running prefills free their blocks)
+                    self.stats["nacks"] += 1
+                    await self.queue.nack(item_id)
+                    await asyncio.sleep(0.05)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("remote prefill failed: %s", rpr.request_id)
+                    self.stats["prefill_errors"] += 1
+                    await self._notify_error(rpr, str(e))
+                await self.queue.ack(item_id)
+        except asyncio.CancelledError:
+            pass
+
+    async def _process(self, rpr: RemotePrefillRequest) -> None:
+        req = PreprocessedRequest.from_dict(rpr.request)
+        ctx = AsyncEngineContext(rpr.request_id)
+        first, k, v = await self.engine.prefill_extract(
+            req, ctx, skip_blocks=rpr.skip_blocks
+        )
+        self.stats["prefills_total"] += 1
+        if rpr.connection.get("local"):
+            assert self.local_pipe is not None, "local connection without pipe"
+            await self.local_pipe.deliver(rpr.request_id, first, k, v)
+        else:
+            await send_kv_blocks(
+                rpr.connection, rpr.request_id, first, k, v,
+                layer_chunk=self.layer_chunk,
+            )
+
+    async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
+        try:
+            if rpr.connection.get("local"):
+                if self.local_pipe is not None:
+                    await self.local_pipe.deliver(
+                        rpr.request_id, -1, None, None, error=message
+                    )
+            else:
+                await send_kv_blocks(
+                    rpr.connection, rpr.request_id, -1, None, None, error=message
+                )
+        except Exception:  # noqa: BLE001 — decode side also has a timeout
+            logger.exception("error notification failed: %s", rpr.request_id)
+
+
+class DisaggEngine(AsyncEngine):
+    """Decode-side conditional-disaggregation front (AsyncEngine over
+    PreprocessedRequest -> LLMEngineOutput stream)."""
+
+    def __init__(
+        self,
+        engine: JaxEngine,
+        router: ConditionalDisaggRouter,
+        queue: PrefillQueue,
+        transfer: Union[KvTransferServer, LocalKvPipe],
+        engine_id: int = 0,
+        transfer_timeout: float = 120.0,
+    ):
+        self.engine = engine
+        self.router = router
+        self.queue = queue
+        self.transfer = transfer
+        self.engine_id = engine_id
+        self.transfer_timeout = transfer_timeout
+        self.stats = {"remote_prefills": 0, "local_prefills": 0, "remote_errors": 0}
+
+    def _connection(self) -> dict:
+        if isinstance(self.transfer, LocalKvPipe):
+            return {"local": True}
+        return self.transfer.address.to_dict()
+
+    async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
+        req = request.data
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_dict(req)
+            request = request.transfer(req)
+        prompt_len = len(req.token_ids or [])
+        handle = None
+        remote = False
+        if self.router.config.enabled and prompt_len:
+            handle = self.engine.begin_remote(request)
+        if handle is not None:
+            depth = await self.queue.get_depth()
+            remote = self.router.prefill_remote(
+                prompt_len, handle.seq.cached_prefix, depth
+            )
+        if not remote:
+            if handle is not None:
+                self.engine.release_remote(handle)
+            self.stats["local_prefills"] += 1
+            async for out in self.engine.generate(request):
+                yield out
+            return
+
+        self.stats["remote_prefills"] += 1
+        self.engine.start()
+        req_id = request.id
+        fut = self.transfer.expect(req_id)
+        rpr = RemotePrefillRequest(
+            request_id=req_id,
+            request=req.to_dict(),
+            skip_blocks=handle.skip_blocks,
+            connection=self._connection(),
+            engine_id=self.engine_id,
+        )
+        try:
+            await self.queue.enqueue(rpr)
+            delivery = await asyncio.wait_for(fut, self.transfer_timeout)
+        except asyncio.CancelledError:
+            # caller went away: clean up the reservation, propagate
+            self.transfer.abandon(req_id)
+            self.engine.abort_remote(handle, "cancelled")
+            raise
+        except Exception as e:  # noqa: BLE001 — timeout, enqueue or
+            # transfer-stream failure: blocks must return to the pool
+            self.transfer.abandon(req_id)
+            self.stats["remote_errors"] += 1
+            self.engine.abort_remote(handle, f"remote prefill failed: {e}")
+            yield await handle.seq.out_queue.get()
+            return
+        if delivery.error:
+            self.stats["remote_errors"] += 1
+            self.engine.abort_remote(handle, delivery.error)
+            yield await handle.seq.out_queue.get()
+            return
+        out_queue = await self.engine.complete_remote(
+            handle, delivery.first_token, delivery.k_data, delivery.v_data
+        )
+        while True:
+            out = await out_queue.get()
+            if out is None:
+                return
+            yield out
+            if out.is_final():
+                return

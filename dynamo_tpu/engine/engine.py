@@ -74,6 +74,11 @@ class EngineConfig:
         ) // self.block_size
 
 
+class OutOfBlocks(Exception):
+    """KV pool exhausted — caller should backpressure/retry (the prefill
+    queue nacks the item so another worker, or this one later, retries)."""
+
+
 @dataclass
 class _Sequence:
     request: PreprocessedRequest
@@ -132,9 +137,15 @@ class JaxEngine(AsyncEngine):
             and cfg.block_size % 8 == 0
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
+        # remotely-prefilled sequences with KV landed, awaiting a batch slot
+        self._remote_ready: list[_Sequence] = []
         self._active: list[Optional[_Sequence]] = [None] * cfg.max_batch_size
         self._n_active = 0
         self._loop_task: Optional[asyncio.Task] = None
+        # serializes device-state mutation (k/v cache is donated through
+        # every jit call — concurrent dispatch would use freed buffers);
+        # contended only when disagg hooks run beside the decode loop
+        self._device_lock = asyncio.Lock()
         self._wake = asyncio.Event()
         self._closed = False
         # host mirrors of device-side batch state
@@ -232,11 +243,12 @@ class JaxEngine(AsyncEngine):
             logger.exception("engine loop crashed")
             # fail every request we own — active, and still-waiting (their
             # generate() coroutines block on out_queue otherwise)
-            for seq in self._active:
+            for seq in self._active + self._remote_ready:
                 if seq is not None:
                     seq.out_queue.put_nowait(
                         LLMEngineOutput(finish_reason=FinishReason.ERROR)
                     )
+            self._remote_ready.clear()
             while not self._waiting.empty():
                 seq = self._waiting.get_nowait()
                 seq.out_queue.put_nowait(
@@ -247,6 +259,15 @@ class JaxEngine(AsyncEngine):
 
     async def _admit(self) -> bool:
         admitted = False
+        while self._remote_ready and self._n_active < self.cfg.max_batch_size:
+            seq = self._remote_ready.pop(0)
+            if seq.finished:
+                continue
+            if seq.context.is_stopped():
+                self._finish(seq, FinishReason.CANCELLED)
+                continue
+            self._place_in_batch(seq)
+            admitted = True
         while self._n_active < self.cfg.max_batch_size and not self._waiting.empty():
             seq = self._waiting.get_nowait()
             if seq.context.is_stopped():
@@ -273,12 +294,19 @@ class JaxEngine(AsyncEngine):
         self.stats["requests_waiting"] = self._waiting.qsize()
         return admitted
 
-    async def _try_prefill(self, seq: _Sequence) -> bool:
+    def _reserve_for_prompt(self, seq: _Sequence, probe_host: bool = False):
+        """The one allocation protocol shared by local prefill, remote
+        prefill (worker side) and remote decode (decode side): match the
+        device prefix cache on the prompt's full blocks (always recompute
+        the final token so prefill yields fresh last-position logits),
+        optionally probe the host offload tier for the chain's
+        continuation, then allocate fresh blocks for prompt + decode
+        headroom. Populates seq.{blocks,committed,parent_hash,
+        cached_prefix}; returns (history, restore_hashes, restore_data,
+        restore_idxs) or None with every claim rolled back."""
         cfg = self.cfg
         bs = cfg.block_size
         prompt = seq.tokens
-        # prefix-cache match on full blocks, but always recompute the final
-        # token so prefill yields fresh last-position logits
         all_hashes = sequence_block_hashes(prompt[: len(prompt) - 1], bs)
         matched = self.allocator.match_prefix(
             prompt[: len(prompt) - 1], hashes=all_hashes
@@ -288,35 +316,40 @@ class JaxEngine(AsyncEngine):
         # blocks out of the pool so they can't be LRU'd before restore
         restore_hashes: list[int] = []
         restore_data: list = []
-        if self.offload is not None:
+        if probe_host and self.offload is not None:
             tail = [s for _l, s in all_hashes[len(matched) :]]
             restore_hashes, restore_data = self.offload.reserve_chain(tail)
-        history = (len(matched) + len(restore_hashes)) * bs
-        seq.cached_prefix = history
-        self.stats["prefix_cache_hits_tokens"] += history
-        # blocks needed to cover prompt + some decode headroom
         total_needed = min(
             (len(prompt) + bs) // bs + 1, cfg.max_blocks_per_seq
         )
-        fresh_needed = max(0, total_needed - len(matched))
-        fresh = self.allocator.allocate(fresh_needed)
+        fresh = self.allocator.allocate(max(0, total_needed - len(matched)))
         if fresh is None:
             self.allocator.free(matched)
             if self.offload is not None and restore_hashes:
                 self.offload.unreserve(restore_hashes, restore_data)
-            seq.cached_prefix = 0
-            return False
+            return None
         seq.blocks = matched + fresh
         seq.committed = len(matched)
         seq.parent_hash = matched[-1].seq_hash if matched else None
+        history = (len(matched) + len(restore_hashes)) * bs
+        seq.cached_prefix = history
         restore_idxs = [b.idx for b in fresh[: len(restore_hashes)]]
+        return history, restore_hashes, restore_data, restore_idxs
+
+    async def _try_prefill(self, seq: _Sequence) -> bool:
+        reserved = self._reserve_for_prompt(seq, probe_host=True)
+        if reserved is None:
+            return False
+        history, restore_hashes, restore_data, restore_idxs = reserved
+        self.stats["prefix_cache_hits_tokens"] += history
 
         # device work (jit dispatch + compile + host sync) runs in a worker
         # thread so lease keepalives / bus traffic stay live on the loop
         try:
-            first_token = await asyncio.get_running_loop().run_in_executor(
-                None, self._prefill_device, seq, history, restore_data, restore_idxs
-            )
+            async with self._device_lock:
+                first_token = await asyncio.get_running_loop().run_in_executor(
+                    None, self._prefill_device, seq, history, restore_data, restore_idxs
+                )
         except Exception:
             # device failure: hand reserved host blocks back so the prefix
             # isn't silently lost from the offload tier (host arrays are
@@ -436,9 +469,10 @@ class JaxEngine(AsyncEngine):
              for i in range(cfg.max_batch_size)],
             np.int32,
         )
-        toks_host = await asyncio.get_running_loop().run_in_executor(
-            None, self._decode_device, steps
-        )
+        async with self._device_lock:
+            toks_host = await asyncio.get_running_loop().run_in_executor(
+                None, self._decode_device, steps
+            )
         self.stats["decode_steps"] += 1
         for i in active_slots:
             seq = self._active[i]
@@ -543,3 +577,169 @@ class JaxEngine(AsyncEngine):
                 seq.blocks[i], tokens, seq.parent_hash
             )
             seq.committed += 1
+
+    # ---------------- disaggregation hooks ----------------
+    # (ref docs/disagg_serving.md:58-91; vllm patch remote-prefill states)
+
+    def n_prompt_blocks(self, prompt_len: int) -> int:
+        bs = self.cfg.block_size
+        return (prompt_len + bs - 1) // bs
+
+    async def prefill_extract(
+        self, req: PreprocessedRequest, context, skip_blocks: int = 0
+    ) -> tuple[int, Optional[np.ndarray], Optional[np.ndarray]]:
+        """Prefill-worker side: compute the prompt's KV (with this worker's
+        own prefix cache), sample the first token (max_tokens=1 semantics,
+        ref prefill_worker.py:109-137), and return host copies of the
+        prompt's KV blocks after ``skip_blocks`` (the decode side's
+        prefix hit). Blocks are committed to the reuse pool before being
+        freed, so repeated prefixes stay warm on the prefill worker."""
+        prompt = list(req.token_ids)
+        seq = _Sequence(
+            request=req,
+            context=context,
+            out_queue=asyncio.Queue(),
+            tokens=prompt,
+            prompt_len=len(prompt),
+        )
+        reserved = self._reserve_for_prompt(seq)
+        if reserved is None:
+            raise OutOfBlocks(f"cannot cover {len(prompt)}-token prompt")
+        history = reserved[0]
+        self.stats["prefix_cache_hits_tokens"] += history
+        try:
+            async with self._device_lock:
+                first_token = await asyncio.get_running_loop().run_in_executor(
+                    None, self._prefill_device, seq, history
+                )
+                n_prompt = self.n_prompt_blocks(len(prompt))
+                idxs = [b.idx for b in seq.blocks[skip_blocks:n_prompt]]
+                if idxs:
+                    k_np, v_np = await asyncio.get_running_loop().run_in_executor(
+                        None, self._gather_device, idxs
+                    )
+                else:
+                    k_np = v_np = None
+            self._commit_full_blocks(seq)
+        finally:
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+        return first_token, k_np, v_np
+
+    def _gather_device(self, idxs: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        from .offload import _gather_blocks, _pad_idxs
+
+        padded = _pad_idxs(idxs)
+        k, v = _gather_blocks(self.k_cache, self.v_cache, jnp.asarray(padded))
+        k = np.asarray(jax.device_get(k))[:, :, : len(idxs)]
+        v = np.asarray(jax.device_get(v))[:, :, : len(idxs)]
+        return k, v
+
+    def begin_remote(self, request: Context) -> Optional["RemoteHandle"]:
+        """Decode side, before enqueueing a remote prefill: match the local
+        prefix cache and pre-allocate the sequence's blocks (the reference
+        allocates decode blocks up front and ships their ids in
+        RemotePrefillRequest). Returns None when the pool can't cover the
+        request — caller falls back to local serving's backpressure."""
+        req: PreprocessedRequest = request.data
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_dict(req)
+        prompt = list(req.token_ids)
+        if not prompt or len(prompt) >= self.cfg.max_context:
+            return None
+        seq = _Sequence(
+            request=req,
+            context=request.context,
+            out_queue=asyncio.Queue(),
+            tokens=prompt,
+            prompt_len=len(prompt),
+        )
+        if self._reserve_for_prompt(seq) is None:
+            return None
+        self.stats["requests_total"] += 1
+        return RemoteHandle(
+            seq=seq,
+            skip_blocks=seq.committed,
+            n_prompt_blocks=self.n_prompt_blocks(len(prompt)),
+        )
+
+    def release_remote(self, handle: "RemoteHandle") -> None:
+        """Local-prefill fallback chosen after begin_remote: return the
+        blocks untouched (no output emitted; caller re-submits locally)."""
+        self.stats["requests_total"] -= 1
+        self.allocator.free(handle.seq.blocks)
+        handle.seq.blocks = []
+
+    async def complete_remote(
+        self,
+        handle: "RemoteHandle",
+        first_token: int,
+        k_data: Optional[np.ndarray],
+        v_data: Optional[np.ndarray],
+    ) -> asyncio.Queue:
+        """KV landed from the prefill worker: scatter it into the
+        pre-allocated pages, register the sequence for continuous-batching
+        decode, emit the (already sampled) first token."""
+        seq = handle.seq
+        if k_data is not None and k_data.shape[2]:
+            n = int(k_data.shape[2])
+            idxs = [
+                b.idx
+                for b in seq.blocks[handle.skip_blocks : handle.skip_blocks + n]
+            ]
+            async with self._device_lock:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._scatter_device, idxs, k_data, v_data
+                )
+        self.stats["prefix_cache_hits_tokens"] += seq.cached_prefix
+        self._emit_token(seq, first_token)
+        if not seq.finished:
+            self._commit_full_blocks(seq)
+            self._remote_ready.append(seq)
+            self._wake.set()
+        return seq.out_queue
+
+    def abort_remote(self, handle: "RemoteHandle", message: str = "") -> None:
+        seq = handle.seq
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        seq.finished = True
+        seq.out_queue.put_nowait(
+            LLMEngineOutput(finish_reason=FinishReason.ERROR, text=message or None)
+        )
+
+    def _scatter_device(
+        self, idxs: list[int], k_data: np.ndarray, v_data: np.ndarray
+    ) -> None:
+        from .offload import _bucket, _pad_idxs, _scatter_blocks
+
+        if self.offload is not None:
+            # pending evictions may reference the very pages we're about to
+            # overwrite — snapshot them to the host tier first
+            self.offload.flush_evictions(self.k_cache, self.v_cache)
+        n = len(idxs)
+        padded = _pad_idxs(idxs)
+        if len(padded) != n:
+            shape = list(k_data.shape)
+            shape[2] = _bucket(n)
+            k_pad = np.zeros(shape, k_data.dtype)
+            v_pad = np.zeros(shape, v_data.dtype)
+            k_pad[:, :, :n] = k_data
+            v_pad[:, :, :n] = v_data
+            k_data, v_data = k_pad, v_pad
+        self.k_cache, self.v_cache = _scatter_blocks(
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(padded),
+            jnp.asarray(k_data),
+            jnp.asarray(v_data),
+        )
+
+
+@dataclass
+class RemoteHandle:
+    """A decode-side reservation for a remotely-prefilled sequence."""
+
+    seq: _Sequence
+    skip_blocks: int
+    n_prompt_blocks: int

@@ -169,13 +169,44 @@ async def run_endpoint(args) -> None:
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
     drt = await connect_runtime(args)
-    engine = OpenAIWorkerEngine(tokenizer, core)
-    stats = core.load_metrics if isinstance(core, JaxEngine) else (lambda: {})
+    jax_core = core if isinstance(core, JaxEngine) else None
+    if args.disagg == "decode":
+        # conditional disaggregation: long uncached prompts offload to
+        # prefill workers via the queue + KV transfer plane (disagg/)
+        from ..disagg import (
+            ConditionalDisaggRouter, DisaggConfig, DisaggEngine,
+            KvTransferServer, PrefillQueue,
+        )
+
+        assert jax_core is not None, "--disagg decode requires out=jax"
+        transfer = KvTransferServer(
+            host=args.host, advertise_host=args.advertise_host
+        )
+        await transfer.start()
+        disagg_router = ConditionalDisaggRouter(
+            drt, ns, name,
+            DisaggConfig(max_local_prefill_length=args.max_local_prefill),
+        )
+        await disagg_router.start()
+        # queue is named by the endpoint's namespace — prefill workers must
+        # run with --namespace <same> (run_prefill prints the queue name)
+        queue = PrefillQueue(drt.bus, ns)
+        disagg_engine = DisaggEngine(
+            jax_core, disagg_router, queue, transfer,
+            engine_id=drt.primary_lease_id,
+        )
+        engine = OpenAIWorkerEngine(tokenizer, disagg_engine)
+        stats = lambda: (  # noqa: E731
+            jax_core.load_metrics() | jax_core.stats | disagg_engine.stats
+        )
+    else:
+        engine = OpenAIWorkerEngine(tokenizer, core)
+        stats = jax_core.load_metrics if jax_core else (lambda: {})
     component = drt.namespace(ns).component(comp)
-    if isinstance(core, JaxEngine):
+    if jax_core is not None:
         from ..kv_router import KvEventPublisher
 
-        KvEventPublisher(drt, component, drt.primary_lease_id).attach(core.allocator)
+        KvEventPublisher(drt, component, drt.primary_lease_id).attach(jax_core.allocator)
     await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
@@ -189,6 +220,25 @@ async def run_endpoint(args) -> None:
     refresher = MdcRefresher(drt.bus, card)
     refresher.start()
     print(f"worker {drt.worker_id:x} serving {name!r} at dyn://{target}", flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_prefill(args) -> None:
+    """Prefill-worker mode (`in=prefill`): consume the namespace's prefill
+    queue, compute KV + first token, push to the requesting decode worker
+    (ref examples/llm/components/prefill_worker.py)."""
+    from ..disagg import PrefillQueue, PrefillWorker
+
+    ns = args.namespace
+    cfg, params, _tokenizer, name = build_model(args)
+    core = build_core_engine(args, cfg, params)
+    assert isinstance(core, JaxEngine), "in=prefill requires out=jax"
+    drt = await connect_runtime(args)
+    queue = PrefillQueue(drt.bus, ns)
+    worker = PrefillWorker(core, queue)
+    worker.start()
+    print(f"prefill worker {drt.worker_id:x} serving {name!r} "
+          f"on queue {queue.name}", flush=True)
     await asyncio.Event().wait()
 
 
@@ -332,6 +382,16 @@ def main(argv=None) -> None:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-context", type=int, default=0)
+    p.add_argument("--namespace", default="dynamo",
+                   help="in=prefill queue namespace — must match the decode "
+                        "workers' dyn:// namespace")
+    p.add_argument("--advertise-host", default=None,
+                   help="routable address advertised for KV transfer "
+                        "connect-back (defaults to this host's IP)")
+    p.add_argument("--disagg", default=None, choices=[None, "decode"],
+                   help="decode: offload long prompts to prefill workers")
+    p.add_argument("--max-local-prefill", type=int, default=512,
+                   help="uncached prompt tokens above this go remote")
     args = p.parse_args(argv)
 
     args.in_ = "http"
@@ -356,6 +416,8 @@ def main(argv=None) -> None:
         coro = run_stdin(args)
     elif args.in_.startswith("batch:"):
         coro = run_batch(args, args.in_[len("batch:"):])
+    elif args.in_ == "prefill":
+        coro = run_prefill(args)
     elif args.in_.startswith("dyn://"):
         coro = run_endpoint(args)
     else:

@@ -1,0 +1,255 @@
+"""Disaggregated prefill/decode tests (ref docs/disagg_serving.md).
+
+End-to-end on the CPU mesh with tiny models: conditional routing,
+prefill queue semantics, the KV transfer plane (local pipe + TCP), and
+token-level equivalence between disaggregated and aggregated serving.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from dynamo_tpu.disagg import (
+    ConditionalDisaggRouter,
+    DisaggConfig,
+    DisaggEngine,
+    KvTransferServer,
+    LocalKvPipe,
+    PrefillQueue,
+    PrefillWorker,
+    RemotePrefillRequest,
+)
+from dynamo_tpu.disagg.transfer import send_kv_blocks
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import Context, DistributedRuntime, collect
+
+MODEL_CFG = ModelConfig.tiny()
+PARAMS = llama.init_params(MODEL_CFG, jax.random.key(7))
+
+
+def engine_cfg(**kw):
+    kw.setdefault("model", MODEL_CFG)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("prefill_chunk", 32)
+    return EngineConfig(**kw)
+
+
+def make_req(tokens, max_tokens=8):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0, seed=0),
+        eos_token_ids=[511],
+    )
+
+
+# ---------------- policy ----------------
+
+
+def test_disagg_config_roundtrip():
+    cfg = DisaggConfig(max_local_prefill_length=100, max_prefill_queue_size=4)
+    again = DisaggConfig.from_json(cfg.to_json())
+    assert again == cfg
+
+
+def test_disagg_decision_logic(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        r = ConditionalDisaggRouter(
+            drt, "dynamo", "m", DisaggConfig(max_local_prefill_length=512)
+        )
+        await r.start()
+        # short prompt local; long remote; cached prefix subtracts
+        assert not r.prefill_remote(100, 0, 0)
+        assert r.prefill_remote(1000, 0, 0)
+        assert not r.prefill_remote(1000, 600, 0)
+        # queue-depth cutoff
+        await r.update(DisaggConfig(max_local_prefill_length=512, max_prefill_queue_size=2))
+        assert not r.prefill_remote(1000, 0, 5)
+        assert r.prefill_remote(1000, 0, 1)
+        await r.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
+def test_disagg_config_hot_reload(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        r = ConditionalDisaggRouter(drt, "dynamo", "m")
+        await r.start()
+        # a second router (ops CLI) updates the store; first sees it
+        r2 = ConditionalDisaggRouter(drt, "dynamo", "m")
+        await r2.start()
+        await r2.update(DisaggConfig(max_local_prefill_length=7777))
+        for _ in range(50):
+            if r.config.max_local_prefill_length == 7777:
+                break
+            await asyncio.sleep(0.01)
+        assert r.config.max_local_prefill_length == 7777
+        await r.stop()
+        await r2.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
+# ---------------- queue ----------------
+
+
+def test_prefill_queue_ack_nack(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        q = PrefillQueue(drt.bus, redeliver_after=0.2)
+        rpr = RemotePrefillRequest(
+            request_id="r1", request=make_req([1, 2, 3]).to_dict(),
+            skip_blocks=0, connection={"local": True},
+        )
+        await q.enqueue(rpr)
+        assert q.depth == 1
+        item_id, got = await q.dequeue(timeout=1.0)
+        assert got.request_id == "r1" and got.skip_blocks == 0
+        # nack -> redelivered
+        await q.nack(item_id)
+        item_id2, got2 = await q.dequeue(timeout=1.0)
+        assert got2.request_id == "r1"
+        assert await q.ack(item_id2)
+        assert q.depth == 0
+        # visibility timeout redelivery without ack
+        await q.enqueue(rpr)
+        iid, _ = await q.dequeue(timeout=1.0)
+        await asyncio.sleep(0.3)
+        redelivered = await q.dequeue(timeout=1.0)
+        assert redelivered is not None
+        await q.ack(redelivered[0])
+        await drt.shutdown()
+
+    run(main())
+
+
+# ---------------- transfer plane ----------------
+
+
+def test_kv_transfer_tcp_roundtrip(run):
+    async def main():
+        srv = KvTransferServer()
+        await srv.start()
+        fut = srv.expect("req-9")
+        k = np.random.default_rng(0).standard_normal((4, 2, 3, 4, 8)).astype(np.float32)
+        v = np.random.default_rng(1).standard_normal((4, 2, 3, 4, 8)).astype(np.float32)
+        await send_kv_blocks(srv.address, "req-9", 42, k, v, layer_chunk=3)
+        d = await asyncio.wait_for(fut, 5)
+        assert d.first_token == 42 and d.n_blocks == 3
+        np.testing.assert_array_equal(d.k_data, k)
+        np.testing.assert_array_equal(d.v_data, v)
+        # error notification path
+        fut2 = srv.expect("req-10")
+        await send_kv_blocks(srv.address, "req-10", -1, None, None, error="boom")
+        d2 = await asyncio.wait_for(fut2, 5)
+        assert d2.error == "boom" and d2.n_blocks == 0
+        await srv.close()
+
+    run(main())
+
+
+# ---------------- end-to-end ----------------
+
+
+def _disagg_stack(transfer, max_local=8):
+    """decode engine + prefill engine (shared weights) + queue + worker."""
+    decode = JaxEngine(engine_cfg(), params=PARAMS)
+    prefill = JaxEngine(engine_cfg(), params=PARAMS)
+    return decode, prefill
+
+
+@pytest.mark.parametrize("mode", ["local_pipe", "tcp"])
+def test_disagg_end_to_end_matches_aggregated(run, mode):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        router = ConditionalDisaggRouter(
+            drt, "dynamo", "tiny", DisaggConfig(max_local_prefill_length=8)
+        )
+        await router.start()
+        queue = PrefillQueue(drt.bus)
+        decode, prefill = _disagg_stack(None)
+        if mode == "local_pipe":
+            transfer = LocalKvPipe()
+            worker = PrefillWorker(prefill, queue, local_pipe=transfer)
+        else:
+            transfer = KvTransferServer()
+            await transfer.start()
+            worker = PrefillWorker(prefill, queue, layer_chunk=1)
+        worker.start()
+        eng = DisaggEngine(decode, router, queue, transfer)
+
+        prompt = list(range(10, 34))  # 24 tokens >> max_local 8 -> remote
+        outs = await collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
+        toks = [t for o in outs for t in o.token_ids]
+        assert outs[-1].finish_reason in (FinishReason.LENGTH, FinishReason.EOS)
+        assert eng.stats["remote_prefills"] == 1
+        assert worker.stats["prefills_total"] == 1
+
+        # aggregated reference run with the same weights must match exactly
+        ref_engine = JaxEngine(engine_cfg(), params=PARAMS)
+        ref = await collect(ref_engine.generate(Context(make_req(prompt, max_tokens=6))))
+        ref_toks = [t for o in ref for t in o.token_ids]
+        assert toks == ref_toks
+
+        # short prompt stays local
+        outs2 = await collect(eng.generate(Context(make_req([1, 2, 3], max_tokens=3))))
+        assert eng.stats["local_prefills"] == 1
+        assert [t for o in outs2 for t in o.token_ids]
+
+        # decode-side prefix cache: same long prompt again -> skip_blocks > 0,
+        # decision sees the cached prefix and stays local now
+        outs3 = await collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
+        toks3 = [t for o in outs3 for t in o.token_ids]
+        assert toks3 == ref_toks
+        assert eng.stats["local_prefills"] == 2  # cached prefix -> local
+
+        await worker.close()
+        if mode == "tcp":
+            await transfer.close()
+        await decode.close()
+        await prefill.close()
+        await router.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
+def test_disagg_timeout_fails_request(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        router = ConditionalDisaggRouter(
+            drt, "dynamo", "tiny", DisaggConfig(max_local_prefill_length=4)
+        )
+        await router.start()
+        queue = PrefillQueue(drt.bus)
+        decode = JaxEngine(engine_cfg(), params=PARAMS)
+        transfer = LocalKvPipe()
+        # no prefill worker running -> delivery never arrives
+        eng = DisaggEngine(decode, router, queue, transfer, transfer_timeout=0.3)
+        outs = await collect(eng.generate(Context(make_req(list(range(20))))))
+        assert outs[-1].finish_reason == FinishReason.ERROR
+        # blocks were returned to the pool
+        assert decode.allocator.used_count == 0
+        await decode.close()
+        await router.stop()
+        await drt.shutdown()
+
+    run(main())
