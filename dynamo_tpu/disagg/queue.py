@@ -19,6 +19,7 @@ class PrefillQueue:
     def __init__(self, bus, namespace: str = "dynamo", redeliver_after: float = 60.0):
         self.name = f"{namespace}.{QUEUE_NAME}"
         self._q = bus.work_queue(self.name, redeliver_after=redeliver_after)
+        self._deliveries: dict[int, int] = {}
 
     async def enqueue(self, req: RemotePrefillRequest) -> int:
         r = self._q.push(req.to_bytes())
@@ -32,7 +33,14 @@ class PrefillQueue:
         item = await self._q.pop(timeout)
         if item is None:
             return None
+        # keep a bounded map of delivery counts for poison-pill cutoffs
+        if len(self._deliveries) > 4096:
+            self._deliveries.clear()
+        self._deliveries[item.id] = item.deliveries
         return item.id, RemotePrefillRequest.from_bytes(item.payload)
+
+    def deliveries(self, item_id: int) -> int:
+        return self._deliveries.get(item_id, 1)
 
     async def ack(self, item_id: int) -> bool:
         r = self._q.ack(item_id)

@@ -32,6 +32,12 @@ from ..runtime.tcp import ConnectionInfo
 
 logger = logging.getLogger(__name__)
 
+
+class TransferError(Exception):
+    """KV push failed or was not acknowledged — the queue item should be
+    redelivered (nack), not treated as delivered."""
+
+
 _DTYPES = {}
 
 
@@ -133,6 +139,8 @@ class KvTransferServer:
             req_id = head["request_id"]
             fut = self._pending.pop(req_id, None)
             if head.get("error"):
+                writer.write(b"ok")
+                await writer.drain()
                 if fut is not None and not fut.done():
                     fut.set_result(
                         KvDelivery(req_id, -1, 0, None, None, error=head["error"])
@@ -184,7 +192,10 @@ async def send_kv_blocks(
     if isinstance(connection, dict):
         connection = ConnectionInfo.from_dict(connection)
     host, port = connection.address.rsplit(":", 1)
-    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        reader, writer = await asyncio.open_connection(host, int(port))
+    except OSError as e:
+        raise TransferError(f"connect to {connection.address} failed: {e}") from e
     try:
         n = 0 if k_data is None else int(k_data.shape[2])
         head = {
@@ -206,8 +217,14 @@ async def send_kv_blocks(
                     writer, TwoPartMessage(b"", blob)
                 )
         await writer.drain()
-        # wait for the receiver's ack so redelivery can't double-complete
-        await asyncio.wait_for(reader.read(2), timeout=30.0)
+        # require the receiver's ack — anything else (EOF from a mid-stream
+        # receive failure) must surface as a retriable error, or the caller
+        # would ack the queue item for a transfer that never landed
+        ack = await asyncio.wait_for(reader.read(2), timeout=30.0)
+        if ack != b"ok":
+            raise TransferError(f"receiver did not acknowledge (got {ack!r})")
+    except (OSError, asyncio.TimeoutError) as e:
+        raise TransferError(str(e)) from e
     finally:
         writer.close()
 

@@ -24,7 +24,7 @@ from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
 from .router import ConditionalDisaggRouter
-from .transfer import KvTransferServer, LocalKvPipe, send_kv_blocks
+from .transfer import KvTransferServer, LocalKvPipe, TransferError, send_kv_blocks
 
 logger = logging.getLogger(__name__)
 
@@ -55,29 +55,50 @@ class PrefillWorker:
             self._task.cancel()
             self._task = None
 
+    MAX_DELIVERIES = 5  # poison-pill cutoff: after this, fail the request
+
     async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self._run_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — transient bus/hub error:
+                # the fleet must not silently lose a prefill consumer
+                logger.exception("prefill consume loop error; retrying")
+                await asyncio.sleep(0.5)
+
+    async def _run_once(self) -> None:
+        got = await self.queue.dequeue(timeout=0.5)
+        if got is None:
+            return
+        item_id, rpr = got
         try:
-            while not self._stop.is_set():
-                got = await self.queue.dequeue(timeout=0.5)
-                if got is None:
-                    continue
-                item_id, rpr = got
-                try:
-                    await self._process(rpr)
-                except OutOfBlocks:
-                    # pool full: hand the item back for another worker (or
-                    # ourselves, once running prefills free their blocks)
-                    self.stats["nacks"] += 1
-                    await self.queue.nack(item_id)
-                    await asyncio.sleep(0.05)
-                    continue
-                except Exception as e:  # noqa: BLE001
-                    logger.exception("remote prefill failed: %s", rpr.request_id)
-                    self.stats["prefill_errors"] += 1
-                    await self._notify_error(rpr, str(e))
-                await self.queue.ack(item_id)
-        except asyncio.CancelledError:
-            pass
+            await self._process(rpr)
+        except OutOfBlocks:
+            # pool full: hand the item back for another worker (or
+            # ourselves, once running prefills free their blocks)
+            self.stats["nacks"] += 1
+            await self.queue.nack(item_id)
+            await asyncio.sleep(0.05)
+            return
+        except TransferError as e:
+            # the KV never landed: retriable — unless this item has
+            # already bounced enough to look like a dead decode host
+            if self.queue.deliveries(item_id) < self.MAX_DELIVERIES:
+                logger.warning("kv transfer failed (%s); redelivering", e)
+                self.stats["nacks"] += 1
+                await self.queue.nack(item_id)
+                await asyncio.sleep(0.1)
+                return
+            logger.error("kv transfer failed %d times: %s", self.MAX_DELIVERIES, e)
+            self.stats["prefill_errors"] += 1
+            await self._notify_error(rpr, str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("remote prefill failed: %s", rpr.request_id)
+            self.stats["prefill_errors"] += 1
+            await self._notify_error(rpr, str(e))
+        await self.queue.ack(item_id)
 
     async def _process(self, rpr: RemotePrefillRequest) -> None:
         req = PreprocessedRequest.from_dict(rpr.request)
@@ -144,7 +165,13 @@ class DisaggEngine(AsyncEngine):
         prompt_len = len(req.token_ids or [])
         handle = None
         remote = False
-        if self.router.config.enabled and prompt_len:
+        # fast path: a prompt under the threshold can never go remote
+        # (cached prefix only shortens it) — skip the reservation churn
+        # and the queue-depth RPC entirely
+        if (
+            self.router.config.enabled
+            and prompt_len > self.router.config.max_local_prefill_length
+        ):
             handle = self.engine.begin_remote(request)
         if handle is not None:
             depth = await self.queue.get_depth()

@@ -77,6 +77,11 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
     if args.model_path in (None, "tiny"):
         cfg = ModelConfig.tiny()
         return cfg, None, ByteTokenizer(), args.model_name or "tiny"
+    if args.model_path == "tiny-moe":
+        cfg = ModelConfig.tiny(
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32
+        )
+        return cfg, None, ByteTokenizer(), args.model_name or "tiny-moe"
     cfg = ModelConfig.from_local_path(args.model_path)
     tokenizer = HFTokenizer(args.model_path)
     name = args.model_name or os.path.basename(os.path.normpath(args.model_path))

@@ -33,6 +33,8 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0
+    num_shared_experts: int = 0  # DeepSeek-style always-on experts
+    norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
     # runtime
     dtype: str = "bfloat16"
 
@@ -63,6 +65,8 @@ class ModelConfig:
             num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
+            num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            norm_topk_prob=cfg.get("norm_topk_prob", True),
             dtype=cfg.get("torch_dtype", "bfloat16"),
         )
 

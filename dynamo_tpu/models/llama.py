@@ -56,20 +56,35 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     def layer_stack(k, shape, scale=0.02):
         return norm_init(k, (L,) + shape, scale)
 
+    layers = {
+        "attn_norm": jnp.ones((L, E), dt),
+        "wq": layer_stack(keys[1], (E, H * D)),
+        "wk": layer_stack(keys[2], (E, Hkv * D)),
+        "wv": layer_stack(keys[3], (E, Hkv * D)),
+        "wo": layer_stack(keys[4], (H * D, E)),
+        "mlp_norm": jnp.ones((L, E), dt),
+    }
+    if cfg.is_moe:
+        X = cfg.num_experts
+        Fm = cfg.moe_intermediate_size or F
+        mk = jax.random.split(keys[5], 7)
+        layers["moe_gate"] = layer_stack(mk[0], (E, X))
+        layers["we_gate"] = layer_stack(mk[1], (X, E, Fm))
+        layers["we_up"] = layer_stack(mk[2], (X, E, Fm))
+        layers["we_down"] = layer_stack(mk[3], (X, Fm, E))
+        if cfg.num_shared_experts:
+            Fs = Fm * cfg.num_shared_experts
+            layers["shared_gate"] = layer_stack(mk[4], (E, Fs))
+            layers["shared_up"] = layer_stack(mk[5], (E, Fs))
+            layers["shared_down"] = layer_stack(mk[6], (Fs, E))
+    else:
+        layers["w_gate"] = layer_stack(keys[5], (E, F))
+        layers["w_up"] = layer_stack(keys[6], (E, F))
+        layers["w_down"] = layer_stack(keys[7], (F, E))
     params = {
         "embed": norm_init(keys[0], (V, E), 0.02),
         "final_norm": jnp.ones((E,), dt),
-        "layers": {
-            "attn_norm": jnp.ones((L, E), dt),
-            "wq": layer_stack(keys[1], (E, H * D)),
-            "wk": layer_stack(keys[2], (E, Hkv * D)),
-            "wv": layer_stack(keys[3], (E, Hkv * D)),
-            "wo": layer_stack(keys[4], (H * D, E)),
-            "mlp_norm": jnp.ones((L, E), dt),
-            "w_gate": layer_stack(keys[5], (E, F)),
-            "w_up": layer_stack(keys[6], (E, F)),
-            "w_down": layer_stack(keys[7], (F, E)),
-        },
+        "layers": layers,
     }
     if cfg.attention_bias:
         params["layers"]["bq"] = jnp.zeros((L, H * D), dt)
@@ -131,6 +146,45 @@ def swiglu(x, w_gate, w_up, w_down):
     return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
 
 
+def moe_ffn(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixtral/DeepSeek-style sparse MoE FFN (ref serves these via vLLM —
+    README's Mixtral / DeepSeek-R1 rows; here it's native).
+
+    Dense dispatch: every (stacked) expert runs over all tokens and the
+    routing matrix — zero except each token's top-k — selects at combine.
+    The expert axis ``x`` of ``we_*`` is sharded over the ``ep`` mesh axis
+    (parallel/mesh.py), so GSPMD keeps per-device work at X/ep experts and
+    inserts the combine all-reduce over ICI: the einsum contraction over
+    ``x`` IS the expert-parallel reduce. Exact (no capacity factor, no
+    token dropping). A ragged all-to-all Pallas dispatch is the later
+    optimization for very large X.
+    """
+    T = x.shape[0]
+    gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
+    vals, idx = lax.top_k(probs, cfg.num_experts_per_tok)  # [T, k]
+    if cfg.norm_topk_prob:
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    w = jnp.sum(
+        jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)
+        * vals[..., None],
+        axis=1,
+    )  # [T, X] routing weights
+    g = jnp.einsum("te,xef->txf", x, lp["we_gate"])
+    u = jnp.einsum("te,xef->txf", x, lp["we_up"])
+    y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, lp["we_down"])
+    out = jnp.einsum("txe,tx->te", y, w.astype(x.dtype))
+    if "shared_gate" in lp:  # DeepSeek shared experts: always-on dense path
+        out = out + swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    return out
+
+
+def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    if cfg.is_moe:
+        return moe_ffn(lp, cfg, h)
+    return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return (x @ head).astype(jnp.float32)
@@ -188,7 +242,7 @@ def prefill(
         )
         x = x + o.reshape(T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(lp, cfg, h)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))
@@ -238,7 +292,7 @@ def decode_step(
         )
         x = x + o.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(lp, cfg, h)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))
@@ -267,7 +321,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         o = att.prefill_attention_xla(q, k, v, positions, jnp.int32(T), scale)
         x = x + o.reshape(T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(lp, cfg, h)
         return x, None
 
     x, _ = lax.scan(body, x, params["layers"])

@@ -75,20 +75,70 @@ def load_llama_params(
             mats.append(t.T if transpose else t)
         return np.stack(mats)
 
+    layers: dict = {
+        "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+        "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
+    }
+    if cfg.is_moe:
+        X = cfg.num_experts
+
+        def has(name: str) -> bool:
+            return name in name_to_file
+
+        def stack_experts(mix_fmt: str, ds_fmt: str) -> np.ndarray:
+            """[L, X, in, out] from per-expert tensors; supports Mixtral
+            (block_sparse_moe.experts.N.w1/w3/w2) and DeepSeek/Qwen-MoE
+            (mlp.experts.N.gate/up/down_proj) naming."""
+            out = []
+            for i in range(L):
+                fmt = mix_fmt if has(mix_fmt.format(i=i, x=0)) else ds_fmt
+                out.append(
+                    np.stack([get(fmt.format(i=i, x=x)).T for x in range(X)])
+                )
+            return np.stack(out)
+
+        gate_mix = "model.layers.{i}.block_sparse_moe.gate.weight"
+        gate_ds = "model.layers.{i}.mlp.gate.weight"
+        layers["moe_gate"] = np.stack(
+            [
+                get((gate_mix if has(gate_mix.format(i=i)) else gate_ds).format(i=i)).T
+                for i in range(L)
+            ]
+        )
+        layers["we_gate"] = stack_experts(
+            "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
+            "model.layers.{i}.mlp.experts.{x}.gate_proj.weight",
+        )
+        layers["we_up"] = stack_experts(
+            "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
+            "model.layers.{i}.mlp.experts.{x}.up_proj.weight",
+        )
+        layers["we_down"] = stack_experts(
+            "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
+            "model.layers.{i}.mlp.experts.{x}.down_proj.weight",
+        )
+        if cfg.num_shared_experts:
+            layers["shared_gate"] = stack(
+                "model.layers.{i}.mlp.shared_experts.gate_proj.weight"
+            )
+            layers["shared_up"] = stack(
+                "model.layers.{i}.mlp.shared_experts.up_proj.weight"
+            )
+            layers["shared_down"] = stack(
+                "model.layers.{i}.mlp.shared_experts.down_proj.weight"
+            )
+    else:
+        layers["w_gate"] = stack("model.layers.{i}.mlp.gate_proj.weight")
+        layers["w_up"] = stack("model.layers.{i}.mlp.up_proj.weight")
+        layers["w_down"] = stack("model.layers.{i}.mlp.down_proj.weight")
     params: dict = {
         "embed": get("model.embed_tokens.weight"),
         "final_norm": get("model.norm.weight"),
-        "layers": {
-            "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
-            "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
-            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
-        },
+        "layers": layers,
     }
     if cfg.attention_bias:
         params["layers"]["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
@@ -137,6 +187,22 @@ def save_llama_params(path: str, params: dict) -> None:
         for i in range(L):
             t = np.asarray(lay[key][i], np.float32)
             flat[fmt.format(i=i)] = t.T.copy() if transpose else t
+    if "we_gate" in lay:  # MoE: Mixtral naming
+        X = lay["we_gate"].shape[1]
+        expert_names = {
+            "we_gate": "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
+            "we_up": "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
+            "we_down": "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
+        }
+        for i in range(L):
+            flat[f"model.layers.{i}.block_sparse_moe.gate.weight"] = np.asarray(
+                lay["moe_gate"][i], np.float32
+            ).T.copy()
+            for key, fmt in expert_names.items():
+                for x in range(X):
+                    flat[fmt.format(i=i, x=x)] = np.asarray(
+                        lay[key][i, x], np.float32
+                    ).T.copy()
     if "lm_head" in params:
         flat["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
     save_file(flat, os.path.join(path, "model.safetensors"))

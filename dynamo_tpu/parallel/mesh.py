@@ -1,8 +1,17 @@
 """Device mesh + sharding placement rules.
 
-The mesh axes:
+The mesh axes (any subset may be 1; all five always exist by name):
 
   * ``dp``   — data parallel (independent request batches / replicas)
+  * ``pp``   — pipeline parallel (layer-stage sharding of the stacked
+               [L, ...] parameter arrays — distributes weight memory
+               across stages; the scan layer loop slices one stage's
+               shard per step)
+  * ``sp``   — sequence parallel (ring attention over ICI for
+               long-context prefill, parallel/ring_attention.py)
+  * ``ep``   — expert parallel (MoE expert axis of we_* weights;
+               the combine einsum's contraction over experts becomes
+               the all-reduce GSPMD inserts on ICI)
   * ``tp``   — tensor parallel (heads / mlp-hidden / vocab, over ICI)
 
 Megatron-style placement (column-parallel qkv/gate/up, row-parallel
@@ -32,11 +41,17 @@ from ..models.config import ModelConfig
 @dataclass
 class MeshConfig:
     dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
     tp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.pp * self.sp * self.ep * self.tp
+
+
+AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 def make_mesh(mesh_cfg: Optional[MeshConfig] = None, devices=None) -> Mesh:
@@ -46,35 +61,38 @@ def make_mesh(mesh_cfg: Optional[MeshConfig] = None, devices=None) -> Mesh:
     n = mesh_cfg.num_devices
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    grid = np.asarray(devices[:n]).reshape(mesh_cfg.dp, mesh_cfg.tp)
-    return Mesh(grid, ("dp", "tp"))
+    shape = (mesh_cfg.dp, mesh_cfg.pp, mesh_cfg.sp, mesh_cfg.ep, mesh_cfg.tp)
+    grid = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(grid, AXES)
 
 
-# partition specs per parameter path (leading L axis on stacked layers)
+# partition specs per parameter path; stacked layers lead with the L axis,
+# which shards over "pp" (layer-stage sharding: each pipeline stage holds
+# its layers' weights; the scan loop slices one step's shard at a time)
 _PARAM_SPECS = {
     "embed": P("tp", None),  # vocab-parallel
     "lm_head": P(None, "tp"),  # vocab-parallel output
     "final_norm": P(None),
-    "layers.attn_norm": P(None, None),
-    "layers.mlp_norm": P(None, None),
-    "layers.wq": P(None, None, "tp"),  # column: heads
-    "layers.wk": P(None, None, "tp"),
-    "layers.wv": P(None, None, "tp"),
-    "layers.wo": P(None, "tp", None),  # row
-    "layers.bq": P(None, "tp"),
-    "layers.bk": P(None, "tp"),
-    "layers.bv": P(None, "tp"),
-    "layers.w_gate": P(None, None, "tp"),  # column: hidden
-    "layers.w_up": P(None, None, "tp"),
-    "layers.w_down": P(None, "tp", None),  # row
-    # MoE (experts stacked on axis 1: [L, X, ...])
-    "layers.moe_gate": P(None, None, None),
-    "layers.we_gate": P(None, None, None, "tp"),
-    "layers.we_up": P(None, None, None, "tp"),
-    "layers.we_down": P(None, None, "tp", None),
-    "layers.shared_gate": P(None, None, "tp"),
-    "layers.shared_up": P(None, None, "tp"),
-    "layers.shared_down": P(None, "tp", None),
+    "layers.attn_norm": P("pp", None),
+    "layers.mlp_norm": P("pp", None),
+    "layers.wq": P("pp", None, "tp"),  # column: heads
+    "layers.wk": P("pp", None, "tp"),
+    "layers.wv": P("pp", None, "tp"),
+    "layers.wo": P("pp", "tp", None),  # row
+    "layers.bq": P("pp", "tp"),
+    "layers.bk": P("pp", "tp"),
+    "layers.bv": P("pp", "tp"),
+    "layers.w_gate": P("pp", None, "tp"),  # column: hidden
+    "layers.w_up": P("pp", None, "tp"),
+    "layers.w_down": P("pp", "tp", None),  # row
+    # MoE (experts stacked on axis 1: [L, X, ...]; expert axis over "ep")
+    "layers.moe_gate": P("pp", None, None),
+    "layers.we_gate": P("pp", "ep", None, "tp"),
+    "layers.we_up": P("pp", "ep", None, "tp"),
+    "layers.we_down": P("pp", "ep", "tp", None),
+    "layers.shared_gate": P("pp", None, "tp"),
+    "layers.shared_up": P("pp", None, "tp"),
+    "layers.shared_down": P("pp", "tp", None),
 }
 
 
@@ -104,12 +122,14 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 
 
 def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
-    """[L, Hkv, num_blocks, block_size, D]: shard kv heads over tp when
-    divisible, else replicate that axis."""
+    """[L, Hkv, num_blocks, block_size, D]: layer axis shards over pp
+    (stage-local KV), kv heads over tp — each when divisible, else
+    replicated on that axis."""
+    pp = mesh.shape.get("pp", 1)
     tp = mesh.shape["tp"]
-    if cfg.num_kv_heads % tp == 0:
-        return NamedSharding(mesh, P(None, "tp", None, None, None))
-    return NamedSharding(mesh, P(None, None, None, None, None))
+    l_ax = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
+    h_ax = "tp" if cfg.num_kv_heads % tp == 0 else None
+    return NamedSharding(mesh, P(l_ax, h_ax, None, None, None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,141 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.8 — its
+long-context story is paged KV + chunked prefill + disagg offload); this
+is the TPU build's parity-plus capability for long-context prefill: shard
+the sequence over the ``sp`` mesh axis, keep Q resident, and rotate KV
+shards around the ring with ``lax.ppermute`` while accumulating exact
+softmax attention blockwise (online/streaming softmax, the flash
+-attention recurrence). Compute on each hop overlaps the next hop's
+KV transfer on ICI.
+
+Public papers behind the pattern: Liu et al., "Ring Attention with
+Blockwise Transformers" (2023); the blockwise softmax recurrence from
+Milakov & Gimelshein (2018) / flash attention.
+
+All functions are shape-static and jit/shard_map friendly. Q/K/V are
+``[T_local, H, D]`` inside each shard (one sequence, heads replicated or
+tp-sharded orthogonally).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, q_pos, kv_pos, causal):
+    """One blockwise attention contribution + its online-softmax stats.
+
+    q: [Tq, H, D]; k/v: [Tk, Hkv, D] with Hkv == H (pre-repeated for GQA).
+    Returns (contrib [Tq, H, D] — unnormalized exp-weighted values,
+    m [Tq, H] row max, l [Tq, H] row sum)."""
+    s = jnp.einsum("qhd,khd->hqk", q, k) * scale  # [H, Tq, Tk]
+    if causal:
+        mask = q_pos[None, :, None] >= kv_pos[None, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [H, Tq]
+    # fully-masked rows (causal: shard ahead of all queries) would have
+    # m = NEG_INF; pin m to 0 there so exp(s - m) underflows to 0 cleanly
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])  # [H, Tq, Tk]
+    l = jnp.sum(p, axis=-1)  # noqa: E741
+    contrib = jnp.einsum("hqk,khd->qhd", p, v)
+    return contrib, jnp.transpose(m_safe), jnp.transpose(l)  # m,l -> [Tq, H]
+
+
+def _merge(acc, m, l, contrib, m_new, l_new):  # noqa: E741
+    """Merge a new block's (contrib, m, l) into running accumulators."""
+    m_next = jnp.maximum(m, m_new)
+    a = jnp.exp(m - m_next)  # rescale old
+    b = jnp.exp(m_new - m_next)  # rescale new
+    acc = acc * a[..., None] + contrib * b[..., None]
+    l_next = l * a + l_new * b
+    return acc, m_next, l_next
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: float,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Must run inside shard_map (or pmap) with q/k/v local shards
+    [T_local, H, D]. Global sequence order follows the mesh axis index.
+    Returns the local shard of the attention output [T_local, H, D].
+    """
+    p_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[0]
+    q_pos = my * t_local + jnp.arange(t_local)
+
+    # pvary: accumulators start as constants but the loop carry is
+    # device-varying over the ring axis — mark them so shard_map's
+    # varying-manual-axes check accepts the fori_loop carry
+    acc = lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
+    m = lax.pcast(
+        jnp.full(q.shape[:1] + q.shape[1:2], NEG_INF, jnp.float32),
+        (axis_name,), to="varying",
+    )  # [Tq, H]
+    l = lax.pcast(  # noqa: E741
+        jnp.zeros(q.shape[:1] + q.shape[1:2], jnp.float32),
+        (axis_name,), to="varying",
+    )
+
+    def attend(step, acc, m, l, k_cur, v_cur):  # noqa: E741
+        src = (my - step) % p_size  # whose KV we hold this step
+        kv_pos = src * t_local + jnp.arange(t_local)
+        contrib, m_new, l_new = _block_attend(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), scale, q_pos, kv_pos, causal,
+        )
+        return _merge(acc, m, l, contrib, m_new, l_new)
+
+    def body(step, carry):
+        acc, m, l, k_cur, v_cur = carry  # noqa: E741
+        acc, m, l = attend(step, acc, m, l, k_cur, v_cur)  # noqa: E741
+        # rotate KV around the ring for the next step
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    # p_size - 1 rotations; the final shard attends outside the loop so no
+    # ppermute result is ever discarded
+    acc, m, l, k_last, v_last = lax.fori_loop(  # noqa: E741
+        0, p_size - 1, body, (acc, m, l, k, v)
+    )
+    acc, m, l = attend(p_size - 1, acc, m, l, k_last, v_last)  # noqa: E741
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    scale: float,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Driver: global [T, H, D] arrays in, ring attention over mesh axis
+    ``axis_name`` (T must divide by its size), global [T, H, D] out."""
+    spec = P(axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, scale=scale, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

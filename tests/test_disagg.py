@@ -169,8 +169,8 @@ def test_kv_transfer_tcp_roundtrip(run):
 # ---------------- end-to-end ----------------
 
 
-def _disagg_stack(transfer, max_local=8):
-    """decode engine + prefill engine (shared weights) + queue + worker."""
+def _disagg_stack():
+    """decode engine + prefill engine with shared weights."""
     decode = JaxEngine(engine_cfg(), params=PARAMS)
     prefill = JaxEngine(engine_cfg(), params=PARAMS)
     return decode, prefill
@@ -185,7 +185,7 @@ def test_disagg_end_to_end_matches_aggregated(run, mode):
         )
         await router.start()
         queue = PrefillQueue(drt.bus)
-        decode, prefill = _disagg_stack(None)
+        decode, prefill = _disagg_stack()
         if mode == "local_pipe":
             transfer = LocalKvPipe()
             worker = PrefillWorker(prefill, queue, local_pipe=transfer)
