@@ -137,8 +137,11 @@ class KvTransferServer:
                 return
             head = json.loads(frame.header)
             req_id = head["request_id"]
-            fut = self._pending.pop(req_id, None)
+            # look up (don't pop) — on a mid-stream failure the future must
+            # stay pending so the sender's redelivery retry can complete it
+            fut = self._pending.get(req_id)
             if head.get("error"):
+                self._pending.pop(req_id, None)
                 writer.write(b"ok")
                 await writer.drain()
                 if fut is not None and not fut.done():
@@ -167,14 +170,16 @@ class KvTransferServer:
                 l0 = l1
             writer.write(b"ok")
             await writer.drain()
+            self._pending.pop(req_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(
                     KvDelivery(req_id, head["first_token"], n, k, v)
                 )
-        except Exception as e:  # noqa: BLE001
-            logger.exception("kv transfer receive failed")
-            if fut is not None and not fut.done():
-                fut.set_exception(e)
+        except Exception:  # noqa: BLE001 — receive failed mid-stream: no
+            # ack is sent, the sender sees a TransferError and redelivers;
+            # the pending future survives for that retry (the decode side's
+            # transfer_timeout is the terminal backstop)
+            logger.exception("kv transfer receive failed; awaiting redelivery")
         finally:
             writer.close()
 
@@ -220,10 +225,10 @@ async def send_kv_blocks(
         # require the receiver's ack — anything else (EOF from a mid-stream
         # receive failure) must surface as a retriable error, or the caller
         # would ack the queue item for a transfer that never landed
-        ack = await asyncio.wait_for(reader.read(2), timeout=30.0)
+        ack = await asyncio.wait_for(reader.readexactly(2), timeout=30.0)
         if ack != b"ok":
             raise TransferError(f"receiver did not acknowledge (got {ack!r})")
-    except (OSError, asyncio.TimeoutError) as e:
+    except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
         raise TransferError(str(e)) from e
     finally:
         writer.close()

@@ -42,7 +42,15 @@ def chain_hash(parent: Optional[int], local: int) -> int:
 
 
 def sequence_block_hashes(tokens: Sequence[int], block_size: int) -> list[tuple[int, int]]:
-    """[(local_hash, chained_hash)] for each *full* block of the sequence."""
+    """[(local_hash, chained_hash)] for each *full* block of the sequence.
+
+    Uses the native C++ batch hasher when built (bit-identical output —
+    hashes address KV blocks across processes, so both layers must agree).
+    """
+    from .. import native
+
+    if native.available():
+        return native.sequence_block_hashes(tokens, block_size)
     out: list[tuple[int, int]] = []
     parent: Optional[int] = None
     for i in range(0, len(tokens) - len(tokens) % block_size, block_size):

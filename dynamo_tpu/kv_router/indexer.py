@@ -158,8 +158,8 @@ class ShardedPrefixIndex:
     """Hash-partitioned by worker id: each worker's residency lives in one
     shard; queries fan out and merge (ref KvIndexerSharded)."""
 
-    def __init__(self, shards: int = 4):
-        self._shards = [PrefixIndex() for _ in range(shards)]
+    def __init__(self, shards: int = 4, factory=None):
+        self._shards = [(factory or PrefixIndex)() for _ in range(shards)]
 
     def _shard(self, worker_id: int) -> PrefixIndex:
         return self._shards[worker_id % len(self._shards)]
@@ -179,14 +179,30 @@ class ShardedPrefixIndex:
         return merged
 
 
+def make_prefix_index(shards: int = 1, use_native: bool = True):
+    """PrefixIndex factory: the C++ tree (dynamo_tpu.native, mirroring the
+    reference's native Rust indexer) when its library is loaded, else the
+    pure-Python twin. Behavior is identical (differential-tested)."""
+    if use_native:
+        from .. import native
+
+        if native.available():
+            if shards <= 1:
+                return native.NativePrefixIndex()
+            return ShardedPrefixIndex(
+                shards, factory=native.NativePrefixIndex
+            )
+    return PrefixIndex() if shards <= 1 else ShardedPrefixIndex(shards)
+
+
 class KvIndexer:
     """Event-plane consumer: subscribes the component's kv_events subject
     and owns a PrefixIndex behind a queue (ref KvIndexer, indexer.rs:499)."""
 
-    def __init__(self, drt, component, shards: int = 1):
+    def __init__(self, drt, component, shards: int = 1, use_native: bool = True):
         self.drt = drt
         self.component = component
-        self.index = PrefixIndex() if shards <= 1 else ShardedPrefixIndex(shards)
+        self.index = make_prefix_index(shards=shards, use_native=use_native)
         self._task: Optional[asyncio.Task] = None
         self.events_applied = 0
 

@@ -206,7 +206,10 @@ async def run_endpoint(args) -> None:
         )
     else:
         engine = OpenAIWorkerEngine(tokenizer, core)
-        stats = jax_core.load_metrics if jax_core else (lambda: {})
+        stats = (
+            (lambda: jax_core.load_metrics() | jax_core.stats)
+            if jax_core else (lambda: {})
+        )
     component = drt.namespace(ns).component(comp)
     if jax_core is not None:
         from ..kv_router import KvEventPublisher

@@ -34,6 +34,7 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0
     num_shared_experts: int = 0  # DeepSeek-style always-on experts
+    first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
     # runtime
     dtype: str = "bfloat16"
@@ -66,6 +67,7 @@ class ModelConfig:
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
             dtype=cfg.get("torch_dtype", "bfloat16"),
         )

@@ -36,6 +36,16 @@ def load_llama_params(
 ) -> dict:
     """Load a HF llama-family checkpoint directory into the stacked pytree
     used by dynamo_tpu.models.llama."""
+    if cfg.is_moe and cfg.first_dense_layers:
+        # DeepSeek first_k_dense_replace: leading dense layers in an
+        # otherwise-MoE stack. The stacked-scan pytree is homogeneous;
+        # heterogeneous stacks need the split-scan model variant
+        # (tracked follow-up) — fail loudly instead of KeyError soup.
+        raise NotImplementedError(
+            f"checkpoint has {cfg.first_dense_layers} leading dense "
+            "layers (first_k_dense_replace); mixed dense/MoE stacks "
+            "are not supported yet"
+        )
     from safetensors import safe_open
 
     dt = _np_dtype(dtype or str(cfg.dtype))
@@ -187,12 +197,17 @@ def save_llama_params(path: str, params: dict) -> None:
         for i in range(L):
             t = np.asarray(lay[key][i], np.float32)
             flat[fmt.format(i=i)] = t.T.copy() if transpose else t
-    if "we_gate" in lay:  # MoE: Mixtral naming
+    if "we_gate" in lay:  # MoE: Mixtral naming (shared experts: DeepSeek's)
         X = lay["we_gate"].shape[1]
         expert_names = {
             "we_gate": "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
             "we_up": "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
             "we_down": "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
+        }
+        shared_names = {
+            "shared_gate": "model.layers.{i}.mlp.shared_experts.gate_proj.weight",
+            "shared_up": "model.layers.{i}.mlp.shared_experts.up_proj.weight",
+            "shared_down": "model.layers.{i}.mlp.shared_experts.down_proj.weight",
         }
         for i in range(L):
             flat[f"model.layers.{i}.block_sparse_moe.gate.weight"] = np.asarray(
@@ -202,6 +217,11 @@ def save_llama_params(path: str, params: dict) -> None:
                 for x in range(X):
                     flat[fmt.format(i=i, x=x)] = np.asarray(
                         lay[key][i, x], np.float32
+                    ).T.copy()
+            for key, fmt in shared_names.items():
+                if key in lay:
+                    flat[fmt.format(i=i)] = np.asarray(
+                        lay[key][i], np.float32
                     ).T.copy()
     if "lm_head" in params:
         flat["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()

@@ -1,0 +1,181 @@
+"""ctypes loader for the native C++ hot paths (native/ at the repo root).
+
+Mirrors the reference's split between native performance layers (Rust
+indexer/tokens, lib/llm/src/kv_router/indexer.rs + tokens.rs) and Python
+orchestration. Everything here has a pure-Python twin with bit-identical
+behavior — the native path is an acceleration, never a requirement:
+
+  * :func:`available` — True when the shared library is loaded
+  * :func:`build` — compile it (cmake+ninja if present, plain g++ else)
+  * :func:`sequence_block_hashes` — batch token-block chained hashing
+  * :class:`NativePrefixIndex` — the router's global KV index
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Iterable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_CANDIDATES = (
+    os.environ.get("DYNAMO_NATIVE_LIB", ""),
+    os.path.join(_NATIVE_DIR, "build", "libdynamo_native.so"),
+    os.path.join(_NATIVE_DIR, "libdynamo_native.so"),
+)
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, i64, i32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_int
+    p = ctypes.POINTER
+    lib.dn_block_token_hash.restype = u64
+    lib.dn_block_token_hash.argtypes = [p(i64), i32]
+    lib.dn_chain_hash.restype = u64
+    lib.dn_chain_hash.argtypes = [u64, u64]
+    lib.dn_sequence_block_hashes.restype = i32
+    lib.dn_sequence_block_hashes.argtypes = [p(i64), i32, i32, p(u64), p(u64)]
+    lib.dn_pi_new.restype = ctypes.c_void_p
+    lib.dn_pi_free.argtypes = [ctypes.c_void_p]
+    lib.dn_pi_size.restype = u64
+    lib.dn_pi_size.argtypes = [ctypes.c_void_p]
+    lib.dn_pi_apply_stored.argtypes = [ctypes.c_void_p, u64, u64, i32, p(u64), i32]
+    lib.dn_pi_apply_removed.argtypes = [ctypes.c_void_p, u64, p(u64), i32]
+    lib.dn_pi_remove_worker.argtypes = [ctypes.c_void_p, u64]
+    lib.dn_pi_find_matches.restype = i32
+    lib.dn_pi_find_matches.argtypes = [
+        ctypes.c_void_p, p(u64), i32, p(u64), p(ctypes.c_uint32), i32, p(i32),
+    ]
+    return lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    for path in _LIB_CANDIDATES:
+        if path and os.path.exists(path):
+            try:
+                return _bind(ctypes.CDLL(path))
+            except OSError:  # pragma: no cover — wrong arch / stale build
+                logger.exception("failed to load native lib at %s", path)
+    return None
+
+
+_lib = _try_load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def build(force: bool = False) -> bool:
+    """Compile native/ into build/libdynamo_native.so. Returns success."""
+    global _lib
+    if _lib is not None and not force:
+        return True
+    build_dir = os.path.join(_NATIVE_DIR, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, "libdynamo_native.so")
+    try:
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            os.path.join(_NATIVE_DIR, "blake2b.cc"),
+            os.path.join(_NATIVE_DIR, "dynamo_native.cc"),
+            "-o", out,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        logger.exception("native build failed")
+        return False
+    _lib = _try_load()
+    return _lib is not None
+
+
+# ------------------------------------------------------------- hashing
+
+
+def block_token_hash(tokens: Sequence[int]) -> int:
+    arr = (ctypes.c_int64 * len(tokens))(*tokens)
+    return int(_lib.dn_block_token_hash(arr, len(tokens)))
+
+
+def chain_hash(parent: Optional[int], local: int) -> int:
+    return int(_lib.dn_chain_hash(parent or 0, local))
+
+
+def sequence_block_hashes(
+    tokens: Sequence[int], block_size: int
+) -> list[tuple[int, int]]:
+    import numpy as np
+
+    n = len(tokens)
+    full = n // block_size if block_size > 0 else 0
+    if full == 0:
+        return []
+    arr = np.ascontiguousarray(tokens, dtype=np.int64)
+    out = np.empty((2, full), dtype=np.uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    k = _lib.dn_sequence_block_hashes(
+        arr.ctypes.data_as(i64p), n, block_size,
+        out[0].ctypes.data_as(u64p), out[1].ctypes.data_as(u64p),
+    )
+    return list(zip(out[0, :k].tolist(), out[1, :k].tolist()))
+
+
+# --------------------------------------------------------- prefix index
+
+
+class NativePrefixIndex:
+    """Drop-in for kv_router.indexer.PrefixIndex backed by the C++ tree."""
+
+    MAX_WORKERS = 4096
+
+    def __init__(self):
+        self._h = _lib.dn_pi_new()
+
+    def __del__(self):  # pragma: no cover — interpreter teardown timing
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.dn_pi_free(h)
+
+    @property
+    def size(self) -> int:
+        return int(_lib.dn_pi_size(self._h))
+
+    def apply_event(self, ev) -> None:
+        kv = ev.event
+        if kv.kind == "stored":
+            hashes = [b.block_hash for b in kv.blocks]
+            arr = (ctypes.c_uint64 * len(hashes))(*hashes)
+            _lib.dn_pi_apply_stored(
+                self._h, ev.worker_id, kv.parent_hash or 0,
+                1 if kv.parent_hash is not None else 0, arr, len(hashes),
+            )
+        elif kv.kind == "removed":
+            arr = (ctypes.c_uint64 * len(kv.block_hashes))(*kv.block_hashes)
+            _lib.dn_pi_apply_removed(self._h, ev.worker_id, arr, len(kv.block_hashes))
+
+    def remove_worker(self, worker_id: int) -> None:
+        _lib.dn_pi_remove_worker(self._h, worker_id)
+
+    def find_matches(self, block_hashes: Iterable[int]):
+        from ..kv_router.indexer import OverlapScores
+
+        hashes = list(block_hashes)
+        arr = (ctypes.c_uint64 * len(hashes))(*hashes)
+        out_w = (ctypes.c_uint64 * self.MAX_WORKERS)()
+        out_s = (ctypes.c_uint32 * self.MAX_WORKERS)()
+        total = ctypes.c_int(0)
+        k = _lib.dn_pi_find_matches(
+            self._h, arr, len(hashes), out_w, out_s, self.MAX_WORKERS,
+            ctypes.byref(total),
+        )
+        scores = OverlapScores()
+        scores.scores = {int(out_w[i]): int(out_s[i]) for i in range(k)}
+        scores.total_blocks = int(total.value)
+        return scores

@@ -1,0 +1,111 @@
+#include "blake2b.h"
+
+#include <cstring>
+
+namespace dynamo_native {
+namespace {
+
+constexpr uint64_t kIV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+constexpr uint8_t kSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+inline uint64_t rotr64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86/arm)
+  return v;
+}
+
+struct State {
+  uint64_t h[8];
+  uint64_t t = 0;  // bytes processed (low word; messages < 2^64 bytes)
+};
+
+void compress(State& s, const uint8_t block[128], bool last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; ++i) m[i] = load64(block + 8 * i);
+  for (int i = 0; i < 8; ++i) v[i] = s.h[i];
+  for (int i = 0; i < 8; ++i) v[8 + i] = kIV[i];
+  v[12] ^= s.t;
+  // v[13] ^= t_high (always 0 here)
+  if (last) v[14] = ~v[14];
+
+  auto G = [&](int r, int i, int a, int b, int c, int d) {
+    v[a] = v[a] + v[b] + m[kSigma[r][2 * i]];
+    v[d] = rotr64(v[d] ^ v[a], 32);
+    v[c] = v[c] + v[d];
+    v[b] = rotr64(v[b] ^ v[c], 24);
+    v[a] = v[a] + v[b] + m[kSigma[r][2 * i + 1]];
+    v[d] = rotr64(v[d] ^ v[a], 16);
+    v[c] = v[c] + v[d];
+    v[b] = rotr64(v[b] ^ v[c], 63);
+  };
+  for (int r = 0; r < 12; ++r) {
+    G(r, 0, 0, 4, 8, 12);
+    G(r, 1, 1, 5, 9, 13);
+    G(r, 2, 2, 6, 10, 14);
+    G(r, 3, 3, 7, 11, 15);
+    G(r, 4, 0, 5, 10, 15);
+    G(r, 5, 1, 6, 11, 12);
+    G(r, 6, 2, 7, 8, 13);
+    G(r, 7, 3, 4, 9, 14);
+  }
+  for (int i = 0; i < 8; ++i) s.h[i] ^= v[i] ^ v[8 + i];
+}
+
+}  // namespace
+
+void blake2b(const void* data, size_t len, uint8_t* out, size_t digest_len) {
+  State s;
+  for (int i = 0; i < 8; ++i) s.h[i] = kIV[i];
+  // parameter block word 0: digest_len | (key_len << 8) | (fanout << 16)
+  // | (depth << 24); fanout = depth = 1, no key
+  s.h[0] ^= 0x0000000001010000ULL | (uint64_t)digest_len;
+
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint8_t block[128];
+  // full blocks except the last (the final block — even if full — is
+  // compressed with the finalization flag)
+  while (len > 128) {
+    s.t += 128;
+    compress(s, p, false);
+    p += 128;
+    len -= 128;
+  }
+  std::memset(block, 0, sizeof(block));
+  std::memcpy(block, p, len);
+  s.t += len;
+  compress(s, block, true);
+
+  uint8_t full[64];
+  std::memcpy(full, s.h, 64);  // little-endian word serialization
+  std::memcpy(out, full, digest_len);
+}
+
+uint64_t blake2b64_be(const void* data, size_t len) {
+  uint8_t d[8];
+  blake2b(data, len, d, 8);
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | d[i];
+  return v;
+}
+
+}  // namespace dynamo_native

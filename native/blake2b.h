@@ -1,0 +1,21 @@
+// BLAKE2b (RFC 7693) — minimal sequential implementation, no key, no salt.
+// Public algorithm; implemented from the RFC specification. Used to keep
+// native block hashes bit-identical to Python's hashlib.blake2b so hashes
+// computed in either layer interoperate (they address KV blocks across
+// processes — ref lib/llm/src/kv_router/indexer.rs:87 uses xxh3 the same
+// way; we standardize on blake2b-64 everywhere).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace dynamo_native {
+
+// Hash `len` bytes of `data` into `out` (digest_len in 1..64).
+void blake2b(const void* data, size_t len, uint8_t* out, size_t digest_len);
+
+// Convenience: 8-byte digest interpreted big-endian (matches Python's
+// int.from_bytes(h.digest(), "big")).
+uint64_t blake2b64_be(const void* data, size_t len);
+
+}  // namespace dynamo_native

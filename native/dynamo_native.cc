@@ -1,0 +1,224 @@
+// Native hot paths for dynamo_tpu, exposed through a plain C ABI and
+// loaded from Python via ctypes (the reference keeps these layers native
+// too: Rust kv_router/indexer.rs [radix tree, 1409 LoC], tokens.rs
+// [chained block hashing]; its CUDA block_copy.cu role is played by XLA
+// device scatters here, so the remaining native surface is hashing and
+// the router index).
+//
+// Components:
+//   * token-block hashing — bit-identical to the Python implementation
+//     (engine/allocator.py block_token_hash/chain_hash), so hashes
+//     computed natively or in Python interoperate across processes;
+//   * PrefixIndex — the KV router's global chained-hash index
+//     (kv_router/indexer.py) with worker residency sets and
+//     consecutive-prefix overlap queries.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "blake2b.h"
+
+using dynamo_native::blake2b64_be;
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashing
+
+// local hash: blake2b-64("tok:" + ",".join(str(t)))
+uint64_t dn_block_token_hash(const int64_t* tokens, int n) {
+  std::string buf = "tok:";
+  char tmp[24];
+  for (int i = 0; i < n; ++i) {
+    if (i) buf.push_back(',');
+    int len = std::snprintf(tmp, sizeof(tmp), "%lld",
+                            static_cast<long long>(tokens[i]));
+    buf.append(tmp, len);
+  }
+  return blake2b64_be(buf.data(), buf.size());
+}
+
+// chained hash: blake2b-64("seq:" + be64(parent) + be64(local))
+uint64_t dn_chain_hash(uint64_t parent, uint64_t local) {
+  uint8_t buf[4 + 16] = {'s', 'e', 'q', ':'};
+  for (int i = 0; i < 8; ++i) {
+    buf[4 + i] = static_cast<uint8_t>(parent >> (56 - 8 * i));
+    buf[12 + i] = static_cast<uint8_t>(local >> (56 - 8 * i));
+  }
+  return blake2b64_be(buf, sizeof(buf));
+}
+
+// batch: hashes for every full block of a token sequence; returns the
+// number of full blocks written to out_local/out_chain.
+int dn_sequence_block_hashes(const int64_t* tokens, int n, int block_size,
+                             uint64_t* out_local, uint64_t* out_chain) {
+  if (block_size <= 0) return 0;
+  int full = n / block_size;
+  uint64_t parent = 0;
+  for (int b = 0; b < full; ++b) {
+    uint64_t local = dn_block_token_hash(tokens + b * block_size, block_size);
+    parent = dn_chain_hash(parent, local);
+    out_local[b] = local;
+    out_chain[b] = parent;
+  }
+  return full;
+}
+
+// ------------------------------------------------------------ prefix index
+
+namespace {
+
+struct Node {
+  uint64_t parent_hash = 0;
+  bool has_parent = false;
+  std::unordered_set<uint64_t> workers;
+  std::unordered_set<uint64_t> children;
+};
+
+struct PrefixIndex {
+  std::unordered_map<uint64_t, Node> nodes;
+  std::unordered_map<uint64_t, std::unordered_set<uint64_t>> by_worker;
+
+  void drop_node(uint64_t hash) {
+    // unlink from parent, then drop the whole subtree (unreachable in a
+    // prefix walk once the chain is broken)
+    auto it = nodes.find(hash);
+    if (it == nodes.end()) return;
+    if (it->second.has_parent) {
+      auto pit = nodes.find(it->second.parent_hash);
+      if (pit != nodes.end()) pit->second.children.erase(hash);
+    }
+    std::vector<uint64_t> stack{hash};
+    while (!stack.empty()) {
+      uint64_t h = stack.back();
+      stack.pop_back();
+      auto nit = nodes.find(h);
+      if (nit == nodes.end()) continue;
+      for (uint64_t c : nit->second.children) stack.push_back(c);
+      for (uint64_t w : nit->second.workers) {
+        auto wit = by_worker.find(w);
+        if (wit != by_worker.end()) wit->second.erase(h);
+      }
+      nodes.erase(nit);
+    }
+  }
+
+  void remove_worker_block(uint64_t worker, uint64_t hash) {
+    std::vector<uint64_t> stack{hash};
+    while (!stack.empty()) {
+      uint64_t h = stack.back();
+      stack.pop_back();
+      auto it = nodes.find(h);
+      if (it == nodes.end()) continue;
+      it->second.workers.erase(worker);
+      auto wit = by_worker.find(worker);
+      if (wit != by_worker.end()) wit->second.erase(h);
+      for (uint64_t c : it->second.children) {
+        auto cit = nodes.find(c);
+        if (cit != nodes.end() && cit->second.workers.count(worker))
+          stack.push_back(c);
+      }
+      if (it->second.workers.empty()) drop_node(h);
+    }
+  }
+};
+
+}  // namespace
+
+void* dn_pi_new() { return new PrefixIndex(); }
+
+void dn_pi_free(void* h) { delete static_cast<PrefixIndex*>(h); }
+
+uint64_t dn_pi_size(void* h) {
+  return static_cast<PrefixIndex*>(h)->nodes.size();
+}
+
+void dn_pi_apply_stored(void* h, uint64_t worker, uint64_t parent,
+                        int has_parent, const uint64_t* hashes, int n) {
+  auto* pi = static_cast<PrefixIndex*>(h);
+  bool hp = has_parent != 0;
+  for (int i = 0; i < n; ++i) {
+    uint64_t bh = hashes[i];
+    auto it = pi->nodes.find(bh);
+    if (it == pi->nodes.end()) {
+      Node node;
+      node.parent_hash = parent;
+      node.has_parent = hp;
+      it = pi->nodes.emplace(bh, std::move(node)).first;
+      if (hp) {
+        auto pit = pi->nodes.find(parent);
+        if (pit != pi->nodes.end()) pit->second.children.insert(bh);
+      }
+    }
+    it->second.workers.insert(worker);
+    pi->by_worker[worker].insert(bh);
+    parent = bh;
+    hp = true;
+  }
+}
+
+void dn_pi_apply_removed(void* h, uint64_t worker, const uint64_t* hashes,
+                         int n) {
+  auto* pi = static_cast<PrefixIndex*>(h);
+  for (int i = 0; i < n; ++i) pi->remove_worker_block(worker, hashes[i]);
+}
+
+void dn_pi_remove_worker(void* h, uint64_t worker) {
+  auto* pi = static_cast<PrefixIndex*>(h);
+  auto wit = pi->by_worker.find(worker);
+  if (wit != pi->by_worker.end()) {
+    std::vector<uint64_t> held(wit->second.begin(), wit->second.end());
+    for (uint64_t bh : held) {
+      auto it = pi->nodes.find(bh);
+      if (it == pi->nodes.end()) continue;
+      it->second.workers.erase(worker);
+      if (it->second.workers.empty()) pi->drop_node(bh);
+    }
+  }
+  pi->by_worker.erase(worker);
+}
+
+// Walk the chained hashes; per worker, count consecutive-from-start
+// residency. Writes up to max_out (worker, score) pairs; returns the pair
+// count; *out_total = blocks examined (== query length).
+int dn_pi_find_matches(void* h, const uint64_t* hashes, int n,
+                       uint64_t* out_workers, uint32_t* out_scores,
+                       int max_out, int* out_total) {
+  auto* pi = static_cast<PrefixIndex*>(h);
+  std::unordered_map<uint64_t, uint32_t> scores;
+  std::unordered_set<uint64_t> active;
+  bool first = true;
+  int examined = 0;
+  for (int i = 0; i < n; ++i) {
+    ++examined;  // counts the breaking block too (matches PrefixIndex)
+    auto it = pi->nodes.find(hashes[i]);
+    if (it == pi->nodes.end()) break;
+    std::unordered_set<uint64_t> workers;
+    if (first) {
+      workers = it->second.workers;
+    } else {
+      for (uint64_t w : it->second.workers)
+        if (active.count(w)) workers.insert(w);
+    }
+    if (workers.empty()) break;
+    for (uint64_t w : workers) scores[w] += 1;
+    active = std::move(workers);
+    first = false;
+  }
+  *out_total = examined;
+  int k = 0;
+  for (const auto& [w, s] : scores) {
+    if (k >= max_out) break;
+    out_workers[k] = w;
+    out_scores[k] = s;
+    ++k;
+  }
+  return k;
+}
+
+}  // extern "C"

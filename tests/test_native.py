@@ -1,0 +1,113 @@
+"""Native C++ layer: build, hash compatibility, index differential."""
+
+import random
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.engine import allocator as pyalloc
+from dynamo_tpu.kv_router.indexer import PrefixIndex
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, RouterEvent, StoredBlock
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.build():
+        pytest.skip("native toolchain unavailable")
+
+
+def test_hashes_match_python():
+    rng = random.Random(7)
+    for _ in range(100):
+        toks = [rng.randrange(0, 1 << 31) for _ in range(rng.randrange(1, 64))]
+        assert native.block_token_hash(toks) == pyalloc.block_token_hash(toks)
+        bs = rng.choice([1, 2, 4, 8, 16, 32])
+        # compare against the pure-Python chain (bypass the native fast path)
+        expect, parent = [], None
+        for i in range(0, len(toks) - len(toks) % bs, bs):
+            local = pyalloc.block_token_hash(toks[i : i + bs])
+            parent = pyalloc.chain_hash(parent, local)
+            expect.append((local, parent))
+        assert native.sequence_block_hashes(toks, bs) == expect
+
+
+def _random_events(rng, n_workers=4, n_chains=6, depth=8):
+    """Plausible stored/removed event stream over shared chains."""
+    chains = []
+    for c in range(n_chains):
+        base = [rng.getrandbits(63) for _ in range(depth)]
+        chains.append(base)
+    events = []
+    held = {}  # (worker, chain) -> depth stored
+    for _ in range(300):
+        w = rng.randrange(n_workers)
+        c = rng.randrange(n_chains)
+        if rng.random() < 0.6:
+            d = rng.randrange(1, depth + 1)
+            parent = None
+            blocks = [StoredBlock(block_hash=h, tokens_hash=h) for h in chains[c][:d]]
+            events.append(
+                RouterEvent(
+                    worker_id=w,
+                    event=KvCacheEvent(kind="stored", parent_hash=parent, blocks=blocks),
+                )
+            )
+            held[(w, c)] = max(held.get((w, c), 0), d)
+        elif held:
+            # remove a suffix of something held
+            (w, c), d = rng.choice(list(held.items()))
+            cut = rng.randrange(0, d)
+            events.append(
+                RouterEvent(
+                    worker_id=w,
+                    event=KvCacheEvent(kind="removed", block_hashes=chains[c][cut:d]),
+                )
+            )
+            if cut == 0:
+                held.pop((w, c))
+            else:
+                held[(w, c)] = cut
+    return chains, events
+
+
+def test_index_differential_random_streams():
+    rng = random.Random(123)
+    for trial in range(5):
+        chains, events = _random_events(rng)
+        py = PrefixIndex()
+        cc = native.NativePrefixIndex()
+        for ev in events:
+            py.apply_event(ev)
+            cc.apply_event(ev)
+        assert cc.size == py.size, f"trial {trial}"
+        for chain in chains:
+            for d in (1, len(chain) // 2, len(chain)):
+                a = py.find_matches(chain[:d])
+                b = cc.find_matches(chain[:d])
+                assert a.scores == b.scores, f"trial {trial} depth {d}"
+                assert a.total_blocks == b.total_blocks
+        # worker removal
+        py.remove_worker(1)
+        cc.remove_worker(1)
+        assert cc.size == py.size
+        for chain in chains:
+            assert py.find_matches(chain).scores == cc.find_matches(chain).scores
+
+
+def test_native_index_basic_routing():
+    idx = native.NativePrefixIndex()
+    h = [native.chain_hash(None, native.block_token_hash([i])) for i in range(4)]
+    idx.apply_event(
+        RouterEvent(
+            worker_id=7,
+            event=KvCacheEvent(
+                kind="stored",
+                parent_hash=None,
+                blocks=[StoredBlock(block_hash=x, tokens_hash=x) for x in h],
+            ),
+        )
+    )
+    scores = idx.find_matches(h)
+    assert scores.scores == {7: 4}
+    idx.remove_worker(7)
+    assert idx.size == 0

@@ -41,6 +41,25 @@ def test_moe_roundtrip(tmp_path):
     )
 
 
+def test_moe_shared_experts_roundtrip(tmp_path):
+    _roundtrip(
+        tmp_path,
+        ModelConfig.tiny(
+            dtype="float32", num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=32, num_shared_experts=1,
+        ),
+    )
+
+
+def test_first_dense_layers_guard(tmp_path):
+    cfg = ModelConfig.tiny(
+        dtype="float32", num_experts=4, moe_intermediate_size=32,
+        first_dense_layers=1,
+    )
+    with pytest.raises(NotImplementedError):
+        load_llama_params(str(tmp_path / "missing"), cfg)
+
+
 def test_moe_config_from_hf():
     cfg = ModelConfig.from_hf_config(
         {
