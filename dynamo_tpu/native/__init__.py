@@ -60,8 +60,10 @@ def _try_load() -> Optional[ctypes.CDLL]:
         if path and os.path.exists(path):
             try:
                 return _bind(ctypes.CDLL(path))
-            except OSError:  # pragma: no cover — wrong arch / stale build
-                logger.exception("failed to load native lib at %s", path)
+            except (OSError, AttributeError):  # pragma: no cover — wrong
+                # arch, or a stale .so missing a newly-bound symbol: fall
+                # back to pure Python rather than poisoning every import
+                logger.warning("failed to load native lib at %s", path)
     return None
 
 

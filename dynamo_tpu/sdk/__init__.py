@@ -1,0 +1,43 @@
+"""Application SDK: compose components into deployable serving graphs.
+
+The TPU-native equivalent of the reference's BentoML-derived SDK
+(deploy/dynamo/sdk: ``@service``, ``@dynamo_endpoint``, ``depends()``,
+``dynamo serve`` — service.py:67-120, dependency.py:185, cli/serving.py).
+Differences are deliberate: no BentoML base, no circus — a service is a
+plain class, the graph is resolved from ``depends()`` edges, and the
+supervisor is a small asyncio subprocess manager with restart-on-crash.
+
+    from dynamo_tpu.sdk import service, dynamo_endpoint, depends
+
+    @service(namespace="app")
+    class Worker:
+        @dynamo_endpoint
+        async def generate(self, request):
+            yield {"out": request["x"] * 2}
+
+    @service(namespace="app")
+    class Frontend:
+        worker = depends(Worker)
+
+        @dynamo_endpoint
+        async def generate(self, request):
+            async for it in await self.worker.generate(request):
+                yield it
+
+Run in-process (tests, notebooks) with ``serve_graph``; multi-process
+with ``python -m dynamo_tpu.sdk.cli module:Frontend``.
+"""
+
+from .service import Dependency, ServiceSpec, depends, dynamo_endpoint, service
+from .serving import GraphRunner, Supervisor, serve_graph
+
+__all__ = [
+    "Dependency",
+    "GraphRunner",
+    "ServiceSpec",
+    "Supervisor",
+    "depends",
+    "dynamo_endpoint",
+    "serve_graph",
+    "service",
+]

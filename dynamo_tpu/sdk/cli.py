@@ -1,0 +1,83 @@
+"""``dynamo serve`` equivalent (ref deploy/dynamo/sdk cli/serve.py):
+
+    python -m dynamo_tpu.sdk.cli pkg.module:Frontend -f config.yaml \
+        [--hub HOST:PORT | --hub-port N]
+
+Starts a hub control plane if no --hub is given, then supervises one
+subprocess per service in the graph."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+
+def _load_config(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        try:
+            import yaml  # type: ignore
+
+            return yaml.safe_load(text) or {}
+        except ImportError:  # environment without pyyaml: JSON fallback
+            pass
+    return json.loads(text)
+
+
+async def main_async(args) -> None:
+    from .serving import Supervisor
+
+    hub_proc = None
+    hub = args.hub
+    if hub is None:
+        hub = f"127.0.0.1:{args.hub_port}"
+        hub_proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "dynamo_tpu.launch.dynamo_run", "hub",
+            "--hub-port", str(args.hub_port),
+        )
+        # hub startup pays the interpreter+jax import cost: poll until it
+        # answers so workers don't burn their restart budget on the race
+        from ..runtime.hub import connect_hub
+
+        for _ in range(120):
+            try:
+                _store, _bus, conn = await connect_hub(hub)
+                await conn.close()
+                break
+            except OSError:
+                await asyncio.sleep(0.5)
+        else:
+            raise SystemExit(f"hub at {hub} never came up")
+    config = _load_config(args.file) if args.file else {}
+    sup = Supervisor(args.graph, hub, config=config)
+    await sup.start()
+    print(f"serving graph {args.graph} on hub {hub}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await sup.stop()
+        if hub_proc is not None:
+            hub_proc.terminate()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("dynamo-serve")
+    p.add_argument("graph", help="pkg.module:LeafService")
+    p.add_argument("-f", "--file", default=None, help="per-service config (yaml/json)")
+    p.add_argument("--hub", default=None, help="existing hub host:port")
+    p.add_argument("--hub-port", type=int, default=18500)
+    args = p.parse_args()
+    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    try:
+        asyncio.run(main_async(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

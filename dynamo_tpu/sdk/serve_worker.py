@@ -1,0 +1,47 @@
+"""Subprocess entry for one SDK service (ref cli/serve_dynamo.py):
+``python -m dynamo_tpu.sdk.serve_worker pkg.module:Leaf ServiceName --hub H``.
+Connects to the hub control plane, serves exactly the named service from
+the graph, and runs until terminated."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime.hub import connect_hub
+from ..runtime.runtime import DistributedRuntime
+from .serving import GraphRunner, Supervisor
+from .service import resolve_graph
+
+
+async def main_async(args) -> None:
+    leaf = Supervisor._load_leaf(args.graph)
+    spec = next(
+        (s for s in resolve_graph(leaf) if s.name == args.service), None
+    )
+    if spec is None:
+        raise SystemExit(f"service {args.service!r} not in graph {args.graph}")
+    store, bus, _conn = await connect_hub(args.hub)
+    drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+    runner = GraphRunner(drt)
+    await runner.serve_service(spec)
+    print(f"sdk service {spec.name} up (worker {drt.worker_id:x})", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("dynamo_tpu.sdk.serve_worker")
+    p.add_argument("graph")
+    p.add_argument("service")
+    p.add_argument("--hub", required=True)
+    args = p.parse_args()
+    logging.basicConfig(level="INFO")
+    try:
+        asyncio.run(main_async(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

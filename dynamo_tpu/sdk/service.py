@@ -1,0 +1,167 @@
+"""Service model: decorators, dependency edges, graph resolution
+(ref deploy/dynamo/sdk/src/dynamo/sdk/lib/{service,decorators,dependency}.py).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+CONFIG_ENV = "DYNAMO_SERVICE_CONFIG"  # per-service config JSON (ref service.py:96)
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    namespace: str
+    component: str  # component name in the runtime path scheme
+    config: dict = field(default_factory=dict)
+    cls: Optional[type] = None
+
+    def _attrs(self) -> dict[str, Any]:
+        """Class attributes including inherited ones (MRO order, subclass
+        wins) — a @service may factor endpoints into a base class."""
+        merged: dict[str, Any] = {}
+        for klass in reversed(self.cls.__mro__):
+            merged.update(vars(klass))
+        return merged
+
+    def endpoints(self) -> dict[str, Callable]:
+        """endpoint name -> unbound async-generator function."""
+        out = {}
+        for attr, val in self._attrs().items():
+            ep = getattr(val, "_dynamo_endpoint", None)
+            if ep:
+                out[ep] = val
+        return out
+
+    def dependencies(self) -> dict[str, "Dependency"]:
+        """attribute name -> Dependency declared on the class."""
+        return {
+            attr: val
+            for attr, val in self._attrs().items()
+            if isinstance(val, Dependency)
+        }
+
+    def runtime_config(self) -> dict:
+        """Static config overlaid with DYNAMO_SERVICE_CONFIG[name]."""
+        merged = dict(self.config)
+        raw = os.environ.get(CONFIG_ENV)
+        if raw:
+            try:
+                merged.update(json.loads(raw).get(self.name, {}))
+            except (ValueError, AttributeError):
+                pass
+        return merged
+
+
+def service(
+    cls: Optional[type] = None,
+    *,
+    name: Optional[str] = None,
+    namespace: str = "dynamo",
+    **config: Any,
+):
+    """Class decorator registering a graph component (ref @service,
+    lib/service.py:202). Usable bare or with arguments."""
+
+    def wrap(c: type) -> type:
+        svc_name = name or c.__name__
+        c._dynamo_service = ServiceSpec(
+            name=svc_name,
+            namespace=namespace,
+            component=svc_name.lower(),
+            config=config,
+            cls=c,
+        )
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def dynamo_endpoint(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """Marks an async-generator method as a servable endpoint
+    (ref @dynamo_endpoint, decorators.py:61)."""
+
+    def wrap(f: Callable) -> Callable:
+        if not inspect.isasyncgenfunction(f):
+            raise TypeError(
+                f"@dynamo_endpoint {f.__name__} must be an async generator "
+                "(async def ... yield ...)"
+            )
+        f._dynamo_endpoint = name or f.__name__
+        return f
+
+    return wrap(fn) if fn is not None else wrap
+
+
+class Dependency:
+    """A graph edge declared as a class attribute (ref depends(),
+    dependency.py:185). Resolved by the runner into a client proxy whose
+    endpoint methods return streams of payloads."""
+
+    def __init__(self, target: type):
+        spec = getattr(target, "_dynamo_service", None)
+        if spec is None:
+            raise TypeError(f"depends() target {target!r} is not a @service")
+        self.target = target
+        self.spec: ServiceSpec = spec
+
+
+def depends(target: type) -> Any:
+    return Dependency(target)
+
+
+def resolve_graph(leaf: type) -> list[ServiceSpec]:
+    """Topological order (dependencies first) of the graph rooted at
+    ``leaf`` (ref LinkedServices resolution)."""
+    order: list[ServiceSpec] = []
+    seen: set[type] = set()
+
+    def visit(cls: type, path: tuple = ()):
+        if cls in path:
+            cycle = " -> ".join(c.__name__ for c in path + (cls,))
+            raise ValueError(f"dependency cycle: {cycle}")
+        if cls in seen:
+            return
+        spec: ServiceSpec = cls._dynamo_service
+        for dep in spec.dependencies().values():
+            visit(dep.target, path + (cls,))
+        seen.add(cls)
+        order.append(spec)
+
+    visit(leaf)
+    return order
+
+
+class EndpointProxy:
+    """``await proxy.generate(payload)`` -> async iterator of payloads."""
+
+    def __init__(self, get_stream: Callable, endpoint: str):
+        self._get_stream = get_stream
+        self._endpoint = endpoint
+
+    async def __call__(self, payload: Any) -> AsyncIterator[Any]:
+        return await self._get_stream(self._endpoint, payload)
+
+
+class ServiceClient:
+    """What a ``depends()`` attribute becomes at runtime: endpoint-name
+    attribute access yields callables streaming from the dependency."""
+
+    def __init__(self, spec: ServiceSpec, get_stream: Callable):
+        self._spec = spec
+        self._get_stream = get_stream
+
+    def __getattr__(self, name: str) -> EndpointProxy:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._spec.endpoints():
+            raise AttributeError(
+                f"{self._spec.name} has no endpoint {name!r} "
+                f"(has: {sorted(self._spec.endpoints())})"
+            )
+        return EndpointProxy(self._get_stream, name)

@@ -1,0 +1,196 @@
+"""Graph runners: in-process (tests/notebooks) and multi-process
+supervisor (ref cli/serving.py's circus watchers — here a plain asyncio
+subprocess manager with restart-on-crash)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import sys
+from typing import Any, AsyncIterator, Optional
+
+from ..runtime.component import Client
+from ..runtime.engine import AsyncEngine, Context
+from ..runtime.runtime import DistributedRuntime
+from .service import ServiceClient, ServiceSpec, resolve_graph
+
+logger = logging.getLogger(__name__)
+
+
+class _BoundEndpointEngine(AsyncEngine):
+    """Adapts a bound @dynamo_endpoint async generator to AsyncEngine."""
+
+    def __init__(self, bound_fn):
+        self._fn = bound_fn
+
+    async def generate(self, request: Context) -> AsyncIterator[Any]:
+        async for item in self._fn(request.data):
+            yield item
+
+
+class GraphRunner:
+    """Instantiates services, injects dependency clients, registers every
+    endpoint on the DistributedRuntime. One runner can host the whole
+    graph (in-process mode) or a single service (subprocess mode)."""
+
+    def __init__(self, drt: DistributedRuntime):
+        self.drt = drt
+        self.instances: dict[str, object] = {}
+        self._handles: list = []
+        # one cached client per (namespace, component, endpoint) — created
+        # on first use, reused for every subsequent dependency call
+        self._clients: dict[tuple[str, str, str], Client] = {}
+        self._client_locks: dict[tuple[str, str, str], asyncio.Lock] = {}
+
+    async def serve_graph(self, leaf: type) -> None:
+        for spec in resolve_graph(leaf):
+            await self.serve_service(spec)
+
+    async def serve_service(self, spec: ServiceSpec) -> None:
+        instance = spec.cls()
+        # config + dependency injection before user __init__ hooks run
+        instance.dynamo_config = spec.runtime_config()
+        for attr, dep in spec.dependencies().items():
+            setattr(instance, attr, await self._client_for(dep.spec))
+        if hasattr(instance, "async_init"):
+            await instance.async_init()
+        self.instances[spec.name] = instance
+        component = self.drt.namespace(spec.namespace).component(spec.component)
+        for ep_name, fn in spec.endpoints().items():
+            engine = _BoundEndpointEngine(getattr(instance, fn.__name__))
+            handle = await component.endpoint(ep_name).serve(
+                engine,
+                stats_handler=getattr(instance, "stats_handler", None),
+            )
+            self._handles.append(handle)
+        logger.info(
+            "service %s serving %s at %s/%s",
+            spec.name, sorted(spec.endpoints()), spec.namespace, spec.component,
+        )
+
+    async def _cached_client(self, spec: ServiceSpec, endpoint: str) -> Client:
+        key = (spec.namespace, spec.component, endpoint)
+        lock = self._client_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(key)
+            if client is None:
+                ep = (
+                    self.drt.namespace(spec.namespace)
+                    .component(spec.component)
+                    .endpoint(endpoint)
+                )
+                client = await ep.client().start()
+                await client.wait_for_instances()
+                self._clients[key] = client
+        return client
+
+    async def _client_for(self, spec: ServiceSpec) -> ServiceClient:
+        runner = self
+
+        async def get_stream(endpoint: str, payload: Any):
+            client = await runner._cached_client(spec, endpoint)
+            stream = await client.generate(Context(payload))
+
+            async def payloads():
+                async for item in stream:
+                    data = getattr(item, "data", item)
+                    if getattr(item, "error", None):
+                        raise RuntimeError(item.error)
+                    if data is not None:
+                        yield data
+
+            return payloads()
+
+        return ServiceClient(spec, get_stream)
+
+    async def stop(self) -> None:
+        for c in self._clients.values():
+            c.stop()
+        self._clients.clear()
+        for h in self._handles:
+            await h.stop()
+        self._handles.clear()
+
+
+async def serve_graph(drt: DistributedRuntime, leaf: type) -> GraphRunner:
+    runner = GraphRunner(drt)
+    await runner.serve_graph(leaf)
+    return runner
+
+
+class Supervisor:
+    """One subprocess per service with restart-on-crash (ref circus
+    watchers, cli/serving.py:118-157)."""
+
+    def __init__(
+        self,
+        graph_target: str,  # "pkg.module:LeafService"
+        hub: str,
+        config: Optional[dict] = None,
+        max_restarts: int = 5,
+    ):
+        self.graph_target = graph_target
+        self.hub = hub
+        self.config = config or {}
+        self.max_restarts = max_restarts
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+
+    @staticmethod
+    def _load_leaf(target: str) -> type:
+        import importlib
+
+        mod_name, _, cls_name = target.partition(":")
+        return getattr(importlib.import_module(mod_name), cls_name)
+
+    async def start(self) -> None:
+        leaf = self._load_leaf(self.graph_target)
+        for spec in resolve_graph(leaf):
+            self._tasks.append(
+                asyncio.get_running_loop().create_task(self._watch(spec))
+            )
+
+    HEALTHY_RESET_S = 60.0  # a run this long forgives earlier crashes
+
+    async def _watch(self, spec: ServiceSpec) -> None:
+        restarts = 0
+        while not self._stopping and restarts <= self.max_restarts:
+            env = dict(os.environ)
+            env["DYNAMO_SERVICE_CONFIG"] = json.dumps(self.config)
+            started = asyncio.get_running_loop().time()
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "dynamo_tpu.sdk.serve_worker",
+                self.graph_target, spec.name, "--hub", self.hub,
+                env=env,
+            )
+            self._procs[spec.name] = proc
+            rc = await proc.wait()
+            if self._stopping:
+                return
+            uptime = asyncio.get_running_loop().time() - started
+            if uptime >= self.HEALTHY_RESET_S:
+                restarts = 0  # crash-looping, not an occasional crash
+            restarts += 1
+            logger.warning(
+                "service %s exited rc=%s after %.0fs; restart %d/%d",
+                spec.name, rc, uptime, restarts, self.max_restarts,
+            )
+            await asyncio.sleep(min(2.0 * restarts, 10.0))
+        if not self._stopping:
+            logger.error("service %s exceeded restart budget", spec.name)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for proc in self._procs.values():
+            if proc.returncode is None:
+                proc.terminate()
+        for proc in self._procs.values():
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=10.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+        for t in self._tasks:
+            t.cancel()

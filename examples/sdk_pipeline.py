@@ -1,0 +1,41 @@
+"""Minimal 3-stage SDK graph (ref examples/hello_world/hello_world.py):
+Frontend -> Middle -> Backend, each stage transforming a text stream.
+
+In-process:
+    drt = await DistributedRuntime.from_settings()
+    runner = await serve_graph(drt, Frontend)
+
+Multi-process:
+    python -m dynamo_tpu.sdk.cli examples.sdk_pipeline:Frontend
+"""
+
+from dynamo_tpu.sdk import depends, dynamo_endpoint, service
+
+
+@service(namespace="hello")
+class Backend:
+    @dynamo_endpoint
+    async def generate(self, request):
+        text = request["text"]
+        for word in text.split():
+            yield {"text": f"{word}-back"}
+
+
+@service(namespace="hello")
+class Middle:
+    backend = depends(Backend)
+
+    @dynamo_endpoint
+    async def generate(self, request):
+        async for item in await self.backend.generate(request):
+            yield {"text": item["text"] + "-mid"}
+
+
+@service(namespace="hello")
+class Frontend:
+    middle = depends(Middle)
+
+    @dynamo_endpoint
+    async def generate(self, request):
+        async for item in await self.middle.generate(request):
+            yield {"text": item["text"] + "-front"}

@@ -1,0 +1,154 @@
+"""SDK graph tests: decorators, resolution, in-process serving, config
+injection, and the multi-process supervisor over a real hub
+(ref deploy/dynamo/sdk tests/e2e.py)."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.sdk import depends, dynamo_endpoint, serve_graph, service
+from dynamo_tpu.sdk.service import resolve_graph
+
+from examples.sdk_pipeline import Backend, Frontend, Middle
+
+
+def test_graph_resolution_order():
+    order = [s.name for s in resolve_graph(Frontend)]
+    assert order == ["Backend", "Middle", "Frontend"]
+
+
+def test_cycle_detection():
+    @service
+    class A:
+        pass
+
+    @service
+    class B:
+        a = depends(A)
+
+    # introduce a cycle after definition
+    A.b = depends(B)
+    with pytest.raises(ValueError, match="cycle"):
+        resolve_graph(B)
+
+
+def test_inherited_endpoints_visible():
+    class BaseMixin:
+        @dynamo_endpoint
+        async def generate(self, request):
+            yield request
+
+    @service(namespace="inh")
+    class Child(BaseMixin):
+        pass
+
+    assert "generate" in Child._dynamo_service.endpoints()
+
+
+def test_endpoint_must_be_async_generator():
+    with pytest.raises(TypeError, match="async generator"):
+
+        @service
+        class Bad:
+            @dynamo_endpoint
+            async def nope(self, request):
+                return request
+
+
+async def _call(drt, namespace, component, endpoint, payload):
+    client = await (
+        drt.namespace(namespace).component(component).endpoint(endpoint)
+        .client().start()
+    )
+    await client.wait_for_instances()
+    stream = await client.generate(Context(payload))
+    out = []
+    async for item in stream:
+        if item.data is not None:
+            out.append(item.data)
+    client.stop()
+    return out
+
+
+def test_three_stage_graph_in_process(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        runner = await serve_graph(drt, Frontend)
+        out = await _call(drt, "hello", "frontend", "generate", {"text": "a b"})
+        assert [o["text"] for o in out] == [
+            "a-back-mid-front", "b-back-mid-front"
+        ]
+        await runner.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
+def test_config_injection(run, monkeypatch):
+    @service(namespace="cfged", threshold=5)
+    class Svc:
+        @dynamo_endpoint
+        async def generate(self, request):
+            yield {"threshold": self.dynamo_config["threshold"]}
+
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        runner = await serve_graph(drt, Svc)
+        out = await _call(drt, "cfged", "svc", "generate", {})
+        assert out == [{"threshold": 9}]  # env overrides static config
+        await runner.stop()
+        await drt.shutdown()
+
+    monkeypatch.setenv("DYNAMO_SERVICE_CONFIG", json.dumps({"Svc": {"threshold": 9}}))
+    run(main())
+
+
+@pytest.mark.slow
+def test_supervisor_multiprocess(run, tmp_path):
+    """Full deployment path: hub subprocess + one subprocess per service."""
+
+    async def main():
+        from dynamo_tpu.runtime.hub import connect_hub
+        from dynamo_tpu.sdk.serving import Supervisor
+
+        hub_proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "dynamo_tpu.launch.dynamo_run", "hub",
+            "--hub-port", "18611",
+            cwd="/root/repo",
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            # hub startup pays the interpreter+jax import cost; poll until up
+            store = bus = conn = None
+            for _ in range(60):
+                try:
+                    store, bus, conn = await connect_hub("127.0.0.1:18611")
+                    break
+                except OSError:
+                    await asyncio.sleep(0.5)
+            assert store is not None, "hub never came up"
+            sup = Supervisor("examples.sdk_pipeline:Frontend", "127.0.0.1:18611")
+            await sup.start()
+            drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+            out = None
+            for _ in range(60):  # wait for all three services to come up
+                try:
+                    out = await _call(
+                        drt, "hello", "frontend", "generate", {"text": "x"}
+                    )
+                    if out:
+                        break
+                except Exception:  # noqa: BLE001 — not up yet
+                    await asyncio.sleep(0.5)
+            assert out == [{"text": "x-back-mid-front"}]
+            await sup.stop()
+            await drt.shutdown()
+        finally:
+            hub_proc.terminate()
+            await hub_proc.wait()
+
+    run(main())
