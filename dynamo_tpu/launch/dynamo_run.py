@@ -412,7 +412,8 @@ def main(argv=None) -> None:
         elif tok == "hub":
             args.in_ = "hub"
 
-    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    from ..utils.logging import setup_logging
+    setup_logging()
 
     if args.in_ == "hub":
         coro = run_hub(args)

@@ -1,0 +1,17 @@
+"""Observability plane (ref components/metrics, §2.3 + SURVEY §5).
+
+Three tiers, like the reference:
+ 1. per-process Prometheus counters in the HTTP frontend
+    (dynamo_tpu/http/metrics.py),
+ 2. per-endpoint stats handlers scraped over the bus
+    (runtime/component.py stats subjects + kv_router KvMetricsAggregator),
+ 3. THIS package — the fleet-level aggregation component: scrapes every
+    worker of an endpoint, subscribes the kv-hit-rate event plane, and
+    serves Prometheus gauges (kv_blocks_active/total,
+    requests_active/total, …) for ops dashboards
+    (ref components/metrics/src/{main,lib}.rs:255,145-364).
+"""
+
+from .component import MetricsComponent, MockWorker
+
+__all__ = ["MetricsComponent", "MockWorker"]

@@ -1,0 +1,51 @@
+"""Standalone metrics component (ref components/metrics/src/main.rs):
+
+    python -m dynamo_tpu.observability dynamo.backend.generate \
+        --hub 127.0.0.1:18500 --port 18090
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+
+async def main_async(args) -> None:
+    from ..runtime.hub import connect_hub
+    from ..runtime.runtime import DistributedRuntime
+    from .component import MetricsComponent
+
+    ns, comp, _ep = args.target.split(".")
+    if args.hub:
+        store, bus, _conn = await connect_hub(args.hub)
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+    else:
+        drt = await DistributedRuntime.from_settings()
+    component = drt.namespace(ns).component(comp)
+    mc = await MetricsComponent(
+        drt, component, host=args.host, port=args.port, interval=args.interval
+    ).start()
+    print(f"metrics for {args.target} on http://{args.host}:{mc.port}/metrics",
+          flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("dynamo-metrics")
+    p.add_argument("target", help="ns.component.endpoint to scrape")
+    p.add_argument("--hub", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=18090)
+    p.add_argument("--interval", type=float, default=1.0)
+    from ..utils.logging import setup_logging
+    setup_logging()
+    try:
+        asyncio.run(main_async(p.parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

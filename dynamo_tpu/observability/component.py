@@ -1,0 +1,188 @@
+"""Fleet metrics aggregation component (ref components/metrics/src/lib.rs).
+
+Scrapes the target endpoint's per-worker stats on an interval (via
+KvMetricsAggregator), folds them into fleet gauges, subscribes
+``kv-hit-rate`` events from routers, and serves a Prometheus text
+endpoint:
+
+    dynamo_tpu_kv_blocks_active{worker="..."} / kv_blocks_total
+    dynamo_tpu_requests_active{worker="..."} / requests_total_slots
+    dynamo_tpu_requests_waiting{worker="..."}
+    dynamo_tpu_kv_hit_rate (running ratio of overlap to prompt blocks)
+    dynamo_tpu_load_avg / dynamo_tpu_load_std (the scheduler's view)
+
+Run standalone: ``python -m dynamo_tpu.observability --hub H ns.comp.ep``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..kv_router.protocols import KV_HIT_RATE_SUBJECT, KVHitRateEvent
+from ..kv_router.publisher import KvMetricsAggregator
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsComponent:
+    def __init__(
+        self,
+        drt,
+        component,
+        host: str = "0.0.0.0",
+        port: int = 18090,
+        interval: float = 1.0,
+        prefix: str = "dynamo_tpu",
+    ):
+        self.drt = drt
+        self.component = component
+        self.host = host
+        self.port = port
+        self.prefix = prefix
+        self.aggregator = KvMetricsAggregator(drt, component, interval=interval)
+        self.hit_events = 0
+        self.hit_isl_blocks = 0
+        self.hit_overlap_blocks = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._hit_task = None
+
+    async def start(self) -> "MetricsComponent":
+        await self.aggregator.start()
+        sub = self.drt.bus.subscribe(
+            self.component.event_subject(KV_HIT_RATE_SUBJECT)
+        )
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._hit_task = self.drt.runtime.spawn(self._consume_hits(sub))
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def close(self) -> None:
+        if self._hit_task is not None:
+            self._hit_task.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _consume_hits(self, sub) -> None:
+        async for msg in sub:
+            try:
+                ev = KVHitRateEvent.from_bytes(msg.payload)
+                self.hit_events += 1
+                self.hit_isl_blocks += ev.isl_blocks
+                self.hit_overlap_blocks += ev.overlap_blocks
+            except Exception:  # noqa: BLE001
+                logger.exception("bad kv-hit-rate event")
+
+    # ---------------- rendering ----------------
+
+    def render(self) -> str:
+        p = self.prefix
+        lines: list[str] = []
+
+        def gauge(name: str, value, labels: str = "") -> None:
+            lines.append(f"{p}_{name}{{{labels}}} {value}"
+                         if labels else f"{p}_{name} {value}")
+
+        ep = self.aggregator.endpoints
+        for w in ep.loads:
+            lb = f'worker="{w.worker_id:x}"'
+            gauge("kv_blocks_active", w.kv_active_blocks, lb)
+            gauge("kv_blocks_total", w.kv_total_blocks, lb)
+            gauge("requests_active", w.active_requests, lb)
+            gauge("requests_total_slots", w.total_slots, lb)
+            gauge("requests_waiting", w.waiting, lb)
+        gauge("worker_count", len(ep.loads))
+        gauge("load_avg", round(ep.load_avg, 6))
+        gauge("load_std", round(ep.load_std, 6))
+        if self.hit_isl_blocks:
+            gauge(
+                "kv_hit_rate",
+                round(self.hit_overlap_blocks / self.hit_isl_blocks, 6),
+            )
+        gauge("kv_hit_events_total", self.hit_events)
+        return "\n".join(lines) + "\n"
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            # minimal HTTP: read request line + headers, serve GET /metrics
+            line = await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            path = line.split()[1].decode() if len(line.split()) > 1 else "/"
+            if path in ("/metrics", "/"):
+                body = self.render().encode()
+                status = b"200 OK"
+                ctype = b"text/plain; version=0.0.4"
+            elif path == "/health":
+                body = b'{"status":"ok"}'
+                status = b"200 OK"
+                ctype = b"application/json"
+            else:
+                body = b"not found"
+                status = b"404 Not Found"
+                ctype = b"text/plain"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\nContent-Type: " + ctype
+                + b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        except Exception:  # noqa: BLE001
+            logger.exception("metrics request failed")
+        finally:
+            writer.close()
+
+
+class MockWorker:
+    """Registers a stats handler publishing synthetic load metrics —
+    exercises the scrape/aggregate/Prometheus path with no real engine
+    (ref components/metrics/src/bin/mock_worker.rs:36)."""
+
+    def __init__(self, drt, namespace: str, component: str, endpoint: str, seed: int = 0):
+        import random
+
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.endpoint = endpoint
+        self._rng = random.Random(seed)
+        self._handle = None
+
+    def _stats(self) -> dict:
+        r = self._rng
+        total = 128
+        active = r.randrange(0, total)
+        return {
+            "kv_active_blocks": active,
+            "kv_total_blocks": total,
+            "gpu_cache_usage_perc": active / total,
+            "request_active_slots": r.randrange(0, 8),
+            "request_total_slots": 8,
+            "num_requests_waiting": r.randrange(0, 4),
+        }
+
+    async def start(self) -> "MockWorker":
+        from ..runtime.engine import AsyncEngine
+
+        class _Noop(AsyncEngine):
+            async def generate(self, request):
+                yield {"mock": True}
+
+        comp = self.drt.namespace(self.namespace).component(self.component)
+        self._handle = await comp.endpoint(self.endpoint).serve(
+            _Noop(), stats_handler=self._stats
+        )
+        return self
+
+    async def close(self) -> None:
+        if self._handle is not None:
+            await self._handle.stop()

@@ -72,7 +72,8 @@ def main() -> None:
     p.add_argument("--hub", default=None, help="existing hub host:port")
     p.add_argument("--hub-port", type=int, default=18500)
     args = p.parse_args()
-    logging.basicConfig(level=os.environ.get("DYN_LOG", "INFO"))
+    from ..utils.logging import setup_logging
+    setup_logging()
     try:
         asyncio.run(main_async(args))
     except KeyboardInterrupt:

@@ -1,0 +1,5 @@
+"""Shared utilities (logging setup, …)."""
+
+from .logging import setup_logging
+
+__all__ = ["setup_logging"]

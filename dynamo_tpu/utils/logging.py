@@ -1,0 +1,67 @@
+"""Process logging setup (ref lib/runtime/src/logging.rs:16-70).
+
+Environment contract mirrors the reference:
+
+  * ``DYN_LOG``           — level or comma filter (``info``,
+    ``dynamo_tpu.engine=debug,warn``): per-logger levels with an
+    optional bare default.
+  * ``DYN_LOGGING_JSONL`` — when truthy, one JSON object per line
+    (ts/level/target/message + exc) for log shippers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+import traceback
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exception"] = "".join(
+                traceback.format_exception(*record.exc_info)
+            )
+        return json.dumps(out, ensure_ascii=False)
+
+
+def setup_logging(default_level: str = "INFO") -> None:
+    spec = os.environ.get("DYN_LOG", default_level)
+    jsonl = os.environ.get("DYN_LOGGING_JSONL", "") not in ("", "0", "false")
+
+    root_level = "INFO"
+    per_logger: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, lvl = part.partition("=")
+            per_logger[name.strip()] = lvl.strip().upper()
+        else:
+            root_level = part.upper()
+
+    handler = logging.StreamHandler(sys.stderr)
+    if jsonl:
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(root_level)
+    for name, lvl in per_logger.items():
+        logging.getLogger(name).setLevel(lvl)

@@ -1,0 +1,32 @@
+"""Logging contract: DYN_LOG filters + JSONL output (ref logging.rs)."""
+
+import json
+import logging
+
+from dynamo_tpu.utils.logging import JsonlFormatter, setup_logging
+
+
+def test_jsonl_formatter_roundtrip():
+    rec = logging.LogRecord(
+        "dynamo_tpu.engine", logging.WARNING, __file__, 1, "oops %d", (7,), None
+    )
+    out = json.loads(JsonlFormatter().format(rec))
+    assert out["level"] == "WARNING"
+    assert out["target"] == "dynamo_tpu.engine"
+    assert out["message"] == "oops 7"
+    assert "ts" in out
+
+
+def test_dyn_log_filters(monkeypatch):
+    monkeypatch.setenv("DYN_LOG", "warn,dynamo_tpu.engine=debug")
+    setup_logging()
+    assert logging.getLogger().level == logging.WARNING
+    assert logging.getLogger("dynamo_tpu.engine").level == logging.DEBUG
+
+
+def test_jsonl_env_switch(monkeypatch):
+    monkeypatch.setenv("DYN_LOG", "info")
+    monkeypatch.setenv("DYN_LOGGING_JSONL", "1")
+    setup_logging()
+    handler = logging.getLogger().handlers[0]
+    assert isinstance(handler.formatter, JsonlFormatter)

@@ -1,0 +1,54 @@
+"""Metrics aggregation component: mock-worker scrape -> Prometheus text
+(ref components/metrics tests via mock_worker.rs)."""
+
+import asyncio
+
+from dynamo_tpu.kv_router.protocols import KV_HIT_RATE_SUBJECT, KVHitRateEvent
+from dynamo_tpu.observability import MetricsComponent, MockWorker
+from dynamo_tpu.runtime import DistributedRuntime
+
+
+async def _fetch(port: int, path: str = "/metrics") -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), timeout=5)
+    writer.close()
+    return raw.decode()
+
+
+def test_metrics_component_scrape_and_render(run):
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        # each worker needs its own lease (instance identity) — separate
+        # runtimes sharing the control plane
+        drt2 = await DistributedRuntime.from_settings(store=drt.store, bus=drt.bus)
+        w1 = await MockWorker(drt, "obs", "workers", "generate", seed=1).start()
+        w2 = await MockWorker(drt2, "obs", "workers", "generate", seed=2).start()
+        comp = drt.namespace("obs").component("workers")
+        mc = await MetricsComponent(
+            drt, comp, host="127.0.0.1", port=0, interval=0.1
+        ).start()
+        await asyncio.sleep(0.3)
+        text = await _fetch(mc.port)
+        assert "dynamo_tpu_kv_blocks_active" in text
+        assert "dynamo_tpu_worker_count" in text
+        assert "dynamo_tpu_load_avg" in text
+        # health endpoint
+        assert "ok" in await _fetch(mc.port, "/health")
+        # hit-rate event plane feeds the gauge
+        drt.bus.publish(
+            comp.event_subject(KV_HIT_RATE_SUBJECT),
+            KVHitRateEvent(worker_id=1, isl_blocks=10, overlap_blocks=5).to_bytes(),
+        )
+        await asyncio.sleep(0.1)
+        text = await _fetch(mc.port)
+        assert "dynamo_tpu_kv_hit_rate 0.5" in text
+        assert "dynamo_tpu_kv_hit_events_total 1" in text
+        await mc.close()
+        await w1.close()
+        await w2.close()
+        await drt2.shutdown()
+        await drt.shutdown()
+
+    run(main())
