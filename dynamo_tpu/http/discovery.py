@@ -66,10 +66,12 @@ async def register_model(drt, entry: ModelEntry, use_lease: bool = True) -> None
         await put
 
 
-async def unregister_model(drt, model_type: str, name: str) -> None:
-    deleted = drt.store.kv_delete(f"{MODEL_ROOT}/{model_type}/{name}")
+async def unregister_model(drt, model_type: str, name: str) -> int:
+    """llmctl remove: drop every worker's entry for this model."""
+    deleted = drt.store.kv_delete_prefix(f"{MODEL_ROOT}/{model_type}/{name}/")
     if asyncio.iscoroutine(deleted):
-        await deleted
+        deleted = await deleted
+    return int(deleted)
 
 
 async def list_models(drt) -> list[ModelEntry]:

@@ -1,0 +1,132 @@
+"""llmctl — operator CLI for the model registry.
+
+Re-design of the reference's ``llmctl`` binary (launch/llmctl/src/main.rs:
+16-100): CRUD of ``ModelEntry`` records in the control-plane store, which
+the HTTP frontend's ModelWatcher turns into live routes.
+
+  llmctl --hub 127.0.0.1:7001 http add chat-model  meta/llama-3-8b dynamo.backend.generate
+  llmctl http list
+  llmctl http remove chat-model meta/llama-3-8b
+
+Entries added here are *unleased* (they survive the CLI exiting); worker
+self-registrations are leased and vanish with the worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import Optional
+
+from ..http.discovery import (
+    ModelEntry,
+    list_models,
+    register_model,
+    unregister_model,
+)
+from ..runtime.runtime import DistributedRuntime
+
+_KIND_TO_TYPE = {
+    "chat-model": "chat",
+    "completion-model": "completion",
+    "model": "both",
+}
+
+
+def _parse_endpoint(path: str) -> tuple[str, str, str]:
+    """``ns.component.endpoint`` (ref protocols.rs:48-80 Endpoint path)."""
+    parts = path.removeprefix("dyn://").split(".")
+    if len(parts) != 3 or not all(parts):
+        raise SystemExit(
+            f"invalid endpoint path {path!r}: expected namespace.component.endpoint"
+        )
+    return parts[0], parts[1], parts[2]
+
+
+async def _connect(hub: Optional[str]) -> DistributedRuntime:
+    import os
+
+    if not hub and not os.environ.get("DYN_RUNTIME_HUB_URL"):
+        raise SystemExit(
+            "llmctl needs a control-plane hub: pass --hub host:port or set "
+            "DYN_RUNTIME_HUB_URL (a private in-process store would make "
+            "add/remove silent no-ops)"
+        )
+    return await DistributedRuntime.from_settings(hub_url=hub)
+
+
+async def cmd_add(args) -> None:
+    drt = await _connect(args.hub)
+    try:
+        ns, comp, ep = _parse_endpoint(args.endpoint)
+        entry = ModelEntry(
+            name=args.name,
+            namespace=ns,
+            component=comp,
+            endpoint=ep,
+            model_type=_KIND_TO_TYPE[args.kind],
+            instance=1,  # static registration, not tied to a worker lease
+        )
+        await register_model(drt, entry, use_lease=False)
+        print(f"added {args.kind} {args.name} -> {ns}.{comp}.{ep}")
+    finally:
+        await drt.shutdown()
+
+
+async def cmd_list(args) -> None:
+    drt = await _connect(args.hub)
+    try:
+        entries = await list_models(drt)
+        if not entries:
+            print("no models registered")
+            return
+        w = max(len(e.name) for e in entries)
+        for e in sorted(entries, key=lambda e: (e.model_type, e.name)):
+            print(
+                f"{e.model_type:<11} {e.name:<{w}} "
+                f"{e.namespace}.{e.component}.{e.endpoint} "
+                f"[instance {e.instance:x}]"
+            )
+    finally:
+        await drt.shutdown()
+
+
+async def cmd_remove(args) -> None:
+    drt = await _connect(args.hub)
+    try:
+        n = await unregister_model(drt, _KIND_TO_TYPE[args.kind], args.name)
+        print(f"removed {n} entr{'y' if n == 1 else 'ies'} for {args.name}")
+    finally:
+        await drt.shutdown()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl", description=__doc__)
+    p.add_argument("--hub", default=None, help="control-plane hub host:port")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http", help="manage HTTP frontend model routes")
+    hsub = http.add_subparsers(dest="verb", required=True)
+
+    add = hsub.add_parser("add")
+    add.add_argument("kind", choices=sorted(_KIND_TO_TYPE))
+    add.add_argument("name")
+    add.add_argument("endpoint", help="namespace.component.endpoint")
+    add.set_defaults(fn=cmd_add)
+
+    ls = hsub.add_parser("list")
+    ls.set_defaults(fn=cmd_list)
+
+    rm = hsub.add_parser("remove")
+    rm.add_argument("kind", choices=sorted(_KIND_TO_TYPE))
+    rm.add_argument("name")
+    rm.set_defaults(fn=cmd_remove)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    asyncio.run(args.fn(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,7 @@ class HubServer:
         self._host, self._port = host, port
         self._server: Optional[asyncio.base_events.Server] = None
         self.address = ""
+        self._writers: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         self.store.start()
@@ -63,12 +64,19 @@ class HubServer:
     async def close(self) -> None:
         if self._server:
             self._server.close()
+            # drop live client connections — wait_closed() (3.12) blocks
+            # until every handler ends, and clients that died without a
+            # clean close (killed worker host) would hang it forever;
+            # abort() skips the write-buffer drain a dead peer never ACKs
+            for w in list(self._writers):
+                w.transport.abort()
             await self._server.wait_closed()
             self._server = None
         await self.store.close()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         session = _Session(self, writer)
+        self._writers.add(writer)
         try:
             while True:
                 frame = await read_frame(reader)
@@ -82,6 +90,7 @@ class HubServer:
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
+            self._writers.discard(writer)
             await session.cleanup()
             writer.close()
 

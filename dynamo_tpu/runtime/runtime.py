@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import signal
 import sys
 from typing import Awaitable, Callable, Optional
@@ -25,7 +24,6 @@ from .tcp import TcpStreamServer
 
 logger = logging.getLogger(__name__)
 
-GRACEFUL_SHUTDOWN_TIMEOUT_ENV = "DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT"
 EXIT_CODE_SHUTDOWN_OVERRUN = 911
 
 
@@ -85,10 +83,31 @@ class DistributedRuntime:
         self.primary_lease_id: int = 0
         self._lease_keeper: Optional[LeaseKeeper] = None
         self._started = False
+        self._hub_conn = None  # hub connection owned by this runtime, if any
 
     @classmethod
-    async def from_settings(cls, store=None, bus=None, host: str = "127.0.0.1"):
-        drt = cls(store=store, bus=bus, host=host)
+    async def from_settings(
+        cls,
+        store=None,
+        bus=None,
+        host: Optional[str] = None,
+        hub_url: Optional[str] = None,
+    ):
+        """Build from the layered config (defaults ← TOML ← ``DYN_RUNTIME_*``
+        env, ref config.rs:86-88): resolves the response-plane host and, when
+        ``store``/``bus`` are not given and a hub is configured (``hub_url``
+        arg or ``DYN_RUNTIME_HUB_URL``), connects both to that TCP hub. The
+        hub connection is owned by the runtime and closed on shutdown."""
+        from ..utils.config import RuntimeConfig
+
+        cfg = RuntimeConfig.from_settings(hub_url=hub_url)
+        hub_conn = None
+        if store is None and bus is None and cfg.hub_url:
+            from .hub import connect_hub
+
+            store, bus, hub_conn = await connect_hub(cfg.hub_url)
+        drt = cls(store=store, bus=bus, host=host or cfg.response_host)
+        drt._hub_conn = hub_conn
         await drt.start()
         return drt
 
@@ -96,6 +115,20 @@ class DistributedRuntime:
         if self._started:
             return
         self._started = True
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..utils.config import RuntimeConfig
+
+        loop = asyncio.get_running_loop()
+        if not getattr(loop, "_dyn_blocking_pool", None):
+            # bound the default-executor pool used for blocking work
+            # (tokenize, host staging IO) — ref config.rs max_blocking_threads
+            cfg = RuntimeConfig.from_settings()
+            loop._dyn_blocking_pool = ThreadPoolExecutor(
+                max_workers=cfg.max_blocking_threads,
+                thread_name_prefix="dyn-blocking",
+            )
+            loop.set_default_executor(loop._dyn_blocking_pool)
         if isinstance(self.store, LocalStore):
             self.store.start()
         lease = self.store.grant_lease(self.PRIMARY_LEASE_TTL)
@@ -137,6 +170,9 @@ class DistributedRuntime:
             await self._tcp_server.close()
             self._tcp_server = None
         await self.runtime.join(timeout=5.0, cancel=True)
+        if self._hub_conn is not None:
+            await self._hub_conn.close()
+            self._hub_conn = None
 
 
 class Worker:
@@ -172,7 +208,9 @@ class Worker:
             await drt.shutdown()
             return
         # external shutdown requested: give main a grace period
-        timeout = float(os.environ.get(GRACEFUL_SHUTDOWN_TIMEOUT_ENV, "30"))
+        from ..utils.config import WorkerConfig
+
+        timeout = WorkerConfig.from_settings().graceful_shutdown_timeout
         main_task.cancel()
         try:
             await asyncio.wait_for(asyncio.gather(main_task, return_exceptions=True), timeout)

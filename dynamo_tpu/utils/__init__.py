@@ -1,5 +1,6 @@
-"""Shared utilities (logging setup, …)."""
+"""Shared utilities (logging setup, layered config, …)."""
 
+from .config import RuntimeConfig, WorkerConfig, load_config
 from .logging import setup_logging
 
-__all__ = ["setup_logging"]
+__all__ = ["RuntimeConfig", "WorkerConfig", "load_config", "setup_logging"]

@@ -1,0 +1,79 @@
+"""Layered config resolution (defaults ← TOML ← env ← overrides).
+
+Mirrors the reference's figment layering contract
+(lib/runtime/src/config.rs:26-103, env prefixes at :86-88).
+"""
+
+import dataclasses
+
+import pytest
+
+from dynamo_tpu.utils.config import (
+    CONFIG_PATH_ENV,
+    RuntimeConfig,
+    WorkerConfig,
+    load_config,
+)
+
+
+@dataclasses.dataclass
+class Sample:
+    threads: int = 2
+    rate: float = 0.5
+    name: str = "x"
+    fast: bool = False
+
+
+def test_defaults():
+    cfg = load_config(Sample, section="s", env_prefix="T")
+    assert cfg == Sample()
+
+
+def test_toml_layer(tmp_path, monkeypatch):
+    p = tmp_path / "conf.toml"
+    p.write_text('[s]\nthreads = 7\nname = "toml"\n')
+    monkeypatch.setenv(CONFIG_PATH_ENV, str(p))
+    cfg = load_config(Sample, section="s", env_prefix="T")
+    assert cfg.threads == 7 and cfg.name == "toml" and cfg.rate == 0.5
+
+
+def test_env_beats_toml(tmp_path, monkeypatch):
+    p = tmp_path / "conf.toml"
+    p.write_text("[s]\nthreads = 7\nfast = false\n")
+    monkeypatch.setenv(CONFIG_PATH_ENV, str(p))
+    monkeypatch.setenv("T_THREADS", "9")
+    monkeypatch.setenv("T_FAST", "yes")
+    cfg = load_config(Sample, section="s", env_prefix="T")
+    assert cfg.threads == 9 and cfg.fast is True
+
+
+def test_overrides_beat_env(monkeypatch):
+    monkeypatch.setenv("T_RATE", "0.25")
+    cfg = load_config(Sample, section="s", env_prefix="T", overrides={"rate": 0.75})
+    assert cfg.rate == 0.75
+
+
+def test_bad_bool_rejected(monkeypatch):
+    monkeypatch.setenv("T_FAST", "maybe")
+    with pytest.raises(ValueError):
+        load_config(Sample, section="s", env_prefix="T")
+
+
+def test_nested_section(tmp_path, monkeypatch):
+    p = tmp_path / "conf.toml"
+    p.write_text("[a.b]\nthreads = 3\n")
+    monkeypatch.setenv(CONFIG_PATH_ENV, str(p))
+    cfg = load_config(Sample, section="a.b", env_prefix="T")
+    assert cfg.threads == 3
+
+
+def test_runtime_config_env(monkeypatch):
+    monkeypatch.setenv("DYN_RUNTIME_HUB_URL", "127.0.0.1:9000")
+    monkeypatch.setenv("DYN_RUNTIME_MAX_BLOCKING_THREADS", "4")
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.hub_url == "127.0.0.1:9000" and cfg.max_blocking_threads == 4
+
+
+def test_worker_config_env(monkeypatch):
+    monkeypatch.setenv("DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "2.5")
+    assert WorkerConfig.from_settings().graceful_shutdown_timeout == 2.5

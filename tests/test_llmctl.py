@@ -1,0 +1,61 @@
+"""llmctl CLI against a live hub (ref launch/llmctl/src/main.rs:16-100)."""
+
+import asyncio
+
+from dynamo_tpu.http.discovery import list_models
+from dynamo_tpu.launch.llmctl import _parse_endpoint, main
+from dynamo_tpu.runtime.hub import HubServer
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+import pytest
+
+
+def test_parse_endpoint():
+    assert _parse_endpoint("ns.comp.ep") == ("ns", "comp", "ep")
+    assert _parse_endpoint("dyn://a.b.c") == ("a", "b", "c")
+    with pytest.raises(SystemExit):
+        _parse_endpoint("just-a-name")
+
+
+def test_no_hub_is_an_error(monkeypatch):
+    monkeypatch.delenv("DYN_RUNTIME_HUB_URL", raising=False)
+    with pytest.raises(SystemExit, match="hub"):
+        main(["http", "list"])
+
+
+def test_add_list_remove_roundtrip(capsys):
+    async def serve():
+        hub = HubServer(host="127.0.0.1", port=0)
+        await hub.start()
+        return hub
+
+    async def scenario():
+        hub = await serve()
+        addr = hub.address
+        loop = asyncio.get_running_loop()
+
+        def cli(*argv):
+            main(["--hub", addr, *argv])
+
+        # main() calls asyncio.run, so push CLI invocations to a thread
+        await loop.run_in_executor(
+            None, cli, "http", "add", "chat-model", "m1", "ns.backend.generate"
+        )
+        await loop.run_in_executor(None, cli, "http", "list")
+        drt = await DistributedRuntime.from_settings(hub_url=addr)
+        entries = await list_models(drt)
+        assert [(e.name, e.model_type) for e in entries] == [("m1", "chat")]
+        await drt.shutdown()
+        await loop.run_in_executor(
+            None, cli, "http", "remove", "chat-model", "m1"
+        )
+        drt2 = await DistributedRuntime.from_settings(hub_url=addr)
+        assert await list_models(drt2) == []
+        await drt2.shutdown()
+        await hub.close()
+
+    asyncio.run(scenario())
+    out = capsys.readouterr().out
+    assert "added chat-model m1" in out
+    assert "chat" in out and "ns.backend.generate" in out
+    assert "removed 1 entry for m1" in out
