@@ -137,6 +137,7 @@ class JaxEngine(AsyncEngine):
             and cfg.block_size % 8 == 0
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
+        self._prefill_state: Optional[_PrefillState] = None
         # remotely-prefilled sequences with KV landed, awaiting a batch slot
         self._remote_ready: list[_Sequence] = []
         self._active: list[Optional[_Sequence]] = [None] * cfg.max_batch_size
@@ -229,7 +230,11 @@ class JaxEngine(AsyncEngine):
         try:
             while not self._closed:
                 admitted = await self._admit()
-                if self._n_active == 0 and not admitted:
+                if (
+                    self._n_active == 0
+                    and not admitted
+                    and self._prefill_state is None
+                ):
                     self._wake.clear()
                     await self._wake.wait()
                     continue
@@ -241,9 +246,11 @@ class JaxEngine(AsyncEngine):
             pass
         except Exception:  # noqa: BLE001
             logger.exception("engine loop crashed")
-            # fail every request we own — active, and still-waiting (their
-            # generate() coroutines block on out_queue otherwise)
-            for seq in self._active + self._remote_ready:
+            # fail every request we own — active, mid-prefill, and
+            # still-waiting (their generate() coroutines block on
+            # out_queue otherwise)
+            in_prefill = [self._prefill_state.seq] if self._prefill_state else []
+            for seq in self._active + self._remote_ready + in_prefill:
                 if seq is not None:
                     seq.out_queue.put_nowait(
                         LLMEngineOutput(finish_reason=FinishReason.ERROR)
@@ -268,17 +275,26 @@ class JaxEngine(AsyncEngine):
                 continue
             self._place_in_batch(seq)
             admitted = True
-        while self._n_active < self.cfg.max_batch_size and not self._waiting.empty():
+        # advance an in-flight chunked prefill by exactly one chunk per
+        # iteration — decode steps for the running batch interleave between
+        # chunks, so a long prompt can't stall token streaming
+        if self._prefill_state is not None:
+            admitted |= await self._prefill_step()
+        while (
+            self._prefill_state is None
+            and self._n_active < self.cfg.max_batch_size
+            and not self._waiting.empty()
+        ):
             seq = self._waiting.get_nowait()
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
             try:
-                ok = await self._try_prefill(seq)
+                ok = self._begin_prefill(seq)
             except Exception:  # noqa: BLE001
                 # device failure on THIS request (oom, compile error): fail
                 # it alone — the loop and other requests keep going
-                logger.exception("prefill failed for request %s", seq.context.id())
+                logger.exception("prefill failed for request %s", seq.context.id)
                 self.allocator.free(seq.blocks)
                 seq.blocks = []
                 seq.out_queue.put_nowait(
@@ -289,7 +305,7 @@ class JaxEngine(AsyncEngine):
                 # out of KV blocks: put back and stop admitting (backpressure)
                 self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
                 break
-            admitted = True
+            admitted |= await self._prefill_step()
         self.stats["requests_active"] = self._n_active
         self.stats["requests_waiting"] = self._waiting.qsize()
         return admitted
@@ -336,32 +352,111 @@ class JaxEngine(AsyncEngine):
         restore_idxs = [b.idx for b in fresh[: len(restore_hashes)]]
         return history, restore_hashes, restore_data, restore_idxs
 
-    async def _try_prefill(self, seq: _Sequence) -> bool:
+    def _begin_prefill(self, seq: _Sequence) -> bool:
+        """Reserve blocks + prefix/host-tier claims and queue the sequence
+        as the in-flight chunked prefill. Returns False on pool pressure."""
         reserved = self._reserve_for_prompt(seq, probe_host=True)
         if reserved is None:
             return False
         history, restore_hashes, restore_data, restore_idxs = reserved
         self.stats["prefix_cache_hits_tokens"] += history
+        self._prefill_state = _PrefillState(
+            seq=seq,
+            pos=history,
+            restore_hashes=restore_hashes,
+            restore_data=restore_data,
+            restore_idxs=restore_idxs,
+        )
+        return True
 
+    async def _prefill_step(self) -> bool:
+        """Run ONE prefill chunk of the in-flight sequence; on the final
+        chunk, sample the first token and join the decode batch. Returns
+        True when the sequence was admitted (prefill completed)."""
+        st = self._prefill_state
+        assert st is not None
+        seq = st.seq
+        if seq.context.is_stopped():
+            self._prefill_state = None
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+            # hand reserved host blocks back even mid-restore (host arrays
+            # are never mutated, so re-pooling is safe) — same as the
+            # error path below; dropping them would leak the cached prefix
+            if self.offload is not None and st.restore_hashes:
+                self.offload.unreserve(st.restore_hashes, st.restore_data)
+            seq.out_queue.put_nowait(
+                LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+            )
+            return False
         # device work (jit dispatch + compile + host sync) runs in a worker
         # thread so lease keepalives / bus traffic stay live on the loop
         try:
             async with self._device_lock:
                 first_token = await asyncio.get_running_loop().run_in_executor(
-                    None, self._prefill_device, seq, history, restore_data, restore_idxs
+                    None, self._prefill_chunk_device, st
                 )
         except Exception:
             # device failure: hand reserved host blocks back so the prefix
             # isn't silently lost from the offload tier (host arrays are
             # never mutated, so re-pooling is safe even mid-restore)
-            if self.offload is not None and restore_hashes:
-                self.offload.unreserve(restore_hashes, restore_data)
-            raise
+            self._prefill_state = None
+            logger.exception("prefill failed for request %s", seq.context.id)
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+            if self.offload is not None and st.restore_hashes:
+                self.offload.unreserve(st.restore_hashes, st.restore_data)
+            seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.ERROR))
+            return False
+        if first_token is None:
+            return False  # more chunks to go
+        self._prefill_state = None
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token)
         if not seq.finished:
             self._place_in_batch(seq)
         return True
+
+    def _prefill_chunk_device(self, st: _PrefillState) -> Optional[int]:
+        """Runs in an executor thread: one bucketed prefill chunk. Returns
+        the sampled first token on the final chunk, else None."""
+        self._offload_preamble(st.restore_data if not st.restored else None, st.restore_idxs)
+        st.restored = True
+        logits, st.pos = self._run_one_chunk(st.seq, st.pos)
+        if st.pos < len(st.seq.tokens):
+            return None
+        return self._sample_prefill(st.seq, logits)
+
+    def _offload_preamble(self, restore_data, restore_idxs) -> None:
+        """d2h evicted blocks before their pages get overwritten, then land
+        any host-tier prefix restore."""
+        if self.offload is None:
+            return
+        self.offload.flush_evictions(self.k_cache, self.v_cache)
+        if restore_data:
+            self.k_cache, self.v_cache = self.offload.restore(
+                self.k_cache, self.v_cache, restore_data, restore_idxs
+            )
+
+    def _run_one_chunk(self, seq: _Sequence, pos: int):
+        """One bucketed prefill chunk at ``pos``; returns (logits, new_pos)."""
+        cfg = self.cfg
+        chunk = seq.tokens[pos : pos + cfg.prefill_chunk]
+        T = _bucket(len(chunk))
+        toks = np.zeros(T, np.int32)
+        toks[: len(chunk)] = chunk
+        # table must cover padded chunk; _table_for pads with trash 0
+        logits, self.k_cache, self.v_cache = llama.prefill(
+            self.params,
+            cfg.model,
+            jnp.asarray(toks),
+            jnp.asarray(self._table_for(seq)),
+            jnp.int32(pos),
+            jnp.int32(len(chunk)),
+            self.k_cache,
+            self.v_cache,
+        )
+        return logits, pos + len(chunk)
 
     def _prefill_device(
         self,
@@ -370,36 +465,15 @@ class JaxEngine(AsyncEngine):
         restore_data: Optional[list] = None,
         restore_idxs: Optional[list[int]] = None,
     ) -> int:
-        """Runs in an executor thread: chunked prefill + first-token sample."""
-        cfg = self.cfg
-        if self.offload is not None:
-            # d2h evicted blocks before their pages get overwritten below
-            self.offload.flush_evictions(self.k_cache, self.v_cache)
-            if restore_data:
-                self.k_cache, self.v_cache = self.offload.restore(
-                    self.k_cache, self.v_cache, restore_data, restore_idxs
-                )
-        prompt = seq.tokens
-        table = self._table_for(seq)
+        """Runs in an executor thread: whole-prompt chunked prefill +
+        first-token sample (the disagg prefill-worker path, which owns the
+        device for the whole prompt — the serving loop uses the chunk-at-a-
+        time _prefill_chunk_device instead)."""
+        self._offload_preamble(restore_data, restore_idxs)
         logits = None
         pos = history
-        while pos < len(prompt):
-            chunk = prompt[pos : pos + cfg.prefill_chunk]
-            T = _bucket(len(chunk))
-            toks = np.zeros(T, np.int32)
-            toks[: len(chunk)] = chunk
-            # table must cover padded chunk; _table_for pads with trash 0
-            logits, self.k_cache, self.v_cache = llama.prefill(
-                self.params,
-                self.cfg.model,
-                jnp.asarray(toks),
-                jnp.asarray(table),
-                jnp.int32(pos),
-                jnp.int32(len(chunk)),
-                self.k_cache,
-                self.v_cache,
-            )
-            pos += len(chunk)
+        while pos < len(seq.tokens):
+            logits, pos = self._run_one_chunk(seq, pos)
         return self._sample_prefill(seq, logits)
 
     def _table_for(self, seq: _Sequence) -> np.ndarray:
@@ -743,3 +817,18 @@ class RemoteHandle:
     seq: _Sequence
     skip_blocks: int
     n_prompt_blocks: int
+
+
+@dataclass
+class _PrefillState:
+    """An in-flight chunked prefill: one chunk runs per scheduler
+    iteration so decode steps interleave with long prompts (the
+    reference gets this from its patched engine scheduler's chunked
+    prefill; here it's native to the loop)."""
+
+    seq: _Sequence
+    pos: int  # next prompt index to prefill
+    restore_hashes: list
+    restore_data: list
+    restore_idxs: list
+    restored: bool = False  # host-tier restore done (first chunk)

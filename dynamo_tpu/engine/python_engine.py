@@ -1,0 +1,148 @@
+"""User-supplied Python engines (``pystr:``/``pytok:``).
+
+Re-design of the reference's generic Python engine (lib/llm/src/engines/
+python.rs:43-70): ``out=pystr:file.py`` / ``out=pytok:file.py`` load a user
+file and bridge its async generator into the AsyncEngine pipeline — the
+escape hatch for serving any model/runtime behind the full frontend stack
+(HTTP, routing, disagg) without touching framework code.
+
+User-file contract — define an async generator::
+
+    async def generate(request: dict):
+        ...yield items...
+
+  * ``pytok:`` — token-level engine, sits where the JAX core engine does
+    (behind preprocessor + detokenizer). ``request`` is a
+    PreprocessedRequest dict (token_ids, stop_conditions, sampling_options,
+    …). Yield ``int`` token ids, ``list[int]``, LLMEngineOutput, or its
+    dict form.
+  * ``pystr:`` — text-level engine (reference "full" surface): ``request``
+    additionally carries the rendered prompt at
+    ``request["annotations"]["formatted_prompt"]``. Yield ``str`` text
+    deltas, LLMEngineOutput, or its dict form. The detokenizer stage is
+    skipped.
+
+Optionally define ``async def init() -> None`` (called once before the
+first request) and ``ENGINE_NAME`` (reported model name).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import os
+import sys
+from typing import AsyncIterator, Optional
+
+from ..protocols.common import FinishReason, LLMEngineOutput
+from ..runtime.engine import AsyncEngine, Context
+
+
+def load_user_module(path: str):
+    """Import a user engine file as an anonymous module (runpy-equivalent,
+    ref engines/python.rs:43 loading via runpy)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"engine file not found: {path}")
+    name = f"_dyn_user_engine_{abs(hash(path)) & 0xFFFFFF:x}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    assert spec and spec.loader
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "generate"):
+        raise TypeError(f"{path} must define `async def generate(request)`")
+    return mod
+
+
+def _normalize(item, text_mode: bool) -> LLMEngineOutput:
+    if isinstance(item, LLMEngineOutput):
+        return item
+    if isinstance(item, dict):
+        return LLMEngineOutput.from_dict(item)
+    if text_mode:
+        if isinstance(item, str):
+            return LLMEngineOutput(text=item)
+    else:
+        if isinstance(item, int):
+            return LLMEngineOutput(token_ids=[item])
+        if isinstance(item, (list, tuple)) and all(isinstance(t, int) for t in item):
+            return LLMEngineOutput(token_ids=list(item))
+    raise TypeError(
+        f"user engine yielded {type(item).__name__}; expected "
+        + ("str/dict/LLMEngineOutput" if text_mode else "int/list[int]/dict/LLMEngineOutput")
+    )
+
+
+class PythonEngine(AsyncEngine):
+    """Bridges a user module's ``generate`` into the engine protocol.
+
+    ``text_mode=False`` -> pytok (token-level core engine);
+    ``text_mode=True``  -> pystr (text-level engine, detokenizer skipped).
+    """
+
+    def __init__(self, module, text_mode: bool):
+        self._mod = module
+        self.text_mode = text_mode
+        self._initialized = not hasattr(module, "init")
+        self._init_lock = asyncio.Lock()
+        self.name = getattr(module, "ENGINE_NAME", None)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "PythonEngine":
+        """``pystr:path`` or ``pytok:path`` (ref dynamo-run out= grammar)."""
+        kind, _, path = spec.partition(":")
+        if kind not in ("pystr", "pytok") or not path:
+            raise ValueError(f"bad python engine spec {spec!r}")
+        return cls(load_user_module(path), text_mode=(kind == "pystr"))
+
+    async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
+        if not self._initialized:
+            async with self._init_lock:
+                if not self._initialized:
+                    await self._mod.init()
+                    self._initialized = True
+        req = request.data
+        req_dict = req if isinstance(req, dict) else req.to_dict()
+        n_tokens = 0
+        final_seen = False
+        async for item in self._mod.generate(req_dict):
+            out = _normalize(item, self.text_mode)
+            n_tokens += len(out.token_ids) or (1 if out.text else 0)
+            if out.is_final():
+                final_seen = True
+                out.prompt_tokens = out.prompt_tokens or len(req_dict.get("token_ids", []))
+                out.completion_tokens = out.completion_tokens or n_tokens
+            yield out
+            if final_seen:
+                return
+            if request.context.is_stopped():
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.CANCELLED,
+                    prompt_tokens=len(req_dict.get("token_ids", [])),
+                    completion_tokens=n_tokens,
+                )
+                return
+        if not final_seen:  # generator ended without a finish marker
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.STOP if self.text_mode else FinishReason.LENGTH,
+                prompt_tokens=len(req_dict.get("token_ids", [])),
+                completion_tokens=n_tokens,
+            )
+
+
+def build_python_engine(
+    spec: str, subprocess_mode: bool = False
+) -> tuple[AsyncEngine, bool]:
+    """Resolve an ``out=pystr:…|pytok:…`` spec. Returns (engine, text_mode).
+
+    ``subprocess_mode=True`` isolates the user engine in a child process
+    (ref: the vLLM/SGLang subprocess pattern, engines/vllm/worker.rs) —
+    crashes or GIL-hogging user code can't take down the worker's control
+    plane."""
+    text_mode = spec.startswith("pystr:")
+    if subprocess_mode:
+        from .subproc import SubprocessEngine
+
+        return SubprocessEngine(spec), text_mode
+    return PythonEngine.from_spec(spec), text_mode

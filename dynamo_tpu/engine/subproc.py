@@ -1,0 +1,255 @@
+"""Subprocess-isolated engine: run a user Python engine in a child process.
+
+Re-design of the reference's engine-subprocess pattern (engines/vllm/
+worker.rs:65-115, engines/sglang/subprocess.rs): the worker process keeps
+its control plane (leases, bus, HTTP) responsive by pushing the user
+engine — arbitrary Python that may crash, block the GIL, or leak — into a
+child process. The reference multiplexes zmq sockets (data/input/output/
+heartbeat); here one unix-domain socket carries two-part-codec frames with
+per-request ids:
+
+  parent -> child:  {op:"generate", id} + data=json(request dict)
+                    {op:"stop", id}          (client disconnected)
+  child -> parent:  {op:"ready", name}       (engine loaded)
+                    {op:"item", id} + data=json(LLMEngineOutput dict)
+                    {op:"done", id}          (stream complete)
+                    {op:"err",  id, error}   (request failed)
+
+Child death fails all in-flight requests with FinishReason.ERROR — the
+component stays up and later requests return errors rather than hanging.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import os
+import sys
+import tempfile
+from typing import AsyncIterator, Optional
+
+from ..protocols.common import FinishReason, LLMEngineOutput
+from ..runtime.codec import TwoPartMessage, read_frame, write_frame
+from ..runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()
+
+
+class SubprocessEngine(AsyncEngine):
+    """AsyncEngine facade whose generate() streams from a child process."""
+
+    def __init__(self, spec: str, ready_timeout: float = 60.0):
+        self.spec = spec
+        self.ready_timeout = ready_timeout
+        self.name: Optional[str] = None
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+        self._started = False
+        self._connected = asyncio.Event()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._sock_dir: Optional[tempfile.TemporaryDirectory] = None
+
+    async def start(self) -> None:
+        # late joiners wait for the child's connect instead of racing past
+        # a _started flag into a writer that isn't there yet
+        if not self._started:
+            self._started = True
+            self._sock_dir = tempfile.TemporaryDirectory(prefix="dyn-subproc-")
+            sock_path = os.path.join(self._sock_dir.name, "engine.sock")
+
+            async def on_connect(reader, writer):
+                self._writer = writer
+                self._connected.set()
+                try:
+                    await self._read_loop(reader)
+                finally:
+                    # close the transport so the server's connection count
+                    # drops — wait_closed() blocks on lingering transports
+                    writer.close()
+
+            self._server = await asyncio.start_unix_server(on_connect, path=sock_path)
+            self._proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "dynamo_tpu.engine.subproc",
+                "--spec", self.spec, "--connect", sock_path,
+            )
+            asyncio.get_running_loop().create_task(self._reap())
+        await asyncio.wait_for(self._connected.wait(), self.ready_timeout)
+
+    async def _reap(self) -> None:
+        assert self._proc is not None
+        rc = await self._proc.wait()
+        logger.warning("engine subprocess exited rc=%s", rc)
+        for q in list(self._streams.values()):
+            q.put_nowait(
+                LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    text=f"engine subprocess died (rc={rc})",
+                )
+            )
+            q.put_nowait(_DONE)
+        self._streams.clear()
+        self._writer = None
+
+    async def _read_loop(self, reader) -> None:
+        while True:
+            frame = await read_frame(reader)
+            if frame is None:
+                return
+            head = frame.header_json() or {}
+            op, rid = head.get("op"), head.get("id")
+            if op == "ready":
+                self.name = head.get("name")
+            elif op == "item" and rid in self._streams:
+                self._streams[rid].put_nowait(
+                    LLMEngineOutput.from_dict(json.loads(frame.data))
+                )
+            elif op == "done" and rid in self._streams:
+                self._streams[rid].put_nowait(_DONE)
+            elif op == "err" and rid in self._streams:
+                self._streams[rid].put_nowait(
+                    LLMEngineOutput(
+                        finish_reason=FinishReason.ERROR, text=head.get("error")
+                    )
+                )
+                self._streams[rid].put_nowait(_DONE)
+
+    async def _send(self, head: dict, data: bytes = b"") -> None:
+        async with self._lock:
+            if self._writer is None:
+                raise RuntimeError("engine subprocess not running")
+            await write_frame(self._writer, TwoPartMessage.from_json(head, data))
+
+    async def close(self) -> None:
+        if self._proc and self._proc.returncode is None:
+            try:
+                await self._send({"op": "shutdown"})
+                await asyncio.wait_for(self._proc.wait(), 5.0)
+            except Exception:  # noqa: BLE001
+                self._proc.kill()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self._sock_dir is not None:
+            self._sock_dir.cleanup()
+
+    async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
+        await self.start()
+        req = request.data
+        req_dict = req if isinstance(req, dict) else req.to_dict()
+        rid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        try:
+            await self._send({"op": "generate", "id": rid},
+                             json.dumps(req_dict).encode())
+            while True:
+                get = asyncio.ensure_future(q.get())
+                stopped = asyncio.ensure_future(request.context.stopped())
+                done, _ = await asyncio.wait(
+                    [get, stopped], return_when=asyncio.FIRST_COMPLETED
+                )
+                if get in done:
+                    stopped.cancel()
+                    item = get.result()
+                    if item is _DONE:
+                        return
+                    yield item
+                    if item.is_final():
+                        return
+                else:
+                    get.cancel()
+                    try:
+                        await self._send({"op": "stop", "id": rid})
+                    except RuntimeError:
+                        pass
+                    yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+                    return
+        finally:
+            self._streams.pop(rid, None)
+
+
+# ---------------- child-process side ----------------
+
+
+async def _child_main(spec: str, sock_path: str) -> None:
+    from .python_engine import PythonEngine
+
+    engine = PythonEngine.from_spec(spec)
+    reader, writer = await asyncio.open_unix_connection(sock_path)
+    wlock = asyncio.Lock()
+    tasks: dict[int, asyncio.Task] = {}
+
+    async def send(head: dict, data: bytes = b"") -> None:
+        async with wlock:
+            await write_frame(writer, TwoPartMessage.from_json(head, data))
+
+    class _ChildContext:
+        """Minimal AsyncEngineContext for the child side."""
+
+        def __init__(self):
+            self._stop = asyncio.Event()
+
+        def id(self) -> str:
+            return "subproc"
+
+        def is_stopped(self) -> bool:
+            return self._stop.is_set()
+
+        async def stopped(self) -> None:
+            await self._stop.wait()
+
+        def stop_generating(self) -> None:
+            self._stop.set()
+
+    async def run_request(rid: int, req_dict: dict) -> None:
+        ctx = _ChildContext()
+        tasks_ctx[rid] = ctx
+        try:
+            async for out in engine.generate(Context(req_dict, context=ctx)):
+                await send({"op": "item", "id": rid},
+                           json.dumps(out.to_dict()).encode())
+            await send({"op": "done", "id": rid})
+        except Exception as e:  # noqa: BLE001
+            await send({"op": "err", "id": rid, "error": f"{type(e).__name__}: {e}"})
+        finally:
+            tasks.pop(rid, None)
+            tasks_ctx.pop(rid, None)
+
+    tasks_ctx: dict[int, _ChildContext] = {}
+    await send({"op": "ready", "name": engine.name})
+    while True:
+        frame = await read_frame(reader)
+        if frame is None:
+            return
+        head = frame.header_json() or {}
+        op, rid = head.get("op"), head.get("id")
+        if op == "generate":
+            tasks[rid] = asyncio.get_running_loop().create_task(
+                run_request(rid, json.loads(frame.data))
+            )
+        elif op == "stop" and rid in tasks_ctx:
+            tasks_ctx[rid].stop_generating()
+        elif op == "shutdown":
+            return
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--spec", required=True)
+    p.add_argument("--connect", required=True)
+    args = p.parse_args(argv)
+    asyncio.run(_child_main(args.spec, args.connect))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ for the TPU stack:
 
   out=echo     token-echo fake engine (testing, ref output/echo_core.rs)
   out=jax      the native JAX/TPU engine
+  out=pystr:F  user Python engine, text level (ref engines/python.rs)
+  out=pytok:F  user Python engine, token level
   out=dyn://ns.comp.ep  route to discovered remote workers (frontend mode)
 
 Examples:
@@ -102,6 +104,16 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
 def build_core_engine(args, cfg: ModelConfig, params) -> AsyncEngine:
     if args.out == "echo":
         return EchoEngine()
+    if args.out.startswith(("pystr:", "pytok:")):
+        # user-supplied Python engine (ref engines/python.rs);
+        # --engine-subprocess isolates it in a child process
+        from ..engine.python_engine import build_python_engine
+
+        engine, text_mode = build_python_engine(
+            args.out, subprocess_mode=args.engine_subprocess
+        )
+        engine.text_mode = text_mode
+        return engine
     if args.out == "jax":
         from ..parallel.mesh import MeshConfig
 
@@ -300,7 +312,7 @@ async def run_batch(args, batch_file: str) -> None:
     """Throughput harness (ref input/batch.rs): JSONL with {"text": ...}."""
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
-    pipeline = link(Backend(tokenizer), core)
+    pipeline = core if getattr(core, "text_mode", False) else link(Backend(tokenizer), core)
 
     entries = []
     with open(batch_file) as f:
@@ -323,6 +335,8 @@ async def run_batch(args, batch_file: str) -> None:
             ),
             sampling_options=SamplingOptions(temperature=0.0),
             model=name,
+            # text-level (pystr) engines read the prompt from here
+            annotations={"formatted_prompt": entry["text"]},
         )
         t_start = time.monotonic()
         tokens_out = 0
@@ -331,7 +345,8 @@ async def run_batch(args, batch_file: str) -> None:
             out = getattr(item, "data", None)
             if out is None:
                 continue
-            tokens_out += len(out.token_ids)
+            # text engines emit deltas without token ids — count each as one
+            tokens_out += len(out.token_ids) or (1 if out.text else 0)
         results.append(
             {"tokens_in": tokens_in, "tokens_out": tokens_out,
              "elapsed_ms": (time.monotonic() - t_start) * 1e3}
@@ -400,6 +415,8 @@ def main(argv=None) -> None:
                    help="decode: offload long prompts to prefill workers")
     p.add_argument("--max-local-prefill", type=int, default=512,
                    help="uncached prompt tokens above this go remote")
+    p.add_argument("--engine-subprocess", action="store_true",
+                   help="isolate a pystr:/pytok: engine in a child process")
     args = p.parse_args(argv)
 
     args.in_ = "http"

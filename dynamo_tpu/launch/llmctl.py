@@ -44,12 +44,13 @@ def _parse_endpoint(path: str) -> tuple[str, str, str]:
 
 
 async def _connect(hub: Optional[str]) -> DistributedRuntime:
-    import os
+    from ..utils.config import RuntimeConfig
 
-    if not hub and not os.environ.get("DYN_RUNTIME_HUB_URL"):
+    if not RuntimeConfig.from_settings(hub_url=hub).hub_url:
         raise SystemExit(
-            "llmctl needs a control-plane hub: pass --hub host:port or set "
-            "DYN_RUNTIME_HUB_URL (a private in-process store would make "
+            "llmctl needs a control-plane hub: pass --hub host:port, set "
+            "DYN_RUNTIME_HUB_URL, or configure [runtime] hub_url via "
+            "DYN_CONFIG_PATH (a private in-process store would make "
             "add/remove silent no-ops)"
         )
     return await DistributedRuntime.from_settings(hub_url=hub)

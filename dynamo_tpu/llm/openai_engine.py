@@ -24,9 +24,14 @@ from .tokenizer import Tokenizer
 class OpenAIWorkerEngine(AsyncEngine):
     def __init__(self, tokenizer: Tokenizer, core_engine: AsyncEngine):
         self._core = core_engine
-        self._pipeline = link(
-            OpenAIPreprocessor(tokenizer), Backend(tokenizer), core_engine
-        )
+        # text-level engines (pystr) emit text directly — the detokenizer
+        # stage would overwrite it from their (empty) token ids, so skip it
+        if getattr(core_engine, "text_mode", False):
+            self._pipeline = link(OpenAIPreprocessor(tokenizer), core_engine)
+        else:
+            self._pipeline = link(
+                OpenAIPreprocessor(tokenizer), Backend(tokenizer), core_engine
+            )
 
     async def generate(self, request: Context) -> AsyncIterator[Annotated]:
         data = request.data

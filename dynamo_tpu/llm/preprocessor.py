@@ -52,6 +52,9 @@ class OpenAIPreprocessor(Operator):
             sampling_options=req.sampling,
             model=req.model,
             eos_token_ids=self._tokenizer.eos_token_ids,
+            # text-level engines (pystr) consume the rendered prompt; the
+            # reference's PreprocessedRequest carries it the same way
+            annotations={ANNOTATION_FORMATTED_PROMPT: prompt},
         )
         return pre, prompt
 
@@ -68,6 +71,7 @@ class OpenAIPreprocessor(Operator):
             sampling_options=req.sampling,
             model=req.model,
             eos_token_ids=self._tokenizer.eos_token_ids,
+            annotations={ANNOTATION_FORMATTED_PROMPT: prompt},
         )
         return pre, prompt
 

@@ -1,0 +1,133 @@
+"""Mock transport: in-memory planes with injectable latency.
+
+Re-design of the reference's mock network (lib/runtime/tests/common/
+mock.rs:30-43): tests exercise multi-node behavior — discovery, routing,
+streaming, cancellation, lease expiry — against the in-process store/bus
+with a configurable per-hop latency model (NoDelay / Constant /
+NormalDistribution), no external etcd/NATS and no real network required.
+
+Usage::
+
+    lat = LatencyModel.normal(mean=0.005, std=0.002, seed=1)
+    drt = DistributedRuntime(store=LatencyStore(LocalStore(), lat),
+                             bus=LatencyBus(LocalBus(), lat))
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from .bus import LocalBus
+from .store import LocalStore
+
+
+@dataclass
+class LatencyModel:
+    """ref mock.rs LatencyModel::{NoDelay, Constant, NormalDistribution}."""
+
+    mean: float = 0.0
+    std: float = 0.0
+    _rng: Optional[random.Random] = None
+
+    @staticmethod
+    def no_delay() -> "LatencyModel":
+        return LatencyModel()
+
+    @staticmethod
+    def constant(delay: float) -> "LatencyModel":
+        return LatencyModel(mean=delay)
+
+    @staticmethod
+    def normal(mean: float, std: float, seed: int = 0) -> "LatencyModel":
+        return LatencyModel(mean=mean, std=std, _rng=random.Random(seed))
+
+    def sample(self) -> float:
+        if self.std and self._rng is not None:
+            return max(0.0, self._rng.gauss(self.mean, self.std))
+        return self.mean
+
+    async def apply(self) -> None:
+        d = self.sample()
+        if d > 0:
+            await asyncio.sleep(d)
+
+
+async def _resolve(value):
+    if inspect.iscoroutine(value):
+        return await value
+    return value
+
+
+class _LatencyProxy:
+    """Delays a fixed set of methods by one latency sample each (turning
+    them into coroutines — callers already await coroutine-returning
+    stores/buses, the remote hub clients work the same way)."""
+
+    _delayed: tuple[str, ...] = ()
+
+    def __init__(self, inner, latency: LatencyModel):
+        self._inner = inner
+        self.latency = latency
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name not in self._delayed or not callable(attr):
+            return attr
+
+        async def delayed(*args, **kwargs):
+            await self.latency.apply()
+            return await _resolve(attr(*args, **kwargs))
+
+        return delayed
+
+
+class LatencyStore(_LatencyProxy):
+    """Control-plane store with per-op latency (ref mock.rs control plane)."""
+
+    _delayed = (
+        "kv_put",
+        "kv_create",
+        "kv_create_or_validate",
+        "kv_get",
+        "kv_get_prefix",
+        "kv_delete",
+        "kv_delete_prefix",
+        "grant_lease",
+        "keep_alive",
+        "revoke_lease",
+        "watch_prefix",
+    )
+
+    def __init__(self, inner: Optional[LocalStore] = None, latency: Optional[LatencyModel] = None):
+        super().__init__(inner or LocalStore(), latency or LatencyModel.no_delay())
+
+
+class LatencyBus(_LatencyProxy):
+    """Message plane with per-hop latency: publish/request delay before
+    delivery; request pays the hop twice (there and back)."""
+
+    _delayed = ("publish",)
+
+    def __init__(self, inner: Optional[LocalBus] = None, latency: Optional[LatencyModel] = None):
+        super().__init__(inner or LocalBus(), latency or LatencyModel.no_delay())
+
+    async def request(self, *args, **kwargs):
+        await self.latency.apply()
+        result = await _resolve(self._inner.request(*args, **kwargs))
+        await self.latency.apply()
+        return result
+
+
+def mock_runtime(latency: Optional[LatencyModel] = None):
+    """A DistributedRuntime over latency-injected in-memory planes."""
+    from .runtime import DistributedRuntime
+
+    lat = latency or LatencyModel.no_delay()
+    store = LocalStore()
+    return DistributedRuntime(
+        store=LatencyStore(store, lat), bus=LatencyBus(LocalBus(), lat)
+    )

@@ -79,6 +79,7 @@ class DistributedRuntime:
         self.store = store if store is not None else LocalStore()
         self.bus = bus if bus is not None else LocalBus()
         self._tcp_server: Optional[TcpStreamServer] = None
+        self._tcp_starting: Optional[asyncio.Future] = None
         self._host = host
         self.primary_lease_id: int = 0
         self._lease_keeper: Optional[LeaseKeeper] = None
@@ -129,8 +130,11 @@ class DistributedRuntime:
                 thread_name_prefix="dyn-blocking",
             )
             loop.set_default_executor(loop._dyn_blocking_pool)
-        if isinstance(self.store, LocalStore):
-            self.store.start()
+        # local stores (including latency-wrapped ones) need their lease
+        # reaper started in this loop; remote hub stores have no start()
+        starter = getattr(self.store, "start", None)
+        if starter is not None:
+            starter()
         lease = self.store.grant_lease(self.PRIMARY_LEASE_TTL)
         if asyncio.iscoroutine(lease):
             lease = await lease
@@ -150,10 +154,26 @@ class DistributedRuntime:
         return self.primary_lease_id
 
     async def tcp_server(self) -> TcpStreamServer:
-        """Lazily-started response-plane server (ref distributed.rs lazy TCP)."""
+        """Lazily-started response-plane server (ref distributed.rs lazy TCP).
+
+        Single-flight: concurrent first callers must share one instance —
+        otherwise streams register on a half-started server that a racing
+        caller then overwrites."""
         if self._tcp_server is None:
-            self._tcp_server = TcpStreamServer(host=self._host)
-            await self._tcp_server.start()
+            if self._tcp_starting is None:
+
+                async def _start() -> TcpStreamServer:
+                    srv = TcpStreamServer(host=self._host)
+                    await srv.start()
+                    return srv
+
+                self._tcp_starting = asyncio.ensure_future(_start())
+            try:
+                self._tcp_server = await asyncio.shield(self._tcp_starting)
+            finally:
+                # success or failure, drop the in-flight future so a
+                # transient start error isn't replayed forever
+                self._tcp_starting = None
         return self._tcp_server
 
     def namespace(self, name: str):

@@ -184,3 +184,42 @@ def test_engine_metrics_shape(run, engine_cfg, shared_engine):
         assert m["kv_total_blocks"] == 63
 
     run(main())
+
+
+def test_chunked_prefill_interleaves_decode(run, engine_cfg):
+    """A long prompt prefills in chunks (one per scheduler iteration) while
+    an already-running sequence keeps streaming decode tokens between
+    chunks — long prompts must not stall the running batch."""
+
+    async def main():
+        engine = JaxEngine(engine_cfg, seed=0)
+        decode_steps_during_chunk: list[int] = []
+        orig_chunk = engine._prefill_chunk_device
+
+        def spy_chunk(st):
+            decode_steps_during_chunk.append(engine.stats["decode_steps"])
+            return orig_chunk(st)
+
+        engine._prefill_chunk_device = spy_chunk
+
+        # start a short-prompt sequence that decodes for a while
+        short = collect(
+            engine.generate(Context(make_req(range(10, 14), max_tokens=30)))
+        )
+        t_short = asyncio.ensure_future(short)
+        while engine.stats["decode_steps"] == 0:
+            await asyncio.sleep(0.01)
+        # now a 100-token prompt: 4 chunks of 32 with prefill_chunk=32
+        long_out = await collect(
+            engine.generate(Context(make_req(range(100, 200), max_tokens=2)))
+        )
+        out_short = await t_short
+        assert long_out[-1].finish_reason is not None
+        assert sum(len(o.token_ids) for o in out_short) == 30
+        # the long prompt took several chunks...
+        assert len(decode_steps_during_chunk) >= 4
+        # ...and decode steps advanced while the chunks were running
+        assert decode_steps_during_chunk[-1] > decode_steps_during_chunk[0]
+        await engine.close()
+
+    run(main())

@@ -1,0 +1,159 @@
+"""User-supplied Python engines (pystr:/pytok:) + subprocess isolation.
+
+Mirrors the reference's generic python engine (engines/python.rs:43-70) and
+the engine-subprocess pattern (engines/vllm/worker.rs zmq sockets).
+"""
+
+import asyncio
+import textwrap
+
+import pytest
+
+from dynamo_tpu.engine.python_engine import PythonEngine, build_python_engine
+from dynamo_tpu.engine.subproc import SubprocessEngine
+from dynamo_tpu.llm.openai_engine import OpenAIWorkerEngine
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.runtime import Context, collect
+
+PYTOK_SRC = textwrap.dedent(
+    """
+    async def generate(request):
+        # echo prompt token ids, doubled
+        for t in request["token_ids"][: request["stop_conditions"]["max_tokens"]]:
+            yield t * 2
+    """
+)
+
+PYSTR_SRC = textwrap.dedent(
+    """
+    ENGINE_NAME = "shouty"
+
+    async def generate(request):
+        prompt = request["annotations"]["formatted_prompt"]
+        for word in prompt.upper().split():
+            yield word + " "
+    """
+)
+
+CRASH_SRC = textwrap.dedent(
+    """
+    import os
+
+    async def generate(request):
+        yield 1
+        os._exit(17)
+    """
+)
+
+
+@pytest.fixture
+def pytok_file(tmp_path):
+    p = tmp_path / "user_tok.py"
+    p.write_text(PYTOK_SRC)
+    return str(p)
+
+
+@pytest.fixture
+def pystr_file(tmp_path):
+    p = tmp_path / "user_str.py"
+    p.write_text(PYSTR_SRC)
+    return str(p)
+
+
+def _chat_req(text, max_tokens=8):
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+
+    return ChatCompletionRequest.from_dict(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": text}],
+            "max_tokens": max_tokens,
+            "nvext": {"use_raw_prompt": True},
+        }
+    )
+
+
+def test_pytok_in_process(run, pytok_file):
+    async def main():
+        engine = PythonEngine.from_spec(f"pytok:{pytok_file}")
+        req = {
+            "token_ids": [1, 2, 3, 4, 5],
+            "stop_conditions": {"max_tokens": 3},
+            "sampling_options": {},
+        }
+        out = await collect(engine.generate(Context(req)))
+        toks = [t for o in out for t in o.token_ids]
+        assert toks == [2, 4, 6]
+        assert out[-1].finish_reason == FinishReason.LENGTH
+        assert out[-1].prompt_tokens == 5 and out[-1].completion_tokens == 3
+
+    run(main())
+
+
+def test_pystr_full_pipeline(run, pystr_file):
+    """pystr engine behind the OpenAI worker pipeline: the rendered prompt
+    reaches the engine, its text deltas come back as chat chunks (the
+    detokenizer stage is skipped)."""
+
+    async def main():
+        engine, text_mode = build_python_engine(f"pystr:{pystr_file}")
+        assert text_mode
+        engine.text_mode = text_mode
+        worker = OpenAIWorkerEngine(ByteTokenizer(), engine)
+        out = await collect(worker.generate(Context(_chat_req("hello tpu world"))))
+        text = "".join(
+            a.data["choices"][0]["delta"].get("content", "")
+            for a in out
+            if a.data and a.data.get("choices")
+        )
+        assert text == "HELLO TPU WORLD "
+        finals = [
+            a.data["choices"][0]["finish_reason"]
+            for a in out
+            if a.data and a.data.get("choices") and a.data["choices"][0].get("finish_reason")
+        ]
+        assert finals == ["stop"]
+
+    run(main())
+
+
+def test_pytok_subprocess_roundtrip(run, pytok_file):
+    async def main():
+        engine = SubprocessEngine(f"pytok:{pytok_file}")
+        req = {
+            "token_ids": [7, 8, 9],
+            "stop_conditions": {"max_tokens": 2},
+            "sampling_options": {},
+        }
+        out = await collect(engine.generate(Context(req)))
+        toks = [t for o in out for t in o.token_ids]
+        assert toks == [14, 16]
+        assert out[-1].finish_reason == FinishReason.LENGTH
+        # second request reuses the same child
+        out2 = await collect(engine.generate(Context(req)))
+        assert [t for o in out2 for t in o.token_ids] == [14, 16]
+        await engine.close()
+
+    run(main())
+
+
+def test_subprocess_crash_fails_request_not_worker(run, tmp_path):
+    async def main():
+        p = tmp_path / "crash.py"
+        p.write_text(CRASH_SRC)
+        engine = SubprocessEngine(f"pytok:{p}")
+        req = {"token_ids": [1], "stop_conditions": {}, "sampling_options": {}}
+        out = await collect(engine.generate(Context(req)))
+        assert out[-1].finish_reason == FinishReason.ERROR
+        assert "died" in (out[-1].text or "")
+        await engine.close()
+
+    run(main())
+
+
+def test_bad_spec_rejected():
+    with pytest.raises(ValueError):
+        PythonEngine.from_spec("wat:/tmp/x.py")
+    with pytest.raises(FileNotFoundError):
+        PythonEngine.from_spec("pytok:/nonexistent/engine.py")
