@@ -69,6 +69,10 @@ class KvDelivery:
     k_data: Optional[np.ndarray]
     v_data: Optional[np.ndarray]
     error: Optional[str] = None
+    # sender's kv-head ordering — the decode side regroups on mismatch
+    # (ops/kv_rearrange.py; ref vllm patch:743-810 kv_rearrange)
+    head_layout: str = "blocked"
+    src_tp: int = 1
 
 
 class KvTransferServer:
@@ -173,7 +177,11 @@ class KvTransferServer:
             self._pending.pop(req_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(
-                    KvDelivery(req_id, head["first_token"], n, k, v)
+                    KvDelivery(
+                        req_id, head["first_token"], n, k, v,
+                        head_layout=head.get("head_layout", "blocked"),
+                        src_tp=head.get("src_tp", 1),
+                    )
                 )
         except Exception:  # noqa: BLE001 — receive failed mid-stream: no
             # ack is sent, the sender sees a TransferError and redelivers;
@@ -192,6 +200,8 @@ async def send_kv_blocks(
     v_data: Optional[np.ndarray],
     layer_chunk: int = 4,
     error: Optional[str] = None,
+    head_layout: str = "blocked",
+    src_tp: int = 1,
 ) -> None:
     """Prefill-side push of one request's KV (or an error notification)."""
     if isinstance(connection, dict):
@@ -211,6 +221,8 @@ async def send_kv_blocks(
             "dtype": "" if k_data is None else str(k_data.dtype),
             "layer_chunk": layer_chunk,
             "error": error,
+            "head_layout": head_layout,
+            "src_tp": src_tp,
         }
         await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
         if n:
@@ -259,9 +271,16 @@ class LocalKvPipe:
         k_data: Optional[np.ndarray],
         v_data: Optional[np.ndarray],
         error: Optional[str] = None,
+        head_layout: str = "blocked",
+        src_tp: int = 1,
     ) -> None:
         fut = self._pending.pop(request_id, None)
         if fut is None or fut.done():
             return
         n = 0 if k_data is None else int(k_data.shape[2])
-        fut.set_result(KvDelivery(request_id, first_token, n, k_data, v_data, error))
+        fut.set_result(
+            KvDelivery(
+                request_id, first_token, n, k_data, v_data, error,
+                head_layout=head_layout, src_tp=src_tp,
+            )
+        )

@@ -36,11 +36,15 @@ class PrefillWorker:
         queue: PrefillQueue,
         local_pipe: Optional[LocalKvPipe] = None,
         layer_chunk: int = 4,
+        head_layout: Optional[str] = None,
     ):
         self.engine = engine
         self.queue = queue
         self.local_pipe = local_pipe
         self.layer_chunk = layer_chunk
+        # wire-declared kv-head ordering; override only when wrapping an
+        # engine whose extraction really produces a non-natural order
+        self.head_layout = head_layout or engine.cfg.kv_head_layout
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
         self.stats = {"prefills_total": 0, "prefill_errors": 0, "nacks": 0}
@@ -107,13 +111,17 @@ class PrefillWorker:
             req, ctx, skip_blocks=rpr.skip_blocks
         )
         self.stats["prefills_total"] += 1
+        layout = self.head_layout
+        tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
         if rpr.connection.get("local"):
             assert self.local_pipe is not None, "local connection without pipe"
-            await self.local_pipe.deliver(rpr.request_id, first, k, v)
+            await self.local_pipe.deliver(
+                rpr.request_id, first, k, v, head_layout=layout, src_tp=tp
+            )
         else:
             await send_kv_blocks(
                 rpr.connection, rpr.request_id, first, k, v,
-                layer_chunk=self.layer_chunk,
+                layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
             )
 
     async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
@@ -217,8 +225,33 @@ class DisaggEngine(AsyncEngine):
             self.engine.abort_remote(handle, delivery.error)
             yield await handle.seq.out_queue.get()
             return
+        k_data, v_data = delivery.k_data, delivery.v_data
+        my_layout = self.engine.cfg.kv_head_layout
+        my_tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
+        # interleaved orderings are tp-dependent: same-layout peers with
+        # different tp still need the regroup (ref kv_rearrange, patch:743-810)
+        mismatched = k_data is not None and (
+            delivery.head_layout != my_layout
+            or (delivery.head_layout == "interleaved" and delivery.src_tp != my_tp)
+        )
+        if mismatched:
+            from ..ops.kv_rearrange import rearrange_for_decode
+
+            try:
+                k_data = rearrange_for_decode(
+                    k_data, delivery.src_tp, my_tp, delivery.head_layout, my_layout
+                )
+                v_data = rearrange_for_decode(
+                    v_data, delivery.src_tp, my_tp, delivery.head_layout, my_layout
+                )
+            except Exception as e:  # noqa: BLE001 — bad peer metadata must
+                # not leak the reservation (blocks) or hang the caller
+                self.stats["remote_errors"] += 1
+                self.engine.abort_remote(handle, f"kv rearrange failed: {e}")
+                yield await handle.seq.out_queue.get()
+                return
         out_queue = await self.engine.complete_remote(
-            handle, delivery.first_token, delivery.k_data, delivery.v_data
+            handle, delivery.first_token, k_data, v_data
         )
         while True:
             out = await out_queue.get()

@@ -65,8 +65,20 @@ class EngineConfig:
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
+    # kv-head ordering of this engine's cache. The native JAX engine
+    # stores heads in natural (blocked) order — only "blocked" is valid
+    # here; foreign-ordered peers declare their layout on the KV wire
+    # (PrefillWorker head_layout / KvDelivery.head_layout) and the decode
+    # side regroups on delivery (ops/kv_rearrange.py; ref kv_rearrange)
+    kv_head_layout: str = "blocked"
 
     def __post_init__(self):
+        if self.kv_head_layout != "blocked":
+            raise ValueError(
+                "JaxEngine stores kv heads in blocked (natural) order; "
+                f"kv_head_layout={self.kv_head_layout!r} would mislabel the "
+                "cache — foreign layouts belong on the transfer metadata"
+            )
         if self.max_context == 0:
             self.max_context = self.model.max_position_embeddings
         self.max_blocks_per_seq = (

@@ -49,6 +49,12 @@ class ModelConfig:
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "ModelConfig":
+        archs = cfg.get("architectures") or []
+        # Qwen2 has qkv bias baked into the architecture; its HF config
+        # carries no attention_bias field
+        qkv_bias = cfg.get("attention_bias", False) or any(
+            a.startswith("Qwen2") for a in archs
+        )
         return ModelConfig(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=cfg.get("hidden_size", 4096),
@@ -62,14 +68,14 @@ class ModelConfig:
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
-            attention_bias=cfg.get("attention_bias", False),
+            attention_bias=qkv_bias,
             num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
             first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
-            dtype=cfg.get("torch_dtype", "bfloat16"),
+            dtype=cfg.get("torch_dtype") or "bfloat16",
         )
 
     @staticmethod

@@ -1,0 +1,101 @@
+"""KV head-layout rearrangement for TP-mismatched prefill/decode.
+
+Re-design of the reference's ``kv_rearrange`` Triton kernel
+(vllm patch:743-810), which re-groups the head dimension when the prefill
+worker's tensor-parallel degree differs from the decode worker's: NIXL
+writes raw *per-rank* GPU buffers, so a TP=2 prefill shard pair must be
+re-split into TP=4 decode quarters on the wire.
+
+The TPU build mostly does NOT need that kernel: KV travels as a global
+``[L, Hkv, n_blocks, bs, D]`` array (disagg/transfer.py), and scattering it
+into a decode cache jit-sharded over any tp degree is XLA's job — the
+mesh sharding splits the head axis however the decode mesh needs. What
+remains real on TPU:
+
+  * **layout regroup** — checkpoints/engines may order kv heads
+    "blocked" (shard-contiguous: shard i of tp=P owns heads
+    [i*H/P, (i+1)*H/P)) or "interleaved" (round-robin: shard i owns heads
+    i, i+P, i+2P, …). Converting between them is a head-axis permutation.
+  * **GQA replication** — a decode mesh with tp > num_kv_heads needs each
+    kv head replicated tp/Hkv times so every shard holds a full copy.
+
+Both are pure gathers over the head axis; under jit XLA lowers them to a
+single HBM-bandwidth copy fused with the surrounding scatter — a
+hand-written Pallas kernel could not beat that, so none is used (cf. the
+reference needing Triton only because its buffers live outside any
+compiler-managed layout).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _head_permutation(num_heads: int, tp: int, src_layout: str, dst_layout: str) -> np.ndarray:
+    """Permutation p with out[h] = in[p[h]] converting head order
+    src_layout -> dst_layout for a tp-way sharding."""
+    if src_layout == dst_layout:
+        return np.arange(num_heads)
+    if num_heads % tp:
+        raise ValueError(f"{num_heads} heads not divisible by tp={tp}")
+    per = num_heads // tp
+    # interleaved order listed shard-major: position j (shard j//per,
+    # slot r=j%per) holds head (j//per) + r*tp
+    interleaved = np.arange(num_heads).reshape(per, tp).T.reshape(-1)
+    if src_layout == "blocked" and dst_layout == "interleaved":
+        # out[j] = in[interleaved[j]] places head ids in interleaved order
+        return interleaved
+    if src_layout == "interleaved" and dst_layout == "blocked":
+        inv = np.empty(num_heads, np.int64)
+        inv[interleaved] = np.arange(num_heads)
+        return inv
+    raise ValueError(f"unknown layouts {src_layout!r}->{dst_layout!r}")
+
+
+def regroup_heads(
+    kv,
+    tp: int,
+    src_layout: str = "blocked",
+    dst_layout: str = "blocked",
+    head_axis: int = 1,
+):
+    """Permute the kv-head axis between shard layouts (jit-able; works on
+    numpy or jax arrays). ``[L, Hkv, n, bs, D]`` stacks use head_axis=1."""
+    perm = _head_permutation(kv.shape[head_axis], tp, src_layout, dst_layout)
+    if (perm == np.arange(len(perm))).all():
+        return kv
+    return kv.take(perm, axis=head_axis)
+
+
+def expand_kv_heads(kv, factor: int, head_axis: int = 1):
+    """Replicate each kv head ``factor`` times (decode tp > num_kv_heads:
+    every pair/quad of decode shards needs its own copy of the head).
+    Shard i of the expanded array then owns exactly one replica."""
+    if factor == 1:
+        return kv
+    idx = np.repeat(np.arange(kv.shape[head_axis]), factor)
+    return kv.take(idx, axis=head_axis)
+
+
+def rearrange_for_decode(
+    kv,
+    src_tp: int,
+    dst_tp: int,
+    src_layout: str = "blocked",
+    dst_layout: str = "blocked",
+    head_axis: int = 1,
+):
+    """Full prefill->decode adaptation: undo the source head ordering,
+    apply the destination's (ref kv_rearrange's TP-mismatch path,
+    patch:743-810). Interleaved orderings are tp-dependent, so
+    interleaved->interleaved with src_tp != dst_tp is NOT an identity.
+
+    Note: no head replication happens here — the decode cache is a global
+    ``[L, Hkv, …]`` array whose tp>Hkv replication (GQA) is a *sharding*
+    concern handled by the mesh, never a data transform
+    (``expand_kv_heads`` exists for per-shard export paths only)."""
+    if src_layout != "blocked":
+        kv = regroup_heads(kv, src_tp, src_layout, "blocked", head_axis)
+    if dst_layout != "blocked":
+        kv = regroup_heads(kv, dst_tp, "blocked", dst_layout, head_axis)
+    return kv

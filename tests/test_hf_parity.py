@@ -1,0 +1,117 @@
+"""Numerical parity vs HuggingFace transformers (torch CPU).
+
+The strongest correctness check for the model zoo: build a tiny random HF
+checkpoint per family (llama / qwen2 / mistral / mixtral), load it with our
+safetensors loader, and compare full-vocab logits of the JAX forward pass
+against the torch reference. Catches weight-transpose, RoPE, GQA, bias and
+router bugs that internal-consistency tests cannot see.
+
+(ref parity point: the reference delegates correctness to vLLM et al.; the
+TPU build owns the models, so it owns this proof too.)
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.models.weights import load_llama_params  # noqa: E402
+
+TINY = dict(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=112,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=128,
+    torch_dtype="float32",
+)
+
+
+def _save(tmp_path, model):
+    model = model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    # make the declared dtype explicit for our loader (older transformers
+    # versions omit torch_dtype from the saved config)
+    cfg_path = tmp_path / "config.json"
+    cfg = json.loads(cfg_path.read_text())
+    cfg["torch_dtype"] = "float32"
+    cfg_path.write_text(json.dumps(cfg))
+    return str(tmp_path)
+
+
+def _compare(path, tokens, hf_model, atol=2e-4):
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.dtype == "float32"
+    params = load_llama_params(path, cfg)
+    ours = np.asarray(llama.dense_forward(params, cfg, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens)[None]).logits[0].numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=2e-3)
+
+
+TOKENS = [3, 17, 92, 45, 200, 7, 7, 133]
+
+
+def test_llama_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(**TINY, rope_theta=10000.0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _compare(_save(tmp_path, model), TOKENS, model)
+
+
+def test_qwen2_parity(tmp_path):
+    # qwen2: qkv bias baked into the architecture (no config field) —
+    # randomize the zero-initialized biases so the check isn't vacuous
+    hf_cfg = transformers.Qwen2Config(**TINY)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith("bias"):
+                p.normal_(0.0, 0.1)
+    path = _save(tmp_path, model)
+    assert ModelConfig.from_local_path(path).attention_bias
+    _compare(path, TOKENS, model)
+
+
+def test_mistral_parity(tmp_path):
+    hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
+    model = transformers.MistralForCausalLM(hf_cfg)
+    _compare(_save(tmp_path, model), TOKENS, model)
+
+
+def test_mixtral_parity(tmp_path):
+    hf_cfg = transformers.MixtralConfig(
+        **TINY, num_local_experts=4, num_experts_per_tok=2
+    )
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    _compare(_save(tmp_path, model), TOKENS, model)
+
+
+def test_tied_embeddings_parity(tmp_path):
+    cfg_kwargs = dict(TINY, tie_word_embeddings=True)
+    hf_cfg = transformers.LlamaConfig(**cfg_kwargs)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _compare(_save(tmp_path, model), TOKENS, model)
+
+
+def test_llama31_rope_scaling_parity(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        **TINY,
+        rope_theta=500000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    _compare(_save(tmp_path, model), TOKENS, model)

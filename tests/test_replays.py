@@ -1,0 +1,94 @@
+"""Golden-file replay tests: recorded SSE streams -> aggregator folding.
+
+Mirrors the reference's replay-data strategy (lib/llm/tests/data/replays/
+{meta,mistralai}/… incl. edge_cases): checked-in wire-format streams are
+parsed with the SSE codec and folded with the aggregators; the expected
+full responses are asserted exactly. Catches codec/aggregator regressions
+against real recorded byte streams, not synthetic dicts — including
+incremental (byte-at-a-time) parser feeding.
+"""
+
+import os
+import random
+
+from dynamo_tpu.protocols.aggregator import (
+    aggregate_chat_chunks,
+    aggregate_completion_chunks,
+)
+from dynamo_tpu.protocols.sse import SseParser, parse_sse_stream
+
+REPLAYS = os.path.join(os.path.dirname(__file__), "data", "replays")
+
+
+def _load(name: str) -> bytes:
+    with open(os.path.join(REPLAYS, name), "rb") as f:
+        return f.read()
+
+
+def _data_chunks(events):
+    return [e.json() for e in events if e.data is not None and not e.is_done()]
+
+
+def test_chat_basic_fold():
+    events = parse_sse_stream(_load("chat_basic.sse"))
+    assert events[-1].is_done()
+    out = aggregate_chat_chunks(_data_chunks(events))
+    assert out["id"] == "chatcmpl-r1" and out["object"] == "chat.completion"
+    choice = out["choices"][0]
+    assert choice["message"]["content"] == "The quick brown fox"
+    assert choice["finish_reason"] == "stop"
+    assert out["usage"]["total_tokens"] == 13
+
+
+def test_chat_tool_calls_fold():
+    events = parse_sse_stream(_load("chat_tool_calls.sse"))
+    out = aggregate_chat_chunks(_data_chunks(events))
+    tc = out["choices"][0]["message"]["tool_calls"]
+    assert len(tc) == 1
+    assert tc[0]["id"] == "call_7"
+    assert tc[0]["function"]["name"] == "get_weather"
+    assert tc[0]["function"]["arguments"] == '{"city":"Paris"}'
+    assert out["choices"][0]["finish_reason"] == "tool_calls"
+
+
+def test_chat_edge_unicode_comments_events():
+    raw = _load("chat_edge_unicode_and_events.sse")
+    events = parse_sse_stream(raw)
+    # the keep-alive comment and the named event must not corrupt folding
+    named = [e for e in events if e.event == "annotation"]
+    assert len(named) == 1 and named[0].json()["data"] == [42, 17]
+    chunks = [
+        e.json()
+        for e in events
+        if e.data is not None and not e.is_done() and e.event is None
+    ]
+    out = aggregate_chat_chunks(chunks)
+    assert out["choices"][0]["message"]["content"] == "naïve — café 🍕"
+    assert out["choices"][0]["finish_reason"] == "length"
+
+
+def test_completion_basic_fold():
+    events = parse_sse_stream(_load("completion_basic.sse"))
+    out = aggregate_completion_chunks(_data_chunks(events))
+    assert out["object"] == "text_completion"
+    assert out["choices"][0]["text"] == "Hello, world!"
+    assert out["usage"]["completion_tokens"] == 3
+
+
+def test_incremental_parse_matches_whole_buffer():
+    """Feeding the parser at random split points (including mid-UTF-8
+    rune) must yield the same events as one-shot parsing."""
+    raw = _load("chat_edge_unicode_and_events.sse")
+    whole = parse_sse_stream(raw)
+    rng = random.Random(5)
+    for _ in range(10):
+        parser = SseParser()
+        got = []
+        i = 0
+        while i < len(raw):
+            j = min(len(raw), i + rng.randint(1, 17))
+            got.extend(parser.feed(raw[i:j]))
+            i = j
+        assert [(e.data, e.event) for e in got] == [
+            (e.data, e.event) for e in whole
+        ]
