@@ -1,0 +1,34 @@
+"""Deployment plane: spec types, k8s manifest generation, api-server.
+
+TPU-native re-design of the reference's Kubernetes machinery
+(deploy/dynamo/operator Go CRDs + controllers, deploy/dynamo/api-server
+REST): the deployment *spec* is the same shape (a graph deployment with
+per-service replicas/resources/autoscaling, operator/api/v1alpha1/
+dynamodeployment_types.go:28), but instead of an in-cluster reconciler
+the TPU build renders deterministic manifests (GitOps-style) with
+TPU-slice scheduling (nodeSelectors for gke-tpu-accelerator/topology,
+one worker per slice host group) — a controller has nothing TPU-specific
+to reconcile that the manifest cannot declare.
+"""
+
+from .api_server import ApiServer
+from .builder import build_artifact, read_artifact
+from .crd import (
+    Autoscaling,
+    DynamoDeployment,
+    Resources,
+    ServiceDeploymentSpec,
+)
+from .manifests import render_manifests, to_yaml
+
+__all__ = [
+    "ApiServer",
+    "Autoscaling",
+    "DynamoDeployment",
+    "Resources",
+    "ServiceDeploymentSpec",
+    "build_artifact",
+    "read_artifact",
+    "render_manifests",
+    "to_yaml",
+]

@@ -1,0 +1,195 @@
+"""Deployment api-server.
+
+Re-design of the reference's Go api-server (deploy/dynamo/api-server/api/
+routes/routes.go:339: REST for clusters/deployments/revisions backed by
+Postgres): a REST service over the shared asyncio HTTP base with a
+file-backed store (one JSON per deployment, atomic replace; artifacts as
+content-addressed tarballs) — the control plane a TPU-VM fleet actually
+needs, with no database dependency.
+
+  GET    /health
+  GET    /api/v1/deployments
+  POST   /api/v1/deployments                   (409 on duplicate)
+  GET    /api/v1/deployments/{name}
+  PUT    /api/v1/deployments/{name}
+  DELETE /api/v1/deployments/{name}
+  GET    /api/v1/deployments/{name}/manifests  (YAML stream, text/yaml)
+  GET    /api/v1/artifacts
+  POST   /api/v1/artifacts                     (raw tar.gz body -> digest)
+  GET    /api/v1/artifacts/{digest}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+from ..http.base import HttpError, HttpServerBase
+from .crd import DynamoDeployment, SpecError
+from .manifests import render_manifests, to_yaml
+
+
+class DeploymentStore:
+    """Durable deployment specs: one JSON file per deployment, written
+    atomically (tmp + rename) so a crashed write never corrupts a spec."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "deployments"), exist_ok=True)
+        os.makedirs(os.path.join(root, "artifacts"), exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        if not name or "/" in name or name.startswith("."):
+            raise HttpError(400, f"bad deployment name {name!r}")
+        return os.path.join(self.root, "deployments", name + ".json")
+
+    def list(self) -> list[str]:
+        d = os.path.join(self.root, "deployments")
+        return sorted(f[:-5] for f in os.listdir(d) if f.endswith(".json"))
+
+    def get(self, name: str) -> dict:
+        try:
+            with open(self._path(name)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise HttpError(404, f"deployment {name!r} not found", "not_found") from None
+
+    def put(self, name: str, spec: dict, create: bool) -> None:
+        path = self._path(name)
+        if create and os.path.exists(path):
+            raise HttpError(409, f"deployment {name!r} exists", "conflict")
+        if not create and not os.path.exists(path):
+            raise HttpError(404, f"deployment {name!r} not found", "not_found")
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        with os.fdopen(fd, "w") as f:
+            json.dump(spec, f, indent=2)
+        os.replace(tmp, path)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            raise HttpError(404, f"deployment {name!r} not found", "not_found") from None
+
+    # ---- artifacts ("bentos", ref api-server revisions) ----
+
+    def put_artifact(self, data: bytes) -> str:
+        digest = hashlib.sha256(data).hexdigest()[:16]
+        path = os.path.join(self.root, "artifacts", digest + ".tar.gz")
+        if not os.path.exists(path):
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        return digest
+
+    def list_artifacts(self) -> list[str]:
+        d = os.path.join(self.root, "artifacts")
+        return sorted(f[: -len(".tar.gz")] for f in os.listdir(d) if f.endswith(".tar.gz"))
+
+    def get_artifact(self, digest: str) -> bytes:
+        if not digest or "/" in digest or digest.startswith("."):
+            raise HttpError(400, f"bad digest {digest!r}")
+        try:
+            with open(os.path.join(self.root, "artifacts", digest + ".tar.gz"), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise HttpError(404, f"artifact {digest!r} not found", "not_found") from None
+
+
+class ApiServer(HttpServerBase):
+    def __init__(self, root: str, host: str = "0.0.0.0", port: int = 7700):
+        super().__init__(host=host, port=port)
+        self.store = DeploymentStore(root)
+
+    def _parse_spec(self, body: bytes) -> DynamoDeployment:
+        try:
+            spec = DynamoDeployment.from_dict(json.loads(body))
+            spec.validate()
+            return spec
+        except (json.JSONDecodeError, KeyError, TypeError, SpecError) as e:
+            raise HttpError(422, f"invalid deployment spec: {e}") from None
+
+    async def _route(self, method, path, headers, body, writer) -> None:
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if method == "GET" and parts == ["health"]:
+            await self._send_json(writer, 200, {"status": "ok"})
+            return
+        if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+            raise HttpError(404, f"no route for {method} {path}", "not_found")
+        rest = parts[2:]
+
+        if rest and rest[0] == "deployments":
+            if method == "GET" and len(rest) == 1:
+                await self._send_json(
+                    writer, 200, {"deployments": self.store.list()}
+                )
+            elif method == "POST" and len(rest) == 1:
+                spec = self._parse_spec(body)
+                self.store.put(spec.name, spec.to_dict(), create=True)
+                await self._send_json(writer, 201, spec.to_dict())
+            elif method == "GET" and len(rest) == 2:
+                await self._send_json(writer, 200, self.store.get(rest[1]))
+            elif method == "PUT" and len(rest) == 2:
+                spec = self._parse_spec(body)
+                if spec.name != rest[1]:
+                    raise HttpError(422, "spec name does not match URL")
+                self.store.put(rest[1], spec.to_dict(), create=False)
+                await self._send_json(writer, 200, spec.to_dict())
+            elif method == "DELETE" and len(rest) == 2:
+                self.store.delete(rest[1])
+                await self._send_json(writer, 200, {"deleted": rest[1]})
+            elif method == "GET" and len(rest) == 3 and rest[2] == "manifests":
+                dep = DynamoDeployment.from_dict(self.store.get(rest[1]))
+                yaml_text = to_yaml(render_manifests(dep))
+                await self._send_response(
+                    writer, 200, yaml_text.encode(), content_type="text/yaml"
+                )
+            else:
+                raise HttpError(405, f"{method} not allowed on {path}")
+            return
+
+        if rest and rest[0] == "artifacts":
+            if method == "GET" and len(rest) == 1:
+                await self._send_json(
+                    writer, 200, {"artifacts": self.store.list_artifacts()}
+                )
+            elif method == "POST" and len(rest) == 1:
+                digest = self.store.put_artifact(body)
+                await self._send_json(writer, 201, {"digest": digest})
+            elif method == "GET" and len(rest) == 2:
+                await self._send_response(
+                    writer, 200, self.store.get_artifact(rest[1]),
+                    content_type="application/gzip",
+                )
+            else:
+                raise HttpError(405, f"{method} not allowed on {path}")
+            return
+
+        raise HttpError(404, f"no route for {method} {path}", "not_found")
+
+
+def main(argv=None) -> None:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser("dynamo-api-server", description=__doc__)
+    p.add_argument("--root", default="./dynamo-deployments")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7700)
+    args = p.parse_args(argv)
+
+    async def run():
+        srv = ApiServer(args.root, host=args.host, port=args.port)
+        await srv.start()
+        print(f"api-server on http://{args.host}:{srv.port} (root {args.root})",
+              flush=True)
+        await srv.run()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""Graph artifact builder + deploy CLI ("bentos" equivalent).
+
+Re-design of the reference's ``dynamo build`` packaging (deploy/dynamo/sdk
+cli/{bentos,deploy}.py, BentoML-derived): resolve the service graph,
+package source + config + a build manifest into a tar.gz artifact, and
+push specs/artifacts to the api-server.
+
+  python -m dynamo_tpu.deploy build  examples.sdk_pipeline:Frontend -o graph.tar.gz
+  python -m dynamo_tpu.deploy deploy spec.json   --api http://host:7700
+  python -m dynamo_tpu.deploy manifests spec.json > k8s.yaml
+"""
+
+from __future__ import annotations
+
+import importlib
+import io
+import json
+import os
+import tarfile
+import time
+from typing import Optional
+
+MANIFEST_NAME = "dynamo_manifest.json"
+
+
+def _resolve(graph: str):
+    mod_name, _, leaf_name = graph.partition(":")
+    mod = importlib.import_module(mod_name)
+    leaf = getattr(mod, leaf_name)
+    from ..sdk.service import resolve_graph
+
+    return mod, resolve_graph(leaf)
+
+
+def build_artifact(
+    graph: str,
+    out_path: str,
+    config: Optional[dict] = None,
+    created_ts: Optional[float] = None,
+) -> dict:
+    """Package the graph's source module + manifest into ``out_path``.
+
+    The manifest records the graph entry, its resolved services (name,
+    namespace, endpoints), and the per-service config — everything the
+    serving CLI needs to run the artifact on a fresh host."""
+    mod, specs = _resolve(graph)
+    manifest = {
+        "graph": graph,
+        "created": created_ts if created_ts is not None else time.time(),
+        "services": [
+            {
+                "name": s.name,
+                "namespace": s.namespace,
+                "endpoints": sorted(s.endpoints),
+            }
+            for s in specs
+        ],
+        "config": config or {},
+    }
+    src_file = getattr(mod, "__file__", None)
+    with tarfile.open(out_path, "w:gz") as tar:
+        data = json.dumps(manifest, indent=2).encode()
+        info = tarfile.TarInfo(MANIFEST_NAME)
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+        if src_file and os.path.exists(src_file):
+            tar.add(src_file, arcname=f"src/{os.path.basename(src_file)}")
+    return manifest
+
+
+def read_artifact(path: str) -> dict:
+    """Load the build manifest from an artifact."""
+    with tarfile.open(path, "r:gz") as tar:
+        f = tar.extractfile(MANIFEST_NAME)
+        if f is None:
+            raise ValueError(f"{path} has no {MANIFEST_NAME}")
+        return json.load(f)
+
+
+# ---------------- CLI ----------------
+
+
+def _http_json(method: str, url: str, body: Optional[bytes] = None) -> dict:
+    import urllib.request
+
+    req = urllib.request.Request(url, data=body, method=method)
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("dynamo-deploy", description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    b = sub.add_parser("build", help="package a graph into an artifact")
+    b.add_argument("graph", help="pkg.module:LeafService")
+    b.add_argument("-o", "--out", default="graph.tar.gz")
+    b.add_argument("-f", "--file", default=None, help="config yaml/json")
+
+    d = sub.add_parser("deploy", help="push a deployment spec to the api-server")
+    d.add_argument("spec", help="deployment spec json file")
+    d.add_argument("--api", default="http://127.0.0.1:7700")
+
+    m = sub.add_parser("manifests", help="render k8s manifests for a spec")
+    m.add_argument("spec", help="deployment spec json file")
+
+    args = p.parse_args(argv)
+    if args.verb == "build":
+        config = None
+        if args.file:
+            from ..sdk.cli import _load_config
+
+            config = _load_config(args.file)
+        manifest = build_artifact(args.graph, args.out, config=config)
+        print(f"built {args.out}: {len(manifest['services'])} services "
+              f"({', '.join(s['name'] for s in manifest['services'])})")
+    elif args.verb == "deploy":
+        with open(args.spec, "rb") as f:
+            body = f.read()
+        name = json.loads(body)["name"]
+        try:
+            out = _http_json("POST", f"{args.api}/api/v1/deployments", body)
+        except Exception:
+            out = _http_json("PUT", f"{args.api}/api/v1/deployments/{name}", body)
+        print(f"deployed {out['name']}: services "
+              f"{[s['name'] for s in out['services']]}")
+    elif args.verb == "manifests":
+        from .crd import DynamoDeployment
+        from .manifests import render_manifests, to_yaml
+
+        with open(args.spec) as f:
+            dep = DynamoDeployment.from_dict(json.load(f))
+        print(to_yaml(render_manifests(dep)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Deployment spec types (CRD-equivalents).
+
+Re-design of the reference's CRDs (operator/api/v1alpha1/
+dynamodeployment_types.go:28 `DynamoDeployment`,
+dynamonimdeployment_types.go `DynamoNimDeployment`): a deployment is a
+named graph of services; each service declares replicas, resources
+(with first-class TPU topology instead of nvidia.com/gpu counts),
+autoscaling, env, and optional ingress. Specs are plain dataclasses with
+dict/JSON round-trip and validation — consumed by the manifest renderer
+and the api-server.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+# GKE TPU accelerator names (cloud.google.com/gke-tpu-accelerator values)
+TPU_ACCELERATORS = {
+    "tpu-v4-podslice",
+    "tpu-v5-lite-podslice",   # v5e
+    "tpu-v5p-slice",
+    "tpu-v6e-slice",
+}
+
+
+class SpecError(ValueError):
+    pass
+
+
+@dataclass
+class Resources:
+    """Per-replica resources (ref dynamonimdeployment_types.go Resources,
+    TPU-flavored: an accelerator + topology instead of a GPU count)."""
+
+    cpu: str = "1"
+    memory: str = "2Gi"
+    tpu_accelerator: str = ""     # "" = CPU-only service (frontend, router)
+    tpu_topology: str = ""        # e.g. "2x4" — chips per replica's slice
+    tpu_chips: int = 0            # chips requested per host (google.com/tpu)
+
+    def validate(self) -> None:
+        if self.tpu_accelerator and self.tpu_accelerator not in TPU_ACCELERATORS:
+            raise SpecError(
+                f"unknown tpu accelerator {self.tpu_accelerator!r}; "
+                f"expected one of {sorted(TPU_ACCELERATORS)}"
+            )
+        if self.tpu_accelerator and not self.tpu_topology:
+            raise SpecError("tpu_topology required when tpu_accelerator is set")
+        if self.tpu_accelerator and self.tpu_chips <= 0:
+            raise SpecError("tpu_chips must be > 0 for TPU services")
+
+
+@dataclass
+class Autoscaling:
+    """ref dynamonimdeployment_types.go Autoscaling."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # scale on the worker's queue depth (num_requests_waiting from the
+    # metrics plane) — the TPU-meaningful signal; CPU% is meaningless for
+    # a device-bound worker
+    target_queue_depth: int = 8
+
+    def validate(self) -> None:
+        if self.enabled and self.min_replicas > self.max_replicas:
+            raise SpecError("min_replicas > max_replicas")
+
+
+@dataclass
+class ServiceDeploymentSpec:
+    """One service of the graph (ref DynamoNimDeployment spec)."""
+
+    name: str
+    command: list[str] = field(default_factory=list)  # container args
+    replicas: int = 1
+    resources: Resources = field(default_factory=Resources)
+    autoscaling: Autoscaling = field(default_factory=Autoscaling)
+    env: dict[str, str] = field(default_factory=dict)
+    # expose an HTTP ingress for this service (the OpenAI frontend)
+    http_port: int = 0
+    ingress_host: str = ""
+
+    def validate(self) -> None:
+        if not self.name or "/" in self.name:
+            raise SpecError(f"bad service name {self.name!r}")
+        if self.replicas < 0:
+            raise SpecError("replicas must be >= 0")
+        self.resources.validate()
+        self.autoscaling.validate()
+
+
+@dataclass
+class DynamoDeployment:
+    """The graph deployment (ref dynamodeployment_types.go:28)."""
+
+    name: str
+    namespace: str = "default"
+    image: str = "dynamo-tpu:latest"
+    hub_port: int = 18500
+    services: list[ServiceDeploymentSpec] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise SpecError("deployment needs a name")
+        seen = set()
+        for svc in self.services:
+            svc.validate()
+            if svc.name in seen:
+                raise SpecError(f"duplicate service {svc.name!r}")
+            seen.add(svc.name)
+        if not self.services:
+            raise SpecError("deployment has no services")
+
+    # ---- dict/JSON round-trip (api-server wire format) ----
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "DynamoDeployment":
+        services = [
+            ServiceDeploymentSpec(
+                name=s["name"],
+                command=list(s.get("command", [])),
+                replicas=s.get("replicas", 1),
+                resources=Resources(**s.get("resources", {})),
+                autoscaling=Autoscaling(**s.get("autoscaling", {})),
+                env=dict(s.get("env", {})),
+                http_port=s.get("http_port", 0),
+                ingress_host=s.get("ingress_host", ""),
+            )
+            for s in d.get("services", [])
+        ]
+        return DynamoDeployment(
+            name=d["name"],
+            namespace=d.get("namespace", "default"),
+            image=d.get("image", "dynamo-tpu:latest"),
+            hub_port=d.get("hub_port", 18500),
+            services=services,
+            labels=dict(d.get("labels", {})),
+        )

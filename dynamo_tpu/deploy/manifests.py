@@ -1,0 +1,197 @@
+"""Deployment spec -> Kubernetes manifests.
+
+What the reference's operator reconcilers materialize imperatively
+(operator/internal/controller/dynamonimdeployment_controller.go: child
+Deployments, Services, Ingress), the TPU build renders declaratively:
+
+  * a hub Deployment + Service (control plane; the reference deploys
+    etcd + NATS here, deploy/docker-compose.yml:16-40),
+  * per graph service: a Deployment (or one per TPU slice) with TPU
+    nodeSelectors (`cloud.google.com/gke-tpu-accelerator`,
+    `gke-tpu-topology`) and `google.com/tpu` chip limits,
+  * a Service for any http_port, an Ingress for ingress_host,
+  * queue-depth HPA-equivalent rendered as an annotation block (the
+    autoscaler component consumes it; k8s HPA cannot see queue depth).
+
+Manifests are plain dicts; ``to_yaml`` serializes a multi-doc stream.
+"""
+
+from __future__ import annotations
+
+from .crd import DynamoDeployment, ServiceDeploymentSpec
+
+MANAGED_BY = "dynamo-tpu"
+
+
+def _meta(dep: DynamoDeployment, name: str, extra: dict | None = None) -> dict:
+    labels = {
+        "app.kubernetes.io/managed-by": MANAGED_BY,
+        "dynamo.deployment": dep.name,
+        **dep.labels,
+        **(extra or {}),
+    }
+    return {"name": name, "namespace": dep.namespace, "labels": labels}
+
+
+def _hub_manifests(dep: DynamoDeployment) -> list[dict]:
+    name = f"{dep.name}-hub"
+    labels = {"dynamo.component": "hub"}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta(dep, name, labels),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"dynamo.service": name}},
+                "template": {
+                    "metadata": {"labels": {"dynamo.service": name, **labels}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "hub",
+                                "image": dep.image,
+                                "args": [
+                                    "python", "-m", "dynamo_tpu.launch.dynamo_run",
+                                    "hub", "--hub-port", str(dep.hub_port),
+                                ],
+                                "ports": [{"containerPort": dep.hub_port}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(dep, name, labels),
+            "spec": {
+                "selector": {"dynamo.service": name},
+                "ports": [{"port": dep.hub_port, "targetPort": dep.hub_port}],
+            },
+        },
+    ]
+
+
+def _container(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> dict:
+    hub_addr = f"{dep.name}-hub.{dep.namespace}.svc:{dep.hub_port}"
+    env = [{"name": "DYN_RUNTIME_HUB_URL", "value": hub_addr}]
+    env += [{"name": k, "value": v} for k, v in sorted(svc.env.items())]
+    res = svc.resources
+    limits: dict = {"cpu": res.cpu, "memory": res.memory}
+    if res.tpu_accelerator:
+        limits["google.com/tpu"] = str(res.tpu_chips)
+    c = {
+        "name": svc.name,
+        "image": dep.image,
+        "args": list(svc.command),
+        "env": env,
+        "resources": {"limits": limits, "requests": {"cpu": res.cpu, "memory": res.memory}},
+    }
+    if svc.http_port:
+        c["ports"] = [{"containerPort": svc.http_port}]
+        c["readinessProbe"] = {
+            "httpGet": {"path": "/health", "port": svc.http_port},
+            "periodSeconds": 5,
+        }
+    return c
+
+
+def _service_manifests(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> list[dict]:
+    name = f"{dep.name}-{svc.name}"
+    labels = {"dynamo.component": svc.name}
+    pod_spec: dict = {"containers": [_container(dep, svc)]}
+    res = svc.resources
+    if res.tpu_accelerator:
+        # TPU slice scheduling: GKE places the pod on a node of the slice
+        # with the matching accelerator/topology; chips-per-host come from
+        # the google.com/tpu limit (the TPU analog of nvidia.com/gpu)
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": res.tpu_accelerator,
+            "cloud.google.com/gke-tpu-topology": res.tpu_topology,
+        }
+    annotations = {}
+    if svc.autoscaling.enabled:
+        a = svc.autoscaling
+        annotations["dynamo.autoscale"] = (
+            f"min={a.min_replicas},max={a.max_replicas},"
+            f"target_queue_depth={a.target_queue_depth}"
+        )
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(dep, name, labels) | (
+            {"annotations": annotations} if annotations else {}
+        ),
+        "spec": {
+            "replicas": svc.replicas,
+            "selector": {"matchLabels": {"dynamo.service": name}},
+            "template": {
+                "metadata": {"labels": {"dynamo.service": name, **labels}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    out = [deployment]
+    if svc.http_port:
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": _meta(dep, name, labels),
+                "spec": {
+                    "selector": {"dynamo.service": name},
+                    "ports": [{"port": svc.http_port, "targetPort": svc.http_port}],
+                },
+            }
+        )
+    if svc.ingress_host:
+        out.append(
+            {
+                "apiVersion": "networking.k8s.io/v1",
+                "kind": "Ingress",
+                "metadata": _meta(dep, name, labels),
+                "spec": {
+                    "rules": [
+                        {
+                            "host": svc.ingress_host,
+                            "http": {
+                                "paths": [
+                                    {
+                                        "path": "/",
+                                        "pathType": "Prefix",
+                                        "backend": {
+                                            "service": {
+                                                "name": name,
+                                                "port": {"number": svc.http_port},
+                                            }
+                                        },
+                                    }
+                                ]
+                            },
+                        }
+                    ]
+                },
+            }
+        )
+    return out
+
+
+def render_manifests(dep: DynamoDeployment) -> list[dict]:
+    """Validate + render the full manifest set for one deployment."""
+    dep.validate()
+    out = _hub_manifests(dep)
+    for svc in dep.services:
+        out.extend(_service_manifests(dep, svc))
+    return out
+
+
+def to_yaml(manifests: list[dict]) -> str:
+    """Multi-document YAML stream (kubectl apply -f -)."""
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(m, sort_keys=False, default_flow_style=False)
+        for m in manifests
+    )
