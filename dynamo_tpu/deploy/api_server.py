@@ -100,7 +100,10 @@ class DeploymentStore:
 
 
 class ApiServer(HttpServerBase):
-    def __init__(self, root: str, host: str = "0.0.0.0", port: int = 7700):
+    """Unauthenticated control-plane API: binds loopback by default; put an
+    authenticating proxy in front before exposing it beyond the host."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 7700):
         super().__init__(host=host, port=port)
         self.store = DeploymentStore(root)
 
@@ -177,7 +180,8 @@ def main(argv=None) -> None:
 
     p = argparse.ArgumentParser("dynamo-api-server", description=__doc__)
     p.add_argument("--root", default="./dynamo-deployments")
-    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (no auth — keep loopback unless proxied)")
     p.add_argument("--port", type=int, default=7700)
     args = p.parse_args(argv)
 

@@ -105,30 +105,64 @@ class PythonEngine(AsyncEngine):
         req = request.data
         req_dict = req if isinstance(req, dict) else req.to_dict()
         n_tokens = 0
-        final_seen = False
-        async for item in self._mod.generate(req_dict):
-            out = _normalize(item, self.text_mode)
-            n_tokens += len(out.token_ids) or (1 if out.text else 0)
-            if out.is_final():
-                final_seen = True
-                out.prompt_tokens = out.prompt_tokens or len(req_dict.get("token_ids", []))
-                out.completion_tokens = out.completion_tokens or n_tokens
-            yield out
-            if final_seen:
-                return
-            if request.context.is_stopped():
-                yield LLMEngineOutput(
-                    finish_reason=FinishReason.CANCELLED,
-                    prompt_tokens=len(req_dict.get("token_ids", [])),
-                    completion_tokens=n_tokens,
+        agen = self._mod.generate(req_dict).__aiter__()
+        # Race each __anext__ against context.stopped() (same pattern as
+        # SubprocessEngine.generate) so cancellation interrupts a user
+        # generator that blocks between yields, instead of being observed
+        # only after the next item arrives.
+        try:
+            while True:
+                nxt = asyncio.ensure_future(agen.__anext__())
+                stopped = asyncio.ensure_future(request.context.stopped())
+                done, _ = await asyncio.wait(
+                    [nxt, stopped], return_when=asyncio.FIRST_COMPLETED
                 )
-                return
-        if not final_seen:  # generator ended without a finish marker
-            yield LLMEngineOutput(
-                finish_reason=FinishReason.STOP if self.text_mode else FinishReason.LENGTH,
-                prompt_tokens=len(req_dict.get("token_ids", [])),
-                completion_tokens=n_tokens,
-            )
+                if nxt not in done:
+                    nxt.cancel()
+                    try:
+                        await nxt
+                    except (asyncio.CancelledError, StopAsyncIteration):
+                        pass
+                    yield LLMEngineOutput(
+                        finish_reason=FinishReason.CANCELLED,
+                        prompt_tokens=len(req_dict.get("token_ids", [])),
+                        completion_tokens=n_tokens,
+                    )
+                    return
+                stopped.cancel()
+                try:
+                    item = nxt.result()
+                except StopAsyncIteration:
+                    break
+                out = _normalize(item, self.text_mode)
+                n_tokens += len(out.token_ids) or (1 if out.text else 0)
+                if out.is_final():
+                    out.prompt_tokens = out.prompt_tokens or len(
+                        req_dict.get("token_ids", [])
+                    )
+                    out.completion_tokens = out.completion_tokens or n_tokens
+                    yield out
+                    return
+                yield out
+                # a generator whose __anext__ resolves immediately would
+                # otherwise starve the race above — honor stop between yields
+                if request.context.is_stopped():
+                    yield LLMEngineOutput(
+                        finish_reason=FinishReason.CANCELLED,
+                        prompt_tokens=len(req_dict.get("token_ids", [])),
+                        completion_tokens=n_tokens,
+                    )
+                    return
+        finally:
+            aclose = getattr(agen, "aclose", None)
+            if aclose is not None:
+                await aclose()
+        # generator ended without a finish marker
+        yield LLMEngineOutput(
+            finish_reason=FinishReason.STOP if self.text_mode else FinishReason.LENGTH,
+            prompt_tokens=len(req_dict.get("token_ids", [])),
+            completion_tokens=n_tokens,
+        )
 
 
 def build_python_engine(

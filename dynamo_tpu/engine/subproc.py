@@ -52,6 +52,7 @@ class SubprocessEngine(AsyncEngine):
         self._ids = itertools.count(1)
         self._lock = asyncio.Lock()
         self._started = False
+        self._closing = False
         self._connected = asyncio.Event()
         self._server: Optional[asyncio.AbstractServer] = None
         self._sock_dir: Optional[tempfile.TemporaryDirectory] = None
@@ -96,6 +97,18 @@ class SubprocessEngine(AsyncEngine):
             q.put_nowait(_DONE)
         self._streams.clear()
         self._writer = None
+        if not self._closing:
+            # reset startup state so the next generate() respawns a fresh
+            # child instead of erroring forever while the worker keeps its
+            # lease and continues to attract routed traffic
+            self._started = False
+            self._connected = asyncio.Event()
+            if self._server is not None:
+                self._server.close()
+                self._server = None
+            if self._sock_dir is not None:
+                self._sock_dir.cleanup()
+                self._sock_dir = None
 
     async def _read_loop(self, reader) -> None:
         while True:
@@ -127,6 +140,7 @@ class SubprocessEngine(AsyncEngine):
             await write_frame(self._writer, TwoPartMessage.from_json(head, data))
 
     async def close(self) -> None:
+        self._closing = True
         if self._proc and self._proc.returncode is None:
             try:
                 await self._send({"op": "shutdown"})
@@ -209,9 +223,7 @@ async def _child_main(spec: str, sock_path: str) -> None:
         def stop_generating(self) -> None:
             self._stop.set()
 
-    async def run_request(rid: int, req_dict: dict) -> None:
-        ctx = _ChildContext()
-        tasks_ctx[rid] = ctx
+    async def run_request(rid: int, req_dict: dict, ctx: "_ChildContext") -> None:
         try:
             async for out in engine.generate(Context(req_dict, context=ctx)):
                 await send({"op": "item", "id": rid},
@@ -232,8 +244,12 @@ async def _child_main(spec: str, sock_path: str) -> None:
         head = frame.header_json() or {}
         op, rid = head.get("op"), head.get("id")
         if op == "generate":
+            # register the context synchronously so a 'stop' frame arriving
+            # before the task's first tick still lands
+            ctx = _ChildContext()
+            tasks_ctx[rid] = ctx
             tasks[rid] = asyncio.get_running_loop().create_task(
-                run_request(rid, json.loads(frame.data))
+                run_request(rid, json.loads(frame.data), ctx)
             )
         elif op == "stop" and rid in tasks_ctx:
             tasks_ctx[rid].stop_generating()

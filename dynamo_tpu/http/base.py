@@ -34,6 +34,10 @@ class HttpError(Exception):
 
 
 class HttpServerBase:
+    #: reject request bodies larger than this (anti memory-exhaustion: the
+    #: body is buffered in full before routing)
+    max_body_bytes: int = 64 * 1024 * 1024
+
     def __init__(self, host: str = "0.0.0.0", port: int = 8080):
         self._host, self._port = host, port
         self._server: Optional[asyncio.base_events.Server] = None
@@ -106,8 +110,7 @@ class HttpServerBase:
             except Exception:
                 pass
 
-    @staticmethod
-    async def _read_request(reader: asyncio.StreamReader):
+    async def _read_request(self, reader: asyncio.StreamReader):
         try:
             request_line = await reader.readline()
         except (ConnectionResetError, asyncio.LimitOverrunError):
@@ -127,10 +130,13 @@ class HttpServerBase:
             headers[name.strip().lower()] = value.strip()
         body = b""
         length = int(headers.get("content-length", 0) or 0)
+        if length > self.max_body_bytes:
+            raise ValueError(f"body {length} exceeds limit {self.max_body_bytes}")
         if length:
             body = await reader.readexactly(length)
         elif headers.get("transfer-encoding", "").lower() == "chunked":
             chunks = []
+            total = 0
             while True:
                 size_line = await reader.readline()
                 # RFC 7230: ignore chunk extensions after ';'
@@ -138,6 +144,9 @@ class HttpServerBase:
                 if size == 0:
                     await reader.readline()
                     break
+                total += size
+                if total > self.max_body_bytes:
+                    raise ValueError(f"chunked body exceeds limit {self.max_body_bytes}")
                 chunks.append(await reader.readexactly(size))
                 await reader.readline()
             body = b"".join(chunks)

@@ -84,6 +84,7 @@ class DistributedRuntime:
         self.primary_lease_id: int = 0
         self._lease_keeper: Optional[LeaseKeeper] = None
         self._started = False
+        self._shut_down = False
         self._hub_conn = None  # hub connection owned by this runtime, if any
 
     @classmethod
@@ -167,13 +168,28 @@ class DistributedRuntime:
                     await srv.start()
                     return srv
 
-                self._tcp_starting = asyncio.ensure_future(_start())
-            try:
-                self._tcp_server = await asyncio.shield(self._tcp_starting)
-            finally:
-                # success or failure, drop the in-flight future so a
-                # transient start error isn't replayed forever
-                self._tcp_starting = None
+                fut = asyncio.ensure_future(_start())
+                self._tcp_starting = fut
+
+                def _done(f: asyncio.Future) -> None:
+                    # Publish the server even if every awaiter was cancelled
+                    # mid-shield — otherwise the shielded start completes
+                    # unobserved, a later caller starts a second server, and
+                    # the first listening socket leaks. On failure, drop the
+                    # future so a transient error isn't replayed forever.
+                    if self._tcp_starting is f:
+                        self._tcp_starting = None
+                    if not f.cancelled() and f.exception() is None:
+                        if self._tcp_server is None and not self._shut_down:
+                            self._tcp_server = f.result()
+                        else:  # racing second start / post-shutdown orphan
+                            srv = f.result()
+                            asyncio.ensure_future(srv.close())
+
+                fut.add_done_callback(_done)
+            starting = self._tcp_starting
+            await asyncio.shield(starting)
+            self._tcp_server = starting.result()
         return self._tcp_server
 
     def namespace(self, name: str):
@@ -182,6 +198,9 @@ class DistributedRuntime:
         return Namespace(self, name)
 
     async def shutdown(self) -> None:
+        self._shut_down = True
+        if self._tcp_starting is not None:
+            self._tcp_starting.cancel()
         self.runtime.shutdown()
         if self._lease_keeper:
             await self._lease_keeper.stop(revoke=True)
