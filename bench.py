@@ -137,8 +137,14 @@ def main() -> None:
     # HBM roofline: each decode step streams all weights once
     hbm_bw = 50e9 if on_cpu else 819e9  # v5e ~819 GB/s
     roofline = hbm_bw / param_bytes * B
+    # A CPU run is a tiny-model smoke test — label it so a busy-TPU
+    # fallback can't masquerade as a real llama-1B/TPU datapoint
+    metric = (
+        "decode_tokens_per_sec_cpu_smoke_tiny" if on_cpu
+        else "decode_tokens_per_sec_per_chip_llama1b_bf16_b16"
+    )
     result = {
-        "metric": "decode_tokens_per_sec_per_chip_llama1b_bf16_b16",
+        "metric": metric,
         "value": round(toks_per_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(toks_per_s / roofline, 4),

@@ -120,19 +120,34 @@ class JaxEngine(AsyncEngine):
         cfg: EngineConfig,
         params: Optional[dict] = None,
         seed: int = 0,
+        mirror=None,
     ):
         self.cfg = cfg
-        self.mesh = make_mesh(cfg.mesh) if cfg.mesh else None
         mcfg = cfg.model
+        # multi-host: a StepMirror (parallel/multihost.py) makes this engine
+        # the leader of a process-spanning mesh — every device dispatch is
+        # broadcast to follower ranks which replay the identical jit call
+        self.mirror = mirror
+        if mirror is not None:
+            if cfg.host_cache_blocks > 0:
+                raise ValueError("host offload tier is single-host only")
+            self.mesh = mirror.mesh
+        else:
+            self.mesh = make_mesh(cfg.mesh) if cfg.mesh else None
         if params is None:
             params = llama.init_params(mcfg, jax.random.key(seed))
-        if self.mesh is not None:
+        if mirror is not None:
+            params = mirror.shard_params(params)
+        elif self.mesh is not None:
             params = shard_params(params, self.mesh)
         self.params = params
-        k, v = llama.init_kv_cache(mcfg, cfg.num_blocks, cfg.block_size)
-        if self.mesh is not None:
-            sh = cache_sharding(self.mesh, mcfg)
-            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        if mirror is not None:
+            k, v = mirror.init_cache(cfg.num_blocks, cfg.block_size)
+        else:
+            k, v = llama.init_kv_cache(mcfg, cfg.num_blocks, cfg.block_size)
+            if self.mesh is not None:
+                sh = cache_sharding(self.mesh, mcfg)
+                k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
         self.offload: Optional[OffloadManager] = None
@@ -192,6 +207,14 @@ class JaxEngine(AsyncEngine):
         if self._loop_task:
             self._loop_task.cancel()
             self._loop_task = None
+        if self.mirror is not None:
+            # release follower ranks blocked on the next broadcast; take the
+            # device lock so the halt can't interleave with a decode/prefill
+            # broadcast still running in an executor thread
+            async with self._device_lock:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.mirror.lead_halt
+                )
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
         self.start()
@@ -457,6 +480,12 @@ class JaxEngine(AsyncEngine):
         T = _bucket(len(chunk))
         toks = np.zeros(T, np.int32)
         toks[: len(chunk)] = chunk
+        if self.mirror is not None:
+            logits, self.k_cache, self.v_cache = self.mirror.lead_prefill(
+                self.params, toks, self._table_for(seq), pos, len(chunk),
+                self.k_cache, self.v_cache,
+            )
+            return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0
         logits, self.k_cache, self.v_cache = llama.prefill(
             self.params,
@@ -499,6 +528,11 @@ class JaxEngine(AsyncEngine):
         temp = so.temperature if so.temperature is not None else 1.0
         if getattr(seq.request, "greedy", False):
             temp = 0.0
+        if self.mirror is not None:
+            return self.mirror.lead_sample1(
+                logits, (so.seed or 0) & 0x7FFFFFFF, seq.generated, temp,
+                so.top_k or 0, so.top_p if so.top_p is not None else 1.0,
+            )
         keys = make_keys(
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
@@ -576,6 +610,14 @@ class JaxEngine(AsyncEngine):
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
+        if self.mirror is not None:
+            toks, self.k_cache, self.v_cache = self.mirror.lead_decode(
+                self.params, self._last_tokens, positions,
+                self._block_tables, self._seq_lens, self._seeds, steps,
+                self._temps, self._top_ks, self._top_ps,
+                self.k_cache, self.v_cache,
+            )
+            return toks
         logits, self.k_cache, self.v_cache = llama.decode_step(
             self.params,
             cfg.model,
@@ -680,6 +722,11 @@ class JaxEngine(AsyncEngine):
         prompt's KV blocks after ``skip_blocks`` (the decode side's
         prefix hit). Blocks are committed to the reuse pool before being
         freed, so repeated prefixes stay warm on the prefill worker."""
+        if self.mirror is not None:
+            raise RuntimeError(
+                "disaggregated KV extract is single-host only: the host "
+                "gather would read a multi-process sharded cache"
+            )
         prompt = list(req.token_ids)
         seq = _Sequence(
             request=req,
@@ -727,6 +774,11 @@ class JaxEngine(AsyncEngine):
         allocates decode blocks up front and ships their ids in
         RemotePrefillRequest). Returns None when the pool can't cover the
         request — caller falls back to local serving's backpressure."""
+        if self.mirror is not None:
+            raise RuntimeError(
+                "disaggregated decode is single-host only: remote-KV "
+                "scatter cannot write a multi-process sharded cache"
+            )
         req: PreprocessedRequest = request.data
         if isinstance(req, dict):
             req = PreprocessedRequest.from_dict(req)

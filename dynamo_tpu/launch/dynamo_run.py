@@ -101,7 +101,27 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
     return cfg, params, tokenizer, name
 
 
-def build_core_engine(args, cfg: ModelConfig, params) -> AsyncEngine:
+def mesh_config(args):
+    """MeshConfig from the parallelism flags, or None when trivial."""
+    from ..parallel.mesh import MeshConfig
+
+    mc = MeshConfig(dp=args.dp, pp=args.pp, ep=args.ep, tp=args.tp)
+    return mc if mc.num_devices > 1 else None
+
+
+def engine_config(args, cfg: ModelConfig) -> EngineConfig:
+    return EngineConfig(
+        model=cfg,
+        num_blocks=args.num_blocks,
+        block_size=args.block_size,
+        max_batch_size=args.max_batch,
+        max_context=args.max_context or 0,
+        mesh=mesh_config(args),
+        host_cache_blocks=args.host_cache_blocks,
+    )
+
+
+def build_core_engine(args, cfg: ModelConfig, params, mirror=None) -> AsyncEngine:
     if args.out == "echo":
         return EchoEngine()
     if args.out.startswith(("pystr:", "pytok:")):
@@ -115,18 +135,7 @@ def build_core_engine(args, cfg: ModelConfig, params) -> AsyncEngine:
         engine.text_mode = text_mode
         return engine
     if args.out == "jax":
-        from ..parallel.mesh import MeshConfig
-
-        ecfg = EngineConfig(
-            model=cfg,
-            num_blocks=args.num_blocks,
-            block_size=args.block_size,
-            max_batch_size=args.max_batch,
-            max_context=args.max_context or 0,
-            mesh=MeshConfig(tp=args.tp) if args.tp > 1 else None,
-            host_cache_blocks=args.host_cache_blocks,
-        )
-        return JaxEngine(ecfg, params=params)
+        return JaxEngine(engine_config(args, cfg), params=params, mirror=mirror)
     raise SystemExit(f"unknown out= engine {args.out!r}")
 
 
@@ -178,13 +187,45 @@ async def run_http(args) -> None:
 
 
 async def run_endpoint(args) -> None:
-    """Worker mode: serve the engine at dyn://ns.comp.ep (ref input/endpoint.rs)."""
+    """Worker mode: serve the engine at dyn://ns.comp.ep (ref input/endpoint.rs).
+
+    Multi-node (``--num-nodes N --node-rank R --coordinator host:port``,
+    ref flags.rs:59-92 + MultiNodeConfig engines.rs:35-52): every rank
+    joins the JAX multi-controller runtime; rank 0 becomes the leader
+    (scheduler + hub endpoint + lease) with a StepMirror over the global
+    mesh, ranks 1.. run the follower loop (pure SPMD compute, no control
+    plane)."""
+    from ..parallel import multihost
+
     target = args.in_.removeprefix("dyn://")
     ns, comp, ep = target.split(".")
+    mh = multihost.MultiHostConfig(
+        num_nodes=args.num_nodes, node_rank=args.node_rank,
+        coordinator=args.coordinator,
+    )
+    mirror = None
+    if mh.enabled:
+        assert args.out == "jax", "--num-nodes > 1 requires out=jax"
+        assert args.disagg is None, (
+            "--disagg is single-host only (remote-KV scatter/gather cannot "
+            "touch a multi-process sharded cache)"
+        )
+        multihost.initialize(mh)
+        mcfg_mesh = mesh_config(args)
+        assert mcfg_mesh is not None, (
+            "--num-nodes > 1 needs explicit mesh axes (--dp/--pp/--ep/--tp) "
+            "whose product equals the global device count"
+        )
+        if not mh.is_leader:
+            cfg, params, _tokenizer, _name = build_model(args)
+            multihost.run_follower(engine_config(args, cfg), params=params)
+            return
     # build the engine (slow: weight loading, jit warmup) BEFORE taking a
     # lease, so control-plane keepalives aren't starved during init
     cfg, params, tokenizer, name = build_model(args)
-    core = build_core_engine(args, cfg, params)
+    if mh.enabled:
+        mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
+    core = build_core_engine(args, cfg, params, mirror=mirror)
     drt = await connect_runtime(args)
     jax_core = core if isinstance(core, JaxEngine) else None
     if args.disagg == "decode":
@@ -397,6 +438,17 @@ def main(argv=None) -> None:
     p.add_argument("--max-tokens", type=int, default=128)
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
+    p.add_argument("--pp", type=int, default=1, help="pipeline mesh axis")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel mesh axis")
+    # multi-node bootstrap (ref MultiNodeConfig engines.rs:35-52 +
+    # --num-nodes/--node-rank/--leader-addr flags.rs:59-92)
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="total processes in the multi-host mesh")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this process's rank (0 = leader)")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of rank 0's jax.distributed coordinator")
     p.add_argument("--router", default="round_robin",
                    choices=["round_robin", "random", "kv"])
     p.add_argument("--num-blocks", type=int, default=512)

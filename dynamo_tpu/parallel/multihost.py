@@ -1,0 +1,350 @@
+"""Multi-host bootstrap + single-controller SPMD step mirroring.
+
+The reference spans nodes with engine-specific bootstrap — Ray for vLLM
+(`lib/llm/src/engines/vllm/ray.rs`), one-process-per-rank for SGLang
+(`engines/sglang.rs:59-76`), MPI for TRT-LLM — configured by
+`MultiNodeConfig{num_nodes, node_rank, leader_addr}`
+(`lib/llm/src/engines.rs:35-52`) and the `--num-nodes/--node-rank/
+--leader-addr` flags (`launch/dynamo-run/src/flags.rs:59-92`).
+
+The TPU-native equivalent is JAX's multi-controller runtime:
+
+  * :func:`initialize` — `jax.distributed.initialize(coordinator,
+    num_processes, process_id)`; after it, `jax.devices()` is the GLOBAL
+    device list across all hosts and collectives ride ICI within a slice /
+    DCN (gloo on CPU) across.
+  * :func:`global_mesh` — a `jax.sharding.Mesh` over the global devices,
+    ordered process-major so the leading mesh axes span hosts.
+  * :class:`StepMirror` — serving is request-driven, but SPMD requires
+    every process to enter every compiled program in lockstep. The leader
+    (process 0) owns the scheduler (continuous batching, block allocation,
+    admission) and, per device dispatch, broadcasts a tiny step descriptor
+    + host inputs to the followers, which replay the identical jit call —
+    single-controller scheduling, SPMD execution. Leases/HTTP/discovery
+    live only on the leader; followers are pure compute ranks.
+
+Wire protocol per dispatch (two `broadcast_one_to_all` rounds — the first
+a fixed-size JSON header naming the op + array shapes/dtypes, the second
+the host input arrays themselves):
+
+    leader: lead(op, arrays)  ->  followers: op, arrays = follow()
+
+Both sides then call the same fused jit (decode+sample / prefill /
+sample1) on identically-sharded global arrays. Sampled tokens come back
+with replicated out_shardings so the leader can `device_get` them.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HDR_BYTES = 4096
+
+
+@dataclass
+class MultiHostConfig:
+    """Mirrors the reference MultiNodeConfig (engines.rs:35-52)."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    coordinator: Optional[str] = None  # host:port of node 0 (leader_addr)
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_nodes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+def initialize(cfg: MultiHostConfig) -> None:
+    """Join the multi-controller runtime. Call BEFORE any jax device init
+    (backend creation binds the process to its local devices only)."""
+    if not cfg.enabled:
+        return
+    if cfg.coordinator is None:
+        raise ValueError("--coordinator host:port is required with --num-nodes > 1")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank,
+    )
+    logger.info(
+        "joined multihost runtime: process %d/%d, %d local / %d global devices",
+        cfg.node_rank, cfg.num_nodes,
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def mesh_devices() -> list:
+    """Global devices ordered process-major (leading mesh axes span hosts,
+    trailing axes stay within a host — tp rides ICI, dp/pp span DCN)."""
+    import jax
+
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def global_mesh(mesh_cfg):
+    """Mesh over the global (all-hosts) device list."""
+    from .mesh import make_mesh
+
+    return make_mesh(mesh_cfg, devices=mesh_devices())
+
+
+# ---------------- step mirroring ----------------
+
+
+class StepMirror:
+    """Leader/follower lockstep dispatch over a global mesh.
+
+    One instance per engine (leader) or follower loop. All methods ending
+    in ``lead_*`` run on the leader; :meth:`follow` runs on followers.
+    The fused jits are shared by both sides so the compiled programs (and
+    their collectives) are identical.
+    """
+
+    def __init__(self, mesh, model_cfg):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .mesh import cache_sharding
+
+        self.mesh = mesh
+        self.model_cfg = model_cfg
+        self.is_leader = jax.process_index() == 0
+        self._rep = NamedSharding(mesh, P())
+        self._cache_sh = cache_sharding(mesh, model_cfg)
+        self._fns = {}
+
+    # ---- array placement ----
+
+    def to_global(self, host_array: np.ndarray):
+        """Replicated global array from an identical-everywhere host value."""
+        import jax
+
+        return jax.device_put(np.asarray(host_array), self._rep)
+
+    def init_cache(self, num_blocks: int, block_size: int, dtype=None):
+        """KV cache created directly with its global sharding (no host
+        roundtrip; every process materializes only its shards)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        cfg = self.model_cfg
+        shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size,
+                 cfg.head_dim)
+        dt = dtype or llama._dtype(cfg)
+        make = jax.jit(
+            lambda: (jnp.zeros(shape, dt), jnp.zeros(shape, dt)),
+            out_shardings=(self._cache_sh, self._cache_sh),
+        )
+        return make()
+
+    def shard_params(self, params: dict) -> dict:
+        """Place identically-initialized host params onto the global mesh
+        (device_put with a multi-process sharding assumes every rank passes
+        the same host value — guaranteed by same-seed init / same checkpoint)."""
+        from .mesh import shard_params
+
+        return shard_params(params, self.mesh)
+
+    # ---- fused step programs (shared leader/follower) ----
+
+    def _decode_fn(self):
+        if "decode" not in self._fns:
+            import jax
+
+            from ..models import llama
+            from ..ops.sampling import make_keys, sample_tokens
+
+            cfg = self.model_cfg
+
+            def step(params, tokens, positions, tables, seq_lens, seeds,
+                     steps, temps, top_ks, top_ps, k_cache, v_cache):
+                logits, k_cache, v_cache = llama.decode_step.__wrapped__(
+                    params, cfg, tokens, positions, tables, seq_lens,
+                    k_cache, v_cache,
+                )
+                keys = make_keys(seeds, steps)
+                toks = sample_tokens(logits, keys, temps, top_ks, top_ps)
+                return toks, k_cache, v_cache
+
+            self._fns["decode"] = jax.jit(
+                step,
+                donate_argnums=(10, 11),
+                out_shardings=(self._rep, self._cache_sh, self._cache_sh),
+            )
+        return self._fns["decode"]
+
+    def _prefill_fn(self):
+        if "prefill" not in self._fns:
+            import jax
+
+            from ..models import llama
+
+            cfg = self.model_cfg
+
+            def step(params, toks, table, pos, valid, k_cache, v_cache):
+                return llama.prefill.__wrapped__(
+                    params, cfg, toks, table, pos, valid, k_cache, v_cache
+                )
+
+            self._fns["prefill"] = jax.jit(
+                step,
+                donate_argnums=(5, 6),
+                out_shardings=(self._rep, self._cache_sh, self._cache_sh),
+            )
+        return self._fns["prefill"]
+
+    def _sample1_fn(self):
+        if "sample1" not in self._fns:
+            import jax
+
+            from ..ops.sampling import make_keys, sample_tokens
+
+            def step(logits, seed, step_no, temp, top_k, top_p):
+                keys = make_keys(seed, step_no)
+                return sample_tokens(logits[None, :], keys, temp, top_k, top_p)
+
+            self._fns["sample1"] = jax.jit(step, out_shardings=self._rep)
+        return self._fns["sample1"]
+
+    # ---- broadcast plumbing ----
+
+    def _bcast_header(self, obj: Optional[dict]) -> dict:
+        from jax.experimental import multihost_utils
+
+        buf = np.zeros(_HDR_BYTES, np.uint8)
+        if self.is_leader:
+            data = json.dumps(obj).encode()
+            if len(data) > _HDR_BYTES:
+                raise ValueError(f"step header {len(data)}B exceeds {_HDR_BYTES}")
+            buf[: len(data)] = np.frombuffer(data, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return json.loads(bytes(out).rstrip(b"\0").decode())
+
+    def _bcast_arrays(self, arrays: tuple) -> tuple:
+        from jax.experimental import multihost_utils
+
+        return tuple(
+            np.asarray(a) for a in multihost_utils.broadcast_one_to_all(arrays)
+        )
+
+    def _lead(self, op: str, arrays: tuple[np.ndarray, ...]) -> None:
+        """Leader: announce an op + ship its host inputs to followers."""
+        arrays = tuple(np.asarray(a) for a in arrays)
+        self._bcast_header(
+            {
+                "op": op,
+                "shapes": [list(a.shape) for a in arrays],
+                "dtypes": [a.dtype.str for a in arrays],
+            }
+        )
+        self._bcast_arrays(arrays)
+
+    def follow(self) -> tuple[str, tuple[np.ndarray, ...]]:
+        """Follower: receive the next (op, host inputs)."""
+        head = self._bcast_header(None)
+        zeros = tuple(
+            np.zeros(s, np.dtype(d))
+            for s, d in zip(head["shapes"], head["dtypes"])
+        )
+        return head["op"], self._bcast_arrays(zeros)
+
+    # ---- leader-side dispatch (called from JaxEngine) ----
+
+    def lead_decode(self, params, last_tokens, positions, tables, seq_lens,
+                    seeds, steps, temps, top_ks, top_ps, k_cache, v_cache):
+        import jax
+
+        self._lead("decode", (last_tokens, positions, tables, seq_lens,
+                              seeds, steps, temps, top_ks, top_ps))
+        g = self.to_global
+        toks, k_cache, v_cache = self._decode_fn()(
+            params, g(last_tokens), g(positions), g(tables), g(seq_lens),
+            g(seeds), g(steps), g(temps), g(top_ks), g(top_ps),
+            k_cache, v_cache,
+        )
+        return np.asarray(jax.device_get(toks)), k_cache, v_cache
+
+    def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache):
+        self._lead(
+            "prefill",
+            (toks, np.asarray(table),
+             np.asarray(pos, np.int32), np.asarray(valid, np.int32)),
+        )
+        g = self.to_global
+        return self._prefill_fn()(
+            params, g(toks), g(np.asarray(table)),
+            g(np.asarray(pos, np.int32)), g(np.asarray(valid, np.int32)),
+            k_cache, v_cache,
+        )
+
+    def lead_sample1(self, logits, seed, step_no, temp, top_k, top_p) -> int:
+        import jax
+
+        scalars = (
+            np.asarray([seed], np.int32), np.asarray([step_no], np.int32),
+            np.asarray([temp], np.float32), np.asarray([top_k], np.int32),
+            np.asarray([top_p], np.float32),
+        )
+        self._lead("sample1", scalars)
+        g = self.to_global
+        tok = self._sample1_fn()(logits, *(g(s) for s in scalars))
+        return int(np.asarray(jax.device_get(tok))[0])
+
+    def lead_halt(self) -> None:
+        self._lead("halt", ())
+
+
+def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> None:
+    """Follower main loop: replay the leader's device dispatches forever
+    (until a ``halt`` op). ``engine_cfg`` is the same EngineConfig the
+    leader's JaxEngine was built with; params must be initialized the same
+    way on every rank (same seed, or same checkpoint path)."""
+    import jax
+
+    from ..models import llama
+
+    mcfg = engine_cfg.model
+    mesh = global_mesh(engine_cfg.mesh)
+    mirror = StepMirror(mesh, mcfg)
+    if params is None:
+        params = llama.init_params(mcfg, jax.random.key(seed))
+    params = mirror.shard_params(params)
+    k_cache, v_cache = mirror.init_cache(
+        engine_cfg.num_blocks, engine_cfg.block_size
+    )
+    logits = None
+    logger.info("follower %d ready", jax.process_index())
+    while True:
+        op, arrays = mirror.follow()
+        g = mirror.to_global
+        if op == "halt":
+            logger.info("follower %d halting", jax.process_index())
+            return
+        if op == "decode":
+            _toks, k_cache, v_cache = mirror._decode_fn()(
+                params, *(g(a) for a in arrays), k_cache, v_cache
+            )
+        elif op == "prefill":
+            logits, k_cache, v_cache = mirror._prefill_fn()(
+                params, *(g(a) for a in arrays), k_cache, v_cache
+            )
+        elif op == "sample1":
+            mirror._sample1_fn()(logits, *(g(a) for a in arrays))
+        else:
+            raise RuntimeError(f"unknown mirrored op {op!r}")

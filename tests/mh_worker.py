@@ -1,0 +1,88 @@
+"""Subprocess entry for the multi-host bootstrap test.
+
+Two OS processes (ranks 0/1) join a jax.distributed CPU runtime with 2
+virtual devices each, forming a dp=2 x tp=2 global mesh spanning both
+processes. Rank 0 runs the JaxEngine leader and serves it through the hub
+at dyn://mh.worker.generate; rank 1 runs the SPMD follower loop. Rank 0
+exits (broadcasting halt) after serving one request.
+
+Usage: python tests/mh_worker.py <rank> <coordinator-port> <hub-addr>
+"""
+
+import os
+import sys
+
+RANK = int(sys.argv[1])
+COORD_PORT = sys.argv[2]
+HUB = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import asyncio  # noqa: E402
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.parallel import multihost  # noqa: E402
+from dynamo_tpu.parallel.mesh import MeshConfig  # noqa: E402
+from dynamo_tpu.runtime import DistributedRuntime  # noqa: E402
+from dynamo_tpu.runtime.hub import connect_hub  # noqa: E402
+
+
+def engine_cfg() -> EngineConfig:
+    return EngineConfig(
+        model=ModelConfig.tiny(),
+        num_blocks=32,
+        block_size=16,
+        max_batch_size=4,
+        mesh=MeshConfig(dp=2, tp=2),
+    )
+
+
+async def leader() -> None:
+    cfg = engine_cfg()
+    mirror = multihost.StepMirror(multihost.global_mesh(cfg.mesh), cfg.model)
+    engine = JaxEngine(cfg, mirror=mirror)
+    store, bus, conn = await connect_hub(HUB)
+    drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+
+    served = asyncio.Event()
+
+    class OneShot:
+        async def generate(self, request):
+            async for item in engine.generate(request):
+                yield item
+            served.set()
+
+    await drt.namespace("mh").component("worker").endpoint("generate").serve(
+        OneShot()
+    )
+    print("leader serving", flush=True)
+    await asyncio.wait_for(served.wait(), 120)
+    await asyncio.sleep(0.2)  # let the response stream flush
+    await engine.close()  # broadcasts halt to the follower
+    await drt.shutdown()
+    await conn.close()
+    print("leader done", flush=True)
+
+
+def main() -> None:
+    multihost.initialize(
+        multihost.MultiHostConfig(
+            num_nodes=2, node_rank=RANK, coordinator=f"127.0.0.1:{COORD_PORT}"
+        )
+    )
+    assert jax.device_count() == 4, jax.device_count()
+    if RANK == 0:
+        asyncio.run(leader())
+    else:
+        multihost.run_follower(engine_cfg())
+        print("follower done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Multi-host bootstrap: 2 OS processes, one global mesh, served via hub.
+
+The TPU answer to the reference's multi-node engine bootstrap (Ray/MPI/
+per-rank launch, engines.rs:35-52): rank 0 leads scheduling and serves the
+endpoint, rank 1 followers the SPMD dispatches, the mesh (dp=2 x tp=2)
+spans both processes, and the parent (this test) plays the frontend role
+through the hub.
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime, collect
+from dynamo_tpu.runtime.hub import HubServer, connect_hub
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, coord_port: int, hub: str) -> subprocess.Popen:
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)  # the worker sets its own device count
+    env["PYTHONPATH"] = REPO
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
+         str(rank), str(coord_port), hub],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_two_process_mesh_serves_through_hub(run):
+    async def main():
+        hub = HubServer()
+        await hub.start()
+        coord = _free_port()
+        procs = [_spawn(r, coord, hub.address) for r in (0, 1)]
+        try:
+            store, bus, conn = await connect_hub(hub.address)
+            front = await DistributedRuntime.from_settings(store=store, bus=bus)
+            client = await (
+                front.namespace("mh").component("worker").endpoint("generate")
+                .client().start()
+            )
+            await client.wait_for_instances(timeout=120)
+
+            req = {
+                "token_ids": [5, 6, 7, 8],
+                "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+                "sampling_options": {"temperature": 0.0},
+            }
+            out = await asyncio.wait_for(
+                collect(await client.round_robin(Context(req))), 120
+            )
+            datas = [a.data for a in out if a.data]
+            tokens = [t for d in datas for t in d.get("token_ids", [])]
+            assert len(tokens) == 4, datas
+            assert datas[-1].get("finish_reason") == "length", datas[-1]
+
+            await front.shutdown()
+            await conn.close()
+            # both ranks must exit cleanly: leader after serving + halt
+            # broadcast, follower on receiving halt. The wait must not
+            # block this event loop — the hub (serving the leader's
+            # shutdown traffic) lives on it.
+            import functools
+
+            loop = asyncio.get_running_loop()
+            for p in procs:
+                out_text = (
+                    await loop.run_in_executor(
+                        None, functools.partial(p.communicate, timeout=150)
+                    )
+                )[0]
+                assert p.returncode == 0, f"rank exited {p.returncode}:\n{out_text}"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+            await hub.close()
+
+    run(main())
