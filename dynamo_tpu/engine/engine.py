@@ -65,6 +65,12 @@ class EngineConfig:
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
+    # max fused decode steps per device dispatch (lax.scan window): the
+    # sampled token of step i feeds step i+1 on device, so the host syncs
+    # once per window, not once per token. The scheduler drops to 1-step
+    # windows whenever admission work is pending (fairness) and clamps to
+    # each sequence's stop/context headroom. Power of two.
+    decode_window: int = 4
     # kv-head ordering of this engine's cache. The native JAX engine
     # stores heads in natural (blocked) order — only "blocked" is valid
     # here; foreign-ordered peers declare their layout on the KV wire
@@ -154,14 +160,16 @@ class JaxEngine(AsyncEngine):
         if cfg.host_cache_blocks > 0:
             self.offload = OffloadManager(cfg.host_cache_blocks)
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
-        # Pallas decode path: TPU backend, unsharded cache, aligned tiles
-        # (the sharded-mesh pallas path goes through shard_map — see
-        # parallel/; until then meshes use the XLA fallback).
+        # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
+        # run the kernel under shard_map over tp (head-parallel, no
+        # collectives) when tp divides the kv heads; otherwise the XLA
+        # fallback lets GSPMD handle the uneven split.
+        tp = self.mesh.shape["tp"] if self.mesh is not None else 1
         self.use_pallas = (
             jax.default_backend() == "tpu"
-            and self.mesh is None
             and cfg.model.head_dim % 128 == 0
             and cfg.block_size % 8 == 0
+            and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         self._prefill_state: Optional[_PrefillState] = None
@@ -193,6 +201,7 @@ class JaxEngine(AsyncEngine):
             "tokens_generated": 0,
             "prefix_cache_hits_tokens": 0,
             "decode_steps": 0,
+            "preemptions": 0,
         }
 
     # ---------------- public api ----------------
@@ -337,6 +346,23 @@ class JaxEngine(AsyncEngine):
                 )
                 continue
             if not ok:
+                # A sequence whose minimum reservation exceeds the whole
+                # pool can never admit (e.g. preempted late with a grown
+                # token list, or an oversized prompt) — finish it rather
+                # than head-of-line-block the queue forever.
+                bs = self.cfg.block_size
+                min_needed = min(
+                    (seq.seq_len + bs) // bs + 1, self.cfg.max_blocks_per_seq
+                )
+                if min_needed > self.allocator.num_blocks - 1:
+                    logger.warning(
+                        "request %s needs %d blocks but the pool holds %d — "
+                        "finishing as LENGTH",
+                        getattr(seq.context, "id", "?"), min_needed,
+                        self.allocator.num_blocks - 1,
+                    )
+                    self._finish(seq, FinishReason.LENGTH)
+                    continue
                 # out of KV blocks: put back and stop admitting (backpressure)
                 self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
                 break
@@ -563,23 +589,101 @@ class JaxEngine(AsyncEngine):
 
     # ---- decode ----
 
-    async def _decode_once(self) -> None:
-        cfg = self.cfg
-        # ensure every active sequence has a block for the incoming token
+    def _pick_window(self) -> int:
+        """Fused steps for the next dispatch: 1 while admission work is
+        pending (a long window would delay waiting requests), else the
+        largest power of two within every active sequence's remaining
+        stop/context headroom."""
+        if (
+            self._prefill_state is not None
+            or not self._waiting.empty()
+            or self._remote_ready
+            or self.cfg.decode_window <= 1
+        ):
+            return 1
+        headroom = self.cfg.decode_window
         for seq in self._active:
             if seq is None:
                 continue
+            headroom = min(headroom, self.cfg.max_context - seq.seq_len)
+            sc = seq.request.stop_conditions
+            if sc.max_tokens is not None:
+                headroom = min(headroom, sc.max_tokens - seq.generated)
+        n = 1
+        while n * 2 <= headroom and n * 2 <= self.cfg.decode_window:
+            n *= 2
+        return n
+
+    def _preempt(self, seq: _Sequence) -> None:
+        """Evict a running sequence under pool pressure (ref vllm patch
+        scheduler edits, patch:249-742: swap/recompute preemption). The
+        recompute flavor composes with the content-addressed reuse pool:
+        freed full blocks stay claimable by hash (and park in the host
+        offload tier on eviction), so re-admission re-claims the prefix
+        and only recomputes the uncommitted tail — never silent
+        truncation."""
+        if seq.slot >= 0:
+            self._active[seq.slot] = None
+            self._seq_lens[seq.slot] = 0
+            self._block_tables[seq.slot] = 0
+            self._n_active -= 1
+            seq.slot = -1
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        seq.committed = 0
+        seq.parent_hash = None
+        seq.cached_prefix = 0
+        # resume at the FRONT of the waiting queue: the whole token list
+        # (prompt + generated so far) re-admits as a prefill whose final
+        # sampled token simply continues the stream (PRNG steps continue
+        # from seq.generated, so sampling is replay-exact)
+        self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
+        self.stats["preemptions"] += 1
+        logger.info(
+            "preempted request %s at %d tokens (pool pressure)",
+            getattr(seq.context, "id", "?"), seq.seq_len,
+        )
+
+    def _youngest_active(self) -> Optional[_Sequence]:
+        cand = [s for s in self._active if s is not None and not s.finished]
+        return max(cand, key=lambda s: s.arrival_t) if cand else None
+
+    async def _decode_once(self) -> None:
+        cfg = self.cfg
+        n = self._pick_window()
+        # ensure every active sequence has blocks for the window's tokens
+        for seq in list(self._active):
+            if seq is None or seq.finished or seq.slot < 0:
+                continue  # may have been preempted earlier this pass
             if seq.context.is_stopped():
                 self._finish(seq, FinishReason.CANCELLED)
                 continue
-            needed = seq.seq_len + 1
-            if needed > len(seq.blocks) * cfg.block_size:
+            needed = seq.seq_len + n
+            while needed > len(seq.blocks) * cfg.block_size and seq.slot >= 0:
+                if len(seq.blocks) >= cfg.max_blocks_per_seq:
+                    self._finish(seq, FinishReason.LENGTH)  # true ctx limit
+                    break
                 extra = self.allocator.allocate(1)
-                if extra is None or len(seq.blocks) >= cfg.max_blocks_per_seq:
-                    self._finish(seq, FinishReason.LENGTH)
+                if extra is not None:
+                    seq.blocks.extend(extra)
+                    self._block_tables[seq.slot] = self._table_for(seq)
                     continue
-                seq.blocks.extend(extra)
-                self._block_tables[seq.slot] = self._table_for(seq)
+                # pool exhausted: preempt the youngest running sequence
+                # (possibly this one) instead of truncating output
+                victim = self._youngest_active()
+                if victim is seq or victim is None:
+                    if self._n_active <= 1:
+                        # nothing left to evict — the pool cannot hold even
+                        # one sequence at this length
+                        logger.warning(
+                            "KV pool too small for request %s at %d tokens",
+                            getattr(seq.context, "id", "?"), seq.seq_len,
+                        )
+                        self._finish(seq, FinishReason.LENGTH)
+                    else:
+                        self._preempt(seq)
+                    break
+                self._preempt(victim)
         if self._n_active == 0:
             return
 
@@ -591,21 +695,28 @@ class JaxEngine(AsyncEngine):
         )
         async with self._device_lock:
             toks_host = await asyncio.get_running_loop().run_in_executor(
-                None, self._decode_device, steps
+                None, self._decode_device, steps, n
             )
-        self.stats["decode_steps"] += 1
+        self.stats["decode_steps"] += n
+        # emit window tokens in step order; a sequence that hits a stop
+        # condition mid-window has its tail tokens discarded
+        for step_i in range(n):
+            for i in active_slots:
+                seq = self._active[i]
+                if seq is None or seq.finished:
+                    continue
+                self._emit_token(seq, int(toks_host[step_i, i]))
         for i in active_slots:
             seq = self._active[i]
-            if seq is None:
+            if seq is None or seq.finished:
                 continue
-            self._emit_token(seq, int(toks_host[i]))
-            if not seq.finished:
-                self._seq_lens[i] = seq.seq_len
-                self._last_tokens[i] = seq.tokens[-1]
-                self._commit_full_blocks(seq)
+            self._seq_lens[i] = seq.seq_len
+            self._last_tokens[i] = seq.tokens[-1]
+            self._commit_full_blocks(seq)
 
-    def _decode_device(self, steps: np.ndarray) -> np.ndarray:
-        """Runs in an executor thread: one decode step + sampling."""
+    def _decode_device(self, steps: np.ndarray, n: int) -> np.ndarray:
+        """Runs in an executor thread: one fused n-step decode+sample
+        window. Returns sampled tokens [n, B]."""
         cfg = self.cfg
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
@@ -616,26 +727,26 @@ class JaxEngine(AsyncEngine):
                 self._block_tables, self._seq_lens, self._seeds, steps,
                 self._temps, self._top_ks, self._top_ps,
                 self.k_cache, self.v_cache,
+                n_steps=n, use_pallas=self.use_pallas,
             )
             return toks
-        logits, self.k_cache, self.v_cache = llama.decode_step(
+        toks, self.k_cache, self.v_cache = llama.decode_window(
             self.params,
             cfg.model,
             jnp.asarray(self._last_tokens),
             jnp.asarray(positions),
             jnp.asarray(self._block_tables),
             jnp.asarray(self._seq_lens),
-            self.k_cache,
-            self.v_cache,
-            use_pallas=self.use_pallas,
-        )
-        keys = make_keys(jnp.asarray(self._seeds), jnp.asarray(steps))
-        toks = sample_tokens(
-            logits,
-            keys,
+            jnp.asarray(self._seeds),
+            jnp.asarray(steps),
             jnp.asarray(self._temps),
             jnp.asarray(self._top_ks),
             jnp.asarray(self._top_ps),
+            self.k_cache,
+            self.v_cache,
+            n_steps=n,
+            use_pallas=self.use_pallas,
+            mesh=self.mesh if (self.use_pallas and self.mesh is not None) else None,
         )
         return np.asarray(jax.device_get(toks))
 

@@ -256,23 +256,11 @@ def prefill(
 # ---------------- batched decode step ----------------
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "use_pallas"),
-    donate_argnames=("k_cache", "v_cache"),
-)
-def decode_step(
-    params: dict,
-    cfg: ModelConfig,
-    tokens: jnp.ndarray,  # [B] last sampled token per sequence
-    positions: jnp.ndarray,  # [B] absolute position of that token
-    block_tables: jnp.ndarray,  # [B, M]
-    seq_lens: jnp.ndarray,  # [B] length including the new token
-    k_cache: jnp.ndarray,  # donated
-    v_cache: jnp.ndarray,
-    use_pallas: bool = False,
+def _decode_body(
+    params, cfg, tokens, positions, block_tables, seq_lens,
+    k_cache, v_cache, use_pallas, mesh=None,
 ):
-    """One continuous-batching decode step for all active sequences."""
+    """Shared un-jitted decode forward (one token per sequence)."""
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     B = tokens.shape[0]
@@ -288,7 +276,8 @@ def decode_step(
         kc = att.write_decode_token_to_cache(kc, k, block_tables, positions)
         vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
         o = att.decode_attention(
-            q, kc, vc, block_tables, seq_lens, scale, use_pallas=use_pallas
+            q, kc, vc, block_tables, seq_lens, scale,
+            use_pallas=use_pallas, mesh=mesh,
         )
         x = x + o.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -299,6 +288,80 @@ def decode_step(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)  # [B, V]
     return logits, k_cache, v_cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_pallas", "mesh"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def decode_step(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] last sampled token per sequence
+    positions: jnp.ndarray,  # [B] absolute position of that token
+    block_tables: jnp.ndarray,  # [B, M]
+    seq_lens: jnp.ndarray,  # [B] length including the new token
+    k_cache: jnp.ndarray,  # donated
+    v_cache: jnp.ndarray,
+    use_pallas: bool = False,
+    mesh=None,
+):
+    """One continuous-batching decode step for all active sequences."""
+    return _decode_body(
+        params, cfg, tokens, positions, block_tables, seq_lens,
+        k_cache, v_cache, use_pallas, mesh,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def decode_window(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] last sampled token per sequence
+    positions: jnp.ndarray,  # [B]
+    block_tables: jnp.ndarray,  # [B, M]
+    seq_lens: jnp.ndarray,  # [B]
+    seeds: jnp.ndarray,  # [B] int32 sampling seeds
+    steps: jnp.ndarray,  # [B] int32 per-request generation counters
+    temps: jnp.ndarray,  # [B] float32
+    top_ks: jnp.ndarray,  # [B] int32
+    top_ps: jnp.ndarray,  # [B] float32
+    k_cache: jnp.ndarray,  # donated
+    v_cache: jnp.ndarray,
+    n_steps: int = 1,
+    use_pallas: bool = False,
+    mesh=None,
+):
+    """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
+    the sampled token of step i feeds step i+1 entirely on device, so the
+    host syncs once per window instead of once per token (SURVEY §7
+    "per-token latency floor"; VERDICT round-1 weak #4). Returns
+    (tokens [n_steps, B], k_cache, v_cache). The host discards any tail
+    tokens of sequences that hit a stop condition mid-window; callers must
+    pre-allocate KV blocks for ``n_steps`` new tokens per sequence."""
+    from ..ops.sampling import make_keys, sample_tokens
+
+    def body(carry, _):
+        tokens, positions, seq_lens, steps, k_cache, v_cache = carry
+        logits, k_cache, v_cache = _decode_body(
+            params, cfg, tokens, positions, block_tables, seq_lens,
+            k_cache, v_cache, use_pallas, mesh,
+        )
+        keys = make_keys(seeds, steps)
+        nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)
+        return (nxt, positions + 1, seq_lens + 1, steps + 1,
+                k_cache, v_cache), nxt
+
+    carry = (tokens, positions, seq_lens, steps, k_cache, v_cache)
+    (_, _, _, _, k_cache, v_cache), toks = lax.scan(
+        body, carry, None, length=n_steps
+    )
+    return toks, k_cache, v_cache
 
 
 # ---------------- reference dense forward (tests) ----------------

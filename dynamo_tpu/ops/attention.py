@@ -47,22 +47,72 @@ def decode_attention(
     seq_lens: jnp.ndarray,
     scale: float,
     use_pallas: bool = False,
+    mesh=None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
 
-    ``use_pallas`` must be trace-static (the engine derives it from
-    backend + sharding: the Pallas path requires unsharded cache arrays —
-    sharded meshes go through shard_map in parallel/).
+    ``use_pallas`` must be trace-static. With a ``mesh``, the kernel runs
+    under shard_map: each device gets its tp shard of the kv heads (cache
+    axis 0 / q axis 1) and runs the kernel on purely local tiles — paged
+    attention is head-parallel, so no collectives are needed. Callers
+    guarantee num_kv_heads % tp == 0 (the engine falls back to XLA
+    otherwise, where GSPMD handles uneven head splits).
     """
+    if use_pallas and mesh is not None:
+        return paged_decode_attention_sharded(
+            q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+            mesh, interpret=interpret,
+        )
     if use_pallas:
         from .paged_attention_pallas import paged_decode_attention
 
         return paged_decode_attention(
-            q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
+            q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+            interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
     )
+
+
+def paged_decode_attention_sharded(
+    q: jnp.ndarray,  # [B, H, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] replicated
+    seq_lens: jnp.ndarray,  # [B] replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas decode kernel under shard_map over the ``tp`` axis.
+
+    The kv-head axis is the cache's sharded axis (ops module docs), and
+    attention is embarrassingly parallel over kv-head groups — each device
+    runs the kernel on its local [Hkv/tp, ...] cache shard against its
+    local [B, H/tp, D] query shard. Other mesh axes (dp/pp/sp/ep)
+    replicate, matching the engine's replicated batch inputs.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from .paged_attention_pallas import paged_decode_attention
+
+    return jax.shard_map(
+        partial(paged_decode_attention, scale=scale, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q: heads sharded
+            P("tp", None, None, None),  # k cache: kv heads sharded
+            P("tp", None, None, None),  # v cache
+            P(),  # block tables replicated
+            P(),  # seq lens replicated
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, k_cache_layer, v_cache_layer, block_tables, seq_lens)
 
 
 def decode_attention_xla(

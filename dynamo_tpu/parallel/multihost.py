@@ -163,31 +163,30 @@ class StepMirror:
 
     # ---- fused step programs (shared leader/follower) ----
 
-    def _decode_fn(self):
-        if "decode" not in self._fns:
+    def _decode_fn(self, n_steps: int = 1, use_pallas: bool = False):
+        key = ("decode", n_steps, use_pallas)
+        if key not in self._fns:
             import jax
 
             from ..models import llama
-            from ..ops.sampling import make_keys, sample_tokens
 
             cfg = self.model_cfg
+            mesh = self.mesh if use_pallas else None
 
             def step(params, tokens, positions, tables, seq_lens, seeds,
                      steps, temps, top_ks, top_ps, k_cache, v_cache):
-                logits, k_cache, v_cache = llama.decode_step.__wrapped__(
+                return llama.decode_window.__wrapped__(
                     params, cfg, tokens, positions, tables, seq_lens,
-                    k_cache, v_cache,
+                    seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
+                    n_steps=n_steps, use_pallas=use_pallas, mesh=mesh,
                 )
-                keys = make_keys(seeds, steps)
-                toks = sample_tokens(logits, keys, temps, top_ks, top_ps)
-                return toks, k_cache, v_cache
 
-            self._fns["decode"] = jax.jit(
+            self._fns[key] = jax.jit(
                 step,
                 donate_argnums=(10, 11),
                 out_shardings=(self._rep, self._cache_sh, self._cache_sh),
             )
-        return self._fns["decode"]
+        return self._fns[key]
 
     def _prefill_fn(self):
         if "prefill" not in self._fns:
@@ -243,7 +242,7 @@ class StepMirror:
             np.asarray(a) for a in multihost_utils.broadcast_one_to_all(arrays)
         )
 
-    def _lead(self, op: str, arrays: tuple[np.ndarray, ...]) -> None:
+    def _lead(self, op: str, arrays: tuple[np.ndarray, ...], **extra) -> None:
         """Leader: announce an op + ship its host inputs to followers."""
         arrays = tuple(np.asarray(a) for a in arrays)
         self._bcast_header(
@@ -251,29 +250,32 @@ class StepMirror:
                 "op": op,
                 "shapes": [list(a.shape) for a in arrays],
                 "dtypes": [a.dtype.str for a in arrays],
+                **extra,
             }
         )
         self._bcast_arrays(arrays)
 
-    def follow(self) -> tuple[str, tuple[np.ndarray, ...]]:
-        """Follower: receive the next (op, host inputs)."""
+    def follow(self) -> tuple[dict, tuple[np.ndarray, ...]]:
+        """Follower: receive the next (header, host inputs)."""
         head = self._bcast_header(None)
         zeros = tuple(
             np.zeros(s, np.dtype(d))
             for s, d in zip(head["shapes"], head["dtypes"])
         )
-        return head["op"], self._bcast_arrays(zeros)
+        return head, self._bcast_arrays(zeros)
 
     # ---- leader-side dispatch (called from JaxEngine) ----
 
     def lead_decode(self, params, last_tokens, positions, tables, seq_lens,
-                    seeds, steps, temps, top_ks, top_ps, k_cache, v_cache):
+                    seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
+                    n_steps: int = 1, use_pallas: bool = False):
         import jax
 
         self._lead("decode", (last_tokens, positions, tables, seq_lens,
-                              seeds, steps, temps, top_ks, top_ps))
+                              seeds, steps, temps, top_ks, top_ps),
+                   n=n_steps, pallas=use_pallas)
         g = self.to_global
-        toks, k_cache, v_cache = self._decode_fn()(
+        toks, k_cache, v_cache = self._decode_fn(n_steps, use_pallas)(
             params, g(last_tokens), g(positions), g(tables), g(seq_lens),
             g(seeds), g(steps), g(temps), g(top_ks), g(top_ps),
             k_cache, v_cache,
@@ -331,13 +333,15 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
     logits = None
     logger.info("follower %d ready", jax.process_index())
     while True:
-        op, arrays = mirror.follow()
+        head, arrays = mirror.follow()
+        op = head["op"]
         g = mirror.to_global
         if op == "halt":
             logger.info("follower %d halting", jax.process_index())
             return
         if op == "decode":
-            _toks, k_cache, v_cache = mirror._decode_fn()(
+            fn = mirror._decode_fn(head.get("n", 1), head.get("pallas", False))
+            _toks, k_cache, v_cache = fn(
                 params, *(g(a) for a in arrays), k_cache, v_cache
             )
         elif op == "prefill":

@@ -112,6 +112,127 @@ def test_engine_greedy_deterministic(run, engine_cfg, shared_engine):
     run(main())
 
 
+def test_decode_window_matches_single_step(run, engine_cfg):
+    """Fused n-step decode windows must produce the exact token stream of
+    1-step dispatch (sampled and greedy): the scan feeds step i's token to
+    step i+1 on device with identical PRNG key derivation."""
+
+    async def main():
+        from dataclasses import replace
+
+        outs = {}
+        for window in (1, 4):
+            cfg = replace(engine_cfg, decode_window=window)
+            engine = JaxEngine(cfg, seed=0)
+            for name, req in (
+                ("greedy", make_req(range(10, 20), max_tokens=7)),
+                ("sampled", make_req(range(10, 20), max_tokens=7,
+                                     temperature=0.9, seed=123)),
+            ):
+                out = await collect(engine.generate(Context(req)))
+                outs[(window, name)] = [t for o in out for t in o.token_ids]
+                assert out[-1].finish_reason == FinishReason.LENGTH
+            await engine.close()
+        assert outs[(1, "greedy")] == outs[(4, "greedy")]
+        assert outs[(1, "sampled")] == outs[(4, "sampled")]
+
+    run(main())
+
+
+def test_decode_window_midwindow_eos(run, engine_cfg):
+    """A stop token sampled mid-window must end the stream there — the
+    window's tail tokens are discarded, not emitted."""
+
+    async def main():
+        from dataclasses import replace
+
+        # find what greedy generates, then declare its 2nd token a stop id
+        engine = JaxEngine(replace(engine_cfg, decode_window=1), seed=0)
+        out = await collect(engine.generate(Context(make_req(range(20, 30),
+                                                            max_tokens=6))))
+        toks = [t for o in out for t in o.token_ids]
+        await engine.close()
+
+        engine = JaxEngine(replace(engine_cfg, decode_window=4), seed=0)
+        req = make_req(range(20, 30), max_tokens=6,
+                       stop_token_ids=[toks[2]])
+        out = await collect(engine.generate(Context(req)))
+        got = [t for o in out for t in o.token_ids]
+        assert got == toks[:3]
+        assert out[-1].finish_reason == FinishReason.STOP
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
+
+
+def test_preemption_under_pool_pressure(run):
+    """Pool exhaustion mid-decode must preempt (evict + resume) instead of
+    truncating: every request completes its full max_tokens with exactly
+    the tokens an uncontended run produces (ref vllm patch scheduler
+    swap-preemption, patch:249-742)."""
+
+    async def main():
+        def cfg(blocks):
+            return EngineConfig(
+                model=ModelConfig.tiny(), num_blocks=blocks, block_size=4,
+                max_batch_size=4, max_context=128, prefill_chunk=32,
+            )
+
+        prompts = [list(range(10 + 7 * i, 22 + 7 * i)) for i in range(3)]
+
+        # ground truth: roomy pool, sequential (no contention)
+        ref_engine = JaxEngine(cfg(64), seed=0)
+        want = []
+        for p in prompts:
+            out = await collect(ref_engine.generate(Context(make_req(p, max_tokens=24))))
+            want.append([t for o in out for t in o.token_ids])
+        await ref_engine.close()
+
+        # starved pool: 3 requests x (12 prompt + 24 gen = 36 tokens = 9
+        # blocks) vs 13 usable blocks -> must preempt to finish
+        engine = JaxEngine(cfg(14), seed=0)
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(make_req(p, max_tokens=24))))
+              for p in prompts]
+        )
+        for i, out in enumerate(outs):
+            toks = [t for o in out for t in o.token_ids]
+            assert out[-1].finish_reason == FinishReason.LENGTH
+            assert len(toks) == 24, f"req {i} truncated to {len(toks)}"
+            assert toks == want[i], f"req {i} diverged after preemption"
+        assert engine.stats["preemptions"] > 0
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
+
+
+def test_unservable_request_finishes_instead_of_hanging(run):
+    """A request whose minimum block reservation exceeds the whole pool
+    must finish (LENGTH) rather than head-of-line-block admission forever."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=4, block_size=4,
+            max_batch_size=2, max_context=128, prefill_chunk=32,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        # 24-token prompt -> 8-block minimum vs 3 usable blocks
+        big = make_req(range(10, 34), max_tokens=4)
+        small = make_req(range(40, 46), max_tokens=2)
+        out_big, out_small = await asyncio.gather(
+            asyncio.wait_for(collect(engine.generate(Context(big))), 60),
+            asyncio.wait_for(collect(engine.generate(Context(small))), 60),
+        )
+        assert out_big[-1].finish_reason == FinishReason.LENGTH
+        # the small request behind it still completes fully
+        assert sum(len(o.token_ids) for o in out_small) == 2
+        await engine.close()
+
+    run(main())
+
+
 def test_engine_prefix_cache_hit(run, engine_cfg, shared_engine):
     async def main():
         engine = shared_engine
