@@ -171,6 +171,10 @@ class JaxEngine(AsyncEngine):
             and cfg.block_size % 8 == 0
             and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)
         )
+        # the mesh the pallas dispatchers shard_map over (None = single-chip
+        # kernel or XLA fallback); derived once so every dispatch site uses
+        # the same jit static key
+        self._pallas_mesh = self.mesh if self.use_pallas else None
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         self._prefill_state: Optional[_PrefillState] = None
         # remotely-prefilled sequences with KV landed, awaiting a batch slot
@@ -184,6 +188,7 @@ class JaxEngine(AsyncEngine):
         self._device_lock = asyncio.Lock()
         self._wake = asyncio.Event()
         self._closed = False
+        self._backpressured = False
         # host mirrors of device-side batch state
         M = cfg.max_blocks_per_seq
         self._block_tables = np.zeros((cfg.max_batch_size, M), np.int32)
@@ -310,6 +315,10 @@ class JaxEngine(AsyncEngine):
 
     async def _admit(self) -> bool:
         admitted = False
+        # re-derived every scheduler iteration; True means the head of the
+        # waiting queue can't get blocks right now, so waiting requests are
+        # NOT actionable admission work and decode-window fusion stays on
+        self._backpressured = False
         while self._remote_ready and self._n_active < self.cfg.max_batch_size:
             seq = self._remote_ready.pop(0)
             if seq.finished:
@@ -355,16 +364,25 @@ class JaxEngine(AsyncEngine):
                     (seq.seq_len + bs) // bs + 1, self.cfg.max_blocks_per_seq
                 )
                 if min_needed > self.allocator.num_blocks - 1:
+                    # a fresh prompt that can never fit is a capacity ERROR
+                    # (like prompts >= max_context); a preempted sequence
+                    # that outgrew the pool already streamed real tokens,
+                    # so it ends as an honest LENGTH truncation
+                    reason = (
+                        FinishReason.LENGTH if seq.generated
+                        else FinishReason.ERROR
+                    )
                     logger.warning(
                         "request %s needs %d blocks but the pool holds %d — "
-                        "finishing as LENGTH",
+                        "finishing as %s",
                         getattr(seq.context, "id", "?"), min_needed,
-                        self.allocator.num_blocks - 1,
+                        self.allocator.num_blocks - 1, reason,
                     )
-                    self._finish(seq, FinishReason.LENGTH)
+                    self._finish(seq, reason)
                     continue
                 # out of KV blocks: put back and stop admitting (backpressure)
                 self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
+                self._backpressured = True
                 break
             admitted |= await self._prefill_step()
         self.stats["requests_active"] = self._n_active
@@ -509,7 +527,7 @@ class JaxEngine(AsyncEngine):
         if self.mirror is not None:
             logits, self.k_cache, self.v_cache = self.mirror.lead_prefill(
                 self.params, toks, self._table_for(seq), pos, len(chunk),
-                self.k_cache, self.v_cache,
+                self.k_cache, self.v_cache, use_pallas=self.use_pallas,
             )
             return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0
@@ -522,6 +540,8 @@ class JaxEngine(AsyncEngine):
             jnp.int32(len(chunk)),
             self.k_cache,
             self.v_cache,
+            use_pallas=self.use_pallas,
+            mesh=self._pallas_mesh,
         )
         return logits, pos + len(chunk)
 
@@ -590,16 +610,20 @@ class JaxEngine(AsyncEngine):
     # ---- decode ----
 
     def _pick_window(self) -> int:
-        """Fused steps for the next dispatch: 1 while admission work is
-        pending (a long window would delay waiting requests), else the
-        largest power of two within every active sequence's remaining
-        stop/context headroom."""
-        if (
+        """Fused steps for the next dispatch: 1 while *actionable* admission
+        work is pending (a long window would delay waiting requests), else
+        the largest power of two within every active sequence's remaining
+        stop/context headroom. Waiting requests that CANNOT admit right now
+        (pool backpressure, batch full) don't disable fusion — that would
+        reintroduce the per-token host sync exactly under load."""
+        batch_full = self._n_active >= self.cfg.max_batch_size
+        actionable = (
             self._prefill_state is not None
-            or not self._waiting.empty()
-            or self._remote_ready
-            or self.cfg.decode_window <= 1
-        ):
+            or (not self._waiting.empty() and not batch_full
+                and not self._backpressured)
+            or (bool(self._remote_ready) and not batch_full)
+        )
+        if actionable or self.cfg.decode_window <= 1:
             return 1
         headroom = self.cfg.decode_window
         for seq in self._active:
@@ -622,12 +646,7 @@ class JaxEngine(AsyncEngine):
         offload tier on eviction), so re-admission re-claims the prefix
         and only recomputes the uncommitted tail — never silent
         truncation."""
-        if seq.slot >= 0:
-            self._active[seq.slot] = None
-            self._seq_lens[seq.slot] = 0
-            self._block_tables[seq.slot] = 0
-            self._n_active -= 1
-            seq.slot = -1
+        self._release_slot(seq)
         self.allocator.free(seq.blocks)
         seq.blocks = []
         seq.committed = 0
@@ -712,7 +731,7 @@ class JaxEngine(AsyncEngine):
                 continue
             self._seq_lens[i] = seq.seq_len
             self._last_tokens[i] = seq.tokens[-1]
-            self._commit_full_blocks(seq)
+            self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
 
     def _decode_device(self, steps: np.ndarray, n: int) -> np.ndarray:
         """Runs in an executor thread: one fused n-step decode+sample
@@ -746,7 +765,7 @@ class JaxEngine(AsyncEngine):
             self.v_cache,
             n_steps=n,
             use_pallas=self.use_pallas,
-            mesh=self.mesh if (self.use_pallas and self.mesh is not None) else None,
+            mesh=self._pallas_mesh,
         )
         return np.asarray(jax.device_get(toks))
 
@@ -795,20 +814,36 @@ class JaxEngine(AsyncEngine):
                     completion_tokens=seq.generated,
                 )
             )
+        self._release_slot(seq)
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        self._wake.set()
+
+    def _release_slot(self, seq: _Sequence) -> None:
+        """Vacate a sequence's continuous-batching slot (shared by finish
+        and preemption so the teardown can't drift between them)."""
         if seq.slot >= 0:
             self._active[seq.slot] = None
             self._seq_lens[seq.slot] = 0
             self._block_tables[seq.slot] = 0
             self._n_active -= 1
             seq.slot = -1
-        self.allocator.free(seq.blocks)
-        seq.blocks = []
-        self._wake.set()
 
-    def _commit_full_blocks(self, seq: _Sequence) -> None:
-        """Content-address blocks that just became full."""
+    def _commit_full_blocks(self, seq: _Sequence, written_len: int = -1) -> None:
+        """Content-address blocks that just became full AND fully written.
+
+        ``written_len`` is the number of positions whose KV is actually in
+        the device cache. After a decode window (and after complete_remote's
+        first-token emit) the final sampled token is in ``seq.tokens`` but
+        its KV is only written at the start of the NEXT dispatch — callers
+        there pass ``seq.seq_len - 1`` so a block whose last row is pending
+        is never exposed to match_prefix (a concurrent prefix hit would
+        attend garbage). Prefill-side callers commit at ``seq.seq_len``
+        (tokens list holds only written positions there)."""
         bs = self.cfg.block_size
-        full = seq.seq_len // bs
+        if written_len < 0:
+            written_len = seq.seq_len
+        full = written_len // bs
         while seq.committed < full and seq.committed < len(seq.blocks):
             i = seq.committed
             tokens = seq.tokens[i * bs : (i + 1) * bs]
@@ -943,7 +978,7 @@ class JaxEngine(AsyncEngine):
         self.stats["prefix_cache_hits_tokens"] += seq.cached_prefix
         self._emit_token(seq, first_token)
         if not seq.finished:
-            self._commit_full_blocks(seq)
+            self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
             self._remote_ready.append(seq)
             self._wake.set()
         return seq.out_queue

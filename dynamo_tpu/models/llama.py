@@ -205,7 +205,11 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
 # ---------------- prefill (one sequence, chunked) ----------------
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_pallas", "mesh"),
+    donate_argnames=("k_cache", "v_cache"),
+)
 def prefill(
     params: dict,
     cfg: ModelConfig,
@@ -215,6 +219,8 @@ def prefill(
     valid_len: jnp.ndarray,  # scalar int32: real tokens in this chunk
     k_cache: jnp.ndarray,  # [L, N, bs, Hkv, D] (donated)
     v_cache: jnp.ndarray,
+    use_pallas: bool = False,
+    mesh=None,
 ):
     """Process one (chunk of a) prompt; returns (last_hidden_logits, caches).
 
@@ -237,8 +243,9 @@ def prefill(
         k = apply_rope(k, positions, inv_freq)
         kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
         vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
-        o = att.chunk_attention_with_cache_xla(
-            q, k, v, kc, vc, block_table, history_len, valid_len, scale
+        o = att.chunk_attention_with_cache(
+            q, k, v, kc, vc, block_table, history_len, valid_len, scale,
+            use_pallas=use_pallas, mesh=mesh,
         )
         x = x + o.reshape(T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

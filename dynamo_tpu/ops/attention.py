@@ -76,6 +76,33 @@ def decode_attention(
     )
 
 
+def _shard_headwise(kernel_fn, mesh, q, k_cache_layer, v_cache_layer, *scalars):
+    """Run a paged-attention kernel under shard_map over the ``tp`` axis.
+
+    The kv-head axis is the cache's sharded axis (ops module docs), and
+    paged attention is embarrassingly parallel over kv-head groups — each
+    device runs the kernel on its local [Hkv/tp, ...] cache shard against
+    its local [..., H/tp, D] query shard (q head axis = 1 for both the
+    decode [B, H, D] and prefill [T, H, D] layouts). ``scalars`` (block
+    tables, lengths) replicate, matching the engine's host-batch inputs;
+    other mesh axes (dp/pp/sp/ep) replicate too. No collectives needed.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        kernel_fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q: heads sharded
+            P("tp", None, None, None),  # k cache: kv heads sharded
+            P("tp", None, None, None),  # v cache
+            *([P()] * len(scalars)),  # tables/lengths replicated
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, k_cache_layer, v_cache_layer, *scalars)
+
+
 def paged_decode_attention_sharded(
     q: jnp.ndarray,  # [B, H, D]
     k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
@@ -86,33 +113,15 @@ def paged_decode_attention_sharded(
     mesh,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas decode kernel under shard_map over the ``tp`` axis.
-
-    The kv-head axis is the cache's sharded axis (ops module docs), and
-    attention is embarrassingly parallel over kv-head groups — each device
-    runs the kernel on its local [Hkv/tp, ...] cache shard against its
-    local [B, H/tp, D] query shard. Other mesh axes (dp/pp/sp/ep)
-    replicate, matching the engine's replicated batch inputs.
-    """
+    """Pallas decode kernel under shard_map over tp (see _shard_headwise)."""
     from functools import partial
-
-    from jax.sharding import PartitionSpec as P
 
     from .paged_attention_pallas import paged_decode_attention
 
-    return jax.shard_map(
+    return _shard_headwise(
         partial(paged_decode_attention, scale=scale, interpret=interpret),
-        mesh=mesh,
-        in_specs=(
-            P(None, "tp", None),  # q: heads sharded
-            P("tp", None, None, None),  # k cache: kv heads sharded
-            P("tp", None, None, None),  # v cache
-            P(),  # block tables replicated
-            P(),  # seq lens replicated
-        ),
-        out_specs=P(None, "tp", None),
-        check_vma=False,
-    )(q, k_cache_layer, v_cache_layer, block_tables, seq_lens)
+        mesh, q, k_cache_layer, v_cache_layer, block_tables, seq_lens,
+    )
 
 
 def decode_attention_xla(
@@ -161,6 +170,68 @@ def prefill_attention_xla(
     scores = jnp.where(mask[None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("hts,shd->thd", probs, v)
+
+
+def chunk_attention_with_cache(
+    q: jnp.ndarray,  # [T, H, D] chunk queries
+    k_chunk: jnp.ndarray,  # [T, Hkv, D]
+    v_chunk: jnp.ndarray,
+    k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D]
+    v_cache_layer: jnp.ndarray,
+    block_table: jnp.ndarray,  # [M]
+    history_len: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    scale: float,
+    use_pallas: bool = False,
+    mesh=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
+
+    The Pallas path requires the chunk's K/V to be ALREADY scattered into
+    the cache (write-before-attend — llama.prefill's layer body does this),
+    so it ignores ``k_chunk``/``v_chunk`` and reads history + chunk through
+    the block table. The XLA path reads history from the cache and the
+    chunk from the args. Both agree on all real rows (t < valid_len);
+    padded tail rows differ but are discarded by every caller.
+    """
+    if use_pallas and mesh is not None:
+        return paged_prefill_attention_sharded(
+            q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
+            mesh, interpret=interpret,
+        )
+    if use_pallas:
+        from .paged_attention_pallas import paged_prefill_attention
+
+        return paged_prefill_attention(
+            q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
+            interpret=interpret,
+        )
+    return chunk_attention_with_cache_xla(
+        q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
+        history_len, valid_len, scale,
+    )
+
+
+def paged_prefill_attention_sharded(
+    q: jnp.ndarray,  # [T, H, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
+    v_cache_layer: jnp.ndarray,
+    block_table: jnp.ndarray,  # [M] replicated
+    history_len: jnp.ndarray,  # scalar replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas prefill kernel under shard_map over tp (see _shard_headwise)."""
+    from functools import partial
+
+    from .paged_attention_pallas import paged_prefill_attention
+
+    return _shard_headwise(
+        partial(paged_prefill_attention, scale=scale, interpret=interpret),
+        mesh, q, k_cache_layer, v_cache_layer, block_table, history_len,
+    )
 
 
 def chunk_attention_with_cache_xla(

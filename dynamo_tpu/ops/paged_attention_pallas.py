@@ -1,7 +1,8 @@
-"""Pallas TPU kernel: ragged paged-attention for the decode hot loop.
+"""Pallas TPU kernels: ragged paged-attention for decode and prefill.
 
-The reference's equivalent is vLLM's paged_attention CUDA kernel (invoked
-inside the engines Dynamo wraps); here it is a native Mosaic/TPU kernel.
+The reference's equivalent is vLLM's paged_attention CUDA kernel plus its
+flash-attention prefill (invoked inside the engines Dynamo wraps); here
+they are native Mosaic/TPU kernels.
 
 Design (per SURVEY.md §7 "hard parts" — this is the decode make-or-break):
 
@@ -152,3 +153,155 @@ def paged_decode_attention(
         interpret=interpret,
     )(block_tables, seq_lens, qg, k_cache_layer, v_cache_layer)
     return out[:, :, :G, :].reshape(B, H, D)
+
+
+# ---------------- ragged prefill (chunked, reads the paged cache) ----------------
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    block_table_ref,  # [M] int32 (SMEM)
+    hist_ref,  # [1] int32 (SMEM): tokens already cached before this chunk
+    # inputs
+    q_ref,  # [1, Tq*Gp, D] queries for (h, tile j), rows = (t, g) pairs
+    k_ref,  # [1, 1, bs, D] one KV page
+    v_ref,  # [1, 1, bs, D]
+    # outputs
+    o_ref,  # [1, Tq*Gp, D]
+    # scratch
+    m_scr,  # [Tq*Gp, 128] f32 running max
+    l_scr,  # [Tq*Gp, 128] f32 running normalizer
+    acc_scr,  # [Tq*Gp, D] f32 accumulator
+    *,
+    scale: float,
+    block_size: int,
+    q_tile: int,  # Tq: chunk rows per grid step
+    group: int,  # Gp: padded query heads per kv head
+):
+    j = pl.program_id(0)  # q tile
+    i = pl.program_id(2)  # kv page (innermost: sequential accumulation)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    hist = hist_ref[0]
+    start = i * block_size
+    # last query position in this tile — pages past it are fully masked
+    tile_last_q = hist + (j + 1) * q_tile - 1
+
+    @pl.when(start <= tile_last_q)
+    def _page():
+        q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Tq*Gp, bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = hist + j * q_tile + rows // group
+        kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-20)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [T, H, D] chunk queries
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D] — chunk ALREADY written
+    v_cache_layer: jnp.ndarray,
+    block_table: jnp.ndarray,  # [M] int32, covers history + padded chunk
+    history_len: jnp.ndarray,  # scalar int32
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:  # [T, H, D]
+    """Flash-style chunked-prefill attention over the paged cache.
+
+    The caller must have scattered this chunk's (rope'd) K/V into the cache
+    first (write-before-attend, as llama.prefill does) — the kernel then
+    reads history AND chunk through the block table, so one code path
+    covers chunked prefill and prefix-cache hits. Causal masking at
+    absolute positions does all the ragged bookkeeping: padded tail rows
+    only ever produce garbage in rows the wrapper's caller discards, and
+    real rows (t < valid_len) never attend past themselves.
+
+    Grid = (q_tiles, kv_heads, pages); block table + history length are
+    scalar-prefetched so the BlockSpec index_map DMAs exactly the needed
+    physical [bs, D] page per step (pages beyond a tile's causal horizon
+    re-map to the last needed page — consecutive identical indices skip
+    the fetch). fp32 online softmax in VMEM scratch, output written once
+    on the final page step.
+    """
+    T, H, D = q.shape
+    Hkv, N, bs, _ = k_cache_layer.shape
+    M = block_table.shape[0]
+    G = H // Hkv
+    Gp = max(8, -(-G // 8) * 8)
+    Tq = min(128, T)
+    nT = -(-T // Tq)
+    Tpad = nT * Tq
+    # [T, H, D] -> [Hkv, nT*Tq*Gp, D]: rows are (tile, t, g) lexicographic,
+    # so in-kernel row r of tile j maps to t = j*Tq + r//Gp, g = r%Gp
+    qg = q.reshape(T, Hkv, G, D)
+    qg = jnp.pad(qg, ((0, Tpad - T), (0, 0), (0, Gp - G), (0, 0)))
+    qg = qg.transpose(1, 0, 2, 3).reshape(Hkv, Tpad * Gp, D)
+
+    def page_index(j, h, i, bt, hist):
+        tile_last = (hist[0] + (j + 1) * Tq - 1) // bs
+        written_last = (hist[0] + Tpad - 1) // bs
+        pi = jnp.minimum(jnp.minimum(i, tile_last), jnp.minimum(written_last, M - 1))
+        return (h, bt[pi], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nT, Hkv, M),
+        in_specs=[
+            pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
+            pl.BlockSpec((1, 1, bs, D), page_index),
+            pl.BlockSpec((1, 1, bs, D), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Tq * Gp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Gp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Gp, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hkv, Tpad * Gp, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * Tpad * H * M * bs * D,
+            bytes_accessed=2 * Hkv * M * bs * D * k_cache_layer.dtype.itemsize,
+            transcendentals=Tpad * H * M * bs,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(block_table), jnp.asarray(history_len, jnp.int32).reshape(1),
+      qg, k_cache_layer, v_cache_layer)
+    out = out.reshape(Hkv, nT, Tq, Gp, D).transpose(1, 2, 0, 3, 4)
+    return out.reshape(Tpad, Hkv, Gp, D)[:T, :, :G, :].reshape(T, H, D)

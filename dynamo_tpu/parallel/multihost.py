@@ -188,25 +188,28 @@ class StepMirror:
             )
         return self._fns[key]
 
-    def _prefill_fn(self):
-        if "prefill" not in self._fns:
+    def _prefill_fn(self, use_pallas: bool = False):
+        key = ("prefill", use_pallas)
+        if key not in self._fns:
             import jax
 
             from ..models import llama
 
             cfg = self.model_cfg
+            mesh = self.mesh if use_pallas else None
 
             def step(params, toks, table, pos, valid, k_cache, v_cache):
                 return llama.prefill.__wrapped__(
-                    params, cfg, toks, table, pos, valid, k_cache, v_cache
+                    params, cfg, toks, table, pos, valid, k_cache, v_cache,
+                    use_pallas=use_pallas, mesh=mesh,
                 )
 
-            self._fns["prefill"] = jax.jit(
+            self._fns[key] = jax.jit(
                 step,
                 donate_argnums=(5, 6),
                 out_shardings=(self._rep, self._cache_sh, self._cache_sh),
             )
-        return self._fns["prefill"]
+        return self._fns[key]
 
     def _sample1_fn(self):
         if "sample1" not in self._fns:
@@ -282,14 +285,16 @@ class StepMirror:
         )
         return np.asarray(jax.device_get(toks)), k_cache, v_cache
 
-    def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache):
+    def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache,
+                     use_pallas: bool = False):
         self._lead(
             "prefill",
             (toks, np.asarray(table),
              np.asarray(pos, np.int32), np.asarray(valid, np.int32)),
+            pallas=use_pallas,
         )
         g = self.to_global
-        return self._prefill_fn()(
+        return self._prefill_fn(use_pallas)(
             params, g(toks), g(np.asarray(table)),
             g(np.asarray(pos, np.int32)), g(np.asarray(valid, np.int32)),
             k_cache, v_cache,
@@ -345,9 +350,9 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
                 params, *(g(a) for a in arrays), k_cache, v_cache
             )
         elif op == "prefill":
-            logits, k_cache, v_cache = mirror._prefill_fn()(
-                params, *(g(a) for a in arrays), k_cache, v_cache
-            )
+            logits, k_cache, v_cache = mirror._prefill_fn(
+                head.get("pallas", False)
+            )(params, *(g(a) for a in arrays), k_cache, v_cache)
         elif op == "sample1":
             mirror._sample1_fn()(logits, *(g(a) for a in arrays))
         else:

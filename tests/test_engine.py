@@ -210,7 +210,8 @@ def test_preemption_under_pool_pressure(run):
 
 def test_unservable_request_finishes_instead_of_hanging(run):
     """A request whose minimum block reservation exceeds the whole pool
-    must finish (LENGTH) rather than head-of-line-block admission forever."""
+    must finish (ERROR — a capacity misconfiguration, not an honest
+    truncation) rather than head-of-line-block admission forever."""
 
     async def main():
         cfg = EngineConfig(
@@ -225,10 +226,48 @@ def test_unservable_request_finishes_instead_of_hanging(run):
             asyncio.wait_for(collect(engine.generate(Context(big))), 60),
             asyncio.wait_for(collect(engine.generate(Context(small))), 60),
         )
-        assert out_big[-1].finish_reason == FinishReason.LENGTH
+        assert out_big[-1].finish_reason == FinishReason.ERROR
         # the small request behind it still completes fully
         assert sum(len(o.token_ids) for o in out_small) == 2
         await engine.close()
+
+    run(main())
+
+
+def test_commit_respects_written_horizon(run, engine_cfg, shared_engine):
+    """A block whose last KV row is the just-sampled (not-yet-written)
+    token must NOT enter the prefix-reuse pool: a concurrent prefix hit
+    would attend garbage. Decode-side commits (seq placed in a batch
+    slot) must lag one token behind seq_len; they catch up on the next
+    dispatch once the pending token's KV is written."""
+
+    async def main():
+        engine = shared_engine
+        bs = engine.cfg.block_size  # 4
+        decode_commits = []
+        orig = engine._commit_full_blocks
+
+        def spy(seq, written_len=-1):
+            orig(seq, written_len)
+            if seq.slot >= 0:  # decode-window site (prefill commits pre-slot)
+                decode_commits.append((seq.committed * bs, seq.seq_len))
+
+        engine._commit_full_blocks = spy
+        try:
+            # prompt 11 + admission token = 12, then window=4 dispatches
+            # land a commit exactly at the seq_len=16 block boundary while
+            # token 15's KV is still pending
+            req = make_req(range(30, 41), max_tokens=8)
+            await collect(engine.generate(Context(req)))
+        finally:
+            engine._commit_full_blocks = orig
+        boundary = [c for c, sl in decode_commits if sl % bs == 0]
+        assert boundary, "no window ended on a block boundary — bad geometry"
+        for committed_tokens, seq_len in decode_commits:
+            assert committed_tokens <= seq_len - 1, (
+                f"committed {committed_tokens} tokens but only "
+                f"{seq_len - 1} have written KV"
+            )
 
     run(main())
 

@@ -79,6 +79,140 @@ def test_kernel_sharded_tp2_matches_xla():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+# ---------------- ragged prefill kernel ----------------
+
+
+def _mk_prefill(T, H, Hkv, D, N, bs, M, hist, seed=0):
+    """Random cache with history + a chunk written at [hist, hist+T) —
+    returns everything both the XLA ref and the Pallas kernel need."""
+    from dynamo_tpu.ops.attention import write_chunk_to_cache
+
+    k = jax.random.key(seed)
+    ks = jax.random.split(k, 5)
+    q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+    k_chunk = jax.random.normal(ks[1], (T, Hkv, D), jnp.float32)
+    v_chunk = jax.random.normal(ks[2], (T, Hkv, D), jnp.float32)
+    kc = jax.random.normal(ks[3], (Hkv, N, bs, D), jnp.float32)
+    vc = jax.random.normal(ks[4], (Hkv, N, bs, D), jnp.float32)
+    rng = np.random.default_rng(seed)
+    table = rng.permutation(np.arange(1, N))[:M].astype(np.int32)
+    table = jnp.asarray(table)
+    hist = jnp.int32(hist)
+    # pallas reads the chunk from the cache: write-before-attend
+    kc_w = write_chunk_to_cache(kc, k_chunk, table, hist)
+    vc_w = write_chunk_to_cache(vc, v_chunk, table, hist)
+    return q, k_chunk, v_chunk, kc, vc, kc_w, vc_w, table, hist
+
+
+@pytest.mark.parametrize("H,Hkv,hist,T,valid", [
+    (8, 8, 0, 32, 32),       # plain prefill, no history
+    (8, 2, 24, 32, 32),      # GQA + chunked continuation
+    (16, 8, 7, 48, 33),      # ragged: padded chunk tail
+    (8, 4, 0, 8, 5),         # tiny chunk, padded
+])
+def test_prefill_kernel_matches_xla(H, Hkv, hist, T, valid):
+    from dynamo_tpu.ops.attention import chunk_attention_with_cache_xla
+    from dynamo_tpu.ops.paged_attention_pallas import paged_prefill_attention
+
+    D, N, bs, M = 128, 64, 16, 8
+    q, k_chunk, v_chunk, kc, vc, kc_w, vc_w, table, h = _mk_prefill(
+        T, H, Hkv, D, N, bs, M, hist
+    )
+    scale = D**-0.5
+    ref = chunk_attention_with_cache_xla(
+        q, k_chunk, v_chunk, kc, vc, table, h, jnp.int32(valid), scale
+    )
+    got = paged_prefill_attention(q, kc_w, vc_w, table, h, scale, interpret=True)
+    # real rows must agree exactly; padded tail rows are discarded by callers
+    np.testing.assert_allclose(
+        np.asarray(got)[:valid], np.asarray(ref)[:valid], rtol=2e-5, atol=2e-5
+    )
+    assert not np.isnan(np.asarray(got)).any()
+
+
+def test_prefill_kernel_long_multitile():
+    """T > 128 exercises multiple q tiles sharing the page pipeline."""
+    from dynamo_tpu.ops.attention import chunk_attention_with_cache_xla
+    from dynamo_tpu.ops.paged_attention_pallas import paged_prefill_attention
+
+    T, H, Hkv, D, N, bs, M, hist = 160, 8, 4, 128, 128, 16, 16, 30
+    q, k_chunk, v_chunk, kc, vc, kc_w, vc_w, table, h = _mk_prefill(
+        T, H, Hkv, D, N, bs, M, hist, seed=5
+    )
+    scale = D**-0.5
+    ref = chunk_attention_with_cache_xla(
+        q, k_chunk, v_chunk, kc, vc, table, h, jnp.int32(T), scale
+    )
+    got = paged_prefill_attention(q, kc_w, vc_w, table, h, scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_kernel_sharded_tp2_matches_xla():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import (
+        chunk_attention_with_cache_xla,
+        paged_prefill_attention_sharded,
+    )
+
+    T, H, Hkv, D, N, bs, M, hist = 32, 8, 4, 128, 64, 16, 8, 16
+    q, k_chunk, v_chunk, kc, vc, kc_w, vc_w, table, h = _mk_prefill(
+        T, H, Hkv, D, N, bs, M, hist, seed=7
+    )
+    scale = D**-0.5
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 1, 1, 1, 2),
+                ("dp", "pp", "sp", "ep", "tp"))
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
+    kcs = jax.device_put(kc_w, NamedSharding(mesh, P("tp", None, None, None)))
+    vcs = jax.device_put(vc_w, NamedSharding(mesh, P("tp", None, None, None)))
+    ref = chunk_attention_with_cache_xla(
+        q, k_chunk, v_chunk, kc, vc, table, h, jnp.int32(T), scale
+    )
+    got = paged_prefill_attention_sharded(
+        qs, kcs, vcs, table, h, scale, mesh, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_end_to_end_matches_dense():
+    """llama.prefill with the Pallas path (interpret) must match
+    dense_forward logits — the full-model equivalence the engine relies on."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.ops import attention as att
+
+    cfg = ModelConfig(
+        num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+        head_dim=128, intermediate_size=128, vocab_size=128,
+        dtype="float32",
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    T, bs, N = 24, 8, 16
+    toks = jax.random.randint(jax.random.key(1), (T,), 0, cfg.vocab_size)
+    ref_logits = llama.dense_forward(params, cfg, toks)[-1]
+
+    kc, vc = llama.init_kv_cache(cfg, N, bs)
+    table = jnp.arange(1, 1 + -(-T // bs), dtype=jnp.int32)
+    table = jnp.pad(table, (0, 8 - table.shape[0]))
+    orig = att.chunk_attention_with_cache
+
+    def pallas_interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    att.chunk_attention_with_cache = pallas_interp
+    try:
+        logits, kc, vc = llama.prefill.__wrapped__(
+            params, cfg, toks, table, jnp.int32(0), jnp.int32(T), kc, vc,
+            use_pallas=True,
+        )
+    finally:
+        att.chunk_attention_with_cache = orig
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_kernel_bf16_cache():
     B, H, Hkv, D, N, bs, M = 2, 8, 4, 128, 32, 16, 2
     q, kc, vc, tables = _mk(B, H, Hkv, D, N, bs, M, seed=2)
