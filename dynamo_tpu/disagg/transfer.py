@@ -248,8 +248,10 @@ async def send_kv_blocks(
 
 class LocalKvPipe:
     """In-process transfer: prefill and decode engines share the process
-    (two meshes / two engines on one host) — hand the arrays over
-    directly, zero copies on the host side."""
+    (two meshes / two engines on one slice) — the arrays handed over are
+    jax.Arrays still resident in HBM (prefill_extract keep_on_device), so
+    the whole gather -> deliver -> scatter path is device-to-device with
+    zero host copies. TCP (send_kv_blocks) is the cross-DCN fallback."""
 
     def __init__(self):
         self._pending: dict[str, asyncio.Future] = {}

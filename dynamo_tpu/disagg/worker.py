@@ -107,8 +107,12 @@ class PrefillWorker:
     async def _process(self, rpr: RemotePrefillRequest) -> None:
         req = PreprocessedRequest.from_dict(rpr.request)
         ctx = AsyncEngineContext(rpr.request_id)
+        # in-process pipe => same device slice: keep KV on device end to
+        # end (gather -> pipe -> decode scatter, no host hop); the TCP
+        # path needs host bytes anyway
+        local = bool(rpr.connection.get("local")) and self.local_pipe is not None
         first, k, v = await self.engine.prefill_extract(
-            req, ctx, skip_blocks=rpr.skip_blocks
+            req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local
         )
         self.stats["prefills_total"] += 1
         layout = self.head_layout

@@ -146,20 +146,85 @@ def swiglu(x, w_gate, w_up, w_down):
     return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
 
 
-def moe_ffn(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
-    """Mixtral/DeepSeek-style sparse MoE FFN (ref serves these via vLLM —
-    README's Mixtral / DeepSeek-R1 rows; here it's native).
+def _moe_route(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
+    """Top-k routing + expert-sorted dispatch order (shared by the single-
+    device and ep-sharded ragged paths). Returns (t_sorted, w_sorted,
+    group_sizes): token row per assignment in expert order, its combine
+    weight, and per-expert assignment counts."""
+    k = cfg.num_experts_per_tok
+    gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
+    vals, idx = lax.top_k(probs, k)  # [T, k]
+    if cfg.norm_topk_prob:
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    e_flat = idx.reshape(-1)  # [T*k] row-major: assignment r -> token r//k
+    order = jnp.argsort(e_flat)  # stable: deterministic within an expert
+    t_sorted = order // k
+    w_sorted = vals.reshape(-1)[order]
+    group_sizes = jnp.bincount(e_flat, length=cfg.num_experts)
+    return t_sorted, w_sorted, group_sizes
 
-    Dense dispatch: every (stacked) expert runs over all tokens and the
-    routing matrix — zero except each token's top-k — selects at combine.
-    The expert axis ``x`` of ``we_*`` is sharded over the ``ep`` mesh axis
-    (parallel/mesh.py), so GSPMD keeps per-device work at X/ep experts and
-    inserts the combine all-reduce over ICI: the einsum contraction over
-    ``x`` IS the expert-parallel reduce. Exact (no capacity factor, no
-    token dropping). A ragged all-to-all Pallas dispatch is the later
-    optimization for very large X.
+
+def _moe_combine(o, t_sorted, w_sorted, T: int, dtype):
+    """Scatter-add expert outputs back to token rows. ``t_sorted`` entries
+    of masked rows point at the sacrificial row T, sliced off."""
+    out = jnp.zeros((T + 1, o.shape[-1]), dtype)
+    out = out.at[t_sorted].add(o * w_sorted[:, None].astype(dtype))
+    return out[:T]
+
+
+def moe_ffn(
+    lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh=None
+) -> jnp.ndarray:
+    """Mixtral/DeepSeek-style sparse MoE FFN with RAGGED dispatch (ref
+    serves these via vLLM's fused_moe grouped-GEMM CUDA kernels; the TPU
+    equivalent is ``lax.ragged_dot`` — XLA's grouped matmul).
+
+    Tokens are sorted by assigned expert and each expert contracts only
+    its own contiguous row group, so per-token FLOPs scale with top-k, not
+    with the expert count (dense dispatch computed every expert for every
+    token — X/k times the work, fatal at Mixtral-8x22B scale). Exact: no
+    capacity factor, no token dropping.
+
+    With a mesh, the dispatch runs under shard_map over (ep, tp): experts
+    are ep-sharded (parallel/mesh.py we_* specs) so each device slices the
+    expert-sorted rows at its own traced offset — a static [T*k]-row
+    window, masked to its true count — and the token-scatter combine
+    psum-reduces over ep (the expert combine) and tp (the down-projection
+    contraction). Routing is computed replicated per device: T×X scalar
+    work, negligible beside the expert GEMMs.
+
+    Three paths: no mesh -> plain ragged_dot; mesh + divisible shapes ->
+    shard_map ragged; mesh but indivisible shapes (or ep/tp axes absent)
+    -> dense dispatch. The last is deliberate: ragged_dot's group axis is
+    opaque to GSPMD, so running it on ep-sharded weights would all-gather
+    every expert onto every device — the dense einsum's contraction over
+    experts IS GSPMD's expert-parallel reduce, making it the safe (if
+    FLOP-heavier) fallback for odd shapes.
     """
     T = x.shape[0]
+    out_dt = x.dtype
+    if mesh is None:
+        t_sorted, w_sorted, group_sizes = _moe_route(lp, cfg, x)
+        g = lax.ragged_dot(x[t_sorted], lp["we_gate"], group_sizes)
+        u = lax.ragged_dot(x[t_sorted], lp["we_up"], group_sizes)
+        o = lax.ragged_dot(jax.nn.silu(g) * u, lp["we_down"], group_sizes)
+        out = _moe_combine(o, t_sorted, w_sorted, T, out_dt)
+    elif _moe_can_shard(mesh, cfg):
+        out = _moe_ragged_sharded(lp, cfg, x, mesh)
+    else:
+        out = _moe_dense_dispatch(lp, cfg, x)
+    if "shared_gate" in lp:  # DeepSeek shared experts: always-on dense path
+        out = out + swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    return out
+
+
+def _moe_dense_dispatch(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Dense dispatch: every expert computes every token, the routing
+    matrix (zero except each token's top-k) selects at combine. X/k times
+    the ragged path's expert-GEMM FLOPs, but fully GSPMD-shardable — the
+    equivalence ground truth for tests and the mesh fallback for shapes
+    the shard_map ragged path can't cover."""
     gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
     probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
     vals, idx = lax.top_k(probs, cfg.num_experts_per_tok)  # [T, k]
@@ -173,15 +238,84 @@ def moe_ffn(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     g = jnp.einsum("te,xef->txf", x, lp["we_gate"])
     u = jnp.einsum("te,xef->txf", x, lp["we_up"])
     y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, lp["we_down"])
-    out = jnp.einsum("txe,tx->te", y, w.astype(x.dtype))
-    if "shared_gate" in lp:  # DeepSeek shared experts: always-on dense path
+    return jnp.einsum("txe,tx->te", y, w.astype(x.dtype))
+
+
+def moe_ffn_dense(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Full dense-dispatch reference (incl. shared experts) for tests."""
+    out = _moe_dense_dispatch(lp, cfg, x)
+    if "shared_gate" in lp:
         out = out + swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
     return out
 
 
-def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+def _moe_can_shard(mesh, cfg: ModelConfig) -> bool:
+    if not {"ep", "tp"} <= set(mesh.axis_names):
+        return False
+    fm = cfg.moe_intermediate_size or cfg.intermediate_size
+    return (
+        cfg.num_experts % mesh.shape["ep"] == 0 and fm % mesh.shape["tp"] == 0
+    )
+
+
+def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
+    """shard_map body for ragged MoE over (ep, tp); other axes stay auto."""
+    from jax.sharding import PartitionSpec as P
+
+    T = x.shape[0]
+    X = cfg.num_experts
+    R = T * cfg.num_experts_per_tok
+    ep = mesh.shape["ep"]
+    Xl = X // ep
+    out_dt = x.dtype
+
+    def body(x, moe_gate, we_gate, we_up, we_down):
+        t_sorted, w_sorted, group_sizes = _moe_route(
+            {"moe_gate": moe_gate}, cfg, x
+        )
+        first = lax.axis_index("ep") * Xl
+        gs_local = lax.dynamic_slice_in_dim(group_sizes, first, Xl)
+        start = jnp.sum(jnp.where(jnp.arange(X) < first, group_sizes, 0))
+        count = jnp.sum(gs_local)
+        # static [R]-row window at this device's traced offset; rows past
+        # ``count`` belong to other devices' experts and are masked out
+        xs = jnp.concatenate([x[t_sorted], jnp.zeros_like(x[t_sorted])], 0)
+        xs = lax.dynamic_slice_in_dim(xs, start, R)
+        t_l = lax.dynamic_slice_in_dim(
+            jnp.concatenate([t_sorted, jnp.full((R,), T, t_sorted.dtype)]),
+            start, R,
+        )
+        w_l = lax.dynamic_slice_in_dim(
+            jnp.concatenate([w_sorted, jnp.zeros((R,), w_sorted.dtype)]),
+            start, R,
+        )
+        valid = jnp.arange(R) < count
+        t_l = jnp.where(valid, t_l, T)  # sacrificial combine row
+        w_l = jnp.where(valid, w_l, 0.0)
+        g = lax.ragged_dot(xs, we_gate, gs_local)
+        u = lax.ragged_dot(xs, we_up, gs_local)
+        o = lax.ragged_dot(jax.nn.silu(g) * u, we_down, gs_local)
+        out = _moe_combine(o, t_l, w_l, T, out_dt)
+        return lax.psum(out, ("ep", "tp"))
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(),  # x replicated (batch inputs are replicated engine-side)
+            P(),  # router weights replicated
+            P("ep", None, "tp"),  # we_gate [X, E, Fm]
+            P("ep", None, "tp"),  # we_up
+            P("ep", "tp", None),  # we_down [X, Fm, E]
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(x, lp["moe_gate"], lp["we_gate"], lp["we_up"], lp["we_down"])
+
+
+def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
     if cfg.is_moe:
-        return moe_ffn(lp, cfg, h)
+        return moe_ffn(lp, cfg, h, mesh=mesh)
     return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
@@ -249,7 +383,7 @@ def prefill(
         )
         x = x + o.reshape(T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h)
+        x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))
@@ -288,7 +422,7 @@ def _decode_body(
         )
         x = x + o.reshape(B, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h)
+        x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))

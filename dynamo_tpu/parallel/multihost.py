@@ -171,7 +171,7 @@ class StepMirror:
             from ..models import llama
 
             cfg = self.model_cfg
-            mesh = self.mesh if use_pallas else None
+            mesh = self.mesh  # sharded pallas attention + ragged MoE
 
             def step(params, tokens, positions, tables, seq_lens, seeds,
                      steps, temps, top_ks, top_ps, k_cache, v_cache):
@@ -196,7 +196,7 @@ class StepMirror:
             from ..models import llama
 
             cfg = self.model_cfg
-            mesh = self.mesh if use_pallas else None
+            mesh = self.mesh  # sharded pallas attention + ragged MoE
 
             def step(params, toks, table, pos, valid, k_cache, v_cache):
                 return llama.prefill.__wrapped__(
