@@ -4,15 +4,20 @@ TPU-native re-design of the reference's Kubernetes machinery
 (deploy/dynamo/operator Go CRDs + controllers, deploy/dynamo/api-server
 REST): the deployment *spec* is the same shape (a graph deployment with
 per-service replicas/resources/autoscaling, operator/api/v1alpha1/
-dynamodeployment_types.go:28), but instead of an in-cluster reconciler
-the TPU build renders deterministic manifests (GitOps-style) with
-TPU-slice scheduling (nodeSelectors for gke-tpu-accelerator/topology,
-one worker per slice host group) — a controller has nothing TPU-specific
-to reconcile that the manifest cannot declare.
+dynamodeployment_types.go:28). Two execution paths:
+
+  * **manifests** — deterministic k8s YAML (GitOps-style) with TPU-slice
+    scheduling (nodeSelectors for gke-tpu-accelerator/topology, one
+    worker per slice host group) for real clusters;
+  * **controller** — a live reconcile loop (the operator-controller
+    equivalent, dynamonimdeployment_controller.go) for TPU-VM hosts: it
+    converges specs into child processes with crash-restart backoff,
+    queue-depth autoscaling, and a status subresource.
 """
 
 from .api_server import ApiServer
 from .builder import build_artifact, read_artifact
+from .controller import DeploymentController
 from .crd import (
     Autoscaling,
     DynamoDeployment,
@@ -23,6 +28,7 @@ from .manifests import render_manifests, to_yaml
 
 __all__ = [
     "ApiServer",
+    "DeploymentController",
     "Autoscaling",
     "DynamoDeployment",
     "Resources",

@@ -25,6 +25,7 @@ import hashlib
 import json
 import os
 import tempfile
+from typing import Optional
 
 from ..http.base import HttpError, HttpServerBase
 from .crd import DynamoDeployment, SpecError
@@ -56,22 +57,42 @@ class DeploymentStore:
         except FileNotFoundError:
             raise HttpError(404, f"deployment {name!r} not found", "not_found") from None
 
+    @staticmethod
+    def _atomic_write(path: str, obj: dict) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=2)
+        os.replace(tmp, path)
+
     def put(self, name: str, spec: dict, create: bool) -> None:
         path = self._path(name)
         if create and os.path.exists(path):
             raise HttpError(409, f"deployment {name!r} exists", "conflict")
         if not create and not os.path.exists(path):
             raise HttpError(404, f"deployment {name!r} not found", "not_found")
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
-        with os.fdopen(fd, "w") as f:
-            json.dump(spec, f, indent=2)
-        os.replace(tmp, path)
+        self._atomic_write(path, spec)
 
     def delete(self, name: str) -> None:
         try:
             os.unlink(self._path(name))
         except FileNotFoundError:
             raise HttpError(404, f"deployment {name!r} not found", "not_found") from None
+        try:
+            os.unlink(self._path(name) + ".status")
+        except FileNotFoundError:
+            pass
+
+    # ---- status subresource (written by the reconcile controller) ----
+
+    def put_status(self, name: str, status: dict) -> None:
+        self._atomic_write(self._path(name) + ".status", status)
+
+    def get_status(self, name: str) -> Optional[dict]:
+        try:
+            with open(self._path(name) + ".status") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
 
     # ---- artifacts ("bentos", ref api-server revisions) ----
 
@@ -144,6 +165,11 @@ class ApiServer(HttpServerBase):
             elif method == "DELETE" and len(rest) == 2:
                 self.store.delete(rest[1])
                 await self._send_json(writer, 200, {"deleted": rest[1]})
+            elif method == "GET" and len(rest) == 3 and rest[2] == "status":
+                self.store.get(rest[1])  # 404 on unknown deployment
+                await self._send_json(
+                    writer, 200, self.store.get_status(rest[1]) or {}
+                )
             elif method == "GET" and len(rest) == 3 and rest[2] == "manifests":
                 dep = DynamoDeployment.from_dict(self.store.get(rest[1]))
                 yaml_text = to_yaml(render_manifests(dep))
@@ -183,14 +209,27 @@ def main(argv=None) -> None:
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (no auth — keep loopback unless proxied)")
     p.add_argument("--port", type=int, default=7700)
+    p.add_argument("--reconcile", action="store_true",
+                   help="run the live controller: converge specs into child "
+                        "processes on this host (deploy/controller.py)")
     args = p.parse_args(argv)
 
     async def run():
         srv = ApiServer(args.root, host=args.host, port=args.port)
         await srv.start()
-        print(f"api-server on http://{args.host}:{srv.port} (root {args.root})",
-              flush=True)
-        await srv.run()
+        ctl = None
+        if args.reconcile:
+            from .controller import DeploymentController
+
+            ctl = DeploymentController(srv.store)
+            ctl.start()
+        print(f"api-server on http://{args.host}:{srv.port} (root {args.root}"
+              f"{', reconciling' if ctl else ''})", flush=True)
+        try:
+            await srv.run()
+        finally:
+            if ctl is not None:
+                await ctl.stop()
 
     asyncio.run(run())
 

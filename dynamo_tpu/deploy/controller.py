@@ -1,0 +1,266 @@
+"""Live reconcile loop over the deployment store.
+
+Re-design of the reference's in-cluster operator
+(deploy/dynamo/operator/internal/controller/
+dynamonimdeployment_controller.go — watch CRs, create/scale the child
+Deployments, write status conditions). On a TPU-VM fleet the unit of
+scheduling is a host process, not a pod, so the controller here converges
+*processes*: it polls the DeploymentStore (the CR store), diffs desired
+replicas against the child processes it owns, and spawns/kills/restarts
+to match — crash-restart with exponential backoff, queue-depth
+autoscaling, and a status subresource written back next to each spec.
+
+The manifest renderer (manifests.py) remains the GitOps path for real
+k8s clusters; this controller is the single-host / dev-fleet reconciler
+the api-server can host directly (``ApiServer(..., reconcile=True)``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .crd import DynamoDeployment, ServiceDeploymentSpec, SpecError
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Replica:
+    proc: object  # subprocess.Popen-like (poll/terminate/kill)
+    started_at: float = field(default_factory=time.monotonic)
+
+
+class DeploymentController:
+    """Reconciles DeploymentStore specs into running child processes.
+
+    ``spawn`` is injectable (tests use fakes): called with
+    (deployment_name, service_spec, replica_index) and must return a
+    Popen-like object. ``metrics_fn(deployment, service) -> queue_depth``
+    enables autoscaling; None means replicas follow the spec exactly.
+    """
+
+    def __init__(
+        self,
+        store,
+        poll_interval: float = 1.0,
+        spawn: Optional[Callable] = None,
+        metrics_fn: Optional[Callable] = None,
+        backoff_base: float = 1.0,
+        backoff_max: float = 30.0,
+    ):
+        self.store = store
+        self.poll_interval = poll_interval
+        self._spawn = spawn or self._spawn_subprocess
+        self._metrics_fn = metrics_fn
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self._replicas: dict[tuple[str, str, int], _Replica] = {}
+        # terminated children awaiting reap; SIGKILL after the grace period
+        self._terminating: list[tuple[object, float]] = []
+        self.kill_grace = 10.0
+        # consecutive crash count + not-before time per replica slot
+        self._crashes: dict[tuple[str, str, int], int] = {}
+        self._not_before: dict[tuple[str, str, int], float] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._last_status: dict[str, dict] = {}
+        self.stats = {"spawns": 0, "restarts": 0, "kills": 0, "reconciles": 0}
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stopping = False
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self, kill_children: bool = True) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if kill_children:
+            for key in list(self._replicas):
+                self._kill(key)
+            deadline = time.monotonic() + self.kill_grace
+            while self._terminating and time.monotonic() < deadline:
+                self._reap_terminating()
+                await asyncio.sleep(0.05)
+            for proc, _d in self._terminating:
+                try:
+                    proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._terminating = []
+
+    async def _loop(self) -> None:
+        while not self._stopping:
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 — controller must survive
+                logger.exception("reconcile iteration failed")
+            await asyncio.sleep(self.poll_interval)
+
+    # ---- the reconcile step ----
+
+    def reconcile_once(self) -> None:
+        """One observe/diff/converge pass (sync; also called from tests)."""
+        self.stats["reconciles"] += 1
+        self._reap_terminating()
+        desired: dict[tuple[str, str, int], ServiceDeploymentSpec] = {}
+        deployments: dict[str, DynamoDeployment] = {}
+        for name in self.store.list():
+            try:
+                dep = DynamoDeployment.from_dict(self.store.get(name))
+                dep.validate()
+            except (SpecError, KeyError, TypeError) as e:
+                logger.warning("skipping invalid deployment %s: %s", name, e)
+                continue
+            deployments[name] = dep
+            for svc in dep.services:
+                n = self._desired_replicas(name, svc)
+                for i in range(n):
+                    desired[(name, svc.name, i)] = svc
+
+        # reap crashed children; schedule their restart with backoff
+        for key, rep in list(self._replicas.items()):
+            if rep.proc.poll() is not None:
+                del self._replicas[key]
+                if key in desired:
+                    crashes = self._crashes.get(key, 0) + 1
+                    self._crashes[key] = crashes
+                    delay = min(
+                        self._backoff_base * (2 ** (crashes - 1)),
+                        self._backoff_max,
+                    )
+                    self._not_before[key] = time.monotonic() + delay
+                    self.stats["restarts"] += 1
+                    logger.warning(
+                        "replica %s exited rc=%s; restart in %.1fs (crash #%d)",
+                        key, rep.proc.poll(), delay, crashes,
+                    )
+
+        # converge: kill what shouldn't run, spawn what should
+        for key in list(self._replicas):
+            if key not in desired:
+                self._kill(key)
+        now = time.monotonic()
+        for key, svc in desired.items():
+            if key in self._replicas or self._not_before.get(key, 0) > now:
+                continue
+            name, _svc_name, idx = key
+            try:
+                proc = self._spawn(name, svc, idx)
+            except Exception:  # noqa: BLE001 — bad command must not kill
+                logger.exception("spawn failed for %s", key)
+                self._not_before[key] = now + self._backoff_max
+                continue
+            self._replicas[key] = _Replica(proc)
+            self.stats["spawns"] += 1
+        # a replica that stayed up past the backoff window resets its count
+        for key, rep in self._replicas.items():
+            if self._crashes.get(key) and (
+                time.monotonic() - rep.started_at > self._backoff_max
+            ):
+                self._crashes.pop(key, None)
+
+        self._write_statuses(deployments, desired)
+
+    def _desired_replicas(self, name: str, svc: ServiceDeploymentSpec) -> int:
+        if not (svc.autoscaling.enabled and self._metrics_fn):
+            return svc.replicas
+        a = svc.autoscaling
+        try:
+            depth = self._metrics_fn(name, svc)
+        except Exception:  # noqa: BLE001 — metrics plane down: hold steady
+            logger.exception("metrics_fn failed; keeping current scale")
+            current = sum(
+                1 for (d, s, _i) in self._replicas if d == name and s == svc.name
+            )
+            return max(current, a.min_replicas)
+        if depth is None:
+            return svc.replicas
+        want = math.ceil(depth / max(a.target_queue_depth, 1)) if depth > 0 else a.min_replicas
+        return max(a.min_replicas, min(a.max_replicas, want))
+
+    def _kill(self, key) -> None:
+        rep = self._replicas.pop(key, None)
+        if rep is None:
+            return
+        self.stats["kills"] += 1
+        try:
+            rep.proc.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        self._terminating.append((rep.proc, time.monotonic() + self.kill_grace))
+        self._crashes.pop(key, None)
+        self._not_before.pop(key, None)
+
+    def _reap_terminating(self) -> None:
+        """Reap terminated children (no zombies); SIGKILL any that trap
+        SIGTERM past the grace period."""
+        still = []
+        for proc, deadline in self._terminating:
+            if proc.poll() is not None:
+                continue  # reaped
+            if time.monotonic() >= deadline:
+                logger.warning("child ignored SIGTERM; killing")
+                try:
+                    proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+                # keep it one more round so the SIGKILL gets reaped too
+                still.append((proc, deadline + self.kill_grace))
+            else:
+                still.append((proc, deadline))
+        self._terminating = still
+
+    # ---- status subresource ----
+
+    def _write_statuses(self, deployments, desired) -> None:
+        if not hasattr(self.store, "put_status"):
+            return
+        for name, dep in deployments.items():
+            services = {}
+            for svc in dep.services:
+                want = sum(
+                    1 for (d, s, _i) in desired if d == name and s == svc.name
+                )
+                ready = sum(
+                    1 for (d, s, _i) in self._replicas if d == name and s == svc.name
+                )
+                services[svc.name] = {"desired": want, "ready": ready}
+            ok = all(v["ready"] >= v["desired"] for v in services.values())
+            body = {
+                "services": services,
+                "conditions": [{
+                    "type": "Available",
+                    "status": "True" if ok else "False",
+                }],
+            }
+            # write only on change: a steady-state poll loop must not
+            # churn one file-replace per deployment per second
+            if self._last_status.get(name) == body:
+                continue
+            self._last_status[name] = body
+            self.store.put_status(name, body | {"updated_at": time.time()})
+
+    # ---- default child spawner ----
+
+    @staticmethod
+    def _spawn_subprocess(name: str, svc: ServiceDeploymentSpec, idx: int):
+        env = os.environ.copy()
+        env.update(svc.env)
+        env["DYN_DEPLOYMENT"] = name
+        env["DYN_SERVICE"] = svc.name
+        env["DYN_REPLICA"] = str(idx)
+        cmd = svc.command or [sys.executable, "-c", "import time; time.sleep(1e9)"]
+        logger.info("spawning %s/%s[%d]: %s", name, svc.name, idx, cmd)
+        return subprocess.Popen(cmd, env=env)

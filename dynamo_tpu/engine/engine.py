@@ -77,6 +77,14 @@ class EngineConfig:
     # (PrefillWorker head_layout / KvDelivery.head_layout) and the decode
     # side regroups on delivery (ops/kv_rearrange.py; ref kv_rearrange)
     kv_head_layout: str = "blocked"
+    # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
+    # per-output-channel scales; halves decode's HBM weight streaming, the
+    # ref's FP8 serving equivalent, docs/architecture.md:57-61)
+    quantization: str = "none"
+    # KV cache storage dtype: "model" | "float8_e4m3" | "bfloat16"
+    # (float8 = scale-free direct cast, vLLM fp8-KV approach; halves KV
+    # HBM traffic + doubles cache capacity at some quality cost)
+    kv_cache_dtype: str = "model"
 
     def __post_init__(self):
         if self.kv_head_layout != "blocked":
@@ -142,15 +150,23 @@ class JaxEngine(AsyncEngine):
             self.mesh = make_mesh(cfg.mesh) if cfg.mesh else None
         if params is None:
             params = llama.init_params(mcfg, jax.random.key(seed))
+        from ..models.quant import kv_cache_dtype, quantize_params
+
+        # quantize BEFORE placement so the derived q/s leaves get their
+        # own shardings (parallel/mesh.py derives them from the parent's)
+        params = quantize_params(params, mcfg, cfg.quantization)
         if mirror is not None:
             params = mirror.shard_params(params)
         elif self.mesh is not None:
             params = shard_params(params, self.mesh)
         self.params = params
+        cache_dt = kv_cache_dtype(mcfg, cfg.kv_cache_dtype)
         if mirror is not None:
-            k, v = mirror.init_cache(cfg.num_blocks, cfg.block_size)
+            k, v = mirror.init_cache(cfg.num_blocks, cfg.block_size, dtype=cache_dt)
         else:
-            k, v = llama.init_kv_cache(mcfg, cfg.num_blocks, cfg.block_size)
+            k, v = llama.init_kv_cache(
+                mcfg, cfg.num_blocks, cfg.block_size, dtype=cache_dt
+            )
             if self.mesh is not None:
                 sh = cache_sharding(self.mesh, mcfg)
                 k, v = jax.device_put(k, sh), jax.device_put(v, sh)
@@ -170,6 +186,9 @@ class JaxEngine(AsyncEngine):
             and cfg.model.head_dim % 128 == 0
             and cfg.block_size % 8 == 0
             and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)
+            # quantized KV caches take the XLA path (which casts on read);
+            # the Mosaic kernels assume bf16/f32 page tiles
+            and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         self._prefill_state: Optional[_PrefillState] = None

@@ -84,9 +84,15 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
             num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32
         )
         return cfg, None, ByteTokenizer(), args.model_name or "tiny-moe"
+    from ..llm.hub import resolve_model_path
+
+    # the served name comes from the user-facing id (org/name or dir), not
+    # the hex snapshot path a cache hit resolves to
+    name = args.model_name or os.path.basename(os.path.normpath(args.model_path))
+    # local dir, HF-cache snapshot, or hub download (ref hub.rs from_hf)
+    args.model_path = resolve_model_path(args.model_path)
     cfg = ModelConfig.from_local_path(args.model_path)
     tokenizer = HFTokenizer(args.model_path)
-    name = args.model_name or os.path.basename(os.path.normpath(args.model_path))
     params = None
     has_weights = load_weights and any(
         f.endswith(".safetensors") for f in os.listdir(args.model_path)
@@ -118,6 +124,8 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         max_context=args.max_context or 0,
         mesh=mesh_config(args),
         host_cache_blocks=args.host_cache_blocks,
+        quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
     )
 
 
@@ -418,7 +426,7 @@ async def run_batch(args, batch_file: str) -> None:
 
 
 async def run_hub(args) -> None:
-    hub = HubServer(host=args.host, port=args.hub_port)
+    hub = HubServer(host=args.host, port=args.hub_port, data_dir=args.data_dir)
     await hub.start()
     print(f"hub listening on {hub.address}", flush=True)
     await asyncio.Event().wait()
@@ -455,6 +463,15 @@ def main(argv=None) -> None:
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="host-DRAM KV offload tier capacity (blocks; 0=off)")
     p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--data-dir", default=None,
+                   help="hub durability dir: work queues WAL to JSONL here "
+                        "and survive hub restarts (in=hub role)")
+    p.add_argument("--quantization", default="none",
+                   choices=["none", "int8", "fp8_e4m3"],
+                   help="weight quantization (per-channel; models/quant.py)")
+    p.add_argument("--kv-cache-dtype", default="model",
+                   choices=["model", "float8_e4m3", "bfloat16"],
+                   help="KV cache storage dtype (float8 = scale-free cast)")
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-context", type=int, default=0)
     p.add_argument("--namespace", default="dynamo",
@@ -470,6 +487,14 @@ def main(argv=None) -> None:
     p.add_argument("--engine-subprocess", action="store_true",
                    help="isolate a pystr:/pytok: engine in a child process")
     args = p.parse_args(argv)
+
+    # escape hatch for tests/ops: force the JAX platform before any device
+    # init (the site config may bake a TPU platform in; see conftest.py)
+    plat = os.environ.get("DYN_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
 
     args.in_ = "http"
     args.out = "jax"

@@ -142,8 +142,19 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) ->
     return out.astype(x.dtype)
 
 
+def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """Matmul against a plain or quantized weight. Quantized weights are
+    ``{"q": int8|float8 [in, out], "s": f32 [out]}`` (models/quant.py);
+    the convert fuses into the dot's operand read and the per-channel
+    scale into its epilogue, so int8/fp8 storage halves HBM traffic with
+    bf16 MXU compute."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
 def swiglu(x, w_gate, w_up, w_down):
-    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+    return _mm(jax.nn.silu(_mm(x, w_gate)) * _mm(x, w_up), w_down)
 
 
 def _moe_route(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
@@ -325,9 +336,9 @@ def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = _mm(x, lp["wq"])
+    k = _mm(x, lp["wk"])
+    v = _mm(x, lp["wv"])
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(x.shape[:-1] + (cfg.num_heads, cfg.head_dim))
@@ -381,7 +392,7 @@ def prefill(
             q, k, v, kc, vc, block_table, history_len, valid_len, scale,
             use_pallas=use_pallas, mesh=mesh,
         )
-        x = x + o.reshape(T, -1) @ lp["wo"]
+        x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
@@ -420,7 +431,7 @@ def _decode_body(
             q, kc, vc, block_tables, seq_lens, scale,
             use_pallas=use_pallas, mesh=mesh,
         )
-        x = x + o.reshape(B, -1) @ lp["wo"]
+        x = x + _mm(o.reshape(B, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
@@ -523,7 +534,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         o = att.prefill_attention_xla(q, k, v, positions, jnp.int32(T), scale)
-        x = x + o.reshape(T, -1) @ lp["wo"]
+        x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h)
         return x, None

@@ -1,0 +1,91 @@
+"""Post-training weight + KV-cache quantization for serving.
+
+The reference's headline workloads are FP8 70B-class models served through
+its wrapped engines (ref docs/architecture.md:57-91, TRT-LLM/vLLM FP8
+paths); here quantization is native to the JAX engine.
+
+TPU serving decode is HBM-bandwidth-bound: below the roofline knee every
+decode step streams the full weight set from HBM once, so int8/fp8 storage
+halves the bytes per token versus bf16. The dequantize — a convert plus a
+per-output-channel scale multiply — fuses into the matmul's operand read
+under XLA, so the win is pure bandwidth; compute stays bf16 on the MXU.
+
+Scheme: symmetric per-output-channel absmax scaling over the contraction
+axis. A quantized weight is a ``{"q": int8|float8 [..., in, out],
+"s": f32 [..., out]}`` pytree node; ``models.llama._mm`` consumes either
+form, and the stacked-layer scan slices the nested leaves like any other.
+MoE expert weights stay bf16 for now (ragged_dot's group GEMM has no
+int8 path); the KV cache can independently be stored as float8_e4m3fn
+(scale-free direct cast, vLLM's fp8 KV cache approach) via
+``EngineConfig.kv_cache_dtype``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+WEIGHT_MODES = ("none", "int8", "fp8_e4m3")
+KV_CACHE_DTYPES = ("model", "float8_e4m3", "bfloat16")
+
+# the stacked-layer projection matrices worth quantizing ([L, in, out]
+# layout, contraction on axis -2); embeddings/norms/biases/router stay
+# high-precision (tiny, or quality-critical), expert stacks stay bf16
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "shared_gate", "shared_up", "shared_down")
+
+
+def _qdtype(mode: str):
+    if mode == "int8":
+        return jnp.int8, 127.0
+    if mode == "fp8_e4m3":
+        return jnp.float8_e4m3fn, 448.0
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def quantize_array(w: jnp.ndarray, mode: str) -> dict:
+    """Symmetric per-output-channel quantization of a [..., in, out]
+    matmul weight: scale = absmax over the contraction axis / dtype max."""
+    dt, qmax = _qdtype(mode)
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.maximum(absmax / qmax, 1e-12)
+    q = wf / scale
+    if mode == "int8":
+        q = jnp.clip(jnp.round(q), -127, 127)
+    return {"q": q.astype(dt), "s": scale.squeeze(-2).astype(jnp.float32)}
+
+
+def dequantize_array(qw: dict) -> jnp.ndarray:
+    return qw["q"].astype(jnp.float32) * qw["s"][..., None, :]
+
+
+def quantize_params(params: dict, cfg: ModelConfig, mode: str) -> dict:
+    """Quantize the serving-relevant projection weights in a params pytree
+    (pure function; the engine applies it before mesh placement so the
+    derived q/s leaves get their own shardings, parallel/mesh.py)."""
+    if mode in (None, "none"):
+        return params
+    if mode not in WEIGHT_MODES:
+        raise ValueError(f"quantization must be one of {WEIGHT_MODES}")
+    layers = dict(params["layers"])
+    for key in _QUANT_KEYS:
+        if key in layers and not isinstance(layers[key], dict):  # idempotent
+            layers[key] = quantize_array(layers[key], mode)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def kv_cache_dtype(cfg: ModelConfig, name: str):
+    """Resolve an EngineConfig.kv_cache_dtype name to a jnp dtype (None =
+    the model's own dtype)."""
+    if name in (None, "model"):
+        return None
+    if name == "float8_e4m3":
+        return jnp.float8_e4m3fn
+    if name == "bfloat16":
+        return jnp.bfloat16
+    raise ValueError(f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}")

@@ -137,9 +137,13 @@ def decode_attention_xla(
     Hkv, _, bs, _ = k_cache_layer.shape
     G = H // Hkv
     # gather pages -> [Hkv, B, M*bs, D] (no repeat_kv materialization:
-    # grouped-query einsum keeps kv heads shared)
+    # grouped-query einsum keeps kv heads shared). A quantized (fp8) cache
+    # casts back to the compute dtype here — XLA fuses the convert into
+    # the gather read, so HBM traffic stays at the narrow dtype's bytes.
     k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
     v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    if k.dtype != q.dtype:
+        k, v = k.astype(q.dtype), v.astype(q.dtype)
     qg = q.reshape(B, Hkv, G, D)
     scores = jnp.einsum("bkgd,kbtd->bkgt", qg * scale, k).astype(jnp.float32)
     positions = jnp.arange(M * bs)[None, :]  # [1, T]
@@ -254,6 +258,9 @@ def chunk_attention_with_cache_xla(
     G = H // Hkv
     k_hist = jnp.take(k_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
     v_hist = jnp.take(v_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
+    if k_hist.dtype != k_chunk.dtype:  # quantized cache: cast on read
+        k_hist = k_hist.astype(k_chunk.dtype)
+        v_hist = v_hist.astype(v_chunk.dtype)
     k_all = jnp.concatenate([k_hist, k_chunk.swapaxes(0, 1)], axis=1)  # [Hkv, S, D]
     v_all = jnp.concatenate([v_hist, v_chunk.swapaxes(0, 1)], axis=1)
     qg = q.reshape(T, Hkv, G, D)
@@ -289,7 +296,9 @@ def write_chunk_to_cache(
     pos = start_pos + jnp.arange(T)
     blk = block_table[pos // bs]
     off = pos % bs
-    return cache_layer.at[:, blk, off].set(chunk.swapaxes(0, 1))
+    return cache_layer.at[:, blk, off].set(
+        chunk.swapaxes(0, 1).astype(cache_layer.dtype)
+    )
 
 
 def write_decode_token_to_cache(
@@ -303,4 +312,6 @@ def write_decode_token_to_cache(
         block_tables, (positions // bs)[:, None], axis=1
     )[:, 0]
     off = positions % bs
-    return cache_layer.at[:, blk, off].set(token_kv.swapaxes(0, 1))
+    return cache_layer.at[:, blk, off].set(
+        token_kv.swapaxes(0, 1).astype(cache_layer.dtype)
+    )

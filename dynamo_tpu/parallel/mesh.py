@@ -96,27 +96,40 @@ _PARAM_SPECS = {
 }
 
 
+def _spec_for(prefix: str) -> P:
+    """Spec for a param path. Quantized weights (models/quant.py) nest
+    ``{"q", "s"}`` under the weight's path: q keeps the parent's spec
+    ([..., in, out] layout unchanged), s ([..., out], the contraction
+    axis dropped) keeps every parent axis except the second-to-last."""
+    if prefix in _PARAM_SPECS:
+        return _PARAM_SPECS[prefix]
+    parent = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+    if prefix.endswith(".q") and parent in _PARAM_SPECS:
+        return _PARAM_SPECS[parent]
+    if prefix.endswith(".s") and parent in _PARAM_SPECS:
+        ps = tuple(_PARAM_SPECS[parent])
+        return P(*ps[:-2], ps[-1])
+    return P()
+
+
 def param_sharding(mesh: Mesh) -> dict:
     """Pytree of NamedShardings matching the params structure."""
 
     def build(prefix: str, tree):
         if isinstance(tree, dict):
             return {k: build(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
-        spec = _PARAM_SPECS.get(prefix, P())
-        return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, _spec_for(prefix))
 
     return build
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the placement rules."""
-    builder = param_sharding(mesh)
 
     def walk(prefix: str, tree):
         if isinstance(tree, dict):
             return {k: walk(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
-        spec = _PARAM_SPECS.get(prefix, P())
-        return jax.device_put(tree, NamedSharding(mesh, spec))
+        return jax.device_put(tree, NamedSharding(mesh, _spec_for(prefix)))
 
     return walk("", params)
 

@@ -326,14 +326,20 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
 
     from ..models import llama
 
+    from ..models.quant import kv_cache_dtype, quantize_params
+
     mcfg = engine_cfg.model
     mesh = global_mesh(engine_cfg.mesh)
     mirror = StepMirror(mesh, mcfg)
     if params is None:
         params = llama.init_params(mcfg, jax.random.key(seed))
+    # same quantization as the leader: the mirrored jits must compile the
+    # identical program on identically-typed params
+    params = quantize_params(params, mcfg, engine_cfg.quantization)
     params = mirror.shard_params(params)
     k_cache, v_cache = mirror.init_cache(
-        engine_cfg.num_blocks, engine_cfg.block_size
+        engine_cfg.num_blocks, engine_cfg.block_size,
+        dtype=kv_cache_dtype(mcfg, engine_cfg.kv_cache_dtype),
     )
     logits = None
     logger.info("follower %d ready", jax.process_index())

@@ -98,18 +98,136 @@ def _subject_matches(pattern: str, subject: str) -> bool:
 
 
 class _WorkQueue:
-    """Durable-ish FIFO with ack + visibility-timeout redelivery
-    (JetStream work-queue semantics, ref nats_queue.py)."""
+    """Durable FIFO with ack + visibility-timeout redelivery (JetStream
+    work-queue semantics, ref nats_queue.py).
 
-    def __init__(self, name: str, redeliver_after: float = 30.0):
+    With ``wal_path``, every push/ack appends one fsync'd JSONL record, so
+    a hub restart replays unacked items instead of silently dropping
+    queued prefills (the reference gets this from JetStream's file-backed
+    streams). In-flight-at-crash items replay as ready — at-least-once,
+    like an expired visibility timeout. The log self-compacts once dead
+    records dominate."""
+
+    def __init__(
+        self,
+        name: str,
+        redeliver_after: float = 30.0,
+        wal_path: Optional[str] = None,
+    ):
         self.name = name
         self.redeliver_after = redeliver_after
-        self._ids = itertools.count(1)
         self._ready: asyncio.Queue[QueueItem] = asyncio.Queue()
         self._inflight: dict[int, tuple[QueueItem, float]] = {}
+        self._wal_path = wal_path
+        self._wal = None
+        self._dead_records = 0
+        self._fsync_pending = False
+        next_id = 1
+        if wal_path:
+            next_id = self._replay_wal()
+            self._wal = open(wal_path, "ab")
+        self._ids = itertools.count(next_id)
+
+    def _replay_wal(self) -> int:
+        """Load surviving (pushed, never acked) items; returns next id."""
+        import base64
+        import json
+        import os
+
+        max_id = 0
+        items: dict[int, bytes] = {}
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail write from a crash
+                    max_id = max(max_id, rec["id"])
+                    if rec["op"] == "push":
+                        items[rec["id"]] = base64.b64decode(rec["p"])
+                    else:  # ack
+                        items.pop(rec["id"], None)
+        for item_id in sorted(items):
+            self._ready.put_nowait(QueueItem(item_id, items[item_id]))
+        # start from a clean, compacted log
+        self._rewrite_wal(items)
+        return max_id + 1
+
+    def _rewrite_wal(self, items: dict[int, bytes]) -> None:
+        import base64
+        import json
+        import os
+
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for item_id in sorted(items):
+                rec = {"op": "push", "id": item_id,
+                       "p": base64.b64encode(items[item_id]).decode()}
+                f.write(json.dumps(rec).encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._wal_path)
+        self._dead_records = 0
+
+    def _wal_append(self, rec: dict, durable: bool = True) -> None:
+        """Append + flush; fsync is batched off-loop (one per event-loop
+        tick) so disk latency never stalls unrelated bus traffic. Acks
+        skip fsync entirely — losing one means a redelivery, not data
+        loss. Crash window: records flushed to the page cache but not yet
+        fsynced (one tick)."""
+        import json
+
+        self._wal.write(json.dumps(rec).encode() + b"\n")
+        self._wal.flush()
+        if durable:
+            self._schedule_fsync()
+
+    def _schedule_fsync(self) -> None:
+        import os
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            os.fsync(self._wal.fileno())
+            return
+        if self._fsync_pending:
+            return
+        self._fsync_pending = True
+
+        def _sync(fd=self._wal.fileno()):
+            try:
+                os.fsync(fd)
+            except OSError:
+                pass
+
+        def _done(_fut):
+            self._fsync_pending = False
+
+        loop.run_in_executor(None, _sync).add_done_callback(_done)
+
+    def _maybe_compact(self) -> None:
+        live = self.depth
+        if self._dead_records < 256 or self._dead_records < 4 * max(live, 1):
+            return
+        items = {i.id: i.payload for i in list(self._ready._queue)}  # type: ignore[attr-defined]
+        items.update({i.id: i.payload for i, _ in self._inflight.values()})
+        self._wal.close()
+        self._rewrite_wal(items)
+        self._wal = open(self._wal_path, "ab")
 
     def push(self, payload: bytes) -> int:
         item = QueueItem(next(self._ids), payload)
+        if self._wal is not None:
+            import base64
+
+            self._wal_append(
+                {"op": "push", "id": item.id,
+                 "p": base64.b64encode(payload).decode()}
+            )
         self._ready.put_nowait(item)
         return item.id
 
@@ -138,7 +256,13 @@ class _WorkQueue:
             self._ready.put_nowait(item)
 
     def ack(self, item_id: int) -> bool:
-        return self._inflight.pop(item_id, None) is not None
+        if self._inflight.pop(item_id, None) is None:
+            return False
+        if self._wal is not None:
+            self._wal_append({"op": "ack", "id": item_id}, durable=False)
+            self._dead_records += 2  # the push + this ack are both dead
+            self._maybe_compact()
+        return True
 
     def nack(self, item_id: int) -> bool:
         entry = self._inflight.pop(item_id, None)
@@ -167,9 +291,11 @@ class _ObjectEntry:
 
 
 class LocalBus:
-    """In-process bus implementation."""
+    """In-process bus implementation. ``data_dir`` enables write-ahead
+    logging of work queues (one JSONL per queue) so a hub restart doesn't
+    drop queued work — the JetStream-durability equivalent."""
 
-    def __init__(self):
+    def __init__(self, data_dir: Optional[str] = None):
         self._subs: list[Subscription] = []
         self._rr: dict[tuple[str, str], int] = {}  # queue-group round robin
         self._inboxes: dict[str, asyncio.Future] = {}
@@ -178,6 +304,11 @@ class LocalBus:
         self._objects: dict[str, dict[str, _ObjectEntry]] = {}
         # request handlers registered as service endpoints (fast path)
         self._handlers: dict[str, Callable[[Message], Awaitable[bytes]]] = {}
+        self._data_dir = data_dir
+        if data_dir:
+            import os
+
+            os.makedirs(data_dir, exist_ok=True)
 
     # ---- pub/sub ----
     def subscribe(self, subject: str, group: Optional[str] = None) -> Subscription:
@@ -262,7 +393,19 @@ class LocalBus:
     def work_queue(self, name: str, redeliver_after: float = 30.0) -> _WorkQueue:
         q = self._queues.get(name)
         if q is None:
-            q = self._queues[name] = _WorkQueue(name, redeliver_after)
+            wal = None
+            if self._data_dir:
+                import hashlib
+                import os
+
+                # short hash keeps distinct names distinct even when the
+                # readable prefix sanitizes identically ('a.b' vs 'a_b')
+                safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+                digest = hashlib.sha1(name.encode()).hexdigest()[:8]
+                wal = os.path.join(
+                    self._data_dir, f"queue-{safe}-{digest}.jsonl"
+                )
+            q = self._queues[name] = _WorkQueue(name, redeliver_after, wal_path=wal)
         return q
 
     # ---- object store ----

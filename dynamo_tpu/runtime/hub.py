@@ -47,9 +47,10 @@ class HubServer:
         bus: Optional[LocalBus] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        data_dir: Optional[str] = None,
     ):
         self.store = store or LocalStore()
-        self.bus = bus or LocalBus()
+        self.bus = bus or LocalBus(data_dir=data_dir)
         self._host, self._port = host, port
         self._server: Optional[asyncio.base_events.Server] = None
         self.address = ""

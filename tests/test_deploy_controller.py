@@ -1,0 +1,163 @@
+"""Reconcile controller: spec -> processes convergence (the operator
+controller role, ref dynamonimdeployment_controller.go)."""
+
+import time
+
+from dynamo_tpu.deploy import (
+    Autoscaling,
+    DeploymentController,
+    DynamoDeployment,
+    ServiceDeploymentSpec,
+)
+from dynamo_tpu.deploy.api_server import DeploymentStore
+
+
+class FakeProc:
+    def __init__(self):
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = -15
+
+    def crash(self, rc=1):
+        self.rc = rc
+
+
+class FakeSpawner:
+    def __init__(self):
+        self.calls = []
+        self.procs = {}
+
+    def __call__(self, name, svc, idx):
+        self.calls.append((name, svc.name, idx))
+        p = FakeProc()
+        self.procs[(name, svc.name, idx)] = p
+        return p
+
+
+def _dep(name="d1", replicas=2, autoscale=None):
+    return DynamoDeployment(
+        name=name,
+        services=[
+            ServiceDeploymentSpec(
+                name="worker", replicas=replicas,
+                autoscaling=autoscale or Autoscaling(),
+            )
+        ],
+    )
+
+
+def _store(tmp_path):
+    return DeploymentStore(str(tmp_path))
+
+
+def test_controller_spawns_and_scales(tmp_path):
+    store = _store(tmp_path)
+    store.put("d1", _dep(replicas=2).to_dict(), create=True)
+    sp = FakeSpawner()
+    ctl = DeploymentController(store, spawn=sp)
+    ctl.reconcile_once()
+    assert sorted(sp.calls) == [("d1", "worker", 0), ("d1", "worker", 1)]
+    # idempotent
+    ctl.reconcile_once()
+    assert len(sp.calls) == 2
+    # scale down to 1 kills the excess replica
+    store.put("d1", _dep(replicas=1).to_dict(), create=False)
+    ctl.reconcile_once()
+    assert sp.procs[("d1", "worker", 1)].terminated
+    assert not sp.procs[("d1", "worker", 0)].terminated
+    # status subresource reflects the converged state
+    st = store.get_status("d1")
+    assert st["services"]["worker"] == {"desired": 1, "ready": 1}
+    assert st["conditions"][0]["status"] == "True"
+
+
+def test_controller_restarts_crashed_with_backoff(tmp_path):
+    store = _store(tmp_path)
+    store.put("d1", _dep(replicas=1).to_dict(), create=True)
+    sp = FakeSpawner()
+    ctl = DeploymentController(store, spawn=sp, backoff_base=0.05)
+    ctl.reconcile_once()
+    assert len(sp.calls) == 1
+    sp.procs[("d1", "worker", 0)].crash()
+    ctl.reconcile_once()  # reaps; restart is delayed by backoff
+    assert len(sp.calls) == 1
+    assert ctl.stats["restarts"] == 1
+    st = store.get_status("d1")
+    assert st["conditions"][0]["status"] == "False"
+    time.sleep(0.06)
+    ctl.reconcile_once()
+    assert len(sp.calls) == 2  # respawned after backoff
+
+
+def test_controller_deletes_children_on_spec_delete(tmp_path):
+    store = _store(tmp_path)
+    store.put("d1", _dep(replicas=2).to_dict(), create=True)
+    sp = FakeSpawner()
+    ctl = DeploymentController(store, spawn=sp)
+    ctl.reconcile_once()
+    store.delete("d1")
+    ctl.reconcile_once()
+    assert all(p.terminated for p in sp.procs.values())
+    assert store.get_status("d1") is None  # status file removed with spec
+
+
+def test_controller_autoscaling_on_queue_depth(tmp_path):
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
+                       target_queue_depth=8)
+    store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    depth = {"v": 0}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"]
+    )
+    ctl.reconcile_once()
+    assert len([k for k in sp.procs]) == 1  # min_replicas
+    depth["v"] = 30  # ceil(30/8) = 4
+    ctl.reconcile_once()
+    ready = sum(1 for p in sp.procs.values() if p.rc is None)
+    assert ready == 4
+    depth["v"] = 0  # back to min
+    ctl.reconcile_once()
+    ready = sum(1 for p in sp.procs.values() if p.rc is None)
+    assert ready == 1
+
+
+def test_controller_skips_invalid_spec(tmp_path):
+    store = _store(tmp_path)
+    store.put("bad", {"name": "bad", "services": []}, create=True)
+    store.put("good", _dep("good", replicas=1).to_dict(), create=True)
+    sp = FakeSpawner()
+    ctl = DeploymentController(store, spawn=sp)
+    ctl.reconcile_once()  # must not raise
+    assert sp.calls == [("good", "worker", 0)]
+
+
+def test_real_subprocess_reconcile(tmp_path):
+    """Default spawner with a real (sleeping) child process."""
+    import sys
+
+    store = _store(tmp_path)
+    dep = DynamoDeployment(
+        name="real",
+        services=[ServiceDeploymentSpec(
+            name="sleeper", replicas=1,
+            command=[sys.executable, "-c", "import time; time.sleep(60)"],
+        )],
+    )
+    store.put("real", dep.to_dict(), create=True)
+    ctl = DeploymentController(store)
+    ctl.reconcile_once()
+    key = ("real", "sleeper", 0)
+    proc = ctl._replicas[key].proc
+    assert proc.poll() is None
+    store.delete("real")
+    ctl.reconcile_once()
+    assert key not in ctl._replicas
+    proc.wait(timeout=10)

@@ -1,0 +1,70 @@
+"""Model resolution (llm/hub.py — ref launch/dynamo-run/src/hub.rs)."""
+
+import os
+
+import pytest
+
+from dynamo_tpu.llm.hub import resolve_model_path
+
+
+def test_local_dir_passthrough(tmp_path):
+    assert resolve_model_path(str(tmp_path)) == str(tmp_path)
+
+
+def test_bad_id_rejected():
+    with pytest.raises(FileNotFoundError):
+        resolve_model_path("not-a-dir-and-not-a-repo-id")
+    with pytest.raises(FileNotFoundError):
+        resolve_model_path("too/many/slashes")
+
+
+def _seed_cache(tmp_path, repo="meta-llama/Llama-tiny", rev="abc123"):
+    repo_dir = tmp_path / f"models--{repo.replace('/', '--')}"
+    snap = repo_dir / "snapshots" / rev
+    snap.mkdir(parents=True)
+    (snap / "config.json").write_text("{}")
+    (snap / "model.safetensors").write_text("x")
+    (repo_dir / "refs").mkdir()
+    (repo_dir / "refs" / "main").write_text(rev)
+    return str(snap)
+
+
+def test_cache_snapshot_resolution(tmp_path):
+    snap = _seed_cache(tmp_path)
+    got = resolve_model_path("meta-llama/Llama-tiny", cache_dir=str(tmp_path))
+    assert got == snap
+
+
+def test_cache_prefers_pinned_main_ref(tmp_path):
+    old = _seed_cache(tmp_path, rev="oldrev")
+    # a newer-mtime snapshot exists but refs/main pins oldrev
+    stray = tmp_path / "models--meta-llama--Llama-tiny" / "snapshots" / "newrev"
+    stray.mkdir()
+    (stray / "config.json").write_text("{}")
+    got = resolve_model_path("meta-llama/Llama-tiny", cache_dir=str(tmp_path))
+    assert got == old
+
+
+def test_offline_miss_is_actionable(tmp_path, monkeypatch):
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    with pytest.raises(FileNotFoundError, match="HF_HUB_OFFLINE"):
+        resolve_model_path("org/never-cached", cache_dir=str(tmp_path))
+
+
+def test_download_call_shape(tmp_path, monkeypatch):
+    """A cache miss with network allowed delegates to snapshot_download."""
+    import huggingface_hub
+
+    calls = {}
+
+    def fake_download(repo_id, allow_patterns=None, cache_dir=None):
+        calls["repo"] = repo_id
+        calls["patterns"] = allow_patterns
+        return str(tmp_path / "dl")
+
+    monkeypatch.delenv("HF_HUB_OFFLINE", raising=False)
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake_download)
+    got = resolve_model_path("org/model", cache_dir=str(tmp_path))
+    assert got == str(tmp_path / "dl")
+    assert calls["repo"] == "org/model"
+    assert any("safetensors" in p for p in calls["patterns"])

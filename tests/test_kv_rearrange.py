@@ -108,8 +108,8 @@ def test_disagg_delivery_applies_regroup(run):
         # permute what the natural-order gather returns
         orig_extract = prefill_engine.prefill_extract
 
-        async def interleaved_extract(req, ctx, skip_blocks=0):
-            first, k, v = await orig_extract(req, ctx, skip_blocks)
+        async def interleaved_extract(req, ctx, skip_blocks=0, **kw):
+            first, k, v = await orig_extract(req, ctx, skip_blocks, **kw)
             if k is not None:
                 k = regroup_heads(k, tp=2, src_layout="blocked",
                                   dst_layout="interleaved")

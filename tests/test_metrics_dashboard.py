@@ -1,0 +1,57 @@
+"""Grafana dashboard artifact stays in sync with the metrics the code
+actually emits (deploy/metrics/ — ref deploy/metrics Grafana stack)."""
+
+import json
+import os
+import re
+
+import dynamo_tpu
+
+ROOT = os.path.join(os.path.dirname(dynamo_tpu.__file__), "deploy", "metrics")
+
+
+def _dashboard():
+    with open(os.path.join(ROOT, "grafana-dashboard.json")) as f:
+        return json.load(f)
+
+
+def test_dashboard_parses_and_has_panels():
+    dash = _dashboard()
+    assert dash["uid"] == "dynamo-tpu-serving"
+    assert len(dash["panels"]) >= 8
+    # every timeseries panel keeps one axis, a legend, and multi tooltips
+    for p in dash["panels"]:
+        if p["type"] == "timeseries":
+            assert p["options"]["legend"]["placement"] == "bottom"
+            assert p["options"]["tooltip"]["mode"] == "multi"
+
+
+def test_dashboard_metric_names_are_emitted_by_code():
+    """Every dynamo_tpu_* metric in a PromQL expr must appear in the HTTP
+    metrics renderer or the observability component's gauge set."""
+    from dynamo_tpu.http.metrics import Metrics
+
+    m = Metrics()
+    with m.inflight_guard("m", "chat"):
+        pass
+    m.observe_tokens("m", "output", 3)
+    emitted = set(re.findall(r"dynamo_tpu_[a-z_]+", m.render()))
+    # gauges from observability/component.py (rendered with the same prefix)
+    comp_src = open(
+        os.path.join(os.path.dirname(dynamo_tpu.__file__),
+                     "observability", "component.py")
+    ).read()
+    emitted |= {
+        "dynamo_tpu_" + name for name in re.findall(r'gauge\(\s*"([a-z_]+)"', comp_src)
+    }
+    dash_metrics = set()
+    for p in _dashboard()["panels"]:
+        for t in p.get("targets", []):
+            dash_metrics |= set(re.findall(r"dynamo_tpu_[a-z_]+", t["expr"]))
+    # strip histogram suffixes Prometheus adds
+    missing = {
+        d for d in dash_metrics
+        if d not in emitted
+        and re.sub(r"_(bucket|sum|count)$", "", d) not in emitted
+    }
+    assert not missing, missing

@@ -1,0 +1,141 @@
+"""Quantized serving: weight int8/fp8 + fp8 KV cache (models/quant.py).
+
+Parity discipline mirrors the reference's quantized-engine acceptance
+(FP8 70B workloads, docs/architecture.md:57-61): quantized logits must
+stay close to the full-precision model's, and the engine must serve
+end-to-end in every quantized mode.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import (
+    dequantize_array,
+    quantize_array,
+    quantize_params,
+)
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime import Context, collect
+
+CFG = ModelConfig.tiny(dtype="float32")
+PARAMS = llama.init_params(CFG, jax.random.key(11))
+
+
+@pytest.mark.parametrize("mode,tol", [("int8", 2e-2), ("fp8_e4m3", 8e-2)])
+def test_quantize_roundtrip_error_bounded(mode, tol):
+    w = jax.random.normal(jax.random.key(0), (4, 64, 32), jnp.float32) * 0.1
+    qw = quantize_array(w, mode)
+    assert qw["q"].shape == w.shape and qw["s"].shape == (4, 32)
+    back = dequantize_array(qw)
+    rel = np.abs(np.asarray(back - w)) / (np.abs(np.asarray(w)).max() + 1e-9)
+    assert rel.max() < tol
+
+
+def test_quantize_params_structure_and_selectivity():
+    qp = quantize_params(PARAMS, CFG, "int8")
+    assert qp["layers"]["wq"]["q"].dtype == jnp.int8
+    assert qp["layers"]["wq"]["s"].dtype == jnp.float32
+    # norms / embeddings stay full precision
+    assert qp["layers"]["attn_norm"].dtype == PARAMS["layers"]["attn_norm"].dtype
+    assert qp["embed"].dtype == PARAMS["embed"].dtype
+    # original pytree untouched (pure function)
+    assert not isinstance(PARAMS["layers"]["wq"], dict)
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8_e4m3"])
+def test_quantized_logits_parity(mode):
+    """dense_forward with quantized projections must track full precision:
+    high cosine similarity and strong greedy-argmax agreement."""
+    toks = jax.random.randint(jax.random.key(1), (24,), 0, CFG.vocab_size)
+    ref = np.asarray(llama.dense_forward(PARAMS, CFG, toks))
+    qp = quantize_params(PARAMS, CFG, mode)
+    got = np.asarray(llama.dense_forward(qp, CFG, toks))
+    cos = np.sum(ref * got, -1) / (
+        np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1) + 1e-9
+    )
+    assert cos.min() > 0.99, cos.min()
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree >= 0.9, agree
+
+
+def make_req(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0, seed=0),
+        eos_token_ids=[],
+    )
+
+
+@pytest.mark.parametrize("quant,kv_dt", [
+    ("int8", "model"),
+    ("none", "float8_e4m3"),
+    ("int8", "float8_e4m3"),
+])
+def test_engine_serves_quantized(run, quant, kv_dt):
+    """End-to-end generation in every quantized mode — prefill (chunked,
+    through the cast-on-read attention), decode windows, sampling."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=CFG, num_blocks=32, block_size=4, max_batch_size=2,
+            max_context=64, prefill_chunk=16,
+            quantization=quant, kv_cache_dtype=kv_dt,
+        )
+        engine = JaxEngine(cfg, params=PARAMS)
+        if kv_dt == "float8_e4m3":
+            assert engine.k_cache.dtype == jnp.float8_e4m3fn
+        outs = await collect(engine.generate(Context(make_req(range(10, 28)))))
+        toks = [t for o in outs for t in o.token_ids]
+        assert len(toks) == 6
+        # int8 weights on a tiny model track full precision closely enough
+        # that greedy decoding matches in practice; fp8 KV is lossier, so
+        # only require a completed, finite stream there
+        if quant == "int8" and kv_dt == "model":
+            ref_engine = JaxEngine(
+                EngineConfig(model=CFG, num_blocks=32, block_size=4,
+                             max_batch_size=2, max_context=64,
+                             prefill_chunk=16),
+                params=PARAMS,
+            )
+            ref = await collect(
+                ref_engine.generate(Context(make_req(range(10, 28))))
+            )
+            ref_toks = [t for o in ref for t in o.token_ids]
+            agree = np.mean([a == b for a, b in zip(toks, ref_toks)])
+            assert agree >= 0.5, (toks, ref_toks)
+            await ref_engine.close()
+        await engine.close()
+
+    run(main())
+
+
+def test_quantized_sharded_serving_matches_unsharded(run):
+    """int8 weights under a tp=2 mesh (derived q/s shardings) must produce
+    the same greedy stream as unsharded int8."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    async def main():
+        outs = {}
+        for mesh in (None, MeshConfig(tp=2)):
+            cfg = EngineConfig(
+                model=CFG, num_blocks=32, block_size=4, max_batch_size=2,
+                max_context=64, prefill_chunk=16, quantization="int8",
+                mesh=mesh,
+            )
+            engine = JaxEngine(cfg, params=PARAMS)
+            o = await collect(engine.generate(Context(make_req(range(30, 48)))))
+            outs[mesh is None] = [t for x in o for t in x.token_ids]
+            await engine.close()
+        assert outs[True] == outs[False]
+
+    run(main())
