@@ -151,6 +151,19 @@ class DeploymentController:
         for key in list(self._replicas):
             if key not in desired:
                 self._kill(key)
+        # drop per-slot crash/backoff state for slots that no longer exist
+        # (a deleted-and-recreated deployment must start fresh, not
+        # inherit the old slot's backoff) and status cache for deleted
+        # deployments (a recreate must rewrite its .status file)
+        for key in list(self._crashes):
+            if key not in desired:
+                self._crashes.pop(key, None)
+        for key in list(self._not_before):
+            if key not in desired:
+                self._not_before.pop(key, None)
+        for name in list(self._last_status):
+            if name not in deployments:
+                self._last_status.pop(name, None)
         now = time.monotonic()
         for key, svc in desired.items():
             if key in self._replicas or self._not_before.get(key, 0) > now:

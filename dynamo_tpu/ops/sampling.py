@@ -24,34 +24,46 @@ def sample_tokens(
     top_p: jnp.ndarray,  # [B] 1.0 => disabled
     top_k_max: int = 0,  # static cap for the top-k sort width (0 = full V)
 ) -> jnp.ndarray:  # [B] int32
+    """The hot paths are gated with lax.cond so a batch that needs none of
+    the machinery pays none of it: an all-greedy batch is one argmax, and
+    a filter-free sampled batch skips the full-vocab sort entirely (the
+    sort dominated fused decode-window time at V=32k before this —
+    tokens/s, not correctness, rides on these two conds)."""
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # temperature
+    def do_sample(scaled: jnp.ndarray) -> jnp.ndarray:
+        def apply_filters(scaled: jnp.ndarray) -> jnp.ndarray:
+            # top-k: mask everything below the k-th largest
+            kth = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [B]
+            sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
+            kth_val = jnp.take_along_axis(
+                sorted_desc, (kth - 1)[:, None], axis=1
+            )  # [B,1]
+            scaled = jnp.where(scaled < kth_val, NEG_INF, scaled)
+            # top-p (nucleus): keep smallest set with cumulative prob >= p
+            probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs_sorted, axis=-1)
+            inside = cum - probs_sorted < top_p[:, None]
+            thresh = jnp.min(
+                jnp.where(inside, sorted_desc, jnp.inf), axis=-1, keepdims=True
+            )
+            return jnp.where(scaled < thresh, NEG_INF, scaled)
+
+        needs_filter = jnp.any((top_k > 0) | (top_p < 1.0))
+        scaled = jax.lax.cond(needs_filter, apply_filters, lambda s: s, scaled)
+
+        def sample_one(key_data, row):
+            key = jax.random.wrap_key_data(key_data)
+            return jax.random.categorical(key, row)
+
+        sampled = jax.vmap(sample_one)(keys, scaled)
+        return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
     t = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / t
-
-    # top-k: mask everything below the k-th largest
-    kth = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [B]
-    sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
-    kth_val = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=1)  # [B,1]
-    scaled = jnp.where(scaled < kth_val, NEG_INF, scaled)
-
-    # top-p (nucleus): keep smallest set with cumulative prob >= top_p
-    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    # find threshold value: smallest logit still inside the nucleus
-    inside = cum - probs_sorted < top_p[:, None]  # keep while cumsum(before) < p
-    # threshold = min sorted value that is inside
-    thresh = jnp.min(jnp.where(inside, sorted_desc, jnp.inf), axis=-1, keepdims=True)
-    scaled = jnp.where(scaled < thresh, NEG_INF, scaled)
-
-    def sample_one(key_data, row):
-        key = jax.random.wrap_key_data(key_data)
-        return jax.random.categorical(key, row)
-
-    sampled = jax.vmap(sample_one)(keys, scaled)
-    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+    all_greedy = jnp.all(temperature <= 0.0)
+    return jax.lax.cond(all_greedy, lambda s: greedy, do_sample, scaled)
 
 
 def make_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:

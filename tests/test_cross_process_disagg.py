@@ -34,6 +34,11 @@ def _free_port() -> int:
 def _spawn(log_path: str, args: list[str]) -> subprocess.Popen:
     env = os.environ.copy()
     env.pop("XLA_FLAGS", None)
+    # CPU-only children must not touch the TPU relay at interpreter
+    # startup (the site hook registers the axon backend when this is
+    # set, and HANGS every new python if the relay is wedged)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     env["DYN_JAX_PLATFORM"] = "cpu"
     env["PYTHONPATH"] = REPO
     # log to files, not PIPE: an undrained pipe blocks the child once the

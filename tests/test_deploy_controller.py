@@ -107,6 +107,29 @@ def test_controller_deletes_children_on_spec_delete(tmp_path):
     assert store.get_status("d1") is None  # status file removed with spec
 
 
+def test_controller_delete_recreate_resets_state(tmp_path):
+    """A deleted-and-recreated deployment must get a fresh status file and
+    fresh crash/backoff slots (no inherited backoff)."""
+    store = _store(tmp_path)
+    store.put("d1", _dep(replicas=1).to_dict(), create=True)
+    sp = FakeSpawner()
+    ctl = DeploymentController(store, spawn=sp, backoff_base=60.0)
+    ctl.reconcile_once()
+    sp.procs[("d1", "worker", 0)].crash()
+    ctl.reconcile_once()  # reaped -> long backoff pending
+    assert ctl._not_before
+    store.delete("d1")
+    ctl.reconcile_once()
+    assert not ctl._not_before and not ctl._crashes
+    assert "d1" not in ctl._last_status
+    # recreate: spawns immediately (no inherited backoff), status rewritten
+    store.put("d1", _dep(replicas=1).to_dict(), create=True)
+    ctl.reconcile_once()
+    alive = [p for p in sp.procs.values() if p.rc is None]
+    assert len(alive) == 1
+    assert store.get_status("d1")["services"]["worker"]["ready"] == 1
+
+
 def test_controller_autoscaling_on_queue_depth(tmp_path):
     store = _store(tmp_path)
     auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,

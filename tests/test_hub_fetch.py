@@ -45,6 +45,22 @@ def test_cache_prefers_pinned_main_ref(tmp_path):
     assert got == old
 
 
+def test_torn_snapshot_without_weights_redownloads(tmp_path, monkeypatch):
+    """config.json alone (interrupted download) must NOT count as a cache
+    hit — serving it would mean random-init weights."""
+    import huggingface_hub
+
+    repo_dir = tmp_path / "models--org--m" / "snapshots" / "r1"
+    repo_dir.mkdir(parents=True)
+    (repo_dir / "config.json").write_text("{}")  # no safetensors
+    monkeypatch.delenv("HF_HUB_OFFLINE", raising=False)
+    monkeypatch.setattr(
+        huggingface_hub, "snapshot_download",
+        lambda repo_id, allow_patterns=None, cache_dir=None: str(tmp_path / "dl"),
+    )
+    assert resolve_model_path("org/m", cache_dir=str(tmp_path)) == str(tmp_path / "dl")
+
+
 def test_offline_miss_is_actionable(tmp_path, monkeypatch):
     monkeypatch.setenv("HF_HUB_OFFLINE", "1")
     with pytest.raises(FileNotFoundError, match="HF_HUB_OFFLINE"):

@@ -30,6 +30,10 @@ def _free_port() -> int:
 def _spawn(rank: int, coord_port: int, hub: str) -> subprocess.Popen:
     env = os.environ.copy()
     env.pop("XLA_FLAGS", None)  # the worker sets its own device count
+    # CPU-only workers must not touch the TPU relay at interpreter
+    # startup (site hook registers axon when this is set; a wedged
+    # relay then hangs every new python before main() runs)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = REPO
     return subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
