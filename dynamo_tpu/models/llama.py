@@ -341,9 +341,13 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     v = _mm(x, lp["wv"])
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-    q = q.reshape(x.shape[:-1] + (cfg.num_heads, cfg.head_dim))
-    k = k.reshape(x.shape[:-1] + (cfg.num_kv_heads, cfg.head_dim))
-    v = v.reshape(x.shape[:-1] + (cfg.num_kv_heads, cfg.head_dim))
+    # head counts derive from the projection width, not cfg: under a
+    # manual-tp shard_map (parallel/pp.py) lp holds per-device column
+    # shards, so this one function serves both global and tp-local views
+    D = cfg.head_dim
+    q = q.reshape(x.shape[:-1] + (q.shape[-1] // D, D))
+    k = k.reshape(x.shape[:-1] + (k.shape[-1] // D, D))
+    v = v.reshape(x.shape[:-1] + (v.shape[-1] // D, D))
     return q, k, v
 
 
@@ -372,7 +376,21 @@ def prefill(
     Supports chunked prefill and prefix-cache hits: ``history_len`` tokens
     are already in the cache and are attended to but not recomputed
     (the reference gets this from vLLM's chunked-prefill scheduler patch).
+
+    On a pp>1 mesh (dense models, divisible shapes) the layer loop runs
+    as a STAGED PIPELINE: microbatches flow through the pp stages via
+    ppermute so stages compute concurrently (parallel/pp.py), instead of
+    the scan all-gathering one stage's weights per step.
     """
+    if mesh is not None:
+        from ..parallel.pp import can_pipeline, pick_n_micro, pipelined_prefill
+
+        n_micro = pick_n_micro(mesh, tokens.shape[0])
+        if can_pipeline(mesh, cfg, tokens.shape[0], n_micro):
+            return pipelined_prefill(
+                params, cfg, tokens, block_table, history_len, valid_len,
+                k_cache, v_cache, mesh, n_micro, use_pallas=use_pallas,
+            )
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     T = tokens.shape[0]

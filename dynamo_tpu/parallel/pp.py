@@ -1,0 +1,198 @@
+"""Staged pipeline-parallel prefill: microbatches + ppermute over ``pp``.
+
+The stacked-layer weight sharding (parallel/mesh.py: every [L, ...] param
+leads with a ``pp``-sharded layer axis) distributes *memory*; this module
+adds distributed *execution*: a GPipe-style schedule where prefill
+microbatches flow through the pipeline stages over ICI, so all ``pp``
+stages compute concurrently instead of all-gathering one stage's weights
+per scan step. (The reference leans on its engines' Megatron-style PP for
+the same role; TPU-native it is a shard_map + collective-permute loop —
+"How to Scale Your Model"'s pipelining recipe.)
+
+Schedule: ``m`` microbatches of ``T/m`` tokens, ``m + pp - 1`` ticks. At
+tick ``t`` stage ``s`` processes microbatch ``t - s`` (when in range):
+runs its local layer block (a scan over L/pp layers against its local
+KV-cache shard — cache_sharding puts the layer axis on ``pp``, so stage
+KV is resident), then hands the activations to stage ``s+1`` via
+``lax.ppermute``. Stage 0 injects embeddings; the last stage collects
+hidden states. Causality across microbatches comes for free: microbatch
+``j`` passes stage ``s`` strictly before ``j+1`` arrives there, and its
+K/V are already scattered into the stage-local cache (write-before-attend,
+same invariant as llama.prefill).
+
+The shard_map is fully manual over the mesh, so tensor parallelism is
+carried explicitly Megatron-style inside each stage: column-parallel
+qkv/gate/up (local head / hidden shards), head-parallel attention on the
+tp-sharded kv cache, row-parallel wo/down with a psum over ``tp``. MoE
+models keep the existing scan path (expert dispatch inside a manual
+pipeline is a follow-up), as do shapes that don't divide evenly.
+
+Inactive ticks compute on garbage but scatter through an all-zeros block
+table, i.e. into the sacrificial trash block 0 — never-read by masking,
+the allocator's existing convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models.config import ModelConfig
+from .mesh import _spec_for
+
+
+def pick_n_micro(mesh, T: int) -> int:
+    """More microbatches shrink the pipeline bubble — fraction
+    (pp-1)/(n_micro+pp-1) — so prefer the largest multiple of pp that
+    still leaves MXU-worthy microbatches (>= 32 tokens each)."""
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    for mult in (8, 4, 2, 1):
+        cand = mult * pp
+        if T % cand == 0 and T // cand >= 32:
+            return cand
+    return pp
+
+
+def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
+    if mesh is None or "pp" not in mesh.axis_names or "tp" not in mesh.axis_names:
+        return False
+    pp = mesh.shape["pp"]
+    tp = mesh.shape["tp"]
+    return (
+        pp > 1
+        and not cfg.is_moe
+        and cfg.num_layers % pp == 0
+        and n_micro >= 1
+        and T % n_micro == 0
+        and (tp == 1 or (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
+                         and cfg.intermediate_size % tp == 0))
+    )
+
+
+def _layers_specs(layers: dict) -> dict:
+    """Full-manual in_specs for the layers subtree, mirroring the
+    placement rules (incl. derived q/s specs of quantized leaves)."""
+
+    def walk(prefix, tree):
+        if isinstance(tree, dict):
+            return {k: walk(f"{prefix}.{k}", v) for k, v in tree.items()}
+        return _spec_for(prefix)
+
+    return walk("layers", layers)
+
+
+def pipelined_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [T] padded chunk
+    block_table: jnp.ndarray,  # [M]
+    history_len: jnp.ndarray,  # scalar int32
+    valid_len: jnp.ndarray,  # scalar int32
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D]; layer axis pp-, head tp-sharded
+    v_cache: jnp.ndarray,
+    mesh,
+    n_micro: int,
+    use_pallas: bool = False,
+):
+    """Drop-in for llama.prefill's layer loop on a pp>1 mesh. Returns
+    (last-token logits [V], k_cache, v_cache)."""
+    from ..models import llama
+    from ..ops import attention as att
+
+    pp = mesh.shape["pp"]
+    tp = mesh.shape["tp"]
+    T = tokens.shape[0]
+    Tm = T // n_micro
+    D = cfg.head_dim
+    inv_freq = llama._rope_freqs(cfg)
+    scale = D**-0.5
+
+    # embeddings + final norm/head run under GSPMD outside the stage loop
+    x_all = params["embed"][tokens].reshape(n_micro, Tm, -1)
+    h_ax = "tp" if cfg.num_kv_heads % tp == 0 else None
+    cache_spec = P("pp", h_ax, None, None, None)
+
+    def stages(layers_local, kc_l, vc_l, x_all, table, hist, valid):
+        s = lax.axis_index("pp")
+        zero_table = jnp.zeros_like(table)  # trash-block writes when idle
+
+        def stage_block(x, mb_idx, kc_l, vc_l, active):
+            """This stage's L/pp layers on one microbatch (tp-local
+            shards; row-parallel projections psum over tp).
+
+            NOTE: this mirrors llama.prefill's layer body with the tp
+            reductions made explicit (llama._qkv is shared — it derives
+            head counts from the shard width); any change to the llama
+            layer body must be applied here too."""
+            start = hist + mb_idx * Tm
+            positions = start + jnp.arange(Tm)
+            mb_valid = jnp.clip(valid - mb_idx * Tm, 0, Tm)
+            tbl = jnp.where(active, table, zero_table)
+
+            def body(x, layer_in):
+                lp, kc, vc = layer_in
+                h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+                q, k, v = llama._qkv(lp, cfg, h)
+                q = llama.apply_rope(q, positions, inv_freq)
+                k = llama.apply_rope(k, positions, inv_freq)
+                kc = att.write_chunk_to_cache(kc, k, tbl, start)
+                vc = att.write_chunk_to_cache(vc, v, tbl, start)
+                o = att.chunk_attention_with_cache(
+                    q, k, v, kc, vc, tbl, start, mb_valid, scale,
+                    use_pallas=use_pallas,
+                )
+                x = x + lax.psum(llama._mm(o.reshape(Tm, -1), lp["wo"]), "tp")
+                h = llama.rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+                up = jax.nn.silu(llama._mm(h, lp["w_gate"])) * llama._mm(h, lp["w_up"])
+                x = x + lax.psum(llama._mm(up, lp["w_down"]), "tp")
+                return x, (kc, vc)
+
+            x, (kc_l, vc_l) = lax.scan(body, x, (layers_local, kc_l, vc_l))
+            return x, kc_l, vc_l
+
+        def tick(t, carry):
+            x_cur, kc_l, vc_l, out = carry
+            mb = t - s  # this stage's microbatch index this tick
+            active = (mb >= 0) & (mb < n_micro)
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+            # stage 0 reads its input fresh from the embeddings
+            inject = x_all[jnp.clip(t, 0, n_micro - 1)]
+            x_in = jnp.where(s == 0, inject, x_cur)
+            y, kc_l, vc_l = stage_block(x_in, mb_c, kc_l, vc_l, active)
+            # last stage collects its finished microbatch
+            out = lax.dynamic_update_index_in_dim(
+                out,
+                jnp.where(active & (s == pp - 1), y, out[mb_c]),
+                mb_c,
+                axis=0,
+            )
+            # hand activations downstream (ring permute; the wraparound
+            # edge feeds stage 0, which ignores it and re-injects)
+            x_next = lax.ppermute(y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return (x_next, kc_l, vc_l, out)
+
+        carry = (x_all[0], kc_l, vc_l, jnp.zeros_like(x_all))
+        _, kc_l, vc_l, out = lax.fori_loop(0, n_micro + pp - 1, tick, carry)
+        # finished hidden states live on the last stage; replicate them
+        out = lax.psum(jnp.where(s == pp - 1, out, 0.0), "pp")
+        return out, kc_l, vc_l
+
+    x_out, k_cache, v_cache = jax.shard_map(
+        stages,
+        mesh=mesh,
+        in_specs=(
+            _layers_specs(params["layers"]), cache_spec, cache_spec,
+            P(), P(), P(), P(),
+        ),
+        out_specs=(P(), cache_spec, cache_spec),
+        check_vma=False,
+    )(params["layers"], k_cache, v_cache, x_all, block_table,
+      jnp.asarray(history_len, jnp.int32), jnp.asarray(valid_len, jnp.int32))
+
+    x_flat = x_out.reshape(T, -1)
+    x_flat = llama.rms_norm(x_flat, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.clip(valid_len - 1, 0, T - 1)
+    logits = llama._logits(params, cfg, x_flat[last])
+    return logits, k_cache, v_cache
