@@ -77,6 +77,10 @@ class EngineConfig:
     # (PrefillWorker head_layout / KvDelivery.head_layout) and the decode
     # side regroups on delivery (ops/kv_rearrange.py; ref kv_rearrange)
     kv_head_layout: str = "blocked"
+    # decode layer loop: unrolled (default — in-place cache scatters, no
+    # scan-ys cache re-stack) vs lax.scan (faster compiles on very deep
+    # models, at a full extra KV-cache copy per step)
+    decode_layer_scan: bool = False
     # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
     # per-output-channel scales; halves decode's HBM weight streaming, the
     # ref's FP8 serving equivalent, docs/architecture.md:57-61)
@@ -762,6 +766,7 @@ class JaxEngine(AsyncEngine):
                 self._temps, self._top_ks, self._top_ps,
                 self.k_cache, self.v_cache,
                 n_steps=n, use_pallas=self.use_pallas,
+                unroll=not cfg.decode_layer_scan,
             )
             return toks
         toks, self.k_cache, self.v_cache = llama.decode_window(
@@ -781,6 +786,7 @@ class JaxEngine(AsyncEngine):
             n_steps=n,
             use_pallas=self.use_pallas,
             mesh=self.mesh,
+            unroll=not cfg.decode_layer_scan,
         )
         return np.asarray(jax.device_get(toks))
 

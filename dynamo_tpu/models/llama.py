@@ -428,33 +428,71 @@ def prefill(
 
 def _decode_body(
     params, cfg, tokens, positions, block_tables, seq_lens,
-    k_cache, v_cache, use_pallas, mesh=None,
+    k_cache, v_cache, use_pallas, mesh=None, unroll=True,
 ):
-    """Shared un-jitted decode forward (one token per sequence)."""
+    """Shared un-jitted decode forward (one token per sequence).
+
+    ``unroll=True`` (default) runs an UNROLLED python loop over layers
+    with static layer indices: the caches are updated by tiny in-place
+    scatters on the donated stacked arrays and read by static slices.
+    The scan variant threads the caches as scan xs/ys, and XLA
+    materializes the re-stacked ys — a full extra cache copy per decode
+    step (measured: a 2.15GB cache pair costs ~2.5GB of temp and
+    dominates step time; decode is supposed to stream WEIGHTS, not
+    copy the KV pool). Scan remains for compile-time-sensitive very
+    deep models (EngineConfig.decode_layer_scan)."""
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     B = tokens.shape[0]
     x = params["embed"][tokens]  # [B, E]
 
-    def body(carry, layer_in):
-        x = carry
-        lp, kc, vc = layer_in
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        kc = att.write_decode_token_to_cache(kc, k, block_tables, positions)
-        vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
-        o = att.decode_attention(
-            q, kc, vc, block_tables, seq_lens, scale,
-            use_pallas=use_pallas, mesh=mesh,
+    if unroll:
+        blk, off = att.decode_slot_indices(
+            block_tables, positions, k_cache.shape[3]
         )
-        x = x + _mm(o.reshape(B, -1), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h, mesh=mesh)
-        return x, (kc, vc)
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            # mixed basic+advanced indexing puts the advanced axes
+            # (blk, off) in front: the update value is [B, Hkv, D]
+            k_cache = k_cache.at[l, :, blk, off].set(
+                k.astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[l, :, blk, off].set(
+                v.astype(v_cache.dtype)
+            )
+            o = att.decode_attention(
+                q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
+                use_pallas=use_pallas, mesh=mesh,
+            )
+            x = x + _mm(o.reshape(B, -1), lp["wo"])
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(lp, cfg, h, mesh=mesh)
+    else:
+        def body(carry, layer_in):
+            x = carry
+            lp, kc, vc = layer_in
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(lp, cfg, h)
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            kc = att.write_decode_token_to_cache(kc, k, block_tables, positions)
+            vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
+            o = att.decode_attention(
+                q, kc, vc, block_tables, seq_lens, scale,
+                use_pallas=use_pallas, mesh=mesh,
+            )
+            x = x + _mm(o.reshape(B, -1), lp["wo"])
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(lp, cfg, h, mesh=mesh)
+            return x, (kc, vc)
 
-    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))
+        x, (k_cache, v_cache) = lax.scan(
+            body, x, (params["layers"], k_cache, v_cache)
+        )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)  # [B, V]
     return logits, k_cache, v_cache
@@ -462,7 +500,7 @@ def _decode_body(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_pallas", "mesh"),
+    static_argnames=("cfg", "use_pallas", "mesh", "unroll"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_step(
@@ -476,17 +514,18 @@ def decode_step(
     v_cache: jnp.ndarray,
     use_pallas: bool = False,
     mesh=None,
+    unroll: bool = True,
 ):
     """One continuous-batching decode step for all active sequences."""
     return _decode_body(
         params, cfg, tokens, positions, block_tables, seq_lens,
-        k_cache, v_cache, use_pallas, mesh,
+        k_cache, v_cache, use_pallas, mesh, unroll,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "mesh"),
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_window(
@@ -506,6 +545,7 @@ def decode_window(
     n_steps: int = 1,
     use_pallas: bool = False,
     mesh=None,
+    unroll: bool = True,
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -520,7 +560,7 @@ def decode_window(
         tokens, positions, seq_lens, steps, k_cache, v_cache = carry
         logits, k_cache, v_cache = _decode_body(
             params, cfg, tokens, positions, block_tables, seq_lens,
-            k_cache, v_cache, use_pallas, mesh,
+            k_cache, v_cache, use_pallas, mesh, unroll,
         )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)

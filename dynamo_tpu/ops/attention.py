@@ -336,17 +336,27 @@ def write_chunk_to_cache(
     )
 
 
+def decode_slot_indices(
+    block_tables: jnp.ndarray,  # [B, M]
+    positions: jnp.ndarray,  # [B]
+    block_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(physical block, in-block offset) of each sequence's write slot —
+    the one slot-mapping convention, shared by the scan-path writer below
+    and the unrolled decode loop's in-place scatters (models/llama.py)."""
+    blk = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1
+    )[:, 0]
+    return blk, positions % block_size
+
+
 def write_decode_token_to_cache(
     cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D]
     token_kv: jnp.ndarray,  # [B, Hkv, D]
     block_tables: jnp.ndarray,  # [B, M]
     positions: jnp.ndarray,  # [B] absolute position of the new token
 ) -> jnp.ndarray:
-    bs = cache_layer.shape[2]
-    blk = jnp.take_along_axis(
-        block_tables, (positions // bs)[:, None], axis=1
-    )[:, 0]
-    off = positions % bs
+    blk, off = decode_slot_indices(block_tables, positions, cache_layer.shape[2])
     return cache_layer.at[:, blk, off].set(
         token_kv.swapaxes(0, 1).astype(cache_layer.dtype)
     )

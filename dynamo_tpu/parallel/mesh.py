@@ -112,13 +112,29 @@ def _spec_for(prefix: str) -> P:
     return P()
 
 
+def spec_tree(tree, prefix: str = "") -> dict:
+    """PartitionSpec pytree for a params subtree per the placement rules
+    (the one walk; param_sharding/shard_params/pp all consume it)."""
+    if isinstance(tree, dict):
+        return {
+            k: spec_tree(v, f"{prefix}.{k}" if prefix else k)
+            for k, v in tree.items()
+        }
+    return _spec_for(prefix)
+
+
 def param_sharding(mesh: Mesh) -> dict:
     """Pytree of NamedShardings matching the params structure."""
 
     def build(prefix: str, tree):
-        if isinstance(tree, dict):
-            return {k: build(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
-        return NamedSharding(mesh, _spec_for(prefix))
+        specs = spec_tree(tree, prefix)
+
+        def wrap(node):
+            if isinstance(node, dict):
+                return {k: wrap(v) for k, v in node.items()}
+            return NamedSharding(mesh, node)
+
+        return wrap(specs)
 
     return build
 
@@ -126,12 +142,12 @@ def param_sharding(mesh: Mesh) -> dict:
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the placement rules."""
 
-    def walk(prefix: str, tree):
-        if isinstance(tree, dict):
-            return {k: walk(f"{prefix}.{k}" if prefix else k, v) for k, v in tree.items()}
-        return jax.device_put(tree, NamedSharding(mesh, _spec_for(prefix)))
+    def walk(leafs, specs):
+        if isinstance(leafs, dict):
+            return {k: walk(v, specs[k]) for k, v in leafs.items()}
+        return jax.device_put(leafs, NamedSharding(mesh, specs))
 
-    return walk("", params)
+    return walk(params, spec_tree(params))
 
 
 def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:

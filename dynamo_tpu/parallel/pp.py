@@ -40,19 +40,24 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
-from .mesh import _spec_for
+from .mesh import spec_tree
+
+
+MIN_MICRO_TOKENS = 32  # below this, microbatch matmuls waste the MXU
 
 
 def pick_n_micro(mesh, T: int) -> int:
     """More microbatches shrink the pipeline bubble — fraction
     (pp-1)/(n_micro+pp-1) — so prefer the largest multiple of pp that
-    still leaves MXU-worthy microbatches (>= 32 tokens each)."""
+    still leaves MXU-worthy microbatches. Returns 0 when no multiple
+    meets the floor: the chunk is too small to pipeline profitably and
+    the caller should keep the scan path."""
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     for mult in (8, 4, 2, 1):
         cand = mult * pp
-        if T % cand == 0 and T // cand >= 32:
+        if T % cand == 0 and T // cand >= MIN_MICRO_TOKENS:
             return cand
-    return pp
+    return 0
 
 
 def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
@@ -66,21 +71,16 @@ def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
         and cfg.num_layers % pp == 0
         and n_micro >= 1
         and T % n_micro == 0
+        and n_micro % pp == 0
         and (tp == 1 or (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                          and cfg.intermediate_size % tp == 0))
     )
 
 
 def _layers_specs(layers: dict) -> dict:
-    """Full-manual in_specs for the layers subtree, mirroring the
-    placement rules (incl. derived q/s specs of quantized leaves)."""
-
-    def walk(prefix, tree):
-        if isinstance(tree, dict):
-            return {k: walk(f"{prefix}.{k}", v) for k, v in tree.items()}
-        return _spec_for(prefix)
-
-    return walk("layers", layers)
+    """Full-manual in_specs for the layers subtree: exactly the placement
+    rules' spec walk (incl. derived q/s specs of quantized leaves)."""
+    return spec_tree(layers, "layers")
 
 
 def pipelined_prefill(

@@ -234,6 +234,28 @@ def test_unservable_request_finishes_instead_of_hanging(run):
     run(main())
 
 
+def test_decode_unrolled_matches_scan(run, engine_cfg):
+    """The unrolled decode layer loop (in-place cache scatters) must
+    produce the exact token stream of the scan variant."""
+
+    async def main():
+        from dataclasses import replace
+
+        outs = {}
+        for scan in (False, True):
+            cfg = replace(engine_cfg, decode_layer_scan=scan)
+            engine = JaxEngine(cfg, seed=0)
+            # greedy: the two variants are separate XLA compilations, so
+            # last-ulp logit differences are possible; argmax is robust
+            req = make_req(range(40, 52), max_tokens=7)
+            out = await collect(engine.generate(Context(req)))
+            outs[scan] = [t for o in out for t in o.token_ids]
+            await engine.close()
+        assert outs[False] == outs[True]
+
+    run(main())
+
+
 def test_commit_respects_written_horizon(run, engine_cfg, shared_engine):
     """A block whose last KV row is the just-sampled (not-yet-written)
     token must NOT enter the prefix-reuse pool: a concurrent prefix hit

@@ -102,8 +102,16 @@ def test_pipelined_chunked_continuation_and_ragged_tail():
 
 def test_prefill_dispatches_to_pipeline():
     """llama.prefill on a pp>1 mesh must route through the pipeline and
-    produce identical logits to the no-mesh path."""
-    mesh, params, toks, table, kc, vc, hist, valid = _setup(MeshConfig(pp=2))
+    produce identical logits to the no-mesh path. T=64 clears the
+    microbatch-size floor (pick_n_micro returns 0 below it — tiny chunks
+    stay on the scan path)."""
+    from dynamo_tpu.parallel.pp import pick_n_micro
+
+    mesh, params, toks, table, kc, vc, hist, valid = _setup(
+        MeshConfig(pp=2), T=64
+    )
+    assert pick_n_micro(mesh, 64) == 2
+    assert pick_n_micro(mesh, 16) == 0  # below the floor -> scan path
     ref_logits, _, _ = _reference(params, toks, table, kc, vc, hist, valid)
     sp = shard_params(params, mesh)
     csh = cache_sharding(mesh, CFG)
