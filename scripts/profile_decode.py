@@ -1,0 +1,127 @@
+"""Profile decode-step components on the local device (TPU).
+
+Breaks the bench's 23ms/step into: full window, XLA-attention window,
+isolated paged attention (one layer), isolated no-attention model body,
+and sampling — to find where the roofline gap lives.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops import attention as att
+from dynamo_tpu.ops.paged_attention_pallas import paged_decode_attention
+
+cfg = ModelConfig(
+    vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=2048, dtype="bfloat16",
+)
+B, BLOCK, CTX = 16, 16, 2048
+M = CTX // BLOCK
+NUM_BLOCKS = B * M + 1
+
+params = llama.init_params(cfg, jax.random.key(0))
+k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+print("cache shape", k_cache.shape, k_cache.dtype)
+
+tables = jnp.asarray(np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M))
+seq_len0 = CTX // 2
+seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
+tokens = jnp.zeros(B, jnp.int32)
+positions = jnp.full((B,), seq_len0, jnp.int32)
+seeds = jnp.zeros(B, jnp.int32)
+steps0 = jnp.zeros(B, jnp.int32)
+temps = jnp.zeros(B, jnp.float32)
+top_ks = jnp.zeros(B, jnp.int32)
+top_ps = jnp.ones(B, jnp.float32)
+
+
+def timeit(name, fn, iters=20):
+    fn()  # compile
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:48s} {dt*1e3:9.3f} ms", flush=True)
+    return dt
+
+
+# 1. isolated paged decode attention, one layer
+q = jnp.zeros((B, cfg.num_heads, cfg.head_dim), jnp.bfloat16)
+kl, vl = k_cache[0], v_cache[0]
+scale = cfg.head_dim ** -0.5
+
+t_att = timeit(
+    "paged_decode_attention (1 layer, pallas)",
+    jax.jit(lambda: paged_decode_attention(q, kl, vl, tables, seq_lens, scale)),
+)
+
+t_att_xla = timeit(
+    "decode_attention XLA fallback (1 layer)",
+    jax.jit(lambda: att.decode_attention(
+        q, kl, vl, tables, seq_lens, scale, use_pallas=False)),
+)
+
+# 2. matmul-only body: same weights, no attention/cache
+def mm_only(x):
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        h = llama.rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q3, k3, v3 = llama._qkv(lp, cfg, h)
+        o = q3.reshape(B, -1)
+        x = x + o @ lp["wo"]
+        h = llama.rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._ffn(lp, cfg, h)
+    x = llama.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return llama._logits(params, cfg, x)
+
+x0 = jnp.zeros((B, cfg.hidden_size), jnp.bfloat16)
+t_mm = timeit("matmul-only body (16 layers + logits)", jax.jit(lambda: mm_only(x0)))
+
+# 3. one full decode step (window=1), pallas + xla
+for use_pallas, tag in ((True, "pallas"), (False, "xla")):
+    kc, vc = jnp.copy(k_cache), jnp.copy(v_cache)
+
+    def one(kc=kc, vc=vc, up=use_pallas):
+        logits, kc2, vc2 = llama.decode_step(
+            params, cfg, tokens, positions, tables, seq_lens,
+            jnp.copy(kc), jnp.copy(vc), use_pallas=up,
+        )
+        return logits
+
+    timeit(f"decode_step window=1 ({tag}) incl cache copy", one, iters=10)
+
+# 4. full window=16 via decode_window (amortized per step)
+for W in (8, 16, 32):
+    kc, vc = jnp.copy(k_cache), jnp.copy(v_cache)
+
+    def win(kc=kc, vc=vc, W=W):
+        toks, kc2, vc2 = llama.decode_window(
+            params, cfg, tokens, positions, tables, seq_lens,
+            seeds, steps0, temps, top_ks, top_ps,
+            jnp.copy(kc), jnp.copy(vc), n_steps=W, use_pallas=True,
+        )
+        return toks
+
+    dt = timeit(f"decode_window n={W} (pallas, incl cache copy)", win, iters=5)
+    print(f"    -> per-step {dt/W*1e3:.3f} ms, per-chip tok/s {W*B/dt:.0f} (incl copy overhead)")
+
+# 5. sampling cost
+from dynamo_tpu.ops.sampling import make_keys, sample_tokens
+logits = jnp.zeros((B, cfg.vocab_size), jnp.bfloat16)
+keys = make_keys(seeds, steps0)
+timeit("sample_tokens (greedy temps=0)", jax.jit(lambda: sample_tokens(logits, keys, temps, top_ks, top_ps)))
+
+print("\nbytes: params", sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params)) / 1e9,
+      "GB; kv pair", 2 * k_cache.size * k_cache.dtype.itemsize / 1e9, "GB")
