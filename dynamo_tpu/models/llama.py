@@ -428,7 +428,7 @@ def prefill(
 
 def _decode_body(
     params, cfg, tokens, positions, block_tables, seq_lens,
-    k_cache, v_cache, use_pallas, mesh=None, unroll=True,
+    k_cache, v_cache, use_pallas, mesh=None, unroll=True, interpret=False,
 ):
     """Shared un-jitted decode forward (one token per sequence).
 
@@ -446,16 +446,53 @@ def _decode_body(
     B = tokens.shape[0]
     x = params["embed"][tokens]  # [B, E]
 
+    def layer_tail(x, lp, o):
+        x = x + _mm(o.reshape(B, -1), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        return x + _ffn(lp, cfg, h, mesh=mesh)
+
+    def layer_qkv(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        return q, k, v
+
     if unroll:
         blk, off = att.decode_slot_indices(
             block_tables, positions, k_cache.shape[3]
         )
+    merged = unroll and use_pallas and mesh is None
+    if merged:
+        # MERGED one-write path (TPU single-device): attention handles the
+        # current token out-of-cache (flash merge over the stats-emitting
+        # paged kernel), so the cache sees ONE in-place Pallas append per
+        # step instead of 2L XLA scatters — XLA will not update scatters
+        # of this shape in place; each one copied the full cache
+        # (measured ~0.55 GB/copy on the 1B bench config; the reference's
+        # equivalent split is vLLM's reshape_and_cache + paged attention).
+        from ..ops.kv_cache_update_pallas import kv_cache_append
+
+        hist_lens = seq_lens - 1  # cache contents EXCLUDE the new token
+        k_news, v_news = [], []
         for l in range(cfg.num_layers):
             lp = jax.tree.map(lambda a: a[l], params["layers"])
-            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            q, k, v = layer_qkv(x, lp)
+            k_news.append(k)
+            v_news.append(v)
+            o = att.decode_attention_merged(
+                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+                scale, interpret=interpret,
+            )
+            x = layer_tail(x, lp, o)
+        k_cache, v_cache = kv_cache_append(
+            jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
+            interpret=interpret,
+        )
+    elif unroll:
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            q, k, v = layer_qkv(x, lp)
             # mixed basic+advanced indexing puts the advanced axes
             # (blk, off) in front: the update value is [B, Hkv, D]
             k_cache = k_cache.at[l, :, blk, off].set(
@@ -468,26 +505,19 @@ def _decode_body(
                 q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
                 use_pallas=use_pallas, mesh=mesh,
             )
-            x = x + _mm(o.reshape(B, -1), lp["wo"])
-            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _ffn(lp, cfg, h, mesh=mesh)
+            x = layer_tail(x, lp, o)
     else:
         def body(carry, layer_in):
             x = carry
             lp, kc, vc = layer_in
-            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = _qkv(lp, cfg, h)
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            q, k, v = layer_qkv(x, lp)
             kc = att.write_decode_token_to_cache(kc, k, block_tables, positions)
             vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
             o = att.decode_attention(
                 q, kc, vc, block_tables, seq_lens, scale,
                 use_pallas=use_pallas, mesh=mesh,
             )
-            x = x + _mm(o.reshape(B, -1), lp["wo"])
-            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _ffn(lp, cfg, h, mesh=mesh)
+            x = layer_tail(x, lp, o)
             return x, (kc, vc)
 
         x, (k_cache, v_cache) = lax.scan(
@@ -500,7 +530,7 @@ def _decode_body(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_pallas", "mesh", "unroll"),
+    static_argnames=("cfg", "use_pallas", "mesh", "unroll", "interpret"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_step(
@@ -515,17 +545,18 @@ def decode_step(
     use_pallas: bool = False,
     mesh=None,
     unroll: bool = True,
+    interpret: bool = False,
 ):
     """One continuous-batching decode step for all active sequences."""
     return _decode_body(
         params, cfg, tokens, positions, block_tables, seq_lens,
-        k_cache, v_cache, use_pallas, mesh, unroll,
+        k_cache, v_cache, use_pallas, mesh, unroll, interpret,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll"),
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll", "interpret"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_window(
@@ -546,6 +577,7 @@ def decode_window(
     use_pallas: bool = False,
     mesh=None,
     unroll: bool = True,
+    interpret: bool = False,
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -560,7 +592,7 @@ def decode_window(
         tokens, positions, seq_lens, steps, k_cache, v_cache = carry
         logits, k_cache, v_cache = _decode_body(
             params, cfg, tokens, positions, block_tables, seq_lens,
-            k_cache, v_cache, use_pallas, mesh, unroll,
+            k_cache, v_cache, use_pallas, mesh, unroll, interpret,
         )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)

@@ -159,6 +159,60 @@ def paged_decode_attention_sharded(
     )
 
 
+def decode_attention_merged(
+    q: jnp.ndarray,  # [B, H, D] current token's queries
+    k_new: jnp.ndarray,  # [B, Hkv, D] current token's key (rope'd)
+    v_new: jnp.ndarray,  # [B, Hkv, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D] — current token NOT written
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] int32
+    hist_lens: jnp.ndarray,  # [B] int32 tokens in cache (EXCLUDES current)
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:  # [B, H, D]
+    """Decode attention with the current token handled OUT of the cache.
+
+    History attention comes from the in-repo paged kernel with softmax
+    stats (m, l); the current token's contribution — scores s_new = q.k_new
+    and value v_new — is folded in with the flash-decoding merge:
+
+        m_f = max(m_h, s_new)
+        out = (l_h*exp(m_h-m_f)*o_h + exp(s_new-m_f)*v_new)
+              / (l_h*exp(m_h-m_f) + exp(s_new-m_f))
+
+    Why: it removes the write-before-attend dependency, so the decode
+    step batches ALL layers' cache writes into one in-place Pallas append
+    (ops/kv_cache_update_pallas) instead of 2L XLA scatters that each
+    copy the cache (the reference's reshape_and_cache + paged-attention
+    split does the same on GPU). hist_lens == 0 rows degenerate cleanly
+    to out = v_new (l_h = 0, m_h = -inf).
+    """
+    from .paged_attention_pallas import paged_decode_attention
+
+    B, H, D = q.shape
+    Hkv = k_cache_layer.shape[0]
+    G = H // Hkv
+    o_h, m_h, l_h = paged_decode_attention(
+        q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
+        return_stats=True, interpret=interpret,
+    )  # o: [B, H, D]; m, l: [B, Hkv, G]
+    qg = q.reshape(B, Hkv, G, D)
+    s_new = jnp.einsum(
+        "bkgd,bkd->bkg", qg.astype(jnp.float32) * scale,
+        k_new.astype(jnp.float32),
+    )  # [B, Hkv, G]
+    m_f = jnp.maximum(m_h, s_new)
+    alpha = jnp.exp(m_h - m_f)  # exp(-inf - s) = 0 handles empty history
+    beta = jnp.exp(s_new - m_f)
+    o_hg = o_h.reshape(B, Hkv, G, D).astype(jnp.float32)
+    num = (l_h * alpha)[..., None] * o_hg + beta[..., None] * v_new.astype(
+        jnp.float32
+    )[:, :, None, :]
+    den = l_h * alpha + beta
+    out = num / den[..., None]
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def decode_attention_xla(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence
     k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]

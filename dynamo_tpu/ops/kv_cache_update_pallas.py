@@ -1,0 +1,128 @@
+"""Pallas TPU kernel: in-place KV-cache page writes.
+
+XLA lowers the engine's cache writes — ``cache.at[l, :, blk, off].set(v)``
+(decode) and ``cache.at[:, blk, off].set(chunk)`` (prefill) — to scatter
+ops it will NOT update in place: measured on v5e AND CPU, every such
+write copies the whole cache array (the reference hits the same wall on
+GPU and solves it with vLLM's reshape_and_cache CUDA kernel; vLLM's TPU
+port ships an equivalent kv_cache_update Pallas kernel).
+
+This kernel is that equivalent, for the stacked-layer head-major layout
+``[L, Hkv, N, bs, D]``: ``input_output_aliases`` pins the output buffer
+to the input cache, so only the touched page tiles move. Per grid step
+(l, b) the pipeline DMAs the target page tile [Hkv, bs, D] in, the
+kernel overwrites row ``off[b]``, and the pipeline writes the tile back
+— a read-modify-write of 64 KB per (layer, seq) instead of a copy of
+the full multi-GB cache.
+
+Decode usage (one call per fused step, all layers at once): the layer
+loop STACKS each layer's new-token K/V (tiny [L, B, Hkv, D]) instead of
+scattering into the big cache 2L times per step; attention handles the
+current token out-of-cache (ops/attention.decode_attention_merged) so
+nothing needs the write until the step ends.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _append_kernel(
+    # scalar prefetch
+    blk_ref,  # [B] int32 physical page per sequence (SMEM)
+    off_ref,  # [B] int32 row within the page (SMEM)
+    # inputs
+    k_new_ref,  # [1, 1, Hkv, D] layer l, sequence b
+    v_new_ref,  # [1, 1, Hkv, D]
+    k_page_ref,  # [1, Hkv, 1, bs, D] aliased page tile of k_cache
+    v_page_ref,  # [1, Hkv, 1, bs, D] aliased page tile of v_cache
+    # outputs (aliased)
+    k_out_ref,  # [1, Hkv, 1, bs, D]
+    v_out_ref,  # [1, Hkv, 1, bs, D]
+):
+    b = pl.program_id(1)
+    off = off_ref[b]
+    # pass the tile through, then overwrite row `off` of every head
+    k_out_ref[...] = k_page_ref[...]
+    v_out_ref[...] = v_page_ref[...]
+    kn = k_new_ref[0, 0].astype(k_out_ref.dtype)  # [Hkv, D]
+    vn = v_new_ref[0, 0].astype(v_out_ref.dtype)
+    k_out_ref[0, :, 0, pl.ds(off, 1), :] = kn[:, None, :]
+    v_out_ref[0, :, 0, pl.ds(off, 1), :] = vn[:, None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(2, 3))
+def kv_cache_append(
+    k_new: jnp.ndarray,  # [L, B, Hkv, D] this step's keys, all layers
+    v_new: jnp.ndarray,  # [L, B, Hkv, D]
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D] donated
+    v_cache: jnp.ndarray,  # [L, Hkv, N, bs, D] donated
+    blk: jnp.ndarray,  # [B] int32 physical page of each sequence's slot
+    off: jnp.ndarray,  # [B] int32 row within that page
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write one new token per sequence into both caches, in place.
+
+    Sequences sharing a physical page (cannot happen for live decode
+    slots — the allocator gives every sequence its own tail page) would
+    race, so callers must pass distinct ``blk`` entries for real rows;
+    padded rows may all point at the sacrificial page 0 with distinct
+    semantics handled by masking (never read).
+    """
+    L, B, Hkv, D = k_new.shape
+    bs = k_cache.shape[3]
+
+    if interpret:
+        # CPU path: the aliased-page pipeline is a Mosaic feature; tests
+        # use the same scatter the kernel replaces (bit-identical result)
+        lidx = jnp.arange(L)[:, None]
+        bidx = jnp.arange(B)[None, :]
+        k_cache = k_cache.at[lidx, :, blk[bidx], off[bidx]].set(
+            k_new.astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[lidx, :, blk[bidx], off[bidx]].set(
+            v_new.astype(v_cache.dtype)
+        )
+        return k_cache, v_cache
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(L, B),
+        in_specs=[
+            pl.BlockSpec((1, 1, Hkv, D), lambda l, b, blk, off: (l, b, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, D), lambda l, b, blk, off: (l, b, 0, 0)),
+            pl.BlockSpec(
+                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+            ),
+        ],
+    )
+    return pl.pallas_call(
+        _append_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # +2 for the scalar-prefetch args: pallas numbers aliases over the
+        # FULL operand list including prefetch scalars
+        input_output_aliases={4: 0, 5: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(blk, off, k_new, v_new, k_cache, v_cache)

@@ -6,20 +6,27 @@ they are native Mosaic/TPU kernels.
 
 Design (per SURVEY.md §7 "hard parts" — this is the decode make-or-break):
 
-  * grid = (batch, kv_heads, max_pages): one KV page per grid step.
+  * grid = (batch, kv_heads, superblocks): one superblock = ``P``
+    consecutive logical KV pages per grid step. A single page is a tiny
+    ``[block_size, head_dim]`` tile (4 KB at bs=16/D=128/bf16) — far too
+    small to amortize per-grid-step pipeline overhead or fill the MXU, and
+    measured 80x off the HBM floor on v5e. Fetching P pages per step and
+    fusing them into ONE ``[Gp, P*bs]`` dot fixes both: P parallel
+    double-buffered DMA streams (the cache is passed P times with
+    per-page ``index_map``s — the BlockSpec pipeline machinery runs one
+    stream per input) and an MXU-shaped score matrix.
   * ``PrefetchScalarGridSpec`` prefetches the block table and sequence
-    lengths so the BlockSpec ``index_map`` can turn the *logical* page
-    number into the *physical* page index — the pipeline then DMAs exactly
-    that ``[block_size, head_dim]`` tile from HBM into VMEM with automatic
-    double-buffering. No gather of the whole table, no materialized
-    [B, M*bs, H, D] intermediate (what the XLA fallback does).
+    lengths so each ``index_map`` can turn its *logical* page number into
+    the *physical* page index. No gather of the whole table, no
+    materialized [B, M*bs, H, D] intermediate (what the XLA fallback does).
   * pages past a sequence's length map to the sequence's *last valid*
     page — consecutive identical indices make the pipeline skip the
     re-fetch, so ragged sequences cost bandwidth proportional to their
-    true length, and compute for them is predicated off with ``pl.when``.
+    true length, and compute for them is predicated off with ``pl.when``
+    (whole superblocks) or masking (page tails).
   * flash-attention-style online softmax in fp32 VMEM scratch
-    (running max / normalizer / accumulator) across the page dimension;
-    the output tile is written once on the final page step.
+    (running max / normalizer / accumulator) across the superblock
+    dimension; the output tile is written once on the final step.
 
 The cache layout [Hkv, N, bs, D] (head-major) makes each (head, page)
 tile contiguous — see dynamo_tpu.ops.attention module docs.
@@ -37,24 +44,36 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _pick_pages_per_step(M: int, cap: int = 8) -> int:
+    """Largest power of two <= cap dividing the table width."""
+    p = 1
+    while p * 2 <= cap and M % (p * 2) == 0:
+        p *= 2
+    return p
+
+
 def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [B, M] int32 (SMEM)
     seq_lens_ref,  # [B] int32 (SMEM)
-    # inputs
-    q_ref,  # [1, 1, Gp, D] queries for (b, h)
-    k_ref,  # [1, 1, bs, D] one KV page
-    v_ref,  # [1, 1, bs, D]
-    # outputs
-    o_ref,  # [1, 1, Gp, D]
-    # scratch
-    m_scr,  # [Gp, 128] f32 running max (broadcast over lanes)
-    l_scr,  # [Gp, 128] f32 running normalizer
-    acc_scr,  # [Gp, D] f32 output accumulator
-    *,
+    # inputs: q then P k-page refs then P v-page refs
+    *refs,
     scale: float,
     block_size: int,
+    pages_per_step: int,
+    return_stats: bool,
 ):
+    P = pages_per_step
+    q_ref = refs[0]  # [1, 1, Gp, D]
+    k_refs = refs[1 : 1 + P]  # each [1, 1, bs, D]
+    v_refs = refs[1 + P : 1 + 2 * P]
+    if return_stats:
+        o_ref, mo_ref, lo_ref = refs[1 + 2 * P : 4 + 2 * P]
+        m_scr, l_scr, acc_scr = refs[4 + 2 * P :]
+    else:
+        o_ref = refs[1 + 2 * P]  # [1, 1, Gp, D]
+        m_scr, l_scr, acc_scr = refs[2 + 2 * P :]
+
     b = pl.program_id(0)
     i = pl.program_id(2)
 
@@ -65,16 +84,20 @@ def _decode_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     seq_len = seq_lens_ref[b]
-    start = i * block_size
+    start = i * (P * block_size)
 
     @pl.when(start < seq_len)
-    def _page():
+    def _superblock():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [Gp, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bs, D]
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = jnp.concatenate(
+            [r[0, 0] for r in k_refs], axis=0
+        ).astype(jnp.float32)  # [P*bs, D]
+        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+            jnp.float32
+        )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Gp, bs]
+        )  # [Gp, P*bs]
         pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < seq_len, s, _NEG_INF)
 
@@ -82,7 +105,7 @@ def _decode_kernel(
         l_prev = l_scr[:, 0:1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)  # [Gp, bs]
+        p = jnp.exp(s - m_cur)  # [Gp, P*bs]
         l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -94,10 +117,14 @@ def _decode_kernel(
     def _emit():
         l = jnp.maximum(l_scr[:, 0:1], 1e-20)
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if return_stats:
+            mo_ref[0, 0] = m_scr[...]
+            lo_ref[0, 0] = l_scr[...]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret")
+    jax.jit,
+    static_argnames=("scale", "pages_per_step", "return_stats", "interpret"),
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, D]
@@ -106,42 +133,68 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,  # [B, M] int32
     seq_lens: jnp.ndarray,  # [B] int32
     scale: float,
+    pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
+    return_stats: bool = False,
     interpret: bool = False,
-) -> jnp.ndarray:  # [B, H, D]
+):  # [B, H, D] or (out, m [B, Hkv, G], l [B, Hkv, G]) when return_stats
     B, H, D = q.shape
     Hkv, N, bs, _ = k_cache_layer.shape
     M = block_tables.shape[1]
     G = H // Hkv
+    P = pages_per_step or _pick_pages_per_step(M)
+    if M % P:
+        raise ValueError(
+            f"pages_per_step={P} must divide table width M={M} "
+            "(a truncated grid would silently drop tail pages)"
+        )
     # pad the query-group dim to the fp32 sublane quantum
     Gp = max(8, -(-G // 8) * 8)
     qg = q.reshape(B, Hkv, G, D).astype(jnp.float32)
     if Gp != G:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
 
-    def page_index(b, h, i, bt, sl):
-        last = jnp.maximum(sl[b] - 1, 0) // bs
-        return (h, bt[b, jnp.minimum(i, last)], 0, 0)
+    def page_index(j):
+        def index(b, h, i, bt, sl):
+            last = jnp.maximum(sl[b] - 1, 0) // bs
+            return (h, bt[b, jnp.minimum(i * P + j, last)], 0, 0)
 
+        return index
+
+    page_spec = [
+        pl.BlockSpec((1, 1, bs, D), page_index(j)) for j in range(P)
+    ]
+    o_spec = pl.BlockSpec((1, 1, Gp, D), lambda b, h, i, bt, sl: (b, h, 0, 0))
+    stat_spec = pl.BlockSpec(
+        (1, 1, Gp, 128), lambda b, h, i, bt, sl: (b, h, 0, 0)
+    )
+    out_specs = [o_spec, stat_spec, stat_spec] if return_stats else o_spec
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, Gp, D), q.dtype)
+    if return_stats:
+        stat_shape = jax.ShapeDtypeStruct((B, Hkv, Gp, 128), jnp.float32)
+        out_shape = [out_shape, stat_shape, stat_shape]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, Hkv, M),
+        grid=(B, Hkv, M // P),
         in_specs=[
             pl.BlockSpec((1, 1, Gp, D), lambda b, h, i, bt, sl: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bs, D), page_index),
-            pl.BlockSpec((1, 1, bs, D), page_index),
+            *page_spec,
+            *page_spec,
         ],
-        out_specs=pl.BlockSpec((1, 1, Gp, D), lambda b, h, i, bt, sl: (b, h, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((Gp, 128), jnp.float32),
             pltpu.VMEM((Gp, 128), jnp.float32),
             pltpu.VMEM((Gp, D), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs)
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_size=bs, pages_per_step=P,
+        return_stats=return_stats,
+    )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, Gp, D), q.dtype),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
@@ -151,7 +204,17 @@ def paged_decode_attention(
             transcendentals=B * H * M * bs,
         ),
         interpret=interpret,
-    )(block_tables, seq_lens, qg, k_cache_layer, v_cache_layer)
+    )(
+        block_tables, seq_lens, qg,
+        *([k_cache_layer] * P), *([v_cache_layer] * P),
+    )
+    if return_stats:
+        o, m, l = out
+        return (
+            o[:, :, :G, :].reshape(B, H, D),
+            m[:, :, :G, 0],  # [B, Hkv, G] (stats broadcast over lanes)
+            l[:, :, :G, 0],
+        )
     return out[:, :, :G, :].reshape(B, H, D)
 
 
@@ -162,24 +225,23 @@ def _prefill_kernel(
     # scalar prefetch
     block_table_ref,  # [M] int32 (SMEM)
     hist_ref,  # [1] int32 (SMEM): tokens already cached before this chunk
-    # inputs
-    q_ref,  # [1, Tq*Gp, D] queries for (h, tile j), rows = (t, g) pairs
-    k_ref,  # [1, 1, bs, D] one KV page
-    v_ref,  # [1, 1, bs, D]
-    # outputs
-    o_ref,  # [1, Tq*Gp, D]
-    # scratch
-    m_scr,  # [Tq*Gp, 128] f32 running max
-    l_scr,  # [Tq*Gp, 128] f32 running normalizer
-    acc_scr,  # [Tq*Gp, D] f32 accumulator
-    *,
+    # inputs: q then P k-page refs then P v-page refs
+    *refs,
     scale: float,
     block_size: int,
     q_tile: int,  # Tq: chunk rows per grid step
     group: int,  # Gp: padded query heads per kv head
+    pages_per_step: int,
 ):
+    P = pages_per_step
+    q_ref = refs[0]  # [1, Tq*Gp, D]
+    k_refs = refs[1 : 1 + P]  # each [1, 1, bs, D]
+    v_refs = refs[1 + P : 1 + 2 * P]
+    o_ref = refs[1 + 2 * P]  # [1, Tq*Gp, D]
+    m_scr, l_scr, acc_scr = refs[2 + 2 * P :]
+
     j = pl.program_id(0)  # q tile
-    i = pl.program_id(2)  # kv page (innermost: sequential accumulation)
+    i = pl.program_id(2)  # kv superblock (innermost: sequential accumulation)
 
     @pl.when(i == 0)
     def _init():
@@ -188,18 +250,22 @@ def _prefill_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     hist = hist_ref[0]
-    start = i * block_size
-    # last query position in this tile — pages past it are fully masked
+    start = i * (P * block_size)
+    # last query position in this tile — superblocks past it are fully masked
     tile_last_q = hist + (j + 1) * q_tile - 1
 
     @pl.when(start <= tile_last_q)
-    def _page():
+    def _superblock():
         q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bs, D]
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = jnp.concatenate(
+            [r[0, 0] for r in k_refs], axis=0
+        ).astype(jnp.float32)  # [P*bs, D]
+        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+            jnp.float32
+        )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Tq*Gp, bs]
+        )  # [Tq*Gp, P*bs]
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         q_pos = hist + j * q_tile + rows // group
         kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -223,7 +289,9 @@ def _prefill_kernel(
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_step", "interpret")
+)
 def paged_prefill_attention(
     q: jnp.ndarray,  # [T, H, D] chunk queries
     k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D] — chunk ALREADY written
@@ -231,6 +299,7 @@ def paged_prefill_attention(
     block_table: jnp.ndarray,  # [M] int32, covers history + padded chunk
     history_len: jnp.ndarray,  # scalar int32
     scale: float,
+    pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
     interpret: bool = False,
 ) -> jnp.ndarray:  # [T, H, D]
     """Flash-style chunked-prefill attention over the paged cache.
@@ -243,12 +312,12 @@ def paged_prefill_attention(
     only ever produce garbage in rows the wrapper's caller discards, and
     real rows (t < valid_len) never attend past themselves.
 
-    Grid = (q_tiles, kv_heads, pages); block table + history length are
-    scalar-prefetched so the BlockSpec index_map DMAs exactly the needed
-    physical [bs, D] page per step (pages beyond a tile's causal horizon
-    re-map to the last needed page — consecutive identical indices skip
-    the fetch). fp32 online softmax in VMEM scratch, output written once
-    on the final page step.
+    Grid = (q_tiles, kv_heads, superblocks of P pages); block table +
+    history length are scalar-prefetched so each page's ``index_map`` DMAs
+    exactly the needed physical [bs, D] tile per stream (pages beyond a
+    tile's causal horizon re-map to the last needed page — consecutive
+    identical indices skip the fetch). fp32 online softmax in VMEM
+    scratch, output written once on the final step.
     """
     T, H, D = q.shape
     Hkv, N, bs, _ = k_cache_layer.shape
@@ -258,25 +327,40 @@ def paged_prefill_attention(
     Tq = min(128, T)
     nT = -(-T // Tq)
     Tpad = nT * Tq
+    P = pages_per_step or _pick_pages_per_step(M)
+    if M % P:
+        raise ValueError(
+            f"pages_per_step={P} must divide table width M={M} "
+            "(a truncated grid would silently drop tail pages)"
+        )
     # [T, H, D] -> [Hkv, nT*Tq*Gp, D]: rows are (tile, t, g) lexicographic,
     # so in-kernel row r of tile j maps to t = j*Tq + r//Gp, g = r%Gp
     qg = q.reshape(T, Hkv, G, D)
     qg = jnp.pad(qg, ((0, Tpad - T), (0, 0), (0, Gp - G), (0, 0)))
     qg = qg.transpose(1, 0, 2, 3).reshape(Hkv, Tpad * Gp, D)
 
-    def page_index(j, h, i, bt, hist):
-        tile_last = (hist[0] + (j + 1) * Tq - 1) // bs
-        written_last = (hist[0] + Tpad - 1) // bs
-        pi = jnp.minimum(jnp.minimum(i, tile_last), jnp.minimum(written_last, M - 1))
-        return (h, bt[pi], 0, 0)
+    def page_index(p):
+        def index(j, h, i, bt, hist):
+            tile_last = (hist[0] + (j + 1) * Tq - 1) // bs
+            written_last = (hist[0] + Tpad - 1) // bs
+            pi = jnp.minimum(
+                jnp.minimum(i * P + p, tile_last),
+                jnp.minimum(written_last, M - 1),
+            )
+            return (h, bt[pi], 0, 0)
 
+        return index
+
+    page_spec = [
+        pl.BlockSpec((1, 1, bs, D), page_index(p)) for p in range(P)
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(nT, Hkv, M),
+        grid=(nT, Hkv, M // P),
         in_specs=[
             pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
-            pl.BlockSpec((1, 1, bs, D), page_index),
-            pl.BlockSpec((1, 1, bs, D), page_index),
+            *page_spec,
+            *page_spec,
         ],
         out_specs=pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
         scratch_shapes=[
@@ -286,7 +370,8 @@ def paged_prefill_attention(
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp
+        _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp,
+        pages_per_step=P,
     )
     out = pl.pallas_call(
         kernel,
@@ -302,6 +387,6 @@ def paged_prefill_attention(
         ),
         interpret=interpret,
     )(jnp.asarray(block_table), jnp.asarray(history_len, jnp.int32).reshape(1),
-      qg, k_cache_layer, v_cache_layer)
+      qg, *([k_cache_layer] * P), *([v_cache_layer] * P))
     out = out.reshape(Hkv, nT, Tq, Gp, D).transpose(1, 2, 0, 3, 4)
     return out.reshape(Tpad, Hkv, Gp, D)[:T, :, :G, :].reshape(T, H, D)

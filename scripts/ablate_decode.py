@@ -1,0 +1,79 @@
+"""Ablate the fused decode window on the real chip: which component eats
+the ~21.6 ms/step? Chained W=64 windows (dispatch overhead amortized).
+
+Axes: seq_len (attention KV read scales with it; ~0 at seq=1),
+weight quantization (halves weight streaming), layer scan vs unroll,
+pallas vs XLA attention.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+cfg = ModelConfig(
+    vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+    num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=2048, dtype="bfloat16",
+)
+B, BLOCK, CTX = 16, 16, 2048
+M = CTX // BLOCK
+NUM_BLOCKS = B * M + 1
+W = 64
+
+params_bf16 = llama.init_params(cfg, jax.random.key(0))
+tables = jnp.asarray(np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M))
+seeds = jnp.zeros(B, jnp.int32)
+temps = jnp.zeros(B, jnp.float32)
+top_ks = jnp.zeros(B, jnp.int32)
+top_ps = jnp.ones(B, jnp.float32)
+
+
+def run(tag, params, seq0, use_pallas=True, unroll=True, total=256):
+    k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+    tokens = jnp.zeros(B, jnp.int32)
+    positions = jnp.full((B,), seq0, jnp.int32)
+    seq_lens = jnp.full((B,), seq0 + 1, jnp.int32)
+    steps = jnp.zeros(B, jnp.int32)
+    iters = total // W
+
+    def window(tokens, positions, seq_lens, steps, k_cache, v_cache):
+        toks, k_cache, v_cache = llama.decode_window(
+            params, cfg, tokens, positions, tables, seq_lens,
+            seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
+            n_steps=W, use_pallas=use_pallas, unroll=unroll,
+        )
+        return (toks[-1], positions + W, seq_lens + W, steps + W,
+                k_cache, v_cache)
+
+    state = (tokens, positions, seq_lens, steps, k_cache, v_cache)
+    state = window(*state)
+    np.asarray(jax.device_get(state[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = window(*state)
+    np.asarray(jax.device_get(state[0]))
+    dt = time.perf_counter() - t0
+    per_step = dt / (iters * W)
+    print(f"{tag:44s} {per_step*1e3:7.3f} ms/step  {B/per_step:7.0f} tok/s",
+          flush=True)
+
+
+run("bf16 seq=1024 pallas unroll (baseline)", params_bf16, 1024)
+run("bf16 seq=1    pallas unroll (no KV read)", params_bf16, 1)
+run("bf16 seq=1024 XLA-attn unroll", params_bf16, 1024, use_pallas=False)
+run("bf16 seq=1024 pallas SCAN layers", params_bf16, 1024, unroll=False)
+
+from dynamo_tpu.models.quant import quantize_params
+
+params_i8 = quantize_params(params_bf16, cfg, "int8")
+run("int8 seq=1024 pallas unroll", params_i8, 1024)
+run("int8 seq=1    pallas unroll", params_i8, 1)

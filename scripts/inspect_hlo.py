@@ -1,0 +1,87 @@
+"""Inspect compiled HLO of decode_window for hidden full-cache copies.
+
+If the lax.scan over decode steps double-buffers the KV-cache carry, the
+while-loop body will contain copy/dynamic-update ops over the full cache
+shape — a per-step 2x2.15GB tax that would explain the measured 21.6
+ms/step vs the ~5ms component sum. CPU-compiled, small-but-structured
+shapes; we grep the optimized HLO for cache-shaped copies.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+cfg = ModelConfig.tiny(num_layers=4)
+B, BLOCK, CTX = 4, 16, 256
+M = CTX // BLOCK
+NUM_BLOCKS = B * M + 1
+W = 8
+
+params = llama.init_params(cfg, jax.random.key(0))
+k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+cache_shape = k_cache.shape
+print("cache shape:", cache_shape)
+
+tables = jnp.asarray(np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M))
+args = dict(
+    tokens=jnp.zeros(B, jnp.int32),
+    positions=jnp.full((B,), 100, jnp.int32),
+    seq_lens=jnp.full((B,), 101, jnp.int32),
+    seeds=jnp.zeros(B, jnp.int32),
+    steps=jnp.zeros(B, jnp.int32),
+    temps=jnp.zeros(B, jnp.float32),
+    top_ks=jnp.zeros(B, jnp.int32),
+    top_ps=jnp.ones(B, jnp.float32),
+)
+
+lowered = llama.decode_window.lower(
+    params, cfg, args["tokens"], args["positions"], tables,
+    args["seq_lens"], args["seeds"], args["steps"], args["temps"],
+    args["top_ks"], args["top_ps"], k_cache, v_cache,
+    n_steps=W, use_pallas=False,
+)
+compiled = lowered.compile()
+hlo = compiled.as_text()
+
+# count ops whose output is the full cache shape
+dims = "x".join(str(d) for d in cache_shape)
+pat = re.compile(rf"bf16\[{dims}\]")
+lines = [ln.strip() for ln in hlo.splitlines() if pat.search(ln)]
+print(f"\nops producing/using full-cache-shaped bf16[{dims}]: {len(lines)}")
+by_op = {}
+for ln in lines:
+    m = re.search(r"= bf16\[" + dims + r"\][^ ]* ([a-z-]+)", ln)
+    if m:
+        by_op[m.group(1)] = by_op.get(m.group(1), 0) + 1
+print("producers by op:", by_op)
+
+# full-cache copies anywhere in the optimized HLO
+copies = []
+for ln in hlo.splitlines():
+    if "copy" in ln and pat.search(ln):
+        copies.append(ln.strip()[:160])
+print(f"\nfull-cache copy ops: {len(copies)}")
+for c in copies[:20]:
+    print(" ", c)
+
+ca = compiled.cost_analysis()
+if ca:
+    print("\ncost analysis bytes accessed:", ca.get("bytes accessed", "n/a"),
+          " flops:", ca.get("flops", "n/a"))
+    cache_bytes = int(np.prod(cache_shape)) * 2
+    print("one cache bytes:", cache_bytes,
+          " => cache-copies-equivalent:",
+          (ca.get("bytes accessed", 0)) / cache_bytes)

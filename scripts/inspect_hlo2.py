@@ -1,0 +1,60 @@
+"""Per-step bytes-accessed slope of decode_window (CPU compile, bench-like
+dims but 2 layers). If slope >> weights+KV-read, the scan is copying the
+cache every step."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+cfg = ModelConfig(
+    vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+    num_layers=2, num_heads=16, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=2048, dtype="bfloat16",
+)
+B, BLOCK, CTX = 16, 16, 2048
+M = CTX // BLOCK
+NUM_BLOCKS = B * M + 1
+
+params = llama.init_params(cfg, jax.random.key(0))
+k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+cache_bytes = k_cache.size * 2
+w_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+print(f"one cache: {cache_bytes/1e6:.0f} MB   weights: {w_bytes/1e6:.0f} MB")
+
+tables = jnp.asarray(np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M))
+Z = jnp.zeros(B, jnp.int32)
+args = (Z, jnp.full((B,), 1024, jnp.int32), tables,
+        jnp.full((B,), 1025, jnp.int32), Z, Z,
+        jnp.zeros(B, jnp.float32), Z, jnp.ones(B, jnp.float32))
+
+res = {}
+for W in (1, 4, 8):
+    for unroll in (True, False):
+        c = llama.decode_window.lower(
+            params, cfg, *args, k_cache, v_cache,
+            n_steps=W, use_pallas=False, unroll=unroll,
+        ).compile()
+        ca = c.cost_analysis()
+        ma = c.memory_analysis()
+        ba = ca.get("bytes accessed", 0)
+        res[(W, unroll)] = ba
+        print(f"W={W} unroll={unroll!s:5s}: bytes accessed {ba/1e9:7.3f} GB, "
+              f"temp alloc {ma.temp_size_in_bytes/1e6:8.1f} MB",
+              flush=True)
+
+for unroll in (True, False):
+    slope = (res[(8, unroll)] - res[(1, unroll)]) / 7
+    print(f"unroll={unroll}: per-step bytes {slope/1e9:.3f} GB "
+          f"(weights {w_bytes/1e9:.3f}, 2x cache copy {4*cache_bytes/1e9:.3f})")

@@ -1,0 +1,63 @@
+"""Scan (block_size, pages_per_compute_block) for the decode attention
+kernel on the real chip, at bench shapes (B=16, seq~1024 of 2048 ctx).
+
+Per-call times include ~4.4ms tunnel dispatch overhead; compare deltas.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, CTX, Hkv, H, D = 16, 2048, 8, 16, 128
+SEQ = 1025
+scale = D ** -0.5
+q = jnp.zeros((B, H, D), jnp.bfloat16)
+
+# floor: stream the same bytes with a trivial reduce
+for label, n in (("full-CTX KV bytes", B * CTX * 2 * Hkv * D),
+                 ("seq-bounded KV bytes", B * SEQ * 2 * Hkv * D)):
+    arr = jnp.zeros((n,), jnp.bfloat16)
+    red = jax.jit(lambda a: jnp.sum(a, dtype=jnp.float32))
+    jax.block_until_ready(red(arr))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        r = red(arr)
+    jax.block_until_ready(r)
+    print(f"stream floor {label:22s} ({n*2/1e6:6.0f} MB): "
+          f"{(time.perf_counter()-t0)/20*1e3:7.3f} ms/call", flush=True)
+
+from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+for bs in (16, 32, 64, 128):
+    M = CTX // bs
+    NB = B * M + 1
+    kc = jnp.zeros((Hkv, NB, bs, D), jnp.bfloat16)
+    vc = jnp.zeros((Hkv, NB, bs, D), jnp.bfloat16)
+    tables = jnp.asarray(np.arange(1, NB, dtype=np.int32).reshape(B, M))
+    seq_lens = jnp.full((B,), SEQ, jnp.int32)
+    for ppcb in (4, 8, 16, 32, 64):
+        if M % ppcb or ppcb > M:
+            continue
+        try:
+            fn = jax.jit(
+                lambda q, kc, vc, p=ppcb: paged_attention(
+                    q, kc, vc, seq_lens, tables, pages_per_compute_block=p
+                )
+            )
+            jax.block_until_ready(fn(q, kc, vc))
+            t0 = time.perf_counter()
+            for _ in range(20):
+                r = fn(q, kc, vc)
+            jax.block_until_ready(r)
+            dt = (time.perf_counter() - t0) / 20
+            print(f"bs={bs:4d} ppcb={ppcb:3d} grid_pages={M:4d}: "
+                  f"{dt*1e3:7.3f} ms/call", flush=True)
+        except Exception as e:
+            print(f"bs={bs:4d} ppcb={ppcb:3d}: FAIL {type(e).__name__}: {e}",
+                  flush=True)
