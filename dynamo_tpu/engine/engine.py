@@ -81,6 +81,10 @@ class EngineConfig:
     # scan-ys cache re-stack) vs lax.scan (faster compiles on very deep
     # models, at a full extra KV-cache copy per step)
     decode_layer_scan: bool = False
+    # merged one-write decode (flash-merged attention + single in-place
+    # Pallas cache append per step); False = per-layer write-then-attend
+    # (escape hatch for Mosaic kernel regressions)
+    decode_merged: bool = True
     # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
     # per-output-channel scales; halves decode's HBM weight streaming, the
     # ref's FP8 serving equivalent, docs/architecture.md:57-61)
@@ -787,6 +791,7 @@ class JaxEngine(AsyncEngine):
             use_pallas=self.use_pallas,
             mesh=self.mesh,
             unroll=not cfg.decode_layer_scan,
+            merged=cfg.decode_merged,
         )
         return np.asarray(jax.device_get(toks))
 

@@ -429,6 +429,7 @@ def prefill(
 def _decode_body(
     params, cfg, tokens, positions, block_tables, seq_lens,
     k_cache, v_cache, use_pallas, mesh=None, unroll=True, interpret=False,
+    merged=True,
 ):
     """Shared un-jitted decode forward (one token per sequence).
 
@@ -462,16 +463,21 @@ def _decode_body(
         blk, off = att.decode_slot_indices(
             block_tables, positions, k_cache.shape[3]
         )
-    merged = unroll and use_pallas and mesh is None
+    merged = merged and unroll and use_pallas
     if merged:
-        # MERGED one-write path (TPU single-device): attention handles the
-        # current token out-of-cache (flash merge over the stats-emitting
-        # paged kernel), so the cache sees ONE in-place Pallas append per
-        # step instead of 2L XLA scatters — XLA will not update scatters
-        # of this shape in place; each one copied the full cache
-        # (measured ~0.55 GB/copy on the 1B bench config; the reference's
-        # equivalent split is vLLM's reshape_and_cache + paged attention).
-        from ..ops.kv_cache_update_pallas import kv_cache_append
+        # MERGED one-write path (TPU): attention handles the current token
+        # out-of-cache (flash merge over the stats-emitting paged kernel),
+        # so the cache sees ONE in-place Pallas append per step instead of
+        # 2L XLA scatters — XLA will not update scatters of this shape in
+        # place; each one copied the full cache (measured ~0.55 GB/copy on
+        # the 1B bench config; the reference's equivalent split is vLLM's
+        # reshape_and_cache + paged attention). On a mesh, every piece is
+        # kv-head-parallel and runs under shard_map over tp (the engine
+        # only sets use_pallas when tp divides the kv heads).
+        from ..ops.kv_cache_update_pallas import (
+            kv_cache_append,
+            kv_cache_append_sharded,
+        )
 
         hist_lens = seq_lens - 1  # cache contents EXCLUDE the new token
         k_news, v_news = [], []
@@ -480,15 +486,28 @@ def _decode_body(
             q, k, v = layer_qkv(x, lp)
             k_news.append(k)
             v_news.append(v)
-            o = att.decode_attention_merged(
-                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                scale, interpret=interpret,
-            )
+            if mesh is None:
+                o = att.decode_attention_merged(
+                    q, k, v, k_cache[l], v_cache[l], block_tables,
+                    hist_lens, scale, interpret=interpret,
+                )
+            else:
+                o = att.decode_attention_merged_sharded(
+                    q, k, v, k_cache[l], v_cache[l], block_tables,
+                    hist_lens, scale, mesh, interpret=interpret,
+                )
             x = layer_tail(x, lp, o)
-        k_cache, v_cache = kv_cache_append(
-            jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
-            interpret=interpret,
-        )
+        k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)
+        if mesh is None:
+            k_cache, v_cache = kv_cache_append(
+                k_new, v_new, k_cache, v_cache, blk, off,
+                interpret=interpret,
+            )
+        else:
+            k_cache, v_cache = kv_cache_append_sharded(
+                k_new, v_new, k_cache, v_cache, blk, off, mesh,
+                interpret=interpret,
+            )
     elif unroll:
         for l in range(cfg.num_layers):
             lp = jax.tree.map(lambda a: a[l], params["layers"])
@@ -530,7 +549,7 @@ def _decode_body(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_pallas", "mesh", "unroll", "interpret"),
+    static_argnames=("cfg", "use_pallas", "mesh", "unroll", "interpret", "merged"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_step(
@@ -546,17 +565,22 @@ def decode_step(
     mesh=None,
     unroll: bool = True,
     interpret: bool = False,
+    merged: bool = True,
 ):
-    """One continuous-batching decode step for all active sequences."""
+    """One continuous-batching decode step for all active sequences.
+
+    ``merged=False`` opts out of the one-write merged path back to the
+    per-layer write-then-attend kernels (escape hatch for Mosaic
+    regressions; bench.py falls back through it)."""
     return _decode_body(
         params, cfg, tokens, positions, block_tables, seq_lens,
-        k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+        k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll", "interpret"),
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll", "interpret", "merged"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def decode_window(
@@ -578,6 +602,7 @@ def decode_window(
     mesh=None,
     unroll: bool = True,
     interpret: bool = False,
+    merged: bool = True,
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -592,7 +617,7 @@ def decode_window(
         tokens, positions, seq_lens, steps, k_cache, v_cache = carry
         logits, k_cache, v_cache = _decode_body(
             params, cfg, tokens, positions, block_tables, seq_lens,
-            k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+            k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
         )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)

@@ -213,6 +213,45 @@ def decode_attention_merged(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def decode_attention_merged_sharded(
+    q: jnp.ndarray,  # [B, H, D], H sharded over tp
+    k_new: jnp.ndarray,  # [B, Hkv, D], Hkv sharded over tp
+    v_new: jnp.ndarray,
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M] replicated
+    hist_lens: jnp.ndarray,  # [B] replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Merged decode attention under shard_map over ``tp``.
+
+    The whole merged computation — paged kernel over the local kv-head
+    shard, s_new = q.k_new, and the flash merge — is elementwise per
+    kv-head group, so each device runs it on local tiles with no
+    collectives (same head-parallel argument as _shard_headwise)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        partial(decode_attention_merged, scale=scale, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q
+            P(None, "tp", None),  # k_new
+            P(None, "tp", None),  # v_new
+            P("tp", None, None, None),  # k cache layer
+            P("tp", None, None, None),  # v cache layer
+            P(),  # tables
+            P(),  # hist_lens
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, k_new, v_new, k_cache_layer, v_cache_layer, block_tables, hist_lens)
+
+
 def decode_attention_xla(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence
     k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]

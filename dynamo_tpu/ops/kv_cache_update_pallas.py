@@ -74,12 +74,55 @@ def kv_cache_append(
     padded rows may all point at the sacrificial page 0 with distinct
     semantics handled by masking (never read).
     """
+    return _append_call(
+        k_new, v_new, k_cache, v_cache, blk, off, interpret=interpret
+    )
+
+
+def kv_cache_append_sharded(
+    k_new: jnp.ndarray,  # [L, B, Hkv, D], Hkv sharded over tp
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D], Hkv sharded over tp
+    v_cache: jnp.ndarray,
+    blk: jnp.ndarray,  # [B] replicated
+    off: jnp.ndarray,  # [B] replicated
+    mesh,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The append kernel under shard_map over ``tp``: each device RMWs the
+    page tiles of its local kv-head shard — head-parallel, no collectives
+    (kv-head axis is the cache's sharded axis, see ops/attention docs)."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        functools.partial(_append_call, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),  # k_new
+            P(None, None, "tp", None),  # v_new
+            P(None, "tp", None, None, None),  # k_cache
+            P(None, "tp", None, None, None),  # v_cache
+            P(),  # blk
+            P(),  # off
+        ),
+        out_specs=(
+            P(None, "tp", None, None, None),
+            P(None, "tp", None, None, None),
+        ),
+        check_vma=False,
+    )(k_new, v_new, k_cache, v_cache, blk, off)
+
+
+def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
+    """The pallas_call body shared by the single-device and shard_map
+    paths (operates on whatever shard it is handed)."""
     L, B, Hkv, D = k_new.shape
     bs = k_cache.shape[3]
-
     if interpret:
-        # CPU path: the aliased-page pipeline is a Mosaic feature; tests
-        # use the same scatter the kernel replaces (bit-identical result)
+        # CPU/shard_map tests: same scatter as kv_cache_append's interpret
+        # branch, applied to the local shard
         lidx = jnp.arange(L)[:, None]
         bidx = jnp.arange(B)[None, :]
         k_cache = k_cache.at[lidx, :, blk[bidx], off[bidx]].set(
@@ -89,7 +132,6 @@ def kv_cache_append(
             v_new.astype(v_cache.dtype)
         )
         return k_cache, v_cache
-
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(L, B),

@@ -119,6 +119,54 @@ def test_decode_body_merged_path_matches_regular():
     )
 
 
+def test_merged_sharded_tp2_matches_single_device():
+    """decode_attention_merged_sharded + kv_cache_append_sharded over a
+    tp=2 CPU mesh must match the single-device merged path (this is the
+    sharded-mesh decode hot path on TPU)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import decode_attention_merged_sharded
+    from dynamo_tpu.ops.kv_cache_update_pallas import kv_cache_append_sharded
+
+    B, H, Hkv, D, L, N, bs, M = 4, 8, 4, 128, 2, 64, 16, 4
+    q, kc, vc, k_new, v_new, tables = _setup(B, H, Hkv, D, L, N, bs, M, seed=5)
+    hist = jnp.asarray([0, 5, bs, 2 * bs + 3], jnp.int32)
+    scale = D**-0.5
+
+    ref_o = decode_attention_merged(
+        q, k_new[0], v_new[0], kc[0], vc[0], tables, hist, scale,
+        interpret=True,
+    )
+    blk, off = decode_slot_indices(tables, hist, bs)
+    ref_k, ref_v = kv_cache_append(
+        k_new, v_new, jnp.copy(kc), jnp.copy(vc), blk, off, interpret=True
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 1, 1, 1, 2),
+                ("dp", "pp", "sp", "ep", "tp"))
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
+    kns = jax.device_put(k_new, NamedSharding(mesh, P(None, None, "tp", None)))
+    vns = jax.device_put(v_new, NamedSharding(mesh, P(None, None, "tp", None)))
+    cache_sh = NamedSharding(mesh, P(None, "tp", None, None, None))
+    kcs = jax.device_put(kc, cache_sh)
+    vcs = jax.device_put(vc, cache_sh)
+
+    got_o = decode_attention_merged_sharded(
+        qs, kns[0], vns[0], kcs[0], vcs[0], tables, hist, scale, mesh,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_o), np.asarray(ref_o), rtol=2e-5, atol=2e-5
+    )
+
+    got_k, got_v = kv_cache_append_sharded(
+        kns, vns, jnp.copy(kcs), jnp.copy(vcs), blk, off, mesh,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+
+
 def test_merged_attention_no_nans_on_empty_batch():
     B, H, Hkv, D, L, N, bs, M = 2, 8, 4, 128, 1, 16, 16, 2
     q, kc, vc, k_new, v_new, tables = _setup(B, H, Hkv, D, L, N, bs, M, seed=2)
