@@ -85,6 +85,19 @@ class EngineConfig:
     # Pallas cache append per step); False = per-layer write-then-attend
     # (escape hatch for Mosaic kernel regressions)
     decode_merged: bool = True
+    # pipelined decode: dispatch window k+1 (fed window k's last sampled
+    # tokens as a device array) BEFORE the host consumes window k, hiding
+    # host emission + dispatch latency behind device compute (the async
+    # scheduling overlap vLLM gets from multi-step scheduling). Drained
+    # whenever batch membership changes, and never the CAUSE of a
+    # preemption (speculative window blocks are returned first under pool
+    # pressure) — but the overlapped schedule can still shift WHICH
+    # sequence a genuine preemption picks, and a replay whose prefix
+    # blocks were evicted recomputes with different reduction orders than
+    # the original decode (near-tie greedy tokens may flip). Default OFF
+    # so the uncontended==contended bit-exactness guarantee holds;
+    # opt in for throughput on pools provisioned to rarely preempt.
+    decode_pipeline: bool = False
     # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
     # per-output-channel scales; halves decode's HBM weight streaming, the
     # ref's FP8 serving equivalent, docs/architecture.md:57-61)
@@ -209,6 +222,8 @@ class JaxEngine(AsyncEngine):
         # every jit call — concurrent dispatch would use freed buffers);
         # contended only when disagg hooks run beside the decode loop
         self._device_lock = asyncio.Lock()
+        # pipelined decode: the not-yet-drained window's device tokens
+        self._inflight: Optional[dict] = None
         self._wake = asyncio.Event()
         self._closed = False
         self._backpressured = False
@@ -307,6 +322,9 @@ class JaxEngine(AsyncEngine):
                     and not admitted
                     and self._prefill_state is None
                 ):
+                    # drop a stale pipelined window before going idle (its
+                    # participants all finished; tokens are discards)
+                    await self._drain_inflight()
                     self._wake.clear()
                     await self._wake.wait()
                     continue
@@ -693,6 +711,8 @@ class JaxEngine(AsyncEngine):
     async def _decode_once(self) -> None:
         cfg = self.cfg
         n = self._pick_window()
+        # tokens already written/writing on device for an undrained window
+        pending = self._inflight["n"] if self._inflight else 0
         # ensure every active sequence has blocks for the window's tokens
         for seq in list(self._active):
             if seq is None or seq.finished or seq.slot < 0:
@@ -700,15 +720,38 @@ class JaxEngine(AsyncEngine):
             if seq.context.is_stopped():
                 self._finish(seq, FinishReason.CANCELLED)
                 continue
-            needed = seq.seq_len + n
-            while needed > len(seq.blocks) * cfg.block_size and seq.slot >= 0:
+            while (
+                seq.seq_len + pending + n > len(seq.blocks) * cfg.block_size
+                and seq.slot >= 0
+                and not seq.finished
+            ):
                 if len(seq.blocks) >= cfg.max_blocks_per_seq:
+                    if self._inflight is not None:
+                        # the requirement is inflated by the speculative
+                        # pending window — drain (emits its tokens,
+                        # advances seq_len, pending -> 0), re-pick the
+                        # window from fresh lengths, and re-evaluate
+                        # before declaring a context-limit finish, or the
+                        # in-flight tokens would be discarded and the
+                        # stream truncated up to a window early
+                        await self._drain_inflight()
+                        pending, n = 0, self._pick_window()
+                        continue
                     self._finish(seq, FinishReason.LENGTH)  # true ctx limit
                     break
                 extra = self.allocator.allocate(1)
                 if extra is not None:
                     seq.blocks.extend(extra)
                     self._block_tables[seq.slot] = self._table_for(seq)
+                    continue
+                if self._inflight is not None:
+                    # pipelining must never CAUSE a preemption: the
+                    # speculative pending-window blocks are the first thing
+                    # to give back under pressure. Draining emits the
+                    # window (advancing seq_len by `pending`) and frees the
+                    # speculation headroom requirement.
+                    await self._drain_inflight()
+                    pending, n = 0, self._pick_window()
                     continue
                 # pool exhausted: preempt the youngest running sequence
                 # (possibly this one) instead of truncating output
@@ -727,59 +770,146 @@ class JaxEngine(AsyncEngine):
                     break
                 self._preempt(victim)
         if self._n_active == 0:
+            await self._drain_inflight()
             return
 
-        active_slots = [i for i, s in enumerate(self._active) if s is not None]
+        # The in-flight window froze a batch membership; if it changed
+        # (finish, cancellation, preemption, admission), the chained
+        # device tokens and the `pending` offset no longer describe the
+        # current batch — drain first (survivors' tokens still emit; a
+        # vacated slot's are discarded) and start an unchained window.
+        if self._inflight is not None:
+            infl = self._inflight["slots"]
+            cur = {i: s for i, s in enumerate(self._active) if s is not None}
+            if cur.keys() != infl.keys() or any(
+                cur[i] is not infl[i] for i in cur
+            ):
+                await self._drain_inflight()
+                pending = 0
+                if self._n_active == 0:  # drain may finish survivors
+                    return
+
+        # Pipelined mode: dispatch window k+1 BEFORE draining window k.
+        # Its token inputs are window k's last sampled tokens — a device
+        # array, no host round trip — and positions/lengths/steps advance
+        # by the pending step count host-side. Safe without draining on
+        # finish/preempt because (a) in-flight writes land only ABOVE the
+        # commit horizon (never into hash-claimable blocks) and (b) any
+        # re-used block is re-prefilled by a dispatch device-ordered after
+        # the in-flight window. Admission pressure forces n == 1
+        # (_pick_window), which drains first — new sequences never join a
+        # frozen in-flight batch.
+        pipe = (
+            cfg.decode_pipeline
+            and self.mirror is None
+            and n > 1
+            and self._prefill_state is None
+        )
+        if not pipe:
+            await self._drain_inflight()
+            pending = 0
+            if self._n_active == 0:
+                return
+            n = self._pick_window()
+        prev = self._inflight
+        # chain token inputs on device when a window is in flight;
+        # otherwise feed the host-mirrored last tokens
+        tokens_in = prev["toks"][-1] if prev is not None else None
         steps = np.asarray(
-            [self._active[i].generated if self._active[i] else 0
+            [(self._active[i].generated if self._active[i] else 0) + pending
              for i in range(cfg.max_batch_size)],
             np.int32,
         )
         async with self._device_lock:
-            toks_host = await asyncio.get_running_loop().run_in_executor(
-                None, self._decode_device, steps, n
+            toks = await asyncio.get_running_loop().run_in_executor(
+                None, self._dispatch_window, steps, n, pending, tokens_in
             )
+        self._inflight = {
+            "toks": toks, "n": n,
+            "slots": {i: s for i, s in enumerate(self._active)
+                      if s is not None},
+        }
+        if prev is not None:
+            await self._emit_window(prev)
+        if not pipe:
+            await self._drain_inflight()
+
+    async def _drain_inflight(self) -> None:
+        """Sync + emit the pending pipelined window, if any."""
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            await self._emit_window(inflight)
+
+    async def _emit_window(self, window: dict) -> None:
+        toks_host = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: np.asarray(jax.device_get(window["toks"]))
+        )
+        n = window["n"]
         self.stats["decode_steps"] += n
         # emit window tokens in step order; a sequence that hits a stop
-        # condition mid-window has its tail tokens discarded
+        # condition mid-window has its tail tokens discarded, and a slot
+        # that changed hands since dispatch (finish -> re-admission) must
+        # not receive the old occupant's tokens
+        live = [
+            (i, seq) for i, seq in window["slots"].items()
+            if self._active[i] is seq and not seq.finished
+        ]
         for step_i in range(n):
-            for i in active_slots:
-                seq = self._active[i]
-                if seq is None or seq.finished:
+            for i, seq in live:
+                if seq.finished:
                     continue
                 self._emit_token(seq, int(toks_host[step_i, i]))
-        for i in active_slots:
-            seq = self._active[i]
-            if seq is None or seq.finished:
+        for i, seq in live:
+            if seq.finished:
                 continue
             self._seq_lens[i] = seq.seq_len
             self._last_tokens[i] = seq.tokens[-1]
             self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
 
-    def _decode_device(self, steps: np.ndarray, n: int) -> np.ndarray:
-        """Runs in an executor thread: one fused n-step decode+sample
-        window. Returns sampled tokens [n, B]."""
+    def _dispatch_window(
+        self, steps: np.ndarray, n: int, pending: int, tokens_in=None
+    ):
+        """Runs in an executor thread: dispatch one fused n-step
+        decode+sample window WITHOUT syncing its result. Returns the
+        sampled-token device array [n, B] (host np array on the mirror
+        path, which syncs internally).
+
+        ``pending`` > 0 means an undrained window is in flight: this
+        window's token inputs are that window's last sampled tokens
+        (``tokens_in``, a device array — the chain stays on device) and
+        the host-mirrored positions/lengths advance by ``pending``
+        steps."""
         cfg = self.cfg
+        if pending and tokens_in is None:
+            raise RuntimeError(
+                "pending window without a chained token source"
+            )
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
-        positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
+        positions = (
+            np.maximum(self._seq_lens - 1, 0) + pending
+        ).astype(np.int32)
+        seq_lens = (self._seq_lens + pending).astype(np.int32)
         if self.mirror is not None:
             toks, self.k_cache, self.v_cache = self.mirror.lead_decode(
                 self.params, self._last_tokens, positions,
-                self._block_tables, self._seq_lens, self._seeds, steps,
+                self._block_tables, seq_lens, self._seeds, steps,
                 self._temps, self._top_ks, self._top_ps,
                 self.k_cache, self.v_cache,
                 n_steps=n, use_pallas=self.use_pallas,
                 unroll=not cfg.decode_layer_scan,
+                merged=cfg.decode_merged,
             )
             return toks
+        if tokens_in is None:
+            tokens_in = jnp.asarray(self._last_tokens)
         toks, self.k_cache, self.v_cache = llama.decode_window(
             self.params,
             cfg.model,
-            jnp.asarray(self._last_tokens),
+            tokens_in,
             jnp.asarray(positions),
             jnp.asarray(self._block_tables),
-            jnp.asarray(self._seq_lens),
+            jnp.asarray(seq_lens),
             jnp.asarray(self._seeds),
             jnp.asarray(steps),
             jnp.asarray(self._temps),
@@ -793,7 +923,7 @@ class JaxEngine(AsyncEngine):
             unroll=not cfg.decode_layer_scan,
             merged=cfg.decode_merged,
         )
-        return np.asarray(jax.device_get(toks))
+        return toks
 
     # ---- token emission + finish logic ----
 

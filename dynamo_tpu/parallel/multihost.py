@@ -164,8 +164,8 @@ class StepMirror:
     # ---- fused step programs (shared leader/follower) ----
 
     def _decode_fn(self, n_steps: int = 1, use_pallas: bool = False,
-                   unroll: bool = True):
-        key = ("decode", n_steps, use_pallas, unroll)
+                   unroll: bool = True, merged: bool = True):
+        key = ("decode", n_steps, use_pallas, unroll, merged)
         if key not in self._fns:
             import jax
 
@@ -180,7 +180,7 @@ class StepMirror:
                     params, cfg, tokens, positions, tables, seq_lens,
                     seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
                     n_steps=n_steps, use_pallas=use_pallas, mesh=mesh,
-                    unroll=unroll,
+                    unroll=unroll, merged=merged,
                 )
 
             self._fns[key] = jax.jit(
@@ -274,14 +274,16 @@ class StepMirror:
     def lead_decode(self, params, last_tokens, positions, tables, seq_lens,
                     seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
                     n_steps: int = 1, use_pallas: bool = False,
-                    unroll: bool = True):
+                    unroll: bool = True, merged: bool = True):
         import jax
 
         self._lead("decode", (last_tokens, positions, tables, seq_lens,
                               seeds, steps, temps, top_ks, top_ps),
-                   n=n_steps, pallas=use_pallas, unroll=unroll)
+                   n=n_steps, pallas=use_pallas, unroll=unroll, merged=merged)
         g = self.to_global
-        toks, k_cache, v_cache = self._decode_fn(n_steps, use_pallas, unroll)(
+        toks, k_cache, v_cache = self._decode_fn(
+            n_steps, use_pallas, unroll, merged
+        )(
             params, g(last_tokens), g(positions), g(tables), g(seq_lens),
             g(seeds), g(steps), g(temps), g(top_ks), g(top_ps),
             k_cache, v_cache,
@@ -355,7 +357,8 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
             return
         if op == "decode":
             fn = mirror._decode_fn(head.get("n", 1), head.get("pallas", False),
-                                   head.get("unroll", True))
+                                   head.get("unroll", True),
+                                   head.get("merged", True))
             _toks, k_cache, v_cache = fn(
                 params, *(g(a) for a in arrays), k_cache, v_cache
             )

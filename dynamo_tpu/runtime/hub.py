@@ -304,9 +304,26 @@ class _HubConnection:
         self._wlock = asyncio.Lock()
         self._bg_tasks: set[asyncio.Task] = set()
 
-    async def connect(self) -> None:
+    async def connect(self, timeout: float = 15.0) -> None:
+        """Dial the hub, retrying connection refusals with backoff until
+        ``timeout``: components of one deployment start concurrently, and
+        a worker/frontend may reach its dial before the hub process has
+        bound its listener (the reference's runtime retries its etcd/NATS
+        connects the same way)."""
         host, port = self.address.rsplit(":", 1)
-        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        deadline = asyncio.get_running_loop().time() + timeout
+        delay = 0.1
+        while True:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    host, int(port)
+                )
+                break
+            except (ConnectionRefusedError, OSError):
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
         self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
 
     async def close(self) -> None:

@@ -405,3 +405,125 @@ def test_chunked_prefill_interleaves_decode(run, engine_cfg):
         await engine.close()
 
     run(main())
+
+
+# ---------------- pipelined decode (decode_pipeline=True) ----------------
+
+
+def test_pipelined_decode_matches_unpipelined(run):
+    """With decode_pipeline=True and no pool contention, the token streams
+    (greedy AND sampled) must be bit-identical to the unpipelined engine —
+    the chained device windows use the same PRNG steps and positions."""
+
+    async def main():
+        outs = {}
+        for pipe in (False, True):
+            cfg = EngineConfig(
+                model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+                max_batch_size=4, decode_window=4, decode_pipeline=pipe,
+            )
+            engine = JaxEngine(cfg, seed=0)
+            reqs = [
+                make_req(range(10, 20), max_tokens=17),
+                make_req(range(30, 38), max_tokens=17,
+                         temperature=0.9, seed=7),
+            ]
+            results = await asyncio.gather(
+                *[collect(engine.generate(Context(r))) for r in reqs]
+            )
+            outs[pipe] = [
+                [t for o in out for t in o.token_ids] for out in results
+            ]
+            for out in results:
+                assert out[-1].finish_reason == FinishReason.LENGTH
+            await engine.close()
+        assert outs[True] == outs[False]
+
+    run(main())
+
+
+def test_pipelined_cancellation_mid_stream(run):
+    """Cancelling a request while windows are in flight must terminate its
+    stream promptly and leave the engine serving others."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+            max_batch_size=4, decode_window=4, decode_pipeline=True,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        ctx = Context(make_req(range(10, 20), max_tokens=64))
+        stream = engine.generate(ctx)
+        got = 0
+        async for out in stream:
+            got += len(out.token_ids)
+            if got >= 4:
+                ctx.context.stop_generating()
+        # engine still serves new requests afterwards
+        out = await collect(
+            engine.generate(Context(make_req(range(40, 50), max_tokens=5)))
+        )
+        assert out[-1].finish_reason == FinishReason.LENGTH
+        assert len([t for o in out for t in o.token_ids]) == 5
+        await engine.close()
+
+    run(main())
+
+
+def test_pipelined_preemption_completes_all(run):
+    """Under pool starvation with pipelining on, every request still
+    completes its full max_tokens (preemption, never truncation); the
+    tokens may differ from the uncontended stream only after a replay
+    whose prefix blocks were evicted (recompute numerics)."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=14, block_size=4,
+            max_batch_size=4, max_context=128, prefill_chunk=32,
+            decode_window=4, decode_pipeline=True,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        prompts = [list(range(10 + 7 * i, 22 + 7 * i)) for i in range(3)]
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(make_req(p, max_tokens=24))))
+              for p in prompts]
+        )
+        for i, out in enumerate(outs):
+            toks = [t for o in out for t in o.token_ids]
+            assert out[-1].finish_reason == FinishReason.LENGTH
+            assert len(toks) == 24, f"req {i} truncated to {len(toks)}"
+        assert engine._n_active == 0 and engine._inflight is None
+        await engine.close()
+
+    run(main())
+
+
+def test_pipelined_context_limit_not_truncated_early(run):
+    """A sequence approaching max_context with a window in flight must
+    still generate up to the true limit: the speculative pending-window
+    block requirement must not trigger a premature LENGTH finish that
+    discards in-flight tokens (regression: drain-and-repick before the
+    context-limit check)."""
+
+    async def main():
+        outs = {}
+        for pipe in (False, True):
+            cfg = EngineConfig(
+                model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+                max_batch_size=2, max_context=32, decode_window=4,
+                decode_pipeline=pipe,
+            )
+            engine = JaxEngine(cfg, seed=0)
+            # 12-token prompt, ask for more than fits: must emit exactly
+            # max_context - prompt_len = 20 tokens, not fewer
+            out = await collect(
+                engine.generate(Context(make_req(range(10, 22), max_tokens=64)))
+            )
+            toks = [t for o in out for t in o.token_ids]
+            assert out[-1].finish_reason == FinishReason.LENGTH
+            outs[pipe] = toks
+            await engine.close()
+        assert len(outs[True]) == len(outs[False]) == 20
+        assert outs[True] == outs[False]
+
+    run(main())
