@@ -98,6 +98,20 @@ class EngineConfig:
     # so the uncontended==contended bit-exactness guarantee holds;
     # opt in for throughput on pools provisioned to rarely preempt.
     decode_pipeline: bool = False
+    # speculative decoding via prompt-lookup (n-gram) drafts: propose up
+    # to spec_gamma continuation tokens from the sequence's own history
+    # (last spec_ngram tokens matched against earlier occurrences) and
+    # verify them in ONE fused forward (llama.verify_window) — the weight
+    # stream amortizes over gamma+1 tokens, so accepted runs multiply
+    # decode throughput on repetitive/structured text. Greedy-only
+    # (temperature 0); slots without a match fall back to a plain
+    # single-token step inside the same dispatch. Preserves the greedy
+    # stream except at exact logit ties (the verify pass splits
+    # history/window attention differently than plain decode, so tied
+    # argmaxes can resolve differently — the standard spec-decode
+    # caveat). 0 = off.
+    spec_gamma: int = 0
+    spec_ngram: int = 3
     # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
     # per-output-channel scales; halves decode's HBM weight streaming, the
     # ref's FP8 serving equivalent, docs/architecture.md:57-61)
@@ -113,6 +127,12 @@ class EngineConfig:
                 "JaxEngine stores kv heads in blocked (natural) order; "
                 f"kv_head_layout={self.kv_head_layout!r} would mislabel the "
                 "cache — foreign layouts belong on the transfer metadata"
+            )
+        if self.spec_gamma > 0 and self.decode_window < 2:
+            raise ValueError(
+                "spec_gamma requires decode_window >= 2: the speculative "
+                "path only engages when the scheduler picks multi-step "
+                "windows (decode_window=1 would silently disable it)"
             )
         if self.max_context == 0:
             self.max_context = self.model.max_position_embeddings
@@ -245,6 +265,8 @@ class JaxEngine(AsyncEngine):
             "prefix_cache_hits_tokens": 0,
             "decode_steps": 0,
             "preemptions": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
         }
 
     # ---------------- public api ----------------
@@ -789,6 +811,34 @@ class JaxEngine(AsyncEngine):
                 if self._n_active == 0:  # drain may finish survivors
                     return
 
+        # Speculative decoding: greedy-only batches with an n-gram match
+        # verify gamma proposals in one fused forward instead of a decode
+        # window. Unchained (drains any pipeline first); bails to the
+        # normal path when blocks are short or nothing matched.
+        if (
+            cfg.spec_gamma > 0
+            and self.mirror is None
+            and self.mesh is None
+            and n > 1
+            and self._prefill_state is None
+            and all(
+                self._temps[i] == 0.0
+                for i, s in enumerate(self._active) if s is not None
+            )
+        ):
+            # drain BEFORE proposing: an undrained window's tokens are
+            # part of each sequence's tail, and proposals matched against
+            # a stale tail would never be accepted by the verify
+            await self._drain_inflight()
+            pending = 0
+            if self._n_active == 0:
+                return
+            proposals = self._propose_ngram()
+            if proposals is not None and await self._spec_verify_once(
+                proposals
+            ):
+                return
+
         # Pipelined mode: dispatch window k+1 BEFORE draining window k.
         # Its token inputs are window k's last sampled tokens — a device
         # array, no host round trip — and positions/lengths/steps advance
@@ -833,6 +883,114 @@ class JaxEngine(AsyncEngine):
             await self._emit_window(prev)
         if not pipe:
             await self._drain_inflight()
+
+    def _propose_ngram(self) -> Optional[np.ndarray]:
+        """Prompt-lookup drafts: match each sequence's trailing n-gram
+        against its own earlier tokens and propose the continuation that
+        followed last time (the draft-model-free speculation vLLM ships
+        as prompt lookup / assisted generation). Returns [B, gamma] with
+        -1 padding (never matches a real token id), or None when no slot
+        produced a proposal."""
+        g, ng = self.cfg.spec_gamma, self.cfg.spec_ngram
+        out = np.full((self.cfg.max_batch_size, g), -1, np.int64)
+        found = False
+        for i, seq in enumerate(self._active):
+            if seq is None or seq.finished:
+                continue
+            toks = seq.tokens
+            if len(toks) < ng + 2:
+                continue
+            # vectorized sliding match over a bounded tail window (one
+            # array conversion + ng compares, not a python tuple scan)
+            arr = np.asarray(toks[-4097:], np.int64)
+            key = arr[-ng:]
+            hay = arr[:-1]  # a match ending at the tail itself is useless
+            hits = hay[: len(hay) - ng + 1] == key[0]
+            for k in range(1, ng):
+                hits &= hay[k : len(hay) - ng + 1 + k] == key[k]
+            idx = np.flatnonzero(hits)
+            # the most recent occurrence BEFORE the trailing one
+            idx = idx[idx < len(arr) - ng]
+            if idx.size == 0:
+                continue
+            j = int(idx[-1])
+            cont = arr[j + ng : j + ng + g]
+            if cont.size:
+                out[i, : cont.size] = cont
+                found = True
+        return out if found else None
+
+    async def _spec_verify_once(self, proposals: np.ndarray) -> bool:
+        """One fused verify of gamma proposals + bonus token per slot.
+        Returns False (caller falls back to a plain window) when block
+        headroom for the in-flight rows isn't available without
+        preempting — speculation must never cause a preemption."""
+        cfg = self.cfg
+        g = cfg.spec_gamma
+        T = g + 1
+        if T > cfg.block_size:
+            return False  # in-flight rows must fit a page (append kernel)
+        for seq in list(self._active):
+            if seq is None or seq.finished or seq.slot < 0:
+                continue
+            while seq.seq_len + g > len(seq.blocks) * cfg.block_size:
+                if len(seq.blocks) >= cfg.max_blocks_per_seq:
+                    return False  # near context limit: plain windows clamp
+                extra = self.allocator.allocate(1)
+                if extra is None:
+                    return False
+                seq.blocks.extend(extra)
+                self._block_tables[seq.slot] = self._table_for(seq)
+
+        # window tokens: last accepted token + proposals (-1 -> 0 for a
+        # safe embed; acceptance below compares against the ORIGINAL -1s,
+        # which no real pred equals)
+        window = np.zeros((cfg.max_batch_size, T), np.int32)
+        window[:, 0] = self._last_tokens
+        window[:, 1:] = np.maximum(proposals, 0)
+        async with self._device_lock:
+            preds = await asyncio.get_running_loop().run_in_executor(
+                None, self._dispatch_verify, window
+            )
+        self.stats["decode_steps"] += 1
+        for i, seq in list(enumerate(self._active)):
+            if seq is None or seq.finished:
+                continue
+            n_acc = 0
+            while n_acc < g and proposals[i, n_acc] == preds[i, n_acc]:
+                n_acc += 1
+            self.stats["spec_proposed"] += int((proposals[i] >= 0).sum())
+            self.stats["spec_accepted"] += n_acc
+            for t in range(n_acc + 1):
+                if seq.finished:
+                    break
+                self._emit_token(seq, int(preds[i, t]))
+            if seq.finished or self._active[i] is not seq:
+                continue
+            self._seq_lens[i] = seq.seq_len
+            self._last_tokens[i] = seq.tokens[-1]
+            self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
+        return True
+
+    def _dispatch_verify(self, window: np.ndarray) -> np.ndarray:
+        """Executor thread: fused verify forward. Returns preds [B, T]."""
+        cfg = self.cfg
+        if self.offload is not None:
+            self.offload.flush_evictions(self.k_cache, self.v_cache)
+        positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
+        preds, _n_acc, self.k_cache, self.v_cache = llama.verify_window(
+            self.params,
+            cfg.model,
+            jnp.asarray(window),
+            jnp.asarray(positions),
+            jnp.asarray(self._block_tables),
+            jnp.asarray(self._seq_lens),
+            self.k_cache,
+            self.v_cache,
+            n_spec=cfg.spec_gamma,
+            use_pallas=self.use_pallas,
+        )
+        return np.asarray(jax.device_get(preds))
 
     async def _drain_inflight(self) -> None:
         """Sync + emit the pending pipelined window, if any."""

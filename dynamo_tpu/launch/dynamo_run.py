@@ -126,6 +126,10 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         host_cache_blocks=args.host_cache_blocks,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,
+        decode_window=args.decode_window,
+        decode_pipeline=args.decode_pipeline,
+        spec_gamma=args.spec_gamma,
+        spec_ngram=args.spec_ngram,
     )
 
 
@@ -473,6 +477,14 @@ def main(argv=None) -> None:
                    choices=["model", "float8_e4m3", "bfloat16"],
                    help="KV cache storage dtype (float8 = scale-free cast)")
     p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--decode-window", type=int, default=4,
+                   help="fused decode steps per device dispatch")
+    p.add_argument("--decode-pipeline", action="store_true",
+                   help="overlap host work with the next decode window")
+    p.add_argument("--spec-gamma", type=int, default=0,
+                   help="speculative decoding: proposals per verify (0=off)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="speculative decoding: lookup n-gram length")
     p.add_argument("--max-context", type=int, default=0)
     p.add_argument("--namespace", default="dynamo",
                    help="in=prefill queue namespace — must match the decode "

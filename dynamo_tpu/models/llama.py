@@ -631,6 +631,85 @@ def decode_window(
     return toks, k_cache, v_cache
 
 
+# ---------------- speculative verify (prompt-lookup decoding) ----------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_spec", "use_pallas", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def verify_window(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T]: t=0 last accepted token, t>=1 proposals
+    positions: jnp.ndarray,  # [B] absolute position of tokens[:, 0]
+    block_tables: jnp.ndarray,  # [B, M]
+    seq_lens: jnp.ndarray,  # [B] length INCLUDING tokens[:, 0]
+    k_cache: jnp.ndarray,  # donated; holds history only (rows < seq_len-1)
+    v_cache: jnp.ndarray,
+    n_spec: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
+    """Speculative-decoding verify: score T = n_spec+1 in-flight tokens
+    per sequence in ONE forward pass (the weight stream amortizes over
+    T tokens — the whole point of speculation; the reference gets this
+    from vLLM's spec-decode worker).
+
+    Returns (preds [B, T], n_acc [B], k_cache, v_cache): ``preds[:, t]``
+    is the model's (greedy) next token after position ``positions + t``;
+    ``n_acc`` counts leading proposals confirmed (``preds[:, t-1] ==
+    tokens[:, t]``), so the caller emits ``preds[:, :n_acc+1]`` — the
+    accepted run plus the free correction/bonus token. All T rows' K/V
+    append to the cache in place; rows past the accepted run hold the
+    rejected proposals' K/V, which live above the commit horizon and are
+    overwritten by the next dispatch before any read (same invariant as
+    a discarded decode-window tail).
+    """
+    from ..ops.kv_cache_update_pallas import kv_cache_append_tokens
+
+    T = n_spec + 1
+    B, E = tokens.shape[0], cfg.hidden_size
+    inv_freq = _rope_freqs(cfg)
+    scale = cfg.head_dim**-0.5
+    pos_bt = positions[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    hist_lens = seq_lens - 1  # cache rows before the in-flight window
+    x = params["embed"][tokens.reshape(-1)].reshape(B, T, E)
+
+    k_news, v_news = [], []
+    for l in range(cfg.num_layers):
+        lp = jax.tree.map(lambda a: a[l], params["layers"])
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
+        q = apply_rope(q, pos_bt, inv_freq)
+        k = apply_rope(k, pos_bt, inv_freq)
+        k_news.append(k)
+        v_news.append(v)
+        o = att.verify_attention(
+            q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+            scale, use_pallas=use_pallas, interpret=interpret,
+        )
+        x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _ffn(lp, cfg, h.reshape(B * T, E)).reshape(B, T, E)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+
+    ok = preds[:, :-1] == tokens[:, 1:]  # proposal t confirmed by pred t-1
+    n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+    bs = k_cache.shape[3]
+    blk = jnp.take_along_axis(block_tables, pos_bt // bs, axis=1)
+    off = pos_bt % bs
+    k_cache, v_cache = kv_cache_append_tokens(
+        jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
+        interpret=interpret or not use_pallas,
+    )
+    return preds, n_acc, k_cache, v_cache
+
+
 # ---------------- reference dense forward (tests) ----------------
 
 

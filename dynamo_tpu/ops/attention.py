@@ -252,6 +252,106 @@ def decode_attention_merged_sharded(
     )(q, k_new, v_new, k_cache_layer, v_cache_layer, block_tables, hist_lens)
 
 
+def verify_attention(
+    q: jnp.ndarray,  # [B, T, H, D] queries for T in-flight tokens per seq
+    k_win: jnp.ndarray,  # [B, T, Hkv, D] their keys (rope'd, NOT in cache)
+    v_win: jnp.ndarray,  # [B, T, Hkv, D]
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D] history only
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, M]
+    hist_lens: jnp.ndarray,  # [B] tokens in cache (before the T in-flight)
+    scale: float,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:  # [B, T, H, D]
+    """Multi-token decode attention (speculative-decoding verify): T
+    in-flight tokens per sequence attend cached history plus the causal
+    prefix of the in-flight window, all out-of-cache.
+
+    The Pallas path reuses the stats-emitting DECODE kernel unchanged:
+    every history row precedes every in-flight position, so no causal
+    masking is needed against history — the T*G query rows simply pack
+    into the kernel's query-group dimension. The tiny [T, T] intra-window
+    causal part is dense XLA, folded in with the same flash merge as
+    decode_attention_merged.
+    """
+    B, T, H, D = q.shape
+    Hkv = k_cache_layer.shape[0]
+    G = H // Hkv
+    if use_pallas:
+        from .paged_attention_pallas import paged_decode_attention
+
+        # rows ordered (hkv, t, g) so the kernel's internal
+        # reshape(B, Hkv, T*G, D) lands each row on its kv head
+        qp = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
+        qp = qp.reshape(B, Hkv * T * G, D)
+        o_h, m_h, l_h = paged_decode_attention(
+            qp, k_cache_layer, v_cache_layer, block_tables, hist_lens,
+            scale, return_stats=True, interpret=interpret,
+        )  # o: [B, Hkv*T*G, D]; m, l: [B, Hkv, T*G]
+        o_h = o_h.reshape(B, Hkv, T, G, D).astype(jnp.float32)
+        m_h = m_h.reshape(B, Hkv, T, G)
+        l_h = l_h.reshape(B, Hkv, T, G)
+    else:
+        o_h, m_h, l_h = _history_attention_xla(
+            q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale
+        )
+    # intra-window causal scores [B, Hkv, T, G, T']
+    qg = q.reshape(B, T, Hkv, G, D)
+    s_w = jnp.einsum(
+        "btkgd,bukd->bktgu",
+        qg.astype(jnp.float32) * scale,
+        k_win.astype(jnp.float32),
+    )
+    causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]  # [T, T']
+    s_w = jnp.where(causal[None, None, :, None, :], s_w, NEG_INF)
+    m_w = jnp.max(s_w, axis=-1)  # [B, Hkv, T, G]
+    m_f = jnp.maximum(m_h, m_w)
+    alpha = jnp.exp(m_h - m_f)
+    p_w = jnp.exp(s_w - m_f[..., None])  # [B, Hkv, T, G, T']
+    o_w = jnp.einsum("bktgu,bukd->bktgd", p_w, v_win.astype(jnp.float32))
+    l_w = jnp.sum(p_w, axis=-1)
+    num = (l_h * alpha)[..., None] * o_h + o_w
+    den = l_h * alpha + l_w
+    out = num / den[..., None]  # den >= 1 term from the diagonal (u == t)
+    return (
+        out.transpose(0, 2, 1, 3, 4).reshape(B, T, H, D).astype(q.dtype)
+    )
+
+
+def _history_attention_xla(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k_cache_layer: jnp.ndarray,
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    hist_lens: jnp.ndarray,
+    scale: float,
+):
+    """XLA twin of the stats-emitting kernel path: history-only attention
+    with raw softmax stats (o normalized, m row max, l normalizer) in the
+    [B, Hkv, T, G(, D)] layout verify_attention merges over."""
+    B, T, H, D = q.shape
+    M = block_tables.shape[1]
+    Hkv, _, bs, _ = k_cache_layer.shape
+    G = H // Hkv
+    k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    qg = q.reshape(B, T, Hkv, G, D)
+    s = jnp.einsum(
+        "btkgd,kbsd->bktgs", qg.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+    )
+    valid = jnp.arange(M * bs)[None, :] < hist_lens[:, None]  # [B, S]
+    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, Hkv, T, G]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(valid[:, None, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bktgs,kbsd->bktgd", p, v.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-20)[..., None]
+    return o, m, l
+
+
 def decode_attention_xla(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence
     k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]

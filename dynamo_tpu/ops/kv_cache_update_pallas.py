@@ -115,6 +115,120 @@ def kv_cache_append_sharded(
     )(k_new, v_new, k_cache, v_cache, blk, off)
 
 
+def _append_tokens_kernel(
+    # scalar prefetch
+    page_ref,  # [B] int32 this phase's target page per sequence
+    off0_ref,  # [B] int32 row of the FIRST in-flight token within page 0
+    # inputs
+    k_new_ref,  # [1, 1, T, Hkv, D]
+    v_new_ref,
+    k_page_ref,  # [1, Hkv, 1, bs, D] aliased page tile
+    v_page_ref,
+    # outputs (aliased)
+    k_out_ref,
+    v_out_ref,
+    *,
+    n_tokens: int,
+    block_size: int,
+    phase: int,  # 0: rows inside the first page; 1: spill into the next
+):
+    b = pl.program_id(1)
+    off0 = off0_ref[b]
+    k_out_ref[...] = k_page_ref[...]
+    v_out_ref[...] = v_page_ref[...]
+    for t in range(n_tokens):
+        kn = k_new_ref[0, 0, t].astype(k_out_ref.dtype)  # [Hkv, D]
+        vn = v_new_ref[0, 0, t].astype(v_out_ref.dtype)
+        row = off0 + t
+        mine = (row < block_size) if phase == 0 else (row >= block_size)
+        local = row if phase == 0 else jnp.maximum(row - block_size, 0)
+
+        @pl.when(mine)
+        def _w(kn=kn, vn=vn, local=local):
+            k_out_ref[0, :, 0, pl.ds(local, 1), :] = kn[:, None, :]
+            v_out_ref[0, :, 0, pl.ds(local, 1), :] = vn[:, None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(2, 3))
+def kv_cache_append_tokens(
+    k_new: jnp.ndarray,  # [L, B, T, Hkv, D] T in-flight tokens per seq
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D] donated
+    v_cache: jnp.ndarray,
+    blk: jnp.ndarray,  # [B, T] int32 physical page per (seq, token)
+    off: jnp.ndarray,  # [B, T] int32 row within the page
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Multi-token kv_cache_append (speculative-decoding verify): writes
+    T consecutive-position rows per sequence, all layers, in place.
+
+    T consecutive rows span at most TWO pages. Each page is RMW'd in its
+    own chained pallas_call (phase 0 = the first page's rows, phase 1 =
+    the spill into the next page) so one grid step owns each page — a
+    same-page RMW split across pipeline steps could read a stale
+    prefetched tile and lose the earlier step's rows. Sequences that
+    don't cross a boundary point phase 1 at the sacrificial page 0 (a
+    benign passthrough; real pages are never 0). Requires T <= block_size.
+    """
+    L, B, T, Hkv, D = k_new.shape
+    bs = k_cache.shape[3]
+    if T > bs:
+        raise ValueError(f"T={T} in-flight rows must fit a page (bs={bs})")
+    if interpret:
+        lidx = jnp.arange(L)[:, None, None]
+        bidx = jnp.arange(B)[None, :, None]
+        tidx = jnp.arange(T)[None, None, :]
+        k_cache = k_cache.at[lidx, :, blk[bidx, tidx], off[bidx, tidx]].set(
+            k_new.astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[lidx, :, blk[bidx, tidx], off[bidx, tidx]].set(
+            v_new.astype(v_cache.dtype)
+        )
+        return k_cache, v_cache
+
+    blk0 = blk[:, 0]
+    blk_last = blk[:, T - 1]
+    # no boundary cross -> phase 1 RMWs the trash page instead
+    blk1 = jnp.where(blk_last == blk0, 0, blk_last)
+    off0 = off[:, 0]
+
+    for phase, page in ((0, blk0), (1, blk1)):
+        page_spec = pl.BlockSpec(
+            (1, Hkv, 1, bs, D), lambda l, b, pg, o0: (l, 0, pg[b], 0, 0)
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(L, B),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, T, Hkv, D), lambda l, b, pg, o0: (l, b, 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, T, Hkv, D), lambda l, b, pg, o0: (l, b, 0, 0, 0)
+                ),
+                page_spec,
+                page_spec,
+            ],
+            out_specs=[page_spec, page_spec],
+        )
+        kernel = functools.partial(
+            _append_tokens_kernel, n_tokens=T, block_size=bs, phase=phase
+        )
+        k_cache, v_cache = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+            ],
+            input_output_aliases={4: 0, 5: 1},
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary"),
+            ),
+        )(page, off0, k_new, v_new, k_cache, v_cache)
+    return k_cache, v_cache
+
+
 def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
     """The pallas_call body shared by the single-device and shard_map
     paths (operates on whatever shard it is handed)."""

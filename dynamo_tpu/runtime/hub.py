@@ -311,16 +311,20 @@ class _HubConnection:
         bound its listener (the reference's runtime retries its etcd/NATS
         connects the same way)."""
         host, port = self.address.rsplit(":", 1)
-        deadline = asyncio.get_running_loop().time() + timeout
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
         delay = 0.1
         while True:
             try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    host, int(port)
+                # per-attempt cap: a black-holed address otherwise blocks
+                # in the OS connect far past the retry budget
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)),
+                    max(deadline - loop.time(), 0.05),
                 )
                 break
-            except (ConnectionRefusedError, OSError):
-                if asyncio.get_running_loop().time() >= deadline:
+            except (ConnectionRefusedError, OSError, asyncio.TimeoutError):
+                if loop.time() >= deadline:
                     raise
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 1.0)
