@@ -1,0 +1,46 @@
+"""Generate JSONL workload files for the in=batch harness.
+
+Shapes follow the reference's headline workloads: 3K ISL / 150 OSL
+(disagg throughput) and 4K ISL / 800 OSL (KV-routing latency), plus a
+multi-turn shape for the offload-tier benchmark. Prompts are synthetic
+token-ish text with a shared prefix fraction so the prefix cache and the
+KV router have something to hit.
+"""
+
+import argparse
+import json
+import random
+
+
+def words(rng: random.Random, n: int) -> str:
+    return " ".join(
+        rng.choice(["alpha", "beta", "gamma", "delta", "eps", "zeta",
+                    "eta", "theta", "iota", "kappa"])
+        for _ in range(n)
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--n", type=int, default=64, help="requests")
+    p.add_argument("--isl", type=int, default=3000, help="approx input words")
+    p.add_argument("--osl", type=int, default=150, help="max output tokens")
+    p.add_argument("--shared-prefix", type=float, default=0.25,
+                   help="fraction of ISL shared across requests")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    rng = random.Random(args.seed)
+    shared = words(rng, int(args.isl * args.shared_prefix))
+    with open(args.out, "w") as f:
+        for _ in range(args.n):
+            prompt = shared + " " + words(rng, args.isl - len(shared.split()))
+            f.write(json.dumps(
+                {"prompt": prompt, "max_tokens": args.osl}
+            ) + "\n")
+    print(f"wrote {args.n} requests to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,12 +38,21 @@ class Metrics:
         self.inflight: dict[tuple, int] = defaultdict(int)
         self.duration: dict[tuple, Histogram] = defaultdict(Histogram)
         self.tokens_total: dict[tuple, int] = defaultdict(int)
+        # serving-latency histograms (BASELINE targets: p50/p99 TTFT, ITL)
+        self.first_token: dict[tuple, Histogram] = defaultdict(Histogram)
+        self.inter_token: dict[tuple, Histogram] = defaultdict(Histogram)
 
     def inflight_guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
 
     def observe_tokens(self, model: str, kind: str, n: int) -> None:
         self.tokens_total[(model, kind)] += n
+
+    def observe_first_token(self, model: str, endpoint: str, v: float) -> None:
+        self.first_token[(model, endpoint)].observe(v)
+
+    def observe_inter_token(self, model: str, endpoint: str, v: float) -> None:
+        self.inter_token[(model, endpoint)].observe(v)
 
     def render(self) -> str:
         p = self.prefix
@@ -77,6 +86,28 @@ class Metrics:
             lines.append(
                 f'{p}_http_service_request_duration_seconds_count{{model="{model}",endpoint="{endpoint}"}} {h.n}'
             )
+        for name, table in (
+            ("first_token_seconds", self.first_token),
+            ("inter_token_seconds", self.inter_token),
+        ):
+            lines.append(f"# TYPE {p}_http_service_{name} histogram")
+            for (model, endpoint), h in sorted(table.items()):
+                cum = 0
+                for i, b in enumerate(_BUCKETS):
+                    cum += h.counts[i]
+                    lines.append(
+                        f'{p}_http_service_{name}_bucket{{model="{model}",endpoint="{endpoint}",le="{b}"}} {cum}'
+                    )
+                cum += h.counts[-1]
+                lines.append(
+                    f'{p}_http_service_{name}_bucket{{model="{model}",endpoint="{endpoint}",le="+Inf"}} {cum}'
+                )
+                lines.append(
+                    f'{p}_http_service_{name}_sum{{model="{model}",endpoint="{endpoint}"}} {h.total}'
+                )
+                lines.append(
+                    f'{p}_http_service_{name}_count{{model="{model}",endpoint="{endpoint}"}} {h.n}'
+                )
         lines.append(f"# TYPE {p}_tokens_total counter")
         for (model, kind), v in sorted(self.tokens_total.items()):
             lines.append(f'{p}_tokens_total{{model="{model}",kind="{kind}"}} {v}')
@@ -92,7 +123,21 @@ class InflightGuard:
         self._key = (model, endpoint)
         self._status = "error"
         self._start = time.monotonic()
+        self._last_token_t: float | None = None
         metrics.inflight[self._key] += 1
+
+    def observe_token(self) -> None:
+        """Per-streamed-chunk timing: the first call records TTFT, later
+        calls record inter-token gaps."""
+        now = time.monotonic()
+        model, endpoint = self._key
+        if self._last_token_t is None:
+            self._m.observe_first_token(model, endpoint, now - self._start)
+        else:
+            self._m.observe_inter_token(
+                model, endpoint, now - self._last_token_t
+            )
+        self._last_token_t = now
 
     def mark_ok(self) -> None:
         self._status = "success"

@@ -146,6 +146,9 @@ class HttpService(HttpServerBase):
                         error = ann.error or "engine error"
                         break
                     if ann.data is not None:
+                        # the engine streams internally even for folded
+                        # responses — TTFT/ITL are still real
+                        guard.observe_token()
                         chunks.append(ann.data)
                 if error is not None:
                     guard.mark("error")
@@ -206,6 +209,7 @@ class HttpService(HttpServerBase):
                             self._count_tokens(req.model, data)
                             if not include_usage:
                                 data = {k: v for k, v in data.items() if k != "usage"}
+                        guard.observe_token()  # TTFT / ITL histograms
                         await send(encode_data(data))
             except (ConnectionResetError, BrokenPipeError):
                 raise

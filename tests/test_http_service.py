@@ -147,13 +147,16 @@ def test_errors_and_metrics(run):
         status, _, _ = await http_request(svc.port, "POST", "/v1/chat/completions", b"{nope")
         assert status == 400
         # a good request, then check counters
-        ok = {"model": "echo", "messages": [{"role": "user", "content": "x"}],
+        ok = {"model": "echo", "messages": [{"role": "user", "content": "xyz"}],
               "nvext": {"use_raw_prompt": True}}
         await http_request(svc.port, "POST", "/v1/chat/completions", json.dumps(ok).encode())
         status, _, body = await http_request(svc.port, "GET", "/metrics")
         text = body.decode()
         assert 'requests_total{model="echo",endpoint="chat_completions",status="success"} 1' in text
         assert "request_duration_seconds_bucket" in text
+        # serving-latency histograms (BASELINE p50/p99 TTFT & ITL targets)
+        assert 'first_token_seconds_count{model="echo",endpoint="chat_completions"} 1' in text
+        assert "inter_token_seconds_bucket" in text
         await svc.close()
 
     run(main())
