@@ -103,13 +103,13 @@ class EngineConfig:
     # (last spec_ngram tokens matched against earlier occurrences) and
     # verify them in ONE fused forward (llama.verify_window) — the weight
     # stream amortizes over gamma+1 tokens, so accepted runs multiply
-    # decode throughput on repetitive/structured text. Greedy-only
-    # (temperature 0); slots without a match fall back to a plain
-    # single-token step inside the same dispatch. Preserves the greedy
-    # stream except at exact logit ties (the verify pass splits
-    # history/window attention differently than plain decode, so tied
-    # argmaxes can resolve differently — the standard spec-decode
-    # caveat). 0 = off.
+    # decode throughput on repetitive/structured text. Greedy rows accept
+    # argmax-matching proposals; sampled rows use rejection sampling
+    # against the deterministic draft (lossless in distribution). Slots
+    # without a match fall back to a plain single-token step inside the
+    # same dispatch. Greedy streams are preserved except at exact logit
+    # ties; sampled streams match plain decode in distribution, not
+    # token-for-token (the standard spec-decode contract). 0 = off.
     spec_gamma: int = 0
     spec_ngram: int = 3
     # weight quantization: "none" | "int8" | "fp8_e4m3" (models/quant.py —
@@ -821,10 +821,6 @@ class JaxEngine(AsyncEngine):
             and self.mesh is None
             and n > 1
             and self._prefill_state is None
-            and all(
-                self._temps[i] == 0.0
-                for i, s in enumerate(self._active) if s is not None
-            )
         ):
             # drain BEFORE proposing: an undrained window's tokens are
             # part of each sequence's tail, and proposals matched against
@@ -943,28 +939,32 @@ class JaxEngine(AsyncEngine):
                 self._block_tables[seq.slot] = self._table_for(seq)
 
         # window tokens: last accepted token + proposals (-1 -> 0 for a
-        # safe embed; acceptance below compares against the ORIGINAL -1s,
-        # which no real pred equals)
+        # safe embed; acceptance on device uses the ORIGINAL -1s, which
+        # never accept)
         window = np.zeros((cfg.max_batch_size, T), np.int32)
         window[:, 0] = self._last_tokens
         window[:, 1:] = np.maximum(proposals, 0)
+        steps = np.asarray(
+            [self._active[i].generated if self._active[i] else 0
+             for i in range(cfg.max_batch_size)],
+            np.int32,
+        )
         async with self._device_lock:
-            preds = await asyncio.get_running_loop().run_in_executor(
-                None, self._dispatch_verify, window
+            out_toks, n_accs = await asyncio.get_running_loop().run_in_executor(
+                None, self._dispatch_verify, window,
+                proposals.astype(np.int32), steps,
             )
         self.stats["decode_steps"] += 1
         for i, seq in list(enumerate(self._active)):
             if seq is None or seq.finished:
                 continue
-            n_acc = 0
-            while n_acc < g and proposals[i, n_acc] == preds[i, n_acc]:
-                n_acc += 1
+            n_acc = int(n_accs[i])
             self.stats["spec_proposed"] += int((proposals[i] >= 0).sum())
             self.stats["spec_accepted"] += n_acc
             for t in range(n_acc + 1):
                 if seq.finished:
                     break
-                self._emit_token(seq, int(preds[i, t]))
+                self._emit_token(seq, int(out_toks[i, t]))
             if seq.finished or self._active[i] is not seq:
                 continue
             self._seq_lens[i] = seq.seq_len
@@ -972,25 +972,37 @@ class JaxEngine(AsyncEngine):
             self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
         return True
 
-    def _dispatch_verify(self, window: np.ndarray) -> np.ndarray:
-        """Executor thread: fused verify forward. Returns preds [B, T]."""
+    def _dispatch_verify(
+        self, window: np.ndarray, proposals: np.ndarray, steps: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Executor thread: fused verify forward + on-device acceptance.
+        Returns (out_tokens [B, T], n_acc [B])."""
         cfg = self.cfg
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
-        preds, _n_acc, self.k_cache, self.v_cache = llama.verify_window(
+        out, n_acc, self.k_cache, self.v_cache = llama.verify_window(
             self.params,
             cfg.model,
             jnp.asarray(window),
+            jnp.asarray(proposals),
             jnp.asarray(positions),
             jnp.asarray(self._block_tables),
             jnp.asarray(self._seq_lens),
+            jnp.asarray(self._seeds),
+            jnp.asarray(steps),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps),
             self.k_cache,
             self.v_cache,
             n_spec=cfg.spec_gamma,
             use_pallas=self.use_pallas,
         )
-        return np.asarray(jax.device_get(preds))
+        return (
+            np.asarray(jax.device_get(out)),
+            np.asarray(jax.device_get(n_acc)),
+        )
 
     async def _drain_inflight(self) -> None:
         """Sync + emit the pending pipelined window, if any."""

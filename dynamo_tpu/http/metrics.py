@@ -68,25 +68,8 @@ class Metrics:
             lines.append(
                 f'{p}_http_service_inflight_requests{{model="{model}",endpoint="{endpoint}"}} {v}'
             )
-        lines.append(f"# TYPE {p}_http_service_request_duration_seconds histogram")
-        for (model, endpoint), h in sorted(self.duration.items()):
-            cum = 0
-            for i, b in enumerate(_BUCKETS):
-                cum += h.counts[i]
-                lines.append(
-                    f'{p}_http_service_request_duration_seconds_bucket{{model="{model}",endpoint="{endpoint}",le="{b}"}} {cum}'
-                )
-            cum += h.counts[-1]
-            lines.append(
-                f'{p}_http_service_request_duration_seconds_bucket{{model="{model}",endpoint="{endpoint}",le="+Inf"}} {cum}'
-            )
-            lines.append(
-                f'{p}_http_service_request_duration_seconds_sum{{model="{model}",endpoint="{endpoint}"}} {h.total}'
-            )
-            lines.append(
-                f'{p}_http_service_request_duration_seconds_count{{model="{model}",endpoint="{endpoint}"}} {h.n}'
-            )
         for name, table in (
+            ("request_duration_seconds", self.duration),
             ("first_token_seconds", self.first_token),
             ("inter_token_seconds", self.inter_token),
         ):

@@ -30,6 +30,22 @@ from .metrics import Metrics
 logger = logging.getLogger(__name__)
 
 
+def _chunk_has_tokens(data) -> bool:
+    """True when an SSE chunk carries generated content — finish-only and
+    usage-only chunks must not pollute the TTFT/ITL histograms."""
+    if not isinstance(data, dict):
+        return True  # raw engine items (tests/custom engines) count
+    choices = data.get("choices") or []
+    for c in choices:
+        delta = c.get("delta") or {}
+        if delta.get("content") or c.get("text"):
+            return True
+        msg = c.get("message") or {}
+        if msg.get("content"):
+            return True
+    return False
+
+
 class ModelManager:
     """Live model registry (ref http/service.rs:58 ModelManager): model name
     -> engine, hot add/remove as workers come and go."""
@@ -148,7 +164,8 @@ class HttpService(HttpServerBase):
                     if ann.data is not None:
                         # the engine streams internally even for folded
                         # responses — TTFT/ITL are still real
-                        guard.observe_token()
+                        if _chunk_has_tokens(ann.data):
+                            guard.observe_token()
                         chunks.append(ann.data)
                 if error is not None:
                     guard.mark("error")
@@ -209,7 +226,8 @@ class HttpService(HttpServerBase):
                             self._count_tokens(req.model, data)
                             if not include_usage:
                                 data = {k: v for k, v in data.items() if k != "usage"}
-                        guard.observe_token()  # TTFT / ITL histograms
+                        if _chunk_has_tokens(data):
+                            guard.observe_token()  # TTFT / ITL histograms
                         await send(encode_data(data))
             except (ConnectionResetError, BrokenPipeError):
                 raise

@@ -634,39 +634,17 @@ def decode_window(
 # ---------------- speculative verify (prompt-lookup decoding) ----------------
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "n_spec", "use_pallas", "interpret"),
-    donate_argnames=("k_cache", "v_cache"),
-)
-def verify_window(
-    params: dict,
-    cfg: ModelConfig,
-    tokens: jnp.ndarray,  # [B, T]: t=0 last accepted token, t>=1 proposals
-    positions: jnp.ndarray,  # [B] absolute position of tokens[:, 0]
-    block_tables: jnp.ndarray,  # [B, M]
-    seq_lens: jnp.ndarray,  # [B] length INCLUDING tokens[:, 0]
-    k_cache: jnp.ndarray,  # donated; holds history only (rows < seq_len-1)
-    v_cache: jnp.ndarray,
-    n_spec: int,
-    use_pallas: bool = False,
-    interpret: bool = False,
+def _verify_forward(
+    params, cfg, tokens, positions, block_tables, seq_lens,
+    k_cache, v_cache, n_spec, use_pallas=False, interpret=False,
 ):
-    """Speculative-decoding verify: score T = n_spec+1 in-flight tokens
-    per sequence in ONE forward pass (the weight stream amortizes over
-    T tokens — the whole point of speculation; the reference gets this
-    from vLLM's spec-decode worker).
-
-    Returns (preds [B, T], n_acc [B], k_cache, v_cache): ``preds[:, t]``
-    is the model's (greedy) next token after position ``positions + t``;
-    ``n_acc`` counts leading proposals confirmed (``preds[:, t-1] ==
-    tokens[:, t]``), so the caller emits ``preds[:, :n_acc+1]`` — the
-    accepted run plus the free correction/bonus token. All T rows' K/V
-    append to the cache in place; rows past the accepted run hold the
-    rejected proposals' K/V, which live above the commit horizon and are
-    overwritten by the next dispatch before any read (same invariant as
-    a discarded decode-window tail).
-    """
+    """The fused multi-token forward of the speculative verify: logits
+    for T = n_spec+1 in-flight tokens per sequence in one pass (the
+    weight stream amortizes over T — the whole point of speculation),
+    with all T rows' K/V appended to the cache in place. Rows past the
+    accepted run hold rejected proposals' K/V, which live above the
+    commit horizon and are overwritten before any read (same invariant
+    as a discarded decode-window tail)."""
     from ..ops.kv_cache_update_pallas import kv_cache_append_tokens
 
     T = n_spec + 1
@@ -695,10 +673,6 @@ def verify_window(
         x = x + _ffn(lp, cfg, h.reshape(B * T, E)).reshape(B, T, E)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
-    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
-
-    ok = preds[:, :-1] == tokens[:, 1:]  # proposal t confirmed by pred t-1
-    n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
 
     bs = k_cache.shape[3]
     blk = jnp.take_along_axis(block_tables, pos_bt // bs, axis=1)
@@ -707,7 +681,63 @@ def verify_window(
         jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
         interpret=interpret or not use_pallas,
     )
-    return preds, n_acc, k_cache, v_cache
+    return logits, k_cache, v_cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_spec", "use_pallas", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def verify_window(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T]: t=0 last accepted token, t>=1 proposals
+    proposals: jnp.ndarray,  # [B, n_spec] int32, -1 = no proposal
+    positions: jnp.ndarray,  # [B] absolute position of tokens[:, 0]
+    block_tables: jnp.ndarray,  # [B, M]
+    seq_lens: jnp.ndarray,  # [B] length INCLUDING tokens[:, 0]
+    seeds: jnp.ndarray,  # [B] int32 sampling seeds
+    steps: jnp.ndarray,  # [B] int32 per-request generation counters
+    temps: jnp.ndarray,  # [B] float32; 0 = greedy row
+    top_ks: jnp.ndarray,  # [B] int32
+    top_ps: jnp.ndarray,  # [B] float32
+    k_cache: jnp.ndarray,  # donated; holds history only (rows < seq_len-1)
+    v_cache: jnp.ndarray,
+    n_spec: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
+    """Speculative verify + acceptance (greedy AND sampled rows):
+
+      * greedy rows accept proposals matching the argmax chain;
+      * sampled rows use rejection sampling against the deterministic
+        draft (ops/sampling.speculative_accept) — lossless in
+        distribution; accept draws come from a tweaked seed stream
+        (seed ^ 0x5EC) so emitted-token keys stay identical to the
+        plain decode stream (replay-exactness of resumed requests).
+
+    Returns (out_tokens [B, T], n_acc [B], k_cache, v_cache): the caller
+    emits out_tokens[:, :n_acc+1] — accepted run + correction/bonus.
+    """
+    from ..ops.sampling import make_keys, speculative_accept
+
+    T = n_spec + 1
+    logits, k_cache, v_cache = _verify_forward(
+        params, cfg, tokens, positions, block_tables, seq_lens,
+        k_cache, v_cache, n_spec, use_pallas, interpret,
+    )
+    keys_accept = jnp.stack(
+        [make_keys(seeds ^ 0x5EC, steps + t) for t in range(n_spec)], axis=1
+    ) if n_spec else jnp.zeros((tokens.shape[0], 0, 2), jnp.uint32)
+    keys_sample = jnp.stack(
+        [make_keys(seeds, steps + t) for t in range(T)], axis=1
+    )
+    out, n_acc = speculative_accept(
+        logits.astype(jnp.float32), proposals, keys_accept, keys_sample,
+        temps, top_ks, top_ps,
+    )
+    return out, n_acc, k_cache, v_cache
 
 
 # ---------------- reference dense forward (tests) ----------------

@@ -33,25 +33,13 @@ def sample_tokens(
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def do_sample(scaled: jnp.ndarray) -> jnp.ndarray:
-        def apply_filters(scaled: jnp.ndarray) -> jnp.ndarray:
-            # top-k: mask everything below the k-th largest
-            kth = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [B]
-            sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
-            kth_val = jnp.take_along_axis(
-                sorted_desc, (kth - 1)[:, None], axis=1
-            )  # [B,1]
-            scaled = jnp.where(scaled < kth_val, NEG_INF, scaled)
-            # top-p (nucleus): keep smallest set with cumulative prob >= p
-            probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
-            cum = jnp.cumsum(probs_sorted, axis=-1)
-            inside = cum - probs_sorted < top_p[:, None]
-            thresh = jnp.min(
-                jnp.where(inside, sorted_desc, jnp.inf), axis=-1, keepdims=True
-            )
-            return jnp.where(scaled < thresh, NEG_INF, scaled)
-
         needs_filter = jnp.any((top_k > 0) | (top_p < 1.0))
-        scaled = jax.lax.cond(needs_filter, apply_filters, lambda s: s, scaled)
+        scaled = jax.lax.cond(
+            needs_filter,
+            lambda s: _apply_topk_topp(s, top_k, top_p),
+            lambda s: s,
+            scaled,
+        )
 
         def sample_one(key_data, row):
             key = jax.random.wrap_key_data(key_data)
@@ -64,6 +52,124 @@ def sample_tokens(
     scaled = logits / t
     all_greedy = jnp.all(temperature <= 0.0)
     return jax.lax.cond(all_greedy, lambda s: greedy, do_sample, scaled)
+
+
+def _apply_topk_topp(
+    scaled: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    """Mask temperature-scaled logits to the top-k / nucleus support."""
+    V = scaled.shape[-1]
+    # top-k: mask everything below the k-th largest
+    kth = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)  # [B]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
+    kth_val = jnp.take_along_axis(
+        sorted_desc, (kth - 1)[:, None], axis=1
+    )  # [B,1]
+    scaled = jnp.where(scaled < kth_val, NEG_INF, scaled)
+    # top-p (nucleus): keep smallest set with cumulative prob >= p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    inside = cum - probs_sorted < top_p[:, None]
+    thresh = jnp.min(
+        jnp.where(inside, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(scaled < thresh, NEG_INF, scaled)
+
+
+def filtered_dist(
+    logits: jnp.ndarray,  # [B, V] float32
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """The exact masked/temperature-scaled logits sample_tokens draws
+    from (speculative acceptance must score proposals against the SAME
+    distribution the plain sampler uses)."""
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    return _apply_topk_topp(logits / t, top_k, top_p)
+
+
+def speculative_accept(
+    logits: jnp.ndarray,  # [B, T, V] f32: position t predicts token t+1
+    proposals: jnp.ndarray,  # [B, T-1] int32, -1 = no proposal (never accepts)
+    keys_accept: jnp.ndarray,  # [B, T-1, 2] uint32 key data (accept draws)
+    keys_sample: jnp.ndarray,  # [B, T, 2] uint32 key data (corr/bonus draws)
+    temperature: jnp.ndarray,  # [B] 0 => greedy rows
+    top_k: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+) -> tuple[jnp.ndarray, jnp.ndarray]:  # (out_tokens [B, T], n_acc [B])
+    """Rejection-sampling acceptance for deterministic (prompt-lookup)
+    drafts — the draft distribution is a point mass on the proposal, so:
+
+      * accept proposal d_t with probability p_t(d_t)  (min(1, p/q), q=1)
+      * on rejection, sample the correction from the residual
+        max(0, p - q) ∝ p with d_t masked out — lossless in distribution
+      * greedy rows (temperature 0) degenerate to accept iff d_t == argmax
+
+    The full-acceptance bonus position (t = T-1) samples normally.
+    ``out_tokens[:, t]`` is d_t for t < n_acc and the correction/bonus at
+    t = n_acc; the caller emits exactly n_acc + 1 tokens per row."""
+    B, T, V = logits.shape
+    g = T - 1
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+    is_greedy = (temperature <= 0.0)[:, None]  # [B, 1]
+    d = jnp.maximum(proposals, 0)  # [B, g] safe gather index
+    valid = proposals >= 0
+    accept_greedy = (d == greedy[:, :g]) & valid
+    greedy_out = (accept_greedy, greedy)
+
+    def sampled_path(_):
+        # per-position filtered distributions (flattened over B*T); the
+        # full-vocab sort/softmax runs ONLY for batches with sampled rows
+        # (same all-greedy gating discipline as sample_tokens — the sort
+        # dominates fused-step time at V=32k)
+        scaled = filtered_dist(
+            logits.reshape(B * T, V), jnp.repeat(temperature, T),
+            jnp.repeat(top_k, T), jnp.repeat(top_p, T),
+        ).reshape(B, T, V)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        p_d = jnp.take_along_axis(probs[:, :g], d[..., None], axis=-1)[..., 0]
+
+        def uniform_one(key_data):
+            return jax.random.uniform(jax.random.wrap_key_data(key_data))
+
+        u = jax.vmap(jax.vmap(uniform_one))(keys_accept)  # [B, g]
+        accept = jnp.where(is_greedy, accept_greedy, (u < p_d) & valid)
+
+        # corrections: residual distribution (proposal masked) at t < g;
+        # plain distribution at the bonus position t = g and at invalid
+        # (unproposed) positions — index V is out of range, one_hot of it
+        # is all-zeros, so those rows mask nothing
+        d_mask = jnp.where(valid, d, V)
+        d_full = jnp.concatenate(
+            [d_mask, jnp.full((B, 1), V, jnp.int32)], axis=1
+        )
+        mask = jax.nn.one_hot(d_full, V, dtype=bool)  # [B, T, V]
+        resid = jnp.where(mask, NEG_INF, scaled)
+
+        def cat_one(key_data, row):
+            return jax.random.categorical(
+                jax.random.wrap_key_data(key_data), row
+            ).astype(jnp.int32)
+
+        corr = jax.vmap(jax.vmap(cat_one))(keys_sample, resid)  # [B, T]
+        # greedy rows' correction = argmax (d != argmax on rejection)
+        return accept, jnp.where(is_greedy, greedy, corr)
+
+    all_greedy = jnp.all(temperature <= 0.0)
+    accept, corr = jax.lax.cond(
+        all_greedy, lambda _: greedy_out, sampled_path, None
+    )
+    n_acc = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+    )  # [B]
+    t_idx = jnp.arange(T)[None, :]
+    out = jnp.where(
+        t_idx < n_acc[:, None],
+        jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1),
+        corr,
+    ).astype(jnp.int32)
+    return out, n_acc
 
 
 def make_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:

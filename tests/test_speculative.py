@@ -106,10 +106,13 @@ def test_verify_window_matches_forced_decode_steps():
         preds_ref.append(np.asarray(jnp.argmax(logits, axis=-1)))
     preds_ref = np.stack(preds_ref, axis=1)  # [B, T]
 
-    preds, n_acc, kc_v, vc_v = llama.verify_window(
+    logits_v, kc_v, vc_v = jax.jit(
+        llama._verify_forward, static_argnames=("cfg", "n_spec"),
+    )(
         params, cfg, window, seq_lens - 1, tables, seq_lens,
         jnp.copy(kc), jnp.copy(vc), n_spec=T - 1,
     )
+    preds = jnp.argmax(logits_v, axis=-1)
     np.testing.assert_allclose(
         np.asarray(preds), preds_ref, rtol=0, atol=0
     )
@@ -132,11 +135,18 @@ def test_verify_window_matches_forced_decode_steps():
         chain.append(np.asarray(jnp.argmax(logits, axis=-1), np.int32))
     win2 = np.stack(chain, axis=1)  # [B, T] true greedy continuation
     win2[0, 2] = (win2[0, 2] + 1) % cfg.vocab_size  # break seq0 at t=2
-    _, n_acc2, _, _ = llama.verify_window(
-        params, cfg, jnp.asarray(win2), seq_lens - 1, tables, seq_lens,
+    Z = jnp.zeros(B, jnp.int32)
+    out2, n_acc2, _, _ = llama.verify_window(
+        params, cfg, jnp.asarray(win2), jnp.asarray(win2[:, 1:]),
+        seq_lens - 1, tables, seq_lens,
+        Z, Z, jnp.zeros(B, jnp.float32), Z, jnp.ones(B, jnp.float32),
         jnp.copy(kc), jnp.copy(vc), n_spec=T - 1,
     )
     assert n_acc2.tolist() == [1, 3]
+    # emitted tokens: accepted proposals then the greedy correction
+    out2 = np.asarray(out2)
+    assert out2[0, 0] == win2[0, 1]
+    assert out2[1, :3].tolist() == win2[1, 1:].tolist()
 
 
 def test_engine_spec_decode_stream_matches_plain(run):
@@ -185,5 +195,107 @@ def test_engine_spec_decode_stream_matches_plain(run):
         assert stats[3]["spec_accepted"] > 0
         # fewer device dispatches than generated tokens when specs accept
         assert stats[3]["decode_steps"] < stats[0]["decode_steps"]
+
+    run(main())
+
+
+def test_speculative_accept_math():
+    """Rejection-sampling acceptance on crafted distributions: certain
+    proposals accept, impossible ones reject with a correction from the
+    residual; greedy rows degenerate to argmax comparison."""
+    from dynamo_tpu.ops.sampling import make_keys, speculative_accept
+
+    B, T, V = 4, 3, 16  # gamma = 2
+    g = T - 1
+    logits = np.full((B, T, V), -20.0, np.float32)
+    # row 0 (sampled): p(5) ~ 1.0 at every position -> accept both
+    logits[0, :, 5] = 20.0
+    # row 1 (sampled): proposal token has ~0 prob -> reject at t=0
+    logits[1, :, 7] = 20.0
+    # row 2 (greedy): argmax chain is token 9
+    logits[2, :, 9] = 20.0
+    # row 3: no proposals (padding) -> n_acc 0, plain sample at t=0
+    logits[3, :, 11] = 20.0
+
+    proposals = np.array(
+        [[5, 5], [3, 7], [9, 8], [-1, -1]], np.int32
+    )
+    temps = jnp.asarray([0.8, 0.8, 0.0, 0.7], jnp.float32)
+    tk = jnp.zeros(B, jnp.int32)
+    tp = jnp.ones(B, jnp.float32)
+    seeds = jnp.arange(B, dtype=jnp.int32)
+    ka = np.stack(
+        [np.asarray(make_keys(seeds ^ 0x5EC, jnp.full((B,), t, jnp.int32)))
+         for t in range(g)], axis=1,
+    )
+    ks = np.stack(
+        [np.asarray(make_keys(seeds, jnp.full((B,), t, jnp.int32)))
+         for t in range(T)], axis=1,
+    )
+    out, n_acc = speculative_accept(
+        jnp.asarray(logits), jnp.asarray(proposals), jnp.asarray(ka),
+        jnp.asarray(ks), temps, tk, tp,
+    )
+    out, n_acc = np.asarray(out), np.asarray(n_acc)
+
+    assert n_acc[0] == 2  # certain proposals accepted
+    assert out[0, 0] == 5 and out[0, 1] == 5
+    assert out[0, 2] == 5  # bonus drawn from p(5)~1
+
+    assert n_acc[1] == 0  # impossible proposal rejected immediately
+    assert out[1, 0] == 7  # correction from the residual (mass on 7)
+
+    assert n_acc[2] == 1  # greedy: first proposal == argmax, second not
+    assert out[2, 0] == 9 and out[2, 1] == 9  # correction = argmax
+
+    assert n_acc[3] == 0  # padding row: plain sample at t=0
+    assert out[3, 0] == 11
+
+
+def test_engine_spec_decode_sampled_requests(run):
+    """Sampled requests run through the speculative path too (rejection
+    sampling): streams complete at full length, the engine stays healthy,
+    and on repetitive text some proposals are accepted."""
+    from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    import asyncio
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(dtype="float32"), num_blocks=64,
+            block_size=8, max_batch_size=2, decode_window=4, spec_gamma=3,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        # mixed batch: the greedy row's repetitive continuation drives
+        # proposals (verify engages), the sampled row rides the same
+        # dispatches through rejection acceptance
+        greedy = PreprocessedRequest(
+            token_ids=[7, 8, 9, 10] * 6,
+            stop_conditions=StopConditions(max_tokens=24),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        )
+        sampled = PreprocessedRequest(
+            token_ids=[7, 8, 9, 10] * 6,
+            stop_conditions=StopConditions(max_tokens=24),
+            sampling_options=SamplingOptions(temperature=0.3, seed=42),
+            eos_token_ids=[],
+        )
+        out_g, out_s = await asyncio.gather(
+            collect(engine.generate(Context(greedy))),
+            collect(engine.generate(Context(sampled))),
+        )
+        for out in (out_g, out_s):
+            toks = [t for o in out for t in o.token_ids]
+            assert len(toks) == 24
+            assert out[-1].finish_reason.value == "length"
+        assert engine.stats["spec_proposed"] > 0
+        await engine.close()
 
     run(main())
