@@ -2,8 +2,9 @@
 
 Parsed from HF ``config.json`` (the reference reads the same artifact via
 its ModelDeploymentCard, model_card/create.rs). Covers Llama 2/3,
-DeepSeek-R1-Distill-Llama, Qwen2 (bias variant), Mistral, and
-Mixtral/DeepSeek-style MoE.
+DeepSeek-R1-Distill-Llama, Qwen2 (bias variant), Mistral, Gemma
+(GeGLU/(1+w)-norm/scaled-embedding variants), and Mixtral/DeepSeek-style
+MoE.
 """
 
 from __future__ import annotations
@@ -36,6 +37,10 @@ class ModelConfig:
     num_shared_experts: int = 0  # DeepSeek-style always-on experts
     first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
+    # gemma-family variants
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh" (gemma GeGLU)
+    rms_add_unit: bool = False  # gemma RMSNorm scales by (1 + w)
+    scale_embed: bool = False  # gemma multiplies embeddings by sqrt(E)
     # runtime
     dtype: str = "bfloat16"
 
@@ -55,6 +60,13 @@ class ModelConfig:
         qkv_bias = cfg.get("attention_bias", False) or any(
             a.startswith("Qwen2") for a in archs
         )
+        # gemma: GeGLU activation, (1+w) norms, sqrt(E)-scaled embeddings
+        is_gemma = any(a.startswith("Gemma") for a in archs) or (
+            cfg.get("model_type", "").startswith("gemma")
+        )
+        act = cfg.get("hidden_act") or cfg.get("hidden_activation") or "silu"
+        if act in ("gelu", "gelu_pytorch_tanh", "gelu_tanh"):
+            act = "gelu_tanh"
         return ModelConfig(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=cfg.get("hidden_size", 4096),
@@ -67,7 +79,7 @@ class ModelConfig:
             rope_scaling=cfg.get("rope_scaling"),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
             attention_bias=qkv_bias,
             num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
@@ -75,6 +87,9 @@ class ModelConfig:
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
             first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
+            hidden_act=act if act != "silu" else "silu",
+            rms_add_unit=is_gemma,
+            scale_embed=is_gemma,
             dtype=cfg.get("torch_dtype") or "bfloat16",
         )
 

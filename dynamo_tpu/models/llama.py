@@ -1,6 +1,7 @@
 """Llama-family model: pure-JAX functional forward over a paged KV cache.
 
-Covers Llama 2/3, DeepSeek-R1-Distill-Llama, Mistral, Qwen2 (bias) — the
+Covers Llama 2/3, DeepSeek-R1-Distill-Llama, Mistral, Qwen2 (bias), and
+Gemma (GeGLU, (1+w) norms folded at load, sqrt(E)-scaled embeddings) — the
 dense decoder families the reference serves through vLLM (README model
 list). Design is TPU-first, not a port:
 
@@ -142,6 +143,15 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) ->
     return out.astype(x.dtype)
 
 
+def _embed(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup; gemma scales activations by sqrt(E) (the
+    table itself must stay unscaled — it is tied to the lm head)."""
+    x = params["embed"][tokens]
+    if cfg.scale_embed:
+        x = (x.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(x.dtype)
+    return x
+
+
 def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """Matmul against a plain or quantized weight. Quantized weights are
     ``{"q": int8|float8 [in, out], "s": f32 [out]}`` (models/quant.py);
@@ -153,8 +163,13 @@ def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
-def swiglu(x, w_gate, w_up, w_down):
-    return _mm(jax.nn.silu(_mm(x, w_gate)) * _mm(x, w_up), w_down)
+def swiglu(x, w_gate, w_up, w_down, act: str = "silu"):
+    gate = _mm(x, w_gate)
+    gate = (
+        jax.nn.gelu(gate, approximate=True) if act == "gelu_tanh"
+        else jax.nn.silu(gate)
+    )
+    return _mm(gate * _mm(x, w_up), w_down)
 
 
 def _moe_route(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
@@ -327,7 +342,7 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
 def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
     if cfg.is_moe:
         return moe_ffn(lp, cfg, h, mesh=mesh)
-    return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"], cfg.hidden_act)
 
 
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -394,7 +409,7 @@ def prefill(
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     T = tokens.shape[0]
-    x = params["embed"][tokens]  # [T, E]
+    x = _embed(params, cfg, tokens)  # [T, E]
     positions = history_len + jnp.arange(T)
 
     def body(carry, layer_in):
@@ -445,7 +460,7 @@ def _decode_body(
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     B = tokens.shape[0]
-    x = params["embed"][tokens]  # [B, E]
+    x = _embed(params, cfg, tokens)  # [B, E]
 
     def layer_tail(x, lp, o):
         x = x + _mm(o.reshape(B, -1), lp["wo"])
@@ -653,7 +668,7 @@ def _verify_forward(
     scale = cfg.head_dim**-0.5
     pos_bt = positions[:, None] + jnp.arange(T)[None, :]  # [B, T]
     hist_lens = seq_lens - 1  # cache rows before the in-flight window
-    x = params["embed"][tokens.reshape(-1)].reshape(B, T, E)
+    x = _embed(params, cfg, tokens.reshape(-1)).reshape(B, T, E)
 
     k_news, v_news = [], []
     for l in range(cfg.num_layers):
@@ -749,7 +764,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
     inv_freq = _rope_freqs(cfg)
     scale = cfg.head_dim**-0.5
     T = tokens.shape[0]
-    x = params["embed"][tokens]
+    x = _embed(params, cfg, tokens)
     positions = jnp.arange(T)
 
     def body(x, lp):

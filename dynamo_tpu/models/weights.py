@@ -157,6 +157,14 @@ def load_llama_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = get("lm_head.weight").T
 
+    if cfg.rms_add_unit:
+        # gemma checkpoints store norm weights as offsets (the model
+        # scales by 1 + w); folding the +1 here keeps every runtime
+        # rms_norm call family-agnostic
+        layers["attn_norm"] = layers["attn_norm"] + 1.0
+        layers["mlp_norm"] = layers["mlp_norm"] + 1.0
+        params["final_norm"] = params["final_norm"] + 1.0
+
     # cast + (optionally) place on mesh shard-by-shard
     if mesh is not None:
         from ..parallel.mesh import shard_params
@@ -170,16 +178,23 @@ def load_llama_params(
     return params
 
 
-def save_llama_params(path: str, params: dict) -> None:
+def save_llama_params(path: str, params: dict, cfg=None) -> None:
     """Write params back out as a single safetensors file (testing and
     fixture generation)."""
     from safetensors.numpy import save_file
 
     flat: dict[str, np.ndarray] = {}
     L = params["layers"]["wq"].shape[0]
+    lay = dict(params["layers"])
+    final_norm = params["final_norm"]
+    if cfg is not None and getattr(cfg, "rms_add_unit", False):
+        # inverse of the load-time (1 + w) fold: gemma checkpoints store
+        # norm OFFSETS
+        lay["attn_norm"] = lay["attn_norm"] - 1.0
+        lay["mlp_norm"] = lay["mlp_norm"] - 1.0
+        final_norm = final_norm - 1.0
     flat["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
-    flat["model.norm.weight"] = np.asarray(params["final_norm"], np.float32)
-    lay = params["layers"]
+    flat["model.norm.weight"] = np.asarray(final_norm, np.float32)
     names = {
         "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
         "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),

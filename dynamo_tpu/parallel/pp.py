@@ -110,7 +110,9 @@ def pipelined_prefill(
     scale = D**-0.5
 
     # embeddings + final norm/head run under GSPMD outside the stage loop
-    x_all = params["embed"][tokens].reshape(n_micro, Tm, -1)
+    from ..models.llama import _embed
+
+    x_all = _embed(params, cfg, tokens).reshape(n_micro, Tm, -1)
     h_ax = "tp" if cfg.num_kv_heads % tp == 0 else None
     cache_spec = P("pp", h_ax, None, None, None)
 
@@ -145,7 +147,12 @@ def pipelined_prefill(
                 )
                 x = x + lax.psum(llama._mm(o.reshape(Tm, -1), lp["wo"]), "tp")
                 h = llama.rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-                up = jax.nn.silu(llama._mm(h, lp["w_gate"])) * llama._mm(h, lp["w_up"])
+                gate = llama._mm(h, lp["w_gate"])
+                gate = (
+                    jax.nn.gelu(gate, approximate=True)
+                    if cfg.hidden_act == "gelu_tanh" else jax.nn.silu(gate)
+                )
+                up = gate * llama._mm(h, lp["w_up"])
                 x = x + lax.psum(llama._mm(up, lp["w_down"]), "tp")
                 return x, (kc, vc)
 

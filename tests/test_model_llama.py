@@ -144,3 +144,56 @@ def test_sampling_deterministic_per_seed():
     a = sample_tokens(logits, k1, jnp.asarray([1.0]), jnp.asarray([0]), jnp.asarray([1.0]))
     b = sample_tokens(logits, k2, jnp.asarray([1.0]), jnp.asarray([0]), jnp.asarray([1.0]))
     assert int(a[0]) == int(b[0])
+
+
+def test_gemma_variant_paged_matches_dense():
+    """Gemma-family config (GeGLU, sqrt(E)-scaled embeddings, tied head):
+    the paged prefill+decode path must match the dense forward, same as
+    the llama families."""
+    cfg = ModelConfig.tiny(
+        dtype="float32", hidden_act="gelu_tanh", scale_embed=True,
+        tie_word_embeddings=True, rms_add_unit=True,  # fold is load-time
+    )
+    params = llama.init_params(cfg, jax.random.key(5))
+    assert "lm_head" not in params  # tied
+    prompt = jnp.asarray(np.random.RandomState(9).randint(0, cfg.vocab_size, 9))
+    dense = llama.dense_forward(params, cfg, prompt)
+
+    k_cache, v_cache = llama.init_kv_cache(cfg, num_blocks=16, block_size=BS)
+    T = 12
+    tokens = jnp.zeros(T, jnp.int32).at[:9].set(prompt)
+    table = make_table(1, T // BS, 8)
+    logits, k_cache, v_cache = llama.prefill(
+        params, cfg, tokens, table, jnp.int32(0), jnp.int32(9), k_cache, v_cache
+    )
+    np.testing.assert_allclose(logits, dense[8], rtol=2e-4, atol=2e-4)
+
+    # one decode step continues the dense chain
+    nxt = int(jnp.argmax(logits))
+    btables = jnp.stack([table, jnp.zeros(8, jnp.int32)])
+    logits_b, k_cache, v_cache = llama.decode_step(
+        params, cfg, jnp.asarray([nxt, 0]), jnp.asarray([9, 0]),
+        btables, jnp.asarray([10, 1]), k_cache, v_cache,
+    )
+    dense2 = llama.dense_forward(
+        params, cfg, jnp.concatenate([prompt, jnp.asarray([nxt])])
+    )
+    np.testing.assert_allclose(logits_b[0], dense2[-1], rtol=3e-4, atol=3e-4)
+
+
+def test_gemma_hf_config_parsing():
+    hf = {
+        "architectures": ["GemmaForCausalLM"],
+        "model_type": "gemma",
+        "vocab_size": 256000, "hidden_size": 3072,
+        "intermediate_size": 24576, "num_hidden_layers": 28,
+        "num_attention_heads": 16, "num_key_value_heads": 16,
+        "head_dim": 256, "hidden_act": "gelu_pytorch_tanh",
+        "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+        "max_position_embeddings": 8192,
+    }
+    cfg = ModelConfig.from_hf_config(hf)
+    assert cfg.hidden_act == "gelu_tanh"
+    assert cfg.rms_add_unit and cfg.scale_embed
+    assert cfg.tie_word_embeddings  # gemma default
+    assert cfg.head_dim == 256
