@@ -822,18 +822,25 @@ class JaxEngine(AsyncEngine):
             and n > 1
             and self._prefill_state is None
         ):
-            # drain BEFORE proposing: an undrained window's tokens are
-            # part of each sequence's tail, and proposals matched against
-            # a stale tail would never be accepted by the verify
-            await self._drain_inflight()
-            pending = 0
-            if self._n_active == 0:
-                return
+            # Proposals must come from the FRESH tail (an undrained
+            # window's tokens are part of it), but draining kills the
+            # pipeline overlap — so with a window in flight, first probe
+            # the stale tail cheaply: only a hit pays the drain, then
+            # re-proposes on the advanced tail. No stale hit -> stay
+            # pipelined (a fresh-only match is possible but rare, and the
+            # next iteration's stale probe would see it anyway).
             proposals = self._propose_ngram()
-            if proposals is not None and await self._spec_verify_once(
-                proposals
-            ):
-                return
+            if proposals is not None:
+                if self._inflight is not None:
+                    await self._drain_inflight()
+                    pending = 0
+                    if self._n_active == 0:
+                        return
+                    proposals = self._propose_ngram()
+                if proposals is not None and await self._spec_verify_once(
+                    proposals
+                ):
+                    return
 
         # Pipelined mode: dispatch window k+1 BEFORE draining window k.
         # Its token inputs are window k's last sampled tokens — a device

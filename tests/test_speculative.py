@@ -299,3 +299,49 @@ def test_engine_spec_decode_sampled_requests(run):
         await engine.close()
 
     run(main())
+
+
+def test_spec_with_pipeline_and_preemption_completes(run):
+    """The full feature stack at once — speculation, pipelined windows,
+    pool starvation with preemption — must still complete every request
+    at full length with a healthy engine."""
+    import asyncio
+
+    from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(dtype="float32"), num_blocks=14,
+            block_size=4, max_batch_size=4, max_context=128,
+            prefill_chunk=32, decode_window=4, decode_pipeline=True,
+            spec_gamma=3,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        reqs = [
+            PreprocessedRequest(
+                token_ids=[7, 8, 9, 10] * 3,
+                stop_conditions=StopConditions(max_tokens=24),
+                sampling_options=SamplingOptions(
+                    temperature=0.0 if i % 2 == 0 else 0.4, seed=i
+                ),
+                eos_token_ids=[],
+            )
+            for i in range(3)
+        ]
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(r))) for r in reqs]
+        )
+        for i, out in enumerate(outs):
+            toks = [t for o in out for t in o.token_ids]
+            assert len(toks) == 24, f"req {i}: {len(toks)}"
+            assert out[-1].finish_reason.value == "length"
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
