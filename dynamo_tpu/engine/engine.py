@@ -818,7 +818,6 @@ class JaxEngine(AsyncEngine):
         if (
             cfg.spec_gamma > 0
             and self.mirror is None
-            and self.mesh is None
             and n > 1
             and self._prefill_state is None
         ):
@@ -1005,6 +1004,7 @@ class JaxEngine(AsyncEngine):
             self.v_cache,
             n_spec=cfg.spec_gamma,
             use_pallas=self.use_pallas,
+            mesh=self.mesh,
         )
         return (
             np.asarray(jax.device_get(out)),

@@ -651,7 +651,7 @@ def decode_window(
 
 def _verify_forward(
     params, cfg, tokens, positions, block_tables, seq_lens,
-    k_cache, v_cache, n_spec, use_pallas=False, interpret=False,
+    k_cache, v_cache, n_spec, use_pallas=False, mesh=None, interpret=False,
 ):
     """The fused multi-token forward of the speculative verify: logits
     for T = n_spec+1 in-flight tokens per sequence in one pass (the
@@ -660,7 +660,10 @@ def _verify_forward(
     accepted run hold rejected proposals' K/V, which live above the
     commit horizon and are overwritten before any read (same invariant
     as a discarded decode-window tail)."""
-    from ..ops.kv_cache_update_pallas import kv_cache_append_tokens
+    from ..ops.kv_cache_update_pallas import (
+        kv_cache_append_tokens,
+        kv_cache_append_tokens_sharded,
+    )
 
     T = n_spec + 1
     B, E = tokens.shape[0], cfg.hidden_size
@@ -679,29 +682,41 @@ def _verify_forward(
         k = apply_rope(k, pos_bt, inv_freq)
         k_news.append(k)
         v_news.append(v)
-        o = att.verify_attention(
-            q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-            scale, use_pallas=use_pallas, interpret=interpret,
-        )
+        if use_pallas and mesh is not None:
+            o = att.verify_attention_sharded(
+                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+                scale, mesh, use_pallas=True, interpret=interpret,
+            )
+        else:
+            o = att.verify_attention(
+                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+                scale, use_pallas=use_pallas, interpret=interpret,
+            )
         x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h.reshape(B * T, E)).reshape(B, T, E)
+        x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(B, T, E)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
 
     bs = k_cache.shape[3]
     blk = jnp.take_along_axis(block_tables, pos_bt // bs, axis=1)
     off = pos_bt % bs
-    k_cache, v_cache = kv_cache_append_tokens(
-        jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
-        interpret=interpret or not use_pallas,
-    )
+    if use_pallas and mesh is not None:
+        k_cache, v_cache = kv_cache_append_tokens_sharded(
+            jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk,
+            off, mesh, interpret=interpret,
+        )
+    else:
+        k_cache, v_cache = kv_cache_append_tokens(
+            jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk, off,
+            interpret=interpret or not use_pallas,
+        )
     return logits, k_cache, v_cache
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_spec", "use_pallas", "interpret"),
+    static_argnames=("cfg", "n_spec", "use_pallas", "mesh", "interpret"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def verify_window(
@@ -721,6 +736,7 @@ def verify_window(
     v_cache: jnp.ndarray,
     n_spec: int,
     use_pallas: bool = False,
+    mesh=None,
     interpret: bool = False,
 ):
     """Speculative verify + acceptance (greedy AND sampled rows):
@@ -740,7 +756,7 @@ def verify_window(
     T = n_spec + 1
     logits, k_cache, v_cache = _verify_forward(
         params, cfg, tokens, positions, block_tables, seq_lens,
-        k_cache, v_cache, n_spec, use_pallas, interpret,
+        k_cache, v_cache, n_spec, use_pallas, mesh, interpret,
     )
     keys_accept = jnp.stack(
         [make_keys(seeds ^ 0x5EC, steps + t) for t in range(n_spec)], axis=1

@@ -319,6 +319,47 @@ def verify_attention(
     )
 
 
+def verify_attention_sharded(
+    q: jnp.ndarray,  # [B, T, H, D], H sharded over tp
+    k_win: jnp.ndarray,  # [B, T, Hkv, D], Hkv sharded over tp
+    v_win: jnp.ndarray,
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
+    v_cache_layer: jnp.ndarray,
+    block_tables: jnp.ndarray,  # replicated
+    hist_lens: jnp.ndarray,  # replicated
+    scale: float,
+    mesh,
+    use_pallas: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """verify_attention under shard_map over ``tp``: the paged-kernel
+    history pass, the dense intra-window part, and the flash merge are
+    all kv-head-parallel — each device computes its head shard on local
+    tiles, no collectives (same argument as decode_attention_merged)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        partial(
+            verify_attention, scale=scale, use_pallas=use_pallas,
+            interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),  # q
+            P(None, None, "tp", None),  # k_win
+            P(None, None, "tp", None),  # v_win
+            P("tp", None, None, None),  # k cache layer
+            P("tp", None, None, None),  # v cache layer
+            P(),  # tables
+            P(),  # hist_lens
+        ),
+        out_specs=P(None, None, "tp", None),
+        check_vma=False,
+    )(q, k_win, v_win, k_cache_layer, v_cache_layer, block_tables, hist_lens)
+
+
 def _history_attention_xla(
     q: jnp.ndarray,  # [B, T, H, D]
     k_cache_layer: jnp.ndarray,

@@ -229,6 +229,41 @@ def kv_cache_append_tokens(
     return k_cache, v_cache
 
 
+def kv_cache_append_tokens_sharded(
+    k_new: jnp.ndarray,  # [L, B, T, Hkv, D], Hkv sharded over tp
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D], Hkv sharded over tp
+    v_cache: jnp.ndarray,
+    blk: jnp.ndarray,  # [B, T] replicated
+    off: jnp.ndarray,  # [B, T] replicated
+    mesh,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """kv_cache_append_tokens under shard_map over ``tp`` (head-parallel,
+    no collectives — same argument as kv_cache_append_sharded)."""
+    import functools as _ft
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        _ft.partial(kv_cache_append_tokens, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, None, "tp", None),  # k_new
+            P(None, None, None, "tp", None),  # v_new
+            P(None, "tp", None, None, None),  # k_cache
+            P(None, "tp", None, None, None),  # v_cache
+            P(),  # blk
+            P(),  # off
+        ),
+        out_specs=(
+            P(None, "tp", None, None, None),
+            P(None, "tp", None, None, None),
+        ),
+        check_vma=False,
+    )(k_new, v_new, k_cache, v_cache, blk, off)
+
+
 def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
     """The pallas_call body shared by the single-device and shard_map
     paths (operates on whatever shard it is handed)."""

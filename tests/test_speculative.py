@@ -345,3 +345,68 @@ def test_spec_with_pipeline_and_preemption_completes(run):
         await engine.close()
 
     run(main())
+
+
+def test_verify_sharded_tp2_matches_single_device():
+    """verify_attention_sharded + kv_cache_append_tokens_sharded over a
+    tp=2 CPU mesh must match the single-device paths."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import verify_attention_sharded
+    from dynamo_tpu.ops.kv_cache_update_pallas import (
+        kv_cache_append_tokens,
+        kv_cache_append_tokens_sharded,
+    )
+
+    B, T, H, Hkv, D, M = 2, 3, 8, 4, 128, 4
+    N = B * M + 1
+    ks = jax.random.split(jax.random.key(2), 5)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (Hkv, N, BS, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (Hkv, N, BS, D), jnp.float32)
+    k_win = jax.random.normal(ks[3], (B, T, Hkv, D), jnp.float32)
+    v_win = jax.random.normal(ks[4], (B, T, Hkv, D), jnp.float32)
+    tables = jnp.asarray(np.arange(1, N, dtype=np.int32).reshape(B, M))
+    hist = jnp.asarray([3, BS + 1], jnp.int32)
+    scale = D**-0.5
+
+    ref = verify_attention(
+        q, k_win, v_win, kc, vc, tables, hist, scale,
+        use_pallas=True, interpret=True,
+    )
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 1, 1, 1, 2),
+                ("dp", "pp", "sp", "ep", "tp"))
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, None, "tp", None)))
+    kws = jax.device_put(k_win, NamedSharding(mesh, P(None, None, "tp", None)))
+    vws = jax.device_put(v_win, NamedSharding(mesh, P(None, None, "tp", None)))
+    csh = NamedSharding(mesh, P("tp", None, None, None))
+    got = verify_attention_sharded(
+        qs, kws, vws, jax.device_put(kc, csh), jax.device_put(vc, csh),
+        tables, hist, scale, mesh, use_pallas=True, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+    # multi-token append sharded == single-device
+    L = 2
+    kN = jax.random.normal(ks[0], (L, B, T, Hkv, D), jnp.float32)
+    vN = jax.random.normal(ks[1], (L, B, T, Hkv, D), jnp.float32)
+    kcL = jnp.stack([kc, vc])  # [L, Hkv, N, bs, D]
+    vcL = jnp.stack([vc, kc])
+    pos = hist[:, None] + jnp.arange(T)[None, :]
+    blk = jnp.take_along_axis(tables, pos // BS, axis=1)
+    off = pos % BS
+    ref_k, ref_v = kv_cache_append_tokens(
+        kN, vN, jnp.copy(kcL), jnp.copy(vcL), blk, off, interpret=True
+    )
+    csh5 = NamedSharding(mesh, P(None, "tp", None, None, None))
+    got_k, got_v = kv_cache_append_tokens_sharded(
+        jax.device_put(kN, NamedSharding(mesh, P(None, None, None, "tp", None))),
+        jax.device_put(vN, NamedSharding(mesh, P(None, None, None, "tp", None))),
+        jax.device_put(jnp.copy(kcL), csh5),
+        jax.device_put(jnp.copy(vcL), csh5),
+        blk, off, mesh, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
