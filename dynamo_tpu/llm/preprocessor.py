@@ -43,7 +43,8 @@ class OpenAIPreprocessor(Operator):
             prompt = req.messages[-1].content_text()
         else:
             prompt = self._tokenizer.apply_chat_template(
-                [m.to_dict() for m in req.messages], add_generation_prompt=True
+                [m.to_dict() for m in req.messages], add_generation_prompt=True,
+                tools=req.tools,
             )
         token_ids = self._tokenizer.encode(prompt, add_special_tokens=False)
         pre = PreprocessedRequest(

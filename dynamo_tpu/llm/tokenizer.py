@@ -45,7 +45,8 @@ class Tokenizer(abc.ABC):
         return None
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list | None = None,
     ) -> str:
         raise NotImplementedError("this tokenizer has no chat template")
 
@@ -79,9 +80,14 @@ class ByteTokenizer(Tokenizer):
         return 258
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list | None = None,
     ) -> str:
         parts = [f"<|{m['role']}|>{m.get('content') or ''}" for m in messages]
+        if tools:
+            import json as _json
+
+            parts.insert(0, f"<|tools|>{_json.dumps(tools, sort_keys=True)}")
         if add_generation_prompt:
             parts.append("<|assistant|>")
         return "".join(parts)
@@ -128,10 +134,17 @@ class HFTokenizer(Tokenizer):
         return len(self._tok)
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list | None = None,
     ) -> str:
+        # tools flow into the jinja context — function-calling templates
+        # (llama-3.1, qwen, mistral v3...) render the tool schemas into
+        # the system prompt (ref: the engines the reference wraps pass
+        # request.tools through the same HF API)
         return self._tok.apply_chat_template(
-            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            messages, tokenize=False,
+            add_generation_prompt=add_generation_prompt,
+            tools=tools or None,
         )
 
 

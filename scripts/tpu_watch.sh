@@ -1,0 +1,22 @@
+#!/bin/bash
+# Poll for TPU relay recovery; on success run the queued on-chip work.
+# Outputs land in /tmp/tpu_results/.
+mkdir -p /tmp/tpu_results
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "TPU BACK at $(date)" | tee /tmp/tpu_results/status
+    timeout 900 python scripts/validate_tpu_kernels.py \
+        > /tmp/tpu_results/validate.log 2>&1
+    echo "validate rc=$?" >> /tmp/tpu_results/status
+    timeout 1500 python scripts/decompose_window.py \
+        > /tmp/tpu_results/decompose.log 2>&1
+    echo "decompose rc=$?" >> /tmp/tpu_results/status
+    timeout 900 python bench.py > /tmp/tpu_results/bench.log 2>&1
+    echo "bench rc=$?" >> /tmp/tpu_results/status
+    echo "ALL DONE $(date)" >> /tmp/tpu_results/status
+    exit 0
+  fi
+  sleep 120
+done
+echo "TPU never recovered" > /tmp/tpu_results/status

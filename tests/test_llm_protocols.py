@@ -221,3 +221,19 @@ def test_full_pipeline_stop_sequence(run):
         assert full["choices"][0]["finish_reason"] == "stop"
 
     run(main())
+
+
+def test_chat_template_tools_passthrough():
+    """request.tools reach the chat template context (function-calling
+    templates render the schemas; the engines the reference wraps pass
+    tools through the same HF API)."""
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    tools = [{"type": "function", "function": {"name": "get_weather"}}]
+    with_tools = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], tools=tools
+    )
+    without = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert "get_weather" in with_tools
+    assert "get_weather" not in without
