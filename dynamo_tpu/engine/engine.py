@@ -224,6 +224,8 @@ class JaxEngine(AsyncEngine):
         tp = self.mesh.shape["tp"] if self.mesh is not None else 1
         self.use_pallas = (
             jax.default_backend() == "tpu"
+            # sliding-window masking lives in the XLA paths only (so far)
+            and cfg.model.sliding_window == 0
             and cfg.model.head_dim % 128 == 0
             and cfg.block_size % 8 == 0
             and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)

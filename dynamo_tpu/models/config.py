@@ -37,6 +37,12 @@ class ModelConfig:
     num_shared_experts: int = 0  # DeepSeek-style always-on experts
     first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
+    # sliding-window attention (mistral v0.1-style; 0 = full attention).
+    # Enforced by masking in the XLA attention paths; the Pallas kernels
+    # don't take windowed shapes yet, so the engine gates use_pallas off
+    # when a window is set (correct, slower — kernel support is the
+    # follow-up)
+    sliding_window: int = 0
     # gemma-family variants
     hidden_act: str = "silu"  # "silu" | "gelu_tanh" (gemma GeGLU)
     rms_add_unit: bool = False  # gemma RMSNorm scales by (1 + w)
@@ -87,6 +93,7 @@ class ModelConfig:
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
             first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
+            sliding_window=cfg.get("sliding_window") or 0,
             hidden_act=act if act != "silu" else "silu",
             rms_add_unit=is_gemma,
             scale_embed=is_gemma,

@@ -423,7 +423,7 @@ def prefill(
         vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
         o = att.chunk_attention_with_cache(
             q, k, v, kc, vc, block_table, history_len, valid_len, scale,
-            use_pallas=use_pallas, mesh=mesh,
+            use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
         )
         x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -537,7 +537,7 @@ def _decode_body(
             )
             o = att.decode_attention(
                 q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
-                use_pallas=use_pallas, mesh=mesh,
+                use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
             )
             x = layer_tail(x, lp, o)
     else:
@@ -549,7 +549,7 @@ def _decode_body(
             vc = att.write_decode_token_to_cache(vc, v, block_tables, positions)
             o = att.decode_attention(
                 q, kc, vc, block_tables, seq_lens, scale,
-                use_pallas=use_pallas, mesh=mesh,
+                use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
             )
             x = layer_tail(x, lp, o)
             return x, (kc, vc)
@@ -690,7 +690,8 @@ def _verify_forward(
         else:
             o = att.verify_attention(
                 q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                scale, use_pallas=use_pallas, interpret=interpret,
+                scale, use_pallas=use_pallas, window=cfg.sliding_window,
+                interpret=interpret,
             )
         x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -788,7 +789,10 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         q, k, v = _qkv(lp, cfg, h)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        o = att.prefill_attention_xla(q, k, v, positions, jnp.int32(T), scale)
+        o = att.prefill_attention_xla(
+            q, k, v, positions, jnp.int32(T), scale,
+            window=cfg.sliding_window,
+        )
         x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h)

@@ -48,9 +48,12 @@ def decode_attention(
     scale: float,
     use_pallas: bool = False,
     mesh=None,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
+    ``window`` (sliding attention) is honored by the XLA path only —
+    callers gate use_pallas off when a window is set.
 
     ``use_pallas`` must be trace-static. With a ``mesh``, the kernel runs
     under shard_map: each device gets its tp shard of the kv heads (cache
@@ -70,7 +73,8 @@ def decode_attention(
             interpret=interpret,
         )
     return decode_attention_xla(
-        q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale
+        q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+        window=window,
     )
 
 
@@ -262,6 +266,7 @@ def verify_attention(
     hist_lens: jnp.ndarray,  # [B] tokens in cache (before the T in-flight)
     scale: float,
     use_pallas: bool = False,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, T, H, D]
     """Multi-token decode attention (speculative-decoding verify): T
@@ -294,8 +299,11 @@ def verify_attention(
         l_h = l_h.reshape(B, Hkv, T, G)
     else:
         o_h, m_h, l_h = _history_attention_xla(
-            q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale
+            q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
+            window=window,
         )
+    # intra-window rows are at most T-1 < window positions apart for any
+    # practical sliding window, so the causal mask below already covers it
     # intra-window causal scores [B, Hkv, T, G, T']
     qg = q.reshape(B, T, Hkv, G, D)
     s_w = jnp.einsum(
@@ -367,6 +375,7 @@ def _history_attention_xla(
     block_tables: jnp.ndarray,
     hist_lens: jnp.ndarray,
     scale: float,
+    window: int = 0,
 ):
     """XLA twin of the stats-emitting kernel path: history-only attention
     with raw softmax stats (o normalized, m row max, l normalizer) in the
@@ -383,7 +392,16 @@ def _history_attention_xla(
         k.astype(jnp.float32),
     )
     valid = jnp.arange(M * bs)[None, :] < hist_lens[:, None]  # [B, S]
-    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+    if window > 0:
+        # query t sits at absolute position hist + t
+        q_pos = hist_lens[:, None] + jnp.arange(q.shape[1])[None, :]  # [B, T]
+        lo = (q_pos - window + 1)[:, :, None]  # [B, T, 1]
+        valid_tw = valid[:, None, :] & (
+            jnp.arange(M * bs)[None, None, :] >= lo
+        )  # [B, T, S]
+        s = jnp.where(valid_tw[:, None, :, None, :], s, NEG_INF)
+    else:
+        s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)  # [B, Hkv, T, G]
     p = jnp.exp(s - m[..., None])
     p = jnp.where(valid[:, None, None, None, :], p, 0.0)
@@ -400,6 +418,7 @@ def decode_attention_xla(
     block_tables: jnp.ndarray,  # [B, M] int32
     seq_lens: jnp.ndarray,  # [B] int32 (includes the new token)
     scale: float,
+    window: int = 0,  # sliding window width; 0 = full attention
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     M = block_tables.shape[1]
@@ -417,6 +436,8 @@ def decode_attention_xla(
     scores = jnp.einsum("bkgd,kbtd->bkgt", qg * scale, k).astype(jnp.float32)
     positions = jnp.arange(M * bs)[None, :]  # [1, T]
     mask = positions < seq_lens[:, None]  # [B, T]
+    if window > 0:  # q position is seq_len-1; keep kv in (q-W, q]
+        mask &= positions >= (seq_lens[:, None] - window)
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
@@ -430,6 +451,7 @@ def prefill_attention_xla(
     q_positions: jnp.ndarray,  # [T] absolute positions of the queries
     valid_len: jnp.ndarray,  # scalar: number of real (unpadded) tokens
     scale: float,
+    window: int = 0,  # sliding window width; 0 = full attention
 ) -> jnp.ndarray:  # [T, H, D]
     """Causal self-attention within one (padded) prompt chunk."""
     T, H, D = q.shape
@@ -438,6 +460,8 @@ def prefill_attention_xla(
     v = repeat_kv(v, H // Hkv, axis=1)
     scores = jnp.einsum("thd,shd->hts", q * scale, k).astype(jnp.float32)
     causal = q_positions[:, None] >= q_positions[None, :]  # [T, T]
+    if window > 0:
+        causal &= (q_positions[:, None] - q_positions[None, :]) < window
     valid = jnp.arange(T)[None, :] < valid_len  # [1, T]
     mask = causal & valid
     scores = jnp.where(mask[None, :, :], scores, NEG_INF)
@@ -457,9 +481,12 @@ def chunk_attention_with_cache(
     scale: float,
     use_pallas: bool = False,
     mesh=None,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
+    ``window`` (sliding attention) is honored by the XLA path only —
+    callers gate use_pallas off when a window is set.
 
     The Pallas path requires the chunk's K/V to be ALREADY scattered into
     the cache (write-before-attend — llama.prefill's layer body does this),
@@ -482,7 +509,7 @@ def chunk_attention_with_cache(
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
-        history_len, valid_len, scale,
+        history_len, valid_len, scale, window=window,
     )
 
 
@@ -517,6 +544,7 @@ def chunk_attention_with_cache_xla(
     history_len: jnp.ndarray,  # scalar: tokens already in cache
     valid_len: jnp.ndarray,  # scalar: real tokens in this chunk
     scale: float,
+    window: int = 0,  # sliding window width; 0 = full attention
 ) -> jnp.ndarray:
     """Chunked-prefill attention: queries attend to cached history plus the
     causal prefix of the current chunk (enables chunked prefill and
@@ -544,6 +572,8 @@ def chunk_attention_with_cache_xla(
         (jnp.arange(S) - M * bs) < valid_len,  # chunk entries below valid_len
     )
     causal = q_pos[:, None] >= kv_pos[None, :]
+    if window > 0:
+        causal &= (q_pos[:, None] - kv_pos[None, :]) < window
     mask = causal & kv_valid[None, :]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)

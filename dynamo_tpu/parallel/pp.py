@@ -143,7 +143,7 @@ def pipelined_prefill(
                 vc = att.write_chunk_to_cache(vc, v, tbl, start)
                 o = att.chunk_attention_with_cache(
                     q, k, v, kc, vc, tbl, start, mb_valid, scale,
-                    use_pallas=use_pallas,
+                    use_pallas=use_pallas, window=cfg.sliding_window,
                 )
                 x = x + lax.psum(llama._mm(o.reshape(Tm, -1), lp["wo"]), "tp")
                 h = llama.rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
