@@ -224,8 +224,6 @@ class JaxEngine(AsyncEngine):
         tp = self.mesh.shape["tp"] if self.mesh is not None else 1
         self.use_pallas = (
             jax.default_backend() == "tpu"
-            # sliding-window masking lives in the XLA paths only (so far)
-            and cfg.model.sliding_window == 0
             and cfg.model.head_dim % 128 == 0
             and cfg.block_size % 8 == 0
             and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)
@@ -820,6 +818,10 @@ class JaxEngine(AsyncEngine):
         if (
             cfg.spec_gamma > 0
             and self.mirror is None
+            # the verify kernel's window floor is uniform per dispatch
+            # (exact per-row floors live in the XLA path only) — windowed
+            # models take plain decode windows instead
+            and cfg.model.sliding_window == 0
             and n > 1
             and self._prefill_state is None
         ):

@@ -65,12 +65,12 @@ def decode_attention(
     if use_pallas and mesh is not None:
         return paged_decode_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-            mesh, interpret=interpret,
+            mesh, window=window, interpret=interpret,
         )
     if use_pallas:
         return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-            interpret=interpret,
+            window=window, interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
@@ -80,6 +80,7 @@ def decode_attention(
 
 def _decode_kernel(
     q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+    window: int = 0,
     interpret: bool = False,
 ):
     """TPU decode kernel selection: prefer jax's tuned paged-attention
@@ -94,7 +95,7 @@ def _decode_kernel(
     """
     from .paged_attention_pallas import paged_decode_attention
 
-    if not interpret:
+    if not interpret and window == 0:  # the library kernel has no window
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention,
@@ -111,7 +112,7 @@ def _decode_kernel(
             pass  # odd shape or old jax: in-repo kernel
     return paged_decode_attention(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-        interpret=interpret,
+        window=window, interpret=interpret,
     )
 
 
@@ -150,6 +151,7 @@ def paged_decode_attention_sharded(
     seq_lens: jnp.ndarray,  # [B] replicated
     scale: float,
     mesh,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas decode kernel under shard_map over tp (see _shard_headwise).
@@ -158,7 +160,8 @@ def paged_decode_attention_sharded(
     from functools import partial
 
     return _shard_headwise(
-        partial(_decode_kernel, scale=scale, interpret=interpret),
+        partial(_decode_kernel, scale=scale, window=window,
+                interpret=interpret),
         mesh, q, k_cache_layer, v_cache_layer, block_tables, seq_lens,
     )
 
@@ -172,6 +175,7 @@ def decode_attention_merged(
     block_tables: jnp.ndarray,  # [B, M] int32
     hist_lens: jnp.ndarray,  # [B] int32 tokens in cache (EXCLUDES current)
     scale: float,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, H, D]
     """Decode attention with the current token handled OUT of the cache.
@@ -196,9 +200,12 @@ def decode_attention_merged(
     B, H, D = q.shape
     Hkv = k_cache_layer.shape[0]
     G = H // Hkv
+    # the query sits ONE PAST the cached history (it is out-of-cache),
+    # so the kernel's window floor shifts by q_pos_offset=1
     o_h, m_h, l_h = paged_decode_attention(
         q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
-        return_stats=True, interpret=interpret,
+        return_stats=True, window=window, q_pos_offset=1,
+        interpret=interpret,
     )  # o: [B, H, D]; m, l: [B, Hkv, G]
     qg = q.reshape(B, Hkv, G, D)
     s_new = jnp.einsum(
@@ -227,6 +234,7 @@ def decode_attention_merged_sharded(
     hist_lens: jnp.ndarray,  # [B] replicated
     scale: float,
     mesh,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Merged decode attention under shard_map over ``tp``.
@@ -240,7 +248,8 @@ def decode_attention_merged_sharded(
     from jax.sharding import PartitionSpec as P
 
     return jax.shard_map(
-        partial(decode_attention_merged, scale=scale, interpret=interpret),
+        partial(decode_attention_merged, scale=scale, window=window,
+                interpret=interpret),
         mesh=mesh,
         in_specs=(
             P(None, "tp", None),  # q
@@ -287,7 +296,12 @@ def verify_attention(
         from .paged_attention_pallas import paged_decode_attention
 
         # rows ordered (hkv, t, g) so the kernel's internal
-        # reshape(B, Hkv, T*G, D) lands each row on its kv head
+        # reshape(B, Hkv, T*G, D) lands each row on its kv head.
+        # NOTE windowed verify over the kernel: the kernel's uniform
+        # window floor uses hist (the FIRST in-flight position); later
+        # rows' floors are up to T-1 higher — within tolerance for any
+        # practical window (W >> T), and exact masking happens in the
+        # XLA path, so windowed engines route there (use_pallas gate).
         qp = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
         qp = qp.reshape(B, Hkv * T * G, D)
         o_h, m_h, l_h = paged_decode_attention(
@@ -505,7 +519,7 @@ def chunk_attention_with_cache(
 
         return paged_prefill_attention(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
-            interpret=interpret,
+            window=window, interpret=interpret,
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,

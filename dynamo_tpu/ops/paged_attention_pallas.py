@@ -62,6 +62,8 @@ def _decode_kernel(
     block_size: int,
     pages_per_step: int,
     return_stats: bool,
+    window: int = 0,  # sliding attention; 0 = full
+    q_pos_offset: int = 0,  # query position = seq_len - 1 + offset
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, 1, Gp, D]
@@ -85,8 +87,16 @@ def _decode_kernel(
 
     seq_len = seq_lens_ref[b]
     start = i * (P * block_size)
+    # sliding window: the query sits at seq_len-1+q_pos_offset (the
+    # merged/out-of-cache path scores against history of length seq_len
+    # with the query ONE past it); only positions in (q_pos-window, q_pos]
+    # contribute — whole superblocks below skip compute
+    lo = seq_len + q_pos_offset - window if window > 0 else 0
+    in_range = start < seq_len
+    if window > 0:
+        in_range &= start + P * block_size > lo
 
-    @pl.when(start < seq_len)
+    @pl.when(in_range)
     def _superblock():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [Gp, D]
         k = jnp.concatenate(
@@ -99,7 +109,10 @@ def _decode_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Gp, P*bs]
         pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        keep = pos < seq_len
+        if window > 0:
+            keep &= pos >= lo
+        s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[:, 0:1]  # [Gp, 1]
         l_prev = l_scr[:, 0:1]
@@ -124,7 +137,10 @@ def _decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "pages_per_step", "return_stats", "interpret"),
+    static_argnames=(
+        "scale", "pages_per_step", "return_stats", "window",
+        "q_pos_offset", "interpret"
+    ),
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, D]
@@ -135,6 +151,8 @@ def paged_decode_attention(
     scale: float,
     pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
     return_stats: bool = False,
+    window: int = 0,  # sliding attention width; 0 = full
+    q_pos_offset: int = 0,  # see _decode_kernel
     interpret: bool = False,
 ):  # [B, H, D] or (out, m [B, Hkv, G], l [B, Hkv, G]) when return_stats
     B, H, D = q.shape
@@ -189,7 +207,7 @@ def paged_decode_attention(
     )
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_size=bs, pages_per_step=P,
-        return_stats=return_stats,
+        return_stats=return_stats, window=window, q_pos_offset=q_pos_offset,
     )
     out = pl.pallas_call(
         kernel,
@@ -232,6 +250,7 @@ def _prefill_kernel(
     q_tile: int,  # Tq: chunk rows per grid step
     group: int,  # Gp: padded query heads per kv head
     pages_per_step: int,
+    window: int = 0,  # sliding attention; 0 = full
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, Tq*Gp, D]
@@ -253,8 +272,13 @@ def _prefill_kernel(
     start = i * (P * block_size)
     # last query position in this tile — superblocks past it are fully masked
     tile_last_q = hist + (j + 1) * q_tile - 1
+    in_range = start <= tile_last_q
+    if window > 0:
+        # first (lowest) query position of the tile bounds the window floor
+        tile_first_q = hist + j * q_tile
+        in_range &= start + P * block_size > tile_first_q - window + 1
 
-    @pl.when(start <= tile_last_q)
+    @pl.when(in_range)
     def _superblock():
         q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
         k = jnp.concatenate(
@@ -269,7 +293,10 @@ def _prefill_kernel(
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         q_pos = hist + j * q_tile + rows // group
         kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+        keep = kv_pos <= q_pos
+        if window > 0:
+            keep &= (q_pos - kv_pos) < window
+        s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[:, 0:1]
         l_prev = l_scr[:, 0:1]
@@ -290,7 +317,7 @@ def _prefill_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "pages_per_step", "interpret")
+    jax.jit, static_argnames=("scale", "pages_per_step", "window", "interpret")
 )
 def paged_prefill_attention(
     q: jnp.ndarray,  # [T, H, D] chunk queries
@@ -300,6 +327,7 @@ def paged_prefill_attention(
     history_len: jnp.ndarray,  # scalar int32
     scale: float,
     pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
+    window: int = 0,  # sliding attention width; 0 = full
     interpret: bool = False,
 ) -> jnp.ndarray:  # [T, H, D]
     """Flash-style chunked-prefill attention over the paged cache.
@@ -371,7 +399,7 @@ def paged_prefill_attention(
     )
     kernel = functools.partial(
         _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp,
-        pages_per_step=P,
+        pages_per_step=P, window=window,
     )
     out = pl.pallas_call(
         kernel,

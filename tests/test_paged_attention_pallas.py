@@ -225,3 +225,71 @@ def test_kernel_bf16_cache():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(ref, np.float32), rtol=5e-2, atol=5e-2
     )
+
+
+def test_decode_kernel_sliding_window_matches_xla():
+    B, H, Hkv, D, N, bs, M = 4, 8, 4, 128, 64, 16, 4
+    q, kc, vc, tables = _mk(B, H, Hkv, D, N, bs, M, seed=7)
+    seq_lens = jnp.asarray([5, bs + 2, 3 * bs, M * bs], jnp.int32)
+    scale = D**-0.5
+    W = 10
+    ref = decode_attention_xla(q, kc, vc, tables, seq_lens, scale, window=W)
+    got = paged_decode_attention(
+        q, kc, vc, tables, seq_lens, scale, window=W, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_kernel_sliding_window_matches_xla():
+    from dynamo_tpu.ops.attention import (
+        chunk_attention_with_cache_xla,
+        write_chunk_to_cache,
+    )
+    from dynamo_tpu.ops.paged_attention_pallas import paged_prefill_attention
+
+    T, H, Hkv, D, N, bs, M = 8, 8, 4, 128, 32, 16, 4
+    ks = jax.random.split(jax.random.key(3), 5)
+    q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+    kch = jax.random.normal(ks[1], (T, Hkv, D), jnp.float32)
+    vch = jax.random.normal(ks[2], (T, Hkv, D), jnp.float32)
+    kc = jax.random.normal(ks[3], (Hkv, N, bs, D), jnp.float32)
+    vc = jax.random.normal(ks[4], (Hkv, N, bs, D), jnp.float32)
+    table = jnp.asarray(np.arange(1, M + 1, dtype=np.int32))
+    hist = jnp.int32(bs + 3)
+    W = 12
+    scale = D**-0.5
+    # pallas reads the chunk from cache: write-before-attend
+    kc1 = write_chunk_to_cache(kc, kch, table, hist)
+    vc1 = write_chunk_to_cache(vc, vch, table, hist)
+    ref = chunk_attention_with_cache_xla(
+        q, kch, vch, kc, vc, table, hist, jnp.int32(T), scale, window=W
+    )
+    got = paged_prefill_attention(
+        q, kc1, vc1, table, hist, scale, window=W, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_merged_decode_sliding_window_matches_xla():
+    from dynamo_tpu.ops.attention import decode_attention_merged
+
+    B, H, Hkv, D, N, bs, M = 4, 8, 4, 128, 64, 16, 4
+    q, kc, vc, tables = _mk(B, H, Hkv, D, N, bs, M, seed=9)
+    ks = jax.random.split(jax.random.key(4), 2)
+    k_new = jax.random.normal(ks[0], (B, Hkv, D), jnp.float32)
+    v_new = jax.random.normal(ks[1], (B, Hkv, D), jnp.float32)
+    hist = jnp.asarray([0, 5, bs, 2 * bs + 3], jnp.int32)
+    scale = D**-0.5
+    W = 9
+    from dynamo_tpu.ops.attention import decode_slot_indices
+
+    blk, off = decode_slot_indices(tables, hist, bs)
+    # contiguous advanced indices stay in place: update is [Hkv, B, D]
+    kc1 = kc.at[:, blk, off].set(k_new.swapaxes(0, 1))
+    vc1 = vc.at[:, blk, off].set(v_new.swapaxes(0, 1))
+    ref = decode_attention_xla(q, kc1, vc1, tables, hist + 1, scale, window=W)
+    got = decode_attention_merged(
+        q, k_new, v_new, kc, vc, tables, hist, scale, window=W,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
