@@ -195,33 +195,13 @@ def decode_attention_merged(
     split does the same on GPU). hist_lens == 0 rows degenerate cleanly
     to out = v_new (l_h = 0, m_h = -inf).
     """
-    from .paged_attention_pallas import paged_decode_attention
-
-    B, H, D = q.shape
-    Hkv = k_cache_layer.shape[0]
-    G = H // Hkv
-    # the query sits ONE PAST the cached history (it is out-of-cache),
-    # so the kernel's window floor shifts by q_pos_offset=1
-    o_h, m_h, l_h = paged_decode_attention(
-        q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
-        return_stats=True, window=window, q_pos_offset=1,
-        interpret=interpret,
-    )  # o: [B, H, D]; m, l: [B, Hkv, G]
-    qg = q.reshape(B, Hkv, G, D)
-    s_new = jnp.einsum(
-        "bkgd,bkd->bkg", qg.astype(jnp.float32) * scale,
-        k_new.astype(jnp.float32),
-    )  # [B, Hkv, G]
-    m_f = jnp.maximum(m_h, s_new)
-    alpha = jnp.exp(m_h - m_f)  # exp(-inf - s) = 0 handles empty history
-    beta = jnp.exp(s_new - m_f)
-    o_hg = o_h.reshape(B, Hkv, G, D).astype(jnp.float32)
-    num = (l_h * alpha)[..., None] * o_hg + beta[..., None] * v_new.astype(
-        jnp.float32
-    )[:, :, None, :]
-    den = l_h * alpha + beta
-    out = num / den[..., None]
-    return out.reshape(B, H, D).astype(q.dtype)
+    # exactly verify_attention with a T=1 in-flight window (the merge,
+    # stats kernel, and window floor all coincide; one implementation)
+    return verify_attention(
+        q[:, None], k_new[:, None], v_new[:, None], k_cache_layer,
+        v_cache_layer, block_tables, hist_lens, scale, use_pallas=True,
+        window=window, interpret=interpret,
+    )[:, 0]
 
 
 def decode_attention_merged_sharded(
@@ -297,16 +277,17 @@ def verify_attention(
 
         # rows ordered (hkv, t, g) so the kernel's internal
         # reshape(B, Hkv, T*G, D) lands each row on its kv head.
-        # NOTE windowed verify over the kernel: the kernel's uniform
-        # window floor uses hist (the FIRST in-flight position); later
-        # rows' floors are up to T-1 higher — within tolerance for any
-        # practical window (W >> T), and exact masking happens in the
-        # XLA path, so windowed engines route there (use_pallas gate).
+        # Windowed: the kernel's uniform floor is set for the FIRST
+        # in-flight position (q_pos_offset=1) — exact at T=1 (the merged
+        # decode path); for T>1 later rows under-mask by < T positions,
+        # negligible for practical windows (W >> T). The spec path for
+        # windowed models routes to the exact XLA masking anyway.
         qp = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
         qp = qp.reshape(B, Hkv * T * G, D)
         o_h, m_h, l_h = paged_decode_attention(
             qp, k_cache_layer, v_cache_layer, block_tables, hist_lens,
-            scale, return_stats=True, interpret=interpret,
+            scale, return_stats=True, window=window, q_pos_offset=1,
+            interpret=interpret,
         )  # o: [B, Hkv*T*G, D]; m, l: [B, Hkv, T*G]
         o_h = o_h.reshape(B, Hkv, T, G, D).astype(jnp.float32)
         m_h = m_h.reshape(B, Hkv, T, G)
