@@ -52,6 +52,19 @@ def _bucket(n: int) -> int:
     return ((n + 8191) // 8192) * 8192
 
 
+@jax.jit
+def _reset_pen_slot(counts, mask, slot, prompt_ids, gen_ids):
+    """Rebuild one slot's penalty state: prompt-token mask from
+    ``prompt_ids`` and output counts from ``gen_ids`` (non-empty after a
+    prefill's first sampled token or a preemption replay). Both padded
+    with vocab_size — out-of-bounds scatters drop."""
+    V = mask.shape[1]
+    crow = jnp.zeros((V,), jnp.int32).at[gen_ids].add(1, mode="drop")
+    counts = counts.at[slot].set(crow)
+    row = jnp.zeros((V,), jnp.bool_).at[prompt_ids].set(True, mode="drop")
+    return counts, mask.at[slot].set(row)
+
+
 @dataclass
 class EngineConfig:
     model: ModelConfig
@@ -256,6 +269,14 @@ class JaxEngine(AsyncEngine):
         self._temps = np.zeros(cfg.max_batch_size, np.float32)
         self._top_ks = np.zeros(cfg.max_batch_size, np.int32)
         self._top_ps = np.ones(cfg.max_batch_size, np.float32)
+        # sampling penalties (vLLM semantics — see ops/sampling):
+        # device [B, V] output-token counts + prompt-membership mask,
+        # allocated lazily on the first request that asks for a penalty
+        self._freq_pens = np.zeros(cfg.max_batch_size, np.float32)
+        self._pres_pens = np.zeros(cfg.max_batch_size, np.float32)
+        self._rep_pens = np.ones(cfg.max_batch_size, np.float32)
+        self._pen_counts = None
+        self._pen_mask = None
         # metrics
         self.stats = {
             "requests_total": 0,
@@ -646,8 +667,31 @@ class JaxEngine(AsyncEngine):
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
         )
+        logits_row = logits[None, :]
+        rep = so.repetition_penalty or 1.0
+        if rep != 1.0:
+            # repetition penalty covers the prompt, so it applies to the
+            # very first sampled token too (freq/presence count OUTPUT
+            # tokens — zero here)
+            from ..ops.sampling import apply_penalties
+
+            V = self.cfg.model.vocab_size
+            ids = seq.tokens[: seq.prompt_len]
+            padded = np.full(_bucket(max(len(ids), 1)), V, np.int32)
+            padded[: len(ids)] = ids
+            mask = jnp.zeros((V,), jnp.bool_).at[jnp.asarray(padded)].set(
+                True, mode="drop"
+            )
+            logits_row = apply_penalties(
+                logits_row.astype(jnp.float32),
+                jnp.zeros((1, V), jnp.int32),
+                mask[None],
+                jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1,), jnp.float32),
+                jnp.asarray([rep], jnp.float32),
+            )
         tok = sample_tokens(
-            logits[None, :],
+            logits_row,
             keys,
             jnp.asarray([temp], jnp.float32),
             jnp.asarray([so.top_k or 0], jnp.int32),
@@ -669,6 +713,56 @@ class JaxEngine(AsyncEngine):
         self._temps[slot] = so.temperature if so.temperature is not None else 1.0
         self._top_ks[slot] = so.top_k or 0
         self._top_ps[slot] = so.top_p if so.top_p is not None else 1.0
+        self._freq_pens[slot] = so.frequency_penalty or 0.0
+        self._pres_pens[slot] = so.presence_penalty or 0.0
+        self._rep_pens[slot] = so.repetition_penalty or 1.0
+        if self._slot_has_penalty(slot):
+            if self.mirror is not None:
+                logger.warning(
+                    "sampling penalties are not mirrored to multi-host "
+                    "followers yet; ignoring for request %s",
+                    getattr(seq.context, "id", "?"),
+                )
+                self._freq_pens[slot] = 0.0
+                self._pres_pens[slot] = 0.0
+                self._rep_pens[slot] = 1.0
+            else:
+                self._reset_penalty_slot(slot, seq)
+
+    def _slot_has_penalty(self, i: int) -> bool:
+        return (
+            self._freq_pens[i] != 0.0
+            or self._pres_pens[i] != 0.0
+            or self._rep_pens[i] != 1.0
+        )
+
+    def _penalties_active(self) -> bool:
+        return self._pen_counts is not None and any(
+            self._slot_has_penalty(i)
+            for i, s in enumerate(self._active) if s is not None
+        )
+
+    def _reset_penalty_slot(self, slot: int, seq: _Sequence) -> None:
+        """Zero the slot's output counts and rebuild its prompt mask
+        (repetition penalty covers prompt + output tokens)."""
+        V = self.cfg.model.vocab_size
+        if self._pen_counts is None:
+            self._pen_counts = jnp.zeros(
+                (self.cfg.max_batch_size, V), jnp.int32
+            )
+            self._pen_mask = jnp.zeros(
+                (self.cfg.max_batch_size, V), jnp.bool_
+            )
+        def pad(ids):
+            out = np.full(_bucket(max(len(ids), 1)), V, np.int32)  # V = drop
+            out[: len(ids)] = ids
+            return jnp.asarray(out)
+
+        self._pen_counts, self._pen_mask = _reset_pen_slot(
+            self._pen_counts, self._pen_mask, slot,
+            pad(seq.tokens[: seq.prompt_len]),
+            pad(seq.tokens[seq.prompt_len :]),
+        )
 
     # ---- decode ----
 
@@ -822,6 +916,9 @@ class JaxEngine(AsyncEngine):
             # (exact per-row floors live in the XLA path only) — windowed
             # models take plain decode windows instead
             and cfg.model.sliding_window == 0
+            # penalties mutate the sampling distribution per emitted token;
+            # the verify acceptance doesn't model that yet
+            and not self._penalties_active()
             and n > 1
             and self._prefill_state is None
         ):
@@ -1084,7 +1181,7 @@ class JaxEngine(AsyncEngine):
             return toks
         if tokens_in is None:
             tokens_in = jnp.asarray(self._last_tokens)
-        toks, self.k_cache, self.v_cache = llama.decode_window(
+        args = (
             self.params,
             cfg.model,
             tokens_in,
@@ -1098,12 +1195,29 @@ class JaxEngine(AsyncEngine):
             jnp.asarray(self._top_ps),
             self.k_cache,
             self.v_cache,
+        )
+        kw = dict(
             n_steps=n,
             use_pallas=self.use_pallas,
             mesh=self.mesh,
             unroll=not cfg.decode_layer_scan,
             merged=cfg.decode_merged,
         )
+        if self._penalties_active():
+            toks, self.k_cache, self.v_cache, self._pen_counts = (
+                llama.decode_window(
+                    *args, **kw,
+                    freq_pens=jnp.asarray(self._freq_pens),
+                    pres_pens=jnp.asarray(self._pres_pens),
+                    rep_pens=jnp.asarray(self._rep_pens),
+                    counts=self._pen_counts,
+                    prompt_mask=self._pen_mask,
+                )
+            )
+        else:
+            toks, self.k_cache, self.v_cache = llama.decode_window(
+                *args, **kw
+            )
         return toks
 
     # ---- token emission + finish logic ----

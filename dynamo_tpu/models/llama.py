@@ -596,7 +596,7 @@ def decode_step(
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll", "interpret", "merged"),
-    donate_argnames=("k_cache", "v_cache"),
+    donate_argnames=("k_cache", "v_cache", "counts"),
 )
 def decode_window(
     params: dict,
@@ -618,27 +618,59 @@ def decode_window(
     unroll: bool = True,
     interpret: bool = False,
     merged: bool = True,
+    # sampling penalties (all-or-nothing per program: the engine compiles
+    # the penalized variant only when some active request asks for one)
+    freq_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    pres_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    rep_pens: Optional[jnp.ndarray] = None,  # [B] f32 (1.0 = off)
+    counts: Optional[jnp.ndarray] = None,  # [B, V] i32 output-token counts, donated
+    prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
     host syncs once per window instead of once per token (SURVEY §7
     "per-token latency floor"; VERDICT round-1 weak #4). Returns
-    (tokens [n_steps, B], k_cache, v_cache). The host discards any tail
-    tokens of sequences that hit a stop condition mid-window; callers must
-    pre-allocate KV blocks for ``n_steps`` new tokens per sequence."""
-    from ..ops.sampling import make_keys, sample_tokens
+    (tokens [n_steps, B], k_cache, v_cache[, counts]) — counts only when
+    penalties are active. The host discards any tail tokens of sequences
+    that hit a stop condition mid-window; callers must pre-allocate KV
+    blocks for ``n_steps`` new tokens per sequence."""
+    from ..ops.sampling import (
+        apply_penalties,
+        bump_counts,
+        make_keys,
+        sample_tokens,
+    )
+
+    penalized = counts is not None
 
     def body(carry, _):
-        tokens, positions, seq_lens, steps, k_cache, v_cache = carry
+        if penalized:
+            tokens, positions, seq_lens, steps, k_cache, v_cache, cnt = carry
+        else:
+            tokens, positions, seq_lens, steps, k_cache, v_cache = carry
         logits, k_cache, v_cache = _decode_body(
             params, cfg, tokens, positions, block_tables, seq_lens,
             k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
         )
+        if penalized:
+            logits = apply_penalties(
+                logits, cnt, prompt_mask, freq_pens, pres_pens, rep_pens
+            )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)
+        if penalized:
+            cnt = bump_counts(cnt, nxt)
+            return (nxt, positions + 1, seq_lens + 1, steps + 1,
+                    k_cache, v_cache, cnt), nxt
         return (nxt, positions + 1, seq_lens + 1, steps + 1,
                 k_cache, v_cache), nxt
 
+    if penalized:
+        carry = (tokens, positions, seq_lens, steps, k_cache, v_cache, counts)
+        (_, _, _, _, k_cache, v_cache, counts), toks = lax.scan(
+            body, carry, None, length=n_steps
+        )
+        return toks, k_cache, v_cache, counts
     carry = (tokens, positions, seq_lens, steps, k_cache, v_cache)
     (_, _, _, _, k_cache, v_cache), toks = lax.scan(
         body, carry, None, length=n_steps

@@ -172,6 +172,33 @@ def speculative_accept(
     return out, n_acc
 
 
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V] float32
+    counts: jnp.ndarray,  # [B, V] int32 output-token counts
+    prompt_mask: jnp.ndarray,  # [B, V] bool: token appeared in the prompt
+    freq_pen: jnp.ndarray,  # [B] float32 (0 = off)
+    pres_pen: jnp.ndarray,  # [B] float32 (0 = off)
+    rep_pen: jnp.ndarray,  # [B] float32 (1.0 = off)
+) -> jnp.ndarray:
+    """OpenAI/HF sampling penalties, vLLM semantics: frequency and
+    presence penalize OUTPUT tokens (additive on logits); repetition
+    penalizes prompt AND output tokens (divide positive logits by r,
+    multiply negative ones — the HF formula)."""
+    cf = counts.astype(jnp.float32)
+    logits = logits - freq_pen[:, None] * cf
+    logits = logits - pres_pen[:, None] * (cf > 0)
+    seen = prompt_mask | (counts > 0)
+    r = jnp.where(rep_pen[:, None] <= 0.0, 1.0, rep_pen[:, None])
+    penalized = jnp.where(logits > 0, logits / r, logits * r)
+    return jnp.where(seen, penalized, logits)
+
+
+def bump_counts(counts: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """counts[b, tokens[b]] += 1 for every row (decode-window step)."""
+    B = tokens.shape[0]
+    return counts.at[jnp.arange(B), tokens].add(1)
+
+
 def make_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
     """Derive per-(request, step) key data from int seeds — deterministic
     replay per request without threading key state through the host."""

@@ -527,3 +527,109 @@ def test_pipelined_context_limit_not_truncated_early(run):
         assert outs[True] == outs[False]
 
     run(main())
+
+
+# ---------------- sampling penalties ----------------
+
+
+def _pen_req(tokens, max_tokens=16, **so):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0, **so),
+        eos_token_ids=[],
+    )
+
+
+def test_frequency_penalty_breaks_greedy_loops(run):
+    """A greedy tiny model degenerates into repeating one token; a strong
+    frequency penalty must break the loop (counts accumulate on device
+    through the fused windows)."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+            max_batch_size=2, decode_window=4,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        plain = await collect(
+            engine.generate(Context(_pen_req(range(10, 20), max_tokens=16)))
+        )
+        pen = await collect(
+            engine.generate(Context(_pen_req(
+                range(10, 20), max_tokens=16, frequency_penalty=5.0
+            )))
+        )
+        toks_plain = [t for o in plain for t in o.token_ids]
+        toks_pen = [t for o in pen for t in o.token_ids]
+        assert len(toks_pen) == 16
+
+        def max_mult(toks):
+            return max(toks.count(t) for t in set(toks))
+
+        # the penalty must strictly reduce the worst repetition
+        assert max_mult(toks_pen) < max_mult(toks_plain), (toks_plain, toks_pen)
+        await engine.close()
+
+    run(main())
+
+
+def test_penalized_window_matches_single_step(run):
+    """Fused windows with penalties must produce the exact stream of
+    1-step... 2-step dispatch (the counts carry updates per step on
+    device; spec_gamma requires window >= 2 so compare 2 vs 4)."""
+
+    async def main():
+        outs = {}
+        for window in (2, 4):
+            cfg = EngineConfig(
+                model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+                max_batch_size=2, decode_window=window,
+            )
+            engine = JaxEngine(cfg, seed=0)
+            out = await collect(engine.generate(Context(_pen_req(
+                range(30, 40), max_tokens=15, frequency_penalty=2.0,
+                presence_penalty=0.5, repetition_penalty=1.2,
+            ))))
+            outs[window] = [t for o in out for t in o.token_ids]
+            await engine.close()
+        assert len(outs[2]) == 15
+        assert outs[2] == outs[4]
+
+    run(main())
+
+
+def test_repetition_penalty_applies_to_first_token(run):
+    """A huge repetition penalty on a prompt whose greedy continuation
+    would repeat a prompt token must change the FIRST generated token too
+    (the penalty covers the prompt)."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+            max_batch_size=2, decode_window=4,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        prompt = list(range(10, 20))
+        plain = await collect(
+            engine.generate(Context(_pen_req(prompt, max_tokens=1)))
+        )
+        first_plain = plain[0].token_ids[0]
+        # force the penalty scenario: make the greedy-first token part of
+        # the prompt, then penalize hard
+        prompt2 = prompt + [first_plain]
+        plain2 = await collect(
+            engine.generate(Context(_pen_req(prompt2, max_tokens=1)))
+        )
+        pen2 = await collect(
+            engine.generate(Context(_pen_req(
+                prompt2, max_tokens=1, repetition_penalty=50.0
+            )))
+        )
+        # with the huge penalty the first token must avoid prompt tokens
+        # whenever the unpenalized choice was a prompt token
+        if plain2[0].token_ids[0] in prompt2:
+            assert pen2[0].token_ids[0] not in prompt2
+        await engine.close()
+
+    run(main())
