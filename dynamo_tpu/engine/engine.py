@@ -277,6 +277,9 @@ class JaxEngine(AsyncEngine):
         self._rep_pens = np.ones(cfg.max_batch_size, np.float32)
         self._pen_counts = None
         self._pen_mask = None
+        # requested top-logprob count per slot (0 = none)
+        self._logprob_ks = np.zeros(cfg.max_batch_size, np.int32)
+        self._window_logprobs = None
         # metrics
         self.stats = {
             "requests_total": 0,
@@ -716,6 +719,7 @@ class JaxEngine(AsyncEngine):
         self._freq_pens[slot] = so.frequency_penalty or 0.0
         self._pres_pens[slot] = so.presence_penalty or 0.0
         self._rep_pens[slot] = so.repetition_penalty or 1.0
+        self._logprob_ks[slot] = min(so.logprobs or 0, 20)
         if self._slot_has_penalty(slot):
             if self.mirror is not None:
                 logger.warning(
@@ -739,6 +743,12 @@ class JaxEngine(AsyncEngine):
     def _penalties_active(self) -> bool:
         return self._pen_counts is not None and any(
             self._slot_has_penalty(i)
+            for i, s in enumerate(self._active) if s is not None
+        )
+
+    def _logprobs_active(self) -> bool:
+        return any(
+            self._logprob_ks[i] > 0
             for i, s in enumerate(self._active) if s is not None
         )
 
@@ -919,6 +929,8 @@ class JaxEngine(AsyncEngine):
             # penalties mutate the sampling distribution per emitted token;
             # the verify acceptance doesn't model that yet
             and not self._penalties_active()
+            # the verify path doesn't emit logprobs yet
+            and not self._logprobs_active()
             and n > 1
             and self._prefill_state is None
         ):
@@ -979,6 +991,7 @@ class JaxEngine(AsyncEngine):
             )
         self._inflight = {
             "toks": toks, "n": n,
+            "lps": self._window_logprobs,
             "slots": {i: s for i, s in enumerate(self._active)
                       if s is not None},
         }
@@ -1132,11 +1145,24 @@ class JaxEngine(AsyncEngine):
             (i, seq) for i, seq in window["slots"].items()
             if self._active[i] is seq and not seq.finished
         ]
+        lps = window.get("lps")
         for step_i in range(n):
             for i, seq in live:
                 if seq.finished:
                     continue
-                self._emit_token(seq, int(toks_host[step_i, i]))
+                entry = None
+                k = int(self._logprob_ks[i])
+                if lps is not None and k > 0:
+                    chosen, top_ids, top_lps = lps
+                    entry = {
+                        "logprob": float(chosen[step_i, i]),
+                        "top": [
+                            [int(top_ids[step_i, i, j]),
+                             float(top_lps[step_i, i, j])]
+                            for j in range(k)
+                        ],
+                    }
+                self._emit_token(seq, int(toks_host[step_i, i]), entry)
         for i, seq in live:
             if seq.finished:
                 continue
@@ -1196,33 +1222,40 @@ class JaxEngine(AsyncEngine):
             self.k_cache,
             self.v_cache,
         )
+        want_lp = self._logprobs_active()
         kw = dict(
             n_steps=n,
             use_pallas=self.use_pallas,
             mesh=self.mesh,
             unroll=not cfg.decode_layer_scan,
             merged=cfg.decode_merged,
+            with_logprobs=want_lp,
         )
         if self._penalties_active():
-            toks, self.k_cache, self.v_cache, self._pen_counts = (
-                llama.decode_window(
-                    *args, **kw,
-                    freq_pens=jnp.asarray(self._freq_pens),
-                    pres_pens=jnp.asarray(self._pres_pens),
-                    rep_pens=jnp.asarray(self._rep_pens),
-                    counts=self._pen_counts,
-                    prompt_mask=self._pen_mask,
-                )
+            out = llama.decode_window(
+                *args, **kw,
+                freq_pens=jnp.asarray(self._freq_pens),
+                pres_pens=jnp.asarray(self._pres_pens),
+                rep_pens=jnp.asarray(self._rep_pens),
+                counts=self._pen_counts,
+                prompt_mask=self._pen_mask,
             )
+            toks, self.k_cache, self.v_cache, self._pen_counts = out[:4]
+            lps = out[4] if want_lp else None
         else:
-            toks, self.k_cache, self.v_cache = llama.decode_window(
-                *args, **kw
-            )
+            out = llama.decode_window(*args, **kw)
+            toks, self.k_cache, self.v_cache = out[:3]
+            lps = out[3] if want_lp else None
+        # (chosen_lp [n, B], top_ids [n, B, K], top_lps [n, B, K]) host-side
+        self._window_logprobs = (
+            tuple(np.asarray(jax.device_get(a)) for a in lps)
+            if lps is not None else None
+        )
         return toks
 
     # ---- token emission + finish logic ----
 
-    def _emit_token(self, seq: _Sequence, token: int) -> None:
+    def _emit_token(self, seq: _Sequence, token: int, lp_entry=None) -> None:
         req = seq.request
         sc = req.stop_conditions
         seq.tokens.append(token)
@@ -1244,6 +1277,8 @@ class JaxEngine(AsyncEngine):
             finish = FinishReason.CANCELLED
 
         out = LLMEngineOutput(token_ids=[token])
+        if lp_entry is not None:
+            out.logprobs = [lp_entry]
         if finish is not None:
             out.finish_reason = finish
             out.prompt_tokens = seq.prompt_len

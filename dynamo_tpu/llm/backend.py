@@ -93,6 +93,18 @@ class Backend(Operator):
                 piece = decoder.step(tid)
                 if piece is not None:
                     text_parts.append(piece)
+            if out.logprobs:
+                # enrich id-level entries with token text (the engine
+                # emits ids + floats; OpenAI responses carry strings)
+                for tid, entry in zip(out.token_ids, out.logprobs):
+                    entry["token"] = self._tokenizer.decode([tid])
+                    entry["top"] = [
+                        {
+                            "token": self._tokenizer.decode([i]),
+                            "logprob": lp,
+                        }
+                        for i, lp in entry.get("top", [])
+                    ]
             if out.is_final():
                 tail = decoder.flush()
                 if tail:

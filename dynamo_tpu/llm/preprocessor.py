@@ -138,6 +138,12 @@ class DeltaGenerator:
                 prompt_tokens=out.prompt_tokens or self.prompt_tokens,
                 completion_tokens=out.completion_tokens or self.completion_tokens,
             )
+        from ..protocols.openai import (
+            chat_logprobs_block,
+            completion_logprobs_block,
+        )
+
+        lps = out.logprobs or None
         if self.is_chat:
             delta: dict = {}
             if include_role:
@@ -149,6 +155,7 @@ class DeltaGenerator:
                     chat_chunk(
                         self.id, self.req.model, delta,
                         finish_reason=finish, usage=usage,
+                        logprobs=chat_logprobs_block(lps) if lps else None,
                     )
                 )
         else:
@@ -157,6 +164,7 @@ class DeltaGenerator:
                     completion_chunk(
                         self.id, self.req.model, text,
                         finish_reason=finish, usage=usage,
+                        logprobs=completion_logprobs_block(lps) if lps else None,
                     )
                 )
         return result

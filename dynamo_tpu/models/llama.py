@@ -595,7 +595,8 @@ def decode_step(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll", "interpret", "merged"),
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh", "unroll",
+                     "interpret", "merged", "with_logprobs"),
     donate_argnames=("k_cache", "v_cache", "counts"),
 )
 def decode_window(
@@ -625,6 +626,7 @@ def decode_window(
     rep_pens: Optional[jnp.ndarray] = None,  # [B] f32 (1.0 = off)
     counts: Optional[jnp.ndarray] = None,  # [B, V] i32 output-token counts, donated
     prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
+    with_logprobs: bool = False,  # also emit per-step top-k logprobs
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -639,6 +641,7 @@ def decode_window(
         bump_counts,
         make_keys,
         sample_tokens,
+        token_logprobs,
     )
 
     penalized = counts is not None
@@ -658,24 +661,31 @@ def decode_window(
             )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)
+        ys = (nxt, *token_logprobs(logits, nxt)) if with_logprobs else nxt
         if penalized:
             cnt = bump_counts(cnt, nxt)
             return (nxt, positions + 1, seq_lens + 1, steps + 1,
-                    k_cache, v_cache, cnt), nxt
+                    k_cache, v_cache, cnt), ys
         return (nxt, positions + 1, seq_lens + 1, steps + 1,
-                k_cache, v_cache), nxt
+                k_cache, v_cache), ys
 
     if penalized:
         carry = (tokens, positions, seq_lens, steps, k_cache, v_cache, counts)
-        (_, _, _, _, k_cache, v_cache, counts), toks = lax.scan(
+        (_, _, _, _, k_cache, v_cache, counts), ys = lax.scan(
             body, carry, None, length=n_steps
         )
-        return toks, k_cache, v_cache, counts
+        toks = ys[0] if with_logprobs else ys
+        lps = ys[1:] if with_logprobs else None
+        out = (toks, k_cache, v_cache, counts)
+        return out + (lps,) if with_logprobs else out
     carry = (tokens, positions, seq_lens, steps, k_cache, v_cache)
-    (_, _, _, _, k_cache, v_cache), toks = lax.scan(
+    (_, _, _, _, k_cache, v_cache), ys = lax.scan(
         body, carry, None, length=n_steps
     )
-    return toks, k_cache, v_cache
+    toks = ys[0] if with_logprobs else ys
+    lps = ys[1:] if with_logprobs else None
+    out = (toks, k_cache, v_cache)
+    return out + (lps,) if with_logprobs else out
 
 
 # ---------------- speculative verify (prompt-lookup decoding) ----------------

@@ -199,6 +199,22 @@ def bump_counts(counts: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return counts.at[jnp.arange(B), tokens].add(1)
 
 
+TOPK_LOGPROBS = 20  # OpenAI's top_logprobs cap; the host slices per-request
+
+
+def token_logprobs(
+    logits: jnp.ndarray,  # [B, V] float32 (raw model logits)
+    chosen: jnp.ndarray,  # [B] int32 the emitted token
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(chosen_logprob [B], top_ids [B, K], top_logprobs [B, K]) of the
+    model's distribution (raw log-softmax — reported logprobs are
+    pre-temperature/penalty, the model's own distribution)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen_lp = jnp.take_along_axis(logp, chosen[:, None], axis=1)[:, 0]
+    top_lp, top_ids = jax.lax.top_k(logp, TOPK_LOGPROBS)
+    return chosen_lp, top_ids.astype(jnp.int32), top_lp
+
+
 def make_keys(seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
     """Derive per-(request, step) key data from int seeds — deterministic
     replay per request without threading key state through the host."""

@@ -116,6 +116,10 @@ class LLMEngineOutput:
     token_ids: list[int] = field(default_factory=list)
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
+    # per-token logprob entries aligned with token_ids (filled when the
+    # request asked for logprobs): {"logprob": float, "top": [[id, lp]..]},
+    # enriched with token text by the detokenizer stage
+    logprobs: Optional[list] = None
     finish_reason: Optional[FinishReason] = None
     # usage accounting (filled by the engine on the final chunk)
     prompt_tokens: Optional[int] = None
@@ -132,6 +136,8 @@ class LLMEngineOutput:
             d["text"] = self.text
         if self.cum_log_probs is not None:
             d["cum_log_probs"] = self.cum_log_probs
+        if self.logprobs is not None:
+            d["logprobs"] = self.logprobs
         if self.finish_reason is not None:
             d["finish_reason"] = self.finish_reason.value
         if self.prompt_tokens is not None:
@@ -147,6 +153,7 @@ class LLMEngineOutput:
             token_ids=list(d.get("token_ids", [])),
             text=d.get("text"),
             cum_log_probs=d.get("cum_log_probs"),
+            logprobs=d.get("logprobs"),
             finish_reason=FinishReason(fr) if fr else None,
             prompt_tokens=d.get("prompt_tokens"),
             completion_tokens=d.get("completion_tokens"),

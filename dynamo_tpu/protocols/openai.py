@@ -224,6 +224,7 @@ def chat_chunk(
     created: Optional[int] = None,
     usage: Optional[Usage] = None,
     index: int = 0,
+    logprobs: Optional[dict] = None,
 ) -> dict:
     """One chat.completion.chunk SSE object."""
     out = {
@@ -232,12 +233,40 @@ def chat_chunk(
         "created": created or _now(),
         "model": model,
         "choices": [
-            {"index": index, "delta": delta, "finish_reason": finish_reason, "logprobs": None}
+            {"index": index, "delta": delta, "finish_reason": finish_reason,
+             "logprobs": logprobs}
         ],
     }
     if usage is not None:
         out["usage"] = usage.to_dict()
     return out
+
+
+def chat_logprobs_block(entries: list) -> dict:
+    """OpenAI chat logprobs schema from the backend's enriched entries."""
+    return {
+        "content": [
+            {
+                "token": e.get("token", ""),
+                "logprob": e.get("logprob"),
+                "top_logprobs": e.get("top", []),
+            }
+            for e in entries
+        ]
+    }
+
+
+def completion_logprobs_block(entries: list) -> dict:
+    """Legacy completions logprobs schema."""
+    return {
+        "tokens": [e.get("token", "") for e in entries],
+        "token_logprobs": [e.get("logprob") for e in entries],
+        "top_logprobs": [
+            {t["token"]: t["logprob"] for t in e.get("top", [])}
+            for e in entries
+        ],
+        "text_offset": [],
+    }
 
 
 def completion_chunk(
@@ -248,6 +277,7 @@ def completion_chunk(
     created: Optional[int] = None,
     usage: Optional[Usage] = None,
     index: int = 0,
+    logprobs: Optional[dict] = None,
 ) -> dict:
     out = {
         "id": id,
@@ -255,7 +285,8 @@ def completion_chunk(
         "created": created or _now(),
         "model": model,
         "choices": [
-            {"index": index, "text": text, "finish_reason": finish_reason, "logprobs": None}
+            {"index": index, "text": text, "finish_reason": finish_reason,
+             "logprobs": logprobs}
         ],
     }
     if usage is not None:

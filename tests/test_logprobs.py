@@ -1,0 +1,78 @@
+"""Per-token logprobs: engine emission + OpenAI rendering."""
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import Context, collect
+
+
+def _req(tokens, max_tokens=8, logprobs=None, temperature=0.0):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(
+            temperature=temperature, logprobs=logprobs
+        ),
+        eos_token_ids=[],
+    )
+
+
+def test_engine_emits_logprobs(run):
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(dtype="float32"), num_blocks=64,
+            block_size=4, max_batch_size=2, decode_window=4,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        out = await collect(
+            engine.generate(Context(_req(range(10, 20), max_tokens=6,
+                                         logprobs=3)))
+        )
+        entries = [e for o in out for e in (o.logprobs or [])]
+        toks = [t for o in out for t in o.token_ids]
+        # the prefill's first sampled token carries no entry (documented);
+        # every decode-window token does
+        assert len(entries) >= len(toks) - 1
+        for e in entries:
+            assert e["logprob"] <= 0.0
+            assert len(e["top"]) == 3
+            lps = [lp for _, lp in e["top"]]
+            assert lps == sorted(lps, reverse=True)  # top-k descending
+            # greedy: the chosen token IS the top-1
+            assert e["top"][0][1] >= e["logprob"] - 1e-5
+
+        # a request WITHOUT logprobs must not pay for or carry them
+        out2 = await collect(
+            engine.generate(Context(_req(range(10, 20), max_tokens=4)))
+        )
+        assert all(o.logprobs is None for o in out2)
+        await engine.close()
+
+    run(main())
+
+
+def test_openai_logprob_rendering():
+    from dynamo_tpu.protocols.openai import (
+        chat_logprobs_block,
+        completion_logprobs_block,
+    )
+
+    entries = [
+        {"token": "a", "logprob": -0.1,
+         "top": [{"token": "a", "logprob": -0.1},
+                 {"token": "b", "logprob": -2.0}]},
+    ]
+    chat = chat_logprobs_block(entries)
+    assert chat["content"][0]["token"] == "a"
+    assert chat["content"][0]["top_logprobs"][1]["logprob"] == -2.0
+    comp = completion_logprobs_block(entries)
+    assert comp["tokens"] == ["a"]
+    assert comp["top_logprobs"][0]["b"] == -2.0
