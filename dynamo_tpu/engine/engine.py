@@ -576,9 +576,10 @@ class JaxEngine(AsyncEngine):
             return False
         if first_token is None:
             return False  # more chunks to go
+        first_token, first_lp = first_token
         self._prefill_state = None
         self._commit_full_blocks(seq)
-        self._emit_token(seq, first_token)
+        self._emit_token(seq, first_token, first_lp)
         if not seq.finished:
             self._place_in_batch(seq)
         return True
@@ -591,7 +592,7 @@ class JaxEngine(AsyncEngine):
         logits, st.pos = self._run_one_chunk(st.seq, st.pos)
         if st.pos < len(st.seq.tokens):
             return None
-        return self._sample_prefill(st.seq, logits)
+        return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
 
     def _offload_preamble(self, restore_data, restore_idxs) -> None:
         """d2h evicted blocks before their pages get overwritten, then land
@@ -648,7 +649,7 @@ class JaxEngine(AsyncEngine):
         pos = history
         while pos < len(seq.tokens):
             logits, pos = self._run_one_chunk(seq, pos)
-        return self._sample_prefill(seq, logits)
+        return self._sample_prefill(seq, logits)[0]
 
     def _table_for(self, seq: _Sequence) -> np.ndarray:
         t = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
@@ -656,7 +657,9 @@ class JaxEngine(AsyncEngine):
             t[i] = b.idx
         return t
 
-    def _sample_prefill(self, seq: _Sequence, logits) -> int:
+    def _sample_prefill(self, seq: _Sequence, logits):
+        """Sample the first token from the prefill logits; returns
+        (token, logprob_entry_or_None)."""
         so = seq.request.sampling_options
         temp = so.temperature if so.temperature is not None else 1.0
         if getattr(seq.request, "greedy", False):
@@ -665,7 +668,7 @@ class JaxEngine(AsyncEngine):
             return self.mirror.lead_sample1(
                 logits, (so.seed or 0) & 0x7FFFFFFF, seq.generated, temp,
                 so.top_k or 0, so.top_p if so.top_p is not None else 1.0,
-            )
+            ), None
         keys = make_keys(
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
@@ -700,7 +703,23 @@ class JaxEngine(AsyncEngine):
             jnp.asarray([so.top_k or 0], jnp.int32),
             jnp.asarray([so.top_p if so.top_p is not None else 1.0], jnp.float32),
         )
-        return int(jax.device_get(tok)[0])
+        token = int(jax.device_get(tok)[0])
+        entry = None
+        k = min(so.logprobs or 0, 20)
+        if k > 0:
+            from ..ops.sampling import token_logprobs
+
+            chosen, top_ids, top_lps = token_logprobs(
+                jnp.asarray(logits)[None].astype(jnp.float32),
+                jnp.asarray([token], jnp.int32),
+            )
+            ids = np.asarray(jax.device_get(top_ids))[0]
+            lps = np.asarray(jax.device_get(top_lps))[0]
+            entry = {
+                "logprob": float(jax.device_get(chosen)[0]),
+                "top": [[int(ids[j]), float(lps[j])] for j in range(k)],
+            }
+        return token, entry
 
     def _place_in_batch(self, seq: _Sequence) -> None:
         slot = self._active.index(None)

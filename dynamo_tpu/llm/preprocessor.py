@@ -12,7 +12,7 @@ chat.completion.chunk / text_completion deltas, including the requested
 
 from __future__ import annotations
 
-from typing import AsyncIterator, Union
+from typing import AsyncIterator, Optional, Union
 
 from ..protocols.common import LLMEngineOutput, PreprocessedRequest
 from ..protocols.openai import (
@@ -94,6 +94,14 @@ class OpenAIPreprocessor(Operator):
             elif ann == ANNOTATION_TOKEN_IDS:
                 yield Annotated.from_annotation(ANNOTATION_TOKEN_IDS, pre.token_ids)
 
+        n = getattr(req.sampling, "n", 1) or 1
+        if n > 1:
+            async for item in self._generate_n(
+                request, next_engine, req, pre, is_chat, n
+            ):
+                yield item
+            return
+
         delta = DeltaGenerator(req, is_chat=is_chat, prompt_tokens=len(pre.token_ids))
         first = True
         async for item in next_engine.generate(request.transfer(pre)):
@@ -114,13 +122,100 @@ class OpenAIPreprocessor(Operator):
                 break
 
 
+    async def _generate_n(
+        self, request: Context, next_engine: AsyncEngine, req, pre,
+        is_chat: bool, n: int,
+    ) -> AsyncIterator[Annotated]:
+        """OpenAI ``n > 1``: fan the request out as n concurrent engine
+        sub-streams (per-choice seeds so sampled choices differ; each
+        sub-stream gets its own detokenizer state downstream), multiplex
+        their chunks under one response id with per-choice indexes, and
+        emit one summed usage on the final chunk."""
+        import asyncio
+        import dataclasses
+
+        delta_id = new_chat_id() if is_chat else new_cmpl_id()
+        queue: asyncio.Queue = asyncio.Queue()
+        prompt_tokens = len(pre.token_ids)
+        completion_total = 0
+
+        async def run_choice(i: int) -> None:
+            so = dataclasses.replace(
+                pre.sampling_options,
+                n=1,
+                seed=((pre.sampling_options.seed or 0) + i * 1_000_003)
+                & 0x7FFFFFFF,
+            )
+            sub = dataclasses.replace(pre, sampling_options=so)
+            delta = DeltaGenerator(
+                req, is_chat=is_chat, prompt_tokens=prompt_tokens,
+                id=delta_id, index=i, with_usage=False,
+            )
+            first = True
+            try:
+                async for item in next_engine.generate(request.transfer(sub)):
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.data is None:
+                        queue.put_nowait(("item", item, 0))
+                        continue
+                    out = (
+                        item.data
+                        if isinstance(item.data, LLMEngineOutput)
+                        else LLMEngineOutput.from_dict(item.data)
+                    )
+                    for chunk in delta.chunks(out, include_role=first):
+                        queue.put_nowait(
+                            ("item", Annotated(data=chunk, id=item.id), 0)
+                        )
+                    first = False
+                    if out.is_final():
+                        break
+            finally:
+                queue.put_nowait(("done", None, delta.completion_tokens))
+
+        tasks = [
+            asyncio.get_running_loop().create_task(run_choice(i))
+            for i in range(n)
+        ]
+        try:
+            done = 0
+            while done < n:
+                kind, item, toks = await queue.get()
+                if kind == "done":
+                    done += 1
+                    completion_total += toks
+                else:
+                    yield item
+        finally:
+            for t in tasks:
+                t.cancel()
+        usage = Usage(
+            prompt_tokens=prompt_tokens, completion_tokens=completion_total
+        )
+        yield Annotated(
+            data={
+                "id": delta_id,
+                "object": "chat.completion.chunk" if is_chat
+                else "text_completion",
+                "model": req.model,
+                "choices": [],
+                "usage": usage.to_dict(),
+            }
+        )
+
+
 class DeltaGenerator:
     """LLMEngineOutput -> OpenAI chunk dicts (ref chat_completions/delta.rs:215)."""
 
-    def __init__(self, req, is_chat: bool, prompt_tokens: int):
+    def __init__(self, req, is_chat: bool, prompt_tokens: int,
+                 id: Optional[str] = None, index: int = 0,
+                 with_usage: bool = True):
         self.req = req
         self.is_chat = is_chat
-        self.id = new_chat_id() if is_chat else new_cmpl_id()
+        self.id = id or (new_chat_id() if is_chat else new_cmpl_id())
+        self.index = index
+        self.with_usage = with_usage
         self.prompt_tokens = prompt_tokens
         self.completion_tokens = 0
 
@@ -133,7 +228,7 @@ class DeltaGenerator:
         # streaming clients that did not ask for include_usage, and the
         # aggregator folds it into non-streaming responses (OpenAI-required)
         usage = None
-        if finish is not None:
+        if finish is not None and self.with_usage:
             usage = Usage(
                 prompt_tokens=out.prompt_tokens or self.prompt_tokens,
                 completion_tokens=out.completion_tokens or self.completion_tokens,
@@ -155,6 +250,7 @@ class DeltaGenerator:
                     chat_chunk(
                         self.id, self.req.model, delta,
                         finish_reason=finish, usage=usage,
+                        index=self.index,
                         logprobs=chat_logprobs_block(lps) if lps else None,
                     )
                 )
@@ -164,6 +260,7 @@ class DeltaGenerator:
                     completion_chunk(
                         self.id, self.req.model, text,
                         finish_reason=finish, usage=usage,
+                        index=self.index,
                         logprobs=completion_logprobs_block(lps) if lps else None,
                     )
                 )

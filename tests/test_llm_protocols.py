@@ -237,3 +237,60 @@ def test_chat_template_tools_passthrough():
     without = tok.apply_chat_template([{"role": "user", "content": "hi"}])
     assert "get_weather" in with_tools
     assert "get_weather" not in without
+
+
+def test_n_parallel_completions(run):
+    """OpenAI n>1: the preprocessor fans out n engine sub-streams with
+    distinct seeds, multiplexes indexed chunks under one id, and the
+    aggregator folds them into n choices with summed usage."""
+    import asyncio
+
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.protocols.aggregator import aggregate_chat_chunks
+    from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime import Annotated, AsyncEngine, Context, collect
+
+    class SeedEchoEngine(AsyncEngine):
+        """Emits tokens derived from the per-choice seed so choices differ."""
+
+        async def generate(self, request: Context):
+            seed = request.data.sampling_options.seed or 0
+            for t in range(3):
+                tok = ord("a") + (seed + t) % 26
+                yield Annotated.from_data(
+                    LLMEngineOutput(token_ids=[tok], text=chr(tok))
+                )
+            yield Annotated.from_data(
+                LLMEngineOutput(finish_reason=FinishReason.LENGTH,
+                                prompt_tokens=2, completion_tokens=3)
+            )
+
+    async def main():
+        pre = OpenAIPreprocessor(ByteTokenizer())
+        req = ChatCompletionRequest.from_dict({
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "n": 3,
+            "seed": 5,
+            "temperature": 0.9,
+        })
+        items = await collect(pre.generate(Context(req), SeedEchoEngine()))
+        chunks = [a.data for a in items if isinstance(a.data, dict)]
+        indexes = {
+            c["choices"][0]["index"] for c in chunks if c.get("choices")
+        }
+        assert indexes == {0, 1, 2}
+        ids = {c["id"] for c in chunks if c.get("id")}
+        assert len(ids) == 1
+        full = aggregate_chat_chunks(chunks)
+        assert len(full["choices"]) == 3
+        texts = {c["message"]["content"] for c in full["choices"]}
+        assert len(texts) == 3  # distinct seeds -> distinct choices
+        assert full["usage"]["completion_tokens"] == 9
+        # the summed-usage chunk reports the preprocessor's own prompt
+        # token count (the engine's per-choice usage is suppressed)
+        assert full["usage"]["prompt_tokens"] > 0
+
+    run(main())

@@ -38,9 +38,9 @@ def test_engine_emits_logprobs(run):
         )
         entries = [e for o in out for e in (o.logprobs or [])]
         toks = [t for o in out for t in o.token_ids]
-        # the prefill's first sampled token carries no entry (documented);
-        # every decode-window token does
-        assert len(entries) >= len(toks) - 1
+        # every emitted token carries an entry, including the prefill's
+        # first sampled token
+        assert len(entries) == len(toks)
         for e in entries:
             assert e["logprob"] <= 0.0
             assert len(e["top"]) == 3
