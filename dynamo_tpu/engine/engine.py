@@ -665,10 +665,25 @@ class JaxEngine(AsyncEngine):
         if getattr(seq.request, "greedy", False):
             temp = 0.0
         if self.mirror is not None:
-            return self.mirror.lead_sample1(
+            token = self.mirror.lead_sample1(
                 logits, (so.seed or 0) & 0x7FFFFFFF, seq.generated, temp,
                 so.top_k or 0, so.top_p if so.top_p is not None else 1.0,
-            ), None
+            )
+            entry = None
+            k = min(so.logprobs or 0, 20)
+            if k > 0:
+                # read the leader's LOCAL shard (replicated => complete);
+                # jax.device_get on a multiprocess array would wait on a
+                # collective the followers never join
+                row = np.asarray(logits.addressable_data(0), np.float64)
+                row = row - row.max()
+                row = row - np.log(np.exp(row).sum())
+                top = np.argsort(row)[::-1][:k]
+                entry = {
+                    "logprob": float(row[token]),
+                    "top": [[int(i), float(row[i])] for i in top],
+                }
+            return token, entry
         keys = make_keys(
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
@@ -740,17 +755,7 @@ class JaxEngine(AsyncEngine):
         self._rep_pens[slot] = so.repetition_penalty or 1.0
         self._logprob_ks[slot] = min(so.logprobs or 0, 20)
         if self._slot_has_penalty(slot):
-            if self.mirror is not None:
-                logger.warning(
-                    "sampling penalties are not mirrored to multi-host "
-                    "followers yet; ignoring for request %s",
-                    getattr(seq.context, "id", "?"),
-                )
-                self._freq_pens[slot] = 0.0
-                self._pres_pens[slot] = 0.0
-                self._rep_pens[slot] = 1.0
-            else:
-                self._reset_penalty_slot(slot, seq)
+            self._reset_penalty_slot(slot, seq)
 
     def _slot_has_penalty(self, i: int) -> bool:
         return (
@@ -775,22 +780,37 @@ class JaxEngine(AsyncEngine):
         """Zero the slot's output counts and rebuild its prompt mask
         (repetition penalty covers prompt + output tokens)."""
         V = self.cfg.model.vocab_size
-        if self._pen_counts is None:
-            self._pen_counts = jnp.zeros(
-                (self.cfg.max_batch_size, V), jnp.int32
-            )
-            self._pen_mask = jnp.zeros(
-                (self.cfg.max_batch_size, V), jnp.bool_
-            )
+        B = self.cfg.max_batch_size
+
         def pad(ids):
             out = np.full(_bucket(max(len(ids), 1)), V, np.int32)  # V = drop
             out[: len(ids)] = ids
-            return jnp.asarray(out)
+            return out
 
+        prompt_p = pad(seq.tokens[: seq.prompt_len])
+        gen_p = pad(seq.tokens[seq.prompt_len :])
+        if self.mirror is not None:
+            # broadcast FIRST: multi-process array creation below expects
+            # every rank to participate, and the followers only start on
+            # receiving the pen_reset op (leader-only device_put of a
+            # process-spanning array blocks awaiting peers)
+            self.mirror.lead_pen_reset(slot, prompt_p, gen_p)
+        if self._pen_counts is None:
+            if self.mirror is not None:
+                self._pen_counts = self.mirror.to_global(
+                    np.zeros((B, V), np.int32)
+                )
+                self._pen_mask = self.mirror.to_global(np.zeros((B, V), bool))
+            else:
+                self._pen_counts = jnp.zeros((B, V), jnp.int32)
+                self._pen_mask = jnp.zeros((B, V), jnp.bool_)
+        if self.mirror is not None:
+            prompt_j = self.mirror.to_global(prompt_p)
+            gen_j = self.mirror.to_global(gen_p)
+        else:
+            prompt_j, gen_j = jnp.asarray(prompt_p), jnp.asarray(gen_p)
         self._pen_counts, self._pen_mask = _reset_pen_slot(
-            self._pen_counts, self._pen_mask, slot,
-            pad(seq.tokens[: seq.prompt_len]),
-            pad(seq.tokens[seq.prompt_len :]),
+            self._pen_counts, self._pen_mask, slot, prompt_j, gen_j
         )
 
     # ---- decode ----
@@ -1214,7 +1234,9 @@ class JaxEngine(AsyncEngine):
         ).astype(np.int32)
         seq_lens = (self._seq_lens + pending).astype(np.int32)
         if self.mirror is not None:
-            toks, self.k_cache, self.v_cache = self.mirror.lead_decode(
+            penalized = self._penalties_active()
+            want_lp = self._logprobs_active()
+            out = self.mirror.lead_decode(
                 self.params, self._last_tokens, positions,
                 self._block_tables, seq_lens, self._seeds, steps,
                 self._temps, self._top_ks, self._top_ps,
@@ -1222,6 +1244,22 @@ class JaxEngine(AsyncEngine):
                 n_steps=n, use_pallas=self.use_pallas,
                 unroll=not cfg.decode_layer_scan,
                 merged=cfg.decode_merged,
+                penalties=(self._freq_pens, self._pres_pens, self._rep_pens)
+                if penalized else None,
+                pen_state=(self._pen_counts, self._pen_mask)
+                if penalized else None,
+                with_logprobs=want_lp,
+            )
+            toks, self.k_cache, self.v_cache = out[0], out[1], out[2]
+            rest = list(out[3:])
+            if penalized:
+                self._pen_counts = rest.pop(0)
+            # local shards of replicated outputs (device_get would wait on
+            # a cross-process fetch the followers never join)
+            self._window_logprobs = (
+                tuple(np.asarray(a.addressable_data(0))
+                      for a in rest.pop(0))
+                if want_lp else None
             )
             return toks
         if tokens_in is None:

@@ -164,8 +164,10 @@ class StepMirror:
     # ---- fused step programs (shared leader/follower) ----
 
     def _decode_fn(self, n_steps: int = 1, use_pallas: bool = False,
-                   unroll: bool = True, merged: bool = True):
-        key = ("decode", n_steps, use_pallas, unroll, merged)
+                   unroll: bool = True, merged: bool = True,
+                   penalized: bool = False, with_logprobs: bool = False):
+        key = ("decode", n_steps, use_pallas, unroll, merged, penalized,
+               with_logprobs)
         if key not in self._fns:
             import jax
 
@@ -174,20 +176,35 @@ class StepMirror:
             cfg = self.model_cfg
             mesh = self.mesh  # sharded pallas attention + ragged MoE
 
-            def step(params, tokens, positions, tables, seq_lens, seeds,
-                     steps, temps, top_ks, top_ps, k_cache, v_cache):
-                return llama.decode_window.__wrapped__(
-                    params, cfg, tokens, positions, tables, seq_lens,
-                    seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
-                    n_steps=n_steps, use_pallas=use_pallas, mesh=mesh,
-                    unroll=unroll, merged=merged,
-                )
+            if penalized:
 
-            self._fns[key] = jax.jit(
-                step,
-                donate_argnums=(10, 11),
-                out_shardings=(self._rep, self._cache_sh, self._cache_sh),
-            )
+                def step(params, tokens, positions, tables, seq_lens, seeds,
+                         steps, temps, top_ks, top_ps, freq, pres, rep,
+                         k_cache, v_cache, counts, prompt_mask):
+                    return llama.decode_window.__wrapped__(
+                        params, cfg, tokens, positions, tables, seq_lens,
+                        seeds, steps, temps, top_ks, top_ps, k_cache,
+                        v_cache, n_steps=n_steps, use_pallas=use_pallas,
+                        mesh=mesh, unroll=unroll, merged=merged,
+                        with_logprobs=with_logprobs, freq_pens=freq,
+                        pres_pens=pres, rep_pens=rep, counts=counts,
+                        prompt_mask=prompt_mask,
+                    )
+
+                self._fns[key] = jax.jit(step, donate_argnums=(13, 14, 15))
+            else:
+
+                def step(params, tokens, positions, tables, seq_lens, seeds,
+                         steps, temps, top_ks, top_ps, k_cache, v_cache):
+                    return llama.decode_window.__wrapped__(
+                        params, cfg, tokens, positions, tables, seq_lens,
+                        seeds, steps, temps, top_ks, top_ps, k_cache,
+                        v_cache, n_steps=n_steps, use_pallas=use_pallas,
+                        mesh=mesh, unroll=unroll, merged=merged,
+                        with_logprobs=with_logprobs,
+                    )
+
+                self._fns[key] = jax.jit(step, donate_argnums=(10, 11))
         return self._fns[key]
 
     def _prefill_fn(self, use_pallas: bool = False):
@@ -271,24 +288,50 @@ class StepMirror:
 
     # ---- leader-side dispatch (called from JaxEngine) ----
 
+    def lead_pen_reset(self, slot: int, prompt_ids, gen_ids) -> None:
+        """Mirror a penalty-state slot rebuild: followers apply the same
+        deterministic reset so their [B, V] counts/mask device state stays
+        identical to the leader's through every subsequent window."""
+        self._lead(
+            "pen_reset",
+            (np.asarray(prompt_ids, np.int32), np.asarray(gen_ids, np.int32)),
+            slot=slot,
+        )
+
     def lead_decode(self, params, last_tokens, positions, tables, seq_lens,
                     seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
                     n_steps: int = 1, use_pallas: bool = False,
-                    unroll: bool = True, merged: bool = True):
+                    unroll: bool = True, merged: bool = True,
+                    penalties=None, pen_state=None,
+                    with_logprobs: bool = False):
+        """``penalties`` = (freq, pres, rep) host vectors; ``pen_state`` =
+        (counts, prompt_mask) device arrays (leader's copy — followers
+        hold their own mirrored state). Returns (host_tokens, k, v[,
+        counts, logprob arrays])."""
         import jax
 
-        self._lead("decode", (last_tokens, positions, tables, seq_lens,
-                              seeds, steps, temps, top_ks, top_ps),
-                   n=n_steps, pallas=use_pallas, unroll=unroll, merged=merged)
+        penalized = penalties is not None
+        head_arrays = [last_tokens, positions, tables, seq_lens,
+                       seeds, steps, temps, top_ks, top_ps]
+        if penalized:
+            head_arrays += [np.asarray(a) for a in penalties]
+        self._lead("decode", tuple(head_arrays),
+                   n=n_steps, pallas=use_pallas, unroll=unroll,
+                   merged=merged, penalized=penalized, lp=with_logprobs)
         g = self.to_global
-        toks, k_cache, v_cache = self._decode_fn(
-            n_steps, use_pallas, unroll, merged
-        )(
-            params, g(last_tokens), g(positions), g(tables), g(seq_lens),
-            g(seeds), g(steps), g(temps), g(top_ks), g(top_ps),
-            k_cache, v_cache,
+        fn = self._decode_fn(
+            n_steps, use_pallas, unroll, merged, penalized, with_logprobs
         )
-        return np.asarray(jax.device_get(toks)), k_cache, v_cache
+        base = (params, g(last_tokens), g(positions), g(tables), g(seq_lens),
+                g(seeds), g(steps), g(temps), g(top_ks), g(top_ps))
+        if penalized:
+            freq, pres, rep = (g(np.asarray(a, np.float32)) for a in penalties)
+            out = fn(*base[:10], freq, pres, rep, k_cache, v_cache,
+                     pen_state[0], pen_state[1])
+        else:
+            out = fn(*base, k_cache, v_cache)
+        toks = np.asarray(jax.device_get(out[0]))
+        return (toks,) + tuple(out[1:])
 
     def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache,
                      use_pallas: bool = False):
@@ -328,6 +371,7 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
     leader's JaxEngine was built with; params must be initialized the same
     way on every rank (same seed, or same checkpoint path)."""
     import jax
+    import jax.numpy as jnp
 
     from ..models import llama
 
@@ -347,6 +391,7 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
         dtype=kv_cache_dtype(mcfg, engine_cfg.kv_cache_dtype),
     )
     logits = None
+    pen_counts = pen_mask = None  # mirrored sampling-penalty state
     logger.info("follower %d ready", jax.process_index())
     while True:
         head, arrays = mirror.follow()
@@ -355,13 +400,34 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
         if op == "halt":
             logger.info("follower %d halting", jax.process_index())
             return
-        if op == "decode":
+        if op == "pen_reset":
+            if pen_counts is None:
+                V = mcfg.vocab_size
+                B = engine_cfg.max_batch_size
+                pen_counts = g(np.zeros((B, V), np.int32))
+                pen_mask = g(np.zeros((B, V), bool))
+            from ..engine.engine import _reset_pen_slot
+
+            prompt_ids, gen_ids = arrays
+            pen_counts, pen_mask = _reset_pen_slot(
+                pen_counts, pen_mask, head["slot"],
+                g(prompt_ids), g(gen_ids),
+            )
+        elif op == "decode":
+            penalized = head.get("penalized", False)
             fn = mirror._decode_fn(head.get("n", 1), head.get("pallas", False),
                                    head.get("unroll", True),
-                                   head.get("merged", True))
-            _toks, k_cache, v_cache = fn(
-                params, *(g(a) for a in arrays), k_cache, v_cache
-            )
+                                   head.get("merged", True),
+                                   penalized, head.get("lp", False))
+            if penalized:
+                out = fn(
+                    params, *(g(a) for a in arrays), k_cache, v_cache,
+                    pen_counts, pen_mask,
+                )
+                k_cache, v_cache, pen_counts = out[1], out[2], out[3]
+            else:
+                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache)
+                k_cache, v_cache = out[1], out[2]
         elif op == "prefill":
             logits, k_cache, v_cache = mirror._prefill_fn(
                 head.get("pallas", False)

@@ -4,7 +4,8 @@ Two OS processes (ranks 0/1) join a jax.distributed CPU runtime with 2
 virtual devices each, forming a dp=2 x tp=2 global mesh spanning both
 processes. Rank 0 runs the JaxEngine leader and serves it through the hub
 at dyn://mh.worker.generate; rank 1 runs the SPMD follower loop. Rank 0
-exits (broadcasting halt) after serving one request.
+exits (broadcasting halt) after serving two requests
+(the second exercises mirrored penalties + logprobs).
 
 Usage: python tests/mh_worker.py <rank> <coordinator-port> <hub-addr>
 """
@@ -51,12 +52,16 @@ async def leader() -> None:
     drt = await DistributedRuntime.from_settings(store=store, bus=bus)
 
     served = asyncio.Event()
+    n_served = 0
 
     class OneShot:
         async def generate(self, request):
+            nonlocal n_served
             async for item in engine.generate(request):
                 yield item
-            served.set()
+            n_served += 1
+            if n_served >= 2:
+                served.set()
 
     await drt.namespace("mh").component("worker").endpoint("generate").serve(
         OneShot()

@@ -73,6 +73,29 @@ def test_two_process_mesh_serves_through_hub(run):
             assert len(tokens) == 4, datas
             assert datas[-1].get("finish_reason") == "length", datas[-1]
 
+            # second request: mirrored sampling penalties + logprobs (the
+            # follower must replay the penalty-state reset and the
+            # penalized/logprob program variants in lockstep)
+            req2 = {
+                "token_ids": [5, 6, 7, 8],
+                "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+                "sampling_options": {
+                    "temperature": 0.0,
+                    "frequency_penalty": 2.0,
+                    "repetition_penalty": 1.1,
+                    "logprobs": 2,
+                },
+            }
+            out2 = await asyncio.wait_for(
+                collect(await client.round_robin(Context(req2))), 120
+            )
+            datas2 = [a.data for a in out2 if a.data]
+            tokens2 = [t for d in datas2 for t in d.get("token_ids", [])]
+            assert len(tokens2) == 4, datas2
+            entries2 = [e for d in datas2 for e in (d.get("logprobs") or [])]
+            assert len(entries2) == len(tokens2), datas2
+            assert all(len(e["top"]) == 2 for e in entries2)
+
             await front.shutdown()
             await conn.close()
             # both ranks must exit cleanly: leader after serving + halt
