@@ -659,15 +659,31 @@ class JaxEngine(AsyncEngine):
 
     def _sample_prefill(self, seq: _Sequence, logits):
         """Sample the first token from the prefill logits; returns
-        (token, logprob_entry_or_None)."""
+        (token, logprob_entry_or_None). Full penalty semantics: the
+        prompt mask AND output counts rebuild from the sequence's token
+        lists, so the replay-after-preemption first token draws from the
+        same distribution a decode window would use."""
         so = seq.request.sampling_options
         temp = so.temperature if so.temperature is not None else 1.0
         if getattr(seq.request, "greedy", False):
             temp = 0.0
+        V = self.cfg.model.vocab_size
+
+        def pad(ids):
+            out = np.full(_bucket(max(len(ids), 1)), V, np.int32)
+            out[: len(ids)] = ids
+            return out
+
+        prompt_p = pad(seq.tokens[: seq.prompt_len])
+        gen_p = pad(seq.tokens[seq.prompt_len :])
         if self.mirror is not None:
             token = self.mirror.lead_sample1(
                 logits, (so.seed or 0) & 0x7FFFFFFF, seq.generated, temp,
                 so.top_k or 0, so.top_p if so.top_p is not None else 1.0,
+                freq=so.frequency_penalty or 0.0,
+                pres=so.presence_penalty or 0.0,
+                rep=so.repetition_penalty or 1.0,
+                prompt_ids=prompt_p, gen_ids=gen_p,
             )
             entry = None
             k = min(so.logprobs or 0, 20)
@@ -684,39 +700,23 @@ class JaxEngine(AsyncEngine):
                     "top": [[int(i), float(row[i])] for i in top],
                 }
             return token, entry
+        from ..ops.sampling import sample_first_token
+
         keys = make_keys(
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
         )
-        logits_row = logits[None, :]
-        rep = so.repetition_penalty or 1.0
-        if rep != 1.0:
-            # repetition penalty covers the prompt, so it applies to the
-            # very first sampled token too (freq/presence count OUTPUT
-            # tokens — zero here)
-            from ..ops.sampling import apply_penalties
-
-            V = self.cfg.model.vocab_size
-            ids = seq.tokens[: seq.prompt_len]
-            padded = np.full(_bucket(max(len(ids), 1)), V, np.int32)
-            padded[: len(ids)] = ids
-            mask = jnp.zeros((V,), jnp.bool_).at[jnp.asarray(padded)].set(
-                True, mode="drop"
-            )
-            logits_row = apply_penalties(
-                logits_row.astype(jnp.float32),
-                jnp.zeros((1, V), jnp.int32),
-                mask[None],
-                jnp.zeros((1,), jnp.float32),
-                jnp.zeros((1,), jnp.float32),
-                jnp.asarray([rep], jnp.float32),
-            )
-        tok = sample_tokens(
-            logits_row,
+        tok = jax.jit(sample_first_token)(
+            logits[None, :],
             keys,
             jnp.asarray([temp], jnp.float32),
             jnp.asarray([so.top_k or 0], jnp.int32),
             jnp.asarray([so.top_p if so.top_p is not None else 1.0], jnp.float32),
+            jnp.asarray([so.frequency_penalty or 0.0], jnp.float32),
+            jnp.asarray([so.presence_penalty or 0.0], jnp.float32),
+            jnp.asarray([so.repetition_penalty or 1.0], jnp.float32),
+            jnp.asarray(prompt_p),
+            jnp.asarray(gen_p),
         )
         token = int(jax.device_get(tok)[0])
         entry = None
@@ -1185,6 +1185,11 @@ class JaxEngine(AsyncEngine):
             if self._active[i] is seq and not seq.finished
         ]
         lps = window.get("lps")
+        if lps is not None:
+            # local shards: complete for replicated outputs, and the only
+            # safe fetch on multi-process arrays (device_get would wait on
+            # a cross-process collective the followers never join)
+            lps = tuple(np.asarray(a.addressable_data(0)) for a in lps)
         for step_i in range(n):
             for i, seq in live:
                 if seq.finished:
@@ -1254,13 +1259,8 @@ class JaxEngine(AsyncEngine):
             rest = list(out[3:])
             if penalized:
                 self._pen_counts = rest.pop(0)
-            # local shards of replicated outputs (device_get would wait on
-            # a cross-process fetch the followers never join)
-            self._window_logprobs = (
-                tuple(np.asarray(a.addressable_data(0))
-                      for a in rest.pop(0))
-                if want_lp else None
-            )
+            # device handles; materialized at emission
+            self._window_logprobs = rest.pop(0) if want_lp else None
             return toks
         if tokens_in is None:
             tokens_in = jnp.asarray(self._last_tokens)
@@ -1303,11 +1303,9 @@ class JaxEngine(AsyncEngine):
             out = llama.decode_window(*args, **kw)
             toks, self.k_cache, self.v_cache = out[:3]
             lps = out[3] if want_lp else None
-        # (chosen_lp [n, B], top_ids [n, B, K], top_lps [n, B, K]) host-side
-        self._window_logprobs = (
-            tuple(np.asarray(jax.device_get(a)) for a in lps)
-            if lps is not None else None
-        )
+        # device handles; materialized at emission (fetching here would
+        # block the pipelined dispatch on the window's full execution)
+        self._window_logprobs = lps
         return toks
 
     # ---- token emission + finish logic ----

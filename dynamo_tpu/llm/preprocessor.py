@@ -156,6 +156,9 @@ class OpenAIPreprocessor(Operator):
                 async for item in next_engine.generate(request.transfer(sub)):
                     if not isinstance(item, Annotated):
                         item = Annotated.from_data(item)
+                    if item.is_error():
+                        queue.put_nowait(("err", item.error or "engine error", 0))
+                        return
                     if item.data is None:
                         queue.put_nowait(("item", item, 0))
                         continue
@@ -171,8 +174,13 @@ class OpenAIPreprocessor(Operator):
                     first = False
                     if out.is_final():
                         break
-            finally:
-                queue.put_nowait(("done", None, delta.completion_tokens))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — a dead choice must not
+                # masquerade as a completed one
+                queue.put_nowait(("err", f"{type(e).__name__}: {e}", 0))
+                return
+            queue.put_nowait(("done", None, delta.completion_tokens))
 
         tasks = [
             asyncio.get_running_loop().create_task(run_choice(i))
@@ -182,6 +190,10 @@ class OpenAIPreprocessor(Operator):
             done = 0
             while done < n:
                 kind, item, toks = await queue.get()
+                if kind == "err":
+                    # fail the whole request, matching the n=1 path
+                    yield Annotated.from_error(item)
+                    return
                 if kind == "done":
                     done += 1
                     completion_total += toks
@@ -193,11 +205,14 @@ class OpenAIPreprocessor(Operator):
         usage = Usage(
             prompt_tokens=prompt_tokens, completion_tokens=completion_total
         )
+        from ..protocols.openai import _now
+
         yield Annotated(
             data={
                 "id": delta_id,
                 "object": "chat.completion.chunk" if is_chat
                 else "text_completion",
+                "created": _now(),
                 "model": req.model,
                 "choices": [],
                 "usage": usage.to_dict(),

@@ -655,13 +655,14 @@ def decode_window(
             params, cfg, tokens, positions, block_tables, seq_lens,
             k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
         )
+        raw_logits = logits  # reported logprobs are the model's own dist
         if penalized:
             logits = apply_penalties(
                 logits, cnt, prompt_mask, freq_pens, pres_pens, rep_pens
             )
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)
-        ys = (nxt, *token_logprobs(logits, nxt)) if with_logprobs else nxt
+        ys = (nxt, *token_logprobs(raw_logits, nxt)) if with_logprobs else nxt
         if penalized:
             cnt = bump_counts(cnt, nxt)
             return (nxt, positions + 1, seq_lens + 1, steps + 1,

@@ -202,6 +202,32 @@ def bump_counts(counts: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
 TOPK_LOGPROBS = 20  # OpenAI's top_logprobs cap; the host slices per-request
 
 
+def sample_first_token(
+    logits: jnp.ndarray,  # [1, V] float32
+    keys: jnp.ndarray,  # [1, 2]
+    temperature: jnp.ndarray,  # [1]
+    top_k: jnp.ndarray,  # [1]
+    top_p: jnp.ndarray,  # [1]
+    freq_pen: jnp.ndarray,  # [1]
+    pres_pen: jnp.ndarray,  # [1]
+    rep_pen: jnp.ndarray,  # [1]
+    prompt_ids: jnp.ndarray,  # [P] int32 padded with V (dropped)
+    gen_ids: jnp.ndarray,  # [G] int32 padded with V — nonempty on replay
+) -> jnp.ndarray:  # [1] int32
+    """The prefill's first-token sample with full penalty semantics:
+    prompt-membership mask + output counts rebuilt from the id lists (the
+    replay-after-preemption case), so the first token is drawn from the
+    same distribution a decode window would use."""
+    V = logits.shape[-1]
+    mask = jnp.zeros((V,), jnp.bool_).at[prompt_ids].set(True, mode="drop")
+    counts = jnp.zeros((V,), jnp.int32).at[gen_ids].add(1, mode="drop")
+    logits = apply_penalties(
+        logits.astype(jnp.float32), counts[None], mask[None],
+        freq_pen, pres_pen, rep_pen,
+    )
+    return sample_tokens.__wrapped__(logits, keys, temperature, top_k, top_p)
+
+
 def token_logprobs(
     logits: jnp.ndarray,  # [B, V] float32 (raw model logits)
     chosen: jnp.ndarray,  # [B] int32 the emitted token

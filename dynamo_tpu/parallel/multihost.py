@@ -176,6 +176,16 @@ class StepMirror:
             cfg = self.model_cfg
             mesh = self.mesh  # sharded pallas attention + ragged MoE
 
+            # pin outputs: tokens/counts/logprobs replicated (the leader
+            # reads their local shards), caches on the cache sharding (the
+            # donation round-trip depends on a stable layout)
+            out_sh = [self._rep, self._cache_sh, self._cache_sh]
+            if penalized:
+                out_sh.append(self._rep)
+            if with_logprobs:
+                out_sh.append((self._rep, self._rep, self._rep))
+            out_sh = tuple(out_sh)
+
             if penalized:
 
                 def step(params, tokens, positions, tables, seq_lens, seeds,
@@ -191,7 +201,9 @@ class StepMirror:
                         prompt_mask=prompt_mask,
                     )
 
-                self._fns[key] = jax.jit(step, donate_argnums=(13, 14, 15))
+                self._fns[key] = jax.jit(
+                    step, donate_argnums=(13, 14, 15), out_shardings=out_sh
+                )
             else:
 
                 def step(params, tokens, positions, tables, seq_lens, seeds,
@@ -204,7 +216,9 @@ class StepMirror:
                         with_logprobs=with_logprobs,
                     )
 
-                self._fns[key] = jax.jit(step, donate_argnums=(10, 11))
+                self._fns[key] = jax.jit(
+                    step, donate_argnums=(10, 11), out_shardings=out_sh
+                )
         return self._fns[key]
 
     def _prefill_fn(self, use_pallas: bool = False):
@@ -234,11 +248,15 @@ class StepMirror:
         if "sample1" not in self._fns:
             import jax
 
-            from ..ops.sampling import make_keys, sample_tokens
+            from ..ops.sampling import make_keys, sample_first_token
 
-            def step(logits, seed, step_no, temp, top_k, top_p):
+            def step(logits, seed, step_no, temp, top_k, top_p,
+                     freq, pres, rep, prompt_ids, gen_ids):
                 keys = make_keys(seed, step_no)
-                return sample_tokens(logits[None, :], keys, temp, top_k, top_p)
+                return sample_first_token(
+                    logits[None, :], keys, temp, top_k, top_p,
+                    freq, pres, rep, prompt_ids, gen_ids,
+                )
 
             self._fns["sample1"] = jax.jit(step, out_shardings=self._rep)
         return self._fns["sample1"]
@@ -348,18 +366,27 @@ class StepMirror:
             k_cache, v_cache,
         )
 
-    def lead_sample1(self, logits, seed, step_no, temp, top_k, top_p) -> int:
-        import jax
-
-        scalars = (
+    def lead_sample1(self, logits, seed, step_no, temp, top_k, top_p,
+                     freq=0.0, pres=0.0, rep=1.0,
+                     prompt_ids=None, gen_ids=None) -> int:
+        arrays = (
             np.asarray([seed], np.int32), np.asarray([step_no], np.int32),
             np.asarray([temp], np.float32), np.asarray([top_k], np.int32),
             np.asarray([top_p], np.float32),
+            np.asarray([freq], np.float32), np.asarray([pres], np.float32),
+            np.asarray([rep], np.float32),
+            np.asarray(
+                prompt_ids if prompt_ids is not None else [2**31 - 1],
+                np.int32,
+            ),
+            np.asarray(
+                gen_ids if gen_ids is not None else [2**31 - 1], np.int32
+            ),
         )
-        self._lead("sample1", scalars)
+        self._lead("sample1", arrays)
         g = self.to_global
-        tok = self._sample1_fn()(logits, *(g(s) for s in scalars))
-        return int(np.asarray(jax.device_get(tok))[0])
+        tok = self._sample1_fn()(logits, *(g(a) for a in arrays))
+        return int(np.asarray(tok.addressable_data(0))[0])
 
     def lead_halt(self) -> None:
         self._lead("halt", ())

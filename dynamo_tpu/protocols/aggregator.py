@@ -64,6 +64,11 @@ def aggregate_chat_chunks(chunks: Iterable[dict]) -> dict:
                 _merge_tool_calls(acc["message"]["tool_calls"], delta["tool_calls"])
             if choice.get("finish_reason"):
                 acc["finish_reason"] = choice["finish_reason"]
+            lp = choice.get("logprobs")
+            if lp and lp.get("content"):
+                if acc["logprobs"] is None:
+                    acc["logprobs"] = {"content": []}
+                acc["logprobs"]["content"].extend(lp["content"])
     out = {
         "id": base.get("id"),
         "object": "chat.completion",
@@ -95,6 +100,16 @@ def aggregate_completion_chunks(chunks: Iterable[dict]) -> dict:
             acc["text"] += choice.get("text", "")
             if choice.get("finish_reason"):
                 acc["finish_reason"] = choice["finish_reason"]
+            lp = choice.get("logprobs")
+            if lp and lp.get("tokens"):
+                if acc["logprobs"] is None:
+                    acc["logprobs"] = {
+                        "tokens": [], "token_logprobs": [],
+                        "top_logprobs": [], "text_offset": [],
+                    }
+                acc["logprobs"]["tokens"].extend(lp["tokens"])
+                acc["logprobs"]["token_logprobs"].extend(lp["token_logprobs"])
+                acc["logprobs"]["top_logprobs"].extend(lp["top_logprobs"])
     out = {
         "id": base.get("id"),
         "object": "text_completion",
