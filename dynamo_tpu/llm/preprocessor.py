@@ -260,7 +260,7 @@ class DeltaGenerator:
                 delta["role"] = "assistant"
             if text or include_role:
                 delta["content"] = text
-            if delta or finish is not None:
+            if delta or finish is not None or lps:
                 result.append(
                     chat_chunk(
                         self.id, self.req.model, delta,
@@ -270,7 +270,7 @@ class DeltaGenerator:
                     )
                 )
         else:
-            if text or finish is not None:
+            if text or finish is not None or lps:
                 result.append(
                     completion_chunk(
                         self.id, self.req.model, text,

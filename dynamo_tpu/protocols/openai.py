@@ -79,7 +79,11 @@ def _common_sampling(d: dict) -> SamplingOptions:
         repetition_penalty=d.get("repetition_penalty"),
         seed=d.get("seed"),
         n=int(d.get("n") or 1),
-        logprobs=d.get("top_logprobs") if d.get("logprobs") else None,
+        # chat schema: logprobs (bool) + top_logprobs (int). logprobs:true
+        # alone still returns each chosen token's logprob (k=1 top).
+        logprobs=(
+            int(d.get("top_logprobs") or 1) if d.get("logprobs") else None
+        ),
     )
 
 
@@ -175,13 +179,17 @@ class CompletionRequest:
                 "list-of-strings prompt is not supported; send one request per prompt"
             )
         nvext = NvExt.from_dict(d.get("nvext"))
+        sampling = _common_sampling(d)
+        # legacy completions schema: logprobs is the top-k count itself
+        if d.get("logprobs") is not None:
+            sampling.logprobs = int(d["logprobs"]) or None
         return CompletionRequest(
             model=d["model"],
             prompt=d["prompt"],
             stream=bool(d.get("stream", False)),
             stream_options=d.get("stream_options") or {},
             echo=bool(d.get("echo", False)),
-            sampling=_common_sampling(d),
+            sampling=sampling,
             stops=_common_stops(d, nvext),
             nvext=nvext,
             raw=d,

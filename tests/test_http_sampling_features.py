@@ -1,0 +1,98 @@
+"""Full-stack e2e of the sampling feature set: one OS process running
+`dynamo_run in=http out=jax` (tiny model, CPU), driven over real HTTP —
+logprobs, n>1 choices, penalties, both streaming and folded."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, path: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_http_logprobs_n_and_penalties(tmp_path):
+    port = _free_port()
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.launch.dynamo_run",
+         "in=http", "out=jax", "--model-path", "tiny",
+         "--host", "127.0.0.1", "--http-port", str(port),
+         "--num-blocks", "64", "--block-size", "8", "--max-batch", "4"],
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/models", timeout=2
+                ) as r:
+                    if b"tiny" in r.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("server never came up")
+
+        # logprobs through /v1/completions (folded)
+        out = _post(port, "/v1/completions", {
+            "model": "tiny", "prompt": "hello", "max_tokens": 5,
+            "temperature": 0.0, "logprobs": 2,
+        })
+        lp = out["choices"][0]["logprobs"]
+        assert lp is not None
+        assert len(lp["tokens"]) == 5
+        assert len(lp["token_logprobs"]) == 5
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+        assert all(len(t) == 2 for t in lp["top_logprobs"])
+
+        # n=2 sampled chat choices (folded): two indexed choices + usage
+        out = _post(port, "/v1/chat/completions", {
+            "model": "tiny", "max_tokens": 6, "temperature": 0.9,
+            "seed": 3, "n": 2,
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        assert len(out["choices"]) == 2
+        assert {c["index"] for c in out["choices"]} == {0, 1}
+        assert out["usage"]["completion_tokens"] == 12
+
+        # penalties accepted end-to-end (stream completes at full length)
+        out = _post(port, "/v1/completions", {
+            "model": "tiny", "prompt": "aaaa", "max_tokens": 8,
+            "temperature": 0.0, "frequency_penalty": 2.0,
+            "repetition_penalty": 1.2,
+        })
+        assert out["choices"][0]["finish_reason"] == "length"
+        assert out["usage"]["completion_tokens"] == 8
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
