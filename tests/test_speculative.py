@@ -410,3 +410,69 @@ def test_verify_sharded_tp2_matches_single_device():
     )
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+
+
+def test_spec_gates_fall_back_cleanly(run):
+    """Feature-interaction gates: requests that the speculative path
+    cannot serve (logprobs, penalties, windowed models) must fall back to
+    plain windows and still produce full, correct-shaped output."""
+    import asyncio
+
+    from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(dtype="float32"), num_blocks=64,
+            block_size=8, max_batch_size=2, decode_window=4, spec_gamma=3,
+        )
+        engine = JaxEngine(cfg, seed=0)
+
+        # logprobs request: spec disabled for it, entries still complete
+        req = PreprocessedRequest(
+            token_ids=[7, 8, 9, 10] * 4,
+            stop_conditions=StopConditions(max_tokens=10),
+            sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
+            eos_token_ids=[],
+        )
+        out = await collect(engine.generate(Context(req)))
+        toks = [t for o in out for t in o.token_ids]
+        entries = [e for o in out for e in (o.logprobs or [])]
+        assert len(toks) == 10 and len(entries) == 10
+
+        # penalties request: spec disabled, full length
+        req2 = PreprocessedRequest(
+            token_ids=[7, 8, 9, 10] * 4,
+            stop_conditions=StopConditions(max_tokens=10),
+            sampling_options=SamplingOptions(
+                temperature=0.0, frequency_penalty=3.0
+            ),
+            eos_token_ids=[],
+        )
+        out2 = await collect(engine.generate(Context(req2)))
+        assert len([t for o in out2 for t in o.token_ids]) == 10
+        await engine.close()
+
+        # windowed model: spec gate off entirely, streams still complete
+        cfgw = EngineConfig(
+            model=ModelConfig.tiny(dtype="float32", sliding_window=6),
+            num_blocks=64, block_size=8, max_batch_size=2,
+            decode_window=4, spec_gamma=3,
+        )
+        enginew = JaxEngine(cfgw, seed=0)
+        outw = await collect(enginew.generate(Context(PreprocessedRequest(
+            token_ids=[7, 8, 9, 10] * 4,
+            stop_conditions=StopConditions(max_tokens=10),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        assert len([t for o in outw for t in o.token_ids]) == 10
+        assert enginew.stats["spec_proposed"] == 0  # gate held
+        await enginew.close()
+
+    run(main())
