@@ -277,8 +277,9 @@ class JaxEngine(AsyncEngine):
         self._rep_pens = np.ones(cfg.max_batch_size, np.float32)
         self._pen_counts = None
         self._pen_mask = None
-        # requested top-logprob count per slot (0 = none)
-        self._logprob_ks = np.zeros(cfg.max_batch_size, np.int32)
+        # requested top-logprob count per slot (-1 = logprobs off;
+        # 0 = chosen-token logprob only, no alternates)
+        self._logprob_ks = np.full(cfg.max_batch_size, -1, np.int32)
         self._window_logprobs = None
         # metrics
         self.stats = {
@@ -686,8 +687,8 @@ class JaxEngine(AsyncEngine):
                 prompt_ids=prompt_p, gen_ids=gen_p,
             )
             entry = None
-            k = min(so.logprobs or 0, 20)
-            if k > 0:
+            k = min(so.logprobs, 20) if so.logprobs is not None else -1
+            if k >= 0:
                 # read the leader's LOCAL shard (replicated => complete);
                 # jax.device_get on a multiprocess array would wait on a
                 # collective the followers never join
@@ -720,8 +721,8 @@ class JaxEngine(AsyncEngine):
         )
         token = int(jax.device_get(tok)[0])
         entry = None
-        k = min(so.logprobs or 0, 20)
-        if k > 0:
+        k = min(so.logprobs, 20) if so.logprobs is not None else -1
+        if k >= 0:
             from ..ops.sampling import token_logprobs
 
             chosen, top_ids, top_lps = token_logprobs(
@@ -753,7 +754,9 @@ class JaxEngine(AsyncEngine):
         self._freq_pens[slot] = so.frequency_penalty or 0.0
         self._pres_pens[slot] = so.presence_penalty or 0.0
         self._rep_pens[slot] = so.repetition_penalty or 1.0
-        self._logprob_ks[slot] = min(so.logprobs or 0, 20)
+        self._logprob_ks[slot] = (
+            min(so.logprobs, 20) if so.logprobs is not None else -1
+        )
         if self._slot_has_penalty(slot):
             self._reset_penalty_slot(slot, seq)
 
@@ -772,7 +775,7 @@ class JaxEngine(AsyncEngine):
 
     def _logprobs_active(self) -> bool:
         return any(
-            self._logprob_ks[i] > 0
+            self._logprob_ks[i] >= 0
             for i, s in enumerate(self._active) if s is not None
         )
 
@@ -1196,7 +1199,7 @@ class JaxEngine(AsyncEngine):
                     continue
                 entry = None
                 k = int(self._logprob_ks[i])
-                if lps is not None and k > 0:
+                if lps is not None and k >= 0:
                     chosen, top_ids, top_lps = lps
                     entry = {
                         "logprob": float(chosen[step_i, i]),

@@ -78,13 +78,29 @@ def _common_sampling(d: dict) -> SamplingOptions:
         presence_penalty=d.get("presence_penalty"),
         repetition_penalty=d.get("repetition_penalty"),
         seed=d.get("seed"),
-        n=int(d.get("n") or 1),
-        # chat schema: logprobs (bool) + top_logprobs (int). logprobs:true
-        # alone still returns each chosen token's logprob (k=1 top).
+        n=_int_field(d, "n", 1, lo=1, hi=16),
+        # chat schema: logprobs (bool) + top_logprobs (int, 0..20).
+        # logprobs:true alone returns each chosen token's logprob with no
+        # alternates (top_logprobs defaults to 0, per the OpenAI schema).
         logprobs=(
-            int(d.get("top_logprobs") or 1) if d.get("logprobs") else None
+            _int_field(d, "top_logprobs", 0, lo=0, hi=20)
+            if d.get("logprobs") else None
         ),
     )
+
+
+def _int_field(d: dict, key: str, default: int, lo: int, hi: int) -> int:
+    """Validated int request field -> 400 on junk, not a 500."""
+    v = d.get(key)
+    if v is None:
+        return default
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        raise RequestError(f"{key} must be an integer") from None
+    if not lo <= v <= hi:
+        raise RequestError(f"{key} must be between {lo} and {hi}")
+    return v
 
 
 def _common_stops(d: dict, nvext: NvExt) -> StopConditions:
@@ -181,8 +197,9 @@ class CompletionRequest:
         nvext = NvExt.from_dict(d.get("nvext"))
         sampling = _common_sampling(d)
         # legacy completions schema: logprobs is the top-k count itself
+        # (0 = chosen-token logprobs with no alternates)
         if d.get("logprobs") is not None:
-            sampling.logprobs = int(d["logprobs"]) or None
+            sampling.logprobs = _int_field(d, "logprobs", 0, lo=0, hi=20)
         return CompletionRequest(
             model=d["model"],
             prompt=d["prompt"],

@@ -294,3 +294,28 @@ def test_n_parallel_completions(run):
         assert full["usage"]["prompt_tokens"] > 0
 
     run(main())
+
+
+def test_logprob_request_validation():
+    """Malformed logprob params must 400 (RequestError), and top_logprobs=0
+    means chosen-token logprobs with no alternates."""
+    with pytest.raises(RequestError):
+        CompletionRequest.from_dict(
+            {"model": "m", "prompt": "x", "logprobs": "two"}
+        )
+    with pytest.raises(RequestError):
+        ChatCompletionRequest.from_dict({
+            "model": "m", "messages": [{"role": "user", "content": "x"}],
+            "logprobs": True, "top_logprobs": 99,
+        })
+    req = ChatCompletionRequest.from_dict({
+        "model": "m", "messages": [{"role": "user", "content": "x"}],
+        "logprobs": True, "top_logprobs": 0,
+    })
+    assert req.sampling.logprobs == 0  # on, no alternates
+    req2 = CompletionRequest.from_dict(
+        {"model": "m", "prompt": "x", "logprobs": 0}
+    )
+    assert req2.sampling.logprobs == 0
+    req3 = CompletionRequest.from_dict({"model": "m", "prompt": "x"})
+    assert req3.sampling.logprobs is None
