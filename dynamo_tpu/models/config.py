@@ -38,10 +38,12 @@ class ModelConfig:
     first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
     # sliding-window attention (mistral v0.1-style; 0 = full attention).
-    # Enforced by masking in the XLA attention paths; the Pallas kernels
-    # don't take windowed shapes yet, so the engine gates use_pallas off
-    # when a window is set (correct, slower — kernel support is the
-    # follow-up)
+    # Enforced by masking in the XLA paths and by a window floor in the
+    # in-repo Pallas kernels (exact for decode/merged at T=1 and for
+    # prefill rows; the jax library decode kernel has no window support
+    # and is skipped when a window is set). Speculative decoding stays
+    # gated off for windowed models: the verify kernel's uniform floor
+    # under-masks T>1 rows (ops/attention.py verify_attention).
     sliding_window: int = 0
     # gemma-family variants
     hidden_act: str = "silu"  # "silu" | "gelu_tanh" (gemma GeGLU)

@@ -504,12 +504,14 @@ def _decode_body(
             if mesh is None:
                 o = att.decode_attention_merged(
                     q, k, v, k_cache[l], v_cache[l], block_tables,
-                    hist_lens, scale, interpret=interpret,
+                    hist_lens, scale, window=cfg.sliding_window,
+                    interpret=interpret,
                 )
             else:
                 o = att.decode_attention_merged_sharded(
                     q, k, v, k_cache[l], v_cache[l], block_tables,
-                    hist_lens, scale, mesh, interpret=interpret,
+                    hist_lens, scale, mesh, window=cfg.sliding_window,
+                    interpret=interpret,
                 )
             x = layer_tail(x, lp, o)
         k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)

@@ -52,8 +52,10 @@ def decode_attention(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
-    ``window`` (sliding attention) is honored by the XLA path only —
-    callers gate use_pallas off when a window is set.
+    ``window`` (sliding attention) is honored by every path: the XLA
+    fallback masks, the in-repo Mosaic kernel takes a window floor, and
+    the library kernel (which has no window support) is skipped whenever
+    a window is set.
 
     ``use_pallas`` must be trace-static. With a ``mesh``, the kernel runs
     under shard_map: each device gets its tp shard of the kv heads (cache
@@ -480,8 +482,9 @@ def chunk_attention_with_cache(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
-    ``window`` (sliding attention) is honored by the XLA path only —
-    callers gate use_pallas off when a window is set.
+    ``window`` (sliding attention) is honored by both paths (the Pallas
+    prefill kernel masks per query row — exact, unlike the decode
+    kernel's uniform floor which is exact only at T=1).
 
     The Pallas path requires the chunk's K/V to be ALREADY scattered into
     the cache (write-before-attend — llama.prefill's layer body does this),
@@ -493,7 +496,7 @@ def chunk_attention_with_cache(
     if use_pallas and mesh is not None:
         return paged_prefill_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
-            mesh, interpret=interpret,
+            mesh, window=window, interpret=interpret,
         )
     if use_pallas:
         from .paged_attention_pallas import paged_prefill_attention
@@ -516,6 +519,7 @@ def paged_prefill_attention_sharded(
     history_len: jnp.ndarray,  # scalar replicated
     scale: float,
     mesh,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas prefill kernel under shard_map over tp (see _shard_headwise)."""
@@ -524,7 +528,8 @@ def paged_prefill_attention_sharded(
     from .paged_attention_pallas import paged_prefill_attention
 
     return _shard_headwise(
-        partial(paged_prefill_attention, scale=scale, interpret=interpret),
+        partial(paged_prefill_attention, scale=scale, window=window,
+                interpret=interpret),
         mesh, q, k_cache_layer, v_cache_layer, block_table, history_len,
     )
 

@@ -1,22 +1,52 @@
 #!/bin/bash
-# Poll for TPU relay recovery; on success run the queued on-chip work.
-# Outputs land in /tmp/tpu_results/.
+# Poll for TPU relay recovery; on success run the queued on-chip work and
+# WRITE ARTIFACTS INTO THE REPO immediately (VERDICT r2: a relay death must
+# never leave the round's perf claim unrecorded).
+#
+# Outputs:
+#   /tmp/tpu_results/*.log        — raw logs
+#   /root/repo/BENCH_partial.json — last good bench JSON line (commit asap)
+#   /root/repo/docs/perf_log.md   — appended dated entry per artifact
 mkdir -p /tmp/tpu_results
 cd /root/repo
-for i in $(seq 1 200); do
+
+log_entry() {  # $1 = title, $2 = file with content
+  {
+    echo ""
+    echo "## $1 — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    echo ""
+    echo '```'
+    tail -c 4000 "$2"
+    echo '```'
+  } >> /root/repo/docs/perf_log.md
+}
+
+for i in $(seq 1 300); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "TPU BACK at $(date)" | tee /tmp/tpu_results/status
-    timeout 900 python scripts/validate_tpu_kernels.py \
+    timeout 1200 python scripts/validate_tpu_kernels.py \
         > /tmp/tpu_results/validate.log 2>&1
     echo "validate rc=$?" >> /tmp/tpu_results/status
-    timeout 1500 python scripts/decompose_window.py \
+    log_entry "validate_tpu_kernels" /tmp/tpu_results/validate.log
+
+    timeout 1800 python scripts/decompose_window.py \
         > /tmp/tpu_results/decompose.log 2>&1
     echo "decompose rc=$?" >> /tmp/tpu_results/status
-    timeout 900 python bench.py > /tmp/tpu_results/bench.log 2>&1
-    echo "bench rc=$?" >> /tmp/tpu_results/status
+    log_entry "decompose_window" /tmp/tpu_results/decompose.log
+
+    timeout 1200 python bench.py > /tmp/tpu_results/bench.log 2>&1
+    rc=$?
+    echo "bench rc=$rc" >> /tmp/tpu_results/status
+    log_entry "bench.py" /tmp/tpu_results/bench.log
+    # Persist the JSON line as a repo artifact for the driver/judge.
+    # Never truncate a previously captured good result with an empty one.
+    line=$(grep -E '^\{.*"metric"' /tmp/tpu_results/bench.log | tail -1)
+    [ -n "$line" ] && printf '%s\n' "$line" > /root/repo/BENCH_partial.json
+
     echo "ALL DONE $(date)" >> /tmp/tpu_results/status
     exit 0
   fi
+  echo "probe $i failed $(date)" >> /tmp/tpu_results/status
   sleep 120
 done
-echo "TPU never recovered" > /tmp/tpu_results/status
+echo "TPU never recovered" >> /tmp/tpu_results/status

@@ -119,6 +119,44 @@ def test_decode_body_merged_path_matches_regular():
     )
 
 
+def test_decode_body_merged_honors_sliding_window():
+    """Regression (advisor r2 high): a sliding-window model on the merged
+    decode path must mask history beyond the window — the merged calls in
+    llama._decode_body previously dropped cfg.sliding_window, silently
+    attending the full history once context exceeded the window."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny(dtype="float32", sliding_window=3)
+    params = llama.init_params(cfg, jax.random.key(0))
+    B, bs, M = 2, 4, 8
+    kc0, vc0 = llama.init_kv_cache(cfg, num_blocks=2 * M + 1, block_size=bs)
+    tables = jnp.asarray(
+        np.arange(1, 2 * M + 1, dtype=np.int32).reshape(B, M)
+    )
+
+    state = {}
+    for tag, use_pallas in (("reg", False), ("merged", True)):
+        kc, vc = jnp.copy(kc0), jnp.copy(vc0)
+        toks = jnp.asarray([3, 9], jnp.int32)
+        logits_all = []
+        # run well past the window so masking actually matters
+        for step in range(8):
+            positions = jnp.asarray([step, step + 2], jnp.int32)
+            seq_lens = positions + 1
+            logits, kc, vc = llama.decode_step(
+                params, cfg, toks, positions, tables, seq_lens, kc, vc,
+                use_pallas=use_pallas, interpret=use_pallas,
+            )
+            logits_all.append(np.asarray(logits))
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        state[tag] = np.stack(logits_all)
+
+    np.testing.assert_allclose(
+        state["merged"], state["reg"], rtol=2e-4, atol=2e-4
+    )
+
+
 def test_merged_sharded_tp2_matches_single_device():
     """decode_attention_merged_sharded + kv_cache_append_sharded over a
     tp=2 CPU mesh must match the single-device merged path (this is the
