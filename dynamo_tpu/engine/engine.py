@@ -901,9 +901,17 @@ class JaxEngine(AsyncEngine):
                         # window from fresh lengths, and re-evaluate
                         # before declaring a context-limit finish, or the
                         # in-flight tokens would be discarded and the
-                        # stream truncated up to a window early
+                        # stream truncated up to a window early.
+                        # min(): CLAMP to the previously validated n.
+                        # Sequences already provisioned earlier in this
+                        # pass hold seq_len_old + pending + n <= allocated;
+                        # the drain turns that into seq_len_new + n' <=
+                        # allocated only for n' <= n — a larger re-pick
+                        # (the drain can finish a headroom-constraining
+                        # sequence) would write past their blocks through
+                        # zero table entries into reserved page 0
                         await self._drain_inflight()
-                        pending, n = 0, self._pick_window()
+                        pending, n = 0, min(n, self._pick_window())
                         continue
                     self._finish(seq, FinishReason.LENGTH)  # true ctx limit
                     break
@@ -917,9 +925,10 @@ class JaxEngine(AsyncEngine):
                     # speculative pending-window blocks are the first thing
                     # to give back under pressure. Draining emits the
                     # window (advancing seq_len by `pending`) and frees the
-                    # speculation headroom requirement.
+                    # speculation headroom requirement. min(): same
+                    # already-validated-sequences clamp as above.
                     await self._drain_inflight()
-                    pending, n = 0, self._pick_window()
+                    pending, n = 0, min(n, self._pick_window())
                     continue
                 # pool exhausted: preempt the youngest running sequence
                 # (possibly this one) instead of truncating output
@@ -1017,7 +1026,11 @@ class JaxEngine(AsyncEngine):
             pending = 0
             if self._n_active == 0:
                 return
-            n = self._pick_window()
+            # min(): the provisioning pass above validated blocks for at
+            # most n tokens per sequence; a fresh pick may shrink (e.g.
+            # admission became actionable after a preemption) but must
+            # never grow past what was provisioned
+            n = min(n, self._pick_window())
         prev = self._inflight
         # chain token inputs on device when a window is in flight;
         # otherwise feed the host-mirrored last tokens
@@ -1235,6 +1248,20 @@ class JaxEngine(AsyncEngine):
             raise RuntimeError(
                 "pending window without a chained token source"
             )
+        # Provisioning invariant (loud, not silent): every active sequence
+        # must have blocks covering this window's writes. A violation
+        # would scatter through zero block-table entries into reserved
+        # page 0 — garbage K/V that later reads silently consume.
+        for seq in self._active:
+            if seq is None or seq.finished or seq.slot < 0:
+                continue
+            if seq.seq_len + pending + n > len(seq.blocks) * cfg.block_size:
+                raise RuntimeError(
+                    f"window n={n} pending={pending} exceeds provisioned "
+                    f"blocks for request "
+                    f"{getattr(seq.context, 'id', '?')} "
+                    f"(seq_len={seq.seq_len}, blocks={len(seq.blocks)})"
+                )
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
         positions = (

@@ -42,9 +42,14 @@ for i in $(seq 1 300); do
     # Never truncate a previously captured good result with an empty one.
     line=$(grep -E '^\{.*"metric"' /tmp/tpu_results/bench.log | tail -1)
     [ -n "$line" ] && printf '%s\n' "$line" > /root/repo/BENCH_partial.json
-
-    echo "ALL DONE $(date)" >> /tmp/tpu_results/status
-    exit 0
+    # A real (non-CPU-fallback) number ends the watch; a wedge mid-work
+    # (rc!=0 or only a cpu_smoke line) re-enters the probe loop — the
+    # relay dying DURING the queued work is the script's raison d'etre.
+    if [ "$rc" = 0 ] && [ -n "$line" ] && ! printf '%s' "$line" | grep -q cpu_smoke; then
+      echo "ALL DONE $(date)" >> /tmp/tpu_results/status
+      exit 0
+    fi
+    echo "on-chip work incomplete (rc=$rc); resuming probe loop" >> /tmp/tpu_results/status
   fi
   echo "probe $i failed $(date)" >> /tmp/tpu_results/status
   sleep 120

@@ -529,6 +529,57 @@ def test_pipelined_context_limit_not_truncated_early(run):
     run(main())
 
 
+def test_pipelined_repick_never_grows_window(run):
+    """Regression (advisor r2 medium): when a mid-provisioning drain
+    re-picks the fused window, the new n must be CLAMPED to the value the
+    earlier-validated sequences were provisioned for — a drain that
+    finishes a headroom-constraining sequence could otherwise return a
+    larger n and write past their allocated blocks (silent corruption via
+    reserved page 0). Mixed max_tokens make one sequence finish mid-flight
+    (the headroom constrainer); tight pools force the drain path. Streams
+    must match the unpipelined engine bit-for-bit whenever neither run
+    preempted."""
+
+    async def main():
+        for num_blocks in (18, 20, 24, 64):
+            outs, preempts = {}, {}
+            for pipe in (False, True):
+                cfg = EngineConfig(
+                    model=ModelConfig.tiny(), num_blocks=num_blocks,
+                    block_size=4, max_batch_size=4, max_context=64,
+                    prefill_chunk=32, decode_window=8, decode_pipeline=pipe,
+                )
+                engine = JaxEngine(cfg, seed=0)
+                reqs = [
+                    make_req(range(10, 18), max_tokens=5),   # constrainer
+                    make_req(range(30, 42), max_tokens=30),
+                    make_req(range(50, 60), max_tokens=26),
+                ]
+                results = await asyncio.gather(
+                    *[collect(engine.generate(Context(r))) for r in reqs]
+                )
+                outs[pipe] = [
+                    [t for o in out for t in o.token_ids] for out in results
+                ]
+                preempts[pipe] = engine.stats["preemptions"]
+                assert engine._n_active == 0 and engine._inflight is None
+                await engine.close()
+            for i, (a, b) in enumerate(zip(outs[False], outs[True])):
+                assert len(b) == len(a), (
+                    f"blocks={num_blocks} req {i}: pipelined len {len(b)} "
+                    f"!= unpipelined {len(a)}"
+                )
+            if preempts[False] == preempts[True] == 0:
+                assert outs[True] == outs[False], f"blocks={num_blocks}"
+            # pipelining must not preempt when the unpipelined engine
+            # didn't (the speculative window requirement is shed by the
+            # drain, never by eviction)
+            if preempts[False] == 0:
+                assert preempts[True] == 0, f"blocks={num_blocks}"
+
+    run(main())
+
+
 # ---------------- sampling penalties ----------------
 
 
