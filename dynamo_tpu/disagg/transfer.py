@@ -73,6 +73,10 @@ class KvDelivery:
     # (ops/kv_rearrange.py; ref vllm patch:743-810 kv_rearrange)
     head_layout: str = "blocked"
     src_tp: int = 1
+    # first token's logprob entry ({"logprob": f, "top": [[id, lp], ...]})
+    # when the request asked for logprobs — computed where the logits are
+    # (the prefill worker) and carried with the KV
+    first_lp: Optional[dict] = None
 
 
 class KvTransferServer:
@@ -181,6 +185,7 @@ class KvTransferServer:
                         req_id, head["first_token"], n, k, v,
                         head_layout=head.get("head_layout", "blocked"),
                         src_tp=head.get("src_tp", 1),
+                        first_lp=head.get("first_lp"),
                     )
                 )
         except Exception:  # noqa: BLE001 — receive failed mid-stream: no
@@ -202,6 +207,7 @@ async def send_kv_blocks(
     error: Optional[str] = None,
     head_layout: str = "blocked",
     src_tp: int = 1,
+    first_lp: Optional[dict] = None,
 ) -> None:
     """Prefill-side push of one request's KV (or an error notification)."""
     if isinstance(connection, dict):
@@ -223,6 +229,7 @@ async def send_kv_blocks(
             "error": error,
             "head_layout": head_layout,
             "src_tp": src_tp,
+            "first_lp": first_lp,
         }
         await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
         if n:
@@ -275,6 +282,7 @@ class LocalKvPipe:
         error: Optional[str] = None,
         head_layout: str = "blocked",
         src_tp: int = 1,
+        first_lp: Optional[dict] = None,
     ) -> None:
         fut = self._pending.pop(request_id, None)
         if fut is None or fut.done():
@@ -283,6 +291,6 @@ class LocalKvPipe:
         fut.set_result(
             KvDelivery(
                 request_id, first_token, n, k_data, v_data, error,
-                head_layout=head_layout, src_tp=src_tp,
+                head_layout=head_layout, src_tp=src_tp, first_lp=first_lp,
             )
         )

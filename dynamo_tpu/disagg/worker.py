@@ -111,7 +111,7 @@ class PrefillWorker:
         # end (gather -> pipe -> decode scatter, no host hop); the TCP
         # path needs host bytes anyway
         local = bool(rpr.connection.get("local")) and self.local_pipe is not None
-        first, k, v = await self.engine.prefill_extract(
+        first, first_lp, k, v = await self.engine.prefill_extract(
             req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local
         )
         self.stats["prefills_total"] += 1
@@ -120,12 +120,14 @@ class PrefillWorker:
         if rpr.connection.get("local"):
             assert self.local_pipe is not None, "local connection without pipe"
             await self.local_pipe.deliver(
-                rpr.request_id, first, k, v, head_layout=layout, src_tp=tp
+                rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
+                first_lp=first_lp,
             )
         else:
             await send_kv_blocks(
                 rpr.connection, rpr.request_id, first, k, v,
                 layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
+                first_lp=first_lp,
             )
 
     async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
@@ -255,7 +257,8 @@ class DisaggEngine(AsyncEngine):
                 yield await handle.seq.out_queue.get()
                 return
         out_queue = await self.engine.complete_remote(
-            handle, delivery.first_token, k_data, v_data
+            handle, delivery.first_token, k_data, v_data,
+            first_lp=delivery.first_lp,
         )
         while True:
             out = await out_queue.get()

@@ -640,17 +640,20 @@ class JaxEngine(AsyncEngine):
         history: int,
         restore_data: Optional[list] = None,
         restore_idxs: Optional[list[int]] = None,
-    ) -> int:
+    ) -> tuple[int, Optional[dict]]:
         """Runs in an executor thread: whole-prompt chunked prefill +
         first-token sample (the disagg prefill-worker path, which owns the
         device for the whole prompt — the serving loop uses the chunk-at-a-
-        time _prefill_chunk_device instead)."""
+        time _prefill_chunk_device instead). Returns (token, logprob
+        entry or None) — the entry rides the KV transfer so a logprobs
+        request served via remote prefill doesn't lose its first token's
+        logprobs (advisor r2)."""
         self._offload_preamble(restore_data, restore_idxs)
         logits = None
         pos = history
         while pos < len(seq.tokens):
             logits, pos = self._run_one_chunk(seq, pos)
-        return self._sample_prefill(seq, logits)[0]
+        return self._sample_prefill(seq, logits)
 
     def _table_for(self, seq: _Sequence) -> np.ndarray:
         t = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
@@ -1433,7 +1436,7 @@ class JaxEngine(AsyncEngine):
     async def prefill_extract(
         self, req: PreprocessedRequest, context, skip_blocks: int = 0,
         keep_on_device: bool = False,
-    ) -> tuple[int, Optional[np.ndarray], Optional[np.ndarray]]:
+    ) -> tuple[int, Optional[dict], Optional[np.ndarray], Optional[np.ndarray]]:
         """Prefill-worker side: compute the prompt's KV (with this worker's
         own prefix cache), sample the first token (max_tokens=1 semantics,
         ref prefill_worker.py:109-137), and return the prompt's KV blocks
@@ -1467,8 +1470,10 @@ class JaxEngine(AsyncEngine):
         self.stats["prefix_cache_hits_tokens"] += history
         try:
             async with self._device_lock:
-                first_token = await asyncio.get_running_loop().run_in_executor(
-                    None, self._prefill_device, seq, history
+                first_token, first_lp = await (
+                    asyncio.get_running_loop().run_in_executor(
+                        None, self._prefill_device, seq, history
+                    )
                 )
                 n_prompt = self.n_prompt_blocks(len(prompt))
                 idxs = [b.idx for b in seq.blocks[skip_blocks:n_prompt]]
@@ -1482,7 +1487,7 @@ class JaxEngine(AsyncEngine):
         finally:
             self.allocator.free(seq.blocks)
             seq.blocks = []
-        return first_token, k_np, v_np
+        return first_token, first_lp, k_np, v_np
 
     def _gather_device(self, idxs: list[int], keep_on_device: bool = False):
         from .offload import _gather_blocks, _pad_idxs
@@ -1540,10 +1545,12 @@ class JaxEngine(AsyncEngine):
         first_token: int,
         k_data: Optional[np.ndarray],
         v_data: Optional[np.ndarray],
+        first_lp: Optional[dict] = None,
     ) -> asyncio.Queue:
         """KV landed from the prefill worker: scatter it into the
         pre-allocated pages, register the sequence for continuous-batching
-        decode, emit the (already sampled) first token."""
+        decode, emit the (already sampled) first token with the logprob
+        entry the prefill worker computed for it (if requested)."""
         seq = handle.seq
         if k_data is not None and k_data.shape[2]:
             n = int(k_data.shape[2])
@@ -1556,7 +1563,7 @@ class JaxEngine(AsyncEngine):
                     None, self._scatter_device, idxs, k_data, v_data
                 )
         self.stats["prefix_cache_hits_tokens"] += seq.cached_prefix
-        self._emit_token(seq, first_token)
+        self._emit_token(seq, first_token, first_lp)
         if not seq.finished:
             self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
             self._remote_ready.append(seq)

@@ -89,15 +89,24 @@ class Backend(Operator):
                 else LLMEngineOutput.from_dict(item.data)
             )
             text_parts = []
+            pieces = []  # per-token INCREMENTAL text (may be "")
             for tid in out.token_ids:
                 piece = decoder.step(tid)
+                pieces.append(piece or "")
                 if piece is not None:
                     text_parts.append(piece)
             if out.logprobs:
                 # enrich id-level entries with token text (the engine
-                # emits ids + floats; OpenAI responses carry strings)
-                for tid, entry in zip(out.token_ids, out.logprobs):
-                    entry["token"] = self._tokenizer.decode([tid])
+                # emits ids + floats; OpenAI responses carry strings).
+                # The chosen token's text is the INCREMENTAL decode piece
+                # — isolated decode of a byte-level BPE piece yields
+                # U+FFFD and would drift text_offset off the streamed
+                # text; an incomplete multibyte prefix contributes ""
+                # and the completing token carries the full char.
+                for tid, piece, entry in zip(
+                    out.token_ids, pieces, out.logprobs
+                ):
+                    entry["token"] = piece
                     entry["top"] = [
                         {
                             "token": self._tokenizer.decode([i]),

@@ -233,6 +233,9 @@ class DeltaGenerator:
         self.with_usage = with_usage
         self.prompt_tokens = prompt_tokens
         self.completion_tokens = 0
+        # running character offset of emitted logprob tokens in the
+        # generated text (legacy completions text_offset field)
+        self._lp_text_offset = 0
 
     def chunks(self, out: LLMEngineOutput, include_role: bool = False) -> list[dict]:
         self.completion_tokens += len(out.token_ids)
@@ -271,12 +274,20 @@ class DeltaGenerator:
                 )
         else:
             if text or finish is not None or lps:
+                lp_block = None
+                if lps:
+                    lp_block = completion_logprobs_block(
+                        lps, start_offset=self._lp_text_offset
+                    )
+                    self._lp_text_offset += sum(
+                        len(e.get("token", "")) for e in lps
+                    )
                 result.append(
                     completion_chunk(
                         self.id, self.req.model, text,
                         finish_reason=finish, usage=usage,
                         index=self.index,
-                        logprobs=completion_logprobs_block(lps) if lps else None,
+                        logprobs=lp_block,
                     )
                 )
         return result

@@ -110,6 +110,9 @@ def aggregate_completion_chunks(chunks: Iterable[dict]) -> dict:
                 acc["logprobs"]["tokens"].extend(lp["tokens"])
                 acc["logprobs"]["token_logprobs"].extend(lp["token_logprobs"])
                 acc["logprobs"]["top_logprobs"].extend(lp["top_logprobs"])
+                acc["logprobs"]["text_offset"].extend(
+                    lp.get("text_offset", [])
+                )
     out = {
         "id": base.get("id"),
         "object": "text_completion",

@@ -281,16 +281,33 @@ def chat_logprobs_block(entries: list) -> dict:
     }
 
 
-def completion_logprobs_block(entries: list) -> dict:
-    """Legacy completions logprobs schema."""
+def completion_logprobs_block(entries: list, start_offset: int = 0) -> dict:
+    """Legacy completions logprobs schema.
+
+    Distinct token ids can decode to the same string (BPE byte /
+    whitespace pieces); the dict comprehension would silently drop all
+    but the last, so collisions keep the MAX logprob (the alternative a
+    client ranking by probability would want). ``text_offset`` is the
+    character offset of each token in the generated text, starting at
+    ``start_offset`` (the caller's running offset across stream chunks).
+    """
+    tops = []
+    for e in entries:
+        d: dict = {}
+        for t in e.get("top", []):
+            k = t["token"]
+            if k not in d or t["logprob"] > d[k]:
+                d[k] = t["logprob"]
+        tops.append(d)
+    offsets, off = [], start_offset
+    for e in entries:
+        offsets.append(off)
+        off += len(e.get("token", ""))
     return {
         "tokens": [e.get("token", "") for e in entries],
         "token_logprobs": [e.get("logprob") for e in entries],
-        "top_logprobs": [
-            {t["token"]: t["logprob"] for t in e.get("top", [])}
-            for e in entries
-        ],
-        "text_offset": [],
+        "top_logprobs": tops,
+        "text_offset": offsets,
     }
 
 

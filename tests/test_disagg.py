@@ -232,6 +232,68 @@ def test_disagg_end_to_end_matches_aggregated(run, mode):
     run(main())
 
 
+def test_disagg_first_token_carries_logprobs(run):
+    """Regression (advisor r2 low): a logprobs request served via remote
+    prefill must emit a logprob entry for the FIRST generated token too —
+    the entry is computed on the prefill worker (where the logits are)
+    and rides the KV transfer. Entries must match the aggregated run."""
+
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        router = ConditionalDisaggRouter(
+            drt, "dynamo", "tiny", DisaggConfig(max_local_prefill_length=8)
+        )
+        await router.start()
+        queue = PrefillQueue(drt.bus)
+        decode, prefill = _disagg_stack()
+        transfer = KvTransferServer()
+        await transfer.start()
+        worker = PrefillWorker(prefill, queue, layer_chunk=1)
+        worker.start()
+        eng = DisaggEngine(decode, router, queue, transfer)
+
+        def lp_req(max_tokens=5):
+            return PreprocessedRequest(
+                token_ids=list(range(10, 34)),  # 24 >> max_local 8 -> remote
+                stop_conditions=StopConditions(max_tokens=max_tokens),
+                sampling_options=SamplingOptions(
+                    temperature=0.0, seed=0, logprobs=3
+                ),
+                eos_token_ids=[511],
+            )
+
+        outs = await collect(eng.generate(Context(lp_req())))
+        assert eng.stats["remote_prefills"] == 1
+        toks = [t for o in outs for t in o.token_ids]
+        entries = [e for o in outs for e in (o.logprobs or [])]
+        # one entry per emitted token, INCLUDING the prefill-sampled first
+        assert len(entries) == len(toks), (len(entries), len(toks))
+        assert all(len(e["top"]) == 3 for e in entries)
+
+        ref_engine = JaxEngine(engine_cfg(), params=PARAMS)
+        ref = await collect(ref_engine.generate(Context(lp_req())))
+        ref_entries = [e for o in ref for e in (o.logprobs or [])]
+        assert len(ref_entries) == len(entries)
+        np.testing.assert_allclose(
+            [e["logprob"] for e in entries],
+            [e["logprob"] for e in ref_entries],
+            rtol=1e-4, atol=1e-4,
+        )
+        assert [[t[0] for t in e["top"]] for e in entries] == [
+            [t[0] for t in e["top"]] for e in ref_entries
+        ]
+
+        await worker.close()
+        await transfer.close()
+        await decode.close()
+        await prefill.close()
+        await ref_engine.close()
+        await router.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
 def test_disagg_local_pipe_stays_on_device(run):
     """VERDICT round-1 missing #3: the in-process pipe must hand over
     device-resident jax.Arrays — no numpy hop, so same-slice disagg never

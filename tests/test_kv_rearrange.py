@@ -109,13 +109,15 @@ def test_disagg_delivery_applies_regroup(run):
         orig_extract = prefill_engine.prefill_extract
 
         async def interleaved_extract(req, ctx, skip_blocks=0, **kw):
-            first, k, v = await orig_extract(req, ctx, skip_blocks, **kw)
+            first, first_lp, k, v = await orig_extract(
+                req, ctx, skip_blocks, **kw
+            )
             if k is not None:
                 k = regroup_heads(k, tp=2, src_layout="blocked",
                                   dst_layout="interleaved")
                 v = regroup_heads(v, tp=2, src_layout="blocked",
                                   dst_layout="interleaved")
-            return first, k, v
+            return first, first_lp, k, v
 
         prefill_engine.prefill_extract = interleaved_extract
 

@@ -319,3 +319,26 @@ def test_logprob_request_validation():
     assert req2.sampling.logprobs == 0
     req3 = CompletionRequest.from_dict({"model": "m", "prompt": "x"})
     assert req3.sampling.logprobs is None
+
+
+def test_completion_logprobs_block_dedup_and_offsets():
+    """Regression (advisor r2 low): top_logprobs entries whose token ids
+    decode to the same string must keep the MAX logprob (not silently
+    drop one), and text_offset must be populated alongside tokens."""
+    from dynamo_tpu.protocols.openai import completion_logprobs_block
+
+    entries = [
+        {"token": "he", "logprob": -0.1,
+         "top": [{"token": "he", "logprob": -0.1},
+                 {"token": " ", "logprob": -2.0},
+                 {"token": " ", "logprob": -1.5}]},  # byte-piece collision
+        {"token": "llo", "logprob": -0.2,
+         "top": [{"token": "llo", "logprob": -0.2}]},
+    ]
+    block = completion_logprobs_block(entries, start_offset=4)
+    assert block["tokens"] == ["he", "llo"]
+    assert block["token_logprobs"] == [-0.1, -0.2]
+    # collision kept the higher (max) logprob
+    assert block["top_logprobs"][0] == {"he": -0.1, " ": -1.5}
+    # offsets: start at the caller's running offset, advance by token text
+    assert block["text_offset"] == [4, 6]
