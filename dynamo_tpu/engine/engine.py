@@ -197,8 +197,6 @@ class JaxEngine(AsyncEngine):
         # broadcast to follower ranks which replay the identical jit call
         self.mirror = mirror
         if mirror is not None:
-            if cfg.host_cache_blocks > 0:
-                raise ValueError("host offload tier is single-host only")
             self.mesh = mirror.mesh
         else:
             self.mesh = make_mesh(cfg.mesh) if cfg.mesh else None
@@ -228,7 +226,9 @@ class JaxEngine(AsyncEngine):
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
         self.offload: Optional[OffloadManager] = None
         if cfg.host_cache_blocks > 0:
-            self.offload = OffloadManager(cfg.host_cache_blocks)
+            # under the multi-host mirror, flush/restore become mirrored
+            # ops and every process parks its own cache shards in host DRAM
+            self.offload = OffloadManager(cfg.host_cache_blocks, mirror=mirror)
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
         # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
         # run the kernel under shard_map over tp (head-parallel, no
@@ -551,7 +551,7 @@ class JaxEngine(AsyncEngine):
             # are never mutated, so re-pooling is safe) — same as the
             # error path below; dropping them would leak the cached prefix
             if self.offload is not None and st.restore_hashes:
-                self.offload.unreserve(st.restore_hashes, st.restore_data)
+                self.offload.unreserve(st.restore_hashes, st.restore_data, restored=st.restored)
             seq.out_queue.put_nowait(
                 LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
             )
@@ -572,7 +572,7 @@ class JaxEngine(AsyncEngine):
             self.allocator.free(seq.blocks)
             seq.blocks = []
             if self.offload is not None and st.restore_hashes:
-                self.offload.unreserve(st.restore_hashes, st.restore_data)
+                self.offload.unreserve(st.restore_hashes, st.restore_data, restored=st.restored)
             seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.ERROR))
             return False
         if first_token is None:
@@ -588,14 +588,18 @@ class JaxEngine(AsyncEngine):
     def _prefill_chunk_device(self, st: _PrefillState) -> Optional[int]:
         """Runs in an executor thread: one bucketed prefill chunk. Returns
         the sampled first token on the final chunk, else None."""
-        self._offload_preamble(st.restore_data if not st.restored else None, st.restore_idxs)
+        self._offload_preamble(
+            st.restore_data if not st.restored else None, st.restore_idxs,
+            st.restore_hashes,
+        )
         st.restored = True
         logits, st.pos = self._run_one_chunk(st.seq, st.pos)
         if st.pos < len(st.seq.tokens):
             return None
         return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
 
-    def _offload_preamble(self, restore_data, restore_idxs) -> None:
+    def _offload_preamble(self, restore_data, restore_idxs,
+                          restore_hashes=None) -> None:
         """d2h evicted blocks before their pages get overwritten, then land
         any host-tier prefix restore."""
         if self.offload is None:
@@ -603,7 +607,8 @@ class JaxEngine(AsyncEngine):
         self.offload.flush_evictions(self.k_cache, self.v_cache)
         if restore_data:
             self.k_cache, self.v_cache = self.offload.restore(
-                self.k_cache, self.v_cache, restore_data, restore_idxs
+                self.k_cache, self.v_cache, restore_data, restore_idxs,
+                hashes=restore_hashes,
             )
 
     def _run_one_chunk(self, seq: _Sequence, pos: int):
@@ -640,6 +645,7 @@ class JaxEngine(AsyncEngine):
         history: int,
         restore_data: Optional[list] = None,
         restore_idxs: Optional[list[int]] = None,
+        restore_hashes: Optional[list[int]] = None,
     ) -> tuple[int, Optional[dict]]:
         """Runs in an executor thread: whole-prompt chunked prefill +
         first-token sample (the disagg prefill-worker path, which owns the
@@ -648,7 +654,7 @@ class JaxEngine(AsyncEngine):
         entry or None) — the entry rides the KV transfer so a logprobs
         request served via remote prefill doesn't lose its first token's
         logprobs (advisor r2)."""
-        self._offload_preamble(restore_data, restore_idxs)
+        self._offload_preamble(restore_data, restore_idxs, restore_hashes)
         logits = None
         pos = history
         while pos < len(seq.tokens):
@@ -1449,12 +1455,15 @@ class JaxEngine(AsyncEngine):
         copies: the in-process LocalKvPipe path hands them straight to the
         decode engine's scatter, so same-slice disagg never pays the
         d2h + h2d round-trip (VERDICT round-1 missing #3; the reference's
-        same-node NIXL path is GPU-direct for the same reason)."""
+        same-node NIXL path is GPU-direct for the same reason).
+
+        Under the multi-host mirror the gather is a mirrored op with
+        replicated output (compiled all-gather over ICI/DCN) and the
+        LEADER ships full host blocks over the transfer plane;
+        ``keep_on_device`` is ignored there (a multi-process array cannot
+        hand over in-process to a differently-meshed engine)."""
         if self.mirror is not None:
-            raise RuntimeError(
-                "disaggregated KV extract is single-host only: the host "
-                "gather would read a multi-process sharded cache"
-            )
+            keep_on_device = False
         prompt = list(req.token_ids)
         seq = _Sequence(
             request=req,
@@ -1493,6 +1502,11 @@ class JaxEngine(AsyncEngine):
         from .offload import _gather_blocks, _pad_idxs
 
         padded = _pad_idxs(idxs)
+        if self.mirror is not None:
+            k, v = self.mirror.lead_kv_gather_full(
+                self.k_cache, self.v_cache, padded
+            )
+            return k[:, :, : len(idxs)], v[:, :, : len(idxs)]
         k, v = _gather_blocks(self.k_cache, self.v_cache, jnp.asarray(padded))
         k, v = k[:, :, : len(idxs)], v[:, :, : len(idxs)]
         if keep_on_device:
@@ -1504,12 +1518,12 @@ class JaxEngine(AsyncEngine):
         prefix cache and pre-allocate the sequence's blocks (the reference
         allocates decode blocks up front and ships their ids in
         RemotePrefillRequest). Returns None when the pool can't cover the
-        request — caller falls back to local serving's backpressure."""
-        if self.mirror is not None:
-            raise RuntimeError(
-                "disaggregated decode is single-host only: remote-KV "
-                "scatter cannot write a multi-process sharded cache"
-            )
+        request — caller falls back to local serving's backpressure.
+
+        Composes with the multi-host mirror: the reservation is pure
+        host-side allocator work, and the eventual remote-KV landing
+        (complete_remote -> _scatter_device) broadcasts the blocks so
+        every process scatters its shards in lockstep."""
         req: PreprocessedRequest = request.data
         if isinstance(req, dict):
             req = PreprocessedRequest.from_dict(req)
@@ -1582,21 +1596,24 @@ class JaxEngine(AsyncEngine):
     def _scatter_device(
         self, idxs: list[int], k_data: np.ndarray, v_data: np.ndarray
     ) -> None:
-        from .offload import _bucket, _pad_idxs, _scatter_blocks
+        from .offload import _pad_idxs, _scatter_blocks
 
         if self.offload is not None:
             # pending evictions may reference the very pages we're about to
             # overwrite — snapshot them to the host tier first
             self.offload.flush_evictions(self.k_cache, self.v_cache)
-        n = len(idxs)
         padded = _pad_idxs(idxs)
-        if len(padded) != n:
-            # pad on device (jnp.pad): a host numpy input ships only the
-            # real blocks over PCIe; a device input never leaves HBM
-            pad = [(0, 0)] * k_data.ndim
-            pad[2] = (0, _bucket(n) - n)
-            k_data = jnp.pad(jnp.asarray(k_data), pad)
-            v_data = jnp.pad(jnp.asarray(v_data), pad)
+        if self.mirror is not None:
+            # mirrored landing: broadcast the UNPADDED host blocks (the
+            # scatter core pads on device), every process scatters its
+            # cache shards in lockstep
+            self.k_cache, self.v_cache = self.mirror.lead_kv_scatter(
+                self.k_cache, self.v_cache, padded,
+                np.asarray(k_data), np.asarray(v_data),
+            )
+            return
+        # only real blocks ship over PCIe — the scatter core pads the
+        # stack to the bucketed index count on device
         self.k_cache, self.v_cache = _scatter_blocks(
             self.k_cache,
             self.v_cache,

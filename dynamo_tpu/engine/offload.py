@@ -43,18 +43,48 @@ def _pad_idxs(idxs: list[int]) -> np.ndarray:
     return out
 
 
-@jax.jit
-def _gather_blocks(k_cache, v_cache, idxs):
-    """[L, Hkv, N, bs, D] x [n] -> two [L, Hkv, n, bs, D] stacks."""
+def gather_blocks_core(k_cache, v_cache, idxs):
+    """[L, Hkv, N, bs, D] x [n] -> two [L, Hkv, n, bs, D] stacks.
+    Unjitted core — StepMirror re-jits it with mesh out_shardings for the
+    mirrored multi-host paths."""
     return jnp.take(k_cache, idxs, axis=2), jnp.take(v_cache, idxs, axis=2)
 
 
-@partial(jax.jit, donate_argnames=("k_cache", "v_cache"))
-def _scatter_blocks(k_cache, v_cache, idxs, k_data, v_data):
+def scatter_blocks_core(k_cache, v_cache, idxs, k_data, v_data):
+    """Pads the data stack to the (bucketed) index count ON DEVICE — host
+    callers ship only real blocks over PCIe/DCN; pad rows target trash
+    block 0 and never leave HBM."""
+    n, m = idxs.shape[0], k_data.shape[2]
+    if m < n:  # static at trace time
+        pad = [(0, 0)] * k_data.ndim
+        pad[2] = (0, n - m)
+        k_data = jnp.pad(k_data, pad)
+        v_data = jnp.pad(v_data, pad)
     return (
-        k_cache.at[:, :, idxs].set(k_data),
-        v_cache.at[:, :, idxs].set(v_data),
+        k_cache.at[:, :, idxs].set(k_data.astype(k_cache.dtype)),
+        v_cache.at[:, :, idxs].set(v_data.astype(v_cache.dtype)),
     )
+
+
+def stack_pieces(entries: list, which: int) -> list[np.ndarray]:
+    """Stack per-piece host blocks ([L, Hl, bs, D] each) into per-piece
+    [L, Hl, m, bs, D] stacks (m = len(entries), UNPADDED — the scatter
+    core pads to the bucketed index count on device). ``entries`` are
+    host-tier values (k_pieces, v_pieces); ``which`` selects k (0) or
+    v (1). ONE implementation shared by the leader's
+    OffloadManager.restore and the follower's offload_restore replay —
+    both sides must build identically-shaped global arrays."""
+    n_pieces = len(entries[0][which])
+    return [
+        np.stack([e[which][j] for e in entries], axis=2)
+        for j in range(n_pieces)
+    ]
+
+
+_gather_blocks = jax.jit(gather_blocks_core)
+_scatter_blocks = jax.jit(
+    scatter_blocks_core, donate_argnames=("k_cache", "v_cache")
+)
 
 
 class HostKvPool:
@@ -97,6 +127,47 @@ class HostKvPool:
             n += 1
         return n
 
+    def plan_puts(
+        self, hashes: list[int]
+    ) -> tuple[list[int], list[bool], list[int]]:
+        """Simulate :meth:`put` over ``hashes`` without data: returns
+        (drops, keep, final_order) — drops = currently-resident hashes the
+        LRU will evict, keep[i] = whether hashes[i] is resident AFTER all
+        puts (False when a later insert evicts it or capacity is 0), and
+        the final recency order. The multi-host mirror broadcasts the plan
+        so follower tiers apply the leader's policy verbatim instead of
+        running their own."""
+        sim = OrderedDict((k, None) for k in self._data)
+        for h in hashes:
+            if self.capacity <= 0:
+                break
+            if h in sim:
+                sim.move_to_end(h)
+                continue
+            while len(sim) >= self.capacity:
+                sim.popitem(last=False)
+            sim[h] = None
+        drops = [k for k in self._data if k not in sim]
+        seen: set = set()
+        keep = []
+        for h in hashes:
+            keep.append(h in sim and h not in seen)
+            seen.add(h)
+        return drops, keep, list(sim.keys())
+
+    def apply_plan(self, drops, keep, final_order, hashes, data_for) -> None:
+        """Apply a :meth:`plan_puts` result: drop evictions, insert kept
+        entries (``data_for(i)`` supplies hashes[i]'s value), and restore
+        the simulated recency order."""
+        for h in drops:
+            self._data.pop(h, None)
+        for i, h in enumerate(hashes):
+            if keep[i] and h not in self._data:
+                self._data[h] = data_for(i)
+        for h in final_order:
+            if h in self._data:
+                self._data.move_to_end(h)
+
 
 class OffloadManager:
     """Orchestrates device<->host block movement for one engine.
@@ -108,10 +179,22 @@ class OffloadManager:
     CopyStream (kv/layer.rs:619).
     """
 
-    def __init__(self, host_blocks: int):
+    def __init__(self, host_blocks: int, mirror=None):
         self.pool = HostKvPool(host_blocks)
         # (seq_hash, device_block_idx) evictions awaiting d2h
         self._pending: list[tuple[int, int]] = []
+        # multi-host: flushes/restores become mirrored ops — every process
+        # gathers/scatters in lockstep and parks its OWN cache shards in
+        # host DRAM (pool values are per-unique-shard piece lists instead
+        # of full arrays). The leader's LRU plan is broadcast so follower
+        # tiers stay content-identical (parallel/multihost.py).
+        self.mirror = mirror
+        # leader-side pool mutations that happen OUTSIDE a mirrored op
+        # (unreserve's re-pool evictions, discards of already-restored
+        # reservations) queue their follower-side drops here; the next
+        # flush/restore broadcast carries them. Invariant: the follower
+        # tier must remain a superset of {leader pool + reservations}.
+        self._deferred_drops: list[int] = []
 
     # -- allocator callback (event-loop thread) --
     def on_evict(self, seq_hash: int, block_idx: int) -> None:
@@ -127,8 +210,31 @@ class OffloadManager:
         hashes = seq_hashes[:n]
         return hashes, [self.pool.take(h) for h in hashes]
 
-    def unreserve(self, hashes: list[int], data) -> None:
-        """Admission failed after reservation — return blocks to the pool."""
+    def unreserve(self, hashes: list[int], data, restored: bool = False) -> None:
+        """Admission failed (or the prefill was cancelled/errored) after
+        reservation — return blocks to the pool.
+
+        Under the mirror, ``restored`` says the entries already landed via
+        a mirrored restore, i.e. follower tiers POPPED them: re-pooling on
+        the leader would let a later restore take a hash the followers no
+        longer hold (KeyError -> dead follower). Those entries are
+        discarded instead (their content usually survives in the device
+        reuse pool anyway). Re-pools of never-restored entries go through
+        the LRU plan and queue any evictions as deferred follower drops."""
+        if self.mirror is not None:
+            if restored:
+                # followers popped at restore; leader forgets too. The
+                # drop is deferred only to cover the (idempotent) case of
+                # follower tiers that never saw the restore.
+                self._deferred_drops.extend(hashes)
+                return
+            drops, keep, order = self.pool.plan_puts(hashes)
+            by_hash = dict(zip(hashes, data))
+            self.pool.apply_plan(
+                drops, keep, order, hashes, lambda i: by_hash[hashes[i]]
+            )
+            self._deferred_drops.extend(drops)
+            return
         for h, (k, v) in zip(hashes, data):
             self.pool.put(h, k, v)
 
@@ -139,6 +245,26 @@ class OffloadManager:
             return
         pending, self._pending = self._pending, []
         idxs = _pad_idxs([idx for _h, idx in pending])
+        if self.mirror is not None:
+            hashes = [h for h, _idx in pending]
+            drops, keep, order = self.pool.plan_puts(hashes)
+            bcast_drops = drops + self._deferred_drops
+            self._deferred_drops = []
+            kg, vg = self.mirror.lead_offload_flush(
+                k_cache, v_cache, idxs, hashes,
+                np.asarray(keep, np.uint8), bcast_drops,
+            )
+            k_pc = self.mirror.local_pieces(kg)
+            v_pc = self.mirror.local_pieces(vg)
+            self.pool.apply_plan(
+                drops, keep, order, hashes,
+                lambda i: (
+                    [p[:, :, i].copy() for p in k_pc],
+                    [p[:, :, i].copy() for p in v_pc],
+                ),
+            )
+            self.pool.stored_total += len(pending)
+            return
         kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
         kg, vg = np.asarray(jax.device_get(kg)), np.asarray(jax.device_get(vg))
         for i, (seq_hash, _idx) in enumerate(pending):
@@ -147,22 +273,33 @@ class OffloadManager:
             self.pool.put(seq_hash, kg[:, :, i].copy(), vg[:, :, i].copy())
         self.pool.stored_total += len(pending)
 
-    def restore(self, k_cache, v_cache, data, block_idxs: list[int]):
+    def restore(self, k_cache, v_cache, data, block_idxs: list[int],
+                hashes: Optional[list[int]] = None):
         """Upload reserved host blocks (from :meth:`reserve_chain`) into
-        device pages ``block_idxs``; returns updated caches."""
+        device pages ``block_idxs``; returns updated caches. Under the
+        multi-host mirror ``hashes`` names the entries so follower tiers
+        pop the same blocks (their data is their own local shards)."""
         assert len(data) == len(block_idxs)
         if not data:
             return k_cache, v_cache
-        ks = [k for k, _v in data]
-        vs = [v for _k, v in data]
         self.pool.hit_blocks_total += len(data)
         n = _bucket(len(block_idxs))
-        k_host = np.stack(ks, axis=2)  # [L, Hkv, n, bs, D]
-        v_host = np.stack(vs, axis=2)
-        if n != len(block_idxs):
-            pad = ((0, 0), (0, 0), (0, n - len(block_idxs)), (0, 0), (0, 0))
-            k_host = np.pad(k_host, pad)
-            v_host = np.pad(v_host, pad)
+        if self.mirror is not None:
+            assert hashes is not None and len(hashes) == len(data)
+            k_pieces = stack_pieces(data, 0)
+            v_pieces = stack_pieces(data, 1)
+            gs = (k_cache.shape[0], k_cache.shape[1], len(data),
+                  k_cache.shape[3], k_cache.shape[4])
+            drops = self._deferred_drops
+            self._deferred_drops = []
+            return self.mirror.lead_offload_restore(
+                k_cache, v_cache, _pad_idxs(block_idxs), hashes,
+                k_pieces, v_pieces, gs, drop_hashes=drops,
+            )
+        ks = [k for k, _v in data]
+        vs = [v for _k, v in data]
+        k_host = np.stack(ks, axis=2)  # [L, Hkv, m, bs, D] unpadded —
+        v_host = np.stack(vs, axis=2)  # the scatter core pads on device
         return _scatter_blocks(
             k_cache,
             v_cache,

@@ -218,10 +218,10 @@ async def run_endpoint(args) -> None:
     mirror = None
     if mh.enabled:
         assert args.out == "jax", "--num-nodes > 1 requires out=jax"
-        assert args.disagg is None, (
-            "--disagg is single-host only (remote-KV scatter/gather cannot "
-            "touch a multi-process sharded cache)"
-        )
+        # --disagg and --host-cache-blocks compose with multi-host: KV
+        # gather/scatter and offload flush/restore are mirrored ops (the
+        # leader broadcasts, every rank moves its own cache shards) —
+        # BASELINE configs 4-5 (tests/mh_compose_worker.py)
         multihost.initialize(mh)
         mcfg_mesh = mesh_config(args)
         assert mcfg_mesh is not None, (
@@ -299,12 +299,35 @@ async def run_endpoint(args) -> None:
 async def run_prefill(args) -> None:
     """Prefill-worker mode (`in=prefill`): consume the namespace's prefill
     queue, compute KV + first token, push to the requesting decode worker
-    (ref examples/llm/components/prefill_worker.py)."""
+    (ref examples/llm/components/prefill_worker.py).
+
+    Composes with --num-nodes: rank 0 leads (queue consumer + mirrored
+    prefill/gather dispatch), other ranks replay — the KV extract's
+    all-gather is a mirrored op (BASELINE config 5's multi-host MoE
+    prefill workers)."""
     from ..disagg import PrefillQueue, PrefillWorker
+    from ..parallel import multihost
 
     ns = args.namespace
+    mh = multihost.MultiHostConfig(
+        num_nodes=args.num_nodes, node_rank=args.node_rank,
+        coordinator=args.coordinator,
+    )
+    mirror = None
+    if mh.enabled:
+        multihost.initialize(mh)
+        mcfg_mesh = mesh_config(args)
+        assert mcfg_mesh is not None, (
+            "--num-nodes > 1 needs explicit mesh axes (--dp/--pp/--ep/--tp)"
+        )
+        if not mh.is_leader:
+            cfg, params, _tokenizer, _name = build_model(args)
+            multihost.run_follower(engine_config(args, cfg), params=params)
+            return
     cfg, params, _tokenizer, name = build_model(args)
-    core = build_core_engine(args, cfg, params)
+    if mh.enabled:
+        mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
+    core = build_core_engine(args, cfg, params, mirror=mirror)
     assert isinstance(core, JaxEngine), "in=prefill requires out=jax"
     drt = await connect_runtime(args)
     queue = PrefillQueue(drt.bus, ns)

@@ -261,6 +261,166 @@ class StepMirror:
             self._fns["sample1"] = jax.jit(step, out_shardings=self._rep)
         return self._fns["sample1"]
 
+    # ---- KV block movement (offload tier + disagg transfer) ----
+
+    def _kv_gather_fn(self, replicated_out: bool):
+        """Gather [n] block indices out of the paged cache. Sharded output
+        keeps the cache's layout (offload: each process parks its own
+        shards in host DRAM); replicated output all-gathers (disagg
+        extract: the leader ships full blocks over the transfer plane)."""
+        key = ("kv_gather", replicated_out)
+        if key not in self._fns:
+            import jax
+
+            from ..engine.offload import gather_blocks_core
+
+            out = self._rep if replicated_out else self._stack_sh
+            self._fns[key] = jax.jit(
+                gather_blocks_core, out_shardings=(out, out)
+            )
+        return self._fns[key]
+
+    def _kv_scatter_fn(self):
+        """Scatter a block stack into cache pages (donated). Serves both
+        the offload restore (stack sharded like the cache) and the disagg
+        remote-KV landing (stack replicated from broadcast host data) —
+        jit specializes per input sharding."""
+        if "kv_scatter" not in self._fns:
+            import jax
+
+            from ..engine.offload import scatter_blocks_core
+
+            self._fns["kv_scatter"] = jax.jit(
+                scatter_blocks_core,
+                donate_argnums=(0, 1),
+                out_shardings=(self._cache_sh, self._cache_sh),
+            )
+        return self._fns["kv_scatter"]
+
+    @property
+    def _stack_sh(self):
+        """[L, Hkv, n, bs, D] block-stack sharding == the cache's spec
+        (the block axis is never sharded)."""
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self._cache_sh.spec)
+
+    def _stack_devices(self) -> list:
+        """This process's devices of the block-stack sharding, in the
+        stable order the piece helpers agree on."""
+        return sorted(
+            self._stack_sh.addressable_devices, key=lambda d: d.id
+        )
+
+    def _piece_map(self, global_shape) -> list[tuple]:
+        """[(device, piece_key)] for this process's devices. The key is
+        the device's global index range on the two shardable stack axes
+        (layer, kv-head) — devices that replicate a shard (e.g. along dp)
+        share a key, so host copies are stored ONCE per distinct shard,
+        not once per device."""
+        m = self._stack_sh.devices_indices_map(tuple(global_shape))
+        out = []
+        for d in self._stack_devices():
+            idx = m[d]
+            key = tuple(
+                (s.start or 0, s.stop if s.stop is not None else dim)
+                for s, dim in zip(idx[:2], global_shape[:2])
+            )
+            out.append((d, key))
+        return out
+
+    def local_pieces(self, arr) -> list[np.ndarray]:
+        """Unique host copies of this process's shards of a global array,
+        in canonical key order (the layout pieces_to_global reverses)."""
+        shards = {s.device.id: s for s in arr.addressable_shards}
+        pieces: dict = {}
+        for d, key in self._piece_map(arr.shape):
+            if key not in pieces:
+                pieces[key] = np.asarray(shards[d.id].data)
+        return [pieces[k] for k in sorted(pieces)]
+
+    def pieces_to_global(self, pieces: list[np.ndarray], global_shape):
+        """Rebuild a stack-sharded global array from this process's
+        unique host pieces (every process calls this with ITS pieces).
+        Replicating devices re-use the same host array."""
+        import jax
+
+        pm = self._piece_map(global_shape)
+        keys = sorted({k for _d, k in pm})
+        by_key = dict(zip(keys, pieces))
+        arrs = [jax.device_put(by_key[k], d) for d, k in pm]
+        return jax.make_array_from_single_device_arrays(
+            tuple(global_shape), self._stack_sh, arrs
+        )
+
+    def lead_offload_flush(self, k_cache, v_cache, idxs, hashes, keep,
+                           drop_hashes):
+        """Mirror an offload-tier flush: every process gathers the evicted
+        blocks (cache-sharded output) and parks ITS local shards in host
+        DRAM. ``hashes`` aligns with the gathered stack positions;
+        ``keep`` flags which survive the leader's LRU plan and
+        ``drop_hashes`` are its evictions — followers apply the plan
+        verbatim instead of running their own policy."""
+        self._lead(
+            "offload_flush",
+            (np.asarray(idxs, np.int32),
+             np.asarray(hashes, np.uint64),
+             np.asarray(keep, np.uint8),
+             np.asarray(drop_hashes, np.uint64)),
+        )
+        return self._kv_gather_fn(False)(
+            k_cache, v_cache, self.to_global(np.asarray(idxs, np.int32))
+        )
+
+    def lead_offload_restore(self, k_cache, v_cache, idxs, take_hashes,
+                             k_pieces, v_pieces, global_shape,
+                             drop_hashes=()):
+        """Mirror an offload-tier restore: every process rebuilds the
+        sharded block stack from its own host pieces and runs the same
+        scatter. ``drop_hashes`` piggybacks deferred follower-tier drops
+        (leader-side unreserve evictions, see OffloadManager)."""
+        self._lead(
+            "offload_restore",
+            (np.asarray(idxs, np.int32),
+             np.asarray(take_hashes, np.uint64),
+             np.asarray(list(drop_hashes), np.uint64)),
+        )
+        kg = self.pieces_to_global(k_pieces, global_shape)
+        vg = self.pieces_to_global(v_pieces, global_shape)
+        return self._kv_scatter_fn()(
+            k_cache, v_cache, self.to_global(np.asarray(idxs, np.int32)),
+            kg, vg,
+        )
+
+    def lead_kv_gather_full(self, k_cache, v_cache, idxs):
+        """Disagg prefill extract under mirror: all-gather the blocks to a
+        replicated stack; the leader reads its local copy and ships it over
+        the KV transfer plane (host numpy out)."""
+        import jax
+
+        self._lead("kv_gather_full", (np.asarray(idxs, np.int32),))
+        kg, vg = self._kv_gather_fn(True)(
+            k_cache, v_cache, self.to_global(np.asarray(idxs, np.int32))
+        )
+        return (
+            np.asarray(jax.device_get(kg.addressable_data(0))),
+            np.asarray(jax.device_get(vg.addressable_data(0))),
+        )
+
+    def lead_kv_scatter(self, k_cache, v_cache, idxs, k_host, v_host):
+        """Disagg remote-KV landing under mirror: broadcast the host block
+        stack to every process; all scatter it into their cache shards."""
+        self._lead(
+            "kv_scatter",
+            (np.asarray(idxs, np.int32), np.asarray(k_host),
+             np.asarray(v_host)),
+        )
+        g = self.to_global
+        return self._kv_scatter_fn()(
+            k_cache, v_cache, g(np.asarray(idxs, np.int32)),
+            g(np.asarray(k_host)), g(np.asarray(v_host)),
+        )
+
     # ---- broadcast plumbing ----
 
     def _bcast_header(self, obj: Optional[dict]) -> dict:
@@ -283,26 +443,48 @@ class StepMirror:
         )
 
     def _lead(self, op: str, arrays: tuple[np.ndarray, ...], **extra) -> None:
-        """Leader: announce an op + ship its host inputs to followers."""
+        """Leader: announce an op + ship its host inputs to followers.
+
+        Arrays travel as flat uint8 byte views with logical dtype NAMES in
+        the header — the collective itself never sees the element type, so
+        uint64 block hashes (x64 is off) and bfloat16 KV data (numpy void
+        dtype) broadcast losslessly alongside the int32/float32 step
+        inputs."""
         arrays = tuple(np.asarray(a) for a in arrays)
         self._bcast_header(
             {
                 "op": op,
                 "shapes": [list(a.shape) for a in arrays],
-                "dtypes": [a.dtype.str for a in arrays],
+                "dtypes": [str(a.dtype) for a in arrays],
                 **extra,
             }
         )
-        self._bcast_arrays(arrays)
+        self._bcast_arrays(
+            tuple(np.frombuffer(a.tobytes(), np.uint8) for a in arrays)
+        )
+
+    @staticmethod
+    def _np_dtype(name: str):
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
 
     def follow(self) -> tuple[dict, tuple[np.ndarray, ...]]:
         """Follower: receive the next (header, host inputs)."""
         head = self._bcast_header(None)
+        dts = [self._np_dtype(d) for d in head["dtypes"]]
         zeros = tuple(
-            np.zeros(s, np.dtype(d))
-            for s, d in zip(head["shapes"], head["dtypes"])
+            np.zeros(int(np.prod(s)) * dt.itemsize, np.uint8)
+            for s, dt in zip(head["shapes"], dts)
         )
-        return head, self._bcast_arrays(zeros)
+        bufs = self._bcast_arrays(zeros)
+        return head, tuple(
+            np.frombuffer(b.tobytes(), dt).reshape(s)
+            for b, dt, s in zip(bufs, dts, head["shapes"])
+        )
 
     # ---- leader-side dispatch (called from JaxEngine) ----
 
@@ -419,6 +601,11 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
     )
     logits = None
     pen_counts = pen_mask = None  # mirrored sampling-penalty state
+    # follower half of the host offload tier: seq_hash -> per-local-device
+    # (k_pieces, v_pieces). Content mirrors the leader's HostKvPool — every
+    # mutation arrives as an explicit store/drop/take in a mirrored op, so
+    # the follower runs no eviction policy of its own.
+    host_tier: dict[int, tuple[list, list]] = {}
     logger.info("follower %d ready", jax.process_index())
     while True:
         head, arrays = mirror.follow()
@@ -461,5 +648,44 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
             )(params, *(g(a) for a in arrays), k_cache, v_cache)
         elif op == "sample1":
             mirror._sample1_fn()(logits, *(g(a) for a in arrays))
+        elif op == "offload_flush":
+            idxs, hashes, keep, drop_hashes = arrays
+            kg, vg = mirror._kv_gather_fn(False)(k_cache, v_cache, g(idxs))
+            k_pc, v_pc = mirror.local_pieces(kg), mirror.local_pieces(vg)
+            for h in drop_hashes.tolist():
+                host_tier.pop(h, None)
+            for i, h in enumerate(hashes.tolist()):
+                if not keep[i] or h in host_tier:
+                    continue
+                host_tier[h] = (
+                    [p[:, :, i].copy() for p in k_pc],
+                    [p[:, :, i].copy() for p in v_pc],
+                )
+        elif op == "offload_restore":
+            from ..engine.offload import stack_pieces
+
+            idxs, take_hashes, drop_hashes = arrays
+            for h in drop_hashes.tolist():
+                host_tier.pop(h, None)
+            entries = [host_tier.pop(h) for h in take_hashes.tolist()]
+            k_pieces = stack_pieces(entries, 0)
+            v_pieces = stack_pieces(entries, 1)
+            # global stack shape = cache dims with the block axis =
+            # the UNPADDED entry count (the scatter core pads on device)
+            gs = (k_cache.shape[0], k_cache.shape[1], len(entries),
+                  k_cache.shape[3], k_cache.shape[4])
+            k_cache, v_cache = mirror._kv_scatter_fn()(
+                k_cache, v_cache, g(idxs),
+                mirror.pieces_to_global(k_pieces, gs),
+                mirror.pieces_to_global(v_pieces, gs),
+            )
+        elif op == "kv_gather_full":
+            (idxs,) = arrays
+            mirror._kv_gather_fn(True)(k_cache, v_cache, g(idxs))
+        elif op == "kv_scatter":
+            idxs, k_host, v_host = arrays
+            k_cache, v_cache = mirror._kv_scatter_fn()(
+                k_cache, v_cache, g(idxs), g(k_host), g(v_host)
+            )
         else:
             raise RuntimeError(f"unknown mirrored op {op!r}")

@@ -1,0 +1,51 @@
+"""Composition of multi-host mirror + host offload + disagg (VERDICT r2
+missing #2, the BASELINE config-4/5 shapes). The scenario logic lives in
+tests/mh_compose_worker.py; this test spawns the 2 ranks and asserts both
+exit cleanly after all three phases print their ok markers."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_offload_and_disagg_compose_with_multihost():
+    coord = _free_port()
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU relay
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "mh_compose_worker.py"),
+             str(rank), str(coord)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"rank exited {p.returncode}:\n{out}"
+        assert "phase1 offload ok" in outs[0], outs[0]
+        assert "phase1c cancel-before-restore ok" in outs[0], outs[0]
+        assert "phase1b cancel-after-restore ok" in outs[0], outs[0]
+        assert "phase2 mirrored-decode disagg ok" in outs[0], outs[0]
+        assert "phase3 mirrored-prefill extract ok" in outs[0], outs[0]
+        assert "follower done" in outs[1], outs[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
