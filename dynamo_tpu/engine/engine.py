@@ -975,22 +975,19 @@ class JaxEngine(AsyncEngine):
                 if self._n_active == 0:  # drain may finish survivors
                     return
 
-        # Speculative decoding: greedy-only batches with an n-gram match
-        # verify gamma proposals in one fused forward instead of a decode
-        # window. Unchained (drains any pipeline first); bails to the
-        # normal path when blocks are short or nothing matched.
+        # Speculative decoding: batches with an n-gram match verify gamma
+        # proposals in one fused forward instead of a decode window.
+        # Unchained (drains any pipeline first); bails to the normal path
+        # when blocks are short or nothing matched. Composes with
+        # penalties (sequential semantics modeled in the joint verify),
+        # logprobs (emitted from the verify forward's own logits), and
+        # the multi-host mirror (the verify is a broadcast op). The ONE
+        # remaining gate is sliding-window models: the verify kernel's
+        # window floor is uniform per dispatch (exact per-row floors live
+        # in the XLA path only) — they take plain decode windows.
         if (
             cfg.spec_gamma > 0
-            and self.mirror is None
-            # the verify kernel's window floor is uniform per dispatch
-            # (exact per-row floors live in the XLA path only) — windowed
-            # models take plain decode windows instead
             and cfg.model.sliding_window == 0
-            # penalties mutate the sampling distribution per emitted token;
-            # the verify acceptance doesn't model that yet
-            and not self._penalties_active()
-            # the verify path doesn't emit logprobs yet
-            and not self._logprobs_active()
             and n > 1
             and self._prefill_state is None
         ):
@@ -1134,7 +1131,7 @@ class JaxEngine(AsyncEngine):
             np.int32,
         )
         async with self._device_lock:
-            out_toks, n_accs = await asyncio.get_running_loop().run_in_executor(
+            out_toks, n_accs, lps = await asyncio.get_running_loop().run_in_executor(
                 None, self._dispatch_verify, window,
                 proposals.astype(np.int32), steps,
             )
@@ -1145,10 +1142,21 @@ class JaxEngine(AsyncEngine):
             n_acc = int(n_accs[i])
             self.stats["spec_proposed"] += int((proposals[i] >= 0).sum())
             self.stats["spec_accepted"] += n_acc
+            k = int(self._logprob_ks[i])
             for t in range(n_acc + 1):
                 if seq.finished:
                     break
-                self._emit_token(seq, int(out_toks[i, t]))
+                entry = None
+                if lps is not None and k >= 0:
+                    chosen, top_ids, top_lps = lps
+                    entry = {
+                        "logprob": float(chosen[i, t]),
+                        "top": [
+                            [int(top_ids[i, t, j]), float(top_lps[i, t, j])]
+                            for j in range(k)
+                        ],
+                    }
+                self._emit_token(seq, int(out_toks[i, t]), entry)
             if seq.finished or self._active[i] is not seq:
                 continue
             self._seq_lens[i] = seq.seq_len
@@ -1158,14 +1166,44 @@ class JaxEngine(AsyncEngine):
 
     def _dispatch_verify(
         self, window: np.ndarray, proposals: np.ndarray, steps: np.ndarray
-    ) -> tuple[np.ndarray, np.ndarray]:
+    ):
         """Executor thread: fused verify forward + on-device acceptance.
-        Returns (out_tokens [B, T], n_acc [B])."""
+        Returns (out_tokens [B, T], n_acc [B], lp arrays or None)."""
         cfg = self.cfg
         if self.offload is not None:
             self.offload.flush_evictions(self.k_cache, self.v_cache)
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
-        out, n_acc, self.k_cache, self.v_cache = llama.verify_window(
+        penalized = self._penalties_active()
+        want_lp = self._logprobs_active()
+        if self.mirror is not None:
+            out = self.mirror.lead_verify(
+                self.params, window, proposals, positions,
+                self._block_tables, self._seq_lens, self._seeds, steps,
+                self._temps, self._top_ks, self._top_ps,
+                self.k_cache, self.v_cache,
+                n_spec=cfg.spec_gamma, use_pallas=self.use_pallas,
+                penalties=(self._freq_pens, self._pres_pens, self._rep_pens)
+                if penalized else None,
+                pen_state=(self._pen_counts, self._pen_mask)
+                if penalized else None,
+                with_logprobs=want_lp,
+            )
+            toks, n_acc, self.k_cache, self.v_cache = out[:4]
+            rest = list(out[4:])
+            if penalized:
+                self._pen_counts = rest.pop(0)
+            lps = rest.pop(0) if want_lp else None
+            return toks, n_acc, lps
+        kwargs = {}
+        if penalized:
+            kwargs.update(
+                freq_pens=jnp.asarray(self._freq_pens),
+                pres_pens=jnp.asarray(self._pres_pens),
+                rep_pens=jnp.asarray(self._rep_pens),
+                counts=self._pen_counts,
+                prompt_mask=self._pen_mask,
+            )
+        out = llama.verify_window(
             self.params,
             cfg.model,
             jnp.asarray(window),
@@ -1183,10 +1221,22 @@ class JaxEngine(AsyncEngine):
             n_spec=cfg.spec_gamma,
             use_pallas=self.use_pallas,
             mesh=self.mesh,
+            with_logprobs=want_lp,
+            **kwargs,
+        )
+        toks, n_acc, self.k_cache, self.v_cache = out[:4]
+        rest = list(out[4:])
+        if penalized:
+            self._pen_counts = rest.pop(0)
+        lps_dev = rest.pop(0) if want_lp else None
+        lps = (
+            tuple(np.asarray(jax.device_get(a)) for a in lps_dev)
+            if lps_dev is not None else None
         )
         return (
-            np.asarray(jax.device_get(out)),
+            np.asarray(jax.device_get(toks)),
             np.asarray(jax.device_get(n_acc)),
+            lps,
         )
 
     async def _drain_inflight(self) -> None:

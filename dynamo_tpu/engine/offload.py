@@ -20,7 +20,6 @@ transfer size.
 from __future__ import annotations
 
 from collections import OrderedDict
-from functools import partial
 from typing import Optional
 
 import jax
@@ -233,7 +232,13 @@ class OffloadManager:
             self.pool.apply_plan(
                 drops, keep, order, hashes, lambda i: by_hash[hashes[i]]
             )
+            # follower tiers hold every hash from the original flush: drop
+            # both the plan's evictions AND any re-pooled hash the plan
+            # itself discarded (keep=False, not resident afterwards) — or
+            # follower host DRAM grows past the leader's budget
+            final = set(order)
             self._deferred_drops.extend(drops)
+            self._deferred_drops.extend(h for h in hashes if h not in final)
             return
         for h, (k, v) in zip(hashes, data):
             self.pool.put(h, k, v)
@@ -283,7 +288,6 @@ class OffloadManager:
         if not data:
             return k_cache, v_cache
         self.pool.hit_blocks_total += len(data)
-        n = _bucket(len(block_idxs))
         if self.mirror is not None:
             assert hashes is not None and len(hashes) == len(data)
             k_pieces = stack_pieces(data, 0)

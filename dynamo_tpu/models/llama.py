@@ -762,8 +762,9 @@ def _verify_forward(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_spec", "use_pallas", "mesh", "interpret"),
-    donate_argnames=("k_cache", "v_cache"),
+    static_argnames=("cfg", "n_spec", "use_pallas", "mesh", "interpret",
+                     "with_logprobs"),
+    donate_argnames=("k_cache", "v_cache", "counts"),
 )
 def verify_window(
     params: dict,
@@ -784,6 +785,13 @@ def verify_window(
     use_pallas: bool = False,
     mesh=None,
     interpret: bool = False,
+    # sampling penalties (compiled in only when some request asks)
+    freq_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    pres_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    rep_pens: Optional[jnp.ndarray] = None,  # [B] f32 (1.0 = off)
+    counts: Optional[jnp.ndarray] = None,  # [B, V] i32, donated
+    prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
+    with_logprobs: bool = False,
 ):
     """Speculative verify + acceptance (greedy AND sampled rows):
 
@@ -794,16 +802,58 @@ def verify_window(
         (seed ^ 0x5EC) so emitted-token keys stay identical to the
         plain decode stream (replay-exactness of resumed requests).
 
-    Returns (out_tokens [B, T], n_acc [B], k_cache, v_cache): the caller
+    Penalties (when ``counts`` is given) model the SEQUENTIAL semantics
+    of plain decode inside the joint verify: position t's distribution is
+    penalized by the base counts plus the window's own tokens before t
+    (accepted proposals bump as they would had they been emitted one by
+    one), and the returned counts include every emitted token (the
+    accepted run + correction/bonus). Acceptance and greedy argmax run on
+    the PENALIZED logits — exactly the distribution the plain sampler
+    would have used — while reported logprobs stay the model's own raw
+    distribution (same convention as decode_window).
+
+    Returns (out_tokens [B, T], n_acc [B], k_cache, v_cache[, counts]
+    [, (chosen_lp [B,T], top_ids [B,T,K], top_lps [B,T,K])]): the caller
     emits out_tokens[:, :n_acc+1] — accepted run + correction/bonus.
     """
-    from ..ops.sampling import make_keys, speculative_accept
+    from ..ops.sampling import (
+        apply_penalties,
+        make_keys,
+        speculative_accept,
+        token_logprobs,
+    )
 
     T = n_spec + 1
+    B = tokens.shape[0]
     logits, k_cache, v_cache = _verify_forward(
         params, cfg, tokens, positions, block_tables, seq_lens,
         k_cache, v_cache, n_spec, use_pallas, mesh, interpret,
     )
+    raw_logits = logits.astype(jnp.float32)
+    penalized = counts is not None
+    if penalized:
+        V = raw_logits.shape[-1]
+        d = jnp.maximum(proposals, 0)
+        valid = proposals >= 0
+        # window-token bumps BEFORE each position: one_hot of V (the
+        # invalid sentinel) is all-zeros, so unproposed slots bump nothing
+        oh = jax.nn.one_hot(
+            jnp.where(valid, d, V), V, dtype=jnp.int32
+        )  # [B, g, V]
+        cum = jnp.cumsum(oh, axis=1)
+        cnt_t = counts[:, None] + jnp.concatenate(
+            [jnp.zeros((B, 1, V), jnp.int32), cum], axis=1
+        )  # [B, T, V]
+        sample_logits = apply_penalties(
+            raw_logits.reshape(B * T, V),
+            cnt_t.reshape(B * T, V),
+            jnp.repeat(prompt_mask, T, axis=0),
+            jnp.repeat(freq_pens, T),
+            jnp.repeat(pres_pens, T),
+            jnp.repeat(rep_pens, T),
+        ).reshape(B, T, V)
+    else:
+        sample_logits = raw_logits
     keys_accept = jnp.stack(
         [make_keys(seeds ^ 0x5EC, steps + t) for t in range(n_spec)], axis=1
     ) if n_spec else jnp.zeros((tokens.shape[0], 0, 2), jnp.uint32)
@@ -811,10 +861,27 @@ def verify_window(
         [make_keys(seeds, steps + t) for t in range(T)], axis=1
     )
     out, n_acc = speculative_accept(
-        logits.astype(jnp.float32), proposals, keys_accept, keys_sample,
+        sample_logits, proposals, keys_accept, keys_sample,
         temps, top_ks, top_ps,
     )
-    return out, n_acc, k_cache, v_cache
+    result = [out, n_acc, k_cache, v_cache]
+    if penalized:
+        # count every emitted token (t <= n_acc); others drop via index V
+        emitted = jnp.arange(T)[None, :] <= n_acc[:, None]
+        ids = jnp.where(emitted, out, raw_logits.shape[-1])
+        counts = counts.at[jnp.arange(B)[:, None], ids].add(1, mode="drop")
+        result.append(counts)
+    if with_logprobs:
+        chosen_lp, top_ids, top_lps = token_logprobs(
+            raw_logits.reshape(B * T, -1), out.reshape(-1)
+        )
+        K = top_ids.shape[-1]
+        result.append((
+            chosen_lp.reshape(B, T),
+            top_ids.reshape(B, T, K),
+            top_lps.reshape(B, T, K),
+        ))
+    return tuple(result)
 
 
 # ---------------- reference dense forward (tests) ----------------

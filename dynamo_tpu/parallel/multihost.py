@@ -244,6 +244,101 @@ class StepMirror:
             )
         return self._fns[key]
 
+    def _verify_fn(self, n_spec: int, use_pallas: bool = False,
+                   penalized: bool = False, with_logprobs: bool = False):
+        """Speculative verify as a mirrored program (spec decode composes
+        with multi-host — VERDICT r2 #4)."""
+        key = ("verify", n_spec, use_pallas, penalized, with_logprobs)
+        if key not in self._fns:
+            import jax
+
+            from ..models import llama
+
+            cfg = self.model_cfg
+            mesh = self.mesh
+
+            out_sh = [self._rep, self._rep, self._cache_sh, self._cache_sh]
+            if penalized:
+                out_sh.append(self._rep)
+            if with_logprobs:
+                out_sh.append((self._rep, self._rep, self._rep))
+            out_sh = tuple(out_sh)
+
+            if penalized:
+
+                def step(params, tokens, proposals, positions, tables,
+                         seq_lens, seeds, steps, temps, top_ks, top_ps,
+                         freq, pres, rep, k_cache, v_cache, counts,
+                         prompt_mask):
+                    return llama.verify_window.__wrapped__(
+                        params, cfg, tokens, proposals, positions, tables,
+                        seq_lens, seeds, steps, temps, top_ks, top_ps,
+                        k_cache, v_cache, n_spec=n_spec,
+                        use_pallas=use_pallas, mesh=mesh,
+                        freq_pens=freq, pres_pens=pres, rep_pens=rep,
+                        counts=counts, prompt_mask=prompt_mask,
+                        with_logprobs=with_logprobs,
+                    )
+
+                self._fns[key] = jax.jit(
+                    step, donate_argnums=(14, 15, 16), out_shardings=out_sh
+                )
+            else:
+
+                def step(params, tokens, proposals, positions, tables,
+                         seq_lens, seeds, steps, temps, top_ks, top_ps,
+                         k_cache, v_cache):
+                    return llama.verify_window.__wrapped__(
+                        params, cfg, tokens, proposals, positions, tables,
+                        seq_lens, seeds, steps, temps, top_ks, top_ps,
+                        k_cache, v_cache, n_spec=n_spec,
+                        use_pallas=use_pallas, mesh=mesh,
+                        with_logprobs=with_logprobs,
+                    )
+
+                self._fns[key] = jax.jit(
+                    step, donate_argnums=(11, 12), out_shardings=out_sh
+                )
+        return self._fns[key]
+
+    def lead_verify(self, params, window, proposals, positions, tables,
+                    seq_lens, seeds, steps, temps, top_ks, top_ps,
+                    k_cache, v_cache, n_spec: int, use_pallas: bool = False,
+                    penalties=None, pen_state=None,
+                    with_logprobs: bool = False):
+        """Mirror one speculative verify. Returns host (tokens, n_acc)
+        plus device (k, v[, counts][, lp arrays])."""
+        import jax
+
+        penalized = penalties is not None
+        head_arrays = [window, proposals, positions, tables, seq_lens,
+                       seeds, steps, temps, top_ks, top_ps]
+        if penalized:
+            head_arrays += [np.asarray(a, np.float32) for a in penalties]
+        self._lead("verify", tuple(head_arrays),
+                   n=n_spec, pallas=use_pallas, penalized=penalized,
+                   lp=with_logprobs)
+        g = self.to_global
+        fn = self._verify_fn(n_spec, use_pallas, penalized, with_logprobs)
+        base = [params] + [g(np.asarray(a)) for a in head_arrays]
+        if penalized:
+            out = fn(*base, k_cache, v_cache, pen_state[0], pen_state[1])
+        else:
+            out = fn(*base, k_cache, v_cache)
+        toks = np.asarray(jax.device_get(out[0]))
+        n_acc = np.asarray(jax.device_get(out[1]))
+        rest = list(out[4:])
+        lp_host = None
+        if with_logprobs:
+            lp_dev = rest.pop(-1)
+            lp_host = tuple(
+                np.asarray(a.addressable_data(0)) for a in lp_dev
+            )
+        result = [toks, n_acc, out[2], out[3]] + rest
+        if with_logprobs:
+            result.append(lp_host)
+        return tuple(result)
+
     def _sample1_fn(self):
         if "sample1" not in self._fns:
             import jax
@@ -642,6 +737,23 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
             else:
                 out = fn(params, *(g(a) for a in arrays), k_cache, v_cache)
                 k_cache, v_cache = out[1], out[2]
+        elif op == "verify":
+            penalized = head.get("penalized", False)
+            fn = mirror._verify_fn(head.get("n", 1),
+                                   head.get("pallas", False),
+                                   penalized, head.get("lp", False))
+            if penalized:
+                if pen_counts is None:
+                    V = mcfg.vocab_size
+                    B = engine_cfg.max_batch_size
+                    pen_counts = g(np.zeros((B, V), np.int32))
+                    pen_mask = g(np.zeros((B, V), bool))
+                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache,
+                         pen_counts, pen_mask)
+                k_cache, v_cache, pen_counts = out[2], out[3], out[4]
+            else:
+                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache)
+                k_cache, v_cache = out[2], out[3]
         elif op == "prefill":
             logits, k_cache, v_cache = mirror._prefill_fn(
                 head.get("pallas", False)

@@ -60,6 +60,7 @@ def engine_cfg() -> EngineConfig:
         prefill_chunk=8,  # 24-token prompts take 3 chunks (mid-prefill
         # cancellation needs a chunk boundary after the restore chunk)
         host_cache_blocks=64,
+        spec_gamma=3,  # phase 4: speculative verify as a mirrored op
         mesh=MeshConfig(dp=2, tp=2),
     )
 
@@ -213,6 +214,33 @@ async def leader() -> None:
     toks3 = await _drain(out_q3)
     assert toks3 == ref3_toks, (toks3, ref3_toks)
     print("phase3 mirrored-prefill extract ok", flush=True)
+
+    # ---- phase 4: speculative verify as a mirrored op ----
+    # repetitive prompt -> prompt-lookup proposals -> mirrored verify
+    # (with logprobs, exercising the verify's logprob emission too);
+    # greedy stream must equal the plain single-host engine's.
+    rep_prompt = [11, 12, 13, 14] * 6
+    spec_req = PreprocessedRequest(
+        token_ids=list(rep_prompt),
+        stop_conditions=StopConditions(max_tokens=12),
+        sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
+        eos_token_ids=[511],
+    )
+    base_acc = engine.stats["spec_accepted"]
+    out4 = await collect(engine.generate(Context(spec_req)))
+    toks4 = [t for o in out4 for t in o.token_ids]
+    ents4 = [e for o in out4 for e in (o.logprobs or [])]
+    ref4 = await collect(local.generate(Context(PreprocessedRequest(
+        token_ids=list(rep_prompt),
+        stop_conditions=StopConditions(max_tokens=12),
+        sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
+        eos_token_ids=[511],
+    ))))
+    ref4_toks = [t for o in ref4 for t in o.token_ids]
+    assert toks4 == ref4_toks, (toks4, ref4_toks)
+    assert len(ents4) == len(toks4)
+    assert engine.stats["spec_accepted"] > base_acc, engine.stats
+    print("phase4 mirrored spec decode ok", flush=True)
 
     await local.close()
     await local_decode.close()

@@ -43,6 +43,7 @@ def test_offload_and_disagg_compose_with_multihost():
         assert "phase1b cancel-after-restore ok" in outs[0], outs[0]
         assert "phase2 mirrored-decode disagg ok" in outs[0], outs[0]
         assert "phase3 mirrored-prefill extract ok" in outs[0], outs[0]
+        assert "phase4 mirrored spec decode ok" in outs[0], outs[0]
         assert "follower done" in outs[1], outs[1]
     finally:
         for p in procs:
