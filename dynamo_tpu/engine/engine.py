@@ -1023,7 +1023,6 @@ class JaxEngine(AsyncEngine):
         # frozen in-flight batch.
         pipe = (
             cfg.decode_pipeline
-            and self.mirror is None
             and n > 1
             and self._prefill_state is None
         )
@@ -1039,8 +1038,16 @@ class JaxEngine(AsyncEngine):
             n = min(n, self._pick_window())
         prev = self._inflight
         # chain token inputs on device when a window is in flight;
-        # otherwise feed the host-mirrored last tokens
-        tokens_in = prev["toks"][-1] if prev is not None else None
+        # otherwise feed the host-mirrored last tokens. Under the mirror
+        # the previous output is a multi-process array — eager indexing
+        # is illegal, so the whole [n, B] array is handed over and
+        # lead_decode slices on device (followers slice their own copy).
+        if prev is None:
+            tokens_in = None
+        elif self.mirror is not None:
+            tokens_in = prev["toks"]
+        else:
+            tokens_in = prev["toks"][-1]
         steps = np.asarray(
             [(self._active[i].generated if self._active[i] else 0) + pending
              for i in range(cfg.max_batch_size)],
@@ -1246,8 +1253,19 @@ class JaxEngine(AsyncEngine):
             await self._emit_window(inflight)
 
     async def _emit_window(self, window: dict) -> None:
+        def materialize():
+            t = window["toks"]
+            if hasattr(t, "addressable_data") and not getattr(
+                t, "is_fully_addressable", True
+            ):
+                # multi-process replicated array: read the local shard
+                # (device_get would wait on a collective followers never
+                # join)
+                return np.asarray(t.addressable_data(0))
+            return np.asarray(jax.device_get(t))
+
         toks_host = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: np.asarray(jax.device_get(window["toks"]))
+            None, materialize
         )
         n = window["n"]
         self.stats["decode_steps"] += n
@@ -1343,6 +1361,9 @@ class JaxEngine(AsyncEngine):
                 pen_state=(self._pen_counts, self._pen_mask)
                 if penalized else None,
                 with_logprobs=want_lp,
+                tokens_dev=tokens_in,
+                sync=False,  # device handle; materialized at emission so
+                # a pipelined next window dispatches without waiting
             )
             toks, self.k_cache, self.v_cache = out[0], out[1], out[2]
             rest = list(out[3:])

@@ -23,21 +23,29 @@ The TPU-native equivalent is JAX's multi-controller runtime:
     single-controller scheduling, SPMD execution. Leases/HTTP/discovery
     live only on the leader; followers are pure compute ranks.
 
-Wire protocol per dispatch (two `broadcast_one_to_all` rounds — the first
-a fixed-size JSON header naming the op + array shapes/dtypes, the second
-the host input arrays themselves):
+Wire protocol per dispatch: ONE `broadcast_one_to_all` of a fixed-size
+frame packing [4B header length][JSON header][array payload bytes] —
+the decode hot loop's host inputs (~10 small arrays) fit comfortably, so
+the per-window cost is a single collective round (VERDICT r2 #5: the
+two-round header+arrays scheme doubled the host sync per window). Ops
+whose payload exceeds the frame (KV block data) mark ``inline: false``
+and ship arrays in a second broadcast of exact size:
 
     leader: lead(op, arrays)  ->  followers: op, arrays = follow()
 
 Both sides then call the same fused jit (decode+sample / prefill /
-sample1) on identically-sharded global arrays. Sampled tokens come back
-with replicated out_shardings so the leader can `device_get` them.
+sample1 / verify / kv ops) on identically-sharded global arrays —
+replicated inputs go through a content-keyed device_put cache, so
+rarely-changing arrays (block tables, sampling params) skip the H2D
+re-placement. Sampled tokens come back with replicated out_shardings so
+the leader can read its local shard.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import struct
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -46,7 +54,23 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-_HDR_BYTES = 4096
+# one-round frame: header + small-op payloads ride a single collective.
+# The decode op's payload is dominated by the [B, M] int32 block tables
+# (B*M*4 bytes): 64KB covers e.g. B=16 x M=512 or B=64 x M=128 plus the
+# ~9 [B] vectors and header. Larger configs (and KV block payloads)
+# silently take the two-round path — correct, one extra collective.
+_FRAME_BYTES = 65536
+
+# stable replicated inputs per mirrored op (broadcast-array index ->
+# cache key): block tables change only on allocation, sampling params
+# only on admission — their device placement is content-cached. Indices
+# follow the lead_decode / lead_verify head_arrays order.
+_PLACE_CACHE = {
+    "decode": {2: "tables", 4: "seeds", 6: "temps", 7: "top_ks",
+               8: "top_ps", 9: "freq", 10: "pres", 11: "rep"},
+    "verify": {3: "tables", 5: "seeds", 7: "temps", 8: "top_ks",
+               9: "top_ps", 10: "freq", 11: "pres", 12: "rep"},
+}
 
 
 @dataclass
@@ -126,6 +150,10 @@ class StepMirror:
         self._rep = NamedSharding(mesh, P())
         self._cache_sh = cache_sharding(mesh, model_cfg)
         self._fns = {}
+        # content-keyed device_put cache for rarely-changing replicated
+        # inputs (block tables, sampling params): leader and followers
+        # each skip the per-window H2D when bytes are unchanged
+        self._gcache: dict = {}
 
     # ---- array placement ----
 
@@ -134,6 +162,33 @@ class StepMirror:
         import jax
 
         return jax.device_put(np.asarray(host_array), self._rep)
+
+    def to_global_cached(self, key: str, host_array: np.ndarray):
+        """to_global through a per-key content cache: unchanged bytes
+        reuse the previously placed device array (the decode hot loop's
+        tables/sampling params change only on admission)."""
+        arr = np.asarray(host_array)
+        b = arr.tobytes()
+        hit = self._gcache.get(key)
+        if hit is not None and hit[0] == b:
+            return hit[1]
+        g = self.to_global(arr)
+        self._gcache[key] = (b, g)
+        return g
+
+    def place_inputs(self, op: str, arrays, skip=()) -> list:
+        """Replicated device placement for a mirrored op's host inputs,
+        caching the stable ones (_PLACE_CACHE). Used identically by the
+        leader and the follower loop so both sides skip the same H2Ds.
+        ``skip`` indices yield None (chained decode replaces the token
+        input with a device slice — don't pay its H2D)."""
+        keys = _PLACE_CACHE.get(op, {})
+        return [
+            None if i in skip
+            else self.to_global_cached(f"{op}:{keys[i]}", a)
+            if i in keys else self.to_global(a)
+            for i, a in enumerate(arrays)
+        ]
 
     def init_cache(self, num_blocks: int, block_size: int, dtype=None):
         """KV cache created directly with its global sharding (no host
@@ -318,9 +373,8 @@ class StepMirror:
         self._lead("verify", tuple(head_arrays),
                    n=n_spec, pallas=use_pallas, penalized=penalized,
                    lp=with_logprobs)
-        g = self.to_global
         fn = self._verify_fn(n_spec, use_pallas, penalized, with_logprobs)
-        base = [params] + [g(np.asarray(a)) for a in head_arrays]
+        base = [params] + self.place_inputs("verify", head_arrays)
         if penalized:
             out = fn(*base, k_cache, v_cache, pen_state[0], pen_state[1])
         else:
@@ -518,17 +572,21 @@ class StepMirror:
 
     # ---- broadcast plumbing ----
 
-    def _bcast_header(self, obj: Optional[dict]) -> dict:
+    def _bcast_frame(self, payload: Optional[bytes]) -> bytes:
+        """One fixed-size broadcast: [4B length][payload][zero pad]."""
         from jax.experimental import multihost_utils
 
-        buf = np.zeros(_HDR_BYTES, np.uint8)
+        buf = np.zeros(_FRAME_BYTES, np.uint8)
         if self.is_leader:
-            data = json.dumps(obj).encode()
-            if len(data) > _HDR_BYTES:
-                raise ValueError(f"step header {len(data)}B exceeds {_HDR_BYTES}")
-            buf[: len(data)] = np.frombuffer(data, np.uint8)
+            if len(payload) + 4 > _FRAME_BYTES:
+                raise ValueError(
+                    f"frame payload {len(payload)}B exceeds {_FRAME_BYTES}"
+                )
+            buf[:4] = np.frombuffer(struct.pack("<I", len(payload)), np.uint8)
+            buf[4 : 4 + len(payload)] = np.frombuffer(payload, np.uint8)
         out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-        return json.loads(bytes(out).rstrip(b"\0").decode())
+        (ln,) = struct.unpack("<I", bytes(out[:4]))
+        return bytes(out[4 : 4 + ln])
 
     def _bcast_arrays(self, arrays: tuple) -> tuple:
         from jax.experimental import multihost_utils
@@ -540,22 +598,31 @@ class StepMirror:
     def _lead(self, op: str, arrays: tuple[np.ndarray, ...], **extra) -> None:
         """Leader: announce an op + ship its host inputs to followers.
 
-        Arrays travel as flat uint8 byte views with logical dtype NAMES in
-        the header — the collective itself never sees the element type, so
-        uint64 block hashes (x64 is off) and bfloat16 KV data (numpy void
-        dtype) broadcast losslessly alongside the int32/float32 step
-        inputs."""
+        Arrays travel as raw bytes with logical dtype NAMES in the header
+        — the collectives never see the element type, so uint64 block
+        hashes (x64 is off) and bfloat16 KV data (numpy void dtype)
+        broadcast losslessly alongside the int32/float32 step inputs.
+        Small ops (the decode hot loop) inline the payload into the one
+        header frame; oversized payloads take a second exact-size round."""
         arrays = tuple(np.asarray(a) for a in arrays)
-        self._bcast_header(
-            {
-                "op": op,
-                "shapes": [list(a.shape) for a in arrays],
-                "dtypes": [str(a.dtype) for a in arrays],
-                **extra,
-            }
-        )
+        blobs = [a.tobytes() for a in arrays]
+        head = {
+            "op": op,
+            "shapes": [list(a.shape) for a in arrays],
+            "dtypes": [str(a.dtype) for a in arrays],
+            **extra,
+        }
+        total = sum(len(b) for b in blobs)
+        hdr = json.dumps({**head, "inline": True}).encode()
+        if 4 + len(hdr) + 4 + total <= _FRAME_BYTES:
+            self._bcast_frame(
+                struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
+            )
+            return
+        hdr = json.dumps({**head, "inline": False}).encode()
+        self._bcast_frame(struct.pack("<I", len(hdr)) + hdr)
         self._bcast_arrays(
-            tuple(np.frombuffer(a.tobytes(), np.uint8) for a in arrays)
+            tuple(np.frombuffer(b, np.uint8) for b in blobs)
         )
 
     @staticmethod
@@ -569,13 +636,24 @@ class StepMirror:
 
     def follow(self) -> tuple[dict, tuple[np.ndarray, ...]]:
         """Follower: receive the next (header, host inputs)."""
-        head = self._bcast_header(None)
+        frame = self._bcast_frame(None)
+        (hlen,) = struct.unpack("<I", frame[:4])
+        head = json.loads(frame[4 : 4 + hlen].decode())
         dts = [self._np_dtype(d) for d in head["dtypes"]]
-        zeros = tuple(
-            np.zeros(int(np.prod(s)) * dt.itemsize, np.uint8)
+        sizes = [
+            int(np.prod(s)) * dt.itemsize
             for s, dt in zip(head["shapes"], dts)
+        ]
+        if head["inline"]:
+            body = frame[4 + hlen :]
+            out, off = [], 0
+            for s, dt, size in zip(head["shapes"], dts, sizes):
+                out.append(np.frombuffer(body[off : off + size], dt).reshape(s))
+                off += size
+            return head, tuple(out)
+        bufs = self._bcast_arrays(
+            tuple(np.zeros(size, np.uint8) for size in sizes)
         )
-        bufs = self._bcast_arrays(zeros)
         return head, tuple(
             np.frombuffer(b.tobytes(), dt).reshape(s)
             for b, dt, s in zip(bufs, dts, head["shapes"])
@@ -593,39 +671,65 @@ class StepMirror:
             slot=slot,
         )
 
+    def _slice_last_fn(self):
+        """toks [n, B] -> toks[-1] as a compiled slice (eager indexing on
+        a multi-process array is illegal; this keeps window chaining on
+        device)."""
+        if "slice_last" not in self._fns:
+            import jax
+
+            self._fns["slice_last"] = jax.jit(
+                lambda t: t[-1], out_shardings=self._rep
+            )
+        return self._fns["slice_last"]
+
     def lead_decode(self, params, last_tokens, positions, tables, seq_lens,
                     seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
                     n_steps: int = 1, use_pallas: bool = False,
                     unroll: bool = True, merged: bool = True,
                     penalties=None, pen_state=None,
-                    with_logprobs: bool = False):
+                    with_logprobs: bool = False,
+                    tokens_dev=None, sync: bool = True):
         """``penalties`` = (freq, pres, rep) host vectors; ``pen_state`` =
         (counts, prompt_mask) device arrays (leader's copy — followers
-        hold their own mirrored state). Returns (host_tokens, k, v[,
-        counts, logprob arrays])."""
+        hold their own mirrored state). Returns (tokens, k, v[, counts,
+        logprob arrays]).
+
+        ``tokens_dev`` chains a pipelined window: the token input is the
+        previous window's [n, B] device output (sliced on device), the
+        broadcast ``last_tokens`` is a placeholder, and followers use
+        THEIR retained previous output (header flag ``chain``).
+        ``sync=False`` returns the [n, B] replicated device array instead
+        of host tokens — the leader materializes at emission, so dispatch
+        of window k+1 overlaps window k's execution."""
         import jax
 
         penalized = penalties is not None
+        chain = tokens_dev is not None
         head_arrays = [last_tokens, positions, tables, seq_lens,
                        seeds, steps, temps, top_ks, top_ps]
         if penalized:
-            head_arrays += [np.asarray(a) for a in penalties]
+            head_arrays += [np.asarray(a, np.float32) for a in penalties]
         self._lead("decode", tuple(head_arrays),
                    n=n_steps, pallas=use_pallas, unroll=unroll,
-                   merged=merged, penalized=penalized, lp=with_logprobs)
-        g = self.to_global
+                   merged=merged, penalized=penalized, lp=with_logprobs,
+                   chain=chain)
         fn = self._decode_fn(
             n_steps, use_pallas, unroll, merged, penalized, with_logprobs
         )
-        base = (params, g(last_tokens), g(positions), g(tables), g(seq_lens),
-                g(seeds), g(steps), g(temps), g(top_ks), g(top_ps))
+        placed = self.place_inputs(
+            "decode", head_arrays, skip=(0,) if chain else ()
+        )
+        if chain:
+            placed[0] = self._slice_last_fn()(tokens_dev)
         if penalized:
-            freq, pres, rep = (g(np.asarray(a, np.float32)) for a in penalties)
-            out = fn(*base[:10], freq, pres, rep, k_cache, v_cache,
+            out = fn(params, *placed, k_cache, v_cache,
                      pen_state[0], pen_state[1])
         else:
-            out = fn(*base, k_cache, v_cache)
-        toks = np.asarray(jax.device_get(out[0]))
+            out = fn(params, *placed, k_cache, v_cache)
+        toks = out[0] if not sync else np.asarray(
+            out[0].addressable_data(0)
+        )
         return (toks,) + tuple(out[1:])
 
     def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache,
@@ -696,6 +800,8 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
     )
     logits = None
     pen_counts = pen_mask = None  # mirrored sampling-penalty state
+    last_decode_toks = None  # previous decode window's [n, B] output
+    # (chained-window token source when the leader pipelines dispatches)
     # follower half of the host offload tier: seq_hash -> per-local-device
     # (k_pieces, v_pieces). Content mirrors the leader's HostKvPool — every
     # mutation arrives as an explicit store/drop/take in a mirrored op, so
@@ -728,31 +834,38 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
                                    head.get("unroll", True),
                                    head.get("merged", True),
                                    penalized, head.get("lp", False))
+            chain = head.get("chain", False)
+            placed = mirror.place_inputs(
+                "decode", arrays, skip=(0,) if chain else ()
+            )
+            if chain:
+                placed[0] = mirror._slice_last_fn()(last_decode_toks)
             if penalized:
-                out = fn(
-                    params, *(g(a) for a in arrays), k_cache, v_cache,
-                    pen_counts, pen_mask,
-                )
+                out = fn(params, *placed, k_cache, v_cache,
+                         pen_counts, pen_mask)
                 k_cache, v_cache, pen_counts = out[1], out[2], out[3]
             else:
-                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache)
+                out = fn(params, *placed, k_cache, v_cache)
                 k_cache, v_cache = out[1], out[2]
+            last_decode_toks = out[0]
         elif op == "verify":
             penalized = head.get("penalized", False)
             fn = mirror._verify_fn(head.get("n", 1),
                                    head.get("pallas", False),
                                    penalized, head.get("lp", False))
+            placed = mirror.place_inputs("verify", arrays)
             if penalized:
-                if pen_counts is None:
-                    V = mcfg.vocab_size
-                    B = engine_cfg.max_batch_size
-                    pen_counts = g(np.zeros((B, V), np.int32))
-                    pen_mask = g(np.zeros((B, V), bool))
-                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache,
+                # a penalized verify can only follow a pen_reset op (the
+                # engine broadcasts one when the first penalized request
+                # is admitted) — anything else is a protocol bug
+                assert pen_counts is not None, (
+                    "penalized verify before any pen_reset"
+                )
+                out = fn(params, *placed, k_cache, v_cache,
                          pen_counts, pen_mask)
                 k_cache, v_cache, pen_counts = out[2], out[3], out[4]
             else:
-                out = fn(params, *(g(a) for a in arrays), k_cache, v_cache)
+                out = fn(params, *placed, k_cache, v_cache)
                 k_cache, v_cache = out[2], out[3]
         elif op == "prefill":
             logits, k_cache, v_cache = mirror._prefill_fn(

@@ -1,0 +1,249 @@
+"""Measure mirrored-dispatch overhead vs single-process dispatch
+(VERDICT r2 #5 / weak #2: the multi-host step mirror must not dominate
+per-token latency).
+
+CPU 2-process proxy: a tiny model decodes with decode_window=1 (every
+token is a dispatch — the worst case for mirror overhead; real serving
+fuses windows which amortizes it further). Prints per-token ms for the
+single-process engine and for the 2-process mirrored leader, plus the
+ratio. The compose/multihost tests cover correctness; this script covers
+cost.
+
+Run: JAX_PLATFORMS=cpu python scripts/mirror_overhead.py
+     (the env var must be set at LAUNCH — with a wedged TPU relay the
+     axon site hook hangs the interpreter before any script code runs)
+     python scripts/mirror_overhead.py <rank> <port>   (internal)
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU proxy: never touch the TPU relay (a wedged relay hangs the first
+# backend probe). The axon site hook may have pre-imported jax at
+# interpreter start, so the env var alone is too late — force the
+# platform through jax.config as well (same pattern as tests/mh_worker).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+N_WARM = 8
+N_TIMED = 64
+N_BCAST = 20
+WINDOWS = (1, 8)  # per-token dispatch (worst case) and fused serving
+
+
+def _engine_cfg(window, mesh=None):
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.models.config import ModelConfig
+
+    return EngineConfig(
+        model=ModelConfig.tiny(),
+        num_blocks=64,
+        block_size=8,
+        max_batch_size=2,
+        max_context=256,
+        decode_window=window,
+        decode_pipeline=window > 1,  # chained windows (mirrored too)
+        mesh=mesh,
+    )
+
+
+def _req(max_tokens, seed=0):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        token_ids=list(range(10, 22)),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0, seed=seed),
+        eos_token_ids=[],
+    )
+
+
+async def _time_engine(engine) -> float:
+    """Warmup + timed run; returns per-token seconds. The warmup uses the
+    SAME max_tokens so the timed run hits every window-size program
+    already compiled (headroom clamps near the stop produce several)."""
+    from dynamo_tpu.runtime import Context, collect
+
+    await collect(engine.generate(Context(_req(N_TIMED))))
+    t0 = time.perf_counter()
+    out = await collect(engine.generate(Context(_req(N_TIMED))))
+    dt = time.perf_counter() - t0
+    n = sum(len(o.token_ids) for o in out)
+    assert n == N_TIMED, n
+    return dt / n
+
+
+def run_single() -> dict:
+    import asyncio
+
+    from dynamo_tpu.engine import JaxEngine
+
+    out = {}
+    for w in WINDOWS:
+        engine = JaxEngine(_engine_cfg(w), seed=0)
+
+        async def main(engine=engine):
+            per_tok = await _time_engine(engine)
+            await engine.close()
+            return per_tok
+
+        out[w] = asyncio.run(main())
+    return out
+
+
+def run_meshed() -> None:
+    """Single-process engine over the SAME dp=2 x tp=2 mesh (4 virtual
+    devices, in-process collectives): isolates what the 2-process mirror
+    adds (broadcast protocol + cross-process gloo collectives) from what
+    the sharded program itself costs."""
+    import asyncio
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.engine import JaxEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    out = {}
+    for w in WINDOWS:
+        engine = JaxEngine(_engine_cfg(w, MeshConfig(dp=2, tp=2)), seed=0)
+
+        async def main(engine=engine):
+            per_tok = await _time_engine(engine)
+            await engine.close()
+            return per_tok
+
+        out[w] = asyncio.run(main())
+    print(json.dumps({f"meshed_w{w}_per_token_s": v for w, v in out.items()}),
+          flush=True)
+
+
+def run_rank(rank: int, port: str) -> None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import asyncio
+
+    from dynamo_tpu.engine import JaxEngine
+    from dynamo_tpu.parallel import multihost
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    multihost.initialize(
+        multihost.MultiHostConfig(
+            num_nodes=2, node_rank=rank, coordinator=f"127.0.0.1:{port}"
+        )
+    )
+    mesh_cfg = MeshConfig(dp=2, tp=2)
+    cfgs = {w: _engine_cfg(w, mesh_cfg) for w in WINDOWS}
+    mirror0 = multihost.StepMirror(
+        multihost.global_mesh(mesh_cfg), cfgs[WINDOWS[0]].model
+    )
+    # raw one-round frame cost: the protocol floor per mirrored dispatch
+    for _ in range(3):  # warm the collective path
+        mirror0._bcast_frame(b"w" if rank == 0 else None)
+    t0 = time.perf_counter()
+    for _ in range(N_BCAST):
+        mirror0._bcast_frame(b"x" if rank == 0 else None)
+    bcast_s = (time.perf_counter() - t0) / N_BCAST
+    if rank == 1:
+        for cfg in cfgs.values():
+            multihost.run_follower(cfg)  # returns on each engine's halt
+        return
+
+    result = {"bcast_frame_ms": round(bcast_s * 1e3, 3)}
+    for w, cfg in cfgs.items():
+        mirror = multihost.StepMirror(
+            multihost.global_mesh(cfg.mesh), cfg.model
+        )
+        engine = JaxEngine(cfg, mirror=mirror)
+
+        async def main(engine=engine):
+            per_tok = await _time_engine(engine)
+            await engine.close()
+            return per_tok
+
+        result[f"mirrored_w{w}_per_token_s"] = asyncio.run(main())
+    print(json.dumps(result), flush=True)
+
+
+def _spawn(args, env):
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _json_line(text, key):
+    for line in text.splitlines():
+        try:
+            d = json.loads(line)
+            if key in d:
+                return d
+        except ValueError:
+            continue
+    raise AssertionError(f"no {key} line in:\n{text}")
+
+
+def orchestrate() -> dict:
+    single = run_single()
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+
+    env_meshed = dict(env, XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    p_meshed = _spawn(["meshed"], env_meshed)
+    meshed_out = p_meshed.communicate(timeout=600)[0]
+    assert p_meshed.returncode == 0, meshed_out
+    meshed = _json_line(meshed_out, f"meshed_w{WINDOWS[0]}_per_token_s")
+
+    env.pop("XLA_FLAGS", None)
+    procs = [_spawn([str(r), str(port)], env) for r in (0, 1)]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(f"rank failed rc={p.returncode}:\n{o}")
+    mirrored = _json_line(outs[0], "bcast_frame_ms")
+
+    result = {"bcast_frame_ms": mirrored["bcast_frame_ms"]}
+    for w in WINDOWS:
+        s = single[w]
+        me = meshed[f"meshed_w{w}_per_token_s"]
+        m = mirrored[f"mirrored_w{w}_per_token_s"]
+        result[f"single_w{w}_per_token_ms"] = round(s * 1e3, 3)
+        result[f"meshed_w{w}_per_token_ms"] = round(me * 1e3, 3)
+        result[f"mirrored_w{w}_per_token_ms"] = round(m * 1e3, 3)
+        # the mirror's own cost relative to the same program one-process
+        result[f"ratio_vs_meshed_w{w}"] = round(m / me, 2)
+        result[f"ratio_vs_single_w{w}"] = round(m / s, 2)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 2 and sys.argv[1] == "meshed":
+        run_meshed()
+    elif len(sys.argv) == 3:
+        run_rank(int(sys.argv[1]), sys.argv[2])
+    else:
+        orchestrate()

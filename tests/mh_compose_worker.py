@@ -61,6 +61,8 @@ def engine_cfg() -> EngineConfig:
         # cancellation needs a chunk boundary after the restore chunk)
         host_cache_blocks=64,
         spec_gamma=3,  # phase 4: speculative verify as a mirrored op
+        decode_pipeline=True,  # chained windows ride the mirror too
+        decode_window=4,
         mesh=MeshConfig(dp=2, tp=2),
     )
 
@@ -222,7 +224,7 @@ async def leader() -> None:
     rep_prompt = [11, 12, 13, 14] * 6
     spec_req = PreprocessedRequest(
         token_ids=list(rep_prompt),
-        stop_conditions=StopConditions(max_tokens=12),
+        stop_conditions=StopConditions(max_tokens=24),
         sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
         eos_token_ids=[511],
     )
@@ -232,7 +234,7 @@ async def leader() -> None:
     ents4 = [e for o in out4 for e in (o.logprobs or [])]
     ref4 = await collect(local.generate(Context(PreprocessedRequest(
         token_ids=list(rep_prompt),
-        stop_conditions=StopConditions(max_tokens=12),
+        stop_conditions=StopConditions(max_tokens=24),
         sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
         eos_token_ids=[511],
     ))))
