@@ -159,11 +159,15 @@ class KvTransferServer:
                 return
             n = head["n_blocks"]
             shape = tuple(head["shape"])  # [L, Hkv, n, bs, D]
+            # MLA latent caches: k and v stacks have different trailing
+            # dims, so the v shape rides its own header field and the
+            # per-chunk blob splits at the k part's byte length
+            v_shape = tuple(head.get("v_shape") or shape)
             dt = _np_dtype(head["dtype"])
             layer_chunk = head["layer_chunk"]
             L = shape[0]
             k = np.empty(shape, dt) if n else None
-            v = np.empty(shape, dt) if n else None
+            v = np.empty(v_shape, dt) if n else None
             l0 = 0
             while l0 < L and n:
                 part = await read_frame(reader)
@@ -171,10 +175,11 @@ class KvTransferServer:
                     raise ConnectionError("kv stream truncated")
                 l1 = min(l0 + layer_chunk, L)
                 blob = part.data
-                half = len(blob) // 2
-                sub = (l1 - l0,) + shape[1:]
-                k[l0:l1] = np.frombuffer(blob[:half], dt).reshape(sub)
-                v[l0:l1] = np.frombuffer(blob[half:], dt).reshape(sub)
+                sub_k = (l1 - l0,) + shape[1:]
+                sub_v = (l1 - l0,) + v_shape[1:]
+                k_bytes = int(np.prod(sub_k)) * dt.itemsize
+                k[l0:l1] = np.frombuffer(blob[:k_bytes], dt).reshape(sub_k)
+                v[l0:l1] = np.frombuffer(blob[k_bytes:], dt).reshape(sub_v)
                 l0 = l1
             writer.write(b"ok")
             await writer.drain()
@@ -224,6 +229,7 @@ async def send_kv_blocks(
             "first_token": int(first_token),
             "n_blocks": n,
             "shape": [] if k_data is None else list(k_data.shape),
+            "v_shape": [] if v_data is None else list(v_data.shape),
             "dtype": "" if k_data is None else str(k_data.dtype),
             "layer_chunk": layer_chunk,
             "error": error,

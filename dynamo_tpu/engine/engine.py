@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Optional
 
@@ -133,6 +134,13 @@ class EngineConfig:
     # (float8 = scale-free direct cast, vLLM fp8-KV approach; halves KV
     # HBM traffic + doubles cache capacity at some quality cost)
     kv_cache_dtype: str = "model"
+    # sequence-parallel long-prompt prefill: prompts at least this many
+    # tokens go through ring attention over the mesh's sp axis as ONE
+    # history-free chunk (parallel/ring_attention.py) instead of chunked
+    # dense prefill — each sp device computes T/sp query rows while KV
+    # shards rotate the ICI ring. 0 = off. Requires an sp>1 mesh; full
+    # attention, non-MLA models (engine falls back otherwise).
+    ring_prefill_threshold: int = 0
 
     def __post_init__(self):
         if self.kv_head_layout != "blocked":
@@ -243,8 +251,16 @@ class JaxEngine(AsyncEngine):
             # quantized KV caches take the XLA path (which casts on read);
             # the Mosaic kernels assume bf16/f32 page tiles
             and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
+            # MLA runs the absorbed XLA latent path (models/mla.py); a
+            # Mosaic latent kernel is a follow-up
+            and not cfg.model.is_mla
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
+        # re-admissions (preemption replay, backpressure put-back) jump
+        # the line through this explicit front buffer — consumers drain
+        # it before the queue, so no reaching into asyncio.Queue._queue
+        # internals (advisor r2 weak #4)
+        self._waiting_front: deque[_Sequence] = deque()
         self._prefill_state: Optional[_PrefillState] = None
         # remotely-prefilled sequences with KV landed, awaiting a batch slot
         self._remote_ready: list[_Sequence] = []
@@ -355,7 +371,7 @@ class JaxEngine(AsyncEngine):
             "gpu_cache_usage_perc": self.allocator.usage(),
             "request_active_slots": self._n_active,
             "request_total_slots": self.cfg.max_batch_size,
-            "num_requests_waiting": self._waiting.qsize(),
+            "num_requests_waiting": self._waiting_size(),
         }
 
     # ---------------- scheduler loop ----------------
@@ -393,13 +409,24 @@ class JaxEngine(AsyncEngine):
                         LLMEngineOutput(finish_reason=FinishReason.ERROR)
                     )
             self._remote_ready.clear()
-            while not self._waiting.empty():
-                seq = self._waiting.get_nowait()
+            while self._waiting_front or not self._waiting.empty():
+                seq = self._pop_waiting()
                 seq.out_queue.put_nowait(
                     LLMEngineOutput(finish_reason=FinishReason.ERROR)
                 )
 
     # ---- admission ----
+
+    def _waiting_is_empty(self) -> bool:
+        return not self._waiting_front and self._waiting.empty()
+
+    def _waiting_size(self) -> int:
+        return len(self._waiting_front) + self._waiting.qsize()
+
+    def _pop_waiting(self) -> "_Sequence":
+        if self._waiting_front:
+            return self._waiting_front.popleft()
+        return self._waiting.get_nowait()
 
     async def _admit(self) -> bool:
         admitted = False
@@ -424,9 +451,9 @@ class JaxEngine(AsyncEngine):
         while (
             self._prefill_state is None
             and self._n_active < self.cfg.max_batch_size
-            and not self._waiting.empty()
+            and (self._waiting_front or not self._waiting.empty())
         ):
-            seq = self._waiting.get_nowait()
+            seq = self._pop_waiting()
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
@@ -469,12 +496,12 @@ class JaxEngine(AsyncEngine):
                     self._finish(seq, reason)
                     continue
                 # out of KV blocks: put back and stop admitting (backpressure)
-                self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
+                self._waiting_front.appendleft(seq)
                 self._backpressured = True
                 break
             admitted |= await self._prefill_step()
         self.stats["requests_active"] = self._n_active
-        self.stats["requests_waiting"] = self._waiting.qsize()
+        self.stats["requests_waiting"] = self._waiting_size()
         return admitted
 
     def _reserve_for_prompt(self, seq: _Sequence, probe_host: bool = False):
@@ -611,10 +638,35 @@ class JaxEngine(AsyncEngine):
                 hashes=restore_hashes,
             )
 
+    def _ring_chunk(self, seq: _Sequence, pos: int) -> bool:
+        """Route THIS chunk through sp ring attention? History-free
+        first chunk of a long-enough prompt on an sp>1 mesh, full
+        attention, non-MLA (the whole prompt becomes one ring chunk)."""
+        cfg = self.cfg
+        if (
+            cfg.ring_prefill_threshold <= 0
+            or pos != 0
+            or self.mesh is None
+            or self.mirror is not None  # lead_prefill has no ring path
+            # yet — without this guard the whole prompt would go through
+            # as ONE dense chunk (O(T^2) scores, per-prompt compiles)
+            or self.mesh.shape.get("sp", 1) <= 1
+            or len(seq.tokens) < cfg.ring_prefill_threshold
+            or cfg.model.sliding_window != 0
+            or cfg.model.is_mla
+        ):
+            return False
+        # bucket sizes are powers of two >= sp, so T % sp == 0 holds
+        return _bucket(len(seq.tokens)) % self.mesh.shape["sp"] == 0
+
     def _run_one_chunk(self, seq: _Sequence, pos: int):
         """One bucketed prefill chunk at ``pos``; returns (logits, new_pos)."""
         cfg = self.cfg
-        chunk = seq.tokens[pos : pos + cfg.prefill_chunk]
+        ring = self._ring_chunk(seq, pos)
+        # ring: the WHOLE prompt is one sequence-parallel chunk
+        chunk = seq.tokens[pos:] if ring else (
+            seq.tokens[pos : pos + cfg.prefill_chunk]
+        )
         T = _bucket(len(chunk))
         toks = np.zeros(T, np.int32)
         toks[: len(chunk)] = chunk
@@ -636,6 +688,7 @@ class JaxEngine(AsyncEngine):
             self.v_cache,
             use_pallas=self.use_pallas,
             mesh=self.mesh,
+            use_ring=ring,
         )
         return logits, pos + len(chunk)
 
@@ -837,7 +890,7 @@ class JaxEngine(AsyncEngine):
         batch_full = self._n_active >= self.cfg.max_batch_size
         actionable = (
             self._prefill_state is not None
-            or (not self._waiting.empty() and not batch_full
+            or (not self._waiting_is_empty() and not batch_full
                 and not self._backpressured)
             or (bool(self._remote_ready) and not batch_full)
         )
@@ -874,7 +927,7 @@ class JaxEngine(AsyncEngine):
         # (prompt + generated so far) re-admits as a prefill whose final
         # sampled token simply continues the stream (PRNG steps continue
         # from seq.generated, so sampling is replay-exact)
-        self._waiting._queue.appendleft(seq)  # type: ignore[attr-defined]
+        self._waiting_front.appendleft(seq)
         self.stats["preemptions"] += 1
         logger.info(
             "preempted request %s at %d tokens (pool pressure)",
@@ -988,6 +1041,9 @@ class JaxEngine(AsyncEngine):
         if (
             cfg.spec_gamma > 0
             and cfg.model.sliding_window == 0
+            # MLA verify (multi-token absorbed attention) is a follow-up;
+            # MLA models take plain decode windows
+            and not cfg.model.is_mla
             and n > 1
             and self._prefill_state is None
         ):

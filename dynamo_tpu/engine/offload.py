@@ -292,13 +292,17 @@ class OffloadManager:
             assert hashes is not None and len(hashes) == len(data)
             k_pieces = stack_pieces(data, 0)
             v_pieces = stack_pieces(data, 1)
-            gs = (k_cache.shape[0], k_cache.shape[1], len(data),
-                  k_cache.shape[3], k_cache.shape[4])
+
+            def gs(cache):  # MLA caches have DIFFERENT trailing dims
+                return (cache.shape[0], cache.shape[1], len(data),
+                        cache.shape[3], cache.shape[4])
+
             drops = self._deferred_drops
             self._deferred_drops = []
             return self.mirror.lead_offload_restore(
                 k_cache, v_cache, _pad_idxs(block_idxs), hashes,
-                k_pieces, v_pieces, gs, drop_hashes=drops,
+                k_pieces, v_pieces, gs(k_cache), gs(v_cache),
+                drop_hashes=drops,
             )
         ks = [k for k, _v in data]
         vs = [v for _k, v in data]

@@ -138,6 +138,11 @@ class OpenAIPreprocessor(Operator):
         queue: asyncio.Queue = asyncio.Queue()
         prompt_tokens = len(pre.token_ids)
         completion_total = 0
+        # choice 0's prompt blocks are committed to the prefix cache the
+        # moment its first token emits; siblings admitted AFTER that point
+        # prefix-hit instead of racing n identical prefills through the
+        # engine (advisor r2 weak #5 / VERDICT #8)
+        first_token_evt = asyncio.Event()
 
         async def run_choice(i: int) -> None:
             so = dataclasses.replace(
@@ -171,6 +176,8 @@ class OpenAIPreprocessor(Operator):
                         queue.put_nowait(
                             ("item", Annotated(data=chunk, id=item.id), 0)
                         )
+                    if i == 0 and out.token_ids:
+                        first_token_evt.set()
                     first = False
                     if out.is_final():
                         break
@@ -178,14 +185,21 @@ class OpenAIPreprocessor(Operator):
                 raise
             except Exception as e:  # noqa: BLE001 — a dead choice must not
                 # masquerade as a completed one
+                first_token_evt.set()  # never strand the sibling launcher
                 queue.put_nowait(("err", f"{type(e).__name__}: {e}", 0))
                 return
+            if i == 0:
+                first_token_evt.set()  # zero-token finishes included
             queue.put_nowait(("done", None, delta.completion_tokens))
 
-        tasks = [
-            asyncio.get_running_loop().create_task(run_choice(i))
-            for i in range(n)
-        ]
+        loop = asyncio.get_running_loop()
+        tasks = [loop.create_task(run_choice(0))]
+
+        async def launch_siblings() -> None:
+            await first_token_evt.wait()
+            tasks.extend(loop.create_task(run_choice(i)) for i in range(1, n))
+
+        launcher = loop.create_task(launch_siblings())
         try:
             done = 0
             while done < n:
@@ -200,6 +214,7 @@ class OpenAIPreprocessor(Operator):
                 else:
                     yield item
         finally:
+            launcher.cancel()
             for t in tasks:
                 t.cancel()
         usage = Usage(

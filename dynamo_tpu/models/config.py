@@ -15,6 +15,23 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 
+def is_yarn(rope_scaling: dict) -> bool:
+    return (
+        rope_scaling.get("type") == "yarn"
+        or rope_scaling.get("rope_type") == "yarn"
+    )
+
+
+def yarn_mscale(factor: float, mscale: float) -> float:
+    """DeepSeek's YaRN attention-scale correction (ONE copy — the rope
+    cos/sin correction in models/mla.py uses the same formula)."""
+    import math
+
+    if factor <= 1.0 or mscale == 0:
+        return 1.0
+    return 0.1 * mscale * math.log(factor) + 1.0
+
+
 @dataclass(eq=False)  # identity hash/eq: used as a jit static arg
 class ModelConfig:
     vocab_size: int = 32000
@@ -37,6 +54,32 @@ class ModelConfig:
     num_shared_experts: int = 0  # DeepSeek-style always-on experts
     first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
+    # DeepSeek-V2/V3 routing variants (ref patch:3548-3560 deepseek_v2;
+    # BASELINE config 5 names DeepSeek-R1 = the V3 architecture)
+    moe_scoring: str = "softmax"  # "softmax" (V2) | "sigmoid" (V3)
+    moe_gate_bias: bool = False  # V3 e_score_correction_bias (topk only)
+    routed_scaling_factor: float = 1.0
+    n_group: int = 0  # group-limited routing (0 = off)
+    topk_group: int = 0
+    # group score: V2 group_limited_greedy uses the group MAX, V3
+    # noaux_tc the sum of the group's top-2
+    moe_group_score: str = "max"
+    # Multi-Latent Attention (DeepSeek-V2/V3; kv_lora_rank > 0 enables).
+    # The KV cache stores the COMPRESSED latent per token: c_kv
+    # [kv_lora_rank] in the k-cache slot and the shared rotated k_pe
+    # [qk_rope_head_dim] in the v-cache slot, both single-"head" paged
+    # arrays — attention runs ABSORBED (q_nope folded through the
+    # kv_b up-projection), so per-token cache bytes are
+    # kv_lora_rank + qk_rope_head_dim instead of 2*Hkv*head_dim.
+    q_lora_rank: int = 0  # 0 = direct q projection (V2-Lite)
+    kv_lora_rank: int = 0  # 0 = regular attention
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # DeepSeek checkpoints store rope dims interleaved (GPT-J pairs);
+    # weights.py de-interleaves at load so the runtime rotation stays the
+    # fast half-split form — this flag records the CHECKPOINT convention
+    rope_interleave: bool = False
     # sliding-window attention (mistral v0.1-style; 0 = full attention).
     # Enforced by masking in the XLA paths and by a window floor in the
     # in-repo Pallas kernels (exact for decode/merged at T=1 and for
@@ -59,6 +102,25 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    def mla_softmax_scale(self) -> float:
+        """qk_head_dim^-0.5 times the YaRN mscale^2 correction DeepSeek
+        applies when rope_scaling.mscale_all_dim is set."""
+        scale = self.qk_head_dim**-0.5
+        rs = self.rope_scaling or {}
+        if is_yarn(rs):
+            m = yarn_mscale(rs.get("factor", 1.0),
+                            rs.get("mscale_all_dim", 0.0) or 0.0)
+            scale = scale * m * m
+        return scale
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "ModelConfig":
@@ -95,6 +157,29 @@ class ModelConfig:
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
             first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
+            # deepseek_v2/v3 (R1 = V3): sigmoid scoring + gate bias and
+            # group-limited top-k arrive with topk_method "noaux_tc"
+            moe_scoring=cfg.get("scoring_func", "softmax"),
+            moe_gate_bias=cfg.get("topk_method") == "noaux_tc",
+            moe_group_score=(
+                "top2" if cfg.get("topk_method") == "noaux_tc" else "max"
+            ),
+            routed_scaling_factor=cfg.get("routed_scaling_factor", 1.0),
+            n_group=cfg.get("n_group", 0) or 0,
+            topk_group=cfg.get("topk_group", 0) or 0,
+            q_lora_rank=cfg.get("q_lora_rank") or 0,
+            kv_lora_rank=cfg.get("kv_lora_rank") or 0,
+            qk_nope_head_dim=cfg.get("qk_nope_head_dim") or 0,
+            qk_rope_head_dim=cfg.get("qk_rope_head_dim") or 0,
+            v_head_dim=cfg.get("v_head_dim") or 0,
+            # interleaved rope storage is an MLA-checkpoint convention;
+            # non-MLA deepseek (deepseek-moe) checkpoints use the plain
+            # half-split layout like every other llama-family model
+            rope_interleave=cfg.get(
+                "rope_interleave",
+                cfg.get("model_type", "").startswith("deepseek")
+                and bool(cfg.get("kv_lora_rank")),
+            ),
             sliding_window=cfg.get("sliding_window") or 0,
             hidden_act=act if act != "silu" else "silu",
             rms_add_unit=is_gemma,

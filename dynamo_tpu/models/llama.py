@@ -42,34 +42,57 @@ def _dtype(cfg: ModelConfig):
 # ---------------- parameter init / structure ----------------
 
 
-def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
-    """Random-init params (tests/benches; real weights via weights.py)."""
+def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
+                      moe: bool) -> dict:
+    """Stacked [L, ...] layer leaves for one homogeneous group (attention
+    + one FFN kind). DeepSeek's first_k_dense_replace makes the model
+    heterogeneous, so params carry up to two groups (``dense_layers``
+    then ``layers``) — each scanned separately."""
     dt = _dtype(cfg)
-    E, H, Hkv, D, F, L, V = (
+    E, H, Hkv, D, F, V = (
         cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
-        cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
+        cfg.intermediate_size, cfg.vocab_size,
     )
-    keys = jax.random.split(key, 10)
-
-    def norm_init(k, shape, scale):
-        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+    keys = jax.random.split(key, 12)
 
     def layer_stack(k, shape, scale=0.02):
-        return norm_init(k, (L,) + shape, scale)
+        return (
+            jax.random.normal(k, (L,) + shape, jnp.float32) * scale
+        ).astype(dt)
 
     layers = {
         "attn_norm": jnp.ones((L, E), dt),
-        "wq": layer_stack(keys[1], (E, H * D)),
-        "wk": layer_stack(keys[2], (E, Hkv * D)),
-        "wv": layer_stack(keys[3], (E, Hkv * D)),
-        "wo": layer_stack(keys[4], (H * D, E)),
         "mlp_norm": jnp.ones((L, E), dt),
     }
-    if cfg.is_moe:
+    if cfg.is_mla:
+        Cq, C = cfg.q_lora_rank, cfg.kv_lora_rank
+        dqk, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        if Cq:
+            layers["wq_a"] = layer_stack(keys[1], (E, Cq))
+            layers["q_norm"] = jnp.ones((L, Cq), dt)
+            layers["wq_b"] = layer_stack(keys[2], (Cq, H * (dqk + dr)))
+        else:
+            layers["wq"] = layer_stack(keys[1], (E, H * (dqk + dr)))
+        layers["wkv_a"] = layer_stack(keys[3], (E, C + dr))
+        layers["kv_norm"] = jnp.ones((L, C), dt)
+        layers["wkv_b"] = layer_stack(keys[9], (C, H * (dqk + dv)))
+        layers["wo"] = layer_stack(keys[4], (H * dv, E))
+    else:
+        layers["wq"] = layer_stack(keys[1], (E, H * D))
+        layers["wk"] = layer_stack(keys[2], (E, Hkv * D))
+        layers["wv"] = layer_stack(keys[3], (E, Hkv * D))
+        layers["wo"] = layer_stack(keys[4], (H * D, E))
+        if cfg.attention_bias:
+            layers["bq"] = jnp.zeros((L, H * D), dt)
+            layers["bk"] = jnp.zeros((L, Hkv * D), dt)
+            layers["bv"] = jnp.zeros((L, Hkv * D), dt)
+    if moe:
         X = cfg.num_experts
         Fm = cfg.moe_intermediate_size or F
         mk = jax.random.split(keys[5], 7)
         layers["moe_gate"] = layer_stack(mk[0], (E, X))
+        if cfg.moe_gate_bias:
+            layers["moe_gate_bias"] = jnp.zeros((L, X), jnp.float32)
         layers["we_gate"] = layer_stack(mk[1], (X, E, Fm))
         layers["we_up"] = layer_stack(mk[2], (X, E, Fm))
         layers["we_down"] = layer_stack(mk[3], (X, Fm, E))
@@ -82,26 +105,83 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         layers["w_gate"] = layer_stack(keys[5], (E, F))
         layers["w_up"] = layer_stack(keys[6], (E, F))
         layers["w_down"] = layer_stack(keys[7], (F, E))
+    return layers
+
+
+def layer_groups(params: dict, cfg: ModelConfig):
+    """[(stacked_layer_params, n_layers, layer_offset)] in forward order
+    — one group for homogeneous models, (dense, moe) for DeepSeek-style
+    first_k_dense_replace checkpoints."""
+    k = cfg.first_dense_layers if "dense_layers" in params else 0
+    out = []
+    if k:
+        out.append((params["dense_layers"], k, 0))
+    out.append((params["layers"], cfg.num_layers - k, k))
+    return out
+
+
+def _scan_groups(body, x, params, cfg: ModelConfig, k_cache, v_cache):
+    """lax.scan the layer body over every layer group, threading the
+    cache slices; returns (x, k_cache, v_cache) with per-group ys
+    re-concatenated on the layer axis. ONE implementation for prefill
+    and both scan decode variants."""
+    kcs, vcs = [], []
+    for lps, n, off in layer_groups(params, cfg):
+        x, (kc_g, vc_g) = lax.scan(
+            body, x, (lps, k_cache[off : off + n], v_cache[off : off + n])
+        )
+        kcs.append(kc_g)
+        vcs.append(vc_g)
+    k_cache = jnp.concatenate(kcs) if len(kcs) > 1 else kcs[0]
+    v_cache = jnp.concatenate(vcs) if len(vcs) > 1 else vcs[0]
+    return x, k_cache, v_cache
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    """Random-init params (tests/benches; real weights via weights.py)."""
+    dt = _dtype(cfg)
+    E, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    keys = jax.random.split(key, 4)
+
+    def norm_init(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    kd = cfg.first_dense_layers if cfg.is_moe else 0
     params = {
         "embed": norm_init(keys[0], (V, E), 0.02),
         "final_norm": jnp.ones((E,), dt),
-        "layers": layers,
+        "layers": _init_layer_group(cfg, keys[1], L - kd, cfg.is_moe),
     }
-    if cfg.attention_bias:
-        params["layers"]["bq"] = jnp.zeros((L, H * D), dt)
-        params["layers"]["bk"] = jnp.zeros((L, Hkv * D), dt)
-        params["layers"]["bv"] = jnp.zeros((L, Hkv * D), dt)
+    if kd:
+        params["dense_layers"] = _init_layer_group(cfg, keys[3], kd, False)
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = norm_init(keys[8], (E, V), 0.02)
+        params["lm_head"] = norm_init(keys[2], (E, V), 0.02)
     return params
+
+
+def kv_cache_shapes(
+    cfg: ModelConfig, num_blocks: int, block_size: int
+) -> tuple[tuple, tuple]:
+    """(k_shape, v_shape). MLA stores the compressed latent instead of
+    per-head K/V: c_kv rides the k slot, the head-shared rotated k_pe the
+    v slot — both single-"head" paged arrays, so every block-table /
+    allocator / offload / transfer path works unchanged (models/mla.py)."""
+    L = cfg.num_layers
+    if cfg.is_mla:
+        return (
+            (L, 1, num_blocks, block_size, cfg.kv_lora_rank),
+            (L, 1, num_blocks, block_size, cfg.qk_rope_head_dim),
+        )
+    s = (L, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim)
+    return s, s
 
 
 def init_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=None
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim)
+    ks, vs = kv_cache_shapes(cfg, num_blocks, block_size)
     dt = dtype or _dtype(cfg)
-    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+    return jnp.zeros(ks, dt), jnp.zeros(vs, dt)
 
 
 # ---------------- building blocks ----------------
@@ -176,19 +256,60 @@ def _moe_route(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     """Top-k routing + expert-sorted dispatch order (shared by the single-
     device and ep-sharded ragged paths). Returns (t_sorted, w_sorted,
     group_sizes): token row per assignment in expert order, its combine
-    weight, and per-expert assignment counts."""
+    weight, and per-expert assignment counts.
+
+    Covers Mixtral/Qwen softmax routing AND the DeepSeek variants: V2
+    softmax, V3 sigmoid scoring with the no-aux-loss gate bias (bias
+    picks the experts, the UNBIASED score is the combine weight) and
+    group-limited top-k (score the n_group blocks by their top-2 sum,
+    route only within the best topk_group blocks), with
+    routed_scaling_factor applied to the final weights."""
     k = cfg.num_experts_per_tok
-    gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
-    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
-    vals, idx = lax.top_k(probs, k)  # [T, k]
-    if cfg.norm_topk_prob:
-        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    vals, idx = _route_topk(lp, cfg, x)
     e_flat = idx.reshape(-1)  # [T*k] row-major: assignment r -> token r//k
     order = jnp.argsort(e_flat)  # stable: deterministic within an expert
     t_sorted = order // k
     w_sorted = vals.reshape(-1)[order]
     group_sizes = jnp.bincount(e_flat, length=cfg.num_experts)
     return t_sorted, w_sorted, group_sizes
+
+
+def _route_topk(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
+    """(combine weights [T, k], expert indices [T, k]) — ONE scoring
+    implementation shared by the ragged, sharded-ragged and dense
+    dispatch paths."""
+    k = cfg.num_experts_per_tok
+    X = cfg.num_experts
+    gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
+    if cfg.moe_scoring == "sigmoid":
+        scores = jax.nn.sigmoid(gate_logits)
+    else:
+        scores = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
+    sel = scores
+    if lp.get("moe_gate_bias") is not None:
+        sel = scores + lp["moe_gate_bias"]
+    if cfg.n_group > 1 and cfg.topk_group:
+        T = sel.shape[0]
+        g = sel.reshape(T, cfg.n_group, X // cfg.n_group)
+        if cfg.moe_group_score == "top2":  # V3 noaux_tc
+            g_score = jnp.sum(lax.top_k(g, 2)[0], axis=-1)  # [T, n_group]
+        else:  # V2 group_limited_greedy: the group's max score
+            g_score = jnp.max(g, axis=-1)
+        _, g_idx = lax.top_k(g_score, cfg.topk_group)
+        g_mask = jnp.zeros((T, cfg.n_group), bool).at[
+            jnp.arange(T)[:, None], g_idx
+        ].set(True)
+        # masked groups score 0.0, not -inf — the HF routers mask to 0,
+        # and a NEGATIVE biased in-group score must lose to an
+        # out-of-group 0 exactly as it does there
+        sel = jnp.where(
+            jnp.repeat(g_mask, X // cfg.n_group, axis=1), sel, 0.0
+        )
+    _, idx = lax.top_k(sel, k)  # selection by (biased, group-limited) score
+    vals = jnp.take_along_axis(scores, idx, axis=1)  # combine: raw score
+    if cfg.norm_topk_prob:
+        vals = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-20)
+    return vals * cfg.routed_scaling_factor, idx
 
 
 def _moe_combine(o, t_sorted, w_sorted, T: int, dtype):
@@ -251,11 +372,7 @@ def _moe_dense_dispatch(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarr
     the ragged path's expert-GEMM FLOPs, but fully GSPMD-shardable — the
     equivalence ground truth for tests and the mesh fallback for shapes
     the shard_map ragged path can't cover."""
-    gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
-    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, X]
-    vals, idx = lax.top_k(probs, cfg.num_experts_per_tok)  # [T, k]
-    if cfg.norm_topk_prob:
-        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    vals, idx = _route_topk(lp, cfg, x)  # [T, k]
     w = jnp.sum(
         jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)
         * vals[..., None],
@@ -295,9 +412,9 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
     Xl = X // ep
     out_dt = x.dtype
 
-    def body(x, moe_gate, we_gate, we_up, we_down):
+    def body(x, moe_gate, gate_bias, we_gate, we_up, we_down):
         t_sorted, w_sorted, group_sizes = _moe_route(
-            {"moe_gate": moe_gate}, cfg, x
+            {"moe_gate": moe_gate, "moe_gate_bias": gate_bias}, cfg, x
         )
         first = lax.axis_index("ep") * Xl
         gs_local = lax.dynamic_slice_in_dim(group_sizes, first, Xl)
@@ -324,23 +441,30 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
         out = _moe_combine(o, t_l, w_l, T, out_dt)
         return lax.psum(out, ("ep", "tp"))
 
+    gate_bias = lp.get("moe_gate_bias")
+    if gate_bias is None:  # uniform operand pytree for the shard_map
+        gate_bias = jnp.zeros((X,), jnp.float32)
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
             P(),  # x replicated (batch inputs are replicated engine-side)
             P(),  # router weights replicated
+            P(),  # V3 no-aux gate bias (zeros when absent)
             P("ep", None, "tp"),  # we_gate [X, E, Fm]
             P("ep", None, "tp"),  # we_up
             P("ep", "tp", None),  # we_down [X, Fm, E]
         ),
         out_specs=P(),
         check_vma=False,
-    )(x, lp["moe_gate"], lp["we_gate"], lp["we_up"], lp["we_down"])
+    )(x, lp["moe_gate"], gate_bias, lp["we_gate"], lp["we_up"],
+      lp["we_down"])
 
 
 def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
-    if cfg.is_moe:
+    # branch on the GROUP's own leaves, not cfg.is_moe: DeepSeek's
+    # first_k_dense_replace layers are dense inside an MoE model
+    if "moe_gate" in lp:
         return moe_ffn(lp, cfg, h, mesh=mesh)
     return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"], cfg.hidden_act)
 
@@ -371,7 +495,7 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_pallas", "mesh"),
+    static_argnames=("cfg", "use_pallas", "mesh", "use_ring"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def prefill(
@@ -385,6 +509,7 @@ def prefill(
     v_cache: jnp.ndarray,
     use_pallas: bool = False,
     mesh=None,
+    use_ring: bool = False,
 ):
     """Process one (chunk of a) prompt; returns (last_hidden_logits, caches).
 
@@ -396,8 +521,17 @@ def prefill(
     as a STAGED PIPELINE: microbatches flow through the pp stages via
     ppermute so stages compute concurrently (parallel/pp.py), instead of
     the scan all-gathering one stage's weights per step.
+
+    ``use_ring`` (static; history-free chunks only — the ENGINE gates it
+    on history == 0, an sp>1 mesh, T % sp == 0, prompt length >= its
+    ring threshold, full attention, non-MLA) routes the chunk's
+    self-attention through sequence-parallel ring attention over the sp
+    axis (parallel/ring_attention.py) instead of the dense score matrix:
+    each device holds T/sp query rows and KV shards rotate the ICI ring.
+    Cache writes are unchanged, so decode and later chunked prefill
+    continue through the paged path.
     """
-    if mesh is not None:
+    if mesh is not None and not use_ring:
         from ..parallel.pp import can_pipeline, pick_n_micro, pipelined_prefill
 
         n_micro = pick_n_micro(mesh, tokens.shape[0])
@@ -406,31 +540,70 @@ def prefill(
                 params, cfg, tokens, block_table, history_len, valid_len,
                 k_cache, v_cache, mesh, n_micro, use_pallas=use_pallas,
             )
-    inv_freq = _rope_freqs(cfg)
-    scale = cfg.head_dim**-0.5
+    if use_ring:
+        assert mesh is not None and mesh.shape.get("sp", 1) > 1
+        assert not cfg.is_mla and cfg.sliding_window == 0
     T = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [T, E]
     positions = history_len + jnp.arange(T)
+    if cfg.is_mla:
+        from . import mla
+
+        inv_freq, msc = mla.mla_rope_freqs(cfg)
+        scale = cfg.mla_softmax_scale()
+    else:
+        inv_freq = _rope_freqs(cfg)
+        scale = cfg.head_dim**-0.5
 
     def body(carry, layer_in):
         x = carry
         lp, kc, vc = layer_in
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
-        vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
-        o = att.chunk_attention_with_cache(
-            q, k, v, kc, vc, block_table, history_len, valid_len, scale,
-            use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
-        )
-        x = x + _mm(o.reshape(T, -1), lp["wo"])
+        if cfg.is_mla:
+            from . import mla
+
+            q_eff, q_pe, c_kv, k_pe = mla.mla_q_and_latent(
+                lp, cfg, h, positions, inv_freq, msc
+            )
+            kc = att.write_chunk_to_cache(
+                kc, c_kv[:, None, :], block_table, history_len
+            )
+            vc = att.write_chunk_to_cache(
+                vc, k_pe[:, None, :], block_table, history_len
+            )
+            out_lat = mla.mla_prefill_attention_xla(
+                q_eff, q_pe, kc, vc, block_table, history_len, valid_len,
+                scale,
+            )
+            o = mla._o_proj(lp, cfg, out_lat).astype(x.dtype)
+            x = x + _mm(o, lp["wo"])
+        else:
+            q, k, v = _qkv(lp, cfg, h)
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
+            vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
+            if use_ring:
+                from ..parallel.ring_attention import ring_attention_sharded
+
+                H = q.shape[1]
+                o = ring_attention_sharded(
+                    q, att.repeat_kv(k, H // k.shape[1], axis=1),
+                    att.repeat_kv(v, H // v.shape[1], axis=1),
+                    mesh, scale,
+                )
+            else:
+                o = att.chunk_attention_with_cache(
+                    q, k, v, kc, vc, block_table, history_len, valid_len,
+                    scale, use_pallas=use_pallas, mesh=mesh,
+                    window=cfg.sliding_window,
+                )
+            x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
 
-    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], k_cache, v_cache))
+    x, k_cache, v_cache = _scan_groups(body, x, params, cfg, k_cache, v_cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     # logits for the last *real* token of the chunk
     last = jnp.clip(valid_len - 1, 0, T - 1)
@@ -457,10 +630,16 @@ def _decode_body(
     dominates step time; decode is supposed to stream WEIGHTS, not
     copy the KV pool). Scan remains for compile-time-sensitive very
     deep models (EngineConfig.decode_layer_scan)."""
-    inv_freq = _rope_freqs(cfg)
-    scale = cfg.head_dim**-0.5
     B = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [B, E]
+    if cfg.is_mla:
+        from . import mla as _mla
+
+        inv_freq, msc = _mla.mla_rope_freqs(cfg)
+        scale = cfg.mla_softmax_scale()
+    else:
+        inv_freq = _rope_freqs(cfg)
+        scale = cfg.head_dim**-0.5
 
     def layer_tail(x, lp, o):
         x = x + _mm(o.reshape(B, -1), lp["wo"])
@@ -474,12 +653,48 @@ def _decode_body(
         k = apply_rope(k, positions, inv_freq)
         return q, k, v
 
-    if unroll:
-        blk, off = att.decode_slot_indices(
-            block_tables, positions, k_cache.shape[3]
+    def mla_layer(x, lp, kc_l, vc_l):
+        """One MLA decode layer against full cache layers kc_l/vc_l:
+        write the token's latent, absorbed attention, output fold."""
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
+            lp, cfg, h, positions, inv_freq, msc
         )
-    merged = merged and unroll and use_pallas
-    if merged:
+        # ADJACENT advanced indices (blk, off) stay in place (unlike the
+        # non-MLA [l, :, blk, off] form where the scalar l separates
+        # them): the slice is [1, B, D], so the update is value[None]
+        kc_l = kc_l.at[:, blk, off].set(c_kv[None].astype(kc_l.dtype))
+        vc_l = vc_l.at[:, blk, off].set(k_pe[None].astype(vc_l.dtype))
+        o = _mla.mla_decode_attention_xla(
+            q_eff, q_pe, kc_l, vc_l, block_tables, seq_lens, scale
+        )
+        o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
+        return layer_tail(x, lp, o), kc_l, vc_l
+
+    # slot indices are used by the unrolled paths AND the MLA scan body
+    blk, off = att.decode_slot_indices(
+        block_tables, positions, k_cache.shape[3]
+    )
+    merged = merged and unroll and use_pallas and not cfg.is_mla
+    if cfg.is_mla and unroll:
+        for lps, n, goff in layer_groups(params, cfg):
+            for li in range(n):
+                l = goff + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                x, kc_l, vc_l = mla_layer(x, lp, k_cache[l], v_cache[l])
+                k_cache = k_cache.at[l].set(kc_l)
+                v_cache = v_cache.at[l].set(vc_l)
+    elif cfg.is_mla:
+        def mla_body(carry, layer_in):
+            x = carry
+            lp, kc, vc = layer_in
+            x, kc, vc = mla_layer(x, lp, kc, vc)
+            return x, (kc, vc)
+
+        x, k_cache, v_cache = _scan_groups(
+            mla_body, x, params, cfg, k_cache, v_cache
+        )
+    elif merged:
         # MERGED one-write path (TPU): attention handles the current token
         # out-of-cache (flash merge over the stats-emitting paged kernel),
         # so the cache sees ONE in-place Pallas append per step instead of
@@ -496,24 +711,26 @@ def _decode_body(
 
         hist_lens = seq_lens - 1  # cache contents EXCLUDE the new token
         k_news, v_news = [], []
-        for l in range(cfg.num_layers):
-            lp = jax.tree.map(lambda a: a[l], params["layers"])
-            q, k, v = layer_qkv(x, lp)
-            k_news.append(k)
-            v_news.append(v)
-            if mesh is None:
-                o = att.decode_attention_merged(
-                    q, k, v, k_cache[l], v_cache[l], block_tables,
-                    hist_lens, scale, window=cfg.sliding_window,
-                    interpret=interpret,
-                )
-            else:
-                o = att.decode_attention_merged_sharded(
-                    q, k, v, k_cache[l], v_cache[l], block_tables,
-                    hist_lens, scale, mesh, window=cfg.sliding_window,
-                    interpret=interpret,
-                )
-            x = layer_tail(x, lp, o)
+        for lps, n, goff in layer_groups(params, cfg):
+            for li in range(n):
+                l = goff + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                q, k, v = layer_qkv(x, lp)
+                k_news.append(k)
+                v_news.append(v)
+                if mesh is None:
+                    o = att.decode_attention_merged(
+                        q, k, v, k_cache[l], v_cache[l], block_tables,
+                        hist_lens, scale, window=cfg.sliding_window,
+                        interpret=interpret,
+                    )
+                else:
+                    o = att.decode_attention_merged_sharded(
+                        q, k, v, k_cache[l], v_cache[l], block_tables,
+                        hist_lens, scale, mesh, window=cfg.sliding_window,
+                        interpret=interpret,
+                    )
+                x = layer_tail(x, lp, o)
         k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)
         if mesh is None:
             k_cache, v_cache = kv_cache_append(
@@ -526,22 +743,25 @@ def _decode_body(
                 interpret=interpret,
             )
     elif unroll:
-        for l in range(cfg.num_layers):
-            lp = jax.tree.map(lambda a: a[l], params["layers"])
-            q, k, v = layer_qkv(x, lp)
-            # mixed basic+advanced indexing puts the advanced axes
-            # (blk, off) in front: the update value is [B, Hkv, D]
-            k_cache = k_cache.at[l, :, blk, off].set(
-                k.astype(k_cache.dtype)
-            )
-            v_cache = v_cache.at[l, :, blk, off].set(
-                v.astype(v_cache.dtype)
-            )
-            o = att.decode_attention(
-                q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
-                use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
-            )
-            x = layer_tail(x, lp, o)
+        for lps, n, goff in layer_groups(params, cfg):
+            for li in range(n):
+                l = goff + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                q, k, v = layer_qkv(x, lp)
+                # mixed basic+advanced indexing puts the advanced axes
+                # (blk, off) in front: the update value is [B, Hkv, D]
+                k_cache = k_cache.at[l, :, blk, off].set(
+                    k.astype(k_cache.dtype)
+                )
+                v_cache = v_cache.at[l, :, blk, off].set(
+                    v.astype(v_cache.dtype)
+                )
+                o = att.decode_attention(
+                    q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
+                    use_pallas=use_pallas, mesh=mesh,
+                    window=cfg.sliding_window,
+                )
+                x = layer_tail(x, lp, o)
     else:
         def body(carry, layer_in):
             x = carry
@@ -556,8 +776,8 @@ def _decode_body(
             x = layer_tail(x, lp, o)
             return x, (kc, vc)
 
-        x, (k_cache, v_cache) = lax.scan(
-            body, x, (params["layers"], k_cache, v_cache)
+        x, k_cache, v_cache = _scan_groups(
+            body, x, params, cfg, k_cache, v_cache
         )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)  # [B, V]
@@ -710,6 +930,11 @@ def _verify_forward(
         kv_cache_append_tokens_sharded,
     )
 
+    if cfg.is_mla:
+        raise NotImplementedError(
+            "speculative verify is gated off for MLA models (the engine "
+            "routes them to plain decode windows)"
+        )
     T = n_spec + 1
     B, E = tokens.shape[0], cfg.hidden_size
     inv_freq = _rope_freqs(cfg)
@@ -719,28 +944,32 @@ def _verify_forward(
     x = _embed(params, cfg, tokens.reshape(-1)).reshape(B, T, E)
 
     k_news, v_news = [], []
-    for l in range(cfg.num_layers):
-        lp = jax.tree.map(lambda a: a[l], params["layers"])
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
-        q = apply_rope(q, pos_bt, inv_freq)
-        k = apply_rope(k, pos_bt, inv_freq)
-        k_news.append(k)
-        v_news.append(v)
-        if use_pallas and mesh is not None:
-            o = att.verify_attention_sharded(
-                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                scale, mesh, use_pallas=True, interpret=interpret,
+    for lps, ng, goff in layer_groups(params, cfg):
+        for li in range(ng):
+            l = goff + li
+            lp = jax.tree.map(lambda a: a[li], lps)
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
+            q = apply_rope(q, pos_bt, inv_freq)
+            k = apply_rope(k, pos_bt, inv_freq)
+            k_news.append(k)
+            v_news.append(v)
+            if use_pallas and mesh is not None:
+                o = att.verify_attention_sharded(
+                    q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+                    scale, mesh, use_pallas=True, interpret=interpret,
+                )
+            else:
+                o = att.verify_attention(
+                    q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
+                    scale, use_pallas=use_pallas, window=cfg.sliding_window,
+                    interpret=interpret,
+                )
+            x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
+                B, T, E
             )
-        else:
-            o = att.verify_attention(
-                q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                scale, use_pallas=use_pallas, window=cfg.sliding_window,
-                interpret=interpret,
-            )
-        x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(B, T, E)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
 
@@ -889,27 +1118,84 @@ def verify_window(
 
 def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """Straight full-attention forward [T] -> logits [T, V]; ground truth
-    for paged-path equivalence tests."""
-    inv_freq = _rope_freqs(cfg)
-    scale = cfg.head_dim**-0.5
+    for paged-path equivalence tests. MLA models run the NAIVE
+    (non-absorbed) formulation — reconstruct per-head K/V from latents —
+    which the absorbed paged path must match."""
     T = tokens.shape[0]
     x = _embed(params, cfg, tokens)
     positions = jnp.arange(T)
+    if cfg.is_mla:
+        from . import mla as _mla
+
+        inv_freq, msc = _mla.mla_rope_freqs(cfg)
+        scale = cfg.mla_softmax_scale()
+    else:
+        inv_freq = _rope_freqs(cfg)
+        scale = cfg.head_dim**-0.5
 
     def body(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        o = att.prefill_attention_xla(
-            q, k, v, positions, jnp.int32(T), scale,
-            window=cfg.sliding_window,
-        )
-        x = x + _mm(o.reshape(T, -1), lp["wo"])
+        if cfg.is_mla:
+            # DELIBERATELY independent of mla.mla_q_and_latent: this is
+            # the ground-truth NAIVE formulation (reconstruct full K/V,
+            # no absorption) the absorbed paged path is validated
+            # against — sharing the projection code would make the
+            # equivalence tests circular. External anchor: the HF parity
+            # tests (tests/test_hf_parity.py deepseek v2/v3).
+            from . import mla as _mla
+
+            H, dn, dr = cfg.num_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+            if cfg.q_lora_rank:
+                q = _mm(rms_norm(_mm(h, lp["wq_a"]), lp["q_norm"],
+                                 cfg.rms_norm_eps), lp["wq_b"])
+            else:
+                q = _mm(h, lp["wq"])
+            q = q.reshape(T, H, dn + dr)
+            q_nope, q_pe = q[..., :dn], q[..., dn:]
+            q_pe = _mla.rope_rotate(q_pe, positions, inv_freq, msc)
+            kv = _mm(h, lp["wkv_a"])
+            c_kv = rms_norm(kv[..., : cfg.kv_lora_rank], lp["kv_norm"],
+                            cfg.rms_norm_eps)
+            k_pe = _mla.rope_rotate(
+                kv[..., cfg.kv_lora_rank:][:, None, :], positions,
+                inv_freq, msc,
+            )[:, 0, :]
+            w_kc, w_vc = _mla._wkv_b_parts(lp, cfg)
+            # naive reconstruction: per-head K/V from the latent
+            k_nope = jnp.einsum("tc,chd->thd", c_kv.astype(jnp.float32),
+                                w_kc.astype(jnp.float32))
+            v = jnp.einsum("tc,chd->thd", c_kv.astype(jnp.float32),
+                           w_vc.astype(jnp.float32))
+            qf = jnp.concatenate(
+                [q_nope.astype(jnp.float32),
+                 q_pe.astype(jnp.float32)], axis=-1,
+            )
+            kf = jnp.concatenate(
+                [k_nope,
+                 jnp.broadcast_to(k_pe[:, None, :].astype(jnp.float32),
+                                  (T, H, dr))], axis=-1,
+            )
+            s = jnp.einsum("thd,shd->hts", qf * scale, kf)
+            causal = positions[:, None] >= positions[None, :]
+            s = jnp.where(causal[None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("hts,shd->thd", p, v)
+            o = o.reshape(T, -1).astype(x.dtype)
+            x = x + _mm(o, lp["wo"])
+        else:
+            q, k, v = _qkv(lp, cfg, h)
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            o = att.prefill_attention_xla(
+                q, k, v, positions, jnp.int32(T), scale,
+                window=cfg.sliding_window,
+            )
+            x = x + _mm(o.reshape(T, -1), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h)
         return x, None
 
-    x, _ = lax.scan(body, x, params["layers"])
+    for lps, _n, _off in layer_groups(params, cfg):
+        x, _ = lax.scan(body, x, lps)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _logits(params, cfg, x)

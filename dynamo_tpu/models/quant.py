@@ -34,7 +34,10 @@ KV_CACHE_DTYPES = ("model", "float8_e4m3", "bfloat16")
 # layout, contraction on axis -2); embeddings/norms/biases/router stay
 # high-precision (tiny, or quality-critical), expert stacks stay bf16
 _QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-               "shared_gate", "shared_up", "shared_down")
+               "shared_gate", "shared_up", "shared_down",
+               # MLA projections (mla._wkv_b_parts dequants wkv_b for
+               # the absorbed fold; the rest ride _mm's fused dequant)
+               "wq_a", "wq_b", "wkv_a", "wkv_b")
 
 
 def _qdtype(mode: str):
@@ -70,12 +73,15 @@ def quantize_params(params: dict, cfg: ModelConfig, mode: str) -> dict:
         return params
     if mode not in WEIGHT_MODES:
         raise ValueError(f"quantization must be one of {WEIGHT_MODES}")
-    layers = dict(params["layers"])
-    for key in _QUANT_KEYS:
-        if key in layers and not isinstance(layers[key], dict):  # idempotent
-            layers[key] = quantize_array(layers[key], mode)
     out = dict(params)
-    out["layers"] = layers
+    for grp in ("layers", "dense_layers"):
+        if grp not in params:
+            continue
+        layers = dict(params[grp])
+        for key in _QUANT_KEYS:
+            if key in layers and not isinstance(layers[key], dict):
+                layers[key] = quantize_array(layers[key], mode)  # idempotent
+        out[grp] = layers
     return out
 
 

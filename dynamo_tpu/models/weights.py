@@ -34,18 +34,10 @@ def load_llama_params(
     mesh=None,
     dtype: Optional[str] = None,
 ) -> dict:
-    """Load a HF llama-family checkpoint directory into the stacked pytree
-    used by dynamo_tpu.models.llama."""
-    if cfg.is_moe and cfg.first_dense_layers:
-        # DeepSeek first_k_dense_replace: leading dense layers in an
-        # otherwise-MoE stack. The stacked-scan pytree is homogeneous;
-        # heterogeneous stacks need the split-scan model variant
-        # (tracked follow-up) — fail loudly instead of KeyError soup.
-        raise NotImplementedError(
-            f"checkpoint has {cfg.first_dense_layers} leading dense "
-            "layers (first_k_dense_replace); mixed dense/MoE stacks "
-            "are not supported yet"
-        )
+    """Load a HF llama-family or DeepSeek-MLA checkpoint directory into
+    the stacked pytree used by dynamo_tpu.models.llama. DeepSeek's
+    first_k_dense_replace leading dense layers land in a second stacked
+    group (``dense_layers``) that the forward scans separately."""
     from safetensors import safe_open
 
     dt = _np_dtype(dtype or str(cfg.dtype))
@@ -78,33 +70,109 @@ def load_llama_params(
 
     L = cfg.num_layers
 
-    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
+    def stack(fmt: str, rng, transpose: bool = True) -> np.ndarray:
         mats = []
-        for i in range(L):
+        for i in rng:
             t = get(fmt.format(i=i))
             mats.append(t.T if transpose else t)
         return np.stack(mats)
 
-    layers: dict = {
-        "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
-        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
-        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
-        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
-        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
-        "mlp_norm": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False),
-    }
-    if cfg.is_moe:
-        X = cfg.num_experts
+    def has(name: str) -> bool:
+        return name in name_to_file
 
-        def has(name: str) -> bool:
-            return name in name_to_file
+    def deinterleave_rope(w: np.ndarray, n_head: int, d_head: int,
+                          d_rope: int) -> np.ndarray:
+        """DeepSeek stores rope dims interleaved (GPT-J pairs); reorder
+        the rope columns of a [in, n_head*d_head] projection (rope dims
+        are the LAST d_rope of each head) to the half-split layout the
+        runtime rotation uses."""
+        if not cfg.rope_interleave:
+            return w
+        v = w.reshape(w.shape[0], n_head, d_head)
+        rope = v[..., d_head - d_rope:]
+        perm = np.concatenate(
+            [np.arange(0, d_rope, 2), np.arange(1, d_rope, 2)]
+        )
+        v = np.concatenate([v[..., : d_head - d_rope], rope[..., perm]], -1)
+        return v.reshape(w.shape)
+
+    def attn_leaves(rng) -> dict:
+        out = {
+            "attn_norm": stack("model.layers.{i}.input_layernorm.weight",
+                               rng, transpose=False),
+            "mlp_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                rng, transpose=False,
+            ),
+        }
+        if cfg.is_mla:
+            dqk, dr = cfg.qk_head_dim, cfg.qk_rope_head_dim
+            H = cfg.num_heads
+            if cfg.q_lora_rank:
+                out["wq_a"] = stack(
+                    "model.layers.{i}.self_attn.q_a_proj.weight", rng
+                )
+                out["q_norm"] = stack(
+                    "model.layers.{i}.self_attn.q_a_layernorm.weight",
+                    rng, transpose=False,
+                )
+                wq_b = stack("model.layers.{i}.self_attn.q_b_proj.weight", rng)
+                out["wq_b"] = np.stack(
+                    [deinterleave_rope(w, H, dqk, dr) for w in wq_b]
+                )
+            else:
+                wq = stack("model.layers.{i}.self_attn.q_proj.weight", rng)
+                out["wq"] = np.stack(
+                    [deinterleave_rope(w, H, dqk, dr) for w in wq]
+                )
+            wkv_a = stack(
+                "model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight", rng
+            )
+            # rope dims are the trailing d_rope columns (one "head")
+            out["wkv_a"] = np.stack(
+                [
+                    deinterleave_rope(w, 1, cfg.kv_lora_rank + dr, dr)
+                    for w in wkv_a
+                ]
+            )
+            out["kv_norm"] = stack(
+                "model.layers.{i}.self_attn.kv_a_layernorm.weight",
+                rng, transpose=False,
+            )
+            out["wkv_b"] = stack(
+                "model.layers.{i}.self_attn.kv_b_proj.weight", rng
+            )
+            out["wo"] = stack("model.layers.{i}.self_attn.o_proj.weight", rng)
+        else:
+            out["wq"] = stack("model.layers.{i}.self_attn.q_proj.weight", rng)
+            out["wk"] = stack("model.layers.{i}.self_attn.k_proj.weight", rng)
+            out["wv"] = stack("model.layers.{i}.self_attn.v_proj.weight", rng)
+            out["wo"] = stack("model.layers.{i}.self_attn.o_proj.weight", rng)
+            if cfg.attention_bias:
+                out["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias",
+                                  rng, transpose=False)
+                out["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias",
+                                  rng, transpose=False)
+                out["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias",
+                                  rng, transpose=False)
+        return out
+
+    def dense_ffn_leaves(rng) -> dict:
+        return {
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", rng),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", rng),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", rng),
+        }
+
+    def moe_ffn_leaves(rng) -> dict:
+        X = cfg.num_experts
 
         def stack_experts(mix_fmt: str, ds_fmt: str) -> np.ndarray:
             """[L, X, in, out] from per-expert tensors; supports Mixtral
             (block_sparse_moe.experts.N.w1/w3/w2) and DeepSeek/Qwen-MoE
             (mlp.experts.N.gate/up/down_proj) naming."""
             out = []
-            for i in range(L):
+            for i in rng:
                 fmt = mix_fmt if has(mix_fmt.format(i=i, x=0)) else ds_fmt
                 out.append(
                     np.stack([get(fmt.format(i=i, x=x)).T for x in range(X)])
@@ -113,47 +181,59 @@ def load_llama_params(
 
         gate_mix = "model.layers.{i}.block_sparse_moe.gate.weight"
         gate_ds = "model.layers.{i}.mlp.gate.weight"
-        layers["moe_gate"] = np.stack(
-            [
-                get((gate_mix if has(gate_mix.format(i=i)) else gate_ds).format(i=i)).T
-                for i in range(L)
-            ]
-        )
-        layers["we_gate"] = stack_experts(
-            "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
-            "model.layers.{i}.mlp.experts.{x}.gate_proj.weight",
-        )
-        layers["we_up"] = stack_experts(
-            "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
-            "model.layers.{i}.mlp.experts.{x}.up_proj.weight",
-        )
-        layers["we_down"] = stack_experts(
-            "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
-            "model.layers.{i}.mlp.experts.{x}.down_proj.weight",
-        )
+        out = {
+            "moe_gate": np.stack(
+                [
+                    get((gate_mix if has(gate_mix.format(i=i))
+                         else gate_ds).format(i=i)).T
+                    for i in rng
+                ]
+            ),
+            "we_gate": stack_experts(
+                "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
+                "model.layers.{i}.mlp.experts.{x}.gate_proj.weight",
+            ),
+            "we_up": stack_experts(
+                "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
+                "model.layers.{i}.mlp.experts.{x}.up_proj.weight",
+            ),
+            "we_down": stack_experts(
+                "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
+                "model.layers.{i}.mlp.experts.{x}.down_proj.weight",
+            ),
+        }
+        if cfg.moe_gate_bias:
+            out["moe_gate_bias"] = stack(
+                "model.layers.{i}.mlp.gate.e_score_correction_bias",
+                rng, transpose=False,
+            ).astype(np.float32)
         if cfg.num_shared_experts:
-            layers["shared_gate"] = stack(
-                "model.layers.{i}.mlp.shared_experts.gate_proj.weight"
+            out["shared_gate"] = stack(
+                "model.layers.{i}.mlp.shared_experts.gate_proj.weight", rng
             )
-            layers["shared_up"] = stack(
-                "model.layers.{i}.mlp.shared_experts.up_proj.weight"
+            out["shared_up"] = stack(
+                "model.layers.{i}.mlp.shared_experts.up_proj.weight", rng
             )
-            layers["shared_down"] = stack(
-                "model.layers.{i}.mlp.shared_experts.down_proj.weight"
+            out["shared_down"] = stack(
+                "model.layers.{i}.mlp.shared_experts.down_proj.weight", rng
             )
-    else:
-        layers["w_gate"] = stack("model.layers.{i}.mlp.gate_proj.weight")
-        layers["w_up"] = stack("model.layers.{i}.mlp.up_proj.weight")
-        layers["w_down"] = stack("model.layers.{i}.mlp.down_proj.weight")
+        return out
+
+    kd = cfg.first_dense_layers if cfg.is_moe else 0
+    layers: dict = attn_leaves(range(kd, L))
+    layers.update(
+        moe_ffn_leaves(range(kd, L)) if cfg.is_moe
+        else dense_ffn_leaves(range(kd, L))
+    )
     params: dict = {
         "embed": get("model.embed_tokens.weight"),
         "final_norm": get("model.norm.weight"),
         "layers": layers,
     }
-    if cfg.attention_bias:
-        params["layers"]["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
-        params["layers"]["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False)
-        params["layers"]["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False)
+    if kd:
+        dense = attn_leaves(range(0, kd))
+        dense.update(dense_ffn_leaves(range(0, kd)))
+        params["dense_layers"] = dense
     if not cfg.tie_word_embeddings:
         params["lm_head"] = get("lm_head.weight").T
 
@@ -183,17 +263,18 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
     fixture generation)."""
     from safetensors.numpy import save_file
 
+    if cfg is not None and getattr(cfg, "rope_interleave", False):
+        raise NotImplementedError(
+            "saving back to the interleaved-rope checkpoint convention "
+            "is not supported (the loader de-interleaved at load)"
+        )
     flat: dict[str, np.ndarray] = {}
-    L = params["layers"]["wq"].shape[0]
-    lay = dict(params["layers"])
+    flat["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
     final_norm = params["final_norm"]
     if cfg is not None and getattr(cfg, "rms_add_unit", False):
         # inverse of the load-time (1 + w) fold: gemma checkpoints store
         # norm OFFSETS
-        lay["attn_norm"] = lay["attn_norm"] - 1.0
-        lay["mlp_norm"] = lay["mlp_norm"] - 1.0
         final_norm = final_norm - 1.0
-    flat["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
     flat["model.norm.weight"] = np.asarray(final_norm, np.float32)
     names = {
         "attn_norm": ("model.layers.{i}.input_layernorm.weight", False),
@@ -205,39 +286,64 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
         "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
         "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
         "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+        # MLA (models/mla.py)
+        "wq_a": ("model.layers.{i}.self_attn.q_a_proj.weight", True),
+        "q_norm": ("model.layers.{i}.self_attn.q_a_layernorm.weight", False),
+        "wq_b": ("model.layers.{i}.self_attn.q_b_proj.weight", True),
+        "wkv_a": ("model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight", True),
+        "kv_norm": ("model.layers.{i}.self_attn.kv_a_layernorm.weight", False),
+        "wkv_b": ("model.layers.{i}.self_attn.kv_b_proj.weight", True),
+        "moe_gate_bias": (
+            "model.layers.{i}.mlp.gate.e_score_correction_bias", False
+        ),
     }
-    for key, (fmt, transpose) in names.items():
-        if key not in lay:
-            continue
-        for i in range(L):
-            t = np.asarray(lay[key][i], np.float32)
-            flat[fmt.format(i=i)] = t.T.copy() if transpose else t
-    if "we_gate" in lay:  # MoE: Mixtral naming (shared experts: DeepSeek's)
-        X = lay["we_gate"].shape[1]
-        expert_names = {
-            "we_gate": "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
-            "we_up": "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
-            "we_down": "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
-        }
-        shared_names = {
-            "shared_gate": "model.layers.{i}.mlp.shared_experts.gate_proj.weight",
-            "shared_up": "model.layers.{i}.mlp.shared_experts.up_proj.weight",
-            "shared_down": "model.layers.{i}.mlp.shared_experts.down_proj.weight",
-        }
-        for i in range(L):
-            flat[f"model.layers.{i}.block_sparse_moe.gate.weight"] = np.asarray(
-                lay["moe_gate"][i], np.float32
-            ).T.copy()
-            for key, fmt in expert_names.items():
-                for x in range(X):
-                    flat[fmt.format(i=i, x=x)] = np.asarray(
-                        lay[key][i, x], np.float32
-                    ).T.copy()
-            for key, fmt in shared_names.items():
-                if key in lay:
-                    flat[fmt.format(i=i)] = np.asarray(
-                        lay[key][i], np.float32
-                    ).T.copy()
+
+    def save_group(lay: dict, n: int, off: int) -> None:
+        lay = dict(lay)
+        if cfg is not None and getattr(cfg, "rms_add_unit", False):
+            lay["attn_norm"] = lay["attn_norm"] - 1.0
+            lay["mlp_norm"] = lay["mlp_norm"] - 1.0
+        for key, (fmt, transpose) in names.items():
+            if key not in lay:
+                continue
+            for li in range(n):
+                t = np.asarray(lay[key][li], np.float32)
+                flat[fmt.format(i=off + li)] = t.T.copy() if transpose else t
+        if "we_gate" in lay:  # MoE: Mixtral naming (shared: DeepSeek's)
+            X = lay["we_gate"].shape[1]
+            expert_names = {
+                "we_gate": "model.layers.{i}.block_sparse_moe.experts.{x}.w1.weight",
+                "we_up": "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
+                "we_down": "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
+            }
+            shared_names = {
+                "shared_gate": "model.layers.{i}.mlp.shared_experts.gate_proj.weight",
+                "shared_up": "model.layers.{i}.mlp.shared_experts.up_proj.weight",
+                "shared_down": "model.layers.{i}.mlp.shared_experts.down_proj.weight",
+            }
+            for li in range(n):
+                i = off + li
+                flat[f"model.layers.{i}.block_sparse_moe.gate.weight"] = (
+                    np.asarray(lay["moe_gate"][li], np.float32).T.copy()
+                )
+                for key, fmt in expert_names.items():
+                    for x in range(X):
+                        flat[fmt.format(i=i, x=x)] = np.asarray(
+                            lay[key][li, x], np.float32
+                        ).T.copy()
+                for key, fmt in shared_names.items():
+                    if key in lay:
+                        flat[fmt.format(i=i)] = np.asarray(
+                            lay[key][li], np.float32
+                        ).T.copy()
+
+    kd = 0
+    if "dense_layers" in params:
+        kd = params["dense_layers"]["attn_norm"].shape[0]
+        save_group(params["dense_layers"], kd, 0)
+    save_group(
+        params["layers"], params["layers"]["attn_norm"].shape[0], kd
+    )
     if "lm_head" in params:
         flat["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
     save_file(flat, os.path.join(path, "model.safetensors"))

@@ -93,7 +93,25 @@ _PARAM_SPECS = {
     "layers.shared_gate": P("pp", None, "tp"),
     "layers.shared_up": P("pp", None, "tp"),
     "layers.shared_down": P("pp", "tp", None),
+    # MLA (models/mla.py): the q/kv down-projections and the shared
+    # latent stream are small and replicated; head-parallel tp lives in
+    # the q up-projection columns and the o row
+    "layers.wq_a": P("pp", None, None),
+    "layers.q_norm": P("pp", None),
+    "layers.wq_b": P("pp", None, "tp"),
+    "layers.wkv_a": P("pp", None, None),
+    "layers.kv_norm": P("pp", None),
+    "layers.wkv_b": P("pp", None, "tp"),
+    "layers.moe_gate_bias": P("pp", None),
 }
+
+
+def _spec_alias(prefix: str) -> str:
+    """DeepSeek's leading dense group (``dense_layers.*``) shares the
+    stacked-layer placement rules."""
+    if prefix.startswith("dense_layers."):
+        return "layers." + prefix[len("dense_layers."):]
+    return prefix
 
 
 def _spec_for(prefix: str) -> P:
@@ -101,6 +119,7 @@ def _spec_for(prefix: str) -> P:
     ``{"q", "s"}`` under the weight's path: q keeps the parent's spec
     ([..., in, out] layout unchanged), s ([..., out], the contraction
     axis dropped) keeps every parent axis except the second-to-last."""
+    prefix = _spec_alias(prefix)
     if prefix in _PARAM_SPECS:
         return _PARAM_SPECS[prefix]
     parent = prefix.rsplit(".", 1)[0] if "." in prefix else ""
@@ -139,13 +158,33 @@ def param_sharding(mesh: Mesh) -> dict:
     return build
 
 
+def fit_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Drop the LAYER-STACK axis ("pp") from a spec when the group is too
+    short to divide it (DeepSeek's 1-3 dense_layers) — replicate those
+    few layers' weights instead of failing placement. Deliberately
+    narrow: a non-dividing tp/ep axis still fails LOUDLY at device_put
+    (silent replication of multi-GB weight shards would surface only as
+    a mystery OOM far from the misconfigured mesh)."""
+    out = []
+    for i, ax in enumerate(spec):
+        if ax == "pp" and i < len(shape) and (
+            shape[i] % mesh.shape.get("pp", 1) != 0
+        ):
+            out.append(None)
+        else:
+            out.append(ax)
+    return P(*out)
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the placement rules."""
 
     def walk(leafs, specs):
         if isinstance(leafs, dict):
             return {k: walk(v, specs[k]) for k, v in leafs.items()}
-        return jax.device_put(leafs, NamedSharding(mesh, specs))
+        return jax.device_put(
+            leafs, NamedSharding(mesh, fit_spec(specs, leafs.shape, mesh))
+        )
 
     return walk(params, spec_tree(params))
 
@@ -153,11 +192,15 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
     """[L, Hkv, num_blocks, block_size, D]: layer axis shards over pp
     (stage-local KV), kv heads over tp — each when divisible, else
-    replicated on that axis."""
+    replicated on that axis. MLA's latent cache is single-"head"
+    (MQA-shaped — every query head reads the same latent stream), so it
+    replicates over tp; tp parallelism lives in the query heads."""
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape["tp"]
     l_ax = "pp" if pp > 1 and cfg.num_layers % pp == 0 else None
-    h_ax = "tp" if cfg.num_kv_heads % tp == 0 else None
+    h_ax = (
+        "tp" if not cfg.is_mla and cfg.num_kv_heads % tp == 0 else None
+    )
     return NamedSharding(mesh, P(l_ax, h_ax, None, None, None))
 
 

@@ -199,11 +199,10 @@ class StepMirror:
         from ..models import llama
 
         cfg = self.model_cfg
-        shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size,
-                 cfg.head_dim)
+        ks, vs = llama.kv_cache_shapes(cfg, num_blocks, block_size)
         dt = dtype or llama._dtype(cfg)
         make = jax.jit(
-            lambda: (jnp.zeros(shape, dt), jnp.zeros(shape, dt)),
+            lambda: (jnp.zeros(ks, dt), jnp.zeros(vs, dt)),
             out_shardings=(self._cache_sh, self._cache_sh),
         )
         return make()
@@ -522,20 +521,22 @@ class StepMirror:
         )
 
     def lead_offload_restore(self, k_cache, v_cache, idxs, take_hashes,
-                             k_pieces, v_pieces, global_shape,
+                             k_pieces, v_pieces, k_shape, v_shape,
                              drop_hashes=()):
         """Mirror an offload-tier restore: every process rebuilds the
-        sharded block stack from its own host pieces and runs the same
-        scatter. ``drop_hashes`` piggybacks deferred follower-tier drops
-        (leader-side unreserve evictions, see OffloadManager)."""
+        sharded block stacks from its own host pieces and runs the same
+        scatter. k/v global shapes are passed separately — MLA's latent
+        caches have different trailing dims. ``drop_hashes`` piggybacks
+        deferred follower-tier drops (leader-side unreserve evictions,
+        see OffloadManager)."""
         self._lead(
             "offload_restore",
             (np.asarray(idxs, np.int32),
              np.asarray(take_hashes, np.uint64),
              np.asarray(list(drop_hashes), np.uint64)),
         )
-        kg = self.pieces_to_global(k_pieces, global_shape)
-        vg = self.pieces_to_global(v_pieces, global_shape)
+        kg = self.pieces_to_global(k_pieces, k_shape)
+        vg = self.pieces_to_global(v_pieces, v_shape)
         return self._kv_scatter_fn()(
             k_cache, v_cache, self.to_global(np.asarray(idxs, np.int32)),
             kg, vg,
@@ -895,14 +896,18 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
             entries = [host_tier.pop(h) for h in take_hashes.tolist()]
             k_pieces = stack_pieces(entries, 0)
             v_pieces = stack_pieces(entries, 1)
+
             # global stack shape = cache dims with the block axis =
-            # the UNPADDED entry count (the scatter core pads on device)
-            gs = (k_cache.shape[0], k_cache.shape[1], len(entries),
-                  k_cache.shape[3], k_cache.shape[4])
+            # the UNPADDED entry count (the scatter core pads on
+            # device); k/v differ for MLA's latent caches
+            def gs(cache):
+                return (cache.shape[0], cache.shape[1], len(entries),
+                        cache.shape[3], cache.shape[4])
+
             k_cache, v_cache = mirror._kv_scatter_fn()(
                 k_cache, v_cache, g(idxs),
-                mirror.pieces_to_global(k_pieces, gs),
-                mirror.pieces_to_global(v_pieces, gs),
+                mirror.pieces_to_global(k_pieces, gs(k_cache)),
+                mirror.pieces_to_global(v_pieces, gs(v_cache)),
             )
         elif op == "kv_gather_full":
             (idxs,) = arrays

@@ -68,6 +68,7 @@ def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
     return (
         pp > 1
         and not cfg.is_moe
+        and not cfg.is_mla  # MLA runs the absorbed-latent scan path
         and cfg.num_layers % pp == 0
         and n_micro >= 1
         and T % n_micro == 0

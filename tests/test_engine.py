@@ -600,20 +600,22 @@ def test_frequency_penalty_breaks_greedy_loops(run):
     async def main():
         cfg = EngineConfig(
             model=ModelConfig.tiny(), num_blocks=64, block_size=4,
-            max_batch_size=2, decode_window=4,
+            max_batch_size=2, max_context=128, decode_window=4,
         )
         engine = JaxEngine(cfg, seed=0)
+        # long enough that the random tiny model's greedy rollout enters
+        # a cycle (short rollouts may not loop for every init seed)
         plain = await collect(
-            engine.generate(Context(_pen_req(range(10, 20), max_tokens=16)))
+            engine.generate(Context(_pen_req(range(10, 20), max_tokens=48)))
         )
         pen = await collect(
             engine.generate(Context(_pen_req(
-                range(10, 20), max_tokens=16, frequency_penalty=5.0
+                range(10, 20), max_tokens=48, frequency_penalty=5.0
             )))
         )
         toks_plain = [t for o in plain for t in o.token_ids]
         toks_pen = [t for o in pen for t in o.token_ids]
-        assert len(toks_pen) == 16
+        assert len(toks_pen) == 48
 
         def max_mult(toks):
             return max(toks.count(t) for t in set(toks))

@@ -69,7 +69,10 @@ def test_http_logprobs_n_and_penalties(tmp_path):
         assert len(lp["tokens"]) == 5
         assert len(lp["token_logprobs"]) == 5
         assert all(v <= 0.0 for v in lp["token_logprobs"])
-        assert all(len(t) == 2 for t in lp["top_logprobs"])
+        # distinct alternate ids may decode to the same string; the
+        # block keeps the max logprob for colliding keys, so entries
+        # hold 1..k alternates
+        assert all(1 <= len(t) <= 2 for t in lp["top_logprobs"])
 
         # n=2 sampled chat choices (folded): two indexed choices + usage
         out = _post(port, "/v1/chat/completions", {

@@ -342,3 +342,54 @@ def test_completion_logprobs_block_dedup_and_offsets():
     assert block["top_logprobs"][0] == {"he": -0.1, " ": -1.5}
     # offsets: start at the caller's running offset, advance by token text
     assert block["text_offset"] == [4, 6]
+
+
+def test_n_fanout_dedupes_prefill(run):
+    """VERDICT r2 #8: n>1 must not race n identical prefills — choice 0's
+    prefill runs first, siblings admit through the prefix cache. With
+    n=4 and a 16-token prompt (4 full hashed blocks), the engine must
+    count exactly 3 sibling prefix hits."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.openai import CompletionRequest
+    from dynamo_tpu.runtime import Context, collect
+
+    async def main():
+        engine = JaxEngine(
+            EngineConfig(
+                model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+                max_batch_size=4, max_context=64, prefill_chunk=16,
+            ),
+            seed=0,
+        )
+        pre = OpenAIPreprocessor(ByteTokenizer())
+        req = CompletionRequest.from_dict({
+            "model": "m",
+            "prompt": "abcdabcdabcdabcd",  # 16 byte tokens = 4 blocks
+            "n": 4,
+            "max_tokens": 4,
+            "seed": 3,
+            "temperature": 0.8,
+        })
+        items = await collect(pre.generate(Context(req), engine))
+        chunks = [a.data for a in items if isinstance(a.data, dict)]
+        indexes = {
+            c["choices"][0]["index"] for c in chunks if c.get("choices")
+        }
+        assert indexes == {0, 1, 2, 3}
+        # choice 0 prefills cold (0 hits); each sibling hits the hashed
+        # prefix = the prompt's full blocks excluding its final token
+        # (the tokenizer may add BOS, so derive from the reported count)
+        usage = [c for c in chunks if c.get("usage")][-1]["usage"]
+        p = usage["prompt_tokens"]
+        expect = 3 * (((p - 1) // 4) * 4)
+        assert engine.stats["prefix_cache_hits_tokens"] == expect, (
+            p, engine.stats
+        )
+        await engine.close()
+
+    run(main())

@@ -51,13 +51,38 @@ def test_moe_shared_experts_roundtrip(tmp_path):
     )
 
 
-def test_first_dense_layers_guard(tmp_path):
-    cfg = ModelConfig.tiny(
-        dtype="float32", num_experts=4, moe_intermediate_size=32,
-        first_dense_layers=1,
+def test_first_dense_layers_roundtrip(tmp_path):
+    """DeepSeek first_k_dense_replace: the heterogeneous dense->MoE stack
+    saves/loads through the two-group pytree (was a NotImplementedError
+    guard before round 3)."""
+    _roundtrip(
+        tmp_path,
+        ModelConfig.tiny(
+            dtype="float32", num_layers=3, num_experts=4,
+            num_experts_per_tok=2, moe_intermediate_size=32,
+            first_dense_layers=1,
+        ),
     )
-    with pytest.raises(NotImplementedError):
-        load_llama_params(str(tmp_path / "missing"), cfg)
+
+
+def test_mla_roundtrip(tmp_path):
+    """MLA (DeepSeek-V2/V3) attention weights roundtrip, q_lora and
+    direct-q variants."""
+    _roundtrip(
+        tmp_path,
+        ModelConfig.tiny(
+            dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24,
+        ),
+    )
+    _roundtrip(
+        tmp_path,
+        ModelConfig.tiny(
+            dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        ),
+    )
 
 
 def test_moe_config_from_hf():
