@@ -10,6 +10,16 @@ replicas against the child processes it owns, and spawns/kills/restarts
 to match — crash-restart with exponential backoff, queue-depth
 autoscaling, and a status subresource written back next to each spec.
 
+Multi-host engines (BASELINE config 4: one logical worker spanning 2
+TPU-VM hosts) are first-class: a service with ``num_nodes > 1`` expands
+every replica into ``num_nodes`` rank processes placed on ``hosts[k %
+len(hosts)]`` through a pluggable :class:`HostLauncher` (local
+subprocess for the dev fleet, :class:`SshLauncher` for real hosts, fakes
+in tests). Rank processes get ``DYN_NODE_RANK / DYN_NUM_NODES /
+DYN_COORDINATOR`` env so ``dynamo_run --num-nodes`` style workers can
+join the jax.distributed runtime, and a rank crash restarts the WHOLE
+replica group — SPMD lockstep cannot survive a lone rank respawn.
+
 The manifest renderer (manifests.py) remains the GitOps path for real
 k8s clusters; this controller is the single-host / dev-fleet reconciler
 the api-server can host directly (``ApiServer(..., reconcile=True)``).
@@ -38,13 +48,69 @@ class _Replica:
     started_at: float = field(default_factory=time.monotonic)
 
 
+class LocalLauncher:
+    """Spawn rank processes as local children (the dev-fleet default);
+    ``host`` is ignored."""
+
+    def spawn(self, host: str, name: str, svc: ServiceDeploymentSpec,
+              replica: int, rank: int, extra_env: dict):
+        env = os.environ.copy()
+        env.update(svc.env)
+        env.update(extra_env)
+        cmd = svc.command or [sys.executable, "-c", "import time; time.sleep(1e9)"]
+        logger.info(
+            "spawning %s/%s[%d.%d] on %s: %s",
+            name, svc.name, replica, rank, host or "local", cmd,
+        )
+        return subprocess.Popen(cmd, env=env)
+
+
+class SshLauncher:
+    """Spawn rank processes on remote hosts over ssh (agent-less fleet
+    path — a TPU-VM pool reachable by hostname). The returned Popen is
+    the LOCAL ssh client: poll() tracks the remote command's exit,
+    terminate() drops the connection (with ``-tt`` the remote side gets
+    SIGHUP and dies with it). env rides the remote command line —
+    values are shell-quoted."""
+
+    def __init__(self, user: str = "", ssh_opts: Optional[list[str]] = None):
+        self.user = user
+        self.ssh_opts = ssh_opts or ["-o", "BatchMode=yes"]
+
+    def spawn(self, host: str, name: str, svc: ServiceDeploymentSpec,
+              replica: int, rank: int, extra_env: dict):
+        import shlex
+
+        env = dict(svc.env)
+        env.update(extra_env)
+        assigns = " ".join(
+            f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+        )
+        cmd = svc.command or ["sleep", "infinity"]
+        remote = f"env {assigns} {' '.join(shlex.quote(c) for c in cmd)}"
+        target = f"{self.user}@{host}" if self.user else host
+        logger.info(
+            "ssh-spawning %s/%s[%d.%d] on %s", name, svc.name, replica,
+            rank, target,
+        )
+        # stdin=DEVNULL: concurrent rank clients must not contend for the
+        # controller's terminal (-tt still forces a remote pty so a
+        # dropped connection SIGHUPs the remote command)
+        return subprocess.Popen(
+            ["ssh", "-tt", *self.ssh_opts, target, remote],
+            stdin=subprocess.DEVNULL,
+        )
+
+
 class DeploymentController:
     """Reconciles DeploymentStore specs into running child processes.
 
-    ``spawn`` is injectable (tests use fakes): called with
-    (deployment_name, service_spec, replica_index) and must return a
-    Popen-like object. ``metrics_fn(deployment, service) -> queue_depth``
-    enables autoscaling; None means replicas follow the spec exactly.
+    ``launcher`` is injectable (tests use fakes): ``spawn(host, name,
+    svc, replica, rank, extra_env)`` must return a Popen-like object.
+    The legacy ``spawn(name, svc, idx)`` callable is still accepted for
+    single-node services. ``metrics_fn(deployment, service) ->
+    queue_depth`` enables autoscaling; None means replicas follow the
+    spec exactly.
     """
 
     def __init__(
@@ -52,21 +118,26 @@ class DeploymentController:
         store,
         poll_interval: float = 1.0,
         spawn: Optional[Callable] = None,
+        launcher=None,
         metrics_fn: Optional[Callable] = None,
         backoff_base: float = 1.0,
         backoff_max: float = 30.0,
     ):
         self.store = store
         self.poll_interval = poll_interval
-        self._spawn = spawn or self._spawn_subprocess
+        if launcher is None and spawn is not None:
+            launcher = _LegacySpawnLauncher(spawn)
+        self.launcher = launcher or LocalLauncher()
         self._metrics_fn = metrics_fn
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
-        self._replicas: dict[tuple[str, str, int], _Replica] = {}
+        # key = (deployment, service, replica, rank)
+        self._replicas: dict[tuple[str, str, int, int], _Replica] = {}
         # terminated children awaiting reap; SIGKILL after the grace period
         self._terminating: list[tuple[object, float]] = []
         self.kill_grace = 10.0
-        # consecutive crash count + not-before time per replica slot
+        # consecutive crash count + not-before time per replica GROUP
+        # (deployment, service, replica) — ranks restart together
         self._crashes: dict[tuple[str, str, int], int] = {}
         self._not_before: dict[tuple[str, str, int], float] = {}
         self._task: Optional[asyncio.Task] = None
@@ -114,7 +185,7 @@ class DeploymentController:
         """One observe/diff/converge pass (sync; also called from tests)."""
         self.stats["reconciles"] += 1
         self._reap_terminating()
-        desired: dict[tuple[str, str, int], ServiceDeploymentSpec] = {}
+        desired: dict[tuple[str, str, int, int], tuple] = {}
         deployments: dict[str, DynamoDeployment] = {}
         for name in self.store.list():
             try:
@@ -126,65 +197,113 @@ class DeploymentController:
             deployments[name] = dep
             for svc in dep.services:
                 n = self._desired_replicas(name, svc)
-                for i in range(n):
-                    desired[(name, svc.name, i)] = svc
+                for r in range(n):
+                    for k in range(svc.num_nodes):
+                        host = (
+                            svc.hosts[k % len(svc.hosts)] if svc.hosts else ""
+                        )
+                        desired[(name, svc.name, r, k)] = (svc, host)
 
-        # reap crashed children; schedule their restart with backoff
+        # reap crashed children; a crashed rank takes its whole GROUP
+        # down (SPMD lockstep) and schedules the group's restart. A
+        # group counts ONE crash per pass no matter how many of its
+        # ranks died together (a host reboot must not fast-forward the
+        # exponential backoff schedule).
+        crashed_groups: set[tuple[str, str, int]] = set()
         for key, rep in list(self._replicas.items()):
             if rep.proc.poll() is not None:
+                rc = rep.proc.poll()
                 del self._replicas[key]
                 if key in desired:
-                    crashes = self._crashes.get(key, 0) + 1
-                    self._crashes[key] = crashes
+                    group = key[:3]
+                    if group in crashed_groups:
+                        continue
+                    crashed_groups.add(group)
+                    crashes = self._crashes.get(group, 0) + 1
+                    self._crashes[group] = crashes
                     delay = min(
                         self._backoff_base * (2 ** (crashes - 1)),
                         self._backoff_max,
                     )
-                    self._not_before[key] = time.monotonic() + delay
+                    self._not_before[group] = time.monotonic() + delay
                     self.stats["restarts"] += 1
                     logger.warning(
-                        "replica %s exited rc=%s; restart in %.1fs (crash #%d)",
-                        key, rep.proc.poll(), delay, crashes,
+                        "replica %s exited rc=%s; group restart in %.1fs "
+                        "(crash #%d)", key, rc, delay, crashes,
                     )
+        for key in list(self._replicas):
+            if key[:3] in crashed_groups:
+                self._kill(key, clear_group_state=False)
 
         # converge: kill what shouldn't run, spawn what should
         for key in list(self._replicas):
             if key not in desired:
                 self._kill(key)
-        # drop per-slot crash/backoff state for slots that no longer exist
-        # (a deleted-and-recreated deployment must start fresh, not
+        # drop per-group crash/backoff state for groups that no longer
+        # exist (a deleted-and-recreated deployment must start fresh, not
         # inherit the old slot's backoff) and status cache for deleted
         # deployments (a recreate must rewrite its .status file)
-        for key in list(self._crashes):
-            if key not in desired:
-                self._crashes.pop(key, None)
-        for key in list(self._not_before):
-            if key not in desired:
-                self._not_before.pop(key, None)
+        desired_groups = {key[:3] for key in desired}
+        for group in list(self._crashes):
+            if group not in desired_groups:
+                self._crashes.pop(group, None)
+        for group in list(self._not_before):
+            if group not in desired_groups:
+                self._not_before.pop(group, None)
         for name in list(self._last_status):
             if name not in deployments:
                 self._last_status.pop(name, None)
         now = time.monotonic()
-        for key, svc in desired.items():
-            if key in self._replicas or self._not_before.get(key, 0) > now:
+        for key, (svc, host) in desired.items():
+            if key in self._replicas or self._not_before.get(key[:3], 0) > now:
                 continue
-            name, _svc_name, idx = key
+            name, _svc_name, r, k = key
             try:
-                proc = self._spawn(name, svc, idx)
+                proc = self.launcher.spawn(
+                    host, name, svc, r, k,
+                    self._rank_env(svc, r, k, deployment=name),
+                )
             except Exception:  # noqa: BLE001 — bad command must not kill
                 logger.exception("spawn failed for %s", key)
-                self._not_before[key] = now + self._backoff_max
+                self._not_before[key[:3]] = now + self._backoff_max
+                # a partial SPMD group must not run: already-spawned
+                # sibling ranks would wedge in jax.distributed init
+                # waiting for the peer that never arrives — kill them
+                for k2 in [
+                    kk for kk in self._replicas if kk[:3] == key[:3]
+                ]:
+                    self._kill(k2, clear_group_state=False)
                 continue
             self._replicas[key] = _Replica(proc)
             self.stats["spawns"] += 1
-        # a replica that stayed up past the backoff window resets its count
+        # a replica group that stayed up past the backoff window resets
+        # its crash count
         for key, rep in self._replicas.items():
-            if self._crashes.get(key) and (
+            if self._crashes.get(key[:3]) and (
                 time.monotonic() - rep.started_at > self._backoff_max
             ):
-                self._crashes.pop(key, None)
+                self._crashes.pop(key[:3], None)
 
         self._write_statuses(deployments, desired)
+
+    @staticmethod
+    def _rank_env(svc: ServiceDeploymentSpec, replica: int, rank: int,
+                  deployment: str = "") -> dict:
+        env = {
+            "DYN_DEPLOYMENT": deployment,
+            "DYN_REPLICA": str(replica),
+            "DYN_SERVICE": svc.name,
+        }
+        if svc.num_nodes > 1:
+            env.update({
+                "DYN_NODE_RANK": str(rank),
+                "DYN_NUM_NODES": str(svc.num_nodes),
+                # coordinator = rank 0's host; one port per replica group
+                "DYN_COORDINATOR": (
+                    f"{svc.hosts[0]}:{svc.coordinator_port + replica}"
+                ),
+            })
+        return env
 
     def _desired_replicas(self, name: str, svc: ServiceDeploymentSpec) -> int:
         if not (svc.autoscaling.enabled and self._metrics_fn):
@@ -195,7 +314,8 @@ class DeploymentController:
         except Exception:  # noqa: BLE001 — metrics plane down: hold steady
             logger.exception("metrics_fn failed; keeping current scale")
             current = sum(
-                1 for (d, s, _i) in self._replicas if d == name and s == svc.name
+                1 for (d, s, _r, k) in self._replicas
+                if d == name and s == svc.name and k == 0
             )
             return max(current, a.min_replicas)
         if depth is None:
@@ -203,7 +323,7 @@ class DeploymentController:
         want = math.ceil(depth / max(a.target_queue_depth, 1)) if depth > 0 else a.min_replicas
         return max(a.min_replicas, min(a.max_replicas, want))
 
-    def _kill(self, key) -> None:
+    def _kill(self, key, clear_group_state: bool = True) -> None:
         rep = self._replicas.pop(key, None)
         if rep is None:
             return
@@ -213,8 +333,9 @@ class DeploymentController:
         except Exception:  # noqa: BLE001
             pass
         self._terminating.append((rep.proc, time.monotonic() + self.kill_grace))
-        self._crashes.pop(key, None)
-        self._not_before.pop(key, None)
+        if clear_group_state:
+            self._crashes.pop(key[:3], None)
+            self._not_before.pop(key[:3], None)
 
     def _reap_terminating(self) -> None:
         """Reap terminated children (no zombies); SIGKILL any that trap
@@ -243,13 +364,21 @@ class DeploymentController:
         for name, dep in deployments.items():
             services = {}
             for svc in dep.services:
-                want = sum(
-                    1 for (d, s, _i) in desired if d == name and s == svc.name
-                )
+                want_groups = {
+                    (d, s, r) for (d, s, r, _k) in desired
+                    if d == name and s == svc.name
+                }
+                # a multi-host replica is ready only when ALL ranks run
                 ready = sum(
-                    1 for (d, s, _i) in self._replicas if d == name and s == svc.name
+                    1 for g in want_groups
+                    if all(
+                        (g[0], g[1], g[2], k) in self._replicas
+                        for k in range(svc.num_nodes)
+                    )
                 )
-                services[svc.name] = {"desired": want, "ready": ready}
+                services[svc.name] = {
+                    "desired": len(want_groups), "ready": ready,
+                }
             ok = all(v["ready"] >= v["desired"] for v in services.values())
             body = {
                 "services": services,
@@ -265,15 +394,13 @@ class DeploymentController:
             self._last_status[name] = body
             self.store.put_status(name, body | {"updated_at": time.time()})
 
-    # ---- default child spawner ----
 
-    @staticmethod
-    def _spawn_subprocess(name: str, svc: ServiceDeploymentSpec, idx: int):
-        env = os.environ.copy()
-        env.update(svc.env)
-        env["DYN_DEPLOYMENT"] = name
-        env["DYN_SERVICE"] = svc.name
-        env["DYN_REPLICA"] = str(idx)
-        cmd = svc.command or [sys.executable, "-c", "import time; time.sleep(1e9)"]
-        logger.info("spawning %s/%s[%d]: %s", name, svc.name, idx, cmd)
-        return subprocess.Popen(cmd, env=env)
+class _LegacySpawnLauncher:
+    """Adapter for the pre-round-3 ``spawn(name, svc, idx)`` injectable
+    (single-node services only; rank env rides the svc env unused)."""
+
+    def __init__(self, spawn: Callable):
+        self._spawn = spawn
+
+    def spawn(self, host, name, svc, replica, rank, extra_env):
+        return self._spawn(name, svc, replica)

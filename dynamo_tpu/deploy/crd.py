@@ -81,12 +81,26 @@ class ServiceDeploymentSpec:
     # expose an HTTP ingress for this service (the OpenAI frontend)
     http_port: int = 0
     ingress_host: str = ""
+    # multi-host SPMD engines (BASELINE config 4: 2 hosts x tp=8): each
+    # REPLICA expands to num_nodes rank processes, rank k placed on
+    # hosts[k % len(hosts)] via the controller's host launcher. Ranks
+    # get DYN_NODE_RANK / DYN_NUM_NODES / DYN_COORDINATOR env (the
+    # coordinator is rank 0's host at coordinator_port + replica index),
+    # and a rank crash restarts the WHOLE replica group — SPMD lockstep
+    # can't survive a lone rank respawn.
+    num_nodes: int = 1
+    hosts: list[str] = field(default_factory=list)  # empty = local
+    coordinator_port: int = 9900
 
     def validate(self) -> None:
         if not self.name or "/" in self.name:
             raise SpecError(f"bad service name {self.name!r}")
         if self.replicas < 0:
             raise SpecError("replicas must be >= 0")
+        if self.num_nodes < 1:
+            raise SpecError("num_nodes must be >= 1")
+        if self.num_nodes > 1 and not self.hosts:
+            raise SpecError("num_nodes > 1 needs a hosts list")
         self.resources.validate()
         self.autoscaling.validate()
 
@@ -131,6 +145,9 @@ class DynamoDeployment:
                 env=dict(s.get("env", {})),
                 http_port=s.get("http_port", 0),
                 ingress_host=s.get("ingress_host", ""),
+                num_nodes=s.get("num_nodes", 1),
+                hosts=list(s.get("hosts", [])),
+                coordinator_port=s.get("coordinator_port", 9900),
             )
             for s in d.get("services", [])
         ]

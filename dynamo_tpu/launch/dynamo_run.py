@@ -477,12 +477,18 @@ def main(argv=None) -> None:
     p.add_argument("--pp", type=int, default=1, help="pipeline mesh axis")
     p.add_argument("--ep", type=int, default=1, help="expert-parallel mesh axis")
     # multi-node bootstrap (ref MultiNodeConfig engines.rs:35-52 +
-    # --num-nodes/--node-rank/--leader-addr flags.rs:59-92)
-    p.add_argument("--num-nodes", type=int, default=1,
+    # --num-nodes/--node-rank/--leader-addr flags.rs:59-92). Flag
+    # defaults come from the DYN_* env the deployment controller injects
+    # per rank (deploy/controller.py) so one command line serves every
+    # rank of a multi-host service.
+    p.add_argument("--num-nodes", type=int,
+                   default=int(os.environ.get("DYN_NUM_NODES", "1")),
                    help="total processes in the multi-host mesh")
-    p.add_argument("--node-rank", type=int, default=0,
+    p.add_argument("--node-rank", type=int,
+                   default=int(os.environ.get("DYN_NODE_RANK", "0")),
                    help="this process's rank (0 = leader)")
-    p.add_argument("--coordinator", default=None,
+    p.add_argument("--coordinator",
+                   default=os.environ.get("DYN_COORDINATOR"),
                    help="host:port of rank 0's jax.distributed coordinator")
     p.add_argument("--router", default="round_robin",
                    choices=["round_robin", "random", "kv"])

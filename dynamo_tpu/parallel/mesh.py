@@ -159,16 +159,18 @@ def param_sharding(mesh: Mesh) -> dict:
 
 
 def fit_spec(spec: P, shape, mesh: Mesh) -> P:
-    """Drop the LAYER-STACK axis ("pp") from a spec when the group is too
-    short to divide it (DeepSeek's 1-3 dense_layers) — replicate those
-    few layers' weights instead of failing placement. Deliberately
-    narrow: a non-dividing tp/ep axis still fails LOUDLY at device_put
-    (silent replication of multi-GB weight shards would surface only as
-    a mystery OOM far from the misconfigured mesh)."""
+    """Drop the LAYER-STACK axis ("pp") from a spec ONLY when the group
+    is SHORTER than the pp axis (DeepSeek's 1-3 dense_layers on pp>=2 —
+    unshardable by construction) — replicate those few layers' weights
+    instead of failing placement. Deliberately narrow: any other
+    indivisibility (the main layer group, tp/ep axes) still fails
+    LOUDLY at device_put — silent replication of multi-GB weight shards
+    would surface only as a mystery OOM far from the misconfigured
+    mesh."""
     out = []
     for i, ax in enumerate(spec):
         if ax == "pp" and i < len(shape) and (
-            shape[i] % mesh.shape.get("pp", 1) != 0
+            shape[i] < mesh.shape.get("pp", 1)
         ):
             out.append(None)
         else:

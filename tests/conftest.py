@@ -26,6 +26,20 @@ import asyncio  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables after each test module.
+
+    ModelConfig hashes by identity (jit static arg), so every test's
+    fresh tiny config compiles a fresh program set; across the whole
+    suite the accumulated JIT code eventually segfaulted XLA's CPU
+    compiler mid-suite (observed twice at ~250 tests, always inside
+    backend_compile of a trivial op). Clearing per module bounds the
+    executable count without losing intra-module cache reuse."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def run():
     """Run a coroutine inside a fresh event loop."""

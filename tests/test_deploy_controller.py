@@ -177,10 +177,79 @@ def test_real_subprocess_reconcile(tmp_path):
     store.put("real", dep.to_dict(), create=True)
     ctl = DeploymentController(store)
     ctl.reconcile_once()
-    key = ("real", "sleeper", 0)
+    key = ("real", "sleeper", 0, 0)
     proc = ctl._replicas[key].proc
     assert proc.poll() is None
     store.delete("real")
     ctl.reconcile_once()
     assert key not in ctl._replicas
     proc.wait(timeout=10)
+
+
+import pytest
+
+from dynamo_tpu.deploy.crd import SpecError
+
+
+class _FakeFleetLauncher:
+    """Records (host, deployment, service, replica, rank, env) spawns."""
+
+    def __init__(self):
+        self.calls = []
+        self.procs = {}
+
+    def spawn(self, host, name, svc, replica, rank, extra_env):
+        self.calls.append((host, name, svc.name, replica, rank, dict(extra_env)))
+        p = FakeProc()
+        self.procs[(replica, rank)] = p
+        return p
+
+
+def test_multihost_fleet_converges_two_host_spec(tmp_path):
+    """VERDICT r2 #9: a DynamoDeployment expressing BASELINE config 4's
+    2-host topology (one SPMD worker spanning hosts w0/w1) converges
+    through the host-launcher abstraction: one rank per host with the
+    jax.distributed env injected, group-ready status, and a rank crash
+    restarting the WHOLE group after backoff."""
+    store = _store(tmp_path)
+    dep = DynamoDeployment(
+        name="cfg4",
+        services=[ServiceDeploymentSpec(
+            name="worker", replicas=1, num_nodes=2,
+            hosts=["w0", "w1"], coordinator_port=9950,
+            command=["dynamo-run"],
+        )],
+    )
+    store.put("cfg4", dep.to_dict(), create=True)
+    fleet = _FakeFleetLauncher()
+    ctl = DeploymentController(store, launcher=fleet, backoff_base=0.05)
+    ctl.reconcile_once()
+
+    assert [(c[0], c[4]) for c in fleet.calls] == [("w0", 0), ("w1", 1)]
+    for _h, _n, _s, _r, rank, env in fleet.calls:
+        assert env["DYN_NODE_RANK"] == str(rank)
+        assert env["DYN_NUM_NODES"] == "2"
+        assert env["DYN_COORDINATOR"] == "w0:9950"
+    st = store.get_status("cfg4")
+    assert st["services"]["worker"] == {"desired": 1, "ready": 1}
+
+    # rank 1 dies -> rank 0 must be killed too (SPMD lockstep); backoff
+    # holds the group down this pass, then it respawns as a unit
+    p00, p01 = fleet.procs[(0, 0)], fleet.procs[(0, 1)]
+    p01.rc = 1
+    ctl.reconcile_once()
+    assert p00.terminated, "surviving rank must be killed with its group"
+    assert len(fleet.calls) == 2  # backoff: no respawn yet
+    st = store.get_status("cfg4")
+    assert st["services"]["worker"]["ready"] == 0
+    time.sleep(0.06)
+    ctl.reconcile_once()
+    assert len(fleet.calls) == 4, fleet.calls  # both ranks respawned
+    st = store.get_status("cfg4")
+    assert st["services"]["worker"] == {"desired": 1, "ready": 1}
+
+
+def test_multihost_spec_validation():
+    with pytest.raises(SpecError):
+        ServiceDeploymentSpec(name="w", num_nodes=2).validate()
+    ServiceDeploymentSpec(name="w", num_nodes=2, hosts=["a", "b"]).validate()
