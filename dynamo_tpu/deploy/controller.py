@@ -133,8 +133,12 @@ class DeploymentController:
         self._backoff_max = backoff_max
         # key = (deployment, service, replica, rank)
         self._replicas: dict[tuple[str, str, int, int], _Replica] = {}
-        # terminated children awaiting reap; SIGKILL after the grace period
-        self._terminating: list[tuple[object, float]] = []
+        # terminated children awaiting reap; SIGKILL after the grace
+        # period. Entries carry their replica key: a group must not
+        # respawn while any of its old ranks still drains — the new
+        # rank 0 would race the old one for the deterministic
+        # coordinator port and the TPU devices.
+        self._terminating: list[tuple[object, object, float]] = []
         self.kill_grace = 10.0
         # consecutive crash count + not-before time per replica GROUP
         # (deployment, service, replica) — ranks restart together
@@ -164,7 +168,7 @@ class DeploymentController:
             while self._terminating and time.monotonic() < deadline:
                 self._reap_terminating()
                 await asyncio.sleep(0.05)
-            for proc, _d in self._terminating:
+            for _key, proc, _d in self._terminating:
                 try:
                     proc.kill()
                 except Exception:  # noqa: BLE001
@@ -254,8 +258,14 @@ class DeploymentController:
             if name not in deployments:
                 self._last_status.pop(name, None)
         now = time.monotonic()
+        # groups with a rank still draining must not respawn yet (the
+        # old process holds the coordinator port / TPU until it exits)
+        draining = {k[:3] for k, _p, _d in self._terminating
+                    if k is not None}
         for key, (svc, host) in desired.items():
             if key in self._replicas or self._not_before.get(key[:3], 0) > now:
+                continue
+            if svc.num_nodes > 1 and key[:3] in draining:
                 continue
             name, _svc_name, r, k = key
             try:
@@ -332,7 +342,9 @@ class DeploymentController:
             rep.proc.terminate()
         except Exception:  # noqa: BLE001
             pass
-        self._terminating.append((rep.proc, time.monotonic() + self.kill_grace))
+        self._terminating.append(
+            (key, rep.proc, time.monotonic() + self.kill_grace)
+        )
         if clear_group_state:
             self._crashes.pop(key[:3], None)
             self._not_before.pop(key[:3], None)
@@ -341,7 +353,7 @@ class DeploymentController:
         """Reap terminated children (no zombies); SIGKILL any that trap
         SIGTERM past the grace period."""
         still = []
-        for proc, deadline in self._terminating:
+        for key, proc, deadline in self._terminating:
             if proc.poll() is not None:
                 continue  # reaped
             if time.monotonic() >= deadline:
@@ -351,9 +363,9 @@ class DeploymentController:
                 except Exception:  # noqa: BLE001
                     pass
                 # keep it one more round so the SIGKILL gets reaped too
-                still.append((proc, deadline + self.kill_grace))
+                still.append((key, proc, deadline + self.kill_grace))
             else:
-                still.append((proc, deadline))
+                still.append((key, proc, deadline))
         self._terminating = still
 
     # ---- status subresource ----

@@ -84,6 +84,14 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
             num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32
         )
         return cfg, None, ByteTokenizer(), args.model_name or "tiny-moe"
+    if args.model_path == "llama3-8b-sim":
+        # full Llama-3-8B architecture with RANDOM weights + the byte
+        # tokenizer: the serving-path TTFT/ITL bench shape for when no
+        # real checkpoint is reachable (zero-egress environments) —
+        # compute, memory traffic and scheduling are identical to the
+        # real model; only the token->text map differs
+        cfg = ModelConfig.llama3_8b()
+        return cfg, None, ByteTokenizer(), args.model_name or "llama3-8b-sim"
     from ..llm.hub import resolve_model_path
 
     # the served name comes from the user-facing id (org/name or dir), not

@@ -64,8 +64,16 @@ class ByteTokenizer(Tokenizer):
         return ids
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
-        data = bytes(i for i in ids if i < 256)
-        return data.decode("utf-8", errors="replace")
+        """ids >= 258 (a larger model served through the byte tokenizer,
+        e.g. the llama3-8b-sim bench config) decode to U+FFFD so token
+        streams still produce visible text instead of silently dropping."""
+        out = []
+        for i in ids:
+            if i < 256:
+                out.append(bytes([i]))
+            elif i >= 258:
+                out.append("�".encode())
+        return b"".join(out).decode("utf-8", errors="replace")
 
     @property
     def eos_token_ids(self) -> list[int]:

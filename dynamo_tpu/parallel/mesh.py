@@ -108,7 +108,7 @@ _PARAM_SPECS = {
 
 def _spec_alias(prefix: str) -> str:
     """DeepSeek's leading dense group (``dense_layers.*``) shares the
-    stacked-layer placement rules."""
+    stacked-layer placement rules (minus pp — see _spec_for)."""
     if prefix.startswith("dense_layers."):
         return "layers." + prefix[len("dense_layers."):]
     return prefix
@@ -119,15 +119,27 @@ def _spec_for(prefix: str) -> P:
     ``{"q", "s"}`` under the weight's path: q keeps the parent's spec
     ([..., in, out] layout unchanged), s ([..., out], the contraction
     axis dropped) keeps every parent axis except the second-to-last."""
+    dense_group = prefix.startswith("dense_layers.")
     prefix = _spec_alias(prefix)
+
+    def out(spec: P) -> P:
+        # the dense-first group is 1-3 layers (first_k_dense_replace):
+        # pipeline-stage sharding of so few rows is meaningless and
+        # rarely divisible — always replicate it over pp. Every other
+        # indivisibility fails LOUDLY at device_put (silent replication
+        # of multi-GB shards would surface only as a mystery OOM).
+        if dense_group and len(spec) and spec[0] == "pp":
+            return P(None, *tuple(spec)[1:])
+        return spec
+
     if prefix in _PARAM_SPECS:
-        return _PARAM_SPECS[prefix]
+        return out(_PARAM_SPECS[prefix])
     parent = prefix.rsplit(".", 1)[0] if "." in prefix else ""
     if prefix.endswith(".q") and parent in _PARAM_SPECS:
-        return _PARAM_SPECS[parent]
+        return out(_PARAM_SPECS[parent])
     if prefix.endswith(".s") and parent in _PARAM_SPECS:
         ps = tuple(_PARAM_SPECS[parent])
-        return P(*ps[:-2], ps[-1])
+        return out(P(*ps[:-2], ps[-1]))
     return P()
 
 
@@ -158,35 +170,13 @@ def param_sharding(mesh: Mesh) -> dict:
     return build
 
 
-def fit_spec(spec: P, shape, mesh: Mesh) -> P:
-    """Drop the LAYER-STACK axis ("pp") from a spec ONLY when the group
-    is SHORTER than the pp axis (DeepSeek's 1-3 dense_layers on pp>=2 —
-    unshardable by construction) — replicate those few layers' weights
-    instead of failing placement. Deliberately narrow: any other
-    indivisibility (the main layer group, tp/ep axes) still fails
-    LOUDLY at device_put — silent replication of multi-GB weight shards
-    would surface only as a mystery OOM far from the misconfigured
-    mesh."""
-    out = []
-    for i, ax in enumerate(spec):
-        if ax == "pp" and i < len(shape) and (
-            shape[i] < mesh.shape.get("pp", 1)
-        ):
-            out.append(None)
-        else:
-            out.append(ax)
-    return P(*out)
-
-
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the placement rules."""
 
     def walk(leafs, specs):
         if isinstance(leafs, dict):
             return {k: walk(v, specs[k]) for k, v in leafs.items()}
-        return jax.device_put(
-            leafs, NamedSharding(mesh, fit_spec(specs, leafs.shape, mesh))
-        )
+        return jax.device_put(leafs, NamedSharding(mesh, specs))
 
     return walk(params, spec_tree(params))
 

@@ -1,0 +1,31 @@
+"""Smoke of the full-stack serving benchmark harness (scripts/
+serve_bench.py — the VERDICT r2 #3 TTFT/ITL measurement path): tiny
+model on CPU, real HTTP streaming, sane measurements out."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serve_bench_smoke():
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--cpu", "--model-path", "tiny", "--n", "2", "--isl", "32",
+         "--osl", "8", "--num-blocks", "64", "--block-size", "8",
+         "--max-batch", "4", "--concurrency", "2"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")][-1]
+    r = json.loads(line)
+    assert r["ok"] == 2 and not r["errors"], r
+    assert r["tokens_total"] == 16, r  # ignore_eos: exactly osl each
+    assert r["ttft_ms"]["p50"] > 0 and r["itl_ms"]["p50"] > 0, r
+    assert any("first_token_seconds" in k for k in r["server_metrics"]), r
