@@ -258,14 +258,15 @@ class DeploymentController:
             if name not in deployments:
                 self._last_status.pop(name, None)
         now = time.monotonic()
-        # groups with a rank still draining must not respawn yet (the
-        # old process holds the coordinator port / TPU until it exits)
+        # groups with a rank still draining must not respawn yet — the
+        # old process holds the coordinator port / TPU devices until it
+        # exits (single-node replicas hold the chip just the same)
         draining = {k[:3] for k, _p, _d in self._terminating
                     if k is not None}
         for key, (svc, host) in desired.items():
             if key in self._replicas or self._not_before.get(key[:3], 0) > now:
                 continue
-            if svc.num_nodes > 1 and key[:3] in draining:
+            if key[:3] in draining:
                 continue
             name, _svc_name, r, k = key
             try:

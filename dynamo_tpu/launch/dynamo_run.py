@@ -84,6 +84,18 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
             num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32
         )
         return cfg, None, ByteTokenizer(), args.model_name or "tiny-moe"
+    if args.model_path == "tiny-mla":
+        # DeepSeek-V2/V3-shaped MLA test model (compressed latent cache,
+        # absorbed attention, dense-first MoE stack) — config-5's model
+        # family servable end to end without a checkpoint
+        cfg = ModelConfig.tiny(
+            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=32, num_shared_experts=1,
+            first_dense_layers=1, num_layers=3,
+        )
+        return cfg, None, ByteTokenizer(), args.model_name or "tiny-mla"
     if args.model_path == "llama3-8b-sim":
         # full Llama-3-8B architecture with RANDOM weights + the byte
         # tokenizer: the serving-path TTFT/ITL bench shape for when no

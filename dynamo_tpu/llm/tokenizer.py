@@ -65,14 +65,17 @@ class ByteTokenizer(Tokenizer):
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
         """ids >= 258 (a larger model served through the byte tokenizer,
-        e.g. the llama3-8b-sim bench config) decode to U+FFFD so token
-        streams still produce visible text instead of silently dropping."""
+        e.g. the llama3-8b-sim bench config) decode to one printable
+        ASCII char derived from the id. NOT U+FFFD: the incremental
+        DecodeStream treats a trailing replacement char as an incomplete
+        multibyte sequence and holds output, which would stall streaming
+        for every out-of-range token."""
         out = []
         for i in ids:
             if i < 256:
                 out.append(bytes([i]))
             elif i >= 258:
-                out.append("�".encode())
+                out.append(bytes([33 + (i % 94)]))
         return b"".join(out).decode("utf-8", errors="replace")
 
     @property

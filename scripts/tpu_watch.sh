@@ -43,14 +43,21 @@ for i in $(seq 1 300); do
     # int8 weights + fp8 KV so it fits one v5e chip (16GB HBM).
     # ISL is in WORDS; the byte tokenizer yields ~5.3 tokens/word, so
     # 400 words ~ 2100 tokens/prompt -> 4 concurrent sequences fit the
-    # 640-block (10240-token) pool with decode headroom.
-    timeout 2400 python scripts/serve_bench.py \
-        --model-path llama3-8b-sim --quantization int8 \
-        --kv-cache-dtype float8_e4m3 --num-blocks 640 --block-size 16 \
-        --max-batch 8 --n 16 --isl 400 --osl 150 --concurrency 4 \
-        --artifact > /tmp/tpu_results/serve_bench.log 2>&1
-    echo "serve_bench rc=$?" >> /tmp/tpu_results/status
-    log_entry "serve_bench" /tmp/tpu_results/serve_bench.log
+    # 640-block (10240-token) pool with decode headroom. Runs once per
+    # recovery (BENCH_serving.json gates re-runs on wedge/retry loops);
+    # --artifact writes its own perf_log entry, so only failures get the
+    # raw-log append here.
+    if [ ! -s /root/repo/BENCH_serving.json ]; then
+      timeout 2400 python scripts/serve_bench.py \
+          --model-path llama3-8b-sim --quantization int8 \
+          --kv-cache-dtype float8_e4m3 --num-blocks 640 --block-size 16 \
+          --max-batch 8 --n 16 --isl 400 --osl 150 --concurrency 4 \
+          --artifact > /tmp/tpu_results/serve_bench.log 2>&1
+      sb_rc=$?
+      echo "serve_bench rc=$sb_rc" >> /tmp/tpu_results/status
+      [ "$sb_rc" != 0 ] && log_entry "serve_bench (FAILED)" \
+          /tmp/tpu_results/serve_bench.log
+    fi
     # Persist the JSON line as a repo artifact for the driver/judge.
     # Never truncate a previously captured good result with an empty one.
     line=$(grep -E '^\{.*"metric"' /tmp/tpu_results/bench.log | tail -1)

@@ -112,5 +112,32 @@ for tag, up in (("regular", False), ("merged", True)):
 check("decode_step merged==regular (logits, 3 steps)",
       out["merged"], out["regular"], rtol=5e-2, atol=5e-1)
 
+# 5. MLA (DeepSeek-shaped): absorbed paged decode on TPU vs the naive
+# dense reference (round-3 feature; XLA path, but compiled-on-TPU
+# behavior is what serves config 5)
+mla_cfg = ModelConfig.tiny(
+    num_heads=8, num_kv_heads=8, kv_lora_rank=64, qk_nope_head_dim=32,
+    qk_rope_head_dim=16, v_head_dim=32, q_lora_rank=48, dtype="bfloat16",
+)
+mla_params = llama.init_params(mla_cfg, jax.random.key(3))
+mtoks = jnp.asarray(np.arange(24) % mla_cfg.vocab_size, jnp.int32)
+mref = llama.dense_forward(mla_params, mla_cfg, mtoks)
+mk, mv = llama.init_kv_cache(mla_cfg, 16, 4)
+mtable = jnp.asarray(np.arange(1, 9, dtype=np.int32))
+pt = jnp.zeros(16, jnp.int32).at[:16].set(mtoks[:16])
+mlog, mk, mv = llama.prefill(
+    mla_params, mla_cfg, pt, mtable, jnp.int32(0), jnp.int32(16), mk, mv
+)
+check("mla prefill vs dense", mlog, mref[15], rtol=5e-2, atol=5e-1)
+got_rows = []
+for t in range(16, 20):
+    mlog, mk, mv = llama.decode_step(
+        mla_params, mla_cfg, mtoks[t : t + 1], jnp.asarray([t]),
+        mtable[None], jnp.asarray([t + 1]), mk, mv,
+    )
+    got_rows.append(np.asarray(mlog[0], np.float32))
+check("mla decode vs dense", np.stack(got_rows),
+      np.asarray(mref[16:20], np.float32), rtol=5e-2, atol=5e-1)
+
 print("ALL PASS" if ok else "FAILURES", flush=True)
 sys.exit(0 if ok else 1)

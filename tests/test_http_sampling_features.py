@@ -99,3 +99,56 @@ def test_http_logprobs_n_and_penalties(tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
         log.close()
+
+
+def test_http_serves_mla_model(tmp_path):
+    """config-5 family end to end: the DeepSeek-shaped tiny-mla model
+    (compressed latent cache, absorbed attention, dense-first MoE)
+    served through in=http out=jax over real HTTP."""
+    port = _free_port()
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.launch.dynamo_run",
+         "in=http", "out=jax", "--model-path", "tiny-mla",
+         "--host", "127.0.0.1", "--http-port", str(port),
+         "--num-blocks", "64", "--block-size", "8", "--max-batch", "4"],
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/models", timeout=2
+                ) as r:
+                    if b"tiny-mla" in r.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("server never came up")
+        out = _post(port, "/v1/completions", {
+            "model": "tiny-mla", "prompt": "hello mla", "max_tokens": 6,
+            "temperature": 0.0, "nvext": {"ignore_eos": True},
+        })
+        assert out["choices"][0]["finish_reason"] == "length"
+        assert out["usage"]["completion_tokens"] == 6
+        # a second identical prompt exercises the latent-cache prefix hit
+        out2 = _post(port, "/v1/completions", {
+            "model": "tiny-mla", "prompt": "hello mla", "max_tokens": 6,
+            "temperature": 0.0, "nvext": {"ignore_eos": True},
+        })
+        assert out2["choices"][0]["text"] == out["choices"][0]["text"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log.close()
