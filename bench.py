@@ -59,8 +59,33 @@ def _acquire_devices(retries: int = 3, probe_timeout: float = 120.0):
     return jax.devices("cpu")
 
 
+def _cached_silicon_result():
+    """A previously-measured on-chip number (scripts/tpu_watch.sh writes
+    BENCH_partial.json the moment one lands). Surfaced when the backend
+    is unreachable at driver time so a relay death between measurement
+    and collection can't erase the round's real datapoint (round-2
+    weak #7); the metric name says it's cached, never fresh."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_partial.json")
+    try:
+        with open(path) as f:
+            cached = json.loads(f.readline())
+    except (OSError, ValueError):
+        return None
+    if "cpu_smoke" in cached.get("metric", ""):
+        return None  # only real silicon numbers are worth surfacing
+    cached["metric"] = cached["metric"] + "_cached"
+    return cached
+
+
 def main() -> None:
-    devices = _acquire_devices()
+    cached = _cached_silicon_result()
+    # with a real silicon number already in hand, one failed probe is
+    # enough to fall back to it — don't burn 6 minutes re-probing a
+    # relay that is known to wedge (round-2 weak #7)
+    devices = _acquire_devices(retries=1 if cached is not None else 3)
 
     import jax
     import jax.numpy as jnp
@@ -70,6 +95,9 @@ def main() -> None:
     from dynamo_tpu.models.config import ModelConfig
 
     on_cpu = devices[0].platform == "cpu"
+    if on_cpu and cached is not None:
+        print(json.dumps(cached))
+        return
     if on_cpu:
         # smoke-test scale only — the real bench runs on TPU
         cfg = ModelConfig.tiny(dtype="bfloat16")

@@ -124,9 +124,9 @@ mtoks = jnp.asarray(np.arange(24) % mla_cfg.vocab_size, jnp.int32)
 mref = llama.dense_forward(mla_params, mla_cfg, mtoks)
 mk, mv = llama.init_kv_cache(mla_cfg, 16, 4)
 mtable = jnp.asarray(np.arange(1, 9, dtype=np.int32))
-pt = jnp.zeros(16, jnp.int32).at[:16].set(mtoks[:16])
 mlog, mk, mv = llama.prefill(
-    mla_params, mla_cfg, pt, mtable, jnp.int32(0), jnp.int32(16), mk, mv
+    mla_params, mla_cfg, mtoks[:16], mtable, jnp.int32(0), jnp.int32(16),
+    mk, mv,
 )
 check("mla prefill vs dense", mlog, mref[15], rtol=5e-2, atol=5e-1)
 got_rows = []
