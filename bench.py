@@ -72,11 +72,13 @@ def _cached_silicon_result():
     try:
         with open(path) as f:
             cached = json.loads(f.readline())
-    except (OSError, ValueError):
-        return None
-    if "cpu_smoke" in cached.get("metric", ""):
+        metric = cached["metric"]
+        assert isinstance(metric, str) and metric
+    except (OSError, ValueError, KeyError, TypeError, AssertionError):
+        return None  # absent/corrupt cache: measure fresh instead
+    if "cpu_smoke" in metric:
         return None  # only real silicon numbers are worth surfacing
-    cached["metric"] = cached["metric"] + "_cached"
+    cached["metric"] = metric + "_cached"
     return cached
 
 

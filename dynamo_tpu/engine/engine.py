@@ -647,9 +647,6 @@ class JaxEngine(AsyncEngine):
             cfg.ring_prefill_threshold <= 0
             or pos != 0
             or self.mesh is None
-            or self.mirror is not None  # lead_prefill has no ring path
-            # yet — without this guard the whole prompt would go through
-            # as ONE dense chunk (O(T^2) scores, per-prompt compiles)
             or self.mesh.shape.get("sp", 1) <= 1
             or len(seq.tokens) < cfg.ring_prefill_threshold
             or cfg.model.sliding_window != 0
@@ -674,6 +671,7 @@ class JaxEngine(AsyncEngine):
             logits, self.k_cache, self.v_cache = self.mirror.lead_prefill(
                 self.params, toks, self._table_for(seq), pos, len(chunk),
                 self.k_cache, self.v_cache, use_pallas=self.use_pallas,
+                use_ring=ring,
             )
             return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0

@@ -275,8 +275,8 @@ class StepMirror:
                 )
         return self._fns[key]
 
-    def _prefill_fn(self, use_pallas: bool = False):
-        key = ("prefill", use_pallas)
+    def _prefill_fn(self, use_pallas: bool = False, use_ring: bool = False):
+        key = ("prefill", use_pallas, use_ring)
         if key not in self._fns:
             import jax
 
@@ -288,7 +288,7 @@ class StepMirror:
             def step(params, toks, table, pos, valid, k_cache, v_cache):
                 return llama.prefill.__wrapped__(
                     params, cfg, toks, table, pos, valid, k_cache, v_cache,
-                    use_pallas=use_pallas, mesh=mesh,
+                    use_pallas=use_pallas, mesh=mesh, use_ring=use_ring,
                 )
 
             self._fns[key] = jax.jit(
@@ -734,15 +734,19 @@ class StepMirror:
         return (toks,) + tuple(out[1:])
 
     def lead_prefill(self, params, toks, table, pos, valid, k_cache, v_cache,
-                     use_pallas: bool = False):
+                     use_pallas: bool = False, use_ring: bool = False):
+        """``use_ring`` mirrors a sequence-parallel ring-attention prefill
+        chunk over the mesh's sp axis (long-context x multi-host: the
+        shard_map ring's ppermute hops ride ICI within a host and DCN
+        across — the engine gates on sp>1 + history-free chunks)."""
         self._lead(
             "prefill",
             (toks, np.asarray(table),
              np.asarray(pos, np.int32), np.asarray(valid, np.int32)),
-            pallas=use_pallas,
+            pallas=use_pallas, ring=use_ring,
         )
         g = self.to_global
-        return self._prefill_fn(use_pallas)(
+        return self._prefill_fn(use_pallas, use_ring)(
             params, g(toks), g(np.asarray(table)),
             g(np.asarray(pos, np.int32)), g(np.asarray(valid, np.int32)),
             k_cache, v_cache,
@@ -870,7 +874,7 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
                 k_cache, v_cache = out[2], out[3]
         elif op == "prefill":
             logits, k_cache, v_cache = mirror._prefill_fn(
-                head.get("pallas", False)
+                head.get("pallas", False), head.get("ring", False)
             )(params, *(g(a) for a in arrays), k_cache, v_cache)
         elif op == "sample1":
             mirror._sample1_fn()(logits, *(g(a) for a in arrays))

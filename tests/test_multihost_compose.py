@@ -50,3 +50,37 @@ def test_offload_and_disagg_compose_with_multihost():
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+
+
+def test_ring_prefill_composes_with_multihost():
+    """Long-context sequence parallelism x the step mirror: an sp=2 mesh
+    spanning 2 OS processes runs the mirrored ring-attention prefill
+    (ppermute crossing the process boundary) with the greedy stream
+    equal to the single-host reference (tests/mh_ring_worker.py)."""
+    coord = _free_port()
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "mh_ring_worker.py"),
+             str(rank), str(coord)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"rank exited {p.returncode}:\n{out}"
+        assert "mirrored ring prefill ok" in outs[0], outs[0]
+        assert "follower done" in outs[1], outs[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
