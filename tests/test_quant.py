@@ -139,3 +139,59 @@ def test_quantized_sharded_serving_matches_unsharded(run):
         assert outs[True] == outs[False]
 
     run(main())
+
+
+def test_quantized_mla_serves(run):
+    """int8-quantized MLA: the absorbed fold dequants the {"q","s"}
+    wkv_b leaf (mla._wkv_b_parts) and the q/kv projections ride _mm's
+    fused dequant — the engine must stream full-length output and stay
+    close to the unquantized model's greedy tokens."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    cfg = ModelConfig.tiny(
+        num_heads=4, num_kv_heads=4, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, q_lora_rank=24,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        num_shared_experts=1, first_dense_layers=1, num_layers=3,
+    )
+
+    def req():
+        return PreprocessedRequest(
+            token_ids=list(range(10, 26)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        )
+
+    async def main():
+        outs = {}
+        for quant in ("none", "int8"):
+            engine = JaxEngine(
+                EngineConfig(model=cfg, num_blocks=64, block_size=4,
+                             max_batch_size=2, max_context=64,
+                             prefill_chunk=16, quantization=quant),
+                seed=0,
+            )
+            out = await collect(engine.generate(Context(req())))
+            toks = [t for o in out for t in o.token_ids]
+            assert len(toks) == 8, (quant, toks)
+            outs[quant] = toks
+            await engine.close()
+        # int8 per-channel quantization drifts logits; on a random tiny
+        # model the greedy stream usually survives the first tokens —
+        # require a shared prefix so gross breakage (wrong dequant path)
+        # can't pass
+        common = sum(
+            1 for a, b in zip(outs["none"], outs["int8"]) if a == b
+        )
+        assert common >= 2, outs
+
+    run(main())
