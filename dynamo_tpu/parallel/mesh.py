@@ -143,22 +143,59 @@ def _spec_for(prefix: str) -> P:
     return P()
 
 
-def spec_tree(tree, prefix: str = "") -> dict:
+# small-leaf bound for the undersized-axis fallback below: DeepSeek
+# dense groups and tiny test stacks sit well under this; a real model's
+# multi-GB layer stack stays above it and fails loudly
+_FIT_MAX_BYTES = 1 << 26  # 64 MiB
+
+
+def _fit_undersized(spec: P, leaf, mesh: Optional[Mesh]) -> P:
+    """Replicate axes whose dimension is SMALLER than the mesh axis —
+    physically unshardable (a 1-3 layer DeepSeek dense group on pp>=2,
+    or a tiny test model's stack) — but ONLY for small leaves
+    (_FIT_MAX_BYTES). Everything else, including indivisible-but-larger
+    dims and undersized axes on big weights (e.g. pp=8 over a 4-layer
+    real model), fails LOUDLY at placement: silently replicating
+    multi-GB shards would surface only as a mystery OOM far from the
+    misconfigured mesh."""
+    shape = getattr(leaf, "shape", ())
+    if (
+        mesh is None
+        or not shape
+        or getattr(leaf, "nbytes", 0) > _FIT_MAX_BYTES
+    ):
+        return spec
+    out = []
+    for i, ax in enumerate(spec):
+        if (
+            ax is not None and i < len(shape)
+            and shape[i] < mesh.shape.get(ax, 1)
+        ):
+            out.append(None)
+        else:
+            out.append(ax)
+    return P(*out)
+
+
+def spec_tree(tree, prefix: str = "", mesh: Optional[Mesh] = None) -> dict:
     """PartitionSpec pytree for a params subtree per the placement rules
-    (the one walk; param_sharding/shard_params/pp all consume it)."""
+    (the one walk; param_sharding/shard_params/pp all consume it). With
+    ``mesh`` given, specs are fitted to the leaves' shapes
+    (_fit_undersized); pp.py passes no mesh because can_pipeline already
+    guarantees divisibility of every sharded dim."""
     if isinstance(tree, dict):
         return {
-            k: spec_tree(v, f"{prefix}.{k}" if prefix else k)
+            k: spec_tree(v, f"{prefix}.{k}" if prefix else k, mesh)
             for k, v in tree.items()
         }
-    return _spec_for(prefix)
+    return _fit_undersized(_spec_for(prefix), tree, mesh)
 
 
 def param_sharding(mesh: Mesh) -> dict:
     """Pytree of NamedShardings matching the params structure."""
 
     def build(prefix: str, tree):
-        specs = spec_tree(tree, prefix)
+        specs = spec_tree(tree, prefix, mesh)
 
         def wrap(node):
             if isinstance(node, dict):
@@ -178,7 +215,7 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
             return {k: walk(v, specs[k]) for k, v in leafs.items()}
         return jax.device_put(leafs, NamedSharding(mesh, specs))
 
-    return walk(params, spec_tree(params))
+    return walk(params, spec_tree(params, mesh=mesh))
 
 
 def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
