@@ -1031,14 +1031,12 @@ class JaxEngine(AsyncEngine):
         # Unchained (drains any pipeline first); bails to the normal path
         # when blocks are short or nothing matched. Composes with
         # penalties (sequential semantics modeled in the joint verify),
-        # logprobs (emitted from the verify forward's own logits), and
-        # the multi-host mirror (the verify is a broadcast op). The ONE
-        # remaining gate is sliding-window models: the verify kernel's
-        # window floor is uniform per dispatch (exact per-row floors live
-        # in the XLA path only) — they take plain decode windows.
+        # logprobs (emitted from the verify forward's own logits),
+        # sliding-window models (the verify kernel computes exact
+        # per-row window floors via its ``group`` row mapping), and
+        # the multi-host mirror (the verify is a broadcast op).
         if (
             cfg.spec_gamma > 0
-            and cfg.model.sliding_window == 0
             # MLA verify (multi-token absorbed attention) is a follow-up;
             # MLA models take plain decode windows
             and not cfg.model.is_mla

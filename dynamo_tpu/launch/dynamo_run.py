@@ -79,6 +79,11 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
     if args.model_path in (None, "tiny"):
         cfg = ModelConfig.tiny()
         return cfg, None, ByteTokenizer(), args.model_name or "tiny"
+    if args.model_path == "tiny-window":
+        # sliding-window (mistral-style) smoke model: exercises windowed
+        # attention + windowed speculative decoding through the stack
+        cfg = ModelConfig.tiny(sliding_window=16)
+        return cfg, None, ByteTokenizer(), args.model_name or "tiny-window"
     if args.model_path == "tiny-moe":
         cfg = ModelConfig.tiny(
             num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32

@@ -957,7 +957,8 @@ def _verify_forward(
             if use_pallas and mesh is not None:
                 o = att.verify_attention_sharded(
                     q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                    scale, mesh, use_pallas=True, interpret=interpret,
+                    scale, mesh, use_pallas=True, window=cfg.sliding_window,
+                    interpret=interpret,
                 )
             else:
                 o = att.verify_attention(

@@ -279,17 +279,16 @@ def verify_attention(
 
         # rows ordered (hkv, t, g) so the kernel's internal
         # reshape(B, Hkv, T*G, D) lands each row on its kv head.
-        # Windowed: the kernel's uniform floor is set for the FIRST
-        # in-flight position (q_pos_offset=1) — exact at T=1 (the merged
-        # decode path); for T>1 later rows under-mask by < T positions,
-        # negligible for practical windows (W >> T). The spec path for
-        # windowed models routes to the exact XLA masking anyway.
+        # Windowed: group=G tells the kernel row r is in-flight token
+        # t = r // G, so every row gets its EXACT per-row window floor
+        # (hist + t + 1 - window); q_pos_offset=1 anchors token 0 one
+        # past the cached history.
         qp = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
         qp = qp.reshape(B, Hkv * T * G, D)
         o_h, m_h, l_h = paged_decode_attention(
             qp, k_cache_layer, v_cache_layer, block_tables, hist_lens,
             scale, return_stats=True, window=window, q_pos_offset=1,
-            interpret=interpret,
+            group=G, interpret=interpret,
         )  # o: [B, Hkv*T*G, D]; m, l: [B, Hkv, T*G]
         o_h = o_h.reshape(B, Hkv, T, G, D).astype(jnp.float32)
         m_h = m_h.reshape(B, Hkv, T, G)
@@ -299,8 +298,6 @@ def verify_attention(
             q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
             window=window,
         )
-    # intra-window rows are at most T-1 < window positions apart for any
-    # practical sliding window, so the causal mask below already covers it
     # intra-window causal scores [B, Hkv, T, G, T']
     qg = q.reshape(B, T, Hkv, G, D)
     s_w = jnp.einsum(
@@ -309,6 +306,8 @@ def verify_attention(
         k_win.astype(jnp.float32),
     )
     causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]  # [T, T']
+    if window > 0:  # only binds when T > window (degenerate but exact)
+        causal &= (jnp.arange(T)[:, None] - jnp.arange(T)[None, :]) < window
     s_w = jnp.where(causal[None, None, :, None, :], s_w, NEG_INF)
     m_w = jnp.max(s_w, axis=-1)  # [B, Hkv, T, G]
     m_f = jnp.maximum(m_h, m_w)
@@ -335,6 +334,7 @@ def verify_attention_sharded(
     scale: float,
     mesh,
     use_pallas: bool = True,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """verify_attention under shard_map over ``tp``: the paged-kernel
@@ -348,7 +348,7 @@ def verify_attention_sharded(
     return jax.shard_map(
         partial(
             verify_attention, scale=scale, use_pallas=use_pallas,
-            interpret=interpret,
+            window=window, interpret=interpret,
         ),
         mesh=mesh,
         in_specs=(

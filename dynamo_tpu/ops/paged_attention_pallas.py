@@ -64,6 +64,10 @@ def _decode_kernel(
     return_stats: bool,
     window: int = 0,  # sliding attention; 0 = full
     q_pos_offset: int = 0,  # query position = seq_len - 1 + offset
+    group: int = 0,  # >0: row r is in-flight token t = r // group, so its
+    # query position is seq_len - 1 + q_pos_offset + r // group (the
+    # verify path packs T tokens x G heads into the row dim); 0 = all
+    # rows share one position (plain decode)
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, 1, Gp, D]
@@ -87,10 +91,12 @@ def _decode_kernel(
 
     seq_len = seq_lens_ref[b]
     start = i * (P * block_size)
-    # sliding window: the query sits at seq_len-1+q_pos_offset (the
-    # merged/out-of-cache path scores against history of length seq_len
-    # with the query ONE past it); only positions in (q_pos-window, q_pos]
-    # contribute — whole superblocks below skip compute
+    # sliding window: row r's query sits at seq_len-1+q_pos_offset+t(r)
+    # (the merged/out-of-cache path scores against history of length
+    # seq_len with queries past it); only positions in (q_pos-window,
+    # q_pos] contribute. ``lo`` is row 0's floor — the MINIMUM over rows
+    # (later in-flight tokens only see more) — so it gates whole
+    # superblocks; per-row exactness is enforced in the score mask.
     lo = seq_len + q_pos_offset - window if window > 0 else 0
     in_range = start < seq_len
     if window > 0:
@@ -111,7 +117,12 @@ def _decode_kernel(
         pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         keep = pos < seq_len
         if window > 0:
-            keep &= pos >= lo
+            row_lo = lo
+            if group > 0:  # per-row floor: row r is token t = r // group
+                row_lo = lo + (
+                    jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+                )
+            keep &= pos >= row_lo
         s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[:, 0:1]  # [Gp, 1]
@@ -139,7 +150,7 @@ def _decode_kernel(
     jax.jit,
     static_argnames=(
         "scale", "pages_per_step", "return_stats", "window",
-        "q_pos_offset", "interpret"
+        "q_pos_offset", "group", "interpret"
     ),
 )
 def paged_decode_attention(
@@ -153,6 +164,7 @@ def paged_decode_attention(
     return_stats: bool = False,
     window: int = 0,  # sliding attention width; 0 = full
     q_pos_offset: int = 0,  # see _decode_kernel
+    group: int = 0,  # see _decode_kernel (verify path: heads per token)
     interpret: bool = False,
 ):  # [B, H, D] or (out, m [B, Hkv, G], l [B, Hkv, G]) when return_stats
     B, H, D = q.shape
@@ -208,6 +220,7 @@ def paged_decode_attention(
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_size=bs, pages_per_step=P,
         return_stats=return_stats, window=window, q_pos_offset=q_pos_offset,
+        group=group,
     )
     out = pl.pallas_call(
         kernel,
