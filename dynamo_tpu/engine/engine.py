@@ -1033,13 +1033,11 @@ class JaxEngine(AsyncEngine):
         # penalties (sequential semantics modeled in the joint verify),
         # logprobs (emitted from the verify forward's own logits),
         # sliding-window models (the verify kernel computes exact
-        # per-row window floors via its ``group`` row mapping), and
-        # the multi-host mirror (the verify is a broadcast op).
+        # per-row window floors via its ``group`` row mapping), MLA
+        # models (multi-token absorbed attention, write-before-attend),
+        # and the multi-host mirror (the verify is a broadcast op).
         if (
             cfg.spec_gamma > 0
-            # MLA verify (multi-token absorbed attention) is a follow-up;
-            # MLA models take plain decode windows
-            and not cfg.model.is_mla
             and n > 1
             and self._prefill_state is None
         ):

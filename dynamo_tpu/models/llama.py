@@ -930,18 +930,57 @@ def _verify_forward(
         kv_cache_append_tokens_sharded,
     )
 
-    if cfg.is_mla:
-        raise NotImplementedError(
-            "speculative verify is gated off for MLA models (the engine "
-            "routes them to plain decode windows)"
-        )
     T = n_spec + 1
     B, E = tokens.shape[0], cfg.hidden_size
-    inv_freq = _rope_freqs(cfg)
-    scale = cfg.head_dim**-0.5
     pos_bt = positions[:, None] + jnp.arange(T)[None, :]  # [B, T]
     hist_lens = seq_lens - 1  # cache rows before the in-flight window
     x = _embed(params, cfg, tokens.reshape(-1)).reshape(B, T, E)
+    # write slots of the T in-flight rows (one slot-mapping convention)
+    bs = k_cache.shape[3]
+    blk = jnp.take_along_axis(block_tables, pos_bt // bs, axis=1)
+    off = pos_bt % bs
+
+    if cfg.is_mla:
+        # MLA verify: absorbed attention with all T rows' latents written
+        # BEFORE attending (same write-then-attend convention as the MLA
+        # decode path), per-row causal masking at absolute positions.
+        # Rows past the accepted run live above the commit horizon and
+        # are overwritten before any read (same invariant as below).
+        from . import mla as _mla
+
+        inv_freq, msc = _mla.mla_rope_freqs(cfg)
+        scale = cfg.mla_softmax_scale()
+        for lps, ng, goff in layer_groups(params, cfg):
+            for li in range(ng):
+                l = goff + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+                q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
+                    lp, cfg, h, pos_bt, inv_freq, msc
+                )
+                kc_l = k_cache[l].at[:, blk, off].set(
+                    c_kv[None].astype(k_cache.dtype)
+                )
+                vc_l = v_cache[l].at[:, blk, off].set(
+                    k_pe[None].astype(v_cache.dtype)
+                )
+                k_cache = k_cache.at[l].set(kc_l)
+                v_cache = v_cache.at[l].set(vc_l)
+                o = _mla.mla_verify_attention_xla(
+                    q_eff, q_pe, kc_l, vc_l, block_tables, pos_bt, scale
+                )
+                o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
+                x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
+                h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+                x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
+                    B, T, E
+                )
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
+        return logits, k_cache, v_cache
+
+    inv_freq = _rope_freqs(cfg)
+    scale = cfg.head_dim**-0.5
 
     k_news, v_news = [], []
     for lps, ng, goff in layer_groups(params, cfg):
@@ -974,9 +1013,6 @@ def _verify_forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
 
-    bs = k_cache.shape[3]
-    blk = jnp.take_along_axis(block_tables, pos_bt // bs, axis=1)
-    off = pos_bt % bs
     if use_pallas and mesh is not None:
         k_cache, v_cache = kv_cache_append_tokens_sharded(
             jnp.stack(k_news), jnp.stack(v_news), k_cache, v_cache, blk,

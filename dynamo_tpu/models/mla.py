@@ -106,10 +106,13 @@ def _wkv_b_parts(lp: dict, cfg: ModelConfig):
 def mla_q_and_latent(lp: dict, cfg: ModelConfig, x: jnp.ndarray,
                      positions: jnp.ndarray, inv_freq: jnp.ndarray,
                      mscale: float):
-    """Shared projection head for prefill and decode.
+    """Shared projection head for prefill, decode, and verify.
 
-    x: [T, E] (or [B, E]); positions: [T].
-    Returns (q_eff [T, H, C], q_pe [T, H, R], c_kv [T, C], k_pe [T, R])
+    x: [..., E] with arbitrary leading batch dims; positions broadcasts
+    against them (prefill [T]/[T,E], decode [B]/[B,E], verify [B,T]/
+    [B,T,E]).
+    Returns (q_eff [..., H, C], q_pe [..., H, R], c_kv [..., C],
+    k_pe [..., R])
     with C = kv_lora_rank, R = qk_rope_head_dim. q_eff is the ABSORBED
     query (q_nope @ w_kc) scoring directly against cache latents."""
     from .llama import _mm, rms_norm
@@ -180,6 +183,40 @@ def mla_prefill_attention_xla(
     s = jnp.where(valid[:, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("ths,sc->thc", p, ck.astype(jnp.float32))
+
+
+def mla_verify_attention_xla(
+    q_eff: jnp.ndarray,  # [B, T, H, C] absorbed queries, T in-flight tokens
+    q_pe: jnp.ndarray,  # [B, T, H, R]
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] — window ALREADY written
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
+    block_tables: jnp.ndarray,  # [B, M]
+    q_pos: jnp.ndarray,  # [B, T] absolute position of each in-flight token
+    scale: float,
+) -> jnp.ndarray:  # [B, T, H, C] latent output
+    """Multi-token decode attention for the speculative verify: T
+    in-flight tokens per sequence attend cached history plus the causal
+    prefix of their own window. Write-before-attend like the MLA decode
+    path (the window's latents are scattered into the cache first), so
+    per-row causal masking at absolute positions is the only bookkeeping
+    — no out-of-cache merge needed."""
+    B, T, H, C = q_eff.shape
+    M = block_tables.shape[1]
+    bs = c_cache_layer.shape[2]
+    ck = jnp.take(c_cache_layer[0], block_tables, axis=0).reshape(B, M * bs, C)
+    kp = jnp.take(pe_cache_layer[0], block_tables, axis=0).reshape(
+        B, M * bs, -1
+    )
+    s = (
+        jnp.einsum("bthc,bsc->bths", q_eff.astype(jnp.float32) * scale,
+                   ck.astype(jnp.float32))
+        + jnp.einsum("bthr,bsr->bths", q_pe.astype(jnp.float32) * scale,
+                     kp.astype(jnp.float32))
+    )
+    valid = jnp.arange(M * bs)[None, None, :] <= q_pos[:, :, None]  # [B,T,S]
+    s = jnp.where(valid[:, :, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bths,bsc->bthc", p, ck.astype(jnp.float32))
 
 
 def mla_decode_attention_xla(

@@ -22,19 +22,26 @@ log_entry() {  # $1 = title, $2 = file with content
 }
 
 for i in $(seq 1 300); do
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # the probe must EXECUTE on device, not just enumerate it: a wedged
+  # relay serves jax.devices() fine while any real dispatch hangs
+  # forever (observed 2026-07-31: devices() ok, jit(x+1) hung 90s,
+  # validate burned its whole 1200s timeout with zero output)
+  if timeout 90 python -c "
+import jax
+jax.block_until_ready(jax.jit(lambda x: x + 1)(1.0))
+print('exec-ok')" 2>/dev/null | grep -q exec-ok; then
     echo "TPU BACK at $(date)" | tee /tmp/tpu_results/status
-    timeout 1200 python scripts/validate_tpu_kernels.py \
+    timeout 1200 python -u scripts/validate_tpu_kernels.py \
         > /tmp/tpu_results/validate.log 2>&1
     echo "validate rc=$?" >> /tmp/tpu_results/status
     log_entry "validate_tpu_kernels" /tmp/tpu_results/validate.log
 
-    timeout 1800 python scripts/decompose_window.py \
+    timeout 1800 python -u scripts/decompose_window.py \
         > /tmp/tpu_results/decompose.log 2>&1
     echo "decompose rc=$?" >> /tmp/tpu_results/status
     log_entry "decompose_window" /tmp/tpu_results/decompose.log
 
-    timeout 1200 python bench.py > /tmp/tpu_results/bench.log 2>&1
+    timeout 1200 python -u bench.py > /tmp/tpu_results/bench.log 2>&1
     rc=$?
     echo "bench rc=$rc" >> /tmp/tpu_results/status
     log_entry "bench.py" /tmp/tpu_results/bench.log
@@ -49,7 +56,7 @@ for i in $(seq 1 300); do
     # --artifact writes its own perf_log entry, so only failures get the
     # raw-log append here.
     if ! grep -q '"ok": [1-9]' /root/repo/BENCH_serving.json 2>/dev/null; then
-      timeout 2400 python scripts/serve_bench.py \
+      timeout 2400 python -u scripts/serve_bench.py \
           --model-path llama3-8b-sim --quantization int8 \
           --kv-cache-dtype float8_e4m3 --num-blocks 640 --block-size 16 \
           --max-batch 8 --n 16 --isl 400 --osl 150 --concurrency 4 \

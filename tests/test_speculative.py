@@ -20,6 +20,28 @@ from dynamo_tpu.ops.attention import (
 BS = 4
 
 
+def _force_proposals(engine, ref_stream, gamma):
+    """Replace the engine's prompt-lookup proposer with one that feeds
+    each active sequence its own true continuation from ``ref_stream``
+    (the plain gamma=0 run's output). Acceptance must then reproduce
+    that stream exactly — deterministic engagement where organic n-gram
+    hits on a random tiny model are flaky."""
+
+    def forced():
+        prop = np.full((engine.cfg.max_batch_size, gamma), -1, np.int64)
+        found = False
+        for i, seq in enumerate(engine._active):
+            if seq is None or seq.finished:
+                continue
+            nxt = ref_stream[seq.generated: seq.generated + gamma]
+            if nxt:
+                prop[i, : len(nxt)] = nxt
+                found = True
+        return prop if found else None
+
+    engine._propose_ngram = forced
+
+
 def _state(cfg, B, M, seed=1):
     params = llama.init_params(cfg, jax.random.key(seed))
     N = B * M + 1
@@ -112,10 +134,19 @@ def test_verify_attention_windowed_exact_per_row():
             )
 
 
-def test_verify_window_matches_forced_decode_steps():
+@pytest.mark.parametrize("family", ["dense", "mla"])
+def test_verify_window_matches_forced_decode_steps(family):
     """llama.verify_window preds/cache must bit-match T chained
-    decode_steps fed the same forced tokens."""
-    cfg = ModelConfig.tiny(dtype="float32")
+    decode_steps fed the same forced tokens — for the dense family AND
+    the MLA family (absorbed multi-token verify, write-before-attend)."""
+    if family == "mla":
+        cfg = ModelConfig.tiny(
+            dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24, num_layers=2,
+        )
+    else:
+        cfg = ModelConfig.tiny(dtype="float32")
     B, M, T = 2, 8, 4
     params, kc0, vc0, tables = _state(cfg, B, M)
     # histories: both sequences have a few tokens already decoded
@@ -515,25 +546,7 @@ def test_spec_gates_fall_back_cleanly(run):
             )
             enginew = JaxEngine(cfgw, seed=0)
             if gamma:
-                ref_stream = streams[0]
-
-                def forced_proposals():
-                    prop = np.full(
-                        (cfgw.max_batch_size, gamma), -1, np.int64
-                    )
-                    found = False
-                    for i, seq in enumerate(enginew._active):
-                        if seq is None or seq.finished:
-                            continue
-                        nxt = ref_stream[
-                            seq.generated: seq.generated + gamma
-                        ]
-                        if nxt:
-                            prop[i, : len(nxt)] = nxt
-                            found = True
-                    return prop if found else None
-
-                enginew._propose_ngram = forced_proposals
+                _force_proposals(enginew, streams[0], gamma)
             outw = await collect(enginew.generate(Context(
                 PreprocessedRequest(
                     token_ids=[7, 8, 9, 10] * 4,
@@ -546,6 +559,54 @@ def test_spec_gates_fall_back_cleanly(run):
             if gamma:
                 assert enginew.stats["spec_accepted"] > 0, enginew.stats
             await enginew.close()
+        assert streams[0] == streams[3], streams
+
+    run(main())
+
+
+def test_spec_engages_on_mla_models(run):
+    """The MLA spec gate is closed: a DeepSeek-shaped engine must accept
+    forced true-chain proposals and reproduce the plain greedy stream
+    exactly (absorbed multi-token verify + latent cache appends)."""
+    from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    mla_model = dict(
+        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        q_lora_rank=24, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=32, num_shared_experts=1,
+        first_dense_layers=1, num_layers=3,
+    )
+
+    async def main():
+        streams = {}
+        for gamma in (0, 3):
+            cfg = EngineConfig(
+                model=ModelConfig.tiny(**mla_model), num_blocks=64,
+                block_size=8, max_batch_size=2, decode_window=4,
+                spec_gamma=gamma,
+            )
+            engine = JaxEngine(cfg, seed=0)
+            if gamma:
+                _force_proposals(engine, streams[0], gamma)
+            out = await collect(engine.generate(Context(
+                PreprocessedRequest(
+                    token_ids=[7, 8, 9, 10] * 4,
+                    stop_conditions=StopConditions(max_tokens=12),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    eos_token_ids=[],
+                )
+            )))
+            streams[gamma] = [t for o in out for t in o.token_ids]
+            if gamma:
+                assert engine.stats["spec_accepted"] > 0, engine.stats
+            await engine.close()
         assert streams[0] == streams[3], streams
 
     run(main())
@@ -612,26 +673,9 @@ def test_spec_composes_with_logprobs_and_penalties(run):
                 # penalties (correctly) steer generation away from the
                 # very repetition prompt-lookup feeds on, so organic
                 # proposals are flaky — drive them deterministically from
-                # the PLAIN run's stream. Acceptance must then reproduce
-                # that stream exactly, exercising the penalized verify
+                # the PLAIN run's stream, exercising the penalized verify
                 # math plus counts threading across windows.
-                ref_stream = outs[("pen", 0)]
-
-                def forced_proposals():
-                    prop = np.full(
-                        (cfg.max_batch_size, gamma), -1, np.int64
-                    )
-                    found = False
-                    for i, seq in enumerate(engine._active):
-                        if seq is None or seq.finished:
-                            continue
-                        nxt = ref_stream[seq.generated: seq.generated + gamma]
-                        if nxt:
-                            prop[i, : len(nxt)] = nxt
-                            found = True
-                    return prop if found else None
-
-                engine._propose_ngram = forced_proposals
+                _force_proposals(engine, outs[("pen", 0)], gamma)
             out2 = await collect(engine.generate(Context(pen_req())))
             outs[("pen", gamma)] = [t for o in out2 for t in o.token_ids]
             stats[gamma] = dict(engine.stats)
