@@ -245,15 +245,34 @@ class JaxEngine(AsyncEngine):
         tp = self.mesh.shape["tp"] if self.mesh is not None else 1
         self.use_pallas = (
             jax.default_backend() == "tpu"
-            and cfg.model.head_dim % 128 == 0
             and cfg.block_size % 8 == 0
-            and (self.mesh is None or cfg.model.num_kv_heads % tp == 0)
             # quantized KV caches take the XLA path (which casts on read);
             # the Mosaic kernels assume bf16/f32 page tiles
             and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
-            # MLA runs the absorbed XLA latent path (models/mla.py); a
-            # Mosaic latent kernel is a follow-up
-            and not cfg.model.is_mla
+            and (
+                (
+                    not cfg.model.is_mla
+                    and cfg.model.head_dim % 128 == 0
+                    and (
+                        self.mesh is None
+                        or cfg.model.num_kv_heads % tp == 0
+                    )
+                )
+                or (
+                    # MLA: the latent decode kernel + merged one-write
+                    # append (ops/mla_attention_pallas). Query heads are
+                    # the tp axis; the latent cache replicates — but pp
+                    # shards the cache's LAYER axis, which the per-layer
+                    # shard_map would have to all-gather back, so pp
+                    # meshes keep the XLA absorbed path.
+                    cfg.model.is_mla
+                    and cfg.model.kv_lora_rank % 128 == 0
+                    and (
+                        self.mesh is None
+                        or self.mesh.shape.get("pp", 1) == 1
+                    )
+                )
+            )
         )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         # re-admissions (preemption replay, backpressure put-back) jump

@@ -634,6 +634,7 @@ def _decode_body(
     x = _embed(params, cfg, tokens)  # [B, E]
     if cfg.is_mla:
         from . import mla as _mla
+        from ..ops import mla_attention_pallas as _mla_ops
 
         inv_freq, msc = _mla.mla_rope_freqs(cfg)
         scale = cfg.mla_softmax_scale()
@@ -655,7 +656,8 @@ def _decode_body(
 
     def mla_layer(x, lp, kc_l, vc_l):
         """One MLA decode layer against full cache layers kc_l/vc_l:
-        write the token's latent, absorbed attention, output fold."""
+        write the token's latent, absorbed attention (latent kernel when
+        use_pallas, XLA gather otherwise), output fold."""
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
             lp, cfg, h, positions, inv_freq, msc
@@ -665,9 +667,20 @@ def _decode_body(
         # them): the slice is [1, B, D], so the update is value[None]
         kc_l = kc_l.at[:, blk, off].set(c_kv[None].astype(kc_l.dtype))
         vc_l = vc_l.at[:, blk, off].set(k_pe[None].astype(vc_l.dtype))
-        o = _mla.mla_decode_attention_xla(
-            q_eff, q_pe, kc_l, vc_l, block_tables, seq_lens, scale
-        )
+        if use_pallas and mesh is not None:
+            o = _mla_ops.mla_paged_decode_attention_sharded(
+                q_eff, q_pe, kc_l, vc_l, block_tables, seq_lens, scale,
+                mesh, interpret=interpret,
+            )
+        elif use_pallas:
+            o = _mla_ops.mla_paged_decode_attention(
+                q_eff, q_pe, kc_l, vc_l, block_tables, seq_lens, scale,
+                interpret=interpret,
+            )
+        else:
+            o = _mla.mla_decode_attention_xla(
+                q_eff, q_pe, kc_l, vc_l, block_tables, seq_lens, scale
+            )
         o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
         return layer_tail(x, lp, o), kc_l, vc_l
 
@@ -675,8 +688,60 @@ def _decode_body(
     blk, off = att.decode_slot_indices(
         block_tables, positions, k_cache.shape[3]
     )
+    mla_merged = merged and unroll and use_pallas and cfg.is_mla
     merged = merged and unroll and use_pallas and not cfg.is_mla
-    if cfg.is_mla and unroll:
+    if mla_merged:
+        # MERGED one-write path, MLA flavor: the latent kernel scores
+        # history with stats, the current token's (c_kv, k_pe) folds in
+        # via the flash merge, and ALL layers' latent writes batch into
+        # one in-place Pallas append — same 2L-scatters-to-1-append trick
+        # as the GQA merged branch below. On a mesh the query heads are
+        # the parallel axis and the latent cache replicates (MQA shape —
+        # see parallel/mesh.cache_sharding), so attention shard_maps over
+        # tp and every device RMWs its cache replica.
+        from ..ops.kv_cache_update_pallas import (
+            kv_cache_append,
+            kv_cache_append_replicated,
+        )
+
+        hist_lens = seq_lens - 1  # cache contents EXCLUDE the new token
+        c_news, pe_news = [], []
+        for lps, n, goff in layer_groups(params, cfg):
+            for li in range(n):
+                l = goff + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+                q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
+                    lp, cfg, h, positions, inv_freq, msc
+                )
+                c_news.append(c_kv)
+                pe_news.append(k_pe)
+                if mesh is None:
+                    o_lat = _mla_ops.mla_decode_attention_merged(
+                        q_eff, q_pe, c_kv, k_pe, k_cache[l], v_cache[l],
+                        block_tables, hist_lens, scale, interpret=interpret,
+                    )
+                else:
+                    o_lat = _mla_ops.mla_decode_attention_merged_sharded(
+                        q_eff, q_pe, c_kv, k_pe, k_cache[l], v_cache[l],
+                        block_tables, hist_lens, scale, mesh,
+                        interpret=interpret,
+                    )
+                o = _mla._o_proj(lp, cfg, o_lat).astype(x.dtype)
+                x = layer_tail(x, lp, o)
+        c_stack = jnp.stack(c_news)[:, :, None, :]  # [L, B, 1, C]
+        pe_stack = jnp.stack(pe_news)[:, :, None, :]  # [L, B, 1, R]
+        if mesh is None:
+            k_cache, v_cache = kv_cache_append(
+                c_stack, pe_stack, k_cache, v_cache, blk, off,
+                interpret=interpret,
+            )
+        else:
+            k_cache, v_cache = kv_cache_append_replicated(
+                c_stack, pe_stack, k_cache, v_cache, blk, off, mesh,
+                interpret=interpret,
+            )
+    elif cfg.is_mla and unroll:
         for lps, n, goff in layer_groups(params, cfg):
             for li in range(n):
                 l = goff + li

@@ -17,10 +17,11 @@ start:
     full K/V, so HBM reads per step stay at
     ``kv_lora_rank + qk_rope_head_dim`` bytes/token (the entire point of
     MLA; 576 vs 2*128*Hkv for V3);
-  * everything is dense XLA einsums over gathered pages (MQA-shaped:
-    one shared KV stream, H query heads) — MXU-friendly; a Pallas
-    latent kernel is a follow-up, the XLA path is the correctness
-    baseline.
+  * the XLA paths here (dense einsums over gathered pages, MQA-shaped:
+    one shared KV stream, H query heads) are the correctness baseline
+    and serve CPU/meshes; single-host TPU decode runs the Pallas latent
+    kernel + merged one-write append (ops/mla_attention_pallas) — no
+    per-step page gather, one cache write for all layers.
 
 RoPE uses DeepSeek's YaRN variant over the qk_rope dims, with the
 mscale cos/sin correction and the mscale_all_dim softmax-scale

@@ -115,6 +115,34 @@ def kv_cache_append_sharded(
     )(k_new, v_new, k_cache, v_cache, blk, off)
 
 
+def kv_cache_append_replicated(
+    k_new: jnp.ndarray,  # [L, B, Hkv, Dk] replicated
+    v_new: jnp.ndarray,  # [L, B, Hkv, Dv] replicated
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, Dk] replicated
+    v_cache: jnp.ndarray,
+    blk: jnp.ndarray,
+    off: jnp.ndarray,
+    mesh,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The append kernel on a mesh whose cache is fully REPLICATED (the
+    MLA latent cache: single kv "head", so no tp axis to shard — see
+    parallel/mesh.cache_sharding). shard_map with all-replicated specs
+    pins the pallas_call per device; each redundantly RMWs its replica,
+    which beats letting GSPMD guess a partition for the kernel."""
+    import functools as _ft
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        _ft.partial(_append_call, interpret=interpret),
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(k_new, v_new, k_cache, v_cache, blk, off)
+
+
 def _append_tokens_kernel(
     # scalar prefetch
     page_ref,  # [B] int32 this phase's target page per sequence
@@ -266,8 +294,11 @@ def kv_cache_append_tokens_sharded(
 
 def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
     """The pallas_call body shared by the single-device and shard_map
-    paths (operates on whatever shard it is handed)."""
-    L, B, Hkv, D = k_new.shape
+    paths (operates on whatever shard it is handed). The two caches may
+    have DIFFERENT trailing dims (MLA stores the c_kv latent in the
+    k slot and the head-shared k_pe in the v slot)."""
+    L, B, Hkv, Dk = k_new.shape
+    Dv = v_new.shape[-1]
     bs = k_cache.shape[3]
     if interpret:
         # CPU/shard_map tests: same scatter as kv_cache_append's interpret
@@ -281,27 +312,22 @@ def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
             v_new.astype(v_cache.dtype)
         )
         return k_cache, v_cache
+    k_page = pl.BlockSpec(
+        (1, Hkv, 1, bs, Dk), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+    )
+    v_page = pl.BlockSpec(
+        (1, Hkv, 1, bs, Dv), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(L, B),
         in_specs=[
-            pl.BlockSpec((1, 1, Hkv, D), lambda l, b, blk, off: (l, b, 0, 0)),
-            pl.BlockSpec((1, 1, Hkv, D), lambda l, b, blk, off: (l, b, 0, 0)),
-            pl.BlockSpec(
-                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
-            ),
+            pl.BlockSpec((1, 1, Hkv, Dk), lambda l, b, blk, off: (l, b, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, Dv), lambda l, b, blk, off: (l, b, 0, 0)),
+            k_page,
+            v_page,
         ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, Hkv, 1, bs, D), lambda l, b, blk, off: (l, 0, blk[b], 0, 0)
-            ),
-        ],
+        out_specs=[k_page, v_page],
     )
     return pl.pallas_call(
         _append_kernel,

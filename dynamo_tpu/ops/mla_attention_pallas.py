@@ -1,0 +1,336 @@
+"""Pallas TPU kernel: paged LATENT attention for MLA decode.
+
+The reference serves DeepSeek through vLLM, whose GPU MLA path pairs a
+fused latent decode kernel with reshape_and_cache (README workloads;
+patch:3548-3560). Here the equivalent is a Mosaic kernel over the
+COMPRESSED cache (models/mla.py layout): per token the cache holds the
+kv_lora_rank latent ``c_kv`` and the head-shared rotated ``k_pe`` —
+attention is MQA-shaped (one shared KV stream, H query heads), scores
+are the two-part absorbed dot ``q_eff . c_kv + q_pe . k_pe``, and the
+VALUES are the ``c_kv`` latents themselves (the caller folds the output
+latent through w_vc).
+
+Design mirrors ops/paged_attention_pallas (the decode make-or-break,
+SURVEY §7): grid = (batch, superblocks of P logical pages), the block
+table scalar-prefetched so per-page ``index_map``s DMA exactly the
+needed physical [bs, C] / [bs, R] tiles (pages past a sequence's length
+re-map to its last valid page — consecutive identical indices skip the
+re-fetch), fp32 online softmax in VMEM scratch, output written once.
+The kv-head grid axis is gone (Hkv == 1 by construction) and the H
+query heads pack the row dimension — H is 16..128 for real DeepSeek
+configs, so the score matrix [H, P*bs] is MXU-shaped without the
+query-group packing the GQA kernel needs.
+
+The stats-emitting variant (m, l) powers the MERGED one-write decode:
+attention handles the current token out-of-cache (flash merge), so the
+step batches all layers' latent writes into one in-place append
+(ops/kv_cache_update_pallas) instead of 2L XLA scatters that each copy
+the cache.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# one superblock-sizing policy for every paged kernel (GQA and MLA pick
+# the same page pipeline for the same block table)
+from .paged_attention_pallas import _pick_pages_per_step
+
+_NEG_INF = -1e30
+
+
+def _mla_decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, M] int32 (SMEM)
+    seq_lens_ref,  # [B] int32 (SMEM)
+    # inputs: q_eff, q_pe, then P c-page refs then P pe-page refs
+    *refs,
+    scale: float,
+    block_size: int,
+    pages_per_step: int,
+    return_stats: bool,
+):
+    P = pages_per_step
+    qc_ref = refs[0]  # [1, Hp, C]
+    qp_ref = refs[1]  # [1, Hp, R]
+    c_refs = refs[2 : 2 + P]  # each [1, 1, bs, C]
+    pe_refs = refs[2 + P : 2 + 2 * P]  # each [1, 1, bs, R]
+    if return_stats:
+        o_ref, mo_ref, lo_ref = refs[2 + 2 * P : 5 + 2 * P]
+        m_scr, l_scr, acc_scr = refs[5 + 2 * P :]
+    else:
+        o_ref = refs[2 + 2 * P]  # [1, Hp, C]
+        m_scr, l_scr, acc_scr = refs[3 + 2 * P :]
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = seq_lens_ref[b]
+    start = i * (P * block_size)
+
+    @pl.when(start < seq_len)
+    def _superblock():
+        qc = qc_ref[0].astype(jnp.float32) * scale  # [Hp, C]
+        qp = qp_ref[0].astype(jnp.float32) * scale  # [Hp, R]
+        c = jnp.concatenate(
+            [r[0, 0] for r in c_refs], axis=0
+        ).astype(jnp.float32)  # [P*bs, C]
+        pe = jnp.concatenate([r[0, 0] for r in pe_refs], axis=0).astype(
+            jnp.float32
+        )  # [P*bs, R]
+        # two-part absorbed score; separate dots keep each contracted dim
+        # at its natural width (C and R) instead of a concat at C+R,
+        # which is rarely lane-aligned (576 for V2/V3)
+        s = jax.lax.dot_general(
+            qc, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            qp, pe, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Hp, P*bs]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0:1]  # [Hp, 1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)  # [Hp, P*bs]
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # values ARE the latents
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-20)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if return_stats:
+            mo_ref[0] = m_scr[...]
+            lo_ref[0] = l_scr[...]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "pages_per_step", "return_stats", "interpret"),
+)
+def mla_paged_decode_attention(
+    q_eff: jnp.ndarray,  # [B, H, C] absorbed queries
+    q_pe: jnp.ndarray,  # [B, H, R]
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C]
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
+    block_tables: jnp.ndarray,  # [B, M] int32
+    seq_lens: jnp.ndarray,  # [B] int32
+    scale: float,
+    pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
+    return_stats: bool = False,
+    interpret: bool = False,
+):  # [B, H, C] f-out, or (out, m [B, H], l [B, H]) when return_stats
+    B, H, C = q_eff.shape
+    _, N, bs, R = pe_cache_layer.shape
+    M = block_tables.shape[1]
+    P = pages_per_step or _pick_pages_per_step(M)
+    if M % P:
+        raise ValueError(
+            f"pages_per_step={P} must divide table width M={M} "
+            "(a truncated grid would silently drop tail pages)"
+        )
+    Hp = max(8, -(-H // 8) * 8)  # fp32 sublane quantum
+    qc = q_eff.astype(jnp.float32)
+    qp = q_pe.astype(jnp.float32)
+    if Hp != H:
+        qc = jnp.pad(qc, ((0, 0), (0, Hp - H), (0, 0)))
+        qp = jnp.pad(qp, ((0, 0), (0, Hp - H), (0, 0)))
+
+    def page_index(j):
+        def index(b, i, bt, sl):
+            last = jnp.maximum(sl[b] - 1, 0) // bs
+            return (0, bt[b, jnp.minimum(i * P + j, last)], 0, 0)
+
+        return index
+
+    c_specs = [pl.BlockSpec((1, 1, bs, C), page_index(j)) for j in range(P)]
+    pe_specs = [pl.BlockSpec((1, 1, bs, R), page_index(j)) for j in range(P)]
+    o_spec = pl.BlockSpec((1, Hp, C), lambda b, i, bt, sl: (b, 0, 0))
+    stat_spec = pl.BlockSpec((1, Hp, 128), lambda b, i, bt, sl: (b, 0, 0))
+    out_specs = [o_spec, stat_spec, stat_spec] if return_stats else o_spec
+    out_shape = jax.ShapeDtypeStruct((B, Hp, C), q_eff.dtype)
+    if return_stats:
+        stat_shape = jax.ShapeDtypeStruct((B, Hp, 128), jnp.float32)
+        out_shape = [out_shape, stat_shape, stat_shape]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, M // P),
+        in_specs=[
+            pl.BlockSpec((1, Hp, C), lambda b, i, bt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, Hp, R), lambda b, i, bt, sl: (b, 0, 0)),
+            *c_specs,
+            *pe_specs,
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((Hp, 128), jnp.float32),
+            pltpu.VMEM((Hp, 128), jnp.float32),
+            pltpu.VMEM((Hp, C), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_decode_kernel, scale=scale, block_size=bs, pages_per_step=P,
+        return_stats=return_stats,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * H * M * bs * (C + R + C),
+            bytes_accessed=(
+                M * bs * (C + R) * c_cache_layer.dtype.itemsize * B
+            ),
+            transcendentals=B * H * M * bs,
+        ),
+        interpret=interpret,
+    )(
+        block_tables, seq_lens, qc, qp,
+        *([c_cache_layer] * P), *([pe_cache_layer] * P),
+    )
+    if return_stats:
+        o, m, l = out
+        return o[:, :H, :], m[:, :H, 0], l[:, :H, 0]
+    return out[:, :H, :]
+
+
+def mla_paged_decode_attention_sharded(
+    q_eff: jnp.ndarray,  # [B, H, C], H sharded over tp
+    q_pe: jnp.ndarray,  # [B, H, R], H sharded over tp
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] replicated
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R] replicated
+    block_tables: jnp.ndarray,  # [B, M] replicated
+    seq_lens: jnp.ndarray,  # [B] replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The latent kernel under shard_map over ``tp``: query heads are
+    the parallel axis (see mla_decode_attention_merged_sharded's note on
+    why the cache replicates), no collectives."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        partial(mla_paged_decode_attention, scale=scale,
+                interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q_eff
+            P(None, "tp", None),  # q_pe
+            P(),  # c cache
+            P(),  # pe cache
+            P(),  # tables
+            P(),  # seq_lens
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q_eff, q_pe, c_cache_layer, pe_cache_layer, block_tables, seq_lens)
+
+
+def mla_decode_attention_merged(
+    q_eff: jnp.ndarray,  # [B, H, C]
+    q_pe: jnp.ndarray,  # [B, H, R]
+    c_new: jnp.ndarray,  # [B, C] current token's latent (NOT in cache)
+    pe_new: jnp.ndarray,  # [B, R] current token's rotated k_pe
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] history only
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
+    block_tables: jnp.ndarray,  # [B, M]
+    hist_lens: jnp.ndarray,  # [B] tokens in cache (EXCLUDES current)
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:  # [B, H, C] latent output
+    """MLA decode attention with the current token handled OUT of the
+    cache: history via the stats-emitting latent kernel, the current
+    token's score ``q_eff.c_new + q_pe.pe_new`` (value: ``c_new``,
+    shared across heads) folded in with the flash-decoding merge — the
+    same one-write trick as ops/attention.decode_attention_merged, so
+    all layers' latent writes batch into one in-place append.
+    hist_lens == 0 rows degenerate cleanly to out = c_new."""
+    o_h, m_h, l_h = mla_paged_decode_attention(
+        q_eff, q_pe, c_cache_layer, pe_cache_layer, block_tables, hist_lens,
+        scale, return_stats=True, interpret=interpret,
+    )
+    o_h = o_h.astype(jnp.float32)
+    s_new = (
+        jnp.einsum(
+            "bhc,bc->bh", q_eff.astype(jnp.float32), c_new.astype(jnp.float32)
+        )
+        + jnp.einsum(
+            "bhr,br->bh", q_pe.astype(jnp.float32), pe_new.astype(jnp.float32)
+        )
+    ) * scale  # [B, H]
+    m_f = jnp.maximum(m_h, s_new)
+    alpha = jnp.exp(m_h - m_f)
+    p_new = jnp.exp(s_new - m_f)
+    num = (l_h * alpha)[..., None] * o_h + p_new[..., None] * c_new[
+        :, None, :
+    ].astype(jnp.float32)
+    den = l_h * alpha + p_new  # >= p_new > 0: the current token is live
+    return num / den[..., None]
+
+
+def mla_decode_attention_merged_sharded(
+    q_eff: jnp.ndarray,  # [B, H, C], H sharded over tp
+    q_pe: jnp.ndarray,  # [B, H, R], H sharded over tp
+    c_new: jnp.ndarray,  # [B, C] replicated
+    pe_new: jnp.ndarray,  # [B, R] replicated
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] replicated
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R] replicated
+    block_tables: jnp.ndarray,  # [B, M] replicated
+    hist_lens: jnp.ndarray,  # [B] replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Merged latent attention under shard_map over ``tp``: MLA is
+    MQA-shaped, so the QUERY-head axis is the parallel one — each device
+    runs the kernel for its H/tp heads against the full (replicated)
+    latent cache, no collectives. (The cache cannot shard over kv heads
+    the way GQA does — there is only one latent stream — and at
+    kv_lora_rank+rope bytes/token it is ~4x smaller than a GQA cache,
+    which is the MLA trade: replicate small cache, shard heads.)"""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        partial(mla_decode_attention_merged, scale=scale,
+                interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q_eff
+            P(None, "tp", None),  # q_pe
+            P(),  # c_new
+            P(),  # pe_new
+            P(),  # c cache
+            P(),  # pe cache
+            P(),  # tables
+            P(),  # hist_lens
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q_eff, q_pe, c_new, pe_new, c_cache_layer, pe_cache_layer,
+      block_tables, hist_lens)
