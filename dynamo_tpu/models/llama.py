@@ -1006,15 +1006,18 @@ def _verify_forward(
     off = pos_bt % bs
 
     if cfg.is_mla:
-        # MLA verify: absorbed attention with all T rows' latents written
-        # BEFORE attending (same write-then-attend convention as the MLA
-        # decode path), per-row causal masking at absolute positions.
-        # Rows past the accepted run live above the commit horizon and
-        # are overwritten before any read (same invariant as below).
+        # MLA verify: absorbed multi-token attention with the in-flight
+        # window OUT of the cache (ops/mla_attention_pallas
+        # .mla_verify_attention), so all layers' latent writes batch
+        # into ONE append instead of 2L cache-copying scatters. Rows
+        # past the accepted run live above the commit horizon and are
+        # overwritten before any read (same invariant as below).
         from . import mla as _mla
+        from ..ops import mla_attention_pallas as _mla_ops
 
         inv_freq, msc = _mla.mla_rope_freqs(cfg)
         scale = cfg.mla_softmax_scale()
+        c_news, pe_news = [], []
         for lps, ng, goff in layer_groups(params, cfg):
             for li in range(ng):
                 l = goff + li
@@ -1023,16 +1026,13 @@ def _verify_forward(
                 q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
                     lp, cfg, h, pos_bt, inv_freq, msc
                 )
-                kc_l = k_cache[l].at[:, blk, off].set(
-                    c_kv[None].astype(k_cache.dtype)
-                )
-                vc_l = v_cache[l].at[:, blk, off].set(
-                    k_pe[None].astype(v_cache.dtype)
-                )
-                k_cache = k_cache.at[l].set(kc_l)
-                v_cache = v_cache.at[l].set(vc_l)
-                o = _mla.mla_verify_attention_xla(
-                    q_eff, q_pe, kc_l, vc_l, block_tables, pos_bt, scale
+                c_news.append(c_kv)
+                pe_news.append(k_pe)
+                o = _mla_ops.mla_verify_attention(
+                    q_eff, q_pe, c_kv, k_pe, k_cache[l], v_cache[l],
+                    block_tables, hist_lens, scale,
+                    use_pallas=use_pallas and mesh is None,
+                    interpret=interpret,
                 )
                 o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
                 x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
@@ -1042,6 +1042,12 @@ def _verify_forward(
                 )
         x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
+        k_cache, v_cache = kv_cache_append_tokens(
+            jnp.stack(c_news)[:, :, :, None, :],  # [L, B, T, 1, C]
+            jnp.stack(pe_news)[:, :, :, None, :],  # [L, B, T, 1, R]
+            k_cache, v_cache, blk, off,
+            interpret=interpret or not use_pallas or mesh is not None,
+        )
         return logits, k_cache, v_cache
 
     inv_freq = _rope_freqs(cfg)

@@ -186,40 +186,6 @@ def mla_prefill_attention_xla(
     return jnp.einsum("ths,sc->thc", p, ck.astype(jnp.float32))
 
 
-def mla_verify_attention_xla(
-    q_eff: jnp.ndarray,  # [B, T, H, C] absorbed queries, T in-flight tokens
-    q_pe: jnp.ndarray,  # [B, T, H, R]
-    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] — window ALREADY written
-    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
-    block_tables: jnp.ndarray,  # [B, M]
-    q_pos: jnp.ndarray,  # [B, T] absolute position of each in-flight token
-    scale: float,
-) -> jnp.ndarray:  # [B, T, H, C] latent output
-    """Multi-token decode attention for the speculative verify: T
-    in-flight tokens per sequence attend cached history plus the causal
-    prefix of their own window. Write-before-attend like the MLA decode
-    path (the window's latents are scattered into the cache first), so
-    per-row causal masking at absolute positions is the only bookkeeping
-    — no out-of-cache merge needed."""
-    B, T, H, C = q_eff.shape
-    M = block_tables.shape[1]
-    bs = c_cache_layer.shape[2]
-    ck = jnp.take(c_cache_layer[0], block_tables, axis=0).reshape(B, M * bs, C)
-    kp = jnp.take(pe_cache_layer[0], block_tables, axis=0).reshape(
-        B, M * bs, -1
-    )
-    s = (
-        jnp.einsum("bthc,bsc->bths", q_eff.astype(jnp.float32) * scale,
-                   ck.astype(jnp.float32))
-        + jnp.einsum("bthr,bsr->bths", q_pe.astype(jnp.float32) * scale,
-                     kp.astype(jnp.float32))
-    )
-    valid = jnp.arange(M * bs)[None, None, :] <= q_pos[:, :, None]  # [B,T,S]
-    s = jnp.where(valid[:, :, None, :], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bths,bsc->bthc", p, ck.astype(jnp.float32))
-
-
 def mla_decode_attention_xla(
     q_eff: jnp.ndarray,  # [B, H, C]
     q_pe: jnp.ndarray,  # [B, H, R]

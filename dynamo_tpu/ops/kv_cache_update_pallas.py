@@ -197,8 +197,10 @@ def kv_cache_append_tokens(
     prefetched tile and lose the earlier step's rows. Sequences that
     don't cross a boundary point phase 1 at the sacrificial page 0 (a
     benign passthrough; real pages are never 0). Requires T <= block_size.
+    The two caches may have different trailing dims (MLA: c_kv vs k_pe).
     """
-    L, B, T, Hkv, D = k_new.shape
+    L, B, T, Hkv, Dk = k_new.shape
+    Dv = v_new.shape[-1]
     bs = k_cache.shape[3]
     if T > bs:
         raise ValueError(f"T={T} in-flight rows must fit a page (bs={bs})")
@@ -221,23 +223,26 @@ def kv_cache_append_tokens(
     off0 = off[:, 0]
 
     for phase, page in ((0, blk0), (1, blk1)):
-        page_spec = pl.BlockSpec(
-            (1, Hkv, 1, bs, D), lambda l, b, pg, o0: (l, 0, pg[b], 0, 0)
+        k_page = pl.BlockSpec(
+            (1, Hkv, 1, bs, Dk), lambda l, b, pg, o0: (l, 0, pg[b], 0, 0)
+        )
+        v_page = pl.BlockSpec(
+            (1, Hkv, 1, bs, Dv), lambda l, b, pg, o0: (l, 0, pg[b], 0, 0)
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(L, B),
             in_specs=[
                 pl.BlockSpec(
-                    (1, 1, T, Hkv, D), lambda l, b, pg, o0: (l, b, 0, 0, 0)
+                    (1, 1, T, Hkv, Dk), lambda l, b, pg, o0: (l, b, 0, 0, 0)
                 ),
                 pl.BlockSpec(
-                    (1, 1, T, Hkv, D), lambda l, b, pg, o0: (l, b, 0, 0, 0)
+                    (1, 1, T, Hkv, Dv), lambda l, b, pg, o0: (l, b, 0, 0, 0)
                 ),
-                page_spec,
-                page_spec,
+                k_page,
+                v_page,
             ],
-            out_specs=[page_spec, page_spec],
+            out_specs=[k_page, v_page],
         )
         kernel = functools.partial(
             _append_tokens_kernel, n_tokens=T, block_size=bs, phase=phase

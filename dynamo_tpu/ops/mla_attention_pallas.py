@@ -292,6 +292,82 @@ def mla_decode_attention_merged(
     return num / den[..., None]
 
 
+def mla_verify_attention(
+    q_eff: jnp.ndarray,  # [B, T, H, C] T in-flight tokens' absorbed queries
+    q_pe: jnp.ndarray,  # [B, T, H, R]
+    c_win: jnp.ndarray,  # [B, T, C] their latents (NOT in cache)
+    pe_win: jnp.ndarray,  # [B, T, R]
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] history only
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
+    block_tables: jnp.ndarray,  # [B, M]
+    hist_lens: jnp.ndarray,  # [B] tokens in cache (before the window)
+    scale: float,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:  # [B, T, H, C] f32 latent outputs
+    """Multi-token latent attention for the speculative verify, with the
+    whole in-flight window OUT of the cache: history comes from the
+    stats-emitting latent kernel (every history row precedes every
+    window position, so the T*H query rows simply pack the kernel's row
+    dimension) or its XLA twin; the tiny [T, T'] intra-window causal
+    part is dense and folds in with the flash merge. Keeping the window
+    out of the cache lets the caller batch all layers' latent writes
+    into ONE append (kv_cache_append_tokens) instead of 2L scatters that
+    each copy the cache."""
+    B, T, H, C = q_eff.shape
+    R = q_pe.shape[-1]
+    if use_pallas:
+        o_h, m_h, l_h = mla_paged_decode_attention(
+            q_eff.reshape(B, T * H, C), q_pe.reshape(B, T * H, R),
+            c_cache_layer, pe_cache_layer, block_tables, hist_lens, scale,
+            return_stats=True, interpret=interpret,
+        )
+        o_h = o_h.reshape(B, T, H, C).astype(jnp.float32)
+        m_h = m_h.reshape(B, T, H)
+        l_h = l_h.reshape(B, T, H)
+    else:
+        M = block_tables.shape[1]
+        bs = c_cache_layer.shape[2]
+        ck = jnp.take(c_cache_layer[0], block_tables, axis=0).reshape(
+            B, M * bs, C
+        )
+        kp = jnp.take(pe_cache_layer[0], block_tables, axis=0).reshape(
+            B, M * bs, -1
+        )
+        s = (
+            jnp.einsum("bthc,bsc->bths", q_eff.astype(jnp.float32) * scale,
+                       ck.astype(jnp.float32))
+            + jnp.einsum("bthr,bsr->bths", q_pe.astype(jnp.float32) * scale,
+                         kp.astype(jnp.float32))
+        )
+        valid = jnp.arange(M * bs)[None, :] < hist_lens[:, None]  # [B, S]
+        s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+        m_h = jnp.max(s, axis=-1)  # [B, T, H]
+        p = jnp.exp(s - m_h[..., None])
+        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        l_h = jnp.sum(p, axis=-1)
+        o_h = jnp.einsum("bths,bsc->bthc", p, ck.astype(jnp.float32))
+        o_h = o_h / jnp.maximum(l_h, 1e-20)[..., None]
+    # intra-window causal scores [B, T, H, T']
+    s_w = (
+        jnp.einsum("bthc,buc->bthu", q_eff.astype(jnp.float32),
+                   c_win.astype(jnp.float32))
+        + jnp.einsum("bthr,bur->bthu", q_pe.astype(jnp.float32),
+                     pe_win.astype(jnp.float32))
+    ) * scale
+    causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]  # [T, T']
+    s_w = jnp.where(causal[:, None, :], s_w, _NEG_INF)
+    m_w = jnp.max(s_w, axis=-1)  # [B, T, H]
+    m_f = jnp.maximum(m_h, m_w)
+    alpha = jnp.exp(m_h - m_f)
+    p_w = jnp.exp(s_w - m_f[..., None])
+    o_w = jnp.einsum("bthu,buc->bthc", p_w, c_win.astype(jnp.float32))
+    l_w = jnp.sum(p_w, axis=-1)
+    num = (l_h * alpha)[..., None] * o_h + o_w
+    den = l_h * alpha + l_w  # >= the diagonal term (u == t) > 0
+    return num / den[..., None]
+
+
 def mla_decode_attention_merged_sharded(
     q_eff: jnp.ndarray,  # [B, H, C], H sharded over tp
     q_pe: jnp.ndarray,  # [B, H, R], H sharded over tp

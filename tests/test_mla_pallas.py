@@ -211,6 +211,48 @@ def test_mla_pallas_decode_on_tp_mesh_matches_single_device():
     np.testing.assert_array_equal(streams["ref"], streams["mesh-plain"])
 
 
+def test_mla_verify_attention_matches_write_then_attend():
+    """Out-of-cache multi-token latent verify (both the XLA twin and the
+    kernel-backed path) must equal writing the window's latents then
+    attending per position through the cache."""
+    from dynamo_tpu.ops.mla_attention_pallas import mla_verify_attention
+
+    B, T, M, C, R, H = 2, 3, 4, 32, 8, 4
+    N = B * M + 1
+    ks = jax.random.split(jax.random.key(6), 6)
+    q_eff = jax.random.normal(ks[0], (B, T, H, C), jnp.float32)
+    q_pe = jax.random.normal(ks[1], (B, T, H, R), jnp.float32)
+    c_win = jax.random.normal(ks[2], (B, T, C), jnp.float32)
+    pe_win = jax.random.normal(ks[3], (B, T, R), jnp.float32)
+    c_cache = jax.random.normal(ks[4], (1, N, BS, C), jnp.float32)
+    pe_cache = jax.random.normal(ks[5], (1, N, BS, R), jnp.float32)
+    tables = jnp.asarray(np.arange(1, N, dtype=np.int32).reshape(B, M))
+    hist = jnp.asarray([0, BS + 3], jnp.int32)  # hist 0: window-only row
+    scale = 0.19
+
+    cc, pc = c_cache, pe_cache
+    for b in range(B):
+        for t in range(T):
+            pos = int(hist[b]) + t
+            blk, off = int(tables[b, pos // BS]), pos % BS
+            cc = cc.at[0, blk, off].set(c_win[b, t])
+            pc = pc.at[0, blk, off].set(pe_win[b, t])
+    for use_pallas in (False, True):
+        got = mla_verify_attention(
+            q_eff, q_pe, c_win, pe_win, c_cache, pe_cache, tables, hist,
+            scale, use_pallas=use_pallas, interpret=True,
+        )
+        for t in range(T):
+            ref_t = mla.mla_decode_attention_xla(
+                q_eff[:, t], q_pe[:, t], cc, pc, tables, hist + t + 1, scale
+            )
+            np.testing.assert_allclose(
+                np.asarray(got[:, t]), np.asarray(ref_t),
+                rtol=2e-5, atol=2e-5,
+                err_msg=f"use_pallas={use_pallas} t={t}",
+            )
+
+
 def test_mla_kernel_stats_power_the_merge():
     """return_stats must emit the exact (m, l) of the history softmax:
     reconstructing full attention from (o, m, l) + the current token
