@@ -306,12 +306,15 @@ class DeploymentController:
             "DYN_SERVICE": svc.name,
         }
         if svc.num_nodes > 1:
+            # coordinator = rank 0's host; one port per replica group.
+            # Empty hosts = every rank local (dev fleet on one box; the
+            # k8s renderer covers platform-scheduled ranks instead).
+            head = svc.hosts[0] if svc.hosts else "127.0.0.1"
             env.update({
                 "DYN_NODE_RANK": str(rank),
                 "DYN_NUM_NODES": str(svc.num_nodes),
-                # coordinator = rank 0's host; one port per replica group
                 "DYN_COORDINATOR": (
-                    f"{svc.hosts[0]}:{svc.coordinator_port + replica}"
+                    f"{head}:{svc.coordinator_port + replica}"
                 ),
             })
         return env

@@ -82,14 +82,16 @@ class ServiceDeploymentSpec:
     http_port: int = 0
     ingress_host: str = ""
     # multi-host SPMD engines (BASELINE config 4: 2 hosts x tp=8): each
-    # REPLICA expands to num_nodes rank processes, rank k placed on
-    # hosts[k % len(hosts)] via the controller's host launcher. Ranks
-    # get DYN_NODE_RANK / DYN_NUM_NODES / DYN_COORDINATOR env (the
-    # coordinator is rank 0's host at coordinator_port + replica index),
-    # and a rank crash restarts the WHOLE replica group — SPMD lockstep
-    # can't survive a lone rank respawn.
+    # REPLICA expands to num_nodes rank processes. With a ``hosts``
+    # list, rank k is placed on hosts[k % len(hosts)] via the
+    # controller's host launcher; with hosts EMPTY the ranks are
+    # platform-scheduled — the k8s renderer emits one StatefulSet per
+    # replica group (rank = pod index, coordinator = pod 0's stable
+    # DNS name). Ranks get DYN_NODE_RANK / DYN_NUM_NODES /
+    # DYN_COORDINATOR env, and a rank crash restarts the WHOLE replica
+    # group — SPMD lockstep can't survive a lone rank respawn.
     num_nodes: int = 1
-    hosts: list[str] = field(default_factory=list)  # empty = local
+    hosts: list[str] = field(default_factory=list)  # empty = platform-placed
     coordinator_port: int = 9900
 
     def validate(self) -> None:
@@ -99,8 +101,6 @@ class ServiceDeploymentSpec:
             raise SpecError("replicas must be >= 0")
         if self.num_nodes < 1:
             raise SpecError("num_nodes must be >= 1")
-        if self.num_nodes > 1 and not self.hosts:
-            raise SpecError("num_nodes > 1 needs a hosts list")
         self.resources.validate()
         self.autoscaling.validate()
 

@@ -18,7 +18,7 @@ Manifests are plain dicts; ``to_yaml`` serializes a multi-doc stream.
 
 from __future__ import annotations
 
-from .crd import DynamoDeployment, ServiceDeploymentSpec
+from .crd import DynamoDeployment, ServiceDeploymentSpec, SpecError
 
 MANAGED_BY = "dynamo-tpu"
 
@@ -98,9 +98,7 @@ def _container(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> dict:
     return c
 
 
-def _service_manifests(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> list[dict]:
-    name = f"{dep.name}-{svc.name}"
-    labels = {"dynamo.component": svc.name}
+def _pod_spec(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> dict:
     pod_spec: dict = {"containers": [_container(dep, svc)]}
     res = svc.resources
     if res.tpu_accelerator:
@@ -111,13 +109,160 @@ def _service_manifests(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> lis
             "cloud.google.com/gke-tpu-accelerator": res.tpu_accelerator,
             "cloud.google.com/gke-tpu-topology": res.tpu_topology,
         }
-    annotations = {}
-    if svc.autoscaling.enabled:
-        a = svc.autoscaling
-        annotations["dynamo.autoscale"] = (
+    return pod_spec
+
+
+def _autoscale_annotations(svc: ServiceDeploymentSpec) -> dict:
+    if not svc.autoscaling.enabled:
+        return {}
+    a = svc.autoscaling
+    return {
+        "dynamo.autoscale": (
             f"min={a.min_replicas},max={a.max_replicas},"
             f"target_queue_depth={a.target_queue_depth}"
         )
+    }
+
+
+def _multihost_service_manifests(
+    dep: DynamoDeployment, svc: ServiceDeploymentSpec
+) -> list[dict]:
+    """A ``num_nodes > 1`` service (BASELINE config 4: one SPMD engine
+    spanning hosts) renders as one StatefulSet PER REPLICA GROUP with
+    ``num_nodes`` pods — the k8s shape of the reference operator's
+    multinode deployments (dynamonimdeployment_controller.go renders
+    LeaderWorkerSet-style groups):
+
+      * rank = pod index (the ``apps.kubernetes.io/pod-index`` label the
+        StatefulSet controller stamps), injected as DYN_NODE_RANK via
+        the downward API — dynamo_run reads it as its --node-rank
+        default;
+      * a headless Service gives pod 0 a stable DNS name, which every
+        rank gets as DYN_COORDINATOR (jax.distributed coordinator);
+      * podManagementPolicy Parallel: SPMD ranks must start together —
+        OrderedReady would deadlock rank 0's barrier on rank 1 never
+        being created;
+      * a whole group restarts together on rank crash (the controller's
+        crash-group semantics); separate groups = separate StatefulSets
+        so one group's rolling restart can't take down another.
+    """
+    if svc.hosts:
+        raise SpecError(
+            f"service {svc.name!r} pins hosts {svc.hosts}; host-pinned "
+            "multi-host services are controller-launched (HostLauncher), "
+            "not k8s-rendered — drop the hosts list to let the scheduler "
+            "place the ranks"
+        )
+    name = f"{dep.name}-{svc.name}"
+    labels = {"dynamo.component": svc.name}
+    # pod-matching labels must carry the DEPLOYMENT too: dynamo.component
+    # alone would cross-select same-named services of another deployment
+    # in the namespace
+    selector = {"dynamo.component": svc.name, "dynamo.deployment": dep.name}
+    headless = f"{name}-ranks"
+    # group-count scaling means adding/removing whole StatefulSets (a
+    # StatefulSet's replicas field is RANKS, which must equal num_nodes),
+    # so the autoscale annotation lives on the service-level object
+    annotations = _autoscale_annotations(svc)
+    out: list[dict] = [
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(dep, headless, labels) | (
+                {"annotations": annotations} if annotations else {}
+            ),
+            "spec": {
+                "clusterIP": "None",  # headless: per-pod DNS records
+                # ranks need the coordinator's DNS record BEFORE pod 0 is
+                # ready (readiness needs jax.distributed init, which
+                # needs all ranks connected — a records-when-ready
+                # headless service would deadlock the group)
+                "publishNotReadyAddresses": True,
+                "selector": dict(selector),
+                "ports": [
+                    {
+                        "port": svc.coordinator_port,
+                        "targetPort": svc.coordinator_port,
+                    }
+                ],
+            },
+        }
+    ]
+    for r in range(svc.replicas):
+        group = f"{name}-g{r}"
+        pod_spec = _pod_spec(dep, svc)
+        env = pod_spec["containers"][0].setdefault("env", [])
+        env.extend(
+            [
+                {"name": "DYN_NUM_NODES", "value": str(svc.num_nodes)},
+                {
+                    "name": "DYN_NODE_RANK",
+                    "valueFrom": {
+                        "fieldRef": {
+                            "fieldPath": (
+                                "metadata.labels"
+                                "['apps.kubernetes.io/pod-index']"
+                            )
+                        }
+                    },
+                },
+                {
+                    "name": "DYN_COORDINATOR",
+                    "value": (
+                        f"{group}-0.{headless}.{dep.namespace}.svc:"
+                        f"{svc.coordinator_port}"
+                    ),
+                },
+            ]
+        )
+        out.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "StatefulSet",
+                "metadata": _meta(dep, group, labels),
+                "spec": {
+                    "serviceName": headless,
+                    "replicas": svc.num_nodes,
+                    "podManagementPolicy": "Parallel",
+                    "selector": {"matchLabels": {"dynamo.service": group}},
+                    "template": {
+                        "metadata": {
+                            "labels": {
+                                "dynamo.service": group,
+                                **selector,
+                            }
+                        },
+                        "spec": pod_spec,
+                    },
+                },
+            }
+        )
+    if svc.http_port:  # front all ranks' pods (the engine serves on rank 0;
+        # non-leaders fail the readiness probe and drop out of endpoints —
+        # this NON-headless service only routes to ready pods)
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": _meta(dep, name, labels),
+                "spec": {
+                    "selector": dict(selector),
+                    "ports": [
+                        {"port": svc.http_port, "targetPort": svc.http_port}
+                    ],
+                },
+            }
+        )
+        if svc.ingress_host:
+            out.append(_ingress(dep, svc, name, labels))
+    return out
+
+
+def _service_manifests(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> list[dict]:
+    name = f"{dep.name}-{svc.name}"
+    labels = {"dynamo.component": svc.name}
+    pod_spec = _pod_spec(dep, svc)
+    annotations = _autoscale_annotations(svc)
     deployment = {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -147,35 +292,38 @@ def _service_manifests(dep: DynamoDeployment, svc: ServiceDeploymentSpec) -> lis
             }
         )
     if svc.ingress_host:
-        out.append(
-            {
-                "apiVersion": "networking.k8s.io/v1",
-                "kind": "Ingress",
-                "metadata": _meta(dep, name, labels),
-                "spec": {
-                    "rules": [
-                        {
-                            "host": svc.ingress_host,
-                            "http": {
-                                "paths": [
-                                    {
-                                        "path": "/",
-                                        "pathType": "Prefix",
-                                        "backend": {
-                                            "service": {
-                                                "name": name,
-                                                "port": {"number": svc.http_port},
-                                            }
-                                        },
-                                    }
-                                ]
-                            },
-                        }
-                    ]
-                },
-            }
-        )
+        out.append(_ingress(dep, svc, name, labels))
     return out
+
+
+def _ingress(dep: DynamoDeployment, svc: ServiceDeploymentSpec,
+             name: str, labels: dict) -> dict:
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": _meta(dep, name, labels),
+        "spec": {
+            "rules": [
+                {
+                    "host": svc.ingress_host,
+                    "http": {
+                        "paths": [
+                            {
+                                "path": "/",
+                                "pathType": "Prefix",
+                                "backend": {
+                                    "service": {
+                                        "name": name,
+                                        "port": {"number": svc.http_port},
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                }
+            ]
+        },
+    }
 
 
 def render_manifests(dep: DynamoDeployment) -> list[dict]:
@@ -183,7 +331,10 @@ def render_manifests(dep: DynamoDeployment) -> list[dict]:
     dep.validate()
     out = _hub_manifests(dep)
     for svc in dep.services:
-        out.extend(_service_manifests(dep, svc))
+        if svc.num_nodes > 1:
+            out.extend(_multihost_service_manifests(dep, svc))
+        else:
+            out.extend(_service_manifests(dep, svc))
     return out
 
 

@@ -250,6 +250,9 @@ def test_multihost_fleet_converges_two_host_spec(tmp_path):
 
 
 def test_multihost_spec_validation():
-    with pytest.raises(SpecError):
-        ServiceDeploymentSpec(name="w", num_nodes=2).validate()
+    # empty hosts is VALID for num_nodes > 1: platform-scheduled ranks
+    # (k8s StatefulSet renderer) or an all-local dev fleet
+    ServiceDeploymentSpec(name="w", num_nodes=2).validate()
     ServiceDeploymentSpec(name="w", num_nodes=2, hosts=["a", "b"]).validate()
+    with pytest.raises(SpecError):
+        ServiceDeploymentSpec(name="w", num_nodes=0).validate()

@@ -1,0 +1,166 @@
+"""DynamoDeployment -> k8s manifest rendering (deploy/manifests.py).
+
+The reference operator materializes child Deployments/Services/Ingress
+imperatively (dynamonimdeployment_controller.go); the TPU build renders
+them declaratively — including the multi-host SPMD shape (one
+StatefulSet per replica group, rank = pod index, coordinator via
+headless-service DNS) that BASELINE config 4 needs.
+"""
+
+from dynamo_tpu.deploy.crd import (
+    Autoscaling,
+    DynamoDeployment,
+    Resources,
+    ServiceDeploymentSpec,
+)
+from dynamo_tpu.deploy.manifests import render_manifests, to_yaml
+
+
+def _dep(**svc_kw):
+    svc = ServiceDeploymentSpec(
+        name="worker",
+        command=["python", "-m", "dynamo_tpu.launch.dynamo_run", "out=jax"],
+        **svc_kw,
+    )
+    return DynamoDeployment(name="graph", namespace="prod", services=[svc])
+
+
+def _by_kind(manifests, kind):
+    return [m for m in manifests if m["kind"] == kind]
+
+
+def test_single_host_service_renders_deployment():
+    dep = _dep(
+        replicas=3,
+        http_port=8080,
+        ingress_host="llm.example.com",
+        resources=Resources(
+            tpu_accelerator="tpu-v5-lite-podslice", tpu_topology="2x4",
+            tpu_chips=8,
+        ),
+        autoscaling=Autoscaling(enabled=True, min_replicas=1, max_replicas=5),
+    )
+    ms = render_manifests(dep)
+    # hub Deployment+Service, worker Deployment+Service+Ingress
+    deployments = _by_kind(ms, "Deployment")
+    assert {d["metadata"]["name"] for d in deployments} == {
+        "graph-hub", "graph-worker",
+    }
+    worker = next(
+        d for d in deployments if d["metadata"]["name"] == "graph-worker"
+    )
+    assert worker["spec"]["replicas"] == 3
+    pod = worker["spec"]["template"]["spec"]
+    assert pod["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4",
+    }
+    limits = pod["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "8"
+    assert "dynamo.autoscale" in worker["metadata"]["annotations"]
+    assert len(_by_kind(ms, "Ingress")) == 1
+    # the hub address flows into the worker env
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["DYN_RUNTIME_HUB_URL"] == "graph-hub.prod.svc:18500"
+    # serializes as a kubectl-appliable multi-doc stream
+    assert to_yaml(ms).count("apiVersion") == len(ms)
+
+
+def test_multihost_service_renders_statefulset_groups():
+    """num_nodes=2 x replicas=2 (config-4 shape): one StatefulSet per
+    SPMD group with rank/coordinator env, plus the headless service."""
+    dep = _dep(
+        replicas=2, num_nodes=2, coordinator_port=9901,
+        resources=Resources(
+            tpu_accelerator="tpu-v5p-slice", tpu_topology="2x2x1",
+            tpu_chips=4,
+        ),
+    )
+    ms = render_manifests(dep)
+    sts = _by_kind(ms, "StatefulSet")
+    assert {s["metadata"]["name"] for s in sts} == {
+        "graph-worker-g0", "graph-worker-g1",
+    }
+    headless = next(
+        m for m in _by_kind(ms, "Service")
+        if m["metadata"]["name"] == "graph-worker-ranks"
+    )
+    assert headless["spec"]["clusterIP"] == "None"
+    # ranks resolve the coordinator BEFORE pod 0 is ready (readiness
+    # needs distributed init, which needs the DNS record — deadlock
+    # otherwise)
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+    # selectors scope by deployment, not just component, so same-named
+    # services of another deployment can't be cross-selected
+    assert headless["spec"]["selector"]["dynamo.deployment"] == "graph"
+    for s in sts:
+        assert s["spec"]["replicas"] == 2  # num_nodes pods per group
+        assert s["spec"]["serviceName"] == "graph-worker-ranks"
+        # SPMD ranks must start together
+        assert s["spec"]["podManagementPolicy"] == "Parallel"
+        env = s["spec"]["template"]["spec"]["containers"][0]["env"]
+        by_name = {e["name"]: e for e in env}
+        assert by_name["DYN_NUM_NODES"]["value"] == "2"
+        # rank from the pod-index label via the downward API
+        assert "pod-index" in (
+            by_name["DYN_NODE_RANK"]["valueFrom"]["fieldRef"]["fieldPath"]
+        )
+        # coordinator = pod 0 of THIS group through the headless service
+        g = s["metadata"]["name"]
+        assert by_name["DYN_COORDINATOR"]["value"] == (
+            f"{g}-0.graph-worker-ranks.prod.svc:9901"
+        )
+    # no plain Deployment for the multihost worker
+    assert {d["metadata"]["name"] for d in _by_kind(ms, "Deployment")} == {
+        "graph-hub",
+    }
+
+
+def test_multihost_with_http_port_fronts_all_groups():
+    dep = _dep(
+        replicas=1, num_nodes=2, http_port=8080,
+        ingress_host="llm.example.com",
+    )
+    ms = render_manifests(dep)
+    svc = next(
+        m for m in _by_kind(ms, "Service")
+        if m["metadata"]["name"] == "graph-worker"
+    )
+    assert svc["spec"]["selector"] == {
+        "dynamo.component": "worker", "dynamo.deployment": "graph",
+    }
+    assert svc["spec"]["ports"][0]["port"] == 8080
+    # ingress_host renders for multihost services too
+    ing = _by_kind(ms, "Ingress")
+    assert len(ing) == 1
+    assert ing[0]["spec"]["rules"][0]["host"] == "llm.example.com"
+
+
+def test_multihost_autoscale_annotation_lives_on_headless_service():
+    """A StatefulSet's replicas field is RANKS (must equal num_nodes);
+    the group-scaling annotation must not sit where a consumer would
+    scale ranks within an SPMD group."""
+    dep = _dep(
+        replicas=1, num_nodes=2,
+        autoscaling=Autoscaling(enabled=True, min_replicas=1, max_replicas=4),
+    )
+    ms = render_manifests(dep)
+    for s in _by_kind(ms, "StatefulSet"):
+        assert "annotations" not in s["metadata"]
+    headless = next(
+        m for m in _by_kind(ms, "Service")
+        if m["metadata"]["name"] == "graph-worker-ranks"
+    )
+    assert "dynamo.autoscale" in headless["metadata"]["annotations"]
+
+
+def test_multihost_host_pinned_spec_rejected_by_renderer():
+    """hosts pinning is the process-controller contract; the k8s
+    renderer must refuse rather than silently discard the pinning."""
+    import pytest
+
+    from dynamo_tpu.deploy.crd import SpecError
+
+    dep = _dep(replicas=1, num_nodes=2, hosts=["tpu-a", "tpu-b"])
+    with pytest.raises(SpecError, match="pins hosts"):
+        render_manifests(dep)
