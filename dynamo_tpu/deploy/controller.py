@@ -81,6 +81,16 @@ class SshLauncher:
               replica: int, rank: int, extra_env: dict):
         import shlex
 
+        if not host:
+            # fail FAST: an empty hostname would become `ssh "" ...`,
+            # which exits instantly and puts the group in an endless
+            # crash/backoff loop. Hostless multi-node specs are for the
+            # local dev fleet or the k8s renderer, not the ssh fleet.
+            raise SpecError(
+                f"{name}/{svc.name}: SshLauncher needs a hosts list "
+                "(hostless multi-node specs are platform-scheduled — "
+                "use the k8s renderer or the LocalLauncher)"
+            )
         env = dict(svc.env)
         env.update(extra_env)
         assigns = " ".join(

@@ -101,6 +101,10 @@ class ServiceDeploymentSpec:
             raise SpecError("replicas must be >= 0")
         if self.num_nodes < 1:
             raise SpecError("num_nodes must be >= 1")
+        if self.ingress_host and not self.http_port:
+            # an Ingress backend needs a Service port; accepting the
+            # host and rendering nothing would silently drop it
+            raise SpecError("ingress_host requires http_port")
         self.resources.validate()
         self.autoscaling.validate()
 

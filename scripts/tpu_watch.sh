@@ -41,6 +41,11 @@ print('exec-ok')" 2>/dev/null | grep -q exec-ok; then
     echo "decompose rc=$?" >> /tmp/tpu_results/status
     log_entry "decompose_window" /tmp/tpu_results/decompose.log
 
+    timeout 1200 python -u scripts/bench_mla.py \
+        > /tmp/tpu_results/bench_mla.log 2>&1
+    echo "bench_mla rc=$?" >> /tmp/tpu_results/status
+    log_entry "bench_mla (latent kernel vs XLA)" /tmp/tpu_results/bench_mla.log
+
     timeout 1200 python -u bench.py > /tmp/tpu_results/bench.log 2>&1
     rc=$?
     echo "bench rc=$rc" >> /tmp/tpu_results/status

@@ -154,6 +154,29 @@ def test_multihost_autoscale_annotation_lives_on_headless_service():
     assert "dynamo.autoscale" in headless["metadata"]["annotations"]
 
 
+def test_ingress_without_http_port_rejected():
+    import pytest
+
+    from dynamo_tpu.deploy.crd import SpecError
+
+    dep = _dep(ingress_host="llm.example.com")  # no http_port
+    with pytest.raises(SpecError, match="requires http_port"):
+        render_manifests(dep)
+
+
+def test_ssh_launcher_rejects_empty_host():
+    """A hostless multi-node spec must fail FAST on the ssh fleet path
+    (an empty hostname would crash-loop `ssh \"\" ...` forever)."""
+    import pytest
+
+    from dynamo_tpu.deploy.controller import SshLauncher
+    from dynamo_tpu.deploy.crd import ServiceDeploymentSpec, SpecError
+
+    svc = ServiceDeploymentSpec(name="w", num_nodes=2)
+    with pytest.raises(SpecError, match="hosts list"):
+        SshLauncher().spawn("", "dep", svc, 0, 0, {})
+
+
 def test_multihost_host_pinned_spec_rejected_by_renderer():
     """hosts pinning is the process-controller contract; the k8s
     renderer must refuse rather than silently discard the pinning."""
