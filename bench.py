@@ -42,7 +42,16 @@ def _acquire_devices(retries: int = 3, probe_timeout: float = 120.0):
     hard timeout; only after a successful probe do we init in-process.
     Falls back to CPU so the bench always emits a number.
     """
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # explicit CPU request (smoke runs): the site hook bakes the TPU
+        # platform into the config snapshot at interpreter start, so the
+        # env var alone is too late — honor it here and skip the probe
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices("cpu")
 
     for attempt in range(retries):
         if _probe_backend(probe_timeout):
@@ -83,6 +92,80 @@ def _cached_silicon_result():
     return cached
 
 
+def time_decode_windows(
+    params, cfg, *, B: int, BLOCK: int, CTX: int, WINDOW: int,
+    use_pallas: bool, merged: bool, iters: int, rounds: int = 3,
+) -> float:
+    """Wall-time ``iters`` fused decode+sample windows; returns tokens/s.
+
+    The serving path under measurement: one host sync per WINDOW tokens,
+    sampled token i feeding step i+1 on device. The timed region ends
+    with a device_get of the final tokens — the host must receive real
+    bytes that depend on every prior step through the kv-cache chain, so
+    async dispatch / lazy sync can't shorten the measurement. Median of
+    ``rounds`` to shed scheduling noise; state rewinds between rounds so
+    the ragged lengths stay inside the block tables (the caller must
+    keep seq_len0 + iters*WINDOW <= CTX). Compile/Mosaic errors
+    propagate — callers choose their fallback (bench.py retries with
+    merged=False). Shared by bench.py and scripts/bench_mla.py so the
+    two benches cannot drift in methodology.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models import llama
+
+    M = CTX // BLOCK
+    NUM_BLOCKS = B * M + 1
+    k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
+    tables = jnp.asarray(
+        np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M)
+    )
+    seq_len0 = CTX // 2
+    seeds = jnp.zeros(B, jnp.int32)
+    temps = jnp.zeros(B, jnp.float32)  # greedy
+    top_ks = jnp.zeros(B, jnp.int32)
+    top_ps = jnp.ones(B, jnp.float32)
+
+    def window(tokens, positions, seq_lens, steps, k_cache, v_cache):
+        toks, k_cache, v_cache = llama.decode_window(
+            params, cfg, tokens, positions, tables, seq_lens,
+            seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
+            n_steps=WINDOW, use_pallas=use_pallas, merged=merged,
+        )
+        return (toks[-1], positions + WINDOW, seq_lens + WINDOW,
+                steps + WINDOW, k_cache, v_cache)
+
+    def reset():
+        return (
+            jnp.zeros(B, jnp.int32),
+            jnp.full((B,), seq_len0, jnp.int32),
+            jnp.full((B,), seq_len0 + 1, jnp.int32),
+            jnp.zeros(B, jnp.int32),
+        )
+
+    tokens, positions, seq_lens, steps = reset()
+    for _ in range(2):  # warmup / compile
+        tokens, positions, seq_lens, steps, k_cache, v_cache = window(
+            tokens, positions, seq_lens, steps, k_cache, v_cache
+        )
+    np.asarray(jax.device_get(tokens))
+
+    times = []
+    for _ in range(rounds):
+        tokens, positions, seq_lens, steps = reset()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            tokens, positions, seq_lens, steps, k_cache, v_cache = window(
+                tokens, positions, seq_lens, steps, k_cache, v_cache
+            )
+        np.asarray(jax.device_get(tokens))
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    return iters * WINDOW * B / dt
+
+
 def main() -> None:
     cached = _cached_silicon_result()
     # with a real silicon number already in hand, one failed probe is
@@ -91,14 +174,18 @@ def main() -> None:
     devices = _acquire_devices(retries=1 if cached is not None else 3)
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from dynamo_tpu.models import llama
     from dynamo_tpu.models.config import ModelConfig
 
+    import os
+
     on_cpu = devices[0].platform == "cpu"
-    if on_cpu and cached is not None:
+    # the cached-silicon fallback is for "backend unreachable", not for
+    # an EXPLICIT CPU smoke request — a developer smoke-testing a code
+    # change must actually run the decode path, not replay a number
+    explicit_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    if on_cpu and cached is not None and not explicit_cpu:
         print(json.dumps(cached))
         return
     if on_cpu:
@@ -113,94 +200,31 @@ def main() -> None:
             max_position_embeddings=2048, dtype="bfloat16",
         )
         B, BLOCK, CTX = 16, 16, 2048
-    M = CTX // BLOCK
-    NUM_BLOCKS = B * M + 1
 
     params = llama.init_params(cfg, jax.random.key(0))
-    k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
-
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
-    tokens = jnp.zeros(B, jnp.int32)
-    seq_len0 = CTX // 2
-    positions = jnp.full((B,), seq_len0, jnp.int32)
-    tables = jnp.asarray(
-        np.arange(1, NUM_BLOCKS, dtype=np.int32).reshape(B, M)
-    )
-    seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
-
     use_pallas = not on_cpu and cfg.head_dim % 128 == 0 and BLOCK % 8 == 0
-
-    # the serving path: fused decode+sample windows (one host sync per
-    # WINDOW tokens, sampled token i feeding step i+1 on device)
     WINDOW = 1 if on_cpu else 16
-    seeds = jnp.zeros(B, jnp.int32)
-    steps0 = jnp.zeros(B, jnp.int32)
-    temps = jnp.zeros(B, jnp.float32)  # greedy
-    top_ks = jnp.zeros(B, jnp.int32)
-    top_ps = jnp.ones(B, jnp.float32)
+    ITERS = 24 if on_cpu else 800 // WINDOW
 
-    def make_window(merged):
-        def window(tokens, positions, seq_lens, steps, k_cache, v_cache):
-            toks, k_cache, v_cache = llama.decode_window(
-                params, cfg, tokens, positions, tables, seq_lens,
-                seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
-                n_steps=WINDOW, use_pallas=use_pallas, merged=merged,
-            )
-            return (toks[-1], positions + WINDOW, seq_lens + WINDOW,
-                    steps + WINDOW, k_cache, v_cache)
-
-        return window
-
-    # warmup / compile — the merged one-write decode path first; if its
-    # Mosaic kernels fail on this chip/toolchain, fall back to the
-    # write-then-attend path so the bench still lands a real number
-    window = make_window(merged=True)
-    steps_c = steps0
+    # the merged one-write decode path first; if its Mosaic kernels fail
+    # on this chip/toolchain, fall back to the write-then-attend path so
+    # the bench still lands a real number
     try:
-        for _ in range(2):
-            tokens, positions, seq_lens, steps_c, k_cache, v_cache = window(
-                tokens, positions, seq_lens, steps_c, k_cache, v_cache
-            )
-        np.asarray(jax.device_get(tokens))
+        toks_per_s = time_decode_windows(
+            params, cfg, B=B, BLOCK=BLOCK, CTX=CTX, WINDOW=WINDOW,
+            use_pallas=use_pallas, merged=True, iters=ITERS,
+        )
     except Exception as e:  # noqa: BLE001
         print(f"bench: merged decode path failed ({type(e).__name__}: {e}); "
               "falling back to per-layer writes", file=sys.stderr)
-        window = make_window(merged=False)
-        tokens = jnp.zeros(B, jnp.int32)
-        positions = jnp.full((B,), seq_len0, jnp.int32)
-        seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
-        steps_c = steps0
-        k_cache, v_cache = llama.init_kv_cache(cfg, NUM_BLOCKS, BLOCK)
-        for _ in range(2):
-            tokens, positions, seq_lens, steps_c, k_cache, v_cache = window(
-                tokens, positions, seq_lens, steps_c, k_cache, v_cache
-            )
-        np.asarray(jax.device_get(tokens))
+        toks_per_s = time_decode_windows(
+            params, cfg, B=B, BLOCK=BLOCK, CTX=CTX, WINDOW=WINDOW,
+            use_pallas=use_pallas, merged=False, iters=ITERS,
+        )
 
-    # Timed region ends with a device_get of the final tokens: the host
-    # must receive real bytes that depend on every prior step through the
-    # kv-cache chain, so async dispatch / lazy sync can't shorten the
-    # measurement. Median of 3 rounds to shed scheduling noise.
-    # stay inside the block tables: seq_len0 + ITERS*WINDOW <= CTX
-    ITERS = 24 if on_cpu else 800 // WINDOW
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            tokens, positions, seq_lens, steps_c, k_cache, v_cache = window(
-                tokens, positions, seq_lens, steps_c, k_cache, v_cache
-            )
-        np.asarray(jax.device_get(tokens))
-        times.append(time.perf_counter() - t0)
-        # rewind the ragged state so later rounds don't run past CTX
-        positions = jnp.full((B,), seq_len0, jnp.int32)
-        seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
-        steps_c = steps0
-    dt = sorted(times)[1]
-
-    n_chips = jax.device_count()
-    toks_per_s = ITERS * WINDOW * B / dt / n_chips
+    toks_per_s /= jax.device_count()
 
     # HBM roofline: each decode step streams all weights once
     hbm_bw = 50e9 if on_cpu else 819e9  # v5e ~819 GB/s

@@ -16,7 +16,6 @@ docs/perf_log.md so the numbers survive a relay death.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -27,8 +26,6 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     # interpreter start, so the env var alone is too late — honoring it
     # here keeps a CPU smoke run from probing a (possibly wedged) relay
     jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp
-import numpy as np
 
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
@@ -39,6 +36,8 @@ def main() -> None:
     if on_cpu:
         print(json.dumps({"metric": "bench_mla_skipped_cpu", "value": 0}))
         return
+    from bench import time_decode_windows
+
     cfg = ModelConfig(
         vocab_size=32768, hidden_size=2048, intermediate_size=8192,
         num_layers=16, num_heads=16, num_kv_heads=16,
@@ -47,16 +46,7 @@ def main() -> None:
         v_head_dim=128,
     )
     B, BLOCK, CTX, WINDOW = 16, 16, 2048, 16
-    M = CTX // BLOCK
-    N = B * M + 1
     params = llama.init_params(cfg, jax.random.key(0))
-    tables = jnp.asarray(np.arange(1, N, dtype=np.int32).reshape(B, M))
-    seq_len0 = CTX // 2
-    seeds = jnp.zeros(B, jnp.int32)
-    temps = jnp.zeros(B, jnp.float32)
-    top_ks = jnp.zeros(B, jnp.int32)
-    top_ps = jnp.ones(B, jnp.float32)
-
     param_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
     )
@@ -67,45 +57,11 @@ def main() -> None:
         "pallas": (True, False),
         "merged": (True, True),
     }.items():
-        k_cache, v_cache = llama.init_kv_cache(cfg, N, BLOCK)
-        tokens = jnp.zeros(B, jnp.int32)
-        positions = jnp.full((B,), seq_len0, jnp.int32)
-        seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
-        steps = jnp.zeros(B, jnp.int32)
-
-        def window(tokens, positions, seq_lens, steps, k_cache, v_cache,
-                   up=up, mg=mg):
-            toks, k_cache, v_cache = llama.decode_window(
-                params, cfg, tokens, positions, tables, seq_lens,
-                seeds, steps, temps, top_ks, top_ps, k_cache, v_cache,
-                n_steps=WINDOW, use_pallas=up, merged=mg,
-            )
-            return (toks[-1], positions + WINDOW, seq_lens + WINDOW,
-                    steps + WINDOW, k_cache, v_cache)
-
         try:
-            for _ in range(2):  # warmup/compile
-                tokens, positions, seq_lens, steps, k_cache, v_cache = (
-                    window(tokens, positions, seq_lens, steps, k_cache,
-                           v_cache)
-                )
-            np.asarray(jax.device_get(tokens))
-            ITERS = 800 // WINDOW
-            times = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(ITERS):
-                    tokens, positions, seq_lens, steps, k_cache, v_cache = (
-                        window(tokens, positions, seq_lens, steps, k_cache,
-                               v_cache)
-                    )
-                np.asarray(jax.device_get(tokens))
-                times.append(time.perf_counter() - t0)
-                positions = jnp.full((B,), seq_len0, jnp.int32)
-                seq_lens = jnp.full((B,), seq_len0 + 1, jnp.int32)
-                steps = jnp.zeros(B, jnp.int32)
-            dt = sorted(times)[1]
-            tps = ITERS * WINDOW * B / dt
+            tps = time_decode_windows(
+                params, cfg, B=B, BLOCK=BLOCK, CTX=CTX, WINDOW=WINDOW,
+                use_pallas=up, merged=mg, iters=800 // WINDOW,
+            ) / jax.device_count()  # per-chip, same as bench.py
             print(json.dumps({
                 "metric": f"mla1b_decode_tokens_per_sec_{label}",
                 "value": round(tps, 2),

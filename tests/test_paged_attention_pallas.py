@@ -227,6 +227,25 @@ def test_kernel_bf16_cache():
     )
 
 
+def test_kernel_fp8_cache():
+    """A float8_e4m3 cache flows through the kernel's existing
+    cast-to-f32 tile reads (interpret mode; the compiled lowering is
+    probed on-chip by validate_tpu_kernels §7 before the engine gate
+    admits quantized caches to the Pallas path)."""
+    B, H, Hkv, D, N, bs, M = 2, 8, 4, 128, 32, 16, 2
+    q, kc, vc, tables = _mk(B, H, Hkv, D, N, bs, M, seed=3)
+    kc = kc.astype(jnp.float8_e4m3fn)
+    vc = vc.astype(jnp.float8_e4m3fn)
+    seq_lens = jnp.asarray([7, 2 * bs], jnp.int32)
+    scale = D**-0.5
+    ref = decode_attention_xla(q, kc, vc, tables, seq_lens, scale)
+    got = paged_decode_attention(q, kc, vc, tables, seq_lens, scale, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
 def test_decode_kernel_sliding_window_matches_xla():
     B, H, Hkv, D, N, bs, M = 4, 8, 4, 128, 64, 16, 4
     q, kc, vc, tables = _mk(B, H, Hkv, D, N, bs, M, seed=7)
