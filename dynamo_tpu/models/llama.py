@@ -571,10 +571,24 @@ def prefill(
             vc = att.write_chunk_to_cache(
                 vc, k_pe[:, None, :], block_table, history_len
             )
-            out_lat = mla.mla_prefill_attention_xla(
-                q_eff, q_pe, kc, vc, block_table, history_len, valid_len,
-                scale,
-            )
+            if use_pallas and mesh is not None:
+                from ..ops import mla_attention_pallas as _mla_ops
+
+                out_lat = _mla_ops.mla_paged_prefill_attention_sharded(
+                    q_eff, q_pe, kc, vc, block_table, history_len, scale,
+                    mesh,
+                )
+            elif use_pallas:
+                from ..ops import mla_attention_pallas as _mla_ops
+
+                out_lat = _mla_ops.mla_paged_prefill_attention(
+                    q_eff, q_pe, kc, vc, block_table, history_len, scale,
+                )
+            else:
+                out_lat = mla.mla_prefill_attention_xla(
+                    q_eff, q_pe, kc, vc, block_table, history_len,
+                    valid_len, scale,
+                )
             o = mla._o_proj(lp, cfg, out_lat).astype(x.dtype)
             x = x + _mm(o, lp["wo"])
         else:

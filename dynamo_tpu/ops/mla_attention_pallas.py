@@ -292,6 +292,219 @@ def mla_decode_attention_merged(
     return num / den[..., None]
 
 
+def _mla_prefill_kernel(
+    # scalar prefetch
+    block_table_ref,  # [M] int32 (SMEM)
+    hist_ref,  # [1] int32 (SMEM): tokens already cached before this chunk
+    # inputs: q_eff, q_pe, then P c-page refs then P pe-page refs
+    *refs,
+    scale: float,
+    block_size: int,
+    q_tile: int,  # Tq: chunk rows per grid step
+    group: int,  # Hp: padded query heads per token
+    pages_per_step: int,
+):
+    P = pages_per_step
+    qc_ref = refs[0]  # [1, Tq*Hp, C]
+    qp_ref = refs[1]  # [1, Tq*Hp, R]
+    c_refs = refs[2 : 2 + P]  # each [1, 1, bs, C]
+    pe_refs = refs[2 + P : 2 + 2 * P]
+    o_ref = refs[2 + 2 * P]  # [1, Tq*Hp, C]
+    m_scr, l_scr, acc_scr = refs[3 + 2 * P :]
+
+    j = pl.program_id(0)  # q tile
+    i = pl.program_id(1)  # kv superblock (innermost: sequential accum)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    hist = hist_ref[0]
+    start = i * (P * block_size)
+    # last query position in this tile — superblocks past it are fully
+    # masked (full attention only: MLA models have no sliding window)
+    in_range = start <= hist + (j + 1) * q_tile - 1
+
+    @pl.when(in_range)
+    def _superblock():
+        qc = qc_ref[0].astype(jnp.float32) * scale  # [Tq*Hp, C]
+        qp = qp_ref[0].astype(jnp.float32) * scale
+        c = jnp.concatenate(
+            [r[0, 0] for r in c_refs], axis=0
+        ).astype(jnp.float32)  # [P*bs, C]
+        pe = jnp.concatenate([r[0, 0] for r in pe_refs], axis=0).astype(
+            jnp.float32
+        )
+        s = jax.lax.dot_general(
+            qc, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            qp, pe, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Tq*Hp, P*bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = hist + j * q_tile + rows // group
+        kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, 0:1], 1e-20)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_step", "interpret")
+)
+def mla_paged_prefill_attention(
+    q_eff: jnp.ndarray,  # [T, H, C] chunk's absorbed queries
+    q_pe: jnp.ndarray,  # [T, H, R]
+    c_cache_layer: jnp.ndarray,  # [1, N, bs, C] — chunk ALREADY written
+    pe_cache_layer: jnp.ndarray,  # [1, N, bs, R]
+    block_table: jnp.ndarray,  # [M] int32, covers history + padded chunk
+    history_len: jnp.ndarray,  # scalar int32
+    scale: float,
+    pages_per_step: int = 0,  # 0 -> auto
+    interpret: bool = False,
+) -> jnp.ndarray:  # [T, H, C] latent outputs
+    """Flash-style chunked-prefill latent attention over the paged MLA
+    cache — the MLA twin of ops/paged_attention_pallas
+    .paged_prefill_attention (write-before-attend: the caller scattered
+    this chunk's latents first, so the kernel reads history AND chunk
+    through the block table; causal masking at absolute positions does
+    all the ragged bookkeeping; padded tail rows produce garbage only in
+    rows every caller discards). Two-stream page DMA and values-are-
+    latents exactly as the decode kernel."""
+    T, H, C = q_eff.shape
+    _, N, bs, R = pe_cache_layer.shape
+    M = block_table.shape[0]
+    Hp = max(8, -(-H // 8) * 8)
+    # cap the packed row dim near 1024 so fp32 VMEM scratch stays a few
+    # MB at C=512 (acc [Tq*Hp, C] is the big one)
+    Tq = max(1, min(T, 1024 // Hp))
+    nT = -(-T // Tq)
+    Tpad = nT * Tq
+    P = pages_per_step or _pick_pages_per_step(M)
+    if M % P:
+        raise ValueError(
+            f"pages_per_step={P} must divide table width M={M} "
+            "(a truncated grid would silently drop tail pages)"
+        )
+    # [T, H, C] -> [1, Tpad*Hp, C]: rows (t, h) lexicographic, so
+    # in-kernel row r of tile j maps to t = j*Tq + r // Hp
+    def pack(q, D):
+        q = jnp.pad(
+            q.astype(jnp.float32),
+            ((0, Tpad - T), (0, Hp - H), (0, 0)),
+        )
+        return q.reshape(1, Tpad * Hp, D)
+
+    qc = pack(q_eff, C)
+    qp = pack(q_pe, R)
+
+    def page_index(p):
+        def index(j, i, bt, hist):
+            tile_last = (hist[0] + (j + 1) * Tq - 1) // bs
+            written_last = (hist[0] + Tpad - 1) // bs
+            pi = jnp.minimum(
+                jnp.minimum(i * P + p, tile_last),
+                jnp.minimum(written_last, M - 1),
+            )
+            return (0, bt[pi], 0, 0)
+
+        return index
+
+    c_specs = [pl.BlockSpec((1, 1, bs, C), page_index(p)) for p in range(P)]
+    pe_specs = [pl.BlockSpec((1, 1, bs, R), page_index(p)) for p in range(P)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nT, M // P),
+        in_specs=[
+            pl.BlockSpec((1, Tq * Hp, C), lambda j, i, bt, hist: (0, j, 0)),
+            pl.BlockSpec((1, Tq * Hp, R), lambda j, i, bt, hist: (0, j, 0)),
+            *c_specs,
+            *pe_specs,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Tq * Hp, C), lambda j, i, bt, hist: (0, j, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Tq * Hp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Hp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Hp, C), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_prefill_kernel, scale=scale, block_size=bs, q_tile=Tq,
+        group=Hp, pages_per_step=P,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, Tpad * Hp, C), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Tpad * H * M * bs * (C + R + C),
+            bytes_accessed=M * bs * (C + R) * c_cache_layer.dtype.itemsize,
+            transcendentals=Tpad * H * M * bs,
+        ),
+        interpret=interpret,
+    )(jnp.asarray(block_table), jnp.asarray(history_len, jnp.int32).reshape(1),
+      qc, qp, *([c_cache_layer] * P), *([pe_cache_layer] * P))
+    out = out.reshape(Tpad, Hp, C)[:T, :H, :]
+    return out
+
+
+def mla_paged_prefill_attention_sharded(
+    q_eff: jnp.ndarray,  # [T, H, C], H sharded over tp
+    q_pe: jnp.ndarray,  # [T, H, R], H sharded over tp
+    c_cache_layer: jnp.ndarray,  # replicated
+    pe_cache_layer: jnp.ndarray,  # replicated
+    block_table: jnp.ndarray,  # [M] replicated
+    history_len: jnp.ndarray,  # scalar replicated
+    scale: float,
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The prefill latent kernel under shard_map over ``tp`` (query
+    heads parallel, replicated latent cache — same argument as the
+    decode wrappers)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        partial(mla_paged_prefill_attention, scale=scale,
+                interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q_eff
+            P(None, "tp", None),  # q_pe
+            P(),  # c cache
+            P(),  # pe cache
+            P(),  # table
+            P(),  # history_len
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q_eff, q_pe, c_cache_layer, pe_cache_layer, block_table, history_len)
+
+
 def mla_verify_attention(
     q_eff: jnp.ndarray,  # [B, T, H, C] T in-flight tokens' absorbed queries
     q_pe: jnp.ndarray,  # [B, T, H, R]

@@ -63,7 +63,7 @@ def main() -> None:
                 use_pallas=up, merged=mg, iters=800 // WINDOW,
             ) / jax.device_count()  # per-chip, same as bench.py
             print(json.dumps({
-                "metric": f"mla1b_decode_tokens_per_sec_{label}",
+                "metric": f"mla1b_decode_tokens_per_sec_per_chip_{label}",
                 "value": round(tps, 2),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tps / roofline, 4),

@@ -202,6 +202,16 @@ for tag, up in (("regular", False), ("merged", True)):
 check("mla decode_step merged==regular (logits, 3 steps)",
       out["merged"], out["regular"], rtol=5e-2, atol=5e-1)
 
+# prefill through the latent kernel vs the naive dense reference
+mref2 = llama.dense_forward(mla_params2, mla_cfg2, mtoks)
+mk3, mv3 = llama.init_kv_cache(mla_cfg2, 16, 8)
+mtable3 = jnp.asarray(np.arange(1, 5, dtype=np.int32))
+mlog3, mk3, mv3 = llama.prefill(
+    mla_params2, mla_cfg2, mtoks[:16], mtable3, jnp.int32(0),
+    jnp.int32(16), mk3, mv3, use_pallas=True,
+)
+check("mla prefill kernel vs dense", mlog3, mref2[15], rtol=5e-2, atol=5e-1)
+
 # 7. fp8 KV-cache tiles through the COMPILED kernels. Quantized caches
 # currently route to the XLA path (engine gate) because Mosaic's fp8
 # tile support on this chip generation is unproven; interpret mode
@@ -238,7 +248,8 @@ try:
     for l in range(L):
         ref_k8 = ref_k8.at[l, :, blk, off].set(k_new[l].astype(jnp.float8_e4m3fn))
         ref_v8 = ref_v8.at[l, :, blk, off].set(v_new[l].astype(jnp.float8_e4m3fn))
-    info_check("kv_cache_append fp8 cache", got_k8, ref_k8, rtol=0, atol=0)
+    info_check("kv_cache_append fp8 cache k", got_k8, ref_k8, rtol=0, atol=0)
+    info_check("kv_cache_append fp8 cache v", got_v8, ref_v8, rtol=0, atol=0)
 except Exception as e:  # noqa: BLE001
     print(f"INFO fp8-cache append kernel not lowerable: "
           f"{type(e).__name__}: {e}"[:300], flush=True)

@@ -211,6 +211,71 @@ def test_mla_pallas_decode_on_tp_mesh_matches_single_device():
     np.testing.assert_array_equal(streams["ref"], streams["mesh-plain"])
 
 
+def test_mla_prefill_kernel_matches_xla():
+    """The chunked-prefill latent kernel (write-before-attend, absolute-
+    position causal masking) must equal mla_prefill_attention_xla on all
+    REAL rows, across chunk boundaries and prefix-cache history."""
+    from dynamo_tpu.ops.mla_attention_pallas import (
+        mla_paged_prefill_attention,
+    )
+
+    M, C, R, H = 6, 32, 8, 4
+    N = M + 1
+    ks = jax.random.split(jax.random.key(12), 4)
+    c_cache = jax.random.normal(ks[0], (1, N, BS, C), jnp.float32)
+    pe_cache = jax.random.normal(ks[1], (1, N, BS, R), jnp.float32)
+    table = jnp.asarray(np.arange(1, N, dtype=np.int32))
+    scale = 0.23
+    for hist, T, valid in ((0, 16, 16), (5, 16, 11), (BS + 2, 8, 3)):
+        q_eff = jax.random.normal(ks[2], (T, H, C), jnp.float32)
+        q_pe = jax.random.normal(ks[3], (T, H, R), jnp.float32)
+        got = mla_paged_prefill_attention(
+            q_eff, q_pe, c_cache, pe_cache, table, jnp.int32(hist), scale,
+            interpret=True,
+        )
+        ref = mla.mla_prefill_attention_xla(
+            q_eff, q_pe, c_cache, pe_cache, table, jnp.int32(hist),
+            jnp.int32(valid), scale,
+        )
+        # agreement on REAL rows only (padded tails are discarded by
+        # every caller; the kernel and the XLA twin mask them
+        # differently by design)
+        np.testing.assert_allclose(
+            np.asarray(got)[:valid], np.asarray(ref)[:valid],
+            rtol=2e-5, atol=2e-5, err_msg=f"hist={hist} T={T}",
+        )
+
+
+def test_mla_prefill_sharded_matches_single_device():
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.ops.mla_attention_pallas import (
+        mla_paged_prefill_attention,
+        mla_paged_prefill_attention_sharded,
+    )
+
+    M, C, R, H, T = 4, 32, 8, 4, 8
+    N = M + 1
+    ks = jax.random.split(jax.random.key(13), 4)
+    c_cache = jax.random.normal(ks[0], (1, N, BS, C), jnp.float32)
+    pe_cache = jax.random.normal(ks[1], (1, N, BS, R), jnp.float32)
+    q_eff = jax.random.normal(ks[2], (T, H, C), jnp.float32)
+    q_pe = jax.random.normal(ks[3], (T, H, R), jnp.float32)
+    table = jnp.asarray(np.arange(1, N, dtype=np.int32))
+    ref = mla_paged_prefill_attention(
+        q_eff, q_pe, c_cache, pe_cache, table, jnp.int32(3), 0.2,
+        interpret=True,
+    )
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("tp",))
+    got = mla_paged_prefill_attention_sharded(
+        q_eff, q_pe, c_cache, pe_cache, table, jnp.int32(3), 0.2, mesh,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_mla_verify_attention_matches_write_then_attend():
     """Out-of-cache multi-token latent verify (both the XLA twin and the
     kernel-backed path) must equal writing the window's latents then
