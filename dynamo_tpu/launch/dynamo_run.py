@@ -101,6 +101,21 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
             first_dense_layers=1, num_layers=3,
         )
         return cfg, None, ByteTokenizer(), args.model_name or "tiny-mla"
+    if args.model_path == "deepseek-8b-sim":
+        # 8B-class dense-MLA architecture with DeepSeek-V3 head geometry
+        # (kv_lora 512 + rope 64, q_lora 1536) and random weights: the
+        # serving-bench shape for BASELINE config 5's model family when
+        # no checkpoint is reachable — compute, latent-cache traffic and
+        # scheduling identical to a real dense-MLA model; int8 weights
+        # fit one v5e (16 GB HBM)
+        cfg = ModelConfig(
+            vocab_size=32768, hidden_size=4096, intermediate_size=14336,
+            num_layers=30, num_heads=32, num_kv_heads=32,
+            max_position_embeddings=8192, dtype="bfloat16",
+            kv_lora_rank=512, qk_nope_head_dim=128, qk_rope_head_dim=64,
+            v_head_dim=128, q_lora_rank=1536,
+        )
+        return cfg, None, ByteTokenizer(), args.model_name or "deepseek-8b-sim"
     if args.model_path == "llama3-8b-sim":
         # full Llama-3-8B architecture with RANDOM weights + the byte
         # tokenizer: the serving-path TTFT/ITL bench shape for when no

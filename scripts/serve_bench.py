@@ -222,7 +222,10 @@ def main() -> None:
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (smoke runs)")
     p.add_argument("--artifact", action="store_true",
-                   help="append docs/perf_log.md + BENCH_serving.json")
+                   help="append docs/perf_log.md + the artifact json")
+    p.add_argument("--artifact-name", default="BENCH_serving.json",
+                   help="artifact filename (distinct per benched config "
+                        "so one config's result can't clobber another's)")
     p.add_argument("--startup-timeout", type=float, default=900.0)
     args = p.parse_args()
 
@@ -282,7 +285,7 @@ def main() -> None:
         })
         print(json.dumps(result), flush=True)
         if args.artifact:
-            with open(os.path.join(REPO, "BENCH_serving.json"), "w") as f:
+            with open(os.path.join(REPO, args.artifact_name), "w") as f:
                 json.dump(result, f, indent=1)
             stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             with open(os.path.join(REPO, "docs", "perf_log.md"), "a") as f:

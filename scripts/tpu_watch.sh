@@ -71,6 +71,21 @@ print('exec-ok')" 2>/dev/null | grep -q exec-ok; then
       [ "$sb_rc" != 0 ] && log_entry "serve_bench (FAILED)" \
           /tmp/tpu_results/serve_bench.log
     fi
+    # config-5's model family: dense-MLA 8B through the full stack.
+    # bf16 KV keeps the latent kernels engaged (fp8 KV routes to XLA);
+    # the latent cache is ~4x smaller than GQA so 640 blocks still fit.
+    if ! grep -q '"ok": [1-9]' /root/repo/BENCH_serving_mla.json 2>/dev/null; then
+      timeout 2400 python -u scripts/serve_bench.py \
+          --model-path deepseek-8b-sim --quantization int8 \
+          --num-blocks 640 --block-size 16 \
+          --max-batch 8 --n 16 --isl 400 --osl 150 --concurrency 4 \
+          --artifact --artifact-name BENCH_serving_mla.json \
+          > /tmp/tpu_results/serve_bench_mla.log 2>&1
+      sbm_rc=$?
+      echo "serve_bench_mla rc=$sbm_rc" >> /tmp/tpu_results/status
+      [ "$sbm_rc" != 0 ] && log_entry "serve_bench deepseek-8b-sim (FAILED)" \
+          /tmp/tpu_results/serve_bench_mla.log
+    fi
     # Persist the JSON line as a repo artifact for the driver/judge.
     # Never truncate a previously captured good result with an empty
     # one, and never re-persist bench.py's own *_cached replay (it IS
