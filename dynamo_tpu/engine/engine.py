@@ -660,7 +660,9 @@ class JaxEngine(AsyncEngine):
     def _ring_chunk(self, seq: _Sequence, pos: int) -> bool:
         """Route THIS chunk through sp ring attention? History-free
         first chunk of a long-enough prompt on an sp>1 mesh, full
-        attention, non-MLA (the whole prompt becomes one ring chunk)."""
+        attention (the whole prompt becomes one ring chunk). MLA models
+        ride a latent ring — the rotated chunk is the compressed
+        (c_kv, k_pe) stream."""
         cfg = self.cfg
         if (
             cfg.ring_prefill_threshold <= 0
@@ -669,7 +671,6 @@ class JaxEngine(AsyncEngine):
             or self.mesh.shape.get("sp", 1) <= 1
             or len(seq.tokens) < cfg.ring_prefill_threshold
             or cfg.model.sliding_window != 0
-            or cfg.model.is_mla
         ):
             return False
         # bucket sizes are powers of two >= sp, so T % sp == 0 holds

@@ -524,12 +524,13 @@ def prefill(
 
     ``use_ring`` (static; history-free chunks only — the ENGINE gates it
     on history == 0, an sp>1 mesh, T % sp == 0, prompt length >= its
-    ring threshold, full attention, non-MLA) routes the chunk's
-    self-attention through sequence-parallel ring attention over the sp
-    axis (parallel/ring_attention.py) instead of the dense score matrix:
-    each device holds T/sp query rows and KV shards rotate the ICI ring.
-    Cache writes are unchanged, so decode and later chunked prefill
-    continue through the paged path.
+    ring threshold, full attention) routes the chunk's self-attention
+    through sequence-parallel ring attention over the sp axis
+    (parallel/ring_attention.py) instead of the dense score matrix:
+    each device holds T/sp query rows and the KV shards — or, for MLA,
+    the far smaller compressed (c_kv, k_pe) latent shards — rotate the
+    ICI ring. Cache writes are unchanged, so decode and later chunked
+    prefill continue through the paged path.
     """
     if mesh is not None and not use_ring:
         from ..parallel.pp import can_pipeline, pick_n_micro, pipelined_prefill
@@ -542,7 +543,7 @@ def prefill(
             )
     if use_ring:
         assert mesh is not None and mesh.shape.get("sp", 1) > 1
-        assert not cfg.is_mla and cfg.sliding_window == 0
+        assert cfg.sliding_window == 0
     T = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [T, E]
     positions = history_len + jnp.arange(T)
@@ -571,7 +572,18 @@ def prefill(
             vc = att.write_chunk_to_cache(
                 vc, k_pe[:, None, :], block_table, history_len
             )
-            if use_pallas and mesh is not None:
+            if use_ring:
+                # sequence-parallel exact attention over the sp ring,
+                # rotating the COMPRESSED latents (~(C+R) bytes/token of
+                # ICI traffic instead of 2*H*D of K/V)
+                from ..parallel.ring_attention import (
+                    mla_ring_attention_sharded,
+                )
+
+                out_lat = mla_ring_attention_sharded(
+                    q_eff, q_pe, c_kv, k_pe, mesh, scale
+                )
+            elif use_pallas and mesh is not None:
                 from ..ops import mla_attention_pallas as _mla_ops
 
                 out_lat = _mla_ops.mla_paged_prefill_attention_sharded(

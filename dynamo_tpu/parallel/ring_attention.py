@@ -60,6 +60,49 @@ def _merge(acc, m, l, contrib, m_new, l_new):  # noqa: E741
     return acc, m_next, l_next
 
 
+def _ring_reduce(axis_name, out_shape, stat_shape, rotated, attend):
+    """The shared ring recurrence: ``rotated`` (a tuple of this shard's
+    KV-side operands) hops the ring one step per iteration via ppermute
+    while ``attend(step, *operands) -> (contrib, m, l)`` contributions
+    merge into online-softmax accumulators. One implementation for the
+    GQA and MLA rings — the subtle parts (the pcast varying-manual-axes
+    workaround, compute/transfer overlap, the final out-of-loop attend
+    so no ppermute result is discarded, the l-guarded normalize) cannot
+    diverge between them. Returns the normalized [*, ...] f32 output.
+    """
+    p_size = lax.psum(1, axis_name)
+
+    # pvary: accumulators start as constants but the loop carry is
+    # device-varying over the ring axis — mark them so shard_map's
+    # varying-manual-axes check accepts the fori_loop carry
+    acc = lax.pcast(
+        jnp.zeros(out_shape, jnp.float32), (axis_name,), to="varying"
+    )
+    m = lax.pcast(
+        jnp.full(stat_shape, NEG_INF, jnp.float32), (axis_name,),
+        to="varying",
+    )
+    l = lax.pcast(  # noqa: E741
+        jnp.zeros(stat_shape, jnp.float32), (axis_name,), to="varying"
+    )
+
+    def body(step, carry):
+        acc, m, l, ops = carry  # noqa: E741
+        acc, m, l = _merge(acc, m, l, *attend(step, *ops))  # noqa: E741
+        # rotate the KV-side operands around the ring for the next step
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        ops = tuple(lax.ppermute(o, axis_name, perm) for o in ops)
+        return acc, m, l, ops
+
+    # p_size - 1 rotations; the final shard attends outside the loop so
+    # no ppermute result is ever discarded
+    acc, m, l, ops = lax.fori_loop(  # noqa: E741
+        0, p_size - 1, body, (acc, m, l, tuple(rotated))
+    )
+    acc, m, l = _merge(acc, m, l, *attend(p_size - 1, *ops))  # noqa: E741
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -79,44 +122,17 @@ def ring_attention(
     t_local = q.shape[0]
     q_pos = my * t_local + jnp.arange(t_local)
 
-    # pvary: accumulators start as constants but the loop carry is
-    # device-varying over the ring axis — mark them so shard_map's
-    # varying-manual-axes check accepts the fori_loop carry
-    acc = lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to="varying")
-    m = lax.pcast(
-        jnp.full(q.shape[:1] + q.shape[1:2], NEG_INF, jnp.float32),
-        (axis_name,), to="varying",
-    )  # [Tq, H]
-    l = lax.pcast(  # noqa: E741
-        jnp.zeros(q.shape[:1] + q.shape[1:2], jnp.float32),
-        (axis_name,), to="varying",
-    )
-
-    def attend(step, acc, m, l, k_cur, v_cur):  # noqa: E741
+    def attend(step, k_cur, v_cur):
         src = (my - step) % p_size  # whose KV we hold this step
         kv_pos = src * t_local + jnp.arange(t_local)
-        contrib, m_new, l_new = _block_attend(
+        return _block_attend(
             q.astype(jnp.float32), k_cur.astype(jnp.float32),
             v_cur.astype(jnp.float32), scale, q_pos, kv_pos, causal,
         )
-        return _merge(acc, m, l, contrib, m_new, l_new)
 
-    def body(step, carry):
-        acc, m, l, k_cur, v_cur = carry  # noqa: E741
-        acc, m, l = attend(step, acc, m, l, k_cur, v_cur)  # noqa: E741
-        # rotate KV around the ring for the next step
-        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return acc, m, l, k_nxt, v_nxt
-
-    # p_size - 1 rotations; the final shard attends outside the loop so no
-    # ppermute result is ever discarded
-    acc, m, l, k_last, v_last = lax.fori_loop(  # noqa: E741
-        0, p_size - 1, body, (acc, m, l, k, v)
+    out = _ring_reduce(
+        axis_name, q.shape, q.shape[:2], (k, v), attend
     )
-    acc, m, l = attend(p_size - 1, acc, m, l, k_last, v_last)  # noqa: E741
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
 
@@ -139,3 +155,91 @@ def ring_attention_sharded(
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+# ---------------- MLA (latent) ring attention ----------------
+
+
+def _block_attend_latent(q_eff, q_pe, c, pe, scale, q_pos, kv_pos, causal):
+    """Latent blockwise contribution: scores are the two-part absorbed
+    dot ``q_eff . c + q_pe . pe`` and the VALUES are the latents
+    themselves (models/mla.py) — the ring twin of _block_attend.
+    q_eff: [Tq, H, C], q_pe: [Tq, H, R]; c: [Tk, C], pe: [Tk, R]
+    (single shared latent stream — MQA shape, nothing to repeat)."""
+    s = (
+        jnp.einsum("qhc,kc->hqk", q_eff, c)
+        + jnp.einsum("qhr,kr->hqk", q_pe, pe)
+    ) * scale  # [H, Tq, Tk]
+    if causal:
+        mask = q_pos[None, :, None] >= kv_pos[None, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)  # noqa: E741
+    contrib = jnp.einsum("hqk,kc->qhc", p, c)
+    return contrib, jnp.transpose(m_safe), jnp.transpose(l)
+
+
+def mla_ring_attention(
+    q_eff: jnp.ndarray,  # [T_local, H, C] absorbed queries
+    q_pe: jnp.ndarray,  # [T_local, H, R]
+    c_kv: jnp.ndarray,  # [T_local, C] this shard's latents
+    k_pe: jnp.ndarray,  # [T_local, R] head-shared rotated keys
+    axis_name: str,
+    scale: float,
+    causal: bool = True,
+) -> jnp.ndarray:  # [T_local, H, C] latent outputs (caller folds w_vc)
+    """Ring attention over COMPRESSED latents for the MLA family.
+
+    Identical recurrence to :func:`ring_attention`, but each hop rotates
+    the (c_kv, k_pe) latent chunk instead of full K/V — (C + R) bytes
+    per token (576 for DeepSeek-V3) versus 2*H*D of pre-repeated K/V,
+    a ~2-orders-of-magnitude cut in ICI ring traffic. That asymmetry is
+    the MLA trade carried to sequence parallelism: queries stay heavy
+    and resident, the shared latent stream is what travels.
+    """
+    p_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q_eff.shape[0]
+    q_pos = my * t_local + jnp.arange(t_local)
+
+    def attend(step, c_cur, pe_cur):
+        src = (my - step) % p_size
+        kv_pos = src * t_local + jnp.arange(t_local)
+        return _block_attend_latent(
+            q_eff.astype(jnp.float32), q_pe.astype(jnp.float32),
+            c_cur.astype(jnp.float32), pe_cur.astype(jnp.float32),
+            scale, q_pos, kv_pos, causal,
+        )
+
+    out_shape = q_eff.shape[:2] + (c_kv.shape[-1],)
+    return _ring_reduce(
+        axis_name, out_shape, q_eff.shape[:2], (c_kv, k_pe), attend
+    )
+
+
+def mla_ring_attention_sharded(
+    q_eff: jnp.ndarray,  # [T, H, C]
+    q_pe: jnp.ndarray,  # [T, H, R]
+    c_kv: jnp.ndarray,  # [T, C]
+    k_pe: jnp.ndarray,  # [T, R]
+    mesh: Mesh,
+    scale: float,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Driver: global latent arrays in, ring over ``axis_name``, global
+    [T, H, C] latent outputs out (f32; the caller folds through w_vc)."""
+    spec3 = P(axis_name, None, None)
+    spec2 = P(axis_name, None)
+    fn = jax.shard_map(
+        partial(
+            mla_ring_attention, axis_name=axis_name, scale=scale,
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec3, spec3, spec2, spec2),
+        out_specs=spec3,
+    )
+    return fn(q_eff, q_pe, c_kv, k_pe)

@@ -318,6 +318,39 @@ def test_mla_verify_attention_matches_write_then_attend():
             )
 
 
+def test_mla_pallas_decode_scan_path_matches_unrolled():
+    """decode_layer_scan (unroll=False) routes MLA attention through the
+    latent kernel inside lax.scan; its stream must match the unrolled
+    XLA path."""
+    cfg = ModelConfig.tiny(
+        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        q_lora_rank=24, num_layers=2,
+    )
+    B, M, T = 2, 4, 4
+    params = llama.init_params(cfg, jax.random.key(14))
+    N = B * M + 1
+    tables = jnp.asarray(np.arange(1, N, dtype=np.int32).reshape(B, M))
+    streams = {}
+    for label, (up, unroll) in {
+        "ref": (False, True), "scan-pallas": (True, False),
+    }.items():
+        kc, vc = llama.init_kv_cache(cfg, N, BS)
+        toks = jnp.asarray([3, 11], jnp.int32)
+        lens = jnp.asarray([1, 1], jnp.int32)
+        out = []
+        for _ in range(T):
+            logits, kc, vc = llama.decode_step(
+                params, cfg, toks, lens - 1, tables, lens, kc, vc,
+                use_pallas=up, unroll=unroll, interpret=up, merged=False,
+            )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(np.asarray(toks))
+            lens = lens + 1
+        streams[label] = np.stack(out, axis=1)
+    np.testing.assert_array_equal(streams["ref"], streams["scan-pallas"])
+
+
 def test_mla_kernel_stats_power_the_merge():
     """return_stats must emit the exact (m, l) of the history softmax:
     reconstructing full attention from (o, m, l) + the current token
