@@ -574,8 +574,8 @@ def prefill(
             )
             if use_ring:
                 # sequence-parallel exact attention over the sp ring,
-                # rotating the COMPRESSED latents (~(C+R) bytes/token of
-                # ICI traffic instead of 2*H*D of K/V)
+                # rotating the COMPRESSED latents (C+R elements/token of
+                # ICI traffic instead of 2*H*D of repeated K/V)
                 from ..parallel.ring_attention import (
                     mla_ring_attention_sharded,
                 )

@@ -60,17 +60,20 @@ def _merge(acc, m, l, contrib, m_new, l_new):  # noqa: E741
     return acc, m_next, l_next
 
 
-def _ring_reduce(axis_name, out_shape, stat_shape, rotated, attend):
+def _ring_reduce(axis_name, t_local, out_shape, stat_shape, rotated, attend):
     """The shared ring recurrence: ``rotated`` (a tuple of this shard's
     KV-side operands) hops the ring one step per iteration via ppermute
-    while ``attend(step, *operands) -> (contrib, m, l)`` contributions
-    merge into online-softmax accumulators. One implementation for the
-    GQA and MLA rings — the subtle parts (the pcast varying-manual-axes
+    while ``attend(kv_pos, *operands) -> (contrib, m, l)`` contributions
+    merge into online-softmax accumulators; ``kv_pos`` [t_local] are the
+    global positions of the operands currently held (the source shard's
+    slots). One implementation for the GQA and MLA rings — the subtle
+    parts (position/causality bookkeeping, the pcast varying-manual-axes
     workaround, compute/transfer overlap, the final out-of-loop attend
     so no ppermute result is discarded, the l-guarded normalize) cannot
     diverge between them. Returns the normalized [*, ...] f32 output.
     """
     p_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
 
     # pvary: accumulators start as constants but the loop carry is
     # device-varying over the ring axis — mark them so shard_map's
@@ -86,9 +89,15 @@ def _ring_reduce(axis_name, out_shape, stat_shape, rotated, attend):
         jnp.zeros(stat_shape, jnp.float32), (axis_name,), to="varying"
     )
 
+    def kv_pos_at(step):
+        src = (my - step) % p_size  # whose operands we hold this step
+        return src * t_local + jnp.arange(t_local)
+
     def body(step, carry):
         acc, m, l, ops = carry  # noqa: E741
-        acc, m, l = _merge(acc, m, l, *attend(step, *ops))  # noqa: E741
+        acc, m, l = _merge(  # noqa: E741
+            acc, m, l, *attend(kv_pos_at(step), *ops)
+        )
         # rotate the KV-side operands around the ring for the next step
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         ops = tuple(lax.ppermute(o, axis_name, perm) for o in ops)
@@ -99,7 +108,9 @@ def _ring_reduce(axis_name, out_shape, stat_shape, rotated, attend):
     acc, m, l, ops = lax.fori_loop(  # noqa: E741
         0, p_size - 1, body, (acc, m, l, tuple(rotated))
     )
-    acc, m, l = _merge(acc, m, l, *attend(p_size - 1, *ops))  # noqa: E741
+    acc, m, l = _merge(  # noqa: E741
+        acc, m, l, *attend(kv_pos_at(p_size - 1), *ops)
+    )
     return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
@@ -117,21 +128,17 @@ def ring_attention(
     [T_local, H, D]. Global sequence order follows the mesh axis index.
     Returns the local shard of the attention output [T_local, H, D].
     """
-    p_size = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
     t_local = q.shape[0]
-    q_pos = my * t_local + jnp.arange(t_local)
+    q_pos = lax.axis_index(axis_name) * t_local + jnp.arange(t_local)
 
-    def attend(step, k_cur, v_cur):
-        src = (my - step) % p_size  # whose KV we hold this step
-        kv_pos = src * t_local + jnp.arange(t_local)
+    def attend(kv_pos, k_cur, v_cur):
         return _block_attend(
             q.astype(jnp.float32), k_cur.astype(jnp.float32),
             v_cur.astype(jnp.float32), scale, q_pos, kv_pos, causal,
         )
 
     out = _ring_reduce(
-        axis_name, q.shape, q.shape[:2], (k, v), attend
+        axis_name, t_local, q.shape, q.shape[:2], (k, v), attend
     )
     return out.astype(q.dtype)
 
@@ -193,20 +200,17 @@ def mla_ring_attention(
     """Ring attention over COMPRESSED latents for the MLA family.
 
     Identical recurrence to :func:`ring_attention`, but each hop rotates
-    the (c_kv, k_pe) latent chunk instead of full K/V — (C + R) bytes
-    per token (576 for DeepSeek-V3) versus 2*H*D of pre-repeated K/V,
-    a ~2-orders-of-magnitude cut in ICI ring traffic. That asymmetry is
-    the MLA trade carried to sequence parallelism: queries stay heavy
-    and resident, the shared latent stream is what travels.
+    the (c_kv, k_pe) latent chunk instead of full K/V — C + R elements
+    per token (576 for DeepSeek-V3, so 1152 B in bf16) versus 2*H*D
+    elements of pre-repeated K/V (32768 for V3 geometry), a ~57x cut in
+    ICI ring traffic at equal dtype. That asymmetry is the MLA trade
+    carried to sequence parallelism: queries stay heavy and resident,
+    the shared latent stream is what travels.
     """
-    p_size = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
     t_local = q_eff.shape[0]
-    q_pos = my * t_local + jnp.arange(t_local)
+    q_pos = lax.axis_index(axis_name) * t_local + jnp.arange(t_local)
 
-    def attend(step, c_cur, pe_cur):
-        src = (my - step) % p_size
-        kv_pos = src * t_local + jnp.arange(t_local)
+    def attend(kv_pos, c_cur, pe_cur):
         return _block_attend_latent(
             q_eff.astype(jnp.float32), q_pe.astype(jnp.float32),
             c_cur.astype(jnp.float32), pe_cur.astype(jnp.float32),
@@ -215,7 +219,8 @@ def mla_ring_attention(
 
     out_shape = q_eff.shape[:2] + (c_kv.shape[-1],)
     return _ring_reduce(
-        axis_name, out_shape, q_eff.shape[:2], (c_kv, k_pe), attend
+        axis_name, t_local, out_shape, q_eff.shape[:2], (c_kv, k_pe),
+        attend,
     )
 
 

@@ -1,0 +1,34 @@
+"""The driver contract for bench.py: run it and you get EXACTLY one
+JSON line on stdout with the required keys — the round's perf artifact
+(BENCH_r{N}.json) is whatever that line says, so a formatting or
+crash regression here silently destroys the round's recorded result.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_json_line_cpu_smoke():
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"  # honored explicitly by bench.py
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)  # single CPU device, like the driver
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    json_lines = [
+        ln for ln in r.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(json_lines) == 1, r.stdout
+    result = json.loads(json_lines[0])
+    assert set(result) >= {"metric", "value", "unit", "vs_baseline"}
+    # an explicit CPU run must be a fresh smoke measurement, never the
+    # cached-silicon replay (that fallback is for unreachable backends)
+    assert "cpu_smoke" in result["metric"]
+    assert result["value"] > 0
