@@ -36,7 +36,13 @@ from ..models import llama
 from ..models.config import ModelConfig
 from ..ops.sampling import make_keys, sample_tokens
 from ..parallel.mesh import MeshConfig, cache_sharding, make_mesh, shard_params
-from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from ..protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
 from ..runtime.engine import AsyncEngine, Context
 from .allocator import Block, BlockAllocator, sequence_block_hashes
 from .offload import OffloadManager
@@ -349,6 +355,60 @@ class JaxEngine(AsyncEngine):
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.mirror.lead_halt
                 )
+
+    async def warmup(self) -> list[int]:
+        """Compile the serving paths BEFORE real traffic: one dummy
+        request per reachable prefill bucket (chunked prefill buckets
+        every chunk, so larger prompts only ever see these shapes) plus
+        the full decode-window ladder. Without this, the first real
+        request at each new shape pays a 20-40s XLA compile on its TTFT
+        — the TPU analog of the reference engines' startup
+        profile/warmup pass.
+
+        Details that make the coverage real:
+          * each bucket's prompt repeats a DIFFERENT token — identical
+            prompts would prefix-hit the previous request's committed
+            blocks and prefill only the (smaller-bucket) tail;
+          * a prompt of min(prefill_chunk, max_context-1) tokens warms
+            the TOP bucket real chunks round up to, which the
+            power-of-two list alone misses when that limit isn't a
+            bucket boundary;
+          * the first request generates 2*decode_window - 1 tokens:
+            _pick_window then walks the whole power-of-two window
+            ladder W, W/2, ..., 1 — the smaller windows are exactly
+            what concurrent admission traffic dispatches, so leaving
+            them cold would inject the compile stall mid-stream under
+            real load.
+
+        Dummy blocks enter the prefix cache content-addressed and age
+        out LRU like any other. The speculative verify still compiles on
+        its first organic proposal. Returns the warmed bucket sizes.
+        """
+        lim = min(self.cfg.prefill_chunk, self.cfg.max_context - 1)
+        lengths = [b for b in PREFILL_BUCKETS if b <= lim]
+        sizes = list(lengths)
+        top = _bucket(lim)
+        if top not in sizes:
+            lengths.append(lim)
+            sizes.append(top)
+        W = self.cfg.decode_window
+        V = self.cfg.model.vocab_size
+        for i, n_toks in enumerate(lengths):
+            req = PreprocessedRequest(
+                token_ids=[(i + 2) % V] * n_toks,
+                stop_conditions=StopConditions(
+                    # the first (shortest) prompt has the context
+                    # headroom to walk the decode-window ladder; the
+                    # rest stop at their prefill-sampled token
+                    max_tokens=max(2 * W - 1, 1) if i == 0 else 1,
+                    ignore_eos=True,
+                ),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[],
+            )
+            async for _ in self.generate(Context(req)):
+                pass
+        return sizes
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
         self.start()

@@ -191,6 +191,17 @@ def build_core_engine(args, cfg: ModelConfig, params, mirror=None) -> AsyncEngin
     raise SystemExit(f"unknown out= engine {args.out!r}")
 
 
+async def maybe_warmup(args, core) -> None:
+    """--warmup: compile the serving paths before any endpoint/port
+    exists, so discovery can never route a request into a cold-bucket
+    XLA compile."""
+    if args.warmup and isinstance(core, JaxEngine):
+        t0 = time.monotonic()
+        sizes = await core.warmup()
+        print(f"warmup: compiled prefill buckets {sizes} + decode window "
+              f"ladder in {time.monotonic() - t0:.1f}s", flush=True)
+
+
 async def connect_runtime(args) -> DistributedRuntime:
     if args.hub:
         store, bus, _conn = await connect_hub(args.hub)
@@ -229,6 +240,7 @@ async def run_http(args) -> None:
     else:
         cfg, params, tokenizer, name = build_model(args)
         core = build_core_engine(args, cfg, params)
+        await maybe_warmup(args, core)
         engine = OpenAIWorkerEngine(tokenizer, core)
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
@@ -278,8 +290,9 @@ async def run_endpoint(args) -> None:
     if mh.enabled:
         mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
     core = build_core_engine(args, cfg, params, mirror=mirror)
-    drt = await connect_runtime(args)
     jax_core = core if isinstance(core, JaxEngine) else None
+    await maybe_warmup(args, core)
+    drt = await connect_runtime(args)
     if args.disagg == "decode":
         # conditional disaggregation: long uncached prompts offload to
         # prefill workers via the queue + KV transfer plane (disagg/)
@@ -567,6 +580,10 @@ def main(argv=None) -> None:
                    help="uncached prompt tokens above this go remote")
     p.add_argument("--engine-subprocess", action="store_true",
                    help="isolate a pystr:/pytok: engine in a child process")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every prefill bucket + the decode window "
+                        "before serving (first-request TTFT skips the "
+                        "20-40s per-bucket XLA compile)")
     args = p.parse_args(argv)
 
     # escape hatch for tests/ops: force the JAX platform before any device

@@ -112,6 +112,37 @@ def test_engine_greedy_deterministic(run, engine_cfg, shared_engine):
     run(main())
 
 
+def test_warmup_compiles_buckets_and_serving_still_exact(run, engine_cfg):
+    """warmup() must cover every reachable prefill bucket, and a real
+    request after warmup must produce the same stream as a cold engine
+    (dummy blocks may enter the prefix cache but cannot change outputs)."""
+    from dataclasses import replace
+
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    async def main():
+        cold = JaxEngine(replace(engine_cfg), seed=0)
+        ref = await collect(cold.generate(Context(make_req(range(30, 44),
+                                                           max_tokens=5))))
+        ref_toks = [t for o in ref for t in o.token_ids]
+        await cold.close()
+
+        # prefill_chunk=48 is not a bucket boundary: real 33..48-token
+        # chunks round UP to bucket 64, which the warm set must include
+        warm = JaxEngine(replace(engine_cfg, prefill_chunk=48), seed=0)
+        sizes = await warm.warmup()
+        assert sizes == [16, 32, 64], sizes
+        # distinct per-bucket dummy tokens: a prefix-cache hit would mean
+        # a warmup prompt only prefilled its (smaller) TAIL bucket
+        assert warm.stats["prefix_cache_hits_tokens"] == 0, warm.stats
+        out = await collect(warm.generate(Context(make_req(range(30, 44),
+                                                           max_tokens=5))))
+        assert [t for o in out for t in o.token_ids] == ref_toks
+        await warm.close()
+
+    run(main())
+
+
 def test_decode_window_matches_single_step(run, engine_cfg):
     """Fused n-step decode windows must produce the exact token stream of
     1-step dispatch (sampled and greedy): the scan feeds step i's token to
