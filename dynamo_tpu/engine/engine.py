@@ -373,16 +373,21 @@ class JaxEngine(AsyncEngine):
             the TOP bucket real chunks round up to, which the
             power-of-two list alone misses when that limit isn't a
             bucket boundary;
-          * the first request generates 2*decode_window - 1 tokens:
-            _pick_window then walks the whole power-of-two window
-            ladder W, W/2, ..., 1 — the smaller windows are exactly
-            what concurrent admission traffic dispatches, so leaving
-            them cold would inject the compile stall mid-stream under
-            real load.
+          * the first request's max_tokens is 2*decode_window: one token
+            comes from the prefill sample, so decode has a 2W-1 budget
+            and _pick_window walks the whole power-of-two ladder
+            W, W/2, ..., 1 — the smaller windows (especially 1) are
+            exactly what concurrent admission traffic dispatches, so
+            leaving them cold would inject the compile stall mid-stream
+            under real load;
+          * speculation is held off for the duration: repeated-token
+            dummy prompts are the canonical prompt-lookup trigger, and
+            an engaged verify would swallow the very window dispatches
+            being warmed (the verify itself still compiles on its first
+            organic proposal).
 
         Dummy blocks enter the prefix cache content-addressed and age
-        out LRU like any other. The speculative verify still compiles on
-        its first organic proposal. Returns the warmed bucket sizes.
+        out LRU like any other. Returns the warmed bucket sizes.
         """
         lim = min(self.cfg.prefill_chunk, self.cfg.max_context - 1)
         lengths = [b for b in PREFILL_BUCKETS if b <= lim]
@@ -393,21 +398,31 @@ class JaxEngine(AsyncEngine):
             sizes.append(top)
         W = self.cfg.decode_window
         V = self.cfg.model.vocab_size
-        for i, n_toks in enumerate(lengths):
-            req = PreprocessedRequest(
-                token_ids=[(i + 2) % V] * n_toks,
-                stop_conditions=StopConditions(
-                    # the first (shortest) prompt has the context
-                    # headroom to walk the decode-window ladder; the
-                    # rest stop at their prefill-sampled token
-                    max_tokens=max(2 * W - 1, 1) if i == 0 else 1,
-                    ignore_eos=True,
-                ),
-                sampling_options=SamplingOptions(temperature=0.0),
-                eos_token_ids=[],
-            )
-            async for _ in self.generate(Context(req)):
-                pass
+        gamma, self.cfg.spec_gamma = self.cfg.spec_gamma, 0
+        try:
+            for i, n_toks in enumerate(lengths):
+                # per-bucket pseudo-random prompts: distinct across
+                # buckets (no prefix-cache hit shrinking the prefilled
+                # shape) and non-repeating within one (no n-gram bait)
+                toks = np.random.RandomState(1000 + i).randint(
+                    0, V, n_toks
+                ).tolist()
+                req = PreprocessedRequest(
+                    token_ids=toks,
+                    stop_conditions=StopConditions(
+                        # the first (shortest) prompt has the context
+                        # headroom to walk the decode-window ladder; the
+                        # rest stop at their prefill-sampled token
+                        max_tokens=2 * W if i == 0 else 1,
+                        ignore_eos=True,
+                    ),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    eos_token_ids=[],
+                )
+                async for _ in self.generate(Context(req)):
+                    pass
+        finally:
+            self.cfg.spec_gamma = gamma
         return sizes
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:

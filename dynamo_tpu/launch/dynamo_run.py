@@ -382,6 +382,7 @@ async def run_prefill(args) -> None:
         mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
     core = build_core_engine(args, cfg, params, mirror=mirror)
     assert isinstance(core, JaxEngine), "in=prefill requires out=jax"
+    await maybe_warmup(args, core)
     drt = await connect_runtime(args)
     queue = PrefillQueue(drt.bus, ns)
     worker = PrefillWorker(core, queue)
@@ -414,6 +415,7 @@ async def _one_shot(engine: AsyncEngine, model: str, prompt: str, max_tokens: in
 async def run_text(args) -> None:
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
+    await maybe_warmup(args, core)
     engine = OpenAIWorkerEngine(tokenizer, core)
     print(f"interactive mode — model {name!r}; ctrl-d to exit", flush=True)
     loop = asyncio.get_running_loop()
@@ -430,6 +432,7 @@ async def run_text(args) -> None:
 async def run_stdin(args) -> None:
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
+    await maybe_warmup(args, core)
     engine = OpenAIWorkerEngine(tokenizer, core)
     prompt = sys.stdin.read().strip()
     await _one_shot(engine, name, prompt, args.max_tokens,
@@ -441,6 +444,7 @@ async def run_batch(args, batch_file: str) -> None:
     """Throughput harness (ref input/batch.rs): JSONL with {"text": ...}."""
     cfg, params, tokenizer, name = build_model(args)
     core = build_core_engine(args, cfg, params)
+    await maybe_warmup(args, core)  # keep compiles out of the throughput numbers
     pipeline = core if getattr(core, "text_mode", False) else link(Backend(tokenizer), core)
 
     entries = []

@@ -232,6 +232,53 @@ def test_disagg_end_to_end_matches_aggregated(run, mode):
     run(main())
 
 
+def test_disagg_mla_kv_transfer_matches_aggregated(run):
+    """Disagg on the MLA family: the KV transfer plane must carry the
+    latent cache's ASYMMETRIC k/v shapes (c_kv vs k_pe) over the TCP
+    path and land a decode stream equal to aggregated serving."""
+
+    async def main():
+        mla_cfg = ModelConfig.tiny(
+            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24, num_layers=2,
+        )
+        mla_params = llama.init_params(mla_cfg, jax.random.key(9))
+        drt = await DistributedRuntime.from_settings()
+        router = ConditionalDisaggRouter(
+            drt, "dynamo", "tiny-mla", DisaggConfig(max_local_prefill_length=8)
+        )
+        await router.start()
+        queue = PrefillQueue(drt.bus)
+        decode = JaxEngine(engine_cfg(model=mla_cfg), params=mla_params)
+        prefill = JaxEngine(engine_cfg(model=mla_cfg), params=mla_params)
+        assert decode.k_cache.shape[-1] != decode.v_cache.shape[-1]
+        transfer = KvTransferServer()
+        await transfer.start()
+        worker = PrefillWorker(prefill, queue, layer_chunk=1)
+        worker.start()
+        eng = DisaggEngine(decode, router, queue, transfer)
+
+        prompt = list(range(10, 34))  # 24 tokens >> max_local 8 -> remote
+        outs = await collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
+        toks = [t for o in outs for t in o.token_ids]
+        assert eng.stats["remote_prefills"] == 1
+
+        ref_engine = JaxEngine(engine_cfg(model=mla_cfg), params=mla_params)
+        ref = await collect(ref_engine.generate(Context(make_req(prompt, max_tokens=6))))
+        assert toks == [t for o in ref for t in o.token_ids]
+
+        await worker.close()
+        await transfer.close()
+        await decode.close()
+        await prefill.close()
+        await ref_engine.close()
+        await router.stop()
+        await drt.shutdown()
+
+    run(main())
+
+
 def test_disagg_first_token_carries_logprobs(run):
     """Regression (advisor r2 low): a logprobs request served via remote
     prefill must emit a logprob entry for the FIRST generated token too —

@@ -129,12 +129,27 @@ def test_warmup_compiles_buckets_and_serving_still_exact(run, engine_cfg):
 
         # prefill_chunk=48 is not a bucket boundary: real 33..48-token
         # chunks round UP to bucket 64, which the warm set must include
-        warm = JaxEngine(replace(engine_cfg, prefill_chunk=48), seed=0)
+        warm = JaxEngine(
+            replace(engine_cfg, prefill_chunk=48, decode_window=4,
+                    spec_gamma=3),
+            seed=0,
+        )
+        windows = []
+        orig_pick = warm._pick_window
+        warm._pick_window = lambda: windows.append(n := orig_pick()) or n
         sizes = await warm.warmup()
+        warm._pick_window = orig_pick
         assert sizes == [16, 32, 64], sizes
         # distinct per-bucket dummy tokens: a prefix-cache hit would mean
         # a warmup prompt only prefilled its (smaller) TAIL bucket
         assert warm.stats["prefix_cache_hits_tokens"] == 0, warm.stats
+        # the decode-window ladder walks ALL the way down: 1-step windows
+        # are what concurrent admission dispatches, and speculation (the
+        # other path that could swallow window dispatches on repetitive
+        # dummy prompts) must be held off during warmup
+        assert {4, 2, 1} <= set(windows), windows
+        assert warm.stats["spec_proposed"] == 0, warm.stats
+        assert warm.cfg.spec_gamma == 3  # restored after warmup
         out = await collect(warm.generate(Context(make_req(range(30, 44),
                                                            max_tokens=5))))
         assert [t for o in out for t in o.token_ids] == ref_toks

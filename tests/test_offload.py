@@ -88,6 +88,43 @@ def test_engine_offload_restore_roundtrip(run):
     run(main())
 
 
+def test_engine_offload_restore_roundtrip_mla(run):
+    """The host tier must carry the MLA latent cache's ASYMMETRIC
+    k/v shapes (c_kv [.., C] vs k_pe [.., R]) through evict + restore
+    with the same greedy-stream guarantee."""
+    cfg = EngineConfig(
+        model=ModelConfig.tiny(
+            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24, num_layers=2,
+        ),
+        num_blocks=17,
+        block_size=4,
+        max_batch_size=2,
+        max_context=64,
+        prefill_chunk=32,
+        host_cache_blocks=64,
+    )
+    engine = JaxEngine(cfg, seed=0)
+    assert engine.k_cache.shape[-1] != engine.v_cache.shape[-1]
+
+    async def main():
+        prompt_a = list(range(100, 124))
+        out1 = await collect(engine.generate(Context(_req(prompt_a, max_tokens=4))))
+        toks1 = [t for o in out1 for t in o.token_ids]
+        for i in range(4):
+            filler = list(range(200 + 30 * i, 200 + 30 * i + 24))
+            await collect(engine.generate(Context(_req(filler, max_tokens=2))))
+        assert engine.offload.pool.stored_total > 0
+        base_hits = engine.offload.pool.hit_blocks_total
+        out2 = await collect(engine.generate(Context(_req(prompt_a, max_tokens=4))))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert engine.offload.pool.hit_blocks_total > base_hits
+        assert toks1 == toks2
+
+    run(main())
+
+
 def test_engine_offload_disabled_by_default(run):
     cfg = EngineConfig(
         model=ModelConfig.tiny(), num_blocks=17, block_size=4, max_batch_size=2,
