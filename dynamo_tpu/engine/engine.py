@@ -356,7 +356,7 @@ class JaxEngine(AsyncEngine):
                     None, self.mirror.lead_halt
                 )
 
-    async def warmup(self) -> list[int]:
+    async def warmup(self, decode: bool = True) -> list[int]:
         """Compile the serving paths BEFORE real traffic: one dummy
         request per reachable prefill bucket (chunked prefill buckets
         every chunk, so larger prompts only ever see these shapes) plus
@@ -366,8 +366,8 @@ class JaxEngine(AsyncEngine):
         profile/warmup pass.
 
         Details that make the coverage real:
-          * each bucket's prompt repeats a DIFFERENT token — identical
-            prompts would prefix-hit the previous request's committed
+          * each bucket gets its own pseudo-random prompt — a repeated
+            prompt would prefix-hit the previous request's committed
             blocks and prefill only the (smaller-bucket) tail;
           * a prompt of min(prefill_chunk, max_context-1) tokens warms
             the TOP bucket real chunks round up to, which the
@@ -385,6 +385,10 @@ class JaxEngine(AsyncEngine):
             an engaged verify would swallow the very window dispatches
             being warmed (the verify itself still compiles on its first
             organic proposal).
+
+        ``decode=False`` skips the window ladder entirely (every request
+        stops at its prefill-sampled token) — for prefill-only disagg
+        workers, which never dispatch decode windows.
 
         Dummy blocks enter the prefix cache content-addressed and age
         out LRU like any other. Returns the warmed bucket sizes.
@@ -413,7 +417,7 @@ class JaxEngine(AsyncEngine):
                         # the first (shortest) prompt has the context
                         # headroom to walk the decode-window ladder; the
                         # rest stop at their prefill-sampled token
-                        max_tokens=2 * W if i == 0 else 1,
+                        max_tokens=2 * W if (i == 0 and decode) else 1,
                         ignore_eos=True,
                     ),
                     sampling_options=SamplingOptions(temperature=0.0),

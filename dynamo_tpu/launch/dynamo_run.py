@@ -191,15 +191,17 @@ def build_core_engine(args, cfg: ModelConfig, params, mirror=None) -> AsyncEngin
     raise SystemExit(f"unknown out= engine {args.out!r}")
 
 
-async def maybe_warmup(args, core) -> None:
+async def maybe_warmup(args, core, decode: bool = True) -> None:
     """--warmup: compile the serving paths before any endpoint/port
     exists, so discovery can never route a request into a cold-bucket
-    XLA compile."""
+    XLA compile. ``decode=False`` (prefill-only disagg workers) skips
+    the decode-window ladder those roles never dispatch."""
     if args.warmup and isinstance(core, JaxEngine):
         t0 = time.monotonic()
-        sizes = await core.warmup()
-        print(f"warmup: compiled prefill buckets {sizes} + decode window "
-              f"ladder in {time.monotonic() - t0:.1f}s", flush=True)
+        sizes = await core.warmup(decode=decode)
+        what = "+ decode window ladder " if decode else "(prefill only) "
+        print(f"warmup: compiled prefill buckets {sizes} {what}"
+              f"in {time.monotonic() - t0:.1f}s", flush=True)
 
 
 async def connect_runtime(args) -> DistributedRuntime:
@@ -382,7 +384,7 @@ async def run_prefill(args) -> None:
         mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
     core = build_core_engine(args, cfg, params, mirror=mirror)
     assert isinstance(core, JaxEngine), "in=prefill requires out=jax"
-    await maybe_warmup(args, core)
+    await maybe_warmup(args, core, decode=False)
     drt = await connect_runtime(args)
     queue = PrefillQueue(drt.bus, ns)
     worker = PrefillWorker(core, queue)

@@ -203,6 +203,19 @@ class ModelConfig:
         base.update(overrides)
         return ModelConfig(**base)
 
+    @staticmethod
+    def tiny_mla(**overrides) -> "ModelConfig":
+        """A small DeepSeek-shaped MLA config (compressed latent cache,
+        absorbed attention) for tests/benches — ONE definition so shape
+        tweaks can't drift between the many tests that need it."""
+        base = dict(
+            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            q_lora_rank=24, num_layers=2,
+        )
+        base.update(overrides)
+        return ModelConfig.tiny(**base)
+
     # llama-3-8b-ish for benches
     @staticmethod
     def llama3_8b(**overrides) -> "ModelConfig":

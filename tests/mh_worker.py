@@ -35,8 +35,15 @@ from dynamo_tpu.runtime.hub import connect_hub  # noqa: E402
 
 
 def engine_cfg() -> EngineConfig:
+    if os.environ.get("MH_MODEL") == "mla":
+        # DeepSeek-shaped: q heads shard over tp, the latent cache
+        # (ASYMMETRIC k/v trailing dims) replicates — the mirror's
+        # broadcast frames and follower cache bookkeeping must carry it
+        model = ModelConfig.tiny_mla()
+    else:
+        model = ModelConfig.tiny()
     return EngineConfig(
-        model=ModelConfig.tiny(),
+        model=model,
         num_blocks=32,
         block_size=16,
         max_batch_size=4,

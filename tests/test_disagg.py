@@ -238,11 +238,7 @@ def test_disagg_mla_kv_transfer_matches_aggregated(run):
     path and land a decode stream equal to aggregated serving."""
 
     async def main():
-        mla_cfg = ModelConfig.tiny(
-            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-            q_lora_rank=24, num_layers=2,
-        )
+        mla_cfg = ModelConfig.tiny_mla()
         mla_params = llama.init_params(mla_cfg, jax.random.key(9))
         drt = await DistributedRuntime.from_settings()
         router = ConditionalDisaggRouter(

@@ -150,6 +150,13 @@ def test_warmup_compiles_buckets_and_serving_still_exact(run, engine_cfg):
         assert {4, 2, 1} <= set(windows), windows
         assert warm.stats["spec_proposed"] == 0, warm.stats
         assert warm.cfg.spec_gamma == 3  # restored after warmup
+
+        # prefill-only role (disagg prefill worker): no decode windows
+        pre = JaxEngine(replace(engine_cfg, decode_window=4), seed=0)
+        base_steps = pre.stats["decode_steps"]
+        await pre.warmup(decode=False)
+        assert pre.stats["decode_steps"] == base_steps, pre.stats
+        await pre.close()
         out = await collect(warm.generate(Context(make_req(range(30, 44),
                                                            max_tokens=5))))
         assert [t for o in out for t in o.token_ids] == ref_toks

@@ -80,11 +80,7 @@ def test_mla_merged_decode_stream_matches_xla_path():
     """Model-level: the merged MLA decode (latent kernel + one append,
     interpret mode) must produce the same tokens and cache as the
     per-layer-write XLA path over a multi-step window."""
-    cfg = ModelConfig.tiny(
-        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-        q_lora_rank=24, num_layers=2,
-    )
+    cfg = ModelConfig.tiny_mla(dtype="float32")
     B, M, T = 2, 4, 5
     params = llama.init_params(cfg, jax.random.key(3))
     N = B * M + 1
@@ -176,11 +172,7 @@ def test_mla_pallas_decode_on_tp_mesh_matches_single_device():
     (merged AND non-merged) must match the single-device XLA stream."""
     from jax.sharding import Mesh
 
-    cfg = ModelConfig.tiny(
-        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-        q_lora_rank=24, num_layers=2,
-    )
+    cfg = ModelConfig.tiny_mla(dtype="float32")
     B, M, T = 2, 4, 4
     params = llama.init_params(cfg, jax.random.key(8))
     N = B * M + 1
@@ -322,11 +314,7 @@ def test_mla_pallas_decode_scan_path_matches_unrolled():
     """decode_layer_scan (unroll=False) routes MLA attention through the
     latent kernel inside lax.scan; its stream must match the unrolled
     XLA path."""
-    cfg = ModelConfig.tiny(
-        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-        q_lora_rank=24, num_layers=2,
-    )
+    cfg = ModelConfig.tiny_mla(dtype="float32")
     B, M, T = 2, 4, 4
     params = llama.init_params(cfg, jax.random.key(14))
     N = B * M + 1

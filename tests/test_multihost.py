@@ -27,7 +27,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn(rank: int, coord_port: int, hub: str) -> subprocess.Popen:
+def _spawn(rank: int, coord_port: int, hub: str,
+           extra_env: dict | None = None) -> subprocess.Popen:
     env = os.environ.copy()
     env.pop("XLA_FLAGS", None)  # the worker sets its own device count
     # CPU-only workers must not touch the TPU relay at interpreter
@@ -35,6 +36,7 @@ def _spawn(rank: int, coord_port: int, hub: str) -> subprocess.Popen:
     # relay then hangs every new python before main() runs)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = REPO
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
          str(rank), str(coord_port), hub],
@@ -43,6 +45,82 @@ def _spawn(rank: int, coord_port: int, hub: str) -> subprocess.Popen:
         stderr=subprocess.STDOUT,
         text=True,
     )
+
+
+def test_two_process_mesh_serves_mla(run):
+    """The mirror must carry the MLA family across processes: asymmetric
+    latent k/v cache shapes ride the broadcast frames / follower cache
+    bookkeeping, and the mirrored stream equals a single-process engine
+    with the same seed."""
+    async def main():
+        # single-process reference stream (same default-seed weights)
+        from dynamo_tpu.engine import EngineConfig, JaxEngine
+        from dynamo_tpu.models.config import ModelConfig
+        mla_model = ModelConfig.tiny_mla()
+        local = JaxEngine(EngineConfig(
+            model=mla_model, num_blocks=32, block_size=16, max_batch_size=4,
+        ))
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest, SamplingOptions, StopConditions,
+        )
+        lreq = PreprocessedRequest(
+            token_ids=[5, 6, 7, 8],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        )
+        ref = await collect(local.generate(Context(lreq)))
+        ref_toks = [t for o in ref for t in o.token_ids]
+        await local.close()
+
+        hub = HubServer()
+        await hub.start()
+        coord = _free_port()
+        procs = [
+            _spawn(r, coord, hub.address, extra_env={"MH_MODEL": "mla"})
+            for r in (0, 1)
+        ]
+        try:
+            store, bus, conn = await connect_hub(hub.address)
+            front = await DistributedRuntime.from_settings(store=store, bus=bus)
+            client = await (
+                front.namespace("mh").component("worker").endpoint("generate")
+                .client().start()
+            )
+            await client.wait_for_instances(timeout=120)
+            req = {
+                "token_ids": [5, 6, 7, 8],
+                "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+                "sampling_options": {"temperature": 0.0},
+            }
+            for _ in range(2):  # the worker halts after two requests
+                out = await asyncio.wait_for(
+                    collect(await client.round_robin(Context(req))), 120
+                )
+            datas = [a.data for a in out if a.data]
+            tokens = [t for d in datas for t in d.get("token_ids", [])]
+            assert tokens == ref_toks, (tokens, ref_toks)
+
+            await front.shutdown()
+            await conn.close()
+            import functools
+
+            loop = asyncio.get_running_loop()
+            for p in procs:
+                out_text = (
+                    await loop.run_in_executor(
+                        None, functools.partial(p.communicate, timeout=150)
+                    )
+                )[0]
+                assert p.returncode == 0, f"rank exited {p.returncode}:\n{out_text}"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+            await hub.close()
+
+    run(main())
 
 
 def test_two_process_mesh_serves_through_hub(run):

@@ -93,11 +93,7 @@ def test_engine_offload_restore_roundtrip_mla(run):
     k/v shapes (c_kv [.., C] vs k_pe [.., R]) through evict + restore
     with the same greedy-stream guarantee."""
     cfg = EngineConfig(
-        model=ModelConfig.tiny(
-            num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-            q_lora_rank=24, num_layers=2,
-        ),
+        model=ModelConfig.tiny_mla(),
         num_blocks=17,
         block_size=4,
         max_batch_size=2,

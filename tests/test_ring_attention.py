@@ -119,11 +119,7 @@ def test_mla_ring_prefill_serving_path(run):
     )
     from dynamo_tpu.runtime import Context, collect
 
-    mcfg = ModelConfig.tiny(
-        dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-        q_lora_rank=24, num_layers=2,
-    )
+    mcfg = ModelConfig.tiny_mla(dtype="float32")
     params = llama.init_params(mcfg, jax.random.key(4))
     prompt = [(5 * i + 2) % mcfg.vocab_size for i in range(48)]
 

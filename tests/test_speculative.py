@@ -140,11 +140,7 @@ def test_verify_window_matches_forced_decode_steps(family):
     decode_steps fed the same forced tokens — for the dense family AND
     the MLA family (absorbed multi-token verify, write-before-attend)."""
     if family == "mla":
-        cfg = ModelConfig.tiny(
-            dtype="float32", num_heads=4, num_kv_heads=4, kv_lora_rank=32,
-            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
-            q_lora_rank=24, num_layers=2,
-        )
+        cfg = ModelConfig.tiny_mla(dtype="float32")
     else:
         cfg = ModelConfig.tiny(dtype="float32")
     B, M, T = 2, 8, 4
