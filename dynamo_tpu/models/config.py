@@ -47,6 +47,8 @@ class ModelConfig:
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # qwen3: per-head RMS norm on q and k after projection, before rope
+    qk_norm: bool = False
     # MoE (0 experts = dense)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -151,7 +153,21 @@ class ModelConfig:
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
             attention_bias=qkv_bias,
-            num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
+            # qwen3 (dense and MoE): per-head q/k RMS norm, no qkv bias
+            qk_norm=any(a.startswith("Qwen3") for a in archs),
+            # mixtral: num_local_experts; deepseek: n_routed_experts;
+            # qwen3moe: num_experts — the bare key is honored ONLY for
+            # Qwen3 archs, because qwen2_moe also carries it and its
+            # always-on shared expert is not implemented: that family
+            # must keep failing loudly at load, not serve garbage
+            num_experts=cfg.get(
+                "num_local_experts",
+                cfg.get(
+                    "n_routed_experts",
+                    cfg.get("num_experts", 0)
+                    if any(a.startswith("Qwen3") for a in archs) else 0,
+                ),
+            ) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,

@@ -86,6 +86,9 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
             layers["bq"] = jnp.zeros((L, H * D), dt)
             layers["bk"] = jnp.zeros((L, Hkv * D), dt)
             layers["bv"] = jnp.zeros((L, Hkv * D), dt)
+        if cfg.qk_norm:
+            layers["q_norm"] = jnp.ones((L, D), dt)
+            layers["k_norm"] = jnp.ones((L, D), dt)
     if moe:
         X = cfg.num_experts
         Fm = cfg.moe_intermediate_size or F
@@ -487,6 +490,9 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     q = q.reshape(x.shape[:-1] + (q.shape[-1] // D, D))
     k = k.reshape(x.shape[:-1] + (k.shape[-1] // D, D))
     v = v.reshape(x.shape[:-1] + (v.shape[-1] // D, D))
+    if cfg.qk_norm:  # qwen3: per-head RMS norm before rope, weight [D]
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

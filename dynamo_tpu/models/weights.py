@@ -155,6 +155,15 @@ def load_llama_params(
                                   rng, transpose=False)
                 out["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias",
                                   rng, transpose=False)
+            if cfg.qk_norm:  # qwen3 per-head q/k norms, weight [head_dim]
+                out["q_norm"] = stack(
+                    "model.layers.{i}.self_attn.q_norm.weight", rng,
+                    transpose=False,
+                )
+                out["k_norm"] = stack(
+                    "model.layers.{i}.self_attn.k_norm.weight", rng,
+                    transpose=False,
+                )
         return out
 
     def dense_ffn_leaves(rng) -> dict:
@@ -296,7 +305,14 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
         "moe_gate_bias": (
             "model.layers.{i}.mlp.gate.e_score_correction_bias", False
         ),
+        "k_norm": ("model.layers.{i}.self_attn.k_norm.weight", False),
     }
+    if cfg is not None and getattr(cfg, "qk_norm", False):
+        # "q_norm" is shared between two checkpoint conventions: the MLA
+        # q_a_layernorm (default above) and qwen3's per-head q_norm
+        names["q_norm"] = (
+            "model.layers.{i}.self_attn.q_norm.weight", False
+        )
 
     def save_group(lay: dict, n: int, off: int) -> None:
         lay = dict(lay)

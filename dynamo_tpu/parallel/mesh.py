@@ -82,6 +82,9 @@ _PARAM_SPECS = {
     "layers.bq": P("pp", "tp"),
     "layers.bk": P("pp", "tp"),
     "layers.bv": P("pp", "tp"),
+    # qwen3 per-head q/k norms [L, head_dim] (q_norm shares the MLA
+    # entry below — same rank-2 layer-stacked shape, same placement)
+    "layers.k_norm": P("pp", None),
     "layers.w_gate": P("pp", None, "tp"),  # column: hidden
     "layers.w_up": P("pp", None, "tp"),
     "layers.w_down": P("pp", "tp", None),  # row

@@ -80,6 +80,44 @@ def test_qwen2_parity(tmp_path):
     _compare(path, TOKENS, model)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "Qwen3Config"),
+    reason="transformers too old for Qwen3",
+)
+def test_qwen3_parity(tmp_path):
+    # qwen3: per-head RMS norm on q/k before rope, no qkv bias
+    hf_cfg = transformers.Qwen3Config(**TINY, head_dim=16)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    with torch.no_grad():  # ones-init norms would make the check vacuous
+        for name, p in model.named_parameters():
+            if "q_norm" in name or "k_norm" in name:
+                p.normal_(1.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.qk_norm and not cfg.attention_bias
+    _compare(path, TOKENS, model)
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Qwen3MoeConfig"),
+    reason="transformers too old for Qwen3-MoE",
+)
+def test_qwen3_moe_parity(tmp_path):
+    hf_cfg = transformers.Qwen3MoeConfig(
+        **TINY, head_dim=16, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, norm_topk_prob=True,
+    )
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if "q_norm" in name or "k_norm" in name:
+                p.normal_(1.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.qk_norm and cfg.num_experts == 4
+    _compare(path, TOKENS, model)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)

@@ -14,6 +14,9 @@ from dynamo_tpu.parallel.pp import can_pipeline, pipelined_prefill
 CFG = ModelConfig.tiny(dtype="float32")
 # 4 layers so pp=4 stages hold one layer each
 CFG4 = ModelConfig.tiny(dtype="float32", num_layers=4)
+# qwen3-shaped: per-head q/k norms must carry a pp-sharded param spec
+# (a replicated [L, D] leaf would break the stage-local lax.scan)
+CFG_QKN = ModelConfig.tiny(dtype="float32", qk_norm=True)
 
 
 def _setup(mesh_cfg, T=16, hist=0, valid=None, seed=0, cfg=CFG):
@@ -40,6 +43,7 @@ def _reference(params, toks, table, kc, vc, hist, valid, cfg=CFG):
     (MeshConfig(pp=2), 2, CFG),
     (MeshConfig(pp=2, tp=2), 2, CFG),
     (MeshConfig(pp=4), 4, CFG4),
+    (MeshConfig(pp=2), 2, CFG_QKN),
 ])
 def test_pipelined_prefill_matches_scan(mesh_cfg, n_micro, cfg):
     mesh, params, toks, table, kc, vc, hist, valid = _setup(mesh_cfg, cfg=cfg)

@@ -31,6 +31,21 @@ def test_dense_roundtrip(tmp_path):
     _roundtrip(tmp_path, ModelConfig.tiny(dtype="float32"))
 
 
+def test_qwen3_qk_norm_roundtrip(tmp_path):
+    # "q_norm" names two different checkpoint conventions (MLA
+    # q_a_layernorm vs qwen3 per-head q_norm): the save path must pick
+    # by cfg and write k_norm too, or the roundtrip KeyErrors
+    cfg = ModelConfig.tiny(dtype="float32", qk_norm=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    save_llama_params(str(tmp_path), params, cfg=cfg)
+    loaded = load_llama_params(str(tmp_path), cfg, dtype="float32")
+    assert jax.tree.structure(params) == jax.tree.structure(loaded)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6
+        )
+
+
 def test_moe_roundtrip(tmp_path):
     _roundtrip(
         tmp_path,
