@@ -259,6 +259,10 @@ class JaxEngine(AsyncEngine):
                 (
                     not cfg.model.is_mla
                     and cfg.model.head_dim % 128 == 0
+                    # sinks and per-layer windows live in the XLA
+                    # attention paths only (gpt-oss)
+                    and not cfg.model.attn_sinks
+                    and not cfg.model.layer_windows
                     and (
                         self.mesh is None
                         or cfg.model.num_kv_heads % tp == 0
@@ -750,6 +754,8 @@ class JaxEngine(AsyncEngine):
             or self.mesh.shape.get("sp", 1) <= 1
             or len(seq.tokens) < cfg.ring_prefill_threshold
             or cfg.model.sliding_window != 0
+            or cfg.model.layer_windows  # per-layer windows (gpt-oss)
+            or cfg.model.attn_sinks  # sinks live in the paged XLA paths
         ):
             return False
         # bucket sizes are powers of two >= sp, so T % sp == 0 holds
@@ -1137,6 +1143,10 @@ class JaxEngine(AsyncEngine):
         # and the multi-host mirror (the verify is a broadcast op).
         if (
             cfg.spec_gamma > 0
+            # gpt-oss: the verify forward knows neither per-layer
+            # windows nor sinks — those models take plain decode windows
+            and not cfg.model.layer_windows
+            and not cfg.model.attn_sinks
             and n > 1
             and self._prefill_state is None
         ):

@@ -101,6 +101,16 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
             first_dense_layers=1, num_layers=3,
         )
         return cfg, None, ByteTokenizer(), args.model_name or "tiny-mla"
+    if args.model_path == "tiny-gptoss":
+        # gpt-oss-shaped smoke model: alternating sliding/full layers,
+        # attention sinks, biased clamped-SwiGLU MoE
+        cfg = ModelConfig.tiny(
+            num_layers=4, layer_windows=(16, 0, 16, 0), attn_sinks=True,
+            o_bias=True, attention_bias=True, num_experts=4,
+            num_experts_per_tok=2, moe_intermediate_size=32,
+            moe_act="gptoss_clamp",
+        )
+        return cfg, None, ByteTokenizer(), args.model_name or "tiny-gptoss"
     if args.model_path == "deepseek-8b-sim":
         # 8B-class dense-MLA architecture with DeepSeek-V3 head geometry
         # (kv_lora 512 + rope 64, q_lora 1536) and random weights: the

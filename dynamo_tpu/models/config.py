@@ -83,13 +83,30 @@ class ModelConfig:
     # fast half-split form — this flag records the CHECKPOINT convention
     rope_interleave: bool = False
     # sliding-window attention (mistral v0.1-style; 0 = full attention).
-    # Enforced by masking in the XLA paths and by a window floor in the
-    # in-repo Pallas kernels (exact for decode/merged at T=1 and for
-    # prefill rows; the jax library decode kernel has no window support
-    # and is skipped when a window is set). Speculative decoding stays
-    # gated off for windowed models: the verify kernel's uniform floor
-    # under-masks T>1 rows (ops/attention.py verify_attention).
+    # Enforced by masking in the XLA paths and by per-row window floors
+    # in the in-repo Pallas kernels (the jax library decode kernel has
+    # no window support and is skipped when a window is set).
+    # Speculative decoding composes (exact per-row floors via the
+    # kernel's ``group`` row mapping).
     sliding_window: int = 0
+    # gpt-oss: layers ALTERNATE sliding/full attention. When set, entry
+    # l is layer l's window (0 = full) and the GLOBAL sliding_window is
+    # forced to 0 — per-layer entries are the only source of widths, so
+    # homogeneous gates never window every layer. Such models run the
+    # unrolled layer paths (a lax.scan body cannot carry a per-layer
+    # static mask shape).
+    layer_windows: tuple = ()
+    # gpt-oss attention sinks: a learnable per-head logit joins every
+    # softmax's normalization (no value row) — attention mass can park
+    # on the sink instead of real tokens. Folded into the denominator
+    # in the XLA attention paths.
+    attn_sinks: bool = False
+    # gpt-oss expert FFN: fused clamped SwiGLU — gate clamped at +limit,
+    # up at +-limit, glu = gate*sigmoid(alpha*gate), out = (up+1)*glu —
+    # with per-expert biases on both projections
+    moe_act: str = "swiglu"  # "swiglu" | "gptoss_clamp"
+    # o_proj bias (gpt-oss: every attention projection carries bias)
+    o_bias: bool = False
     # gemma-family variants
     hidden_act: str = "silu"  # "silu" | "gelu_tanh" (gemma GeGLU)
     rms_add_unit: bool = False  # gemma RMSNorm scales by (1 + w)
@@ -98,6 +115,13 @@ class ModelConfig:
     dtype: str = "bfloat16"
 
     def __post_init__(self):
+        if self.layer_windows:
+            self.layer_windows = tuple(self.layer_windows)
+            if len(self.layer_windows) != self.num_layers:
+                raise ValueError(
+                    f"layer_windows has {len(self.layer_windows)} entries "
+                    f"for {self.num_layers} layers"
+                )
         if self.head_dim == 0:
             self.head_dim = self.hidden_size // self.num_heads
 
@@ -136,6 +160,15 @@ class ModelConfig:
         is_gemma = any(a.startswith("Gemma") for a in archs) or (
             cfg.get("model_type", "").startswith("gemma")
         )
+        is_gptoss = any(a.startswith("GptOss") for a in archs)
+        # gpt-oss layer_types: per-layer sliding/full alternation
+        layer_windows: tuple = ()
+        if is_gptoss and cfg.get("layer_types"):
+            sw = cfg.get("sliding_window") or 0
+            layer_windows = tuple(
+                sw if t == "sliding_attention" else 0
+                for t in cfg["layer_types"]
+            )
         act = cfg.get("hidden_act") or cfg.get("hidden_activation") or "silu"
         if act in ("gelu", "gelu_pytorch_tanh", "gelu_tanh"):
             act = "gelu_tanh"
@@ -155,6 +188,10 @@ class ModelConfig:
             attention_bias=qkv_bias,
             # qwen3 (dense and MoE): per-head q/k RMS norm, no qkv bias
             qk_norm=any(a.startswith("Qwen3") for a in archs),
+            layer_windows=layer_windows,
+            attn_sinks=is_gptoss,
+            moe_act="gptoss_clamp" if is_gptoss else "swiglu",
+            o_bias=is_gptoss and bool(cfg.get("attention_bias")),
             # mixtral: num_local_experts; deepseek: n_routed_experts;
             # qwen3moe: num_experts — the bare key is honored ONLY for
             # Qwen3 archs, because qwen2_moe also carries it and its
@@ -196,7 +233,11 @@ class ModelConfig:
                 cfg.get("model_type", "").startswith("deepseek")
                 and bool(cfg.get("kv_lora_rank")),
             ),
-            sliding_window=cfg.get("sliding_window") or 0,
+            # with per-layer windows the GLOBAL width stays 0 — the
+            # homogeneous paths/gates must not window every layer
+            sliding_window=(
+                0 if layer_windows else (cfg.get("sliding_window") or 0)
+            ),
             hidden_act=act if act != "silu" else "silu",
             rms_add_unit=is_gemma,
             scale_embed=is_gemma,

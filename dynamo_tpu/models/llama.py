@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import attention as att
-from .config import ModelConfig
+from .config import ModelConfig, yarn_mscale
 
 
 def _dtype(cfg: ModelConfig):
@@ -89,6 +89,10 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
         if cfg.qk_norm:
             layers["q_norm"] = jnp.ones((L, D), dt)
             layers["k_norm"] = jnp.ones((L, D), dt)
+        if cfg.attn_sinks:
+            layers["sinks"] = layer_stack(keys[10], (H,), 0.5)
+        if cfg.o_bias:
+            layers["bo"] = jnp.zeros((L, E), dt)
     if moe:
         X = cfg.num_experts
         Fm = cfg.moe_intermediate_size or F
@@ -99,6 +103,11 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
         layers["we_gate"] = layer_stack(mk[1], (X, E, Fm))
         layers["we_up"] = layer_stack(mk[2], (X, E, Fm))
         layers["we_down"] = layer_stack(mk[3], (X, Fm, E))
+        if cfg.moe_act == "gptoss_clamp":  # gpt-oss expert/router biases
+            layers["moe_router_bias"] = jnp.zeros((L, X), jnp.float32)
+            layers["be_gate"] = layer_stack(keys[8], (X, Fm), 0.05)
+            layers["be_up"] = layer_stack(keys[9], (X, Fm), 0.05)
+            layers["be_down"] = layer_stack(keys[11], (X, E), 0.05)
         if cfg.num_shared_experts:
             Fs = Fm * cfg.num_shared_experts
             layers["shared_gate"] = layer_stack(mk[4], (E, Fs))
@@ -196,10 +205,67 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
+def window_for_layer(cfg: ModelConfig, l: int) -> int:
+    """Layer l's sliding-window width (0 = full). gpt-oss alternates
+    sliding/full per layer (cfg.layer_windows); every other family is
+    homogeneous (cfg.sliding_window). Call sites must be UNROLLED —
+    the value is trace-static per layer."""
+    return cfg.layer_windows[l] if cfg.layer_windows else cfg.sliding_window
+
+
+def _rope_attention_scaling(cfg: ModelConfig) -> float:
+    """YaRN multiplies cos/sin by an attention factor (transformers
+    _compute_yarn_parameters); 1.0 for every other rope flavor."""
+    import math
+
+    scaling = cfg.rope_scaling or {}
+    if (scaling.get("rope_type") or scaling.get("type")) != "yarn":
+        return 1.0
+    factor = scaling.get("factor", 1.0)
+    af = scaling.get("attention_factor")
+    if af is not None:
+        return float(af)
+    msc, mad = scaling.get("mscale"), scaling.get("mscale_all_dim")
+    if msc and mad:
+        return float(yarn_mscale(factor, msc) / yarn_mscale(factor, mad))
+    if factor <= 1.0:
+        return 1.0
+    return 0.1 * math.log(factor) + 1.0
+
+
 def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
+    import math
+
     D = cfg.head_dim
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
     scaling = cfg.rope_scaling or {}
+    if (scaling.get("rope_type") or scaling.get("type")) == "yarn":
+        # YaRN (transformers _compute_yarn_parameters): interpolate the
+        # low-frequency dims by ``factor``, extrapolate the high ones,
+        # linear ramp across the correction range (gpt-oss ships
+        # truncate=False, so the range bounds stay fractional)
+        factor = scaling.get("factor", 1.0)
+        beta_fast = scaling.get("beta_fast") or 32
+        beta_slow = scaling.get("beta_slow") or 1
+        orig = (scaling.get("original_max_position_embeddings")
+                or cfg.max_position_embeddings)
+
+        def corr_dim(n_rot):
+            return (D * math.log(orig / (n_rot * 2 * math.pi))) / (
+                2 * math.log(cfg.rope_theta)
+            )
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, D - 1)
+        ramp = jnp.clip(
+            (jnp.arange(D // 2, dtype=jnp.float32) - low)
+            / max(high - low, 0.001),
+            0.0, 1.0,
+        )
+        extrap = 1.0 - ramp
+        return (inv / factor) * (1 - extrap) + inv * extrap
     if scaling.get("rope_type") == "llama3" or scaling.get("type") == "llama3":
         # llama-3.1 NTK-by-parts frequency remap
         factor = scaling.get("factor", 8.0)
@@ -216,11 +282,13 @@ def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
     return inv
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
-    """x: [..., T, Hx, D] rotated at absolute positions [..., T]."""
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray,
+               mscale: float = 1.0) -> jnp.ndarray:
+    """x: [..., T, Hx, D] rotated at absolute positions [..., T];
+    ``mscale`` is YaRN's cos/sin attention factor (1.0 elsewhere)."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
-    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
-    sin = jnp.sin(angles)[..., None, :]
+    cos = jnp.cos(angles)[..., None, :] * mscale  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :] * mscale
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
@@ -244,6 +312,13 @@ def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     if isinstance(w, dict):
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
+
+
+def _mm_b(x: jnp.ndarray, lp: dict, w_key: str, b_key: str) -> jnp.ndarray:
+    """_mm plus an optional bias leaf (gpt-oss: o_proj carries one)."""
+    out = _mm(x, lp[w_key])
+    b = lp.get(b_key)
+    return out if b is None else out + b
 
 
 def swiglu(x, w_gate, w_up, w_down, act: str = "silu"):
@@ -273,8 +348,9 @@ def _moe_route(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     order = jnp.argsort(e_flat)  # stable: deterministic within an expert
     t_sorted = order // k
     w_sorted = vals.reshape(-1)[order]
+    e_sorted = e_flat[order]  # expert id per sorted row (expert biases)
     group_sizes = jnp.bincount(e_flat, length=cfg.num_experts)
-    return t_sorted, w_sorted, group_sizes
+    return t_sorted, w_sorted, e_sorted, group_sizes
 
 
 def _route_topk(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
@@ -284,6 +360,11 @@ def _route_topk(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     k = cfg.num_experts_per_tok
     X = cfg.num_experts
     gate_logits = x.astype(jnp.float32) @ lp["moe_gate"].astype(jnp.float32)
+    if lp.get("moe_router_bias") is not None:
+        # gpt-oss: a LOGIT bias (pre-softmax, affects selection AND
+        # combine) — unlike V3's moe_gate_bias, which biases selection
+        # on post-score values only
+        gate_logits = gate_logits + lp["moe_router_bias"].astype(jnp.float32)
     if cfg.moe_scoring == "sigmoid":
         scores = jax.nn.sigmoid(gate_logits)
     else:
@@ -313,6 +394,17 @@ def _route_topk(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     if cfg.norm_topk_prob:
         vals = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-20)
     return vals * cfg.routed_scaling_factor, idx
+
+
+def _expert_act(cfg: ModelConfig, g: jnp.ndarray, u: jnp.ndarray):
+    """Expert gating nonlinearity. gpt-oss clamps both streams and uses
+    an alpha-sigmoid GLU with a +1 on the linear stream:
+    glu = min(g, 7) * sigmoid(1.702 * min(g, 7)); out = (clip(u) + 1) * glu."""
+    if cfg.moe_act == "gptoss_clamp":
+        g = jnp.clip(g, None, 7.0)
+        u = jnp.clip(u, -7.0, 7.0)
+        return (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+    return jax.nn.silu(g) * u
 
 
 def _moe_combine(o, t_sorted, w_sorted, T: int, dtype):
@@ -355,12 +447,20 @@ def moe_ffn(
     T = x.shape[0]
     out_dt = x.dtype
     if mesh is None:
-        t_sorted, w_sorted, group_sizes = _moe_route(lp, cfg, x)
+        t_sorted, w_sorted, e_sorted, group_sizes = _moe_route(lp, cfg, x)
         g = lax.ragged_dot(x[t_sorted], lp["we_gate"], group_sizes)
         u = lax.ragged_dot(x[t_sorted], lp["we_up"], group_sizes)
-        o = lax.ragged_dot(jax.nn.silu(g) * u, lp["we_down"], group_sizes)
+        if "be_gate" in lp:  # gpt-oss per-expert projection biases
+            g = g + lp["be_gate"][e_sorted]
+            u = u + lp["be_up"][e_sorted]
+        o = lax.ragged_dot(_expert_act(cfg, g, u), lp["we_down"], group_sizes)
+        if "be_down" in lp:
+            o = o + lp["be_down"][e_sorted]
         out = _moe_combine(o, t_sorted, w_sorted, T, out_dt)
-    elif _moe_can_shard(mesh, cfg):
+    elif _moe_can_shard(mesh, cfg) and "be_gate" not in lp:
+        # per-expert biases (gpt-oss) take the dense fallback on meshes:
+        # the shard_map body would need ep-local bias gathers; dense
+        # dispatch is GSPMD-shardable and exact
         out = _moe_ragged_sharded(lp, cfg, x, mesh)
     else:
         out = _moe_dense_dispatch(lp, cfg, x)
@@ -383,7 +483,12 @@ def _moe_dense_dispatch(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarr
     )  # [T, X] routing weights
     g = jnp.einsum("te,xef->txf", x, lp["we_gate"])
     u = jnp.einsum("te,xef->txf", x, lp["we_up"])
-    y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, lp["we_down"])
+    if "be_gate" in lp:  # gpt-oss per-expert projection biases
+        g = g + lp["be_gate"][None]
+        u = u + lp["be_up"][None]
+    y = jnp.einsum("txf,xfe->txe", _expert_act(cfg, g, u), lp["we_down"])
+    if "be_down" in lp:
+        y = y + lp["be_down"][None]
     return jnp.einsum("txe,tx->te", y, w.astype(x.dtype))
 
 
@@ -416,7 +521,7 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
     out_dt = x.dtype
 
     def body(x, moe_gate, gate_bias, we_gate, we_up, we_down):
-        t_sorted, w_sorted, group_sizes = _moe_route(
+        t_sorted, w_sorted, _e_sorted, group_sizes = _moe_route(
             {"moe_gate": moe_gate, "moe_gate_bias": gate_bias}, cfg, x
         )
         first = lax.axis_index("ep") * Xl
@@ -549,7 +654,8 @@ def prefill(
             )
     if use_ring:
         assert mesh is not None and mesh.shape.get("sp", 1) > 1
-        assert cfg.sliding_window == 0
+        assert cfg.sliding_window == 0 and not cfg.layer_windows
+        assert not cfg.attn_sinks
     T = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [T, E]
     positions = history_len + jnp.arange(T)
@@ -560,9 +666,10 @@ def prefill(
         scale = cfg.mla_softmax_scale()
     else:
         inv_freq = _rope_freqs(cfg)
+        rope_msc = _rope_attention_scaling(cfg)
         scale = cfg.head_dim**-0.5
 
-    def body(carry, layer_in):
+    def body(carry, layer_in, window=cfg.sliding_window):
         x = carry
         lp, kc, vc = layer_in
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -611,8 +718,8 @@ def prefill(
             x = x + _mm(o, lp["wo"])
         else:
             q, k, v = _qkv(lp, cfg, h)
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            q = apply_rope(q, positions, inv_freq, rope_msc)
+            k = apply_rope(k, positions, inv_freq, rope_msc)
             kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
             vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
             if use_ring:
@@ -628,14 +735,31 @@ def prefill(
                 o = att.chunk_attention_with_cache(
                     q, k, v, kc, vc, block_table, history_len, valid_len,
                     scale, use_pallas=use_pallas, mesh=mesh,
-                    window=cfg.sliding_window,
+                    window=window, sinks=lp.get("sinks"),
                 )
-            x = x + _mm(o.reshape(T, -1), lp["wo"])
+            x = x + _mm_b(o.reshape(T, -1), lp, "wo", "bo")
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h, mesh=mesh)
         return x, (kc, vc)
 
-    x, k_cache, v_cache = _scan_groups(body, x, params, cfg, k_cache, v_cache)
+    if cfg.layer_windows:
+        # heterogeneous attention (gpt-oss alternating sliding/full):
+        # the window width is trace-static PER LAYER, so the layer loop
+        # unrolls — a lax.scan body cannot carry a per-layer mask shape
+        for lps, n, off in layer_groups(params, cfg):
+            for li in range(n):
+                l = off + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                x, (kc_l, vc_l) = body(
+                    x, (lp, k_cache[l], v_cache[l]),
+                    window=window_for_layer(cfg, l),
+                )
+                k_cache = k_cache.at[l].set(kc_l)
+                v_cache = v_cache.at[l].set(vc_l)
+    else:
+        x, k_cache, v_cache = _scan_groups(
+            body, x, params, cfg, k_cache, v_cache
+        )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     # logits for the last *real* token of the chunk
     last = jnp.clip(valid_len - 1, 0, T - 1)
@@ -672,18 +796,19 @@ def _decode_body(
         scale = cfg.mla_softmax_scale()
     else:
         inv_freq = _rope_freqs(cfg)
+        rope_msc = _rope_attention_scaling(cfg)
         scale = cfg.head_dim**-0.5
 
     def layer_tail(x, lp, o):
-        x = x + _mm(o.reshape(B, -1), lp["wo"])
+        x = x + _mm_b(o.reshape(B, -1), lp, "wo", "bo")
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         return x + _ffn(lp, cfg, h, mesh=mesh)
 
     def layer_qkv(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, rope_msc)
+        k = apply_rope(k, positions, inv_freq, rope_msc)
         return q, k, v
 
     def mla_layer(x, lp, kc_l, vc_l):
@@ -721,7 +846,12 @@ def _decode_body(
         block_tables, positions, k_cache.shape[3]
     )
     mla_merged = merged and unroll and use_pallas and cfg.is_mla
-    merged = merged and unroll and use_pallas and not cfg.is_mla
+    # sinks / per-layer windows live in the XLA paths only — the merged
+    # path's kernels know neither, so those models stay write-then-attend
+    merged = (
+        merged and unroll and use_pallas and not cfg.is_mla
+        and not cfg.attn_sinks and not cfg.layer_windows
+    )
     if mla_merged:
         # MERGED one-write path, MLA flavor: the latent kernel scores
         # history with stats, the current token's (c_kv, k_pe) folds in
@@ -856,10 +986,17 @@ def _decode_body(
                 o = att.decode_attention(
                     q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
                     use_pallas=use_pallas, mesh=mesh,
-                    window=cfg.sliding_window,
+                    window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
                 )
                 x = layer_tail(x, lp, o)
     else:
+        if cfg.layer_windows:
+            raise ValueError(
+                "decode_layer_scan cannot serve per-layer-window models "
+                "(the scan body would need a per-layer static mask "
+                "shape) — use the default unrolled decode"
+            )
+
         def body(carry, layer_in):
             x = carry
             lp, kc, vc = layer_in
@@ -869,6 +1006,7 @@ def _decode_body(
             o = att.decode_attention(
                 q, kc, vc, block_tables, seq_lens, scale,
                 use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
+                sinks=lp.get("sinks"),
             )
             x = layer_tail(x, lp, o)
             return x, (kc, vc)
@@ -1271,9 +1409,10 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         scale = cfg.mla_softmax_scale()
     else:
         inv_freq = _rope_freqs(cfg)
+        rope_msc = _rope_attention_scaling(cfg)
         scale = cfg.head_dim**-0.5
 
-    def body(x, lp):
+    def body(x, lp, window=cfg.sliding_window):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         if cfg.is_mla:
             # DELIBERATELY independent of mla.mla_q_and_latent: this is
@@ -1324,18 +1463,24 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
             x = x + _mm(o, lp["wo"])
         else:
             q, k, v = _qkv(lp, cfg, h)
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            q = apply_rope(q, positions, inv_freq, rope_msc)
+            k = apply_rope(k, positions, inv_freq, rope_msc)
             o = att.prefill_attention_xla(
                 q, k, v, positions, jnp.int32(T), scale,
-                window=cfg.sliding_window,
+                window=window, sinks=lp.get("sinks"),
             )
-            x = x + _mm(o.reshape(T, -1), lp["wo"])
+            x = x + _mm_b(o.reshape(T, -1), lp, "wo", "bo")
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(lp, cfg, h)
         return x, None
 
-    for lps, _n, _off in layer_groups(params, cfg):
-        x, _ = lax.scan(body, x, lps)
+    if cfg.layer_windows:  # per-layer static windows: unrolled
+        for lps, n, off in layer_groups(params, cfg):
+            for li in range(n):
+                lp = jax.tree.map(lambda a: a[li], lps)
+                x, _ = body(x, lp, window=window_for_layer(cfg, off + li))
+    else:
+        for lps, _n, _off in layer_groups(params, cfg):
+            x, _ = lax.scan(body, x, lps)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _logits(params, cfg, x)

@@ -164,6 +164,13 @@ def load_llama_params(
                     "model.layers.{i}.self_attn.k_norm.weight", rng,
                     transpose=False,
                 )
+            if cfg.o_bias:
+                out["bo"] = stack("model.layers.{i}.self_attn.o_proj.bias",
+                                  rng, transpose=False)
+            if cfg.attn_sinks:
+                out["sinks"] = stack(
+                    "model.layers.{i}.self_attn.sinks", rng, transpose=False
+                ).astype(np.float32)
         return out
 
     def dense_ffn_leaves(rng) -> dict:
@@ -173,7 +180,40 @@ def load_llama_params(
             "w_down": stack("model.layers.{i}.mlp.down_proj.weight", rng),
         }
 
+    def gptoss_moe_leaves(rng) -> dict:
+        """gpt-oss expert tensors are FUSED per layer (not per expert):
+        gate_up_proj [X, E, 2F] with gate/up INTERLEAVED on the last
+        axis (gate = [..., ::2], up = [..., 1::2]) plus bias [X, 2F];
+        down_proj [X, F, E] (+bias [X, E]) is already in our we_down
+        orientation; the router is mlp.router with a LOGIT bias."""
+        gu = np.stack(
+            [get(f"model.layers.{i}.mlp.experts.gate_up_proj") for i in rng]
+        )  # [L, X, E, 2F]
+        gub = np.stack(
+            [get(f"model.layers.{i}.mlp.experts.gate_up_proj_bias")
+             for i in rng]
+        )  # [L, X, 2F]
+        return {
+            "moe_gate": stack("model.layers.{i}.mlp.router.weight", rng),
+            "moe_router_bias": stack(
+                "model.layers.{i}.mlp.router.bias", rng, transpose=False
+            ).astype(np.float32),
+            "we_gate": gu[..., ::2],
+            "we_up": gu[..., 1::2],
+            "be_gate": gub[..., ::2],
+            "be_up": gub[..., 1::2],
+            "we_down": np.stack(
+                [get(f"model.layers.{i}.mlp.experts.down_proj") for i in rng]
+            ),
+            "be_down": np.stack(
+                [get(f"model.layers.{i}.mlp.experts.down_proj_bias")
+                 for i in rng]
+            ),
+        }
+
     def moe_ffn_leaves(rng) -> dict:
+        if cfg.moe_act == "gptoss_clamp":
+            return gptoss_moe_leaves(rng)
         X = cfg.num_experts
 
         def stack_experts(mix_fmt: str, ds_fmt: str) -> np.ndarray:

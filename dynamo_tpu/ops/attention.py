@@ -49,6 +49,7 @@ def decode_attention(
     use_pallas: bool = False,
     mesh=None,
     window: int = 0,
+    sinks=None,  # [H] gpt-oss sink logits; forces the XLA path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
@@ -64,19 +65,19 @@ def decode_attention(
     guarantee num_kv_heads % tp == 0 (the engine falls back to XLA
     otherwise, where GSPMD handles uneven head splits).
     """
-    if use_pallas and mesh is not None:
+    if use_pallas and sinks is None and mesh is not None:
         return paged_decode_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             mesh, window=window, interpret=interpret,
         )
-    if use_pallas:
+    if use_pallas and sinks is None:
         return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             window=window, interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-        window=window,
+        window=window, sinks=sinks,
     )
 
 
@@ -408,6 +409,25 @@ def _history_attention_xla(
     return o, m, l
 
 
+def _sink_softmax(scores, mask, sinks, Hkv, G):
+    """Masked softmax whose normalization includes an optional per-head
+    SINK logit (gpt-oss): the sink joins the denominator but contributes
+    no value row, so attention mass can park off the real tokens.
+    scores: [B, Hkv, G, S] f32; mask: [B, S]; sinks: [H] or None.
+    Returns probs [B, Hkv, G, S] (rows sum to < 1 when a sink is set)."""
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [B, Hkv, G, 1]
+    if sinks is not None:
+        s = sinks.astype(jnp.float32).reshape(1, Hkv, G, 1)
+        m = jnp.maximum(m, s)
+    p = jnp.exp(scores - m)
+    p = jnp.where(mask[:, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)  # noqa: E741
+    if sinks is not None:
+        l = l + jnp.exp(s - m)  # noqa: E741
+    return p / jnp.maximum(l, 1e-30)
+
+
 def decode_attention_xla(
     q: jnp.ndarray,  # [B, H, D] one new token per sequence
     k_cache_layer: jnp.ndarray,  # [Hkv, num_blocks, block_size, D]
@@ -416,6 +436,7 @@ def decode_attention_xla(
     seq_lens: jnp.ndarray,  # [B] int32 (includes the new token)
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
+    sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     M = block_tables.shape[1]
@@ -435,10 +456,25 @@ def decode_attention_xla(
     mask = positions < seq_lens[:, None]  # [B, T]
     if window > 0:  # q position is seq_len-1; keep kv in (q-W, q]
         mask &= positions >= (seq_lens[:, None] - window)
-    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    probs = _sink_softmax(scores, mask, sinks, Hkv, G).astype(v.dtype)
     out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
     return out.reshape(B, H, D)
+
+
+def _sink_softmax_rows(scores, mask, sinks):
+    """Row-wise variant of _sink_softmax for prefill layouts: scores
+    [H, T, S] f32 with mask [T, S] (or [1, T, S]); sinks [H] or None."""
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [H, T, 1]
+    if sinks is not None:
+        s = sinks.astype(jnp.float32).reshape(-1, 1, 1)
+        m = jnp.maximum(m, s)
+    p = jnp.exp(scores - m)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)  # noqa: E741
+    if sinks is not None:
+        l = l + jnp.exp(s - m)  # noqa: E741
+    return p / jnp.maximum(l, 1e-30)
 
 
 def prefill_attention_xla(
@@ -449,6 +485,7 @@ def prefill_attention_xla(
     valid_len: jnp.ndarray,  # scalar: number of real (unpadded) tokens
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
+    sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
 ) -> jnp.ndarray:  # [T, H, D]
     """Causal self-attention within one (padded) prompt chunk."""
     T, H, D = q.shape
@@ -461,8 +498,7 @@ def prefill_attention_xla(
         causal &= (q_positions[:, None] - q_positions[None, :]) < window
     valid = jnp.arange(T)[None, :] < valid_len  # [1, T]
     mask = causal & valid
-    scores = jnp.where(mask[None, :, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    probs = _sink_softmax_rows(scores, mask[None], sinks).astype(v.dtype)
     return jnp.einsum("hts,shd->thd", probs, v)
 
 
@@ -479,6 +515,7 @@ def chunk_attention_with_cache(
     use_pallas: bool = False,
     mesh=None,
     window: int = 0,
+    sinks=None,  # [H] gpt-oss sink logits; forces the XLA path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
@@ -493,12 +530,12 @@ def chunk_attention_with_cache(
     chunk from the args. Both agree on all real rows (t < valid_len);
     padded tail rows differ but are discarded by every caller.
     """
-    if use_pallas and mesh is not None:
+    if use_pallas and sinks is None and mesh is not None:
         return paged_prefill_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
             mesh, window=window, interpret=interpret,
         )
-    if use_pallas:
+    if use_pallas and sinks is None:
         from .paged_attention_pallas import paged_prefill_attention
 
         return paged_prefill_attention(
@@ -507,7 +544,7 @@ def chunk_attention_with_cache(
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
-        history_len, valid_len, scale, window=window,
+        history_len, valid_len, scale, window=window, sinks=sinks,
     )
 
 
@@ -545,6 +582,7 @@ def chunk_attention_with_cache_xla(
     valid_len: jnp.ndarray,  # scalar: real tokens in this chunk
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
+    sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
 ) -> jnp.ndarray:
     """Chunked-prefill attention: queries attend to cached history plus the
     causal prefix of the current chunk (enables chunked prefill and
@@ -574,9 +612,10 @@ def chunk_attention_with_cache_xla(
     causal = q_pos[:, None] >= kv_pos[None, :]
     if window > 0:
         causal &= (q_pos[:, None] - kv_pos[None, :]) < window
-    mask = causal & kv_valid[None, :]
-    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v_all.dtype)
+    mask = causal & kv_valid[None, :]  # [T, S]
+    # _sink_softmax's leading axis is batch-like — the chunk layout's T
+    # rows broadcast identically ([T, 1, 1, S] mask vs [T, Hkv, G, S])
+    probs = _sink_softmax(scores, mask, sinks, Hkv, G).astype(v_all.dtype)
     out = jnp.einsum("tkgs,ksd->tkgd", probs, v_all)
     return out.reshape(T, H, D)
 

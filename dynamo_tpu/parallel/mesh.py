@@ -85,6 +85,14 @@ _PARAM_SPECS = {
     # qwen3 per-head q/k norms [L, head_dim] (q_norm shares the MLA
     # entry below — same rank-2 layer-stacked shape, same placement)
     "layers.k_norm": P("pp", None),
+    # gpt-oss: per-head attention sinks, o-projection bias, router logit
+    # bias, per-expert projection biases (expert axis over ep)
+    "layers.sinks": P("pp", None),
+    "layers.bo": P("pp", None),
+    "layers.moe_router_bias": P("pp", None),
+    "layers.be_gate": P("pp", "ep", None),
+    "layers.be_up": P("pp", "ep", None),
+    "layers.be_down": P("pp", "ep", None),
     "layers.w_gate": P("pp", None, "tp"),  # column: hidden
     "layers.w_up": P("pp", None, "tp"),
     "layers.w_down": P("pp", "tp", None),  # row

@@ -69,6 +69,10 @@ def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
         pp > 1
         and not cfg.is_moe
         and not cfg.is_mla  # MLA runs the absorbed-latent scan path
+        # per-layer windows (gpt-oss) need an unrolled layer loop; the
+        # pipeline's scanned stage body is homogeneous and sink-less
+        and not cfg.layer_windows
+        and not cfg.attn_sinks
         and cfg.num_layers % pp == 0
         and n_micro >= 1
         and T % n_micro == 0

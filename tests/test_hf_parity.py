@@ -118,6 +118,42 @@ def test_qwen3_moe_parity(tmp_path):
     _compare(path, TOKENS, model)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "GptOssConfig"),
+    reason="transformers too old for GPT-OSS",
+)
+def test_gptoss_parity(tmp_path):
+    """gpt-oss: alternating sliding/full layers, per-head attention
+    sinks, biased router with topk-then-softmax, fused clamped-SwiGLU
+    experts with biases, biased attention projections, YaRN rope with
+    truncate=False."""
+    hf_cfg = transformers.GptOssConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=8, max_position_embeddings=128,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "truncate": False,
+            "original_max_position_embeddings": 32,
+        },
+    )
+    model = transformers.GptOssForCausalLM(hf_cfg)
+    with torch.no_grad():  # randomize empty-init sink/bias params
+        for name, p in model.named_parameters():
+            if "sinks" in name or "bias" in name:
+                p.normal_(0.0, 0.5)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.attn_sinks and cfg.moe_act == "gptoss_clamp"
+    assert cfg.layer_windows == (8, 0, 8, 0) and cfg.sliding_window == 0
+    assert cfg.o_bias and cfg.attention_bias
+    # prompt longer than the window so sliding layers actually mask
+    toks = [(7 * i + 3) % 256 for i in range(24)]
+    _compare(path, toks, model, atol=5e-4)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
@@ -212,6 +248,54 @@ def test_deepseek_v3_mla_parity(tmp_path):
     cfg = ModelConfig.from_local_path(path)
     assert cfg.is_mla and cfg.moe_scoring == "sigmoid" and cfg.moe_gate_bias
     _compare(path, TOKENS, model)
+
+
+def test_gptoss_paged_engine_matches_dense():
+    """The paged serving path (chunked prefill + decode with per-layer
+    windows and sinks) must reproduce the dense gpt-oss-shaped forward
+    token-for-token through the engine — with chunks crossing window
+    boundaries."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    cfg = ModelConfig.tiny(
+        num_layers=4, layer_windows=(6, 0, 6, 0),  # global width stays 0
+        attn_sinks=True, o_bias=True, attention_bias=True,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        moe_act="gptoss_clamp", dtype="float32",
+    )
+    params = llama.init_params(cfg, __import__("jax").random.key(2))
+    prompt = [(11 * i + 5) % cfg.vocab_size for i in range(18)]
+    cur = list(prompt)
+    for _ in range(6):
+        lg = llama.dense_forward(params, cfg, jnp.asarray(cur))
+        cur.append(int(np.argmax(np.asarray(lg[-1]))))
+    want = cur[len(prompt):]
+
+    import asyncio
+
+    async def main():
+        engine = JaxEngine(
+            EngineConfig(model=cfg, num_blocks=32, block_size=4,
+                         max_batch_size=2, max_context=64, prefill_chunk=8),
+            params=params,
+        )
+        out = await collect(engine.generate(Context(PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        toks = [t for o in out for t in o.token_ids]
+        assert toks == want, (toks, want)
+        await engine.close()
+
+    asyncio.run(main())
 
 
 def test_mla_paged_engine_matches_dense(tmp_path):
