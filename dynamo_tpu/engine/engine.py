@@ -1140,13 +1140,11 @@ class JaxEngine(AsyncEngine):
         # sliding-window models (the verify kernel computes exact
         # per-row window floors via its ``group`` row mapping), MLA
         # models (multi-token absorbed attention, write-before-attend),
-        # and the multi-host mirror (the verify is a broadcast op).
+        # gpt-oss models (per-layer windows and sinks thread through
+        # the unrolled XLA verify), and the multi-host mirror (the
+        # verify is a broadcast op). NO model family is gated off.
         if (
             cfg.spec_gamma > 0
-            # gpt-oss: the verify forward knows neither per-layer
-            # windows nor sinks — those models take plain decode windows
-            and not cfg.model.layer_windows
-            and not cfg.model.attn_sinks
             and n > 1
             and self._prefill_state is None
         ):

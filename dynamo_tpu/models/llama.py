@@ -1221,6 +1221,7 @@ def _verify_forward(
         return logits, k_cache, v_cache
 
     inv_freq = _rope_freqs(cfg)
+    rope_msc = _rope_attention_scaling(cfg)
     scale = cfg.head_dim**-0.5
 
     k_news, v_news = [], []
@@ -1230,23 +1231,34 @@ def _verify_forward(
             lp = jax.tree.map(lambda a: a[li], lps)
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
-            q = apply_rope(q, pos_bt, inv_freq)
-            k = apply_rope(k, pos_bt, inv_freq)
+            q = apply_rope(q, pos_bt, inv_freq, rope_msc)
+            k = apply_rope(k, pos_bt, inv_freq, rope_msc)
             k_news.append(k)
             v_news.append(v)
             if use_pallas and mesh is not None:
+                # the sharded kernel path knows neither sinks nor
+                # per-layer windows — fail loud rather than attend wrong
+                assert not cfg.attn_sinks and not cfg.layer_windows, (
+                    "sharded pallas verify cannot serve sink/per-layer-"
+                    "window models (the engine gates use_pallas off)"
+                )
                 o = att.verify_attention_sharded(
                     q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
                     scale, mesh, use_pallas=True, window=cfg.sliding_window,
                     interpret=interpret,
                 )
             else:
+                # the layer loop is unrolled, so per-layer windows and
+                # sinks (gpt-oss) thread straight through the XLA verify
                 o = att.verify_attention(
                     q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                    scale, use_pallas=use_pallas, window=cfg.sliding_window,
+                    scale, use_pallas=use_pallas,
+                    window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
                     interpret=interpret,
                 )
-            x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
+            x = x + _mm_b(
+                o.reshape(B * T, -1), lp, "wo", "bo"
+            ).reshape(B, T, E)
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
                 B, T, E

@@ -259,6 +259,7 @@ def verify_attention(
     scale: float,
     use_pallas: bool = False,
     window: int = 0,
+    sinks=None,  # [H] gpt-oss sink logits; joins the merge denominator
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, T, H, D]
     """Multi-token decode attention (speculative-decoding verify): T
@@ -312,12 +313,17 @@ def verify_attention(
     s_w = jnp.where(causal[None, None, :, None, :], s_w, NEG_INF)
     m_w = jnp.max(s_w, axis=-1)  # [B, Hkv, T, G]
     m_f = jnp.maximum(m_h, m_w)
+    if sinks is not None:  # gpt-oss: the sink joins the normalization
+        s_k = sinks.astype(jnp.float32).reshape(1, Hkv, 1, G)
+        m_f = jnp.maximum(m_f, s_k)
     alpha = jnp.exp(m_h - m_f)
     p_w = jnp.exp(s_w - m_f[..., None])  # [B, Hkv, T, G, T']
     o_w = jnp.einsum("bktgu,bukd->bktgd", p_w, v_win.astype(jnp.float32))
     l_w = jnp.sum(p_w, axis=-1)
     num = (l_h * alpha)[..., None] * o_h + o_w
     den = l_h * alpha + l_w
+    if sinks is not None:
+        den = den + jnp.exp(s_k - m_f)
     out = num / den[..., None]  # den >= 1 term from the diagonal (u == t)
     return (
         out.transpose(0, 2, 1, 3, 4).reshape(B, T, H, D).astype(q.dtype)

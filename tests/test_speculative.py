@@ -134,13 +134,55 @@ def test_verify_attention_windowed_exact_per_row():
             )
 
 
-@pytest.mark.parametrize("family", ["dense", "mla"])
+def test_verify_attention_sinks_match_write_then_decode():
+    """With gpt-oss sink logits, the out-of-cache verify's flash merge
+    must fold the sink into the combined denominator exactly — equal to
+    writing the window rows then running sink decode per position."""
+    B, T, H, Hkv, D, M = 2, 3, 8, 4, 128, 4
+    N = B * M + 1
+    ks = jax.random.split(jax.random.key(4), 6)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (Hkv, N, BS, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (Hkv, N, BS, D), jnp.float32)
+    k_win = jax.random.normal(ks[3], (B, T, Hkv, D), jnp.float32)
+    v_win = jax.random.normal(ks[4], (B, T, Hkv, D), jnp.float32)
+    sinks = jax.random.normal(ks[5], (H,), jnp.float32) * 2.0
+    tables = jnp.asarray(np.arange(1, N, dtype=np.int32).reshape(B, M))
+    hist = jnp.asarray([3, BS + 1], jnp.int32)
+    scale = D**-0.5
+
+    got = verify_attention(
+        q, k_win, v_win, kc, vc, tables, hist, scale, sinks=sinks,
+    )
+    kc1, vc1 = kc, vc
+    for b in range(B):
+        for t in range(T):
+            pos = int(hist[b]) + t
+            blk, off = int(tables[b, pos // BS]), pos % BS
+            kc1 = kc1.at[:, blk, off].set(k_win[b, t])
+            vc1 = vc1.at[:, blk, off].set(v_win[b, t])
+    for t in range(T):
+        ref_t = decode_attention_xla(
+            q[:, t], kc1, vc1, tables, hist + t + 1, scale, sinks=sinks
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[:, t]), np.asarray(ref_t),
+            rtol=2e-5, atol=2e-5, err_msg=f"t={t}",
+        )
+
+
+@pytest.mark.parametrize("family", ["dense", "mla", "gptoss"])
 def test_verify_window_matches_forced_decode_steps(family):
     """llama.verify_window preds/cache must bit-match T chained
     decode_steps fed the same forced tokens — for the dense family AND
     the MLA family (absorbed multi-token verify, write-before-attend)."""
     if family == "mla":
         cfg = ModelConfig.tiny_mla(dtype="float32")
+    elif family == "gptoss":
+        cfg = ModelConfig.tiny(
+            dtype="float32", num_layers=4, layer_windows=(6, 0, 6, 0),
+            attn_sinks=True, o_bias=True, attention_bias=True,
+        )
     else:
         cfg = ModelConfig.tiny(dtype="float32")
     B, M, T = 2, 8, 4
@@ -585,6 +627,53 @@ def test_spec_engages_on_mla_models(run):
         for gamma in (0, 3):
             cfg = EngineConfig(
                 model=ModelConfig.tiny(**mla_model), num_blocks=64,
+                block_size=8, max_batch_size=2, decode_window=4,
+                spec_gamma=gamma,
+            )
+            engine = JaxEngine(cfg, seed=0)
+            if gamma:
+                _force_proposals(engine, streams[0], gamma)
+            out = await collect(engine.generate(Context(
+                PreprocessedRequest(
+                    token_ids=[7, 8, 9, 10] * 4,
+                    stop_conditions=StopConditions(max_tokens=12),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    eos_token_ids=[],
+                )
+            )))
+            streams[gamma] = [t for o in out for t in o.token_ids]
+            if gamma:
+                assert engine.stats["spec_accepted"] > 0, engine.stats
+            await engine.close()
+        assert streams[0] == streams[3], streams
+
+    run(main())
+
+
+def test_spec_engages_on_gptoss_models(run):
+    """gpt-oss spec: forced true-chain proposals must accept and
+    reproduce the plain greedy stream exactly — per-layer windows and
+    attention sinks ride the unrolled XLA verify."""
+    from dynamo_tpu.engine.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    model = dict(
+        dtype="float32", num_layers=4, layer_windows=(6, 0, 6, 0),
+        attn_sinks=True, o_bias=True, attention_bias=True,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        moe_act="gptoss_clamp",
+    )
+
+    async def main():
+        streams = {}
+        for gamma in (0, 3):
+            cfg = EngineConfig(
+                model=ModelConfig.tiny(**model), num_blocks=64,
                 block_size=8, max_batch_size=2, decode_window=4,
                 spec_gamma=gamma,
             )
