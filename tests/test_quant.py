@@ -141,6 +141,50 @@ def test_quantized_sharded_serving_matches_unsharded(run):
     run(main())
 
 
+def test_quantized_new_families_serve(run):
+    """int8 quantization on the round-3 zoo additions: qwen3 (qk norms
+    stay high-precision) and gpt-oss (sinks/biases/router stay
+    high-precision, clamped experts stay bf16) must stream full-length
+    output with a shared greedy PREFIX vs unquantized."""
+    families = {
+        "qwen3": ModelConfig.tiny(qk_norm=True),
+        "gptoss": ModelConfig.tiny(
+            num_layers=4, layer_windows=(6, 0, 6, 0), attn_sinks=True,
+            o_bias=True, attention_bias=True, num_experts=4,
+            num_experts_per_tok=2, moe_intermediate_size=32,
+            moe_act="gptoss_clamp",
+        ),
+    }
+
+    async def main():
+        for name, mcfg in families.items():
+            outs = {}
+            for quant in ("none", "int8"):
+                engine = JaxEngine(
+                    EngineConfig(model=mcfg, num_blocks=64, block_size=4,
+                                 max_batch_size=2, max_context=64,
+                                 prefill_chunk=16, quantization=quant),
+                    seed=0,
+                )
+                out = await collect(engine.generate(
+                    Context(make_req(range(10, 26), max_tokens=8))
+                ))
+                toks = [t for o in out for t in o.token_ids]
+                assert len(toks) == 8, (name, quant, toks)
+                outs[quant] = toks
+                await engine.close()
+            # shared greedy PREFIX (not coincidental later matches): a
+            # wrong dequant path diverges at token 1 and fails this
+            prefix = 0
+            for a, b in zip(outs["none"], outs["int8"]):
+                if a != b:
+                    break
+                prefix += 1
+            assert prefix >= 2, (name, outs)
+
+    run(main())
+
+
 def test_quantized_mla_serves(run):
     """int8-quantized MLA: the absorbed fold dequants the {"q","s"}
     wkv_b leaf (mla._wkv_b_parts) and the q/kv projections ride _mm's
