@@ -134,9 +134,11 @@ def _multihost_service_manifests(
     LeaderWorkerSet-style groups):
 
       * rank = pod index (the ``apps.kubernetes.io/pod-index`` label the
-        StatefulSet controller stamps), injected as DYN_NODE_RANK via
-        the downward API — dynamo_run reads it as its --node-rank
-        default;
+        StatefulSet controller stamps — k8s >= 1.28 only, PodIndexLabel
+        gate), injected as DYN_NODE_RANK via the downward API —
+        dynamo_run reads it as its --node-rank default and, when the
+        env resolves empty on an older cluster, falls back to the
+        hostname ordinal (StatefulSet pod names end in the same index);
       * a headless Service gives pod 0 a stable DNS name, which every
         rank gets as DYN_COORDINATOR (jax.distributed coordinator);
       * podManagementPolicy Parallel: SPMD ranks must start together —

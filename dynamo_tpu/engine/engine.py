@@ -279,7 +279,14 @@ class JaxEngine(AsyncEngine):
                     and cfg.model.kv_lora_rank % 128 == 0
                     and (
                         self.mesh is None
-                        or self.mesh.shape.get("pp", 1) == 1
+                        or (
+                            self.mesh.shape.get("pp", 1) == 1
+                            # the sharded latent kernels shard_map the
+                            # QUERY-head axis over tp (advisor r3): an
+                            # uneven split must fall back to XLA, not
+                            # crash at first decode
+                            and cfg.model.num_heads % tp == 0
+                        )
                     )
                 )
             )
@@ -773,25 +780,29 @@ class JaxEngine(AsyncEngine):
         toks = np.zeros(T, np.int32)
         toks[: len(chunk)] = chunk
         if self.mirror is not None:
-            logits, self.k_cache, self.v_cache = self.mirror.lead_prefill(
-                self.params, toks, self._table_for(seq), pos, len(chunk),
-                self.k_cache, self.v_cache, use_pallas=self.use_pallas,
-                use_ring=ring,
+            logits, self.k_cache, self.v_cache = self._pallas_guard(
+                lambda: self.mirror.lead_prefill(
+                    self.params, toks, self._table_for(seq), pos,
+                    len(chunk), self.k_cache, self.v_cache,
+                    use_pallas=self.use_pallas, use_ring=ring,
+                )
             )
             return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0
-        logits, self.k_cache, self.v_cache = llama.prefill(
-            self.params,
-            cfg.model,
-            jnp.asarray(toks),
-            jnp.asarray(self._table_for(seq)),
-            jnp.int32(pos),
-            jnp.int32(len(chunk)),
-            self.k_cache,
-            self.v_cache,
-            use_pallas=self.use_pallas,
-            mesh=self.mesh,
-            use_ring=ring,
+        logits, self.k_cache, self.v_cache = self._pallas_guard(
+            lambda: llama.prefill(
+                self.params,
+                cfg.model,
+                jnp.asarray(toks),
+                jnp.asarray(self._table_for(seq)),
+                jnp.int32(pos),
+                jnp.int32(len(chunk)),
+                self.k_cache,
+                self.v_cache,
+                use_pallas=self.use_pallas,
+                mesh=self.mesh,
+                use_ring=ring,
+            )
         )
         return logits, pos + len(chunk)
 
@@ -1328,6 +1339,44 @@ class JaxEngine(AsyncEngine):
             self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
         return True
 
+    def _pallas_guard(self, thunk):
+        """Run a device dispatch; if Mosaic rejects a kernel at its
+        FIRST compile (a constraint the CPU tests can't prove — e.g. the
+        sub-128 pe-stream lane tiles, advisor r3), flip ``use_pallas``
+        off and retry once on the XLA path instead of failing the
+        request. The thunk must read ``self.use_pallas`` at call time.
+
+        Two hard gates on the retry:
+          * mirror mode never retries — the step descriptor (with
+            ``pallas=True``) was already broadcast before the leader's
+            compile failed, so a lone leader retry would re-enter the
+            collective against followers that crashed on the same
+            kernel; SPMD fallback would need coordination BEFORE the
+            broadcast, so mirrored engines surface the error instead;
+          * the caches must still be live — donation frees buffers at
+            execution, so a lowering/compile rejection leaves them
+            intact, but an EXECUTION-stage Mosaic error arrives after
+            donation and a retry would dispatch on deleted arrays.
+        """
+        try:
+            return thunk()
+        except Exception as e:  # noqa: BLE001 — inspected, re-raised
+            msg = str(e).lower()
+            if (
+                self.mirror is not None
+                or not self.use_pallas
+                or not ("mosaic" in msg or "pallas" in msg)
+                or self.k_cache.is_deleted()
+                or self.v_cache.is_deleted()
+            ):
+                raise
+            logger.warning(
+                "Mosaic rejected a kernel at first dispatch; "
+                "falling back to XLA attention for this engine: %s", e
+            )
+            self.use_pallas = False
+            return thunk()
+
     def _dispatch_verify(
         self, window: np.ndarray, proposals: np.ndarray, steps: np.ndarray
     ):
@@ -1340,7 +1389,7 @@ class JaxEngine(AsyncEngine):
         penalized = self._penalties_active()
         want_lp = self._logprobs_active()
         if self.mirror is not None:
-            out = self.mirror.lead_verify(
+            out = self._pallas_guard(lambda: self.mirror.lead_verify(
                 self.params, window, proposals, positions,
                 self._block_tables, self._seq_lens, self._seeds, steps,
                 self._temps, self._top_ks, self._top_ps,
@@ -1351,7 +1400,7 @@ class JaxEngine(AsyncEngine):
                 pen_state=(self._pen_counts, self._pen_mask)
                 if penalized else None,
                 with_logprobs=want_lp,
-            )
+            ))
             toks, n_acc, self.k_cache, self.v_cache = out[:4]
             rest = list(out[4:])
             if penalized:
@@ -1367,7 +1416,7 @@ class JaxEngine(AsyncEngine):
                 counts=self._pen_counts,
                 prompt_mask=self._pen_mask,
             )
-        out = llama.verify_window(
+        out = self._pallas_guard(lambda: llama.verify_window(
             self.params,
             cfg.model,
             jnp.asarray(window),
@@ -1387,7 +1436,7 @@ class JaxEngine(AsyncEngine):
             mesh=self.mesh,
             with_logprobs=want_lp,
             **kwargs,
-        )
+        ))
         toks, n_acc, self.k_cache, self.v_cache = out[:4]
         rest = list(out[4:])
         if penalized:
@@ -1505,7 +1554,7 @@ class JaxEngine(AsyncEngine):
         if self.mirror is not None:
             penalized = self._penalties_active()
             want_lp = self._logprobs_active()
-            out = self.mirror.lead_decode(
+            out = self._pallas_guard(lambda: self.mirror.lead_decode(
                 self.params, self._last_tokens, positions,
                 self._block_tables, seq_lens, self._seeds, steps,
                 self._temps, self._top_ks, self._top_ps,
@@ -1521,7 +1570,7 @@ class JaxEngine(AsyncEngine):
                 tokens_dev=tokens_in,
                 sync=False,  # device handle; materialized at emission so
                 # a pipelined next window dispatches without waiting
-            )
+            ))
             toks, self.k_cache, self.v_cache = out[0], out[1], out[2]
             rest = list(out[3:])
             if penalized:
@@ -1547,27 +1596,30 @@ class JaxEngine(AsyncEngine):
             self.v_cache,
         )
         want_lp = self._logprobs_active()
+        # use_pallas stays OUT of kw: the guard's retry thunk must read
+        # the freshly-flipped value, not a stale snapshot
         kw = dict(
             n_steps=n,
-            use_pallas=self.use_pallas,
             mesh=self.mesh,
             unroll=not cfg.decode_layer_scan,
             merged=cfg.decode_merged,
             with_logprobs=want_lp,
         )
         if self._penalties_active():
-            out = llama.decode_window(
-                *args, **kw,
+            out = self._pallas_guard(lambda: llama.decode_window(
+                *args, **kw, use_pallas=self.use_pallas,
                 freq_pens=jnp.asarray(self._freq_pens),
                 pres_pens=jnp.asarray(self._pres_pens),
                 rep_pens=jnp.asarray(self._rep_pens),
                 counts=self._pen_counts,
                 prompt_mask=self._pen_mask,
-            )
+            ))
             toks, self.k_cache, self.v_cache, self._pen_counts = out[:4]
             lps = out[4] if want_lp else None
         else:
-            out = llama.decode_window(*args, **kw)
+            out = self._pallas_guard(lambda: llama.decode_window(
+                *args, **kw, use_pallas=self.use_pallas
+            ))
             toks, self.k_cache, self.v_cache = out[:3]
             lps = out[3] if want_lp else None
         # device handles; materialized at emission (fetching here would

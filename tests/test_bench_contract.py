@@ -12,11 +12,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_emits_one_json_line_cpu_smoke():
+def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"  # honored explicitly by bench.py
     env["PYTHONPATH"] = REPO
     env.pop("XLA_FLAGS", None)  # single CPU device, like the driver
+    # scratch history: a test run must not accrete into the tracked file
+    env["DYN_SMOKE_HISTORY"] = str(tmp_path / "history.jsonl")
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
@@ -32,3 +34,32 @@ def test_bench_emits_one_json_line_cpu_smoke():
     # cached-silicon replay (that fallback is for unreachable backends)
     assert "cpu_smoke" in result["metric"]
     assert result["value"] > 0
+    # the run recorded itself into the (scratch) history
+    with open(tmp_path / "history.jsonl") as f:
+        recorded = [json.loads(ln) for ln in f if ln.strip()]
+    assert recorded and recorded[-1]["value"] == result["value"]
+
+
+def test_smoke_regression_band_catches_r03_drop():
+    """The exact cross-round drop that shipped silently in round 3
+    (3130.5 -> 2405.33, -23%) must flag; ordinary jitter must not
+    (VERDICT r3 weak #1)."""
+    sys.path.insert(0, REPO)
+    try:
+        from bench import check_smoke_regression
+    finally:
+        sys.path.remove(REPO)
+
+    ratio, regressed = check_smoke_regression(2405.33, [3130.5])
+    assert regressed and ratio < 0.85
+    # +/-10% box noise stays quiet
+    _, regressed = check_smoke_regression(2850.0, [3130.5])
+    assert not regressed
+    _, regressed = check_smoke_regression(3400.0, [3130.5])
+    assert not regressed
+    # no history: never flags
+    ratio, regressed = check_smoke_regression(100.0, [])
+    assert ratio == 1.0 and not regressed
+    # median of last three sheds a one-off dip in the history itself
+    _, regressed = check_smoke_regression(3000.0, [3100.0, 900.0, 3100.0])
+    assert not regressed

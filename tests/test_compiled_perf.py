@@ -1,0 +1,180 @@
+"""Compiled-program perf-property tests (no chip required).
+
+The merged one-write decode's whole value claim is structural: the KV
+caches are DONATED through the jit boundary and appended IN PLACE by one
+Mosaic kernel per step, instead of 2L full-cache XLA scatter copies
+(docs/performance.md "decode killer #2": ~0.55 GB copied per scatter on
+the 1B config). A TPU relay outage must not leave that claim untestable
+(VERDICT r3 #2), so these tests assert it on the artifacts a chip-free
+box CAN produce:
+
+  * ``jax.export`` with ``platforms=["tpu"]`` — Mosaic lowering is
+    hardware-independent, so the TPU StableHLO module is inspectable on
+    CPU: the Pallas kernels must appear as ``tpu_custom_call``s whose
+    cache operands carry ``output_operand_alias`` (the in-place RMW),
+    with ZERO full-cache-shaped ``stablehlo.scatter`` ops left;
+  * a real CPU ``.lower().compile()`` — the executable's
+    ``input_output_alias`` header must map both cache parameters to
+    outputs (donation survived to the buffer assignment).
+
+A negative control locks the regexes themselves: the XLA fallback path
+(``use_pallas=False``) MUST trip the scatter detector — if it stops
+doing so, the detector has rotted, not the product.
+"""
+
+import re
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import export as jexport
+from jax.sharding import Mesh
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+B, BLOCK, CTX, NSTEPS = 2, 16, 128, 4
+
+
+def _decode_inputs(cfg):
+    M = CTX // BLOCK
+    num_blocks = B * M + 1
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(cfg, num_blocks, BLOCK)
+    tables = jnp.asarray(np.arange(1, num_blocks, dtype=np.int32).reshape(B, M))
+    return dict(
+        params=params, k_cache=k_cache, v_cache=v_cache, tables=tables,
+        tokens=jnp.zeros(B, jnp.int32),
+        positions=jnp.full((B,), 10, jnp.int32),
+        seq_lens=jnp.full((B,), 11, jnp.int32),
+        seeds=jnp.zeros(B, jnp.int32), steps=jnp.zeros(B, jnp.int32),
+        temps=jnp.zeros(B, jnp.float32), top_ks=jnp.zeros(B, jnp.int32),
+        top_ps=jnp.ones(B, jnp.float32),
+    )
+
+
+def _export_tpu_text(cfg, inp, *, use_pallas, merged, mesh=None):
+    """TPU-platform StableHLO of the real ``llama.decode_window`` jit
+    (donate_argnames and all), as text."""
+    exp = jexport.export(llama.decode_window, platforms=["tpu"])(
+        inp["params"], cfg, inp["tokens"], inp["positions"], inp["tables"],
+        inp["seq_lens"], inp["seeds"], inp["steps"], inp["temps"],
+        inp["top_ks"], inp["top_ps"], inp["k_cache"], inp["v_cache"],
+        n_steps=NSTEPS, use_pallas=use_pallas, merged=merged, mesh=mesh,
+    )
+    return exp.mlir_module()
+
+
+def _cache_shape_res(*caches):
+    # stablehlo type syntax: tensor<2x2x17x16x128xbf16>
+    return [
+        "x".join(str(d) for d in c.shape) + "x" + ("bf16" if c.dtype == jnp.bfloat16 else str(c.dtype))
+        for c in caches
+    ]
+
+
+def _full_cache_scatters(text, shape_res):
+    hits = []
+    for line in text.splitlines():
+        if "stablehlo.scatter" in line and any(s in line for s in shape_res):
+            hits.append(line.strip()[:160])
+    return hits
+
+
+def test_merged_decode_is_scatter_free_on_tpu():
+    """The headline path (use_pallas, merged): every per-step cache write
+    is one aliased Mosaic custom call; no full-cache scatter survives
+    lowering. head_dim=128 matches the engine's kernel gate."""
+    cfg = ModelConfig.tiny(dtype="bfloat16", head_dim=128)
+    inp = _decode_inputs(cfg)
+    text = _export_tpu_text(cfg, inp, use_pallas=True, merged=True)
+    shape_res = _cache_shape_res(inp["k_cache"], inp["v_cache"])
+
+    assert text.count("tpu_custom_call") >= 2, (
+        "expected Mosaic kernels (paged attention + cache append) in the "
+        "TPU lowering; the Pallas path silently fell back to XLA"
+    )
+    # the append kernel RMWs both caches in place
+    assert text.count("output_operand_alias") >= 2
+    scatters = _full_cache_scatters(text, shape_res)
+    assert not scatters, (
+        "full-cache scatter(s) back in the merged decode path — the "
+        f"~0.55GB/step copy regression: {scatters}"
+    )
+    # donation intent on both caches survives to the exported module
+    donors = text.count("jax.buffer_donor") + text.count("tf.aliasing_output")
+    assert donors >= 2
+
+
+def test_merged_decode_sharded_tp_is_scatter_free_on_tpu():
+    """Same property under the tp shard_map (kv-head-parallel kernels)."""
+    cfg = ModelConfig.tiny(dtype="bfloat16", head_dim=128)
+    inp = _decode_inputs(cfg)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    text = _export_tpu_text(cfg, inp, use_pallas=True, merged=True, mesh=mesh)
+    shape_res = _cache_shape_res(inp["k_cache"], inp["v_cache"])
+    assert text.count("tpu_custom_call") >= 2
+    assert text.count("output_operand_alias") >= 2
+    assert not _full_cache_scatters(text, shape_res)
+
+
+def test_mla_merged_decode_is_scatter_free_on_tpu():
+    """The MLA latent merged path: all layers' latent writes batch into
+    one aliased append (kv_lora_rank=128 engages the engine gate)."""
+    cfg = ModelConfig.tiny_mla(dtype="bfloat16", kv_lora_rank=128)
+    inp = _decode_inputs(cfg)
+    text = _export_tpu_text(cfg, inp, use_pallas=True, merged=True)
+    shape_res = _cache_shape_res(inp["k_cache"], inp["v_cache"])
+    assert text.count("tpu_custom_call") >= 2
+    assert text.count("output_operand_alias") >= 2
+    assert not _full_cache_scatters(text, shape_res)
+
+
+def test_xla_fallback_trips_the_scatter_detector():
+    """Negative control: the XLA path DOES contain full-cache scatters
+    (that's why the Pallas append exists). If this stops failing the
+    detector, the regexes rotted and the positive tests prove nothing."""
+    cfg = ModelConfig.tiny(dtype="bfloat16", head_dim=128)
+    inp = _decode_inputs(cfg)
+    text = _export_tpu_text(cfg, inp, use_pallas=False, merged=False)
+    shape_res = _cache_shape_res(inp["k_cache"], inp["v_cache"])
+    assert _full_cache_scatters(text, shape_res), (
+        "scatter detector no longer matches the known-scatter XLA path"
+    )
+
+
+def test_cpu_compiled_executable_aliases_both_caches():
+    """Donation must survive all the way into the compiled executable's
+    buffer assignment: the HloModule header's input_output_alias has to
+    map two parameters with exactly the cache shapes. (A donation that
+    XLA could not honor is silently dropped — caches would be COPIED
+    every window.)"""
+    cfg = ModelConfig.tiny(dtype="bfloat16")
+    inp = _decode_inputs(cfg)
+    compiled = llama.decode_window.lower(
+        inp["params"], cfg, inp["tokens"], inp["positions"], inp["tables"],
+        inp["seq_lens"], inp["seeds"], inp["steps"], inp["temps"],
+        inp["top_ks"], inp["top_ps"], inp["k_cache"], inp["v_cache"],
+        n_steps=NSTEPS, use_pallas=False, merged=True,
+    ).compile()
+    text = compiled.as_text()
+    header = text.splitlines()[0]
+    m = re.search(r"input_output_alias=\{(.*?)\}, entry_computation", header)
+    assert m, f"no input_output_alias in compiled module header: {header[:200]}"
+    param_idxs = [int(p) for p in re.findall(r"\((\d+), \{\}", m.group(1))]
+    assert len(param_idxs) >= 2, f"expected both caches aliased: {m.group(1)}"
+    # map the aliased parameter indices back to shapes via the entry params
+    shape_of = dict(
+        (int(idx), shape)
+        for shape, idx in re.findall(
+            r"(\S+\[[0-9,]*\])\{[0-9,]*\} parameter\((\d+)\)", text
+        )
+    )
+    cache_shape = "bf16[" + ",".join(str(d) for d in inp["k_cache"].shape) + "]"
+    aliased_shapes = [shape_of.get(i) for i in param_idxs]
+    assert aliased_shapes.count(cache_shape) >= 2, (
+        f"aliased params {param_idxs} have shapes {aliased_shapes}, "
+        f"expected two of {cache_shape}"
+    )
