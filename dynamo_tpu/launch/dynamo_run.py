@@ -44,7 +44,7 @@ from ..llm.backend import Backend
 from ..llm.model_card import MdcRefresher, ModelDeploymentCard
 from ..llm.openai_engine import OpenAIWorkerEngine
 from ..llm.preprocessor import OpenAIPreprocessor
-from ..llm.tokenizer import ByteTokenizer, HFTokenizer
+from ..llm.tokenizer import ByteTokenizer, load_tokenizer
 from ..models.config import ModelConfig
 from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
 from ..protocols.openai import ChatCompletionRequest
@@ -52,6 +52,27 @@ from ..runtime import AsyncEngine, Context, DistributedRuntime, link
 from ..runtime.hub import HubServer, connect_hub
 
 logger = logging.getLogger(__name__)
+
+
+def _node_rank_default() -> int:
+    """Node rank from env, with a StatefulSet hostname fallback.
+
+    The manifests inject DYN_NODE_RANK from the
+    ``apps.kubernetes.io/pod-index`` label, which the StatefulSet
+    controller only stamps on k8s >= 1.28 (PodIndexLabel gate); on older
+    clusters the downward-API env resolves EMPTY and every rank would
+    silently become 0 (advisor r3). StatefulSet pod names always end in
+    the ordinal (``<group>-<n>``), so the hostname carries the same rank
+    on every k8s version.
+    """
+    raw = os.environ.get("DYN_NODE_RANK", "")
+    if raw.strip():
+        return int(raw)
+    host = os.environ.get("HOSTNAME", "")
+    tail = host.rsplit("-", 1)[-1]
+    if host and tail.isdigit():
+        return int(tail)
+    return 0
 
 
 class EchoEngine(AsyncEngine):
@@ -76,6 +97,17 @@ class EchoEngine(AsyncEngine):
 
 def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[dict], object, str]:
     """(model config, params-or-None, tokenizer, model name)."""
+    cfg, params, tok, name = _build_model(args, load_weights)
+    if getattr(args, "tokenizer", None):
+        # explicit tokenizer dir override: lets the sim presets (random
+        # weights, byte tokenizer by default) serve through a REAL HF /
+        # SentencePiece tokenizer so TTFT includes tokenization and ITL
+        # includes detokenization (serve_bench --sim-tokenizer)
+        tok = load_tokenizer(args.tokenizer)
+    return cfg, params, tok, name
+
+
+def _build_model(args, load_weights: bool):
     if args.model_path in (None, "tiny"):
         cfg = ModelConfig.tiny()
         return cfg, None, ByteTokenizer(), args.model_name or "tiny"
@@ -142,7 +174,8 @@ def build_model(args, load_weights: bool = True) -> tuple[ModelConfig, Optional[
     # local dir, HF-cache snapshot, or hub download (ref hub.rs from_hf)
     args.model_path = resolve_model_path(args.model_path)
     cfg = ModelConfig.from_local_path(args.model_path)
-    tokenizer = HFTokenizer(args.model_path)
+    # tokenizer.json -> HF fast path; tokenizer.model -> SentencePiece
+    tokenizer = load_tokenizer(args.model_path)
     params = None
     has_weights = load_weights and any(
         f.endswith(".safetensors") for f in os.listdir(args.model_path)
@@ -554,7 +587,7 @@ def main(argv=None) -> None:
                    default=int(os.environ.get("DYN_NUM_NODES", "1")),
                    help="total processes in the multi-host mesh")
     p.add_argument("--node-rank", type=int,
-                   default=int(os.environ.get("DYN_NODE_RANK", "0")),
+                   default=_node_rank_default(),
                    help="this process's rank (0 = leader)")
     p.add_argument("--coordinator",
                    default=os.environ.get("DYN_COORDINATOR"),
@@ -566,8 +599,15 @@ def main(argv=None) -> None:
                    help="host-DRAM KV offload tier capacity (blocks; 0=off)")
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--data-dir", default=None,
-                   help="hub durability dir: work queues WAL to JSONL here "
-                        "and survive hub restarts (in=hub role)")
+                   help="hub durability dir (in=hub role): the store "
+                        "snapshots+WALs its KV/leases and work queues WAL "
+                        "here — a restarted hub keeps discovery state and "
+                        "queued work, and connected workers/frontends "
+                        "resume their sessions without restarting")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer dir override (tokenizer.json or "
+                        "tokenizer.model) — e.g. a real tokenizer for "
+                        "the *-sim model presets")
     p.add_argument("--quantization", default="none",
                    choices=["none", "int8", "fp8_e4m3"],
                    help="weight quantization (per-channel; models/quant.py)")

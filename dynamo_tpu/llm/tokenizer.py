@@ -159,6 +159,111 @@ class HFTokenizer(Tokenizer):
         )
 
 
+class SPTokenizer(Tokenizer):
+    """SentencePiece tokenizer from a checkpoint's ``tokenizer.model``
+    (ref lib/llm/src/tokenizers/sp.rs:25) — for checkpoints that ship no
+    ``tokenizer.json``. Backed by the in-repo model reader/segmenters
+    (:mod:`.sp_model`; the sentencepiece wheel is not in this image).
+
+    Special ids follow the checkpoint: ``tokenizer_config.json`` /
+    ``special_tokens_map.json`` overrides win when present; otherwise
+    the conventional ``<s>``/``</s>`` control pieces are used. A
+    ``chat_template`` found in ``tokenizer_config.json`` renders via
+    jinja2 (the same engine transformers uses)."""
+
+    def __init__(self, path: str):
+        import json
+        import os
+
+        model_file = (
+            os.path.join(path, "tokenizer.model")
+            if os.path.isdir(path) else path
+        )
+        from .sp_model import CONTROL, SentencePieceModel
+
+        self._sp = SentencePieceModel.load(model_file)
+        self._piece_id = {
+            p.text: i for i, p in enumerate(self._sp.pieces)
+        }
+        self._chat_template = None
+        self._bos_id = self._piece_id.get("<s>")
+        self._eos_ids = [
+            i for i, p in enumerate(self._sp.pieces)
+            if p.type == CONTROL and p.text in ("</s>", "<|endoftext|>")
+        ]
+        cfg_dir = path if os.path.isdir(path) else os.path.dirname(path)
+        for fname in ("special_tokens_map.json", "tokenizer_config.json"):
+            try:
+                with open(os.path.join(cfg_dir, fname)) as f:
+                    cfg = json.load(f)
+            except (OSError, ValueError):
+                continue
+            bos, eos = cfg.get("bos_token"), cfg.get("eos_token")
+            if isinstance(bos, dict):
+                bos = bos.get("content")
+            if isinstance(eos, dict):
+                eos = eos.get("content")
+            if bos in self._piece_id:
+                self._bos_id = self._piece_id[bos]
+            if eos in self._piece_id:
+                self._eos_ids = [self._piece_id[eos]]
+            if cfg.get("chat_template"):
+                self._chat_template = cfg["chat_template"]
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> list[int]:
+        ids = self._sp.encode(text)
+        if add_special_tokens and self._bos_id is not None:
+            ids = [self._bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._sp.decode(ids, skip_special=skip_special_tokens)
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return list(self._eos_ids)
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._bos_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._sp.pieces)
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list | None = None,
+    ) -> str:
+        if not self._chat_template:
+            raise NotImplementedError("checkpoint has no chat template")
+        import jinja2
+
+        env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
+        bos = self._sp.pieces[self._bos_id].text if self._bos_id is not None else ""
+        eos = self._sp.pieces[self._eos_ids[0]].text if self._eos_ids else ""
+        return env.from_string(self._chat_template).render(
+            messages=messages, add_generation_prompt=add_generation_prompt,
+            tools=tools or None, bos_token=bos, eos_token=eos,
+        )
+
+
+def load_tokenizer(path: str) -> Tokenizer:
+    """The checkpoint-dir tokenizer policy (one place): ``tokenizer.json``
+    → :class:`HFTokenizer` (fast path), else ``tokenizer.model`` →
+    :class:`SPTokenizer`. The reference factories pick hf.rs vs sp.rs by
+    the same file probe (lib/llm/src/tokenizers.rs)."""
+    import os
+
+    if os.path.exists(os.path.join(path, "tokenizer.json")):
+        return HFTokenizer(path)
+    if os.path.exists(os.path.join(path, "tokenizer.model")):
+        return SPTokenizer(path)
+    raise FileNotFoundError(
+        f"no tokenizer.json or tokenizer.model under {path!r}"
+    )
+
+
 class DecodeStream:
     """Incremental, UTF-8-safe detokenizer (ref tokenizers.rs:158
     DecodeStream; the sliding-window scheme matches what the engines the

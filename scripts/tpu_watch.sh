@@ -86,6 +86,24 @@ print('exec-ok')" 2>/dev/null | grep -q exec-ok; then
       [ "$sbm_rc" != 0 ] && log_entry "serve_bench deepseek-8b-sim (FAILED)" \
           /tmp/tpu_results/serve_bench_mla.log
     fi
+    # Real-tokenizer serving point (VERDICT r3 weak #3): same 8B sim
+    # through a full HF WordLevel tokenizer so TTFT includes real
+    # tokenization and ITL real detokenization. ISL is ~1 token/word
+    # here, so 2000 words ~ 2000 tokens/prompt; 4 concurrent fit the
+    # 640-block (10240-token) pool like the byte preset does.
+    if ! grep -q '"ok": [1-9]' /root/repo/BENCH_serving_hf.json 2>/dev/null; then
+      timeout 2400 python -u scripts/serve_bench.py \
+          --model-path llama3-8b-sim --quantization int8 \
+          --kv-cache-dtype float8_e4m3 --num-blocks 640 --block-size 16 \
+          --max-batch 8 --n 16 --isl 2000 --osl 150 --concurrency 4 \
+          --sim-tokenizer --artifact \
+          --artifact-name BENCH_serving_hf.json \
+          > /tmp/tpu_results/serve_bench_hf.log 2>&1
+      sbh_rc=$?
+      echo "serve_bench_hf rc=$sbh_rc" >> /tmp/tpu_results/status
+      [ "$sbh_rc" != 0 ] && log_entry "serve_bench hf-tokenizer (FAILED)" \
+          /tmp/tpu_results/serve_bench_hf.log
+    fi
     # Persist the JSON line as a repo artifact for the driver/judge.
     # Never truncate a previously captured good result with an empty
     # one, and never re-persist bench.py's own *_cached replay (it IS
