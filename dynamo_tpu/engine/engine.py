@@ -258,11 +258,16 @@ class JaxEngine(AsyncEngine):
             and (
                 (
                     not cfg.model.is_mla
-                    and cfg.model.head_dim % 128 == 0
-                    # sinks and per-layer windows live in the XLA
-                    # attention paths only (gpt-oss)
-                    and not cfg.model.attn_sinks
-                    and not cfg.model.layer_windows
+                    # 64 covers gpt-oss (head_dim=64): Mosaic pads
+                    # sub-128 lane tiles; if this chip/toolchain
+                    # rejects that, _pallas_guard flips the engine to
+                    # XLA at first dispatch instead of failing the
+                    # request (validate_tpu_kernels checks D=64
+                    # on-chip). Sinks fold into the kernels' merge
+                    # denominators and per-layer windows are static
+                    # per unrolled layer call, so gpt-oss is NOT
+                    # gated off.
+                    and cfg.model.head_dim % 64 == 0
                     and (
                         self.mesh is None
                         or cfg.model.num_kv_heads % tp == 0

@@ -457,11 +457,21 @@ def moe_ffn(
         if "be_down" in lp:
             o = o + lp["be_down"][e_sorted]
         out = _moe_combine(o, t_sorted, w_sorted, T, out_dt)
-    elif _moe_can_shard(mesh, cfg) and "be_gate" not in lp:
-        # per-expert biases (gpt-oss) take the dense fallback on meshes:
-        # the shard_map body would need ep-local bias gathers; dense
-        # dispatch is GSPMD-shardable and exact
+    elif _moe_can_shard(mesh, cfg):
         out = _moe_ragged_sharded(lp, cfg, x, mesh)
+        if "be_down" in lp:
+            # the down-projection bias is added OUTSIDE the shard_map:
+            # inside, the tp psum over the Fm contraction would count it
+            # tp times. Per token it is sum_k w_k * be_down[e_k] — the
+            # replicated routing matrix against [X, E], trivially
+            # GSPMD-safe and exact.
+            vals, idx = _route_topk(lp, cfg, x)
+            w = jnp.sum(
+                jax.nn.one_hot(idx, cfg.num_experts, dtype=jnp.float32)
+                * vals[..., None],
+                axis=1,
+            )  # [T, X]
+            out = out + (w @ lp["be_down"].astype(jnp.float32)).astype(out_dt)
     else:
         out = _moe_dense_dispatch(lp, cfg, x)
     if "shared_gate" in lp:  # DeepSeek shared experts: always-on dense path
@@ -510,7 +520,14 @@ def _moe_can_shard(mesh, cfg: ModelConfig) -> bool:
 
 
 def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
-    """shard_map body for ragged MoE over (ep, tp); other axes stay auto."""
+    """shard_map body for ragged MoE over (ep, tp); other axes stay auto.
+
+    gpt-oss rides this path too: the router LOGIT bias is replicated into
+    the routing computation, and the per-expert gate/up projection biases
+    are ep×tp-sharded with their weights and indexed by each window row's
+    LOCAL expert id (recovered from the cumulative local group sizes).
+    The down bias is the caller's job (moe_ffn adds it outside — inside,
+    the tp psum would multiply it)."""
     from jax.sharding import PartitionSpec as P
 
     T = x.shape[0]
@@ -519,10 +536,13 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
     ep = mesh.shape["ep"]
     Xl = X // ep
     out_dt = x.dtype
+    has_eb = "be_gate" in lp
 
-    def body(x, moe_gate, gate_bias, we_gate, we_up, we_down):
+    def body(x, moe_gate, gate_bias, router_bias, we_gate, we_up, we_down,
+             be_gate, be_up):
         t_sorted, w_sorted, _e_sorted, group_sizes = _moe_route(
-            {"moe_gate": moe_gate, "moe_gate_bias": gate_bias}, cfg, x
+            {"moe_gate": moe_gate, "moe_gate_bias": gate_bias,
+             "moe_router_bias": router_bias}, cfg, x
         )
         first = lax.axis_index("ep") * Xl
         gs_local = lax.dynamic_slice_in_dim(group_sizes, first, Xl)
@@ -545,13 +565,25 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
         w_l = jnp.where(valid, w_l, 0.0)
         g = lax.ragged_dot(xs, we_gate, gs_local)
         u = lax.ragged_dot(xs, we_up, gs_local)
-        o = lax.ragged_dot(jax.nn.silu(g) * u, we_down, gs_local)
+        if has_eb:
+            # window row r's LOCAL expert: first local group whose
+            # cumulative size exceeds r (masked tail rows clamp to the
+            # last expert; their combine weight is already zero)
+            e_l = jnp.searchsorted(
+                jnp.cumsum(gs_local), jnp.arange(R), side="right"
+            )
+            e_l = jnp.minimum(e_l, Xl - 1)
+            g = g + be_gate[e_l]
+            u = u + be_up[e_l]
+        o = lax.ragged_dot(_expert_act(cfg, g, u), we_down, gs_local)
         out = _moe_combine(o, t_l, w_l, T, out_dt)
         return lax.psum(out, ("ep", "tp"))
 
-    gate_bias = lp.get("moe_gate_bias")
-    if gate_bias is None:  # uniform operand pytree for the shard_map
-        gate_bias = jnp.zeros((X,), jnp.float32)
+    def _z(key, shape):  # uniform operand pytree for the shard_map
+        v = lp.get(key)
+        return v if v is not None else jnp.zeros(shape, jnp.float32)
+
+    Fm = lp["we_gate"].shape[-1]
     return jax.shard_map(
         body,
         mesh=mesh,
@@ -559,14 +591,18 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
             P(),  # x replicated (batch inputs are replicated engine-side)
             P(),  # router weights replicated
             P(),  # V3 no-aux gate bias (zeros when absent)
+            P(),  # gpt-oss router logit bias (zeros when absent)
             P("ep", None, "tp"),  # we_gate [X, E, Fm]
             P("ep", None, "tp"),  # we_up
             P("ep", "tp", None),  # we_down [X, Fm, E]
+            P("ep", "tp"),  # be_gate [X, Fm] (zeros when absent)
+            P("ep", "tp"),  # be_up
         ),
         out_specs=P(),
         check_vma=False,
-    )(x, lp["moe_gate"], gate_bias, lp["we_gate"], lp["we_up"],
-      lp["we_down"])
+    )(x, lp["moe_gate"], _z("moe_gate_bias", (X,)),
+      _z("moe_router_bias", (X,)), lp["we_gate"], lp["we_up"],
+      lp["we_down"], _z("be_gate", (X, Fm)), _z("be_up", (X, Fm)))
 
 
 def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
@@ -846,12 +882,10 @@ def _decode_body(
         block_tables, positions, k_cache.shape[3]
     )
     mla_merged = merged and unroll and use_pallas and cfg.is_mla
-    # sinks / per-layer windows live in the XLA paths only — the merged
-    # path's kernels know neither, so those models stay write-then-attend
-    merged = (
-        merged and unroll and use_pallas and not cfg.is_mla
-        and not cfg.attn_sinks and not cfg.layer_windows
-    )
+    # sinks join the flash-merge denominator and per-layer windows are
+    # static per (unrolled) layer call, so gpt-oss runs the merged
+    # one-write path like every other GQA family
+    merged = merged and unroll and use_pallas and not cfg.is_mla
     if mla_merged:
         # MERGED one-write path, MLA flavor: the latent kernel scores
         # history with stats, the current token's (c_kv, k_pe) folds in
@@ -948,14 +982,15 @@ def _decode_body(
                 if mesh is None:
                     o = att.decode_attention_merged(
                         q, k, v, k_cache[l], v_cache[l], block_tables,
-                        hist_lens, scale, window=cfg.sliding_window,
-                        interpret=interpret,
+                        hist_lens, scale, window=window_for_layer(cfg, l),
+                        sinks=lp.get("sinks"), interpret=interpret,
                     )
                 else:
                     o = att.decode_attention_merged_sharded(
                         q, k, v, k_cache[l], v_cache[l], block_tables,
-                        hist_lens, scale, mesh, window=cfg.sliding_window,
-                        interpret=interpret,
+                        hist_lens, scale, mesh,
+                        window=window_for_layer(cfg, l),
+                        sinks=lp.get("sinks"), interpret=interpret,
                     )
                 x = layer_tail(x, lp, o)
         k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)
@@ -1236,15 +1271,10 @@ def _verify_forward(
             k_news.append(k)
             v_news.append(v)
             if use_pallas and mesh is not None:
-                # the sharded kernel path knows neither sinks nor
-                # per-layer windows — fail loud rather than attend wrong
-                assert not cfg.attn_sinks and not cfg.layer_windows, (
-                    "sharded pallas verify cannot serve sink/per-layer-"
-                    "window models (the engine gates use_pallas off)"
-                )
                 o = att.verify_attention_sharded(
                     q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
-                    scale, mesh, use_pallas=True, window=cfg.sliding_window,
+                    scale, mesh, use_pallas=True,
+                    window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
                     interpret=interpret,
                 )
             else:

@@ -28,8 +28,39 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
+
+
+def _shard_tp(mesh, local_fn, *, arr_specs, arrs, k_cache_layer,
+              v_cache_layer, scalars, sinks, out_spec):
+    """One shard_map over ``tp`` shared by every paged-attention wrapper.
+
+    The kv-head axis is the cache's sharded axis (ops module docs), and
+    paged attention is embarrassingly parallel over kv-head groups: each
+    device runs the kernel on its local [Hkv/tp, ...] cache shard
+    against its local head-sharded query arrays (``arrs`` with
+    ``arr_specs``); ``scalars`` (block tables, lengths) replicate,
+    matching the engine's host-batch inputs; other mesh axes
+    (dp/pp/sp/ep) replicate too — no collectives needed. Per-head sinks,
+    only when present, shard with the heads and arrive as ``local_fn``'s
+    LAST argument; keeping the sinks/no-sinks cases one invocation stops
+    the spec blocks drifting apart."""
+    in_specs = (
+        *arr_specs,
+        P("tp", None, None, None),  # k cache layer
+        P("tp", None, None, None),  # v cache layer
+        *([P()] * len(scalars)),
+    )
+    operands = (*arrs, k_cache_layer, v_cache_layer, *scalars)
+    if sinks is not None:
+        in_specs += (P("tp"),)
+        operands += (sinks,)
+    return jax.shard_map(
+        local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
+        check_vma=False,
+    )(*operands)
 
 
 def repeat_kv(x: jnp.ndarray, n_rep: int, axis: int) -> jnp.ndarray:
@@ -49,7 +80,7 @@ def decode_attention(
     use_pallas: bool = False,
     mesh=None,
     window: int = 0,
-    sinks=None,  # [H] gpt-oss sink logits; forces the XLA path
+    sinks=None,  # [H] gpt-oss sink logits; stats-fold on the kernel path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
@@ -65,15 +96,20 @@ def decode_attention(
     guarantee num_kv_heads % tp == 0 (the engine falls back to XLA
     otherwise, where GSPMD handles uneven head splits).
     """
-    if use_pallas and sinks is None and mesh is not None:
+    if use_pallas and mesh is not None:
         return paged_decode_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-            mesh, window=window, interpret=interpret,
+            mesh, window=window, sinks=sinks, interpret=interpret,
         )
     if use_pallas and sinks is None:
         return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             window=window, interpret=interpret,
+        )
+    if use_pallas:
+        return _decode_kernel_with_sinks(
+            q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+            sinks, window=window, interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
@@ -119,31 +155,31 @@ def _decode_kernel(
     )
 
 
-def _shard_headwise(kernel_fn, mesh, q, k_cache_layer, v_cache_layer, *scalars):
-    """Run a paged-attention kernel under shard_map over the ``tp`` axis.
+def _decode_kernel_with_sinks(
+    q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+    sinks, window: int = 0, interpret: bool = False,
+):
+    """Pallas decode attention for gpt-oss sink models: the in-repo
+    stats-emitting kernel scores the cache, then the sink logit joins
+    the normalization OUTSIDE the kernel — the kernel's output o is
+    already softmax-normalized by l, so the sink fold is one per-head
+    rescale: o' = o * l*exp(m-m_f) / (l*exp(m-m_f) + exp(s-m_f)), the
+    same algebra verify_attention uses for its merge denominator."""
+    from .paged_attention_pallas import paged_decode_attention
 
-    The kv-head axis is the cache's sharded axis (ops module docs), and
-    paged attention is embarrassingly parallel over kv-head groups — each
-    device runs the kernel on its local [Hkv/tp, ...] cache shard against
-    its local [..., H/tp, D] query shard (q head axis = 1 for both the
-    decode [B, H, D] and prefill [T, H, D] layouts). ``scalars`` (block
-    tables, lengths) replicate, matching the engine's host-batch inputs;
-    other mesh axes (dp/pp/sp/ep) replicate too. No collectives needed.
-    """
-    from jax.sharding import PartitionSpec as P
-
-    return jax.shard_map(
-        kernel_fn,
-        mesh=mesh,
-        in_specs=(
-            P(None, "tp", None),  # q: heads sharded
-            P("tp", None, None, None),  # k cache: kv heads sharded
-            P("tp", None, None, None),  # v cache
-            *([P()] * len(scalars)),  # tables/lengths replicated
-        ),
-        out_specs=P(None, "tp", None),
-        check_vma=False,
-    )(q, k_cache_layer, v_cache_layer, *scalars)
+    B, H, D = q.shape
+    Hkv = k_cache_layer.shape[0]
+    G = H // Hkv
+    o, m, l = paged_decode_attention(
+        q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
+        return_stats=True, window=window, interpret=interpret,
+    )
+    s = sinks.astype(jnp.float32).reshape(1, Hkv, G)
+    m_f = jnp.maximum(m, s)
+    kept = l * jnp.exp(m - m_f)  # [B, Hkv, G]
+    w = kept / jnp.maximum(kept + jnp.exp(s - m_f), 1e-20)
+    o = o.astype(jnp.float32).reshape(B, Hkv, G, D) * w[..., None]
+    return o.reshape(B, H, D).astype(q.dtype)
 
 
 def paged_decode_attention_sharded(
@@ -155,17 +191,29 @@ def paged_decode_attention_sharded(
     scale: float,
     mesh,
     window: int = 0,
+    sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas decode kernel under shard_map over tp (see _shard_headwise).
-    Head-parallel, so the same library-vs-in-repo selection applies per
-    device shard."""
-    from functools import partial
+    """Pallas decode kernel under shard_map over tp (see _shard_tp).
+    Head-parallel — the sink fold included (it's a per-head rescale), so
+    the same library-vs-in-repo selection applies per device shard."""
 
-    return _shard_headwise(
-        partial(_decode_kernel, scale=scale, window=window,
-                interpret=interpret),
-        mesh, q, k_cache_layer, v_cache_layer, block_tables, seq_lens,
+    def _local(q, kc, vc, bt, sl, s=None):
+        if s is None:
+            return _decode_kernel(
+                q, kc, vc, bt, sl, scale, window=window, interpret=interpret
+            )
+        return _decode_kernel_with_sinks(
+            q, kc, vc, bt, sl, scale, s, window=window, interpret=interpret,
+        )
+
+    return _shard_tp(
+        mesh, _local,
+        arr_specs=(P(None, "tp", None),),  # q: heads sharded
+        arrs=(q,),
+        k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
+        scalars=(block_tables, seq_lens), sinks=sinks,
+        out_spec=P(None, "tp", None),
     )
 
 
@@ -179,6 +227,7 @@ def decode_attention_merged(
     hist_lens: jnp.ndarray,  # [B] int32 tokens in cache (EXCLUDES current)
     scale: float,
     window: int = 0,
+    sinks=None,  # [H] gpt-oss sink logits; joins the merge denominator
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, H, D]
     """Decode attention with the current token handled OUT of the cache.
@@ -199,11 +248,12 @@ def decode_attention_merged(
     to out = v_new (l_h = 0, m_h = -inf).
     """
     # exactly verify_attention with a T=1 in-flight window (the merge,
-    # stats kernel, and window floor all coincide; one implementation)
+    # stats kernel, window floor — and the sink's place in the merge
+    # denominator — all coincide; one implementation)
     return verify_attention(
         q[:, None], k_new[:, None], v_new[:, None], k_cache_layer,
         v_cache_layer, block_tables, hist_lens, scale, use_pallas=True,
-        window=window, interpret=interpret,
+        window=window, sinks=sinks, interpret=interpret,
     )[:, 0]
 
 
@@ -218,34 +268,35 @@ def decode_attention_merged_sharded(
     scale: float,
     mesh,
     window: int = 0,
+    sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Merged decode attention under shard_map over ``tp``.
 
     The whole merged computation — paged kernel over the local kv-head
-    shard, s_new = q.k_new, and the flash merge — is elementwise per
-    kv-head group, so each device runs it on local tiles with no
-    collectives (same head-parallel argument as _shard_headwise)."""
-    from functools import partial
+    shard, s_new = q.k_new, the flash merge, and the per-head sink fold
+    — is elementwise per kv-head group, so each device runs it on local
+    tiles with no collectives (same head-parallel argument as
+    _shard_tp)."""
 
-    from jax.sharding import PartitionSpec as P
+    def _local(q, k_new, v_new, kc, vc, bt, hl, s=None):
+        return decode_attention_merged(
+            q, k_new, v_new, kc, vc, bt, hl, scale, window=window,
+            sinks=s, interpret=interpret,
+        )
 
-    return jax.shard_map(
-        partial(decode_attention_merged, scale=scale, window=window,
-                interpret=interpret),
-        mesh=mesh,
-        in_specs=(
+    return _shard_tp(
+        mesh, _local,
+        arr_specs=(
             P(None, "tp", None),  # q
             P(None, "tp", None),  # k_new
             P(None, "tp", None),  # v_new
-            P("tp", None, None, None),  # k cache layer
-            P("tp", None, None, None),  # v cache layer
-            P(),  # tables
-            P(),  # hist_lens
         ),
-        out_specs=P(None, "tp", None),
-        check_vma=False,
-    )(q, k_new, v_new, k_cache_layer, v_cache_layer, block_tables, hist_lens)
+        arrs=(q, k_new, v_new),
+        k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
+        scalars=(block_tables, hist_lens), sinks=sinks,
+        out_spec=P(None, "tp", None),
+    )
 
 
 def verify_attention(
@@ -342,34 +393,34 @@ def verify_attention_sharded(
     mesh,
     use_pallas: bool = True,
     window: int = 0,
+    sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
 ) -> jnp.ndarray:
     """verify_attention under shard_map over ``tp``: the paged-kernel
-    history pass, the dense intra-window part, and the flash merge are
-    all kv-head-parallel — each device computes its head shard on local
-    tiles, no collectives (same argument as decode_attention_merged)."""
-    from functools import partial
+    history pass, the dense intra-window part, the flash merge, and the
+    sink fold are all kv-head-parallel — each device computes its head
+    shard on local tiles, no collectives (same argument as
+    decode_attention_merged)."""
 
-    from jax.sharding import PartitionSpec as P
+    def _local(q, k_win, v_win, kc, vc, bt, hl, s=None):
+        return verify_attention(
+            q, k_win, v_win, kc, vc, bt, hl, scale,
+            use_pallas=use_pallas, window=window, sinks=s,
+            interpret=interpret,
+        )
 
-    return jax.shard_map(
-        partial(
-            verify_attention, scale=scale, use_pallas=use_pallas,
-            window=window, interpret=interpret,
-        ),
-        mesh=mesh,
-        in_specs=(
+    return _shard_tp(
+        mesh, _local,
+        arr_specs=(
             P(None, None, "tp", None),  # q
             P(None, None, "tp", None),  # k_win
             P(None, None, "tp", None),  # v_win
-            P("tp", None, None, None),  # k cache layer
-            P("tp", None, None, None),  # v cache layer
-            P(),  # tables
-            P(),  # hist_lens
         ),
-        out_specs=P(None, None, "tp", None),
-        check_vma=False,
-    )(q, k_win, v_win, k_cache_layer, v_cache_layer, block_tables, hist_lens)
+        arrs=(q, k_win, v_win),
+        k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
+        scalars=(block_tables, hist_lens), sinks=sinks,
+        out_spec=P(None, None, "tp", None),
+    )
 
 
 def _history_attention_xla(
@@ -521,7 +572,7 @@ def chunk_attention_with_cache(
     use_pallas: bool = False,
     mesh=None,
     window: int = 0,
-    sinks=None,  # [H] gpt-oss sink logits; forces the XLA path
+    sinks=None,  # [H] gpt-oss sink logits; in-kernel fold on the pallas path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
@@ -536,17 +587,17 @@ def chunk_attention_with_cache(
     chunk from the args. Both agree on all real rows (t < valid_len);
     padded tail rows differ but are discarded by every caller.
     """
-    if use_pallas and sinks is None and mesh is not None:
+    if use_pallas and mesh is not None:
         return paged_prefill_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
-            mesh, window=window, interpret=interpret,
+            mesh, window=window, sinks=sinks, interpret=interpret,
         )
-    if use_pallas and sinks is None:
+    if use_pallas:
         from .paged_attention_pallas import paged_prefill_attention
 
         return paged_prefill_attention(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
-            window=window, interpret=interpret,
+            window=window, sinks=sinks, interpret=interpret,
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
@@ -563,17 +614,26 @@ def paged_prefill_attention_sharded(
     scale: float,
     mesh,
     window: int = 0,
+    sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Pallas prefill kernel under shard_map over tp (see _shard_headwise)."""
-    from functools import partial
-
+    """Pallas prefill kernel under shard_map over tp (see _shard_tp;
+    the in-kernel sink fold is per-head, so it shards with the heads)."""
     from .paged_attention_pallas import paged_prefill_attention
 
-    return _shard_headwise(
-        partial(paged_prefill_attention, scale=scale, window=window,
-                interpret=interpret),
-        mesh, q, k_cache_layer, v_cache_layer, block_table, history_len,
+    def _local(q, kc, vc, bt, hist, s=None):
+        return paged_prefill_attention(
+            q, kc, vc, bt, hist, scale, window=window, sinks=s,
+            interpret=interpret,
+        )
+
+    return _shard_tp(
+        mesh, _local,
+        arr_specs=(P(None, "tp", None),),  # q: heads sharded
+        arrs=(q,),
+        k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
+        scalars=(block_table, history_len), sinks=sinks,
+        out_spec=P(None, "tp", None),
     )
 
 

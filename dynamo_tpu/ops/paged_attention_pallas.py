@@ -256,7 +256,7 @@ def _prefill_kernel(
     # scalar prefetch
     block_table_ref,  # [M] int32 (SMEM)
     hist_ref,  # [1] int32 (SMEM): tokens already cached before this chunk
-    # inputs: q then P k-page refs then P v-page refs
+    # inputs: q then P k-page refs then P v-page refs [then sinks]
     *refs,
     scale: float,
     block_size: int,
@@ -264,13 +264,16 @@ def _prefill_kernel(
     group: int,  # Gp: padded query heads per kv head
     pages_per_step: int,
     window: int = 0,  # sliding attention; 0 = full
+    has_sinks: bool = False,  # gpt-oss per-head sink logits
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, Tq*Gp, D]
     k_refs = refs[1 : 1 + P]  # each [1, 1, bs, D]
     v_refs = refs[1 + P : 1 + 2 * P]
-    o_ref = refs[1 + 2 * P]  # [1, Tq*Gp, D]
-    m_scr, l_scr, acc_scr = refs[2 + 2 * P :]
+    n_in = 1 + 2 * P + int(has_sinks)
+    sink_ref = refs[1 + 2 * P] if has_sinks else None  # [1, Gp]
+    o_ref = refs[n_in]  # [1, Tq*Gp, D]
+    m_scr, l_scr, acc_scr = refs[n_in + 1 :]
 
     j = pl.program_id(0)  # q tile
     i = pl.program_id(2)  # kv superblock (innermost: sequential accumulation)
@@ -325,8 +328,32 @@ def _prefill_kernel(
 
     @pl.when(i == pl.num_programs(2) - 1)
     def _emit():
-        l = jnp.maximum(l_scr[:, 0:1], 1e-20)
-        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        l = l_scr[:, 0:1]
+        if has_sinks:
+            # gpt-oss: the sink logit joins the softmax normalization —
+            # l' = l*exp(m - m_f) + exp(s - m_f) with m_f = max(m, s).
+            # Row r's sink is its query head's (g = r % Gp; rows are
+            # (t, g) lexicographic). Select it with a one-hot dot —
+            # gather/relayout-free in Mosaic; sink_ref is [Gp, 128]
+            # lane-broadcast so the product lands as [rows, 128].
+            rows = q_tile * group
+            g_of_row = jax.lax.broadcasted_iota(
+                jnp.int32, (rows, group), 0
+            ) % group
+            col = jax.lax.broadcasted_iota(jnp.int32, (rows, group), 1)
+            oh = (col == g_of_row).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                oh, sink_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[:, 0:1]
+            m = m_scr[:, 0:1]
+            m_f = jnp.maximum(m, s)
+            l = l * jnp.exp(m - m_f) + jnp.exp(s - m_f)
+            acc = acc_scr[...] * jnp.exp(m - m_f)
+        else:
+            acc = acc_scr[...]
+        l = jnp.maximum(l, 1e-20)
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -341,6 +368,7 @@ def paged_prefill_attention(
     scale: float,
     pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
     window: int = 0,  # sliding attention width; 0 = full
+    sinks: jnp.ndarray | None = None,  # [H] gpt-oss sink logits
     interpret: bool = False,
 ) -> jnp.ndarray:  # [T, H, D]
     """Flash-style chunked-prefill attention over the paged cache.
@@ -395,6 +423,18 @@ def paged_prefill_attention(
     page_spec = [
         pl.BlockSpec((1, 1, bs, D), page_index(p)) for p in range(P)
     ]
+    sink_inputs, sink_specs = (), ()
+    if sinks is not None:
+        # [H] -> [Hkv, Gp, 128] f32 lane-broadcast; padded group lanes
+        # at a large FINITE negative (their exp underflows to 0 — -inf
+        # would produce 0*inf NaNs in the one-hot dot)
+        s = sinks.astype(jnp.float32).reshape(Hkv, G)
+        s = jnp.pad(s, ((0, 0), (0, Gp - G)), constant_values=-1e30)
+        s = jnp.broadcast_to(s[:, :, None], (Hkv, Gp, 128))
+        sink_inputs = (s,)
+        sink_specs = (
+            pl.BlockSpec((1, Gp, 128), lambda j, h, i, bt, hist: (h, 0, 0)),
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(nT, Hkv, M // P),
@@ -402,6 +442,7 @@ def paged_prefill_attention(
             pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
             *page_spec,
             *page_spec,
+            *sink_specs,
         ],
         out_specs=pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
         scratch_shapes=[
@@ -412,7 +453,7 @@ def paged_prefill_attention(
     )
     kernel = functools.partial(
         _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp,
-        pages_per_step=P, window=window,
+        pages_per_step=P, window=window, has_sinks=sinks is not None,
     )
     out = pl.pallas_call(
         kernel,
@@ -428,6 +469,6 @@ def paged_prefill_attention(
         ),
         interpret=interpret,
     )(jnp.asarray(block_table), jnp.asarray(history_len, jnp.int32).reshape(1),
-      qg, *([k_cache_layer] * P), *([v_cache_layer] * P))
+      qg, *([k_cache_layer] * P), *([v_cache_layer] * P), *sink_inputs)
     out = out.reshape(Hkv, nT, Tq, Gp, D).transpose(1, 2, 0, 3, 4)
     return out.reshape(Tpad, Hkv, Gp, D)[:T, :, :G, :].reshape(T, H, D)

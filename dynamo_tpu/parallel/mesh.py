@@ -87,11 +87,16 @@ _PARAM_SPECS = {
     "layers.k_norm": P("pp", None),
     # gpt-oss: per-head attention sinks, o-projection bias, router logit
     # bias, per-expert projection biases (expert axis over ep)
-    "layers.sinks": P("pp", None),
+    # sinks are per query head: shard with the head axis the attention
+    # shard_maps split (their P("tp") operand spec)
+    "layers.sinks": P("pp", "tp"),
     "layers.bo": P("pp", None),
     "layers.moe_router_bias": P("pp", None),
-    "layers.be_gate": P("pp", "ep", None),
-    "layers.be_up": P("pp", "ep", None),
+    # gate/up biases live on the Fm axis that tp shards (the ragged
+    # shard_map adds them to tp-local activations); be_down replicates
+    # its E axis like we_down's output
+    "layers.be_gate": P("pp", "ep", "tp"),
+    "layers.be_up": P("pp", "ep", "tp"),
     "layers.be_down": P("pp", "ep", None),
     "layers.w_gate": P("pp", None, "tp"),  # column: hidden
     "layers.w_up": P("pp", None, "tp"),

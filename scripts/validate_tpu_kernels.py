@@ -254,5 +254,40 @@ except Exception as e:  # noqa: BLE001
     print(f"INFO fp8-cache append kernel not lowerable: "
           f"{type(e).__name__}: {e}"[:300], flush=True)
 
+# 8. gpt-oss geometry (head_dim=64, sinks, sliding window) through the
+# COMPILED kernels: the engine gate admits D%64 and sinks now, with
+# _pallas_guard degrading to XLA if Mosaic rejects the sub-128 lane
+# tiles — a rejection here is INFO (the guard handles it in serving),
+# but a wrong-NUMBERS lowering must fail the run.
+D64 = 64
+ks3 = jax.random.split(jax.random.key(7), 4)
+q64 = jax.random.normal(ks3[0], (B, H, D64), jnp.bfloat16)
+kc64 = jax.random.normal(ks3[1], (Hkv, N, bs, D64), jnp.bfloat16)
+vc64 = jax.random.normal(ks3[2], (Hkv, N, bs, D64), jnp.bfloat16)
+sinks64 = jax.random.normal(ks3[3], (H,), jnp.float32)
+scale64 = D64**-0.5
+for name, window, snk in (
+    ("d64 plain", 0, None),
+    ("d64 window", 10, None),
+    ("d64 sinks", 0, sinks64),
+    ("d64 sinks+window", 10, sinks64),
+):
+    try:
+        ref = decode_attention_xla(
+            q64, kc64, vc64, tables, seq_lens, scale64, window=window,
+            sinks=snk,
+        )
+        from dynamo_tpu.ops.attention import decode_attention
+
+        got = decode_attention(
+            q64, kc64, vc64, tables, seq_lens, scale64, use_pallas=True,
+            window=window, sinks=snk,
+        )
+        check(f"decode kernel {name}", got, ref)
+    except Exception as e:  # noqa: BLE001 — Mosaic rejection = guard path
+        print(f"INFO decode kernel {name} not lowerable "
+              f"(engine guard degrades to XLA): {type(e).__name__}: {e}"[:300],
+              flush=True)
+
 print("ALL PASS" if ok else "FAILURES", flush=True)
 sys.exit(0 if ok else 1)

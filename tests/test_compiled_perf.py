@@ -76,10 +76,21 @@ def _cache_shape_res(*caches):
 
 
 def _full_cache_scatters(text, shape_res):
+    """Scatter ops whose type signature touches a full-cache shape. The
+    stablehlo.scatter op prints MULTI-LINE (its update-computation region
+    sits between the op name and the trailing type signature), so the
+    detector scans a bounded window after each occurrence rather than a
+    single line."""
     hits = []
-    for line in text.splitlines():
-        if "stablehlo.scatter" in line and any(s in line for s in shape_res):
-            hits.append(line.strip()[:160])
+    idx = 0
+    while True:
+        i = text.find("stablehlo.scatter", idx)
+        if i < 0:
+            break
+        window = text[i : i + 4000]
+        if any(s in window for s in shape_res):
+            hits.append(window.split("\n", 1)[0][:160])
+        idx = i + 1
     return hits
 
 
