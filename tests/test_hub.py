@@ -110,8 +110,10 @@ def test_full_serving_over_hub(run):
         out = await collect(await client.round_robin(Context({"text": "tpu"})))
         assert [a.data["token"] for a in out] == ["t", "p", "u"]
 
-        # hub-side session cleanup: dropping the worker connection revokes
-        # its lease -> discovery removes the instance
+        # clean shutdown revokes the worker's lease EXPLICITLY ->
+        # discovery removes the instance at once (an unclean death would
+        # instead expire by TTL — sessions no longer revoke on
+        # disconnect, so reconnecting clients keep their keys)
         await worker.shutdown()
         await wconn.close()
         await asyncio.sleep(0.1)
@@ -120,5 +122,142 @@ def test_full_serving_over_hub(run):
         await front.shutdown()
         await fconn.close()
         await hub.close()
+
+    run(main())
+
+
+def test_store_persistence_roundtrip(tmp_path, run):
+    """Snapshot+WAL: KV and leases survive a store restart; restored
+    leases restart their TTL clock (downtime is not liveness time);
+    torn WAL tail lines are tolerated."""
+    from dynamo_tpu.runtime.store import LocalStore
+
+    async def main():
+        d = str(tmp_path)
+        s1 = LocalStore(data_dir=d)
+        lease = s1.grant_lease(5.0)
+        s1.kv_put("disc/w1", b"addr1", lease)
+        s1.kv_put("cfg/x", b"42")
+        s1.kv_put("cfg/y", b"dead")
+        s1.kv_delete("cfg/y")
+        dead = s1.grant_lease(5.0)
+        s1.kv_put("disc/w2", b"addr2", dead)
+        s1.revoke_lease(dead)
+        # crash: no clean close/snapshot — restore replays the WAL,
+        # including a torn final line
+        s1._wal.write('{"op":"put","k":"torn"')
+        s1._wal.flush()
+
+        s2 = LocalStore(data_dir=d)
+        assert s2.kv_get("disc/w1").value == b"addr1"
+        assert s2.kv_get("disc/w1").lease_id == lease
+        assert s2.kv_get("cfg/x").value == b"42"
+        assert s2.kv_get("cfg/y") is None
+        assert s2.kv_get("disc/w2") is None  # died with its lease
+        assert s2.kv_get("torn") is None
+        # the restored lease is alive with a fresh deadline
+        assert s2.keep_alive(lease)
+        # ids never collide with restored state — including the REVOKED
+        # lease's id, which must stay burned (a stale holder of it would
+        # otherwise control a new client's lease)
+        assert s2.grant_lease(1.0) > max(lease, dead)
+        # expiry still works post-restore
+        s2._leases[lease].deadline = 0.0
+        s2.expire_leases()
+        assert s2.kv_get("disc/w1") is None
+        await s2.close()
+        # clean close compacted: a third open sees the same state
+        s3 = LocalStore(data_dir=d)
+        assert s3.kv_get("cfg/x").value == b"42"
+        assert s3.kv_get("disc/w1") is None
+        await s3.close()
+
+    run(main())
+
+
+def test_hub_restart_mid_serving(tmp_path, run):
+    """VERDICT r3 #5 e2e: kill + restart the hub (same port, same
+    data_dir) while a worker and frontend stay up — the next request
+    must succeed WITHOUT restarting either: clients redial, the session
+    (subscriptions, watches) re-establishes, the durable store revived
+    the worker's lease and registration."""
+
+    async def main():
+        hub = HubServer(data_dir=str(tmp_path))
+        await hub.start()
+        port = int(hub.address.rsplit(":", 1)[1])
+        ws, wb, wconn = await connect_hub(hub.address)
+        fs, fb, fconn = await connect_hub(hub.address)
+        worker = await DistributedRuntime.from_settings(store=ws, bus=wb)
+        front = await DistributedRuntime.from_settings(store=fs, bus=fb)
+        await worker.namespace("ns").component("gen").endpoint("g").serve(
+            EchoEngine()
+        )
+        client = (
+            await front.namespace("ns").component("gen").endpoint("g")
+            .client().start()
+        )
+        await client.wait_for_instances(5)
+        out = await collect(await client.round_robin(Context({"text": "aa"})))
+        assert len(out) == 2
+
+        await hub.close()  # the bounce: every client connection drops
+        hub2 = HubServer(data_dir=str(tmp_path), port=port)
+        await hub2.start()
+
+        # the clients' reconnect loops redial + rebuild; first request
+        # may race the rebuild, so poll briefly
+        deadline = asyncio.get_running_loop().time() + 10.0
+        last = None
+        while True:
+            try:
+                out = await asyncio.wait_for(
+                    collect(await client.round_robin(Context({"text": "tpu"}))),
+                    timeout=3.0,
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — retried until deadline
+                last = e
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"request never succeeded after hub restart: {last}"
+                    )
+                await asyncio.sleep(0.3)
+        assert [a.data["token"] for a in out] == ["t", "p", "u"]
+        # discovery stayed intact (no re-registration happened)
+        assert client.instance_ids() != []
+
+        await worker.shutdown()
+        await front.shutdown()
+        await wconn.close()
+        await fconn.close()
+        await hub2.close()
+
+    run(main())
+
+
+def test_store_wal_replay_lease_migration(tmp_path, run):
+    """A key re-registered under a NEW lease within one WAL generation:
+    after restore, the OLD lease's expiry must not delete it (the replay
+    has to detach the key from its previous owner, like live kv_put)."""
+    from dynamo_tpu.runtime.store import LocalStore
+
+    async def main():
+        d = str(tmp_path)
+        s1 = LocalStore(data_dir=d)
+        a = s1.grant_lease(5.0)
+        b = s1.grant_lease(5.0)
+        s1.kv_put("disc/w", b"via-a", a)
+        s1.kv_put("disc/w", b"via-b", b)  # re-registration: b owns it now
+
+        s2 = LocalStore(data_dir=d)  # crash-restore (WAL replay)
+        s2._leases[a].deadline = 0.0  # a's owner never returns
+        s2.expire_leases()
+        assert s2.kv_get("disc/w").value == b"via-b"
+        assert s2.kv_get("disc/w").lease_id == b
+        s2._leases[b].deadline = 0.0
+        s2.expire_leases()
+        assert s2.kv_get("disc/w") is None
+        await s2.close()
 
     run(main())
