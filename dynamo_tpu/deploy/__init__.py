@@ -12,7 +12,12 @@ dynamodeployment_types.go:28). Two execution paths:
   * **controller** — a live reconcile loop (the operator-controller
     equivalent, dynamonimdeployment_controller.go) for TPU-VM hosts: it
     converges specs into child processes with crash-restart backoff,
-    queue-depth autoscaling, and a status subresource.
+    queue-depth autoscaling, and a status subresource;
+  * **kube** — the cluster-API reconciler: renders each stored spec and
+    APPLIES it through a KubeApi client (create / drift-revert / prune),
+    aggregating live readiness back into the status subresource — the
+    operator's Reconcile() role against a real (or fake, in tests)
+    Kubernetes API.
 """
 
 from .api_server import ApiServer
@@ -24,11 +29,14 @@ from .crd import (
     Resources,
     ServiceDeploymentSpec,
 )
+from .kube import FakeKubeApi, KubeReconciler
 from .manifests import render_manifests, to_yaml
 
 __all__ = [
     "ApiServer",
     "DeploymentController",
+    "FakeKubeApi",
+    "KubeReconciler",
     "Autoscaling",
     "DynamoDeployment",
     "Resources",

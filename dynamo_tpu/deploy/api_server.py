@@ -14,6 +14,8 @@ needs, with no database dependency.
   PUT    /api/v1/deployments/{name}
   DELETE /api/v1/deployments/{name}
   GET    /api/v1/deployments/{name}/manifests  (YAML stream, text/yaml)
+  GET    /api/v1/deployments/{name}/revisions  (append-only spec history)
+  POST   /api/v1/deployments/{name}/rollback   ({"revision": N})
   GET    /api/v1/artifacts
   POST   /api/v1/artifacts                     (raw tar.gz body -> digest)
   GET    /api/v1/artifacts/{digest}
@@ -71,16 +73,83 @@ class DeploymentStore:
         if not create and not os.path.exists(path):
             raise HttpError(404, f"deployment {name!r} not found", "not_found")
         self._atomic_write(path, spec)
+        self._append_revision(name, spec)
+
+    # ---- revisions (ref api-server routes.go:339 revision model) ----
+
+    def _rev_path(self, name: str) -> str:
+        return self._path(name) + ".revisions.jsonl"
+
+    def _last_revision(self, name: str) -> Optional[dict]:
+        """Parse only the FINAL line (the append path must not re-parse
+        the whole history per PUT)."""
+        try:
+            with open(self._rev_path(name), "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - (1 << 20)))
+                tail = f.read().splitlines()
+        except FileNotFoundError:
+            return None
+        for ln in reversed(tail):
+            if ln.strip():
+                return json.loads(ln)
+        return None
+
+    def _append_revision(self, name: str, spec: dict) -> int:
+        """Every accepted spec CHANGE appends an immutable revision —
+        the rollback target set. A rollback itself appends a NEW
+        revision (history is linear and append-only, like the
+        reference's deployment revisions). Idempotent re-PUTs of the
+        same spec (the standard reconciler pattern) append nothing."""
+        import time
+
+        last = self._last_revision(name)
+        if last is not None and last["spec"] == spec:
+            return last["revision"]
+        n = (last["revision"] + 1) if last else 1
+        with open(self._rev_path(name), "a") as f:
+            json.dump(
+                {"revision": n, "spec": spec,
+                 "created_at": time.strftime(
+                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+                f,
+            )
+            f.write("\n")
+        return n
+
+    def list_revisions(self, name: str) -> list[dict]:
+        try:
+            with open(self._rev_path(name)) as f:
+                return [json.loads(ln) for ln in f if ln.strip()]
+        except FileNotFoundError:
+            return []
+
+    def rollback(self, name: str, revision: int) -> dict:
+        """Reinstate an earlier revision's spec as the current one."""
+        current = self.get(name)  # 404 on unknown deployment
+        for rev in self.list_revisions(name):
+            if rev["revision"] == revision:
+                spec = rev["spec"]
+                if spec == current:
+                    return spec  # no-op rollback: don't append noise
+                self._atomic_write(self._path(name), spec)
+                self._append_revision(name, spec)
+                return spec
+        raise HttpError(
+            404, f"deployment {name!r} has no revision {revision}", "not_found"
+        )
 
     def delete(self, name: str) -> None:
         try:
             os.unlink(self._path(name))
         except FileNotFoundError:
             raise HttpError(404, f"deployment {name!r} not found", "not_found") from None
-        try:
-            os.unlink(self._path(name) + ".status")
-        except FileNotFoundError:
-            pass
+        for suffix in (".status", ".revisions.jsonl"):
+            try:
+                os.unlink(self._path(name) + suffix)
+            except FileNotFoundError:
+                pass
 
     # ---- status subresource (written by the reconcile controller) ----
 
@@ -170,6 +239,21 @@ class ApiServer(HttpServerBase):
                 await self._send_json(
                     writer, 200, self.store.get_status(rest[1]) or {}
                 )
+            elif method == "GET" and len(rest) == 3 and rest[2] == "revisions":
+                self.store.get(rest[1])  # 404 on unknown deployment
+                await self._send_json(
+                    writer, 200,
+                    {"revisions": self.store.list_revisions(rest[1])},
+                )
+            elif method == "POST" and len(rest) == 3 and rest[2] == "rollback":
+                try:
+                    revision = int(json.loads(body)["revision"])
+                except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                    raise HttpError(
+                        422, 'rollback body must be {"revision": N}'
+                    ) from None
+                spec = self.store.rollback(rest[1], revision)
+                await self._send_json(writer, 200, spec)
             elif method == "GET" and len(rest) == 3 and rest[2] == "manifests":
                 dep = DynamoDeployment.from_dict(self.store.get(rest[1]))
                 yaml_text = to_yaml(render_manifests(dep))

@@ -1,0 +1,202 @@
+"""Kube reconciler: manifests applied + drift reconciled against a fake
+cluster API, and api-server revisions with rollback (VERDICT r3 missing
+#2/#3; ref dynamonimdeployment_controller.go:136, routes.go:339). The
+e2e drives one deployment through create -> scale -> crash -> drift ->
+rollback -> delete with a single-stepped reconcile loop."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.deploy import DynamoDeployment, ServiceDeploymentSpec
+from dynamo_tpu.deploy.api_server import DeploymentStore
+from dynamo_tpu.deploy.kube import FakeKubeApi, KubeReconciler
+from dynamo_tpu.http.base import HttpError
+
+
+def _dep(name="d1", replicas=2, image="dynamo-tpu:latest"):
+    return DynamoDeployment(
+        name=name, image=image,
+        services=[
+            ServiceDeploymentSpec(name="worker", replicas=replicas),
+            ServiceDeploymentSpec(name="frontend", replicas=1, http_port=8080),
+        ],
+    )
+
+
+@pytest.fixture
+def setup(tmp_path):
+    store = DeploymentStore(str(tmp_path))
+    api = FakeKubeApi()
+    rec = KubeReconciler(store, api)
+    return store, api, rec
+
+
+def test_create_applies_manifest_set(setup):
+    store, api, rec = setup
+    store.put("d1", _dep().to_dict(), create=True)
+    rec.reconcile_once()
+    kinds = sorted((k, n) for k, _, n in (
+        FakeKubeApi._key(o) for o in api.list()))
+    # hub Deployment+Service, worker Deployment, frontend Deployment+Service
+    assert ("Deployment", "d1-hub") in kinds
+    assert ("Deployment", "d1-worker") in kinds
+    assert ("Deployment", "d1-frontend") in kinds
+    dep = api.get("Deployment", "default", "d1-worker")
+    assert dep["spec"]["replicas"] == 2
+    status = store.get_status("d1")
+    assert status["phase"] == "Progressing"  # nothing ready yet
+    assert status["services"]["d1-worker"]["desired"] == 2
+
+
+def test_reconcile_is_idempotent(setup):
+    store, api, rec = setup
+    store.put("d1", _dep().to_dict(), create=True)
+    rec.reconcile_once()
+    n_actions = len(api.actions)
+    rec.reconcile_once()
+    rec.reconcile_once()
+    assert len(api.actions) == n_actions, (
+        "steady state must not re-apply unchanged objects"
+    )
+
+
+def test_scale_and_ready_status(setup):
+    store, api, rec = setup
+    store.put("d1", _dep(replicas=2).to_dict(), create=True)
+    rec.reconcile_once()
+    store.put("d1", _dep(replicas=3).to_dict(), create=False)
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-worker")["spec"]["replicas"] == 3
+    # kubelet-side readiness flows back into the status subresource
+    for name in ("d1-worker", "d1-frontend", "d1-hub"):
+        obj = api.get("Deployment", "default", name)
+        api.set_status("Deployment", "default", name,
+                       {"readyReplicas": obj["spec"]["replicas"]})
+    rec.reconcile_once()
+    assert store.get_status("d1")["phase"] == "Ready"
+
+
+def test_crash_recreated_and_drift_reverted(setup):
+    store, api, rec = setup
+    store.put("d1", _dep().to_dict(), create=True)
+    rec.reconcile_once()
+    # crash: the object vanishes from the cluster
+    api.delete("Deployment", "default", "d1-worker")
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-worker") is not None
+    # drift: a kubectl edit changes the image out of band
+    api.mutate(
+        "Deployment", "default", "d1-worker",
+        lambda o: o["spec"]["template"]["spec"]["containers"][0]
+        .__setitem__("image", "rogue:v9"),
+    )
+    rec.reconcile_once()
+    img = (api.get("Deployment", "default", "d1-worker")
+           ["spec"]["template"]["spec"]["containers"][0]["image"])
+    assert img == "dynamo-tpu:latest"
+    # status writes alone must NOT trigger re-apply (field ownership)
+    api.set_status("Deployment", "default", "d1-worker", {"readyReplicas": 1})
+    n = len(api.actions)
+    rec.reconcile_once()
+    assert len(api.actions) == n
+
+
+def test_delete_prunes_managed_objects(setup):
+    store, api, rec = setup
+    store.put("d1", _dep().to_dict(), create=True)
+    # an unmanaged bystander object must never be pruned
+    api.apply({"kind": "Deployment", "apiVersion": "apps/v1",
+               "metadata": {"name": "other", "namespace": "default"},
+               "spec": {"replicas": 1}})
+    rec.reconcile_once()
+    store.delete("d1")
+    rec.reconcile_once()
+    assert [FakeKubeApi._key(o) for o in api.list()] == [
+        ("Deployment", "default", "other")
+    ]
+
+
+def test_removed_service_objects_are_deleted(setup):
+    store, api, rec = setup
+    store.put("d1", _dep().to_dict(), create=True)
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-frontend") is not None
+    solo = DynamoDeployment(
+        name="d1", services=[ServiceDeploymentSpec(name="worker", replicas=2)]
+    )
+    store.put("d1", solo.to_dict(), create=False)
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-frontend") is None
+    assert api.get("Service", "default", "d1-frontend") is None
+    assert api.get("Deployment", "default", "d1-worker") is not None
+
+
+def test_revisions_and_rollback(setup):
+    store, api, rec = setup
+    store.put("d1", _dep(replicas=2).to_dict(), create=True)
+    store.put("d1", _dep(replicas=5).to_dict(), create=False)
+    revs = store.list_revisions("d1")
+    assert [r["revision"] for r in revs] == [1, 2]
+    assert revs[0]["spec"]["services"][0]["replicas"] == 2
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-worker")["spec"]["replicas"] == 5
+
+    # rollback reinstates revision 1 AND appends revision 3
+    spec = store.rollback("d1", 1)
+    assert spec["services"][0]["replicas"] == 2
+    assert [r["revision"] for r in store.list_revisions("d1")] == [1, 2, 3]
+    rec.reconcile_once()
+    assert api.get("Deployment", "default", "d1-worker")["spec"]["replicas"] == 2
+
+    with pytest.raises(HttpError):
+        store.rollback("d1", 99)
+    # no-op rollback (same spec) appends nothing
+    store.rollback("d1", 1)
+    assert len(store.list_revisions("d1")) == 3
+
+
+def test_rollback_http_routes(tmp_path, run):
+    """The REST surface: revisions listing + rollback through real HTTP."""
+    import asyncio
+
+    from dynamo_tpu.deploy.api_server import ApiServer
+    from tests.test_http_service import http_request
+
+    async def main():
+        srv = ApiServer(str(tmp_path), port=0)
+        await srv.start()
+        d = _dep(replicas=1).to_dict()
+        st, _, _ = await http_request(
+            srv.port, "POST", "/api/v1/deployments", json.dumps(d).encode()
+        )
+        assert st == 201
+        d2 = _dep(replicas=4).to_dict()
+        st, _, _ = await http_request(
+            srv.port, "PUT", "/api/v1/deployments/d1", json.dumps(d2).encode()
+        )
+        assert st == 200
+        st, _, body = await http_request(
+            srv.port, "GET", "/api/v1/deployments/d1/revisions"
+        )
+        assert st == 200
+        revs = json.loads(body)["revisions"]
+        assert [r["revision"] for r in revs] == [1, 2]
+        st, _, body = await http_request(
+            srv.port, "POST", "/api/v1/deployments/d1/rollback",
+            json.dumps({"revision": 1}).encode(),
+        )
+        assert st == 200
+        assert json.loads(body)["services"][0]["replicas"] == 1
+        st, _, body = await http_request(
+            srv.port, "GET", "/api/v1/deployments/d1"
+        )
+        assert json.loads(body)["services"][0]["replicas"] == 1
+        st, _, _ = await http_request(
+            srv.port, "POST", "/api/v1/deployments/d1/rollback",
+            json.dumps({"revision": 77}).encode(),
+        )
+        assert st == 404
+        await srv.close()
+
+    run(main())
