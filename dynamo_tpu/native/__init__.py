@@ -52,6 +52,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dn_pi_find_matches.argtypes = [
         ctypes.c_void_p, p(u64), i32, p(u64), p(ctypes.c_uint32), i32, p(i32),
     ]
+    # foreign-engine KV-event C ABI (kv_events_c.cc; ref
+    # lib/bindings/c/src/lib.rs:51-90) — bound here so tests can drive
+    # the ABI exactly as an external C++ engine would
+    cp = ctypes.c_char_p
+    lib.dn_kv_init.restype = ctypes.c_void_p
+    lib.dn_kv_init.argtypes = [cp, i32, cp, cp, i64, i32]
+    lib.dn_kv_publish_stored.restype = i32
+    lib.dn_kv_publish_stored.argtypes = [
+        ctypes.c_void_p, p(i64), p(ctypes.c_int32), p(u64), i32, p(u64),
+    ]
+    lib.dn_kv_publish_removed.restype = i32
+    lib.dn_kv_publish_removed.argtypes = [ctypes.c_void_p, p(u64), i32]
+    lib.dn_kv_shutdown.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -87,6 +100,7 @@ def build(force: bool = False) -> bool:
             "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
             os.path.join(_NATIVE_DIR, "blake2b.cc"),
             os.path.join(_NATIVE_DIR, "dynamo_native.cc"),
+            os.path.join(_NATIVE_DIR, "kv_events_c.cc"),
             "-o", out,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

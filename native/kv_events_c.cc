@@ -1,0 +1,301 @@
+// C ABI for FOREIGN-ENGINE KV-cache event publication.
+//
+// TPU-native equivalent of the reference's C bindings
+// (lib/bindings/c/src/lib.rs:51-90: dynamo_llm_init +
+// dynamo_kv_event_publish_stored/removed), which let an external C++
+// engine feed its KV cache stored/removed events into the KV router's
+// event plane. The reference embeds its whole Rust runtime behind the C
+// API; this implementation embeds the minimal thing a foreign engine
+// actually needs — a hub bus client: one blocking TCP connection
+// speaking the two-part codec (runtime/codec.py framing), publishing
+// RouterEvent JSON on the component's kv_events subject.
+//
+// Hash interop: the router's index matches on CHAINED sequence hashes
+// (engine/allocator.py chain_hash), so the library computes them HERE
+// from the block tokens with the same blake2b the Python engine uses
+// (dynamo_native.cc) — the caller's block_ids are the engine's own
+// EXTERNAL identifiers, kept in a per-handle external->chained map so
+// removals and parent linkage can be expressed in the engine's ids
+// (exactly the external-hash/tokens-hash split of the reference's
+// KvCacheStoredBlockData). Foreign-published blocks therefore index
+// bit-identically with natively-published ones.
+//
+// Thread safety: one mutex per handle; external engine threads may call
+// publish concurrently (the reference's API contract). Each publish is
+// a synchronous round trip — the hub replies per request, and an unread
+// reply stream would eventually block the hub session's writer.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+extern "C" {
+uint64_t dn_block_token_hash(const int64_t* tokens, int n);
+uint64_t dn_chain_hash(uint64_t parent, uint64_t local);
+}
+
+namespace {
+
+struct KvHandle {
+  int fd = -1;
+  std::string subject;
+  int64_t worker_id = 0;
+  int block_size = 0;
+  uint64_t next_req = 1;
+  uint64_t next_event = 1;
+  // the engine's external block ids -> the chained hashes we published
+  std::unordered_map<uint64_t, uint64_t> ext2chain;
+  std::mutex mu;
+};
+
+// codec.py: magic(2B) | flags(1B) | header_len(u32 BE) | data_len(u64 BE)
+constexpr uint8_t kMagic0 = 0xD7, kMagic1 = 0x70;
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// one send() per frame: three small writes would sit behind Nagle +
+// the peer's delayed ACK on every synchronous round trip
+bool write_frame(int fd, const std::string& header, const std::string& data) {
+  std::string frame;
+  frame.reserve(15 + header.size() + data.size());
+  frame.push_back(static_cast<char>(kMagic0));
+  frame.push_back(static_cast<char>(kMagic1));
+  frame.push_back(0);  // flags
+  uint32_t hl = static_cast<uint32_t>(header.size());
+  uint64_t dl = data.size();
+  for (int i = 0; i < 4; ++i)
+    frame.push_back(static_cast<char>(hl >> (24 - 8 * i)));
+  for (int i = 0; i < 8; ++i)
+    frame.push_back(static_cast<char>(dl >> (56 - 8 * i)));
+  frame += header;
+  frame += data;
+  return send_all(fd, frame.data(), frame.size());
+}
+
+// read one reply frame; returns false on transport failure, fills the
+// header so the caller can detect a hub-side error reply
+bool read_frame(int fd, std::string* header_out) {
+  uint8_t prefix[15];
+  if (!recv_all(fd, prefix, sizeof prefix)) return false;
+  if (prefix[0] != kMagic0 || prefix[1] != kMagic1) return false;
+  uint32_t hl = 0;
+  uint64_t dl = 0;
+  for (int i = 0; i < 4; ++i) hl = (hl << 8) | prefix[3 + i];
+  for (int i = 0; i < 8; ++i) dl = (dl << 8) | prefix[7 + i];
+  if (hl > (16u << 20) || dl > (1ull << 30)) return false;
+  header_out->resize(hl);
+  if (hl && !recv_all(fd, header_out->data(), hl)) return false;
+  std::string sink;
+  sink.resize(dl);
+  return dl == 0 || recv_all(fd, sink.data(), sink.size());
+}
+
+// subjects go through Python's slug(): [^a-zA-Z0-9_-]+ -> "_"
+std::string slug(const char* s) {
+  std::string out;
+  bool in_bad = false;
+  for (const char* p = s; *p; ++p) {
+    bool ok = (*p >= 'a' && *p <= 'z') || (*p >= 'A' && *p <= 'Z') ||
+              (*p >= '0' && *p <= '9') || *p == '_' || *p == '-';
+    if (ok) {
+      out.push_back(*p);
+      in_bad = false;
+    } else if (!in_bad) {
+      out.push_back('_');
+      in_bad = true;
+    }
+  }
+  return out;
+}
+
+bool publish(KvHandle* h, const std::string& event_json) {
+  char header[512];
+  int n = std::snprintf(
+      header, sizeof header,
+      "{\"op\": \"publish\", \"subject\": \"%s\", \"headers\": null, "
+      "\"reply\": null, \"id\": %llu}",
+      h->subject.c_str(),
+      static_cast<unsigned long long>(h->next_req++));
+  if (n <= 0 || n >= static_cast<int>(sizeof header)) return false;
+  std::string reply;
+  if (!write_frame(h->fd, std::string(header, n), event_json) ||
+      !read_frame(h->fd, &reply)) {
+    return false;
+  }
+  // a hub-side dispatch failure replies {"op": "reply", ..., "error":
+  // ...}; swallowing it would let the router silently diverge from the
+  // engine's cache state
+  return reply.find("\"error\"") == std::string::npos;
+}
+
+void append_u64(std::string& out, uint64_t v) {
+  char tmp[24];
+  out.append(tmp, std::snprintf(tmp, sizeof tmp, "%llu",
+                                static_cast<unsigned long long>(v)));
+}
+
+void append_i64(std::string& out, int64_t v) {
+  char tmp[24];
+  out.append(tmp,
+             std::snprintf(tmp, sizeof tmp, "%lld", static_cast<long long>(v)));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to the hub and bind the publisher to one component's
+// kv_events subject (ref dynamo_llm_init). Returns an opaque handle or
+// null on failure.
+void* dn_kv_init(const char* host, int port, const char* ns,
+                 const char* component, int64_t worker_id,
+                 int kv_block_size) {
+  if (!host || !ns || !component || kv_block_size <= 0) return nullptr;
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (::getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) return nullptr;
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;  // sync round trips: don't let Nagle gate the replies
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  auto* h = new KvHandle;
+  h->fd = fd;
+  h->subject = slug(ns) + "." + slug(component) + ".kv_events";
+  h->worker_id = worker_id;
+  h->block_size = kv_block_size;
+  return h;
+}
+
+// Publish a stored event (ref dynamo_kv_event_publish_stored):
+// block_ids are the engine's own EXTERNAL block identifiers; the
+// published block_hash per block is the blake2b CHAINED sequence hash
+// computed here from the tokens (seeded by parent_hash, an external id
+// of a block previously stored through this handle, or null for a
+// chain head) — that is what the router's index matches on.
+// Like the reference, the FIRST block shorter than kv_block_size stops
+// publication: it and everything after it are dropped (a partial block
+// can't carry a stable content hash). Returns 0 on ok.
+int dn_kv_publish_stored(void* handle, const int64_t* token_ids,
+                         const int32_t* num_block_tokens,
+                         const uint64_t* block_ids, int num_blocks,
+                         const uint64_t* parent_hash) {
+  auto* h = static_cast<KvHandle*>(handle);
+  if (!h || h->fd < 0 || num_blocks < 0) return 1;
+  std::lock_guard<std::mutex> lock(h->mu);
+  uint64_t prev = 0;
+  if (parent_hash) {
+    auto it = h->ext2chain.find(*parent_hash);
+    // unknown external parent (stored before this handle existed):
+    // treat the value as an already-chained hash
+    prev = it != h->ext2chain.end() ? it->second : *parent_hash;
+  }
+  const uint64_t parent_chained = prev;  // seed, before the loop advances
+  std::string blocks;
+  int64_t off = 0;
+  for (int b = 0; b < num_blocks; ++b) {
+    if (num_block_tokens[b] != h->block_size) break;  // partial: stop here
+    uint64_t local = dn_block_token_hash(token_ids + off, h->block_size);
+    uint64_t chained = dn_chain_hash(prev, local);
+    h->ext2chain[block_ids[b]] = chained;
+    prev = chained;
+    off += num_block_tokens[b];
+    if (!blocks.empty()) blocks.push_back(',');
+    blocks.push_back('[');
+    append_u64(blocks, chained);
+    blocks.push_back(',');
+    append_u64(blocks, local);
+    blocks.push_back(']');
+  }
+  std::string ev = "{\"worker_id\": ";
+  append_i64(ev, h->worker_id);
+  ev += ", \"event_id\": ";
+  append_u64(ev, h->next_event++);
+  // the CHAINED parent rides the event so the indexer links this
+  // event's first block to its cross-event parent node (subtree
+  // removal relies on those child edges)
+  ev += ", \"kind\": \"stored\", \"parent_hash\": ";
+  if (parent_hash) {
+    append_u64(ev, parent_chained);
+  } else {
+    ev += "null";
+  }
+  ev += ", \"blocks\": [" + blocks + "], \"block_hashes\": []}";
+  return publish(h, ev) ? 0 : 1;
+}
+
+// Publish a removed event (ref dynamo_kv_event_publish_removed):
+// block_ids are the same external identifiers passed to stored; they
+// translate through the handle's map (unknown ids pass through as
+// already-chained hashes).
+int dn_kv_publish_removed(void* handle, const uint64_t* block_ids,
+                          int num_blocks) {
+  auto* h = static_cast<KvHandle*>(handle);
+  if (!h || h->fd < 0 || num_blocks < 0) return 1;
+  std::lock_guard<std::mutex> lock(h->mu);
+  std::string ids;
+  for (int b = 0; b < num_blocks; ++b) {
+    auto it = h->ext2chain.find(block_ids[b]);
+    uint64_t chained = it != h->ext2chain.end() ? it->second : block_ids[b];
+    if (it != h->ext2chain.end()) h->ext2chain.erase(it);
+    if (!ids.empty()) ids.push_back(',');
+    append_u64(ids, chained);
+  }
+  std::string ev = "{\"worker_id\": ";
+  append_i64(ev, h->worker_id);
+  ev += ", \"event_id\": ";
+  append_u64(ev, h->next_event++);
+  ev += ", \"kind\": \"removed\", \"parent_hash\": null, \"blocks\": [], "
+        "\"block_hashes\": [" + ids + "]}";
+  return publish(h, ev) ? 0 : 1;
+}
+
+// ref dynamo_llm_shutdown
+void dn_kv_shutdown(void* handle) {
+  auto* h = static_cast<KvHandle*>(handle);
+  if (!h) return;
+  if (h->fd >= 0) ::close(h->fd);
+  delete h;
+}
+
+}  // extern "C"
