@@ -24,7 +24,7 @@ class ModelDeploymentCard:
     display_name: str
     service_name: str
     model_path: str = ""
-    tokenizer_kind: str = "hf"  # "hf" | "byte"
+    tokenizer_kind: str = "hf"  # "hf" | "sp" (SentencePiece) | "byte"
     context_length: int = 8192
     kv_block_size: int = 16
     model_type: str = "chat"  # "chat" | "completion" | "both"
@@ -58,6 +58,12 @@ class ModelDeploymentCard:
                 cfg.get("max_position_embeddings", card.context_length)
             )
             card.dtype = cfg.get("torch_dtype", card.dtype)
+        # same file probe as llm.tokenizer.load_tokenizer (ref
+        # model_card/create.rs picks hf vs sp the same way)
+        if os.path.exists(os.path.join(path, "tokenizer.json")):
+            card.tokenizer_kind = "hf"
+        elif os.path.exists(os.path.join(path, "tokenizer.model")):
+            card.tokenizer_kind = "sp"
         return card
 
     # ---- object-store publication ----

@@ -54,6 +54,10 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0
     num_shared_experts: int = 0  # DeepSeek-style always-on experts
+    # Qwen2-MoE: one shared expert of its OWN width whose contribution
+    # is gated by sigmoid(x @ shared_expert_gate) instead of always-on
+    shared_expert_size: int = 0  # 0 = moe_intermediate * num_shared
+    shared_expert_gate: bool = False
     first_dense_layers: int = 0  # DeepSeek first_k_dense_replace
     norm_topk_prob: bool = True  # Mixtral renormalizes top-k gate probs
     # DeepSeek-V2/V3 routing variants (ref patch:3548-3560 deepseek_v2;
@@ -161,6 +165,17 @@ class ModelConfig:
             cfg.get("model_type", "").startswith("gemma")
         )
         is_gptoss = any(a.startswith("GptOss") for a in archs)
+        # qwen2moe: gated shared expert; interleaved dense layers are
+        # not implemented — reject rather than serve wrong logits
+        is_qwen2moe = any(a.startswith("Qwen2Moe") for a in archs)
+        if is_qwen2moe and (
+            cfg.get("decoder_sparse_step", 1) != 1
+            or cfg.get("mlp_only_layers")
+        ):
+            raise ValueError(
+                "qwen2moe with decoder_sparse_step != 1 or mlp_only_layers "
+                "is not supported (interleaved dense/sparse layers)"
+            )
         # gpt-oss layer_types: per-layer sliding/full alternation
         layer_windows: tuple = ()
         if is_gptoss and cfg.get("layer_types"):
@@ -193,21 +208,26 @@ class ModelConfig:
             moe_act="gptoss_clamp" if is_gptoss else "swiglu",
             o_bias=is_gptoss and bool(cfg.get("attention_bias")),
             # mixtral: num_local_experts; deepseek: n_routed_experts;
-            # qwen3moe: num_experts — the bare key is honored ONLY for
-            # Qwen3 archs, because qwen2_moe also carries it and its
-            # always-on shared expert is not implemented: that family
-            # must keep failing loudly at load, not serve garbage
+            # qwen2moe/qwen3moe: the bare num_experts key
             num_experts=cfg.get(
                 "num_local_experts",
                 cfg.get(
                     "n_routed_experts",
                     cfg.get("num_experts", 0)
-                    if any(a.startswith("Qwen3") for a in archs) else 0,
+                    if any(a.startswith(("Qwen3", "Qwen2Moe"))
+                           for a in archs) else 0,
                 ),
             ) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
-            num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            # qwen2moe: ONE gated shared expert of its own width
+            num_shared_experts=cfg.get("n_shared_experts", 0) or (
+                1 if is_qwen2moe else 0
+            ),
+            shared_expert_size=(
+                cfg.get("shared_expert_intermediate_size", 0) or 0
+            ) if is_qwen2moe else 0,
+            shared_expert_gate=is_qwen2moe,
             first_dense_layers=cfg.get("first_k_dense_replace", 0) or 0,
             norm_topk_prob=cfg.get("norm_topk_prob", True),
             # deepseek_v2/v3 (R1 = V3): sigmoid scoring + gate bias and

@@ -96,7 +96,7 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
     if moe:
         X = cfg.num_experts
         Fm = cfg.moe_intermediate_size or F
-        mk = jax.random.split(keys[5], 7)
+        mk = jax.random.split(keys[5], 8)
         layers["moe_gate"] = layer_stack(mk[0], (E, X))
         if cfg.moe_gate_bias:
             layers["moe_gate_bias"] = jnp.zeros((L, X), jnp.float32)
@@ -109,10 +109,12 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
             layers["be_up"] = layer_stack(keys[9], (X, Fm), 0.05)
             layers["be_down"] = layer_stack(keys[11], (X, E), 0.05)
         if cfg.num_shared_experts:
-            Fs = Fm * cfg.num_shared_experts
+            Fs = cfg.shared_expert_size or Fm * cfg.num_shared_experts
             layers["shared_gate"] = layer_stack(mk[4], (E, Fs))
             layers["shared_up"] = layer_stack(mk[5], (E, Fs))
             layers["shared_down"] = layer_stack(mk[6], (Fs, E))
+            if cfg.shared_expert_gate:  # qwen2moe sigmoid gate [E, 1]
+                layers["shared_egate"] = layer_stack(mk[7], (E, 1))
     else:
         layers["w_gate"] = layer_stack(keys[5], (E, F))
         layers["w_up"] = layer_stack(keys[6], (E, F))
@@ -474,9 +476,21 @@ def moe_ffn(
             out = out + (w @ lp["be_down"].astype(jnp.float32)).astype(out_dt)
     else:
         out = _moe_dense_dispatch(lp, cfg, x)
-    if "shared_gate" in lp:  # DeepSeek shared experts: always-on dense path
-        out = out + swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    if "shared_gate" in lp:
+        out = out + _shared_expert(lp, x)
     return out
+
+
+def _shared_expert(lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Shared-expert contribution: DeepSeek's is always-on; Qwen2-MoE
+    gates it per token with sigmoid(x @ shared_expert_gate)."""
+    shared = swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+    if "shared_egate" in lp:
+        g = jax.nn.sigmoid(
+            x.astype(jnp.float32) @ lp["shared_egate"].astype(jnp.float32)
+        )
+        shared = shared * g.astype(shared.dtype)
+    return shared
 
 
 def _moe_dense_dispatch(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -506,7 +520,7 @@ def moe_ffn_dense(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     """Full dense-dispatch reference (incl. shared experts) for tests."""
     out = _moe_dense_dispatch(lp, cfg, x)
     if "shared_gate" in lp:
-        out = out + swiglu(x, lp["shared_gate"], lp["shared_up"], lp["shared_down"])
+        out = out + _shared_expert(lp, x)
     return out
 
 

@@ -257,15 +257,21 @@ def load_llama_params(
                 rng, transpose=False,
             ).astype(np.float32)
         if cfg.num_shared_experts:
-            out["shared_gate"] = stack(
-                "model.layers.{i}.mlp.shared_experts.gate_proj.weight", rng
+            # DeepSeek writes plural "shared_experts", Qwen2-MoE writes
+            # singular "shared_expert" — same tensors either way
+            plural = "model.layers.{i}.mlp.shared_experts.gate_proj.weight"
+            base = (
+                "model.layers.{i}.mlp.shared_experts"
+                if has(plural.format(i=next(iter(rng))))
+                else "model.layers.{i}.mlp.shared_expert"
             )
-            out["shared_up"] = stack(
-                "model.layers.{i}.mlp.shared_experts.up_proj.weight", rng
-            )
-            out["shared_down"] = stack(
-                "model.layers.{i}.mlp.shared_experts.down_proj.weight", rng
-            )
+            out["shared_gate"] = stack(base + ".gate_proj.weight", rng)
+            out["shared_up"] = stack(base + ".up_proj.weight", rng)
+            out["shared_down"] = stack(base + ".down_proj.weight", rng)
+            if cfg.shared_expert_gate:  # qwen2moe: [1, E] -> [E, 1]
+                out["shared_egate"] = stack(
+                    "model.layers.{i}.mlp.shared_expert_gate.weight", rng
+                )
         return out
 
     kd = cfg.first_dense_layers if cfg.is_moe else 0
@@ -372,10 +378,18 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
                 "we_up": "model.layers.{i}.block_sparse_moe.experts.{x}.w3.weight",
                 "we_down": "model.layers.{i}.block_sparse_moe.experts.{x}.w2.weight",
             }
+            # plural = DeepSeek convention; singular + gate = Qwen2-MoE
+            sbase = (
+                "model.layers.{i}.mlp.shared_expert"
+                if "shared_egate" in lay
+                else "model.layers.{i}.mlp.shared_experts"
+            )
             shared_names = {
-                "shared_gate": "model.layers.{i}.mlp.shared_experts.gate_proj.weight",
-                "shared_up": "model.layers.{i}.mlp.shared_experts.up_proj.weight",
-                "shared_down": "model.layers.{i}.mlp.shared_experts.down_proj.weight",
+                "shared_gate": sbase + ".gate_proj.weight",
+                "shared_up": sbase + ".up_proj.weight",
+                "shared_down": sbase + ".down_proj.weight",
+                "shared_egate":
+                    "model.layers.{i}.mlp.shared_expert_gate.weight",
             }
             for li in range(n):
                 i = off + li

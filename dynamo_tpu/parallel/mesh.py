@@ -107,6 +107,8 @@ _PARAM_SPECS = {
     "layers.we_up": P("pp", "ep", None, "tp"),
     "layers.we_down": P("pp", "ep", "tp", None),
     "layers.shared_gate": P("pp", None, "tp"),
+    # qwen2moe sigmoid gate [L, E, 1]: tiny, replicated
+    "layers.shared_egate": P("pp", None, None),
     "layers.shared_up": P("pp", None, "tp"),
     "layers.shared_down": P("pp", "tp", None),
     # MLA (models/mla.py): the q/kv down-projections and the shared

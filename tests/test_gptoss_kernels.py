@@ -309,3 +309,52 @@ def test_gptoss_verify_window_pallas_matches_xla():
     toks_got, acc_got = run(True)
     np.testing.assert_array_equal(acc_got, acc_ref)
     np.testing.assert_array_equal(toks_got, toks_ref)
+
+
+def test_gptoss_engine_sharded_matches_unsharded(run):
+    """Engine-level: gpt-oss (sinks, alternating windows, biased clamped
+    MoE) served on an ep x tp mesh — now through the RAGGED dispatch —
+    samples the same tokens as single-device serving."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    cfg = ModelConfig.tiny(**GPTOSS_CFG)
+    params = llama.init_params(cfg, jax.random.key(31))
+    prompt = list(range(7, 25))
+
+    def _gen(engine, n=6):
+        req = PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=n),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+        return collect(engine.generate(Context(req)))
+
+    async def main():
+        ref_engine = JaxEngine(
+            EngineConfig(model=cfg, num_blocks=32, block_size=8,
+                         max_batch_size=2, max_context=64),
+            params=params,
+        )
+        ref = await _gen(ref_engine)
+        await ref_engine.close()
+        eng = JaxEngine(
+            EngineConfig(model=cfg, num_blocks=32, block_size=8,
+                         max_batch_size=2, max_context=64,
+                         mesh=MeshConfig(ep=2, tp=2)),
+            params=params,
+        )
+        out = await _gen(eng)
+        await eng.close()
+        ref_toks = [t for o in ref for t in o.token_ids]
+        out_toks = [t for o in out for t in o.token_ids]
+        assert ref_toks == out_toks and len(ref_toks) == 6
+
+    run(main())

@@ -119,6 +119,28 @@ def test_qwen3_moe_parity(tmp_path):
 
 
 @pytest.mark.skipif(
+    not hasattr(transformers, "Qwen2MoeConfig"),
+    reason="transformers too old for Qwen2-MoE",
+)
+def test_qwen2_moe_parity(tmp_path):
+    """Qwen2-MoE (Qwen1.5-MoE-A2.7B / Qwen2-57B-A14B architecture): one
+    GATED shared expert of its own width riding beside top-k routing
+    (sigmoid(x @ shared_expert_gate) scales the shared contribution),
+    qkv bias, norm_topk_prob=False."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        **TINY, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+    )
+    model = transformers.Qwen2MoeForCausalLM(hf_cfg)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.num_experts == 4 and cfg.shared_expert_gate
+    assert cfg.shared_expert_size == 96 and not cfg.norm_topk_prob
+    assert cfg.attention_bias  # qwen2 family qkv bias
+    _compare(path, TOKENS, model)
+
+
+@pytest.mark.skipif(
     not hasattr(transformers, "GptOssConfig"),
     reason="transformers too old for GPT-OSS",
 )

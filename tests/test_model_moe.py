@@ -202,3 +202,31 @@ def test_moe_engine_sharded_matches_unsharded(run, mesh_cfg):
         assert ref_toks == out_toks
 
     run(main())
+
+
+def test_qwen2moe_gated_shared_expert_sharded_matches_dense():
+    """Qwen2-MoE shape: gated shared expert (own width) + ragged routed
+    dispatch, single-device and ep x tp sharded, vs the dense reference."""
+    cfg = ModelConfig.tiny(
+        dtype="float32", num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=32, num_shared_experts=1,
+        shared_expert_size=48, shared_expert_gate=True,
+        norm_topk_prob=False,
+    )
+    params = llama.init_params(cfg, jax.random.key(9))
+    lp = {k: v[0] for k, v in params["layers"].items()}
+    assert "shared_egate" in lp
+    assert lp["shared_gate"].shape == (cfg.hidden_size, 48)
+    x = jax.random.normal(jax.random.key(10), (11, cfg.hidden_size),
+                          jnp.float32)
+    ref = np.asarray(llama.moe_ffn_dense(lp, cfg, x))
+    got = np.asarray(llama.moe_ffn(lp, cfg, x))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    mesh = make_mesh(MeshConfig(ep=2, tp=2))
+    got_sharded = np.asarray(llama.moe_ffn(lp, cfg, x, mesh=mesh))
+    np.testing.assert_allclose(got_sharded, ref, rtol=1e-4, atol=1e-4)
+    # the gate actually gates: saturating it (sigmoid -> 1, always-on)
+    # must change the output vs the learned gate values
+    lp2 = dict(lp, shared_egate=jnp.full_like(lp["shared_egate"], 1e9))
+    always_on = np.asarray(llama.moe_ffn(lp2, cfg, x))
+    assert not np.allclose(always_on, got, atol=1e-5)
