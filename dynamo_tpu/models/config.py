@@ -184,6 +184,30 @@ class ModelConfig:
                 sw if t == "sliding_attention" else 0
                 for t in cfg["layer_types"]
             )
+        # partial rotary (Phi-4-mini, GLM): rotating only a prefix of
+        # each head is not implemented — reject rather than rotate all
+        # dims and serve wrong logits
+        if (cfg.get("partial_rotary_factor") or 1.0) != 1.0:
+            raise ValueError(
+                "partial_rotary_factor != 1.0 is not supported"
+            )
+        # Phi-3 keeps original_max_position_embeddings at the TOP level
+        # of config.json; the longrope math needs it inside the scaling
+        # dict (where yarn/llama3 checkpoints put theirs)
+        rope_scaling = cfg.get("rope_scaling")
+        if (
+            rope_scaling
+            and (rope_scaling.get("rope_type") or rope_scaling.get("type"))
+            == "longrope"
+            and "original_max_position_embeddings" not in rope_scaling
+            and cfg.get("original_max_position_embeddings")
+        ):
+            rope_scaling = dict(
+                rope_scaling,
+                original_max_position_embeddings=cfg[
+                    "original_max_position_embeddings"
+                ],
+            )
         act = cfg.get("hidden_act") or cfg.get("hidden_activation") or "silu"
         if act in ("gelu", "gelu_pytorch_tanh", "gelu_tanh"):
             act = "gelu_tanh"
@@ -196,7 +220,7 @@ class ModelConfig:
             num_kv_heads=cfg.get("num_key_value_heads", cfg.get("num_attention_heads", 32)),
             head_dim=cfg.get("head_dim", 0) or 0,
             rope_theta=cfg.get("rope_theta", 10000.0),
-            rope_scaling=cfg.get("rope_scaling"),
+            rope_scaling=rope_scaling,
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),

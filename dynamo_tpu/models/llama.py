@@ -221,7 +221,26 @@ def _rope_attention_scaling(cfg: ModelConfig) -> float:
     import math
 
     scaling = cfg.rope_scaling or {}
-    if (scaling.get("rope_type") or scaling.get("type")) != "yarn":
+    kind = scaling.get("rope_type") or scaling.get("type")
+    if kind == "longrope":
+        # Phi-3: sqrt(1 + log(ctx growth)/log(orig)) on cos/sin —
+        # applied in BOTH factor regimes (HF computes it once at init).
+        af = scaling.get("attention_factor")
+        if af is not None:
+            return float(af)
+        orig = scaling.get("original_max_position_embeddings")
+        if orig:
+            factor = cfg.max_position_embeddings / orig
+            log_base = orig
+        else:
+            # no original context recorded: HF falls back to the
+            # explicit rope_scaling["factor"] over max_position
+            factor = scaling.get("factor", 1.0)
+            log_base = cfg.max_position_embeddings
+        if factor <= 1.0:
+            return 1.0
+        return math.sqrt(1.0 + math.log(factor) / math.log(log_base))
+    if kind != "yarn":
         return 1.0
     factor = scaling.get("factor", 1.0)
     af = scaling.get("attention_factor")
@@ -268,6 +287,19 @@ def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
         )
         extrap = 1.0 - ramp
         return (inv / factor) * (1 - extrap) + inv * extrap
+    if (scaling.get("rope_type") or scaling.get("type")) == "longrope":
+        # Phi-3 LongRoPE: two per-dim rescale-factor sets, selected PER
+        # POSITION at the original-context boundary (vLLM's
+        # Phi3LongRoPEScaledRotaryEmbedding semantics — the serving
+        # standard; HF instead re-ropes the WHOLE sequence when its
+        # length crosses the boundary, which an incremental KV cache
+        # cannot replay). apply_rope consumes the (stacked-sets,
+        # threshold) form.
+        orig = (scaling.get("original_max_position_embeddings")
+                or cfg.max_position_embeddings)
+        short = inv / jnp.asarray(scaling["short_factor"], jnp.float32)
+        long = inv / jnp.asarray(scaling["long_factor"], jnp.float32)
+        return (jnp.stack([short, long]), orig)
     if scaling.get("rope_type") == "llama3" or scaling.get("type") == "llama3":
         # llama-3.1 NTK-by-parts frequency remap
         factor = scaling.get("factor", 8.0)
@@ -284,11 +316,20 @@ def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
     return inv
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray,
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq,
                mscale: float = 1.0) -> jnp.ndarray:
     """x: [..., T, Hx, D] rotated at absolute positions [..., T];
-    ``mscale`` is YaRN's cos/sin attention factor (1.0 elsewhere)."""
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    ``mscale`` is the cos/sin attention factor (YaRN / LongRoPE; 1.0
+    elsewhere). ``inv_freq`` is a [D/2] array, or LongRoPE's
+    ``([2, D/2] stacked short/long sets, original-context threshold)``
+    — each position uses the set its side of the threshold, so an
+    incrementally-written KV cache stays self-consistent."""
+    if isinstance(inv_freq, tuple):
+        sets, orig = inv_freq
+        inv = jnp.where(positions[..., None] < orig, sets[0], sets[1])
+    else:
+        inv = inv_freq
+    angles = positions[..., None].astype(jnp.float32) * inv  # [..., T, D/2]
     cos = jnp.cos(angles)[..., None, :] * mscale  # [..., T, 1, D/2]
     sin = jnp.sin(angles)[..., None, :] * mscale
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)

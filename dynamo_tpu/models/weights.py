@@ -143,6 +143,16 @@ def load_llama_params(
                 "model.layers.{i}.self_attn.kv_b_proj.weight", rng
             )
             out["wo"] = stack("model.layers.{i}.self_attn.o_proj.weight", rng)
+        elif has(f"model.layers.{next(iter(rng))}.self_attn.qkv_proj.weight"):
+            # Phi-3 fuses q/k/v into one projection ([Hq+2Hkv]*D rows,
+            # q first) and gate/up likewise — split to our leaves
+            qkv = stack("model.layers.{i}.self_attn.qkv_proj.weight", rng)
+            dq = cfg.num_heads * cfg.head_dim
+            dkv = cfg.num_kv_heads * cfg.head_dim
+            out["wq"] = qkv[..., :dq]
+            out["wk"] = qkv[..., dq : dq + dkv]
+            out["wv"] = qkv[..., dq + dkv :]
+            out["wo"] = stack("model.layers.{i}.self_attn.o_proj.weight", rng)
         else:
             out["wq"] = stack("model.layers.{i}.self_attn.q_proj.weight", rng)
             out["wk"] = stack("model.layers.{i}.self_attn.k_proj.weight", rng)
@@ -174,6 +184,15 @@ def load_llama_params(
         return out
 
     def dense_ffn_leaves(rng) -> dict:
+        if has(f"model.layers.{next(iter(rng))}.mlp.gate_up_proj.weight"):
+            # Phi-3 fused gate_up ([2F, E] rows: gate then up)
+            gu = stack("model.layers.{i}.mlp.gate_up_proj.weight", rng)
+            F2 = gu.shape[-1] // 2
+            return {
+                "w_gate": gu[..., :F2],
+                "w_up": gu[..., F2:],
+                "w_down": stack("model.layers.{i}.mlp.down_proj.weight", rng),
+            }
         return {
             "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", rng),
             "w_up": stack("model.layers.{i}.mlp.up_proj.weight", rng),

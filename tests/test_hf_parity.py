@@ -176,6 +176,79 @@ def test_gptoss_parity(tmp_path):
     _compare(path, toks, model, atol=5e-4)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "Phi3Config"),
+    reason="transformers too old for Phi-3",
+)
+def test_phi3_parity(tmp_path):
+    """Phi-3: FUSED qkv_proj / gate_up_proj (the loader splits them)."""
+    hf_cfg = transformers.Phi3Config(**TINY, pad_token_id=0)
+    model = transformers.Phi3ForCausalLM(hf_cfg)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert not cfg.attention_bias
+    _compare(path, TOKENS, model)
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Phi3Config"),
+    reason="transformers too old for Phi-3",
+)
+def test_phi3_longrope_parity(tmp_path):
+    """Phi-3 LongRoPE. Factor sets are selected PER POSITION at the
+    original-context boundary (vLLM's serving semantics — HF instead
+    re-ropes the whole sequence when its length crosses the boundary,
+    which an incremental KV cache cannot replay), so:
+
+      * prompts inside the original context match HF EXACTLY (both use
+        the short set + the sqrt-log attention factor);
+      * past the boundary, each position's frequencies must equal the
+        matching HF regime's values (short below, long above).
+    """
+    import math
+
+    D2 = 16 // 2  # head_dim 16 -> 8 freq dims
+    short = [1.0 + 0.05 * i for i in range(D2)]
+    long = [1.5 + 0.25 * i for i in range(D2)]
+    hf_cfg = transformers.Phi3Config(
+        **{**TINY, "max_position_embeddings": 256},
+        pad_token_id=0,
+        original_max_position_embeddings=64,
+        rope_scaling={
+            "type": "longrope", "short_factor": short, "long_factor": long,
+        },
+    )
+    model = transformers.Phi3ForCausalLM(hf_cfg)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert (cfg.rope_scaling or {}).get("type") == "longrope"
+    # short regime end-to-end: exact HF parity (attention factor incl.)
+    toks = [(t * 7) % 256 for t in range(50)]
+    _compare(path, toks, model)
+
+    # per-position frequency selection across the boundary
+    from dynamo_tpu.models.llama import (
+        _rope_attention_scaling, _rope_freqs, apply_rope,
+    )
+
+    inv = _rope_freqs(cfg)
+    msc = _rope_attention_scaling(cfg)
+    assert msc == pytest.approx(math.sqrt(1 + math.log(4) / math.log(64)))
+    base = 1.0 / (10000.0 ** (np.arange(0, 16, 2) / 16))
+    # x1 = ones, x2 = zeros: rotated halves are exactly cos/sin * msc
+    x = jnp.zeros((2, 1, 16)).at[..., :8].set(1.0)
+    pos = jnp.asarray([63, 64])  # last-short, first-long
+    out = np.asarray(apply_rope(x, pos, inv, msc))
+    for row, p, factors in ((0, 63, short), (1, 64, long)):
+        angles = p * (base / np.asarray(factors))
+        np.testing.assert_allclose(
+            out[row, 0, :8], np.cos(angles) * msc, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            out[row, 0, 8:], np.sin(angles) * msc, rtol=1e-5, atol=1e-6
+        )
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
