@@ -268,6 +268,8 @@ class JaxEngine(AsyncEngine):
                     # per unrolled layer call, so gpt-oss is NOT
                     # gated off.
                     and cfg.model.head_dim % 64 == 0
+                    # gemma-2 score softcapping lives in the XLA paths
+                    and not cfg.model.attn_softcap
                     and (
                         self.mesh is None
                         or cfg.model.num_kv_heads % tp == 0
@@ -768,6 +770,7 @@ class JaxEngine(AsyncEngine):
             or cfg.model.sliding_window != 0
             or cfg.model.layer_windows  # per-layer windows (gpt-oss)
             or cfg.model.attn_sinks  # sinks live in the paged XLA paths
+            or cfg.model.attn_softcap  # gemma-2 caps: paged XLA paths
         ):
             return False
         # bucket sizes are powers of two >= sp, so T % sp == 0 holds

@@ -115,6 +115,13 @@ class ModelConfig:
     hidden_act: str = "silu"  # "silu" | "gelu_tanh" (gemma GeGLU)
     rms_add_unit: bool = False  # gemma RMSNorm scales by (1 + w)
     scale_embed: bool = False  # gemma multiplies embeddings by sqrt(E)
+    # gemma-2: tanh caps on attention scores / final logits, sandwich
+    # (post-attention + post-FFN) norms, and a fixed query scale from
+    # query_pre_attn_scalar instead of head_dim
+    attn_softcap: float = 0.0  # 0 = off
+    final_softcap: float = 0.0
+    post_norms: bool = False
+    attn_scale_base: int = 0  # 0 = use head_dim
     # runtime
     dtype: str = "bfloat16"
 
@@ -165,6 +172,9 @@ class ModelConfig:
             cfg.get("model_type", "").startswith("gemma")
         )
         is_gptoss = any(a.startswith("GptOss") for a in archs)
+        is_gemma2 = any(a.startswith("Gemma2") for a in archs) or (
+            cfg.get("model_type") == "gemma2"
+        )
         # qwen2moe: gated shared expert; interleaved dense layers are
         # not implemented — reject rather than serve wrong logits
         is_qwen2moe = any(a.startswith("Qwen2Moe") for a in archs)
@@ -176,13 +186,24 @@ class ModelConfig:
                 "qwen2moe with decoder_sparse_step != 1 or mlp_only_layers "
                 "is not supported (interleaved dense/sparse layers)"
             )
-        # gpt-oss layer_types: per-layer sliding/full alternation
+        # layer_types: per-layer sliding/full alternation (gpt-oss,
+        # gemma-2/3 style)
         layer_windows: tuple = ()
-        if is_gptoss and cfg.get("layer_types"):
+        if (is_gptoss or is_gemma2) and cfg.get("layer_types"):
             sw = cfg.get("sliding_window") or 0
             layer_windows = tuple(
                 sw if t == "sliding_attention" else 0
                 for t in cfg["layer_types"]
+            )
+        elif is_gemma2 and cfg.get("sliding_window"):
+            # original gemma-2 uploads predate the layer_types key: the
+            # architecture alternates sliding on EVEN layers
+            # (modeling_gemma2: sliding iff layer_idx % 2 == 0) — a bare
+            # global window would wrongly mask the full-attention layers
+            sw = cfg["sliding_window"]
+            layer_windows = tuple(
+                sw if i % 2 == 0 else 0
+                for i in range(cfg.get("num_hidden_layers", 32))
             )
         # partial rotary (Phi-4-mini, GLM): rotating only a prefix of
         # each head is not implemented — reject rather than rotate all
@@ -284,6 +305,13 @@ class ModelConfig:
             ),
             hidden_act=act if act != "silu" else "silu",
             rms_add_unit=is_gemma,
+            attn_softcap=(cfg.get("attn_logit_softcapping") or 0.0)
+            if is_gemma2 else 0.0,
+            final_softcap=(cfg.get("final_logit_softcapping") or 0.0)
+            if is_gemma2 else 0.0,
+            post_norms=is_gemma2,
+            attn_scale_base=(cfg.get("query_pre_attn_scalar") or 0)
+            if is_gemma2 else 0,
             scale_embed=is_gemma,
             dtype=cfg.get("torch_dtype") or "bfloat16",
         )

@@ -63,6 +63,11 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
     layers = {
         "attn_norm": jnp.ones((L, E), dt),
         "mlp_norm": jnp.ones((L, E), dt),
+        **(
+            {"attn_post_norm": jnp.ones((L, E), dt),
+             "mlp_post_norm": jnp.ones((L, E), dt)}
+            if cfg.post_norms else {}
+        ),
     }
     if cfg.is_mla:
         Cq, C = cfg.q_lora_rank, cfg.kv_lora_rank
@@ -205,6 +210,20 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def attn_query_scale(cfg: ModelConfig) -> float:
+    """Query scale: head_dim**-0.5, or gemma-2's fixed
+    query_pre_attn_scalar**-0.5."""
+    return (cfg.attn_scale_base or cfg.head_dim) ** -0.5
+
+
+def post_norm(lp: dict, key: str, v: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Gemma-2 sandwich norm: normalize the sublayer OUTPUT before the
+    residual add (post_attention/post_feedforward_layernorm). No-op for
+    every other family (no post-norm weights in lp)."""
+    w = lp.get(key)
+    return v if w is None else rms_norm(v, w, cfg.rms_norm_eps)
 
 
 def window_for_layer(cfg: ModelConfig, l: int) -> int:
@@ -670,7 +689,7 @@ def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
 
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    return att.softcap((x @ head).astype(jnp.float32), cfg.final_softcap)
 
 
 def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
@@ -758,7 +777,7 @@ def prefill(
     else:
         inv_freq = _rope_freqs(cfg)
         rope_msc = _rope_attention_scaling(cfg)
-        scale = cfg.head_dim**-0.5
+        scale = attn_query_scale(cfg)
 
     def body(carry, layer_in, window=cfg.sliding_window):
         x = carry
@@ -827,10 +846,14 @@ def prefill(
                     q, k, v, kc, vc, block_table, history_len, valid_len,
                     scale, use_pallas=use_pallas, mesh=mesh,
                     window=window, sinks=lp.get("sinks"),
+                    cap=cfg.attn_softcap,
                 )
-            x = x + _mm_b(o.reshape(T, -1), lp, "wo", "bo")
+            x = x + post_norm(
+                lp, "attn_post_norm",
+                _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
+            )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h, mesh=mesh)
+        x = x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
         return x, (kc, vc)
 
     if cfg.layer_windows:
@@ -888,12 +911,14 @@ def _decode_body(
     else:
         inv_freq = _rope_freqs(cfg)
         rope_msc = _rope_attention_scaling(cfg)
-        scale = cfg.head_dim**-0.5
+        scale = attn_query_scale(cfg)
 
     def layer_tail(x, lp, o):
-        x = x + _mm_b(o.reshape(B, -1), lp, "wo", "bo")
+        x = x + post_norm(
+            lp, "attn_post_norm", _mm_b(o.reshape(B, -1), lp, "wo", "bo"), cfg
+        )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        return x + _ffn(lp, cfg, h, mesh=mesh)
+        return x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
 
     def layer_qkv(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -940,7 +965,10 @@ def _decode_body(
     # sinks join the flash-merge denominator and per-layer windows are
     # static per (unrolled) layer call, so gpt-oss runs the merged
     # one-write path like every other GQA family
-    merged = merged and unroll and use_pallas and not cfg.is_mla
+    merged = (
+        merged and unroll and use_pallas and not cfg.is_mla
+        and not cfg.attn_softcap  # gemma-2 caps live in the XLA paths
+    )
     if mla_merged:
         # MERGED one-write path, MLA flavor: the latent kernel scores
         # history with stats, the current token's (c_kv, k_pe) folds in
@@ -1077,6 +1105,7 @@ def _decode_body(
                     q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
                     use_pallas=use_pallas, mesh=mesh,
                     window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
+                    cap=cfg.attn_softcap,
                 )
                 x = layer_tail(x, lp, o)
     else:
@@ -1096,7 +1125,7 @@ def _decode_body(
             o = att.decode_attention(
                 q, kc, vc, block_tables, seq_lens, scale,
                 use_pallas=use_pallas, mesh=mesh, window=cfg.sliding_window,
-                sinks=lp.get("sinks"),
+                sinks=lp.get("sinks"), cap=cfg.attn_softcap,
             )
             x = layer_tail(x, lp, o)
             return x, (kc, vc)
@@ -1312,7 +1341,7 @@ def _verify_forward(
 
     inv_freq = _rope_freqs(cfg)
     rope_msc = _rope_attention_scaling(cfg)
-    scale = cfg.head_dim**-0.5
+    scale = attn_query_scale(cfg)
 
     k_news, v_news = [], []
     for lps, ng, goff in layer_groups(params, cfg):
@@ -1339,14 +1368,18 @@ def _verify_forward(
                     q, k, v, k_cache[l], v_cache[l], block_tables, hist_lens,
                     scale, use_pallas=use_pallas,
                     window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
-                    interpret=interpret,
+                    cap=cfg.attn_softcap, interpret=interpret,
                 )
-            x = x + _mm_b(
-                o.reshape(B * T, -1), lp, "wo", "bo"
-            ).reshape(B, T, E)
+            x = x + post_norm(
+                lp, "attn_post_norm",
+                _mm_b(o.reshape(B * T, -1), lp, "wo", "bo").reshape(B, T, E),
+                cfg,
+            )
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
-                B, T, E
+            x = x + post_norm(
+                lp, "mlp_post_norm",
+                _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(B, T, E),
+                cfg,
             )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
@@ -1507,7 +1540,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
     else:
         inv_freq = _rope_freqs(cfg)
         rope_msc = _rope_attention_scaling(cfg)
-        scale = cfg.head_dim**-0.5
+        scale = attn_query_scale(cfg)
 
     def body(x, lp, window=cfg.sliding_window):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -1564,11 +1597,14 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
             k = apply_rope(k, positions, inv_freq, rope_msc)
             o = att.prefill_attention_xla(
                 q, k, v, positions, jnp.int32(T), scale,
-                window=window, sinks=lp.get("sinks"),
+                window=window, sinks=lp.get("sinks"), cap=cfg.attn_softcap,
             )
-            x = x + _mm_b(o.reshape(T, -1), lp, "wo", "bo")
+            x = x + post_norm(
+                lp, "attn_post_norm",
+                _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
+            )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(lp, cfg, h)
+        x = x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h), cfg)
         return x, None
 
     if cfg.layer_windows:  # per-layer static windows: unrolled

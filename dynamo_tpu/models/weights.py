@@ -100,11 +100,28 @@ def load_llama_params(
         out = {
             "attn_norm": stack("model.layers.{i}.input_layernorm.weight",
                                rng, transpose=False),
-            "mlp_norm": stack(
+        }
+        if cfg.post_norms:
+            # gemma-2 sandwich norms: post_attention_layernorm is the
+            # ATTENTION OUTPUT norm here (not the pre-FFN norm it names
+            # in llama-family checkpoints)
+            out["attn_post_norm"] = stack(
                 "model.layers.{i}.post_attention_layernorm.weight",
                 rng, transpose=False,
-            ),
-        }
+            )
+            out["mlp_norm"] = stack(
+                "model.layers.{i}.pre_feedforward_layernorm.weight",
+                rng, transpose=False,
+            )
+            out["mlp_post_norm"] = stack(
+                "model.layers.{i}.post_feedforward_layernorm.weight",
+                rng, transpose=False,
+            )
+        else:
+            out["mlp_norm"] = stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                rng, transpose=False,
+            )
         if cfg.is_mla:
             dqk, dr = cfg.qk_head_dim, cfg.qk_rope_head_dim
             H = cfg.num_heads
@@ -315,8 +332,10 @@ def load_llama_params(
         # gemma checkpoints store norm weights as offsets (the model
         # scales by 1 + w); folding the +1 here keeps every runtime
         # rms_norm call family-agnostic
-        layers["attn_norm"] = layers["attn_norm"] + 1.0
-        layers["mlp_norm"] = layers["mlp_norm"] + 1.0
+        for key in ("attn_norm", "mlp_norm", "attn_post_norm",
+                    "mlp_post_norm"):
+            if key in layers:
+                layers[key] = layers[key] + 1.0
         params["final_norm"] = params["final_norm"] + 1.0
 
     # cast + (optionally) place on mesh shard-by-shard
@@ -378,12 +397,26 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
         names["q_norm"] = (
             "model.layers.{i}.self_attn.q_norm.weight", False
         )
+    if cfg is not None and getattr(cfg, "post_norms", False):
+        # gemma-2 sandwich norms: post_attention_layernorm is the attn
+        # OUTPUT norm; the pre-FFN norm gets its own name
+        names["mlp_norm"] = (
+            "model.layers.{i}.pre_feedforward_layernorm.weight", False
+        )
+        names["attn_post_norm"] = (
+            "model.layers.{i}.post_attention_layernorm.weight", False
+        )
+        names["mlp_post_norm"] = (
+            "model.layers.{i}.post_feedforward_layernorm.weight", False
+        )
 
     def save_group(lay: dict, n: int, off: int) -> None:
         lay = dict(lay)
         if cfg is not None and getattr(cfg, "rms_add_unit", False):
-            lay["attn_norm"] = lay["attn_norm"] - 1.0
-            lay["mlp_norm"] = lay["mlp_norm"] - 1.0
+            for key in ("attn_norm", "mlp_norm", "attn_post_norm",
+                        "mlp_post_norm"):
+                if key in lay:
+                    lay[key] = lay[key] - 1.0
         for key, (fmt, transpose) in names.items():
             if key not in lay:
                 continue

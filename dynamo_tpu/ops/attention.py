@@ -81,6 +81,7 @@ def decode_attention(
     mesh=None,
     window: int = 0,
     sinks=None,  # [H] gpt-oss sink logits; stats-fold on the kernel path
+    cap: float = 0.0,  # gemma-2 softcap: forces the XLA path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
@@ -96,24 +97,24 @@ def decode_attention(
     guarantee num_kv_heads % tp == 0 (the engine falls back to XLA
     otherwise, where GSPMD handles uneven head splits).
     """
-    if use_pallas and mesh is not None:
+    if use_pallas and mesh is not None and not cap:
         return paged_decode_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             mesh, window=window, sinks=sinks, interpret=interpret,
         )
-    if use_pallas and sinks is None:
+    if use_pallas and sinks is None and not cap:
         return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             window=window, interpret=interpret,
         )
-    if use_pallas:
+    if use_pallas and not cap:
         return _decode_kernel_with_sinks(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             sinks, window=window, interpret=interpret,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
-        window=window, sinks=sinks,
+        window=window, sinks=sinks, cap=cap,
     )
 
 
@@ -311,6 +312,7 @@ def verify_attention(
     use_pallas: bool = False,
     window: int = 0,
     sinks=None,  # [H] gpt-oss sink logits; joins the merge denominator
+    cap: float = 0.0,  # gemma-2 softcap (XLA path only; callers gate)
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, T, H, D]
     """Multi-token decode attention (speculative-decoding verify): T
@@ -327,6 +329,9 @@ def verify_attention(
     B, T, H, D = q.shape
     Hkv = k_cache_layer.shape[0]
     G = H // Hkv
+    # a softcap routes history scoring to the XLA twin — the kernels
+    # know no cap (same guard as the decode/prefill dispatchers)
+    use_pallas = use_pallas and not cap
     if use_pallas:
         from .paged_attention_pallas import paged_decode_attention
 
@@ -349,15 +354,15 @@ def verify_attention(
     else:
         o_h, m_h, l_h = _history_attention_xla(
             q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
-            window=window,
+            window=window, cap=cap,
         )
     # intra-window causal scores [B, Hkv, T, G, T']
     qg = q.reshape(B, T, Hkv, G, D)
-    s_w = jnp.einsum(
+    s_w = softcap(jnp.einsum(
         "btkgd,bukd->bktgu",
         qg.astype(jnp.float32) * scale,
         k_win.astype(jnp.float32),
-    )
+    ), cap)
     causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]  # [T, T']
     if window > 0:  # only binds when T > window (degenerate but exact)
         causal &= (jnp.arange(T)[:, None] - jnp.arange(T)[None, :]) < window
@@ -431,6 +436,7 @@ def _history_attention_xla(
     hist_lens: jnp.ndarray,
     scale: float,
     window: int = 0,
+    cap: float = 0.0,  # gemma-2 softcap; 0 = off
 ):
     """XLA twin of the stats-emitting kernel path: history-only attention
     with raw softmax stats (o normalized, m row max, l normalizer) in the
@@ -442,10 +448,10 @@ def _history_attention_xla(
     k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
     v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
     qg = q.reshape(B, T, Hkv, G, D)
-    s = jnp.einsum(
+    s = softcap(jnp.einsum(
         "btkgd,kbsd->bktgs", qg.astype(jnp.float32) * scale,
         k.astype(jnp.float32),
-    )
+    ), cap)
     valid = jnp.arange(M * bs)[None, :] < hist_lens[:, None]  # [B, S]
     if window > 0:
         # query t sits at absolute position hist + t
@@ -464,6 +470,13 @@ def _history_attention_xla(
     o = jnp.einsum("bktgs,kbsd->bktgd", p, v.astype(jnp.float32))
     o = o / jnp.maximum(l, 1e-20)[..., None]
     return o, m, l
+
+
+def softcap(scores, cap: float):
+    """Gemma-2 logit soft-capping: cap * tanh(s / cap); identity at 0."""
+    if not cap:
+        return scores
+    return cap * jnp.tanh(scores / cap)
 
 
 def _sink_softmax(scores, mask, sinks, Hkv, G):
@@ -494,6 +507,7 @@ def decode_attention_xla(
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
     sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
+    cap: float = 0.0,  # gemma-2 attention-score softcap; 0 = off
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     M = block_tables.shape[1]
@@ -508,7 +522,9 @@ def decode_attention_xla(
     if k.dtype != q.dtype:
         k, v = k.astype(q.dtype), v.astype(q.dtype)
     qg = q.reshape(B, Hkv, G, D)
-    scores = jnp.einsum("bkgd,kbtd->bkgt", qg * scale, k).astype(jnp.float32)
+    scores = softcap(
+        jnp.einsum("bkgd,kbtd->bkgt", qg * scale, k).astype(jnp.float32), cap
+    )
     positions = jnp.arange(M * bs)[None, :]  # [1, T]
     mask = positions < seq_lens[:, None]  # [B, T]
     if window > 0:  # q position is seq_len-1; keep kv in (q-W, q]
@@ -543,13 +559,16 @@ def prefill_attention_xla(
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
     sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
+    cap: float = 0.0,  # gemma-2 attention-score softcap; 0 = off
 ) -> jnp.ndarray:  # [T, H, D]
     """Causal self-attention within one (padded) prompt chunk."""
     T, H, D = q.shape
     Hkv = k.shape[1]
     k = repeat_kv(k, H // Hkv, axis=1)
     v = repeat_kv(v, H // Hkv, axis=1)
-    scores = jnp.einsum("thd,shd->hts", q * scale, k).astype(jnp.float32)
+    scores = softcap(
+        jnp.einsum("thd,shd->hts", q * scale, k).astype(jnp.float32), cap
+    )
     causal = q_positions[:, None] >= q_positions[None, :]  # [T, T]
     if window > 0:
         causal &= (q_positions[:, None] - q_positions[None, :]) < window
@@ -573,6 +592,7 @@ def chunk_attention_with_cache(
     mesh=None,
     window: int = 0,
     sinks=None,  # [H] gpt-oss sink logits; in-kernel fold on the pallas path
+    cap: float = 0.0,  # gemma-2 softcap: forces the XLA path
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
@@ -587,12 +607,12 @@ def chunk_attention_with_cache(
     chunk from the args. Both agree on all real rows (t < valid_len);
     padded tail rows differ but are discarded by every caller.
     """
-    if use_pallas and mesh is not None:
+    if use_pallas and mesh is not None and not cap:
         return paged_prefill_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
             mesh, window=window, sinks=sinks, interpret=interpret,
         )
-    if use_pallas:
+    if use_pallas and not cap:
         from .paged_attention_pallas import paged_prefill_attention
 
         return paged_prefill_attention(
@@ -601,7 +621,7 @@ def chunk_attention_with_cache(
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
-        history_len, valid_len, scale, window=window, sinks=sinks,
+        history_len, valid_len, scale, window=window, sinks=sinks, cap=cap,
     )
 
 
@@ -649,6 +669,7 @@ def chunk_attention_with_cache_xla(
     scale: float,
     window: int = 0,  # sliding window width; 0 = full attention
     sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
+    cap: float = 0.0,  # gemma-2 attention-score softcap; 0 = off
 ) -> jnp.ndarray:
     """Chunked-prefill attention: queries attend to cached history plus the
     causal prefix of the current chunk (enables chunked prefill and
@@ -665,7 +686,10 @@ def chunk_attention_with_cache_xla(
     k_all = jnp.concatenate([k_hist, k_chunk.swapaxes(0, 1)], axis=1)  # [Hkv, S, D]
     v_all = jnp.concatenate([v_hist, v_chunk.swapaxes(0, 1)], axis=1)
     qg = q.reshape(T, Hkv, G, D)
-    scores = jnp.einsum("tkgd,ksd->tkgs", qg * scale, k_all).astype(jnp.float32)
+    scores = softcap(
+        jnp.einsum("tkgd,ksd->tkgs", qg * scale, k_all).astype(jnp.float32),
+        cap,
+    )
     S = M * bs + T
     q_pos = history_len + jnp.arange(T)  # absolute positions of queries
     kv_pos = jnp.concatenate([jnp.arange(M * bs), history_len + jnp.arange(T)])

@@ -85,6 +85,9 @@ _PARAM_SPECS = {
     # qwen3 per-head q/k norms [L, head_dim] (q_norm shares the MLA
     # entry below — same rank-2 layer-stacked shape, same placement)
     "layers.k_norm": P("pp", None),
+    # gemma-2 sandwich norms
+    "layers.attn_post_norm": P("pp", None),
+    "layers.mlp_post_norm": P("pp", None),
     # gpt-oss: per-head attention sinks, o-projection bias, router logit
     # bias, per-expert projection biases (expert axis over ep)
     # sinks are per query head: shard with the head axis the attention

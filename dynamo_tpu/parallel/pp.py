@@ -73,6 +73,9 @@ def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
         # pipeline's scanned stage body is homogeneous and sink-less
         and not cfg.layer_windows
         and not cfg.attn_sinks
+        # gemma-2 softcap/sandwich norms live in the XLA unrolled paths
+        and not cfg.attn_softcap
+        and not cfg.post_norms
         and cfg.num_layers % pp == 0
         and n_micro >= 1
         and T % n_micro == 0

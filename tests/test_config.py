@@ -77,3 +77,28 @@ def test_runtime_config_env(monkeypatch):
 def test_worker_config_env(monkeypatch):
     monkeypatch.setenv("DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "2.5")
     assert WorkerConfig.from_settings().graceful_shutdown_timeout == 2.5
+
+
+def test_gemma2_legacy_config_synthesizes_alternation():
+    """Original gemma-2 uploads predate layer_types: the config parser
+    must synthesize the even-sliding alternation (a bare global window
+    would wrongly mask the full-attention layers), and model_type alone
+    must be enough to identify the family."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    base = {
+        "hidden_size": 64, "intermediate_size": 112,
+        "num_hidden_layers": 4, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 16, "vocab_size": 256,
+    }
+    cfg = ModelConfig.from_hf_config({
+        **base, "architectures": ["Gemma2ForCausalLM"],
+        "sliding_window": 4096, "attn_logit_softcapping": 50.0,
+        "final_logit_softcapping": 30.0, "query_pre_attn_scalar": 32,
+    })
+    assert cfg.layer_windows == (4096, 0, 4096, 0)
+    assert cfg.sliding_window == 0
+    assert cfg.attn_softcap == 50.0 and cfg.post_norms
+
+    cfg2 = ModelConfig.from_hf_config({**base, "model_type": "gemma2"})
+    assert cfg2.post_norms and cfg2.rms_add_unit

@@ -249,6 +249,38 @@ def test_phi3_longrope_parity(tmp_path):
         )
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "Gemma2Config"),
+    reason="transformers too old for Gemma-2",
+)
+def test_gemma2_parity(tmp_path):
+    """Gemma-2: sandwich (post-attention/post-FFN) norms, attention and
+    final logit soft-capping, query_pre_attn_scalar scale, alternating
+    sliding/full layers, (1+w) norms, scaled embeddings, GeGLU."""
+    hf_cfg = transformers.Gemma2Config(
+        **{**TINY, "num_hidden_layers": 4},
+        head_dim=16, pad_token_id=0,
+        query_pre_attn_scalar=32,
+        sliding_window=5,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+    )
+    model = transformers.Gemma2ForCausalLM(hf_cfg)
+    with torch.no_grad():  # non-trivial norms so the sandwich order shows
+        for name, p in model.named_parameters():
+            if "norm" in name:
+                p.normal_(0.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.post_norms and cfg.attn_softcap == 50.0
+    assert cfg.final_softcap == 30.0 and cfg.attn_scale_base == 32
+    assert cfg.layer_windows and cfg.layer_windows[0] == 5
+    assert cfg.rms_add_unit and cfg.scale_embed
+    # 12 tokens: window 5 binds on the sliding layers
+    toks = [(t * 11) % 256 for t in range(12)]
+    _compare(path, toks, model, atol=5e-4)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
@@ -366,6 +398,54 @@ def test_gptoss_paged_engine_matches_dense():
     )
     params = llama.init_params(cfg, __import__("jax").random.key(2))
     prompt = [(11 * i + 5) % cfg.vocab_size for i in range(18)]
+    cur = list(prompt)
+    for _ in range(6):
+        lg = llama.dense_forward(params, cfg, jnp.asarray(cur))
+        cur.append(int(np.argmax(np.asarray(lg[-1]))))
+    want = cur[len(prompt):]
+
+    import asyncio
+
+    async def main():
+        engine = JaxEngine(
+            EngineConfig(model=cfg, num_blocks=32, block_size=4,
+                         max_batch_size=2, max_context=64, prefill_chunk=8),
+            params=params,
+        )
+        out = await collect(engine.generate(Context(PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        toks = [t for o in out for t in o.token_ids]
+        assert toks == want, (toks, want)
+        await engine.close()
+
+    asyncio.run(main())
+
+
+def test_gemma2_paged_engine_matches_dense():
+    """The paged serving path (chunked prefill + decode with sandwich
+    norms, score/logit softcaps, alternating windows, fixed query scale)
+    must reproduce the dense gemma-2-shaped forward token-for-token
+    through the engine."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    cfg = ModelConfig.tiny(
+        num_layers=4, layer_windows=(6, 0, 6, 0),
+        post_norms=True, attn_softcap=50.0, final_softcap=30.0,
+        attn_scale_base=32, rms_add_unit=True, scale_embed=True,
+        tie_word_embeddings=True, hidden_act="gelu_tanh", dtype="float32",
+    )
+    params = llama.init_params(cfg, __import__("jax").random.key(4))
+    prompt = [(13 * i + 2) % cfg.vocab_size for i in range(18)]
     cur = list(prompt)
     for _ in range(6):
         lg = llama.dense_forward(params, cfg, jnp.asarray(cur))
