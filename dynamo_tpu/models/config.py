@@ -122,6 +122,9 @@ class ModelConfig:
     final_softcap: float = 0.0
     post_norms: bool = False
     attn_scale_base: int = 0  # 0 = use head_dim
+    # gemma-3: sliding layers rope at their own LOCAL base frequency
+    # (rope_local_base_freq); full layers use rope_theta (+scaling)
+    rope_local_theta: float = 0.0  # 0 = single rope for all layers
     # runtime
     dtype: str = "bfloat16"
 
@@ -162,6 +165,16 @@ class ModelConfig:
     @staticmethod
     def from_hf_config(cfg: dict) -> "ModelConfig":
         archs = cfg.get("architectures") or []
+        if isinstance(cfg.get("text_config"), dict) and any(
+            a.startswith("Gemma3") for a in archs
+        ):
+            # gemma-3 multimodal checkpoints nest the language model
+            # under text_config; serve that (the vision tower has no
+            # TPU serving path here)
+            merged = {**cfg["text_config"], "architectures": archs}
+            if cfg.get("torch_dtype") and "torch_dtype" not in merged:
+                merged["torch_dtype"] = cfg["torch_dtype"]
+            cfg = merged
         # Qwen2 has qkv bias baked into the architecture; its HF config
         # carries no attention_bias field
         qkv_bias = cfg.get("attention_bias", False) or any(
@@ -174,6 +187,9 @@ class ModelConfig:
         is_gptoss = any(a.startswith("GptOss") for a in archs)
         is_gemma2 = any(a.startswith("Gemma2") for a in archs) or (
             cfg.get("model_type") == "gemma2"
+        )
+        is_gemma3 = any(a.startswith("Gemma3") for a in archs) or (
+            cfg.get("model_type") in ("gemma3", "gemma3_text")
         )
         # qwen2moe: gated shared expert; interleaved dense layers are
         # not implemented — reject rather than serve wrong logits
@@ -189,11 +205,28 @@ class ModelConfig:
         # layer_types: per-layer sliding/full alternation (gpt-oss,
         # gemma-2/3 style)
         layer_windows: tuple = ()
-        if (is_gptoss or is_gemma2) and cfg.get("layer_types"):
+        if (is_gptoss or is_gemma2 or is_gemma3) and cfg.get("layer_types"):
             sw = cfg.get("sliding_window") or 0
             layer_windows = tuple(
                 sw if t == "sliding_attention" else 0
                 for t in cfg["layer_types"]
+            )
+        elif is_gemma3 and cfg.get("sliding_window") and cfg.get(
+            "sliding_window_pattern"
+        ):
+            # original gemma-3 uploads predate layer_types: every Nth
+            # layer is full attention (HF: sliding iff (i+1) % N != 0)
+            sw, n = cfg["sliding_window"], cfg["sliding_window_pattern"]
+            layer_windows = tuple(
+                sw if (i + 1) % n else 0
+                for i in range(cfg.get("num_hidden_layers", 32))
+            )
+        elif is_gemma3 and cfg.get("sliding_window"):
+            raise ValueError(
+                "gemma-3 config has sliding_window but neither "
+                "layer_types nor sliding_window_pattern — cannot "
+                "recover the sliding/full alternation; refusing to "
+                "serve wrong attention"
             )
         elif is_gemma2 and cfg.get("sliding_window"):
             # original gemma-2 uploads predate the layer_types key: the
@@ -247,7 +280,7 @@ class ModelConfig:
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
             attention_bias=qkv_bias,
             # qwen3 (dense and MoE): per-head q/k RMS norm, no qkv bias
-            qk_norm=any(a.startswith("Qwen3") for a in archs),
+            qk_norm=any(a.startswith("Qwen3") for a in archs) or is_gemma3,
             layer_windows=layer_windows,
             attn_sinks=is_gptoss,
             moe_act="gptoss_clamp" if is_gptoss else "swiglu",
@@ -309,9 +342,11 @@ class ModelConfig:
             if is_gemma2 else 0.0,
             final_softcap=(cfg.get("final_logit_softcapping") or 0.0)
             if is_gemma2 else 0.0,
-            post_norms=is_gemma2,
+            post_norms=is_gemma2 or is_gemma3,
             attn_scale_base=(cfg.get("query_pre_attn_scalar") or 0)
-            if is_gemma2 else 0,
+            if (is_gemma2 or is_gemma3) else 0,
+            rope_local_theta=(cfg.get("rope_local_base_freq") or 0.0)
+            if is_gemma3 else 0.0,
             scale_embed=is_gemma,
             dtype=cfg.get("torch_dtype") or "bfloat16",
         )

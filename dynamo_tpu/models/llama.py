@@ -306,7 +306,11 @@ def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
         )
         extrap = 1.0 - ramp
         return (inv / factor) * (1 - extrap) + inv * extrap
-    if (scaling.get("rope_type") or scaling.get("type")) == "longrope":
+    kind = scaling.get("rope_type") or scaling.get("type")
+    if kind == "linear":
+        # position-interpolation scaling (gemma-3 global layers et al.)
+        return inv / scaling.get("factor", 1.0)
+    if kind == "longrope":
         # Phi-3 LongRoPE: two per-dim rescale-factor sets, selected PER
         # POSITION at the original-context boundary (vLLM's
         # Phi3LongRoPEScaledRotaryEmbedding semantics — the serving
@@ -333,6 +337,26 @@ def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
             jnp.where(ratio > hi, inv, (1 - smooth) * inv / factor + smooth * inv),
         )
     return inv
+
+
+def _rope_freqs_local(cfg: ModelConfig):
+    """Gemma-3 local rope: sliding layers rotate at rope_local_base_freq
+    with NO scaling; None when the model has a single rope."""
+    if not cfg.rope_local_theta:
+        return None
+    D = cfg.head_dim
+    return 1.0 / (
+        cfg.rope_local_theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D)
+    )
+
+
+def rope_freqs_for_layer(cfg: ModelConfig, l: int, inv_global, inv_local):
+    """Layer l's rope frequencies: the LOCAL set on sliding layers when
+    the model defines one (gemma-3), the global set elsewhere. Static
+    per layer — callers are the unrolled layer loops."""
+    if inv_local is None:
+        return inv_global
+    return inv_local if window_for_layer(cfg, l) > 0 else inv_global
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq,
@@ -779,7 +803,9 @@ def prefill(
         rope_msc = _rope_attention_scaling(cfg)
         scale = attn_query_scale(cfg)
 
-    def body(carry, layer_in, window=cfg.sliding_window):
+    inv_local = _rope_freqs_local(cfg)
+
+    def body(carry, layer_in, window=cfg.sliding_window, freqs=None):
         x = carry
         lp, kc, vc = layer_in
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -828,8 +854,9 @@ def prefill(
             x = x + _mm(o, lp["wo"])
         else:
             q, k, v = _qkv(lp, cfg, h)
-            q = apply_rope(q, positions, inv_freq, rope_msc)
-            k = apply_rope(k, positions, inv_freq, rope_msc)
+            fr = inv_freq if freqs is None else freqs
+            q = apply_rope(q, positions, fr, rope_msc)
+            k = apply_rope(k, positions, fr, rope_msc)
             kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
             vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
             if use_ring:
@@ -867,6 +894,7 @@ def prefill(
                 x, (kc_l, vc_l) = body(
                     x, (lp, k_cache[l], v_cache[l]),
                     window=window_for_layer(cfg, l),
+                    freqs=rope_freqs_for_layer(cfg, l, inv_freq, inv_local),
                 )
                 k_cache = k_cache.at[l].set(kc_l)
                 v_cache = v_cache.at[l].set(vc_l)
@@ -920,11 +948,14 @@ def _decode_body(
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         return x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
 
-    def layer_qkv(x, lp):
+    inv_local_dec = _rope_freqs_local(cfg)
+
+    def layer_qkv(x, lp, freqs=None):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
-        q = apply_rope(q, positions, inv_freq, rope_msc)
-        k = apply_rope(k, positions, inv_freq, rope_msc)
+        fr = inv_freq if freqs is None else freqs
+        q = apply_rope(q, positions, fr, rope_msc)
+        k = apply_rope(k, positions, fr, rope_msc)
         return q, k, v
 
     def mla_layer(x, lp, kc_l, vc_l):
@@ -1059,7 +1090,9 @@ def _decode_body(
             for li in range(n):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
-                q, k, v = layer_qkv(x, lp)
+                q, k, v = layer_qkv(
+                    x, lp, rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec)
+                )
                 k_news.append(k)
                 v_news.append(v)
                 if mesh is None:
@@ -1092,7 +1125,9 @@ def _decode_body(
             for li in range(n):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
-                q, k, v = layer_qkv(x, lp)
+                q, k, v = layer_qkv(
+                    x, lp, rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec)
+                )
                 # mixed basic+advanced indexing puts the advanced axes
                 # (blk, off) in front: the update value is [B, Hkv, D]
                 k_cache = k_cache.at[l, :, blk, off].set(
@@ -1340,6 +1375,7 @@ def _verify_forward(
         return logits, k_cache, v_cache
 
     inv_freq = _rope_freqs(cfg)
+    inv_local = _rope_freqs_local(cfg)
     rope_msc = _rope_attention_scaling(cfg)
     scale = attn_query_scale(cfg)
 
@@ -1350,8 +1386,9 @@ def _verify_forward(
             lp = jax.tree.map(lambda a: a[li], lps)
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
-            q = apply_rope(q, pos_bt, inv_freq, rope_msc)
-            k = apply_rope(k, pos_bt, inv_freq, rope_msc)
+            fr = rope_freqs_for_layer(cfg, l, inv_freq, inv_local)
+            q = apply_rope(q, pos_bt, fr, rope_msc)
+            k = apply_rope(k, pos_bt, fr, rope_msc)
             k_news.append(k)
             v_news.append(v)
             if use_pallas and mesh is not None:
@@ -1542,7 +1579,9 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         rope_msc = _rope_attention_scaling(cfg)
         scale = attn_query_scale(cfg)
 
-    def body(x, lp, window=cfg.sliding_window):
+    inv_local = _rope_freqs_local(cfg)
+
+    def body(x, lp, window=cfg.sliding_window, freqs=None):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         if cfg.is_mla:
             # DELIBERATELY independent of mla.mla_q_and_latent: this is
@@ -1593,8 +1632,9 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
             x = x + _mm(o, lp["wo"])
         else:
             q, k, v = _qkv(lp, cfg, h)
-            q = apply_rope(q, positions, inv_freq, rope_msc)
-            k = apply_rope(k, positions, inv_freq, rope_msc)
+            fr = inv_freq if freqs is None else freqs
+            q = apply_rope(q, positions, fr, rope_msc)
+            k = apply_rope(k, positions, fr, rope_msc)
             o = att.prefill_attention_xla(
                 q, k, v, positions, jnp.int32(T), scale,
                 window=window, sinks=lp.get("sinks"), cap=cfg.attn_softcap,
@@ -1611,7 +1651,11 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
         for lps, n, off in layer_groups(params, cfg):
             for li in range(n):
                 lp = jax.tree.map(lambda a: a[li], lps)
-                x, _ = body(x, lp, window=window_for_layer(cfg, off + li))
+                l = off + li
+                x, _ = body(
+                    x, lp, window=window_for_layer(cfg, l),
+                    freqs=rope_freqs_for_layer(cfg, l, inv_freq, inv_local),
+                )
     else:
         for lps, _n, _off in layer_groups(params, cfg):
             x, _ = lax.scan(body, x, lps)

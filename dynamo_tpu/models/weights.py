@@ -61,7 +61,39 @@ def load_llama_params(
 
     handles: dict[str, object] = {}
 
+    # multimodal checkpoints (gemma-3 conditional generation et al.)
+    # nest the language model: weights live under language_model.model.*
+    # (or model.language_model.* in newer transformers) instead of the
+    # bare model.* this loader's name table uses — resolve the prefix
+    # once from wherever the embedding actually lives
+    _prefix = ""
+    if "model.embed_tokens.weight" not in name_to_file:
+        for cand in ("language_model.", "model.language_model."):
+            if (
+                cand + "model.embed_tokens.weight" in name_to_file
+                or cand + "embed_tokens.weight" in name_to_file
+            ):
+                _prefix = cand
+                break
+
+    def _resolve(name: str) -> str:
+        """Bare llama-family name -> this checkpoint's actual key.
+        Tries, in order: the bare name (lm_head etc. stay top-level in
+        multimodal checkpoints), prefix+name, and prefix replacing the
+        leading "model." segment."""
+        if not _prefix or name in name_to_file:
+            return name
+        full = _prefix + name
+        if full in name_to_file:
+            return full
+        if name.startswith("model."):
+            alt = _prefix + name[len("model."):]
+            if alt in name_to_file:
+                return alt
+        return name
+
     def get(name: str) -> np.ndarray:
+        name = _resolve(name)
         fname = name_to_file[name]
         if fname not in handles:
             handles[fname] = safe_open(os.path.join(path, fname), framework="numpy")
@@ -78,7 +110,7 @@ def load_llama_params(
         return np.stack(mats)
 
     def has(name: str) -> bool:
-        return name in name_to_file
+        return _resolve(name) in name_to_file
 
     def deinterleave_rope(w: np.ndarray, n_head: int, d_head: int,
                           d_rope: int) -> np.ndarray:
@@ -331,9 +363,10 @@ def load_llama_params(
     if cfg.rms_add_unit:
         # gemma checkpoints store norm weights as offsets (the model
         # scales by 1 + w); folding the +1 here keeps every runtime
-        # rms_norm call family-agnostic
+        # rms_norm call family-agnostic (incl. gemma-3's per-head q/k
+        # norms, which share the convention)
         for key in ("attn_norm", "mlp_norm", "attn_post_norm",
-                    "mlp_post_norm"):
+                    "mlp_post_norm", "q_norm", "k_norm"):
             if key in layers:
                 layers[key] = layers[key] + 1.0
         params["final_norm"] = params["final_norm"] + 1.0

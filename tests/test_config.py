@@ -102,3 +102,27 @@ def test_gemma2_legacy_config_synthesizes_alternation():
 
     cfg2 = ModelConfig.from_hf_config({**base, "model_type": "gemma2"})
     assert cfg2.post_norms and cfg2.rms_add_unit
+
+
+def test_gemma3_legacy_pattern_and_rejection():
+    """Pre-layer_types gemma-3 configs carry sliding_window_pattern
+    (every Nth layer full); with NEITHER key the alternation is
+    unrecoverable and the load must refuse."""
+    import pytest
+
+    from dynamo_tpu.models.config import ModelConfig
+
+    base = {
+        "architectures": ["Gemma3ForCausalLM"], "hidden_size": 64,
+        "intermediate_size": 112, "num_hidden_layers": 6,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "head_dim": 16, "vocab_size": 256, "rope_local_base_freq": 10000.0,
+    }
+    cfg = ModelConfig.from_hf_config({
+        **base, "sliding_window": 512, "sliding_window_pattern": 3,
+    })
+    assert cfg.layer_windows == (512, 512, 0, 512, 512, 0)
+    assert cfg.rope_local_theta == 10000.0
+
+    with pytest.raises(ValueError, match="sliding_window_pattern"):
+        ModelConfig.from_hf_config({**base, "sliding_window": 512})

@@ -281,6 +281,129 @@ def test_gemma2_parity(tmp_path):
     _compare(path, toks, model, atol=5e-4)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "Gemma3TextConfig"),
+    reason="transformers too old for Gemma-3",
+)
+def test_gemma3_parity(tmp_path):
+    """Gemma-3 (text): per-layer ROPE — sliding layers rotate at the
+    LOCAL base frequency, full layers at rope_theta with linear
+    scaling — plus per-head (1+w) q/k norms, sandwich norms, 5:1
+    sliding pattern, query_pre_attn_scalar scale, no softcaps."""
+    hf_cfg = transformers.Gemma3TextConfig(
+        **{**TINY, "num_hidden_layers": 6}, head_dim=16, pad_token_id=0,
+        query_pre_attn_scalar=32, sliding_window=5,
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+    )
+    model = transformers.Gemma3ForCausalLM(hf_cfg)
+    with torch.no_grad():  # non-trivial norms (zero-offset init)
+        for name, p in model.named_parameters():
+            if "norm" in name:
+                p.normal_(0.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.post_norms and cfg.qk_norm and cfg.rms_add_unit
+    assert cfg.rope_local_theta == 10000.0
+    assert cfg.layer_windows == (5, 5, 5, 5, 5, 0)
+    assert (cfg.rope_scaling or {}).get("factor") == 8.0
+    toks = [(t * 11) % 256 for t in range(12)]
+    _compare(path, toks, model, atol=5e-4)
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Gemma3TextConfig"),
+    reason="transformers too old for Gemma-3",
+)
+def test_gemma3_paged_engine_matches_dense():
+    """Paged serving (chunked prefill + decode with per-layer rope and
+    windows) reproduces the dense gemma-3-shaped forward."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    cfg = ModelConfig.tiny(
+        num_layers=6, layer_windows=(6, 6, 6, 6, 6, 0),
+        post_norms=True, qk_norm=True, attn_scale_base=32,
+        rms_add_unit=True, scale_embed=True, tie_word_embeddings=True,
+        hidden_act="gelu_tanh", rope_theta=1000000.0,
+        rope_local_theta=10000.0, dtype="float32",
+    )
+    params = llama.init_params(cfg, __import__("jax").random.key(6))
+    prompt = [(17 * i + 3) % cfg.vocab_size for i in range(18)]
+    cur = list(prompt)
+    for _ in range(6):
+        lg = llama.dense_forward(params, cfg, jnp.asarray(cur))
+        cur.append(int(np.argmax(np.asarray(lg[-1]))))
+    want = cur[len(prompt):]
+
+    import asyncio
+
+    async def main():
+        engine = JaxEngine(
+            EngineConfig(model=cfg, num_blocks=32, block_size=4,
+                         max_batch_size=2, max_context=64, prefill_chunk=8),
+            params=params,
+        )
+        out = await collect(engine.generate(Context(PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        toks = [t for o in out for t in o.token_ids]
+        assert toks == want, (toks, want)
+        await engine.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Gemma3TextConfig"),
+    reason="transformers too old for Gemma-3",
+)
+def test_gemma3_multimodal_checkpoint_text_serving(tmp_path):
+    """Gemma-3 MULTIMODAL checkpoints: config nests under text_config
+    and the text weights carry the language_model.model.* prefix — the
+    loader resolves both, lm_head/top-level names included."""
+    import os
+
+    from safetensors.numpy import load_file, save_file
+
+    hf_cfg = transformers.Gemma3TextConfig(
+        **{**TINY, "num_hidden_layers": 2}, head_dim=16, pad_token_id=0,
+        query_pre_attn_scalar=32, sliding_window=5,
+        layer_types=["sliding_attention", "full_attention"],
+        rope_local_base_freq=10000.0, rope_theta=1000000.0,
+    )
+    model = transformers.Gemma3ForCausalLM(hf_cfg)
+    path = _save(tmp_path, model)
+    # rewrite as a multimodal-shaped checkpoint: prefixed weights +
+    # nested text_config
+    st = os.path.join(path, "model.safetensors")
+    tensors = load_file(st)
+    save_file(
+        {"language_model." + k: v for k, v in tensors.items()}, st
+    )
+    text_cfg = json.loads((tmp_path / "config.json").read_text())
+    (tmp_path / "config.json").write_text(json.dumps({
+        "architectures": ["Gemma3ForConditionalGeneration"],
+        "model_type": "gemma3",
+        "torch_dtype": "float32",
+        "text_config": {k: v for k, v in text_cfg.items()
+                        if k not in ("architectures", "torch_dtype")},
+        "vision_config": {"model_type": "siglip_vision_model"},
+    }))
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.post_norms and cfg.rope_local_theta == 10000.0
+    assert cfg.dtype == "float32"  # top-level torch_dtype carried
+    _compare(path, TOKENS, model, atol=5e-4)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
