@@ -125,6 +125,11 @@ class ModelConfig:
     # gemma-3: sliding layers rope at their own LOCAL base frequency
     # (rope_local_base_freq); full layers use rope_theta (+scaling)
     rope_local_theta: float = 0.0  # 0 = single rope for all layers
+    # partial rotary (Phi-4-mini, GLM, persimmon): only the first
+    # head_dim * rope_partial_factor dims of each head rotate
+    # (rope_partial_dim derives in __post_init__ once head_dim resolves)
+    rope_partial_factor: float = 1.0
+    rope_partial_dim: int = 0
     # runtime
     dtype: str = "bfloat16"
 
@@ -138,6 +143,8 @@ class ModelConfig:
                 )
         if self.head_dim == 0:
             self.head_dim = self.hidden_size // self.num_heads
+        if self.rope_partial_factor != 1.0 and not self.rope_partial_dim:
+            self.rope_partial_dim = int(self.head_dim * self.rope_partial_factor)
 
     @property
     def is_moe(self) -> bool:
@@ -238,13 +245,7 @@ class ModelConfig:
                 sw if i % 2 == 0 else 0
                 for i in range(cfg.get("num_hidden_layers", 32))
             )
-        # partial rotary (Phi-4-mini, GLM): rotating only a prefix of
-        # each head is not implemented — reject rather than rotate all
-        # dims and serve wrong logits
-        if (cfg.get("partial_rotary_factor") or 1.0) != 1.0:
-            raise ValueError(
-                "partial_rotary_factor != 1.0 is not supported"
-            )
+
         # Phi-3 keeps original_max_position_embeddings at the TOP level
         # of config.json; the longrope math needs it inside the scaling
         # dict (where yarn/llama3 checkpoints put theirs)
@@ -274,6 +275,7 @@ class ModelConfig:
             num_kv_heads=cfg.get("num_key_value_heads", cfg.get("num_attention_heads", 32)),
             head_dim=cfg.get("head_dim", 0) or 0,
             rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_partial_factor=cfg.get("partial_rotary_factor") or 1.0,
             rope_scaling=rope_scaling,
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),

@@ -276,7 +276,7 @@ def _rope_attention_scaling(cfg: ModelConfig) -> float:
 def _rope_freqs(cfg: ModelConfig) -> jnp.ndarray:
     import math
 
-    D = cfg.head_dim
+    D = cfg.rope_partial_dim or cfg.head_dim
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
     scaling = cfg.rope_scaling or {}
     if (scaling.get("rope_type") or scaling.get("type")) == "yarn":
@@ -372,12 +372,18 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq,
         inv = jnp.where(positions[..., None] < orig, sets[0], sets[1])
     else:
         inv = inv_freq
-    angles = positions[..., None].astype(jnp.float32) * inv  # [..., T, D/2]
-    cos = jnp.cos(angles)[..., None, :] * mscale  # [..., T, 1, D/2]
+    R = 2 * inv.shape[-1]  # rotary dims; < head_dim = partial rotary
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    xr, x_pass = xf[..., :R], xf[..., R:]
+    angles = positions[..., None].astype(jnp.float32) * inv  # [..., T, R/2]
+    cos = jnp.cos(angles)[..., None, :] * mscale  # [..., T, 1, R/2]
     sin = jnp.sin(angles)[..., None, :] * mscale
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    x1, x2 = jnp.split(xr, 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.astype(x.dtype)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out.astype(dtype)
 
 
 def _embed(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:

@@ -194,6 +194,30 @@ def test_phi3_parity(tmp_path):
     not hasattr(transformers, "Phi3Config"),
     reason="transformers too old for Phi-3",
 )
+def test_phi3_partial_rotary_parity(tmp_path):
+    """Partial rotary (the Phi-4-mini convention): only the first
+    head_dim * partial_rotary_factor dims of each head rotate; the rest
+    pass through."""
+    import inspect
+
+    if "partial_rotary_factor" not in inspect.signature(
+        transformers.Phi3Config.__init__
+    ).parameters:
+        pytest.skip("installed transformers predates Phi-3 partial rotary")
+    hf_cfg = transformers.Phi3Config(
+        **TINY, pad_token_id=0, partial_rotary_factor=0.5,
+    )
+    model = transformers.Phi3ForCausalLM(hf_cfg)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.rope_partial_dim == 8  # head_dim 16 * 0.5
+    _compare(path, TOKENS, model)
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Phi3Config"),
+    reason="transformers too old for Phi-3",
+)
 def test_phi3_longrope_parity(tmp_path):
     """Phi-3 LongRoPE. Factor sets are selected PER POSITION at the
     original-context boundary (vLLM's serving semantics — HF instead
