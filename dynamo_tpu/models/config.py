@@ -198,6 +198,20 @@ class ModelConfig:
         is_gemma3 = any(a.startswith("Gemma3") for a in archs) or (
             cfg.get("model_type") in ("gemma3", "gemma3_text")
         )
+        # EXACT arch matching: Glm4Moe (qk-norm MoE) and Glm4v
+        # (multimodal, text under text_config) have different layer
+        # anatomy — reject them rather than mis-serve (the file's
+        # standing reject-over-wrong-logits rule)
+        glm_archs = {a for a in archs if a.startswith("Glm")}
+        if glm_archs - {"GlmForCausalLM", "Glm4ForCausalLM"}:
+            raise ValueError(
+                f"unsupported GLM variant {sorted(glm_archs)} — only "
+                "GlmForCausalLM / Glm4ForCausalLM are implemented"
+            )
+        is_glm = bool(glm_archs) or cfg.get("model_type") in ("glm", "glm4")
+        is_glm4 = "Glm4ForCausalLM" in glm_archs or (
+            cfg.get("model_type") == "glm4"
+        )
         # qwen2moe: gated shared expert; interleaved dense layers are
         # not implemented — reject rather than serve wrong logits
         is_qwen2moe = any(a.startswith("Qwen2Moe") for a in archs)
@@ -325,13 +339,14 @@ class ModelConfig:
             qk_nope_head_dim=cfg.get("qk_nope_head_dim") or 0,
             qk_rope_head_dim=cfg.get("qk_rope_head_dim") or 0,
             v_head_dim=cfg.get("v_head_dim") or 0,
-            # interleaved rope storage is an MLA-checkpoint convention;
-            # non-MLA deepseek (deepseek-moe) checkpoints use the plain
-            # half-split layout like every other llama-family model
+            # interleaved (GPT-J-pair) rope storage: MLA checkpoints
+            # interleave the TRAILING rope dims, GLM the LEADING partial
+            # dims — both de-interleave at load so the runtime rotation
+            # stays the fast half-split form
             rope_interleave=cfg.get(
                 "rope_interleave",
-                cfg.get("model_type", "").startswith("deepseek")
-                and bool(cfg.get("kv_lora_rank")),
+                (cfg.get("model_type", "").startswith("deepseek")
+                 and bool(cfg.get("kv_lora_rank"))) or is_glm,
             ),
             # with per-layer windows the GLOBAL width stays 0 — the
             # homogeneous paths/gates must not window every layer
@@ -344,7 +359,7 @@ class ModelConfig:
             if is_gemma2 else 0.0,
             final_softcap=(cfg.get("final_logit_softcapping") or 0.0)
             if is_gemma2 else 0.0,
-            post_norms=is_gemma2 or is_gemma3,
+            post_norms=is_gemma2 or is_gemma3 or is_glm4,
             attn_scale_base=(cfg.get("query_pre_attn_scalar") or 0)
             if (is_gemma2 or is_gemma3) else 0,
             rope_local_theta=(cfg.get("rope_local_base_freq") or 0.0)

@@ -113,19 +113,23 @@ def load_llama_params(
         return _resolve(name) in name_to_file
 
     def deinterleave_rope(w: np.ndarray, n_head: int, d_head: int,
-                          d_rope: int) -> np.ndarray:
-        """DeepSeek stores rope dims interleaved (GPT-J pairs); reorder
-        the rope columns of a [in, n_head*d_head] projection (rope dims
-        are the LAST d_rope of each head) to the half-split layout the
-        runtime rotation uses."""
+                          d_rope: int, leading: bool = False) -> np.ndarray:
+        """GPT-J-pair rope columns -> the half-split layout the runtime
+        rotation uses, for a [..., n_head*d_head] projection (or stacked
+        bias). DeepSeek/MLA interleaves the TRAILING d_rope dims of each
+        head; GLM (``leading=True``) the LEADING ones."""
         if not cfg.rope_interleave:
             return w
-        v = w.reshape(w.shape[0], n_head, d_head)
-        rope = v[..., d_head - d_rope:]
+        v = w.reshape(*w.shape[:-1], n_head, d_head)
         perm = np.concatenate(
             [np.arange(0, d_rope, 2), np.arange(1, d_rope, 2)]
         )
-        v = np.concatenate([v[..., : d_head - d_rope], rope[..., perm]], -1)
+        if leading:
+            v = np.concatenate([v[..., :d_rope][..., perm],
+                                v[..., d_rope:]], -1)
+        else:
+            v = np.concatenate([v[..., : d_head - d_rope],
+                                v[..., d_head - d_rope:][..., perm]], -1)
         return v.reshape(w.shape)
 
     def attn_leaves(rng) -> dict:
@@ -133,7 +137,27 @@ def load_llama_params(
             "attn_norm": stack("model.layers.{i}.input_layernorm.weight",
                                rng, transpose=False),
         }
-        if cfg.post_norms:
+        glm4_norms = cfg.post_norms and has(
+            "model.layers.{}.post_self_attn_layernorm.weight"
+            .format(next(iter(rng)))
+        )
+        if glm4_norms:
+            # glm-4 sandwich naming: post_self_attn / post_mlp norms,
+            # with post_attention_layernorm keeping its llama meaning
+            # (the pre-FFN norm)
+            out["attn_post_norm"] = stack(
+                "model.layers.{i}.post_self_attn_layernorm.weight",
+                rng, transpose=False,
+            )
+            out["mlp_norm"] = stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                rng, transpose=False,
+            )
+            out["mlp_post_norm"] = stack(
+                "model.layers.{i}.post_mlp_layernorm.weight",
+                rng, transpose=False,
+            )
+        elif cfg.post_norms:
             # gemma-2 sandwich norms: post_attention_layernorm is the
             # ATTENTION OUTPUT norm here (not the pre-FFN norm it names
             # in llama-family checkpoints)
@@ -214,6 +238,21 @@ def load_llama_params(
                                   rng, transpose=False)
                 out["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias",
                                   rng, transpose=False)
+            if cfg.rope_interleave:
+                # GLM: the LEADING partial-rotary dims of every head are
+                # stored as GPT-J pairs; permuting q AND k the same way
+                # leaves attention scores identical while the runtime
+                # keeps the fast half-split rotation
+                rot = cfg.rope_partial_dim or cfg.head_dim
+                for key, n_head in (("wq", cfg.num_heads),
+                                    ("wk", cfg.num_kv_heads),
+                                    ("bq", cfg.num_heads),
+                                    ("bk", cfg.num_kv_heads)):
+                    if key in out:
+                        out[key] = deinterleave_rope(
+                            out[key], n_head, cfg.head_dim, rot,
+                            leading=True,
+                        )
             if cfg.qk_norm:  # qwen3 per-head q/k norms, weight [head_dim]
                 out["q_norm"] = stack(
                     "model.layers.{i}.self_attn.q_norm.weight", rng,

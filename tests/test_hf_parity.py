@@ -428,6 +428,52 @@ def test_gemma3_multimodal_checkpoint_text_serving(tmp_path):
     _compare(path, TOKENS, model, atol=5e-4)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "GlmConfig"),
+    reason="transformers too old for GLM",
+)
+def test_glm_parity(tmp_path):
+    """GLM (glm-4-9b legacy arch): INTERLEAVED partial rotary on the
+    leading head dims (de-interleaved at load — q and k permute
+    identically so scores are unchanged), qkv bias, fused gate_up."""
+    hf_cfg = transformers.GlmConfig(
+        **TINY, head_dim=16, pad_token_id=0,
+    )
+    model = transformers.GlmForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith("bias"):
+                p.normal_(0.0, 0.1)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.rope_interleave and cfg.rope_partial_dim == 8
+    assert cfg.attention_bias
+    _compare(path, TOKENS, model)
+
+
+@pytest.mark.skipif(
+    not hasattr(transformers, "Glm4Config"),
+    reason="transformers too old for GLM-4",
+)
+def test_glm4_parity(tmp_path):
+    """GLM-4 (0414): GLM plus EXTRA sandwich norms (post_self_attn /
+    post_mlp), with post_attention_layernorm keeping its llama meaning."""
+    hf_cfg = transformers.Glm4Config(
+        **TINY, head_dim=16, pad_token_id=0,
+    )
+    model = transformers.Glm4ForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith("bias"):
+                p.normal_(0.0, 0.1)
+            if "post_self_attn" in name or "post_mlp" in name:
+                p.normal_(1.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.post_norms and cfg.rope_interleave
+    _compare(path, TOKENS, model)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
