@@ -49,6 +49,11 @@ class ModelConfig:
     attention_bias: bool = False
     # qwen3: per-head RMS norm on q and k after projection, before rope
     qk_norm: bool = False
+    # olmo-2: q/k RMS norm over the FULL projection width (pre-reshape)
+    qk_norm_full: bool = False
+    # olmo-2: NO input/pre-FFN norms — normalization applies to the
+    # sublayer OUTPUT (post_norms) only
+    norm_after: bool = False
     # MoE (0 experts = dense)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -209,6 +214,9 @@ class ModelConfig:
                 "GlmForCausalLM / Glm4ForCausalLM are implemented"
             )
         is_glm = bool(glm_archs) or cfg.get("model_type") in ("glm", "glm4")
+        is_olmo2 = any(a.startswith("Olmo2") for a in archs) or (
+            cfg.get("model_type") == "olmo2"
+        )
         is_glm4 = "Glm4ForCausalLM" in glm_archs or (
             cfg.get("model_type") == "glm4"
         )
@@ -296,7 +304,8 @@ class ModelConfig:
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
             attention_bias=qkv_bias,
             # qwen3 (dense and MoE): per-head q/k RMS norm, no qkv bias
-            qk_norm=any(a.startswith("Qwen3") for a in archs) or is_gemma3,
+            qk_norm=any(a.startswith("Qwen3") for a in archs) or is_gemma3
+            or is_olmo2,
             layer_windows=layer_windows,
             attn_sinks=is_gptoss,
             moe_act="gptoss_clamp" if is_gptoss else "swiglu",
@@ -359,7 +368,9 @@ class ModelConfig:
             if is_gemma2 else 0.0,
             final_softcap=(cfg.get("final_logit_softcapping") or 0.0)
             if is_gemma2 else 0.0,
-            post_norms=is_gemma2 or is_gemma3 or is_glm4,
+            post_norms=is_gemma2 or is_gemma3 or is_glm4 or is_olmo2,
+            norm_after=is_olmo2,
+            qk_norm_full=is_olmo2,
             attn_scale_base=(cfg.get("query_pre_attn_scalar") or 0)
             if (is_gemma2 or is_gemma3) else 0,
             rope_local_theta=(cfg.get("rope_local_base_freq") or 0.0)

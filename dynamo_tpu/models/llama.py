@@ -61,8 +61,12 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
         ).astype(dt)
 
     layers = {
-        "attn_norm": jnp.ones((L, E), dt),
-        "mlp_norm": jnp.ones((L, E), dt),
+        **(
+            {} if cfg.norm_after else {
+                "attn_norm": jnp.ones((L, E), dt),
+                "mlp_norm": jnp.ones((L, E), dt),
+            }
+        ),
         **(
             {"attn_post_norm": jnp.ones((L, E), dt),
              "mlp_post_norm": jnp.ones((L, E), dt)}
@@ -91,7 +95,10 @@ def _init_layer_group(cfg: ModelConfig, key: jax.Array, L: int,
             layers["bq"] = jnp.zeros((L, H * D), dt)
             layers["bk"] = jnp.zeros((L, Hkv * D), dt)
             layers["bv"] = jnp.zeros((L, Hkv * D), dt)
-        if cfg.qk_norm:
+        if cfg.qk_norm_full:  # olmo-2: full projection width
+            layers["q_norm"] = jnp.ones((L, H * D), dt)
+            layers["k_norm"] = jnp.ones((L, Hkv * D), dt)
+        elif cfg.qk_norm:
             layers["q_norm"] = jnp.ones((L, D), dt)
             layers["k_norm"] = jnp.ones((L, D), dt)
         if cfg.attn_sinks:
@@ -216,6 +223,14 @@ def attn_query_scale(cfg: ModelConfig) -> float:
     """Query scale: head_dim**-0.5, or gemma-2's fixed
     query_pre_attn_scalar**-0.5."""
     return (cfg.attn_scale_base or cfg.head_dim) ** -0.5
+
+
+def pre_norm(lp: dict, key: str, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Pre-sublayer RMS norm — identity for norm-AFTER families (OLMo-2
+    carries no input/pre-FFN norms; normalization happens on the
+    sublayer output via post_norm)."""
+    w = lp.get(key)
+    return x if w is None else rms_norm(x, w, cfg.rms_norm_eps)
 
 
 def post_norm(lp: dict, key: str, v: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -728,6 +743,9 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     v = _mm(x, lp["wv"])
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    if cfg.qk_norm_full:  # olmo-2: norm the FLAT projection pre-reshape
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     # head counts derive from the projection width, not cfg: under a
     # manual-tp shard_map (parallel/pp.py) lp holds per-device column
     # shards, so this one function serves both global and tp-local views
@@ -735,7 +753,8 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
     q = q.reshape(x.shape[:-1] + (q.shape[-1] // D, D))
     k = k.reshape(x.shape[:-1] + (k.shape[-1] // D, D))
     v = v.reshape(x.shape[:-1] + (v.shape[-1] // D, D))
-    if cfg.qk_norm:  # qwen3: per-head RMS norm before rope, weight [D]
+    if cfg.qk_norm and not cfg.qk_norm_full:
+        # qwen3: per-head RMS norm before rope, weight [D]
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
@@ -814,7 +833,7 @@ def prefill(
     def body(carry, layer_in, window=cfg.sliding_window, freqs=None):
         x = carry
         lp, kc, vc = layer_in
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "attn_norm", x, cfg)
         if cfg.is_mla:
             from . import mla
 
@@ -885,7 +904,7 @@ def prefill(
                 lp, "attn_post_norm",
                 _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
             )
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "mlp_norm", x, cfg)
         x = x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
         return x, (kc, vc)
 
@@ -951,13 +970,13 @@ def _decode_body(
         x = x + post_norm(
             lp, "attn_post_norm", _mm_b(o.reshape(B, -1), lp, "wo", "bo"), cfg
         )
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "mlp_norm", x, cfg)
         return x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
 
     inv_local_dec = _rope_freqs_local(cfg)
 
     def layer_qkv(x, lp, freqs=None):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "attn_norm", x, cfg)
         q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
         fr = inv_freq if freqs is None else freqs
         q = apply_rope(q, positions, fr, rope_msc)
@@ -968,7 +987,7 @@ def _decode_body(
         """One MLA decode layer against full cache layers kc_l/vc_l:
         write the token's latent, absorbed attention (latent kernel when
         use_pallas, XLA gather otherwise), output fold."""
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "attn_norm", x, cfg)
         q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
             lp, cfg, h, positions, inv_freq, msc
         )
@@ -1026,7 +1045,7 @@ def _decode_body(
             for li in range(n):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
-                h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+                h = pre_norm(lp, "attn_norm", x, cfg)
                 q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
                     lp, cfg, h, positions, inv_freq, msc
                 )
@@ -1352,7 +1371,7 @@ def _verify_forward(
             for li in range(ng):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
-                h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+                h = pre_norm(lp, "attn_norm", x, cfg)
                 q_eff, q_pe, c_kv, k_pe = _mla.mla_q_and_latent(
                     lp, cfg, h, pos_bt, inv_freq, msc
                 )
@@ -1366,7 +1385,7 @@ def _verify_forward(
                 )
                 o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
                 x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
-                h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+                h = pre_norm(lp, "mlp_norm", x, cfg)
                 x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
                     B, T, E
                 )
@@ -1390,7 +1409,7 @@ def _verify_forward(
         for li in range(ng):
             l = goff + li
             lp = jax.tree.map(lambda a: a[li], lps)
-            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            h = pre_norm(lp, "attn_norm", x, cfg)
             q, k, v = _qkv(lp, cfg, h)  # [B, T, H/Hkv, D]
             fr = rope_freqs_for_layer(cfg, l, inv_freq, inv_local)
             q = apply_rope(q, pos_bt, fr, rope_msc)
@@ -1418,7 +1437,7 @@ def _verify_forward(
                 _mm_b(o.reshape(B * T, -1), lp, "wo", "bo").reshape(B, T, E),
                 cfg,
             )
-            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = pre_norm(lp, "mlp_norm", x, cfg)
             x = x + post_norm(
                 lp, "mlp_post_norm",
                 _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(B, T, E),
@@ -1588,7 +1607,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
     inv_local = _rope_freqs_local(cfg)
 
     def body(x, lp, window=cfg.sliding_window, freqs=None):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "attn_norm", x, cfg)
         if cfg.is_mla:
             # DELIBERATELY independent of mla.mla_q_and_latent: this is
             # the ground-truth NAIVE formulation (reconstruct full K/V,
@@ -1649,7 +1668,7 @@ def dense_forward(params: dict, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.nd
                 lp, "attn_post_norm",
                 _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
             )
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = pre_norm(lp, "mlp_norm", x, cfg)
         x = x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h), cfg)
         return x, None
 

@@ -133,15 +133,28 @@ def load_llama_params(
         return v.reshape(w.shape)
 
     def attn_leaves(rng) -> dict:
-        out = {
-            "attn_norm": stack("model.layers.{i}.input_layernorm.weight",
-                               rng, transpose=False),
-        }
+        out = {}
+        if not cfg.norm_after:  # olmo-2 has no input norms at all
+            out["attn_norm"] = stack(
+                "model.layers.{i}.input_layernorm.weight",
+                rng, transpose=False,
+            )
         glm4_norms = cfg.post_norms and has(
             "model.layers.{}.post_self_attn_layernorm.weight"
             .format(next(iter(rng)))
         )
-        if glm4_norms:
+        if cfg.norm_after:
+            # olmo-2: ONLY output norms exist — post_attention on the
+            # attention output, post_feedforward on the MLP output
+            out["attn_post_norm"] = stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                rng, transpose=False,
+            )
+            out["mlp_post_norm"] = stack(
+                "model.layers.{i}.post_feedforward_layernorm.weight",
+                rng, transpose=False,
+            )
+        elif glm4_norms:
             # glm-4 sandwich naming: post_self_attn / post_mlp norms,
             # with post_attention_layernorm keeping its llama meaning
             # (the pre-FFN norm)
@@ -531,13 +544,16 @@ def save_llama_params(path: str, params: dict, cfg=None) -> None:
                             lay[key][li], np.float32
                         ).T.copy()
 
+    def n_layers(group: dict) -> int:
+        # attn_norm is absent for norm-after (olmo-2) params — count
+        # from any leaf (all are layer-stacked on axis 0)
+        return next(iter(group.values())).shape[0]
+
     kd = 0
     if "dense_layers" in params:
-        kd = params["dense_layers"]["attn_norm"].shape[0]
+        kd = n_layers(params["dense_layers"])
         save_group(params["dense_layers"], kd, 0)
-    save_group(
-        params["layers"], params["layers"]["attn_norm"].shape[0], kd
-    )
+    save_group(params["layers"], n_layers(params["layers"]), kd)
     if "lm_head" in params:
         flat["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T.copy()
     save_file(flat, os.path.join(path, "model.safetensors"))

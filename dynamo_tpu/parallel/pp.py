@@ -76,6 +76,7 @@ def can_pipeline(mesh, cfg: ModelConfig, T: int, n_micro: int) -> bool:
         # gemma-2 softcap/sandwich norms live in the XLA unrolled paths
         and not cfg.attn_softcap
         and not cfg.post_norms
+        and not cfg.norm_after
         and cfg.num_layers % pp == 0
         and n_micro >= 1
         and T % n_micro == 0

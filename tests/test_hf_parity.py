@@ -474,6 +474,26 @@ def test_glm4_parity(tmp_path):
     _compare(path, TOKENS, model)
 
 
+@pytest.mark.skipif(
+    not hasattr(transformers, "Olmo2Config"),
+    reason="transformers too old for OLMo-2",
+)
+def test_olmo2_parity(tmp_path):
+    """OLMo-2: norm-AFTER architecture — no input/pre-FFN norms,
+    post_attention/post_feedforward norms on the sublayer OUTPUTS —
+    plus q/k RMS norms over the FULL projection width (pre-reshape)."""
+    hf_cfg = transformers.Olmo2Config(**TINY, pad_token_id=0)
+    model = transformers.Olmo2ForCausalLM(hf_cfg)
+    with torch.no_grad():  # non-trivial norms so ordering shows
+        for name, p in model.named_parameters():
+            if "norm" in name:
+                p.normal_(1.0, 0.3)
+    path = _save(tmp_path, model)
+    cfg = ModelConfig.from_local_path(path)
+    assert cfg.norm_after and cfg.post_norms and cfg.qk_norm_full
+    _compare(path, TOKENS, model)
+
+
 def test_mistral_parity(tmp_path):
     hf_cfg = transformers.MistralConfig(**TINY, sliding_window=None)
     model = transformers.MistralForCausalLM(hf_cfg)
