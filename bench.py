@@ -92,6 +92,34 @@ def _cached_silicon_result():
     return cached
 
 
+def _modeled_roofline_citation() -> dict:
+    """Fields citing the chip-free roofline model (VERDICT r4 next #1:
+    the bench artifact must carry a modeled MFU even when the relay is
+    dead). Values come from the committed benchmarks/roofline_model.json
+    — regression-locked to the code by tests/test_roofline.py — not
+    recomputed here, so a wedged relay can't take the citation down."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "roofline_model.json")
+    try:
+        with open(path) as f:
+            recs = {r["scenario"]: r for r in json.load(f)}
+        r8 = recs["8b-int8-v5e1"]
+        r70 = recs["70b-int8-v5p8-tp8"]
+        return {
+            "modeled_8b_int8_v5e_tok_s_chip": round(
+                r8["decode_tok_s_chip_modeled"], 1),
+            "modeled_8b_int8_v5e_mfu": round(r8["decode_mfu_modeled"], 4),
+            "modeled_70b_int8_v5p8_tok_s_chip": round(
+                r70["decode_tok_s_chip_modeled"], 1),
+            "modeled_70b_int8_v5p8_mfu": round(r70["decode_mfu_modeled"], 4),
+            "modeled_source": "benchmarks/roofline_model.json",
+        }
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return {"modeled_source": f"unavailable ({type(e).__name__})"}
+
+
 SMOKE_HISTORY = "benchmarks/smoke_history.jsonl"
 SMOKE_BAND = 0.85  # flag a smoke run below 85% of the recent median
 
@@ -248,6 +276,7 @@ def main() -> None:
     # change must actually run the decode path, not replay a number
     explicit_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
     if on_cpu and cached is not None and not explicit_cpu:
+        cached.update(_modeled_roofline_citation())
         print(json.dumps(cached))
         return
     if on_cpu:
@@ -305,6 +334,7 @@ def main() -> None:
     }
     if on_cpu:
         _track_smoke(result)
+    result.update(_modeled_roofline_citation())
     print(json.dumps(result))
 
 

@@ -418,3 +418,57 @@ class ModelConfig:
         )
         base.update(overrides)
         return ModelConfig(**base)
+
+    # llama-3-70b (BASELINE config 4: the disagg + router north star)
+    @staticmethod
+    def llama3_70b(**overrides) -> "ModelConfig":
+        base = dict(
+            vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+            num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+            rope_theta=500000.0, max_position_embeddings=8192,
+        )
+        base.update(overrides)
+        return ModelConfig(**base)
+
+    # mixtral-8x22b (BASELINE config 5 alternative: classic EP decode)
+    @staticmethod
+    def mixtral_8x22b(**overrides) -> "ModelConfig":
+        base = dict(
+            vocab_size=32768, hidden_size=6144, intermediate_size=16384,
+            num_layers=56, num_heads=48, num_kv_heads=8, head_dim=128,
+            rope_theta=1000000.0, max_position_embeddings=65536,
+            num_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=16384, norm_topk_prob=True,
+        )
+        base.update(overrides)
+        return ModelConfig(**base)
+
+    # deepseek-r1 = the DeepSeek-V3 architecture (BASELINE config 5
+    # flagship: MLA latent cache + 256-expert sigmoid-scored MoE).
+    # Shape fields follow the published V3 config.json.
+    @staticmethod
+    def deepseek_r1(**overrides) -> "ModelConfig":
+        base = dict(
+            vocab_size=129280, hidden_size=7168, intermediate_size=18432,
+            num_layers=61, num_heads=128, num_kv_heads=128,
+            rope_theta=10000.0, max_position_embeddings=163840,
+            num_experts=256, num_experts_per_tok=8,
+            moe_intermediate_size=2048, num_shared_experts=1,
+            first_dense_layers=3, norm_topk_prob=True,
+            moe_scoring="sigmoid", moe_gate_bias=True,
+            routed_scaling_factor=2.5, n_group=8, topk_group=4,
+            moe_group_score="top2",
+            q_lora_rank=1536, kv_lora_rank=512,
+            qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+            # the published config.json's YaRN extension (4k→160k) and
+            # GPT-J-interleaved rope storage — required for correct
+            # logits when real R1 weights load through this preset
+            rope_scaling=dict(
+                type="yarn", factor=40.0, beta_fast=32.0, beta_slow=1.0,
+                mscale=1.0, mscale_all_dim=1.0,
+                original_max_position_embeddings=4096,
+            ),
+            rope_interleave=True,
+        )
+        base.update(overrides)
+        return ModelConfig(**base)

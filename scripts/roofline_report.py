@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Regenerate the chip-free roofline artifact + docs table.
+
+Usage:
+    python scripts/roofline_report.py          # print the table
+    python scripts/roofline_report.py --write  # also update
+                                               # benchmarks/roofline_model.json
+                                               # and the docs/performance.md
+                                               # section between the markers
+
+The numbers come from dynamo_tpu.perf.roofline (cost_analysis() FLOPs of
+the real jits + the analytic Pallas-path byte stream — see that module's
+docstring for the full methodology and the two documented cost-model
+corrections).  tests/test_roofline.py locks the committed artifact to the
+current code; if it fails after a model change, run this with --write and
+commit the refreshed table.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# CPU-only analysis: must win the race against the site hook's platform
+# snapshot (see scripts/tpu_watch.sh conventions)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from dynamo_tpu.perf import roofline as R  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+ART = os.path.join(REPO, "benchmarks", "roofline_model.json")
+DOC = os.path.join(REPO, "docs", "performance.md")
+BEGIN = "<!-- roofline:begin (scripts/roofline_report.py --write) -->"
+END = "<!-- roofline:end -->"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--write", action="store_true")
+    args = p.parse_args()
+
+    recs = R.analyze_all()
+    table = R.to_markdown(recs)
+    print(table)
+
+    if args.write:
+        with open(ART, "w") as f:
+            json.dump(recs, f, indent=1)
+        with open(DOC) as f:
+            doc = f.read()
+        if BEGIN in doc and END in doc:
+            head, rest = doc.split(BEGIN, 1)
+            _, tail = rest.split(END, 1)
+            doc = head + BEGIN + "\n\n" + table + "\n\n" + END + tail
+            with open(DOC, "w") as f:
+                f.write(doc)
+            print(f"\nwrote {ART} and refreshed the {DOC} table")
+        else:
+            print(f"\nwrote {ART}; no markers in {DOC} — table NOT embedded",
+                  file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
