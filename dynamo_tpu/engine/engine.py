@@ -136,6 +136,10 @@ class EngineConfig:
     # per-output-channel scales; halves decode's HBM weight streaming, the
     # ref's FP8 serving equivalent, docs/architecture.md:57-61)
     quantization: str = "none"
+    # quantization covers MoE expert stacks by default (the grouped-
+    # dequant Pallas kernel streams them at storage width,
+    # ops/moe_gmm_pallas.py); False pins experts at the model dtype
+    quant_experts: bool = True
     # KV cache storage dtype: "model" | "float8_e4m3" | "bfloat16"
     # (float8 = scale-free direct cast, vLLM fp8-KV approach; halves KV
     # HBM traffic + doubles cache capacity at some quality cost)
@@ -220,7 +224,8 @@ class JaxEngine(AsyncEngine):
 
         # quantize BEFORE placement so the derived q/s leaves get their
         # own shardings (parallel/mesh.py derives them from the parent's)
-        params = quantize_params(params, mcfg, cfg.quantization)
+        params = quantize_params(params, mcfg, cfg.quantization,
+                                 experts=cfg.quant_experts)
         if mirror is not None:
             params = mirror.shard_params(params)
         elif self.mesh is not None:

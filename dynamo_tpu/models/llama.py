@@ -514,6 +514,36 @@ def _expert_act(cfg: ModelConfig, g: jnp.ndarray, u: jnp.ndarray):
     return jax.nn.silu(g) * u
 
 
+def _ragged_mm(xs, w, group_sizes, use_pallas: bool, interpret: bool):
+    """Grouped matmul against a plain or int8/fp8-quantized expert stack
+    — the ragged twin of ``_mm``. Quantized stacks ({"q", "s"} nodes,
+    models/quant.py) ride the in-repo grouped-dequant Pallas kernel so
+    the weights stream from HBM at storage width
+    (ops/moe_gmm_pallas.py); without use_pallas they fall back to the
+    dequantize-then-ragged_dot XLA reference (CPU tests — the fallback
+    materializes the bf16 stack and exists for correctness only)."""
+    if isinstance(w, dict):
+        from ..ops.moe_gmm_pallas import ragged_int8_gmm, ragged_int8_xla
+
+        if use_pallas:
+            out = ragged_int8_gmm(xs, w["q"], w["s"], group_sizes,
+                                  interpret=interpret)
+        else:
+            out = ragged_int8_xla(xs, w["q"], w["s"], group_sizes)
+        return out.astype(xs.dtype)
+    return lax.ragged_dot(xs, w, group_sizes)
+
+
+def _dense_expert_mm(x, w, spec: str):
+    """Dense-dispatch einsum against a plain or quantized expert stack:
+    both dispatch einsums produce [T, X, out] with scales [X, out], so
+    one broadcast covers gate/up and down."""
+    if isinstance(w, dict):
+        out = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        return out * w["s"][None].astype(out.dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def _moe_combine(o, t_sorted, w_sorted, T: int, dtype):
     """Scatter-add expert outputs back to token rows. ``t_sorted`` entries
     of masked rows point at the sacrificial row T, sliced off."""
@@ -523,7 +553,8 @@ def _moe_combine(o, t_sorted, w_sorted, T: int, dtype):
 
 
 def moe_ffn(
-    lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh=None
+    lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh=None,
+    use_pallas: bool = False, interpret: bool = False,
 ) -> jnp.ndarray:
     """Mixtral/DeepSeek-style sparse MoE FFN with RAGGED dispatch (ref
     serves these via vLLM's fused_moe grouped-GEMM CUDA kernels; the TPU
@@ -555,17 +586,19 @@ def moe_ffn(
     out_dt = x.dtype
     if mesh is None:
         t_sorted, w_sorted, e_sorted, group_sizes = _moe_route(lp, cfg, x)
-        g = lax.ragged_dot(x[t_sorted], lp["we_gate"], group_sizes)
-        u = lax.ragged_dot(x[t_sorted], lp["we_up"], group_sizes)
+        xs = x[t_sorted]
+        g = _ragged_mm(xs, lp["we_gate"], group_sizes, use_pallas, interpret)
+        u = _ragged_mm(xs, lp["we_up"], group_sizes, use_pallas, interpret)
         if "be_gate" in lp:  # gpt-oss per-expert projection biases
             g = g + lp["be_gate"][e_sorted]
             u = u + lp["be_up"][e_sorted]
-        o = lax.ragged_dot(_expert_act(cfg, g, u), lp["we_down"], group_sizes)
+        o = _ragged_mm(_expert_act(cfg, g, u), lp["we_down"], group_sizes,
+                       use_pallas, interpret)
         if "be_down" in lp:
             o = o + lp["be_down"][e_sorted]
         out = _moe_combine(o, t_sorted, w_sorted, T, out_dt)
     elif _moe_can_shard(mesh, cfg):
-        out = _moe_ragged_sharded(lp, cfg, x, mesh)
+        out = _moe_ragged_sharded(lp, cfg, x, mesh, use_pallas, interpret)
         if "be_down" in lp:
             # the down-projection bias is added OUTSIDE the shard_map:
             # inside, the tp psum over the Fm contraction would count it
@@ -610,12 +643,12 @@ def _moe_dense_dispatch(lp: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarr
         * vals[..., None],
         axis=1,
     )  # [T, X] routing weights
-    g = jnp.einsum("te,xef->txf", x, lp["we_gate"])
-    u = jnp.einsum("te,xef->txf", x, lp["we_up"])
+    g = _dense_expert_mm(x, lp["we_gate"], "te,xef->txf")
+    u = _dense_expert_mm(x, lp["we_up"], "te,xef->txf")
     if "be_gate" in lp:  # gpt-oss per-expert projection biases
         g = g + lp["be_gate"][None]
         u = u + lp["be_up"][None]
-    y = jnp.einsum("txf,xfe->txe", _expert_act(cfg, g, u), lp["we_down"])
+    y = _dense_expert_mm(_expert_act(cfg, g, u), lp["we_down"], "txf,xfe->txe")
     if "be_down" in lp:
         y = y + lp["be_down"][None]
     return jnp.einsum("txe,tx->te", y, w.astype(x.dtype))
@@ -638,7 +671,8 @@ def _moe_can_shard(mesh, cfg: ModelConfig) -> bool:
     )
 
 
-def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
+def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh,
+                        use_pallas: bool = False, interpret: bool = False):
     """shard_map body for ragged MoE over (ep, tp); other axes stay auto.
 
     gpt-oss rides this path too: the router LOGIT bias is replicated into
@@ -682,8 +716,8 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
         valid = jnp.arange(R) < count
         t_l = jnp.where(valid, t_l, T)  # sacrificial combine row
         w_l = jnp.where(valid, w_l, 0.0)
-        g = lax.ragged_dot(xs, we_gate, gs_local)
-        u = lax.ragged_dot(xs, we_up, gs_local)
+        g = _ragged_mm(xs, we_gate, gs_local, use_pallas, interpret)
+        u = _ragged_mm(xs, we_up, gs_local, use_pallas, interpret)
         if has_eb:
             # window row r's LOCAL expert: first local group whose
             # cumulative size exceeds r (masked tail rows clamp to the
@@ -694,7 +728,8 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
             e_l = jnp.minimum(e_l, Xl - 1)
             g = g + be_gate[e_l]
             u = u + be_up[e_l]
-        o = lax.ragged_dot(_expert_act(cfg, g, u), we_down, gs_local)
+        o = _ragged_mm(_expert_act(cfg, g, u), we_down, gs_local,
+                       use_pallas, interpret)
         out = _moe_combine(o, t_l, w_l, T, out_dt)
         return lax.psum(out, ("ep", "tp"))
 
@@ -702,7 +737,17 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
         v = lp.get(key)
         return v if v is not None else jnp.zeros(shape, jnp.float32)
 
-    Fm = lp["we_gate"].shape[-1]
+    def _wspec(w, spec: P) -> object:
+        # quantized stacks ({"q", "s"}) shard q like the plain weight
+        # and s with the contraction axis dropped (mirrors
+        # parallel/mesh._spec_for's derivation for the placed pytree)
+        if isinstance(w, dict):
+            ps = tuple(spec)
+            return {"q": spec, "s": P(*ps[:-2], ps[-1])}
+        return spec
+
+    wg, wu, wd = lp["we_gate"], lp["we_up"], lp["we_down"]
+    Fm = (wg["q"] if isinstance(wg, dict) else wg).shape[-1]
     return jax.shard_map(
         body,
         mesh=mesh,
@@ -711,24 +756,26 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh):
             P(),  # router weights replicated
             P(),  # V3 no-aux gate bias (zeros when absent)
             P(),  # gpt-oss router logit bias (zeros when absent)
-            P("ep", None, "tp"),  # we_gate [X, E, Fm]
-            P("ep", None, "tp"),  # we_up
-            P("ep", "tp", None),  # we_down [X, Fm, E]
+            _wspec(wg, P("ep", None, "tp")),  # we_gate [X, E, Fm]
+            _wspec(wu, P("ep", None, "tp")),  # we_up
+            _wspec(wd, P("ep", "tp", None)),  # we_down [X, Fm, E]
             P("ep", "tp"),  # be_gate [X, Fm] (zeros when absent)
             P("ep", "tp"),  # be_up
         ),
         out_specs=P(),
         check_vma=False,
     )(x, lp["moe_gate"], _z("moe_gate_bias", (X,)),
-      _z("moe_router_bias", (X,)), lp["we_gate"], lp["we_up"],
-      lp["we_down"], _z("be_gate", (X, Fm)), _z("be_up", (X, Fm)))
+      _z("moe_router_bias", (X,)), wg, wu, wd,
+      _z("be_gate", (X, Fm)), _z("be_up", (X, Fm)))
 
 
-def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None) -> jnp.ndarray:
+def _ffn(lp: dict, cfg: ModelConfig, h: jnp.ndarray, mesh=None,
+         use_pallas: bool = False, interpret: bool = False) -> jnp.ndarray:
     # branch on the GROUP's own leaves, not cfg.is_moe: DeepSeek's
     # first_k_dense_replace layers are dense inside an MoE model
     if "moe_gate" in lp:
-        return moe_ffn(lp, cfg, h, mesh=mesh)
+        return moe_ffn(lp, cfg, h, mesh=mesh, use_pallas=use_pallas,
+                       interpret=interpret)
     return swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"], cfg.hidden_act)
 
 
@@ -905,7 +952,10 @@ def prefill(
                 _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
             )
         h = pre_norm(lp, "mlp_norm", x, cfg)
-        x = x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
+        x = x + post_norm(
+            lp, "mlp_post_norm",
+            _ffn(lp, cfg, h, mesh=mesh, use_pallas=use_pallas), cfg,
+        )
         return x, (kc, vc)
 
     if cfg.layer_windows:
@@ -971,7 +1021,11 @@ def _decode_body(
             lp, "attn_post_norm", _mm_b(o.reshape(B, -1), lp, "wo", "bo"), cfg
         )
         h = pre_norm(lp, "mlp_norm", x, cfg)
-        return x + post_norm(lp, "mlp_post_norm", _ffn(lp, cfg, h, mesh=mesh), cfg)
+        return x + post_norm(
+            lp, "mlp_post_norm",
+            _ffn(lp, cfg, h, mesh=mesh, use_pallas=use_pallas,
+                 interpret=interpret), cfg,
+        )
 
     inv_local_dec = _rope_freqs_local(cfg)
 
@@ -1386,9 +1440,10 @@ def _verify_forward(
                 o = _mla._o_proj(lp, cfg, o).astype(x.dtype)
                 x = x + _mm(o.reshape(B * T, -1), lp["wo"]).reshape(B, T, E)
                 h = pre_norm(lp, "mlp_norm", x, cfg)
-                x = x + _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(
-                    B, T, E
-                )
+                x = x + _ffn(
+                    lp, cfg, h.reshape(B * T, E), mesh=mesh,
+                    use_pallas=use_pallas, interpret=interpret,
+                ).reshape(B, T, E)
         x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         logits = _logits(params, cfg, x.reshape(B * T, E)).reshape(B, T, -1)
         k_cache, v_cache = kv_cache_append_tokens(
@@ -1440,7 +1495,9 @@ def _verify_forward(
             h = pre_norm(lp, "mlp_norm", x, cfg)
             x = x + post_norm(
                 lp, "mlp_post_norm",
-                _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh).reshape(B, T, E),
+                _ffn(lp, cfg, h.reshape(B * T, E), mesh=mesh,
+                     use_pallas=use_pallas, interpret=interpret,
+                     ).reshape(B, T, E),
                 cfg,
             )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

@@ -797,7 +797,8 @@ def run_follower(engine_cfg, params: Optional[dict] = None, seed: int = 0) -> No
         params = llama.init_params(mcfg, jax.random.key(seed))
     # same quantization as the leader: the mirrored jits must compile the
     # identical program on identically-typed params
-    params = quantize_params(params, mcfg, engine_cfg.quantization)
+    params = quantize_params(params, mcfg, engine_cfg.quantization,
+                             experts=engine_cfg.quant_experts)
     params = mirror.shard_params(params)
     k_cache, v_cache = mirror.init_cache(
         engine_cfg.num_blocks, engine_cfg.block_size,

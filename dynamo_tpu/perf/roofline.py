@@ -422,8 +422,9 @@ DEFAULT_SCENARIOS = (
              notes="BASELINE cfg 5 · Mixtral-8x22B · ep8 disagg decode"),
     Scenario("r1-v5p64-ep16tp4", "deepseek_r1", "v5p", 64, batch=256,
              isl=3000, osl=150, quant="int8", kv_dtype="float8_e4m3",
-             quant_experts=False, ep=16, tp=4, disagg=True,
-             notes="BASELINE cfg 5 · DeepSeek-R1 671B MLA · ep16·tp4"),
+             quant_experts=True, ep=16, tp=4, disagg=True,
+             notes="BASELINE cfg 5 · DeepSeek-R1 671B MLA · ep16·tp4 · "
+                   "int8 experts via the grouped-dequant kernel"),
 )
 
 
@@ -488,11 +489,23 @@ def analyze(sc: Scenario) -> dict:
     kv_push_bytes = sc.isl * kv_row_bytes(cfg, sc.kv_dtype)
     t_kv_push_ici = kv_push_bytes / chip.ici_link_bw
 
-    # blended aggregated serving: decode steps share the replica with
-    # prefills arriving at rate B/(OSL·t_step); each costs t_prefill of
-    # chip time — the term disaggregation deletes (ref's +30%/2x claim)
+    # blended aggregated serving: to emit B·OSL tokens the replica pays
+    # OSL decode steps PLUS B prefills of serial chip time, so
+    # tok/s = B·OSL / (OSL·t_step + B·t_prefill).  Disaggregation moves
+    # the B·t_prefill term onto dedicated prefill chips: the DECODE-side
+    # rate jumps by that whole term (the ITL/interference win), while
+    # the fleet as a whole must still fund prefill_chips_per_decode_chip
+    # = B·t_prefill/(OSL·t_step) extra chips — in pure chip-time
+    # arithmetic the two layouts tie, and the reference's measured
+    # +30%/2× (docs/architecture.md:57-61) is the serving-dynamics win
+    # (no prefill stalls in decode ITL, per-pool batching and
+    # parallelism) that a roofline cannot price.  Both sides of that
+    # decomposition are reported; no first-order fleet gain is claimed.
     def blended(t_step):
-        return sc.batch / (t_step + t_prefill / sc.osl) / sc.n_chips
+        return (sc.batch / (t_step + sc.batch * t_prefill / sc.osl)
+                / sc.n_chips)
+
+    pf_chips_per_decode_chip = sc.batch * t_prefill / (sc.osl * t_model)
 
     tok_s_chip_bound = sc.batch / t_bound / sc.n_chips
     tok_s_chip = sc.batch / t_model / sc.n_chips
@@ -535,7 +548,9 @@ def analyze(sc: Scenario) -> dict:
         "kv_push_bytes_per_req": kv_push_bytes,
         "kv_push_ici_ms": t_kv_push_ici * 1e3,
         "blended_agg_tok_s_chip": blended(t_model),
-        "disagg_gain_pct": (tok_s_chip / blended(t_model) - 1.0) * 100.0,
+        "disagg_decode_side_gain_pct": (
+            tok_s_chip / blended(t_model) - 1.0) * 100.0,
+        "prefill_chips_per_decode_chip": pf_chips_per_decode_chip,
         "notes": sc.notes,
         "assumptions": {
             "hbm_eff": HBM_EFF, "mxu_eff": MXU_EFF,
@@ -554,7 +569,8 @@ def to_markdown(records: list[dict]) -> str:
     """The docs/performance.md table."""
     head = ("| scenario | chip×n | quant/kv | B | modeled tok/s/chip "
             "(bound) | t_step ms | decode MFU | TTFT ms (prefill) | "
-            "agg→disagg | fits HBM |\n|---|---|---|---|---|---|---|---|---|---|")
+            "disagg decode-side | pf:dec chips | fits HBM |\n"
+            "|---|---|---|---|---|---|---|---|---|---|---|")
     rows = []
     for r in records:
         rows.append(
@@ -565,7 +581,8 @@ def to_markdown(records: list[dict]) -> str:
             f"| {r['t_step_modeled_ms']:.2f} "
             f"| {r['decode_mfu_modeled'] * 100:.1f}% "
             f"| {r['ttft_prefill_modeled_ms']:.0f} "
-            f"| +{r['disagg_gain_pct']:.0f}% "
+            f"| {r['disagg_decode_side_gain_pct']:+.0f}% "
+            f"| {r['prefill_chips_per_decode_chip']:.2f} "
             f"| {'yes' if r['hbm_fits'] else 'NO'} |")
     return head + "\n" + "\n".join(rows)
 
