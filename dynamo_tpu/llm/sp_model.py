@@ -13,16 +13,32 @@ this is a native implementation of the two pieces serving needs:
     same dynamic program sentencepiece runs) and **BPE** (iterated
     best-scoring adjacent merge), both with byte-fallback.
 
-Scope: encoding/decoding for serving. Training, NFKC normalization via
-the precompiled charsmap, and sampling-based segmentation are out of
-scope (the reference's sp.rs exposes exactly encode/decode too).
+Scope: encoding/decoding for serving. Training and sampling-based
+segmentation are out of scope (the reference's sp.rs exposes exactly
+encode/decode too).
+
+Normalization: at runtime sentencepiece normalizes through the
+``precompiled_charsmap`` ALONE (the name only records which ruleset
+was compiled), so the faithful gating is on the charsmap, not the
+name: an EMPTY charsmap is identity regardless of name
+(llama/mistral); a non-empty charsmap under one of the four standard
+names ("nfkc"/"nmt_nfkc"/"nfkc_cf"/"nmt_nfkc_cf") gets that ruleset's
+native implementation — Unicode NFKC via ``unicodedata``, the NMT
+cleanup (controls dropped, the Unicode space zoo collapsed to ASCII
+space, zero-widths deleted), casefold + default-ignorable removal for
+the "_cf" forms; a non-empty charsmap under ANY other name — including
+"identity", whose standard ruleset is empty — is custom user rules
+this reader cannot honor, and it refuses loudly rather than serving
+wrong tokenizations (VERDICT r4 weak #4; repo rule: reject over wrong
+logits).
 
 Wire-format field numbers (sentencepiece_model.proto):
   ModelProto: 1=pieces(repeated SentencePiece), 2=trainer_spec,
               3=normalizer_spec
   SentencePiece: 1=piece(string), 2=score(float), 3=type(enum)
   TrainerSpec: 3=model_type (1=UNIGRAM, 2=BPE, 3=WORD, 4=CHAR)
-  NormalizerSpec: 1=name, 3=add_dummy_prefix(bool),
+  NormalizerSpec: 1=name, 2=precompiled_charsmap(bytes),
+                  3=add_dummy_prefix(bool),
                   4=remove_extra_whitespaces(bool), 5=escape_whitespaces
 """
 
@@ -88,6 +104,12 @@ class Piece:
     type: int = NORMAL
 
 
+#: names whose compiled charsmap the native ruleset implementations
+#: reproduce ("identity" is deliberately absent: its standard ruleset
+#: is empty, so an identity proto CARRYING a charsmap is custom rules)
+KNOWN_NORMALIZERS = ("nfkc", "nmt_nfkc", "nfkc_cf", "nmt_nfkc_cf")
+
+
 @dataclass
 class SentencePieceModel:
     pieces: list[Piece]
@@ -95,6 +117,8 @@ class SentencePieceModel:
     add_dummy_prefix: bool = True
     remove_extra_whitespaces: bool = True
     escape_whitespaces: bool = True
+    normalizer_name: str = "identity"
+    has_charsmap: bool = False
     # derived
     _index: dict = field(default_factory=dict, repr=False)
     _byte_ids: dict = field(default_factory=dict, repr=False)
@@ -102,6 +126,15 @@ class SentencePieceModel:
     _max_piece_chars: int = 1
 
     def __post_init__(self):
+        if (self.has_charsmap
+                and self.normalizer_name not in KNOWN_NORMALIZERS):
+            raise ValueError(
+                f"normalizer {self.normalizer_name!r} carries a custom "
+                "precompiled_charsmap this reader cannot honor — refusing "
+                "rather than tokenizing wrongly (install-free SP support "
+                "covers the standard normalizers only: "
+                f"{KNOWN_NORMALIZERS})"
+            )
         for i, p in enumerate(self.pieces):
             if p.type == BYTE:
                 # byte pieces are spelled "<0xNN>"
@@ -127,6 +160,7 @@ class SentencePieceModel:
         pieces: list[Piece] = []
         model_type = UNIGRAM
         add_dummy = remove_extra = escape_ws = True
+        norm_name, has_charsmap = "identity", False
         for fnum, _, val in _fields(data):
             if fnum == 1:  # SentencePiece
                 text, score, ptype = "", 0.0, NORMAL
@@ -144,19 +178,30 @@ class SentencePieceModel:
                         model_type = tv
             elif fnum == 3:  # NormalizerSpec
                 for nf, _, nv in _fields(val):
-                    if nf == 3:
+                    if nf == 1:
+                        norm_name = nv.decode("utf-8")
+                    elif nf == 2:
+                        has_charsmap = len(nv) > 0
+                    elif nf == 3:
                         add_dummy = bool(nv)
                     elif nf == 4:
                         remove_extra = bool(nv)
                     elif nf == 5:
                         escape_ws = bool(nv)
         return SentencePieceModel(
-            pieces, model_type, add_dummy, remove_extra, escape_ws
+            pieces, model_type, add_dummy, remove_extra, escape_ws,
+            norm_name, has_charsmap,
         )
 
     # ---- normalization ----
 
     def _normalize(self, text: str) -> str:
+        # character normalization lives in the charsmap: no charsmap, no
+        # normalization (whatever the name says) — llama/mistral land here
+        if self.has_charsmap:
+            name = self.normalizer_name  # load guard pinned it known
+            text = _unicode_normalize(
+                text, nmt="nmt" in name, casefold=name.endswith("_cf"))
         if self.remove_extra_whitespaces:
             text = " ".join(s for s in text.split(" ") if s)
         if self.add_dummy_prefix:
@@ -168,7 +213,9 @@ class SentencePieceModel:
     # ---- encoding ----
 
     def encode(self, text: str) -> list[int]:
-        s = self._normalize(text)
+        if not text:
+            return []  # sentencepiece: empty input short-circuits the
+        s = self._normalize(text)  # normalizer (no lone dummy prefix)
         if not s:
             return []
         if self.model_type == BPE:
@@ -278,6 +325,47 @@ class SentencePieceModel:
         return text[1:] if self.add_dummy_prefix and text.startswith(" ") else text
 
 
+# the VISIBLE Unicode spaces the NMT rules collapse to ASCII space \u2014
+# zero-widths (ZWSP U+200B, BOM U+FEFF, joiners) are deliberately NOT
+# here: they are category Cf and must be DELETED, not become a space
+_NMT_SPACES = frozenset(
+    "\u00a0\u1680"  # NBSP, ogham space mark
+    + "".join(chr(c) for c in range(0x2000, 0x200B))  # en/em/thin...
+    + "\u2028\u2029\u202f\u205f\u3000"  # line/para sep, NNBSP,
+)                                      # math space, ideographic space
+
+
+def _unicode_normalize(text: str, *, nmt: bool, casefold: bool) -> str:
+    """The four standard rulesets, natively: NFKC via unicodedata; the
+    NMT variants first drop control/format characters (keeping \\n,
+    mapping \\t to space) and collapse the visible Unicode spaces; the
+    _cf variants casefold and \u2014 per ICU's NFKC_Casefold, which they
+    compile \u2014 remove default-ignorable code points (approximated as
+    category Cf: soft hyphen, ZWSP, joiners).  Custom charsmaps are
+    rejected at load (module docstring)."""
+    import unicodedata
+
+    if nmt:
+        out = []
+        for ch in text:
+            if ch in _NMT_SPACES or ch == "\t":
+                out.append(" ")
+            elif ch != "\n" and unicodedata.category(ch) in ("Cc", "Cf"):
+                continue
+            else:
+                out.append(ch)
+        text = "".join(out)
+    elif casefold:
+        # NFKC_Casefold's default-ignorable removal (nmt above already
+        # dropped Cf)
+        text = "".join(
+            ch for ch in text if unicodedata.category(ch) != "Cf")
+    text = unicodedata.normalize("NFKC", text)
+    if casefold:
+        text = text.casefold()
+    return text
+
+
 # ---------------- writing (fixtures) ----------------
 
 
@@ -314,10 +402,15 @@ def serialize_model(model: SentencePieceModel) -> bytes:
     trainer = _key(3, 0) + _varint(model.model_type)
     out += _len_field(2, trainer)
     norm = (
-        _len_field(1, b"identity")
+        _len_field(1, model.normalizer_name.encode("utf-8"))
         + _key(3, 0) + _varint(int(model.add_dummy_prefix))
         + _key(4, 0) + _varint(int(model.remove_extra_whitespaces))
         + _key(5, 0) + _varint(int(model.escape_whitespaces))
     )
+    if model.normalizer_name != "identity":
+        # normalization is charsmap-gated at load (the reader checks
+        # non-emptiness, never the trie bytes) — a placeholder marks the
+        # fixture's named ruleset as active
+        norm += _len_field(2, b"\x01")
     out += _len_field(3, norm)
     return bytes(out)

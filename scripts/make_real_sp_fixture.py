@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Build the REAL-vocab SentencePiece test fixture (VERDICT r4 next #4).
+
+Why generated and not vendored: this image has no sentencepiece wheel to
+run ``spm_train``, and the one genuine ``tokenizer.model`` on disk — the
+reference's TinyLlama_v1.1 sample — is CRLF-CORRUPTED in their checkout
+(the binary was checked in without a binary attribute and git ate every
+``0d 0a`` byte pair; dynamo_tpu's wire reader detects the torn frame and
+refuses it, see tests/test_sp_real.py::test_reference_fixture_is_corrupt).
+The same model's vocab, merges and normalizer survive intact in the
+sibling ``tokenizer.json``, so this script rebuilds a VALID ModelProto
+from that public data:
+
+  * pieces in id order; <unk> UNKNOWN, added specials CONTROL,
+    ``<0xNN>`` BYTE, the rest NORMAL;
+  * BPE piece score = -(1 + min merge rank producing the piece) — the
+    merge list is rank-ordered, so min-rank recovers the original
+    per-piece priority that sentencepiece's BPE encoder keys on;
+    multi-char pieces no merge produces get a sentinel score so the
+    encoder can never synthesize them (matching HF, where they are
+    unreachable mid-merge);
+  * normalizer: llama's identity + Prepend-dummy-prefix + escape, with
+    remove_extra_whitespaces off.
+
+Ground truth: the installed HF ``tokenizers`` engine encodes a
+diverse corpus from the SAME tokenizer.json; the ids land next to the
+proto so the test asserts exact parity without needing the reference
+checkout or any network.
+
+Writes tests/data/real_sp/{tinyllama.model,expected.json}.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dynamo_tpu.llm.sp_model import (  # noqa: E402
+    BPE, BYTE, CONTROL, NORMAL, UNKNOWN, Piece, SentencePieceModel,
+    serialize_model,
+)
+
+SRC = ("/root/reference/lib/llm/tests/data/sample-models/"
+       "TinyLlama_v1.1/tokenizer.json")
+OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "tests", "data",
+                       "real_sp")
+
+CORPUS = [
+    "Hello, world!",
+    "The quick brown fox jumps over the lazy dog.",
+    "  leading and   multiple  spaces ",
+    "unicode: héllo wörld — em-dash … ellipsis",
+    "emoji 🤖🔥 and CJK 你好世界 and عربى",
+    "numbers 12345.678 and code: def f(x): return x**2",
+    "llama-style ▁ escaped piece literal",
+    "CamelCase snake_case kebab-case MiXeD",
+    "quotes \"double\" 'single' `back`",
+    "trailing newline\n",
+    "\ttab lead",
+    "a",
+    "",
+    "ᚠᚢᚦᚨᚱᚲ runes and ʘǃǂ clicks",
+    "müßige Straße größer",
+]
+
+
+def build_model(tok_json: dict) -> SentencePieceModel:
+    vocab = tok_json["model"]["vocab"]  # piece -> id
+    merges = tok_json["model"]["merges"]
+    special = {t["content"] for t in tok_json["added_tokens"] if t["special"]}
+    unk = tok_json["model"].get("unk_token") or "<unk>"
+
+    merge_score = {}
+    for rank, m in enumerate(merges):
+        a, b = m.split(" ", 1) if isinstance(m, str) else m
+        piece = a + b
+        merge_score.setdefault(piece, -(rank + 1.0))
+
+    by_id = sorted(vocab.items(), key=lambda kv: kv[1])
+    pieces = []
+    for text, _ in by_id:
+        if text == unk:
+            pieces.append(Piece(text, 0.0, UNKNOWN))
+        elif text in special:
+            pieces.append(Piece(text, 0.0, CONTROL))
+        elif (len(text) == 6 and text.startswith("<0x")
+              and text.endswith(">")):
+            pieces.append(Piece(text, 0.0, BYTE))
+        elif text in merge_score:
+            pieces.append(Piece(text, merge_score[text], NORMAL))
+        elif len(text) == 1:
+            pieces.append(Piece(text, 0.0, NORMAL))
+        else:
+            # multi-char piece no merge produces: unreachable mid-merge
+            pieces.append(Piece(text, -1e9, NORMAL))
+    return SentencePieceModel(
+        pieces, model_type=BPE, add_dummy_prefix=True,
+        remove_extra_whitespaces=False, escape_whitespaces=True,
+    )
+
+
+def main():
+    from tokenizers import Tokenizer
+
+    with open(SRC) as f:
+        tok_json = json.load(f)
+    model = build_model(tok_json)
+    hf = Tokenizer.from_file(SRC)
+    expected = []
+    for t in CORPUS:
+        ids = hf.encode(t, add_special_tokens=False).ids
+        # HF's decode is the behavior oracle for ours (▁-escape is
+        # inherently lossy for literal ▁ in the input — both sides
+        # unescape it to space)
+        expected.append({"text": t, "ids": ids, "decoded": hf.decode(ids)})
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "tinyllama.model"), "wb") as f:
+        f.write(serialize_model(model))
+    with open(os.path.join(OUT_DIR, "expected.json"), "w") as f:
+        json.dump(expected, f, ensure_ascii=False, indent=1)
+    print(f"wrote {OUT_DIR}: {len(model.pieces)} pieces, "
+          f"{len(expected)} ground-truth encodings")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,215 @@
+"""SentencePiece against a REAL production vocab (VERDICT r4 next #4).
+
+tests/data/real_sp/tinyllama.model is a valid ModelProto rebuilt from
+the public TinyLlama v1.1 tokenizer's vocab/merges/normalizer by
+scripts/make_real_sp_fixture.py — 32,000 pieces, full byte-fallback
+alphabet, llama normalizer flags.  Ground truth ids/decodes were
+produced by the independent HF ``tokenizers`` engine from the same
+data, so these tests assert cross-implementation parity, not
+self-consistency.  (Why not vendor a pristine ``spm_train`` output: no
+sentencepiece wheel in this image, and the one genuine .model on disk —
+the reference's sample — is CRLF-corrupted in their checkout; see
+test_reference_fixture_is_corrupt.)
+
+Also covers the normalizer-spec rules the real-model work forced:
+NFKC/NMT normalization for the standard names, and the loud refusal of
+custom precompiled charsmaps (ref lib/llm/src/tokenizers/sp.rs ships
+full charsmap support via the sentencepiece crate; here the standard
+rulesets are native and anything else must fail closed).
+"""
+
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.llm.sp_model import (
+    BPE, NORMAL, UNIGRAM, Piece, SentencePieceModel, _key, _len_field,
+    _varint, serialize_model,
+)
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "real_sp")
+REF_MODEL = ("/root/reference/lib/llm/tests/data/sample-models/"
+             "TinyLlama_v1.1/tokenizer.model")
+
+
+@pytest.fixture(scope="module")
+def real():
+    model = SentencePieceModel.load(os.path.join(DATA, "tinyllama.model"))
+    with open(os.path.join(DATA, "expected.json")) as f:
+        expected = json.load(f)
+    return model, expected
+
+
+def test_real_vocab_loads(real):
+    model, _ = real
+    assert len(model.pieces) == 32000
+    assert model.model_type == BPE
+    assert len(model._byte_ids) == 256  # full byte-fallback alphabet
+    assert model.add_dummy_prefix and model.escape_whitespaces
+    assert not model.remove_extra_whitespaces
+
+
+def test_real_vocab_encode_matches_hf(real):
+    model, expected = real
+    for e in expected:
+        got = model.encode(e["text"])
+        assert got == e["ids"], (
+            f"encode diverged from the HF tokenizers engine on "
+            f"{e['text']!r}: {got[:12]} vs {e['ids'][:12]}"
+        )
+
+
+def test_real_vocab_decode_matches_hf(real):
+    model, expected = real
+    for e in expected:
+        assert model.decode(e["ids"]) == e["decoded"], e["text"]
+
+
+def test_real_vocab_byte_fallback_roundtrip(real):
+    model, _ = real
+    text = "byte fallback: \x07 bell and ௵ tamil"
+    ids = model.encode(text)
+    assert model.decode(ids) == text
+
+
+@pytest.mark.skipif(not os.path.exists(REF_MODEL),
+                    reason="reference checkout not present")
+def test_reference_fixture_is_corrupt():
+    """The reference's own TinyLlama tokenizer.model was checked in
+    without a binary attribute and git's CRLF normalization ate every
+    0d0a byte pair (verified byte-by-byte: the '</s>' piece frame is
+    two bytes short).  The wire reader must refuse the torn frame, not
+    mis-tokenize from it."""
+    with pytest.raises(ValueError):
+        SentencePieceModel.load(REF_MODEL)
+
+
+def test_serving_wrapper_streams_real_vocab(real):
+    """The serving path over the real vocab: SPTokenizer + DecodeStream
+    must emit exactly the decoded text, multibyte pieces held back until
+    their UTF-8 run completes."""
+    from dynamo_tpu.llm.tokenizer import DecodeStream, SPTokenizer
+
+    tok = SPTokenizer(os.path.join(DATA, "tinyllama.model"))
+    _, expected = real
+    for e in expected:
+        if not e["text"]:
+            continue
+        ids = tok.encode(e["text"])
+        assert ids == e["ids"]
+        stream = DecodeStream(tok)
+        out = "".join(filter(None, (stream.step(i) for i in ids)))
+        out += stream.flush() or ""
+        assert out == e["decoded"], e["text"]
+
+
+# ---------------------------------------------------------------------------
+# normalizer rules
+# ---------------------------------------------------------------------------
+
+
+def _uni(pieces_texts, name="identity", **kw):
+    pieces = [Piece("<unk>", 0.0, 2)] + [
+        Piece(t, -float(i + 1), NORMAL) for i, t in enumerate(pieces_texts)
+    ]
+    # real named-ruleset protos ship a charsmap; normalization is gated
+    # on its presence (empty charsmap = identity, whatever the name)
+    return SentencePieceModel(
+        pieces, UNIGRAM, normalizer_name=name,
+        has_charsmap=(name != "identity"), **kw)
+
+
+def test_nfkc_normalizes_compatibility_forms():
+    m = _uni(["▁fi", "▁A1", "▁", "f", "i", "A", "1"], name="nfkc")
+    # U+FB01 LATIN SMALL LIGATURE FI -> "fi"; fullwidth Ａ１ -> A1
+    assert m.encode("ﬁ") == m.encode("fi")
+    assert m.encode("Ａ１") == m.encode("A1")
+
+
+def test_nmt_rules_collapse_unicode_spaces_and_controls():
+    m = _uni(["\u2581a", "\u2581b", "a", "b", "\u2581"], name="nmt_nfkc")
+    assert m.encode("a\u00a0b") == m.encode("a b")  # NBSP
+    assert m.encode("a\u2009b") == m.encode("a b")  # thin space
+    assert m.encode("a\x07b") == m.encode("ab")  # bell control dropped
+    assert m.encode("a\tb") == m.encode("a b")  # tab -> space
+    # zero-widths are DELETED, not turned into a visible word boundary
+    assert m.encode("a\u200bb") == m.encode("ab")  # ZWSP
+    assert m.encode("a\ufeffb") == m.encode("ab")  # BOM
+
+
+def test_nfkc_cf_casefolds():
+    m = _uni(["▁strasse", "▁", "s", "t", "r", "a", "e"], name="nmt_nfkc_cf")
+    assert m.encode("STRASSE") == m.encode("strasse")
+    assert m.encode("Straße") == m.encode("strasse")  # ß casefolds to ss
+
+
+def test_identity_normalizer_leaves_text_alone():
+    m = _uni(["▁", "ﬁ", "f", "i"])  # identity: ligature is a piece
+    ids = m.encode("ﬁ")
+    assert m.pieces[ids[-1]].text == "ﬁ"
+
+
+def test_custom_charsmap_is_refused_loudly():
+    """Unknown normalizer name + a precompiled charsmap = user rules we
+    cannot reproduce; loading must raise, not silently mis-tokenize."""
+    base = _uni(["▁a"])
+    base.normalizer_name = "my_custom_rules"
+    raw = bytearray(serialize_model(base))
+    # append a charsmap blob to the normalizer spec by rebuilding it
+    norm = (
+        _len_field(1, b"my_custom_rules")
+        + _len_field(2, b"\x01\x02\x03\x04")  # non-empty charsmap
+        + _key(3, 0) + _varint(1)
+    )
+    raw += _len_field(3, norm)
+    with pytest.raises(ValueError, match="charsmap"):
+        SentencePieceModel.from_bytes(bytes(raw))
+
+
+def test_identity_with_charsmap_is_refused():
+    """identity's standard ruleset is EMPTY, so an identity proto
+    carrying a charsmap is custom rules by definition — refuse."""
+    base = _uni(["▁a"])
+    raw = bytearray(serialize_model(base))
+    norm = (
+        _len_field(1, b"identity")
+        + _len_field(2, b"\x01\x02\x03\x04")
+        + _key(3, 0) + _varint(1)
+    )
+    raw += _len_field(3, norm)
+    with pytest.raises(ValueError, match="charsmap"):
+        SentencePieceModel.from_bytes(bytes(raw))
+
+
+def test_unknown_name_without_charsmap_is_identity():
+    """No charsmap = no runtime normalization in sentencepiece,
+    whatever the name field says — must serve identity, not guess from
+    the name."""
+    base = _uni(["▁", "ﬁ", "f", "i"])  # serialized with name identity
+    raw = serialize_model(base)
+    m = SentencePieceModel.from_bytes(
+        raw + _len_field(3, _len_field(1, b"totally_custom")
+                         + _key(3, 0) + _varint(1)
+                         + _key(5, 0) + _varint(1)))
+    assert m.normalizer_name == "totally_custom"
+    assert m.has_charsmap is False
+    # identity semantics: the ligature piece is matched verbatim
+    ids = m.encode("ﬁ")
+    assert m.pieces[ids[-1]].text == "ﬁ"
+
+
+def test_known_normalizer_with_charsmap_is_served():
+    """nmt_nfkc protos SHIP a charsmap (it compiles the standard rules);
+    they must load and normalize, not be refused."""
+    base = _uni(["▁a", "▁", "a"], name="nmt_nfkc")
+    raw = bytearray(serialize_model(base))
+    norm = (
+        _len_field(1, b"nmt_nfkc")
+        + _len_field(2, b"\x01\x02\x03\x04")
+        + _key(3, 0) + _varint(1)
+        + _key(5, 0) + _varint(1)
+    )
+    raw += _len_field(3, norm)
+    m = SentencePieceModel.from_bytes(bytes(raw))
+    assert m.encode("a ") == m.encode("a ")
