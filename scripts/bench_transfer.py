@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""On-chip transfer-plane measurements (VERDICT r4 weak #7).
+
+The engine's offload tier (engine/offload.py) and the disagg KV push
+(disagg/transfer.py, layer-chunked ≙ ref lib/llm/src/kv/layer.rs
+CopyStream) make OVERLAP claims — d2h/h2d rides behind device compute,
+the prefill-side push streams layer chunks while later layers still
+compute — that only silicon can price.  This script runs on relay
+revival (scripts/tpu_watch.sh) and reports JSON lines:
+
+  1. d2h gather bandwidth: paged blocks gathered on device
+     (offload.gather_blocks_core jit) then fetched, GB/s;
+  2. h2d restore bandwidth: host stacks device_put + scattered back into
+     the paged cache in place, GB/s;
+  3. d2h/compute overlap: N decode windows with a concurrent
+     copy_to_host_async of a gathered slab vs the serial sum — overlap
+     efficiency = hidden fraction of the transfer;
+  4. layer-chunked KV push over loopback TCP (the real
+     KvTransferServer + send_kv_blocks), chunked vs monolithic, with
+     and without concurrent decode windows on the chip.
+
+Loopback TCP understates DCN latency but exercises the real codec,
+chunking, and asyncio pipeline; the bandwidth and overlap numbers are
+the chip-side quantities the roofline model cannot supply.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = os.environ.get("DYN_BT_SMOKE") == "1"
+if SMOKE:
+    # harness-test mode runs on CPU: the env var alone is too late (the
+    # site hook bakes the platform at interpreter start) and a wedged
+    # relay hangs backend init forever — force it before any jax use
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.offload import gather_blocks_core, scatter_blocks_core
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def median(xs):
+    return sorted(xs)[len(xs) // 2]
+
+
+# ---- shapes: llama-1B-class cache (the bench.py config), 2048-token
+# seq.  DYN_BT_SMOKE=1 shrinks everything to harness-test scale (the
+# CPU suite drives that mode; numbers are meaningless there).
+if SMOKE:
+    CFG = ModelConfig.tiny(dtype="bfloat16")
+    BLOCK, N_BLOCKS, N_SEQ_BLOCKS = 16, 64, 16
+    B, CTX, WINDOW = 2, 128, 2
+else:
+    CFG = ModelConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+        max_position_embeddings=2048, dtype="bfloat16",
+    )
+    BLOCK = 16
+    N_BLOCKS = 512  # pool
+    N_SEQ_BLOCKS = 128  # one 2048-token sequence's blocks
+    B, CTX, WINDOW = 8, 2048, 8
+
+params = llama.init_params(CFG, jax.random.key(0))
+k_cache, v_cache = llama.init_kv_cache(CFG, N_BLOCKS, BLOCK)
+idxs = jnp.arange(1, N_SEQ_BLOCKS + 1, dtype=jnp.int32)
+gather = jax.jit(gather_blocks_core)
+scatter = jax.jit(scatter_blocks_core, donate_argnames=("k_cache", "v_cache"))
+
+blk_bytes = 2 * CFG.num_layers * CFG.num_kv_heads * BLOCK * CFG.head_dim * 2
+seq_bytes = blk_bytes * N_SEQ_BLOCKS
+
+# ---- 1. d2h gather bandwidth
+for _ in range(2):  # warm
+    kb, vb = gather(k_cache, v_cache, idxs)
+    jax.block_until_ready((kb, vb))
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    kb, vb = gather(k_cache, v_cache, idxs)
+    k_host, v_host = np.asarray(kb), np.asarray(vb)
+    ts.append(time.perf_counter() - t0)
+t = median(ts)
+emit(metric="offload_d2h_gather_GBps", value=round(seq_bytes / t / 1e9, 3),
+     unit="GB/s", bytes=seq_bytes, ms=round(t * 1e3, 3))
+
+# ---- 2. h2d restore bandwidth
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    kd = jax.device_put(k_host)
+    vd = jax.device_put(v_host)
+    k_cache, v_cache = scatter(k_cache, v_cache, idxs, kd, vd)
+    jax.block_until_ready((k_cache, v_cache))
+    ts.append(time.perf_counter() - t0)
+t = median(ts)
+emit(metric="offload_h2d_restore_GBps", value=round(seq_bytes / t / 1e9, 3),
+     unit="GB/s", bytes=seq_bytes, ms=round(t * 1e3, 3))
+
+# ---- 3. d2h / compute overlap
+M = CTX // BLOCK
+nb2 = B * M + 1
+kc2, vc2 = llama.init_kv_cache(CFG, nb2, BLOCK)
+tables = jnp.asarray(np.arange(1, nb2, dtype=np.int32).reshape(B, M))
+state = dict(
+    tokens=jnp.zeros(B, jnp.int32),
+    positions=jnp.full((B,), CTX // 2, jnp.int32),
+    seq_lens=jnp.full((B,), CTX // 2 + 1, jnp.int32),
+    steps=jnp.zeros(B, jnp.int32),
+)
+zeros_f = jnp.zeros(B, jnp.float32)
+zeros_i = jnp.zeros(B, jnp.int32)
+ones_f = jnp.ones(B, jnp.float32)
+
+
+def windows(n, kc, vc):
+    s = dict(state)
+    for _ in range(n):
+        toks, kc, vc = llama.decode_window(
+            params, CFG, s["tokens"], s["positions"], tables, s["seq_lens"],
+            zeros_i, s["steps"], zeros_f, zeros_i, ones_f, kc, vc,
+            n_steps=WINDOW, use_pallas=jax.default_backend() != "cpu",
+        )
+        s = dict(tokens=toks[-1], positions=s["positions"] + WINDOW,
+                 seq_lens=s["seq_lens"] + WINDOW, steps=s["steps"] + WINDOW)
+    jax.block_until_ready(toks)
+    return kc, vc
+
+
+CACHES = {}
+CACHES["k"], CACHES["v"] = kc2, vc2
+del kc2, vc2
+
+
+def run_windows(n):
+    # donation invalidates the old cache buffers; always thread the
+    # current pair through the holder
+    CACHES["k"], CACHES["v"] = windows(n, CACHES["k"], CACHES["v"])
+
+
+run_windows(2)  # compile
+NW = 6
+t0 = time.perf_counter()
+run_windows(NW)
+t_compute = time.perf_counter() - t0
+
+kb, vb = gather(k_cache, v_cache, idxs)
+jax.block_until_ready((kb, vb))
+t0 = time.perf_counter()
+kb.copy_to_host_async()
+vb.copy_to_host_async()
+_ = np.asarray(kb), np.asarray(vb)
+t_d2h = time.perf_counter() - t0
+
+# np.asarray caches the host copy ON the array — the overlapped pass
+# needs FRESH device buffers or its transfer is a no-op
+kb2, vb2 = gather(k_cache, v_cache, idxs + 1)
+jax.block_until_ready((kb2, vb2))
+t0 = time.perf_counter()
+kb2.copy_to_host_async()  # transfer in flight...
+vb2.copy_to_host_async()
+run_windows(NW)  # ...decode runs over it
+_ = np.asarray(kb2), np.asarray(vb2)
+t_both = time.perf_counter() - t0
+hidden = max(0.0, (t_compute + t_d2h) - t_both)
+emit(metric="offload_d2h_overlap_hidden_frac",
+     value=round(min(1.0, hidden / max(t_d2h, 1e-9)), 3), unit="fraction",
+     t_compute_ms=round(t_compute * 1e3, 2), t_d2h_ms=round(t_d2h * 1e3, 2),
+     t_overlapped_ms=round(t_both * 1e3, 2))
+
+
+# ---- 4. layer-chunked KV push over loopback (real transfer server)
+async def push_bench(layer_chunk, with_decode):
+    from dynamo_tpu.disagg.transfer import KvTransferServer, send_kv_blocks
+
+    srv = KvTransferServer(host="127.0.0.1")
+    await srv.start()
+    k_np = np.asarray(kb)  # [L, Hkv, n, bs, D]
+    v_np = np.asarray(vb)
+    times = []
+    for i in range(3):
+        rid = f"bench-{layer_chunk}-{with_decode}-{i}"
+        fut = srv.expect(rid)
+        t0 = time.perf_counter()
+        if with_decode:
+            loop = asyncio.get_running_loop()
+            dec = loop.run_in_executor(None, run_windows, 2)
+        await send_kv_blocks(srv.address, rid, 1, k_np, v_np,
+                             layer_chunk=layer_chunk)
+        await fut
+        times.append(time.perf_counter() - t0)  # push delivered
+        if with_decode:
+            await dec  # decode drains OUTSIDE the push timing
+    await srv.close()
+    return median(times)
+
+
+for chunk, dec in ((4, False), (CFG.num_layers, False), (4, True)):
+    t = asyncio.run(push_bench(chunk, dec))
+    emit(metric="kv_push_loopback_GBps",
+         value=round(seq_bytes / t / 1e9, 3), unit="GB/s",
+         layer_chunk=chunk, concurrent_decode=dec,
+         ms=round(t * 1e3, 2), bytes=seq_bytes)
+
+emit(metric="bench_transfer_done", value=1, unit="ok")

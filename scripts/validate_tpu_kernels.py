@@ -289,5 +289,28 @@ for name, window, snk in (
               f"(engine guard degrades to XLA): {type(e).__name__}: {e}"[:300],
               flush=True)
 
+# 9. grouped-dequant MoE matmul (ops/moe_gmm_pallas.py, round 5): the
+# quantized-expert path COMPILED on the chip vs the dequantize-then-
+# ragged_dot XLA reference, at an ep-shard-shaped problem (ragged
+# groups incl. an empty one, rows not tile-aligned) and a DeepSeek-
+# proportioned one (K=7168, Fm=2048 slices).
+from dynamo_tpu.ops.moe_gmm_pallas import ragged_int8_gmm, ragged_int8_xla
+
+kg = jax.random.split(jax.random.key(11), 3)
+for name, (R_, K_, N_, X_, sizes) in (
+    # sizes sum to 80 < R_=96: the 16 padding rows (an ep-shard window's
+    # masked tail) must come back zeroed, which the ref mask mirrors
+    ("gmm ragged+pad", (96, 512, 256, 8, [17, 0, 31, 5, 11, 9, 7, 0])),
+    ("gmm deepseek-ish", (256, 7168, 2048, 4, [64, 128, 0, 64])),
+):
+    gs_ = jnp.asarray(np.array(sizes, np.int32))
+    lhs_ = jax.random.normal(kg[0], (R_, K_), jnp.bfloat16)
+    q_ = jax.random.randint(kg[1], (X_, K_, N_), -127, 128, jnp.int8)
+    s_ = jax.random.uniform(kg[2], (X_, N_), jnp.float32, 0.5, 2.0)
+    ref = ragged_int8_xla(lhs_, q_, s_, gs_)
+    ref = jnp.where(jnp.arange(R_)[:, None] < int(np.sum(sizes)), ref, 0.0)
+    got = ragged_int8_gmm(lhs_, q_, s_, gs_)
+    check(name, got, ref)
+
 print("ALL PASS" if ok else "FAILURES", flush=True)
 sys.exit(0 if ok else 1)
