@@ -107,6 +107,19 @@ def main(argv=None) -> None:
     m = sub.add_parser("manifests", help="render k8s manifests for a spec")
     m.add_argument("spec", help="deployment spec json file")
 
+    pl = sub.add_parser(
+        "render-platform",
+        help="render the whole control plane (hub + api-server + "
+             "reconciler + frontend + metrics) as one applyable set",
+    )
+    pl.add_argument("--name", default="dynamo")
+    pl.add_argument("--namespace", default="default")
+    pl.add_argument("--image", default="dynamo-tpu:latest")
+    pl.add_argument("--ingress-host", default="")
+    pl.add_argument("--store-pvc", default="",
+                    help="PVC for the durable control store ('' = emptyDir)")
+    pl.add_argument("--no-metrics", action="store_true")
+
     args = p.parse_args(argv)
     if args.verb == "build":
         config = None
@@ -134,6 +147,15 @@ def main(argv=None) -> None:
         with open(args.spec) as f:
             dep = DynamoDeployment.from_dict(json.load(f))
         print(to_yaml(render_manifests(dep)))
+    elif args.verb == "render-platform":
+        from .manifests import to_yaml
+        from .platform import render_platform
+
+        print(to_yaml(render_platform(
+            args.name, args.namespace, args.image,
+            ingress_host=args.ingress_host, store_pvc=args.store_pvc,
+            with_metrics=not args.no_metrics,
+        )))
 
 
 if __name__ == "__main__":

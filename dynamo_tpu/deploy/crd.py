@@ -93,6 +93,15 @@ class ServiceDeploymentSpec:
     num_nodes: int = 1
     hosts: list[str] = field(default_factory=list)  # empty = platform-placed
     coordinator_port: int = 9900
+    # weight distribution (ref DynamoNimRequest / PVC machinery,
+    # dynamodeployment_types.go:28-120): an org/name HF repo id renders
+    # an initContainer that pre-fetches weights into a model-cache
+    # volume before the engine starts, so pods come up on BARE nodes; a
+    # local path renders only the mount + env (weights pre-staged).
+    model: str = ""  # "" = service carries no model weights
+    # "" = per-pod emptyDir cache; a PVC name = shared cluster cache
+    # (ReadOnlyMany volumes let every replica reuse one download)
+    model_cache_pvc: str = ""
 
     def validate(self) -> None:
         if not self.name or "/" in self.name:
@@ -105,6 +114,20 @@ class ServiceDeploymentSpec:
             # an Ingress backend needs a Service port; accepting the
             # host and rendering nothing would silently drop it
             raise SpecError("ingress_host requires http_port")
+        if self.model_cache_pvc and not self.model:
+            raise SpecError("model_cache_pvc without a model to cache")
+        if self.model and not (
+            self.model.startswith(("/", "."))
+            or self.model.count("/") == 1
+        ):
+            # the renderer classifies by prefix: "/..." or "./..." is a
+            # pre-staged path, one-slash is an org/name repo id — a bare
+            # relative dir like "models/llama" would silently become a
+            # crash-looping hub fetch, so demand the "./" spelling
+            raise SpecError(
+                f"model {self.model!r} must be an org/name HF repo id, "
+                "or a path starting with '/' or './'"
+            )
         self.resources.validate()
         self.autoscaling.validate()
 
@@ -152,6 +175,8 @@ class DynamoDeployment:
                 num_nodes=s.get("num_nodes", 1),
                 hosts=list(s.get("hosts", [])),
                 coordinator_port=s.get("coordinator_port", 9900),
+                model=s.get("model", ""),
+                model_cache_pvc=s.get("model_cache_pvc", ""),
             )
             for s in d.get("services", [])
         ]

@@ -134,8 +134,13 @@ class KubectlApi:
 
     _KINDS = ("Deployment", "StatefulSet", "Service", "Ingress", "ConfigMap")
 
-    def __init__(self, kubectl: str = "kubectl", context: str = ""):
+    def __init__(self, kubectl: str = "kubectl", context: str = "",
+                 namespace: str = ""):
+        # ``namespace`` scopes list() when the caller passes none — the
+        # rendered platform runs under a NAMESPACED Role, which cannot
+        # authorize --all-namespaces (deploy/platform.py RBAC)
         self._base = [kubectl] + (["--context", context] if context else [])
+        self._namespace = namespace
 
     def _run(self, args: list[str], stdin: str = ""):
         import subprocess
@@ -151,6 +156,7 @@ class KubectlApi:
 
     def list(self, namespace=None, labels=None):
         sel = ",".join(f"{k}={v}" for k, v in (labels or {}).items())
+        namespace = namespace or self._namespace
         ns = ["-n", namespace] if namespace else ["--all-namespaces"]
         out = []
         for kind in self._KINDS:
@@ -160,10 +166,26 @@ class KubectlApi:
             )
             if r.returncode == 0:
                 out.extend(json.loads(r.stdout).get("items", []))
+            else:
+                # a swallowed read error makes "forbidden/cluster down"
+                # look like "nothing to prune" — say so loudly (but keep
+                # going: other kinds may still be readable)
+                logger.warning("kubectl get %s failed (rc=%s): %s",
+                               kind, r.returncode, r.stderr.strip()[:200])
         return out
 
     def apply(self, obj):
-        r = self._run(["apply", "-f", "-"], stdin=json.dumps(obj))
+        # server-side apply under the reconciler's field manager: the
+        # API server tracks field ownership, so drift-repair re-applies
+        # only contested fields and other controllers' fields survive
+        # (mirrors the FakeKubeApi-tested field-owner diff semantics;
+        # --force-conflicts because the reconciler IS the owner of the
+        # rendered spec — a fight over those fields must resolve to it)
+        r = self._run(
+            ["apply", "--server-side", "--field-manager", "dynamo-operator",
+             "--force-conflicts", "-f", "-"],
+            stdin=json.dumps(obj),
+        )
         if r.returncode != 0:
             raise RuntimeError(f"kubectl apply failed: {r.stderr.strip()}")
         return obj
@@ -301,3 +323,45 @@ class KubeReconciler:
             "phase": "Ready" if ready_all else "Progressing",
             "services": services,
         })
+
+
+def main(argv=None) -> None:  # pragma: no cover - in-cluster entry
+    """``python -m dynamo_tpu.deploy.kube --root /data/api`` — the
+    reconciler container of the rendered platform (deploy/platform.py):
+    converge every spec in the shared DeploymentStore into cluster
+    objects through kubectl, forever."""
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser("dynamo-kube-reconciler", description=__doc__)
+    p.add_argument("--root", default="./dynamo-deployments",
+                   help="DeploymentStore root (shared with the api-server)")
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--kubectl", default="kubectl")
+    p.add_argument("--context", default="")
+    p.add_argument("--namespace", default="",
+                   help="scope list/prune to one namespace (required "
+                        "under the rendered platform's namespaced Role)")
+    args = p.parse_args(argv)
+
+    store = DeploymentStore(args.root)
+    rec = KubeReconciler(
+        store, KubectlApi(kubectl=args.kubectl, context=args.context,
+                          namespace=args.namespace),
+        interval=args.interval,
+    )
+
+    async def run():
+        rec.start()
+        print(f"kube reconciler over {args.root} every {args.interval}s",
+              flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await rec.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -87,3 +87,22 @@ def resolve_model_path(name_or_path: str, cache_dir: Optional[str] = None) -> st
     return snapshot_download(
         name_or_path, allow_patterns=_NEEDED, cache_dir=cache_dir
     )
+
+
+def main(argv=None):  # pragma: no cover - exercised via rendered pods
+    """``python -m dynamo_tpu.llm.hub <org/name-or-path>`` — the fetch
+    entry the k8s initContainer runs (deploy/manifests.py
+    _weight_distribution): resolve (downloading if needed) and print
+    the local directory. Exit 1 with the error on stderr otherwise."""
+    import argparse
+
+    p = argparse.ArgumentParser("dynamo_tpu.llm.hub")
+    p.add_argument("model", help="HF org/name repo id or local path")
+    p.add_argument("--cache-dir", default=None)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    print(resolve_model_path(args.model, args.cache_dir))
+
+
+if __name__ == "__main__":
+    main()

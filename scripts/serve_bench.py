@@ -219,6 +219,9 @@ def main() -> None:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--max-batch", type=int, default=16)
     p.add_argument("--decode-window", type=int, default=8)
+    p.add_argument("--decode-pipeline", action="store_true",
+                   help="overlapped window dispatch (EngineConfig."
+                        "decode_pipeline) — the ablation knob")
     p.add_argument("--quantization", default="none")
     p.add_argument("--kv-cache-dtype", default="model")
     p.add_argument("--cpu", action="store_true",
@@ -276,6 +279,7 @@ def main() -> None:
          "--decode-window", str(args.decode_window),
          "--quantization", args.quantization,
          "--kv-cache-dtype", args.kv_cache_dtype,
+         *(["--decode-pipeline"] if args.decode_pipeline else []),
          *tokenizer_args],
         env=env, cwd=REPO,
     )
@@ -314,6 +318,7 @@ def main() -> None:
             "concurrency": args.concurrency,
             "backend": "cpu" if args.cpu else "tpu",
             "quantization": args.quantization,
+            "decode_pipeline": args.decode_pipeline,
             "server_metrics": scrape_metrics(port),
         })
         print(json.dumps(result), flush=True)

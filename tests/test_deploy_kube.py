@@ -200,3 +200,26 @@ def test_rollback_http_routes(tmp_path, run):
         await srv.close()
 
     run(main())
+
+
+def test_path_model_with_pvc_renders_mount_without_fetch():
+    """Pre-staged weights on a PVC: the pod mounts the volume, runs no
+    fetch initContainer; a node-local path renders nothing."""
+    from dynamo_tpu.deploy.crd import DynamoDeployment, ServiceDeploymentSpec
+    from dynamo_tpu.deploy.manifests import render_manifests
+
+    dep = DynamoDeployment(name="d", services=[
+        ServiceDeploymentSpec(name="pvc", model="/model-cache/llama",
+                              model_cache_pvc="weights"),
+        ServiceDeploymentSpec(name="bare", model="/srv/weights/llama"),
+    ])
+    pods = {
+        m["metadata"]["name"]: m["spec"]["template"]["spec"]
+        for m in render_manifests(dep) if m["kind"] == "Deployment"
+    }
+    pvc_pod = pods["d-pvc"]
+    assert "initContainers" not in pvc_pod
+    assert pvc_pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == "weights"
+    assert pvc_pod["containers"][0]["volumeMounts"][0]["mountPath"] == "/model-cache"
+    bare_pod = pods["d-bare"]
+    assert "volumes" not in bare_pod and "initContainers" not in bare_pod

@@ -1,0 +1,185 @@
+"""KubectlApi golden-command contract tests (VERDICT r4 next #7).
+
+The reconciler's live-cluster adapter (deploy/kube.KubectlApi) had zero
+coverage — not even of the command lines it runs.  These tests put a
+STUB kubectl on PATH that records argv + stdin and replays canned
+responses, then drive both the raw adapter and a full KubeReconciler
+create→drift→prune pass through it, asserting the exact invocations
+(server-side apply + field-manager, namespaced gets, selector lists,
+ignore-not-found deletes).  The stub is the contract: if the command
+shapes drift, a real cluster is the first place anyone would notice.
+(ref: the operator's envtest suite,
+dynamonimdeployment_controller.go:136.)
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.deploy.crd import DynamoDeployment, ServiceDeploymentSpec
+from dynamo_tpu.deploy.kube import KubectlApi
+
+STUB = r'''#!/usr/bin/env python3
+import json, os, sys
+
+log = os.environ["KSTUB_LOG"]
+resp_dir = os.environ["KSTUB_RESPONSES"]
+args = sys.argv[1:]
+stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
+with open(log, "a") as f:
+    f.write(json.dumps({"args": args, "stdin": stdin}) + "\n")
+
+verb = args[0] if args else ""
+if verb == "get":
+    # canned object / list keyed by "<kind>" file if present, else 404
+    kind = args[1].lower()
+    path = os.path.join(resp_dir, f"get_{kind}.json")
+    if os.path.exists(path):
+        sys.stdout.write(open(path).read())
+        sys.exit(0)
+    sys.stderr.write("Error from server (NotFound)\n")
+    sys.exit(1)
+if verb == "apply":
+    obj = json.loads(stdin) if stdin.strip() else {}
+    sys.stdout.write(json.dumps(obj))
+    sys.exit(0)
+if verb == "delete":
+    sys.stdout.write(f"{args[1]} \"{args[2] if len(args)>2 else ''}\" deleted\n")
+    sys.exit(0)
+sys.exit(2)
+'''
+
+
+@pytest.fixture()
+def kstub(tmp_path):
+    """A recording kubectl stub; yields (api, read_log)."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    stub = bin_dir / "kubectl"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    resp = tmp_path / "responses"
+    resp.mkdir()
+    log = tmp_path / "log.jsonl"
+    os.environ["KSTUB_LOG"] = str(log)
+    os.environ["KSTUB_RESPONSES"] = str(resp)
+
+    def read_log():
+        if not log.exists():
+            return []
+        return [json.loads(ln) for ln in log.read_text().splitlines()]
+
+    yield KubectlApi(kubectl=str(stub)), read_log, resp
+    os.environ.pop("KSTUB_LOG", None)
+    os.environ.pop("KSTUB_RESPONSES", None)
+
+
+def test_apply_is_server_side_with_field_manager(kstub):
+    api, read_log, _ = kstub
+    obj = {"kind": "Deployment", "apiVersion": "apps/v1",
+           "metadata": {"name": "w", "namespace": "ns"}, "spec": {}}
+    api.apply(obj)
+    (rec,) = read_log()
+    assert rec["args"] == [
+        "apply", "--server-side", "--field-manager", "dynamo-operator",
+        "--force-conflicts", "-f", "-",
+    ]
+    assert json.loads(rec["stdin"]) == obj
+
+
+def test_get_is_namespaced_json(kstub):
+    api, read_log, resp = kstub
+    (resp / "get_deployment.json").write_text(json.dumps(
+        {"kind": "Deployment", "metadata": {"name": "w"}}))
+    got = api.get("Deployment", "ns", "w")
+    assert got["metadata"]["name"] == "w"
+    (rec,) = read_log()
+    assert rec["args"] == ["get", "Deployment", "w", "-n", "ns", "-o", "json"]
+
+
+def test_get_notfound_returns_none(kstub):
+    api, read_log, _ = kstub
+    assert api.get("Deployment", "ns", "missing") is None
+
+
+def test_list_uses_label_selector_per_kind(kstub):
+    api, read_log, resp = kstub
+    for kind in ("deployment", "statefulset", "service", "ingress",
+                 "configmap"):
+        (resp / f"get_{kind}.json").write_text(json.dumps({"items": []}))
+    api.list(namespace="ns", labels={"app": "x", "dyn": "y"})
+    recs = read_log()
+    assert len(recs) == 5  # one get per managed kind
+    for rec in recs:
+        assert rec["args"][0] == "get"
+        assert rec["args"][2:4] == ["-n", "ns"]
+        assert rec["args"][-2:] == ["-l", "app=x,dyn=y"]
+
+
+def test_delete_ignores_not_found(kstub):
+    api, read_log, _ = kstub
+    assert api.delete("Service", "ns", "svc") is True
+    (rec,) = read_log()
+    assert rec["args"] == [
+        "delete", "Service", "svc", "-n", "ns", "--ignore-not-found"]
+
+
+def test_context_flag_prefixes_every_invocation(kstub, tmp_path):
+    _, read_log, _ = kstub
+    api = KubectlApi(kubectl=str(tmp_path / "bin" / "kubectl"),
+                     context="prod-cluster")
+    api.delete("Service", "ns", "svc")
+    rec = read_log()[-1]
+    assert rec["args"][:2] == ["--context", "prod-cluster"]
+
+
+def test_reconciler_create_pass_over_kubectl(kstub):
+    """The full KubeReconciler create pass driven through the stubbed
+    kubectl: every rendered manifest lands as one server-side apply in
+    dependency order, and status gets read back via namespaced gets."""
+    from dynamo_tpu.deploy.kube import DeploymentStore, KubeReconciler
+
+    api, read_log, resp = kstub
+    dep = DynamoDeployment(
+        name="g", namespace="ns",
+        services=[ServiceDeploymentSpec(
+            name="w", model="org/m", http_port=8080)],
+    )
+    store = DeploymentStore(os.environ["KSTUB_RESPONSES"] + "/../store")
+    rec = KubeReconciler(store, api)
+    store.put("g", dep.to_dict(), create=True)
+    rec.reconcile_once()
+    log = read_log()
+    applies = [r for r in log if r["args"][0] == "apply"]
+    assert applies, "reconcile issued no applies"
+    for a in applies:
+        assert a["args"][1:5] == [
+            "--server-side", "--field-manager", "dynamo-operator",
+            "--force-conflicts"]
+    kinds = [json.loads(a["stdin"])["kind"] for a in applies]
+    assert "Deployment" in kinds and "Service" in kinds
+    # the weight-distribution initContainer rides through the live path
+    dep_objs = [json.loads(a["stdin"]) for a in applies
+                if json.loads(a["stdin"])["kind"] == "Deployment"]
+    worker = [d for d in dep_objs
+              if d["metadata"]["name"].endswith("-w")]
+    assert worker and "initContainers" in worker[0]["spec"]["template"]["spec"]
+
+
+def test_namespaced_list_scoping(kstub, tmp_path):
+    """A namespace-scoped KubectlApi must never ask for --all-namespaces
+    (the rendered platform's Role cannot authorize it)."""
+    _, read_log, resp = kstub
+    for kind in ("deployment", "statefulset", "service", "ingress",
+                 "configmap"):
+        (resp / f"get_{kind}.json").write_text(json.dumps({"items": []}))
+    api = KubectlApi(kubectl=str(tmp_path / "bin" / "kubectl"),
+                     namespace="prod")
+    api.list(labels={"a": "b"})
+    for rec in read_log():
+        assert "--all-namespaces" not in rec["args"]
+        assert rec["args"][2:4] == ["-n", "prod"]
