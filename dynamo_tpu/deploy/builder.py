@@ -118,6 +118,9 @@ def main(argv=None) -> None:
     pl.add_argument("--ingress-host", default="")
     pl.add_argument("--store-pvc", default="",
                     help="PVC for the durable control store ('' = emptyDir)")
+    pl.add_argument("--hub-pvc", default="",
+                    help="PVC for the hub's snapshot+WAL (separate claim: "
+                         "RWO volumes cannot attach to two pods)")
     pl.add_argument("--no-metrics", action="store_true")
 
     args = p.parse_args(argv)
@@ -154,6 +157,7 @@ def main(argv=None) -> None:
         print(to_yaml(render_platform(
             args.name, args.namespace, args.image,
             ingress_host=args.ingress_host, store_pvc=args.store_pvc,
+            hub_pvc=args.hub_pvc,
             with_metrics=not args.no_metrics,
         )))
 

@@ -266,6 +266,14 @@ class KubeReconciler:
         live_by_dep: dict[str, list[dict]] = {}
         for obj in self.api.list(labels={"app.kubernetes.io/managed-by": MANAGED_BY}):
             dep = obj.get("metadata", {}).get("labels", {}).get("dynamo.deployment")
+            if dep is None:
+                # managed-by alone is NOT ownership: the rendered control
+                # plane itself (deploy/platform.py) carries the managed-by
+                # label with no dynamo.deployment — grouping it under None
+                # would make the prune pass delete the hub, frontend,
+                # metrics stack and the reconciler's own Deployment on
+                # its first tick
+                continue
             live_by_dep.setdefault(dep, []).append(obj)
 
         names = set(self.store.list())

@@ -117,6 +117,10 @@ class EngineConfig:
     # the original decode (near-tie greedy tokens may flip). Default OFF
     # so the uncontended==contended bit-exactness guarantee holds;
     # opt in for throughput on pools provisioned to rarely preempt.
+    # Measured: the serving-layer on/off pair lives in
+    # benchmarks/serving_cpu.json (pipeline_speedup; ~1.0x on CPU where
+    # dispatch gaps are a tiny share of step time) with the on-chip twin
+    # queued via serve_bench --decode-pipeline in the relay battery.
     decode_pipeline: bool = False
     # speculative decoding via prompt-lookup (n-gram) drafts: propose up
     # to spec_gamma continuation tokens from the sequence's own history
@@ -150,6 +154,11 @@ class EngineConfig:
     # dense prefill — each sp device computes T/sp query rows while KV
     # shards rotate the ICI ring. 0 = off. Requires an sp>1 mesh; full
     # attention, non-MLA models (engine falls back otherwise).
+    # Measured (scripts/ablate_ring.py, benchmarks/ablate_ring.json):
+    # ring wins grow with T (3.6x @ 1k -> 11.4x @ 4k on the virtual
+    # mesh) and dense prefill's O(T^2) score memory becomes the binding
+    # constraint near 16k — set the threshold where score memory rivals
+    # a layer's weights (~8k for 8B-class) on sp>1 slices.
     ring_prefill_threshold: int = 0
 
     def __post_init__(self):
@@ -509,7 +518,19 @@ class JaxEngine(AsyncEngine):
                     # drop a stale pipelined window before going idle (its
                     # participants all finished; tokens are discards)
                     await self._drain_inflight()
+                    # the drain AWAITED (device sync): requests that
+                    # arrived during it already called _wake.set() — a
+                    # blind clear() here erases their wakeup and the
+                    # loop sleeps on a non-empty queue forever (the
+                    # pipelined-decode deadlock tests/test_engine.py
+                    # pins). Re-check before AND after the clear; the
+                    # after-clear check has no awaits in between, so a
+                    # concurrent set() is always observed by wait().
+                    if self._has_pending_work():
+                        continue
                     self._wake.clear()
+                    if self._has_pending_work():
+                        continue
                     await self._wake.wait()
                     continue
                 if self._n_active:
@@ -517,24 +538,40 @@ class JaxEngine(AsyncEngine):
                 # yield to the event loop so emissions flush
                 await asyncio.sleep(0)
         except asyncio.CancelledError:
-            pass
+            # engine close() with sequences in flight: fail them — their
+            # generate() coroutines block on out_queue forever otherwise,
+            # and an ingress that gets cancelled around that block would
+            # hand callers silently-truncated streams
+            self._fail_all_owned()
         except Exception:  # noqa: BLE001
             logger.exception("engine loop crashed")
-            # fail every request we own — active, mid-prefill, and
-            # still-waiting (their generate() coroutines block on
-            # out_queue otherwise)
-            in_prefill = [self._prefill_state.seq] if self._prefill_state else []
-            for seq in self._active + self._remote_ready + in_prefill:
-                if seq is not None:
-                    seq.out_queue.put_nowait(
-                        LLMEngineOutput(finish_reason=FinishReason.ERROR)
-                    )
-            self._remote_ready.clear()
-            while self._waiting_front or not self._waiting.empty():
-                seq = self._pop_waiting()
+            self._fail_all_owned()
+
+    def _has_pending_work(self) -> bool:
+        """Anything the idle scheduler must NOT sleep on."""
+        return bool(
+            self._waiting_front
+            or not self._waiting.empty()
+            or self._remote_ready
+            or self._n_active
+            or self._prefill_state is not None
+        )
+
+    def _fail_all_owned(self) -> None:
+        """ERROR-terminate every request this engine owns — active,
+        mid-prefill, and still-waiting."""
+        in_prefill = [self._prefill_state.seq] if self._prefill_state else []
+        for seq in self._active + self._remote_ready + in_prefill:
+            if seq is not None:
                 seq.out_queue.put_nowait(
                     LLMEngineOutput(finish_reason=FinishReason.ERROR)
                 )
+        self._remote_ready.clear()
+        while self._waiting_front or not self._waiting.empty():
+            seq = self._pop_waiting()
+            seq.out_queue.put_nowait(
+                LLMEngineOutput(finish_reason=FinishReason.ERROR)
+            )
 
     # ---- admission ----
 

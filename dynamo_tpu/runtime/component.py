@@ -258,6 +258,18 @@ class Endpoint:
                         await writer.send(item)
                         if context.is_killed():
                             break
+                except asyncio.CancelledError:
+                    # worker shutdown with this stream mid-flight: the
+                    # finally's bare sentinel would hand the caller a
+                    # clean-looking TRUNCATED stream (the lost-stream
+                    # bug tests/test_soak_churn.py hunts) — tell the
+                    # caller the truth first, then propagate
+                    try:
+                        await writer.error(
+                            "worker shutdown: stream aborted")
+                    except Exception:  # noqa: BLE001 - socket may be gone
+                        pass
+                    raise
                 except Exception as e:  # noqa: BLE001
                     logger.exception("engine error for %s", env.request_id)
                     await writer.error(str(e))

@@ -125,9 +125,15 @@ class TcpStreamServer:
                 self._send_control(pending.context, writer)
             )
 
+            ended_clean = False
             while True:
                 frame = await read_frame(reader)
                 if frame is None:
+                    # EOF with NO sentinel: the worker died mid-stream.
+                    # Silently ending here would hand the consumer a
+                    # truncated stream indistinguishable from a complete
+                    # one (the lost-stream failure tests/test_soak_churn.py
+                    # hunts) — it must surface as an error.
                     break
                 head = frame.header_json() or {}
                 ftype = head.get("type")
@@ -135,12 +141,20 @@ class TcpStreamServer:
                     payload = json.loads(frame.data) if frame.data else {}
                     pending.queue.put_nowait(Annotated.from_dict(payload))
                 elif ftype == T_SENTINEL:
+                    ended_clean = True
                     break
                 elif ftype == T_ERROR:
+                    ended_clean = True  # error IS a terminal signal
                     pending.queue.put_nowait(Annotated.from_error(head.get("error", "worker error")))
                     break
+            if not ended_clean:
+                pending.queue.put_nowait(Annotated.from_error(
+                    "response stream truncated: worker connection lost "
+                    "before the completion sentinel"))
         except (ConnectionResetError, asyncio.IncompleteReadError):
-            pass
+            if pending is not None:
+                pending.queue.put_nowait(Annotated.from_error(
+                    "response stream truncated: worker connection reset"))
         except Exception as e:  # noqa: BLE001
             logger.warning("response stream error: %s", e)
             if pending is not None:

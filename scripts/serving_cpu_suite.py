@@ -42,7 +42,9 @@ PRESETS = [
     dict(name="tiny-mla-byte", args=["--model-path", "tiny-mla"]),
     dict(name="tiny-hf-wordlevel",
          args=["--model-path", "tiny", "--sim-tokenizer"]),
-    dict(name="tiny-pipeline-off", args=["--model-path", "tiny"]),
+    # the pipeline ablation's OFF arm IS tiny-byte (identical args) —
+    # running it twice would double-pay a full server spawn for a
+    # duplicate record
     dict(name="tiny-pipeline-on",
          args=["--model-path", "tiny", "--decode-pipeline"]),
 ]
@@ -86,8 +88,8 @@ def main():
         ok = "error" not in rec
         print(f"{p['name']:>20}: "
               + (f"{rec.get('tokens_per_sec', 0):8.1f} tok/s  "
-                 f"ttft p50 {rec.get('ttft_p50_ms', 0):7.1f} ms  "
-                 f"itl p50 {rec.get('itl_p50_ms', 0):6.2f} ms  "
+                 f"ttft p50 {(rec.get('ttft_ms') or {}).get('p50', 0):7.1f} ms  "
+                 f"itl p50 {(rec.get('itl_ms') or {}).get('p50', 0):6.2f} ms  "
                  f"({time.time()-t0:.0f}s)" if ok
                  else "FAILED " + rec["error"][-200:]),
               flush=True)
@@ -112,22 +114,21 @@ def main():
             base["tokens_per_sec"], history)
         summary.update(
             tokens_per_sec=base["tokens_per_sec"],
-            ttft_p50_ms=base.get("ttft_p50_ms"),
-            itl_p50_ms=base.get("itl_p50_ms"),
+            ttft_p50_ms=(base.get("ttft_ms") or {}).get("p50"),
+            itl_p50_ms=(base.get("itl_ms") or {}).get("p50"),
             vs_prev=ratio, regressed=regressed,
         )
         if regressed:
             print(f"SERVING REGRESSION: {ratio:.2f}x recent median",
                   flush=True)
 
-    # pipeline ablation delta as a first-class field
-    off = next((r for r in records if r["preset"] == "tiny-pipeline-off"
-                and "error" not in r), None)
+    # pipeline ablation delta as a first-class field (OFF arm =
+    # tiny-byte, the identical configuration)
     on = next((r for r in records if r["preset"] == "tiny-pipeline-on"
                and "error" not in r), None)
-    if off and on and off.get("tokens_per_sec"):
+    if base and on and base.get("tokens_per_sec"):
         summary["pipeline_speedup"] = round(
-            on["tokens_per_sec"] / off["tokens_per_sec"], 4)
+            on["tokens_per_sec"] / base["tokens_per_sec"], 4)
 
     with open(ARTIFACT, "w") as f:
         json.dump({"summary": summary, "records": records,

@@ -739,3 +739,48 @@ def test_repetition_penalty_applies_to_first_token(run):
         await engine.close()
 
     run(main())
+
+
+def test_pipelined_decode_survives_idle_transitions(run):
+    """Lost-wakeup regression (round 5): with decode_pipeline on, the
+    idle path AWAITS the inflight drain between its emptiness check and
+    _wake.clear() — requests arriving in that window had their wakeup
+    erased and the scheduler slept on a non-empty queue forever. Waves
+    separated by idle gaps reproduce it; wait_for turns the hang into a
+    failure."""
+    import asyncio
+
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=256, block_size=16,
+            max_batch_size=8, max_context=128, prefill_chunk=32,
+            decode_pipeline=True, decode_window=8,
+        )
+        eng = JaxEngine(cfg, seed=0)
+
+        def mkreq(i):
+            return Context(PreprocessedRequest(
+                token_ids=[100 + i] * 40,
+                stop_conditions=StopConditions(max_tokens=12),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[],
+            ).to_dict())
+
+        async def one(i):
+            out = await collect(eng.generate(mkreq(i)))
+            assert any(getattr(o, "finish_reason", None) for o in out)
+
+        for wave in range(3):
+            await asyncio.wait_for(
+                asyncio.gather(*(one(wave * 12 + i) for i in range(12))),
+                timeout=180,
+            )
+            await asyncio.sleep(0.05)  # let the scheduler go idle
+        await eng.close()
+
+    run(main())

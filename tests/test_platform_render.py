@@ -123,3 +123,24 @@ def test_render_platform_cli_emits_applyable_yaml(capsys):
     assert len(docs) >= 14
     for d in docs:
         assert d["apiVersion"] and d["kind"] and d["metadata"]["name"]
+
+def test_reconciler_never_prunes_the_platform_itself():
+    """The control plane carries managed-by with NO dynamo.deployment
+    label; the prune pass must skip it (before this guard, the rendered
+    reconciler deleted the hub, frontend, metrics stack and its own
+    Deployment on its first tick)."""
+    from dynamo_tpu.deploy.api_server import DeploymentStore
+    from dynamo_tpu.deploy.kube import FakeKubeApi, KubeReconciler
+
+    api = FakeKubeApi()
+    for m in render_platform("dyn", "prod", "img:1"):
+        api.apply(m)
+    n_before = len(api.list())
+    import tempfile
+
+    store = DeploymentStore(tempfile.mkdtemp())
+    rec = KubeReconciler(store, api)
+    rec.reconcile_once()  # empty store: maximum prune pressure
+    deletes = [a for a in api.actions if a[0] == "delete"]
+    assert not deletes, f"platform objects pruned: {deletes}"
+    assert len(api.list()) == n_before
