@@ -451,6 +451,25 @@ def _collective_time(cfg: ModelConfig, sc: Scenario, chip: ChipSpec,
     return t
 
 
+
+def _step_time(cfg: ModelConfig, sc: Scenario, chip: ChipSpec, batch: int,
+               flops_per_token: float, stream_total: float,
+               bw_eff: float = HBM_EFF, mxu_eff: float = MXU_EFF) -> float:
+    """ONE implementation of the modeled decode step time — analyze()
+    and batch_sweep() must price identically or the two committed
+    artifacts split-brain."""
+    t_hbm = stream_total / sc.n_chips / (chip.hbm_bw * bw_eff)
+    t_mxu = flops_per_token * batch / sc.n_chips / (chip.flops_bf16 * mxu_eff)
+    return (max(t_hbm, t_mxu) + _collective_time(cfg, sc, chip, batch)
+            + HOST_US_PER_DISPATCH * 1e-6 / sc.decode_window)
+
+
+def _hbm_used(sc: Scenario, batch: int, params_resident: float,
+              row_bytes: float) -> float:
+    return (params_resident / sc.n_chips
+            + batch * (sc.isl + sc.osl) * row_bytes / sc.n_chips)
+
+
 def analyze(sc: Scenario) -> dict:
     """One scenario → the full modeled record (all inputs included so
     every number is recomputable by hand)."""
@@ -462,18 +481,12 @@ def analyze(sc: Scenario) -> dict:
     stream = decode_stream_bytes(cfg, sc.batch, mean_ctx, sc.quant,
                                  sc.kv_dtype, sc.quant_experts)
 
-    bytes_chip = stream["total"] / sc.n_chips
     flops_chip = dec["flops_step"] / sc.n_chips
     t_ici = _collective_time(cfg, sc, chip, sc.batch)
-    t_host = HOST_US_PER_DISPATCH * 1e-6 / sc.decode_window
-
-    def step_time(bw_eff, mxu_eff):
-        t_hbm = bytes_chip / (chip.hbm_bw * bw_eff)
-        t_mxu = flops_chip / (chip.flops_bf16 * mxu_eff)
-        return max(t_hbm, t_mxu) + t_ici + t_host
-
-    t_bound = step_time(1.0, 1.0)
-    t_model = step_time(HBM_EFF, MXU_EFF)
+    t_bound = _step_time(cfg, sc, chip, sc.batch, dec["flops_per_token"],
+                         stream["total"], 1.0, 1.0)
+    t_model = _step_time(cfg, sc, chip, sc.batch, dec["flops_per_token"],
+                         stream["total"])
 
     # prefill (TTFT) — compute-bound; the weight stream is the floor
     pf = prefill_flops_per_token(cfg, sc.isl)
@@ -511,9 +524,8 @@ def analyze(sc: Scenario) -> dict:
     tok_s_chip = sc.batch / t_model / sc.n_chips
     mfu = flops_chip / t_model / chip.flops_bf16
 
-    hbm_used = (stream["params_resident"] / sc.n_chips
-                + sc.batch * (sc.isl + sc.osl) * kv_row_bytes(cfg, sc.kv_dtype)
-                / sc.n_chips)
+    hbm_used = _hbm_used(sc, sc.batch, stream["params_resident"],
+                         kv_row_bytes(cfg, sc.kv_dtype))
 
     return {
         "scenario": sc.name,
@@ -585,6 +597,42 @@ def to_markdown(records: list[dict]) -> str:
             f"| {r['prefill_chips_per_decode_chip']:.2f} "
             f"| {'yes' if r['hbm_fits'] else 'NO'} |")
     return head + "\n" + "\n".join(rows)
+
+
+def batch_sweep(sc: Scenario, batches=(1, 2, 4, 8, 16, 32, 64, 128, 256,
+                                        512),
+                flops_per_token: float = 0.0) -> dict:
+    """Modeled decode throughput vs batch for one scenario — the
+    serving-provisioning curve: where tokens/s/chip saturates (weight
+    stream amortized, KV reads dominant) and where HBM capacity caps
+    the batch.  Decode FLOPs/token are batch-independent (verified in
+    tests/test_roofline.py): pass the analyzed record's value to skip
+    re-lowering, or leave 0 to compute it here (one lowering)."""
+    cfg = getattr(ModelConfig, sc.preset)()
+    chip = CHIPS[sc.chip]
+    mean_ctx = sc.isl + sc.osl // 2
+    per_tok = flops_per_token or decode_flops_per_token(
+        cfg, sc.batch, mean_ctx)["flops_per_token"]
+    row_bytes = kv_row_bytes(cfg, sc.kv_dtype)
+    rows = []
+    for b in batches:
+        stream = decode_stream_bytes(cfg, b, mean_ctx, sc.quant,
+                                     sc.kv_dtype, sc.quant_experts)
+        t_hbm = stream["total"] / sc.n_chips / (chip.hbm_bw * HBM_EFF)
+        t_mxu = per_tok * b / sc.n_chips / (chip.flops_bf16 * MXU_EFF)
+        t = _step_time(cfg, sc, chip, b, per_tok, stream["total"])
+        hbm = _hbm_used(sc, b, stream["params_resident"], row_bytes)
+        rows.append({
+            "batch": b,
+            "tok_s_chip": round(b / t / sc.n_chips, 1),
+            "t_step_ms": round(t * 1e3, 3),
+            "bound": "hbm" if t_hbm >= t_mxu else "mxu",
+            "hbm_used_gib": round(hbm / 2**30, 2),
+            "hbm_fits": hbm <= chip.hbm_bytes,
+        })
+    return {"scenario": sc.name, "rows": rows,
+            "max_feasible_batch": max(
+                (r["batch"] for r in rows if r["hbm_fits"]), default=0)}
 
 
 # the one regeneration entry point is scripts/roofline_report.py --write

@@ -46,10 +46,27 @@ def main():
     recs = R.analyze_all()
     table = R.to_markdown(recs)
     print(table)
+    sweeps = [
+        R.batch_sweep(sc, flops_per_token=rec["flops_per_token"])
+        for sc, rec in zip(R.DEFAULT_SCENARIOS, recs)
+    ]
+    for s in sweeps:
+        if not s["max_feasible_batch"]:
+            print(f"{s['scenario']:>24}: DOES NOT FIT this slice at any "
+                  "batch")
+            continue
+        sat = max((r for r in s["rows"] if r["hbm_fits"]),
+                  key=lambda r: r["tok_s_chip"])
+        print(f"{s['scenario']:>24}: max feasible B={s['max_feasible_batch']}"
+              f", best {sat['tok_s_chip']:.0f} tok/s/chip @ B={sat['batch']}"
+              f" ({sat['bound']}-bound)")
 
     if args.write:
         with open(ART, "w") as f:
             json.dump(recs, f, indent=1)
+        with open(os.path.join(REPO, "benchmarks",
+                               "roofline_sweep.json"), "w") as f:
+            json.dump(sweeps, f, indent=1)
         with open(DOC) as f:
             doc = f.read()
         if BEGIN in doc and END in doc:

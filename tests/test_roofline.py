@@ -239,3 +239,48 @@ def test_committed_artifact_sanity():
         # stream (that delta IS the merged-decode win being priced)
         assert (r["xla_unfused_bytes_per_step"]
                 > 2 * r["bytes_per_step"]), r["scenario"]
+
+
+def test_batch_sweep_shape_and_saturation():
+    """The provisioning curve: throughput rises with batch while the
+    weight stream amortizes, and HBM capacity caps the feasible batch."""
+    sweep = R.batch_sweep(R.DEFAULT_SCENARIOS[0],
+                          batches=(1, 4, 16, 64, 256))
+    rows = sweep["rows"]
+    feasible = [r for r in rows if r["hbm_fits"]]
+    assert feasible, "no feasible batch at all"
+    # monotone non-decreasing tok/s over the feasible prefix (weight
+    # stream amortizes; KV reads grow linearly, never reversing it
+    # before capacity runs out on this config)
+    ts = [r["tok_s_chip"] for r in feasible]
+    assert all(a <= b * 1.001 for a, b in zip(ts, ts[1:]))
+    # the 16 GiB v5e must cap batch well below 256 at 3k context
+    assert sweep["max_feasible_batch"] < 256
+    assert rows[0]["bound"] == "hbm"  # B=1 decode is weight-stream bound
+
+
+def test_committed_sweep_matches_regeneration():
+    """benchmarks/roofline_sweep.json must regenerate from the current
+    code (cheap scenario only — same convention as the model artifact),
+    and its row at the scenario's own batch must agree with the
+    committed model record (one pricing implementation)."""
+    sweep_path = os.path.join(os.path.dirname(__file__), "..",
+                              "benchmarks", "roofline_sweep.json")
+    with open(sweep_path) as f:
+        committed = {s["scenario"]: s for s in json.load(f)}
+    sc = R.DEFAULT_SCENARIOS[0]
+    fresh = R.batch_sweep(sc)
+    old = committed[sc.name]
+    assert fresh["max_feasible_batch"] == old["max_feasible_batch"]
+    for a, b in zip(fresh["rows"], old["rows"]):
+        assert a["batch"] == b["batch"]
+        assert a["tok_s_chip"] == pytest.approx(b["tok_s_chip"], rel=1e-6), (
+            "sweep artifact drifted — rerun scripts/roofline_report.py "
+            "--write"
+        )
+    with open(ART) as f:
+        model = {r["scenario"]: r for r in json.load(f)}
+    at_b = next(r for r in fresh["rows"] if r["batch"] == sc.batch)
+    # sweep rows round to 0.1 tok/s; the model record is full precision
+    assert at_b["tok_s_chip"] == pytest.approx(
+        model[sc.name]["decode_tok_s_chip_modeled"], abs=0.05)
