@@ -158,6 +158,22 @@ def _build_model(args, load_weights: bool):
             v_head_dim=128, q_lora_rank=1536,
         )
         return cfg, None, ByteTokenizer(), args.model_name or "deepseek-8b-sim"
+    if args.model_path == "moe-8x2b-sim":
+        # Mixtral-proportioned sparse MoE sized for one v5e: ~4.4B
+        # total / ~1.3B active, so the bf16 init + int8 copy PEAK
+        # (~13 GB) fits 16 GB HBM during quantization. The on-chip
+        # serving shape that drives the grouped-dequant expert kernel
+        # (ops/moe_gmm_pallas.py) through the FULL stack — routing,
+        # ragged dispatch, int8 expert streams, continuous batching —
+        # not just the kernel bench
+        cfg = ModelConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=4096,
+            num_layers=20, num_heads=16, num_kv_heads=8, head_dim=128,
+            max_position_embeddings=8192, dtype="bfloat16",
+            num_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=4096,
+        )
+        return cfg, None, ByteTokenizer(), args.model_name or "moe-8x2b-sim"
     if args.model_path == "llama3-8b-sim":
         # full Llama-3-8B architecture with RANDOM weights + the byte
         # tokenizer: the serving-path TTFT/ITL bench shape for when no

@@ -240,6 +240,13 @@ def main() -> None:
     p.add_argument("--startup-timeout", type=float, default=900.0)
     args = p.parse_args()
 
+    # cleanup must run on TERM too (the suite/watch-loop timeout path):
+    # convert it to SystemExit so the finally below tears the server
+    # group down instead of leaking a chip-holding process
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     port = _free_port()
     env = os.environ.copy()
     env.pop("XLA_FLAGS", None)
@@ -269,6 +276,9 @@ def main() -> None:
         )
         tokenizer_args = ["--tokenizer", tok_dir]
         count_tokens = lambda text: max(1, len(text.split()))  # noqa: E731
+    # own process group: timeouts/INT must take the server down with
+    # this harness, never leak it to hold the chip (watch loop sends
+    # SIGINT so the finally below actually runs)
     server = subprocess.Popen(
         [sys.executable, "-m", "dynamo_tpu.launch.dynamo_run",
          "in=http", "out=jax", "--model-path", args.model_path,
@@ -281,7 +291,7 @@ def main() -> None:
          "--kv-cache-dtype", args.kv_cache_dtype,
          *(["--decode-pipeline"] if args.decode_pipeline else []),
          *tokenizer_args],
-        env=env, cwd=REPO,
+        env=env, cwd=REPO, start_new_session=True,
     )
     try:
         deadline = time.monotonic() + args.startup_timeout
@@ -337,6 +347,12 @@ def main() -> None:
             server.wait(timeout=15)
         except subprocess.TimeoutExpired:
             server.kill()
+        import signal
+
+        try:  # group sweep: the server may have spawned engine subprocs
+            os.killpg(server.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 if __name__ == "__main__":

@@ -67,8 +67,14 @@ def run_preset(p):
     except subprocess.TimeoutExpired:
         import signal
 
-        os.killpg(proc.pid, signal.SIGKILL)
-        proc.wait()
+        # TERM first: serve_bench's handler tears down the SERVER group
+        # (it runs in its own session, so killpg here cannot reach it)
+        os.killpg(proc.pid, signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
         return {"preset": p["name"], "error": "timeout after 900s"}
     if proc.returncode != 0:
         return {"preset": p["name"], "error": err[-800:]}
