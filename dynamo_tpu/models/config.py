@@ -443,6 +443,42 @@ class ModelConfig:
         base.update(overrides)
         return ModelConfig(**base)
 
+    # gpt-oss (published shapes): alternating sliding/full attention,
+    # sinks, biased clamped-SwiGLU MoE, head_dim 64. 120b: 36 layers /
+    # 128 experts; 20b: 24 layers / 32 experts — both top-4.
+    @staticmethod
+    def gptoss_120b(**overrides) -> "ModelConfig":
+        base = dict(
+            vocab_size=201088, hidden_size=2880, intermediate_size=2880,
+            num_layers=36, num_heads=64, num_kv_heads=8, head_dim=64,
+            rope_theta=150000.0, max_position_embeddings=131072,
+            num_experts=128, num_experts_per_tok=4,
+            moe_intermediate_size=2880, moe_act="gptoss_clamp",
+            attn_sinks=True, o_bias=True, attention_bias=True,
+            layer_windows=tuple(128 if i % 2 == 0 else 0
+                                for i in range(36)),
+            # the published YaRN extension (4k→128k): llama._rope_freqs
+            # implements this ruleset (incl. the fractional correction
+            # range gpt-oss's truncate=False keeps) — required for
+            # correct logits past ~4k when real weights load through
+            # this preset
+            rope_scaling=dict(
+                rope_type="yarn", factor=32.0, beta_fast=32.0,
+                beta_slow=1.0, original_max_position_embeddings=4096,
+                truncate=False,
+            ),
+        )
+        base.update(overrides)
+        return ModelConfig(**base)
+
+    @staticmethod
+    def gptoss_20b(**overrides) -> "ModelConfig":
+        base = dict(num_layers=24, num_experts=32,
+                    layer_windows=tuple(128 if i % 2 == 0 else 0
+                                        for i in range(24)))
+        base.update(overrides)
+        return ModelConfig.gptoss_120b(**base)
+
     # deepseek-r1 = the DeepSeek-V3 architecture (BASELINE config 5
     # flagship: MLA latent cache + 256-expert sigmoid-scored MoE).
     # Shape fields follow the published V3 config.json.

@@ -192,6 +192,21 @@ def kv_row_bytes(cfg: ModelConfig, kv_dtype: str = "model") -> float:
     return float(per_layer * b * cfg.num_layers)
 
 
+def kv_read_tokens_per_layer_sum(cfg: ModelConfig, ctx: int) -> float:
+    """Σ over layers of the KV tokens one decode step READS — full
+    layers read the whole live context, sliding-window layers only the
+    window (the paged kernels skip superblocks below the window floor,
+    so the saving is real HBM traffic, not just masking). gpt-oss's
+    alternating 128/full layers halve-plus the KV read stream at long
+    context; writes are unaffected (every layer appends one row)."""
+    if cfg.layer_windows:
+        return float(sum(min(ctx, w) if w else ctx
+                         for w in cfg.layer_windows))
+    if cfg.sliding_window:
+        return float(cfg.num_layers * min(ctx, cfg.sliding_window))
+    return float(cfg.num_layers * ctx)
+
+
 def decode_stream_bytes(cfg: ModelConfig, batch: int, mean_ctx: int,
                         quant: str = "none", kv_dtype: str = "model",
                         quant_experts: bool = False) -> dict:
@@ -204,7 +219,10 @@ def decode_stream_bytes(cfg: ModelConfig, batch: int, mean_ctx: int,
         frac = expected_experts_touched(
             cfg.num_experts, cfg.num_experts_per_tok, batch) / cfg.num_experts
         weight += pb["expert_bytes_per_layer"] * pb["n_moe_layers"] * frac
-    kv_read = batch * mean_ctx * row
+    # sliding-window layers read only their window of KV (kernel
+    # superblock skip); the per-layer sum folds that in
+    kv_read = (batch * (row / cfg.num_layers)
+               * kv_read_tokens_per_layer_sum(cfg, mean_ctx))
     kv_write = batch * row
     # token embedding gather + activations: B rows in/out per matmul,
     # negligible but counted for honesty
@@ -425,6 +443,18 @@ DEFAULT_SCENARIOS = (
              quant_experts=True, ep=16, tp=4, disagg=True,
              notes="BASELINE cfg 5 · DeepSeek-R1 671B MLA · ep16·tp4 · "
                    "int8 experts via the grouped-dequant kernel"),
+    # gpt-oss: beyond the BASELINE list (the family the repo serves
+    # with sinks/window kernels) — alternating 128-token sliding layers
+    # halve-plus the KV read stream, which the byte model prices via
+    # kv_read_tokens_per_layer_sum
+    Scenario("gptoss20b-v5e2-ep2", "gptoss_20b", "v5e", 2, batch=32,
+             isl=3000, osl=150, quant="int8", kv_dtype="float8_e4m3",
+             quant_experts=True, ep=2,
+             notes="gpt-oss-20b · int8 experts · windowed KV reads"),
+    Scenario("gptoss120b-v5p4-ep4", "gptoss_120b", "v5p", 4, batch=128,
+             isl=3000, osl=150, quant="int8", kv_dtype="float8_e4m3",
+             quant_experts=True, ep=4, disagg=True,
+             notes="gpt-oss-120b · int8 experts · ep4 disagg decode"),
 )
 
 
@@ -498,7 +528,13 @@ def analyze(sc: Scenario) -> dict:
                     / (chip.hbm_bw * HBM_EFF))
 
     # KV handoff for disagg: one request's prefilled cache pushed
-    # decode-ward, layer-chunked and overlapped (disagg/transfer.py)
+    # decode-ward, layer-chunked and overlapped (disagg/transfer.py).
+    # Priced at FULL context for every layer because that is what the
+    # transfer path ships today — for windowed models (~half of
+    # gpt-oss's layers only ever read their trailing 128 tokens) a
+    # window-trimmed handoff is a known future optimization worth
+    # ~isl/(isl+window) of those layers' bytes; pricing the current
+    # implementation keeps the record honest
     kv_push_bytes = sc.isl * kv_row_bytes(cfg, sc.kv_dtype)
     t_kv_push_ici = kv_push_bytes / chip.ici_link_bw
 

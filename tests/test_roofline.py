@@ -284,3 +284,24 @@ def test_committed_sweep_matches_regeneration():
     # sweep rows round to 0.1 tok/s; the model record is full precision
     assert at_b["tok_s_chip"] == pytest.approx(
         model[sc.name]["decode_tok_s_chip_modeled"], abs=0.05)
+
+
+def test_windowed_layers_shrink_kv_read_stream():
+    """gpt-oss-style alternating sliding windows must halve-plus the
+    modeled KV READ bytes at long context (the paged kernels skip
+    superblocks below the window floor — real traffic, not masking),
+    while the WRITE stream (one row per layer) is unchanged."""
+    ctx = 4096
+    win = ModelConfig.gptoss_20b()
+    full = ModelConfig.gptoss_20b(layer_windows=())
+    s_win = R.decode_stream_bytes(win, 8, ctx)
+    s_full = R.decode_stream_bytes(full, 8, ctx)
+    assert s_win["kv_write"] == s_full["kv_write"]
+    # half the layers read 128 tokens instead of 4096
+    expect = (0.5 + 0.5 * 128 / ctx)
+    assert s_win["kv_read"] / s_full["kv_read"] == pytest.approx(
+        expect, rel=1e-6)
+    # homogeneous sliding_window path too
+    sw = R.kv_read_tokens_per_layer_sum(
+        ModelConfig.tiny(sliding_window=64), 1000)
+    assert sw == ModelConfig.tiny().num_layers * 64
