@@ -167,6 +167,19 @@ def test_kv_routed_serving(run):
             await asyncio.sleep(0.02)
         assert router.indexer.events_applied >= 6
 
+        # wait for a post-completion stats scrape: on a loaded box the
+        # aggregator's last snapshot can still show the cached worker
+        # with the finished request active, and the scheduler CORRECTLY
+        # prefers the idle worker on that stale view — the property
+        # under test is prefix routing between idle workers
+        for _ in range(200):
+            eps = router.metrics.endpoints
+            if (len(eps.loads) == 2
+                    and all(l.active_requests == 0 and l.waiting == 0
+                            for l in eps.loads)):
+                break
+            await asyncio.sleep(0.02)
+
         # same prompt again: must route to the worker holding the prefix
         scores = router.indexer.find_matches(_hashes(prompt))
         assert len(scores.scores) == 1
