@@ -12,6 +12,7 @@ and the api-server.
 
 from __future__ import annotations
 
+import re
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
@@ -116,18 +117,27 @@ class ServiceDeploymentSpec:
             raise SpecError("ingress_host requires http_port")
         if self.model_cache_pvc and not self.model:
             raise SpecError("model_cache_pvc without a model to cache")
-        if self.model and not (
-            self.model.startswith(("/", "."))
-            or self.model.count("/") == 1
-        ):
+        if self.model and not self.model.startswith(("/", ".")):
             # the renderer classifies by prefix: "/..." or "./..." is a
-            # pre-staged path, one-slash is an org/name repo id — a bare
-            # relative dir like "models/llama" would silently become a
-            # crash-looping hub fetch, so demand the "./" spelling
-            raise SpecError(
-                f"model {self.model!r} must be an org/name HF repo id, "
-                "or a path starting with '/' or './'"
-            )
+            # pre-staged path; everything else must be a strict org/name
+            # HF repo id (^[\w.-]+/[\w.-]+$ — one slash, no spaces or
+            # empty components, ASCII only). A bare relative dir like
+            # "models/llama" has valid repo-id SHAPE, but "models" /
+            # "datasets" / "spaces" are reserved hub ROUTES that can
+            # never be org names — exactly the classic weights-dir
+            # spellings, rejected deterministically (no filesystem
+            # probing: validation must give one answer on every
+            # machine). Both mistakes would render a crash-looping
+            # hub-fetch initContainer; the fix is "./models/llama".
+            org = self.model.split("/", 1)[0].lower()
+            if not re.fullmatch(
+                r"[\w.-]+/[\w.-]+", self.model, re.ASCII
+            ) or org in ("models", "datasets", "spaces"):
+                raise SpecError(
+                    f"model {self.model!r} must be an org/name HF repo id "
+                    r"(^[\w.-]+/[\w.-]+$, org not a reserved dir name), "
+                    "or a path starting with '/' or './'"
+                )
         self.resources.validate()
         self.autoscaling.validate()
 

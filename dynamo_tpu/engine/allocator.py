@@ -66,6 +66,9 @@ class Block:
     ref_count: int = 0
     seq_hash: Optional[int] = None  # chained hash when full+immutable
     local_hash: Optional[int] = None
+    # restored via a router prefetch hint and not yet claimed — cleared
+    # (and counted as h2d_prefetch_hits) on the first match_prefix claim
+    prefetched: bool = False
 
 
 class BlockAllocator:
@@ -119,6 +122,7 @@ class BlockAllocator:
                 self.on_removed([seq_hash])
             b.seq_hash = None
             b.local_hash = None
+            b.prefetched = False
         else:
             return None
         b.ref_count = 1
@@ -159,6 +163,40 @@ class BlockAllocator:
             out.append(b)
         return out
 
+    def has_hash(self, seq_hash: int) -> bool:
+        """Non-claiming device-residency probe (active OR reuse pool) —
+        the prefetch path's radix check before it touches the host tier."""
+        return seq_hash in self._by_hash or seq_hash in self._reuse
+
+    def adopt_restored(
+        self,
+        block: Block,
+        seq_hash: int,
+        local_hash: Optional[int],
+        parent_hash: Optional[int],
+    ) -> bool:
+        """Content-address a block whose KV was just restored from a
+        lower tier (router-hinted prefetch): like
+        :meth:`commit_full_block` but the hashes arrive precomputed from
+        the hint instead of from tokens. The caller still holds the
+        allocation ref; its :meth:`free` parks the block in the reuse
+        pool where match_prefix claims it.
+
+        Returns False without adopting when the hash is ALREADY device
+        resident (a request raced its own hint and committed first):
+        registering a second block under the hash would let free() park
+        it over the existing reuse entry and orphan that block. The
+        un-adopted block stays plain and free() returns it to the free
+        list."""
+        if self.has_hash(seq_hash):
+            return False
+        block.seq_hash = seq_hash
+        block.local_hash = local_hash
+        self._by_hash[seq_hash] = block.idx
+        if self.on_stored:
+            self.on_stored(block, parent_hash)
+        return True
+
     def commit_full_block(self, block: Block, tokens: Sequence[int], parent_hash: Optional[int]) -> int:
         """Mark a now-full block immutable + content-addressed; returns its
         chained hash. Fires the stored event (feeds the KV router)."""
@@ -189,11 +227,22 @@ class BlockAllocator:
                 continue
             if b.seq_hash is not None and self._by_hash.get(b.seq_hash) == b.idx:
                 del self._by_hash[b.seq_hash]
-                self._reuse[b.seq_hash] = b.idx
-                self._reuse.move_to_end(b.seq_hash)
+                if b.seq_hash not in self._reuse:
+                    self._reuse[b.seq_hash] = b.idx
+                    self._reuse.move_to_end(b.seq_hash)
+                else:
+                    # belt-and-braces vs adopt_restored's residency
+                    # check: parking over an existing reuse entry would
+                    # orphan that block (ref 0, in neither _free nor
+                    # _reuse) — the duplicate goes to the free list
+                    b.seq_hash = None
+                    b.local_hash = None
+                    b.prefetched = False
+                    self._free.append(b.idx)
             else:
                 b.seq_hash = None
                 b.local_hash = None
+                b.prefetched = False
                 self._free.append(b.idx)
         if removed_hashes and self.on_removed:
             self.on_removed(removed_hashes)
@@ -203,6 +252,7 @@ class BlockAllocator:
             b.ref_count = 0
             b.seq_hash = None
             b.local_hash = None
+            b.prefetched = False
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._by_hash.clear()
         self._reuse.clear()

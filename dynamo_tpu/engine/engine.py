@@ -85,6 +85,16 @@ class EngineConfig:
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
+    # async offload tier: d2h eviction flushes land via background
+    # executor threads (double-buffered, budgeted) and h2d restores
+    # upload from the moment admission reserves the chain — the
+    # scheduler loop never blocks on a transfer (offload.py module
+    # docstring). False = legacy synchronous transfers (escape hatch;
+    # the multi-host mirror is always synchronous regardless).
+    offload_async: bool = True
+    # max OPTIONAL evicted blocks one decode dispatch gathers d2h;
+    # evictions whose pages the dispatch itself overwrites always flush
+    offload_flush_budget: int = 64
     # max fused decode steps per device dispatch (lax.scan window): the
     # sampled token of step i feeds step i+1 on device, so the host syncs
     # once per window, not once per token. The scheduler drops to 1-step
@@ -256,7 +266,11 @@ class JaxEngine(AsyncEngine):
         if cfg.host_cache_blocks > 0:
             # under the multi-host mirror, flush/restore become mirrored
             # ops and every process parks its own cache shards in host DRAM
-            self.offload = OffloadManager(cfg.host_cache_blocks, mirror=mirror)
+            self.offload = OffloadManager(
+                cfg.host_cache_blocks, mirror=mirror,
+                flush_budget=cfg.offload_flush_budget,
+                async_tier=cfg.offload_async,
+            )
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
         # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
         # run the kernel under shard_map over tp (head-parallel, no
@@ -379,6 +393,8 @@ class JaxEngine(AsyncEngine):
         if self._loop_task:
             self._loop_task.cancel()
             self._loop_task = None
+        if self.offload is not None:
+            self.offload.close()
         if self.mirror is not None:
             # release follower ranks blocked on the next broadcast; take the
             # device lock so the halt can't interleave with a decode/prefill
@@ -615,8 +631,23 @@ class JaxEngine(AsyncEngine):
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
+            prompt_hashes = None
+            if self.offload is not None and self.offload.async_tier and (
+                self.offload.has_pending()
+                or self.offload.has_inflight_flushes()
+            ):
+                # land any in-flight d2h holding this prompt's chain
+                # off-loop, so _begin_prefill's host probe never blocks
+                # the scheduler on a transfer; the chain is computed once
+                # and handed down so admission doesn't re-hash the prompt
+                prompt_hashes = sequence_block_hashes(
+                    seq.tokens[: seq.seq_len - 1], self.cfg.block_size
+                )
+                await self._offload_prejoin(
+                    [s for _l, s in prompt_hashes]
+                )
             try:
-                ok = self._begin_prefill(seq)
+                ok = self._begin_prefill(seq, hashes=prompt_hashes)
             except Exception:  # noqa: BLE001
                 # device failure on THIS request (oom, compile error): fail
                 # it alone — the loop and other requests keep going
@@ -662,23 +693,41 @@ class JaxEngine(AsyncEngine):
         self.stats["requests_waiting"] = self._waiting_size()
         return admitted
 
-    def _reserve_for_prompt(self, seq: _Sequence, probe_host: bool = False):
+    def _reserve_for_prompt(self, seq: _Sequence, probe_host: bool = False,
+                            hashes=None):
         """The one allocation protocol shared by local prefill, remote
         prefill (worker side) and remote decode (decode side): match the
         device prefix cache on the prompt's full blocks (always recompute
         the final token so prefill yields fresh last-position logits),
         optionally probe the host offload tier for the chain's
-        continuation, then allocate fresh blocks for prompt + decode
-        headroom. Populates seq.{blocks,committed,parent_hash,
-        cached_prefix}; returns (history, restore_hashes, restore_data,
-        restore_idxs) or None with every claim rolled back."""
+        continuation — the reserved chain starts its h2d upload HERE, so
+        by the time the prefill chunk needs the pages the transfer has
+        (usually) already landed — then allocate fresh blocks for prompt
+        + decode headroom. Populates seq.{blocks,committed,parent_hash,
+        cached_prefix}; returns (history, upload_or_None) or None with
+        every claim rolled back."""
         cfg = self.cfg
         bs = cfg.block_size
         prompt = seq.tokens
-        all_hashes = sequence_block_hashes(prompt[: len(prompt) - 1], bs)
+        # ``hashes`` may carry the chain the caller already computed
+        # (admission's prejoin) so long prompts hash once, not twice
+        all_hashes = hashes if hashes is not None else (
+            sequence_block_hashes(prompt[: len(prompt) - 1], bs)
+        )
         matched = self.allocator.match_prefix(
             prompt[: len(prompt) - 1], hashes=all_hashes
         )
+        if self.offload is not None and matched:
+            # blocks that reached the device tier via a router prefetch
+            # hint and are now claimed: the hint saved this request a
+            # cold host restore (or a full recompute)
+            n_pf = 0
+            for b in matched:
+                if b.prefetched:
+                    b.prefetched = False
+                    n_pf += 1
+            if n_pf:
+                self.offload.note_prefetch_hits(n_pf)
         # host-tier probe: continuation of the chain past the device match
         # (ref docs/kv_cache_manager.md host offload); reserving takes the
         # blocks out of the pool so they can't be LRU'd before restore
@@ -701,24 +750,23 @@ class JaxEngine(AsyncEngine):
         seq.parent_hash = matched[-1].seq_hash if matched else None
         history = (len(matched) + len(restore_hashes)) * bs
         seq.cached_prefix = history
-        restore_idxs = [b.idx for b in fresh[: len(restore_hashes)]]
-        return history, restore_hashes, restore_data, restore_idxs
+        upload = None
+        if self.offload is not None and restore_hashes:
+            upload = self.offload.begin_upload(
+                restore_hashes, restore_data,
+                [b.idx for b in fresh[: len(restore_hashes)]],
+            )
+        return history, upload
 
-    def _begin_prefill(self, seq: _Sequence) -> bool:
+    def _begin_prefill(self, seq: _Sequence, hashes=None) -> bool:
         """Reserve blocks + prefix/host-tier claims and queue the sequence
         as the in-flight chunked prefill. Returns False on pool pressure."""
-        reserved = self._reserve_for_prompt(seq, probe_host=True)
+        reserved = self._reserve_for_prompt(seq, probe_host=True, hashes=hashes)
         if reserved is None:
             return False
-        history, restore_hashes, restore_data, restore_idxs = reserved
+        history, upload = reserved
         self.stats["prefix_cache_hits_tokens"] += history
-        self._prefill_state = _PrefillState(
-            seq=seq,
-            pos=history,
-            restore_hashes=restore_hashes,
-            restore_data=restore_data,
-            restore_idxs=restore_idxs,
-        )
+        self._prefill_state = _PrefillState(seq=seq, pos=history, upload=upload)
         return True
 
     async def _prefill_step(self) -> bool:
@@ -732,11 +780,11 @@ class JaxEngine(AsyncEngine):
             self._prefill_state = None
             self.allocator.free(seq.blocks)
             seq.blocks = []
-            # hand reserved host blocks back even mid-restore (host arrays
-            # are never mutated, so re-pooling is safe) — same as the
-            # error path below; dropping them would leak the cached prefix
-            if self.offload is not None and st.restore_hashes:
-                self.offload.unreserve(st.restore_hashes, st.restore_data, restored=st.restored)
+            # hand reserved host blocks back even mid-upload (the upload
+            # only READS the host arrays, so re-pooling is safe) — same
+            # as the error path below; dropping them would leak the
+            # cached prefix
+            self._rollback_upload(st)
             seq.out_queue.put_nowait(
                 LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
             )
@@ -751,13 +799,12 @@ class JaxEngine(AsyncEngine):
         except Exception:
             # device failure: hand reserved host blocks back so the prefix
             # isn't silently lost from the offload tier (host arrays are
-            # never mutated, so re-pooling is safe even mid-restore)
+            # never mutated, so re-pooling is safe even mid-upload)
             self._prefill_state = None
             logger.exception("prefill failed for request %s", seq.context.id)
             self.allocator.free(seq.blocks)
             seq.blocks = []
-            if self.offload is not None and st.restore_hashes:
-                self.offload.unreserve(st.restore_hashes, st.restore_data, restored=st.restored)
+            self._rollback_upload(st)
             seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.ERROR))
             return False
         if first_token is None:
@@ -770,30 +817,42 @@ class JaxEngine(AsyncEngine):
             self._place_in_batch(seq)
         return True
 
+    def _rollback_upload(self, st: _PrefillState) -> None:
+        """Shared cancel/error rollback for a prefill's reserved host
+        chain: record the abandoned upload (if it never landed) and
+        return the entries to the pool — the one protocol both paths
+        must not drift apart on."""
+        if self.offload is None or st.upload is None:
+            return
+        if not st.restored:
+            self.offload.cancel_upload(st.upload)
+        self.offload.unreserve(
+            st.upload.hashes, st.upload.data, restored=st.restored
+        )
+
     def _prefill_chunk_device(self, st: _PrefillState) -> Optional[int]:
         """Runs in an executor thread: one bucketed prefill chunk. Returns
         the sampled first token on the final chunk, else None."""
-        self._offload_preamble(
-            st.restore_data if not st.restored else None, st.restore_idxs,
-            st.restore_hashes,
-        )
+        self._offload_preamble(st.upload if not st.restored else None)
         st.restored = True
         logits, st.pos = self._run_one_chunk(st.seq, st.pos)
         if st.pos < len(st.seq.tokens):
             return None
         return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
 
-    def _offload_preamble(self, restore_data, restore_idxs,
-                          restore_hashes=None) -> None:
-        """d2h evicted blocks before their pages get overwritten, then land
-        any host-tier prefix restore."""
+    def _offload_preamble(self, upload=None) -> None:
+        """Dispatch d2h gathers for every pending eviction before this
+        prefill overwrites their pages (the fetch lands in background —
+        budget=None takes all pending because a prefill may write any
+        freshly allocated page), then land the reserved chain's h2d
+        upload: a cheap on-device scatter that waits only if the upload
+        begun at reservation hasn't arrived yet."""
         if self.offload is None:
             return
-        self.offload.flush_evictions(self.k_cache, self.v_cache)
-        if restore_data:
-            self.k_cache, self.v_cache = self.offload.restore(
-                self.k_cache, self.v_cache, restore_data, restore_idxs,
-                hashes=restore_hashes,
+        self.offload.flush_evictions_async(self.k_cache, self.v_cache)
+        if upload is not None:
+            self.k_cache, self.v_cache = self.offload.finish_upload(
+                self.k_cache, self.v_cache, upload
             )
 
     def _ring_chunk(self, seq: _Sequence, pos: int) -> bool:
@@ -860,9 +919,7 @@ class JaxEngine(AsyncEngine):
         self,
         seq: _Sequence,
         history: int,
-        restore_data: Optional[list] = None,
-        restore_idxs: Optional[list[int]] = None,
-        restore_hashes: Optional[list[int]] = None,
+        upload=None,
     ) -> tuple[int, Optional[dict]]:
         """Runs in an executor thread: whole-prompt chunked prefill +
         first-token sample (the disagg prefill-worker path, which owns the
@@ -871,7 +928,7 @@ class JaxEngine(AsyncEngine):
         entry or None) — the entry rides the KV transfer so a logprobs
         request served via remote prefill doesn't lose its first token's
         logprobs (advisor r2)."""
-        self._offload_preamble(restore_data, restore_idxs, restore_hashes)
+        self._offload_preamble(upload)
         logits = None
         pos = history
         while pos < len(seq.tokens):
@@ -1040,6 +1097,140 @@ class JaxEngine(AsyncEngine):
             prompt_j, gen_j = jnp.asarray(prompt_p), jnp.asarray(gen_p)
         self._pen_counts, self._pen_mask = _reset_pen_slot(
             self._pen_counts, self._pen_mask, slot, prompt_j, gen_j
+        )
+
+    # ---- offload tier helpers ----
+
+    def _flush_evictions_budgeted(self) -> None:
+        """Budgeted background d2h for decode-path dispatches: at most
+        ``offload_flush_budget`` optional blocks per window so offload
+        traffic can't starve decode, but every pending eviction whose
+        page appears in the live block tables (a page this dispatch may
+        write) flushes unconditionally — deferring those would snapshot
+        overwritten KV."""
+        if self.offload is None or not self.offload.has_pending():
+            return
+        must = set(np.unique(self._block_tables).tolist())
+        must.discard(0)
+        self.offload.flush_evictions_async(
+            self.k_cache, self.v_cache,
+            budget=self.offload.flush_budget, must_idxs=must,
+        )
+
+    async def _offload_prejoin(self, hashes: list[int]) -> None:
+        """Before an event-loop host-tier probe: dispatch any pending
+        eviction gathers (budget-deferred entries are otherwise invisible
+        to admission — neither in the pool nor in flight) and wait
+        OFF-LOOP for in-flight flushes holding ``hashes``, so the probe
+        sees every landed block without the event loop ever blocking on
+        a d2h fetch."""
+        off = self.offload
+        if off is None or not off.async_tier or not hashes:
+            return
+        loop = asyncio.get_running_loop()
+        if off.has_pending():
+            # under the device lock: dispatch order across threads stays
+            # serialized, so the gathers remain stream-ordered before any
+            # later compute that overwrites the evicted pages
+            async with self._device_lock:
+                await loop.run_in_executor(
+                    None, off.flush_evictions_async,
+                    self.k_cache, self.v_cache,
+                )
+        if off.has_inflight_flushes():
+            await loop.run_in_executor(None, off._join_flushes_for, hashes)
+
+    async def prefetch_hint(self, blocks: list) -> int:
+        """Router-hinted host-tier prefetch (PRESERVE-style): ``blocks``
+        is the request's prompt chain as (local_hash, chained_hash)
+        pairs, shipped by the KV router the moment it picked this worker
+        (kv_router/scheduler.py emit_prefetch). Probes the device tiers
+        for the longest resident prefix, restores the host-tier
+        continuation into freshly allocated pages, and commits them to
+        the content-addressed reuse pool — so when the request itself
+        arrives, admission claims them as ordinary device prefix hits
+        and TTFT never sees the h2d latency.
+
+        Best-effort by design: bails without side effects under pool
+        pressure, on mirrored engines (restores there are lockstep
+        broadcasts), or when the tier is cold. The host chain is read
+        NON-destructively (peek, not take): a request racing its own
+        hint still finds the chain in the pool and restores normally —
+        a hint can never make the hinted request slower. The host copies
+        are only discarded after the device commit. Returns blocks
+        restored."""
+        if (
+            self.offload is None
+            or self.mirror is not None
+            or not self.cfg.offload_async
+            or not blocks
+            or self._closed
+        ):
+            return 0
+        chain = [s for _l, s in blocks]
+        await self._offload_prejoin(chain)
+        n_dev = 0
+        for h in chain:
+            if not self.allocator.has_hash(h):
+                break
+            n_dev += 1
+        tail = blocks[n_dev:]
+        if not tail:
+            return 0
+        hashes, data = self.offload.peek_chain([s for _l, s in tail])
+        if not hashes:
+            return 0
+        fresh = self.allocator.allocate(len(hashes))
+        if fresh is None:
+            return 0
+        upload = self.offload.begin_upload(
+            hashes, data, [b.idx for b in fresh]
+        )
+        try:
+            # wait out the h2d BEFORE taking the device lock — holding it
+            # across the transfer would stall every decode window for the
+            # upload duration, re-exposing the very latency this hides.
+            # Bounded: a wedged executor must degrade this hint to a cold
+            # restore, not wedge the (serial) prefetch listener with it
+            if upload.future is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, upload.future.result, 30.0
+                )
+            async with self._device_lock:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._prefetch_land_device, upload
+                )
+        except Exception:  # noqa: BLE001 — prefetch is advisory
+            logger.exception("hinted prefetch restore failed")
+            self.allocator.free(fresh)
+            self.offload.cancel_upload(upload)
+            return 0
+        # commit the restored pages into the reuse pool under their
+        # chained hashes (parent linkage from the hint), then drop our
+        # ref — they become LRU-claimable device prefix blocks, exactly
+        # like blocks a finished sequence left behind. A hash that went
+        # device-resident DURING the upload (the request raced its own
+        # hint) is not adopted — that block returns to the free list.
+        # Only now do the host copies go (entries a racing admission
+        # already took are fine — content is hash-addressed, identical).
+        parent = chain[n_dev - 1] if n_dev else None
+        adopted = 0
+        for b, (local, seq_hash) in zip(fresh, tail):
+            if self.allocator.adopt_restored(b, seq_hash, local, parent):
+                b.prefetched = True
+                adopted += 1
+            parent = seq_hash
+        self.allocator.free(fresh)
+        self.offload.discard_chain(hashes)
+        self.offload.note_prefetch_landed(upload)
+        return adopted
+
+    def _prefetch_land_device(self, upload) -> None:
+        """Executor thread: flush pending evictions that may reference
+        the prefetch's pages, then scatter the landed upload."""
+        self.offload.flush_evictions_async(self.k_cache, self.v_cache)
+        self.k_cache, self.v_cache = self.offload.finish_upload(
+            self.k_cache, self.v_cache, upload, account=False
         )
 
     # ---- decode ----
@@ -1433,8 +1624,7 @@ class JaxEngine(AsyncEngine):
         """Executor thread: fused verify forward + on-device acceptance.
         Returns (out_tokens [B, T], n_acc [B], lp arrays or None)."""
         cfg = self.cfg
-        if self.offload is not None:
-            self.offload.flush_evictions(self.k_cache, self.v_cache)
+        self._flush_evictions_budgeted()
         positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
         penalized = self._penalties_active()
         want_lp = self._logprobs_active()
@@ -1595,8 +1785,7 @@ class JaxEngine(AsyncEngine):
                     f"{getattr(seq.context, 'id', '?')} "
                     f"(seq_len={seq.seq_len}, blocks={len(seq.blocks)})"
                 )
-        if self.offload is not None:
-            self.offload.flush_evictions(self.k_cache, self.v_cache)
+        self._flush_evictions_budgeted()
         positions = (
             np.maximum(self._seq_lens - 1, 0) + pending
         ).astype(np.int32)
@@ -1930,8 +2119,9 @@ class JaxEngine(AsyncEngine):
 
         if self.offload is not None:
             # pending evictions may reference the very pages we're about to
-            # overwrite — snapshot them to the host tier first
-            self.offload.flush_evictions(self.k_cache, self.v_cache)
+            # overwrite — dispatch their gathers first (budget=None: the
+            # landing KV may target any freshly allocated page)
+            self.offload.flush_evictions_async(self.k_cache, self.v_cache)
         padded = _pad_idxs(idxs)
         if self.mirror is not None:
             # mirrored landing: broadcast the UNPADDED host blocks (the
@@ -1971,7 +2161,7 @@ class _PrefillState:
 
     seq: _Sequence
     pos: int  # next prompt index to prefill
-    restore_hashes: list
-    restore_data: list
-    restore_idxs: list
-    restored: bool = False  # host-tier restore done (first chunk)
+    # reserved host chain's in-flight h2d stage (offload.RestoreUpload,
+    # begun at reservation), or None when the host tier missed
+    upload: Optional[object] = None
+    restored: bool = False  # host-tier restore landed (first chunk)

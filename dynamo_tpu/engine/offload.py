@@ -15,18 +15,60 @@ Transfer shapes are bucketed (pad block-index vectors with the trash
 block 0 — scatters to it are harmless by design) so the jitted
 gather/scatter pair compiles O(log max_batch) programs, not one per
 transfer size.
+
+**Async tier (PRESERVE-style overlap).** Both transfer directions are
+pipelined so the single scheduler loop never blocks on PCIe:
+
+  * **d2h**: :meth:`OffloadManager.flush_evictions_async` dispatches the
+    bucketed device gather in the calling (device-executor) thread — so
+    it is device-stream-ordered BEFORE the compute that overwrites the
+    evicted pages, the invariant the sync path also relied on — but the
+    blocking d2h fetch + host-pool insertion run on a small offload
+    executor, double-buffered (at most ``_MAX_INFLIGHT_FLUSHES`` gathers
+    in flight) with a per-iteration block budget so decode windows are
+    never starved by offload traffic. Evictions whose pages the caller
+    is about to overwrite are flushed unconditionally (``must_idxs``).
+  * **h2d**: restore splits into :meth:`begin_upload` — stacks the
+    reserved host chain and starts the device upload on the offload
+    executor the moment admission claims it — and :meth:`finish_upload`,
+    a cheap on-device scatter that only waits if the upload hasn't
+    landed. The wait actually paid is tracked as *exposed* restore
+    latency vs. the *hidden* remainder (``restore_latency_hidden_frac``).
+
+Under the multi-host mirror every transfer stays a synchronous mirrored
+op (leader/follower lockstep leaves no room for background landing).
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 _BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128]
+
+#: double-buffer depth for async d2h flushes: one gather landing while
+#: the next is being filled; more would just queue PCIe traffic
+_MAX_INFLIGHT_FLUSHES = 2
+
+
+def _device_fetch(arr) -> np.ndarray:
+    """The one d2h sync point (module-level so tests can inject latency)."""
+    return np.asarray(jax.device_get(arr))
+
+
+def _device_put(arr: np.ndarray):
+    """The one h2d entry point (module-level so tests can inject latency)."""
+    return jnp.asarray(arr)
 
 
 def _bucket(n: int) -> int:
@@ -117,6 +159,17 @@ class HostKvPool:
         which re-registers it in the device reuse pool on release)."""
         return self._data.pop(seq_hash, None)
 
+    def peek(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Return WITHOUT removing (router-hinted prefetch reads the
+        chain non-destructively: the entry stays claimable by a racing
+        admission until the prefetched copy is committed on device —
+        content is hash-addressed and immutable, so concurrent readers
+        are safe)."""
+        got = self._data.get(seq_hash)
+        if got is not None:
+            self._data.move_to_end(seq_hash)
+        return got
+
     def match_chain(self, seq_hashes: list[int]) -> int:
         """Longest consecutive run of hashes resident in the pool."""
         n = 0
@@ -168,20 +221,75 @@ class HostKvPool:
                 self._data.move_to_end(h)
 
 
+class _FlushTask:
+    """One in-flight async d2h flush: the gather was dispatched on the
+    device thread; ``future`` lands the host copies into the pool."""
+
+    __slots__ = ("hashes", "future")
+
+    def __init__(self, hashes: list[int], future):
+        self.hashes = hashes
+        self.future = future
+
+
+class RestoreUpload:
+    """One reserved host chain's h2d stage: stacking + device upload run
+    on the offload executor from the moment admission reserves the chain;
+    :meth:`OffloadManager.finish_upload` scatters (and only then waits,
+    if the upload hasn't landed). ``future`` is None on the synchronous
+    paths (mirror, async tier disabled, empty chain)."""
+
+    __slots__ = ("hashes", "data", "idxs", "future", "t_start", "t_landed",
+                 "cancelled")
+
+    def __init__(self, hashes: list, data: list, idxs: list[int]):
+        self.hashes = hashes
+        self.data = data
+        self.idxs = idxs
+        self.future = None
+        self.t_start = time.monotonic()
+        self.t_landed: Optional[float] = None
+        self.cancelled = False
+
+
 class OffloadManager:
     """Orchestrates device<->host block movement for one engine.
 
-    Runs entirely on the engine's device-executor thread (the same thread
-    that issues prefill/decode), so gathers of evicted blocks are always
-    dispatched before the compute that overwrites those pages — ordering
-    by construction, the role CUDA stream events play in the reference's
-    CopyStream (kv/layer.rs:619).
+    Device dispatch (gathers, scatters) happens on the engine's
+    device-executor thread, so transfers are always stream-ordered before
+    the compute that overwrites those pages — ordering by construction,
+    the role CUDA stream events play in the reference's CopyStream
+    (kv/layer.rs:619). The blocking host side of each transfer (d2h
+    fetch, host stacking, h2d upload) runs on ``_exec``, a 2-thread
+    offload executor, so the scheduler loop and the device thread never
+    wait on PCIe unless a restore is needed *right now* (module
+    docstring). ``_lock`` guards the pool + pending/in-flight structures
+    across the event-loop, device-executor and offload-executor threads.
     """
 
-    def __init__(self, host_blocks: int, mirror=None):
+    def __init__(self, host_blocks: int, mirror=None,
+                 flush_budget: int = 64, async_tier: bool = True):
         self.pool = HostKvPool(host_blocks)
         # (seq_hash, device_block_idx) evictions awaiting d2h
         self._pending: list[tuple[int, int]] = []
+        # async tier state: in-flight d2h flush tasks + transfer knobs.
+        # The mirror path is always synchronous (lockstep broadcasts).
+        self.async_tier = async_tier and mirror is None
+        self.flush_budget = max(1, flush_budget)
+        self._lock = threading.RLock()
+        self._exec: Optional[ThreadPoolExecutor] = None
+        self._closed = False
+        self._inflight_flushes: list[_FlushTask] = []
+        # stats (ISSUE: d2h_flush_async / h2d_prefetch_hits /
+        # restore_latency_hidden_frac)
+        self.d2h_flush_async_total = 0
+        self.d2h_flush_failures = 0
+        self.h2d_prefetch_blocks_total = 0
+        self.h2d_prefetch_hits = 0
+        self.h2d_uploads_started = 0
+        self.h2d_uploads_cancelled = 0
+        self.restore_hidden_s = 0.0
+        self.restore_exposed_s = 0.0
         # multi-host: flushes/restores become mirrored ops — every process
         # gathers/scatters in lockstep and parks its OWN cache shards in
         # host DRAM (pool values are per-unique-shard piece lists instead
@@ -197,17 +305,113 @@ class OffloadManager:
 
     # -- allocator callback (event-loop thread) --
     def on_evict(self, seq_hash: int, block_idx: int) -> None:
-        self._pending.append((seq_hash, block_idx))
+        with self._lock:
+            self._pending.append((seq_hash, block_idx))
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._closed:
+            # a late hint/flush after engine close must not resurrect
+            # threads on a torn-down engine
+            raise RuntimeError("offload manager is closed")
+        if self._exec is None:
+            self._exec = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="kv-offload"
+            )
+        return self._exec
+
+    #: admission-side cap on waiting for a relevant in-flight flush to
+    #: land: normally the d2h was dispatched a scheduler iteration ago
+    #: and the wait is ~zero, but a wedged executor must degrade to a
+    #: cache miss (shorter reserved chain), not a stalled event loop
+    _JOIN_TIMEOUT_S = 1.0
+
+    def _join_flushes_for(self, seq_hashes: list[int]) -> None:
+        """Wait (bounded) for in-flight flushes holding any of
+        ``seq_hashes`` to land; paying the usually-zero wait only when a
+        probe could actually hit keeps admission from trading a whole
+        prefix recompute for a near-landed copy. On timeout the unlanded
+        entries simply don't match — they land later and serve the next
+        request."""
+        need = set(seq_hashes)
+        with self._lock:
+            tasks = [
+                t for t in self._inflight_flushes
+                if not need.isdisjoint(t.hashes)
+            ]
+        deadline = time.monotonic() + self._JOIN_TIMEOUT_S
+        for t in tasks:
+            try:
+                t.future.result(max(0.0, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 — timeout/failure = cache miss
+                pass
+        with self._lock:
+            self._reap_flushes_locked()
+
+    def _reap_flushes_locked(self) -> None:
+        alive = []
+        for t in self._inflight_flushes:
+            if not t.future.done():
+                alive.append(t)
+                continue
+            exc = t.future.exception()
+            if exc is not None:
+                # a failed landing silently drops those blocks from the
+                # host tier (multi-turn TTFT regresses to recompute) —
+                # that must be visible to operators, not just absent
+                self.d2h_flush_failures += 1
+                logger.warning(
+                    "async d2h flush of %d blocks failed (KV dropped "
+                    "from the host tier): %s", len(t.hashes), exc,
+                )
+        self._inflight_flushes = alive
+
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def has_inflight_flushes(self) -> bool:
+        return bool(self._inflight_flushes)
 
     # -- admission-time reservation (event-loop thread) --
     def reserve_chain(
         self, seq_hashes: list[int]
     ) -> tuple[list[int], list[tuple[np.ndarray, np.ndarray]]]:
         """Take the longest resident prefix OUT of the pool (so a later
-        flush_evictions can't LRU it away before restore runs)."""
-        n = self.pool.match_chain(seq_hashes)
-        hashes = seq_hashes[:n]
-        return hashes, [self.pool.take(h) for h in hashes]
+        flush_evictions can't LRU it away before restore runs).
+
+        Callers on the event loop should have pre-joined relevant
+        in-flight flushes off-loop (engine._offload_prejoin); the inline
+        bounded join here is the correctness backstop for direct
+        callers."""
+        if seq_hashes and self._inflight_flushes:
+            self._join_flushes_for(seq_hashes)
+        with self._lock:
+            n = self.pool.match_chain(seq_hashes)
+            hashes = seq_hashes[:n]
+            return hashes, [self.pool.take(h) for h in hashes]
+
+    def peek_chain(
+        self, seq_hashes: list[int]
+    ) -> tuple[list[int], list[tuple[np.ndarray, np.ndarray]]]:
+        """Non-destructive :meth:`reserve_chain` for the prefetch path:
+        the entries STAY in the pool, claimable by a racing admission,
+        until :meth:`discard_chain` drops them after the device commit.
+        (A hint must never make the hinted request slower: popping here
+        would hide the chain from the request while the upload is in
+        flight.)"""
+        if seq_hashes and self._inflight_flushes:
+            self._join_flushes_for(seq_hashes)
+        with self._lock:
+            n = self.pool.match_chain(seq_hashes)
+            hashes = seq_hashes[:n]
+            return hashes, [self.pool.peek(h) for h in hashes]
+
+    def discard_chain(self, hashes: list[int]) -> None:
+        """Drop host copies whose content is now device-resident (the
+        prefetch landed + committed). Entries a racing admission already
+        took are simply gone — nothing to do."""
+        with self._lock:
+            for h in hashes:
+                self.pool.take(h)
 
     def unreserve(self, hashes: list[int], data, restored: bool = False) -> None:
         """Admission failed (or the prefill was cancelled/errored) after
@@ -221,62 +425,211 @@ class OffloadManager:
         reuse pool anyway). Re-pools of never-restored entries go through
         the LRU plan and queue any evictions as deferred follower drops."""
         if self.mirror is not None:
-            if restored:
-                # followers popped at restore; leader forgets too. The
-                # drop is deferred only to cover the (idempotent) case of
-                # follower tiers that never saw the restore.
-                self._deferred_drops.extend(hashes)
-                return
-            drops, keep, order = self.pool.plan_puts(hashes)
-            by_hash = dict(zip(hashes, data))
-            self.pool.apply_plan(
-                drops, keep, order, hashes, lambda i: by_hash[hashes[i]]
-            )
-            # follower tiers hold every hash from the original flush: drop
-            # both the plan's evictions AND any re-pooled hash the plan
-            # itself discarded (keep=False, not resident afterwards) — or
-            # follower host DRAM grows past the leader's budget
-            final = set(order)
-            self._deferred_drops.extend(drops)
-            self._deferred_drops.extend(h for h in hashes if h not in final)
+            with self._lock:
+                if restored:
+                    # followers popped at restore; leader forgets too. The
+                    # drop is deferred only to cover the (idempotent) case
+                    # of follower tiers that never saw the restore.
+                    self._deferred_drops.extend(hashes)
+                    return
+                drops, keep, order = self.pool.plan_puts(hashes)
+                by_hash = dict(zip(hashes, data))
+                self.pool.apply_plan(
+                    drops, keep, order, hashes, lambda i: by_hash[hashes[i]]
+                )
+                # follower tiers hold every hash from the original flush:
+                # drop both the plan's evictions AND any re-pooled hash the
+                # plan itself discarded (keep=False, not resident
+                # afterwards) — or follower host DRAM grows past the
+                # leader's budget
+                final = set(order)
+                self._deferred_drops.extend(drops)
+                self._deferred_drops.extend(
+                    h for h in hashes if h not in final
+                )
             return
-        for h, (k, v) in zip(hashes, data):
-            self.pool.put(h, k, v)
+        with self._lock:
+            for h, (k, v) in zip(hashes, data):
+                self.pool.put(h, k, v)
 
     # -- device-thread operations --
     def flush_evictions(self, k_cache, v_cache) -> None:
-        """Gather + d2h all pending evicted blocks into the host pool."""
-        if not self._pending:
-            return
-        pending, self._pending = self._pending, []
+        """Gather + d2h all pending evicted blocks into the host pool,
+        synchronously (the mirror path and the ``async_tier=False``
+        escape hatch)."""
+        with self._lock:
+            if not self._pending:
+                return
+            pending, self._pending = self._pending, []
         idxs = _pad_idxs([idx for _h, idx in pending])
         if self.mirror is not None:
             hashes = [h for h, _idx in pending]
-            drops, keep, order = self.pool.plan_puts(hashes)
-            bcast_drops = drops + self._deferred_drops
-            self._deferred_drops = []
+            with self._lock:
+                drops, keep, order = self.pool.plan_puts(hashes)
+                bcast_drops = drops + self._deferred_drops
+                self._deferred_drops = []
             kg, vg = self.mirror.lead_offload_flush(
                 k_cache, v_cache, idxs, hashes,
                 np.asarray(keep, np.uint8), bcast_drops,
             )
             k_pc = self.mirror.local_pieces(kg)
             v_pc = self.mirror.local_pieces(vg)
-            self.pool.apply_plan(
-                drops, keep, order, hashes,
-                lambda i: (
-                    [p[:, :, i].copy() for p in k_pc],
-                    [p[:, :, i].copy() for p in v_pc],
-                ),
-            )
-            self.pool.stored_total += len(pending)
+            with self._lock:
+                self.pool.apply_plan(
+                    drops, keep, order, hashes,
+                    lambda i: (
+                        [p[:, :, i].copy() for p in k_pc],
+                        [p[:, :, i].copy() for p in v_pc],
+                    ),
+                )
+                self.pool.stored_total += len(pending)
             return
         kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
-        kg, vg = np.asarray(jax.device_get(kg)), np.asarray(jax.device_get(vg))
-        for i, (seq_hash, _idx) in enumerate(pending):
-            # copy: a view would pin the whole padded gather batch in RAM
-            # for as long as any one block stays resident
-            self.pool.put(seq_hash, kg[:, :, i].copy(), vg[:, :, i].copy())
-        self.pool.stored_total += len(pending)
+        self._land_flush(pending, kg, vg)
+
+    def _land_flush(self, pending, kg, vg) -> None:
+        """Blocking half of a flush: d2h fetch + host-pool insertion.
+        Runs inline on the sync path, on the offload executor otherwise."""
+        kg, vg = _device_fetch(kg), _device_fetch(vg)
+        with self._lock:
+            for i, (seq_hash, _idx) in enumerate(pending):
+                # copy: a view would pin the whole padded gather batch in
+                # RAM for as long as any one block stays resident
+                self.pool.put(seq_hash, kg[:, :, i].copy(), vg[:, :, i].copy())
+            self.pool.stored_total += len(pending)
+
+    def flush_evictions_async(
+        self, k_cache, v_cache,
+        budget: Optional[int] = None,
+        must_idxs: Optional[set] = None,
+    ) -> None:
+        """Dispatch d2h for pending evictions WITHOUT blocking on the
+        copy (device thread). The bucketed gather is dispatched here so
+        it stays stream-ordered before the caller's page-overwriting
+        compute; the fetch + pool insertion land on the offload executor.
+
+        ``budget`` caps how many optional blocks one call gathers and the
+        double buffer caps concurrent in-flight flushes — but evictions
+        whose page index is in ``must_idxs`` (pages the caller's imminent
+        dispatch writes) are ALWAYS taken: deferring those would snapshot
+        a page after its new owner overwrote it. Callers that overwrite
+        arbitrary pages (prefill preamble, remote-KV landing) pass
+        ``budget=None`` = flush everything now.
+        """
+        if not self.async_tier:
+            return self.flush_evictions(k_cache, v_cache)
+        with self._lock:
+            self._reap_flushes_locked()
+            if not self._pending:
+                return
+            if budget is None:
+                pending, self._pending = self._pending, []
+            else:
+                room = max(0, budget)
+                if len(self._inflight_flushes) >= _MAX_INFLIGHT_FLUSHES:
+                    room = 0  # double buffer full: must-flush only
+                pending, deferred = [], []
+                for h, idx in self._pending:
+                    if must_idxs is not None and idx in must_idxs:
+                        pending.append((h, idx))
+                    elif room > 0:
+                        pending.append((h, idx))
+                        room -= 1
+                    else:
+                        deferred.append((h, idx))
+                self._pending = deferred
+            if not pending:
+                return
+        idxs = _pad_idxs([idx for _h, idx in pending])
+        kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
+        fut = self._executor().submit(self._land_flush, pending, kg, vg)
+        with self._lock:
+            self._inflight_flushes.append(
+                _FlushTask([h for h, _idx in pending], fut)
+            )
+            self.d2h_flush_async_total += 1
+
+    # -- async h2d restore stage --
+    def begin_upload(
+        self, hashes: list[int], data: list, block_idxs: list[int]
+    ) -> RestoreUpload:
+        """Start the h2d half of a restore the moment the chain is
+        reserved: stack the host blocks and upload them on the offload
+        executor. The returned handle goes to :meth:`finish_upload` (or
+        :meth:`cancel_upload` on rollback). Synchronous paths (mirror,
+        async tier off, empty chain) return a handle with no future —
+        finish_upload falls back to the one-shot :meth:`restore`."""
+        up = RestoreUpload(hashes, data, block_idxs)
+        if not hashes or not self.async_tier:
+            return up
+        up.future = self._executor().submit(self._upload_worker, up)
+        with self._lock:
+            self.h2d_uploads_started += 1
+        return up
+
+    def _upload_worker(self, up: RestoreUpload):
+        k_host = np.stack([k for k, _v in up.data], axis=2)
+        v_host = np.stack([v for _k, v in up.data], axis=2)
+        k_dev, v_dev = _device_put(k_host), _device_put(v_host)
+        jax.block_until_ready((k_dev, v_dev))
+        up.t_landed = time.monotonic()
+        return k_dev, v_dev
+
+    def cancel_upload(self, up: Optional[RestoreUpload]) -> None:
+        """Admission failed / request cancelled with the upload still in
+        flight. The upload only READS the host arrays, so the caller's
+        :meth:`unreserve` re-pool is safe concurrently; this just records
+        the abandonment (the device arrays are dropped on landing)."""
+        if up is None or up.future is None or up.cancelled:
+            return
+        up.cancelled = True
+        with self._lock:
+            self.h2d_uploads_cancelled += 1
+
+    def finish_upload(self, k_cache, v_cache, up: RestoreUpload,
+                      account: bool = True):
+        """Land a begun upload: wait for the device copies (only if they
+        haven't arrived — the wait actually paid is the EXPOSED restore
+        latency; the rest was hidden behind scheduling/compute) and
+        scatter them into the reserved pages. ``account=False`` skips the
+        hidden/exposed bookkeeping (prefetch landings never block
+        admission; their whole latency counts as hidden at claim time)."""
+        if not up.hashes:
+            return k_cache, v_cache
+        if up.future is None:
+            return self.restore(
+                k_cache, v_cache, up.data, up.idxs, hashes=up.hashes
+            )
+        t0 = time.monotonic()
+        k_dev, v_dev = up.future.result()
+        if account:
+            waited = time.monotonic() - t0
+            total = max(up.t_landed - up.t_start, 1e-9)
+            exposed = min(waited, total)
+            with self._lock:
+                self.restore_exposed_s += exposed
+                self.restore_hidden_s += max(total - exposed, 0.0)
+                # request-driven restores only: speculative prefetch
+                # landings (account=False) count as hits at CLAIM time
+                # (h2d_prefetch_hits), not at landing — a hint for a
+                # request that never arrives is not a hit
+                self.pool.hit_blocks_total += len(up.data)
+        return _scatter_blocks(
+            k_cache, v_cache, jnp.asarray(_pad_idxs(up.idxs)), k_dev, v_dev
+        )
+
+    # -- prefetch accounting (router-hinted restores, engine-side) --
+    def note_prefetch_landed(self, up: RestoreUpload) -> None:
+        """A hinted restore landed off the admission path: its entire
+        transfer latency was hidden from every future request."""
+        with self._lock:
+            self.h2d_prefetch_blocks_total += len(up.hashes)
+            if up.t_landed is not None:
+                self.restore_hidden_s += max(up.t_landed - up.t_start, 0.0)
+
+    def note_prefetch_hits(self, n: int) -> None:
+        with self._lock:
+            self.h2d_prefetch_hits += n
 
     def restore(self, k_cache, v_cache, data, block_idxs: list[int],
                 hashes: Optional[list[int]] = None):
@@ -287,7 +640,8 @@ class OffloadManager:
         assert len(data) == len(block_idxs)
         if not data:
             return k_cache, v_cache
-        self.pool.hit_blocks_total += len(data)
+        with self._lock:
+            self.pool.hit_blocks_total += len(data)
         if self.mirror is not None:
             assert hashes is not None and len(hashes) == len(data)
             k_pieces = stack_pieces(data, 0)
@@ -297,8 +651,9 @@ class OffloadManager:
                 return (cache.shape[0], cache.shape[1], len(data),
                         cache.shape[3], cache.shape[4])
 
-            drops = self._deferred_drops
-            self._deferred_drops = []
+            with self._lock:
+                drops = self._deferred_drops
+                self._deferred_drops = []
             return self.mirror.lead_offload_restore(
                 k_cache, v_cache, _pad_idxs(block_idxs), hashes,
                 k_pieces, v_pieces, gs(k_cache), gs(v_cache),
@@ -316,9 +671,34 @@ class OffloadManager:
             jnp.asarray(v_host),
         )
 
+    def close(self) -> None:
+        """Release the offload executor (in-flight landings still run to
+        completion; nothing new is accepted)."""
+        self._closed = True
+        if self._exec is not None:
+            self._exec.shutdown(wait=False)
+            self._exec = None
+
     def stats(self) -> dict:
-        return {
-            "offload_blocks_resident": len(self.pool),
-            "offload_blocks_stored_total": self.pool.stored_total,
-            "offload_hit_blocks_total": self.pool.hit_blocks_total,
-        }
+        with self._lock:
+            hid, exp = self.restore_hidden_s, self.restore_exposed_s
+            denom = hid + exp
+            return {
+                "offload_blocks_resident": len(self.pool),
+                "offload_blocks_stored_total": self.pool.stored_total,
+                "offload_hit_blocks_total": self.pool.hit_blocks_total,
+                # async-tier surface (ISSUE 1): background d2h flushes
+                # dispatched, hinted blocks restored + later claimed, and
+                # the fraction of total restore (h2d) latency hidden
+                # behind scheduling/compute instead of exposed on TTFT
+                "d2h_flush_async": self.d2h_flush_async_total,
+                "d2h_flush_failures": self.d2h_flush_failures,
+                "d2h_flush_pending": len(self._pending),
+                "h2d_prefetch_blocks_total": self.h2d_prefetch_blocks_total,
+                "h2d_prefetch_hits": self.h2d_prefetch_hits,
+                "h2d_uploads_started": self.h2d_uploads_started,
+                "h2d_uploads_cancelled": self.h2d_uploads_cancelled,
+                "restore_latency_hidden_frac": (
+                    round(hid / denom, 6) if denom > 0 else 0.0
+                ),
+            }

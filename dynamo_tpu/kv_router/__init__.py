@@ -8,8 +8,8 @@ routes to the best worker. This is the capability behind the reference's
 """
 
 from .indexer import KvIndexer, OverlapScores, PrefixIndex
-from .protocols import KvCacheEvent, RouterEvent
-from .publisher import KvEventPublisher, KvMetricsAggregator
+from .protocols import KvCacheEvent, KvPrefetchHint, RouterEvent
+from .publisher import KvEventPublisher, KvMetricsAggregator, KvPrefetchListener
 from .router import KvRouter
 from .scheduler import KvScheduler, ProcessedEndpoints, WorkerLoad
 
@@ -18,6 +18,8 @@ __all__ = [
     "KvEventPublisher",
     "KvIndexer",
     "KvMetricsAggregator",
+    "KvPrefetchHint",
+    "KvPrefetchListener",
     "KvRouter",
     "KvScheduler",
     "OverlapScores",

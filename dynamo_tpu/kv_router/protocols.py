@@ -15,6 +15,11 @@ from typing import Optional
 
 KV_EVENT_SUBJECT = "kv_events"
 KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+KV_PREFETCH_SUBJECT = "kv-prefetch"
+
+#: hard cap on blocks per prefetch hint: bounds message size and the
+#: host->device burst one hint can trigger on the worker
+KV_PREFETCH_MAX_BLOCKS = 512
 
 
 @dataclass
@@ -70,6 +75,33 @@ class RouterEvent:
                 blocks=[StoredBlock(b[0], b[1]) for b in d.get("blocks", [])],
                 block_hashes=d.get("block_hashes", []),
             ),
+        )
+
+
+@dataclass
+class KvPrefetchHint:
+    """Router -> chosen worker, published the moment a request is routed
+    to a worker whose device radix match does NOT cover the prompt: the
+    prompt's full block-hash chain as (tokens_hash, block_hash) pairs in
+    prompt order. The worker probes its own tiers against the chain and
+    starts uploading the host-resident continuation BEFORE the request
+    itself arrives (PRESERVE, arxiv 2501.08192), so admission claims the
+    blocks as ordinary device prefix hits."""
+
+    worker_id: int
+    blocks: list  # [[tokens_hash, block_hash], ...] prompt order
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            {"worker_id": self.worker_id, "blocks": self.blocks}
+        ).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "KvPrefetchHint":
+        d = json.loads(raw)
+        return KvPrefetchHint(
+            worker_id=d["worker_id"],
+            blocks=[[int(a), int(b)] for a, b in d.get("blocks", [])],
         )
 
 

@@ -5,6 +5,10 @@ Re-design of lib/llm/src/kv_router/{publisher,metrics_aggregator,scoring}.rs:
   * :class:`KvEventPublisher` — hooks the engine's BlockAllocator
     stored/removed callbacks and publishes RouterEvents on the component's
     ``kv_events`` subject,
+  * :class:`KvPrefetchListener` — the other direction: consumes the
+    router's ``kv-prefetch`` hints addressed to this worker and hands
+    the block-hash chain to the engine's host-tier prefetch
+    (engine.prefetch_hint), so restores start before requests arrive,
   * :class:`KvMetricsAggregator` — periodically scrapes every worker
     instance's stats endpoint (the engine's ``load_metrics``) into
     :class:`ProcessedEndpoints` for the scheduler.
@@ -17,7 +21,14 @@ import itertools
 import logging
 from typing import Optional
 
-from .protocols import KV_EVENT_SUBJECT, KvCacheEvent, RouterEvent, StoredBlock
+from .protocols import (
+    KV_EVENT_SUBJECT,
+    KV_PREFETCH_SUBJECT,
+    KvCacheEvent,
+    KvPrefetchHint,
+    RouterEvent,
+    StoredBlock,
+)
 from .scheduler import ProcessedEndpoints, WorkerLoad
 
 logger = logging.getLogger(__name__)
@@ -51,6 +62,53 @@ class KvEventPublisher:
     def attach(self, allocator) -> None:
         allocator.on_stored = self.on_stored
         allocator.on_removed = self.on_removed
+
+
+class KvPrefetchListener:
+    """Worker-side prefetch-hint consumer: subscribes the component's
+    ``kv-prefetch`` subject, filters hints addressed to this worker, and
+    drives the engine's router-hinted host-tier prefetch. Hints are
+    advisory — any failure is logged and dropped (the request still
+    serves correctly, it just pays the cold restore)."""
+
+    def __init__(self, drt, component, worker_id: int, engine):
+        self.drt = drt
+        self.subject = component.event_subject(KV_PREFETCH_SUBJECT)
+        self.worker_id = worker_id
+        self.engine = engine
+        self.hints_received = 0
+        self.blocks_prefetched = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+
+    async def start(self) -> "KvPrefetchListener":
+        sub = self.drt.bus.subscribe(self.subject)
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._sub = sub
+        self._task = self.drt.runtime.spawn(self._consume(sub))
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                hint = KvPrefetchHint.from_bytes(msg.payload)
+                if hint.worker_id != self.worker_id:
+                    continue
+                self.hints_received += 1
+                n = await self.engine.prefetch_hint(
+                    [(l, s) for l, s in hint.blocks]
+                )
+                self.blocks_prefetched += n
+            except Exception:  # noqa: BLE001 — hints are advisory
+                logger.debug("prefetch hint failed", exc_info=True)
 
 
 class KvMetricsAggregator:
@@ -89,6 +147,12 @@ class KvMetricsAggregator:
                     active_requests=d.get("request_active_slots", 0),
                     total_slots=max(d.get("request_total_slots", 1), 1),
                     waiting=d.get("num_requests_waiting", 0),
+                    offload_blocks_resident=d.get(
+                        "offload_blocks_resident", 0),
+                    offload_d2h_flush_async=d.get("d2h_flush_async", 0),
+                    offload_prefetch_hits=d.get("h2d_prefetch_hits", 0),
+                    offload_restore_hidden_frac=d.get(
+                        "restore_latency_hidden_frac", 0.0),
                 )
             )
         self.endpoints = ProcessedEndpoints(loads)

@@ -73,7 +73,8 @@ class KvRouter:
 
     async def schedule(self, token_ids: list[int]) -> tuple[int, int]:
         """-> (worker_id, overlap_blocks). Raises AllWorkersBusy."""
-        hashes = [s for _l, s in sequence_block_hashes(token_ids, self.block_size)]
+        pairs = sequence_block_hashes(token_ids, self.block_size)
+        hashes = [s for _l, s in pairs]
         overlaps = self.indexer.find_matches(hashes)
         # never scrape inline: the aggregator loop refreshes every interval;
         # an empty load set (cold start / all workers gone) raises
@@ -81,7 +82,24 @@ class KvRouter:
         worker_id = self.scheduler.select_worker(
             self.metrics.endpoints, overlaps, len(hashes)
         )
-        return worker_id, overlaps.scores.get(worker_id, 0)
+        overlap = overlaps.scores.get(worker_id, 0)
+        # admission hashes prompt[:-1] (the final token always recomputes
+        # for fresh logits), so a prompt of exactly N full blocks can only
+        # ever claim N-1 — don't hint a block the worker can't claim
+        n_hint = (
+            len(pairs) - 1 if token_ids and len(token_ids)
+            % self.block_size == 0 else len(pairs)
+        )
+        # compare against the CLAIMABLE chain: a worker already holding
+        # all n_hint claimable blocks must not be re-hinted every turn
+        if overlap < n_hint:
+            # the chosen worker's device radix match doesn't cover the
+            # prompt: ship the chain so its host tier can start the h2d
+            # upload before the request lands (PRESERVE-style prefetch).
+            # The worker re-derives its own device match from the chain —
+            # the index view here may be stale either way.
+            self.scheduler.emit_prefetch(worker_id, pairs[:n_hint])
+        return worker_id, overlap
 
     def request_finished(self, worker_id: int) -> None:
         self.scheduler.request_finished(worker_id)

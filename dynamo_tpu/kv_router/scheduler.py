@@ -27,7 +27,13 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .indexer import OverlapScores
-from .protocols import KV_HIT_RATE_SUBJECT, KVHitRateEvent
+from .protocols import (
+    KV_HIT_RATE_SUBJECT,
+    KV_PREFETCH_MAX_BLOCKS,
+    KV_PREFETCH_SUBJECT,
+    KVHitRateEvent,
+    KvPrefetchHint,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -44,6 +50,12 @@ class WorkerLoad:
     active_requests: int = 0
     total_slots: int = 1
     waiting: int = 0
+    # async offload-tier surface (engine OffloadManager.stats): scraped
+    # for the fleet metrics endpoint, not used by the cost model
+    offload_blocks_resident: int = 0
+    offload_d2h_flush_async: int = 0
+    offload_prefetch_hits: int = 0
+    offload_restore_hidden_frac: float = 0.0
 
     @property
     def kv_usage(self) -> float:
@@ -97,6 +109,10 @@ class KvScheduler:
         self._hit_subject = (
             component.event_subject(KV_HIT_RATE_SUBJECT) if component else None
         )
+        self._prefetch_subject = (
+            component.event_subject(KV_PREFETCH_SUBJECT) if component else None
+        )
+        self.prefetch_hints_sent = 0
         # optimistic in-flight bumps: worker -> extra requests assumed
         self._pending: dict[int, int] = {}
 
@@ -144,6 +160,24 @@ class KvScheduler:
             self._pending.pop(worker_id, None)
         else:
             self._pending[worker_id] = n - 1
+
+    def emit_prefetch(self, worker_id: int, blocks: list) -> None:
+        """Ship the routed request's block-hash chain to the chosen
+        worker as a prefetch hint ((tokens_hash, block_hash) pairs in
+        prompt order) — fired when the worker's known device overlap
+        doesn't cover the prompt, so the worker can start its host-tier
+        h2d upload before the request arrives (engine.prefetch_hint).
+        Best-effort: a lost hint only costs the overlap."""
+        if self.drt is None or self._prefetch_subject is None or not blocks:
+            return
+        hint = KvPrefetchHint(
+            worker_id, [[l, s] for l, s in blocks[:KV_PREFETCH_MAX_BLOCKS]]
+        )
+        try:
+            self.drt.bus.publish(self._prefetch_subject, hint.to_bytes())
+            self.prefetch_hints_sent += 1
+        except Exception:  # noqa: BLE001
+            logger.debug("prefetch-hint publish failed", exc_info=True)
 
     def _emit_hit_rate(self, worker_id: int, isl_blocks: int, overlap: int) -> None:
         if self.drt is None or self._hit_subject is None:

@@ -391,9 +391,19 @@ async def run_endpoint(args) -> None:
         )
     component = drt.namespace(ns).component(comp)
     if jax_core is not None:
-        from ..kv_router import KvEventPublisher
+        from ..kv_router import KvEventPublisher, KvPrefetchListener
 
         KvEventPublisher(drt, component, drt.primary_lease_id).attach(jax_core.allocator)
+        if jax_core.offload is not None:
+            # router-hinted host-tier prefetch: the KV router ships the
+            # routed prompt's block-hash chain here the moment it picks
+            # this worker; the engine starts the h2d restore before the
+            # request itself arrives (engine.prefetch_hint). The handle
+            # is kept so the subscription/task stay referenced for the
+            # worker's lifetime (and closeable by embedders).
+            prefetch_listener = await KvPrefetchListener(  # noqa: F841
+                drt, component, drt.primary_lease_id, jax_core
+            ).start()
     await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,

@@ -44,8 +44,16 @@ Wire-format field numbers (sentencepiece_model.proto):
 
 from __future__ import annotations
 
+import logging
 import struct
 from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+#: one-time flag for the accepted-charsmap caveat below — serving loads
+#: tokenizers repeatedly (model cards, warmup, workers) and the caveat
+#: is per-process, not per-load
+_warned_charsmap = False
 
 WS = "▁"  # ▁ — sentencepiece's escaped space
 
@@ -135,6 +143,23 @@ class SentencePieceModel:
                 "covers the standard normalizers only: "
                 f"{KNOWN_NORMALIZERS})"
             )
+        if self.has_charsmap:
+            # accepted: a standard-named non-empty charsmap is served by
+            # this module's NATIVE ruleset (unicodedata NFKC + NMT
+            # cleanup), not by walking the compiled charsmap itself —
+            # the approximation can diverge from sentencepiece's
+            # compiled Darts table on exotic codepoints. Say so once.
+            global _warned_charsmap
+            if not _warned_charsmap:
+                _warned_charsmap = True
+                logger.warning(
+                    "sentencepiece model carries a non-empty "
+                    "precompiled_charsmap (normalizer %r); serving with "
+                    "the native %s approximation — normalization may "
+                    "diverge from sentencepiece's compiled ruleset on "
+                    "edge-case codepoints",
+                    self.normalizer_name, self.normalizer_name,
+                )
         for i, p in enumerate(self.pieces):
             if p.type == BYTE:
                 # byte pieces are spelled "<0xNN>"

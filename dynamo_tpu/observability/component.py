@@ -98,6 +98,16 @@ class MetricsComponent:
             gauge("requests_active", w.active_requests, lb)
             gauge("requests_total_slots", w.total_slots, lb)
             gauge("requests_waiting", w.waiting, lb)
+            # async offload tier (engine OffloadManager.stats): host-tier
+            # residency, background d2h flushes, hinted prefetch claims,
+            # and the fraction of restore latency hidden from TTFT
+            gauge("offload_blocks_resident", w.offload_blocks_resident, lb)
+            gauge("offload_d2h_flush_async", w.offload_d2h_flush_async, lb)
+            gauge("offload_prefetch_hits", w.offload_prefetch_hits, lb)
+            gauge(
+                "offload_restore_hidden_frac",
+                round(w.offload_restore_hidden_frac, 6), lb,
+            )
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))

@@ -1,0 +1,23 @@
+"""Version shims for the Pallas TPU surface.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``
+across releases; every kernel in this package imports the alias from
+here so the whole family traces on either toolchain (0.4.x ships only
+the old spelling, newer trees only the new one).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+def _missing(*_a, **_k):  # pragma: no cover - depends on jax build
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams — this jax build is incompatible with the "
+        "repo's Pallas kernels"
+    )
+
+
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", _missing)
+)

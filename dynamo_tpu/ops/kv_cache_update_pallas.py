@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import CompilerParams as _CompilerParams
+
 
 def _append_kernel(
     # scalar prefetch
@@ -255,7 +257,7 @@ def kv_cache_append_tokens(
                 jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
             ],
             input_output_aliases={4: 0, 5: 1},
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("arbitrary", "arbitrary"),
             ),
         )(page, off0, k_new, v_new, k_cache, v_cache)
@@ -344,7 +346,7 @@ def _append_call(k_new, v_new, k_cache, v_cache, blk, off, interpret=False):
         # +2 for the scalar-prefetch args: pallas numbers aliases over the
         # FULL operand list including prefetch scalars
         input_output_aliases={4: 0, 5: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
     )(blk, off, k_new, v_new, k_cache, v_cache)

@@ -59,6 +59,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import CompilerParams as _CompilerParams
+
 
 def ragged_int8_xla(lhs, w_q, w_s, group_sizes):
     """Reference/fallback: dequantize the full stack, then ragged_dot.
@@ -104,7 +106,11 @@ def _step_metadata(group_sizes, r_pad: int, tm: int, n_experts: int):
 def _kernel(expert_ref, rowtile_ref, gstart_ref, gend_ref,  # prefetched
             lhs_ref, wq_ref, ws_ref, out_ref, acc_ref, *, tm: int):
     s = pl.program_id(1)
-    first = (s == 0) | (rowtile_ref[s] != rowtile_ref[s - 1])
+    # clamp: `|` does not short-circuit, so rowtile_ref[s - 1] would be
+    # an out-of-bounds SMEM read at s == 0 (the s == 0 term already
+    # forces `first` there, so the clamped value never matters)
+    prev_rt = rowtile_ref[jnp.maximum(s - 1, 0)]
+    first = (s == 0) | (rowtile_ref[s] != prev_rt)
     last = rowtile_ref[s + 1] != rowtile_ref[s]
 
     @pl.when(first)
@@ -134,7 +140,9 @@ def ragged_int8_gmm(lhs, w_q, w_s, group_sizes, *, tm: int = 0,
     [R, N] f32 with rows beyond sum(group_sizes) zeroed."""
     r, k = lhs.shape
     x_experts, _, n = w_q.shape
-    tm = tm or min(128, max(8, r))
+    # default row tile: multiple of 8 (Mosaic's sublane floor) so
+    # arbitrary row counts compile on real hardware, not just interpret
+    tm = tm or min(128, -(-max(8, r) // 8) * 8)
     tn = tn or (128 if n % 128 == 0 else n)
     if n % tn:
         raise ValueError(f"N={n} not divisible by tn={tn}")
@@ -163,7 +171,7 @@ def ragged_int8_gmm(lhs, w_q, w_s, group_sizes, *, tm: int = 0,
         functools.partial(_kernel, tm=tm),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((r_pad, n), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(expert, rowtile_ext, gstart, gend, lhs, w_q, w_s[:, None, :])

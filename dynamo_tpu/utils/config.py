@@ -16,8 +16,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 import typing
+
+try:  # stdlib on 3.11+
+    import tomllib
+except ImportError:  # pragma: no cover - interpreter-version dependent
+    try:  # tomli is the pre-3.11 backport with the identical API
+        import tomli as tomllib
+    except ImportError:
+        tomllib = None
 from typing import Any, Optional, Type, TypeVar
 
 CONFIG_PATH_ENV = "DYN_CONFIG_PATH"
@@ -52,6 +59,17 @@ def _coerce(value: Any, ty: Any) -> Any:
 def _toml_section(section: str, path: Optional[str]) -> dict:
     path = path or os.environ.get(CONFIG_PATH_ENV)
     if not path or not os.path.exists(path):
+        return {}
+    if tomllib is None:
+        # an EXPLICITLY configured file being skipped must not be silent
+        import warnings
+
+        warnings.warn(
+            f"config file {path!r} ignored: this Python has no tomllib "
+            "(3.11+); only defaults and environment overrides apply",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return {}
     with open(path, "rb") as f:
         doc = tomllib.load(f)

@@ -198,3 +198,85 @@ def test_kv_routed_serving(run):
         await front.shutdown()
 
     run(main())
+
+
+# ---------------- prefetch hints ----------------
+
+
+def test_schedule_emits_prefetch_hint_for_uncovered_prompt(run):
+    """Routing a request whose prompt extends past the chosen worker's
+    device radix match must ship the block-hash chain on the component's
+    kv-prefetch subject; a fully-covered prompt must not."""
+    from dynamo_tpu.kv_router.protocols import (
+        KV_PREFETCH_SUBJECT,
+        KvPrefetchHint,
+    )
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        router = await KvRouter(drt, comp, block_size=4).start()
+        router.metrics.endpoints = make_eps((0.1, 1, 0))  # worker 1
+
+        sub = bus.subscribe(comp.event_subject(KV_PREFETCH_SUBJECT))
+        prompt = list(range(300, 324))  # 6 blocks, index cold
+        wid, overlap = await router.schedule(prompt)
+        assert wid == 1 and overlap == 0
+        msg = await sub.next(1.0)
+        assert msg is not None
+        hint = KvPrefetchHint.from_bytes(msg.payload)
+        assert hint.worker_id == 1
+        pairs = sequence_block_hashes(prompt, 4)
+        # block-multiple prompt: the final block can never be claimed by
+        # admission (it hashes prompt[:-1]), so the hint excludes it
+        assert hint.blocks == [[l, s] for l, s in pairs[:-1]]
+        router.request_finished(wid)
+
+        # full coverage: worker 1 now holds the whole chain -> no hint
+        router.indexer.index.apply_event(_stored_event(1, prompt))
+        wid, overlap = await router.schedule(prompt)
+        assert wid == 1 and overlap == len(pairs)
+        assert await sub.next(0.2) is None
+        await drt.shutdown()
+
+    run(main())
+
+
+def test_prefetch_listener_filters_and_forwards(run):
+    """The worker-side listener consumes only hints addressed to it and
+    hands the chain to engine.prefetch_hint."""
+    from dynamo_tpu.kv_router import KvPrefetchListener
+    from dynamo_tpu.kv_router.protocols import (
+        KV_PREFETCH_SUBJECT,
+        KvPrefetchHint,
+    )
+
+    class FakeEngine:
+        def __init__(self):
+            self.calls = []
+
+        async def prefetch_hint(self, blocks):
+            self.calls.append(blocks)
+            return len(blocks)
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        eng = FakeEngine()
+        listener = await KvPrefetchListener(drt, comp, 42, eng).start()
+        subject = comp.event_subject(KV_PREFETCH_SUBJECT)
+        bus.publish(subject, KvPrefetchHint(99, [[1, 2]]).to_bytes())
+        bus.publish(subject, KvPrefetchHint(42, [[3, 4], [5, 6]]).to_bytes())
+        for _ in range(100):
+            if eng.calls:
+                break
+            await asyncio.sleep(0.01)
+        assert eng.calls == [[(3, 4), (5, 6)]]
+        assert listener.hints_received == 1
+        assert listener.blocks_prefetched == 2
+        await listener.close()
+        await drt.shutdown()
+
+    run(main())
