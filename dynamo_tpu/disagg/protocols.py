@@ -29,13 +29,23 @@ class RemotePrefillRequest:
     connection: dict
     # decode engine identity (diagnostics / metrics)
     engine_id: int = 0
+    # W3C traceparent continuing the request's trace on the prefill
+    # worker (None when tracing is off)
+    trace: Optional[str] = None
+    # decode-side wall clock at enqueue — the prefill worker derives the
+    # queue-wait span from it (cross-host wall skew applies; the queue
+    # wait is seconds-scale where it matters, so skew stays in the noise)
+    enqueue_ts: float = 0.0
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
-        return cls(**json.loads(raw))
+        d = json.loads(raw)
+        # ignore unknown keys: version-skew safety for fields newer peers
+        # may add (this is how `trace` itself shipped)
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
 
 
 @dataclass

@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import AsyncIterator, Optional, Union
 
+from .. import tracing
 from ..engine.engine import JaxEngine, OutOfBlocks
 from ..protocols.common import LLMEngineOutput, PreprocessedRequest
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
@@ -107,28 +109,53 @@ class PrefillWorker:
     async def _process(self, rpr: RemotePrefillRequest) -> None:
         req = PreprocessedRequest.from_dict(rpr.request)
         ctx = AsyncEngineContext(rpr.request_id)
-        # in-process pipe => same device slice: keep KV on device end to
-        # end (gather -> pipe -> decode scatter, no host hop); the TCP
-        # path needs host bytes anyway
-        local = bool(rpr.connection.get("local")) and self.local_pipe is not None
-        first, first_lp, k, v = await self.engine.prefill_extract(
-            req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local
-        )
-        self.stats["prefills_total"] += 1
-        layout = self.head_layout
-        tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
-        if rpr.connection.get("local"):
-            assert self.local_pipe is not None, "local connection without pipe"
-            await self.local_pipe.deliver(
-                rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
-                first_lp=first_lp,
-            )
-        else:
-            await send_kv_blocks(
-                rpr.connection, rpr.request_id, first, k, v,
-                layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
-                first_lp=first_lp,
-            )
+        trace_token = None
+        if tracing.enabled() and rpr.trace:
+            # continue the decode side's trace across the queue handoff
+            tc = tracing.TraceContext.for_request(rpr.request_id, rpr.trace)
+            trace_token = tracing.set_trace(tc)
+            if rpr.enqueue_ts:
+                # queue wait reconstructed from the decode side's enqueue
+                # stamp (cross-host wall clocks; see protocols.py)
+                waited_s = max(time.time() - rpr.enqueue_ts, 0.0)
+                tracing.RECORDER.record_span(
+                    "prefill.queue_wait", tc, ts=rpr.enqueue_ts,
+                    dur_ms=waited_s * 1e3, request_id=rpr.request_id,
+                )
+        try:
+            # in-process pipe => same device slice: keep KV on device end to
+            # end (gather -> pipe -> decode scatter, no host hop); the TCP
+            # path needs host bytes anyway
+            local = bool(rpr.connection.get("local")) and self.local_pipe is not None
+            with tracing.span(
+                "prefill.compute", request_id=rpr.request_id,
+                prompt_tokens=len(req.token_ids), skip_blocks=rpr.skip_blocks,
+            ):
+                first, first_lp, k, v = await self.engine.prefill_extract(
+                    req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local
+                )
+            self.stats["prefills_total"] += 1
+            layout = self.head_layout
+            tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
+            with tracing.span(
+                "prefill.kv_send", request_id=rpr.request_id,
+                local=bool(rpr.connection.get("local")),
+            ):
+                if rpr.connection.get("local"):
+                    assert self.local_pipe is not None, "local connection without pipe"
+                    await self.local_pipe.deliver(
+                        rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
+                        first_lp=first_lp,
+                    )
+                else:
+                    await send_kv_blocks(
+                        rpr.connection, rpr.request_id, first, k, v,
+                        layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
+                        first_lp=first_lp,
+                    )
+        finally:
+            if trace_token is not None:
+                tracing.reset_trace(trace_token)
 
     async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
         try:
@@ -210,22 +237,37 @@ class DisaggEngine(AsyncEngine):
             skip_blocks=handle.skip_blocks,
             connection=self._connection(),
             engine_id=self.engine_id,
+            trace=tracing.current_traceparent(),
+            enqueue_ts=time.time() if tracing.enabled() else 0.0,
+        )
+        # decode-side wait for the whole remote leg (queue + prefill +
+        # KV transfer); the decomposition subtracts the worker-side spans
+        # to isolate the transfer cost
+        remote_span = tracing.span(
+            "disagg.remote_prefill", request_id=req_id,
+            prompt_tokens=prompt_len, skip_blocks=handle.skip_blocks,
         )
         try:
             await self.queue.enqueue(rpr)
             delivery = await asyncio.wait_for(fut, self.transfer_timeout)
         except asyncio.CancelledError:
             # caller went away: clean up the reservation, propagate
+            remote_span.set(error="cancelled")
             self.transfer.abandon(req_id)
             self.engine.abort_remote(handle, "cancelled")
             raise
         except Exception as e:  # noqa: BLE001 — timeout, enqueue or
             # transfer-stream failure: blocks must return to the pool
+            remote_span.set(error=type(e).__name__)
             self.transfer.abandon(req_id)
             self.stats["remote_errors"] += 1
             self.engine.abort_remote(handle, f"remote prefill failed: {e}")
             yield await handle.seq.out_queue.get()
             return
+        finally:
+            # the remote leg ends when the delivery future resolves (or
+            # fails) — everything after is local scatter/decode work
+            remote_span.end()
         if delivery.error:
             self.stats["remote_errors"] += 1
             self.engine.abort_remote(handle, delivery.error)

@@ -44,6 +44,7 @@ from ..protocols.common import (
     StopConditions,
 )
 from ..runtime.engine import AsyncEngine, Context
+from .. import tracing
 from .allocator import Block, BlockAllocator, sequence_block_hashes
 from .offload import OffloadManager
 
@@ -211,6 +212,10 @@ class _Sequence:
     slot: int = -1  # decode batch slot
     finished: bool = False
     arrival_t: float = field(default_factory=time.monotonic)
+    # request trace (tracing.TraceContext), captured at generate() entry
+    # while the caller's contextvar is still in scope; None = untraced,
+    # and every hot-path instrumentation site gates on that None first
+    trace: Optional[object] = None
 
     @property
     def seq_len(self) -> int:
@@ -494,6 +499,7 @@ class JaxEngine(AsyncEngine):
             out_queue=asyncio.Queue(),
             tokens=list(req.token_ids),
             prompt_len=len(req.token_ids),
+            trace=tracing.current_trace() if tracing.enabled() else None,
         )
         self.stats["requests_total"] += 1
         await self._waiting.put(seq)
@@ -766,6 +772,19 @@ class JaxEngine(AsyncEngine):
             return False
         history, upload = reserved
         self.stats["prefix_cache_hits_tokens"] += history
+        if seq.trace is not None and seq.generated == 0:
+            # admission latency: arrival -> blocks reserved, reconstructed
+            # backwards so the span's start anchors at arrival time. A
+            # preemption REPLAY (generated > 0) is post-first-token work:
+            # re-recording would overlap the original span and break the
+            # decomposition's sum-to-TTFT contract
+            waited_s = time.monotonic() - seq.arrival_t
+            tracing.RECORDER.record_span(
+                "engine.queue_wait", seq.trace,
+                ts=time.time() - waited_s, dur_ms=waited_s * 1e3,
+                request_id=seq.context.id,
+                waiting=self._waiting_size(),
+            )
         self._prefill_state = _PrefillState(seq=seq, pos=history, upload=upload)
         return True
 
@@ -810,6 +829,15 @@ class JaxEngine(AsyncEngine):
         if first_token is None:
             return False  # more chunks to go
         first_token, first_lp = first_token
+        if seq.trace is not None and seq.generated == 0:
+            # first prefill only — a preemption replay's prefill is
+            # post-first-token and must not re-enter the decomposition
+            tracing.RECORDER.record_span(
+                "engine.prefill", seq.trace, ts=st.t0_wall,
+                dur_ms=st.dev_ms,
+                request_id=seq.context.id,
+                prompt_tokens=seq.prompt_len, cached_prefix=seq.cached_prefix,
+            )
         self._prefill_state = None
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token, first_lp)
@@ -833,27 +861,55 @@ class JaxEngine(AsyncEngine):
     def _prefill_chunk_device(self, st: _PrefillState) -> Optional[int]:
         """Runs in an executor thread: one bucketed prefill chunk. Returns
         the sampled first token on the final chunk, else None."""
-        self._offload_preamble(st.upload if not st.restored else None)
-        st.restored = True
-        logits, st.pos = self._run_one_chunk(st.seq, st.pos)
-        if st.pos < len(st.seq.tokens):
-            return None
-        return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
+        t0 = time.perf_counter()
+        try:
+            self._offload_preamble(
+                st.upload if not st.restored else None, seq=st.seq
+            )
+            st.restored = True
+            logits, st.pos = self._run_one_chunk(st.seq, st.pos)
+            if st.pos < len(st.seq.tokens):
+                return None
+            return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
+        finally:
+            # accumulate DEVICE time only: chunks of a long prompt
+            # interleave with other requests' decode steps, so the
+            # traced prefill component must not absorb that wall time
+            st.dev_ms += (time.perf_counter() - t0) * 1e3
 
-    def _offload_preamble(self, upload=None) -> None:
+    def _offload_preamble(self, upload=None, seq: Optional[_Sequence] = None) -> None:
         """Dispatch d2h gathers for every pending eviction before this
         prefill overwrites their pages (the fetch lands in background —
         budget=None takes all pending because a prefill may write any
         freshly allocated page), then land the reserved chain's h2d
         upload: a cheap on-device scatter that waits only if the upload
-        begun at reservation hasn't arrived yet."""
+        begun at reservation hasn't arrived yet. With a traced ``seq``,
+        the restore's hidden-vs-exposed split (PR 1's accounting) is
+        recorded as this request's ``engine.kv_restore`` span."""
         if self.offload is None:
             return
         self.offload.flush_evictions_async(self.k_cache, self.v_cache)
         if upload is not None:
+            t0 = time.perf_counter()
             self.k_cache, self.v_cache = self.offload.finish_upload(
                 self.k_cache, self.v_cache, upload
             )
+            if seq is not None and seq.trace is not None and seq.generated == 0:
+                waited_ms = (time.perf_counter() - t0) * 1e3
+                t_landed = getattr(upload, "t_landed", None)
+                total_ms = (
+                    max((t_landed - upload.t_start) * 1e3, 0.0)
+                    if t_landed is not None else waited_ms
+                )
+                exposed_ms = min(waited_ms, total_ms)
+                tracing.RECORDER.record_span(
+                    "engine.kv_restore", seq.trace,
+                    ts=time.time() - waited_ms / 1e3, dur_ms=waited_ms,
+                    request_id=seq.context.id,
+                    blocks=len(upload.hashes),
+                    exposed_ms=round(exposed_ms, 3),
+                    hidden_ms=round(max(total_ms - exposed_ms, 0.0), 3),
+                )
 
     def _ring_chunk(self, seq: _Sequence, pos: int) -> bool:
         """Route THIS chunk through sp ring attention? History-free
@@ -928,7 +984,7 @@ class JaxEngine(AsyncEngine):
         entry or None) — the entry rides the KV transfer so a logprobs
         request served via remote prefill doesn't lose its first token's
         logprobs (advisor r2)."""
-        self._offload_preamble(upload)
+        self._offload_preamble(upload, seq=seq)
         logits = None
         pos = history
         while pos < len(seq.tokens):
@@ -1874,6 +1930,13 @@ class JaxEngine(AsyncEngine):
         seq.tokens.append(token)
         seq.generated += 1
         self.stats["tokens_generated"] += 1
+        if seq.trace is not None and seq.generated == 1:
+            # first-token anchor for the TTFT decomposition; later tokens
+            # pay only the seq.trace None-check above
+            tracing.RECORDER.event(
+                "engine.first_token", trace=seq.trace,
+                request_id=seq.context.id,
+            )
 
         finish: Optional[FinishReason] = None
         eos_ids = set(req.eos_token_ids or [])
@@ -1990,6 +2053,7 @@ class JaxEngine(AsyncEngine):
             out_queue=asyncio.Queue(),
             tokens=prompt,
             prompt_len=len(prompt),
+            trace=tracing.current_trace() if tracing.enabled() else None,
         )
         reserved = self._reserve_for_prompt(seq)
         if reserved is None:
@@ -2055,6 +2119,7 @@ class JaxEngine(AsyncEngine):
             out_queue=asyncio.Queue(),
             tokens=prompt,
             prompt_len=len(prompt),
+            trace=tracing.current_trace() if tracing.enabled() else None,
         )
         if self._reserve_for_prompt(seq) is None:
             return None
@@ -2165,3 +2230,8 @@ class _PrefillState:
     # begun at reservation), or None when the host tier missed
     upload: Optional[object] = None
     restored: bool = False  # host-tier restore landed (first chunk)
+    # span anchors for the traced "engine.prefill" component: wall start
+    # + accumulated per-chunk DEVICE milliseconds (the span duration —
+    # wall time would absorb decode steps interleaved between chunks)
+    t0_wall: float = field(default_factory=time.time)
+    dev_ms: float = 0.0

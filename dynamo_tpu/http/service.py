@@ -19,11 +19,12 @@ import json
 import logging
 from typing import Optional
 
+from .. import tracing
 from ..protocols.aggregator import aggregate_chat_chunks, aggregate_completion_chunks
 from ..protocols.openai import ChatCompletionRequest, CompletionRequest, RequestError
 from ..protocols.sse import encode_comment, encode_data, encode_done, encode_event
 from ..runtime.annotated import Annotated
-from ..runtime.engine import AsyncEngine, Context
+from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .base import HttpError, HttpServerBase, _STATUS_TEXT  # noqa: F401 — HttpError re-exported
 from .metrics import Metrics
 
@@ -85,15 +86,23 @@ class HttpService(HttpServerBase):
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics: Optional[Metrics] = None,
+        trace_collector=None,
     ):
         super().__init__(host=host, port=port)
         self.models = model_manager or ModelManager()
         self.metrics = metrics or Metrics()
+        # tracing.TraceCollector serving /trace/{request_id} (None = off)
+        self.tracing = trace_collector
+        # client-supplied request ids currently in flight: a duplicate
+        # would key cross-request shared state (worker inflight map,
+        # disagg transfer futures) onto one id — the second request
+        # falls back to a minted uuid instead
+        self._inflight_ids: set[str] = set()
 
     # ---------------- routing ----------------
 
     async def _route(self, method, path, headers, body, writer) -> None:
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if method == "GET":
             if path in ("/health", "/live", "/ready"):
                 await self._send_json(writer, 200, {"status": "ok"})
@@ -108,21 +117,57 @@ class HttpService(HttpServerBase):
                     for name in self.models.model_names()
                 ]
                 await self._send_json(writer, 200, {"object": "list", "data": data})
+            elif path.startswith("/trace/") or path == "/trace":
+                await self._trace_endpoint(writer, path, query)
             else:
                 raise HttpError(404, f"no route for GET {path}", "not_found")
         elif method == "POST":
             if path == "/v1/chat/completions":
-                await self._openai_endpoint(writer, body, chat=True)
+                await self._openai_endpoint(writer, headers, body, chat=True)
             elif path == "/v1/completions":
-                await self._openai_endpoint(writer, body, chat=False)
+                await self._openai_endpoint(writer, headers, body, chat=False)
             else:
                 raise HttpError(404, f"no route for POST {path}", "not_found")
         else:
             raise HttpError(405, f"method {method} not allowed")
 
+    # ---------------- tracing endpoint ----------------
+
+    async def _trace_endpoint(self, writer, path: str, query: str) -> None:
+        """``GET /trace/{request_id}[?format=chrome]`` — the assembled
+        per-request timeline + TTFT decomposition (or Chrome trace-event
+        JSON); ``GET /trace`` lists collected trace ids + aggregate
+        percentiles."""
+        if self.tracing is None:
+            raise HttpError(404, "tracing is not enabled", "tracing_disabled")
+        if path in ("/trace", "/trace/"):
+            await self._send_json(writer, 200, {
+                "traces": self.tracing.trace_ids(),
+                "ttft_percentiles_ms": self.tracing.percentiles(),
+            })
+            return
+        trace_id = path[len("/trace/"):]
+        fmt = "chrome" if "format=chrome" in query else "timeline"
+        body = self.tracing.render_trace(trace_id, fmt=fmt)
+        if body is None:
+            raise HttpError(404, f"no trace for {trace_id!r}", "trace_not_found")
+        if fmt == "timeline":
+            body = {"request_id": trace_id, **body}
+        await self._send_json(writer, 200, body)
+
     # ---------------- openai endpoints (ref openai.rs:132,214) ----------------
 
-    async def _openai_endpoint(self, writer, body: bytes, chat: bool) -> None:
+    @staticmethod
+    def _client_request_id(headers: dict) -> Optional[str]:
+        """Honor a client-supplied ``X-Request-Id`` (so client logs
+        correlate with traces) — sanitized: printable, bounded, no
+        whitespace. Anything unusable falls back to a minted uuid."""
+        rid = (headers.get("x-request-id") or "").strip()
+        if 0 < len(rid) <= 128 and all(33 <= ord(c) <= 126 for c in rid):
+            return rid
+        return None
+
+    async def _openai_endpoint(self, writer, headers: dict, body: bytes, chat: bool) -> None:
         endpoint = "chat_completions" if chat else "completions"
         try:
             payload = json.loads(body or b"{}")
@@ -148,7 +193,31 @@ class HttpService(HttpServerBase):
             )
 
         guard = self.metrics.inflight_guard(req.model, endpoint)
-        context = Context(req)
+        client_rid = self._client_request_id(headers)
+        if client_rid is not None:
+            if client_rid in self._inflight_ids:
+                logger.warning(
+                    "duplicate in-flight X-Request-Id %r; minting fresh id",
+                    client_rid,
+                )
+                client_rid = None
+            else:
+                self._inflight_ids.add(client_rid)
+        context = Context(req, AsyncEngineContext(client_rid))
+        req_span = tracing.NULL_SPAN
+        trace_token = None
+        if tracing.enabled():
+            # root the request's trace here (honoring an incoming
+            # traceparent); the contextvar scopes this handler task, so
+            # the preprocessor/router/client-egress spans all join it
+            tc = tracing.TraceContext.for_request(
+                context.id, headers.get(tracing.TRACEPARENT_HEADER)
+            )
+            trace_token = tracing.set_trace(tc)
+            req_span = tracing.span(
+                "frontend.request", request_id=context.id,
+                model=req.model, endpoint=endpoint,
+            )
         try:
             stream = engine.generate(context)
             if req.stream:
@@ -156,6 +225,7 @@ class HttpService(HttpServerBase):
             else:
                 chunks: list[dict] = []
                 error: Optional[str] = None
+                first_token = True
                 async for item in stream:
                     ann = item if isinstance(item, Annotated) else Annotated.from_data(item)
                     if ann.is_error():
@@ -166,6 +236,12 @@ class HttpService(HttpServerBase):
                         # responses — TTFT/ITL are still real
                         if _chunk_has_tokens(ann.data):
                             guard.observe_token()
+                            if first_token:
+                                first_token = False
+                                tracing.event(
+                                    "frontend.first_token",
+                                    request_id=context.id,
+                                )
                         chunks.append(ann.data)
                 if error is not None:
                     guard.mark("error")
@@ -183,6 +259,11 @@ class HttpService(HttpServerBase):
                 await self._send_json(writer, 200, full)
         finally:
             guard.done()
+            if client_rid is not None:
+                self._inflight_ids.discard(client_rid)
+            req_span.end()
+            if trace_token is not None:
+                tracing.reset_trace(trace_token)
 
     def _count_tokens(self, model: str, full: dict) -> None:
         usage = full.get("usage") or {}
@@ -208,6 +289,7 @@ class HttpService(HttpServerBase):
 
         include_usage = bool(getattr(req, "stream_options", {}).get("include_usage"))
         ok = True
+        first_token = True
         try:
             try:
                 async for item in stream:
@@ -228,6 +310,12 @@ class HttpService(HttpServerBase):
                                 data = {k: v for k, v in data.items() if k != "usage"}
                         if _chunk_has_tokens(data):
                             guard.observe_token()  # TTFT / ITL histograms
+                            if first_token:
+                                first_token = False
+                                tracing.event(
+                                    "frontend.first_token",
+                                    request_id=context.id,
+                                )
                         await send(encode_data(data))
             except (ConnectionResetError, BrokenPipeError):
                 raise

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 from typing import AsyncIterator, Optional
 
+from .. import tracing
 from ..engine.allocator import sequence_block_hashes
 from ..protocols.common import PreprocessedRequest
 from ..runtime.annotated import Annotated
@@ -125,12 +126,18 @@ class KvRoutedEngine(AsyncEngine):
         )
         payload = data.to_dict() if isinstance(data, PreprocessedRequest) else data
         worker_id: Optional[int] = None
-        try:
-            worker_id, _overlap = await self.router.schedule(token_ids)
-        except AllWorkersBusy:
-            logger.warning("all workers busy; falling back to round robin")
-        except Exception:  # noqa: BLE001
-            logger.exception("router failure; falling back to round robin")
+        # the routing decision is the TTFT's "route" component — recorded
+        # even on the fallback paths (the time was spent either way)
+        with tracing.span("router.schedule", request_id=request.id) as rt_span:
+            try:
+                worker_id, overlap = await self.router.schedule(token_ids)
+                rt_span.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
+            except AllWorkersBusy:
+                rt_span.set(fallback="round_robin")
+                logger.warning("all workers busy; falling back to round robin")
+            except Exception:  # noqa: BLE001
+                rt_span.set(fallback="round_robin", error="router_failure")
+                logger.exception("router failure; falling back to round robin")
         try:
             if worker_id is not None and worker_id in set(self.client.instance_ids()):
                 stream = await self.client.direct(request.transfer(payload), worker_id)

@@ -50,8 +50,48 @@ from ..protocols.common import FinishReason, LLMEngineOutput, PreprocessedReques
 from ..protocols.openai import ChatCompletionRequest
 from ..runtime import AsyncEngine, Context, DistributedRuntime, link
 from ..runtime.hub import HubServer, connect_hub
+from .. import tracing
 
 logger = logging.getLogger(__name__)
+
+
+async def setup_tracing(args, service: str, drt=None, component=None,
+                        collector: bool = False):
+    """--trace wiring for one process role. Enables the span recorder
+    under the given service name; with ``collector=True`` (frontend /
+    standalone collector roles) returns a TraceCollector fed by local
+    spans AND — when a runtime is given — by remote workers' span batches
+    on the trace-events subject(s). Worker roles instead export their
+    spans onto their component's trace-events subject."""
+    if not getattr(args, "trace", False):
+        return None
+    tracing.configure(enabled=True, service=service)
+    if collector:
+        tc = tracing.TraceCollector(drt, component)
+        sink = tc.ingest
+        if drt is not None:
+            await tc.start()
+            # ALSO export the frontend's own spans onto the bus: a
+            # standalone collector (python -m dynamo_tpu.observability
+            # --trace) needs the frontend.request/first_token anchors or
+            # its decompositions never resolve. Three-token subject so
+            # the *.*.trace-events wildcard matches.
+            exporter = tracing.BusExporter(
+                drt.bus, f"{service}.http.{tracing.TRACE_EVENTS_SUBJECT}"
+            )
+
+            def sink(rec, _ingest=tc.ingest, _export=exporter):  # noqa: F811
+                _ingest(rec)
+                _export(rec)
+
+        tracing.RECORDER.configure(enabled=True, sink=sink)
+        return tc
+    if drt is not None and component is not None:
+        exporter = tracing.BusExporter(
+            drt.bus, component.event_subject(tracing.TRACE_EVENTS_SUBJECT)
+        )
+        tracing.RECORDER.configure(enabled=True, sink=exporter)
+    return None
 
 
 def _node_rank_default() -> int:
@@ -295,9 +335,20 @@ async def run_http(args) -> None:
         )
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
+        # wildcard, not pinned to `comp`: disagg prefill workers export
+        # on their own {ns}.prefill.trace-events subject and their spans
+        # must land in the same timelines as the decode workers'
+        svc.tracing = await setup_tracing(
+            args, "frontend", drt=drt, collector=True
+        )
     elif args.out.startswith("dyn://"):
         drt = await connect_runtime(args)
         await ModelWatcher(drt, manager).start()
+        # no single component to pin to: the collector subscribes the
+        # trace-events wildcard and assembles whatever workers export
+        svc.tracing = await setup_tracing(
+            args, "frontend", drt=drt, collector=True
+        )
     else:
         cfg, params, tokenizer, name = build_model(args)
         core = build_core_engine(args, cfg, params)
@@ -305,6 +356,8 @@ async def run_http(args) -> None:
         engine = OpenAIWorkerEngine(tokenizer, core)
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
+        # single process: local spans feed the collector directly
+        svc.tracing = await setup_tracing(args, "frontend", collector=True)
     await svc.start()
     print(f"OpenAI server on http://{args.host}:{svc.port} "
           f"(models: {manager.model_names() or 'discovered dynamically'})", flush=True)
@@ -390,6 +443,9 @@ async def run_endpoint(args) -> None:
             if jax_core else (lambda: {})
         )
     component = drt.namespace(ns).component(comp)
+    await setup_tracing(
+        args, f"worker-{drt.primary_lease_id:x}", drt=drt, component=component
+    )
     if jax_core is not None:
         from ..kv_router import KvEventPublisher, KvPrefetchListener
 
@@ -455,6 +511,10 @@ async def run_prefill(args) -> None:
     assert isinstance(core, JaxEngine), "in=prefill requires out=jax"
     await maybe_warmup(args, core, decode=False)
     drt = await connect_runtime(args)
+    await setup_tracing(
+        args, f"prefill-{drt.primary_lease_id:x}", drt=drt,
+        component=drt.namespace(ns).component("prefill"),
+    )
     queue = PrefillQueue(drt.bus, ns)
     worker = PrefillWorker(core, queue)
     worker.start()
@@ -666,6 +726,12 @@ def main(argv=None) -> None:
                    help="compile every prefill bucket + the decode window "
                         "before serving (first-request TTFT skips the "
                         "20-40s per-bucket XLA compile)")
+    p.add_argument("--trace", action="store_true",
+                   default=os.environ.get("DYN_TRACE", "") not in ("", "0"),
+                   help="distributed request tracing: span propagation "
+                        "across frontend/router/workers, /trace/{id} "
+                        "timelines + per-request TTFT decomposition "
+                        "(also: DYN_TRACE=1)")
     args = p.parse_args(argv)
 
     # escape hatch for tests/ops: force the JAX platform before any device

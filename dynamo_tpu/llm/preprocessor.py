@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import AsyncIterator, Optional, Union
 
+from .. import tracing
 from ..protocols.common import LLMEngineOutput, PreprocessedRequest
 from ..protocols.openai import (
     ChatCompletionRequest,
@@ -82,10 +83,13 @@ class OpenAIPreprocessor(Operator):
     ) -> AsyncIterator[Annotated]:
         req: Union[ChatCompletionRequest, CompletionRequest] = request.data
         is_chat = isinstance(req, ChatCompletionRequest)
-        if is_chat:
-            pre, prompt = self.preprocess_chat(req)
-        else:
-            pre, prompt = self.preprocess_completion(req)
+        # template render + tokenization = the TTFT's "tokenize" component
+        with tracing.span("tokenize", request_id=request.id) as tok_span:
+            if is_chat:
+                pre, prompt = self.preprocess_chat(req)
+            else:
+                pre, prompt = self.preprocess_completion(req)
+            tok_span.set(tokens=len(pre.token_ids))
 
         # requested annotations ride the stream as events (ref nvext.rs)
         for ann in req.nvext.annotations:

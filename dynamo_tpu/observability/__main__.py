@@ -25,9 +25,11 @@ async def main_async(args) -> None:
         drt = await DistributedRuntime.from_settings()
     component = drt.namespace(ns).component(comp)
     mc = await MetricsComponent(
-        drt, component, host=args.host, port=args.port, interval=args.interval
+        drt, component, host=args.host, port=args.port, interval=args.interval,
+        enable_tracing=args.trace,
     ).start()
-    print(f"metrics for {args.target} on http://{args.host}:{mc.port}/metrics",
+    print(f"metrics for {args.target} on http://{args.host}:{mc.port}/metrics"
+          + (f" (+ /trace/{{request_id}})" if args.trace else ""),
           flush=True)
     await asyncio.Event().wait()
 
@@ -39,6 +41,10 @@ def main() -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=18090)
     p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--trace", action="store_true",
+                   default=os.environ.get("DYN_TRACE", "") not in ("", "0"),
+                   help="collect trace-events spans: TTFT-decomposition "
+                        "gauges + /trace/{request_id} timelines")
     from ..utils.logging import setup_logging
     setup_logging()
     try:

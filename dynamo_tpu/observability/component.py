@@ -35,6 +35,8 @@ class MetricsComponent:
         port: int = 18090,
         interval: float = 1.0,
         prefix: str = "dynamo_tpu",
+        tracing_collector=None,
+        enable_tracing: bool = False,
     ):
         self.drt = drt
         self.component = component
@@ -47,6 +49,17 @@ class MetricsComponent:
         self.hit_overlap_blocks = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._hit_task = None
+        # per-request trace collector (tracing.TraceCollector): assembles
+        # trace-events spans into timelines, feeds the TTFT-decomposition
+        # percentile gauges and the /trace/{request_id} endpoint
+        self.tracing = tracing_collector
+        if self.tracing is None and enable_tracing:
+            from ..tracing import TraceCollector
+
+            # unpinned: subscribe the *.*.trace-events wildcard so
+            # frontend anchors and disagg prefill-worker spans land in
+            # the same timelines as the scraped component's workers
+            self.tracing = TraceCollector(drt)
 
     async def start(self) -> "MetricsComponent":
         await self.aggregator.start()
@@ -57,6 +70,8 @@ class MetricsComponent:
         if ready is not None:
             await ready
         self._hit_task = self.drt.runtime.spawn(self._consume_hits(sub))
+        if self.tracing is not None and self.tracing.drt is not None:
+            await self.tracing.start()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port
         )
@@ -66,6 +81,8 @@ class MetricsComponent:
     async def close(self) -> None:
         if self._hit_task is not None:
             self._hit_task.cancel()
+        if self.tracing is not None:
+            await self.tracing.close()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -117,6 +134,17 @@ class MetricsComponent:
                 round(self.hit_overlap_blocks / self.hit_isl_blocks, 6),
             )
         gauge("kv_hit_events_total", self.hit_events)
+        if self.tracing is not None:
+            # per-request TTFT decomposition percentiles (tracing plane):
+            # where TTFT actually went, fleet-wide — queue wait vs KV
+            # transfer vs prefill compute, not just the total
+            gauge("traces_spans_total", self.tracing.spans_total)
+            for comp, qs in sorted(self.tracing.percentiles().items()):
+                for q, v in sorted(qs.items()):
+                    gauge(
+                        "ttft_component_ms",
+                        v, f'component="{comp}",quantile="{q}"',
+                    )
         return "\n".join(lines) + "\n"
 
     async def _handle(self, reader, writer) -> None:
@@ -128,6 +156,7 @@ class MetricsComponent:
                 if h in (b"\r\n", b"\n", b""):
                     break
             path = line.split()[1].decode() if len(line.split()) > 1 else "/"
+            path, _, query = path.partition("?")
             if path in ("/metrics", "/"):
                 body = self.render().encode()
                 status = b"200 OK"
@@ -135,6 +164,18 @@ class MetricsComponent:
             elif path == "/health":
                 body = b'{"status":"ok"}'
                 status = b"200 OK"
+                ctype = b"application/json"
+            elif path.startswith("/trace/") and self.tracing is not None:
+                import json as _json
+
+                fmt = "chrome" if "format=chrome" in query else "timeline"
+                obj = self.tracing.render_trace(path[len("/trace/"):], fmt=fmt)
+                if obj is None:
+                    body = b'{"error":"trace not found"}'
+                    status = b"404 Not Found"
+                else:
+                    body = _json.dumps(obj).encode()
+                    status = b"200 OK"
                 ctype = b"application/json"
             else:
                 body = b"not found"

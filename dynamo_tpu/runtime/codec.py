@@ -10,6 +10,12 @@ opaque payload (serialized request, a KV-block shard, a token batch...).
 The u64 data length lets the same framing carry multi-GB KV-cache transfers
 on the DCN KV plane (see dynamo_tpu.kv.transfer) as well as tiny control
 messages.
+
+**Forward compatibility contract**: decoders read the header keys they
+know and MUST ignore the rest — a newer peer may add fields (the trace
+context's ``traceparent`` rode in this way) and frames from it must still
+decode on older builds. Use :meth:`TwoPartMessage.header_field` for
+tolerant access; never destructure the header dict exhaustively.
 """
 
 from __future__ import annotations
@@ -45,6 +51,23 @@ class TwoPartMessage:
 
     def header_json(self) -> Any:
         return json.loads(self.header) if self.header else None
+
+    def header_field(self, key: str, default: Any = None) -> Any:
+        """Version-skew-safe header read: the named key if the header is
+        a JSON object carrying it, else ``default``. Unknown extra keys
+        in the header are — by contract — ignored, and a malformed or
+        non-object header reads as "no fields" rather than an exception
+        (the frame layer stays decodable even when a peer's header
+        schema has drifted)."""
+        if not self.header:
+            return default
+        try:
+            obj = json.loads(self.header)
+        except ValueError:
+            return default
+        if not isinstance(obj, dict):
+            return default
+        return obj.get(key, default)
 
 
 def encode(msg: TwoPartMessage, flags: int = FLAG_NONE) -> bytes:

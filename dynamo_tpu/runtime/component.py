@@ -32,6 +32,7 @@ from .bus import Message, NoResponders
 from .engine import AsyncEngine, AsyncEngineContext, Context
 from .store import EventKind
 from .tcp import ConnectionInfo, connect_response_stream
+from .. import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -64,7 +65,11 @@ class EndpointInfo:
 
     @staticmethod
     def from_json(raw: bytes) -> "EndpointInfo":
-        return EndpointInfo(**json.loads(raw))
+        d = json.loads(raw)
+        # ignore unknown keys: a newer peer may advertise fields this
+        # process doesn't know yet (version-skew safety)
+        fields = EndpointInfo.__dataclass_fields__
+        return EndpointInfo(**{k: v for k, v in d.items() if k in fields})
 
 
 class Namespace:
@@ -132,22 +137,27 @@ class RequestEnvelope:
     connection_info: Optional[dict]
     payload: Any
     annotations: dict = field(default_factory=dict)
+    # W3C traceparent carrying the caller's trace across the bus hop
+    # (absent when tracing is off; decoders must tolerate unknown keys)
+    trace: Optional[str] = None
 
     def to_bytes(self) -> bytes:
-        return json.dumps(
-            {
-                "request_id": self.request_id,
-                "connection_info": self.connection_info,
-                "payload": self.payload,
-                "annotations": self.annotations,
-            }
-        ).encode()
+        d = {
+            "request_id": self.request_id,
+            "connection_info": self.connection_info,
+            "payload": self.payload,
+            "annotations": self.annotations,
+        }
+        if self.trace is not None:
+            d["trace"] = self.trace
+        return json.dumps(d).encode()
 
     @staticmethod
     def from_bytes(raw: bytes) -> "RequestEnvelope":
         d = json.loads(raw)
         return RequestEnvelope(
-            d["request_id"], d.get("connection_info"), d.get("payload"), d.get("annotations", {})
+            d["request_id"], d.get("connection_info"), d.get("payload"),
+            d.get("annotations", {}), d.get("trace"),
         )
 
 
@@ -242,12 +252,24 @@ class Endpoint:
         """Ingress push handler (ref ingress/push_handler.rs)."""
         writer = None
         env = None
+        handle_span = tracing.NULL_SPAN
+        trace_token = None
         try:
             env = RequestEnvelope.from_bytes(msg.payload)
             context = AsyncEngineContext(env.request_id)
             self._inflight[env.request_id] = context
             self.drt.bus.respond(msg, b'{"ack":true}')
             request = Context(env.payload, context, env.annotations)
+            if tracing.enabled():
+                # continue the caller's trace across the bus hop; this
+                # task's contextvar scopes the whole engine run, so every
+                # downstream span (engine, disagg) joins the same trace
+                tc = tracing.TraceContext.for_request(env.request_id, env.trace)
+                trace_token = tracing.set_trace(tc)
+                handle_span = tracing.span(
+                    "worker.handle", request_id=env.request_id,
+                    endpoint=self.subject,
+                )
             if env.connection_info is not None:
                 info = ConnectionInfo.from_dict(env.connection_info)
                 writer = await connect_response_stream(info, context)
@@ -280,6 +302,9 @@ class Endpoint:
         except Exception as e:  # noqa: BLE001
             logger.exception("ingress failure: %s", e)
         finally:
+            handle_span.end()
+            if trace_token is not None:
+                tracing.reset_trace(trace_token)
             if writer is not None:
                 await writer.close()
             if env is not None:
@@ -427,6 +452,7 @@ class Client:
             connection_info=conn.to_dict(),
             payload=request.data,
             annotations=request.annotations,
+            trace=tracing.current_traceparent(),
         )
         try:
             await self.drt.bus.request(info.subject, env.to_bytes(), timeout=10.0)

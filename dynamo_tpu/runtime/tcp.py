@@ -24,6 +24,7 @@ from typing import AsyncIterator, Optional
 from .annotated import Annotated
 from .codec import TwoPartMessage, read_frame, write_frame
 from .engine import AsyncEngineContext
+from .. import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -109,14 +110,27 @@ class TcpStreamServer:
             prologue = await read_frame(reader)
             if prologue is None:
                 return
-            head = prologue.header_json() or {}
-            stream_id = head.get("stream_id", "")
+            # tolerant reads: newer peers may add header keys (e.g. the
+            # trace context) — decode what we know, ignore the rest
+            stream_id = prologue.header_field("stream_id", "")
             pending = self._pending.get(stream_id)
             if pending is None or pending.connected.done():
                 await write_frame(
                     writer, TwoPartMessage.from_json({"type": T_ERROR, "error": "unknown stream"})
                 )
                 return
+            if tracing.enabled():
+                # the worker's prologue names the request trace: record
+                # the connect-back on the caller side, so the timeline
+                # shows when the response plane came up for this request
+                tc = tracing.TraceContext.from_traceparent(
+                    prologue.header_field("traceparent")
+                )
+                if tc is not None:
+                    tracing.RECORDER.event(
+                        "response.stream_connect", trace=tc,
+                        stream_id=stream_id,
+                    )
             await write_frame(writer, TwoPartMessage.from_json({"type": T_PROLOGUE, "ok": True}))
             pending.connected.set_result(True)
 
@@ -135,8 +149,7 @@ class TcpStreamServer:
                     # one (the lost-stream failure tests/test_soak_churn.py
                     # hunts) — it must surface as an error.
                     break
-                head = frame.header_json() or {}
-                ftype = head.get("type")
+                ftype = frame.header_field("type")
                 if ftype == T_DATA:
                     payload = json.loads(frame.data) if frame.data else {}
                     pending.queue.put_nowait(Annotated.from_dict(payload))
@@ -145,7 +158,8 @@ class TcpStreamServer:
                     break
                 elif ftype == T_ERROR:
                     ended_clean = True  # error IS a terminal signal
-                    pending.queue.put_nowait(Annotated.from_error(head.get("error", "worker error")))
+                    pending.queue.put_nowait(Annotated.from_error(
+                        frame.header_field("error", "worker error")))
                     break
             if not ended_clean:
                 pending.queue.put_nowait(Annotated.from_error(
@@ -201,9 +215,8 @@ class ResponseWriter:
                     # caller hung up -> kill generation (ref: disconnect => kill)
                     self.context.kill()
                     return
-                head = frame.header_json() or {}
-                if head.get("type") == T_CONTROL:
-                    if head.get("msg") == "kill":
+                if frame.header_field("type") == T_CONTROL:
+                    if frame.header_field("msg") == "kill":
                         self.context.kill()
                     else:
                         self.context.stop_generating()
@@ -244,9 +257,14 @@ async def connect_response_stream(
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host, int(port_s)), timeout
     )
-    await write_frame(
-        writer, TwoPartMessage.from_json({"type": T_PROLOGUE, "stream_id": info.stream_id})
-    )
+    prologue = {"type": T_PROLOGUE, "stream_id": info.stream_id}
+    tp = tracing.current_traceparent()
+    if tp is not None:
+        # attribute the response stream to the request's trace; receivers
+        # on older builds ignore the extra header key (codec frame headers
+        # are decoded tolerantly — see read_frame / header_json)
+        prologue["traceparent"] = tp
+    await write_frame(writer, TwoPartMessage.from_json(prologue))
     resp = await read_frame(reader)
     head = (resp.header_json() or {}) if resp else {}
     if not head.get("ok"):

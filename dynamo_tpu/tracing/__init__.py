@@ -1,0 +1,71 @@
+"""Distributed request tracing: end-to-end span propagation with
+per-request TTFT decomposition.
+
+A request crosses frontend -> router -> prefill queue -> decode worker
+-> offload tier; this package gives it one trace id at ingress
+(W3C-traceparent compatible, client-supplied ``traceparent`` honored),
+carries it across every hop (contextvars in-process, the bus
+RequestEnvelope / disagg handoff / TCP prologue across processes),
+records spans in a near-zero-cost ring buffer, and assembles them into
+per-request timelines with a canonical TTFT decomposition
+(tokenize / route / queue wait / KV-transfer exposed-vs-hidden /
+prefill / first decode). See docs/tracing.md.
+"""
+
+from .context import (
+    TRACE_ANNOTATION,
+    TRACEPARENT_HEADER,
+    TraceContext,
+    current_trace,
+    current_traceparent,
+    extract,
+    inject,
+    reset_trace,
+    set_trace,
+    use_trace,
+)
+from .collector import (
+    TRACE_EVENTS_SUBJECT,
+    TRACE_EVENTS_WILDCARD,
+    BusExporter,
+    TraceCollector,
+    percentile,
+)
+from .span import (
+    NULL_SPAN,
+    RECORDER,
+    SpanRecorder,
+    configure,
+    enabled,
+    event,
+    span,
+)
+from .ttft import COMPONENTS, decompose, measured_ttft_ms
+
+__all__ = [
+    "BusExporter",
+    "COMPONENTS",
+    "NULL_SPAN",
+    "RECORDER",
+    "SpanRecorder",
+    "TRACE_ANNOTATION",
+    "TRACEPARENT_HEADER",
+    "TRACE_EVENTS_SUBJECT",
+    "TRACE_EVENTS_WILDCARD",
+    "TraceCollector",
+    "TraceContext",
+    "configure",
+    "current_trace",
+    "current_traceparent",
+    "decompose",
+    "enabled",
+    "event",
+    "extract",
+    "inject",
+    "measured_ttft_ms",
+    "percentile",
+    "reset_trace",
+    "set_trace",
+    "span",
+    "use_trace",
+]

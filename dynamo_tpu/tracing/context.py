@@ -1,0 +1,154 @@
+"""W3C-traceparent-style trace context, contextvar-propagated.
+
+One request gets one ``TraceContext`` at the first ingress it crosses
+(the HTTP frontend, or a worker endpoint for dyn:// callers that sent
+none). The context is:
+
+  * **header-encoded** as a ``traceparent`` string
+    (``00-{trace_id:32x}-{span_id:16x}-{flags:02x}``, the W3C Trace
+    Context wire form) so it can ride HTTP headers, the bus
+    RequestEnvelope, the TCP response-plane prologue, and the disagg
+    remote-prefill handoff without any of those layers knowing more
+    than "an opaque string",
+  * **contextvar-propagated** inside a process, so pipeline stages
+    (preprocessor -> router -> client egress) pick it up without
+    threading an argument through every ``generate`` signature.
+
+When the caller supplied a traceparent we honor its ``trace_id`` (their
+logs correlate with our spans); otherwise the trace id is derived from
+the request id when that is already a 32-hex uuid, so ``/trace/{id}``
+lookups need no extra mapping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import re
+import uuid
+from dataclasses import dataclass, field, replace
+from typing import Iterator, Optional
+
+TRACEPARENT_HEADER = "traceparent"
+# key under which the traceparent rides request annotations / envelopes
+TRACE_ANNOTATION = "traceparent"
+
+_TRACEPARENT_RE = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})"
+    r"-(?P<span_id>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})$"
+)
+_HEX32_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Identity of one span within one request's trace."""
+
+    trace_id: str
+    span_id: str = field(default_factory=_new_span_id)
+    parent_id: Optional[str] = None
+    sampled: bool = True
+
+    # ---- construction ----
+    @staticmethod
+    def new(trace_id: Optional[str] = None) -> "TraceContext":
+        return TraceContext(trace_id=trace_id or uuid.uuid4().hex)
+
+    @staticmethod
+    def for_request(
+        request_id: Optional[str], traceparent: Optional[str] = None
+    ) -> "TraceContext":
+        """Root context at an ingress: continue the caller's trace when a
+        valid ``traceparent`` came in (their span becomes our parent),
+        else root a new trace — reusing a 32-hex request id as the trace
+        id so request-id lookups are trace-id lookups."""
+        if traceparent:
+            parsed = TraceContext.from_traceparent(traceparent)
+            if parsed is not None:
+                return parsed.child()
+        rid = (request_id or "").lower()
+        if _HEX32_RE.match(rid):
+            return TraceContext(trace_id=rid)
+        return TraceContext.new()
+
+    def child(self) -> "TraceContext":
+        """A new span under this one (same trace)."""
+        return replace(self, span_id=_new_span_id(), parent_id=self.span_id)
+
+    # ---- wire form ----
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+    @staticmethod
+    def from_traceparent(header: Optional[str]) -> Optional["TraceContext"]:
+        """Parse a traceparent; returns None on anything malformed (a bad
+        header must never fail the request) or on the all-zero ids the
+        spec reserves as invalid. Unknown versions parse leniently —
+        forward compatibility per the W3C spec."""
+        if not header or not isinstance(header, str):
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if m is None:
+            return None
+        trace_id, span_id = m.group("trace_id"), m.group("span_id")
+        if m.group("version") == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        sampled = bool(int(m.group("flags"), 16) & 0x01)
+        return TraceContext(trace_id=trace_id, span_id=span_id, sampled=sampled)
+
+
+# ---------------- contextvar propagation ----------------
+
+_current: contextvars.ContextVar[Optional[TraceContext]] = contextvars.ContextVar(
+    "dynamo_tpu_trace", default=None
+)
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _current.get()
+
+
+def current_traceparent() -> Optional[str]:
+    tc = _current.get()
+    return tc.to_traceparent() if tc is not None else None
+
+
+def set_trace(tc: Optional[TraceContext]) -> contextvars.Token:
+    return _current.set(tc)
+
+
+def reset_trace(token: contextvars.Token) -> None:
+    _current.reset(token)
+
+
+@contextlib.contextmanager
+def use_trace(tc: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    token = _current.set(tc)
+    try:
+        yield tc
+    finally:
+        _current.reset(token)
+
+
+# ---------------- annotation / envelope helpers ----------------
+
+def inject(carrier: Optional[dict], tc: Optional[TraceContext] = None) -> Optional[dict]:
+    """Write the (current) trace into a dict carrier (request annotations,
+    an envelope header). Returns the carrier for chaining; no-op without
+    an active trace."""
+    tc = tc or _current.get()
+    if tc is None or carrier is None:
+        return carrier
+    carrier[TRACE_ANNOTATION] = tc.to_traceparent()
+    return carrier
+
+
+def extract(carrier: Optional[dict]) -> Optional[TraceContext]:
+    """Read a trace out of a dict carrier; None when absent/malformed."""
+    if not carrier:
+        return None
+    return TraceContext.from_traceparent(carrier.get(TRACE_ANNOTATION))

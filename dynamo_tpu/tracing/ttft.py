@@ -1,0 +1,123 @@
+"""Canonical per-request TTFT decomposition from a trace's spans.
+
+TTFT (frontend receipt -> first generated token on the wire) decomposes
+into the canonical components every perf argument in this repo should be
+made with (see docs/tracing.md for the definitions):
+
+    tokenize             chat-template render + tokenization
+    route                KV-router scheduling decision
+    queue_wait           engine admission wait + disagg prefill-queue wait
+    kv_transfer_exposed  restore/transfer latency actually paid on TTFT
+    prefill              prompt compute (local chunks or remote prefill)
+    first_decode         the remainder: first-token sampling, stream
+                         transport, scheduling gaps
+
+``kv_transfer_hidden`` is reported alongside (PR 1's restore-latency
+accounting: transfer time overlapped behind scheduling/compute) but is
+NOT part of the sum — hidden latency, by definition, cost the request
+nothing.
+
+The components are measured leaf spans; ``first_decode`` is defined as
+the un-attributed remainder, so the decomposition sums to the measured
+TTFT exactly whenever the leaf spans nest cleanly inside it (the
+acceptance bound is 5% to absorb cross-process clock skew).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: span names making up the request timeline (the instrumentation contract)
+SPAN_REQUEST = "frontend.request"
+EVENT_FIRST_TOKEN = "frontend.first_token"
+SPAN_TOKENIZE = "tokenize"
+SPAN_ROUTE = "router.schedule"
+SPAN_QUEUE_WAIT = "engine.queue_wait"
+SPAN_KV_RESTORE = "engine.kv_restore"
+SPAN_PREFILL = "engine.prefill"
+EVENT_ENGINE_FIRST_TOKEN = "engine.first_token"
+SPAN_WORKER_HANDLE = "worker.handle"
+SPAN_DISAGG_REMOTE = "disagg.remote_prefill"
+SPAN_PREFILL_QUEUE_WAIT = "prefill.queue_wait"
+SPAN_PREFILL_COMPUTE = "prefill.compute"
+SPAN_PREFILL_KV_SEND = "prefill.kv_send"
+
+#: decomposition keys, in canonical order (these sum to ttft_ms)
+COMPONENTS = (
+    "tokenize",
+    "route",
+    "queue_wait",
+    "kv_transfer_exposed",
+    "prefill",
+    "first_decode",
+)
+
+
+def _sum_dur(spans: list[dict], name: str) -> float:
+    return sum(s["dur_ms"] for s in spans if s["name"] == name)
+
+
+def _sum_attr(spans: list[dict], name: str, attr: str) -> float:
+    return sum(
+        float(s.get("attrs", {}).get(attr, 0.0) or 0.0)
+        for s in spans
+        if s["name"] == name
+    )
+
+
+def measured_ttft_ms(spans: list[dict]) -> Optional[float]:
+    """First-token wall time minus request receipt, from the frontend's
+    own clock when it recorded both; falls back to the engine's
+    first-token event against the request span (cross-process wall
+    clocks — same host in every supported deployment shape)."""
+    req = next((s for s in spans if s["name"] == SPAN_REQUEST), None)
+    first = next(
+        (s for s in spans if s["name"] == EVENT_FIRST_TOKEN), None
+    ) or next((s for s in spans if s["name"] == EVENT_ENGINE_FIRST_TOKEN), None)
+    if req is None or first is None:
+        return None
+    return max((first["ts"] - req["ts"]) * 1e3, 0.0)
+
+
+def decompose(spans: list[dict]) -> Optional[dict]:
+    """-> {"ttft_ms", components..., "kv_transfer_hidden"} or None when
+    the trace lacks the request/first-token anchors."""
+    ttft = measured_ttft_ms(spans)
+    if ttft is None:
+        return None
+    tokenize = _sum_dur(spans, SPAN_TOKENIZE)
+    route = _sum_dur(spans, SPAN_ROUTE)
+    queue_wait = _sum_dur(spans, SPAN_QUEUE_WAIT) + _sum_dur(
+        spans, SPAN_PREFILL_QUEUE_WAIT
+    )
+    kv_exposed = _sum_attr(spans, SPAN_KV_RESTORE, "exposed_ms")
+    kv_hidden = _sum_attr(spans, SPAN_KV_RESTORE, "hidden_ms")
+    prefill = _sum_dur(spans, SPAN_PREFILL) + _sum_dur(spans, SPAN_PREFILL_COMPUTE)
+    # the engine's kv-restore wait happens INSIDE the prefill region
+    # (offload preamble of the first chunk / the remote extract), so the
+    # prefill spans contain it — carve it out so the components stay
+    # disjoint and the sum honest
+    prefill = max(prefill - kv_exposed, 0.0)
+    # remote prefill: the decode side's wait covers queue wait + compute +
+    # transfer; what it paid beyond the accounted parts is KV transfer
+    remote_wait = _sum_dur(spans, SPAN_DISAGG_REMOTE)
+    if remote_wait:
+        kv_exposed += max(
+            remote_wait
+            - _sum_dur(spans, SPAN_PREFILL_QUEUE_WAIT)
+            - _sum_dur(spans, SPAN_PREFILL_COMPUTE),
+            0.0,
+        )
+    attributed = tokenize + route + queue_wait + kv_exposed + prefill
+    out = {
+        "ttft_ms": round(ttft, 3),
+        "tokenize": round(tokenize, 3),
+        "route": round(route, 3),
+        "queue_wait": round(queue_wait, 3),
+        "kv_transfer_exposed": round(kv_exposed, 3),
+        "prefill": round(prefill, 3),
+        "first_decode": round(max(ttft - attributed, 0.0), 3),
+        # informational, not part of the sum
+        "kv_transfer_hidden": round(kv_hidden, 3),
+    }
+    return out

@@ -40,11 +40,71 @@ def _clear_jax_caches_between_modules():
     jax.clear_caches()
 
 
+#: modules whose event loops run under the asyncio stall detector —
+#: the engine scheduler / offload pipeline / tracing paths promise to
+#: keep device work off the loop (PR 1's async invariants); a blocking
+#: callback beyond the threshold FAILS the test instead of silently
+#: freezing token streams in production. DYN_LOOP_STALL_S=0 disables.
+_STALL_GUARDED_MODULES = {
+    "test_engine",
+    "test_offload",
+    "test_offload_pipeline",
+    "test_tracing",
+}
+
+
+def _run_stall_guarded(coro, threshold: float):
+    """asyncio.run under debug mode with slow_callback_duration: collect
+    the 'Executing <Handle> took Ns' warnings asyncio emits for loop
+    stalls and fail the test if any fired."""
+    import logging
+
+    stalls: list[str] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if "Executing" in msg and "took" in msg:
+                stalls.append(msg)
+
+    handler = _Capture()
+    alog = logging.getLogger("asyncio")
+    old_level = alog.level
+    alog.addHandler(handler)
+    if alog.level > logging.WARNING or alog.level == logging.NOTSET:
+        alog.setLevel(logging.WARNING)
+
+    async def _with_threshold():
+        loop = asyncio.get_running_loop()
+        loop.slow_callback_duration = threshold
+        return await coro
+
+    try:
+        result = asyncio.run(_with_threshold(), debug=True)
+    finally:
+        alog.removeHandler(handler)
+        alog.setLevel(old_level)
+    if stalls:
+        pytest.fail(
+            f"event-loop stall beyond {threshold}s — scheduler/offload "
+            f"work blocked the loop (PR-1 async invariant):\n  "
+            + "\n  ".join(stalls)
+        )
+    return result
+
+
 @pytest.fixture
-def run():
-    """Run a coroutine inside a fresh event loop."""
+def run(request):
+    """Run a coroutine inside a fresh event loop. For the engine/offload/
+    tracing modules the loop runs in asyncio debug mode with a
+    slow-callback detector (see _STALL_GUARDED_MODULES)."""
+    module = request.node.module.__name__.rsplit(".", 1)[-1]
+    threshold = float(os.environ.get("DYN_LOOP_STALL_S", "1.0"))
+    guarded = module in _STALL_GUARDED_MODULES and threshold > 0
 
     def _run(coro):
+        if guarded:
+            return _run_stall_guarded(coro, threshold)
         return asyncio.run(coro)
 
     return _run
