@@ -14,33 +14,55 @@ import sys
 import time
 
 
+# process-level memo of a FAILED backend probe: a down backend costs one
+# probe timeout for the whole process, not one per retry/call site (and
+# DYN_BENCH_SKIP_PROBE skips straight to the CPU fallback — for boxes
+# known to have no reachable accelerator)
+_probe_failed = False
+
+
 def _probe_backend(timeout_s: float) -> bool:
     """True iff a fresh subprocess can init the default jax backend in time.
 
     Backend init can HANG (not raise) when the TPU is held by another
     process or the tunnel is down, so the probe must live in a killable
     subprocess — a hung init in this process would be unrecoverable.
+    A failure is memoized for the process (see _probe_failed above).
     """
+    import os
     import subprocess
 
+    global _probe_failed
+    if os.environ.get("DYN_BENCH_SKIP_PROBE"):
+        # the explicit skip must also suppress the caller's retry
+        # backoff sleeps, not just the probe subprocess
+        _probe_failed = True
+    if _probe_failed:
+        return False
     code = "import jax; jax.devices(); print('ok')"
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
             capture_output=True, timeout=timeout_s, text=True,
         )
-        return r.returncode == 0 and "ok" in r.stdout
+        ok = r.returncode == 0 and "ok" in r.stdout
     except (subprocess.TimeoutExpired, OSError):
-        return False
+        ok = False
+    if not ok:
+        _probe_failed = True
+    return ok
 
 
-def _acquire_devices(retries: int = 3, probe_timeout: float = 120.0):
-    """Initialize the jax backend with retry/backoff and CPU fallback.
+def _acquire_devices(probe_timeout: float = 120.0):
+    """Initialize the jax backend with a single probe and CPU fallback.
 
     The TPU chip is exclusive-access and init hangs rather than raising
-    when it's unavailable, so availability is probed in a subprocess with a
-    hard timeout; only after a successful probe do we init in-process.
-    Falls back to CPU so the bench always emits a number.
+    when it's unavailable, so availability is probed in a subprocess with
+    a hard timeout; only after a successful probe do we init in-process.
+    A failed probe is memoized process-wide, so a down backend costs ONE
+    probe timeout for the whole process — the old retry/backoff ladder
+    (3 x 120s + sleeps before the same fallback) is gone. Falls back to
+    CPU so the bench always emits a number.
     """
     import os
 
@@ -53,17 +75,12 @@ def _acquire_devices(retries: int = 3, probe_timeout: float = 120.0):
         jax.config.update("jax_platforms", "cpu")
         return jax.devices("cpu")
 
-    for attempt in range(retries):
-        if _probe_backend(probe_timeout):
-            return jax.devices()
-        print(
-            f"bench: backend probe {attempt + 1}/{retries} failed "
-            f"(timeout {probe_timeout}s)",
-            file=sys.stderr,
-        )
-        if attempt < retries - 1:
-            time.sleep(10.0 * (attempt + 1))
-    print("bench: falling back to CPU", file=sys.stderr)
+    if _probe_backend(probe_timeout):
+        return jax.devices()
+    print(
+        f"bench: backend probe failed (timeout {probe_timeout}s); "
+        "falling back to CPU", file=sys.stderr,
+    )
     jax.config.update("jax_platforms", "cpu")
     return jax.devices("cpu")
 
@@ -319,6 +336,113 @@ def _offload_overlap_stats() -> dict:
     }
 
 
+def _decode_itl_under_prefill() -> dict:
+    """Measure decode inter-token latency WHILE a chunked prefill is in
+    flight, fused mixed-batch vs the alternating baseline (ISSUE 3): a
+    steady decode stream runs while long prompts prefill chunk by chunk,
+    and every token-arrival gap that lands during an in-flight prefill
+    is a sample. The alternating scheduler serializes each chunk's
+    dispatch between decode steps, so those gaps absorb the chunk's
+    device time; the fused step dispatches chunk+decode as one forward.
+    Reports p50/p99 per scheduler plus the p99 speedup, so the bench
+    artifact carries the mixed-batch win (or its regression) every
+    round."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    def req(toks, max_tokens):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    def run_one(mixed: bool) -> list:
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=192, block_size=4,
+            max_batch_size=2, max_context=256, prefill_chunk=16,
+            mixed_batch=mixed,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        itl_ms: list = []
+
+        async def decode_stream(base, record):
+            prev = None
+            prev_inflight = False
+            async for _ in engine.generate(
+                Context(req(range(base, base + 8), max_tokens=60))
+            ):
+                now = _time.perf_counter()
+                inflight = engine._prefill_state is not None
+                # a gap counts if a prefill was in flight at EITHER
+                # endpoint: the alternating scheduler clears
+                # _prefill_state when the FINAL chunk completes, before
+                # the next decode token emits — sampling only at arrival
+                # would drop exactly the gap that absorbed that chunk
+                # (and flatter the alternating baseline's p99)
+                if record and prev is not None and (
+                    inflight or prev_inflight
+                ):
+                    itl_ms.append((now - prev) * 1e3)
+                prev = now
+                prev_inflight = inflight
+
+        async def phase(base, prompts, record):
+            before = engine.stats["decode_steps"]
+            t = asyncio.ensure_future(decode_stream(base, record))
+            while engine.stats["decode_steps"] == before:
+                await asyncio.sleep(0.005)
+            # multi-chunk long prompts with distinct tokens (no
+            # prefix-cache hits shrinking the chunk count); max_tokens=1
+            # keeps them out of the decode batch after admission
+            for b in prompts:
+                await collect(engine.generate(
+                    Context(req(range(b, b + 80), max_tokens=1))
+                ))
+            await t
+
+        async def run():
+            # warmup phase: compiles every shape this workload reaches
+            # (prefill buckets, decode step, the fused mixed program) so
+            # the measured gaps are steady-state scheduling, not XLA
+            await phase(10, [300], record=False)
+            await phase(20, [500, 700, 900], record=True)
+            await engine.close()
+
+        asyncio.run(run())
+        return itl_ms
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * p / 100))]
+
+    out = {}
+    for name, mixed in (("alternating", False), ("fused", True)):
+        xs = run_one(mixed)
+        out[name] = (
+            {"p50": round(pct(xs, 50), 3), "p99": round(pct(xs, 99), 3),
+             "n": len(xs)}
+            if xs else {"p50": None, "p99": None, "n": 0}
+        )
+    if out["fused"]["n"] and out["alternating"]["n"]:
+        out["p99_speedup"] = round(
+            out["alternating"]["p99"] / max(out["fused"]["p99"], 1e-9), 3
+        )
+    return {"decode_itl_under_prefill_ms": out}
+
+
 def _ttft_trace_stats() -> dict:
     """Run a handful of traced requests through a tiny engine and report
     the TTFT-decomposition percentiles (ISSUE 2): the bench artifact
@@ -399,10 +523,9 @@ def _ttft_trace_stats() -> dict:
 
 def main() -> None:
     cached = _cached_silicon_result()
-    # with a real silicon number already in hand, one failed probe is
-    # enough to fall back to it — don't burn 6 minutes re-probing a
-    # relay that is known to wedge (round-2 weak #7)
-    devices = _acquire_devices(retries=1 if cached is not None else 3)
+    # one failed probe falls back (memoized) — a wedged relay costs one
+    # timeout whether or not a cached silicon number is in hand
+    devices = _acquire_devices()
 
     import jax
 
@@ -484,6 +607,10 @@ def main() -> None:
         result.update(_ttft_trace_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["ttft_stats_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_decode_itl_under_prefill())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["mixed_batch_stats_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

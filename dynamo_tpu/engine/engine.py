@@ -34,7 +34,7 @@ import numpy as np
 
 from ..models import llama
 from ..models.config import ModelConfig
-from ..ops.sampling import make_keys, sample_tokens
+from ..ops.sampling import make_keys, sample_first_token, sample_tokens
 from ..parallel.mesh import MeshConfig, cache_sharding, make_mesh, shard_params
 from ..protocols.common import (
     FinishReason,
@@ -51,6 +51,11 @@ from .offload import OffloadManager
 logger = logging.getLogger(__name__)
 
 PREFILL_BUCKETS = [16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192]
+
+# the prefill-admission first-token sampler, jitted ONCE at module scope:
+# a per-call ``jax.jit(sample_first_token)`` built a fresh wrapper (and a
+# fresh trace cache) on every admission, so every prefill paid a retrace
+_sample_first_jit = jax.jit(sample_first_token)
 
 
 def _bucket(n: int) -> int:
@@ -83,6 +88,23 @@ class EngineConfig:
     prefill_chunk: int = 2048
     mesh: Optional[MeshConfig] = None
     max_queue: int = 1024
+    # fused mixed prefill+decode batching (Sarathi-style chunked-prefill
+    # piggybacking / ragged paged attention, PAPERS.md): while a chunked
+    # prefill is in flight AND sequences are decoding, each scheduler
+    # iteration dispatches ONE fused step — a budget-bounded prefill
+    # chunk plus a decode token for every active sequence — instead of
+    # alternating a dedicated prefill dispatch with 1-step decode
+    # windows. Decode inter-token latency stops absorbing the chunk's
+    # device time behind a separate dispatch, and the chunk's GEMMs
+    # amortize the weight stream over the decode rows (bench.py
+    # ``decode_itl_under_prefill_ms``). False = the legacy alternating
+    # scheduler (escape hatch + the bench baseline). Multi-host mirrors
+    # and ring-prefill chunks always take the alternating path.
+    mixed_batch: bool = True
+    # prefill tokens per fused mixed step (the Sarathi token budget);
+    # 0 = prefill_chunk. Smaller budgets bound the fused step's device
+    # time (tighter decode ITL) at more steps per prompt.
+    mixed_step_budget: int = 0
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
@@ -187,6 +209,16 @@ class EngineConfig:
             )
         if self.max_context == 0:
             self.max_context = self.model.max_position_embeddings
+        if self.mixed_step_budget < 0:
+            # a negative budget would slice empty chunks: the fused
+            # prefill would never advance and admission behind it would
+            # hang forever — fail loudly at construction instead
+            raise ValueError(
+                f"mixed_step_budget={self.mixed_step_budget} must be >= 0 "
+                "(0 = prefill_chunk)"
+            )
+        if self.mixed_step_budget == 0:
+            self.mixed_step_budget = self.prefill_chunk
         self.max_blocks_per_seq = (
             self.max_context + self.block_size - 1
         ) // self.block_size
@@ -381,6 +413,7 @@ class JaxEngine(AsyncEngine):
             "tokens_generated": 0,
             "prefix_cache_hits_tokens": 0,
             "decode_steps": 0,
+            "mixed_steps": 0,
             "preemptions": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
@@ -518,6 +551,10 @@ class JaxEngine(AsyncEngine):
         if self.offload is not None:
             out.update(self.offload.stats())
         return out | {
+            # mixed-batch fusion activity (prefill chunks riding decode
+            # steps) — lets the router/metrics plane see whether decode
+            # ITL is being shielded from concurrent prefill
+            "mixed_steps": self.stats["mixed_steps"],
             "kv_active_blocks": self.allocator.used_count,
             "kv_total_blocks": self.allocator.num_blocks - 1,
             "gpu_cache_usage_perc": self.allocator.usage(),
@@ -624,9 +661,12 @@ class JaxEngine(AsyncEngine):
             self._place_in_batch(seq)
             admitted = True
         # advance an in-flight chunked prefill by exactly one chunk per
-        # iteration — decode steps for the running batch interleave between
-        # chunks, so a long prompt can't stall token streaming
-        if self._prefill_state is not None:
+        # iteration. With mixed batching OFF (or no decode batch to fuse
+        # into) that's a dedicated prefill dispatch here; when the chunk
+        # can FUSE into the running batch's decode step, _decode_once
+        # dispatches it as one mixed step instead — decode streams never
+        # stall a full chunk's device time behind a separate dispatch
+        if self._prefill_state is not None and not self._mixed_fusable():
             admitted |= await self._prefill_step()
         while (
             self._prefill_state is None
@@ -664,6 +704,9 @@ class JaxEngine(AsyncEngine):
                     LLMEngineOutput(finish_reason=FinishReason.ERROR)
                 )
                 continue
+            if ok and self._mixed_fusable():
+                # first chunk rides the next fused step
+                break
             if not ok:
                 # A sequence whose minimum reservation exceeds the whole
                 # pool can never admit (e.g. preempted late with a grown
@@ -796,17 +839,11 @@ class JaxEngine(AsyncEngine):
         assert st is not None
         seq = st.seq
         if seq.context.is_stopped():
-            self._prefill_state = None
-            self.allocator.free(seq.blocks)
-            seq.blocks = []
             # hand reserved host blocks back even mid-upload (the upload
             # only READS the host arrays, so re-pooling is safe) — same
             # as the error path below; dropping them would leak the
             # cached prefix
-            self._rollback_upload(st)
-            seq.out_queue.put_nowait(
-                LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
-            )
+            self._abort_prefill(st, FinishReason.CANCELLED)
             return False
         # device work (jit dispatch + compile + host sync) runs in a worker
         # thread so lease keepalives / bus traffic stay live on the loop
@@ -819,12 +856,8 @@ class JaxEngine(AsyncEngine):
             # device failure: hand reserved host blocks back so the prefix
             # isn't silently lost from the offload tier (host arrays are
             # never mutated, so re-pooling is safe even mid-upload)
-            self._prefill_state = None
             logger.exception("prefill failed for request %s", seq.context.id)
-            self.allocator.free(seq.blocks)
-            seq.blocks = []
-            self._rollback_upload(st)
-            seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.ERROR))
+            self._abort_prefill(st, FinishReason.ERROR)
             return False
         if first_token is None:
             return False  # more chunks to go
@@ -842,8 +875,29 @@ class JaxEngine(AsyncEngine):
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token, first_lp)
         if not seq.finished:
-            self._place_in_batch(seq)
+            if self._n_active < self.cfg.max_batch_size:
+                self._place_in_batch(seq)
+            else:
+                # slots filled mid-prefill (a remote-ready admission took
+                # the last one): the KV is landed, so queue for the next
+                # free slot exactly like a remotely-prefilled sequence —
+                # an unconditional placement would index(None) on a full
+                # batch and crash the scheduler loop
+                self._remote_ready.append(seq)
         return True
+
+    def _abort_prefill(self, st: "_PrefillState", reason: FinishReason) -> None:
+        """The one teardown for an in-flight prefill — cancellation AND
+        device failure, alternating AND mixed paths: drop the state,
+        free the sequence's blocks, hand the reserved host chain back
+        (_rollback_upload), and terminate the stream. Four call sites
+        share it so the rollback protocol cannot drift between them."""
+        seq = st.seq
+        self._prefill_state = None
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        self._rollback_upload(st)
+        seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=reason))
 
     def _rollback_upload(self, st: _PrefillState) -> None:
         """Shared cancel/error rollback for a prefill's reserved host
@@ -1040,13 +1094,11 @@ class JaxEngine(AsyncEngine):
                     "top": [[int(i), float(row[i])] for i in top],
                 }
             return token, entry
-        from ..ops.sampling import sample_first_token
-
         keys = make_keys(
             jnp.asarray([(so.seed or 0) & 0x7FFFFFFF]),
             jnp.asarray([seq.generated]),
         )
-        tok = jax.jit(sample_first_token)(
+        tok = _sample_first_jit(
             logits[None, :],
             keys,
             jnp.asarray([temp], jnp.float32),
@@ -1291,16 +1343,36 @@ class JaxEngine(AsyncEngine):
 
     # ---- decode ----
 
+    def _mixed_fusable(self) -> bool:
+        """Can the in-flight prefill's next chunk fuse into a decode
+        step? Needs the mixed-batch path on, a decode batch to ride
+        along, no multi-host mirror (the fused step is not a broadcast
+        op — mirrored engines keep the alternating scheduler), and a
+        chunk that isn't routed through sp ring attention."""
+        st = self._prefill_state
+        return (
+            self.cfg.mixed_batch
+            and st is not None
+            and self.mirror is None
+            and self._n_active > 0
+            and not self._ring_chunk(st.seq, st.pos)
+        )
+
     def _pick_window(self) -> int:
         """Fused steps for the next dispatch: 1 while *actionable* admission
         work is pending (a long window would delay waiting requests), else
         the largest power of two within every active sequence's remaining
         stop/context headroom. Waiting requests that CANNOT admit right now
         (pool backpressure, batch full) don't disable fusion — that would
-        reintroduce the per-token host sync exactly under load."""
+        reintroduce the per-token host sync exactly under load. An
+        in-flight prefill whose chunks fuse into mixed steps is not
+        actionable admission work either (it advances WITH the decode
+        steps), so it no longer collapses the window — though mixed
+        dispatch itself never consults this (a fused step is inherently
+        one decode step per chunk)."""
         batch_full = self._n_active >= self.cfg.max_batch_size
         actionable = (
-            self._prefill_state is not None
+            (self._prefill_state is not None and not self._mixed_fusable())
             or (not self._waiting_is_empty() and not batch_full
                 and not self._backpressured)
             or (bool(self._remote_ready) and not batch_full)
@@ -1349,8 +1421,41 @@ class JaxEngine(AsyncEngine):
         cand = [s for s in self._active if s is not None and not s.finished]
         return max(cand, key=lambda s: s.arrival_t) if cand else None
 
+    def _evict_for_headroom(self, seq: _Sequence) -> bool:
+        """Pool exhausted while growing ``seq``'s blocks: preempt the
+        youngest active sequence — possibly ``seq`` itself — or, when
+        nothing else is left to evict, LENGTH-finish ``seq`` (the pool
+        cannot hold even one sequence at this length). ONE policy shared
+        by the window and mixed dispatch paths so victim selection can't
+        drift between them. Returns True when ``seq`` itself was removed
+        (caller stops growing it)."""
+        victim = self._youngest_active()
+        if victim is seq or victim is None:
+            if self._n_active <= 1:
+                logger.warning(
+                    "KV pool too small for request %s at %d tokens",
+                    getattr(seq.context, "id", "?"), seq.seq_len,
+                )
+                self._finish(seq, FinishReason.LENGTH)
+            else:
+                self._preempt(seq)
+            return True
+        self._preempt(victim)
+        return False
+
     async def _decode_once(self) -> None:
         cfg = self.cfg
+        if self._mixed_fusable():
+            # chunked prefill fuses into this iteration's decode step: a
+            # pipelined window can't chain across the membership change a
+            # completing prefill brings, so drain first (cheap — mixed
+            # phases force 1-step windows anyway)
+            await self._drain_inflight()
+            if self._n_active == 0:
+                return
+            if self._mixed_fusable():
+                await self._mixed_step_once()
+                return
         n = self._pick_window()
         # tokens already written/writing on device for an undrained window
         pending = self._inflight["n"] if self._inflight else 0
@@ -1405,20 +1510,8 @@ class JaxEngine(AsyncEngine):
                     continue
                 # pool exhausted: preempt the youngest running sequence
                 # (possibly this one) instead of truncating output
-                victim = self._youngest_active()
-                if victim is seq or victim is None:
-                    if self._n_active <= 1:
-                        # nothing left to evict — the pool cannot hold even
-                        # one sequence at this length
-                        logger.warning(
-                            "KV pool too small for request %s at %d tokens",
-                            getattr(seq.context, "id", "?"), seq.seq_len,
-                        )
-                        self._finish(seq, FinishReason.LENGTH)
-                    else:
-                        self._preempt(seq)
+                if self._evict_for_headroom(seq):
                     break
-                self._preempt(victim)
         if self._n_active == 0:
             await self._drain_inflight()
             return
@@ -1635,6 +1728,206 @@ class JaxEngine(AsyncEngine):
             self._last_tokens[i] = seq.tokens[-1]
             self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
         return True
+
+    async def _mixed_step_once(self) -> None:
+        """ONE fused mixed step: a ``mixed_step_budget``-bounded chunk of
+        the in-flight prefill AND one decode token for every active
+        sequence, in a single device dispatch (llama.mixed_step). The
+        decode side behaves exactly like a 1-step window (same commit
+        horizon / emission / preemption rules); the prefill side advances
+        like a `_prefill_step` chunk (same cancel/error rollback, same
+        ``engine.prefill`` span accounting — the fused dispatch's device
+        time lands on the prefill component, since the chunk dominates
+        it, so decode ITL stops absorbing chunk time)."""
+        cfg = self.cfg
+        st = self._prefill_state
+        seq_p = st.seq
+        if seq_p.context.is_stopped():
+            self._abort_prefill(st, FinishReason.CANCELLED)
+            return
+        # provision one decode token per active sequence (no window is in
+        # flight here — _decode_once drained before calling)
+        for seq in list(self._active):
+            if seq is None or seq.finished or seq.slot < 0:
+                continue
+            if seq.context.is_stopped():
+                self._finish(seq, FinishReason.CANCELLED)
+                continue
+            while (
+                seq.seq_len + 1 > len(seq.blocks) * cfg.block_size
+                and seq.slot >= 0
+                and not seq.finished
+            ):
+                if len(seq.blocks) >= cfg.max_blocks_per_seq:
+                    self._finish(seq, FinishReason.LENGTH)
+                    break
+                extra = self.allocator.allocate(1)
+                if extra is not None:
+                    seq.blocks.extend(extra)
+                    self._block_tables[seq.slot] = self._table_for(seq)
+                    continue
+                if self._evict_for_headroom(seq):
+                    break
+        if self._n_active == 0:
+            return  # next iteration advances the prefill alone
+        steps = np.asarray(
+            [self._active[i].generated if self._active[i] else 0
+             for i in range(cfg.max_batch_size)],
+            np.int32,
+        )
+        try:
+            async with self._device_lock:
+                toks, lps, first = await (
+                    asyncio.get_running_loop().run_in_executor(
+                        None, self._dispatch_mixed, st, steps
+                    )
+                )
+        except Exception:  # noqa: BLE001
+            # fail the PREFILL request alone (lowering/compile failures
+            # leave the donated caches intact; the decode rows simply
+            # didn't advance and retry next iteration on the plain path)
+            logger.exception(
+                "mixed prefill step failed for request %s", seq_p.context.id
+            )
+            self._abort_prefill(st, FinishReason.ERROR)
+            return
+        self.stats["decode_steps"] += 1
+        self.stats["mixed_steps"] += 1
+        # decode emission: exactly a drained 1-step window
+        for i, seq in list(enumerate(self._active)):
+            if seq is None or seq.finished:
+                continue
+            entry = None
+            k = int(self._logprob_ks[i])
+            if lps is not None and k >= 0:
+                chosen, top_ids, top_lps = lps
+                entry = {
+                    "logprob": float(chosen[i]),
+                    "top": [
+                        [int(top_ids[i, j]), float(top_lps[i, j])]
+                        for j in range(k)
+                    ],
+                }
+            self._emit_token(seq, int(toks[i]), entry)
+            if seq.finished or self._active[i] is not seq:
+                continue
+            self._seq_lens[i] = seq.seq_len
+            self._last_tokens[i] = seq.tokens[-1]
+            self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
+        if first is None:
+            return  # more chunks to go
+        first_token, first_lp = first
+        if seq_p.trace is not None and seq_p.generated == 0:
+            tracing.RECORDER.record_span(
+                "engine.prefill", seq_p.trace, ts=st.t0_wall,
+                dur_ms=st.dev_ms,
+                request_id=seq_p.context.id,
+                prompt_tokens=seq_p.prompt_len,
+                cached_prefix=seq_p.cached_prefix,
+            )
+        self._prefill_state = None
+        self._commit_full_blocks(seq_p)
+        self._emit_token(seq_p, first_token, first_lp)
+        if not seq_p.finished:
+            if self._n_active < cfg.max_batch_size:
+                self._place_in_batch(seq_p)
+            else:
+                # slots filled mid-prefill (remote-ready admissions):
+                # the KV is landed, so queue for the next free slot
+                # exactly like a remotely-prefilled sequence
+                self._remote_ready.append(seq_p)
+
+    def _dispatch_mixed(self, st: "_PrefillState", steps: np.ndarray):
+        """Executor thread: the fused mixed dispatch. Returns
+        (decode_tokens [B] np, logprob arrays or None, and — on the
+        final chunk — the prefill's sampled (first_token, lp_entry))."""
+        cfg = self.cfg
+        seq_p = st.seq
+        # provisioning invariant (loud, not silent — the same check the
+        # window dispatch makes): every active sequence must have a block
+        # for this step's token, or its write would scatter through zero
+        # table entries into reserved page 0 as silent garbage
+        for seq in self._active:
+            if seq is None or seq.finished or seq.slot < 0:
+                continue
+            if seq.seq_len + 1 > len(seq.blocks) * cfg.block_size:
+                raise RuntimeError(
+                    f"mixed step exceeds provisioned blocks for request "
+                    f"{getattr(seq.context, 'id', '?')} "
+                    f"(seq_len={seq.seq_len}, blocks={len(seq.blocks)})"
+                )
+        t0 = time.perf_counter()
+        try:
+            self._offload_preamble(
+                st.upload if not st.restored else None, seq=seq_p
+            )
+            st.restored = True
+            chunk = seq_p.tokens[st.pos : st.pos + cfg.mixed_step_budget]
+            T = _bucket(len(chunk))
+            toks_p = np.zeros(T, np.int32)
+            toks_p[: len(chunk)] = chunk
+            positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
+            penalized = self._penalties_active()
+            want_lp = self._logprobs_active()
+            kwargs = {}
+            if penalized:
+                kwargs.update(
+                    freq_pens=jnp.asarray(self._freq_pens),
+                    pres_pens=jnp.asarray(self._pres_pens),
+                    rep_pens=jnp.asarray(self._rep_pens),
+                    counts=self._pen_counts,
+                    prompt_mask=self._pen_mask,
+                )
+            out = self._pallas_guard(lambda: llama.mixed_step(
+                self.params,
+                cfg.model,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(positions),
+                jnp.asarray(self._block_tables),
+                jnp.asarray(self._seq_lens),
+                jnp.asarray(self._seeds),
+                jnp.asarray(steps),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps),
+                jnp.asarray(toks_p),
+                jnp.asarray(self._table_for(seq_p)),
+                jnp.int32(st.pos),
+                jnp.int32(len(chunk)),
+                self.k_cache,
+                self.v_cache,
+                use_pallas=self.use_pallas,
+                mesh=self.mesh,
+                # the decode part must mirror this engine's own
+                # decode_window structure or the XLA branch's bit-exact
+                # contract breaks
+                unroll=not cfg.decode_layer_scan,
+                merged=cfg.decode_merged,
+                with_logprobs=want_lp,
+                **kwargs,
+            ))
+            toks, p_logits, self.k_cache, self.v_cache = out[:4]
+            rest = list(out[4:])
+            if penalized:
+                self._pen_counts = rest.pop(0)
+            lps_dev = rest.pop(0) if want_lp else None
+            st.pos += len(chunk)
+            first = None
+            if st.pos >= len(seq_p.tokens):
+                first = self._sample_prefill(seq_p, p_logits)
+            toks_host = np.asarray(jax.device_get(toks))
+            lps = (
+                tuple(np.asarray(jax.device_get(a)) for a in lps_dev)
+                if lps_dev is not None else None
+            )
+            return toks_host, lps, first
+        finally:
+            # the fused dispatch's device time lands on the traced
+            # prefill component (the chunk dominates it; attributing the
+            # decode row share too slightly overcounts prefill but keeps
+            # decode ITL honest — the span decode streams no longer wait
+            # on)
+            st.dev_ms += (time.perf_counter() - t0) * 1e3
 
     def _pallas_guard(self, thunk):
         """Run a device dispatch; if Mosaic rejects a kernel at its

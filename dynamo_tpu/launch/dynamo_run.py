@@ -269,6 +269,8 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         decode_pipeline=args.decode_pipeline,
         spec_gamma=args.spec_gamma,
         spec_ngram=args.spec_ngram,
+        mixed_batch=not args.no_mixed_batch,
+        mixed_step_budget=args.mixed_step_budget,
     )
 
 
@@ -705,6 +707,12 @@ def main(argv=None) -> None:
                    help="fused decode steps per device dispatch")
     p.add_argument("--decode-pipeline", action="store_true",
                    help="overlap host work with the next decode window")
+    p.add_argument("--no-mixed-batch", action="store_true",
+                   help="disable fused mixed prefill+decode steps (fall "
+                        "back to the alternating chunk/window scheduler)")
+    p.add_argument("--mixed-step-budget", type=int, default=0,
+                   help="prefill tokens per fused mixed step "
+                        "(0 = prefill_chunk)")
     p.add_argument("--spec-gamma", type=int, default=0,
                    help="speculative decoding: proposals per verify (0=off)")
     p.add_argument("--spec-ngram", type=int, default=3,

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import attention as att
+from ..ops._pallas_compat import shard_map
 from .config import ModelConfig, yarn_mscale
 
 
@@ -748,7 +749,7 @@ def _moe_ragged_sharded(lp: dict, cfg: ModelConfig, x: jnp.ndarray, mesh,
 
     wg, wu, wd = lp["we_gate"], lp["we_up"], lp["we_down"]
     Fm = (wg["q"] if isinstance(wg, dict) else wg).shape[-1]
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -1377,6 +1378,223 @@ def decode_window(
     lps = ys[1:] if with_logprobs else None
     out = (toks, k_cache, v_cache)
     return out + (lps,) if with_logprobs else out
+
+
+# ---------------- fused mixed prefill+decode step ----------------
+
+
+def _mixed_fused_forward(
+    params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+    p_tokens, p_table, p_hist, p_valid, k_cache, v_cache,
+    mesh=None, interpret=False,
+):
+    """The FULLY-fused mixed forward (TPU/Pallas path): embeddings and
+    every projection/FFN/logits GEMM run over the combined [B + T] row
+    axis — the weight stream amortizes across the decode rows and the
+    chunk (the mixed-batch MFU win) — and attention is ONE ragged
+    paged-attention kernel invocation per layer covering both parts
+    (ops/ragged_paged_attention_pallas). Write-before-attend throughout.
+
+    Combined-row GEMMs reassociate reductions vs the unfused [B]- and
+    [T]-row programs, so this path matches them only to kernel-grade
+    tolerance (greedy streams preserved except at exact logit ties —
+    the same contract as the Pallas-vs-XLA kernel pairs and spec
+    decode). The bit-exact twin for the XLA path lives in mixed_step's
+    other branch. GQA families only; MLA and softcap models take the
+    per-part branch.
+
+    Returns (decode_logits [B, V] f32, p_logits [V] f32, k_cache,
+    v_cache).
+    """
+    from ..ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention,
+        ragged_mixed_attention_sharded,
+    )
+
+    B = d_tokens.shape[0]
+    T = p_tokens.shape[0]
+    x = _embed(params, cfg, jnp.concatenate([d_tokens, p_tokens]))  # [B+T, E]
+    p_positions = p_hist + jnp.arange(T)
+    positions_all = jnp.concatenate([d_positions, p_positions])
+    inv_freq = _rope_freqs(cfg)
+    rope_msc = _rope_attention_scaling(cfg)
+    scale = attn_query_scale(cfg)
+    inv_local = _rope_freqs_local(cfg)
+
+    def layer_tail(x, lp, o_flat):
+        x = x + post_norm(lp, "attn_post_norm",
+                          _mm_b(o_flat, lp, "wo", "bo"), cfg)
+        h = pre_norm(lp, "mlp_norm", x, cfg)
+        return x + post_norm(
+            lp, "mlp_post_norm",
+            _ffn(lp, cfg, h, mesh=mesh, use_pallas=True,
+                 interpret=interpret), cfg,
+        )
+
+    # UNROLLED layer loop (per-layer windows / local rope stay
+    # trace-static; program count bounded by the prefill buckets)
+    for lps, n, goff in layer_groups(params, cfg):
+        for li in range(n):
+            l = goff + li
+            lp = jax.tree.map(lambda a: a[li], lps)
+            h = pre_norm(lp, "attn_norm", x, cfg)
+            w = window_for_layer(cfg, l)
+            kc_l, vc_l = k_cache[l], v_cache[l]
+            q, k, v = _qkv(lp, cfg, h)  # [B+T, H/Hkv, D]
+            fr = rope_freqs_for_layer(cfg, l, inv_freq, inv_local)
+            q = apply_rope(q, positions_all, fr, rope_msc)
+            k = apply_rope(k, positions_all, fr, rope_msc)
+            # write-before-attend for BOTH parts (distinct pages: the
+            # prefill sequence is not in the decode batch; padded chunk
+            # rows land in reserved trash page 0)
+            kc_l = att.write_decode_token_to_cache(
+                kc_l, k[:B], d_tables, d_positions
+            )
+            vc_l = att.write_decode_token_to_cache(
+                vc_l, v[:B], d_tables, d_positions
+            )
+            kc_l = att.write_chunk_to_cache(kc_l, k[B:], p_table, p_hist)
+            vc_l = att.write_chunk_to_cache(vc_l, v[B:], p_table, p_hist)
+            if mesh is not None:
+                o_dec, o_chunk = ragged_mixed_attention_sharded(
+                    q[:B], q[B:], kc_l, vc_l, d_tables, d_seq_lens,
+                    p_table, p_hist, p_valid, scale, mesh, window=w,
+                    sinks=lp.get("sinks"), interpret=interpret,
+                )
+            else:
+                o_dec, o_chunk = ragged_mixed_attention(
+                    q[:B], q[B:], kc_l, vc_l, d_tables, d_seq_lens,
+                    p_table, p_hist, p_valid, scale, window=w,
+                    sinks=lp.get("sinks"), interpret=interpret,
+                )
+            k_cache = k_cache.at[l].set(kc_l)
+            v_cache = v_cache.at[l].set(vc_l)
+            o = jnp.concatenate(
+                [o_dec.reshape(B, -1), o_chunk.reshape(T, -1)]
+            )
+            x = layer_tail(x, lp, o)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits_d = _logits(params, cfg, x[:B])  # [B, V] f32
+    # the chunk's last REAL row only (the unfused prefill computes the
+    # same single row — a full [T, V] head matmul would be pure waste)
+    last = B + jnp.clip(p_valid - 1, 0, T - 1)
+    p_logits = _logits(params, cfg, x[last])  # [V] f32
+    return logits_d, p_logits, k_cache, v_cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_pallas", "mesh", "unroll", "merged",
+                     "interpret", "with_logprobs"),
+    donate_argnames=("k_cache", "v_cache", "counts"),
+)
+def mixed_step(
+    params: dict,
+    cfg: ModelConfig,
+    # decode side (same conventions as decode_window at n_steps=1)
+    d_tokens: jnp.ndarray,  # [B] last sampled token per sequence
+    d_positions: jnp.ndarray,  # [B] absolute position of that token
+    d_tables: jnp.ndarray,  # [B, M]
+    d_seq_lens: jnp.ndarray,  # [B] length including the new token
+    seeds: jnp.ndarray,  # [B] int32 sampling seeds
+    steps: jnp.ndarray,  # [B] int32 per-request generation counters
+    temps: jnp.ndarray,  # [B] float32
+    top_ks: jnp.ndarray,  # [B] int32
+    top_ps: jnp.ndarray,  # [B] float32
+    # prefill side (same conventions as prefill's chunk args)
+    p_tokens: jnp.ndarray,  # [T] padded chunk of the in-flight prompt
+    p_table: jnp.ndarray,  # [M] the prefill sequence's block table
+    p_hist: jnp.ndarray,  # scalar int32: tokens already cached
+    p_valid: jnp.ndarray,  # scalar int32: real tokens in this chunk
+    k_cache: jnp.ndarray,  # donated
+    v_cache: jnp.ndarray,
+    use_pallas: bool = False,
+    mesh=None,
+    unroll: bool = True,
+    merged: bool = True,
+    interpret: bool = False,
+    # sampling penalties (compiled in only when some request asks)
+    freq_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    pres_pens: Optional[jnp.ndarray] = None,  # [B] f32
+    rep_pens: Optional[jnp.ndarray] = None,  # [B] f32 (1.0 = off)
+    counts: Optional[jnp.ndarray] = None,  # [B, V] i32, donated
+    prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
+    with_logprobs: bool = False,
+):
+    """ONE device dispatch fusing a prefill chunk into a decode step.
+
+    Two forward flavors behind one dispatch boundary:
+
+      * **Pallas (TPU) path** — `_mixed_fused_forward`: combined-row
+        GEMMs + one ragged paged-attention kernel invocation per layer
+        (the full mixed-batch MFU win). Matches the unfused paths to
+        kernel-grade tolerance; greedy streams preserved except at
+        exact logit ties — the standing contract for every
+        Pallas-vs-XLA pairing in this repo. MLA and softcap families on
+        this path fall through to the per-part flavor below (MLA's
+        latent decode+prefill kernel pair runs inside the same
+        dispatch; there is no latent ragged kernel yet).
+      * **XLA path** (CPU, quantized-KV, softcap) — per-part structural
+        identity: the chunk runs through EXACTLY the unfused prefill
+        forward (``prefill.__wrapped__``: same scan/unrolled layer
+        loop, same [T]-row GEMMs) and the decode batch through EXACTLY
+        ``_decode_body`` with the engine's own ``unroll``/``merged``
+        flags — so tokens AND logprobs are BIT-IDENTICAL to the
+        alternating scheduler (the tests/test_mixed_batch.py contract;
+        restructured GEMMs would reassociate bf16 reductions and flip
+        sampled tokens). The two parts are computationally independent
+        (the prefill sequence is not in the decode batch; disjoint
+        pages), so fusing them into one program cannot change either.
+
+    Sampling mirrors decode_window's body exactly (penalties on the
+    sampled distribution, raw logits for reported logprobs).
+
+    Returns (next_tokens [B], p_logits [V] f32 — the chunk's
+    last-real-row logits, for host-side first-token sampling on the
+    final chunk —, k_cache, v_cache[, counts]
+    [, (chosen_lp [B], top_ids [B, K], top_lps [B, K])]).
+    """
+    from ..ops.sampling import (
+        apply_penalties,
+        bump_counts,
+        make_keys,
+        sample_tokens,
+        token_logprobs,
+    )
+
+    if use_pallas and not cfg.is_mla and not cfg.attn_softcap:
+        logits_d, p_logits, k_cache, v_cache = _mixed_fused_forward(
+            params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+            p_tokens, p_table, p_hist, p_valid, k_cache, v_cache,
+            mesh=mesh, interpret=interpret,
+        )
+    else:
+        # chunk first, then decode — order is numerically irrelevant
+        # (independent parts) and matches the admission-then-decode
+        # order of the alternating scheduler
+        p_logits, k_cache, v_cache = prefill.__wrapped__(
+            params, cfg, p_tokens, p_table, p_hist, p_valid,
+            k_cache, v_cache, use_pallas=use_pallas, mesh=mesh,
+        )
+        logits_d, k_cache, v_cache = _decode_body(
+            params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+            k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
+        )
+
+    raw_logits = logits_d
+    penalized = counts is not None
+    if penalized:
+        logits_d = apply_penalties(
+            logits_d, counts, prompt_mask, freq_pens, pres_pens, rep_pens
+        )
+    keys = make_keys(seeds, steps)
+    nxt = sample_tokens.__wrapped__(logits_d, keys, temps, top_ks, top_ps)
+    result = [nxt, p_logits, k_cache, v_cache]
+    if penalized:
+        result.append(bump_counts(counts, nxt))
+    if with_logprobs:
+        result.append(token_logprobs(raw_logits, nxt))
+    return tuple(result)
 
 
 # ---------------- speculative verify (prompt-lookup decoding) ----------------

@@ -1,1 +1,3 @@
-"""TPU compute ops: paged attention, sampling, KV block copies."""
+"""TPU compute ops: paged attention (decode, chunked prefill, and the
+ragged MIXED prefill+decode kernel behind the engine's fused batching —
+ragged_paged_attention_pallas), sampling, KV block copies."""

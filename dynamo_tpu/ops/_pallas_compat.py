@@ -1,14 +1,32 @@
-"""Version shims for the Pallas TPU surface.
+"""Version shims for the Pallas TPU / sharding surface.
 
 jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``
 across releases; every kernel in this package imports the alias from
 here so the whole family traces on either toolchain (0.4.x ships only
 the old spelling, newer trees only the new one).
+
+``shard_map`` moved the other way: 0.4.x ships it only as
+``jax.experimental.shard_map.shard_map`` (with ``check_rep``), newer
+trees as ``jax.shard_map`` (with ``check_vma``). Every shard_map call
+in the repo goes through the alias below so both spellings work.
 """
 
 from __future__ import annotations
 
+import jax
 from jax.experimental.pallas import tpu as pltpu
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # 0.4.x: experimental spelling; check_vma was called check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
 
 def _missing(*_a, **_k):  # pragma: no cover - depends on jax build
     raise ImportError(

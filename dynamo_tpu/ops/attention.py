@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._pallas_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -57,7 +59,7 @@ def _shard_tp(mesh, local_fn, *, arr_specs, arrs, k_cache_layer,
     if sinks is not None:
         in_specs += (P("tp"),)
         operands += (sinks,)
-    return jax.shard_map(
+    return shard_map(
         local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
         check_vma=False,
     )(*operands)

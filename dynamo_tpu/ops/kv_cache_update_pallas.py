@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._pallas_compat import CompilerParams as _CompilerParams
+from ._pallas_compat import shard_map
 
 
 def _append_kernel(
@@ -98,7 +99,7 @@ def kv_cache_append_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         functools.partial(_append_call, interpret=interpret),
         mesh=mesh,
         in_specs=(
@@ -136,7 +137,7 @@ def kv_cache_append_replicated(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         _ft.partial(_append_call, interpret=interpret),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(), P()),
@@ -280,7 +281,7 @@ def kv_cache_append_tokens_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         _ft.partial(kv_cache_append_tokens, interpret=interpret),
         mesh=mesh,
         in_specs=(

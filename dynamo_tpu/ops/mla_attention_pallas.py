@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._pallas_compat import CompilerParams as _CompilerParams
+from ._pallas_compat import shard_map
 
 # one superblock-sizing policy for every paged kernel (GQA and MLA pick
 # the same page pipeline for the same block table)
@@ -235,7 +236,7 @@ def mla_paged_decode_attention_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         partial(mla_paged_decode_attention, scale=scale,
                 interpret=interpret),
         mesh=mesh,
@@ -490,7 +491,7 @@ def mla_paged_prefill_attention_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         partial(mla_paged_prefill_attention, scale=scale,
                 interpret=interpret),
         mesh=mesh,
@@ -607,7 +608,7 @@ def mla_decode_attention_merged_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         partial(mla_decode_attention_merged, scale=scale,
                 interpret=interpret),
         mesh=mesh,

@@ -1,0 +1,376 @@
+"""Pallas TPU kernel: ragged paged attention for MIXED prefill+decode.
+
+The engine's mixed-batch step (engine/engine.py `_mixed_step_once` →
+models/llama.mixed_step) fuses one chunked-prefill segment into the same
+device dispatch as a decode step for every active sequence, so decode
+streams stop stalling behind prefill chunks (the Sarathi/\"Ragged Paged
+Attention\" mixed-batch scheduling — PAPERS.md). This module is that
+step's attention: ONE kernel invocation computes
+
+  * B decode rows — one query token per sequence, each against its own
+    block table and sequence length, and
+  * one prefill segment — up to a per-step token budget of chunk query
+    rows against the prefill sequence's history plus the causal prefix
+    of the chunk itself,
+
+with per-row query positions, causal masking, per-row sliding-window
+floors, and the gpt-oss sink fold, all in a single grid.
+
+Design — a strict generalization of the two existing kernels
+(paged_attention_pallas._decode_kernel / _prefill_kernel), reusing their
+row/group mapping (row r of a tile is token t = r // group, head
+g = r % group):
+
+  * everything is write-before-attend: the caller has already scattered
+    the decode tokens' K/V and the chunk's K/V into the paged cache, so
+    every query row attends PURELY through block tables and the mask is
+    uniform — ``kv_pos <= q_pos`` (plus the window floor). One mask rule
+    covers history, chunk-causal, and the decode self-row.
+  * grid = (tiles, kv_heads, superblocks). The tile axis is ragged over
+    SEQUENCES: tiles 0..B-1 are the decode rows (one real token each,
+    padded to the uniform ``q_tile`` tokens; the padding rows compute
+    garbage that is sliced off — their page DMAs are shared with the
+    real row, so the waste is compute the DMA-bound step hides), tiles
+    B.. are the prefill chunk in ``q_tile``-token slices.
+  * scalar-prefetched per-tile metadata (`tile_q0`, `tile_last_q`) and
+    the stacked block tables ([B+1, M]; row B is the prefill sequence)
+    let each page stream's ``index_map`` fetch exactly the physical
+    pages the tile's own sequence needs; pages past a tile's causal
+    horizon re-map to its last needed page (consecutive identical
+    indices skip the re-fetch, the same trick as the parent kernels).
+  * fp32 online softmax in VMEM scratch; output written once on the
+    final superblock, with the sink logit folded into the normalizer
+    there (per-row head via the relayout-free one-hot dot).
+
+Interpret mode (CPU tests) runs the same kernel body under the Pallas
+interpreter — the exactness tests in tests/test_mixed_batch.py pin it
+against the XLA decode/chunk attention pair.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from ._pallas_compat import CompilerParams as _CompilerParams
+from ._pallas_compat import shard_map
+
+_NEG_INF = -1e30
+
+
+def _pick_pages_per_step(M: int, cap: int = 8) -> int:
+    p = 1
+    while p * 2 <= cap and M % (p * 2) == 0:
+        p *= 2
+    return p
+
+
+def _mixed_kernel(
+    # scalar prefetch
+    tables_ref,  # [B+1, M] int32 (SMEM): decode tables + prefill table
+    q0_ref,  # [S] int32: tile row 0's absolute query position
+    lastq_ref,  # [S] int32: tile's last REAL query position (-1 = all pad)
+    # inputs: q then P k-page refs then P v-page refs [then sinks]
+    *refs,
+    scale: float,
+    block_size: int,
+    group: int,  # Gp: padded query heads per kv head
+    pages_per_step: int,
+    window: int = 0,  # sliding attention; 0 = full
+    has_sinks: bool = False,
+):
+    Pp = pages_per_step
+    q_ref = refs[0]  # [1, Tq*Gp, D]
+    k_refs = refs[1 : 1 + Pp]  # each [1, 1, bs, D]
+    v_refs = refs[1 + Pp : 1 + 2 * Pp]
+    n_in = 1 + 2 * Pp + int(has_sinks)
+    sink_ref = refs[1 + 2 * Pp] if has_sinks else None  # [1, Gp, 128]
+    o_ref = refs[n_in]  # [1, Tq*Gp, D]
+    m_scr, l_scr, acc_scr = refs[n_in + 1 :]
+
+    s_tile = pl.program_id(0)
+    i = pl.program_id(2)  # kv superblock (innermost: sequential accumulation)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q0 = q0_ref[s_tile]
+    last_q = lastq_ref[s_tile]
+    start = i * (Pp * block_size)
+    # causal upper bound over the tile's REAL rows; all-padding tiles
+    # (last_q == -1) never enter a superblock and emit zeros
+    in_range = start <= last_q
+    if window > 0:
+        # row 0's window floor is the tile MINIMUM (later rows only see
+        # more); per-row exactness is enforced in the score mask
+        in_range &= start + Pp * block_size > q0 - window + 1
+
+    @pl.when(in_range)
+    def _superblock():
+        q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
+        k = jnp.concatenate(
+            [r[0, 0] for r in k_refs], axis=0
+        ).astype(jnp.float32)  # [P*bs, D]
+        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+            jnp.float32
+        )
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Tq*Gp, P*bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = q0 + rows // group
+        kv_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # write-before-attend: every position up to the row's own is
+        # valid (history, chunk-causal prefix, and the decode self-row
+        # all reduce to this one rule)
+        keep = kv_pos <= q_pos
+        if window > 0:
+            keep &= (q_pos - kv_pos) < window
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _emit():
+        l = l_scr[:, 0:1]
+        if has_sinks:
+            # sink joins the normalizer: l' = l*exp(m-m_f) + exp(s-m_f);
+            # row r's sink is head g = r % Gp, selected with a one-hot
+            # dot (gather/relayout-free in Mosaic)
+            n_rows = l_scr.shape[0]
+            g_of_row = jax.lax.broadcasted_iota(
+                jnp.int32, (n_rows, group), 0
+            ) % group
+            col = jax.lax.broadcasted_iota(jnp.int32, (n_rows, group), 1)
+            oh = (col == g_of_row).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                oh, sink_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[:, 0:1]
+            m = m_scr[:, 0:1]
+            m_f = jnp.maximum(m, s)
+            l = l * jnp.exp(m - m_f) + jnp.exp(s - m_f)
+            acc = acc_scr[...] * jnp.exp(m - m_f)
+        else:
+            acc = acc_scr[...]
+        l = jnp.maximum(l, 1e-20)
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "scale", "q_tile", "pages_per_step", "window", "interpret"
+    ),
+)
+def ragged_mixed_attention(
+    q_dec: jnp.ndarray,  # [B, H, D] decode queries (token ALREADY written)
+    q_chunk: jnp.ndarray,  # [T, H, D] chunk queries (chunk ALREADY written)
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D]
+    v_cache_layer: jnp.ndarray,
+    d_tables: jnp.ndarray,  # [B, M] int32 decode block tables
+    d_seq_lens: jnp.ndarray,  # [B] int32, INCLUDING the new token
+    p_table: jnp.ndarray,  # [M] int32 the prefill sequence's table
+    p_hist: jnp.ndarray,  # scalar int32: tokens cached before this chunk
+    p_valid: jnp.ndarray,  # scalar int32: real tokens in this chunk
+    scale: float,
+    q_tile: int = 0,  # 0 -> min(128, T); must divide T
+    pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
+    window: int = 0,  # sliding attention width; 0 = full
+    sinks: jnp.ndarray | None = None,  # [H] gpt-oss sink logits
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:  # (o_dec [B, H, D], o_chunk [T, H, D])
+    """One kernel invocation over B decode rows + one prefill segment.
+
+    Both parts must be write-before-attend (K/V for the decode tokens AND
+    the chunk scattered into the cache first); every row then attends
+    ``kv_pos <= q_pos`` through its sequence's block table. Decode row b
+    sits at q_pos = d_seq_lens[b]-1; chunk row t at p_hist + t. Inactive
+    decode slots (seq_len 0) and padded chunk rows emit zeros/garbage the
+    caller slices off — their superblocks are skipped entirely.
+    """
+    B, H, D = q_dec.shape
+    T = q_chunk.shape[0]
+    Hkv, N, bs, _ = k_cache_layer.shape
+    M = d_tables.shape[1]
+    assert p_table.shape[0] == M, "decode and prefill tables must share M"
+    G = H // Hkv
+    Gp = max(8, -(-G // 8) * 8)
+    Tq = q_tile or min(128, T)
+    if T % Tq:
+        raise ValueError(f"q_tile={Tq} must divide chunk length T={T}")
+    nT = T // Tq
+    S = B + nT  # ragged tile axis: B decode tiles + nT chunk tiles
+    Pp = pages_per_step or _pick_pages_per_step(M)
+    if M % Pp:
+        raise ValueError(
+            f"pages_per_step={Pp} must divide table width M={M} "
+            "(a truncated grid would silently drop tail pages)"
+        )
+
+    # ---- pack queries: [Hkv, S*Tq*Gp, D], rows (t, g) lexicographic ----
+    # decode tiles: real row at t=0 only; rows t>0 are padding whose
+    # output is sliced off (their page DMAs are shared with row 0)
+    qd = q_dec.reshape(B, 1, Hkv, G, D)
+    qd = jnp.pad(
+        qd, ((0, 0), (0, Tq - 1), (0, 0), (0, Gp - G), (0, 0))
+    )  # [B, Tq, Hkv, Gp, D]
+    qp = q_chunk.reshape(T, Hkv, G, D)
+    qp = jnp.pad(qp, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    qp = qp.reshape(nT, Tq, Hkv, Gp, D)
+    q_all = jnp.concatenate([qd, qp], axis=0)  # [S, Tq, Hkv, Gp, D]
+    q_all = q_all.transpose(2, 0, 1, 3, 4).reshape(Hkv, S * Tq * Gp, D)
+
+    # ---- per-tile metadata (scalar prefetch) ----
+    tables = jnp.concatenate(
+        [d_tables.astype(jnp.int32), p_table.astype(jnp.int32)[None]], axis=0
+    )  # [B+1, M]
+    hist = jnp.asarray(p_hist, jnp.int32)
+    valid = jnp.asarray(p_valid, jnp.int32)
+    dec_q0 = d_seq_lens.astype(jnp.int32) - 1  # -1 for inactive slots
+    j = jnp.arange(nT, dtype=jnp.int32)
+    chunk_q0 = hist + j * Tq
+    # last REAL row of chunk tile j (tiles fully in the padding get -1,
+    # which skips every superblock)
+    real = jnp.clip(valid - j * Tq, 0, Tq)
+    chunk_last = jnp.where(real > 0, hist + j * Tq + real - 1, -1)
+    tile_q0 = jnp.concatenate([dec_q0, chunk_q0])
+    tile_last = jnp.concatenate([dec_q0, chunk_last])
+
+    def page_index(p):
+        def index(s, h, i, bt, q0, lastq):
+            seq_row = jnp.minimum(s, B)  # decode tile s<B; chunk tiles -> B
+            last_pg = jnp.maximum(lastq[s], 0) // bs
+            pi = jnp.minimum(jnp.minimum(i * Pp + p, last_pg), M - 1)
+            return (h, bt[seq_row, pi], 0, 0)
+
+        return index
+
+    page_spec = [
+        pl.BlockSpec((1, 1, bs, D), page_index(p)) for p in range(Pp)
+    ]
+    sink_inputs, sink_specs = (), ()
+    if sinks is not None:
+        # [H] -> [Hkv, Gp, 128] lane-broadcast; padded group lanes at a
+        # large FINITE negative (exp underflows to 0; -inf would 0*inf)
+        sk = sinks.astype(jnp.float32).reshape(Hkv, G)
+        sk = jnp.pad(sk, ((0, 0), (0, Gp - G)), constant_values=-1e30)
+        sk = jnp.broadcast_to(sk[:, :, None], (Hkv, Gp, 128))
+        sink_inputs = (sk,)
+        sink_specs = (
+            pl.BlockSpec((1, Gp, 128), lambda s, h, i, bt, q0, lq: (h, 0, 0)),
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, Hkv, M // Pp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, Tq * Gp, D), lambda s, h, i, bt, q0, lq: (h, s, 0)
+            ),
+            *page_spec,
+            *page_spec,
+            *sink_specs,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, Tq * Gp, D), lambda s, h, i, bt, q0, lq: (h, s, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Tq * Gp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Gp, 128), jnp.float32),
+            pltpu.VMEM((Tq * Gp, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _mixed_kernel, scale=scale, block_size=bs, group=Gp,
+        pages_per_step=Pp, window=window, has_sinks=sinks is not None,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hkv, S * Tq * Gp, D), q_dec.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * S * Tq * H * M * bs * D,
+            bytes_accessed=2 * Hkv * M * bs * D
+            * k_cache_layer.dtype.itemsize * S,
+            transcendentals=S * Tq * H * M * bs,
+        ),
+        interpret=interpret,
+    )(
+        tables, tile_q0, tile_last, q_all,
+        *([k_cache_layer] * Pp), *([v_cache_layer] * Pp), *sink_inputs,
+    )
+    out = out.reshape(Hkv, S, Tq, Gp, D)
+    o_dec = out[:, :B, 0].transpose(1, 0, 2, 3)  # [B, Hkv, Gp, D]
+    o_dec = o_dec[:, :, :G, :].reshape(B, H, D)
+    o_chunk = out[:, B:].transpose(1, 2, 0, 3, 4)  # [nT, Tq, Hkv, Gp, D]
+    o_chunk = o_chunk.reshape(T, Hkv, Gp, D)[:, :, :G, :].reshape(T, H, D)
+    return o_dec, o_chunk
+
+
+def ragged_mixed_attention_sharded(
+    q_dec: jnp.ndarray,  # [B, H, D], H sharded over tp
+    q_chunk: jnp.ndarray,  # [T, H, D], H sharded over tp
+    k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
+    v_cache_layer: jnp.ndarray,
+    d_tables: jnp.ndarray,  # [B, M] replicated
+    d_seq_lens: jnp.ndarray,  # [B] replicated
+    p_table: jnp.ndarray,  # [M] replicated
+    p_hist: jnp.ndarray,  # scalar replicated
+    p_valid: jnp.ndarray,  # scalar replicated
+    scale: float,
+    mesh,
+    window: int = 0,
+    sinks=None,  # [H], sharded over tp with the heads
+    interpret: bool = False,
+):
+    """ragged_mixed_attention under shard_map over ``tp`` — the mixed
+    kernel is kv-head-parallel exactly like its decode/prefill parents
+    (ops/attention._shard_tp), so each device runs it on its local head
+    shard with no collectives. Scalars (tables, lengths) replicate."""
+
+    def _local(qd, qc, kc, vc, bt, sl, pt, ph, pv, s=None):
+        return ragged_mixed_attention(
+            qd, qc, kc, vc, bt, sl, pt, ph, pv, scale,
+            window=window, sinks=s, interpret=interpret,
+        )
+
+    in_specs = [
+        P(None, "tp", None),  # q_dec
+        P(None, "tp", None),  # q_chunk
+        P("tp", None, None, None),  # k cache layer
+        P("tp", None, None, None),  # v cache layer
+        P(), P(), P(), P(), P(),  # tables + lengths replicate
+    ]
+    operands = (
+        q_dec, q_chunk, k_cache_layer, v_cache_layer,
+        d_tables, d_seq_lens, p_table, p_hist, p_valid,
+    )
+    if sinks is not None:
+        in_specs.append(P("tp"))
+        operands += (sinks,)
+    return shard_map(
+        _local, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=(P(None, "tp", None), P(None, "tp", None)),
+        check_vma=False,
+    )(*operands)

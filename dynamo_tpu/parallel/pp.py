@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..models.config import ModelConfig
+from ..ops._pallas_compat import shard_map
 from .mesh import spec_tree
 
 
@@ -195,7 +196,7 @@ def pipelined_prefill(
         out = lax.psum(jnp.where(s == pp - 1, out, 0.0), "pp")
         return out, kc_l, vc_l
 
-    x_out, k_cache, v_cache = jax.shard_map(
+    x_out, k_cache, v_cache = shard_map(
         stages,
         mesh=mesh,
         in_specs=(

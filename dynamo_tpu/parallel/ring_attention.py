@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops._pallas_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -77,17 +79,17 @@ def _ring_reduce(axis_name, t_local, out_shape, stat_shape, rotated, attend):
 
     # pvary: accumulators start as constants but the loop carry is
     # device-varying over the ring axis — mark them so shard_map's
-    # varying-manual-axes check accepts the fori_loop carry
-    acc = lax.pcast(
-        jnp.zeros(out_shape, jnp.float32), (axis_name,), to="varying"
-    )
-    m = lax.pcast(
-        jnp.full(stat_shape, NEG_INF, jnp.float32), (axis_name,),
-        to="varying",
-    )
-    l = lax.pcast(  # noqa: E741
-        jnp.zeros(stat_shape, jnp.float32), (axis_name,), to="varying"
-    )
+    # varying-manual-axes check accepts the fori_loop carry. jax 0.4.x
+    # has no lax.pcast (and its check_rep machinery doesn't need the
+    # marking) — identity there.
+    def _pvary(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis_name,), to="varying")
+        return x
+
+    acc = _pvary(jnp.zeros(out_shape, jnp.float32))
+    m = _pvary(jnp.full(stat_shape, NEG_INF, jnp.float32))
+    l = _pvary(jnp.zeros(stat_shape, jnp.float32))  # noqa: E741
 
     def kv_pos_at(step):
         src = (my - step) % p_size  # whose operands we hold this step
@@ -155,7 +157,7 @@ def ring_attention_sharded(
     """Driver: global [T, H, D] arrays in, ring attention over mesh axis
     ``axis_name`` (T must divide by its size), global [T, H, D] out."""
     spec = P(axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=axis_name, scale=scale, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -238,7 +240,7 @@ def mla_ring_attention_sharded(
     [T, H, C] latent outputs out (f32; the caller folds through w_vc)."""
     spec3 = P(axis_name, None, None)
     spec2 = P(axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             mla_ring_attention, axis_name=axis_name, scale=scale,
             causal=causal,

@@ -38,6 +38,13 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     with open(tmp_path / "history.jsonl") as f:
         recorded = [json.loads(ln) for ln in f if ln.strip()]
     assert recorded and recorded[-1]["value"] == result["value"]
+    # the mixed-batch win must be recorded in the bench JSON (ISSUE 3):
+    # p50/p99 decode-step time under concurrent prefill, fused AND
+    # alternating, with real samples behind both
+    itl = result.get("decode_itl_under_prefill_ms")
+    assert itl, result.get("mixed_batch_stats_error", "metric missing")
+    for side in ("fused", "alternating"):
+        assert itl[side]["n"] > 0 and itl[side]["p99"] > 0, itl
 
 
 def test_smoke_regression_band_catches_r03_drop():

@@ -1,0 +1,372 @@
+"""Fused mixed prefill+decode batching (ISSUE 3): exactness + kernel.
+
+The mixed-batch scheduler must be a pure LATENCY optimization: fusing a
+prefill chunk into the decode step may change WHEN tokens arrive, never
+WHICH tokens (or logprobs) arrive. Every test here runs the same
+concurrent workload — a live decode stream with a multi-chunk prompt
+prefilling beside it — through the fused engine (mixed_batch=True, the
+default) and the alternating baseline (mixed_batch=False), asserting
+bit-identical streams across the model families the engine serves:
+dense GQA, sliding-window, gpt-oss (alternating per-layer windows +
+sinks + MoE), and MLA. The ragged mixed-attention kernel itself is
+pinned against the XLA decode/chunk attention pair in interpret mode.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import Context, collect
+
+
+def _req(tokens, max_tokens, *, temperature=0.0, seed=0, logprobs=None,
+         eos=(), **stops):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **stops),
+        sampling_options=SamplingOptions(
+            temperature=temperature, seed=seed, logprobs=logprobs,
+        ),
+        eos_token_ids=list(eos),
+    )
+
+
+def _engine_cfg(model, mixed, **over):
+    base = dict(
+        model=model, num_blocks=96, block_size=4, max_batch_size=2,
+        max_context=128, prefill_chunk=16, mixed_batch=mixed,
+    )
+    base.update(over)
+    return EngineConfig(**base)
+
+
+def _stream(out):
+    return (
+        [t for o in out for t in o.token_ids],
+        [lp for o in out if o.logprobs for lp in o.logprobs],
+        out[-1].finish_reason,
+    )
+
+
+async def _mixed_workload(engine, *, dec_kw=None, long_kw=None):
+    """A decode stream running WHILE a multi-chunk prompt prefills: the
+    exact interleaving the mixed scheduler fuses. Returns (decode
+    stream, long-prompt stream)."""
+    dec = _req(range(10, 18), 16, ignore_eos=True, **(dec_kw or {}))
+    t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+    while engine.stats["decode_steps"] == 0:
+        await asyncio.sleep(0.005)
+    # 48 tokens -> 3 chunks of prefill_chunk=16 riding the decode steps
+    long = _req(range(200, 248), 3, temperature=0.8, seed=7,
+                ignore_eos=True, **(long_kw or {}))
+    long_out = await collect(engine.generate(Context(long)))
+    dec_out = await t
+    return dec_out, long_out
+
+
+FAMILIES = {
+    "dense": lambda: ModelConfig.tiny(),
+    "sliding_window": lambda: ModelConfig.tiny(sliding_window=8),
+    "gptoss": lambda: ModelConfig.tiny(
+        num_layers=2, layer_windows=(6, 0), attn_sinks=True, o_bias=True,
+        attention_bias=True, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=32, moe_act="gptoss_clamp",
+    ),
+    "mla": lambda: ModelConfig.tiny_mla(),
+}
+
+
+@pytest.mark.parametrize("family", list(FAMILIES))
+def test_mixed_step_exact_vs_alternating(run, family):
+    """The fused mixed step must produce bit-identical token streams AND
+    logprob entries to the alternating baseline — greedy decode stream
+    (with logprobs), sampled long prompt — for every model family."""
+
+    async def one(mixed):
+        engine = JaxEngine(_engine_cfg(FAMILIES[family](), mixed), seed=0)
+        dec_out, long_out = await _mixed_workload(
+            engine, dec_kw={"logprobs": 2}
+        )
+        fused_steps = engine.stats["mixed_steps"]
+        await engine.close()
+        return _stream(dec_out), _stream(long_out), fused_steps
+
+    async def main():
+        dec_f, long_f, fused_steps = await one(True)
+        dec_a, long_a, alt_steps = await one(False)
+        # the fused path actually engaged (several chunks rode decode
+        # steps) and the baseline really was the alternating scheduler
+        assert fused_steps >= 3, f"mixed never engaged ({fused_steps})"
+        assert alt_steps == 0
+        assert dec_f == dec_a, f"{family}: decode stream diverged"
+        assert long_f == long_a, f"{family}: prefilled stream diverged"
+
+    run(main())
+
+
+def test_mixed_step_midstream_eos(run):
+    """A decode row sampling its eos DURING the fused phase must end its
+    stream there (EOS, exact prefix) while the prefill completes."""
+
+    async def main():
+        # probe the greedy continuation to learn a real mid-stream token
+        probe = JaxEngine(_engine_cfg(ModelConfig.tiny(), True), seed=0)
+        out = await collect(probe.generate(
+            Context(_req(range(10, 18), 8, ignore_eos=True))
+        ))
+        toks = [t for o in out for t in o.token_ids]
+        await probe.close()
+
+        engine = JaxEngine(_engine_cfg(ModelConfig.tiny(), True), seed=0)
+        dec = _req(range(10, 18), 24, eos=[toks[2]])
+        t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+        while engine.stats["decode_steps"] == 0:
+            await asyncio.sleep(0.005)
+        long_out = await collect(engine.generate(
+            Context(_req(range(200, 248), 2, ignore_eos=True))
+        ))
+        dec_out = await t
+        got = [t for o in dec_out for t in o.token_ids]
+        assert got == toks[:3]
+        assert dec_out[-1].finish_reason == FinishReason.EOS
+        assert sum(len(o.token_ids) for o in long_out) == 2
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
+
+
+def test_mixed_step_preemption_replay_exact(run):
+    """Pool starvation during mixed batching must preempt + replay, never
+    truncate: every stream completes max_tokens with exactly the tokens
+    the uncontended engine produces (the seed preemption contract,
+    carried over to the fused scheduler)."""
+
+    async def main():
+        prompts = [list(range(10 + 7 * i, 22 + 7 * i)) for i in range(3)]
+        ref = JaxEngine(
+            _engine_cfg(ModelConfig.tiny(), True, num_blocks=64,
+                        max_batch_size=4, prefill_chunk=32), seed=0,
+        )
+        want = []
+        for p in prompts:
+            out = await collect(ref.generate(
+                Context(_req(p, 24, ignore_eos=True))
+            ))
+            want.append([t for o in out for t in o.token_ids])
+        await ref.close()
+
+        engine = JaxEngine(
+            _engine_cfg(ModelConfig.tiny(), True, num_blocks=14,
+                        max_batch_size=4, prefill_chunk=32), seed=0,
+        )
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(_req(p, 24, ignore_eos=True))))
+              for p in prompts]
+        )
+        for i, out in enumerate(outs):
+            toks = [t for o in out for t in o.token_ids]
+            assert out[-1].finish_reason == FinishReason.LENGTH
+            assert len(toks) == 24, f"req {i} truncated to {len(toks)}"
+            assert toks == want[i], f"req {i} diverged after preemption"
+        assert engine.stats["preemptions"] > 0
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
+
+
+def test_mixed_step_with_penalties_exact(run):
+    """Penalized sampling through the fused step (device counts carried
+    across mixed and plain steps) must match the alternating path."""
+
+    async def one(mixed):
+        engine = JaxEngine(_engine_cfg(ModelConfig.tiny(), mixed), seed=0)
+        dec_kw = {"temperature": 0.0}
+        dec = PreprocessedRequest(
+            token_ids=list(range(10, 18)),
+            stop_conditions=StopConditions(max_tokens=16, ignore_eos=True),
+            sampling_options=SamplingOptions(
+                temperature=0.0, seed=0, frequency_penalty=2.0,
+                presence_penalty=0.5, repetition_penalty=1.2,
+            ),
+            eos_token_ids=[],
+        )
+        t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+        while engine.stats["decode_steps"] == 0:
+            await asyncio.sleep(0.005)
+        long_out = await collect(engine.generate(
+            Context(_req(range(200, 248), 2, ignore_eos=True))
+        ))
+        dec_out = await t
+        del dec_kw
+        await engine.close()
+        return (
+            [t for o in dec_out for t in o.token_ids],
+            [t for o in long_out for t in o.token_ids],
+        )
+
+    async def main():
+        assert await one(True) == await one(False)
+
+    run(main())
+
+
+# ---------------- the ragged kernel itself (interpret mode) ----------------
+
+
+def _random_cache_setup(rng, *, B, Hkv, G, D, bs, M, T, hist, valid):
+    """A populated paged cache + packed queries for B decode rows and one
+    prefill chunk, with everything written write-before-attend."""
+    H = Hkv * G
+    N = (B + 1) * M + 1
+    kc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    # disjoint physical pages per sequence; page 0 reserved
+    pages = rng.permutation(np.arange(1, N)).astype(np.int32)
+    d_tables = pages[: B * M].reshape(B, M)
+    p_table = pages[B * M : (B + 1) * M]
+    d_seq_lens = np.asarray(
+        [1 + rng.integers(0, M * bs - 1) for _ in range(B)], np.int32
+    )
+    q_dec = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    q_chunk = jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+    return (
+        kc, vc, jnp.asarray(d_tables), jnp.asarray(d_seq_lens),
+        jnp.asarray(p_table), q_dec, q_chunk,
+    )
+
+
+@pytest.mark.parametrize("window", [0, 5])
+@pytest.mark.parametrize("with_sinks", [False, True])
+def test_ragged_mixed_kernel_matches_xla(window, with_sinks):
+    """Interpret-mode kernel vs the XLA pair it fuses: decode rows must
+    match decode_attention_xla (per-row lengths + window + sinks), chunk
+    rows must match chunk_attention_with_cache_xla on the real rows."""
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention,
+    )
+
+    rng = np.random.default_rng(3)
+    B, Hkv, G, D, bs, M = 3, 2, 2, 16, 8, 8
+    T, valid = 16, 13
+    hist = 9
+    scale = D ** -0.5
+    kc, vc, d_tables, d_seq_lens, p_table, q_dec, q_chunk = (
+        _random_cache_setup(rng, B=B, Hkv=Hkv, G=G, D=D, bs=bs, M=M, T=T,
+                            hist=hist, valid=valid)
+    )
+    H = Hkv * G
+    sinks = (
+        jnp.asarray(rng.standard_normal(H), jnp.float32) if with_sinks
+        else None
+    )
+    # the chunk's own K/V: write rows [hist, hist+T) through the table
+    # (padded rows too — the causal mask keeps real rows off them)
+    k_chunk = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    v_chunk = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    kc = att.write_chunk_to_cache(kc, k_chunk, p_table, jnp.int32(hist))
+    vc = att.write_chunk_to_cache(vc, v_chunk, p_table, jnp.int32(hist))
+
+    o_dec, o_chunk = ragged_mixed_attention(
+        q_dec, q_chunk, kc, vc, d_tables, d_seq_lens, p_table,
+        jnp.int32(hist), jnp.int32(valid), scale, q_tile=8,
+        window=window, sinks=sinks, interpret=True,
+    )
+    ref_dec = att.decode_attention_xla(
+        q_dec, kc, vc, d_tables, d_seq_lens, scale, window=window,
+        sinks=sinks,
+    )
+    ref_chunk = att.chunk_attention_with_cache_xla(
+        q_chunk, k_chunk, v_chunk, kc, vc, p_table, jnp.int32(hist),
+        jnp.int32(valid), scale, window=window, sinks=sinks,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_dec), np.asarray(ref_dec), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_chunk)[:valid], np.asarray(ref_chunk)[:valid],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_ragged_mixed_kernel_sharded_tp2_matches_xla():
+    """The shard_map wrapper (tp=2 over kv heads) must match the XLA pair
+    — interpret mode on a CPU mesh; same shard_map + Mosaic compile on
+    TPU (the mixed kernel is kv-head-parallel like its parents)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention_sharded,
+    )
+
+    rng = np.random.default_rng(11)
+    B, Hkv, G, D, bs, M = 2, 2, 2, 16, 8, 8
+    T, valid, hist = 16, 16, 5
+    scale = D ** -0.5
+    kc, vc, d_tables, d_seq_lens, p_table, q_dec, q_chunk = (
+        _random_cache_setup(rng, B=B, Hkv=Hkv, G=G, D=D, bs=bs, M=M, T=T,
+                            hist=hist, valid=valid)
+    )
+    k_chunk = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    v_chunk = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    kc = att.write_chunk_to_cache(kc, k_chunk, p_table, jnp.int32(hist))
+    vc = att.write_chunk_to_cache(vc, v_chunk, p_table, jnp.int32(hist))
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 1, 1, 1, 2),
+                ("dp", "pp", "sp", "ep", "tp"))
+    qd = jax.device_put(q_dec, NamedSharding(mesh, P(None, "tp", None)))
+    qc = jax.device_put(q_chunk, NamedSharding(mesh, P(None, "tp", None)))
+    kcs = jax.device_put(kc, NamedSharding(mesh, P("tp", None, None, None)))
+    vcs = jax.device_put(vc, NamedSharding(mesh, P("tp", None, None, None)))
+    o_dec, o_chunk = ragged_mixed_attention_sharded(
+        qd, qc, kcs, vcs, d_tables, d_seq_lens, p_table,
+        jnp.int32(hist), jnp.int32(valid), scale, mesh, interpret=True,
+    )
+    ref_dec = att.decode_attention_xla(
+        q_dec, kc, vc, d_tables, d_seq_lens, scale
+    )
+    ref_chunk = att.chunk_attention_with_cache_xla(
+        q_chunk, k_chunk, v_chunk, kc, vc, p_table, jnp.int32(hist),
+        jnp.int32(valid), scale,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_dec), np.asarray(ref_dec), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_chunk), np.asarray(ref_chunk), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ragged_mixed_kernel_inactive_slots_zero():
+    """Inactive decode slots (seq_len 0) must emit zeros — their tiles
+    skip every superblock — exactly like the XLA fallback."""
+    from dynamo_tpu.ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention,
+    )
+
+    rng = np.random.default_rng(5)
+    B, Hkv, G, D, bs, M = 2, 1, 4, 16, 8, 4
+    kc, vc, d_tables, _sl, p_table, q_dec, q_chunk = _random_cache_setup(
+        rng, B=B, Hkv=Hkv, G=G, D=D, bs=bs, M=M, T=8, hist=0, valid=8,
+    )
+    d_seq_lens = jnp.asarray([5, 0], jnp.int32)  # slot 1 inactive
+    o_dec, _ = ragged_mixed_attention(
+        q_dec, q_chunk, kc, vc, d_tables, d_seq_lens, p_table,
+        jnp.int32(0), jnp.int32(8), D ** -0.5, interpret=True,
+    )
+    assert np.all(np.asarray(o_dec)[1] == 0.0)
+    assert np.all(np.isfinite(np.asarray(o_dec)[0]))
