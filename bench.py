@@ -521,6 +521,125 @@ def _ttft_trace_stats() -> dict:
         tracing.RECORDER.clear()
 
 
+def _churn_kill_stats() -> dict:
+    """Goodput + p99 TTFT under a scripted worker kill (ISSUE 4): a
+    two-worker pool serves a staggered request wave through the
+    migration layer while the fault harness deterministically kills one
+    worker mid-decode. The artifact carries the COST of resilience —
+    completed/issued goodput, client-visible errors (must stay 0 with
+    migration on), TTFT p50/p99 across the wave, and how many streams
+    migrated — so cross-round regressions in the recovery path show up
+    as goodput/latency moves, not just failing tests."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.resilience import (
+        MigratingEngine, MigrationPolicy, faultpoints,
+    )
+    from dynamo_tpu.runtime import AsyncEngine, Context
+
+    tiny = ModelConfig.tiny()
+
+    def mk():
+        cfg = EngineConfig(
+            model=tiny, num_blocks=96, block_size=4, max_batch_size=4,
+            max_context=128, prefill_chunk=32, decode_window=1,
+        )
+        return JaxEngine(cfg, seed=0)
+
+    class _Pool(AsyncEngine):
+        def __init__(self, engines):
+            self.engines = engines
+            self.i = 0
+
+        async def generate(self, request):
+            e = self.engines[self.i % len(self.engines)]
+            self.i += 1
+            async for out in e.generate(request):
+                yield out
+
+    def req(base):
+        return PreprocessedRequest(
+            token_ids=list(range(base, base + 12)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    N = 12
+    engines = [mk(), mk()]
+    mig = MigratingEngine(_Pool(engines), MigrationPolicy(max_migrations=4))
+    ttft_ms: list = []
+    outcome = {"completed": 0, "errors": 0}
+
+    async def one(i):
+        t0 = _time.perf_counter()
+        first = True
+        finishes = 0
+        try:
+            async for item in mig.generate(Context(req(200 + 13 * i))):
+                err = getattr(item, "error", None)
+                if err:
+                    outcome["errors"] += 1
+                    return
+                data = getattr(item, "data", item)
+                toks = getattr(data, "token_ids", None) or []
+                if toks and first:
+                    first = False
+                    ttft_ms.append((_time.perf_counter() - t0) * 1e3)
+                if getattr(data, "finish_reason", None):
+                    finishes += 1
+            outcome["completed"] += 1 if finishes == 1 else 0
+        except Exception:  # noqa: BLE001 — a client-visible failure
+            outcome["errors"] += 1
+
+    async def run():
+        # warm both engines' compile caches outside the measured wave
+        await one(-15)
+        outcome["completed"] = 0
+        outcome["errors"] = 0
+        ttft_ms.clear()
+        # the scripted kill: one worker dies on its 6th decode step,
+        # mid-wave — its streams must migrate, not error
+        faultpoints.arm("mid_decode", "kill", after=6, times=1)
+        tasks = []
+        for i in range(N):
+            tasks.append(asyncio.ensure_future(one(i)))
+            await asyncio.sleep(0.01)  # staggered arrivals
+        await asyncio.gather(*tasks)
+        for e in engines:
+            await e.close()
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * p / 100))]
+
+    try:
+        asyncio.run(run())
+        kills = len(faultpoints.FAULTS.history)
+    finally:
+        faultpoints.reset()
+    return {
+        "bench_churn": {
+            "requests": N,
+            "completed": outcome["completed"],
+            "client_errors": outcome["errors"],
+            "goodput_frac": round(outcome["completed"] / N, 4),
+            "ttft_p50_ms": round(pct(ttft_ms, 50), 3) if ttft_ms else None,
+            "ttft_p99_ms": round(pct(ttft_ms, 99), 3) if ttft_ms else None,
+            "migrations": mig.stats["migrations_total"],
+            "kills_fired": kills,
+        }
+    }
+
+
 def main() -> None:
     cached = _cached_silicon_result()
     # one failed probe falls back (memoized) — a wedged relay costs one
@@ -611,6 +730,10 @@ def main() -> None:
         result.update(_decode_itl_under_prefill())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["mixed_batch_stats_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_churn_kill_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_churn_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -22,6 +22,8 @@ from typing import AsyncIterator, Optional, Union
 from .. import tracing
 from ..engine.engine import JaxEngine, OutOfBlocks
 from ..protocols.common import LLMEngineOutput, PreprocessedRequest
+from ..resilience import faultpoints
+from ..resilience.faultpoints import FaultInjected
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
@@ -69,6 +71,13 @@ class PrefillWorker:
                 await self._run_once()
             except asyncio.CancelledError:
                 return
+            except FaultInjected:
+                # harness kill: the consume loop DIES (no retry) — the
+                # un-acked item redelivers to a surviving consumer, not
+                # back to this one
+                logger.warning("prefill worker killed by fault point")
+                self._stop.set()
+                return
             except Exception:  # noqa: BLE001 — transient bus/hub error:
                 # the fleet must not silently lose a prefill consumer
                 logger.exception("prefill consume loop error; retrying")
@@ -81,6 +90,11 @@ class PrefillWorker:
         item_id, rpr = got
         try:
             await self._process(rpr)
+        except FaultInjected:
+            # harness kill mid-processing: die like a real crash — no
+            # ack, no nack, no error notification; the queue's
+            # visibility timeout redelivers the item to a survivor
+            raise
         except OutOfBlocks:
             # pool full: hand the item back for another worker (or
             # ourselves, once running prefills free their blocks)
@@ -100,10 +114,16 @@ class PrefillWorker:
             logger.error("kv transfer failed %d times: %s", self.MAX_DELIVERIES, e)
             self.stats["prefill_errors"] += 1
             await self._notify_error(rpr, str(e))
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — a COMPUTE failure is
+            # deterministic (bad request, model error): another worker
+            # would fail identically, so notify the decode side and ack
             logger.exception("remote prefill failed: %s", rpr.request_id)
             self.stats["prefill_errors"] += 1
             await self._notify_error(rpr, str(e))
+        # the WAL item is acked only here — AFTER the KV handoff
+        # committed (or after a deterministic failure was delivered): a
+        # worker killed anywhere above leaves the item in flight and the
+        # prefill redelivers instead of silently dropping
         await self.queue.ack(item_id)
 
     async def _process(self, rpr: RemotePrefillRequest) -> None:
@@ -137,22 +157,34 @@ class PrefillWorker:
             self.stats["prefills_total"] += 1
             layout = self.head_layout
             tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
+            await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
             with tracing.span(
                 "prefill.kv_send", request_id=rpr.request_id,
                 local=bool(rpr.connection.get("local")),
             ):
-                if rpr.connection.get("local"):
-                    assert self.local_pipe is not None, "local connection without pipe"
-                    await self.local_pipe.deliver(
-                        rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
-                        first_lp=first_lp,
-                    )
-                else:
-                    await send_kv_blocks(
-                        rpr.connection, rpr.request_id, first, k, v,
-                        layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
-                        first_lp=first_lp,
-                    )
+                try:
+                    if rpr.connection.get("local"):
+                        assert self.local_pipe is not None, "local connection without pipe"
+                        await self.local_pipe.deliver(
+                            rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
+                            first_lp=first_lp,
+                        )
+                    else:
+                        await send_kv_blocks(
+                            rpr.connection, rpr.request_id, first, k, v,
+                            layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
+                            first_lp=first_lp,
+                        )
+                except (TransferError, FaultInjected):
+                    raise
+                except Exception as e:  # noqa: BLE001 — ANY handoff-stage
+                    # failure (connection reset writing the stream,
+                    # serialization trouble) means the KV never committed
+                    # on the decode side: it must redeliver like a
+                    # TransferError, never ack-with-error (which would
+                    # strand the decode side waiting out its full
+                    # transfer timeout on a prefill nobody will redo)
+                    raise TransferError(f"kv handoff failed: {e}") from e
         finally:
             if trace_token is not None:
                 tracing.reset_trace(trace_token)

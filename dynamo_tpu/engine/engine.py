@@ -43,6 +43,9 @@ from ..protocols.common import (
     SamplingOptions,
     StopConditions,
 )
+from ..resilience import faultpoints
+from ..resilience.faultpoints import FaultInjected
+from ..resilience.policy import MIGRATION_SIGNAL
 from ..runtime.engine import AsyncEngine, Context
 from .. import tracing
 from .allocator import Block, BlockAllocator, sequence_block_hashes
@@ -384,6 +387,16 @@ class JaxEngine(AsyncEngine):
         self._wake = asyncio.Event()
         self._closed = False
         self._backpressured = False
+        # graceful drain (resilience/drain.py): _draining stops admission
+        # (generate() bounces new work with the migration signal); past
+        # _drain_deadline the scheduler hands off in-flight streams too.
+        # _dead marks a crashed/fault-killed scheduler loop — generate()
+        # then fails FAST with a worker-lost signature instead of parking
+        # requests on a queue nothing will ever drain.
+        self._draining = False
+        self._drain_handoff = True
+        self._drain_deadline = 0.0
+        self._dead: Optional[str] = None
         # host mirrors of device-side batch state
         M = cfg.max_blocks_per_seq
         self._block_tables = np.zeros((cfg.max_batch_size, M), np.int32)
@@ -417,6 +430,9 @@ class JaxEngine(AsyncEngine):
             "preemptions": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
+            "drains_total": 0,
+            "drain_handoffs": 0,
+            "migration_resumes": 0,
         }
 
     # ---------------- public api ----------------
@@ -516,7 +532,17 @@ class JaxEngine(AsyncEngine):
         return sizes
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
+        if self._draining or self._dead is not None:
+            # draining/dead worker: bounce immediately with a worker-lost
+            # signature so a migration-aware frontend re-dispatches —
+            # never park work on a queue this scheduler won't drain
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                text=self._dead or MIGRATION_SIGNAL,
+            )
+            return
         self.start()
+        faultpoints.hit_sync("admission", request_id=request.id)
         req: PreprocessedRequest = request.data
         if isinstance(req, dict):
             req = PreprocessedRequest.from_dict(req)
@@ -534,6 +560,25 @@ class JaxEngine(AsyncEngine):
             prompt_len=len(req.token_ids),
             trace=tracing.current_trace() if tracing.enabled() else None,
         )
+        resume = (
+            req.annotations.get("resume")
+            if isinstance(req.annotations, dict) else None
+        )
+        if isinstance(resume, dict):
+            # migration resume (resilience/migration.py): token_ids =
+            # original prompt + tokens already delivered. Restoring the
+            # prompt/generated split makes the continuation exact: the
+            # per-step sampling keys fold_in(seed, generated) pick up at
+            # the seam, penalty state rebuilds from the TRUE output list,
+            # and max/min_tokens + usage count from the original prompt.
+            try:
+                plen = int(resume.get("prompt_len", 0))
+            except (TypeError, ValueError):
+                plen = 0
+            if 0 < plen <= len(req.token_ids):
+                seq.prompt_len = plen
+                seq.generated = len(req.token_ids) - plen
+                self.stats["migration_resumes"] += 1
         self.stats["requests_total"] += 1
         await self._waiting.put(seq)
         self._wake.set()
@@ -561,13 +606,84 @@ class JaxEngine(AsyncEngine):
             "request_active_slots": self._n_active,
             "request_total_slots": self.cfg.max_batch_size,
             "num_requests_waiting": self._waiting_size(),
+            # resilience surface: the router deprioritizes draining
+            # workers; the metrics component tracks drain/migration volume
+            "draining": int(self._draining),
+            "drains_total": self.stats["drains_total"],
+            "drain_handoffs": self.stats["drain_handoffs"],
+            "migration_resumes": self.stats["migration_resumes"],
         }
+
+    # ---------------- graceful drain (resilience/drain.py) ----------------
+
+    async def drain(self, deadline_s: float = 10.0, handoff: bool = True) -> dict:
+        """Stop admitting and retire in-flight work: requests get
+        ``deadline_s`` to finish naturally; with ``handoff=True`` the
+        stragglers (and everything still queued) are terminated with the
+        migration signal so a migration-aware frontend resumes them on a
+        surviving worker as prompt + tokens-so-far. ``handoff=False``
+        waits for natural completion regardless of the deadline."""
+        if not self._draining:
+            self._draining = True
+            self.stats["drains_total"] += 1
+        self._drain_handoff = handoff
+        self._drain_deadline = asyncio.get_running_loop().time() + deadline_s
+        self._wake.set()
+        handoffs_before = self.stats["drain_handoffs"]
+        while (
+            self._has_pending_work()
+            and self._loop_task is not None
+            and not self._closed
+            and self._dead is None
+        ):
+            await asyncio.sleep(0.01)
+        return {"handed_off": self.stats["drain_handoffs"] - handoffs_before}
+
+    def _drain_tick(self) -> None:
+        """One scheduler-loop pass of drain progress (runs at an
+        iteration boundary, so it never races a device dispatch)."""
+        if not self._drain_handoff:
+            return
+        # queued-but-unstarted work first: nothing is computed yet, so
+        # the re-dispatch loses nothing — hand it back immediately
+        while not self._waiting_is_empty():
+            self._handoff_seq(self._pop_waiting())
+        if asyncio.get_running_loop().time() < self._drain_deadline:
+            return
+        # deadline passed: hand off the stragglers still on the device.
+        # _remote_ready waits until here too — its prefill + KV transfer
+        # are already paid for, and admission keeps pulling it into the
+        # batch while the drain window is open, so it can finish locally
+        while self._remote_ready:
+            self._handoff_seq(self._remote_ready.pop())
+        if self._prefill_state is not None:
+            st = self._prefill_state
+            self.stats["drain_handoffs"] += 1
+            self._abort_prefill(st, FinishReason.ERROR, text=MIGRATION_SIGNAL)
+        for seq in list(self._active):
+            if seq is not None and not seq.finished:
+                self._handoff_seq(seq)
+
+    def _handoff_seq(self, seq: "_Sequence") -> None:
+        """Terminate one stream with the migration signal (tokens already
+        emitted stay valid — the frontend splices the continuation)."""
+        if seq.finished:
+            return
+        self.stats["drain_handoffs"] += 1
+        seq.out_queue.put_nowait(
+            LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, text=MIGRATION_SIGNAL
+            )
+        )
+        self._finish(seq, FinishReason.ERROR, emit=False)
 
     # ---------------- scheduler loop ----------------
 
     async def _loop(self) -> None:
         try:
             while not self._closed:
+                if self._draining:
+                    self._drain_tick()
                 admitted = await self._admit()
                 if (
                     self._n_active == 0
@@ -602,9 +718,20 @@ class JaxEngine(AsyncEngine):
             # and an ingress that gets cancelled around that block would
             # hand callers silently-truncated streams
             self._fail_all_owned()
+        except FaultInjected as e:
+            # the harness killed this worker mid-step: mark the engine
+            # dead and abort every owned stream with the worker-lost
+            # signature, exactly what a real death looks like through the
+            # transport — the migration layer re-dispatches them all
+            logger.warning("engine killed by fault point: %s", e)
+            self._dead = str(e)
+            self._fail_all_owned(text=str(e))
         except Exception:  # noqa: BLE001
             logger.exception("engine loop crashed")
-            self._fail_all_owned()
+            # a dead scheduler must not accept (and silently park) new
+            # requests: fail fast with a retryable signature
+            self._dead = "engine stopped: scheduler loop crashed"
+            self._fail_all_owned(text=self._dead)
 
     def _has_pending_work(self) -> bool:
         """Anything the idle scheduler must NOT sleep on."""
@@ -616,20 +743,22 @@ class JaxEngine(AsyncEngine):
             or self._prefill_state is not None
         )
 
-    def _fail_all_owned(self) -> None:
+    def _fail_all_owned(self, text: Optional[str] = None) -> None:
         """ERROR-terminate every request this engine owns — active,
-        mid-prefill, and still-waiting."""
+        mid-prefill, and still-waiting. ``text`` rides the terminal chunk
+        (a worker-lost signature there lets the migration layer pick the
+        streams up instead of surfacing errors)."""
         in_prefill = [self._prefill_state.seq] if self._prefill_state else []
         for seq in self._active + self._remote_ready + in_prefill:
             if seq is not None:
                 seq.out_queue.put_nowait(
-                    LLMEngineOutput(finish_reason=FinishReason.ERROR)
+                    LLMEngineOutput(finish_reason=FinishReason.ERROR, text=text)
                 )
         self._remote_ready.clear()
         while self._waiting_front or not self._waiting.empty():
             seq = self._pop_waiting()
             seq.out_queue.put_nowait(
-                LLMEngineOutput(finish_reason=FinishReason.ERROR)
+                LLMEngineOutput(finish_reason=FinishReason.ERROR, text=text)
             )
 
     # ---- admission ----
@@ -837,6 +966,7 @@ class JaxEngine(AsyncEngine):
         True when the sequence was admitted (prefill completed)."""
         st = self._prefill_state
         assert st is not None
+        faultpoints.hit_sync("mid_prefill", request_id=st.seq.context.id)
         seq = st.seq
         if seq.context.is_stopped():
             # hand reserved host blocks back even mid-upload (the upload
@@ -886,18 +1016,24 @@ class JaxEngine(AsyncEngine):
                 self._remote_ready.append(seq)
         return True
 
-    def _abort_prefill(self, st: "_PrefillState", reason: FinishReason) -> None:
+    def _abort_prefill(
+        self, st: "_PrefillState", reason: FinishReason,
+        text: Optional[str] = None,
+    ) -> None:
         """The one teardown for an in-flight prefill — cancellation AND
         device failure, alternating AND mixed paths: drop the state,
         free the sequence's blocks, hand the reserved host chain back
-        (_rollback_upload), and terminate the stream. Four call sites
-        share it so the rollback protocol cannot drift between them."""
+        (_rollback_upload), and terminate the stream. The call sites
+        share it so the rollback protocol cannot drift between them;
+        ``text`` lets the drain handoff stamp the migration signal."""
         seq = st.seq
         self._prefill_state = None
         self.allocator.free(seq.blocks)
         seq.blocks = []
         self._rollback_upload(st)
-        seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=reason))
+        seq.out_queue.put_nowait(
+            LLMEngineOutput(finish_reason=reason, text=text)
+        )
 
     def _rollback_upload(self, st: _PrefillState) -> None:
         """Shared cancel/error rollback for a prefill's reserved host
@@ -1445,6 +1581,7 @@ class JaxEngine(AsyncEngine):
 
     async def _decode_once(self) -> None:
         cfg = self.cfg
+        faultpoints.hit_sync("mid_decode")
         if self._mixed_fusable():
             # chunked prefill fuses into this iteration's decode step: a
             # pipelined window can't chain across the membership change a

@@ -121,8 +121,10 @@ class ModelWatcher:
             try:
                 if ev.kind == EventKind.PUT:
                     await self._add(ModelEntry.from_json(ev.value))
-                else:
+                elif ev.kind == EventKind.DELETE:
                     self._remove_by_key(ev.key)
+                # RESUMED: post-reconnect reconcile marker — the replayed
+                # puts/deletes above already brought the registry current
             except Exception:  # noqa: BLE001
                 logger.exception("model watcher error for %s", ev.key)
 

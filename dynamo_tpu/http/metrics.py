@@ -41,6 +41,13 @@ class Metrics:
         # serving-latency histograms (BASELINE targets: p50/p99 TTFT, ITL)
         self.first_token: dict[tuple, Histogram] = defaultdict(Histogram)
         self.inter_token: dict[tuple, Histogram] = defaultdict(Histogram)
+        # extra scrape sources: () -> {metric_suffix: number}, rendered as
+        # plain gauges — lets subsystems (e.g. the migration wrapper's
+        # migrations_total) surface counters at /metrics without coupling
+        self._sources: list = []
+
+    def register_source(self, fn) -> None:
+        self._sources.append(fn)
 
     def inflight_guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
@@ -94,6 +101,12 @@ class Metrics:
         lines.append(f"# TYPE {p}_tokens_total counter")
         for (model, kind), v in sorted(self.tokens_total.items()):
             lines.append(f'{p}_tokens_total{{model="{model}",kind="{kind}"}} {v}')
+        for src in self._sources:
+            try:
+                for k, v in sorted(src().items()):
+                    lines.append(f"{p}_{k} {v}")
+            except Exception:  # noqa: BLE001 — a bad source must not
+                pass  # break the whole exposition
         return "\n".join(lines) + "\n"
 
 

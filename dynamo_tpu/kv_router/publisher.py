@@ -153,6 +153,9 @@ class KvMetricsAggregator:
                     offload_prefetch_hits=d.get("h2d_prefetch_hits", 0),
                     offload_restore_hidden_frac=d.get(
                         "restore_latency_hidden_frac", 0.0),
+                    draining=d.get("draining", 0),
+                    drains_total=d.get("drains_total", 0),
+                    migration_resumes=d.get("migration_resumes", 0),
                 )
             )
         self.endpoints = ProcessedEndpoints(loads)

@@ -72,7 +72,9 @@ class KvRouter:
             logger.info("pruning dead worker %x from kv index", worker_id)
             self.indexer.remove_worker(worker_id)
 
-    async def schedule(self, token_ids: list[int]) -> tuple[int, int]:
+    async def schedule(
+        self, token_ids: list[int], avoid: frozenset = frozenset()
+    ) -> tuple[int, int]:
         """-> (worker_id, overlap_blocks). Raises AllWorkersBusy."""
         pairs = sequence_block_hashes(token_ids, self.block_size)
         hashes = [s for _l, s in pairs]
@@ -81,7 +83,7 @@ class KvRouter:
         # an empty load set (cold start / all workers gone) raises
         # AllWorkersBusy and the caller falls back to round robin
         worker_id = self.scheduler.select_worker(
-            self.metrics.endpoints, overlaps, len(hashes)
+            self.metrics.endpoints, overlaps, len(hashes), avoid=avoid
         )
         overlap = overlaps.scores.get(worker_id, 0)
         # admission hashes prompt[:-1] (the final token always recomputes
@@ -126,11 +128,19 @@ class KvRoutedEngine(AsyncEngine):
         )
         payload = data.to_dict() if isinstance(data, PreprocessedRequest) else data
         worker_id: Optional[int] = None
+        # workers a migrating request already failed on (resilience/
+        # migration.py stamps them on re-dispatch): a killed worker stays
+        # leased until its TTL lapses, so routing must steer around it
+        # rather than trust discovery
+        avoid = frozenset(
+            i for i in (request.annotations.get("migration.avoid_workers") or ())
+            if isinstance(i, int)
+        )
         # the routing decision is the TTFT's "route" component — recorded
         # even on the fallback paths (the time was spent either way)
         with tracing.span("router.schedule", request_id=request.id) as rt_span:
             try:
-                worker_id, overlap = await self.router.schedule(token_ids)
+                worker_id, overlap = await self.router.schedule(token_ids, avoid=avoid)
                 rt_span.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
             except AllWorkersBusy:
                 rt_span.set(fallback="round_robin")
@@ -138,10 +148,23 @@ class KvRoutedEngine(AsyncEngine):
             except Exception:  # noqa: BLE001
                 rt_span.set(fallback="round_robin", error="router_failure")
                 logger.exception("router failure; falling back to round robin")
+        if worker_id is None and avoid:
+            # router fallback on a re-dispatch: blind round-robin may hand
+            # the request straight back to the instance it is fleeing —
+            # pin any live instance outside the avoid set instead
+            alive = sorted(set(self.client.instance_ids()) - avoid)
+            if alive:
+                worker_id = alive[0]
         try:
             if worker_id is not None and worker_id in set(self.client.instance_ids()):
+                # stamp the pinned instance into the request annotations:
+                # the migration layer reads it back on a stream failure to
+                # tell lease loss (instance gone from the store watch)
+                # from a transient TCP drop (instance still live)
+                request.annotations["routed_worker_id"] = worker_id
                 stream = await self.client.direct(request.transfer(payload), worker_id)
             else:
+                request.annotations.pop("routed_worker_id", None)
                 stream = await self.client.round_robin(request.transfer(payload))
             async for item in stream:
                 yield item

@@ -56,6 +56,11 @@ class WorkerLoad:
     offload_d2h_flush_async: int = 0
     offload_prefetch_hits: int = 0
     offload_restore_hidden_frac: float = 0.0
+    # resilience surface: a draining worker (SIGTERM received, lease
+    # still live) must not be picked — its engine bounces new work
+    draining: int = 0
+    drains_total: int = 0
+    migration_resumes: int = 0
 
     @property
     def kv_usage(self) -> float:
@@ -121,13 +126,23 @@ class KvScheduler:
         endpoints: ProcessedEndpoints,
         overlaps: OverlapScores,
         isl_blocks: int,
+        avoid: frozenset = frozenset(),
     ) -> int:
         loads = [l for l in endpoints.loads]
         if not loads:
             raise AllWorkersBusy("no workers")
-        candidates = [l for l in loads if not l.saturated]
+        candidates = [l for l in loads if not l.saturated and not l.draining]
         if not candidates:
-            raise AllWorkersBusy("all workers saturated")
+            raise AllWorkersBusy("all workers saturated or draining")
+        # ``avoid`` carries the workers a migrating request already failed
+        # on. A freshly-killed worker stays in discovery (and in the
+        # metrics view) until its lease TTL lapses, and prefix affinity
+        # would re-pick the corpse every time — soft-exclude: prefer any
+        # other worker, but fall back rather than refuse when the avoid
+        # set covers every candidate (lone-worker restarts)
+        if avoid:
+            preferred = [l for l in candidates if l.worker_id not in avoid]
+            candidates = preferred or candidates
 
         balance_mode = endpoints.load_std > self.cfg.balance_threshold
         alpha = self.cfg.balance_alpha if balance_mode else self.cfg.overlap_alpha

@@ -330,10 +330,28 @@ async def run_http(args) -> None:
         comp = drt.namespace(ns).component(comp_name)
         client = await comp.endpoint(ep).client().start()
         router = await KvRouter(drt, comp, block_size=args.block_size).start()
+        dispatch = KvRoutedEngine(router, client)
+        if not args.no_migration:
+            # transparent in-flight migration (resilience/): worker death
+            # mid-stream re-dispatches prompt + tokens-so-far through the
+            # same KV router — the client stream never notices
+            from ..resilience import MigratingEngine, MigrationPolicy
+
+            dispatch = MigratingEngine(
+                dispatch,
+                MigrationPolicy(
+                    max_migrations=args.max_migrations,
+                    deadline_s=args.migration_deadline,
+                ),
+                client=client,
+            )
+            svc.metrics.register_source(
+                lambda s=dispatch.stats: dict(s)
+            )
         engine = link(
             OpenAIPreprocessor(tokenizer),
             Backend(tokenizer),
-            KvRoutedEngine(router, client),
+            dispatch,
         )
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
@@ -462,7 +480,7 @@ async def run_endpoint(args) -> None:
             prefetch_listener = await KvPrefetchListener(  # noqa: F841
                 drt, component, drt.primary_lease_id, jax_core
             ).start()
-    await component.endpoint(ep).serve(engine, stats_handler=stats)
+    handle = await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
                         model_type="both"),
@@ -475,7 +493,21 @@ async def run_endpoint(args) -> None:
     refresher = MdcRefresher(drt.bus, card)
     refresher.start()
     print(f"worker {drt.worker_id:x} serving {name!r} at dyn://{target}", flush=True)
-    await asyncio.Event().wait()
+    # SIGTERM = graceful drain (resilience/drain.py): vanish from
+    # discovery, finish or hand off in-flight streams within
+    # --drain-deadline, revoke the lease last, then exit
+    from ..resilience import DrainCoordinator
+
+    done = asyncio.Event()
+    drain = DrainCoordinator(
+        drt,
+        engines=[jax_core] if jax_core is not None else [],
+        handles=[handle],
+        deadline_s=args.drain_deadline,
+        on_done=done.set,
+    )
+    drain.install_signal_handlers()
+    await done.wait()
 
 
 async def run_prefill(args) -> None:
@@ -522,7 +554,17 @@ async def run_prefill(args) -> None:
     worker.start()
     print(f"prefill worker {drt.worker_id:x} serving {name!r} "
           f"on queue {queue.name}", flush=True)
-    await asyncio.Event().wait()
+    # SIGTERM: stop consuming the queue (the in-flight item finishes or
+    # redelivers to a surviving prefill worker), revoke the lease last
+    from ..resilience import DrainCoordinator
+
+    done = asyncio.Event()
+    drain = DrainCoordinator(
+        drt, closers=[worker.close], deadline_s=args.drain_deadline,
+        on_done=done.set,
+    )
+    drain.install_signal_handlers()
+    await done.wait()
 
 
 async def _one_shot(engine: AsyncEngine, model: str, prompt: str, max_tokens: int, emit):
@@ -728,6 +770,20 @@ def main(argv=None) -> None:
                    help="decode: offload long prompts to prefill workers")
     p.add_argument("--max-local-prefill", type=int, default=512,
                    help="uncached prompt tokens above this go remote")
+    p.add_argument("--no-migration", action="store_true",
+                   help="disable transparent in-flight request migration "
+                        "(frontend roles: a worker death then errors its "
+                        "streams instead of resuming them elsewhere)")
+    p.add_argument("--max-migrations", type=int, default=3,
+                   help="re-dispatch attempts per request before the "
+                        "failure surfaces to the client")
+    p.add_argument("--migration-deadline", type=float, default=30.0,
+                   help="wall-clock budget (s) from a request's first "
+                        "failure across all its re-dispatches")
+    p.add_argument("--drain-deadline", type=float, default=15.0,
+                   help="SIGTERM graceful-drain budget (s): in-flight "
+                        "requests get this long to finish before being "
+                        "handed off to surviving workers")
     p.add_argument("--engine-subprocess", action="store_true",
                    help="isolate a pystr:/pytok: engine in a child process")
     p.add_argument("--warmup", action="store_true",

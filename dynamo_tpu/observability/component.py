@@ -125,6 +125,11 @@ class MetricsComponent:
                 "offload_restore_hidden_frac",
                 round(w.offload_restore_hidden_frac, 6), lb,
             )
+            # resilience plane: draining state + handoff/resume volume
+            # (resilience subsystem; docs/resilience.md)
+            gauge("draining", w.draining, lb)
+            gauge("drains_total", w.drains_total, lb)
+            gauge("migration_resumes_total", w.migration_resumes, lb)
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))

@@ -1,0 +1,44 @@
+"""Request-lifecycle reliability: transparent in-flight migration,
+graceful drain, and a deterministic fault-injection harness.
+
+The subsystem that makes worker death invisible to clients
+(docs/resilience.md):
+
+  * :mod:`.migration` — :class:`MigratingEngine`, the frontend stream
+    wrapper that checkpoints emitted tokens and re-dispatches broken
+    streams as ``prompt + tokens-so-far`` (exactly-once splice, RNG /
+    penalty continuity, KV-aware placement through the router);
+  * :mod:`.policy` — :class:`MigrationPolicy` knobs + the disconnect
+    classifier (lease loss vs. transient blip vs. engine error);
+  * :mod:`.drain` — :class:`DrainCoordinator`, the SIGTERM sequence:
+    stop admitting, finish or hand off in-flight work, revoke the
+    lease last;
+  * :mod:`.faultpoints` — named, deterministic kill/delay points at
+    every lifecycle stage, armed programmatically or via
+    ``DYN_FAULTPOINTS`` (the tests' and soak's worker-killing lever).
+"""
+
+from . import faultpoints
+from .drain import DrainCoordinator
+from .faultpoints import FaultInjected
+from .migration import MigratingEngine, ROUTED_WORKER_KEY
+from .policy import (
+    MIGRATION_SIGNAL,
+    WORKER_LOST_SIGNATURES,
+    FailureKind,
+    MigrationPolicy,
+    classify_failure,
+)
+
+__all__ = [
+    "DrainCoordinator",
+    "FailureKind",
+    "FaultInjected",
+    "MIGRATION_SIGNAL",
+    "MigratingEngine",
+    "MigrationPolicy",
+    "ROUTED_WORKER_KEY",
+    "WORKER_LOST_SIGNATURES",
+    "classify_failure",
+    "faultpoints",
+]

@@ -320,6 +320,25 @@ class ServeHandle:
         self._endpoint = endpoint
         self._subs = [sub, stats_sub]
 
+    @property
+    def endpoint(self) -> Endpoint:
+        return self._endpoint
+
+    def inflight_count(self) -> int:
+        """Requests this endpoint's ingress is currently streaming."""
+        return len(self._endpoint._inflight)
+
+    async def deregister(self) -> None:
+        """Graceful-drain step 1 (resilience/drain.py): delete the
+        discovery key so routers stop picking this instance, while the
+        subject subscription stays live — in-flight streams keep their
+        control plane and racing requests still get an honest bounce
+        from the draining engine instead of NoResponders."""
+        ep = self._endpoint
+        deleted = ep.drt.store.kv_delete(ep.etcd_key)
+        if asyncio.iscoroutine(deleted):
+            await deleted
+
     async def stop(self) -> None:
         ep = self._endpoint
         deleted = ep.drt.store.kv_delete(ep.etcd_key)
@@ -363,6 +382,11 @@ class Client:
             if ev.kind == EventKind.PUT:
                 info = EndpointInfo.from_json(ev.value)
                 self._instances[info.instance_id] = info
+            elif ev.kind == EventKind.RESUMED:
+                # post-reconnect reconcile finished (hub.py): the missed
+                # deletes/puts were replayed just above, so the instance
+                # map is consistent again — wake any parked waiters
+                pass
             else:
                 # key format ...{endpoint}:{lease:x}
                 try:

@@ -419,14 +419,21 @@ class _HubConnection:
                 )
 
     async def _re_establish(self) -> None:
-        """Redial forever (capped backoff), then rebuild the session;
-        a bounce DURING rebuild just starts the loop over."""
+        """Redial forever (exponential backoff with jitter), then rebuild
+        the session; a bounce DURING rebuild just starts the loop over.
+
+        The jitter matters at fleet scale: a hub restart disconnects
+        every worker at the same instant, and un-jittered backoff would
+        have the whole fleet redial in synchronized waves (thundering
+        herd against a half-started listener)."""
+        import random as _random
+
         delay = 0.2
         while not self._closing:
             try:
                 await self._dial(timeout=5.0)
             except (OSError, asyncio.TimeoutError):
-                await asyncio.sleep(delay)
+                await asyncio.sleep(delay * (0.5 + _random.random()))
                 delay = min(delay * 2, 5.0)
                 continue
             logger.info(
@@ -454,7 +461,7 @@ class _HubConnection:
                 logger.warning(
                     "hub session rebuild interrupted (%s); retrying", e
                 )
-                await asyncio.sleep(delay)
+                await asyncio.sleep(delay * (0.5 + _random.random()))
 
     async def call(self, head: dict, data: bytes = b"") -> tuple[Any, bytes]:
         if not self._connected.is_set() and not self._closing:
@@ -519,6 +526,14 @@ class RemoteWatcher:
                  bytes.fromhex(d["value"]))
             )
         self._seen = current
+        # reconcile done: surface a watch_resumed marker so dependents
+        # with state DERIVED from the event stream (instance lists,
+        # model registries) know a gap just closed and can re-list —
+        # before this, a consumer that missed the window could sit on
+        # silently-stale state until the next organic event
+        self._queue.put_nowait(
+            ({"kind": "resumed", "key": self.prefix, "lease": 0}, b"")
+        )
 
     def cancel(self) -> None:
         self._conn._watch_queues.pop(self._wid, None)

@@ -42,6 +42,11 @@ class ValidationFailed(StoreError):
 class EventKind(str, Enum):
     PUT = "put"
     DELETE = "delete"
+    # synthetic marker a RECONNECTED remote watcher emits after its
+    # reconcile pass (hub.py RemoteWatcher._reconcile): the missed
+    # deletes/puts have all been replayed, dependents holding derived
+    # state can re-list/refresh. In-process watchers never emit it.
+    RESUMED = "resumed"
 
 
 @dataclass

@@ -1,7 +1,9 @@
 """Subprocess entry for one SDK service (ref cli/serve_dynamo.py):
 ``python -m dynamo_tpu.sdk.serve_worker pkg.module:Leaf ServiceName --hub H``.
 Connects to the hub control plane, serves exactly the named service from
-the graph, and runs until terminated."""
+the graph, and runs until terminated — SIGTERM triggers a graceful drain
+(deregister from discovery, let in-flight endpoint streams flush, revoke
+the lease last) instead of an abrupt death."""
 
 from __future__ import annotations
 
@@ -9,6 +11,7 @@ import argparse
 import asyncio
 import logging
 
+from ..resilience import DrainCoordinator
 from ..runtime.hub import connect_hub
 from ..runtime.runtime import DistributedRuntime
 from .serving import GraphRunner, Supervisor
@@ -27,7 +30,13 @@ async def main_async(args) -> None:
     runner = GraphRunner(drt)
     await runner.serve_service(spec)
     print(f"sdk service {spec.name} up (worker {drt.worker_id:x})", flush=True)
-    await asyncio.Event().wait()
+    done = asyncio.Event()
+    drain = DrainCoordinator(
+        drt, handles=list(runner._handles),
+        deadline_s=args.drain_deadline, on_done=done.set,
+    )
+    drain.install_signal_handlers()
+    await done.wait()
 
 
 def main() -> None:
@@ -35,6 +44,8 @@ def main() -> None:
     p.add_argument("graph")
     p.add_argument("service")
     p.add_argument("--hub", required=True)
+    p.add_argument("--drain-deadline", type=float, default=15.0,
+                   help="SIGTERM graceful-drain budget (s)")
     args = p.parse_args()
     logging.basicConfig(level="INFO")
     try:

@@ -45,6 +45,15 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert itl, result.get("mixed_batch_stats_error", "metric missing")
     for side in ("fused", "alternating"):
         assert itl[side]["n"] > 0 and itl[side]["p99"] > 0, itl
+    # resilience cost must be recorded (ISSUE 4): goodput + TTFT under a
+    # scripted mid-decode kill, with migration keeping the wave lossless
+    churn = result.get("bench_churn")
+    assert churn, result.get("bench_churn_error", "metric missing")
+    assert churn["kills_fired"] == 1, churn
+    assert churn["client_errors"] == 0, churn
+    assert churn["goodput_frac"] == 1.0, churn
+    assert churn["migrations"] >= 1, churn
+    assert churn["ttft_p99_ms"] and churn["ttft_p99_ms"] > 0, churn
 
 
 def test_smoke_regression_band_catches_r03_drop():

@@ -59,10 +59,14 @@ def test_http_logprobs_n_and_penalties(tmp_path):
         else:
             raise TimeoutError("server never came up")
 
-        # logprobs through /v1/completions (folded)
+        # logprobs through /v1/completions (folded). ignore_eos pins the
+        # exact-length assertions below: the tiny model's greedy rollout
+        # can incidentally emit the eos id and stop early (the PR 3
+        # eos-vs-length flake family)
         out = _post(port, "/v1/completions", {
             "model": "tiny", "prompt": "hello", "max_tokens": 5,
             "temperature": 0.0, "logprobs": 2,
+            "nvext": {"ignore_eos": True},
         })
         lp = out["choices"][0]["logprobs"]
         assert lp is not None
@@ -79,6 +83,7 @@ def test_http_logprobs_n_and_penalties(tmp_path):
             "model": "tiny", "max_tokens": 6, "temperature": 0.9,
             "seed": 3, "n": 2,
             "messages": [{"role": "user", "content": "hi"}],
+            "nvext": {"ignore_eos": True},
         })
         assert len(out["choices"]) == 2
         assert {c["index"] for c in out["choices"]} == {0, 1}
@@ -89,6 +94,7 @@ def test_http_logprobs_n_and_penalties(tmp_path):
             "model": "tiny", "prompt": "aaaa", "max_tokens": 8,
             "temperature": 0.0, "frequency_penalty": 2.0,
             "repetition_penalty": 1.2,
+            "nvext": {"ignore_eos": True},
         })
         assert out["choices"][0]["finish_reason"] == "length"
         assert out["usage"]["completion_tokens"] == 8

@@ -101,6 +101,19 @@ def test_scheduler_prefers_load_in_balance_mode():
     assert s.select_worker(eps, overlaps, 10) == 2
 
 
+def test_scheduler_avoid_set_soft_excludes():
+    s = KvScheduler()
+    # worker 1 wins on perfect overlap — but a migrating request that
+    # already failed on it (dead, lease not yet expired) must go elsewhere
+    eps = make_eps((0.5, 2, 0), (0.5, 2, 0))
+    overlaps = OverlapScores(scores={1: 10}, total_blocks=10)
+    assert s.select_worker(eps, overlaps, 10, avoid=frozenset({1})) == 2
+    s.request_finished(2)
+    # soft: when the avoid set covers every candidate, still pick one
+    # (lone-worker restart) rather than refuse
+    assert s.select_worker(eps, overlaps, 10, avoid=frozenset({1, 2})) in (1, 2)
+
+
 def test_scheduler_all_busy_and_optimistic_bump():
     s = KvScheduler()
     eps = make_eps((0.5, 8, 3), (0.5, 8, 1))
