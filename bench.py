@@ -21,6 +21,13 @@ import time
 _probe_failed = False
 
 
+def _pct(xs, p):
+    """Nearest-rank percentile over a small sample (shared by every
+    bench metric so the index convention can't drift between them)."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p / 100))]
+
+
 def _probe_backend(timeout_s: float) -> bool:
     """True iff a fresh subprocess can init the default jax backend in time.
 
@@ -424,15 +431,11 @@ def _decode_itl_under_prefill() -> dict:
         asyncio.run(run())
         return itl_ms
 
-    def pct(xs, p):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(len(xs) * p / 100))]
-
     out = {}
     for name, mixed in (("alternating", False), ("fused", True)):
         xs = run_one(mixed)
         out[name] = (
-            {"p50": round(pct(xs, 50), 3), "p99": round(pct(xs, 99), 3),
+            {"p50": round(_pct(xs, 50), 3), "p99": round(_pct(xs, 99), 3),
              "n": len(xs)}
             if xs else {"p50": None, "p99": None, "n": 0}
         )
@@ -617,10 +620,6 @@ def _churn_kill_stats() -> dict:
         for e in engines:
             await e.close()
 
-    def pct(xs, p):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(len(xs) * p / 100))]
-
     try:
         asyncio.run(run())
         kills = len(faultpoints.FAULTS.history)
@@ -632,10 +631,186 @@ def _churn_kill_stats() -> dict:
             "completed": outcome["completed"],
             "client_errors": outcome["errors"],
             "goodput_frac": round(outcome["completed"] / N, 4),
-            "ttft_p50_ms": round(pct(ttft_ms, 50), 3) if ttft_ms else None,
-            "ttft_p99_ms": round(pct(ttft_ms, 99), 3) if ttft_ms else None,
+            "ttft_p50_ms": round(_pct(ttft_ms, 50), 3) if ttft_ms else None,
+            "ttft_p99_ms": round(_pct(ttft_ms, 99), 3) if ttft_ms else None,
             "migrations": mig.stats["migrations_total"],
             "kills_fired": kills,
+        }
+    }
+
+
+def _overload_stats() -> dict:
+    """Goodput + shed rate + admitted-request TTFT under 2x-capacity
+    offered load (ISSUE 5): the frontend admission gate's value is only
+    visible under overload, so the artifact carries the comparison the
+    planner docs promise — with the gate ON (rate held at measured
+    capacity) the shed rate absorbs the excess and ADMITTED requests
+    keep a TTFT close to the uncongested baseline; with the gate OFF
+    the same wave queues unboundedly and the tail TTFT balloons.
+
+    Three phases on one tiny engine: (1) a closed-loop wave at engine
+    concurrency measures serving capacity (req/s) and the uncongested
+    TTFT p99 — the self-normalizing baseline the SLO target derives
+    from; (2) an open-loop wave at 2x that rate with no gate; (3) the
+    same wave through an AdmissionGate at capacity rate (every 3rd
+    request class ``batch``, which reserves half the burst for
+    ``interactive``)."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.planner import AdmissionGate
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context
+
+    tiny = ModelConfig.tiny()
+    cfg = EngineConfig(
+        model=tiny, num_blocks=96, block_size=4, max_batch_size=4,
+        max_context=128, prefill_chunk=32, decode_window=1,
+    )
+    engine = JaxEngine(cfg, seed=0)
+
+    def req(base):
+        return PreprocessedRequest(
+            token_ids=list(range(base, base + 12)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    async def one(i, ttfts, outcome, gate=None, slo_class=None):
+        t0 = _time.perf_counter()
+        first = True
+        finishes = 0
+        try:
+            async for item in engine.generate(Context(req(600 + 13 * i))):
+                if getattr(item, "error", None):
+                    outcome["errors"] += 1
+                    return
+                data = getattr(item, "data", item)
+                toks = getattr(data, "token_ids", None) or []
+                if toks and first:
+                    first = False
+                    ttfts.append((_time.perf_counter() - t0) * 1e3)
+                if getattr(data, "finish_reason", None):
+                    finishes += 1
+            outcome["completed"] += 1 if finishes == 1 else 0
+        except Exception:  # noqa: BLE001 — a client-visible failure
+            outcome["errors"] += 1
+        finally:
+            if gate is not None:
+                gate.done(slo_class)
+
+    N = 24
+
+    async def closed_loop():
+        # first wave warms every compile shape this concurrency hits
+        # (prefill buckets, 1..4-wide decode batches); the SECOND wave
+        # measures — capacity and the uncongested TTFT baseline must
+        # not carry compile time or the 2x offered rate is fiction
+        await asyncio.gather(*(one(100 + i, [], {"completed": 0, "errors": 0})
+                               for i in range(8)))
+        ttfts: list = []
+        outcome = {"completed": 0, "errors": 0}
+        t0 = _time.perf_counter()
+        await asyncio.gather(*(one(130 + i, ttfts, outcome)
+                               for i in range(8)))
+        dt = _time.perf_counter() - t0
+        return outcome["completed"] / max(dt, 1e-9), ttfts
+
+    async def open_loop(interval_s, gate=None):
+        ttfts: list = []
+        outcome = {"completed": 0, "errors": 0}
+        shed = {"interactive": 0, "batch": 0}
+        admitted = {"interactive": 0, "batch": 0}
+        tasks = []
+        t_first = _time.perf_counter()
+        for i in range(N):
+            cls = "batch" if i % 3 == 2 else "interactive"
+            if gate is not None:
+                decision = gate.admit(cls)
+                if not decision.admitted:
+                    shed[cls] += 1
+                    await asyncio.sleep(interval_s)
+                    continue
+                admitted[cls] += 1
+                tasks.append(asyncio.ensure_future(
+                    one(200 + i, ttfts, outcome, gate=gate, slo_class=cls)
+                ))
+            else:
+                admitted[cls] += 1
+                tasks.append(asyncio.ensure_future(one(200 + i, ttfts, outcome)))
+            await asyncio.sleep(interval_s)
+        realized_req_s = N / max(_time.perf_counter() - t_first, 1e-9)
+        await asyncio.gather(*tasks)
+        return ttfts, outcome, admitted, shed, realized_req_s
+
+    async def run():
+        capacity_req_s, base_ttfts = await closed_loop()
+        interval = 1.0 / max(2.0 * capacity_req_s, 1e-9)
+        un_ttfts, un_out, un_adm, _, un_rate = await open_loop(interval)
+        gate = AdmissionGate(capacity_req_s, burst=2.0)
+        g_ttfts, g_out, g_adm, g_shed, g_rate = await open_loop(
+            interval, gate=gate
+        )
+        await engine.close()
+        return (capacity_req_s, base_ttfts, un_ttfts, un_out, un_adm,
+                un_rate, g_ttfts, g_out, g_adm, g_shed, g_rate, gate)
+
+    (cap, base_ttfts, un_ttfts, un_out, un_adm, un_rate,
+     g_ttfts, g_out, g_adm, g_shed, g_rate, gate) = asyncio.run(run())
+    base_p99 = _pct(base_ttfts, 99) if base_ttfts else 0.0
+    # SLO target self-normalized to this box: admitted requests under a
+    # gated 2x wave should stay within ~2.5x the uncongested tail. The
+    # absolute floor absorbs scheduler noise when the baseline itself
+    # is a few ms (the ungated tail at 2x queues an order of magnitude
+    # past it either way)
+    target_ms = round(max(2.5 * base_p99, 250.0), 3)
+    g_admitted = sum(g_adm.values())
+    g_shed_n = sum(g_shed.values())
+    g_p99 = _pct(g_ttfts, 99) if g_ttfts else None
+    un_p99 = _pct(un_ttfts, 99) if un_ttfts else None
+    return {
+        "bench_overload": {
+            "requests": N,
+            "capacity_req_s": round(cap, 3),
+            "offered_req_s": round(2.0 * cap, 3),
+            "realized_offer_req_s": {
+                "ungated": round(un_rate, 3), "gated": round(g_rate, 3),
+            },
+            "uncongested_ttft_p99_ms": round(base_p99, 3),
+            "slo_ttft_target_ms": target_ms,
+            "gated": {
+                "admitted": g_admitted,
+                "shed": g_shed_n,
+                "shed_frac": round(g_shed_n / N, 4),
+                "shed_by_class": dict(g_shed),
+                "admitted_by_class": dict(g_adm),
+                "completed": g_out["completed"],
+                "client_errors": g_out["errors"],
+                "goodput_frac": round(
+                    g_out["completed"] / max(g_admitted, 1), 4
+                ),
+                "ttft_p50_ms": round(_pct(g_ttfts, 50), 3) if g_ttfts else None,
+                "ttft_p99_ms": round(g_p99, 3) if g_p99 is not None else None,
+                "within_target": bool(g_p99 is not None
+                                      and g_p99 <= target_ms),
+                "shed_total_stat": gate.stats["shed_total"],
+            },
+            "ungated": {
+                "admitted": sum(un_adm.values()),
+                "completed": un_out["completed"],
+                "client_errors": un_out["errors"],
+                "ttft_p50_ms": round(_pct(un_ttfts, 50), 3) if un_ttfts else None,
+                "ttft_p99_ms": round(un_p99, 3) if un_p99 is not None else None,
+            },
+            "ttft_p99_speedup": round(un_p99 / g_p99, 3)
+            if g_p99 and un_p99 else None,
         }
     }
 
@@ -734,6 +909,10 @@ def main() -> None:
         result.update(_churn_kill_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_churn_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_overload_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_overload_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

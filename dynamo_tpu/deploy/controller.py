@@ -132,6 +132,8 @@ class DeploymentController:
         metrics_fn: Optional[Callable] = None,
         backoff_base: float = 1.0,
         backoff_max: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        planner=None,
     ):
         self.store = store
         self.poll_interval = poll_interval
@@ -141,6 +143,19 @@ class DeploymentController:
         self._metrics_fn = metrics_fn
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
+        # injected clock drives the autoscaler guard rails (tests tick
+        # a fake clock); process lifecycle keeps real time.monotonic
+        self._clock = clock
+        # embedded SLA planner (planner.Planner): ticked once per
+        # reconcile pass so its scale decisions land in the same store
+        # this controller converges — `dynamo_run --planner` is the
+        # standalone alternative
+        self.planner = planner
+        # per-(deployment, service) autoscaler guard: hysteresis +
+        # cooldown so a threshold-straddling queue depth can't flap
+        # replicas every tick (planner/guard.py, shared with the
+        # planner's prefill/decode drivers)
+        self._guards: dict[tuple[str, str], tuple[object, tuple]] = {}
         # key = (deployment, service, replica, rank)
         self._replicas: dict[tuple[str, str, int, int], _Replica] = {}
         # terminated children awaiting reap; SIGKILL after the grace
@@ -198,6 +213,11 @@ class DeploymentController:
     def reconcile_once(self) -> None:
         """One observe/diff/converge pass (sync; also called from tests)."""
         self.stats["reconciles"] += 1
+        if self.planner is not None:
+            try:
+                self.planner.tick()
+            except Exception:  # noqa: BLE001 — a sick planner must not
+                logger.exception("embedded planner tick failed")  # stop
         self._reap_terminating()
         desired: dict[tuple[str, str, int, int], tuple] = {}
         deployments: dict[str, DynamoDeployment] = {}
@@ -267,6 +287,19 @@ class DeploymentController:
         for name in list(self._last_status):
             if name not in deployments:
                 self._last_status.pop(name, None)
+        # autoscaler guards die with their service (a recreated
+        # deployment must not inherit the old cooldown clock) — keyed on
+        # the SPECS, not `desired`: a service legitimately scaled to
+        # zero has no desired replicas but must keep its guard, or the
+        # next reconcile reseeds from spec.replicas and flaps 0 -> spec
+        live_services = {
+            (name, svc.name)
+            for name, dep in deployments.items()
+            for svc in dep.services
+        }
+        for key in list(self._guards):
+            if key not in live_services:
+                self._guards.pop(key, None)
         now = time.monotonic()
         # groups with a rank still draining must not respawn yet — the
         # old process holds the coordinator port / TPU devices until it
@@ -331,6 +364,7 @@ class DeploymentController:
 
     def _desired_replicas(self, name: str, svc: ServiceDeploymentSpec) -> int:
         if not (svc.autoscaling.enabled and self._metrics_fn):
+            self._guards.pop((name, svc.name), None)
             return svc.replicas
         a = svc.autoscaling
         try:
@@ -343,9 +377,43 @@ class DeploymentController:
             )
             return max(current, a.min_replicas)
         if depth is None:
+            # metric not yet published this tick: hold the guarded scale
+            # — falling back to spec.replicas would bypass the guard and
+            # kill/respawn autoscaled replicas on one missing sample
+            cached = self._guards.get((name, svc.name))
+            if cached is not None and cached[0].current is not None:
+                return cached[0].current
             return svc.replicas
         want = math.ceil(depth / max(a.target_queue_depth, 1)) if depth > 0 else a.min_replicas
-        return max(a.min_replicas, min(a.max_replicas, want))
+        return self._guard_for(name, svc).apply(want)
+
+    def _guard_for(self, name: str, svc: ServiceDeploymentSpec):
+        """Per-service ScaleGuard, rebuilt (keeping the current scale)
+        when the spec's autoscaling rails change."""
+        from ..planner.guard import GuardConfig, ScaleGuard
+
+        a = svc.autoscaling
+        key = (name, svc.name)
+        cfg_sig = (a.min_replicas, a.max_replicas, a.up_cooldown_s,
+                   a.down_cooldown_s, a.down_stable_s)
+        cached = self._guards.get(key)
+        if cached is not None and cached[1] == cfg_sig:
+            return cached[0]
+        guard = ScaleGuard(
+            GuardConfig(
+                min_replicas=a.min_replicas, max_replicas=a.max_replicas,
+                up_cooldown_s=a.up_cooldown_s,
+                down_cooldown_s=a.down_cooldown_s,
+                down_stable_s=a.down_stable_s,
+            ),
+            clock=self._clock,
+            # rails changed: keep the live scale; brand new: seed from
+            # the spec so a fresh controller can only scale DOWN through
+            # the stability window, never instantly on its first tick
+            initial=cached[0].current if cached is not None else svc.replicas,
+        )
+        self._guards[key] = (guard, cfg_sig)
+        return guard
 
     def _kill(self, key, clear_group_state: bool = True) -> None:
         rep = self._replicas.pop(key, None)

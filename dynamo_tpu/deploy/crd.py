@@ -63,10 +63,23 @@ class Autoscaling:
     # metrics plane) — the TPU-meaningful signal; CPU% is meaningless for
     # a device-bound worker
     target_queue_depth: int = 8
+    # guard rails (planner/guard.py ScaleGuard — shared with the SLA
+    # planner): scale-up paced by up_cooldown_s; scale-down only after
+    # the desire has sat below current for down_stable_s continuously
+    # AND down_cooldown_s since the last action — a queue depth
+    # oscillating around the threshold can no longer flap replicas
+    # every reconcile tick. All three at 0 = the legacy instant path.
+    up_cooldown_s: float = 0.0
+    down_cooldown_s: float = 60.0
+    down_stable_s: float = 30.0
 
     def validate(self) -> None:
         if self.enabled and self.min_replicas > self.max_replicas:
             raise SpecError("min_replicas > max_replicas")
+        if min(self.up_cooldown_s, self.down_cooldown_s,
+               self.down_stable_s) < 0:
+            raise SpecError("autoscaling cooldown/stability windows "
+                            "must be >= 0")
 
 
 @dataclass

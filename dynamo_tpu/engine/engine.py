@@ -424,6 +424,7 @@ class JaxEngine(AsyncEngine):
             "requests_active": 0,
             "requests_waiting": 0,
             "tokens_generated": 0,
+            "prompt_tokens_total": 0,
             "prefix_cache_hits_tokens": 0,
             "decode_steps": 0,
             "mixed_steps": 0,
@@ -580,6 +581,7 @@ class JaxEngine(AsyncEngine):
                 seq.generated = len(req.token_ids) - plen
                 self.stats["migration_resumes"] += 1
         self.stats["requests_total"] += 1
+        self.stats["prompt_tokens_total"] += seq.prompt_len
         await self._waiting.put(seq)
         self._wake.set()
         while True:
@@ -606,6 +608,12 @@ class JaxEngine(AsyncEngine):
             "request_active_slots": self._n_active,
             "request_total_slots": self.cfg.max_batch_size,
             "num_requests_waiting": self._waiting_size(),
+            # cumulative serving counters: the planner's telemetry
+            # aggregator derives fleet arrival/throughput rates from
+            # scrape-to-scrape deltas of these
+            "requests_total": self.stats["requests_total"],
+            "tokens_generated": self.stats["tokens_generated"],
+            "prompt_tokens_total": self.stats["prompt_tokens_total"],
             # resilience surface: the router deprioritizes draining
             # workers; the metrics component tracks drain/migration volume
             "draining": int(self._draining),
@@ -2554,6 +2562,7 @@ class JaxEngine(AsyncEngine):
         if self._reserve_for_prompt(seq) is None:
             return None
         self.stats["requests_total"] += 1
+        self.stats["prompt_tokens_total"] += seq.prompt_len
         return RemoteHandle(
             seq=seq,
             skip_blocks=seq.committed,
@@ -2564,6 +2573,7 @@ class JaxEngine(AsyncEngine):
         """Local-prefill fallback chosen after begin_remote: return the
         blocks untouched (no output emitted; caller re-submits locally)."""
         self.stats["requests_total"] -= 1
+        self.stats["prompt_tokens_total"] -= handle.seq.prompt_len
         self.allocator.free(handle.seq.blocks)
         handle.seq.blocks = []
 

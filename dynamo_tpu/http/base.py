@@ -21,16 +21,22 @@ _STATUS_TEXT = {
     200: "OK", 201: "Created", 204: "No Content",
     400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
     409: "Conflict", 422: "Unprocessable Entity",
+    429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str, code: str = "invalid_request_error"):
+    def __init__(self, status: int, message: str,
+                 code: str = "invalid_request_error",
+                 retry_after_s: float = 0.0):
         super().__init__(message)
         self.status = status
         self.message = message
         self.code = code
+        # > 0 = emit a Retry-After header (admission-gate sheds: the
+        # client is told when capacity is expected back)
+        self.retry_after_s = retry_after_s
 
 
 class HttpServerBase:
@@ -85,9 +91,14 @@ class HttpServerBase:
                 try:
                     await self._route(method, path, headers, body, writer)
                 except HttpError as e:
+                    extra = (
+                        {"Retry-After": str(int(max(e.retry_after_s, 1)))}
+                        if e.retry_after_s > 0 else None
+                    )
                     await self._send_json(
                         writer, e.status,
                         {"error": {"message": e.message, "type": e.code}},
+                        extra_headers=extra,
                     )
                 except (ConnectionResetError, BrokenPipeError):
                     break
@@ -158,15 +169,22 @@ class HttpServerBase:
         status: int,
         body: bytes,
         content_type: str = "application/json",
+        extra_headers: Optional[dict] = None,
     ) -> None:
         head = (
             f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
-            "\r\n"
         )
+        for k, v in (extra_headers or {}).items():
+            head += f"{k}: {v}\r\n"
+        head += "\r\n"
         writer.write(head.encode() + body)
         await writer.drain()
 
-    async def _send_json(self, writer, status: int, obj) -> None:
-        await self._send_response(writer, status, json.dumps(obj).encode())
+    async def _send_json(self, writer, status: int, obj,
+                         extra_headers: Optional[dict] = None) -> None:
+        await self._send_response(
+            writer, status, json.dumps(obj).encode(),
+            extra_headers=extra_headers,
+        )

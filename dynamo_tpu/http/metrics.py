@@ -45,6 +45,10 @@ class Metrics:
         # plain gauges — lets subsystems (e.g. the migration wrapper's
         # migrations_total) surface counters at /metrics without coupling
         self._sources: list = []
+        # optional planner.TelemetryAggregator: first/inter-token
+        # observations double as the embedded planner's TTFT/ITL
+        # samples (the SLO evaluator's inputs)
+        self.planner_telemetry = None
 
     def register_source(self, fn) -> None:
         self._sources.append(fn)
@@ -57,9 +61,13 @@ class Metrics:
 
     def observe_first_token(self, model: str, endpoint: str, v: float) -> None:
         self.first_token[(model, endpoint)].observe(v)
+        if self.planner_telemetry is not None:
+            self.planner_telemetry.record_ttft(v * 1e3)
 
     def observe_inter_token(self, model: str, endpoint: str, v: float) -> None:
         self.inter_token[(model, endpoint)].observe(v)
+        if self.planner_telemetry is not None:
+            self.planner_telemetry.record_itl(v * 1e3)
 
     def render(self) -> str:
         p = self.prefix

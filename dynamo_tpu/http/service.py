@@ -87,12 +87,19 @@ class HttpService(HttpServerBase):
         port: int = 8080,
         metrics: Optional[Metrics] = None,
         trace_collector=None,
+        admission=None,
     ):
         super().__init__(host=host, port=port)
         self.models = model_manager or ModelManager()
         self.metrics = metrics or Metrics()
         # tracing.TraceCollector serving /trace/{request_id} (None = off)
         self.tracing = trace_collector
+        # planner.AdmissionGate overload control (None = admit all):
+        # shed requests get 429 + Retry-After BEFORE touching the
+        # engine, so admitted requests keep their SLO under overload
+        self.admission = admission
+        if admission is not None:
+            self.metrics.register_source(admission.render_stats)
         # client-supplied request ids currently in flight: a duplicate
         # would key cross-request shared state (worker inflight map,
         # disagg transfer futures) onto one id — the second request
@@ -192,6 +199,30 @@ class HttpService(HttpServerBase):
                 404, f"model {req.model!r} not found", "model_not_found"
             )
 
+        slo_class: Optional[str] = None
+        if self.admission is not None:
+            # overload gate: classify by nvext annotation (["slo:batch"])
+            # and admit/shed before any engine work is queued
+            slo_class = self.admission.classify(
+                getattr(getattr(req, "nvext", None), "annotations", None)
+            )
+            decision = self.admission.admit(slo_class)
+            if not decision.admitted:
+                self.metrics.requests_total[
+                    (req.model, endpoint, "shed")
+                ] += 1
+                tracing.event(
+                    "frontend.shed", slo_class=slo_class,
+                    reason=decision.reason,
+                )
+                raise HttpError(
+                    429,
+                    f"overloaded ({decision.reason}); retry after "
+                    f"{decision.retry_after_s:.0f}s",
+                    "overloaded",
+                    retry_after_s=decision.retry_after_s,
+                )
+
         guard = self.metrics.inflight_guard(req.model, endpoint)
         client_rid = self._client_request_id(headers)
         if client_rid is not None:
@@ -204,6 +235,10 @@ class HttpService(HttpServerBase):
             else:
                 self._inflight_ids.add(client_rid)
         context = Context(req, AsyncEngineContext(client_rid))
+        if slo_class is not None:
+            # downstream planes (router, engine queues, traces) see the
+            # request's SLO class
+            context.annotations["slo_class"] = slo_class
         req_span = tracing.NULL_SPAN
         trace_token = None
         if tracing.enabled():
@@ -259,6 +294,8 @@ class HttpService(HttpServerBase):
                 await self._send_json(writer, 200, full)
         finally:
             guard.done()
+            if slo_class is not None:
+                self.admission.done(slo_class)
             if client_rid is not None:
                 self._inflight_ids.discard(client_rid)
             req_span.end()

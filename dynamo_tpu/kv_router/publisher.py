@@ -119,6 +119,13 @@ class KvMetricsAggregator:
         self.component = component
         self.interval = interval
         self.endpoints = ProcessedEndpoints([])
+        # last-known load per instance: a worker that misses one scrape
+        # window (1s stats timeout on a starved box) keeps its previous
+        # snapshot — with its ORIGINAL ts, so the scheduler's load_ttl_s
+        # ages it out if it stays silent — instead of vanishing from the
+        # routing view for a tick. Departed workers (discovery key gone)
+        # still drop immediately.
+        self._known: dict[int, WorkerLoad] = {}
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> "KvMetricsAggregator":
@@ -135,11 +142,21 @@ class KvMetricsAggregator:
                 logger.exception("metrics scrape failed")
 
     async def _collect_once(self) -> None:
-        stats = await self.component.scrape_stats()
-        loads = []
+        import time as _time
+
+        stats = await self.component.scrape_stats(include_missing=True)
+        now = _time.monotonic()
+        merged: dict[int, WorkerLoad] = {}
         for s in stats:
-            d = s.get("data") or {}
-            loads.append(
+            d = s.get("data")
+            if d is None:
+                # discovered but slow: retain the last-known load (stale
+                # ts and all) rather than dropping a live worker
+                prev = self._known.get(s["instance_id"])
+                if prev is not None:
+                    merged[s["instance_id"]] = prev
+                continue
+            merged[s["instance_id"]] = (
                 WorkerLoad(
                     worker_id=s["instance_id"],
                     kv_active_blocks=d.get("kv_active_blocks", 0),
@@ -156,6 +173,14 @@ class KvMetricsAggregator:
                     draining=d.get("draining", 0),
                     drains_total=d.get("drains_total", 0),
                     migration_resumes=d.get("migration_resumes", 0),
+                    requests_total=d.get("requests_total", 0),
+                    tokens_generated=d.get("tokens_generated", 0),
+                    prompt_tokens_total=d.get("prompt_tokens_total", 0),
+                    # stamped at scrape time: the scheduler ages these
+                    # out (load_ttl_s) instead of trusting a dead
+                    # worker's last report forever
+                    ts=now,
                 )
             )
-        self.endpoints = ProcessedEndpoints(loads)
+        self._known = merged
+        self.endpoints = ProcessedEndpoints(list(merged.values()))

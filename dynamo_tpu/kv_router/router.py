@@ -43,7 +43,12 @@ class KvRouter:
         self.indexer = KvIndexer(drt, component, shards=indexer_shards)
         self.metrics = KvMetricsAggregator(drt, component)
         self.scheduler = KvScheduler(drt, component, config)
+        # planner plane: latest disagg-ratio hint from the capacity
+        # watermark events (advisory — recorded for operators/the disagg
+        # router; 0 until a planner publishes)
+        self.disagg_ratio_hint = 0.0
         self._watch_task = None
+        self._watermark_task = None
 
     async def start(self) -> "KvRouter":
         await self.indexer.start()
@@ -56,7 +61,31 @@ class KvRouter:
         if asyncio.iscoroutine(watcher):
             watcher = await watcher
         self._watch_task = self.drt.runtime.spawn(self._watch_instances(watcher))
+        # planner capacity watermarks: saturated workers stop receiving
+        # new routes until the next tick clears them
+        from ..planner.protocols import PLANNER_WATERMARK_SUBJECT
+
+        sub = self.drt.bus.subscribe(
+            self.component.event_subject(PLANNER_WATERMARK_SUBJECT)
+        )
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._watermark_task = self.drt.runtime.spawn(
+            self._consume_watermarks(sub)
+        )
         return self
+
+    async def _consume_watermarks(self, sub) -> None:
+        from ..planner.protocols import CapacityWatermark
+
+        async for msg in sub:
+            try:
+                wm = CapacityWatermark.from_bytes(msg.payload)
+                self.scheduler.set_watermarks(wm.saturated_workers)
+                self.disagg_ratio_hint = wm.disagg_ratio
+            except Exception:  # noqa: BLE001 — watermarks are advisory
+                logger.debug("bad planner watermark", exc_info=True)
 
     async def _watch_instances(self, watcher) -> None:
         from ..runtime.store import EventKind

@@ -61,6 +61,17 @@ class WorkerLoad:
     draining: int = 0
     drains_total: int = 0
     migration_resumes: int = 0
+    # cumulative serving counters (engine stats): the planner's
+    # telemetry aggregator turns scrape-to-scrape deltas into fleet
+    # arrival/throughput rates
+    requests_total: int = 0
+    tokens_generated: int = 0
+    prompt_tokens_total: int = 0
+    # monotonic stamp set at scrape time (None = constructed directly /
+    # legacy producer): the scheduler discards loads older than
+    # ``SchedulerConfig.load_ttl_s`` instead of trusting a dead
+    # worker's last report
+    ts: Optional[float] = None
 
     @property
     def kv_usage(self) -> float:
@@ -105,12 +116,35 @@ class SchedulerConfig:
     balance_alpha: float = 0.7  # weight on load in balance mode
     balance_threshold: float = 0.2  # load-std that flips to balance mode
     gamma: float = 0.2  # request-load term
+    #: discard WorkerLoad snapshots older than this (stamped at scrape
+    #: time): a worker that died between scrapes keeps advertising its
+    #: last (often attractive, near-idle) load until its lease TTL
+    #: lapses — routing must not trust it. 0 disables the check.
+    #: Default is deliberately >> the 1s scrape interval: it guards a
+    #: wedged metrics plane, not one missed tick (a dead worker drops
+    #: out of the very next successful scrape on its own).
+    load_ttl_s: float = 30.0
+    #: ignore planner capacity watermarks older than this: a planner
+    #: that stopped publishing must not keep its last saturated-worker
+    #: set applied to routing forever (same stale-authority guard as
+    #: load_ttl_s). 0 disables the expiry.
+    watermark_ttl_s: float = 30.0
 
 
 class KvScheduler:
-    def __init__(self, drt=None, component=None, config: Optional[SchedulerConfig] = None):
+    def __init__(self, drt=None, component=None,
+                 config: Optional[SchedulerConfig] = None, clock=None):
+        import time as _time
+
         self.cfg = config or SchedulerConfig()
         self.drt = drt
+        self._clock = clock or _time.monotonic
+        # planner capacity watermarks: worker ids the planner currently
+        # considers saturated — soft-excluded from selection (prefer any
+        # unsaturated worker; fall back rather than refuse when every
+        # candidate is marked)
+        self.watermarked: set[int] = set()
+        self._watermark_ts: Optional[float] = None
         self._hit_subject = (
             component.event_subject(KV_HIT_RATE_SUBJECT) if component else None
         )
@@ -131,6 +165,18 @@ class KvScheduler:
         loads = [l for l in endpoints.loads]
         if not loads:
             raise AllWorkersBusy("no workers")
+        if self.cfg.load_ttl_s > 0:
+            now = self._clock()
+            fresh = [
+                l for l in loads
+                if l.ts is None or now - l.ts <= self.cfg.load_ttl_s
+            ]
+            if not fresh:
+                # every load is stale (metrics plane wedged / all
+                # workers dead): refuse rather than route on fiction —
+                # the caller falls back to round robin over discovery
+                raise AllWorkersBusy("all worker loads stale")
+            loads = fresh
         candidates = [l for l in loads if not l.saturated and not l.draining]
         if not candidates:
             raise AllWorkersBusy("all workers saturated or draining")
@@ -142,6 +188,21 @@ class KvScheduler:
         # set covers every candidate (lone-worker restarts)
         if avoid:
             preferred = [l for l in candidates if l.worker_id not in avoid]
+            candidates = preferred or candidates
+        # planner watermarks: workers at capacity stop receiving NEW
+        # work while they drain their queues — soft, like ``avoid``,
+        # so an all-saturated fleet still serves (the admission gate is
+        # the component that actually sheds). A dead planner's last set
+        # expires (watermark_ttl_s) instead of skewing routing forever
+        if self.watermarked and self.cfg.watermark_ttl_s > 0:
+            if (self._watermark_ts is None
+                    or self._clock() - self._watermark_ts
+                    > self.cfg.watermark_ttl_s):
+                self.watermarked = set()
+        if self.watermarked:
+            preferred = [
+                l for l in candidates if l.worker_id not in self.watermarked
+            ]
             candidates = preferred or candidates
 
         balance_mode = endpoints.load_std > self.cfg.balance_threshold
@@ -167,6 +228,14 @@ class KvScheduler:
         self._pending[best_id] = self._pending.get(best_id, 0) + 1
         self._emit_hit_rate(best_id, isl_blocks, overlaps.scores.get(best_id, 0))
         return best_id
+
+    def set_watermarks(self, saturated_workers) -> None:
+        """Planner capacity-watermark update (full replacement — the
+        planner republishes the complete set every tick, so a worker
+        that cooled off clears automatically; a planner that stops
+        publishing ages out via ``watermark_ttl_s``)."""
+        self.watermarked = set(saturated_workers or ())
+        self._watermark_ts = self._clock()
 
     def request_finished(self, worker_id: int) -> None:
         """Release the optimistic bump once the request lands/completes."""

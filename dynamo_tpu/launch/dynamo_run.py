@@ -315,9 +315,26 @@ async def connect_runtime(args) -> DistributedRuntime:
 # ---------------- in= modes ----------------
 
 
+def _build_admission(args):
+    """--admission-rate > 0 -> the frontend overload gate (planner/
+    admission.py): token-bucket shedding with SLO classes, so admitted
+    requests keep their latency target when offered load exceeds
+    capacity. 0 (default) = admit everything (legacy behavior)."""
+    if args.admission_rate <= 0:
+        return None
+    from ..planner import AdmissionGate
+
+    return AdmissionGate(
+        args.admission_rate,
+        burst=args.admission_burst if args.admission_burst > 0 else None,
+    )
+
+
 async def run_http(args) -> None:
     manager = ModelManager()
-    svc = HttpService(manager, host=args.host, port=args.http_port)
+    admission = _build_admission(args)
+    svc = HttpService(manager, host=args.host, port=args.http_port,
+                      admission=admission)
     if args.out.startswith("dyn://") and args.router == "kv":
         # KV-aware frontend: tokenize locally (for prefix hashing), route
         # each request to the worker with the best cache overlap
@@ -378,6 +395,16 @@ async def run_http(args) -> None:
         manager.add_completion_model(name, engine)
         # single process: local spans feed the collector directly
         svc.tracing = await setup_tracing(args, "frontend", collector=True)
+    if admission is not None and args.out.startswith("dyn://"):
+        # planner capacity watermarks continuously retune the gate's
+        # admission rate to the fleet's corrected serving capacity
+        # (static --admission-rate until the first watermark arrives)
+        from ..planner.admission import start_watermark_follower
+
+        ns, comp_name, _ep = args.out.removeprefix("dyn://").split(".")
+        await start_watermark_follower(
+            drt, drt.namespace(ns).component(comp_name), admission
+        )
     await svc.start()
     print(f"OpenAI server on http://{args.host}:{svc.port} "
           f"(models: {manager.model_names() or 'discovered dynamically'})", flush=True)
@@ -684,6 +711,92 @@ async def run_batch(args, batch_file: str) -> None:
     }), flush=True)
 
 
+async def run_planner(args) -> None:
+    """Standalone SLA planner (``--planner`` / ``in=planner``): the
+    control loop that watches the fleet's load/latency telemetry and
+    resizes the prefill/decode pools against the TTFT/ITL SLOs
+    (docs/planner.md).
+
+    Observes via the same metrics scrape the KV router uses (plus the
+    tracing plane's TTFT decomposition when --trace is on), decides
+    through the roofline-seeded capacity model + Holt forecaster +
+    ScaleGuard rails, and actuates by rewriting replica counts in the
+    deploy controller's store (--deploy-root/--deployment; scale-down
+    rides the controller's SIGTERM -> graceful drain). Decisions and
+    capacity watermarks are published on the worker component's
+    ``planner-decisions``/``planner-watermarks`` subjects for the KV
+    scheduler, frontends, and the metrics component. Without a deploy
+    store target the planner is observe-and-publish only."""
+    from ..kv_router.publisher import KvMetricsAggregator
+    from ..perf import roofline
+    from ..planner import (
+        BusPublisher, CapacityModel, GuardConfig, Planner, PlannerConfig,
+        SloTargets, StoreScaleDriver, TelemetryAggregator,
+    )
+
+    target = (
+        args.out if args.out.startswith("dyn://")
+        else f"dyn://{args.namespace}.worker.generate"
+    )
+    ns, comp_name, _ep = target.removeprefix("dyn://").split(".")
+    drt = await connect_runtime(args)
+    comp = drt.namespace(ns).component(comp_name)
+    collector = await setup_tracing(args, "planner", drt=drt, collector=True)
+    aggregator = await KvMetricsAggregator(drt, comp).start()
+    telemetry = TelemetryAggregator(
+        metrics_aggregator=aggregator, trace_collector=collector
+    )
+    if args.planner_capacity:
+        parts = [float(x) for x in args.planner_capacity.split(",")]
+        capacity = CapacityModel(parts[0], parts[1] if len(parts) > 1 else parts[0])
+    else:
+        sc = next(
+            (s for s in roofline.DEFAULT_SCENARIOS
+             if s.name == args.planner_scenario), None,
+        )
+        if sc is None:
+            names = ", ".join(s.name for s in roofline.DEFAULT_SCENARIOS)
+            raise SystemExit(
+                f"unknown --planner-scenario {args.planner_scenario!r} "
+                f"(have: {names})"
+            )
+        capacity = CapacityModel.from_roofline(sc)
+    driver = None
+    if args.deploy_root and args.deployment:
+        from ..deploy.api_server import DeploymentStore
+
+        driver = StoreScaleDriver(
+            DeploymentStore(args.deploy_root), args.deployment
+        )
+    cfg = PlannerConfig(
+        tick_s=args.planner_tick,
+        slo=SloTargets(
+            ttft_p99_ms=args.slo_ttft_ms, itl_p99_ms=args.slo_itl_ms
+        ),
+        decode_guard=GuardConfig(
+            min_replicas=args.planner_min_replicas,
+            max_replicas=args.planner_max_replicas,
+        ),
+        prefill_guard=GuardConfig(
+            min_replicas=0, max_replicas=args.planner_max_replicas
+        ),
+        prefill_pool=args.planner_pools == "disagg",
+    )
+    planner = Planner(
+        telemetry, capacity, cfg,
+        scale_driver=driver, publisher=BusPublisher(drt, comp),
+    )
+    print(
+        f"planner watching {target} every {cfg.tick_s}s "
+        f"(SLO ttft p99 <= {cfg.slo.ttft_p99_ms:.0f}ms, "
+        f"itl p99 <= {cfg.slo.itl_p99_ms:.0f}ms; "
+        f"actuator: {'deploy store' if driver else 'publish-only'})",
+        flush=True,
+    )
+    planner.start()
+    await asyncio.Event().wait()
+
+
 async def run_hub(args) -> None:
     hub = HubServer(host=args.host, port=args.hub_port, data_dir=args.data_dir)
     await hub.start()
@@ -784,6 +897,41 @@ def main(argv=None) -> None:
                    help="SIGTERM graceful-drain budget (s): in-flight "
                         "requests get this long to finish before being "
                         "handed off to surviving workers")
+    p.add_argument("--admission-rate", type=float, default=0.0,
+                   help="frontend overload gate: admitted req/s "
+                        "(token bucket; planner watermarks retune it "
+                        "live; 0 = admit everything). Shed requests "
+                        "get 429 + Retry-After before any engine work")
+    p.add_argument("--admission-burst", type=float, default=0.0,
+                   help="admission gate burst size (0 = max(rate, 1))")
+    p.add_argument("--planner", action="store_true",
+                   help="run the standalone SLA planner role "
+                        "(equivalent to in=planner)")
+    p.add_argument("--planner-tick", type=float, default=2.0,
+                   help="planner control-loop period (s)")
+    p.add_argument("--slo-ttft-ms", type=float, default=2000.0,
+                   help="planner SLO: TTFT p99 target (ms)")
+    p.add_argument("--slo-itl-ms", type=float, default=200.0,
+                   help="planner SLO: inter-token-latency p99 target (ms)")
+    p.add_argument("--planner-scenario", default="8b-int8-v5e1",
+                   help="roofline scenario seeding the capacity model "
+                        "(perf/roofline.py DEFAULT_SCENARIOS name)")
+    p.add_argument("--planner-capacity", default=None,
+                   help="explicit per-replica capacity seed "
+                        "'DECODE_TOK_S[,PREFILL_TOK_S]' (overrides "
+                        "--planner-scenario)")
+    p.add_argument("--planner-min-replicas", type=int, default=1)
+    p.add_argument("--planner-max-replicas", type=int, default=8)
+    p.add_argument("--planner-pools", default="aggregated",
+                   choices=["aggregated", "disagg"],
+                   help="disagg: size a separate prefill pool; "
+                        "aggregated: TTFT breaches grow the decode pool")
+    p.add_argument("--deploy-root", default=None,
+                   help="planner actuator: deploy controller store root "
+                        "(with --deployment; omit for publish-only)")
+    p.add_argument("--deployment", default=None,
+                   help="planner actuator: deployment name whose "
+                        "worker/prefill services the planner resizes")
     p.add_argument("--engine-subprocess", action="store_true",
                    help="isolate a pystr:/pytok: engine in a child process")
     p.add_argument("--warmup", action="store_true",
@@ -815,6 +963,8 @@ def main(argv=None) -> None:
             args.out = tok[4:]
         elif tok == "hub":
             args.in_ = "hub"
+    if args.planner:
+        args.in_ = "planner"
 
     from ..utils.logging import setup_logging
     setup_logging()
@@ -831,6 +981,8 @@ def main(argv=None) -> None:
         coro = run_batch(args, args.in_[len("batch:"):])
     elif args.in_ == "prefill":
         coro = run_prefill(args)
+    elif args.in_ == "planner":
+        coro = run_planner(args)
     elif args.in_.startswith("dyn://"):
         coro = run_endpoint(args)
     else:

@@ -22,6 +22,12 @@ from typing import Optional
 
 from ..kv_router.protocols import KV_HIT_RATE_SUBJECT, KVHitRateEvent
 from ..kv_router.publisher import KvMetricsAggregator
+from ..planner.protocols import (
+    PLANNER_DECISION_SUBJECT,
+    PLANNER_WATERMARK_SUBJECT,
+    CapacityWatermark,
+    PlannerDecision,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -47,8 +53,13 @@ class MetricsComponent:
         self.hit_events = 0
         self.hit_isl_blocks = 0
         self.hit_overlap_blocks = 0
+        # planner plane: last decision + watermark seen on the bus
+        self.planner_decision: Optional[PlannerDecision] = None
+        self.planner_watermark: Optional[CapacityWatermark] = None
+        self.planner_decisions_total = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._hit_task = None
+        self._planner_task = None
         # per-request trace collector (tracing.TraceCollector): assembles
         # trace-events spans into timelines, feeds the TTFT-decomposition
         # percentile gauges and the /trace/{request_id} endpoint
@@ -70,6 +81,22 @@ class MetricsComponent:
         if ready is not None:
             await ready
         self._hit_task = self.drt.runtime.spawn(self._consume_hits(sub))
+        psub = self.drt.bus.subscribe(
+            self.component.event_subject(PLANNER_DECISION_SUBJECT)
+        )
+        wsub = self.drt.bus.subscribe(
+            self.component.event_subject(PLANNER_WATERMARK_SUBJECT)
+        )
+        for s in (psub, wsub):
+            ready = getattr(s, "ready", None)
+            if ready is not None:
+                await ready
+        self._planner_task = self.drt.runtime.spawn(
+            self._consume_decisions(psub)
+        )
+        self._watermark_task = self.drt.runtime.spawn(
+            self._consume_watermarks(wsub)
+        )
         if self.tracing is not None and self.tracing.drt is not None:
             await self.tracing.start()
         self._server = await asyncio.start_server(
@@ -81,6 +108,9 @@ class MetricsComponent:
     async def close(self) -> None:
         if self._hit_task is not None:
             self._hit_task.cancel()
+        for t in (self._planner_task, getattr(self, "_watermark_task", None)):
+            if t is not None:
+                t.cancel()
         if self.tracing is not None:
             await self.tracing.close()
         if self._server is not None:
@@ -96,6 +126,23 @@ class MetricsComponent:
                 self.hit_overlap_blocks += ev.overlap_blocks
             except Exception:  # noqa: BLE001
                 logger.exception("bad kv-hit-rate event")
+
+    async def _consume_decisions(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.planner_decision = PlannerDecision.from_bytes(msg.payload)
+                self.planner_decisions_total += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("bad planner decision event")
+
+    async def _consume_watermarks(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.planner_watermark = CapacityWatermark.from_bytes(
+                    msg.payload
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("bad planner watermark event")
 
     # ---------------- rendering ----------------
 
@@ -130,6 +177,10 @@ class MetricsComponent:
             gauge("draining", w.draining, lb)
             gauge("drains_total", w.drains_total, lb)
             gauge("migration_resumes_total", w.migration_resumes, lb)
+            # cumulative serving counters (planner telemetry inputs)
+            gauge("requests_served_total", w.requests_total, lb)
+            gauge("tokens_generated_total", w.tokens_generated, lb)
+            gauge("prompt_tokens_total", w.prompt_tokens_total, lb)
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))
@@ -139,6 +190,23 @@ class MetricsComponent:
                 round(self.hit_overlap_blocks / self.hit_isl_blocks, 6),
             )
         gauge("kv_hit_events_total", self.hit_events)
+        # SLA planner plane (docs/planner.md): the last decision +
+        # capacity watermark this component saw on the bus
+        gauge("planner_decisions_total", self.planner_decisions_total)
+        d = self.planner_decision
+        if d is not None:
+            gauge("planner_decode_replicas", d.decode_replicas)
+            gauge("planner_prefill_replicas", d.prefill_replicas)
+            gauge("planner_disagg_ratio", round(d.disagg_ratio, 6))
+            gauge("planner_request_rate", round(d.request_rate, 6))
+            gauge("planner_gen_token_rate", round(d.gen_token_rate, 6))
+        w = self.planner_watermark
+        if w is not None:
+            gauge("planner_saturated_workers", len(w.saturated_workers))
+            gauge("planner_cluster_utilization",
+                  round(w.cluster_utilization, 6))
+            gauge("planner_admission_rate_req_s",
+                  round(w.admission_rate_req_s, 6))
         if self.tracing is not None:
             # per-request TTFT decomposition percentiles (tracing plane):
             # where TTFT actually went, fleet-wide — queue wait vs KV

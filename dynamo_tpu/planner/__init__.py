@@ -1,0 +1,62 @@
+"""SLA-driven planner: predictive prefill/decode autoscaling + frontend
+overload control (docs/planner.md).
+
+The control-loop component the reference Dynamo stack ships as
+``components/planner``: a telemetry aggregator over the metrics/load
+plane, an SLO evaluator + Holt load forecaster seeded by the roofline
+capacity model, guarded scale actuators targeting the deploy
+controller's replica API, and the frontend token-bucket admission gate
+with per-request SLO classes.
+"""
+
+from .admission import (
+    DEFAULT_CLASSES,
+    AdmissionDecision,
+    AdmissionGate,
+    SloClass,
+    TokenBucket,
+)
+from .actuators import BusPublisher, CallbackScaleDriver, StoreScaleDriver
+from .guard import GuardConfig, ScaleAction, ScaleGuard
+from .planner import Planner, PlannerConfig
+from .predictor import (
+    CapacityModel,
+    HoltForecaster,
+    SloEvaluator,
+    SloStatus,
+    SloTargets,
+)
+from .protocols import (
+    PLANNER_DECISION_SUBJECT,
+    PLANNER_WATERMARK_SUBJECT,
+    CapacityWatermark,
+    PlannerDecision,
+)
+from .telemetry import ClusterSnapshot, TelemetryAggregator
+
+__all__ = [
+    "AdmissionDecision",
+    "AdmissionGate",
+    "BusPublisher",
+    "CallbackScaleDriver",
+    "CapacityModel",
+    "CapacityWatermark",
+    "ClusterSnapshot",
+    "DEFAULT_CLASSES",
+    "GuardConfig",
+    "HoltForecaster",
+    "PLANNER_DECISION_SUBJECT",
+    "PLANNER_WATERMARK_SUBJECT",
+    "Planner",
+    "PlannerConfig",
+    "PlannerDecision",
+    "ScaleAction",
+    "ScaleGuard",
+    "SloClass",
+    "SloEvaluator",
+    "SloStatus",
+    "SloTargets",
+    "StoreScaleDriver",
+    "TelemetryAggregator",
+    "TokenBucket",
+]

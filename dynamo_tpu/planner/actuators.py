@@ -1,0 +1,134 @@
+"""Planner actuators: replica-count drivers + bus publication.
+
+The planner *decides*; these carry the decision out:
+
+  * :class:`StoreScaleDriver` — targets the deploy controller's replica
+    API: rewrites the service's ``replicas`` in the
+    :class:`~dynamo_tpu.deploy.api_server.DeploymentStore` and lets the
+    controller's reconcile loop converge processes. Scale-down is
+    drain-aware for free: the controller terminates excess replicas
+    with SIGTERM, which the worker's DrainCoordinator (resilience/
+    drain.py) turns into deregister -> finish-or-hand-off -> lease
+    revoke — the planner never has to pick a victim or kill anything
+    itself.
+  * :class:`CallbackScaleDriver` — embedding/test hook: records every
+    (pool, replicas) application and forwards to an optional callable.
+  * :class:`BusPublisher` — publishes :class:`PlannerDecision` and
+    :class:`CapacityWatermark` events on the target component's
+    subjects for the KV router, frontends, and the metrics component.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from dynamo_tpu.http.base import HttpError
+
+from .protocols import (
+    PLANNER_DECISION_SUBJECT,
+    PLANNER_WATERMARK_SUBJECT,
+    CapacityWatermark,
+    PlannerDecision,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CallbackScaleDriver:
+    """Records applications; optionally forwards to ``fn(pool, n)``."""
+
+    def __init__(self, fn: Optional[Callable[[str, int], None]] = None):
+        self._fn = fn
+        self.applied: list[tuple[str, int]] = []
+        self.replicas: dict[str, int] = {}
+
+    def set_replicas(self, pool: str, n: int) -> bool:
+        if self.replicas.get(pool) == n:
+            return False
+        self.replicas[pool] = n
+        self.applied.append((pool, n))
+        if self._fn is not None:
+            self._fn(pool, n)
+        return True
+
+    def current(self, pool: str) -> Optional[int]:
+        return self.replicas.get(pool)
+
+
+class StoreScaleDriver:
+    """Writes replica counts into one DynamoDeployment's services.
+
+    ``pools`` maps the planner's pool names to service names in the
+    deployment (e.g. ``{"decode": "worker", "prefill": "prefill"}``);
+    a pool with no mapped service is ignored (aggregated clusters have
+    no prefill pool to size)."""
+
+    def __init__(self, store, deployment: str,
+                 pools: Optional[dict[str, str]] = None):
+        self.store = store
+        self.deployment = deployment
+        self.pools = pools or {"decode": "worker", "prefill": "prefill"}
+
+    def current(self, pool: str) -> Optional[int]:
+        svc_name = self.pools.get(pool)
+        if svc_name is None:
+            return None
+        try:
+            spec = self.store.get(self.deployment)
+        except (KeyError, HttpError):
+            return None
+        for svc in spec.get("services", []):
+            if svc.get("name") == svc_name:
+                return int(svc.get("replicas", 1))
+        return None
+
+    def set_replicas(self, pool: str, n: int) -> bool:
+        svc_name = self.pools.get(pool)
+        if svc_name is None:
+            return False
+        try:
+            spec = self.store.get(self.deployment)
+        except (KeyError, HttpError):
+            logger.warning("planner target deployment %r missing",
+                           self.deployment)
+            return False
+        for svc in spec.get("services", []):
+            if svc.get("name") == svc_name:
+                if int(svc.get("replicas", 1)) == n:
+                    return False
+                svc["replicas"] = int(n)
+                self.store.put(self.deployment, spec, create=False)
+                logger.info("planner: %s/%s replicas -> %d",
+                            self.deployment, svc_name, n)
+                return True
+        logger.warning("planner pool %r: service %r not in deployment %r",
+                       pool, svc_name, self.deployment)
+        return False
+
+
+class BusPublisher:
+    """Best-effort event publication (a lost decision event costs
+    observability, never correctness — the next tick republishes)."""
+
+    def __init__(self, drt, component):
+        self.drt = drt
+        self._decision_subject = component.event_subject(
+            PLANNER_DECISION_SUBJECT
+        )
+        self._watermark_subject = component.event_subject(
+            PLANNER_WATERMARK_SUBJECT
+        )
+        self.published = 0
+
+    def publish(self, decision: PlannerDecision,
+                watermark: CapacityWatermark) -> None:
+        for subject, ev in (
+            (self._decision_subject, decision),
+            (self._watermark_subject, watermark),
+        ):
+            try:
+                self.drt.bus.publish(subject, ev.to_bytes())
+                self.published += 1
+            except Exception:  # noqa: BLE001
+                logger.debug("planner publish failed", exc_info=True)

@@ -1,0 +1,226 @@
+"""Frontend overload control: token-bucket admission with SLO classes.
+
+When offered load exceeds what any scale decision can absorb, admitting
+everything means EVERY request misses its SLO (unbounded queueing). The
+gate sheds the excess instead — shed requests get an immediate 429 +
+Retry-After (cheap for the client to retry elsewhere/later), admitted
+requests keep their latency target.
+
+Mechanics:
+
+  * one global :class:`TokenBucket` (req/s rate + burst) — the rate is
+    the cluster's serving capacity, configured or continuously updated
+    from the planner's capacity watermarks;
+  * per-request SLO classes, annotation-driven (``nvext.annotations:
+    ["slo:batch"]``): each class declares a ``reserve_frac`` — the
+    bucket floor it may not drain below. Batch traffic reserves
+    capacity for interactive traffic; interactive can spend the whole
+    bucket. Priority without starvation bookkeeping;
+  * queue-depth-bounded shedding: each class caps how many of its
+    requests may be in flight (admitted, unfinished) — a stalled fleet
+    bounds its queue instead of timing everyone out.
+
+Deterministic: clock injected, no background task — refill is computed
+lazily on each admit.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class SloClass:
+    name: str
+    #: fraction of the bucket's burst this class must leave for more
+    #: latency-critical classes (0 = may drain the bucket dry)
+    reserve_frac: float = 0.0
+    #: max in-flight (admitted, unfinished) requests of this class
+    max_inflight: int = 256
+    #: floor for the Retry-After hint (the real hint also accounts for
+    #: the bucket's refill time)
+    min_retry_after_s: float = 1.0
+
+
+#: default ladder: interactive drains the whole bucket; batch keeps half
+#: the burst in reserve for interactive and tolerates a shorter queue
+DEFAULT_CLASSES = (
+    SloClass("interactive", reserve_frac=0.0, max_inflight=256,
+             min_retry_after_s=1.0),
+    SloClass("batch", reserve_frac=0.5, max_inflight=64,
+             min_retry_after_s=5.0),
+)
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate <= 0 or burst <= 0:
+            raise ValueError("rate and burst must be > 0")
+        self.rate = rate
+        self.burst = burst
+        self._clock = clock
+        self.level = burst
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        self.level = min(self.burst, self.level + (now - self._last) * self.rate)
+        self._last = now
+
+    def set_rate(self, rate: float, burst: Optional[float] = None) -> None:
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        self._refill(self._clock())
+        self.rate = rate
+        if burst is not None and burst > 0:
+            self.burst = burst
+            self.level = min(self.level, burst)
+
+    def try_take(self, n: float = 1.0, floor: float = 0.0) -> bool:
+        """Take ``n`` tokens unless that would leave fewer than
+        ``floor`` in the bucket (the reserve kept for higher classes)."""
+        self._refill(self._clock())
+        if self.level - n < floor - 1e-9:
+            return False
+        self.level -= n
+        return True
+
+    def time_until(self, n: float = 1.0, floor: float = 0.0) -> float:
+        """Seconds until ``try_take(n, floor)`` could succeed."""
+        self._refill(self._clock())
+        deficit = (floor + n) - self.level
+        return max(0.0, deficit / self.rate)
+
+
+@dataclass
+class AdmissionDecision:
+    admitted: bool
+    slo_class: str = "interactive"
+    reason: str = ""  # "rate" | "queue" when shed
+    retry_after_s: float = 0.0
+
+
+class AdmissionGate:
+    """``admit()`` before dispatch, ``done()`` when the stream ends
+    (success or not) — the inflight counts bound the queue."""
+
+    ANNOTATION_PREFIX = "slo:"
+
+    def __init__(
+        self,
+        rate_req_s: float,
+        burst: Optional[float] = None,
+        classes: tuple[SloClass, ...] = DEFAULT_CLASSES,
+        clock: Callable[[], float] = time.monotonic,
+        telemetry=None,
+    ):
+        self.bucket = TokenBucket(
+            rate_req_s, burst if burst is not None else max(rate_req_s, 1.0),
+            clock,
+        )
+        self.classes = {c.name: c for c in classes}
+        self.default_class = classes[0].name
+        #: optional TelemetryAggregator — arrivals feed the planner
+        self.telemetry = telemetry
+        self.inflight: dict[str, int] = {c.name: 0 for c in classes}
+        self.stats = {"admitted_total": 0, "shed_total": 0}
+        for c in classes:
+            self.stats[f"admitted_{c.name}"] = 0
+            self.stats[f"shed_{c.name}"] = 0
+
+    # -- classification --
+
+    def classify(self, annotations: Optional[list] = None) -> str:
+        """``slo:<class>`` annotation -> class name (unknown classes fall
+        back to the default rather than 400ing the request)."""
+        for a in annotations or ():
+            if isinstance(a, str) and a.startswith(self.ANNOTATION_PREFIX):
+                name = a[len(self.ANNOTATION_PREFIX):]
+                if name in self.classes:
+                    return name
+        return self.default_class
+
+    # -- planner plane --
+
+    def set_rate(self, rate_req_s: float, burst: Optional[float] = None) -> None:
+        """Planner watermark update: hold admission at cluster capacity."""
+        if rate_req_s > 0:
+            self.bucket.set_rate(rate_req_s, burst)
+
+    # -- the gate --
+
+    def admit(self, slo_class: Optional[str] = None,
+              prompt_tokens: int = 0) -> AdmissionDecision:
+        name = slo_class if slo_class in self.classes else self.default_class
+        cls = self.classes[name]
+        if self.telemetry is not None:
+            self.telemetry.record_arrival(prompt_tokens)
+        if self.inflight[name] >= cls.max_inflight:
+            return self._shed(cls, "queue", cls.min_retry_after_s)
+        # the reserve may never consume the whole bucket: cap the floor
+        # so a full bucket always admits one request of ANY class (at
+        # burst < 2 an uncapped batch floor of burst/2 would starve the
+        # class forever, even on an idle gate)
+        floor = min(self.bucket.burst * cls.reserve_frac,
+                    max(self.bucket.burst - 1.0, 0.0))
+        if not self.bucket.try_take(1.0, floor=floor):
+            wait = self.bucket.time_until(1.0, floor=floor)
+            return self._shed(
+                cls, "rate", max(cls.min_retry_after_s, math.ceil(wait))
+            )
+        self.inflight[name] += 1
+        self.stats["admitted_total"] += 1
+        self.stats[f"admitted_{name}"] += 1
+        return AdmissionDecision(True, name)
+
+    def _shed(self, cls: SloClass, reason: str,
+              retry_after: float) -> AdmissionDecision:
+        self.stats["shed_total"] += 1
+        self.stats[f"shed_{cls.name}"] += 1
+        return AdmissionDecision(False, cls.name, reason, retry_after)
+
+    def done(self, slo_class: str) -> None:
+        name = slo_class if slo_class in self.inflight else self.default_class
+        self.inflight[name] = max(0, self.inflight[name] - 1)
+
+    # -- metrics surface (http.Metrics.register_source) --
+
+    def render_stats(self) -> dict:
+        out = {f"admission_{k}": v for k, v in self.stats.items()}
+        out["admission_rate_req_s"] = round(self.bucket.rate, 6)
+        for name, n in self.inflight.items():
+            out[f"admission_inflight_{name}"] = n
+        return out
+
+
+async def start_watermark_follower(drt, component, gate: AdmissionGate):
+    """Subscribe the planner's capacity watermarks and hold the gate's
+    admission rate at the published cluster capacity (frontend-side
+    wiring for `dynamo_run in=http ... --admission-rate`). Returns the
+    consumer task; keep a reference for the frontend's lifetime."""
+    from .protocols import PLANNER_WATERMARK_SUBJECT, CapacityWatermark
+
+    sub = drt.bus.subscribe(
+        component.event_subject(PLANNER_WATERMARK_SUBJECT)
+    )
+    ready = getattr(sub, "ready", None)
+    if ready is not None:
+        await ready
+
+    async def _consume():
+        import logging
+
+        log = logging.getLogger(__name__)
+        async for msg in sub:
+            try:
+                wm = CapacityWatermark.from_bytes(msg.payload)
+                # set_rate ignores rate <= 0 (planner has no mix yet:
+                # keep the configured rate)
+                gate.set_rate(wm.admission_rate_req_s)
+            except Exception:  # noqa: BLE001 — watermarks are advisory
+                log.debug("bad capacity watermark", exc_info=True)
+
+    return drt.runtime.spawn(_consume())

@@ -1,0 +1,116 @@
+"""Scale-decision guard rails: hysteresis, cooldown, min/max bounds.
+
+One implementation shared by the planner's prefill/decode scale drivers
+and the deploy controller's queue-depth autoscaler — the reference
+planner ships the same idea as ``adjustment_interval`` plus blocked
+scale-down windows, and the operator grew flap guards independently;
+here both planes ride ONE guard so the rails can't drift.
+
+Semantics (asymmetric on purpose — under-provisioning breaks SLOs,
+over-provisioning only costs chips):
+
+  * scale UP applies immediately, paced only by ``up_cooldown_s``
+    between consecutive up actions;
+  * scale DOWN applies only after the desire has been *continuously*
+    below the current value for ``down_stable_s`` (the time-domain
+    hysteresis band — an oscillating signal keeps resetting the window
+    and never scales down) AND ``down_cooldown_s`` has elapsed since the
+    last action in either direction;
+  * everything is clamped to ``[min_replicas, max_replicas]``.
+
+Deterministic under test: the clock is injected.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class GuardConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: min seconds between consecutive scale-up actions (0 = every tick)
+    up_cooldown_s: float = 0.0
+    #: min seconds after ANY action before a scale-down may apply
+    down_cooldown_s: float = 60.0
+    #: the desire must sit below current for this long, continuously,
+    #: before a scale-down applies (hysteresis window)
+    down_stable_s: float = 30.0
+
+    def validate(self) -> None:
+        if self.min_replicas > self.max_replicas:
+            raise ValueError("min_replicas > max_replicas")
+        if min(self.up_cooldown_s, self.down_cooldown_s,
+               self.down_stable_s) < 0:
+            raise ValueError("guard windows must be >= 0")
+
+
+@dataclass
+class ScaleAction:
+    ts: float
+    from_replicas: int
+    to_replicas: int
+
+    @property
+    def direction(self) -> str:
+        return "up" if self.to_replicas > self.from_replicas else "down"
+
+
+class ScaleGuard:
+    """Feed it the raw desired replica count every tick; it returns the
+    guarded value to actually apply and records each real change in
+    ``actions`` (the no-flap assertions in tests count these)."""
+
+    def __init__(
+        self,
+        cfg: Optional[GuardConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        initial: Optional[int] = None,
+    ):
+        self.cfg = cfg or GuardConfig()
+        self.cfg.validate()
+        self._clock = clock
+        self.current: Optional[int] = (
+            None if initial is None else self._clamp(initial)
+        )
+        self._last_action = -math.inf
+        self._below_since: Optional[float] = None
+        self.actions: list[ScaleAction] = []
+
+    def _clamp(self, n: int) -> int:
+        return max(self.cfg.min_replicas, min(self.cfg.max_replicas, int(n)))
+
+    def _act(self, to: int, now: float) -> None:
+        assert self.current is not None
+        self.actions.append(ScaleAction(now, self.current, to))
+        self.current = to
+        self._last_action = now
+        self._below_since = None
+
+    def apply(self, desired: int) -> int:
+        """One tick: raw desire in, guarded replica count out."""
+        now = self._clock()
+        desired = self._clamp(desired)
+        if self.current is None:
+            # seeding (spec value / first observation) is not an action
+            self.current = desired
+            return self.current
+        if desired > self.current:
+            self._below_since = None
+            if now - self._last_action >= self.cfg.up_cooldown_s:
+                self._act(desired, now)
+        elif desired < self.current:
+            if self._below_since is None:
+                self._below_since = now
+            if (
+                now - self._below_since >= self.cfg.down_stable_s
+                and now - self._last_action >= self.cfg.down_cooldown_s
+            ):
+                self._act(desired, now)
+        else:
+            self._below_since = None
+        return self.current

@@ -1,0 +1,277 @@
+"""The SLA-driven control loop: snapshot -> evaluate -> plan -> actuate.
+
+Closes the loop the reference Dynamo planner owns (components/planner):
+observe TTFT/ITL against SLA targets plus forecast arrival rates, and
+resize the prefill/decode pools accordingly — while the frontend's
+admission gate and the KV scheduler's capacity watermarks protect the
+admitted requests when offered load outruns any scale decision.
+
+Every tick is synchronous and deterministic (injected clock, pure
+inputs); ``run()`` merely schedules ticks on an interval. Unit tests
+drive ``tick()`` directly against scripted traces.
+
+Scaling policy per tick:
+
+  1. demand floor — Holt-forecast prompt/gen token arrival rates over
+     the telemetry window, divided by the capacity model's corrected
+     per-replica rates at ``headroom`` utilization;
+  2. SLO push — a TTFT-p99 breach sustained past the grace window asks
+     for one more prefill replica (TTFT is prefill/queue bound; in an
+     aggregated cluster with no prefill pool it bumps decode instead),
+     a sustained ITL-p99 breach one more decode replica;
+  3. guard rails — both pools' desires pass through
+     :class:`~dynamo_tpu.planner.guard.ScaleGuard` (hysteresis,
+     cooldown, min/max bounds) so the fleet never flaps;
+  4. actuate — replica counts to the scale driver (deploy controller
+     replica API; scale-down rides SIGTERM -> DrainCoordinator), and a
+     :class:`CapacityWatermark` (saturated workers + admission rate +
+     disagg ratio) onto the bus for the KV scheduler and the frontend
+     gate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .guard import GuardConfig, ScaleGuard
+from .predictor import CapacityModel, HoltForecaster, SloEvaluator, SloTargets
+from .protocols import CapacityWatermark, PlannerDecision
+from .telemetry import ClusterSnapshot, TelemetryAggregator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    tick_s: float = 2.0
+    slo: SloTargets = field(default_factory=SloTargets)
+    #: target utilization of modeled capacity (fraction of roofline the
+    #: fleet is sized to run at — the rest is burst headroom)
+    headroom: float = 0.8
+    #: Holt horizon in ticks: plan for the rate ~this far ahead
+    forecast_horizon: float = 2.0
+    decode_guard: GuardConfig = field(default_factory=GuardConfig)
+    prefill_guard: GuardConfig = field(
+        default_factory=lambda: GuardConfig(min_replicas=0, max_replicas=8)
+    )
+    #: False = aggregated cluster: no prefill pool to size, TTFT
+    #: breaches push the decode pool instead
+    prefill_pool: bool = True
+    #: per-worker saturation watermarks (telemetry.saturated_workers)
+    watermark_slot_frac: float = 0.9
+    watermark_kv_frac: float = 0.9
+    #: only fold observed throughput into the capacity correction when
+    #: the fleet is at least this utilized — an idle fleet's low tok/s
+    #: measures demand, not capacity
+    correction_min_utilization: float = 0.8
+
+
+class Planner:
+    def __init__(
+        self,
+        telemetry: TelemetryAggregator,
+        capacity: CapacityModel,
+        config: Optional[PlannerConfig] = None,
+        scale_driver=None,
+        publisher=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = config or PlannerConfig()
+        self.telemetry = telemetry
+        self.capacity = capacity
+        self.scale_driver = scale_driver
+        self.publisher = publisher
+        self._clock = clock
+        self.decode_guard = ScaleGuard(self.cfg.decode_guard, clock)
+        self.prefill_guard = ScaleGuard(self.cfg.prefill_guard, clock)
+        self.slo = SloEvaluator(self.cfg.slo, clock)
+        self.req_forecast = HoltForecaster()
+        self.prompt_forecast = HoltForecaster()
+        self.gen_forecast = HoltForecaster()
+        self.last_decision: Optional[PlannerDecision] = None
+        self.last_watermark: Optional[CapacityWatermark] = None
+        self.stats = {"ticks": 0, "scale_ups": 0, "scale_downs": 0,
+                      "ttft_breach_ticks": 0, "itl_breach_ticks": 0}
+        self._task: Optional[asyncio.Task] = None
+
+    # ---------------- the control step ----------------
+
+    def tick(self) -> PlannerDecision:
+        self.stats["ticks"] += 1
+        snap = self.telemetry.snapshot()
+        self.req_forecast.update(snap.request_rate)
+        self.prompt_forecast.update(snap.prompt_token_rate)
+        self.gen_forecast.update(snap.gen_token_rate)
+
+        # seed the guards from the live fleet on first sight, so the
+        # planner's baseline is what actually runs, not a config guess
+        if self.decode_guard.current is None and snap.decode_replicas:
+            self.decode_guard.apply(snap.decode_replicas)
+        cur_decode = (
+            self.decode_guard.current
+            if self.decode_guard.current is not None
+            else max(snap.decode_replicas, 1)
+        )
+        cur_prefill = (
+            self.prefill_guard.current
+            if self.prefill_guard.current is not None
+            else self.cfg.prefill_guard.min_replicas
+        )
+
+        # online capacity correction — only when the fleet is loaded
+        # enough that throughput measures capacity rather than demand
+        if (
+            snap.gen_token_rate > 0
+            and snap.decode_replicas
+            and snap.slot_utilization >= self.cfg.correction_min_utilization
+        ):
+            self.capacity.observe_decode(
+                snap.gen_token_rate, snap.decode_replicas
+            )
+
+        # 1. demand floor from the forecast
+        h = self.cfg.forecast_horizon
+        gen_f = self.gen_forecast.forecast(h)
+        prompt_f = self.prompt_forecast.forecast(h)
+        want_decode = self.capacity.decode_replicas_for(
+            gen_f, self.cfg.headroom
+        )
+        want_prefill = (
+            self.capacity.prefill_replicas_for(prompt_f, self.cfg.headroom)
+            if self.cfg.prefill_pool and prompt_f > 0
+            else self.cfg.prefill_guard.min_replicas
+        )
+
+        # 2. SLO push
+        status = self.slo.evaluate(snap.ttft_p99_ms, snap.itl_p99_ms)
+        reason = "demand" if want_decode != cur_decode else "steady"
+        if status.ttft_breached:
+            self.stats["ttft_breach_ticks"] += 1
+        if status.itl_breached:
+            self.stats["itl_breach_ticks"] += 1
+        if status.ttft_sustained:
+            reason = "ttft_breach"
+            if self.cfg.prefill_pool:
+                want_prefill = max(want_prefill, cur_prefill + 1)
+            else:
+                want_decode = max(want_decode, cur_decode + 1)
+        if status.itl_sustained:
+            reason = "itl_breach"
+            want_decode = max(want_decode, cur_decode + 1)
+
+        # 3. guard rails
+        decode_n = self.decode_guard.apply(want_decode)
+        prefill_n = self.prefill_guard.apply(want_prefill)
+        moved = decode_n != cur_decode or (
+            self.cfg.prefill_pool and prefill_n != cur_prefill
+        )
+        held = want_decode != decode_n or (
+            self.cfg.prefill_pool and want_prefill != prefill_n
+        )
+        if not moved and held and reason in ("demand", "ttft_breach",
+                                             "itl_breach"):
+            # the guards vetoed every desired change this tick
+            reason = "cooldown_hold"
+
+        # 4. actuate
+        if self.scale_driver is not None:
+            try:
+                self.scale_driver.set_replicas("decode", decode_n)
+                if self.cfg.prefill_pool:
+                    self.scale_driver.set_replicas("prefill", prefill_n)
+            except Exception:  # noqa: BLE001 — a broken actuator must
+                logger.exception("scale driver failed")  # not kill the loop
+
+        decision = PlannerDecision(
+            ts=self._clock(),
+            decode_replicas=decode_n,
+            prefill_replicas=prefill_n if self.cfg.prefill_pool else 0,
+            reason=reason,
+            request_rate=round(snap.request_rate, 6),
+            prompt_token_rate=round(snap.prompt_token_rate, 6),
+            gen_token_rate=round(snap.gen_token_rate, 6),
+            ttft_p99_ms=snap.ttft_p99_ms or 0.0,
+            itl_p99_ms=snap.itl_p99_ms or 0.0,
+            disagg_ratio=round(
+                prefill_n / max(prefill_n + decode_n, 1), 6
+            ) if self.cfg.prefill_pool else 0.0,
+        )
+        watermark = self._watermark(snap, decision)
+        if self.publisher is not None:
+            try:
+                self.publisher.publish(decision, watermark)
+            except Exception:  # noqa: BLE001
+                logger.exception("planner publish failed")
+        self._fold_action_stats()
+        self.last_decision = decision
+        self.last_watermark = watermark
+        return decision
+
+    def _watermark(self, snap: ClusterSnapshot,
+                   decision: PlannerDecision) -> CapacityWatermark:
+        # admission rate = corrected decode capacity at headroom,
+        # converted to req/s via the observed tokens-per-request mix;
+        # 0 (= leave the gate alone) until there's a real mix to use
+        rate = 0.0
+        if snap.request_rate > 0 and snap.gen_token_rate > 0:
+            mean_gen = snap.gen_token_rate / snap.request_rate
+            rate = (
+                self.capacity.decode_tok_s(decision.decode_replicas)
+                * self.cfg.headroom / max(mean_gen, 1e-9)
+            )
+        return CapacityWatermark(
+            ts=decision.ts,
+            saturated_workers=snap.saturated_workers(
+                self.cfg.watermark_slot_frac, self.cfg.watermark_kv_frac
+            ),
+            cluster_utilization=round(snap.slot_utilization, 6),
+            admission_rate_req_s=round(rate, 6),
+            disagg_ratio=decision.disagg_ratio,
+        )
+
+    def _fold_action_stats(self) -> None:
+        ups = downs = 0
+        for g in (self.decode_guard, self.prefill_guard):
+            ups += sum(1 for a in g.actions if a.direction == "up")
+            downs += sum(1 for a in g.actions if a.direction == "down")
+        self.stats["scale_ups"] = ups
+        self.stats["scale_downs"] = downs
+
+    # ---------------- metrics surface ----------------
+
+    def render_stats(self) -> dict:
+        out = {f"planner_{k}": v for k, v in self.stats.items()}
+        d = self.last_decision
+        if d is not None:
+            out["planner_decode_replicas"] = d.decode_replicas
+            out["planner_prefill_replicas"] = d.prefill_replicas
+            out["planner_disagg_ratio"] = d.disagg_ratio
+        w = self.last_watermark
+        if w is not None:
+            out["planner_saturated_workers"] = len(w.saturated_workers)
+            out["planner_admission_rate_req_s"] = w.admission_rate_req_s
+        return out
+
+    # ---------------- async loop ----------------
+
+    async def run(self) -> None:
+        while True:
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("planner tick failed")
+            await asyncio.sleep(self.cfg.tick_s)
+
+    def start(self) -> "Planner":
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self.run())
+        return self
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

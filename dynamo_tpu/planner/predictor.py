@@ -1,0 +1,192 @@
+"""Load forecasting + capacity modeling + SLO evaluation.
+
+Three small, pure pieces the planner composes (each deterministic —
+no wall clock, no randomness — so the control loop is unit-testable
+tick by tick):
+
+  * :class:`HoltForecaster` — Holt's linear (double-exponential)
+    smoothing over the observed request/token arrival rates: level +
+    trend, so a ramp is extrapolated instead of chased one tick late.
+  * :class:`CapacityModel` — per-replica serving rates (decode tok/s,
+    prefill tok/s). Seeded from the roofline model's per-config
+    estimates (perf/roofline.py) and corrected online by an EWMA of
+    observed/modeled throughput, clamped so one bad sample can't wreck
+    the plan.
+  * :class:`SloEvaluator` — TTFT/ITL p99 targets with a grace window:
+    a breach only counts once it has been sustained for
+    ``grace_s`` (transient spikes must not resize the fleet).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+class HoltForecaster:
+    """Holt's linear trend method: ``level`` tracks the smoothed rate,
+    ``trend`` its per-update slope; ``forecast(h)`` extrapolates h
+    updates ahead (floored at 0 — a negative arrival rate is noise)."""
+
+    def __init__(self, alpha: float = 0.5, beta: float = 0.3):
+        if not (0.0 < alpha <= 1.0 and 0.0 <= beta <= 1.0):
+            raise ValueError("alpha in (0,1], beta in [0,1]")
+        self.alpha = alpha
+        self.beta = beta
+        self.level: Optional[float] = None
+        self.trend = 0.0
+
+    def update(self, y: float) -> None:
+        if self.level is None:
+            self.level = float(y)
+            return
+        prev = self.level
+        self.level = self.alpha * y + (1 - self.alpha) * (self.level + self.trend)
+        self.trend = self.beta * (self.level - prev) + (1 - self.beta) * self.trend
+
+    def forecast(self, horizon: float = 1.0) -> float:
+        if self.level is None:
+            return 0.0
+        return max(0.0, self.level + horizon * self.trend)
+
+
+class CapacityModel:
+    """Per-replica tok/s for the two pools, roofline-seeded and
+    observation-corrected.
+
+    ``observe_decode/prefill(observed_tok_s, replicas)`` folds the
+    measured fleet throughput into a multiplicative correction factor
+    (EWMA of observed / modeled, clamped to ``corr_bounds``) — the
+    roofline gives the shape, production gives the scale."""
+
+    def __init__(
+        self,
+        decode_tok_s_per_replica: float,
+        prefill_tok_s_per_replica: float,
+        correction_alpha: float = 0.2,
+        corr_bounds: tuple[float, float] = (0.25, 4.0),
+    ):
+        if decode_tok_s_per_replica <= 0 or prefill_tok_s_per_replica <= 0:
+            raise ValueError("per-replica rates must be > 0")
+        self.decode_seed = decode_tok_s_per_replica
+        self.prefill_seed = prefill_tok_s_per_replica
+        self.alpha = correction_alpha
+        self.corr_bounds = corr_bounds
+        self.decode_corr = 1.0
+        self.prefill_corr = 1.0
+
+    @staticmethod
+    def from_roofline(scenario) -> "CapacityModel":
+        """Seed from one roofline Scenario: decode = modeled decode
+        tok/s/chip x chips-per-replica; prefill = isl / modeled prefill
+        time (prompt tokens one replica prefills per second)."""
+        from ..perf.roofline import analyze
+
+        rec = analyze(scenario)
+        decode = rec["decode_tok_s_chip_modeled"] * rec["n_chips"]
+        prefill = scenario.isl / max(
+            rec["ttft_prefill_modeled_ms"] / 1e3, 1e-9
+        )
+        return CapacityModel(decode, prefill)
+
+    # -- online correction --
+
+    def _fold(self, corr: float, observed: float, modeled: float) -> float:
+        if observed <= 0 or modeled <= 0:
+            return corr
+        sample = observed / modeled
+        lo, hi = self.corr_bounds
+        return min(hi, max(lo, (1 - self.alpha) * corr + self.alpha * sample))
+
+    def observe_decode(self, observed_tok_s: float, replicas: int) -> None:
+        self.decode_corr = self._fold(
+            self.decode_corr, observed_tok_s, self.decode_seed * max(replicas, 1)
+        )
+
+    def observe_prefill(self, observed_tok_s: float, replicas: int) -> None:
+        self.prefill_corr = self._fold(
+            self.prefill_corr, observed_tok_s, self.prefill_seed * max(replicas, 1)
+        )
+
+    # -- corrected capacity --
+
+    def decode_tok_s(self, replicas: int = 1) -> float:
+        return self.decode_seed * self.decode_corr * max(replicas, 0)
+
+    def prefill_tok_s(self, replicas: int = 1) -> float:
+        return self.prefill_seed * self.prefill_corr * max(replicas, 0)
+
+    def decode_replicas_for(self, token_rate: float, headroom: float = 0.8) -> int:
+        """Replicas needed to serve ``token_rate`` gen-tok/s at
+        ``headroom`` target utilization (never 0 — an idle fleet still
+        keeps a warm replica; the guard's min bound also enforces this)."""
+        per = self.decode_tok_s(1) * max(min(headroom, 1.0), 1e-6)
+        return max(1, math.ceil(token_rate / max(per, 1e-9)))
+
+    def prefill_replicas_for(self, token_rate: float, headroom: float = 0.8) -> int:
+        per = self.prefill_tok_s(1) * max(min(headroom, 1.0), 1e-6)
+        return max(1, math.ceil(token_rate / max(per, 1e-9)))
+
+
+@dataclass
+class SloTargets:
+    ttft_p99_ms: float = 2000.0
+    itl_p99_ms: float = 200.0
+    #: a breach must be sustained this long before it drives scaling
+    grace_s: float = 10.0
+
+
+@dataclass
+class SloStatus:
+    ttft_breached: bool = False  # instantaneous
+    itl_breached: bool = False
+    ttft_sustained: bool = False  # breached continuously for >= grace_s
+    itl_sustained: bool = False
+
+    @property
+    def any_sustained(self) -> bool:
+        return self.ttft_sustained or self.itl_sustained
+
+
+class SloEvaluator:
+    """Tracks how long each SLO has been continuously breached; a
+    missing sample (no traffic in the window) clears the breach — an
+    idle cluster is not violating anything."""
+
+    def __init__(
+        self,
+        targets: Optional[SloTargets] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.targets = targets or SloTargets()
+        self._clock = clock
+        self._ttft_since: Optional[float] = None
+        self._itl_since: Optional[float] = None
+
+    def _track(self, since: Optional[float], breached: bool,
+               now: float) -> tuple[Optional[float], bool]:
+        if not breached:
+            return None, False
+        if since is None:
+            since = now
+        return since, (now - since) >= self.targets.grace_s
+
+    def evaluate(self, ttft_p99_ms: Optional[float],
+                 itl_p99_ms: Optional[float]) -> SloStatus:
+        now = self._clock()
+        st = SloStatus()
+        st.ttft_breached = bool(
+            ttft_p99_ms and ttft_p99_ms > self.targets.ttft_p99_ms
+        )
+        st.itl_breached = bool(
+            itl_p99_ms and itl_p99_ms > self.targets.itl_p99_ms
+        )
+        self._ttft_since, st.ttft_sustained = self._track(
+            self._ttft_since, st.ttft_breached, now
+        )
+        self._itl_since, st.itl_sustained = self._track(
+            self._itl_since, st.itl_breached, now
+        )
+        return st

@@ -1,0 +1,78 @@
+"""Planner wire schema: scale decisions and capacity watermarks.
+
+Two subjects, published on the target component (same bus idiom as the
+kv_router's ``kv-hit-rate``/``kv-prefetch`` events):
+
+  * ``planner-decisions`` — one :class:`PlannerDecision` per control
+    tick: the replica counts the planner wants per pool, the SLO view
+    that justified them, and the disagg-ratio hint. The metrics
+    component renders these as gauges; operators replay them to audit
+    why the fleet resized.
+  * ``planner-watermarks`` — :class:`CapacityWatermark`: which workers
+    the planner considers saturated (the KV scheduler soft-excludes
+    them from routing) and the admission rate the frontend's overload
+    gate should hold (0 = leave the gate's configured rate alone).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+PLANNER_DECISION_SUBJECT = "planner-decisions"
+PLANNER_WATERMARK_SUBJECT = "planner-watermarks"
+
+
+@dataclass
+class PlannerDecision:
+    ts: float = 0.0
+    decode_replicas: int = 0
+    prefill_replicas: int = 0
+    #: why the counts moved (or didn't): "demand", "ttft_breach",
+    #: "itl_breach", "steady", "cooldown_hold", ...
+    reason: str = "steady"
+    request_rate: float = 0.0  # observed req/s over the telemetry window
+    prompt_token_rate: float = 0.0
+    gen_token_rate: float = 0.0
+    ttft_p99_ms: float = 0.0  # 0 = no samples in window
+    itl_p99_ms: float = 0.0
+    #: prefill share of the fleet the planner is steering toward —
+    #: prefill_replicas / (prefill + decode); the KV router records it
+    #: as its disagg-ratio hint
+    disagg_ratio: float = 0.0
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "PlannerDecision":
+        d = json.loads(raw)
+        return PlannerDecision(**{
+            k: d[k] for k in PlannerDecision().__dict__ if k in d
+        })
+
+
+@dataclass
+class CapacityWatermark:
+    ts: float = 0.0
+    #: workers at/over the saturation watermark: the KV scheduler must
+    #: stop routing NEW work at them while they drain their queues
+    saturated_workers: list[int] = field(default_factory=list)
+    #: fleet slot utilization (0..1) behind the watermark decision
+    cluster_utilization: float = 0.0
+    #: admission rate (req/s) the frontend gate should hold; 0 = don't
+    #: touch the gate's configured rate
+    admission_rate_req_s: float = 0.0
+    #: mirror of PlannerDecision.disagg_ratio for routers that only
+    #: subscribe watermarks
+    disagg_ratio: float = 0.0
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "CapacityWatermark":
+        d = json.loads(raw)
+        return CapacityWatermark(**{
+            k: d[k] for k in CapacityWatermark().__dict__ if k in d
+        })

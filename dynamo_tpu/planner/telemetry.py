@@ -1,0 +1,177 @@
+"""Telemetry aggregator: metrics/load subjects -> sliding-window
+cluster snapshots.
+
+The planner's eyes. Folds three sources into one
+:class:`ClusterSnapshot` per control tick:
+
+  * per-worker :class:`WorkerLoad` rows (the same scrape the KV router
+    uses — ``observe_loads`` accepts a load list or pulls a live
+    ``KvMetricsAggregator``), including the cumulative
+    ``requests_total`` / ``tokens_generated`` / ``prompt_tokens_total``
+    counters whose deltas give fleet arrival and throughput rates
+    without any frontend cooperation;
+  * frontend arrival events (``record_arrival`` — the admission gate
+    feeds these when the planner is embedded in the HTTP service);
+  * latency samples: ``record_ttft``/``record_itl`` directly, or the
+    tracing plane's TTFT-decomposition percentiles via an attached
+    ``TraceCollector``.
+
+Everything is windowed on an injected clock, so scripted traces replay
+deterministically in tests.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..kv_router.scheduler import WorkerLoad
+from ..tracing.collector import percentile
+
+
+@dataclass
+class ClusterSnapshot:
+    ts: float = 0.0
+    workers: list[WorkerLoad] = field(default_factory=list)
+    request_rate: float = 0.0  # req/s arriving over the window
+    prompt_token_rate: float = 0.0  # prompt tok/s (prefill demand)
+    gen_token_rate: float = 0.0  # generated tok/s (decode demand)
+    queue_depth: int = 0  # sum of per-worker waiting
+    active_requests: int = 0
+    total_slots: int = 0
+    ttft_p99_ms: Optional[float] = None  # None = no samples in window
+    itl_p99_ms: Optional[float] = None
+
+    @property
+    def decode_replicas(self) -> int:
+        """Live, non-draining workers — the pool the planner sizes."""
+        return sum(1 for w in self.workers if not w.draining)
+
+    @property
+    def slot_utilization(self) -> float:
+        return self.active_requests / max(self.total_slots, 1)
+
+    def saturated_workers(self, slot_frac: float = 0.9,
+                          kv_frac: float = 0.9) -> list[int]:
+        """Workers at/over the capacity watermark: slots nearly full
+        with work queued, or KV pool nearly exhausted — routing more at
+        them only grows their queue."""
+        out = []
+        for w in self.workers:
+            if w.draining:
+                continue
+            slots_hot = w.slot_usage >= slot_frac and w.waiting > 0
+            if slots_hot or w.kv_usage >= kv_frac:
+                out.append(w.worker_id)
+        return out
+
+
+class TelemetryAggregator:
+    def __init__(
+        self,
+        window_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        trace_collector=None,
+        metrics_aggregator=None,
+    ):
+        self.window_s = window_s
+        self._clock = clock
+        #: optional tracing.TraceCollector — its ttft_ms percentiles
+        #: back-fill the TTFT view when no direct samples are recorded
+        self.trace_collector = trace_collector
+        #: optional kv_router.KvMetricsAggregator — ``snapshot()`` pulls
+        #: its latest endpoint view when no loads were pushed
+        self.metrics_aggregator = metrics_aggregator
+        self._loads: list[WorkerLoad] = []
+        # (ts, requests, prompt_tokens) arrival events
+        self._arrivals: deque[tuple[float, int, int]] = deque()
+        # (ts, generated_tokens)
+        self._generated: deque[tuple[float, int]] = deque()
+        self._ttft: deque[tuple[float, float]] = deque()
+        self._itl: deque[tuple[float, float]] = deque()
+        # cumulative-counter baselines per worker: (requests_total,
+        # tokens_generated, prompt_tokens_total)
+        self._counter_base: dict[int, tuple[int, int, int]] = {}
+
+    # ---------------- feeding ----------------
+
+    def record_arrival(self, prompt_tokens: int = 0, n: int = 1) -> None:
+        self._arrivals.append((self._clock(), n, max(prompt_tokens, 0)))
+
+    def record_generated(self, tokens: int) -> None:
+        self._generated.append((self._clock(), max(tokens, 0)))
+
+    def record_ttft(self, ms: float) -> None:
+        self._ttft.append((self._clock(), ms))
+
+    def record_itl(self, ms: float) -> None:
+        self._itl.append((self._clock(), ms))
+
+    def observe_loads(self, loads: list[WorkerLoad]) -> None:
+        """Fold a fresh per-worker load scrape: keep the instantaneous
+        view, and convert each worker's cumulative counters into
+        windowed arrival/throughput events (delta vs the last scrape;
+        a restarted worker's counter reset clamps to 0, losing one
+        interval instead of going negative)."""
+        now = self._clock()
+        self._loads = list(loads)
+        seen = set()
+        for w in loads:
+            seen.add(w.worker_id)
+            cur = (w.requests_total, w.tokens_generated, w.prompt_tokens_total)
+            base = self._counter_base.get(w.worker_id)
+            self._counter_base[w.worker_id] = cur
+            if base is None:
+                continue  # first sight: baseline only
+            d_req = max(cur[0] - base[0], 0)
+            d_gen = max(cur[1] - base[1], 0)
+            d_prompt = max(cur[2] - base[2], 0)
+            if d_req or d_prompt:
+                self._arrivals.append((now, d_req, d_prompt))
+            if d_gen:
+                self._generated.append((now, d_gen))
+        for wid in list(self._counter_base):
+            if wid not in seen:
+                del self._counter_base[wid]
+
+    # ---------------- folding ----------------
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        for q in (self._arrivals, self._generated, self._ttft, self._itl):
+            while q and q[0][0] < cutoff:
+                q.popleft()
+
+    def _p99(self, q: deque) -> Optional[float]:
+        vals = [v for _ts, v in q]
+        return round(percentile(vals, 99), 3) if vals else None
+
+    def snapshot(self) -> ClusterSnapshot:
+        # live wiring: pull the aggregator's latest scrape and fold its
+        # counter deltas before reading the window
+        if self.metrics_aggregator is not None:
+            self.observe_loads(self.metrics_aggregator.endpoints.loads)
+        now = self._clock()
+        self._prune(now)
+        loads = self._loads
+        span = max(self.window_s, 1e-9)
+        snap = ClusterSnapshot(
+            ts=now,
+            workers=list(loads),
+            request_rate=sum(n for _t, n, _p in self._arrivals) / span,
+            prompt_token_rate=sum(p for _t, _n, p in self._arrivals) / span,
+            gen_token_rate=sum(g for _t, g in self._generated) / span,
+            queue_depth=sum(w.waiting for w in loads),
+            active_requests=sum(w.active_requests for w in loads),
+            total_slots=sum(w.total_slots for w in loads),
+            ttft_p99_ms=self._p99(self._ttft),
+            itl_p99_ms=self._p99(self._itl),
+        )
+        if snap.ttft_p99_ms is None and self.trace_collector is not None:
+            snap.ttft_p99_ms = (
+                self.trace_collector.percentiles(ps=(99,))
+                .get("ttft_ms", {}).get("p99")
+            )
+        return snap

@@ -99,9 +99,16 @@ class Component:
         (ref kv_router.rs:41)."""
         return f"{slug(self.namespace)}.{slug(self.name)}.{event}"
 
-    async def scrape_stats(self, timeout: float = 1.0) -> list[dict]:
+    async def scrape_stats(
+        self, timeout: float = 1.0, include_missing: bool = False
+    ) -> list[dict]:
         """Collect per-instance stats from every live instance of every
-        endpoint of this component (ref $SRV stats scrape, component.rs:171)."""
+        endpoint of this component (ref $SRV stats scrape, component.rs:171).
+
+        With ``include_missing``, an instance that is still discovered but
+        missed the reply window (event loop starved on a loaded box) is
+        reported with ``data=None`` instead of silently dropped, so callers
+        holding a last-known snapshot can tell "slow" from "departed"."""
         entries = self.drt.store.kv_get_prefix(self.etcd_root + "/")
         if asyncio.iscoroutine(entries):
             entries = await entries
@@ -113,8 +120,18 @@ class Component:
                     info.subject + ".stats", b"{}", timeout=timeout
                 )
                 stats = json.loads(raw) if raw else {}
-            except (NoResponders, asyncio.TimeoutError):
+            except NoResponders:
                 continue  # instance mid-departure; expected churn
+            except asyncio.TimeoutError:
+                if include_missing:
+                    out.append(
+                        {
+                            "endpoint": info.endpoint,
+                            "instance_id": info.instance_id,
+                            "data": None,
+                        }
+                    )
+                continue
             except Exception:  # noqa: BLE001
                 logger.exception("bad stats from %s", info.subject)
                 continue

@@ -26,6 +26,22 @@ import asyncio  # noqa: E402
 import pytest  # noqa: E402
 
 
+class FakeClock:
+    """Deterministic injectable clock for control-loop tests (planner
+    guards, deploy-controller autoscaler): call to read, advance() to
+    step simulated time."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Release compiled executables after each test module.

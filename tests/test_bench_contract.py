@@ -54,6 +54,18 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert churn["goodput_frac"] == 1.0, churn
     assert churn["migrations"] >= 1, churn
     assert churn["ttft_p99_ms"] and churn["ttft_p99_ms"] > 0, churn
+    # overload control must be recorded (ISSUE 5): at 2x-capacity
+    # offered load the admission gate sheds the excess while admitted
+    # requests keep a TTFT near the uncongested baseline; the ungated
+    # wave queues unboundedly and its tail balloons
+    ov = result.get("bench_overload")
+    assert ov, result.get("bench_overload_error", "metric missing")
+    gated, ungated = ov["gated"], ov["ungated"]
+    assert gated["shed"] > 0, ov
+    assert gated["admitted"] + gated["shed"] == ov["requests"], ov
+    assert gated["client_errors"] == 0 and gated["goodput_frac"] == 1.0, ov
+    assert gated["within_target"], ov
+    assert gated["ttft_p99_ms"] < ungated["ttft_p99_ms"], ov
 
 
 def test_smoke_regression_band_catches_r03_drop():

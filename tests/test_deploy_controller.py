@@ -132,8 +132,11 @@ def test_controller_delete_recreate_resets_state(tmp_path):
 
 def test_controller_autoscaling_on_queue_depth(tmp_path):
     store = _store(tmp_path)
+    # zero guard windows = the legacy instant-converge autoscaler
+    # (guarded behavior is covered by the hysteresis tests below)
     auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
-                       target_queue_depth=8)
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=0, down_stable_s=0)
     store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
     sp = FakeSpawner()
     depth = {"v": 0}
@@ -150,6 +153,146 @@ def test_controller_autoscaling_on_queue_depth(tmp_path):
     ctl.reconcile_once()
     ready = sum(1 for p in sp.procs.values() if p.rc is None)
     assert ready == 1
+
+
+from conftest import FakeClock  # noqa: E402 — shared fake clock
+
+
+def _alive(sp):
+    return sum(1 for p in sp.procs.values() if p.rc is None)
+
+
+def test_controller_autoscaler_down_needs_stability_and_cooldown(tmp_path):
+    """A queue depth dropping to zero must NOT instantly drop replicas:
+    the desire has to sit below current for down_stable_s AND
+    down_cooldown_s must have passed since the last action."""
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=20, down_stable_s=10)
+    store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    clock = FakeClock()
+    depth = {"v": 30}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"],
+        clock=clock,
+    )
+    ctl.reconcile_once()
+    assert _alive(sp) == 4  # scale-up is immediate
+    depth["v"] = 0
+    clock.advance(5)
+    ctl.reconcile_once()
+    assert _alive(sp) == 4  # below for 0s: stability window not met
+    clock.advance(6)  # below for 11s > stable, but only 11s < cooldown 20
+    ctl.reconcile_once()
+    assert _alive(sp) == 4
+    clock.advance(10)  # 21s since the up action: both gates open
+    ctl.reconcile_once()
+    assert _alive(sp) == 1
+
+
+def test_controller_autoscaler_no_flap_on_oscillating_depth(tmp_path):
+    """A depth oscillating across the threshold every tick must produce
+    ZERO scale-down actions — each dip resets the stability window."""
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=20, down_stable_s=10)
+    store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    clock = FakeClock()
+    depth = {"v": 30}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"],
+        clock=clock,
+    )
+    ctl.reconcile_once()
+    assert _alive(sp) == 4
+    spawns_after_up = len(sp.calls)
+    for _ in range(30):  # 150 s of oscillation, 5 s per tick
+        depth["v"] = 0 if depth["v"] else 30
+        clock.advance(5)
+        ctl.reconcile_once()
+        assert _alive(sp) == 4
+    assert len(sp.calls) == spawns_after_up  # zero churn
+
+
+def test_controller_autoscaler_guard_dies_with_deployment(tmp_path):
+    """Deleting and recreating a deployment must not inherit the old
+    guard's cooldown clock (a fresh service scales from its spec)."""
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=300, down_stable_s=0)
+    store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    clock = FakeClock()
+    depth = {"v": 30}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"],
+        clock=clock,
+    )
+    ctl.reconcile_once()
+    assert ("d1", "worker") in ctl._guards
+    store.delete("d1")
+    ctl.reconcile_once()
+    assert ("d1", "worker") not in ctl._guards
+
+
+def test_controller_autoscaler_holds_on_missing_metric(tmp_path):
+    """metrics_fn returning None (metric not yet published this tick)
+    must hold the guarded scale, not fall back to spec.replicas — one
+    missing sample killing 3 autoscaled replicas IS the flap."""
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=1, max_replicas=4,
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=20, down_stable_s=10)
+    store.put("d1", _dep(replicas=1, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    clock = FakeClock()
+    depth = {"v": 30}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"],
+        clock=clock,
+    )
+    ctl.reconcile_once()
+    assert _alive(sp) == 4
+    depth["v"] = None
+    clock.advance(60)  # well past every guard window
+    ctl.reconcile_once()
+    assert _alive(sp) == 4  # held, not snapped back to spec's 1
+
+
+def test_controller_autoscaler_scale_to_zero_holds(tmp_path):
+    """A service scaled to zero keeps its guard: with no desired
+    replicas the guard must survive eviction, or the next reconcile
+    reseeds it from spec.replicas and the fleet flaps 0 -> spec -> 0."""
+    store = _store(tmp_path)
+    auto = Autoscaling(enabled=True, min_replicas=0, max_replicas=4,
+                       target_queue_depth=8,
+                       up_cooldown_s=0, down_cooldown_s=20, down_stable_s=10)
+    store.put("d1", _dep(replicas=2, autoscale=auto).to_dict(), create=True)
+    sp = FakeSpawner()
+    clock = FakeClock()
+    depth = {"v": 0}
+    ctl = DeploymentController(
+        store, spawn=sp, metrics_fn=lambda name, svc: depth["v"],
+        clock=clock,
+    )
+    ctl.reconcile_once()
+    assert _alive(sp) == 2  # seeded from the spec, not an action
+    for _ in range(10):  # 50 s idle: stability + cooldown both elapse
+        clock.advance(5)
+        ctl.reconcile_once()
+    assert _alive(sp) == 0
+    spawns_at_zero = len(sp.calls)
+    for _ in range(10):  # and it STAYS down — zero respawn churn
+        clock.advance(5)
+        ctl.reconcile_once()
+        assert _alive(sp) == 0
+    assert len(sp.calls) == spawns_at_zero
+    assert ("d1", "worker") in ctl._guards
 
 
 def test_controller_skips_invalid_spec(tmp_path):

@@ -1,0 +1,826 @@
+"""SLA-driven planner: deterministic control-loop simulation.
+
+Every decision path runs under an injected fake clock with scripted
+arrival traces — no silicon, no wall-clock sleeps. The acceptance
+matrix from the issue: sustained TTFT-SLO breach -> scale-up decision
+within the grace window; oscillating load -> ZERO flapping actions;
+scale-down only after the cooldown; shed-vs-admit fairness by SLO
+class under 2x offered load.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.deploy import (
+    Autoscaling,
+    DeploymentController,
+    DynamoDeployment,
+    ServiceDeploymentSpec,
+)
+from dynamo_tpu.deploy.api_server import DeploymentStore
+from dynamo_tpu.http.metrics import Metrics
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.publisher import ProcessedEndpoints
+from dynamo_tpu.kv_router.scheduler import (
+    AllWorkersBusy,
+    KvScheduler,
+    SchedulerConfig,
+    WorkerLoad,
+)
+from dynamo_tpu.planner import (
+    AdmissionGate,
+    CallbackScaleDriver,
+    CapacityModel,
+    CapacityWatermark,
+    GuardConfig,
+    HoltForecaster,
+    Planner,
+    PlannerConfig,
+    PlannerDecision,
+    ScaleGuard,
+    SloEvaluator,
+    SloTargets,
+    StoreScaleDriver,
+    TelemetryAggregator,
+    TokenBucket,
+)
+
+from conftest import FakeClock
+
+pytestmark = pytest.mark.planner
+
+
+def _load(wid, active=0, slots=8, waiting=0, kv=0.0, ts=None, draining=0,
+          requests_total=0, tokens_generated=0, prompt_tokens_total=0):
+    return WorkerLoad(
+        worker_id=wid, kv_active_blocks=int(kv * 100), kv_total_blocks=100,
+        active_requests=active, total_slots=slots, waiting=waiting,
+        draining=draining, ts=ts, requests_total=requests_total,
+        tokens_generated=tokens_generated,
+        prompt_tokens_total=prompt_tokens_total,
+    )
+
+
+# ---------------- scale guard ----------------
+
+
+def test_guard_up_immediate_down_gated():
+    clk = FakeClock()
+    g = ScaleGuard(GuardConfig(min_replicas=1, max_replicas=8,
+                               up_cooldown_s=0, down_cooldown_s=20,
+                               down_stable_s=10), clock=clk, initial=2)
+    assert g.apply(5) == 5  # up: immediate
+    assert [a.direction for a in g.actions] == ["up"]
+    assert g.apply(2) == 5  # down: stability window starts now
+    clk.advance(9)
+    assert g.apply(2) == 5  # 9s below < 10s stable
+    clk.advance(2)
+    assert g.apply(2) == 5  # stable met, but 11s < 20s cooldown
+    clk.advance(10)
+    assert g.apply(2) == 2  # both gates open
+    assert [a.direction for a in g.actions] == ["up", "down"]
+
+
+def test_guard_up_cooldown_paces_consecutive_ups():
+    clk = FakeClock()
+    g = ScaleGuard(GuardConfig(max_replicas=16, up_cooldown_s=30),
+                   clock=clk, initial=1)
+    assert g.apply(2) == 2
+    clk.advance(5)
+    assert g.apply(4) == 2  # paced: 5s < 30s since the last up
+    clk.advance(26)
+    assert g.apply(4) == 4
+
+
+def test_guard_oscillation_resets_stability_window():
+    clk = FakeClock()
+    g = ScaleGuard(GuardConfig(down_cooldown_s=0, down_stable_s=10),
+                   clock=clk, initial=4)
+    for _ in range(50):  # 250 s of a desire flapping 4 <-> 2 every 5 s
+        clk.advance(5)
+        g.apply(2)
+        clk.advance(5)
+        g.apply(4)
+    assert g.current == 4
+    assert g.actions == []  # every dip reset the window: zero churn
+
+
+def test_guard_clamps_and_validates():
+    clk = FakeClock()
+    g = ScaleGuard(GuardConfig(min_replicas=2, max_replicas=4,
+                               down_cooldown_s=0, down_stable_s=0), clock=clk)
+    assert g.apply(100) == 4  # first apply seeds (clamped), no action
+    assert g.actions == []
+    assert g.apply(0) == 2
+    with pytest.raises(ValueError):
+        ScaleGuard(GuardConfig(min_replicas=5, max_replicas=2))
+    with pytest.raises(ValueError):
+        ScaleGuard(GuardConfig(up_cooldown_s=-1))
+
+
+# ---------------- forecaster / capacity / SLO ----------------
+
+
+def test_holt_forecast_extrapolates_ramp():
+    f = HoltForecaster(alpha=0.6, beta=0.4)
+    for y in (10, 20, 30, 40, 50):  # steady +10/update ramp
+        f.update(y)
+    assert f.forecast(0) > 40  # level tracks the ramp
+    assert f.forecast(2) > f.forecast(0)  # trend extrapolates ahead
+    assert HoltForecaster().forecast() == 0.0  # no data -> 0
+    g = HoltForecaster()
+    for y in (100, 50, 10, 0, 0, 0):  # collapsing load
+        g.update(y)
+    assert g.forecast(5) == 0.0  # floored, never negative
+
+
+def test_capacity_model_replica_math_and_correction():
+    m = CapacityModel(100.0, 1000.0)
+    assert m.decode_replicas_for(0) == 1  # warm floor
+    assert m.decode_replicas_for(400, headroom=0.8) == 5  # 400/(100*0.8)
+    assert m.prefill_replicas_for(2400, headroom=0.8) == 3
+    # observed fleet throughput 50% of modeled: correction folds in...
+    for _ in range(50):
+        m.observe_decode(100.0, replicas=2)  # modeled 200
+    assert 0.45 < m.decode_corr < 0.6
+    assert m.decode_replicas_for(400, headroom=1.0) > 4  # needs more chips
+    # ...but one absurd sample can't wreck the plan (clamped)
+    m2 = CapacityModel(100.0, 100.0, corr_bounds=(0.25, 4.0))
+    m2.observe_decode(1e9, replicas=1)
+    assert m2.decode_corr <= 4.0
+    with pytest.raises(ValueError):
+        CapacityModel(0.0, 1.0)
+
+
+def test_capacity_model_from_roofline():
+    from dynamo_tpu.perf.roofline import DEFAULT_SCENARIOS
+
+    m = CapacityModel.from_roofline(DEFAULT_SCENARIOS[0])
+    assert m.decode_tok_s(1) > 0
+    assert m.prefill_tok_s(1) > 0
+
+
+def test_slo_evaluator_grace_window():
+    clk = FakeClock()
+    ev = SloEvaluator(SloTargets(ttft_p99_ms=2000, itl_p99_ms=200,
+                                 grace_s=10), clock=clk)
+    st = ev.evaluate(5000, 100)
+    assert st.ttft_breached and not st.ttft_sustained  # just started
+    clk.advance(11)
+    st = ev.evaluate(5000, 100)
+    assert st.ttft_sustained and not st.itl_sustained
+    # a gap (no samples: None) clears the breach entirely
+    ev.evaluate(None, None)
+    clk.advance(1)
+    st = ev.evaluate(5000, None)
+    assert st.ttft_breached and not st.ttft_sustained  # window restarted
+
+
+# ---------------- telemetry aggregator ----------------
+
+
+def test_telemetry_window_and_rates():
+    clk = FakeClock()
+    t = TelemetryAggregator(window_s=10.0, clock=clk)
+    for _ in range(20):
+        t.record_arrival(prompt_tokens=100)
+        t.record_ttft(500.0)
+        clk.advance(1)
+    snap = t.snapshot()  # 10s window holds the last 10 arrivals
+    assert snap.request_rate == pytest.approx(1.0)
+    assert snap.prompt_token_rate == pytest.approx(100.0)
+    assert snap.ttft_p99_ms == pytest.approx(500.0)
+    clk.advance(30)  # everything ages out
+    snap = t.snapshot()
+    assert snap.request_rate == 0.0
+    assert snap.ttft_p99_ms is None
+
+
+def test_telemetry_counter_deltas_and_restart_clamp():
+    clk = FakeClock()
+    t = TelemetryAggregator(window_s=10.0, clock=clk)
+    t.observe_loads([_load(1, requests_total=100, tokens_generated=1000,
+                           prompt_tokens_total=5000)])
+    clk.advance(5)
+    t.observe_loads([_load(1, requests_total=110, tokens_generated=1500,
+                           prompt_tokens_total=6000)])
+    snap = t.snapshot()
+    assert snap.request_rate == pytest.approx(10 / 10.0)
+    assert snap.gen_token_rate == pytest.approx(500 / 10.0)
+    assert snap.prompt_token_rate == pytest.approx(1000 / 10.0)
+    # worker restart: counters reset below the baseline -> clamp to 0
+    # (one lost interval), never a negative rate
+    clk.advance(1)
+    t.observe_loads([_load(1, requests_total=3, tokens_generated=30,
+                           prompt_tokens_total=90)])
+    assert t.snapshot().request_rate >= 0.0
+    # a vanished worker's baseline is dropped (its comeback re-baselines)
+    t.observe_loads([_load(2)])
+    assert 1 not in t._counter_base
+
+
+def test_telemetry_saturation_watermarks():
+    clk = FakeClock()
+    t = TelemetryAggregator(clock=clk)
+    t.observe_loads([
+        _load(1, active=8, slots=8, waiting=3),   # slots full, queue
+        _load(2, active=2, slots=8, kv=0.95),     # KV pool exhausted
+        _load(3, active=8, slots=8, waiting=0),   # full but no queue
+        _load(4, active=8, slots=8, waiting=5, draining=1),  # draining
+    ])
+    snap = t.snapshot()
+    assert snap.saturated_workers() == [1, 2]
+    assert snap.decode_replicas == 3  # draining worker not counted
+    assert snap.queue_depth == 8
+
+
+# ---------------- admission gate ----------------
+
+
+def test_token_bucket_refill_and_floor():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clk)
+    assert all(b.try_take() for _ in range(4))
+    assert not b.try_take()  # drained
+    assert b.time_until() == pytest.approx(0.5)
+    clk.advance(1.0)  # +2 tokens
+    assert b.try_take() and b.try_take() and not b.try_take()
+    clk.advance(2.0)  # 4 tokens, but a floor of 3 leaves only 1 takeable
+    assert b.try_take(floor=3.0)
+    assert not b.try_take(floor=3.0)
+
+
+def test_admission_sheds_at_2x_and_recovers():
+    clk = FakeClock()
+    gate = AdmissionGate(rate_req_s=10.0, burst=10.0, clock=clk)
+    shed = admitted = 0
+    for _ in range(100):  # 10 s of 20 req/s offered against 10 req/s
+        for _ in range(2):
+            d = gate.admit("interactive")
+            admitted += d.admitted
+            shed += not d.admitted
+        clk.advance(0.1)
+    # capacity = 10 burst + 10 s x 10 req/s = 110; shed absorbs the rest
+    assert admitted == pytest.approx(110, abs=3)
+    assert shed == pytest.approx(90, abs=3)
+    assert gate.admit("interactive").admitted  # last refill's token
+    d = gate.admit("interactive")
+    assert not d.admitted and d.reason == "rate"
+    assert d.retry_after_s >= 1.0
+    clk.advance(30)  # offered load stops: bucket refills, gate reopens
+    assert gate.admit("interactive").admitted
+
+
+def test_admission_reserve_protects_interactive():
+    """Batch must not drain the bucket below its reserve floor; the
+    capacity it leaves stays takeable by interactive."""
+    clk = FakeClock()
+    gate = AdmissionGate(rate_req_s=10.0, burst=10.0, clock=clk)
+    batch_admitted = 0
+    while gate.admit("batch").admitted:
+        batch_admitted += 1
+    # burst 10, reserve_frac 0.5 -> batch stops at the 5-token floor
+    assert batch_admitted == 5
+    interactive_admitted = 0
+    while gate.admit("interactive").admitted:
+        interactive_admitted += 1
+    assert interactive_admitted == 5  # the reserve was really there
+
+
+def test_admission_low_rate_gate_still_admits_batch():
+    """The reserve floor is capped at burst - 1: a full bucket must
+    admit one request of ANY class, even when burst < 2 (the default
+    for --admission-rate < 2) would make batch's burst/2 floor
+    unsatisfiable."""
+    clk = FakeClock()
+    gate = AdmissionGate(rate_req_s=1.0, clock=clk)  # burst defaults to 1
+    d = gate.admit("batch")
+    assert d.admitted, d
+    # drained: the next batch request sheds, but with a FINITE retry
+    d = gate.admit("batch")
+    assert not d.admitted and d.retry_after_s >= 1.0
+    clk.advance(60.0)  # refilled: batch admits again, forever viable
+    assert gate.admit("batch").admitted
+
+
+def test_admission_fairness_by_class_at_2x():
+    """2x overload, mixed classes: interactive keeps a materially
+    higher admit rate than batch (the reserve at work), and shed
+    volume absorbs exactly the excess."""
+    clk = FakeClock()
+    gate = AdmissionGate(rate_req_s=10.0, burst=10.0, clock=clk)
+    clk.advance(100)  # full bucket
+    for _ in range(200):  # 10 s at 20 req/s offered, alternating classes
+        gate.admit("interactive")
+        gate.admit("batch")
+        clk.advance(0.05)
+    s = gate.stats
+    total = s["admitted_total"] + s["shed_total"]
+    assert total == 400
+    # capacity ~ burst + 10 s * 10 req/s
+    assert s["admitted_total"] == pytest.approx(110, abs=5)
+    int_admit = s["admitted_interactive"] / (s["admitted_interactive"]
+                                             + s["shed_interactive"])
+    bat_admit = s["admitted_batch"] / (s["admitted_batch"]
+                                       + s["shed_batch"])
+    assert int_admit > 1.5 * bat_admit
+
+
+def test_admission_queue_bound_and_done():
+    clk = FakeClock()
+    from dynamo_tpu.planner import SloClass
+
+    gate = AdmissionGate(
+        rate_req_s=1000.0, burst=1000.0,
+        classes=(SloClass("interactive", max_inflight=2),), clock=clk,
+    )
+    assert gate.admit().admitted and gate.admit().admitted
+    d = gate.admit()
+    assert not d.admitted and d.reason == "queue"
+    gate.done("interactive")
+    assert gate.admit().admitted
+    gate.done("unknown-class")  # falls back to default, never KeyError
+
+
+def test_admission_classify_and_set_rate():
+    clk = FakeClock()
+    gate = AdmissionGate(rate_req_s=5.0, clock=clk)
+    assert gate.classify(["slo:batch"]) == "batch"
+    assert gate.classify(["slo:nonsense"]) == "interactive"
+    assert gate.classify(None) == "interactive"
+    gate.set_rate(50.0)
+    assert gate.bucket.rate == 50.0
+    gate.set_rate(0.0)  # planner has no mix yet: keep the current rate
+    assert gate.bucket.rate == 50.0
+    stats = gate.render_stats()
+    assert stats["admission_rate_req_s"] == 50.0
+    assert "admission_inflight_interactive" in stats
+
+
+def test_metrics_feeds_planner_telemetry():
+    clk = FakeClock()
+    tel = TelemetryAggregator(clock=clk)
+    m = Metrics()
+    m.planner_telemetry = tel
+    m.observe_first_token("m", "chat", 0.5)
+    m.observe_inter_token("m", "chat", 0.02)
+    snap = tel.snapshot()
+    assert snap.ttft_p99_ms == pytest.approx(500.0)
+    assert snap.itl_p99_ms == pytest.approx(20.0)
+
+
+# ---------------- the control loop ----------------
+
+
+def _sim(prefill_pool=False, decode_max=8, clk=None):
+    clk = clk or FakeClock()
+    telemetry = TelemetryAggregator(window_s=10.0, clock=clk)
+    capacity = CapacityModel(100.0, 1000.0)
+    driver = CallbackScaleDriver()
+    cfg = PlannerConfig(
+        tick_s=2.0,
+        slo=SloTargets(ttft_p99_ms=2000, itl_p99_ms=200, grace_s=10),
+        decode_guard=GuardConfig(min_replicas=1, max_replicas=decode_max,
+                                 up_cooldown_s=0, down_cooldown_s=60,
+                                 down_stable_s=20),
+        prefill_guard=GuardConfig(min_replicas=0, max_replicas=8,
+                                  up_cooldown_s=0, down_cooldown_s=60,
+                                  down_stable_s=20),
+        prefill_pool=prefill_pool,
+    )
+    planner = Planner(telemetry, capacity, cfg, scale_driver=driver,
+                      clock=clk)
+    return clk, telemetry, planner, driver
+
+
+def _steady_fleet(n=2, active=4):
+    return [_load(i + 1, active=active, slots=8) for i in range(n)]
+
+
+def test_planner_scales_up_on_sustained_ttft_breach():
+    """Acceptance: sustained TTFT-SLO breach -> scale-up decision
+    within the grace window (aggregated: the decode pool grows)."""
+    clk, telemetry, planner, driver = _sim(prefill_pool=False)
+    breach_start = clk()
+    decision = None
+    for _ in range(10):  # 20 s of p99 = 5000 ms >> 2000 ms target
+        telemetry.observe_loads(_steady_fleet())
+        for _ in range(5):
+            telemetry.record_ttft(5000.0)
+        decision = planner.tick()
+        if decision.reason == "ttft_breach":
+            break
+        clk.advance(2.0)
+    assert decision.reason == "ttft_breach"
+    # within grace (10 s) + one tick, not eventually-someday
+    assert clk() - breach_start <= 12.0
+    assert decision.decode_replicas == 3  # fleet of 2 + the SLO push
+    assert ("decode", 3) in driver.applied
+
+
+def test_planner_disagg_ttft_breach_grows_prefill_pool():
+    """Disagg: TTFT is prefill/queue bound — the prefill pool takes
+    the push, decode holds."""
+    clk, telemetry, planner, _driver = _sim(prefill_pool=True)
+    decision = None
+    for _ in range(10):
+        telemetry.observe_loads(_steady_fleet())
+        for _ in range(5):
+            telemetry.record_ttft(5000.0)
+        decision = planner.tick()
+        if decision.reason == "ttft_breach":
+            break
+        clk.advance(2.0)
+    assert decision.reason == "ttft_breach"
+    assert decision.prefill_replicas >= 1
+    assert decision.decode_replicas == 2  # seeded fleet, unchanged
+    assert decision.disagg_ratio == pytest.approx(
+        decision.prefill_replicas
+        / (decision.prefill_replicas + decision.decode_replicas)
+    )
+
+
+def test_planner_itl_breach_grows_decode_pool():
+    clk, telemetry, planner, _driver = _sim(prefill_pool=True)
+    decision = None
+    for _ in range(10):
+        telemetry.observe_loads(_steady_fleet())
+        for _ in range(5):
+            telemetry.record_itl(500.0)  # >> 200 ms target
+        decision = planner.tick()
+        if decision.reason == "itl_breach":
+            break
+        clk.advance(2.0)
+    assert decision.reason == "itl_breach"
+    assert decision.decode_replicas == 3
+
+
+def test_planner_demand_scale_up_from_token_rate():
+    """No SLO breach yet — the forecasted token arrival rate alone
+    must grow the pool ahead of the breach (predictive, not reactive)."""
+    clk, telemetry, planner, _driver = _sim(prefill_pool=False)
+    fleet = _steady_fleet()
+    gen = 0
+    decision = None
+    for tick in range(10):
+        gen += 640  # 320 tok/s on a fleet modeled at 100 tok/s/replica
+        telemetry.observe_loads([
+            _load(w.worker_id, active=4, slots=8, tokens_generated=gen // 2)
+            for w in fleet
+        ])
+        decision = planner.tick()
+        clk.advance(2.0)
+    assert decision.decode_replicas >= 4  # ceil(320 / (100*0.8))
+    assert planner.stats["scale_ups"] >= 1
+    assert planner.stats["scale_downs"] == 0
+
+
+def test_planner_no_flap_under_oscillating_load():
+    """Acceptance: offered load oscillating every tick produces ZERO
+    scale-down actions and at most one net scale-up — the fleet holds
+    its high-water size through the trough."""
+    clk, telemetry, planner, _driver = _sim(prefill_pool=False)
+    gen = 0
+    for tick in range(60):  # 120 s of on/off square-wave load
+        burst = 2000 if tick % 2 == 0 else 0
+        gen += burst
+        telemetry.observe_loads([
+            _load(1, active=4, slots=8, tokens_generated=gen),
+            _load(2, active=4, slots=8),
+        ])
+        planner.tick()
+        clk.advance(2.0)
+    downs = [a for a in planner.decode_guard.actions
+             if a.direction == "down"]
+    assert downs == []  # zero flapping actions
+    # scale-ups belong to the initial ramp only — once the fleet sits
+    # at its high-water size, the oscillation produces NO more actions
+    late = [a for a in planner.decode_guard.actions if a.ts > 40.0]
+    assert late == []
+    assert planner.stats["scale_downs"] == 0
+
+
+def test_planner_scales_down_only_after_cooldown():
+    clk, telemetry, planner, driver = _sim(prefill_pool=False)
+    gen = 0
+    for _ in range(6):  # sustained heavy load: scale up
+        gen += 6400
+        telemetry.observe_loads([_load(1, active=8, slots=8,
+                                       tokens_generated=gen)])
+        planner.tick()
+        clk.advance(2.0)
+    high = planner.decode_guard.current
+    assert high >= 4
+    sizes = []
+    for _ in range(60):  # load vanishes; 120 s of idle ticks
+        telemetry.observe_loads([_load(1, active=0, slots=8,
+                                       tokens_generated=gen)])
+        d = planner.tick()
+        sizes.append((clk(), d.decode_replicas))
+        clk.advance(2.0)
+    acts = planner.decode_guard.actions
+    ups = [a for a in acts if a.direction == "up"]
+    downs = [a for a in acts if a.direction == "down"]
+    assert ups and downs
+    # the down waited out the full cooldown from the last action...
+    assert downs[0].ts - ups[-1].ts >= 60.0
+    # ...and until it fired, the fleet held its high-water size
+    for ts, n in sizes:
+        if ts < downs[0].ts:
+            assert n == high, f"dropped at t={ts}, inside cooldown"
+    assert sizes[-1][1] == 1
+    assert planner.stats["scale_downs"] >= 1
+
+
+def test_planner_watermarks_saturated_workers_and_scheduler_obeys():
+    clk, telemetry, planner, _driver = _sim(prefill_pool=False)
+    telemetry.observe_loads([
+        _load(1, active=8, slots=8, waiting=4),  # saturated
+        _load(2, active=2, slots=8),
+    ])
+    planner.tick()
+    wm = planner.last_watermark
+    assert wm.saturated_workers == [1]
+    assert wm.cluster_utilization == pytest.approx(10 / 16)
+    # the KV scheduler soft-excludes watermarked workers...
+    s = KvScheduler()
+    s.set_watermarks(wm.saturated_workers)
+    eps = ProcessedEndpoints([_load(1, active=2), _load(2, active=2)])
+    assert s.select_worker(eps, OverlapScores(scores={1: 10},
+                                              total_blocks=10), 10) == 2
+    # ...softly: an all-watermarked fleet still serves
+    s.set_watermarks([1, 2])
+    assert s.select_worker(eps, OverlapScores(), 10) in (1, 2)
+    # a republished empty set clears everything
+    s.set_watermarks([])
+    assert s.watermarked == set()
+
+
+def test_scheduler_watermarks_expire_without_planner():
+    """A planner that stops publishing must not keep its last
+    saturated-worker set skewing routing forever: the set ages out
+    after watermark_ttl_s (same stale-authority guard as load_ttl_s)."""
+    clk = FakeClock()
+    s = KvScheduler(config=SchedulerConfig(watermark_ttl_s=5.0), clock=clk)
+    s.set_watermarks([1])
+    eps = ProcessedEndpoints([_load(1, active=2), _load(2, active=2)])
+    overlaps = OverlapScores(scores={1: 10}, total_blocks=10)
+    assert s.select_worker(eps, overlaps, 10) == 2  # fresh: obeyed
+    s.request_finished(2)
+    clk.advance(6.0)  # planner silent past the TTL: watermark expires
+    assert s.select_worker(eps, overlaps, 10) == 1  # overlap wins again
+    assert s.watermarked == set()
+
+
+def test_planner_publishes_decisions_and_admission_rate():
+    class SpyPublisher:
+        def __init__(self):
+            self.events = []
+
+        def publish(self, decision, watermark):
+            self.events.append((decision, watermark))
+
+    clk = FakeClock()
+    telemetry = TelemetryAggregator(window_s=10.0, clock=clk)
+    planner = Planner(telemetry, CapacityModel(100.0, 1000.0),
+                      PlannerConfig(), publisher=SpyPublisher(), clock=clk)
+    telemetry.observe_loads(_steady_fleet())
+    clk.advance(5)
+    # 20 req/s arriving, 50 gen tok/req mix
+    telemetry.observe_loads([
+        _load(1, active=4, slots=8, requests_total=100,
+              tokens_generated=5000),
+        _load(2, active=4, slots=8),
+    ])
+    planner.tick()
+    decision, wm = planner.publisher.events[-1]
+    assert decision.request_rate > 0
+    # admission rate = corrected capacity at headroom / mean tok/req
+    mean_gen = wm.admission_rate_req_s
+    assert mean_gen == pytest.approx(
+        100.0 * decision.decode_replicas * 0.8 / 50.0
+    )
+    # wire-schema round trip (what the bus actually carries)
+    d2 = PlannerDecision.from_bytes(decision.to_bytes())
+    assert d2 == decision
+    w2 = CapacityWatermark.from_bytes(wm.to_bytes())
+    assert w2 == wm
+    # forward compat: unknown keys are filtered, not fatal
+    raw = json.loads(decision.to_bytes())
+    raw["from_the_future"] = 1
+    assert PlannerDecision.from_bytes(json.dumps(raw).encode()) == decision
+
+
+def test_planner_capacity_correction_only_when_loaded():
+    """An idle fleet's low throughput measures demand, not capacity —
+    it must NOT shrink the capacity model."""
+    clk, telemetry, planner, _driver = _sim(prefill_pool=False)
+    gen = 0
+    for _ in range(5):  # 50 tok/s on a near-idle fleet (util 1/16)
+        gen += 500
+        telemetry.observe_loads([_load(1, active=1, slots=8,
+                                       tokens_generated=gen),
+                                 _load(2, slots=8)])
+        planner.tick()
+        clk.advance(2.0)
+    assert planner.capacity.decode_corr == 1.0  # untouched
+    for _ in range(8):  # saturated fleet at half the modeled 200 tok/s
+        gen += 200
+        telemetry.observe_loads([_load(1, active=8, slots=8,
+                                       tokens_generated=gen),
+                                 _load(2, active=8, slots=8)])
+        planner.tick()
+        clk.advance(2.0)
+    assert planner.capacity.decode_corr < 1.0  # now it counts
+
+
+# ---------------- stale-load TTL (KV scheduler satellite) ----------------
+
+
+def test_scheduler_discards_stale_worker_loads():
+    clk = FakeClock(100.0)
+    s = KvScheduler(config=SchedulerConfig(load_ttl_s=10.0), clock=clk)
+    eps = ProcessedEndpoints([
+        _load(1, active=6, ts=95.0),   # busy but alive
+        _load(2, active=0, ts=50.0),   # idle-looking — died 50 s ago
+    ])
+    # the dead worker's attractive last report must not win
+    assert s.select_worker(eps, OverlapScores(), 10) == 1
+    # every load stale (metrics plane wedged): refuse -> caller falls
+    # back to discovery round-robin
+    eps = ProcessedEndpoints([_load(1, ts=50.0), _load(2, ts=60.0)])
+    with pytest.raises(AllWorkersBusy):
+        s.select_worker(eps, OverlapScores(), 10)
+    # legacy producers without a stamp are trusted (ts=None)
+    eps = ProcessedEndpoints([_load(1, ts=None)])
+    assert s.select_worker(eps, OverlapScores(), 10) == 1
+    # load_ttl_s=0 disables the check entirely
+    s0 = KvScheduler(config=SchedulerConfig(load_ttl_s=0.0), clock=clk)
+    eps = ProcessedEndpoints([_load(1, ts=1.0)])
+    assert s0.select_worker(eps, OverlapScores(), 10) == 1
+
+
+# ---------------- actuators ----------------
+
+
+def test_store_scale_driver_rewrites_deployment(tmp_path):
+    store = DeploymentStore(str(tmp_path))
+    dep = DynamoDeployment(name="d1", services=[
+        ServiceDeploymentSpec(name="worker", replicas=2),
+        ServiceDeploymentSpec(name="prefill", replicas=1),
+    ])
+    store.put("d1", dep.to_dict(), create=True)
+    drv = StoreScaleDriver(store, "d1")
+    assert drv.current("decode") == 2
+    assert drv.set_replicas("decode", 4) is True
+    assert drv.set_replicas("prefill", 2) is True
+    svcs = {s["name"]: s["replicas"] for s in store.get("d1")["services"]}
+    assert svcs == {"worker": 4, "prefill": 2}
+    assert drv.set_replicas("decode", 4) is False  # idempotent: no write
+    assert drv.set_replicas("unknown-pool", 9) is False
+    assert StoreScaleDriver(store, "ghost").set_replicas("decode", 1) is False
+
+
+def test_callback_scale_driver_dedupes():
+    applied = []
+    drv = CallbackScaleDriver(lambda pool, n: applied.append((pool, n)))
+    assert drv.set_replicas("decode", 3) is True
+    assert drv.set_replicas("decode", 3) is False
+    assert drv.set_replicas("decode", 4) is True
+    assert applied == [("decode", 3), ("decode", 4)]
+
+
+def test_controller_embeds_planner_tick(tmp_path):
+    """reconcile_once ticks an embedded planner; a sick planner must
+    not stop reconciliation."""
+    class TickCounter:
+        def __init__(self, fail=False):
+            self.ticks = 0
+            self.fail = fail
+
+        def tick(self):
+            self.ticks += 1
+            if self.fail:
+                raise RuntimeError("sick planner")
+
+    store = DeploymentStore(str(tmp_path))
+    dep = DynamoDeployment(name="d1", services=[
+        ServiceDeploymentSpec(name="worker", replicas=1),
+    ])
+    store.put("d1", dep.to_dict(), create=True)
+    spawned = []
+
+    class P:
+        rc = None
+
+        def poll(self):
+            return None
+
+        def terminate(self):
+            self.rc = -15
+
+    ctl = DeploymentController(
+        store, spawn=lambda *a: spawned.append(a) or P(),
+        planner=(planner := TickCounter()),
+    )
+    ctl.reconcile_once()
+    ctl.reconcile_once()
+    assert planner.ticks == 2
+    ctl.planner = TickCounter(fail=True)
+    ctl.reconcile_once()  # must not raise
+    assert len(spawned) == 1  # the replica was still converged
+
+
+# ---------------- HTTP overload gate (end to end) ----------------
+
+
+def test_http_shed_returns_429_with_retry_after(run):
+    from tests.test_http_service import http_request, make_local_service
+
+    async def main():
+        clk = FakeClock()
+        gate = AdmissionGate(rate_req_s=1.0, burst=2.0, clock=clk)
+        svc = make_local_service()
+        svc.admission = gate
+        svc.metrics.register_source(gate.render_stats)
+        await svc.start()
+        req = json.dumps({
+            "model": "echo", "messages": [{"role": "user", "content": "hi"}],
+            "nvext": {"use_raw_prompt": True},
+        }).encode()
+        statuses = []
+        for _ in range(4):
+            status, headers, body = await http_request(
+                svc.port, "POST", "/v1/chat/completions", req
+            )
+            statuses.append(status)
+        assert statuses == [200, 200, 429, 429]
+        assert int(headers["retry-after"]) >= 1
+        err = json.loads(body)["error"]
+        assert err["type"] == "overloaded"
+        # shed requests are visible on /metrics, and never reached the
+        # engine's inflight accounting
+        status, _, body = await http_request(svc.port, "GET", "/metrics")
+        text = body.decode()
+        assert 'status="shed"' in text
+        assert gate.stats["shed_total"] == 2
+        assert gate.inflight["interactive"] == 0  # done() released all
+        # the bucket refills: the gate reopens without a restart
+        clk.advance(5)
+        status, _, _ = await http_request(
+            svc.port, "POST", "/v1/chat/completions", req
+        )
+        assert status == 200
+        await svc.close()
+
+    run(main())
+
+
+def test_http_slo_class_annotation_routes_to_batch(run):
+    from tests.test_http_service import http_request, make_local_service
+
+    async def main():
+        clk = FakeClock()
+        gate = AdmissionGate(rate_req_s=10.0, burst=10.0, clock=clk)
+        svc = make_local_service()
+        svc.admission = gate
+        await svc.start()
+        req = json.dumps({
+            "model": "echo", "messages": [{"role": "user", "content": "hi"}],
+            "nvext": {"use_raw_prompt": True, "annotations": ["slo:batch"]},
+        }).encode()
+        status, _, _ = await http_request(
+            svc.port, "POST", "/v1/chat/completions", req
+        )
+        assert status == 200
+        assert gate.stats["admitted_batch"] == 1
+        assert gate.inflight["batch"] == 0
+        # batch may only spend down to its reserve floor: drain to it
+        while gate.admit("batch").admitted:
+            pass
+        status, headers, _ = await http_request(
+            svc.port, "POST", "/v1/chat/completions", req
+        )
+        assert status == 429
+        assert int(headers["retry-after"]) >= 5  # batch's min_retry_after
+        await svc.close()
+
+    run(main())
+
+
+def test_admission_gate_feeds_telemetry_arrivals():
+    clk = FakeClock()
+    tel = TelemetryAggregator(window_s=10.0, clock=clk)
+    # burst 1: only the first request is admitted — but ALL five count
+    # as arrivals, because offered (not served) load is what the
+    # planner sizes the fleet to
+    gate = AdmissionGate(rate_req_s=0.1, burst=1.0, clock=clk,
+                         telemetry=tel)
+    for _ in range(5):
+        gate.admit("interactive", prompt_tokens=100)
+    assert gate.stats["admitted_total"] == 1
+    snap = tel.snapshot()
+    assert snap.request_rate == pytest.approx(0.5)
+    assert snap.prompt_token_rate == pytest.approx(50.0)
