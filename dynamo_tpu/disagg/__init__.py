@@ -22,17 +22,27 @@ The reference's flagship capability, rebuilt TPU-native:
 from .protocols import DisaggConfig, RemotePrefillRequest
 from .queue import PrefillQueue
 from .router import ConditionalDisaggRouter
-from .transfer import KvTransferServer, LocalKvPipe, send_kv_blocks
+from .transfer import (
+    KV_STREAM_VERSION,
+    KvStreamSender,
+    KvTransferServer,
+    LocalKvPipe,
+    TransferError,
+    send_kv_blocks,
+)
 from .worker import DisaggEngine, PrefillWorker
 
 __all__ = [
     "ConditionalDisaggRouter",
     "DisaggConfig",
     "DisaggEngine",
+    "KV_STREAM_VERSION",
+    "KvStreamSender",
     "KvTransferServer",
     "LocalKvPipe",
     "PrefillQueue",
     "PrefillWorker",
     "RemotePrefillRequest",
+    "TransferError",
     "send_kv_blocks",
 ]

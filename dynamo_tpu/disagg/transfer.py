@@ -2,19 +2,38 @@
 (ref patch:811-1216 nixl.py, utils/nixl.py, docs/disagg_serving.md:58-91).
 
 XLA exposes no one-sided remote writes, so the protocol is inverted into
-a push stream: the prefill worker gathers the computed KV blocks on
-device ([L, Hkv, n, bs, D] stacks, one d2h fetch), then ships them over
-a TCP connection to the decode host **layer-chunked** — frame i carries
-layers [i*c, (i+1)*c) of both K and V — so the wire transfer of layer
-chunk i overlaps the serialization of chunk i+1, the same overlap the
-reference gets from per-layer CUDA-stream triggered copies
-(kv/layer.rs:619-1132). The decode side reassembles and scatters into
-its own paged cache with a donated jit scatter.
+a push stream. Two wire flavors share the framing (runtime two-part
+codec — header JSON + raw bytes, same as the response plane):
 
-Frames use the runtime's two-part codec (header JSON + raw bytes), the
-same framing as the response plane. In-process prefill→decode (both
-engines in one process, e.g. two meshes on one host) short-circuits
-through ``LocalKvPipe`` — no serialization at all.
+* **bulk** (legacy, ``send_kv_blocks``): the prefill worker gathers the
+  whole [L, Hkv, n, bs, D] stack after prefill completes and ships it
+  layer-chunked — frame i carries layers [i*c, (i+1)*c) of both K and V
+  so the wire transfer of layer chunk i overlaps the serialization of
+  chunk i+1 (the overlap the reference gets from per-layer CUDA-stream
+  triggered copies, kv/layer.rs:619-1132).
+* **streamed** (``KvStreamSender``): the connection opens at prefill
+  *start* (header declares the total geometry), and each prefill
+  chunk's freshly computed blocks ship as a ``(b0, n)`` segment — still
+  layer-chunked within the segment — the moment the chunk's compute
+  finishes, so the transfer hides behind the remaining prefill compute
+  (FlowKV, PAPERS.md). The final frame carries ``first_token`` /
+  ``first_lp``; ONE end-to-end ack covers the whole stream, so the
+  prefill queue's ack/redeliver semantics (resilience PR 4) are
+  untouched: any mid-stream failure means no ack, and the sender
+  redelivers from scratch (segment re-scatters are idempotent — the
+  decode blocks are pre-allocated and uncommitted until admission).
+
+The decode side either scatters segments incrementally through a
+registered **sink** (DisaggEngine wires the engine's paged-cache
+scatter) or — when no sink is registered, the sink declines (kv-head
+layout / tp mismatch needs the full-stack ``kv_rearrange`` regroup), or
+the peer still speaks bulk — falls back to assembling the full stack
+exactly like the legacy path.
+
+In-process prefill→decode (both engines in one process, e.g. two meshes
+on one host) short-circuits through ``LocalKvPipe`` — the same streamed
+semantics, but the segments are device-resident jax.Arrays handed
+straight to the decode scatter: zero serialization, zero host hops.
 """
 
 from __future__ import annotations
@@ -22,20 +41,38 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
-from ..runtime.codec import TwoPartMessage, read_frame, write_frame
+from ..runtime.codec import (
+    TwoPartMessage,
+    read_frame,
+    write_frame,
+    write_frame_parts,
+)
 from ..runtime.tcp import ConnectionInfo
 
 logger = logging.getLogger(__name__)
+
+#: streamed-protocol version declared in the stream header. Receivers
+#: ignore header keys they don't know (codec forward-compat contract),
+#: and senders only stream when the decode side advertised the
+#: capability in its connection info — an old decode peer never sees a
+#: streamed header, and an old sender's bulk header still decodes here.
+KV_STREAM_VERSION = 1
 
 
 class TransferError(Exception):
     """KV push failed or was not acknowledged — the queue item should be
     redelivered (nack), not treated as delivered."""
+
+
+class SinkClosed(Exception):
+    """The decode side abandoned the request while a stream was landing —
+    remaining segments are drained and discarded, not an error."""
 
 
 _DTYPES = {}
@@ -77,13 +114,129 @@ class KvDelivery:
     # when the request asked for logprobs — computed where the logits are
     # (the prefill worker) and carried with the KV
     first_lp: Optional[dict] = None
+    # True when the KV already landed incrementally through a stream
+    # sink — k_data/v_data are None and the decode side must NOT expect
+    # a bulk stack to scatter
+    streamed: bool = False
+
+
+class _StreamAssembler:
+    """Per-attempt landing policy for one streamed handoff, shared by the
+    TCP server and the in-process pipe. ``begin()`` decides the mode:
+
+    * **sink** — the registered sink accepted (layouts match): every
+      full-layer segment scatters into the decode cache the moment it
+      lands; the final delivery carries no data.
+    * **buffer** — no sink, or the sink declined (kv-head layout / tp
+      mismatch still needs the full-stack regroup): segments accumulate
+      and the delivery is bit-identical to the legacy bulk path.
+    * **discard** — nobody is waiting (the decode side abandoned the
+      request): frames are consumed and acked so the sender doesn't
+      retry a transfer whose result nobody wants (bulk semantics).
+
+    A redelivered stream gets a FRESH assembler (and a fresh
+    ``sink.begin``), so a half-landed first attempt leaves no state —
+    segment re-scatters target the same pre-allocated, uncommitted
+    blocks and are idempotent.
+    """
+
+    def __init__(self, request_id: str, head: dict, sink, discard: bool):
+        self.request_id = request_id
+        self.head = head
+        self.n = int(head.get("n_blocks") or 0)
+        self._candidate = sink
+        self.sink = None
+        self.discard = discard
+        self.parts: list[tuple[int, object, object]] = []
+        self.segments = 0
+        self.covered = 0
+
+    async def begin(self) -> None:
+        if self.discard:
+            return
+        if self._candidate is not None and await self._candidate.begin(self.head):
+            self.sink = self._candidate
+
+    async def add_segment(self, b0: int, k_seg, v_seg) -> None:
+        """One full-layer segment ([L, Hkv, nseg, bs, D] pair) starting at
+        block offset ``b0`` within the shipped range."""
+        if self.discard:
+            return
+        if b0 != self.covered:
+            # segments are emitted in block order; an out-of-order or
+            # duplicate b0 could sum to n_blocks while leaving real
+            # blocks uncovered (recycled KV committed with a clean ack)
+            raise ConnectionError(
+                f"kv stream segment out of order: b0={b0}, expected "
+                f"{self.covered}"
+            )
+        self.segments += 1
+        self.covered += int(k_seg.shape[2])
+        if self.sink is not None:
+            try:
+                await self.sink.segment(b0, k_seg, v_seg)
+            except SinkClosed:
+                # abandoned mid-stream: drain the rest and ack, exactly
+                # like the bulk path consumes a delivery nobody awaits
+                self.sink = None
+                self.discard = True
+                self.parts.clear()
+            return
+        self.parts.append((b0, k_seg, v_seg))
+
+    @staticmethod
+    def _concat(parts: list):
+        if len(parts) == 1:
+            return parts[0]
+        if isinstance(parts[0], np.ndarray):
+            return np.concatenate(parts, axis=2)
+        import jax.numpy as jnp  # device-resident segments (local pipe)
+
+        return jnp.concatenate(parts, axis=2)
+
+    def check_complete(self) -> None:
+        """Before the ack: every declared block must have landed. An
+        incomplete stream delivering would commit a reservation whose
+        missing pages still hold a previous request's recycled KV — it
+        must take the no-ack/redeliver path like every other malformed
+        stream (same hazard class as the intra-segment layer-gap check)."""
+        if self.discard:
+            return
+        if self.covered != self.n:
+            raise ConnectionError(
+                f"kv stream incomplete: {self.covered}/{self.n} blocks"
+            )
+
+    def delivery(self, fin: dict) -> KvDelivery:
+        first_token = int(fin.get("first_token", -1))
+        first_lp = fin.get("first_lp")
+        head = self.head
+        if self.sink is not None or self.n == 0:
+            return KvDelivery(
+                self.request_id, first_token, self.n, None, None,
+                head_layout=head.get("head_layout", "blocked"),
+                src_tp=head.get("src_tp", 1), first_lp=first_lp,
+                streamed=self.sink is not None,
+            )
+        # add_segment enforced in-order contiguous b0, so parts are
+        # already block-ordered
+        k = self._concat([p[1] for p in self.parts])
+        v = self._concat([p[2] for p in self.parts])
+        return KvDelivery(
+            self.request_id, first_token, self.n, k, v,
+            head_layout=head.get("head_layout", "blocked"),
+            src_tp=head.get("src_tp", 1), first_lp=first_lp,
+        )
 
 
 class KvTransferServer:
     """Decode-side listener. ``expect(request_id)`` registers a pending
     delivery and returns (ConnectionInfo, future); the prefill worker
     connects back with the data (mirror of the response plane's
-    connect-back handshake, tcp/server.rs:74)."""
+    connect-back handshake, tcp/server.rs:74). ``expect`` optionally
+    registers a stream *sink* — streamed-protocol segments then scatter
+    into the decode cache as they arrive instead of buffering the full
+    stack."""
 
     def __init__(
         self,
@@ -96,6 +249,7 @@ class KvTransferServer:
         self._advertise = advertise_host
         self._server: Optional[asyncio.AbstractServer] = None
         self._pending: dict[str, asyncio.Future] = {}
+        self._sinks: dict[str, object] = {}
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -122,20 +276,28 @@ class KvTransferServer:
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
+        self._sinks.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
 
-    def expect(self, request_id: str) -> asyncio.Future:
+    def expect(self, request_id: str, sink=None) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        if sink is not None:
+            self._sinks[request_id] = sink
         return fut
 
     def abandon(self, request_id: str) -> None:
+        self._sinks.pop(request_id, None)
         fut = self._pending.pop(request_id, None)
         if fut is not None and not fut.done():
             fut.cancel()
+
+    def _resolve(self, request_id: str) -> Optional[asyncio.Future]:
+        self._sinks.pop(request_id, None)
+        return self._pending.pop(request_id, None)
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         fut: Optional[asyncio.Future] = None
@@ -149,13 +311,16 @@ class KvTransferServer:
             # stay pending so the sender's redelivery retry can complete it
             fut = self._pending.get(req_id)
             if head.get("error"):
-                self._pending.pop(req_id, None)
                 writer.write(b"ok")
                 await writer.drain()
+                fut = self._resolve(req_id)
                 if fut is not None and not fut.done():
                     fut.set_result(
                         KvDelivery(req_id, -1, 0, None, None, error=head["error"])
                     )
+                return
+            if head.get("stream"):
+                await self._handle_stream(reader, writer, head)
                 return
             n = head["n_blocks"]
             shape = tuple(head["shape"])  # [L, Hkv, n, bs, D]
@@ -174,16 +339,19 @@ class KvTransferServer:
                 if part is None:
                     raise ConnectionError("kv stream truncated")
                 l1 = min(l0 + layer_chunk, L)
-                blob = part.data
                 sub_k = (l1 - l0,) + shape[1:]
                 sub_v = (l1 - l0,) + v_shape[1:]
-                k_bytes = int(np.prod(sub_k)) * dt.itemsize
-                k[l0:l1] = np.frombuffer(blob[:k_bytes], dt).reshape(sub_k)
-                v[l0:l1] = np.frombuffer(blob[k_bytes:], dt).reshape(sub_v)
+                cnt_k, cnt_v = int(np.prod(sub_k)), int(np.prod(sub_v))
+                # frombuffer with count/offset: no intermediate bytes
+                # slice copies of multi-MB payloads
+                k[l0:l1] = np.frombuffer(part.data, dt, cnt_k).reshape(sub_k)
+                v[l0:l1] = np.frombuffer(
+                    part.data, dt, cnt_v, offset=cnt_k * dt.itemsize
+                ).reshape(sub_v)
                 l0 = l1
             writer.write(b"ok")
             await writer.drain()
-            self._pending.pop(req_id, None)
+            fut = self._resolve(req_id) or fut
             if fut is not None and not fut.done():
                 fut.set_result(
                     KvDelivery(
@@ -200,6 +368,93 @@ class KvTransferServer:
             logger.exception("kv transfer receive failed; awaiting redelivery")
         finally:
             writer.close()
+            try:
+                # actually release the socket before the handler returns —
+                # under churn (redelivery storms) half-closed sockets
+                # otherwise pile up until the fd limit
+                await writer.wait_closed()
+            except (OSError, ConnectionError):
+                pass
+
+    async def _handle_stream(self, reader, writer, head: dict) -> None:
+        """Streamed-protocol receive: header already read. Unknown header
+        keys are ignored (codec forward-compat contract) so a newer
+        sender's extra fields never break this peer; a mid-stream failure
+        sends no ack and leaves the pending future for the redelivery."""
+        req_id = head["request_id"]
+        fut = self._pending.get(req_id)
+        sink = self._sinks.get(req_id)
+        asm = _StreamAssembler(
+            req_id, head, sink, discard=fut is None or fut.done()
+        )
+        await asm.begin()
+        n = asm.n
+        shape = tuple(head.get("shape") or ())
+        v_shape = tuple(head.get("v_shape") or shape)
+        dt = _np_dtype(head["dtype"]) if n else None
+        L = shape[0] if shape else 0
+        seg_b0, seg_filled = -1, 0
+        seg_k = seg_v = None
+        fin: Optional[dict] = None
+        # read-ahead: the NEXT frame's socket read + deserialize overlap
+        # the current segment's scatter, so the receiver never serializes
+        # wire time behind device time (this is the decode-side half of
+        # the stream's exposed tail)
+        pending = asyncio.ensure_future(read_frame(reader))
+        try:
+            while fin is None:
+                part = await pending
+                if part is None:
+                    raise ConnectionError("kv stream truncated")
+                h = part.header_json() or {}
+                if h.get("fin"):
+                    fin = h
+                    break
+                pending = asyncio.ensure_future(read_frame(reader))
+                if asm.discard:
+                    # nobody is waiting: consume frames to reach fin/ack
+                    # without paying the decode copies
+                    continue
+                b0, ns, l0, l1 = h["b0"], h["n"], h["l0"], h["l1"]
+                if b0 != seg_b0:
+                    if seg_k is not None and seg_filled != L:
+                        raise ConnectionError("kv stream segment interleaved")
+                    seg_b0, seg_filled = b0, 0
+                    seg_k = np.empty((L, shape[1], ns) + shape[3:], dt)
+                    seg_v = np.empty((L, v_shape[1], ns) + v_shape[3:], dt)
+                if l0 != seg_filled:
+                    # a layer-range gap would silently land uninitialized
+                    # np.empty rows in the decode cache
+                    raise ConnectionError(
+                        f"kv stream layer gap: got [{l0},{l1}) at fill "
+                        f"{seg_filled}"
+                    )
+                sub_k = (l1 - l0, shape[1], ns) + shape[3:]
+                sub_v = (l1 - l0, v_shape[1], ns) + v_shape[3:]
+                cnt_k, cnt_v = int(np.prod(sub_k)), int(np.prod(sub_v))
+                # frombuffer with count/offset: no intermediate bytes
+                # slice copies of multi-MB payloads on the hot path
+                seg_k[l0:l1] = np.frombuffer(
+                    part.data, dt, cnt_k
+                ).reshape(sub_k)
+                seg_v[l0:l1] = np.frombuffer(
+                    part.data, dt, cnt_v, offset=cnt_k * dt.itemsize
+                ).reshape(sub_v)
+                seg_filled = l1
+                if l1 == L:
+                    await asm.add_segment(b0, seg_k, seg_v)
+                    seg_k = seg_v = None
+        finally:
+            if not pending.done():
+                pending.cancel()
+        if seg_k is not None and seg_filled != L:
+            raise ConnectionError("kv stream ended mid-segment")
+        asm.check_complete()
+        writer.write(b"ok")
+        await writer.drain()
+        fut = self._resolve(req_id) or fut
+        if fut is not None and not fut.done():
+            fut.set_result(asm.delivery(fin))
 
 
 async def send_kv_blocks(
@@ -240,11 +495,16 @@ async def send_kv_blocks(
         await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
         if n:
             L = k_data.shape[0]
+            k_data = np.ascontiguousarray(k_data)
+            v_data = np.ascontiguousarray(v_data)
             for l0 in range(0, L, layer_chunk):
                 l1 = min(l0 + layer_chunk, L)
-                blob = k_data[l0:l1].tobytes() + v_data[l0:l1].tobytes()
-                await write_frame(
-                    writer, TwoPartMessage(b"", blob)
+                # zero-copy buffer views, and write_frame_parts drains
+                # PER FRAME: the sender paces itself to the socket's
+                # high-water mark instead of staging the whole multi-GB
+                # stack through tobytes copies before the first drain
+                await write_frame_parts(
+                    writer, b"", (k_data[l0:l1], v_data[l0:l1])
                 )
         await writer.drain()
         # require the receiver's ack — anything else (EOF from a mid-stream
@@ -257,6 +517,103 @@ async def send_kv_blocks(
         raise TransferError(str(e)) from e
     finally:
         writer.close()
+        try:
+            await writer.wait_closed()
+        except (OSError, ConnectionError):
+            pass
+
+
+class KvStreamSender:
+    """Prefill-side streamed push: opened at prefill START, fed one
+    segment per completed prefill chunk, finished with the sampled first
+    token. Segment frames are layer-chunked with a per-frame drain
+    (backpressure against the socket, bounded userspace buffering); the
+    single end-to-end ack arrives in :meth:`finish`."""
+
+    def __init__(self, reader, writer, request_id: str, head: dict):
+        self._reader = reader
+        self._writer = writer
+        self.request_id = request_id
+        self._layers = int(head["shape"][0]) if head.get("shape") else 0
+        self._layer_chunk = max(int(head.get("layer_chunk") or 1), 1)
+        self.segments = 0
+
+    @classmethod
+    async def open(
+        cls, connection: ConnectionInfo | dict, request_id: str, head: dict
+    ) -> "KvStreamSender":
+        """Connect and ship the geometry header. ``head`` must carry
+        request_id/stream/n_blocks/shape/v_shape/dtype/layer_chunk plus
+        the sender's head_layout/src_tp."""
+        if isinstance(connection, dict):
+            connection = ConnectionInfo.from_dict(connection)
+        host, port = connection.address.rsplit(":", 1)
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+        except OSError as e:
+            raise TransferError(
+                f"connect to {connection.address} failed: {e}"
+            ) from e
+        sender = cls(reader, writer, request_id, head)
+        try:
+            await write_frame(
+                writer, TwoPartMessage(json.dumps(head).encode(), b"")
+            )
+        except (OSError, ConnectionError) as e:
+            await sender.aclose()
+            raise TransferError(str(e)) from e
+        return sender
+
+    async def send_segment(self, b0: int, k_seg: np.ndarray, v_seg: np.ndarray) -> None:
+        """Ship one segment (host arrays [L, Hkv, nseg, bs, D]) starting
+        at block offset ``b0`` within the shipped range. Layer-chunk
+        slices go to the socket as zero-copy buffer views — no
+        ``tobytes`` staging copy, which would double the sender's memory
+        traffic per segment."""
+        ns = int(k_seg.shape[2])
+        k_seg = np.ascontiguousarray(k_seg)
+        v_seg = np.ascontiguousarray(v_seg)
+        try:
+            for l0 in range(0, self._layers, self._layer_chunk):
+                l1 = min(l0 + self._layer_chunk, self._layers)
+                h = {"b0": b0, "n": ns, "l0": l0, "l1": l1}
+                await write_frame_parts(
+                    self._writer, json.dumps(h).encode(),
+                    (k_seg[l0:l1], v_seg[l0:l1]),
+                )
+            self.segments += 1
+        except (OSError, ConnectionError) as e:
+            raise TransferError(str(e)) from e
+
+    async def finish(
+        self,
+        first_token: int,
+        first_lp: Optional[dict] = None,
+        ack_timeout: float = 30.0,
+    ) -> None:
+        """Fin frame + the stream's single end-to-end ack."""
+        try:
+            fin = {"fin": 1, "first_token": int(first_token), "first_lp": first_lp}
+            await write_frame(
+                self._writer, TwoPartMessage(json.dumps(fin).encode(), b"")
+            )
+            ack = await asyncio.wait_for(
+                self._reader.readexactly(2), timeout=ack_timeout
+            )
+            if ack != b"ok":
+                raise TransferError(f"receiver did not acknowledge (got {ack!r})")
+        except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError) as e:
+            raise TransferError(str(e)) from e
+        finally:
+            await self.aclose()
+
+    async def aclose(self) -> None:
+        self._writer.close()
+        try:
+            await self._writer.wait_closed()
+        except (OSError, ConnectionError):
+            pass
 
 
 class LocalKvPipe:
@@ -264,17 +621,26 @@ class LocalKvPipe:
     (two meshes / two engines on one slice) — the arrays handed over are
     jax.Arrays still resident in HBM (prefill_extract keep_on_device), so
     the whole gather -> deliver -> scatter path is device-to-device with
-    zero host copies. TCP (send_kv_blocks) is the cross-DCN fallback."""
+    zero host copies. TCP (send_kv_blocks) is the cross-DCN fallback.
+
+    ``open_stream`` is the streamed equivalent: per-chunk device arrays
+    hand straight to the decode engine's donated scatter (through the
+    registered sink), so same-slice disagg never leaves HBM AND never
+    serializes on prefill completion."""
 
     def __init__(self):
         self._pending: dict[str, asyncio.Future] = {}
+        self._sinks: dict[str, object] = {}
 
-    def expect(self, request_id: str) -> asyncio.Future:
+    def expect(self, request_id: str, sink=None) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        if sink is not None:
+            self._sinks[request_id] = sink
         return fut
 
     def abandon(self, request_id: str) -> None:
+        self._sinks.pop(request_id, None)
         fut = self._pending.pop(request_id, None)
         if fut is not None and not fut.done():
             fut.cancel()
@@ -290,6 +656,7 @@ class LocalKvPipe:
         src_tp: int = 1,
         first_lp: Optional[dict] = None,
     ) -> None:
+        self._sinks.pop(request_id, None)
         fut = self._pending.pop(request_id, None)
         if fut is None or fut.done():
             return
@@ -300,3 +667,51 @@ class LocalKvPipe:
                 head_layout=head_layout, src_tp=src_tp, first_lp=first_lp,
             )
         )
+
+    async def open_stream(self, request_id: str, head: dict) -> "LocalKvStream":
+        """Streamed in-process handoff: same assembler policy as the TCP
+        server (sink scatter / buffered bulk fallback / discard), zero
+        serialization — segments are whatever arrays the caller holds
+        (device-resident under keep_on_device)."""
+        fut = self._pending.get(request_id)
+        sink = self._sinks.get(request_id)
+        asm = _StreamAssembler(
+            request_id, head, sink, discard=fut is None or fut.done()
+        )
+        await asm.begin()
+        return LocalKvStream(self, request_id, asm)
+
+
+class LocalKvStream:
+    """One streamed handoff over the in-process pipe (KvStreamSender's
+    zero-copy twin): ``segment()`` per completed prefill chunk, then
+    ``finish()`` resolves the decode side's delivery future."""
+
+    def __init__(self, pipe: LocalKvPipe, request_id: str, asm: _StreamAssembler):
+        self._pipe = pipe
+        self.request_id = request_id
+        self._asm = asm
+        self.segments = 0
+
+    async def send_segment(self, b0: int, k_seg, v_seg) -> None:
+        await self._asm.add_segment(b0, k_seg, v_seg)
+        self.segments += 1
+
+    async def finish(self, first_token: int, first_lp: Optional[dict] = None) -> None:
+        try:
+            self._asm.check_complete()
+        except ConnectionError as e:
+            # leave the decode future pending for the redelivery, exactly
+            # like a TCP truncation — the sender must nack, not ack
+            raise TransferError(str(e)) from e
+        self._pipe._sinks.pop(self.request_id, None)
+        fut = self._pipe._pending.pop(self.request_id, None)
+        if fut is None or fut.done():
+            return
+        fut.set_result(
+            self._asm.delivery({"first_token": first_token, "first_lp": first_lp})
+        )
+
+    async def aclose(self) -> None:
+        """Abort: nothing to tear down — the decode side's future stays
+        pending for the queue redelivery, mirroring a TCP truncation."""

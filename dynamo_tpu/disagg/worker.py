@@ -19,6 +19,8 @@ import logging
 import time
 from typing import AsyncIterator, Optional, Union
 
+import numpy as np
+
 from .. import tracing
 from ..engine.engine import JaxEngine, OutOfBlocks
 from ..protocols.common import LLMEngineOutput, PreprocessedRequest
@@ -27,10 +29,23 @@ from ..resilience.faultpoints import FaultInjected
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
+from .transfer import (
+    KV_STREAM_VERSION,
+    KvStreamSender,
+    KvTransferServer,
+    LocalKvPipe,
+    SinkClosed,
+    TransferError,
+    send_kv_blocks,
+)
 from .router import ConditionalDisaggRouter
-from .transfer import KvTransferServer, LocalKvPipe, TransferError, send_kv_blocks
 
 logger = logging.getLogger(__name__)
+
+#: per-segment wall bound for the streamed handoff's socket sends — the
+#: sender's backpressure reaches into prefill compute (device lock held),
+#: so a peer that stops reading must fail fast into nack/redelivery
+SEGMENT_SEND_TIMEOUT_S = 60.0
 
 
 class PrefillWorker:
@@ -41,6 +56,8 @@ class PrefillWorker:
         local_pipe: Optional[LocalKvPipe] = None,
         layer_chunk: int = 4,
         head_layout: Optional[str] = None,
+        kv_stream: bool = True,
+        segment_blocks: int = 0,
     ):
         self.engine = engine
         self.queue = queue
@@ -49,9 +66,18 @@ class PrefillWorker:
         # wire-declared kv-head ordering; override only when wrapping an
         # engine whose extraction really produces a non-natural order
         self.head_layout = head_layout or engine.cfg.kv_head_layout
+        # streamed layer-wise handoff (FlowKV): open the transfer at
+        # prefill start, ship each chunk's blocks as its compute lands.
+        # Engages only when the decode peer advertised the capability in
+        # its connection info — old peers keep getting the bulk protocol
+        self.kv_stream = kv_stream
+        self.segment_blocks = segment_blocks
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
-        self.stats = {"prefills_total": 0, "prefill_errors": 0, "nacks": 0}
+        self.stats = {
+            "prefills_total": 0, "prefill_errors": 0, "nacks": 0,
+            "kv_stream_sends": 0, "kv_stream_segments": 0, "kv_bulk_sends": 0,
+        }
 
     def start(self) -> None:
         if self._task is None:
@@ -147,21 +173,45 @@ class PrefillWorker:
             # end (gather -> pipe -> decode scatter, no host hop); the TCP
             # path needs host bytes anyway
             local = bool(rpr.connection.get("local")) and self.local_pipe is not None
-            with tracing.span(
+            # graceful downgrade: stream only when the decode peer
+            # advertised a protocol version covering ours — an old peer
+            # (no kv_stream key, or a lower version) silently gets the
+            # bulk protocol it already speaks
+            streamed = (
+                self.kv_stream
+                and int(rpr.connection.get("kv_stream") or 0) >= KV_STREAM_VERSION
+                and hasattr(self.engine, "prefill_extract_stream")
+                and (local or not rpr.connection.get("local"))
+            )
+            if streamed:
+                await self._process_streamed(rpr, req, ctx, local)
+                return
+            timings: dict = {}
+            compute_span = tracing.span(
                 "prefill.compute", request_id=rpr.request_id,
                 prompt_tokens=len(req.token_ids), skip_blocks=rpr.skip_blocks,
-            ):
+            )
+            with compute_span:
                 first, first_lp, k, v = await self.engine.prefill_extract(
-                    req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local
+                    req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local,
+                    timings=timings,
+                )
+                # the d2h gather inside the extract is handoff time, not
+                # prompt compute — ttft.py carves it out of this span
+                # into the kv_transfer decomposition
+                compute_span.set(
+                    kv_gather_ms=round(timings.get("gather_ms", 0.0), 3)
                 )
             self.stats["prefills_total"] += 1
             layout = self.head_layout
             tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
             await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
-            with tracing.span(
+            send_span = tracing.span(
                 "prefill.kv_send", request_id=rpr.request_id,
                 local=bool(rpr.connection.get("local")),
-            ):
+            )
+            with send_span:
+                t0 = time.perf_counter()
                 try:
                     if rpr.connection.get("local"):
                         assert self.local_pipe is not None, "local connection without pipe"
@@ -185,9 +235,170 @@ class PrefillWorker:
                     # strand the decode side waiting out its full
                     # transfer timeout on a prefill nobody will redo)
                     raise TransferError(f"kv handoff failed: {e}") from e
+                # bulk handoff: the ENTIRE send sits after prefill, so it
+                # is all exposed transfer time (ttft.py reads these attrs)
+                send_span.set(
+                    exposed_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                    hidden_ms=0.0,
+                )
+            self.stats["kv_bulk_sends"] += 1
         finally:
             if trace_token is not None:
                 tracing.reset_trace(trace_token)
+
+    async def _process_streamed(
+        self, rpr: RemotePrefillRequest, req: PreprocessedRequest, ctx, local: bool
+    ) -> None:
+        """Streamed handoff: open the transfer BEFORE prefill compute,
+        pump each chunk's blocks through a bounded send queue while the
+        next chunk computes, finish with the sampled first token and the
+        stream's single end-to-end ack. Failure semantics match the bulk
+        path exactly: transfer trouble -> TransferError (nack/redeliver),
+        fault kill -> crash-like no-ack, compute error -> propagates for
+        the deterministic error notification."""
+        engine = self.engine
+        layout = self.head_layout
+        tp = engine.cfg.mesh.tp if engine.cfg.mesh else 1
+        n_prompt = engine.n_prompt_blocks(len(req.token_ids))
+        n = max(n_prompt - rpr.skip_blocks, 0)
+        kc, vc = engine.k_cache, engine.v_cache
+        head = {
+            "request_id": rpr.request_id,
+            "stream": KV_STREAM_VERSION,
+            "n_blocks": n,
+            "shape": [kc.shape[0], kc.shape[1], n, kc.shape[3], kc.shape[4]],
+            "v_shape": [vc.shape[0], vc.shape[1], n, vc.shape[3], vc.shape[4]],
+            "dtype": str(kc.dtype),
+            "layer_chunk": self.layer_chunk,
+            "head_layout": layout,
+            "src_tp": tp,
+        }
+        await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
+        send_span = tracing.span(
+            "prefill.kv_send", request_id=rpr.request_id, local=local,
+            streamed=True,
+        )
+        # the connection opens at prefill START — segment i's wire time
+        # hides behind chunk i+1's compute (FlowKV, ROADMAP item 1)
+        if local:
+            assert self.local_pipe is not None
+            stream = await self.local_pipe.open_stream(rpr.request_id, head)
+        else:
+            try:
+                stream = await KvStreamSender.open(
+                    rpr.connection, rpr.request_id, head
+                )
+            except TransferError as e:
+                send_span.set(error=type(e).__name__)
+                send_span.end()
+                raise
+        sendq: asyncio.Queue = asyncio.Queue(maxsize=2)
+
+        send_ms = 0.0
+
+        async def pump() -> None:
+            nonlocal send_ms
+            while True:
+                item = await sendq.get()
+                if item is None:
+                    return
+                t_s = time.perf_counter()
+                try:
+                    # the pump's backpressure reaches into prefill compute
+                    # (emit_upto blocks on the queue under the DEVICE
+                    # lock), so a half-open peer that stops reading must
+                    # become a bounded TransferError -> nack, not a
+                    # forever-wedged prefill engine
+                    await asyncio.wait_for(
+                        stream.send_segment(*item), SEGMENT_SEND_TIMEOUT_S
+                    )
+                except (TransferError, FaultInjected):
+                    raise
+                except asyncio.TimeoutError as e:
+                    raise TransferError(
+                        f"kv segment send stalled > {SEGMENT_SEND_TIMEOUT_S}s"
+                    ) from e
+                except Exception as e:  # noqa: BLE001 — same contract as
+                    # the bulk handoff stage: an uncommitted segment must
+                    # redeliver, never ack-with-error
+                    raise TransferError(f"kv segment handoff failed: {e}") from e
+                send_ms += (time.perf_counter() - t_s) * 1e3
+                self.stats["kv_stream_segments"] += 1
+
+        pump_task = asyncio.get_running_loop().create_task(pump())
+
+        async def put_or_fail(item) -> None:
+            # never block on a queue whose consumer died: race the put
+            # against the pump so a send failure surfaces immediately
+            put = asyncio.ensure_future(sendq.put(item))
+            done, _ = await asyncio.wait(
+                {put, pump_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if put in done:
+                return
+            put.cancel()
+            exc = pump_task.exception()
+            raise exc if exc else TransferError("kv stream sender stopped")
+
+        async def on_segment(b0: int, k_seg, v_seg) -> None:
+            await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
+            if not local:
+                k_seg, v_seg = np.asarray(k_seg), np.asarray(v_seg)
+            await put_or_fail((b0, k_seg, v_seg))
+
+        ok = False
+        timings: dict = {}
+        try:
+            compute_span = tracing.span(
+                "prefill.compute", request_id=rpr.request_id,
+                prompt_tokens=len(req.token_ids), skip_blocks=rpr.skip_blocks,
+            )
+            with compute_span:
+                first, first_lp, _sent = await engine.prefill_extract_stream(
+                    req, ctx, skip_blocks=rpr.skip_blocks, keep_on_device=local,
+                    segment_blocks=self.segment_blocks, on_segment=on_segment,
+                    timings=timings,
+                )
+                # per-segment gathers OVERLAP the wire transfer of the
+                # segments already shipped — unlike the bulk path's
+                # whole-stack gather (which nothing overlaps, so it's
+                # carved into kv_transfer_exposed via kv_gather_ms),
+                # they are pipeline stages, recorded for observability
+                # but left inside the prefill region
+                compute_span.set(
+                    seg_gather_ms=round(timings.get("gather_ms", 0.0), 3)
+                )
+            self.stats["prefills_total"] += 1
+            t_done = time.perf_counter()
+            await put_or_fail(None)
+            await pump_task  # drains the tail; raises on send failure
+            await stream.finish(first, first_lp)
+            ok = True
+            self.stats["kv_stream_sends"] += 1
+            # exposed = the post-compute tail (final drain + fin + ack);
+            # hidden = ACTUAL send activity that overlapped compute (the
+            # pump's measured per-segment send time minus the part that
+            # ran in the tail) — not the open-to-finish window, which
+            # would misreport the whole prefill duration as transfer.
+            # ttft.py folds these into the PR 2 decomposition
+            now = time.perf_counter()
+            exposed_ms = (now - t_done) * 1e3
+            send_span.set(
+                exposed_ms=round(exposed_ms, 3),
+                hidden_ms=round(max(send_ms - exposed_ms, 0.0), 3),
+                segments=stream.segments,
+                n_blocks=n,
+            )
+        finally:
+            if not pump_task.done():
+                pump_task.cancel()
+                try:
+                    await pump_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            if not ok:
+                await stream.aclose()
+            send_span.end()
 
     async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
         try:
@@ -204,6 +415,58 @@ class PrefillWorker:
             logger.exception("error notification failed: %s", rpr.request_id)
 
 
+class _RemoteScatterSink:
+    """Decode-side landing policy for ONE streamed remote prefill: each
+    segment scatters into the request's pre-allocated pages the moment
+    it arrives (engine.scatter_remote_segment), so the full-stack buffer
+    never materializes and only the final segment's tail can sit on
+    TTFT. ``begin`` declines — routing the stream into the buffered bulk
+    fallback — when the sender's kv-head layout / tp doesn't match this
+    engine (kv_rearrange has no per-segment regroup yet; see
+    docs/disagg_serving.md). ``aclose`` waits out any in-flight scatter
+    before the caller frees the reservation, so an abandoned stream can
+    never write into recycled pages."""
+
+    def __init__(self, engine: JaxEngine, handle, stats: dict):
+        self._engine = engine
+        self._handle = handle
+        self._stats = stats
+        self._closed = False
+        self._lock = asyncio.Lock()
+        self.segments = 0
+
+    async def begin(self, head: dict) -> bool:
+        if self._closed:
+            return False
+        my_layout = self._engine.cfg.kv_head_layout
+        my_tp = self._engine.cfg.mesh.tp if self._engine.cfg.mesh else 1
+        layout = head.get("head_layout", "blocked")
+        src_tp = head.get("src_tp", 1)
+        if layout != my_layout or (
+            layout == "interleaved" and src_tp != my_tp
+        ):
+            return False  # bulk fallback: buffer + rearrange + one scatter
+        # a redelivered stream restarts from block 0 — re-scatters over
+        # the same uncommitted pages are idempotent
+        self.segments = 0
+        return True
+
+    async def segment(self, b0: int, k_seg, v_seg) -> None:
+        async with self._lock:
+            if self._closed:
+                raise SinkClosed(self._handle.seq.context.id)
+            await self._engine.scatter_remote_segment(
+                self._handle, b0, k_seg, v_seg
+            )
+            self.segments += 1
+            self._stats["kv_stream_segments"] += 1
+
+    async def aclose(self) -> None:
+        self._closed = True
+        async with self._lock:
+            pass
+
+
 class DisaggEngine(AsyncEngine):
     """Decode-side conditional-disaggregation front (AsyncEngine over
     PreprocessedRequest -> LLMEngineOutput stream)."""
@@ -216,6 +479,7 @@ class DisaggEngine(AsyncEngine):
         transfer: Union[KvTransferServer, LocalKvPipe],
         engine_id: int = 0,
         transfer_timeout: float = 120.0,
+        kv_stream: bool = True,
     ):
         self.engine = engine
         self.router = router
@@ -223,12 +487,23 @@ class DisaggEngine(AsyncEngine):
         self.transfer = transfer
         self.engine_id = engine_id
         self.transfer_timeout = transfer_timeout
-        self.stats = {"remote_prefills": 0, "local_prefills": 0, "remote_errors": 0}
+        # advertise the streamed-handoff capability to prefill workers;
+        # off = force the legacy bulk protocol end to end
+        self.kv_stream = kv_stream
+        self.stats = {
+            "remote_prefills": 0, "local_prefills": 0, "remote_errors": 0,
+            "streamed_deliveries": 0, "bulk_deliveries": 0,
+            "kv_stream_segments": 0,
+        }
 
     def _connection(self) -> dict:
         if isinstance(self.transfer, LocalKvPipe):
-            return {"local": True}
-        return self.transfer.address.to_dict()
+            conn = {"local": True}
+        else:
+            conn = self.transfer.address.to_dict()
+        if self.kv_stream:
+            conn["kv_stream"] = KV_STREAM_VERSION
+        return conn
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
         req = request.data
@@ -262,7 +537,11 @@ class DisaggEngine(AsyncEngine):
         self.stats["remote_prefills"] += 1
         self.engine.start()
         req_id = request.id
-        fut = self.transfer.expect(req_id)
+        sink = (
+            _RemoteScatterSink(self.engine, handle, self.stats)
+            if self.kv_stream else None
+        )
+        fut = self.transfer.expect(req_id, sink=sink)
         rpr = RemotePrefillRequest(
             request_id=req_id,
             request=req.to_dict(),
@@ -283,15 +562,21 @@ class DisaggEngine(AsyncEngine):
             await self.queue.enqueue(rpr)
             delivery = await asyncio.wait_for(fut, self.transfer_timeout)
         except asyncio.CancelledError:
-            # caller went away: clean up the reservation, propagate
+            # caller went away: clean up the reservation, propagate.
+            # The sink must close BEFORE abort_remote frees the blocks —
+            # an in-flight streamed scatter may still be writing them
             remote_span.set(error="cancelled")
             self.transfer.abandon(req_id)
+            if sink is not None:
+                await sink.aclose()
             self.engine.abort_remote(handle, "cancelled")
             raise
         except Exception as e:  # noqa: BLE001 — timeout, enqueue or
             # transfer-stream failure: blocks must return to the pool
             remote_span.set(error=type(e).__name__)
             self.transfer.abandon(req_id)
+            if sink is not None:
+                await sink.aclose()
             self.stats["remote_errors"] += 1
             self.engine.abort_remote(handle, f"remote prefill failed: {e}")
             yield await handle.seq.out_queue.get()
@@ -302,9 +587,23 @@ class DisaggEngine(AsyncEngine):
             remote_span.end()
         if delivery.error:
             self.stats["remote_errors"] += 1
+            if sink is not None:
+                await sink.aclose()
             self.engine.abort_remote(handle, delivery.error)
             yield await handle.seq.out_queue.get()
             return
+        if delivery.streamed:
+            self.stats["streamed_deliveries"] += 1
+        else:
+            self.stats["bulk_deliveries"] += 1
+        if sink is not None:
+            # the delivery is complete: a STALE concurrent attempt (a
+            # visibility-timeout redelivery racing the winner) must not
+            # scatter into these pages once they commit and go live for
+            # decode — closing the sink turns its late segments into
+            # SinkClosed -> discard, and waits out any in-flight scatter
+            # before the commit below
+            await sink.aclose()
         k_data, v_data = delivery.k_data, delivery.v_data
         my_layout = self.engine.cfg.kv_head_layout
         my_tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1

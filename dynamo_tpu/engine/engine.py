@@ -2461,7 +2461,7 @@ class JaxEngine(AsyncEngine):
 
     async def prefill_extract(
         self, req: PreprocessedRequest, context, skip_blocks: int = 0,
-        keep_on_device: bool = False,
+        keep_on_device: bool = False, timings: Optional[dict] = None,
     ) -> tuple[int, Optional[dict], Optional[np.ndarray], Optional[np.ndarray]]:
         """Prefill-worker side: compute the prompt's KV (with this worker's
         own prefix cache), sample the first token (max_tokens=1 semantics,
@@ -2508,9 +2508,18 @@ class JaxEngine(AsyncEngine):
                 n_prompt = self.n_prompt_blocks(len(prompt))
                 idxs = [b.idx for b in seq.blocks[skip_blocks:n_prompt]]
                 if idxs:
+                    t_g = time.perf_counter()
                     k_np, v_np = await asyncio.get_running_loop().run_in_executor(
                         None, self._gather_device, idxs, keep_on_device
                     )
+                    if timings is not None:
+                        # the d2h extraction is HANDOFF work, not prompt
+                        # compute — the caller folds it into the
+                        # kv_transfer decomposition (ttft.py)
+                        timings["gather_ms"] = (
+                            timings.get("gather_ms", 0.0)
+                            + (time.perf_counter() - t_g) * 1e3
+                        )
                 else:
                     k_np = v_np = None
             self._commit_full_blocks(seq)
@@ -2518,6 +2527,97 @@ class JaxEngine(AsyncEngine):
             self.allocator.free(seq.blocks)
             seq.blocks = []
         return first_token, first_lp, k_np, v_np
+
+    async def prefill_extract_stream(
+        self, req: PreprocessedRequest, context, skip_blocks: int = 0,
+        keep_on_device: bool = False, segment_blocks: int = 0,
+        on_segment=None, timings: Optional[dict] = None,
+    ) -> tuple[int, Optional[dict], int]:
+        """Streamed twin of :meth:`prefill_extract` (ROADMAP item 1 /
+        FlowKV): the prompt prefills chunk by chunk and every chunk's
+        freshly completed blocks are gathered and handed to
+        ``on_segment(b0, k_seg, v_seg)`` the moment the chunk's compute
+        finishes — the caller ships them while the NEXT chunk computes,
+        hiding the transfer behind prefill. ``b0`` is the block offset
+        relative to ``skip_blocks``; segments arrive in order and cover
+        [skip_blocks, n_prompt_blocks) exactly once. ``segment_blocks``
+        caps a segment's block count (0 = one segment per prefill chunk).
+
+        The FINAL segment (including the prompt's partial last block) is
+        emitted BEFORE first-token sampling, so even the tail transfer
+        overlaps the sampling dispatch instead of sitting on TTFT.
+
+        Gathers go through the same bucketed ``_gather_device`` as the
+        bulk path, so the compiled-program count is bounded by segment
+        GEOMETRY buckets, not per-request shapes (test_compiled_perf).
+        Returns (first_token, first_lp, blocks_emitted)."""
+        if self.mirror is not None:
+            keep_on_device = False
+        prompt = list(req.token_ids)
+        seq = _Sequence(
+            request=req,
+            context=context,
+            out_queue=asyncio.Queue(),
+            tokens=prompt,
+            prompt_len=len(prompt),
+            trace=tracing.current_trace() if tracing.enabled() else None,
+        )
+        reserved = self._reserve_for_prompt(seq)
+        if reserved is None:
+            raise OutOfBlocks(f"cannot cover {len(prompt)}-token prompt")
+        history = reserved[0]
+        self.stats["prefix_cache_hits_tokens"] += history
+        bs = self.cfg.block_size
+        n_prompt = self.n_prompt_blocks(len(prompt))
+        sent = skip_blocks
+        loop = asyncio.get_running_loop()
+
+        async def emit_upto(full: int) -> None:
+            nonlocal sent
+            while sent < full:
+                hi = (
+                    min(full, sent + segment_blocks)
+                    if segment_blocks > 0 else full
+                )
+                idxs = [b.idx for b in seq.blocks[sent:hi]]
+                t_g = time.perf_counter()
+                k_seg, v_seg = await loop.run_in_executor(
+                    None, self._gather_device, idxs, keep_on_device
+                )
+                if timings is not None:
+                    # per-segment d2h time is handoff work too (same
+                    # accounting as the bulk twin's single gather)
+                    timings["gather_ms"] = (
+                        timings.get("gather_ms", 0.0)
+                        + (time.perf_counter() - t_g) * 1e3
+                    )
+                await on_segment(sent - skip_blocks, k_seg, v_seg)
+                sent = hi
+
+        try:
+            async with self._device_lock:
+                await loop.run_in_executor(None, self._offload_preamble)
+                pos = history
+                logits = None
+                while pos < len(prompt):
+                    logits, pos = await loop.run_in_executor(
+                        None, self._run_one_chunk, seq, pos
+                    )
+                    # blocks whose every position is now written; the
+                    # final chunk also releases the partial last block
+                    full = n_prompt if pos >= len(prompt) else min(
+                        pos // bs, n_prompt
+                    )
+                    if on_segment is not None and full > sent:
+                        await emit_upto(full)
+                first_token, first_lp = await loop.run_in_executor(
+                    None, self._sample_prefill, seq, logits
+                )
+            self._commit_full_blocks(seq)
+        finally:
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+        return first_token, first_lp, max(n_prompt - skip_blocks, 0)
 
     def _gather_device(self, idxs: list[int], keep_on_device: bool = False):
         from .offload import _gather_blocks, _pad_idxs
@@ -2607,6 +2707,52 @@ class JaxEngine(AsyncEngine):
             self._remote_ready.append(seq)
             self._wake.set()
         return seq.out_queue
+
+    async def scatter_remote_segment(
+        self, handle: "RemoteHandle", b0: int, k_data, v_data
+    ) -> None:
+        """Streamed disagg landing (decode side): scatter ONE segment's
+        blocks into the pre-allocated reservation the moment it arrives,
+        instead of buffering the full [L, Hkv, n, bs, D] stack until
+        prefill completes. ``b0`` is the block offset relative to the
+        handle's skip_blocks. Replay-safe: a redelivered stream
+        re-scatters the same still-uncommitted pages, so exactly-once
+        queue semantics need no extra bookkeeping here.
+
+        The data stack is padded HOST-side to the bucketed index count
+        (pad rows target trash block 0), so the donated scatter compiles
+        one program per segment-size bucket — not one per distinct
+        segment geometry (test_compiled_perf guard)."""
+        seq = handle.seq
+        n = int(k_data.shape[2])
+        if n == 0:
+            return
+        blocks = seq.blocks[handle.skip_blocks + b0 : handle.skip_blocks + b0 + n]
+        if seq.finished or len(blocks) != n:
+            raise RuntimeError(
+                f"remote segment [{b0}, {b0 + n}) outside the live "
+                f"reservation of {getattr(seq.context, 'id', '?')}"
+            )
+        idxs = [b.idx for b in blocks]
+        async with self._device_lock:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._scatter_segment_device, idxs, k_data, v_data
+            )
+
+    def _scatter_segment_device(self, idxs: list[int], k_data, v_data) -> None:
+        from .offload import _pad_idxs
+
+        bucket = len(_pad_idxs(idxs))
+        if int(k_data.shape[2]) < bucket:
+            pad = [(0, 0)] * k_data.ndim
+            pad[2] = (0, bucket - int(k_data.shape[2]))
+            if isinstance(k_data, np.ndarray):
+                k_data = np.pad(k_data, pad)
+                v_data = np.pad(v_data, pad)
+            else:  # device-resident segment (LocalKvPipe)
+                k_data = jnp.pad(k_data, pad)
+                v_data = jnp.pad(v_data, pad)
+        self._scatter_device(idxs, k_data, v_data)
 
     def abort_remote(self, handle: "RemoteHandle", message: str = "") -> None:
         seq = handle.seq

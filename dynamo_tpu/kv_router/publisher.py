@@ -173,6 +173,9 @@ class KvMetricsAggregator:
                     draining=d.get("draining", 0),
                     drains_total=d.get("drains_total", 0),
                     migration_resumes=d.get("migration_resumes", 0),
+                    kv_stream_deliveries=d.get("streamed_deliveries", 0),
+                    kv_bulk_deliveries=d.get("bulk_deliveries", 0),
+                    kv_stream_segments=d.get("kv_stream_segments", 0),
                     requests_total=d.get("requests_total", 0),
                     tokens_generated=d.get("tokens_generated", 0),
                     prompt_tokens_total=d.get("prompt_tokens_total", 0),

@@ -61,6 +61,12 @@ class WorkerLoad:
     draining: int = 0
     drains_total: int = 0
     migration_resumes: int = 0
+    # disagg KV-handoff surface (DisaggEngine.stats): streamed (layer-
+    # wise, transfer hidden behind prefill) vs bulk deliveries, plus the
+    # segment volume landed through the incremental scatter path
+    kv_stream_deliveries: int = 0
+    kv_bulk_deliveries: int = 0
+    kv_stream_segments: int = 0
     # cumulative serving counters (engine stats): the planner's
     # telemetry aggregator turns scrape-to-scrape deltas into fleet
     # arrival/throughput rates

@@ -478,6 +478,7 @@ async def run_endpoint(args) -> None:
         disagg_engine = DisaggEngine(
             jax_core, disagg_router, queue, transfer,
             engine_id=drt.primary_lease_id,
+            kv_stream=args.kv_stream,
         )
         engine = OpenAIWorkerEngine(tokenizer, disagg_engine)
         stats = lambda: (  # noqa: E731
@@ -577,7 +578,10 @@ async def run_prefill(args) -> None:
         component=drt.namespace(ns).component("prefill"),
     )
     queue = PrefillQueue(drt.bus, ns)
-    worker = PrefillWorker(core, queue)
+    worker = PrefillWorker(
+        core, queue, kv_stream=args.kv_stream,
+        segment_blocks=args.kv_segment_blocks,
+    )
     worker.start()
     print(f"prefill worker {drt.worker_id:x} serving {name!r} "
           f"on queue {queue.name}", flush=True)
@@ -883,6 +887,18 @@ def main(argv=None) -> None:
                    help="decode: offload long prompts to prefill workers")
     p.add_argument("--max-local-prefill", type=int, default=512,
                    help="uncached prompt tokens above this go remote")
+    p.add_argument("--kv-stream", dest="kv_stream", action="store_true",
+                   default=True,
+                   help="streamed layer-wise KV handoff: open the "
+                        "transfer at prefill start and ship each chunk's "
+                        "blocks as its compute finishes (default)")
+    p.add_argument("--no-kv-stream", dest="kv_stream", action="store_false",
+                   help="force the legacy post-prefill bulk KV handoff "
+                        "(decode role stops advertising the streamed "
+                        "capability; prefill role stops using it)")
+    p.add_argument("--kv-segment-blocks", type=int, default=0,
+                   help="cap per-segment block count in the streamed "
+                        "handoff (0 = one segment per prefill chunk)")
     p.add_argument("--no-migration", action="store_true",
                    help="disable transparent in-flight request migration "
                         "(frontend roles: a worker death then errors its "

@@ -177,6 +177,12 @@ class MetricsComponent:
             gauge("draining", w.draining, lb)
             gauge("drains_total", w.drains_total, lb)
             gauge("migration_resumes_total", w.migration_resumes, lb)
+            # disagg KV handoff: streamed (transfer hidden behind
+            # prefill compute) vs legacy bulk deliveries, and how many
+            # segments landed through the incremental scatter
+            gauge("kv_stream_deliveries_total", w.kv_stream_deliveries, lb)
+            gauge("kv_bulk_deliveries_total", w.kv_bulk_deliveries, lb)
+            gauge("kv_stream_segments_total", w.kv_stream_segments, lb)
             # cumulative serving counters (planner telemetry inputs)
             gauge("requests_served_total", w.requests_total, lb)
             gauge("tokens_generated_total", w.tokens_generated, lb)

@@ -13,9 +13,9 @@ made with (see docs/tracing.md for the definitions):
                          transport, scheduling gaps
 
 ``kv_transfer_hidden`` is reported alongside (PR 1's restore-latency
-accounting: transfer time overlapped behind scheduling/compute) but is
-NOT part of the sum — hidden latency, by definition, cost the request
-nothing.
+accounting plus the streamed disagg handoff's behind-prefill transfer
+time: transfer overlapped behind scheduling/compute) but is NOT part of
+the sum — hidden latency, by definition, cost the request nothing.
 
 The components are measured leaf spans; ``first_decode`` is defined as
 the un-attributed remainder, so the decomposition sums to the measured
@@ -91,13 +91,27 @@ def decompose(spans: list[dict]) -> Optional[dict]:
         spans, SPAN_PREFILL_QUEUE_WAIT
     )
     kv_exposed = _sum_attr(spans, SPAN_KV_RESTORE, "exposed_ms")
-    kv_hidden = _sum_attr(spans, SPAN_KV_RESTORE, "hidden_ms")
+    # hidden: PR 1's restore overlap + the streamed disagg handoff's
+    # transfer activity overlapped behind prefill compute (the sender
+    # stamps exposed/hidden on prefill.kv_send; its exposed tail is
+    # already part of the decode side's remote-wait remainder below, so
+    # only hidden folds in here — exposed would double-count)
+    kv_hidden = _sum_attr(spans, SPAN_KV_RESTORE, "hidden_ms") + _sum_attr(
+        spans, SPAN_PREFILL_KV_SEND, "hidden_ms"
+    )
     prefill = _sum_dur(spans, SPAN_PREFILL) + _sum_dur(spans, SPAN_PREFILL_COMPUTE)
-    # the engine's kv-restore wait happens INSIDE the prefill region
-    # (offload preamble of the first chunk / the remote extract), so the
-    # prefill spans contain it — carve it out so the components stay
-    # disjoint and the sum honest
-    prefill = max(prefill - kv_exposed, 0.0)
+    # the BULK handoff's whole-stack d2h gather inside the disagg
+    # prefill worker's compute span is pure HANDOFF work (nothing
+    # overlaps it) — count it as kv_transfer. The streamed path's
+    # per-segment gathers overlap the wire transfer of already-shipped
+    # segments, so they stay inside prefill (seg_gather_ms attr)
+    kv_gather = _sum_attr(spans, SPAN_PREFILL_COMPUTE, "kv_gather_ms")
+    # the engine's kv-restore wait and the extraction gathers happen
+    # INSIDE the prefill region (offload preamble of the first chunk /
+    # the remote extract), so the prefill spans contain them — carve
+    # them out so the components stay disjoint and the sum honest
+    prefill = max(prefill - kv_exposed - kv_gather, 0.0)
+    kv_exposed += kv_gather
     # remote prefill: the decode side's wait covers queue wait + compute +
     # transfer; what it paid beyond the accounted parts is KV transfer
     remote_wait = _sum_dur(spans, SPAN_DISAGG_REMOTE)

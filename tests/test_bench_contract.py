@@ -9,9 +9,17 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# slow: the subprocess runs the FULL bench, whose scenario suite has
+# grown PR over PR (decode smoke + offload + ttft + mixed-batch +
+# churn + overload + disagg handoff ≈ 4 minutes) — too heavy for the
+# tier-1 window, and the driver's bench stage exercises bench.py every
+# round anyway (same precedent as test_cross_process_disagg)
+@pytest.mark.slow
 def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"  # honored explicitly by bench.py
@@ -66,6 +74,24 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert gated["client_errors"] == 0 and gated["goodput_frac"] == 1.0, ov
     assert gated["within_target"], ov
     assert gated["ttft_p99_ms"] < ungated["ttft_p99_ms"], ov
+    # streamed KV handoff must be recorded (ISSUE 6): the streamed and
+    # bulk paths serve bit-identical token streams, every delivery used
+    # its intended wire flavor, and the streamed path's exposed
+    # kv_transfer is a small fraction of the bulk path's (the bulk
+    # stack's gather+serialize+wire+scatter all sit on TTFT; streamed
+    # leaves only the final segment's drain + fin/ack)
+    dg = result.get("bench_disagg")
+    assert dg, result.get("bench_disagg_error", "metric missing")
+    assert dg["tokens_match"] is True, dg
+    assert dg["streamed"]["deliveries"] == dg["requests"], dg
+    assert dg["bulk"]["deliveries"] == dg["requests"], dg
+    assert dg["streamed"]["segments"] > dg["requests"], dg
+    assert dg["streamed"]["kv_transfer_hidden_ms"]["p50"] > 0, dg
+    # the tight headline ratio belongs to a SOLO bench run (the driver's
+    # artifact); under a loaded CI box CPU contention hits the streamed
+    # path's many small ops hardest, so the contract only pins the
+    # direction: streaming must strictly reduce exposed transfer
+    assert dg["exposed_p50_frac_of_bulk"] < 1.0, dg
 
 
 def test_smoke_regression_band_catches_r03_drop():

@@ -313,3 +313,84 @@ def test_pp_decode_moves_activations_not_weights():
         if np.prod(dims) >= 64 * 64:
             big_ag.append(m.group(0)[:120])
     assert not big_ag, f"stage-weight all-gathers appeared: {big_ag}"
+
+
+def test_streamed_handoff_program_count_bounded(run):
+    """Shape-bucketing guard for the streamed disagg handoff (ISSUE 6):
+    the incremental extract's per-segment gathers and the decode side's
+    per-segment scatters must compile one program per SEGMENT-GEOMETRY
+    BUCKET (``_pad_idxs`` power-of-two bucketing), never per request
+    shape — an accidental per-request key would inject an XLA compile
+    into every streamed segment of every new prompt length."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.offload import _gather_blocks, _pad_idxs, _scatter_blocks
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context
+
+    cfg = ModelConfig.tiny(dtype="float32")
+
+    def eng():
+        return JaxEngine(
+            EngineConfig(
+                model=cfg, num_blocks=64, block_size=4, max_batch_size=4,
+                max_context=128, prefill_chunk=8,
+            ),
+            seed=0,
+        )
+
+    def req(toks):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    prefill, decode = eng(), eng()
+
+    async def main():
+        # prompts of DIFFERENT lengths whose chunking lands on the same
+        # segment bucket (prefill_chunk 8 / block 4 -> 2-block segments)
+        cases = [
+            (list(range(10, 34)), 0),   # 24 tokens, per-chunk segments
+            (list(range(50, 90)), 0),   # 40 tokens, same 2-block bucket
+            (list(range(200, 224)), 1), # segment_blocks=1 -> new bucket
+        ]
+        g0, s0 = _gather_blocks._cache_size(), _scatter_blocks._cache_size()
+        seen_buckets = set()
+        for i, (toks, seg_blocks) in enumerate(cases):
+            segs = []
+
+            async def on_segment(b0, k, v, _segs=segs):
+                _segs.append((b0, np.asarray(k), np.asarray(v)))
+
+            await prefill.prefill_extract_stream(
+                req(toks), None, segment_blocks=seg_blocks,
+                on_segment=on_segment,
+            )
+            handle = decode.begin_remote(Context(req(toks)))
+            assert handle is not None
+            for b0, k, v in segs:
+                seen_buckets.add(len(_pad_idxs(list(range(k.shape[2])))))
+                await decode.scatter_remote_segment(handle, b0, k, v)
+            decode.abort_remote(handle, "test teardown")
+        g_grown = _gather_blocks._cache_size() - g0
+        s_grown = _scatter_blocks._cache_size() - s0
+        assert g_grown <= len(seen_buckets), (
+            f"extract gathers compiled {g_grown} programs for "
+            f"{len(seen_buckets)} segment buckets {sorted(seen_buckets)}"
+        )
+        assert s_grown <= len(seen_buckets), (
+            f"segment scatters compiled {s_grown} programs for "
+            f"{len(seen_buckets)} segment buckets {sorted(seen_buckets)}"
+        )
+        await prefill.close()
+        await decode.close()
+
+    run(main())

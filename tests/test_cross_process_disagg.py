@@ -65,13 +65,18 @@ def _wait_http(url: str, deadline: float, pred=lambda b: True) -> bytes:
 
 
 @pytest.mark.slow
-def test_four_process_disagg_round_trip(run, tmp_path):
+@pytest.mark.parametrize("kv_stream", [True, False], ids=["streamed", "bulk"])
+def test_four_process_disagg_round_trip(run, tmp_path, kv_stream):
+    """Real multi-process round trip for BOTH handoff flavors: the
+    default streamed layer-wise protocol, and the --no-kv-stream bulk
+    downgrade (also the shape an old peer negotiates to)."""
     hub_port, http_port = _free_port(), _free_port()
     hub_addr = f"127.0.0.1:{hub_port}"
     engine_args = [
         "--model-path", "tiny", "--hub", hub_addr,
         "--num-blocks", "64", "--block-size", "4", "--max-batch", "2",
         "--host", "127.0.0.1",
+        *([] if kv_stream else ["--no-kv-stream"]),
     ]
     logs = [str(tmp_path / f"proc{i}.log") for i in range(4)]
     procs = [
@@ -120,6 +125,12 @@ def test_four_process_disagg_round_trip(run, tmp_path):
             await drt.shutdown()
             assert any(
                 s.get("data", {}).get("remote_prefills", 0) >= 1 for s in stats
+            ), stats
+            # and it used the EXPECTED wire flavor: streamed segments by
+            # default, the bulk protocol under --no-kv-stream
+            flavor = "streamed_deliveries" if kv_stream else "bulk_deliveries"
+            assert any(
+                s.get("data", {}).get(flavor, 0) >= 1 for s in stats
             ), stats
 
         run(check_stats())

@@ -22,7 +22,7 @@ from dynamo_tpu.disagg import (
     PrefillWorker,
     RemotePrefillRequest,
 )
-from dynamo_tpu.disagg.transfer import send_kv_blocks
+from dynamo_tpu.disagg.transfer import KvStreamSender, send_kv_blocks
 from dynamo_tpu.engine import EngineConfig, JaxEngine
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
@@ -166,6 +166,85 @@ def test_kv_transfer_tcp_roundtrip(run):
     run(main())
 
 
+def test_kv_stream_tcp_roundtrip(run):
+    """Streamed protocol over real TCP with NO registered sink: segments
+    buffer on the receiver and the delivery is bit-identical to the bulk
+    path's full stack. Headers carry extra unknown keys (forward-compat
+    contract: a newer peer's fields must be ignored, not fatal)."""
+
+    async def main():
+        srv = KvTransferServer()
+        await srv.start()
+        fut = srv.expect("req-s1")
+        rng = np.random.default_rng(2)
+        k = rng.standard_normal((4, 2, 5, 4, 8)).astype(np.float32)
+        v = rng.standard_normal((4, 2, 5, 4, 8)).astype(np.float32)
+        head = {
+            "request_id": "req-s1", "stream": 1, "n_blocks": 5,
+            "shape": [4, 2, 5, 4, 8], "v_shape": [4, 2, 5, 4, 8],
+            "dtype": "float32", "layer_chunk": 3,
+            "head_layout": "blocked", "src_tp": 1,
+            "future_knob": {"x": 1},  # unknown key: must be ignored
+        }
+        sender = await KvStreamSender.open(srv.address, "req-s1", head)
+        # two uneven segments, shipped out of completion order of sizes
+        await sender.send_segment(0, k[:, :, :2], v[:, :, :2])
+        await sender.send_segment(2, k[:, :, 2:], v[:, :, 2:])
+        await sender.finish(77, {"logprob": -0.5})
+        d = await asyncio.wait_for(fut, 5)
+        assert d.first_token == 77 and d.n_blocks == 5 and not d.streamed
+        assert d.first_lp == {"logprob": -0.5}
+        np.testing.assert_array_equal(d.k_data, k)
+        np.testing.assert_array_equal(d.v_data, v)
+
+        # zero-block stream (decode's prefix cache covered every shipped
+        # block): header + fin only, no data frames
+        fut0 = srv.expect("req-s0")
+        head0 = dict(head, request_id="req-s0", n_blocks=0,
+                     shape=[4, 2, 0, 4, 8], v_shape=[4, 2, 0, 4, 8])
+        sender0 = await KvStreamSender.open(srv.address, "req-s0", head0)
+        await sender0.finish(12)
+        d0 = await asyncio.wait_for(fut0, 5)
+        assert d0.first_token == 12 and d0.n_blocks == 0
+        assert d0.k_data is None and d0.error is None
+        await srv.close()
+
+    run(main())
+
+
+def test_kv_stream_truncation_leaves_future_pending(run):
+    """A sender dying mid-stream must NOT resolve the delivery future —
+    the pending future is what the queue's redelivery retries against
+    (resilience contract: no ack, no delivery, try again)."""
+
+    async def main():
+        srv = KvTransferServer()
+        await srv.start()
+        fut = srv.expect("req-t1")
+        k = np.zeros((2, 2, 4, 4, 8), np.float32)
+        head = {
+            "request_id": "req-t1", "stream": 1, "n_blocks": 4,
+            "shape": [2, 2, 4, 4, 8], "v_shape": [2, 2, 4, 4, 8],
+            "dtype": "float32", "layer_chunk": 1,
+            "head_layout": "blocked", "src_tp": 1,
+        }
+        sender = await KvStreamSender.open(srv.address, "req-t1", head)
+        await sender.send_segment(0, k[:, :, :2], k[:, :, :2])
+        await sender.aclose()  # dies before fin
+        await asyncio.sleep(0.1)
+        assert not fut.done()
+        # a second (redelivered) attempt completes the SAME future
+        sender2 = await KvStreamSender.open(srv.address, "req-t1", head)
+        await sender2.send_segment(0, k[:, :, :2], k[:, :, :2])
+        await sender2.send_segment(2, k[:, :, 2:], k[:, :, 2:])
+        await sender2.finish(5)
+        d = await asyncio.wait_for(fut, 5)
+        assert d.first_token == 5 and d.n_blocks == 4
+        await srv.close()
+
+    run(main())
+
+
 # ---------------- end-to-end ----------------
 
 
@@ -176,8 +255,13 @@ def _disagg_stack():
     return decode, prefill
 
 
+@pytest.mark.parametrize("kv_stream", [True, False])
 @pytest.mark.parametrize("mode", ["local_pipe", "tcp"])
-def test_disagg_end_to_end_matches_aggregated(run, mode):
+def test_disagg_end_to_end_matches_aggregated(run, mode, kv_stream):
+    """The full handoff matrix: {local pipe, TCP} x {streamed, bulk} all
+    land a first token + decode continuation bit-identical to aggregated
+    serving, and each flavor is asserted to have actually engaged."""
+
     async def main():
         drt = await DistributedRuntime.from_settings()
         router = ConditionalDisaggRouter(
@@ -188,13 +272,17 @@ def test_disagg_end_to_end_matches_aggregated(run, mode):
         decode, prefill = _disagg_stack()
         if mode == "local_pipe":
             transfer = LocalKvPipe()
-            worker = PrefillWorker(prefill, queue, local_pipe=transfer)
+            worker = PrefillWorker(
+                prefill, queue, local_pipe=transfer, kv_stream=kv_stream
+            )
         else:
             transfer = KvTransferServer()
             await transfer.start()
-            worker = PrefillWorker(prefill, queue, layer_chunk=1)
+            worker = PrefillWorker(
+                prefill, queue, layer_chunk=1, kv_stream=kv_stream
+            )
         worker.start()
-        eng = DisaggEngine(decode, router, queue, transfer)
+        eng = DisaggEngine(decode, router, queue, transfer, kv_stream=kv_stream)
 
         prompt = list(range(10, 34))  # 24 tokens >> max_local 8 -> remote
         outs = await collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
@@ -202,6 +290,13 @@ def test_disagg_end_to_end_matches_aggregated(run, mode):
         assert outs[-1].finish_reason in (FinishReason.LENGTH, FinishReason.EOS)
         assert eng.stats["remote_prefills"] == 1
         assert worker.stats["prefills_total"] == 1
+        if kv_stream:
+            assert eng.stats["streamed_deliveries"] == 1
+            assert worker.stats["kv_stream_sends"] == 1
+            assert worker.stats["kv_stream_segments"] >= 1
+        else:
+            assert eng.stats["bulk_deliveries"] == 1
+            assert worker.stats["kv_bulk_sends"] == 1
 
         # aggregated reference run with the same weights must match exactly
         ref_engine = JaxEngine(engine_cfg(), params=PARAMS)
@@ -259,6 +354,9 @@ def test_disagg_mla_kv_transfer_matches_aggregated(run):
         outs = await collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
         toks = [t for o in outs for t in o.token_ids]
         assert eng.stats["remote_prefills"] == 1
+        # the default handoff is STREAMED: the asymmetric v_shape rode
+        # the per-segment frames, not the bulk stack
+        assert eng.stats["streamed_deliveries"] == 1
 
         ref_engine = JaxEngine(engine_cfg(model=mla_cfg), params=mla_params)
         ref = await collect(ref_engine.generate(Context(make_req(prompt, max_tokens=6))))
@@ -337,10 +435,13 @@ def test_disagg_first_token_carries_logprobs(run):
     run(main())
 
 
-def test_disagg_local_pipe_stays_on_device(run):
+@pytest.mark.parametrize("kv_stream", [True, False])
+def test_disagg_local_pipe_stays_on_device(run, kv_stream):
     """VERDICT round-1 missing #3: the in-process pipe must hand over
     device-resident jax.Arrays — no numpy hop, so same-slice disagg never
-    pays d2h + h2d. (The TCP path still serializes, by design.)"""
+    pays d2h + h2d. (The TCP path still serializes, by design.) Both
+    handoff flavors: the bulk delivery's full stack, and every SEGMENT
+    of the streamed handoff landing through the decode scatter sink."""
 
     async def main():
         import jax as _jax
@@ -353,29 +454,139 @@ def test_disagg_local_pipe_stays_on_device(run):
         queue = PrefillQueue(drt.bus)
         decode, prefill = _disagg_stack()
         transfer = LocalKvPipe()
-        seen = {}
+        seen = []
         orig_deliver = transfer.deliver
+        orig_scatter = decode.scatter_remote_segment
 
-        async def spy(request_id, first_token, k_data, v_data, **kw):
-            seen["k"], seen["v"] = k_data, v_data
+        async def spy_deliver(request_id, first_token, k_data, v_data, **kw):
+            seen.append((k_data, v_data))
             await orig_deliver(request_id, first_token, k_data, v_data, **kw)
 
-        transfer.deliver = spy
-        worker = PrefillWorker(prefill, queue, local_pipe=transfer)
+        async def spy_scatter(handle, b0, k_data, v_data):
+            seen.append((k_data, v_data))
+            await orig_scatter(handle, b0, k_data, v_data)
+
+        transfer.deliver = spy_deliver
+        decode.scatter_remote_segment = spy_scatter
+        worker = PrefillWorker(
+            prefill, queue, local_pipe=transfer, kv_stream=kv_stream
+        )
         worker.start()
-        eng = DisaggEngine(decode, router, queue, transfer)
+        eng = DisaggEngine(decode, router, queue, transfer, kv_stream=kv_stream)
         prompt = list(range(50, 74))
         outs = await collect(eng.generate(Context(make_req(prompt, max_tokens=4))))
         assert [t for o in outs for t in o.token_ids]
-        assert isinstance(seen["k"], _jax.Array), type(seen["k"])
-        assert isinstance(seen["v"], _jax.Array)
-        assert not isinstance(seen["k"], np.ndarray)
+        if kv_stream:
+            assert eng.stats["streamed_deliveries"] == 1
+            assert len(seen) >= 1  # one scatter per streamed segment
+        else:
+            assert eng.stats["bulk_deliveries"] == 1
+            assert len(seen) == 1
+        for k, v in seen:
+            assert isinstance(k, _jax.Array), type(k)
+            assert isinstance(v, _jax.Array)
+            assert not isinstance(k, np.ndarray)
 
         await worker.close()
         await decode.close()
         await prefill.close()
         await router.stop()
         await drt.shutdown()
+
+    run(main())
+
+
+@pytest.mark.faultinject
+def test_disagg_streamed_kill_mid_stream_redelivers_once(run):
+    """A prefill worker killed MID-STREAM (after segments already landed
+    in the decode cache) must look like a crash: no ack, the half-landed
+    stream resolves nothing, and a surviving worker's redelivery re-runs
+    the prefill and re-streams from scratch over the SAME pre-allocated
+    blocks — the decode side sees exactly one delivery and a token
+    stream bit-identical to an unkilled aggregated run."""
+    from dynamo_tpu.resilience import faultpoints
+
+    async def main():
+        drt = await DistributedRuntime.from_settings()
+        router = ConditionalDisaggRouter(
+            drt, "dynamo", "tiny", DisaggConfig(max_local_prefill_length=8)
+        )
+        await router.start()
+        queue = PrefillQueue(drt.bus, redeliver_after=3.0)
+        decode, prefill = _disagg_stack()
+        transfer = KvTransferServer()
+        await transfer.start()
+        # segment_blocks=2 splits the 6-block prompt into 3 segments so
+        # the kill can land strictly MID-stream
+        worker_a = PrefillWorker(prefill, queue, layer_chunk=1, segment_blocks=2)
+        worker_a.start()
+        eng = DisaggEngine(decode, router, queue, transfer)
+
+        try:
+            # warm-up round trip (faultpoint not armed): compiles every
+            # jit in the streamed path (module-level caches, shared by
+            # worker B's engine) so neither attempt of the measured
+            # request outlives the redelivery visibility window
+            warm = await collect(
+                eng.generate(Context(make_req(list(range(60, 84)), max_tokens=2)))
+            )
+            assert [t for o in warm for t in o.token_ids]
+            assert eng.stats["streamed_deliveries"] == 1
+            # the cold-compile warm-up may have outlived the visibility
+            # window and been processed twice (second copy DISCARDED by
+            # the assembler — delivery above still counted once); only
+            # deltas from here on are meaningful
+            a_sends = worker_a.stats["kv_stream_sends"]
+
+            # hit 1 = stream open, hits 2+ = one per emitted segment:
+            # the 3rd hit kills worker A after a segment already
+            # scattered into the decode cache
+            faultpoints.arm("mid_kv_transfer", "kill", after=3, times=1)
+            prompt = list(range(10, 34))
+            gen = asyncio.ensure_future(
+                collect(eng.generate(Context(make_req(prompt, max_tokens=6))))
+            )
+            # wait for worker A to die mid-stream, then bring up the
+            # survivor that consumes the redelivered item
+            for _ in range(100):
+                if worker_a._stop.is_set():
+                    break
+                await asyncio.sleep(0.05)
+            assert worker_a._stop.is_set(), "fault point never fired"
+            # A's measured-request attempt never completed a stream
+            assert worker_a.stats["kv_stream_sends"] == a_sends
+            prefill_b = JaxEngine(engine_cfg(), params=PARAMS)
+            worker_b = PrefillWorker(
+                prefill_b, queue, layer_chunk=1, segment_blocks=2
+            )
+            worker_b.start()
+            outs = await asyncio.wait_for(gen, 30)
+            toks = [t for o in outs for t in o.token_ids]
+            assert outs[-1].finish_reason in (FinishReason.LENGTH, FinishReason.EOS)
+
+            ref_engine = JaxEngine(engine_cfg(), params=PARAMS)
+            ref = await collect(
+                ref_engine.generate(Context(make_req(prompt, max_tokens=6)))
+            )
+            assert toks == [t for o in ref for t in o.token_ids]
+            # exactly once: one delivery of the measured request (plus
+            # the warm-up's), by the survivor, and the item is off the
+            # queue (acked only after the handoff committed)
+            assert eng.stats["streamed_deliveries"] == 2
+            assert worker_b.stats["kv_stream_sends"] >= 1
+            assert await queue.get_depth() == 0
+
+            await worker_b.close()
+            await prefill_b.close()
+            await ref_engine.close()
+        finally:
+            faultpoints.reset()
+            await worker_a.close()
+            await transfer.close()
+            await decode.close()
+            await prefill.close()
+            await router.stop()
+            await drt.shutdown()
 
     run(main())
 

@@ -105,21 +105,22 @@ def test_disagg_delivery_applies_regroup(run):
             seed=0,
         )
         # simulate an engine that physically stores heads interleaved:
-        # permute what the natural-order gather returns
-        orig_extract = prefill_engine.prefill_extract
+        # permute what the natural-order gather returns — patched at the
+        # GATHER so both the bulk extract and the streamed per-segment
+        # extract ship permuted data (the streamed sink must then
+        # DECLINE on the layout mismatch and fall back to the buffered
+        # bulk-identical delivery this regroup applies to)
+        orig_gather = prefill_engine._gather_device
 
-        async def interleaved_extract(req, ctx, skip_blocks=0, **kw):
-            first, first_lp, k, v = await orig_extract(
-                req, ctx, skip_blocks, **kw
-            )
-            if k is not None:
-                k = regroup_heads(k, tp=2, src_layout="blocked",
-                                  dst_layout="interleaved")
-                v = regroup_heads(v, tp=2, src_layout="blocked",
-                                  dst_layout="interleaved")
-            return first, first_lp, k, v
+        def interleaved_gather(idxs, keep_on_device=False):
+            k, v = orig_gather(idxs, keep_on_device)
+            k = regroup_heads(k, tp=2, src_layout="blocked",
+                              dst_layout="interleaved")
+            v = regroup_heads(v, tp=2, src_layout="blocked",
+                              dst_layout="interleaved")
+            return k, v
 
-        prefill_engine.prefill_extract = interleaved_extract
+        prefill_engine._gather_device = interleaved_gather
 
         decode_engine = JaxEngine(
             EngineConfig(

@@ -553,7 +553,7 @@ class _StubPrefillEngine:
     cfg = _Cfg()
 
     async def prefill_extract(self, req, ctx, skip_blocks=0,
-                              keep_on_device=False):
+                              keep_on_device=False, timings=None):
         return 7, None, None, None
 
 
