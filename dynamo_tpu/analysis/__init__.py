@@ -1,0 +1,31 @@
+"""dynlint: invariant-encoding static analysis for the serving stack.
+
+PRs 1-6 built an async serving stack whose correctness rests on a
+handful of conventions that previously lived only in review comments:
+no blocking host work on the event loop, device mutations only under
+``_device_lock`` (and no network awaits while holding it), module-scope
+``jax.jit`` only, forward-compatible codec header reads, writers closed
+through ``wait_closed()``, faultpoints exercised by tests. Every rule in
+:mod:`dynamo_tpu.analysis.rules` encodes a bug class we actually shipped
+and then fixed by hand; the pass keeps them fixed.
+
+Run it::
+
+    python -m dynamo_tpu.analysis dynamo_tpu/ tests/
+
+Suppress a finding on one line with a justification::
+
+    writer.close()  # dynlint: disable=writer-wait-closed -- lingering transports
+
+See docs/static_analysis.md for the rule catalog and
+:mod:`dynamo_tpu.analysis.sanitizer` for the runtime counterpart (loop
+stall / lock hold / leaked writer detection under the live test suite).
+"""
+
+from .engine import (  # noqa: F401
+    LintReport,
+    Violation,
+    lint_paths,
+    lint_source,
+)
+from .rules import ALL_RULES, Rule  # noqa: F401

@@ -1,0 +1,59 @@
+"""CLI: ``python -m dynamo_tpu.analysis [paths...]``.
+
+Exit 0 when clean, 1 on violations (the CI gate in scripts/check.sh).
+``--json`` emits the machine-readable report; ``--rule`` restricts to a
+subset (comma-separated names); ``--list-rules`` prints the catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .engine import lint_paths
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.analysis",
+        description="dynlint: invariant-encoding static analysis "
+        "(docs/static_analysis.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=["dynamo_tpu/", "tests/"],
+        help="files/directories to lint (default: dynamo_tpu/ tests/)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--rule", default="",
+        help="comma-separated rule names to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            kind = "project" if r.project else "file"
+            print(f"{r.name:26s} [{kind}] {r.summary}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rule:
+        wanted = {n.strip() for n in args.rule.split(",") if n.strip()}
+        unknown = wanted - {r.name for r in ALL_RULES}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = tuple(r for r in ALL_RULES if r.name in wanted)
+
+    report = lint_paths(args.paths, rules=rules)
+    print(report.to_json() if args.json else report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""dynlint driver: file discovery, suppression comments, reporting.
+
+Suppression grammar (pylint-style, justification encouraged)::
+
+    code()  # dynlint: disable=rule-a,rule-b -- why this is safe
+    # dynlint: disable=rule-a          <- alone on a line: next line
+    # dynlint: disable-file=rule-a     <- whole file (first 25 lines)
+
+Suppressions are counted and reported (``--json`` carries them), so a
+tree that is "clean" by silencing everything is visible as such.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from .rules import ALL_RULES, Rule, Violation
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dynlint:\s*(disable(?:-file)?)\s*=\s*([\w\-*,\s]+?)\s*(?:--.*)?$"
+)
+
+#: directories never linted (fixtures, build junk)
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "build"}
+
+
+@dataclass
+class _Suppressions:
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    file_wide: set[str] = field(default_factory=set)
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "*" in self.file_wide:
+            return True
+        names = self.by_line.get(line, ())
+        return rule in names or "*" in names
+
+
+def _parse_suppressions(source: str) -> _Suppressions:
+    sup = _Suppressions()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [
+            (t.start[0], t.string, t.line)
+            for t in tokens if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = [
+            (i + 1, line[line.index("#"):], line)
+            for i, line in enumerate(source.splitlines()) if "#" in line
+        ]
+    for lineno, comment, full_line in comments:
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        kind, names_s = m.group(1), m.group(2)
+        names = {n.strip() for n in names_s.split(",") if n.strip()}
+        if kind == "disable-file":
+            if lineno <= 25:
+                sup.file_wide |= names
+            continue
+        target = lineno
+        if full_line.strip().startswith("#"):
+            # comment-only line: applies to the NEXT line
+            target = lineno + 1
+        sup.by_line.setdefault(target, set()).update(names)
+    return sup
+
+
+@dataclass
+class LintReport:
+    violations: list[Violation] = field(default_factory=list)
+    files_checked: int = 0
+    suppressed: int = 0
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.errors
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "ok": self.ok,
+                "files_checked": self.files_checked,
+                "suppressed": self.suppressed,
+                "errors": self.errors,
+                "violations": [v.to_dict() for v in self.violations],
+            },
+            indent=2,
+        )
+
+    def render(self) -> str:
+        lines = []
+        for v in sorted(self.violations, key=lambda v: (v.path, v.line)):
+            lines.append(f"{v.path}:{v.line}: [{v.rule}] {v.message}")
+        for e in self.errors:
+            lines.append(f"error: {e}")
+        n = len(self.violations)
+        lines.append(
+            f"dynlint: {self.files_checked} files, {n} violation"
+            f"{'s' if n != 1 else ''}, {self.suppressed} suppressed"
+        )
+        return "\n".join(lines)
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    p = os.path.abspath(path)
+    if root:
+        try:
+            p = os.path.relpath(p, root)
+        except ValueError:  # different drive (windows) — keep absolute
+            pass
+    else:
+        # anchor at the repo-shaped segment so path-scoped rules match
+        # regardless of where the checkout lives
+        for marker in ("dynamo_tpu", "tests"):
+            idx = p.replace("\\", "/").find("/" + marker + "/")
+            if idx >= 0:
+                p = p[idx + 1:]
+                break
+    return p.replace("\\", "/")
+
+
+def lint_source(
+    relpath: str,
+    source: str,
+    rules: Sequence[Rule] = ALL_RULES,
+) -> tuple[list[Violation], int]:
+    """Lint one in-memory file. ``relpath`` drives rule scoping (use
+    repo-shaped paths like ``dynamo_tpu/engine/engine.py``). Returns
+    (violations, suppressed_count). Project rules are skipped — they
+    need the whole file set (:func:`lint_paths`)."""
+    out, suppressed, _sup = _lint_one(relpath, source, rules)
+    return out, suppressed
+
+
+def _lint_one(
+    relpath: str, source: str, rules: Sequence[Rule]
+) -> tuple[list[Violation], int, _Suppressions]:
+    """Per-file pass, returning the parsed suppressions too so
+    :func:`lint_paths` can reuse them for project-rule coverage without
+    tokenizing every file a second time."""
+    sup = _parse_suppressions(source)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return (
+            [Violation("syntax-error", relpath, e.lineno or 0, str(e))],
+            0,
+            sup,
+        )
+    out: list[Violation] = []
+    suppressed = 0
+    for rule in rules:
+        if rule.project or not rule.applies_to(relpath):
+            continue
+        for v in rule.check(relpath, source, tree):
+            if sup.covers(v.rule, v.line):
+                suppressed += 1
+            else:
+                out.append(v)
+    return out, suppressed, sup
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d not in _SKIP_DIRS
+                ]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Sequence[Rule] = ALL_RULES,
+    root: Optional[str] = None,
+) -> LintReport:
+    """Lint files/directories. Project rules (cross-file invariants like
+    faultpoint test coverage) run over the full collected file set."""
+    report = LintReport()
+    files: dict[str, str] = {}
+    sups: dict[str, _Suppressions] = {}
+    for path in _iter_py_files(paths):
+        rel = _rel(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                files[rel] = f.read()
+        except OSError as e:
+            report.errors.append(f"{rel}: {e}")
+    for rel, source in files.items():
+        vs, sup_n, sup = _lint_one(rel, source, rules)
+        report.violations.extend(vs)
+        report.suppressed += sup_n
+        sups[rel] = sup
+        report.files_checked += 1
+    for rule in rules:
+        if not rule.project:
+            continue
+        for v in rule.check_project(files):
+            sup = sups.get(v.path)
+            if sup is not None and sup.covers(v.rule, v.line):
+                report.suppressed += 1
+            else:
+                report.violations.append(v)
+    return report

@@ -1,0 +1,731 @@
+"""The dynlint rule set: one rule per bug class PRs 1-6 shipped and fixed.
+
+Every rule documents the historical incident that motivated it (see
+docs/static_analysis.md for the operator-facing catalog). Rules are
+deliberately narrow — they encode *this repo's* invariants, not generic
+style. A finding is suppressed line-by-line with::
+
+    offending_code()  # dynlint: disable=<rule-name> -- why this is safe
+
+File-scope rules (path predicates) keep the noise down: the async
+blocking rule only watches event-loop packages, the header rule only
+watches wire decoders, the jit rule only watches serving code (tests
+build throwaway jits all the time).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+__all__ = ["Rule", "Violation", "ALL_RULES"]
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+#: packages whose code runs on (or adjacent to) the serving event loop —
+#: the scope of the async-blocking and lock-discipline rules
+EVENT_LOOP_PACKAGES = (
+    "dynamo_tpu/engine/",
+    "dynamo_tpu/disagg/",
+    "dynamo_tpu/http/",
+    "dynamo_tpu/kv_router/",
+    "dynamo_tpu/planner/",
+    "dynamo_tpu/resilience/",
+    "dynamo_tpu/runtime/",
+    "dynamo_tpu/observability/",
+    "dynamo_tpu/tracing/",
+    "dynamo_tpu/sdk/",
+    "dynamo_tpu/launch/",
+)
+
+#: wire-decoder modules bound by the codec forward-compat contract
+DECODER_MODULES = (
+    "dynamo_tpu/runtime/codec.py",
+    "dynamo_tpu/runtime/tcp.py",
+    "dynamo_tpu/runtime/component.py",
+    "dynamo_tpu/runtime/hub.py",
+    "dynamo_tpu/disagg/transfer.py",
+    "dynamo_tpu/disagg/worker.py",
+)
+
+
+def _dotted(func: ast.expr) -> str:
+    """Best-effort dotted name for a call target: ``time.sleep``,
+    ``np.asarray``, ``writer.wait_closed``...  Empty for complex
+    expressions (subscripts, calls-of-calls)."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        # <expr>.attr — keep the attribute chain, mark the base opaque
+        return "?." + ".".join(reversed(parts))
+    return ""
+
+
+def _base_source(func: ast.expr) -> str:
+    """Source text of the receiver of an attribute call (``x.y`` of
+    ``x.y.close()``) — used for name-pattern matching on lock/writer
+    variables."""
+    if isinstance(func, ast.Attribute):
+        try:
+            return ast.unparse(func.value)
+        except Exception:  # noqa: BLE001 — unparse of exotic nodes
+            return ""
+    return ""
+
+
+def _walk_same_scope(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``node`` without descending into nested function/class
+    definitions: code inside an inner ``def`` does not execute in the
+    enclosing scope, so scope-sensitive rules must not attribute it."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+class Rule:
+    """Base: per-file AST rule. ``project`` rules instead see the whole
+    file set at once (cross-file invariants)."""
+
+    name: str = ""
+    summary: str = ""
+    project: bool = False
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("dynamo_tpu/")
+
+    def check(
+        self, relpath: str, source: str, tree: ast.AST
+    ) -> list[Violation]:
+        return []
+
+    def check_project(
+        self, files: dict[str, str]
+    ) -> list[Violation]:  # pragma: no cover - overridden by project rules
+        return []
+
+
+# ---------------------------------------------------------------------------
+# 1. async-blocking-call
+# ---------------------------------------------------------------------------
+
+
+class AsyncBlockingCallRule(Rule):
+    """Blocking host work inside ``async def`` bodies of event-loop
+    modules. PR 1 moved the d2h gathers off the scheduler loop and PR 6
+    moved the streamed sender's ``tobytes`` staging copies off it — both
+    after shipping a build whose token streams froze for the duration of
+    a host copy. ``asyncio.sleep`` is of course fine; ``time.sleep``,
+    sync socket/subprocess ops, multi-MB host materialization
+    (``.tobytes()`` / ``np.asarray`` of device buffers) and
+    ``block_until_ready`` belong in ``run_in_executor``."""
+
+    name = "async-blocking-call"
+    summary = "blocking call on the event loop (PR 1/PR 6 invariant)"
+
+    BLOCKING_DOTTED = {
+        "time.sleep": "time.sleep blocks the event loop — use asyncio.sleep",
+        "socket.create_connection":
+            "sync socket connect on the loop — use asyncio.open_connection",
+        "socket.getaddrinfo":
+            "sync DNS resolution on the loop — use loop.getaddrinfo",
+        "subprocess.run": "sync subprocess on the loop — use asyncio.create_subprocess_exec",
+        "subprocess.check_output":
+            "sync subprocess on the loop — use asyncio.create_subprocess_exec",
+        "subprocess.check_call":
+            "sync subprocess on the loop — use asyncio.create_subprocess_exec",
+        "subprocess.call": "sync subprocess on the loop — use asyncio.create_subprocess_exec",
+        "os.system": "sync subprocess on the loop — use asyncio.create_subprocess_exec",
+        "jax.block_until_ready":
+            "device sync on the loop — run_in_executor (PR 1 invariant)",
+        "np.asarray":
+            "host materialization on the loop — multi-MB device->host copies "
+            "belong in run_in_executor (PR 6 streamed-sender fix)",
+        "numpy.asarray":
+            "host materialization on the loop — multi-MB device->host copies "
+            "belong in run_in_executor (PR 6 streamed-sender fix)",
+    }
+    #: attribute calls flagged regardless of receiver
+    BLOCKING_ATTRS = {
+        "tobytes":
+            ".tobytes() stages a full host copy on the loop — write buffer "
+            "views (codec.write_frame_parts) or copy in an executor",
+        "block_until_ready":
+            "device sync on the loop — run_in_executor (PR 1 invariant)",
+        "recv": "sync socket read on the loop",
+        "recv_into": "sync socket read on the loop",
+        "sendall": "sync socket write on the loop",
+        "accept": "sync socket accept on the loop",
+    }
+    #: socket-shaped receiver names for the .recv/.sendall/.accept
+    #: attribute checks (exact leaf "s", or substring "sock"/"conn" —
+    #: NOT a bare "s" substring, which would match nearly any name)
+    _SOCKETY = ("sock", "conn")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(EVENT_LOOP_PACKAGES)
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in _walk_same_scope(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                why = self.BLOCKING_DOTTED.get(dotted)
+                if why is None and isinstance(sub.func, ast.Attribute):
+                    attr = sub.func.attr
+                    why = self.BLOCKING_ATTRS.get(attr)
+                    if why is not None and attr in (
+                        "recv", "recv_into", "sendall", "accept"
+                    ):
+                        leaf = _base_source(sub.func).rsplit(".", 1)[-1].lower()
+                        if leaf != "s" and not any(
+                            t in leaf for t in self._SOCKETY
+                        ):
+                            why = None
+                if why is not None:
+                    out.append(Violation(
+                        self.name, relpath, sub.lineno,
+                        f"`{dotted or ast.unparse(sub.func)}` in async "
+                        f"`{node.name}`: {why}",
+                    ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 2. await-in-lock
+# ---------------------------------------------------------------------------
+
+
+class AwaitInLockRule(Rule):
+    """Network/queue awaits while holding an ``asyncio.Lock``. The
+    engine's ``_device_lock`` serializes device mutations; PR 6's review
+    found a half-open peer could wedge the prefill engine *under its
+    device lock* because the segment send awaited the socket inside the
+    critical section. Device dispatch (``run_in_executor``) under the
+    lock is the designed pattern; socket/bus/queue waits are not —
+    copy out, release, then send."""
+
+    name = "await-in-lock"
+    summary = "network/queue await while holding a lock (PR 6 review bug)"
+
+    #: awaited call targets that park the coroutine on I/O another task
+    #: (or a remote peer) must complete
+    IO_ATTRS = {
+        "open_connection", "start_server", "read_frame", "write_frame",
+        "write_frame_parts", "drain", "wait_closed", "readexactly",
+        "readline", "readuntil", "read", "publish", "subscribe",
+        "request", "direct", "round_robin", "send_request", "sendall",
+        "finish",
+    }
+    #: queue-shaped receivers whose get/put block on another task
+    QUEUE_ATTRS = {"get", "put", "join"}
+    _QUEUEY = ("queue", "_q", "sendq", "recvq", "waiting", "inbox")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(EVENT_LOOP_PACKAGES)
+
+    def _is_lock_ctx(self, item: ast.withitem) -> bool:
+        try:
+            src = ast.unparse(item.context_expr)
+        except Exception:  # noqa: BLE001
+            return False
+        return "lock" in src.lower()
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncWith):
+                continue
+            lock_item = next(
+                (i for i in node.items if self._is_lock_ctx(i)), None
+            )
+            if lock_item is None:
+                continue
+            lock_src = ast.unparse(lock_item.context_expr)
+            for stmt in node.body:
+                if isinstance(
+                    stmt,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    # a def inside the with-block runs later, outside
+                    # the critical section
+                    continue
+                for sub in _walk_same_scope(stmt):
+                    if not isinstance(sub, ast.Await):
+                        continue
+                    call = sub.value
+                    if not isinstance(call, ast.Call):
+                        continue
+                    dotted = _dotted(call.func)
+                    attr = (
+                        call.func.attr
+                        if isinstance(call.func, ast.Attribute)
+                        else dotted
+                    )
+                    bad = attr in self.IO_ATTRS
+                    if not bad and attr in self.QUEUE_ATTRS:
+                        base = _base_source(call.func).lower()
+                        bad = any(t in base for t in self._QUEUEY)
+                    if bad:
+                        out.append(Violation(
+                            self.name, relpath, sub.lineno,
+                            f"await `{dotted or attr}` while holding "
+                            f"`{lock_src}`: I/O under a lock serializes the "
+                            "loop on a peer — copy out, release, then send",
+                        ))
+                # the body's own awaits are what's held under the lock;
+                # nested async-with lock blocks are walked on their own
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 3. jit-in-function
+# ---------------------------------------------------------------------------
+
+
+class JitInFunctionRule(Rule):
+    """``jax.jit`` / ``pjit`` constructed inside a function. PR 3 found a
+    per-admission ``jax.jit(sample_first_token)`` building a fresh
+    wrapper (and tracing a fresh program) for every request — module
+    scope amortizes trace+compile over the process. Memoized
+    construction (compile once per bucket key into a cache) is the one
+    sanctioned exception; suppress it with a justification."""
+
+    name = "jit-in-function"
+    summary = "jax.jit/pjit built at call time (PR 3 per-admission regression)"
+
+    JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "shard_map.jit"}
+
+    def applies_to(self, relpath: str) -> bool:
+        # serving code only: tests build throwaway jits legitimately
+        return relpath.startswith("dynamo_tpu/")
+
+    def _is_jit_call(self, call: ast.Call) -> bool:
+        dotted = _dotted(call.func)
+        if dotted in self.JIT_NAMES:
+            return True
+        # functools.partial(jax.jit, ...) — the decorator spelling
+        if dotted in ("functools.partial", "partial") and call.args:
+            return _dotted(call.args[0]) in self.JIT_NAMES
+        return False
+
+    def check(self, relpath, source, tree):
+        # decorators on module/class-level defs evaluate at import time —
+        # that IS module scope. Only calls inside function BODIES (and
+        # decorators of *nested* defs, which evaluate when the enclosing
+        # function runs) build wrappers at call time.
+        out: list[Violation] = []
+
+        def scan(node: ast.AST, fn_name: str) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and self._is_jit_call(sub):
+                    out.append(Violation(
+                        self.name, relpath, sub.lineno,
+                        f"`{_dotted(sub.func)}` constructed inside "
+                        f"`{fn_name}`: builds a fresh traced wrapper per "
+                        "call (PR 3 regression) — hoist to module scope, or "
+                        "suppress if memoized per static key",
+                    ))
+                elif isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    # a NESTED def's bare `@jax.jit` decorator evaluates
+                    # when the enclosing function runs (call decorators
+                    # are Calls, already caught by the walk above)
+                    for dec in sub.decorator_list:
+                        if not isinstance(dec, ast.Call) and _dotted(
+                            dec
+                        ) in self.JIT_NAMES:
+                            out.append(Violation(
+                                self.name, relpath, dec.lineno,
+                                f"`{_dotted(dec)}` decorates nested "
+                                f"`{sub.name}` inside `{fn_name}`: traces "
+                                "a fresh wrapper per call (PR 3 "
+                                "regression) — hoist to module scope, or "
+                                "suppress if memoized per static key",
+                            ))
+
+        def visit_module_scope(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # decorator_list/defaults run at def time (module
+                    # scope here) — skip them, scan only the body
+                    for stmt in child.body:
+                        scan(stmt, child.name)
+                elif isinstance(child, ast.ClassDef):
+                    visit_module_scope(child)  # methods: same treatment
+                else:
+                    # module-level statements (incl. decorators already
+                    # consumed above) are module scope by definition
+                    pass
+
+        visit_module_scope(tree)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 4. raw-header-subscript
+# ---------------------------------------------------------------------------
+
+
+class RawHeaderSubscriptRule(Rule):
+    """``header["key"]`` in a wire decoder. The codec's forward-compat
+    contract (runtime/codec.py module doc): decoders read the keys they
+    know and ignore the rest, via ``.get`` / ``header_field`` — a raw
+    subscript turns a newer peer's extra or missing field into a
+    ``KeyError`` mid-protocol (PR 2 and PR 6 both grew the header schema
+    in flight; old builds kept decoding because of this rule)."""
+
+    name = "raw-header-subscript"
+    summary = "intolerant header[key] read in a wire decoder (codec contract)"
+
+    _HEADER_NAMES = ("header", "hdr")
+    _HEADER_SOURCES = ("header_json", "header_field")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.endswith(DECODER_MODULES) or any(
+            relpath.endswith(m.rsplit("/", 1)[-1]) and m in relpath
+            for m in DECODER_MODULES
+        )
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # names assigned from header_json()/msg.header parses in this
+            # function also carry the contract
+            header_vars = set(self._HEADER_NAMES)
+
+            def _from_header(value: ast.expr) -> bool:
+                if isinstance(value, ast.Call):
+                    dotted = _dotted(value.func)
+                    return dotted.rsplit(".", 1)[-1] in self._HEADER_SOURCES
+                if isinstance(value, ast.BoolOp):
+                    # the `frame.header_json() or {}` idiom
+                    return any(_from_header(v) for v in value.values)
+                return False
+
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _from_header(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            header_vars.add(tgt.id)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Subscript):
+                    continue
+                if not isinstance(sub.slice, ast.Constant) or not isinstance(
+                    sub.slice.value, str
+                ):
+                    continue
+                if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    continue  # building a header dict is fine
+                base = sub.value
+                name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute) else ""
+                )
+                is_hdr = name in header_vars
+                if not is_hdr and isinstance(base, ast.Call):
+                    is_hdr = (
+                        _dotted(base.func).rsplit(".", 1)[-1]
+                        in self._HEADER_SOURCES
+                    )
+                if is_hdr:
+                    out.append(Violation(
+                        self.name, relpath, sub.lineno,
+                        f"`{ast.unparse(sub)}` destructures a wire header — "
+                        "use .get()/header_field() (forward-compat contract, "
+                        "runtime/codec.py)",
+                    ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 5. writer-wait-closed
+# ---------------------------------------------------------------------------
+
+
+class WriterWaitClosedRule(Rule):
+    """``writer.close()`` without ``await writer.wait_closed()`` in the
+    same function. PR 6 fixed fd leaks under churn in the KV transfer
+    server and the stream sender finallys: ``close()`` only *schedules*
+    transport teardown — without ``wait_closed()`` a busy loop accretes
+    half-closed sockets until the fd table blows. Applies to
+    stream-writer-shaped names (``writer``, ``_writer``, ``w``) and
+    asyncio servers (``_server``)."""
+
+    name = "writer-wait-closed"
+    summary = "close() without wait_closed() leaks fds under churn (PR 6 fix)"
+
+    _WRITERY = ("writer", "_server", "server")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(EVENT_LOOP_PACKAGES)
+
+    def _writer_targets(self, node) -> tuple[set[str], set[str]]:
+        closed: dict[str, int] = {}
+        waited: set[str] = set()
+        for sub in _walk_same_scope(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not isinstance(sub.func, ast.Attribute):
+                continue
+            base = _base_source(sub.func)
+            leaf = base.rsplit(".", 1)[-1].lower()
+            if not any(leaf == t or leaf.endswith(t) for t in self._WRITERY):
+                continue
+            if sub.func.attr == "close":
+                closed.setdefault(base, sub.lineno)
+            elif sub.func.attr in ("wait_closed", "abort"):
+                # abort() is the hard-teardown sibling: no graceful drain
+                # to wait for, the transport drops synchronously
+                waited.add(base)
+        return closed, waited
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            closed, waited = self._writer_targets(node)
+            for base, line in closed.items():
+                if base not in waited:
+                    out.append(Violation(
+                        self.name, relpath, line,
+                        f"`{base}.close()` without `await "
+                        f"{base}.wait_closed()` in `{node.name}`: close only "
+                        "schedules teardown — the fd lingers under churn "
+                        "(PR 6 transfer-server leak)",
+                    ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 6. faultpoint-test-coverage
+# ---------------------------------------------------------------------------
+
+
+class FaultpointCoverageRule(Rule):
+    """Every faultpoint declared in resilience/faultpoints.py must be
+    referenced by at least one test. A faultpoint nobody injects is a
+    lifecycle stage whose death path silently stopped being exercised —
+    the whole value of PR 4's deterministic harness is that worker loss
+    at each stage stays a reproducible test input."""
+
+    name = "faultpoint-test-coverage"
+    summary = "declared faultpoint never exercised by any test (PR 4 harness)"
+    project = True
+
+    FAULTPOINTS_FILE = "dynamo_tpu/resilience/faultpoints.py"
+
+    def check_project(self, files):
+        src = None
+        for path, text in files.items():
+            if path.endswith("resilience/faultpoints.py"):
+                src = (path, text)
+                break
+        if src is None:
+            return []
+        path, text = src
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            # the per-file pass already reported a syntax-error
+            # violation for this file; nothing to judge here
+            return []
+        points: list[tuple[str, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "POINTS"
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            points.append((elt.value, elt.lineno))
+        test_blob = "\n".join(
+            text for p, text in files.items()
+            if p.split("/")[-1].startswith("test_") or "/tests/" in p
+        )
+        if not test_blob:
+            return []  # tests not in the lint path set — nothing to judge
+        out = []
+        for name, line in points:
+            if name not in test_blob:
+                out.append(Violation(
+                    self.name, path, line,
+                    f"faultpoint `{name}` is declared but no test references "
+                    "it — its kill/delay path is unexercised (PR 4 contract)",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 7. swallowed-exception
+# ---------------------------------------------------------------------------
+
+
+class SwallowedExceptionRule(Rule):
+    """``except Exception: pass`` (or bare ``except:``) with no logging.
+    The scheduler and transfer loops are long-running: an invisible
+    swallow turns a protocol bug into a silent stall that only a soak
+    test's timeout finds (that is exactly how PR 4's parked-forever
+    requests hid). Log at debug or narrow the exception type."""
+
+    name = "swallowed-exception"
+    summary = "silent except-pass hides loop failures (PR 4 parked requests)"
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            typ = node.type
+            broad = (
+                typ is None
+                or (isinstance(typ, ast.Name)
+                    and typ.id in ("Exception", "BaseException"))
+            )
+            if not broad:
+                continue
+            body_real = [
+                s for s in node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))
+            ]
+            if all(
+                isinstance(s, ast.Pass)
+                or (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis)
+                for s in body_real
+            ):
+                out.append(Violation(
+                    self.name, relpath, node.lineno,
+                    "broad except with a silent pass — log at debug "
+                    "(logger.debug(..., exc_info=True)) or narrow the type; "
+                    "silent swallows in long-running loops become invisible "
+                    "stalls",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 8. span-leak
+# ---------------------------------------------------------------------------
+
+
+class SpanLeakRule(Rule):
+    """A trace span opened by hand (assigned, not ``with``) must be
+    ``.end()``-ed in the same function. PR 2's decomposition depends on
+    every opened span landing in the ring buffer — a leaked handle
+    records nothing, and the TTFT component it covered silently reads as
+    zero in /trace and the fleet percentiles."""
+
+    name = "span-leak"
+    summary = "span opened without with/end() drops its TTFT component (PR 2)"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(EVENT_LOOP_PACKAGES) or relpath.startswith(
+            "dynamo_tpu/llm/"
+        )
+
+    def _is_span_call(self, call: ast.Call) -> bool:
+        dotted = _dotted(call.func)
+        return dotted.rsplit(".", 1)[-1] == "span" and "span" != dotted
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            assigned: dict[str, int] = {}
+            ended: set[str] = set()
+            withed: set[str] = set()
+            for sub in _walk_same_scope(node):
+                if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.Call
+                ) and self._is_span_call(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigned.setdefault(tgt.id, sub.lineno)
+                elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        ctx = item.context_expr
+                        if isinstance(ctx, ast.Name):
+                            withed.add(ctx.id)
+                        elif isinstance(
+                            ctx, ast.Call
+                        ) and self._is_span_call(ctx):
+                            pass  # direct `with tracing.span(...)` — fine
+                elif isinstance(sub, ast.Expr) and isinstance(
+                    sub.value, ast.Call
+                ):
+                    call = sub.value
+                    if isinstance(call.func, ast.Attribute) and call.func.attr in (
+                        "end", "__exit__"
+                    ):
+                        base = call.func.value
+                        if isinstance(base, ast.Name):
+                            ended.add(base.id)
+                    elif self._is_span_call(call):
+                        out.append(Violation(
+                            self.name, relpath, sub.lineno,
+                            "span opened and immediately discarded — it will "
+                            "never be recorded; use `with tracing.span(...)` "
+                            "or keep the handle and .end() it",
+                        ))
+            for name, line in assigned.items():
+                if name not in ended and name not in withed:
+                    out.append(Violation(
+                        self.name, relpath, line,
+                        f"span handle `{name}` is never .end()-ed (or used "
+                        "as a context manager) in this function — the span "
+                        "drops and its TTFT component reads as zero (PR 2)",
+                    ))
+        return out
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    AsyncBlockingCallRule(),
+    AwaitInLockRule(),
+    JitInFunctionRule(),
+    RawHeaderSubscriptRule(),
+    WriterWaitClosedRule(),
+    FaultpointCoverageRule(),
+    SwallowedExceptionRule(),
+    SpanLeakRule(),
+)

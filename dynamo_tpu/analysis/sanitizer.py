@@ -1,0 +1,398 @@
+"""Asyncio hot-path sanitizer: the runtime half of dynlint.
+
+The static rules catch the patterns we know; this module catches the
+*behavior* — under the live test suite (and, via ``--sanitize``, in
+production workers):
+
+* **loop-stall detection with stack capture** — a heartbeat timer runs
+  on the loop; a watchdog thread measures heartbeat age and, the moment
+  it exceeds the threshold, snapshots the loop thread's Python stack
+  (``sys._current_frames``) so the report names the blocking frame, not
+  just "something took 1.3s". The loop side independently measures the
+  exact gap when the heartbeat finally runs, so no stall is missed even
+  if the watchdog samples unluckily. This generalizes the old conftest
+  debug-mode stall guard (``DYN_LOOP_STALL_S``) without asyncio debug
+  overhead.
+* **per-lock hold-time histograms** — ``asyncio.Lock`` acquire/release
+  are instrumented while active; holds are bucketed per acquire site
+  (or per :func:`name_lock` label), so "the device lock was held >100ms
+  N times" is a number, not a hunch.
+* **leak detection at loop shutdown** — stream writers created while
+  active that were never closed (the PR 6 fd-leak class), and tasks
+  still pending when the loop winds down.
+
+Zero global state is mutated while inactive; activation monkeypatches
+are restored on deactivate. Counters aggregate process-wide in
+:data:`COUNTERS` so the engine's ``load_metrics`` can export them (the
+metrics component turns them into gauges — production stalls are
+observable, not just test-time).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+import traceback
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = [
+    "LoopSanitizer",
+    "SanitizerError",
+    "SanitizerReport",
+    "run_sanitized",
+    "name_lock",
+    "counters",
+    "reset_counters",
+]
+
+#: hold/stall histogram bucket upper bounds (seconds)
+BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, float("inf"))
+
+
+class SanitizerError(AssertionError):
+    """Raised by :func:`run_sanitized` in strict mode on violations."""
+
+
+def name_lock(lock: asyncio.Lock, name: str) -> asyncio.Lock:
+    """Label a lock so its hold-time histogram is keyed by ``name``
+    instead of the acquire site (engine.py names ``_device_lock``)."""
+    lock._dyn_san_name = name  # type: ignore[attr-defined]
+    return lock
+
+
+@dataclass
+class StallRecord:
+    duration_s: float
+    stack: str = ""  # loop-thread stack captured DURING the stall ("" if missed)
+
+    def to_dict(self) -> dict:
+        return {"duration_s": round(self.duration_s, 4), "stack": self.stack}
+
+
+@dataclass
+class Histogram:
+    counts: list = field(default_factory=lambda: [0] * len(BUCKETS))
+    total: int = 0
+    sum_s: float = 0.0
+    max_s: float = 0.0
+
+    def observe(self, v: float) -> None:
+        self.total += 1
+        self.sum_s += v
+        if v > self.max_s:
+            self.max_s = v
+        for i, ub in enumerate(BUCKETS):
+            if v <= ub:
+                self.counts[i] += 1
+                break
+
+    def to_dict(self) -> dict:
+        return {
+            "total": self.total,
+            "sum_s": round(self.sum_s, 6),
+            "max_s": round(self.max_s, 6),
+            "buckets": dict(zip([str(b) for b in BUCKETS], self.counts)),
+        }
+
+
+@dataclass
+class SanitizerReport:
+    stalls: list = field(default_factory=list)
+    lock_holds: dict = field(default_factory=dict)  # site/name -> Histogram
+    leaked_writers: list = field(default_factory=list)  # creation sites
+    pending_tasks: list = field(default_factory=list)  # repr strings
+
+    @property
+    def max_stall_s(self) -> float:
+        return max((s.duration_s for s in self.stalls), default=0.0)
+
+    def to_dict(self) -> dict:
+        return {
+            "stalls": [s.to_dict() for s in self.stalls],
+            "lock_holds": {k: h.to_dict() for k, h in self.lock_holds.items()},
+            "leaked_writers": list(self.leaked_writers),
+            "pending_tasks": list(self.pending_tasks),
+        }
+
+
+#: process-wide counters (survive individual sanitizer runs) — exported
+#: by engine.load_metrics -> WorkerLoad -> metrics-component gauges
+COUNTERS = {
+    "san_loop_stalls": 0,
+    "san_loop_stall_max_ms": 0.0,
+    "san_lock_holds": 0,
+    "san_lock_hold_max_ms": 0.0,
+    "san_writers_leaked": 0,
+}
+
+
+def counters() -> dict:
+    """Snapshot of the process-wide sanitizer counters (load_metrics)."""
+    return dict(COUNTERS)
+
+
+def reset_counters() -> None:
+    for k in COUNTERS:
+        COUNTERS[k] = 0.0 if k.endswith("_ms") else 0
+
+
+def _caller_site(skip_prefixes=("asyncio", "analysis/sanitizer")) -> str:
+    """filename:lineno of the nearest frame outside asyncio/this module."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if not any(p in fn for p in skip_prefixes):
+            short = "/".join(fn.rsplit("/", 3)[1:])
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class LoopSanitizer:
+    """One activation per event loop. See the module doc for what it
+    watches. ``activate`` must run ON the target loop; ``deactivate``
+    runs after the loop work is done (still inside the loop for pending-
+    task inspection, or just after ``asyncio.run`` returns for writer
+    leak accounting — :func:`run_sanitized` sequences this correctly)."""
+
+    def __init__(
+        self,
+        stall_threshold_s: float = 1.0,
+        capture_stacks: bool = True,
+    ):
+        self.stall_threshold_s = stall_threshold_s
+        self.capture_stacks = capture_stacks
+        self.report = SanitizerReport()
+        self._active = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread_id: Optional[int] = None
+        self._last_beat = 0.0
+        self._beat_handle: Optional[asyncio.TimerHandle] = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop_watchdog = threading.Event()
+        self._stall_stack: Optional[str] = None  # captured mid-stall
+        self._orig_acquire = None
+        self._orig_release = None
+        self._orig_writer_init = None
+        self._orig_writer_close = None
+        self._holds: dict[int, tuple[float, str]] = {}
+        #: id(writer) -> creation site, pruned on close/wait_closed
+        self._writers: dict[int, str] = {}
+        self._writer_refs: dict[int, Any] = {}
+
+    # ---------------- lifecycle ----------------
+
+    def activate(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> "LoopSanitizer":
+        if self._active:
+            return self
+        self._active = True
+        self._loop = loop or asyncio.get_running_loop()
+        self._loop_thread_id = threading.get_ident()
+        self._patch_locks()
+        self._patch_writers()
+        if self.stall_threshold_s > 0:
+            self._last_beat = time.monotonic()
+            self._schedule_beat()
+            if self.capture_stacks:
+                self._stop_watchdog.clear()
+                self._watchdog = threading.Thread(
+                    target=self._watch, name="dyn-san-watchdog", daemon=True
+                )
+                self._watchdog.start()
+        return self
+
+    def before_shutdown(self) -> None:
+        """Call on the loop, after the workload, before the loop closes:
+        snapshots tasks still pending (other than the caller's)."""
+        try:
+            current = asyncio.current_task()
+            for t in asyncio.all_tasks():
+                if t is current or t.done():
+                    continue
+                self.report.pending_tasks.append(repr(t))
+        except RuntimeError:  # not on a loop — nothing to inspect
+            pass
+
+    def deactivate(self) -> SanitizerReport:
+        if not self._active:
+            return self.report
+        self._active = False
+        if self._beat_handle is not None:
+            self._beat_handle.cancel()
+            self._beat_handle = None
+        self._stop_watchdog.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=1.0)
+            self._watchdog = None
+        self._unpatch_locks()
+        self._unpatch_writers()
+        # writers never closed = leaked (half-closed transports keep fds).
+        # _writer_refs holds weakrefs: a ref that resolves to None was
+        # GC'd without close() — still a leak (the fd lived until the
+        # collector ran), but the tracking itself must not pin memory
+        for wid, site in self._writers.items():
+            ref = self._writer_refs.get(wid)
+            w = ref() if ref is not None else None
+            transport = getattr(w, "transport", None) if w is not None else None
+            if transport is not None and transport.is_closing():
+                continue  # peer-initiated teardown in flight — not a leak
+            self.report.leaked_writers.append(site)
+            COUNTERS["san_writers_leaked"] += 1
+        self._writers.clear()
+        self._writer_refs.clear()
+        self._loop = None
+        return self.report
+
+    # ---------------- loop-stall detection ----------------
+
+    def _schedule_beat(self) -> None:
+        # fine-grained: the beat itself measures the true gap, the
+        # interval only bounds watchdog detection latency
+        interval = max(min(self.stall_threshold_s / 4.0, 0.05), 0.01)
+        self._beat_handle = self._loop.call_later(interval, self._beat)
+
+    def _beat(self) -> None:
+        now = time.monotonic()
+        gap = now - self._last_beat
+        self._last_beat = now
+        if gap > self.stall_threshold_s:
+            # the loop just came back from a stall at least this long;
+            # attach the stack the watchdog grabbed while it was stuck
+            stack = self._stall_stack or ""
+            self._stall_stack = None
+            self.report.stalls.append(StallRecord(gap, stack))
+            COUNTERS["san_loop_stalls"] += 1
+            COUNTERS["san_loop_stall_max_ms"] = max(
+                COUNTERS["san_loop_stall_max_ms"], gap * 1e3
+            )
+        if self._active:
+            self._schedule_beat()
+
+    def _watch(self) -> None:
+        interval = max(min(self.stall_threshold_s / 4.0, 0.05), 0.01)
+        while not self._stop_watchdog.wait(interval):
+            age = time.monotonic() - self._last_beat
+            if age > self.stall_threshold_s and self._stall_stack is None:
+                frame = sys._current_frames().get(self._loop_thread_id)
+                if frame is not None:
+                    self._stall_stack = "".join(
+                        traceback.format_stack(frame, limit=25)
+                    )
+
+    # ---------------- lock hold histograms ----------------
+
+    def _patch_locks(self) -> None:
+        san = self
+        self._orig_acquire = asyncio.Lock.acquire
+        self._orig_release = asyncio.Lock.release
+        orig_acquire, orig_release = self._orig_acquire, self._orig_release
+
+        async def acquire(lock):  # noqa: ANN001
+            result = await orig_acquire(lock)
+            key = getattr(lock, "_dyn_san_name", None) or _caller_site()
+            san._holds[id(lock)] = (time.monotonic(), key)
+            return result
+
+        def release(lock):  # noqa: ANN001
+            entry = san._holds.pop(id(lock), None)
+            if entry is not None:
+                t0, key = entry
+                dt = time.monotonic() - t0
+                hist = san.report.lock_holds.setdefault(key, Histogram())
+                hist.observe(dt)
+                COUNTERS["san_lock_holds"] += 1
+                COUNTERS["san_lock_hold_max_ms"] = max(
+                    COUNTERS["san_lock_hold_max_ms"], dt * 1e3
+                )
+            return orig_release(lock)
+
+        asyncio.Lock.acquire = acquire
+        asyncio.Lock.release = release
+
+    def _unpatch_locks(self) -> None:
+        if self._orig_acquire is not None:
+            asyncio.Lock.acquire = self._orig_acquire
+            asyncio.Lock.release = self._orig_release
+            self._orig_acquire = self._orig_release = None
+        self._holds.clear()
+
+    # ---------------- writer leak tracking ----------------
+
+    def _patch_writers(self) -> None:
+        san = self
+        StreamWriter = asyncio.streams.StreamWriter
+        self._orig_writer_init = StreamWriter.__init__
+        self._orig_writer_close = StreamWriter.close
+        orig_init, orig_close = self._orig_writer_init, self._orig_writer_close
+
+        def __init__(w, *args, **kwargs):  # noqa: ANN001,N807
+            orig_init(w, *args, **kwargs)
+            san._writers[id(w)] = _caller_site()
+            # weakref only: a long-lived production sanitizer
+            # (dynamo_run --sanitize) must never pin dropped writers —
+            # the leak DETECTOR must not itself leak the transports
+            san._writer_refs[id(w)] = weakref.ref(w)
+
+        def close(w):  # noqa: ANN001
+            san._writers.pop(id(w), None)
+            san._writer_refs.pop(id(w), None)
+            return orig_close(w)
+
+        StreamWriter.__init__ = __init__
+        StreamWriter.close = close
+
+    def _unpatch_writers(self) -> None:
+        if self._orig_writer_init is not None:
+            asyncio.streams.StreamWriter.__init__ = self._orig_writer_init
+            asyncio.streams.StreamWriter.close = self._orig_writer_close
+            self._orig_writer_init = self._orig_writer_close = None
+
+
+def run_sanitized(
+    coro,
+    stall_s: float = 1.0,
+    strict_stalls: bool = False,
+    strict_writers: bool = False,
+    capture_stacks: bool = True,
+) -> Any:
+    """``asyncio.run`` with the sanitizer active around ``coro``.
+
+    Raises :class:`SanitizerError` after the workload completes if a
+    strict mode saw violations; the coroutine's own result/exception is
+    otherwise passed through. ``stall_s <= 0`` disables stall tracking
+    (lock/writer accounting stays on — it is nearly free)."""
+    san = LoopSanitizer(stall_threshold_s=stall_s, capture_stacks=capture_stacks)
+
+    async def _main():
+        san.activate(asyncio.get_running_loop())
+        try:
+            return await coro
+        finally:
+            san.before_shutdown()
+
+    try:
+        result = asyncio.run(_main())
+    finally:
+        report = san.deactivate()
+    problems: list[str] = []
+    if strict_stalls and report.stalls:
+        worst = max(report.stalls, key=lambda s: s.duration_s)
+        problems.append(
+            f"{len(report.stalls)} event-loop stall(s) beyond {stall_s}s "
+            f"(worst {worst.duration_s:.2f}s) — scheduler/offload work "
+            "blocked the loop (PR-1 async invariant)"
+            + (f"\nstack during stall:\n{worst.stack}" if worst.stack else "")
+        )
+    if strict_writers and report.leaked_writers:
+        problems.append(
+            f"{len(report.leaked_writers)} stream writer(s) never closed "
+            "(fd leak under churn — PR 6 invariant); created at:\n  "
+            + "\n  ".join(report.leaked_writers)
+        )
+    if problems:
+        raise SanitizerError("\n".join(problems))
+    return result

@@ -196,8 +196,8 @@ class DeploymentController:
             for _key, proc, _d in self._terminating:
                 try:
                     proc.kill()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — already reaped/dead
+                    logger.debug("kill on exit failed", exc_info=True)
             self._terminating = []
 
     async def _loop(self) -> None:
@@ -422,8 +422,8 @@ class DeploymentController:
         self.stats["kills"] += 1
         try:
             rep.proc.terminate()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — already exited on its own
+            logger.debug("terminate failed", exc_info=True)
         self._terminating.append(
             (key, rep.proc, time.monotonic() + self.kill_grace)
         )
@@ -442,8 +442,8 @@ class DeploymentController:
                 logger.warning("child ignored SIGTERM; killing")
                 try:
                     proc.kill()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — already exited
+                    logger.debug("sigkill failed", exc_info=True)
                 # keep it one more round so the SIGKILL gets reaped too
                 still.append((key, proc, deadline + self.kill_grace))
             else:

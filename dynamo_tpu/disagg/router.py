@@ -45,7 +45,7 @@ class ConditionalDisaggRouter:
             if asyncio.iscoroutine(created):
                 await created
         except Exception:  # noqa: BLE001 — already exists: adopt stored value
-            pass
+            logger.debug("disagg config kv_create raced", exc_info=True)
         entry = self.drt.store.kv_get(self.key)
         if asyncio.iscoroutine(entry):
             entry = await entry

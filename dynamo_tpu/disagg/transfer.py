@@ -415,7 +415,15 @@ class KvTransferServer:
                     # nobody is waiting: consume frames to reach fin/ack
                     # without paying the decode copies
                     continue
-                b0, ns, l0, l1 = h["b0"], h["n"], h["l0"], h["l1"]
+                # tolerant read + explicit validation: a peer whose frame
+                # schema drifted must surface as a clean protocol error
+                # (-> no-ack redelivery), not a KeyError mid-decode
+                b0, ns, l0, l1 = (h.get("b0"), h.get("n"),
+                                  h.get("l0"), h.get("l1"))
+                if None in (b0, ns, l0, l1):
+                    raise ConnectionError(
+                        f"kv stream frame missing segment geometry: {h}"
+                    )
                 if b0 != seg_b0:
                     if seg_k is not None and seg_filled != L:
                         raise ConnectionError("kv stream segment interleaved")

@@ -343,7 +343,12 @@ class PrefillWorker:
         async def on_segment(b0: int, k_seg, v_seg) -> None:
             await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
             if not local:
-                k_seg, v_seg = np.asarray(k_seg), np.asarray(v_seg)
+                # segment-sized (multi-MB) device->host materialization:
+                # off the loop, or the whole engine freezes for the copy
+                # while prefill compute should be hiding it
+                k_seg, v_seg = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: (np.asarray(k_seg), np.asarray(v_seg))
+                )
             await put_or_fail((b0, k_seg, v_seg))
 
         ok = False

@@ -43,6 +43,7 @@ from ..protocols.common import (
     SamplingOptions,
     StopConditions,
 )
+from ..analysis import sanitizer
 from ..resilience import faultpoints
 from ..resilience.faultpoints import FaultInjected
 from ..resilience.policy import MIGRATION_SIGNAL
@@ -380,8 +381,10 @@ class JaxEngine(AsyncEngine):
         self._loop_task: Optional[asyncio.Task] = None
         # serializes device-state mutation (k/v cache is donated through
         # every jit call — concurrent dispatch would use freed buffers);
-        # contended only when disagg hooks run beside the decode loop
-        self._device_lock = asyncio.Lock()
+        # contended only when disagg hooks run beside the decode loop.
+        # Named for the runtime sanitizer: when active, its hold times
+        # histogram under "device_lock" instead of an acquire site.
+        self._device_lock = sanitizer.name_lock(asyncio.Lock(), "device_lock")
         # pipelined decode: the not-yet-drained window's device tokens
         self._inflight: Optional[dict] = None
         self._wake = asyncio.Event()
@@ -597,6 +600,11 @@ class JaxEngine(AsyncEngine):
         out = {}
         if self.offload is not None:
             out.update(self.offload.stats())
+        # runtime-sanitizer counters (analysis/sanitizer.py): zeros when
+        # no sanitizer has ever been active in this process; under
+        # --sanitize (or the test suite) they surface loop stalls and
+        # worst lock holds through the scrape -> metrics-gauge plane
+        out.update(sanitizer.counters())
         return out | {
             # mixed-batch fusion activity (prefill chunks riding decode
             # steps) — lets the router/metrics plane see whether decode
@@ -1751,6 +1759,7 @@ class JaxEngine(AsyncEngine):
             tokens_in = prev["toks"]
         else:
             tokens_in = prev["toks"][-1]
+        # dynlint: disable=async-blocking-call -- [B]-sized host int list, no device copy
         steps = np.asarray(
             [(self._active[i].generated if self._active[i] else 0) + pending
              for i in range(cfg.max_batch_size)],
@@ -1835,6 +1844,7 @@ class JaxEngine(AsyncEngine):
         window = np.zeros((cfg.max_batch_size, T), np.int32)
         window[:, 0] = self._last_tokens
         window[:, 1:] = np.maximum(proposals, 0)
+        # dynlint: disable=async-blocking-call -- [B]-sized host int list, no device copy
         steps = np.asarray(
             [self._active[i].generated if self._active[i] else 0
              for i in range(cfg.max_batch_size)],
@@ -1915,6 +1925,7 @@ class JaxEngine(AsyncEngine):
                     break
         if self._n_active == 0:
             return  # next iteration advances the prefill alone
+        # dynlint: disable=async-blocking-call -- [B]-sized host int list, no device copy
         steps = np.asarray(
             [self._active[i].generated if self._active[i] else 0
              for i in range(cfg.max_batch_size)],
@@ -2201,10 +2212,19 @@ class JaxEngine(AsyncEngine):
                 # multi-process replicated array: read the local shard
                 # (device_get would wait on a collective followers never
                 # join)
-                return np.asarray(t.addressable_data(0))
-            return np.asarray(jax.device_get(t))
+                toks = np.asarray(t.addressable_data(0))
+            else:
+                toks = np.asarray(jax.device_get(t))
+            lp = window.get("lps")
+            if lp is not None:
+                # local shards: complete for replicated outputs, and the
+                # only safe fetch on multi-process arrays (device_get
+                # would wait on a cross-process collective the followers
+                # never join)
+                lp = tuple(np.asarray(a.addressable_data(0)) for a in lp)
+            return toks, lp
 
-        toks_host = await asyncio.get_running_loop().run_in_executor(
+        toks_host, lps = await asyncio.get_running_loop().run_in_executor(
             None, materialize
         )
         n = window["n"]
@@ -2217,12 +2237,6 @@ class JaxEngine(AsyncEngine):
             (i, seq) for i, seq in window["slots"].items()
             if self._active[i] is seq and not seq.finished
         ]
-        lps = window.get("lps")
-        if lps is not None:
-            # local shards: complete for replicated outputs, and the only
-            # safe fetch on multi-process arrays (device_get would wait on
-            # a cross-process collective the followers never join)
-            lps = tuple(np.asarray(a.addressable_data(0)) for a in lps)
         for step_i in range(n):
             for i, seq in live:
                 if seq.finished:

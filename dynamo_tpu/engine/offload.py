@@ -343,7 +343,8 @@ class OffloadManager:
             try:
                 t.future.result(max(0.0, deadline - time.monotonic()))
             except Exception:  # noqa: BLE001 — timeout/failure = cache miss
-                pass
+                logger.debug("flush join missed (treated as cache miss)",
+                             exc_info=True)
         with self._lock:
             self._reap_flushes_locked()
 

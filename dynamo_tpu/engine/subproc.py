@@ -73,7 +73,7 @@ class SubprocessEngine(AsyncEngine):
                 finally:
                     # close the transport so the server's connection count
                     # drops — wait_closed() blocks on lingering transports
-                    writer.close()
+                    writer.close()  # dynlint: disable=writer-wait-closed -- deliberate: wait_closed() wedges on the lingering child transport
 
             self._server = await asyncio.start_unix_server(on_connect, path=sock_path)
             self._proc = await asyncio.create_subprocess_exec(
@@ -104,7 +104,9 @@ class SubprocessEngine(AsyncEngine):
             self._started = False
             self._connected = asyncio.Event()
             if self._server is not None:
-                self._server.close()
+                # respawn path: close() alone releases the listener fd;
+                # wait_closed() can wedge on the dead child's transport
+                self._server.close()  # dynlint: disable=writer-wait-closed -- respawn path, see comment
                 self._server = None
             if self._sock_dir is not None:
                 self._sock_dir.cleanup()
@@ -137,7 +139,9 @@ class SubprocessEngine(AsyncEngine):
         async with self._lock:
             if self._writer is None:
                 raise RuntimeError("engine subprocess not running")
-            await write_frame(self._writer, TwoPartMessage.from_json(head, data))
+            # frame-serialization lock: held across the write by design
+            # so frames never interleave on the pipe
+            await write_frame(self._writer, TwoPartMessage.from_json(head, data))  # dynlint: disable=await-in-lock -- frame-serialization lock, guards only this stream
 
     async def close(self) -> None:
         self._closing = True
@@ -203,7 +207,8 @@ async def _child_main(spec: str, sock_path: str) -> None:
 
     async def send(head: dict, data: bytes = b"") -> None:
         async with wlock:
-            await write_frame(writer, TwoPartMessage.from_json(head, data))
+            # frame-serialization lock: held across the write by design
+            await write_frame(writer, TwoPartMessage.from_json(head, data))  # dynlint: disable=await-in-lock -- frame-serialization lock, guards only this stream
 
     class _ChildContext:
         """Minimal AsyncEngineContext for the child side."""

@@ -118,8 +118,9 @@ class HttpServerBase:
         finally:
             try:
                 writer.close()
-            except Exception:
-                pass
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001 — peer may already be gone
+                logger.debug("http connection teardown failed", exc_info=True)
 
     async def _read_request(self, reader: asyncio.StreamReader):
         try:

@@ -9,8 +9,11 @@ prometheus client dependency needed.
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import defaultdict
+
+logger = logging.getLogger(__name__)
 
 _BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
 
@@ -114,7 +117,8 @@ class Metrics:
                 for k, v in sorted(src().items()):
                     lines.append(f"{p}_{k} {v}")
             except Exception:  # noqa: BLE001 — a bad source must not
-                pass  # break the whole exposition
+                # break the whole exposition
+                logger.debug("metrics source failed", exc_info=True)
         return "\n".join(lines) + "\n"
 
 

@@ -127,11 +127,35 @@ class KvMetricsAggregator:
         # still drop immediately.
         self._known: dict[int, WorkerLoad] = {}
         self._task: Optional[asyncio.Task] = None
+        #: completed-scrape signal: counter + waiter futures resolved at
+        #: the end of every _collect_once. Lets tests (and watchers)
+        #: synchronize on SCRAPES OBSERVED rather than wall time — on a
+        #: starved box the scrape loop stretches, and a fixed-duration
+        #: poll times out while the aggregator has simply not run yet
+        #: (the test_kv_routed_serving flake, CHANGES.md PR 5).
+        self.scrapes_total = 0
+        self._scrape_waiters: list[asyncio.Future] = []
 
     async def start(self) -> "KvMetricsAggregator":
         await self._collect_once()
         self._task = self.drt.runtime.spawn(self._loop())
         return self
+
+    async def next_scrape(self, timeout: Optional[float] = None) -> int:
+        """Resolve after the NEXT completed scrape (an event, not a
+        timer); returns the new ``scrapes_total``. With ``timeout``,
+        falls through after that many seconds even if no scrape landed —
+        callers decide whether a starved loop is an error."""
+        fut = asyncio.get_running_loop().create_future()
+        self._scrape_waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            if fut in self._scrape_waiters:
+                self._scrape_waiters.remove(fut)
+        return self.scrapes_total
 
     async def _loop(self) -> None:
         while True:
@@ -179,6 +203,10 @@ class KvMetricsAggregator:
                     requests_total=d.get("requests_total", 0),
                     tokens_generated=d.get("tokens_generated", 0),
                     prompt_tokens_total=d.get("prompt_tokens_total", 0),
+                    loop_stalls=d.get("san_loop_stalls", 0),
+                    loop_stall_max_ms=d.get("san_loop_stall_max_ms", 0.0),
+                    lock_hold_max_ms=d.get("san_lock_hold_max_ms", 0.0),
+                    writers_leaked=d.get("san_writers_leaked", 0),
                     # stamped at scrape time: the scheduler ages these
                     # out (load_ttl_s) instead of trusting a dead
                     # worker's last report forever
@@ -187,3 +215,8 @@ class KvMetricsAggregator:
             )
         self._known = merged
         self.endpoints = ProcessedEndpoints(list(merged.values()))
+        self.scrapes_total += 1
+        waiters, self._scrape_waiters = self._scrape_waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(self.scrapes_total)

@@ -73,6 +73,14 @@ class WorkerLoad:
     requests_total: int = 0
     tokens_generated: int = 0
     prompt_tokens_total: int = 0
+    # runtime-sanitizer surface (dynamo_tpu.analysis.sanitizer, exported
+    # through engine.load_metrics when a sanitizer is active): event-loop
+    # stalls and worst lock holds observed on THIS worker — production
+    # stalls become fleet gauges instead of test-time-only signals
+    loop_stalls: int = 0
+    loop_stall_max_ms: float = 0.0
+    lock_hold_max_ms: float = 0.0
+    writers_leaked: int = 0
     # monotonic stamp set at scrape time (None = constructed directly /
     # legacy producer): the scheduler discards loads older than
     # ``SchedulerConfig.load_ttl_s`` instead of trusting a dead

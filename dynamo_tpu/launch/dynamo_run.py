@@ -960,6 +960,13 @@ def main(argv=None) -> None:
                         "across frontend/router/workers, /trace/{id} "
                         "timelines + per-request TTFT decomposition "
                         "(also: DYN_TRACE=1)")
+    p.add_argument("--sanitize", action="store_true",
+                   default=os.environ.get("DYN_SANITIZE", "") not in ("", "0"),
+                   help="run the role under the asyncio hot-path sanitizer "
+                        "in record mode (analysis/sanitizer.py): loop-stall "
+                        "and lock-hold counters flow into load_metrics -> "
+                        "fleet gauges (also: DYN_SANITIZE=1; threshold "
+                        "DYN_LOOP_STALL_S, default 1.0s)")
     args = p.parse_args(argv)
 
     # escape hatch for tests/ops: force the JAX platform before any device
@@ -1003,6 +1010,26 @@ def main(argv=None) -> None:
         coro = run_endpoint(args)
     else:
         raise SystemExit(f"unknown in= mode {args.in_!r}")
+    if args.sanitize:
+        # record mode: never fails the process — it feeds the san_*
+        # counters that load_metrics exports and the metrics component
+        # turns into per-worker gauges (docs/static_analysis.md)
+        from ..analysis.sanitizer import LoopSanitizer
+
+        async def _sanitized(inner):
+            san = LoopSanitizer(
+                stall_threshold_s=float(
+                    os.environ.get("DYN_LOOP_STALL_S", "1.0")
+                ),
+            )
+            san.activate()
+            try:
+                return await inner
+            finally:
+                san.before_shutdown()
+                san.deactivate()
+
+        coro = _sanitized(coro)
     try:
         asyncio.run(coro)
     except KeyboardInterrupt:

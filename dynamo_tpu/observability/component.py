@@ -187,6 +187,13 @@ class MetricsComponent:
             gauge("requests_served_total", w.requests_total, lb)
             gauge("tokens_generated_total", w.tokens_generated, lb)
             gauge("prompt_tokens_total", w.prompt_tokens_total, lb)
+            # runtime-sanitizer plane (docs/static_analysis.md): loop
+            # stalls + worst lock hold on the worker — a production
+            # stall shows up here, not just in a failing test
+            gauge("loop_stalls_total", w.loop_stalls, lb)
+            gauge("loop_stall_max_ms", round(w.loop_stall_max_ms, 3), lb)
+            gauge("lock_hold_max_ms", round(w.lock_hold_max_ms, 3), lb)
+            gauge("writers_leaked_total", w.writers_leaked, lb)
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))
@@ -270,6 +277,10 @@ class MetricsComponent:
             logger.exception("metrics request failed")
         finally:
             writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass  # scraper already gone — the fd is released either way
 
 
 class MockWorker:

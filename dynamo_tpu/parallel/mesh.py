@@ -228,13 +228,35 @@ def param_sharding(mesh: Mesh) -> dict:
     return build
 
 
+def put_global(x, sharding: NamedSharding):
+    """``device_put`` onto a (possibly multi-process) sharding WITHOUT
+    the hidden collective newer jax runs: ``device_put(host_value,
+    non-addressable-sharding)`` broadcasts a cross-process
+    ``assert_equal`` of the whole value, which both costs a collective
+    per placement and — worse — deadlocks/crosses streams in lockstep
+    protocols whose ranks place arrays at independent moments (the
+    multihost mirror). The mirror protocol already guarantees identical
+    host values on every rank, so build the global array from this
+    process's addressable shards directly."""
+    import numpy as np
+
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    shards = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        x.shape, sharding, shards
+    )
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per the placement rules."""
 
     def walk(leafs, specs):
         if isinstance(leafs, dict):
             return {k: walk(v, specs[k]) for k, v in leafs.items()}
-        return jax.device_put(leafs, NamedSharding(mesh, specs))
+        return put_global(leafs, NamedSharding(mesh, specs))
 
     return walk(params, spec_tree(params, mesh=mesh))
 

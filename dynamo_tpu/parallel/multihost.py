@@ -97,7 +97,22 @@ def initialize(cfg: MultiHostConfig) -> None:
         return
     if cfg.coordinator is None:
         raise ValueError("--coordinator host:port is required with --num-nodes > 1")
+    import os
+
     import jax
+
+    plat = (os.environ.get("JAX_PLATFORMS") or "").lower()
+    if "cpu" in plat:
+        # newer jax (>=0.4.34-era) refuses multiprocess computations on
+        # the CPU backend unless a cross-process collectives impl is
+        # chosen explicitly; gloo is the one shipped in jaxlib. Must be
+        # set BEFORE backend creation. Older versions lack the option
+        # (and allowed multiprocess CPU without it) — ignore there.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — unknown config on old jax
+            logger.debug("no jax_cpu_collectives_implementation option",
+                         exc_info=True)
 
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator,
@@ -158,10 +173,13 @@ class StepMirror:
     # ---- array placement ----
 
     def to_global(self, host_array: np.ndarray):
-        """Replicated global array from an identical-everywhere host value."""
-        import jax
+        """Replicated global array from an identical-everywhere host
+        value (collective-free placement — see mesh.put_global; the
+        mirror protocol itself guarantees the identical-everywhere
+        part, so no cross-process assert is needed or wanted)."""
+        from .mesh import put_global
 
-        return jax.device_put(np.asarray(host_array), self._rep)
+        return put_global(np.asarray(host_array), self._rep)
 
     def to_global_cached(self, key: str, host_array: np.ndarray):
         """to_global through a per-key content cache: unchanged bytes
@@ -201,7 +219,7 @@ class StepMirror:
         cfg = self.model_cfg
         ks, vs = llama.kv_cache_shapes(cfg, num_blocks, block_size)
         dt = dtype or llama._dtype(cfg)
-        make = jax.jit(
+        make = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
             lambda: (jnp.zeros(ks, dt), jnp.zeros(vs, dt)),
             out_shardings=(self._cache_sh, self._cache_sh),
         )
@@ -255,7 +273,7 @@ class StepMirror:
                         prompt_mask=prompt_mask,
                     )
 
-                self._fns[key] = jax.jit(
+                self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                     step, donate_argnums=(13, 14, 15), out_shardings=out_sh
                 )
             else:
@@ -270,7 +288,7 @@ class StepMirror:
                         with_logprobs=with_logprobs,
                     )
 
-                self._fns[key] = jax.jit(
+                self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                     step, donate_argnums=(10, 11), out_shardings=out_sh
                 )
         return self._fns[key]
@@ -291,7 +309,7 @@ class StepMirror:
                     use_pallas=use_pallas, mesh=mesh, use_ring=use_ring,
                 )
 
-            self._fns[key] = jax.jit(
+            self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                 step,
                 donate_argnums=(5, 6),
                 out_shardings=(self._rep, self._cache_sh, self._cache_sh),
@@ -334,7 +352,7 @@ class StepMirror:
                         with_logprobs=with_logprobs,
                     )
 
-                self._fns[key] = jax.jit(
+                self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                     step, donate_argnums=(14, 15, 16), out_shardings=out_sh
                 )
             else:
@@ -350,7 +368,7 @@ class StepMirror:
                         with_logprobs=with_logprobs,
                     )
 
-                self._fns[key] = jax.jit(
+                self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                     step, donate_argnums=(11, 12), out_shardings=out_sh
                 )
         return self._fns[key]
@@ -406,7 +424,7 @@ class StepMirror:
                     freq, pres, rep, prompt_ids, gen_ids,
                 )
 
-            self._fns["sample1"] = jax.jit(step, out_shardings=self._rep)
+            self._fns["sample1"] = jax.jit(step, out_shardings=self._rep)  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
         return self._fns["sample1"]
 
     # ---- KV block movement (offload tier + disagg transfer) ----
@@ -423,7 +441,7 @@ class StepMirror:
             from ..engine.offload import gather_blocks_core
 
             out = self._rep if replicated_out else self._stack_sh
-            self._fns[key] = jax.jit(
+            self._fns[key] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                 gather_blocks_core, out_shardings=(out, out)
             )
         return self._fns[key]
@@ -438,7 +456,7 @@ class StepMirror:
 
             from ..engine.offload import scatter_blocks_core
 
-            self._fns["kv_scatter"] = jax.jit(
+            self._fns["kv_scatter"] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                 scatter_blocks_core,
                 donate_argnums=(0, 1),
                 out_shardings=(self._cache_sh, self._cache_sh),
@@ -585,15 +603,26 @@ class StepMirror:
                 )
             buf[:4] = np.frombuffer(struct.pack("<I", len(payload)), np.uint8)
             buf[4 : 4 + len(payload)] = np.frombuffer(payload, np.uint8)
-        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        # newer jax broadcasts through a psum whose type promotion can
+        # return the uint8 frame as uint32 (values intact, one byte per
+        # element) — cast back before reinterpreting as wire bytes
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(
+            buf.dtype, copy=False
+        )
         (ln,) = struct.unpack("<I", bytes(out[:4]))
         return bytes(out[4 : 4 + ln])
 
     def _bcast_arrays(self, arrays: tuple) -> tuple:
         from jax.experimental import multihost_utils
 
+        # cast each result back to its input dtype: the collective's
+        # psum may promote (uint8 payload buffers come back uint32 on
+        # newer jax), and the caller reinterprets raw bytes
         return tuple(
-            np.asarray(a) for a in multihost_utils.broadcast_one_to_all(arrays)
+            np.asarray(out).astype(src.dtype, copy=False)
+            for out, src in zip(
+                multihost_utils.broadcast_one_to_all(arrays), arrays
+            )
         )
 
     def _lead(self, op: str, arrays: tuple[np.ndarray, ...], **extra) -> None:
@@ -679,7 +708,7 @@ class StepMirror:
         if "slice_last" not in self._fns:
             import jax
 
-            self._fns["slice_last"] = jax.jit(
+            self._fns["slice_last"] = jax.jit(  # dynlint: disable=jit-in-function -- memoized: compiled once per static key
                 lambda t: t[-1], out_shardings=self._rep
             )
         return self._fns["slice_last"]

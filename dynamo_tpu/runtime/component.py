@@ -307,7 +307,9 @@ class Endpoint:
                         await writer.error(
                             "worker shutdown: stream aborted")
                     except Exception:  # noqa: BLE001 - socket may be gone
-                        pass
+                        logger.debug(
+                            "abort notice lost (socket gone)", exc_info=True
+                        )
                     raise
                 except Exception as e:  # noqa: BLE001
                     logger.exception("engine error for %s", env.request_id)
@@ -323,7 +325,9 @@ class Endpoint:
             if trace_token is not None:
                 tracing.reset_trace(trace_token)
             if writer is not None:
-                await writer.close()
+                # ResponseWriter.close() is async and awaits the
+                # transport's wait_closed() itself (runtime/tcp.py)
+                await writer.close()  # dynlint: disable=writer-wait-closed -- ResponseWriter.close() waits internally
             if env is not None:
                 self._inflight.pop(env.request_id, None)
 

@@ -16,8 +16,11 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import logging
 import uuid
 from typing import Any, AsyncIterator, Callable, Generic, Optional, TypeVar
+
+logger = logging.getLogger(__name__)
 
 Req = TypeVar("Req")
 Resp = TypeVar("Resp")
@@ -50,8 +53,9 @@ class CancellationToken:
         for cb in self._callbacks:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — one bad callback must not
+                # stop cancellation fan-out, but it must leave a trace
+                logger.debug("cancel callback failed", exc_info=True)
         for child in self._children:
             child.cancel()
 

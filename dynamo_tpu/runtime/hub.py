@@ -98,6 +98,10 @@ class HubServer:
             self._writers.discard(writer)
             await session.cleanup()
             writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass  # peer already gone — the fd is released either way
 
 
 class _Session:
@@ -120,7 +124,12 @@ class _Session:
 
     async def send(self, head: dict, data: bytes = b"") -> None:
         async with self._wlock:
-            await write_frame(self.writer, TwoPartMessage(json.dumps(head).encode(), data))
+            # this lock exists to serialize whole frames onto ONE stream
+            # (interleaved writes would corrupt the framing) — unlike a
+            # state lock, holding it across the write is the point
+            await write_frame(  # dynlint: disable=await-in-lock -- frame-serialization lock, guards only this stream
+                self.writer, TwoPartMessage(json.dumps(head).encode(), data)
+            )
 
     async def reply(self, req_id: int, result: Any = None, data: bytes = b"") -> None:
         await self.send({"op": "reply", "id": req_id, "result": result}, data)
@@ -371,6 +380,10 @@ class _HubConnection:
             self._reconnect_task.cancel()
         if self._writer:
             self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass  # peer already gone — the fd is released either way
 
     async def _read_loop(self) -> None:
         try:
@@ -384,19 +397,21 @@ class _HubConnection:
                     fut = self._pending.pop(head.get("id"), None)
                     if fut and not fut.done():
                         if "error" in head:
-                            exc = _ERRORS.get(head.get("etype"), StoreError)(head["error"])
+                            exc = _ERRORS.get(head.get("etype"), StoreError)(
+                                head.get("error", "hub error")
+                            )
                             fut.set_exception(exc)
                         else:
                             fut.set_result((head.get("result"), frame.data))
                 elif op == "watch_event":
-                    w = self._watchers.get(head["watch_id"])
+                    w = self._watchers.get(head.get("watch_id"))
                     if w is not None:
-                        w._track(head["kind"], head["key"])
-                    q = self._watch_queues.get(head["watch_id"])
+                        w._track(head.get("kind"), head.get("key"))
+                    q = self._watch_queues.get(head.get("watch_id"))
                     if q:
                         q.put_nowait((head, frame.data))
                 elif op == "bus_msg":
-                    q = self._sub_queues.get(head["sub_id"])
+                    q = self._sub_queues.get(head.get("sub_id"))
                     if q:
                         q.put_nowait((head, frame.data))
         except (ConnectionResetError, asyncio.CancelledError, OSError):
@@ -474,7 +489,9 @@ class _HubConnection:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         async with self._wlock:
-            await write_frame(
+            # frame-serialization lock (see _Session.send): held across
+            # the write by design so frames never interleave
+            await write_frame(  # dynlint: disable=await-in-lock -- frame-serialization lock, guards only this stream
                 self._writer, TwoPartMessage(json.dumps(head).encode(), data)
             )
         return await fut

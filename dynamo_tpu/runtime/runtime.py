@@ -206,7 +206,9 @@ class DistributedRuntime:
             await self._lease_keeper.stop(revoke=True)
             self._lease_keeper = None
         if self._tcp_server:
-            await self._tcp_server.close()
+            # TcpServer.close() is async and awaits the asyncio server's
+            # wait_closed() itself (runtime/tcp.py)
+            await self._tcp_server.close()  # dynlint: disable=writer-wait-closed -- TcpServer.close() waits internally
             self._tcp_server = None
         await self.runtime.join(timeout=5.0, cancel=True)
         if self._hub_conn is not None:

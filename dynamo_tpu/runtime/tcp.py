@@ -181,6 +181,10 @@ class TcpStreamServer:
                     pending.connected.set_exception(ConnectionError("worker hung up"))
                 pending.queue.put_nowait(None)
             writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass  # peer already gone — the fd is released either way
 
     @staticmethod
     async def _send_control(context: AsyncEngineContext, writer: asyncio.StreamWriter):
@@ -188,8 +192,11 @@ class TcpStreamServer:
             await context.stopped()
             msg = "kill" if context.is_killed() else "stop"
             await write_frame(writer, TwoPartMessage.from_json({"type": T_CONTROL, "msg": msg}))
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001
+            # control is best-effort (the data plane surfaces real
+            # failures) — but a silent swallow hid a dead control plane
+            # once already, so leave a trace for debugging
+            logger.debug("control-frame send failed", exc_info=True)
 
 
 class ResponseWriter:
@@ -247,6 +254,10 @@ class ResponseWriter:
         except (ConnectionResetError, BrokenPipeError):
             pass
         self._writer.close()
+        try:
+            await self._writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass  # peer already gone — the fd is released either way
 
 
 async def connect_response_stream(
@@ -269,5 +280,9 @@ async def connect_response_stream(
     head = (resp.header_json() or {}) if resp else {}
     if not head.get("ok"):
         writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
         raise ConnectionError(f"handshake rejected: {head}")
     return ResponseWriter(reader, writer, context)

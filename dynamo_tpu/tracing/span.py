@@ -16,10 +16,13 @@ collector directly in single-process setups). Durations come from
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 from .context import TraceContext, current_trace
 
@@ -165,7 +168,7 @@ class SpanRecorder:
             try:
                 sink(rec)
             except Exception:  # noqa: BLE001 — tracing must never fail a request
-                pass
+                logger.debug("span sink failed", exc_info=True)
 
     # ---- inspection ----
     def spans(self, trace_id: Optional[str] = None) -> list[dict]:

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# The one-command local gate (mirrored by .github/workflows/ci.yml):
+#
+#   1. dynlint          — the invariant-encoding static-analysis pass
+#                          (docs/static_analysis.md); exits non-zero on
+#                          any unsuppressed violation.
+#   2. lint self-tests  — every rule's firing/suppression fixtures plus
+#                          the runtime-sanitizer unit tests.
+#   3. sanitized subset — the event-loop-critical test modules, run with
+#                          the runtime sanitizer strict (loop stalls /
+#                          leaked writers fail tests; see conftest.py).
+#
+# Usage: scripts/check.sh [--fast]   (--fast skips step 3)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "==> dynlint (python -m dynamo_tpu.analysis dynamo_tpu/ tests/)"
+python -m dynamo_tpu.analysis dynamo_tpu/ tests/
+
+echo "==> lint-engine + sanitizer self-tests"
+python -m pytest tests/test_analysis.py -q -p no:cacheprovider
+
+if [[ "${1:-}" != "--fast" ]]; then
+    echo "==> sanitizer-strict fast subset (loop-stall + leaked-writer guards live)"
+    python -m pytest \
+        tests/test_engine.py \
+        tests/test_offload.py \
+        tests/test_offload_pipeline.py \
+        tests/test_tracing.py \
+        tests/test_resilience.py \
+        tests/test_kv_router.py \
+        tests/test_observability.py \
+        -q -m 'not slow' -p no:cacheprovider
+fi
+
+echo "check.sh: all green"

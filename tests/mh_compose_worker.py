@@ -177,7 +177,8 @@ async def leader() -> None:
     out_b2 = await collect(engine.generate(Context(_req(prompt_b1))))
     toks_b2 = [t for o in out_b2 for t in o.token_ids]
     ref_b = await collect(local.generate(Context(_req(prompt_b1))))
-    assert toks_b2 == [t for o in ref_b for t in o.token_ids]
+    ref_b_toks = [t for o in ref_b for t in o.token_ids]
+    assert toks_b2 == ref_b_toks, (toks_b2, ref_b_toks)
     print("phase1b cancel-after-restore ok", flush=True)
 
     # ---- phase 2: remote prefill INTO the mirrored decode engine ----

@@ -173,8 +173,9 @@ def test_kv_routed_serving(run):
         prompt = list(range(100, 124))  # 6 blocks of 4
         out1 = await collect(routed.generate(Context(make_req(prompt))))
         assert any((a.data or {}).get("finish_reason") for a in out1)
-        # let kv events propagate into the index
-        for _ in range(100):
+        # let kv events propagate into the index (generous: box load
+        # stretches the bus consumer the same way it stretches scrapes)
+        for _ in range(500):
             if router.indexer.events_applied >= 6:
                 break
             await asyncio.sleep(0.02)
@@ -184,14 +185,25 @@ def test_kv_routed_serving(run):
         # aggregator's last snapshot can still show the cached worker
         # with the finished request active, and the scheduler CORRECTLY
         # prefers the idle worker on that stale view — the property
-        # under test is prefix routing between idle workers
-        for _ in range(200):
+        # under test is prefix routing between idle workers. Wait on
+        # SCRAPES OBSERVED (the aggregator's completion event), not wall
+        # time: under 4x-parallel box load the 1s scrape loop stretches
+        # arbitrarily and a fixed-duration poll times out while the
+        # aggregator simply hasn't run (the PR 5-era flake).
+        def _all_idle():
             eps = router.metrics.endpoints
-            if (len(eps.loads) == 2
+            return (len(eps.loads) == 2
                     and all(l.active_requests == 0 and l.waiting == 0
-                            for l in eps.loads)):
+                            for l in eps.loads))
+
+        for _ in range(30):  # 30 COMPLETED scrapes, not 30 ticks of a clock
+            if _all_idle():
                 break
-            await asyncio.sleep(0.02)
+            await router.metrics.next_scrape(timeout=30.0)
+        assert _all_idle(), (
+            f"workers never scraped idle after {router.metrics.scrapes_total}"
+            " scrapes"
+        )
 
         # same prompt again: must route to the worker holding the prefix
         scores = router.indexer.find_matches(_hashes(prompt))

@@ -400,6 +400,68 @@ def test_drain_deadline_hands_off_and_migration_resumes(run):
     run(main())
 
 
+def test_mid_drain_fault_aborts_drain(run):
+    """Arming ``mid_drain`` kills the coordinator right after it leaves
+    discovery: the drain aborts (counted in drain_errors), the engines
+    are never drained — surviving streams take the worker-death path and
+    migrate anyway — and the aborted sequence must NOT revoke the lease
+    or stop the ingress (a real mid-drain crash dies before those)."""
+
+    class _Handle:
+        def __init__(self):
+            self.deregistered = False
+            self.stopped = False
+
+        async def deregister(self):
+            self.deregistered = True
+
+        def inflight_count(self):
+            return 0
+
+        async def stop(self):
+            self.stopped = True
+
+    class _Drt:
+        def __init__(self):
+            self.shutdowns = 0
+
+        async def shutdown(self):
+            self.shutdowns += 1
+
+    class _Engine:
+        def __init__(self):
+            self.drained = 0
+
+        async def drain(self, deadline_s=0.0, handoff=True):
+            self.drained += 1
+            return {"handed_off": 0}
+
+    async def main():
+        h, drt, e = _Handle(), _Drt(), _Engine()
+        coord = DrainCoordinator(
+            drt, engines=[e], handles=[h], deadline_s=0.0
+        )
+        faultpoints.arm("mid_drain", "kill")
+        await coord.trigger()
+        assert h.deregistered  # step 1 ran: discovery keys deleted
+        assert e.drained == 0  # fault fired before the engine drain
+        assert not h.stopped and drt.shutdowns == 0  # sequence aborted
+        assert coord.stats["drain_errors"] == 1
+        # delay flavor: the drain survives (slow, not dead) and runs the
+        # full sequence through lease revocation
+        faultpoints.reset()
+        faultpoints.arm("mid_drain", "delay", delay_s=0.01)
+        h2, drt2, e2 = _Handle(), _Drt(), _Engine()
+        coord2 = DrainCoordinator(
+            drt2, engines=[e2], handles=[h2], deadline_s=0.0
+        )
+        res = await coord2.drain()
+        assert res["drained"] and e2.drained == 1
+        assert h2.stopped and drt2.shutdowns == 1
+
+    run(main())
+
+
 # ---------------------------------------------------------------------------
 # migration policy edges
 # ---------------------------------------------------------------------------
