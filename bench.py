@@ -392,10 +392,10 @@ def _decode_itl_under_prefill() -> dict:
                 Context(req(range(base, base + 8), max_tokens=60))
             ):
                 now = _time.perf_counter()
-                inflight = engine._prefill_state is not None
+                inflight = bool(engine._prefill_states)
                 # a gap counts if a prefill was in flight at EITHER
                 # endpoint: the alternating scheduler clears
-                # _prefill_state when the FINAL chunk completes, before
+                # _prefill_states when the FINAL chunk completes, before
                 # the next decode token emits — sampling only at arrival
                 # would drop exactly the gap that absorbed that chunk
                 # (and flatter the alternating baseline's p99)
@@ -423,9 +423,12 @@ def _decode_itl_under_prefill() -> dict:
         async def run():
             # warmup phase: compiles every shape this workload reaches
             # (prefill buckets, decode step, the fused mixed program) so
-            # the measured gaps are steady-state scheduling, not XLA
+            # the measured gaps are steady-state scheduling, not XLA.
+            # All prompt ids stay inside the tiny model's 512 vocab —
+            # the engine now rejects OOB ids (their embeds are
+            # implementation-defined across meshes)
             await phase(10, [300], record=False)
-            await phase(20, [500, 700, 900], record=True)
+            await phase(20, [330, 150, 420], record=True)
             await engine.close()
 
         asyncio.run(run())
@@ -444,6 +447,124 @@ def _decode_itl_under_prefill() -> dict:
             out["alternating"]["p99"] / max(out["fused"]["p99"], 1e-9), 3
         )
     return {"decode_itl_under_prefill_ms": out}
+
+
+def _prefill_hol_stats() -> dict:
+    """bench_prefill_hol (ISSUE 9): K short prompts arriving BEHIND one
+    long prefill, multi-segment packing (mixed_max_prefills=4) vs
+    single-segment (=1, the PR 3 scheduler). With a single in-flight
+    prefill the shorts serialize head-of-line: each waits out the whole
+    long prompt's remaining chunks before its own prefill starts. The
+    multi-segment packer splits the Sarathi token budget across all
+    queued prompts per fused step (per-prompt minimum chunk), so the
+    shorts' first tokens arrive while the long prompt is still
+    prefilling. Reports short-prompt TTFT p50/p99 and decode ITL p99
+    per mode + the p99 TTFT speedup — the bench artifact carries the
+    HOL-kill (or its regression) every round."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    K = 5  # short prompts queued behind the long prefill
+
+    def req(toks, max_tokens):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    def run_one(max_prefills: int) -> tuple:
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=320, block_size=4,
+            max_batch_size=8, max_context=512, prefill_chunk=16,
+            mixed_batch=True, mixed_max_prefills=max_prefills,
+        )
+        engine = JaxEngine(cfg, seed=0)
+        ttfts: list = []
+        itls: list = []
+
+        async def decode_stream(record):
+            prev = None
+            async for _ in engine.generate(
+                Context(req(range(10, 18), max_tokens=70))
+            ):
+                now = _time.perf_counter()
+                if record and prev is not None:
+                    itls.append((now - prev) * 1e3)
+                prev = now
+
+        async def short_stream(toks, record):
+            t0 = _time.perf_counter()
+            first = None
+            async for out in engine.generate(Context(req(toks, 2))):
+                if first is None and out.token_ids:
+                    first = _time.perf_counter()
+                    if record:
+                        ttfts.append((first - t0) * 1e3)
+
+        async def drive(long_base, short_base, record):
+            # distinct ids per phase: a prefix hit from the warm phase
+            # would shrink the measured prefills (all ids in-vocab)
+            t = asyncio.ensure_future(decode_stream(record))
+            while engine.stats["decode_steps"] == 0:
+                await asyncio.sleep(0.005)
+            long_t = asyncio.ensure_future(collect(engine.generate(
+                Context(req(range(long_base, long_base + 320), 1))
+            )))
+            # the shorts arrive once the long prompt's prefill is in
+            # flight — the head-of-line moment
+            while not engine._prefill_states:
+                await asyncio.sleep(0.002)
+            shorts = [
+                asyncio.ensure_future(
+                    short_stream(range(short_base + 3 * i,
+                                       short_base + 3 * i + 24), record)
+                )
+                for i in range(K)
+            ]
+            await asyncio.gather(long_t, *shorts)
+            await t
+
+        async def run():
+            # warm phase compiles every reachable shape (prefill buckets,
+            # segment-count buckets, fused programs)
+            await drive(100, 20, record=False)
+            await drive(130, 60, record=True)
+            await engine.close()
+
+        asyncio.run(run())
+        return ttfts, itls
+
+    out: dict = {"short_prompts": K, "long_prompt_tokens": 320}
+    for name, mp in (("single_segment", 1), ("multi_segment", 4)):
+        ttfts, itls = run_one(mp)
+        out[name] = {
+            "short_ttft_ms": {
+                "p50": round(_pct(ttfts, 50), 3),
+                "p99": round(_pct(ttfts, 99), 3),
+                "n": len(ttfts),
+            } if ttfts else {"p50": None, "p99": None, "n": 0},
+            "decode_itl_p99_ms": round(_pct(itls, 99), 3) if itls else None,
+        }
+    single = out["single_segment"]["short_ttft_ms"]
+    multi = out["multi_segment"]["short_ttft_ms"]
+    if single["n"] and multi["n"]:
+        out["short_ttft_p99_speedup"] = round(
+            single["p99"] / max(multi["p99"], 1e-9), 3
+        )
+    return {"bench_prefill_hol": out}
 
 
 def _ttft_trace_stats() -> dict:
@@ -1070,6 +1191,10 @@ def main() -> None:
         result.update(_decode_itl_under_prefill())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["mixed_batch_stats_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_prefill_hol_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_prefill_hol_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(_churn_kill_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands

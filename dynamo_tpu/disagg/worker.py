@@ -58,6 +58,7 @@ class PrefillWorker:
         head_layout: Optional[str] = None,
         kv_stream: bool = True,
         segment_blocks: int = 0,
+        concurrency: int = 1,
     ):
         self.engine = engine
         self.queue = queue
@@ -72,7 +73,16 @@ class PrefillWorker:
         # its connection info — old peers keep getting the bulk protocol
         self.kv_stream = kv_stream
         self.segment_blocks = segment_blocks
-        self._task: Optional[asyncio.Task] = None
+        # consume-loop fan-out: with the engine's streamed extract taking
+        # the device lock per CHUNK, N concurrent prompts interleave
+        # chunk-wise and each streams its segments as its own chunks
+        # land — M queued prompts advance together instead of
+        # head-of-line blocking on whole-prompt prefills (the disagg
+        # twin of the mixed-batch packer). Each loop owns its item's
+        # full dequeue->process->ack lifecycle, so the PR 4 no-ack/
+        # redeliver semantics are untouched.
+        self.concurrency = max(int(concurrency), 1)
+        self._tasks: list[asyncio.Task] = []
         self._stop = asyncio.Event()
         self.stats = {
             "prefills_total": 0, "prefill_errors": 0, "nacks": 0,
@@ -80,14 +90,17 @@ class PrefillWorker:
         }
 
     def start(self) -> None:
-        if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self.run())
+        if not self._tasks:
+            loop = asyncio.get_running_loop()
+            self._tasks = [
+                loop.create_task(self.run()) for _ in range(self.concurrency)
+            ]
 
     async def close(self) -> None:
         self._stop.set()
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
 
     MAX_DELIVERIES = 5  # poison-pill cutoff: after this, fail the request
 
@@ -425,12 +438,16 @@ class _RemoteScatterSink:
     segment scatters into the request's pre-allocated pages the moment
     it arrives (engine.scatter_remote_segment), so the full-stack buffer
     never materializes and only the final segment's tail can sit on
-    TTFT. ``begin`` declines — routing the stream into the buffered bulk
-    fallback — when the sender's kv-head layout / tp doesn't match this
-    engine (kv_rearrange has no per-segment regroup yet; see
-    docs/disagg_serving.md). ``aclose`` waits out any in-flight scatter
-    before the caller frees the reservation, so an abandoned stream can
-    never write into recycled pages."""
+    TTFT. A kv-head-layout / tp mismatch no longer declines the stream:
+    the head-axis permutation (ops/kv_rearrange) is block-independent,
+    so each segment regroups ON ARRIVAL — mismatched peers stream too
+    (ROADMAP item 1's last leftover). ``begin`` validates the
+    permutation against the declared geometry and only falls back to
+    the buffered bulk path when no valid regroup exists (bad peer
+    metadata — the bulk delivery's regroup then surfaces the error
+    through the existing abort path). ``aclose`` waits out any
+    in-flight scatter before the caller frees the reservation, so an
+    abandoned stream can never write into recycled pages."""
 
     def __init__(self, engine: JaxEngine, handle, stats: dict):
         self._engine = engine
@@ -438,6 +455,7 @@ class _RemoteScatterSink:
         self._stats = stats
         self._closed = False
         self._lock = asyncio.Lock()
+        self._regroup = None  # (src_tp, dst_tp, src_layout, dst_layout)
         self.segments = 0
 
     async def begin(self, head: dict) -> bool:
@@ -447,10 +465,27 @@ class _RemoteScatterSink:
         my_tp = self._engine.cfg.mesh.tp if self._engine.cfg.mesh else 1
         layout = head.get("head_layout", "blocked")
         src_tp = head.get("src_tp", 1)
+        self._regroup = None
         if layout != my_layout or (
             layout == "interleaved" and src_tp != my_tp
         ):
-            return False  # bulk fallback: buffer + rearrange + one scatter
+            from ..ops.kv_rearrange import rearrange_for_decode
+
+            # validate the permutation NOW against both declared head
+            # geometries (k and v differ for MLA latents): a geometry
+            # the regroup can't cover must take the bulk fallback at
+            # begin-time, not poison the stream mid-flight
+            shape = tuple(head.get("shape") or ())
+            v_shape = tuple(head.get("v_shape") or shape)
+            try:
+                for hkv in {shape[1], v_shape[1]}:
+                    rearrange_for_decode(
+                        np.empty((1, hkv, 0, 1, 1), np.int8),
+                        src_tp, my_tp, layout, my_layout,
+                    )
+            except Exception:  # noqa: BLE001 — bad peer metadata
+                return False
+            self._regroup = (src_tp, my_tp, layout, my_layout)
         # a redelivered stream restarts from block 0 — re-scatters over
         # the same uncommitted pages are idempotent
         self.segments = 0
@@ -460,6 +495,17 @@ class _RemoteScatterSink:
         async with self._lock:
             if self._closed:
                 raise SinkClosed(self._handle.seq.context.id)
+            if self._regroup is not None:
+                from ..ops.kv_rearrange import rearrange_for_decode
+
+                src_tp, dst_tp, sl, dl = self._regroup
+                # pure head-axis gather; on device-resident segments
+                # (local pipe) XLA fuses it into the scatter
+                k_seg = rearrange_for_decode(k_seg, src_tp, dst_tp, sl, dl)
+                v_seg = rearrange_for_decode(v_seg, src_tp, dst_tp, sl, dl)
+                self._stats["kv_stream_regroups"] = (
+                    self._stats.get("kv_stream_regroups", 0) + 1
+                )
             await self._engine.scatter_remote_segment(
                 self._handle, b0, k_seg, v_seg
             )
@@ -498,7 +544,7 @@ class DisaggEngine(AsyncEngine):
         self.stats = {
             "remote_prefills": 0, "local_prefills": 0, "remote_errors": 0,
             "streamed_deliveries": 0, "bulk_deliveries": 0,
-            "kv_stream_segments": 0,
+            "kv_stream_segments": 0, "kv_stream_regroups": 0,
         }
 
     def _connection(self) -> dict:

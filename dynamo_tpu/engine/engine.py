@@ -69,6 +69,18 @@ def _bucket(n: int) -> int:
     return ((n + 8191) // 8192) * 8192
 
 
+def _seg_bucket(n: int) -> int:
+    """Segment-count bucket for the fused mixed step: the smallest power
+    of two covering ``n`` in-flight prefill segments. The fused program
+    is keyed by (segment-count bucket x prefill-length bucket), so the
+    mixture of live prompts never multiplies compiles — dead pad
+    segments (valid 0, zero tables) fill the bucket."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 @jax.jit
 def _reset_pen_slot(counts, mask, slot, prompt_ids, gen_ids):
     """Rebuild one slot's penalty state: prompt-token mask from
@@ -109,6 +121,17 @@ class EngineConfig:
     # 0 = prefill_chunk. Smaller budgets bound the fused step's device
     # time (tighter decode ITL) at more steps per prompt.
     mixed_step_budget: int = 0
+    # max concurrent prompts whose prefills PACK into one fused step
+    # (the full ragged formulation / Sarathi stall-free multi-prompt
+    # packing): the mixed_step_budget splits across up to this many
+    # in-flight prompts per iteration — admission order, per-prompt
+    # minimum chunk so no prompt starves — killing head-of-line
+    # blocking among queued prompts (short prompts behind a long
+    # prefill get their first token without waiting it out). 1 = one
+    # prefill at a time (the PR 3 behavior). Compiled program count is
+    # bounded by segment-count buckets x prefill buckets, not by the
+    # live mixture (test_compiled_perf).
+    mixed_max_prefills: int = 4
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
@@ -223,6 +246,11 @@ class EngineConfig:
             )
         if self.mixed_step_budget == 0:
             self.mixed_step_budget = self.prefill_chunk
+        if self.mixed_max_prefills < 1:
+            raise ValueError(
+                f"mixed_max_prefills={self.mixed_max_prefills} must be "
+                ">= 1 (1 = single-prefill fused steps)"
+            )
         self.max_blocks_per_seq = (
             self.max_context + self.block_size - 1
         ) // self.block_size
@@ -373,7 +401,11 @@ class JaxEngine(AsyncEngine):
         # it before the queue, so no reaching into asyncio.Queue._queue
         # internals (advisor r2 weak #4)
         self._waiting_front: deque[_Sequence] = deque()
-        self._prefill_state: Optional[_PrefillState] = None
+        # in-flight chunked prefills, admission order. The mixed-batch
+        # scheduler packs the Sarathi token budget across ALL of them
+        # per fused step (up to cfg.mixed_max_prefills); the alternating
+        # scheduler (mixed off / mirror / ring) only ever holds one
+        self._prefill_states: list[_PrefillState] = []
         # remotely-prefilled sequences with KV landed, awaiting a batch slot
         self._remote_ready: list[_Sequence] = []
         self._active: list[Optional[_Sequence]] = [None] * cfg.max_batch_size
@@ -431,6 +463,7 @@ class JaxEngine(AsyncEngine):
             "prefix_cache_hits_tokens": 0,
             "decode_steps": 0,
             "mixed_steps": 0,
+            "mixed_prefill_segments": 0,
             "preemptions": 0,
             "spec_proposed": 0,
             "spec_accepted": 0,
@@ -556,6 +589,20 @@ class JaxEngine(AsyncEngine):
         if len(req.token_ids) >= self.cfg.max_context:
             yield LLMEngineOutput(finish_reason=FinishReason.ERROR)
             return
+        if not self._tokens_in_vocab(req.token_ids):
+            # out-of-vocab ids make the embedding gather IMPLEMENTATION-
+            # DEFINED (XLA clamps on one device; a multi-process sharded
+            # mesh lands OOB rows differently), so the same request can
+            # legally produce different streams on different meshes —
+            # found as the test_multihost_compose cancel-after-restore
+            # "token mismatch", which was OOB prompt ids all along.
+            # Reject loudly instead of serving garbage.
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                text=f"prompt token id out of range [0, "
+                     f"{self.cfg.model.vocab_size})",
+            )
+            return
         seq = _Sequence(
             request=req,
             context=request.context,
@@ -607,9 +654,12 @@ class JaxEngine(AsyncEngine):
         out.update(sanitizer.counters())
         return out | {
             # mixed-batch fusion activity (prefill chunks riding decode
-            # steps) — lets the router/metrics plane see whether decode
-            # ITL is being shielded from concurrent prefill
+            # steps, and how many prompt segments packed into them) —
+            # lets the router/metrics plane see whether decode ITL is
+            # being shielded from concurrent prefill and whether queued
+            # prompts are advancing together or head-of-line blocking
             "mixed_steps": self.stats["mixed_steps"],
+            "mixed_prefill_segments": self.stats["mixed_prefill_segments"],
             "kv_active_blocks": self.allocator.used_count,
             "kv_total_blocks": self.allocator.num_blocks - 1,
             "gpu_cache_usage_perc": self.allocator.usage(),
@@ -672,8 +722,7 @@ class JaxEngine(AsyncEngine):
         # batch while the drain window is open, so it can finish locally
         while self._remote_ready:
             self._handoff_seq(self._remote_ready.pop())
-        if self._prefill_state is not None:
-            st = self._prefill_state
+        for st in list(self._prefill_states):
             self.stats["drain_handoffs"] += 1
             self._abort_prefill(st, FinishReason.ERROR, text=MIGRATION_SIGNAL)
         for seq in list(self._active):
@@ -704,7 +753,7 @@ class JaxEngine(AsyncEngine):
                 if (
                     self._n_active == 0
                     and not admitted
-                    and self._prefill_state is None
+                    and not self._prefill_states
                 ):
                     # drop a stale pipelined window before going idle (its
                     # participants all finished; tokens are discards)
@@ -724,7 +773,11 @@ class JaxEngine(AsyncEngine):
                         continue
                     await self._wake.wait()
                     continue
-                if self._n_active:
+                # a multi-prompt prefill pack with no decode batch is
+                # still a fused dispatch (_mixed_fusable covers it) —
+                # the queued prompts advance TOGETHER instead of
+                # head-of-line blocking behind states[0]
+                if self._n_active or self._mixed_fusable():
                     await self._decode_once()
                 # yield to the event loop so emissions flush
                 await asyncio.sleep(0)
@@ -756,7 +809,7 @@ class JaxEngine(AsyncEngine):
             or not self._waiting.empty()
             or self._remote_ready
             or self._n_active
-            or self._prefill_state is not None
+            or self._prefill_states
         )
 
     def _fail_all_owned(self, text: Optional[str] = None) -> None:
@@ -764,13 +817,14 @@ class JaxEngine(AsyncEngine):
         mid-prefill, and still-waiting. ``text`` rides the terminal chunk
         (a worker-lost signature there lets the migration layer pick the
         streams up instead of surfacing errors)."""
-        in_prefill = [self._prefill_state.seq] if self._prefill_state else []
+        in_prefill = [st.seq for st in self._prefill_states]
         for seq in self._active + self._remote_ready + in_prefill:
             if seq is not None:
                 seq.out_queue.put_nowait(
                     LLMEngineOutput(finish_reason=FinishReason.ERROR, text=text)
                 )
         self._remote_ready.clear()
+        self._prefill_states.clear()
         while self._waiting_front or not self._waiting.empty():
             seq = self._pop_waiting()
             seq.out_queue.put_nowait(
@@ -806,19 +860,29 @@ class JaxEngine(AsyncEngine):
             self._place_in_batch(seq)
             admitted = True
         # advance an in-flight chunked prefill by exactly one chunk per
-        # iteration. With mixed batching OFF (or no decode batch to fuse
-        # into) that's a dedicated prefill dispatch here; when the chunk
-        # can FUSE into the running batch's decode step, _decode_once
-        # dispatches it as one mixed step instead — decode streams never
-        # stall a full chunk's device time behind a separate dispatch
-        if self._prefill_state is not None and not self._mixed_fusable():
+        # iteration. With mixed batching OFF (or nothing to fuse with)
+        # that's a dedicated prefill dispatch here; when chunks can FUSE
+        # into the running batch's decode step (or into each other —
+        # multi-prompt packs dispatch even with no decode batch),
+        # _decode_once dispatches one mixed step instead — decode
+        # streams never stall a full chunk's device time behind a
+        # separate dispatch, and queued prompts advance together
+        if self._prefill_states and not self._mixed_fusable():
             admitted |= await self._prefill_step()
         while (
-            self._prefill_state is None
-            and self._n_active < self.cfg.max_batch_size
+            len(self._prefill_states) < self._prefill_limit()
+            and self._n_active + len(self._prefill_states)
+            < self.cfg.max_batch_size
             and (self._waiting_front or not self._waiting.empty())
         ):
             seq = self._pop_waiting()
+            # a ring-routed prompt owns its whole dispatch (sequence-
+            # parallel one-shot prefill) — never pack IT behind other
+            # in-flight prefills; it waits for the states list to clear
+            # and runs alternating (where _mixed_fusable defers to it)
+            if self._prefill_states and self._could_ring(seq):
+                self._waiting_front.appendleft(seq)
+                break
             if seq.context.is_stopped():
                 seq.out_queue.put_nowait(LLMEngineOutput(finish_reason=FinishReason.CANCELLED))
                 continue
@@ -850,8 +914,10 @@ class JaxEngine(AsyncEngine):
                 )
                 continue
             if ok and self._mixed_fusable():
-                # first chunk rides the next fused step
-                break
+                # first chunk rides the next fused step; keep admitting
+                # more queued prompts into the pack (up to the limit —
+                # the while condition) so the budget splits across them
+                continue
             if not ok:
                 # A sequence whose minimum reservation exceeds the whole
                 # pool can never admit (e.g. preempted late with a grown
@@ -886,6 +952,42 @@ class JaxEngine(AsyncEngine):
         self.stats["requests_active"] = self._n_active
         self.stats["requests_waiting"] = self._waiting_size()
         return admitted
+
+    def _tokens_in_vocab(self, ids) -> bool:
+        V = self.cfg.model.vocab_size
+        return all(0 <= t < V for t in ids)
+
+    def _prefill_limit(self) -> int:
+        """How many chunked prefills may be in flight at once: mixed
+        batching packs up to ``mixed_max_prefills`` prompts per fused
+        step; the alternating scheduler (mixed off, multi-host mirror)
+        keeps the single-prefill discipline."""
+        if not self.cfg.mixed_batch or self.mirror is not None:
+            return 1
+        return self.cfg.mixed_max_prefills
+
+    def _could_ring(self, seq: _Sequence) -> bool:
+        """Pre-reservation screen for ring routing: would this prompt
+        plausibly take the sp ring-attention path at pos 0? Used only to
+        keep ring prompts OUT of multi-prefill packs (the ring dispatch
+        is whole-prompt, not chunk-wise). Mirrors ``_ring_chunk``'s
+        model-family exclusions — a family that can never ring
+        (sliding-window, gpt-oss windows/sinks, gemma-2 softcap) must
+        not have its long prompts barred from packing; the authoritative
+        per-chunk check stays ``_ring_chunk`` (only the cached-prefix
+        pos!=0 and bucket-divisibility terms are unknowable here, and
+        over-matching on those merely under-packs)."""
+        cfg = self.cfg
+        return (
+            cfg.ring_prefill_threshold > 0
+            and self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and len(seq.tokens) >= cfg.ring_prefill_threshold
+            and cfg.model.sliding_window == 0
+            and not cfg.model.layer_windows
+            and not cfg.model.attn_sinks
+            and not cfg.model.attn_softcap
+        )
 
     def _reserve_for_prompt(self, seq: _Sequence, probe_host: bool = False,
                             hashes=None):
@@ -973,15 +1075,18 @@ class JaxEngine(AsyncEngine):
                 request_id=seq.context.id,
                 waiting=self._waiting_size(),
             )
-        self._prefill_state = _PrefillState(seq=seq, pos=history, upload=upload)
+        self._prefill_states.append(
+            _PrefillState(seq=seq, pos=history, upload=upload)
+        )
         return True
 
     async def _prefill_step(self) -> bool:
-        """Run ONE prefill chunk of the in-flight sequence; on the final
-        chunk, sample the first token and join the decode batch. Returns
-        True when the sequence was admitted (prefill completed)."""
-        st = self._prefill_state
-        assert st is not None
+        """Run ONE prefill chunk of the OLDEST in-flight sequence (the
+        alternating path only ever holds one); on the final chunk,
+        sample the first token and join the decode batch. Returns True
+        when the sequence was admitted (prefill completed)."""
+        assert self._prefill_states
+        st = self._prefill_states[0]
         faultpoints.hit_sync("mid_prefill", request_id=st.seq.context.id)
         seq = st.seq
         if seq.context.is_stopped():
@@ -1017,7 +1122,7 @@ class JaxEngine(AsyncEngine):
                 request_id=seq.context.id,
                 prompt_tokens=seq.prompt_len, cached_prefix=seq.cached_prefix,
             )
-        self._prefill_state = None
+        self._drop_prefill_state(st)
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token, first_lp)
         if not seq.finished:
@@ -1032,6 +1137,10 @@ class JaxEngine(AsyncEngine):
                 self._remote_ready.append(seq)
         return True
 
+    def _drop_prefill_state(self, st: "_PrefillState") -> None:
+        if st in self._prefill_states:
+            self._prefill_states.remove(st)
+
     def _abort_prefill(
         self, st: "_PrefillState", reason: FinishReason,
         text: Optional[str] = None,
@@ -1043,7 +1152,7 @@ class JaxEngine(AsyncEngine):
         share it so the rollback protocol cannot drift between them;
         ``text`` lets the drain handoff stamp the migration signal."""
         seq = st.seq
-        self._prefill_state = None
+        self._drop_prefill_state(st)
         self.allocator.free(seq.blocks)
         seq.blocks = []
         self._rollback_upload(st)
@@ -1496,18 +1605,23 @@ class JaxEngine(AsyncEngine):
     # ---- decode ----
 
     def _mixed_fusable(self) -> bool:
-        """Can the in-flight prefill's next chunk fuse into a decode
-        step? Needs the mixed-batch path on, a decode batch to ride
-        along, no multi-host mirror (the fused step is not a broadcast
-        op — mirrored engines keep the alternating scheduler), and a
-        chunk that isn't routed through sp ring attention."""
-        st = self._prefill_state
+        """Can the in-flight prefills' next chunks fuse into one mixed
+        step? Needs the mixed-batch path on, no multi-host mirror (the
+        fused step is not a broadcast op — mirrored engines keep the
+        alternating scheduler), a head-of-line chunk that isn't routed
+        through sp ring attention (admission never packs a ring prompt
+        behind others, so only states[0] can ring), and something to
+        fuse WITH: a live decode batch, or at least two prompts packing
+        into each other (a lone prefill with nothing decoding gains
+        nothing from the fused dispatch — the dedicated prefill program
+        is cheaper)."""
+        sts = self._prefill_states
         return (
             self.cfg.mixed_batch
-            and st is not None
+            and bool(sts)
             and self.mirror is None
-            and self._n_active > 0
-            and not self._ring_chunk(st.seq, st.pos)
+            and not self._ring_chunk(sts[0].seq, sts[0].pos)
+            and (self._n_active > 0 or len(sts) > 1)
         )
 
     def _pick_window(self) -> int:
@@ -1524,7 +1638,7 @@ class JaxEngine(AsyncEngine):
         one decode step per chunk)."""
         batch_full = self._n_active >= self.cfg.max_batch_size
         actionable = (
-            (self._prefill_state is not None and not self._mixed_fusable())
+            (bool(self._prefill_states) and not self._mixed_fusable())
             or (not self._waiting_is_empty() and not batch_full
                 and not self._backpressured)
             or (bool(self._remote_ready) and not batch_full)
@@ -1599,15 +1713,15 @@ class JaxEngine(AsyncEngine):
         cfg = self.cfg
         faultpoints.hit_sync("mid_decode")
         if self._mixed_fusable():
-            # chunked prefill fuses into this iteration's decode step: a
+            # chunked prefills fuse into this iteration's decode step: a
             # pipelined window can't chain across the membership change a
             # completing prefill brings, so drain first (cheap — mixed
             # phases force 1-step windows anyway)
             await self._drain_inflight()
-            if self._n_active == 0:
-                return
             if self._mixed_fusable():
                 await self._mixed_step_once()
+                return
+            if self._n_active == 0:
                 return
         n = self._pick_window()
         # tokens already written/writing on device for an undrained window
@@ -1700,7 +1814,7 @@ class JaxEngine(AsyncEngine):
         if (
             cfg.spec_gamma > 0
             and n > 1
-            and self._prefill_state is None
+            and not self._prefill_states
         ):
             # Proposals must come from the FRESH tail (an undrained
             # window's tokens are part of it), but draining kills the
@@ -1721,6 +1835,19 @@ class JaxEngine(AsyncEngine):
                     proposals
                 ):
                     return
+                # a stale hit whose fresh re-probe (or verify) missed:
+                # the tail is HOT — a match existed ``pending`` tokens
+                # ago. Re-entering pipelined mode here would keep every
+                # future probe one window behind the repetition, so
+                # speculation could NEVER engage on a pipelined engine
+                # (found via test_multihost_compose phase 4, which this
+                # starved to 0 accepted tokens). Dispatch this one
+                # window unchained so the next iteration probes fresh.
+                spec_hot = True
+            else:
+                spec_hot = False
+        else:
+            spec_hot = False
 
         # Pipelined mode: dispatch window k+1 BEFORE draining window k.
         # Its token inputs are window k's last sampled tokens — a device
@@ -1735,7 +1862,8 @@ class JaxEngine(AsyncEngine):
         pipe = (
             cfg.decode_pipeline
             and n > 1
-            and self._prefill_state is None
+            and not self._prefill_states
+            and not spec_hot
         )
         if not pipe:
             await self._drain_inflight()
@@ -1885,20 +2013,25 @@ class JaxEngine(AsyncEngine):
         return True
 
     async def _mixed_step_once(self) -> None:
-        """ONE fused mixed step: a ``mixed_step_budget``-bounded chunk of
-        the in-flight prefill AND one decode token for every active
-        sequence, in a single device dispatch (llama.mixed_step). The
-        decode side behaves exactly like a 1-step window (same commit
-        horizon / emission / preemption rules); the prefill side advances
-        like a `_prefill_step` chunk (same cancel/error rollback, same
-        ``engine.prefill`` span accounting — the fused dispatch's device
-        time lands on the prefill component, since the chunk dominates
-        it, so decode ITL stops absorbing chunk time)."""
+        """ONE fused mixed step: the ``mixed_step_budget`` token budget
+        packed across EVERY in-flight prefill (admission order, each
+        prompt guaranteed a minimum chunk so none starves) AND one
+        decode token for every active sequence, in a single device
+        dispatch (llama.mixed_step). The decode side behaves exactly
+        like a 1-step window (same commit horizon / emission /
+        preemption rules); each prefill side advances like a
+        `_prefill_step` chunk (same per-prompt cancel/error rollback,
+        same per-segment ``engine.prefill`` span accounting — the fused
+        dispatch's device time splits across the advancing prompts in
+        proportion to their token take, so decode ITL stops absorbing
+        chunk time and each prompt's traced prefill stays honest)."""
         cfg = self.cfg
-        st = self._prefill_state
-        seq_p = st.seq
-        if seq_p.context.is_stopped():
-            self._abort_prefill(st, FinishReason.CANCELLED)
+        # per-prompt cancel: drop ONE cancelled prompt from the pack,
+        # the others keep advancing in the same step
+        for st in list(self._prefill_states):
+            if st.seq.context.is_stopped():
+                self._abort_prefill(st, FinishReason.CANCELLED)
+        if not self._prefill_states:
             return
         # provision one decode token per active sequence (no window is in
         # flight here — _decode_once drained before calling)
@@ -1923,8 +2056,9 @@ class JaxEngine(AsyncEngine):
                     continue
                 if self._evict_for_headroom(seq):
                     break
-        if self._n_active == 0:
-            return  # next iteration advances the prefill alone
+        if self._n_active == 0 and len(self._prefill_states) < 2:
+            return  # a lone prefill alone: the alternating step is cheaper
+        packed = self._split_mixed_budget()
         # dynlint: disable=async-blocking-call -- [B]-sized host int list, no device copy
         steps = np.asarray(
             [self._active[i].generated if self._active[i] else 0
@@ -1933,72 +2067,111 @@ class JaxEngine(AsyncEngine):
         )
         try:
             async with self._device_lock:
-                toks, lps, first = await (
+                toks, lps, completed = await (
                     asyncio.get_running_loop().run_in_executor(
-                        None, self._dispatch_mixed, st, steps
+                        None, self._dispatch_mixed, packed, steps
                     )
                 )
         except Exception:  # noqa: BLE001
-            # fail the PREFILL request alone (lowering/compile failures
-            # leave the donated caches intact; the decode rows simply
-            # didn't advance and retry next iteration on the plain path)
+            # a fused-dispatch failure (lowering/compile) is not
+            # attributable to one prompt: fail every in-flight prefill,
+            # each with its OWN upload rollback (the donated caches are
+            # intact; the decode rows simply didn't advance and retry
+            # next iteration on the plain path)
             logger.exception(
-                "mixed prefill step failed for request %s", seq_p.context.id
+                "mixed prefill step failed for requests %s",
+                [st.seq.context.id for st, _ in packed],
             )
-            self._abort_prefill(st, FinishReason.ERROR)
+            for st in list(self._prefill_states):
+                self._abort_prefill(st, FinishReason.ERROR)
             return
         self.stats["decode_steps"] += 1
         self.stats["mixed_steps"] += 1
+        self.stats["mixed_prefill_segments"] += len(packed)
         # decode emission: exactly a drained 1-step window
-        for i, seq in list(enumerate(self._active)):
-            if seq is None or seq.finished:
-                continue
-            entry = None
-            k = int(self._logprob_ks[i])
-            if lps is not None and k >= 0:
-                chosen, top_ids, top_lps = lps
-                entry = {
-                    "logprob": float(chosen[i]),
-                    "top": [
-                        [int(top_ids[i, j]), float(top_lps[i, j])]
-                        for j in range(k)
-                    ],
-                }
-            self._emit_token(seq, int(toks[i]), entry)
-            if seq.finished or self._active[i] is not seq:
-                continue
-            self._seq_lens[i] = seq.seq_len
-            self._last_tokens[i] = seq.tokens[-1]
-            self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
-        if first is None:
-            return  # more chunks to go
-        first_token, first_lp = first
-        if seq_p.trace is not None and seq_p.generated == 0:
-            tracing.RECORDER.record_span(
-                "engine.prefill", seq_p.trace, ts=st.t0_wall,
-                dur_ms=st.dev_ms,
-                request_id=seq_p.context.id,
-                prompt_tokens=seq_p.prompt_len,
-                cached_prefix=seq_p.cached_prefix,
-            )
-        self._prefill_state = None
-        self._commit_full_blocks(seq_p)
-        self._emit_token(seq_p, first_token, first_lp)
-        if not seq_p.finished:
-            if self._n_active < cfg.max_batch_size:
-                self._place_in_batch(seq_p)
-            else:
-                # slots filled mid-prefill (remote-ready admissions):
-                # the KV is landed, so queue for the next free slot
-                # exactly like a remotely-prefilled sequence
-                self._remote_ready.append(seq_p)
+        if self._n_active:
+            for i, seq in list(enumerate(self._active)):
+                if seq is None or seq.finished:
+                    continue
+                entry = None
+                k = int(self._logprob_ks[i])
+                if lps is not None and k >= 0:
+                    chosen, top_ids, top_lps = lps
+                    entry = {
+                        "logprob": float(chosen[i]),
+                        "top": [
+                            [int(top_ids[i, j]), float(top_lps[i, j])]
+                            for j in range(k)
+                        ],
+                    }
+                self._emit_token(seq, int(toks[i]), entry)
+                if seq.finished or self._active[i] is not seq:
+                    continue
+                self._seq_lens[i] = seq.seq_len
+                self._last_tokens[i] = seq.tokens[-1]
+                self._commit_full_blocks(seq, written_len=seq.seq_len - 1)
+        # prompts whose FINAL chunk just ran: first token sampled on
+        # device in _dispatch_mixed — emit + join the batch, in
+        # admission order (multiple prompts may complete in one step)
+        for st, first in completed:
+            seq_p = st.seq
+            first_token, first_lp = first
+            if seq_p.trace is not None and seq_p.generated == 0:
+                tracing.RECORDER.record_span(
+                    "engine.prefill", seq_p.trace, ts=st.t0_wall,
+                    dur_ms=st.dev_ms,
+                    request_id=seq_p.context.id,
+                    prompt_tokens=seq_p.prompt_len,
+                    cached_prefix=seq_p.cached_prefix,
+                )
+            self._drop_prefill_state(st)
+            self._commit_full_blocks(seq_p)
+            self._emit_token(seq_p, first_token, first_lp)
+            if not seq_p.finished:
+                if self._n_active < cfg.max_batch_size:
+                    self._place_in_batch(seq_p)
+                else:
+                    # slots filled mid-prefill (remote-ready admissions):
+                    # the KV is landed, so queue for the next free slot
+                    # exactly like a remotely-prefilled sequence
+                    self._remote_ready.append(seq_p)
 
-    def _dispatch_mixed(self, st: "_PrefillState", steps: np.ndarray):
-        """Executor thread: the fused mixed dispatch. Returns
-        (decode_tokens [B] np, logprob arrays or None, and — on the
-        final chunk — the prefill's sampled (first_token, lp_entry))."""
+    def _split_mixed_budget(self) -> list[tuple["_PrefillState", int]]:
+        """Pack the Sarathi token budget across the in-flight prefills:
+        every prompt gets a minimum chunk of budget // n_prompts (at
+        least 1 token — no prompt starves, the stall-free guarantee),
+        and the leftover goes to the EARLIEST-admitted prompts first
+        (admission order keeps TTFT ordering fair). Returns
+        [(state, take)] with every take >= 1."""
+        sts = self._prefill_states
+        budget = self.cfg.mixed_step_budget
+        rem = [len(st.seq.tokens) - st.pos for st in sts]
+        floor = max(budget // len(sts), 1)
+        takes = [min(r, floor) for r in rem]
+        left = budget - sum(takes)
+        for i in range(len(sts)):
+            if left <= 0:
+                break
+            extra = min(left, rem[i] - takes[i])
+            takes[i] += extra
+            left -= extra
+        return list(zip(sts, takes))
+
+    def _dispatch_mixed(
+        self, packed: list[tuple["_PrefillState", int]], steps: np.ndarray
+    ):
+        """Executor thread: the fused mixed dispatch over M prefill
+        segments + the decode batch. Returns (decode_tokens [B] np,
+        logprob arrays or None, completed: [(state, (first_token,
+        lp_entry))] for every prompt whose final chunk just ran).
+
+        Shape discipline: the segment count pads to a power-of-two
+        bucket (dead segments: valid 0, zero tables — their rows land
+        in reserved trash page 0 and their logits are never read) and
+        every segment shares ONE bucketed length T = bucket(max take),
+        so the compiled program count is bounded by segment-count
+        buckets x prefill buckets, never by the live mixture."""
         cfg = self.cfg
-        seq_p = st.seq
         # provisioning invariant (loud, not silent — the same check the
         # window dispatch makes): every active sequence must have a block
         # for this step's token, or its write would scatter through zero
@@ -2013,15 +2186,27 @@ class JaxEngine(AsyncEngine):
                     f"(seq_len={seq.seq_len}, blocks={len(seq.blocks)})"
                 )
         t0 = time.perf_counter()
+        total_take = sum(take for _st, take in packed) or 1
         try:
-            self._offload_preamble(
-                st.upload if not st.restored else None, seq=seq_p
-            )
-            st.restored = True
-            chunk = seq_p.tokens[st.pos : st.pos + cfg.mixed_step_budget]
-            T = _bucket(len(chunk))
-            toks_p = np.zeros(T, np.int32)
-            toks_p[: len(chunk)] = chunk
+            # land each prompt's reserved host chain (first step only);
+            # eviction flushes are shared across the pack
+            for st, _take in packed:
+                self._offload_preamble(
+                    st.upload if not st.restored else None, seq=st.seq
+                )
+                st.restored = True
+            MP = _seg_bucket(len(packed))
+            T = _bucket(max(take for _st, take in packed))
+            toks_p = np.zeros((MP, T), np.int32)
+            tables_p = np.zeros((MP, cfg.max_blocks_per_seq), np.int32)
+            hists_p = np.zeros(MP, np.int32)
+            valids_p = np.zeros(MP, np.int32)
+            for i, (st, take) in enumerate(packed):
+                chunk = st.seq.tokens[st.pos : st.pos + take]
+                toks_p[i, : len(chunk)] = chunk
+                tables_p[i] = self._table_for(st.seq)
+                hists_p[i] = st.pos
+                valids_p[i] = len(chunk)
             positions = np.maximum(self._seq_lens - 1, 0).astype(np.int32)
             penalized = self._penalties_active()
             want_lp = self._logprobs_active()
@@ -2047,9 +2232,9 @@ class JaxEngine(AsyncEngine):
                 jnp.asarray(self._top_ks),
                 jnp.asarray(self._top_ps),
                 jnp.asarray(toks_p),
-                jnp.asarray(self._table_for(seq_p)),
-                jnp.int32(st.pos),
-                jnp.int32(len(chunk)),
+                jnp.asarray(tables_p),
+                jnp.asarray(hists_p),
+                jnp.asarray(valids_p),
                 self.k_cache,
                 self.v_cache,
                 use_pallas=self.use_pallas,
@@ -2067,23 +2252,29 @@ class JaxEngine(AsyncEngine):
             if penalized:
                 self._pen_counts = rest.pop(0)
             lps_dev = rest.pop(0) if want_lp else None
-            st.pos += len(chunk)
-            first = None
-            if st.pos >= len(seq_p.tokens):
-                first = self._sample_prefill(seq_p, p_logits)
+            completed = []
+            for i, (st, take) in enumerate(packed):
+                st.pos += take
+                if st.pos >= len(st.seq.tokens):
+                    completed.append(
+                        (st, self._sample_prefill(st.seq, p_logits[i]))
+                    )
             toks_host = np.asarray(jax.device_get(toks))
             lps = (
                 tuple(np.asarray(jax.device_get(a)) for a in lps_dev)
                 if lps_dev is not None else None
             )
-            return toks_host, lps, first
+            return toks_host, lps, completed
         finally:
             # the fused dispatch's device time lands on the traced
-            # prefill component (the chunk dominates it; attributing the
-            # decode row share too slightly overcounts prefill but keeps
-            # decode ITL honest — the span decode streams no longer wait
-            # on)
-            st.dev_ms += (time.perf_counter() - t0) * 1e3
+            # prefill components, split across the advancing prompts in
+            # proportion to their token take (the chunks dominate the
+            # step; attributing the decode row share too slightly
+            # overcounts prefill but keeps decode ITL honest — the span
+            # decode streams no longer wait on)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            for st, take in packed:
+                st.dev_ms += dt_ms * (take / total_take)
 
     def _pallas_guard(self, thunk):
         """Run a device dispatch; if Mosaic rejects a kernel at its
@@ -2595,9 +2786,10 @@ class JaxEngine(AsyncEngine):
                 )
                 idxs = [b.idx for b in seq.blocks[sent:hi]]
                 t_g = time.perf_counter()
-                k_seg, v_seg = await loop.run_in_executor(
-                    None, self._gather_device, idxs, keep_on_device
-                )
+                async with self._device_lock:
+                    k_seg, v_seg = await loop.run_in_executor(
+                        None, self._gather_device, idxs, keep_on_device
+                    )
                 if timings is not None:
                     # per-segment d2h time is handoff work too (same
                     # accounting as the bulk twin's single gather)
@@ -2609,21 +2801,35 @@ class JaxEngine(AsyncEngine):
                 sent = hi
 
         try:
+            # the device lock is taken PER CHUNK (and per gather), not
+            # across the whole prompt: M concurrent streamed extracts —
+            # and a co-resident serving loop's decode steps — interleave
+            # chunk-wise instead of serializing whole prompts, so every
+            # advancing prompt streams its segments as its own chunks
+            # land (the multi-prompt twin of the mixed-batch packer;
+            # PrefillWorker ``concurrency`` drives it). Safe because the
+            # sequence's blocks are reserved (no interleaved dispatch
+            # can touch them) and every cache-donating dispatch still
+            # serializes under the lock. on_segment backpressure is paid
+            # OUTSIDE the lock, so a slow peer throttles only its own
+            # prompt, never the whole engine.
             async with self._device_lock:
                 await loop.run_in_executor(None, self._offload_preamble)
-                pos = history
-                logits = None
-                while pos < len(prompt):
+            pos = history
+            logits = None
+            while pos < len(prompt):
+                async with self._device_lock:
                     logits, pos = await loop.run_in_executor(
                         None, self._run_one_chunk, seq, pos
                     )
-                    # blocks whose every position is now written; the
-                    # final chunk also releases the partial last block
-                    full = n_prompt if pos >= len(prompt) else min(
-                        pos // bs, n_prompt
-                    )
-                    if on_segment is not None and full > sent:
-                        await emit_upto(full)
+                # blocks whose every position is now written; the
+                # final chunk also releases the partial last block
+                full = n_prompt if pos >= len(prompt) else min(
+                    pos // bs, n_prompt
+                )
+                if on_segment is not None and full > sent:
+                    await emit_upto(full)
+            async with self._device_lock:
                 first_token, first_lp = await loop.run_in_executor(
                     None, self._sample_prefill, seq, logits
                 )
@@ -2663,7 +2869,13 @@ class JaxEngine(AsyncEngine):
         if isinstance(req, dict):
             req = PreprocessedRequest.from_dict(req)
         prompt = list(req.token_ids)
-        if not prompt or len(prompt) >= self.cfg.max_context:
+        if (
+            not prompt
+            or len(prompt) >= self.cfg.max_context
+            # OOB ids: fall back to local serving, whose generate()
+            # rejects them with the clean vocab-range error
+            or not self._tokens_in_vocab(prompt)
+        ):
             return None
         seq = _Sequence(
             request=req,

@@ -200,6 +200,8 @@ class KvMetricsAggregator:
                     kv_stream_deliveries=d.get("streamed_deliveries", 0),
                     kv_bulk_deliveries=d.get("bulk_deliveries", 0),
                     kv_stream_segments=d.get("kv_stream_segments", 0),
+                    mixed_steps=d.get("mixed_steps", 0),
+                    mixed_prefill_segments=d.get("mixed_prefill_segments", 0),
                     requests_total=d.get("requests_total", 0),
                     tokens_generated=d.get("tokens_generated", 0),
                     prompt_tokens_total=d.get("prompt_tokens_total", 0),

@@ -67,6 +67,12 @@ class WorkerLoad:
     kv_stream_deliveries: int = 0
     kv_bulk_deliveries: int = 0
     kv_stream_segments: int = 0
+    # mixed-batch fusion surface (engine stats): fused steps dispatched
+    # and how many prefill SEGMENTS packed into them — segments/steps
+    # near 1 under a deep prompt queue means head-of-line blocking the
+    # packer should be absorbing (docs/architecture.md mixed batching)
+    mixed_steps: int = 0
+    mixed_prefill_segments: int = 0
     # cumulative serving counters (engine stats): the planner's
     # telemetry aggregator turns scrape-to-scrape deltas into fleet
     # arrival/throughput rates

@@ -271,6 +271,7 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         spec_ngram=args.spec_ngram,
         mixed_batch=not args.no_mixed_batch,
         mixed_step_budget=args.mixed_step_budget,
+        mixed_max_prefills=args.mixed_max_prefills,
     )
 
 
@@ -581,6 +582,7 @@ async def run_prefill(args) -> None:
     worker = PrefillWorker(
         core, queue, kv_stream=args.kv_stream,
         segment_blocks=args.kv_segment_blocks,
+        concurrency=args.prefill_concurrency,
     )
     worker.start()
     print(f"prefill worker {drt.worker_id:x} serving {name!r} "
@@ -872,6 +874,10 @@ def main(argv=None) -> None:
     p.add_argument("--mixed-step-budget", type=int, default=0,
                    help="prefill tokens per fused mixed step "
                         "(0 = prefill_chunk)")
+    p.add_argument("--mixed-max-prefills", type=int, default=4,
+                   help="max concurrent prompts packed into one fused "
+                        "mixed step (the budget splits across them; "
+                        "1 = one prefill at a time)")
     p.add_argument("--spec-gamma", type=int, default=0,
                    help="speculative decoding: proposals per verify (0=off)")
     p.add_argument("--spec-ngram", type=int, default=3,
@@ -899,6 +905,11 @@ def main(argv=None) -> None:
     p.add_argument("--kv-segment-blocks", type=int, default=0,
                    help="cap per-segment block count in the streamed "
                         "handoff (0 = one segment per prefill chunk)")
+    p.add_argument("--prefill-concurrency", type=int, default=1,
+                   help="in=prefill: concurrent prompts advancing "
+                        "chunk-wise on one engine (each streams its own "
+                        "KV segments as its chunks land; 1 = serialize "
+                        "whole prompts)")
     p.add_argument("--no-migration", action="store_true",
                    help="disable transparent in-flight request migration "
                         "(frontend roles: a worker death then errors its "

@@ -1385,15 +1385,16 @@ def decode_window(
 
 def _mixed_fused_forward(
     params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
-    p_tokens, p_table, p_hist, p_valid, k_cache, v_cache,
+    p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
     mesh=None, interpret=False,
 ):
     """The FULLY-fused mixed forward (TPU/Pallas path): embeddings and
-    every projection/FFN/logits GEMM run over the combined [B + T] row
-    axis — the weight stream amortizes across the decode rows and the
-    chunk (the mixed-batch MFU win) — and attention is ONE ragged
-    paged-attention kernel invocation per layer covering both parts
-    (ops/ragged_paged_attention_pallas). Write-before-attend throughout.
+    every projection/FFN/logits GEMM run over the combined [B + MP*T]
+    row axis — the weight stream amortizes across the decode rows and
+    every prefill segment (the mixed-batch MFU win) — and attention is
+    ONE ragged paged-attention kernel invocation per layer covering all
+    parts (ops/ragged_paged_attention_pallas). Write-before-attend
+    throughout.
 
     Combined-row GEMMs reassociate reductions vs the unfused [B]- and
     [T]-row programs, so this path matches them only to kernel-grade
@@ -1403,7 +1404,7 @@ def _mixed_fused_forward(
     other branch. GQA families only; MLA and softcap models take the
     per-part branch.
 
-    Returns (decode_logits [B, V] f32, p_logits [V] f32, k_cache,
+    Returns (decode_logits [B, V] f32, p_logits [MP, V] f32, k_cache,
     v_cache).
     """
     from ..ops.ragged_paged_attention_pallas import (
@@ -1412,9 +1413,11 @@ def _mixed_fused_forward(
     )
 
     B = d_tokens.shape[0]
-    T = p_tokens.shape[0]
-    x = _embed(params, cfg, jnp.concatenate([d_tokens, p_tokens]))  # [B+T, E]
-    p_positions = p_hist + jnp.arange(T)
+    MP, T = p_tokens.shape
+    x = _embed(
+        params, cfg, jnp.concatenate([d_tokens, p_tokens.reshape(-1)])
+    )  # [B + MP*T, E]
+    p_positions = (p_hists[:, None] + jnp.arange(T)[None, :]).reshape(-1)
     positions_all = jnp.concatenate([d_positions, p_positions])
     inv_freq = _rope_freqs(cfg)
     rope_msc = _rope_attention_scaling(cfg)
@@ -1440,45 +1443,54 @@ def _mixed_fused_forward(
             h = pre_norm(lp, "attn_norm", x, cfg)
             w = window_for_layer(cfg, l)
             kc_l, vc_l = k_cache[l], v_cache[l]
-            q, k, v = _qkv(lp, cfg, h)  # [B+T, H/Hkv, D]
+            q, k, v = _qkv(lp, cfg, h)  # [B+MP*T, H/Hkv, D]
             fr = rope_freqs_for_layer(cfg, l, inv_freq, inv_local)
             q = apply_rope(q, positions_all, fr, rope_msc)
             k = apply_rope(k, positions_all, fr, rope_msc)
-            # write-before-attend for BOTH parts (distinct pages: the
-            # prefill sequence is not in the decode batch; padded chunk
-            # rows land in reserved trash page 0)
+            # write-before-attend for EVERY part (distinct pages: no
+            # prefill sequence is in the decode batch and segments are
+            # distinct sequences; padded/dead segment rows land in
+            # reserved trash page 0 through their zero table entries)
             kc_l = att.write_decode_token_to_cache(
                 kc_l, k[:B], d_tables, d_positions
             )
             vc_l = att.write_decode_token_to_cache(
                 vc_l, v[:B], d_tables, d_positions
             )
-            kc_l = att.write_chunk_to_cache(kc_l, k[B:], p_table, p_hist)
-            vc_l = att.write_chunk_to_cache(vc_l, v[B:], p_table, p_hist)
+            for m in range(MP):
+                sl = slice(B + m * T, B + (m + 1) * T)
+                kc_l = att.write_chunk_to_cache(
+                    kc_l, k[sl], p_tables[m], p_hists[m]
+                )
+                vc_l = att.write_chunk_to_cache(
+                    vc_l, v[sl], p_tables[m], p_hists[m]
+                )
+            Hq, Dh = q.shape[1], q.shape[2]
+            q_chunks = q[B:].reshape(MP, T, Hq, Dh)
             if mesh is not None:
-                o_dec, o_chunk = ragged_mixed_attention_sharded(
-                    q[:B], q[B:], kc_l, vc_l, d_tables, d_seq_lens,
-                    p_table, p_hist, p_valid, scale, mesh, window=w,
+                o_dec, o_chunks = ragged_mixed_attention_sharded(
+                    q[:B], q_chunks, kc_l, vc_l, d_tables, d_seq_lens,
+                    p_tables, p_hists, p_valids, scale, mesh, window=w,
                     sinks=lp.get("sinks"), interpret=interpret,
                 )
             else:
-                o_dec, o_chunk = ragged_mixed_attention(
-                    q[:B], q[B:], kc_l, vc_l, d_tables, d_seq_lens,
-                    p_table, p_hist, p_valid, scale, window=w,
+                o_dec, o_chunks = ragged_mixed_attention(
+                    q[:B], q_chunks, kc_l, vc_l, d_tables, d_seq_lens,
+                    p_tables, p_hists, p_valids, scale, window=w,
                     sinks=lp.get("sinks"), interpret=interpret,
                 )
             k_cache = k_cache.at[l].set(kc_l)
             v_cache = v_cache.at[l].set(vc_l)
             o = jnp.concatenate(
-                [o_dec.reshape(B, -1), o_chunk.reshape(T, -1)]
+                [o_dec.reshape(B, -1), o_chunks.reshape(MP * T, -1)]
             )
             x = layer_tail(x, lp, o)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits_d = _logits(params, cfg, x[:B])  # [B, V] f32
-    # the chunk's last REAL row only (the unfused prefill computes the
-    # same single row — a full [T, V] head matmul would be pure waste)
-    last = B + jnp.clip(p_valid - 1, 0, T - 1)
-    p_logits = _logits(params, cfg, x[last])  # [V] f32
+    # each segment's last REAL row only (the unfused prefill computes
+    # the same single row — full [T, V] head matmuls would be pure waste)
+    last = B + jnp.arange(MP) * T + jnp.clip(p_valids - 1, 0, T - 1)
+    p_logits = _logits(params, cfg, x[last])  # [MP, V] f32
     return logits_d, p_logits, k_cache, v_cache
 
 
@@ -1501,11 +1513,13 @@ def mixed_step(
     temps: jnp.ndarray,  # [B] float32
     top_ks: jnp.ndarray,  # [B] int32
     top_ps: jnp.ndarray,  # [B] float32
-    # prefill side (same conventions as prefill's chunk args)
-    p_tokens: jnp.ndarray,  # [T] padded chunk of the in-flight prompt
-    p_table: jnp.ndarray,  # [M] the prefill sequence's block table
-    p_hist: jnp.ndarray,  # scalar int32: tokens already cached
-    p_valid: jnp.ndarray,  # scalar int32: real tokens in this chunk
+    # prefill side (same conventions as prefill's chunk args, stacked
+    # over M in-flight prompts; dead pad segments have valid 0 + zero
+    # tables and their logits row is garbage the engine never reads)
+    p_tokens: jnp.ndarray,  # [MP, T] padded chunks of in-flight prompts
+    p_tables: jnp.ndarray,  # [MP, M] the prefill sequences' block tables
+    p_hists: jnp.ndarray,  # [MP] int32: tokens already cached per prompt
+    p_valids: jnp.ndarray,  # [MP] int32: real tokens in each chunk
     k_cache: jnp.ndarray,  # donated
     v_cache: jnp.ndarray,
     use_pallas: bool = False,
@@ -1521,37 +1535,44 @@ def mixed_step(
     prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
     with_logprobs: bool = False,
 ):
-    """ONE device dispatch fusing a prefill chunk into a decode step.
+    """ONE device dispatch fusing M prefill chunks into a decode step.
 
     Two forward flavors behind one dispatch boundary:
 
       * **Pallas (TPU) path** — `_mixed_fused_forward`: combined-row
-        GEMMs + one ragged paged-attention kernel invocation per layer
-        (the full mixed-batch MFU win). Matches the unfused paths to
-        kernel-grade tolerance; greedy streams preserved except at
-        exact logit ties — the standing contract for every
-        Pallas-vs-XLA pairing in this repo. MLA and softcap families on
-        this path fall through to the per-part flavor below (MLA's
-        latent decode+prefill kernel pair runs inside the same
-        dispatch; there is no latent ragged kernel yet).
+        GEMMs over the decode rows + every segment, plus one ragged
+        paged-attention kernel invocation per layer (the full
+        mixed-batch MFU win). Matches the unfused paths to kernel-grade
+        tolerance; greedy streams preserved except at exact logit ties
+        — the standing contract for every Pallas-vs-XLA pairing in this
+        repo. MLA and softcap families on this path fall through to the
+        per-part flavor below (MLA's latent decode+prefill kernel pair
+        runs inside the same dispatch, once per segment; there is no
+        latent ragged kernel yet).
       * **XLA path** (CPU, quantized-KV, softcap) — per-part structural
-        identity: the chunk runs through EXACTLY the unfused prefill
+        identity: each segment runs through EXACTLY the unfused prefill
         forward (``prefill.__wrapped__``: same scan/unrolled layer
-        loop, same [T]-row GEMMs) and the decode batch through EXACTLY
-        ``_decode_body`` with the engine's own ``unroll``/``merged``
-        flags — so tokens AND logprobs are BIT-IDENTICAL to the
-        alternating scheduler (the tests/test_mixed_batch.py contract;
-        restructured GEMMs would reassociate bf16 reductions and flip
-        sampled tokens). The two parts are computationally independent
-        (the prefill sequence is not in the decode batch; disjoint
-        pages), so fusing them into one program cannot change either.
+        loop, same [T]-row GEMMs), in admission order, and the decode
+        batch through EXACTLY ``_decode_body`` with the engine's own
+        ``unroll``/``merged`` flags — so tokens AND logprobs are
+        BIT-IDENTICAL to the alternating scheduler (the
+        tests/test_mixed_batch.py contract; restructured GEMMs would
+        reassociate bf16 reductions and flip sampled tokens). All parts
+        are computationally independent (no prefill sequence is in the
+        decode batch; segments are distinct sequences with disjoint
+        pages), so fusing them into one program cannot change any.
+
+    The segment count MP and padded length T are static shape keys —
+    the engine buckets both (segment-count buckets x prefill buckets),
+    so the compiled program count is bounded by the bucket grid, never
+    the per-step segment-length mixture.
 
     Sampling mirrors decode_window's body exactly (penalties on the
     sampled distribution, raw logits for reported logprobs).
 
-    Returns (next_tokens [B], p_logits [V] f32 — the chunk's
-    last-real-row logits, for host-side first-token sampling on the
-    final chunk —, k_cache, v_cache[, counts]
+    Returns (next_tokens [B], p_logits [MP, V] f32 — each segment's
+    last-real-row logits, for host-side first-token sampling on a
+    prompt's final chunk —, k_cache, v_cache[, counts]
     [, (chosen_lp [B], top_ids [B, K], top_lps [B, K])]).
     """
     from ..ops.sampling import (
@@ -1562,20 +1583,26 @@ def mixed_step(
         token_logprobs,
     )
 
+    MP = p_tokens.shape[0]
     if use_pallas and not cfg.is_mla and not cfg.attn_softcap:
         logits_d, p_logits, k_cache, v_cache = _mixed_fused_forward(
             params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
-            p_tokens, p_table, p_hist, p_valid, k_cache, v_cache,
+            p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
             mesh=mesh, interpret=interpret,
         )
     else:
-        # chunk first, then decode — order is numerically irrelevant
-        # (independent parts) and matches the admission-then-decode
-        # order of the alternating scheduler
-        p_logits, k_cache, v_cache = prefill.__wrapped__(
-            params, cfg, p_tokens, p_table, p_hist, p_valid,
-            k_cache, v_cache, use_pallas=use_pallas, mesh=mesh,
-        )
+        # chunks first (admission order), then decode — order is
+        # numerically irrelevant (independent parts) and matches the
+        # admission-then-decode order of the alternating scheduler
+        p_logit_rows = []
+        for m in range(MP):
+            lg, k_cache, v_cache = prefill.__wrapped__(
+                params, cfg, p_tokens[m], p_tables[m], p_hists[m],
+                p_valids[m], k_cache, v_cache, use_pallas=use_pallas,
+                mesh=mesh,
+            )
+            p_logit_rows.append(lg)
+        p_logits = jnp.stack(p_logit_rows)  # [MP, V]
         logits_d, k_cache, v_cache = _decode_body(
             params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
             k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,

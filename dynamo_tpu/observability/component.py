@@ -183,6 +183,11 @@ class MetricsComponent:
             gauge("kv_stream_deliveries_total", w.kv_stream_deliveries, lb)
             gauge("kv_bulk_deliveries_total", w.kv_bulk_deliveries, lb)
             gauge("kv_stream_segments_total", w.kv_stream_segments, lb)
+            # mixed-batch packing: fused steps + prefill segments packed
+            # into them (segments/steps ~1 under a deep queue = HOL
+            # blocking the multi-prompt packer should be absorbing)
+            gauge("mixed_steps_total", w.mixed_steps, lb)
+            gauge("mixed_prefill_segments_total", w.mixed_prefill_segments, lb)
             # cumulative serving counters (planner telemetry inputs)
             gauge("requests_served_total", w.requests_total, lb)
             gauge("tokens_generated_total", w.tokens_generated, lb)

@@ -1,43 +1,53 @@
 """Pallas TPU kernel: ragged paged attention for MIXED prefill+decode.
 
 The engine's mixed-batch step (engine/engine.py `_mixed_step_once` →
-models/llama.mixed_step) fuses one chunked-prefill segment into the same
+models/llama.mixed_step) fuses M chunked-prefill segments into the same
 device dispatch as a decode step for every active sequence, so decode
-streams stop stalling behind prefill chunks (the Sarathi/\"Ragged Paged
-Attention\" mixed-batch scheduling — PAPERS.md). This module is that
-step's attention: ONE kernel invocation computes
+streams stop stalling behind prefill chunks AND queued prompts stop
+stalling behind each other's prefills (the Sarathi token-budget packing
++ the full "Ragged Paged Attention" formulation — PAPERS.md). This
+module is that step's attention: ONE kernel invocation computes
 
   * B decode rows — one query token per sequence, each against its own
     block table and sequence length, and
-  * one prefill segment — up to a per-step token budget of chunk query
-    rows against the prefill sequence's history plus the causal prefix
-    of the chunk itself,
+  * M prefill segments — each up to a per-segment share of the step's
+    token budget, every segment's rows against its own sequence's
+    history plus the causal prefix of the segment itself,
 
 with per-row query positions, causal masking, per-row sliding-window
 floors, and the gpt-oss sink fold, all in a single grid.
 
 Design — a strict generalization of the two existing kernels
-(paged_attention_pallas._decode_kernel / _prefill_kernel), reusing their
-row/group mapping (row r of a tile is token t = r // group, head
-g = r % group):
+(paged_attention_pallas._decode_kernel / _prefill_kernel) and of this
+kernel's own one-segment predecessor (PR 3), reusing their row/group
+mapping (row r of a tile is token t = r // group, head g = r % group):
 
   * everything is write-before-attend: the caller has already scattered
-    the decode tokens' K/V and the chunk's K/V into the paged cache, so
-    every query row attends PURELY through block tables and the mask is
-    uniform — ``kv_pos <= q_pos`` (plus the window floor). One mask rule
-    covers history, chunk-causal, and the decode self-row.
+    the decode tokens' K/V and every segment's K/V into the paged
+    cache, so every query row attends PURELY through block tables and
+    the mask is uniform — ``kv_pos <= q_pos`` (plus the window floor).
+    One mask rule covers history, chunk-causal, and the decode
+    self-row, for every segment.
   * grid = (tiles, kv_heads, superblocks). The tile axis is ragged over
     SEQUENCES: tiles 0..B-1 are the decode rows (one real token each,
     padded to the uniform ``q_tile`` tokens; the padding rows compute
     garbage that is sliced off — their page DMAs are shared with the
     real row, so the waste is compute the DMA-bound step hides), tiles
-    B.. are the prefill chunk in ``q_tile``-token slices.
-  * scalar-prefetched per-tile metadata (`tile_q0`, `tile_last_q`) and
-    the stacked block tables ([B+1, M]; row B is the prefill sequence)
-    let each page stream's ``index_map`` fetch exactly the physical
-    pages the tile's own sequence needs; pages past a tile's causal
-    horizon re-map to its last needed page (consecutive identical
-    indices skip the re-fetch, the same trick as the parent kernels).
+    B.. are the M prefill segments in ``q_tile``-token slices, segment-
+    major.
+  * scalar-prefetched per-tile metadata (`tile_seq`, `tile_q0`,
+    `tile_last_q`) and the stacked block tables ([B+M, Mb]; rows B..
+    are the prefill sequences) let each page stream's ``index_map``
+    fetch exactly the physical pages the tile's own sequence needs;
+    pages past a tile's causal horizon re-map to its last needed page
+    (consecutive identical indices skip the re-fetch, the same trick as
+    the parent kernels). ``tile_seq`` is what makes the tile axis truly
+    ragged: a tile no longer infers its table row from its position.
+  * all segments share ONE padded length T (the caller buckets the
+    largest take), so the compiled program is keyed by (M bucket, T
+    bucket) — never by the segment-length mixture. Dead segments
+    (valid 0) and all-padding tiles have ``tile_last_q == -1``, skip
+    every superblock, and emit zeros the caller slices off.
   * fp32 online softmax in VMEM scratch; output written once on the
     final superblock, with the sink logit folded into the normalizer
     there (per-row head via the relayout-free one-hot dot).
@@ -71,8 +81,9 @@ def _pick_pages_per_step(M: int, cap: int = 8) -> int:
 
 
 def _mixed_kernel(
-    # scalar prefetch
-    tables_ref,  # [B+1, M] int32 (SMEM): decode tables + prefill table
+    # scalar prefetch (order matches the pallas_call operands)
+    seq_ref,  # [S] int32: tile -> its sequence's row in tables_ref
+    tables_ref,  # [B+MP, Mb] int32 (SMEM): decode + prefill tables
     q0_ref,  # [S] int32: tile row 0's absolute query position
     lastq_ref,  # [S] int32: tile's last REAL query position (-1 = all pad)
     # inputs: q then P k-page refs then P v-page refs [then sinks]
@@ -183,42 +194,47 @@ def _mixed_kernel(
 )
 def ragged_mixed_attention(
     q_dec: jnp.ndarray,  # [B, H, D] decode queries (token ALREADY written)
-    q_chunk: jnp.ndarray,  # [T, H, D] chunk queries (chunk ALREADY written)
+    q_chunks: jnp.ndarray,  # [MP, T, H, D] segment queries (ALREADY written)
     k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D]
     v_cache_layer: jnp.ndarray,
     d_tables: jnp.ndarray,  # [B, M] int32 decode block tables
     d_seq_lens: jnp.ndarray,  # [B] int32, INCLUDING the new token
-    p_table: jnp.ndarray,  # [M] int32 the prefill sequence's table
-    p_hist: jnp.ndarray,  # scalar int32: tokens cached before this chunk
-    p_valid: jnp.ndarray,  # scalar int32: real tokens in this chunk
+    p_tables: jnp.ndarray,  # [MP, M] int32 the prefill sequences' tables
+    p_hists: jnp.ndarray,  # [MP] int32: tokens cached before each segment
+    p_valids: jnp.ndarray,  # [MP] int32: real tokens in each segment
     scale: float,
     q_tile: int = 0,  # 0 -> min(128, T); must divide T
     pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
     window: int = 0,  # sliding attention width; 0 = full
     sinks: jnp.ndarray | None = None,  # [H] gpt-oss sink logits
     interpret: bool = False,
-) -> tuple[jnp.ndarray, jnp.ndarray]:  # (o_dec [B, H, D], o_chunk [T, H, D])
-    """One kernel invocation over B decode rows + one prefill segment.
+) -> tuple[jnp.ndarray, jnp.ndarray]:  # (o_dec [B,H,D], o_chunks [MP,T,H,D])
+    """One kernel invocation over B decode rows + M prefill segments.
 
-    Both parts must be write-before-attend (K/V for the decode tokens AND
-    the chunk scattered into the cache first); every row then attends
-    ``kv_pos <= q_pos`` through its sequence's block table. Decode row b
-    sits at q_pos = d_seq_lens[b]-1; chunk row t at p_hist + t. Inactive
-    decode slots (seq_len 0) and padded chunk rows emit zeros/garbage the
-    caller slices off — their superblocks are skipped entirely.
+    Every part must be write-before-attend (K/V for the decode tokens
+    AND every segment scattered into the cache first); every row then
+    attends ``kv_pos <= q_pos`` through its sequence's block table.
+    Decode row b sits at q_pos = d_seq_lens[b]-1; segment m's row t at
+    p_hists[m] + t. Inactive decode slots (seq_len 0), dead segments
+    (p_valids[m] == 0), and padded segment rows emit zeros/garbage the
+    caller slices off — their superblocks are skipped entirely. All
+    segments share the padded length T, so the compiled program is
+    keyed by (MP, T) buckets, never the per-segment length mixture.
     """
     B, H, D = q_dec.shape
-    T = q_chunk.shape[0]
+    MP, T = q_chunks.shape[0], q_chunks.shape[1]
     Hkv, N, bs, _ = k_cache_layer.shape
     M = d_tables.shape[1]
-    assert p_table.shape[0] == M, "decode and prefill tables must share M"
+    assert p_tables.shape == (MP, M), (
+        "decode and prefill tables must share the blocks-per-seq width"
+    )
     G = H // Hkv
     Gp = max(8, -(-G // 8) * 8)
     Tq = q_tile or min(128, T)
     if T % Tq:
-        raise ValueError(f"q_tile={Tq} must divide chunk length T={T}")
+        raise ValueError(f"q_tile={Tq} must divide segment length T={T}")
     nT = T // Tq
-    S = B + nT  # ragged tile axis: B decode tiles + nT chunk tiles
+    S = B + MP * nT  # ragged tile axis: B decode + MP*nT segment tiles
     Pp = pages_per_step or _pick_pages_per_step(M)
     if M % Pp:
         raise ValueError(
@@ -233,31 +249,36 @@ def ragged_mixed_attention(
     qd = jnp.pad(
         qd, ((0, 0), (0, Tq - 1), (0, 0), (0, Gp - G), (0, 0))
     )  # [B, Tq, Hkv, Gp, D]
-    qp = q_chunk.reshape(T, Hkv, G, D)
+    qp = q_chunks.reshape(MP * T, Hkv, G, D)
     qp = jnp.pad(qp, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
-    qp = qp.reshape(nT, Tq, Hkv, Gp, D)
+    qp = qp.reshape(MP * nT, Tq, Hkv, Gp, D)
     q_all = jnp.concatenate([qd, qp], axis=0)  # [S, Tq, Hkv, Gp, D]
     q_all = q_all.transpose(2, 0, 1, 3, 4).reshape(Hkv, S * Tq * Gp, D)
 
     # ---- per-tile metadata (scalar prefetch) ----
     tables = jnp.concatenate(
-        [d_tables.astype(jnp.int32), p_table.astype(jnp.int32)[None]], axis=0
-    )  # [B+1, M]
-    hist = jnp.asarray(p_hist, jnp.int32)
-    valid = jnp.asarray(p_valid, jnp.int32)
+        [d_tables.astype(jnp.int32), p_tables.astype(jnp.int32)], axis=0
+    )  # [B+MP, M]
+    hists = p_hists.astype(jnp.int32)  # [MP]
+    valids = p_valids.astype(jnp.int32)
     dec_q0 = d_seq_lens.astype(jnp.int32) - 1  # -1 for inactive slots
-    j = jnp.arange(nT, dtype=jnp.int32)
-    chunk_q0 = hist + j * Tq
-    # last REAL row of chunk tile j (tiles fully in the padding get -1,
-    # which skips every superblock)
-    real = jnp.clip(valid - j * Tq, 0, Tq)
-    chunk_last = jnp.where(real > 0, hist + j * Tq + real - 1, -1)
+    # segment-major sub-tiling: tile B + m*nT + j is segment m, slice j
+    m_idx = jnp.repeat(jnp.arange(MP, dtype=jnp.int32), nT)  # [MP*nT]
+    j_idx = jnp.tile(jnp.arange(nT, dtype=jnp.int32), MP)
+    chunk_q0 = hists[m_idx] + j_idx * Tq
+    # last REAL row of each segment tile (tiles fully in the padding —
+    # or of a dead segment — get -1, which skips every superblock)
+    real = jnp.clip(valids[m_idx] - j_idx * Tq, 0, Tq)
+    chunk_last = jnp.where(real > 0, chunk_q0 + real - 1, -1)
+    tile_seq = jnp.concatenate(
+        [jnp.arange(B, dtype=jnp.int32), B + m_idx]
+    )  # [S]: each tile's row in the stacked tables
     tile_q0 = jnp.concatenate([dec_q0, chunk_q0])
     tile_last = jnp.concatenate([dec_q0, chunk_last])
 
     def page_index(p):
-        def index(s, h, i, bt, q0, lastq):
-            seq_row = jnp.minimum(s, B)  # decode tile s<B; chunk tiles -> B
+        def index(s, h, i, sq, bt, q0, lastq):
+            seq_row = sq[s]
             last_pg = jnp.maximum(lastq[s], 0) // bs
             pi = jnp.minimum(jnp.minimum(i * Pp + p, last_pg), M - 1)
             return (h, bt[seq_row, pi], 0, 0)
@@ -276,21 +297,23 @@ def ragged_mixed_attention(
         sk = jnp.broadcast_to(sk[:, :, None], (Hkv, Gp, 128))
         sink_inputs = (sk,)
         sink_specs = (
-            pl.BlockSpec((1, Gp, 128), lambda s, h, i, bt, q0, lq: (h, 0, 0)),
+            pl.BlockSpec(
+                (1, Gp, 128), lambda s, h, i, sq, bt, q0, lq: (h, 0, 0)
+            ),
         )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(S, Hkv, M // Pp),
         in_specs=[
             pl.BlockSpec(
-                (1, Tq * Gp, D), lambda s, h, i, bt, q0, lq: (h, s, 0)
+                (1, Tq * Gp, D), lambda s, h, i, sq, bt, q0, lq: (h, s, 0)
             ),
             *page_spec,
             *page_spec,
             *sink_specs,
         ],
         out_specs=pl.BlockSpec(
-            (1, Tq * Gp, D), lambda s, h, i, bt, q0, lq: (h, s, 0)
+            (1, Tq * Gp, D), lambda s, h, i, sq, bt, q0, lq: (h, s, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((Tq * Gp, 128), jnp.float32),
@@ -317,27 +340,28 @@ def ragged_mixed_attention(
         ),
         interpret=interpret,
     )(
-        tables, tile_q0, tile_last, q_all,
+        tile_seq, tables, tile_q0, tile_last, q_all,
         *([k_cache_layer] * Pp), *([v_cache_layer] * Pp), *sink_inputs,
     )
     out = out.reshape(Hkv, S, Tq, Gp, D)
     o_dec = out[:, :B, 0].transpose(1, 0, 2, 3)  # [B, Hkv, Gp, D]
     o_dec = o_dec[:, :, :G, :].reshape(B, H, D)
-    o_chunk = out[:, B:].transpose(1, 2, 0, 3, 4)  # [nT, Tq, Hkv, Gp, D]
-    o_chunk = o_chunk.reshape(T, Hkv, Gp, D)[:, :, :G, :].reshape(T, H, D)
-    return o_dec, o_chunk
+    o_chunks = out[:, B:].reshape(Hkv, MP, nT, Tq, Gp, D)
+    o_chunks = o_chunks.transpose(1, 2, 3, 0, 4, 5)  # [MP,nT,Tq,Hkv,Gp,D]
+    o_chunks = o_chunks.reshape(MP, T, Hkv, Gp, D)[:, :, :, :G, :]
+    return o_dec, o_chunks.reshape(MP, T, H, D)
 
 
 def ragged_mixed_attention_sharded(
     q_dec: jnp.ndarray,  # [B, H, D], H sharded over tp
-    q_chunk: jnp.ndarray,  # [T, H, D], H sharded over tp
+    q_chunks: jnp.ndarray,  # [MP, T, H, D], H sharded over tp
     k_cache_layer: jnp.ndarray,  # [Hkv, N, bs, D], Hkv sharded over tp
     v_cache_layer: jnp.ndarray,
     d_tables: jnp.ndarray,  # [B, M] replicated
     d_seq_lens: jnp.ndarray,  # [B] replicated
-    p_table: jnp.ndarray,  # [M] replicated
-    p_hist: jnp.ndarray,  # scalar replicated
-    p_valid: jnp.ndarray,  # scalar replicated
+    p_tables: jnp.ndarray,  # [MP, M] replicated
+    p_hists: jnp.ndarray,  # [MP] replicated
+    p_valids: jnp.ndarray,  # [MP] replicated
     scale: float,
     mesh,
     window: int = 0,
@@ -357,20 +381,20 @@ def ragged_mixed_attention_sharded(
 
     in_specs = [
         P(None, "tp", None),  # q_dec
-        P(None, "tp", None),  # q_chunk
+        P(None, None, "tp", None),  # q_chunks
         P("tp", None, None, None),  # k cache layer
         P("tp", None, None, None),  # v cache layer
         P(), P(), P(), P(), P(),  # tables + lengths replicate
     ]
     operands = (
-        q_dec, q_chunk, k_cache_layer, v_cache_layer,
-        d_tables, d_seq_lens, p_table, p_hist, p_valid,
+        q_dec, q_chunks, k_cache_layer, v_cache_layer,
+        d_tables, d_seq_lens, p_tables, p_hists, p_valids,
     )
     if sinks is not None:
         in_specs.append(P("tp"))
         operands += (sinks,)
     return shard_map(
         _local, mesh=mesh, in_specs=tuple(in_specs),
-        out_specs=(P(None, "tp", None), P(None, "tp", None)),
+        out_specs=(P(None, "tp", None), P(None, None, "tp", None)),
         check_vma=False,
     )(*operands)

@@ -22,6 +22,10 @@ python -m dynamo_tpu.analysis dynamo_tpu/ tests/
 echo "==> lint-engine + sanitizer self-tests"
 python -m pytest tests/test_analysis.py -q -p no:cacheprovider
 
+echo "==> compiled-perf shape-bucketing guards (mixed-step program count)"
+python -m pytest tests/test_compiled_perf.py -q -p no:cacheprovider \
+    -k "mixed_step_program_count or streamed_handoff_program_count"
+
 if [[ "${1:-}" != "--fast" ]]; then
     echo "==> sanitizer-strict fast subset (loop-stall + leaked-writer guards live)"
     python -m pytest \

@@ -130,7 +130,7 @@ async def leader() -> None:
     # ---- phase 1c: cancel BEFORE the restore chunk runs ----
     # unreserve(restored=False) must re-pool on the leader (followers
     # still hold their pieces) and the next run must restore cleanly.
-    await churn(500)
+    await churn(16)
     ctx_c = Context(_req(prompt_a))
     ctx_c.context.stop_generating()  # cancelled at admission
     out_c = await collect(engine.generate(ctx_c))
@@ -149,10 +149,10 @@ async def leader() -> None:
     # chunks remain after the restore-bearing first chunk: prime a
     # 16-token stem, evict it, then prefill stem+16 (restore = 3 stem
     # blocks, then 2-3 more chunks at prefill_chunk=8).
-    stem = list(range(800, 816))
+    stem = list(range(440, 456))
     await collect(engine.generate(Context(_req(stem, max_tokens=2))))
-    await churn(700)
-    prompt_b1 = stem + list(range(900, 916))
+    await churn(330)
+    prompt_b1 = stem + list(range(460, 476))
     ctx_b = Context(_req(prompt_b1))
     orig_chunk = engine._run_one_chunk
     state = {"n": 0}
@@ -220,8 +220,20 @@ async def leader() -> None:
 
     # ---- phase 4: speculative verify as a mirrored op ----
     # repetitive prompt -> prompt-lookup proposals -> mirrored verify
-    # (with logprobs, exercising the verify's logprob emission too);
-    # greedy stream must equal the plain single-host engine's.
+    # (with logprobs, exercising the verify's logprob emission too).
+    # Two subtleties this phase originally got wrong (it sat behind the
+    # phase-1b OOB-vocab red and was never reached):
+    #   * pipelining is held off for the phase — the pipelined probe
+    #     sees a tail one window stale, and this pool-bounded 24-token
+    #     stream is too short for the stale probe to catch the
+    #     repetition (the engine's spec-hot unchain handles persistent
+    #     repetition, but not one this brief);
+    #   * the reference runs WITH speculation on a single-host engine:
+    #     the verify forward's reassociated reductions may flip exact
+    #     near-ties vs plain decode (the standing spec-decode
+    #     contract), so spec-on vs spec-off equality is not the
+    #     invariant — mirrored-spec == single-host-spec is.
+    engine.cfg.decode_pipeline = False
     rep_prompt = [11, 12, 13, 14] * 6
     spec_req = PreprocessedRequest(
         token_ids=list(rep_prompt),
@@ -233,16 +245,22 @@ async def leader() -> None:
     out4 = await collect(engine.generate(Context(spec_req)))
     toks4 = [t for o in out4 for t in o.token_ids]
     ents4 = [e for o in out4 for e in (o.logprobs or [])]
-    ref4 = await collect(local.generate(Context(PreprocessedRequest(
+    local_spec_cfg = local_cfg()
+    local_spec_cfg.spec_gamma = 3
+    local_spec_cfg.decode_window = 4
+    local_spec = JaxEngine(local_spec_cfg, seed=0)
+    ref4 = await collect(local_spec.generate(Context(PreprocessedRequest(
         token_ids=list(rep_prompt),
         stop_conditions=StopConditions(max_tokens=24),
         sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
         eos_token_ids=[511],
     ))))
     ref4_toks = [t for o in ref4 for t in o.token_ids]
+    assert local_spec.stats["spec_accepted"] > 0, local_spec.stats
     assert toks4 == ref4_toks, (toks4, ref4_toks)
     assert len(ents4) == len(toks4)
     assert engine.stats["spec_accepted"] > base_acc, engine.stats
+    await local_spec.close()
     print("phase4 mirrored spec decode ok", flush=True)
 
     await local.close()

@@ -92,6 +92,16 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     # path's many small ops hardest, so the contract only pins the
     # direction: streaming must strictly reduce exposed transfer
     assert dg["exposed_p50_frac_of_bulk"] < 1.0, dg
+    # head-of-line packing must be recorded (ISSUE 9): K short prompts
+    # behind one long prefill — multi-segment packing must strictly
+    # improve short-prompt TTFT p99 over single-segment (direction
+    # only; the tight ratio belongs to the solo bench artifact)
+    hol = result.get("bench_prefill_hol")
+    assert hol, result.get("bench_prefill_hol_error", "metric missing")
+    for side in ("single_segment", "multi_segment"):
+        assert hol[side]["short_ttft_ms"]["n"] == hol["short_prompts"], hol
+        assert hol[side]["decode_itl_p99_ms"] > 0, hol
+    assert hol["short_ttft_p99_speedup"] > 1.0, hol
 
 
 def test_smoke_regression_band_catches_r03_drop():

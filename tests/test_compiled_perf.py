@@ -193,49 +193,64 @@ def test_cpu_compiled_executable_aliases_both_caches():
 
 def test_mixed_step_program_count_bounded():
     """Shape-bucketing guard for the fused mixed prefill+decode step
-    (ISSUE 3): across every reachable (decode-batch x prefill-bucket)
-    dispatch shape, the number of distinct XLA programs must equal the
-    number of prefill buckets — the decode batch is ALWAYS padded to
-    max_batch_size and lengths/positions/histories are traced values, so
-    nothing else may key a recompile. A regression here (e.g. an
-    accidentally-static chunk length) multiplies warmup/compile time by
+    (ISSUEs 3 + 9): across every reachable (decode-batch x
+    segment-count-bucket x prefill-bucket) dispatch shape, the number
+    of distinct XLA programs must equal segment-count buckets x prefill
+    buckets — the decode batch is ALWAYS padded to max_batch_size and
+    lengths/positions/histories/valids are traced values, so nothing
+    else (in particular NOT the live segment-length mixture) may key a
+    recompile. A regression here (e.g. an accidentally-static chunk
+    length, or per-mixture shapes) multiplies warmup/compile time by
     the request mix and injects 20-40s XLA stalls mid-serving."""
     cfg = ModelConfig.tiny(dtype="float32")
     M = CTX // BLOCK
-    num_blocks = (B + 1) * M + 1
+    MP_MAX = 2
+    num_blocks = (B + MP_MAX) * M + 1
     params = llama.init_params(cfg, jax.random.key(0))
     k_cache, v_cache = llama.init_kv_cache(cfg, num_blocks, BLOCK)
     d_tables = jnp.asarray(
         np.arange(1, B * M + 1, dtype=np.int32).reshape(B, M)
     )
-    p_table = jnp.asarray(
-        np.arange(B * M + 1, (B + 1) * M + 1, dtype=np.int32)
+    p_tables = jnp.asarray(
+        np.arange(B * M + 1, (B + MP_MAX) * M + 1, dtype=np.int32)
+        .reshape(MP_MAX, M)
     )
-    buckets = (16, 32, 64)
+    seg_buckets = (1, 2)
+    buckets = (16, 32)
     base = llama.mixed_step._cache_size()
-    for T in buckets:
-        # two dispatches per bucket with DIFFERENT traced values (active
-        # rows, lengths, chunk fill) — only the bucket may recompile
-        for sl, hist, valid in ((11, 0, T - 3), (7, T // 2, 2)):
-            out = llama.mixed_step(
-                params, cfg,
-                jnp.zeros(B, jnp.int32),
-                jnp.full((B,), sl - 1, jnp.int32),
-                d_tables,
-                jnp.full((B,), sl, jnp.int32),
-                jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
-                jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
-                jnp.ones(B, jnp.float32),
-                jnp.zeros(T, jnp.int32), p_table,
-                jnp.int32(hist), jnp.int32(valid),
-                k_cache, v_cache,
-                use_pallas=False,
+    for MP in seg_buckets:
+        for T in buckets:
+            # two dispatches per bucket pair with DIFFERENT traced
+            # values (active rows, lengths, per-segment fill/history,
+            # dead pad segments) — only the bucket pair may recompile
+            variants = (
+                (11, (0,) * MP, (T - 3,) + (2,) * (MP - 1)),
+                (7, (T // 2,) * MP, (2,) + (0,) * (MP - 1)),
             )
-            _, _, k_cache, v_cache = out[:4]
+            for sl, hists, valids in variants:
+                out = llama.mixed_step(
+                    params, cfg,
+                    jnp.zeros(B, jnp.int32),
+                    jnp.full((B,), sl - 1, jnp.int32),
+                    d_tables,
+                    jnp.full((B,), sl, jnp.int32),
+                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                    jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                    jnp.ones(B, jnp.float32),
+                    jnp.zeros((MP, T), jnp.int32), p_tables[:MP],
+                    jnp.asarray(hists, jnp.int32),
+                    jnp.asarray(valids, jnp.int32),
+                    k_cache, v_cache,
+                    use_pallas=False,
+                )
+                _, _, k_cache, v_cache = out[:4]
     grown = llama.mixed_step._cache_size() - base
-    assert grown == len(buckets), (
-        f"mixed_step compiled {grown} programs for {len(buckets)} prefill "
-        "buckets — a traced value leaked into the static shape key"
+    limit = len(seg_buckets) * len(buckets)
+    assert grown == limit, (
+        f"mixed_step compiled {grown} programs for {len(seg_buckets)} "
+        f"segment-count buckets x {len(buckets)} prefill buckets "
+        f"(expected {limit}) — a traced value leaked into the static "
+        "shape key"
     )
 
 
@@ -246,14 +261,16 @@ def test_mixed_step_tpu_lowering_uses_ragged_kernel():
     fusion's scheduling without its single-kernel attention."""
     cfg = ModelConfig.tiny(dtype="bfloat16", head_dim=128)
     M = CTX // BLOCK
-    num_blocks = (B + 1) * M + 1
+    MP = 2  # a multi-segment pack must still lower the ONE ragged kernel
+    num_blocks = (B + MP) * M + 1
     params = llama.init_params(cfg, jax.random.key(0))
     k_cache, v_cache = llama.init_kv_cache(cfg, num_blocks, BLOCK)
     d_tables = jnp.asarray(
         np.arange(1, B * M + 1, dtype=np.int32).reshape(B, M)
     )
-    p_table = jnp.asarray(
-        np.arange(B * M + 1, (B + 1) * M + 1, dtype=np.int32)
+    p_tables = jnp.asarray(
+        np.arange(B * M + 1, (B + MP) * M + 1, dtype=np.int32)
+        .reshape(MP, M)
     )
     T = 32
     exp = jexport.export(llama.mixed_step, platforms=["tpu"])(
@@ -263,7 +280,8 @@ def test_mixed_step_tpu_lowering_uses_ragged_kernel():
         jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
         jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
         jnp.ones(B, jnp.float32),
-        jnp.zeros(T, jnp.int32), p_table, jnp.int32(0), jnp.int32(T),
+        jnp.zeros((MP, T), jnp.int32), p_tables,
+        jnp.zeros(MP, jnp.int32), jnp.full((MP,), T, jnp.int32),
         k_cache, v_cache, use_pallas=True,
     )
     text = exp.mlir_module()

@@ -820,3 +820,68 @@ def test_pipelined_decode_survives_idle_transitions(run):
         await eng.close()
 
     run(main())
+
+
+def test_out_of_vocab_prompt_rejected(run):
+    """Out-of-vocab token ids must be rejected loudly: their embedding
+    gather is IMPLEMENTATION-DEFINED (XLA clamps on one device, a
+    multi-process sharded mesh lands OOB rows differently), so the same
+    request can legally produce different streams on different meshes —
+    the test_multihost_compose "cancel-after-restore token mismatch"
+    was exactly this, OOB prompt ids masquerading as an engine bug."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=32, block_size=4,
+            max_batch_size=2, max_context=64,
+        )
+        eng = JaxEngine(cfg, seed=0)
+        V = cfg.model.vocab_size
+        for bad in ([1, 2, V], [1, -1, 2], [V + 100] * 8):
+            out = await collect(eng.generate(Context(PreprocessedRequest(
+                token_ids=bad,
+                stop_conditions=StopConditions(max_tokens=2),
+                sampling_options=SamplingOptions(temperature=0.0),
+                eos_token_ids=[],
+            ))))
+            assert out[-1].finish_reason == FinishReason.ERROR
+            assert "out of range" in (out[-1].text or "")
+        # in-vocab boundary ids still serve
+        ok = await collect(eng.generate(Context(PreprocessedRequest(
+            token_ids=[0, V - 1, 1],
+            stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        assert sum(len(o.token_ids) for o in ok) == 2
+        await eng.close()
+
+    run(main())
+
+
+def test_spec_engages_under_pipelining(run):
+    """Pipelined decode must not starve speculation forever: the stale
+    probe lags the tail by one window, so a stale hit whose fresh
+    re-probe misses must dispatch ONE unchained window (next probe sees
+    a fresh tail) instead of re-entering the pipeline — before this, a
+    spec_gamma + decode_pipeline engine never accepted a single token
+    on persistently repetitive streams."""
+
+    async def main():
+        cfg = EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=64, block_size=4,
+            max_batch_size=2, max_context=256, prefill_chunk=8,
+            spec_gamma=3, decode_pipeline=True, decode_window=4,
+        )
+        eng = JaxEngine(cfg, seed=0)
+        out = await collect(eng.generate(Context(PreprocessedRequest(
+            token_ids=[11, 12, 13, 14] * 6,
+            stop_conditions=StopConditions(max_tokens=96, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            eos_token_ids=[],
+        ))))
+        assert sum(len(o.token_ids) for o in out) == 96
+        assert eng.stats["spec_accepted"] > 0, eng.stats
+        await eng.close()
+
+    run(main())

@@ -67,12 +67,19 @@ def test_rearrange_for_decode_never_expands():
     assert y.shape[1] == 4
 
 
-def test_disagg_delivery_applies_regroup(run):
+import pytest
+
+
+@pytest.mark.parametrize("streamed", [True, False])
+def test_disagg_delivery_applies_regroup(run, streamed):
     """A tp=2 prefill engine whose gathered KV arrives in *interleaved*
     head order (simulated by permuting the gather output, since the native
     engine stores heads naturally) feeding a blocked decode engine: the
     delivery-side regroup must undo the permutation, giving greedy tokens
-    identical to an all-local run."""
+    identical to an all-local run — on BOTH wire flavors. The streamed
+    path regroups each segment on arrival in the scatter sink (ISSUE 9:
+    mismatched peers stream too, no more buffered-bulk downgrade); the
+    bulk path keeps the delivery-time full-stack regroup."""
 
     from dynamo_tpu.disagg import (
         ConditionalDisaggRouter, DisaggConfig, DisaggEngine, LocalKvPipe,
@@ -107,9 +114,7 @@ def test_disagg_delivery_applies_regroup(run):
         # simulate an engine that physically stores heads interleaved:
         # permute what the natural-order gather returns — patched at the
         # GATHER so both the bulk extract and the streamed per-segment
-        # extract ship permuted data (the streamed sink must then
-        # DECLINE on the layout mismatch and fall back to the buffered
-        # bulk-identical delivery this regroup applies to)
+        # extract ship permuted data
         orig_gather = prefill_engine._gather_device
 
         def interleaved_gather(idxs, keep_on_device=False):
@@ -135,15 +140,27 @@ def test_disagg_delivery_applies_regroup(run):
         pipe = LocalKvPipe()
         queue = PrefillQueue(drt.bus, "t")
         worker = PrefillWorker(
-            prefill_engine, queue, local_pipe=pipe, head_layout="interleaved"
+            prefill_engine, queue, local_pipe=pipe,
+            head_layout="interleaved", kv_stream=streamed,
         )
         worker.start()
-        disagg = DisaggEngine(decode_engine, router, queue, pipe)
+        disagg = DisaggEngine(
+            decode_engine, router, queue, pipe, kv_stream=streamed
+        )
 
         prompt = list(range(40, 72))  # 32 tokens > threshold -> remote
         out = await collect(disagg.generate(Context(make_req(prompt))))
         toks = [t for o in out for t in o.token_ids]
         assert disagg.stats["remote_prefills"] == 1
+        if streamed:
+            # the mismatch must no longer downgrade to buffered bulk:
+            # segments landed incrementally, each regrouped on arrival
+            assert disagg.stats["streamed_deliveries"] == 1
+            assert disagg.stats["kv_stream_regroups"] >= 1
+            assert disagg.stats["kv_stream_segments"] >= 1
+        else:
+            assert disagg.stats["bulk_deliveries"] == 1
+            assert disagg.stats["kv_stream_regroups"] == 0
 
         # reference: same request served fully locally on a fresh engine
         local_engine = JaxEngine(

@@ -223,6 +223,197 @@ def test_mixed_step_with_penalties_exact(run):
     run(main())
 
 
+# ---------------- multi-prompt packing (ISSUE 9: M prefill segments) -------
+
+
+async def _multi_prefill_workload(engine, n_prompts, *, dec_kw=None,
+                                  long_mt=3):
+    """A decode stream running while M multi-chunk prompts prefill
+    CONCURRENTLY — the head-of-line mixture the multi-segment packer
+    splits the token budget across. Returns (decode stream, [prompt
+    streams] in submission order)."""
+    dec = _req(range(10, 18), 20, ignore_eos=True, **(dec_kw or {}))
+    t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+    while engine.stats["decode_steps"] == 0:
+        await asyncio.sleep(0.005)
+    longs = [
+        _req(range(200 + 60 * i, 248 + 60 * i), long_mt, temperature=0.8,
+             seed=7 + i, ignore_eos=True)
+        for i in range(n_prompts)
+    ]
+    long_outs = await asyncio.gather(
+        *[collect(engine.generate(Context(lg))) for lg in longs]
+    )
+    dec_out = await t
+    return dec_out, long_outs
+
+
+@pytest.mark.parametrize("family", list(FAMILIES))
+@pytest.mark.parametrize("n_prompts", [3])
+def test_multi_prefill_pack_exact_vs_alternating(run, family, n_prompts):
+    """M concurrent prompts packing into fused steps must produce
+    bit-identical token streams AND logprob entries to the alternating
+    baseline (which serializes the prefills entirely), for every model
+    family — and the packer must actually pack (segments > steps)."""
+
+    async def one(mixed):
+        engine = JaxEngine(
+            _engine_cfg(FAMILIES[family](), mixed, num_blocks=192,
+                        max_batch_size=4 + n_prompts),
+            seed=0,
+        )
+        dec_out, long_outs = await _multi_prefill_workload(
+            engine, n_prompts, dec_kw={"logprobs": 2}
+        )
+        stats = dict(engine.stats)
+        await engine.close()
+        return (
+            [_stream(dec_out)] + [_stream(o) for o in long_outs], stats,
+        )
+
+    async def main():
+        fused, s_f = await one(True)
+        alt, s_a = await one(False)
+        # the packer actually engaged: multiple segments rode single
+        # fused steps (admission-order budget split)
+        assert s_f["mixed_prefill_segments"] > s_f["mixed_steps"] > 0, s_f
+        assert s_a["mixed_steps"] == 0
+        assert fused == alt, f"{family}: streams diverged under packing"
+
+    run(main())
+
+
+def test_multi_prefill_one_prompt_cancelled_mid_mixture(run):
+    """Cancelling ONE of M packed prompts mid-prefill must drop only it
+    (CANCELLED, its blocks/upload rolled back) while the other prompts
+    and the decode stream finish with exactly the uncancelled-run
+    streams of those survivors."""
+
+    async def one(cancel):
+        engine = JaxEngine(
+            _engine_cfg(ModelConfig.tiny(), True, num_blocks=192,
+                        max_batch_size=6),
+            seed=0,
+        )
+        dec = _req(range(10, 18), 20, ignore_eos=True)
+        t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+        while engine.stats["decode_steps"] == 0:
+            await asyncio.sleep(0.005)
+        ctxs = [
+            Context(_req(range(200 + 60 * i, 248 + 60 * i), 3,
+                         temperature=0.8, seed=7 + i, ignore_eos=True))
+            for i in range(3)
+        ]
+        victim = ctxs[1]
+        if cancel:
+            # cancel prompt 1 once the pack is in flight (its first
+            # chunks have ridden fused steps beside the others)
+            async def cancel_when_packed():
+                while engine.stats["mixed_steps"] == 0:
+                    await asyncio.sleep(0.002)
+                victim.context.stop_generating()
+
+            asyncio.ensure_future(cancel_when_packed())
+        outs = await asyncio.gather(
+            *[collect(engine.generate(c)) for c in ctxs]
+        )
+        dec_out = await t
+        # scheduler fully unwound: no leaked states, and no leaked
+        # block refcounts (the whole pool is re-claimable — reuse-pool
+        # residents are LRU-claimable, a leaked refcount is not)
+        assert not engine._prefill_states
+        assert engine._n_active == 0
+        fresh = engine.allocator.allocate(engine.allocator.num_blocks - 1)
+        assert fresh is not None, "cancelled prompt leaked block refs"
+        engine.allocator.free(fresh)
+        await engine.close()
+        return dec_out, outs
+
+    async def main():
+        dec_c, outs_c = await one(True)
+        dec_u, outs_u = await one(False)
+        assert outs_c[1][-1].finish_reason == FinishReason.CANCELLED
+        # survivors and the decode stream are untouched by the cancel
+        assert _stream(dec_c) == _stream(dec_u)
+        assert _stream(outs_c[0]) == _stream(outs_u[0])
+        assert _stream(outs_c[2]) == _stream(outs_u[2])
+
+    run(main())
+
+
+def test_multi_prefill_midstream_eos_of_decode_row(run):
+    """A decode row sampling its eos while M prompts are packing must
+    end its stream there (EOS, exact prefix) while every packed prompt
+    still completes."""
+
+    async def main():
+        probe = JaxEngine(_engine_cfg(ModelConfig.tiny(), True), seed=0)
+        out = await collect(probe.generate(
+            Context(_req(range(10, 18), 8, ignore_eos=True))
+        ))
+        toks = [t for o in out for t in o.token_ids]
+        await probe.close()
+
+        engine = JaxEngine(
+            _engine_cfg(ModelConfig.tiny(), True, num_blocks=192,
+                        max_batch_size=6),
+            seed=0,
+        )
+        dec = _req(range(10, 18), 24, eos=[toks[2]])
+        t = asyncio.ensure_future(collect(engine.generate(Context(dec))))
+        while engine.stats["decode_steps"] == 0:
+            await asyncio.sleep(0.005)
+        long_outs = await asyncio.gather(*[
+            collect(engine.generate(Context(
+                _req(range(200 + 60 * i, 248 + 60 * i), 2, ignore_eos=True)
+            )))
+            for i in range(2)
+        ])
+        dec_out = await t
+        got = [t for o in dec_out for t in o.token_ids]
+        assert got == toks[:3]
+        assert dec_out[-1].finish_reason == FinishReason.EOS
+        for o in long_outs:
+            assert sum(len(x.token_ids) for x in o) == 2
+        assert engine._n_active == 0
+        await engine.close()
+
+    run(main())
+
+
+def test_multi_prefill_pack_without_decode_batch(run):
+    """A pure prefill burst (nothing decoding) must still pack: M
+    queued prompts advance TOGETHER through prefill-only fused steps
+    instead of serializing whole prompts, with streams bit-identical to
+    the alternating scheduler."""
+
+    async def one(mixed):
+        engine = JaxEngine(
+            _engine_cfg(ModelConfig.tiny(), mixed, num_blocks=192,
+                        max_batch_size=6),
+            seed=0,
+        )
+        longs = [
+            _req(range(200 + 60 * i, 248 + 60 * i), 4, temperature=0.8,
+                 seed=7 + i, ignore_eos=True)
+            for i in range(3)
+        ]
+        outs = await asyncio.gather(
+            *[collect(engine.generate(Context(lg))) for lg in longs]
+        )
+        stats = dict(engine.stats)
+        await engine.close()
+        return [_stream(o) for o in outs], stats
+
+    async def main():
+        fused, s_f = await one(True)
+        alt, s_a = await one(False)
+        assert s_f["mixed_prefill_segments"] > 0, s_f
+        assert fused == alt
+
+    run(main())
+
+
 # ---------------- the ragged kernel itself (interpret mode) ----------------
 
 
@@ -280,10 +471,10 @@ def test_ragged_mixed_kernel_matches_xla(window, with_sinks):
     kc = att.write_chunk_to_cache(kc, k_chunk, p_table, jnp.int32(hist))
     vc = att.write_chunk_to_cache(vc, v_chunk, p_table, jnp.int32(hist))
 
-    o_dec, o_chunk = ragged_mixed_attention(
-        q_dec, q_chunk, kc, vc, d_tables, d_seq_lens, p_table,
-        jnp.int32(hist), jnp.int32(valid), scale, q_tile=8,
-        window=window, sinks=sinks, interpret=True,
+    o_dec, o_chunks = ragged_mixed_attention(
+        q_dec, q_chunk[None], kc, vc, d_tables, d_seq_lens, p_table[None],
+        jnp.asarray([hist], jnp.int32), jnp.asarray([valid], jnp.int32),
+        scale, q_tile=8, window=window, sinks=sinks, interpret=True,
     )
     ref_dec = att.decode_attention_xla(
         q_dec, kc, vc, d_tables, d_seq_lens, scale, window=window,
@@ -297,7 +488,7 @@ def test_ragged_mixed_kernel_matches_xla(window, with_sinks):
         np.asarray(o_dec), np.asarray(ref_dec), rtol=2e-5, atol=2e-5
     )
     np.testing.assert_allclose(
-        np.asarray(o_chunk)[:valid], np.asarray(ref_chunk)[:valid],
+        np.asarray(o_chunks)[0, :valid], np.asarray(ref_chunk)[:valid],
         rtol=2e-5, atol=2e-5,
     )
 
@@ -329,12 +520,15 @@ def test_ragged_mixed_kernel_sharded_tp2_matches_xla():
     mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 1, 1, 1, 2),
                 ("dp", "pp", "sp", "ep", "tp"))
     qd = jax.device_put(q_dec, NamedSharding(mesh, P(None, "tp", None)))
-    qc = jax.device_put(q_chunk, NamedSharding(mesh, P(None, "tp", None)))
+    qc = jax.device_put(
+        q_chunk[None], NamedSharding(mesh, P(None, None, "tp", None))
+    )
     kcs = jax.device_put(kc, NamedSharding(mesh, P("tp", None, None, None)))
     vcs = jax.device_put(vc, NamedSharding(mesh, P("tp", None, None, None)))
-    o_dec, o_chunk = ragged_mixed_attention_sharded(
-        qd, qc, kcs, vcs, d_tables, d_seq_lens, p_table,
-        jnp.int32(hist), jnp.int32(valid), scale, mesh, interpret=True,
+    o_dec, o_chunks = ragged_mixed_attention_sharded(
+        qd, qc, kcs, vcs, d_tables, d_seq_lens, p_table[None],
+        jnp.asarray([hist], jnp.int32), jnp.asarray([valid], jnp.int32),
+        scale, mesh, interpret=True,
     )
     ref_dec = att.decode_attention_xla(
         q_dec, kc, vc, d_tables, d_seq_lens, scale
@@ -347,7 +541,124 @@ def test_ragged_mixed_kernel_sharded_tp2_matches_xla():
         np.asarray(o_dec), np.asarray(ref_dec), rtol=2e-5, atol=2e-5
     )
     np.testing.assert_allclose(
-        np.asarray(o_chunk), np.asarray(ref_chunk), rtol=2e-5, atol=2e-5
+        np.asarray(o_chunks)[0], np.asarray(ref_chunk), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("window", [0, 5])
+@pytest.mark.parametrize("with_sinks", [False, True])
+def test_ragged_mixed_kernel_multi_segment_matches_xla(window, with_sinks):
+    """The generalized kernel with M=2 segments (different histories,
+    different fills) must match the XLA pair per part: decode rows vs
+    decode_attention_xla, EACH segment's real rows vs
+    chunk_attention_with_cache_xla."""
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention,
+    )
+
+    rng = np.random.default_rng(3)
+    B, Hkv, G, D, bs, M = 3, 2, 2, 16, 8, 8
+    MP, T = 2, 16
+    valids, hists = [13, 16], [9, 3]
+    scale = D ** -0.5
+    H = Hkv * G
+    N = (B + MP) * M + 1
+    kc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    pages = rng.permutation(np.arange(1, N)).astype(np.int32)
+    d_tables = jnp.asarray(pages[: B * M].reshape(B, M))
+    p_tables = jnp.asarray(pages[B * M : (B + MP) * M].reshape(MP, M))
+    d_seq_lens = jnp.asarray(
+        [1 + rng.integers(0, M * bs - 1) for _ in range(B)], jnp.int32
+    )
+    q_dec = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    q_chunks = jnp.asarray(rng.standard_normal((MP, T, H, D)), jnp.float32)
+    k_chunks, v_chunks = [], []
+    for m in range(MP):
+        k_m = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+        v_m = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+        kc = att.write_chunk_to_cache(
+            kc, k_m, p_tables[m], jnp.int32(hists[m])
+        )
+        vc = att.write_chunk_to_cache(
+            vc, v_m, p_tables[m], jnp.int32(hists[m])
+        )
+        k_chunks.append(k_m)
+        v_chunks.append(v_m)
+    sinks = (
+        jnp.asarray(rng.standard_normal(H), jnp.float32) if with_sinks
+        else None
+    )
+
+    o_dec, o_chunks = ragged_mixed_attention(
+        q_dec, q_chunks, kc, vc, d_tables, d_seq_lens, p_tables,
+        jnp.asarray(hists, jnp.int32), jnp.asarray(valids, jnp.int32),
+        scale, q_tile=8, window=window, sinks=sinks, interpret=True,
+    )
+    ref_dec = att.decode_attention_xla(
+        q_dec, kc, vc, d_tables, d_seq_lens, scale, window=window,
+        sinks=sinks,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_dec), np.asarray(ref_dec), rtol=2e-5, atol=2e-5
+    )
+    for m in range(MP):
+        ref_chunk = att.chunk_attention_with_cache_xla(
+            q_chunks[m], k_chunks[m], v_chunks[m], kc, vc, p_tables[m],
+            jnp.int32(hists[m]), jnp.int32(valids[m]), scale,
+            window=window, sinks=sinks,
+        )
+        np.testing.assert_allclose(
+            np.asarray(o_chunks[m])[: valids[m]],
+            np.asarray(ref_chunk)[: valids[m]],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_ragged_mixed_kernel_dead_segment_and_inactive_slot_zero():
+    """Dead pad segments (valid 0 — the segment-count bucket filler) and
+    inactive decode slots must emit zeros (every superblock skipped)
+    while live parts stay finite and exact."""
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops.ragged_paged_attention_pallas import (
+        ragged_mixed_attention,
+    )
+
+    rng = np.random.default_rng(7)
+    B, Hkv, G, D, bs, M = 2, 1, 4, 16, 8, 4
+    MP, T = 2, 8
+    N = (B + MP) * M + 1
+    kc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((Hkv, N, bs, D)), jnp.float32)
+    pages = rng.permutation(np.arange(1, N)).astype(np.int32)
+    d_tables = jnp.asarray(pages[: B * M].reshape(B, M))
+    p_tables_np = pages[B * M : (B + MP) * M].reshape(MP, M).copy()
+    p_tables_np[1] = 0  # dead segment: zero table, like the engine pads
+    H = Hkv * G
+    q_dec = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    q_chunks = jnp.asarray(rng.standard_normal((MP, T, H, D)), jnp.float32)
+    k0 = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((T, Hkv, D)), jnp.float32)
+    kc = att.write_chunk_to_cache(kc, k0, jnp.asarray(p_tables_np[0]),
+                                  jnp.int32(0))
+    vc = att.write_chunk_to_cache(vc, v0, jnp.asarray(p_tables_np[0]),
+                                  jnp.int32(0))
+    d_seq_lens = jnp.asarray([5, 0], jnp.int32)  # slot 1 inactive
+    o_dec, o_chunks = ragged_mixed_attention(
+        q_dec, q_chunks, kc, vc, d_tables, d_seq_lens,
+        jnp.asarray(p_tables_np), jnp.asarray([0, 0], jnp.int32),
+        jnp.asarray([8, 0], jnp.int32), D ** -0.5, interpret=True,
+    )
+    assert np.all(np.asarray(o_dec)[1] == 0.0)
+    assert np.all(np.asarray(o_chunks)[1] == 0.0)  # dead segment
+    assert np.all(np.isfinite(np.asarray(o_dec)[0]))
+    ref0 = att.chunk_attention_with_cache_xla(
+        q_chunks[0], k0, v0, kc, vc, jnp.asarray(p_tables_np[0]),
+        jnp.int32(0), jnp.int32(8), D ** -0.5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o_chunks)[0], np.asarray(ref0), rtol=2e-5, atol=2e-5
     )
 
 
@@ -365,8 +676,9 @@ def test_ragged_mixed_kernel_inactive_slots_zero():
     )
     d_seq_lens = jnp.asarray([5, 0], jnp.int32)  # slot 1 inactive
     o_dec, _ = ragged_mixed_attention(
-        q_dec, q_chunk, kc, vc, d_tables, d_seq_lens, p_table,
-        jnp.int32(0), jnp.int32(8), D ** -0.5, interpret=True,
+        q_dec, q_chunk[None], kc, vc, d_tables, d_seq_lens, p_table[None],
+        jnp.asarray([0], jnp.int32), jnp.asarray([8], jnp.int32),
+        D ** -0.5, interpret=True,
     )
     assert np.all(np.asarray(o_dec)[1] == 0.0)
     assert np.all(np.isfinite(np.asarray(o_dec)[0]))

@@ -177,7 +177,7 @@ async def _park_in_host_tier(engine, prompt):
     # prompt (bucket 32) never compiles — without this, both measured
     # paths pay the same one-time XLA compile inside the timed region
     # and the hinted-vs-cold ratio drowns in it
-    await collect(engine.generate(Context(_req(range(900, 912), 2))))
+    await collect(engine.generate(Context(_req(range(450, 462), 2))))
     out = await collect(engine.generate(Context(_req(prompt, 2))))
     toks = [t for o in out for t in o.token_ids]
     for i in range(4):
@@ -273,13 +273,14 @@ def test_cancel_mid_upload_rolls_back(run, monkeypatch):
             prompt_len=len(prompt_a),
         )
         assert engine._begin_prefill(seq)
-        st = engine._prefill_state
-        assert st is not None and st.upload is not None
+        assert engine._prefill_states
+        st = engine._prefill_states[0]
+        assert st.upload is not None
         assert not st.upload.future.done(), "upload should still be in flight"
         # cancel while the h2d is mid-flight
         ctx.context.stop_generating()
         admitted = await engine._prefill_step()
-        assert not admitted and engine._prefill_state is None
+        assert not admitted and not engine._prefill_states
         out = seq.out_queue.get_nowait()
         assert out.finish_reason is not None
 
