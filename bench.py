@@ -1101,6 +1101,246 @@ def _disagg_handoff_stats() -> dict:
     }
 
 
+def _prefix_fleet_stats() -> dict:
+    """bench_prefix_fleet (ISSUE 10 / ROADMAP item 3): TTFT for one
+    shared-prefix request served three ways — cold recompute, LOCAL
+    host/disk-tier restore (router-hinted prefetch), and PEER-tier pull
+    (bus-negotiated fetch answered over real TCP, landed as a normal
+    kv-prefetch restore) — with the token streams asserted bit-exact
+    across all three paths, plus a mid-pull worker-kill phase that must
+    degrade to recompute with zero client-visible errors.
+
+    The workload is the fleet prefix cache's reason to exist: a long
+    shared prefix (system prompt / few-shot block) + a short unique
+    tail. Cold pays the full chunked prefill; the warm paths restore
+    the prefix (promoted through host DRAM from wherever it lives —
+    this worker's disk, or a peer across the wire) and prefill only the
+    tail. Engines share one parameter tree so streams are comparable."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.allocator import sequence_block_hashes
+    from dynamo_tpu.kv_router import KvPeerServer, KvPrefetchListener
+    from dynamo_tpu.kv_router.protocols import (
+        KV_PREFETCH_SUBJECT,
+        KvPrefetchHint,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.resilience import faultpoints
+    from dynamo_tpu.runtime import (
+        Context,
+        DistributedRuntime,
+        LocalBus,
+        LocalStore,
+        collect,
+    )
+
+    import jax as _jax
+
+    # fat enough that a 320-token prefill is real compute (the cold
+    # path's cost), small enough to stay a smoke bench
+    tiny = ModelConfig.tiny(
+        hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=4, num_kv_heads=4, head_dim=64,
+        max_position_embeddings=1024,
+    )
+    params = llama.init_params(tiny, _jax.random.key(5))
+    BS = 16
+    PREFIX, TAIL = 320, 16  # 20 shared blocks + one recomputed tail
+    prefix = [(11 * j) % 480 + 10 for j in range(PREFIX)]
+
+    def cfg(tmp=None, host=0, disk=0):
+        # device pool barely over one request's footprint (23 blocks):
+        # the park churn actually evicts the shared chain into the
+        # offload tiers instead of idling in a roomy reuse pool
+        return EngineConfig(
+            model=tiny, num_blocks=28, block_size=BS, max_batch_size=2,
+            max_context=1024, prefill_chunk=64,
+            host_cache_blocks=host, disk_cache_blocks=disk,
+            disk_cache_path=tmp,
+        )
+
+    def req(toks, max_tokens=8):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    measured = prefix + [(7 * j) % 480 + 10 for j in range(TAIL)]
+    pairs = sequence_block_hashes(measured, BS)[: PREFIX // BS]
+    chain = [s for _l, s in pairs]
+
+    async def warm_short(engine, base):
+        # compiles the bucket-16 prefill the restored-history resume
+        # uses, plus the decode window — outside every timed region
+        await collect(engine.generate(Context(req(range(base, base + 12)))))
+
+    async def serve_ttft(engine, toks):
+        t0 = _time.monotonic()
+        first = None
+        out_toks = []
+        async for o in engine.generate(Context(req(toks))):
+            if first is None and o.token_ids:
+                first = _time.monotonic()
+            out_toks.extend(o.token_ids)
+        return (first - t0) * 1e3, out_toks
+
+    async def park(engine):
+        """Serve prefix+tailA once, churn the chain into the offload
+        tiers, wait until it's fully export-serveable."""
+        other = prefix + [(13 * j) % 480 + 10 for j in range(TAIL)]
+        await collect(engine.generate(Context(req(other))))
+        for i in range(2):
+            filler = [(17 * j + 29 * i) % 480 + 10 for j in range(PREFIX + TAIL)]
+            await collect(engine.generate(Context(req(filler))))
+        for _ in range(500):
+            covered = 0
+            for h in chain:
+                if engine.offload.tier_contains(h):
+                    covered += 1
+                else:
+                    break
+            if covered >= len(chain):
+                return
+            await asyncio.sleep(0.02)
+        raise AssertionError("shared prefix never parked in offload tiers")
+
+    import shutil
+    import tempfile
+
+    async def run():
+        # peer/local source: small host pool + disk so the chain spans
+        # BOTH lower tiers (the export/promote paths cross them)
+        disk_dir = tempfile.mkdtemp(prefix="dynkv-bench-")
+        eng_a = JaxEngine(
+            cfg(disk_dir, host=8, disk=64), params=params,
+        )
+        eng_cold = JaxEngine(cfg(), params=params)
+        eng_peer = JaxEngine(cfg(host=64), params=params)
+        eng_kill = JaxEngine(cfg(host=64), params=params)
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dynamo").component("bench")
+        server = await KvPeerServer(drt, comp, 1, eng_a).start()
+        listener = await KvPrefetchListener(drt, comp, 2, eng_peer).start()
+        kill_listener = await KvPrefetchListener(
+            drt, comp, 3, eng_kill, pull_timeout=2.0
+        ).start()
+        out: dict = {
+            "shared_prefix_tokens": PREFIX,
+            "prompt_tokens": PREFIX + TAIL,
+            "shared_blocks": len(chain),
+        }
+        try:
+            await park(eng_a)  # also warms A's full-prefill buckets
+            for e, base in ((eng_a, 20), (eng_cold, 40), (eng_peer, 60),
+                            (eng_kill, 80)):
+                await warm_short(e, base)
+
+            # cold: full chunked prefill (warm compile via a
+            # same-length, different-content prompt first)
+            warm_full = [(23 * j) % 480 + 10 for j in range(PREFIX + TAIL)]
+            await collect(eng_cold.generate(Context(req(warm_full))))
+            ttft_cold, toks_cold = await serve_ttft(eng_cold, measured)
+
+            # peer tier: bus-negotiated pull from A's host/disk tiers,
+            # landed + promoted BEFORE the request (all pre-TTFT)
+            hint = KvPrefetchHint(
+                2, [[l, s] for l, s in pairs], peer_worker_id=1,
+                peer_blocks=len(pairs),
+            )
+            bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                        hint.to_bytes())
+            for _ in range(500):
+                if listener.blocks_prefetched >= len(chain):
+                    break
+                await asyncio.sleep(0.02)
+            if listener.blocks_prefetched < len(chain):
+                raise AssertionError(
+                    f"peer pull promoted only {listener.blocks_prefetched}"
+                    f"/{len(chain)} blocks"
+                )
+            ttft_peer, toks_peer = await serve_ttft(eng_peer, measured)
+            peer_stats = eng_peer.offload.stats()
+
+            # local tier: the same hinted-prefetch restore, chain
+            # promoted from THIS worker's host/disk tiers (measured
+            # last — the restore consumes A's host entries)
+            await eng_a.prefetch_hint(pairs)
+            ttft_local, toks_local = await serve_ttft(eng_a, measured)
+            a_stats = eng_a.offload.stats()
+
+            # mid-pull worker kill: the peer dies before pushing; the
+            # puller must fall back to a clean full recompute
+            faultpoints.arm("mid_peer_serve", "kill", after=1, times=1)
+            hint_k = KvPrefetchHint(
+                3, [[l, s] for l, s in pairs], peer_worker_id=1,
+                peer_blocks=len(pairs),
+            )
+            bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                        hint_k.to_bytes())
+            for _ in range(500):
+                if kill_listener.peer_pull_failures >= 1:
+                    break
+                await asyncio.sleep(0.02)
+            kill_errors = 0
+            try:
+                _ttft, toks_kill = await serve_ttft(eng_kill, measured)
+            except Exception:  # noqa: BLE001 — a client-visible failure
+                kill_errors = 1
+                toks_kill = None
+
+            out.update({
+                "cold": {"ttft_ms": round(ttft_cold, 3)},
+                "local_host_tier": {
+                    "ttft_ms": round(ttft_local, 3),
+                    "disk_hit_blocks": a_stats["disk_hit_blocks_total"],
+                    "prefetch_hits": a_stats["h2d_prefetch_hits"],
+                    "speedup_vs_cold": round(
+                        ttft_cold / max(ttft_local, 1e-9), 3),
+                },
+                "peer_tier": {
+                    "ttft_ms": round(ttft_peer, 3),
+                    "pulled_blocks": peer_stats["peer_pull_blocks_total"],
+                    "pull_hidden_frac": peer_stats["peer_pull_hidden_frac"],
+                    "speedup_vs_cold": round(
+                        ttft_cold / max(ttft_peer, 1e-9), 3),
+                },
+                "kill": {
+                    "pull_failures": kill_listener.peer_pull_failures,
+                    "kills_fired": len(faultpoints.FAULTS.history),
+                    "client_errors": kill_errors,
+                    "tokens_match": toks_kill == toks_cold,
+                },
+                "tokens_match": (
+                    bool(toks_cold)
+                    and toks_cold == toks_peer == toks_local
+                ),
+            })
+        finally:
+            faultpoints.reset()
+            await listener.close()
+            await kill_listener.close()
+            await server.close()
+            for e in (eng_a, eng_cold, eng_peer, eng_kill):
+                await e.close()
+            await drt.shutdown()
+            shutil.rmtree(disk_dir, ignore_errors=True)
+        return out
+
+    return {"bench_prefix_fleet": asyncio.run(run())}
+
+
 def main() -> None:
     cached = _cached_silicon_result()
     # one failed probe falls back (memoized) — a wedged relay costs one
@@ -1207,6 +1447,10 @@ def main() -> None:
         result.update(_disagg_handoff_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_disagg_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_prefix_fleet_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_prefix_fleet_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -644,7 +644,83 @@ class SwallowedExceptionRule(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 8. span-leak
+# 8. blocking-disk-io
+# ---------------------------------------------------------------------------
+
+
+class BlockingDiskIoRule(Rule):
+    """Filesystem I/O inside ``async def`` bodies of event-loop modules.
+    The disk KV tier (PR 9) put block files one executor hop from the
+    scheduler loop — a stray ``open()`` / ``os.remove`` / ``f.write()``
+    on the loop stalls every token stream for a seek's worth of
+    milliseconds (or a disk-contention eternity), the same bug class as
+    async-blocking-call but for the new tier's I/O surface. Executor
+    dispatch passes: ``run_in_executor(None, store.put, ...)`` hands a
+    *reference*, so only direct calls in the async body fire. Sync
+    helpers (DiskKvStore methods) are where the I/O belongs."""
+
+    name = "blocking-disk-io"
+    summary = "filesystem I/O on the event loop (disk-tier invariant)"
+
+    #: direct calls that always hit the filesystem
+    BLOCKING_DOTTED = {
+        "open": "open() blocks the loop on the filesystem — read/write in "
+                "a sync helper dispatched via run_in_executor",
+        "os.read": "raw fd read on the loop",
+        "os.write": "raw fd write on the loop",
+        "os.fsync": "fsync on the loop can stall for a full disk flush",
+        "os.remove": "unlink on the loop",
+        "os.unlink": "unlink on the loop",
+        "os.rename": "rename on the loop",
+        "os.replace": "rename on the loop",
+        "os.makedirs": "mkdir on the loop",
+        "os.listdir": "directory scan on the loop",
+        "shutil.rmtree": "recursive delete on the loop",
+        "shutil.copyfile": "file copy on the loop",
+    }
+    #: pathlib's read/write conveniences — filesystem hits regardless of
+    #: receiver (no other common type exposes these names)
+    PATH_ATTRS = {"read_bytes", "write_bytes", "read_text", "write_text"}
+    #: file-object methods, gated on a file-shaped receiver name so
+    #: StreamWriter.write / reader.read (non-blocking asyncio) never fire
+    FILE_ATTRS = {"write", "read", "readline", "flush"}
+    _FILEY = ("file", "fp", "fh")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(EVENT_LOOP_PACKAGES)
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in _walk_same_scope(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                why = self.BLOCKING_DOTTED.get(dotted)
+                if why is None and isinstance(sub.func, ast.Attribute):
+                    attr = sub.func.attr
+                    if attr in self.PATH_ATTRS:
+                        why = (f".{attr}() hits the filesystem on the loop "
+                               "— dispatch to an executor")
+                    elif attr in self.FILE_ATTRS:
+                        leaf = _base_source(sub.func).rsplit(".", 1)[-1].lower()
+                        if leaf == "f" or any(t in leaf for t in self._FILEY):
+                            why = (f"file .{attr}() on the loop — file I/O "
+                                   "belongs in a sync helper on the "
+                                   "offload executor")
+                if why is not None:
+                    out.append(Violation(
+                        self.name, relpath, sub.lineno,
+                        f"`{dotted or ast.unparse(sub.func)}` in async "
+                        f"`{node.name}`: {why}",
+                    ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 9. span-leak
 # ---------------------------------------------------------------------------
 
 
@@ -727,5 +803,6 @@ ALL_RULES: tuple[Rule, ...] = (
     WriterWaitClosedRule(),
     FaultpointCoverageRule(),
     SwallowedExceptionRule(),
+    BlockingDiskIoRule(),
     SpanLeakRule(),
 )

@@ -55,6 +55,10 @@ from ..runtime.codec import (
 )
 from ..runtime.tcp import ConnectionInfo
 
+# shared with the disk-tier codec (utils/dtypes.py) so the two
+# serialization planes can't drift on which dtypes round-trip
+from ..utils.dtypes import np_dtype as _np_dtype
+
 logger = logging.getLogger(__name__)
 
 #: streamed-protocol version declared in the stream header. Receivers
@@ -75,24 +79,6 @@ class SinkClosed(Exception):
     remaining segments are drained and discarded, not an error."""
 
 
-_DTYPES = {}
-
-
-def _np_dtype(name: str):
-    """dtype registry incl. bfloat16 (ml_dtypes ships with jax)."""
-    if not _DTYPES:
-        import ml_dtypes
-
-        _DTYPES.update(
-            {
-                "bfloat16": np.dtype(ml_dtypes.bfloat16),
-                "float32": np.dtype(np.float32),
-                "float16": np.dtype(np.float16),
-                "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
-                "int8": np.dtype(np.int8),
-            }
-        )
-    return _DTYPES[name]
 
 
 @dataclass
@@ -118,6 +104,11 @@ class KvDelivery:
     # sink — k_data/v_data are None and the decode side must NOT expect
     # a bulk stack to scatter
     streamed: bool = False
+    # chained seq hashes of the shipped blocks, prompt order (fleet
+    # prefix-cache pulls: the peer may serve a shorter run than asked,
+    # so the puller must know WHICH hashes the stack carries); None on
+    # the disagg handoff, whose block identity is the reservation's
+    hashes: Optional[list] = None
 
 
 class _StreamAssembler:
@@ -359,6 +350,7 @@ class KvTransferServer:
                         head_layout=head.get("head_layout", "blocked"),
                         src_tp=head.get("src_tp", 1),
                         first_lp=head.get("first_lp"),
+                        hashes=head.get("hashes"),
                     )
                 )
         except Exception:  # noqa: BLE001 — receive failed mid-stream: no
@@ -476,8 +468,12 @@ async def send_kv_blocks(
     head_layout: str = "blocked",
     src_tp: int = 1,
     first_lp: Optional[dict] = None,
+    hashes: Optional[list] = None,
 ) -> None:
-    """Prefill-side push of one request's KV (or an error notification)."""
+    """Prefill-side push of one request's KV (or an error notification).
+    ``hashes`` names the shipped blocks' chained seq hashes for
+    content-addressed deliveries (fleet prefix-cache pulls); receivers
+    that don't know the key ignore it (codec forward-compat)."""
     if isinstance(connection, dict):
         connection = ConnectionInfo.from_dict(connection)
     host, port = connection.address.rsplit(":", 1)
@@ -500,6 +496,8 @@ async def send_kv_blocks(
             "src_tp": src_tp,
             "first_lp": first_lp,
         }
+        if hashes is not None:
+            head["hashes"] = list(hashes)
         await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
         if n:
             L = k_data.shape[0]

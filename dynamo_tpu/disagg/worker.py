@@ -466,9 +466,9 @@ class _RemoteScatterSink:
         layout = head.get("head_layout", "blocked")
         src_tp = head.get("src_tp", 1)
         self._regroup = None
-        if layout != my_layout or (
-            layout == "interleaved" and src_tp != my_tp
-        ):
+        from ..ops.kv_rearrange import layout_mismatched
+
+        if layout_mismatched(layout, src_tp, my_layout, my_tp):
             from ..ops.kv_rearrange import rearrange_for_decode
 
             # validate the permutation NOW against both declared head
@@ -658,11 +658,10 @@ class DisaggEngine(AsyncEngine):
         k_data, v_data = delivery.k_data, delivery.v_data
         my_layout = self.engine.cfg.kv_head_layout
         my_tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
-        # interleaved orderings are tp-dependent: same-layout peers with
-        # different tp still need the regroup (ref kv_rearrange, patch:743-810)
-        mismatched = k_data is not None and (
-            delivery.head_layout != my_layout
-            or (delivery.head_layout == "interleaved" and delivery.src_tp != my_tp)
+        from ..ops.kv_rearrange import layout_mismatched
+
+        mismatched = k_data is not None and layout_mismatched(
+            delivery.head_layout, delivery.src_tp, my_layout, my_tp
         )
         if mismatched:
             from ..ops.kv_rearrange import rearrange_for_decode

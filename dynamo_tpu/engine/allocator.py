@@ -94,6 +94,13 @@ class BlockAllocator:
         # fired when a reuse-pool block is about to be repurposed — the
         # offload tier's chance to copy it down (engine/offload.py)
         self.on_evict = on_evict
+        # fired INSTEAD of on_removed when an offload tier takes the
+        # evicted block (set alongside on_evict by the KV-event
+        # publisher): the worker still holds the KV, one tier down, so
+        # the router's radix index must keep counting it as residency —
+        # the true removal arrives later via OffloadManager.on_dropped
+        # when the block leaves the last local tier
+        self.on_demoted: Optional[Callable[[list[int]], None]] = None
 
     # ---- stats ----
     @property
@@ -118,7 +125,10 @@ class BlockAllocator:
             b = self._blocks[idx]
             if self.on_evict:
                 self.on_evict(seq_hash, b)
-            if self.on_removed:
+            if self.on_evict and self.on_demoted:
+                # device -> offload tier: a demotion, not a removal
+                self.on_demoted([seq_hash])
+            elif self.on_removed:
                 self.on_removed([seq_hash])
             b.seq_hash = None
             b.local_hash = None

@@ -135,6 +135,18 @@ class EngineConfig:
     # host-DRAM offload tier capacity in blocks (0 = disabled); evicted
     # device blocks park here and restore on prefix hits (engine/offload.py)
     host_cache_blocks: int = 0
+    # third KV tier: local disk/SSD capacity in blocks (0 = disabled;
+    # requires a host tier — promotion back to device goes THROUGH host
+    # DRAM so the unchanged upload/scatter restore path serves it).
+    # Host-pool LRU overflow demotes here instead of dropping; disk
+    # LRU/TTL overflow is the real drop (offload.DiskKvStore)
+    disk_cache_blocks: int = 0
+    # disk-tier directory (None = a fresh tempdir per engine); a
+    # restarted worker pointed at the same path keeps its disk tier
+    disk_cache_path: Optional[str] = None
+    # disk-tier entry TTL in seconds (0 = LRU only): at fleet scale the
+    # long tail of stale prefixes ages out instead of squatting capacity
+    kv_tier_ttl_s: float = 0.0
     # async offload tier: d2h eviction flushes land via background
     # executor threads (double-buffered, budgeted) and h2d restores
     # upload from the moment admission reserves the chain — the
@@ -246,6 +258,14 @@ class EngineConfig:
             )
         if self.mixed_step_budget == 0:
             self.mixed_step_budget = self.prefill_chunk
+        if self.disk_cache_blocks > 0 and self.host_cache_blocks <= 0:
+            # the disk tier restores THROUGH host DRAM (promotion), so a
+            # disk-only configuration would silently never restore —
+            # fail loudly at construction
+            raise ValueError(
+                "disk_cache_blocks > 0 requires host_cache_blocks > 0 "
+                "(disk restores promote through the host tier)"
+            )
         if self.mixed_max_prefills < 1:
             raise ValueError(
                 f"mixed_max_prefills={self.mixed_max_prefills} must be "
@@ -339,8 +359,15 @@ class JaxEngine(AsyncEngine):
                 cfg.host_cache_blocks, mirror=mirror,
                 flush_budget=cfg.offload_flush_budget,
                 async_tier=cfg.offload_async,
+                disk_blocks=cfg.disk_cache_blocks,
+                disk_path=cfg.disk_cache_path,
+                tier_ttl_s=cfg.kv_tier_ttl_s,
             )
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
+            # tier-drop removals re-check device residency before
+            # publishing (offload.flush_dropped): a stale lower-tier
+            # copy aging out must not un-index a device-resident block
+            self.offload.device_has = self.allocator.has_hash
         # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
         # run the kernel under shard_map over tp (head-parallel, no
         # collectives) when tp divides the kv heads; otherwise the XLA
@@ -646,6 +673,10 @@ class JaxEngine(AsyncEngine):
         """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
         out = {}
         if self.offload is not None:
+            # piggyback the (loop-side) stats scrape to publish queued
+            # tier-drop removals: blocks that left the LAST local tier
+            # must stop counting as this worker's radix residency
+            self.offload.flush_dropped()
             out.update(self.offload.stats())
         # runtime-sanitizer counters (analysis/sanitizer.py): zeros when
         # no sanitizer has ever been active in this process; under
@@ -1016,14 +1047,19 @@ class JaxEngine(AsyncEngine):
         if self.offload is not None and matched:
             # blocks that reached the device tier via a router prefetch
             # hint and are now claimed: the hint saved this request a
-            # cold host restore (or a full recompute)
+            # cold host restore (or a full recompute). The claimed
+            # hashes ride along so peer-pulled blocks count toward
+            # peer_pull_hidden_frac (their cross-worker transfer was
+            # fully hidden from this request)
             n_pf = 0
+            pf_hashes = []
             for b in matched:
                 if b.prefetched:
                     b.prefetched = False
                     n_pf += 1
+                    pf_hashes.append(b.seq_hash)
             if n_pf:
-                self.offload.note_prefetch_hits(n_pf)
+                self.offload.note_prefetch_hits(n_pf, hashes=pf_hashes)
         # host-tier probe: continuation of the chain past the device match
         # (ref docs/kv_cache_manager.md host offload); reserving takes the
         # blocks out of the pool so they can't be LRU'd before restore
@@ -1489,13 +1525,15 @@ class JaxEngine(AsyncEngine):
     async def _offload_prejoin(self, hashes: list[int]) -> None:
         """Before an event-loop host-tier probe: dispatch any pending
         eviction gathers (budget-deferred entries are otherwise invisible
-        to admission — neither in the pool nor in flight) and wait
-        OFF-LOOP for in-flight flushes holding ``hashes``, so the probe
+        to admission — neither in the pool nor in flight), wait
+        OFF-LOOP for in-flight flushes holding ``hashes``, and promote
+        any disk-tier continuation into the host pool — so the probe
         sees every landed block without the event loop ever blocking on
-        a d2h fetch."""
+        a d2h fetch or a file read."""
         off = self.offload
         if off is None or not off.async_tier or not hashes:
             return
+        off.flush_dropped()
         loop = asyncio.get_running_loop()
         if off.has_pending():
             # under the device lock: dispatch order across threads stays
@@ -1508,6 +1546,26 @@ class JaxEngine(AsyncEngine):
                 )
         if off.has_inflight_flushes():
             await loop.run_in_executor(None, off._join_flushes_for, hashes)
+        if off.disk is not None:
+            # disk -> host promotion off-loop; cheap when the disk index
+            # has no continuation for this chain (index-only probe first)
+            await loop.run_in_executor(None, off.promote_chain, hashes)
+
+    def chain_coverage(self, chain: list[int]) -> int:
+        """Longest prefix of chained hashes resident in ANY local tier
+        (device radix, host pool, or disk index) — index-only probes, no
+        data reads. The peer-pull path sizes its remote fetch from this:
+        only the continuation PAST local coverage is worth wire time."""
+        n = 0
+        for h in chain:
+            if self.allocator.has_hash(h):
+                n += 1
+                continue
+            if self.offload is not None and self.offload.tier_contains(h):
+                n += 1
+                continue
+            break
+        return n
 
     async def prefetch_hint(self, blocks: list) -> int:
         """Router-hinted host-tier prefetch (PRESERVE-style): ``blocks``

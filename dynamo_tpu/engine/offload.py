@@ -35,22 +35,57 @@ pipelined so the single scheduler loop never blocks on PCIe:
     landed. The wait actually paid is tracked as *exposed* restore
     latency vs. the *hidden* remainder (``restore_latency_hidden_frac``).
 
+**Disk tier (third tier).** With ``disk_blocks > 0`` the host pool's LRU
+overflow *demotes* to an on-disk block store (:class:`DiskKvStore`, one
+content-addressed file per block: small validated header + raw ``k``/``v``
+bytes) instead of dropping, and restores *promote* back through host DRAM
+— :meth:`OffloadManager.promote_chain` reads disk hits into a host-DRAM
+staging area (``_staged``, exempt from the pool's LRU capacity so chains
+longer than the host budget restore whole) on the offload executor, after
+which ``reserve_chain``/``begin_upload``/``finish_upload`` (and their
+hidden-vs-exposed accounting) work unchanged.
+Eviction story per tier: device LRU → host, host LRU → disk, disk
+LRU/TTL → dropped. All disk I/O runs on the offload executor (or a
+sync backstop off the event loop) — the ``blocking-disk-io`` dynlint
+rule keeps the loop itself filesystem-free.
+
+**Fleet tier (peer prefix pulls).** Dropping a block from the *last*
+local tier is the only true removal: the manager queues the hash
+(:meth:`flush_dropped` → ``on_dropped``) so the KV-event publisher can
+tell the router, which otherwise keeps counting demoted blocks as this
+worker's radix residency — that residency is what lets a *peer* worker
+pull the chain from here (:meth:`export_chain` serves host∪disk blocks
+non-destructively; :meth:`land_peer_chain` parks a pulled chain in the
+host staging area, where the normal prefetch restore promotes it to
+device).
+
 Under the multi-host mirror every transfer stays a synchronous mirrored
-op (leader/follower lockstep leaves no room for background landing).
+op (leader/follower lockstep leaves no room for background landing) and
+the disk/fleet tiers are disabled.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import shutil
+import struct
+import tempfile
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# shared with the wire codec (disagg/transfer.py) so the two
+# serialization planes can't drift on which dtypes round-trip
+from ..utils.dtypes import np_dtype as _resolve_dtype
 
 logger = logging.getLogger(__name__)
 
@@ -128,15 +163,226 @@ _scatter_blocks = jax.jit(
 )
 
 
+class DiskKvStore:
+    """Third KV tier: content-addressed on-disk block store.
+
+    One file per block (``<seq_hash:016x>.kvb``): a small validated
+    header (magic, format version, shapes, dtype, payload CRC) followed
+    by the raw ``k`` then ``v`` bytes. Crash safety by construction:
+    writes land in a temp file and ``os.replace`` into place (a crash
+    mid-write leaves no entry), and every read re-validates magic /
+    version / declared sizes / CRC — a truncated, corrupt or
+    version-mismatched entry is a clean cache miss (discarded, counted
+    in ``corrupt_discards``), never an exception on the restore path.
+
+    Capacity is LRU over an in-memory index rebuilt from the directory
+    at construction (so a restarted worker keeps its disk tier);
+    ``ttl_s > 0`` additionally expires entries by residency age. Every
+    hash that leaves the store (LRU, TTL, corruption) is queued in
+    ``drain_dropped`` so the owner can publish the residency loss.
+
+    All methods do blocking filesystem I/O — callers must be on the
+    offload executor (or an explicitly-off-loop backstop), never the
+    serving event loop (the ``blocking-disk-io`` dynlint rule).
+    """
+
+    MAGIC = b"DKV1"
+    VERSION = 1
+
+    def __init__(self, path: str, capacity_blocks: int, ttl_s: float = 0.0):
+        self.path = path
+        self.capacity = capacity_blocks
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # seq_hash -> stored_at (monotonic); OrderedDict = LRU order
+        self._index: OrderedDict[int, float] = OrderedDict()
+        self._dropped: list[int] = []
+        self.stored_total = 0
+        self.hit_blocks_total = 0
+        self.corrupt_discards = 0
+        self.evictions_total = 0
+        os.makedirs(path, exist_ok=True)
+        now = time.monotonic()
+        for name in sorted(os.listdir(path)):
+            if not name.endswith(".kvb"):
+                continue  # temp files from a crashed writer, etc.
+            try:
+                self._index[int(name[:-4], 16)] = now
+            except ValueError:
+                continue
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._index
+
+    def _file(self, seq_hash: int) -> str:
+        return os.path.join(self.path, f"{seq_hash:016x}.kvb")
+
+    def _discard_locked(self, seq_hash: int, corrupt: bool = False) -> None:
+        self._index.pop(seq_hash, None)
+        self._dropped.append(seq_hash)
+        if corrupt:
+            self.corrupt_discards += 1
+        else:
+            self.evictions_total += 1
+        try:
+            os.remove(self._file(seq_hash))
+        except OSError:
+            pass
+
+    def _sweep_ttl_locked(self) -> None:
+        if self.ttl_s <= 0:
+            return
+        cutoff = time.monotonic() - self.ttl_s
+        expired = [h for h, t in self._index.items() if t < cutoff]
+        for h in expired:
+            self._discard_locked(h)
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+        """Demote one block to disk; returns whether it is resident
+        afterwards (False = capacity 0 or the write failed)."""
+        if self.capacity <= 0:
+            return False
+        with self._lock:
+            self._sweep_ttl_locked()
+            if seq_hash in self._index:
+                self._index.move_to_end(seq_hash)
+                return True
+        k_bytes = np.ascontiguousarray(k).tobytes()
+        v_bytes = np.ascontiguousarray(v).tobytes()
+        header = json.dumps({
+            "v": self.VERSION,
+            "hash": seq_hash,
+            "k_shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "dtype": str(k.dtype),
+            "k_bytes": len(k_bytes),
+            "v_bytes": len(v_bytes),
+            "crc": zlib.crc32(v_bytes, zlib.crc32(k_bytes)),
+        }).encode()
+        final = self._file(seq_hash)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(self.MAGIC)
+                    f.write(struct.pack("<I", len(header)))
+                    f.write(header)
+                    f.write(k_bytes)
+                    f.write(v_bytes)
+                os.replace(tmp, final)  # atomic: no half-written entries
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            logger.warning("disk tier write failed for %x (block dropped)",
+                           seq_hash, exc_info=True)
+            return False
+        with self._lock:
+            self._index[seq_hash] = time.monotonic()
+            self._index.move_to_end(seq_hash)
+            self.stored_total += 1
+            while len(self._index) > self.capacity:
+                old, _t = next(iter(self._index.items()))
+                self._discard_locked(old)
+        return True
+
+    def get(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Read + validate one block; any validation failure discards
+        the entry and reads as a miss (None)."""
+        with self._lock:
+            self._sweep_ttl_locked()
+            if seq_hash not in self._index:
+                return None
+            self._index.move_to_end(seq_hash)
+        try:
+            with open(self._file(seq_hash), "rb") as f:
+                raw = f.read()
+        except OSError:
+            with self._lock:
+                self._discard_locked(seq_hash, corrupt=True)
+            return None
+        got = self._decode(seq_hash, raw)
+        if got is None:
+            with self._lock:
+                self._discard_locked(seq_hash, corrupt=True)
+            return None
+        with self._lock:
+            self.hit_blocks_total += 1
+        return got
+
+    def _decode(
+        self, seq_hash: int, raw: bytes
+    ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        try:
+            if raw[:4] != self.MAGIC:
+                return None
+            (hlen,) = struct.unpack("<I", raw[4:8])
+            head = json.loads(raw[8 : 8 + hlen])
+            if head.get("v") != self.VERSION or head.get("hash") != seq_hash:
+                return None
+            nk, nv = int(head["k_bytes"]), int(head["v_bytes"])
+            payload = raw[8 + hlen :]
+            if len(payload) != nk + nv:
+                return None  # truncated (or padded) payload
+            if zlib.crc32(payload) != head.get("crc"):
+                return None
+            dt = _resolve_dtype(head["dtype"])
+            k = np.frombuffer(payload, dt, nk // dt.itemsize).reshape(
+                head["k_shape"]
+            )
+            v = np.frombuffer(
+                payload, dt, nv // dt.itemsize, offset=nk
+            ).reshape(head["v_shape"])
+            return k, v
+        except Exception:  # noqa: BLE001 — any malformed entry = miss
+            logger.debug("disk tier entry %x malformed", seq_hash,
+                         exc_info=True)
+            return None
+
+    def match_chain(self, seq_hashes: list[int]) -> int:
+        """Longest consecutive run resident in the index (index-only —
+        cheap enough for the event loop; the data reads stay on the
+        executor)."""
+        with self._lock:
+            self._sweep_ttl_locked()
+            n = 0
+            for h in seq_hashes:
+                if h not in self._index:
+                    break
+                n += 1
+            return n
+
+    def drain_dropped(self) -> list[int]:
+        with self._lock:
+            dropped, self._dropped = self._dropped, []
+            return dropped
+
+
 class HostKvPool:
     """LRU pool of offloaded blocks: seq_hash -> (k, v) host arrays of
-    shape [L, Hkv, bs, D] (ref kv/reuse.rs AvailableBlocks, one tier up)."""
+    shape [L, Hkv, bs, D] (ref kv/reuse.rs AvailableBlocks, one tier up).
+
+    ``on_overflow(hash, k, v) -> bool`` (when set) is offered every LRU
+    overflow victim — True means a lower tier kept it (demotion, not a
+    drop); ``on_drop(hash)`` fires for entries that truly left this
+    worker's tiers. :meth:`apply_plan` bypasses both (the mirror path
+    accounts for its plan's drops explicitly)."""
 
     def __init__(self, capacity_blocks: int):
         self.capacity = capacity_blocks
         self._data: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
         self.stored_total = 0
         self.hit_blocks_total = 0
+        self.on_overflow: Optional[Callable] = None
+        self.on_drop: Optional[Callable] = None
 
     def __len__(self) -> int:
         return len(self._data)
@@ -151,7 +397,12 @@ class HostKvPool:
             self._data.move_to_end(seq_hash)
             return
         while len(self._data) >= self.capacity:
-            self._data.popitem(last=False)
+            old_h, (old_k, old_v) = self._data.popitem(last=False)
+            kept = bool(
+                self.on_overflow and self.on_overflow(old_h, old_k, old_v)
+            )
+            if not kept and self.on_drop:
+                self.on_drop(old_h)
         self._data[seq_hash] = (k, v)
 
     def take(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -268,7 +519,9 @@ class OffloadManager:
     """
 
     def __init__(self, host_blocks: int, mirror=None,
-                 flush_budget: int = 64, async_tier: bool = True):
+                 flush_budget: int = 64, async_tier: bool = True,
+                 disk_blocks: int = 0, disk_path: Optional[str] = None,
+                 tier_ttl_s: float = 0.0):
         self.pool = HostKvPool(host_blocks)
         # (seq_hash, device_block_idx) evictions awaiting d2h
         self._pending: list[tuple[int, int]] = []
@@ -290,6 +543,57 @@ class OffloadManager:
         self.h2d_uploads_cancelled = 0
         self.restore_hidden_s = 0.0
         self.restore_exposed_s = 0.0
+        # third tier (local disk/SSD): host-pool LRU overflow demotes
+        # here via the offload executor; restores promote back through
+        # host DRAM (promote_chain). Mirror engines keep two tiers —
+        # lockstep broadcasts have no background thread to write on.
+        self.disk: Optional[DiskKvStore] = None
+        self.disk_demotions_total = 0
+        # auto-created tempdirs are OURS to remove at close(); an
+        # explicit disk_path persists across restarts by design
+        self._own_disk_path: Optional[str] = None
+        if disk_blocks > 0 and mirror is None:
+            if disk_path is None:
+                disk_path = tempfile.mkdtemp(prefix="dynkv-")
+                self._own_disk_path = disk_path
+            self.disk = DiskKvStore(disk_path, disk_blocks, ttl_s=tier_ttl_s)
+            self.pool.on_overflow = self._demote_to_disk
+        self.pool.on_drop = self._note_dropped_one
+        # fleet tier: hashes that left the LAST local tier, queued for
+        # the KV-event publisher (flush_dropped runs on the event loop —
+        # the callback publishes on the bus, which is not thread-safe
+        # from the executor threads most drops originate on)
+        self.on_dropped: Optional[Callable[[list[int]], None]] = None
+        self._dropped_pending: list[int] = []
+        # device-tier residency probe (engine wires allocator.has_hash):
+        # a queued drop is only PUBLISHED as a removal if the hash is
+        # resident in NO tier at publish time — a stale disk copy aging
+        # out while the block sits hot on device (or re-staged in the
+        # host tier) must not remove live residency from the router,
+        # where the tree's chain-cascade would take the worker's whole
+        # downstream subtree with it
+        self.device_has: Optional[Callable[[int], bool]] = None
+        # staging area for INCOMING chains (disk promotions, peer
+        # pulls): a reserve-side overlay the host pool's LRU capacity
+        # does not apply to. Promoting a chain longer than the host
+        # budget through pool.put would thrash — each put demotes the
+        # chain's own earlier blocks back out before match_chain ever
+        # sees a consecutive run. Entries are transient: popped by
+        # reserve/discard, LRU-capped at a small multiple of the host
+        # budget (disk-backed entries re-read for free; a capped-out
+        # peer block just shortens that pull's restore).
+        self._staged: OrderedDict[int, tuple] = OrderedDict()
+        # peer-pulled hashes resident in the staging/host tier but not
+        # yet claimed by a request — claiming one means its transfer
+        # latency was fully hidden (peer_pull_hidden_frac). Insertion-
+        # ordered + capped: a pull whose request never arrives would
+        # otherwise track its hashes forever (evicting the oldest only
+        # undercounts hidden_frac for ancient unclaimed pulls)
+        self._peer_hashes: OrderedDict[int, None] = OrderedDict()
+        self._peer_track_cap = 8192
+        self.peer_pull_blocks_total = 0
+        self.peer_pull_blocks_claimed = 0
+        self.peer_serve_blocks_total = 0
         # multi-host: flushes/restores become mirrored ops — every process
         # gathers/scatters in lockstep and parks its OWN cache shards in
         # host DRAM (pool values are per-unique-shard piece lists instead
@@ -358,8 +662,10 @@ class OffloadManager:
             if exc is not None:
                 # a failed landing silently drops those blocks from the
                 # host tier (multi-turn TTFT regresses to recompute) —
-                # that must be visible to operators, not just absent
+                # that must be visible to operators, not just absent,
+                # and the router must stop counting them as residency
                 self.d2h_flush_failures += 1
+                self._dropped_pending.extend(t.hashes)
                 logger.warning(
                     "async d2h flush of %d blocks failed (KV dropped "
                     "from the host tier): %s", len(t.hashes), exc,
@@ -372,6 +678,227 @@ class OffloadManager:
     def has_inflight_flushes(self) -> bool:
         return bool(self._inflight_flushes)
 
+    # -- disk tier (third tier) --
+
+    def _note_dropped_one(self, seq_hash: int) -> None:
+        # callers hold self._lock (pool.put paths) or don't need it for
+        # a list append under the GIL; re-entrant lock keeps this cheap
+        with self._lock:
+            self._dropped_pending.append(seq_hash)
+
+    def _demote_to_disk(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+        """Host-pool overflow victim -> disk, via the offload executor
+        (pool.put callers hold ``_lock`` on whatever thread they're on;
+        the file write itself must never run on the event loop). True =
+        the block stays resident (a failed write later re-queues the
+        hash as a drop)."""
+        if self.disk is None or self._closed:
+            return False
+        if self.disk.contains(seq_hash):
+            return True  # already demoted once; content is immutable
+        try:
+            self._executor().submit(self._disk_demote_worker, seq_hash, k, v)
+        except RuntimeError:
+            return False
+        return True
+
+    def _disk_demote_worker(self, seq_hash: int, k, v) -> None:
+        kept = False
+        try:
+            kept = self.disk.put(seq_hash, k, v)
+        except Exception:  # noqa: BLE001 — a failed demotion is a drop
+            logger.warning("disk demotion of %x failed", seq_hash,
+                           exc_info=True)
+        with self._lock:
+            if kept:
+                self.disk_demotions_total += 1
+            else:
+                self._dropped_pending.append(seq_hash)
+            self._dropped_pending.extend(self.disk.drain_dropped())
+
+    def _staged_cap(self) -> int:
+        return max(4 * self.pool.capacity, 64)
+
+    def _stage_locked(self, seq_hash: int, k, v, peer: bool = False,
+                      fresh: Optional[set] = None) -> None:
+        self._staged[seq_hash] = (k, v)
+        self._staged.move_to_end(seq_hash)
+        if fresh is not None:
+            fresh.add(seq_hash)
+        while len(self._staged) > self._staged_cap():
+            old = next(iter(self._staged))
+            if fresh is not None and old in fresh:
+                # NEVER evict the chain being staged right now: reserve
+                # matches a CONSECUTIVE prefix from the chain head, so
+                # popping its own head would zero the whole restore.
+                # Per-call staging is capped at _staged_cap() blocks
+                # (callers truncate the TAIL), so the transient
+                # over-cap here is bounded at ~2x while a previous
+                # call's stale entries drain
+                break
+            self._staged.popitem(last=False)
+            self._peer_hashes.pop(old, None)
+            if self.disk is None or not self.disk.contains(old):
+                # left the last tier (a capped-out peer block; disk-
+                # backed stagings re-read for free and stay resident)
+                self._dropped_pending.append(old)
+        if peer:
+            self._peer_hashes[seq_hash] = None
+            while len(self._peer_hashes) > self._peer_track_cap:
+                self._peer_hashes.popitem(last=False)
+
+    def _match_chain_locked(self, seq_hashes: list[int]) -> int:
+        """Longest consecutive run claimable by a reserve: host pool ∪
+        staging area."""
+        n = 0
+        for h in seq_hashes:
+            if h in self.pool or h in self._staged:
+                n += 1
+            else:
+                break
+        return n
+
+    def promote_chain(self, seq_hashes: list[int]) -> int:
+        """Disk -> host-DRAM promotion of the chain's continuation past
+        the already-claimable prefix, into the staging area (NOT the
+        LRU pool — a chain longer than the host budget must still
+        restore whole; see ``_staged``), so the unchanged
+        reserve/upload/scatter restore path serves it. Blocking disk
+        reads — executor thread (engine._offload_prejoin) or an
+        explicitly off-loop backstop only. Returns blocks promoted."""
+        if self.disk is None or not seq_hashes:
+            return 0
+        with self._lock:
+            n = self._match_chain_locked(seq_hashes)
+        tail = seq_hashes[n:]
+        # truncate at the staging cap: a chain longer than the staging
+        # area restores its PREFIX (reads stop before the cap would
+        # start evicting this chain's own head out from under the
+        # consecutive match)
+        run = min(
+            self.disk.match_chain(tail) if tail else 0, self._staged_cap()
+        )
+        promoted = 0
+        fresh: set = set()
+        for h in tail[:run]:
+            got = self.disk.get(h)  # validates; corrupt -> clean miss
+            if got is None:
+                break
+            with self._lock:
+                self._stage_locked(h, got[0], got[1], fresh=fresh)
+            promoted += 1
+        with self._lock:
+            self._dropped_pending.extend(self.disk.drain_dropped())
+        return promoted
+
+    def tier_contains(self, seq_hash: int) -> bool:
+        """Index-only host∪staged∪disk residency probe (no data reads)."""
+        with self._lock:
+            if seq_hash in self.pool or seq_hash in self._staged:
+                return True
+        return self.disk is not None and self.disk.contains(seq_hash)
+
+    def flush_dropped(self) -> None:
+        """Deliver queued tier-drop notifications to ``on_dropped``.
+        Event-loop callers only: the callback publishes KV removal
+        events on the bus (kv_router.publisher), and the drops
+        themselves accrue from executor threads.
+
+        Drops are re-checked against EVERY tier (device via the
+        engine-wired ``device_has``, host pool, staging, disk) before
+        publishing: tiers hold independent copies of a content-addressed
+        block, so one tier evicting its copy is only a removal if no
+        other copy survives — publishing otherwise would erase live
+        residency (and, via the index's chain cascade, the worker's
+        whole downstream chain) from the router."""
+        cb = self.on_dropped
+        with self._lock:
+            if self.disk is not None:
+                self._dropped_pending.extend(self.disk.drain_dropped())
+            dropped, self._dropped_pending = self._dropped_pending, []
+        if cb is None or not dropped:
+            return
+        gone = []
+        seen: set = set()
+        for h in dropped:
+            if h in seen:
+                continue
+            seen.add(h)
+            if self.tier_contains(h):
+                continue
+            if self.device_has is not None and self.device_has(h):
+                continue
+            gone.append(h)
+        if gone:
+            try:
+                cb(gone)
+            except Exception:  # noqa: BLE001 — residency events are advisory
+                logger.debug("tier-drop notification failed", exc_info=True)
+
+    # -- fleet tier (peer prefix pulls) --
+
+    def export_chain(
+        self, seq_hashes: list[int], max_blocks: int = 512
+    ) -> tuple[list[int], Optional[np.ndarray], Optional[np.ndarray]]:
+        """Serve side of a peer prefix pull: the longest consecutive run
+        of ``seq_hashes`` resident in the host∪disk tiers, stacked
+        [L, Hkv, n, bs, D] for the transfer plane. Non-destructive (peek
+        + disk read, no promotion churn) so a requester dying mid-pull
+        leaves this worker's tiers untouched. Executor thread (disk
+        reads + multi-MB stacking)."""
+        if self.mirror is not None:
+            return [], None, None  # mirror pools hold per-shard pieces
+        served: list[int] = []
+        ks: list[np.ndarray] = []
+        vs: list[np.ndarray] = []
+        for h in seq_hashes[:max_blocks]:
+            with self._lock:
+                got = self.pool.peek(h)
+                if got is None:
+                    got = self._staged.get(h)
+            if got is None and self.disk is not None:
+                got = self.disk.get(h)
+            if got is None:
+                break
+            served.append(h)
+            ks.append(got[0])
+            vs.append(got[1])
+        if not served:
+            return [], None, None
+        with self._lock:
+            self.peer_serve_blocks_total += len(served)
+        return served, np.stack(ks, axis=2), np.stack(vs, axis=2)
+
+    def land_peer_chain(
+        self, seq_hashes: list[int], k_data: np.ndarray, v_data: np.ndarray
+    ) -> int:
+        """Puller side: park a peer-served chain in the host-DRAM
+        STAGING area — not the LRU pool, whose capacity would thrash a
+        chain longer than the host budget out of existence before the
+        restore runs — where the hinted-prefetch restore promotes it to
+        device exactly like a locally-offloaded chain. Executor thread —
+        the per-block splits are multi-MB copies (a view would pin the
+        whole stack for as long as any one block stays resident)."""
+        landed = 0
+        fresh: set = set()
+        # truncate at the staging cap (keep the chain's PREFIX): staging
+        # past it would evict this chain's own head and zero the
+        # consecutive match the restore needs
+        for i, h in enumerate(seq_hashes[: self._staged_cap()]):
+            k = k_data[:, :, i].copy()
+            v = v_data[:, :, i].copy()
+            with self._lock:
+                if (
+                    h in self.pool
+                    or h in self._staged
+                    or (self.disk is not None and self.disk.contains(h))
+                ):
+                    continue  # raced a local landing; content-identical
+                self._stage_locked(h, k, v, peer=True, fresh=fresh)
+                self.peer_pull_blocks_total += 1
+            landed += 1
+        return landed
+
     # -- admission-time reservation (event-loop thread) --
     def reserve_chain(
         self, seq_hashes: list[int]
@@ -380,15 +907,30 @@ class OffloadManager:
         flush_evictions can't LRU it away before restore runs).
 
         Callers on the event loop should have pre-joined relevant
-        in-flight flushes off-loop (engine._offload_prejoin); the inline
-        bounded join here is the correctness backstop for direct
-        callers."""
+        in-flight flushes AND pre-promoted disk hits off-loop
+        (engine._offload_prejoin); the inline bounded join / promotion
+        here is the correctness backstop for direct callers."""
         if seq_hashes and self._inflight_flushes:
             self._join_flushes_for(seq_hashes)
+        if self.disk is not None and seq_hashes:
+            self.promote_chain(seq_hashes)
         with self._lock:
-            n = self.pool.match_chain(seq_hashes)
+            n = self._match_chain_locked(seq_hashes)
             hashes = seq_hashes[:n]
-            return hashes, [self.pool.take(h) for h in hashes]
+            out = []
+            for h in hashes:
+                if h in self.pool:
+                    out.append(self.pool.take(h))
+                else:
+                    out.append(self._staged.pop(h))
+                # a request racing its own hint can reserve a
+                # peer-pulled block before the prefetch restore marks
+                # it: reserving IS the claim (restore instead of
+                # recompute — the transfer was hidden either way)
+                if h in self._peer_hashes:
+                    self._peer_hashes.pop(h)
+                    self.peer_pull_blocks_claimed += 1
+            return hashes, out
 
     def peek_chain(
         self, seq_hashes: list[int]
@@ -401,10 +943,19 @@ class OffloadManager:
         flight.)"""
         if seq_hashes and self._inflight_flushes:
             self._join_flushes_for(seq_hashes)
+        if self.disk is not None and seq_hashes:
+            self.promote_chain(seq_hashes)
         with self._lock:
-            n = self.pool.match_chain(seq_hashes)
+            n = self._match_chain_locked(seq_hashes)
             hashes = seq_hashes[:n]
-            return hashes, [self.pool.peek(h) for h in hashes]
+            out = []
+            for h in hashes:
+                got = self.pool.peek(h)
+                if got is None:
+                    got = self._staged[h]
+                    self._staged.move_to_end(h)
+                out.append(got)
+            return hashes, out
 
     def discard_chain(self, hashes: list[int]) -> None:
         """Drop host copies whose content is now device-resident (the
@@ -413,6 +964,7 @@ class OffloadManager:
         with self._lock:
             for h in hashes:
                 self.pool.take(h)
+                self._staged.pop(h, None)
 
     def unreserve(self, hashes: list[int], data, restored: bool = False) -> None:
         """Admission failed (or the prefill was cancelled/errored) after
@@ -448,6 +1000,10 @@ class OffloadManager:
                 self._deferred_drops.extend(
                     h for h in hashes if h not in final
                 )
+                self._dropped_pending.extend(drops)
+                self._dropped_pending.extend(
+                    h for h in hashes if h not in final
+                )
             return
         with self._lock:
             for h, (k, v) in zip(hashes, data):
@@ -469,6 +1025,12 @@ class OffloadManager:
                 drops, keep, order = self.pool.plan_puts(hashes)
                 bcast_drops = drops + self._deferred_drops
                 self._deferred_drops = []
+                # plan drops leave the leader's last tier (mirror
+                # engines have no disk tier): residency ends here
+                self._dropped_pending.extend(drops)
+                self._dropped_pending.extend(
+                    h for i, h in enumerate(hashes) if not keep[i]
+                )
             kg, vg = self.mirror.lead_offload_flush(
                 k_cache, v_cache, idxs, hashes,
                 np.asarray(keep, np.uint8), bcast_drops,
@@ -628,9 +1190,16 @@ class OffloadManager:
             if up.t_landed is not None:
                 self.restore_hidden_s += max(up.t_landed - up.t_start, 0.0)
 
-    def note_prefetch_hits(self, n: int) -> None:
+    def note_prefetch_hits(self, n: int, hashes: Optional[list] = None) -> None:
         with self._lock:
             self.h2d_prefetch_hits += n
+            # a claimed block that arrived via a peer pull: its whole
+            # cross-worker transfer was hidden from the request
+            # (peer_pull_hidden_frac numerator)
+            for h in hashes or ():
+                if h in self._peer_hashes:
+                    self._peer_hashes.pop(h)
+                    self.peer_pull_blocks_claimed += 1
 
     def restore(self, k_cache, v_cache, data, block_idxs: list[int],
                 hashes: Optional[list[int]] = None):
@@ -674,20 +1243,53 @@ class OffloadManager:
 
     def close(self) -> None:
         """Release the offload executor (in-flight landings still run to
-        completion; nothing new is accepted)."""
+        completion; nothing new is accepted). A disk tier on an
+        AUTO-created tempdir is deleted with the engine — leaving every
+        short-lived engine's multi-MB block files in /tmp would fill the
+        host; explicit ``disk_path`` directories persist by design."""
         self._closed = True
         if self._exec is not None:
             self._exec.shutdown(wait=False)
             self._exec = None
+        if self._own_disk_path is not None:
+            shutil.rmtree(self._own_disk_path, ignore_errors=True)
 
     def stats(self) -> dict:
         with self._lock:
             hid, exp = self.restore_hidden_s, self.restore_exposed_s
             denom = hid + exp
+            pulled = self.peer_pull_blocks_total
             return {
                 "offload_blocks_resident": len(self.pool),
                 "offload_blocks_stored_total": self.pool.stored_total,
                 "offload_hit_blocks_total": self.pool.hit_blocks_total,
+                # third-tier surface (ISSUE 10): disk residency/traffic,
+                # and the fleet tier's pull volume + the fraction of
+                # pulled blocks whose cross-worker transfer was fully
+                # hidden (landed + promoted before a request claimed it)
+                "disk_blocks_resident": (
+                    len(self.disk) if self.disk is not None else 0
+                ),
+                "disk_blocks_stored_total": (
+                    self.disk.stored_total if self.disk is not None else 0
+                ),
+                "disk_hit_blocks_total": (
+                    self.disk.hit_blocks_total if self.disk is not None else 0
+                ),
+                "disk_corrupt_discards": (
+                    self.disk.corrupt_discards if self.disk is not None else 0
+                ),
+                "disk_evictions_total": (
+                    self.disk.evictions_total if self.disk is not None else 0
+                ),
+                "disk_demotions_total": self.disk_demotions_total,
+                "peer_pull_blocks_total": pulled,
+                "peer_pull_blocks_claimed": self.peer_pull_blocks_claimed,
+                "peer_pull_hidden_frac": (
+                    round(self.peer_pull_blocks_claimed / pulled, 6)
+                    if pulled else 0.0
+                ),
+                "peer_serve_blocks_total": self.peer_serve_blocks_total,
                 # async-tier surface (ISSUE 1): background d2h flushes
                 # dispatched, hinted blocks restored + later claimed, and
                 # the fraction of total restore (h2d) latency hidden

@@ -8,8 +8,13 @@ routes to the best worker. This is the capability behind the reference's
 """
 
 from .indexer import KvIndexer, OverlapScores, PrefixIndex
-from .protocols import KvCacheEvent, KvPrefetchHint, RouterEvent
-from .publisher import KvEventPublisher, KvMetricsAggregator, KvPrefetchListener
+from .protocols import KvCacheEvent, KvPeerFetchRequest, KvPrefetchHint, RouterEvent
+from .publisher import (
+    KvEventPublisher,
+    KvMetricsAggregator,
+    KvPeerServer,
+    KvPrefetchListener,
+)
 from .router import KvRouter
 from .scheduler import KvScheduler, ProcessedEndpoints, WorkerLoad
 
@@ -18,6 +23,8 @@ __all__ = [
     "KvEventPublisher",
     "KvIndexer",
     "KvMetricsAggregator",
+    "KvPeerFetchRequest",
+    "KvPeerServer",
     "KvPrefetchHint",
     "KvPrefetchListener",
     "KvRouter",

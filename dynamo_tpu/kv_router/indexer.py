@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
@@ -31,16 +31,29 @@ logger = logging.getLogger(__name__)
 @dataclass
 class OverlapScores:
     """worker_id -> number of consecutive prefix blocks resident
-    (ref indexer.rs:239 OverlapScores)."""
+    (ref indexer.rs:239 OverlapScores).
+
+    ``scores`` is tier-inclusive: a worker that demoted part of the
+    chain to its host/disk tiers still counts (restore beats recompute
+    — that residency is the fleet prefix cache). ``device_scores``
+    holds the shallower device-only depth for workers known to have
+    demoted inside their match; :meth:`device` falls back to the
+    tier-inclusive score for everyone else."""
 
     scores: dict[int, int] = field(default_factory=dict)
     total_blocks: int = 0
+    device_scores: dict[int, int] = field(default_factory=dict)
 
     def best(self) -> tuple[Optional[int], int]:
         if not self.scores:
             return None, 0
         wid = max(self.scores, key=lambda w: self.scores[w])
         return wid, self.scores[wid]
+
+    def device(self, worker_id: int) -> int:
+        return self.device_scores.get(
+            worker_id, self.scores.get(worker_id, 0)
+        )
 
 
 @dataclass
@@ -197,7 +210,14 @@ def make_prefix_index(shards: int = 1, use_native: bool = True):
 
 class KvIndexer:
     """Event-plane consumer: subscribes the component's kv_events subject
-    and owns a PrefixIndex behind a queue (ref KvIndexer, indexer.rs:499)."""
+    and owns a PrefixIndex behind a queue (ref KvIndexer, indexer.rs:499).
+
+    Tier tracking rides as an overlay, not in the tree: ``demoted``
+    events (block left the device cache for the worker's offload tiers)
+    flip a ``(worker, hash)`` membership set instead of touching the
+    index, so both the Python tree and the native C++ tree stay
+    tier-blind and byte-compatible. ``find_matches`` projects the
+    overlay into ``OverlapScores.device_scores``."""
 
     def __init__(self, drt, component, shards: int = 1, use_native: bool = True):
         self.drt = drt
@@ -205,6 +225,17 @@ class KvIndexer:
         self.index = make_prefix_index(shards=shards, use_native=use_native)
         self._task: Optional[asyncio.Task] = None
         self.events_applied = 0
+        # (worker_id, block_hash) currently resident ONLY in the
+        # worker's offload tiers; stored re-promotes, removed drops.
+        # Insertion-ordered + capped: the tree's chain-cascade can
+        # remove descendants a ``removed`` event never names, leaving
+        # their overlay entries behind — stale entries are harmless to
+        # correctness (any path that puts (w, h) back in the tree goes
+        # through a stored event for exactly that pair, clearing it;
+        # an orphaned entry only makes device_scores conservative) but
+        # must not grow without bound on a long-lived router
+        self._offloaded: "OrderedDict[tuple[int, int], None]" = OrderedDict()
+        self._offloaded_cap = 1 << 18
 
     async def start(self) -> "KvIndexer":
         sub = self.drt.bus.subscribe(self.component.event_subject(KV_EVENT_SUBJECT))
@@ -225,13 +256,45 @@ class KvIndexer:
         # only add an unbounded buffer here
         async for msg in sub:
             try:
-                self.index.apply_event(RouterEvent.from_bytes(msg.payload))
-                self.events_applied += 1
+                self.apply_event(RouterEvent.from_bytes(msg.payload))
             except Exception:  # noqa: BLE001
                 logger.exception("bad kv event")
 
+    def apply_event(self, ev: RouterEvent) -> None:
+        kv = ev.event
+        if kv.kind == "demoted":
+            # overlay-only: the residency stays in the tree (the worker
+            # still holds the KV), it just stops counting as device
+            for h in kv.block_hashes:
+                self._offloaded[(ev.worker_id, h)] = None
+                self._offloaded.move_to_end((ev.worker_id, h))
+            while len(self._offloaded) > self._offloaded_cap:
+                # dropping the oldest entry is safe-conservative: the
+                # block reads as device-resident again, which at worst
+                # suppresses one redundant prefetch hint
+                self._offloaded.popitem(last=False)
+            self.events_applied += 1
+            return
+        if kv.kind == "stored":
+            # a restore/commit puts the block back on device
+            for blk in kv.blocks:
+                self._offloaded.pop((ev.worker_id, blk.block_hash), None)
+        elif kv.kind == "removed":
+            for h in kv.block_hashes:
+                self._offloaded.pop((ev.worker_id, h), None)
+        self.index.apply_event(ev)
+        self.events_applied += 1
+
     def find_matches(self, block_hashes) -> OverlapScores:
-        return self.index.find_matches(block_hashes)
+        hashes = list(block_hashes)
+        scores = self.index.find_matches(hashes)
+        if self._offloaded:
+            for w, k in scores.scores.items():
+                for i in range(k):
+                    if (w, hashes[i]) in self._offloaded:
+                        scores.device_scores[w] = i
+                        break
+        return scores
 
     def find_matches_for_tokens(self, tokens, block_size: int) -> OverlapScores:
         from ..engine.allocator import sequence_block_hashes
@@ -240,4 +303,7 @@ class KvIndexer:
         return self.find_matches(hashes)
 
     def remove_worker(self, worker_id: int) -> None:
+        self._offloaded = OrderedDict(
+            (k, None) for k in self._offloaded if k[0] != worker_id
+        )
         self.index.remove_worker(worker_id)

@@ -16,6 +16,12 @@ from typing import Optional
 KV_EVENT_SUBJECT = "kv_events"
 KV_HIT_RATE_SUBJECT = "kv-hit-rate"
 KV_PREFETCH_SUBJECT = "kv-prefetch"
+#: fleet prefix cache: a routed worker whose local tiers miss asks the
+#: peer named in its kv-prefetch hint for the chain's continuation; the
+#: peer answers by pushing the blocks over the TCP transfer plane
+#: (disagg/transfer.py framing + ack) to the requester's connect-back
+#: address — the bus carries only the negotiation, never the KV bytes
+KV_PEER_FETCH_SUBJECT = "kv-peer-fetch"
 
 #: hard cap on blocks per prefetch hint: bounds message size and the
 #: host->device burst one hint can trigger on the worker
@@ -30,9 +36,16 @@ class StoredBlock:
 
 @dataclass
 class KvCacheEvent:
-    """Stored (with parent linkage) or Removed."""
+    """Stored (with parent linkage), Removed, or Demoted.
 
-    kind: str  # "stored" | "removed"
+    ``demoted`` = the block left the device cache for the worker's
+    offload tiers (host DRAM / disk): the worker still holds the KV —
+    the index keeps the residency (that is what makes the fleet one
+    prefix cache) but tags it offload-tier so routing can tell a
+    device hit from a restorable one. The matching ``removed`` arrives
+    only when the block leaves the worker's LAST tier."""
+
+    kind: str  # "stored" | "removed" | "demoted"
     parent_hash: Optional[int] = None
     blocks: list[StoredBlock] = field(default_factory=list)
     block_hashes: list[int] = field(default_factory=list)
@@ -44,6 +57,10 @@ class KvCacheEvent:
     @staticmethod
     def removed(block_hashes: list[int]) -> "KvCacheEvent":
         return KvCacheEvent(kind="removed", block_hashes=block_hashes)
+
+    @staticmethod
+    def demoted(block_hashes: list[int]) -> "KvCacheEvent":
+        return KvCacheEvent(kind="demoted", block_hashes=block_hashes)
 
 
 @dataclass
@@ -86,22 +103,69 @@ class KvPrefetchHint:
     prompt order. The worker probes its own tiers against the chain and
     starts uploading the host-resident continuation BEFORE the request
     itself arrives (PRESERVE, arxiv 2501.08192), so admission claims the
-    blocks as ordinary device prefix hits."""
+    blocks as ordinary device prefix hits.
+
+    ``peer_worker_id``/``peer_blocks`` (fleet prefix cache): the peer
+    whose radix chain covers the prompt deeper than the routed worker's
+    own tiers, and to what depth. The worker re-probes its local tiers
+    first and, when they fall short, pulls the continuation from the
+    peer over the transfer plane (kv-peer-fetch) before the restore.
+    Advisory like the rest of the hint — a dead or mistaken peer just
+    costs the pull attempt; the request recomputes."""
 
     worker_id: int
     blocks: list  # [[tokens_hash, block_hash], ...] prompt order
+    peer_worker_id: Optional[int] = None
+    peer_blocks: int = 0
 
     def to_bytes(self) -> bytes:
         return json.dumps(
-            {"worker_id": self.worker_id, "blocks": self.blocks}
+            {"worker_id": self.worker_id, "blocks": self.blocks,
+             "peer_worker_id": self.peer_worker_id,
+             "peer_blocks": self.peer_blocks}
         ).encode()
 
     @staticmethod
     def from_bytes(raw: bytes) -> "KvPrefetchHint":
         d = json.loads(raw)
+        peer = d.get("peer_worker_id")
         return KvPrefetchHint(
             worker_id=d["worker_id"],
             blocks=[[int(a), int(b)] for a, b in d.get("blocks", [])],
+            peer_worker_id=int(peer) if peer is not None else None,
+            peer_blocks=int(d.get("peer_blocks") or 0),
+        )
+
+
+@dataclass
+class KvPeerFetchRequest:
+    """Worker -> peer negotiation for one fleet-tier prefix pull: the
+    requested chain (hashes PAST the requester's local coverage, prompt
+    order) plus the requester's transfer-plane connect-back address.
+    The peer probes its host/disk tiers and pushes the longest
+    consecutive resident run as one bulk KV transfer keyed by
+    ``request_id`` (or an error delivery on a total miss, so the
+    requester doesn't wait out its timeout). The KV bytes never touch
+    the bus."""
+
+    peer_worker_id: int  # the peer asked to serve
+    src_worker_id: int  # the requester (logging/metrics)
+    request_id: str  # transfer-plane correlation id
+    hashes: list  # chained block hashes, prompt order
+    connection: dict  # requester's KvTransferServer ConnectionInfo
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "KvPeerFetchRequest":
+        d = json.loads(raw)
+        return KvPeerFetchRequest(
+            peer_worker_id=int(d["peer_worker_id"]),
+            src_worker_id=int(d.get("src_worker_id", 0)),
+            request_id=str(d["request_id"]),
+            hashes=[int(h) for h in d.get("hashes", [])],
+            connection=d.get("connection") or {},
         )
 
 

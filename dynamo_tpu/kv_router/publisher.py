@@ -3,12 +3,19 @@
 Re-design of lib/llm/src/kv_router/{publisher,metrics_aggregator,scoring}.rs:
 
   * :class:`KvEventPublisher` — hooks the engine's BlockAllocator
-    stored/removed callbacks and publishes RouterEvents on the component's
+    stored/removed/demoted callbacks (and the offload tier's last-tier
+    drop queue) and publishes RouterEvents on the component's
     ``kv_events`` subject,
   * :class:`KvPrefetchListener` — the other direction: consumes the
-    router's ``kv-prefetch`` hints addressed to this worker and hands
-    the block-hash chain to the engine's host-tier prefetch
+    router's ``kv-prefetch`` hints addressed to this worker, pulls
+    peer-held prefix continuations over the transfer plane when the
+    hint names a deeper peer (fleet prefix cache), and hands the
+    block-hash chain to the engine's host-tier prefetch
     (engine.prefetch_hint), so restores start before requests arrive,
+  * :class:`KvPeerServer` — the serve side of those pulls: answers
+    ``kv-peer-fetch`` requests addressed to this worker by pushing the
+    chain's host/disk-resident blocks to the requester's connect-back
+    address (disagg/transfer.py framing + ack),
   * :class:`KvMetricsAggregator` — periodically scrapes every worker
     instance's stats endpoint (the engine's ``load_metrics``) into
     :class:`ProcessedEndpoints` for the scheduler.
@@ -19,12 +26,15 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import uuid
 from typing import Optional
 
 from .protocols import (
     KV_EVENT_SUBJECT,
+    KV_PEER_FETCH_SUBJECT,
     KV_PREFETCH_SUBJECT,
     KvCacheEvent,
+    KvPeerFetchRequest,
     KvPrefetchHint,
     RouterEvent,
     StoredBlock,
@@ -32,6 +42,11 @@ from .protocols import (
 from .scheduler import ProcessedEndpoints, WorkerLoad
 
 logger = logging.getLogger(__name__)
+
+#: wall bound on one peer prefix pull (bus negotiation + TCP push):
+#: past this the hinted request is probably already being served, so
+#: the puller abandons the delivery and lets admission recompute
+PEER_PULL_TIMEOUT_S = 20.0
 
 
 class KvEventPublisher:
@@ -59,9 +74,21 @@ class KvEventPublisher:
     def on_removed(self, block_hashes: list[int]) -> None:
         self.publish(KvCacheEvent.removed(block_hashes))
 
-    def attach(self, allocator) -> None:
+    def on_demoted(self, block_hashes: list[int]) -> None:
+        self.publish(KvCacheEvent.demoted(block_hashes))
+
+    def attach(self, allocator, offload=None) -> None:
+        """Wire the allocator's events; with an ``offload`` manager the
+        residency story becomes tiered: device evictions publish
+        ``demoted`` (the worker still holds the KV, one tier down —
+        the router keeps the radix entry, which is what lets peers pull
+        it), and the true ``removed`` fires from the offload manager's
+        last-tier drop queue (OffloadManager.flush_dropped)."""
         allocator.on_stored = self.on_stored
         allocator.on_removed = self.on_removed
+        if offload is not None:
+            allocator.on_demoted = self.on_demoted
+            offload.on_dropped = self.on_removed
 
 
 class KvPrefetchListener:
@@ -69,19 +96,75 @@ class KvPrefetchListener:
     ``kv-prefetch`` subject, filters hints addressed to this worker, and
     drives the engine's router-hinted host-tier prefetch. Hints are
     advisory — any failure is logged and dropped (the request still
-    serves correctly, it just pays the cold restore)."""
+    serves correctly, it just pays the cold restore).
 
-    def __init__(self, drt, component, worker_id: int, engine):
+    Fleet prefix cache: a hint naming a ``peer_worker_id`` whose chain
+    runs deeper than this worker's local coverage triggers a peer pull
+    first — a ``kv-peer-fetch`` negotiation on the bus answered by the
+    peer pushing the blocks to this listener's transfer server, landed
+    in the HOST tier, then promoted to device by the very same
+    ``engine.prefetch_hint`` restore that serves locally-offloaded
+    chains. Every failure mode (peer dead, timeout, partial serve,
+    miss) degrades to exactly what would have happened without the
+    peer: recompute."""
+
+    def __init__(self, drt, component, worker_id: int, engine,
+                 transfer=None, peer_pull: bool = True,
+                 pull_timeout: float = PEER_PULL_TIMEOUT_S):
         self.drt = drt
         self.subject = component.event_subject(KV_PREFETCH_SUBJECT)
+        self.fetch_subject = component.event_subject(KV_PEER_FETCH_SUBJECT)
         self.worker_id = worker_id
         self.engine = engine
         self.hints_received = 0
         self.blocks_prefetched = 0
+        self.peer_pulls = 0
+        self.peer_pull_blocks = 0
+        self.peer_pull_failures = 0
+        self.pull_timeout = pull_timeout
+        self.peer_pull = peer_pull
+        # connect-back target for peer pushes: the disagg decode role
+        # shares its existing KvTransferServer; otherwise the listener
+        # owns a lightweight one, started lazily with it
+        self._transfer = transfer
+        self._own_transfer = False
         self._task: Optional[asyncio.Task] = None
         self._sub = None
+        # one task per hint: a dead peer's pull waits out its timeout
+        # WITHOUT head-of-line blocking every later hint's restore (the
+        # same hazard KvPeerServer spawns per serve for). Pulls beyond
+        # the cap skip the peer and go straight to the local restore;
+        # the restores themselves serialize (one h2d pipe, and the
+        # engine's prefetch path was written for one caller at a time)
+        self._hint_tasks: set[asyncio.Task] = set()
+        self._restore_lock = asyncio.Lock()
+        self._active_pulls = 0
+        self.max_concurrent_pulls = 8
+
+    def _pull_ready(self) -> bool:
+        off = getattr(self.engine, "offload", None)
+        return (
+            self.peer_pull
+            and self._transfer is not None
+            and off is not None
+            and off.mirror is None
+        )
 
     async def start(self) -> "KvPrefetchListener":
+        off = getattr(self.engine, "offload", None)
+        if (
+            self.peer_pull
+            and self._transfer is None
+            and off is not None
+            and off.mirror is None  # same gate as _pull_ready: a mirror
+            # engine never pulls, so don't bind a dead connect-back
+            # socket + server task per mirror worker
+        ):
+            from ..disagg.transfer import KvTransferServer
+
+            self._transfer = KvTransferServer()
+            await self._transfer.start()
+            self._own_transfer = True
         sub = self.drt.bus.subscribe(self.subject)
         ready = getattr(sub, "ready", None)
         if ready is not None:
@@ -95,6 +178,10 @@ class KvPrefetchListener:
             self._sub.unsubscribe()
         if self._task is not None:
             self._task.cancel()
+        for t in list(self._hint_tasks):
+            t.cancel()
+        if self._own_transfer and self._transfer is not None:
+            await self._transfer.close()
 
     async def _consume(self, sub) -> None:
         async for msg in sub:
@@ -103,12 +190,240 @@ class KvPrefetchListener:
                 if hint.worker_id != self.worker_id:
                     continue
                 self.hints_received += 1
-                n = await self.engine.prefetch_hint(
-                    [(l, s) for l, s in hint.blocks]
+                t = asyncio.get_running_loop().create_task(
+                    self._handle_hint(hint)
                 )
-                self.blocks_prefetched += n
+                self._hint_tasks.add(t)
+                t.add_done_callback(self._hint_tasks.discard)
             except Exception:  # noqa: BLE001 — hints are advisory
                 logger.debug("prefetch hint failed", exc_info=True)
+
+    async def _handle_hint(self, hint: KvPrefetchHint) -> None:
+        try:
+            blocks = [(l, s) for l, s in hint.blocks]
+            if (
+                hint.peer_worker_id is not None
+                and self._pull_ready()
+                # gate on PULLS in flight, not hint tasks — peer-less
+                # hints and tasks merely queued on the restore lock must
+                # not lock later hints out of their pulls
+                and self._active_pulls < self.max_concurrent_pulls
+            ):
+                self._active_pulls += 1
+                try:
+                    await self._maybe_pull(hint, blocks)
+                finally:
+                    self._active_pulls -= 1
+            async with self._restore_lock:
+                n = await self.engine.prefetch_hint(blocks)
+            self.blocks_prefetched += n
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — hints are advisory
+            logger.debug("prefetch hint failed", exc_info=True)
+
+    async def _maybe_pull(self, hint: KvPrefetchHint, blocks: list) -> None:
+        """One peer prefix pull: size the remote tail from local
+        coverage, negotiate over the bus, await the transfer-plane
+        delivery, and land it in the host tier. Best-effort throughout."""
+        chain = [s for _l, s in blocks]
+        cov = self.engine.chain_coverage(chain)
+        if cov >= min(hint.peer_blocks, len(chain)):
+            return  # local tiers already cover what the peer offers
+        tail = chain[cov:]
+        request_id = f"peer-pull-{uuid.uuid4().hex}"
+        fut = self._transfer.expect(request_id)
+        req = KvPeerFetchRequest(
+            peer_worker_id=hint.peer_worker_id,
+            src_worker_id=self.worker_id,
+            request_id=request_id,
+            hashes=tail,
+            connection=self._transfer.address.to_dict(),
+        )
+        self.peer_pulls += 1
+        try:
+            self.drt.bus.publish(self.fetch_subject, req.to_bytes())
+            delivery = await asyncio.wait_for(fut, self.pull_timeout)
+        except Exception:  # noqa: BLE001 — dead peer / timeout / bus
+            # trouble: the request recomputes, exactly as if the peer
+            # never existed. The pending future is abandoned so a
+            # stale late push can't land into a recycled request id.
+            self.peer_pull_failures += 1
+            self._transfer.abandon(request_id)
+            logger.debug("peer pull %s failed; falling back to recompute",
+                         request_id, exc_info=True)
+            return
+        if delivery.error or not delivery.hashes or delivery.k_data is None:
+            self.peer_pull_failures += 1
+            return
+        served = [int(h) for h in delivery.hashes]
+        if served != tail[: len(served)]:
+            # a peer whose probe drifted from the request must not park
+            # mislabeled KV in the content-addressed pool
+            self.peer_pull_failures += 1
+            logger.warning("peer pull %s returned a mismatched chain",
+                           request_id)
+            return
+        # regroup (a whole-stack head-axis permutation copy) AND the
+        # per-block landing copies are multi-MB host work: one executor
+        # hop for both — neither belongs on the serving loop
+        try:
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, self._regroup_and_land, delivery, served
+            )
+        except Exception:  # noqa: BLE001 — bad peer metadata
+            self.peer_pull_failures += 1
+            logger.warning("peer pull %s regroup/landing failed", request_id,
+                           exc_info=True)
+            return
+        self.peer_pull_blocks += n
+
+    def _regroup_and_land(self, delivery, served: list) -> int:
+        """Executor thread: permute a foreign kv-head ordering (same
+        shared rule as the disagg delivery paths — ops/kv_rearrange.
+        layout_mismatched) and park the chain in the host staging
+        area."""
+        from ..ops.kv_rearrange import layout_mismatched, rearrange_for_decode
+
+        k, v = delivery.k_data, delivery.v_data
+        my_layout = self.engine.cfg.kv_head_layout
+        my_tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
+        if layout_mismatched(
+            delivery.head_layout, delivery.src_tp, my_layout, my_tp
+        ):
+            k = rearrange_for_decode(
+                k, delivery.src_tp, my_tp, delivery.head_layout, my_layout
+            )
+            v = rearrange_for_decode(
+                v, delivery.src_tp, my_tp, delivery.head_layout, my_layout
+            )
+        return self.engine.offload.land_peer_chain(served, k, v)
+
+
+class KvPeerServer:
+    """Serve side of the fleet prefix cache: consumes ``kv-peer-fetch``
+    requests addressed to this worker and answers each by pushing the
+    requested chain's host/disk-resident blocks to the requester's
+    transfer server — the same bulk framing, layer-chunked frames and
+    end-to-end ack as the disagg KV handoff (disagg/transfer.py). A
+    total miss answers with an error delivery so the requester falls
+    back immediately instead of waiting out its pull timeout. Serving
+    is non-destructive (export reads, never takes), so a requester
+    dying mid-pull leaves this worker's tiers untouched."""
+
+    def __init__(self, drt, component, worker_id: int, engine,
+                 layer_chunk: int = 4):
+        self.drt = drt
+        self.subject = component.event_subject(KV_PEER_FETCH_SUBJECT)
+        self.worker_id = worker_id
+        self.engine = engine
+        self.layer_chunk = layer_chunk
+        self.fetches_received = 0
+        self.blocks_served = 0
+        self.misses = 0
+        self.serve_errors = 0
+        self.serve_rejects = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self._serves: set[asyncio.Task] = set()
+        # a hint storm naming this worker for a hot shared prefix must
+        # not stack unbounded concurrent exports (each one np.stacks a
+        # multi-MB..GB KV run on the executor) — the puller side caps
+        # its fan-out the same way (max_concurrent_pulls)
+        self.max_concurrent_serves = 8
+
+    async def start(self) -> "KvPeerServer":
+        sub = self.drt.bus.subscribe(self.subject)
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._sub = sub
+        self._task = self.drt.runtime.spawn(self._consume(sub))
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+        for t in list(self._serves):
+            t.cancel()
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                req = KvPeerFetchRequest.from_bytes(msg.payload)
+                if req.peer_worker_id != self.worker_id:
+                    continue
+                self.fetches_received += 1
+                if len(self._serves) >= self.max_concurrent_serves:
+                    # over the export cap: answer busy so the puller
+                    # falls back to recompute NOW instead of waiting
+                    # out its pull timeout
+                    self.serve_rejects += 1
+                    t = asyncio.get_running_loop().create_task(
+                        self._reject(req)
+                    )
+                else:
+                    # one task per serve: a slow requester link must not
+                    # head-of-line block other peers' pulls
+                    t = asyncio.get_running_loop().create_task(
+                        self._serve(req)
+                    )
+                self._serves.add(t)
+                t.add_done_callback(self._serves.discard)
+            except Exception:  # noqa: BLE001 — fetches are advisory
+                logger.debug("bad kv-peer-fetch request", exc_info=True)
+
+    async def _reject(self, req: KvPeerFetchRequest) -> None:
+        from ..disagg.transfer import send_kv_blocks
+
+        try:
+            await send_kv_blocks(
+                req.connection, req.request_id, -1, None, None,
+                error="peer-busy",
+            )
+        except Exception:  # noqa: BLE001 — the puller's timeout covers us
+            logger.debug("peer-busy notify %s failed", req.request_id,
+                         exc_info=True)
+
+    async def _serve(self, req: KvPeerFetchRequest) -> None:
+        from ..disagg.transfer import send_kv_blocks
+        from ..resilience import faultpoints
+
+        try:
+            # deterministic worker-death injection for the mid-pull
+            # crash tests: a kill here is a peer dying before (or
+            # instead of) the push — no data, no ack, the puller's
+            # timeout degrades it to recompute
+            await faultpoints.hit("mid_peer_serve", request_id=req.request_id)
+            off = getattr(self.engine, "offload", None)
+            hashes, k, v = ([], None, None)
+            if off is not None:
+                hashes, k, v = await asyncio.get_running_loop().run_in_executor(
+                    None, off.export_chain, req.hashes
+                )
+            if not hashes:
+                self.misses += 1
+                await send_kv_blocks(
+                    req.connection, req.request_id, -1, None, None,
+                    error="peer-miss",
+                )
+                return
+            await send_kv_blocks(
+                req.connection, req.request_id, -1, k, v,
+                layer_chunk=self.layer_chunk,
+                head_layout=self.engine.cfg.kv_head_layout,
+                src_tp=self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1,
+                hashes=hashes,
+            )
+            self.blocks_served += len(hashes)
+        except Exception:  # noqa: BLE001 — serving is best-effort: the
+            # puller's timeout covers us, and a FaultInjected kill must
+            # look exactly like a crashed peer (no ack, no retry)
+            self.serve_errors += 1
+            logger.debug("peer serve %s failed", req.request_id,
+                         exc_info=True)
 
 
 class KvMetricsAggregator:
@@ -194,6 +509,10 @@ class KvMetricsAggregator:
                     offload_prefetch_hits=d.get("h2d_prefetch_hits", 0),
                     offload_restore_hidden_frac=d.get(
                         "restore_latency_hidden_frac", 0.0),
+                    disk_blocks_resident=d.get("disk_blocks_resident", 0),
+                    disk_hit_blocks=d.get("disk_hit_blocks_total", 0),
+                    peer_pull_blocks=d.get("peer_pull_blocks_total", 0),
+                    peer_pull_hidden_frac=d.get("peer_pull_hidden_frac", 0.0),
                     draining=d.get("draining", 0),
                     drains_total=d.get("drains_total", 0),
                     migration_resumes=d.get("migration_resumes", 0),

@@ -122,15 +122,28 @@ class KvRouter:
             len(pairs) - 1 if token_ids and len(token_ids)
             % self.block_size == 0 else len(pairs)
         )
-        # compare against the CLAIMABLE chain: a worker already holding
-        # all n_hint claimable blocks must not be re-hinted every turn
-        if overlap < n_hint:
-            # the chosen worker's device radix match doesn't cover the
-            # prompt: ship the chain so its host tier can start the h2d
-            # upload before the request lands (PRESERVE-style prefetch).
-            # The worker re-derives its own device match from the chain —
-            # the index view here may be stale either way.
-            self.scheduler.emit_prefetch(worker_id, pairs[:n_hint])
+        # compare against the CLAIMABLE chain, on the DEVICE-tier depth:
+        # a worker already holding all n_hint claimable blocks on device
+        # must not be re-hinted every turn, but a chain the worker
+        # demoted to host/disk still wants the hint (it triggers the
+        # pre-arrival restore that hides the promotion latency)
+        if overlaps.device(worker_id) < n_hint:
+            # fleet prefix cache: when a PEER's radix chain covers the
+            # prompt deeper than everything the routed worker holds
+            # (any tier), name it in the hint — the worker pulls the
+            # continuation from the peer's host/disk tier over the
+            # transfer plane before the request lands. The peer's own
+            # tier split is decided at serve time by its local probe;
+            # this is advisory, like the hint itself.
+            peer_id, peer_ov = None, overlap
+            for w, ov in overlaps.scores.items():
+                if w != worker_id and ov > peer_ov:
+                    peer_id, peer_ov = w, ov
+            self.scheduler.emit_prefetch(
+                worker_id, pairs[:n_hint],
+                peer_worker_id=peer_id,
+                peer_blocks=min(peer_ov, n_hint) if peer_id is not None else 0,
+            )
         return worker_id, overlap
 
     def request_finished(self, worker_id: int) -> None:

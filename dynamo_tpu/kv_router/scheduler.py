@@ -56,6 +56,13 @@ class WorkerLoad:
     offload_d2h_flush_async: int = 0
     offload_prefetch_hits: int = 0
     offload_restore_hidden_frac: float = 0.0
+    # third-tier + fleet-prefix-cache surface (ISSUE 10): disk-tier
+    # residency/hits, peer-pull volume, and the fraction of pulled
+    # blocks whose cross-worker transfer stayed off every TTFT path
+    disk_blocks_resident: int = 0
+    disk_hit_blocks: int = 0
+    peer_pull_blocks: int = 0
+    peer_pull_hidden_frac: float = 0.0
     # resilience surface: a draining worker (SIGTERM received, lease
     # still live) must not be picked — its engine bounces new work
     draining: int = 0
@@ -265,17 +272,27 @@ class KvScheduler:
         else:
             self._pending[worker_id] = n - 1
 
-    def emit_prefetch(self, worker_id: int, blocks: list) -> None:
+    def emit_prefetch(
+        self, worker_id: int, blocks: list,
+        peer_worker_id: Optional[int] = None, peer_blocks: int = 0,
+    ) -> None:
         """Ship the routed request's block-hash chain to the chosen
         worker as a prefetch hint ((tokens_hash, block_hash) pairs in
         prompt order) — fired when the worker's known device overlap
         doesn't cover the prompt, so the worker can start its host-tier
         h2d upload before the request arrives (engine.prefetch_hint).
-        Best-effort: a lost hint only costs the overlap."""
+        ``peer_worker_id`` names the peer whose radix chain covers the
+        prompt deeper than the routed worker's own tiers (to depth
+        ``peer_blocks``) — the worker pulls the continuation from that
+        peer's host/disk tier over the transfer plane (fleet prefix
+        cache). Best-effort: a lost hint only costs the overlap."""
         if self.drt is None or self._prefetch_subject is None or not blocks:
             return
+        capped = blocks[:KV_PREFETCH_MAX_BLOCKS]
         hint = KvPrefetchHint(
-            worker_id, [[l, s] for l, s in blocks[:KV_PREFETCH_MAX_BLOCKS]]
+            worker_id, [[l, s] for l, s in capped],
+            peer_worker_id=peer_worker_id,
+            peer_blocks=min(peer_blocks, len(capped)),
         )
         try:
             self.drt.bus.publish(self._prefetch_subject, hint.to_bytes())

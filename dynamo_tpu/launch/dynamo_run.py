@@ -263,6 +263,9 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         max_context=args.max_context or 0,
         mesh=mesh_config(args),
         host_cache_blocks=args.host_cache_blocks,
+        disk_cache_blocks=args.disk_blocks,
+        disk_cache_path=args.disk_path,
+        kv_tier_ttl_s=args.kv_tier_ttl_s,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,
         decode_window=args.decode_window,
@@ -455,6 +458,7 @@ async def run_endpoint(args) -> None:
     jax_core = core if isinstance(core, JaxEngine) else None
     await maybe_warmup(args, core)
     drt = await connect_runtime(args)
+    transfer_server = None
     if args.disagg == "decode":
         # conditional disaggregation: long uncached prompts offload to
         # prefill workers via the queue + KV transfer plane (disagg/)
@@ -468,6 +472,7 @@ async def run_endpoint(args) -> None:
             host=args.host, advertise_host=args.advertise_host
         )
         await transfer.start()
+        transfer_server = transfer  # shared with the peer-pull listener
         disagg_router = ConditionalDisaggRouter(
             drt, ns, name,
             DisaggConfig(max_local_prefill_length=args.max_local_prefill),
@@ -496,17 +501,33 @@ async def run_endpoint(args) -> None:
         args, f"worker-{drt.primary_lease_id:x}", drt=drt, component=component
     )
     if jax_core is not None:
-        from ..kv_router import KvEventPublisher, KvPrefetchListener
+        from ..kv_router import (
+            KvEventPublisher, KvPeerServer, KvPrefetchListener,
+        )
 
-        KvEventPublisher(drt, component, drt.primary_lease_id).attach(jax_core.allocator)
+        # with an offload tier, demotions keep their radix residency and
+        # last-tier drops publish the real removals (fleet prefix cache)
+        KvEventPublisher(drt, component, drt.primary_lease_id).attach(
+            jax_core.allocator, offload=jax_core.offload
+        )
         if jax_core.offload is not None:
             # router-hinted host-tier prefetch: the KV router ships the
             # routed prompt's block-hash chain here the moment it picks
             # this worker; the engine starts the h2d restore before the
-            # request itself arrives (engine.prefetch_hint). The handle
-            # is kept so the subscription/task stay referenced for the
+            # request itself arrives (engine.prefetch_hint), pulling the
+            # continuation from the hinted PEER's tiers first when local
+            # tiers fall short. The disagg decode role shares its
+            # transfer server for the connect-back; other roles get a
+            # lightweight one inside the listener. The handles are kept
+            # so the subscriptions/tasks stay referenced for the
             # worker's lifetime (and closeable by embedders).
             prefetch_listener = await KvPrefetchListener(  # noqa: F841
+                drt, component, drt.primary_lease_id, jax_core,
+                transfer=transfer_server,
+            ).start()
+            # ...and the serve side: answer peers' kv-peer-fetch
+            # requests from this worker's host/disk tiers
+            peer_server = await KvPeerServer(  # noqa: F841
                 drt, component, drt.primary_lease_id, jax_core
             ).start()
     handle = await component.endpoint(ep).serve(engine, stats_handler=stats)
@@ -656,6 +677,7 @@ async def run_batch(args, batch_file: str) -> None:
     pipeline = core if getattr(core, "text_mode", False) else link(Backend(tokenizer), core)
 
     entries = []
+    # dynlint: disable=blocking-disk-io -- one-shot harness setup before any request exists
     with open(batch_file) as f:
         for line in f:
             line = line.strip()
@@ -846,6 +868,19 @@ def main(argv=None) -> None:
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="host-DRAM KV offload tier capacity (blocks; 0=off)")
+    p.add_argument("--disk-blocks", type=int, default=0,
+                   help="disk/SSD third KV tier capacity (blocks; 0=off; "
+                        "requires --host-cache-blocks — host LRU overflow "
+                        "demotes here, restores promote back through host "
+                        "DRAM; docs/kv_offload.md)")
+    p.add_argument("--disk-path", default=None,
+                   help="disk-tier directory (default: a fresh tempdir; "
+                        "point a restarted worker at the same path to "
+                        "keep its disk tier)")
+    p.add_argument("--kv-tier-ttl-s", type=float, default=0.0,
+                   help="disk-tier entry TTL in seconds (0 = LRU only): "
+                        "stale fleet prefixes age out instead of "
+                        "squatting disk capacity")
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--data-dir", default=None,
                    help="hub durability dir (in=hub role): the store "

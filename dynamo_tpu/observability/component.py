@@ -172,6 +172,17 @@ class MetricsComponent:
                 "offload_restore_hidden_frac",
                 round(w.offload_restore_hidden_frac, 6), lb,
             )
+            # third KV tier + fleet prefix cache (docs/kv_offload.md):
+            # disk-tier residency and hits, the volume of blocks pulled
+            # from peers' tiers, and the fraction of pulled blocks whose
+            # cross-worker transfer stayed fully hidden from requests
+            gauge("disk_blocks_resident", w.disk_blocks_resident, lb)
+            gauge("disk_hit_blocks_total", w.disk_hit_blocks, lb)
+            gauge("peer_pull_blocks_total", w.peer_pull_blocks, lb)
+            gauge(
+                "peer_pull_hidden_frac",
+                round(w.peer_pull_hidden_frac, 6), lb,
+            )
             # resilience plane: draining state + handoff/resume volume
             # (resilience subsystem; docs/resilience.md)
             gauge("draining", w.draining, lb)

@@ -77,6 +77,21 @@ def expand_kv_heads(kv, factor: int, head_axis: int = 1):
     return kv.take(idx, axis=head_axis)
 
 
+def layout_mismatched(
+    src_layout: str, src_tp: int, dst_layout: str, dst_tp: int
+) -> bool:
+    """Does a delivery with the source's declared head ordering need the
+    :func:`rearrange_for_decode` regroup before landing in a cache with
+    the destination's? A foreign layout always does, and interleaved
+    orderings are tp-DEPENDENT — the same layout name with a different
+    tp still permutes (module doc). ONE definition shared by the disagg
+    bulk delivery, the streamed scatter sink, and the fleet peer-pull
+    landing, so the tp-dependence rule cannot drift between them."""
+    return src_layout != dst_layout or (
+        src_layout == "interleaved" and src_tp != dst_tp
+    )
+
+
 def rearrange_for_decode(
     kv,
     src_tp: int,

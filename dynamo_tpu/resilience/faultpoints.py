@@ -47,6 +47,10 @@ POINTS = (
     "mid_decode",
     "mid_kv_transfer",
     "mid_drain",
+    # fleet prefix cache: the peer-side serve of a kv-peer-fetch —
+    # killing here is a worker dying mid-peer-pull (the puller must
+    # degrade to recompute, the peer's tiers must stay intact)
+    "mid_peer_serve",
 )
 
 ACTIONS = ("kill", "delay")

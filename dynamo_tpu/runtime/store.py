@@ -280,6 +280,7 @@ class LocalStore:
             import os
 
             try:  # compacted into the snapshot
+                # dynlint: disable=blocking-disk-io -- shutdown-only WAL compaction, loop is tearing down
                 os.remove(self._wal_path())
             except OSError:
                 pass

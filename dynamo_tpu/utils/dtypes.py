@@ -1,0 +1,22 @@
+"""One name -> np.dtype resolver for serialized KV payloads.
+
+Shared by the wire codec (disagg/transfer.py) and the disk-tier codec
+(engine/offload.py DiskKvStore) so the two can never diverge on which
+dtypes round-trip — a dtype the wire accepts but the disk tier can't
+resolve would turn valid entries into corrupt-discards after an
+upgrade. Covers everything ``str(np.dtype)`` emits for jax cache
+arrays, including the ml_dtypes extras (bfloat16, float8_e4m3fn, ...).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))

@@ -404,7 +404,65 @@ def test_swallowed_exception_good():
 
 
 # ---------------------------------------------------------------------------
-# rule 8: span-leak
+# rule 8: blocking-disk-io
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_disk_io_fires():
+    bad = """
+    import os
+    async def land(path, h):
+        with open(path, "rb") as f:
+            raw = f.read()
+        os.remove(path)
+        return raw
+    """
+    # open() + f.read() (file-shaped receiver) + os.remove
+    assert rules_fired(bad) == ["blocking-disk-io"] * 3
+
+
+def test_blocking_disk_io_pathlib_and_file_receivers():
+    bad = """
+    async def demote(p, fh):
+        p.write_bytes(b"x")
+        fh.write(b"y")
+        fh.flush()
+    """
+    assert rules_fired(bad) == ["blocking-disk-io"] * 3
+
+
+def test_blocking_disk_io_good_patterns():
+    """Executor dispatch passes a function REFERENCE (the sanctioned
+    pattern for the disk tier), sync helpers may do file I/O freely,
+    and asyncio StreamWriter/StreamReader write/read never fire."""
+    good = """
+    import asyncio
+    def disk_put(store, h, k, v):   # sync helper: runs on the executor
+        with open(store.path, "wb") as f:
+            f.write(k)
+    async def promote(loop, store, hashes):
+        await loop.run_in_executor(None, store.promote_chain, hashes)
+    async def send(writer, reader):
+        writer.write(b"frame")       # StreamWriter: non-blocking
+        await writer.drain()
+        return await reader.read(4)  # StreamReader: awaited, fine
+    """
+    assert rules_fired(good) == []
+
+
+def test_blocking_disk_io_scoped_to_event_loop_packages():
+    bad = """
+    async def snapshot(path):
+        open(path)
+    """
+    assert rules_fired(bad, path="dynamo_tpu/deploy/builder.py") == []
+    assert rules_fired(bad, path="dynamo_tpu/engine/offload.py") == [
+        "blocking-disk-io"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# rule 9: span-leak
 # ---------------------------------------------------------------------------
 
 

@@ -1,0 +1,678 @@
+"""Fleet-wide prefix cache (ISSUE 10): the disk third KV tier behind
+OffloadManager + peer-to-peer prefix pulls over the transfer plane.
+
+Covered here:
+  * DiskKvStore format + LRU/TTL + restart rescan, and the crash-safety
+    contract: truncated / corrupt / version-mismatched entries are clean
+    cache misses (discarded with a counter bump), never exceptions,
+  * host-pool LRU overflow demotes to disk and the chain restores
+    BIT-EXACT through the unchanged host-promotion path,
+  * tier-aware residency events: device eviction with an offload tier
+    publishes ``demoted`` (router keeps the radix entry, device depth
+    drops), last-tier drops publish the real ``removed``,
+  * the router names a deeper peer in its prefetch hint,
+  * the full peer pull: bus-negotiated fetch answered over real TCP,
+    landed in the puller's host tier, promoted to device, claimed by the
+    request with ``peer_pull_hidden_frac`` accounting — bit-exact vs the
+    peer's own stream,
+  * worker death mid-peer-pull (``mid_peer_serve`` faultpoint): the
+    puller recomputes with zero client-visible errors and the peer's
+    tiers stay intact.
+"""
+
+import asyncio
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.engine.allocator import BlockAllocator, sequence_block_hashes
+from dynamo_tpu.engine.offload import DiskKvStore, OffloadManager
+from dynamo_tpu.kv_router import (
+    KvIndexer,
+    KvPeerServer,
+    KvPrefetchListener,
+    KvRouter,
+    RouterEvent,
+)
+from dynamo_tpu.kv_router.protocols import (
+    KV_PREFETCH_SUBJECT,
+    KvCacheEvent,
+    KvPrefetchHint,
+    StoredBlock,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.resilience import faultpoints
+from dynamo_tpu.runtime import Context, DistributedRuntime, LocalBus, LocalStore, collect
+
+
+def _req(tokens, max_tokens=2):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0, seed=0),
+        eos_token_ids=[511],
+    )
+
+
+def _cfg(disk_path, **kw):
+    base = dict(
+        model=ModelConfig.tiny(), num_blocks=17, block_size=4,
+        max_batch_size=2, max_context=64, prefill_chunk=32,
+        host_cache_blocks=8, disk_cache_blocks=64,
+        disk_cache_path=str(disk_path),
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _hashes(tokens, bs=4):
+    return [s for _l, s in sequence_block_hashes(tokens, bs)]
+
+
+# ---------------- DiskKvStore: format, LRU/TTL, crash safety ----------------
+
+
+def _blk(seed, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((2, 2, 4, 8)).astype(dtype)
+    v = rng.standard_normal((2, 2, 4, 8)).astype(dtype)
+    return k, v
+
+
+def test_disk_store_roundtrip_lru_and_restart_rescan(tmp_path):
+    import ml_dtypes
+
+    store = DiskKvStore(str(tmp_path), capacity_blocks=2)
+    k1, v1 = _blk(1, np.dtype(ml_dtypes.bfloat16))
+    assert store.put(101, k1, v1)
+    got = store.get(101)
+    assert got is not None
+    assert got[0].dtype == k1.dtype
+    assert np.array_equal(got[0].view(np.uint8), k1.view(np.uint8))
+    assert np.array_equal(got[1].view(np.uint8), v1.view(np.uint8))
+
+    # LRU at capacity 2: inserting a third evicts the least recent,
+    # removes its file, and queues the drop for the residency plane
+    store.put(102, *_blk(2))
+    store.get(101)  # 101 is now most recent
+    store.put(103, *_blk(3))
+    assert store.get(102) is None and len(store) == 2
+    assert 102 in store.drain_dropped()
+    assert not os.path.exists(os.path.join(str(tmp_path), f"{102:016x}.kvb"))
+
+    # restart: a fresh store over the same directory rebuilds the index
+    # (leftover temp files from a crashed writer are ignored)
+    open(os.path.join(str(tmp_path), "garbage.tmp"), "wb").write(b"junk")
+    store2 = DiskKvStore(str(tmp_path), capacity_blocks=8)
+    assert len(store2) == 2 and store2.contains(101) and store2.contains(103)
+    again = store2.get(101)
+    assert again is not None
+    assert np.array_equal(again[0].view(np.uint8), k1.view(np.uint8))
+
+
+def test_disk_store_ttl_expires_entries(tmp_path):
+    store = DiskKvStore(str(tmp_path), capacity_blocks=8, ttl_s=0.05)
+    store.put(7, *_blk(7))
+    assert store.get(7) is not None
+    time.sleep(0.12)
+    assert store.get(7) is None, "TTL-expired entry must read as a miss"
+    assert 7 in store.drain_dropped()
+    assert store.corrupt_discards == 0  # expiry is eviction, not corruption
+
+
+def test_disk_store_truncated_corrupt_and_version_mismatch(tmp_path):
+    """The crash-safety contract: every malformed shape is a clean miss
+    with a counter bump — never an exception on the restore path."""
+    path = str(tmp_path)
+
+    def entry_file(h):
+        return os.path.join(path, f"{h:016x}.kvb")
+
+    def fresh(h):
+        s = DiskKvStore(path, capacity_blocks=8)
+        s.put(h, *_blk(h))
+        return s
+
+    # truncated payload (crash mid-write of a non-atomic filesystem, or
+    # a torn copy): size check fails
+    fresh(11)
+    raw = open(entry_file(11), "rb").read()
+    open(entry_file(11), "wb").write(raw[: len(raw) // 2])
+    s = DiskKvStore(path, capacity_blocks=8)
+    assert s.get(11) is None and s.corrupt_discards == 1
+    assert not os.path.exists(entry_file(11)), "corrupt entry must be removed"
+    assert 11 in s.drain_dropped()
+
+    # flipped payload byte (bit rot): CRC check fails
+    fresh(12)
+    raw = bytearray(open(entry_file(12), "rb").read())
+    raw[-3] ^= 0xFF
+    open(entry_file(12), "wb").write(bytes(raw))
+    s = DiskKvStore(path, capacity_blocks=8)
+    assert s.get(12) is None and s.corrupt_discards == 1
+
+    # version-mismatched header (an old/newer writer's format)
+    fresh(13)
+    raw = open(entry_file(13), "rb").read()
+    (hlen,) = struct.unpack("<I", raw[4:8])
+    head = raw[8 : 8 + hlen].replace(b'"v": 1', b'"v": 9')
+    open(entry_file(13), "wb").write(
+        raw[:4] + struct.pack("<I", len(head)) + head + raw[8 + hlen :]
+    )
+    s = DiskKvStore(path, capacity_blocks=8)
+    assert s.get(13) is None and s.corrupt_discards == 1
+
+    # bad magic (not our file at all)
+    fresh(14)
+    raw = open(entry_file(14), "rb").read()
+    open(entry_file(14), "wb").write(b"NOPE" + raw[4:])
+    s = DiskKvStore(path, capacity_blocks=8)
+    assert s.get(14) is None and s.corrupt_discards == 1
+
+
+# ---------------- engine-level: demote -> disk -> restore ----------------
+
+
+async def _park_on_disk(engine, prompt, min_blocks=5):
+    """Serve ``prompt`` once, churn until its restore chain (the
+    prompt's claimable full blocks) has been demoted host -> disk;
+    returns the greedy tokens of the first serve."""
+    # warm the resume-prefill bucket (same reasoning as
+    # test_offload_pipeline._park_in_host_tier)
+    await collect(engine.generate(Context(_req(range(450, 462), 2))))
+    out = await collect(engine.generate(Context(_req(prompt, 2))))
+    toks = [t for o in out for t in o.token_ids]
+    for i in range(6):
+        filler = list(range(200 + 30 * i, 200 + 30 * i + 24))
+        await collect(engine.generate(Context(_req(filler, 2))))
+    chain = _hashes(prompt)[: min_blocks]
+    for _ in range(300):
+        if engine.offload.disk.match_chain(chain) >= min_blocks:
+            return toks
+        await asyncio.sleep(0.02)
+    raise AssertionError(
+        f"chain never reached the disk tier "
+        f"(disk={len(engine.offload.disk)}, host={len(engine.offload.pool)})"
+    )
+
+
+def test_host_overflow_demotes_to_disk_and_restores_bit_exact(run, tmp_path):
+    """The three-tier pipeline end to end: device eviction -> host pool
+    -> (LRU overflow) -> disk, then a repeat prompt promotes the chain
+    back through host DRAM and the restored stream is bit-identical."""
+    engine = JaxEngine(_cfg(tmp_path), seed=0)
+    prompt = list(range(100, 124))
+
+    async def main():
+        toks1 = await _park_on_disk(engine, prompt)
+        stats = engine.offload.stats()
+        assert stats["disk_blocks_resident"] >= 5
+        assert stats["disk_demotions_total"] >= 5
+        hits_before = engine.offload.disk.hit_blocks_total
+
+        out2 = await collect(engine.generate(Context(_req(prompt, 2))))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert toks2 == toks1, "disk roundtrip corrupted the restored prefix"
+        assert engine.offload.disk.hit_blocks_total >= hits_before + 5
+
+        # the satellite's per-tier stats surface through load_metrics
+        m = engine.load_metrics()
+        for key in ("disk_blocks_resident", "disk_hit_blocks_total",
+                    "peer_pull_blocks_total", "peer_pull_hidden_frac"):
+            assert key in m, key
+        assert m["disk_hit_blocks_total"] >= 5
+        await engine.close()
+
+    run(main())
+
+
+def test_corrupt_disk_entry_is_clean_miss_on_restore_path(run, tmp_path):
+    """Corrupting the chain's first on-disk block makes the whole serve
+    a recompute — same tokens, a corrupt_discards bump, no exception."""
+    engine = JaxEngine(_cfg(tmp_path), seed=0)
+    prompt = list(range(100, 124))
+
+    async def main():
+        toks1 = await _park_on_disk(engine, prompt)
+        h0 = _hashes(prompt)[0]
+        f = os.path.join(str(tmp_path), f"{h0:016x}.kvb")
+        raw = bytearray(open(f, "rb").read())
+        raw[-5] ^= 0xFF
+        open(f, "wb").write(bytes(raw))
+
+        out2 = await collect(engine.generate(Context(_req(prompt, 2))))
+        toks2 = [t for o in out2 for t in o.token_ids]
+        assert toks2 == toks1
+        assert engine.offload.disk.corrupt_discards >= 1
+        assert engine.offload.stats()["disk_corrupt_discards"] >= 1
+        await engine.close()
+
+    run(main())
+
+
+# ---------------- tier-aware residency events ----------------
+
+
+def test_allocator_demotes_instead_of_removes_with_offload_tier():
+    """With on_evict + on_demoted wired (an offload tier + publisher),
+    a reuse-pool eviction publishes demotion — the worker still holds
+    the KV one tier down — not removal."""
+    demoted, removed, evicted = [], [], []
+    alloc = BlockAllocator(num_blocks=2, block_size=4)
+    alloc.on_evict = lambda h, b: evicted.append(h)
+    alloc.on_demoted = lambda hs: demoted.extend(hs)
+    alloc.on_removed = lambda hs: removed.extend(hs)
+    (b,) = alloc.allocate(1)
+    h = alloc.commit_full_block(b, [1, 2, 3, 4], None)
+    alloc.free([b])
+    # pool exhausted -> the reuse entry is evicted for the new claim
+    (b2,) = alloc.allocate(1)
+    assert evicted == [h] and demoted == [h] and removed == []
+    alloc.free([b2])
+
+    # without a tier (on_evict unset), eviction is a removal as before
+    alloc2 = BlockAllocator(num_blocks=2, block_size=4)
+    removed2 = []
+    alloc2.on_removed = lambda hs: removed2.extend(hs)
+    (c,) = alloc2.allocate(1)
+    h2 = alloc2.commit_full_block(c, [1, 2, 3, 4], None)
+    alloc2.free([c])
+    (c2,) = alloc2.allocate(1)
+    assert removed2 == [h2]
+    alloc2.free([c2])
+
+
+def test_indexer_overlay_demoted_keeps_residency_drops_device_depth():
+    idx = KvIndexer(None, None)
+    tokens = list(range(16))  # 4 blocks
+    pairs = sequence_block_hashes(tokens, 4)
+    blocks = [StoredBlock(block_hash=s, tokens_hash=l) for l, s in pairs]
+    idx.apply_event(RouterEvent(1, KvCacheEvent.stored(None, blocks)))
+    hashes = [s for _l, s in pairs]
+
+    # demote block 1: tier-inclusive score unchanged, device depth = 1
+    idx.apply_event(RouterEvent(1, KvCacheEvent.demoted([hashes[1]])))
+    scores = idx.find_matches(hashes)
+    assert scores.scores == {1: 4}
+    assert scores.device(1) == 1
+
+    # a restore re-stores it: device depth recovers
+    idx.apply_event(RouterEvent(1, KvCacheEvent.stored(
+        hashes[0], [StoredBlock(block_hash=hashes[1],
+                                tokens_hash=pairs[1][0])])))
+    scores = idx.find_matches(hashes)
+    assert scores.device(1) == 4
+
+    # a real removal (left the last tier) drops the residency itself
+    idx.apply_event(RouterEvent(1, KvCacheEvent.demoted([hashes[2]])))
+    idx.apply_event(RouterEvent(1, KvCacheEvent.removed([hashes[2]])))
+    scores = idx.find_matches(hashes)
+    assert scores.scores == {1: 2}
+    assert scores.device(1) == 2
+    # the overlay forgets removed entries (no leak)
+    assert (1, hashes[2]) not in idx._offloaded
+
+    idx.remove_worker(1)
+    assert not idx._offloaded
+
+
+def test_router_hint_names_deeper_peer(run):
+    """Routing to a worker whose tiers miss while another worker's radix
+    chain covers the prompt must stamp that peer into the hint; and a
+    worker holding the chain only in its OFFLOAD tiers is still routed
+    to (tier-inclusive overlap) but still hinted (device depth short)."""
+    from dynamo_tpu.kv_router.scheduler import ProcessedEndpoints, WorkerLoad
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        router = await KvRouter(drt, comp, block_size=4).start()
+        prompt = list(range(300, 324))  # 6 blocks
+        pairs = sequence_block_hashes(prompt, 4)
+        blocks = [StoredBlock(block_hash=s, tokens_hash=l) for l, s in pairs]
+
+        # worker 2 holds the whole chain but is heavily loaded; balance
+        # mode routes to idle worker 1 -> the hint must name peer 2
+        router.indexer.apply_event(RouterEvent(2, KvCacheEvent.stored(None, blocks)))
+        router.metrics.endpoints = ProcessedEndpoints([
+            WorkerLoad(worker_id=1, kv_active_blocks=5, kv_total_blocks=100,
+                       active_requests=0, total_slots=8),
+            WorkerLoad(worker_id=2, kv_active_blocks=95, kv_total_blocks=100,
+                       active_requests=7, total_slots=8),
+        ])
+        sub = bus.subscribe(comp.event_subject(KV_PREFETCH_SUBJECT))
+        wid, _overlap = await router.schedule(prompt)
+        assert wid == 1
+        msg = await sub.next(1.0)
+        assert msg is not None
+        hint = KvPrefetchHint.from_bytes(msg.payload)
+        assert hint.worker_id == 1
+        assert hint.peer_worker_id == 2
+        assert hint.peer_blocks == 5  # claimable chain (block-multiple prompt)
+        router.request_finished(wid)
+
+        # now worker 1 holds the chain too — but demoted to its offload
+        # tiers: still routed (residency counts), still hinted (the
+        # pre-arrival restore is the point), no deeper peer than itself
+        router.indexer.apply_event(RouterEvent(1, KvCacheEvent.stored(None, blocks)))
+        router.indexer.apply_event(
+            RouterEvent(1, KvCacheEvent.demoted([s for _l, s in pairs]))
+        )
+        router.metrics.endpoints = ProcessedEndpoints([
+            WorkerLoad(worker_id=1, kv_active_blocks=5, kv_total_blocks=100,
+                       active_requests=0, total_slots=8),
+        ])
+        wid, overlap = await router.schedule(prompt)
+        assert wid == 1 and overlap == 6
+        msg = await sub.next(1.0)
+        assert msg is not None, "demoted-tier coverage must still be hinted"
+        hint = KvPrefetchHint.from_bytes(msg.payload)
+        assert hint.worker_id == 1
+        await drt.shutdown()
+
+    run(main())
+
+
+# ---------------- peer-to-peer prefix pulls ----------------
+
+
+def _peer_cfg(disk_path, **kw):
+    # bigger device pool than _cfg: the puller must not evict the pulled
+    # prefix mid-test; the peer still churns its chain into host tier
+    base = dict(
+        model=ModelConfig.tiny(), num_blocks=33, block_size=4,
+        max_batch_size=2, max_context=64, prefill_chunk=32,
+        host_cache_blocks=64,
+    )
+    if disk_path is not None:
+        base.update(disk_cache_blocks=64, disk_cache_path=str(disk_path))
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _park_in_host_tier(engine, prompt, min_blocks=5):
+    await collect(engine.generate(Context(_req(range(450, 462), 2))))
+    out = await collect(engine.generate(Context(_req(prompt, 2))))
+    toks = [t for o in out for t in o.token_ids]
+    for i in range(6):
+        filler = list(range(200 + 30 * i, 200 + 30 * i + 24))
+        await collect(engine.generate(Context(_req(filler, 2))))
+    chain = _hashes(prompt)[:min_blocks]
+    for _ in range(300):
+        covered = 0
+        for h in chain:
+            if engine.offload.tier_contains(h):
+                covered += 1
+            else:
+                break
+        if covered >= min_blocks:
+            return toks
+        await asyncio.sleep(0.02)
+    raise AssertionError("chain never parked in the peer's offload tiers")
+
+
+def test_peer_pull_lands_promotes_and_claims_bit_exact(run, tmp_path):
+    """The whole fleet-tier path over a real bus + real TCP: the hint
+    names a peer, the puller fetches the chain from the peer's host/disk
+    tiers, lands it in its own host tier, the prefetch restore promotes
+    it to device, and the request claims it — bit-identical tokens and
+    peer_pull_hidden_frac accounting for fully-hidden transfers."""
+    # the peer (worker 1) holds the prefix; small device pool + disk so
+    # part of the chain may serve from either tier. The puller (worker
+    # 2) is cold.
+    peer_eng = JaxEngine(_cfg(tmp_path / "peer"), seed=0)
+    pull_eng = JaxEngine(_peer_cfg(None), seed=0)
+    prompt = list(range(100, 124))
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        server = await KvPeerServer(drt, comp, 1, peer_eng).start()
+        listener = await KvPrefetchListener(drt, comp, 2, pull_eng).start()
+        try:
+            toks_ref = await _park_on_disk(peer_eng, prompt)
+            pairs = sequence_block_hashes(prompt, 4)
+            hint = KvPrefetchHint(
+                2, [[l, s] for l, s in pairs[:5]],
+                peer_worker_id=1, peer_blocks=5,
+            )
+            bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                        hint.to_bytes())
+            for _ in range(500):
+                if listener.blocks_prefetched >= 5:
+                    break
+                await asyncio.sleep(0.02)
+            assert listener.peer_pulls == 1
+            assert listener.peer_pull_blocks >= 5
+            assert listener.blocks_prefetched >= 5, (
+                "pulled chain never promoted to the puller's device tier"
+            )
+            assert server.blocks_served >= 5
+            st = pull_eng.offload.stats()
+            assert st["peer_pull_blocks_total"] >= 5
+
+            # the hinted request arrives: claims the pulled blocks as
+            # ordinary device prefix hits, stream bit-identical to the
+            # peer's own
+            out = await collect(pull_eng.generate(Context(_req(prompt, 2))))
+            toks = [t for o in out for t in o.token_ids]
+            assert toks == toks_ref, "peer-pulled prefix diverged"
+            st = pull_eng.offload.stats()
+            assert st["peer_pull_hidden_frac"] > 0, (
+                "claimed peer blocks must count as hidden transfer"
+            )
+            assert pull_eng.offload.h2d_prefetch_hits >= 5
+            # serving was non-destructive on the peer
+            assert peer_eng.offload.stats()["peer_serve_blocks_total"] >= 5
+        finally:
+            await listener.close()
+            await server.close()
+            await peer_eng.close()
+            await pull_eng.close()
+            await drt.shutdown()
+
+    run(main())
+
+
+def test_worker_death_mid_peer_pull_degrades_to_recompute(run, tmp_path):
+    """Arm the mid_peer_serve faultpoint as a kill: the peer dies before
+    pushing (crash-like — no data, no ack). The puller must time out,
+    count a failure, and serve the request by recomputing with zero
+    client-visible errors; the peer's tiers stay intact."""
+    peer_eng = JaxEngine(_peer_cfg(tmp_path / "peer"), seed=0)
+    pull_eng = JaxEngine(_peer_cfg(None), seed=0)
+    prompt = list(range(100, 124))
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        server = await KvPeerServer(drt, comp, 1, peer_eng).start()
+        listener = await KvPrefetchListener(
+            drt, comp, 2, pull_eng, pull_timeout=0.6
+        ).start()
+        try:
+            toks_ref = await _park_in_host_tier(peer_eng, prompt)
+            pool_before = len(peer_eng.offload.pool)
+            faultpoints.arm("mid_peer_serve", "kill", after=1, times=1)
+            pairs = sequence_block_hashes(prompt, 4)
+            hint = KvPrefetchHint(
+                2, [[l, s] for l, s in pairs[:5]],
+                peer_worker_id=1, peer_blocks=5,
+            )
+            bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                        hint.to_bytes())
+            for _ in range(300):
+                if listener.peer_pull_failures >= 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert listener.peer_pull_failures == 1
+            assert listener.peer_pull_blocks == 0
+            assert len(faultpoints.FAULTS.history) == 1, "kill never fired"
+
+            # the request still serves — full recompute, same stream
+            out = await collect(pull_eng.generate(Context(_req(prompt, 2))))
+            toks = [t for o in out for t in o.token_ids]
+            assert toks == toks_ref
+            assert pull_eng.offload.stats()["peer_pull_blocks_total"] == 0
+
+            # the dead-peer simulation never touched the peer's tiers:
+            # the pool is unchanged and the chain is still fully
+            # serveable (export is non-destructive, so the failed
+            # attempt consumed nothing)
+            assert len(peer_eng.offload.pool) == pool_before
+            served, _k, _v = peer_eng.offload.export_chain(
+                [s for _l, s in pairs[:5]]
+            )
+            assert len(served) == 5
+        finally:
+            faultpoints.reset()
+            await listener.close()
+            await server.close()
+            await peer_eng.close()
+            await pull_eng.close()
+            await drt.shutdown()
+
+    run(main())
+
+
+def test_peer_miss_answers_immediately_not_timeout(run):
+    """A peer whose tiers don't hold the chain answers with an error
+    delivery so the puller fails fast instead of waiting out its
+    timeout."""
+    peer_eng = JaxEngine(_peer_cfg(None), seed=0)
+    pull_eng = JaxEngine(_peer_cfg(None), seed=0)
+
+    async def main():
+        store, bus = LocalStore(), LocalBus()
+        drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+        comp = drt.namespace("dyn").component("worker")
+        server = await KvPeerServer(drt, comp, 1, peer_eng).start()
+        listener = await KvPrefetchListener(
+            drt, comp, 2, pull_eng, pull_timeout=30.0
+        ).start()
+        try:
+            pairs = sequence_block_hashes(list(range(100, 124)), 4)
+            hint = KvPrefetchHint(
+                2, [[l, s] for l, s in pairs[:5]],
+                peer_worker_id=1, peer_blocks=5,
+            )
+            t0 = time.monotonic()
+            bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                        hint.to_bytes())
+            for _ in range(300):
+                if listener.peer_pull_failures >= 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert listener.peer_pull_failures == 1
+            assert time.monotonic() - t0 < 10.0, "miss must not wait timeout"
+            assert server.misses == 1
+        finally:
+            await listener.close()
+            await server.close()
+            await peer_eng.close()
+            await pull_eng.close()
+            await drt.shutdown()
+
+    run(main())
+
+
+# ---------------- stats plumbing ----------------
+
+
+def test_prefix_fleet_stats_flow_to_worker_load_and_gauges():
+    from dynamo_tpu.kv_router.scheduler import ProcessedEndpoints, WorkerLoad
+    from dynamo_tpu.observability.component import MetricsComponent
+
+    w = WorkerLoad(
+        worker_id=7, disk_blocks_resident=12, disk_hit_blocks=34,
+        peer_pull_blocks=56, peer_pull_hidden_frac=0.75,
+    )
+    mc = MetricsComponent.__new__(MetricsComponent)
+    mc.prefix = "dynamo_tpu"
+    mc.aggregator = type(
+        "A", (), {"endpoints": ProcessedEndpoints([w])}
+    )()
+    mc.hit_events = 0
+    mc.hit_isl_blocks = 0
+    mc.hit_overlap_blocks = 0
+    mc.planner_decision = None
+    mc.planner_watermark = None
+    mc.planner_decisions_total = 0
+    mc.tracing = None
+    text = mc.render()
+    assert 'dynamo_tpu_disk_blocks_resident{worker="7"} 12' in text
+    assert 'dynamo_tpu_disk_hit_blocks_total{worker="7"} 34' in text
+    assert 'dynamo_tpu_peer_pull_blocks_total{worker="7"} 56' in text
+    assert 'dynamo_tpu_peer_pull_hidden_frac{worker="7"} 0.75' in text
+
+
+def test_export_chain_serves_longest_run_nondestructively():
+    om = OffloadManager(8)
+    k0, v0 = _blk(0)
+    k1, v1 = _blk(1)
+    om.pool.put(10, k0, v0)
+    om.pool.put(11, k1, v1)
+    # hash 12 missing: the run stops there even though 13 is resident
+    om.pool.put(13, *_blk(3))
+    hashes, k, v = om.export_chain([10, 11, 12, 13])
+    assert hashes == [10, 11]
+    assert k.shape[2] == 2
+    assert np.array_equal(k[:, :, 0], k0) and np.array_equal(k[:, :, 1], k1)
+    # non-destructive: everything still resident, a second export works
+    assert len(om.pool) == 3
+    again, _k, _v = om.export_chain([10, 11])
+    assert again == [10, 11]
+    # total miss
+    none, nk, nv = om.export_chain([99])
+    assert none == [] and nk is None and nv is None
+    om.close()
+
+
+def test_staging_cap_truncates_tail_never_evicts_chain_head():
+    """A chain longer than the staging cap keeps its PREFIX (the part a
+    consecutive-match restore can actually use) — FIFO-evicting the
+    chain's own head would zero the whole restore."""
+    om = OffloadManager(1)  # staging cap floor = 64
+    n = 100
+    k = np.stack(
+        [np.full((1, 1, 1, 1), i, np.float32) for i in range(n)], axis=2
+    )
+    v = k.copy()
+    hashes = list(range(1000, 1000 + n))
+    landed = om.land_peer_chain(hashes, k, v)
+    assert landed == 64, "landing must truncate at the cap, not overfill"
+    got, data = om.reserve_chain(hashes)
+    assert got == hashes[:64], "the chain PREFIX must survive staging"
+    assert float(data[0][0][0, 0, 0, 0]) == 0.0  # head block, head value
+    om.close()
+
+
+def test_land_peer_chain_claim_accounting():
+    om = OffloadManager(8)
+    k = np.stack([_blk(i)[0] for i in range(3)], axis=2)
+    v = np.stack([_blk(i)[1] for i in range(3)], axis=2)
+    assert om.land_peer_chain([21, 22, 23], k, v) == 3
+    assert om.peer_pull_blocks_total == 3
+    assert om.stats()["peer_pull_hidden_frac"] == 0.0
+    # a duplicate landing is skipped (content-addressed, already here)
+    assert om.land_peer_chain([21], k[:, :, :1], v[:, :, :1]) == 0
+    # two of the three get claimed by a request's admission
+    om.note_prefetch_hits(2, hashes=[21, 22])
+    st = om.stats()
+    assert st["peer_pull_blocks_total"] == 3
+    assert st["peer_pull_blocks_claimed"] == 2
+    assert st["peer_pull_hidden_frac"] == pytest.approx(2 / 3)
+    om.close()
