@@ -1,0 +1,230 @@
+"""ICI collective fast path for same-slice cross-mesh KV handoff.
+
+The streamed disagg handoff (PR 6) already keeps same-process segments
+device-resident through ``LocalKvPipe`` — but the landing side scatters
+whatever layout the prefill engine's gather produced, and when the two
+engines carve the slice into DIFFERENT meshes (prefill tp=2 feeding
+decode tp=1, a pipeline stage feeding a flat decode pool) the implicit
+re-layout XLA inserts at scatter time is an unplanned, per-op resolved
+placement. This module makes the cheapest path explicit and negotiated:
+
+* :func:`parallel.mesh.slice_fingerprint` identifies the physical slice;
+  the decode side advertises ``kv_ici`` + its fingerprint in connection
+  info (version-negotiated exactly like ``kv_stream`` — an old peer
+  never sees the flag, a mismatched peer falls back to the TCP/streamed
+  path), the prefill worker stamps ``ici: 1`` into the stream header
+  only when its own fingerprint matches.
+
+* :class:`IciSegmentMover` re-lays each arriving segment from the
+  source engine's sharding onto the decode cache's sharding with a
+  COMPILED program: an explicit ``shard_map`` over the slice's devices
+  when the two shardings already agree shard-for-shard (the common
+  same-topology case — the collective is the identity permutation, and
+  the shard_map body structurally forbids a host hop), else a jitted
+  identity with ``out_shardings``, the re-layout XLA lowers to the
+  slice's own ``collective_permute``/all-gather over ICI. Either way
+  the bytes never leave the devices: no gather→host→scatter hop, which
+  is the whole point.
+
+Programs are memoized by SEGMENT-GEOMETRY BUCKET (the same power-of-two
+bucketing as the streamed scatter, ``offload._pad_idxs``), so a stream
+of varying segment sizes compiles one mover program per bucket — the
+``test_compiled_perf`` contract. Falls back cleanly: any negotiation or
+geometry mismatch simply leaves the existing streamed path in charge,
+and the ``_StreamAssembler`` redelivery/idempotency contract is
+untouched because the mover is a pure per-segment transform applied
+before the (idempotent) scatter.
+
+The decode engine's cost model observes each moved segment's wall time
+as the ``ici`` link class — which is what makes the router actually
+prefer same-slice placement once the fast path exists (costmodel.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import slice_fingerprint
+
+logger = logging.getLogger(__name__)
+
+#: negotiated in connection info (decode side) and echoed in the stream
+#: header (prefill side). Receivers ignore unknown header keys (codec
+#: forward-compat), so version skew degrades to the plain streamed path
+KV_ICI_VERSION = 1
+
+
+def ici_negotiated(connection: dict, engine, enabled: bool = True) -> bool:
+    """Prefill-side gate: may this handoff take the ICI path? Requires
+    the decode peer to have advertised a covering ``kv_ici`` version AND
+    the same slice fingerprint as this engine's devices; multi-host
+    mirrors are excluded (their extract is a lockstep broadcast that
+    never yields in-process device arrays)."""
+    if not enabled or getattr(engine, "mirror", None) is not None:
+        return False
+    try:
+        return (
+            int(connection.get("kv_ici") or 0) >= KV_ICI_VERSION
+            and str(connection.get("ici_fp") or "") == slice_fingerprint()
+        )
+    except (TypeError, ValueError):
+        return False
+
+
+def _bucket_blocks(n: int) -> int:
+    """Power-of-two segment-size bucket, same rule as offload._pad_idxs
+    (kept in lockstep by test_ici_mover_program_count_bounded)."""
+    from ..engine.offload import _pad_idxs
+
+    return len(_pad_idxs(list(range(n))))
+
+
+class IciSegmentMover:
+    """Per-handoff device→device segment re-layout onto the decode
+    cache's shardings. Construct once per negotiated stream (the decode
+    sink owns it); ``move(k_seg, v_seg)`` returns the pair placed for
+    the decode scatter, still on device."""
+
+    def __init__(self, k_sharding, v_sharding):
+        # decode-side cache shardings for [L, Hkv, n, bs, D] segments
+        # (None = unsharded single-device engine: the mover still runs
+        # its compiled program over a 1-device mesh so the path — and
+        # its program-count contract — is exercised everywhere)
+        self._k_sh = k_sharding
+        self._v_sh = v_sharding
+        self._fns: dict = {}
+        self.segments_moved = 0
+        self.permute_programs = 0
+        self.reshard_programs = 0
+
+    def programs(self) -> int:
+        return len(self._fns)
+
+    # ---- program construction ----
+
+    def _dst_sharding(self, which: str):
+        sh = self._k_sh if which == "k" else self._v_sh
+        if sh is not None:
+            return sh
+        # unsharded engine: replicate over a 1-device mesh — the
+        # degenerate slice, where the permutation is the identity
+        return NamedSharding(Mesh(jax.devices()[:1], ("ici",)), P())
+
+    @staticmethod
+    def _one_axis_split(sharding, shape) -> Optional[tuple[int, list]]:
+        """Describe ``sharding`` over ``shape`` as an even split of at
+        most ONE array axis across its devices: returns (axis, devices
+        in shard order) — axis -1 when every device holds the whole
+        array (replicated / single device). None for anything richer
+        (multi-axis splits take the reshard program instead)."""
+        try:
+            idx_map = sharding.devices_indices_map(tuple(shape))
+        except Exception:  # noqa: BLE001 — exotic sharding
+            return None
+        split_axis = None
+        keyed = []
+        for d, idx in idx_map.items():
+            axes = [
+                a for a, s in enumerate(idx)
+                if not (s.start in (0, None) and s.stop in (None, shape[a]))
+            ]
+            if len(axes) > 1:
+                return None
+            if axes:
+                a = axes[0]
+                if split_axis is None:
+                    split_axis = a
+                elif split_axis != a:
+                    return None
+                keyed.append((idx[a].start or 0, d))
+            else:
+                keyed.append((0, d))
+        if split_axis is None:
+            return -1, sorted((d for _s, d in keyed), key=lambda d: d.id)
+        keyed.sort(key=lambda t: t[0])
+        starts = [s for s, _d in keyed]
+        if len(set(starts)) != len(starts):
+            return None  # partial replication inside the split
+        return split_axis, [d for _s, d in keyed]
+
+    def _build(self, src_sharding, dst_sharding, shape, dtype):
+        """One compiled mover program for this geometry bucket.
+
+        Matched geometry — both engines split the same single axis into
+        the same shard-per-device layout (including the degenerate
+        replicated / 1-device slice) — compiles an explicit ``shard_map``
+        program over the slice's devices: the per-segment collective is
+        the identity permutation there, and the program pins the
+        device-resident contract structurally (a host round-trip cannot
+        hide inside a shard_map body). Anything richer — a tp regroup,
+        a pp re-stage, shards in a different device order — compiles a
+        jitted identity with ``out_shardings``: the one re-layout API
+        XLA lowers to the slice's own collective_permute / all-gather
+        over ICI. Both flavors stay device→device end to end; which one
+        a handoff compiled is visible in ``permute_programs`` vs
+        ``reshard_programs``."""
+        from ..ops._pallas_compat import shard_map as _smap
+
+        src = self._one_axis_split(src_sharding, shape) if src_sharding else None
+        dst = self._one_axis_split(dst_sharding, shape)
+        matched = (
+            src is not None and dst is not None and src[0] == dst[0]
+            and src[1] == dst[1]
+        )
+        if not matched:
+            self.reshard_programs += 1
+            return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry bucket in self._fns (_move_one)
+                lambda a: a, out_shardings=dst_sharding
+            )
+        axis, devs = dst
+        mesh = Mesh(devs, ("ici",))
+        spec = P() if axis < 0 else P(*([None] * axis), "ici")
+
+        def body(a):
+            # identity permutation: shards are already on the devices
+            # the decode cache wants them on — the shard_map is the
+            # structural no-host-hop guarantee, not a data move
+            return a
+
+        fn = _smap(body, mesh=mesh, in_specs=spec, out_specs=spec)
+        self.permute_programs += 1
+        return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry bucket in self._fns (_move_one)
+            fn, out_shardings=dst_sharding
+        )
+
+    # ---- the hot path ----
+
+    def _move_one(self, x, which: str):
+        dst = self._dst_sharding(which)
+        n = int(x.shape[2])
+        bucket = _bucket_blocks(n)
+        if n < bucket:
+            # pad to the geometry bucket BEFORE the compiled move so the
+            # program keys on buckets, not per-request segment sizes
+            # (eager pad, exactly like the streamed scatter's device
+            # branch); the slice back below is a device-side view op
+            pad = [(0, 0)] * x.ndim
+            pad[2] = (0, bucket - n)
+            x = jnp.pad(x, pad)
+        key = (
+            which, tuple(x.shape), str(x.dtype),
+            getattr(x, "sharding", None) and repr(x.sharding),
+        )
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = self._build(
+                getattr(x, "sharding", None), dst, x.shape, x.dtype
+            )
+        out = fn(x)
+        return out[:, :, :n] if n < bucket else out
+
+    def move(self, k_seg, v_seg):
+        k = self._move_one(k_seg, "k")
+        v = self._move_one(v_seg, "v")
+        self.segments_moved += 1
+        return k, v

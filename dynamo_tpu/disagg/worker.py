@@ -59,6 +59,7 @@ class PrefillWorker:
         kv_stream: bool = True,
         segment_blocks: int = 0,
         concurrency: int = 1,
+        kv_ici: bool = True,
     ):
         self.engine = engine
         self.queue = queue
@@ -73,6 +74,13 @@ class PrefillWorker:
         # its connection info — old peers keep getting the bulk protocol
         self.kv_stream = kv_stream
         self.segment_blocks = segment_blocks
+        # ICI same-slice fast path (disagg/ici.py): stamp streamed
+        # headers ``ici`` when the decode peer advertised a covering
+        # kv_ici version AND the same slice fingerprint — the decode
+        # sink then re-lays segments device→device instead of letting
+        # the scatter resolve a foreign placement implicitly. Any
+        # mismatch silently keeps the plain streamed/TCP path.
+        self.kv_ici = kv_ici
         # consume-loop fan-out: with the engine's streamed extract taking
         # the device lock per CHUNK, N concurrent prompts interleave
         # chunk-wise and each streams its segments as its own chunks
@@ -87,6 +95,7 @@ class PrefillWorker:
         self.stats = {
             "prefills_total": 0, "prefill_errors": 0, "nacks": 0,
             "kv_stream_sends": 0, "kv_stream_segments": 0, "kv_bulk_sends": 0,
+            "kv_ici_sends": 0,
         }
 
     def start(self) -> None:
@@ -184,8 +193,17 @@ class PrefillWorker:
         try:
             # in-process pipe => same device slice: keep KV on device end to
             # end (gather -> pipe -> decode scatter, no host hop); the TCP
-            # path needs host bytes anyway
+            # path needs host bytes anyway. A local-advertising decode may
+            # ALSO carry a TCP connect-back address (DisaggEngine
+            # tcp_fallback) — a pipe-less worker then delivers over TCP,
+            # which is what lets one queue mix same-slice and remote
+            # prefill workers (and redeliveries cross between them).
             local = bool(rpr.connection.get("local")) and self.local_pipe is not None
+            has_addr = bool(rpr.connection.get("address"))
+            if rpr.connection.get("local") and not local and not has_addr:
+                # no channel at all: nack/redeliver to a worker that has
+                # one instead of failing the request deterministically
+                raise TransferError("local connection without pipe")
             # graceful downgrade: stream only when the decode peer
             # advertised a protocol version covering ours — an old peer
             # (no kv_stream key, or a lower version) silently gets the
@@ -194,7 +212,7 @@ class PrefillWorker:
                 self.kv_stream
                 and int(rpr.connection.get("kv_stream") or 0) >= KV_STREAM_VERSION
                 and hasattr(self.engine, "prefill_extract_stream")
-                and (local or not rpr.connection.get("local"))
+                and (local or has_addr or not rpr.connection.get("local"))
             )
             if streamed:
                 await self._process_streamed(rpr, req, ctx, local)
@@ -226,8 +244,7 @@ class PrefillWorker:
             with send_span:
                 t0 = time.perf_counter()
                 try:
-                    if rpr.connection.get("local"):
-                        assert self.local_pipe is not None, "local connection without pipe"
+                    if local:
                         await self.local_pipe.deliver(
                             rpr.request_id, first, k, v, head_layout=layout, src_tp=tp,
                             first_lp=first_lp,
@@ -275,6 +292,17 @@ class PrefillWorker:
         n_prompt = engine.n_prompt_blocks(len(req.token_ids))
         n = max(n_prompt - rpr.skip_blocks, 0)
         kc, vc = engine.k_cache, engine.v_cache
+        # ICI fast path: only meaningful on the in-process (device
+        # array) channel, and only when the decode peer negotiated it —
+        # a kv-head-layout mismatch drops it too (the decode sink's
+        # regroup owns that case), keeping the fallback matrix clean
+        from .ici import ici_negotiated
+
+        ici = (
+            local
+            and ici_negotiated(rpr.connection, engine, enabled=self.kv_ici)
+            and layout == rpr.connection.get("ici_layout", layout)
+        )
         head = {
             "request_id": rpr.request_id,
             "stream": KV_STREAM_VERSION,
@@ -286,6 +314,11 @@ class PrefillWorker:
             "head_layout": layout,
             "src_tp": tp,
         }
+        if ici:
+            from ..parallel.mesh import slice_fingerprint
+
+            head["ici"] = 1
+            head["ici_fp"] = slice_fingerprint()
         await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
         send_span = tracing.span(
             "prefill.kv_send", request_id=rpr.request_id, local=local,
@@ -393,6 +426,8 @@ class PrefillWorker:
             await stream.finish(first, first_lp)
             ok = True
             self.stats["kv_stream_sends"] += 1
+            if ici:
+                self.stats["kv_ici_sends"] += 1
             # exposed = the post-compute tail (final drain + fin + ack);
             # hidden = ACTUAL send activity that overlapped compute (the
             # pump's measured per-segment send time minus the part that
@@ -401,12 +436,24 @@ class PrefillWorker:
             # ttft.py folds these into the PR 2 decomposition
             now = time.perf_counter()
             exposed_ms = (now - t_done) * 1e3
+            nbytes = n * getattr(engine, "kv_block_bytes", 0)
             send_span.set(
                 exposed_ms=round(exposed_ms, 3),
                 hidden_ms=round(max(send_ms - exposed_ms, 0.0), 3),
                 segments=stream.segments,
                 n_blocks=n,
+                # link class + volume: the span doubles as a transfer-
+                # cost observation (tracing/ttft.cost_observations)
+                link="ici" if ici else ("local" if local else "dcn"),
+                nbytes=nbytes,
             )
+            # calibrate the sender's cost model from its own measured
+            # send activity: cross-host streamed sends are the "dcn"
+            # class (the ici class is observed decode-side, where the
+            # mover+scatter wall is the honest number)
+            cost = getattr(engine, "cost", None)
+            if cost is not None and not local and send_ms > 0 and nbytes:
+                cost.observe("dcn", nbytes, send_ms / 1e3)
         finally:
             if not pump_task.done():
                 pump_task.cancel()
@@ -420,12 +467,11 @@ class PrefillWorker:
 
     async def _notify_error(self, rpr: RemotePrefillRequest, message: str) -> None:
         try:
-            if rpr.connection.get("local"):
-                if self.local_pipe is not None:
-                    await self.local_pipe.deliver(
-                        rpr.request_id, -1, None, None, error=message
-                    )
-            else:
+            if rpr.connection.get("local") and self.local_pipe is not None:
+                await self.local_pipe.deliver(
+                    rpr.request_id, -1, None, None, error=message
+                )
+            elif rpr.connection.get("address"):
                 await send_kv_blocks(
                     rpr.connection, rpr.request_id, -1, None, None, error=message
                 )
@@ -456,6 +502,7 @@ class _RemoteScatterSink:
         self._closed = False
         self._lock = asyncio.Lock()
         self._regroup = None  # (src_tp, dst_tp, src_layout, dst_layout)
+        self._ici = None  # IciSegmentMover when the ICI path negotiated
         self.segments = 0
 
     async def begin(self, head: dict) -> bool:
@@ -466,6 +513,7 @@ class _RemoteScatterSink:
         layout = head.get("head_layout", "blocked")
         src_tp = head.get("src_tp", 1)
         self._regroup = None
+        self._ici = None
         from ..ops.kv_rearrange import layout_mismatched
 
         if layout_mismatched(layout, src_tp, my_layout, my_tp):
@@ -486,6 +534,30 @@ class _RemoteScatterSink:
             except Exception:  # noqa: BLE001 — bad peer metadata
                 return False
             self._regroup = (src_tp, my_tp, layout, my_layout)
+        if head.get("ici") and self._regroup is None:
+            # ICI fast path: the sender negotiated the same-slice
+            # device→device handoff (fingerprint re-checked here —
+            # defense against a stale connection dict) and the layouts
+            # agree. Mover construction failure just leaves the plain
+            # streamed landing in charge; the stream stays valid.
+            from ..disagg.ici import IciSegmentMover
+            from ..parallel.mesh import cache_sharding, slice_fingerprint
+
+            try:
+                if head.get("ici_fp") in (None, slice_fingerprint()):
+                    eng = self._engine
+                    sh = (
+                        cache_sharding(eng.mesh, eng.cfg.model)
+                        if eng.mesh is not None else None
+                    )
+                    self._ici = IciSegmentMover(sh, sh)
+                    self._stats["ici_handoffs"] = (
+                        self._stats.get("ici_handoffs", 0) + 1
+                    )
+            except Exception:  # noqa: BLE001 — fast path is optional
+                logger.debug("ici mover setup failed; plain streamed "
+                             "landing", exc_info=True)
+                self._ici = None
         # a redelivered stream restarts from block 0 — re-scatters over
         # the same uncommitted pages are idempotent
         self.segments = 0
@@ -506,9 +578,32 @@ class _RemoteScatterSink:
                 self._stats["kv_stream_regroups"] = (
                     self._stats.get("kv_stream_regroups", 0) + 1
                 )
+            t0 = time.perf_counter()
+            if self._ici is not None:
+                # ICI fast path: explicit device→device re-layout onto
+                # the decode cache's sharding (compiled per geometry
+                # bucket) — the scatter below then lands same-placed
+                # arrays instead of resolving a foreign one implicitly
+                k_seg, v_seg = self._ici.move(k_seg, v_seg)
+                self._stats["ici_segments"] = (
+                    self._stats.get("ici_segments", 0) + 1
+                )
             await self._engine.scatter_remote_segment(
                 self._handle, b0, k_seg, v_seg
             )
+            if self._ici is not None:
+                # the moved+scattered wall is the decode side's honest
+                # per-segment ICI cost — folding it into the engine's
+                # cost model is what lets routing learn this link class
+                cost = getattr(self._engine, "cost", None)
+                nbytes = getattr(k_seg, "nbytes", 0) + getattr(
+                    v_seg, "nbytes", 0
+                )
+                if cost is not None and nbytes:
+                    cost.observe(
+                        "ici", nbytes,
+                        max(time.perf_counter() - t0, 1e-9),
+                    )
             self.segments += 1
             self._stats["kv_stream_segments"] += 1
 
@@ -531,6 +626,8 @@ class DisaggEngine(AsyncEngine):
         engine_id: int = 0,
         transfer_timeout: float = 120.0,
         kv_stream: bool = True,
+        kv_ici: bool = True,
+        tcp_fallback: Optional[KvTransferServer] = None,
     ):
         self.engine = engine
         self.router = router
@@ -538,23 +635,88 @@ class DisaggEngine(AsyncEngine):
         self.transfer = transfer
         self.engine_id = engine_id
         self.transfer_timeout = transfer_timeout
+        # optional second delivery channel for LocalKvPipe engines: the
+        # connection then carries BOTH the in-process flag and a real
+        # TCP address, so one prefill queue can serve same-slice workers
+        # (pipe, ICI fast path) and remote workers (TCP) — and a
+        # redelivery after a same-slice worker dies mid-stream lands
+        # over TCP from a survivor. Ignored unless transfer is a pipe.
+        self._tcp = (
+            tcp_fallback if isinstance(transfer, LocalKvPipe) else None
+        )
         # advertise the streamed-handoff capability to prefill workers;
         # off = force the legacy bulk protocol end to end
         self.kv_stream = kv_stream
+        # advertise the ICI same-slice fast path (disagg/ici.py):
+        # version + slice fingerprint + kv-head layout ride connection
+        # info; a prefill worker on the same slice then marks its
+        # streamed headers ``ici`` and the scatter sink re-lays segments
+        # device→device. Off = plain streamed/bulk everywhere.
+        self.kv_ici = kv_ici
         self.stats = {
             "remote_prefills": 0, "local_prefills": 0, "remote_errors": 0,
             "streamed_deliveries": 0, "bulk_deliveries": 0,
             "kv_stream_segments": 0, "kv_stream_regroups": 0,
+            "ici_handoffs": 0, "ici_segments": 0,
         }
 
     def _connection(self) -> dict:
         if isinstance(self.transfer, LocalKvPipe):
             conn = {"local": True}
+            if self._tcp is not None:
+                conn.update(self._tcp.address.to_dict())
         else:
             conn = self.transfer.address.to_dict()
         if self.kv_stream:
             conn["kv_stream"] = KV_STREAM_VERSION
+        if self.kv_ici and self.kv_stream and self.engine.mirror is None:
+            from ..parallel.mesh import slice_fingerprint
+            from .ici import KV_ICI_VERSION
+
+            conn["kv_ici"] = KV_ICI_VERSION
+            conn["ici_fp"] = slice_fingerprint()
+            conn["ici_layout"] = self.engine.cfg.kv_head_layout
         return conn
+
+    def _expect(self, req_id: str, sink) -> asyncio.Future:
+        """Register the pending delivery on every advertised channel
+        (pipe + optional TCP fallback) and return one future resolving
+        with whichever lands first. The shared sink is attempt-safe:
+        ``begin`` re-inits per stream, and the post-delivery sink close
+        turns a racing loser's late segments into discards."""
+        fut = self.transfer.expect(req_id, sink=sink)
+        if self._tcp is None:
+            return fut
+        fut2 = self._tcp.expect(req_id, sink=sink)
+
+        async def race():
+            done, _pending = await asyncio.wait(
+                {fut, fut2}, return_when=asyncio.FIRST_COMPLETED
+            )
+            # both channels can resolve in one loop tick (a late error
+            # notification racing the redelivered push): prefer a real
+            # KV delivery over an error — failing a request whose KV
+            # landed on the other channel would recompute for nothing
+            best = None
+            for f in done:
+                if f.cancelled():
+                    continue
+                d = f.result()
+                if best is None or (
+                    getattr(best, "error", None)
+                    and not getattr(d, "error", None)
+                ):
+                    best = d
+            if best is None:
+                raise asyncio.CancelledError()
+            return best
+
+        return asyncio.ensure_future(race())
+
+    def _abandon(self, req_id: str) -> None:
+        self.transfer.abandon(req_id)
+        if self._tcp is not None:
+            self._tcp.abandon(req_id)
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
         req = request.data
@@ -592,7 +754,7 @@ class DisaggEngine(AsyncEngine):
             _RemoteScatterSink(self.engine, handle, self.stats)
             if self.kv_stream else None
         )
-        fut = self.transfer.expect(req_id, sink=sink)
+        fut = self._expect(req_id, sink)
         rpr = RemotePrefillRequest(
             request_id=req_id,
             request=req.to_dict(),
@@ -617,7 +779,7 @@ class DisaggEngine(AsyncEngine):
             # The sink must close BEFORE abort_remote frees the blocks —
             # an in-flight streamed scatter may still be writing them
             remote_span.set(error="cancelled")
-            self.transfer.abandon(req_id)
+            self._abandon(req_id)
             if sink is not None:
                 await sink.aclose()
             self.engine.abort_remote(handle, "cancelled")
@@ -625,7 +787,7 @@ class DisaggEngine(AsyncEngine):
         except Exception as e:  # noqa: BLE001 — timeout, enqueue or
             # transfer-stream failure: blocks must return to the pool
             remote_span.set(error=type(e).__name__)
-            self.transfer.abandon(req_id)
+            self._abandon(req_id)
             if sink is not None:
                 await sink.aclose()
             self.stats["remote_errors"] += 1
@@ -636,6 +798,10 @@ class DisaggEngine(AsyncEngine):
             # the remote leg ends when the delivery future resolves (or
             # fails) — everything after is local scatter/decode work
             remote_span.end()
+        # one channel delivered: retire the OTHER channel's pending
+        # entry (no-op single-channel) so a late duplicate push into a
+        # recycled request id can never land — it discards+acks instead
+        self._abandon(req_id)
         if delivery.error:
             self.stats["remote_errors"] += 1
             if sink is not None:

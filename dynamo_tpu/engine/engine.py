@@ -157,6 +157,13 @@ class EngineConfig:
     # max OPTIONAL evicted blocks one decode dispatch gathers d2h;
     # evictions whose pages the dispatch itself overwrites always flush
     offload_flush_budget: int = 64
+    # self-calibrating transfer-cost model (kv_router/costmodel.py):
+    # fold observed restore/pull/handoff/prefill timings into per-link
+    # bandwidth estimates and advertise them via load_metrics, so the
+    # KV router can score this worker by predicted TTFT instead of raw
+    # overlap. False = no observations, no advertisement — the router
+    # keeps this worker on the overlap-scoring cold-start path forever.
+    kv_cost_model: bool = True
     # max fused decode steps per device dispatch (lax.scan window): the
     # sampled token of step i feeds step i+1 on device, so the host syncs
     # once per window, not once per token. The scheduler drops to 1-step
@@ -368,6 +375,21 @@ class JaxEngine(AsyncEngine):
             # publishing (offload.flush_dropped): a stale lower-tier
             # copy aging out must not un-index a device-resident block
             self.offload.device_has = self.allocator.has_hash
+        # transfer-cost calibration (kv_router/costmodel.py): one model
+        # per engine, fed by the restore/pull/handoff/prefill paths and
+        # advertised through load_metrics. Block bytes from the real
+        # cache geometry (k and v differ for MLA latents).
+        self.kv_block_bytes = int(
+            (self.k_cache.nbytes + self.v_cache.nbytes)
+            // max(cfg.num_blocks, 1)
+        )
+        self.cost = None
+        if cfg.kv_cost_model:
+            from ..kv_router.costmodel import TransferCostModel
+
+            self.cost = TransferCostModel(block_bytes=self.kv_block_bytes)
+            if self.offload is not None:
+                self.offload.cost_model = self.cost
         # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
         # run the kernel under shard_map over tp (head-parallel, no
         # collectives) when tp divides the kv heads; otherwise the XLA
@@ -497,6 +519,12 @@ class JaxEngine(AsyncEngine):
             "drains_total": 0,
             "drain_handoffs": 0,
             "migration_resumes": 0,
+            # fleet prefix cache: blocks served to peers straight out of
+            # the DEVICE tier (bounded d2h export on fetch)
+            "peer_serve_d2h_blocks": 0,
+            # PRESERVE weight pre-stage requests resolved through the
+            # (no-op today) pre_stage_weights hook
+            "weight_prestage_requests": 0,
         }
 
     # ---------------- public api ----------------
@@ -709,7 +737,19 @@ class JaxEngine(AsyncEngine):
             "drains_total": self.stats["drains_total"],
             "drain_handoffs": self.stats["drain_handoffs"],
             "migration_resumes": self.stats["migration_resumes"],
-        }
+            # transfer-cost-aware placement surface (costmodel.py): the
+            # worker's observed link bandwidths + corrected prefill
+            # throughput + block geometry + slice identity — everything
+            # the router needs to convert this worker's overlap depths
+            # into predicted TTFT milliseconds
+            "kv_block_bytes": self.kv_block_bytes,
+            "kv_block_size": self.cfg.block_size,
+            "kv_slice_fp": self._slice_fp(),
+            "peer_serve_d2h_blocks_total": self.stats[
+                "peer_serve_d2h_blocks"],
+            "weight_prestage_requests": self.stats[
+                "weight_prestage_requests"],
+        } | (self.cost.counters() if self.cost is not None else {})
 
     # ---------------- graceful drain (resilience/drain.py) ----------------
 
@@ -1218,7 +1258,14 @@ class JaxEngine(AsyncEngine):
                 st.upload if not st.restored else None, seq=st.seq
             )
             st.restored = True
+            p0, t_c = st.pos, time.perf_counter()
             logits, st.pos = self._run_one_chunk(st.seq, st.pos)
+            if self.cost is not None and st.pos > p0:
+                # measured chunk timing = the observation that corrects
+                # the cost model's modeled prefill throughput
+                self.cost.observe_prefill(
+                    st.pos - p0, max(time.perf_counter() - t_c, 1e-9)
+                )
             if st.pos < len(st.seq.tokens):
                 return None
             return self._sample_prefill(st.seq, logits)  # (token, lp_entry)
@@ -1258,6 +1305,9 @@ class JaxEngine(AsyncEngine):
                     ts=time.time() - waited_ms / 1e3, dur_ms=waited_ms,
                     request_id=seq.context.id,
                     blocks=len(upload.hashes),
+                    # restore volume: lets ttft.cost_observations replay
+                    # this span into a TransferCostModel ("host" class)
+                    nbytes=len(upload.hashes) * self.kv_block_bytes,
                     exposed_ms=round(exposed_ms, 3),
                     hidden_ms=round(max(total_ms - exposed_ms, 0.0), 3),
                 )
@@ -1339,7 +1389,12 @@ class JaxEngine(AsyncEngine):
         logits = None
         pos = history
         while pos < len(seq.tokens):
+            p0, t_c = pos, time.perf_counter()
             logits, pos = self._run_one_chunk(seq, pos)
+            if self.cost is not None and pos > p0:
+                self.cost.observe_prefill(
+                    pos - p0, max(time.perf_counter() - t_c, 1e-9)
+                )
         return self._sample_prefill(seq, logits)
 
     def _table_for(self, seq: _Sequence) -> np.ndarray:
@@ -1550,6 +1605,61 @@ class JaxEngine(AsyncEngine):
             # disk -> host promotion off-loop; cheap when the disk index
             # has no continuation for this chain (index-only probe first)
             await loop.run_in_executor(None, off.promote_chain, hashes)
+
+    def _slice_fp(self) -> str:
+        """Accelerator-slice fingerprint (parallel/mesh.py, memoized
+        there per process) — advertised in load_metrics so the router
+        can tell which workers can hand KV device→device over ICI."""
+        from ..parallel.mesh import slice_fingerprint
+
+        return slice_fingerprint()
+
+    async def export_device_chain(
+        self, seq_hashes: list[int], max_blocks: int = 128
+    ) -> tuple[list[int], Optional[np.ndarray], Optional[np.ndarray]]:
+        """Serve side of the fleet prefix cache, DEVICE tier: the
+        longest consecutive run of ``seq_hashes`` resident in the device
+        prefix cache, gathered d2h as one bounded export — so chains
+        living only in HBM (the hottest tier) stop being invisible to
+        peers. Non-destructive: the blocks are ref-claimed for the
+        gather's duration (a concurrent eviction can't recycle the
+        pages mid-copy) and released untouched. The d2h runs on the
+        device executor under the device lock, bounded by
+        ``max_blocks`` so a serve can never become an unbounded HBM
+        drain. Mirrored engines return empty (their gather is a
+        lockstep broadcast no peer fetch should trigger)."""
+        if self.mirror is not None or not seq_hashes or self._closed:
+            return [], None, None
+        # claim refs via the allocator's own chain matcher (hashes are
+        # chained, so the local-hash slot is unused by the lookup) —
+        # claiming pins the pages against eviction during the gather
+        claimed = self.allocator.match_prefix(
+            (), hashes=[(0, h) for h in seq_hashes[:max_blocks]]
+        )
+        if not claimed:
+            return [], None, None
+        try:
+            idxs = [b.idx for b in claimed]
+            async with self._device_lock:
+                k, v = await asyncio.get_running_loop().run_in_executor(
+                    None, self._gather_device, idxs, False
+                )
+        finally:
+            self.allocator.free(claimed)
+        served = list(seq_hashes[: len(claimed)])
+        self.stats["peer_serve_d2h_blocks"] += len(served)
+        return served, k, v
+
+    async def pre_stage_weights(self, model: str) -> bool:
+        """PRESERVE-style weight pre-stage hook, driven by the router's
+        prefetch hint naming the model/adapter the routed request will
+        run. A single-model engine's weights are already resident, so
+        today this only counts the request — but the call path (hint →
+        listener → engine) is the one multi-model serving (ROADMAP
+        item 2) lands its real pre-stage on. Returns True when staging
+        work actually ran."""
+        self.stats["weight_prestage_requests"] += 1
+        return False
 
     def chain_coverage(self, chain: list[int]) -> int:
         """Longest prefix of chained hashes resident in ANY local tier
@@ -2876,9 +2986,14 @@ class JaxEngine(AsyncEngine):
             pos = history
             logits = None
             while pos < len(prompt):
+                p0, t_c = pos, time.perf_counter()
                 async with self._device_lock:
                     logits, pos = await loop.run_in_executor(
                         None, self._run_one_chunk, seq, pos
+                    )
+                if self.cost is not None and pos > p0:
+                    self.cost.observe_prefill(
+                        pos - p0, max(time.perf_counter() - t_c, 1e-9)
                     )
                 # blocks whose every position is now written; the
                 # final chunk also releases the partial last block

@@ -565,6 +565,10 @@ class OffloadManager:
         # from the executor threads most drops originate on)
         self.on_dropped: Optional[Callable[[list[int]], None]] = None
         self._dropped_pending: list[int] = []
+        # transfer-cost calibration (kv_router/costmodel.py, wired by
+        # the engine): restore landings observe the "host" link class,
+        # disk promotions the "disk" class. None = no calibration.
+        self.cost_model = None
         # device-tier residency probe (engine wires allocator.has_hash):
         # a queued drop is only PUBLISHED as a removal if the hash is
         # resident in NO tier at publish time — a stale disk copy aging
@@ -780,13 +784,21 @@ class OffloadManager:
         )
         promoted = 0
         fresh: set = set()
+        read_bytes, read_s = 0, 0.0
         for h in tail[:run]:
+            t_r = time.monotonic()
             got = self.disk.get(h)  # validates; corrupt -> clean miss
             if got is None:
                 break
+            read_s += time.monotonic() - t_r
+            read_bytes += got[0].nbytes + got[1].nbytes
             with self._lock:
                 self._stage_locked(h, got[0], got[1], fresh=fresh)
             promoted += 1
+        if self.cost_model is not None and read_bytes and read_s > 0:
+            # measured disk-read wall -> the "disk" link class (the
+            # h2d leg on top of it is observed separately as "host")
+            self.cost_model.observe("disk", read_bytes, read_s)
         with self._lock:
             self._dropped_pending.extend(self.disk.drain_dropped())
         return promoted
@@ -1165,6 +1177,17 @@ class OffloadManager:
             )
         t0 = time.monotonic()
         k_dev, v_dev = up.future.result()
+        if account and self.cost_model is not None and up.t_landed is not None:
+            # the upload worker's measured stack+h2d wall is the "host"
+            # link observation routing prices this worker's restores at.
+            # Request-driven restores only: hinted-prefetch landings
+            # (account=False) observe once in note_prefetch_landed —
+            # observing here too would double-weight every prefetch
+            # sample and open the cold-start gate at half the evidence
+            self.cost_model.observe(
+                "host", k_dev.nbytes + v_dev.nbytes,
+                max(up.t_landed - up.t_start, 1e-9),
+            )
         if account:
             waited = time.monotonic() - t0
             total = max(up.t_landed - up.t_start, 1e-9)
@@ -1189,6 +1212,11 @@ class OffloadManager:
             self.h2d_prefetch_blocks_total += len(up.hashes)
             if up.t_landed is not None:
                 self.restore_hidden_s += max(up.t_landed - up.t_start, 0.0)
+        if self.cost_model is not None and up.t_landed is not None and up.data:
+            nbytes = sum(k.nbytes + v.nbytes for k, v in up.data)
+            self.cost_model.observe(
+                "host", nbytes, max(up.t_landed - up.t_start, 1e-9)
+            )
 
     def note_prefetch_hits(self, n: int, hashes: Optional[list] = None) -> None:
         with self._lock:

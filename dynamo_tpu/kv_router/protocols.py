@@ -111,29 +111,40 @@ class KvPrefetchHint:
     first and, when they fall short, pulls the continuation from the
     peer over the transfer plane (kv-peer-fetch) before the restore.
     Advisory like the rest of the hint — a dead or mistaken peer just
-    costs the pull attempt; the request recomputes."""
+    costs the pull attempt; the request recomputes.
+
+    ``model`` (PRESERVE-style weight prefetch): the routed model or
+    adapter name, so the worker can pre-stage its weights alongside
+    the KV restore — today's workers resolve it to a stat-counted
+    no-op hook (engine.pre_stage_weights), wiring the call path the
+    multi-model work lands on warm. Absent on old routers; ignored by
+    old workers (tolerant decode both ways)."""
 
     worker_id: int
     blocks: list  # [[tokens_hash, block_hash], ...] prompt order
     peer_worker_id: Optional[int] = None
     peer_blocks: int = 0
+    model: Optional[str] = None
 
     def to_bytes(self) -> bytes:
         return json.dumps(
             {"worker_id": self.worker_id, "blocks": self.blocks,
              "peer_worker_id": self.peer_worker_id,
-             "peer_blocks": self.peer_blocks}
+             "peer_blocks": self.peer_blocks,
+             "model": self.model}
         ).encode()
 
     @staticmethod
     def from_bytes(raw: bytes) -> "KvPrefetchHint":
         d = json.loads(raw)
         peer = d.get("peer_worker_id")
+        model = d.get("model")
         return KvPrefetchHint(
             worker_id=d["worker_id"],
             blocks=[[int(a), int(b)] for a, b in d.get("blocks", [])],
             peer_worker_id=int(peer) if peer is not None else None,
             peer_blocks=int(d.get("peer_blocks") or 0),
+            model=str(model) if model else None,
         )
 
 
@@ -171,15 +182,30 @@ class KvPeerFetchRequest:
 
 @dataclass
 class KVHitRateEvent:
-    """Emitted per routing decision (ref scheduler.rs:28-32)."""
+    """Emitted per routing decision (ref scheduler.rs:28-32).
+
+    ``predicted_ttft_ms`` carries the cost model's prediction for the
+    chosen worker when the decision was cost-aware (-1 = overlap-mode
+    fallback), so the metrics component can gauge routing's view of the
+    fleet without a second event plane. Version skew: this decoder
+    tolerates old events (field defaulted); a pre-field consumer
+    decoding a NEW event drops it as a bad event for one upgrade
+    window — hit-rate gauges are advisory, nothing routes on them."""
 
     worker_id: int
     isl_blocks: int
     overlap_blocks: int
+    predicted_ttft_ms: float = -1.0
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
 
     @staticmethod
     def from_bytes(raw: bytes) -> "KVHitRateEvent":
-        return KVHitRateEvent(**json.loads(raw))
+        d = json.loads(raw)
+        return KVHitRateEvent(
+            worker_id=d["worker_id"],
+            isl_blocks=d["isl_blocks"],
+            overlap_blocks=d["overlap_blocks"],
+            predicted_ttft_ms=float(d.get("predicted_ttft_ms", -1.0)),
+        )

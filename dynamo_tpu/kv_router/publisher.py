@@ -29,6 +29,8 @@ import logging
 import uuid
 from typing import Optional
 
+import numpy as np
+
 from .protocols import (
     KV_EVENT_SUBJECT,
     KV_PEER_FETCH_SUBJECT,
@@ -121,6 +123,11 @@ class KvPrefetchListener:
         self.peer_pulls = 0
         self.peer_pull_blocks = 0
         self.peer_pull_failures = 0
+        # PRESERVE-style weight pre-stage (hint.model): requests
+        # forwarded to the engine hook, and failures swallowed there —
+        # a broken pre-stage must never cost the KV prefetch
+        self.prestage_requests = 0
+        self.prestage_failures = 0
         self.pull_timeout = pull_timeout
         self.peer_pull = peer_pull
         # connect-back target for peer pushes: the disagg decode role
@@ -200,6 +207,17 @@ class KvPrefetchListener:
 
     async def _handle_hint(self, hint: KvPrefetchHint) -> None:
         try:
+            if hint.model:
+                # fire-and-forget, never awaited inline: a SLOW weight
+                # pre-stage (the whole point once multi-model staging is
+                # real) must not delay the prefix restore it rides with,
+                # and a failing/fault-killed one is swallowed inside
+                # _pre_stage — either way the KV work below is unaffected
+                t = asyncio.get_running_loop().create_task(
+                    self._pre_stage(hint.model)
+                )
+                self._hint_tasks.add(t)
+                t.add_done_callback(self._hint_tasks.discard)
             blocks = [(l, s) for l, s in hint.blocks]
             if (
                 hint.peer_worker_id is not None
@@ -222,6 +240,29 @@ class KvPrefetchListener:
         except Exception:  # noqa: BLE001 — hints are advisory
             logger.debug("prefetch hint failed", exc_info=True)
 
+    async def _pre_stage(self, model: str) -> None:
+        """PRESERVE-style weight pre-stage: the hint named the model the
+        routed request will run, so staging its weights can start before
+        the request arrives — resolved through the engine's
+        ``pre_stage_weights`` hook (a stat-counted no-op today; the
+        multi-model work lands on this warm call path). Best-effort end
+        to end, with its own faultpoint so tests can prove a dead
+        pre-stage never takes the KV prefetch down with it."""
+        from ..resilience import faultpoints
+
+        self.prestage_requests += 1
+        try:
+            await faultpoints.hit("pre_stage_weights", model=model)
+            fn = getattr(self.engine, "pre_stage_weights", None)
+            if fn is not None:
+                await fn(model)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — advisory, like the hint
+            self.prestage_failures += 1
+            logger.debug("weight pre-stage for %r failed", model,
+                         exc_info=True)
+
     async def _maybe_pull(self, hint: KvPrefetchHint, blocks: list) -> None:
         """One peer prefix pull: size the remote tail from local
         coverage, negotiate over the bus, await the transfer-plane
@@ -241,6 +282,9 @@ class KvPrefetchListener:
             connection=self._transfer.address.to_dict(),
         )
         self.peer_pulls += 1
+        import time as _time
+
+        t0 = _time.monotonic()
         try:
             self.drt.bus.publish(self.fetch_subject, req.to_bytes())
             delivery = await asyncio.wait_for(fut, self.pull_timeout)
@@ -256,6 +300,16 @@ class KvPrefetchListener:
         if delivery.error or not delivery.hashes or delivery.k_data is None:
             self.peer_pull_failures += 1
             return
+        # transfer-cost calibration: the pull's measured wall + bytes
+        # feed the engine's "peer" link-class estimate — this is the
+        # number the router prices this worker's future pulls with
+        cost = getattr(self.engine, "cost", None)
+        if cost is not None and delivery.k_data is not None:
+            cost.observe(
+                "peer",
+                delivery.k_data.nbytes + delivery.v_data.nbytes,
+                max(_time.monotonic() - t0, 1e-9),
+            )
         served = [int(h) for h in delivery.hashes]
         if served != tail[: len(served)]:
             # a peer whose probe drifted from the request must not park
@@ -331,6 +385,11 @@ class KvPeerServer:
         # multi-MB..GB KV run on the executor) — the puller side caps
         # its fan-out the same way (max_concurrent_pulls)
         self.max_concurrent_serves = 8
+        # per-fetch bound on the DEVICE-tier d2h export: a serve must
+        # never turn into an unbounded HBM drain under the device lock
+        # (the concurrency cap above bounds the fan-out; this bounds
+        # each serve's burst)
+        self.max_d2h_blocks = 128
 
     async def start(self) -> "KvPeerServer":
         sub = self.drt.bus.subscribe(self.subject)
@@ -399,9 +458,36 @@ class KvPeerServer:
             await faultpoints.hit("mid_peer_serve", request_id=req.request_id)
             off = getattr(self.engine, "offload", None)
             hashes, k, v = ([], None, None)
+            # device tier first: chains living ONLY in HBM used to be
+            # invisible to the fleet prefix cache — a bounded,
+            # non-destructive d2h export (engine device lock + executor
+            # hop) serves the hottest tier too; the host/disk export
+            # continues the run past the device-resident prefix
+            export_dev = getattr(self.engine, "export_device_chain", None)
+            if export_dev is not None:
+                hashes, k, v = await export_dev(
+                    req.hashes, max_blocks=self.max_d2h_blocks
+                )
             if off is not None:
+                tail = req.hashes[len(hashes):]
+
+                def _export_and_merge(k=k, v=v, hashes=tuple(hashes)):
+                    # executor thread: the lower-tier export AND the
+                    # multi-MB merge with the device run both stay off
+                    # the event loop
+                    h2, k2, v2 = off.export_chain(list(tail))
+                    if not h2:
+                        return list(hashes), k, v
+                    if hashes:
+                        return (
+                            list(hashes) + h2,
+                            np.concatenate([k, k2], axis=2),
+                            np.concatenate([v, v2], axis=2),
+                        )
+                    return h2, k2, v2
+
                 hashes, k, v = await asyncio.get_running_loop().run_in_executor(
-                    None, off.export_chain, req.hashes
+                    None, _export_and_merge
                 )
             if not hashes:
                 self.misses += 1
@@ -495,44 +581,11 @@ class KvMetricsAggregator:
                 if prev is not None:
                     merged[s["instance_id"]] = prev
                 continue
-            merged[s["instance_id"]] = (
-                WorkerLoad(
-                    worker_id=s["instance_id"],
-                    kv_active_blocks=d.get("kv_active_blocks", 0),
-                    kv_total_blocks=max(d.get("kv_total_blocks", 1), 1),
-                    active_requests=d.get("request_active_slots", 0),
-                    total_slots=max(d.get("request_total_slots", 1), 1),
-                    waiting=d.get("num_requests_waiting", 0),
-                    offload_blocks_resident=d.get(
-                        "offload_blocks_resident", 0),
-                    offload_d2h_flush_async=d.get("d2h_flush_async", 0),
-                    offload_prefetch_hits=d.get("h2d_prefetch_hits", 0),
-                    offload_restore_hidden_frac=d.get(
-                        "restore_latency_hidden_frac", 0.0),
-                    disk_blocks_resident=d.get("disk_blocks_resident", 0),
-                    disk_hit_blocks=d.get("disk_hit_blocks_total", 0),
-                    peer_pull_blocks=d.get("peer_pull_blocks_total", 0),
-                    peer_pull_hidden_frac=d.get("peer_pull_hidden_frac", 0.0),
-                    draining=d.get("draining", 0),
-                    drains_total=d.get("drains_total", 0),
-                    migration_resumes=d.get("migration_resumes", 0),
-                    kv_stream_deliveries=d.get("streamed_deliveries", 0),
-                    kv_bulk_deliveries=d.get("bulk_deliveries", 0),
-                    kv_stream_segments=d.get("kv_stream_segments", 0),
-                    mixed_steps=d.get("mixed_steps", 0),
-                    mixed_prefill_segments=d.get("mixed_prefill_segments", 0),
-                    requests_total=d.get("requests_total", 0),
-                    tokens_generated=d.get("tokens_generated", 0),
-                    prompt_tokens_total=d.get("prompt_tokens_total", 0),
-                    loop_stalls=d.get("san_loop_stalls", 0),
-                    loop_stall_max_ms=d.get("san_loop_stall_max_ms", 0.0),
-                    lock_hold_max_ms=d.get("san_lock_hold_max_ms", 0.0),
-                    writers_leaked=d.get("san_writers_leaked", 0),
-                    # stamped at scrape time: the scheduler ages these
-                    # out (load_ttl_s) instead of trusting a dead
-                    # worker's last report forever
-                    ts=now,
-                )
+            # ts stamped at scrape time: the scheduler ages these out
+            # (load_ttl_s) instead of trusting a dead worker's last
+            # report forever
+            merged[s["instance_id"]] = WorkerLoad.from_stats(
+                s["instance_id"], d, ts=now
             )
         self._known = merged
         self.endpoints = ProcessedEndpoints(list(merged.values()))

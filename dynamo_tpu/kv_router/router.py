@@ -36,10 +36,15 @@ class KvRouter:
         block_size: int = 16,
         config: Optional[SchedulerConfig] = None,
         indexer_shards: int = 1,
+        model_name: Optional[str] = None,
     ):
         self.drt = drt
         self.component = component
         self.block_size = block_size
+        # served model name, stamped into prefetch hints so workers can
+        # pre-stage weights (PRESERVE) — None for single-model stacks
+        # that never told the router what they serve
+        self.model_name = model_name
         self.indexer = KvIndexer(drt, component, shards=indexer_shards)
         self.metrics = KvMetricsAggregator(drt, component)
         self.scheduler = KvScheduler(drt, component, config)
@@ -131,18 +136,19 @@ class KvRouter:
             # fleet prefix cache: when a PEER's radix chain covers the
             # prompt deeper than everything the routed worker holds
             # (any tier), name it in the hint — the worker pulls the
-            # continuation from the peer's host/disk tier over the
-            # transfer plane before the request lands. The peer's own
-            # tier split is decided at serve time by its local probe;
-            # this is advisory, like the hint itself.
-            peer_id, peer_ov = None, overlap
-            for w, ov in overlaps.scores.items():
-                if w != worker_id and ov > peer_ov:
-                    peer_id, peer_ov = w, ov
+            # continuation from the peer's tiers over the transfer
+            # plane before the request lands. The chooser prefers the
+            # NEAREST adequate peer (same-slice ICI beats a deeper
+            # chain across DCN) once the cost model is calibrated;
+            # advisory, like the hint itself.
+            peer_id, peer_blocks = self.scheduler.choose_peer(
+                self.metrics.endpoints, overlaps, worker_id, n_hint
+            )
             self.scheduler.emit_prefetch(
                 worker_id, pairs[:n_hint],
                 peer_worker_id=peer_id,
-                peer_blocks=min(peer_ov, n_hint) if peer_id is not None else 0,
+                peer_blocks=peer_blocks,
+                model=self.model_name,
             )
         return worker_id, overlap
 

@@ -26,6 +26,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .costmodel import predict_worker_ttft_ms
 from .indexer import OverlapScores
 from .protocols import (
     KV_HIT_RATE_SUBJECT,
@@ -94,11 +95,80 @@ class WorkerLoad:
     loop_stall_max_ms: float = 0.0
     lock_hold_max_ms: float = 0.0
     writers_leaked: int = 0
+    # transfer-cost calibration (kv_router/costmodel.py): the worker's
+    # observed per-link-class bandwidths, corrected prefill throughput,
+    # and KV block geometry — everything the router needs to convert
+    # this candidate's overlap depths into predicted milliseconds.
+    # cost_obs gates cold-start: below the scheduler's cost_min_obs the
+    # candidate routes on overlap like before
+    cost_obs: int = 0
+    link_gbps: dict = field(default_factory=dict)
+    link_lat_ms: dict = field(default_factory=dict)
+    prefill_tok_s: float = 0.0
+    block_bytes: int = 0
+    block_size: int = 0
+    # accelerator-slice fingerprint (parallel/mesh.slice_fingerprint):
+    # two workers advertising the same fp can hand KV device→device
+    # over ICI — the peer chooser prices their pulls at the ici class
+    slice_fp: str = ""
+    # ICI fast-path + device-tier fleet-cache activity (gauges)
+    ici_handoffs: int = 0
+    peer_serve_d2h_blocks: int = 0
+    weight_prestage_requests: int = 0
     # monotonic stamp set at scrape time (None = constructed directly /
     # legacy producer): the scheduler discards loads older than
     # ``SchedulerConfig.load_ttl_s`` instead of trusting a dead
     # worker's last report
     ts: Optional[float] = None
+
+    @staticmethod
+    def from_stats(worker_id: int, d: dict, ts: Optional[float] = None) -> "WorkerLoad":
+        """One WorkerLoad from a worker's ``load_metrics`` payload — the
+        single mapping the metrics aggregator, the bench harness and
+        tests all share, so the scrape schema can't drift per consumer."""
+        return WorkerLoad(
+            worker_id=worker_id,
+            kv_active_blocks=d.get("kv_active_blocks", 0),
+            kv_total_blocks=max(d.get("kv_total_blocks", 1), 1),
+            active_requests=d.get("request_active_slots", 0),
+            total_slots=max(d.get("request_total_slots", 1), 1),
+            waiting=d.get("num_requests_waiting", 0),
+            offload_blocks_resident=d.get("offload_blocks_resident", 0),
+            offload_d2h_flush_async=d.get("d2h_flush_async", 0),
+            offload_prefetch_hits=d.get("h2d_prefetch_hits", 0),
+            offload_restore_hidden_frac=d.get(
+                "restore_latency_hidden_frac", 0.0),
+            disk_blocks_resident=d.get("disk_blocks_resident", 0),
+            disk_hit_blocks=d.get("disk_hit_blocks_total", 0),
+            peer_pull_blocks=d.get("peer_pull_blocks_total", 0),
+            peer_pull_hidden_frac=d.get("peer_pull_hidden_frac", 0.0),
+            draining=d.get("draining", 0),
+            drains_total=d.get("drains_total", 0),
+            migration_resumes=d.get("migration_resumes", 0),
+            kv_stream_deliveries=d.get("streamed_deliveries", 0),
+            kv_bulk_deliveries=d.get("bulk_deliveries", 0),
+            kv_stream_segments=d.get("kv_stream_segments", 0),
+            mixed_steps=d.get("mixed_steps", 0),
+            mixed_prefill_segments=d.get("mixed_prefill_segments", 0),
+            requests_total=d.get("requests_total", 0),
+            tokens_generated=d.get("tokens_generated", 0),
+            prompt_tokens_total=d.get("prompt_tokens_total", 0),
+            loop_stalls=d.get("san_loop_stalls", 0),
+            loop_stall_max_ms=d.get("san_loop_stall_max_ms", 0.0),
+            lock_hold_max_ms=d.get("san_lock_hold_max_ms", 0.0),
+            writers_leaked=d.get("san_writers_leaked", 0),
+            cost_obs=d.get("kv_cost_obs_total", 0),
+            link_gbps=dict(d.get("kv_link_gbps") or {}),
+            link_lat_ms=dict(d.get("kv_link_lat_ms") or {}),
+            prefill_tok_s=d.get("kv_prefill_tok_s", 0.0),
+            block_bytes=d.get("kv_block_bytes", 0),
+            block_size=d.get("kv_block_size", 0),
+            slice_fp=str(d.get("kv_slice_fp") or ""),
+            ici_handoffs=d.get("ici_handoffs", 0),
+            peer_serve_d2h_blocks=d.get("peer_serve_d2h_blocks_total", 0),
+            weight_prestage_requests=d.get("weight_prestage_requests", 0),
+            ts=ts,
+        )
 
     @property
     def kv_usage(self) -> float:
@@ -156,6 +226,16 @@ class SchedulerConfig:
     #: set applied to routing forever (same stale-authority guard as
     #: load_ttl_s). 0 disables the expiry.
     watermark_ttl_s: float = 30.0
+    #: transfer-cost-aware placement (costmodel.py): score candidates by
+    #: predicted TTFT = queue_wait + transfer + prefill from their own
+    #: advertised link/throughput calibration. Falls back to the overlap
+    #: cost above whenever ANY candidate is still cold — mixing the two
+    #: score scales in one decision would be meaningless. False = the
+    #: PR 9 overlap scoring unconditionally.
+    cost_model: bool = True
+    #: calibration observations a candidate must advertise before its
+    #: predicted TTFT is trusted (cold-start gate)
+    cost_min_obs: int = 4
 
 
 class KvScheduler:
@@ -181,6 +261,11 @@ class KvScheduler:
         self.prefetch_hints_sent = 0
         # optimistic in-flight bumps: worker -> extra requests assumed
         self._pending: dict[int, int] = {}
+        # last decision's route mode + prediction (observability:
+        # riders on the KVHitRateEvent -> route_predicted_ttft_ms gauge)
+        self.last_predicted_ttft_ms: Optional[float] = None
+        self.route_cost_decisions = 0
+        self.route_overlap_decisions = 0
 
     def select_worker(
         self,
@@ -232,29 +317,176 @@ class KvScheduler:
             ]
             candidates = preferred or candidates
 
-        balance_mode = endpoints.load_std > self.cfg.balance_threshold
-        alpha = self.cfg.balance_alpha if balance_mode else self.cfg.overlap_alpha
-        avg = endpoints.load_avg
+        best_id = None
+        self.last_predicted_ttft_ms = None
+        if self.cfg.cost_model:
+            # transfer-cost-aware placement: every candidate must be
+            # calibration-ready (predict returns None when cold) — a
+            # single cold candidate falls the WHOLE decision back to
+            # overlap scoring, because the two score scales (predicted
+            # milliseconds vs the normalized overlap cost) aren't
+            # comparable within one argmin
+            preds = []
+            for l in candidates:
+                p = predict_worker_ttft_ms(
+                    l, overlaps, isl_blocks,
+                    pending=self._pending.get(l.worker_id, 0),
+                    min_obs=self.cfg.cost_min_obs,
+                    peer_slice_fp=self._deepest_peer_fp(
+                        endpoints, overlaps, l.worker_id
+                    ),
+                )
+                if p is None:
+                    preds = None
+                    break
+                preds.append((p, l.worker_id))
+            if preds:
+                # ties (identical candidates, or a model with barely
+                # enough observations) break on the EXISTING overlap
+                # score then worker id — never on float-sum iteration
+                # order, which flaps routing between scrapes
+                p, best_id = min(
+                    preds,
+                    key=lambda t: (
+                        t[0], -overlaps.scores.get(t[1], 0), t[1]
+                    ),
+                )
+                self.last_predicted_ttft_ms = p
+                self.route_cost_decisions += 1
 
-        best_id, best_cost = None, None
-        for l in candidates:
-            overlap = overlaps.scores.get(l.worker_id, 0)
-            new_blocks = max(isl_blocks - overlap, 0)
-            norm_new = new_blocks / max(isl_blocks, 1)
-            pending = self._pending.get(l.worker_id, 0)
-            req_ratio = (l.active_requests + pending) / max(l.total_slots, 1)
-            cost = (
-                alpha * (l.kv_usage - avg)
-                + (1 - alpha) * norm_new
-                + self.cfg.gamma * req_ratio
+        if best_id is None:
+            balance_mode = endpoints.load_std > self.cfg.balance_threshold
+            alpha = (
+                self.cfg.balance_alpha if balance_mode
+                else self.cfg.overlap_alpha
             )
-            if best_cost is None or cost < best_cost:
-                best_id, best_cost = l.worker_id, cost
+            avg = endpoints.load_avg
 
-        assert best_id is not None
+            def legacy_cost(l: WorkerLoad) -> float:
+                overlap = overlaps.scores.get(l.worker_id, 0)
+                norm_new = max(isl_blocks - overlap, 0) / max(isl_blocks, 1)
+                pending = self._pending.get(l.worker_id, 0)
+                req_ratio = (
+                    (l.active_requests + pending) / max(l.total_slots, 1)
+                )
+                return (
+                    alpha * (l.kv_usage - avg)
+                    + (1 - alpha) * norm_new
+                    + self.cfg.gamma * req_ratio
+                )
+
+            # same deterministic tie-break as the cost mode: equal-cost
+            # candidates (identical loads, float-sum ties) must pick the
+            # same worker regardless of the loads list's scrape order
+            best_id = min(
+                candidates,
+                key=lambda l: (
+                    legacy_cost(l),
+                    -overlaps.scores.get(l.worker_id, 0),
+                    l.worker_id,
+                ),
+            ).worker_id
+            self.route_overlap_decisions += 1
+
         self._pending[best_id] = self._pending.get(best_id, 0) + 1
         self._emit_hit_rate(best_id, isl_blocks, overlaps.scores.get(best_id, 0))
         return best_id
+
+    @staticmethod
+    def _deepest_peer_fp(
+        endpoints: ProcessedEndpoints, overlaps: OverlapScores, worker_id: int
+    ) -> str:
+        """Slice fingerprint of the deepest OTHER chain's worker — the
+        peer a pull would come from, so the prediction prices it at the
+        ICI class when it shares the candidate's slice."""
+        best_w, best_ov = None, 0
+        for w, ov in overlaps.scores.items():
+            if w != worker_id and (ov > best_ov or (ov == best_ov and
+                                                    best_w is not None
+                                                    and w < best_w)):
+                best_w, best_ov = w, ov
+        if best_w is None:
+            return ""
+        load = endpoints.by_id.get(best_w)
+        return load.slice_fp if load is not None else ""
+
+    def choose_peer(
+        self,
+        endpoints: ProcessedEndpoints,
+        overlaps: OverlapScores,
+        worker_id: int,
+        n_hint: int,
+    ) -> tuple[Optional[int], int]:
+        """Pick the peer a prefetch hint should name: the NEAREST
+        adequate peer, not the deepest. Candidates are workers whose
+        chain outruns the routed worker's own tiers; with the routed
+        worker's calibration in hand each candidate is scored by net
+        benefit = prefill saved − predicted pull cost (priced at the
+        ICI class when the peer shares the routed worker's slice), so a
+        same-slice peer covering the chain beats a deeper peer across
+        DCN whenever the extra depth isn't worth the slower wire. Cold
+        model (or a pull predicted to cost more than recompute for
+        every candidate) falls back to the PR 9 deepest-chain rule.
+        Deterministic: ties break on depth then worker id."""
+        tier_cov = min(overlaps.scores.get(worker_id, 0), n_hint)
+        cands = sorted(
+            (w, min(ov, n_hint))
+            for w, ov in overlaps.scores.items()
+            if w != worker_id and min(ov, n_hint) > tier_cov
+        )
+        if not cands:
+            return None, 0
+        load = endpoints.by_id.get(worker_id)
+        scored = None
+        if (
+            self.cfg.cost_model
+            and load is not None
+            and load.cost_obs >= self.cfg.cost_min_obs
+            and load.prefill_tok_s > 0
+            and load.block_bytes > 0
+            and load.block_size > 0
+        ):
+            from .costmodel import link_leg_ms, restore_leg_ms
+
+            link_gbps = load.link_gbps or {}
+            scored = []
+            for w, depth in cands:
+                extra = depth - tier_cov
+                peer = endpoints.by_id.get(w)
+                link = (
+                    "ici"
+                    if peer is not None and load.slice_fp
+                    and peer.slice_fp == load.slice_fp
+                    and link_gbps.get("ici")
+                    else "peer"
+                )
+                nbytes = extra * load.block_bytes
+                pull = link_leg_ms(
+                    link_gbps, load.link_lat_ms, link, nbytes
+                )
+                # the pulled chain lands in host staging and still pays
+                # the h2d restore leg — same pricing as predict's pull
+                # term, or the two would disagree on whether a pull
+                # beats recompute
+                land = restore_leg_ms(link_gbps, load.link_lat_ms, nbytes)
+                if pull is None or land is None:
+                    scored = None  # cold pull/restore -> deepest fallback
+                    break
+                saved_ms = extra * load.block_size / load.prefill_tok_s * 1e3
+                scored.append((saved_ms - pull - land, depth, w))
+        if scored:
+            net, depth, w = max(
+                scored, key=lambda t: (t[0], t[1], -t[2])
+            )
+            if net <= 0:
+                # every pull costs more than recomputing the blocks —
+                # don't name a peer at all (the hint's local restore
+                # still fires)
+                return None, 0
+            return w, depth
+        # cold-start / overlap-only: deepest chain, worker id tie-break
+        w, depth = max(cands, key=lambda t: (t[1], -t[0]))
+        return w, depth
 
     def set_watermarks(self, saturated_workers) -> None:
         """Planner capacity-watermark update (full replacement — the
@@ -275,6 +507,7 @@ class KvScheduler:
     def emit_prefetch(
         self, worker_id: int, blocks: list,
         peer_worker_id: Optional[int] = None, peer_blocks: int = 0,
+        model: Optional[str] = None,
     ) -> None:
         """Ship the routed request's block-hash chain to the chosen
         worker as a prefetch hint ((tokens_hash, block_hash) pairs in
@@ -285,7 +518,9 @@ class KvScheduler:
         prompt deeper than the routed worker's own tiers (to depth
         ``peer_blocks``) — the worker pulls the continuation from that
         peer's host/disk tier over the transfer plane (fleet prefix
-        cache). Best-effort: a lost hint only costs the overlap."""
+        cache). ``model`` names the routed model/adapter so the worker
+        can pre-stage its weights (PRESERVE) alongside the KV.
+        Best-effort: a lost hint only costs the overlap."""
         if self.drt is None or self._prefetch_subject is None or not blocks:
             return
         capped = blocks[:KV_PREFETCH_MAX_BLOCKS]
@@ -293,6 +528,7 @@ class KvScheduler:
             worker_id, [[l, s] for l, s in capped],
             peer_worker_id=peer_worker_id,
             peer_blocks=min(peer_blocks, len(capped)),
+            model=model,
         )
         try:
             self.drt.bus.publish(self._prefetch_subject, hint.to_bytes())
@@ -306,7 +542,16 @@ class KvScheduler:
         try:
             self.drt.bus.publish(
                 self._hit_subject,
-                KVHitRateEvent(worker_id, isl_blocks, overlap).to_bytes(),
+                KVHitRateEvent(
+                    worker_id, isl_blocks, overlap,
+                    # -1 = the decision fell back to overlap scoring
+                    # (cold start / cost model off) — the metrics
+                    # component skips the gauge for those
+                    predicted_ttft_ms=(
+                        round(self.last_predicted_ttft_ms, 3)
+                        if self.last_predicted_ttft_ms is not None else -1.0
+                    ),
+                ).to_bytes(),
             )
         except Exception:  # noqa: BLE001
             logger.debug("hit-rate publish failed", exc_info=True)

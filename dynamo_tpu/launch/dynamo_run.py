@@ -275,6 +275,7 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         mixed_batch=not args.no_mixed_batch,
         mixed_step_budget=args.mixed_step_budget,
         mixed_max_prefills=args.mixed_max_prefills,
+        kv_cost_model=getattr(args, "kv_cost_model", True),
     )
 
 
@@ -350,7 +351,12 @@ async def run_http(args) -> None:
         cfg, _params, tokenizer, name = build_model(args, load_weights=False)
         comp = drt.namespace(ns).component(comp_name)
         client = await comp.endpoint(ep).client().start()
-        router = await KvRouter(drt, comp, block_size=args.block_size).start()
+        # model_name rides the prefetch hints (PRESERVE weight
+        # pre-stage); scheduler config default = cost-aware routing
+        # with overlap-scoring cold-start fallback
+        router = await KvRouter(
+            drt, comp, block_size=args.block_size, model_name=name,
+        ).start()
         dispatch = KvRoutedEngine(router, client)
         if not args.no_migration:
             # transparent in-flight migration (resilience/): worker death
@@ -485,6 +491,7 @@ async def run_endpoint(args) -> None:
             jax_core, disagg_router, queue, transfer,
             engine_id=drt.primary_lease_id,
             kv_stream=args.kv_stream,
+            kv_ici=args.kv_ici,
         )
         engine = OpenAIWorkerEngine(tokenizer, disagg_engine)
         stats = lambda: (  # noqa: E731
@@ -604,6 +611,7 @@ async def run_prefill(args) -> None:
         core, queue, kv_stream=args.kv_stream,
         segment_blocks=args.kv_segment_blocks,
         concurrency=args.prefill_concurrency,
+        kv_ici=args.kv_ici,
     )
     worker.start()
     print(f"prefill worker {drt.worker_id:x} serving {name!r} "
@@ -937,6 +945,31 @@ def main(argv=None) -> None:
                    help="force the legacy post-prefill bulk KV handoff "
                         "(decode role stops advertising the streamed "
                         "capability; prefill role stops using it)")
+    p.add_argument("--kv-cost-model", dest="kv_cost_model",
+                   action="store_true", default=True,
+                   help="self-calibrating transfer-cost model (default "
+                        "on): observe restore/pull/handoff/prefill "
+                        "timings and advertise per-link bandwidths so "
+                        "the KV router can route on predicted TTFT")
+    p.add_argument("--no-kv-cost-model", dest="kv_cost_model",
+                   action="store_false",
+                   help="disable cost observation/advertisement (the "
+                        "router keeps this worker on overlap scoring)")
+    p.add_argument("--kv-ici", dest="kv_ici", action="store_true",
+                   default=True,
+                   help="ICI same-slice KV fast path (default on): "
+                        "decode roles advertise their slice "
+                        "fingerprint and same-slice prefill peers hand "
+                        "segments device-to-device (disagg/ici.py). "
+                        "Engages only on the in-process LocalKvPipe "
+                        "channel today (embedded prefill+decode engine "
+                        "pairs); the launched cross-process roles keep "
+                        "advertising for forward-compat but hand off "
+                        "over TCP until engines go mesh-agnostic "
+                        "(ROADMAP item 4)")
+    p.add_argument("--no-kv-ici", dest="kv_ici", action="store_false",
+                   help="disable the ICI fast path (all handoffs take "
+                        "the TCP/streamed plane)")
     p.add_argument("--kv-segment-blocks", type=int, default=0,
                    help="cap per-segment block count in the streamed "
                         "handoff (0 = one segment per prefill chunk)")

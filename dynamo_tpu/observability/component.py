@@ -53,6 +53,11 @@ class MetricsComponent:
         self.hit_events = 0
         self.hit_isl_blocks = 0
         self.hit_overlap_blocks = 0
+        # transfer-cost routing plane: EWMA + last of the scheduler's
+        # predicted TTFT for cost-aware decisions (rides the hit-rate
+        # event; -1 entries are overlap-mode fallbacks and don't count)
+        self.route_cost_events = 0
+        self.route_predicted_ttft_ms = 0.0
         # planner plane: last decision + watermark seen on the bus
         self.planner_decision: Optional[PlannerDecision] = None
         self.planner_watermark: Optional[CapacityWatermark] = None
@@ -124,6 +129,13 @@ class MetricsComponent:
                 self.hit_events += 1
                 self.hit_isl_blocks += ev.isl_blocks
                 self.hit_overlap_blocks += ev.overlap_blocks
+                if ev.predicted_ttft_ms >= 0:
+                    self.route_cost_events += 1
+                    a = 0.2 if self.route_cost_events > 1 else 1.0
+                    self.route_predicted_ttft_ms = (
+                        (1 - a) * self.route_predicted_ttft_ms
+                        + a * ev.predicted_ttft_ms
+                    )
             except Exception:  # noqa: BLE001
                 logger.exception("bad kv-hit-rate event")
 
@@ -210,6 +222,22 @@ class MetricsComponent:
             gauge("loop_stall_max_ms", round(w.loop_stall_max_ms, 3), lb)
             gauge("lock_hold_max_ms", round(w.lock_hold_max_ms, 3), lb)
             gauge("writers_leaked_total", w.writers_leaked, lb)
+            # transfer-cost calibration plane (docs/kv_cache_routing.md):
+            # how many observations this worker's cost model has folded,
+            # its per-link-class observed bandwidths, the ICI fast-path
+            # volume, device-tier peer exports, and weight pre-stages
+            gauge("kv_cost_obs_total", w.cost_obs, lb)
+            for link, gbps in sorted((w.link_gbps or {}).items()):
+                gauge(
+                    "kv_link_gbps", round(gbps, 6),
+                    lb + f',link="{link}"',
+                )
+            gauge("ici_handoffs_total", w.ici_handoffs, lb)
+            gauge("peer_serve_d2h_blocks_total", w.peer_serve_d2h_blocks, lb)
+            gauge(
+                "weight_prestage_requests_total",
+                w.weight_prestage_requests, lb,
+            )
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))
@@ -219,6 +247,16 @@ class MetricsComponent:
                 round(self.hit_overlap_blocks / self.hit_isl_blocks, 6),
             )
         gauge("kv_hit_events_total", self.hit_events)
+        # cost-aware routing: the scheduler's predicted TTFT for its
+        # chosen workers (EWMA over cost-mode decisions; absent until
+        # the first calibrated decision lands). getattr: render-only
+        # harnesses construct this component via __new__
+        if getattr(self, "route_cost_events", 0):
+            gauge("route_cost_decisions_total", self.route_cost_events)
+            gauge(
+                "route_predicted_ttft_ms",
+                round(self.route_predicted_ttft_ms, 3),
+            )
         # SLA planner plane (docs/planner.md): the last decision +
         # capacity watermark this component saw on the bus
         gauge("planner_decisions_total", self.planner_decisions_total)

@@ -278,3 +278,48 @@ def cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+#: memoized default-devices fingerprint, keyed by pid so a (rare)
+#: fork doesn't inherit the parent's identity — the value is constant
+#: for a process's backend, and the callers sit on per-stream paths
+_slice_fp_cache: dict[int, str] = {}
+
+
+def slice_fingerprint(devices=None) -> str:
+    """Stable identity of the accelerator slice THIS process dispatches
+    to — equal fingerprints mean KV can move device→device over ICI
+    (disagg/ici.py) instead of gather→host→scatter.
+
+    Built from the device topology (platform, owning process, device
+    id/coords). Under a multi-controller runtime (jax.distributed) every
+    rank sees the same global device list, so all ranks of one slice
+    agree. WITHOUT one, each process owns an isolated local backend:
+    two such processes are never one slice even on the same host, so
+    the host+pid salt keeps their fingerprints distinct while two
+    engines inside ONE process (the LocalKvPipe arrangement) still
+    match. The default-devices value is memoized per process."""
+    import hashlib
+    import os
+    import socket
+
+    pid = os.getpid()
+    if devices is None:
+        cached = _slice_fp_cache.get(pid)
+        if cached is not None:
+            return cached
+    devs = list(devices) if devices is not None else jax.devices()
+    h = hashlib.blake2b(digest_size=8)
+    if jax.process_count() <= 1:
+        h.update(f"{socket.gethostname()}:{pid}|".encode())
+    for d in devs:
+        coords = getattr(d, "coords", None)
+        h.update(
+            f"{d.platform}:{getattr(d, 'process_index', 0)}:{d.id}:"
+            f"{coords};".encode()
+        )
+    fp = h.hexdigest()
+    if devices is None:
+        _slice_fp_cache.clear()
+        _slice_fp_cache[pid] = fp
+    return fp

@@ -51,6 +51,10 @@ POINTS = (
     # killing here is a worker dying mid-peer-pull (the puller must
     # degrade to recompute, the peer's tiers must stay intact)
     "mid_peer_serve",
+    # PRESERVE-style weight pre-stage on the prefetch-hint path: a kill
+    # here is the pre-stage plumbing dying — the hint's KV restore must
+    # proceed untouched (the pre-stage is advisory, guarded separately)
+    "pre_stage_weights",
 )
 
 ACTIONS = ("kill", "delay")

@@ -53,6 +53,42 @@ COMPONENTS = (
 )
 
 
+def cost_observations(spans: list[dict]) -> list[tuple[str, int, float]]:
+    """Transfer-cost observations carried by one trace's spans, as
+    ``(link_class, nbytes, wall_ms)`` tuples — the bridge between the
+    PR 2 decomposition (which already measures every transfer term per
+    request) and the self-calibrating cost model (kv_router/costmodel):
+    a collector can replay a trace's transfer activity into a
+    ``TransferCostModel`` exactly as the worker observed it live.
+
+    Sources: ``prefill.kv_send`` spans stamp ``link``/``nbytes`` (dcn
+    for cross-host streamed sends, ici for the same-slice device path,
+    local for the un-negotiated in-process pipe) with the measured send
+    activity (hidden + exposed); kv_restore spans that stamp ``nbytes``
+    count as the host class. Spans without a byte count are skipped —
+    an observation without volume can't inform a bandwidth estimate."""
+    out: list[tuple[str, int, float]] = []
+    for s in spans:
+        attrs = s.get("attrs", {}) or {}
+        nbytes = int(attrs.get("nbytes", 0) or 0)
+        if not nbytes:
+            continue
+        if s["name"] == SPAN_PREFILL_KV_SEND:
+            wall = float(attrs.get("hidden_ms", 0.0) or 0.0) + float(
+                attrs.get("exposed_ms", 0.0) or 0.0
+            )
+            link = str(attrs.get("link") or "dcn")
+            if wall > 0:
+                out.append((link, nbytes, wall))
+        elif s["name"] == SPAN_KV_RESTORE:
+            wall = float(attrs.get("hidden_ms", 0.0) or 0.0) + float(
+                attrs.get("exposed_ms", 0.0) or 0.0
+            )
+            if wall > 0:
+                out.append(("host", nbytes, wall))
+    return out
+
+
 def _sum_dur(spans: list[dict], name: str) -> float:
     return sum(s["dur_ms"] for s in spans if s["name"] == name)
 

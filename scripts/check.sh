@@ -24,7 +24,7 @@ python -m pytest tests/test_analysis.py -q -p no:cacheprovider
 
 echo "==> compiled-perf shape-bucketing guards (mixed-step program count)"
 python -m pytest tests/test_compiled_perf.py -q -p no:cacheprovider \
-    -k "mixed_step_program_count or streamed_handoff_program_count"
+    -k "mixed_step_program_count or streamed_handoff_program_count or ici_mover_program_count"
 
 if [[ "${1:-}" != "--fast" ]]; then
     echo "==> sanitizer-strict fast subset (loop-stall + leaked-writer guards live)"
@@ -33,6 +33,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_offload.py \
         tests/test_offload_pipeline.py \
         tests/test_prefix_fleet.py \
+        tests/test_cost_routing.py \
         tests/test_tracing.py \
         tests/test_resilience.py \
         tests/test_kv_router.py \

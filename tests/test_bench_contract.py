@@ -118,6 +118,21 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert pf["kill"]["kills_fired"] == 1, pf
     assert pf["kill"]["client_errors"] == 0, pf
     assert pf["kill"]["tokens_match"] is True, pf
+    # transfer-cost-aware placement must be recorded (ISSUE 11): on the
+    # heterogeneous two-candidate workload the overlap-only scorer picks
+    # the deeper-but-cold-tier busy worker, the cost model picks the
+    # device-hot idle one, and its choice is genuinely TTFT-optimal
+    # (direction-only: the served p50s, not a tight ratio)
+    cr = result.get("bench_cost_routing")
+    assert cr, result.get("bench_cost_routing_error", "metric missing")
+    assert cr["tokens_match"] is True, cr
+    assert cr["overlap_only"]["worker"] == "deep_tier", cr
+    assert cr["cost_aware"]["worker"] == "device_hot", cr
+    assert cr["cost_aware"]["picks"] == ["device_hot"] * 3, cr
+    assert cr["predicted_ttft_ms"] and cr["predicted_ttft_ms"] > 0, cr
+    assert (
+        cr["cost_aware"]["ttft_p50_ms"] <= cr["overlap_only"]["ttft_p50_ms"]
+    ), cr
 
 
 def test_smoke_regression_band_catches_r03_drop():

@@ -412,3 +412,49 @@ def test_streamed_handoff_program_count_bounded(run):
         await decode.close()
 
     run(main())
+
+
+def test_ici_mover_program_count_bounded(run):
+    """Shape-bucketing guard for the ICI same-slice handoff (ISSUE 11):
+    the decode sink's per-segment device→device mover must compile one
+    program per SEGMENT-GEOMETRY BUCKET (the same ``_pad_idxs``
+    power-of-two bucketing as the streamed scatter), never per segment
+    size — an accidental per-shape key would inject an XLA compile into
+    every segment of every new prompt length."""
+    from dynamo_tpu.disagg.ici import IciSegmentMover
+    from dynamo_tpu.engine.offload import _pad_idxs
+
+    def main():
+        import jax.numpy as jnp
+
+        mover = IciSegmentMover(None, None)
+        seen_buckets = set()
+        # segment sizes across two buckets (1,2 -> 2; 3,4 -> 4) in a
+        # fixed [L=2, H=2, n, bs=4, D=8] geometry — also odd/partial
+        # tails, which the mover pads to the bucket before the compiled
+        # move and slices back after
+        for n in (1, 2, 3, 4, 2, 3, 1, 4):
+            k = jnp.arange(2 * 2 * n * 4 * 8, dtype=jnp.float32).reshape(
+                2, 2, n, 4, 8
+            )
+            v = k + 1
+            seen_buckets.add(len(_pad_idxs(list(range(n)))))
+            mk, mv = mover.move(k, v)
+            assert mk.shape == k.shape and mv.shape == v.shape
+            assert jnp.array_equal(mk, k) and jnp.array_equal(mv, v)
+        assert mover.segments_moved == 8
+        # k and v compile separately (MLA-asymmetric shapes), so the
+        # bound is 2 programs per bucket
+        assert mover.programs() <= 2 * len(seen_buckets), (
+            f"ici mover compiled {mover.programs()} programs for "
+            f"{len(seen_buckets)} segment buckets {sorted(seen_buckets)}"
+        )
+        # the matched-geometry (single-device) case took the explicit
+        # shard_map path, not the generic reshard
+        assert mover.permute_programs == mover.programs()
+        assert mover.reshard_programs == 0
+
+    async def amain():
+        main()
+
+    run(amain())
