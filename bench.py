@@ -783,6 +783,7 @@ def _overload_stats() -> dict:
     from dynamo_tpu.models.config import ModelConfig
     from dynamo_tpu.planner import AdmissionGate
     from dynamo_tpu.protocols.common import (
+        FinishReason,
         PreprocessedRequest,
         SamplingOptions,
         StopConditions,
@@ -797,8 +798,14 @@ def _overload_stats() -> dict:
     engine = JaxEngine(cfg, seed=0)
 
     def req(base):
+        # mod keeps every id inside the tiny model's 512-token vocab:
+        # the engine rejects OOB prompt ids with a clean ERROR finish
+        # (PR 8 hardening), and an instantly-erroring wave measures a
+        # fictional multi-thousand-req/s "capacity" that the gate can
+        # never shed against (this bench was silently doing exactly
+        # that — caught when the shed assertion finally flaked to 0)
         return PreprocessedRequest(
-            token_ids=list(range(base, base + 12)),
+            token_ids=[(base + j) % 500 for j in range(12)],
             stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
             sampling_options=SamplingOptions(temperature=0.0, seed=0),
             eos_token_ids=[],
@@ -811,6 +818,12 @@ def _overload_stats() -> dict:
         try:
             async for item in engine.generate(Context(req(600 + 13 * i))):
                 if getattr(item, "error", None):
+                    outcome["errors"] += 1
+                    return
+                if getattr(item, "finish_reason", None) == FinishReason.ERROR:
+                    # an engine-rejected request is a FAILURE, not a
+                    # completion — counting its instant finish as served
+                    # capacity is how the vocab bug above hid
                     outcome["errors"] += 1
                     return
                 data = getattr(item, "data", item)
@@ -1341,6 +1354,152 @@ def _prefix_fleet_stats() -> dict:
     return {"bench_prefix_fleet": asyncio.run(run())}
 
 
+def _reshard_child() -> dict:
+    """Child-process body for bench_reshard (spawned by _reshard_stats
+    with a 2-device CPU topology — the parent bench runs single-device,
+    and a TP morph needs somewhere to morph TO).
+
+    One tiny engine serves a staggered wave of live greedy decode
+    streams while its parallelism degree morphs TP=1 → TP=2 → TP=1
+    under them (engine.reshard: quiesce / re-lay weights+KV through the
+    compiled MeshMorpher programs / resume). The artifact carries the
+    COST of elasticity: per-morph hold wall (the only window tokens
+    stop flowing) and total wall (staging included — it overlaps
+    serving), the wave's per-token gap p50/p99 (tokens-held-back:
+    morphs surface as tail gaps), the KV blocks re-laid, and the
+    bit-exactness of every stream against an unmorphed reference."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context
+
+    tiny = ModelConfig.tiny()
+
+    def mk():
+        cfg = EngineConfig(
+            model=tiny, num_blocks=128, block_size=4, max_batch_size=4,
+            max_context=128, prefill_chunk=32, decode_window=1,
+        )
+        return JaxEngine(cfg, seed=0)
+
+    def req(base, n=48):
+        return PreprocessedRequest(
+            token_ids=list(range(base, base + 12)),
+            stop_conditions=StopConditions(max_tokens=n, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    N = 6
+    bases = [200 + 17 * i for i in range(N)]
+
+    async def drive(engine, base, gaps=None):
+        toks, last = [], _time.perf_counter()
+        async for out in engine.generate(Context(req(base))):
+            now = _time.perf_counter()
+            if out.token_ids:
+                if gaps is not None and toks:
+                    gaps.append((now - last) * 1e3)
+                toks.extend(out.token_ids)
+                last = now
+            if out.finish_reason is not None and out.finish_reason.value == "error":
+                raise RuntimeError(out.text or "stream error")
+        return toks
+
+    async def run() -> dict:
+        # unmorphed reference streams (and program warm-up)
+        ref_engine = mk()
+        reference = {}
+        for b in bases:
+            reference[b] = await drive(ref_engine, b)
+        await ref_engine.close()
+
+        eng = mk()
+        await drive(eng, 400)  # warm this engine's caches too
+        gaps: list = []
+        errors = {"n": 0}
+
+        async def one(b):
+            try:
+                return await drive(eng, b, gaps)
+            except Exception:  # noqa: BLE001 — a client-visible failure
+                errors["n"] += 1
+                return []
+
+        tasks = []
+        for b in bases:
+            tasks.append(asyncio.ensure_future(one(b)))
+            await asyncio.sleep(0.02)
+        # two live morphs while every stream decodes
+        await asyncio.sleep(0.05)
+        up = await eng.reshard(MeshConfig(tp=2))
+        await asyncio.sleep(0.1)
+        down = await eng.reshard(None)
+        streams = await asyncio.gather(*tasks)
+        lm = eng.load_metrics()
+        await eng.close()
+        match = all(streams[i] == reference[b] for i, b in enumerate(bases))
+        return {
+            "bench_reshard": {
+                "requests": N,
+                "client_errors": errors["n"],
+                "tokens_match": match,
+                "morphs": 2,
+                "morph_hold_ms": [up["hold_ms"], down["hold_ms"]],
+                "morph_total_ms": [up["total_ms"], down["total_ms"]],
+                "kv_moved_blocks": (
+                    up["kv_moved_blocks"] + down["kv_moved_blocks"]
+                ),
+                "token_gap_p50_ms": round(_pct(gaps, 50), 3) if gaps else None,
+                # tokens-held-back: the morph hold windows live in this tail
+                "token_gap_p99_ms": round(_pct(gaps, 99), 3) if gaps else None,
+                "token_gap_max_ms": round(max(gaps), 3) if gaps else None,
+                # the gauges the metrics plane re-exports per worker
+                "gauges": {
+                    "resharded_total": lm["resharded_total"],
+                    "reshard_hold_ms": lm["reshard_hold_ms"],
+                    "reshard_kv_moved_blocks": lm["reshard_kv_moved_blocks"],
+                },
+            }
+        }
+
+    return asyncio.run(run())
+
+
+def _reshard_stats() -> dict:
+    """Run the live-reshard scenario in a CHILD process with a 2-device
+    CPU topology (xla_force_host_platform_device_count): the parent
+    bench deliberately runs the driver's single-device config, and a
+    TP=1→2 morph is meaningless without a second device to morph onto."""
+    import os
+    import subprocess
+
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--reshard-child"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"reshard child failed rc={r.returncode}: {r.stderr[-800:]}"
+        )
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    if len(lines) != 1:
+        raise RuntimeError(f"reshard child emitted {len(lines)} JSON lines")
+    return json.loads(lines[0])
+
+
 def _cost_routing_stats() -> dict:
     """bench_cost_routing (ISSUE 11 / ROADMAP item 1, NetKV): two
     heterogeneous decode candidates for one shared-prefix request —
@@ -1693,10 +1852,24 @@ def main() -> None:
         result.update(_cost_routing_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_cost_routing_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_reshard_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_reshard_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    if "--reshard-child" in sys.argv:
+        # the bench_reshard scenario body, re-exec'd with a 2-device
+        # CPU topology by _reshard_stats; one JSON line, like the bench
+        try:
+            print(json.dumps(_reshard_child()))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"bench_reshard_error":
+                              f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
+        sys.exit(0)
     try:
         main()
     except Exception as e:

@@ -795,6 +795,96 @@ class SpanLeakRule(Rule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# 10. mesh-capture
+# ---------------------------------------------------------------------------
+
+
+class MeshCaptureRule(Rule):
+    """A concrete ``Mesh``/``NamedSharding`` captured at IMPORT time
+    (module or class scope, or a top-level function's default argument)
+    in the engine/ops/models/disagg packages. The bug class the elastic
+    live-reshard refactor exists to kill (ISSUE 12): a placement
+    resolved when the module loads survives a live morph and silently
+    pins dispatch to the pre-morph layout — weights move, the captured
+    sharding doesn't, and the next dispatch re-lays everything back (or
+    crosses device sets and crashes). Placement must resolve at CALL
+    time against the engine's current mesh: module scope may hold
+    logical ``PartitionSpec``s (mesh-free by construction) and the
+    rules tables in parallel/mesh.py; anything that binds devices
+    belongs inside a function the reshard path re-runs
+    (``LogicalLayout`` / ``MeshMorpher``)."""
+
+    name = "mesh-capture"
+    summary = "concrete Mesh/NamedSharding bound at import time (reshard invariant)"
+
+    #: call targets that bind CONCRETE devices (PartitionSpec / P do
+    #: not — they are the logical layer module scope is allowed)
+    TARGETS = {
+        "Mesh",
+        "NamedSharding",
+        "make_mesh",
+        "cache_sharding",
+        "param_sharding",
+        "shard_params",
+        "global_mesh",
+    }
+
+    PACKAGES = (
+        "dynamo_tpu/engine/",
+        "dynamo_tpu/ops/",
+        "dynamo_tpu/models/",
+        "dynamo_tpu/disagg/",
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.PACKAGES)
+
+    def _walk_import_time(self, node: ast.AST, relpath: str, where: str,
+                          out: list[Violation]) -> None:
+        """Visit exactly what EXECUTES at import: class bodies do;
+        function/lambda bodies don't (call time) — but a def's default
+        arguments evaluate when the def does, so wherever a def
+        executes (module scope, class body, inside a module-level
+        if/try), its defaults are import-time and its body is not."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in (
+                list(node.args.defaults)
+                + [d for d in node.args.kw_defaults if d is not None]
+            ):
+                self._walk_import_time(
+                    default, relpath, f"function default ({node.name})",
+                    out)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.ClassDef):
+            for sub in ast.iter_child_nodes(node):
+                self._walk_import_time(
+                    sub, relpath, f"class scope ({node.name})", out)
+            return
+        if isinstance(node, ast.Call):
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf in self.TARGETS:
+                out.append(Violation(
+                    self.name, relpath, node.lineno,
+                    f"`{leaf}(...)` at {where} binds a concrete device "
+                    "placement at import time — it goes stale the "
+                    "moment the engine morphs its mesh (elastic "
+                    "resharding). Resolve placement at call time "
+                    "against the current mesh (LogicalLayout) and keep "
+                    "module scope to logical PartitionSpecs",
+                ))
+        for sub in ast.iter_child_nodes(node):
+            self._walk_import_time(sub, relpath, where, out)
+
+    def check(self, relpath, source, tree):
+        out: list[Violation] = []
+        for sub in ast.iter_child_nodes(tree):
+            self._walk_import_time(sub, relpath, "module scope", out)
+        return out
+
+
 ALL_RULES: tuple[Rule, ...] = (
     AsyncBlockingCallRule(),
     AwaitInLockRule(),
@@ -805,4 +895,5 @@ ALL_RULES: tuple[Rule, ...] = (
     SwallowedExceptionRule(),
     BlockingDiskIoRule(),
     SpanLeakRule(),
+    MeshCaptureRule(),
 )

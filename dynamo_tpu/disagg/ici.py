@@ -13,7 +13,13 @@ placement. This module makes the cheapest path explicit and negotiated:
   info (version-negotiated exactly like ``kv_stream`` — an old peer
   never sees the flag, a mismatched peer falls back to the TCP/streamed
   path), the prefill worker stamps ``ici: 1`` into the stream header
-  only when its own fingerprint matches.
+  only when its own fingerprint matches. Negotiation keys on SLICE
+  IDENTITY, not channel: in-process ``LocalKvPipe`` pairs hand device
+  arrays straight through, and launched same-slice roles (one slice,
+  several processes) get the same negotiated landing for their wire
+  segments — the mover places each one explicitly onto the decode
+  layout in a compiled program instead of letting the scatter resolve
+  a foreign placement per op.
 
 * :class:`IciSegmentMover` re-lays each arriving segment from the
   source engine's sharding onto the decode cache's sharding with a
@@ -43,7 +49,6 @@ prefer same-slice placement once the fast path exists (costmodel.py).
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -88,24 +93,38 @@ class IciSegmentMover:
     """Per-handoff device→device segment re-layout onto the decode
     cache's shardings. Construct once per negotiated stream (the decode
     sink owns it); ``move(k_seg, v_seg)`` returns the pair placed for
-    the decode scatter, still on device."""
+    the decode scatter, still on device.
 
-    def __init__(self, k_sharding, v_sharding):
+    Program construction and memoization live in the shared
+    :class:`~dynamo_tpu.parallel.morph.MeshMorpher` (the PR 11 private
+    memo promoted there when elastic resharding needed the same compiled
+    cross-mesh permutations for weights/KV) — this class only owns the
+    segment-specific parts: the k/v destination shardings and the
+    pad-to-geometry-bucket discipline that keeps the morpher's memo
+    bounded by buckets."""
+
+    def __init__(self, k_sharding, v_sharding, morpher=None):
+        from ..parallel.morph import MeshMorpher
+
         # decode-side cache shardings for [L, Hkv, n, bs, D] segments
         # (None = unsharded single-device engine: the mover still runs
         # its compiled program over a 1-device mesh so the path — and
         # its program-count contract — is exercised everywhere)
         self._k_sh = k_sharding
         self._v_sh = v_sharding
-        self._fns: dict = {}
+        self._morpher = morpher if morpher is not None else MeshMorpher()
         self.segments_moved = 0
-        self.permute_programs = 0
-        self.reshard_programs = 0
 
     def programs(self) -> int:
-        return len(self._fns)
+        return self._morpher.programs()
 
-    # ---- program construction ----
+    @property
+    def permute_programs(self) -> int:
+        return self._morpher.permute_programs
+
+    @property
+    def reshard_programs(self) -> int:
+        return self._morpher.reshard_programs
 
     def _dst_sharding(self, which: str):
         sh = self._k_sh if which == "k" else self._v_sh
@@ -114,88 +133,6 @@ class IciSegmentMover:
         # unsharded engine: replicate over a 1-device mesh — the
         # degenerate slice, where the permutation is the identity
         return NamedSharding(Mesh(jax.devices()[:1], ("ici",)), P())
-
-    @staticmethod
-    def _one_axis_split(sharding, shape) -> Optional[tuple[int, list]]:
-        """Describe ``sharding`` over ``shape`` as an even split of at
-        most ONE array axis across its devices: returns (axis, devices
-        in shard order) — axis -1 when every device holds the whole
-        array (replicated / single device). None for anything richer
-        (multi-axis splits take the reshard program instead)."""
-        try:
-            idx_map = sharding.devices_indices_map(tuple(shape))
-        except Exception:  # noqa: BLE001 — exotic sharding
-            return None
-        split_axis = None
-        keyed = []
-        for d, idx in idx_map.items():
-            axes = [
-                a for a, s in enumerate(idx)
-                if not (s.start in (0, None) and s.stop in (None, shape[a]))
-            ]
-            if len(axes) > 1:
-                return None
-            if axes:
-                a = axes[0]
-                if split_axis is None:
-                    split_axis = a
-                elif split_axis != a:
-                    return None
-                keyed.append((idx[a].start or 0, d))
-            else:
-                keyed.append((0, d))
-        if split_axis is None:
-            return -1, sorted((d for _s, d in keyed), key=lambda d: d.id)
-        keyed.sort(key=lambda t: t[0])
-        starts = [s for s, _d in keyed]
-        if len(set(starts)) != len(starts):
-            return None  # partial replication inside the split
-        return split_axis, [d for _s, d in keyed]
-
-    def _build(self, src_sharding, dst_sharding, shape, dtype):
-        """One compiled mover program for this geometry bucket.
-
-        Matched geometry — both engines split the same single axis into
-        the same shard-per-device layout (including the degenerate
-        replicated / 1-device slice) — compiles an explicit ``shard_map``
-        program over the slice's devices: the per-segment collective is
-        the identity permutation there, and the program pins the
-        device-resident contract structurally (a host round-trip cannot
-        hide inside a shard_map body). Anything richer — a tp regroup,
-        a pp re-stage, shards in a different device order — compiles a
-        jitted identity with ``out_shardings``: the one re-layout API
-        XLA lowers to the slice's own collective_permute / all-gather
-        over ICI. Both flavors stay device→device end to end; which one
-        a handoff compiled is visible in ``permute_programs`` vs
-        ``reshard_programs``."""
-        from ..ops._pallas_compat import shard_map as _smap
-
-        src = self._one_axis_split(src_sharding, shape) if src_sharding else None
-        dst = self._one_axis_split(dst_sharding, shape)
-        matched = (
-            src is not None and dst is not None and src[0] == dst[0]
-            and src[1] == dst[1]
-        )
-        if not matched:
-            self.reshard_programs += 1
-            return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry bucket in self._fns (_move_one)
-                lambda a: a, out_shardings=dst_sharding
-            )
-        axis, devs = dst
-        mesh = Mesh(devs, ("ici",))
-        spec = P() if axis < 0 else P(*([None] * axis), "ici")
-
-        def body(a):
-            # identity permutation: shards are already on the devices
-            # the decode cache wants them on — the shard_map is the
-            # structural no-host-hop guarantee, not a data move
-            return a
-
-        fn = _smap(body, mesh=mesh, in_specs=spec, out_specs=spec)
-        self.permute_programs += 1
-        return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry bucket in self._fns (_move_one)
-            fn, out_shardings=dst_sharding
-        )
 
     # ---- the hot path ----
 
@@ -211,16 +148,7 @@ class IciSegmentMover:
             pad = [(0, 0)] * x.ndim
             pad[2] = (0, bucket - n)
             x = jnp.pad(x, pad)
-        key = (
-            which, tuple(x.shape), str(x.dtype),
-            getattr(x, "sharding", None) and repr(x.sharding),
-        )
-        fn = self._fns.get(key)
-        if fn is None:
-            fn = self._fns[key] = self._build(
-                getattr(x, "sharding", None), dst, x.shape, x.dtype
-            )
-        out = fn(x)
+        out = self._morpher.apply(x, dst)
         return out[:, :, :n] if n < bucket else out
 
     def move(self, k_seg, v_seg):

@@ -292,15 +292,21 @@ class PrefillWorker:
         n_prompt = engine.n_prompt_blocks(len(req.token_ids))
         n = max(n_prompt - rpr.skip_blocks, 0)
         kc, vc = engine.k_cache, engine.v_cache
-        # ICI fast path: only meaningful on the in-process (device
-        # array) channel, and only when the decode peer negotiated it —
-        # a kv-head-layout mismatch drops it too (the decode sink's
-        # regroup owns that case), keeping the fallback matrix clean
+        # ICI fast path: negotiated on SLICE IDENTITY, not channel —
+        # the decode peer advertised a covering kv_ici version and the
+        # same slice fingerprint as this engine's devices. In-process
+        # (LocalKvPipe) handoffs stay device-resident end to end;
+        # launched same-slice roles ship wire segments but the decode
+        # sink still lands them through the compiled per-bucket mover
+        # programs onto its cache layout (mesh-agnostic placement)
+        # instead of letting the scatter resolve a foreign placement
+        # implicitly. A kv-head-layout mismatch drops it (the decode
+        # sink's regroup owns that case), keeping the fallback matrix
+        # clean
         from .ici import ici_negotiated
 
         ici = (
-            local
-            and ici_negotiated(rpr.connection, engine, enabled=self.kv_ici)
+            ici_negotiated(rpr.connection, engine, enabled=self.kv_ici)
             and layout == rpr.connection.get("ici_layout", layout)
         )
         head = {

@@ -111,6 +111,14 @@ class BlockAllocator:
     def used_count(self) -> int:
         return self.num_blocks - 1 - self.free_count
 
+    @property
+    def resident_count(self) -> int:
+        """Blocks holding LIVE KV content: ref'd by sequences or parked
+        in the content-addressed reuse pool (claimable prefix cache).
+        This is what a live reshard actually re-lays — the reuse pool's
+        prefix blocks survive a morph exactly like active ones."""
+        return self.num_blocks - 1 - len(self._free)
+
     def usage(self) -> float:
         cap = self.num_blocks - 1
         return self.used_count / cap if cap else 0.0

@@ -35,7 +35,7 @@ import numpy as np
 from ..models import llama
 from ..models.config import ModelConfig
 from ..ops.sampling import make_keys, sample_first_token, sample_tokens
-from ..parallel.mesh import MeshConfig, cache_sharding, make_mesh, shard_params
+from ..parallel.mesh import LogicalLayout, MeshConfig, make_mesh
 from ..protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -288,6 +288,14 @@ class OutOfBlocks(Exception):
     queue nacks the item so another worker, or this one later, retries)."""
 
 
+class ReshardUnsupported(RuntimeError):
+    """This engine cannot morph its mesh live (multi-host mirrors: every
+    dispatch is a lockstep broadcast and the followers' device state
+    can't be re-laid from the leader's loop). Callers fall back to the
+    PR 4 migration path — drain with handoff so the streams continue on
+    workers that CAN serve the new layout."""
+
+
 @dataclass
 class _Sequence:
     request: PreprocessedRequest
@@ -329,6 +337,11 @@ class JaxEngine(AsyncEngine):
         # the leader of a process-spanning mesh — every device dispatch is
         # broadcast to follower ranks which replay the identical jit call
         self.mirror = mirror
+        # the LOGICAL sharding contract (parallel/mesh.LogicalLayout):
+        # placement rules carried mesh-free, resolved against whatever
+        # mesh currently backs the engine — the refactor that makes
+        # reshard() a first-class operation instead of a rebuild
+        self.layout = LogicalLayout(mcfg)
         if mirror is not None:
             self.mesh = mirror.mesh
         else:
@@ -343,8 +356,8 @@ class JaxEngine(AsyncEngine):
                                  experts=cfg.quant_experts)
         if mirror is not None:
             params = mirror.shard_params(params)
-        elif self.mesh is not None:
-            params = shard_params(params, self.mesh)
+        else:
+            params = self.layout.place_params(params, self.mesh)
         self.params = params
         cache_dt = kv_cache_dtype(mcfg, cfg.kv_cache_dtype)
         if mirror is not None:
@@ -353,8 +366,8 @@ class JaxEngine(AsyncEngine):
             k, v = llama.init_kv_cache(
                 mcfg, cfg.num_blocks, cfg.block_size, dtype=cache_dt
             )
-            if self.mesh is not None:
-                sh = cache_sharding(self.mesh, mcfg)
+            sh = self.layout.cache_sharding(self.mesh)
+            if sh is not None:
                 k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
@@ -390,60 +403,7 @@ class JaxEngine(AsyncEngine):
             self.cost = TransferCostModel(block_bytes=self.kv_block_bytes)
             if self.offload is not None:
                 self.offload.cost_model = self.cost
-        # Pallas decode path: TPU backend + aligned tiles. Sharded meshes
-        # run the kernel under shard_map over tp (head-parallel, no
-        # collectives) when tp divides the kv heads; otherwise the XLA
-        # fallback lets GSPMD handle the uneven split.
-        tp = self.mesh.shape["tp"] if self.mesh is not None else 1
-        self.use_pallas = (
-            jax.default_backend() == "tpu"
-            and cfg.block_size % 8 == 0
-            # quantized KV caches take the XLA path (which casts on read);
-            # the Mosaic kernels assume bf16/f32 page tiles
-            and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
-            and (
-                (
-                    not cfg.model.is_mla
-                    # 64 covers gpt-oss (head_dim=64): Mosaic pads
-                    # sub-128 lane tiles; if this chip/toolchain
-                    # rejects that, _pallas_guard flips the engine to
-                    # XLA at first dispatch instead of failing the
-                    # request (validate_tpu_kernels checks D=64
-                    # on-chip). Sinks fold into the kernels' merge
-                    # denominators and per-layer windows are static
-                    # per unrolled layer call, so gpt-oss is NOT
-                    # gated off.
-                    and cfg.model.head_dim % 64 == 0
-                    # gemma-2 score softcapping lives in the XLA paths
-                    and not cfg.model.attn_softcap
-                    and (
-                        self.mesh is None
-                        or cfg.model.num_kv_heads % tp == 0
-                    )
-                )
-                or (
-                    # MLA: the latent decode kernel + merged one-write
-                    # append (ops/mla_attention_pallas). Query heads are
-                    # the tp axis; the latent cache replicates — but pp
-                    # shards the cache's LAYER axis, which the per-layer
-                    # shard_map would have to all-gather back, so pp
-                    # meshes keep the XLA absorbed path.
-                    cfg.model.is_mla
-                    and cfg.model.kv_lora_rank % 128 == 0
-                    and (
-                        self.mesh is None
-                        or (
-                            self.mesh.shape.get("pp", 1) == 1
-                            # the sharded latent kernels shard_map the
-                            # QUERY-head axis over tp (advisor r3): an
-                            # uneven split must fall back to XLA, not
-                            # crash at first decode
-                            and cfg.model.num_heads % tp == 0
-                        )
-                    )
-                )
-            )
-        )
+        self.use_pallas = self._use_pallas_for(self.mesh)
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         # re-admissions (preemption replay, backpressure put-back) jump
         # the line through this explicit front buffer — consumers drain
@@ -481,6 +441,18 @@ class JaxEngine(AsyncEngine):
         self._drain_handoff = True
         self._drain_deadline = 0.0
         self._dead: Optional[str] = None
+        # elastic live resharding (docs/elastic_resharding.md): a posted
+        # morph request the scheduler loop commits at a step boundary;
+        # _resharding is advertised through load_metrics so the router
+        # soft-excludes this worker for the morph window. The morpher
+        # (parallel/morph.MeshMorpher) memoizes the compiled cross-mesh
+        # permutation programs across morphs, lazily built on first use.
+        self._reshard_req: Optional[dict] = None
+        # claimed synchronously at reshard() entry (before the staging
+        # await) so concurrent calls can't both pass the overlap check
+        self._reshard_busy = False
+        self._resharding = False
+        self.morpher = None
         # host mirrors of device-side batch state
         M = cfg.max_blocks_per_seq
         self._block_tables = np.zeros((cfg.max_batch_size, M), np.int32)
@@ -525,7 +497,74 @@ class JaxEngine(AsyncEngine):
             # PRESERVE weight pre-stage requests resolved through the
             # (no-op today) pre_stage_weights hook
             "weight_prestage_requests": 0,
+            # elastic resharding: completed morphs, KV blocks re-laid by
+            # the last morph's commit, and the last morph's client-
+            # visible hold window (quiesce -> resume, weight staging
+            # excluded — it overlaps serving)
+            "resharded_total": 0,
+            "reshard_kv_moved_blocks": 0,
+            "reshard_hold_ms": 0.0,
         }
+
+    def _use_pallas_for(self, mesh) -> bool:
+        """Pallas decode path for ``mesh``: TPU backend + aligned tiles.
+        Sharded meshes run the kernel under shard_map over tp
+        (head-parallel, no collectives) when tp divides the kv heads;
+        otherwise the XLA fallback lets GSPMD handle the uneven split.
+        A method (not an __init__ constant) because reshard() must
+        re-derive it for the new mesh — tp=4 may gate the kernel off
+        where tp=1 allowed it."""
+        cfg = self.cfg
+        tp = mesh.shape["tp"] if mesh is not None else 1
+        return (
+            jax.default_backend() == "tpu"
+            and cfg.block_size % 8 == 0
+            # quantized KV caches take the XLA path (which casts on read);
+            # the Mosaic kernels assume bf16/f32 page tiles
+            and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
+            and (
+                (
+                    not cfg.model.is_mla
+                    # 64 covers gpt-oss (head_dim=64): Mosaic pads
+                    # sub-128 lane tiles; if this chip/toolchain
+                    # rejects that, _pallas_guard flips the engine to
+                    # XLA at first dispatch instead of failing the
+                    # request (validate_tpu_kernels checks D=64
+                    # on-chip). Sinks fold into the kernels' merge
+                    # denominators and per-layer windows are static
+                    # per unrolled layer call, so gpt-oss is NOT
+                    # gated off.
+                    and cfg.model.head_dim % 64 == 0
+                    # gemma-2 score softcapping lives in the XLA paths
+                    and not cfg.model.attn_softcap
+                    and (
+                        mesh is None
+                        or cfg.model.num_kv_heads % tp == 0
+                    )
+                )
+                or (
+                    # MLA: the latent decode kernel + merged one-write
+                    # append (ops/mla_attention_pallas). Query heads are
+                    # the tp axis; the latent cache replicates — but pp
+                    # shards the cache's LAYER axis, which the per-layer
+                    # shard_map would have to all-gather back, so pp
+                    # meshes keep the XLA absorbed path.
+                    cfg.model.is_mla
+                    and cfg.model.kv_lora_rank % 128 == 0
+                    and (
+                        mesh is None
+                        or (
+                            mesh.shape.get("pp", 1) == 1
+                            # the sharded latent kernels shard_map the
+                            # QUERY-head axis over tp (advisor r3): an
+                            # uneven split must fall back to XLA, not
+                            # crash at first decode
+                            and cfg.model.num_heads % tp == 0
+                        )
+                    )
+                )
+            )
+        )
 
     # ---------------- public api ----------------
 
@@ -745,6 +784,20 @@ class JaxEngine(AsyncEngine):
             "kv_block_bytes": self.kv_block_bytes,
             "kv_block_size": self.cfg.block_size,
             "kv_slice_fp": self._slice_fp(),
+            # the ACTUALLY-deployed TP degree: seeds the planner's
+            # morph guard so a restarted planner reasons from the
+            # pool's real layout instead of tp_min
+            "mesh_tp": self.cfg.mesh.tp if self.cfg.mesh is not None else 1,
+            # elastic-reshard surface: ``resharding`` marks the morph
+            # window (the router soft-excludes this worker for it, like
+            # ``draining`` but transient); the counters/gauges feed the
+            # metrics component (resharded_total, reshard_hold_ms,
+            # reshard_kv_moved_blocks)
+            "resharding": int(self._resharding),
+            "resharded_total": self.stats["resharded_total"],
+            "reshard_hold_ms": self.stats["reshard_hold_ms"],
+            "reshard_kv_moved_blocks": self.stats[
+                "reshard_kv_moved_blocks"],
             "peer_serve_d2h_blocks_total": self.stats[
                 "peer_serve_d2h_blocks"],
             "weight_prestage_requests": self.stats[
@@ -813,6 +866,221 @@ class JaxEngine(AsyncEngine):
         )
         self._finish(seq, FinishReason.ERROR, emit=False)
 
+    # ---------------- elastic live resharding ----------------
+    # (docs/elastic_resharding.md — quiesce / morph / resume)
+
+    @staticmethod
+    def _mesh_shape(mc: Optional[MeshConfig]) -> tuple:
+        return (mc.dp, mc.pp, mc.sp, mc.ep, mc.tp) if mc is not None else ()
+
+    async def reshard(
+        self,
+        mesh: Optional[MeshConfig],
+        hold: bool = True,
+        force: bool = False,
+    ) -> dict:
+        """Morph this engine's parallelism degree LIVE: re-lay weights
+        and the paged KV pool onto ``mesh`` without dropping a token.
+
+        Protocol: (1) the new layout's weights are PRE-STAGED off the
+        hold window (PRESERVE-style — the move overlaps continued
+        serving, since params are read-only to dispatch); (2) the
+        scheduler loop quiesces at a step boundary (the pipelined
+        window drains; the device lock serializes against disagg
+        hooks), in-flight and queued requests are *held*, not handed
+        off; (3) KV + penalty planes re-lay through the same compiled
+        cross-mesh permutation programs (parallel/morph.MeshMorpher);
+        (4) one assignment-only commit swaps every piece of device
+        state plus ``self.mesh`` — a crash lands wholly before or
+        wholly after it (the ``mid_reshard`` faultpoint phases walk
+        exactly this matrix); (5) the loop resumes: RNG streams
+        continue token-exactly because sampling keys fold_in(seed,
+        generated) from host-side state the morph never touches, and
+        penalty counts/masks moved bit-identically.
+
+        ``hold=False`` hands off in-flight streams via the PR 4
+        migration path instead of holding them (deadline-pressured
+        requests; queued work is always held — it costs nothing).
+        ``force=True`` re-lays even when the mesh shape is unchanged
+        (absorbing a lost host: same logical shape, new device set).
+        Multi-host mirrors raise :class:`ReshardUnsupported` — their
+        callers drain-with-handoff instead.  Returns the morph stats
+        dict ({"changed", "kv_moved_blocks", "hold_ms", ...})."""
+        if self.mirror is not None:
+            raise ReshardUnsupported(
+                "multi-host mirrored engines cannot morph live; drain "
+                "with handoff and restart on the new mesh instead"
+            )
+        if self._dead is not None:
+            raise RuntimeError(self._dead)
+        if self._closed:
+            raise RuntimeError("engine closed")
+        if self._reshard_busy:
+            raise RuntimeError("a reshard is already in flight")
+        same = self._mesh_shape(mesh) == self._mesh_shape(self.cfg.mesh)
+        if same and not force:
+            return {"changed": False, "kv_moved_blocks": 0, "hold_ms": 0.0}
+        self.start()
+        # claim the morph slot BEFORE the staging await: a second
+        # reshard() racing through the checks above would otherwise
+        # overwrite this one's posted request and park its caller on a
+        # future nothing ever resolves
+        self._reshard_busy = True
+        t0 = time.perf_counter()
+        self._resharding = True  # advertised: router soft-excludes now
+        loop = asyncio.get_running_loop()
+        try:
+            # PRESERVE-style pre-stage: build the new mesh and move the
+            # weights onto its layout while the engine keeps serving —
+            # only the KV re-lay and the commit need the hold window
+            new_mesh, staged = await loop.run_in_executor(
+                None, self._stage_reshard, mesh
+            )
+            # the staging await dropped the loop: an engine closed (or
+            # loop-crashed) meanwhile would never run _reshard_step, so
+            # posting now would hang this caller forever
+            if self._closed or self._dead is not None:
+                raise RuntimeError(self._dead or "engine closed")
+        except BaseException:
+            self._resharding = False
+            self._reshard_busy = False
+            raise
+        fut = loop.create_future()
+        self._reshard_req = {
+            "mesh_cfg": mesh,
+            "new_mesh": new_mesh,
+            "staged": staged,
+            "hold": hold,
+            "fut": fut,
+            "t0": t0,
+        }
+        self._wake.set()
+        return await fut
+
+    def _stage_reshard(self, mesh_cfg: Optional[MeshConfig]):
+        """Executor thread, NO device lock: resolve the logical weight
+        layout against the target mesh and move the params there.
+        Dispatch only ever reads params (the KV caches are the donated
+        arrays), so staging overlaps live decode — the new layout's
+        weight load never sits on the hold window."""
+        from ..parallel.morph import MeshMorpher
+
+        faultpoints.hit_sync("mid_reshard", phase="pre_stage")
+        new_mesh = make_mesh(mesh_cfg) if mesh_cfg is not None else None
+        if self.morpher is None:
+            self.morpher = MeshMorpher()
+        staged = self.morpher.apply_tree(
+            self.params, self.layout.param_shardings(self.params, new_mesh)
+        )
+        jax.block_until_ready(staged)
+        return new_mesh, staged
+
+    async def _reshard_step(self) -> None:
+        """One posted morph, run by the scheduler loop at an iteration
+        boundary (so no dispatch is in flight) — quiesce, commit,
+        resume. A failed morph leaves the engine wholly on the old
+        layout and surfaces the error to the caller without killing the
+        serving loop; a FaultInjected kill propagates (that IS the
+        crash-mid-morph experiment)."""
+        req = self._reshard_req
+        fut = req["fut"]
+        try:
+            if not req["hold"]:
+                # requests that cannot be held through the morph take
+                # the PR 4 migration path NOW: tokens already delivered
+                # stay valid, the frontend splices the continuation on
+                # a worker that isn't morphing (the router is already
+                # soft-excluding this one via the resharding flag)
+                while self._remote_ready:
+                    self._handoff_seq(self._remote_ready.pop())
+                for st in list(self._prefill_states):
+                    self.stats["drain_handoffs"] += 1
+                    self._abort_prefill(
+                        st, FinishReason.ERROR, text=MIGRATION_SIGNAL
+                    )
+                for seq in list(self._active):
+                    if seq is not None and not seq.finished:
+                        self._handoff_seq(seq)
+            # a pipelined decode window still in flight would chain
+            # tokens across the morph's program swap — drain it first
+            await self._drain_inflight()
+            t_hold = time.perf_counter()
+            async with self._device_lock:
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, self._commit_reshard_device, req
+                )
+            out["hold_ms"] = round((time.perf_counter() - t_hold) * 1e3, 3)
+            out["total_ms"] = round((time.perf_counter() - req["t0"]) * 1e3, 3)
+            self.stats["reshard_hold_ms"] = out["hold_ms"]
+            logger.info(
+                "resharded to %s: %d KV blocks re-laid, hold %.1fms "
+                "(total %.1fms)", out["mesh"], out["kv_moved_blocks"],
+                out["hold_ms"], out["total_ms"],
+            )
+            if not fut.done():
+                fut.set_result(out)
+        except asyncio.CancelledError:
+            fut.cancel()
+            raise
+        except FaultInjected as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        except Exception as e:  # noqa: BLE001 — a failed morph must not
+            # kill the serving loop; the engine stays on the old layout
+            logger.exception("reshard failed; engine stays on old layout")
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            self._reshard_req = None
+            self._resharding = False
+            self._reshard_busy = False
+
+    def _commit_reshard_device(self, req: dict) -> dict:
+        """Executor thread, device lock held, loop quiesced: re-lay the
+        paged KV pool (+ penalty planes) onto the target layout, then
+        commit everything in one assignment-only block. The two staging
+        faultpoint phases sit BEFORE the block and the committed phase
+        AFTER it — there is deliberately nothing fallible in between,
+        which is what makes a mid-morph kill leave the engine on
+        exactly one layout."""
+        new_mesh = req["new_mesh"]
+        m = self.morpher
+        faultpoints.hit_sync("mid_reshard", phase="quiesced")
+        cache_sh = self.layout.cache_sharding(new_mesh)
+        new_k = m.apply(self.k_cache, cache_sh)
+        new_v = m.apply(self.v_cache, cache_sh)
+        rep = self.layout.replicated_sharding(new_mesh)
+        new_pc = new_pm = None
+        if self._pen_counts is not None:
+            new_pc = m.apply(self._pen_counts, rep)
+            new_pm = m.apply(self._pen_mask, rep)
+        # the staged state must be REAL (transfers landed) before the
+        # commit claims the engine is on the new layout
+        jax.block_until_ready((new_k, new_v))
+        faultpoints.hit_sync("mid_reshard", phase="kv_staged")
+        # ---- commit: plain host assignments only — no device work, no
+        # faultpoints, no awaits, nothing that can raise halfway ----
+        self.params = req["staged"]
+        self.k_cache, self.v_cache = new_k, new_v
+        if new_pc is not None:
+            self._pen_counts, self._pen_mask = new_pc, new_pm
+        self.mesh = new_mesh
+        self.cfg.mesh = req["mesh_cfg"]
+        self.use_pallas = self._use_pallas_for(new_mesh)
+        moved = self.allocator.resident_count
+        self.stats["resharded_total"] += 1
+        self.stats["reshard_kv_moved_blocks"] += moved
+        # ---- committed ----
+        faultpoints.hit_sync("mid_reshard", phase="committed")
+        return {
+            "changed": True,
+            "kv_moved_blocks": moved,
+            "mesh": "x".join(map(str, self._mesh_shape(req["mesh_cfg"])))
+                    or "unsharded",
+            "morph_programs": m.programs(),
+        }
+
     # ---------------- scheduler loop ----------------
 
     async def _loop(self) -> None:
@@ -820,6 +1088,9 @@ class JaxEngine(AsyncEngine):
             while not self._closed:
                 if self._draining:
                     self._drain_tick()
+                if self._reshard_req is not None:
+                    await self._reshard_step()
+                    continue
                 admitted = await self._admit()
                 if (
                     self._n_active == 0
@@ -888,6 +1159,15 @@ class JaxEngine(AsyncEngine):
         mid-prefill, and still-waiting. ``text`` rides the terminal chunk
         (a worker-lost signature there lets the migration layer pick the
         streams up instead of surfacing errors)."""
+        if self._reshard_req is not None:
+            # a morph awaiting the loop must fail WITH the loop, not
+            # park its caller forever
+            fut = self._reshard_req["fut"]
+            if not fut.done():
+                fut.set_exception(RuntimeError(text or "engine stopped"))
+            self._reshard_req = None
+            self._resharding = False
+            self._reshard_busy = False
         in_prefill = [st.seq for st in self._prefill_states]
         for seq in self._active + self._remote_ready + in_prefill:
             if seq is not None:

@@ -69,6 +69,17 @@ class WorkerLoad:
     draining: int = 0
     drains_total: int = 0
     migration_resumes: int = 0
+    # elastic-reshard surface: ``resharding`` marks a live morph window
+    # — the worker HOLDS work through it (requests queue, nothing
+    # bounces), so unlike ``draining`` it is a SOFT exclusion: prefer
+    # any non-morphing worker, fall back rather than refuse
+    resharding: int = 0
+    resharded_total: int = 0
+    reshard_hold_ms: float = 0.0
+    reshard_kv_moved_blocks: int = 0
+    #: the worker's deployed tensor-parallel degree (0 = not
+    #: advertised); seeds the planner's morph guard from reality
+    mesh_tp: int = 0
     # disagg KV-handoff surface (DisaggEngine.stats): streamed (layer-
     # wise, transfer hidden behind prefill) vs bulk deliveries, plus the
     # segment volume landed through the incremental scatter path
@@ -145,6 +156,11 @@ class WorkerLoad:
             draining=d.get("draining", 0),
             drains_total=d.get("drains_total", 0),
             migration_resumes=d.get("migration_resumes", 0),
+            resharding=d.get("resharding", 0),
+            resharded_total=d.get("resharded_total", 0),
+            reshard_hold_ms=d.get("reshard_hold_ms", 0.0),
+            reshard_kv_moved_blocks=d.get("reshard_kv_moved_blocks", 0),
+            mesh_tp=d.get("mesh_tp", 0),
             kv_stream_deliveries=d.get("streamed_deliveries", 0),
             kv_bulk_deliveries=d.get("bulk_deliveries", 0),
             kv_stream_segments=d.get("kv_stream_segments", 0),
@@ -292,6 +308,12 @@ class KvScheduler:
         candidates = [l for l in loads if not l.saturated and not l.draining]
         if not candidates:
             raise AllWorkersBusy("all workers saturated or draining")
+        # a worker mid-morph (elastic reshard) HOLDS new work through
+        # the quiesce window instead of bouncing it, so exclusion is
+        # soft: route around it while it morphs, but a one-worker pool
+        # still serves (its requests just wait out the hold)
+        not_morphing = [l for l in candidates if not l.resharding]
+        candidates = not_morphing or candidates
         # ``avoid`` carries the workers a migrating request already failed
         # on. A freshly-killed worker stays in discovery (and in the
         # metrics view) until its lease TTL lapses, and prefix affinity

@@ -537,6 +537,15 @@ async def run_endpoint(args) -> None:
             peer_server = await KvPeerServer(  # noqa: F841
                 drt, component, drt.primary_lease_id, jax_core
             ).start()
+        # elastic live resharding: actuate planner MorphDecisions from
+        # the ``reshard`` subject (quiesce/morph/resume — multi-host
+        # mirrors fall back to drain-with-handoff inside the listener)
+        from ..resilience import ReshardListener
+
+        reshard_listener = await ReshardListener(  # noqa: F841
+            drt, component, drt.primary_lease_id, jax_core,
+            drain_deadline_s=args.drain_deadline,
+        ).start()
     handle = await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
@@ -804,6 +813,16 @@ async def run_planner(args) -> None:
         driver = StoreScaleDriver(
             DeploymentStore(args.deploy_root), args.deployment
         )
+    morph = None
+    if args.planner_morph:
+        from ..planner import MorphConfig
+
+        # elastic live resharding: publish guarded MorphDecisions on
+        # the ``reshard`` subject (workers' ReshardListeners actuate)
+        morph = MorphConfig(
+            tp_min=1, tp_max=args.morph_tp_max,
+            grow_prompt_tokens=args.morph_grow_prompt_tokens,
+        )
     cfg = PlannerConfig(
         tick_s=args.planner_tick,
         slo=SloTargets(
@@ -817,6 +836,7 @@ async def run_planner(args) -> None:
             min_replicas=0, max_replicas=args.planner_max_replicas
         ),
         prefill_pool=args.planner_pools == "disagg",
+        morph=morph,
     )
     planner = Planner(
         telemetry, capacity, cfg,
@@ -959,14 +979,16 @@ def main(argv=None) -> None:
                    default=True,
                    help="ICI same-slice KV fast path (default on): "
                         "decode roles advertise their slice "
-                        "fingerprint and same-slice prefill peers hand "
-                        "segments device-to-device (disagg/ici.py). "
-                        "Engages only on the in-process LocalKvPipe "
-                        "channel today (embedded prefill+decode engine "
-                        "pairs); the launched cross-process roles keep "
-                        "advertising for forward-compat but hand off "
-                        "over TCP until engines go mesh-agnostic "
-                        "(ROADMAP item 4)")
+                        "fingerprint and same-slice prefill peers "
+                        "negotiate it per handoff (disagg/ici.py). "
+                        "Engages on ANY channel once fingerprints "
+                        "match: in-process LocalKvPipe pairs hand "
+                        "segments device-to-device, and launched "
+                        "same-slice roles land their wire segments "
+                        "through the same compiled per-bucket mover "
+                        "programs onto the decode layout (cross-slice "
+                        "or mismatched peers keep the plain streamed "
+                        "path)")
     p.add_argument("--no-kv-ici", dest="kv_ici", action="store_false",
                    help="disable the ICI fast path (all handoffs take "
                         "the TCP/streamed plane)")
@@ -1021,6 +1043,20 @@ def main(argv=None) -> None:
                    choices=["aggregated", "disagg"],
                    help="disagg: size a separate prefill pool; "
                         "aggregated: TTFT breaches grow the decode pool")
+    p.add_argument("--planner-morph", action="store_true",
+                   help="elastic live resharding: publish guarded "
+                        "MorphDecisions on the 'reshard' subject — grow "
+                        "a pool's TP when long prompts dominate, shrink "
+                        "on sustained idle, re-lay survivors after a "
+                        "lost host (workers morph in place, zero "
+                        "dropped tokens; docs/elastic_resharding.md)")
+    p.add_argument("--morph-tp-max", type=int, default=4,
+                   help="max tensor-parallel degree the morph policy "
+                        "may grow a worker to")
+    p.add_argument("--morph-grow-prompt-tokens", type=float, default=512.0,
+                   help="windowed mean prompt length at/above which the "
+                        "morph policy doubles TP (long-prompt-dominated "
+                        "signal)")
     p.add_argument("--deploy-root", default=None,
                    help="planner actuator: deploy controller store root "
                         "(with --deployment; omit for publish-only)")

@@ -200,6 +200,13 @@ class MetricsComponent:
             gauge("draining", w.draining, lb)
             gauge("drains_total", w.drains_total, lb)
             gauge("migration_resumes_total", w.migration_resumes, lb)
+            # elastic live resharding: morph window flag + volume
+            gauge("resharding", w.resharding, lb)
+            gauge("resharded_total", w.resharded_total, lb)
+            gauge("reshard_hold_ms", round(w.reshard_hold_ms, 3), lb)
+            gauge(
+                "reshard_kv_moved_blocks", w.reshard_kv_moved_blocks, lb
+            )
             # disagg KV handoff: streamed (transfer hidden behind
             # prefill compute) vs legacy bulk deliveries, and how many
             # segments landed through the incremental scatter

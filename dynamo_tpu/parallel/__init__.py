@@ -3,9 +3,28 @@
 TPU-native replacement for the reference's engine-delegated parallelism
 (Ray/MPI/torch.distributed bootstraps, SURVEY.md section 2.8): a
 jax.sharding.Mesh with named axes + NamedSharding placement rules; XLA SPMD
-inserts all collectives.
+inserts all collectives. ``LogicalLayout`` carries the placement rules
+mesh-free (resolved at dispatch) and ``MeshMorpher`` compiles the
+cross-mesh permutations that move live state between layouts
+(docs/elastic_resharding.md).
 """
 
-from .mesh import MeshConfig, cache_sharding, make_mesh, param_sharding, shard_params
+from .mesh import (
+    LogicalLayout,
+    MeshConfig,
+    cache_sharding,
+    make_mesh,
+    param_sharding,
+    shard_params,
+)
+from .morph import MeshMorpher
 
-__all__ = ["MeshConfig", "cache_sharding", "make_mesh", "param_sharding", "shard_params"]
+__all__ = [
+    "LogicalLayout",
+    "MeshConfig",
+    "MeshMorpher",
+    "cache_sharding",
+    "make_mesh",
+    "param_sharding",
+    "shard_params",
+]

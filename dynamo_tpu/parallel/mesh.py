@@ -280,6 +280,65 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+class LogicalLayout:
+    """ONE logical sharding contract for an engine's weights and paged
+    KV pool, carried mesh-free: the placement RULES (the spec tables
+    above + ``cache_sharding``'s divisibility logic) are the layout;
+    concrete ``NamedSharding``s are resolved at placement/dispatch time
+    against whatever mesh currently backs the engine. This is what
+    makes ``JaxEngine.reshard`` a first-class operation — the engine
+    never captures a concrete ``Mesh``/``NamedSharding`` in long-lived
+    state that a morph would silently invalidate (the dynlint
+    ``mesh-capture`` rule guards the same invariant statically).
+
+    ``mesh=None`` everywhere means "unsharded single-device engine":
+    resolution returns ``None`` and movers place on the default device.
+    """
+
+    def __init__(self, model_cfg: ModelConfig):
+        self.model = model_cfg
+
+    # ---- weights ----
+
+    def param_specs(self, params: dict, mesh: Optional[Mesh] = None) -> dict:
+        """Logical PartitionSpec pytree for ``params`` (fitted to leaf
+        shapes when a mesh is given — see ``spec_tree``)."""
+        return spec_tree(params, mesh=mesh)
+
+    def param_shardings(self, params: dict, mesh: Optional[Mesh]):
+        """Resolve the logical weight layout against ``mesh``: a pytree
+        of NamedShardings matching ``params``' structure, or a pytree of
+        ``None`` leaves for the unsharded engine."""
+        specs = self.param_specs(params, mesh=mesh)
+
+        def wrap(node):
+            if isinstance(node, dict):
+                return {k: wrap(v) for k, v in node.items()}
+            return NamedSharding(mesh, node) if mesh is not None else None
+
+        return wrap(specs)
+
+    def place_params(self, params: dict, mesh: Optional[Mesh]) -> dict:
+        """Initial placement (load/init time): resolve + device_put."""
+        if mesh is None:
+            return params
+        return shard_params(params, mesh)
+
+    # ---- paged KV ----
+
+    def cache_sharding(self, mesh: Optional[Mesh]):
+        """Resolve the paged-KV layout rule against ``mesh`` (None for
+        the unsharded engine)."""
+        if mesh is None:
+            return None
+        return cache_sharding(mesh, self.model)
+
+    # ---- small replicated device state (penalty planes etc.) ----
+
+    def replicated_sharding(self, mesh: Optional[Mesh]):
+        return replicated(mesh) if mesh is not None else None
+
+
 #: memoized default-devices fingerprint, keyed by pid so a (rare)
 #: fork doesn't inherit the parent's identity — the value is constant
 #: for a process's backend, and the callers sit on per-stream paths

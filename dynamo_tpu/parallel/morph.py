@@ -1,0 +1,199 @@
+"""Compiled cross-mesh permutation programs — the one mover for every
+"this array lives on layout A, I need it on layout B" moment.
+
+Grown out of PR 11's ``IciSegmentMover``, which kept a private memo of
+jitted ``out_shardings`` reshards for the disagg handoff's segment
+geometry buckets. Elastic resharding (docs/elastic_resharding.md) needs
+the same machinery for whole weight pytrees and the paged KV pool, so
+the program construction and the memo live here now and every consumer
+(the ICI segment mover, ``JaxEngine.reshard``) shares one rule set:
+
+* **permute** — source and destination describe the same single-axis
+  split onto the same devices in the same order (including the
+  degenerate replicated / single-device case): an explicit ``shard_map``
+  identity over those devices. The collective is the identity
+  permutation and the shard_map body structurally forbids a host hop —
+  this is the no-op-priced common case, kept separate so tests can
+  assert the cheap path was taken.
+* **reshard** — anything richer (a TP regroup, a PP re-stage, shards in
+  a different device order, a grown/shrunk device set): a jitted
+  identity with ``out_shardings``, the one re-layout API XLA lowers to
+  the slice's own collective_permute / all-gather over ICI. On
+  toolchains where the jitted cross-device-set form is rejected, the
+  program degrades to ``jax.device_put`` (same bytes-level result, XLA
+  still picks direct device→device paths where they exist) and the
+  degraded program is memoized so the failed jit is never retried.
+* **place** — destination ``None`` (an unsharded engine): a plain
+  ``device_put`` onto the process default device.
+
+Programs are memoized by (shape, dtype, src sharding, dst sharding).
+Callers that stream varying geometries (the segment mover) bucket their
+shapes BEFORE calling, so the memo stays bounded by geometry buckets —
+the ``test_compiled_perf`` contract.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def one_axis_split(sharding, shape) -> Optional[tuple[int, list]]:
+    """Describe ``sharding`` over ``shape`` as an even split of at most
+    ONE array axis across its devices: returns (axis, devices in shard
+    order) — axis -1 when every device holds the whole array
+    (replicated / single device). None for anything richer (multi-axis
+    splits take the reshard program instead)."""
+    if sharding is None:
+        return None
+    try:
+        idx_map = sharding.devices_indices_map(tuple(shape))
+    except Exception:  # noqa: BLE001 — exotic sharding
+        return None
+    split_axis = None
+    keyed = []
+    for d, idx in idx_map.items():
+        axes = [
+            a for a, s in enumerate(idx)
+            if not (s.start in (0, None) and s.stop in (None, shape[a]))
+        ]
+        if len(axes) > 1:
+            return None
+        if axes:
+            a = axes[0]
+            if split_axis is None:
+                split_axis = a
+            elif split_axis != a:
+                return None
+            keyed.append((idx[a].start or 0, d))
+        else:
+            keyed.append((0, d))
+    if split_axis is None:
+        return -1, sorted((d for _s, d in keyed), key=lambda d: d.id)
+    keyed.sort(key=lambda t: t[0])
+    starts = [s for s, _d in keyed]
+    if len(set(starts)) != len(starts):
+        return None  # partial replication inside the split
+    return split_axis, [d for _s, d in keyed]
+
+
+class MeshMorpher:
+    """Memoized cross-mesh movers (module doc). One instance per
+    consumer scope — the decode sink's segment mover owns one, the
+    engine's reshard owns one — but all instances build programs by the
+    same rules, so the permute/reshard split can't drift between the
+    KV-handoff and live-reshard planes."""
+
+    def __init__(self):
+        self._fns: dict = {}
+        self.permute_programs = 0
+        self.reshard_programs = 0
+        #: programs that degraded to device_put (jit rejected the
+        #: src→dst pair on this toolchain) + every dst=None placement
+        self.place_moves = 0
+        self.moved_arrays = 0
+        self.moved_bytes = 0
+
+    def programs(self) -> int:
+        return len(self._fns)
+
+    # ---- program construction ----
+
+    def _build(self, src_sharding, dst_sharding, shape, dtype):
+        """One compiled mover program for this (geometry, src, dst)."""
+        from ..ops._pallas_compat import shard_map as _smap
+
+        src = one_axis_split(src_sharding, shape)
+        dst = one_axis_split(dst_sharding, shape)
+        matched = (
+            src is not None and dst is not None and src[0] == dst[0]
+            and src[1] == dst[1]
+        )
+        if not matched:
+            self.reshard_programs += 1
+            return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry in self._fns (apply)
+                lambda a: a, out_shardings=dst_sharding
+            )
+        axis, devs = dst
+        mesh = Mesh(devs, ("morph",))
+        spec = P() if axis < 0 else P(*([None] * axis), "morph")
+
+        def body(a):
+            # identity permutation: shards are already on the devices
+            # the destination wants them on — the shard_map is the
+            # structural no-host-hop guarantee, not a data move
+            return a
+
+        fn = _smap(body, mesh=mesh, in_specs=spec, out_specs=spec)
+        self.permute_programs += 1
+        return jax.jit(  # dynlint: disable=jit-in-function -- memoized per geometry in self._fns (apply)
+            fn, out_shardings=dst_sharding
+        )
+
+    # ---- the mover API ----
+
+    def apply(self, x, dst_sharding):
+        """Move one array onto ``dst_sharding`` through the memoized
+        program for its (shape, dtype, src, dst). ``None`` destination =
+        unsharded placement on the default device. Callers with
+        streaming geometries bucket/pad BEFORE calling."""
+        self.moved_arrays += 1
+        self.moved_bytes += int(getattr(x, "nbytes", 0))
+        if dst_sharding is None:
+            self.place_moves += 1
+            return jax.device_put(x, jax.devices()[0])
+        src = getattr(x, "sharding", None)
+        key = (
+            tuple(x.shape), str(x.dtype),
+            repr(src) if src is not None else None,
+            repr(dst_sharding),
+        )
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._fns[key] = self._build(
+                src, dst_sharding, x.shape, x.dtype
+            )
+        try:
+            return fn(x)
+        except (TypeError, ValueError, NotImplementedError):
+            # trace/lowering rejection: this toolchain refuses the
+            # jitted src→dst pair (e.g. a cross-device-set
+            # out_shardings on older jax). Degrade THIS program to
+            # device_put PERMANENTLY so the failed trace is never
+            # retried per call. Execution errors (XlaRuntimeError, a
+            # transient RESOURCE_EXHAUSTED mid-collective) deliberately
+            # propagate instead — a one-off runtime failure must not
+            # pin this geometry onto the slow host-mediated path for
+            # the process lifetime
+            logger.debug(
+                "mover jit rejected %s -> %s; degrading to device_put",
+                src, dst_sharding, exc_info=True,
+            )
+            self.place_moves += 1
+            put = lambda a: jax.device_put(a, dst_sharding)  # noqa: E731
+            self._fns[key] = put
+            return put(x)
+
+    def apply_tree(self, tree, shardings):
+        """Move a params-shaped pytree onto a matching pytree of
+        shardings (dict-of-dict leaves, the spec_tree structure)."""
+        if isinstance(tree, dict):
+            return {
+                k: self.apply_tree(v, shardings[k]) for k, v in tree.items()
+            }
+        return self.apply(tree, shardings)
+
+    def counters(self) -> dict:
+        return {
+            "morph_programs": self.programs(),
+            "morph_permute_programs": self.permute_programs,
+            "morph_reshard_programs": self.reshard_programs,
+            "morph_place_moves": self.place_moves,
+            "morph_moved_arrays": self.moved_arrays,
+            "morph_moved_bytes": self.moved_bytes,
+        }

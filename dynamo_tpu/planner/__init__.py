@@ -18,7 +18,7 @@ from .admission import (
 )
 from .actuators import BusPublisher, CallbackScaleDriver, StoreScaleDriver
 from .guard import GuardConfig, ScaleAction, ScaleGuard
-from .planner import Planner, PlannerConfig
+from .planner import MorphConfig, Planner, PlannerConfig
 from .predictor import (
     CapacityModel,
     HoltForecaster,
@@ -28,8 +28,10 @@ from .predictor import (
 )
 from .protocols import (
     PLANNER_DECISION_SUBJECT,
+    PLANNER_RESHARD_SUBJECT,
     PLANNER_WATERMARK_SUBJECT,
     CapacityWatermark,
+    MorphDecision,
     PlannerDecision,
 )
 from .telemetry import ClusterSnapshot, TelemetryAggregator
@@ -44,8 +46,11 @@ __all__ = [
     "ClusterSnapshot",
     "DEFAULT_CLASSES",
     "GuardConfig",
+    "MorphConfig",
+    "MorphDecision",
     "HoltForecaster",
     "PLANNER_DECISION_SUBJECT",
+    "PLANNER_RESHARD_SUBJECT",
     "PLANNER_WATERMARK_SUBJECT",
     "Planner",
     "PlannerConfig",

@@ -27,8 +27,10 @@ from dynamo_tpu.http.base import HttpError
 
 from .protocols import (
     PLANNER_DECISION_SUBJECT,
+    PLANNER_RESHARD_SUBJECT,
     PLANNER_WATERMARK_SUBJECT,
     CapacityWatermark,
+    MorphDecision,
     PlannerDecision,
 )
 
@@ -119,7 +121,11 @@ class BusPublisher:
         self._watermark_subject = component.event_subject(
             PLANNER_WATERMARK_SUBJECT
         )
+        self._reshard_subject = component.event_subject(
+            PLANNER_RESHARD_SUBJECT
+        )
         self.published = 0
+        self.morphs_published = 0
 
     def publish(self, decision: PlannerDecision,
                 watermark: CapacityWatermark) -> None:
@@ -132,3 +138,14 @@ class BusPublisher:
                 self.published += 1
             except Exception:  # noqa: BLE001
                 logger.debug("planner publish failed", exc_info=True)
+
+    def publish_morph(self, morph: MorphDecision) -> None:
+        """One MorphDecision on the ``reshard`` control subject — the
+        workers' ReshardListeners actuate it (resilience/reshard.py).
+        Best-effort like the rest: the guard's state survives a lost
+        event and an unchanged desire republishes on the next trigger."""
+        try:
+            self.drt.bus.publish(self._reshard_subject, morph.to_bytes())
+            self.morphs_published += 1
+        except Exception:  # noqa: BLE001
+            logger.debug("morph publish failed", exc_info=True)

@@ -39,10 +39,54 @@ from typing import Callable, Optional
 
 from .guard import GuardConfig, ScaleGuard
 from .predictor import CapacityModel, HoltForecaster, SloEvaluator, SloTargets
-from .protocols import CapacityWatermark, PlannerDecision
+from .protocols import CapacityWatermark, MorphDecision, PlannerDecision
 from .telemetry import ClusterSnapshot, TelemetryAggregator
 
 logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MorphConfig:
+    """Policy knobs for the planner's third verb: MORPH a pool's
+    parallelism degree live (docs/elastic_resharding.md) instead of
+    adding/removing whole replicas.
+
+      * long-prompt-dominated (windowed mean prompt length at or above
+        ``grow_prompt_tokens`` with real traffic behind it) → double TP
+        toward ``tp_max`` — prefill is compute-bound, more chips per
+        worker cut TTFT where more workers would not;
+      * sustained idle (slot utilization below ``shrink_utilization``)
+        → shrink back to ``tp_min`` — night-time chips return to the
+        pool without dropping the streams still trickling;
+      * a lost host (non-draining workers vanishing from telemetry) →
+        an immediate ``relayout_lost_host`` morph at the CURRENT degree
+        with ``force=True``, so survivors re-resolve their layout over
+        the devices that remain.
+
+    Desired degrees pass a :class:`ScaleGuard` (the same rails as
+    replica counts — up paced by cooldown, down only after sustained
+    quiet) so a bursty mix can't flap a pool between layouts."""
+
+    tp_min: int = 1
+    tp_max: int = 4
+    grow_prompt_tokens: float = 512.0
+    shrink_utilization: float = 0.1
+    #: hold in-flight streams through morphs (False = hand off via the
+    #: migration path first; for deadline-pressured pools)
+    hold: bool = True
+    guard: GuardConfig = field(
+        default_factory=lambda: GuardConfig(
+            min_replicas=1, max_replicas=4, up_cooldown_s=30.0,
+            down_cooldown_s=120.0, down_stable_s=60.0,
+        )
+    )
+
+    def validate(self) -> None:
+        if self.tp_min < 1 or self.tp_max < self.tp_min:
+            raise ValueError(
+                f"morph degrees invalid: tp_min={self.tp_min} "
+                f"tp_max={self.tp_max}"
+            )
 
 
 @dataclass
@@ -68,6 +112,9 @@ class PlannerConfig:
     #: the fleet is at least this utilized — an idle fleet's low tok/s
     #: measures demand, not capacity
     correction_min_utilization: float = 0.8
+    #: elastic live resharding policy (MorphDecision on the ``reshard``
+    #: subject); None = the planner never morphs (replica scaling only)
+    morph: Optional[MorphConfig] = None
 
 
 class Planner:
@@ -88,6 +135,20 @@ class Planner:
         self._clock = clock
         self.decode_guard = ScaleGuard(self.cfg.decode_guard, clock)
         self.prefill_guard = ScaleGuard(self.cfg.prefill_guard, clock)
+        # morph rails: the SAME guard implementation paces TP degree
+        # changes that paces replica counts — min/max clamp to the
+        # configured degree range, scale-down hysteresis = shrink
+        # hysteresis, so morphs can't flap on a bursty prompt mix
+        self.morph_guard: Optional[ScaleGuard] = None
+        if self.cfg.morph is not None:
+            self.cfg.morph.validate()
+            # the guard's clamp IS the degree range
+            self.cfg.morph.guard.min_replicas = self.cfg.morph.tp_min
+            self.cfg.morph.guard.max_replicas = self.cfg.morph.tp_max
+            self.morph_guard = ScaleGuard(self.cfg.morph.guard, clock,
+                                          initial=self.cfg.morph.tp_min)
+        self.last_morph: Optional[MorphDecision] = None
+        self._relayout_seen: set[int] = set()
         self.slo = SloEvaluator(self.cfg.slo, clock)
         self.req_forecast = HoltForecaster()
         self.prompt_forecast = HoltForecaster()
@@ -206,10 +267,80 @@ class Planner:
                 self.publisher.publish(decision, watermark)
             except Exception:  # noqa: BLE001
                 logger.exception("planner publish failed")
+        # the third verb: morph the pool's parallelism degree (guarded)
+        morph = self._evaluate_morph(snap)
+        if morph is not None:
+            self.stats["morphs"] = self.stats.get("morphs", 0) + 1
+            self.last_morph = morph
+            if self.publisher is not None:
+                publish_morph = getattr(self.publisher, "publish_morph",
+                                        None)
+                if publish_morph is not None:
+                    try:
+                        publish_morph(morph)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("morph publish failed")
         self._fold_action_stats()
         self.last_decision = decision
         self.last_watermark = watermark
         return decision
+
+    def _evaluate_morph(self, snap: ClusterSnapshot) -> Optional[MorphDecision]:
+        """One guarded morph evaluation per tick (None = no change).
+        Lost-host relayouts pre-empt degree policy: survivors must be
+        re-laid at the CURRENT degree before growth/shrink reasoning
+        about them means anything."""
+        mc, guard = self.cfg.morph, self.morph_guard
+        if mc is None or guard is None:
+            return None
+        observed = snap.pool_tp
+        if (
+            self.last_morph is None
+            and observed > 0
+            and guard.current != observed
+        ):
+            # seed the rails from the pool's ACTUALLY-deployed degree
+            # (workers advertise mesh_tp): a planner starting against a
+            # TP=4 fleet must not reason from tp_min — its first
+            # lost-host relayout would otherwise "restore" every
+            # survivor to a degree the pool never ran, and a grow from
+            # the fictional floor would actually SHRINK. Only before
+            # the first morph: after that, actuation lag (workers
+            # mid-morph still advertising the old degree) must not
+            # re-seed the guard backwards and flap
+            guard.current = min(max(observed, mc.tp_min), mc.tp_max)
+        cur = guard.current if guard.current is not None else mc.tp_min
+        new_lost = [w for w in snap.lost_workers
+                    if w not in self._relayout_seen]
+        if new_lost:
+            self._relayout_seen.update(new_lost)
+            return MorphDecision(
+                ts=self._clock(), worker_id=0, tp=cur,
+                reason="relayout_lost_host", hold=mc.hold, force=True,
+                lost_workers=new_lost,
+            )
+        # degree policy: long-prompt-dominated grows (TP halves the
+        # per-worker prefill wall where another replica would not);
+        # sustained idle shrinks back to the floor
+        desired = cur
+        if (
+            snap.request_rate > 0
+            and snap.mean_prompt_tokens >= mc.grow_prompt_tokens
+        ):
+            desired = cur * 2
+        elif (
+            snap.slot_utilization < mc.shrink_utilization
+            and snap.mean_prompt_tokens < mc.grow_prompt_tokens
+        ):
+            desired = mc.tp_min
+        applied = guard.apply(desired)
+        if applied == cur:
+            return None
+        return MorphDecision(
+            ts=self._clock(), worker_id=0, tp=applied,
+            reason="grow_tp" if applied > cur else "shrink_tp",
+            hold=mc.hold,
+        )
 
     def _watermark(self, snap: ClusterSnapshot,
                    decision: PlannerDecision) -> CapacityWatermark:
@@ -254,6 +385,11 @@ class Planner:
         if w is not None:
             out["planner_saturated_workers"] = len(w.saturated_workers)
             out["planner_admission_rate_req_s"] = w.admission_rate_req_s
+        m = self.last_morph
+        if m is not None:
+            out["planner_morphs_total"] = self.stats.get("morphs", 0)
+            out["planner_morph_tp"] = m.tp
+            out["planner_morph_reason"] = m.reason
         return out
 
     # ---------------- async loop ----------------

@@ -1,7 +1,7 @@
-"""Planner wire schema: scale decisions and capacity watermarks.
+"""Planner wire schema: scale decisions, capacity watermarks, morphs.
 
-Two subjects, published on the target component (same bus idiom as the
-kv_router's ``kv-hit-rate``/``kv-prefetch`` events):
+Three subjects, published on the target component (same bus idiom as
+the kv_router's ``kv-hit-rate``/``kv-prefetch`` events):
 
   * ``planner-decisions`` — one :class:`PlannerDecision` per control
     tick: the replica counts the planner wants per pool, the SLO view
@@ -12,15 +12,26 @@ kv_router's ``kv-hit-rate``/``kv-prefetch`` events):
     the planner considers saturated (the KV scheduler soft-excludes
     them from routing) and the admission rate the frontend's overload
     gate should hold (0 = leave the gate's configured rate alone).
+  * ``reshard`` — :class:`MorphDecision`: the planner's third verb
+    beside scale-up/down. Instead of adding/removing whole replicas it
+    asks a pool (or one worker) to MORPH its parallelism degree live
+    (docs/elastic_resharding.md): grow TP when long prompts dominate,
+    shrink when sustained idle, re-lay survivors after a lost host.
+    Workers actuate through a :class:`~dynamo_tpu.resilience.reshard.
+    ReshardListener` → ``JaxEngine.reshard``; decisions pass the same
+    :class:`~dynamo_tpu.planner.guard.ScaleGuard` rails as replica
+    counts, so morphs can't flap.
 """
 
 from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
+from typing import Optional
 
 PLANNER_DECISION_SUBJECT = "planner-decisions"
 PLANNER_WATERMARK_SUBJECT = "planner-watermarks"
+PLANNER_RESHARD_SUBJECT = "reshard"
 
 
 @dataclass
@@ -49,6 +60,45 @@ class PlannerDecision:
         d = json.loads(raw)
         return PlannerDecision(**{
             k: d[k] for k in PlannerDecision().__dict__ if k in d
+        })
+
+
+@dataclass
+class MorphDecision:
+    """One live-reshard request on the ``reshard`` subject.
+
+    ``worker_id=0`` addresses every worker in the pool (a pool-wide
+    degree change, or a survivor re-layout after a lost host); a
+    non-zero id targets one worker. ``tp`` is the requested tensor-
+    parallel degree (the only axis today's policy morphs; dp/pp/sp/ep
+    ride the same machinery through ``JaxEngine.reshard`` when a future
+    policy wants them). ``hold`` asks workers to hold in-flight streams
+    through the morph; False = hand them off via the migration path
+    first (deadline-pressured pools). ``force`` re-lays even at an
+    unchanged shape — the lost-host case, where the logical degree
+    stays put but the surviving device set must re-resolve."""
+
+    ts: float = 0.0
+    worker_id: int = 0
+    pool: str = "decode"
+    tp: int = 1
+    #: why: "grow_tp" (long-prompt-dominated), "shrink_tp" (sustained
+    #: idle), "relayout_lost_host", ...
+    reason: str = "steady"
+    hold: bool = True
+    force: bool = False
+    #: worker ids that vanished from telemetry (lost-host evidence,
+    #: observability only — workers don't need it to actuate)
+    lost_workers: list = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> Optional["MorphDecision"]:
+        d = json.loads(raw)
+        return MorphDecision(**{
+            k: d[k] for k in MorphDecision().__dict__ if k in d
         })
 
 

@@ -43,6 +43,30 @@ class ClusterSnapshot:
     total_slots: int = 0
     ttft_p99_ms: Optional[float] = None  # None = no samples in window
     itl_p99_ms: Optional[float] = None
+    #: workers that vanished from the scrape within the window WITHOUT
+    #: having advertised ``draining`` first — the lost-host signal the
+    #: morph policy re-lays survivors on (a graceful scale-down drains
+    #: before deregistering, so it never lands here)
+    lost_workers: list[int] = field(default_factory=list)
+
+    @property
+    def pool_tp(self) -> int:
+        """The pool's ACTUALLY-deployed tensor-parallel degree: the
+        majority of live workers' advertised ``mesh_tp`` (0 = none
+        advertise one — older workers, or an empty scrape). Seeds the
+        morph guard so a restarted planner reasons from reality, not
+        from ``tp_min``."""
+        tps = [w.mesh_tp for w in self.workers if w.mesh_tp > 0]
+        return max(set(tps), key=tps.count) if tps else 0
+
+    @property
+    def mean_prompt_tokens(self) -> float:
+        """Observed prompt tokens per request over the window — the
+        long-prompt-dominated signal the morph policy grows TP on."""
+        return (
+            self.prompt_token_rate / self.request_rate
+            if self.request_rate > 0 else 0.0
+        )
 
     @property
     def decode_replicas(self) -> int:
@@ -94,6 +118,15 @@ class TelemetryAggregator:
         # cumulative-counter baselines per worker: (requests_total,
         # tokens_generated, prompt_tokens_total)
         self._counter_base: dict[int, tuple[int, int, int]] = {}
+        # (ts, worker_id) of non-draining workers that vanished from a
+        # scrape — windowed lost-host evidence for the morph policy
+        self._lost: deque[tuple[float, int]] = deque()
+        self._was_draining: dict[int, bool] = {}
+        #: consecutive missed scrapes per still-unconfirmed worker: ONE
+        #: miss is a slow metrics endpoint or a long compile, not a lost
+        #: host — a force-relayout of the whole pool must not fire on it
+        self._miss_counts: dict[int, int] = {}
+        self.lost_confirm_scrapes = 2
 
     # ---------------- feeding ----------------
 
@@ -123,6 +156,7 @@ class TelemetryAggregator:
             cur = (w.requests_total, w.tokens_generated, w.prompt_tokens_total)
             base = self._counter_base.get(w.worker_id)
             self._counter_base[w.worker_id] = cur
+            self._was_draining[w.worker_id] = bool(w.draining)
             if base is None:
                 continue  # first sight: baseline only
             d_req = max(cur[0] - base[0], 0)
@@ -135,12 +169,30 @@ class TelemetryAggregator:
         for wid in list(self._counter_base):
             if wid not in seen:
                 del self._counter_base[wid]
+        for wid in seen:
+            self._miss_counts.pop(wid, None)
+        for wid in list(self._was_draining):
+            if wid not in seen:
+                # vanished between scrapes: a drained departure is a
+                # planned scale-down; anything else is lost-host
+                # evidence — but only after ``lost_confirm_scrapes``
+                # CONSECUTIVE misses (a reappearance above resets the
+                # count), so one slow scrape can't trigger a pool-wide
+                # force relayout
+                misses = self._miss_counts.get(wid, 0) + 1
+                if misses < self.lost_confirm_scrapes:
+                    self._miss_counts[wid] = misses
+                    continue
+                self._miss_counts.pop(wid, None)
+                if not self._was_draining.pop(wid):
+                    self._lost.append((now, wid))
 
     # ---------------- folding ----------------
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window_s
-        for q in (self._arrivals, self._generated, self._ttft, self._itl):
+        for q in (self._arrivals, self._generated, self._ttft, self._itl,
+                  self._lost):
             while q and q[0][0] < cutoff:
                 q.popleft()
 
@@ -168,6 +220,7 @@ class TelemetryAggregator:
             total_slots=sum(w.total_slots for w in loads),
             ttft_p99_ms=self._p99(self._ttft),
             itl_p99_ms=self._p99(self._itl),
+            lost_workers=sorted({wid for _t, wid in self._lost}),
         )
         if snap.ttft_p99_ms is None and self.trace_collector is not None:
             snap.ttft_p99_ms = (

@@ -15,13 +15,18 @@ The subsystem that makes worker death invisible to clients
     lease last;
   * :mod:`.faultpoints` — named, deterministic kill/delay points at
     every lifecycle stage, armed programmatically or via
-    ``DYN_FAULTPOINTS`` (the tests' and soak's worker-killing lever).
+    ``DYN_FAULTPOINTS`` (the tests' and soak's worker-killing lever);
+  * :mod:`.reshard` — :class:`ReshardListener`, the worker-side
+    actuation of planner morph decisions (elastic live resharding,
+    docs/elastic_resharding.md) with the drain-with-handoff fallback
+    for engines that can't morph live.
 """
 
 from . import faultpoints
 from .drain import DrainCoordinator
 from .faultpoints import FaultInjected
 from .migration import MigratingEngine, ROUTED_WORKER_KEY
+from .reshard import ReshardListener
 from .policy import (
     MIGRATION_SIGNAL,
     WORKER_LOST_SIGNATURES,
@@ -38,6 +43,7 @@ __all__ = [
     "MigratingEngine",
     "MigrationPolicy",
     "ROUTED_WORKER_KEY",
+    "ReshardListener",
     "WORKER_LOST_SIGNATURES",
     "classify_failure",
     "faultpoints",

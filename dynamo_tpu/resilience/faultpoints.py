@@ -55,6 +55,14 @@ POINTS = (
     # here is the pre-stage plumbing dying — the hint's KV restore must
     # proceed untouched (the pre-stage is advisory, guarded separately)
     "pre_stage_weights",
+    # elastic live resharding (engine.reshard): hit once per morph PHASE
+    # — pre_stage (weight staging off the hold window), quiesced (loop
+    # at a step boundary, device lock held), kv_staged (new-layout
+    # weights+KV real, nothing committed), committed (the assignment
+    # block ran). Arming kill@N walks the matrix; a kill at any phase
+    # must leave the engine wholly on the old layout (N<=3) or wholly
+    # on the new one (N=4), never half (docs/elastic_resharding.md)
+    "mid_reshard",
 )
 
 ACTIONS = ("kill", "delay")

@@ -1,0 +1,154 @@
+"""Worker-side actuation of planner morph decisions.
+
+The planner publishes :class:`~dynamo_tpu.planner.protocols.
+MorphDecision` events on the component's ``reshard`` subject (its third
+verb beside scale-up/down); every worker runs a :class:`ReshardListener`
+that filters for its own id (0 = pool-wide), maps the requested degree
+onto its current mesh axes, and drives ``JaxEngine.reshard`` — the
+quiesce/morph/resume protocol in docs/elastic_resharding.md.
+
+Resilience contract:
+
+  * morphs apply ONE AT A TIME per worker (a second decision arriving
+    mid-morph waits; the engine itself also rejects overlapping
+    reshard calls) — the planner-side ScaleGuard rails already pace
+    the stream, this is the belt to those braces;
+  * an engine that cannot morph live (multi-host mirrors raise
+    ``ReshardUnsupported``) falls back to the PR 4 path: drain with
+    handoff, so its streams migrate to workers that can serve the new
+    layout — the decision is honored, just by replica churn instead of
+    an in-place morph;
+  * a failed morph (device shortage for the requested degree, a
+    mid-morph fault) is counted and logged, never raised into the
+    subscription loop — the engine stays wholly on its old layout and
+    the next decision gets a fresh attempt.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..planner.protocols import PLANNER_RESHARD_SUBJECT, MorphDecision
+
+logger = logging.getLogger(__name__)
+
+
+class ReshardListener:
+    """Subscribe the ``reshard`` subject and actuate morphs on one
+    engine (see module doc)."""
+
+    def __init__(self, drt, component, worker_id: int, engine,
+                 drain_deadline_s: float = 10.0, pool: str = "decode"):
+        self.drt = drt
+        self.subject = component.event_subject(PLANNER_RESHARD_SUBJECT)
+        self.worker_id = worker_id
+        self.engine = engine
+        #: the pool this worker belongs to — decisions for another pool
+        #: are not ours even at worker_id=0 (a decode-pool TP grow must
+        #: not morph prefill workers sharing the subject)
+        self.pool = pool
+        self.drain_deadline_s = drain_deadline_s
+        self.morphs_applied = 0
+        self.morphs_noop = 0
+        self.morphs_failed = 0
+        #: decisions honored via drain+handoff because the engine can't
+        #: morph live (mirrors)
+        self.morphs_drained = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> "ReshardListener":
+        sub = self.drt.bus.subscribe(self.subject)
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._sub = sub
+        self._task = self.drt.runtime.spawn(self._consume(sub))
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+
+    def _target_mesh(self, decision: MorphDecision):
+        """Map the decision's degree onto this engine's mesh axes: keep
+        every non-TP axis, swap TP. A fully-trivial result (every axis
+        1) normalizes to None — the unsharded single-device fast path,
+        so a shrink returns the engine to exactly the layout it would
+        have been built with."""
+        from ..parallel.mesh import MeshConfig
+
+        cur = self.engine.cfg.mesh
+        base = cur if cur is not None else MeshConfig()
+        target = MeshConfig(dp=base.dp, pp=base.pp, sp=base.sp,
+                            ep=base.ep, tp=max(int(decision.tp), 1))
+        return target if target.num_devices > 1 else None
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                decision = MorphDecision.from_bytes(msg.payload)
+                if decision is None:
+                    continue
+                if decision.worker_id not in (0, self.worker_id):
+                    continue
+                if decision.pool != self.pool:
+                    continue
+                await self._apply(decision)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad event must not
+                logger.debug("bad reshard event", exc_info=True)
+
+    async def _apply(self, decision: MorphDecision) -> None:
+        from ..engine.engine import ReshardUnsupported
+
+        async with self._lock:  # one morph at a time per worker
+            target = self._target_mesh(decision)
+            try:
+                out = await self.engine.reshard(
+                    target, hold=decision.hold, force=decision.force
+                )
+                if out.get("changed"):
+                    self.morphs_applied += 1
+                    logger.info(
+                        "morph %s applied (%s): %s", decision.reason,
+                        decision.tp, out,
+                    )
+                else:
+                    self.morphs_noop += 1
+            except ReshardUnsupported:
+                # mirrors can't morph live: honor the decision through
+                # the migration path — streams continue elsewhere while
+                # this worker restarts on the new layout
+                self.morphs_drained += 1
+                logger.info(
+                    "engine can't morph live; draining with handoff "
+                    "for morph %s", decision.reason,
+                )
+                try:
+                    await self.engine.drain(  # dynlint: disable=await-in-lock -- this lock exists to serialize morphs on one engine; the drain IS the morph being serialized, not incidental I/O under it
+                        deadline_s=self.drain_deadline_s, handoff=True
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.exception("morph drain fallback failed")
+            except Exception:  # noqa: BLE001 — engine stays on the old
+                # layout; count it and let the next decision retry
+                self.morphs_failed += 1
+                logger.exception(
+                    "morph %s (tp=%s) failed; engine unchanged",
+                    decision.reason, decision.tp,
+                )
+
+    def stats(self) -> dict:
+        return {
+            "reshard_morphs_applied": self.morphs_applied,
+            "reshard_morphs_noop": self.morphs_noop,
+            "reshard_morphs_failed": self.morphs_failed,
+            "reshard_morphs_drained": self.morphs_drained,
+        }

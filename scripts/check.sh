@@ -36,6 +36,8 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_cost_routing.py \
         tests/test_tracing.py \
         tests/test_resilience.py \
+        tests/test_reshard.py \
+        tests/test_reshard_soak.py \
         tests/test_kv_router.py \
         tests/test_observability.py \
         -q -m 'not slow' -p no:cacheprovider

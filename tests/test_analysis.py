@@ -508,6 +508,109 @@ def test_span_leak_good_patterns():
 
 
 # ---------------------------------------------------------------------------
+# rule 10: mesh-capture
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_capture_fires_on_module_scope():
+    bad = """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import make_mesh
+
+    MESH = Mesh(jax.devices(), ("tp",))
+    CACHE_SH = NamedSharding(MESH, P(None, "tp"))
+    DEFAULT = make_mesh()
+    """
+    assert rules_fired(bad) == ["mesh-capture"] * 3
+
+
+def test_mesh_capture_fires_on_class_scope_and_defaults():
+    bad = """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import cache_sharding
+
+    class Engine:
+        # class bodies execute at import: this placement outlives any
+        # morph the instances perform
+        sharding = NamedSharding(MESH, P("tp"))
+
+    def scatter(x, sh=cache_sharding(MESH, CFG)):
+        return x
+    """
+    assert rules_fired(bad) == ["mesh-capture"] * 2
+
+
+def test_mesh_capture_good_patterns():
+    good = """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # logical specs ARE the layer module scope may hold (mesh-free)
+    CACHE_SPEC = P(None, "tp", None)
+    SPECS = {"wq": P(None, "tp")}
+
+    def resolve(mesh, cfg):
+        # call-time resolution against the CURRENT mesh: the pattern
+        # LogicalLayout/ MeshMorpher institutionalize
+        return NamedSharding(mesh, CACHE_SPEC)
+
+    class Mover:
+        def _dst(self, devs):
+            return NamedSharding(Mesh(devs, ("ici",)), P())
+
+        def inner_default(self):
+            # nested defaults evaluate at call time, not import
+            def f(sh=NamedSharding(self.mesh, P())):
+                return sh
+            return f
+    """
+    assert rules_fired(good) == []
+
+
+def test_mesh_capture_skips_defs_nested_in_module_level_blocks():
+    good = """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # conditional definition: the def EXECUTES at import (so its
+    # defaults would be import-time) but its BODY is call time — a
+    # walk that descends module-level if/try statements wholesale
+    # would false-positive here and break CI on a correct pattern
+    try:
+        from fast import resolve
+    except ImportError:
+        def resolve(mesh):
+            return NamedSharding(mesh, P("tp"))
+
+    if True:
+        fallback = lambda mesh: NamedSharding(mesh, P())
+    """
+    assert rules_fired(good) == []
+    bad = """
+    from jax.sharding import Mesh
+
+    # ...but a def nested in a module-level block still evaluates its
+    # DEFAULTS at import, and a bare call in the block body executes
+    try:
+        def scatter(x, sh=Mesh(devices, ("tp",))):
+            return x
+    except Exception:
+        MESH = Mesh(devices, ("tp",))
+    """
+    assert rules_fired(bad) == ["mesh-capture"] * 2
+
+
+def test_mesh_capture_scoped_to_engine_ops_packages():
+    bad = """
+    from jax.sharding import Mesh
+    MESH = Mesh(devices, ("tp",))
+    """
+    # outside the placement-bearing packages (e.g. the launch CLI or a
+    # test helper) the rule stays quiet
+    assert rules_fired(bad, path="dynamo_tpu/launch/fake.py") == []
+    assert rules_fired(bad, path="dynamo_tpu/ops/fake.py") == ["mesh-capture"]
+
+
+# ---------------------------------------------------------------------------
 # suppressions + report plumbing
 # ---------------------------------------------------------------------------
 

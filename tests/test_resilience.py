@@ -307,6 +307,81 @@ def test_kill_matrix_stream_continues_bit_exact(run, point, after,
     run(main())
 
 
+@pytest.mark.parametrize(
+    "after,dies,on_new_layout",
+    [
+        (1, False, False),  # pre_stage: staging kill, loop untouched
+        (2, True, False),   # quiesced: dies wholly on the old layout
+        (3, True, False),   # kv_staged: staged, not committed -> old
+        (4, True, True),    # committed: dies wholly on the new layout
+    ],
+)
+def test_mid_reshard_kill_matrix_stream_migrates_bit_exact(
+    run, after, dies, on_new_layout
+):
+    """ISSUE 12 crash-atomicity rule through the FULL distributed stack:
+    a worker killed at each live-reshard phase must (a) land wholly on
+    exactly one layout, and (b) when the kill takes the serving loop
+    with it, its in-flight stream continues on the surviving worker to
+    one finish chunk, bit-exact — a morph crash is just a worker death
+    to the migration layer."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    req = make_req(max_tokens=40)
+    engines = [make_engine(decode_window=1) for _ in range(2)]
+    ref_engine = make_engine(decode_window=1)
+
+    async def main():
+        ref = await reference_tokens(ref_engine, req)
+        drts, handles, front, client = await _two_worker_stack(engines)
+        mig = MigratingEngine(
+            EngineClient(client), MigrationPolicy(max_migrations=3),
+            client=client,
+        )
+        task = asyncio.ensure_future(drive(mig, req.to_dict()))
+        victim = None
+        for _ in range(600):
+            victim = next(
+                (e for e in engines if e._n_active >= 1), None)
+            if victim is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert victim is not None, "stream never reached a decode batch"
+        faultpoints.arm("mid_reshard", "kill", after=after, times=1)
+        # stall the victim's decode at the device lock while the morph
+        # stages + posts, so the kill deterministically catches the
+        # stream IN FLIGHT at the commit boundary
+        async with victim._device_lock:
+            morph = asyncio.ensure_future(victim.reshard(MeshConfig(tp=2)))
+            for _ in range(800):
+                if victim._reshard_req is not None or morph.done():
+                    break
+                await asyncio.sleep(0.01)
+        with pytest.raises(FaultInjected):
+            await morph
+        toks, finishes, errors, _final = await drive_task(task)
+        assert errors == []
+        assert finishes == ["length"]
+        assert toks == ref
+        # all-or-nothing layout, whichever side of the commit the kill hit
+        assert victim.cfg.mesh == (MeshConfig(tp=2) if on_new_layout
+                                   else None)
+        if dies:
+            assert victim._dead is not None
+            assert mig.stats["migrations_total"] >= 1
+        else:
+            assert victim._dead is None
+            assert mig.stats["migrations_total"] == 0
+        faultpoints.reset()
+        await _teardown_stack(drts, front, engines)
+
+    run(main())
+
+
+async def drive_task(task):
+    return await task
+
+
 def test_kill_after_death_requests_fail_fast_not_hang(run):
     """A fault-killed engine must bounce subsequent dispatches with a
     retryable signature immediately (not park them on a dead queue)."""
