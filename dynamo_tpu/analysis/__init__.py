@@ -25,7 +25,10 @@ stall / lock hold / leaked writer detection under the live test suite).
 from .engine import (  # noqa: F401
     LintReport,
     Violation,
+    check_program,
     lint_paths,
     lint_source,
 )
 from .rules import ALL_RULES, Rule  # noqa: F401
+from .contracts import CONTRACT_RULES, ContractRule  # noqa: F401
+from .program import ProjectModel, Site, build_model  # noqa: F401

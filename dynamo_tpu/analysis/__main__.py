@@ -1,8 +1,16 @@
 """CLI: ``python -m dynamo_tpu.analysis [paths...]``.
 
-Exit 0 when clean, 1 on violations (the CI gate in scripts/check.sh).
-``--json`` emits the machine-readable report; ``--rule`` restricts to a
-subset (comma-separated names); ``--list-rules`` prints the catalog.
+Exit 0 when clean, 1 on violations (the CI gates in scripts/check.sh):
+
+* default — dynlint, the per-file AST pass;
+* ``--program`` — dynflow, the whole-program contract checker
+  (cross-file rules with evidence chains; wants the full tree);
+* ``--changed`` — lint only files ``git diff HEAD`` reports touched
+  (the pre-commit fast path; per-file rules only — project and
+  program rules need the whole tree and are skipped);
+* ``--json`` emits the machine-readable report; ``--rule`` restricts to
+  a subset (comma-separated names); ``--list-rules`` prints both
+  catalogs.
 """
 
 from __future__ import annotations
@@ -10,14 +18,27 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .engine import lint_paths
+from .contracts import CONTRACT_RULES
+from .engine import changed_files, check_program, lint_paths
 from .rules import ALL_RULES
+
+
+def _select_rules(catalog, spec: str):
+    """Filter a rule catalog by the --rule spec; returns (rules, error)
+    — error is the unknown-name message, None when the spec resolves."""
+    if not spec:
+        return catalog, None
+    wanted = {n.strip() for n in spec.split(",") if n.strip()}
+    unknown = wanted - {r.name for r in catalog}
+    if unknown:
+        return None, f"unknown rule(s): {', '.join(sorted(unknown))}"
+    return tuple(r for r in catalog if r.name in wanted), None
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
-        description="dynlint: invariant-encoding static analysis "
+        description="dynlint/dynflow: invariant-encoding static analysis "
         "(docs/static_analysis.md)",
     )
     ap.add_argument(
@@ -25,6 +46,16 @@ def main(argv=None) -> int:
         help="files/directories to lint (default: dynamo_tpu/ tests/)",
     )
     ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument(
+        "--program", action="store_true",
+        help="run the whole-program contract checker (dynflow) instead "
+        "of the per-file lint",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only git-touched files (per-file rules; skips "
+        "project/program rules, which need the whole tree)",
+    )
     ap.add_argument(
         "--rule", default="",
         help="comma-separated rule names to run (default: all)",
@@ -37,20 +68,53 @@ def main(argv=None) -> int:
     if args.list_rules:
         for r in ALL_RULES:
             kind = "project" if r.project else "file"
-            print(f"{r.name:26s} [{kind}] {r.summary}")
+            print(f"{r.name:34s} [{kind}]   {r.summary}")
+        for r in CONTRACT_RULES:
+            print(f"{r.name:34s} [program] {r.summary}")
         return 0
 
-    rules = ALL_RULES
-    if args.rule:
-        wanted = {n.strip() for n in args.rule.split(",") if n.strip()}
-        unknown = wanted - {r.name for r in ALL_RULES}
-        if unknown:
-            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
-                  file=sys.stderr)
-            return 2
-        rules = tuple(r for r in ALL_RULES if r.name in wanted)
+    if args.program and args.changed:
+        print("--program needs the whole tree; --changed is a per-file "
+              "fast path — pick one", file=sys.stderr)
+        return 2
 
-    report = lint_paths(args.paths, rules=rules)
+    rules, err = _select_rules(
+        CONTRACT_RULES if args.program else ALL_RULES, args.rule
+    )
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+
+    if args.program:
+        report = check_program(args.paths, rules=rules)
+        print(report.to_json() if args.json else report.render())
+        return 0 if report.ok else 1
+
+    paths = args.paths
+    if args.changed:
+        touched = changed_files(paths)
+        if touched is None:
+            print("dynlint: --changed needs git; falling back to the "
+                  "full walk", file=sys.stderr)
+        else:
+            # project rules (cross-file) can't judge a partial set; a
+            # --rule selection naming ONLY project rules must error,
+            # not run zero rules and report a false clean
+            per_file = tuple(r for r in rules if not r.project)
+            if args.rule and not per_file:
+                print("--changed runs per-file rules only; the selected "
+                      "rule(s) are project-wide (drop --changed)",
+                      file=sys.stderr)
+                return 2
+            rules = per_file
+            if not touched:
+                report = lint_paths([], rules=rules)
+                print(report.to_json() if args.json else
+                      "dynlint: 0 changed files, 0 violations, 0 suppressed")
+                return 0
+            paths = touched
+
+    report = lint_paths(paths, rules=rules)
     print(report.to_json() if args.json else report.render())
     return 0 if report.ok else 1
 

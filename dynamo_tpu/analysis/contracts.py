@@ -1,0 +1,482 @@
+"""dynflow contract rules: cross-file checks over the project model.
+
+Each rule encodes a bug class a PR 6-12 review pass actually caught by
+hand — a contract spanning 3+ files where one end silently went dead.
+Findings carry an EVIDENCE CHAIN (both ``file:line`` ends), anchored for
+suppression at the declaration end:
+
+* ``subject-without-subscriber`` — a bus subject constant published with
+  no subscriber anywhere (or subscribed with no publisher, or declared
+  and used by nobody). History: every listener class filters its own
+  subject; a typo'd or half-wired subject drops events on the floor
+  with zero errors.
+* ``header-write-without-tolerant-read`` — a wire header key written by
+  a sender that no decoder reads via ``.get``/``header_field``. History:
+  the codec forward-compat contract (PR 2/PR 6) says decoders read
+  tolerantly; a key only ever subscripted (or never read at all) is one
+  schema skew away from a mid-protocol ``KeyError`` — or is dead weight
+  on every frame.
+* ``unscraped-stat`` — a key deliberately placed on the scrape surface
+  (``load_metrics`` / merged ``stats()``/``counters()`` producers) that
+  ``WorkerLoad.from_stats`` never reads. History: PR 9 *documented*
+  ``disk_corrupt_discards``/``peer_serve_blocks_total`` as gauges; the
+  scrape mapping never picked them up and nobody noticed for three PRs.
+* ``stat-scrape-without-producer`` — the inverse: ``from_stats`` reads a
+  key nothing produces, so the WorkerLoad field is frozen at its
+  default and every gauge built on it lies.
+* ``unrendered-gauge`` — a ``WorkerLoad`` field that neither the metrics
+  component renders nor any router/planner code reads: scrape plumbing
+  with no consumer.
+* ``dead-wire-field`` — a wire-dataclass field that is serialized but
+  never attribute-read outside its protocol module. History: PR 12's
+  ``MorphDecision.pool`` rode the wire for a whole PR while the
+  listener ignored it — a decode-pool grow would have morphed prefill
+  workers.
+* ``version-advertised-unchecked`` — a capability key stamped into
+  connection info that no peer-side code ever ``.get``-checks. History:
+  ``kv_stream``/``kv_ici`` negotiation only works because BOTH ends
+  exist; an advertised-but-unchecked flag is a fast path that silently
+  never engages.
+* ``commit-block-purity`` — the engine-local flow rule: inside a
+  ``# dynflow: commit-block`` region (the reshard commit PR 12
+  established) nothing fallible is allowed — no calls, no awaits, no
+  subscripts on non-locals. History: the whole crash-atomicity story
+  ("a kill at any phase leaves the engine WHOLLY on one layout") rests
+  on the commit being uninterruptible-by-exception; this checker's
+  first real-tree run found a fallible call inside it.
+
+Suppress exactly like dynlint, at the anchored line::
+
+    "d2h_flush_pending": len(self._pending),  # dynlint: disable=unscraped-stat -- diagnostic depth, not a fleet gauge
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .program import (
+    COMMIT_BLOCK_BEGIN,
+    GAUGE_RENDER_MODULE,
+    ProjectModel,
+    Site,
+    build_model,
+)
+from .rules import Violation
+
+__all__ = ["ContractRule", "CONTRACT_RULES", "check_contracts", "build_model"]
+
+
+def _is_test_path(path: str) -> bool:
+    name = path.rsplit("/", 1)[-1]
+    return "/tests/" in path or path.startswith("tests/") \
+        or name.startswith("test_") or name == "conftest.py"
+
+
+def _prod(sites: Iterable[Site]) -> list[Site]:
+    """Production sites only: a contract end that exists only in a test
+    file is still dead in the serving stack."""
+    return [s for s in sites if not _is_test_path(s.path)]
+
+
+def _ev(sites: Iterable[Site], limit: int = 4) -> list[Site]:
+    sites = list(sites)
+    return sites[:limit]
+
+
+class ContractRule:
+    name: str = ""
+    summary: str = ""
+
+    def check(
+        self, model: ProjectModel, files: dict[str, str]
+    ) -> list[Violation]:  # pragma: no cover - interface
+        return []
+
+
+# ---------------------------------------------------------------------------
+# 1. subject-without-subscriber
+# ---------------------------------------------------------------------------
+
+
+class SubjectWithoutSubscriberRule(ContractRule):
+    name = "subject-without-subscriber"
+    summary = "bus subject published/declared with no subscriber (or vice versa)"
+
+    def check(self, model, files):
+        out: list[Violation] = []
+        for const, (value, decl) in sorted(model.subject_constants.items()):
+            pubs = _prod(model.subjects_published.get(const, ()))
+            subs = _prod(model.subjects_subscribed.get(const, ()))
+            if pubs and not subs:
+                out.append(Violation(
+                    self.name, decl.path, decl.line,
+                    f"subject {value!r} ({const}) is published but nothing "
+                    "in the tree subscribes it — events drop silently",
+                    evidence=_ev(pubs),
+                ))
+            elif subs and not pubs:
+                out.append(Violation(
+                    self.name, decl.path, decl.line,
+                    f"subject {value!r} ({const}) is subscribed but nothing "
+                    "publishes it — the consumer waits forever",
+                    evidence=_ev(subs),
+                ))
+            elif not pubs and not subs:
+                out.append(Violation(
+                    self.name, decl.path, decl.line,
+                    f"subject {value!r} ({const}) is declared but neither "
+                    "published nor subscribed anywhere",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 2. header-write-without-tolerant-read
+# ---------------------------------------------------------------------------
+
+
+class HeaderWriteWithoutTolerantReadRule(ContractRule):
+    name = "header-write-without-tolerant-read"
+    summary = "wire header key written but never .get()/header_field()-read"
+
+    def check(self, model, files):
+        out: list[Violation] = []
+        for key, writes in sorted(model.header_writes.items()):
+            writes = _prod(writes)
+            if not writes:
+                continue
+            tol = _prod(model.header_tolerant_reads.get(key, ()))
+            if tol:
+                continue
+            subs = _prod(model.header_subscript_reads.get(key, ()))
+            anchor = writes[0]
+            if subs:
+                out.append(Violation(
+                    self.name, anchor.path, anchor.line,
+                    f"header key {key!r} is written here but only read "
+                    "intolerantly (header[...]) — one schema skew from a "
+                    "mid-protocol KeyError (codec forward-compat contract)",
+                    evidence=_ev(subs),
+                ))
+            else:
+                out.append(Violation(
+                    self.name, anchor.path, anchor.line,
+                    f"header key {key!r} is written on the wire but no "
+                    "decoder reads it — dead weight on every frame, or a "
+                    "consumer that was never wired",
+                    evidence=_ev(writes[1:]),
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 3. unscraped-stat / 4. stat-scrape-without-producer
+# ---------------------------------------------------------------------------
+
+
+class UnscrapedStatRule(ContractRule):
+    name = "unscraped-stat"
+    summary = "stat key on the scrape surface never read by WorkerLoad.from_stats"
+
+    def check(self, model, files):
+        if model.from_stats_site is None:
+            return []  # scrape mapping not in the file set — nothing to judge
+        out: list[Violation] = []
+        for key, sites in sorted(model.stats_produced.items()):
+            sites = _prod(sites)
+            if not sites or key in model.stats_scraped:
+                continue
+            anchor = sites[0]
+            out.append(Violation(
+                self.name, anchor.path, anchor.line,
+                f"stat {key!r} is advertised on the scrape surface but "
+                "WorkerLoad.from_stats never reads it — it reaches no "
+                "gauge and no router/planner input",
+                evidence=[model.from_stats_site],
+            ))
+        return out
+
+
+class StatScrapeWithoutProducerRule(ContractRule):
+    name = "stat-scrape-without-producer"
+    summary = "from_stats reads a stat key nothing produces (field frozen at default)"
+
+    def check(self, model, files):
+        if not model.stats_produced:
+            return []  # no producer modules in the file set
+        out: list[Violation] = []
+        for key, sites in sorted(model.stats_scraped.items()):
+            if key in model.stats_produced:
+                continue
+            anchor = sites[0]
+            out.append(Violation(
+                self.name, anchor.path, anchor.line,
+                f"from_stats reads {key!r} but no producer emits it — the "
+                "WorkerLoad field stays at its default and every gauge "
+                "built on it lies",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 5. unrendered-gauge
+# ---------------------------------------------------------------------------
+
+
+class UnrenderedGaugeRule(ContractRule):
+    name = "unrendered-gauge"
+    summary = "WorkerLoad field with no gauge render and no routing/planner reader"
+
+    #: plumbing fields, not metrics
+    EXEMPT = ("worker_id", "ts")
+
+    def check(self, model, files):
+        if not model.workerload_fields:
+            return []
+        if not any(
+            p.endswith(GAUGE_RENDER_MODULE) for p in files
+        ):
+            return []  # render module absent — partial file set
+        out: list[Violation] = []
+        for fname, decl in sorted(model.workerload_fields.items()):
+            if fname in self.EXEMPT:
+                continue
+            if model.workerload_rendered.get(fname):
+                continue
+            consumed = _prod(model.workerload_consumed.get(fname, ()))
+            if consumed:
+                continue
+            out.append(Violation(
+                self.name, decl.path, decl.line,
+                f"WorkerLoad.{fname} is scraped but neither rendered as a "
+                "gauge nor read by any router/planner code — dead scrape "
+                "plumbing",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 6. dead-wire-field
+# ---------------------------------------------------------------------------
+
+
+class DeadWireFieldRule(ContractRule):
+    name = "dead-wire-field"
+    summary = "wire-dataclass field serialized but never consumed (MorphDecision.pool class)"
+
+    def check(self, model, files):
+        out: list[Violation] = []
+        for cname, wc in sorted(model.wire_classes.items()):
+            reads = model.wire_field_reads.get(cname, {})
+            for fname, decl in sorted(wc.fields.items()):
+                sites = _prod(reads.get(fname, ()))
+                if sites:
+                    continue
+                out.append(Violation(
+                    self.name, decl.path, decl.line,
+                    f"{cname}.{fname} rides the wire but nothing in the "
+                    "tree ever reads it — either a consumer was never "
+                    "wired (the MorphDecision.pool bug class) or it is "
+                    "dead schema",
+                    evidence=[Site(wc.path, wc.line, f"class {cname}")],
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 7. version-advertised-unchecked
+# ---------------------------------------------------------------------------
+
+
+class VersionAdvertisedUncheckedRule(ContractRule):
+    name = "version-advertised-unchecked"
+    summary = "capability advertised in connection info but never peer-checked"
+
+    def check(self, model, files):
+        out: list[Violation] = []
+        for key, writes in sorted(model.conn_advertised.items()):
+            writes = _prod(writes)
+            if not writes:
+                continue
+            checks = [
+                s for s in _prod(model.conn_checked.get(key, ()))
+                if not any(s.path == w.path and s.line == w.line
+                           for w in writes)
+            ]
+            if checks:
+                continue
+            anchor = writes[0]
+            out.append(Violation(
+                self.name, anchor.path, anchor.line,
+                f"connection-info key {key!r} is advertised here but no "
+                "peer-side code checks it — the negotiated path silently "
+                "never engages (kv_stream/kv_ici contract)",
+                evidence=_ev(writes[1:]),
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 8. commit-block-purity
+# ---------------------------------------------------------------------------
+
+
+class CommitBlockPurityRule(ContractRule):
+    name = "commit-block-purity"
+    summary = "fallible code (call/await/non-local subscript) inside a commit block"
+
+    def _local_names(self, fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])
+            ):
+                names.add(arg.arg)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store,)
+            ):
+                names.add(sub.id)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(sub.target):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        return names
+
+    def _judge_expr(
+        self, expr: ast.expr, locals_: set[str], path: str,
+        begin: Site, out: list[Violation]
+    ) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                try:
+                    what = ast.unparse(sub.func)
+                except Exception:  # noqa: BLE001
+                    what = "<call>"
+                out.append(Violation(
+                    self.name, path, sub.lineno,
+                    f"call `{what}(...)` inside the commit block — a raise "
+                    "here leaves the engine torn between layouts; compute "
+                    "it before the block and assign the result",
+                    evidence=[begin],
+                ))
+            elif isinstance(sub, (ast.Await, ast.Yield, ast.YieldFrom)):
+                out.append(Violation(
+                    self.name, path, sub.lineno,
+                    "await/yield inside the commit block — the commit must "
+                    "be uninterruptible (crash-atomicity contract)",
+                    evidence=[begin],
+                ))
+            elif isinstance(sub, ast.Subscript):
+                base = sub.value
+                if not (isinstance(base, ast.Name) and base.id in locals_):
+                    try:
+                        what = ast.unparse(sub)
+                    except Exception:  # noqa: BLE001
+                        what = "<subscript>"
+                    out.append(Violation(
+                        self.name, path, sub.lineno,
+                        f"`{what}` subscripts a non-local inside the commit "
+                        "block — a KeyError/IndexError here leaves the "
+                        "engine torn; read it into a local first",
+                        evidence=[begin],
+                    ))
+
+    def _judge_stmt(
+        self, stmt: ast.stmt, locals_: set[str], path: str,
+        begin: Site, out: list[Violation]
+    ) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._judge_expr(stmt, locals_, path, begin, out)
+        elif isinstance(stmt, ast.If):
+            self._judge_expr(stmt.test, locals_, path, begin, out)
+            for s in list(stmt.body) + list(stmt.orelse):
+                self._judge_stmt(s, locals_, path, begin, out)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            pass  # docstring/ellipsis
+        elif isinstance(stmt, ast.Pass):
+            pass
+        else:
+            out.append(Violation(
+                self.name, path, stmt.lineno,
+                f"{type(stmt).__name__} statement inside the commit block — "
+                "only plain assignments (and pure-guard ifs) are allowed",
+                evidence=[begin],
+            ))
+
+    def check(self, model, files):
+        out: list[Violation] = []
+        trees: dict[str, ast.Module] = {}
+        for cb in model.commit_blocks:
+            if _is_test_path(cb.path):
+                continue
+            tree = trees.get(cb.path)
+            if tree is None:
+                try:
+                    tree = trees[cb.path] = ast.parse(files[cb.path])
+                except (KeyError, SyntaxError):
+                    continue
+            begin = Site(cb.path, cb.begin,
+                         f"{COMMIT_BLOCK_BEGIN}" + (f" -- {cb.note}" if cb.note else ""))
+            # enclosing function (innermost def containing the region)
+            enclosing = None
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    end = getattr(node, "end_lineno", node.lineno)
+                    if node.lineno <= cb.begin and end >= cb.end - 1:
+                        if enclosing is None or node.lineno > enclosing.lineno:
+                            enclosing = node
+            scope = enclosing if enclosing is not None else tree
+            locals_ = self._local_names(scope) if enclosing is not None else set()
+
+            # walk the scope's statement tree, judging each top-level
+            # statement that falls inside the marked region (the judge
+            # itself recurses into allowed compound statements)
+            def visit(body: list[ast.stmt]) -> None:
+                for stmt in body:
+                    end = getattr(stmt, "end_lineno", stmt.lineno)
+                    if stmt.lineno > cb.begin and end < cb.end:
+                        self._judge_stmt(stmt, locals_, cb.path, begin, out)
+                        continue
+                    for attr in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, attr, None)
+                        if isinstance(sub, list):
+                            visit([s for s in sub if isinstance(s, ast.stmt)])
+                    for h in getattr(stmt, "handlers", None) or ():
+                        visit(h.body)
+
+            body = scope.body if hasattr(scope, "body") else []
+            visit(body)
+        return out
+
+
+CONTRACT_RULES: tuple[ContractRule, ...] = (
+    SubjectWithoutSubscriberRule(),
+    HeaderWriteWithoutTolerantReadRule(),
+    UnscrapedStatRule(),
+    StatScrapeWithoutProducerRule(),
+    UnrenderedGaugeRule(),
+    DeadWireFieldRule(),
+    VersionAdvertisedUncheckedRule(),
+    CommitBlockPurityRule(),
+)
+
+
+def check_contracts(
+    files: dict[str, str],
+    rules: tuple[ContractRule, ...] = CONTRACT_RULES,
+) -> list[Violation]:
+    """Run the contract rules over an in-memory file set (suppressions
+    are the caller's job — :func:`.engine.check_program` applies them)."""
+    model = build_model(files)
+    out: list[Violation] = []
+    for err in model.errors:
+        path, _, rest = err.partition(":")
+        out.append(Violation("syntax-error", path, 0, err))
+    for rule in rules:
+        out.extend(rule.check(model, files))
+    return out

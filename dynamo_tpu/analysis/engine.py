@@ -97,15 +97,21 @@ class LintReport:
             indent=2,
         )
 
+    #: report label — the program (dynflow) pass overrides it
+    tool: str = "dynlint"
+
     def render(self) -> str:
         lines = []
         for v in sorted(self.violations, key=lambda v: (v.path, v.line)):
             lines.append(f"{v.path}:{v.line}: [{v.rule}] {v.message}")
+            for s in getattr(v, "evidence", ()) or ():
+                note = f" ({s.note})" if getattr(s, "note", "") else ""
+                lines.append(f"    evidence: {s.path}:{s.line}{note}")
         for e in self.errors:
             lines.append(f"error: {e}")
         n = len(self.violations)
         lines.append(
-            f"dynlint: {self.files_checked} files, {n} violation"
+            f"{self.tool}: {self.files_checked} files, {n} violation"
             f"{'s' if n != 1 else ''}, {self.suppressed} suppressed"
         )
         return "\n".join(lines)
@@ -218,3 +224,107 @@ def lint_paths(
             else:
                 report.violations.append(v)
     return report
+
+
+def read_files(
+    paths: Sequence[str], root: Optional[str] = None
+) -> tuple[dict[str, str], list[str]]:
+    """Collect ``{relpath: source}`` for the given files/directories
+    (the same discovery as :func:`lint_paths`)."""
+    files: dict[str, str] = {}
+    errors: list[str] = []
+    for path in _iter_py_files(paths):
+        rel = _rel(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                files[rel] = f.read()
+        except OSError as e:
+            errors.append(f"{rel}: {e}")
+    return files, errors
+
+
+def check_program(
+    paths: Sequence[str],
+    rules=None,
+    root: Optional[str] = None,
+) -> LintReport:
+    """The dynflow whole-program pass: build one project model over the
+    file set and fire the cross-file contract rules
+    (:mod:`.contracts`). Suppressions use the same grammar as dynlint,
+    anchored at each finding's declaration end."""
+    from .contracts import CONTRACT_RULES, check_contracts
+
+    report = LintReport(tool="dynflow")
+    files, errors = read_files(paths, root)
+    report.errors.extend(errors)
+    report.files_checked = len(files)
+    sups = {rel: _parse_suppressions(src) for rel, src in files.items()}
+    for v in check_contracts(files, rules or CONTRACT_RULES):
+        sup = sups.get(v.path)
+        if sup is not None and sup.covers(v.rule, v.line):
+            report.suppressed += 1
+        else:
+            report.violations.append(v)
+    return report
+
+
+def changed_files(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> Optional[list[str]]:
+    """Files under ``paths`` that ``git diff --name-only HEAD`` (plus
+    untracked) reports as touched — the ``--changed`` fast path for the
+    pre-commit loop. Returns None when git is unavailable (callers fall
+    back to the full walk)."""
+    import subprocess
+
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True,
+            cwd=repo_root or os.getcwd(), timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0 or not top.stdout.strip():
+        return None
+    # resolve and run everything against the REPO ROOT: `git diff` emits
+    # root-relative paths regardless of cwd (joining them onto a
+    # subdirectory cwd silently dropped every touched file — a
+    # false-clean fast path), and `git ls-files --others` is
+    # cwd-relative, so both must share the root as their base
+    cwd = top.stdout.strip()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, cwd=cwd, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=cwd, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    touched = {
+        line.strip() for out in (diff.stdout, untracked.stdout)
+        for line in out.splitlines() if line.strip().endswith(".py")
+    }
+    roots = []
+    for p in paths:
+        ap = os.path.normpath(os.path.abspath(p))
+        if not os.path.exists(ap):
+            # the default path set ("dynamo_tpu/ tests/") assumes the
+            # repo root — re-anchor there when invoked from a subdir
+            alt = os.path.normpath(os.path.join(cwd, p))
+            if os.path.exists(alt):
+                ap = alt
+        roots.append(ap)
+    out: list[str] = []
+    for rel in sorted(touched):
+        ap = os.path.normpath(os.path.join(cwd, rel))
+        if not os.path.exists(ap):
+            continue  # deleted file
+        if any(ap == r or ap.startswith(r + os.sep) for r in roots):
+            out.append(ap)
+    return out

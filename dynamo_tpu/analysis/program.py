@@ -1,0 +1,739 @@
+"""dynflow project-model extraction: the whole-program half of dynlint.
+
+The per-file rules (:mod:`.rules`) see one AST at a time and therefore
+cannot see the bug classes PRs 6-12 kept finding by hand in review:
+wire-schema fields that are serialized but never consumed (PR 12's
+``MorphDecision.pool`` was on the wire for a whole PR before its
+listener filtered it), stats emitted by ``load_metrics`` that no
+``WorkerLoad.from_stats`` mapping ever scrapes, bus subjects published
+with no subscriber, header keys written by a sender that no decoder
+reads tolerantly, and capability versions advertised in connection info
+that the peer side never checks.
+
+This module builds ONE model of the tree — every plane's declarations
+and uses, each with its ``file:line`` — and :mod:`.contracts` fires
+cross-file rules over it, reporting BOTH ends of each broken contract
+(the write site and the missing/present read site) as an evidence
+chain.
+
+Extraction is deliberately declaration-driven, not type-inferred: the
+planes already declare themselves (``*_SUBJECT`` constants resolved
+through ``component.event_subject``, wire dataclasses with
+``to_bytes``/``from_bytes``, the single ``WorkerLoad.from_stats``
+scrape mapping, stats producers named ``load_metrics``/``stats``/
+``counters``), and where they didn't, ISSUE 13's conformance pass made
+them (named header dicts, ``# dynflow: commit-block`` markers). The
+model errs toward over-approximating *consumption* (an attribute read
+anywhere with the right name counts), so the rules stay quiet unless a
+contract end is genuinely absent from the whole tree.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+__all__ = ["Site", "ProjectModel", "build_model"]
+
+
+@dataclass(frozen=True)
+class Site:
+    """One end of an evidence chain."""
+
+    path: str
+    line: int
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"path": self.path, "line": self.line}
+        if self.note:
+            d["note"] = self.note
+        return d
+
+
+# ---------------------------------------------------------------------------
+# plane scopes (declaration lists the extractor reads)
+# ---------------------------------------------------------------------------
+
+#: modules whose ``head``/``fin``/``h`` dict literals are wire headers on
+#: the KV transfer plane (the named-header-dict convention)
+WIRE_HEADER_MODULES = (
+    "dynamo_tpu/disagg/transfer.py",
+    "dynamo_tpu/disagg/worker.py",
+    "dynamo_tpu/disagg/ici.py",
+)
+
+#: names a dict literal/subscript-store must be bound to for its string
+#: keys to count as wire-header keys in WIRE_HEADER_MODULES
+HEADER_DICT_NAMES = ("head", "fin", "hdr", "header", "h")
+
+#: modules holding versioned wire dataclasses (to_bytes/from_bytes pairs)
+WIRE_PROTOCOL_MODULES = (
+    "dynamo_tpu/kv_router/protocols.py",
+    "dynamo_tpu/planner/protocols.py",
+    "dynamo_tpu/disagg/protocols.py",
+)
+
+#: stats-plane producers: (module suffix, function name or dict-target
+#: name) whose string keys form the advertised scrape surface. ``None``
+#: function name = dict literals assigned to ``stats``/``_stats``/
+#: ``COUNTERS`` targets anywhere in the module (the DisaggEngine /
+#: sanitizer style), including later subscript stores on those names.
+STAT_PRODUCERS = (
+    ("dynamo_tpu/engine/engine.py", "load_metrics"),
+    ("dynamo_tpu/engine/offload.py", "stats"),
+    ("dynamo_tpu/kv_router/costmodel.py", "counters"),
+    ("dynamo_tpu/analysis/sanitizer.py", None),
+    ("dynamo_tpu/disagg/worker.py", None),
+)
+
+#: the single scrape mapping (consumer side of the stats plane)
+FROM_STATS_MODULE = "dynamo_tpu/kv_router/scheduler.py"
+
+#: where WorkerLoad fields must surface to count as "rendered"
+GAUGE_RENDER_MODULE = "dynamo_tpu/observability/component.py"
+
+#: receiver-name fragments marking a connection-info dict (the
+#: capability/version advertisement surface)
+CONN_NAMES = ("conn", "connection")
+
+#: commit-block region markers (tokenize comments)
+COMMIT_BLOCK_BEGIN = "dynflow: commit-block"
+COMMIT_BLOCK_END = "dynflow: end-commit-block"
+
+
+def _dotted(func: ast.expr) -> str:
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        return "?." + ".".join(reversed(parts))
+    return ""
+
+
+def _str_const(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@dataclass
+class WireClass:
+    name: str
+    path: str
+    line: int
+    #: field name -> definition Site
+    fields: dict[str, Site] = field(default_factory=dict)
+
+
+@dataclass
+class CommitBlock:
+    path: str
+    begin: int  # line of the begin marker
+    end: int  # line of the end marker (file end if unterminated)
+    note: str = ""
+
+
+@dataclass
+class ProjectModel:
+    """Everything the contract rules look at. All maps are
+    ``key -> [Site, ...]`` unless noted."""
+
+    # -- bus subjects --
+    #: CONST name -> (string value, definition site)
+    subject_constants: dict[str, tuple[str, Site]] = field(default_factory=dict)
+    subjects_published: dict[str, list[Site]] = field(default_factory=dict)
+    subjects_subscribed: dict[str, list[Site]] = field(default_factory=dict)
+
+    # -- wire headers (KV transfer plane) --
+    header_writes: dict[str, list[Site]] = field(default_factory=dict)
+    header_tolerant_reads: dict[str, list[Site]] = field(default_factory=dict)
+    header_subscript_reads: dict[str, list[Site]] = field(default_factory=dict)
+
+    # -- stats pipeline --
+    stats_produced: dict[str, list[Site]] = field(default_factory=dict)
+    stats_scraped: dict[str, list[Site]] = field(default_factory=dict)
+    from_stats_site: Optional[Site] = None
+
+    # -- WorkerLoad -> gauge plane --
+    workerload_fields: dict[str, Site] = field(default_factory=dict)
+    workerload_rendered: dict[str, list[Site]] = field(default_factory=dict)
+    workerload_consumed: dict[str, list[Site]] = field(default_factory=dict)
+
+    # -- wire dataclasses --
+    wire_classes: dict[str, WireClass] = field(default_factory=dict)
+    #: class name -> field name -> attribute-read sites (typed-flow traced)
+    wire_field_reads: dict[str, dict[str, list[Site]]] = field(default_factory=dict)
+
+    # -- capability / version advertisement --
+    conn_advertised: dict[str, list[Site]] = field(default_factory=dict)
+    conn_checked: dict[str, list[Site]] = field(default_factory=dict)
+
+    # -- commit blocks --
+    commit_blocks: list[CommitBlock] = field(default_factory=list)
+
+    #: parse failures (reported as model errors, not silently dropped)
+    errors: list[str] = field(default_factory=list)
+
+
+def _add(d: dict[str, list[Site]], key: str, site: Site) -> None:
+    d.setdefault(key, []).append(site)
+
+
+# ---------------------------------------------------------------------------
+# per-file extraction passes
+# ---------------------------------------------------------------------------
+
+
+class _FileScan:
+    """All single-file facts gathered in one walk, merged into the model
+    afterwards."""
+
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+
+
+def _subject_constants(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            val = _str_const(node.value)
+            if (
+                isinstance(tgt, ast.Name)
+                and tgt.id.endswith("_SUBJECT")
+                and val is not None
+            ):
+                model.subject_constants[tgt.id] = (
+                    val, Site(path, node.lineno, f"{tgt.id} = {val!r}")
+                )
+
+
+def _subject_uses(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    """Resolve bus ``publish``/``subscribe`` call sites back to the
+    ``*_SUBJECT`` constant they carry. Resolution is class-scoped: an
+    ``__init__`` assigning ``self.x = component.event_subject(CONST)``
+    binds ``self.x`` to CONST for every method of that class; plain
+    local assignments bind within their function. Unresolvable subjects
+    (relay infrastructure forwarding a variable) are skipped — the rule
+    only judges what it can prove."""
+
+    def subject_const_of(expr: ast.expr, env: dict[str, str]) -> Optional[str]:
+        # direct: event_subject(CONST) (or any call carrying the CONST name)
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                leaf = _dotted(sub.func).rsplit(".", 1)[-1]
+                if leaf == "event_subject" and sub.args:
+                    a = sub.args[0]
+                    if isinstance(a, ast.Name) and a.id in model.subject_constants:
+                        return a.id
+        if isinstance(expr, ast.Name):
+            if expr.id in model.subject_constants:
+                return expr.id
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return env.get("self." + expr.attr)
+        return None
+
+    def bind_env(scope: ast.AST, env: dict[str, str]) -> None:
+        """Pass 1: collect name/self-attr bindings to subjects — plain
+        assignments, and the property pattern (a method whose return
+        resolves to a subject binds ``self.<method>``, covering
+        ``TraceCollector.subject``)."""
+        for fn in ast.walk(scope):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        const = subject_const_of(sub.value, env)
+                        if const is not None:
+                            env.setdefault("self." + fn.name, const)
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Assign):
+                continue
+            const = subject_const_of(sub.value, env)
+            if const is None:
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name
+                ) and tgt.value.id == "self":
+                    env["self." + tgt.attr] = const
+                elif isinstance(tgt, ast.Name):
+                    env[tgt.id] = const
+
+    def scan_uses(scope: ast.AST, env: dict[str, str], label: str) -> None:
+        """Pass 2: resolve publish/subscribe call sites against env."""
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Call):
+                continue
+            leaf = _dotted(sub.func).rsplit(".", 1)[-1]
+            if leaf not in ("publish", "subscribe") or not sub.args:
+                continue
+            const = subject_const_of(sub.args[0], env)
+            if const is None:
+                continue
+            target = (
+                model.subjects_published if leaf == "publish"
+                else model.subjects_subscribed
+            )
+            _add(target, const, Site(path, sub.lineno, f"{leaf} in {label}"))
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            env: dict[str, str] = {}
+            bind_env(node, env)
+            scan_uses(node, env, node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env = {}
+            bind_env(node, env)
+            scan_uses(node, env, f"{node.name}()")
+
+
+def _header_plane(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    if not path.endswith(WIRE_HEADER_MODULES) and path not in WIRE_HEADER_MODULES:
+        return
+
+    def is_header_name(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in HEADER_DICT_NAMES
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in HEADER_DICT_NAMES
+        return False
+
+    for node in ast.walk(tree):
+        # dict literal bound to a header name: {"k": v, ...}
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            if any(is_header_name(t) for t in node.targets):
+                for k in node.value.keys:
+                    key = _str_const(k) if k is not None else None
+                    if key is not None:
+                        _add(model.header_writes, key,
+                             Site(path, k.lineno, "header dict literal"))
+        # head["k"] = v
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and is_header_name(tgt.value):
+                    key = _str_const(tgt.slice)
+                    if key is not None:
+                        _add(model.header_writes, key,
+                             Site(path, tgt.lineno, "header key store"))
+        elif isinstance(node, ast.Call):
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf == "get" and node.args:
+                key = _str_const(node.args[0])
+                if key is not None:
+                    _add(model.header_tolerant_reads, key,
+                         Site(path, node.lineno, ".get read"))
+            elif leaf == "header_field" and node.args:
+                key = _str_const(node.args[-1])
+                if key is not None:
+                    _add(model.header_tolerant_reads, key,
+                         Site(path, node.lineno, "header_field read"))
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            if is_header_name(node.value):
+                key = _str_const(node.slice)
+                if key is not None:
+                    _add(model.header_subscript_reads, key,
+                         Site(path, node.lineno, "intolerant [] read"))
+
+
+def _dict_keys_of(node: ast.Dict, path: str, note: str,
+                  out: dict[str, list[Site]]) -> None:
+    for k in node.keys:
+        key = _str_const(k) if k is not None else None
+        if key is not None:
+            _add(out, key, Site(path, k.lineno, note))
+
+
+def _stats_producers(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    for suffix, fn_name in STAT_PRODUCERS:
+        if not path.endswith(suffix):
+            continue
+        if fn_name is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name == fn_name:
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Dict):
+                            _dict_keys_of(sub, path, f"{fn_name}()",
+                                          model.stats_produced)
+                        elif isinstance(sub, ast.Assign):
+                            for tgt in sub.targets:
+                                if isinstance(tgt, ast.Subscript):
+                                    key = _str_const(tgt.slice)
+                                    if key is not None:
+                                        _add(model.stats_produced, key,
+                                             Site(path, tgt.lineno,
+                                                  f"{fn_name}() store"))
+        else:
+            # dict literals assigned to stats/_stats/COUNTERS targets +
+            # later subscript stores on those names
+            def is_stats_target(t: ast.expr) -> bool:
+                if isinstance(t, ast.Name):
+                    return t.id in ("stats", "_stats", "COUNTERS")
+                if isinstance(t, ast.Attribute):
+                    return t.attr in ("stats", "_stats")
+                return False
+
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    if isinstance(node.value, ast.Dict) and any(
+                        is_stats_target(t) for t in node.targets
+                    ):
+                        _dict_keys_of(node.value, path, "stats dict",
+                                      model.stats_produced)
+                    else:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Subscript) \
+                                    and is_stats_target(tgt.value):
+                                key = _str_const(tgt.slice)
+                                if key is not None:
+                                    _add(model.stats_produced, key,
+                                         Site(path, tgt.lineno, "stats store"))
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Subscript
+                ) and is_stats_target(node.target.value):
+                    key = _str_const(node.target.slice)
+                    if key is not None:
+                        _add(model.stats_produced, key,
+                             Site(path, node.target.lineno, "stats counter"))
+
+
+def _workerload_plane(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    if path.endswith(FROM_STATS_MODULE) or path == FROM_STATS_MODULE:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "WorkerLoad":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        model.workerload_fields[stmt.target.id] = Site(
+                            path, stmt.lineno, "WorkerLoad field"
+                        )
+                for fn in node.body:
+                    if isinstance(fn, ast.FunctionDef) and fn.name == "from_stats":
+                        model.from_stats_site = Site(path, fn.lineno,
+                                                     "WorkerLoad.from_stats")
+                        for sub in ast.walk(fn):
+                            if isinstance(sub, ast.Call):
+                                leaf = _dotted(sub.func).rsplit(".", 1)[-1]
+                                if leaf == "get" and sub.args:
+                                    key = _str_const(sub.args[0])
+                                    if key is not None:
+                                        _add(model.stats_scraped, key,
+                                             Site(path, sub.lineno,
+                                                  "from_stats .get"))
+
+
+def _workerload_uses(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    """Attribute reads matching WorkerLoad field names. Runs AFTER field
+    extraction (second pass over files). Renders = reads in the gauge
+    module; consumption = reads anywhere else in dynamo_tpu outside the
+    defining module."""
+    fields = model.workerload_fields
+    if not fields:
+        return
+    in_render = path.endswith(GAUGE_RENDER_MODULE) or path == GAUGE_RENDER_MODULE
+    in_def = path.endswith(FROM_STATS_MODULE) or path == FROM_STATS_MODULE
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load) \
+                and node.attr in fields:
+            site = Site(path, node.lineno, f".{node.attr} read")
+            if in_render:
+                _add(model.workerload_rendered, node.attr, site)
+            elif not in_def:
+                _add(model.workerload_consumed, node.attr, site)
+        elif isinstance(node, ast.Call):
+            # getattr(load, "field", ...) consumption (costmodel style)
+            if _dotted(node.func) == "getattr" and len(node.args) >= 2:
+                key = _str_const(node.args[1])
+                if key in fields and not in_def:
+                    target = (
+                        model.workerload_rendered if in_render
+                        else model.workerload_consumed
+                    )
+                    _add(target, key, Site(path, node.lineno, "getattr read"))
+
+
+def _wire_classes(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    if not (path.endswith(WIRE_PROTOCOL_MODULES) or path in WIRE_PROTOCOL_MODULES):
+        return
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        meth = {
+            f.name for f in node.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not ({"to_bytes", "to_json"} & meth):
+            continue  # not a wire roundtrip class
+        wc = WireClass(node.name, path, node.lineno)
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                wc.fields[stmt.target.id] = Site(
+                    path, stmt.lineno, f"{node.name}.{stmt.target.id}"
+                )
+        if wc.fields:
+            model.wire_classes[node.name] = wc
+
+
+def _wire_class_reads(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    """Typed-flow consumption trace for wire dataclass fields: a symbol
+    assigned from ``C.from_bytes(...)`` / ``C(...)`` (or annotated
+    ``x: C``) types it as C; attribute reads on typed symbols count as
+    consumption of that class's field. One level of Name-to-Name /
+    self-attr propagation covers the collector pattern
+    (``self.planner_decision = C.from_bytes(...)`` ... ``d = self.
+    planner_decision``). Protocol modules themselves are excluded —
+    ``to_bytes`` reading its own fields is not consumption."""
+    if path.endswith(WIRE_PROTOCOL_MODULES) or path in WIRE_PROTOCOL_MODULES:
+        return
+    classes = model.wire_classes
+    if not classes:
+        return
+
+    def class_of_value(expr: ast.expr, env: dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            dotted = _dotted(expr.func)
+            head = dotted.split(".", 1)[0]
+            leaf = dotted.rsplit(".", 1)[-1]
+            if head in classes and leaf in (head, "from_bytes", "from_json"):
+                return head
+            # C.from_bytes spelled via module alias: protocols.C.from_bytes
+            for cname in classes:
+                if f"{cname}.from_bytes" in dotted or f"{cname}.from_json" in dotted:
+                    return cname
+                if dotted == cname or dotted.endswith("." + cname):
+                    return cname
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return env.get("self." + expr.attr)
+        return None
+
+    def ann_class(ann: Optional[ast.expr]) -> Optional[str]:
+        if ann is None:
+            return None
+        try:
+            txt = ast.unparse(ann)
+        except Exception:  # noqa: BLE001
+            return None
+        txt = txt.strip("'\"")
+        for cname in classes:
+            if txt == cname or txt.endswith("." + cname) \
+                    or txt == f"Optional[{cname}]" \
+                    or txt.endswith(f"[{cname}]"):
+                return cname
+        return None
+
+    # env is file-wide (self-attrs are class-scoped in reality; a file-
+    # wide map over-approximates consumption, which is the safe
+    # direction for a dead-field rule)
+    env: dict[str, str] = {}
+    for _pass in range(2):  # two passes reach one propagation hop
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                c = class_of_value(node.value, env)
+                if c is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        env[tgt.id] = c
+                    elif isinstance(tgt, ast.Attribute) and isinstance(
+                        tgt.value, ast.Name
+                    ) and tgt.value.id == "self":
+                        env["self." + tgt.attr] = c
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                c = ann_class(node.annotation)
+                if c is not None:
+                    env[node.target.id] = c
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = list(node.args.args) + list(node.args.kwonlyargs)
+                for a in args:
+                    c = ann_class(a.annotation)
+                    if c is not None:
+                        env[a.arg] = c
+
+    reads = model.wire_field_reads
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        base = node.value
+        cname = None
+        if isinstance(base, ast.Name):
+            cname = env.get(base.id)
+        elif isinstance(base, ast.Attribute) and isinstance(
+            base.value, ast.Name
+        ) and base.value.id == "self":
+            cname = env.get("self." + base.attr)
+        if cname is None:
+            continue
+        wc = classes.get(cname)
+        if wc is not None and node.attr in wc.fields:
+            reads.setdefault(cname, {}).setdefault(node.attr, []).append(
+                Site(path, node.lineno, f"{cname}.{node.attr} read")
+            )
+
+
+def _conn_plane(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    """Connection-info capability advertisement (``conn["kv_ici"] = 1``)
+    vs peer-side checks (``connection.get("kv_ici")``)."""
+
+    def is_conn(expr: ast.expr) -> bool:
+        name = ""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        name = name.lower()
+        return any(t in name for t in CONN_NAMES)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and is_conn(tgt.value):
+                    key = _str_const(tgt.slice)
+                    if key is not None:
+                        _add(model.conn_advertised, key,
+                             Site(path, tgt.lineno, "advertised"))
+            if isinstance(node.value, ast.Dict) and any(
+                is_conn(t) for t in node.targets
+            ):
+                for k in node.value.keys:
+                    key = _str_const(k) if k is not None else None
+                    if key is not None:
+                        _add(model.conn_advertised, key,
+                             Site(path, k.lineno, "advertised (literal)"))
+        elif isinstance(node, ast.Call):
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf == "get" and node.args and isinstance(
+                node.func, ast.Attribute
+            ) and is_conn(node.func.value):
+                key = _str_const(node.args[0])
+                if key is not None:
+                    _add(model.conn_checked, key,
+                         Site(path, node.lineno, "peer check"))
+
+
+_DECL_RE = None
+
+
+def _comment_tokens(source: str) -> list[tuple[int, str]]:
+    import io
+    import tokenize
+
+    # real COMMENT tokens only — a docstring *describing* a marker
+    # (this package's own docs) must not count as one
+    try:
+        return [
+            (t.start[0], t.string)
+            for t in tokenize.generate_tokens(io.StringIO(source).readline)
+            if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+
+
+def _subject_declarations(path: str, source: str, model: ProjectModel) -> None:
+    """Explicit pub/sub declarations for sites the resolver can't trace
+    (a subject handed through a constructor parameter, e.g. the
+    BusExporter's)::
+
+        # dynflow: publishes=TRACE_EVENTS_SUBJECT
+        # dynflow: subscribes=KV_EVENT_SUBJECT,KV_PREFETCH_SUBJECT
+
+    The named constant must exist; unknown names are ignored (the
+    declared-but-unused rule would otherwise be gameable by comment).
+    """
+    import re
+
+    global _DECL_RE
+    if _DECL_RE is None:
+        _DECL_RE = re.compile(
+            r"dynflow:\s*(publishes|subscribes)\s*=\s*([\w,\s]+)"
+        )
+    for lineno, comment in _comment_tokens(source):
+        m = _DECL_RE.search(comment)
+        if not m:
+            continue
+        kind, names = m.group(1), m.group(2)
+        target = (
+            model.subjects_published if kind == "publishes"
+            else model.subjects_subscribed
+        )
+        for name in (n.strip() for n in names.split(",")):
+            if name in model.subject_constants:
+                _add(target, name, Site(path, lineno, f"declared {kind}"))
+
+
+def _commit_blocks(path: str, source: str, model: ProjectModel) -> None:
+    comments = _comment_tokens(source)
+    begin: Optional[int] = None
+    note = ""
+    for lineno, comment in comments:
+        if COMMIT_BLOCK_END in comment:
+            if begin is not None:
+                model.commit_blocks.append(
+                    CommitBlock(path, begin, lineno, note)
+                )
+                begin = None
+        elif COMMIT_BLOCK_BEGIN in comment:
+            begin = lineno
+            note = comment.split("--", 1)[1].strip() if "--" in comment else ""
+    if begin is not None:
+        # unterminated marker: close at EOF so the rule still judges it
+        # (and a missing end marker shows up as whatever follows failing)
+        model.commit_blocks.append(
+            CommitBlock(path, begin, len(source.splitlines()) + 1,
+                        note + " [unterminated]")
+        )
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def build_model(files: dict[str, str]) -> ProjectModel:
+    """Extract the project model from ``{relpath: source}``. Paths use
+    repo-shaped forward-slash form (``dynamo_tpu/...``); the per-plane
+    scopes above match on suffixes, so absolute prefixes are fine."""
+    model = ProjectModel()
+    trees: dict[str, ast.Module] = {}
+    for path, source in files.items():
+        if not path.endswith(".py"):
+            continue
+        try:
+            trees[path] = ast.parse(source)
+        except SyntaxError as e:
+            model.errors.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+    # pass 1: declarations (constants, classes, fields)
+    for path, tree in trees.items():
+        _subject_constants(path, tree, model)
+        _wire_classes(path, tree, model)
+        _workerload_plane(path, tree, model)
+    # pass 2: uses (need the declarations)
+    for path, tree in trees.items():
+        _subject_uses(path, tree, model)
+        _subject_declarations(path, files[path], model)
+        _header_plane(path, tree, model)
+        _stats_producers(path, tree, model)
+        _workerload_uses(path, tree, model)
+        _wire_class_reads(path, tree, model)
+        _conn_plane(path, tree, model)
+        _commit_blocks(path, files[path], model)
+    return model

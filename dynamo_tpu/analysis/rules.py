@@ -28,14 +28,24 @@ class Violation:
     path: str
     line: int
     message: str
+    #: evidence chain for cross-file (dynflow) findings: the OTHER ends
+    #: of the broken contract, as :class:`~.program.Site` objects —
+    #: per-file rules leave it empty
+    evidence: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
             "message": self.message,
         }
+        if self.evidence:
+            d["evidence"] = [
+                s.to_dict() if hasattr(s, "to_dict") else s
+                for s in self.evidence
+            ]
+        return d
 
 
 #: packages whose code runs on (or adjacent to) the serving event loop —

@@ -47,6 +47,8 @@ __all__ = [
     "name_lock",
     "counters",
     "reset_counters",
+    "register_executor",
+    "executor_pending",
 ]
 
 #: hold/stall histogram bucket upper bounds (seconds)
@@ -127,6 +129,11 @@ COUNTERS = {
     "san_lock_holds": 0,
     "san_lock_hold_max_ms": 0.0,
     "san_writers_leaked": 0,
+    # deepest pending-task backlog any registered executor has reached
+    # (register_executor): the engine registers its device-dispatch
+    # executor, the offload manager its d2h/disk pool — a wedged
+    # executor shows up here before it shows up as TTFT
+    "san_executor_pending_max": 0,
 }
 
 
@@ -138,6 +145,73 @@ def counters() -> dict:
 def reset_counters() -> None:
     for k in COUNTERS:
         COUNTERS[k] = 0.0 if k.endswith("_ms") else 0
+    _EXEC_PENDING.clear()
+    _EXEC_PENDING_MAX.clear()
+
+
+# ---------------- executor pressure ----------------
+
+#: live + high-water pending-task depth per registered executor name.
+#: _EXEC_LOCK serializes the read-modify-writes: submits land on the
+#: event-loop thread while done-callbacks fire on executor worker
+#: threads — unlocked, two simultaneous completions could both read N
+#: and write N-1, permanently inflating the pending count (and with it
+#: the gauge this plumbing exists to keep honest)
+_EXEC_LOCK = threading.Lock()
+_EXEC_PENDING: dict[str, int] = {}
+_EXEC_PENDING_MAX: dict[str, int] = {}
+_EXEC_REGISTERED: "weakref.WeakSet" = None  # type: ignore[assignment]
+
+
+def register_executor(executor, name: str):
+    """Track a ``ThreadPoolExecutor``'s pending-task depth: ``submit``
+    is wrapped (instance attribute — the class stays untouched) to
+    count tasks in, a done-callback counts them out, and the high-water
+    mark exports as ``san_executor_pending_max`` through the normal
+    counter plane (load_metrics -> WorkerLoad.executor_pending_max ->
+    the ``executor_pending_max`` gauge). Re-registering the same
+    executor is a no-op; tracking is always-on and O(1) per submit."""
+    global _EXEC_REGISTERED
+    if _EXEC_REGISTERED is None:
+        _EXEC_REGISTERED = weakref.WeakSet()
+    if executor is None or executor in _EXEC_REGISTERED:
+        return executor
+    orig_submit = executor.submit
+    _EXEC_PENDING.setdefault(name, 0)
+    _EXEC_PENDING_MAX.setdefault(name, 0)
+
+    def _done(_fut, _name=name):
+        with _EXEC_LOCK:
+            _EXEC_PENDING[_name] = max(_EXEC_PENDING.get(_name, 1) - 1, 0)
+
+    def submit(fn, /, *args, **kwargs):
+        with _EXEC_LOCK:
+            depth = _EXEC_PENDING.get(name, 0) + 1
+            _EXEC_PENDING[name] = depth
+            if depth > _EXEC_PENDING_MAX.get(name, 0):
+                _EXEC_PENDING_MAX[name] = depth
+                if depth > COUNTERS["san_executor_pending_max"]:
+                    COUNTERS["san_executor_pending_max"] = depth
+        try:
+            fut = orig_submit(fn, *args, **kwargs)
+        except Exception:
+            _done(None)  # submit refused (shutdown): not pending
+            raise
+        fut.add_done_callback(_done)
+        return fut
+
+    executor.submit = submit
+    _EXEC_REGISTERED.add(executor)
+    return executor
+
+
+def executor_pending() -> dict:
+    """Per-executor live and high-water pending depths (diagnostics;
+    the scrape plane carries only the global high-water counter)."""
+    return {
+        name: {"pending": _EXEC_PENDING.get(name, 0), "max": mx}
+        for name, mx in _EXEC_PENDING_MAX.items()
+    }
 
 
 def _caller_site(skip_prefixes=("asyncio", "analysis/sanitizer")) -> str:

@@ -297,7 +297,13 @@ class KvTransferServer:
             if frame is None:
                 return
             head = json.loads(frame.header)
-            req_id = head["request_id"]
+            # tolerant read + explicit validation (codec forward-compat
+            # contract): a peer whose header schema drifted must surface
+            # as a clean protocol error -> no-ack redelivery, never a
+            # KeyError mid-decode
+            req_id = head.get("request_id")
+            if not req_id:
+                raise ConnectionError(f"kv transfer header missing request_id: {head}")
             # look up (don't pop) — on a mid-stream failure the future must
             # stay pending so the sender's redelivery retry can complete it
             fut = self._pending.get(req_id)
@@ -313,15 +319,38 @@ class KvTransferServer:
             if head.get("stream"):
                 await self._handle_stream(reader, writer, head)
                 return
-            n = head["n_blocks"]
-            shape = tuple(head["shape"])  # [L, Hkv, n, bs, D]
+            n = int(head.get("n_blocks") or 0)
+            shape = tuple(head.get("shape") or ())  # [L, Hkv, n, bs, D]
             # MLA latent caches: k and v stacks have different trailing
             # dims, so the v shape rides its own header field and the
             # per-chunk blob splits at the k part's byte length
             v_shape = tuple(head.get("v_shape") or shape)
-            dt = _np_dtype(head["dtype"])
-            layer_chunk = head["layer_chunk"]
-            L = shape[0]
+            if n and (
+                len(shape) != 5 or len(v_shape) != 5
+                or not head.get("dtype")
+            ):
+                # the protocol's stacks are rank-5 [L, Hkv, n, bs, D] —
+                # a drifted rank would otherwise allocate garbage
+                # geometry and could ack it
+                raise ConnectionError(
+                    f"kv transfer header missing geometry: {head}"
+                )
+            if shape[2:3] and int(shape[2]) != n:
+                # a drifted header (n_blocks renamed/absent) must NOT
+                # read as a legitimate zero-block delivery — acking a
+                # real transfer as empty would hand the decode side a
+                # phantom prefix hit. The block dim of the shape is the
+                # cross-check: disagree -> protocol error -> redelivery
+                raise ConnectionError(
+                    f"kv transfer header geometry mismatch: {head}"
+                )
+            # resolve lazily: a zero-block delivery (full prefix hit on
+            # the decode side) ships dtype "" — resolving it eagerly
+            # crashed the receiver into a redelivery loop (dynflow
+            # header-plane finding)
+            dt = _np_dtype(head["dtype"]) if n else None
+            layer_chunk = int(head.get("layer_chunk") or 1)
+            L = shape[0] if shape else 0
             k = np.empty(shape, dt) if n else None
             v = np.empty(v_shape, dt) if n else None
             l0 = 0
@@ -373,7 +402,9 @@ class KvTransferServer:
         keys are ignored (codec forward-compat contract) so a newer
         sender's extra fields never break this peer; a mid-stream failure
         sends no ack and leaves the pending future for the redelivery."""
-        req_id = head["request_id"]
+        req_id = head.get("request_id")
+        if not req_id:
+            raise ConnectionError(f"kv stream header missing request_id: {head}")
         fut = self._pending.get(req_id)
         sink = self._sinks.get(req_id)
         asm = _StreamAssembler(
@@ -383,6 +414,17 @@ class KvTransferServer:
         n = asm.n
         shape = tuple(head.get("shape") or ())
         v_shape = tuple(head.get("v_shape") or shape)
+        if n and (
+            len(shape) != 5 or len(v_shape) != 5 or not head.get("dtype")
+        ):
+            # rank-5 [L, Hkv, n, bs, D] or it is not our schema
+            raise ConnectionError(f"kv stream header missing geometry: {head}")
+        if shape[2:3] and int(shape[2]) != n:
+            # same drift cross-check as the bulk path: n_blocks and the
+            # shape's block dim must agree or this is not our schema
+            raise ConnectionError(
+                f"kv stream header geometry mismatch: {head}"
+            )
         dt = _np_dtype(head["dtype"]) if n else None
         L = shape[0] if shape else 0
         seg_b0, seg_filled = -1, 0

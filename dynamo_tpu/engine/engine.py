@@ -738,6 +738,7 @@ class JaxEngine(AsyncEngine):
 
     def load_metrics(self) -> dict:
         """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
+        self._register_device_executor()
         out = {}
         if self.offload is not None:
             # piggyback the (loop-side) stats scrape to publish queued
@@ -760,7 +761,7 @@ class JaxEngine(AsyncEngine):
             "mixed_prefill_segments": self.stats["mixed_prefill_segments"],
             "kv_active_blocks": self.allocator.used_count,
             "kv_total_blocks": self.allocator.num_blocks - 1,
-            "gpu_cache_usage_perc": self.allocator.usage(),
+            "gpu_cache_usage_perc": self.allocator.usage(),  # dynlint: disable=unscraped-stat -- reference-schema compat key (vLLM ForwardPassMetrics); consumers derive usage from kv_active/kv_total
             "request_active_slots": self._n_active,
             "request_total_slots": self.cfg.max_batch_size,
             "num_requests_waiting": self._waiting_size(),
@@ -803,6 +804,23 @@ class JaxEngine(AsyncEngine):
             "weight_prestage_requests": self.stats[
                 "weight_prestage_requests"],
         } | (self.cost.counters() if self.cost is not None else {})
+
+    def _register_device_executor(self) -> None:
+        """Register the loop's default executor (every device dispatch
+        rides ``run_in_executor(None, ...)``) for the sanitizer's
+        executor-pressure surface. Lazy + idempotent: asyncio creates
+        the default executor on first use, so the first scrape after
+        real work picks it up; ``register_executor`` no-ops on repeats."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # scraped off-loop (tests constructing engines raw)
+        # asyncio offers no public getter for the lazily-built default
+        # executor; reading the private slot is the only non-invasive way
+        # to observe it without forcing our own pool onto the loop
+        ex = getattr(loop, "_default_executor", None)
+        if ex is not None:
+            sanitizer.register_executor(ex, "device")
 
     # ---------------- graceful drain (resilience/drain.py) ----------------
 
@@ -1058,16 +1076,25 @@ class JaxEngine(AsyncEngine):
         # the staged state must be REAL (transfers landed) before the
         # commit claims the engine is on the new layout
         jax.block_until_ready((new_k, new_v))
+        # every fallible computation happens BEFORE the commit: the
+        # dynflow commit-block-purity rule found _use_pallas_for being
+        # called inside it — had that call raised, params/caches/mesh
+        # would already have swapped while use_pallas (and the caller's
+        # "engine stays on old layout" recovery) stayed stale: a torn
+        # engine on neither layout
+        new_use_pallas = self._use_pallas_for(new_mesh)
+        new_params = req["staged"]
+        new_mesh_cfg = req["mesh_cfg"]
         faultpoints.hit_sync("mid_reshard", phase="kv_staged")
-        # ---- commit: plain host assignments only — no device work, no
-        # faultpoints, no awaits, nothing that can raise halfway ----
-        self.params = req["staged"]
+        # dynflow: commit-block -- reshard layout swap (crash-atomicity)
+        self.params = new_params
         self.k_cache, self.v_cache = new_k, new_v
         if new_pc is not None:
             self._pen_counts, self._pen_mask = new_pc, new_pm
         self.mesh = new_mesh
-        self.cfg.mesh = req["mesh_cfg"]
-        self.use_pallas = self._use_pallas_for(new_mesh)
+        self.cfg.mesh = new_mesh_cfg
+        self.use_pallas = new_use_pallas
+        # dynflow: end-commit-block
         moved = self.allocator.resident_count
         self.stats["resharded_total"] += 1
         self.stats["reshard_kv_moved_blocks"] += moved

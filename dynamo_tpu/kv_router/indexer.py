@@ -236,6 +236,13 @@ class KvIndexer:
         # must not grow without bound on a long-lived router
         self._offloaded: "OrderedDict[tuple[int, int], None]" = OrderedDict()
         self._offloaded_cap = 1 << 18
+        # per-worker event-id continuity: the publisher stamps a
+        # monotonically increasing event_id, so a jump > 1 means the bus
+        # dropped events and this router's radix view has silently
+        # diverged from the worker's real residency until the next
+        # stored/removed pair for the affected chains
+        self.event_gaps = 0
+        self._last_event_id: dict[int, int] = {}
 
     async def start(self) -> "KvIndexer":
         sub = self.drt.bus.subscribe(self.component.event_subject(KV_EVENT_SUBJECT))
@@ -261,6 +268,16 @@ class KvIndexer:
                 logger.exception("bad kv event")
 
     def apply_event(self, ev: RouterEvent) -> None:
+        if ev.event_id:
+            last = self._last_event_id.get(ev.worker_id, 0)
+            if last and ev.event_id > last + 1:
+                self.event_gaps += 1
+                logger.debug(
+                    "kv event gap from worker %x: %d -> %d",
+                    ev.worker_id, last, ev.event_id,
+                )
+            if ev.event_id > last:
+                self._last_event_id[ev.worker_id] = ev.event_id
         kv = ev.event
         if kv.kind == "demoted":
             # overlay-only: the residency stays in the tree (the worker
@@ -306,4 +323,7 @@ class KvIndexer:
         self._offloaded = OrderedDict(
             (k, None) for k in self._offloaded if k[0] != worker_id
         )
+        # a departed worker's event-id restarts from 1 when it rejoins —
+        # carrying the old high-water would count the restart as a gap
+        self._last_event_id.pop(worker_id, None)
         self.index.remove_worker(worker_id)

@@ -192,7 +192,7 @@ class KVHitRateEvent:
     decoding a NEW event drops it as a bad event for one upgrade
     window — hit-rate gauges are advisory, nothing routes on them."""
 
-    worker_id: int
+    worker_id: int  # dynlint: disable=dead-wire-field -- identifies the routed worker for operators replaying decision events; the metrics gauges deliberately aggregate fleet-wide
     isl_blocks: int
     overlap_blocks: int
     predicted_ttft_ms: float = -1.0

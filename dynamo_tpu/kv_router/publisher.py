@@ -508,8 +508,8 @@ class KvPeerServer:
             # puller's timeout covers us, and a FaultInjected kill must
             # look exactly like a crashed peer (no ack, no retry)
             self.serve_errors += 1
-            logger.debug("peer serve %s failed", req.request_id,
-                         exc_info=True)
+            logger.debug("peer serve %s for worker %x failed",
+                         req.request_id, req.src_worker_id, exc_info=True)
 
 
 class KvMetricsAggregator:

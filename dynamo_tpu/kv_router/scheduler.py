@@ -64,10 +64,19 @@ class WorkerLoad:
     disk_hit_blocks: int = 0
     peer_pull_blocks: int = 0
     peer_pull_hidden_frac: float = 0.0
+    # disk-tier health + fleet-serve volume: corrupt entries discarded
+    # as clean misses, host->disk demotions, and blocks this worker
+    # served to peers from its host/disk tiers (the d2h device-tier
+    # serve counts separately below) — the PR 9 chain the dynflow
+    # unscraped-stat rule found dropped at this hop
+    disk_corrupt_discards: int = 0
+    disk_demotions: int = 0
+    peer_serve_blocks: int = 0
     # resilience surface: a draining worker (SIGTERM received, lease
     # still live) must not be picked — its engine bounces new work
     draining: int = 0
     drains_total: int = 0
+    drain_handoffs: int = 0
     migration_resumes: int = 0
     # elastic-reshard surface: ``resharding`` marks a live morph window
     # — the worker HOLDS work through it (requests queue, nothing
@@ -104,8 +113,14 @@ class WorkerLoad:
     # stalls become fleet gauges instead of test-time-only signals
     loop_stalls: int = 0
     loop_stall_max_ms: float = 0.0
+    lock_holds: int = 0
     lock_hold_max_ms: float = 0.0
     writers_leaked: int = 0
+    # executor pressure (sanitizer.register_executor): the deepest
+    # pending-task backlog any registered executor (offload d2h/disk,
+    # engine device dispatch) has reached — a wedged executor shows up
+    # here before it shows up as TTFT
+    executor_pending_max: int = 0
     # transfer-cost calibration (kv_router/costmodel.py): the worker's
     # observed per-link-class bandwidths, corrected prefill throughput,
     # and KV block geometry — everything the router needs to convert
@@ -153,8 +168,12 @@ class WorkerLoad:
             disk_hit_blocks=d.get("disk_hit_blocks_total", 0),
             peer_pull_blocks=d.get("peer_pull_blocks_total", 0),
             peer_pull_hidden_frac=d.get("peer_pull_hidden_frac", 0.0),
+            disk_corrupt_discards=d.get("disk_corrupt_discards", 0),
+            disk_demotions=d.get("disk_demotions_total", 0),
+            peer_serve_blocks=d.get("peer_serve_blocks_total", 0),
             draining=d.get("draining", 0),
             drains_total=d.get("drains_total", 0),
+            drain_handoffs=d.get("drain_handoffs", 0),
             migration_resumes=d.get("migration_resumes", 0),
             resharding=d.get("resharding", 0),
             resharded_total=d.get("resharded_total", 0),
@@ -171,8 +190,10 @@ class WorkerLoad:
             prompt_tokens_total=d.get("prompt_tokens_total", 0),
             loop_stalls=d.get("san_loop_stalls", 0),
             loop_stall_max_ms=d.get("san_loop_stall_max_ms", 0.0),
+            lock_holds=d.get("san_lock_holds", 0),
             lock_hold_max_ms=d.get("san_lock_hold_max_ms", 0.0),
             writers_leaked=d.get("san_writers_leaked", 0),
+            executor_pending_max=d.get("san_executor_pending_max", 0),
             cost_obs=d.get("kv_cost_obs_total", 0),
             link_gbps=dict(d.get("kv_link_gbps") or {}),
             link_lat_ms=dict(d.get("kv_link_lat_ms") or {}),

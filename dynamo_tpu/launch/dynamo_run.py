@@ -791,6 +791,13 @@ async def run_planner(args) -> None:
     telemetry = TelemetryAggregator(
         metrics_aggregator=aggregator, trace_collector=collector
     )
+    # lost-host evidence, event-driven: a worker's discovery lease
+    # expiring halves the missed-scrape debounce for relayout_lost_host
+    # (drained departures + still-scraping workers are filtered inside
+    # the aggregator — a lease flap alone never relays a live pool)
+    from ..planner.telemetry import start_lease_watch
+
+    await start_lease_watch(drt, comp, telemetry)
     if args.planner_capacity:
         parts = [float(x) for x in args.planner_capacity.split(",")]
         capacity = CapacityModel(parts[0], parts[1] if len(parts) > 1 else parts[0])

@@ -142,8 +142,21 @@ class MetricsComponent:
     async def _consume_decisions(self, sub) -> None:
         async for msg in sub:
             try:
-                self.planner_decision = PlannerDecision.from_bytes(msg.payload)
+                prev = self.planner_decision
+                self.planner_decision = d = PlannerDecision.from_bytes(
+                    msg.payload
+                )
                 self.planner_decisions_total += 1
+                if prev is None or (
+                    (prev.decode_replicas, prev.prefill_replicas)
+                    != (d.decode_replicas, d.prefill_replicas)
+                ):
+                    # the decision's own audit trail: WHY the counts
+                    # moved, next to the counts
+                    logger.info(
+                        "planner decision: decode=%d prefill=%d (%s)",
+                        d.decode_replicas, d.prefill_replicas, d.reason,
+                    )
             except Exception:  # noqa: BLE001
                 logger.exception("bad planner decision event")
 
@@ -195,10 +208,17 @@ class MetricsComponent:
                 "peer_pull_hidden_frac",
                 round(w.peer_pull_hidden_frac, 6), lb,
             )
+            # disk-tier health + host/disk fleet serves (the PR 9 keys
+            # the dynflow unscraped-stat rule found dropped between
+            # OffloadManager.stats and this render)
+            gauge("disk_corrupt_discards_total", w.disk_corrupt_discards, lb)
+            gauge("disk_demotions_total", w.disk_demotions, lb)
+            gauge("peer_serve_blocks_total", w.peer_serve_blocks, lb)
             # resilience plane: draining state + handoff/resume volume
             # (resilience subsystem; docs/resilience.md)
             gauge("draining", w.draining, lb)
             gauge("drains_total", w.drains_total, lb)
+            gauge("drain_handoffs_total", w.drain_handoffs, lb)
             gauge("migration_resumes_total", w.migration_resumes, lb)
             # elastic live resharding: morph window flag + volume
             gauge("resharding", w.resharding, lb)
@@ -227,8 +247,13 @@ class MetricsComponent:
             # stall shows up here, not just in a failing test
             gauge("loop_stalls_total", w.loop_stalls, lb)
             gauge("loop_stall_max_ms", round(w.loop_stall_max_ms, 3), lb)
+            gauge("lock_holds_total", w.lock_holds, lb)
             gauge("lock_hold_max_ms", round(w.lock_hold_max_ms, 3), lb)
             gauge("writers_leaked_total", w.writers_leaked, lb)
+            # executor pressure (sanitizer.register_executor): deepest
+            # pending backlog across the worker's registered executors —
+            # a wedged offload/device executor surfaces here first
+            gauge("executor_pending_max", w.executor_pending_max, lb)
             # transfer-cost calibration plane (docs/kv_cache_routing.md):
             # how many observations this worker's cost model has folded,
             # its per-link-class observed bandwidths, the ICI fast-path
@@ -274,6 +299,12 @@ class MetricsComponent:
             gauge("planner_disagg_ratio", round(d.disagg_ratio, 6))
             gauge("planner_request_rate", round(d.request_rate, 6))
             gauge("planner_gen_token_rate", round(d.gen_token_rate, 6))
+            # the SLO view that justified the counts (these rode the
+            # wire unread until the dynflow dead-wire-field rule):
+            # operators correlate a scale-up with the breach it answered
+            gauge("planner_prompt_token_rate", round(d.prompt_token_rate, 6))
+            gauge("planner_ttft_p99_ms", round(d.ttft_p99_ms, 3))
+            gauge("planner_itl_p99_ms", round(d.itl_p99_ms, 3))
         w = self.planner_watermark
         if w is not None:
             gauge("planner_saturated_workers", len(w.saturated_workers))

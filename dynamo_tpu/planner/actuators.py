@@ -129,6 +129,7 @@ class BusPublisher:
 
     def publish(self, decision: PlannerDecision,
                 watermark: CapacityWatermark) -> None:
+        # dynflow: publishes=PLANNER_DECISION_SUBJECT,PLANNER_WATERMARK_SUBJECT
         for subject, ev in (
             (self._decision_subject, decision),
             (self._watermark_subject, watermark),

@@ -78,7 +78,7 @@ class MorphDecision:
     unchanged shape — the lost-host case, where the logical degree
     stays put but the surviving device set must re-resolve."""
 
-    ts: float = 0.0
+    ts: float = 0.0  # dynlint: disable=dead-wire-field -- wall-clock stamp for the operator audit trail (replayed decisions); actuation is ordering-free by design
     worker_id: int = 0
     pool: str = "decode"
     tp: int = 1
@@ -89,7 +89,7 @@ class MorphDecision:
     force: bool = False
     #: worker ids that vanished from telemetry (lost-host evidence,
     #: observability only — workers don't need it to actuate)
-    lost_workers: list = field(default_factory=list)
+    lost_workers: list = field(default_factory=list)  # dynlint: disable=dead-wire-field -- evidence payload for operators auditing WHY a relayout fired; actuation keys on force/tp alone by design
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -104,7 +104,7 @@ class MorphDecision:
 
 @dataclass
 class CapacityWatermark:
-    ts: float = 0.0
+    ts: float = 0.0  # dynlint: disable=dead-wire-field -- wall-clock stamp for the operator audit trail; receipt-time staleness is tracked scheduler-side (watermark_ttl_s)
     #: workers at/over the saturation watermark: the KV scheduler must
     #: stop routing NEW work at them while they drain their queues
     saturated_workers: list[int] = field(default_factory=list)

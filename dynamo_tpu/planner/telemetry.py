@@ -127,6 +127,17 @@ class TelemetryAggregator:
         #: host — a force-relayout of the whole pool must not fire on it
         self._miss_counts: dict[int, int] = {}
         self.lost_confirm_scrapes = 2
+        #: discovery-watch lease-expiry evidence (record_lease_expiry):
+        #: workers whose discovery key vanished unexpectedly. NOT lost
+        #: yet — a hub restart or watch flap can expire a lease while
+        #: the worker keeps answering scrapes, and a force-relayout must
+        #: never fire on a worker that is demonstrably alive. The
+        #: evidence instead halves the scrape debounce: ONE missed
+        #: scrape confirms (vs lost_confirm_scrapes without it), and a
+        #: worker already missing when its lease expires confirms on
+        #: the spot.
+        self._lease_expired: dict[int, float] = {}
+        self.lease_expiries = 0
 
     # ---------------- feeding ----------------
 
@@ -141,6 +152,32 @@ class TelemetryAggregator:
 
     def record_itl(self, ms: float) -> None:
         self._itl.append((self._clock(), ms))
+
+    def record_lease_expiry(self, worker_id: int) -> None:
+        """Discovery-watch lost-host evidence (ROADMAP PR 12 leftover):
+        the worker's lease expired, which normally means its host died
+        with scrapes about to stop. Cuts ``relayout_lost_host``
+        detection from two missed scrapes to at most one — immediately,
+        when the worker was already missing from the last scrape. A
+        drained departure (deregister-before-lease-revoke, the PR 4
+        shutdown order) is planned churn and ignored; a worker whose
+        scrapes KEEP arriving clears the evidence instead of being
+        relaid (the lease loss was the control plane's problem, not the
+        host's)."""
+        if worker_id not in self._was_draining:
+            return  # never scraped: not part of the pool we'd re-lay
+        if self._was_draining.get(worker_id):
+            return  # planned departure — drain deregisters first
+        self.lease_expiries += 1
+        if self._miss_counts.get(worker_id, 0) >= 1:
+            # already missing from the last scrape AND the lease is
+            # gone: both signals agree — confirm now, not next tick
+            self._miss_counts.pop(worker_id, None)
+            self._lease_expired.pop(worker_id, None)
+            self._was_draining.pop(worker_id, None)
+            self._lost.append((self._clock(), worker_id))
+            return
+        self._lease_expired[worker_id] = self._clock()
 
     def observe_loads(self, loads: list[WorkerLoad]) -> None:
         """Fold a fresh per-worker load scrape: keep the instantaneous
@@ -171,6 +208,10 @@ class TelemetryAggregator:
                 del self._counter_base[wid]
         for wid in seen:
             self._miss_counts.pop(wid, None)
+            # scrapes still arriving: the lease expiry was a control-
+            # plane flap, not a dead host — evidence cleared, and a
+            # relayout never fires on a live worker (regression-pinned)
+            self._lease_expired.pop(wid, None)
         for wid in list(self._was_draining):
             if wid not in seen:
                 # vanished between scrapes: a drained departure is a
@@ -178,12 +219,18 @@ class TelemetryAggregator:
                 # evidence — but only after ``lost_confirm_scrapes``
                 # CONSECUTIVE misses (a reappearance above resets the
                 # count), so one slow scrape can't trigger a pool-wide
-                # force relayout
+                # force relayout. A discovery lease expiry for the same
+                # worker corroborates the miss, so ONE is enough.
+                needed = (
+                    1 if wid in self._lease_expired
+                    else self.lost_confirm_scrapes
+                )
                 misses = self._miss_counts.get(wid, 0) + 1
-                if misses < self.lost_confirm_scrapes:
+                if misses < needed:
                     self._miss_counts[wid] = misses
                     continue
                 self._miss_counts.pop(wid, None)
+                self._lease_expired.pop(wid, None)
                 if not self._was_draining.pop(wid):
                     self._lost.append((now, wid))
 
@@ -228,3 +275,34 @@ class TelemetryAggregator:
                 .get("ttft_ms", {}).get("p99")
             )
         return snap
+
+
+async def start_lease_watch(drt, component, telemetry: TelemetryAggregator):
+    """Feed the discovery watch's lease-expiry events into the
+    aggregator's lost-host evidence (``record_lease_expiry``): watch the
+    component's discovery prefix and report every DELETE's lease id.
+    The aggregator decides what an expiry means — drained departures
+    and workers whose scrapes keep arriving are ignored there, so this
+    watch can stay a dumb pipe. Returns the spawned watch task (cancel
+    it to stop)."""
+    import asyncio
+
+    from ..runtime.store import EventKind
+
+    prefix = f"{component.namespace}/components/{component.name}/"
+    watcher = drt.store.watch_prefix(prefix)
+    if asyncio.iscoroutine(watcher):
+        watcher = await watcher
+
+    async def _consume() -> None:
+        async for ev in watcher:
+            if ev.kind != EventKind.DELETE:
+                continue
+            # key format: {ns}/components/{comp}/{endpoint}:{lease:x}
+            try:
+                wid = int(ev.key.rsplit(":", 1)[1], 16)
+            except (IndexError, ValueError):
+                continue
+            telemetry.record_lease_expiry(wid)
+
+    return drt.runtime.spawn(_consume())

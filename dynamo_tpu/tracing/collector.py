@@ -278,6 +278,8 @@ class BusExporter:
         if not batch:
             return
         try:
+            # dynflow: publishes=TRACE_EVENTS_SUBJECT (constructor-injected
+            # subject — dynamo_run wires component.event_subject of it)
             res = self.bus.publish(self.subject, json.dumps(batch).encode())
             if hasattr(res, "__await__"):  # remote hub bus
                 task = self._loop.create_task(res)

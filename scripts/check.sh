@@ -1,23 +1,47 @@
 #!/usr/bin/env bash
 # The one-command local gate (mirrored by .github/workflows/ci.yml):
 #
-#   1. dynlint          — the invariant-encoding static-analysis pass
-#                          (docs/static_analysis.md); exits non-zero on
-#                          any unsuppressed violation.
-#   2. lint self-tests  — every rule's firing/suppression fixtures plus
+#   1. dynlint          — the per-file invariant-encoding static-analysis
+#                          pass (docs/static_analysis.md); exits non-zero
+#                          on any unsuppressed violation. With --fast,
+#                          lints only git-touched files (--changed).
+#   2. dynflow          — the whole-program contract checker (--program):
+#                          wire/stats/lock-plane contracts with evidence
+#                          chains; the JSON report is archived next to
+#                          the terminal output.
+#   3. lint self-tests  — every rule's firing/suppression fixtures plus
 #                          the runtime-sanitizer unit tests.
-#   3. sanitized subset — the event-loop-critical test modules, run with
+#   4. sanitized subset — the event-loop-critical test modules, run with
 #                          the runtime sanitizer strict (loop stalls /
 #                          leaked writers fail tests; see conftest.py).
 #
-# Usage: scripts/check.sh [--fast]   (--fast skips step 3)
+# Usage: scripts/check.sh [--fast]   (--fast: changed-files lint, skips
+#                                     step 4)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "==> dynlint (python -m dynamo_tpu.analysis dynamo_tpu/ tests/)"
-python -m dynamo_tpu.analysis dynamo_tpu/ tests/
+if [[ "${1:-}" == "--fast" ]]; then
+    echo "==> dynlint --changed (git-touched files only)"
+    python -m dynamo_tpu.analysis --changed dynamo_tpu/ tests/
+else
+    echo "==> dynlint (python -m dynamo_tpu.analysis dynamo_tpu/ tests/)"
+    python -m dynamo_tpu.analysis dynamo_tpu/ tests/
+fi
+
+DYNFLOW_JSON="${DYNFLOW_JSON:-/tmp/dynflow_report.json}"
+echo "==> dynflow (python -m dynamo_tpu.analysis --program dynamo_tpu/ tests/)"
+python -m dynamo_tpu.analysis --program --json dynamo_tpu/ tests/ \
+    > "$DYNFLOW_JSON" \
+    || { cat "$DYNFLOW_JSON"; exit 1; }
+python - "$DYNFLOW_JSON" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+print(f"dynflow: {r['files_checked']} files, "
+      f"{len(r['violations'])} violations, {r['suppressed']} suppressed "
+      f"(report: {sys.argv[1]})")
+EOF
 
 echo "==> lint-engine + sanitizer self-tests"
 python -m pytest tests/test_analysis.py -q -p no:cacheprovider
@@ -40,6 +64,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_reshard_soak.py \
         tests/test_kv_router.py \
         tests/test_observability.py \
+        tests/test_planner.py \
         -q -m 'not slow' -p no:cacheprovider
 fi
 

@@ -902,3 +902,818 @@ def test_metrics_component_renders_sanitizer_gauges():
     assert 'dynamo_tpu_loop_stall_max_ms{worker="7"} 250.5' in text
     assert 'dynamo_tpu_lock_hold_max_ms{worker="7"} 12.25' in text
     assert 'dynamo_tpu_writers_leaked_total{worker="7"} 1' in text
+
+
+# ===========================================================================
+# dynflow: the whole-program contract checker (analysis/program.py +
+# analysis/contracts.py). Every cross-file rule gets a firing BAD
+# fixture and a passing GOOD fixture over an in-memory file set; the
+# meta-test at the bottom pins the real tree clean in --program mode.
+# ===========================================================================
+
+from dynamo_tpu.analysis.contracts import check_contracts
+from dynamo_tpu.analysis.engine import check_program
+
+
+def contracts_fired(files, rule=None):
+    fs = {p: textwrap.dedent(s) for p, s in files.items()}
+    vs = check_contracts(fs)
+    if rule is not None:
+        vs = [v for v in vs if v.rule == rule]
+    return vs
+
+
+# ---------------------------------------------------------------------------
+# subject-without-subscriber
+# ---------------------------------------------------------------------------
+
+_PUB_ONLY = {
+    "dynamo_tpu/kv_router/fakeproto.py": """
+    FOO_SUBJECT = "foo-events"
+    """,
+    "dynamo_tpu/kv_router/fakepub.py": """
+    from .fakeproto import FOO_SUBJECT
+
+    class Pub:
+        def __init__(self, drt, component):
+            self.drt = drt
+            self.subject = component.event_subject(FOO_SUBJECT)
+
+        def send(self, ev):
+            self.drt.bus.publish(self.subject, ev)
+    """,
+}
+
+
+def test_subject_without_subscriber_fires():
+    vs = contracts_fired(_PUB_ONLY, "subject-without-subscriber")
+    assert len(vs) == 1
+    v = vs[0]
+    # anchored at the constant declaration, evidence = the publish end
+    assert v.path == "dynamo_tpu/kv_router/fakeproto.py"
+    assert "published but nothing" in v.message
+    assert any(s.path.endswith("fakepub.py") for s in v.evidence)
+
+
+def test_subject_with_subscriber_passes():
+    files = dict(_PUB_ONLY)
+    files["dynamo_tpu/observability/fakesub.py"] = """
+    from ..kv_router.fakeproto import FOO_SUBJECT
+
+    class Sub:
+        def __init__(self, drt, component):
+            self.sub = drt.bus.subscribe(component.event_subject(FOO_SUBJECT))
+    """
+    assert contracts_fired(files, "subject-without-subscriber") == []
+
+
+def test_subject_subscribed_never_published_fires():
+    files = {
+        "dynamo_tpu/kv_router/fakeproto.py": "BAR_SUBJECT = 'bar'\n",
+        "dynamo_tpu/kv_router/fakesub.py": """
+        from .fakeproto import BAR_SUBJECT
+
+        class Sub:
+            def __init__(self, drt, component):
+                self.sub = drt.bus.subscribe(
+                    component.event_subject(BAR_SUBJECT))
+        """,
+    }
+    vs = contracts_fired(files, "subject-without-subscriber")
+    assert len(vs) == 1 and "waits forever" in vs[0].message
+
+
+def test_subject_declared_unused_fires_and_decl_comment_satisfies():
+    files = {"dynamo_tpu/kv_router/fakeproto.py": "DEAD_SUBJECT = 'dead'\n"}
+    vs = contracts_fired(files, "subject-without-subscriber")
+    assert len(vs) == 1 and "neither published nor subscribed" in vs[0].message
+    # a constructor-injected publisher declares itself by comment
+    # (the BusExporter pattern) — resolvable by declaration, and the
+    # pub-without-sub direction then fires instead
+    files["dynamo_tpu/tracing/fakeexp.py"] = """
+    class Exporter:
+        def flush(self, batch):
+            # dynflow: publishes=DEAD_SUBJECT
+            self.bus.publish(self.subject, batch)
+    """
+    vs = contracts_fired(files, "subject-without-subscriber")
+    assert len(vs) == 1 and "published but nothing" in vs[0].message
+
+
+def test_subject_property_pattern_resolves():
+    """TraceCollector's shape: the subject lives behind a property; the
+    subscribe through self.<property> must still resolve."""
+    files = {
+        "dynamo_tpu/tracing/fakeproto.py": "EVT_SUBJECT = 'evt'\n",
+        "dynamo_tpu/tracing/fakecol.py": """
+        from .fakeproto import EVT_SUBJECT
+
+        class Col:
+            @property
+            def subject(self):
+                return self.component.event_subject(EVT_SUBJECT)
+
+            async def start(self, drt):
+                self._sub = drt.bus.subscribe(self.subject)
+        """,
+    }
+    vs = contracts_fired(files, "subject-without-subscriber")
+    assert len(vs) == 1 and "waits forever" in vs[0].message  # sub, no pub
+
+
+# ---------------------------------------------------------------------------
+# header-write-without-tolerant-read
+# ---------------------------------------------------------------------------
+
+
+def test_header_write_without_any_read_fires():
+    files = {
+        "dynamo_tpu/disagg/transfer.py": """
+        import json
+
+        async def send(writer, rid):
+            head = {"request_id": rid, "mystery": 1}
+            await write_frame(writer, json.dumps(head).encode())
+
+        async def receive(reader):
+            h = json.loads((await read_frame(reader)).header)
+            rid = h.get("request_id")
+            return rid
+        """,
+    }
+    vs = contracts_fired(files, "header-write-without-tolerant-read")
+    assert len(vs) == 1
+    assert "'mystery'" in vs[0].message and "no " in vs[0].message
+
+
+def test_header_write_with_only_subscript_read_fires_with_evidence():
+    files = {
+        "dynamo_tpu/disagg/transfer.py": """
+        import json
+
+        async def send(writer, rid):
+            head = {"geometry": [1, 2]}
+            await write_frame(writer, json.dumps(head).encode())
+
+        async def receive(reader):
+            head = json.loads((await read_frame(reader)).header)
+            return head["geometry"]
+        """,
+    }
+    vs = contracts_fired(files, "header-write-without-tolerant-read")
+    assert len(vs) == 1
+    assert "intolerantly" in vs[0].message
+    # the evidence chain points at the intolerant read end
+    assert any(s.note.startswith("intolerant") for s in vs[0].evidence)
+
+
+def test_header_write_with_tolerant_read_passes():
+    files = {
+        "dynamo_tpu/disagg/transfer.py": """
+        import json
+
+        async def send(writer, rid):
+            head = {"geometry": [1, 2]}
+            await write_frame(writer, json.dumps(head).encode())
+
+        async def receive(reader):
+            head = json.loads((await read_frame(reader)).header)
+            return head.get("geometry")
+        """,
+    }
+    assert contracts_fired(files, "header-write-without-tolerant-read") == []
+
+
+def test_header_plane_scoped_to_wire_modules():
+    # the same dict outside the wire-module set is not a header
+    files = {
+        "dynamo_tpu/planner/whatever.py": """
+        def build():
+            head = {"not_a_wire_key": 1}
+            return head
+        """,
+    }
+    assert contracts_fired(files, "header-write-without-tolerant-read") == []
+
+
+# ---------------------------------------------------------------------------
+# unscraped-stat / stat-scrape-without-producer
+# ---------------------------------------------------------------------------
+
+_SCHED_FROM_STATS = """
+from dataclasses import dataclass
+
+@dataclass
+class WorkerLoad:
+    worker_id: int
+    cool: int = 0
+
+    @staticmethod
+    def from_stats(worker_id, d, ts=None):
+        return WorkerLoad(
+            worker_id=worker_id,
+            cool=d.get("cool_stat", 0),
+        )
+"""
+
+
+def test_unscraped_stat_fires_with_evidence():
+    files = {
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"cool_stat": 1, "forgotten_stat": 2}
+        """,
+        "dynamo_tpu/kv_router/scheduler.py": _SCHED_FROM_STATS,
+    }
+    vs = contracts_fired(files, "unscraped-stat")
+    assert len(vs) == 1
+    assert "'forgotten_stat'" in vs[0].message
+    assert vs[0].path == "dynamo_tpu/engine/engine.py"
+    # evidence names the scrape-mapping end
+    assert any(s.path.endswith("scheduler.py") for s in vs[0].evidence)
+
+
+def test_unscraped_stat_all_scraped_passes():
+    files = {
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"cool_stat": 1}
+        """,
+        "dynamo_tpu/kv_router/scheduler.py": _SCHED_FROM_STATS,
+    }
+    assert contracts_fired(files, "unscraped-stat") == []
+
+
+def test_unscraped_stat_silent_without_scrape_mapping():
+    # partial file set (no from_stats): nothing to judge
+    files = {
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"anything": 1}
+        """,
+    }
+    assert contracts_fired(files, "unscraped-stat") == []
+
+
+def test_stat_scrape_without_producer_fires():
+    files = {
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"cool_stat": 1}
+        """,
+        "dynamo_tpu/kv_router/scheduler.py": _SCHED_FROM_STATS.replace(
+            '"cool_stat"', '"ghost_stat"'
+        ),
+    }
+    vs = contracts_fired(files, "stat-scrape-without-producer")
+    assert len(vs) == 1
+    assert "'ghost_stat'" in vs[0].message and "lies" in vs[0].message
+
+
+def test_stats_dict_attribute_producer_counts():
+    # the DisaggEngine shape: a stats dict literal on self, exported
+    # wholesale — its keys are producers too
+    files = {
+        "dynamo_tpu/disagg/worker.py": """
+        class D:
+            def __init__(self):
+                self.stats = {"cool_stat": 0}
+        """,
+        "dynamo_tpu/kv_router/scheduler.py": _SCHED_FROM_STATS,
+    }
+    assert contracts_fired(files, "stat-scrape-without-producer") == []
+    assert contracts_fired(files, "unscraped-stat") == []
+
+
+# ---------------------------------------------------------------------------
+# unrendered-gauge
+# ---------------------------------------------------------------------------
+
+_WL_TWO_FIELDS = """
+from dataclasses import dataclass
+
+@dataclass
+class WorkerLoad:
+    worker_id: int
+    shown: int = 0
+    dead_field: int = 0
+
+    @staticmethod
+    def from_stats(worker_id, d, ts=None):
+        return WorkerLoad(worker_id=worker_id, shown=d.get("shown", 0),
+                          dead_field=d.get("dead_field", 0))
+"""
+
+
+def test_unrendered_gauge_fires():
+    files = {
+        "dynamo_tpu/kv_router/scheduler.py": _WL_TWO_FIELDS,
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"shown": 1, "dead_field": 2}
+        """,
+        "dynamo_tpu/observability/component.py": """
+        def render(ep):
+            return [w.shown for w in ep.loads]
+        """,
+    }
+    vs = contracts_fired(files, "unrendered-gauge")
+    assert len(vs) == 1 and "dead_field" in vs[0].message
+
+
+def test_unrendered_gauge_consumption_elsewhere_passes():
+    files = {
+        "dynamo_tpu/kv_router/scheduler.py": _WL_TWO_FIELDS,
+        "dynamo_tpu/engine/engine.py": """
+        class E:
+            def load_metrics(self):
+                return {"shown": 1, "dead_field": 2}
+        """,
+        "dynamo_tpu/observability/component.py": """
+        def render(ep):
+            return [w.shown for w in ep.loads]
+        """,
+        # a planner reads the field: consumed, even though not a gauge
+        "dynamo_tpu/planner/fake.py": """
+        def policy(load):
+            return load.dead_field > 0
+        """,
+    }
+    assert contracts_fired(files, "unrendered-gauge") == []
+
+
+def test_unrendered_gauge_silent_without_render_module():
+    files = {"dynamo_tpu/kv_router/scheduler.py": _WL_TWO_FIELDS}
+    assert contracts_fired(files, "unrendered-gauge") == []
+
+
+# ---------------------------------------------------------------------------
+# dead-wire-field — including the exact MorphDecision.pool shape
+# ---------------------------------------------------------------------------
+
+_MORPH_PROTO = """
+import json
+from dataclasses import dataclass, field
+
+@dataclass
+class MorphDecision:
+    ts: float = 0.0
+    worker_id: int = 0
+    pool: str = "decode"
+    tp: int = 1
+
+    def to_bytes(self):
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw):
+        d = json.loads(raw)
+        return MorphDecision(**d)
+"""
+
+_MORPH_LISTENER_NO_POOL_FILTER = """
+from ..planner.protocols import MorphDecision
+
+class ReshardListener:
+    async def _consume(self, sub):
+        async for msg in sub:
+            decision = MorphDecision.from_bytes(msg.payload)
+            if decision.worker_id not in (0, self.worker_id):
+                continue
+            if decision.ts < 0:
+                continue
+            await self._apply(decision.tp)
+"""
+
+
+def test_dead_wire_field_reproduces_morphdecision_pool():
+    """The PR 12 bug verbatim: MorphDecision.pool serialized, listener
+    filters worker_id but never pool — a decode-pool grow would morph
+    prefill workers sharing the subject."""
+    files = {
+        "dynamo_tpu/planner/protocols.py": _MORPH_PROTO,
+        "dynamo_tpu/resilience/reshard.py": _MORPH_LISTENER_NO_POOL_FILTER,
+    }
+    vs = contracts_fired(files, "dead-wire-field")
+    assert len(vs) == 1
+    assert "MorphDecision.pool" in vs[0].message
+    assert vs[0].path == "dynamo_tpu/planner/protocols.py"
+
+
+def test_dead_wire_field_clean_when_listener_filters_pool():
+    files = {
+        "dynamo_tpu/planner/protocols.py": _MORPH_PROTO,
+        "dynamo_tpu/resilience/reshard.py":
+            _MORPH_LISTENER_NO_POOL_FILTER.replace(
+                "if decision.ts < 0:",
+                "if decision.pool != self.pool or decision.ts < 0:",
+            ),
+    }
+    assert contracts_fired(files, "dead-wire-field") == []
+
+
+def test_dead_wire_field_traces_self_attr_and_annotation():
+    # the MetricsComponent shape: from_bytes lands on a self attr, a
+    # later local alias reads the fields; annotations type parameters
+    files = {
+        "dynamo_tpu/planner/protocols.py": _MORPH_PROTO,
+        "dynamo_tpu/observability/fake.py": """
+        from ..planner.protocols import MorphDecision
+
+        class C:
+            def consume(self, raw):
+                self.last = MorphDecision.from_bytes(raw)
+
+            def render(self):
+                d = self.last
+                return (d.pool, d.tp, d.ts)
+
+        def apply(decision: MorphDecision):
+            return decision.worker_id
+        """,
+    }
+    assert contracts_fired(files, "dead-wire-field") == []
+
+
+def test_dead_wire_field_test_only_reads_do_not_count():
+    files = {
+        "dynamo_tpu/planner/protocols.py": _MORPH_PROTO,
+        "dynamo_tpu/resilience/reshard.py": _MORPH_LISTENER_NO_POOL_FILTER,
+        # a test reads .pool — production is still dead
+        "tests/test_fake.py": """
+        from dynamo_tpu.planner.protocols import MorphDecision
+
+        def test_pool():
+            assert MorphDecision.from_bytes(b'{}').pool == "decode"
+        """,
+    }
+    vs = contracts_fired(files, "dead-wire-field")
+    assert len(vs) == 1 and "MorphDecision.pool" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# version-advertised-unchecked
+# ---------------------------------------------------------------------------
+
+
+def test_version_advertised_unchecked_fires():
+    files = {
+        "dynamo_tpu/disagg/worker.py": """
+        class D:
+            def _connection(self):
+                conn = {"address": self.addr}
+                conn["kv_flux"] = 3
+                return conn
+        """,
+    }
+    vs = contracts_fired(files, "version-advertised-unchecked")
+    fired = {v.message.split("'")[1] for v in vs}
+    assert "kv_flux" in fired
+
+
+def test_version_advertised_checked_passes():
+    files = {
+        "dynamo_tpu/disagg/worker.py": """
+        class D:
+            def _connection(self):
+                conn = {}
+                conn["kv_flux"] = 3
+                return conn
+        """,
+        "dynamo_tpu/disagg/ici.py": """
+        def negotiated(connection):
+            return int(connection.get("kv_flux") or 0) >= 3
+        """,
+    }
+    assert contracts_fired(files, "version-advertised-unchecked") == []
+
+
+# ---------------------------------------------------------------------------
+# commit-block-purity
+# ---------------------------------------------------------------------------
+
+
+def _engine_with_commit(body):
+    return {
+        "dynamo_tpu/engine/engine.py": f"""
+        class E:
+            def _commit(self, req, new_k, new_v):
+                staged = req["staged"]
+                # dynflow: commit-block -- test fixture
+{textwrap.indent(textwrap.dedent(body), "                ")}
+                # dynflow: end-commit-block
+                return True
+        """,
+    }
+
+
+def test_commit_block_call_fires():
+    vs = contracts_fired(
+        _engine_with_commit("self.use_pallas = self._use_pallas_for(req)"),
+        "commit-block-purity",
+    )
+    assert len(vs) == 1 and "call" in vs[0].message
+    # evidence anchors the block's begin marker
+    assert any("commit-block" in s.note for s in vs[0].evidence)
+
+
+def test_commit_block_await_and_nonlocal_subscript_fire():
+    bad = """
+    self.params = await self.stage()
+    self.stats["resharded"] += 1
+    """
+    vs = contracts_fired(_engine_with_commit(bad), "commit-block-purity")
+    kinds = sorted(v.message.split(" ", 1)[0] for v in vs)
+    assert len(vs) >= 2
+    assert any("await" in v.message for v in vs)
+    assert any("non-local" in v.message for v in vs)
+
+
+def test_commit_block_statement_type_fires():
+    vs = contracts_fired(
+        _engine_with_commit("for x in req:\n    self.y = x"),
+        "commit-block-purity",
+    )
+    assert len(vs) == 1 and "For statement" in vs[0].message
+
+
+def test_commit_block_pure_assignments_pass():
+    good = """
+    self.params = staged
+    self.k_cache, self.v_cache = new_k, new_v
+    if new_k is not None:
+        self.mesh = req["mesh"]
+    self.use_pallas = staged
+    """
+    assert contracts_fired(
+        _engine_with_commit(good), "commit-block-purity"
+    ) == []
+
+
+def test_commit_block_markers_in_tests_ignored():
+    files = {
+        "tests/test_fake.py": """
+        def f(q):
+            # dynflow: commit-block
+            q.pop()
+            # dynflow: end-commit-block
+        """,
+    }
+    assert contracts_fired(files, "commit-block-purity") == []
+
+
+# ---------------------------------------------------------------------------
+# program-mode suppressions, CLI, JSON
+# ---------------------------------------------------------------------------
+
+
+def _write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def test_program_suppression_counts(tmp_path):
+    _write_tree(tmp_path, {
+        "dynamo_tpu/kv_router/fakeproto.py":
+            "DEAD_SUBJECT = 'dead'  "
+            "# dynlint: disable=subject-without-subscriber -- fixture\n",
+    })
+    report = check_program([str(tmp_path / "dynamo_tpu")])
+    assert report.ok and report.suppressed == 1
+
+
+def test_program_cli_exit_codes_and_json(tmp_path, capsys):
+    _write_tree(tmp_path, {
+        "dynamo_tpu/kv_router/fakeproto.py": "DEAD_SUBJECT = 'dead'\n",
+    })
+    rc = lint_main(["--program", str(tmp_path / "dynamo_tpu")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "subject-without-subscriber" in out
+    assert "dynflow:" in out
+
+    rc = lint_main(["--program", "--json", str(tmp_path / "dynamo_tpu")])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1 and data["ok"] is False
+    assert data["violations"][0]["rule"] == "subject-without-subscriber"
+
+    # evidence chains ride the JSON for findings that carry them
+    _write_tree(tmp_path, {
+        "dynamo_tpu/planner/protocols.py": _MORPH_PROTO,
+        "dynamo_tpu/resilience/reshard.py": _MORPH_LISTENER_NO_POOL_FILTER,
+    })
+    rc = lint_main([
+        "--program", "--json", "--rule", "dead-wire-field",
+        str(tmp_path / "dynamo_tpu"),
+    ])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["violations"][0]["evidence"][0]["path"].endswith(
+        "protocols.py"
+    )
+
+
+def test_program_cli_rule_filter_and_conflicts(capsys):
+    assert lint_main(["--program", "--rule", "nope"]) == 2
+    assert lint_main(["--program", "--changed"]) == 2
+    capsys.readouterr()
+
+
+def test_changed_cli_on_clean_repo_is_fast(tmp_path, capsys):
+    # outside a git repo: falls back to the full walk (still correct)
+    import subprocess
+
+    _write_tree(tmp_path, {"dynamo_tpu/engine/ok.py": "x = 1\n"})
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"],
+        cwd=tmp_path, check=True,
+    )
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main(["--changed", "dynamo_tpu/"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 changed files" in out
+        # touch a file with a violation: --changed picks exactly it up
+        bad = tmp_path / "dynamo_tpu/engine/bad.py"
+        bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+        rc = lint_main(["--changed", "dynamo_tpu/"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "async-blocking-call" in out
+        assert "1 file" in out
+        # from a SUBDIRECTORY: git emits repo-root-relative paths, so
+        # resolving them against the invocation cwd silently dropped
+        # every touched file — a false-clean pre-commit gate (review
+        # finding, reproduced). Paths must re-anchor at the repo root.
+        os.chdir(tmp_path / "dynamo_tpu")
+        rc = lint_main(["--changed", "dynamo_tpu/", "tests/"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "async-blocking-call" in out
+    finally:
+        os.chdir(old)
+
+
+# ---------------------------------------------------------------------------
+# the meta-test: the real tree is contract-clean in --program mode
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_is_contract_clean():
+    """The second acceptance gate: `python -m dynamo_tpu.analysis
+    --program dynamo_tpu/ tests/` exits 0 on this tree. Every
+    suppression carries a written justification — the report counts
+    them, so a tree 'cleaned' by silencing is visible as such."""
+    report = check_program(
+        [os.path.join(REPO, "dynamo_tpu"), os.path.join(REPO, "tests")]
+    )
+    assert report.files_checked > 100
+    msgs = "\n".join(
+        f"{v.path}:{v.line}: [{v.rule}] {v.message}"
+        for v in report.violations
+    )
+    assert not report.violations, f"dynflow violations:\n{msgs}"
+    assert not report.errors
+    # the suppression inventory is deliberate, not accidental silence:
+    # every one is a reviewed diagnostic-surface or audit-field call
+    assert report.suppressed >= 20
+
+
+def test_real_tree_model_extracts_every_plane():
+    """The model is only as good as its extraction: prove each plane is
+    populated on the real tree (an extractor regression that silently
+    stops seeing a plane would otherwise read as 'clean')."""
+    from dynamo_tpu.analysis.engine import read_files
+    from dynamo_tpu.analysis.program import build_model
+
+    files, _ = read_files([os.path.join(REPO, "dynamo_tpu")])
+    m = build_model(files)
+    assert len(m.subject_constants) >= 8
+    assert set(m.subjects_published) >= {
+        "KV_EVENT_SUBJECT", "KV_PREFETCH_SUBJECT", "KV_PEER_FETCH_SUBJECT",
+        "KV_HIT_RATE_SUBJECT", "PLANNER_RESHARD_SUBJECT",
+        "PLANNER_DECISION_SUBJECT", "PLANNER_WATERMARK_SUBJECT",
+        "TRACE_EVENTS_SUBJECT",
+    }
+    assert set(m.subjects_subscribed) >= set(m.subjects_published)
+    assert {"request_id", "stream", "b0", "fin", "ici"} <= set(
+        m.header_writes
+    )
+    assert "kv_slice_fp" in m.stats_produced
+    assert "kv_slice_fp" in m.stats_scraped
+    assert len(m.workerload_fields) >= 45
+    assert "MorphDecision" in m.wire_classes
+    assert "pool" in m.wire_field_reads.get("MorphDecision", {})
+    assert {"kv_stream", "kv_ici", "ici_fp"} <= set(m.conn_advertised)
+    assert {"kv_stream", "kv_ici", "ici_fp"} <= set(m.conn_checked)
+    assert any(
+        cb.path.endswith("engine/engine.py") for cb in m.commit_blocks
+    )
+
+
+# ---------------------------------------------------------------------------
+# executor pressure (sanitizer satellite): register -> counter -> scrape
+# -> WorkerLoad -> gauge
+# ---------------------------------------------------------------------------
+
+
+def test_register_executor_tracks_pending_max():
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    before = sanitizer.COUNTERS["san_executor_pending_max"]
+    ex = ThreadPoolExecutor(max_workers=1)
+    sanitizer.register_executor(ex, "test-pool")
+    sanitizer.register_executor(ex, "test-pool")  # idempotent
+    gate = threading.Event()
+    futs = [ex.submit(gate.wait, 5) for _ in range(4)]
+    try:
+        pend = sanitizer.executor_pending()["test-pool"]
+        assert pend["max"] >= 4
+        assert sanitizer.COUNTERS["san_executor_pending_max"] >= max(before, 4)
+    finally:
+        gate.set()
+        for f in futs:
+            f.result(timeout=5)
+        ex.shutdown(wait=True)
+    # drained: live pending returns to zero, high-water stays
+    assert sanitizer.executor_pending()["test-pool"]["pending"] == 0
+    assert sanitizer.executor_pending()["test-pool"]["max"] >= 4
+
+
+def test_executor_pending_flows_to_workerload_and_gauge():
+    from dynamo_tpu.kv_router.scheduler import ProcessedEndpoints, WorkerLoad
+    from dynamo_tpu.observability.component import MetricsComponent
+
+    w = WorkerLoad.from_stats(7, {
+        "san_executor_pending_max": 9,
+        "san_lock_holds": 4,
+        "disk_corrupt_discards": 2,
+        "disk_demotions_total": 11,
+        "peer_serve_blocks_total": 13,
+        "drain_handoffs": 5,
+    })
+    assert w.executor_pending_max == 9
+    assert w.lock_holds == 4
+
+    mc = MetricsComponent.__new__(MetricsComponent)
+    mc.prefix = "dynamo_tpu"
+    mc.aggregator = type(
+        "A", (), {"endpoints": ProcessedEndpoints([w])}
+    )()
+    mc.hit_events = mc.hit_isl_blocks = mc.hit_overlap_blocks = 0
+    mc.planner_decision = mc.planner_watermark = None
+    mc.planner_decisions_total = 0
+    mc.tracing = None
+    text = mc.render()
+    assert 'dynamo_tpu_executor_pending_max{worker="7"} 9' in text
+    assert 'dynamo_tpu_lock_holds_total{worker="7"} 4' in text
+    # the PR 9 chain the unscraped-stat rule found dropped mid-pipeline
+    assert 'dynamo_tpu_disk_corrupt_discards_total{worker="7"} 2' in text
+    assert 'dynamo_tpu_disk_demotions_total{worker="7"} 11' in text
+    assert 'dynamo_tpu_peer_serve_blocks_total{worker="7"} 13' in text
+    assert 'dynamo_tpu_drain_handoffs_total{worker="7"} 5' in text
+
+
+def test_offload_executor_registers_for_pressure_tracking():
+    from dynamo_tpu.engine.offload import OffloadManager
+
+    om = OffloadManager(host_blocks=4)
+    try:
+        om._executor()
+        assert "offload" in sanitizer.executor_pending()
+    finally:
+        om.close()
+
+
+def test_planner_decision_slo_view_renders():
+    """PlannerDecision.ttft_p99_ms/itl_p99_ms/prompt_token_rate rode the
+    wire unread (dynflow dead-wire-field finding) — now rendered."""
+    from dynamo_tpu.kv_router.scheduler import ProcessedEndpoints
+    from dynamo_tpu.observability.component import MetricsComponent
+    from dynamo_tpu.planner.protocols import PlannerDecision
+
+    mc = MetricsComponent.__new__(MetricsComponent)
+    mc.prefix = "dynamo_tpu"
+    mc.aggregator = type("A", (), {"endpoints": ProcessedEndpoints([])})()
+    mc.hit_events = mc.hit_isl_blocks = mc.hit_overlap_blocks = 0
+    mc.planner_decision = PlannerDecision(
+        decode_replicas=2, prefill_replicas=1, ttft_p99_ms=321.5,
+        itl_p99_ms=12.25, prompt_token_rate=1000.0,
+    )
+    mc.planner_watermark = None
+    mc.planner_decisions_total = 3
+    mc.tracing = None
+    text = mc.render()
+    assert "dynamo_tpu_planner_ttft_p99_ms 321.5" in text
+    assert "dynamo_tpu_planner_itl_p99_ms 12.25" in text
+    assert "dynamo_tpu_planner_prompt_token_rate 1000.0" in text

@@ -684,3 +684,61 @@ def test_concurrent_streamed_prefills_interleave_chunkwise(run):
         await drt.shutdown()
 
     run(main())
+
+
+def test_kv_bulk_zero_block_delivery(run):
+    """Bulk (non-streamed) zero-block delivery — the decode side's
+    prefix cache covered every shipped block, kv_stream off. The
+    receiver used to resolve the header's empty dtype eagerly and
+    crash into a redelivery loop (dynflow header-plane finding); it
+    must ack and resolve the future cleanly."""
+    from dynamo_tpu.disagg.transfer import send_kv_blocks
+
+    async def main():
+        srv = KvTransferServer()
+        await srv.start()
+        fut = srv.expect("req-b0")
+        await send_kv_blocks(srv.address, "req-b0", 42, None, None)
+        d = await asyncio.wait_for(fut, 5)
+        assert d.first_token == 42 and d.n_blocks == 0
+        assert d.k_data is None and d.error is None
+        await srv.close()
+
+    run(main())
+
+
+def test_kv_bulk_drifted_header_forces_redelivery(run):
+    """A peer whose header schema drifted (n_blocks renamed/absent) but
+    whose shape still declares real blocks must NOT be acked as a
+    legitimate zero-block delivery — that would hand the decode side a
+    phantom prefix hit. The geometry cross-check (shape's block dim vs
+    n_blocks) raises, no ack is sent, and the pending future survives
+    for the redelivery."""
+    import json as _json
+
+    from dynamo_tpu.runtime.codec import TwoPartMessage, write_frame
+
+    async def main():
+        srv = KvTransferServer()
+        await srv.start()
+        fut = srv.expect("req-drift")
+        host, port = srv.address.address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        head = {  # no n_blocks key — the drift — but a real-block shape
+            "request_id": "req-drift",
+            "shape": [2, 2, 3, 4, 8], "v_shape": [2, 2, 3, 4, 8],
+            "dtype": "float32", "layer_chunk": 1,
+        }
+        await write_frame(
+            writer, TwoPartMessage(_json.dumps(head).encode(), b"")
+        )
+        # receiver must close WITHOUT acking (protocol error path)
+        ack = await asyncio.wait_for(reader.read(2), 5)
+        assert ack == b""  # EOF, not b"ok"
+        assert not fut.done()  # pending: the redelivery retries it
+        writer.close()
+        await writer.wait_closed()
+        srv.abandon("req-drift")
+        await srv.close()
+
+    run(main())

@@ -793,3 +793,114 @@ def test_morph_decision_wire_roundtrip_and_tolerance():
     raw = _json.dumps({"tp": 2, "new_field": "x"}).encode()
     back = MorphDecision.from_bytes(raw)
     assert back.tp == 2 and back.worker_id == 0 and back.hold is True
+
+
+# ---------------------------------------------------------------------------
+# lease-expiry lost-host evidence (ROADMAP PR 12 leftover): the
+# discovery watch's lease-expiry events corroborate missed scrapes,
+# cutting relayout_lost_host detection latency — without ever firing
+# on a worker whose scrapes keep arriving.
+# ---------------------------------------------------------------------------
+
+
+def _tele_load(wid, draining=0):
+    return WorkerLoad(worker_id=wid, total_slots=8, draining=draining)
+
+
+@pytest.mark.planner
+def test_lease_expiry_alone_does_not_relayout():
+    """THE regression the satellite demands: a lease expiry while
+    scrapes keep arriving (hub restart, watch flap) must NOT force a
+    relayout — the host is demonstrably alive."""
+    clk = FakeClock()
+    telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+    telemetry.observe_loads([_tele_load(1), _tele_load(2)])
+    telemetry.record_lease_expiry(2)
+    for _ in range(5):
+        clk.advance(1.0)
+        telemetry.observe_loads([_tele_load(1), _tele_load(2)])
+    assert telemetry.snapshot().lost_workers == []
+    # the evidence was cleared by the arriving scrapes: even if the
+    # worker NOW misses one scrape, the normal two-miss debounce holds
+    clk.advance(1.0)
+    telemetry.observe_loads([_tele_load(1)])
+    assert telemetry.snapshot().lost_workers == []
+
+
+@pytest.mark.planner
+def test_lease_expiry_halves_scrape_debounce():
+    """Expiry + ONE missed scrape confirms (the scrape-only path needs
+    two consecutive misses)."""
+    clk = FakeClock()
+    telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+    telemetry.observe_loads([_tele_load(1), _tele_load(2)])
+    telemetry.record_lease_expiry(2)
+    clk.advance(1.0)
+    telemetry.observe_loads([_tele_load(1)])  # first miss
+    assert telemetry.snapshot().lost_workers == [2]
+
+
+@pytest.mark.planner
+def test_lease_expiry_after_miss_confirms_immediately():
+    """The worker already missed a scrape when its lease expires: both
+    signals agree — confirmed on the spot, no further scrape needed."""
+    clk = FakeClock()
+    telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+    telemetry.observe_loads([_tele_load(1), _tele_load(2)])
+    clk.advance(1.0)
+    telemetry.observe_loads([_tele_load(1)])  # one miss: below debounce
+    assert telemetry.snapshot().lost_workers == []
+    telemetry.record_lease_expiry(2)
+    assert telemetry.snapshot().lost_workers == [2]
+
+
+@pytest.mark.planner
+def test_lease_expiry_ignores_drained_and_unknown_workers():
+    clk = FakeClock()
+    telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+    telemetry.observe_loads([_tele_load(1), _tele_load(3, draining=1)])
+    telemetry.record_lease_expiry(3)   # draining: planned departure
+    telemetry.record_lease_expiry(99)  # never scraped: not our pool
+    clk.advance(1.0)
+    telemetry.observe_loads([_tele_load(1)])
+    clk.advance(1.0)
+    telemetry.observe_loads([_tele_load(1)])
+    assert telemetry.snapshot().lost_workers == []
+
+
+@pytest.mark.planner
+def test_lease_watch_feeds_telemetry():
+    """End to end through the runtime: a worker's discovery key deleted
+    (lease revoke) reaches record_lease_expiry via start_lease_watch."""
+    from dynamo_tpu.planner.telemetry import start_lease_watch
+
+    async def main():
+        drt = DistributedRuntime()
+        await drt.start()
+        try:
+            comp = drt.namespace("ns").component("workers")
+            clk = FakeClock()
+            telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+            task = await start_lease_watch(drt, comp, telemetry)
+            key = "ns/components/workers/generate:2a"
+            put = drt.store.kv_put(key, b"{}")
+            if asyncio.iscoroutine(put):
+                await put
+            telemetry.observe_loads([_tele_load(0x2A), _tele_load(1)])
+            clk.advance(1.0)
+            telemetry.observe_loads([_tele_load(1)])  # one miss
+            delete = drt.store.kv_delete(key)
+            if asyncio.iscoroutine(delete):
+                await delete
+            for _ in range(50):
+                if telemetry.lease_expiries:
+                    break
+                await asyncio.sleep(0.01)
+            assert telemetry.lease_expiries == 1
+            # corroborated miss: confirmed without a second missed scrape
+            assert telemetry.snapshot().lost_workers == [0x2A]
+            task.cancel()
+        finally:
+            await drt.shutdown()
+
+    asyncio.run(main())
