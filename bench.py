@@ -1354,6 +1354,318 @@ def _prefix_fleet_stats() -> dict:
     return {"bench_prefix_fleet": asyncio.run(run())}
 
 
+def _kv_quant_stats() -> dict:
+    """bench_kv_quant (ISSUE 14 / ROADMAP item 3): the same host+disk
+    BLOCK BUDGET served full-width (bf16/f32) vs int8 — the tiers are
+    byte-budgeted, so the quantized codec must hold ~2x the resident
+    cached-prefix blocks before eviction — plus TTFT p50/p99 for the
+    cold / local-tier / peer-tier paths under each codec, and the
+    logprob-drift quality gate's numbers (greedy agreement + max/mean
+    chosen-token delta vs the full-width reference) printed into the
+    bench JSON.
+
+    Hard asserts (the acceptance criteria, enforced here so a
+    regression fails the bench, not just shifts a number): int8 holds
+    >= 1.8x the resident blocks at the identical budget, local/peer
+    restore TTFT stays within noise of full width at equal block
+    counts, and greedy-token agreement >= 0.99 on the fixed prompts."""
+    import asyncio
+    import shutil
+    import tempfile
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.allocator import sequence_block_hashes
+    from dynamo_tpu.engine.kvquant import measure_logprob_drift
+    from dynamo_tpu.kv_router import KvPeerServer, KvPrefetchListener
+    from dynamo_tpu.kv_router.protocols import (
+        KV_PREFETCH_SUBJECT,
+        KvPrefetchHint,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import (
+        Context,
+        DistributedRuntime,
+        LocalBus,
+        LocalStore,
+        collect,
+    )
+
+    import jax as _jax
+
+    tiny = ModelConfig.tiny(
+        hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=4, num_kv_heads=4, head_dim=64,
+        max_position_embeddings=1024,
+    )
+    params = llama.init_params(tiny, _jax.random.key(5))
+    BS = 16
+    PREFIX, TAIL = 320, 16  # 20 shared blocks + one recomputed tail
+    # capacity phase: a deliberately TIGHT identical budget both codecs
+    # compete for (the byte budget is capacity * full-width block bytes)
+    CAP_HOST, CAP_DISK = 6, 20
+    N_CHAINS = 6  # distinct shared prefixes offered (120 blocks >> 26)
+    # TTFT phase: an adequate identical budget so the measured chain
+    # survives the churn in BOTH modes (equal block counts restored)
+    TT_HOST, TT_DISK = 8, 64
+
+    def cfg(quant, tmp, host, disk):
+        return EngineConfig(
+            model=tiny, num_blocks=28, block_size=BS, max_batch_size=2,
+            max_context=1024, prefill_chunk=64,
+            host_cache_blocks=host, disk_cache_blocks=disk,
+            disk_cache_path=tmp, kv_quant=quant,
+        )
+
+    def req(toks, max_tokens=8, logprobs=None):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0,
+                                             logprobs=logprobs),
+            eos_token_ids=[],
+        )
+
+    def chain_prompt(c):
+        return [(11 * j + 53 * c) % 480 + 10 for j in range(PREFIX)]
+
+    def chain_hashes(c):
+        measured = chain_prompt(c) + [(7 * j + c) % 480 + 10
+                                      for j in range(TAIL)]
+        pairs = sequence_block_hashes(measured, BS)[: PREFIX // BS]
+        return measured, pairs, [s for _l, s in pairs]
+
+    async def serve_ttft(engine, toks):
+        t0 = _time.monotonic()
+        first = None
+        out_toks = []
+        async for o in engine.generate(Context(req(toks))):
+            if first is None and o.token_ids:
+                first = _time.monotonic()
+            out_toks.extend(o.token_ids)
+        return (first - t0) * 1e3, out_toks
+
+    async def settle_tiers(engine, chains, need_blocks):
+        """Wait for the async flush/demote pipeline to park what the
+        budget can hold (bounded: the budget may hold LESS than asked)."""
+        best = 0
+        for _ in range(300):
+            resident = 0
+            for chain in chains:
+                for h in chain:
+                    if engine.offload.tier_contains(h):
+                        resident += 1
+                    else:
+                        break
+            best = max(best, resident)
+            if resident >= need_blocks:
+                return resident
+            await asyncio.sleep(0.02)
+        return best
+
+    async def run_mode(quant):
+        out: dict = {}
+        # ---- capacity phase: the tight identical budget ----
+        cap_dir = tempfile.mkdtemp(prefix=f"dynkvq-cap-{quant}-")
+        eng_cap = JaxEngine(
+            cfg(quant, cap_dir, CAP_HOST, CAP_DISK), params=params
+        )
+        warm_full = [(23 * j) % 480 + 10 for j in range(PREFIX + TAIL)]
+        try:
+            await collect(eng_cap.generate(Context(req(range(20, 32)))))
+            await collect(eng_cap.generate(Context(req(warm_full))))
+            # N distinct shared-prefix chains churn through the device
+            # pool into the SAME host+disk byte budget; count how many
+            # cached-prefix blocks are still tier-resident (consecutive
+            # from each chain's head — what a restore can actually use)
+            chains = []
+            for c in range(N_CHAINS):
+                measured, _pairs, chain = chain_hashes(c)
+                await collect(eng_cap.generate(Context(req(measured))))
+                chains.append(chain)
+            resident = await settle_tiers(
+                eng_cap, chains, need_blocks=N_CHAINS * (PREFIX // BS)
+            )
+            out["resident_cached_prefix_blocks"] = resident
+            st = eng_cap.offload.stats()
+            out["host_blocks"] = st["offload_blocks_resident"]
+            out["disk_blocks"] = st["disk_blocks_resident"]
+            out["kv_quant_blocks_total"] = st["kv_quant_blocks_total"]
+            out["kv_quant_bytes_saved_total"] = (
+                st["kv_quant_bytes_saved_total"]
+            )
+        finally:
+            await eng_cap.close()
+            shutil.rmtree(cap_dir, ignore_errors=True)
+
+        # ---- TTFT phase at EQUAL block counts: one chain, 3 paths ----
+        ttft_dir = tempfile.mkdtemp(prefix=f"dynkvq-ttft-{quant}-")
+        eng = JaxEngine(
+            cfg(quant, ttft_dir, TT_HOST, TT_DISK), params=params
+        )
+        measured, pairs, chain = chain_hashes(0)
+        cold_ts, local_ts, peer_ts = [], [], []
+        try:
+            await collect(eng.generate(Context(req(range(20, 32)))))
+            await collect(eng.generate(Context(req(warm_full))))
+
+            async def park():
+                for i in range(2):
+                    filler = [(17 * j + 29 * i) % 480 + 10
+                              for j in range(PREFIX + TAIL)]
+                    await collect(eng.generate(Context(req(filler))))
+                got = await settle_tiers(eng, [chain],
+                                         need_blocks=len(chain))
+                if got < len(chain):
+                    raise AssertionError(
+                        f"chain never parked whole: {got}/{len(chain)}"
+                    )
+
+            await collect(eng.generate(Context(req(measured))))
+            await park()
+            # cold: a fresh engine recomputes the whole prefix
+            eng_cold = JaxEngine(
+                cfg("none", None, 0, 0), params=params
+            )
+            await collect(eng_cold.generate(Context(req(warm_full))))
+            await collect(eng_cold.generate(Context(req(range(40, 52)))))
+            for _ in range(3):
+                t, toks_cold = await serve_ttft(eng_cold, measured)
+                cold_ts.append(t)
+            await eng_cold.close()
+            # local: hinted prefetch restores the chain from THIS
+            # engine's (possibly quantized) host/disk tiers
+            for _ in range(3):
+                await eng.prefetch_hint(pairs)
+                t, toks_local = await serve_ttft(eng, measured)
+                local_ts.append(t)
+                await park()  # churn it back out for the next round
+            # peer: a puller worker pulls the chain over the bus+TCP
+            # transfer plane from this engine's tiers
+            store, bus = LocalStore(), LocalBus()
+            drt = await DistributedRuntime.from_settings(store=store, bus=bus)
+            comp = drt.namespace("dynamo").component(f"benchq-{quant}")
+            server = await KvPeerServer(drt, comp, 1, eng).start()
+            eng_peer = JaxEngine(
+                cfg(quant, None, 64, 0), params=params
+            )
+            listener = await KvPrefetchListener(
+                drt, comp, 2, eng_peer
+            ).start()
+            try:
+                await collect(eng_peer.generate(Context(req(warm_full))))
+                await collect(eng_peer.generate(Context(req(range(60, 72)))))
+                hint = KvPrefetchHint(
+                    2, [[l, s] for l, s in pairs], peer_worker_id=1,
+                    peer_blocks=len(pairs),
+                )
+                bus.publish(comp.event_subject(KV_PREFETCH_SUBJECT),
+                            hint.to_bytes())
+                for _ in range(500):
+                    if listener.blocks_prefetched >= len(chain):
+                        break
+                    await asyncio.sleep(0.02)
+                if listener.blocks_prefetched < len(chain):
+                    raise AssertionError(
+                        f"peer pull promoted only "
+                        f"{listener.blocks_prefetched}/{len(chain)}"
+                    )
+                # ONE honest pull sample: later serves would hit the
+                # puller's own device/host tiers, not the peer path
+                t, toks_peer = await serve_ttft(eng_peer, measured)
+                peer_ts.append(t)
+                out["peer_pull_blocks"] = (
+                    eng_peer.offload.stats()["peer_pull_blocks_total"]
+                )
+            finally:
+                await listener.close()
+                await server.close()
+                await eng_peer.close()
+                await drt.shutdown()
+            for name, ts in (("cold", cold_ts), ("local", local_ts),
+                             ("peer", peer_ts)):
+                out[name] = {
+                    "ttft_p50_ms": round(_pct(ts, 50), 3),
+                    "ttft_p99_ms": round(_pct(ts, 99), 3),
+                }
+            out["tokens_match"] = (
+                bool(toks_cold)
+                and toks_cold == toks_local == toks_peer
+            )
+        finally:
+            await eng.close()
+            shutil.rmtree(ttft_dir, ignore_errors=True)
+        return out
+
+    async def drift() -> dict:
+        """The quality gate on the SAME fixed prompt set: full-width
+        reference vs a quantized-tier engine whose prefix is parked
+        through the codec round-trip before the measured serve."""
+        ref = JaxEngine(cfg("none", None, 16, 0), params=params)
+        q = JaxEngine(cfg("int8", None, 16, 0), params=params)
+
+        async def park(engine, toks):
+            for i in range(2):
+                filler = [(17 * j + 29 * i) % 480 + 10
+                          for j in range(PREFIX + TAIL)]
+                await collect(engine.generate(Context(req(filler))))
+            await asyncio.sleep(0.3)
+
+        try:
+            return await measure_logprob_drift(
+                ref, q,
+                [chain_prompt(c)[: PREFIX // 2] for c in range(2)],
+                max_tokens=8, park=park,
+            )
+        finally:
+            await ref.close()
+            await q.close()
+
+    async def run():
+        full = await run_mode("none")
+        quant = await run_mode("int8")
+        d = await drift()
+        ratio = quant["resident_cached_prefix_blocks"] / max(
+            full["resident_cached_prefix_blocks"], 1
+        )
+        out = {
+            "tier_budget_blocks": {"host": CAP_HOST, "disk": CAP_DISK},
+            "chains_offered": N_CHAINS,
+            "chain_blocks": PREFIX // BS,
+            "full": full,
+            "int8": quant,
+            "capacity_ratio": round(ratio, 3),
+            "logprob_drift": d,
+        }
+        # the acceptance criteria, enforced
+        assert ratio >= 1.8, (
+            f"int8 resident capacity ratio {ratio:.2f} < 1.8x "
+            f"({quant['resident_cached_prefix_blocks']} vs "
+            f"{full['resident_cached_prefix_blocks']} blocks)"
+        )
+        for path in ("local", "peer"):
+            q_t = quant[path]["ttft_p50_ms"]
+            f_t = full[path]["ttft_p50_ms"]
+            # equal block counts: the quantized restore moves HALF the
+            # bytes, so it must not be slower beyond CPU-smoke noise
+            assert q_t <= f_t * 1.75 + 25.0, (
+                f"quantized {path} restore TTFT regressed: "
+                f"{q_t:.1f}ms vs {f_t:.1f}ms full-width"
+            )
+        assert d["greedy_agreement"] >= 0.99, d
+        assert quant["tokens_match"] and full["tokens_match"]
+        return out
+
+    return {"bench_kv_quant": asyncio.run(run())}
+
+
 def _reshard_child() -> dict:
     """Child-process body for bench_reshard (spawned by _reshard_stats
     with a 2-device CPU topology — the parent bench runs single-device,
@@ -1848,6 +2160,10 @@ def main() -> None:
         result.update(_prefix_fleet_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_prefix_fleet_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_kv_quant_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_kv_quant_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(_cost_routing_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands

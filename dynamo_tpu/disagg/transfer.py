@@ -66,7 +66,25 @@ logger = logging.getLogger(__name__)
 #: and senders only stream when the decode side advertised the
 #: capability in its connection info — an old decode peer never sees a
 #: streamed header, and an old sender's bulk header still decodes here.
-KV_STREAM_VERSION = 1
+#: v2 adds the quantized-KV scale frames (``kv_quant`` header +
+#: per-frame ``ks``/``vs`` scale slices); quantized payloads are
+#: additionally gated on the receiver's explicit ``kv_quant``
+#: capability key, so a v1/v2 skew alone never changes the bytes.
+KV_STREAM_VERSION = 2
+
+#: minimum peer version the streamed protocol itself requires: the v2
+#: frame layout without scale frames IS the v1 layout, so a v1 peer
+#: still takes full-width streams — only the quantized wire shape
+#: needs v2 + the capability key. Downgrade checks compare against
+#: this, not KV_STREAM_VERSION (or every version bump would silently
+#: demote the whole fleet to bulk for an upgrade window).
+KV_STREAM_BASE_VERSION = 1
+
+#: wire codec capability key (connection info / KvPeerFetchRequest):
+#: a receiver advertising ``{"kv_quant": 1}`` accepts int8/fp8 block
+#: payloads + scale frames and dequantizes on landing; senders MUST
+#: ship full-width bytes to peers that don't advertise it.
+KV_QUANT_WIRE_VERSION = 1
 
 
 class TransferError(Exception):
@@ -109,6 +127,13 @@ class KvDelivery:
     # so the puller must know WHICH hashes the stack carries); None on
     # the disagg handoff, whose block identity is the reservation's
     hashes: Optional[list] = None
+    # quantized wire payload (engine/kvquant.py): codec mode + the
+    # [L, n] f32 per-(layer, block) scale arrays. "none" = k_data/
+    # v_data are full-width and the scale fields are None. Only sent
+    # to receivers that advertised the kv_quant capability.
+    kv_quant: str = "none"
+    k_scales: Optional[np.ndarray] = None
+    v_scales: Optional[np.ndarray] = None
 
 
 class _StreamAssembler:
@@ -135,10 +160,13 @@ class _StreamAssembler:
         self.request_id = request_id
         self.head = head
         self.n = int(head.get("n_blocks") or 0)
+        # quantized stream (tolerant read — absent = full-width): the
+        # segments carry int8/fp8 payloads + per-frame scale slices
+        self.kv_quant = str(head.get("kv_quant") or "none")
         self._candidate = sink
         self.sink = None
         self.discard = discard
-        self.parts: list[tuple[int, object, object]] = []
+        self.parts: list[tuple] = []
         self.segments = 0
         self.covered = 0
 
@@ -148,9 +176,11 @@ class _StreamAssembler:
         if self._candidate is not None and await self._candidate.begin(self.head):
             self.sink = self._candidate
 
-    async def add_segment(self, b0: int, k_seg, v_seg) -> None:
+    async def add_segment(self, b0: int, k_seg, v_seg,
+                          ks=None, vs=None) -> None:
         """One full-layer segment ([L, Hkv, nseg, bs, D] pair) starting at
-        block offset ``b0`` within the shipped range."""
+        block offset ``b0`` within the shipped range. ``ks``/``vs``
+        ([L, nseg] f32) ride along on quantized streams."""
         if self.discard:
             return
         if b0 != self.covered:
@@ -161,11 +191,21 @@ class _StreamAssembler:
                 f"kv stream segment out of order: b0={b0}, expected "
                 f"{self.covered}"
             )
+        if self.kv_quant != "none" and ks is None:
+            # a stream that declared the codec but ships scale-less
+            # frames is malformed: landing raw int8 as KV would commit
+            # garbage with a clean ack — no-ack/redeliver instead
+            raise ConnectionError("kv stream quantized segment without scales")
         self.segments += 1
         self.covered += int(k_seg.shape[2])
         if self.sink is not None:
             try:
-                await self.sink.segment(b0, k_seg, v_seg)
+                if ks is not None:
+                    await self.sink.segment(b0, k_seg, v_seg, ks, vs)
+                else:
+                    # positional-compat: full-width streams keep the
+                    # pre-quant sink signature
+                    await self.sink.segment(b0, k_seg, v_seg)
             except SinkClosed:
                 # abandoned mid-stream: drain the rest and ack, exactly
                 # like the bulk path consumes a delivery nobody awaits
@@ -173,7 +213,7 @@ class _StreamAssembler:
                 self.discard = True
                 self.parts.clear()
             return
-        self.parts.append((b0, k_seg, v_seg))
+        self.parts.append((b0, k_seg, v_seg, ks, vs))
 
     @staticmethod
     def _concat(parts: list):
@@ -213,10 +253,15 @@ class _StreamAssembler:
         # already block-ordered
         k = self._concat([p[1] for p in self.parts])
         v = self._concat([p[2] for p in self.parts])
+        ks = vs = None
+        if self.kv_quant != "none":
+            ks = np.concatenate([p[3] for p in self.parts], axis=1)
+            vs = np.concatenate([p[4] for p in self.parts], axis=1)
         return KvDelivery(
             self.request_id, first_token, self.n, k, v,
             head_layout=head.get("head_layout", "blocked"),
             src_tp=head.get("src_tp", 1), first_lp=first_lp,
+            kv_quant=self.kv_quant, k_scales=ks, v_scales=vs,
         )
 
 
@@ -350,9 +395,17 @@ class KvTransferServer:
             # header-plane finding)
             dt = _np_dtype(head["dtype"]) if n else None
             layer_chunk = int(head.get("layer_chunk") or 1)
+            # quantized bulk delivery (tolerant read; absent = full
+            # width): per-chunk frames carry their layers' [l1-l0, n]
+            # scale slices in the frame header
+            kv_quant = str(head.get("kv_quant") or "none") if n else "none"
             L = shape[0] if shape else 0
             k = np.empty(shape, dt) if n else None
             v = np.empty(v_shape, dt) if n else None
+            ks = vs = None
+            if kv_quant != "none":
+                ks = np.empty((L, n), np.float32)
+                vs = np.empty((L, n), np.float32)
             l0 = 0
             while l0 < L and n:
                 part = await read_frame(reader)
@@ -368,6 +421,19 @@ class KvTransferServer:
                 v[l0:l1] = np.frombuffer(
                     part.data, dt, cnt_v, offset=cnt_k * dt.itemsize
                 ).reshape(sub_v)
+                if kv_quant != "none":
+                    h = part.header_json() or {}
+                    ks_sl, vs_sl = h.get("ks"), h.get("vs")
+                    if ks_sl is None or vs_sl is None:
+                        # a quantized delivery missing its scale slices
+                        # must redeliver, never land raw int8 as KV
+                        raise ConnectionError(
+                            "kv transfer quantized chunk without scales"
+                        )
+                    # KB-sized [layers, n] scale slices — not the
+                    # multi-MB payload class the rule guards
+                    ks[l0:l1] = np.asarray(ks_sl, np.float32)  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
+                    vs[l0:l1] = np.asarray(vs_sl, np.float32)  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
                 l0 = l1
             writer.write(b"ok")
             await writer.drain()
@@ -380,6 +446,7 @@ class KvTransferServer:
                         src_tp=head.get("src_tp", 1),
                         first_lp=head.get("first_lp"),
                         hashes=head.get("hashes"),
+                        kv_quant=kv_quant, k_scales=ks, v_scales=vs,
                     )
                 )
         except Exception:  # noqa: BLE001 — receive failed mid-stream: no
@@ -427,8 +494,9 @@ class KvTransferServer:
             )
         dt = _np_dtype(head["dtype"]) if n else None
         L = shape[0] if shape else 0
+        quant = asm.kv_quant != "none"
         seg_b0, seg_filled = -1, 0
-        seg_k = seg_v = None
+        seg_k = seg_v = seg_ks = seg_vs = None
         fin: Optional[dict] = None
         # read-ahead: the NEXT frame's socket read + deserialize overlap
         # the current segment's scatter, so the receiver never serializes
@@ -464,6 +532,9 @@ class KvTransferServer:
                     seg_b0, seg_filled = b0, 0
                     seg_k = np.empty((L, shape[1], ns) + shape[3:], dt)
                     seg_v = np.empty((L, v_shape[1], ns) + v_shape[3:], dt)
+                    if quant:
+                        seg_ks = np.empty((L, ns), np.float32)
+                        seg_vs = np.empty((L, ns), np.float32)
                 if l0 != seg_filled:
                     # a layer-range gap would silently land uninitialized
                     # np.empty rows in the decode cache
@@ -482,10 +553,20 @@ class KvTransferServer:
                 seg_v[l0:l1] = np.frombuffer(
                     part.data, dt, cnt_v, offset=cnt_k * dt.itemsize
                 ).reshape(sub_v)
+                if quant:
+                    ks_sl, vs_sl = h.get("ks"), h.get("vs")
+                    if ks_sl is None or vs_sl is None:
+                        # a declared-quantized stream shipping scale-less
+                        # frames must redeliver, never land raw int8
+                        raise ConnectionError(
+                            "kv stream quantized frame without scales"
+                        )
+                    seg_ks[l0:l1] = np.asarray(ks_sl, np.float32)  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
+                    seg_vs[l0:l1] = np.asarray(vs_sl, np.float32)  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
                 seg_filled = l1
                 if l1 == L:
-                    await asm.add_segment(b0, seg_k, seg_v)
-                    seg_k = seg_v = None
+                    await asm.add_segment(b0, seg_k, seg_v, seg_ks, seg_vs)
+                    seg_k = seg_v = seg_ks = seg_vs = None
         finally:
             if not pending.done():
                 pending.cancel()
@@ -511,11 +592,18 @@ async def send_kv_blocks(
     src_tp: int = 1,
     first_lp: Optional[dict] = None,
     hashes: Optional[list] = None,
+    kv_quant: str = "none",
+    k_scales: Optional[np.ndarray] = None,
+    v_scales: Optional[np.ndarray] = None,
 ) -> None:
     """Prefill-side push of one request's KV (or an error notification).
     ``hashes`` names the shipped blocks' chained seq hashes for
     content-addressed deliveries (fleet prefix-cache pulls); receivers
-    that don't know the key ignore it (codec forward-compat)."""
+    that don't know the key ignore it (codec forward-compat).
+    ``kv_quant`` + ``k_scales``/``v_scales`` ([L, n] f32) ship a
+    quantized payload — callers must have checked the receiver's
+    ``kv_quant`` capability first (legacy peers get dequantized
+    full-width bytes, never a stream they can't decode)."""
     if isinstance(connection, dict):
         connection = ConnectionInfo.from_dict(connection)
     host, port = connection.address.rsplit(":", 1)
@@ -540,6 +628,8 @@ async def send_kv_blocks(
         }
         if hashes is not None:
             head["hashes"] = list(hashes)
+        if n and kv_quant != "none":
+            head["kv_quant"] = kv_quant
         await write_frame(writer, TwoPartMessage(json.dumps(head).encode(), b""))
         if n:
             L = k_data.shape[0]
@@ -547,12 +637,23 @@ async def send_kv_blocks(
             v_data = np.ascontiguousarray(v_data)
             for l0 in range(0, L, layer_chunk):
                 l1 = min(l0 + layer_chunk, L)
+                fh = b""
+                if kv_quant != "none":
+                    # this chunk's layers' scale slices ride the frame
+                    # header (f32 -> float round-trips exactly in JSON;
+                    # KB-sized, unlike the payload views below)
+                    fh = json.dumps({
+                        "ks": np.asarray(  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
+                            k_scales[l0:l1], np.float32).tolist(),
+                        "vs": np.asarray(  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
+                            v_scales[l0:l1], np.float32).tolist(),
+                    }).encode()
                 # zero-copy buffer views, and write_frame_parts drains
                 # PER FRAME: the sender paces itself to the socket's
                 # high-water mark instead of staging the whole multi-GB
                 # stack through tobytes copies before the first drain
                 await write_frame_parts(
-                    writer, b"", (k_data[l0:l1], v_data[l0:l1])
+                    writer, fh, (k_data[l0:l1], v_data[l0:l1])
                 )
         await writer.drain()
         # require the receiver's ack — anything else (EOF from a mid-stream
@@ -612,12 +713,16 @@ class KvStreamSender:
             raise TransferError(str(e)) from e
         return sender
 
-    async def send_segment(self, b0: int, k_seg: np.ndarray, v_seg: np.ndarray) -> None:
+    async def send_segment(self, b0: int, k_seg: np.ndarray, v_seg: np.ndarray,
+                           ks: Optional[np.ndarray] = None,
+                           vs: Optional[np.ndarray] = None) -> None:
         """Ship one segment (host arrays [L, Hkv, nseg, bs, D]) starting
         at block offset ``b0`` within the shipped range. Layer-chunk
         slices go to the socket as zero-copy buffer views — no
         ``tobytes`` staging copy, which would double the sender's memory
-        traffic per segment."""
+        traffic per segment. ``ks``/``vs`` ([L, nseg] f32, quantized
+        streams only) ride each frame's header as that chunk's layers'
+        scale slices."""
         ns = int(k_seg.shape[2])
         k_seg = np.ascontiguousarray(k_seg)
         v_seg = np.ascontiguousarray(v_seg)
@@ -625,6 +730,9 @@ class KvStreamSender:
             for l0 in range(0, self._layers, self._layer_chunk):
                 l1 = min(l0 + self._layer_chunk, self._layers)
                 h = {"b0": b0, "n": ns, "l0": l0, "l1": l1}
+                if ks is not None:
+                    h["ks"] = np.asarray(ks[l0:l1], np.float32).tolist()  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
+                    h["vs"] = np.asarray(vs[l0:l1], np.float32).tolist()  # dynlint: disable=async-blocking-call -- KB-sized scale slice, not a device buffer
                 await write_frame_parts(
                     self._writer, json.dumps(h).encode(),
                     (k_seg[l0:l1], v_seg[l0:l1]),
@@ -741,8 +849,11 @@ class LocalKvStream:
         self._asm = asm
         self.segments = 0
 
-    async def send_segment(self, b0: int, k_seg, v_seg) -> None:
-        await self._asm.add_segment(b0, k_seg, v_seg)
+    async def send_segment(self, b0: int, k_seg, v_seg,
+                           ks=None, vs=None) -> None:
+        # the in-process pipe never quantizes (its segments stay
+        # device-resident — quantizing would ADD work, not save wire)
+        await self._asm.add_segment(b0, k_seg, v_seg, ks, vs)
         self.segments += 1
 
     async def finish(self, first_token: int, first_lp: Optional[dict] = None) -> None:

@@ -30,6 +30,8 @@ from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .protocols import RemotePrefillRequest
 from .queue import PrefillQueue
 from .transfer import (
+    KV_QUANT_WIRE_VERSION,
+    KV_STREAM_BASE_VERSION,
     KV_STREAM_VERSION,
     KvStreamSender,
     KvTransferServer,
@@ -81,6 +83,14 @@ class PrefillWorker:
         # the scatter resolve a foreign placement implicitly. Any
         # mismatch silently keeps the plain streamed/TCP path.
         self.kv_ici = kv_ici
+        # per-block wire quantization (engine/kvquant.py, the engine's
+        # --kv-quant mode): TCP handoffs ship int8/fp8 payloads + scale
+        # frames to decode peers that advertised the kv_quant
+        # capability — half the DCN bytes per handoff. Local-pipe and
+        # ICI handoffs stay full width (they never serialize), and
+        # legacy peers get dequantized full-width bytes. getattr: test
+        # harnesses wrap engines whose cfg predates the knob.
+        self.kv_quant = getattr(engine.cfg, "kv_quant", "none")
         # consume-loop fan-out: with the engine's streamed extract taking
         # the device lock per CHUNK, N concurrent prompts interleave
         # chunk-wise and each streams its segments as its own chunks
@@ -99,7 +109,21 @@ class PrefillWorker:
             "prefills_total": 0, "prefill_errors": 0, "nacks": 0,  # dynlint: disable=unscraped-stat -- prefill-role diagnostics; the scrape plane describes decode workers
             "kv_stream_sends": 0, "kv_stream_segments": 0, "kv_bulk_sends": 0,  # dynlint: disable=unscraped-stat -- prefill-role diagnostics; the scrape plane describes decode workers
             "kv_ici_sends": 0,  # dynlint: disable=unscraped-stat -- prefill-role diagnostics; the scrape plane describes decode workers
+            "kv_quant_sends": 0,  # dynlint: disable=unscraped-stat -- prefill-role diagnostic; the decode-side tier counters are the gauges
         }
+
+    def _wire_quant(self, connection: dict, local: bool) -> str:
+        """Negotiated wire codec for one handoff: this worker's
+        --kv-quant mode, IF the channel serializes (never the local
+        pipe) and the decode peer advertised the kv_quant capability.
+        Everything else — legacy peers above all — gets full width."""
+        if (
+            self.kv_quant != "none"
+            and not local
+            and int(connection.get("kv_quant") or 0) >= KV_QUANT_WIRE_VERSION
+        ):
+            return self.kv_quant
+        return "none"
 
     def start(self) -> None:
         if not self._tasks:
@@ -209,12 +233,15 @@ class PrefillWorker:
                 # one instead of failing the request deterministically
                 raise TransferError("local connection without pipe")
             # graceful downgrade: stream only when the decode peer
-            # advertised a protocol version covering ours — an old peer
-            # (no kv_stream key, or a lower version) silently gets the
-            # bulk protocol it already speaks
+            # advertised a protocol version covering the BASE streamed
+            # layout — an old peer (no kv_stream key, or below the
+            # base) silently gets the bulk protocol it already speaks.
+            # v1 peers still take v2 streams (the v2 scale frames only
+            # engage behind the separate kv_quant capability below)
             streamed = (
                 self.kv_stream
-                and int(rpr.connection.get("kv_stream") or 0) >= KV_STREAM_VERSION
+                and int(rpr.connection.get("kv_stream") or 0)
+                >= KV_STREAM_BASE_VERSION
                 and hasattr(self.engine, "prefill_extract_stream")
                 and (local or has_addr or not rpr.connection.get("local"))
             )
@@ -240,6 +267,19 @@ class PrefillWorker:
             self.stats["prefills_total"] += 1
             layout = self.head_layout
             tp = self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1
+            wire_q = self._wire_quant(rpr.connection, local)
+            k_scales = v_scales = None
+            if wire_q != "none" and k is not None and k.shape[2]:
+                from ..engine import kvquant
+
+                # multi-MB per-block quantize: executor thread, like the
+                # d2h it follows — half the DCN bytes for the send below
+                k, v, k_scales, v_scales = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, kvquant.quantize_stack, k, v, wire_q
+                    )
+                )
+                self.stats["kv_quant_sends"] += 1
             await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
             send_span = tracing.span(
                 "prefill.kv_send", request_id=rpr.request_id,
@@ -257,7 +297,8 @@ class PrefillWorker:
                         await send_kv_blocks(
                             rpr.connection, rpr.request_id, first, k, v,
                             layer_chunk=self.layer_chunk, head_layout=layout, src_tp=tp,
-                            first_lp=first_lp,
+                            first_lp=first_lp, kv_quant=wire_q,
+                            k_scales=k_scales, v_scales=v_scales,
                         )
                 except (TransferError, FaultInjected):
                     raise
@@ -313,17 +354,32 @@ class PrefillWorker:
             ici_negotiated(rpr.connection, engine, enabled=self.kv_ici)
             and layout == rpr.connection.get("ici_layout", layout)
         )
+        # streamed wire quantization: negotiated like the bulk path,
+        # plus the receiver must speak the v2 frame layout; ICI
+        # handoffs stay full width (their segments land device→device
+        # through the mover — quantizing would add a host round-trip)
+        wire_q = self._wire_quant(rpr.connection, local)
+        if ici or int(rpr.connection.get("kv_stream") or 0) < KV_STREAM_VERSION:
+            wire_q = "none"
+        if wire_q != "none":
+            from ..engine.kvquant import quant_dtype
+
+            wire_dtype = str(quant_dtype(wire_q))
+        else:
+            wire_dtype = str(kc.dtype)
         head = {
             "request_id": rpr.request_id,
             "stream": KV_STREAM_VERSION,
             "n_blocks": n,
             "shape": [kc.shape[0], kc.shape[1], n, kc.shape[3], kc.shape[4]],
             "v_shape": [vc.shape[0], vc.shape[1], n, vc.shape[3], vc.shape[4]],
-            "dtype": str(kc.dtype),
+            "dtype": wire_dtype,
             "layer_chunk": self.layer_chunk,
             "head_layout": layout,
             "src_tp": tp,
         }
+        if wire_q != "none":
+            head["kv_quant"] = wire_q
         if ici:
             from ..parallel.mesh import slice_fingerprint
 
@@ -399,12 +455,27 @@ class PrefillWorker:
         async def on_segment(b0: int, k_seg, v_seg) -> None:
             await faultpoints.hit("mid_kv_transfer", request_id=rpr.request_id)
             if not local:
-                # segment-sized (multi-MB) device->host materialization:
-                # off the loop, or the whole engine freezes for the copy
+                # segment-sized (multi-MB) device->host materialization
+                # (+ the per-block wire quantize when negotiated): off
+                # the loop, or the whole engine freezes for the copy
                 # while prefill compute should be hiding it
-                k_seg, v_seg = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: (np.asarray(k_seg), np.asarray(v_seg))
+                def _materialize():
+                    k_np, v_np = np.asarray(k_seg), np.asarray(v_seg)
+                    if wire_q != "none":
+                        from ..engine import kvquant
+
+                        return kvquant.quantize_stack(k_np, v_np, wire_q)
+                    return k_np, v_np, None, None
+                k_np, v_np, ks, vs = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, _materialize
+                    )
                 )
+                if ks is not None:
+                    await put_or_fail((b0, k_np, v_np, ks, vs))
+                    return
+                await put_or_fail((b0, k_np, v_np))
+                return
             await put_or_fail((b0, k_seg, v_seg))
 
         ok = False
@@ -438,6 +509,8 @@ class PrefillWorker:
             self.stats["kv_stream_sends"] += 1
             if ici:
                 self.stats["kv_ici_sends"] += 1
+            if wire_q != "none":
+                self.stats["kv_quant_sends"] += 1
             # exposed = the post-compute tail (final drain + fin + ack);
             # hidden = ACTUAL send activity that overlapped compute (the
             # pump's measured per-segment send time minus the part that
@@ -446,7 +519,11 @@ class PrefillWorker:
             # ttft.py folds these into the PR 2 decomposition
             now = time.perf_counter()
             exposed_ms = (now - t_done) * 1e3
-            nbytes = n * getattr(engine, "kv_block_bytes", 0)
+            nbytes = n * (
+                getattr(engine, "kv_wire_block_bytes", 0)
+                if wire_q != "none"
+                else getattr(engine, "kv_block_bytes", 0)
+            )
             send_span.set(
                 exposed_ms=round(exposed_ms, 3),
                 hidden_ms=round(max(send_ms - exposed_ms, 0.0), 3),
@@ -467,8 +544,27 @@ class PrefillWorker:
         finally:
             if not pump_task.done():
                 pump_task.cancel()
+                # cancel alone is NOT enough: if it lands while the pump
+                # awaits a segment scatter riding run_in_executor, the
+                # executor future is uncancellable once its fn is running
+                # — asyncio swallows the cancellation waiting it out, and
+                # the pump then parks on sendq.get() forever, deadlocking
+                # this drain against a producer that is already unwinding
+                # (found as a ~40% hang of the mid-stream kill tests).
+                # Feed the shutdown sentinel so a cancel-surviving pump
+                # exits through its normal path (pending segments are
+                # discarded — this attempt is abandoned, and no-ack means
+                # the queue redelivers it whole), and bound the drain so
+                # teardown can never wedge the consume loop regardless.
+                while not sendq.empty():
+                    sendq.get_nowait()
                 try:
-                    await pump_task
+                    sendq.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass  # pump is mid-get of the last item; the
+                    # sentinel slot frees by the time it looks again
+                try:
+                    await asyncio.wait_for(pump_task, SEGMENT_SEND_TIMEOUT_S)
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
             if not ok:
@@ -573,7 +669,8 @@ class _RemoteScatterSink:
         self.segments = 0
         return True
 
-    async def segment(self, b0: int, k_seg, v_seg) -> None:
+    async def segment(self, b0: int, k_seg, v_seg,
+                      k_scales=None, v_scales=None) -> None:
         async with self._lock:
             if self._closed:
                 raise SinkClosed(self._handle.seq.context.id)
@@ -582,7 +679,9 @@ class _RemoteScatterSink:
 
                 src_tp, dst_tp, sl, dl = self._regroup
                 # pure head-axis gather; on device-resident segments
-                # (local pipe) XLA fuses it into the scatter
+                # (local pipe) XLA fuses it into the scatter. Valid on
+                # quantized payloads unchanged: the codec's scales are
+                # per (layer, block) — deliberately kv-head-free
                 k_seg = rearrange_for_decode(k_seg, src_tp, dst_tp, sl, dl)
                 v_seg = rearrange_for_decode(v_seg, src_tp, dst_tp, sl, dl)
                 self._stats["kv_stream_regroups"] = (
@@ -598,9 +697,16 @@ class _RemoteScatterSink:
                 self._stats["ici_segments"] = (
                     self._stats.get("ici_segments", 0) + 1
                 )
-            await self._engine.scatter_remote_segment(
-                self._handle, b0, k_seg, v_seg
-            )
+            if k_scales is not None:
+                await self._engine.scatter_remote_segment(
+                    self._handle, b0, k_seg, v_seg, k_scales, v_scales
+                )
+            else:
+                # positional-compat with the pre-quant signature:
+                # full-width segments keep the 4-arg call shape
+                await self._engine.scatter_remote_segment(
+                    self._handle, b0, k_seg, v_seg
+                )
             if self._ici is not None:
                 # the moved+scattered wall is the decode side's honest
                 # per-segment ICI cost — folding it into the engine's
@@ -683,6 +789,14 @@ class DisaggEngine(AsyncEngine):
             conn = self.transfer.address.to_dict()
         if self.kv_stream:
             conn["kv_stream"] = KV_STREAM_VERSION
+        if self.engine.mirror is None:
+            # wire-codec capability: this decode side dequantizes
+            # int8/fp8 deliveries on landing (scales through the
+            # device-side scatter), independent of its OWN --kv-quant
+            # mode. Mirror-backed engines scatter via lockstep
+            # broadcasts that are full-width only — they must not
+            # advertise it.
+            conn["kv_quant"] = KV_QUANT_WIRE_VERSION
         if self.kv_ici and self.kv_stream and self.engine.mirror is None:
             from ..parallel.mesh import slice_fingerprint
             from .ici import KV_ICI_VERSION
@@ -847,6 +961,8 @@ class DisaggEngine(AsyncEngine):
             from ..ops.kv_rearrange import rearrange_for_decode
 
             try:
+                # head-axis permutation only — valid on quantized
+                # payloads as-is (the block scales are kv-head-free)
                 k_data = rearrange_for_decode(
                     k_data, delivery.src_tp, my_tp, delivery.head_layout, my_layout
                 )
@@ -862,6 +978,7 @@ class DisaggEngine(AsyncEngine):
         out_queue = await self.engine.complete_remote(
             handle, delivery.first_token, k_data, v_data,
             first_lp=delivery.first_lp,
+            k_scales=delivery.k_scales, v_scales=delivery.v_scales,
         )
         while True:
             out = await out_queue.get()

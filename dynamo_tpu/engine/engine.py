@@ -225,8 +225,20 @@ class EngineConfig:
     quant_experts: bool = True
     # KV cache storage dtype: "model" | "float8_e4m3" | "bfloat16"
     # (float8 = scale-free direct cast, vLLM fp8-KV approach; halves KV
-    # HBM traffic + doubles cache capacity at some quality cost)
+    # HBM traffic + doubles cache capacity at some quality cost). A
+    # quantized cache still runs the Pallas ragged kernels — the
+    # dequant cast fuses into the kernels' KV page loads
+    # (_use_pallas_for; ops/ragged_paged_attention_pallas.py)
     kv_cache_dtype: str = "model"
+    # per-block KV quantization for the OFFLOAD tiers and the wire
+    # (engine/kvquant.py): "none" | "int8" | "fp8". Blocks entering the
+    # host pool / disk tier / peer-pull + disagg wire are stored and
+    # shipped int8/fp8 with per-(layer, block) scales and dequantized
+    # in the device-side scatter on restore — ~2x effective capacity
+    # of every tier and the wire at once, at a measured (NOT zero)
+    # logprob drift (kvquant.measure_logprob_drift gates it). Opt-in
+    # per model; "none" keeps every plane bit-exact full width.
+    kv_quant: str = "none"
     # sequence-parallel long-prompt prefill: prompts at least this many
     # tokens go through ring attention over the mesh's sp axis as ONE
     # history-free chunk (parallel/ring_attention.py) instead of chunked
@@ -277,6 +289,13 @@ class EngineConfig:
             raise ValueError(
                 f"mixed_max_prefills={self.mixed_max_prefills} must be "
                 ">= 1 (1 = single-prefill fused steps)"
+            )
+        from .kvquant import KV_QUANT_MODES
+
+        if self.kv_quant not in KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quant must be one of {KV_QUANT_MODES}, "
+                f"got {self.kv_quant!r}"
             )
         self.max_blocks_per_seq = (
             self.max_context + self.block_size - 1
@@ -371,6 +390,28 @@ class JaxEngine(AsyncEngine):
                 k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
+        # transfer-cost calibration (kv_router/costmodel.py): one model
+        # per engine, fed by the restore/pull/handoff/prefill paths and
+        # advertised through load_metrics. Block bytes from the real
+        # cache geometry (k and v differ for MLA latents).
+        self.kv_block_bytes = int(
+            (self.k_cache.nbytes + self.v_cache.nbytes)
+            // max(cfg.num_blocks, 1)
+        )
+        # bytes one block costs on the TIER/WIRE planes: the full-width
+        # size, or the quantized payload + per-layer scales under
+        # --kv-quant (engine/kvquant.py). Advertised alongside
+        # kv_block_bytes so routing prices restore/pull legs at the
+        # bytes that actually move
+        from .kvquant import wire_block_bytes as _wire_bb
+
+        self.kv_wire_block_bytes = _wire_bb(
+            self.kv_block_bytes, self.k_cache.dtype.itemsize,
+            mcfg.num_layers,
+            # mirror-backed engines force the tier codec off (lockstep
+            # broadcasts are full-width only) — advertise accordingly
+            cfg.kv_quant if mirror is None else "none",
+        )
         self.offload: Optional[OffloadManager] = None
         if cfg.host_cache_blocks > 0:
             # under the multi-host mirror, flush/restore become mirrored
@@ -382,20 +423,15 @@ class JaxEngine(AsyncEngine):
                 disk_blocks=cfg.disk_cache_blocks,
                 disk_path=cfg.disk_cache_path,
                 tier_ttl_s=cfg.kv_tier_ttl_s,
+                kv_quant=cfg.kv_quant,
+                block_bytes=self.kv_block_bytes,
+                full_dtype=str(self.k_cache.dtype),
             )
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
             # tier-drop removals re-check device residency before
             # publishing (offload.flush_dropped): a stale lower-tier
             # copy aging out must not un-index a device-resident block
             self.offload.device_has = self.allocator.has_hash
-        # transfer-cost calibration (kv_router/costmodel.py): one model
-        # per engine, fed by the restore/pull/handoff/prefill paths and
-        # advertised through load_metrics. Block bytes from the real
-        # cache geometry (k and v differ for MLA latents).
-        self.kv_block_bytes = int(
-            (self.k_cache.nbytes + self.v_cache.nbytes)
-            // max(cfg.num_blocks, 1)
-        )
         self.cost = None
         if cfg.kv_cost_model:
             from ..kv_router.costmodel import TransferCostModel
@@ -403,6 +439,9 @@ class JaxEngine(AsyncEngine):
             self.cost = TransferCostModel(block_bytes=self.kv_block_bytes)
             if self.offload is not None:
                 self.offload.cost_model = self.cost
+        # one-time dispatch-capability log for quantized device caches
+        # (set before the first _use_pallas_for derivation below)
+        self._kvq_dispatch_logged = False
         self.use_pallas = self._use_pallas_for(self.mesh)
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         # re-admissions (preemption replay, backpressure put-back) jump
@@ -504,6 +543,10 @@ class JaxEngine(AsyncEngine):
             "resharded_total": 0,
             "reshard_kv_moved_blocks": 0,
             "reshard_hold_ms": 0.0,
+            # worst chosen-token logprob drift the kv-quant harness
+            # (engine/kvquant.measure_logprob_drift) recorded against
+            # this engine's quantized tiers; 0 until a harness ran
+            "kv_quant_logprob_drift_max": 0.0,
         }
 
     def _use_pallas_for(self, mesh) -> bool:
@@ -516,12 +559,37 @@ class JaxEngine(AsyncEngine):
         where tp=1 allowed it."""
         cfg = self.cfg
         tp = mesh.shape["tp"] if mesh is not None else 1
+        # EXPLICIT quantized-KV capability check (was a silent dtype
+        # opt-out): the non-MLA ragged/decode/prefill kernels consume
+        # int8/fp8 pages directly — the dequant cast fuses into their
+        # KV page loads — so a quantized cache keeps the Pallas path.
+        # The MLA latent kernels are still bf16/f32-only (the absorbed
+        # latent matmuls were never validated at sub-bf16), so MLA +
+        # quantized cache stays on the XLA fallback, loudly.
+        kv_dt = self.k_cache.dtype
+        kv_quantized = kv_dt not in (jnp.bfloat16, jnp.float32)
+        kv_dtype_ok = not kv_quantized or (
+            not cfg.model.is_mla
+            and kv_dt in (jnp.float8_e4m3fn, jnp.int8)
+        )
+        if kv_quantized and not self._kvq_dispatch_logged:
+            self._kvq_dispatch_logged = True
+            if kv_dtype_ok:
+                logger.info(
+                    "quantized KV cache (%s): Pallas kernels stay engaged "
+                    "— dequant fused into the ragged kernels' page loads",
+                    kv_dt,
+                )
+            else:
+                logger.info(
+                    "quantized KV cache (%s) on an MLA model: falling "
+                    "back to the XLA attention path (latent kernels are "
+                    "bf16/f32-only)", kv_dt,
+                )
         return (
             jax.default_backend() == "tpu"
             and cfg.block_size % 8 == 0
-            # quantized KV caches take the XLA path (which casts on read);
-            # the Mosaic kernels assume bf16/f32 page tiles
-            and self.k_cache.dtype in (jnp.bfloat16, jnp.float32)
+            and kv_dtype_ok
             and (
                 (
                     not cfg.model.is_mla
@@ -783,6 +851,14 @@ class JaxEngine(AsyncEngine):
             # the router needs to convert this worker's overlap depths
             # into predicted TTFT milliseconds
             "kv_block_bytes": self.kv_block_bytes,
+            # tier/wire bytes per block under --kv-quant (== the full
+            # width when the codec is off): what restore/pull legs
+            # actually move, so the router prices them at these bytes
+            "kv_wire_block_bytes": self.kv_wire_block_bytes,
+            # the kv-quant quality gate's worst observed drift (set by
+            # kvquant.measure_logprob_drift runs against this engine)
+            "kv_quant_logprob_drift_max": self.stats[
+                "kv_quant_logprob_drift_max"],
             "kv_block_size": self.cfg.block_size,
             "kv_slice_fp": self._slice_fp(),
             # the ACTUALLY-deployed TP degree: seeds the planner's
@@ -1613,8 +1689,10 @@ class JaxEngine(AsyncEngine):
                     request_id=seq.context.id,
                     blocks=len(upload.hashes),
                     # restore volume: lets ttft.cost_observations replay
-                    # this span into a TransferCostModel ("host" class)
-                    nbytes=len(upload.hashes) * self.kv_block_bytes,
+                    # this span into a TransferCostModel ("host" class);
+                    # wire bytes — what the h2d actually moved under
+                    # --kv-quant
+                    nbytes=len(upload.hashes) * self.kv_wire_block_bytes,
                     exposed_ms=round(exposed_ms, 3),
                     hidden_ms=round(max(total_ms - exposed_ms, 0.0), 3),
                 )
@@ -3390,11 +3468,15 @@ class JaxEngine(AsyncEngine):
         k_data: Optional[np.ndarray],
         v_data: Optional[np.ndarray],
         first_lp: Optional[dict] = None,
+        k_scales: Optional[np.ndarray] = None,
+        v_scales: Optional[np.ndarray] = None,
     ) -> asyncio.Queue:
         """KV landed from the prefill worker: scatter it into the
         pre-allocated pages, register the sequence for continuous-batching
         decode, emit the (already sampled) first token with the logprob
-        entry the prefill worker computed for it (if requested)."""
+        entry the prefill worker computed for it (if requested).
+        ``k_scales``/``v_scales`` ([L, n] f32) mark a quantized wire
+        delivery — the dequant fuses into the device-side scatter."""
         seq = handle.seq
         if k_data is not None and k_data.shape[2]:
             n = int(k_data.shape[2])
@@ -3404,7 +3486,8 @@ class JaxEngine(AsyncEngine):
             ]
             async with self._device_lock:
                 await asyncio.get_running_loop().run_in_executor(
-                    None, self._scatter_device, idxs, k_data, v_data
+                    None, self._scatter_device, idxs, k_data, v_data,
+                    k_scales, v_scales,
                 )
         self.stats["prefix_cache_hits_tokens"] += seq.cached_prefix
         self._emit_token(seq, first_token, first_lp)
@@ -3415,7 +3498,8 @@ class JaxEngine(AsyncEngine):
         return seq.out_queue
 
     async def scatter_remote_segment(
-        self, handle: "RemoteHandle", b0: int, k_data, v_data
+        self, handle: "RemoteHandle", b0: int, k_data, v_data,
+        k_scales=None, v_scales=None,
     ) -> None:
         """Streamed disagg landing (decode side): scatter ONE segment's
         blocks into the pre-allocated reservation the moment it arrives,
@@ -3442,23 +3526,29 @@ class JaxEngine(AsyncEngine):
         idxs = [b.idx for b in blocks]
         async with self._device_lock:
             await asyncio.get_running_loop().run_in_executor(
-                None, self._scatter_segment_device, idxs, k_data, v_data
+                None, self._scatter_segment_device, idxs, k_data, v_data,
+                k_scales, v_scales,
             )
 
-    def _scatter_segment_device(self, idxs: list[int], k_data, v_data) -> None:
+    def _scatter_segment_device(self, idxs: list[int], k_data, v_data,
+                                k_scales=None, v_scales=None) -> None:
         from .offload import _pad_idxs
 
         bucket = len(_pad_idxs(idxs))
         if int(k_data.shape[2]) < bucket:
             pad = [(0, 0)] * k_data.ndim
             pad[2] = (0, bucket - int(k_data.shape[2]))
+            spad = ((0, 0), (0, bucket - int(k_data.shape[2])))
             if isinstance(k_data, np.ndarray):
                 k_data = np.pad(k_data, pad)
                 v_data = np.pad(v_data, pad)
+                if k_scales is not None:
+                    k_scales = np.pad(np.asarray(k_scales, np.float32), spad)
+                    v_scales = np.pad(np.asarray(v_scales, np.float32), spad)
             else:  # device-resident segment (LocalKvPipe)
                 k_data = jnp.pad(k_data, pad)
                 v_data = jnp.pad(v_data, pad)
-        self._scatter_device(idxs, k_data, v_data)
+        self._scatter_device(idxs, k_data, v_data, k_scales, v_scales)
 
     def abort_remote(self, handle: "RemoteHandle", message: str = "") -> None:
         seq = handle.seq
@@ -3470,9 +3560,11 @@ class JaxEngine(AsyncEngine):
         )
 
     def _scatter_device(
-        self, idxs: list[int], k_data: np.ndarray, v_data: np.ndarray
+        self, idxs: list[int], k_data: np.ndarray, v_data: np.ndarray,
+        k_scales: Optional[np.ndarray] = None,
+        v_scales: Optional[np.ndarray] = None,
     ) -> None:
-        from .offload import _pad_idxs, _scatter_blocks
+        from .offload import _pad_idxs, _scatter_blocks, _scatter_blocks_q
 
         if self.offload is not None:
             # pending evictions may reference the very pages we're about to
@@ -3483,7 +3575,10 @@ class JaxEngine(AsyncEngine):
         if self.mirror is not None:
             # mirrored landing: broadcast the UNPADDED host blocks (the
             # scatter core pads on device), every process scatters its
-            # cache shards in lockstep
+            # cache shards in lockstep. Quantized wire deliveries never
+            # reach mirrors (the negotiation requires the capability,
+            # which mirror-backed engines do not advertise).
+            assert k_scales is None, "mirror landings are full-width"
             self.k_cache, self.v_cache = self.mirror.lead_kv_scatter(
                 self.k_cache, self.v_cache, padded,
                 np.asarray(k_data), np.asarray(v_data),
@@ -3491,6 +3586,15 @@ class JaxEngine(AsyncEngine):
             return
         # only real blocks ship over PCIe — the scatter core pads the
         # stack to the bucketed index count on device
+        if k_scales is not None:
+            # quantized delivery: dequant fuses into the donated scatter
+            self.k_cache, self.v_cache = _scatter_blocks_q(
+                self.k_cache, self.v_cache, jnp.asarray(padded),
+                jnp.asarray(k_data), jnp.asarray(v_data),
+                jnp.asarray(np.asarray(k_scales, np.float32)),
+                jnp.asarray(np.asarray(v_scales, np.float32)),
+            )
+            return
         self.k_cache, self.v_cache = _scatter_blocks(
             self.k_cache,
             self.v_cache,

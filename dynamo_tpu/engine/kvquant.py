@@ -1,0 +1,226 @@
+"""Per-block KV quantization codec for the offload tiers and the wire.
+
+Every KV boundary PR 9/11 built is bandwidth-bound — d2h flush, disk
+write, peer pull over TCP, h2d restore, streamed disagg handoff
+("Understanding Bottlenecks for Efficiently Serving LLM Inference With
+KV Offloading", PAPERS.md) — so storing and shipping KV blocks at
+int8/fp8 instead of bf16 roughly doubles the effective capacity of the
+host pool, the disk tier and the wire *at once*, compounding the fleet
+prefix cache (ROADMAP item 3).
+
+Scheme — symmetric absmax, ONE scale per (layer, block) per K/V:
+
+    scale[l, b] = max(|x[l, :, b, :, :]|) / qmax        (f32)
+    q[l, h, b, :, :] = round(x / scale[l, b])           (int8 | fp8_e4m3)
+
+Coarser than per-channel (the weight path in models/quant.py) because
+a *block* is the unit every tier and wire plane already moves — the
+scale rides the block through demotion, disk headers, peer pulls and
+stream frames without any re-grouping, and the kv-head axis stays
+scale-free so the ``kv_rearrange`` head permutation and tp regrouping
+apply to quantized payloads unchanged. ``fp8`` keeps the scale too
+(scaled e4m3, not the device cache's scale-free direct cast): the
+scale recenters each block's dynamic range onto the format's ±448
+span, which measurably tightens logprob drift on small-magnitude V
+blocks.
+
+The DEVICE cache's quantization remains ``EngineConfig.kv_cache_dtype``
+(scale-free fp8 cast — per-element, so decode's single-token appends
+need no block rescale); this codec covers every plane that moves KV
+*bytes* off the device. The two compose: a quantized device cache
+gathers fp8 blocks, which this codec re-quantizes for the tiers with
+explicit scales, and restores dequantize back to the cache dtype on
+the device-side scatter.
+
+Quality is gated honestly: the tier round-trip is NOT bit-exact, so
+:func:`measure_logprob_drift` ships alongside the codec — greedy-token
+agreement plus max/mean chosen-token logprob delta against a bf16
+reference on fixed prompts — and the ``--kv-quant`` opt-in defaults to
+``"none"``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+#: tier/wire KV codec modes (EngineConfig.kv_quant / --kv-quant):
+#: "int8" = symmetric absmax int8 + f32 block scales, "fp8" = scaled
+#: float8_e4m3fn + f32 block scales, "none" = full-width passthrough
+KV_QUANT_MODES = ("none", "int8", "fp8")
+
+_EPS = 1e-12
+
+
+def quant_dtype(mode: str) -> np.dtype:
+    if mode == "int8":
+        return np.dtype(np.int8)
+    if mode == "fp8":
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(ml_dtypes.float8_e4m3fn)
+    raise ValueError(f"kv_quant must be one of {KV_QUANT_MODES[1:]}, got {mode!r}")
+
+
+def _qmax(mode: str) -> float:
+    return 127.0 if mode == "int8" else 448.0
+
+
+def _quantize(x: np.ndarray, axes: tuple, mode: str):
+    """Core: absmax over ``axes`` (everything but layer + block), scale
+    per remaining (layer[, block]) coordinate."""
+    dt, qmax = quant_dtype(mode), _qmax(mode)
+    xf = np.asarray(x, np.float32)
+    scale = np.maximum(
+        np.max(np.abs(xf), axis=axes) / qmax, _EPS
+    ).astype(np.float32)
+    q = xf / np.expand_dims(scale, axes)
+    if mode == "int8":
+        q = np.clip(np.rint(q), -127, 127)
+    return np.ascontiguousarray(q.astype(dt)), scale
+
+
+def quantize_stack(k: np.ndarray, v: np.ndarray, mode: str):
+    """Quantize a block stack pair ([L, H, n, bs, D] each; k and v may
+    have different H/D — MLA latents). Returns (qk, qv, ks, vs) with
+    scales [L, n] f32 — one scale per block per layer per K/V."""
+    qk, ks = _quantize(k, (1, 3, 4), mode)
+    qv, vs = _quantize(v, (1, 3, 4), mode)
+    return qk, qv, ks, vs
+
+
+def dequantize_stack(qk, qv, ks, vs, dtype):
+    """Invert :func:`quantize_stack` back to full-width ``dtype``."""
+    dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    k = np.asarray(qk, np.float32) * np.asarray(ks, np.float32)[:, None, :, None, None]
+    v = np.asarray(qv, np.float32) * np.asarray(vs, np.float32)[:, None, :, None, None]
+    return k.astype(dt), v.astype(dt)
+
+
+def quantize_entry(k: np.ndarray, v: np.ndarray, mode: str):
+    """Quantize ONE block ([L, H, bs, D] pair) — the host-pool / disk
+    entry form. Scales are [L] f32 per K/V."""
+    qk, ks = _quantize(k, (1, 2, 3), mode)
+    qv, vs = _quantize(v, (1, 2, 3), mode)
+    return qk, qv, ks, vs
+
+
+def dequantize_entry(qk, qv, ks, vs, dtype):
+    dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    k = np.asarray(qk, np.float32) * np.asarray(ks, np.float32)[:, None, None, None]
+    v = np.asarray(qv, np.float32) * np.asarray(vs, np.float32)[:, None, None, None]
+    return k.astype(dt), v.astype(dt)
+
+
+def entry_nbytes(entry: tuple) -> int:
+    """Bytes one pool/staging entry actually occupies (payload + any
+    scale vectors) — the unit of the tiers' byte budgets."""
+    n = entry[0].nbytes + entry[1].nbytes
+    if len(entry) > 2 and entry[2] is not None:
+        n += entry[2].nbytes + entry[3].nbytes
+    return n
+
+
+def wire_block_bytes(block_bytes: int, itemsize: int, layers: int,
+                     mode: str) -> int:
+    """Bytes ONE block costs on the tier/wire planes under ``mode``:
+    the payload collapses to 1 byte/element, plus the per-layer f32
+    scale pair. ``block_bytes`` is the full-width per-block size
+    (engine.kv_block_bytes) and ``itemsize`` the cache dtype's width —
+    what the routing plane advertises so restore/pull legs are priced
+    at the bytes that actually move (kv_router/costmodel.py)."""
+    if mode in (None, "none"):
+        return int(block_bytes)
+    elems = block_bytes // max(itemsize, 1)
+    return int(elems * quant_dtype(mode).itemsize + 2 * layers * 4)
+
+
+# ---------------- logprob-drift harness (the quality gate) ----------------
+
+
+async def measure_logprob_drift(
+    ref_engine,
+    quant_engine,
+    prompts: list,
+    max_tokens: int = 16,
+    park=None,
+) -> dict:
+    """Greedy-token agreement + chosen-token logprob drift of a
+    quantized-tier engine against a full-width reference, on a fixed
+    prompt set.
+
+    Protocol per prompt: the reference engine serves it cold (greedy,
+    chosen-token logprobs on). The quantized engine serves it once to
+    populate the KV, then ``park(quant_engine, prompt)`` (caller-
+    provided) churns the prefix out of the device pool and into the
+    quantized host/disk tiers, and the prompt is served AGAIN — its
+    prefix now restored through the quantize→dequantize round-trip —
+    which is the stream actually compared. Without ``park`` the second
+    serve still exercises whatever tier traffic the engine's pool
+    pressure produces.
+
+    Bit-exactness is off the table by construction; this measures what
+    the codec actually costs where it matters: the emitted tokens and
+    their logprobs. The max drift is recorded on the quantized engine
+    (``stats["kv_quant_logprob_drift_max"]``) so it rides load_metrics
+    → WorkerLoad → the metrics component like any other gauge.
+    """
+    import asyncio as _asyncio  # noqa: F401  (callers run us in a loop)
+
+    from ..protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from ..runtime.engine import Context
+
+    def req(toks):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0,
+                                             logprobs=0),
+            eos_token_ids=[],
+        )
+
+    async def serve(engine, toks):
+        out_toks, out_lps = [], []
+        async for o in engine.generate(Context(req(toks))):
+            out_toks.extend(o.token_ids)
+            for lp in o.logprobs or []:
+                out_lps.append(float(lp["logprob"]))
+        return out_toks, out_lps
+
+    agree = total = 0
+    deltas: list[float] = []
+    for toks in prompts:
+        ref_toks, ref_lps = await serve(ref_engine, toks)
+        await serve(quant_engine, toks)  # populate the quantized tiers
+        if park is not None:
+            await park(quant_engine, toks)
+        q_toks, q_lps = await serve(quant_engine, toks)
+        n = min(len(ref_toks), len(q_toks))
+        total += n
+        for i in range(n):
+            if ref_toks[i] == q_toks[i]:
+                agree += 1
+        for a, b in zip(ref_lps, q_lps):
+            deltas.append(abs(a - b))
+    drift_max = max(deltas) if deltas else 0.0
+    result = {
+        "n_prompts": len(prompts),
+        "n_tokens": total,
+        "greedy_agreement": round(agree / total, 6) if total else 1.0,
+        "logprob_delta_max": round(drift_max, 6),
+        "logprob_delta_mean": (
+            round(sum(deltas) / len(deltas), 6) if deltas else 0.0
+        ),
+    }
+    stats = getattr(quant_engine, "stats", None)
+    if stats is not None:
+        stats["kv_quant_logprob_drift_max"] = max(
+            float(stats.get("kv_quant_logprob_drift_max", 0.0)), drift_max
+        )
+    return result

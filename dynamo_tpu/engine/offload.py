@@ -86,6 +86,8 @@ import numpy as np
 # shared with the wire codec (disagg/transfer.py) so the two
 # serialization planes can't drift on which dtypes round-trip
 from ..utils.dtypes import np_dtype as _resolve_dtype
+from . import kvquant
+from .kvquant import entry_nbytes
 
 logger = logging.getLogger(__name__)
 
@@ -157,9 +159,34 @@ def stack_pieces(entries: list, which: int) -> list[np.ndarray]:
     ]
 
 
+def scatter_blocks_q_core(k_cache, v_cache, idxs, k_data, v_data, ks, vs):
+    """Quantized-restore twin of :func:`scatter_blocks_core`: the host
+    ships int8/fp8 payloads + per-(layer, block) f32 scales (HALF the
+    PCIe bytes of a full-width restore), and the dequantize fuses into
+    the device-side scatter. Pad rows (scale 0) land zeros in trash
+    block 0 and never leave HBM."""
+    n, m = idxs.shape[0], k_data.shape[2]
+    if m < n:  # static at trace time
+        pad = [(0, 0)] * k_data.ndim
+        pad[2] = (0, n - m)
+        k_data = jnp.pad(k_data, pad)
+        v_data = jnp.pad(v_data, pad)
+        ks = jnp.pad(ks, ((0, 0), (0, n - m)))
+        vs = jnp.pad(vs, ((0, 0), (0, n - m)))
+    kd = k_data.astype(jnp.float32) * ks[:, None, :, None, None]
+    vd = v_data.astype(jnp.float32) * vs[:, None, :, None, None]
+    return (
+        k_cache.at[:, :, idxs].set(kd.astype(k_cache.dtype)),
+        v_cache.at[:, :, idxs].set(vd.astype(v_cache.dtype)),
+    )
+
+
 _gather_blocks = jax.jit(gather_blocks_core)
 _scatter_blocks = jax.jit(
     scatter_blocks_core, donate_argnames=("k_cache", "v_cache")
+)
+_scatter_blocks_q = jax.jit(
+    scatter_blocks_q_core, donate_argnames=("k_cache", "v_cache")
 )
 
 
@@ -181,21 +208,36 @@ class DiskKvStore:
     hash that leaves the store (LRU, TTL, corruption) is queued in
     ``drain_dropped`` so the owner can publish the residency loss.
 
+    Quantized tier (format v2): an entry may carry int8/fp8 payloads
+    plus the per-layer f32 scale vectors (engine/kvquant.py), declared
+    in the header (``quant``/``ks_bytes``/``vs_bytes``) and covered by
+    the same CRC — a truncated or corrupted scale section reads as a
+    clean miss exactly like a torn payload. v1 (pre-scale) entries are
+    clean misses by the existing version check. With ``block_bytes``
+    set, capacity becomes a BYTE budget (``capacity_blocks`` full-width
+    blocks' worth), so quantized entries pack ~2x the blocks into the
+    same disk footprint — that is the capacity win, made real.
+
     All methods do blocking filesystem I/O — callers must be on the
     offload executor (or an explicitly-off-loop backstop), never the
     serving event loop (the ``blocking-disk-io`` dynlint rule).
     """
 
     MAGIC = b"DKV1"
-    VERSION = 1
+    VERSION = 2
 
-    def __init__(self, path: str, capacity_blocks: int, ttl_s: float = 0.0):
+    def __init__(self, path: str, capacity_blocks: int, ttl_s: float = 0.0,
+                 block_bytes: int = 0):
         self.path = path
         self.capacity = capacity_blocks
         self.ttl_s = ttl_s
+        #: full-width per-block bytes; > 0 switches the LRU from entry
+        #: COUNT to a byte budget of capacity_blocks * block_bytes
+        self.block_bytes = int(block_bytes)
         self._lock = threading.Lock()
-        # seq_hash -> stored_at (monotonic); OrderedDict = LRU order
-        self._index: OrderedDict[int, float] = OrderedDict()
+        # seq_hash -> (stored_at monotonic, file bytes); OrderedDict = LRU
+        self._index: OrderedDict[int, tuple[float, int]] = OrderedDict()
+        self._used_bytes = 0
         self._dropped: list[int] = []
         self.stored_total = 0
         self.hit_blocks_total = 0
@@ -207,9 +249,27 @@ class DiskKvStore:
             if not name.endswith(".kvb"):
                 continue  # temp files from a crashed writer, etc.
             try:
-                self._index[int(name[:-4], 16)] = now
+                h = int(name[:-4], 16)
             except ValueError:
                 continue
+            # budget accounting counts PAYLOAD bytes (like the host
+            # pool's entry_nbytes): filesize minus magic + header, read
+            # back from the length prefix — charging the ~250B header
+            # would silently shave one full-width block off every
+            # byte-budgeted tier
+            f = os.path.join(path, name)
+            try:
+                sz = os.path.getsize(f)
+                with open(f, "rb") as fh:
+                    pre = fh.read(8)
+                hlen = (
+                    struct.unpack("<I", pre[4:8])[0] if len(pre) == 8 else 0
+                )
+                sz = max(sz - 8 - hlen, 0)
+            except OSError:
+                continue
+            self._index[h] = (now, sz)
+            self._used_bytes += sz
 
     def __len__(self) -> int:
         with self._lock:
@@ -223,7 +283,8 @@ class DiskKvStore:
         return os.path.join(self.path, f"{seq_hash:016x}.kvb")
 
     def _discard_locked(self, seq_hash: int, corrupt: bool = False) -> None:
-        self._index.pop(seq_hash, None)
+        _t, sz = self._index.pop(seq_hash, (0.0, 0))
+        self._used_bytes -= sz
         self._dropped.append(seq_hash)
         if corrupt:
             self.corrupt_discards += 1
@@ -238,13 +299,24 @@ class DiskKvStore:
         if self.ttl_s <= 0:
             return
         cutoff = time.monotonic() - self.ttl_s
-        expired = [h for h, t in self._index.items() if t < cutoff]
+        expired = [h for h, (t, _sz) in self._index.items() if t < cutoff]
         for h in expired:
             self._discard_locked(h)
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+    def _over_budget_locked(self, extra: int = 0) -> bool:
+        if self.block_bytes > 0:
+            return (
+                self._used_bytes + extra > self.capacity * self.block_bytes
+                and len(self._index) > 0
+            )
+        return len(self._index) > self.capacity
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+            scales: Optional[tuple] = None) -> bool:
         """Demote one block to disk; returns whether it is resident
-        afterwards (False = capacity 0 or the write failed)."""
+        afterwards (False = capacity 0 or the write failed). ``scales``
+        = (ks, vs) per-layer f32 vectors for a quantized payload
+        (engine/kvquant.py) — written as the v2 scale section."""
         if self.capacity <= 0:
             return False
         with self._lock:
@@ -254,6 +326,15 @@ class DiskKvStore:
                 return True
         k_bytes = np.ascontiguousarray(k).tobytes()
         v_bytes = np.ascontiguousarray(v).tobytes()
+        ks_bytes = vs_bytes = b""
+        if scales is not None:
+            ks_bytes = np.ascontiguousarray(
+                scales[0], dtype=np.float32).tobytes()
+            vs_bytes = np.ascontiguousarray(
+                scales[1], dtype=np.float32).tobytes()
+        crc = zlib.crc32(k_bytes)
+        for part in (v_bytes, ks_bytes, vs_bytes):
+            crc = zlib.crc32(part, crc)
         header = json.dumps({
             "v": self.VERSION,
             "hash": seq_hash,
@@ -262,7 +343,11 @@ class DiskKvStore:
             "dtype": str(k.dtype),
             "k_bytes": len(k_bytes),
             "v_bytes": len(v_bytes),
-            "crc": zlib.crc32(v_bytes, zlib.crc32(k_bytes)),
+            # quantized-entry scale section (0/absent = full-width):
+            # per-layer f32 absmax scales, one vector per K/V
+            "ks_bytes": len(ks_bytes),
+            "vs_bytes": len(vs_bytes),
+            "crc": crc,
         }).encode()
         final = self._file(seq_hash)
         try:
@@ -274,6 +359,8 @@ class DiskKvStore:
                     f.write(header)
                     f.write(k_bytes)
                     f.write(v_bytes)
+                    f.write(ks_bytes)
+                    f.write(vs_bytes)
                 os.replace(tmp, final)  # atomic: no half-written entries
             except BaseException:
                 try:
@@ -285,18 +372,29 @@ class DiskKvStore:
             logger.warning("disk tier write failed for %x (block dropped)",
                            seq_hash, exc_info=True)
             return False
+        # payload bytes only (header excluded — see the rescan comment)
+        nbytes = (len(k_bytes) + len(v_bytes)
+                  + len(ks_bytes) + len(vs_bytes))
         with self._lock:
-            self._index[seq_hash] = time.monotonic()
+            self._index[seq_hash] = (time.monotonic(), nbytes)
             self._index.move_to_end(seq_hash)
+            self._used_bytes += nbytes
             self.stored_total += 1
-            while len(self._index) > self.capacity:
-                old, _t = next(iter(self._index.items()))
+            while self._over_budget_locked() and len(self._index) > 1:
+                old = next(iter(self._index))
                 self._discard_locked(old)
+            if self._over_budget_locked():
+                # one entry bigger than the whole byte budget: it can
+                # never be resident — discard it as an eviction
+                self._discard_locked(seq_hash)
+                return False
         return True
 
-    def get(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    def get(self, seq_hash: int) -> Optional[tuple]:
         """Read + validate one block; any validation failure discards
-        the entry and reads as a miss (None)."""
+        the entry and reads as a miss (None). Returns an ENTRY tuple:
+        (k, v) full-width, or (k, v, ks, vs) when the entry carries a
+        quantized payload + scale section."""
         with self._lock:
             self._sweep_ttl_locked()
             if seq_hash not in self._index:
@@ -318,20 +416,23 @@ class DiskKvStore:
             self.hit_blocks_total += 1
         return got
 
-    def _decode(
-        self, seq_hash: int, raw: bytes
-    ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    def _decode(self, seq_hash: int, raw: bytes) -> Optional[tuple]:
         try:
             if raw[:4] != self.MAGIC:
                 return None
             (hlen,) = struct.unpack("<I", raw[4:8])
             head = json.loads(raw[8 : 8 + hlen])
             if head.get("v") != self.VERSION or head.get("hash") != seq_hash:
+                # includes v1 (pre-scale-section) entries: old-format
+                # files are clean misses, never misread payloads
                 return None
             nk, nv = int(head["k_bytes"]), int(head["v_bytes"])
+            # tolerant reads: absent scale keys = full-width entry
+            nks = int(head.get("ks_bytes") or 0)
+            nvs = int(head.get("vs_bytes") or 0)
             payload = raw[8 + hlen :]
-            if len(payload) != nk + nv:
-                return None  # truncated (or padded) payload
+            if len(payload) != nk + nv + nks + nvs:
+                return None  # truncated/padded payload OR scale section
             if zlib.crc32(payload) != head.get("crc"):
                 return None
             dt = _resolve_dtype(head["dtype"])
@@ -341,7 +442,15 @@ class DiskKvStore:
             v = np.frombuffer(
                 payload, dt, nv // dt.itemsize, offset=nk
             ).reshape(head["v_shape"])
-            return k, v
+            if not nks:
+                return k, v
+            ks = np.frombuffer(payload, np.float32, nks // 4, offset=nk + nv)
+            vs = np.frombuffer(
+                payload, np.float32, nvs // 4, offset=nk + nv + nks
+            )
+            if ks.shape[0] != k.shape[0] or vs.shape[0] != v.shape[0]:
+                return None  # scale vectors must be per-layer
+            return k, v, ks, vs
         except Exception:  # noqa: BLE001 — any malformed entry = miss
             logger.debug("disk tier entry %x malformed", seq_hash,
                          exc_info=True)
@@ -367,18 +476,29 @@ class DiskKvStore:
 
 
 class HostKvPool:
-    """LRU pool of offloaded blocks: seq_hash -> (k, v) host arrays of
-    shape [L, Hkv, bs, D] (ref kv/reuse.rs AvailableBlocks, one tier up).
+    """LRU pool of offloaded blocks: seq_hash -> ENTRY host tuples —
+    ``(k, v)`` full-width [L, Hkv, bs, D] pairs, or ``(k, v, ks, vs)``
+    quantized payloads with per-layer f32 scales (engine/kvquant.py).
+    (ref kv/reuse.rs AvailableBlocks, one tier up.)
 
-    ``on_overflow(hash, k, v) -> bool`` (when set) is offered every LRU
+    With ``block_bytes`` set, capacity is a BYTE budget
+    (``capacity_blocks`` full-width blocks' worth) charged at each
+    entry's actual bytes — full-width entries charge exactly one
+    block, quantized entries ~half, so the same budget holds ~2x the
+    quantized blocks. ``block_bytes == 0`` keeps the legacy entry-count
+    LRU (mirror pools, standalone tests).
+
+    ``on_overflow(hash, entry) -> bool`` (when set) is offered every LRU
     overflow victim — True means a lower tier kept it (demotion, not a
     drop); ``on_drop(hash)`` fires for entries that truly left this
     worker's tiers. :meth:`apply_plan` bypasses both (the mirror path
     accounts for its plan's drops explicitly)."""
 
-    def __init__(self, capacity_blocks: int):
+    def __init__(self, capacity_blocks: int, block_bytes: int = 0):
         self.capacity = capacity_blocks
-        self._data: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
+        self.block_bytes = int(block_bytes)
+        self._data: OrderedDict[int, tuple] = OrderedDict()
+        self._used_bytes = 0
         self.stored_total = 0
         self.hit_blocks_total = 0
         self.on_overflow: Optional[Callable] = None
@@ -390,27 +510,45 @@ class HostKvPool:
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._data
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def _over_budget(self, extra: int = 0) -> bool:
+        if self.block_bytes > 0:
+            return (
+                self._used_bytes + extra > self.capacity * self.block_bytes
+                and len(self._data) > 0
+            )
+        return len(self._data) >= self.capacity
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray,
+            scales: Optional[tuple] = None) -> None:
+        """Insert one entry; ``scales`` = (ks, vs) for a quantized
+        payload."""
         if self.capacity <= 0:
             return
         if seq_hash in self._data:
             self._data.move_to_end(seq_hash)
             return
-        while len(self._data) >= self.capacity:
-            old_h, (old_k, old_v) = self._data.popitem(last=False)
-            kept = bool(
-                self.on_overflow and self.on_overflow(old_h, old_k, old_v)
+        entry = (k, v) if scales is None else (k, v, scales[0], scales[1])
+        incoming = entry_nbytes(entry) if self.block_bytes > 0 else 0
+        while self._over_budget(incoming):
+            old_h, old_e = self._data.popitem(last=False)
+            self._used_bytes -= (
+                entry_nbytes(old_e) if self.block_bytes > 0 else 0
             )
+            kept = bool(self.on_overflow and self.on_overflow(old_h, old_e))
             if not kept and self.on_drop:
                 self.on_drop(old_h)
-        self._data[seq_hash] = (k, v)
+        self._data[seq_hash] = entry
+        self._used_bytes += incoming
 
-    def take(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    def take(self, seq_hash: int) -> Optional[tuple]:
         """Remove and return (the block is moving back to the device tier,
         which re-registers it in the device reuse pool on release)."""
-        return self._data.pop(seq_hash, None)
+        got = self._data.pop(seq_hash, None)
+        if got is not None and self.block_bytes > 0:
+            self._used_bytes -= entry_nbytes(got)
+        return got
 
-    def peek(self, seq_hash: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    def peek(self, seq_hash: int) -> Optional[tuple]:
         """Return WITHOUT removing (router-hinted prefetch reads the
         chain non-destructively: the entry stays claimable by a racing
         admission until the prefetched copy is committed on device —
@@ -461,12 +599,19 @@ class HostKvPool:
     def apply_plan(self, drops, keep, final_order, hashes, data_for) -> None:
         """Apply a :meth:`plan_puts` result: drop evictions, insert kept
         entries (``data_for(i)`` supplies hashes[i]'s value), and restore
-        the simulated recency order."""
+        the simulated recency order. (Mirror-only path — plan
+        simulation is entry-count based, which coincides with the byte
+        budget exactly while every entry is full-width.)"""
         for h in drops:
-            self._data.pop(h, None)
+            old = self._data.pop(h, None)
+            if old is not None and self.block_bytes > 0:
+                self._used_bytes -= entry_nbytes(old)
         for i, h in enumerate(hashes):
             if keep[i] and h not in self._data:
-                self._data[h] = data_for(i)
+                e = data_for(i)
+                self._data[h] = e
+                if self.block_bytes > 0:
+                    self._used_bytes += entry_nbytes(e)
         for h in final_order:
             if h in self._data:
                 self._data.move_to_end(h)
@@ -521,8 +666,29 @@ class OffloadManager:
     def __init__(self, host_blocks: int, mirror=None,
                  flush_budget: int = 64, async_tier: bool = True,
                  disk_blocks: int = 0, disk_path: Optional[str] = None,
-                 tier_ttl_s: float = 0.0):
-        self.pool = HostKvPool(host_blocks)
+                 tier_ttl_s: float = 0.0, kv_quant: str = "none",
+                 block_bytes: int = 0, full_dtype: str = "float32"):
+        if kv_quant not in kvquant.KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quant must be one of {kvquant.KV_QUANT_MODES}"
+            )
+        # per-block tier/wire codec (engine/kvquant.py): every block
+        # entering the host pool (and everything demoted past it) is
+        # stored int8/fp8 + per-layer scales; restores dequantize in
+        # the device-side scatter. The mirror path stays full-width —
+        # its lockstep broadcasts ship per-shard piece lists the block
+        # codec doesn't describe.
+        self.kv_quant = kv_quant if mirror is None else "none"
+        #: full-width per-block bytes (engine.kv_block_bytes): > 0 turns
+        #: the host/disk capacities into byte budgets so quantized
+        #: entries actually pack ~2x the blocks into the same budget
+        self.block_bytes = int(block_bytes) if mirror is None else 0
+        #: dtype quantized entries dequantize back to when a consumer
+        #: needs full-width bytes (legacy peers, mode-none restarts)
+        self.full_dtype = full_dtype
+        self.kv_quant_blocks_total = 0
+        self.kv_quant_bytes_saved_total = 0
+        self.pool = HostKvPool(host_blocks, block_bytes=self.block_bytes)
         # (seq_hash, device_block_idx) evictions awaiting d2h
         self._pending: list[tuple[int, int]] = []
         # async tier state: in-flight d2h flush tasks + transfer knobs.
@@ -556,7 +722,8 @@ class OffloadManager:
             if disk_path is None:
                 disk_path = tempfile.mkdtemp(prefix="dynkv-")
                 self._own_disk_path = disk_path
-            self.disk = DiskKvStore(disk_path, disk_blocks, ttl_s=tier_ttl_s)
+            self.disk = DiskKvStore(disk_path, disk_blocks, ttl_s=tier_ttl_s,
+                                    block_bytes=self.block_bytes)
             self.pool.on_overflow = self._demote_to_disk
         self.pool.on_drop = self._note_dropped_one
         # fleet tier: hashes that left the LAST local tier, queued for
@@ -696,7 +863,50 @@ class OffloadManager:
         with self._lock:
             self._dropped_pending.append(seq_hash)
 
-    def _demote_to_disk(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> bool:
+    # -- tier codec (engine/kvquant.py) --
+
+    def _encode_entry(self, k: np.ndarray, v: np.ndarray) -> tuple:
+        """Full-width block -> this manager's tier entry form. Executor
+        threads only (the quantize is real CPU work per block)."""
+        if self.kv_quant == "none":
+            return (k, v)
+        full = k.nbytes + v.nbytes
+        qk, qv, ks, vs = kvquant.quantize_entry(k, v, self.kv_quant)
+        with self._lock:
+            self.kv_quant_blocks_total += 1
+            self.kv_quant_bytes_saved_total += max(
+                full - entry_nbytes((qk, qv, ks, vs)), 0
+            )
+        return (qk, qv, ks, vs)
+
+    def _normalize_entry(self, entry: tuple) -> tuple:
+        """Coerce an incoming entry (disk read after a --kv-quant flip,
+        peer-pulled wire payload) to THIS manager's mode, so every
+        pool/staged entry is uniform and restore/export stacks never
+        mix dtypes. Executor threads only."""
+        quantized = len(entry) > 2 and entry[2] is not None
+        if self.kv_quant == "none":
+            if not quantized:
+                return entry
+            k, v = kvquant.dequantize_entry(
+                entry[0], entry[1], entry[2], entry[3], self.full_dtype
+            )
+            return (k, v)
+        want = kvquant.quant_dtype(self.kv_quant)
+        if quantized and entry[0].dtype == want:
+            return entry
+        if quantized:  # a different quant mode (pre-restart flag flip)
+            k, v = kvquant.dequantize_entry(
+                entry[0], entry[1], entry[2], entry[3], self.full_dtype
+            )
+            entry = (k, v)
+        return self._encode_entry(entry[0], entry[1])
+
+    @staticmethod
+    def _entry_scales(entry: tuple) -> Optional[tuple]:
+        return (entry[2], entry[3]) if len(entry) > 2 else None
+
+    def _demote_to_disk(self, seq_hash: int, entry: tuple) -> bool:
         """Host-pool overflow victim -> disk, via the offload executor
         (pool.put callers hold ``_lock`` on whatever thread they're on;
         the file write itself must never run on the event loop). True =
@@ -707,15 +917,18 @@ class OffloadManager:
         if self.disk.contains(seq_hash):
             return True  # already demoted once; content is immutable
         try:
-            self._executor().submit(self._disk_demote_worker, seq_hash, k, v)
+            self._executor().submit(self._disk_demote_worker, seq_hash, entry)
         except RuntimeError:
             return False
         return True
 
-    def _disk_demote_worker(self, seq_hash: int, k, v) -> None:
+    def _disk_demote_worker(self, seq_hash: int, entry: tuple) -> None:
         kept = False
         try:
-            kept = self.disk.put(seq_hash, k, v)
+            kept = self.disk.put(
+                seq_hash, entry[0], entry[1],
+                scales=self._entry_scales(entry),
+            )
         except Exception:  # noqa: BLE001 — a failed demotion is a drop
             logger.warning("disk demotion of %x failed", seq_hash,
                            exc_info=True)
@@ -729,9 +942,9 @@ class OffloadManager:
     def _staged_cap(self) -> int:
         return max(4 * self.pool.capacity, 64)
 
-    def _stage_locked(self, seq_hash: int, k, v, peer: bool = False,
+    def _stage_locked(self, seq_hash: int, entry: tuple, peer: bool = False,
                       fresh: Optional[set] = None) -> None:
-        self._staged[seq_hash] = (k, v)
+        self._staged[seq_hash] = entry
         self._staged.move_to_end(seq_hash)
         if fresh is not None:
             fresh.add(seq_hash)
@@ -797,9 +1010,12 @@ class OffloadManager:
             if got is None:
                 break
             read_s += time.monotonic() - t_r
-            read_bytes += got[0].nbytes + got[1].nbytes
+            read_bytes += entry_nbytes(got)
+            # normalize to this manager's codec mode (a --kv-quant flip
+            # across a restart leaves the other format on disk)
+            got = self._normalize_entry(got)
             with self._lock:
-                self._stage_locked(h, got[0], got[1], fresh=fresh)
+                self._stage_locked(h, got, fresh=fresh)
             promoted += 1
         if self.cost_model is not None and read_bytes and read_s > 0:
             # measured disk-read wall -> the "disk" link class (the
@@ -855,20 +1071,12 @@ class OffloadManager:
 
     # -- fleet tier (peer prefix pulls) --
 
-    def export_chain(
-        self, seq_hashes: list[int], max_blocks: int = 512
-    ) -> tuple[list[int], Optional[np.ndarray], Optional[np.ndarray]]:
-        """Serve side of a peer prefix pull: the longest consecutive run
-        of ``seq_hashes`` resident in the host∪disk tiers, stacked
-        [L, Hkv, n, bs, D] for the transfer plane. Non-destructive (peek
-        + disk read, no promotion churn) so a requester dying mid-pull
-        leaves this worker's tiers untouched. Executor thread (disk
-        reads + multi-MB stacking)."""
-        if self.mirror is not None:
-            return [], None, None  # mirror pools hold per-shard pieces
+    def _collect_export(self, seq_hashes: list[int], max_blocks: int):
+        """Longest consecutive resident run of ``seq_hashes`` as entry
+        tuples, uniform in this manager's codec mode (disk reads are
+        normalized). Non-destructive. Executor thread."""
         served: list[int] = []
-        ks: list[np.ndarray] = []
-        vs: list[np.ndarray] = []
+        entries: list[tuple] = []
         for h in seq_hashes[:max_blocks]:
             with self._lock:
                 got = self.pool.peek(h)
@@ -876,35 +1084,93 @@ class OffloadManager:
                     got = self._staged.get(h)
             if got is None and self.disk is not None:
                 got = self.disk.get(h)
+                if got is not None:
+                    got = self._normalize_entry(got)
             if got is None:
                 break
             served.append(h)
-            ks.append(got[0])
-            vs.append(got[1])
+            entries.append(got)
+        return served, entries
+
+    def export_chain(
+        self, seq_hashes: list[int], max_blocks: int = 512
+    ) -> tuple[list[int], Optional[np.ndarray], Optional[np.ndarray]]:
+        """Serve side of a peer prefix pull, FULL-WIDTH form: the
+        longest consecutive run of ``seq_hashes`` resident in the
+        host∪disk tiers, stacked [L, Hkv, n, bs, D] for the transfer
+        plane — quantized entries are dequantized first (the legacy-
+        peer shape of the negotiation matrix; :meth:`export_chain_q`
+        serves quant-capable pullers at wire width). Non-destructive
+        (peek + disk read, no promotion churn) so a requester dying
+        mid-pull leaves this worker's tiers untouched. Executor thread
+        (disk reads + multi-MB stacking)."""
+        served, k, v, _ks, _vs = self.export_chain_q(
+            seq_hashes, max_blocks=max_blocks, quant_ok=False
+        )
+        return served, k, v
+
+    def export_chain_q(
+        self, seq_hashes: list[int], max_blocks: int = 512,
+        quant_ok: bool = True,
+    ) -> tuple:
+        """Quant-aware export: (hashes, k, v, ks, vs). With the codec
+        active and ``quant_ok`` (the puller advertised the capability),
+        the stacks are the stored int8/fp8 payloads plus [L, n] scale
+        arrays — half the wire bytes; otherwise scales are None and
+        the stacks are full-width."""
+        if self.mirror is not None:
+            return [], None, None, None, None  # mirror pools hold pieces
+        served, entries = self._collect_export(seq_hashes, max_blocks)
         if not served:
-            return [], None, None
+            return [], None, None, None, None
+        quantized = self.kv_quant != "none"
+        if quantized and not quant_ok:
+            entries = [
+                kvquant.dequantize_entry(
+                    e[0], e[1], e[2], e[3], self.full_dtype
+                )
+                for e in entries
+            ]
+            quantized = False
+        k = np.stack([e[0] for e in entries], axis=2)
+        v = np.stack([e[1] for e in entries], axis=2)
+        ks = vs = None
+        if quantized:
+            ks = np.stack([e[2] for e in entries], axis=1)  # [L, n]
+            vs = np.stack([e[3] for e in entries], axis=1)
         with self._lock:
             self.peer_serve_blocks_total += len(served)
-        return served, np.stack(ks, axis=2), np.stack(vs, axis=2)
+        return served, k, v, ks, vs
 
     def land_peer_chain(
-        self, seq_hashes: list[int], k_data: np.ndarray, v_data: np.ndarray
+        self, seq_hashes: list[int], k_data: np.ndarray, v_data: np.ndarray,
+        k_scales: Optional[np.ndarray] = None,
+        v_scales: Optional[np.ndarray] = None,
     ) -> int:
         """Puller side: park a peer-served chain in the host-DRAM
         STAGING area — not the LRU pool, whose capacity would thrash a
         chain longer than the host budget out of existence before the
         restore runs — where the hinted-prefetch restore promotes it to
-        device exactly like a locally-offloaded chain. Executor thread —
-        the per-block splits are multi-MB copies (a view would pin the
-        whole stack for as long as any one block stays resident)."""
+        device exactly like a locally-offloaded chain. ``k_scales``/
+        ``v_scales`` ([L, n] f32) mark a quantized delivery; either
+        way each block is normalized to THIS manager's codec mode
+        (quantized puller vs unquantized peer and vice versa both
+        land clean). Executor thread — the per-block splits are
+        multi-MB copies (a view would pin the whole stack for as long
+        as any one block stays resident)."""
         landed = 0
         fresh: set = set()
         # truncate at the staging cap (keep the chain's PREFIX): staging
         # past it would evict this chain's own head and zero the
         # consecutive match the restore needs
         for i, h in enumerate(seq_hashes[: self._staged_cap()]):
-            k = k_data[:, :, i].copy()
-            v = v_data[:, :, i].copy()
+            entry = (k_data[:, :, i].copy(), v_data[:, :, i].copy())
+            if k_scales is not None:
+                entry = entry + (
+                    np.ascontiguousarray(k_scales[:, i], dtype=np.float32),
+                    np.ascontiguousarray(v_scales[:, i], dtype=np.float32),
+                )
+            entry = self._normalize_entry(entry)
             with self._lock:
                 if (
                     h in self.pool
@@ -912,7 +1178,7 @@ class OffloadManager:
                     or (self.disk is not None and self.disk.contains(h))
                 ):
                     continue  # raced a local landing; content-identical
-                self._stage_locked(h, k, v, peer=True, fresh=fresh)
+                self._stage_locked(h, entry, peer=True, fresh=fresh)
                 self.peer_pull_blocks_total += 1
             landed += 1
         return landed
@@ -1024,8 +1290,10 @@ class OffloadManager:
                 )
             return
         with self._lock:
-            for h, (k, v) in zip(hashes, data):
-                self.pool.put(h, k, v)
+            for h, e in zip(hashes, data):
+                # entries re-pool in whatever form they were reserved
+                # (already this manager's codec mode)
+                self.pool.put(h, e[0], e[1], scales=self._entry_scales(e))
 
     # -- device-thread operations --
     def flush_evictions(self, k_cache, v_cache) -> None:
@@ -1069,14 +1337,24 @@ class OffloadManager:
         self._land_flush(pending, kg, vg)
 
     def _land_flush(self, pending, kg, vg) -> None:
-        """Blocking half of a flush: d2h fetch + host-pool insertion.
-        Runs inline on the sync path, on the offload executor otherwise."""
+        """Blocking half of a flush: d2h fetch + host-pool insertion
+        (quantized to the tier codec when --kv-quant is on — the
+        quantize runs here, off the loop, before the entry is priced
+        against the pool's byte budget). Runs inline on the sync path,
+        on the offload executor otherwise."""
         kg, vg = _device_fetch(kg), _device_fetch(vg)
+        entries = []
+        for i, (seq_hash, _idx) in enumerate(pending):
+            # copy: a view would pin the whole padded gather batch in
+            # RAM for as long as any one block stays resident
+            entries.append(
+                (seq_hash,
+                 self._encode_entry(kg[:, :, i].copy(), vg[:, :, i].copy()))
+            )
         with self._lock:
-            for i, (seq_hash, _idx) in enumerate(pending):
-                # copy: a view would pin the whole padded gather batch in
-                # RAM for as long as any one block stays resident
-                self.pool.put(seq_hash, kg[:, :, i].copy(), vg[:, :, i].copy())
+            for seq_hash, e in entries:
+                self.pool.put(seq_hash, e[0], e[1],
+                              scales=self._entry_scales(e))
             self.pool.stored_total += len(pending)
 
     def flush_evictions_async(
@@ -1149,9 +1427,19 @@ class OffloadManager:
         return up
 
     def _upload_worker(self, up: RestoreUpload):
-        k_host = np.stack([k for k, _v in up.data], axis=2)
-        v_host = np.stack([v for _k, v in up.data], axis=2)
+        k_host = np.stack([e[0] for e in up.data], axis=2)
+        v_host = np.stack([e[1] for e in up.data], axis=2)
         k_dev, v_dev = _device_put(k_host), _device_put(v_host)
+        if len(up.data[0]) > 2:
+            # quantized chain: the h2d moves int8/fp8 payloads (half
+            # the PCIe bytes) + the tiny per-block scale stacks; the
+            # dequantize fuses into the device-side scatter
+            ks = np.stack([e[2] for e in up.data], axis=1)  # [L, m]
+            vs = np.stack([e[3] for e in up.data], axis=1)
+            ks_dev, vs_dev = _device_put(ks), _device_put(vs)
+            jax.block_until_ready((k_dev, v_dev, ks_dev, vs_dev))
+            up.t_landed = time.monotonic()
+            return k_dev, v_dev, ks_dev, vs_dev
         jax.block_until_ready((k_dev, v_dev))
         up.t_landed = time.monotonic()
         return k_dev, v_dev
@@ -1182,7 +1470,8 @@ class OffloadManager:
                 k_cache, v_cache, up.data, up.idxs, hashes=up.hashes
             )
         t0 = time.monotonic()
-        k_dev, v_dev = up.future.result()
+        landed = up.future.result()
+        k_dev, v_dev = landed[0], landed[1]
         if account and self.cost_model is not None and up.t_landed is not None:
             # the upload worker's measured stack+h2d wall is the "host"
             # link observation routing prices this worker's restores at.
@@ -1206,9 +1495,12 @@ class OffloadManager:
                 # (h2d_prefetch_hits), not at landing — a hint for a
                 # request that never arrives is not a hit
                 self.pool.hit_blocks_total += len(up.data)
-        return _scatter_blocks(
-            k_cache, v_cache, jnp.asarray(_pad_idxs(up.idxs)), k_dev, v_dev
-        )
+        idxs = jnp.asarray(_pad_idxs(up.idxs))
+        if len(landed) > 2:  # quantized chain: dequant fused into scatter
+            return _scatter_blocks_q(
+                k_cache, v_cache, idxs, k_dev, v_dev, landed[2], landed[3]
+            )
+        return _scatter_blocks(k_cache, v_cache, idxs, k_dev, v_dev)
 
     # -- prefetch accounting (router-hinted restores, engine-side) --
     def note_prefetch_landed(self, up: RestoreUpload) -> None:
@@ -1219,7 +1511,7 @@ class OffloadManager:
             if up.t_landed is not None:
                 self.restore_hidden_s += max(up.t_landed - up.t_start, 0.0)
         if self.cost_model is not None and up.t_landed is not None and up.data:
-            nbytes = sum(k.nbytes + v.nbytes for k, v in up.data)
+            nbytes = sum(entry_nbytes(e) for e in up.data)
             self.cost_model.observe(
                 "host", nbytes, max(up.t_landed - up.t_start, 1e-9)
             )
@@ -1263,16 +1555,19 @@ class OffloadManager:
                 k_pieces, v_pieces, gs(k_cache), gs(v_cache),
                 drop_hashes=drops,
             )
-        ks = [k for k, _v in data]
-        vs = [v for _k, v in data]
-        k_host = np.stack(ks, axis=2)  # [L, Hkv, m, bs, D] unpadded —
-        v_host = np.stack(vs, axis=2)  # the scatter core pads on device
+        k_host = np.stack([e[0] for e in data], axis=2)  # [L, Hkv, m, bs, D]
+        v_host = np.stack([e[1] for e in data], axis=2)  # unpadded — the
+        idxs = jnp.asarray(_pad_idxs(block_idxs))  # scatter core pads on device
+        if len(data[0]) > 2:  # quantized chain (sync path)
+            return _scatter_blocks_q(
+                k_cache, v_cache, idxs,
+                jnp.asarray(k_host), jnp.asarray(v_host),
+                jnp.asarray(np.stack([e[2] for e in data], axis=1)),
+                jnp.asarray(np.stack([e[3] for e in data], axis=1)),
+            )
         return _scatter_blocks(
-            k_cache,
-            v_cache,
-            jnp.asarray(_pad_idxs(block_idxs)),
-            jnp.asarray(k_host),
-            jnp.asarray(v_host),
+            k_cache, v_cache, idxs,
+            jnp.asarray(k_host), jnp.asarray(v_host),
         )
 
     def close(self) -> None:
@@ -1324,6 +1619,11 @@ class OffloadManager:
                     if pulled else 0.0
                 ),
                 "peer_serve_blocks_total": self.peer_serve_blocks_total,
+                # per-block tier/wire quantization (engine/kvquant.py):
+                # blocks encoded to the int8/fp8 codec on their way into
+                # the tiers/wire, and the bytes that saved vs full width
+                "kv_quant_blocks_total": self.kv_quant_blocks_total,
+                "kv_quant_bytes_saved_total": self.kv_quant_bytes_saved_total,
                 # async-tier surface (ISSUE 1): background d2h flushes
                 # dispatched, hinted blocks restored + later claimed, and
                 # the fraction of total restore (h2d) latency hidden

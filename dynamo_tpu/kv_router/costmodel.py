@@ -273,6 +273,7 @@ def predict_worker_ttft_ms(
     pending: int = 0,
     min_obs: int = 4,
     peer_slice_fp: str = "",
+    peer_wire_bytes: int = 0,
 ) -> Optional[float]:
     """Predicted TTFT (ms) for routing one ``isl_blocks``-block prompt
     to ``load``'s worker, from the candidate's advertised calibration:
@@ -310,7 +311,11 @@ def predict_worker_ttft_ms(
     if not tok_s or tok_s <= 0:
         return None
     w = load.worker_id
-    bs, bb = load.block_size, load.block_bytes
+    # restore/pull legs move TIER/WIRE bytes: the quantized per-block
+    # size when the worker advertises a --kv-quant codec (half the
+    # bytes -> half the predicted leg), the full width otherwise
+    bs = load.block_size
+    bb = load.wire_bytes_per_block
     isl = max(isl_blocks, 1)
     tier = min(overlaps.scores.get(w, 0), isl)
     dev = min(overlaps.device(w), tier)
@@ -349,9 +354,13 @@ def predict_worker_ttft_ms(
             and peer_slice_fp == load.slice_fp
             else "peer"
         )
+        # the WIRE leg moves bytes at the serving peer's codec width
+        # (peers serve their stored form); the landing/restore leg is
+        # this candidate's own tier width
         pull = link_leg_ms(
             link_gbps, link_lat,
-            link if link_gbps.get(link) else "peer", peer_extra * bb,
+            link if link_gbps.get(link) else "peer",
+            peer_extra * (peer_wire_bytes or bb),
         )
         land = restore_leg_ms(link_gbps, link_lat, peer_extra * bb)
         if pull is not None and land is not None:

@@ -164,6 +164,12 @@ class KvPeerFetchRequest:
     request_id: str  # transfer-plane correlation id
     hashes: list  # chained block hashes, prompt order
     connection: dict  # requester's KvTransferServer ConnectionInfo
+    #: wire-codec capability (disagg/transfer.KV_QUANT_WIRE_VERSION):
+    #: the requester accepts int8/fp8 payloads + scale frames and
+    #: dequantizes on landing. 0/absent (legacy pullers) makes the
+    #: serving peer dequantize its stored blocks to full width first —
+    #: the quant/no-quant skew matrix degrades to bytes, never errors.
+    accept_quant: int = 0
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__).encode()
@@ -177,6 +183,7 @@ class KvPeerFetchRequest:
             request_id=str(d["request_id"]),
             hashes=[int(h) for h in d.get("hashes", [])],
             connection=d.get("connection") or {},
+            accept_quant=int(d.get("accept_quant") or 0),
         )
 
 

@@ -274,12 +274,17 @@ class KvPrefetchListener:
         tail = chain[cov:]
         request_id = f"peer-pull-{uuid.uuid4().hex}"
         fut = self._transfer.expect(request_id)
+        from ..disagg.transfer import KV_QUANT_WIRE_VERSION
+
         req = KvPeerFetchRequest(
             peer_worker_id=hint.peer_worker_id,
             src_worker_id=self.worker_id,
             request_id=request_id,
             hashes=tail,
             connection=self._transfer.address.to_dict(),
+            # this puller dequantizes (or re-quantizes to its own mode)
+            # on landing, so it always accepts the quantized wire shape
+            accept_quant=KV_QUANT_WIRE_VERSION,
         )
         self.peer_pulls += 1
         import time as _time
@@ -336,7 +341,9 @@ class KvPrefetchListener:
         """Executor thread: permute a foreign kv-head ordering (same
         shared rule as the disagg delivery paths — ops/kv_rearrange.
         layout_mismatched) and park the chain in the host staging
-        area."""
+        area. A quantized delivery regroups as-is (the codec's scales
+        are kv-head-free) and lands with its scale arrays — the
+        landing normalizes it to THIS worker's codec mode."""
         from ..ops.kv_rearrange import layout_mismatched, rearrange_for_decode
 
         k, v = delivery.k_data, delivery.v_data
@@ -351,7 +358,10 @@ class KvPrefetchListener:
             v = rearrange_for_decode(
                 v, delivery.src_tp, my_tp, delivery.head_layout, my_layout
             )
-        return self.engine.offload.land_peer_chain(served, k, v)
+        return self.engine.offload.land_peer_chain(
+            served, k, v,
+            k_scales=delivery.k_scales, v_scales=delivery.v_scales,
+        )
 
 
 class KvPeerServer:
@@ -458,6 +468,16 @@ class KvPeerServer:
             await faultpoints.hit("mid_peer_serve", request_id=req.request_id)
             off = getattr(self.engine, "offload", None)
             hashes, k, v = ([], None, None)
+            ks = vs = None
+            # serve at the stored codec's width only when the puller
+            # advertised the capability (tolerant default 0 = legacy
+            # puller = full-width bytes; the negotiation matrix of
+            # docs/kv_offload.md)
+            serve_q = (
+                off.kv_quant
+                if off is not None and req.accept_quant >= 1
+                else "none"
+            )
             # device tier first: chains living ONLY in HBM used to be
             # invisible to the fleet prefix cache — a bounded,
             # non-destructive d2h export (engine device lock + executor
@@ -469,25 +489,35 @@ class KvPeerServer:
                     req.hashes, max_blocks=self.max_d2h_blocks
                 )
             if off is not None:
-                tail = req.hashes[len(hashes):]
 
                 def _export_and_merge(k=k, v=v, hashes=tuple(hashes)):
-                    # executor thread: the lower-tier export AND the
-                    # multi-MB merge with the device run both stay off
-                    # the event loop
-                    h2, k2, v2 = off.export_chain(list(tail))
-                    if not h2:
-                        return list(hashes), k, v
-                    if hashes:
-                        return (
-                            list(hashes) + h2,
-                            np.concatenate([k, k2], axis=2),
-                            np.concatenate([v, v2], axis=2),
-                        )
-                    return h2, k2, v2
+                    # executor thread: the lower-tier export, the
+                    # device run's wire quantize, and the multi-MB
+                    # merge all stay off the event loop
+                    from ..engine import kvquant as _kvq
 
-                hashes, k, v = await asyncio.get_running_loop().run_in_executor(
-                    None, _export_and_merge
+                    tail = req.hashes[len(hashes):]
+                    ks = vs = None
+                    if serve_q != "none" and hashes:
+                        k, v, ks, vs = _kvq.quantize_stack(k, v, serve_q)
+                    h2, k2, v2, ks2, vs2 = off.export_chain_q(
+                        list(tail), quant_ok=serve_q != "none"
+                    )
+                    if not h2:
+                        return list(hashes), k, v, ks, vs
+                    if hashes:
+                        k = np.concatenate([k, k2], axis=2)
+                        v = np.concatenate([v, v2], axis=2)
+                        if ks2 is not None:
+                            ks = np.concatenate([ks, ks2], axis=1)
+                            vs = np.concatenate([vs, vs2], axis=1)
+                        return list(hashes) + h2, k, v, ks, vs
+                    return h2, k2, v2, ks2, vs2
+
+                hashes, k, v, ks, vs = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, _export_and_merge
+                    )
                 )
             if not hashes:
                 self.misses += 1
@@ -502,6 +532,8 @@ class KvPeerServer:
                 head_layout=self.engine.cfg.kv_head_layout,
                 src_tp=self.engine.cfg.mesh.tp if self.engine.cfg.mesh else 1,
                 hashes=hashes,
+                kv_quant=serve_q if ks is not None else "none",
+                k_scales=ks, v_scales=vs,
             )
             self.blocks_served += len(hashes)
         except Exception:  # noqa: BLE001 — serving is best-effort: the

@@ -133,6 +133,20 @@ class WorkerLoad:
     prefill_tok_s: float = 0.0
     block_bytes: int = 0
     block_size: int = 0
+    # tier/wire bytes per block under the worker's --kv-quant codec
+    # (== block_bytes when quantization is off; 0 = not advertised,
+    # pre-quant worker): restore and peer-pull legs move THESE bytes,
+    # so predict/choose_peer price them with this, not the device width
+    wire_block_bytes: int = 0
+    # kv-quant codec activity (OffloadManager.stats): blocks encoded
+    # into the quantized tiers/wire, and the bytes that saved vs full
+    # width — the capacity win, observable per worker
+    kv_quant_blocks: int = 0
+    kv_quant_bytes_saved: int = 0
+    # worst logprob drift the kv-quant quality harness recorded on this
+    # worker (0 until a harness ran) — operators watch this gauge when
+    # a quantized tier is enabled fleet-wide
+    kv_quant_logprob_drift_max: float = 0.0
     # accelerator-slice fingerprint (parallel/mesh.slice_fingerprint):
     # two workers advertising the same fp can hand KV device→device
     # over ICI — the peer chooser prices their pulls at the ici class
@@ -200,12 +214,24 @@ class WorkerLoad:
             prefill_tok_s=d.get("kv_prefill_tok_s", 0.0),
             block_bytes=d.get("kv_block_bytes", 0),
             block_size=d.get("kv_block_size", 0),
+            wire_block_bytes=d.get("kv_wire_block_bytes", 0),
+            kv_quant_blocks=d.get("kv_quant_blocks_total", 0),
+            kv_quant_bytes_saved=d.get("kv_quant_bytes_saved_total", 0),
+            kv_quant_logprob_drift_max=d.get(
+                "kv_quant_logprob_drift_max", 0.0),
             slice_fp=str(d.get("kv_slice_fp") or ""),
             ici_handoffs=d.get("ici_handoffs", 0),
             peer_serve_d2h_blocks=d.get("peer_serve_d2h_blocks_total", 0),
             weight_prestage_requests=d.get("weight_prestage_requests", 0),
             ts=ts,
         )
+
+    @property
+    def wire_bytes_per_block(self) -> int:
+        """Bytes one block actually moves on this worker's tier/wire
+        planes: the quantized advertisement when present, the full
+        width otherwise (pre-quant workers keep their old pricing)."""
+        return self.wire_block_bytes or self.block_bytes
 
     @property
     def kv_usage(self) -> float:
@@ -371,12 +397,17 @@ class KvScheduler:
             # comparable within one argmin
             preds = []
             for l in candidates:
+                peer = self._deepest_peer(endpoints, overlaps, l.worker_id)
                 p = predict_worker_ttft_ms(
                     l, overlaps, isl_blocks,
                     pending=self._pending.get(l.worker_id, 0),
                     min_obs=self.cfg.cost_min_obs,
-                    peer_slice_fp=self._deepest_peer_fp(
-                        endpoints, overlaps, l.worker_id
+                    peer_slice_fp=peer.slice_fp if peer else "",
+                    # pull legs move bytes at the SERVING peer's codec
+                    # width (it ships its stored form), not this
+                    # candidate's
+                    peer_wire_bytes=(
+                        peer.wire_bytes_per_block if peer else 0
                     ),
                 )
                 if p is None:
@@ -436,12 +467,12 @@ class KvScheduler:
         return best_id
 
     @staticmethod
-    def _deepest_peer_fp(
+    def _deepest_peer(
         endpoints: ProcessedEndpoints, overlaps: OverlapScores, worker_id: int
-    ) -> str:
-        """Slice fingerprint of the deepest OTHER chain's worker — the
-        peer a pull would come from, so the prediction prices it at the
-        ICI class when it shares the candidate's slice."""
+    ) -> Optional[WorkerLoad]:
+        """Load of the deepest OTHER chain's worker — the peer a pull
+        would come from, so the prediction prices the wire leg at that
+        peer's slice (ICI class on a match) and codec width."""
         best_w, best_ov = None, 0
         for w, ov in overlaps.scores.items():
             if w != worker_id and (ov > best_ov or (ov == best_ov and
@@ -449,9 +480,8 @@ class KvScheduler:
                                                     and w < best_w)):
                 best_w, best_ov = w, ov
         if best_w is None:
-            return ""
-        load = endpoints.by_id.get(best_w)
-        return load.slice_fp if load is not None else ""
+            return None
+        return endpoints.by_id.get(best_w)
 
     def choose_peer(
         self,
@@ -503,15 +533,28 @@ class KvScheduler:
                     and link_gbps.get("ici")
                     else "peer"
                 )
-                nbytes = extra * load.block_bytes
+                # the WIRE leg moves bytes at the SERVING PEER's codec
+                # width (the peer serves its stored form — a full-width
+                # peer ships full-width bytes to a quantized puller, and
+                # vice versa), so price the pull with the peer's
+                # advertisement; the LANDING leg re-encodes into this
+                # worker's own tiers and restores at its width
+                peer_bb = (
+                    peer.wire_bytes_per_block
+                    if peer is not None and peer.wire_bytes_per_block
+                    else load.wire_bytes_per_block
+                )
                 pull = link_leg_ms(
-                    link_gbps, load.link_lat_ms, link, nbytes
+                    link_gbps, load.link_lat_ms, link, extra * peer_bb
                 )
                 # the pulled chain lands in host staging and still pays
                 # the h2d restore leg — same pricing as predict's pull
                 # term, or the two would disagree on whether a pull
                 # beats recompute
-                land = restore_leg_ms(link_gbps, load.link_lat_ms, nbytes)
+                land = restore_leg_ms(
+                    link_gbps, load.link_lat_ms,
+                    extra * load.wire_bytes_per_block,
+                )
                 if pull is None or land is None:
                     scored = None  # cold pull/restore -> deepest fallback
                     break

@@ -268,6 +268,7 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         kv_tier_ttl_s=args.kv_tier_ttl_s,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_quant=getattr(args, "kv_quant", "none"),
         decode_window=args.decode_window,
         decode_pipeline=args.decode_pipeline,
         spec_gamma=args.spec_gamma,
@@ -932,7 +933,19 @@ def main(argv=None) -> None:
                    help="weight quantization (per-channel; models/quant.py)")
     p.add_argument("--kv-cache-dtype", default="model",
                    choices=["model", "float8_e4m3", "bfloat16"],
-                   help="KV cache storage dtype (float8 = scale-free cast)")
+                   help="KV cache storage dtype (float8 = scale-free cast; "
+                        "quantized caches keep the Pallas ragged kernels — "
+                        "the dequant fuses into their KV page loads)")
+    p.add_argument("--kv-quant", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="per-block KV quantization for the offload tiers "
+                        "and the transfer wire (engine/kvquant.py): blocks "
+                        "entering host DRAM / disk / peer pulls / disagg "
+                        "handoffs ship int8|fp8 + per-layer scales and "
+                        "dequantize on the device-side scatter — ~2x tier "
+                        "and wire capacity at a measured logprob drift "
+                        "(opt in per model; legacy peers transparently "
+                        "receive full-width bytes)")
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--decode-window", type=int, default=4,
                    help="fused decode steps per device dispatch")

@@ -214,6 +214,20 @@ class MetricsComponent:
             gauge("disk_corrupt_discards_total", w.disk_corrupt_discards, lb)
             gauge("disk_demotions_total", w.disk_demotions, lb)
             gauge("peer_serve_blocks_total", w.peer_serve_blocks, lb)
+            # per-block KV quantization (docs/kv_offload.md quantized
+            # tier): blocks encoded to the int8/fp8 tier/wire codec,
+            # the bytes that saved vs full width, and the worst logprob
+            # drift the quality harness has recorded on this worker
+            gauge("kv_quant_blocks_total", w.kv_quant_blocks, lb)
+            gauge("kv_quant_bytes_saved_total", w.kv_quant_bytes_saved, lb)
+            # bytes one block moves on this worker's tier/wire planes
+            # (the quantized advertisement predict/choose_peer price
+            # restore and pull legs with; == full width when codec off)
+            gauge("kv_wire_block_bytes", w.wire_block_bytes, lb)
+            gauge(
+                "kv_quant_logprob_drift_max",
+                round(w.kv_quant_logprob_drift_max, 6), lb,
+            )
             # resilience plane: draining state + handoff/resume volume
             # (resilience subsystem; docs/resilience.md)
             gauge("draining", w.draining, lb)

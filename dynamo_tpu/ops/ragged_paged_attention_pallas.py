@@ -86,7 +86,8 @@ def _mixed_kernel(
     tables_ref,  # [B+MP, Mb] int32 (SMEM): decode + prefill tables
     q0_ref,  # [S] int32: tile row 0's absolute query position
     lastq_ref,  # [S] int32: tile's last REAL query position (-1 = all pad)
-    # inputs: q then P k-page refs then P v-page refs [then sinks]
+    # inputs: q, P k-page refs, P v-page refs [, P k-scale refs,
+    # P v-scale refs] [, sinks]
     *refs,
     scale: float,
     block_size: int,
@@ -94,13 +95,24 @@ def _mixed_kernel(
     pages_per_step: int,
     window: int = 0,  # sliding attention; 0 = full
     has_sinks: bool = False,
+    has_scales: bool = False,  # quantized pages + per-page dequant scales
 ):
     Pp = pages_per_step
     q_ref = refs[0]  # [1, Tq*Gp, D]
     k_refs = refs[1 : 1 + Pp]  # each [1, 1, bs, D]
     v_refs = refs[1 + Pp : 1 + 2 * Pp]
-    n_in = 1 + 2 * Pp + int(has_sinks)
-    sink_ref = refs[1 + 2 * Pp] if has_sinks else None  # [1, Gp, 128]
+    off = 1 + 2 * Pp
+    ks_refs = vs_refs = ()
+    if has_scales:
+        # per-page dequant scales, streamed with the SAME index map as
+        # their page (lane-broadcast [1, 128] f32 tiles) — the fused
+        # dequant of the quantized-KV path: page * scale right at the
+        # load, f32 compute after, zero extra HBM passes
+        ks_refs = refs[off : off + Pp]
+        vs_refs = refs[off + Pp : off + 2 * Pp]
+        off += 2 * Pp
+    n_in = off + int(has_sinks)
+    sink_ref = refs[off] if has_sinks else None  # [1, Gp, 128]
     o_ref = refs[n_in]  # [1, Tq*Gp, D]
     m_scr, l_scr, acc_scr = refs[n_in + 1 :]
 
@@ -127,12 +139,30 @@ def _mixed_kernel(
     @pl.when(in_range)
     def _superblock():
         q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
-        k = jnp.concatenate(
-            [r[0, 0] for r in k_refs], axis=0
-        ).astype(jnp.float32)  # [P*bs, D]
-        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
-            jnp.float32
-        )
+        if has_scales:
+            # quantized pages: cast + per-page scale multiply fused at
+            # the load ([bs, D] * [1] broadcasts the block's scale)
+            k = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * ks_refs[p][0, 0:1]
+                    for p, r in enumerate(k_refs)
+                ],
+                axis=0,
+            )  # [P*bs, D]
+            v = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * vs_refs[p][0, 0:1]
+                    for p, r in enumerate(v_refs)
+                ],
+                axis=0,
+            )
+        else:
+            k = jnp.concatenate(
+                [r[0, 0] for r in k_refs], axis=0
+            ).astype(jnp.float32)  # [P*bs, D]
+            v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+                jnp.float32
+            )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Tq*Gp, P*bs]
@@ -207,6 +237,8 @@ def ragged_mixed_attention(
     pages_per_step: int = 0,  # 0 -> auto (largest pow2 <= 8 dividing M)
     window: int = 0,  # sliding attention width; 0 = full
     sinks: jnp.ndarray | None = None,  # [H] gpt-oss sink logits
+    k_scales: jnp.ndarray | None = None,  # [N] f32 per-page dequant scales
+    v_scales: jnp.ndarray | None = None,  # [N] f32 (quantized caches)
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:  # (o_dec [B,H,D], o_chunks [MP,T,H,D])
     """One kernel invocation over B decode rows + M prefill segments.
@@ -220,6 +252,15 @@ def ragged_mixed_attention(
     caller slices off — their superblocks are skipped entirely. All
     segments share the padded length T, so the compiled program is
     keyed by (MP, T) buckets, never the per-segment length mixture.
+
+    Quantized KV (ROADMAP item 3): the cache layers may be int8/fp8 —
+    the kernel casts page tiles to f32 at the load, and with
+    ``k_scales``/``v_scales`` (one f32 scale per physical page — the
+    per-block-per-layer codec of engine/kvquant.py, this layer's
+    column) multiplies each page by its scale right there, so the
+    dequant is fused into the KV load instead of costing a second HBM
+    pass. Scale-free quantized caches (the fp8 direct-cast device
+    cache) simply pass no scales.
     """
     B, H, D = q_dec.shape
     MP, T = q_chunks.shape[0], q_chunks.shape[1]
@@ -288,6 +329,33 @@ def ragged_mixed_attention(
     page_spec = [
         pl.BlockSpec((1, 1, bs, D), page_index(p)) for p in range(Pp)
     ]
+    has_scales = k_scales is not None
+    scale_inputs, scale_specs = (), ()
+    if has_scales:
+        # [N] -> [N, 128] f32 lane-broadcast; each page stream gets a
+        # twin scale stream driven by the SAME physical-page index map,
+        # so the pipeline fetches exactly the scales of the pages it
+        # loads (consecutive identical indices skip the re-fetch too)
+        def scale_index(p):
+            def index(s, h, i, sq, bt, q0, lastq):
+                seq_row = sq[s]
+                last_pg = jnp.maximum(lastq[s], 0) // bs
+                pi = jnp.minimum(jnp.minimum(i * Pp + p, last_pg), M - 1)
+                return (bt[seq_row, pi], 0)
+
+            return index
+
+        ksb = jnp.broadcast_to(
+            k_scales.astype(jnp.float32)[:, None], (k_scales.shape[0], 128)
+        )
+        vsb = jnp.broadcast_to(
+            v_scales.astype(jnp.float32)[:, None], (v_scales.shape[0], 128)
+        )
+        scale_inputs = tuple([ksb] * Pp + [vsb] * Pp)
+        scale_specs = tuple(
+            pl.BlockSpec((1, 128), scale_index(p))
+            for p in list(range(Pp)) * 2
+        )
     sink_inputs, sink_specs = (), ()
     if sinks is not None:
         # [H] -> [Hkv, Gp, 128] lane-broadcast; padded group lanes at a
@@ -310,6 +378,7 @@ def ragged_mixed_attention(
             ),
             *page_spec,
             *page_spec,
+            *scale_specs,
             *sink_specs,
         ],
         out_specs=pl.BlockSpec(
@@ -324,6 +393,7 @@ def ragged_mixed_attention(
     kernel = functools.partial(
         _mixed_kernel, scale=scale, block_size=bs, group=Gp,
         pages_per_step=Pp, window=window, has_sinks=sinks is not None,
+        has_scales=has_scales,
     )
     out = pl.pallas_call(
         kernel,
@@ -341,7 +411,8 @@ def ragged_mixed_attention(
         interpret=interpret,
     )(
         tile_seq, tables, tile_q0, tile_last, q_all,
-        *([k_cache_layer] * Pp), *([v_cache_layer] * Pp), *sink_inputs,
+        *([k_cache_layer] * Pp), *([v_cache_layer] * Pp),
+        *scale_inputs, *sink_inputs,
     )
     out = out.reshape(Hkv, S, Tq, Gp, D)
     o_dec = out[:, :B, 0].transpose(1, 0, 2, 3)  # [B, Hkv, Gp, D]
@@ -366,17 +437,31 @@ def ragged_mixed_attention_sharded(
     mesh,
     window: int = 0,
     sinks=None,  # [H], sharded over tp with the heads
+    k_scales=None,  # [N] f32 per-page dequant scales (replicated — the
+    v_scales=None,  # page axis is unsharded; scales are head-free)
     interpret: bool = False,
 ):
     """ragged_mixed_attention under shard_map over ``tp`` — the mixed
     kernel is kv-head-parallel exactly like its decode/prefill parents
     (ops/attention._shard_tp), so each device runs it on its local head
-    shard with no collectives. Scalars (tables, lengths) replicate."""
+    shard with no collectives. Scalars (tables, lengths) replicate, and
+    so do the per-page dequant scales (one scale per block per layer —
+    the kv-head axis is deliberately scale-free, which is also what
+    keeps kv_rearrange valid on quantized payloads)."""
+    has_scales = k_scales is not None
 
-    def _local(qd, qc, kc, vc, bt, sl, pt, ph, pv, s=None):
+    def _local(qd, qc, kc, vc, bt, sl, pt, ph, pv, *rest):
+        ks = vs = s = None
+        i = 0
+        if has_scales:
+            ks, vs = rest[0], rest[1]
+            i = 2
+        if len(rest) > i:
+            s = rest[i]
         return ragged_mixed_attention(
             qd, qc, kc, vc, bt, sl, pt, ph, pv, scale,
-            window=window, sinks=s, interpret=interpret,
+            window=window, sinks=s, k_scales=ks, v_scales=vs,
+            interpret=interpret,
         )
 
     in_specs = [
@@ -390,6 +475,9 @@ def ragged_mixed_attention_sharded(
         q_dec, q_chunks, k_cache_layer, v_cache_layer,
         d_tables, d_seq_lens, p_tables, p_hists, p_valids,
     )
+    if has_scales:
+        in_specs += [P(), P()]  # scales replicate (page axis unsharded)
+        operands += (k_scales, v_scales)
     if sinks is not None:
         in_specs.append(P("tp"))
         operands += (sinks,)

@@ -118,6 +118,29 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert pf["kill"]["kills_fired"] == 1, pf
     assert pf["kill"]["client_errors"] == 0, pf
     assert pf["kill"]["tokens_match"] is True, pf
+    # quantized KV tiers must be recorded (ISSUE 14): the identical
+    # host+disk byte budget holds >= 1.8x the resident cached-prefix
+    # blocks at int8, the peer/local quantized restore paths stay
+    # healthy (blocks pulled, streams matching), and the logprob-drift
+    # quality gate clears 0.99 greedy agreement on the fixed prompts.
+    # Direction-only on TTFT (the bench itself enforces the tighter
+    # noise-banded comparison; a loaded CI box inflates every tail)
+    kq = result.get("bench_kv_quant")
+    assert kq, result.get("bench_kv_quant_error", "metric missing")
+    assert kq["capacity_ratio"] >= 1.8, kq
+    assert kq["int8"]["resident_cached_prefix_blocks"] >= int(
+        kq["full"]["resident_cached_prefix_blocks"] * 1.8
+    ), kq
+    assert kq["int8"]["kv_quant_blocks_total"] > 0, kq
+    assert kq["int8"]["kv_quant_bytes_saved_total"] > 0, kq
+    assert kq["full"]["kv_quant_blocks_total"] == 0, kq
+    for mode in ("full", "int8"):
+        assert kq[mode]["tokens_match"] is True, kq
+        assert kq[mode]["peer_pull_blocks"] == kq["chain_blocks"], kq
+        for path in ("cold", "local", "peer"):
+            assert kq[mode][path]["ttft_p50_ms"] > 0, kq
+    assert kq["logprob_drift"]["greedy_agreement"] >= 0.99, kq
+    assert kq["logprob_drift"]["n_tokens"] > 0, kq
     # transfer-cost-aware placement must be recorded (ISSUE 11): on the
     # heterogeneous two-candidate workload the overlap-only scorer picks
     # the deeper-but-cold-tier busy worker, the cost model picks the

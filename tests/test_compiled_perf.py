@@ -254,6 +254,105 @@ def test_mixed_step_program_count_bounded():
     )
 
 
+def test_mixed_step_program_count_bounded_quantized_kv():
+    """Quantized-KV twin of the bucketing guard (ISSUE 14): a
+    float8_e4m3 cache (the quantized device-KV mode the Pallas gate now
+    keeps on the kernel path) must compile exactly the same
+    (segment-count x prefill-bucket) program grid as bf16 — per-DTYPE
+    programs are expected (different cache types ARE different
+    programs), but traced-value variation under a quantized cache must
+    never add more."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    M = CTX // BLOCK
+    MP_MAX = 2
+    num_blocks = (B + MP_MAX) * M + 1
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(
+        cfg, num_blocks, BLOCK, dtype=jnp.float8_e4m3fn
+    )
+    d_tables = jnp.asarray(
+        np.arange(1, B * M + 1, dtype=np.int32).reshape(B, M)
+    )
+    p_tables = jnp.asarray(
+        np.arange(B * M + 1, (B + MP_MAX) * M + 1, dtype=np.int32)
+        .reshape(MP_MAX, M)
+    )
+    seg_buckets = (1, 2)
+    buckets = (16, 32)
+    base = llama.mixed_step._cache_size()
+    for MP in seg_buckets:
+        for T in buckets:
+            variants = (
+                (11, (0,) * MP, (T - 3,) + (2,) * (MP - 1)),
+                (7, (T // 2,) * MP, (2,) + (0,) * (MP - 1)),
+            )
+            for sl, hists, valids in variants:
+                out = llama.mixed_step(
+                    params, cfg,
+                    jnp.zeros(B, jnp.int32),
+                    jnp.full((B,), sl - 1, jnp.int32),
+                    d_tables,
+                    jnp.full((B,), sl, jnp.int32),
+                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                    jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                    jnp.ones(B, jnp.float32),
+                    jnp.zeros((MP, T), jnp.int32), p_tables[:MP],
+                    jnp.asarray(hists, jnp.int32),
+                    jnp.asarray(valids, jnp.int32),
+                    k_cache, v_cache,
+                    use_pallas=False,
+                )
+                _, _, k_cache, v_cache = out[:4]
+                assert k_cache.dtype == jnp.float8_e4m3fn
+    grown = llama.mixed_step._cache_size() - base
+    limit = len(seg_buckets) * len(buckets)
+    assert grown == limit, (
+        f"quantized-KV mixed_step compiled {grown} programs for "
+        f"{len(seg_buckets)} segment-count buckets x {len(buckets)} "
+        f"prefill buckets (expected {limit}) — the quantized cache "
+        "leaked a traced value into the static shape key"
+    )
+
+
+def test_mixed_step_tpu_lowering_uses_ragged_kernel_quantized_kv():
+    """The quantized-cache TPU path must still lower the ragged Mosaic
+    kernel — engine/engine.py's capability gate now keeps fp8 caches on
+    the Pallas path, and this pins that the lowering actually holds
+    (the in-kernel `.astype(f32)` page cast is the fused dequant)."""
+    cfg = ModelConfig.tiny(dtype="bfloat16", head_dim=128)
+    M = CTX // BLOCK
+    MP = 2
+    num_blocks = (B + MP) * M + 1
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(
+        cfg, num_blocks, BLOCK, dtype=jnp.float8_e4m3fn
+    )
+    d_tables = jnp.asarray(
+        np.arange(1, B * M + 1, dtype=np.int32).reshape(B, M)
+    )
+    p_tables = jnp.asarray(
+        np.arange(B * M + 1, (B + MP) * M + 1, dtype=np.int32)
+        .reshape(MP, M)
+    )
+    T = 32
+    exp = jexport.export(llama.mixed_step, platforms=["tpu"])(
+        params, cfg,
+        jnp.zeros(B, jnp.int32), jnp.full((B,), 10, jnp.int32), d_tables,
+        jnp.full((B,), 11, jnp.int32),
+        jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+        jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+        jnp.ones(B, jnp.float32),
+        jnp.zeros((MP, T), jnp.int32), p_tables,
+        jnp.zeros(MP, jnp.int32), jnp.full((MP,), T, jnp.int32),
+        k_cache, v_cache, use_pallas=True,
+    )
+    text = exp.mlir_module()
+    assert text.count("tpu_custom_call") >= 1, (
+        "no Mosaic kernel in the quantized-KV mixed step's TPU "
+        "lowering — the fp8 cache silently fell back to XLA"
+    )
+
+
 def test_mixed_step_tpu_lowering_uses_ragged_kernel():
     """The fused step's TPU path must actually lower the ragged
     mixed-attention Mosaic kernel (head_dim=128 matches the engine's

@@ -161,11 +161,14 @@ def test_disk_store_truncated_corrupt_and_version_mismatch(tmp_path):
     s = DiskKvStore(path, capacity_blocks=8)
     assert s.get(12) is None and s.corrupt_discards == 1
 
-    # version-mismatched header (an old/newer writer's format)
+    # version-mismatched header (an old/newer writer's format) — v1
+    # pre-scale-section entries hit this same check after the v2 bump
     fresh(13)
     raw = open(entry_file(13), "rb").read()
     (hlen,) = struct.unpack("<I", raw[4:8])
-    head = raw[8 : 8 + hlen].replace(b'"v": 1', b'"v": 9')
+    cur = f'"v": {DiskKvStore.VERSION}'.encode()
+    assert cur in raw[8 : 8 + hlen]
+    head = raw[8 : 8 + hlen].replace(cur, b'"v": 9')
     open(entry_file(13), "wb").write(
         raw[:4] + struct.pack("<I", len(head)) + head + raw[8 + hlen :]
     )
