@@ -645,6 +645,130 @@ def _ttft_trace_stats() -> dict:
         tracing.RECORDER.clear()
 
 
+def _slo_observatory_stats() -> dict:
+    """SLO observatory end to end (ISSUE 15): serve a traced wave
+    through the frontend metrics plane (real fixed-bucket histograms,
+    labeled by slo_class) with the flight recorder judging every
+    finish, induce exactly one SLO breach via a zero-threshold class,
+    and report histogram-derived p50/p99 TTFT + breach counts + whether
+    the breach's autopsy resolved with a decomposable timeline. Also
+    self-checks histogram consistency (count == observations,
+    cumulative buckets monotonic) so the artifact can't silently carry
+    a corrupted distribution."""
+    import asyncio
+
+    from dynamo_tpu import tracing
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.http.metrics import Metrics
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.observability import FlightRecorder, SloPolicy
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context
+
+    N = 8
+    cfg = EngineConfig(
+        model=ModelConfig.tiny(), num_blocks=64, block_size=8,
+        max_batch_size=4, max_context=128, prefill_chunk=32,
+    )
+    engine = JaxEngine(cfg, seed=0)
+    collector = tracing.TraceCollector()
+    tracing.configure(enabled=True, service="bench", sink=collector.ingest)
+    metrics = Metrics()
+    flight = FlightRecorder(
+        # interactive never breaches on this smoke; the "batch" class's
+        # zero threshold makes its one request the induced breach
+        SloPolicy(ttft_ms={"interactive": 60_000.0, "batch": 0.0001}),
+        collector=collector,
+        stats_provider=engine.load_metrics,
+        ledger_provider=lambda: engine.compile_ledger,
+        on_breach=metrics.observe_breach,
+    )
+
+    def req(toks):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    async def run_one(i, slo_class):
+        ctx = Context(req(range(40 + 17 * i, 80 + 17 * i)))
+        token = tracing.set_trace(tracing.TraceContext.for_request(ctx.id))
+        guard = metrics.inflight_guard("tiny", "chat_completions", slo_class)
+        try:
+            with tracing.span("frontend.request", request_id=ctx.id):
+                first = True
+                async for out in engine.generate(ctx):
+                    if out.token_ids:
+                        guard.observe_token()
+                        if first:
+                            first = False
+                            tracing.event(
+                                "frontend.first_token", request_id=ctx.id
+                            )
+            guard.mark_ok()
+        finally:
+            elapsed = guard.elapsed_ms
+            guard.done()
+            flight.finish(ctx.id, "tiny", slo_class, guard.status,
+                          guard.ttft_ms, elapsed)
+            tracing.reset_trace(token)
+        return ctx.id
+
+    async def run():
+        ids = []
+        for i in range(N):
+            ids.append(await run_one(
+                i, "batch" if i == N - 1 else "interactive"
+            ))
+        await engine.close()
+        return ids
+
+    try:
+        ids = asyncio.run(run())
+        ft = metrics.first_token
+        merged = None
+        observed = 0
+        consistent = True
+        for _key, h in ft.items():
+            observed += h.count
+            cum, mono = 0, True
+            for c in h.counts:
+                mono = mono and c >= 0
+                cum += c
+            consistent = consistent and mono and cum == h.count
+            if merged is None:
+                merged = h
+            else:
+                merged.merge(h)
+        autopsy = flight.autopsy(ids[-1])
+        return {"bench_slo_observatory": {
+            "requests": N,
+            "ttft_p50_ms": round((merged.quantile(0.5) or 0) * 1e3, 3),
+            "ttft_p99_ms": round((merged.quantile(0.99) or 0) * 1e3, 3),
+            "hist_observations": observed,
+            "hist_consistent": bool(consistent and observed == N),
+            "breaches": sum(metrics.slo_breaches.values()),
+            "breach_classes": {
+                cls: n for (_m, cls), n in sorted(metrics.slo_breaches.items())
+            },
+            "autopsy_ok": bool(
+                autopsy is not None
+                and autopsy.get("reason") == "slo_breach"
+                and (autopsy.get("ttft_decomposition") or {}).get("ttft_ms")
+            ),
+            "autopsies_total": flight.autopsies_total,
+        }}
+    finally:
+        tracing.configure(enabled=False, sink=None)
+        tracing.RECORDER.clear()
+
+
 def _churn_kill_stats() -> dict:
     """Goodput + p99 TTFT under a scripted worker kill (ISSUE 4): a
     two-worker pool serves a staggered request wave through the
@@ -2136,6 +2260,10 @@ def main() -> None:
         result.update(_ttft_trace_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["ttft_stats_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_slo_observatory_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_slo_observatory_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(_decode_itl_under_prefill())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands

@@ -37,6 +37,13 @@ suppression at the declaration end:
   ``kv_stream``/``kv_ici`` negotiation only works because BOTH ends
   exist; an advertised-but-unchecked flag is a fast path that silently
   never engages.
+* ``dashboard-metric-without-producer`` — a ``dynamo_tpu_*`` series
+  queried by any expr in the shipped Grafana dashboard that no render
+  site produces (metric constants / ``gauge()`` / ``hist_rows()`` /
+  ``HistogramVec()`` in the metric render modules). History: the
+  dashboard shipped ``*_seconds_bucket`` panels for histogram families
+  whose labels/render drifted across PRs — a flatlined panel raises no
+  error anywhere, so the drift is machine-checked now (ISSUE 15).
 * ``commit-block-purity`` — the engine-local flow rule: inside a
   ``# dynflow: commit-block`` region (the reshard commit PR 12
   established) nothing fallible is allowed — no calls, no awaits, no
@@ -53,11 +60,14 @@ Suppress exactly like dynlint, at the anchored line::
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable
 
 from .program import (
     COMMIT_BLOCK_BEGIN,
+    DASHBOARD_FILE,
     GAUGE_RENDER_MODULE,
+    METRIC_RENDER_MODULES,
     ProjectModel,
     Site,
     build_model,
@@ -454,6 +464,56 @@ class CommitBlockPurityRule(ContractRule):
         return out
 
 
+# ---------------------------------------------------------------------------
+# 9. dashboard-metric-without-producer
+# ---------------------------------------------------------------------------
+
+
+class DashboardMetricWithoutProducerRule(ContractRule):
+    name = "dashboard-metric-without-producer"
+    summary = "Grafana dashboard queries a series no render site produces"
+
+    #: the exposition prefix every shipped series carries
+    PREFIX = "dynamo_tpu_"
+    #: suffixes Prometheus derives from one histogram family
+    _HIST_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+
+    def check(self, model, files):
+        if not model.metrics_rendered:
+            # no render module in the file set (fixture/partial tree):
+            # there is no producer surface to judge queries against
+            return []
+        produced = set(model.metrics_rendered)
+        out: list[Violation] = []
+        render_sites = [
+            Site(m, 1, "metric render surface")
+            for m in METRIC_RENDER_MODULES
+            if any(p.endswith(m) or p == m for p in files)
+        ]
+        for path, src in sorted(files.items()):
+            if not path.endswith(DASHBOARD_FILE):
+                continue
+            queried = sorted(set(re.findall(
+                self.PREFIX + r"([a-z0-9_]+)", src
+            )))
+            for qname in queried:
+                base = self._HIST_SUFFIX.sub("", qname)
+                if qname in produced or base in produced:
+                    continue
+                idx = src.find(self.PREFIX + qname)
+                line = src.count("\n", 0, max(idx, 0)) + 1
+                out.append(Violation(
+                    self.name, path, line,
+                    f"dashboard queries series "
+                    f"'{self.PREFIX}{qname}' but no render site produces "
+                    "it — the panel flatlines with zero errors anywhere "
+                    "(declare the family in a metric render module, or "
+                    "fix/prune the stale panel expr)",
+                    evidence=_ev(render_sites),
+                ))
+        return out
+
+
 CONTRACT_RULES: tuple[ContractRule, ...] = (
     SubjectWithoutSubscriberRule(),
     HeaderWriteWithoutTolerantReadRule(),
@@ -463,6 +523,7 @@ CONTRACT_RULES: tuple[ContractRule, ...] = (
     DeadWireFieldRule(),
     VersionAdvertisedUncheckedRule(),
     CommitBlockPurityRule(),
+    DashboardMetricWithoutProducerRule(),
 )
 
 

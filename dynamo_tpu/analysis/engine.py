@@ -226,20 +226,40 @@ def lint_paths(
     return report
 
 
+def _iter_dashboard_files(paths: Iterable[str]) -> Iterable[str]:
+    """Grafana dashboard artifacts (the dashboard-metric-without-
+    producer rule's query side). Only ``*dashboard*.json`` files are
+    collected — bench artifacts and fixtures stay out of the model."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".json") and "dashboard" in os.path.basename(p):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d not in _SKIP_DIRS
+                ]
+                for f in sorted(filenames):
+                    if f.endswith(".json") and "dashboard" in f:
+                        yield os.path.join(dirpath, f)
+
+
 def read_files(
     paths: Sequence[str], root: Optional[str] = None
 ) -> tuple[dict[str, str], list[str]]:
     """Collect ``{relpath: source}`` for the given files/directories
-    (the same discovery as :func:`lint_paths`)."""
+    (the same discovery as :func:`lint_paths`, plus Grafana dashboard
+    JSON for the dashboard-producer contract)."""
     files: dict[str, str] = {}
     errors: list[str] = []
-    for path in _iter_py_files(paths):
-        rel = _rel(path, root)
-        try:
-            with open(path, encoding="utf-8") as f:
-                files[rel] = f.read()
-        except OSError as e:
-            errors.append(f"{rel}: {e}")
+    for it in (_iter_py_files(paths), _iter_dashboard_files(paths)):
+        for path in it:
+            rel = _rel(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    files[rel] = f.read()
+            except OSError as e:
+                errors.append(f"{rel}: {e}")
     return files, errors
 
 

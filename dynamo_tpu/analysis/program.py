@@ -94,6 +94,20 @@ FROM_STATS_MODULE = "dynamo_tpu/kv_router/scheduler.py"
 #: where WorkerLoad fields must surface to count as "rendered"
 GAUGE_RENDER_MODULE = "dynamo_tpu/observability/component.py"
 
+#: modules whose render surface defines the Prometheus series the
+#: Grafana dashboard may query (dashboard-metric-without-producer):
+#: metric names are declared there as ALL_CAPS string constants,
+#: ``gauge("name", ...)``/``hist_rows("name", ...)`` literals, or
+#: ``HistogramVec("name", ...)`` families
+METRIC_RENDER_MODULES = (
+    "dynamo_tpu/http/metrics.py",
+    "dynamo_tpu/observability/component.py",
+)
+
+#: the dashboard artifact the rule audits (collected by
+#: engine.read_files alongside the .py tree)
+DASHBOARD_FILE = "grafana-dashboard.json"
+
 #: receiver-name fragments marking a connection-info dict (the
 #: capability/version advertisement surface)
 CONN_NAMES = ("conn", "connection")
@@ -174,6 +188,10 @@ class ProjectModel:
     # -- capability / version advertisement --
     conn_advertised: dict[str, list[Site]] = field(default_factory=dict)
     conn_checked: dict[str, list[Site]] = field(default_factory=dict)
+
+    # -- rendered Prometheus series (dashboard contract) --
+    #: metric name WITHOUT the ``dynamo_tpu`` prefix -> render sites
+    metrics_rendered: dict[str, list[Site]] = field(default_factory=dict)
 
     # -- commit blocks --
     commit_blocks: list[CommitBlock] = field(default_factory=list)
@@ -589,6 +607,49 @@ def _wire_class_reads(path: str, tree: ast.Module, model: ProjectModel) -> None:
             )
 
 
+def _metric_renders(path: str, tree: ast.Module, model: ProjectModel) -> None:
+    """Rendered-series extraction for the dashboard contract. The render
+    modules declare their families instead of burying them in f-strings:
+    ALL_CAPS string constants (and tuples of them) name series suffixes,
+    ``gauge(...)``/``hist_rows(...)`` calls name gauges/histogram
+    families, ``HistogramVec(...)`` names a labeled family. The set
+    over-approximates (any underscore-bearing ALL_CAPS string counts),
+    which keeps the rule quiet unless a queried series is genuinely
+    absent from the whole render surface."""
+    if not (path.endswith(METRIC_RENDER_MODULES)
+            or path in METRIC_RENDER_MODULES):
+        return
+
+    def looks_like_metric(s: str) -> bool:
+        import re
+
+        return bool(re.fullmatch(r"[a-z][a-z0-9_]*", s)) and "_" in s
+
+    def add(name: str, lineno: int, note: str) -> None:
+        _add(model.metrics_rendered, name, Site(path, lineno, note))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Name) and tgt.id.isupper()):
+                continue
+            vals = (
+                node.value.elts
+                if isinstance(node.value, (ast.Tuple, ast.List))
+                else [node.value]
+            )
+            for v in vals:
+                s = _str_const(v)
+                if s is not None and looks_like_metric(s):
+                    add(s, v.lineno, f"{tgt.id} constant")
+        elif isinstance(node, ast.Call) and node.args:
+            leaf = _dotted(node.func).rsplit(".", 1)[-1]
+            if leaf in ("gauge", "hist_rows", "HistogramVec"):
+                s = _str_const(node.args[0])
+                if s is not None and looks_like_metric(s):
+                    add(s, node.lineno, f"{leaf}() render")
+
+
 def _conn_plane(path: str, tree: ast.Module, model: ProjectModel) -> None:
     """Connection-info capability advertisement (``conn["kv_ici"] = 1``)
     vs peer-side checks (``connection.get("kv_ici")``)."""
@@ -735,5 +796,6 @@ def build_model(files: dict[str, str]) -> ProjectModel:
         _workerload_uses(path, tree, model)
         _wire_class_reads(path, tree, model)
         _conn_plane(path, tree, model)
+        _metric_renders(path, tree, model)
         _commit_blocks(path, files[path], model)
     return model

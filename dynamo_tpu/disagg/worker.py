@@ -899,9 +899,15 @@ class DisaggEngine(AsyncEngine):
             "disagg.remote_prefill", request_id=req_id,
             prompt_tokens=prompt_len, skip_blocks=handle.skip_blocks,
         )
+        t_handoff = time.perf_counter()
         try:
             await self.queue.enqueue(rpr)
             delivery = await asyncio.wait_for(fut, self.transfer_timeout)
+            # whole remote leg (queue + prefill + KV transfer) into the
+            # worker's handoff distribution (SLO observatory plane)
+            self.engine.hist["handoff_ms"].observe(
+                (time.perf_counter() - t_handoff) * 1e3
+            )
         except asyncio.CancelledError:
             # caller went away: clean up the reservation, propagate.
             # The sink must close BEFORE abort_remote frees the blocks —

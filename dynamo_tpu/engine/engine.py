@@ -44,6 +44,7 @@ from ..protocols.common import (
     StopConditions,
 )
 from ..analysis import sanitizer
+from ..observability.hist import MS_BUCKETS, Histogram
 from ..resilience import faultpoints
 from ..resilience.faultpoints import FaultInjected
 from ..resilience.policy import MIGRATION_SIGNAL
@@ -547,7 +548,37 @@ class JaxEngine(AsyncEngine):
             # (engine/kvquant.measure_logprob_drift) recorded against
             # this engine's quantized tiers; 0 until a harness ran
             "kv_quant_logprob_drift_max": 0.0,
+            # XLA compile ledger (docs/observability.md): first-dispatch
+            # count + wall-ms per distinct program bucket, and the
+            # warmup coverage report (_warm coverage in warmup()) —
+            # cold-bucket compile stalls in production become
+            # attributable instead of anonymous 20-40s TTFTs
+            "xla_compiles_total": 0,
+            "xla_compile_ms_total": 0.0,
+            "xla_warm_buckets": 0,
+            "xla_reachable_buckets": 0,
         }
+        # SLO observatory worker-side latency distributions
+        # (docs/observability.md): fixed log-bucket histograms riding
+        # load_metrics as serialized vectors -> WorkerLoad.hists -> the
+        # metrics component's per-worker histogram families. Observed
+        # from the loop AND device-executor threads; a lost count under
+        # a rare unlocked race is acceptable for this plane (same
+        # tradeoff as the sanitizer's own histograms).
+        self.hist = {
+            "queue_wait_ms": Histogram(MS_BUCKETS),
+            "prefill_ms": Histogram(MS_BUCKETS),
+            "restore_ms": Histogram(MS_BUCKETS),
+            "handoff_ms": Histogram(MS_BUCKETS),
+        }
+        # (kind, *bucket-shape) keys whose program has dispatched at
+        # least once — the complement of "about to pay a compile stall"
+        self._compiled_keys: set[tuple] = set()
+        #: newest-last {kind, key, ms} entries (bounded); the flight
+        #: recorder's autopsies carry the tail so a compile-stalled TTFT
+        #: names the program that compiled inside its window
+        self.compile_ledger: list[dict] = []
+        self._weight_bytes: Optional[int] = None
 
     def _use_pallas_for(self, mesh) -> bool:
         """Pallas decode path for ``mesh``: TPU backend + aligned tiles.
@@ -728,6 +759,30 @@ class JaxEngine(AsyncEngine):
                     pass
         finally:
             self.cfg.spec_gamma = gamma
+        # compile-warmup coverage report (docs/observability.md): how
+        # many serving-path program buckets this warmup actually
+        # compiled vs what production traffic can reach through it —
+        # the gap is the cold-bucket compile-stall exposure the ledger
+        # will attribute later (xla_warm_buckets/xla_reachable_buckets
+        # gauges through load_metrics)
+        warm = sum(
+            1 for k in self._compiled_keys
+            if k[0] in ("prefill", "decode", "mixed")
+        )
+        reachable = len(sizes)
+        if decode:
+            w = W
+            while w >= 1:  # the _pick_window power-of-two ladder
+                reachable += 1
+                w //= 2
+        self.stats["xla_warm_buckets"] = warm
+        self.stats["xla_reachable_buckets"] = reachable
+        logger.info(
+            "warmup coverage: %d/%d reachable program buckets compiled "
+            "(%d total compiles, %.0f ms compile wall)",
+            warm, reachable, self.stats["xla_compiles_total"],
+            self.stats["xla_compile_ms_total"],
+        )
         return sizes
 
     async def generate(self, request: Context) -> AsyncIterator[LLMEngineOutput]:
@@ -804,10 +859,86 @@ class JaxEngine(AsyncEngine):
             if out.is_final():
                 return
 
+    def _hbm_stats(self) -> dict:
+        """TPU device-memory telemetry (docs/observability.md): real
+        allocator numbers from ``device.memory_stats()`` where the
+        backend exposes them (TPU does; CPU returns nothing), with the
+        engine's own attribution — KV pool and weight bytes are computed
+        from the arrays themselves, so they are exact on every backend.
+        When the allocator view is unavailable, ``in_use`` falls back to
+        the attributed sum (flagged by ``limit == 0``) so the gauge
+        exists fleet-wide instead of silently disappearing on CPU."""
+        kv = int(getattr(self.k_cache, "nbytes", 0) or 0) + int(
+            getattr(self.v_cache, "nbytes", 0) or 0
+        )
+        if self._weight_bytes is None:
+            try:
+                self._weight_bytes = sum(
+                    int(getattr(x, "nbytes", 0) or 0)
+                    for x in jax.tree.leaves(self.params)
+                )
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                self._weight_bytes = 0
+        in_use = limit = 0
+        try:
+            dev = (
+                self.mesh.devices.flat[0] if self.mesh is not None
+                else jax.local_devices()[0]
+            )
+            ms = dev.memory_stats() or {}
+            in_use = int(ms.get("bytes_in_use", 0) or 0)
+            limit = int(ms.get("bytes_limit", 0) or 0)
+        except Exception:  # noqa: BLE001 — backend without memory_stats
+            logger.debug("device memory_stats unavailable", exc_info=True)
+        if not in_use:
+            in_use = kv + self._weight_bytes
+        return {"in_use": in_use, "limit": limit, "kv_pool": kv,
+                "weights": self._weight_bytes}
+
+    async def profile(self, seconds: float) -> str:
+        """On-demand ``jax.profiler`` capture (the frontend's
+        ``POST /profile?seconds=N``): trace every device for N seconds
+        into a fresh directory and return its path (TensorBoard /
+        Perfetto-loadable). Runs in an executor thread so serving, lease
+        keepalives and scrapes continue underneath the capture."""
+        import tempfile
+
+        out_dir = tempfile.mkdtemp(prefix="dynamo-profile-")
+
+        def _capture() -> str:
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            return out_dir
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _capture
+        )
+
     def load_metrics(self) -> dict:
         """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
         self._register_device_executor()
         out = {}
+        # SLO observatory: worker latency distributions as serialized
+        # bucket vectors (merged loss-free downstream), the XLA compile
+        # ledger counters + warmup coverage, and HBM telemetry
+        out["hist_queue_wait_ms"] = self.hist["queue_wait_ms"].to_vec()
+        out["hist_prefill_ms"] = self.hist["prefill_ms"].to_vec()
+        out["hist_restore_ms"] = self.hist["restore_ms"].to_vec()
+        out["hist_handoff_ms"] = self.hist["handoff_ms"].to_vec()
+        out["xla_compiles_total"] = self.stats["xla_compiles_total"]
+        out["xla_compile_ms_total"] = round(
+            self.stats["xla_compile_ms_total"], 3
+        )
+        out["xla_warm_buckets"] = self.stats["xla_warm_buckets"]
+        out["xla_reachable_buckets"] = self.stats["xla_reachable_buckets"]
+        hbm = self._hbm_stats()
+        out["hbm_bytes_in_use"] = hbm["in_use"]
+        out["hbm_bytes_limit"] = hbm["limit"]
+        out["hbm_kv_pool_bytes"] = hbm["kv_pool"]
+        out["hbm_weights_bytes"] = hbm["weights"]
         if self.offload is not None:
             # piggyback the (loop-side) stats scrape to publish queued
             # tier-drop removals: blocks that left the LAST local tier
@@ -1174,6 +1305,13 @@ class JaxEngine(AsyncEngine):
         moved = self.allocator.resident_count
         self.stats["resharded_total"] += 1
         self.stats["reshard_kv_moved_blocks"] += moved
+        # SLO observatory invalidation: every jit program recompiles
+        # under the new shardings on its next dispatch — clearing the
+        # compiled-key set keeps the compile ledger seeing (and tracing)
+        # those post-morph stalls instead of treating them as warm; the
+        # weight-bytes attribution re-derives from the new params
+        self._compiled_keys.clear()
+        self._weight_bytes = None
         # ---- committed ----
         faultpoints.hit_sync("mid_reshard", phase="committed")
         return {
@@ -1521,19 +1659,21 @@ class JaxEngine(AsyncEngine):
             return False
         history, upload = reserved
         self.stats["prefix_cache_hits_tokens"] += history
-        if seq.trace is not None and seq.generated == 0:
+        if seq.generated == 0:
             # admission latency: arrival -> blocks reserved, reconstructed
             # backwards so the span's start anchors at arrival time. A
             # preemption REPLAY (generated > 0) is post-first-token work:
             # re-recording would overlap the original span and break the
             # decomposition's sum-to-TTFT contract
             waited_s = time.monotonic() - seq.arrival_t
-            tracing.RECORDER.record_span(
-                "engine.queue_wait", seq.trace,
-                ts=time.time() - waited_s, dur_ms=waited_s * 1e3,
-                request_id=seq.context.id,
-                waiting=self._waiting_size(),
-            )
+            self.hist["queue_wait_ms"].observe(waited_s * 1e3)
+            if seq.trace is not None:
+                tracing.RECORDER.record_span(
+                    "engine.queue_wait", seq.trace,
+                    ts=time.time() - waited_s, dur_ms=waited_s * 1e3,
+                    request_id=seq.context.id,
+                    waiting=self._waiting_size(),
+                )
         self._prefill_states.append(
             _PrefillState(seq=seq, pos=history, upload=upload)
         )
@@ -1572,15 +1712,18 @@ class JaxEngine(AsyncEngine):
         if first_token is None:
             return False  # more chunks to go
         first_token, first_lp = first_token
-        if seq.trace is not None and seq.generated == 0:
+        if seq.generated == 0:
             # first prefill only — a preemption replay's prefill is
             # post-first-token and must not re-enter the decomposition
-            tracing.RECORDER.record_span(
-                "engine.prefill", seq.trace, ts=st.t0_wall,
-                dur_ms=st.dev_ms,
-                request_id=seq.context.id,
-                prompt_tokens=seq.prompt_len, cached_prefix=seq.cached_prefix,
-            )
+            self.hist["prefill_ms"].observe(st.dev_ms)
+            if seq.trace is not None:
+                tracing.RECORDER.record_span(
+                    "engine.prefill", seq.trace, ts=st.t0_wall,
+                    dur_ms=st.dev_ms,
+                    request_id=seq.context.id,
+                    prompt_tokens=seq.prompt_len,
+                    cached_prefix=seq.cached_prefix,
+                )
         self._drop_prefill_state(st)
         self._commit_full_blocks(seq)
         self._emit_token(seq, first_token, first_lp)
@@ -1675,6 +1818,7 @@ class JaxEngine(AsyncEngine):
             self.k_cache, self.v_cache = self.offload.finish_upload(
                 self.k_cache, self.v_cache, upload
             )
+            self.hist["restore_ms"].observe((time.perf_counter() - t0) * 1e3)
             if seq is not None and seq.trace is not None and seq.generated == 0:
                 waited_ms = (time.perf_counter() - t0) * 1e3
                 t_landed = getattr(upload, "t_landed", None)
@@ -1736,7 +1880,8 @@ class JaxEngine(AsyncEngine):
                     self.params, toks, self._table_for(seq), pos,
                     len(chunk), self.k_cache, self.v_cache,
                     use_pallas=self.use_pallas, use_ring=ring,
-                )
+                ),
+                key=("prefill", T, ring), trace=seq.trace,
             )
             return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0
@@ -1753,7 +1898,8 @@ class JaxEngine(AsyncEngine):
                 use_pallas=self.use_pallas,
                 mesh=self.mesh,
                 use_ring=ring,
-            )
+            ),
+            key=("prefill", T, ring), trace=seq.trace,
         )
         return logits, pos + len(chunk)
 
@@ -2669,14 +2815,16 @@ class JaxEngine(AsyncEngine):
         for st, first in completed:
             seq_p = st.seq
             first_token, first_lp = first
-            if seq_p.trace is not None and seq_p.generated == 0:
-                tracing.RECORDER.record_span(
-                    "engine.prefill", seq_p.trace, ts=st.t0_wall,
-                    dur_ms=st.dev_ms,
-                    request_id=seq_p.context.id,
-                    prompt_tokens=seq_p.prompt_len,
-                    cached_prefix=seq_p.cached_prefix,
-                )
+            if seq_p.generated == 0:
+                self.hist["prefill_ms"].observe(st.dev_ms)
+                if seq_p.trace is not None:
+                    tracing.RECORDER.record_span(
+                        "engine.prefill", seq_p.trace, ts=st.t0_wall,
+                        dur_ms=st.dev_ms,
+                        request_id=seq_p.context.id,
+                        prompt_tokens=seq_p.prompt_len,
+                        cached_prefix=seq_p.cached_prefix,
+                    )
             self._drop_prefill_state(st)
             self._commit_full_blocks(seq_p)
             self._emit_token(seq_p, first_token, first_lp)
@@ -2799,7 +2947,7 @@ class JaxEngine(AsyncEngine):
                 merged=cfg.decode_merged,
                 with_logprobs=want_lp,
                 **kwargs,
-            ))
+            ), key=("mixed", MP, T, penalized, want_lp))
             toks, p_logits, self.k_cache, self.v_cache = out[:4]
             rest = list(out[4:])
             if penalized:
@@ -2829,12 +2977,40 @@ class JaxEngine(AsyncEngine):
             for st, take in packed:
                 st.dev_ms += dt_ms * (take / total_take)
 
-    def _pallas_guard(self, thunk):
+    def _note_compile(self, key: tuple, wall_ms: float, trace=None) -> None:
+        """First dispatch of a program bucket: ledger it. The wall time
+        of a first dispatch is dominated by trace+compile (steady-state
+        dispatch of a compiled program returns in microseconds), so the
+        entry's ``ms`` is the compile stall a cold request would have
+        paid. With a request trace in scope the compile is also stamped
+        into that request's timeline — the autopsy names it."""
+        self._compiled_keys.add(key)
+        entry = {"kind": key[0], "key": list(key[1:]),
+                 "ms": round(wall_ms, 3)}
+        self.compile_ledger.append(entry)
+        if len(self.compile_ledger) > 512:
+            del self.compile_ledger[:-256]
+        self.stats["xla_compiles_total"] += 1
+        self.stats["xla_compile_ms_total"] += wall_ms
+        if trace is not None:
+            tracing.RECORDER.event(
+                "engine.xla_compile", trace=trace,
+                kind=key[0], key=str(key[1:]), ms=round(wall_ms, 3),
+            )
+        if wall_ms > 1000.0:
+            logger.info("XLA compile: %s %s took %.0f ms",
+                        key[0], key[1:], wall_ms)
+
+    def _pallas_guard(self, thunk, key: Optional[tuple] = None, trace=None):
         """Run a device dispatch; if Mosaic rejects a kernel at its
         FIRST compile (a constraint the CPU tests can't prove — e.g. the
         sub-128 pe-stream lane tiles, advisor r3), flip ``use_pallas``
         off and retry once on the XLA path instead of failing the
         request. The thunk must read ``self.use_pallas`` at call time.
+
+        ``key`` names the dispatch's program bucket (kind + the shape
+        coordinates the jit cache keys on): the first dispatch of each
+        bucket is timed into the XLA compile ledger (_note_compile).
 
         Two hard gates on the retry:
           * mirror mode never retries — the step descriptor (with
@@ -2848,8 +3024,10 @@ class JaxEngine(AsyncEngine):
             intact, but an EXECUTION-stage Mosaic error arrives after
             donation and a retry would dispatch on deleted arrays.
         """
+        cold = key is not None and key not in self._compiled_keys
+        t0 = time.perf_counter() if cold else 0.0
         try:
-            return thunk()
+            out = thunk()
         except Exception as e:  # noqa: BLE001 — inspected, re-raised
             msg = str(e).lower()
             if (
@@ -2865,7 +3043,10 @@ class JaxEngine(AsyncEngine):
                 "falling back to XLA attention for this engine: %s", e
             )
             self.use_pallas = False
-            return thunk()
+            out = thunk()
+        if cold:
+            self._note_compile(key, (time.perf_counter() - t0) * 1e3, trace)
+        return out
 
     def _dispatch_verify(
         self, window: np.ndarray, proposals: np.ndarray, steps: np.ndarray
@@ -2889,7 +3070,7 @@ class JaxEngine(AsyncEngine):
                 pen_state=(self._pen_counts, self._pen_mask)
                 if penalized else None,
                 with_logprobs=want_lp,
-            ))
+            ), key=("verify", cfg.spec_gamma, penalized, want_lp))
             toks, n_acc, self.k_cache, self.v_cache = out[:4]
             rest = list(out[4:])
             if penalized:
@@ -2925,7 +3106,7 @@ class JaxEngine(AsyncEngine):
             mesh=self.mesh,
             with_logprobs=want_lp,
             **kwargs,
-        ))
+        ), key=("verify", cfg.spec_gamma, penalized, want_lp))
         toks, n_acc, self.k_cache, self.v_cache = out[:4]
         rest = list(out[4:])
         if penalized:
@@ -3061,7 +3242,7 @@ class JaxEngine(AsyncEngine):
                 tokens_dev=tokens_in,
                 sync=False,  # device handle; materialized at emission so
                 # a pipelined next window dispatches without waiting
-            ))
+            ), key=("decode", n, penalized, want_lp))
             toks, self.k_cache, self.v_cache = out[0], out[1], out[2]
             rest = list(out[3:])
             if penalized:
@@ -3104,13 +3285,13 @@ class JaxEngine(AsyncEngine):
                 rep_pens=jnp.asarray(self._rep_pens),
                 counts=self._pen_counts,
                 prompt_mask=self._pen_mask,
-            ))
+            ), key=("decode", n, True, want_lp))
             toks, self.k_cache, self.v_cache, self._pen_counts = out[:4]
             lps = out[4] if want_lp else None
         else:
             out = self._pallas_guard(lambda: llama.decode_window(
                 *args, **kw, use_pallas=self.use_pallas
-            ))
+            ), key=("decode", n, False, want_lp))
             toks, self.k_cache, self.v_cache = out[:3]
             lps = out[3] if want_lp else None
         # device handles; materialized at emission (fetching here would

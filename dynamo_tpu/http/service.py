@@ -26,7 +26,7 @@ from ..protocols.sse import encode_comment, encode_data, encode_done, encode_eve
 from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, Context
 from .base import HttpError, HttpServerBase, _STATUS_TEXT  # noqa: F401 — HttpError re-exported
-from .metrics import Metrics
+from .metrics import DEFAULT_SLO_CLASS, Metrics
 
 logger = logging.getLogger(__name__)
 
@@ -88,12 +88,24 @@ class HttpService(HttpServerBase):
         metrics: Optional[Metrics] = None,
         trace_collector=None,
         admission=None,
+        flight=None,
+        profiler=None,
     ):
         super().__init__(host=host, port=port)
         self.models = model_manager or ModelManager()
         self.metrics = metrics or Metrics()
         # tracing.TraceCollector serving /trace/{request_id} (None = off)
         self.tracing = trace_collector
+        # observability.FlightRecorder (None = off): every finished
+        # request is recorded; SLO breaches / error finishes persist an
+        # autopsy served at /autopsy/{request_id}
+        self.flight = None
+        if flight is not None:
+            self.attach_flight(flight)
+        # async callable (seconds -> trace dir) running jax.profiler on
+        # the serving engine; wired by dynamo_run when the engine is
+        # in-process (None = POST /profile answers 501)
+        self.profiler = profiler
         # planner.AdmissionGate overload control (None = admit all):
         # shed requests get 429 + Retry-After BEFORE touching the
         # engine, so admitted requests keep their SLO under overload
@@ -105,6 +117,16 @@ class HttpService(HttpServerBase):
         # disagg transfer futures) onto one id — the second request
         # falls back to a minted uuid instead
         self._inflight_ids: set[str] = set()
+
+    def attach_flight(self, flight) -> None:
+        """Wire a FlightRecorder to this service: its counters join the
+        /metrics exposition, and breach counting drives
+        ``slo_breaches_total`` so the counter and the autopsy inventory
+        can never drift apart."""
+        self.flight = flight
+        self.metrics.register_source(flight.counters)
+        if flight.on_breach is None:
+            flight.on_breach = self.metrics.observe_breach
 
     # ---------------- routing ----------------
 
@@ -126,6 +148,8 @@ class HttpService(HttpServerBase):
                 await self._send_json(writer, 200, {"object": "list", "data": data})
             elif path.startswith("/trace/") or path == "/trace":
                 await self._trace_endpoint(writer, path, query)
+            elif path.startswith("/autopsy/") or path == "/autopsy":
+                await self._autopsy_endpoint(writer, path)
             else:
                 raise HttpError(404, f"no route for GET {path}", "not_found")
         elif method == "POST":
@@ -133,6 +157,8 @@ class HttpService(HttpServerBase):
                 await self._openai_endpoint(writer, headers, body, chat=True)
             elif path == "/v1/completions":
                 await self._openai_endpoint(writer, headers, body, chat=False)
+            elif path == "/profile":
+                await self._profile_endpoint(writer, query)
             else:
                 raise HttpError(404, f"no route for POST {path}", "not_found")
         else:
@@ -161,6 +187,63 @@ class HttpService(HttpServerBase):
         if fmt == "timeline":
             body = {"request_id": trace_id, **body}
         await self._send_json(writer, 200, body)
+
+    # ---------------- flight recorder + profiler ----------------
+
+    async def _autopsy_endpoint(self, writer, path: str) -> None:
+        """``GET /autopsy/{request_id}`` — the persisted slow-request
+        autopsy (timeline + decomposition + engine/sanitizer/compile
+        snapshots); ``GET /autopsy`` lists autopsied request ids."""
+        if self.flight is None:
+            raise HttpError(
+                404, "flight recorder is not enabled", "flight_disabled"
+            )
+        if path in ("/autopsy", "/autopsy/"):
+            await self._send_json(writer, 200, {
+                "autopsies": self.flight.autopsy_ids(),
+                "records_total": self.flight.recorded_total,
+                "autopsies_total": self.flight.autopsies_total,
+            })
+            return
+        rid = path[len("/autopsy/"):]
+        body = self.flight.autopsy(rid)
+        if body is None:
+            raise HttpError(404, f"no autopsy for {rid!r}", "autopsy_not_found")
+        await self._send_json(writer, 200, body)
+
+    async def _profile_endpoint(self, writer, query: str) -> None:
+        """``POST /profile?seconds=N`` — run ``jax.profiler`` on the
+        in-process engine for N seconds and return the trace path."""
+        if self.profiler is None:
+            raise HttpError(
+                501, "profiler is not wired on this frontend "
+                "(in-process engine required)", "profiler_unavailable",
+            )
+        import math
+
+        seconds = 2.0
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "seconds" and v:
+                try:
+                    seconds = float(v)
+                except ValueError:
+                    raise HttpError(400, f"bad seconds={v!r}") from None
+                if not math.isfinite(seconds):
+                    # nan slides through min/max clamps (every NaN
+                    # comparison is False) straight into time.sleep
+                    raise HttpError(400, f"bad seconds={v!r}")
+        seconds = min(max(seconds, 0.1), 120.0)
+        try:
+            trace_dir = await self.profiler(seconds)
+        except Exception as e:  # noqa: BLE001 — surface, don't 500-loop
+            raise HttpError(
+                500, f"profiler failed: {type(e).__name__}: {e}",
+                "profiler_error",
+            ) from None
+        await self._send_json(
+            writer, 200, {"trace_dir": trace_dir, "seconds": seconds}
+        )
 
     # ---------------- openai endpoints (ref openai.rs:132,214) ----------------
 
@@ -223,7 +306,9 @@ class HttpService(HttpServerBase):
                     retry_after_s=decision.retry_after_s,
                 )
 
-        guard = self.metrics.inflight_guard(req.model, endpoint)
+        guard = self.metrics.inflight_guard(
+            req.model, endpoint, slo_class or DEFAULT_SLO_CLASS
+        )
         client_rid = self._client_request_id(headers)
         if client_rid is not None:
             if client_rid in self._inflight_ids:
@@ -293,12 +378,22 @@ class HttpService(HttpServerBase):
                 guard.mark_ok()
                 await self._send_json(writer, 200, full)
         finally:
+            elapsed_ms = guard.elapsed_ms
             guard.done()
+            # close the request span BEFORE the flight recorder judges
+            # the finish: the decomposition needs the frontend.request
+            # anchor in the collector, or a breach autopsy would carry
+            # a timeline that can't decompose
+            req_span.end()
+            if self.flight is not None:
+                self.flight.finish(
+                    context.id, req.model, guard.slo_class, guard.status,
+                    guard.ttft_ms, elapsed_ms,
+                )
             if slo_class is not None:
                 self.admission.done(slo_class)
             if client_rid is not None:
                 self._inflight_ids.discard(client_rid)
-            req_span.end()
             if trace_token is not None:
                 tracing.reset_trace(trace_token)
 

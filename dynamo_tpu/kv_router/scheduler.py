@@ -155,6 +155,29 @@ class WorkerLoad:
     ici_handoffs: int = 0
     peer_serve_d2h_blocks: int = 0
     weight_prestage_requests: int = 0
+    # SLO observatory (docs/observability.md): worker-side latency
+    # distributions as serialized histogram bucket vectors
+    # (observability/hist.py to_vec form, keyed queue_wait_ms /
+    # prefill_ms / restore_ms / handoff_ms) — the metrics component
+    # renders them as per-worker Prometheus histogram families and the
+    # planner's telemetry merges them loss-free across the fleet
+    hists: dict = field(default_factory=dict)
+    # XLA compile ledger counters + warmup coverage: distinct program
+    # buckets compiled (with total compile wall-ms), and how many of
+    # the warmup-reachable buckets were actually warmed — a production
+    # TTFT spike correlating with a compiles_total step IS a cold
+    # bucket, attributable instead of anonymous
+    xla_compiles: int = 0
+    xla_compile_ms: float = 0.0
+    xla_warm_buckets: int = 0
+    xla_reachable_buckets: int = 0
+    # TPU device-memory telemetry: allocator view (bytes_limit == 0
+    # marks the attributed-sum fallback on backends without
+    # memory_stats) plus the engine's exact KV-pool/weights attribution
+    hbm_bytes_in_use: int = 0
+    hbm_bytes_limit: int = 0
+    hbm_kv_pool_bytes: int = 0
+    hbm_weights_bytes: int = 0
     # monotonic stamp set at scrape time (None = constructed directly /
     # legacy producer): the scheduler discards loads older than
     # ``SchedulerConfig.load_ttl_s`` instead of trusting a dead
@@ -223,6 +246,24 @@ class WorkerLoad:
             ici_handoffs=d.get("ici_handoffs", 0),
             peer_serve_d2h_blocks=d.get("peer_serve_d2h_blocks_total", 0),
             weight_prestage_requests=d.get("weight_prestage_requests", 0),
+            hists={
+                name: vec
+                for name, vec in (
+                    ("queue_wait_ms", d.get("hist_queue_wait_ms")),
+                    ("prefill_ms", d.get("hist_prefill_ms")),
+                    ("restore_ms", d.get("hist_restore_ms")),
+                    ("handoff_ms", d.get("hist_handoff_ms")),
+                )
+                if vec
+            },
+            xla_compiles=d.get("xla_compiles_total", 0),
+            xla_compile_ms=d.get("xla_compile_ms_total", 0.0),
+            xla_warm_buckets=d.get("xla_warm_buckets", 0),
+            xla_reachable_buckets=d.get("xla_reachable_buckets", 0),
+            hbm_bytes_in_use=d.get("hbm_bytes_in_use", 0),
+            hbm_bytes_limit=d.get("hbm_bytes_limit", 0),
+            hbm_kv_pool_bytes=d.get("hbm_kv_pool_bytes", 0),
+            hbm_weights_bytes=d.get("hbm_weights_bytes", 0),
             ts=ts,
         )
 

@@ -321,6 +321,51 @@ async def connect_runtime(args) -> DistributedRuntime:
 # ---------------- in= modes ----------------
 
 
+def _build_flight(args, collector=None, core=None):
+    """SLO observatory flight recorder for a frontend role
+    (observability/flight.py). Always-on by default: the ring is
+    bounded and a record is a dict append, so the cost is noise. The
+    autopsy providers (engine stats / sanitizer counters / XLA compile
+    ledger) wire only when the engine runs in-process — a distributed
+    frontend's autopsies carry the timeline + decomposition it can
+    see."""
+    if args.no_flight_recorder:
+        return None
+    from ..analysis import sanitizer
+    from ..observability import FlightRecorder, SloPolicy
+
+    per_class: dict[str, float] = {}
+    default_ms = 0.0
+    for part in (args.autopsy_ttft_ms or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, sep, ms = part.partition("=")
+        try:
+            if sep:
+                per_class[cls.strip()] = float(ms)
+            else:
+                default_ms = float(part)
+        except ValueError:
+            raise SystemExit(
+                f"bad --autopsy-ttft-ms entry {part!r} "
+                "(want MS or class=MS[,class=MS...])"
+            ) from None
+    kw = {}
+    if core is not None:
+        kw = dict(
+            stats_provider=core.load_metrics,
+            sanitizer_provider=sanitizer.counters,
+            ledger_provider=lambda: core.compile_ledger,
+        )
+    return FlightRecorder(
+        SloPolicy(ttft_ms=per_class, default_ttft_ms=default_ms),
+        collector=collector,
+        autopsy_dir=args.autopsy_dir,
+        **kw,
+    )
+
+
 def _build_admission(args):
     """--admission-rate > 0 -> the frontend overload gate (planner/
     admission.py): token-bucket shedding with SLO classes, so admitted
@@ -389,6 +434,9 @@ async def run_http(args) -> None:
         svc.tracing = await setup_tracing(
             args, "frontend", drt=drt, collector=True
         )
+        flight = _build_flight(args, collector=svc.tracing)
+        if flight is not None:
+            svc.attach_flight(flight)
     elif args.out.startswith("dyn://"):
         drt = await connect_runtime(args)
         await ModelWatcher(drt, manager).start()
@@ -397,6 +445,9 @@ async def run_http(args) -> None:
         svc.tracing = await setup_tracing(
             args, "frontend", drt=drt, collector=True
         )
+        flight = _build_flight(args, collector=svc.tracing)
+        if flight is not None:
+            svc.attach_flight(flight)
     else:
         cfg, params, tokenizer, name = build_model(args)
         core = build_core_engine(args, cfg, params)
@@ -406,6 +457,17 @@ async def run_http(args) -> None:
         manager.add_completion_model(name, engine)
         # single process: local spans feed the collector directly
         svc.tracing = await setup_tracing(args, "frontend", collector=True)
+        flight = _build_flight(
+            args, collector=svc.tracing,
+            core=core if isinstance(core, JaxEngine) else None,
+        )
+        if flight is not None:
+            svc.attach_flight(flight)
+        if isinstance(core, JaxEngine):
+            # in-process engine: POST /profile drives jax.profiler on
+            # the serving devices (autopsies already carry its stats /
+            # sanitizer / compile-ledger snapshots via _build_flight)
+            svc.profiler = core.profile
     if admission is not None and args.out.startswith("dyn://"):
         # planner capacity watermarks continuously retune the gate's
         # admission rate to the fleet's corrected serving capacity
@@ -1095,6 +1157,22 @@ def main(argv=None) -> None:
                         "across frontend/router/workers, /trace/{id} "
                         "timelines + per-request TTFT decomposition "
                         "(also: DYN_TRACE=1)")
+    p.add_argument("--no-flight-recorder", action="store_true",
+                   help="disable the frontend flight recorder "
+                        "(observability/flight.py): request-timeline "
+                        "ring + slow-request autopsies at "
+                        "/autopsy/{request_id} (on by default; the "
+                        "ring is bounded and near-zero-cost)")
+    p.add_argument("--autopsy-ttft-ms", default="",
+                   help="SLO-breach autopsy thresholds: a TTFT target "
+                        "in ms, flat ('2000') or per class "
+                        "('interactive=2000,batch=30000'); a request "
+                        "whose TTFT exceeds its class target is "
+                        "autopsied and counted in slo_breaches_total. "
+                        "Empty = autopsy only error finishes")
+    p.add_argument("--autopsy-dir", default=None,
+                   help="persist autopsy JSONs here (default: in-memory "
+                        "ring only)")
     p.add_argument("--sanitize", action="store_true",
                    default=os.environ.get("DYN_SANITIZE", "") not in ("", "0"),
                    help="run the role under the asyncio hot-path sanitizer "

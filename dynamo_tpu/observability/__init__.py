@@ -1,17 +1,32 @@
 """Observability plane (ref components/metrics, §2.3 + SURVEY §5).
 
-Three tiers, like the reference:
- 1. per-process Prometheus counters in the HTTP frontend
-    (dynamo_tpu/http/metrics.py),
- 2. per-endpoint stats handlers scraped over the bus
+Four tiers (docs/observability.md):
+ 1. per-process Prometheus counters + latency histograms in the HTTP
+    frontend (dynamo_tpu/http/metrics.py — the ``*_seconds_bucket``
+    families the shipped Grafana dashboard queries),
+ 2. per-endpoint stats handlers scraped over the bus, including
+    serialized worker-side histograms and TPU device telemetry
     (runtime/component.py stats subjects + kv_router KvMetricsAggregator),
- 3. THIS package — the fleet-level aggregation component: scrapes every
-    worker of an endpoint, subscribes the kv-hit-rate event plane, and
-    serves Prometheus gauges (kv_blocks_active/total,
-    requests_active/total, …) for ops dashboards
-    (ref components/metrics/src/{main,lib}.rs:255,145-364).
+ 3. the fleet-level aggregation component: scrapes every worker of an
+    endpoint, subscribes the kv-hit-rate event plane, and serves
+    Prometheus gauges + per-worker histogram families
+    (ref components/metrics/src/{main,lib}.rs:255,145-364),
+ 4. the flight recorder (flight.py): bounded request-timeline ring with
+    slow-request autopsies on SLO breach / error / fault-point kill.
+
+``hist.py`` is the shared fixed-bucket histogram every tier speaks.
 """
 
 from .component import MetricsComponent, MockWorker
+from .flight import FlightRecorder, SloPolicy
+from .hist import Histogram, HistogramVec, WindowedHistogram
 
-__all__ = ["MetricsComponent", "MockWorker"]
+__all__ = [
+    "FlightRecorder",
+    "Histogram",
+    "HistogramVec",
+    "MetricsComponent",
+    "MockWorker",
+    "SloPolicy",
+    "WindowedHistogram",
+]

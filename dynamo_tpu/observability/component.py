@@ -31,6 +31,19 @@ from ..planner.protocols import (
 
 logger = logging.getLogger(__name__)
 
+#: per-worker + fleet-merged histogram families rendered from workers'
+#: serialized ``hists`` vectors (engine load_metrics -> WorkerLoad).
+#: This constant IS the advertised render surface: render() only emits
+#: families listed here, and the dynflow dashboard-metric-without-
+#: producer rule reads it — a new worker distribution must be declared
+#: here before a dashboard panel may query it.
+WORKER_HIST_FAMILIES = (
+    "worker_queue_wait_ms", "worker_prefill_ms",
+    "worker_restore_ms", "worker_handoff_ms",
+    "fleet_queue_wait_ms", "fleet_prefill_ms",
+    "fleet_restore_ms", "fleet_handoff_ms",
+)
+
 
 class MetricsComponent:
     def __init__(
@@ -172,6 +185,8 @@ class MetricsComponent:
     # ---------------- rendering ----------------
 
     def render(self) -> str:
+        from .hist import Histogram
+
         p = self.prefix
         lines: list[str] = []
 
@@ -179,7 +194,16 @@ class MetricsComponent:
             lines.append(f"{p}_{name}{{{labels}}} {value}"
                          if labels else f"{p}_{name} {value}")
 
+        def hist_rows(name: str, h, labels: str = "") -> None:
+            """One histogram family instance (cumulative le buckets +
+            _sum/_count) — the worker-side distributions' render."""
+            lines.extend(h.render(f"{p}_{name}", labels))
+
         ep = self.aggregator.endpoints
+        # fleet rollups of the worker-side latency distributions:
+        # merged bucket vectors (exact — histogram merge is vector
+        # addition), one family per component, plus per-worker rows
+        fleet: dict[str, Histogram] = {}
         for w in ep.loads:
             lb = f'worker="{w.worker_id:x}"'
             gauge("kv_blocks_active", w.kv_active_blocks, lb)
@@ -284,6 +308,35 @@ class MetricsComponent:
                 "weight_prestage_requests_total",
                 w.weight_prestage_requests, lb,
             )
+            # SLO observatory (docs/observability.md): XLA compile
+            # ledger + warmup coverage and HBM telemetry per worker
+            gauge("xla_compiles_total", w.xla_compiles, lb)
+            gauge("xla_compile_ms_total", round(w.xla_compile_ms, 3), lb)
+            gauge("xla_warm_buckets", w.xla_warm_buckets, lb)
+            gauge("xla_reachable_buckets", w.xla_reachable_buckets, lb)
+            gauge("hbm_bytes_in_use", w.hbm_bytes_in_use, lb)
+            gauge("hbm_bytes_limit", w.hbm_bytes_limit, lb)
+            gauge("hbm_kv_pool_bytes", w.hbm_kv_pool_bytes, lb)
+            gauge("hbm_weights_bytes", w.hbm_weights_bytes, lb)
+            # worker latency distributions: per-worker histogram rows
+            # and the exact fleet merge (vector addition; a vector whose
+            # bucket bounds don't match the rollup's is rendered
+            # per-worker but skipped from the merge rather than
+            # corrupting it — schema-skewed peers degrade readable)
+            for hname, vec in sorted((w.hists or {}).items()):
+                if f"worker_{hname}" not in WORKER_HIST_FAMILIES:
+                    continue  # undeclared family: see WORKER_HIST_FAMILIES
+                h = Histogram.from_vec(vec)
+                if h is None:
+                    continue
+                hist_rows(f"worker_{hname}", h, lb)
+                fl = fleet.get(hname)
+                if fl is None:
+                    fleet[hname] = h
+                elif fl.bounds == h.bounds:
+                    fl.merge(h)
+        for hname, h in sorted(fleet.items()):
+            hist_rows(f"fleet_{hname}", h)
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))

@@ -1,0 +1,236 @@
+"""Flight recorder: bounded request-timeline ring + slow-request
+autopsies.
+
+Every finished request leaves one compact record (TTFT, status, SLO
+class, and — when tracing is on — the assembled timeline with its
+canonical decomposition, ``tracing/ttft.decompose``) in a bounded ring.
+When a request breaches its SLO class's TTFT target, finishes in error,
+or dies to a fault-point kill, the recorder persists an **autopsy**: the
+timeline plus everything a human needs to name the cause without
+reproducing it — the engine's stats snapshot at finish time, the
+runtime-sanitizer counters (a loop stall shows up next to the request it
+stalled), and the XLA compile-ledger tail (a 20-40s TTFT whose window
+contains a compile entry IS the compile; docs/observability.md).
+
+Autopsies are retrievable via ``GET /autopsy/{request_id}`` on the
+frontend and optionally persisted as JSON files. Breaches count into
+``Metrics.observe_breach`` -> ``slo_breaches_total{model,slo_class}``,
+so the counter and the autopsy inventory can never drift apart.
+
+The recorder is provider-wired, not import-coupled: stats / sanitizer /
+compile-ledger callables are injected where the deployment shape has
+them in-process (dynamo_run single-process serving) and simply absent
+where it doesn't (a distributed frontend still records timelines and
+breaches; its autopsies carry what the frontend can see).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+_SAFE_ID = re.compile(r"[^A-Za-z0-9._-]")
+
+#: compile-ledger entries included in an autopsy (newest last)
+LEDGER_TAIL = 8
+
+
+def _autopsy_filename(request_id: str) -> str:
+    """Filename-safe, collision-resistant name for a client-suppliable
+    request id: unsafe characters flatten (no separators can traverse
+    out of the dir) and a short hash of the RAW id disambiguates ids
+    that flatten identically ('req:1' vs 'req_1')."""
+    safe = _SAFE_ID.sub("_", request_id)[:96] or "autopsy"
+    digest = hashlib.blake2s(request_id.encode(), digest_size=4).hexdigest()
+    return f"{safe}-{digest}.json"
+
+
+@dataclass
+class SloPolicy:
+    """Per-class TTFT targets in milliseconds. A request whose measured
+    TTFT exceeds its class's target breaches. 0/absent = no target for
+    that class (error finishes still autopsy)."""
+
+    ttft_ms: dict[str, float] = field(default_factory=dict)
+    default_ttft_ms: float = 0.0
+
+    def target_for(self, slo_class: str) -> float:
+        return self.ttft_ms.get(slo_class, self.default_ttft_ms)
+
+    def breached(self, slo_class: str, ttft_ms: Optional[float]) -> bool:
+        target = self.target_for(slo_class)
+        return bool(target > 0 and ttft_ms is not None and ttft_ms > target)
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        policy: Optional[SloPolicy] = None,
+        collector=None,
+        autopsy_dir: Optional[str] = None,
+        ring: int = 256,
+        stats_provider: Optional[Callable[[], dict]] = None,
+        sanitizer_provider: Optional[Callable[[], dict]] = None,
+        ledger_provider: Optional[Callable[[], list]] = None,
+        on_breach: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.policy = policy or SloPolicy()
+        #: tracing.TraceCollector (or anything with ``timeline``/``ttft``)
+        self.collector = collector
+        self.autopsy_dir = autopsy_dir
+        self.stats_provider = stats_provider
+        self.sanitizer_provider = sanitizer_provider
+        self.ledger_provider = ledger_provider
+        self.on_breach = on_breach
+        self._ring: OrderedDict[str, dict] = OrderedDict()
+        self._autopsies: OrderedDict[str, dict] = OrderedDict()
+        self.max_ring = ring
+        self.max_autopsies = max(ring // 4, 16)
+        #: persisted-file retention (autopsy_dir): oldest files written
+        #: by THIS recorder are unlinked past the cap, so an error-heavy
+        #: workload bounds its disk footprint like it bounds its memory
+        self.max_disk_autopsies = max(self.max_autopsies * 4, 64)
+        self._disk_paths: deque[str] = deque()
+        self.recorded_total = 0
+        self.autopsies_total = 0
+
+    # ---------------- recording ----------------
+
+    def finish(
+        self,
+        request_id: str,
+        model: str,
+        slo_class: str,
+        status: str,
+        ttft_ms: Optional[float],
+        duration_ms: float,
+    ) -> Optional[dict]:
+        """Called once per finished request (the frontend's guard-done
+        path). Returns the autopsy dict when one was produced."""
+        rec = {
+            "request_id": request_id,
+            "model": model,
+            "slo_class": slo_class,
+            "status": status,
+            "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+            "duration_ms": round(duration_ms, 3),
+            "ts": time.time(),
+        }
+        self.recorded_total += 1
+        self._ring[request_id] = rec
+        while len(self._ring) > self.max_ring:
+            self._ring.popitem(last=False)
+
+        breached = self.policy.breached(slo_class, ttft_ms)
+        # fault-point kills surface as error finishes (FaultInjected
+        # carries the worker-lost signature, so when migration is off —
+        # or exhausted — the stream ends in status="error"); both paths
+        # autopsy, tagged with their reason
+        errored = status not in ("success", "disconnect", "shed")
+        if not breached and not errored:
+            return None
+        reason = "slo_breach" if breached else f"finish_{status}"
+        autopsy = self._build_autopsy(rec, reason)
+        self._autopsies[request_id] = autopsy
+        while len(self._autopsies) > self.max_autopsies:
+            self._autopsies.popitem(last=False)
+        self.autopsies_total += 1
+        if breached and self.on_breach is not None:
+            try:
+                self.on_breach(model, slo_class)
+            except Exception:  # noqa: BLE001
+                logger.debug("breach callback failed", exc_info=True)
+        self._persist(request_id, autopsy)
+        return autopsy
+
+    def _build_autopsy(self, rec: dict, reason: str) -> dict:
+        out = dict(rec)
+        out["reason"] = reason
+        out["slo_target_ms"] = self.policy.target_for(rec["slo_class"])
+        if self.collector is not None:
+            try:
+                out["timeline"] = self.collector.timeline(rec["request_id"])
+                out["ttft_decomposition"] = self.collector.ttft(
+                    rec["request_id"]
+                )
+            except Exception:  # noqa: BLE001
+                logger.debug("autopsy timeline failed", exc_info=True)
+        for key, provider in (
+            ("engine_stats", self.stats_provider),
+            ("sanitizer", self.sanitizer_provider),
+        ):
+            if provider is None:
+                continue
+            try:
+                out[key] = provider()
+            except Exception:  # noqa: BLE001
+                logger.debug("autopsy %s provider failed", key, exc_info=True)
+        if self.ledger_provider is not None:
+            try:
+                out["compile_ledger_tail"] = list(
+                    self.ledger_provider()
+                )[-LEDGER_TAIL:]
+            except Exception:  # noqa: BLE001
+                logger.debug("autopsy ledger provider failed", exc_info=True)
+        return out
+
+    def _persist(self, request_id: str, autopsy: dict) -> None:
+        if not self.autopsy_dir:
+            return
+        try:
+            os.makedirs(self.autopsy_dir, exist_ok=True)
+            path = os.path.join(
+                self.autopsy_dir, _autopsy_filename(request_id)
+            )
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(autopsy, f, indent=1, default=str)
+        except OSError:
+            logger.warning("autopsy persist failed", exc_info=True)
+            return
+        if path not in self._disk_paths:
+            self._disk_paths.append(path)
+        while len(self._disk_paths) > self.max_disk_autopsies:
+            old = self._disk_paths.popleft()
+            try:
+                os.unlink(old)
+            except OSError:
+                logger.debug("stale autopsy unlink failed", exc_info=True)
+
+    # ---------------- retrieval ----------------
+
+    def autopsy(self, request_id: str) -> Optional[dict]:
+        a = self._autopsies.get(request_id)
+        if a is not None:
+            return a
+        if self.autopsy_dir:
+            path = os.path.join(
+                self.autopsy_dir, _autopsy_filename(request_id)
+            )
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+        return None
+
+    def record(self, request_id: str) -> Optional[dict]:
+        return self._ring.get(request_id)
+
+    def autopsy_ids(self) -> list[str]:
+        return list(self._autopsies)
+
+    def counters(self) -> dict:
+        """Plain-gauge scrape source (Metrics.register_source)."""
+        return {
+            "flight_records_total": self.recorded_total,
+            "flight_autopsies_total": self.autopsies_total,
+        }

@@ -1,0 +1,262 @@
+"""Fixed-bucket Prometheus histograms — the SLO observatory's data type.
+
+One small, dependency-free histogram shared by every layer of the
+metrics plane (docs/observability.md):
+
+  * the HTTP frontend's ``*_seconds`` families (the exact
+    ``_bucket``/``_sum``/``_count`` series the shipped Grafana dashboard
+    queries),
+  * worker-side queue-wait / prefill / restore / handoff distributions,
+    serialized as bucket vectors through ``load_metrics`` ->
+    ``WorkerLoad.hists`` -> the metrics component's render,
+  * the planner's TTFT/ITL p99s (``WindowedHistogram`` — merged bucket
+    counts instead of bounded sample deques, so arbitrary sample rates
+    keep bounded memory and merge across workers losslessly).
+
+Buckets are log-spaced (latencies span 4+ decades: a 2ms cached ITL and
+a 40s compile-stalled TTFT must both land in a resolvable bucket), with
+an implicit ``+Inf`` overflow. Merging requires identical bounds and is
+exact — histogram merge is just vector addition, which is what makes
+the worker -> aggregator -> fleet rollup associative and lossless,
+unlike percentile-of-percentiles.
+
+Quantiles interpolate linearly inside the covering bucket and clamp to
+the observed [min, max], so single-sample and single-bucket
+distributions report exact values rather than bucket-edge artifacts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> tuple[float, ...]:
+    """Log-spaced bucket upper bounds from ``lo`` to >= ``hi``
+    (``per_decade`` bounds per factor of 10), deduplicated ascending."""
+    out: list[float] = []
+    b = lo
+    ratio = 10.0 ** (1.0 / per_decade)
+    while b < hi * (1 + 1e-9):
+        r = float(f"{b:.6g}")
+        if not out or r > out[-1]:
+            out.append(r)
+        b *= ratio
+    return tuple(out)
+
+
+#: HTTP-facing latencies in seconds: 1ms .. ~100s (XLA compile stalls
+#: sit at 20-40s — the top decade must stay resolvable, not one +Inf)
+TIME_BUCKETS_S = log_buckets(0.001, 100.0, per_decade=4)
+
+#: worker-internal distributions in milliseconds: 0.05ms .. ~60s
+MS_BUCKETS = log_buckets(0.05, 60_000.0, per_decade=4)
+
+
+class Histogram:
+    """Counts per fixed bucket + an implicit ``+Inf`` overflow slot."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, bounds: Iterable[float] = TIME_BUCKETS_S):
+        self.bounds = tuple(bounds)
+        assert self.bounds == tuple(sorted(self.bounds)), "bounds must ascend"
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:  # first bound >= v (bisect; bounds are sorted)
+            mid = (lo + hi) // 2
+            if self.bounds[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.counts[lo] += 1
+
+    # ---- merge / serialize ----
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` in (exact vector addition). Bounds must match —
+        a schema-skewed peer's vector cannot be merged losslessly, so the
+        caller skips it instead of corrupting the rollup."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bucket bounds differ")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    def to_vec(self) -> dict:
+        """Wire form for ``load_metrics`` (JSON-safe, bounds included so
+        merge stays checkable across worker versions)."""
+        return {
+            "b": list(self.bounds),
+            "c": list(self.counts),
+            "s": round(self.sum, 6),
+            "n": self.count,
+            "lo": (round(self.min, 6) if self.count else 0.0),
+            "hi": round(self.max, 6),
+        }
+
+    @staticmethod
+    def from_vec(v: dict) -> Optional["Histogram"]:
+        """Tolerant decode (None on malformed input — a skewed peer's
+        vector degrades to 'no histogram', never an exception on the
+        scrape path)."""
+        try:
+            h = Histogram(tuple(float(b) for b in v["b"]))
+            counts = [int(c) for c in v["c"]]
+            if len(counts) != len(h.counts) or any(c < 0 for c in counts):
+                return None
+            h.counts = counts
+            h.sum = float(v.get("s", 0.0))
+            h.count = int(v.get("n", sum(counts)))
+            h.min = float(v.get("lo", 0.0)) if h.count else float("inf")
+            h.max = float(v.get("hi", 0.0))
+            return h
+        except (KeyError, TypeError, ValueError, AssertionError):
+            return None
+
+    # ---- quantiles ----
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Linear interpolation inside the covering bucket, clamped to
+        the observed [min, max] (exact for single-sample / single-value
+        distributions). None when empty."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = 0.0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                lo = self.bounds[i] if i < len(self.bounds) else lo
+                continue
+            if cum + c >= rank:
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                frac = (rank - cum) / c
+                val = lo + (hi - lo) * frac
+                return min(max(val, self.min), self.max)
+            cum += c
+            lo = self.bounds[i] if i < len(self.bounds) else lo
+        return self.max
+
+    # ---- rendering ----
+
+    def render(self, name: str, labels: str = "") -> list[str]:
+        """Prometheus exposition lines (cumulative ``le`` buckets +
+        ``_sum``/``_count``). ``labels`` is the pre-rendered inner label
+        string (``'model="m"'``), extended with ``le``."""
+        sep = "," if labels else ""
+        out = []
+        cum = 0
+        for i, b in enumerate(self.bounds):
+            cum += self.counts[i]
+            out.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}} {cum}')
+        cum += self.counts[-1]
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+        out.append(f"{name}_sum{{{labels}}} {round(self.sum, 6)}"
+                   if labels else f"{name}_sum {round(self.sum, 6)}")
+        out.append(f"{name}_count{{{labels}}} {self.count}"
+                   if labels else f"{name}_count {self.count}")
+        return out
+
+
+def _fmt(b: float) -> str:
+    """Stable ``le`` label text (no float repr noise)."""
+    s = f"{b:.6g}"
+    return s
+
+
+class HistogramVec:
+    """A labeled family of histograms sharing one bucket ladder."""
+
+    def __init__(self, name: str, label_names: tuple[str, ...],
+                 bounds: Iterable[float] = TIME_BUCKETS_S):
+        self.name = name
+        self.label_names = tuple(label_names)
+        self.bounds = tuple(bounds)
+        self._children: dict[tuple, Histogram] = {}
+
+    def labels(self, *values: str) -> Histogram:
+        key = tuple(str(v) for v in values)
+        assert len(key) == len(self.label_names)
+        h = self._children.get(key)
+        if h is None:
+            h = self._children[key] = Histogram(self.bounds)
+        return h
+
+    def items(self):
+        return sorted(self._children.items())
+
+    def render(self, prefix: str) -> list[str]:
+        full = f"{prefix}_{self.name}"
+        out = [f"# TYPE {full} histogram"]
+        for key, h in self.items():
+            labels = ",".join(
+                f'{n}="{v}"' for n, v in zip(self.label_names, key)
+            )
+            out.extend(h.render(full, labels))
+        return out
+
+
+class WindowedHistogram:
+    """Sliding-window histogram as two rotating halves: samples land in
+    the current half; a half older than ``window_s / 2`` rotates out, so
+    ``snapshot()`` always covers between half and one full window with
+    bounded memory at any sample rate (the deque this replaces dropped
+    samples past ``maxlen`` — under load, exactly when the tail matters).
+    Clock-injected so scripted planner traces replay deterministically.
+    """
+
+    def __init__(self, window_s: float,
+                 bounds: Iterable[float] = MS_BUCKETS,
+                 clock: Callable[[], float] = time.monotonic):
+        self.window_s = window_s
+        self.bounds = tuple(bounds)
+        self._clock = clock
+        self._cur = Histogram(self.bounds)
+        self._prev = Histogram(self.bounds)
+        self._cur_start = clock()
+
+    def _rotate(self, now: float) -> None:
+        half = self.window_s / 2.0
+        while now - self._cur_start >= half:
+            self._prev = self._cur
+            self._cur = Histogram(self.bounds)
+            self._cur_start += half
+            if now - self._cur_start >= self.window_s:
+                # idle gap longer than the whole window: both halves are
+                # stale — jump the window forward instead of looping
+                self._prev = Histogram(self.bounds)
+                self._cur_start = now
+
+    def observe(self, v: float) -> None:
+        self._rotate(self._clock())
+        self._cur.observe(v)
+
+    def snapshot(self) -> Histogram:
+        """Merged view of the live window (fresh object, safe to merge
+        further — e.g. with peer workers' vectors)."""
+        self._rotate(self._clock())
+        out = Histogram(self.bounds)
+        out.merge(self._prev)
+        out.merge(self._cur)
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self.snapshot().quantile(q)

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..kv_router.scheduler import WorkerLoad
-from ..tracing.collector import percentile
+from ..observability.hist import MS_BUCKETS, Histogram, WindowedHistogram
 
 
 @dataclass
@@ -113,8 +113,14 @@ class TelemetryAggregator:
         self._arrivals: deque[tuple[float, int, int]] = deque()
         # (ts, generated_tokens)
         self._generated: deque[tuple[float, int]] = deque()
-        self._ttft: deque[tuple[float, float]] = deque()
-        self._itl: deque[tuple[float, float]] = deque()
+        # latency distributions as windowed fixed-bucket histograms
+        # (observability/hist.py): bounded memory at ANY sample rate —
+        # the bounded deques these replace dropped samples under load,
+        # exactly when the tail the SLO evaluator reads matters most —
+        # and the same bucket schema workers advertise, so fleet_hist()
+        # merges frontend and worker views loss-free
+        self._ttft = WindowedHistogram(window_s, MS_BUCKETS, clock=clock)
+        self._itl = WindowedHistogram(window_s, MS_BUCKETS, clock=clock)
         # cumulative-counter baselines per worker: (requests_total,
         # tokens_generated, prompt_tokens_total)
         self._counter_base: dict[int, tuple[int, int, int]] = {}
@@ -148,10 +154,10 @@ class TelemetryAggregator:
         self._generated.append((self._clock(), max(tokens, 0)))
 
     def record_ttft(self, ms: float) -> None:
-        self._ttft.append((self._clock(), ms))
+        self._ttft.observe(ms)
 
     def record_itl(self, ms: float) -> None:
-        self._itl.append((self._clock(), ms))
+        self._itl.observe(ms)
 
     def record_lease_expiry(self, worker_id: int) -> None:
         """Discovery-watch lost-host evidence (ROADMAP PR 12 leftover):
@@ -238,14 +244,32 @@ class TelemetryAggregator:
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window_s
-        for q in (self._arrivals, self._generated, self._ttft, self._itl,
-                  self._lost):
+        for q in (self._arrivals, self._generated, self._lost):
             while q and q[0][0] < cutoff:
                 q.popleft()
 
-    def _p99(self, q: deque) -> Optional[float]:
-        vals = [v for _ts, v in q]
-        return round(percentile(vals, 99), 3) if vals else None
+    @staticmethod
+    def _p99(wh: WindowedHistogram) -> Optional[float]:
+        v = wh.quantile(0.99)
+        return round(v, 3) if v is not None else None
+
+    def fleet_hist(self, name: str) -> Optional[Histogram]:
+        """Merge the named worker-side distribution (``queue_wait_ms`` /
+        ``prefill_ms`` / ``restore_ms`` / ``handoff_ms``) across the
+        last scrape's workers — exact vector addition, so the fleet p99
+        is a real quantile of every worker's observations, not a
+        percentile-of-percentiles. None when no worker advertises it
+        (or every vector is schema-skewed)."""
+        out: Optional[Histogram] = None
+        for w in self._loads:
+            h = Histogram.from_vec((w.hists or {}).get(name) or {})
+            if h is None:
+                continue
+            if out is None:
+                out = h
+            elif out.bounds == h.bounds:
+                out.merge(h)
+        return out
 
     def snapshot(self) -> ClusterSnapshot:
         # live wiring: pull the aggregator's latest scrape and fold its

@@ -65,6 +65,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_reshard_soak.py \
         tests/test_kv_router.py \
         tests/test_observability.py \
+        tests/test_trace_overhead.py \
         tests/test_planner.py \
         -q -m 'not slow' -p no:cacheprovider
 fi

@@ -1468,6 +1468,99 @@ def test_commit_block_markers_in_tests_ignored():
 
 
 # ---------------------------------------------------------------------------
+# dashboard-metric-without-producer
+# ---------------------------------------------------------------------------
+
+_RENDER_MODULE = {
+    "dynamo_tpu/http/metrics.py": """
+    REQUESTS_TOTAL = "http_service_requests_total"
+
+    class Metrics:
+        def render(self):
+            return REQUESTS_TOTAL
+    """,
+    "dynamo_tpu/observability/component.py": """
+    WORKER_HIST_FAMILIES = ("worker_queue_wait_ms",)
+
+    class C:
+        def render(self):
+            lines = []
+
+            def gauge(name, value):
+                lines.append(name + " " + str(value))
+
+            gauge("worker_count", 1)
+            return lines
+    """,
+}
+
+
+def _dashboard_json(*exprs):
+    panels = [
+        {"type": "stat", "targets": [{"expr": e, "refId": "A"}]}
+        for e in exprs
+    ]
+    return json.dumps({"title": "t", "panels": panels})
+
+
+def test_dashboard_metric_without_producer_fires():
+    files = dict(_RENDER_MODULE)
+    files["dynamo_tpu/deploy/metrics/grafana-dashboard.json"] = (
+        _dashboard_json("sum(rate(dynamo_tpu_ghost_series_total[1m]))")
+    )
+    vs = contracts_fired(files, "dashboard-metric-without-producer")
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path.endswith("grafana-dashboard.json")
+    assert "dynamo_tpu_ghost_series_total" in v.message
+    # evidence names the render surface the series is absent from
+    assert any("metrics.py" in s.path or "component.py" in s.path
+               for s in v.evidence)
+
+
+def test_dashboard_metric_with_producer_passes():
+    files = dict(_RENDER_MODULE)
+    files["dynamo_tpu/deploy/metrics/grafana-dashboard.json"] = (
+        _dashboard_json(
+            # gauge() literal, ALL_CAPS constant, and a histogram
+            # family resolved through suffix stripping + the declared
+            # WORKER_HIST_FAMILIES tuple
+            "dynamo_tpu_worker_count",
+            "sum by (status) (rate(dynamo_tpu_http_service_requests_total[1m]))",
+            "histogram_quantile(0.99, sum by (le) "
+            "(rate(dynamo_tpu_worker_queue_wait_ms_bucket[5m])))",
+        )
+    )
+    assert contracts_fired(files, "dashboard-metric-without-producer") == []
+
+
+def test_dashboard_rule_quiet_without_render_modules():
+    """A partial file set (dashboard alone) has no producer surface to
+    judge against — the rule must stay quiet, not fire on everything."""
+    files = {
+        "dynamo_tpu/deploy/metrics/grafana-dashboard.json": (
+            _dashboard_json("dynamo_tpu_anything_at_all")
+        ),
+    }
+    assert contracts_fired(files, "dashboard-metric-without-producer") == []
+
+
+def test_dashboard_rule_real_tree_collects_dashboard():
+    """read_files picks the shipped dashboard up next to the .py tree,
+    and the real dashboard's every query resolves (the acceptance
+    invariant this rule exists to hold)."""
+    from dynamo_tpu.analysis.engine import read_files
+
+    files, _ = read_files([os.path.join(REPO, "dynamo_tpu")])
+    assert any(p.endswith("grafana-dashboard.json") for p in files)
+    vs = [
+        v for v in check_contracts(files)
+        if v.rule == "dashboard-metric-without-producer"
+    ]
+    assert vs == [], [v.message for v in vs]
+
+
+# ---------------------------------------------------------------------------
 # program-mode suppressions, CLI, JSON
 # ---------------------------------------------------------------------------
 
@@ -1615,6 +1708,13 @@ def test_real_tree_model_extracts_every_plane():
     assert "pool" in m.wire_field_reads.get("MorphDecision", {})
     assert {"kv_stream", "kv_ici", "ici_fp"} <= set(m.conn_advertised)
     assert {"kv_stream", "kv_ici", "ici_fp"} <= set(m.conn_checked)
+    # the dashboard contract's producer surface (ISSUE 15): frontend
+    # histogram families + component gauges + worker hist families
+    assert {
+        "http_service_first_token_seconds", "http_service_requests_total",
+        "slo_breaches_total", "worker_count", "worker_queue_wait_ms",
+        "hbm_bytes_in_use", "xla_compiles_total",
+    } <= set(m.metrics_rendered)
     assert any(
         cb.path.endswith("engine/engine.py") for cb in m.commit_blocks
     )

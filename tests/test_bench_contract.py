@@ -53,6 +53,19 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert itl, result.get("mixed_batch_stats_error", "metric missing")
     for side in ("fused", "alternating"):
         assert itl[side]["n"] > 0 and itl[side]["p99"] > 0, itl
+    # the SLO observatory must be recorded (ISSUE 15): histogram-derived
+    # TTFT percentiles with a consistent distribution behind them, the
+    # induced breach counted exactly once in its class, and the
+    # breach's autopsy resolving with a decomposable timeline
+    so = result.get("bench_slo_observatory")
+    assert so, result.get("bench_slo_observatory_error", "metric missing")
+    assert so["hist_consistent"] is True, so
+    assert so["hist_observations"] == so["requests"], so
+    assert so["ttft_p50_ms"] > 0, so
+    assert so["ttft_p99_ms"] >= so["ttft_p50_ms"], so
+    assert so["breaches"] == 1 and so["breach_classes"] == {"batch": 1}, so
+    assert so["autopsy_ok"] is True, so
+    assert so["autopsies_total"] == 1, so
     # resilience cost must be recorded (ISSUE 4): goodput + TTFT under a
     # scripted mid-decode kill, with migration keeping the wave lossless
     churn = result.get("bench_churn")

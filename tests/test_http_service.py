@@ -14,11 +14,13 @@ from dynamo_tpu.runtime import DistributedRuntime, LocalBus, LocalStore
 from tests.test_llm_protocols import TokenEchoEngine
 
 
-async def http_request(port: int, method: str, path: str, body: bytes = b"") -> tuple[int, dict, bytes]:
+async def http_request(port: int, method: str, path: str, body: bytes = b"",
+                       headers: dict | None = None) -> tuple[int, dict, bytes]:
     """Minimal HTTP/1.1 client over asyncio streams."""
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
     req = (
-        f"{method} {path} HTTP/1.1\r\nHost: localhost\r\n"
+        f"{method} {path} HTTP/1.1\r\nHost: localhost\r\n{extra}"
         f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
     ).encode() + body
     writer.write(req)
@@ -154,9 +156,13 @@ def test_errors_and_metrics(run):
         text = body.decode()
         assert 'requests_total{model="echo",endpoint="chat_completions",status="success"} 1' in text
         assert "request_duration_seconds_bucket" in text
-        # serving-latency histograms (BASELINE p50/p99 TTFT & ITL targets)
-        assert 'first_token_seconds_count{model="echo",endpoint="chat_completions"} 1' in text
+        # serving-latency histograms (BASELINE p50/p99 TTFT & ITL
+        # targets), labeled by slo_class since the SLO observatory
+        assert ('first_token_seconds_count{model="echo",'
+                'endpoint="chat_completions",slo_class="interactive"} 1'
+                in text)
         assert "inter_token_seconds_bucket" in text
+        assert 'le="+Inf"' in text
         await svc.close()
 
     run(main())
@@ -210,5 +216,133 @@ def test_discovery_end_to_end(run):
 
         await svc.close()
         await front.shutdown()
+
+    run(main())
+
+
+# ---------------- SLO observatory (ISSUE 15) ----------------
+
+
+async def http_request_h(port, method, path, body=b"", headers=None):
+    """(status, body) shorthand over the shared http_request helper."""
+    status, _headers, body_out = await http_request(
+        port, method, path, body, headers=headers
+    )
+    return status, body_out
+
+
+def test_slo_breach_yields_autopsy_and_counter(run):
+    """An induced SLO breach (threshold below any real TTFT) autopsies
+    the request and counts slo_breaches_total — with ZERO client-visible
+    errors: the response is a normal 200."""
+    from dynamo_tpu.observability import FlightRecorder, SloPolicy
+
+    async def main():
+        svc = make_local_service()
+        svc.attach_flight(FlightRecorder(
+            SloPolicy(default_ttft_ms=0.000001)
+        ))
+        await svc.start()
+        req = {"model": "echo", "messages": [{"role": "user", "content": "hey"}],
+               "nvext": {"use_raw_prompt": True}}
+        status, _ = await http_request_h(
+            svc.port, "POST", "/v1/chat/completions",
+            json.dumps(req).encode(), headers={"X-Request-Id": "breach-1"},
+        )
+        assert status == 200  # the breach is observed, never surfaced
+        status, body = await http_request_h(svc.port, "GET", "/autopsy/breach-1")
+        assert status == 200
+        autopsy = json.loads(body)
+        assert autopsy["reason"] == "slo_breach"
+        assert autopsy["slo_class"] == "interactive"
+        assert autopsy["ttft_ms"] > 0
+        status, body = await http_request_h(svc.port, "GET", "/autopsy")
+        assert "breach-1" in json.loads(body)["autopsies"]
+        status, body = await http_request_h(svc.port, "GET", "/metrics")
+        text = body.decode()
+        assert ('dynamo_tpu_slo_breaches_total{model="echo",'
+                'slo_class="interactive"} 1') in text
+        assert "dynamo_tpu_flight_autopsies_total 1" in text
+        # unknown id -> 404
+        status, _ = await http_request_h(svc.port, "GET", "/autopsy/nope")
+        assert status == 404
+        await svc.close()
+
+    run(main())
+
+
+def test_autopsy_on_faultpoint_kill(run):
+    """A fault-point kill (the existing ``admission`` point) surfaces as
+    an error finish and autopsies — the flight recorder sees worker
+    deaths, not just slow requests."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.observability import FlightRecorder, SloPolicy
+    from dynamo_tpu.resilience import faultpoints
+
+    async def main():
+        core = JaxEngine(EngineConfig(
+            model=ModelConfig.tiny(), num_blocks=16, block_size=16,
+            max_batch_size=2, max_context=128, prefill_chunk=32,
+        ))
+        tok = ByteTokenizer()
+        manager = ModelManager()
+        engine = OpenAIWorkerEngine(tok, core)
+        manager.add_chat_model("tiny", engine)
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        svc.attach_flight(FlightRecorder(
+            SloPolicy(),
+            stats_provider=core.load_metrics,
+            ledger_provider=lambda: core.compile_ledger,
+        ))
+        await svc.start()
+        faultpoints.arm("admission", "kill")
+        try:
+            req = {"model": "tiny",
+                   "messages": [{"role": "user", "content": "hi"}],
+                   "max_tokens": 4, "nvext": {"use_raw_prompt": True}}
+            status, _ = await http_request_h(
+                svc.port, "POST", "/v1/chat/completions",
+                json.dumps(req).encode(),
+                headers={"X-Request-Id": "killed-1"},
+            )
+            assert status == 500  # no migration layer in this harness
+            status, body = await http_request_h(
+                svc.port, "GET", "/autopsy/killed-1"
+            )
+            assert status == 200
+            autopsy = json.loads(body)
+            assert autopsy["reason"] == "finish_error"
+            # the in-process providers landed their snapshots
+            assert "engine_stats" in autopsy
+        finally:
+            faultpoints.reset()
+            await svc.close()
+            await core.close()
+
+    run(main())
+
+
+def test_profile_endpoint(run):
+    async def main():
+        svc = make_local_service()
+        await svc.start()
+        # not wired -> 501
+        status, _ = await http_request_h(svc.port, "POST", "/profile?seconds=1")
+        assert status == 501
+
+        async def fake_profiler(seconds):
+            return f"/tmp/trace-{seconds}"
+
+        svc.profiler = fake_profiler
+        status, body = await http_request_h(
+            svc.port, "POST", "/profile?seconds=0.5"
+        )
+        assert status == 200
+        out = json.loads(body)
+        assert out["trace_dir"] == "/tmp/trace-0.5"
+        status, _ = await http_request_h(svc.port, "POST", "/profile?seconds=zap")
+        assert status == 400
+        await svc.close()
 
     run(main())

@@ -44,6 +44,12 @@ def test_dashboard_metric_names_are_emitted_by_code():
     emitted |= {
         "dynamo_tpu_" + name for name in re.findall(r'gauge\(\s*"([a-z_]+)"', comp_src)
     }
+    # per-worker/fleet histogram families are declared, not literal call
+    # args (observability/component.py WORKER_HIST_FAMILIES — the same
+    # surface the dynflow dashboard rule reads)
+    from dynamo_tpu.observability.component import WORKER_HIST_FAMILIES
+
+    emitted |= {"dynamo_tpu_" + name for name in WORKER_HIST_FAMILIES}
     dash_metrics = set()
     for p in _dashboard()["panels"]:
         for t in p.get("targets", []):

@@ -52,3 +52,258 @@ def test_metrics_component_scrape_and_render(run):
         await drt.shutdown()
 
     run(main())
+
+
+# ===========================================================================
+# SLO observatory (ISSUE 15): histogram plane, device telemetry render,
+# flight recorder autopsies — docs/observability.md
+# ===========================================================================
+
+import random
+
+from dynamo_tpu.http.metrics import Metrics
+from dynamo_tpu.kv_router.scheduler import ProcessedEndpoints, WorkerLoad
+from dynamo_tpu.observability import FlightRecorder, SloPolicy
+from dynamo_tpu.observability.hist import (
+    MS_BUCKETS,
+    Histogram,
+    HistogramVec,
+    WindowedHistogram,
+)
+
+
+def _render_only_component(loads):
+    """MetricsComponent in render-only harness form (same pattern as
+    test_analysis's sanitizer-gauge test)."""
+    mc = MetricsComponent.__new__(MetricsComponent)
+    mc.prefix = "dynamo_tpu"
+    mc.aggregator = type(
+        "A", (), {"endpoints": ProcessedEndpoints(loads)}
+    )()
+    mc.hit_events = mc.hit_isl_blocks = mc.hit_overlap_blocks = 0
+    mc.planner_decision = mc.planner_watermark = None
+    mc.planner_decisions_total = 0
+    mc.tracing = None
+    return mc
+
+
+def test_histogram_buckets_monotonic_and_sum_count_consistent():
+    h = Histogram(MS_BUCKETS)
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(3, 2) for _ in range(500)]
+    for v in vals:
+        h.observe(v)
+    assert h.count == len(vals) == sum(h.counts)
+    assert h.sum == sum(vals)
+    lines = h.render("m")
+    # cumulative bucket counts are non-decreasing and +Inf == _count
+    cums = [int(l.rsplit(" ", 1)[1]) for l in lines if "_bucket" in l]
+    assert cums == sorted(cums)
+    assert cums[-1] == h.count
+    assert f"m_count {h.count}" in lines[-1]
+
+
+def test_histogram_merge_associative_and_wire_roundtrip():
+    rng = random.Random(3)
+    vals = [rng.expovariate(0.01) for _ in range(300)]
+    parts = [Histogram(MS_BUCKETS) for _ in range(3)]
+    whole = Histogram(MS_BUCKETS)
+    for i, v in enumerate(vals):
+        parts[i % 3].observe(v)
+        whole.observe(v)
+    # (a+b)+c == a+(b+c) == direct observation, bucket-for-bucket —
+    # the worker -> aggregator rollup is exact, not approximate
+    ab_c = Histogram(MS_BUCKETS)
+    ab_c.merge(parts[0]).merge(parts[1]).merge(parts[2])
+    bc = Histogram(MS_BUCKETS)
+    bc.merge(parts[1]).merge(parts[2])
+    a_bc = Histogram(MS_BUCKETS)
+    a_bc.merge(parts[0]).merge(bc)
+    assert ab_c.counts == a_bc.counts == whole.counts
+    assert abs(ab_c.sum - whole.sum) < 1e-6
+    # wire roundtrip (the load_metrics serialization) is lossless
+    rt = Histogram.from_vec(whole.to_vec())
+    assert rt.counts == whole.counts and rt.count == whole.count
+    # malformed vectors degrade to None, never raise on the scrape path
+    assert Histogram.from_vec({}) is None
+    assert Histogram.from_vec({"b": [1.0], "c": [1, 2, 3, 4]}) is None
+    assert Histogram.from_vec({"b": [1.0], "c": [-1, 0]}) is None
+
+
+def test_histogram_quantile_exact_for_degenerate_distributions():
+    h = Histogram(MS_BUCKETS)
+    h.observe(500.0)
+    assert h.quantile(0.5) == 500.0
+    assert h.quantile(0.99) == 500.0
+    for _ in range(50):
+        h.observe(500.0)
+    assert h.quantile(0.99) == 500.0
+    assert Histogram(MS_BUCKETS).quantile(0.99) is None
+
+
+def test_histogram_vec_label_hygiene_and_render_golden():
+    hv = HistogramVec("http_service_first_token_seconds",
+                      ("model", "endpoint", "slo_class"), (0.1, 1.0))
+    hv.labels("m", "chat", "interactive").observe(0.05)
+    hv.labels("m", "chat", "interactive").observe(0.5)
+    hv.labels("m", "chat", "batch").observe(2.0)
+    out = hv.render("dynamo_tpu")
+    assert out == [
+        "# TYPE dynamo_tpu_http_service_first_token_seconds histogram",
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="batch",le="0.1"} 0',
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="batch",le="1"} 0',
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="batch",le="+Inf"} 1',
+        'dynamo_tpu_http_service_first_token_seconds_sum{model="m",endpoint="chat",slo_class="batch"} 2.0',
+        'dynamo_tpu_http_service_first_token_seconds_count{model="m",endpoint="chat",slo_class="batch"} 1',
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="interactive",le="0.1"} 1',
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="interactive",le="1"} 2',
+        'dynamo_tpu_http_service_first_token_seconds_bucket{model="m",endpoint="chat",slo_class="interactive",le="+Inf"} 2',
+        'dynamo_tpu_http_service_first_token_seconds_sum{model="m",endpoint="chat",slo_class="interactive"} 0.55',
+        'dynamo_tpu_http_service_first_token_seconds_count{model="m",endpoint="chat",slo_class="interactive"} 2',
+    ]
+
+
+def test_windowed_histogram_rotates_on_injected_clock():
+    t = [0.0]
+    w = WindowedHistogram(10.0, clock=lambda: t[0])
+    for _ in range(5):
+        w.observe(100.0)
+        t[0] += 1.0
+    assert w.snapshot().count == 5
+    t[0] = 9.0
+    w.observe(300.0)
+    # within the window both halves contribute
+    assert w.snapshot().count == 6
+    t[0] = 12.0  # first half aged out; the fresh (t=9) sample remains
+    assert w.snapshot().count == 1
+    t[0] = 100.0  # idle gap > window: everything gone
+    assert w.snapshot().count == 0
+
+
+def test_component_renders_worker_hists_and_device_telemetry():
+    h = Histogram(MS_BUCKETS)
+    for v in (5.0, 50.0, 500.0):
+        h.observe(v)
+    load = WorkerLoad.from_stats(0xAB, {
+        "hist_queue_wait_ms": h.to_vec(),
+        "hist_prefill_ms": h.to_vec(),
+        "xla_compiles_total": 7,
+        "xla_compile_ms_total": 1234.5,
+        "xla_warm_buckets": 5,
+        "xla_reachable_buckets": 8,
+        "hbm_bytes_in_use": 2**30,
+        "hbm_bytes_limit": 16 * 2**30,
+        "hbm_kv_pool_bytes": 2**29,
+        "hbm_weights_bytes": 2**28,
+    })
+    load2 = WorkerLoad.from_stats(0xCD, {"hist_queue_wait_ms": h.to_vec()})
+    text = _render_only_component([load, load2]).render()
+    assert 'dynamo_tpu_xla_compiles_total{worker="ab"} 7' in text
+    assert 'dynamo_tpu_xla_warm_buckets{worker="ab"} 5' in text
+    assert 'dynamo_tpu_hbm_bytes_in_use{worker="ab"} 1073741824' in text
+    assert ('dynamo_tpu_worker_queue_wait_ms_count{worker="ab"} 3'
+            in text)
+    # fleet family is the exact two-worker merge
+    assert "dynamo_tpu_fleet_queue_wait_ms_count 6" in text
+    assert "dynamo_tpu_fleet_prefill_ms_count 3" in text
+    # bucket lines carry le labels
+    assert 'le="+Inf"} 6' in text
+
+
+def test_component_fleet_merge_skips_mismatched_bounds():
+    a, b = Histogram((1.0, 10.0)), Histogram((2.0, 20.0))
+    a.observe(0.5)
+    b.observe(0.5)
+    loads = [
+        WorkerLoad.from_stats(1, {"hist_queue_wait_ms": a.to_vec()}),
+        WorkerLoad.from_stats(2, {"hist_queue_wait_ms": b.to_vec()}),
+    ]
+    text = _render_only_component(loads).render()
+    # both render per-worker; the fleet merge keeps the first schema
+    # instead of corrupting the rollup with mismatched buckets
+    assert 'dynamo_tpu_worker_queue_wait_ms_count{worker="1"} 1' in text
+    assert 'dynamo_tpu_worker_queue_wait_ms_count{worker="2"} 1' in text
+    assert "dynamo_tpu_fleet_queue_wait_ms_count 1" in text
+
+
+class _StubCollector:
+    def __init__(self, spans=None, decomp=None):
+        self._spans = spans or [{"name": "frontend.request", "ts": 0.0,
+                                 "dur_ms": 3000.0, "trace_id": "t"}]
+        self._decomp = decomp or {"ttft_ms": 3000.0, "queue_wait": 2900.0}
+
+    def timeline(self, _id):
+        return self._spans
+
+    def ttft(self, _id):
+        return self._decomp
+
+
+def test_flight_recorder_breach_autopsy_and_persistence(tmp_path):
+    breaches = []
+    fr = FlightRecorder(
+        SloPolicy(ttft_ms={"interactive": 1000.0}),
+        collector=_StubCollector(),
+        autopsy_dir=str(tmp_path),
+        stats_provider=lambda: {"kv_active_blocks": 3},
+        sanitizer_provider=lambda: {"san_loop_stalls": 1},
+        ledger_provider=lambda: [{"kind": "prefill", "key": [256],
+                                  "ms": 2800.0}],
+        on_breach=lambda model, cls: breaches.append((model, cls)),
+    )
+    # fast request: recorded, no autopsy
+    assert fr.finish("ok-1", "m", "interactive", "success", 50.0, 60.0) is None
+    assert fr.record("ok-1") is not None and fr.autopsy("ok-1") is None
+    # breach: autopsy with timeline + providers, persisted, counted
+    a = fr.finish("slow../1", "m", "interactive", "success", 3000.0, 3100.0)
+    assert a["reason"] == "slo_breach"
+    assert a["slo_target_ms"] == 1000.0
+    assert a["ttft_decomposition"]["queue_wait"] == 2900.0
+    assert a["engine_stats"]["kv_active_blocks"] == 3
+    assert a["sanitizer"]["san_loop_stalls"] == 1
+    assert a["compile_ledger_tail"][0]["kind"] == "prefill"
+    assert breaches == [("m", "interactive")]
+    assert fr.autopsy("slow../1") == a
+    # persisted under a sanitized filename inside the dir: the client-
+    # supplied id's separator is flattened (no traversal) and a short
+    # raw-id hash keeps distinct ids from colliding on one file
+    files = list(tmp_path.iterdir())
+    assert len(files) == 1 and files[0].suffix == ".json"
+    assert "/" not in files[0].name and files[0].name.startswith("slow.._1-")
+    # 'slow../1' and 'slow.._1' flatten identically but persist apart
+    fr.finish("slow.._1", "m", "interactive", "success", 3000.0, 3100.0)
+    assert len(list(tmp_path.iterdir())) == 2
+    # a class with no target never breaches on latency
+    assert fr.finish("b-1", "m", "batch", "success", 9e6, 9e6) is None
+
+
+def test_flight_recorder_error_and_kill_autopsy():
+    """Error finishes autopsy — including fault-point kills, whose
+    FaultInjected surfaces as an error-status finish (the existing
+    ``admission`` faultpoint drives one end to end below)."""
+    fr = FlightRecorder(SloPolicy())
+    a = fr.finish("dead-1", "m", "interactive", "error", None, 12.0)
+    assert a is not None and a["reason"] == "finish_error"
+    # sheds and disconnects are not autopsies (they are intended)
+    assert fr.finish("x", "m", "batch", "shed", None, 1.0) is None
+    assert fr.finish("y", "m", "batch", "disconnect", None, 1.0) is None
+    assert fr.autopsies_total == 1
+    assert fr.counters()["flight_autopsies_total"] == 1
+
+
+def test_telemetry_fleet_hist_merges_worker_vectors():
+    from dynamo_tpu.planner import TelemetryAggregator
+
+    h1, h2 = Histogram(MS_BUCKETS), Histogram(MS_BUCKETS)
+    for v in (10.0, 20.0):
+        h1.observe(v)
+    h2.observe(30.0)
+    t = TelemetryAggregator()
+    t.observe_loads([
+        WorkerLoad.from_stats(1, {"hist_prefill_ms": h1.to_vec()}),
+        WorkerLoad.from_stats(2, {"hist_prefill_ms": h2.to_vec()}),
+    ])
+    merged = t.fleet_hist("prefill_ms")
+    assert merged is not None and merged.count == 3
+    assert merged.sum == 60.0
+    assert t.fleet_hist("restore_ms") is None
