@@ -1790,6 +1790,160 @@ def _kv_quant_stats() -> dict:
     return {"bench_kv_quant": asyncio.run(run())}
 
 
+def _lowprec_stats() -> dict:
+    """bench_lowprec (ISSUE 18): the low-precision COMPUTE lane — the
+    int8-with-scales DEVICE cache (kv_cache_dtype="int8") and int8
+    weight GEMMs (quantization="int8_native") measured through the
+    same fused step, in all four combinations against the bf16
+    baseline: decode tok/s, exact HBM attribution (weights + KV pool
+    from the arrays themselves), resident-page capacity at the bf16
+    pool's byte budget, and the logprob-drift gate per mode.
+
+    Hard asserts (acceptance criteria): the int8 device cache holds
+    >= 1.8x the pages at the identical HBM byte budget (the per-page
+    f32 scale planes are the only overhead), and every quantized mode
+    clears its greedy-agreement floor against the bf16 reference —
+    1.0 for the int8 KV cache alone (CPU XLA dequant is deterministic
+    and the tiny-model drift stays below argmax flips), 0.8 for the
+    weight modes (a random tiny model has near-uniform logits, so
+    per-channel weight rounding can legitimately flip a late greedy
+    token; real checkpoints sit far from these margins)."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.kvquant import measure_logprob_drift
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, collect
+
+    import jax as _jax
+
+    tiny = ModelConfig.tiny(
+        hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=4, num_kv_heads=4, head_dim=64,
+        max_position_embeddings=1024,
+    )
+    params = llama.init_params(tiny, _jax.random.key(7))
+    BS, NB = 16, 48
+    MODES = {
+        "bf16": {},
+        "int8_weights": {"quantization": "int8_native"},
+        "int8_kv": {"kv_cache_dtype": "int8"},
+        "int8_both": {"quantization": "int8_native",
+                      "kv_cache_dtype": "int8"},
+    }
+    PROMPTS = [[(13 * j + 41 * c) % 480 + 10 for j in range(96)]
+               for c in range(3)]
+
+    def req(toks, max_tokens=24):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0,
+                                             logprobs=0),
+            eos_token_ids=[],
+        )
+
+    def cfg(**over):
+        return EngineConfig(
+            model=tiny, num_blocks=NB, block_size=BS, max_batch_size=4,
+            max_context=512, prefill_chunk=64, **over,
+        )
+
+    async def run_mode(name, over):
+        eng = JaxEngine(cfg(**over), params=params)
+        try:
+            # warm the programs off the clock, then time a concurrent
+            # greedy wave through the fused mixed step
+            await collect(eng.generate(Context(req(range(20, 36), 4))))
+            t0 = _time.monotonic()
+            outs = await asyncio.gather(*[
+                collect(eng.generate(Context(req(p)))) for p in PROMPTS
+            ])
+            dt = _time.monotonic() - t0
+            n_toks = sum(
+                len(o.token_ids) for outs_one in outs for o in outs_one
+            )
+            hbm = eng._hbm_stats()
+            # exact per-page device bytes INCLUDING the scale planes —
+            # what a page costs at a fixed HBM pool budget
+            page_bytes = hbm["kv_pool"] / NB
+            out = {
+                "tok_s": round(n_toks / max(dt, 1e-9), 2),
+                "lowprec_tok_s": eng.load_metrics()["lowprec_tok_s"],
+                "hbm_weights_bytes": hbm["weights"],
+                "hbm_kv_pool_bytes": hbm["kv_pool"],
+                "kv_page_bytes": round(page_bytes, 1),
+                "kv_cache_dtype": str(eng.k_cache.dtype),
+            }
+            if eng.k_scales is not None:
+                lm = eng.load_metrics()
+                out["kv_device_quant_pages"] = lm["kv_device_quant_pages"]
+                out["kv_device_requants_total"] = (
+                    lm["kv_device_requants_total"]
+                )
+                out["kv_device_bytes_saved_total"] = (
+                    lm["kv_device_bytes_saved_total"]
+                )
+            # drift gate: fresh engines so the reference serves the
+            # prompts cold (park=None — these modes quantize the live
+            # compute path, no tier churn involved)
+            ref = JaxEngine(cfg(), params=params)
+            q = JaxEngine(cfg(**over), params=params)
+            try:
+                out["drift"] = await measure_logprob_drift(
+                    ref, q, PROMPTS, max_tokens=12, park=None,
+                    stat_key=("kv_quant_logprob_drift_max"
+                              if "kv_cache_dtype" in over
+                              else "lowprec_weight_drift_max"),
+                )
+            finally:
+                await ref.close()
+                await q.close()
+            return out
+        finally:
+            await eng.close()
+
+    async def run():
+        out: dict = {"modes": {}}
+        for name, over in MODES.items():
+            out["modes"][name] = await run_mode(name, over)
+        full_page = out["modes"]["bf16"]["kv_page_bytes"]
+        q_page = out["modes"]["int8_kv"]["kv_page_bytes"]
+        # pages each codec affords at the bf16 pool's byte budget
+        budget = out["modes"]["bf16"]["hbm_kv_pool_bytes"]
+        pages_full = int(budget // full_page)
+        pages_q = int(budget // q_page)
+        out["pool_budget_bytes"] = budget
+        out["pages_at_budget"] = {"bf16": pages_full, "int8": pages_q}
+        ratio = pages_q / max(pages_full, 1)
+        out["capacity_ratio"] = round(ratio, 3)
+        # the acceptance criteria, enforced
+        assert ratio >= 1.8, (
+            f"int8 device-page capacity ratio {ratio:.2f} < 1.8x "
+            f"({pages_q} vs {pages_full} pages at {budget} bytes)"
+        )
+        floors = {"bf16": 1.0, "int8_kv": 1.0,
+                  "int8_weights": 0.8, "int8_both": 0.8}
+        for name, floor in floors.items():
+            got = out["modes"][name]["drift"]["greedy_agreement"]
+            assert got >= floor, (
+                f"{name} greedy agreement {got} < {floor} floor: "
+                f"{out['modes'][name]['drift']}"
+            )
+        assert out["modes"]["int8_kv"]["tok_s"] > 0
+        return out
+
+    return {"bench_lowprec": asyncio.run(run())}
+
+
 def _reshard_child() -> dict:
     """Child-process body for bench_reshard (spawned by _reshard_stats
     with a 2-device CPU topology — the parent bench runs single-device,
@@ -2292,6 +2446,10 @@ def main() -> None:
         result.update(_kv_quant_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_kv_quant_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_lowprec_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_lowprec_error"] = f"{type(e).__name__}: {e}"
     try:
         result.update(_cost_routing_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands

@@ -101,6 +101,13 @@ class BlockAllocator:
         # the true removal arrives later via OffloadManager.on_dropped
         # when the block leaves the last local tier
         self.on_demoted: Optional[Callable[[list[int]], None]] = None
+        # fired with the device block index every time a block becomes
+        # fresh-mutable (free-list pop OR reuse-pool eviction) — the
+        # engine's int8 device cache resets the block's scale-plane
+        # entries here so stale absmax scales never survive recycling.
+        # match_prefix claims deliberately do NOT fire it: a claimed
+        # prefix block keeps its content AND its scales.
+        self.on_allocated: Optional[Callable[[int], None]] = None
 
     # ---- stats ----
     @property
@@ -144,6 +151,8 @@ class BlockAllocator:
         else:
             return None
         b.ref_count = 1
+        if self.on_allocated:
+            self.on_allocated(b.idx)
         return b
 
     def match_prefix(

@@ -83,6 +83,33 @@ def _seg_bucket(n: int) -> int:
 
 
 @jax.jit
+def _reset_scale_entries(k_scales, v_scales, idxs):
+    """Reset recycled pages' scale-plane entries to the codec epsilon
+    (one scatter for the whole batch of allocator recycles). ``idxs`` is
+    padded with the trash page 0 — resetting its scale is harmless."""
+    from ..models.quant import KV_SCALE_EPS
+
+    return (
+        k_scales.at[:, idxs].set(KV_SCALE_EPS),
+        v_scales.at[:, idxs].set(KV_SCALE_EPS),
+    )
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("dtype",))
+def _dequant_gathered(pages, scales, dtype):
+    """Dequantize a gathered int8 page stack ([L, Hkv, n, bs, D] codes +
+    [L, n] scales) back to the model's full-width ``dtype`` — the
+    device-side half of an export that must leave the device codec
+    (legacy peer, disagg full-width wire)."""
+    return (
+        pages.astype(jnp.float32) * scales[:, None, :, None, None]
+    ).astype(dtype)
+
+
+@jax.jit
 def _reset_pen_slot(counts, mask, slot, prompt_ids, gen_ids):
     """Rebuild one slot's penalty state: prompt-token mask from
     ``prompt_ids`` and output counts from ``gen_ids`` (non-empty after a
@@ -390,7 +417,82 @@ class JaxEngine(AsyncEngine):
             if sh is not None:
                 k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         self.k_cache, self.v_cache = k, v
+        # int8-with-scales DEVICE cache (kv_cache_dtype="int8"): per-page
+        # f32 scale planes [L, N] — one symmetric absmax scale per
+        # (layer, physical page) per K/V, the tier codec's exact
+        # granularity (engine/kvquant.py), so wire landings adopt their
+        # carried scales directly and d2h exports re-encode from the
+        # planes with zero full-width bounce. None for every other mode.
+        self.k_scales = self.v_scales = None
+        if cache_dt == jnp.int8:
+            if mcfg.is_mla:
+                # LOUD gate, not a silent fallback: the absorbed-matmul
+                # MLA path folds W_kv^B into the query/output projections
+                # and dots queries against the latent cache DIRECTLY —
+                # a per-page scale would have to multiply inside the
+                # absorbed einsums (and the merged latent append + the
+                # bf16-gated MLA Pallas kernels have no scale stream).
+                # MLA keeps the scale-free fp8 cast (kv_cache_dtype=
+                # "float8_e4m3") as its low-precision option.
+                raise ValueError(
+                    "kv_cache_dtype='int8' is not supported for MLA "
+                    "models: the absorbed-matmul latent path has no "
+                    "per-page scale stream — use kv_cache_dtype="
+                    "'float8_e4m3' (scale-free cast) for MLA"
+                )
+            if mirror is not None:
+                raise ValueError(
+                    "kv_cache_dtype='int8' is not supported under the "
+                    "multi-host mirror (lockstep broadcasts carry no "
+                    "scale planes)"
+                )
+            from ..models.quant import KV_SCALE_EPS
+
+            plane = jnp.full(
+                (mcfg.num_layers, cfg.num_blocks), KV_SCALE_EPS,
+                jnp.float32,
+            )
+            if self.mesh is not None:
+                # planes replicate: the page axis is unsharded and the
+                # scales are kv-head-free (ops/attention._shard_tp
+                # passes them as replicated scalars)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                plane = jax.device_put(
+                    plane, NamedSharding(self.mesh, PartitionSpec())
+                )
+            self.k_scales, self.v_scales = plane, plane
         self.allocator = BlockAllocator(cfg.num_blocks, cfg.block_size)
+        # recycled pages must not inherit a previous tenant's absmax
+        # scale: every fresh-mutable allocation queues a scale reset,
+        # flushed as ONE scatter on the next dispatch preamble
+        # (_flush_scale_resets). match_prefix claims keep their scales.
+        self._pending_scale_resets: list[int] = []
+        # device-side accumulator of page requantizations (folded into
+        # stats at scrape time — see _note_quant_step), and the last
+        # folded value of the offload manager's export-bounce counter
+        self._requants_dev = None
+        self._offload_requants_seen = 0
+        # decode-throughput EMA for the low-precision lane (lowprec_tok_s)
+        self._lowprec_rate_t = 0.0
+        if self.k_scales is not None:
+            self.allocator.on_allocated = self._pending_scale_resets.append
+            # bytes one token's K+V rows save landing int8 instead of
+            # full width (per-page scale overhead is L*8 bytes per block
+            # against Hkv*D*bs*itemsize — sub-1% — and is counted in
+            # _hbm_stats, not here)
+            full_itemsize = jnp.dtype(mcfg.dtype).itemsize
+            self._kv_saved_per_token = int(
+                2 * mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
+                * (full_itemsize - 1)
+            )
+            if cfg.spec_gamma > 0:
+                logger.warning(
+                    "kv_cache_dtype='int8': speculative (prompt-lookup) "
+                    "decoding is disabled — the fused verify forward "
+                    "has no scale-plane stream; decode runs plain "
+                    "windows"
+                )
         # transfer-cost calibration (kv_router/costmodel.py): one model
         # per engine, fed by the restore/pull/handoff/prefill paths and
         # advertised through load_metrics. Block bytes from the real
@@ -426,13 +528,32 @@ class JaxEngine(AsyncEngine):
                 tier_ttl_s=cfg.kv_tier_ttl_s,
                 kv_quant=cfg.kv_quant,
                 block_bytes=self.kv_block_bytes,
-                full_dtype=str(self.k_cache.dtype),
+                # the tier's FULL-WIDTH dtype: with an int8 device cache
+                # the cache dtype is the quantized code, not the width
+                # dequants should target — use the model's compute dtype
+                full_dtype=(
+                    mcfg.dtype if self.k_scales is not None
+                    else str(self.k_cache.dtype)
+                ),
             )
             self.allocator.on_evict = lambda h, b: self.offload.on_evict(h, b.idx)
             # tier-drop removals re-check device residency before
             # publishing (offload.flush_dropped): a stale lower-tier
             # copy aging out must not un-index a device-resident block
             self.offload.device_has = self.allocator.has_hash
+            if self.k_scales is not None:
+                # publish the scale planes so tier traffic speaks the
+                # device codec: flushes gather int8 pages + scales (an
+                # int8 tier adopts them with zero re-encode), restores
+                # land payload + scales back into cache + planes
+                self.offload.device_planes = (
+                    lambda: (self.k_scales, self.v_scales)
+                )
+
+                def _set_planes(planes):
+                    self.k_scales, self.v_scales = planes
+
+                self.offload.device_planes_set = _set_planes
         self.cost = None
         if cfg.kv_cost_model:
             from ..kv_router.costmodel import TransferCostModel
@@ -548,6 +669,18 @@ class JaxEngine(AsyncEngine):
             # (engine/kvquant.measure_logprob_drift) recorded against
             # this engine's quantized tiers; 0 until a harness ran
             "kv_quant_logprob_drift_max": 0.0,
+            # int8-with-scales DEVICE cache lane (docs/kv_offload.md):
+            # live quantized resident pages, cumulative page
+            # requantizations (scale-growth rewrites), cumulative bytes
+            # the int8 landings saved vs full width, d2h exports that
+            # had to requantize (tier codec mismatch — the int8->int8
+            # fast path keeps this at 0), and the measured decode
+            # throughput of the low-precision lane
+            "kv_device_quant_pages": 0,
+            "kv_device_requants_total": 0,
+            "kv_device_bytes_saved_total": 0,
+            "kv_device_export_requant_total": 0,
+            "lowprec_tok_s": 0.0,
             # XLA compile ledger (docs/observability.md): first-dispatch
             # count + wall-ms per distinct program bucket, and the
             # warmup coverage report (_warm coverage in warmup()) —
@@ -871,6 +1004,9 @@ class JaxEngine(AsyncEngine):
         kv = int(getattr(self.k_cache, "nbytes", 0) or 0) + int(
             getattr(self.v_cache, "nbytes", 0) or 0
         )
+        if self.k_scales is not None:
+            # the int8 cache's per-page scale planes are KV-pool bytes
+            kv += int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
         if self._weight_bytes is None:
             try:
                 self._weight_bytes = sum(
@@ -920,6 +1056,7 @@ class JaxEngine(AsyncEngine):
     def load_metrics(self) -> dict:
         """Worker stats for the KV router plane (ref ForwardPassMetrics)."""
         self._register_device_executor()
+        self._fold_quant_counters()
         out = {}
         # SLO observatory: worker latency distributions as serialized
         # bucket vectors (merged loss-free downstream), the XLA compile
@@ -1010,6 +1147,19 @@ class JaxEngine(AsyncEngine):
                 "peer_serve_d2h_blocks"],
             "weight_prestage_requests": self.stats[
                 "weight_prestage_requests"],
+            # int8-with-scales device-cache lane (zeros unless
+            # kv_cache_dtype="int8"): resident quantized pages,
+            # cumulative scale-growth requantizations, bytes the int8
+            # landings saved vs full width, exports that paid a
+            # requantize, and the lane's measured decode throughput
+            "kv_device_quant_pages": self.stats["kv_device_quant_pages"],
+            "kv_device_requants_total": self.stats[
+                "kv_device_requants_total"],
+            "kv_device_bytes_saved_total": self.stats[
+                "kv_device_bytes_saved_total"],
+            "kv_device_export_requant_total": self.stats[
+                "kv_device_export_requant_total"],
+            "lowprec_tok_s": self.stats["lowprec_tok_s"],
         } | (self.cost.counters() if self.cost is not None else {})
 
     def _register_device_executor(self) -> None:
@@ -1271,6 +1421,12 @@ class JaxEngine(AsyncEngine):
         exactly one layout."""
         new_mesh = req["new_mesh"]
         m = self.morpher
+        # the device-side requant accumulator (_note_quant_step) lives
+        # on the OLD device set; fold it to the host stat now — the
+        # loop is quiesced, so the one-scalar sync is free — or the
+        # first post-morph dispatch would add an old-mesh scalar to a
+        # new-mesh one and trip an incompatible-devices error
+        self._fold_quant_counters()
         faultpoints.hit_sync("mid_reshard", phase="quiesced")
         cache_sh = self.layout.cache_sharding(new_mesh)
         new_k = m.apply(self.k_cache, cache_sh)
@@ -1280,9 +1436,19 @@ class JaxEngine(AsyncEngine):
         if self._pen_counts is not None:
             new_pc = m.apply(self._pen_counts, rep)
             new_pm = m.apply(self._pen_mask, rep)
+        new_ks = new_vs = None
+        if self.k_scales is not None:
+            # int8 device cache: the scale planes ride the same morph
+            # (replicated layout, page axis unsharded) so every re-laid
+            # page keeps its bit-identical dequant scale
+            new_ks = m.apply(self.k_scales, rep)
+            new_vs = m.apply(self.v_scales, rep)
         # the staged state must be REAL (transfers landed) before the
         # commit claims the engine is on the new layout
-        jax.block_until_ready((new_k, new_v))
+        jax.block_until_ready(
+            (new_k, new_v) if new_ks is None
+            else (new_k, new_v, new_ks, new_vs)
+        )
         # every fallible computation happens BEFORE the commit: the
         # dynflow commit-block-purity rule found _use_pallas_for being
         # called inside it — had that call raised, params/caches/mesh
@@ -1298,6 +1464,8 @@ class JaxEngine(AsyncEngine):
         self.k_cache, self.v_cache = new_k, new_v
         if new_pc is not None:
             self._pen_counts, self._pen_mask = new_pc, new_pm
+        if new_ks is not None:
+            self.k_scales, self.v_scales = new_ks, new_vs
         self.mesh = new_mesh
         self.cfg.mesh = new_mesh_cfg
         self.use_pallas = new_use_pallas
@@ -1841,6 +2009,70 @@ class JaxEngine(AsyncEngine):
                     hidden_ms=round(max(total_ms - exposed_ms, 0.0), 3),
                 )
 
+    def _flush_scale_resets(self) -> None:
+        """int8 device cache: reset the scale-plane entries of every
+        page the allocator recycled since the last dispatch (queued by
+        its ``on_allocated`` hook), as ONE scatter riding the next
+        write dispatch's preamble. Idx count pads to the power-of-two
+        bucket with the trash page 0 so the scatter's program count
+        stays bucket-bounded."""
+        if self.k_scales is None or not self._pending_scale_resets:
+            return
+        idxs = np.unique(
+            np.asarray(self._pending_scale_resets, np.int32)
+        )
+        self._pending_scale_resets.clear()
+        padded = np.zeros(_bucket(len(idxs)), np.int32)
+        padded[: len(idxs)] = idxs
+        self.k_scales, self.v_scales = _reset_scale_entries(
+            self.k_scales, self.v_scales, jnp.asarray(padded)
+        )
+
+    def _note_quant_step(
+        self, n_requants, tokens_written: int, gen_tokens: int = 0
+    ) -> None:
+        """Fold one quantized dispatch's outcome into the lane gauges.
+        ``n_requants`` (the device-computed count of (layer, page) scale
+        entries that grew) stays a DEVICE scalar — it accumulates
+        asynchronously and only converts at scrape time
+        (_fold_quant_counters), so pipelined decode never syncs on it.
+        ``gen_tokens`` > 0 (decode dispatches) feeds the measured
+        lane-throughput EMA behind ``lowprec_tok_s``."""
+        self._requants_dev = (
+            n_requants if self._requants_dev is None
+            else self._requants_dev + n_requants
+        )
+        self.stats["kv_device_bytes_saved_total"] += (
+            tokens_written * self._kv_saved_per_token
+        )
+        self.stats["kv_device_quant_pages"] = self.allocator.resident_count
+        if gen_tokens > 0:
+            now = time.perf_counter()
+            dt = now - self._lowprec_rate_t
+            if self._lowprec_rate_t and 0 < dt < 10.0:
+                inst = gen_tokens / dt
+                prev = self.stats["lowprec_tok_s"]
+                self.stats["lowprec_tok_s"] = round(
+                    inst if prev == 0.0 else 0.8 * prev + 0.2 * inst, 3
+                )
+            self._lowprec_rate_t = now
+
+    def _fold_quant_counters(self) -> None:
+        """Convert the accumulated device-side requant counter into the
+        host stat (one scalar d2h; called from load_metrics scrapes),
+        and fold the offload manager's export-bounce count (blocks that
+        had to leave the device codec for a full-width/fp8 tier) into
+        the export-requant gauge."""
+        if self._requants_dev is not None:
+            self.stats["kv_device_requants_total"] += int(self._requants_dev)
+            self._requants_dev = None
+        if self.offload is not None and self.k_scales is not None:
+            cur = self.offload.device_requants_total
+            self.stats["kv_device_export_requant_total"] += (
+                cur - self._offload_requants_seen
+            )
+            self._offload_requants_seen = cur
+
     def _ring_chunk(self, seq: _Sequence, pos: int) -> bool:
         """Route THIS chunk through sp ring attention? History-free
         first chunk of a long-enough prompt on an sp>1 mesh, full
@@ -1853,6 +2085,9 @@ class JaxEngine(AsyncEngine):
             or pos != 0
             or self.mesh is None
             or self.mesh.shape.get("sp", 1) <= 1
+            # int8 device cache: ring writes land full-width (no scale
+            # stream through the rotated chunks) — paged path only
+            or self.k_scales is not None
             or len(seq.tokens) < cfg.ring_prefill_threshold
             or cfg.model.sliding_window != 0
             or cfg.model.layer_windows  # per-layer windows (gpt-oss)
@@ -1885,6 +2120,30 @@ class JaxEngine(AsyncEngine):
             )
             return logits, pos + len(chunk)
         # table must cover padded chunk; _table_for pads with trash 0
+        if self.k_scales is not None:
+            self._flush_scale_resets()
+            out = self._pallas_guard(
+                lambda: llama.prefill(
+                    self.params,
+                    cfg.model,
+                    jnp.asarray(toks),
+                    jnp.asarray(self._table_for(seq)),
+                    jnp.int32(pos),
+                    jnp.int32(len(chunk)),
+                    self.k_cache,
+                    self.v_cache,
+                    use_pallas=self.use_pallas,
+                    mesh=self.mesh,
+                    use_ring=ring,
+                    k_scales=self.k_scales,
+                    v_scales=self.v_scales,
+                ),
+                key=("prefill", T, ring), trace=seq.trace,
+            )
+            (logits, self.k_cache, self.v_cache,
+             self.k_scales, self.v_scales) = out
+            self._note_quant_step(0, len(chunk))
+            return logits, pos + len(chunk)
         logits, self.k_cache, self.v_cache = self._pallas_guard(
             lambda: llama.prefill(
                 self.params,
@@ -2147,7 +2406,7 @@ class JaxEngine(AsyncEngine):
 
     async def export_device_chain(
         self, seq_hashes: list[int], max_blocks: int = 128
-    ) -> tuple[list[int], Optional[np.ndarray], Optional[np.ndarray]]:
+    ) -> tuple:
         """Serve side of the fleet prefix cache, DEVICE tier: the
         longest consecutive run of ``seq_hashes`` resident in the device
         prefix cache, gathered d2h as one bounded export — so chains
@@ -2158,9 +2417,16 @@ class JaxEngine(AsyncEngine):
         device executor under the device lock, bounded by
         ``max_blocks`` so a serve can never become an unbounded HBM
         drain. Mirrored engines return empty (their gather is a
-        lockstep broadcast no peer fetch should trigger)."""
+        lockstep broadcast no peer fetch should trigger).
+
+        Returns (hashes, k, v, k_scales, v_scales). With an int8 device
+        cache the export is the DEVICE CODEC verbatim — int8 payloads +
+        [L, n] per-block scales, no full-width bounce through HBM or
+        PCIe (the scales are non-None exactly then); the serving side
+        adopts them when the wire codec matches and re-encodes (counted
+        in ``kv_device_export_requant_total``) when it doesn't."""
         if self.mirror is not None or not seq_hashes or self._closed:
-            return [], None, None
+            return [], None, None, None, None
         # claim refs via the allocator's own chain matcher (hashes are
         # chained, so the local-hash slot is unused by the lookup) —
         # claiming pins the pages against eviction during the gather
@@ -2168,18 +2434,32 @@ class JaxEngine(AsyncEngine):
             (), hashes=[(0, h) for h in seq_hashes[:max_blocks]]
         )
         if not claimed:
-            return [], None, None
+            return [], None, None, None, None
+        ks = vs = None
         try:
             idxs = [b.idx for b in claimed]
             async with self._device_lock:
-                k, v = await asyncio.get_running_loop().run_in_executor(
-                    None, self._gather_device, idxs, False
-                )
+                if self.k_scales is not None:
+                    k, v, ks, vs = await (
+                        asyncio.get_running_loop().run_in_executor(
+                            None, self._gather_device, idxs, False, True
+                        )
+                    )
+                else:
+                    k, v = await asyncio.get_running_loop().run_in_executor(
+                        None, self._gather_device, idxs, False
+                    )
         finally:
             self.allocator.free(claimed)
         served = list(seq_hashes[: len(claimed)])
         self.stats["peer_serve_d2h_blocks"] += len(served)
-        return served, k, v
+        return served, k, v, ks, vs
+
+    def note_export_requant(self, n: int) -> None:
+        """A peer serve re-encoded ``n`` device-codec blocks away from
+        int8 (the puller's wire codec didn't match) — the visible form
+        of what used to be a silent full-width bounce."""
+        self.stats["kv_device_export_requant_total"] += n
 
     async def pre_stage_weights(self, model: str) -> bool:
         """PRESERVE-style weight pre-stage hook, driven by the router's
@@ -2514,6 +2794,9 @@ class JaxEngine(AsyncEngine):
             cfg.spec_gamma > 0
             and n > 1
             and not self._prefill_states
+            # int8-with-scales cache: the verify forward has no scale
+            # stream (gated loudly at init) — plain windows only
+            and self.k_scales is None
         ):
             # Proposals must come from the FRESH tail (an undrained
             # window's tokens are part of it), but draining kills the
@@ -2920,6 +3203,12 @@ class JaxEngine(AsyncEngine):
                     counts=self._pen_counts,
                     prompt_mask=self._pen_mask,
                 )
+            quantized = self.k_scales is not None
+            if quantized:
+                self._flush_scale_resets()
+                kwargs.update(
+                    k_scales=self.k_scales, v_scales=self.v_scales
+                )
             out = self._pallas_guard(lambda: llama.mixed_step(
                 self.params,
                 cfg.model,
@@ -2950,6 +3239,13 @@ class JaxEngine(AsyncEngine):
             ), key=("mixed", MP, T, penalized, want_lp))
             toks, p_logits, self.k_cache, self.v_cache = out[:4]
             rest = list(out[4:])
+            if quantized:
+                self.k_scales = rest.pop(0)
+                self.v_scales = rest.pop(0)
+                self._note_quant_step(
+                    rest.pop(0), self._n_active + total_take,
+                    gen_tokens=self._n_active,
+                )
             if penalized:
                 self._pen_counts = rest.pop(0)
             lps_dev = rest.pop(0) if want_lp else None
@@ -3277,6 +3573,10 @@ class JaxEngine(AsyncEngine):
             merged=cfg.decode_merged,
             with_logprobs=want_lp,
         )
+        quantized = self.k_scales is not None
+        if quantized:
+            self._flush_scale_resets()
+            kw.update(k_scales=self.k_scales, v_scales=self.v_scales)
         if self._penalties_active():
             out = self._pallas_guard(lambda: llama.decode_window(
                 *args, **kw, use_pallas=self.use_pallas,
@@ -3286,14 +3586,24 @@ class JaxEngine(AsyncEngine):
                 counts=self._pen_counts,
                 prompt_mask=self._pen_mask,
             ), key=("decode", n, True, want_lp))
-            toks, self.k_cache, self.v_cache, self._pen_counts = out[:4]
-            lps = out[4] if want_lp else None
+            penalized = True
         else:
             out = self._pallas_guard(lambda: llama.decode_window(
                 *args, **kw, use_pallas=self.use_pallas
             ), key=("decode", n, False, want_lp))
-            toks, self.k_cache, self.v_cache = out[:3]
-            lps = out[3] if want_lp else None
+            penalized = False
+        toks, self.k_cache, self.v_cache = out[:3]
+        rest = list(out[3:])
+        if quantized:
+            self.k_scales = rest.pop(0)
+            self.v_scales = rest.pop(0)
+            self._note_quant_step(
+                rest.pop(0), self._n_active * n,
+                gen_tokens=self._n_active * n,
+            )
+        if penalized:
+            self._pen_counts = rest.pop(0)
+        lps = rest.pop(0) if want_lp else None
         # device handles; materialized at emission (fetching here would
         # block the pipelined dispatch on the window's full execution)
         self._window_logprobs = lps
@@ -3578,8 +3888,16 @@ class JaxEngine(AsyncEngine):
             seq.blocks = []
         return first_token, first_lp, max(n_prompt - skip_blocks, 0)
 
-    def _gather_device(self, idxs: list[int], keep_on_device: bool = False):
-        from .offload import _gather_blocks, _pad_idxs
+    def _gather_device(self, idxs: list[int], keep_on_device: bool = False,
+                       with_scales: bool = False):
+        """Bucketed d2h page gather. With an int8 device cache the pages
+        are quantized codes: ``with_scales=True`` returns the device
+        codec verbatim — (k, v, k_scales, v_scales) with [L, n] scale
+        stacks matching the tier/wire entry form, zero re-encode — while
+        ``with_scales=False`` (callers that need full width: disagg
+        extract, legacy peers) dequantizes on device before the d2h and
+        counts the bounce in ``kv_device_export_requant_total``."""
+        from .offload import _gather_blocks, _gather_blocks_s, _pad_idxs
 
         padded = _pad_idxs(idxs)
         if self.mirror is not None:
@@ -3587,6 +3905,29 @@ class JaxEngine(AsyncEngine):
                 self.k_cache, self.v_cache, padded
             )
             return k[:, :, : len(idxs)], v[:, :, : len(idxs)]
+        if self.k_scales is not None:
+            k, v, ks, vs = _gather_blocks_s(
+                self.k_cache, self.v_cache, self.k_scales, self.v_scales,
+                jnp.asarray(padded),
+            )
+            n = len(idxs)
+            if with_scales:
+                k, v = k[:, :, :n], v[:, :, :n]
+                ks, vs = ks[:, :n], vs[:, :n]
+                if keep_on_device:
+                    return k, v, ks, vs
+                return tuple(
+                    np.asarray(jax.device_get(a)) for a in (k, v, ks, vs)
+                )
+            # full-width bounce (visible, not silent): dequantize with
+            # the plane scales before the d2h
+            self.stats["kv_device_export_requant_total"] += n
+            k = _dequant_gathered(k, ks, self.cfg.model.dtype)
+            v = _dequant_gathered(v, vs, self.cfg.model.dtype)
+            k, v = k[:, :, :n], v[:, :, :n]
+            if keep_on_device:
+                return k, v
+            return np.asarray(jax.device_get(k)), np.asarray(jax.device_get(v))
         k, v = _gather_blocks(self.k_cache, self.v_cache, jnp.asarray(padded))
         k, v = k[:, :, : len(idxs)], v[:, :, : len(idxs)]
         if keep_on_device:
@@ -3745,7 +4086,13 @@ class JaxEngine(AsyncEngine):
         k_scales: Optional[np.ndarray] = None,
         v_scales: Optional[np.ndarray] = None,
     ) -> None:
-        from .offload import _pad_idxs, _scatter_blocks, _scatter_blocks_q
+        from .offload import (
+            _pad_idxs,
+            _scatter_blocks,
+            _scatter_blocks_adopt,
+            _scatter_blocks_q,
+            _scatter_blocks_requant,
+        )
 
         if self.offload is not None:
             # pending evictions may reference the very pages we're about to
@@ -3765,8 +4112,31 @@ class JaxEngine(AsyncEngine):
                 np.asarray(k_data), np.asarray(v_data),
             )
             return
-        # only real blocks ship over PCIe — the scatter core pads the
+        # only real blocks ship over PCIe — the scatter cores pad the
         # stack to the bucketed index count on device
+        if self.k_scales is not None:
+            # int8 device cache: the plain cores' astype would truncate
+            # real values into int8 codes. A matching int8 wire payload
+            # adopts verbatim (payload + scales, same codec); anything
+            # else (full-width, fp8 wire) re-quantizes on landing.
+            k_j, v_j = jnp.asarray(k_data), jnp.asarray(v_data)
+            if k_scales is not None and k_j.dtype == self.k_cache.dtype:
+                core = _scatter_blocks_adopt
+            else:
+                core = _scatter_blocks_requant
+            if k_scales is None:
+                shape = (self.k_scales.shape[0], int(k_j.shape[2]))
+                ks_j = vs_j = jnp.ones(shape, jnp.float32)
+            else:
+                ks_j = jnp.asarray(np.asarray(k_scales, np.float32))
+                vs_j = jnp.asarray(np.asarray(v_scales, np.float32))
+            (
+                self.k_cache, self.v_cache, self.k_scales, self.v_scales,
+            ) = core(
+                self.k_cache, self.v_cache, self.k_scales, self.v_scales,
+                jnp.asarray(padded), k_j, v_j, ks_j, vs_j,
+            )
+            return
         if k_scales is not None:
             # quantized delivery: dequant fuses into the donated scatter
             self.k_cache, self.v_cache = _scatter_blocks_q(

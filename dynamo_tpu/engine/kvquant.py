@@ -24,13 +24,14 @@ scale recenters each block's dynamic range onto the format's ±448
 span, which measurably tightens logprob drift on small-magnitude V
 blocks.
 
-The DEVICE cache's quantization remains ``EngineConfig.kv_cache_dtype``
-(scale-free fp8 cast — per-element, so decode's single-token appends
-need no block rescale); this codec covers every plane that moves KV
-*bytes* off the device. The two compose: a quantized device cache
-gathers fp8 blocks, which this codec re-quantizes for the tiers with
-explicit scales, and restores dequantize back to the cache dtype on
-the device-side scatter.
+The DEVICE cache's quantization is ``EngineConfig.kv_cache_dtype``:
+scale-free fp8 cast (per-element, no block rescale on append) or the
+int8-with-scales mode (models/quant.py), whose per-(layer, page) scale
+planes use EXACTLY this codec's granularity and qmax — so an int8
+device cache and an int8 tier exchange blocks verbatim (payload +
+scale adoption, zero re-encode), while fp8/full-width tiers re-encode
+from the device scales (counted: ``kv_device_export_requant_total``).
+This codec covers every plane that moves KV *bytes* off the device.
 
 Quality is gated honestly: the tier round-trip is NOT bit-exact, so
 :func:`measure_logprob_drift` ships alongside the codec — greedy-token
@@ -145,10 +146,15 @@ async def measure_logprob_drift(
     prompts: list,
     max_tokens: int = 16,
     park=None,
+    stat_key: str = "kv_quant_logprob_drift_max",
 ) -> dict:
     """Greedy-token agreement + chosen-token logprob drift of a
-    quantized-tier engine against a full-width reference, on a fixed
-    prompt set.
+    quantized engine against a full-width reference, on a fixed
+    prompt set. Gates every quantized mode, not just the tier codec:
+    pass ``stat_key`` to record int8-weight (``models/quant.py``
+    WEIGHT_MODES) or int8-device-cache drift under its own stat
+    (``park=None`` — those modes quantize the live compute path, no
+    tier churn needed).
 
     Protocol per prompt: the reference engine serves it cold (greedy,
     chosen-token logprobs on). The quantized engine serves it once to
@@ -220,7 +226,5 @@ async def measure_logprob_drift(
     }
     stats = getattr(quant_engine, "stats", None)
     if stats is not None:
-        stats["kv_quant_logprob_drift_max"] = max(
-            float(stats.get("kv_quant_logprob_drift_max", 0.0)), drift_max
-        )
+        stats[stat_key] = max(float(stats.get(stat_key, 0.0)), drift_max)
     return result

@@ -85,6 +85,7 @@ import numpy as np
 
 # shared with the wire codec (disagg/transfer.py) so the two
 # serialization planes can't drift on which dtypes round-trip
+from ..models.quant import KV_INT8_QMAX, KV_SCALE_EPS
 from ..utils.dtypes import np_dtype as _resolve_dtype
 from . import kvquant
 from .kvquant import entry_nbytes
@@ -181,12 +182,97 @@ def scatter_blocks_q_core(k_cache, v_cache, idxs, k_data, v_data, ks, vs):
     )
 
 
+def gather_blocks_s_core(k_cache, v_cache, k_scales, v_scales, idxs):
+    """Scale-plane twin of :func:`gather_blocks_core` for the int8
+    DEVICE cache (models/quant.py KV_CACHE_DTYPES): the gathered pages
+    are quantized codes, so their per-(layer, page) scales ride along —
+    [L, N] planes -> [L, n] stacks matching the tier entry form."""
+    kg, vg = gather_blocks_core(k_cache, v_cache, idxs)
+    return (
+        kg, vg,
+        jnp.take(k_scales, idxs, axis=1),
+        jnp.take(v_scales, idxs, axis=1),
+    )
+
+
+def _pad_block_stack(idxs, k_data, v_data, ks, vs):
+    n, m = idxs.shape[0], k_data.shape[2]
+    if m < n:  # static at trace time
+        pad = [(0, 0)] * k_data.ndim
+        pad[2] = (0, n - m)
+        k_data, v_data = jnp.pad(k_data, pad), jnp.pad(v_data, pad)
+        ks = jnp.pad(ks, ((0, 0), (0, n - m)))
+        vs = jnp.pad(vs, ((0, 0), (0, n - m)))
+    return k_data, v_data, ks, vs
+
+
+def scatter_blocks_adopt_core(k_cache, v_cache, k_scales, v_scales, idxs,
+                              k_data, v_data, ks, vs):
+    """int8 payload -> int8-with-scales DEVICE cache: the tier/wire
+    codec (engine/kvquant.py) and the device planes share the same
+    symmetric-absmax per-(layer, block) scheme at qmax 127, so the
+    payload scatters VERBATIM and the carried scales are adopted into
+    the engine's scale planes — no dequantize bounce in either
+    direction. Pad rows target trash block 0; their zero scales clamp
+    to the epsilon floor (block 0's scale is never read meaningfully)."""
+    k_data, v_data, ks, vs = _pad_block_stack(idxs, k_data, v_data, ks, vs)
+    return (
+        k_cache.at[:, :, idxs].set(k_data.astype(k_cache.dtype)),
+        v_cache.at[:, :, idxs].set(v_data.astype(v_cache.dtype)),
+        k_scales.at[:, idxs].set(
+            jnp.maximum(ks.astype(jnp.float32), KV_SCALE_EPS)
+        ),
+        v_scales.at[:, idxs].set(
+            jnp.maximum(vs.astype(jnp.float32), KV_SCALE_EPS)
+        ),
+    )
+
+
+def scatter_blocks_requant_core(k_cache, v_cache, k_scales, v_scales, idxs,
+                                k_data, v_data, ks, vs):
+    """Full-width or foreign-codec (fp8-wire) landing into the int8
+    DEVICE cache: dequantize with the carried scales (callers pass ones
+    for a full-width payload), re-quantize each block against fresh
+    per-(layer, block) absmax, and land payload + plane scales in one
+    donated dispatch. The cast in :func:`scatter_blocks_core` /
+    :func:`scatter_blocks_q_core` would silently truncate reals to int
+    codes here — this core is the only correct landing."""
+    k_data, v_data, ks, vs = _pad_block_stack(idxs, k_data, v_data, ks, vs)
+    kd = k_data.astype(jnp.float32) * ks[:, None, :, None, None]
+    vd = v_data.astype(jnp.float32) * vs[:, None, :, None, None]
+    new_ks = jnp.maximum(
+        jnp.max(jnp.abs(kd), axis=(1, 3, 4)) / KV_INT8_QMAX, KV_SCALE_EPS
+    )
+    new_vs = jnp.maximum(
+        jnp.max(jnp.abs(vd), axis=(1, 3, 4)) / KV_INT8_QMAX, KV_SCALE_EPS
+    )
+    qk = jnp.clip(jnp.round(kd / new_ks[:, None, :, None, None]),
+                  -KV_INT8_QMAX, KV_INT8_QMAX)
+    qv = jnp.clip(jnp.round(vd / new_vs[:, None, :, None, None]),
+                  -KV_INT8_QMAX, KV_INT8_QMAX)
+    return (
+        k_cache.at[:, :, idxs].set(qk.astype(k_cache.dtype)),
+        v_cache.at[:, :, idxs].set(qv.astype(v_cache.dtype)),
+        k_scales.at[:, idxs].set(new_ks),
+        v_scales.at[:, idxs].set(new_vs),
+    )
+
+
 _gather_blocks = jax.jit(gather_blocks_core)
+_gather_blocks_s = jax.jit(gather_blocks_s_core)
 _scatter_blocks = jax.jit(
     scatter_blocks_core, donate_argnames=("k_cache", "v_cache")
 )
 _scatter_blocks_q = jax.jit(
     scatter_blocks_q_core, donate_argnames=("k_cache", "v_cache")
+)
+_scatter_blocks_adopt = jax.jit(
+    scatter_blocks_adopt_core,
+    donate_argnames=("k_cache", "v_cache", "k_scales", "v_scales"),
+)
+_scatter_blocks_requant = jax.jit(
+    scatter_blocks_requant_core,
+    donate_argnames=("k_cache", "v_cache", "k_scales", "v_scales"),
 )
 
 
@@ -744,6 +830,22 @@ class OffloadManager:
         # where the tree's chain-cascade would take the worker's whole
         # downstream subtree with it
         self.device_has: Optional[Callable[[int], bool]] = None
+        # int8-with-scales DEVICE cache (kv_cache_dtype="int8"): the
+        # engine publishes its per-(layer, page) scale planes so tier
+        # traffic speaks the device codec directly. device_planes()
+        # -> (k_scales, v_scales) [L, N] f32 (or None when the cache is
+        # full-width / scale-free fp8); device_planes_set re-homes
+        # updated planes on the engine after a donated scatter. Flushes
+        # then gather int8 pages + their scales and ADOPT them as tier
+        # entries when the tier codec is int8 too (zero re-encode, the
+        # d2h already moved 1-byte elements); restores scatter payload +
+        # scales back into cache + planes. device_requants_total counts
+        # blocks forced OFF the device codec on the way out (full-width
+        # or fp8-tier bounce) — folded into the engine's
+        # kv_device_export_requant_total gauge at scrape time.
+        self.device_planes: Optional[Callable[[], Optional[tuple]]] = None
+        self.device_planes_set: Optional[Callable[[tuple], None]] = None
+        self.device_requants_total = 0
         # staging area for INCOMING chains (disk promotions, peer
         # pulls): a reserve-side overlay the host pool's LRU capacity
         # does not apply to. Promoting a chain longer than the host
@@ -878,6 +980,28 @@ class OffloadManager:
                 full - entry_nbytes((qk, qv, ks, vs)), 0
             )
         return (qk, qv, ks, vs)
+
+    def _encode_device_entry(self, qk: np.ndarray, qv: np.ndarray,
+                             ks: np.ndarray, vs: np.ndarray) -> tuple:
+        """Device-codec block (int8 payload + per-layer scales gathered
+        straight off the int8 device cache) -> this manager's tier entry.
+        An int8 tier adopts it verbatim — no CPU quantize, and the d2h
+        already moved 1-byte elements. Any other tier codec forces the
+        bounce back through full width (counted: device_requants_total).
+        Executor threads only."""
+        full = (qk.size + qv.size) * np.dtype(self.full_dtype).itemsize
+        if self.kv_quant == "int8":
+            entry = (qk, qv, ks, vs)
+            with self._lock:
+                self.kv_quant_blocks_total += 1
+                self.kv_quant_bytes_saved_total += max(
+                    full - entry_nbytes(entry), 0
+                )
+            return entry
+        with self._lock:
+            self.device_requants_total += 1
+        k, v = kvquant.dequantize_entry(qk, qv, ks, vs, self.full_dtype)
+        return self._encode_entry(k, v)
 
     def _normalize_entry(self, entry: tuple) -> tuple:
         """Coerce an incoming entry (disk read after a --kv-quant flip,
@@ -1333,24 +1457,36 @@ class OffloadManager:
                 )
                 self.pool.stored_total += len(pending)
             return
+        planes = self.device_planes() if self.device_planes else None
+        if planes is not None:
+            kg, vg, ksg, vsg = _gather_blocks_s(
+                k_cache, v_cache, planes[0], planes[1], jnp.asarray(idxs)
+            )
+            return self._land_flush(pending, kg, vg, ksg, vsg)
         kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
         self._land_flush(pending, kg, vg)
 
-    def _land_flush(self, pending, kg, vg) -> None:
+    def _land_flush(self, pending, kg, vg, ksg=None, vsg=None) -> None:
         """Blocking half of a flush: d2h fetch + host-pool insertion
         (quantized to the tier codec when --kv-quant is on — the
         quantize runs here, off the loop, before the entry is priced
         against the pool's byte budget). Runs inline on the sync path,
         on the offload executor otherwise."""
         kg, vg = _device_fetch(kg), _device_fetch(vg)
+        if ksg is not None:
+            ksg, vsg = _device_fetch(ksg), _device_fetch(vsg)
         entries = []
         for i, (seq_hash, _idx) in enumerate(pending):
             # copy: a view would pin the whole padded gather batch in
             # RAM for as long as any one block stays resident
-            entries.append(
-                (seq_hash,
-                 self._encode_entry(kg[:, :, i].copy(), vg[:, :, i].copy()))
-            )
+            if ksg is not None:
+                e = self._encode_device_entry(
+                    kg[:, :, i].copy(), vg[:, :, i].copy(),
+                    ksg[:, i].copy(), vsg[:, i].copy(),
+                )
+            else:
+                e = self._encode_entry(kg[:, :, i].copy(), vg[:, :, i].copy())
+            entries.append((seq_hash, e))
         with self._lock:
             for seq_hash, e in entries:
                 self.pool.put(seq_hash, e[0], e[1],
@@ -1400,8 +1536,17 @@ class OffloadManager:
             if not pending:
                 return
         idxs = _pad_idxs([idx for _h, idx in pending])
-        kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
-        fut = self._executor().submit(self._land_flush, pending, kg, vg)
+        planes = self.device_planes() if self.device_planes else None
+        if planes is not None:
+            kg, vg, ksg, vsg = _gather_blocks_s(
+                k_cache, v_cache, planes[0], planes[1], jnp.asarray(idxs)
+            )
+        else:
+            kg, vg = _gather_blocks(k_cache, v_cache, jnp.asarray(idxs))
+            ksg = vsg = None
+        fut = self._executor().submit(
+            self._land_flush, pending, kg, vg, ksg, vsg
+        )
         with self._lock:
             self._inflight_flushes.append(
                 _FlushTask([h for h, _idx in pending], fut)
@@ -1496,11 +1641,43 @@ class OffloadManager:
                 # request that never arrives is not a hit
                 self.pool.hit_blocks_total += len(up.data)
         idxs = jnp.asarray(_pad_idxs(up.idxs))
+        planes = self.device_planes() if self.device_planes else None
+        if planes is not None:
+            return self._scatter_into_device_q(
+                k_cache, v_cache, planes, idxs, landed
+            )
         if len(landed) > 2:  # quantized chain: dequant fused into scatter
             return _scatter_blocks_q(
                 k_cache, v_cache, idxs, k_dev, v_dev, landed[2], landed[3]
             )
         return _scatter_blocks(k_cache, v_cache, idxs, k_dev, v_dev)
+
+    def _scatter_into_device_q(self, k_cache, v_cache, planes, idxs, parts):
+        """Land a restore into the int8-with-scales DEVICE cache: a
+        matching int8 tier entry adopts payload + scales verbatim
+        (:func:`scatter_blocks_adopt_core`); a full-width or fp8 entry
+        re-quantizes on device against fresh per-(layer, block) absmax
+        (:func:`scatter_blocks_requant_core`). The updated planes are
+        re-homed on the engine via ``device_planes_set``; returns the
+        updated caches (same shape as the plain scatter paths)."""
+        ks_p, vs_p = planes
+        k_dev, v_dev = jnp.asarray(parts[0]), jnp.asarray(parts[1])
+        if len(parts) > 2 and parts[2] is not None:
+            ks, vs = jnp.asarray(parts[2]), jnp.asarray(parts[3])
+            core = (
+                _scatter_blocks_adopt
+                if k_dev.dtype == k_cache.dtype
+                else _scatter_blocks_requant
+            )
+        else:
+            shape = (ks_p.shape[0], k_dev.shape[2])
+            ks = vs = jnp.ones(shape, jnp.float32)
+            core = _scatter_blocks_requant
+        k_cache, v_cache, nk, nv = core(
+            k_cache, v_cache, ks_p, vs_p, idxs, k_dev, v_dev, ks, vs
+        )
+        self.device_planes_set((nk, nv))
+        return k_cache, v_cache
 
     # -- prefetch accounting (router-hinted restores, engine-side) --
     def note_prefetch_landed(self, up: RestoreUpload) -> None:
@@ -1558,6 +1735,17 @@ class OffloadManager:
         k_host = np.stack([e[0] for e in data], axis=2)  # [L, Hkv, m, bs, D]
         v_host = np.stack([e[1] for e in data], axis=2)  # unpadded — the
         idxs = jnp.asarray(_pad_idxs(block_idxs))  # scatter core pads on device
+        planes = self.device_planes() if self.device_planes else None
+        if planes is not None:
+            parts = [k_host, v_host]
+            if len(data[0]) > 2:
+                parts += [
+                    np.stack([e[2] for e in data], axis=1),
+                    np.stack([e[3] for e in data], axis=1),
+                ]
+            return self._scatter_into_device_q(
+                k_cache, v_cache, planes, idxs, parts
+            )
         if len(data[0]) > 2:  # quantized chain (sync path)
             return _scatter_blocks_q(
                 k_cache, v_cache, idxs,

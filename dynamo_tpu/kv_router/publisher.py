@@ -472,10 +472,16 @@ class KvPeerServer:
             # serve at the stored codec's width only when the puller
             # advertised the capability (tolerant default 0 = legacy
             # puller = full-width bytes; the negotiation matrix of
-            # docs/kv_offload.md)
+            # docs/kv_offload.md). Without a host tier the DEVICE
+            # cache's own codec (int8-with-scales) is the stored codec.
+            dev_q = (
+                "int8"
+                if getattr(self.engine, "k_scales", None) is not None
+                else "none"
+            )
             serve_q = (
-                off.kv_quant
-                if off is not None and req.accept_quant >= 1
+                (off.kv_quant if off is not None else dev_q)
+                if req.accept_quant >= 1
                 else "none"
             )
             # device tier first: chains living ONLY in HBM used to be
@@ -484,13 +490,15 @@ class KvPeerServer:
             # hop) serves the hottest tier too; the host/disk export
             # continues the run past the device-resident prefix
             export_dev = getattr(self.engine, "export_device_chain", None)
+            dks = dvs = None
             if export_dev is not None:
-                hashes, k, v = await export_dev(
+                hashes, k, v, dks, dvs = await export_dev(
                     req.hashes, max_blocks=self.max_d2h_blocks
                 )
             if off is not None:
 
-                def _export_and_merge(k=k, v=v, hashes=tuple(hashes)):
+                def _export_and_merge(k=k, v=v, dks=dks, dvs=dvs,
+                                      hashes=tuple(hashes)):
                     # executor thread: the lower-tier export, the
                     # device run's wire quantize, and the multi-MB
                     # merge all stay off the event loop
@@ -498,7 +506,23 @@ class KvPeerServer:
 
                     tail = req.hashes[len(hashes):]
                     ks = vs = None
-                    if serve_q != "none" and hashes:
+                    if dks is not None and hashes:
+                        # int8 DEVICE-codec export: ship verbatim when
+                        # the negotiated wire codec matches; otherwise
+                        # re-encode (the counted bounce — what used to
+                        # happen silently on every device serve)
+                        if serve_q == "int8":
+                            ks, vs = dks, dvs
+                        else:
+                            k, v = _kvq.dequantize_stack(
+                                k, v, dks, dvs, self.engine.cfg.model.dtype
+                            )
+                            self.engine.note_export_requant(len(hashes))
+                            if serve_q != "none":
+                                k, v, ks, vs = _kvq.quantize_stack(
+                                    k, v, serve_q
+                                )
+                    elif serve_q != "none" and hashes:
                         k, v, ks, vs = _kvq.quantize_stack(k, v, serve_q)
                     h2, k2, v2, ks2, vs2 = off.export_chain_q(
                         list(tail), quant_ok=serve_q != "none"
@@ -519,6 +543,25 @@ class KvPeerServer:
                         None, _export_and_merge
                     )
                 )
+            elif dks is not None and hashes:
+                # no host tier: the device-codec export ships verbatim
+                # to a quant-capable puller, or dequantizes (counted)
+                # for a legacy one
+                if serve_q == "int8":
+                    ks, vs = dks, dvs
+                else:
+
+                    def _dequant(k=k, v=v):
+                        from ..engine import kvquant as _kvq
+
+                        self.engine.note_export_requant(len(hashes))
+                        return _kvq.dequantize_stack(
+                            k, v, dks, dvs, self.engine.cfg.model.dtype
+                        )
+
+                    k, v = await asyncio.get_running_loop().run_in_executor(
+                        None, _dequant
+                    )
             if not hashes:
                 self.misses += 1
                 await send_kv_blocks(

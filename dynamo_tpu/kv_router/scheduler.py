@@ -147,6 +147,17 @@ class WorkerLoad:
     # worker (0 until a harness ran) — operators watch this gauge when
     # a quantized tier is enabled fleet-wide
     kv_quant_logprob_drift_max: float = 0.0
+    # int8-with-scales DEVICE cache lane (kv_cache_dtype="int8",
+    # models/quant.py): resident quantized pages, cumulative page
+    # requantizations (appends that grew a page's absmax scale), HBM
+    # bytes the lane saved vs full width, exports forced off the device
+    # codec (full-width/fp8 bounce — ideally 0 with an int8 tier), and
+    # the measured decode throughput of the low-precision lane
+    kv_device_quant_pages: int = 0
+    kv_device_requants: int = 0
+    kv_device_bytes_saved: int = 0
+    kv_device_export_requants: int = 0
+    lowprec_tok_s: float = 0.0
     # accelerator-slice fingerprint (parallel/mesh.slice_fingerprint):
     # two workers advertising the same fp can hand KV device→device
     # over ICI — the peer chooser prices their pulls at the ici class
@@ -242,6 +253,12 @@ class WorkerLoad:
             kv_quant_bytes_saved=d.get("kv_quant_bytes_saved_total", 0),
             kv_quant_logprob_drift_max=d.get(
                 "kv_quant_logprob_drift_max", 0.0),
+            kv_device_quant_pages=d.get("kv_device_quant_pages", 0),
+            kv_device_requants=d.get("kv_device_requants_total", 0),
+            kv_device_bytes_saved=d.get("kv_device_bytes_saved_total", 0),
+            kv_device_export_requants=d.get(
+                "kv_device_export_requant_total", 0),
+            lowprec_tok_s=d.get("lowprec_tok_s", 0.0),
             slice_fp=str(d.get("kv_slice_fp") or ""),
             ici_handoffs=d.get("ici_handoffs", 0),
             peer_serve_d2h_blocks=d.get("peer_serve_d2h_blocks_total", 0),

@@ -991,11 +991,15 @@ def main(argv=None) -> None:
                         "tokenizer.model) — e.g. a real tokenizer for "
                         "the *-sim model presets")
     p.add_argument("--quantization", default="none",
-                   choices=["none", "int8", "fp8_e4m3"],
-                   help="weight quantization (per-channel; models/quant.py)")
+                   choices=["none", "int8", "fp8_e4m3", "int8_native"],
+                   help="weight quantization (per-channel; models/quant.py; "
+                        "int8_native feeds int8 operands into the fused "
+                        "step's GEMMs with f32 accumulation)")
     p.add_argument("--kv-cache-dtype", default="model",
-                   choices=["model", "float8_e4m3", "bfloat16"],
+                   choices=["model", "float8_e4m3", "bfloat16", "int8"],
                    help="KV cache storage dtype (float8 = scale-free cast; "
+                        "int8 = int8-with-scales device cache, per-(layer, "
+                        "page) f32 scale planes — docs/kv_offload.md; "
                         "quantized caches keep the Pallas ragged kernels — "
                         "the dequant fuses into their KV page loads)")
     p.add_argument("--kv-quant", default="none",

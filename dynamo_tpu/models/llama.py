@@ -416,8 +416,27 @@ def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     ``{"q": int8|float8 [in, out], "s": f32 [out]}`` (models/quant.py);
     the convert fuses into the dot's operand read and the per-channel
     scale into its epilogue, so int8/fp8 storage halves HBM traffic with
-    bf16 MXU compute."""
+    bf16 MXU compute.
+
+    ``{"qn": int8, "s": f32}`` (quantization="int8_native") instead runs
+    a REAL int8 dot: activations are dynamically quantized per row
+    (absmax/127 over the contraction axis), the s8 x s8 dot accumulates
+    in int32 on the MXU, and both scales apply in the f32 epilogue —
+    the measured low-precision compute lane, not just narrow storage."""
     if isinstance(w, dict):
+        if "qn" in w:
+            xf = x.astype(jnp.float32)
+            s_x = jnp.maximum(
+                jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, 1e-12
+            )
+            xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w["qn"],
+                (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            out = acc.astype(jnp.float32) * s_x * w["s"]
+            return out.astype(x.dtype)
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
 
@@ -828,6 +847,12 @@ def prefill(
     use_pallas: bool = False,
     mesh=None,
     use_ring: bool = False,
+    # int8-with-scales device cache: per-page f32 scale planes [L, N]
+    # (NOT donated — the engine diffs them for gauges). When present the
+    # chunk lands quantized and the return grows to
+    # (logits, k_cache, v_cache, k_scales, v_scales).
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
 ):
     """Process one (chunk of a) prompt; returns (last_hidden_logits, caches).
 
@@ -850,7 +875,13 @@ def prefill(
     ICI ring. Cache writes are unchanged, so decode and later chunked
     prefill continue through the paged path.
     """
-    if mesh is not None and not use_ring:
+    quantized = k_scales is not None
+    if quantized:
+        # scale planes thread per layer, so: no staged pipeline (stage
+        # movers don't carry planes), no ring (ring writes full-width),
+        # no MLA (the engine gates MLA+int8 loudly at init)
+        assert not use_ring and not cfg.is_mla
+    if mesh is not None and not use_ring and not quantized:
         from ..parallel.pp import can_pipeline, pick_n_micro, pipelined_prefill
 
         n_micro = pick_n_micro(mesh, tokens.shape[0])
@@ -878,7 +909,8 @@ def prefill(
 
     inv_local = _rope_freqs_local(cfg)
 
-    def body(carry, layer_in, window=cfg.sliding_window, freqs=None):
+    def body(carry, layer_in, window=cfg.sliding_window, freqs=None,
+             scales=None):
         x = carry
         lp, kc, vc = layer_in
         h = pre_norm(lp, "attn_norm", x, cfg)
@@ -930,8 +962,18 @@ def prefill(
             fr = inv_freq if freqs is None else freqs
             q = apply_rope(q, positions, fr, rope_msc)
             k = apply_rope(k, positions, fr, rope_msc)
-            kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
-            vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
+            if scales is None:
+                ks_l = vs_l = None
+                kc = att.write_chunk_to_cache(kc, k, block_table, history_len)
+                vc = att.write_chunk_to_cache(vc, v, block_table, history_len)
+            else:
+                ks_l, vs_l = scales
+                kc, ks_l = att.write_chunk_to_cache_quantized(
+                    kc, ks_l, k, block_table, history_len, valid_len
+                )
+                vc, vs_l = att.write_chunk_to_cache_quantized(
+                    vc, vs_l, v, block_table, history_len, valid_len
+                )
             if use_ring:
                 from ..parallel.ring_attention import ring_attention_sharded
 
@@ -947,6 +989,7 @@ def prefill(
                     scale, use_pallas=use_pallas, mesh=mesh,
                     window=window, sinks=lp.get("sinks"),
                     cap=cfg.attn_softcap,
+                    k_scales=ks_l, v_scales=vs_l,
                 )
             x = x + post_norm(
                 lp, "attn_post_norm",
@@ -957,9 +1000,29 @@ def prefill(
             lp, "mlp_post_norm",
             _ffn(lp, cfg, h, mesh=mesh, use_pallas=use_pallas), cfg,
         )
+        if scales is not None:
+            return x, (kc, vc, ks_l, vs_l)
         return x, (kc, vc)
 
-    if cfg.layer_windows:
+    if quantized:
+        # per-layer scale-plane slices must thread through every write,
+        # so the layer loop unrolls (the scan body cannot in-place
+        # scatter the planes without a full re-stack copy per layer)
+        for lps, n, off in layer_groups(params, cfg):
+            for li in range(n):
+                l = off + li
+                lp = jax.tree.map(lambda a: a[li], lps)
+                x, (kc_l, vc_l, ks_l, vs_l) = body(
+                    x, (lp, k_cache[l], v_cache[l]),
+                    window=window_for_layer(cfg, l),
+                    freqs=rope_freqs_for_layer(cfg, l, inv_freq, inv_local),
+                    scales=(k_scales[l], v_scales[l]),
+                )
+                k_cache = k_cache.at[l].set(kc_l)
+                v_cache = v_cache.at[l].set(vc_l)
+                k_scales = k_scales.at[l].set(ks_l)
+                v_scales = v_scales.at[l].set(vs_l)
+    elif cfg.layer_windows:
         # heterogeneous attention (gpt-oss alternating sliding/full):
         # the window width is trace-static PER LAYER, so the layer loop
         # unrolls — a lax.scan body cannot carry a per-layer mask shape
@@ -982,6 +1045,8 @@ def prefill(
     # logits for the last *real* token of the chunk
     last = jnp.clip(valid_len - 1, 0, T - 1)
     logits = _logits(params, cfg, x[last])
+    if quantized:
+        return logits, k_cache, v_cache, k_scales, v_scales
     return logits, k_cache, v_cache
 
 
@@ -991,7 +1056,7 @@ def prefill(
 def _decode_body(
     params, cfg, tokens, positions, block_tables, seq_lens,
     k_cache, v_cache, use_pallas, mesh=None, unroll=True, interpret=False,
-    merged=True,
+    merged=True, k_scales=None, v_scales=None,
 ):
     """Shared un-jitted decode forward (one token per sequence).
 
@@ -1003,7 +1068,22 @@ def _decode_body(
     step (measured: a 2.15GB cache pair costs ~2.5GB of temp and
     dominates step time; decode is supposed to stream WEIGHTS, not
     copy the KV pool). Scan remains for compile-time-sensitive very
-    deep models (EngineConfig.decode_layer_scan)."""
+    deep models (EngineConfig.decode_layer_scan).
+
+    ``k_scales``/``v_scales`` ([L, N] f32, int8-with-scales device cache)
+    thread through every write (scale growth + page requant) and attention
+    read (fused dequant); when present the return grows to
+    (logits, k_cache, v_cache, k_scales, v_scales, n_requants)."""
+    quantized = k_scales is not None
+    if quantized:
+        if cfg.is_mla:
+            raise ValueError("int8 device KV scales: MLA is gated at "
+                             "engine init (absorbed-matmul latents)")
+        if not unroll:
+            raise ValueError("int8 device KV scales need the unrolled "
+                             "decode (decode_layer_scan cannot carry "
+                             "per-layer plane scatters in place)")
+        k_scales0, v_scales0 = k_scales, v_scales
     B = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [B, E]
     if cfg.is_mla:
@@ -1161,6 +1241,8 @@ def _decode_body(
         # only sets use_pallas when tp divides the kv heads).
         from ..ops.kv_cache_update_pallas import (
             kv_cache_append,
+            kv_cache_append_quantized,
+            kv_cache_append_quantized_sharded,
             kv_cache_append_sharded,
         )
 
@@ -1175,11 +1257,17 @@ def _decode_body(
                 )
                 k_news.append(k)
                 v_news.append(v)
+                # history pages dequantize through the step-entry scale
+                # planes — consistent: the batched append below is what
+                # mutates pages/scales, and it runs after attention
+                ks_l = k_scales[l] if quantized else None
+                vs_l = v_scales[l] if quantized else None
                 if mesh is None:
                     o = att.decode_attention_merged(
                         q, k, v, k_cache[l], v_cache[l], block_tables,
                         hist_lens, scale, window=window_for_layer(cfg, l),
                         sinks=lp.get("sinks"), interpret=interpret,
+                        k_scales=ks_l, v_scales=vs_l,
                     )
                 else:
                     o = att.decode_attention_merged_sharded(
@@ -1187,10 +1275,26 @@ def _decode_body(
                         hist_lens, scale, mesh,
                         window=window_for_layer(cfg, l),
                         sinks=lp.get("sinks"), interpret=interpret,
+                        k_scales=ks_l, v_scales=vs_l,
                     )
                 x = layer_tail(x, lp, o)
         k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)
-        if mesh is None:
+        if quantized:
+            if mesh is None:
+                k_cache, v_cache, k_scales, v_scales, _ = (
+                    kv_cache_append_quantized(
+                        k_new, v_new, k_cache, v_cache, k_scales, v_scales,
+                        blk, off, interpret=interpret,
+                    )
+                )
+            else:
+                k_cache, v_cache, k_scales, v_scales, _ = (
+                    kv_cache_append_quantized_sharded(
+                        k_new, v_new, k_cache, v_cache, k_scales, v_scales,
+                        blk, off, mesh, interpret=interpret,
+                    )
+                )
+        elif mesh is None:
             k_cache, v_cache = kv_cache_append(
                 k_new, v_new, k_cache, v_cache, blk, off,
                 interpret=interpret,
@@ -1208,19 +1312,36 @@ def _decode_body(
                 q, k, v = layer_qkv(
                     x, lp, rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec)
                 )
-                # mixed basic+advanced indexing puts the advanced axes
-                # (blk, off) in front: the update value is [B, Hkv, D]
-                k_cache = k_cache.at[l, :, blk, off].set(
-                    k.astype(k_cache.dtype)
-                )
-                v_cache = v_cache.at[l, :, blk, off].set(
-                    v.astype(v_cache.dtype)
-                )
+                ks_l = vs_l = None
+                if quantized:
+                    # write-before-attend: the row quantizes against the
+                    # (possibly grown) page scale, then attention
+                    # dequantizes through the SAME updated plane slice
+                    kc_l, ks_l = att.write_decode_token_to_cache_quantized(
+                        k_cache[l], k_scales[l], k, block_tables, positions
+                    )
+                    vc_l, vs_l = att.write_decode_token_to_cache_quantized(
+                        v_cache[l], v_scales[l], v, block_tables, positions
+                    )
+                    k_cache = k_cache.at[l].set(kc_l)
+                    v_cache = v_cache.at[l].set(vc_l)
+                    k_scales = k_scales.at[l].set(ks_l)
+                    v_scales = v_scales.at[l].set(vs_l)
+                else:
+                    # mixed basic+advanced indexing puts the advanced axes
+                    # (blk, off) in front: the update value is [B, Hkv, D]
+                    k_cache = k_cache.at[l, :, blk, off].set(
+                        k.astype(k_cache.dtype)
+                    )
+                    v_cache = v_cache.at[l, :, blk, off].set(
+                        v.astype(v_cache.dtype)
+                    )
                 o = att.decode_attention(
                     q, k_cache[l], v_cache[l], block_tables, seq_lens, scale,
                     use_pallas=use_pallas, mesh=mesh,
                     window=window_for_layer(cfg, l), sinks=lp.get("sinks"),
                     cap=cfg.attn_softcap,
+                    k_scales=ks_l, v_scales=vs_l,
                 )
                 x = layer_tail(x, lp, o)
     else:
@@ -1250,6 +1371,13 @@ def _decode_body(
         )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)  # [B, V]
+    if quantized:
+        # scales only grow within a step, so plane entries above their
+        # step-entry value count exactly the pages requantized this step
+        n_requants = (
+            jnp.sum(k_scales > k_scales0) + jnp.sum(v_scales > v_scales0)
+        ).astype(jnp.int32)
+        return logits, k_cache, v_cache, k_scales, v_scales, n_requants
     return logits, k_cache, v_cache
 
 
@@ -1272,15 +1400,20 @@ def decode_step(
     unroll: bool = True,
     interpret: bool = False,
     merged: bool = True,
+    k_scales: Optional[jnp.ndarray] = None,  # [L, N] f32, NOT donated
+    v_scales: Optional[jnp.ndarray] = None,
 ):
     """One continuous-batching decode step for all active sequences.
 
     ``merged=False`` opts out of the one-write merged path back to the
     per-layer write-then-attend kernels (escape hatch for Mosaic
-    regressions; bench.py falls back through it)."""
+    regressions; bench.py falls back through it). With scale planes the
+    return grows to (logits, k_cache, v_cache, k_scales, v_scales,
+    n_requants) — see ``_decode_body``."""
     return _decode_body(
         params, cfg, tokens, positions, block_tables, seq_lens,
         k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 
@@ -1318,6 +1451,11 @@ def decode_window(
     counts: Optional[jnp.ndarray] = None,  # [B, V] i32 output-token counts, donated
     prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
     with_logprobs: bool = False,  # also emit per-step top-k logprobs
+    # int8-with-scales device cache planes ([L, N] f32, NOT donated);
+    # they ride the scan carry, and the output grows by
+    # (k_scales, v_scales, n_requants) right after v_cache
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -1336,16 +1474,27 @@ def decode_window(
     )
 
     penalized = counts is not None
+    quantized = k_scales is not None
 
     def body(carry, _):
-        if penalized:
-            tokens, positions, seq_lens, steps, k_cache, v_cache, cnt = carry
+        tokens, positions, seq_lens, steps, k_cache, v_cache = carry[:6]
+        rest = list(carry[6:])
+        if quantized:
+            ks, vs, nreq = rest[:3]
+            del rest[:3]
+        cnt = rest[0] if penalized else None
+        if quantized:
+            logits, k_cache, v_cache, ks, vs, nr = _decode_body(
+                params, cfg, tokens, positions, block_tables, seq_lens,
+                k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+                merged, k_scales=ks, v_scales=vs,
+            )
+            nreq = nreq + nr
         else:
-            tokens, positions, seq_lens, steps, k_cache, v_cache = carry
-        logits, k_cache, v_cache = _decode_body(
-            params, cfg, tokens, positions, block_tables, seq_lens,
-            k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
-        )
+            logits, k_cache, v_cache = _decode_body(
+                params, cfg, tokens, positions, block_tables, seq_lens,
+                k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
+            )
         raw_logits = logits  # reported logprobs are the model's own dist
         if penalized:
             logits = apply_penalties(
@@ -1354,29 +1503,28 @@ def decode_window(
         keys = make_keys(seeds, steps)
         nxt = sample_tokens.__wrapped__(logits, keys, temps, top_ks, top_ps)
         ys = (nxt, *token_logprobs(raw_logits, nxt)) if with_logprobs else nxt
+        tail = (ks, vs, nreq) if quantized else ()
         if penalized:
-            cnt = bump_counts(cnt, nxt)
-            return (nxt, positions + 1, seq_lens + 1, steps + 1,
-                    k_cache, v_cache, cnt), ys
+            tail = tail + (bump_counts(cnt, nxt),)
         return (nxt, positions + 1, seq_lens + 1, steps + 1,
-                k_cache, v_cache), ys
+                k_cache, v_cache) + tail, ys
 
-    if penalized:
-        carry = (tokens, positions, seq_lens, steps, k_cache, v_cache, counts)
-        (_, _, _, _, k_cache, v_cache, counts), ys = lax.scan(
-            body, carry, None, length=n_steps
-        )
-        toks = ys[0] if with_logprobs else ys
-        lps = ys[1:] if with_logprobs else None
-        out = (toks, k_cache, v_cache, counts)
-        return out + (lps,) if with_logprobs else out
     carry = (tokens, positions, seq_lens, steps, k_cache, v_cache)
-    (_, _, _, _, k_cache, v_cache), ys = lax.scan(
-        body, carry, None, length=n_steps
-    )
+    if quantized:
+        carry = carry + (k_scales, v_scales, jnp.zeros((), jnp.int32))
+    if penalized:
+        carry = carry + (counts,)
+    fin, ys = lax.scan(body, carry, None, length=n_steps)
+    k_cache, v_cache = fin[4], fin[5]
+    rest = list(fin[6:])
     toks = ys[0] if with_logprobs else ys
     lps = ys[1:] if with_logprobs else None
     out = (toks, k_cache, v_cache)
+    if quantized:
+        out = out + tuple(rest[:3])  # (k_scales, v_scales, n_requants)
+        del rest[:3]
+    if penalized:
+        out = out + (rest[0],)
     return out + (lps,) if with_logprobs else out
 
 
@@ -1386,7 +1534,7 @@ def decode_window(
 def _mixed_fused_forward(
     params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
     p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
-    mesh=None, interpret=False,
+    mesh=None, interpret=False, k_scales=None, v_scales=None,
 ):
     """The FULLY-fused mixed forward (TPU/Pallas path): embeddings and
     every projection/FFN/logits GEMM run over the combined [B + MP*T]
@@ -1451,20 +1599,40 @@ def _mixed_fused_forward(
             # prefill sequence is in the decode batch and segments are
             # distinct sequences; padded/dead segment rows land in
             # reserved trash page 0 through their zero table entries)
-            kc_l = att.write_decode_token_to_cache(
-                kc_l, k[:B], d_tables, d_positions
-            )
-            vc_l = att.write_decode_token_to_cache(
-                vc_l, v[:B], d_tables, d_positions
-            )
-            for m in range(MP):
-                sl = slice(B + m * T, B + (m + 1) * T)
-                kc_l = att.write_chunk_to_cache(
-                    kc_l, k[sl], p_tables[m], p_hists[m]
+            ks_l = vs_l = None
+            if k_scales is not None:
+                ks_l, vs_l = k_scales[l], v_scales[l]
+                kc_l, ks_l = att.write_decode_token_to_cache_quantized(
+                    kc_l, ks_l, k[:B], d_tables, d_positions
                 )
-                vc_l = att.write_chunk_to_cache(
-                    vc_l, v[sl], p_tables[m], p_hists[m]
+                vc_l, vs_l = att.write_decode_token_to_cache_quantized(
+                    vc_l, vs_l, v[:B], d_tables, d_positions
                 )
+                for m in range(MP):
+                    sl = slice(B + m * T, B + (m + 1) * T)
+                    kc_l, ks_l = att.write_chunk_to_cache_quantized(
+                        kc_l, ks_l, k[sl], p_tables[m], p_hists[m],
+                        p_valids[m],
+                    )
+                    vc_l, vs_l = att.write_chunk_to_cache_quantized(
+                        vc_l, vs_l, v[sl], p_tables[m], p_hists[m],
+                        p_valids[m],
+                    )
+            else:
+                kc_l = att.write_decode_token_to_cache(
+                    kc_l, k[:B], d_tables, d_positions
+                )
+                vc_l = att.write_decode_token_to_cache(
+                    vc_l, v[:B], d_tables, d_positions
+                )
+                for m in range(MP):
+                    sl = slice(B + m * T, B + (m + 1) * T)
+                    kc_l = att.write_chunk_to_cache(
+                        kc_l, k[sl], p_tables[m], p_hists[m]
+                    )
+                    vc_l = att.write_chunk_to_cache(
+                        vc_l, v[sl], p_tables[m], p_hists[m]
+                    )
             Hq, Dh = q.shape[1], q.shape[2]
             q_chunks = q[B:].reshape(MP, T, Hq, Dh)
             if mesh is not None:
@@ -1472,15 +1640,20 @@ def _mixed_fused_forward(
                     q[:B], q_chunks, kc_l, vc_l, d_tables, d_seq_lens,
                     p_tables, p_hists, p_valids, scale, mesh, window=w,
                     sinks=lp.get("sinks"), interpret=interpret,
+                    k_scales=ks_l, v_scales=vs_l,
                 )
             else:
                 o_dec, o_chunks = ragged_mixed_attention(
                     q[:B], q_chunks, kc_l, vc_l, d_tables, d_seq_lens,
                     p_tables, p_hists, p_valids, scale, window=w,
                     sinks=lp.get("sinks"), interpret=interpret,
+                    k_scales=ks_l, v_scales=vs_l,
                 )
             k_cache = k_cache.at[l].set(kc_l)
             v_cache = v_cache.at[l].set(vc_l)
+            if k_scales is not None:
+                k_scales = k_scales.at[l].set(ks_l)
+                v_scales = v_scales.at[l].set(vs_l)
             o = jnp.concatenate(
                 [o_dec.reshape(B, -1), o_chunks.reshape(MP * T, -1)]
             )
@@ -1491,6 +1664,8 @@ def _mixed_fused_forward(
     # the same single row — full [T, V] head matmuls would be pure waste)
     last = B + jnp.arange(MP) * T + jnp.clip(p_valids - 1, 0, T - 1)
     p_logits = _logits(params, cfg, x[last])  # [MP, V] f32
+    if k_scales is not None:
+        return logits_d, p_logits, k_cache, v_cache, k_scales, v_scales
     return logits_d, p_logits, k_cache, v_cache
 
 
@@ -1534,6 +1709,10 @@ def mixed_step(
     counts: Optional[jnp.ndarray] = None,  # [B, V] i32, donated
     prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool
     with_logprobs: bool = False,
+    # int8-with-scales device cache planes ([L, N] f32, NOT donated);
+    # output grows by (k_scales, v_scales, n_requants) after v_cache
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
 ):
     """ONE device dispatch fusing M prefill chunks into a decode step.
 
@@ -1584,29 +1763,62 @@ def mixed_step(
     )
 
     MP = p_tokens.shape[0]
+    quantized = k_scales is not None
+    if quantized:
+        # scales only grow within a step — plane entries above their
+        # step-entry value count the pages requantized this dispatch
+        k_scales0, v_scales0 = k_scales, v_scales
     if use_pallas and not cfg.is_mla and not cfg.attn_softcap:
-        logits_d, p_logits, k_cache, v_cache = _mixed_fused_forward(
-            params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
-            p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
-            mesh=mesh, interpret=interpret,
-        )
+        if quantized:
+            logits_d, p_logits, k_cache, v_cache, k_scales, v_scales = (
+                _mixed_fused_forward(
+                    params, cfg, d_tokens, d_positions, d_tables,
+                    d_seq_lens, p_tokens, p_tables, p_hists, p_valids,
+                    k_cache, v_cache, mesh=mesh, interpret=interpret,
+                    k_scales=k_scales, v_scales=v_scales,
+                )
+            )
+        else:
+            logits_d, p_logits, k_cache, v_cache = _mixed_fused_forward(
+                params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+                p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
+                mesh=mesh, interpret=interpret,
+            )
     else:
         # chunks first (admission order), then decode — order is
         # numerically irrelevant (independent parts) and matches the
         # admission-then-decode order of the alternating scheduler
         p_logit_rows = []
         for m in range(MP):
-            lg, k_cache, v_cache = prefill.__wrapped__(
-                params, cfg, p_tokens[m], p_tables[m], p_hists[m],
-                p_valids[m], k_cache, v_cache, use_pallas=use_pallas,
-                mesh=mesh,
-            )
+            if quantized:
+                lg, k_cache, v_cache, k_scales, v_scales = (
+                    prefill.__wrapped__(
+                        params, cfg, p_tokens[m], p_tables[m], p_hists[m],
+                        p_valids[m], k_cache, v_cache,
+                        use_pallas=use_pallas, mesh=mesh,
+                        k_scales=k_scales, v_scales=v_scales,
+                    )
+                )
+            else:
+                lg, k_cache, v_cache = prefill.__wrapped__(
+                    params, cfg, p_tokens[m], p_tables[m], p_hists[m],
+                    p_valids[m], k_cache, v_cache, use_pallas=use_pallas,
+                    mesh=mesh,
+                )
             p_logit_rows.append(lg)
         p_logits = jnp.stack(p_logit_rows)  # [MP, V]
-        logits_d, k_cache, v_cache = _decode_body(
-            params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
-            k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
-        )
+        if quantized:
+            logits_d, k_cache, v_cache, k_scales, v_scales, _ = _decode_body(
+                params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+                k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+                merged, k_scales=k_scales, v_scales=v_scales,
+            )
+        else:
+            logits_d, k_cache, v_cache = _decode_body(
+                params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
+                k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+                merged,
+            )
 
     raw_logits = logits_d
     penalized = counts is not None
@@ -1617,6 +1829,11 @@ def mixed_step(
     keys = make_keys(seeds, steps)
     nxt = sample_tokens.__wrapped__(logits_d, keys, temps, top_ks, top_ps)
     result = [nxt, p_logits, k_cache, v_cache]
+    if quantized:
+        n_requants = (
+            jnp.sum(k_scales > k_scales0) + jnp.sum(v_scales > v_scales0)
+        ).astype(jnp.int32)
+        result += [k_scales, v_scales, n_requants]
     if penalized:
         result.append(bump_counts(counts, nxt))
     if with_logprobs:

@@ -33,8 +33,16 @@ import jax.numpy as jnp
 
 from .config import ModelConfig
 
-WEIGHT_MODES = ("none", "int8", "fp8_e4m3")
-KV_CACHE_DTYPES = ("model", "float8_e4m3", "bfloat16")
+WEIGHT_MODES = ("none", "int8", "fp8_e4m3", "int8_native")
+KV_CACHE_DTYPES = ("model", "float8_e4m3", "bfloat16", "int8")
+
+#: floor for every KV scale plane entry — a freshly-reset page's scale.
+#: Matches engine/kvquant.py's codec epsilon so a device-plane scale is
+#: always a valid tier-codec scale (zero re-encode on d2h export).
+KV_SCALE_EPS = 1e-12
+#: int8 symmetric range used by the device KV planes (same as the tier
+#: codec's int8 qmax — one number across every plane)
+KV_INT8_QMAX = 127.0
 
 # the stacked-layer projection matrices worth quantizing ([L, in, out]
 # layout, contraction on axis -2); embeddings/norms/biases/router stay
@@ -50,7 +58,7 @@ _EXPERT_QUANT_KEYS = ("we_gate", "we_up", "we_down")
 
 
 def _qdtype(mode: str):
-    if mode == "int8":
+    if mode in ("int8", "int8_native"):
         return jnp.int8, 127.0
     if mode == "fp8_e4m3":
         return jnp.float8_e4m3fn, 448.0
@@ -65,13 +73,20 @@ def quantize_array(w: jnp.ndarray, mode: str) -> dict:
     absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.maximum(absmax / qmax, 1e-12)
     q = wf / scale
-    if mode == "int8":
+    if mode in ("int8", "int8_native"):
         q = jnp.clip(jnp.round(q), -127, 127)
-    return {"q": q.astype(dt), "s": scale.squeeze(-2).astype(jnp.float32)}
+    # "int8_native" stores the SAME numbers under the "qn" key: the
+    # distinct pytree key routes llama._mm onto the native int8
+    # dot_general path (int8 x int8 -> f32-accumulated) instead of the
+    # dequant-at-operand-read path, and the structural difference keeps
+    # the two modes' jit programs from colliding in the compile cache.
+    qkey = "qn" if mode == "int8_native" else "q"
+    return {qkey: q.astype(dt), "s": scale.squeeze(-2).astype(jnp.float32)}
 
 
 def dequantize_array(qw: dict) -> jnp.ndarray:
-    return qw["q"].astype(jnp.float32) * qw["s"][..., None, :]
+    q = qw["qn"] if "qn" in qw else qw["q"]
+    return q.astype(jnp.float32) * qw["s"][..., None, :]
 
 
 def quantize_params(params: dict, cfg: ModelConfig, mode: str,
@@ -93,7 +108,12 @@ def quantize_params(params: dict, cfg: ModelConfig, mode: str,
         layers = dict(params[grp])
         for key in keys:
             if key in layers and not isinstance(layers[key], dict):
-                layers[key] = quantize_array(layers[key], mode)  # idempotent
+                # expert stacks are consumed by the grouped-dequant
+                # Pallas kernel, which wants the "q" form — the native
+                # int8 dot path only covers the dense projections
+                kmode = ("int8" if mode == "int8_native"
+                         and key in _EXPERT_QUANT_KEYS else mode)
+                layers[key] = quantize_array(layers[key], kmode)  # idempotent
         out[grp] = layers
     return out
 
@@ -107,4 +127,9 @@ def kv_cache_dtype(cfg: ModelConfig, name: str):
         return jnp.float8_e4m3fn
     if name == "bfloat16":
         return jnp.bfloat16
+    if name == "int8":
+        # int8-with-scales DEVICE cache: the engine allocates per-page
+        # f32 scale planes alongside the paged k/v caches and threads
+        # them through every write/read dispatch (engine/engine.py)
+        return jnp.int8
     raise ValueError(f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}")

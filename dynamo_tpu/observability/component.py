@@ -252,6 +252,21 @@ class MetricsComponent:
                 "kv_quant_logprob_drift_max",
                 round(w.kv_quant_logprob_drift_max, 6), lb,
             )
+            # int8-with-scales DEVICE cache lane (docs/kv_offload.md
+            # device tier): resident quantized pages, append-driven page
+            # requantizations, HBM bytes saved vs full width, exports
+            # forced off the device codec (0 with a matching int8 tier),
+            # and the lane's measured decode throughput
+            gauge("kv_device_quant_pages", w.kv_device_quant_pages, lb)
+            gauge("kv_device_requants_total", w.kv_device_requants, lb)
+            gauge(
+                "kv_device_bytes_saved_total", w.kv_device_bytes_saved, lb
+            )
+            gauge(
+                "kv_device_export_requant_total",
+                w.kv_device_export_requants, lb,
+            )
+            gauge("lowprec_tok_s", round(w.lowprec_tok_s, 3), lb)
             # resilience plane: draining state + handoff/resume volume
             # (resilience subsystem; docs/resilience.md)
             gauge("draining", w.draining, lb)

@@ -85,6 +85,8 @@ def decode_attention(
     sinks=None,  # [H] gpt-oss sink logits; stats-fold on the kernel path
     cap: float = 0.0,  # gemma-2 softcap: forces the XLA path
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:
     """Dispatcher: Pallas ragged kernel on TPU, XLA fallback elsewhere.
     ``window`` (sliding attention) is honored by every path: the XLA
@@ -98,25 +100,33 @@ def decode_attention(
     attention is head-parallel, so no collectives are needed. Callers
     guarantee num_kv_heads % tp == 0 (the engine falls back to XLA
     otherwise, where GSPMD handles uneven head splits).
+
+    ``k_scales``/``v_scales`` (per-page f32, this layer's [N] slice of
+    the engine's scale planes) ride every path: fused per-page dequant
+    in the kernels, gathered-scale multiply in the XLA fallback.
     """
     if use_pallas and mesh is not None and not cap:
         return paged_decode_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             mesh, window=window, sinks=sinks, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )
     if use_pallas and sinks is None and not cap:
         return _decode_kernel(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             window=window, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )
     if use_pallas and not cap:
         return _decode_kernel_with_sinks(
             q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
             sinks, window=window, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )
     return decode_attention_xla(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
         window=window, sinks=sinks, cap=cap,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 
@@ -124,20 +134,24 @@ def _decode_kernel(
     q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
     window: int = 0,
     interpret: bool = False,
+    k_scales=None, v_scales=None,
 ):
     """TPU decode kernel selection: prefer jax's tuned paged-attention
     Mosaic kernel (the platform library's — serving it is the exact
     analogue of the reference invoking vLLM's paged_attention CUDA
     kernel), falling back to the in-repo kernel when the library can't
     take the shape. Interpret mode (CPU tests) always runs the in-repo
-    kernel — it's the one whose source we control line-by-line.
+    kernel — it's the one whose source we control line-by-line. Per-page
+    scales (int8 device cache) also force the in-repo kernel — the
+    library kernel has no scale inputs.
 
     Measured single-chip (B=16, 8K ctx, bf16): library 76us, in-repo
     103us, XLA gather path 114us — and the gap widens with context.
     """
     from .paged_attention_pallas import paged_decode_attention
 
-    if not interpret and window == 0:  # the library kernel has no window
+    if not interpret and window == 0 and k_scales is None:
+        # the library kernel has neither window nor scale support
         try:
             from jax.experimental.pallas.ops.tpu.paged_attention import (
                 paged_attention,
@@ -155,12 +169,14 @@ def _decode_kernel(
     return paged_decode_attention(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
         window=window, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 
 def _decode_kernel_with_sinks(
     q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
     sinks, window: int = 0, interpret: bool = False,
+    k_scales=None, v_scales=None,
 ):
     """Pallas decode attention for gpt-oss sink models: the in-repo
     stats-emitting kernel scores the cache, then the sink logit joins
@@ -176,6 +192,7 @@ def _decode_kernel_with_sinks(
     o, m, l = paged_decode_attention(
         q, k_cache_layer, v_cache_layer, block_tables, seq_lens, scale,
         return_stats=True, window=window, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales,
     )
     s = sinks.astype(jnp.float32).reshape(1, Hkv, G)
     m_f = jnp.maximum(m, s)
@@ -196,26 +213,42 @@ def paged_decode_attention_sharded(
     window: int = 0,
     sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page, replicated (page axis is unsharded)
+    v_scales=None,
 ) -> jnp.ndarray:
     """Pallas decode kernel under shard_map over tp (see _shard_tp).
     Head-parallel — the sink fold included (it's a per-head rescale), so
-    the same library-vs-in-repo selection applies per device shard."""
+    the same library-vs-in-repo selection applies per device shard.
+    Per-page scales replicate like the block tables (pages aren't the
+    sharded axis; every shard reads the same plane)."""
 
-    def _local(q, kc, vc, bt, sl, s=None):
+    def _local(q, kc, vc, bt, sl, *rest):
+        rest = list(rest)
+        ks = vs = s = None
+        if k_scales is not None:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        if rest:
+            s = rest[0]
         if s is None:
             return _decode_kernel(
-                q, kc, vc, bt, sl, scale, window=window, interpret=interpret
+                q, kc, vc, bt, sl, scale, window=window, interpret=interpret,
+                k_scales=ks, v_scales=vs,
             )
         return _decode_kernel_with_sinks(
             q, kc, vc, bt, sl, scale, s, window=window, interpret=interpret,
+            k_scales=ks, v_scales=vs,
         )
 
+    scalars = (block_tables, seq_lens)
+    if k_scales is not None:
+        scalars += (k_scales, v_scales)
     return _shard_tp(
         mesh, _local,
         arr_specs=(P(None, "tp", None),),  # q: heads sharded
         arrs=(q,),
         k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
-        scalars=(block_tables, seq_lens), sinks=sinks,
+        scalars=scalars, sinks=sinks,
         out_spec=P(None, "tp", None),
     )
 
@@ -232,6 +265,8 @@ def decode_attention_merged(
     window: int = 0,
     sinks=None,  # [H] gpt-oss sink logits; joins the merge denominator
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:  # [B, H, D]
     """Decode attention with the current token handled OUT of the cache.
 
@@ -257,6 +292,7 @@ def decode_attention_merged(
         q[:, None], k_new[:, None], v_new[:, None], k_cache_layer,
         v_cache_layer, block_tables, hist_lens, scale, use_pallas=True,
         window=window, sinks=sinks, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales,
     )[:, 0]
 
 
@@ -273,6 +309,8 @@ def decode_attention_merged_sharded(
     window: int = 0,
     sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page, replicated
+    v_scales=None,
 ) -> jnp.ndarray:
     """Merged decode attention under shard_map over ``tp``.
 
@@ -282,12 +320,22 @@ def decode_attention_merged_sharded(
     tiles with no collectives (same head-parallel argument as
     _shard_tp)."""
 
-    def _local(q, k_new, v_new, kc, vc, bt, hl, s=None):
+    def _local(q, k_new, v_new, kc, vc, bt, hl, *rest):
+        rest = list(rest)
+        ks = vs = s = None
+        if k_scales is not None:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        if rest:
+            s = rest[0]
         return decode_attention_merged(
             q, k_new, v_new, kc, vc, bt, hl, scale, window=window,
-            sinks=s, interpret=interpret,
+            sinks=s, interpret=interpret, k_scales=ks, v_scales=vs,
         )
 
+    scalars = (block_tables, hist_lens)
+    if k_scales is not None:
+        scalars += (k_scales, v_scales)
     return _shard_tp(
         mesh, _local,
         arr_specs=(
@@ -297,7 +345,7 @@ def decode_attention_merged_sharded(
         ),
         arrs=(q, k_new, v_new),
         k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
-        scalars=(block_tables, hist_lens), sinks=sinks,
+        scalars=scalars, sinks=sinks,
         out_spec=P(None, "tp", None),
     )
 
@@ -316,6 +364,8 @@ def verify_attention(
     sinks=None,  # [H] gpt-oss sink logits; joins the merge denominator
     cap: float = 0.0,  # gemma-2 softcap (XLA path only; callers gate)
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:  # [B, T, H, D]
     """Multi-token decode attention (speculative-decoding verify): T
     in-flight tokens per sequence attend cached history plus the causal
@@ -349,6 +399,7 @@ def verify_attention(
             qp, k_cache_layer, v_cache_layer, block_tables, hist_lens,
             scale, return_stats=True, window=window, q_pos_offset=1,
             group=G, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )  # o: [B, Hkv*T*G, D]; m, l: [B, Hkv, T*G]
         o_h = o_h.reshape(B, Hkv, T, G, D).astype(jnp.float32)
         m_h = m_h.reshape(B, Hkv, T, G)
@@ -356,7 +407,7 @@ def verify_attention(
     else:
         o_h, m_h, l_h = _history_attention_xla(
             q, k_cache_layer, v_cache_layer, block_tables, hist_lens, scale,
-            window=window, cap=cap,
+            window=window, cap=cap, k_scales=k_scales, v_scales=v_scales,
         )
     # intra-window causal scores [B, Hkv, T, G, T']
     qg = q.reshape(B, T, Hkv, G, D)
@@ -402,6 +453,8 @@ def verify_attention_sharded(
     window: int = 0,
     sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page, replicated
+    v_scales=None,
 ) -> jnp.ndarray:
     """verify_attention under shard_map over ``tp``: the paged-kernel
     history pass, the dense intra-window part, the flash merge, and the
@@ -409,13 +462,23 @@ def verify_attention_sharded(
     shard on local tiles, no collectives (same argument as
     decode_attention_merged)."""
 
-    def _local(q, k_win, v_win, kc, vc, bt, hl, s=None):
+    def _local(q, k_win, v_win, kc, vc, bt, hl, *rest):
+        rest = list(rest)
+        ks = vs = s = None
+        if k_scales is not None:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        if rest:
+            s = rest[0]
         return verify_attention(
             q, k_win, v_win, kc, vc, bt, hl, scale,
             use_pallas=use_pallas, window=window, sinks=s,
-            interpret=interpret,
+            interpret=interpret, k_scales=ks, v_scales=vs,
         )
 
+    scalars = (block_tables, hist_lens)
+    if k_scales is not None:
+        scalars += (k_scales, v_scales)
     return _shard_tp(
         mesh, _local,
         arr_specs=(
@@ -425,7 +488,7 @@ def verify_attention_sharded(
         ),
         arrs=(q, k_win, v_win),
         k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
-        scalars=(block_tables, hist_lens), sinks=sinks,
+        scalars=scalars, sinks=sinks,
         out_spec=P(None, None, "tp", None),
     )
 
@@ -439,6 +502,8 @@ def _history_attention_xla(
     scale: float,
     window: int = 0,
     cap: float = 0.0,  # gemma-2 softcap; 0 = off
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ):
     """XLA twin of the stats-emitting kernel path: history-only attention
     with raw softmax stats (o normalized, m row max, l normalizer) in the
@@ -449,6 +514,11 @@ def _history_attention_xla(
     G = H // Hkv
     k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
     v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
+    if k_scales is not None:  # per-page dequant, gathered like the pages
+        ks = jnp.repeat(k_scales[block_tables], bs, axis=1)  # [B, M*bs]
+        vs = jnp.repeat(v_scales[block_tables], bs, axis=1)
+        k = k.astype(jnp.float32) * ks[None, :, :, None]
+        v = v.astype(jnp.float32) * vs[None, :, :, None]
     qg = q.reshape(B, T, Hkv, G, D)
     s = softcap(jnp.einsum(
         "btkgd,kbsd->bktgs", qg.astype(jnp.float32) * scale,
@@ -510,6 +580,8 @@ def decode_attention_xla(
     window: int = 0,  # sliding window width; 0 = full attention
     sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
     cap: float = 0.0,  # gemma-2 attention-score softcap; 0 = off
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     M = block_tables.shape[1]
@@ -521,7 +593,12 @@ def decode_attention_xla(
     # the gather read, so HBM traffic stays at the narrow dtype's bytes.
     k = jnp.take(k_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
     v = jnp.take(v_cache_layer, block_tables, axis=1).reshape(Hkv, B, M * bs, D)
-    if k.dtype != q.dtype:
+    if k_scales is not None:  # int8-with-scales: per-page dequant on read
+        ks = jnp.repeat(k_scales[block_tables], bs, axis=1)  # [B, M*bs]
+        vs = jnp.repeat(v_scales[block_tables], bs, axis=1)
+        k = (k.astype(jnp.float32) * ks[None, :, :, None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[None, :, :, None]).astype(q.dtype)
+    elif k.dtype != q.dtype:
         k, v = k.astype(q.dtype), v.astype(q.dtype)
     qg = q.reshape(B, Hkv, G, D)
     scores = softcap(
@@ -596,6 +673,8 @@ def chunk_attention_with_cache(
     sinks=None,  # [H] gpt-oss sink logits; in-kernel fold on the pallas path
     cap: float = 0.0,  # gemma-2 softcap: forces the XLA path
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:
     """Prefill dispatcher: Pallas flash kernel on TPU, XLA gather fallback.
     ``window`` (sliding attention) is honored by both paths (the Pallas
@@ -613,6 +692,7 @@ def chunk_attention_with_cache(
         return paged_prefill_attention_sharded(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
             mesh, window=window, sinks=sinks, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )
     if use_pallas and not cap:
         from .paged_attention_pallas import paged_prefill_attention
@@ -620,10 +700,12 @@ def chunk_attention_with_cache(
         return paged_prefill_attention(
             q, k_cache_layer, v_cache_layer, block_table, history_len, scale,
             window=window, sinks=sinks, interpret=interpret,
+            k_scales=k_scales, v_scales=v_scales,
         )
     return chunk_attention_with_cache_xla(
         q, k_chunk, v_chunk, k_cache_layer, v_cache_layer, block_table,
         history_len, valid_len, scale, window=window, sinks=sinks, cap=cap,
+        k_scales=k_scales, v_scales=v_scales,
     )
 
 
@@ -638,23 +720,35 @@ def paged_prefill_attention_sharded(
     window: int = 0,
     sinks=None,  # [H], sharded over tp with the heads
     interpret: bool = False,
+    k_scales=None,  # [N] f32 per-page, replicated
+    v_scales=None,
 ) -> jnp.ndarray:
     """Pallas prefill kernel under shard_map over tp (see _shard_tp;
     the in-kernel sink fold is per-head, so it shards with the heads)."""
     from .paged_attention_pallas import paged_prefill_attention
 
-    def _local(q, kc, vc, bt, hist, s=None):
+    def _local(q, kc, vc, bt, hist, *rest):
+        rest = list(rest)
+        ks = vs = s = None
+        if k_scales is not None:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        if rest:
+            s = rest[0]
         return paged_prefill_attention(
             q, kc, vc, bt, hist, scale, window=window, sinks=s,
-            interpret=interpret,
+            interpret=interpret, k_scales=ks, v_scales=vs,
         )
 
+    scalars = (block_table, history_len)
+    if k_scales is not None:
+        scalars += (k_scales, v_scales)
     return _shard_tp(
         mesh, _local,
         arr_specs=(P(None, "tp", None),),  # q: heads sharded
         arrs=(q,),
         k_cache_layer=k_cache_layer, v_cache_layer=v_cache_layer,
-        scalars=(block_table, history_len), sinks=sinks,
+        scalars=scalars, sinks=sinks,
         out_spec=P(None, "tp", None),
     )
 
@@ -672,6 +766,8 @@ def chunk_attention_with_cache_xla(
     window: int = 0,  # sliding window width; 0 = full attention
     sinks=None,  # [H] per-head sink logits (gpt-oss); None = off
     cap: float = 0.0,  # gemma-2 attention-score softcap; 0 = off
+    k_scales=None,  # [N] f32 per-page scales (int8-with-scales cache)
+    v_scales=None,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: queries attend to cached history plus the
     causal prefix of the current chunk (enables chunked prefill and
@@ -682,7 +778,16 @@ def chunk_attention_with_cache_xla(
     G = H // Hkv
     k_hist = jnp.take(k_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
     v_hist = jnp.take(v_cache_layer, block_table, axis=1).reshape(Hkv, M * bs, D)
-    if k_hist.dtype != k_chunk.dtype:  # quantized cache: cast on read
+    if k_scales is not None:  # int8-with-scales: per-page dequant on read
+        ks = jnp.repeat(k_scales[block_table], bs)  # [M*bs]
+        vs = jnp.repeat(v_scales[block_table], bs)
+        k_hist = (k_hist.astype(jnp.float32) * ks[None, :, None]).astype(
+            k_chunk.dtype
+        )
+        v_hist = (v_hist.astype(jnp.float32) * vs[None, :, None]).astype(
+            v_chunk.dtype
+        )
+    elif k_hist.dtype != k_chunk.dtype:  # quantized cache: cast on read
         k_hist = k_hist.astype(k_chunk.dtype)
         v_hist = v_hist.astype(v_chunk.dtype)
     k_all = jnp.concatenate([k_hist, k_chunk.swapaxes(0, 1)], axis=1)  # [Hkv, S, D]
@@ -731,6 +836,50 @@ def write_chunk_to_cache(
     )
 
 
+def write_chunk_to_cache_quantized(
+    cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D] int8
+    scales: jnp.ndarray,  # [N] f32 this layer's per-page scale plane
+    chunk: jnp.ndarray,  # [T, Hkv, D] full-precision K or V rows
+    block_table: jnp.ndarray,  # [M]
+    start_pos: jnp.ndarray,  # scalar: first absolute position of the chunk
+    valid_len: jnp.ndarray,  # scalar: real (unpadded) tokens in the chunk
+    qmax: float = 127.0,
+    eps: float = 1e-12,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """write_chunk_to_cache for the int8-with-scales device cache.
+
+    Grows each written page's running absmax scale (scatter-max over the
+    chunk's per-row absmax), requantizes resident page content by the
+    old/new ratio, then lands the rows quantized against the NEW scales.
+    Padded tail rows are zeroed first so they can neither inflate a real
+    page's scale nor write garbage into its tail slots (they land as
+    exact zeros — never read, and harmless if overwritten later).
+    Returns ``(cache_layer, scales)``."""
+    T = chunk.shape[0]
+    bs = cache_layer.shape[2]
+    pos = start_pos + jnp.arange(T)
+    blk = block_table[pos // bs]
+    off = pos % bs
+    real = jnp.arange(T) < valid_len
+    cf = chunk.astype(jnp.float32) * real[:, None, None]
+    row_amax = jnp.max(jnp.abs(cf), axis=(1, 2)) / qmax  # [T]
+    new_scales = scales.at[blk].max(jnp.maximum(row_amax, eps))
+    # requantize touched pages (duplicate pages — bs consecutive rows
+    # share one — carry identical ratios and content: deterministic)
+    r = (scales / new_scales)[blk]  # [T], <= 1; == 1 round-trips exactly
+    pages = cache_layer[:, blk].astype(jnp.float32) * r[None, :, None, None]
+    cache_layer = cache_layer.at[:, blk].set(
+        jnp.clip(jnp.round(pages), -qmax, qmax).astype(cache_layer.dtype)
+    )
+    qrows = jnp.clip(
+        jnp.round(cf / new_scales[blk][:, None, None]), -qmax, qmax
+    )
+    cache_layer = cache_layer.at[:, blk, off].set(
+        qrows.swapaxes(0, 1).astype(cache_layer.dtype)
+    )
+    return cache_layer, new_scales
+
+
 def decode_slot_indices(
     block_tables: jnp.ndarray,  # [B, M]
     positions: jnp.ndarray,  # [B]
@@ -755,3 +904,38 @@ def write_decode_token_to_cache(
     return cache_layer.at[:, blk, off].set(
         token_kv.swapaxes(0, 1).astype(cache_layer.dtype)
     )
+
+
+def write_decode_token_to_cache_quantized(
+    cache_layer: jnp.ndarray,  # [Hkv, num_blocks, bs, D] int8
+    scales: jnp.ndarray,  # [N] f32 this layer's per-page scale plane
+    token_kv: jnp.ndarray,  # [B, Hkv, D] full-precision rows
+    block_tables: jnp.ndarray,  # [B, M]
+    positions: jnp.ndarray,  # [B] absolute position of the new token
+    qmax: float = 127.0,
+    eps: float = 1e-12,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """write_decode_token_to_cache for the int8-with-scales cache: same
+    scale-growth + page-requant + quantized-row-write contract as
+    write_chunk_to_cache_quantized, one row per sequence. Padded batch
+    rows target the trash page 0 — its scale may grow and its content is
+    garbage, both harmless (page 0 is never read). Returns
+    ``(cache_layer, scales)``."""
+    blk, off = decode_slot_indices(
+        block_tables, positions, cache_layer.shape[2]
+    )
+    xf = token_kv.astype(jnp.float32)  # [B, Hkv, D]
+    amax = jnp.max(jnp.abs(xf), axis=(1, 2)) / qmax  # [B]
+    new_scales = scales.at[blk].max(jnp.maximum(amax, eps))
+    r = (scales / new_scales)[blk]  # [B]
+    pages = cache_layer[:, blk].astype(jnp.float32) * r[None, :, None, None]
+    cache_layer = cache_layer.at[:, blk].set(
+        jnp.clip(jnp.round(pages), -qmax, qmax).astype(cache_layer.dtype)
+    )
+    qrows = jnp.clip(
+        jnp.round(xf / new_scales[blk][:, None, None]), -qmax, qmax
+    )
+    cache_layer = cache_layer.at[:, blk, off].set(
+        qrows.swapaxes(0, 1).astype(cache_layer.dtype)
+    )
+    return cache_layer, new_scales

@@ -146,6 +146,205 @@ def kv_cache_append_replicated(
     )(k_new, v_new, k_cache, v_cache, blk, off)
 
 
+def _append_quant_kernel(
+    # scalar prefetch
+    blk_ref,  # [B] int32 physical page per sequence (SMEM)
+    off_ref,  # [B] int32 row within the page (SMEM)
+    rk_ref,  # [L, B] f32 old/new k-scale ratio (<= 1) for the page
+    rv_ref,  # [L, B] f32 old/new v-scale ratio
+    # inputs
+    kq_ref,  # [1, 1, Hkv, D] layer l, sequence b — PRE-quantized int8 row
+    vq_ref,  # [1, 1, Hkv, D]
+    k_page_ref,  # [1, Hkv, 1, bs, D] aliased page tile of k_cache
+    v_page_ref,  # [1, Hkv, 1, bs, D]
+    # outputs (aliased)
+    k_out_ref,
+    v_out_ref,
+):
+    l = pl.program_id(0)
+    b = pl.program_id(1)
+    off = off_ref[b]
+    # requantize the page against its grown scale (r == 1 when the scale
+    # did not grow: int8 -> f32 -> round -> int8 round-trips bit-exactly)
+    rk = rk_ref[l, b]
+    rv = rv_ref[l, b]
+    kp = k_page_ref[...].astype(jnp.float32) * rk
+    vp = v_page_ref[...].astype(jnp.float32) * rv
+    k_out_ref[...] = jnp.clip(jnp.round(kp), -127.0, 127.0).astype(
+        k_out_ref.dtype
+    )
+    v_out_ref[...] = jnp.clip(jnp.round(vp), -127.0, 127.0).astype(
+        v_out_ref.dtype
+    )
+    # then land the new row, already quantized against the new scale
+    k_out_ref[0, :, 0, pl.ds(off, 1), :] = kq_ref[0, 0][:, None, :]
+    v_out_ref[0, :, 0, pl.ds(off, 1), :] = vq_ref[0, 0][:, None, :]
+
+
+def quant_scale_update(x_new, scales, blk, qmax=127.0, eps=1e-12):
+    """Scale-plane update for one appended row per sequence.
+
+    ``x_new`` [L, B, Hkv, D] new rows; ``scales`` [L, N] per-page f32;
+    ``blk`` [B] target page per sequence. Returns ``(new_scales, r, q)``:
+    the grown plane (running absmax/qmax per page, scatter-max so
+    duplicate pages — the trash page 0 — resolve deterministically), the
+    old/new ratio per (layer, row) for requantizing resident page
+    content, and the rows quantized against the NEW scale."""
+    xf = x_new.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(2, 3)) / qmax  # [L, B]
+    new_scales = scales.at[:, blk].max(jnp.maximum(amax, eps))
+    r = (scales / new_scales)[:, blk]  # [L, B], <= 1
+    q = jnp.clip(
+        jnp.round(xf / new_scales[:, blk][:, :, None, None]), -qmax, qmax
+    )
+    return new_scales, r, q.astype(jnp.int8)
+
+
+def _append_quant_call(kq, vq, k_cache, v_cache, rk, rv, blk, off,
+                       interpret=False):
+    """Page RMW for the quantized append: requantize the target page by
+    its old/new scale ratio, then write the pre-quantized int8 row. The
+    scale math happens OUTSIDE (quant_scale_update) so the sharded path
+    sees a globally-consistent plane (a per-shard absmax over the local
+    kv-head slice would diverge across devices)."""
+    L, B, Hkv, Dk = kq.shape
+    Dv = vq.shape[-1]
+    bs = k_cache.shape[3]
+    if interpret:
+        lidx2 = jnp.arange(L)[:, None]
+        bidx = jnp.arange(B)[None, :]
+        # requantize the touched pages (duplicate pages carry identical
+        # ratios and identical gathered content -> deterministic scatter)
+        kp = k_cache[lidx2, :, blk[None, :]].astype(jnp.float32)
+        vp = v_cache[lidx2, :, blk[None, :]].astype(jnp.float32)
+        kp = kp * rk[:, :, None, None, None]
+        vp = vp * rv[:, :, None, None, None]
+        k_cache = k_cache.at[lidx2, :, blk[None, :]].set(
+            jnp.clip(jnp.round(kp), -127, 127).astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[lidx2, :, blk[None, :]].set(
+            jnp.clip(jnp.round(vp), -127, 127).astype(v_cache.dtype)
+        )
+        # then the new rows, quantized against the new scales
+        k_cache = k_cache.at[lidx2, :, blk[bidx], off[bidx]].set(
+            kq.astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[lidx2, :, blk[bidx], off[bidx]].set(
+            vq.astype(v_cache.dtype)
+        )
+        return k_cache, v_cache
+    k_page = pl.BlockSpec(
+        (1, Hkv, 1, bs, Dk), lambda l, b, blk, off, rk, rv: (l, 0, blk[b], 0, 0)
+    )
+    v_page = pl.BlockSpec(
+        (1, Hkv, 1, bs, Dv), lambda l, b, blk, off, rk, rv: (l, 0, blk[b], 0, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(L, B),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, Hkv, Dk), lambda l, b, blk, off, rk, rv: (l, b, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, Hkv, Dv), lambda l, b, blk, off, rk, rv: (l, b, 0, 0)
+            ),
+            k_page,
+            v_page,
+        ],
+        out_specs=[k_page, v_page],
+    )
+    return pl.pallas_call(
+        _append_quant_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # +4 scalar-prefetch args precede the tensor operands
+        input_output_aliases={6: 0, 7: 1},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(blk, off, rk, rv, kq, vq, k_cache, v_cache)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(2, 3))
+def kv_cache_append_quantized(
+    k_new: jnp.ndarray,  # [L, B, Hkv, D] this step's keys, full precision
+    v_new: jnp.ndarray,  # [L, B, Hkv, D]
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D] int8, donated
+    v_cache: jnp.ndarray,  # [L, Hkv, N, bs, D] int8, donated
+    k_scales: jnp.ndarray,  # [L, N] f32 per-page scale plane (NOT donated)
+    v_scales: jnp.ndarray,  # [L, N] f32
+    blk: jnp.ndarray,  # [B] int32
+    off: jnp.ndarray,  # [B] int32
+    interpret: bool = False,
+):
+    """kv_cache_append for the int8-with-scales device cache: one fused
+    dispatch that grows each written page's running absmax scale,
+    requantizes the page when its scale grew, and lands the new row
+    quantized against the updated scale. Returns ``(k_cache, v_cache,
+    k_scales, v_scales, n_requants)`` — n_requants counts the
+    (layer, page) scale entries that grew this step (the
+    kv_device_requants_total gauge reads it off-device)."""
+    new_ks, rk, kq = quant_scale_update(k_new, k_scales, blk)
+    new_vs, rv, vq = quant_scale_update(v_new, v_scales, blk)
+    k_cache, v_cache = _append_quant_call(
+        kq, vq, k_cache, v_cache, rk, rv, blk, off, interpret=interpret
+    )
+    n_requants = (
+        jnp.sum(new_ks > k_scales) + jnp.sum(new_vs > v_scales)
+    ).astype(jnp.int32)
+    return k_cache, v_cache, new_ks, new_vs, n_requants
+
+
+def kv_cache_append_quantized_sharded(
+    k_new: jnp.ndarray,  # [L, B, Hkv, D], Hkv sharded over tp
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, Hkv, N, bs, D], Hkv sharded over tp
+    v_cache: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [L, N] replicated
+    v_scales: jnp.ndarray,
+    blk: jnp.ndarray,  # [B] replicated
+    off: jnp.ndarray,  # [B] replicated
+    mesh,
+    interpret: bool = False,
+):
+    """Quantized append under shard_map over ``tp``. The scale update is
+    computed on the GLOBAL arrays first (absmax spans all kv heads, so
+    it cannot run per-shard); only the page RMW shard_maps."""
+    import functools as _ft
+
+    from jax.sharding import PartitionSpec as P
+
+    new_ks, rk, kq = quant_scale_update(k_new, k_scales, blk)
+    new_vs, rv, vq = quant_scale_update(v_new, v_scales, blk)
+    k_cache, v_cache = shard_map(
+        _ft.partial(_append_quant_call, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),  # kq
+            P(None, None, "tp", None),  # vq
+            P(None, "tp", None, None, None),  # k_cache
+            P(None, "tp", None, None, None),  # v_cache
+            P(),  # rk
+            P(),  # rv
+            P(),  # blk
+            P(),  # off
+        ),
+        out_specs=(
+            P(None, "tp", None, None, None),
+            P(None, "tp", None, None, None),
+        ),
+        check_vma=False,
+    )(kq, vq, k_cache, v_cache, rk, rv, blk, off)
+    n_requants = (
+        jnp.sum(new_ks > k_scales) + jnp.sum(new_vs > v_scales)
+    ).astype(jnp.int32)
+    return k_cache, v_cache, new_ks, new_vs, n_requants
+
+
 def _append_tokens_kernel(
     # scalar prefetch
     page_ref,  # [B] int32 this phase's target page per sequence

@@ -70,17 +70,26 @@ def _decode_kernel(
     # query position is seq_len - 1 + q_pos_offset + r // group (the
     # verify path packs T tokens x G heads into the row dim); 0 = all
     # rows share one position (plain decode)
+    has_scales: bool = False,  # int8-with-scales device cache: P k-scale
+    # + P v-scale [1, 128] lane-broadcast refs follow the v pages; the
+    # per-page dequant fuses into the page loads (same scheme as
+    # ragged_paged_attention_pallas)
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, 1, Gp, D]
     k_refs = refs[1 : 1 + P]  # each [1, 1, bs, D]
     v_refs = refs[1 + P : 1 + 2 * P]
+    n_in = 1 + 2 * P
+    if has_scales:
+        ks_refs = refs[n_in : n_in + P]  # each [1, 128]
+        vs_refs = refs[n_in + P : n_in + 2 * P]
+        n_in += 2 * P
     if return_stats:
-        o_ref, mo_ref, lo_ref = refs[1 + 2 * P : 4 + 2 * P]
-        m_scr, l_scr, acc_scr = refs[4 + 2 * P :]
+        o_ref, mo_ref, lo_ref = refs[n_in : n_in + 3]
+        m_scr, l_scr, acc_scr = refs[n_in + 3 :]
     else:
-        o_ref = refs[1 + 2 * P]  # [1, 1, Gp, D]
-        m_scr, l_scr, acc_scr = refs[2 + 2 * P :]
+        o_ref = refs[n_in]  # [1, 1, Gp, D]
+        m_scr, l_scr, acc_scr = refs[n_in + 1 :]
 
     b = pl.program_id(0)
     i = pl.program_id(2)
@@ -107,12 +116,29 @@ def _decode_kernel(
     @pl.when(in_range)
     def _superblock():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [Gp, D]
-        k = jnp.concatenate(
-            [r[0, 0] for r in k_refs], axis=0
-        ).astype(jnp.float32)  # [P*bs, D]
-        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
-            jnp.float32
-        )
+        if has_scales:
+            # fused per-page dequant: quantized tile * its page scale
+            k = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * ks_refs[p][0, 0:1]
+                    for p, r in enumerate(k_refs)
+                ],
+                axis=0,
+            )  # [P*bs, D]
+            v = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * vs_refs[p][0, 0:1]
+                    for p, r in enumerate(v_refs)
+                ],
+                axis=0,
+            )
+        else:
+            k = jnp.concatenate(
+                [r[0, 0] for r in k_refs], axis=0
+            ).astype(jnp.float32)  # [P*bs, D]
+            v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+                jnp.float32
+            )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Gp, P*bs]
@@ -168,6 +194,8 @@ def paged_decode_attention(
     q_pos_offset: int = 0,  # see _decode_kernel
     group: int = 0,  # see _decode_kernel (verify path: heads per token)
     interpret: bool = False,
+    k_scales: jnp.ndarray | None = None,  # [N] f32 per-page (int8 cache)
+    v_scales: jnp.ndarray | None = None,
 ):  # [B, H, D] or (out, m [B, Hkv, G], l [B, Hkv, G]) when return_stats
     B, H, D = q.shape
     Hkv, N, bs, _ = k_cache_layer.shape
@@ -195,6 +223,28 @@ def paged_decode_attention(
     page_spec = [
         pl.BlockSpec((1, 1, bs, D), page_index(j)) for j in range(P)
     ]
+
+    def scale_index(j):
+        def index(b, h, i, bt, sl):
+            last = jnp.maximum(sl[b] - 1, 0) // bs
+            return (bt[b, jnp.minimum(i * P + j, last)], 0)
+
+        return index
+
+    scale_inputs, scale_specs = (), ()
+    if k_scales is not None:
+        # [N] -> [N, 128] lane-broadcast so each page's scale rides its
+        # own (1, 128) stream through the same physical-page index map
+        ksb = jnp.broadcast_to(
+            k_scales.astype(jnp.float32)[:, None], (N, 128)
+        )
+        vsb = jnp.broadcast_to(
+            v_scales.astype(jnp.float32)[:, None], (N, 128)
+        )
+        scale_inputs = (ksb, vsb)
+        scale_specs = tuple(
+            pl.BlockSpec((1, 128), scale_index(j)) for j in range(P)
+        ) * 2
     o_spec = pl.BlockSpec((1, 1, Gp, D), lambda b, h, i, bt, sl: (b, h, 0, 0))
     stat_spec = pl.BlockSpec(
         (1, 1, Gp, 128), lambda b, h, i, bt, sl: (b, h, 0, 0)
@@ -211,6 +261,7 @@ def paged_decode_attention(
             pl.BlockSpec((1, 1, Gp, D), lambda b, h, i, bt, sl: (b, h, 0, 0)),
             *page_spec,
             *page_spec,
+            *scale_specs,
         ],
         out_specs=out_specs,
         scratch_shapes=[
@@ -222,7 +273,7 @@ def paged_decode_attention(
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_size=bs, pages_per_step=P,
         return_stats=return_stats, window=window, q_pos_offset=q_pos_offset,
-        group=group,
+        group=group, has_scales=k_scales is not None,
     )
     out = pl.pallas_call(
         kernel,
@@ -240,6 +291,8 @@ def paged_decode_attention(
     )(
         block_tables, seq_lens, qg,
         *([k_cache_layer] * P), *([v_cache_layer] * P),
+        *([scale_inputs[0]] * P if scale_inputs else []),
+        *([scale_inputs[1]] * P if scale_inputs else []),
     )
     if return_stats:
         o, m, l = out
@@ -267,13 +320,20 @@ def _prefill_kernel(
     pages_per_step: int,
     window: int = 0,  # sliding attention; 0 = full
     has_sinks: bool = False,  # gpt-oss per-head sink logits
+    has_scales: bool = False,  # int8 device cache: P k-scale + P v-scale
+    # [1, 128] refs between the v pages and the sinks
 ):
     P = pages_per_step
     q_ref = refs[0]  # [1, Tq*Gp, D]
     k_refs = refs[1 : 1 + P]  # each [1, 1, bs, D]
     v_refs = refs[1 + P : 1 + 2 * P]
-    n_in = 1 + 2 * P + int(has_sinks)
-    sink_ref = refs[1 + 2 * P] if has_sinks else None  # [1, Gp]
+    n_in = 1 + 2 * P
+    if has_scales:
+        ks_refs = refs[n_in : n_in + P]  # each [1, 128]
+        vs_refs = refs[n_in + P : n_in + 2 * P]
+        n_in += 2 * P
+    sink_ref = refs[n_in] if has_sinks else None  # [1, Gp]
+    n_in += int(has_sinks)
     o_ref = refs[n_in]  # [1, Tq*Gp, D]
     m_scr, l_scr, acc_scr = refs[n_in + 1 :]
 
@@ -299,12 +359,28 @@ def _prefill_kernel(
     @pl.when(in_range)
     def _superblock():
         q = q_ref[0].astype(jnp.float32) * scale  # [Tq*Gp, D]
-        k = jnp.concatenate(
-            [r[0, 0] for r in k_refs], axis=0
-        ).astype(jnp.float32)  # [P*bs, D]
-        v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
-            jnp.float32
-        )
+        if has_scales:
+            k = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * ks_refs[p][0, 0:1]
+                    for p, r in enumerate(k_refs)
+                ],
+                axis=0,
+            )  # [P*bs, D]
+            v = jnp.concatenate(
+                [
+                    r[0, 0].astype(jnp.float32) * vs_refs[p][0, 0:1]
+                    for p, r in enumerate(v_refs)
+                ],
+                axis=0,
+            )
+        else:
+            k = jnp.concatenate(
+                [r[0, 0] for r in k_refs], axis=0
+            ).astype(jnp.float32)  # [P*bs, D]
+            v = jnp.concatenate([r[0, 0] for r in v_refs], axis=0).astype(
+                jnp.float32
+            )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Tq*Gp, P*bs]
@@ -372,6 +448,8 @@ def paged_prefill_attention(
     window: int = 0,  # sliding attention width; 0 = full
     sinks: jnp.ndarray | None = None,  # [H] gpt-oss sink logits
     interpret: bool = False,
+    k_scales: jnp.ndarray | None = None,  # [N] f32 per-page (int8 cache)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:  # [T, H, D]
     """Flash-style chunked-prefill attention over the paged cache.
 
@@ -425,6 +503,31 @@ def paged_prefill_attention(
     page_spec = [
         pl.BlockSpec((1, 1, bs, D), page_index(p)) for p in range(P)
     ]
+
+    def scale_index(p):
+        def index(j, h, i, bt, hist):
+            tile_last = (hist[0] + (j + 1) * Tq - 1) // bs
+            written_last = (hist[0] + Tpad - 1) // bs
+            pi = jnp.minimum(
+                jnp.minimum(i * P + p, tile_last),
+                jnp.minimum(written_last, M - 1),
+            )
+            return (bt[pi], 0)
+
+        return index
+
+    scale_inputs, scale_specs = (), ()
+    if k_scales is not None:
+        ksb = jnp.broadcast_to(
+            k_scales.astype(jnp.float32)[:, None], (N, 128)
+        )
+        vsb = jnp.broadcast_to(
+            v_scales.astype(jnp.float32)[:, None], (N, 128)
+        )
+        scale_inputs = tuple([ksb] * P + [vsb] * P)
+        scale_specs = tuple(
+            pl.BlockSpec((1, 128), scale_index(p)) for p in range(P)
+        ) * 2
     sink_inputs, sink_specs = (), ()
     if sinks is not None:
         # [H] -> [Hkv, Gp, 128] f32 lane-broadcast; padded group lanes
@@ -444,6 +547,7 @@ def paged_prefill_attention(
             pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
             *page_spec,
             *page_spec,
+            *scale_specs,
             *sink_specs,
         ],
         out_specs=pl.BlockSpec((1, Tq * Gp, D), lambda j, h, i, bt, hist: (h, j, 0)),
@@ -456,6 +560,7 @@ def paged_prefill_attention(
     kernel = functools.partial(
         _prefill_kernel, scale=scale, block_size=bs, q_tile=Tq, group=Gp,
         pages_per_step=P, window=window, has_sinks=sinks is not None,
+        has_scales=k_scales is not None,
     )
     out = pl.pallas_call(
         kernel,
@@ -471,6 +576,7 @@ def paged_prefill_attention(
         ),
         interpret=interpret,
     )(jnp.asarray(block_table), jnp.asarray(history_len, jnp.int32).reshape(1),
-      qg, *([k_cache_layer] * P), *([v_cache_layer] * P), *sink_inputs)
+      qg, *([k_cache_layer] * P), *([v_cache_layer] * P),
+      *scale_inputs, *sink_inputs)
     out = out.reshape(Hkv, nT, Tq, Gp, D).transpose(1, 2, 0, 3, 4)
     return out.reshape(Tpad, Hkv, Gp, D)[:T, :, :G, :].reshape(T, H, D)

@@ -104,7 +104,7 @@ HOST_US_PER_DISPATCH = 100.0
 
 _DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2}
 _QUANT_BYTES = {"none": None, "int8": 1, "fp8_e4m3": 1}
-_KV_BYTES = {"model": None, "float8_e4m3": 1, "bfloat16": 2}
+_KV_BYTES = {"model": None, "float8_e4m3": 1, "bfloat16": 2, "int8": 1}
 
 # expert-stack leaves: streamed per-touched-expert, quantized only when
 # the quant path covers experts (models/quant.py)
@@ -189,7 +189,13 @@ def kv_row_bytes(cfg: ModelConfig, kv_dtype: str = "model") -> float:
         per_layer = cfg.kv_lora_rank + cfg.qk_rope_head_dim
     else:
         per_layer = 2 * cfg.num_kv_heads * cfg.head_dim
-    return float(per_layer * b * cfg.num_layers)
+    row = float(per_layer * b * cfg.num_layers)
+    if kv_dtype == "int8":
+        # the int8-with-scales device cache keeps one f32 scale pair per
+        # (layer, page) (engine.k_scales/v_scales) — amortized over the
+        # serving block size (16 tokens), sub-1% of the row
+        row += 2.0 * 4.0 * cfg.num_layers / 16.0
+    return row
 
 
 def kv_read_tokens_per_layer_sum(cfg: ModelConfig, ctx: int) -> float:
@@ -421,6 +427,15 @@ DEFAULT_SCENARIOS = (
     Scenario("8b-bf16-v5e4-tp4", "llama3_8b", "v5e", 4, batch=16,
              isl=3000, osl=150, tp=4,
              notes="BASELINE cfg 2 · bf16 · tp4"),
+    # low-precision compute lane (ISSUE 18): int8 weights + the
+    # int8-with-scales DEVICE cache on the same chip as cfg 1 — the
+    # kernels dequantize pages against the per-(layer, page) f32 scale
+    # planes in-register, so both the weight stream and the KV read
+    # stream halve (scripts/bench_lowprec_kernels.py prints the
+    # MEASURED rows next to these modeled ones)
+    Scenario("8b-int8w-int8kv-v5e1", "llama3_8b", "v5e", 1, batch=8,
+             isl=3000, osl=150, quant="int8", kv_dtype="int8",
+             notes="low-precision lane · int8 weights + int8+scales KV"),
     # BASELINE config 3: same decode chip, prefill disaggregated away
     Scenario("8b-int8-v5e-disagg", "llama3_8b", "v5e", 1, batch=8,
              isl=3000, osl=150, quant="int8", kv_dtype="float8_e4m3",

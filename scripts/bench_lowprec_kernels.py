@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Per-kernel microbench for the low-precision compute lane: MEASURED
+step times of the real jitted programs (llama.decode_window — the fused
+decode+sample window — and llama.prefill) in the four weight/KV
+precision modes, printed NEXT TO the roofline-modeled rows for the same
+quant/kv_dtype so measured-vs-modeled sits in one table
+(dynamo_tpu/perf/roofline.py; the committed modeled artifact is
+benchmarks/roofline_model.json).
+
+    python scripts/bench_lowprec_kernels.py                  # tiny/CPU smoke
+    python scripts/bench_lowprec_kernels.py --json out.json  # machine-readable
+
+On CPU this is a correctness-scale smoke (tiny model, relative numbers
+only — XLA CPU has no int8 MXU story); on a TPU the same four programs
+run the llama-1B-class config and the achieved-vs-modeled gap is the
+honest number. Modes:
+
+    bf16        full-width weights, full-width KV (the baseline)
+    int8w       int8 weight GEMMs (quantization="int8_native": int8
+                operands into dot_general, f32 accumulation)
+    int8kv      int8-with-scales device KV cache (kv_cache_dtype="int8":
+                per-(layer, page) f32 scale planes, fused dequant in the
+                attention kernels, requantizing appends)
+    int8w+kv    both lanes at once
+
+Every mode runs the SAME decode_window/prefill entry points the engine
+dispatches — no bench-only kernels.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import KV_SCALE_EPS, quantize_params
+from dynamo_tpu.perf import roofline as R
+
+MODES = (
+    # (tag, weight quant mode, int8 device KV?)
+    ("bf16", "none", False),
+    ("int8w", "int8_native", False),
+    ("int8kv", "none", True),
+    ("int8w+kv", "int8_native", True),
+)
+
+
+def build_state(cfg, B, BLOCK, CTX, int8_kv):
+    M = max(1, math.ceil(CTX / BLOCK))
+    num_blocks = B * M + 1
+    dt = jnp.int8 if int8_kv else None
+    k_cache, v_cache = llama.init_kv_cache(
+        cfg, num_blocks, BLOCK, **({"dtype": dt} if dt is not None else {})
+    )
+    scales = None
+    if int8_kv:
+        # warm planes at a realistic magnitude (freshly-reset pages sit
+        # at KV_SCALE_EPS; decoded-into pages carry real absmax scales)
+        plane = jnp.full((cfg.num_layers, num_blocks), 0.05, jnp.float32)
+        plane = plane.at[:, 0].set(KV_SCALE_EPS)
+        scales = (plane, plane)
+    tables = jnp.asarray(
+        np.arange(1, num_blocks, dtype=np.int32).reshape(B, M))
+    return k_cache, v_cache, scales, tables
+
+
+def time_decode(params, cfg, B, BLOCK, CTX, W, iters, int8_kv,
+                use_pallas):
+    k_cache, v_cache, scales, tables = build_state(
+        cfg, B, BLOCK, CTX, int8_kv)
+    seq0 = CTX - W * (iters + 1) - 1
+    tokens = jnp.zeros(B, jnp.int32)
+    positions = jnp.full((B,), seq0, jnp.int32)
+    seq_lens = jnp.full((B,), seq0 + 1, jnp.int32)
+    steps = jnp.zeros(B, jnp.int32)
+    zeros_i = jnp.zeros(B, jnp.int32)
+    temps = jnp.zeros(B, jnp.float32)
+    top_ps = jnp.ones(B, jnp.float32)
+
+    def window(tokens, positions, seq_lens, steps, k_cache, v_cache,
+               scales):
+        out = llama.decode_window(
+            params, cfg, tokens, positions, tables, seq_lens,
+            zeros_i, steps, temps, zeros_i, top_ps, k_cache, v_cache,
+            n_steps=W, use_pallas=use_pallas,
+            k_scales=scales[0] if scales else None,
+            v_scales=scales[1] if scales else None,
+        )
+        toks, k_cache, v_cache = out[0], out[1], out[2]
+        sc = (out[3], out[4]) if scales else None
+        return (toks[-1], positions + W, seq_lens + W, steps + W,
+                k_cache, v_cache, sc)
+
+    state = (tokens, positions, seq_lens, steps, k_cache, v_cache, scales)
+    state = window(*state)  # compile + warm
+    np.asarray(jax.device_get(state[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = window(*state)
+    np.asarray(jax.device_get(state[0]))
+    dt = time.perf_counter() - t0
+    steps_run = iters * W
+    return dt / steps_run * 1e3  # ms / decode step
+
+
+def time_prefill(params, cfg, SEQ, BLOCK, iters, int8_kv, use_pallas):
+    M = max(1, math.ceil(SEQ / BLOCK))
+    k_cache, v_cache, scales, _ = build_state(
+        cfg, 1, BLOCK, SEQ, int8_kv)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        10, cfg.vocab_size - 1, SEQ, dtype=np.int32))
+    blocks = jnp.asarray(np.arange(1, M + 1, dtype=np.int32))
+    start, ln = jnp.int32(0), jnp.int32(SEQ)
+
+    def run(k_cache, v_cache, scales):
+        out = llama.prefill(
+            params, cfg, toks, blocks, start, ln, k_cache, v_cache,
+            use_pallas=use_pallas,
+            k_scales=scales[0] if scales else None,
+            v_scales=scales[1] if scales else None,
+        )
+        sc = (out[3], out[4]) if scales else None
+        return out[0], out[1], out[2], sc
+
+    logits, k_cache, v_cache, scales = run(k_cache, v_cache, scales)
+    np.asarray(jax.device_get(logits))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        logits, k_cache, v_cache, scales = run(k_cache, v_cache, scales)
+    np.asarray(jax.device_get(logits))
+    return (time.perf_counter() - t0) / iters * 1e3  # ms / prefill
+
+
+def modeled_row(cfg, tag, quant, int8_kv, B, CTX, chip_name):
+    """The roofline-modeled step time for this mode on a REAL chip —
+    the comparison column (on CPU the measured column is smoke-scale,
+    but the modeled one is always the v5e/v5p production number)."""
+    quant_mode = "int8" if quant != "none" else "none"
+    kv_dtype = "int8" if int8_kv else "model"
+    chip = R.CHIPS[chip_name]
+    dec = R.decode_flops_per_token(cfg, B, CTX)
+    stream = R.decode_stream_bytes(cfg, B, CTX, quant_mode, kv_dtype)
+    sc = R.Scenario(f"microbench-{tag}", "llama3_8b", chip_name, 1,
+                    batch=B, isl=CTX, osl=1, quant=quant_mode,
+                    kv_dtype=kv_dtype)
+    t = R._step_time(cfg, sc, chip, B, dec["flops_per_token"],
+                     stream["total"])
+    return {
+        "modeled_t_step_ms": round(t * 1e3, 3),
+        "modeled_tok_s_chip": round(B / t, 1),
+        "modeled_bytes_per_step": int(stream["total"]),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="also write the rows as JSON")
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg = ModelConfig.tiny(
+            hidden_size=256, intermediate_size=512, num_layers=4,
+            num_heads=4, num_kv_heads=4, head_dim=64,
+            max_position_embeddings=1024,
+        )
+        B, BLOCK, CTX, W, SEQ = 4, 16, 256, 4, 128
+        iters = args.iters or 4
+        chip_name = "v5e"
+    else:
+        cfg = ModelConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+            max_position_embeddings=2048, dtype="bfloat16",
+        )
+        B, BLOCK, CTX, W, SEQ = 16, 16, 2048, 16, 1024
+        iters = args.iters or 16
+        chip_name = "v5e"
+    use_pallas = not on_cpu and cfg.head_dim % 128 == 0
+
+    params_full = llama.init_params(cfg, jax.random.key(0))
+    rows = []
+    for tag, quant, int8_kv in MODES:
+        params = quantize_params(params_full, cfg, quant)
+        wbytes = sum(int(getattr(x, "nbytes", 0) or 0)
+                     for x in jax.tree.leaves(params))
+        dec_ms = time_decode(params, cfg, B, BLOCK, CTX, W, iters,
+                             int8_kv, use_pallas)
+        pf_ms = time_prefill(params, cfg, SEQ, BLOCK, max(2, iters // 2),
+                             int8_kv, use_pallas)
+        row = {
+            "mode": tag,
+            "backend": jax.devices()[0].platform,
+            "measured_decode_ms_step": round(dec_ms, 3),
+            "measured_tok_s": round(B / (dec_ms * 1e-3), 1),
+            "measured_prefill_ms": round(pf_ms, 3),
+            "weight_bytes": wbytes,
+            "kv_cache_dtype": "int8" if int8_kv else cfg.dtype,
+        }
+        row.update(modeled_row(cfg, tag, quant, int8_kv, B, CTX,
+                               chip_name))
+        rows.append(row)
+        print(f"{tag:>9}: decode {dec_ms:8.3f} ms/step "
+              f"({row['measured_tok_s']:9.1f} tok/s {row['backend']}) | "
+              f"prefill {pf_ms:8.2f} ms | weights "
+              f"{wbytes / 2**20:6.1f} MiB | modeled {chip_name} "
+              f"{row['modeled_t_step_ms']:7.3f} ms/step "
+              f"({row['modeled_tok_s_chip']:7.1f} tok/s/chip)")
+
+    base = rows[0]
+    print(f"\nmeasured vs bf16 (decode): " + ", ".join(
+        f"{r['mode']} {base['measured_decode_ms_step'] / r['measured_decode_ms_step']:.2f}x"
+        for r in rows[1:]))
+    print("modeled  vs bf16 (decode): " + ", ".join(
+        f"{r['mode']} {base['modeled_t_step_ms'] / r['modeled_t_step_ms']:.2f}x"
+        for r in rows[1:]))
+    if on_cpu:
+        print("NOTE: CPU smoke scale — measured columns are relative "
+              "sanity only; the modeled columns price the SAME tiny "
+              "config on a v5e (the production-scale modeled table is "
+              "benchmarks/roofline_model.json).")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

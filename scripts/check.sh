@@ -58,6 +58,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_offload_pipeline.py \
         tests/test_prefix_fleet.py \
         tests/test_kv_quant.py \
+        tests/test_lowprec.py \
         tests/test_cost_routing.py \
         tests/test_tracing.py \
         tests/test_resilience.py \

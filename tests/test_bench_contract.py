@@ -154,6 +154,29 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
             assert kq[mode][path]["ttft_p50_ms"] > 0, kq
     assert kq["logprob_drift"]["greedy_agreement"] >= 0.99, kq
     assert kq["logprob_drift"]["n_tokens"] > 0, kq
+    # the low-precision COMPUTE lane must be recorded (ISSUE 18): all
+    # four weight/KV mode combos measured through the fused step, the
+    # int8 device cache affording >= 1.8x the pages at the bf16 pool's
+    # byte budget, int8 weights actually shrinking the resident weight
+    # bytes, and every mode clearing its greedy-agreement floor vs the
+    # bf16 reference (the bench enforces the per-mode floors; the
+    # contract pins presence + the headline ratio)
+    lp = result.get("bench_lowprec")
+    assert lp, result.get("bench_lowprec_error", "metric missing")
+    assert set(lp["modes"]) == {"bf16", "int8_weights", "int8_kv",
+                                "int8_both"}, lp
+    assert lp["capacity_ratio"] >= 1.8, lp
+    assert (lp["modes"]["int8_weights"]["hbm_weights_bytes"]
+            < lp["modes"]["bf16"]["hbm_weights_bytes"]), lp
+    assert (lp["modes"]["int8_kv"]["kv_page_bytes"]
+            < lp["modes"]["bf16"]["kv_page_bytes"]), lp
+    for mode, rec in lp["modes"].items():
+        assert rec["tok_s"] > 0, (mode, rec)
+        assert rec["drift"]["n_tokens"] > 0, (mode, rec)
+        assert rec["drift"]["greedy_agreement"] >= 0.8, (mode, rec)
+    assert lp["modes"]["int8_kv"]["kv_device_quant_pages"] > 0, lp
+    assert lp["modes"]["int8_kv"]["kv_device_bytes_saved_total"] > 0, lp
+    assert lp["modes"]["int8_kv"]["lowprec_tok_s"] > 0, lp
     # transfer-cost-aware placement must be recorded (ISSUE 11): on the
     # heterogeneous two-candidate workload the overlap-only scorer picks
     # the deeper-but-cold-tier busy worker, the cost model picks the

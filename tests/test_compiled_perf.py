@@ -314,6 +314,70 @@ def test_mixed_step_program_count_bounded_quantized_kv():
     )
 
 
+def test_mixed_step_program_count_bounded_int8_scales_kv():
+    """int8-with-scales twin of the bucketing guard (ISSUE 18): the
+    int8 device cache threads two [L, N] f32 scale planes through every
+    mixed dispatch and returns them grown — the planes are TRACED
+    operands, so across the same (segment-count x prefill-bucket) grid
+    the program count must stay exactly the bucket grid. A regression
+    here (a plane shape or a scale value leaking into the static key)
+    multiplies compiles by the page-recycling pattern."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    M = CTX // BLOCK
+    MP_MAX = 2
+    num_blocks = (B + MP_MAX) * M + 1
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(
+        cfg, num_blocks, BLOCK, dtype=jnp.int8
+    )
+    k_scales = jnp.full((cfg.num_layers, num_blocks), 1e-12, jnp.float32)
+    v_scales = k_scales
+    d_tables = jnp.asarray(
+        np.arange(1, B * M + 1, dtype=np.int32).reshape(B, M)
+    )
+    p_tables = jnp.asarray(
+        np.arange(B * M + 1, (B + MP_MAX) * M + 1, dtype=np.int32)
+        .reshape(MP_MAX, M)
+    )
+    seg_buckets = (1, 2)
+    buckets = (16, 32)
+    base = llama.mixed_step._cache_size()
+    for MP in seg_buckets:
+        for T in buckets:
+            variants = (
+                (11, (0,) * MP, (T - 3,) + (2,) * (MP - 1)),
+                (7, (T // 2,) * MP, (2,) + (0,) * (MP - 1)),
+            )
+            for sl, hists, valids in variants:
+                out = llama.mixed_step(
+                    params, cfg,
+                    jnp.zeros(B, jnp.int32),
+                    jnp.full((B,), sl - 1, jnp.int32),
+                    d_tables,
+                    jnp.full((B,), sl, jnp.int32),
+                    jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+                    jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.int32),
+                    jnp.ones(B, jnp.float32),
+                    jnp.zeros((MP, T), jnp.int32), p_tables[:MP],
+                    jnp.asarray(hists, jnp.int32),
+                    jnp.asarray(valids, jnp.int32),
+                    k_cache, v_cache,
+                    use_pallas=False,
+                    k_scales=k_scales, v_scales=v_scales,
+                )
+                _, _, k_cache, v_cache, k_scales, v_scales, _ = out[:7]
+                assert k_cache.dtype == jnp.int8
+                assert k_scales.dtype == jnp.float32
+    grown = llama.mixed_step._cache_size() - base
+    limit = len(seg_buckets) * len(buckets)
+    assert grown == limit, (
+        f"int8+scales mixed_step compiled {grown} programs for "
+        f"{len(seg_buckets)} segment-count buckets x {len(buckets)} "
+        f"prefill buckets (expected {limit}) — the scale planes leaked "
+        "a traced value into the static shape key"
+    )
+
+
 def test_mixed_step_tpu_lowering_uses_ragged_kernel_quantized_kv():
     """The quantized-cache TPU path must still lower the ragged Mosaic
     kernel — engine/engine.py's capability gate now keeps fp8 caches on

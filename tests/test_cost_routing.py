@@ -608,12 +608,12 @@ def test_export_device_chain_bounded_and_nondestructive(run):
         await collect(eng.generate(Context(make_req(prompt))))
         pairs = sequence_block_hashes(prompt, 4)
         chain = [s for _l, s in pairs]
-        served, k, v = await eng.export_device_chain(chain)
+        served, k, v, _ks, _vs = await eng.export_device_chain(chain)
         assert len(served) >= 5 and served == chain[: len(served)]
         assert k.shape[2] == len(served)
         assert isinstance(k, np.ndarray)
         # bounded
-        short, k2, _v2 = await eng.export_device_chain(chain, max_blocks=2)
+        short, k2, _v2, _ks2, _vs2 = await eng.export_device_chain(chain, max_blocks=2)
         assert len(short) == 2 and k2.shape[2] == 2
         # non-destructive: the chain is still device-resident and a
         # prefix-hit serve afterwards still claims it (stats bump)
@@ -623,7 +623,7 @@ def test_export_device_chain_bounded_and_nondestructive(run):
         assert eng.stats["prefix_cache_hits_tokens"] > hits0
         assert eng.stats["peer_serve_d2h_blocks"] == len(served) + 2
         # a miss at the head serves nothing
-        none, nk, _nv = await eng.export_device_chain([123456789])
+        none, nk, _nv, _nks, _nvs = await eng.export_device_chain([123456789])
         assert none == [] and nk is None
         await eng.close()
 

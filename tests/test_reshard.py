@@ -21,6 +21,7 @@ The acceptance matrix:
 import asyncio
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -234,6 +235,52 @@ def test_reshard_grow_shrink_mid_stream_bit_exact(run):
         # lost-host survivor case: same shape, placement re-resolved)
         assert (await eng.reshard(None))["changed"] is False
         assert (await eng.reshard(None, force=True))["changed"] is True
+        await eng.close()
+
+    run(main())
+
+
+def test_reshard_grow_shrink_int8_cache_bit_exact(run):
+    """Grow/shrink with the int8-with-scales device cache live (ISSUE
+    18): the per-page scale planes are commit-block state — they re-lay
+    (replicated) with the quantized pages, so a mid-stream morph keeps
+    the greedy stream bit-exact against an unmorphed int8 reference,
+    and the planes keep their per-page values across both directions."""
+    async def main():
+        req = make_req(max_tokens=60)
+        ref = make_engine(None, kv_cache_dtype="int8")
+        want, finishes, errs = await drive(ref, make_req(max_tokens=60))
+        assert finishes and not errs
+        await ref.close()
+
+        eng = make_engine(None, kv_cache_dtype="int8")
+        task = asyncio.ensure_future(drive(eng, make_req(max_tokens=60)))
+        await asyncio.sleep(0.15)  # let it get into decode
+        planes_before = np.asarray(eng.k_scales).copy()
+        out = await eng.reshard(TP2)
+        assert out["changed"] and out["kv_moved_blocks"] > 0
+        assert eng.k_cache.dtype == jnp.int8
+        assert _n_devices(eng.k_cache) == 2
+        # planes moved WITH the pages (replicated on the new mesh) and
+        # kept every page's scale — a lost scale would silently rescale
+        # resident content
+        assert _n_devices(eng.k_scales) == 2
+        assert np.asarray(eng.k_scales).shape == planes_before.shape
+        toks, finishes, errs = await task
+        assert not errs and finishes == ["length"]
+        assert toks == want, (
+            "morph mid-stream changed the quantized greedy stream"
+        )
+        # fresh request on the grown layout, then shrink back
+        toks2, _f, errs2 = await drive(eng, req)
+        assert not errs2 and toks2 == want
+        out = await eng.reshard(None)
+        assert out["changed"] and eng.mesh is None
+        assert eng.k_cache.dtype == jnp.int8
+        assert _n_devices(eng.k_scales) == 1
+        toks3, _f, errs3 = await drive(eng, req)
+        assert not errs3 and toks3 == want
+        assert eng.stats["resharded_total"] == 2
         await eng.close()
 
     run(main())
