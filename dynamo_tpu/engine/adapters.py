@@ -1,0 +1,330 @@
+"""LoRA adapter registry: the engine's multi-model lane.
+
+One worker process serves its base model plus N low-rank adapters
+(ROADMAP item 1; the reference Dynamo reaches the same surface through
+vLLM's LoRARequest plumbing). The registry owns:
+
+  * **specs** — every adapter this worker MAY serve, from
+    ``EngineConfig.adapters`` strings (``name:rank[:seed]`` for seeded
+    synthetic adapters, or ``name=/path/to/adapter.npz`` for weights on
+    disk). Spec'd-but-unstaged adapters are advertised, routable, and
+    cold-loadable; they just aren't resident yet.
+  * **host weights** — per-adapter A/B stacks as numpy arrays
+    (materialized lazily: seeded init or npz load), the staging source.
+  * **the device stack** — ONE stacked pytree
+    ``{qa,qb,ka,kb,va,vb,oa,ob}`` of ``[L, NA, ...]`` jnp arrays,
+    where NA is the adapter-count BUCKET (next power of two over the
+    live capacity) and every adapter is zero-padded to the rank bucket.
+    Zero padding is bitwise exact (``x @ 0 == 0``), so the compiled
+    program count keys on the (NA, rank) bucket pair, never on the live
+    adapter census (test_compiled_perf pins this).
+
+Staging (``stage()``) copies one adapter host -> device into a free
+slot, evicting the least-recently-used IDLE adapter when the slots are
+full — evicting an adapter with in-flight sequences would corrupt their
+streams, so that raises instead (the engine passes the in-use id set).
+``pre_stage_weights`` hints (kv_router/publisher.py, PRESERVE-style)
+land here ahead of the request so the request path finds the adapter
+already resident: zero cold-load stall (bench_multi_model measures it).
+
+Deltas attach to the attention projections (wq/wk/wv/wo). A rank-r
+adapter on hidden size E costs 2*r*(E + O) parameters per projection
+per layer — kilobytes at tiny ranks, which is the entire point: dozens
+of fine-tunes share one resident base model.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "AdapterSpec",
+    "AdapterRegistry",
+    "parse_adapter_specs",
+    "LORA_KEYS",
+]
+
+#: device-stack leaves: (down, up) pairs for each attention projection
+LORA_KEYS = ("qa", "qb", "ka", "kb", "va", "vb", "oa", "ob")
+
+#: rank bucket quantum — ranks pad up to a multiple of this so two
+#: adapters of rank 3 and 5 share one compiled program (both bucket 8)
+_RANK_STEP = 8
+
+
+def _rank_bucket(r: int) -> int:
+    return max(_RANK_STEP, ((r + _RANK_STEP - 1) // _RANK_STEP) * _RANK_STEP)
+
+
+def _count_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass(frozen=True)
+class AdapterSpec:
+    """One served adapter. ``path`` set -> weights come from an npz
+    (leaves ``{qa,qb,...}.{layer}``); otherwise a seeded synthetic
+    adapter (deterministic across processes — bench/test fixtures)."""
+
+    name: str
+    rank: int
+    seed: int = 0
+    path: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("adapter spec needs a name")
+        if self.rank <= 0:
+            raise ValueError(f"adapter {self.name!r}: rank must be > 0")
+
+
+def parse_adapter_specs(specs) -> tuple[AdapterSpec, ...]:
+    """``EngineConfig.adapters`` strings -> AdapterSpec tuple.
+
+    Forms: ``name:rank``, ``name:rank:seed``, ``name=/path.npz``.
+    Duplicate names refuse loudly (two adapters answering one model
+    name would route nondeterministically)."""
+    out: list[AdapterSpec] = []
+    seen: set[str] = set()
+    for s in specs or ():
+        if isinstance(s, AdapterSpec):
+            spec = s
+        elif "=" in s:
+            name, path = s.split("=", 1)
+            spec = AdapterSpec(name=name.strip(), rank=1, path=path.strip())
+        else:
+            parts = s.split(":")
+            if len(parts) not in (2, 3):
+                raise ValueError(
+                    f"bad adapter spec {s!r} (want name:rank[:seed] or "
+                    "name=/path.npz)"
+                )
+            spec = AdapterSpec(
+                name=parts[0].strip(),
+                rank=int(parts[1]),
+                seed=int(parts[2]) if len(parts) == 3 else 0,
+            )
+        if spec.name in seen:
+            raise ValueError(f"duplicate adapter name {spec.name!r}")
+        seen.add(spec.name)
+        out.append(spec)
+    return tuple(out)
+
+
+class AdapterRegistry:
+    """Thread-safe adapter store + device stack. The engine's scheduler
+    thread reads ``device_stack()`` / ``slot_of()`` per dispatch; the
+    event-loop thread stages/evicts via ``stage()`` — a single lock
+    covers the mutations, and the stack swap is an atomic rebind."""
+
+    def __init__(self, specs, model_cfg, max_live: int = 0,
+                 dtype=None):
+        self.specs: "OrderedDict[str, AdapterSpec]" = OrderedDict(
+            (s.name, s) for s in parse_adapter_specs(specs)
+        )
+        if not self.specs:
+            raise ValueError("AdapterRegistry needs at least one adapter")
+        self.model_cfg = model_cfg
+        self.max_live = max_live if max_live > 0 else len(self.specs)
+        #: adapter-count bucket (static NA shape of the device stack)
+        self.count_bucket = _count_bucket(self.max_live)
+        #: rank bucket shared by every slot
+        self.rank_bucket = _rank_bucket(
+            max(s.rank for s in self.specs.values())
+        )
+        self._dtype = dtype
+        self._lock = threading.Lock()
+        self._host: dict[str, dict[str, np.ndarray]] = {}
+        # staged name -> slot, LRU-ordered (move_to_end on every use)
+        self._slots: "OrderedDict[str, int]" = OrderedDict()
+        # only max_live slots hand out (the LIVE capacity); the stack's
+        # NA axis is the count BUCKET, so any zero slots past max_live
+        # are pure shape padding
+        self._free_slots = list(range(self.max_live - 1, -1, -1))
+        self._stack = None  # built lazily on first device need
+        self.stats = {
+            "adapters_staged_total": 0,
+            "adapters_evicted_total": 0,
+            "adapter_bytes_staged_total": 0,
+        }
+
+    # ---- introspection ----
+
+    def names(self) -> list[str]:
+        return list(self.specs)
+
+    def is_known(self, name: str) -> bool:
+        return name in self.specs
+
+    def is_staged(self, name: str) -> bool:
+        return name in self._slots
+
+    def slot_of(self, name: str) -> Optional[int]:
+        """Staged slot id (touches LRU), or None when not resident."""
+        with self._lock:
+            if name not in self._slots:
+                return None
+            self._slots.move_to_end(name)
+            return self._slots[name]
+
+    def staged_names(self) -> list[str]:
+        return list(self._slots)
+
+    # ---- host weights ----
+
+    def host_weights(self, name: str) -> dict[str, np.ndarray]:
+        """Materialize (and memoize) one adapter's host A/B stacks:
+        ``{qa: [L, E, r], qb: [L, r, Oq], ...}`` at the shared rank
+        bucket. Synthetic adapters draw from a seeded generator — A
+        gets a small gaussian, B a smaller one (non-zero so adapter
+        outputs genuinely differ from base: a zero-B adapter would make
+        every bit-exactness test vacuous); npz adapters load + pad."""
+        spec = self.specs[name]
+        cached = self._host.get(name)
+        if cached is not None:
+            return cached
+        cfg = self.model_cfg
+        L, E, D = cfg.num_layers, cfg.hidden_size, cfg.head_dim
+        Oq = cfg.num_heads * D
+        Okv = cfg.num_kv_heads * D
+        r, rb = spec.rank, self.rank_bucket
+        dt = np.dtype(self._dtype or "float32")
+        if spec.path:
+            import numpy.lib.npyio  # noqa: F401 — explicit: plain npz
+
+            data = np.load(spec.path)
+            w = {}
+            for key, odim in (("qa", Oq), ("qb", Oq), ("ka", Okv),
+                              ("kb", Okv), ("va", Okv), ("vb", Okv),
+                              ("oa", E), ("ob", E)):
+                arr = np.asarray(data[key], dt)
+                w[key] = arr
+        else:
+            rng = np.random.default_rng(
+                abs(hash(("lora", name, spec.seed))) % (2**32)
+            )
+            scale_a = 1.0 / np.sqrt(E)
+            # large enough that a synthetic adapter's greedy stream
+            # visibly diverges from base on tiny test models (a delta
+            # below argmax resolution would make every mixed-vs-solo
+            # bit-exactness assertion vacuously pass)
+            scale_b = 0.5 / r
+            w = {
+                "qa": rng.normal(0, scale_a, (L, E, r)),
+                "qb": rng.normal(0, scale_b, (L, r, Oq)),
+                "ka": rng.normal(0, scale_a, (L, E, r)),
+                "kb": rng.normal(0, scale_b, (L, r, Okv)),
+                "va": rng.normal(0, scale_a, (L, E, r)),
+                "vb": rng.normal(0, scale_b, (L, r, Okv)),
+                "oa": rng.normal(0, scale_a, (L, Oq, r)),
+                "ob": rng.normal(0, scale_b, (L, r, E)),
+            }
+            w = {k: np.asarray(v, dt) for k, v in w.items()}
+        # zero-pad the rank axis to the bucket (bitwise exact)
+        for k in list(w):
+            arr = w[k]
+            ax = arr.ndim - 1 if k.endswith("a") else arr.ndim - 2
+            if arr.shape[ax] < rb:
+                pad = [(0, 0)] * arr.ndim
+                pad[ax] = (0, rb - arr.shape[ax])
+                w[k] = np.pad(arr, pad)
+            elif arr.shape[ax] > rb:
+                raise ValueError(
+                    f"adapter {name!r} rank {arr.shape[ax]} exceeds the "
+                    f"registry rank bucket {rb}"
+                )
+        self._host[name] = w
+        return w
+
+    def host_nbytes(self, name: str) -> int:
+        return sum(a.nbytes for a in self.host_weights(name).values())
+
+    # ---- device stack ----
+
+    def _empty_stack(self):
+        import jax.numpy as jnp
+
+        cfg = self.model_cfg
+        L, E, D = cfg.num_layers, cfg.hidden_size, cfg.head_dim
+        Oq, Okv = cfg.num_heads * D, cfg.num_kv_heads * D
+        NA, rb = self.count_bucket, self.rank_bucket
+        dt = self._dtype or "float32"
+        shapes = {
+            "qa": (L, NA, E, rb), "qb": (L, NA, rb, Oq),
+            "ka": (L, NA, E, rb), "kb": (L, NA, rb, Okv),
+            "va": (L, NA, E, rb), "vb": (L, NA, rb, Okv),
+            "oa": (L, NA, Oq, rb), "ob": (L, NA, rb, E),
+        }
+        return {k: jnp.zeros(s, dt) for k, s in shapes.items()}
+
+    def device_stack(self):
+        """The stacked ``[L, NA, ...]`` pytree every dispatch threads.
+        Unstaged slots hold zeros (exact base behavior for stray ids)."""
+        with self._lock:
+            if self._stack is None:
+                self._stack = self._empty_stack()
+            return self._stack
+
+    # ---- staging / eviction ----
+
+    def stage(self, name: str, in_use: Optional[set] = None
+              ) -> tuple[int, int]:
+        """Make ``name`` device-resident; returns (slot, bytes_staged
+        — 0 when it was already resident). Evicts the LRU idle adapter
+        when slots are full; every staged adapter in-flight -> loud
+        RuntimeError (the caller's backpressure, never silent
+        corruption of a live stream's weights)."""
+        if name not in self.specs:
+            raise KeyError(f"unknown adapter {name!r}")
+        import jax.numpy as jnp
+
+        with self._lock:
+            if name in self._slots:
+                self._slots.move_to_end(name)
+                return self._slots[name], 0
+            if self._stack is None:
+                self._stack = self._empty_stack()
+            if self._free_slots:
+                slot = self._free_slots.pop()
+            else:
+                victim = next(
+                    (n for n in self._slots if n not in (in_use or ())),
+                    None,
+                )
+                if victim is None:
+                    raise RuntimeError(
+                        "no evictable adapter slot: all "
+                        f"{len(self._slots)} staged adapters are in use"
+                    )
+                slot = self._slots.pop(victim)
+                self.stats["adapters_evicted_total"] += 1
+            w = self.host_weights(name)
+            stack = dict(self._stack)
+            for k in LORA_KEYS:
+                stack[k] = stack[k].at[:, slot].set(jnp.asarray(w[k]))
+            self._stack = stack
+            self._slots[name] = slot
+            nbytes = sum(a.nbytes for a in w.values())
+            self.stats["adapters_staged_total"] += 1
+            self.stats["adapter_bytes_staged_total"] += nbytes
+            return slot, nbytes
+
+    def evict(self, name: str) -> bool:
+        """Drop a staged adapter's slot back to the free list (weights
+        stay in the stack until the slot is re-staged — ids never point
+        at it, so the stale planes are unreachable)."""
+        with self._lock:
+            slot = self._slots.pop(name, None)
+            if slot is None:
+                return False
+            self._free_slots.append(slot)
+            self.stats["adapters_evicted_total"] += 1
+            return True

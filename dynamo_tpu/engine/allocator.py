@@ -41,18 +41,44 @@ def chain_hash(parent: Optional[int], local: int) -> int:
     return int.from_bytes(h.digest(), "big")
 
 
-def sequence_block_hashes(tokens: Sequence[int], block_size: int) -> list[tuple[int, int]]:
+def model_hash_salt(model: Optional[str]) -> Optional[int]:
+    """Per-model root of the chain-hash namespace (multi-model serving).
+
+    The chained sequence hash is the cross-process address of a KV block
+    — radix index entries, reuse-pool keys, wire pulls all speak it. Two
+    models sharing a token-identical prompt must NEVER share that
+    address (an adapter's KV is a different function of the same
+    tokens), so the ADAPTER's name hashes into the chain as a synthetic
+    root parent. ``None``/empty (the base model) returns None — the
+    chain starts unsalted, byte-identical to every pre-multi-model
+    fleet: no hash drift for existing deployments, and base-model
+    traffic on an adapter-serving fleet still prefix-shares with
+    base-only peers."""
+    if not model:
+        return None
+    h = hashlib.blake2b(digest_size=8)
+    h.update(b"model:" + model.encode())
+    return int.from_bytes(h.digest(), "big")
+
+
+def sequence_block_hashes(
+    tokens: Sequence[int], block_size: int, salt: Optional[int] = None
+) -> list[tuple[int, int]]:
     """[(local_hash, chained_hash)] for each *full* block of the sequence.
 
     Uses the native C++ batch hasher when built (bit-identical output —
     hashes address KV blocks across processes, so both layers must agree).
+    ``salt`` (``model_hash_salt``) roots the chain in a per-model
+    namespace; the native hasher has no salt parameter yet, so salted
+    chains take the pure-python walk (adapter prompts only — base-model
+    traffic keeps the fast path).
     """
     from .. import native
 
-    if native.available():
+    if salt is None and native.available():
         return native.sequence_block_hashes(tokens, block_size)
     out: list[tuple[int, int]] = []
-    parent: Optional[int] = None
+    parent: Optional[int] = salt
     for i in range(0, len(tokens) - len(tokens) % block_size, block_size):
         local = block_token_hash(tokens[i : i + block_size])
         parent = chain_hash(parent, local)

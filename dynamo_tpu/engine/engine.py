@@ -50,7 +50,12 @@ from ..resilience.faultpoints import FaultInjected
 from ..resilience.policy import MIGRATION_SIGNAL
 from ..runtime.engine import AsyncEngine, Context
 from .. import tracing
-from .allocator import Block, BlockAllocator, sequence_block_hashes
+from .allocator import (
+    Block,
+    BlockAllocator,
+    model_hash_salt,
+    sequence_block_hashes,
+)
 from .offload import OffloadManager
 
 logger = logging.getLogger(__name__)
@@ -279,6 +284,25 @@ class EngineConfig:
     # constraint near 16k — set the threshold where score memory rivals
     # a layer's weights (~8k for 8B-class) on sp>1 slices.
     ring_prefill_threshold: int = 0
+    # multi-LoRA serving lane (engine/adapters.py): adapter specs, each
+    # "name:rank[:seed]" (synthetic seeded weights — tests/bench) or
+    # "name=/path/to/adapter.npz" (real weights). Non-empty turns on the
+    # adapter registry: requests may carry a model name that resolves to
+    # one of these adapters and the batch runs ONE shared base-GEMM pass
+    # plus grouped per-adapter low-rank deltas (ops/lora.py). Empty ()
+    # keeps every compiled program, block hash, and wire payload
+    # byte-identical to a pre-multi-model fleet.
+    adapters: tuple = ()
+    # public name of the BASE model (what /v1/models advertises and what
+    # requests resolve to adapter_id -1); "" = serve under any name the
+    # frontend registered (legacy single-model behavior)
+    served_model_name: str = ""
+    # max adapters resident in the device stack at once (0 = all
+    # configured adapters stay resident — the test/bench default).
+    # Smaller than the configured count turns on LRU staging: a request
+    # for an unstaged adapter pays a host->device copy unless
+    # pre_stage_weights hid it beforehand.
+    max_live_adapters: int = 0
 
     def __post_init__(self):
         if self.kv_head_layout != "blocked":
@@ -325,6 +349,31 @@ class EngineConfig:
                 f"kv_quant must be one of {KV_QUANT_MODES}, "
                 f"got {self.kv_quant!r}"
             )
+        if self.adapters:
+            # loud construction-time gates, matching the int8/MLA
+            # precedent: every incompatible lane fails HERE, not as a
+            # shape error mid-serve
+            if self.spec_gamma > 0:
+                raise ValueError(
+                    "adapters are incompatible with speculative decoding "
+                    "(spec_gamma > 0): verify_window has no LoRA lane yet"
+                )
+            if getattr(self.model, "is_mla", False):
+                raise ValueError(
+                    "adapters target the separate-QKV projection path; "
+                    "MLA models have no LoRA lane yet"
+                )
+            if self.decode_layer_scan:
+                raise ValueError(
+                    "adapters require the unrolled decode layer loop "
+                    "(decode_layer_scan=False): per-layer adapter stacks "
+                    "are sliced statically like the quantized-KV branch"
+                )
+            if self.ring_prefill_threshold > 0:
+                raise ValueError(
+                    "adapters are incompatible with ring prefill: the "
+                    "ring chunk path has no LoRA lane yet"
+                )
         self.max_blocks_per_seq = (
             self.max_context + self.block_size - 1
         ) // self.block_size
@@ -356,6 +405,13 @@ class _Sequence:
     generated: int = 0
     cached_prefix: int = 0  # tokens served from prefix cache
     slot: int = -1  # decode batch slot
+    # multi-LoRA lane: resolved adapter slot in the device stack (-1 =
+    # base model, no delta) and the public model name the request
+    # arrived under ("" = base). The name — not the slot — salts the
+    # block hash chain, so staging/eviction can reshuffle slots without
+    # moving any block out of its model's prefix namespace.
+    adapter_id: int = -1
+    model: str = ""
     finished: bool = False
     arrival_t: float = field(default_factory=time.monotonic)
     # request trace (tracing.TraceContext), captured at generate() entry
@@ -565,6 +621,23 @@ class JaxEngine(AsyncEngine):
         # (set before the first _use_pallas_for derivation below)
         self._kvq_dispatch_logged = False
         self.use_pallas = self._use_pallas_for(self.mesh)
+        # multi-LoRA lane (engine/adapters.py): registry of adapter A/B
+        # stacks. None when cfg.adapters is empty — every dispatch site
+        # below gates on that None, so base-only fleets run programs
+        # byte-identical to pre-multi-model builds.
+        self.adapters = None
+        if cfg.adapters:
+            if mirror is not None:
+                raise ValueError(
+                    "adapters are not supported under the multi-host "
+                    "mirror yet (lockstep dispatches carry no adapter "
+                    "stacks) — serve adapters on single-host workers"
+                )
+            from .adapters import AdapterRegistry
+
+            self.adapters = AdapterRegistry(
+                cfg.adapters, mcfg, max_live=cfg.max_live_adapters,
+            )
         self._waiting: asyncio.Queue[_Sequence] = asyncio.Queue(cfg.max_queue)
         # re-admissions (preemption replay, backpressure put-back) jump
         # the line through this explicit front buffer — consumers drain
@@ -635,6 +708,12 @@ class JaxEngine(AsyncEngine):
         # 0 = chosen-token logprob only, no alternates)
         self._logprob_ks = np.full(cfg.max_batch_size, -1, np.int32)
         self._window_logprobs = None
+        # per-slot adapter id (-1 = base); mirrors the device dispatch's
+        # adapter_ids operand exactly like _seeds/_temps mirror theirs
+        self._adapter_ids = np.full(cfg.max_batch_size, -1, np.int32)
+        # live-request refcount per adapter NAME: an adapter a running
+        # sequence depends on must never be LRU-evicted mid-stream
+        self._adapter_refs: dict[str, int] = {}
         # metrics
         self.stats = {
             "requests_total": 0,
@@ -655,9 +734,13 @@ class JaxEngine(AsyncEngine):
             # fleet prefix cache: blocks served to peers straight out of
             # the DEVICE tier (bounded d2h export on fetch)
             "peer_serve_d2h_blocks": 0,
-            # PRESERVE weight pre-stage requests resolved through the
-            # (no-op today) pre_stage_weights hook
+            # PRESERVE weight pre-stage lane (pre_stage_weights +
+            # on-demand staging in generate): requests, bytes actually
+            # copied host->device, and hits — a request that arrived to
+            # find its adapter already staged (the prestage did its job)
             "weight_prestage_requests": 0,
+            "weight_prestage_bytes": 0,
+            "weight_prestage_hits": 0,
             # elastic resharding: completed morphs, KV blocks re-laid by
             # the last morph's commit, and the last morph's client-
             # visible hold window (quiesce -> resume, weight staging
@@ -704,6 +787,11 @@ class JaxEngine(AsyncEngine):
             "restore_ms": Histogram(MS_BUCKETS),
             "handoff_ms": Histogram(MS_BUCKETS),
         }
+        # per-model TTFT distributions, lazily keyed by public model
+        # name ("" = base): the multi-model SLO plane trace-replay
+        # asserts against — measured arrival -> first emitted token,
+        # the engine-side component of the frontend's TTFT
+        self.hist_ttft: dict[str, Histogram] = {}
         # (kind, *bucket-shape) keys whose program has dispatched at
         # least once — the complement of "about to pay a compile stall"
         self._compiled_keys: set[tuple] = set()
@@ -953,14 +1041,46 @@ class JaxEngine(AsyncEngine):
                      f"{self.cfg.model.vocab_size})",
             )
             return
+        # multi-LoRA lane: resolve the request's model name to base
+        # (adapter_id -1) or a registered adapter. Fleets without
+        # --adapters skip all of this — any model name passes through
+        # untouched (legacy single-model behavior, the frontend already
+        # checked registration).
+        adapter_id, model_name = -1, ""
+        if self.adapters is not None and req.model:
+            base = self.cfg.served_model_name
+            if self.adapters.is_known(req.model):
+                try:
+                    adapter_id = self._claim_adapter(req.model)
+                except RuntimeError as e:
+                    yield LLMEngineOutput(
+                        finish_reason=FinishReason.ERROR, text=str(e)
+                    )
+                    return
+                model_name = req.model
+            elif base and req.model != base:
+                # same clean signature the frontend's 404 carries —
+                # worker-side requests (bench, direct dispatch) get the
+                # identical body instead of serving base-model tokens
+                # under an unknown name
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    text=f"unknown model {req.model!r}",
+                )
+                return
         seq = _Sequence(
             request=req,
             context=request.context,
             out_queue=asyncio.Queue(),
             tokens=list(req.token_ids),
             prompt_len=len(req.token_ids),
+            adapter_id=adapter_id,
+            model=model_name,
             trace=tracing.current_trace() if tracing.enabled() else None,
         )
+        # the chain's root is the model's salted namespace from the very
+        # first committed block (None for base = pre-multi-model bytes)
+        seq.parent_hash = model_hash_salt(model_name)
         resume = (
             req.annotations.get("resume")
             if isinstance(req.annotations, dict) else None
@@ -991,6 +1111,35 @@ class JaxEngine(AsyncEngine):
             yield out
             if out.is_final():
                 return
+
+    def _claim_adapter(self, name: str) -> int:
+        """Resolve an adapter request to its device-stack slot, staging
+        on demand (the cold-load stall ``pre_stage_weights`` exists to
+        hide) and pinning the adapter against LRU eviction for the
+        request's lifetime (released in ``_finish``)."""
+        reg = self.adapters
+        if reg.is_staged(name):
+            # the prestage (or a previous request) already paid the
+            # host->device copy — this is the hit the PRESERVE lane
+            # measures
+            self.stats["weight_prestage_hits"] += 1
+            slot = reg.slot_of(name)
+        else:
+            in_use = {n for n, c in self._adapter_refs.items() if c > 0}
+            slot, nbytes = reg.stage(name, in_use=in_use)
+            self.stats["weight_prestage_bytes"] += nbytes
+        self._adapter_refs[name] = self._adapter_refs.get(name, 0) + 1
+        return slot
+
+    def served_models(self) -> list[str]:
+        """Every public name this worker answers to: the base model
+        first ("" = any name, the legacy wildcard), then each configured
+        adapter. Advertised through load_metrics so ``select_worker``
+        filters on model identity before scoring."""
+        out = [self.cfg.served_model_name or ""]
+        if self.adapters is not None:
+            out.extend(self.adapters.names())
+        return out
 
     def _hbm_stats(self) -> dict:
         """TPU device-memory telemetry (docs/observability.md): real
@@ -1065,6 +1214,10 @@ class JaxEngine(AsyncEngine):
         out["hist_prefill_ms"] = self.hist["prefill_ms"].to_vec()
         out["hist_restore_ms"] = self.hist["restore_ms"].to_vec()
         out["hist_handoff_ms"] = self.hist["handoff_ms"].to_vec()
+        # per-model TTFT families keyed by public model name ("" = base)
+        out["hist_ttft_ms"] = {
+            m: h.to_vec() for m, h in self.hist_ttft.items()
+        }
         out["xla_compiles_total"] = self.stats["xla_compiles_total"]
         out["xla_compile_ms_total"] = round(
             self.stats["xla_compile_ms_total"], 3
@@ -1147,6 +1300,12 @@ class JaxEngine(AsyncEngine):
                 "peer_serve_d2h_blocks"],
             "weight_prestage_requests": self.stats[
                 "weight_prestage_requests"],
+            "weight_prestage_bytes": self.stats["weight_prestage_bytes"],
+            "weight_prestage_hits": self.stats["weight_prestage_hits"],
+            # multi-model surface: every name this worker answers to
+            # (base first, "" = legacy wildcard) — select_worker filters
+            # on membership before scoring
+            "served_models": self.served_models(),
             # int8-with-scales device-cache lane (zeros unless
             # kv_cache_dtype="int8"): resident quantized pages,
             # cumulative scale-growth requantizations, bytes the int8
@@ -1656,7 +1815,8 @@ class JaxEngine(AsyncEngine):
                 # the scheduler on a transfer; the chain is computed once
                 # and handed down so admission doesn't re-hash the prompt
                 prompt_hashes = sequence_block_hashes(
-                    seq.tokens[: seq.seq_len - 1], self.cfg.block_size
+                    seq.tokens[: seq.seq_len - 1], self.cfg.block_size,
+                    salt=model_hash_salt(seq.model),
                 )
                 await self._offload_prejoin(
                     [s for _l, s in prompt_hashes]
@@ -1767,8 +1927,16 @@ class JaxEngine(AsyncEngine):
         prompt = seq.tokens
         # ``hashes`` may carry the chain the caller already computed
         # (admission's prejoin) so long prompts hash once, not twice
+        # the adapter's name salts the chain root (allocator.
+        # model_hash_salt): a token-identical prompt under two models
+        # hashes to disjoint chains, so cross-model prefix hits are
+        # structurally impossible — here, in the reuse pool, and on
+        # every plane that speaks these hashes (radix index, peer pulls)
         all_hashes = hashes if hashes is not None else (
-            sequence_block_hashes(prompt[: len(prompt) - 1], bs)
+            sequence_block_hashes(
+                prompt[: len(prompt) - 1], bs,
+                salt=model_hash_salt(seq.model),
+            )
         )
         matched = self.allocator.match_prefix(
             prompt[: len(prompt) - 1], hashes=all_hashes
@@ -1808,7 +1976,12 @@ class JaxEngine(AsyncEngine):
             return None
         seq.blocks = matched + fresh
         seq.committed = len(matched)
-        seq.parent_hash = matched[-1].seq_hash if matched else None
+        # no device match: the chain restarts from its model-salted root
+        # (None for base traffic — byte-identical to pre-multi-model)
+        seq.parent_hash = (
+            matched[-1].seq_hash if matched
+            else model_hash_salt(seq.model)
+        )
         history = (len(matched) + len(restore_hashes)) * bs
         seq.cached_prefix = history
         upload = None
@@ -2098,6 +2271,39 @@ class JaxEngine(AsyncEngine):
         # bucket sizes are powers of two >= sp, so T % sp == 0 holds
         return _bucket(len(seq.tokens)) % self.mesh.shape["sp"] == 0
 
+    # ---- multi-LoRA dispatch plumbing ----
+    # With a registry configured, EVERY dispatch carries the full device
+    # stack + per-row adapter ids — base rows get exact +0.0 deltas
+    # (ops/lora.py) — so mixed-adapter and solo-adapter traffic run the
+    # SAME compiled programs and program counts key on the registry's
+    # (count, rank) buckets, never the live request mixture. Fleets
+    # without --adapters return {} and the programs are byte-identical
+    # to pre-multi-model builds.
+
+    def _lora_prefill_kw(self, adapter_id: int) -> dict:
+        if self.adapters is None:
+            return {}
+        return {
+            "lora": self.adapters.device_stack(),
+            "adapter_id": jnp.int32(adapter_id),
+        }
+
+    def _lora_decode_kw(self) -> dict:
+        if self.adapters is None:
+            return {}
+        return {
+            "lora": self.adapters.device_stack(),
+            "adapter_ids": jnp.asarray(self._adapter_ids),
+        }
+
+    def _lora_key(self) -> tuple:
+        """Compile-key suffix: the registry's static bucket pair (or
+        empty — base fleets keep their exact historical key tuples)."""
+        if self.adapters is None:
+            return ()
+        return (("lora", self.adapters.count_bucket,
+                 self.adapters.rank_bucket),)
+
     def _run_one_chunk(self, seq: _Sequence, pos: int):
         """One bucketed prefill chunk at ``pos``; returns (logits, new_pos)."""
         cfg = self.cfg
@@ -2137,8 +2343,10 @@ class JaxEngine(AsyncEngine):
                     use_ring=ring,
                     k_scales=self.k_scales,
                     v_scales=self.v_scales,
+                    **self._lora_prefill_kw(seq.adapter_id),
                 ),
-                key=("prefill", T, ring), trace=seq.trace,
+                key=("prefill", T, ring) + self._lora_key(),
+                trace=seq.trace,
             )
             (logits, self.k_cache, self.v_cache,
              self.k_scales, self.v_scales) = out
@@ -2157,8 +2365,10 @@ class JaxEngine(AsyncEngine):
                 use_pallas=self.use_pallas,
                 mesh=self.mesh,
                 use_ring=ring,
+                **self._lora_prefill_kw(seq.adapter_id),
             ),
-            key=("prefill", T, ring), trace=seq.trace,
+            key=("prefill", T, ring) + self._lora_key(),
+            trace=seq.trace,
         )
         return logits, pos + len(chunk)
 
@@ -2290,6 +2500,7 @@ class JaxEngine(AsyncEngine):
         self._logprob_ks[slot] = (
             min(so.logprobs, 20) if so.logprobs is not None else -1
         )
+        self._adapter_ids[slot] = seq.adapter_id
         if self._slot_has_penalty(slot):
             self._reset_penalty_slot(slot, seq)
 
@@ -2464,13 +2675,33 @@ class JaxEngine(AsyncEngine):
     async def pre_stage_weights(self, model: str) -> bool:
         """PRESERVE-style weight pre-stage hook, driven by the router's
         prefetch hint naming the model/adapter the routed request will
-        run. A single-model engine's weights are already resident, so
-        today this only counts the request — but the call path (hint →
-        listener → engine) is the one multi-model serving (ROADMAP
-        item 2) lands its real pre-stage on. Returns True when staging
-        work actually ran."""
+        run. With an adapter registry configured this stages the named
+        adapter's A/B stacks host->device BEFORE the request lands, so
+        its admission finds the weights resident (a prestage *hit*,
+        ``weight_prestage_hits``) instead of paying the cold-load copy
+        on its TTFT. Base-model names (and fleets without --adapters)
+        only count the request — the base weights are always resident.
+        Returns True when staging work actually ran."""
         self.stats["weight_prestage_requests"] += 1
-        return False
+        reg = self.adapters
+        if reg is None or not model or not reg.is_known(model):
+            return False
+        if reg.is_staged(model):
+            # LRU-touch so the hinted adapter survives until its request
+            reg.slot_of(model)
+            return False
+        faultpoints.hit_sync("weight_prestage", model=model)
+        in_use = {n for n, c in self._adapter_refs.items() if c > 0}
+        try:
+            _slot, nbytes = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: reg.stage(model, in_use=in_use)
+            )
+        except RuntimeError:
+            # every slot pinned by live requests — the request itself
+            # will retry (and likely hit the same wall, loudly)
+            return False
+        self.stats["weight_prestage_bytes"] += nbytes
+        return True
 
     def chain_coverage(self, chain: list[int]) -> int:
         """Longest prefix of chained hashes resident in ANY local tier
@@ -2649,7 +2880,7 @@ class JaxEngine(AsyncEngine):
         self.allocator.free(seq.blocks)
         seq.blocks = []
         seq.committed = 0
-        seq.parent_hash = None
+        seq.parent_hash = model_hash_salt(seq.model)
         seq.cached_prefix = 0
         # resume at the FRONT of the waiting queue: the whole token list
         # (prompt + generated so far) re-admits as a prefill whose final
@@ -3209,6 +3440,18 @@ class JaxEngine(AsyncEngine):
                 kwargs.update(
                     k_scales=self.k_scales, v_scales=self.v_scales
                 )
+            if self.adapters is not None:
+                # decode rows use the slot-mirrored ids; prefill
+                # segments carry their sequence's id (padded rows -1 =
+                # base = exact zero delta)
+                p_ids = np.full(MP, -1, np.int32)
+                for i, (st, _take) in enumerate(packed):
+                    p_ids[i] = st.seq.adapter_id
+                kwargs.update(
+                    lora=self.adapters.device_stack(),
+                    d_adapter_ids=jnp.asarray(self._adapter_ids),
+                    p_adapter_ids=jnp.asarray(p_ids),
+                )
             out = self._pallas_guard(lambda: llama.mixed_step(
                 self.params,
                 cfg.model,
@@ -3236,7 +3479,8 @@ class JaxEngine(AsyncEngine):
                 merged=cfg.decode_merged,
                 with_logprobs=want_lp,
                 **kwargs,
-            ), key=("mixed", MP, T, penalized, want_lp))
+            ), key=("mixed", MP, T, penalized, want_lp)
+                + self._lora_key())
             toks, p_logits, self.k_cache, self.v_cache = out[:4]
             rest = list(out[4:])
             if quantized:
@@ -3573,6 +3817,7 @@ class JaxEngine(AsyncEngine):
             merged=cfg.decode_merged,
             with_logprobs=want_lp,
         )
+        kw.update(self._lora_decode_kw())
         quantized = self.k_scales is not None
         if quantized:
             self._flush_scale_resets()
@@ -3585,12 +3830,12 @@ class JaxEngine(AsyncEngine):
                 rep_pens=jnp.asarray(self._rep_pens),
                 counts=self._pen_counts,
                 prompt_mask=self._pen_mask,
-            ), key=("decode", n, True, want_lp))
+            ), key=("decode", n, True, want_lp) + self._lora_key())
             penalized = True
         else:
             out = self._pallas_guard(lambda: llama.decode_window(
                 *args, **kw, use_pallas=self.use_pallas
-            ), key=("decode", n, False, want_lp))
+            ), key=("decode", n, False, want_lp) + self._lora_key())
             penalized = False
         toks, self.k_cache, self.v_cache = out[:3]
         rest = list(out[3:])
@@ -3617,6 +3862,12 @@ class JaxEngine(AsyncEngine):
         seq.tokens.append(token)
         seq.generated += 1
         self.stats["tokens_generated"] += 1
+        if seq.generated == 1:
+            # per-model TTFT family (the trace-replay assertion plane)
+            h = self.hist_ttft.get(seq.model)
+            if h is None:
+                h = self.hist_ttft[seq.model] = Histogram(MS_BUCKETS)
+            h.observe((time.monotonic() - seq.arrival_t) * 1000.0)
         if seq.trace is not None and seq.generated == 1:
             # first-token anchor for the TTFT decomposition; later tokens
             # pay only the seq.trace None-check above
@@ -3655,6 +3906,12 @@ class JaxEngine(AsyncEngine):
         if seq.finished:
             return
         seq.finished = True
+        if seq.model:
+            # release the adapter's eviction pin (idempotent via the
+            # finished flag above)
+            held = self._adapter_refs.get(seq.model, 0)
+            if held > 0:
+                self._adapter_refs[seq.model] = held - 1
         if emit:
             seq.out_queue.put_nowait(
                 LLMEngineOutput(
@@ -3675,6 +3932,7 @@ class JaxEngine(AsyncEngine):
             self._active[seq.slot] = None
             self._seq_lens[seq.slot] = 0
             self._block_tables[seq.slot] = 0
+            self._adapter_ids[seq.slot] = -1
             self._n_active -= 1
             seq.slot = -1
 
@@ -3708,6 +3966,24 @@ class JaxEngine(AsyncEngine):
         bs = self.cfg.block_size
         return (prompt_len + bs - 1) // bs
 
+    def _guard_remote_adapter(self, req: PreprocessedRequest) -> None:
+        """The disagg remote-prefill/decode paths have no adapter lane
+        yet (the KV wire carries no adapter identity, and a prefill
+        worker would silently compute BASE KV for an adapter prompt —
+        wrong tokens with no error). Reject loudly; the monolithic path
+        serves adapter traffic. Declared as a leftover in
+        docs/multi_model.md."""
+        if (
+            self.adapters is not None
+            and req.model
+            and self.adapters.is_known(req.model)
+        ):
+            raise RuntimeError(
+                f"adapter model {req.model!r} is not supported on the "
+                "remote prefill/decode paths yet — route adapter "
+                "traffic to monolithic workers"
+            )
+
     async def prefill_extract(
         self, req: PreprocessedRequest, context, skip_blocks: int = 0,
         keep_on_device: bool = False, timings: Optional[dict] = None,
@@ -3733,6 +4009,7 @@ class JaxEngine(AsyncEngine):
         hand over in-process to a differently-meshed engine)."""
         if self.mirror is not None:
             keep_on_device = False
+        self._guard_remote_adapter(req)
         prompt = list(req.token_ids)
         seq = _Sequence(
             request=req,
@@ -3802,6 +4079,7 @@ class JaxEngine(AsyncEngine):
         Returns (first_token, first_lp, blocks_emitted)."""
         if self.mirror is not None:
             keep_on_device = False
+        self._guard_remote_adapter(req)
         prompt = list(req.token_ids)
         seq = _Sequence(
             request=req,
@@ -3955,6 +4233,13 @@ class JaxEngine(AsyncEngine):
             # OOB ids: fall back to local serving, whose generate()
             # rejects them with the clean vocab-range error
             or not self._tokens_in_vocab(prompt)
+            # adapter traffic: fall back to local serving (the remote
+            # paths have no adapter lane — _guard_remote_adapter)
+            or (
+                self.adapters is not None
+                and req.model
+                and self.adapters.is_known(req.model)
+            )
         ):
             return None
         seq = _Sequence(

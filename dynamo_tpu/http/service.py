@@ -285,9 +285,12 @@ class HttpService(HttpServerBase):
         slo_class: Optional[str] = None
         if self.admission is not None:
             # overload gate: classify by nvext annotation (["slo:batch"])
-            # and admit/shed before any engine work is queued
+            # — falling back to the model's configured SLO pool
+            # (AdmissionGate.model_classes) — and admit/shed before any
+            # engine work is queued
             slo_class = self.admission.classify(
-                getattr(getattr(req, "nvext", None), "annotations", None)
+                getattr(getattr(req, "nvext", None), "annotations", None),
+                model=req.model,
             )
             decision = self.admission.admit(slo_class)
             if not decision.admitted:

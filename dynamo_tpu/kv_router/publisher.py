@@ -244,10 +244,12 @@ class KvPrefetchListener:
         """PRESERVE-style weight pre-stage: the hint named the model the
         routed request will run, so staging its weights can start before
         the request arrives — resolved through the engine's
-        ``pre_stage_weights`` hook (a stat-counted no-op today; the
-        multi-model work lands on this warm call path). Best-effort end
-        to end, with its own faultpoint so tests can prove a dead
-        pre-stage never takes the KV prefetch down with it."""
+        ``pre_stage_weights`` hook, which stages the adapter's A/B
+        stacks into a device slot (engine/adapters.py) so the request
+        lands on a warm adapter instead of paying the cold-load stall
+        inline. Best-effort end to end, with its own faultpoint so
+        tests can prove a dead pre-stage never takes the KV prefetch
+        down with it."""
         from ..resilience import faultpoints
 
         self.prestage_requests += 1

@@ -14,7 +14,7 @@ import logging
 from typing import AsyncIterator, Optional
 
 from .. import tracing
-from ..engine.allocator import sequence_block_hashes
+from ..engine.allocator import model_hash_salt, sequence_block_hashes
 from ..protocols.common import PreprocessedRequest
 from ..runtime.annotated import Annotated
 from ..runtime.component import Client, Component
@@ -107,17 +107,33 @@ class KvRouter:
             self.indexer.remove_worker(worker_id)
 
     async def schedule(
-        self, token_ids: list[int], avoid: frozenset = frozenset()
+        self, token_ids: list[int], avoid: frozenset = frozenset(),
+        model: str = "",
     ) -> tuple[int, int]:
-        """-> (worker_id, overlap_blocks). Raises AllWorkersBusy."""
-        pairs = sequence_block_hashes(token_ids, self.block_size)
+        """-> (worker_id, overlap_blocks). Raises AllWorkersBusy.
+
+        ``model`` names the requested adapter ("" = base): it salts the
+        block-hash chain into the model's namespace (the SAME chain the
+        worker's allocator builds, so overlap scoring stays honest and a
+        cross-model token-identical prompt scores ZERO overlap), narrows
+        selection to workers advertising the model, and rides the
+        prefetch hint so the worker pre-stages the adapter's weights."""
+        # the BASE model's own name must hash exactly like "" — workers
+        # resolve it to the unsalted base lane (engine.py generate), and
+        # pre-multi-model fleets whose requests carry the served name
+        # must keep their unsalted chains (no hash drift on upgrade)
+        salt_name = "" if model == (self.model_name or "") else model
+        pairs = sequence_block_hashes(
+            token_ids, self.block_size, salt=model_hash_salt(salt_name)
+        )
         hashes = [s for _l, s in pairs]
         overlaps = self.indexer.find_matches(hashes)
         # never scrape inline: the aggregator loop refreshes every interval;
         # an empty load set (cold start / all workers gone) raises
         # AllWorkersBusy and the caller falls back to round robin
         worker_id = self.scheduler.select_worker(
-            self.metrics.endpoints, overlaps, len(hashes), avoid=avoid
+            self.metrics.endpoints, overlaps, len(hashes), avoid=avoid,
+            model=model,
         )
         overlap = overlaps.scores.get(worker_id, 0)
         # admission hashes prompt[:-1] (the final token always recomputes
@@ -148,7 +164,9 @@ class KvRouter:
                 worker_id, pairs[:n_hint],
                 peer_worker_id=peer_id,
                 peer_blocks=peer_blocks,
-                model=self.model_name,
+                # the REQUEST's model wins (adapter prestage); the
+                # router-wide name is the single-model legacy fallback
+                model=model or self.model_name,
             )
         return worker_id, overlap
 
@@ -174,6 +192,11 @@ class KvRoutedEngine(AsyncEngine):
             if isinstance(data, PreprocessedRequest)
             else (data or {}).get("token_ids", [])
         )
+        model = (
+            data.model
+            if isinstance(data, PreprocessedRequest)
+            else (data or {}).get("model", "")
+        ) or ""
         payload = data.to_dict() if isinstance(data, PreprocessedRequest) else data
         worker_id: Optional[int] = None
         # workers a migrating request already failed on (resilience/
@@ -188,7 +211,9 @@ class KvRoutedEngine(AsyncEngine):
         # even on the fallback paths (the time was spent either way)
         with tracing.span("router.schedule", request_id=request.id) as rt_span:
             try:
-                worker_id, overlap = await self.router.schedule(token_ids, avoid=avoid)
+                worker_id, overlap = await self.router.schedule(
+                    token_ids, avoid=avoid, model=model
+                )
                 rt_span.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
             except AllWorkersBusy:
                 rt_span.set(fallback="round_robin")

@@ -166,6 +166,24 @@ class WorkerLoad:
     ici_handoffs: int = 0
     peer_serve_d2h_blocks: int = 0
     weight_prestage_requests: int = 0
+    # multi-model serving surface (engine.served_models / adapter
+    # registry): the model names this worker can serve — "" is the base
+    # model. An EMPTY tuple means the worker predates the advertisement
+    # (or serves base only) and is treated as a wildcard so legacy
+    # fleets keep routing unchanged; select_worker filters on this
+    # BEFORE scoring, because no cost model makes an adapter-less
+    # worker serve an adapter
+    models: tuple = ()
+    # adapter-prestage effectiveness: bytes of adapter weights staged
+    # ahead of traffic via prefetch hints, and requests that found
+    # their adapter already resident (the stall the prestage hid)
+    prestage_bytes: int = 0
+    prestage_hits: int = 0
+    # per-model TTFT distributions (hist_ttft_ms: model name -> to_vec
+    # bucket vector, "" = base): the metrics component renders these as
+    # model-labelled histogram families and trace replay asserts
+    # per-model p99 SLOs from them
+    model_hists: dict = field(default_factory=dict)
     # SLO observatory (docs/observability.md): worker-side latency
     # distributions as serialized histogram bucket vectors
     # (observability/hist.py to_vec form, keyed queue_wait_ms /
@@ -263,6 +281,10 @@ class WorkerLoad:
             ici_handoffs=d.get("ici_handoffs", 0),
             peer_serve_d2h_blocks=d.get("peer_serve_d2h_blocks_total", 0),
             weight_prestage_requests=d.get("weight_prestage_requests", 0),
+            models=tuple(d.get("served_models") or ()),
+            prestage_bytes=d.get("weight_prestage_bytes", 0),
+            prestage_hits=d.get("weight_prestage_hits", 0),
+            model_hists=dict(d.get("hist_ttft_ms") or {}),
             hists={
                 name: vec
                 for name, vec in (
@@ -283,6 +305,20 @@ class WorkerLoad:
             hbm_weights_bytes=d.get("hbm_weights_bytes", 0),
             ts=ts,
         )
+
+    def serves(self, model: str) -> bool:
+        """Can this worker serve ``model``? ``""`` (base traffic) is
+        always servable; a worker advertising no model list is a legacy
+        wildcard (pre-multi-model producer — routing must not strand
+        it), and so is one whose advertisement CONTAINS ``""`` (a
+        single-model engine with no configured served name accepts any
+        name — the legacy contract); otherwise the name must be in the
+        advertisement."""
+        if not model:
+            return True
+        if not self.models:
+            return True
+        return "" in self.models or model in self.models
 
     @property
     def wire_bytes_per_block(self) -> int:
@@ -394,10 +430,20 @@ class KvScheduler:
         overlaps: OverlapScores,
         isl_blocks: int,
         avoid: frozenset = frozenset(),
+        model: str = "",
     ) -> int:
         loads = [l for l in endpoints.loads]
         if not loads:
             raise AllWorkersBusy("no workers")
+        if model:
+            # model filter comes BEFORE every score: a worker without
+            # the adapter can't serve the request at any cost, and the
+            # hard exclusion must not soften into the avoid/watermark
+            # fallbacks below. Distinct error text — "no worker serves
+            # this model" is a deployment gap, not transient pressure
+            loads = [l for l in loads if l.serves(model)]
+            if not loads:
+                raise AllWorkersBusy(f"no worker serves model {model!r}")
         if self.cfg.load_ttl_s > 0:
             now = self._clock()
             fresh = [

@@ -254,7 +254,30 @@ def mesh_config(args):
     return mc if mc.num_devices > 1 else None
 
 
-def engine_config(args, cfg: ModelConfig) -> EngineConfig:
+def _adapter_specs(args) -> tuple:
+    """``--adapters`` comma list -> spec-string tuple (engine/adapters.py
+    parses the ``name:rank[:seed]`` / ``name=/path.npz`` forms)."""
+    raw = getattr(args, "adapters", None) or ""
+    return tuple(s.strip() for s in raw.split(",") if s.strip())
+
+
+def _adapter_names(args) -> list[str]:
+    """Adapter model names a worker/frontend must answer to (validated
+    through the same parser the engine's registry uses, so a bad spec
+    dies at launch, not at first request)."""
+    specs = _adapter_specs(args)
+    if not specs:
+        return []
+    from ..engine.adapters import parse_adapter_specs
+
+    try:
+        return [s.name for s in parse_adapter_specs(specs)]
+    except ValueError as e:
+        raise SystemExit(f"bad --adapters: {e}") from None
+
+
+def engine_config(args, cfg: ModelConfig, served_name: str = "") -> EngineConfig:
+    adapters = _adapter_specs(args)
     return EngineConfig(
         model=cfg,
         num_blocks=args.num_blocks,
@@ -277,10 +300,17 @@ def engine_config(args, cfg: ModelConfig) -> EngineConfig:
         mixed_step_budget=args.mixed_step_budget,
         mixed_max_prefills=args.mixed_max_prefills,
         kv_cost_model=getattr(args, "kv_cost_model", True),
+        adapters=adapters,
+        # the base model keeps its legacy "" wildcard unless adapters
+        # are in play — a single-model worker's load_metrics / request
+        # resolution stay byte-identical to pre-multi-model fleets
+        served_model_name=served_name if adapters else "",
+        max_live_adapters=getattr(args, "max_live_adapters", 0),
     )
 
 
-def build_core_engine(args, cfg: ModelConfig, params, mirror=None) -> AsyncEngine:
+def build_core_engine(args, cfg: ModelConfig, params, mirror=None,
+                      served_name: str = "") -> AsyncEngine:
     if args.out == "echo":
         return EchoEngine()
     if args.out.startswith(("pystr:", "pytok:")):
@@ -294,7 +324,8 @@ def build_core_engine(args, cfg: ModelConfig, params, mirror=None) -> AsyncEngin
         engine.text_mode = text_mode
         return engine
     if args.out == "jax":
-        return JaxEngine(engine_config(args, cfg), params=params, mirror=mirror)
+        return JaxEngine(engine_config(args, cfg, served_name=served_name),
+                         params=params, mirror=mirror)
     raise SystemExit(f"unknown out= engine {args.out!r}")
 
 
@@ -375,9 +406,21 @@ def _build_admission(args):
         return None
     from ..planner import AdmissionGate
 
+    model_classes: dict = {}
+    for part in (getattr(args, "model_slo", None) or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m, sep, c = part.partition("=")
+        if not sep or not m.strip() or not c.strip():
+            raise SystemExit(
+                f"bad --model-slo entry {part!r} (want model=class)"
+            )
+        model_classes[m.strip()] = c.strip()
     return AdmissionGate(
         args.admission_rate,
         burst=args.admission_burst if args.admission_burst > 0 else None,
+        model_classes=model_classes or None,
     )
 
 
@@ -428,6 +471,12 @@ async def run_http(args) -> None:
         )
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
+        # adapter names route through the same KV-routed pipeline — the
+        # request's model rides PreprocessedRequest.model into the
+        # router (hash salting + worker filtering) and the worker
+        for aname in _adapter_names(args):
+            manager.add_chat_model(aname, engine)
+            manager.add_completion_model(aname, engine)
         # wildcard, not pinned to `comp`: disagg prefill workers export
         # on their own {ns}.prefill.trace-events subject and their spans
         # must land in the same timelines as the decode workers'
@@ -450,11 +499,17 @@ async def run_http(args) -> None:
             svc.attach_flight(flight)
     else:
         cfg, params, tokenizer, name = build_model(args)
-        core = build_core_engine(args, cfg, params)
+        core = build_core_engine(args, cfg, params, served_name=name)
         await maybe_warmup(args, core)
         engine = OpenAIWorkerEngine(tokenizer, core)
         manager.add_chat_model(name, engine)
         manager.add_completion_model(name, engine)
+        # every adapter is a first-class model name: /v1/models lists
+        # it, requests resolve through the same engine (which maps the
+        # name to its adapter slot), unknown names keep the clean 404
+        for aname in _adapter_names(args):
+            manager.add_chat_model(aname, engine)
+            manager.add_completion_model(aname, engine)
         # single process: local spans feed the collector directly
         svc.tracing = await setup_tracing(args, "frontend", collector=True)
         flight = _build_flight(
@@ -523,7 +578,8 @@ async def run_endpoint(args) -> None:
     cfg, params, tokenizer, name = build_model(args)
     if mh.enabled:
         mirror = multihost.StepMirror(multihost.global_mesh(mcfg_mesh), cfg)
-    core = build_core_engine(args, cfg, params, mirror=mirror)
+    core = build_core_engine(args, cfg, params, mirror=mirror,
+                             served_name=name)
     jax_core = core if isinstance(core, JaxEngine) else None
     await maybe_warmup(args, core)
     drt = await connect_runtime(args)
@@ -614,6 +670,14 @@ async def run_endpoint(args) -> None:
         drt, ModelEntry(name=name, namespace=ns, component=comp, endpoint=ep,
                         model_type="both"),
     )
+    # each adapter registers as its own discoverable model at the SAME
+    # endpoint: discovery frontends list it and route its requests here,
+    # where the engine resolves the name to its adapter slot
+    for aname in _adapter_names(args):
+        await register_model(
+            drt, ModelEntry(name=aname, namespace=ns, component=comp,
+                            endpoint=ep, model_type="both"),
+        )
     card = ModelDeploymentCard(
         display_name=name, service_name=name, model_path=args.model_path or "",
         context_length=cfg.max_position_embeddings, kv_block_size=args.block_size,
@@ -1032,6 +1096,21 @@ def main(argv=None) -> None:
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="speculative decoding: lookup n-gram length")
     p.add_argument("--max-context", type=int, default=0)
+    p.add_argument("--adapters", default=None,
+                   help="comma-separated LoRA adapters served next to the "
+                        "base model: name:rank[:seed] (seeded synthetic "
+                        "weights) or name=/path.npz (stacked A/B arrays); "
+                        "each name becomes a served model "
+                        "(docs/multi_model.md)")
+    p.add_argument("--max-live-adapters", type=int, default=0,
+                   help="max adapters resident in the device stack at once "
+                        "(0 = all configured adapters stay resident); "
+                        "smaller turns on LRU staging + weight pre-stage")
+    p.add_argument("--model-slo", default=None,
+                   help="per-model admission SLO classes, "
+                        "model=class[,model=class...] — routes a model's "
+                        "traffic into that class's token-bucket pool "
+                        "(requires --admission-rate)")
     p.add_argument("--namespace", default="dynamo",
                    help="in=prefill queue namespace — must match the decode "
                         "workers' dyn:// namespace")

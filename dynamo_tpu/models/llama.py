@@ -804,10 +804,23 @@ def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     return att.softcap((x @ head).astype(jnp.float32), cfg.final_softcap)
 
 
-def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
+def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray, lora_l=None,
+         lora_ids=None, lora_grouped: bool = False):
     q = _mm(x, lp["wq"])
     k = _mm(x, lp["wk"])
     v = _mm(x, lp["wv"])
+    if lora_l is not None:
+        # per-row LoRA deltas land on the FLAT projections, before bias
+        # and qk-norm (norms see base+delta exactly as a merged-weight
+        # forward would); rows with id -1 get an exact +0.0
+        from ..ops.lora import lora_delta
+
+        q = q + lora_delta(x, lora_l["qa"], lora_l["qb"], lora_ids,
+                           lora_grouped)
+        k = k + lora_delta(x, lora_l["ka"], lora_l["kb"], lora_ids,
+                           lora_grouped)
+        v = v + lora_delta(x, lora_l["va"], lora_l["vb"], lora_ids,
+                           lora_grouped)
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     if cfg.qk_norm_full:  # olmo-2: norm the FLAT projection pre-reshape
@@ -825,6 +838,19 @@ def _qkv(lp: dict, cfg: ModelConfig, x: jnp.ndarray):
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
+
+
+def _wo_proj(lp: dict, o_flat: jnp.ndarray, lora_l=None, lora_ids=None,
+             lora_grouped: bool = False) -> jnp.ndarray:
+    """Attention output projection (+ optional per-row LoRA delta on the
+    flat [R, H*D] rows, mirroring ``_qkv``'s q/k/v deltas)."""
+    p = _mm_b(o_flat, lp, "wo", "bo")
+    if lora_l is not None:
+        from ..ops.lora import lora_delta
+
+        p = p + lora_delta(o_flat, lora_l["oa"], lora_l["ob"], lora_ids,
+                           lora_grouped)
+    return p
 
 
 # ---------------- prefill (one sequence, chunked) ----------------
@@ -853,6 +879,12 @@ def prefill(
     # (logits, k_cache, v_cache, k_scales, v_scales).
     k_scales: Optional[jnp.ndarray] = None,
     v_scales: Optional[jnp.ndarray] = None,
+    # multi-LoRA lane: stacked {qa,qb,ka,kb,va,vb,oa,ob} [L, NA, ...]
+    # adapter pytree + this sequence's adapter slot (scalar int32; -1 =
+    # base model — the deltas are then an exact +0.0). The return shape
+    # is unchanged; lora forces the unrolled layer loop.
+    lora=None,
+    adapter_id: Optional[jnp.ndarray] = None,
 ):
     """Process one (chunk of a) prompt; returns (last_hidden_logits, caches).
 
@@ -881,7 +913,14 @@ def prefill(
         # movers don't carry planes), no ring (ring writes full-width),
         # no MLA (the engine gates MLA+int8 loudly at init)
         assert not use_ring and not cfg.is_mla
-    if mesh is not None and not use_ring and not quantized:
+    if lora is not None:
+        # adapters slice per layer (unrolled loop), don't ride the
+        # staged pipeline, and MLA/ring are gated at engine init
+        assert not use_ring and not cfg.is_mla
+        lora_ids = jnp.full((tokens.shape[0],), adapter_id, jnp.int32)
+    else:
+        lora_ids = None
+    if mesh is not None and not use_ring and not quantized and lora is None:
         from ..parallel.pp import can_pipeline, pick_n_micro, pipelined_prefill
 
         n_micro = pick_n_micro(mesh, tokens.shape[0])
@@ -910,7 +949,7 @@ def prefill(
     inv_local = _rope_freqs_local(cfg)
 
     def body(carry, layer_in, window=cfg.sliding_window, freqs=None,
-             scales=None):
+             scales=None, lora_l=None):
         x = carry
         lp, kc, vc = layer_in
         h = pre_norm(lp, "attn_norm", x, cfg)
@@ -958,7 +997,7 @@ def prefill(
             o = mla._o_proj(lp, cfg, out_lat).astype(x.dtype)
             x = x + _mm(o, lp["wo"])
         else:
-            q, k, v = _qkv(lp, cfg, h)
+            q, k, v = _qkv(lp, cfg, h, lora_l, lora_ids)
             fr = inv_freq if freqs is None else freqs
             q = apply_rope(q, positions, fr, rope_msc)
             k = apply_rope(k, positions, fr, rope_msc)
@@ -993,7 +1032,7 @@ def prefill(
                 )
             x = x + post_norm(
                 lp, "attn_post_norm",
-                _mm_b(o.reshape(T, -1), lp, "wo", "bo"), cfg,
+                _wo_proj(lp, o.reshape(T, -1), lora_l, lora_ids), cfg,
             )
         h = pre_norm(lp, "mlp_norm", x, cfg)
         x = x + post_norm(
@@ -1003,6 +1042,12 @@ def prefill(
         if scales is not None:
             return x, (kc, vc, ks_l, vs_l)
         return x, (kc, vc)
+
+    def lora_for_layer(l):
+        return (
+            None if lora is None
+            else jax.tree.map(lambda arr: arr[l], lora)
+        )
 
     if quantized:
         # per-layer scale-plane slices must thread through every write,
@@ -1017,15 +1062,18 @@ def prefill(
                     window=window_for_layer(cfg, l),
                     freqs=rope_freqs_for_layer(cfg, l, inv_freq, inv_local),
                     scales=(k_scales[l], v_scales[l]),
+                    lora_l=lora_for_layer(l),
                 )
                 k_cache = k_cache.at[l].set(kc_l)
                 v_cache = v_cache.at[l].set(vc_l)
                 k_scales = k_scales.at[l].set(ks_l)
                 v_scales = v_scales.at[l].set(vs_l)
-    elif cfg.layer_windows:
+    elif cfg.layer_windows or lora is not None:
         # heterogeneous attention (gpt-oss alternating sliding/full):
         # the window width is trace-static PER LAYER, so the layer loop
-        # unrolls — a lax.scan body cannot carry a per-layer mask shape
+        # unrolls — a lax.scan body cannot carry a per-layer mask shape.
+        # LoRA rides the same unrolled loop: adapter stacks slice per
+        # layer with a static index (quantized-KV precedent).
         for lps, n, off in layer_groups(params, cfg):
             for li in range(n):
                 l = off + li
@@ -1034,6 +1082,7 @@ def prefill(
                     x, (lp, k_cache[l], v_cache[l]),
                     window=window_for_layer(cfg, l),
                     freqs=rope_freqs_for_layer(cfg, l, inv_freq, inv_local),
+                    lora_l=lora_for_layer(l),
                 )
                 k_cache = k_cache.at[l].set(kc_l)
                 v_cache = v_cache.at[l].set(vc_l)
@@ -1056,7 +1105,7 @@ def prefill(
 def _decode_body(
     params, cfg, tokens, positions, block_tables, seq_lens,
     k_cache, v_cache, use_pallas, mesh=None, unroll=True, interpret=False,
-    merged=True, k_scales=None, v_scales=None,
+    merged=True, k_scales=None, v_scales=None, lora=None, adapter_ids=None,
 ):
     """Shared un-jitted decode forward (one token per sequence).
 
@@ -1084,6 +1133,14 @@ def _decode_body(
                              "decode (decode_layer_scan cannot carry "
                              "per-layer plane scatters in place)")
         k_scales0, v_scales0 = k_scales, v_scales
+    if lora is not None:
+        if cfg.is_mla:
+            raise ValueError("LoRA adapters: MLA is gated at engine init "
+                             "(deltas attach to the GQA projections)")
+        if not unroll:
+            raise ValueError("LoRA adapters need the unrolled decode "
+                             "(decode_layer_scan cannot slice per-layer "
+                             "adapter stacks)")
     B = tokens.shape[0]
     x = _embed(params, cfg, tokens)  # [B, E]
     if cfg.is_mla:
@@ -1097,9 +1154,10 @@ def _decode_body(
         rope_msc = _rope_attention_scaling(cfg)
         scale = attn_query_scale(cfg)
 
-    def layer_tail(x, lp, o):
+    def layer_tail(x, lp, o, lora_l=None):
         x = x + post_norm(
-            lp, "attn_post_norm", _mm_b(o.reshape(B, -1), lp, "wo", "bo"), cfg
+            lp, "attn_post_norm",
+            _wo_proj(lp, o.reshape(B, -1), lora_l, adapter_ids), cfg,
         )
         h = pre_norm(lp, "mlp_norm", x, cfg)
         return x + post_norm(
@@ -1110,13 +1168,20 @@ def _decode_body(
 
     inv_local_dec = _rope_freqs_local(cfg)
 
-    def layer_qkv(x, lp, freqs=None):
+    def layer_qkv(x, lp, freqs=None, lora_l=None):
         h = pre_norm(lp, "attn_norm", x, cfg)
-        q, k, v = _qkv(lp, cfg, h)  # q: [B, H, D], k/v: [B, Hkv, D]
+        # q: [B, H, D], k/v: [B, Hkv, D]
+        q, k, v = _qkv(lp, cfg, h, lora_l, adapter_ids)
         fr = inv_freq if freqs is None else freqs
         q = apply_rope(q, positions, fr, rope_msc)
         k = apply_rope(k, positions, fr, rope_msc)
         return q, k, v
+
+    def lora_for_layer(l):
+        return (
+            None if lora is None
+            else jax.tree.map(lambda arr: arr[l], lora)
+        )
 
     def mla_layer(x, lp, kc_l, vc_l):
         """One MLA decode layer against full cache layers kc_l/vc_l:
@@ -1252,8 +1317,11 @@ def _decode_body(
             for li in range(n):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
+                lora_l = lora_for_layer(l)
                 q, k, v = layer_qkv(
-                    x, lp, rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec)
+                    x, lp,
+                    rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec),
+                    lora_l=lora_l,
                 )
                 k_news.append(k)
                 v_news.append(v)
@@ -1277,7 +1345,7 @@ def _decode_body(
                         sinks=lp.get("sinks"), interpret=interpret,
                         k_scales=ks_l, v_scales=vs_l,
                     )
-                x = layer_tail(x, lp, o)
+                x = layer_tail(x, lp, o, lora_l=lora_l)
         k_new, v_new = jnp.stack(k_news), jnp.stack(v_news)
         if quantized:
             if mesh is None:
@@ -1309,8 +1377,11 @@ def _decode_body(
             for li in range(n):
                 l = goff + li
                 lp = jax.tree.map(lambda a: a[li], lps)
+                lora_l = lora_for_layer(l)
                 q, k, v = layer_qkv(
-                    x, lp, rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec)
+                    x, lp,
+                    rope_freqs_for_layer(cfg, l, inv_freq, inv_local_dec),
+                    lora_l=lora_l,
                 )
                 ks_l = vs_l = None
                 if quantized:
@@ -1343,7 +1414,7 @@ def _decode_body(
                     cap=cfg.attn_softcap,
                     k_scales=ks_l, v_scales=vs_l,
                 )
-                x = layer_tail(x, lp, o)
+                x = layer_tail(x, lp, o, lora_l=lora_l)
     else:
         if cfg.layer_windows:
             raise ValueError(
@@ -1402,6 +1473,8 @@ def decode_step(
     merged: bool = True,
     k_scales: Optional[jnp.ndarray] = None,  # [L, N] f32, NOT donated
     v_scales: Optional[jnp.ndarray] = None,
+    lora=None,                                # stacked adapter pytree
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32; -1 = base
 ):
     """One continuous-batching decode step for all active sequences.
 
@@ -1413,7 +1486,8 @@ def decode_step(
     return _decode_body(
         params, cfg, tokens, positions, block_tables, seq_lens,
         k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
-        k_scales=k_scales, v_scales=v_scales,
+        k_scales=k_scales, v_scales=v_scales, lora=lora,
+        adapter_ids=adapter_ids,
     )
 
 
@@ -1456,6 +1530,9 @@ def decode_window(
     # (k_scales, v_scales, n_requants) right after v_cache
     k_scales: Optional[jnp.ndarray] = None,
     v_scales: Optional[jnp.ndarray] = None,
+    # multi-LoRA: step-invariant (closure constants, not scan carry)
+    lora=None,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32; -1 = base
 ):
     """``n_steps`` fused decode+sample steps in ONE dispatch (lax.scan):
     the sampled token of step i feeds step i+1 entirely on device, so the
@@ -1487,13 +1564,15 @@ def decode_window(
             logits, k_cache, v_cache, ks, vs, nr = _decode_body(
                 params, cfg, tokens, positions, block_tables, seq_lens,
                 k_cache, v_cache, use_pallas, mesh, unroll, interpret,
-                merged, k_scales=ks, v_scales=vs,
+                merged, k_scales=ks, v_scales=vs, lora=lora,
+                adapter_ids=adapter_ids,
             )
             nreq = nreq + nr
         else:
             logits, k_cache, v_cache = _decode_body(
                 params, cfg, tokens, positions, block_tables, seq_lens,
-                k_cache, v_cache, use_pallas, mesh, unroll, interpret, merged,
+                k_cache, v_cache, use_pallas, mesh, unroll, interpret,
+                merged, lora=lora, adapter_ids=adapter_ids,
             )
         raw_logits = logits  # reported logprobs are the model's own dist
         if penalized:
@@ -1535,6 +1614,7 @@ def _mixed_fused_forward(
     params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
     p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
     mesh=None, interpret=False, k_scales=None, v_scales=None,
+    lora=None, d_adapter_ids=None, p_adapter_ids=None,
 ):
     """The FULLY-fused mixed forward (TPU/Pallas path): embeddings and
     every projection/FFN/logits GEMM run over the combined [B + MP*T]
@@ -1571,10 +1651,24 @@ def _mixed_fused_forward(
     rope_msc = _rope_attention_scaling(cfg)
     scale = attn_query_scale(cfg)
     inv_local = _rope_freqs_local(cfg)
+    if lora is not None:
+        # one adapter-id per combined row: decode rows carry theirs,
+        # every row of segment m carries segment m's. The deltas run
+        # GROUPED here — rows stable-sorted by adapter, two ragged-dot
+        # passes (the MoE grouped-GMM shape) — so a batch mixing k
+        # adapters costs one low-rank dispatch, not k (ops/lora.py).
+        ids_all = jnp.concatenate(
+            [d_adapter_ids.astype(jnp.int32),
+             jnp.repeat(p_adapter_ids.astype(jnp.int32), T)]
+        )
+    else:
+        ids_all = None
 
-    def layer_tail(x, lp, o_flat):
-        x = x + post_norm(lp, "attn_post_norm",
-                          _mm_b(o_flat, lp, "wo", "bo"), cfg)
+    def layer_tail(x, lp, o_flat, lora_l=None):
+        x = x + post_norm(
+            lp, "attn_post_norm",
+            _wo_proj(lp, o_flat, lora_l, ids_all, lora_grouped=True), cfg,
+        )
         h = pre_norm(lp, "mlp_norm", x, cfg)
         return x + post_norm(
             lp, "mlp_post_norm",
@@ -1588,10 +1682,15 @@ def _mixed_fused_forward(
         for li in range(n):
             l = goff + li
             lp = jax.tree.map(lambda a: a[li], lps)
+            lora_l = (
+                None if lora is None
+                else jax.tree.map(lambda arr: arr[l], lora)
+            )
             h = pre_norm(lp, "attn_norm", x, cfg)
             w = window_for_layer(cfg, l)
             kc_l, vc_l = k_cache[l], v_cache[l]
-            q, k, v = _qkv(lp, cfg, h)  # [B+MP*T, H/Hkv, D]
+            # [B+MP*T, H/Hkv, D]
+            q, k, v = _qkv(lp, cfg, h, lora_l, ids_all, lora_grouped=True)
             fr = rope_freqs_for_layer(cfg, l, inv_freq, inv_local)
             q = apply_rope(q, positions_all, fr, rope_msc)
             k = apply_rope(k, positions_all, fr, rope_msc)
@@ -1657,7 +1756,7 @@ def _mixed_fused_forward(
             o = jnp.concatenate(
                 [o_dec.reshape(B, -1), o_chunks.reshape(MP * T, -1)]
             )
-            x = layer_tail(x, lp, o)
+            x = layer_tail(x, lp, o, lora_l=lora_l)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits_d = _logits(params, cfg, x[:B])  # [B, V] f32
     # each segment's last REAL row only (the unfused prefill computes
@@ -1713,6 +1812,14 @@ def mixed_step(
     # output grows by (k_scales, v_scales, n_requants) after v_cache
     k_scales: Optional[jnp.ndarray] = None,
     v_scales: Optional[jnp.ndarray] = None,
+    # multi-LoRA lane: stacked adapter pytree + per-row slot ids
+    # (-1 = base). The Pallas flavor runs GROUPED deltas over the
+    # combined rows; the XLA flavor threads the same lora through the
+    # unfused prefill/decode calls (per-adapter loop — bit-identical
+    # to solo dispatch). Output shape unchanged.
+    lora=None,
+    d_adapter_ids: Optional[jnp.ndarray] = None,  # [B] int32
+    p_adapter_ids: Optional[jnp.ndarray] = None,  # [MP] int32
 ):
     """ONE device dispatch fusing M prefill chunks into a decode step.
 
@@ -1775,14 +1882,17 @@ def mixed_step(
                     params, cfg, d_tokens, d_positions, d_tables,
                     d_seq_lens, p_tokens, p_tables, p_hists, p_valids,
                     k_cache, v_cache, mesh=mesh, interpret=interpret,
-                    k_scales=k_scales, v_scales=v_scales,
+                    k_scales=k_scales, v_scales=v_scales, lora=lora,
+                    d_adapter_ids=d_adapter_ids,
+                    p_adapter_ids=p_adapter_ids,
                 )
             )
         else:
             logits_d, p_logits, k_cache, v_cache = _mixed_fused_forward(
                 params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
                 p_tokens, p_tables, p_hists, p_valids, k_cache, v_cache,
-                mesh=mesh, interpret=interpret,
+                mesh=mesh, interpret=interpret, lora=lora,
+                d_adapter_ids=d_adapter_ids, p_adapter_ids=p_adapter_ids,
             )
     else:
         # chunks first (admission order), then decode — order is
@@ -1790,6 +1900,7 @@ def mixed_step(
         # admission-then-decode order of the alternating scheduler
         p_logit_rows = []
         for m in range(MP):
+            aid = None if lora is None else p_adapter_ids[m]
             if quantized:
                 lg, k_cache, v_cache, k_scales, v_scales = (
                     prefill.__wrapped__(
@@ -1797,13 +1908,14 @@ def mixed_step(
                         p_valids[m], k_cache, v_cache,
                         use_pallas=use_pallas, mesh=mesh,
                         k_scales=k_scales, v_scales=v_scales,
+                        lora=lora, adapter_id=aid,
                     )
                 )
             else:
                 lg, k_cache, v_cache = prefill.__wrapped__(
                     params, cfg, p_tokens[m], p_tables[m], p_hists[m],
                     p_valids[m], k_cache, v_cache, use_pallas=use_pallas,
-                    mesh=mesh,
+                    mesh=mesh, lora=lora, adapter_id=aid,
                 )
             p_logit_rows.append(lg)
         p_logits = jnp.stack(p_logit_rows)  # [MP, V]
@@ -1812,12 +1924,13 @@ def mixed_step(
                 params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
                 k_cache, v_cache, use_pallas, mesh, unroll, interpret,
                 merged, k_scales=k_scales, v_scales=v_scales,
+                lora=lora, adapter_ids=d_adapter_ids,
             )
         else:
             logits_d, k_cache, v_cache = _decode_body(
                 params, cfg, d_tokens, d_positions, d_tables, d_seq_lens,
                 k_cache, v_cache, use_pallas, mesh, unroll, interpret,
-                merged,
+                merged, lora=lora, adapter_ids=d_adapter_ids,
             )
 
     raw_logits = logits_d

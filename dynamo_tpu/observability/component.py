@@ -42,6 +42,10 @@ WORKER_HIST_FAMILIES = (
     "worker_restore_ms", "worker_handoff_ms",
     "fleet_queue_wait_ms", "fleet_prefill_ms",
     "fleet_restore_ms", "fleet_handoff_ms",
+    # per-model TTFT (multi-model serving): model-labelled families fed
+    # from WorkerLoad.model_hists ("" = the base model) — trace replay's
+    # per-model p99 assertions read the fleet merge of these
+    "worker_ttft_ms", "fleet_ttft_ms",
 )
 
 
@@ -204,6 +208,10 @@ class MetricsComponent:
         # merged bucket vectors (exact — histogram merge is vector
         # addition), one family per component, plus per-worker rows
         fleet: dict[str, Histogram] = {}
+        # per-model TTFT rollup (model name -> merged histogram) — the
+        # model dimension stays a LABEL, not a family, so dashboards
+        # query one family across any adapter census
+        fleet_ttft: dict[str, Histogram] = {}
         for w in ep.loads:
             lb = f'worker="{w.worker_id:x}"'
             gauge("kv_blocks_active", w.kv_active_blocks, lb)
@@ -323,6 +331,21 @@ class MetricsComponent:
                 "weight_prestage_requests_total",
                 w.weight_prestage_requests, lb,
             )
+            # multi-model lane (docs/multi_model.md): adapter-weight
+            # bytes staged ahead of traffic via prefetch hints, the
+            # requests that found their adapter already resident, and
+            # one serves_model row per advertised NAMED model — the
+            # same advertisement select_worker filters on. A worker
+            # advertising only "" (single-model fleet, the legacy
+            # wildcard) renders no per-model rows at all: upgrading a
+            # fleet that never configured --adapters must not change
+            # its metric families
+            gauge("weight_prestage_bytes_total", w.prestage_bytes, lb)
+            gauge("weight_prestage_hits_total", w.prestage_hits, lb)
+            multi_model = any(m for m in w.models)
+            for m in w.models:
+                if m:
+                    gauge("serves_model", 1, lb + f',model="{m}"')
             # SLO observatory (docs/observability.md): XLA compile
             # ledger + warmup coverage and HBM telemetry per worker
             gauge("xla_compiles_total", w.xla_compiles, lb)
@@ -350,8 +373,26 @@ class MetricsComponent:
                     fleet[hname] = h
                 elif fl.bounds == h.bounds:
                     fl.merge(h)
+            # per-model TTFT distributions (engine hist_ttft_ms, keyed
+            # by model name; "" = base): per-worker rows + exact fleet
+            # merge per model, same schema-skew tolerance as above —
+            # rendered only for multi-model workers (see serves_model)
+            for m, vec in sorted(
+                (w.model_hists or {}).items() if multi_model else ()
+            ):
+                h = Histogram.from_vec(vec)
+                if h is None:
+                    continue
+                hist_rows("worker_ttft_ms", h, lb + f',model="{m}"')
+                fl = fleet_ttft.get(m)
+                if fl is None:
+                    fleet_ttft[m] = h
+                elif fl.bounds == h.bounds:
+                    fl.merge(h)
         for hname, h in sorted(fleet.items()):
             hist_rows(f"fleet_{hname}", h)
+        for m, h in sorted(fleet_ttft.items()):
+            hist_rows("fleet_ttft_ms", h, f'model="{m}"')
         gauge("worker_count", len(ep.loads))
         gauge("load_avg", round(ep.load_avg, 6))
         gauge("load_std", round(ep.load_std, 6))

@@ -116,6 +116,7 @@ class AdmissionGate:
         classes: tuple[SloClass, ...] = DEFAULT_CLASSES,
         clock: Callable[[], float] = time.monotonic,
         telemetry=None,
+        model_classes: Optional[dict] = None,
     ):
         self.bucket = TokenBucket(
             rate_req_s, burst if burst is not None else max(rate_req_s, 1.0),
@@ -123,6 +124,15 @@ class AdmissionGate:
         )
         self.classes = {c.name: c for c in classes}
         self.default_class = classes[0].name
+        #: per-model SLO routing (multi-model serving): model/adapter
+        #: name -> class name. A model mapped to "batch" gets batch's
+        #: reserve/queue bounds for ALL its traffic — one adapter's
+        #: burst can't starve another model's interactive SLO. Unknown
+        #: names (and unmapped models) classify as before.
+        self.model_classes = {
+            m: c for m, c in (model_classes or {}).items()
+            if c in self.classes
+        }
         #: optional TelemetryAggregator — arrivals feed the planner
         self.telemetry = telemetry
         self.inflight: dict[str, int] = {c.name: 0 for c in classes}
@@ -133,14 +143,20 @@ class AdmissionGate:
 
     # -- classification --
 
-    def classify(self, annotations: Optional[list] = None) -> str:
+    def classify(self, annotations: Optional[list] = None,
+                 model: Optional[str] = None) -> str:
         """``slo:<class>`` annotation -> class name (unknown classes fall
-        back to the default rather than 400ing the request)."""
+        back to the default rather than 400ing the request). The
+        explicit annotation outranks the model mapping — a request may
+        always downgrade itself — then ``model`` routes through
+        ``model_classes`` (multi-model pools), then the default."""
         for a in annotations or ():
             if isinstance(a, str) and a.startswith(self.ANNOTATION_PREFIX):
                 name = a[len(self.ANNOTATION_PREFIX):]
                 if name in self.classes:
                     return name
+        if model and model in self.model_classes:
+            return self.model_classes[model]
         return self.default_class
 
     # -- planner plane --

@@ -48,7 +48,7 @@ python -m pytest tests/test_analysis.py -q -p no:cacheprovider
 
 echo "==> compiled-perf shape-bucketing guards (mixed-step program count)"
 python -m pytest tests/test_compiled_perf.py -q -p no:cacheprovider \
-    -k "mixed_step_program_count or streamed_handoff_program_count or ici_mover_program_count"
+    -k "mixed_step_program_count or streamed_handoff_program_count or ici_mover_program_count or adapter_program_count"
 
 if [[ "${1:-}" != "--fast" ]]; then
     echo "==> sanitizer-strict fast subset (loop-stall + leaked-writer guards live)"
@@ -68,6 +68,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_observability.py \
         tests/test_trace_overhead.py \
         tests/test_planner.py \
+        tests/test_multi_model.py \
         -q -m 'not slow' -p no:cacheprovider
 fi
 

@@ -577,6 +577,84 @@ def test_streamed_handoff_program_count_bounded(run):
     run(main())
 
 
+def test_adapter_program_count_keys_on_buckets_not_census(run):
+    """Multi-LoRA bucketing guard (ISSUE 19): the adapter device stack's
+    ``[L, NA, ..., rb]`` shapes are the registry's (count, rank)
+    BUCKETS — zero-padded, bitwise exact — so staging, evicting and
+    re-staging adapters, and dispatching ANY per-row adapter-id mixture,
+    must compile exactly ONE prefill program for a fixed chunk bucket.
+    A program count that scales with the live adapter census would
+    inject an XLA compile into every LRU slot churn. The engine's
+    dispatch key mirrors this: adapter fleets append one static
+    ``("lora", count_bucket, rank_bucket)`` suffix; no-adapter engines
+    append NOTHING (their key tuples — and therefore their compiled
+    programs — stay byte-identical to pre-multi-model builds)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.adapters import AdapterRegistry
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    reg = AdapterRegistry(("alice:4", "bob:8:7", "carol:2:3"), cfg)
+    # 3 live slots -> count bucket 4; ranks {4, 8, 2} -> rank bucket 8
+    assert (reg.count_bucket, reg.rank_bucket) == (4, 8)
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    k_cache, v_cache = llama.init_kv_cache(cfg, 8, BLOCK)
+    tables = jnp.asarray(np.arange(1, 5, dtype=np.int32))
+    T = 16
+    base = llama.prefill._cache_size()
+    shapes0 = jax.tree.map(lambda a: a.shape, reg.device_stack())
+    # every registry state x adapter-id mixture the LRU can produce:
+    # cold stack, each staging, an eviction, a re-stage into the freed
+    # slot — with base (-1) and adapter rows dispatched against each
+    states = (
+        lambda: None,
+        lambda: reg.stage("alice"),
+        lambda: reg.stage("bob"),
+        lambda: reg.evict("alice"),
+        lambda: reg.stage("carol"),
+        lambda: reg.stage("alice"),
+    )
+    for mutate in states:
+        mutate()
+        assert jax.tree.map(lambda a: a.shape, reg.device_stack()) == shapes0
+        for aid in (-1, 0, 2):
+            _, k_cache, v_cache = llama.prefill(
+                params, cfg, jnp.zeros(T, jnp.int32), tables,
+                jnp.int32(0), jnp.int32(T - 3), k_cache, v_cache,
+                lora=reg.device_stack(), adapter_id=jnp.int32(aid),
+            )
+    grown = llama.prefill._cache_size() - base
+    assert grown == 1, (
+        f"adapter prefill compiled {grown} programs across "
+        f"{len(states)} registry states x 3 id mixtures (expected 1) — "
+        "the live adapter census leaked into the static shape key"
+    )
+
+    async def engines():
+        lora_eng = JaxEngine(
+            EngineConfig(
+                model=cfg, num_blocks=32, block_size=BLOCK,
+                max_batch_size=2, max_context=128,
+                adapters=("alice:4", "bob:8:7", "carol:2:3"),
+                served_model_name="base",
+            ),
+            seed=0,
+        )
+        plain_eng = JaxEngine(
+            EngineConfig(
+                model=cfg, num_blocks=32, block_size=BLOCK,
+                max_batch_size=2, max_context=128,
+            ),
+            seed=0,
+        )
+        assert lora_eng._lora_key() == (("lora", 4, 8),)
+        assert plain_eng._lora_key() == ()
+        await lora_eng.close()
+        await plain_eng.close()
+
+    run(engines())
+
+
 def test_ici_mover_program_count_bounded(run):
     """Shape-bucketing guard for the ICI same-slice handoff (ISSUE 11):
     the decode sink's per-segment device→device mover must compile one
