@@ -2491,6 +2491,441 @@ def _multi_model_stats():
     return {"bench_multi_model": asyncio.run(run())}
 
 
+def _autopilot_stats() -> dict:
+    """bench_autopilot (ISSUE 20 / ROADMAP item 5): the four autopilot
+    loops closing over the MEASURED plane —
+
+    * **pre-warm**: a cold engine serves its first request through the
+      XLA compile stall (measured TTFT + compile-counter delta); a
+      second cold engine is instead held behind a real Autopilot tick →
+      WarmupDirective over the live bus → WarmupListener actuating
+      ``engine.warmup`` off the hot path → hold released on the next
+      tick — and its first serve compiles NOTHING;
+    * **tail-aware routing**: worker B holds the prompt's 20-block
+      prefix device-hot but turns bimodal (induced queue stalls land
+      real ``queue_wait_ms`` histogram samples); each routing decision
+      sees the PRE-stall scrape (the episodic pathology is invisible to
+      point-in-time load), so mean-based cost routing keeps picking B
+      and pays the stall, while tail-aware routing prices B at its
+      windowed measured tail and escapes to the prefix-cold worker A.
+      TTFT measured by serving on the routed worker;
+    * **auto-quarantine**: the tail phase's measured TTFTs feed the
+      flight recorder; B's breach rate trips the hysteresis, a MEAN
+      scheduler following the health directive routes away from B
+      despite the 20-block overlap, and after the pathology ends B is
+      probed and reinstated — zero client-visible errors throughout;
+    * **headroom shedding**: fake-clock sub-bench — a real
+      AdmissionGate under measured high utilization has its batch class
+      capped at measured headroom (interactive never capped), sheds
+      with the ``headroom`` reason, and every cap lifts when
+      utilization drops.
+
+    Direction-only contract (test_bench_contract): warm serve compiles
+    0 vs cold >= 1 and warm TTFT < cold; tail-aware picks diverge from
+    mean picks and tail-aware TTFT p50 < mean p50; quarantine then
+    reinstate events with 0 client errors; headroom sheds > 0 and caps
+    lifted."""
+    import asyncio
+    import time as _time
+
+    from dynamo_tpu.autopilot import (
+        Autopilot,
+        AutopilotConfig,
+        QuarantineConfig,
+        WarmupListener,
+    )
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.allocator import sequence_block_hashes
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+    from dynamo_tpu.kv_router.scheduler import (
+        KvScheduler,
+        ProcessedEndpoints,
+        SchedulerConfig,
+        WorkerLoad,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.observability.flight import FlightRecorder, SloPolicy
+    from dynamo_tpu.planner.admission import AdmissionGate
+    from dynamo_tpu.planner.telemetry import ClusterSnapshot
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import Context, DistributedRuntime, collect
+
+    import jax as _jax
+
+    def req(toks, max_tokens=8):
+        return PreprocessedRequest(
+            token_ids=list(toks),
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0, seed=0),
+            eos_token_ids=[],
+        )
+
+    async def serve_ttft(engine, toks, max_tokens=8):
+        t0 = _time.monotonic()
+        first, out_toks = None, []
+        async for o in engine.generate(Context(req(toks, max_tokens))):
+            if first is None and o.token_ids:
+                first = _time.monotonic()
+            out_toks.extend(o.token_ids)
+        return (first - t0) * 1e3, out_toks
+
+    async def wait_for(pred, timeout_s=300.0):
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < timeout_s:
+            if pred():
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    class _Tel:
+        """Telemetry shim: a live scrape view over real load_metrics."""
+
+        def __init__(self, fn):
+            self._fn = fn
+
+        def snapshot(self):
+            return self._fn()
+
+    # ---------------- phase 1: compile pre-warm ----------------
+
+    async def prewarm_phase() -> dict:
+        # two DISTINCT tiny configs: ModelConfig hashes by identity, so
+        # each engine owns a disjoint XLA compile cache — the cold
+        # engine's serves can't warm the autopiloted one
+        cfg_a, cfg_b = ModelConfig.tiny(), ModelConfig.tiny()
+        prompt = [(5 * j) % 480 + 10 for j in range(48)]
+
+        def cfg(m):
+            return EngineConfig(
+                model=m, num_blocks=64, block_size=16, max_batch_size=2,
+                max_context=128, prefill_chunk=32,
+            )
+
+        cold = JaxEngine(cfg(cfg_a), params=llama.init_params(
+            cfg_a, _jax.random.key(3)))
+        warm = JaxEngine(cfg(cfg_b), params=llama.init_params(
+            cfg_b, _jax.random.key(3)))
+        drt = await DistributedRuntime.from_settings()
+        comp = drt.namespace("bench_ap").component("worker")
+        listener = None
+        try:
+            # cold worker: first dispatch pays the compile stall inline
+            c0 = cold.stats["xla_compiles_total"]
+            ttft_cold, toks_cold = await serve_ttft(cold, prompt,
+                                                    max_tokens=4)
+            cold_compiles = cold.stats["xla_compiles_total"] - c0
+
+            # autopiloted worker: directive -> actuation -> release
+            listener = await WarmupListener(drt, comp, worker_id=7,
+                                            engine=warm).start()
+            tel = _Tel(lambda: ClusterSnapshot(
+                ts=_time.monotonic(),
+                workers=[WorkerLoad.from_stats(
+                    7, warm.load_metrics(), ts=_time.monotonic())],
+            ))
+            ap = Autopilot(
+                drt=drt, component=comp, telemetry=tel,
+                config=AutopilotConfig(prewarm_cooldown_s=0.2,
+                                       quarantine=False),
+            )
+            d0 = ap.tick()
+            held = 7 in d0.prewarm_hold
+            applied = await wait_for(
+                lambda: listener.warmups_applied + listener.warmups_failed
+                >= 1)
+            d1 = ap.tick()
+            released = 7 not in d1.prewarm_hold and "warm:7" in d1.reason
+            w0 = warm.stats["xla_compiles_total"]
+            ttft_warm, toks_warm = await serve_ttft(warm, prompt,
+                                                    max_tokens=4)
+            warm_compiles = warm.stats["xla_compiles_total"] - w0
+            return {
+                "cold_first_ttft_ms": round(ttft_cold, 3),
+                "warm_first_ttft_ms": round(ttft_warm, 3),
+                "cold_serve_compiles": cold_compiles,
+                "warm_serve_compiles": warm_compiles,
+                "warmups_applied": listener.warmups_applied,
+                "warmup_ms": round(listener.warmup_ms_total, 1),
+                "held_then_released": bool(held and applied and released),
+                "directives": ap.warmup_directives,
+                "tokens_match": toks_cold == toks_warm,
+            }
+        finally:
+            if listener is not None:
+                await listener.close()
+            await drt.shutdown()
+            for e in (cold, warm):
+                await e.close()
+
+    # ------- phases 2+3: tail-aware routing + auto-quarantine -------
+
+    async def tail_and_quarantine_phase() -> tuple[dict, dict]:
+        tiny = ModelConfig.tiny(
+            hidden_size=256, intermediate_size=512, num_layers=4,
+            num_heads=4, num_kv_heads=4, head_dim=64,
+            max_position_embeddings=1024,
+        )
+        params = llama.init_params(tiny, _jax.random.key(11))
+        BS, PREFIX, TAIL = 16, 320, 16
+        prefix = [(11 * j) % 480 + 10 for j in range(PREFIX)]
+        measured = prefix + [(7 * j) % 480 + 10 for j in range(TAIL)]
+        chain = [s for _l, s in
+                 sequence_block_hashes(measured, BS)][: PREFIX // BS]
+        isl = len(sequence_block_hashes(measured, BS))
+        # fillers share their OWN prefix (distinct from the measured
+        # one): each stall is decode-bound (32 sequential steps — the
+        # induced pathology), and the pool never churns deep enough to
+        # evict B's measured-prefix chain mid-bench
+        fprefix = [(19 * j) % 480 + 10 for j in range(PREFIX)]
+
+        def filler(i):
+            return fprefix + [(13 * j + 37 * i) % 480 + 10
+                              for j in range(TAIL)]
+
+        def cfg():
+            # 1-slot engines: a filler in flight makes the measured
+            # request's queue delay REAL, not simulated
+            return EngineConfig(
+                model=tiny, num_blocks=160, block_size=BS,
+                max_batch_size=1, max_context=1024, prefill_chunk=64,
+            )
+
+        a, b = JaxEngine(cfg(), params=params), JaxEngine(cfg(),
+                                                          params=params)
+        names = {1: "healthy", 2: "bimodal"}
+        client_errors = 0
+
+        async def serve(engine, toks, expect=8):
+            nonlocal client_errors
+            ttft, out = await serve_ttft(engine, toks, max_tokens=expect)
+            if len(out) != expect:
+                client_errors += 1
+            return ttft, out
+
+        def scrape():
+            now = _time.monotonic()
+            return ProcessedEndpoints([
+                WorkerLoad.from_stats(1, a.load_metrics(), ts=now),
+                WorkerLoad.from_stats(2, b.load_metrics(), ts=now),
+            ])
+
+        async def stall_b(i):
+            """One induced stall: a decode-bound filler in flight on B."""
+            fut = asyncio.ensure_future(collect(b.generate(
+                Context(req(filler(i), max_tokens=32)))))
+            for _ in range(500):
+                if b.load_metrics()["request_active_slots"] >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            return fut
+
+        try:
+            # warm + calibrate both workers (compile buckets, feed the
+            # cost model's self-calibration) — outside timed regions
+            await collect(a.generate(Context(req(
+                [(23 * j) % 480 + 10 for j in range(PREFIX + TAIL)]))))
+            await collect(b.generate(Context(req(
+                [(29 * j) % 480 + 10 for j in range(PREFIX + TAIL)]))))
+            # the measured prompt's prefix lands device-hot on B; this
+            # first serve is also the bit-exactness reference stream
+            _t, toks_ref = await serve(b, measured)
+            overlaps = OverlapScores(scores={2: PREFIX // BS},
+                                     total_blocks=isl)
+            assert all(b.allocator.has_hash(h) for h in chain)
+
+            # pre-pathology baseline scrape (the tail window's base)
+            eps0 = scrape()
+
+            # induce the bimodal era: queued pairs on B land real big
+            # queue_wait_ms samples in its cumulative histogram
+            for i in range(6):
+                fut = await stall_b(i)
+                await collect(b.generate(
+                    Context(req(filler(100 + i), max_tokens=2))))
+                await fut
+            assert all(b.allocator.has_hash(h) for h in chain)
+
+            async def wave(tail_aware: bool):
+                sched = KvScheduler(config=SchedulerConfig(
+                    cost_model=True, tail_aware=tail_aware))
+                if tail_aware:
+                    # seed the pre-pathology baseline the live router
+                    # would have scraped a minute ago
+                    for l in eps0.loads:
+                        sched.tails.observe(l.worker_id, l.hists,
+                                            ts=l.ts)
+                ttfts, picks, streams = [], [], []
+                for rep in range(3):
+                    # the scrape PREDATES the stall — episodic
+                    # pathology is invisible to point-in-time load,
+                    # which is exactly why the mean router walks into it
+                    eps = scrape()
+                    fut = await stall_b(200 + rep + (50 if tail_aware
+                                                     else 0))
+                    wid = sched.select_worker(eps, overlaps, isl)
+                    picks.append(wid)
+                    if wid == 2:
+                        # routed into the stall: the measured TTFT
+                        # legitimately includes waiting it out
+                        ttft, toks = await serve(b, measured)
+                        await fut
+                    else:
+                        # routed AWAY from the stall: drain the filler
+                        # first — one smoke process shares one CPU, so
+                        # serving concurrently would charge A the very
+                        # contention the router just avoided (the
+                        # DECISION already saw the filler in flight)
+                        await fut
+                        ttft, toks = await serve(a, measured)
+                    ttfts.append(ttft)
+                    streams.append(toks)
+                    sched.request_finished(wid)
+                return ttfts, picks, streams, sched
+
+            mean_ttfts, mean_picks, mean_streams, _s = await wave(False)
+            tail_ttfts, tail_picks, tail_streams, s_tail = await wave(True)
+
+            tail_out = {
+                "prompt_tokens": PREFIX + TAIL,
+                "bimodal_prefix_blocks": PREFIX // BS,
+                "mean": {
+                    "picks": [names[w] for w in mean_picks],
+                    "ttft_p50_ms": round(_pct(mean_ttfts, 50), 3),
+                    "ttft_p99_ms": round(_pct(mean_ttfts, 99), 3),
+                },
+                "tail_aware": {
+                    "picks": [names[w] for w in tail_picks],
+                    "ttft_p50_ms": round(_pct(tail_ttfts, 50), 3),
+                    "ttft_p99_ms": round(_pct(tail_ttfts, 99), 3),
+                },
+                "tail_overrides": s_tail.route_tail_overrides,
+                "cost_decisions": s_tail.route_cost_decisions,
+                "tokens_match": bool(
+                    toks_ref and all(
+                        s == toks_ref
+                        for s in mean_streams + tail_streams)),
+            }
+
+            # ---- quarantine: the measured TTFTs are the evidence ----
+            target = (_pct(tail_ttfts, 50) * _pct(mean_ttfts, 50)) ** 0.5
+            fr = FlightRecorder(policy=SloPolicy(default_ttft_ms=target))
+            ap = Autopilot(
+                recorder=fr,
+                config=AutopilotConfig(
+                    prewarm=False,
+                    quarantine_cfg=QuarantineConfig(
+                        trip_ticks=2, min_breaches=1, breach_frac=0.5,
+                        hold_s=0.2, probe_ticks=1),
+                ),
+            )
+
+            def feed(n, ttft, wid):
+                fr.finish(n, "m", "interactive", "success", ttft, ttft,
+                          worker_id=wid)
+
+            # evidence split over two control ticks: B breaches, A clean
+            for i in range(2):
+                feed(f"m{i}", mean_ttfts[i], mean_picks[i])
+                feed(f"t{i}", tail_ttfts[i], tail_picks[i])
+            ap.tick()
+            feed("m2", mean_ttfts[2], mean_picks[2])
+            feed("t2", tail_ttfts[2], tail_picks[2])
+            d = ap.tick()
+            tripped = list(d.quarantined)
+
+            # a MEAN scheduler following the health directive now
+            # routes away from B despite the 20-block overlap
+            flip = KvScheduler(config=SchedulerConfig(
+                cost_model=True, tail_aware=False))
+            flip.set_autopilot_health(d.quarantined, d.prewarm_hold)
+            flip_wid = flip.select_worker(scrape(), overlaps, isl)
+            ttft_f, _ = await serve(a if flip_wid == 1 else b, measured)
+            feed("f0", ttft_f, flip_wid)
+
+            # pathology over: B drains, serves clean, earns its way back
+            await asyncio.sleep(0.25)  # hold_s elapses -> probe window
+            ttft_h, _ = await serve(b, measured)
+            feed("h0", ttft_h, 2)
+            ap.tick()  # hold expired: B moves to probe
+            ttft_h2, _ = await serve(b, measured)
+            feed("h1", ttft_h2, 2)
+            ap.tick()  # clean probe tick -> reinstate
+            events = [(ev.action, ev.worker_id)
+                      for ev in ap.quarantine.events]
+            quar_out = {
+                "breach_target_ms": round(target, 3),
+                "tripped": [names.get(w, str(w)) for w in tripped],
+                "events": [f"{act}:{names.get(w, str(w))}"
+                           for act, w in events],
+                "post_quarantine_pick": names[flip_wid],
+                "reinstated": not ap.quarantine.quarantined,
+                "client_errors": client_errors,
+            }
+            return tail_out, quar_out
+        finally:
+            for e in (a, b):
+                await e.close()
+
+    # ---------------- phase 4: headroom shedding ----------------
+
+    def headroom_phase() -> dict:
+        class _Clk:
+            t = 1000.0
+
+            def __call__(self):
+                return self.t
+
+        clk = _Clk()
+        gate = AdmissionGate(6.0, burst=6.0, clock=clk)
+        snap = {"active": 19}
+        tel = _Tel(lambda: ClusterSnapshot(
+            ts=clk.t, active_requests=snap["active"], total_slots=20))
+        ap = Autopilot(
+            telemetry=tel, gate=gate,
+            config=AutopilotConfig(prewarm=False, quarantine=False,
+                                   headroom=True, headroom_window_s=10.0),
+            clock=clk,
+        )
+        interactive_capped = False
+        for _tick in range(12):
+            for name in ("interactive", "batch"):
+                for _ in range(8):
+                    if gate.admit(name).admitted:
+                        gate.done(name)
+            clk.t += 2.0
+            ap.tick()
+            interactive_capped |= "interactive" in ap.headroom_caps
+        capped = dict(ap.headroom_caps)
+        sheds = gate.stats["shed_headroom_total"]
+        # load drains: every cap must lift
+        snap["active"] = 1
+        clk.t += 2.0
+        ap.tick()
+        return {
+            "batch_cap_req_s": round(capped.get("batch", 0.0), 3),
+            "shed_headroom_total": sheds,
+            "interactive_capped": interactive_capped,
+            "caps_lifted": not ap.headroom_caps
+            and "batch" not in gate.class_buckets,
+        }
+
+    async def run():
+        out = {"prewarm": await prewarm_phase()}
+        tail_out, quar_out = await tail_and_quarantine_phase()
+        out["tail_routing"] = tail_out
+        out["quarantine"] = quar_out
+        out["headroom"] = headroom_phase()
+        return out
+
+    return {"bench_autopilot": asyncio.run(run())}
+
+
 def main() -> None:
     cached = _cached_silicon_result()
     # one failed probe falls back (memoized) — a wedged relay costs one
@@ -2625,6 +3060,10 @@ def main() -> None:
         result.update(_multi_model_stats())
     except Exception as e:  # noqa: BLE001 - the decode metric still lands
         result["bench_multi_model_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_autopilot_stats())
+    except Exception as e:  # noqa: BLE001 - the decode metric still lands
+        result["bench_autopilot_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

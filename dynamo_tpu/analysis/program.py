@@ -73,6 +73,7 @@ WIRE_PROTOCOL_MODULES = (
     "dynamo_tpu/kv_router/protocols.py",
     "dynamo_tpu/planner/protocols.py",
     "dynamo_tpu/disagg/protocols.py",
+    "dynamo_tpu/autopilot/protocols.py",
 )
 
 #: stats-plane producers: (module suffix, function name or dict-target

@@ -1,0 +1,35 @@
+"""Fleet autopilot: closed-loop control over the measured plane.
+
+Four loops over the SLO observatory's measurements (docs/autopilot.md):
+tail-aware routing (``tails.TailTracker`` folded into the KV router's
+cost model), compile pre-warm (``controller`` publishes, ``warmup.
+WarmupListener`` actuates), breach-driven auto-quarantine
+(``quarantine.QuarantineManager`` hysteresis; the router and
+``resilience.quarantine.QuarantineListener`` subscribe the health
+subject), and measured-headroom admission shedding (``controller`` ->
+``AdmissionGate.set_class_rate``).
+"""
+
+from .controller import Autopilot, AutopilotConfig
+from .protocols import (
+    AUTOPILOT_HEALTH_SUBJECT,
+    AUTOPILOT_WARMUP_SUBJECT,
+    HealthDirective,
+    WarmupDirective,
+)
+from .quarantine import QuarantineConfig, QuarantineManager
+from .tails import TailTracker
+from .warmup import WarmupListener
+
+__all__ = [
+    "AUTOPILOT_HEALTH_SUBJECT",
+    "AUTOPILOT_WARMUP_SUBJECT",
+    "Autopilot",
+    "AutopilotConfig",
+    "HealthDirective",
+    "QuarantineConfig",
+    "QuarantineManager",
+    "TailTracker",
+    "WarmupDirective",
+    "WarmupListener",
+]

@@ -1,0 +1,315 @@
+"""The autopilot controller: one synchronous control step, four loops.
+
+Consumes the observatory's measured plane (the planner telemetry
+aggregator's scrape view, the flight recorder's per-worker breach
+attribution, the admission gate's class counters) and actuates:
+
+  1. **compile pre-warm** — a worker whose compile-ledger coverage says
+     its XLA bucket grid is cold (``xla_warm_buckets`` <
+     ``xla_reachable_buckets``, or 0/0 — never warmed) gets a
+     :class:`WarmupDirective` on the ``autopilot-warmup`` subject and
+     rides the health directive's ``prewarm_hold`` list until its grid
+     is warm, so traffic shifts onto it AFTER the compile stalls are
+     paid, not through them. Cooldown + attempt caps bound republishes;
+     a worker that can't warm (attempts exhausted) is released to serve
+     cold rather than held forever.
+  2. **auto-quarantine** — the flight recorder's per-worker
+     (unhealthy, finished) counters feed the
+     :class:`~dynamo_tpu.autopilot.quarantine.QuarantineManager`
+     hysteresis; its quarantined/probing views ride the health
+     directive.
+  3. **headroom shedding** — measured per-class arrival rates and the
+     fleet's measured serving rate/utilization size a per-class
+     admission cap: reserve-bearing classes get what's left after the
+     critical classes' observed demand (``AdmissionGate.
+     set_class_rate``), instead of a static reserve fraction. Caps lift
+     when utilization drops — and when the autopilot stops.
+  4. **tail-aware routing** rides scrape-side in the scheduler's
+     :class:`~dynamo_tpu.autopilot.tails.TailTracker` (no control tick
+     needed — the router folds tails per decision); the controller just
+     owns its knobs in :class:`AutopilotConfig` for launch wiring.
+
+``tick()`` is synchronous and clock-injected — the planner-sim replay
+and the hysteresis tests drive it deterministically; ``start()`` wraps
+it in the usual spawned loop for live serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .protocols import (
+    AUTOPILOT_HEALTH_SUBJECT,
+    AUTOPILOT_WARMUP_SUBJECT,
+    HealthDirective,
+    WarmupDirective,
+)
+from .quarantine import QuarantineConfig, QuarantineManager
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutopilotConfig:
+    interval_s: float = 2.0
+    pool: str = "decode"
+    # -- pre-warm loop --
+    prewarm: bool = True
+    #: min seconds between warmup directives at one worker
+    prewarm_cooldown_s: float = 30.0
+    #: directives per worker before giving up (a worker that cannot
+    #: warm serves cold instead of being held out forever)
+    prewarm_max_attempts: int = 3
+    # -- quarantine loop --
+    quarantine: bool = True
+    quarantine_cfg: QuarantineConfig = field(default_factory=QuarantineConfig)
+    # -- headroom loop --
+    headroom: bool = False
+    #: slot utilization above which reserve-bearing classes get capped
+    #: at measured headroom (below it every cap lifts)
+    headroom_util: float = 0.85
+    #: safety margin on the measured serving rate
+    headroom_safety: float = 0.9
+    #: never cap a class below this many req/s (starvation guard)
+    headroom_floor_req_s: float = 0.25
+    #: window for the measured per-class arrival / admitted rates
+    headroom_window_s: float = 10.0
+    # -- tail-aware routing knobs (consumed by SchedulerConfig wiring) --
+    tail_aware: bool = True
+    tail_q: float = 0.99
+    tail_window_s: float = 60.0
+    tail_min_count: int = 8
+
+
+class Autopilot:
+    """Owns the control tick; every collaborator is optional so each
+    loop degrades to "off" where the deployment shape lacks its input
+    (a frontend without a flight recorder still pre-warms, etc.)."""
+
+    def __init__(self, drt=None, component=None, telemetry=None,
+                 recorder=None, gate=None,
+                 config: Optional[AutopilotConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = config or AutopilotConfig()
+        self.drt = drt
+        self.telemetry = telemetry
+        self.recorder = recorder
+        self.gate = gate
+        self._clock = clock
+        self._warmup_subject = (
+            component.event_subject(AUTOPILOT_WARMUP_SUBJECT)
+            if component is not None else None
+        )
+        self._health_subject = (
+            component.event_subject(AUTOPILOT_HEALTH_SUBJECT)
+            if component is not None else None
+        )
+        self.quarantine = QuarantineManager(self.cfg.quarantine_cfg, clock)
+        # pre-warm state
+        self._warm_attempts: dict[int, int] = {}
+        self._warm_last: dict[int, float] = {}
+        self.prewarm_hold: set[int] = set()
+        # headroom state: per-class (ts, arrivals-delta) windows and
+        # the gate-counter baselines the deltas difference against
+        self._class_arrivals: dict[str, deque] = {}
+        self._class_base: dict[str, int] = {}
+        self._served: deque = deque()
+        self._served_base: Optional[int] = None
+        self.headroom_caps: dict[str, float] = {}
+        # counters (Metrics.register_source via render_stats)
+        self.ticks = 0
+        self.warmup_directives = 0
+        self.health_published = 0
+        self._task: Optional[asyncio.Task] = None
+
+    # ---------------- the control step ----------------
+
+    def tick(self) -> HealthDirective:
+        """One synchronous control step over the measured plane."""
+        self.ticks += 1
+        now = self._clock()
+        snap = self.telemetry.snapshot() if self.telemetry else None
+        reasons: list[str] = []
+        if snap is not None and self.cfg.prewarm:
+            self._prewarm_step(snap, now, reasons)
+        if self.recorder is not None and self.cfg.quarantine:
+            for ev in self.quarantine.step(self.recorder.worker_counters()):
+                reasons.append(f"{ev.action}:{ev.worker_id:x}")
+        if self.gate is not None and self.cfg.headroom:
+            self._headroom_step(snap, now, reasons)
+        directive = HealthDirective(
+            ts=now,
+            quarantined=self.quarantine.quarantined,
+            probing=self.quarantine.probing,
+            prewarm_hold=sorted(self.prewarm_hold),
+            reason=",".join(reasons) or "steady",
+        )
+        self._publish_health(directive)
+        return directive
+
+    # ---------------- loop 2: compile pre-warm ----------------
+
+    @staticmethod
+    def _is_cold(w) -> bool:
+        """Cold = the compile ledger says the warmup-reachable bucket
+        grid isn't covered. 0/0 (never warmed — warmup is what computes
+        ``reachable``) counts as cold: that IS the fresh/morphed-worker
+        state whose first dispatches pay the compile stalls."""
+        return (w.xla_reachable_buckets == 0
+                or w.xla_warm_buckets < w.xla_reachable_buckets)
+
+    def _prewarm_step(self, snap, now: float, reasons: list) -> None:
+        seen = set()
+        for w in snap.workers:
+            wid = w.worker_id
+            seen.add(wid)
+            if w.draining:
+                continue
+            if not self._is_cold(w):
+                if wid in self.prewarm_hold:
+                    self.prewarm_hold.discard(wid)
+                    reasons.append(f"warm:{wid:x}")
+                self._warm_attempts.pop(wid, None)
+                continue
+            attempts = self._warm_attempts.get(wid, 0)
+            if attempts >= self.cfg.prewarm_max_attempts:
+                # can't warm it — serve cold rather than hold forever
+                self.prewarm_hold.discard(wid)
+                continue
+            self.prewarm_hold.add(wid)
+            last = self._warm_last.get(wid)
+            if last is not None and now - last < self.cfg.prewarm_cooldown_s:
+                continue
+            self._warm_attempts[wid] = attempts + 1
+            self._warm_last[wid] = now
+            self._publish_warmup(WarmupDirective(
+                ts=now, worker_id=wid, pool=self.cfg.pool,
+                reason=("cold_buckets" if w.xla_reachable_buckets == 0
+                        else "partial_coverage"),
+            ))
+            reasons.append(f"cold:{wid:x}")
+        for wid in list(self.prewarm_hold):
+            if wid not in seen:  # departed mid-warm
+                self.prewarm_hold.discard(wid)
+                self._warm_attempts.pop(wid, None)
+
+    # ---------------- loop 4: measured headroom ----------------
+
+    def _headroom_step(self, snap, now: float, reasons: list) -> None:
+        stats = self.gate.stats
+        cutoff = now - self.cfg.headroom_window_s
+        span = max(self.cfg.headroom_window_s, 1e-9)
+        # measured per-class arrival rates (admitted + shed = offered)
+        rates: dict[str, float] = {}
+        for name in self.gate.classes:
+            offered = (stats.get(f"admitted_{name}", 0)
+                       + stats.get(f"shed_{name}", 0))
+            base = self._class_base.get(name)
+            self._class_base[name] = offered
+            dq = self._class_arrivals.setdefault(name, deque())
+            if base is not None and offered > base:
+                dq.append((now, offered - base))
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+            rates[name] = sum(n for _t, n in dq) / span
+        # measured serving rate: admitted/s over the same window (at
+        # high utilization admissions track completions — steady state)
+        admitted = stats.get("admitted_total", 0)
+        if self._served_base is not None and admitted > self._served_base:
+            self._served.append((now, admitted - self._served_base))
+        self._served_base = admitted
+        while self._served and self._served[0][0] < cutoff:
+            self._served.popleft()
+        served_rate = sum(n for _t, n in self._served) / span
+        util = snap.slot_utilization if snap is not None else 0.0
+        if util < self.cfg.headroom_util or served_rate <= 0:
+            # headroom everywhere: lift every cap
+            for name in list(self.headroom_caps):
+                self.gate.set_class_rate(name, 0.0)
+                del self.headroom_caps[name]
+                reasons.append(f"headroom_lift:{name}")
+            return
+        capacity = served_rate / max(util, 0.1) * self.cfg.headroom_safety
+        critical_demand = sum(
+            rates[c.name] for c in self.gate.classes.values()
+            if c.reserve_frac == 0
+        )
+        for c in self.gate.classes.values():
+            if c.reserve_frac <= 0:
+                continue  # critical classes are never headroom-capped
+            cap = max(self.cfg.headroom_floor_req_s,
+                      capacity - critical_demand)
+            prev = self.headroom_caps.get(c.name)
+            if prev is None or abs(prev - cap) / max(prev, 1e-9) > 0.05:
+                self.gate.set_class_rate(c.name, cap)
+                self.headroom_caps[c.name] = cap
+                reasons.append(f"headroom:{c.name}={cap:.2f}")
+
+    # ---------------- publication ----------------
+
+    def _publish_warmup(self, directive: WarmupDirective) -> None:
+        # dynflow: publishes=AUTOPILOT_WARMUP_SUBJECT
+        self.warmup_directives += 1
+        if self.drt is None or self._warmup_subject is None:
+            return
+        try:
+            self.drt.bus.publish(self._warmup_subject, directive.to_bytes())
+        except Exception:  # noqa: BLE001 — best-effort, next tick retries
+            logger.debug("warmup directive publish failed", exc_info=True)
+
+    def _publish_health(self, directive: HealthDirective) -> None:
+        # dynflow: publishes=AUTOPILOT_HEALTH_SUBJECT
+        if self.drt is None or self._health_subject is None:
+            return
+        try:
+            self.drt.bus.publish(self._health_subject, directive.to_bytes())
+            self.health_published += 1
+        except Exception:  # noqa: BLE001 — full replacement republishes
+            logger.debug("health directive publish failed", exc_info=True)
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> "Autopilot":
+        self._task = (self.drt.runtime.spawn(self._loop()) if self.drt
+                      else asyncio.get_running_loop().create_task(self._loop()))
+        return self
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        # leaving caps behind would freeze the last decision into the
+        # gate after the controller is gone
+        if self.gate is not None:
+            for name in list(self.headroom_caps):
+                self.gate.set_class_rate(name, 0.0)
+            self.headroom_caps.clear()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.interval_s)
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — a bad tick must not end
+                logger.exception("autopilot tick failed")
+
+    # ---------------- metrics surface ----------------
+
+    def render_stats(self) -> dict:
+        return {
+            "autopilot_ticks_total": self.ticks,
+            "autopilot_warmup_directives_total": self.warmup_directives,
+            "autopilot_health_published_total": self.health_published,
+            "autopilot_prewarm_holds": len(self.prewarm_hold),
+            "autopilot_quarantined_now": len(self.quarantine.quarantined),
+            "autopilot_probing_now": len(self.quarantine.probing),
+            "autopilot_quarantines_total": self.quarantine.quarantines_total,
+            "autopilot_reinstates_total": self.quarantine.reinstates_total,
+            "autopilot_requarantines_total":
+                self.quarantine.requarantines_total,
+            "autopilot_headroom_caps": len(self.headroom_caps),
+        }

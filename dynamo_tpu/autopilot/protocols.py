@@ -1,0 +1,98 @@
+"""Autopilot wire schema: the control subjects that close the loop.
+
+Two subjects, published on the target component (same bus idiom as the
+planner's ``planner-watermarks``/``reshard`` subjects):
+
+  * ``autopilot-warmup`` — one :class:`WarmupDirective` per cold-bucket
+    detection: the autopilot read a worker's compile-ledger coverage
+    (``xla_warm_buckets`` vs ``xla_reachable_buckets``) and wants the
+    worker to run its XLA bucket grid off the hot path BEFORE traffic
+    shifts onto it. Worker-side actuation is
+    :class:`~dynamo_tpu.autopilot.warmup.WarmupListener` →
+    ``JaxEngine.warmup`` — the same listener shape as the reshard
+    actuator, so a lost directive costs a republish, never correctness.
+  * ``autopilot-health`` — one :class:`HealthDirective` per control
+    tick: the full-replacement health view (like capacity watermarks —
+    the newest event wins, receipt-time staleness is tracked
+    subscriber-side). ``quarantined`` workers are soft-excluded from
+    routing exactly like ``resharding`` workers; ``prewarm_hold``
+    workers are held out of routing until their bucket grid is warm;
+    ``probing`` workers are readmitted under observation after a
+    quarantine hold expires (hysteresis lives in
+    :class:`~dynamo_tpu.autopilot.quarantine.QuarantineManager`).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+AUTOPILOT_WARMUP_SUBJECT = "autopilot-warmup"
+AUTOPILOT_HEALTH_SUBJECT = "autopilot-health"
+
+
+@dataclass
+class WarmupDirective:
+    """One pre-warm request on the ``autopilot-warmup`` subject.
+
+    ``worker_id=0`` addresses every worker in the pool (scale-up of a
+    fresh pool); a non-zero id targets the one cold worker the
+    autopilot saw. ``decode`` asks for the decode ladder on top of the
+    prefill buckets (the full first-dispatch surface); False covers
+    prefill-only pools."""
+
+    ts: float = 0.0  # dynlint: disable=dead-wire-field -- wall-clock stamp for the operator audit trail; actuation is ordering-free (warmup is idempotent)
+    worker_id: int = 0
+    pool: str = "decode"
+    #: why: "cold_buckets" (never warmed), "partial_coverage"
+    #: (morph/config change grew the reachable grid), ...
+    reason: str = ""  # dynlint: disable=dead-wire-field -- operator audit trail: WHY the autopilot judged the worker cold; the actuator warms the same grid regardless
+    decode: bool = True
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> Optional["WarmupDirective"]:
+        d = json.loads(raw)
+        return WarmupDirective(**{
+            k: d[k] for k in WarmupDirective().__dict__ if k in d
+        })
+
+
+@dataclass
+class HealthDirective:
+    """The autopilot's per-tick health view (full replacement).
+
+    Subscribers: the KV router folds ``quarantined`` + ``prewarm_hold``
+    into ``select_worker``'s soft-exclusion chain (the same
+    last-resort semantics as ``resharding`` — a fleet that is entirely
+    unhealthy still serves); every worker's
+    :class:`~dynamo_tpu.resilience.quarantine.QuarantineListener`
+    mirrors its own membership into engine counters so the quarantine
+    state is visible in the scraped/rendered metrics plane."""
+
+    ts: float = 0.0  # dynlint: disable=dead-wire-field -- wall-clock stamp for the operator audit trail; staleness is receipt-time tracked subscriber-side (autopilot_ttl_s)
+    #: workers whose breach/autopsy rate tripped the quarantine
+    #: hysteresis: route no NEW work at them (held streams drain)
+    quarantined: list[int] = field(default_factory=list)
+    #: quarantined workers readmitted under observation (hold expired);
+    #: routable again, re-quarantined with backoff if still unhealthy
+    probing: list[int] = field(default_factory=list)
+    #: cold workers being pre-warmed: hold routing until the bucket
+    #: grid compiles so first dispatches don't pay the compile stall
+    prewarm_hold: list[int] = field(default_factory=list)
+    #: why the view changed this tick ("breach_spike:7", "probe:7",
+    #: "cold:9", "steady") — operators replay these to audit the loop
+    reason: str = "steady"  # dynlint: disable=dead-wire-field -- operator audit trail mirroring MorphDecision.reason; exclusion keys on the membership lists alone by design
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> Optional["HealthDirective"]:
+        d = json.loads(raw)
+        return HealthDirective(**{
+            k: d[k] for k in HealthDirective().__dict__ if k in d
+        })

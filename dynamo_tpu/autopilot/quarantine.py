@@ -1,0 +1,204 @@
+"""Breach-driven worker quarantine with ScaleGuard-style hysteresis.
+
+The flight recorder attributes every SLO breach to the worker the
+router placed the request on (``FlightRecorder.worker_counters``). A
+worker whose breach *rate* spikes — sick HBM, a noisy co-tenant, a
+wedged executor — keeps attracting traffic for as long as its
+advertised load looks attractive; the quarantine loop is the circuit
+breaker: soft-exclude it from routing (exactly like a ``resharding``
+worker — held streams drain, a one-worker pool still serves), hold,
+then readmit it under observation and reinstate only after it proves
+itself on real traffic.
+
+Flap resistance is the design center, mirroring
+:class:`~dynamo_tpu.planner.guard.ScaleGuard`:
+
+  * evidence is per-tick *deltas* of cumulative counters, and only
+    ticks that saw finished requests count — a slow scrape or an idle
+    window advances nothing in either direction;
+  * tripping takes ``trip_ticks`` CONSECUTIVE unhealthy observed ticks
+    AND an absolute per-tick breach floor (``min_breaches``) — one
+    autopsy burst or one breached request cannot quarantine a worker;
+  * a dirty probe re-quarantines with exponential hold backoff
+    (capped), so a genuinely sick worker converges to "mostly out"
+    instead of oscillating at the probe frequency;
+  * at most ``max_quarantined_frac`` of the observed pool is ever out
+    at once (a lone worker is never quarantined) — the loop degrades
+    to "serve with breaches" rather than "serve nothing".
+
+Clock-injected and synchronous: the flap-resistance matrix in
+tests/test_autopilot.py and the planner-sim replay drive it tick by
+tick on a fake clock.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+HEALTHY = "healthy"
+QUARANTINED = "quarantined"
+PROBE = "probe"
+
+
+@dataclass
+class QuarantineConfig:
+    #: breaches / finished-requests ratio (per observed tick) that
+    #: counts as unhealthy evidence
+    breach_frac: float = 0.5
+    #: absolute per-tick breach floor — below this a tick is healthy
+    #: regardless of ratio (2 breaches out of 2 finishes is a blip,
+    #: not a pathology)
+    min_breaches: int = 3
+    #: consecutive unhealthy OBSERVED ticks before quarantining
+    trip_ticks: int = 2
+    #: quarantine hold before the worker is probed
+    hold_s: float = 20.0
+    #: consecutive clean observed ticks in PROBE to fully reinstate
+    probe_ticks: int = 2
+    #: hold multiplier after a dirty probe, capped at max_hold_s
+    backoff: float = 2.0
+    max_hold_s: float = 300.0
+    #: ceiling on the quarantined share of the observed pool
+    max_quarantined_frac: float = 0.5
+
+
+@dataclass
+class _WorkerHealth:
+    state: str = HEALTHY
+    #: consecutive unhealthy observed ticks (HEALTHY state)
+    streak: int = 0
+    #: consecutive clean observed ticks (PROBE state)
+    clean: int = 0
+    held_until: float = 0.0
+    hold_s: float = 0.0
+    #: cumulative-counter baselines from the previous tick
+    last_breaches: int = 0
+    last_records: int = 0
+    quarantines: int = 0
+
+
+@dataclass
+class QuarantineEvent:
+    """One state transition, recorded for no-flap assertions (the
+    ScaleGuard ``actions`` idiom)."""
+    ts: float
+    worker_id: int
+    action: str  # "quarantine" | "probe" | "reinstate" | "requarantine"
+    detail: str = ""
+
+
+class QuarantineManager:
+    """The synchronous state machine; the autopilot controller feeds it
+    one counter map per control tick."""
+
+    def __init__(self, cfg: Optional[QuarantineConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or QuarantineConfig()
+        self._clock = clock
+        self._workers: dict[int, _WorkerHealth] = {}
+        self.events: list[QuarantineEvent] = []
+        self.quarantines_total = 0
+        self.reinstates_total = 0
+        self.requarantines_total = 0
+
+    # ---- views ----
+
+    @property
+    def quarantined(self) -> list[int]:
+        return sorted(w for w, h in self._workers.items()
+                      if h.state == QUARANTINED)
+
+    @property
+    def probing(self) -> list[int]:
+        return sorted(w for w, h in self._workers.items()
+                      if h.state == PROBE)
+
+    def state(self, worker_id: int) -> str:
+        h = self._workers.get(worker_id)
+        return h.state if h is not None else HEALTHY
+
+    # ---- the control step ----
+
+    def step(self, counters: dict[int, tuple[int, int]]) -> list[QuarantineEvent]:
+        """One tick over the observed pool. ``counters`` maps
+        worker_id -> (breaches_total, records_total), CUMULATIVE (the
+        flight recorder's per-worker counters); deltas are taken here.
+        Returns the transitions this tick produced."""
+        now = self._clock()
+        pool = set(counters) | set(self._workers)
+        cap = int(self.cfg.max_quarantined_frac * len(pool))
+        fired: list[QuarantineEvent] = []
+        for wid in sorted(pool):
+            b_tot, r_tot = counters.get(wid, (None, None))
+            h = self._workers.setdefault(wid, _WorkerHealth())
+            if b_tot is None:
+                continue  # no scrape this tick: no evidence either way
+            d_b = b_tot - h.last_breaches
+            d_r = r_tot - h.last_records
+            h.last_breaches, h.last_records = b_tot, r_tot
+            if d_b < 0 or d_r < 0:
+                # recorder restarted — rebase, evidence starts over
+                h.streak = h.clean = 0
+                continue
+            observed = d_r > 0
+            unhealthy = (
+                observed
+                and d_b >= self.cfg.min_breaches
+                and d_b / d_r >= self.cfg.breach_frac
+            )
+            if h.state == HEALTHY:
+                if unhealthy:
+                    h.streak += 1
+                    if (h.streak >= self.cfg.trip_ticks
+                            and len(self.quarantined) < cap):
+                        fired.append(self._quarantine(
+                            h, wid, now,
+                            f"{d_b}/{d_r} breached x{h.streak} ticks"))
+                elif observed:
+                    h.streak = 0
+            elif h.state == QUARANTINED:
+                # held streams may still breach while they drain —
+                # that evidence is pre-quarantine traffic, already
+                # rebased above; the hold is purely time-based
+                if now >= h.held_until:
+                    h.state = PROBE
+                    h.clean = 0
+                    fired.append(QuarantineEvent(now, wid, "probe"))
+            elif h.state == PROBE:
+                if unhealthy:
+                    h.hold_s = min(h.hold_s * self.cfg.backoff,
+                                   self.cfg.max_hold_s)
+                    fired.append(self._quarantine(
+                        h, wid, now, f"dirty probe {d_b}/{d_r}",
+                        requarantine=True))
+                elif observed:
+                    h.clean += 1
+                    if h.clean >= self.cfg.probe_ticks:
+                        h.state = HEALTHY
+                        h.streak = 0
+                        self.reinstates_total += 1
+                        fired.append(QuarantineEvent(now, wid, "reinstate"))
+        self.events.extend(fired)
+        return fired
+
+    def _quarantine(self, h: _WorkerHealth, wid: int, now: float,
+                    detail: str, requarantine: bool = False) -> QuarantineEvent:
+        h.state = QUARANTINED
+        h.streak = h.clean = 0
+        if not requarantine:
+            h.hold_s = self.cfg.hold_s
+        h.held_until = now + h.hold_s
+        h.quarantines += 1
+        self.quarantines_total += 1
+        if requarantine:
+            self.requarantines_total += 1
+        return QuarantineEvent(
+            now, wid, "requarantine" if requarantine else "quarantine",
+            detail)
+
+    def forget(self, worker_id: int) -> None:
+        """Drop a departed worker (lease expiry) so a recycled id
+        starts healthy."""
+        self._workers.pop(worker_id, None)

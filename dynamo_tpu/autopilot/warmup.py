@@ -1,0 +1,131 @@
+"""Worker-side actuation of autopilot pre-warm directives.
+
+The autopilot finds cold XLA bucket grids from the scraped compile
+ledger (``xla_warm_buckets`` vs ``xla_reachable_buckets`` — a fresh or
+morphed worker shows 0/0 until its first warmup) and publishes a
+:class:`~dynamo_tpu.autopilot.protocols.WarmupDirective` on the
+component's ``autopilot-warmup`` subject. Every worker runs a
+:class:`WarmupListener` that filters for its own id (0 = pool-wide) and
+runs ``JaxEngine.warmup`` — the same bucket grid the launch-time
+``--warmup`` flag compiles, but driven by the control plane, so a
+scale-up/morph pays its compile stalls OFF the hot path while the
+router's ``prewarm_hold`` keeps traffic away.
+
+Same resilience contract as the reshard actuator it mirrors
+(resilience/reshard.py): warmups apply one at a time per worker, an
+already-warm grid is a counted no-op (warmup is idempotent — a
+re-published directive costs nothing), and a failed warmup is counted
+and logged, never raised into the subscription loop. Counters land in
+``engine.stats`` so the ``load_metrics`` scrape -> WorkerLoad ->
+metrics-render plane sees actuation without a new producer surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from .protocols import AUTOPILOT_WARMUP_SUBJECT, WarmupDirective
+
+logger = logging.getLogger(__name__)
+
+
+class WarmupListener:
+    """Subscribe the ``autopilot-warmup`` subject and warm one engine's
+    bucket grid on demand (see module doc)."""
+
+    def __init__(self, drt, component, worker_id: int, engine,
+                 pool: str = "decode"):
+        self.drt = drt
+        self.subject = component.event_subject(AUTOPILOT_WARMUP_SUBJECT)
+        self.worker_id = worker_id
+        self.engine = engine
+        #: directives for another pool are not ours even at worker_id=0
+        #: (a decode-pool pre-warm must not grid-compile prefill workers
+        #: sharing the subject)
+        self.pool = pool
+        self.warmups_applied = 0
+        self.warmups_noop = 0
+        self.warmups_failed = 0
+        self.warmup_ms_total = 0.0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> "WarmupListener":
+        sub = self.drt.bus.subscribe(self.subject)
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._sub = sub
+        self._task = self.drt.runtime.spawn(self._consume(sub))
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+
+    def _already_warm(self) -> bool:
+        stats = getattr(self.engine, "stats", None) or {}
+        reachable = stats.get("xla_reachable_buckets", 0)
+        return reachable > 0 and stats.get("xla_warm_buckets", 0) >= reachable
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                directive = WarmupDirective.from_bytes(msg.payload)
+                if directive is None:
+                    continue
+                if directive.worker_id not in (0, self.worker_id):
+                    continue
+                if directive.pool != self.pool:
+                    continue
+                await self._apply(directive)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad event must not
+                logger.debug("bad warmup directive", exc_info=True)
+
+    async def _apply(self, directive: WarmupDirective) -> None:
+        async with self._lock:  # one grid compile at a time per worker
+            if self._already_warm():
+                # idempotence makes republished directives free — the
+                # autopilot's cooldown bounds them, this zeroes them
+                self.warmups_noop += 1
+                self._mirror()
+                return
+            t0 = time.perf_counter()
+            try:
+                await self.engine.warmup(decode=directive.decode)  # dynlint: disable=await-in-lock -- this lock exists to serialize bucket-grid compiles on one engine; the warmup IS the work being serialized, not incidental I/O under it
+                self.warmups_applied += 1
+                self.warmup_ms_total += (time.perf_counter() - t0) * 1e3
+                logger.info(
+                    "autopilot warmup applied on worker %x (%.0f ms)",
+                    self.worker_id, (time.perf_counter() - t0) * 1e3,
+                )
+            except Exception:  # noqa: BLE001 — engine keeps serving
+                # cold; count it and let the next directive retry
+                self.warmups_failed += 1
+                logger.exception("autopilot warmup failed")
+            self._mirror()
+
+    def _mirror(self) -> None:
+        """Mirror actuation counters into ``engine.stats`` so the
+        existing load_metrics scrape advertises them fleet-wide."""
+        stats = getattr(self.engine, "stats", None)
+        if stats is None:
+            return
+        stats["autopilot_warmups_applied"] = self.warmups_applied
+        stats["autopilot_warmup_ms_total"] = round(self.warmup_ms_total, 3)
+
+    def stats(self) -> dict:
+        return {
+            "autopilot_warmups_applied": self.warmups_applied,
+            "autopilot_warmups_noop": self.warmups_noop,
+            "autopilot_warmups_failed": self.warmups_failed,
+            "autopilot_warmup_ms_total": round(self.warmup_ms_total, 3),
+        }

@@ -69,14 +69,15 @@ def sequence_block_hashes(
     Uses the native C++ batch hasher when built (bit-identical output —
     hashes address KV blocks across processes, so both layers must agree).
     ``salt`` (``model_hash_salt``) roots the chain in a per-model
-    namespace; the native hasher has no salt parameter yet, so salted
-    chains take the pure-python walk (adapter prompts only — base-model
-    traffic keeps the fast path).
+    namespace; the native hasher takes it too (seeding the chain's root
+    parent), so adapter prompts keep the fast path — unless the loaded
+    .so predates the salted entry point, in which case salted chains
+    fall back to the pure-python walk below.
     """
     from .. import native
 
-    if salt is None and native.available():
-        return native.sequence_block_hashes(tokens, block_size)
+    if native.available() and (salt is None or native.salted_available()):
+        return native.sequence_block_hashes(tokens, block_size, salt=salt)
     out: list[tuple[int, int]] = []
     parent: Optional[int] = salt
     for i in range(0, len(tokens) - len(tokens) % block_size, block_size):

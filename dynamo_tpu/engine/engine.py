@@ -773,6 +773,16 @@ class JaxEngine(AsyncEngine):
             "xla_compile_ms_total": 0.0,
             "xla_warm_buckets": 0,
             "xla_reachable_buckets": 0,
+            # autopilot actuation surface (docs/autopilot.md): control-
+            # plane warmups the WarmupListener ran on this engine (and
+            # their wall-ms — the compile tax paid off the hot path),
+            # plus the QuarantineListener's mirror of this worker's
+            # quarantine state so one scrape shows a worker was pulled
+            # from rotation and how often
+            "autopilot_warmups_applied": 0,
+            "autopilot_warmup_ms_total": 0.0,
+            "autopilot_quarantined": 0,
+            "autopilot_quarantines_total": 0,
         }
         # SLO observatory worker-side latency distributions
         # (docs/observability.md): fixed log-bucket histograms riding
@@ -1224,6 +1234,14 @@ class JaxEngine(AsyncEngine):
         )
         out["xla_warm_buckets"] = self.stats["xla_warm_buckets"]
         out["xla_reachable_buckets"] = self.stats["xla_reachable_buckets"]
+        # autopilot actuation mirrors (warmup/quarantine listeners)
+        out["autopilot_warmups_applied"] = self.stats[
+            "autopilot_warmups_applied"]
+        out["autopilot_warmup_ms_total"] = self.stats[
+            "autopilot_warmup_ms_total"]
+        out["autopilot_quarantined"] = self.stats["autopilot_quarantined"]
+        out["autopilot_quarantines_total"] = self.stats[
+            "autopilot_quarantines_total"]
         hbm = self._hbm_stats()
         out["hbm_bytes_in_use"] = hbm["in_use"]
         out["hbm_bytes_limit"] = hbm["limit"]

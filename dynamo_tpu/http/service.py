@@ -389,9 +389,14 @@ class HttpService(HttpServerBase):
             # a timeline that can't decompose
             req_span.end()
             if self.flight is not None:
+                # per-worker attribution: the KV router stamps the pinned
+                # instance on the shared annotations dict (autopilot
+                # quarantine evidence) — absent on round-robin fallbacks
+                rw = context.annotations.get("routed_worker_id")
                 self.flight.finish(
                     context.id, req.model, guard.slo_class, guard.status,
                     guard.ttft_ms, elapsed_ms,
+                    worker_id=rw if isinstance(rw, int) else None,
                 )
             if slo_class is not None:
                 self.admission.done(slo_class)

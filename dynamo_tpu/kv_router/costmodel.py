@@ -228,6 +228,28 @@ class TransferCostModel:
 # ---------------- router-side scoring ----------------
 
 
+def tail_adjusted_ttft_ms(
+    pred_ms: float, tail_ms: Optional[float]
+) -> float:
+    """Price a candidate at its measured tail (the autopilot's
+    tail-aware routing loop, docs/autopilot.md).
+
+    ``pred_ms`` is the calibration model's prediction — built from
+    EWMA *means*, so a bimodal worker (periodic GC, a noisy co-tenant,
+    a wedged executor firing every few seconds) averages its stalls
+    away and keeps winning the argmin. ``tail_ms`` is the worker's
+    windowed measured tail (p99 of queue-wait + prefill over the last
+    window, :class:`~dynamo_tpu.autopilot.tails.TailTracker`): what a
+    request routed there actually risks paying. The effective score is
+    the max of the two — the model's structural terms (transfer legs,
+    overlap) still differentiate healthy candidates, but no candidate
+    may score better than its own recent tail says it serves. None
+    (no window evidence — cold or idle worker) changes nothing."""
+    if tail_ms is None:
+        return pred_ms
+    return max(pred_ms, tail_ms)
+
+
 def _restore_gbps(link_gbps: dict) -> Optional[float]:
     """Effective local-tier restore bandwidth for a candidate: the
     router can't see how a chain splits between host DRAM and disk, so

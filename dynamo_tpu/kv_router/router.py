@@ -54,6 +54,7 @@ class KvRouter:
         self.disagg_ratio_hint = 0.0
         self._watch_task = None
         self._watermark_task = None
+        self._health_task = None
 
     async def start(self) -> "KvRouter":
         await self.indexer.start()
@@ -79,7 +80,35 @@ class KvRouter:
         self._watermark_task = self.drt.runtime.spawn(
             self._consume_watermarks(sub)
         )
+        # autopilot health directives: quarantined / pre-warm-held
+        # workers fold into the scheduler's soft-exclusion chain the
+        # same way watermarks do
+        from ..autopilot.protocols import AUTOPILOT_HEALTH_SUBJECT
+
+        hsub = self.drt.bus.subscribe(
+            self.component.event_subject(AUTOPILOT_HEALTH_SUBJECT)
+        )
+        ready = getattr(hsub, "ready", None)
+        if ready is not None:
+            await ready
+        self._health_task = self.drt.runtime.spawn(
+            self._consume_health(hsub)
+        )
         return self
+
+    async def _consume_health(self, sub) -> None:
+        from ..autopilot.protocols import HealthDirective
+
+        async for msg in sub:
+            try:
+                hd = HealthDirective.from_bytes(msg.payload)
+                if hd is None:
+                    continue
+                self.scheduler.set_autopilot_health(
+                    hd.quarantined, hd.prewarm_hold
+                )
+            except Exception:  # noqa: BLE001 — directives are advisory
+                logger.debug("bad autopilot health directive", exc_info=True)
 
     async def _consume_watermarks(self, sub) -> None:
         from ..planner.protocols import CapacityWatermark

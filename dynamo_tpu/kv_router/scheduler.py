@@ -26,7 +26,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
-from .costmodel import predict_worker_ttft_ms
+from .costmodel import predict_worker_ttft_ms, tail_adjusted_ttft_ms
 from .indexer import OverlapScores
 from .protocols import (
     KV_HIT_RATE_SUBJECT,
@@ -200,6 +200,14 @@ class WorkerLoad:
     xla_compile_ms: float = 0.0
     xla_warm_buckets: int = 0
     xla_reachable_buckets: int = 0
+    # autopilot actuation surface (docs/autopilot.md): control-plane
+    # warmups this worker ran (and the wall they took — the compile tax
+    # paid OFF the hot path), plus the worker's mirrored quarantine
+    # state: currently pulled from rotation, and total times it was
+    autopilot_warmups: int = 0
+    autopilot_warmup_ms: float = 0.0
+    autopilot_quarantined: int = 0
+    autopilot_quarantines: int = 0
     # TPU device-memory telemetry: allocator view (bytes_limit == 0
     # marks the attributed-sum fallback on backends without
     # memory_stats) plus the engine's exact KV-pool/weights attribution
@@ -299,6 +307,10 @@ class WorkerLoad:
             xla_compile_ms=d.get("xla_compile_ms_total", 0.0),
             xla_warm_buckets=d.get("xla_warm_buckets", 0),
             xla_reachable_buckets=d.get("xla_reachable_buckets", 0),
+            autopilot_warmups=d.get("autopilot_warmups_applied", 0),
+            autopilot_warmup_ms=d.get("autopilot_warmup_ms_total", 0.0),
+            autopilot_quarantined=d.get("autopilot_quarantined", 0),
+            autopilot_quarantines=d.get("autopilot_quarantines_total", 0),
             hbm_bytes_in_use=d.get("hbm_bytes_in_use", 0),
             hbm_bytes_limit=d.get("hbm_bytes_limit", 0),
             hbm_kv_pool_bytes=d.get("hbm_kv_pool_bytes", 0),
@@ -393,6 +405,23 @@ class SchedulerConfig:
     #: calibration observations a candidate must advertise before its
     #: predicted TTFT is trusted (cold-start gate)
     cost_min_obs: int = 4
+    #: tail-aware routing (autopilot loop 1): fold each candidate's
+    #: WINDOWED measured tail (p-quantile of queue-wait + prefill,
+    #: differenced from the scraped cumulative histograms) into the
+    #: cost-mode score as a floor — a bimodal worker is priced at its
+    #: tail instead of the mean its EWMA calibration reports. Inert for
+    #: workers with no window evidence (cold / idle / pre-observatory
+    #: producers), so legacy fleets route unchanged.
+    tail_aware: bool = True
+    tail_q: float = 0.99
+    tail_window_s: float = 60.0
+    #: window samples the tail needs before it is trusted
+    tail_min_count: int = 8
+    #: ignore autopilot health directives older than this: an autopilot
+    #: that stopped publishing must not keep workers quarantined or
+    #: held forever (same stale-authority guard as watermark_ttl_s).
+    #: 0 disables the expiry.
+    autopilot_ttl_s: float = 30.0
 
 
 class KvScheduler:
@@ -409,6 +438,25 @@ class KvScheduler:
         # candidate is marked)
         self.watermarked: set[int] = set()
         self._watermark_ts: Optional[float] = None
+        # autopilot health directives (docs/autopilot.md): quarantined
+        # workers (breach-rate spike) and pre-warm holds (cold XLA grid
+        # compiling off the hot path) — both soft-excluded like
+        # ``resharding`` workers, both full-replacement + TTL like the
+        # planner watermarks above
+        self.quarantined: set[int] = set()
+        self.prewarm_hold: set[int] = set()
+        self._autopilot_ts: Optional[float] = None
+        # windowed per-worker tails from the scraped cumulative
+        # histograms (autopilot tail-aware routing); imported lazily —
+        # autopilot.tails needs observability.hist, whose package init
+        # reaches back through kv_router to this module
+        from ..autopilot.tails import TailTracker
+
+        self.tails = TailTracker(
+            window_s=self.cfg.tail_window_s, q=self.cfg.tail_q,
+            min_count=self.cfg.tail_min_count, clock=self._clock,
+        )
+        self.route_tail_overrides = 0
         self._hit_subject = (
             component.event_subject(KV_HIT_RATE_SUBJECT) if component else None
         )
@@ -435,6 +483,13 @@ class KvScheduler:
         loads = [l for l in endpoints.loads]
         if not loads:
             raise AllWorkersBusy("no workers")
+        if self.cfg.tail_aware:
+            # feed the tail windows from every scrape that flows
+            # through a decision (deduped on the scrape stamp) — the
+            # quarantined/held workers' tails keep updating too, so
+            # their recovery is visible when they return
+            for l in endpoints.loads:
+                self.tails.observe(l.worker_id, l.hists, ts=l.ts)
         if model:
             # model filter comes BEFORE every score: a worker without
             # the adapter can't serve the request at any cost, and the
@@ -489,6 +544,25 @@ class KvScheduler:
                 l for l in candidates if l.worker_id not in self.watermarked
             ]
             candidates = preferred or candidates
+        # autopilot health directives: quarantined workers (spiking
+        # breach rate) and pre-warm holds (cold XLA grid compiling)
+        # are soft exclusions with the same last-resort semantics as
+        # ``resharding`` — an entirely-unhealthy fleet still serves.
+        # A stopped autopilot's last directive expires instead of
+        # pinning its view on routing forever.
+        if ((self.quarantined or self.prewarm_hold)
+                and self.cfg.autopilot_ttl_s > 0):
+            if (self._autopilot_ts is None
+                    or self._clock() - self._autopilot_ts
+                    > self.cfg.autopilot_ttl_s):
+                self.quarantined = set()
+                self.prewarm_hold = set()
+        for excluded in (self.quarantined, self.prewarm_hold):
+            if excluded:
+                preferred = [
+                    l for l in candidates if l.worker_id not in excluded
+                ]
+                candidates = preferred or candidates
 
         best_id = None
         self.last_predicted_ttft_ms = None
@@ -517,6 +591,16 @@ class KvScheduler:
                 if p is None:
                     preds = None
                     break
+                if self.cfg.tail_aware:
+                    # tail-aware routing: no candidate may score better
+                    # than its own windowed measured tail — the mean-
+                    # built model hides a bimodal worker's p99
+                    adjusted = tail_adjusted_ttft_ms(
+                        p, self.tails.tail_ms(l.worker_id)
+                    )
+                    if adjusted > p:
+                        self.route_tail_overrides += 1
+                    p = adjusted
                 preds.append((p, l.worker_id))
             if preds:
                 # ties (identical candidates, or a model with barely
@@ -685,6 +769,15 @@ class KvScheduler:
         publishing ages out via ``watermark_ttl_s``)."""
         self.watermarked = set(saturated_workers or ())
         self._watermark_ts = self._clock()
+
+    def set_autopilot_health(self, quarantined=(), prewarm_hold=()) -> None:
+        """Autopilot health-directive update (full replacement, exactly
+        like ``set_watermarks``: the controller republishes the whole
+        view every tick, so a reinstated worker clears automatically
+        and a stopped autopilot ages out via ``autopilot_ttl_s``)."""
+        self.quarantined = set(quarantined or ())
+        self.prewarm_hold = set(prewarm_hold or ())
+        self._autopilot_ts = self._clock()
 
     def request_finished(self, worker_id: int) -> None:
         """Release the optimistic bump once the request lands/completes."""

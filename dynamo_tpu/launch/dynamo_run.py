@@ -442,9 +442,17 @@ async def run_http(args) -> None:
         client = await comp.endpoint(ep).client().start()
         # model_name rides the prefetch hints (PRESERVE weight
         # pre-stage); scheduler config default = cost-aware routing
-        # with overlap-scoring cold-start fallback
+        # with overlap-scoring cold-start fallback; tail-aware routing
+        # folds each worker's windowed p99 queue-wait+prefill into the
+        # cost model's prediction (docs/autopilot.md)
+        from ..kv_router.scheduler import SchedulerConfig
+
         router = await KvRouter(
             drt, comp, block_size=args.block_size, model_name=name,
+            config=SchedulerConfig(
+                tail_aware=not args.no_tail_aware,
+                tail_window_s=args.tail_window_s,
+            ),
         ).start()
         dispatch = KvRoutedEngine(router, client)
         if not args.no_migration:
@@ -486,6 +494,37 @@ async def run_http(args) -> None:
         flight = _build_flight(args, collector=svc.tracing)
         if flight is not None:
             svc.attach_flight(flight)
+        if args.autopilot:
+            # fleet autopilot (docs/autopilot.md): the closed loops ride
+            # the frontend because the evidence lives here — the flight
+            # recorder's per-worker breach attribution, the admission
+            # gate's class counters, and the router's scrape view
+            from ..autopilot import Autopilot, AutopilotConfig
+            from ..planner import TelemetryAggregator
+
+            autopilot = await Autopilot(
+                drt, comp,
+                telemetry=TelemetryAggregator(
+                    metrics_aggregator=router.metrics
+                ),
+                recorder=flight,
+                gate=admission,
+                config=AutopilotConfig(
+                    interval_s=args.autopilot_tick,
+                    prewarm=not args.no_prewarm,
+                    quarantine=not args.no_quarantine and flight is not None,
+                    headroom=args.autopilot_headroom
+                    and admission is not None,
+                ),
+            ).start()
+            svc.metrics.register_source(autopilot.render_stats)
+            print(
+                "autopilot engaged: prewarm="
+                f"{autopilot.cfg.prewarm} quarantine="
+                f"{autopilot.cfg.quarantine} headroom="
+                f"{autopilot.cfg.headroom} every "
+                f"{autopilot.cfg.interval_s}s", flush=True,
+            )
     elif args.out.startswith("dyn://"):
         drt = await connect_runtime(args)
         await ModelWatcher(drt, manager).start()
@@ -664,6 +703,20 @@ async def run_endpoint(args) -> None:
         reshard_listener = await ReshardListener(  # noqa: F841
             drt, component, drt.primary_lease_id, jax_core,
             drain_deadline_s=args.drain_deadline,
+        ).start()
+        # autopilot actuators (docs/autopilot.md): pre-warm directives
+        # run the engine's warmup ladder off the hot path before the
+        # router shifts traffic here; health directives mirror this
+        # worker's own quarantine state into its scrape surface so
+        # operators see WHICH worker the autopilot fenced
+        from ..autopilot import WarmupListener
+        from ..resilience.quarantine import QuarantineListener
+
+        warmup_listener = await WarmupListener(  # noqa: F841
+            drt, component, drt.primary_lease_id, jax_core,
+        ).start()
+        quarantine_listener = await QuarantineListener(  # noqa: F841
+            drt, component, drt.primary_lease_id, jax_core,
         ).start()
     handle = await component.endpoint(ep).serve(engine, stats_handler=stats)
     await register_model(
@@ -1228,6 +1281,31 @@ def main(argv=None) -> None:
     p.add_argument("--deployment", default=None,
                    help="planner actuator: deployment name whose "
                         "worker/prefill services the planner resizes")
+    p.add_argument("--autopilot", action="store_true",
+                   help="fleet autopilot on a KV-routed frontend "
+                        "(docs/autopilot.md): compile pre-warm before "
+                        "traffic shifts, auto-quarantine of "
+                        "breach-spiking workers with probe-based "
+                        "reinstatement, and (with --autopilot-headroom) "
+                        "measured-headroom admission caps")
+    p.add_argument("--autopilot-tick", type=float, default=2.0,
+                   help="autopilot control-loop interval in seconds")
+    p.add_argument("--no-prewarm", action="store_true",
+                   help="autopilot: disable the compile pre-warm loop")
+    p.add_argument("--no-quarantine", action="store_true",
+                   help="autopilot: disable the auto-quarantine loop")
+    p.add_argument("--autopilot-headroom", action="store_true",
+                   help="autopilot: cap reserve-bearing admission "
+                        "classes at measured headroom (needs "
+                        "--admission-rate > 0)")
+    p.add_argument("--no-tail-aware", action="store_true",
+                   help="KV router: don't fold windowed per-worker p99 "
+                        "queue-wait+prefill tails into the cost model's "
+                        "predicted TTFT (tail-aware routing is on by "
+                        "default; docs/autopilot.md)")
+    p.add_argument("--tail-window-s", type=float, default=60.0,
+                   help="tail-aware routing: sliding window over the "
+                        "scraped cumulative histograms")
     p.add_argument("--engine-subprocess", action="store_true",
                    help="isolate a pystr:/pytok: engine in a child process")
     p.add_argument("--warmup", action="store_true",

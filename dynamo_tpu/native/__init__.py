@@ -41,6 +41,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dn_chain_hash.argtypes = [u64, u64]
     lib.dn_sequence_block_hashes.restype = i32
     lib.dn_sequence_block_hashes.argtypes = [p(i64), i32, i32, p(u64), p(u64)]
+    try:
+        # salted variant (per-model chain namespaces): OPTIONAL so a
+        # stale pre-salt .so keeps its unsalted fast path instead of
+        # failing the whole load — salted chains then take the
+        # pure-Python walk (allocator.py checks salted_available())
+        lib.dn_sequence_block_hashes_salted.restype = i32
+        lib.dn_sequence_block_hashes_salted.argtypes = [
+            p(i64), i32, i32, u64, p(u64), p(u64),
+        ]
+    except AttributeError:
+        pass
     lib.dn_pi_new.restype = ctypes.c_void_p
     lib.dn_pi_free.argtypes = [ctypes.c_void_p]
     lib.dn_pi_size.restype = u64
@@ -87,6 +98,13 @@ def available() -> bool:
     return _lib is not None
 
 
+def salted_available() -> bool:
+    """True when the loaded library carries the salted batch hasher
+    (older .so builds predate it — their salted chains fall back to
+    the pure-Python walk, unsalted traffic keeps the fast path)."""
+    return _lib is not None and hasattr(_lib, "dn_sequence_block_hashes_salted")
+
+
 def build(force: bool = False) -> bool:
     """Compile native/ into build/libdynamo_native.so. Returns success."""
     global _lib
@@ -124,7 +142,7 @@ def chain_hash(parent: Optional[int], local: int) -> int:
 
 
 def sequence_block_hashes(
-    tokens: Sequence[int], block_size: int
+    tokens: Sequence[int], block_size: int, salt: Optional[int] = None
 ) -> list[tuple[int, int]]:
     import numpy as np
 
@@ -136,10 +154,19 @@ def sequence_block_hashes(
     out = np.empty((2, full), dtype=np.uint64)
     i64p = ctypes.POINTER(ctypes.c_int64)
     u64p = ctypes.POINTER(ctypes.c_uint64)
-    k = _lib.dn_sequence_block_hashes(
-        arr.ctypes.data_as(i64p), n, block_size,
-        out[0].ctypes.data_as(u64p), out[1].ctypes.data_as(u64p),
-    )
+    if salt is not None:
+        # per-model chain namespace: the salt seeds the root parent
+        # (bit-identical to allocator.py's salted pure-Python walk)
+        k = _lib.dn_sequence_block_hashes_salted(
+            arr.ctypes.data_as(i64p), n, block_size,
+            ctypes.c_uint64(salt & ((1 << 64) - 1)),
+            out[0].ctypes.data_as(u64p), out[1].ctypes.data_as(u64p),
+        )
+    else:
+        k = _lib.dn_sequence_block_hashes(
+            arr.ctypes.data_as(i64p), n, block_size,
+            out[0].ctypes.data_as(u64p), out[1].ctypes.data_as(u64p),
+        )
     return list(zip(out[0, :k].tolist(), out[1, :k].tolist()))
 
 

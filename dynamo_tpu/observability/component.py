@@ -356,6 +356,17 @@ class MetricsComponent:
             gauge("hbm_bytes_limit", w.hbm_bytes_limit, lb)
             gauge("hbm_kv_pool_bytes", w.hbm_kv_pool_bytes, lb)
             gauge("hbm_weights_bytes", w.hbm_weights_bytes, lb)
+            # autopilot plane (docs/autopilot.md): pre-warm runs the
+            # worker's actuator applied (and their wall cost), plus the
+            # worker's current quarantine flag and lifetime trips — the
+            # operator's view of WHICH worker the autopilot touched
+            gauge("autopilot_warmups_applied", w.autopilot_warmups, lb)
+            gauge(
+                "autopilot_warmup_ms_total",
+                round(w.autopilot_warmup_ms, 3), lb,
+            )
+            gauge("autopilot_quarantined", w.autopilot_quarantined, lb)
+            gauge("autopilot_quarantines_total", w.autopilot_quarantines, lb)
             # worker latency distributions: per-worker histogram rows
             # and the exact fleet merge (vector addition; a vector whose
             # bucket bounds don't match the rollup's is rendered

@@ -102,6 +102,13 @@ class FlightRecorder:
         self._disk_paths: deque[str] = deque()
         self.recorded_total = 0
         self.autopsies_total = 0
+        # per-worker attribution (the router stamps routed_worker_id on
+        # every request): cumulative finishes and unhealthy finishes
+        # (breach OR error) per worker — the autopilot's quarantine
+        # loop differences these to find the worker whose breach RATE
+        # is spiking, instead of guessing from fleet-wide counters
+        self._worker_records: dict[int, int] = {}
+        self._worker_breaches: dict[int, int] = {}
 
     # ---------------- recording ----------------
 
@@ -113,9 +120,14 @@ class FlightRecorder:
         status: str,
         ttft_ms: Optional[float],
         duration_ms: float,
+        worker_id: Optional[int] = None,
     ) -> Optional[dict]:
         """Called once per finished request (the frontend's guard-done
-        path). Returns the autopsy dict when one was produced."""
+        path). ``worker_id`` is the router's placement (the
+        ``routed_worker_id`` annotation) when known — it attributes the
+        finish to a worker for the quarantine loop and lands in the
+        autopsy so a breach names its worker. Returns the autopsy dict
+        when one was produced."""
         rec = {
             "request_id": request_id,
             "model": model,
@@ -123,6 +135,7 @@ class FlightRecorder:
             "status": status,
             "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
             "duration_ms": round(duration_ms, 3),
+            "worker_id": worker_id,
             "ts": time.time(),
         }
         self.recorded_total += 1
@@ -136,6 +149,14 @@ class FlightRecorder:
         # or exhausted — the stream ends in status="error"); both paths
         # autopsy, tagged with their reason
         errored = status not in ("success", "disconnect", "shed")
+        if worker_id is not None:
+            self._worker_records[worker_id] = (
+                self._worker_records.get(worker_id, 0) + 1
+            )
+            if breached or errored:
+                self._worker_breaches[worker_id] = (
+                    self._worker_breaches.get(worker_id, 0) + 1
+                )
         if not breached and not errored:
             return None
         reason = "slo_breach" if breached else f"finish_{status}"
@@ -227,6 +248,15 @@ class FlightRecorder:
 
     def autopsy_ids(self) -> list[str]:
         return list(self._autopsies)
+
+    def worker_counters(self) -> dict[int, tuple[int, int]]:
+        """``worker_id -> (unhealthy_total, records_total)``, cumulative
+        — the quarantine loop's per-tick evidence (it differences
+        successive reads, so this stays allocation-cheap)."""
+        return {
+            wid: (self._worker_breaches.get(wid, 0), n)
+            for wid, n in self._worker_records.items()
+        }
 
     def counters(self) -> dict:
         """Plain-gauge scrape source (Metrics.register_source)."""

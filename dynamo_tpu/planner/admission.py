@@ -118,10 +118,17 @@ class AdmissionGate:
         telemetry=None,
         model_classes: Optional[dict] = None,
     ):
+        self._clock = clock
         self.bucket = TokenBucket(
             rate_req_s, burst if burst is not None else max(rate_req_s, 1.0),
             clock,
         )
+        # autopilot headroom lane: optional per-class buckets holding a
+        # class at its MEASURED headroom (set_class_rate) — checked
+        # before the global bucket so a shed never needs a refund. No
+        # bucket = the class rides the global rate alone, exactly the
+        # pre-autopilot behavior.
+        self.class_buckets: dict[str, TokenBucket] = {}
         self.classes = {c.name: c for c in classes}
         self.default_class = classes[0].name
         #: per-model SLO routing (multi-model serving): model/adapter
@@ -136,7 +143,8 @@ class AdmissionGate:
         #: optional TelemetryAggregator — arrivals feed the planner
         self.telemetry = telemetry
         self.inflight: dict[str, int] = {c.name: 0 for c in classes}
-        self.stats = {"admitted_total": 0, "shed_total": 0}
+        self.stats = {"admitted_total": 0, "shed_total": 0,
+                      "shed_headroom_total": 0}
         for c in classes:
             self.stats[f"admitted_{c.name}"] = 0
             self.stats[f"shed_{c.name}"] = 0
@@ -166,6 +174,29 @@ class AdmissionGate:
         if rate_req_s > 0:
             self.bucket.set_rate(rate_req_s, burst)
 
+    def set_class_rate(self, name: str, rate_req_s: float,
+                       burst: Optional[float] = None) -> None:
+        """Autopilot headroom update: hold ONE class at its measured
+        per-class headroom (capacity left after more-critical classes'
+        observed demand), instead of the static reserve fraction.
+        ``rate_req_s <= 0`` removes the cap (back to the global bucket
+        alone — the autopilot stopping must not freeze its last
+        decision into the gate forever)."""
+        if name not in self.classes:
+            return
+        if rate_req_s <= 0:
+            self.class_buckets.pop(name, None)
+            return
+        b = self.class_buckets.get(name)
+        if b is None:
+            self.class_buckets[name] = TokenBucket(
+                rate_req_s,
+                burst if burst is not None else max(rate_req_s, 1.0),
+                self._clock,
+            )
+        else:
+            b.set_rate(rate_req_s, burst)
+
     # -- the gate --
 
     def admit(self, slo_class: Optional[str] = None,
@@ -176,6 +207,15 @@ class AdmissionGate:
             self.telemetry.record_arrival(prompt_tokens)
         if self.inflight[name] >= cls.max_inflight:
             return self._shed(cls, "queue", cls.min_retry_after_s)
+        # measured-headroom lane first (no refund path needed): a class
+        # the autopilot capped sheds here before touching the global
+        # bucket, so its excess can't drain tokens interactive needs
+        cb = self.class_buckets.get(name)
+        if cb is not None and not cb.try_take(1.0):
+            wait = cb.time_until(1.0)
+            return self._shed(
+                cls, "headroom", max(cls.min_retry_after_s, math.ceil(wait))
+            )
         # the reserve may never consume the whole bucket: cap the floor
         # so a full bucket always admits one request of ANY class (at
         # burst < 2 an uncapped batch floor of burst/2 would starve the
@@ -196,6 +236,8 @@ class AdmissionGate:
               retry_after: float) -> AdmissionDecision:
         self.stats["shed_total"] += 1
         self.stats[f"shed_{cls.name}"] += 1
+        if reason == "headroom":
+            self.stats["shed_headroom_total"] += 1
         return AdmissionDecision(False, cls.name, reason, retry_after)
 
     def done(self, slo_class: str) -> None:
@@ -209,6 +251,8 @@ class AdmissionGate:
         out["admission_rate_req_s"] = round(self.bucket.rate, 6)
         for name, n in self.inflight.items():
             out[f"admission_inflight_{name}"] = n
+        for name, b in self.class_buckets.items():
+            out[f"admission_headroom_rate_{name}"] = round(b.rate, 6)
         return out
 
 

@@ -1,0 +1,100 @@
+"""Worker-side mirror of autopilot quarantine state.
+
+The quarantine decision is ROUTER-side (the scheduler soft-excludes
+quarantined workers, like ``resharding`` ones — held streams drain, a
+lone-worker pool still serves); the worker itself needs no actuation.
+What it needs is *visibility*: an operator looking at one worker's
+scrape must see that the autopilot pulled it from rotation, and the
+fleet metrics plane must be able to render quarantine state per worker
+without reaching into the controller. The :class:`QuarantineListener`
+subscribes the ``autopilot-health`` subject and mirrors this worker's
+membership into ``engine.stats`` (``autopilot_quarantined`` flag,
+``autopilot_quarantines_total`` transitions), which the existing
+``load_metrics`` -> WorkerLoad -> metrics-render plane carries
+fleet-wide.
+
+Same shape as the reshard/warmup listeners: tolerant decode, one bad
+event never ends the subscription loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..autopilot.protocols import AUTOPILOT_HEALTH_SUBJECT, HealthDirective
+
+logger = logging.getLogger(__name__)
+
+
+class QuarantineListener:
+    def __init__(self, drt, component, worker_id: int, engine):
+        self.drt = drt
+        self.subject = component.event_subject(AUTOPILOT_HEALTH_SUBJECT)
+        self.worker_id = worker_id
+        self.engine = engine
+        #: this worker's current view of itself
+        self.quarantined = False
+        self.probing = False
+        self.quarantines_seen = 0
+        self.directives_seen = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+
+    async def start(self) -> "QuarantineListener":
+        sub = self.drt.bus.subscribe(self.subject)
+        ready = getattr(sub, "ready", None)
+        if ready is not None:
+            await ready
+        self._sub = sub
+        self._task = self.drt.runtime.spawn(self._consume(sub))
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _consume(self, sub) -> None:
+        async for msg in sub:
+            try:
+                directive = HealthDirective.from_bytes(msg.payload)
+                if directive is None:
+                    continue
+                self.apply(directive)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad event must not
+                logger.debug("bad health directive", exc_info=True)
+
+    def apply(self, directive: HealthDirective) -> None:
+        """Fold one health view (full replacement — newest wins)."""
+        self.directives_seen += 1
+        was = self.quarantined
+        self.quarantined = self.worker_id in directive.quarantined
+        self.probing = self.worker_id in directive.probing
+        if self.quarantined and not was:
+            self.quarantines_seen += 1
+            logger.warning("worker %x quarantined by autopilot",
+                           self.worker_id)
+        elif was and not self.quarantined:
+            logger.info("worker %x %s by autopilot", self.worker_id,
+                        "probing" if self.probing else "reinstated")
+        self._mirror()
+
+    def _mirror(self) -> None:
+        stats = getattr(self.engine, "stats", None)
+        if stats is None:
+            return
+        stats["autopilot_quarantined"] = int(self.quarantined)
+        stats["autopilot_quarantines_total"] = self.quarantines_seen
+
+    def stats(self) -> dict:
+        return {
+            "autopilot_quarantined": int(self.quarantined),
+            "autopilot_probing": int(self.probing),
+            "autopilot_quarantines_total": self.quarantines_seen,
+            "autopilot_health_directives_seen": self.directives_seen,
+        }

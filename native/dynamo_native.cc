@@ -54,12 +54,17 @@ uint64_t dn_chain_hash(uint64_t parent, uint64_t local) {
 }
 
 // batch: hashes for every full block of a token sequence; returns the
-// number of full blocks written to out_local/out_chain.
-int dn_sequence_block_hashes(const int64_t* tokens, int n, int block_size,
-                             uint64_t* out_local, uint64_t* out_chain) {
+// number of full blocks written to out_local/out_chain. `salt` seeds
+// the chain's root parent (the per-model hash namespace,
+// engine/allocator.py model_hash_salt); 0 = the unsalted base chain —
+// bit-identical to the Python walk, whose `parent or 0` folds a zero
+// salt onto the unsalted root the same way.
+int dn_sequence_block_hashes_salted(const int64_t* tokens, int n,
+                                    int block_size, uint64_t salt,
+                                    uint64_t* out_local, uint64_t* out_chain) {
   if (block_size <= 0) return 0;
   int full = n / block_size;
-  uint64_t parent = 0;
+  uint64_t parent = salt;
   for (int b = 0; b < full; ++b) {
     uint64_t local = dn_block_token_hash(tokens + b * block_size, block_size);
     parent = dn_chain_hash(parent, local);
@@ -67,6 +72,12 @@ int dn_sequence_block_hashes(const int64_t* tokens, int n, int block_size,
     out_chain[b] = parent;
   }
   return full;
+}
+
+int dn_sequence_block_hashes(const int64_t* tokens, int n, int block_size,
+                             uint64_t* out_local, uint64_t* out_chain) {
+  return dn_sequence_block_hashes_salted(tokens, n, block_size, 0,
+                                         out_local, out_chain);
 }
 
 // ------------------------------------------------------------ prefix index

@@ -69,6 +69,7 @@ if [[ "${1:-}" != "--fast" ]]; then
         tests/test_trace_overhead.py \
         tests/test_planner.py \
         tests/test_multi_model.py \
+        tests/test_autopilot.py \
         -q -m 'not slow' -p no:cacheprovider
 fi
 

@@ -251,6 +251,178 @@ def replay_trace(trace: list[dict], speedup: float = 4.0) -> dict:
     return asyncio.run(_replay(trace, speedup))
 
 
+# ---------------------------------------------------------- planner sim
+
+class _SimRecorder:
+    """The slice of the flight recorder the autopilot consumes: the
+    cumulative per-worker (unhealthy, finished) counters."""
+
+    def __init__(self):
+        self.counters: dict[int, list[int]] = {}
+
+    def record(self, worker_id: int, breached: bool) -> None:
+        c = self.counters.setdefault(worker_id, [0, 0])
+        c[1] += 1
+        if breached:
+            c[0] += 1
+
+    def worker_counters(self) -> dict:
+        return {w: (c[0], c[1]) for w, c in self.counters.items()}
+
+
+def planner_sim(seed: int, ticks: int = 90, tick_s: float = 2.0) -> dict:
+    """Fake-clock planner + autopilot decision loop — no live workers.
+
+    Drives the REAL control stack (TelemetryAggregator -> Planner and
+    Autopilot -> AdmissionGate) against a scripted three-worker fleet
+    on an injected clock: worker 3 starts with a cold XLA bucket grid
+    (pre-warm loop), worker 2 breaches hard for a mid-sim window
+    (quarantine -> probe -> reinstate), and a diurnal load peak pushes
+    utilization over the headroom threshold (measured per-class caps).
+    Pure decision loop — deterministic from ``seed``; same seed, same
+    JSON."""
+    from dynamo_tpu.autopilot import Autopilot, AutopilotConfig
+    from dynamo_tpu.autopilot.quarantine import QuarantineConfig
+    from dynamo_tpu.kv_router.scheduler import WorkerLoad
+    from dynamo_tpu.planner import (
+        CapacityModel, Planner, PlannerConfig, SloTargets,
+        TelemetryAggregator,
+    )
+    from dynamo_tpu.planner.admission import AdmissionGate
+
+    rng = random.Random(seed)
+    now = [1000.0]
+    clk = lambda: now[0]  # noqa: E731
+
+    telemetry = TelemetryAggregator(window_s=30.0, clock=clk)
+    planner = Planner(
+        telemetry, CapacityModel(400.0, 400.0),
+        PlannerConfig(tick_s=tick_s, slo=SloTargets()), clock=clk,
+    )
+    gate = AdmissionGate(12.0, burst=12.0, clock=clk)
+    recorder = _SimRecorder()
+    ap = Autopilot(
+        telemetry=telemetry, recorder=recorder, gate=gate,
+        config=AutopilotConfig(
+            interval_s=tick_s, headroom=True, headroom_window_s=20.0,
+            prewarm_cooldown_s=6.0,
+            quarantine_cfg=QuarantineConfig(
+                trip_ticks=2, hold_s=6 * tick_s, probe_ticks=2,
+            ),
+        ),
+        clock=clk,
+    )
+
+    WORKERS = (1, 2, 3)
+    served = {w: 0 for w in WORKERS}  # cumulative requests_total
+    tokens = {w: 0 for w in WORKERS}
+    warm = {1: True, 2: True, 3: False}  # worker 3: cold bucket grid
+    warm_eta: dict[int, int] = {}  # simulated actuator: ticks to warm
+    warm_tick = None
+    quarantine_log: list[tuple] = []
+    headroom_log: list[tuple] = []
+    shed_headroom_prev = 0
+
+    for i in range(ticks):
+        now[0] += tick_s
+        peak = ticks // 3 <= i < 2 * ticks // 3  # diurnal peak window
+        pathology = ticks // 3 + 5 <= i < ticks // 2  # worker 2 breaches
+
+        # offered load through the REAL gate: interactive steady, batch
+        # surging at peak (the headroom loop's shedding target)
+        for _ in range(rng.randrange(2, 5)):
+            d = gate.admit("interactive")
+            if d.admitted:
+                gate.done("interactive")
+        for _ in range(rng.randrange(12, 18) if peak else rng.randrange(0, 3)):
+            d = gate.admit("batch")
+            if d.admitted:
+                gate.done("batch")
+
+        # the fleet's measured plane for this tick
+        loads = []
+        quarantined_now = set(ap.quarantine.quarantined)
+        for w in WORKERS:
+            routed = w not in quarantined_now and (warm[w] or w == 3)
+            n = rng.randrange(6, 10) if (routed and peak) else \
+                rng.randrange(1, 4) if routed else 0
+            served[w] += n
+            tokens[w] += 8 * n
+            for _ in range(n):
+                recorder.record(
+                    w, pathology and w == 2 and rng.random() < 0.8
+                )
+            loads.append(WorkerLoad(
+                worker_id=w,
+                active_requests=7 if peak else 2, total_slots=8,
+                waiting=3 if peak else 0,
+                kv_active_blocks=96 if peak else 16, kv_total_blocks=128,
+                requests_total=served[w], tokens_generated=tokens[w],
+                prompt_tokens_total=16 * served[w],
+                xla_warm_buckets=4 if warm[w] else 0,
+                xla_reachable_buckets=4 if warm[w] else 0,
+                ts=now[0],
+            ))
+        telemetry.observe_loads(loads)
+
+        before = len(ap.quarantine.events)
+        directives_before = ap.warmup_directives
+        ap.tick()
+        planner.tick()
+        for ev in ap.quarantine.events[before:]:
+            quarantine_log.append((i, ev.action, ev.worker_id))
+        if ap.headroom_caps and (not headroom_log
+                                 or headroom_log[-1][1] != sorted(
+                                     ap.headroom_caps)):
+            headroom_log.append((i, sorted(ap.headroom_caps)))
+        # simulated warmup actuator: a directive at a cold worker warms
+        # its grid two ticks later (the real WarmupListener's role)
+        if ap.warmup_directives > directives_before:
+            warm_eta.setdefault(3, 2)
+        for w in list(warm_eta):
+            warm_eta[w] -= 1
+            if warm_eta[w] <= 0:
+                del warm_eta[w]
+                if not warm[w]:
+                    warm[w] = True
+                    warm_tick = i + 1
+
+    shed_headroom_prev = gate.stats["shed_headroom_total"]
+    return {
+        "ticks": ticks,
+        "warmup_directives": ap.warmup_directives,
+        "worker3_warm_tick": warm_tick,
+        "prewarm_holds_now": sorted(ap.prewarm_hold),
+        "quarantine_events": quarantine_log,
+        "quarantined_now": ap.quarantine.quarantined,
+        "headroom_caps_applied": len(headroom_log),
+        "admission": {
+            "admitted_total": gate.stats["admitted_total"],
+            "shed_total": gate.stats["shed_total"],
+            "shed_headroom_total": shed_headroom_prev,
+        },
+        "planner_decode_replicas":
+            planner.decode_guard.current
+            if hasattr(planner.decode_guard, "current")
+            else None,
+        "planner_ticks": planner.stats["ticks"],
+    }
+
+
+def check_sim(result: dict) -> None:
+    """The four loops must all have closed inside the sim."""
+    actions = [(a, w) for _i, a, w in result["quarantine_events"]]
+    assert result["warmup_directives"] >= 1, "pre-warm loop never fired"
+    assert result["worker3_warm_tick"] is not None, "worker 3 never warmed"
+    assert result["prewarm_holds_now"] == [], "stale pre-warm hold"
+    assert ("quarantine", 2) in actions, "worker 2 never quarantined"
+    assert ("reinstate", 2) in actions, "worker 2 never reinstated"
+    assert result["quarantined_now"] == [], "quarantine never cleared"
+    assert result["admission"]["shed_headroom_total"] > 0, \
+        "headroom loop never shed"
+    assert result["planner_ticks"] == result["ticks"]
+
+
 def check(result: dict, trace: list[dict]) -> None:
     """Per-model TTFT p99 assertions from the measured histograms."""
     assert result["errors"] == 0, f"replay errors: {result['error_sample']}"
@@ -280,7 +452,26 @@ def main() -> int:
     ap.add_argument("--check-repro", action="store_true",
                     help="replay the seed twice on fresh stacks and "
                          "assert the runs agree")
+    ap.add_argument("--planner-sim", action="store_true",
+                    help="fake-clock planner + autopilot decision loop "
+                         "over a scripted fleet — no live workers, no "
+                         "JAX; asserts all four autopilot loops close "
+                         "and (with --check-repro) bit-identical "
+                         "decisions across runs")
+    ap.add_argument("--sim-ticks", type=int, default=90)
     args = ap.parse_args()
+
+    if args.planner_sim:
+        result = planner_sim(args.seed, ticks=args.sim_ticks)
+        check_sim(result)
+        print(json.dumps({"sim1": result}))
+        if args.check_repro:
+            result2 = planner_sim(args.seed, ticks=args.sim_ticks)
+            check_sim(result2)
+            assert json.dumps(result) == json.dumps(result2), \
+                "planner sim not deterministic"
+            print(json.dumps({"sim2": result2, "reproducible": True}))
+        return 0
 
     trace = gen_trace(args.seed, args.requests, day_s=args.day_s)
     if args.dump_trace:

@@ -228,6 +228,40 @@ def test_bench_emits_one_json_line_cpu_smoke(tmp_path):
     assert ps["hinted_request_stages"] == 0, ps
     assert ps["prestage_hits"] >= 1, ps
     assert ps["adapter_bytes_staged"] > 0, ps
+    # the autopilot's four loops must close on measured data (ISSUE
+    # 20): pre-warm eliminates the first-dispatch compile stall
+    # (compile-counter delta, not timing), tail-aware routing escapes
+    # the bimodal worker mean routing walks into, the quarantine
+    # lifecycle trips/probes/reinstates with zero client-visible
+    # errors, headroom caps shed and lift. Direction-only: TTFT
+    # magnitudes belong to the solo bench artifact
+    apb = result.get("bench_autopilot")
+    assert apb, result.get("bench_autopilot_error", "metric missing")
+    pw = apb["prewarm"]
+    assert pw["cold_serve_compiles"] >= 1, pw
+    assert pw["warm_serve_compiles"] == 0, pw
+    assert pw["warm_first_ttft_ms"] < pw["cold_first_ttft_ms"], pw
+    assert pw["warmups_applied"] == 1, pw
+    assert pw["held_then_released"] is True, pw
+    assert pw["tokens_match"] is True, pw
+    tl = apb["tail_routing"]
+    assert tl["mean"]["picks"] == ["bimodal"] * 3, tl
+    assert tl["tail_aware"]["picks"] == ["healthy"] * 3, tl
+    assert tl["tail_aware"]["ttft_p50_ms"] < tl["mean"]["ttft_p50_ms"], tl
+    assert tl["tail_overrides"] >= 1, tl
+    assert tl["cost_decisions"] == 3, tl
+    assert tl["tokens_match"] is True, tl
+    q = apb["quarantine"]
+    assert q["tripped"] == ["bimodal"], q
+    assert q["events"][0] == "quarantine:bimodal", q
+    assert "reinstate:bimodal" in q["events"], q
+    assert q["post_quarantine_pick"] == "healthy", q
+    assert q["reinstated"] is True, q
+    assert q["client_errors"] == 0, q
+    hr = apb["headroom"]
+    assert hr["shed_headroom_total"] > 0, hr
+    assert hr["interactive_capped"] is False, hr
+    assert hr["caps_lifted"] is True, hr
 
 
 def test_smoke_regression_band_catches_r03_drop():

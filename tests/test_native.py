@@ -31,6 +31,40 @@ def test_hashes_match_python():
         assert native.sequence_block_hashes(toks, bs) == expect
 
 
+def test_salted_hashes_match_python():
+    """The salted native chain (per-model hash namespaces) must be
+    bit-identical to the pure-Python salted walk — these hashes address
+    KV blocks across processes, so a one-bit skew silently zeroes every
+    adapter prefix hit."""
+    assert native.salted_available()
+    rng = random.Random(11)
+    fixed_salt = pyalloc.model_hash_salt("adapter-x")
+    for _ in range(50):
+        toks = [rng.randrange(0, 1 << 31) for _ in range(rng.randrange(1, 96))]
+        bs = rng.choice([1, 4, 16])
+        for salt in (fixed_salt, rng.getrandbits(64), 0):
+            expect, parent = [], salt
+            for i in range(0, len(toks) - len(toks) % bs, bs):
+                local = pyalloc.block_token_hash(toks[i : i + bs])
+                parent = pyalloc.chain_hash(parent, local)
+                expect.append((local, parent))
+            assert native.sequence_block_hashes(toks, bs, salt=salt) == expect
+    # salt=0 must collapse onto the unsalted chain (Python's `parent
+    # or 0` does; a native skew here would fork the base namespace)
+    toks = [rng.randrange(0, 1 << 31) for _ in range(64)]
+    assert native.sequence_block_hashes(toks, 16, salt=0) == \
+        native.sequence_block_hashes(toks, 16)
+    # the allocator front door routes salted calls through the native
+    # layer now — differential against the forced-Python walk
+    got = pyalloc.sequence_block_hashes(toks, 16, salt=fixed_salt)
+    expect, parent = [], fixed_salt
+    for i in range(0, 64 - 64 % 16, 16):
+        local = pyalloc.block_token_hash(toks[i : i + 16])
+        parent = pyalloc.chain_hash(parent, local)
+        expect.append((local, parent))
+    assert got == expect
+
+
 def _random_events(rng, n_workers=4, n_chains=6, depth=8):
     """Plausible stored/removed event stream over shared chains."""
     chains = []
